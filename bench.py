@@ -1,0 +1,74 @@
+"""Headline benchmark: GPT causal-LM training throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+metric = fused train-step (fwd+bwd+AdamW) throughput in tokens/sec/chip on
+the flagship GPT; vs_baseline = achieved MFU / 0.45 (the BASELINE.json
+north-star MFU target — the reference publishes no in-repo numbers, see
+BASELINE.md).
+"""
+from __future__ import annotations
+
+import functools
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    from paddle_tpu.models.gpt import (GPTConfig, init_gpt_params,
+                                       init_opt_state, train_step)
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=32768, hidden_size=1024, num_layers=24,
+                        num_heads=16, max_seq_len=1024,
+                        sequence_parallel=False, remat=True,
+                        dtype=jnp.bfloat16)
+        batch, seq = 8, 1024
+        iters = 20
+    else:  # CI smoke
+        cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                        num_heads=4, max_seq_len=128,
+                        sequence_parallel=False, remat=False,
+                        dtype=jnp.float32)
+        batch, seq = 2, 64
+        iters = 3
+
+    params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+    opt_state = init_opt_state(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq + 1), 0,
+                                cfg.vocab_size)
+
+    step = jax.jit(functools.partial(train_step, cfg=cfg, lr=1e-4),
+                   donate_argnums=(0, 1))
+    loss, params, opt_state = step(params, opt_state, tokens)
+    float(loss)  # force (block_until_ready is unreliable over the tunnel)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss, params, opt_state = step(params, opt_state, tokens)
+    float(loss)  # forces the whole chained sequence
+    dt = (time.perf_counter() - t0) / iters
+
+    tokens_per_step = batch * seq
+    tps = tokens_per_step / dt
+
+    # MFU: (6*N + 12*L*D*S) FLOPs/token fwd+bwd (incl. attention quadratic)
+    n_params = sum(int(v.size) for v in params.values())
+    flops_per_token = 6.0 * n_params + \
+        12.0 * cfg.num_layers * cfg.hidden_size * seq
+    peak = 197e12 if on_tpu else 1e12  # TPU v5e bf16 peak per chip
+    mfu = flops_per_token * tps / peak
+
+    print(json.dumps({
+        "metric": "gpt_train_tokens_per_sec_per_chip",
+        "value": round(tps, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.45, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

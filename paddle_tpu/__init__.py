@@ -1,0 +1,112 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle's
+capabilities, rebuilt on JAX/XLA/Pallas/pjit.
+
+Top-level namespace mirrors `paddle.*` (reference: python/paddle/__init__.py)
+so reference-shaped user code ports by changing the import. Heavy subpackages
+load lazily (PEP 562).
+"""
+from __future__ import annotations
+
+import importlib
+
+__version__ = "0.1.0"
+
+from .framework import (
+    Tensor, to_tensor, no_grad, enable_grad, is_grad_enabled,
+    set_grad_enabled, seed, get_rng_state, set_rng_state,
+    get_default_dtype, set_default_dtype,
+    Place, TPUPlace, CPUPlace, CUDAPlace, CUDAPinnedPlace, XPUPlace,
+    CustomPlace,
+)
+from .framework import dtype as _dtype_mod
+from .framework.dtype import (
+    bool, uint8, int8, int16, int32, int64, float16, bfloat16, float32,
+    float64, complex64, complex128, float8_e4m3fn, float8_e5m2,
+)
+from .framework.autograd import grad_fn_of as _grad_fn_of
+from .framework.flags import set_flags, get_flags
+
+from .tensor import *  # noqa: F401,F403 — flat tensor-function namespace
+from . import tensor  # noqa: F401
+from . import device  # noqa: F401
+from .device import set_device, get_device, is_compiled_with_cuda  # noqa: F401
+from . import linalg  # noqa: F401
+
+_LAZY_SUBMODULES = (
+    "nn", "optimizer", "ops", "amp", "io", "jit", "autograd", "framework",
+    "distributed", "parallel", "distribution", "vision", "audio", "text",
+    "metric", "static", "inference", "profiler", "incubate", "sparse",
+    "onnx", "hapi", "callbacks", "fft", "signal", "quantization", "utils",
+    "regularizer", "sysconfig", "geometric",
+)
+
+
+def __getattr__(name):
+    if name in _LAZY_SUBMODULES:
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    if name == "Model":
+        from .hapi.model import Model
+        globals()["Model"] = Model
+        return Model
+    if name == "DataParallel":
+        from .parallel.data_parallel import DataParallel
+        globals()["DataParallel"] = DataParallel
+        return DataParallel
+    if name == "Parameter":
+        from .nn.parameter import Parameter
+        globals()["Parameter"] = Parameter
+        return Parameter
+    if name == "ParamAttr":
+        from .nn.param_attr import ParamAttr
+        globals()["ParamAttr"] = ParamAttr
+        return ParamAttr
+    if name in ("save", "load"):
+        from . import framework_io
+        globals()["save"] = framework_io.save
+        globals()["load"] = framework_io.load
+        return globals()[name]
+    if name == "summary":
+        from .hapi.model_summary import summary
+        globals()["summary"] = summary
+        return summary
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
+
+
+dtype = _dtype_mod.convert_dtype
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """paddle.grad analog (reference: python/paddle/autograd)."""
+    return _grad_fn_of(outputs, inputs, grad_outputs, retain_graph,
+                       create_graph, allow_unused)
+
+
+def disable_static(place=None):
+    """Dygraph is the default (and only) eager mode here."""
+    return None
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu has no legacy static-graph mode; use "
+        "paddle_tpu.jit.to_static to compile (the XLA graph IS the static "
+        "program).")
+
+
+def in_dynamic_mode():
+    return True
+
+
+def in_dygraph_mode():
+    return True
+
+
+def set_printoptions(**kwargs):
+    import numpy as _np
+    _np.set_printoptions(**{k: v for k, v in kwargs.items()
+                            if k in ("precision", "threshold", "edgeitems",
+                                     "linewidth")})

@@ -1,0 +1,24 @@
+"""paddle_tpu.autograd namespace.
+
+Reference analog: python/paddle/autograd/ (backward, PyLayer, jacobian).
+"""
+from __future__ import annotations
+
+from ..framework.autograd import (no_grad, enable_grad, is_grad_enabled,
+                                  set_grad_enabled, run_backward)
+from ..framework.tensor import Tensor
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """Multi-tensor backward (reference: autograd/backward_mode.py:23)."""
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+    run_backward(list(tensors), list(grad_tensors), retain_graph=retain_graph)
+
+
+from .py_layer import PyLayer, PyLayerContext  # noqa: E402
+from .functional import jacobian, hessian, vjp, jvp  # noqa: E402

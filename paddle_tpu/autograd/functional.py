@@ -1,0 +1,92 @@
+"""Functional autograd: jacobian/hessian/vjp/jvp.
+
+Reference analog: python/paddle/autograd/autograd.py:30,183 and
+incubate/autograd/functional.py:22,80. Because paddle_tpu's eager ops run on
+jax values, these are direct applications of jax's transforms to a
+functionalized view of the user's Tensor-level function — no custom
+double-backward machinery needed.
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+
+from ..framework.tensor import Tensor
+from ..framework.autograd import no_grad
+
+
+def _functionalize(func: Callable):
+    """Lift a Tensor->Tensor function to a jax-value function."""
+    def pure(*vals):
+        tensors = [Tensor(v, stop_gradient=False) for v in vals]
+        with no_grad():
+            out = func(*tensors)
+        if isinstance(out, (tuple, list)):
+            return tuple(o._value if isinstance(o, Tensor) else o for o in out)
+        return out._value if isinstance(out, Tensor) else out
+    return pure
+
+
+def _vals(xs):
+    if isinstance(xs, (tuple, list)):
+        return tuple(x._value if isinstance(x, Tensor) else x for x in xs)
+    return (xs._value if isinstance(xs, Tensor) else xs,)
+
+
+def _wrap(tree):
+    return jax.tree_util.tree_map(lambda v: Tensor(v, stop_gradient=True),
+                                  tree)
+
+
+def jacobian(func, xs, is_batched=False):
+    pure = _functionalize(func)
+    vals = _vals(xs)
+    jac = jax.jacrev(pure, argnums=tuple(range(len(vals))))(*vals)
+    out = _wrap(jac)
+    if not isinstance(xs, (tuple, list)):
+        if isinstance(out, (tuple, list)) and len(out) == 1:
+            return out[0]
+    return out
+
+
+def hessian(func, xs, is_batched=False):
+    pure = _functionalize(func)
+    vals = _vals(xs)
+    hess = jax.hessian(pure, argnums=tuple(range(len(vals))))(*vals)
+    out = _wrap(hess)
+    if not isinstance(xs, (tuple, list)):
+        while isinstance(out, (tuple, list)) and len(out) == 1:
+            out = out[0]
+    return out
+
+
+def vjp(func, xs, v=None):
+    pure = _functionalize(func)
+    vals = _vals(xs)
+    primals, vjp_fn = jax.vjp(pure, *vals)
+    if v is None:
+        import jax.numpy as jnp
+        v = jax.tree_util.tree_map(lambda p: jnp.ones_like(p), primals)
+    else:
+        v = jax.tree_util.tree_map(
+            lambda t: t._value if isinstance(t, Tensor) else t, v)
+    grads = vjp_fn(v)
+    outs = _wrap(primals)
+    gouts = _wrap(grads)
+    if not isinstance(xs, (tuple, list)) and isinstance(gouts, tuple) and len(gouts) == 1:
+        gouts = gouts[0]
+    return outs, gouts
+
+
+def jvp(func, xs, v=None):
+    pure = _functionalize(func)
+    vals = _vals(xs)
+    if v is None:
+        import jax.numpy as jnp
+        v = tuple(jnp.ones_like(x) for x in vals)
+    else:
+        vv = _vals(v) if isinstance(v, (tuple, list)) else _vals([v])
+        v = vv
+    primals, tangents = jax.jvp(pure, vals, v)
+    return _wrap(primals), _wrap(tangents)

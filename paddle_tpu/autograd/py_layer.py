@@ -1,0 +1,121 @@
+"""PyLayer — user-defined autograd functions.
+
+Reference analog: python/paddle/autograd/py_layer.py:248 +
+/root/reference/paddle/fluid/eager/pylayer/. Here a PyLayer inserts a custom
+TapeNode whose vjp calls the user's `backward` (which itself runs paddle_tpu
+ops, so it stays jax-traceable and can appear inside a jit region).
+"""
+from __future__ import annotations
+
+from typing import Any, List
+
+import numpy as np
+
+from ..framework import dtype as dtypes
+from ..framework.autograd import TapeNode, is_grad_enabled, no_grad
+from ..framework.tensor import Tensor
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved: List[Tensor] = []
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = [t.detach() if isinstance(t, Tensor) else t
+                       for t in tensors]
+
+    def saved_tensor(self):
+        return list(self._saved)
+
+
+class _PyLayerNode(TapeNode):
+    __slots__ = ("ctx", "backward_fn", "n_inputs")
+
+    def __init__(self, ctx, backward_fn, inputs, out_avals, diff_in_mask,
+                 diff_out_mask):
+        super().__init__(
+            name="pylayer", closure=lambda *a: None, saved_vals=(),
+            inputs=inputs, diff_in_mask=diff_in_mask,
+            diff_out_mask=diff_out_mask, out_avals=out_avals)
+        self.ctx = ctx
+        self.backward_fn = backward_fn
+
+    def release(self):
+        self.ctx = None
+        self.inputs = None
+        self.released = True
+
+    def vjp(self, out_grads):
+        if self.released:
+            raise RuntimeError("PyLayer node released; use retain_graph=True")
+        import jax.numpy as jnp
+        grads_in = []
+        for (shape, dt), g, m in zip(self.out_avals, out_grads,
+                                     self.diff_out_mask):
+            if g is None and self.ctx.materialize_grads and m:
+                g = jnp.zeros(shape, dt)
+            grads_in.append(Tensor(g, stop_gradient=True)
+                            if g is not None else None)
+        with no_grad():
+            result = self.backward_fn(self.ctx, *grads_in)
+        if not isinstance(result, (tuple, list)):
+            result = (result,)
+        out = []
+        ri = iter(result)
+        for m in self.diff_in_mask:
+            if m:
+                r = next(ri, None)
+                out.append(None if r is None else
+                           (r._value if isinstance(r, Tensor) else r))
+            else:
+                out.append(None)
+        return out
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, attrs):
+        super().__init__(name, bases, attrs)
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        with no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outputs, (tuple, list))
+        outs = (outputs,) if single else tuple(outputs)
+
+        grad_needed = is_grad_enabled() and any(
+            not t.stop_gradient and dtypes.is_differentiable(t.dtype)
+            for t in tensor_inputs)
+        if grad_needed:
+            diff_in = [not t.stop_gradient and
+                       dtypes.is_differentiable(t.dtype)
+                       for t in tensor_inputs]
+            diff_out = [isinstance(o, Tensor) and
+                        dtypes.is_differentiable(o.dtype) for o in outs]
+            node = _PyLayerNode(
+                ctx, cls.backward, tensor_inputs,
+                [(tuple(o.shape), o.dtype) for o in outs],
+                diff_in, diff_out)
+            for i, o in enumerate(outs):
+                if diff_out[i]:
+                    o.stop_gradient = False
+                    o._node = node
+                    o._out_idx = i
+        return outs[0] if single else list(outs)
+
+
+class LegacyPyLayer(PyLayer):
+    pass

@@ -1,0 +1,153 @@
+"""paddle_tpu.device — device management API.
+
+Reference analog: python/paddle/device/ (set_device, cuda streams). On TPU,
+streams/events collapse into XLA's async dispatch; synchronize() is
+block_until_ready over live arrays.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..framework.place import (Place, TPUPlace, CPUPlace, CUDAPlace,
+                               _default_place)
+
+_current_device = None
+
+
+def set_device(device):
+    global _current_device
+    if isinstance(device, Place):
+        _current_device = device
+        return _current_device
+    device = str(device)
+    if device.startswith(("gpu", "cuda", "tpu", "xpu")):
+        idx = 0
+        if ":" in device:
+            idx = int(device.split(":")[1])
+        _current_device = TPUPlace(idx)
+    elif device.startswith("cpu"):
+        _current_device = CPUPlace()
+    else:
+        raise ValueError(f"unknown device {device!r}")
+    return _current_device
+
+
+def get_device() -> str:
+    place = _current_device or _default_place()
+    if isinstance(place, CPUPlace):
+        return "cpu"
+    return f"tpu:{place.get_device_id()}"
+
+
+def get_current_place() -> Place:
+    return _current_device or _default_place()
+
+
+def device_count() -> int:
+    return len(jax.devices())
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return []
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return True
+
+
+def is_compiled_with_distribute() -> bool:
+    return True
+
+
+def synchronize(device=None):
+    """Block until all dispatched work completes (stream sync analog)."""
+    (jax.effects_barrier if hasattr(jax, "effects_barrier") else
+     jax.block_until_ready)(jax.numpy.zeros(()))
+
+
+class Stream:
+    """Compat shim: XLA on TPU has a single ordered compute stream."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+def current_stream(device=None):
+    return Stream(device)
+
+
+class cuda:
+    """paddle.device.cuda compat namespace."""
+    Stream = Stream
+    Event = Event
+
+    @staticmethod
+    def synchronize(device=None):
+        return synchronize(device)
+
+    @staticmethod
+    def device_count():
+        return device_count()
+
+    @staticmethod
+    def current_stream(device=None):
+        return Stream(device)
+
+    @staticmethod
+    def stream_guard(stream):
+        import contextlib
+        return contextlib.nullcontext()
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        return 0
+
+    @staticmethod
+    def memory_allocated(device=None):
+        return 0

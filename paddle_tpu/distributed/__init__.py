@@ -1,0 +1,16 @@
+"""paddle_tpu.distributed — alias of paddle_tpu.parallel (the reference's
+import path, python/paddle/distributed/)."""
+from ..parallel import *  # noqa: F401,F403
+from ..parallel import fleet  # noqa: F401
+from ..parallel.collective import ReduceOp  # noqa: F401
+from ..parallel import (  # noqa: F401
+    init_parallel_env, get_rank, get_world_size, spawn, DataParallel)
+from ..parallel import sharding  # noqa: F401
+from .. import parallel as _parallel
+import sys as _sys
+
+# submodule aliases so `import paddle_tpu.distributed.fleet` etc. work
+_sys.modules[__name__ + ".fleet"] = fleet
+_sys.modules[__name__ + ".sharding"] = sharding
+from ..parallel import collective as _collective  # noqa: E402
+_sys.modules[__name__ + ".collective"] = _collective

@@ -1,0 +1,10 @@
+"""Core framework: dtype, Place, Tensor, autograd tape, dispatch, RNG."""
+from . import dtype
+from .dtype import (convert_dtype, get_default_dtype, set_default_dtype)
+from .place import (Place, TPUPlace, CPUPlace, CUDAPlace, CUDAPinnedPlace,
+                    XPUPlace, CustomPlace, _default_place)
+from .tensor import Tensor, to_tensor
+from .autograd import (no_grad, enable_grad, is_grad_enabled,
+                       set_grad_enabled, run_backward, grad_fn_of)
+from .random import seed, get_rng_state, set_rng_state, next_key
+from .dispatch import apply, defop, register_op, get_op, op_names, set_eager_jit

@@ -1,0 +1,112 @@
+"""Dtype system for paddle_tpu.
+
+TPU-native rebuild of the reference's dtype surface
+(/root/reference/paddle/phi/common/data_type.h, python/paddle/framework/dtype.py).
+Instead of a custom enum bridged over protobuf VarType, dtypes ARE numpy/jax
+dtypes — everything under jit sees the native XLA element type directly.
+bfloat16 is first-class (it is the TPU MXU's native matmul input type).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import ml_dtypes
+
+# Canonical dtype objects (np.dtype instances; jax accepts these everywhere).
+bool = np.dtype(np.bool_)  # noqa: A001  (paddle exposes paddle.bool)
+uint8 = np.dtype(np.uint8)
+int8 = np.dtype(np.int8)
+int16 = np.dtype(np.int16)
+int32 = np.dtype(np.int32)
+int64 = np.dtype(np.int64)
+float16 = np.dtype(np.float16)
+bfloat16 = np.dtype(ml_dtypes.bfloat16)
+float32 = np.dtype(np.float32)
+float64 = np.dtype(np.float64)
+complex64 = np.dtype(np.complex64)
+complex128 = np.dtype(np.complex128)
+float8_e4m3fn = np.dtype(ml_dtypes.float8_e4m3fn)
+float8_e5m2 = np.dtype(ml_dtypes.float8_e5m2)
+
+_NAME_TO_DTYPE = {
+    "bool": bool, "uint8": uint8, "int8": int8, "int16": int16,
+    "int32": int32, "int64": int64, "float16": float16, "bfloat16": bfloat16,
+    "float32": float32, "float64": float64, "complex64": complex64,
+    "complex128": complex128, "float8_e4m3fn": float8_e4m3fn,
+    "float8_e5m2": float8_e5m2,
+}
+
+_FLOATING = {float16, bfloat16, float32, float64, float8_e4m3fn, float8_e5m2}
+_COMPLEX = {complex64, complex128}
+_INTEGER = {uint8, int8, int16, int32, int64}
+
+
+def canonicalize(dtype) -> np.dtype:
+    """Map to the XLA-canonical dtype (int64→int32, float64→float32 under the
+    default x32 mode). TPU has no native 64-bit path; the reference's int64
+    indices become int32 here, which is also what XLA wants for gather/scatter
+    performance."""
+    import jax.dtypes
+    return np.dtype(jax.dtypes.canonicalize_dtype(dtype))
+
+
+def convert_dtype(dtype) -> np.dtype:
+    """Normalize any dtype spec (str | np.dtype | jnp dtype | python type) to np.dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        name = dtype
+        if name.startswith("paddle."):
+            name = name[len("paddle."):]
+        if name in _NAME_TO_DTYPE:
+            return canonicalize(_NAME_TO_DTYPE[name])
+        return canonicalize(np.dtype(name))
+    if dtype is float:
+        return float32
+    if dtype is int:
+        return canonicalize(int64)
+    try:
+        return canonicalize(np.dtype(dtype))
+    except TypeError:
+        # jnp.float32-style scalar types
+        return canonicalize(np.dtype(jnp.dtype(dtype)))
+
+
+def dtype_name(dtype) -> str:
+    return convert_dtype(dtype).name
+
+
+def is_floating_point(dtype) -> builtins_bool:  # type: ignore[name-defined]
+    return convert_dtype(dtype) in _FLOATING
+
+
+def is_complex(dtype):
+    return convert_dtype(dtype) in _COMPLEX
+
+
+def is_integer(dtype):
+    return convert_dtype(dtype) in _INTEGER
+
+
+def is_differentiable(dtype):
+    d = convert_dtype(dtype)
+    return d in _FLOATING or d in _COMPLEX
+
+
+# Default dtype management (reference: paddle.set_default_dtype,
+# python/paddle/framework/framework.py).
+_default_dtype = float32
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    d = convert_dtype(d)
+    if d not in (float16, bfloat16, float32, float64):
+        raise TypeError(
+            "set_default_dtype only supports [float16, bfloat16, float32, "
+            f"float64], but received {d}")
+    _default_dtype = d
+
+
+def get_default_dtype() -> np.dtype:
+    return _default_dtype

@@ -1,0 +1,59 @@
+"""Global flag registry.
+
+Reference analog: the gflags exported via PHI_DEFINE_EXPORTED_*
+(/root/reference/paddle/phi/core/flags.cc) + paddle.set_flags. Flags may be
+seeded from FLAGS_* environment variables just like the reference.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+
+_FLAGS: Dict[str, Any] = {}
+
+
+def define_flag(name: str, default, help_str: str = ""):
+    if not name.startswith("FLAGS_"):
+        name = "FLAGS_" + name
+    env = os.environ.get(name)
+    if env is not None:
+        t = type(default)
+        if t is builtins_bool:
+            default = env.lower() in ("1", "true", "yes")
+        else:
+            default = t(env)
+    _FLAGS[name] = default
+
+
+builtins_bool = bool
+
+
+def set_flags(flags: dict):
+    for k, v in flags.items():
+        if not k.startswith("FLAGS_"):
+            k = "FLAGS_" + k
+        _FLAGS[k] = v
+
+
+def get_flags(flags):
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for k in flags:
+        kk = k if k.startswith("FLAGS_") else "FLAGS_" + k
+        out[k] = _FLAGS.get(kk)
+    return out
+
+
+def flag(name: str, default=None):
+    if not name.startswith("FLAGS_"):
+        name = "FLAGS_" + name
+    return _FLAGS.get(name, default)
+
+
+# Reference-parity flags the runtime actually consults.
+define_flag("check_nan_inf", False,
+            "scan op outputs for nan/inf (reference: phi/core/flags.cc:74)")
+define_flag("eager_jit", True, "jit-compile eager ops (per-op executables)")
+define_flag("use_bf16_matmul", False, "run matmuls in bf16 on TPU MXU")

@@ -1,0 +1,96 @@
+"""Device identity ("Place") for paddle_tpu.
+
+Reference analog: phi::Place tagged union (/root/reference/paddle/phi/common/place.h:28).
+On TPU the whole L0 device/allocator zoo collapses into the PJRT client: a Place
+is a thin identity over a `jax.Device`; XLA owns memory. CUDAPlace is aliased to
+TPUPlace so reference-shaped code (`paddle.CUDAPlace(0)`) keeps working.
+"""
+from __future__ import annotations
+
+import jax
+
+
+class Place:
+    _kind = "undefined"
+
+    def __init__(self, device_id: int = 0):
+        self._device_id = int(device_id)
+
+    def get_device_id(self) -> int:
+        return self._device_id
+
+    @property
+    def jax_device(self):
+        devs = [d for d in jax.devices() if self._matches(d)]
+        if not devs:
+            devs = jax.devices()
+        return devs[min(self._device_id, len(devs) - 1)]
+
+    def _matches(self, d) -> bool:
+        return True
+
+    def __repr__(self):
+        return f"Place({self._kind}:{self._device_id})"
+
+    def __eq__(self, other):
+        return (isinstance(other, Place) and self._kind == other._kind
+                and self._device_id == other._device_id)
+
+    def __hash__(self):
+        return hash((self._kind, self._device_id))
+
+
+class TPUPlace(Place):
+    _kind = "tpu"
+
+    def _matches(self, d):
+        return d.platform == "tpu"
+
+
+class CPUPlace(Place):
+    _kind = "cpu"
+
+    def __init__(self):
+        super().__init__(0)
+
+    def _matches(self, d):
+        return d.platform == "cpu"
+
+
+class CUDAPlace(TPUPlace):
+    """Compat alias: reference code says CUDAPlace; here it means the accelerator."""
+    _kind = "tpu"
+
+
+class CUDAPinnedPlace(CPUPlace):
+    pass
+
+
+class XPUPlace(TPUPlace):
+    _kind = "tpu"
+
+
+class CustomPlace(Place):
+    _kind = "custom"
+
+    def __init__(self, dev_type: str, device_id: int = 0):
+        super().__init__(device_id)
+        self._dev_type = dev_type
+
+
+def _default_place() -> Place:
+    backend = jax.default_backend()
+    if backend == "cpu":
+        return CPUPlace()
+    return TPUPlace(0)
+
+
+def place_of(value) -> Place:
+    """Place of a jax array (best-effort; sharded arrays report device 0)."""
+    try:
+        dev = next(iter(value.devices()))
+    except Exception:
+        return _default_place()
+    if dev.platform == "cpu":
+        return CPUPlace()
+    return TPUPlace(getattr(dev, "id", 0))

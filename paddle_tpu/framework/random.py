@@ -1,0 +1,60 @@
+"""Global RNG state.
+
+Reference analog: phi::Generator (/root/reference/paddle/phi/core/generator.cc)
+and paddle.seed (python/paddle/framework/random.py).
+
+TPU-native design: JAX's counter-based PRNG (threefry) instead of stateful
+Philox generators. Eager random ops draw a fresh subkey from this global state
+and pass it as an *array input* to the op, so (a) the op's compiled executable
+is reused across calls, and (b) tape recompute in backward sees the identical
+key — dropout masks are bitwise-reproducible in backward. The TP-aware
+RNGStatesTracker (reference fleet/layers/mpu/random.py:34) lives in
+paddle_tpu.parallel.random and builds on the same mechanism.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as np
+
+
+class _RNGState(threading.local):
+    def __init__(self):
+        self.key = jax.random.PRNGKey(0)
+        self.seed_value = 0
+
+
+_state = _RNGState()
+
+
+def seed(s: int):
+    """paddle.seed analog — resets the global generator."""
+    _state.seed_value = int(s)
+    _state.key = jax.random.PRNGKey(int(s))
+    return _state
+
+
+def get_rng_state():
+    return _state.key
+
+
+def set_rng_state(key):
+    _state.key = key
+
+
+def next_key():
+    """Split one subkey off the global stream. Under a to_static trace, the
+    key is threaded through the compiled program as an input instead (see
+    jit.trace_context.TraceRngContext) so every call of the compiled step
+    gets fresh randomness."""
+    from ..jit.trace_context import active_rng
+    ctx = active_rng()
+    if ctx is not None:
+        return ctx.next_key()
+    _state.key, sub = jax.random.split(_state.key)
+    return sub
+
+
+def default_seed() -> int:
+    return _state.seed_value

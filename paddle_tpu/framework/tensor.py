@@ -1,0 +1,253 @@
+"""The paddle_tpu Tensor: a Paddle-shaped facade over `jax.Array`.
+
+Reference analog: phi::DenseTensor (/root/reference/paddle/phi/core/dense_tensor.h:38)
+plus the eager AutogradMeta (/root/reference/paddle/fluid/eager/autograd_meta.h).
+
+Design: `_value` always holds a jax.Array (device buffer) — or a jax Tracer
+when code runs under a jit trace, which is what makes the whole eager API
+traceable into a single XLA computation. Methods (add/reshape/...) are
+monkey-patched onto this class by `paddle_tpu.tensor` the same way the
+reference patches python methods onto its C++ tensor.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import dtype as dtypes
+from .place import Place, place_of, _default_place
+from .autograd import run_backward
+
+
+class Tensor:
+    __slots__ = ("_value", "stop_gradient", "_grad", "_node", "_out_idx",
+                 "name", "persistable", "_grad_hooks", "is_leaf_override",
+                 "__weakref__")
+
+    def __init__(self, value, stop_gradient: bool = True, name: str = ""):
+        if isinstance(value, Tensor):
+            value = value._value
+        if not isinstance(value, (jax.Array, jax.core.Tracer)):
+            value = jnp.asarray(value)
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self._grad: Optional["Tensor"] = None
+        self._node = None       # producing TapeNode
+        self._out_idx = 0
+        self.name = name
+        self.persistable = False
+        self._grad_hooks = []
+        self.is_leaf_override = None
+
+    # -- meta ------------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def dim(self):
+        return self._value.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def dtype(self):
+        return np.dtype(self._value.dtype)
+
+    @property
+    def place(self) -> Place:
+        return place_of(self._value)
+
+    @property
+    def is_leaf(self) -> bool:
+        if self.is_leaf_override is not None:
+            return self.is_leaf_override
+        return self._node is None
+
+    def numel(self):
+        return self.size
+
+    def rank(self):
+        return self.ndim
+
+    # -- grad ------------------------------------------------------------
+    @property
+    def grad(self) -> Optional["Tensor"]:
+        return self._grad
+
+    @grad.setter
+    def grad(self, g):
+        self._grad = Tensor(g) if (g is not None and not isinstance(g, Tensor)) else g
+
+    def clear_grad(self):
+        self._grad = None
+
+    clear_gradient = clear_grad
+
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        run_backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def register_hook(self, hook):
+        self._grad_hooks.append(hook)
+
+        class _Removable:
+            def remove(_self):
+                try:
+                    self._grad_hooks.remove(hook)
+                except ValueError:
+                    pass
+        return _Removable()
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._value, stop_gradient=True, name=self.name)
+        return t
+
+    def detach_(self):
+        self._node = None
+        self.stop_gradient = True
+        return self
+
+    # -- host transfer ---------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        arr = self.numpy()
+        return arr.astype(dtype) if dtype is not None else arr
+
+    # -- misc paddle surface ----------------------------------------------
+    def clone(self) -> "Tensor":
+        from .dispatch import apply
+        return apply("clone", lambda x: x + jnp.zeros((), x.dtype), self)
+
+    def cpu(self):
+        return Tensor(jax.device_put(self._value, jax.devices("cpu")[0]),
+                      stop_gradient=self.stop_gradient, name=self.name)
+
+    def cuda(self, device_id=0, blocking=True):
+        return self.tpu(device_id)
+
+    def tpu(self, device_id=0):
+        dev = _default_place().jax_device
+        return Tensor(jax.device_put(self._value, dev),
+                      stop_gradient=self.stop_gradient, name=self.name)
+
+    def pin_memory(self):
+        return self
+
+    def contiguous(self):
+        return self
+
+    def is_contiguous(self):
+        return True
+
+    def get_tensor(self):
+        return self
+
+    def value(self):
+        return self
+
+    def set_value(self, value):
+        """In-place assignment (breaks no tapes: nodes snapshot values)."""
+        if isinstance(value, Tensor):
+            value = value._value
+        value = jnp.asarray(value)
+        if tuple(value.shape) != tuple(self._value.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {value.shape} vs {self._value.shape}")
+        self._value = value.astype(self._value.dtype)
+        return self
+
+    def copy_(self, other, blocking=True):
+        return self.set_value(other)
+
+    def _is_initialized(self):
+        return True
+
+    def block_until_ready(self):
+        jax.block_until_ready(self._value)
+        return self
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-D tensor")
+        return self._value.shape[0]
+
+    def __bool__(self):
+        return bool(self.numpy())
+
+    def __int__(self):
+        return int(self.numpy())
+
+    def __float__(self):
+        return float(self.numpy())
+
+    def __index__(self):
+        return int(self.numpy())
+
+    def __hash__(self):
+        return id(self)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __repr__(self):
+        sg = self.stop_gradient
+        try:
+            data = np.array2string(self.numpy(), precision=6, separator=", ",
+                                   threshold=64)
+        except Exception:
+            data = "<traced>"
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
+                f"place={self.place}, stop_gradient={sg},\n       {data})")
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.item(), spec)
+        return format(str(self), spec)
+
+    # dunders for arithmetic are patched in paddle_tpu.tensor (op layer),
+    # mirroring the reference's monkey_patch_tensor.
+
+
+Parameter = None  # set by paddle_tpu.framework.parameter to avoid cycles
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
+    """paddle.to_tensor analog (reference: python/paddle/tensor/creation.py)."""
+    if isinstance(data, Tensor):
+        v = data._value
+        if dtype is not None:
+            v = v.astype(dtypes.convert_dtype(dtype))
+        return Tensor(v, stop_gradient=stop_gradient)
+    if isinstance(data, (jax.Array, jax.core.Tracer)):
+        v = data
+    else:
+        arr = np.asarray(data)
+        if dtype is None:
+            if arr.dtype == np.float64:
+                arr = arr.astype(dtypes.get_default_dtype())
+        v = jnp.asarray(arr)
+    if dtype is not None:
+        v = v.astype(dtypes.convert_dtype(dtype))
+    if place is not None and isinstance(place, Place):
+        v = jax.device_put(v, place.jax_device)
+    return Tensor(v, stop_gradient=stop_gradient)

@@ -1,0 +1,85 @@
+"""paddle.save / paddle.load.
+
+Reference analog: python/paddle/framework/io.py:646,888. Pickle-compatible
+container format: Tensors/Parameters serialize as numpy arrays + metadata;
+nested dicts/lists/state_dicts round-trip. Sharded/distributed checkpoints
+live in paddle_tpu.parallel.checkpoint (orbax-style, mesh-reshape capable).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from .framework.tensor import Tensor, to_tensor
+
+
+class _TensorPickle:
+    def __init__(self, array, stop_gradient, name, is_parameter, trainable):
+        self.array = array
+        self.stop_gradient = stop_gradient
+        self.name = name
+        self.is_parameter = is_parameter
+        self.trainable = trainable
+
+    def restore(self):
+        if self.is_parameter:
+            from .nn.parameter import Parameter
+            p = Parameter(self.array, trainable=self.trainable,
+                          name=self.name)
+            return p
+        return Tensor(self.array, stop_gradient=self.stop_gradient,
+                      name=self.name)
+
+
+def _encode(obj):
+    from .nn.parameter import Parameter
+    if isinstance(obj, Parameter):
+        return _TensorPickle(obj.numpy(), obj.stop_gradient, obj.name, True,
+                             obj.trainable)
+    if isinstance(obj, Tensor):
+        return _TensorPickle(obj.numpy(), obj.stop_gradient, obj.name, False,
+                             False)
+    if isinstance(obj, dict):
+        return {k: _encode(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        if t is not list and t is not tuple:
+            t = list
+        return t(_encode(v) for v in obj)
+    return obj
+
+
+def _decode(obj, return_numpy=False):
+    if isinstance(obj, _TensorPickle):
+        return obj.array if return_numpy else obj.restore()
+    if isinstance(obj, dict):
+        return {k: _decode(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_decode(v, return_numpy) for v in obj]
+    if isinstance(obj, tuple):
+        return tuple(_decode(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    if isinstance(path, str):
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "wb") as f:
+            pickle.dump(_encode(obj), f, protocol=protocol)
+    else:
+        pickle.dump(_encode(obj), path, protocol=protocol)
+    return path
+
+
+def load(path, **configs):
+    return_numpy = configs.get("return_numpy", False)
+    if isinstance(path, str):
+        with open(path, "rb") as f:
+            raw = pickle.load(f)
+    else:
+        raw = pickle.load(path)
+    return _decode(raw, return_numpy)

@@ -1,0 +1,153 @@
+"""paddle_tpu.jit — compile, save, load.
+
+Reference analog: python/paddle/jit/ (to_static api.py:233, save api.py:793,
+load api.py:1275, TranslatedLayer translated_layer.py). The saved artifact is
+StableHLO (via jax.export) + a weights npz + a pytree meta pickle — the
+ProgramDesc+params analog, loadable into the inference Predictor or a
+TranslatedLayer.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework import dtype as dtypes
+from ..framework.random import next_key
+from ..framework.tensor import Tensor
+from .static_function import (  # noqa: F401
+    StaticFunction, InputSpec, to_static, not_to_static, ignore_module)
+
+MODEL_SUFFIX = ".pdmodel"
+PARAMS_SUFFIX = ".pdiparams"
+META_SUFFIX = ".pdmeta"
+
+
+def _get_static_function(layer, input_spec):
+    from ..nn.layer import Layer
+    if isinstance(layer, StaticFunction):
+        return layer, None
+    if isinstance(layer, Layer):
+        fwd = layer.forward
+        if isinstance(fwd, StaticFunction):
+            return fwd, layer
+        sf = StaticFunction(fwd, input_spec=input_spec)
+        sf._layer = layer
+        return sf, layer
+    # plain callable
+    return StaticFunction(layer, input_spec=input_spec), None
+
+
+def save(layer, path, input_spec=None, **configs):
+    """paddle.jit.save analog: trace → StableHLO + weights + meta."""
+    sf, owner = _get_static_function(layer, input_spec)
+    if not sf.program_cache:
+        if input_spec is None:
+            raise RuntimeError(
+                "jit.save needs input_spec (or call the layer once first)")
+        example = [Tensor(jnp.zeros(spec.shape, spec.dtype))
+                   for spec in input_spec]
+        if owner is not None:
+            owner.eval()
+        sf.get_concrete_program(*example)
+    prog = next(iter(sf.program_cache.values()))
+
+    cap_vals = [np.asarray(c._value) for c in prog.captured]
+    key = jax.random.PRNGKey(0)
+
+    from jax import export as jax_export
+    exported = jax_export.export(jax.jit(prog.pure_fn))(
+        key, *[jax.ShapeDtypeStruct(v.shape, v.dtype) for v in cap_vals],
+        *[jax.ShapeDtypeStruct(tuple(s.shape), s.dtype)
+          for s in _input_shapes(sf, prog)])
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path + MODEL_SUFFIX, "wb") as f:
+        f.write(exported.serialize())
+    with open(path + PARAMS_SUFFIX, "wb") as f:
+        np.savez(f, **{f"p{i}": v for i, v in enumerate(cap_vals)})
+    meta = {
+        "n_user_outputs": prog.n_user_outputs,
+        "n_captured": len(cap_vals),
+        "out_treedef": None,  # rebuilt as flat list on load
+        "input_shapes": [(tuple(s.shape), str(np.dtype(s.dtype)))
+                         for s in _input_shapes(sf, prog)],
+        "param_trainable": [not c.stop_gradient for c in prog.captured],
+    }
+    with open(path + META_SUFFIX, "wb") as f:
+        pickle.dump(meta, f)
+    return path
+
+
+def _input_shapes(sf, prog):
+    # recover input avals from the first cached specialization key
+    key = next(iter(sf.program_cache.keys()))
+    avals = key[0]
+    return [jax.ShapeDtypeStruct(shape, np.dtype(dt))
+            for shape, dt, _sg in avals]
+
+
+class TranslatedLayer:
+    """Loaded saved model (reference: dy2static/translated_layer.py).
+    Inference-only in round 1: the StableHLO artifact is a fixed forward
+    computation."""
+
+    def __init__(self, exported, params, meta):
+        self._exported = exported
+        self._params = params
+        self._meta = meta
+        self.training = False
+
+    def __call__(self, *inputs):
+        vals = [i._value if isinstance(i, Tensor) else jnp.asarray(i)
+                for i in inputs]
+        key = jax.random.PRNGKey(0)
+        outs = self._exported.call(key, *self._params, *vals)
+        outs = list(outs)[:self._meta["n_user_outputs"]]
+        outs = [Tensor(o) for o in outs]
+        return outs[0] if len(outs) == 1 else outs
+
+    forward = __call__
+
+    def eval(self):
+        self.training = False
+        return self
+
+    def parameters(self):
+        return [Tensor(p) for p in self._params]
+
+
+def load(path, **configs):
+    """paddle.jit.load analog."""
+    from jax import export as jax_export
+    with open(path + MODEL_SUFFIX, "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    data = np.load(path + PARAMS_SUFFIX, allow_pickle=False)
+    params = [jnp.asarray(data[f"p{i}"]) for i in range(len(data.files))]
+    with open(path + META_SUFFIX, "rb") as f:
+        meta = pickle.load(f)
+    return TranslatedLayer(exported, params, meta)
+
+
+class TracedLayer:
+    """Legacy TracedLayer shim (reference: python/paddle/jit/api.py
+    TracedLayer) — wraps a StaticFunction."""
+
+    def __init__(self, sf):
+        self._sf = sf
+
+    @staticmethod
+    def trace(layer, inputs):
+        sf, _ = _get_static_function(layer, None)
+        out = sf(*inputs)
+        return out, TracedLayer(sf)
+
+    def __call__(self, *inputs):
+        return self._sf(*inputs)
+
+
+def enable_to_static(flag=True):
+    pass

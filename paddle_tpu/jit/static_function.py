@@ -1,0 +1,280 @@
+"""StaticFunction — the to_static compiler.
+
+Reference analog: python/paddle/jit/api.py:233 (to_static) +
+dy2static/program_translator.py:305 (StaticFunction, CacheKey,
+ConcreteProgram) + the run_program op
+(/root/reference/paddle/fluid/operators/run_program_op.cc:22).
+
+TPU-native pipeline (no AST rewriting — the eager API is jax-traceable):
+1. *Capture pre-pass*: run the function once eagerly under a
+   CaptureRecorder to discover every leaf Tensor it touches (params,
+   buffers, closure constants) — the persistable-var discovery the
+   reference gets from program construction.
+2. *Pure function*: build pure(key, *captured, *inputs) that swaps captured
+   tensors' values for tracers, replays the function, and returns
+   (outputs, mutated-buffer updates). RNG calls split from the traced key.
+3. *Execution through the op layer*: the pure function is dispatched via
+   framework.dispatch.apply, so it becomes ONE fused op: jit-compiled with
+   an XLA executable cache, AND differentiable through the tape (jax.vjp
+   re-traces it for backward — the run_program-op grad analog). An entire
+   model forward (or train step) is a single XLA computation.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework import dtype as dtypes
+from ..framework.dispatch import apply
+from ..framework.random import next_key
+from ..framework.tensor import Tensor
+from .trace_context import CaptureRecorder, TraceRngContext
+
+_fn_counter = itertools.count()
+
+
+class InputSpec:
+    """reference: python/paddle/static/input.py InputSpec."""
+
+    def __init__(self, shape=None, dtype="float32", name=None,
+                 stop_gradient=True):
+        self.shape = None if shape is None else tuple(shape)
+        self.dtype = dtypes.convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype}, "
+                f"name={self.name})")
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype, name)
+
+
+def _tree_flatten_tensors(tree):
+    """Flatten a pytree with Tensor leaves; non-tensors become static."""
+    leaves, treedef = jax.tree_util.tree_flatten(
+        tree, is_leaf=lambda x: isinstance(x, Tensor))
+    tensors, mask = [], []
+    statics = []
+    for leaf in leaves:
+        if isinstance(leaf, Tensor):
+            mask.append(True)
+            tensors.append(leaf)
+            statics.append(None)
+        else:
+            mask.append(False)
+            statics.append(leaf)
+    return tensors, tuple(mask), tuple(
+        s if not m else None for m, s in zip(mask, statics)), treedef
+
+
+def _tree_unflatten_tensors(treedef, mask, statics, tensors):
+    it = iter(tensors)
+    leaves = [next(it) if m else s for m, s in zip(mask, statics)]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+class ConcreteProgram:
+    """One traced specialization (reference: ConcreteProgram, dy2static)."""
+
+    def __init__(self, name, fn, in_tensors_spec, captured, pure_fn,
+                 out_treedef, out_mask, out_statics, n_user_outputs,
+                 mutated_buffers, uses_rng):
+        self.name = name
+        self.fn = fn
+        self.captured = captured            # list[Tensor] (params/buffers)
+        self.pure_fn = pure_fn
+        self.out_treedef = out_treedef
+        self.out_mask = out_mask
+        self.out_statics = out_statics
+        self.n_user_outputs = n_user_outputs
+        self.mutated_buffers = mutated_buffers  # list[Tensor]
+        self.uses_rng = uses_rng
+
+
+class StaticFunction:
+    def __init__(self, fn: Callable, input_spec=None, build_strategy=None,
+                 full_graph=True, property_=False, remat=False):
+        self._fn = fn
+        self._input_spec = input_spec
+        self._remat = remat
+        self._cache: Dict[Tuple, ConcreteProgram] = {}
+        self._name = getattr(fn, "__name__", f"sfn{next(_fn_counter)}")
+        self.__name__ = self._name
+        self._layer = getattr(fn, "__self__", None)
+
+    @property
+    def forward_fn(self):
+        return self._fn
+
+    def _cache_key(self, in_tensors, treedef, statics):
+        avals = tuple((tuple(t.shape), t.dtype.name, t.stop_gradient)
+                      for t in in_tensors)
+        mode = None
+        if self._layer is not None and hasattr(self._layer, "training"):
+            mode = self._layer.training
+        from ..amp import amp_state
+        amp = amp_state()
+        amp_key = (amp.enabled, amp.level, str(amp.dtype)) if amp.enabled \
+            else None
+        try:
+            static_key = jax.tree_util.tree_structure(statics)
+            static_key = repr(statics)
+        except Exception:
+            static_key = None
+        return (avals, str(treedef), static_key, mode, amp_key)
+
+    def _trace(self, args, kwargs, in_tensors, mask, statics, treedef):
+        fn = self._fn
+
+        # Phase 1 — capture pre-pass (eager; discovers params/buffers/consts)
+        rec = CaptureRecorder(in_tensors)
+        with rec:
+            sample_out = fn(*args, **kwargs)
+        captured = rec.captured
+
+        out_tensors, out_mask, out_statics, out_treedef = \
+            _tree_flatten_tensors(sample_out)
+        n_user = len(out_tensors)
+
+        n_inputs = len(in_tensors)
+        n_cap = len(captured)
+        in_sg = tuple(t.stop_gradient for t in in_tensors)
+        mutated_slots: List[int] = []
+        uses_rng = [False]
+
+        def pure(key, *vals):
+            cap_vals = vals[:n_cap]
+            input_vals = vals[n_cap:]
+            originals = [c._value for c in captured]
+            try:
+                for c, v in zip(captured, cap_vals):
+                    c._value = v
+                wrapped = [Tensor(v, stop_gradient=sg)
+                           for v, sg in zip(input_vals, in_sg)]
+                call_args, call_kwargs = _rebuild_args(
+                    args, kwargs, wrapped, mask, statics, treedef)
+                rng = TraceRngContext(key)
+                with rng:
+                    out = fn(*call_args, **call_kwargs)
+                uses_rng[0] = uses_rng[0] or rng.used
+                outs, _om, _os, _otd = _tree_flatten_tensors(out)
+                result = [o._value for o in outs]
+                # mutated buffers: captured tensors whose value was replaced
+                # during the trace (batch-norm stats, counters)
+                mutated_slots.clear()
+                for i, (c, v) in enumerate(zip(captured, cap_vals)):
+                    if c._value is not v:
+                        mutated_slots.append(i)
+                        result.append(c._value)
+                return tuple(result)
+            finally:
+                for c, orig in zip(captured, originals):
+                    c._value = orig
+
+        if self._remat:
+            inner_pure = pure
+
+            def pure(key, *vals, _f=jax.checkpoint(inner_pure)):
+                return _f(key, *vals)
+
+        pure.__qualname__ = f"to_static::{self._name}::{len(self._cache)}"
+        pure.__module__ = "paddle_tpu.jit"
+
+        # Phase 2 — trace once abstractly to fix mutated-buffer slots
+        key0 = next_key()
+        jax.eval_shape(pure, key0,
+                       *[c._value for c in captured],
+                       *[t._value for t in in_tensors])
+        mutated = [captured[i] for i in mutated_slots]
+
+        return ConcreteProgram(
+            name=f"{self._name}_{len(self._cache)}", fn=fn,
+            in_tensors_spec=None, captured=captured, pure_fn=pure,
+            out_treedef=out_treedef, out_mask=out_mask,
+            out_statics=out_statics, n_user_outputs=n_user,
+            mutated_buffers=mutated, uses_rng=uses_rng[0])
+
+    def get_concrete_program(self, *args, **kwargs):
+        in_tensors, mask, statics, treedef = _tree_flatten_tensors(
+            (args, kwargs))
+        key = self._cache_key(in_tensors, treedef, statics)
+        prog = self._cache.get(key)
+        if prog is None:
+            prog = self._trace(args, kwargs, in_tensors, mask, statics,
+                               treedef)
+            self._cache[key] = prog
+        return prog, in_tensors
+
+    def __call__(self, *args, **kwargs):
+        if self._layer is None and args and hasattr(args[0], "training") and \
+                getattr(self._fn, "__name__", "") == "forward":
+            self._layer = args[0]
+        prog, in_tensors = self.get_concrete_program(*args, **kwargs)
+        key = Tensor(next_key(), stop_gradient=True)
+        outs = apply(prog.name, prog.pure_fn, key, *prog.captured,
+                     *in_tensors)
+        if not isinstance(outs, list):
+            outs = [outs]
+        user_outs = outs[:prog.n_user_outputs]
+        buffer_outs = outs[prog.n_user_outputs:]
+        for buf, new in zip(prog.mutated_buffers, buffer_outs):
+            buf._value = new._value
+        return _tree_unflatten_tensors(prog.out_treedef, prog.out_mask,
+                                       prog.out_statics, user_outs)
+
+    def concrete_program_specify_input_spec(self, input_spec=None):
+        if not self._cache:
+            if input_spec is None:
+                input_spec = self._input_spec
+            if input_spec is None:
+                raise RuntimeError(
+                    "call the function once, or provide input_spec, before "
+                    "saving")
+            example = [Tensor(jnp.zeros(spec.shape, spec.dtype))
+                       for spec in input_spec]
+            self.get_concrete_program(*example)
+        return next(iter(self._cache.values()))
+
+    @property
+    def program_cache(self):
+        return self._cache
+
+    def rollback(self):
+        return self._fn
+
+
+def _rebuild_args(args, kwargs, wrapped, mask, statics, treedef):
+    tree = _tree_unflatten_tensors(treedef, mask, statics, wrapped)
+    return tree[0], tree[1]
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """paddle.jit.to_static analog (reference: python/paddle/jit/api.py:233)."""
+    def decorate(fn):
+        from ..nn.layer import Layer
+        if isinstance(fn, Layer):
+            sf = StaticFunction(fn.forward, input_spec=input_spec)
+            sf._layer = fn
+            fn.forward = sf
+            return fn
+        return StaticFunction(fn, input_spec=input_spec)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn=None):
+    return fn
+
+
+def ignore_module(modules):
+    pass

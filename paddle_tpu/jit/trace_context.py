@@ -1,0 +1,85 @@
+"""Trace-time context: capture recording and RNG threading.
+
+Reference analog: the dy2static ProgramTranslator cache machinery
+(python/paddle/jit/dy2static/program_translator.py:305). Here "translation"
+is jax tracing — no AST rewriting needed because the eager API is already
+traceable; this module supplies the two pieces tracing alone can't do:
+
+1. Capture discovery: which leaf Tensors (params/buffers/closure constants)
+   a function touches, recorded during one eager pre-pass by the dispatch
+   layer (the ProgramDesc's persistable-var list analog).
+2. RNG threading: under a trace, framework.random.next_key() splits from a
+   *traced* key input instead of host state, so dropout masks differ per
+   step in the compiled program (the reference threads seed+offset into
+   dropout ops the same way).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+
+class _TraceState(threading.local):
+    def __init__(self):
+        self.capture: Optional["CaptureRecorder"] = None
+        self.rng_ctx: List = []  # stack of TraceRngContext
+
+
+_state = _TraceState()
+
+
+class CaptureRecorder:
+    """Records leaf Tensors flowing into ops during an eager pre-pass."""
+
+    def __init__(self, input_tensors):
+        self.derived = {id(t) for t in input_tensors}
+        self.captured = []          # Tensors, first-use order
+        self._captured_ids = set()
+
+    def on_apply(self, input_tensors, output_tensors):
+        for t in input_tensors:
+            tid = id(t)
+            if tid not in self.derived and tid not in self._captured_ids:
+                self._captured_ids.add(tid)
+                self.captured.append(t)
+        for t in output_tensors:
+            self.derived.add(id(t))
+
+    def __enter__(self):
+        self._prev = _state.capture
+        _state.capture = self
+        return self
+
+    def __exit__(self, *exc):
+        _state.capture = self._prev
+        return False
+
+
+def active_capture() -> Optional[CaptureRecorder]:
+    return _state.capture
+
+
+class TraceRngContext:
+    """While active, framework.random.next_key() splits from this traced key."""
+
+    def __init__(self, key):
+        self.key = key
+        self.used = False
+
+    def next_key(self):
+        import jax
+        self.used = True
+        self.key, sub = jax.random.split(self.key)
+        return sub
+
+    def __enter__(self):
+        _state.rng_ctx.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _state.rng_ctx.pop()
+        return False
+
+
+def active_rng() -> Optional[TraceRngContext]:
+    return _state.rng_ctx[-1] if _state.rng_ctx else None

@@ -1,0 +1,3 @@
+"""Pallas TPU kernels for the hot fused ops (the reference's
+paddle/fluid/operators/fused/ zoo, rebuilt as TPU kernels)."""
+from . import flash_attention  # noqa: F401

@@ -1,0 +1,419 @@
+"""GPT — the flagship model family (BASELINE config 3: GPT-3 scale under
+TP×PP×DP×SP(×EP) hybrid parallelism).
+
+Reference analog: the fleet GPT workload (SURVEY.md §3.4 north-star stack —
+ColumnParallelLinear/RowParallelLinear mp_layers.py:35,173, PipelineLayer
+pp_layers.py, fused_attention/fused_feedforward CUDA ops).
+
+TPU-native architecture:
+- A *functional core* (init_gpt_params / gpt_forward / train_step): params
+  are one pytree with per-block weights STACKED on a leading layer axis and
+  the blocks applied with lax.scan — compile time stays O(1) in depth, and
+  the stacked axis is what 'pp' shards for SPMD pipelining.
+- Sharding is declarative: PARAM_SPECS maps each leaf to a PartitionSpec
+  over ('dp','fsdp','pp','mp'); activations get with_sharding_constraint.
+  TP = mp sharding of head/ffn dims (the ColumnParallel/RowParallel split),
+  ZeRO-3 = 'fsdp' sharding of the remaining weight dim, SP = sequence
+  sharding on 'mp' in the norm/residual regions (Megatron-SP), EP = expert
+  axis sharding for the MoE variant. XLA GSPMD inserts all collectives.
+- Attention runs through the fused flash-attention path
+  (paddle_tpu.kernels) in bf16 — MXU-native.
+- A thin `GPTModel` nn.Layer facade exposes the paddle-shaped API over the
+  same functional core for eager/`to_static` use.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import get_mesh, constraint as mesh_constraint
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    ffn_hidden: Optional[int] = None          # default 4*hidden
+    max_seq_len: int = 1024
+    dropout: float = 0.0
+    use_bias: bool = True
+    layer_norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16                 # activation/compute dtype
+    param_dtype: Any = jnp.float32
+    remat: bool = True                        # jax.checkpoint each block
+    sequence_parallel: bool = True            # SP on the 'mp' axis
+    # MoE (expert parallel) — 0 experts = dense FFN
+    num_experts: int = 0
+    expert_capacity_factor: float = 1.25
+
+    def __post_init__(self):
+        if self.ffn_hidden is None:
+            self.ffn_hidden = 4 * self.hidden_size
+        assert self.hidden_size % self.num_heads == 0
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+
+# --------------------------------------------------------------------------
+# Sharding rules: leaf name -> PartitionSpec over (dp, fsdp, pp, mp).
+# Block weights have a leading stacked layer axis -> 'pp'.
+# --------------------------------------------------------------------------
+PARAM_SPECS: Dict[str, P] = {
+    "wte":        P("mp", "fsdp"),          # vocab-parallel embedding
+    "wpe":        P(None, "fsdp"),
+    "ln_f_scale": P(None),
+    "ln_f_bias":  P(None),
+    # stacked block params: leading axis = layer (pp)
+    "ln1_scale":  P("pp", None),
+    "ln1_bias":   P("pp", None),
+    "ln2_scale":  P("pp", None),
+    "ln2_bias":   P("pp", None),
+    "qkv_w":      P("pp", "fsdp", "mp"),    # column-parallel
+    "qkv_b":      P("pp", "mp"),
+    "attn_out_w": P("pp", "mp", "fsdp"),    # row-parallel
+    "attn_out_b": P("pp", None),
+    "mlp_up_w":   P("pp", "fsdp", "mp"),    # column-parallel
+    "mlp_up_b":   P("pp", "mp"),
+    "mlp_down_w": P("pp", "mp", "fsdp"),    # row-parallel
+    "mlp_down_b": P("pp", None),
+    # MoE (expert axis 'ep')
+    "gate_w":     P("pp", None, None),
+    "moe_up_w":   P("pp", "ep", None, "mp"),
+    "moe_up_b":   P("pp", "ep", "mp"),
+    "moe_down_w": P("pp", "ep", "mp", None),
+    "moe_down_b": P("pp", "ep", None),
+}
+
+
+def init_gpt_params(cfg: GPTConfig, key) -> Dict[str, jax.Array]:
+    """Initialize the parameter pytree (host-side, then shard via
+    paddle_tpu.parallel.mesh.shard_value per PARAM_SPECS)."""
+    k = jax.random.split(key, 16)
+    D, F, L, V = (cfg.hidden_size, cfg.ffn_hidden, cfg.num_layers,
+                  cfg.vocab_size)
+    std = 0.02
+    pd = cfg.param_dtype
+
+    def norm(key, shape, scale=std):
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(pd)
+
+    params = {
+        "wte": norm(k[0], (V, D)),
+        "wpe": norm(k[1], (cfg.max_seq_len, D), 0.01),
+        "ln_f_scale": jnp.ones((D,), pd),
+        "ln_f_bias": jnp.zeros((D,), pd),
+        "ln1_scale": jnp.ones((L, D), pd),
+        "ln1_bias": jnp.zeros((L, D), pd),
+        "ln2_scale": jnp.ones((L, D), pd),
+        "ln2_bias": jnp.zeros((L, D), pd),
+        "qkv_w": norm(k[2], (L, D, 3 * D)),
+        "qkv_b": jnp.zeros((L, 3 * D), pd),
+        "attn_out_w": norm(k[3], (L, D, D), std / math.sqrt(2 * L)),
+        "attn_out_b": jnp.zeros((L, D), pd),
+    }
+    if cfg.num_experts > 0:
+        E = cfg.num_experts
+        params.update({
+            "gate_w": norm(k[4], (L, D, E)),
+            "moe_up_w": norm(k[5], (L, E, D, F)),
+            "moe_up_b": jnp.zeros((L, E, F), pd),
+            "moe_down_w": norm(k[6], (L, E, F, D), std / math.sqrt(2 * L)),
+            "moe_down_b": jnp.zeros((L, E, D), pd),
+        })
+    else:
+        params.update({
+            "mlp_up_w": norm(k[5], (L, D, F)),
+            "mlp_up_b": jnp.zeros((L, F), pd),
+            "mlp_down_w": norm(k[6], (L, F, D), std / math.sqrt(2 * L)),
+            "mlp_down_b": jnp.zeros((L, D), pd),
+        })
+    return params
+
+
+def shard_gpt_params(params, mesh=None):
+    from ..parallel.mesh import shard_value, get_mesh as _gm
+    mesh = mesh or _gm()
+    if mesh is None:
+        return params
+    return {name: shard_value(v, PARAM_SPECS[name], mesh)
+            for name, v in params.items()}
+
+
+def _ln(x, scale, bias, eps):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, -1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mu), -1, keepdims=True)
+    out = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return (out * scale + bias).astype(x.dtype)
+
+
+def _sp_constraint(x, cfg):
+    """Sequence-parallel: shard (batch, seq) as (dp, mp) in norm regions."""
+    if cfg.sequence_parallel:
+        return mesh_constraint(x, P(("dp", "fsdp"), "mp", None))
+    return mesh_constraint(x, P(("dp", "fsdp"), None, None))
+
+
+def _tp_constraint(x, cfg):
+    """Inside attention/FFN: batch on dp, heads/features on mp."""
+    return mesh_constraint(x, P(("dp", "fsdp"), None, "mp"))
+
+
+def _attention(x, w_qkv, b_qkv, w_out, b_out, cfg, mask_causal=True):
+    B, S, D = x.shape
+    H, hd = cfg.num_heads, cfg.head_dim
+    qkv = jnp.einsum("bsd,df->bsf", x, w_qkv.astype(x.dtype))
+    if b_qkv is not None:
+        qkv = qkv + b_qkv.astype(x.dtype)
+    qkv = _tp_constraint(qkv, cfg)
+    q, k_, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, S, H, hd)
+    k_ = k_.reshape(B, S, H, hd)
+    v = v.reshape(B, S, H, hd)
+    from ..kernels.flash_attention import _blockwise_attention
+    ctx = _blockwise_attention(q, k_, v, causal=mask_causal)
+    ctx = ctx.reshape(B, S, D)
+    out = jnp.einsum("bsd,df->bsf", ctx, w_out.astype(x.dtype))
+    if b_out is not None:
+        out = out + b_out.astype(x.dtype)
+    return out
+
+
+def _dense_ffn(x, up_w, up_b, down_w, down_b):
+    h = jnp.einsum("bsd,df->bsf", x, up_w.astype(x.dtype))
+    if up_b is not None:
+        h = h + up_b.astype(x.dtype)
+    h = jax.nn.gelu(h)
+    out = jnp.einsum("bsf,fd->bsd", h, down_w.astype(x.dtype))
+    if down_b is not None:
+        out = out + down_b.astype(x.dtype)
+    return out
+
+
+def _moe_ffn(x, gate_w, up_w, up_b, down_w, down_b, cfg):
+    """Top-1 switch MoE (reference: incubate MoELayer moe_layer.py:261 with
+    gshard/switch gates + global_scatter/global_gather all-to-all).
+
+    TPU-native: experts carry an 'ep'-sharded weight axis; the dispatch is a
+    dense einsum over a one-hot combine tensor — GSPMD turns the expert
+    contraction into the all-to-all when tokens and experts live on
+    different mesh axes."""
+    B, S, D = x.shape
+    E = cfg.num_experts
+    logits = jnp.einsum("bsd,de->bse", x.astype(jnp.float32),
+                        gate_w.astype(jnp.float32))
+    probs = jax.nn.softmax(logits, -1)
+    expert_idx = jnp.argmax(probs, -1)                    # [B,S]
+    onehot = jax.nn.one_hot(expert_idx, E, dtype=x.dtype)  # [B,S,E]
+    gate = jnp.take_along_axis(probs, expert_idx[..., None],
+                               -1)[..., 0].astype(x.dtype)
+    # dispatch: xe[e] = tokens routed to expert e (dense masked form)
+    xe = jnp.einsum("bsd,bse->ebsd", x, onehot)
+    h = jnp.einsum("ebsd,edf->ebsf", xe, up_w.astype(x.dtype))
+    h = h + up_b[:, None, None, :].astype(x.dtype)
+    h = jax.nn.gelu(h)
+    ye = jnp.einsum("ebsf,efd->ebsd", h, down_w.astype(x.dtype))
+    ye = ye + down_b[:, None, None, :].astype(x.dtype)
+    y = jnp.einsum("ebsd,bse->bsd", ye, onehot)
+    return y * gate[..., None]
+
+
+def _block(params_l, x, cfg):
+    """One transformer block on stacked-layer slice params_l."""
+    h = _sp_constraint(x, cfg)
+    a_in = _ln(h, params_l["ln1_scale"], params_l["ln1_bias"],
+               cfg.layer_norm_eps)
+    a = _attention(a_in, params_l["qkv_w"],
+                   params_l.get("qkv_b"), params_l["attn_out_w"],
+                   params_l.get("attn_out_b"), cfg)
+    h = _sp_constraint(h + a, cfg)
+    m_in = _ln(h, params_l["ln2_scale"], params_l["ln2_bias"],
+               cfg.layer_norm_eps)
+    if cfg.num_experts > 0:
+        m = _moe_ffn(m_in, params_l["gate_w"], params_l["moe_up_w"],
+                     params_l["moe_up_b"], params_l["moe_down_w"],
+                     params_l["moe_down_b"], cfg)
+    else:
+        m = _dense_ffn(m_in, params_l["mlp_up_w"], params_l.get("mlp_up_b"),
+                       params_l["mlp_down_w"], params_l.get("mlp_down_b"))
+    return _sp_constraint(h + m, cfg)
+
+
+_BLOCK_KEYS_DENSE = ("ln1_scale", "ln1_bias", "ln2_scale", "ln2_bias",
+                     "qkv_w", "qkv_b", "attn_out_w", "attn_out_b",
+                     "mlp_up_w", "mlp_up_b", "mlp_down_w", "mlp_down_b")
+_BLOCK_KEYS_MOE = ("ln1_scale", "ln1_bias", "ln2_scale", "ln2_bias",
+                   "qkv_w", "qkv_b", "attn_out_w", "attn_out_b",
+                   "gate_w", "moe_up_w", "moe_up_b", "moe_down_w",
+                   "moe_down_b")
+
+
+def gpt_forward(params, tokens, cfg: GPTConfig):
+    """tokens [B, S] int32 → logits [B, S, V] (compute dtype cfg.dtype)."""
+    B, S = tokens.shape
+    x = jnp.take(params["wte"], tokens, axis=0).astype(cfg.dtype)
+    x = x + params["wpe"][:S][None].astype(cfg.dtype)
+    x = _sp_constraint(x, cfg)
+
+    block_keys = _BLOCK_KEYS_MOE if cfg.num_experts > 0 else _BLOCK_KEYS_DENSE
+    stacked = {k: params[k] for k in block_keys if k in params}
+
+    body = functools.partial(_block, cfg=cfg)
+    if cfg.remat:
+        body = jax.checkpoint(body)
+
+    def scan_fn(h, layer_params):
+        return body(layer_params, h), None
+
+    x, _ = jax.lax.scan(scan_fn, x, stacked)
+    x = _ln(x, params["ln_f_scale"], params["ln_f_bias"], cfg.layer_norm_eps)
+    # tied LM head (vocab-parallel matmul — mp shards the vocab dim)
+    logits = jnp.einsum("bsd,vd->bsv", x, params["wte"].astype(x.dtype))
+    logits = mesh_constraint(logits, P(("dp", "fsdp"), None, "mp"))
+    return logits
+
+
+def gpt_loss(params, batch, cfg: GPTConfig):
+    """Causal LM loss; batch = (tokens[B,S+1]) or dict with input/labels."""
+    tokens = batch["tokens"] if isinstance(batch, dict) else batch
+    inp, tgt = tokens[:, :-1], tokens[:, 1:]
+    logits = gpt_forward(params, inp, cfg).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, -1)
+    ll = jnp.take_along_axis(logp, tgt[..., None].astype(jnp.int32),
+                             -1)[..., 0]
+    return -jnp.mean(ll)
+
+
+# --------------------------------------------------------------------------
+# Fused train step (fwd + bwd + AdamW) — the unit bench/dryrun compile.
+# --------------------------------------------------------------------------
+def init_opt_state(params):
+    return {
+        "m": jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params),
+        "v": jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params),
+        "step": jnp.zeros((), jnp.float32),
+    }
+
+
+def train_step(params, opt_state, batch, cfg: GPTConfig, lr=3e-4,
+               beta1=0.9, beta2=0.95, eps=1e-8, weight_decay=0.1):
+    loss, grads = jax.value_and_grad(
+        lambda p: gpt_loss(p, batch, cfg))(params)
+    step = opt_state["step"] + 1.0
+    bc1 = 1.0 - beta1 ** step
+    bc2 = 1.0 - beta2 ** step
+
+    def upd(p, g, m, v):
+        gf = g.astype(jnp.float32)
+        m_new = beta1 * m + (1 - beta1) * gf
+        v_new = beta2 * v + (1 - beta2) * jnp.square(gf)
+        den = jnp.sqrt(v_new / bc2) + eps
+        p_new = p.astype(jnp.float32) * (1.0 - lr * weight_decay) - \
+            lr * (m_new / bc1) / den
+        return p_new.astype(p.dtype), m_new, v_new
+
+    flat_p, treedef = jax.tree_util.tree_flatten(params)
+    flat_g = treedef.flatten_up_to(grads)
+    flat_m = treedef.flatten_up_to(opt_state["m"])
+    flat_v = treedef.flatten_up_to(opt_state["v"])
+    new = [upd(p, g, m, v) for p, g, m, v in
+           zip(flat_p, flat_g, flat_m, flat_v)]
+    new_params = jax.tree_util.tree_unflatten(treedef, [n[0] for n in new])
+    new_m = jax.tree_util.tree_unflatten(treedef, [n[1] for n in new])
+    new_v = jax.tree_util.tree_unflatten(treedef, [n[2] for n in new])
+    return loss, new_params, {"m": new_m, "v": new_v, "step": step}
+
+
+# --------------------------------------------------------------------------
+# nn.Layer facade (paddle-shaped API over the functional core)
+# --------------------------------------------------------------------------
+class GPTModel:
+    """Paddle-shaped facade: .parameters(), forward(tokens)->logits, works
+    eagerly and under paddle_tpu.jit.to_static (the functional core runs as
+    one traced op through the dispatch layer)."""
+
+    def __init__(self, cfg: GPTConfig, seed: int = 0):
+        from ..nn.parameter import Parameter
+        from ..framework.tensor import Tensor
+        self.cfg = cfg
+        raw = init_gpt_params(cfg, jax.random.PRNGKey(seed))
+        raw = shard_gpt_params(raw)
+        self._param_names = list(raw.keys())
+        self._params = {name: Parameter(v, name=f"gpt.{name}")
+                        for name, v in raw.items()}
+        for name, p in self._params.items():
+            p.sharding_spec = PARAM_SPECS[name]
+        self.training = True
+
+    def parameters(self):
+        return list(self._params.values())
+
+    def named_parameters(self, *a, **k):
+        return list(self._params.items())
+
+    def state_dict(self):
+        return dict(self._params)
+
+    def set_state_dict(self, sd):
+        for k_, v in sd.items():
+            if k_ in self._params:
+                self._params[k_].set_value(
+                    v.numpy() if hasattr(v, "numpy") else v)
+
+    def train(self):
+        self.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        return self
+
+    def forward(self, tokens):
+        from ..framework.dispatch import apply
+        names = self._param_names
+
+        def _fwd(tok, *pvals, cfg_id=None):
+            params = dict(zip(names, pvals))
+            return gpt_forward(params, tok, self.cfg)
+        return apply("gpt_forward", _fwd, tokens,
+                     *[self._params[n] for n in names],
+                     cfg_id=repr(self.cfg))
+
+    __call__ = forward
+
+    def loss(self, tokens):
+        from ..framework.dispatch import apply
+        names = self._param_names
+
+        def _loss(tok, *pvals, cfg_id=None):
+            params = dict(zip(names, pvals))
+            return gpt_loss(params, tok, self.cfg)
+        return apply("gpt_loss", _loss, tokens,
+                     *[self._params[n] for n in names],
+                     cfg_id=repr(self.cfg))
+
+
+# canonical configs (reference: GPT-3 table; 6.7B is BASELINE config 3)
+GPT3_CONFIGS = {
+    "125m": GPTConfig(hidden_size=768, num_layers=12, num_heads=12),
+    "350m": GPTConfig(hidden_size=1024, num_layers=24, num_heads=16),
+    "1.3b": GPTConfig(hidden_size=2048, num_layers=24, num_heads=16),
+    "2.7b": GPTConfig(hidden_size=2560, num_layers=32, num_heads=32),
+    "6.7b": GPTConfig(hidden_size=4096, num_layers=32, num_heads=32,
+                      max_seq_len=2048),
+    "13b": GPTConfig(hidden_size=5120, num_layers=40, num_heads=40,
+                     max_seq_len=2048),
+}

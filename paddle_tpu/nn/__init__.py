@@ -1,0 +1,40 @@
+"""paddle_tpu.nn (reference: python/paddle/nn/__init__.py)."""
+from .layer import Layer  # noqa: F401
+from .parameter import Parameter  # noqa: F401
+from .param_attr import ParamAttr  # noqa: F401
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .layers.common import (  # noqa: F401
+    Linear, Identity, Embedding, Dropout, Dropout2D, Dropout3D, AlphaDropout,
+    Flatten, Upsample, UpsamplingBilinear2D, UpsamplingNearest2D, Bilinear,
+    PixelShuffle, PixelUnshuffle, ChannelShuffle, Pad1D, Pad2D, Pad3D,
+    ZeroPad2D, CosineSimilarity, PairwiseDistance, Unfold, Fold)
+from .layers.container import (  # noqa: F401
+    Sequential, LayerList, LayerDict, ParameterList)
+from .layers.conv import (  # noqa: F401
+    Conv1D, Conv2D, Conv3D, Conv1DTranspose, Conv2DTranspose,
+    Conv3DTranspose)
+from .layers.norm import (  # noqa: F401
+    LayerNorm, RMSNorm, BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
+    SyncBatchNorm, GroupNorm, InstanceNorm1D, InstanceNorm2D, InstanceNorm3D,
+    LocalResponseNorm, SpectralNorm)
+from .layers.activation import (  # noqa: F401
+    ReLU, ReLU6, Sigmoid, Tanh, Silu, Mish, Softsign, Tanhshrink, LogSigmoid,
+    Hardswish, Swish, GELU, LeakyReLU, ELU, CELU, SELU, PReLU, RReLU,
+    Hardshrink, Softshrink, Hardtanh, Hardsigmoid, Softplus, ThresholdedReLU,
+    Softmax, LogSoftmax, Maxout, GLU)
+from .layers.pooling import (  # noqa: F401
+    MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
+    AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,
+    AdaptiveMaxPool1D, AdaptiveMaxPool2D)
+from .layers.loss import (  # noqa: F401
+    CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
+    KLDivLoss, SmoothL1Loss, HuberLoss, MarginRankingLoss,
+    HingeEmbeddingLoss, CosineEmbeddingLoss, TripletMarginLoss, CTCLoss)
+from .layers.transformer import (  # noqa: F401
+    MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
+    TransformerDecoderLayer, TransformerDecoder, Transformer)
+from .layers.rnn import (  # noqa: F401
+    SimpleRNN, LSTM, GRU, LSTMCell, GRUCell, SimpleRNNCell, RNN, BiRNN)
+from . import utils  # noqa: F401
+from .clip import ClipGradByNorm, ClipGradByValue, ClipGradByGlobalNorm  # noqa: F401

@@ -1,0 +1,89 @@
+"""Gradient clipping (reference: python/paddle/nn/clip.py — ClipGradByNorm,
+ClipGradByValue, ClipGradByGlobalNorm). Applied by optimizers before update;
+the global-norm variant runs as one fused jitted pytree computation.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+
+class ClipGradBase:
+    def _clip_values(self, grads):
+        """grads: list of jax arrays → list of jax arrays (pure; traceable)."""
+        raise NotImplementedError
+
+    def __call__(self, params_grads):
+        # paddle-style interface: list[(param, grad Tensor)]
+        grads = [g._value for _, g in params_grads]
+        clipped = self._clip_values(grads)
+        return [(p, Tensor(g, stop_gradient=True))
+                for (p, _), g in zip(params_grads, clipped)]
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):  # noqa: A002
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def _clip_values(self, grads):
+        return [jnp.clip(g, self.min, self.max) for g in grads]
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _clip_values(self, grads):
+        out = []
+        for g in grads:
+            norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            factor = jnp.where(norm > self.clip_norm,
+                               self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((g * factor).astype(g.dtype))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+
+    def _clip_values(self, grads):
+        gn_sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    for g in grads)
+        gnorm = jnp.sqrt(gn_sq)
+        factor = jnp.where(gnorm > self.clip_norm,
+                           self.clip_norm / jnp.maximum(gnorm, 1e-12), 1.0)
+        return [(g * factor).astype(g.dtype) for g in grads]
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad._value for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g)) for g in grads]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g.astype(jnp.float32)) ** norm_type)
+             for g in grads])) ** (1.0 / norm_type)
+    factor = jnp.minimum(max_norm / jnp.maximum(total, 1e-6), 1.0)
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._value = (p.grad._value * factor).astype(p.grad.dtype)
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._value = jnp.clip(p.grad._value, -clip_value, clip_value)

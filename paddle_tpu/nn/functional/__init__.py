@@ -1,0 +1,34 @@
+"""paddle_tpu.nn.functional (reference: python/paddle/nn/functional/)."""
+from .activation import (  # noqa: F401
+    relu, relu6, sigmoid, tanh, silu, mish, softsign, tanhshrink,
+    log_sigmoid, gelu, leaky_relu, elu, celu, selu, prelu, rrelu,
+    hardshrink, softshrink, hardtanh, hardsigmoid, hardswish, swish,
+    softplus, thresholded_relu, softmax, log_softmax, gumbel_softmax,
+    maxout, glu)
+from .common import (  # noqa: F401
+    linear, embedding, dropout, dropout2d, dropout3d, alpha_dropout,
+    normalize, label_smooth, pad, cosine_similarity, pixel_shuffle,
+    pixel_unshuffle, channel_shuffle, interpolate, upsample, unfold, fold,
+    bilinear, sequence_mask)
+from .conv import (  # noqa: F401
+    conv1d, conv2d, conv3d, conv1d_transpose, conv2d_transpose,
+    conv3d_transpose)
+from .pooling import (  # noqa: F401
+    max_pool1d, max_pool2d, max_pool3d, avg_pool1d, avg_pool2d, avg_pool3d,
+    adaptive_avg_pool1d, adaptive_avg_pool2d, adaptive_avg_pool3d,
+    adaptive_max_pool1d, adaptive_max_pool2d, lp_pool2d)
+from .norm import (  # noqa: F401
+    layer_norm, rms_norm, batch_norm, group_norm, instance_norm,
+    local_response_norm)
+from .loss import (  # noqa: F401
+    cross_entropy, softmax_with_cross_entropy, mse_loss, l1_loss,
+    smooth_l1_loss, huber_loss, nll_loss, binary_cross_entropy,
+    binary_cross_entropy_with_logits, kl_div, margin_ranking_loss,
+    hinge_embedding_loss, cosine_embedding_loss, triplet_margin_loss,
+    log_loss, square_error_cost, sigmoid_focal_loss, ctc_loss, npair_loss)
+from .attention import (  # noqa: F401
+    scaled_dot_product_attention, flash_attention, flash_attn_unpadded,
+    memory_efficient_attention, sparse_attention)
+from ...ops.creation import one_hot  # noqa: F401
+from ...ops.manipulation import gather, gather_nd, scatter, scatter_nd  # noqa: F401
+from ...ops.math import scale  # noqa: F401

@@ -1,0 +1,251 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py
+→ phi activation kernels). Single jax fns — XLA fuses them into surrounding
+matmuls, which is exactly what the reference's fused-op zoo hand-builds.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...framework.dispatch import defop
+from ...framework.tensor import Tensor
+
+
+def _unary(name, jfn):
+    @defop(name)
+    def op(x):
+        return jfn(x)
+
+    def public(x, name=None):
+        return op(x)
+    public.__name__ = name
+    return public
+
+
+relu = _unary("relu", jax.nn.relu)
+relu6 = _unary("relu6", jax.nn.relu6)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+tanh = _unary("tanh_act", jnp.tanh)
+silu = _unary("silu", jax.nn.silu)
+mish = _unary("mish", lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+softsign = _unary("softsign", jax.nn.soft_sign)
+tanhshrink = _unary("tanhshrink", lambda x: x - jnp.tanh(x))
+log_sigmoid = _unary("log_sigmoid", jax.nn.log_sigmoid)
+
+
+@defop("gelu")
+def _gelu(x, approximate):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def gelu(x, approximate=False, name=None):
+    return _gelu(x, bool(approximate))
+
+
+@defop("leaky_relu")
+def _leaky_relu(x, negative_slope):
+    return jax.nn.leaky_relu(x, negative_slope)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _leaky_relu(x, float(negative_slope))
+
+
+@defop("elu")
+def _elu(x, alpha):
+    return jax.nn.elu(x, alpha)
+
+
+def elu(x, alpha=1.0, name=None):
+    return _elu(x, float(alpha))
+
+
+@defop("celu")
+def _celu(x, alpha):
+    return jax.nn.celu(x, alpha)
+
+
+def celu(x, alpha=1.0, name=None):
+    return _celu(x, float(alpha))
+
+
+@defop("selu")
+def _selu(x, scale, alpha):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return _selu(x, float(scale), float(alpha))
+
+
+@defop("prelu_op")
+def _prelu(x, weight, data_format):
+    if weight.ndim == 1 and weight.shape[0] > 1:
+        ch_axis = 1 if data_format in ("NCHW", "NCL", "NCDHW") else x.ndim - 1
+        shape = [1] * x.ndim
+        shape[ch_axis] = weight.shape[0]
+        weight = weight.reshape(shape)
+    return jnp.where(x > 0, x, weight * x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    return _prelu(x, weight, data_format)
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
+    from ...framework.random import next_key
+
+    @defop("rrelu")
+    def _rrelu(x, key, lower, upper, training):
+        if training:
+            a = jax.random.uniform(key, x.shape, jnp.float32, lower,
+                                   upper).astype(x.dtype)
+        else:
+            a = jnp.asarray((lower + upper) / 2.0, x.dtype)
+        return jnp.where(x >= 0, x, a * x)
+    return _rrelu(x, next_key(), float(lower), float(upper), bool(training))
+
+
+@defop("hardshrink")
+def _hardshrink(x, threshold):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0).astype(x.dtype)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return _hardshrink(x, float(threshold))
+
+
+@defop("softshrink")
+def _softshrink(x, threshold):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, 0.0)).astype(x.dtype)
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return _softshrink(x, float(threshold))
+
+
+@defop("hardtanh")
+def _hardtanh(x, mn, mx):
+    return jnp.clip(x, mn, mx)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):  # noqa: A002
+    return _hardtanh(x, float(min), float(max))
+
+
+@defop("hardsigmoid")
+def _hardsigmoid(x, slope, offset):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return _hardsigmoid(x, float(slope), float(offset))
+
+
+@defop("hardswish")
+def _hardswish(x):
+    return x * jnp.clip(x / 6.0 + 0.5, 0.0, 1.0)
+
+
+def hardswish(x, name=None):
+    return _hardswish(x)
+
+
+@defop("swish")
+def _swish(x):
+    return jax.nn.silu(x)
+
+
+def swish(x, name=None):
+    return _swish(x)
+
+
+@defop("softplus")
+def _softplus(x, beta, threshold):
+    return jnp.where(x * beta > threshold, x,
+                     (1.0 / beta) * jnp.log1p(jnp.exp(beta * x))).astype(x.dtype)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return _softplus(x, float(beta), float(threshold))
+
+
+@defop("thresholded_relu")
+def _thresholded_relu(x, threshold, value):
+    return jnp.where(x > threshold, x, value).astype(x.dtype)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return _thresholded_relu(x, float(threshold), float(value))
+
+
+@defop("softmax")
+def _softmax(x, axis, dtype):
+    if dtype is not None:
+        x = x.astype(dtype)
+    return jax.nn.softmax(x, axis=axis)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...framework import dtype as dtypes
+    return _softmax(x, int(axis),
+                    None if dtype is None else dtypes.convert_dtype(dtype))
+
+
+@defop("log_softmax")
+def _log_softmax(x, axis, dtype):
+    if dtype is not None:
+        x = x.astype(dtype)
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...framework import dtype as dtypes
+    return _log_softmax(x, int(axis),
+                        None if dtype is None else dtypes.convert_dtype(dtype))
+
+
+@defop("gumbel_softmax")
+def _gumbel_softmax(x, key, temperature, hard, axis):
+    g = jax.random.gumbel(key, x.shape).astype(x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        onehot = jnp.zeros_like(y)
+        onehot = jnp.put_along_axis(onehot, idx, 1.0, axis=axis, inplace=False)
+        y = onehot + y - jax.lax.stop_gradient(y)
+    return y
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...framework.random import next_key
+    return _gumbel_softmax(x, next_key(), float(temperature), bool(hard),
+                           int(axis))
+
+
+@defop("maxout_op")
+def _maxout(x, groups, axis):
+    c = x.shape[axis]
+    new = x.shape[:axis] + (c // groups, groups) + x.shape[axis + 1:]
+    return jnp.max(x.reshape(new), axis=axis + 1)
+
+
+def maxout(x, groups, axis=1, name=None):
+    return _maxout(x, int(groups), int(axis))
+
+
+@defop("glu_op")
+def _glu(x, axis):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+def glu(x, axis=-1, name=None):
+    return _glu(x, int(axis))
+
+
+@defop("softmax_with_temp")
+def _temperature_scaled_softmax(x, t, axis):
+    return jax.nn.softmax(x / t, axis=axis)

@@ -1,0 +1,121 @@
+"""Attention functionals.
+
+Reference analog: python/paddle/nn/functional/flash_attention.py:125 and the
+fused_attention CUDA ops (/root/reference/paddle/fluid/operators/fused/
+fused_attention_op.cu). TPU-native: one fused jax op body that XLA maps onto
+the MXU; the Pallas flash-attention kernel (paddle_tpu.kernels) plugs in
+underneath `flash_attention` for long sequences.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...framework.dispatch import defop
+from ...framework.tensor import Tensor
+from ...framework.random import next_key
+
+
+@defop("sdpa_op")
+def _sdpa(q, k, v, mask, key, dropout_p, causal, training, scale):
+    # q,k,v: [B, S, H, D] (paddle flash-attn layout)
+    qt = jnp.swapaxes(q, 1, 2)  # B,H,S,D
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum("bhsd,bhtd->bhst", qt, kt) * scale
+    scores = scores.astype(jnp.float32)
+    if causal:
+        s, t = scores.shape[-2], scores.shape[-1]
+        cm = jnp.tril(jnp.ones((s, t), bool))
+        scores = jnp.where(cm, scores, -jnp.inf)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            scores = jnp.where(mask, scores, -jnp.inf)
+        else:
+            scores = scores + mask.astype(jnp.float32)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and training:
+        keep = 1.0 - dropout_p
+        dmask = jax.random.bernoulli(key, keep, probs.shape)
+        probs = jnp.where(dmask, probs / keep, 0.0).astype(q.dtype)
+    out = jnp.einsum("bhst,bhtd->bhsd", probs, vt)
+    return jnp.swapaxes(out, 1, 2)  # B,S,H,D
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    return _sdpa(query, key, value, attn_mask, next_key(), float(dropout_p),
+                 bool(is_causal), bool(training), None)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None,
+                    rng_name="", training=True, name=None):
+    """paddle.nn.functional.flash_attention analog.
+
+    Dispatches to the Pallas TPU kernel for the no-dropout fast path
+    (paddle_tpu/kernels/flash_attention.py); falls back to the fused XLA
+    body otherwise.
+    """
+    from ...kernels import flash_attention as fa_kernel
+    if fa_kernel.available() and dropout == 0.0 and not return_softmax:
+        out = fa_kernel.flash_attention(query, key, value, causal=causal)
+        if return_softmax:
+            return out, None
+        return out, None
+    out = _sdpa(query, key, value, None, next_key(), float(dropout),
+                bool(causal), bool(training), None)
+    return out, None
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
+                        causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    # varlen packing: fall back to dense with mask built from cu_seqlens
+    raise NotImplementedError(
+        "varlen flash attention: pack ragged batches densely; TPU path "
+        "requires static shapes")
+
+
+@defop("memory_efficient_attention_op")
+def _mea(q, k, v, bias, scale, causal):
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    scores = (jnp.einsum("bhsd,bhtd->bhst", qt, kt) * scale).astype(jnp.float32)
+    if causal:
+        s, t = scores.shape[-2], scores.shape[-1]
+        scores = jnp.where(jnp.tril(jnp.ones((s, t), bool)), scores, -jnp.inf)
+    if bias is not None:
+        scores = scores + bias.astype(jnp.float32)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhst,bhtd->bhsd", probs, vt)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0,
+                               scale=None, training=True):
+    """reference: python/paddle/incubate/nn/memory_efficient_attention.py"""
+    return _mea(query, key, value, attn_bias,
+                None if scale is None else float(scale), False)
+
+
+@defop("sparse_attention_op")
+def _sparse_attention(q, k, v, offset, columns):
+    raise NotImplementedError
+
+
+def sparse_attention(*args, **kwargs):
+    raise NotImplementedError(
+        "block-sparse attention: use flash_attention with causal masking; "
+        "a Pallas block-sparse kernel is on the roadmap")

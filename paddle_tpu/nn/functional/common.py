@@ -1,0 +1,312 @@
+"""Common functionals: linear, embedding, dropout, normalize, interpolate, pad.
+
+Reference analog: python/paddle/nn/functional/common.py. Dropout draws its key
+from the global counter-based PRNG so the mask is identical under tape
+recompute (framework/random.py) and threads through to_static traces.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...framework import dtype as dtypes
+from ...framework.dispatch import defop, apply
+from ...framework.random import next_key
+from ...framework.tensor import Tensor
+
+
+@defop("linear")
+def _linear(x, w, b):
+    y = jnp.matmul(x, w)
+    if b is not None:
+        y = y + b
+    return y
+
+
+@defop("linear_nobias")
+def _linear_nb(x, w):
+    return jnp.matmul(x, w)
+
+
+def linear(x, weight, bias=None, name=None):
+    if bias is None:
+        return _linear_nb(x, weight)
+    return _linear(x, weight, bias)
+
+
+@defop("embedding_op")
+def _embedding(weight, x, padding_idx, sparse):
+    out = jnp.take(weight, x, axis=0)
+    if padding_idx is not None:
+        mask = (x == padding_idx)[..., None]
+        out = jnp.where(mask, 0.0, out).astype(weight.dtype)
+    return out
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    return _embedding(weight, x,
+                      None if padding_idx is None else int(padding_idx),
+                      bool(sparse))
+
+
+@defop("dropout_op")
+def _dropout(x, key, p, training, mode, axis):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return x * (1.0 - p)
+        return x
+    shape = list(x.shape)
+    if axis is not None:
+        for i in range(len(shape)):
+            if i not in axis:
+                shape[i] = 1
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, tuple(shape))
+    if mode == "upscale_in_train":
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+    return jnp.where(mask, x, 0.0).astype(x.dtype)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if axis is not None and not isinstance(axis, (list, tuple)):
+        axis = (int(axis),)
+    elif axis is not None:
+        axis = tuple(int(a) for a in axis)
+    return _dropout(x, next_key(), float(p), bool(training), mode, axis)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = (0, 1) if data_format == "NCHW" else (0, 3)
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = (0, 1) if data_format == "NCDHW" else (0, 4)
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+
+    @defop("alpha_dropout")
+    def _alpha_dropout(x, key, p):
+        alpha = 1.6732632423543772
+        scale = 1.0507009873554805
+        alpha_p = -alpha * scale
+        keep = 1.0 - p
+        a = (keep + alpha_p ** 2 * keep * (1 - keep)) ** -0.5
+        b = -a * alpha_p * (1 - keep)
+        mask = jax.random.bernoulli(key, keep, x.shape)
+        return (a * jnp.where(mask, x, alpha_p) + b).astype(x.dtype)
+    return _alpha_dropout(x, next_key(), float(p))
+
+
+@defop("normalize_op")
+def _normalize(x, p, axis, epsilon):
+    norm = jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+    return x / jnp.maximum(norm, epsilon)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    return _normalize(x, float(p), int(axis), float(epsilon))
+
+
+@defop("label_smooth_op")
+def _label_smooth(label, epsilon, prior=None):
+    n = label.shape[-1]
+    if prior is None:
+        return (1 - epsilon) * label + epsilon / n
+    return (1 - epsilon) * label + epsilon * prior
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    if prior_dist is not None:
+        def _ls(label, prior, epsilon):
+            return (1 - epsilon) * label + epsilon * prior
+        return apply("label_smooth_prior", _ls, label, prior_dist,
+                     epsilon=float(epsilon))
+    return _label_smooth(label, float(epsilon))
+
+
+from ...ops.manipulation import pad  # noqa: E402,F401  (F.pad is ops.pad)
+
+
+@defop("cosine_similarity_op")
+def _cosine_similarity(x1, x2, axis, eps):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.linalg.norm(x1, axis=axis)
+    n2 = jnp.linalg.norm(x2, axis=axis)
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    return _cosine_similarity(x1, x2, int(axis), float(eps))
+
+
+@defop("pixel_shuffle_op")
+def _pixel_shuffle(x, upscale_factor, data_format):
+    r = upscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        oc = c // (r * r)
+        x = x.reshape(n, oc, r, r, h, w)
+        x = x.transpose(0, 1, 4, 2, 5, 3)
+        return x.reshape(n, oc, h * r, w * r)
+    n, h, w, c = x.shape
+    oc = c // (r * r)
+    x = x.reshape(n, h, w, r, r, oc)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h * r, w * r, oc)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    return _pixel_shuffle(x, int(upscale_factor), data_format)
+
+
+@defop("pixel_unshuffle_op")
+def _pixel_unshuffle(x, r, data_format):
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c, h // r, r, w // r, r)
+        x = x.transpose(0, 1, 3, 5, 2, 4)
+        return x.reshape(n, c * r * r, h // r, w // r)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // r, r, w // r, r, c)
+    x = x.transpose(0, 2, 4, 5, 1, 3)
+    return x.reshape(n, h // r, w // r, c * r * r)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    return _pixel_unshuffle(x, int(downscale_factor), data_format)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    @defop("channel_shuffle_op")
+    def _channel_shuffle(x, groups, data_format):
+        if data_format == "NCHW":
+            n, c, h, w = x.shape
+            x = x.reshape(n, groups, c // groups, h, w)
+            x = x.transpose(0, 2, 1, 3, 4)
+            return x.reshape(n, c, h, w)
+        n, h, w, c = x.shape
+        x = x.reshape(n, h, w, groups, c // groups)
+        x = x.transpose(0, 1, 2, 4, 3)
+        return x.reshape(n, h, w, c)
+    return _channel_shuffle(x, int(groups), data_format)
+
+
+@defop("interpolate_op")
+def _interpolate(x, size, mode, align_corners, data_format):
+    # channels-first spatial resize via jax.image
+    spatial_dims = len(size)
+    if data_format.startswith("NC"):
+        out_shape = x.shape[:2] + tuple(size)
+    else:
+        out_shape = (x.shape[0],) + tuple(size) + (x.shape[-1],)
+    method = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+              "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+    return jax.image.resize(x, out_shape, method=method).astype(x.dtype)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    nd = x.ndim - 2
+    if size is None:
+        if scale_factor is None:
+            raise ValueError("one of size / scale_factor must be set")
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+            else [scale_factor] * nd
+        spatial = x.shape[2:] if data_format.startswith("NC") else x.shape[1:-1]
+        size = [int(s * f) for s, f in zip(spatial, sf)]
+    if isinstance(size, Tensor):
+        size = [int(v) for v in size.numpy().reshape(-1)]
+    size = tuple(int(s.item() if isinstance(s, Tensor) else s) for s in size)
+    return _interpolate(x, size, mode, bool(align_corners), data_format)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+@defop("unfold_op")
+def _unfold(x, kernel_sizes, strides, paddings, dilations):
+    n, c, h, w = x.shape
+    kh, kw = kernel_sizes
+    sh, sw = strides
+    ph, pw = paddings[0], paddings[1]
+    dh, dw = dilations
+    x = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    oh = (x.shape[2] - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (x.shape[3] - (dw * (kw - 1) + 1)) // sw + 1
+    patches = []
+    for i in range(kh):
+        for j in range(kw):
+            patches.append(
+                x[:, :, i * dh:i * dh + oh * sh:sh,
+                  j * dw:j * dw + ow * sw:sw])
+    out = jnp.stack(patches, axis=2)  # n, c, kh*kw, oh, ow
+    return out.reshape(n, c * kh * kw, oh * ow)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    return _unfold(x, _pair(kernel_sizes), _pair(strides), _pair(paddings),
+                   _pair(dilations))
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    @defop("fold_op")
+    def _fold(x, output_sizes, kernel_sizes, strides, paddings, dilations):
+        n, ckk, l = x.shape
+        kh, kw = kernel_sizes
+        c = ckk // (kh * kw)
+        oh_pad = output_sizes[0] + 2 * paddings[0]
+        ow_pad = output_sizes[1] + 2 * paddings[1]
+        sh, sw = strides
+        dh, dw = dilations
+        nh = (oh_pad - (dh * (kh - 1) + 1)) // sh + 1
+        nw = (ow_pad - (dw * (kw - 1) + 1)) // sw + 1
+        x = x.reshape(n, c, kh, kw, nh, nw)
+        out = jnp.zeros((n, c, oh_pad, ow_pad), x.dtype)
+        for i in range(kh):
+            for j in range(kw):
+                out = out.at[:, :, i * dh:i * dh + nh * sh:sh,
+                             j * dw:j * dw + nw * sw:sw].add(x[:, :, i, j])
+        return out[:, :, paddings[0]:oh_pad - paddings[0],
+                   paddings[1]:ow_pad - paddings[1]]
+    return _fold(x, _pair(output_sizes), _pair(kernel_sizes), _pair(strides),
+                 _pair(paddings), _pair(dilations))
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def _bilinear(x1, x2, w, b=None):
+        out = jnp.einsum("bi,oij,bj->bo", x1, w, x2)
+        if b is not None:
+            out = out + b
+        return out
+    if bias is None:
+        return apply("bilinear_nb", lambda a, b, w: _bilinear(a, b, w),
+                     x1, x2, weight)
+    return apply("bilinear", _bilinear, x1, x2, weight, bias)
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
+    if maxlen is None:
+        maxlen = int(lengths.numpy().max())
+
+    @defop("sequence_mask_op")
+    def _sequence_mask(lengths, maxlen, dtype):
+        r = jnp.arange(maxlen)
+        return (r[None, :] < lengths[..., None]).astype(dtype)
+    return _sequence_mask(lengths, int(maxlen), dtypes.convert_dtype(dtype))

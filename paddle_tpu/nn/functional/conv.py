@@ -1,0 +1,189 @@
+"""Convolution functionals.
+
+Reference analog: python/paddle/nn/functional/conv.py → phi conv kernels
+(cuDNN in the reference). Here convs lower to XLA's conv_general_dilated,
+which maps directly onto the TPU MXU; layout assignment (NCHW→internal) is
+XLA's job, so we keep Paddle's NCHW-default API unchanged.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...framework.dispatch import defop
+from ...framework.tensor import Tensor
+
+
+def _tuplize(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(int(x) for x in v)
+    if len(v) == 1:
+        return v * n
+    return v
+
+
+def _padding(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return tuple((padding, padding) for _ in range(n))
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return tuple((p, p) for p in padding)
+    if len(padding) == 2 * n:
+        return tuple((padding[2 * i], padding[2 * i + 1]) for i in range(n))
+    # paddle also allows [[0,0],[0,0],[ph,ph],[pw,pw]]
+    if len(padding) == n + 2:
+        return tuple(tuple(p) for p in padding[2:])
+    return tuple(tuple(p) for p in padding)
+
+
+def _conv_nd(x, w, bias, stride, padding, dilation, groups, nd, data_format):
+    chan_first = data_format.startswith("NC")
+    if nd == 1:
+        dn_spec = ("NCH", "OIH", "NCH") if chan_first else ("NHC", "OIH", "NHC")
+    elif nd == 2:
+        dn_spec = ("NCHW", "OIHW", "NCHW") if chan_first else \
+            ("NHWC", "OIHW", "NHWC")
+    else:
+        dn_spec = ("NCDHW", "OIDHW", "NCDHW") if chan_first else \
+            ("NDHWC", "OIDHW", "NDHWC")
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape, dn_spec)
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=stride, padding=padding,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=None)
+    if bias is not None:
+        bshape = [1] * out.ndim
+        bshape[1 if chan_first else -1] = bias.shape[0]
+        out = out + bias.reshape(bshape)
+    return out
+
+
+@defop("conv1d_op")
+def _conv1d(x, w, b, stride, padding, dilation, groups, data_format):
+    return _conv_nd(x, w, b, stride, padding, dilation, groups, 1, data_format)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    df = "NCH" if data_format == "NCL" else "NHC"
+    return _conv1d(x, weight, bias, _tuplize(stride, 1), _padding(padding, 1),
+                   _tuplize(dilation, 1), int(groups), df)
+
+
+@defop("conv2d_op")
+def _conv2d(x, w, b, stride, padding, dilation, groups, data_format):
+    return _conv_nd(x, w, b, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv2d(x, weight, bias, _tuplize(stride, 2), _padding(padding, 2),
+                   _tuplize(dilation, 2), int(groups), data_format)
+
+
+@defop("conv3d_op")
+def _conv3d(x, w, b, stride, padding, dilation, groups, data_format):
+    return _conv_nd(x, w, b, stride, padding, dilation, groups, 3, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv3d(x, weight, bias, _tuplize(stride, 3), _padding(padding, 3),
+                   _tuplize(dilation, 3), int(groups), data_format)
+
+
+def _conv_transpose_nd(x, w, bias, stride, padding, output_padding, dilation,
+                       groups, nd, data_format):
+    chan_first = data_format.startswith("NC")
+    # paddle weight layout for transpose conv: [in, out/groups, *k]
+    if nd == 1:
+        spec = ("NCH", "IOH", "NCH") if chan_first else ("NHC", "IOH", "NHC")
+    elif nd == 2:
+        spec = ("NCHW", "IOHW", "NCHW") if chan_first else \
+            ("NHWC", "IOHW", "NHWC")
+    else:
+        spec = ("NCDHW", "IODHW", "NCDHW") if chan_first else \
+            ("NDHWC", "IODHW", "NDHWC")
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape, spec)
+    if isinstance(padding, str):
+        pad = padding
+    else:
+        # conv_transpose padding semantics: derive from forward-conv padding
+        pad = []
+        k = w.shape[2:]
+        for i in range(nd):
+            eff_k = (k[i] - 1) * dilation[i] + 1
+            lo = eff_k - 1 - padding[i][0]
+            hi = eff_k - 1 - padding[i][1] + output_padding[i]
+            pad.append((lo, hi))
+        pad = tuple(pad)
+    if groups > 1:
+        xs = jnp.split(x, groups, axis=1 if chan_first else -1)
+        ws = jnp.split(w, groups, axis=0)
+        outs = [jax.lax.conv_general_dilated(
+            xg, wg, window_strides=(1,) * nd, padding=pad,
+            lhs_dilation=stride, rhs_dilation=dilation,
+            dimension_numbers=dn,
+            transpose_kernel=True) for xg, wg in zip(xs, ws)]
+        out = jnp.concatenate(outs, axis=1 if chan_first else -1)
+    else:
+        out = jax.lax.conv_general_dilated(
+            x, w, window_strides=(1,) * nd, padding=pad,
+            lhs_dilation=stride, rhs_dilation=dilation,
+            dimension_numbers=dn, transpose_kernel=True)
+    if bias is not None:
+        bshape = [1] * out.ndim
+        bshape[1 if chan_first else -1] = bias.shape[0]
+        out = out + bias.reshape(bshape)
+    return out
+
+
+@defop("conv1d_transpose_op")
+def _conv1dt(x, w, b, stride, padding, output_padding, dilation, groups,
+             data_format):
+    return _conv_transpose_nd(x, w, b, stride, padding, output_padding,
+                              dilation, groups, 1, data_format)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    df = "NCH" if data_format == "NCL" else "NHC"
+    return _conv1dt(x, weight, bias, _tuplize(stride, 1), _padding(padding, 1),
+                    _tuplize(output_padding, 1), _tuplize(dilation, 1),
+                    int(groups), df)
+
+
+@defop("conv2d_transpose_op")
+def _conv2dt(x, w, b, stride, padding, output_padding, dilation, groups,
+             data_format):
+    return _conv_transpose_nd(x, w, b, stride, padding, output_padding,
+                              dilation, groups, 2, data_format)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCHW", name=None):
+    return _conv2dt(x, weight, bias, _tuplize(stride, 2),
+                    _padding(padding, 2), _tuplize(output_padding, 2),
+                    _tuplize(dilation, 2), int(groups), data_format)
+
+
+@defop("conv3d_transpose_op")
+def _conv3dt(x, w, b, stride, padding, output_padding, dilation, groups,
+             data_format):
+    return _conv_transpose_nd(x, w, b, stride, padding, output_padding,
+                              dilation, groups, 3, data_format)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    return _conv3dt(x, weight, bias, _tuplize(stride, 3),
+                    _padding(padding, 3), _tuplize(output_padding, 3),
+                    _tuplize(dilation, 3), int(groups), data_format)

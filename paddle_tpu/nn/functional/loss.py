@@ -1,0 +1,371 @@
+"""Loss functionals (reference: python/paddle/nn/functional/loss.py).
+
+cross_entropy fuses log_softmax+gather in one op body — XLA emits the same
+fused softmax-xent the reference's softmax_with_cross_entropy CUDA kernel
+hand-writes.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...framework import dtype as dtypes
+from ...framework.dispatch import defop
+from ...framework.tensor import Tensor
+
+
+def _reduce(loss, reduction, weight_sum=None):
+    if reduction == "mean":
+        if weight_sum is not None:
+            return jnp.sum(loss) / weight_sum
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@defop("cross_entropy_hard")
+def _cross_entropy_hard(input, label, weight, ignore_index, reduction, axis,
+                        use_softmax, label_smoothing):
+    logits = input
+    if axis != -1 and axis != input.ndim - 1:
+        logits = jnp.moveaxis(logits, axis, -1)
+        if label.ndim == input.ndim:
+            label = jnp.moveaxis(label, axis, -1)
+    squeeze_label = (label.ndim == logits.ndim and label.shape[-1] == 1)
+    if squeeze_label:
+        label = label[..., 0]
+    n_class = logits.shape[-1]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1) \
+        if use_softmax else jnp.log(jnp.maximum(logits, 1e-37)).astype(jnp.float32)
+    valid = (label != ignore_index)
+    safe_label = jnp.where(valid, label, 0)
+    picked = jnp.take_along_axis(logp, safe_label[..., None].astype(jnp.int32),
+                                 axis=-1)[..., 0]
+    if label_smoothing > 0.0:
+        smooth = jnp.mean(logp, axis=-1)
+        picked = (1.0 - label_smoothing) * picked + label_smoothing * smooth
+    loss = -picked
+    if weight is not None:
+        w = jnp.take(weight, safe_label, axis=0).astype(jnp.float32)
+        loss = loss * w
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(
+                jnp.sum(jnp.where(valid, w, 0.0)), 1e-12)
+    else:
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(
+                jnp.sum(valid.astype(jnp.float32)), 1.0)
+    return _reduce(loss, reduction)
+
+
+@defop("cross_entropy_soft")
+def _cross_entropy_soft(input, label, reduction, axis, use_softmax,
+                        label_smoothing):
+    logp = jax.nn.log_softmax(input.astype(jnp.float32), axis=axis) \
+        if use_softmax else jnp.log(jnp.maximum(input, 1e-37)).astype(jnp.float32)
+    lab = label.astype(jnp.float32)
+    if label_smoothing > 0.0:
+        n = input.shape[axis]
+        lab = (1.0 - label_smoothing) * lab + label_smoothing / n
+    loss = -jnp.sum(lab * logp, axis=axis)
+    return _reduce(loss, reduction)
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,  # noqa: A002
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    if soft_label:
+        return _cross_entropy_soft(input, label, reduction, int(axis),
+                                   bool(use_softmax), float(label_smoothing))
+    return _cross_entropy_hard(input, label, weight, int(ignore_index),
+                               reduction, int(axis), bool(use_softmax),
+                               float(label_smoothing))
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    from ..functional.activation import softmax as softmax_fn
+    from ...ops.manipulation import unsqueeze
+    if not soft_label:
+        loss = unsqueeze(loss, -1)
+    if return_softmax:
+        return loss, softmax_fn(logits, axis=axis)
+    return loss
+
+
+@defop("mse_loss_op")
+def _mse_loss(input, label, reduction):
+    return _reduce(jnp.square(input - label), reduction)
+
+
+def mse_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    return _mse_loss(input, label, reduction)
+
+
+@defop("l1_loss_op")
+def _l1_loss(input, label, reduction):
+    return _reduce(jnp.abs(input - label), reduction)
+
+
+def l1_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    return _l1_loss(input, label, reduction)
+
+
+@defop("smooth_l1_loss_op")
+def _smooth_l1(input, label, reduction, delta):
+    diff = jnp.abs(input - label)
+    loss = jnp.where(diff < delta, 0.5 * diff * diff / delta,
+                     diff - 0.5 * delta)
+    return _reduce(loss, reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):  # noqa: A002
+    return _smooth_l1(input, label, reduction, float(delta))
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):  # noqa: A002
+    @defop("huber_loss_op")
+    def _huber(input, label, reduction, delta):
+        diff = jnp.abs(input - label)
+        loss = jnp.where(diff <= delta, 0.5 * diff * diff,
+                         delta * (diff - 0.5 * delta))
+        return _reduce(loss, reduction)
+    return _huber(input, label, reduction, float(delta))
+
+
+@defop("nll_loss_op")
+def _nll_loss(input, label, weight, ignore_index, reduction):
+    valid = (label != ignore_index)
+    safe = jnp.where(valid, label, 0)
+    picked = jnp.take_along_axis(input, safe[..., None].astype(jnp.int32)
+                                 if input.ndim == label.ndim + 1 else safe,
+                                 axis=1 if input.ndim > 1 else 0)
+    if input.ndim == label.ndim + 1:
+        picked = jnp.squeeze(picked, axis=1)
+    loss = -picked
+    if weight is not None:
+        w = jnp.take(weight, safe, axis=0)
+        loss = loss * w
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.sum(jnp.where(valid, w, 0.0))
+    loss = jnp.where(valid, loss, 0.0)
+    return _reduce(loss, reduction)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100,  # noqa: A002
+             reduction="mean", name=None):
+    # input: log-probabilities [N, C, ...]; gather along class dim
+    if input.ndim > 2:
+        # flatten spatial dims into batch
+        pass
+    @defop("nll_loss_gather")
+    def _nll(input, label, weight, ignore_index, reduction):
+        valid = (label != ignore_index)
+        safe = jnp.where(valid, label, 0).astype(jnp.int32)
+        picked = jnp.take_along_axis(input, safe[:, None, ...], axis=1)
+        picked = jnp.squeeze(picked, axis=1)
+        loss = -picked
+        if weight is not None:
+            w = jnp.take(weight, safe, axis=0)
+            loss = jnp.where(valid, loss * w, 0.0)
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.sum(jnp.where(valid, w, 0.0))
+        loss = jnp.where(valid, loss, 0.0)
+        return _reduce(loss, reduction)
+    return _nll(input, label, weight, int(ignore_index), reduction)
+
+
+@defop("bce_loss_op")
+def _bce(input, label, weight, reduction):
+    eps = 1e-12
+    loss = -(label * jnp.log(jnp.maximum(input, eps)) +
+             (1.0 - label) * jnp.log(jnp.maximum(1.0 - input, eps)))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",  # noqa: A002
+                         name=None):
+    return _bce(input, label, weight, reduction)
+
+
+@defop("bce_logits_op")
+def _bce_logits(logit, label, weight, pos_weight, reduction):
+    log_sig = jax.nn.log_sigmoid(logit)
+    log_sig_neg = jax.nn.log_sigmoid(-logit)
+    if pos_weight is not None:
+        loss = -(pos_weight * label * log_sig + (1.0 - label) * log_sig_neg)
+    else:
+        loss = -(label * log_sig + (1.0 - label) * log_sig_neg)
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    return _bce_logits(logit, label, weight, pos_weight, reduction)
+
+
+@defop("kl_div_op")
+def _kl_div(input, label, reduction, log_target):
+    if log_target:
+        loss = jnp.exp(label) * (label - input)
+    else:
+        loss = label * (jnp.log(jnp.maximum(label, 1e-12)) - input)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / input.shape[0]
+    return _reduce(loss, reduction)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):  # noqa: A002
+    return _kl_div(input, label, reduction, bool(log_target))
+
+
+@defop("margin_ranking_op")
+def _margin_ranking(input, other, label, margin, reduction):
+    loss = jnp.maximum(0.0, -label * (input - other) + margin)
+    return _reduce(loss, reduction)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",  # noqa: A002
+                        name=None):
+    return _margin_ranking(input, other, label, float(margin), reduction)
+
+
+@defop("hinge_embedding_op")
+def _hinge_embedding(input, label, margin, reduction):
+    loss = jnp.where(label == 1.0, input,
+                     jnp.maximum(0.0, margin - input))
+    return _reduce(loss, reduction)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",  # noqa: A002
+                         name=None):
+    return _hinge_embedding(input, label, float(margin), reduction)
+
+
+@defop("cosine_embedding_op")
+def _cosine_embedding(input1, input2, label, margin, reduction):
+    cos = jnp.sum(input1 * input2, axis=-1) / jnp.maximum(
+        jnp.linalg.norm(input1, axis=-1) * jnp.linalg.norm(input2, axis=-1),
+        1e-12)
+    loss = jnp.where(label == 1, 1.0 - cos,
+                     jnp.maximum(0.0, cos - margin))
+    return _reduce(loss, reduction)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean", name=None):
+    return _cosine_embedding(input1, input2, label, float(margin), reduction)
+
+
+@defop("triplet_margin_op")
+def _triplet_margin(anchor, positive, negative, margin, p, eps, swap,
+                    reduction):
+    def dist(a, b):
+        return jnp.sum(jnp.abs(a - b + eps) ** p, axis=-1) ** (1.0 / p)
+    d_pos = dist(anchor, positive)
+    d_neg = dist(anchor, negative)
+    if swap:
+        d_neg = jnp.minimum(d_neg, dist(positive, negative))
+    loss = jnp.maximum(0.0, d_pos - d_neg + margin)
+    return _reduce(loss, reduction)
+
+
+def triplet_margin_loss(anchor, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean",
+                        name=None):
+    return _triplet_margin(anchor, positive, negative, float(margin),
+                           float(p), float(epsilon), bool(swap), reduction)
+
+
+@defop("log_loss_op")
+def _log_loss(input, label, epsilon):
+    return -label * jnp.log(input + epsilon) - \
+        (1.0 - label) * jnp.log(1.0 - input + epsilon)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):  # noqa: A002
+    return _log_loss(input, label, float(epsilon))
+
+
+@defop("square_error_cost_op")
+def _square_error_cost(input, label):
+    return jnp.square(input - label)
+
+
+def square_error_cost(input, label):  # noqa: A002
+    return _square_error_cost(input, label)
+
+
+@defop("sigmoid_focal_op")
+def _sigmoid_focal(logit, label, normalizer, alpha, gamma, reduction):
+    p = jax.nn.sigmoid(logit)
+    ce = -(label * jax.nn.log_sigmoid(logit) +
+           (1 - label) * jax.nn.log_sigmoid(-logit))
+    p_t = p * label + (1 - p) * (1 - label)
+    loss = ce * ((1 - p_t) ** gamma)
+    if alpha >= 0:
+        a_t = alpha * label + (1 - alpha) * (1 - label)
+        loss = a_t * loss
+    if normalizer is not None:
+        loss = loss / normalizer
+    return _reduce(loss, reduction)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    return _sigmoid_focal(logit, label, normalizer, float(alpha),
+                          float(gamma), reduction)
+
+
+@defop("ctc_loss_op")
+def _ctc_loss(log_probs, labels, input_lengths, label_lengths, blank,
+              reduction):
+    # log_probs: [T, N, C] paddle layout
+    import optax
+    lp = jnp.moveaxis(log_probs, 0, 1)  # [N, T, C]
+    t = lp.shape[1]
+    lmax = labels.shape[1]
+    logit_pad = (jnp.arange(t)[None, :] >= input_lengths[:, None]).astype(
+        jnp.float32)
+    label_pad = (jnp.arange(lmax)[None, :] >= label_lengths[:, None]).astype(
+        jnp.float32)
+    per_seq = optax.ctc_loss(lp, logit_pad, labels, label_pad,
+                             blank_id=blank)
+    if reduction == "mean":
+        return jnp.mean(per_seq / jnp.maximum(label_lengths, 1))
+    return _reduce(per_seq, reduction)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    return _ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                     int(blank), reduction)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    @defop("npair_loss_op")
+    def _npair(anchor, positive, labels, l2_reg):
+        reg = l2_reg * (jnp.mean(jnp.sum(jnp.square(anchor), axis=1)) +
+                        jnp.mean(jnp.sum(jnp.square(positive), axis=1))) / 2
+        sim = anchor @ positive.T
+        lab = labels[:, None] == labels[None, :]
+        lab = lab.astype(jnp.float32)
+        lab = lab / jnp.sum(lab, axis=1, keepdims=True)
+        xent = jnp.mean(jnp.sum(-lab * jax.nn.log_softmax(sim, axis=1),
+                                axis=1))
+        return xent + reg
+    return _npair(anchor, positive, labels, float(l2_reg))

@@ -1,0 +1,176 @@
+"""Normalization functionals.
+
+Reference analog: python/paddle/nn/functional/norm.py → phi layer_norm /
+batch_norm kernels. layer_norm accumulates statistics in float32 even under
+bf16 inputs (the TPU-correct recipe); XLA fuses the whole normalization into
+neighbouring ops.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...framework.dispatch import defop
+from ...framework.tensor import Tensor
+
+
+@defop("layer_norm_op")
+def _layer_norm(x, weight, bias, epsilon, begin_norm_axis):
+    axes = tuple(range(begin_norm_axis, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + epsilon)
+    out = out.astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
+               name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    begin = x.ndim - len(tuple(normalized_shape))
+    return _layer_norm(x, weight, bias, float(epsilon), int(begin))
+
+
+@defop("rms_norm_op")
+def _rms_norm(x, weight, epsilon):
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    out = (xf * jax.lax.rsqrt(var + epsilon)).astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    return out
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (not in the reference snapshot; standard for modern LLMs)."""
+    return _rms_norm(x, weight, float(epsilon))
+
+
+@defop("batch_norm_train", n_outputs=3)
+def _batch_norm_train(x, mean, var, weight, bias, momentum, epsilon,
+                      data_format):
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    xf = x.astype(jnp.float32)
+    batch_mean = jnp.mean(xf, axis=axes)
+    batch_var = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(batch_mean)
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
+    out = (xf - batch_mean.reshape(shape)) * jax.lax.rsqrt(
+        batch_var.reshape(shape) + epsilon)
+    out = out.astype(x.dtype)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    new_mean = momentum * mean + (1.0 - momentum) * batch_mean
+    new_var = momentum * var + (1.0 - momentum) * batch_var
+    return out, new_mean, new_var
+
+
+@defop("batch_norm_eval")
+def _batch_norm_eval(x, mean, var, weight, bias, epsilon, data_format):
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
+    out = (x.astype(jnp.float32) - mean.reshape(shape)) * jax.lax.rsqrt(
+        var.reshape(shape) + epsilon)
+    out = out.astype(x.dtype)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05,
+               data_format="NCHW", use_global_stats=None, name=None):
+    if use_global_stats:
+        training = False
+    if training:
+        out, new_mean, new_var = _batch_norm_train(
+            x, running_mean, running_var, weight, bias, float(momentum),
+            float(epsilon), data_format)
+        # reference semantics: running stats updated in place during training
+        if isinstance(running_mean, Tensor):
+            running_mean._value = new_mean._value.astype(running_mean.dtype)
+        if isinstance(running_var, Tensor):
+            running_var._value = new_var._value.astype(running_var.dtype)
+        return out
+    return _batch_norm_eval(x, running_mean, running_var, weight, bias,
+                            float(epsilon), data_format)
+
+
+@defop("group_norm_op")
+def _group_norm(x, weight, bias, num_groups, epsilon, data_format):
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    if ch_axis != 1:
+        x = jnp.moveaxis(x, -1, 1)
+    n, c = x.shape[0], x.shape[1]
+    spatial = x.shape[2:]
+    xf = x.astype(jnp.float32).reshape(n, num_groups, c // num_groups, -1)
+    mean = jnp.mean(xf, axis=(2, 3), keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=(2, 3), keepdims=True)
+    out = ((xf - mean) * jax.lax.rsqrt(var + epsilon)).reshape(
+        n, c, *spatial).astype(x.dtype)
+    shape = [1, c] + [1] * len(spatial)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    if ch_axis != 1:
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    return _group_norm(x, weight, bias, int(num_groups), float(epsilon),
+                       data_format)
+
+
+@defop("instance_norm_op")
+def _instance_norm(x, weight, bias, epsilon):
+    axes = tuple(range(2, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+    out = ((xf - mean) * jax.lax.rsqrt(var + epsilon)).astype(x.dtype)
+    if weight is not None:
+        shape = [1, x.shape[1]] + [1] * (x.ndim - 2)
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        shape = [1, x.shape[1]] + [1] * (x.ndim - 2)
+        out = out + bias.reshape(shape)
+    return out
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9,
+                  eps=1e-05, data_format="NCHW", name=None):
+    return _instance_norm(x, weight, bias, float(eps))
+
+
+@defop("local_response_norm_op")
+def _local_response_norm(x, size, alpha, beta, k):
+    sq = jnp.square(x)
+    c = x.shape[1]
+    half = size // 2
+    padded = jnp.pad(sq, ((0, 0), (half, size - 1 - half)) +
+                     ((0, 0),) * (x.ndim - 2))
+    acc = sum(padded[:, i:i + c] for i in range(size))
+    return x / (k + alpha * acc) ** beta
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    return _local_response_norm(x, int(size), float(alpha), float(beta),
+                                float(k))

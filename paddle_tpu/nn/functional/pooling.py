@@ -1,0 +1,285 @@
+"""Pooling functionals (reference: python/paddle/nn/functional/pooling.py).
+Lowered to lax.reduce_window — XLA's native windowed reduction."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...framework.dispatch import defop
+from ...framework.tensor import Tensor
+
+
+def _tuplize(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(int(x) for x in v)
+    return v * n if len(v) == 1 else v
+
+
+def _pool_padding(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return tuple((padding, padding) for _ in range(n))
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return tuple((p, p) for p in padding)
+    if len(padding) == 2 * n:
+        return tuple((padding[2 * i], padding[2 * i + 1]) for i in range(n))
+    return tuple(tuple(p) for p in padding)
+
+
+def _reduce_window(x, init, op, window, strides, padding, nd, chan_first):
+    if chan_first:
+        dims = (1, 1) + window
+        strd = (1, 1) + strides
+        pad = ((0, 0), (0, 0)) + padding if not isinstance(padding, str) else padding
+    else:
+        dims = (1,) + window + (1,)
+        strd = (1,) + strides + (1,)
+        pad = ((0, 0),) + padding + ((0, 0),) if not isinstance(padding, str) else padding
+    if isinstance(pad, str):
+        pad_cfg = jax.lax.padtype_to_pads(x.shape, dims, strd, pad)
+    else:
+        pad_cfg = pad
+    return jax.lax.reduce_window(x, init, op, dims, strd, pad_cfg)
+
+
+def _max_pool(x, window, strides, padding, ceil_mode, nd, chan_first):
+    if ceil_mode and not isinstance(padding, str):
+        # extend padding on the high side so the last partial window counts
+        spatial = x.shape[2:2 + nd] if chan_first else x.shape[1:1 + nd]
+        padding = tuple(
+            (p[0], p[1] + _ceil_extra(s, w, st, p))
+            for s, w, st, p in zip(spatial, window, strides, padding))
+    # -inf init lets jax recognize the differentiable select-and-scatter
+    # pattern for reduce_window_max
+    neg = (-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+           else jnp.iinfo(x.dtype).min)
+    return _reduce_window(x, neg, jax.lax.max, window, strides, padding, nd,
+                          chan_first)
+
+
+def _ceil_extra(size, w, stride, pad):
+    padded = size + pad[0] + pad[1]
+    import math
+    out_floor = (padded - w) // stride + 1
+    out_ceil = math.ceil((padded - w) / stride) + 1
+    return (out_ceil - out_floor) * stride
+
+
+def _avg_pool(x, window, strides, padding, ceil_mode, exclusive, nd,
+              chan_first):
+    if ceil_mode and not isinstance(padding, str):
+        spatial = x.shape[2:2 + nd] if chan_first else x.shape[1:1 + nd]
+        padding = tuple(
+            (p[0], p[1] + _ceil_extra(s, w, st, p))
+            for s, w, st, p in zip(spatial, window, strides, padding))
+    summed = _reduce_window(x, 0.0, jax.lax.add, window, strides, padding,
+                            nd, chan_first)
+    if exclusive and (isinstance(padding, str) or
+                      any(p != (0, 0) for p in padding)):
+        ones = jnp.ones_like(x)
+        counts = _reduce_window(ones, 0.0, jax.lax.add, window, strides,
+                                padding, nd, chan_first)
+        return summed / counts
+    return summed / float(np.prod(window))
+
+
+@defop("max_pool1d_op")
+def _max_pool1d(x, k, s, p, ceil_mode):
+    return _max_pool(x, k, s, p, ceil_mode, 1, True)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    stride = stride or kernel_size
+    return _max_pool1d(x, _tuplize(kernel_size, 1), _tuplize(stride, 1),
+                       _pool_padding(padding, 1), bool(ceil_mode))
+
+
+@defop("max_pool2d_op")
+def _max_pool2d(x, k, s, p, ceil_mode, chan_first):
+    return _max_pool(x, k, s, p, ceil_mode, 2, chan_first)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    stride = stride or kernel_size
+    return _max_pool2d(x, _tuplize(kernel_size, 2), _tuplize(stride, 2),
+                       _pool_padding(padding, 2), bool(ceil_mode),
+                       data_format == "NCHW")
+
+
+@defop("max_pool3d_op")
+def _max_pool3d(x, k, s, p, ceil_mode, chan_first):
+    return _max_pool(x, k, s, p, ceil_mode, 3, chan_first)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    stride = stride or kernel_size
+    return _max_pool3d(x, _tuplize(kernel_size, 3), _tuplize(stride, 3),
+                       _pool_padding(padding, 3), bool(ceil_mode),
+                       data_format == "NCDHW")
+
+
+@defop("avg_pool1d_op")
+def _avg_pool1d(x, k, s, p, ceil_mode, exclusive):
+    return _avg_pool(x, k, s, p, ceil_mode, exclusive, 1, True)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    stride = stride or kernel_size
+    return _avg_pool1d(x, _tuplize(kernel_size, 1), _tuplize(stride, 1),
+                       _pool_padding(padding, 1), bool(ceil_mode),
+                       bool(exclusive))
+
+
+@defop("avg_pool2d_op")
+def _avg_pool2d(x, k, s, p, ceil_mode, exclusive, chan_first):
+    return _avg_pool(x, k, s, p, ceil_mode, exclusive, 2, chan_first)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    stride = stride or kernel_size
+    return _avg_pool2d(x, _tuplize(kernel_size, 2), _tuplize(stride, 2),
+                       _pool_padding(padding, 2), bool(ceil_mode),
+                       bool(exclusive), data_format == "NCHW")
+
+
+@defop("avg_pool3d_op")
+def _avg_pool3d(x, k, s, p, ceil_mode, exclusive, chan_first):
+    return _avg_pool(x, k, s, p, ceil_mode, exclusive, 3, chan_first)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    stride = stride or kernel_size
+    return _avg_pool3d(x, _tuplize(kernel_size, 3), _tuplize(stride, 3),
+                       _pool_padding(padding, 3), bool(ceil_mode),
+                       bool(exclusive), data_format == "NCDHW")
+
+
+def _adaptive_window(in_size, out_size):
+    # windows per output position; uniform when divisible
+    return in_size // out_size, in_size // out_size
+
+
+@defop("adaptive_avg_pool2d_op")
+def _adaptive_avg_pool2d(x, out_hw, chan_first):
+    if chan_first:
+        h, w = x.shape[2], x.shape[3]
+    else:
+        h, w = x.shape[1], x.shape[2]
+    oh, ow = out_hw
+    if h % oh == 0 and w % ow == 0:
+        kh, kw = h // oh, w // ow
+        return _avg_pool(x, (kh, kw), (kh, kw),
+                         ((0, 0), (0, 0)), False, False, 2, chan_first)
+    # general: mean over index buckets
+    axis_h = 2 if chan_first else 1
+    splits_h = [x.shape[axis_h] * i // oh for i in range(oh + 1)]
+    rows = [jnp.mean(jax.lax.slice_in_dim(x, splits_h[i], splits_h[i + 1],
+                                          axis=axis_h), axis=axis_h,
+                     keepdims=True) for i in range(oh)]
+    x = jnp.concatenate(rows, axis=axis_h)
+    axis_w = 3 if chan_first else 2
+    splits_w = [x.shape[axis_w] * i // ow for i in range(ow + 1)]
+    cols = [jnp.mean(jax.lax.slice_in_dim(x, splits_w[i], splits_w[i + 1],
+                                          axis=axis_w), axis=axis_w,
+                     keepdims=True) for i in range(ow)]
+    return jnp.concatenate(cols, axis=axis_w)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_avg_pool2d(x, _tuplize(output_size, 2),
+                                data_format == "NCHW")
+
+
+@defop("adaptive_avg_pool1d_op")
+def _adaptive_avg_pool1d(x, out):
+    l = x.shape[2]
+    if l % out == 0:
+        k = l // out
+        return _avg_pool(x, (k,), (k,), ((0, 0),), False, False, 1, True)
+    splits = [l * i // out for i in range(out + 1)]
+    parts = [jnp.mean(x[:, :, splits[i]:splits[i + 1]], axis=2,
+                      keepdims=True) for i in range(out)]
+    return jnp.concatenate(parts, axis=2)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_avg_pool1d(x, int(output_size))
+
+
+@defop("adaptive_avg_pool3d_op")
+def _adaptive_avg_pool3d(x, out_dhw, chan_first):
+    outs = out_dhw
+    for i in range(3):
+        axis = (2 + i) if chan_first else (1 + i)
+        size = x.shape[axis]
+        out = outs[i]
+        splits = [size * j // out for j in range(out + 1)]
+        parts = [jnp.mean(jax.lax.slice_in_dim(x, splits[j], splits[j + 1],
+                                               axis=axis), axis=axis,
+                          keepdims=True) for j in range(out)]
+        x = jnp.concatenate(parts, axis=axis)
+    return x
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_avg_pool3d(x, _tuplize(output_size, 3),
+                                data_format == "NCDHW")
+
+
+@defop("adaptive_max_pool2d_op")
+def _adaptive_max_pool2d(x, out_hw):
+    h, w = x.shape[2], x.shape[3]
+    oh, ow = out_hw
+    if h % oh == 0 and w % ow == 0:
+        kh, kw = h // oh, w // ow
+        return _max_pool(x, (kh, kw), (kh, kw), ((0, 0), (0, 0)), False, 2,
+                         True)
+    splits_h = [h * i // oh for i in range(oh + 1)]
+    rows = [jnp.max(x[:, :, splits_h[i]:splits_h[i + 1], :], axis=2,
+                    keepdims=True) for i in range(oh)]
+    x = jnp.concatenate(rows, axis=2)
+    splits_w = [w * i // ow for i in range(ow + 1)]
+    cols = [jnp.max(x[:, :, :, splits_w[i]:splits_w[i + 1]], axis=3,
+                    keepdims=True) for i in range(ow)]
+    return jnp.concatenate(cols, axis=3)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_max_pool2d(x, _tuplize(output_size, 2))
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    @defop("adaptive_max_pool1d_op")
+    def _amp1(x, out):
+        l = x.shape[2]
+        splits = [l * i // out for i in range(out + 1)]
+        parts = [jnp.max(x[:, :, splits[i]:splits[i + 1]], axis=2,
+                         keepdims=True) for i in range(out)]
+        return jnp.concatenate(parts, axis=2)
+    return _amp1(x, int(output_size))
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    @defop("lp_pool2d_op")
+    def _lp(x, p, k, s, pad, chan_first):
+        powed = jnp.abs(x) ** p
+        summed = _reduce_window(powed, 0.0, jax.lax.add, k, s, pad, 2,
+                                chan_first)
+        return summed ** (1.0 / p)
+    stride = stride or kernel_size
+    return _lp(x, float(norm_type), _tuplize(kernel_size, 2),
+               _tuplize(stride, 2), _pool_padding(padding, 2),
+               data_format == "NCHW")

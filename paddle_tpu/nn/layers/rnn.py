@@ -1,0 +1,350 @@
+"""Recurrent layers: SimpleRNN / LSTM / GRU (+ cells).
+
+Reference analog: python/paddle/nn/layer/rnn.py over the cuDNN-backed phi rnn
+kernel. TPU-native: the whole multi-layer, (bi)directional recurrence is ONE
+op whose body is lax.scan over time — XLA compiles it into a single fused
+while-loop on device (no per-timestep host dispatch), and it is fully
+differentiable through the tape like any other op.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...framework.dispatch import apply
+from ...framework.tensor import Tensor
+from ..layer import Layer
+from .. import initializer as I
+from ..parameter import Parameter
+
+
+def _cell_step(mode, x, h, c, w_ih, w_hh, b_ih, b_hh):
+    gates = x @ w_ih.T + h @ w_hh.T
+    if b_ih is not None:
+        gates = gates + b_ih + b_hh
+    if mode == "LSTM":
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return h_new, c_new
+    if mode == "GRU":
+        # paddle/cudnn gate order: reset, update, candidate
+        xr, xz, xn = jnp.split(x @ w_ih.T + (b_ih if b_ih is not None else 0),
+                               3, axis=-1)
+        hr, hz, hn = jnp.split(h @ w_hh.T + (b_hh if b_hh is not None else 0),
+                               3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        n = jnp.tanh(xn + r * hn)
+        h_new = (1.0 - z) * n + z * h
+        return h_new, None
+    act = jnp.tanh if mode == "RNN_TANH" else jax.nn.relu
+    h_new = act(gates)
+    return h_new, None
+
+
+def _rnn_forward(x, h0, c0, *weights, mode="LSTM", num_layers=1,
+                 bidirect=False, time_major=False, has_bias=True,
+                 dropout=0.0):
+    """x: [B,T,I] (or [T,B,I] if time_major). h0/c0: [L*D, B, H]."""
+    if time_major:
+        x = jnp.swapaxes(x, 0, 1)
+    ndir = 2 if bidirect else 1
+    per = 4 if has_bias else 2
+    outs_h, outs_c = [], []
+    inp = x
+    for layer in range(num_layers):
+        layer_outs = []
+        for d in range(ndir):
+            idx = (layer * ndir + d) * per
+            w_ih, w_hh = weights[idx], weights[idx + 1]
+            b_ih = weights[idx + 2] if has_bias else None
+            b_hh = weights[idx + 3] if has_bias else None
+            h_init = h0[layer * ndir + d]
+            c_init = c0[layer * ndir + d] if c0 is not None else None
+            seq = inp if d == 0 else jnp.flip(inp, axis=1)
+
+            def step(carry, xt):
+                h, c = carry
+                h_new, c_new = _cell_step(mode, xt, h, c, w_ih, w_hh, b_ih,
+                                          b_hh)
+                return (h_new, c_new), h_new
+
+            (h_last, c_last), ys = jax.lax.scan(
+                step, (h_init, c_init), jnp.swapaxes(seq, 0, 1))
+            ys = jnp.swapaxes(ys, 0, 1)  # [B,T,H]
+            if d == 1:
+                ys = jnp.flip(ys, axis=1)
+            layer_outs.append(ys)
+            outs_h.append(h_last)
+            if c_last is not None:
+                outs_c.append(c_last)
+        inp = jnp.concatenate(layer_outs, axis=-1) if ndir == 2 \
+            else layer_outs[0]
+    out = inp
+    if time_major:
+        out = jnp.swapaxes(out, 0, 1)
+    h_n = jnp.stack(outs_h, axis=0)
+    if mode == "LSTM":
+        c_n = jnp.stack(outs_c, axis=0)
+        return out, h_n, c_n
+    return out, h_n
+
+
+class RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        ndir = 2 if self.bidirect else 1
+        gates = {"LSTM": 4, "GRU": 3}.get(mode, 1)
+        std = 1.0 / math.sqrt(hidden_size)
+        self._all_weights = []
+        for layer in range(num_layers):
+            for d in range(ndir):
+                in_size = input_size if layer == 0 else hidden_size * ndir
+                suffix = f"_l{layer}" + ("_reverse" if d == 1 else "")
+                w_ih = self.create_parameter(
+                    [gates * hidden_size, in_size], weight_ih_attr,
+                    default_initializer=I.Uniform(-std, std))
+                w_hh = self.create_parameter(
+                    [gates * hidden_size, hidden_size], weight_hh_attr,
+                    default_initializer=I.Uniform(-std, std))
+                b_ih = self.create_parameter(
+                    [gates * hidden_size], bias_ih_attr, is_bias=True,
+                    default_initializer=I.Uniform(-std, std))
+                b_hh = self.create_parameter(
+                    [gates * hidden_size], bias_hh_attr, is_bias=True,
+                    default_initializer=I.Uniform(-std, std))
+                self.add_parameter(f"weight_ih{suffix}", w_ih)
+                self.add_parameter(f"weight_hh{suffix}", w_hh)
+                self.add_parameter(f"bias_ih{suffix}", b_ih)
+                self.add_parameter(f"bias_hh{suffix}", b_hh)
+                self._all_weights += [w_ih, w_hh, b_ih, b_hh]
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops.creation import zeros
+        ndir = 2 if self.bidirect else 1
+        batch_axis = 1 if self.time_major else 0
+        b = inputs.shape[batch_axis]
+        if self.mode == "LSTM":
+            if initial_states is None:
+                h0 = zeros([self.num_layers * ndir, b, self.hidden_size],
+                           inputs.dtype)
+                c0 = zeros([self.num_layers * ndir, b, self.hidden_size],
+                           inputs.dtype)
+            else:
+                h0, c0 = initial_states
+            out, h_n, c_n = apply(
+                f"rnn_{self.mode}", _rnn_forward, inputs, h0, c0,
+                *self._all_weights, mode=self.mode,
+                num_layers=self.num_layers, bidirect=self.bidirect,
+                time_major=self.time_major, has_bias=True,
+                dropout=self.dropout)
+            return out, (h_n, c_n)
+        if initial_states is None:
+            h0 = zeros([self.num_layers * ndir, b, self.hidden_size],
+                       inputs.dtype)
+        else:
+            h0 = initial_states
+        out, h_n = apply(
+            f"rnn_{self.mode}", _rnn_forward, inputs, h0, None,
+            *self._all_weights, mode=self.mode, num_layers=self.num_layers,
+            bidirect=self.bidirect, time_major=self.time_major,
+            has_bias=True, dropout=self.dropout)
+        return out, h_n
+
+
+class SimpleRNN(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)
+
+
+class LSTM(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)
+
+
+class GRU(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)
+
+
+class _CellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        from ...ops.creation import full
+        b = batch_ref.shape[batch_dim_idx]
+        return full([b, self.hidden_size], init_value,
+                    dtype or batch_ref.dtype)
+
+
+class LSTMCell(_CellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size], weight_ih_attr,
+            default_initializer=I.Uniform(-std, std))
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=I.Uniform(-std, std))
+        self.bias_ih = self.create_parameter(
+            [4 * hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=I.Uniform(-std, std))
+        self.bias_hh = self.create_parameter(
+            [4 * hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=I.Uniform(-std, std))
+
+    def forward(self, inputs, states=None):
+        from ...ops.creation import zeros
+        if states is None:
+            b = inputs.shape[0]
+            states = (zeros([b, self.hidden_size], inputs.dtype),
+                      zeros([b, self.hidden_size], inputs.dtype))
+        h, c = states
+
+        def _step(x, h, c, w_ih, w_hh, b_ih, b_hh):
+            return _cell_step("LSTM", x, h, c, w_ih, w_hh, b_ih, b_hh)
+        h_new, c_new = apply("lstm_cell", _step, inputs, h, c,
+                             self.weight_ih, self.weight_hh, self.bias_ih,
+                             self.bias_hh)
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(_CellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size], weight_ih_attr,
+            default_initializer=I.Uniform(-std, std))
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=I.Uniform(-std, std))
+        self.bias_ih = self.create_parameter(
+            [3 * hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=I.Uniform(-std, std))
+        self.bias_hh = self.create_parameter(
+            [3 * hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=I.Uniform(-std, std))
+
+    def forward(self, inputs, states=None):
+        from ...ops.creation import zeros
+        if states is None:
+            states = zeros([inputs.shape[0], self.hidden_size], inputs.dtype)
+
+        def _step(x, h, w_ih, w_hh, b_ih, b_hh):
+            h_new, _ = _cell_step("GRU", x, h, None, w_ih, w_hh, b_ih, b_hh)
+            return h_new
+        h_new = apply("gru_cell", _step, inputs, states, self.weight_ih,
+                      self.weight_hh, self.bias_ih, self.bias_hh)
+        return h_new, h_new
+
+
+class SimpleRNNCell(_CellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        std = 1.0 / math.sqrt(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [hidden_size, input_size], weight_ih_attr,
+            default_initializer=I.Uniform(-std, std))
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=I.Uniform(-std, std))
+        self.bias_ih = self.create_parameter(
+            [hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=I.Uniform(-std, std))
+        self.bias_hh = self.create_parameter(
+            [hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=I.Uniform(-std, std))
+
+    def forward(self, inputs, states=None):
+        from ...ops.creation import zeros
+        if states is None:
+            states = zeros([inputs.shape[0], self.hidden_size], inputs.dtype)
+        mode = self.mode
+
+        def _step(x, h, w_ih, w_hh, b_ih, b_hh, mode=None):
+            h_new, _ = _cell_step(mode, x, h, None, w_ih, w_hh, b_ih, b_hh)
+            return h_new
+        h_new = apply("rnn_cell", _step, inputs, states, self.weight_ih,
+                      self.weight_hh, self.bias_ih, self.bias_hh, mode=mode)
+        return h_new, h_new
+
+
+class RNN(Layer):
+    """Wrap a cell into a scan over time (reference: nn.RNN wrapper)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        # host-level loop over leading time axis; jit captures it unrolled —
+        # for long sequences use nn.LSTM/GRU (scan-based) instead
+        axis = 0 if self.time_major else 1
+        steps = inputs.shape[axis]
+        rng = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        outs = []
+        states = initial_states
+        from ...ops.manipulation import stack
+        for t in rng:
+            xt = inputs[:, t] if axis == 1 else inputs[t]
+            out, states = self.cell(xt, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        return stack(outs, axis=axis), states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops.manipulation import concat
+        if initial_states is None:
+            fw_states = bw_states = None
+        else:
+            fw_states, bw_states = initial_states
+        out_fw, st_fw = self.rnn_fw(inputs, fw_states)
+        out_bw, st_bw = self.rnn_bw(inputs, bw_states)
+        return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)

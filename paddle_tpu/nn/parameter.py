@@ -1,0 +1,39 @@
+"""Parameter — a trainable Tensor.
+
+Reference analog: EagerParamBase (python/paddle/fluid/framework.py) — a Tensor
+with trainable/optimize metadata that Layers collect.
+"""
+from __future__ import annotations
+
+import itertools
+
+from ..framework.tensor import Tensor
+
+_param_counter = itertools.count()
+
+
+class Parameter(Tensor):
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "do_model_average",
+                 "need_clip", "is_distributed", "sharding_spec")
+
+    def __init__(self, value, trainable: bool = True, name: str = ""):
+        super().__init__(value, stop_gradient=not trainable,
+                         name=name or f"param_{next(_param_counter)}")
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.do_model_average = None
+        self.need_clip = True
+        self.is_distributed = False
+        # PartitionSpec-style sharding annotation consumed by
+        # paddle_tpu.parallel when building pjit shardings (TP/FSDP axes).
+        self.sharding_spec = None
+        self.persistable = True
+        self.is_leaf_override = True
+
+    @property
+    def requires_grad(self):
+        return not self.stop_gradient
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()

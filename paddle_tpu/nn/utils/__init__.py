@@ -1,0 +1,95 @@
+"""nn.utils (reference: python/paddle/nn/utils/): weight_norm, spectral_norm,
+parameter vector helpers."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor
+from ..parameter import Parameter
+
+
+def parameters_to_vector(parameters, name=None):
+    from ...ops.manipulation import concat, reshape
+    return concat([reshape(p, [-1]) for p in parameters], axis=0)
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    for p in parameters:
+        n = p.size
+        chunk = vec.numpy()[offset:offset + n].reshape(p.shape)
+        p.set_value(chunk)
+        offset += n
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize weight = g * v/||v|| (recomputed each forward via a
+    pre-hook — the reference hooks the same way)."""
+    weight = getattr(layer, name)
+    w = weight.numpy()
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    g = np.sqrt((w ** 2).sum(axis=axes, keepdims=True))
+    v = w / np.maximum(g, 1e-12)
+    layer.add_parameter(name + "_g", Parameter(jnp.asarray(g)))
+    layer.add_parameter(name + "_v", Parameter(jnp.asarray(v)))
+    del layer._parameters[name]
+
+    def _pre_hook(lyr, inputs):
+        from ...ops import math as M
+        from ...ops import linalg as L
+        gp = lyr._parameters[name + "_g"]
+        vp = lyr._parameters[name + "_v"]
+        axes_t = [i for i in range(vp.ndim) if i != dim]
+        norm = M.sqrt(M.sum(M.square(vp), axis=axes_t, keepdim=True))
+        w_t = M.multiply(gp, M.divide(vp, norm))
+        object.__setattr__(lyr, name, w_t)
+        return None
+
+    layer.register_forward_pre_hook(_pre_hook)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    g = layer._parameters.pop(name + "_g")
+    v = layer._parameters.pop(name + "_v")
+    w = g.numpy() * v.numpy() / np.sqrt(
+        (v.numpy() ** 2).sum(axis=tuple(
+            i for i in range(v.ndim) if i != 0), keepdims=True))
+    layer.add_parameter(name, Parameter(jnp.asarray(w)))
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    weight = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    w = weight.numpy()
+    h = w.shape[dim]
+    w_mat = np.moveaxis(w, dim, 0).reshape(h, -1)
+    u = np.random.randn(h).astype(np.float32)
+    u /= np.linalg.norm(u) + eps
+
+    def _pre_hook(lyr, inputs):
+        nonlocal u
+        wp = lyr._parameters[name + "_orig"]
+        wn = wp.numpy()
+        wm = np.moveaxis(wn, dim, 0).reshape(h, -1)
+        uu = u
+        for _ in range(n_power_iterations):
+            v = wm.T @ uu
+            v /= np.linalg.norm(v) + eps
+            uu = wm @ v
+            uu /= np.linalg.norm(uu) + eps
+        u = uu
+        sigma = float(uu @ wm @ v)
+        from ...ops import math as M
+        w_t = M.divide(wp, float(sigma))
+        object.__setattr__(lyr, name, w_t)
+        return None
+
+    layer.add_parameter(name + "_orig", Parameter(weight._value))
+    del layer._parameters[name]
+    layer.register_forward_pre_hook(_pre_hook)
+    return layer

@@ -1,0 +1,4 @@
+"""Aggregated op surface (the PHI-kernel-library analog, but each op is a
+jax-traceable function; see framework/dispatch.py)."""
+from . import creation, math, manipulation, logic, search, random_ops, linalg
+from . import indexing

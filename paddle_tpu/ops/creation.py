@@ -1,0 +1,205 @@
+"""Tensor creation ops.
+
+Reference analog: python/paddle/tensor/creation.py + phi full/empty kernels
+(/root/reference/paddle/phi/kernels/full_kernel.h). Shapes/fill values are
+static here — XLA constant-folds them; no host allocator involved.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework import dtype as dtypes
+from ..framework.dispatch import defop, apply
+from ..framework.tensor import Tensor, to_tensor
+from ..framework import random as _random
+
+
+def _norm_shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in shape.numpy().reshape(-1))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(v) for v in shape)
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        return default if default is not None else dtypes.get_default_dtype()
+    return dtypes.convert_dtype(dtype)
+
+
+@defop("full")
+def _full(shape, fill_value, dtype):
+    return jnp.full(shape, fill_value, dtype)
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return _full(_norm_shape(shape), fill_value, _dt(dtype))
+
+
+def zeros(shape, dtype=None, name=None):
+    return full(shape, 0, dtype=_dt(dtype))
+
+
+def ones(shape, dtype=None, name=None):
+    return full(shape, 1, dtype=_dt(dtype))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+@defop("full_like")
+def _full_like(x, fill_value, dtype):
+    return jnp.full_like(x, fill_value, dtype=dtype)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return _full_like(x, fill_value, None if dtype is None
+                      else dtypes.convert_dtype(dtype))
+
+
+def zeros_like(x, dtype=None, name=None):
+    return full_like(x, 0, dtype)
+
+
+def ones_like(x, dtype=None, name=None):
+    return full_like(x, 1, dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return full_like(x, 0, dtype)
+
+
+@defop("arange")
+def _arange(start, end, step, dtype):
+    return jnp.arange(start, end, step, dtype=dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    for v in (start, end, step):
+        if isinstance(v, Tensor):
+            raise TypeError("arange with Tensor bounds is not supported under "
+                            "static shapes; pass python numbers")
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = (dtypes.canonicalize(dtypes.int64) if all(
+            isinstance(v, (int, np.integer)) for v in (start, end, step))
+            else dtypes.get_default_dtype())
+    else:
+        dtype = dtypes.convert_dtype(dtype)
+    return _arange(start, end, step, dtype)
+
+
+@defop("linspace")
+def _linspace(start, stop, num, dtype):
+    return jnp.linspace(start, stop, num, dtype=dtype)
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    if isinstance(start, Tensor):
+        start = start.item()
+    if isinstance(stop, Tensor):
+        stop = stop.item()
+    if isinstance(num, Tensor):
+        num = int(num.item())
+    return _linspace(start, stop, int(num), _dt(dtype))
+
+
+@defop("eye")
+def _eye(num_rows, num_columns, dtype):
+    return jnp.eye(num_rows, num_columns, dtype=dtype)
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return _eye(int(num_rows),
+                int(num_columns) if num_columns is not None else int(num_rows),
+                _dt(dtype))
+
+
+@defop("tril")
+def _tril(x, diagonal):
+    return jnp.tril(x, k=diagonal)
+
+
+def tril(x, diagonal=0, name=None):
+    return _tril(x, int(diagonal))
+
+
+@defop("triu")
+def _triu(x, diagonal):
+    return jnp.triu(x, k=diagonal)
+
+
+def triu(x, diagonal=0, name=None):
+    return _triu(x, int(diagonal))
+
+
+@defop("diag")
+def _diag(x, offset, padding_value):
+    if x.ndim == 1:
+        out = jnp.diag(x, k=offset)
+        if padding_value != 0:
+            mask = jnp.eye(out.shape[0], out.shape[1], k=offset, dtype=bool)
+            out = jnp.where(mask, out, jnp.asarray(padding_value, out.dtype))
+        return out
+    return jnp.diagonal(x, offset=offset)
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    return _diag(x, int(offset), padding_value)
+
+
+@defop("diagflat")
+def _diagflat(x, offset):
+    return jnp.diagflat(x, k=offset)
+
+
+def diagflat(x, offset=0, name=None):
+    return _diagflat(x, int(offset))
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+
+    def _mesh(*xs):
+        return tuple(jnp.meshgrid(*xs, indexing="ij"))
+    return apply("meshgrid", _mesh, *args)
+
+
+@defop("assign")
+def _assign(x):
+    return jnp.asarray(x)
+
+
+def assign(x, output=None):
+    if not isinstance(x, Tensor):
+        x = to_tensor(np.asarray(x))
+    out = _assign(x)
+    if output is not None:
+        output._value = out._value
+        output._node = out._node
+        output._out_idx = out._out_idx
+        output.stop_gradient = out.stop_gradient
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return assign(x)
+
+
+def one_hot(x, num_classes, name=None):
+    def _one_hot(idx, n):
+        return jax.nn.one_hot(idx, n, dtype=dtypes.get_default_dtype())
+    return apply("one_hot", _one_hot, x, num_classes)
+
+
+def to_paddle_tensor(x):
+    return to_tensor(x)

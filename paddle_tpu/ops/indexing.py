@@ -1,0 +1,114 @@
+"""__getitem__/__setitem__ lowering.
+
+Reference analog: the pybind slice machinery
+(/root/reference/paddle/fluid/pybind/eager_method.cc `__getitem__`) and
+set_value op. Here basic indexing is baked static (XLA slices), integer-tensor
+indexing is a traced gather, and bool-mask selection (dynamic shape) takes the
+host path in eager mode — dynamic shapes cannot live inside an XLA graph.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.dispatch import apply
+from ..framework.tensor import Tensor, to_tensor
+
+
+def _normalize(index):
+    if not isinstance(index, tuple):
+        index = (index,)
+    return index
+
+
+def _build_plan(index):
+    """Split an index tuple into (pattern tokens, tensor args)."""
+    pattern = []
+    tensors = []
+    for it in index:
+        if it is Ellipsis:
+            pattern.append(("ellipsis",))
+        elif it is None:
+            pattern.append(("none",))
+        elif isinstance(it, slice):
+            pattern.append(("slice",
+                            None if it.start is None else int(it.start),
+                            None if it.stop is None else int(it.stop),
+                            None if it.step is None else int(it.step)))
+        elif isinstance(it, (int, np.integer)):
+            pattern.append(("int", int(it)))
+        elif isinstance(it, (list, np.ndarray)):
+            arr = np.asarray(it)
+            if arr.dtype == np.bool_:
+                pattern.append(("tensor", len(tensors)))
+                tensors.append(Tensor(jnp.asarray(arr)))
+            else:
+                pattern.append(("array", arr.shape, arr.dtype.name,
+                                arr.tobytes()))
+        elif isinstance(it, Tensor):
+            if it.ndim == 0 and not np.issubdtype(it.dtype, np.bool_):
+                pattern.append(("tensor0", len(tensors)))
+            else:
+                pattern.append(("tensor", len(tensors)))
+            tensors.append(it)
+        else:
+            raise TypeError(f"unsupported index component {type(it)}")
+    return tuple(pattern), tensors
+
+
+def _materialize(pattern, tensor_vals):
+    idx = []
+    for tok in pattern:
+        kind = tok[0]
+        if kind == "ellipsis":
+            idx.append(Ellipsis)
+        elif kind == "none":
+            idx.append(None)
+        elif kind == "slice":
+            idx.append(slice(tok[1], tok[2], tok[3]))
+        elif kind == "int":
+            idx.append(tok[1])
+        elif kind == "array":
+            idx.append(np.frombuffer(tok[3], dtype=tok[2]).reshape(tok[1]))
+        elif kind in ("tensor", "tensor0"):
+            idx.append(tensor_vals[tok[1]])
+    return tuple(idx)
+
+
+def _has_bool_mask(tensors):
+    return any(np.issubdtype(t.dtype, np.bool_) for t in tensors)
+
+
+def getitem(x: Tensor, index):
+    index = _normalize(index)
+    pattern, tensors = _build_plan(index)
+    if _has_bool_mask(tensors) and not isinstance(x._value, jax.core.Tracer):
+        # dynamic-shape host path (mirrors masked_select)
+        np_idx = _materialize(pattern, [t.numpy() for t in tensors])
+        return to_tensor(x.numpy()[np_idx])
+
+    def _fn(x, *tvals, pattern=None):
+        return x[_materialize(pattern, tvals)]
+    return apply("getitem", _fn, x, *tensors, pattern=pattern)
+
+
+def setitem(x: Tensor, index, value):
+    index = _normalize(index)
+    pattern, tensors = _build_plan(index)
+
+    def _fn(x, *args, pattern=None):
+        tvals, v = args[:-1], args[-1]
+        idx = _materialize(pattern, tvals)
+        v = jnp.asarray(v, x.dtype)
+        return x.at[idx].set(v)
+
+    if not isinstance(value, Tensor):
+        value = to_tensor(np.asarray(value))
+    out = apply("setitem", _fn, x, *tensors, value, pattern=pattern)
+    # in-place semantics with tape-correct lineage (like the set_value op)
+    x._value = out._value
+    x._node = out._node
+    x._out_idx = out._out_idx
+    x.stop_gradient = out.stop_gradient if not x.stop_gradient else x.stop_gradient
+    return x

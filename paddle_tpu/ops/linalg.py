@@ -1,0 +1,317 @@
+"""Linear-algebra ops (reference: python/paddle/tensor/linalg.py → phi
+svd/qr/eigh/cholesky/... kernels). On TPU these lower to XLA's decomposition
+HLOs; float64 falls back automatically where TPU lacks native support.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.dispatch import defop
+from ..framework.tensor import Tensor
+from .math import matmul, dot, bmm, mv, outer, cross  # re-export surface
+
+
+@defop("norm_op")
+def _norm(x, p, axis, keepdim):
+    if axis is None and p == "fro":
+        return jnp.linalg.norm(x)
+    if p == "fro":
+        return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdim))
+    if p == "nuc":
+        return jnp.sum(jnp.linalg.svd(x, compute_uv=False), axis=-1)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    ax = axis if axis is None else axis
+    return jnp.sum(jnp.abs(x) ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    if p is None:
+        p = "fro" if axis is None or isinstance(axis, (list, tuple)) else 2
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(int(a) for a in axis)
+    elif axis is not None:
+        axis = (int(axis),)
+    return _norm(x, p, axis, bool(keepdim))
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return norm(x, p, axis, keepdim)
+
+
+@defop("matrix_norm_op")
+def _matrix_norm(x, p, axis, keepdim):
+    return jnp.linalg.norm(x, ord=p, axis=axis, keepdims=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return _matrix_norm(x, p, tuple(axis), bool(keepdim))
+
+
+@defop("cholesky")
+def _cholesky(x, upper):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2).conj() if upper else L
+
+
+def cholesky(x, upper=False, name=None):
+    return _cholesky(x, bool(upper))
+
+
+@defop("cholesky_solve_op")
+def _cholesky_solve(y, x, upper):
+    L = jnp.swapaxes(x, -1, -2).conj() if upper else x
+    z = jax.scipy.linalg.solve_triangular(L, y, lower=True)
+    return jax.scipy.linalg.solve_triangular(
+        jnp.swapaxes(L, -1, -2).conj(), z, lower=False)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    return _cholesky_solve(x, y, bool(upper))
+
+
+@defop("qr", n_outputs=2)
+def _qr(x, mode):
+    return tuple(jnp.linalg.qr(x, mode=mode))
+
+
+def qr(x, mode="reduced", name=None):
+    if mode == "r":
+        return _qr_r(x)
+    q, r = _qr(x, mode)
+    return q, r
+
+
+@defop("qr_r")
+def _qr_r(x):
+    return jnp.linalg.qr(x, mode="r")
+
+
+@defop("svd", n_outputs=3)
+def _svd(x, full_matrices):
+    u, s, vh = jnp.linalg.svd(x, full_matrices=full_matrices)
+    return u, s, jnp.swapaxes(vh, -1, -2).conj()
+
+
+def svd(x, full_matrices=False, name=None):
+    return tuple(_svd(x, bool(full_matrices)))
+
+
+@defop("eigh", n_outputs=2, nondiff_outputs=())
+def _eigh(x, uplo):
+    w, v = jnp.linalg.eigh(x, UPLO=uplo)
+    return w, v
+
+
+def eigh(x, UPLO="L", name=None):
+    return tuple(_eigh(x, UPLO))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    @defop("eigvalsh")
+    def _eigvalsh(x, uplo):
+        return jnp.linalg.eigvalsh(x, UPLO=uplo)
+    return _eigvalsh(x, UPLO)
+
+
+def eig(x, name=None):
+    # general eig: CPU-only in XLA; host roundtrip
+    xs = x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+    w, v = np.linalg.eig(xs)
+    from ..framework.tensor import to_tensor
+    return to_tensor(w), to_tensor(v)
+
+
+def eigvals(x, name=None):
+    xs = x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+    from ..framework.tensor import to_tensor
+    return to_tensor(np.linalg.eigvals(xs))
+
+
+@defop("inverse")
+def _inv(x):
+    return jnp.linalg.inv(x)
+
+
+def inv(x, name=None):
+    return _inv(x)
+
+
+inverse = inv
+
+
+@defop("pinv_op")
+def _pinv(x, rcond, hermitian):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    if isinstance(rcond, Tensor):
+        rcond = float(rcond.item())
+    return _pinv(x, float(rcond), bool(hermitian))
+
+
+@defop("solve_op")
+def _solve(x, y):
+    if y.ndim == x.ndim - 1:
+        return jnp.linalg.solve(x, y[..., None])[..., 0]
+    return jnp.linalg.solve(x, y)
+
+
+def solve(x, y, name=None):
+    return _solve(x, y)
+
+
+@defop("triangular_solve_op")
+def _triangular_solve(x, y, upper, transpose, unitriangular):
+    a = x
+    if transpose:
+        a = jnp.swapaxes(a, -1, -2)
+        upper = not upper
+    return jax.scipy.linalg.solve_triangular(
+        a, y, lower=not upper, unit_diagonal=unitriangular)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    return _triangular_solve(x, y, bool(upper), bool(transpose),
+                             bool(unitriangular))
+
+
+@defop("lstsq_op", n_outputs=4, nondiff_outputs=(1, 2, 3))
+def _lstsq(x, y, rcond):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    return tuple(_lstsq(x, y, rcond))
+
+
+@defop("matrix_power_op")
+def _matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+def matrix_power(x, n, name=None):
+    return _matrix_power(x, int(n))
+
+
+@defop("matrix_rank_op")
+def _matrix_rank(x, tol, hermitian):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    if isinstance(tol, Tensor):
+        tol = float(tol.item())
+    return _matrix_rank(x, tol, bool(hermitian))
+
+
+@defop("slogdet_op", n_outputs=2)
+def _slogdet(x):
+    sign, logdet = jnp.linalg.slogdet(x)
+    return sign, logdet
+
+
+def slogdet(x, name=None):
+    sign, logdet = _slogdet(x)
+    from .manipulation import stack
+    return stack([sign, logdet], axis=0)
+
+
+@defop("det")
+def _det(x):
+    return jnp.linalg.det(x)
+
+
+def det(x, name=None):
+    return _det(x)
+
+
+@defop("lu_op", n_outputs=3, nondiff_outputs=(1, 2))
+def _lu(x):
+    lu, piv = jax.scipy.linalg.lu_factor(x)
+    return lu, (piv + 1).astype(np.int32), jnp.zeros((1,), np.int32)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    l, p, info = _lu(x)
+    if get_infos:
+        return l, p, info
+    return l, p
+
+
+@defop("multi_dot_op")
+def _multi_dot(*xs):
+    return jnp.linalg.multi_dot(xs)
+
+
+def multi_dot(x, name=None):
+    from ..framework.dispatch import apply
+    return apply("multi_dot_op", _multi_dot._raw_fn, *x)
+
+
+@defop("householder_product_op")
+def _householder_product(x, tau):
+    m, n = x.shape[-2], x.shape[-1]
+    def one(a, t):
+        q = jnp.eye(m, dtype=a.dtype)
+        for i in range(n):
+            v = jnp.concatenate([jnp.zeros(i, a.dtype), jnp.ones(1, a.dtype),
+                                 a[i + 1:, i]])
+            h = jnp.eye(m, dtype=a.dtype) - t[i] * jnp.outer(v, v.conj())
+            q = q @ h
+        return q
+    if x.ndim == 2:
+        return one(x, tau)
+    batch = x.reshape(-1, m, n)
+    taub = tau.reshape(-1, n)
+    outs = jax.vmap(one)(batch, taub)
+    return outs.reshape(x.shape[:-2] + (m, m))[..., :, :n]
+
+
+def householder_product(x, tau, name=None):
+    return _householder_product(x, tau)
+
+
+@defop("corrcoef_op")
+def _corrcoef(x, rowvar):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return _corrcoef(x, bool(rowvar))
+
+
+@defop("cov_op")
+def _cov(x, rowvar, ddof):
+    return jnp.cov(x, rowvar=rowvar, ddof=ddof)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return _cov(x, bool(rowvar), 1 if ddof else 0)
+
+
+@defop("cond_op")
+def _cond(x, p):
+    return jnp.linalg.cond(x, p=p)
+
+
+def cond(x, p=None, name=None):
+    return _cond(x, p)
+
+
+@defop("matrix_exp_op")
+def _matrix_exp(x):
+    return jax.scipy.linalg.expm(x)
+
+
+def matrix_exp(x, name=None):
+    return _matrix_exp(x)

@@ -1,0 +1,101 @@
+"""Comparison & logical ops (reference: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.dispatch import defop
+from ..framework.tensor import Tensor
+
+
+def _cmp(name, jfn):
+    @defop(name)
+    def op(x, y):
+        return jfn(x, y)
+
+    def public(x, y, name=None):
+        return op(x, y)
+    public.__name__ = name
+    return public
+
+
+equal = _cmp("equal", jnp.equal)
+not_equal = _cmp("not_equal", jnp.not_equal)
+less_than = _cmp("less_than", jnp.less)
+less_equal = _cmp("less_equal", jnp.less_equal)
+greater_than = _cmp("greater_than", jnp.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal)
+logical_and = _cmp("logical_and", jnp.logical_and)
+logical_or = _cmp("logical_or", jnp.logical_or)
+logical_xor = _cmp("logical_xor", jnp.logical_xor)
+bitwise_and = _cmp("bitwise_and", jnp.bitwise_and)
+bitwise_or = _cmp("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _cmp("bitwise_xor", jnp.bitwise_xor)
+
+
+@defop("logical_not")
+def _logical_not(x):
+    return jnp.logical_not(x)
+
+
+def logical_not(x, out=None, name=None):
+    return _logical_not(x)
+
+
+@defop("bitwise_not")
+def _bitwise_not(x):
+    return jnp.bitwise_not(x)
+
+
+def bitwise_not(x, out=None, name=None):
+    return _bitwise_not(x)
+
+
+@defop("bitwise_shift_left")
+def _shift_left(x, y):
+    return jnp.left_shift(x, y)
+
+
+@defop("bitwise_shift_right")
+def _shift_right(x, y):
+    return jnp.right_shift(x, y)
+
+
+def bitwise_left_shift(x, y, is_arithmetic=True, name=None):
+    return _shift_left(x, y)
+
+
+def bitwise_right_shift(x, y, is_arithmetic=True, name=None):
+    return _shift_right(x, y)
+
+
+@defop("isclose")
+def _isclose(x, y, rtol, atol, equal_nan):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return _isclose(x, y, float(rtol), float(atol), bool(equal_nan))
+
+
+@defop("allclose")
+def _allclose(x, y, rtol, atol, equal_nan):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return _allclose(x, y, float(rtol), float(atol), bool(equal_nan))
+
+
+@defop("equal_all")
+def _equal_all(x, y):
+    return jnp.array_equal(x, y)
+
+
+def equal_all(x, y, name=None):
+    return _equal_all(x, y)
+
+
+def is_empty(x, name=None):
+    from ..framework.tensor import to_tensor
+    return to_tensor(np.asarray(x.size == 0))

@@ -1,0 +1,600 @@
+"""Shape/layout manipulation ops.
+
+Reference analog: python/paddle/tensor/manipulation.py over phi reshape/
+transpose/concat/... kernels (/root/reference/paddle/phi/api/yaml/ops.yaml).
+All shape arguments are static — XLA requires static shapes, and that is what
+lets it tile these ops onto the TPU's (8,128)-lane vector layout for free.
+"""
+from __future__ import annotations
+
+import builtins
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework import dtype as dtypes
+from ..framework.dispatch import defop, apply
+from ..framework.tensor import Tensor
+
+
+def _ints(v):
+    if isinstance(v, Tensor):
+        v = v.tolist()
+    if isinstance(v, (int, np.integer)):
+        return int(v)
+    return tuple(int(x if not isinstance(x, Tensor) else x.item()) for x in v)
+
+
+@defop("cast")
+def _cast(x, dtype):
+    return x.astype(dtype)
+
+
+def cast(x, dtype):
+    return _cast(x, dtypes.convert_dtype(dtype))
+
+
+astype = cast
+
+
+@defop("reshape")
+def _reshape(x, shape):
+    return jnp.reshape(x, shape)
+
+
+def reshape(x, shape, name=None):
+    return _reshape(x, _ints(shape))
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._value, x._node, x._out_idx = out._value, out._node, out._out_idx
+    return x
+
+
+@defop("transpose")
+def _transpose(x, perm):
+    return jnp.transpose(x, perm)
+
+
+def transpose(x, perm, name=None):
+    return _transpose(x, _ints(perm))
+
+
+def t(x, name=None):
+    if isinstance(x, Tensor) and x.ndim < 2:
+        return x
+    return _transpose(x, (1, 0))
+
+
+@defop("moveaxis_op")
+def _moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+def moveaxis(x, source, destination, name=None):
+    return _moveaxis(x, _ints(source), _ints(destination))
+
+
+@defop("swapaxes")
+def _swapaxes(x, a, b):
+    return jnp.swapaxes(x, a, b)
+
+
+def swapaxes(x, axis1, axis2, name=None):
+    return _swapaxes(x, int(axis1), int(axis2))
+
+
+transpose_ = None
+
+
+def concat(x, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+
+    def _concat(*xs, axis=0):
+        return jnp.concatenate(xs, axis=axis)
+    return apply("concat", _concat, *x, axis=int(axis))
+
+
+def stack(x, axis=0, name=None):
+    def _stack(*xs, axis=0):
+        return jnp.stack(xs, axis=axis)
+    return apply("stack", _stack, *x, axis=int(axis))
+
+
+@defop("split_op")
+def _split(x, sections, axis):
+    if isinstance(sections, int):
+        return tuple(jnp.split(x, sections, axis=axis))
+    idx = np.cumsum(sections)[:-1].tolist()
+    return tuple(jnp.split(x, idx, axis=axis))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    if isinstance(num_or_sections, (list, tuple)):
+        secs = list(_ints(num_or_sections))
+        if any(s == -1 for s in secs):
+            total = x.shape[int(axis)]
+            rest = total - builtins_sum(s for s in secs if s != -1)
+            secs = [rest if s == -1 else s for s in secs]
+        return _split(x, tuple(secs), int(axis))
+    return _split(x, int(num_or_sections), int(axis))
+
+
+builtins_sum = sum
+
+
+@defop("chunk_op")
+def _chunk(x, chunks, axis):
+    return tuple(jnp.array_split(x, chunks, axis=axis))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return _chunk(x, int(chunks), int(axis))
+
+
+@defop("squeeze")
+def _squeeze(x, axis):
+    if axis is None:
+        return jnp.squeeze(x)
+    axes = axis if isinstance(axis, tuple) else (axis,)
+    axes = tuple(a for a in axes if x.shape[a] == 1)
+    return jnp.squeeze(x, axis=axes) if axes else x
+
+
+def squeeze(x, axis=None, name=None):
+    return _squeeze(x, None if axis is None else _ints(axis))
+
+
+def squeeze_(x, axis=None, name=None):
+    out = squeeze(x, axis)
+    x._value, x._node, x._out_idx = out._value, out._node, out._out_idx
+    return x
+
+
+@defop("unsqueeze")
+def _unsqueeze(x, axis):
+    axes = axis if isinstance(axis, tuple) else (axis,)
+    for a in sorted(a if a >= 0 else a + x.ndim + 1 for a in axes):
+        x = jnp.expand_dims(x, a)
+    return x
+
+
+def unsqueeze(x, axis, name=None):
+    return _unsqueeze(x, _ints(axis))
+
+
+def unsqueeze_(x, axis, name=None):
+    out = unsqueeze(x, axis)
+    x._value, x._node, x._out_idx = out._value, out._node, out._out_idx
+    return x
+
+
+@defop("flatten")
+def _flatten(x, start_axis, stop_axis):
+    nd = x.ndim
+    s = start_axis % nd if nd else 0
+    e = stop_axis % nd if nd else 0
+    shape = x.shape[:s] + (-1,) + x.shape[e + 1:]
+    return jnp.reshape(x, shape)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    if isinstance(x, Tensor) and x.ndim == 0:
+        return reshape(x, [1])
+    return _flatten(x, int(start_axis), int(stop_axis))
+
+
+@defop("expand")
+def _expand(x, shape):
+    shape = tuple(x.shape[i - (len(shape) - x.ndim)] if s in (-1, 0) and
+                  i >= len(shape) - x.ndim else s for i, s in enumerate(shape))
+    return jnp.broadcast_to(x, shape)
+
+
+def expand(x, shape, name=None):
+    return _expand(x, _ints(shape))
+
+
+broadcast_to = expand
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+@defop("tile")
+def _tile(x, reps):
+    return jnp.tile(x, reps)
+
+
+def tile(x, repeat_times, name=None):
+    return _tile(x, _ints(repeat_times))
+
+
+@defop("flip")
+def _flip(x, axis):
+    return jnp.flip(x, axis=axis)
+
+
+def flip(x, axis, name=None):
+    return _flip(x, _ints(axis))
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    @defop("rot90")
+    def _rot90(x, k, axes):
+        return jnp.rot90(x, k=k, axes=axes)
+    return _rot90(x, int(k), _ints(axes))
+
+
+@defop("roll")
+def _roll(x, shifts, axis):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def roll(x, shifts, axis=None, name=None):
+    return _roll(x, _ints(shifts), None if axis is None else _ints(axis))
+
+
+@defop("pad_op")
+def _pad(x, pad, mode, value, data_format):
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        # paddle order: dim-last-first pairs? paddle.nn.functional.pad uses
+        # [before_last, after_last, ...] for NCHW when len==2*spatial; here
+        # full-rank pad is numpy order already.
+        widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # partial spatial pad like F.pad NCHW [l, r, t, b]
+        spatial = len(pad) // 2
+        widths = [(0, 0)] * (nd - spatial)
+        pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(spatial)]
+        widths += list(reversed(pairs))
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, widths, mode="constant", constant_values=value)
+    return jnp.pad(x, widths, mode=jmode)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # noqa: A002
+    return _pad(x, _ints(pad), mode, value, data_format)
+
+
+@defop("gather")
+def _gather(x, index, axis):
+    idx = index.reshape(-1) if index.ndim > 1 else index
+    return jnp.take(x, idx, axis=axis)
+
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return _gather(x, index, int(axis))
+
+
+@defop("gather_nd")
+def _gather_nd(x, index):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+def gather_nd(x, index, name=None):
+    return _gather_nd(x, index)
+
+
+@defop("take_along_axis_op")
+def _take_along_axis(x, indices, axis):
+    return jnp.take_along_axis(x, indices, axis=axis)
+
+
+def take_along_axis(arr, indices, axis, broadcast=True):
+    return _take_along_axis(arr, indices, int(axis))
+
+
+@defop("put_along_axis_op")
+def _put_along_axis(x, indices, values, axis, reduce):
+    values = jnp.broadcast_to(jnp.asarray(values, x.dtype), indices.shape)
+    if reduce == "assign":
+        return jnp.put_along_axis(x, indices, values, axis=axis,
+                                  inplace=False)
+    idx = [jnp.arange(s).reshape([-1 if i == d else 1 for i in range(x.ndim)])
+           for d, s in enumerate(indices.shape)]
+    idx = [jnp.broadcast_to(ix, indices.shape) for ix in idx]
+    idx[axis] = indices
+    if reduce == "add":
+        return x.at[tuple(idx)].add(values)
+    if reduce == "multiply" or reduce == "mul":
+        return x.at[tuple(idx)].multiply(values)
+    raise ValueError(f"unsupported reduce {reduce}")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True):
+    return _put_along_axis(arr, indices, values, int(axis), reduce)
+
+
+@defop("scatter_op")
+def _scatter(x, index, updates, overwrite):
+    if overwrite:
+        return x.at[index].set(updates)
+    base = x.at[index].set(jnp.zeros_like(updates))
+    return base.at[index].add(updates)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    return _scatter(x, index, updates, bool(overwrite))
+
+
+@defop("scatter_nd_add_op")
+def _scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    return _scatter_nd_add(x, index, updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+    z = zeros(shape, dtype=updates.dtype)
+    return scatter_nd_add(z, index, updates)
+
+
+@defop("index_select_op")
+def _index_select(x, index, axis):
+    return jnp.take(x, index, axis=axis)
+
+
+def index_select(x, index, axis=0, name=None):
+    return _index_select(x, index, int(axis))
+
+
+@defop("index_sample_op")
+def _index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+def index_sample(x, index):
+    return _index_sample(x, index)
+
+
+@defop("index_add_op")
+def _index_add(x, index, axis, value):
+    ix = [slice(None)] * x.ndim
+    ix[axis] = index
+    return x.at[tuple(ix)].add(value)
+
+
+def index_add(x, index, axis, value, name=None):
+    return _index_add(x, index, int(axis), value)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    def _index_put(x, *parts, nidx=0, accumulate=False):
+        idx = tuple(parts[:nidx])
+        v = parts[nidx]
+        if accumulate:
+            return x.at[idx].add(v)
+        return x.at[idx].set(v)
+    return apply("index_put", _index_put, x, *indices, value,
+                 nidx=len(indices), accumulate=bool(accumulate))
+
+
+@defop("masked_select_op")
+def _masked_select_shapeless(x, mask):
+    # dynamic output shape: eager-only (host) path
+    return x[mask]
+
+
+def masked_select(x, mask, name=None):
+    xs = x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+    ms = mask.numpy() if isinstance(mask, Tensor) else np.asarray(mask)
+    from ..framework.tensor import to_tensor
+    return to_tensor(xs[ms])
+
+
+@defop("masked_fill_op")
+def _masked_fill(x, mask, value):
+    return jnp.where(mask, jnp.asarray(value, x.dtype), x)
+
+
+def masked_fill(x, mask, value, name=None):
+    return _masked_fill(x, mask, value)
+
+
+@defop("where_op")
+def _where(cond, x, y):
+    return jnp.where(cond, x, y)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return _where(condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    xs = x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+    from ..framework.tensor import to_tensor
+    nz = np.nonzero(xs)
+    if as_tuple:
+        return tuple(to_tensor(i) for i in nz)
+    return to_tensor(np.stack(nz, axis=-1)) if nz else to_tensor(np.empty((0,)))
+
+
+@defop("unbind_op")
+def _unbind(x, axis):
+    return tuple(jnp.moveaxis(x, axis, 0))
+
+
+def unbind(x, axis=0):
+    return list(_unbind(x, int(axis)))
+
+
+def unstack(x, axis=0, num=None):
+    return unbind(x, axis)
+
+
+@defop("repeat_interleave_op")
+def _repeat_interleave(x, repeats, axis):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        # dynamic repeats: host path
+        xs, rs = x.numpy(), repeats.numpy()
+        from ..framework.tensor import to_tensor
+        return to_tensor(np.repeat(xs, rs, axis=axis))
+    return _repeat_interleave(x, int(repeats),
+                              None if axis is None else int(axis))
+
+
+@defop("slice_op")
+def _slice_op(x, axes, starts, ends):
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        idx[a] = slice(s, e)
+    return x[tuple(idx)]
+
+
+def slice(x, axes, starts, ends):  # noqa: A001
+    return _slice_op(x, _ints(axes), _ints(starts), _ints(ends))
+
+
+@defop("strided_slice_op")
+def _strided_slice(x, axes, starts, ends, strides):
+    idx = [builtins.slice(None)] * x.ndim
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        idx[a] = builtins.slice(s, e, st)
+    return x[tuple(idx)]
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    return _strided_slice(x, _ints(axes), _ints(starts), _ints(ends),
+                          _ints(strides))
+
+
+@defop("as_real_op")
+def _as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+def as_real(x, name=None):
+    return _as_real(x)
+
+
+@defop("as_complex_op")
+def _as_complex(x):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+def as_complex(x, name=None):
+    return _as_complex(x)
+
+
+@defop("unique_op")
+def _unique_noop(x):
+    return x
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    # dynamic output size → host path (reference does device unique; on TPU a
+    # static-shape unique would need masking; eager API goes through host)
+    xs = x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+    res = np.unique(xs, return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    from ..framework.tensor import to_tensor
+    if not (return_index or return_inverse or return_counts):
+        return to_tensor(res)
+    return tuple(to_tensor(r) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    xs = x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+    from ..framework.tensor import to_tensor
+    if axis is None:
+        xs = xs.reshape(-1)
+    keep = np.ones(xs.shape[0 if axis is None else axis], dtype=np.bool_)
+    arr = xs if axis is None else np.moveaxis(xs, axis, 0)
+    for i in range(1, arr.shape[0]):
+        keep[i] = not np.array_equal(arr[i], arr[i - 1])
+    out = arr[keep]
+    if axis is not None:
+        out = np.moveaxis(out, 0, axis)
+    results = [to_tensor(out)]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        results.append(to_tensor(inv))
+    if return_counts:
+        idx = np.nonzero(keep)[0]
+        counts = np.diff(np.append(idx, arr.shape[0]))
+        results.append(to_tensor(counts))
+    return results[0] if len(results) == 1 else tuple(results)
+
+
+@defop("shard_index_op")
+def _shard_index(x, index_num, nshards, shard_id, ignore_value):
+    size = index_num // nshards
+    lo, hi = shard_id * size, (shard_id + 1) * size
+    inside = (x >= lo) & (x < hi)
+    return jnp.where(inside, x - lo, ignore_value)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):  # noqa: A002
+    return _shard_index(input, int(index_num), int(nshards), int(shard_id),
+                        int(ignore_value))
+
+
+@defop("tensordot_op")
+def _tensordot(x, y, axes):
+    return jnp.tensordot(x, y, axes=axes)
+
+
+def tensordot(x, y, axes=2, name=None):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(_ints(a)) if isinstance(a, (list, tuple, Tensor))
+                     else int(a) for a in axes)
+    else:
+        axes = int(axes)
+    return _tensordot(x, y, axes)
+
+
+def numel(x, name=None):
+    from ..framework.tensor import to_tensor
+    return to_tensor(np.asarray(int(np.prod(x.shape)) if x.shape else 1))
+
+
+def shape(x):
+    from ..framework.tensor import to_tensor
+    return to_tensor(np.asarray(x.shape, dtype=np.int64))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_floating_point(x):
+    return dtypes.is_floating_point(x.dtype)
+
+
+def is_complex(x):
+    return dtypes.is_complex(x.dtype)
+
+
+def is_integer(x):
+    return dtypes.is_integer(x.dtype)
+
+
+def rank(x):
+    from ..framework.tensor import to_tensor
+    return to_tensor(np.asarray(x.ndim, dtype=np.int32))

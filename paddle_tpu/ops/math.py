@@ -1,0 +1,548 @@
+"""Elementwise / reduction / unary math ops.
+
+Reference analog: python/paddle/tensor/math.py over phi kernels declared in
+/root/reference/paddle/phi/api/yaml/ops.yaml (add:~28, matmul, etc.) and
+legacy_ops.yaml. Here every op is one jax-traceable fn registered through the
+dispatch layer — XLA fuses chains of these into single TPU kernels, which is
+why there is no separate "fused elementwise" zoo.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework import dtype as dtypes
+from ..framework.dispatch import defop, apply
+from ..framework.tensor import Tensor
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+# ---------------------------------------------------------------- binary
+def _binop(name, jfn):
+    @defop(name)
+    def op(x, y):
+        return jfn(x, y)
+    def public(x, y, name=None):
+        return op(x, y)
+    public.__name__ = name
+    return public
+
+
+add = _binop("add", jnp.add)
+subtract = _binop("subtract", jnp.subtract)
+multiply = _binop("multiply", jnp.multiply)
+divide = _binop("divide", jnp.true_divide)
+floor_divide = _binop("floor_divide", jnp.floor_divide)
+remainder = _binop("remainder", jnp.remainder)
+mod = remainder
+floor_mod = remainder
+maximum = _binop("maximum", jnp.maximum)
+minimum = _binop("minimum", jnp.minimum)
+fmax = _binop("fmax", jnp.fmax)
+fmin = _binop("fmin", jnp.fmin)
+logaddexp = _binop("logaddexp", jnp.logaddexp)
+nextafter = _binop("nextafter", jnp.nextafter)
+copysign = _binop("copysign", jnp.copysign)
+atan2 = _binop("atan2", jnp.arctan2)
+hypot = _binop("hypot", jnp.hypot)
+ldexp = _binop("ldexp", jnp.ldexp)
+heaviside = _binop("heaviside", jnp.heaviside)
+gcd = _binop("gcd", jnp.gcd)
+lcm = _binop("lcm", jnp.lcm)
+
+
+@defop("pow")
+def _pow(x, y):
+    return jnp.power(x, y)
+
+
+def pow(x, y, name=None):  # noqa: A001
+    return _pow(x, y)
+
+
+@defop("scale")
+def _scale(x, scale_v, bias, bias_after_scale):
+    s = jnp.asarray(scale_v, x.dtype) if not hasattr(scale_v, "dtype") else scale_v.astype(x.dtype)
+    b = jnp.asarray(bias, x.dtype)
+    if bias_after_scale:
+        return x * s + b
+    return (x + b) * s
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    out = _scale(x, scale, bias, bool(bias_after_scale))
+    if act is not None:
+        from . import activation
+        out = getattr(activation, act)(out)
+    return out
+
+
+# ---------------------------------------------------------------- unary
+def _unop(name, jfn):
+    @defop(name)
+    def op(x):
+        return jfn(x)
+    def public(x, name=None):
+        return op(x)
+    public.__name__ = name
+    return public
+
+
+exp = _unop("exp", jnp.exp)
+expm1 = _unop("expm1", jnp.expm1)
+log = _unop("log", jnp.log)
+log2 = _unop("log2", jnp.log2)
+log10 = _unop("log10", jnp.log10)
+log1p = _unop("log1p", jnp.log1p)
+sqrt = _unop("sqrt", jnp.sqrt)
+rsqrt = _unop("rsqrt", lambda x: jax.lax.rsqrt(x))
+square = _unop("square", jnp.square)
+abs = _unop("abs", jnp.abs)  # noqa: A001
+ceil = _unop("ceil", jnp.ceil)
+floor = _unop("floor", jnp.floor)
+round = _unop("round", jnp.round)  # noqa: A001
+trunc = _unop("trunc", jnp.trunc)
+frac = _unop("frac", lambda x: x - jnp.trunc(x))
+sign = _unop("sign", jnp.sign)
+sin = _unop("sin", jnp.sin)
+cos = _unop("cos", jnp.cos)
+tan = _unop("tan", jnp.tan)
+asin = _unop("asin", jnp.arcsin)
+acos = _unop("acos", jnp.arccos)
+atan = _unop("atan", jnp.arctan)
+sinh = _unop("sinh", jnp.sinh)
+cosh = _unop("cosh", jnp.cosh)
+tanh = _unop("tanh", jnp.tanh)
+asinh = _unop("asinh", jnp.arcsinh)
+acosh = _unop("acosh", jnp.arccosh)
+atanh = _unop("atanh", jnp.arctanh)
+erf = _unop("erf", jax.scipy.special.erf)
+erfinv = _unop("erfinv", jax.scipy.special.erfinv)
+reciprocal = _unop("reciprocal", lambda x: 1.0 / x)
+neg = _unop("neg", jnp.negative)
+negative = neg
+digamma = _unop("digamma", jax.scipy.special.digamma)
+lgamma = _unop("lgamma", jax.scipy.special.gammaln)
+gammaln = lgamma
+i0 = _unop("i0", jax.scipy.special.i0)
+i0e = _unop("i0e", jax.scipy.special.i0e)
+i1 = _unop("i1", jax.scipy.special.i1)
+i1e = _unop("i1e", jax.scipy.special.i1e)
+angle = _unop("angle", jnp.angle)
+conj = _unop("conj", jnp.conj)
+real = _unop("real", jnp.real)
+imag = _unop("imag", jnp.imag)
+deg2rad = _unop("deg2rad", jnp.deg2rad)
+rad2deg = _unop("rad2deg", jnp.rad2deg)
+exponent_ = None  # placeholder, not part of API
+
+
+@defop("clip")
+def _clip(x, mn, mx):
+    return jnp.clip(x, mn, mx)
+
+
+def clip(x, min=None, max=None, name=None):  # noqa: A001
+    mn = min.item() if isinstance(min, Tensor) else min
+    mx = max.item() if isinstance(max, Tensor) else max
+    return _clip(x, mn, mx)
+
+
+@defop("lerp")
+def _lerp(x, y, w):
+    return x + w * (y - x)
+
+
+def lerp(x, y, weight, name=None):
+    return _lerp(x, y, weight)
+
+
+@defop("stanh")
+def _stanh(x, scale_a, scale_b):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return _stanh(x, scale_a, scale_b)
+
+
+@defop("multiplex")
+def _multiplex(index, *inputs):
+    stacked = jnp.stack(inputs, axis=0)
+    idx = index.reshape(-1)
+    return stacked[idx, jnp.arange(stacked.shape[1])]
+
+
+def multiplex(inputs, index, name=None):
+    return _multiplex(index, *inputs)
+
+
+# ------------------------------------------------------------- reductions
+def _reduce(name, jfn, bool_to_int=False):
+    @defop(name)
+    def op(x, axis, keepdim, dtype):
+        if dtype is not None:
+            x = x.astype(dtype)
+        elif bool_to_int and x.dtype == np.bool_:
+            x = x.astype(np.int64)
+        return jfn(x, axis=axis, keepdims=keepdim)
+
+    def public(x, axis=None, keepdim=False, dtype=None, name=None):
+        return op(x, _axis(axis), builtins_bool(keepdim),
+                  None if dtype is None else dtypes.convert_dtype(dtype))
+    public.__name__ = name
+    return public
+
+
+builtins_bool = bool
+sum = _reduce("sum", jnp.sum, bool_to_int=True)  # noqa: A001
+prod = _reduce("prod", jnp.prod, bool_to_int=True)
+nansum = _reduce("nansum", jnp.nansum, bool_to_int=True)
+
+
+def _mean_like(name, jfn):
+    @defop(name)
+    def op(x, axis, keepdim):
+        return jfn(x, axis=axis, keepdims=keepdim)
+
+    def public(x, axis=None, keepdim=False, name=None):
+        return op(x, _axis(axis), builtins_bool(keepdim))
+    public.__name__ = name
+    return public
+
+
+mean = _mean_like("mean", jnp.mean)
+nanmean = _mean_like("nanmean", jnp.nanmean)
+amax = _mean_like("amax", jnp.max)
+amin = _mean_like("amin", jnp.min)
+max = _mean_like("max", jnp.max)  # noqa: A001
+min = _mean_like("min", jnp.min)  # noqa: A001
+median = _mean_like("median", jnp.median)
+nanmedian = _mean_like("nanmedian", jnp.nanmedian)
+
+
+@defop("logsumexp")
+def _logsumexp(x, axis, keepdim):
+    return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdim)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return _logsumexp(x, _axis(axis), bool(keepdim))
+
+
+@defop("all")
+def _all(x, axis, keepdim):
+    return jnp.all(x, axis=axis, keepdims=keepdim)
+
+
+def all(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return _all(x, _axis(axis), bool(keepdim))
+
+
+@defop("any")
+def _any(x, axis, keepdim):
+    return jnp.any(x, axis=axis, keepdims=keepdim)
+
+
+def any(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return _any(x, _axis(axis), bool(keepdim))
+
+
+@defop("count_nonzero")
+def _count_nonzero(x, axis, keepdim):
+    return jnp.count_nonzero(x, axis=axis, keepdims=keepdim)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return _count_nonzero(x, _axis(axis), bool(keepdim))
+
+
+def _var_std(name, jfn):
+    @defop(name)
+    def op(x, axis, unbiased, keepdim):
+        return jfn(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+    def public(x, axis=None, unbiased=True, keepdim=False, name=None):
+        return op(x, _axis(axis), bool(unbiased), bool(keepdim))
+    public.__name__ = name
+    return public
+
+
+var = _var_std("var", jnp.var)
+std = _var_std("std", jnp.std)
+
+
+# ------------------------------------------------------------- cumulative
+@defop("cumsum")
+def _cumsum(x, axis, dtype):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    if dtype is not None:
+        x = x.astype(dtype)
+    return jnp.cumsum(x, axis=axis)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    return _cumsum(x, _axis(axis),
+                   None if dtype is None else dtypes.convert_dtype(dtype))
+
+
+@defop("cumprod")
+def _cumprod(x, axis, dtype):
+    if dtype is not None:
+        x = x.astype(dtype)
+    return jnp.cumprod(x, axis=axis)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    return _cumprod(x, _axis(dim),
+                    None if dtype is None else dtypes.convert_dtype(dtype))
+
+
+@defop("cummax")
+def _cummax(x, axis):
+    return jax.lax.associative_scan(jnp.maximum, x, axis=axis)
+
+
+def cummax(x, axis=None, dtype=None, name=None):
+    vals = _cummax(x if axis is not None else x.reshape([-1]),
+                   _axis(axis) if axis is not None else 0)
+    return vals
+
+
+@defop("cummin")
+def _cummin(x, axis):
+    return jax.lax.associative_scan(jnp.minimum, x, axis=axis)
+
+
+def cummin(x, axis=None, dtype=None, name=None):
+    return _cummin(x if axis is not None else x.reshape([-1]),
+                   _axis(axis) if axis is not None else 0)
+
+
+@defop("logcumsumexp")
+def _logcumsumexp(x, axis):
+    return jax.lax.associative_scan(jnp.logaddexp, x, axis=axis)
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    if axis is None:
+        return _logcumsumexp(x.reshape([-1]), 0)
+    return _logcumsumexp(x, _axis(axis))
+
+
+# ------------------------------------------------------------- matmul & co
+@defop("matmul")
+def _matmul(x, y, transpose_x, transpose_y):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    return _matmul(x, y, bool(transpose_x), bool(transpose_y))
+
+
+@defop("dot")
+def _dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+def dot(x, y, name=None):
+    return _dot(x, y)
+
+
+@defop("mm")
+def _mm(x, y):
+    return jnp.matmul(x, y)
+
+
+def mm(input, mat2, name=None):  # noqa: A002
+    return _mm(input, mat2)
+
+
+def bmm(x, y, name=None):
+    return _mm(x, y)
+
+
+@defop("mv")
+def _mv(x, v):
+    return jnp.matmul(x, v)
+
+
+def mv(x, vec, name=None):
+    return _mv(x, vec)
+
+
+@defop("addmm")
+def _addmm(input, x, y, beta, alpha):  # noqa: A002
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
+    return _addmm(input, x, y, beta, alpha)
+
+
+@defop("outer")
+def _outer(x, y):
+    return jnp.outer(x, y)
+
+
+def outer(x, y, name=None):
+    return _outer(x, y)
+
+
+@defop("inner")
+def _inner(x, y):
+    return jnp.inner(x, y)
+
+
+def inner(x, y, name=None):
+    return _inner(x, y)
+
+
+@defop("cross")
+def _cross(x, y, axis):
+    return jnp.cross(x, y, axis=axis)
+
+
+def cross(x, y, axis=9, name=None):
+    if axis == 9:
+        # paddle default: first axis with dim 3
+        shape = x.shape if not isinstance(x, Tensor) else x.shape
+        axis = next(i for i, d in enumerate(shape) if d == 3)
+    return _cross(x, y, int(axis))
+
+
+@defop("kron")
+def _kron(x, y):
+    return jnp.kron(x, y)
+
+
+def kron(x, y, name=None):
+    return _kron(x, y)
+
+
+def einsum(equation, *operands):
+    def _einsum(*ops, eq=None):
+        return jnp.einsum(eq, *ops)
+    return apply("einsum", _einsum, *operands, eq=equation)
+
+
+@defop("trace_op")
+def _trace(x, offset, axis1, axis2):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return _trace(x, int(offset), int(axis1), int(axis2))
+
+
+@defop("diagonal")
+def _diagonal(x, offset, axis1, axis2):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return _diagonal(x, int(offset), int(axis1), int(axis2))
+
+
+# ------------------------------------------------------------- predicates
+def _pred(name, jfn):
+    @defop(name)
+    def op(x):
+        return jfn(x)
+    def public(x, name=None):
+        return op(x)
+    public.__name__ = name
+    return public
+
+
+isnan = _pred("isnan", jnp.isnan)
+isinf = _pred("isinf", jnp.isinf)
+isfinite = _pred("isfinite", jnp.isfinite)
+
+
+@defop("nan_to_num")
+def _nan_to_num(x, nan, posinf, neginf):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return _nan_to_num(x, nan, posinf, neginf)
+
+
+# ------------------------------------------------------------- misc
+@defop("increment")
+def _increment(x, value):
+    return x + jnp.asarray(value, x.dtype)
+
+
+def increment(x, value=1.0, name=None):
+    out = _increment(x, value)
+    x._value = out._value
+    x._node = out._node
+    x._out_idx = out._out_idx
+    return x
+
+
+@defop("broadcast_shape_op")
+def _noop(x):
+    return x
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+@defop("renorm")
+def _renorm(x, p, axis, max_norm):
+    dims = tuple(i for i in range(x.ndim) if i != axis)
+    norms = jnp.sum(jnp.abs(x) ** p, axis=dims, keepdims=True) ** (1.0 / p)
+    factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    return x * factor
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    return _renorm(x, float(p), int(axis), float(max_norm))
+
+
+@defop("histogram")
+def _histogram(x, bins, mn, mx):
+    lo, hi = (mn, mx) if (mn != 0 or mx != 0) else (None, None)
+    if lo is None:
+        lo, hi = jnp.min(x), jnp.max(x)
+    hist, _ = jnp.histogram(x, bins=bins, range=None if lo is None else (lo, hi))
+    return hist
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):  # noqa: A002
+    return _histogram(input, int(bins), min, max)
+
+
+@defop("bincount")
+def _bincount(x, weights, minlength):
+    return jnp.bincount(x, weights=weights, minlength=minlength,
+                        length=None)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    # jnp.bincount needs static length under jit; eager fallback via numpy
+    xs = x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+    ws = weights.numpy() if isinstance(weights, Tensor) else weights
+    out = np.bincount(xs, weights=ws, minlength=minlength)
+    from ..framework.tensor import to_tensor
+    return to_tensor(out)

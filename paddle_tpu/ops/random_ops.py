@@ -1,0 +1,178 @@
+"""Random sampling ops.
+
+Reference analog: python/paddle/tensor/random.py over phi
+uniform/gaussian/randint kernels. TPU-native: counter-based PRNG — every call
+draws a subkey from the global stream (framework/random.py) and passes it as a
+traced array input, so the compiled executable is reused and backward-tape
+recompute sees identical bits.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework import dtype as dtypes
+from ..framework.dispatch import defop, apply
+from ..framework.random import next_key
+from ..framework.tensor import Tensor
+
+
+def _shape(s):
+    if isinstance(s, Tensor):
+        return tuple(int(v) for v in s.numpy().reshape(-1))
+    if isinstance(s, (int, np.integer)):
+        return (int(s),)
+    return tuple(int(v if not isinstance(v, Tensor) else v.item()) for v in s)
+
+
+def _dt(dtype):
+    return dtypes.get_default_dtype() if dtype is None else dtypes.convert_dtype(dtype)
+
+
+@defop("uniform")
+def _uniform(key, shape, mn, mx, dtype):
+    return jax.random.uniform(key, shape, dtype=jnp.float32,
+                              minval=mn, maxval=mx).astype(dtype)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):  # noqa: A001,A002
+    if isinstance(min, Tensor):
+        min = min.item()  # noqa: A001
+    if isinstance(max, Tensor):
+        max = max.item()  # noqa: A001
+    return _uniform(next_key(), _shape(shape), float(min), float(max),
+                    _dt(dtype))
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+@defop("gaussian")
+def _gaussian(key, shape, mean, std, dtype):
+    return (jax.random.normal(key, shape, dtype=jnp.float32) * std +
+            mean).astype(dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        def _normal_t(key, mean, std):
+            return jax.random.normal(key, jnp.shape(mean)) * std + mean
+        return apply("normal_t", _normal_t, next_key(), mean, std)
+    return _gaussian(next_key(), _shape(shape), float(mean), float(std),
+                     dtypes.get_default_dtype())
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    return _gaussian(next_key(), _shape(shape), float(mean), float(std),
+                     _dt(dtype))
+
+
+def randn(shape, dtype=None, name=None):
+    return gaussian(shape, 0.0, 1.0, dtype=dtype)
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+@defop("randint")
+def _randint(key, low, high, shape, dtype):
+    return jax.random.randint(key, shape, low, high).astype(dtype)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return _randint(next_key(), int(low), int(high), _shape(shape),
+                    dtypes.convert_dtype(dtype))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    return randint(low, high, tuple(x.shape),
+                   dtype or x.dtype)
+
+
+@defop("randperm")
+def _randperm(key, n, dtype):
+    return jax.random.permutation(key, n).astype(dtype)
+
+
+def randperm(n, dtype="int64", name=None):
+    return _randperm(next_key(), int(n), dtypes.convert_dtype(dtype))
+
+
+@defop("bernoulli")
+def _bernoulli(key, x):
+    return jax.random.bernoulli(key, x).astype(x.dtype)
+
+
+def bernoulli(x, name=None):
+    return _bernoulli(next_key(), x)
+
+
+@defop("poisson")
+def _poisson(key, x):
+    return jax.random.poisson(key, x).astype(x.dtype)
+
+
+def poisson(x, name=None):
+    return _poisson(next_key(), x)
+
+
+@defop("exponential")
+def _exponential(key, x, lam):
+    return (jax.random.exponential(key, x.shape, x.dtype) / lam)
+
+
+def exponential_(x, lam=1.0, name=None):
+    out = _exponential(next_key(), x, float(lam))
+    x._value = out._value
+    return x
+
+
+@defop("multinomial")
+def _multinomial(key, x, num_samples, replacement):
+    logits = jnp.log(jnp.maximum(x, 1e-37))
+    if replacement:
+        if x.ndim == 1:
+            return jax.random.categorical(key, logits, shape=(num_samples,)).astype(np.int64)
+        return jax.random.categorical(
+            key, logits, axis=-1,
+            shape=(x.shape[0], num_samples)).astype(np.int64)
+    # without replacement: gumbel top-k trick
+    g = jax.random.gumbel(key, x.shape)
+    scores = logits + g
+    _, idx = jax.lax.top_k(scores, num_samples)
+    return idx.astype(np.int64)
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    return _multinomial(next_key(), x, int(num_samples), bool(replacement))
+
+
+@defop("uniform_inplace")
+def _uniform_like(key, x, mn, mx):
+    return jax.random.uniform(key, x.shape, jnp.float32, mn, mx).astype(x.dtype)
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):  # noqa: A001,A002
+    out = _uniform_like(next_key(), x, float(min), float(max))
+    x._value = out._value
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    out = _gaussian(next_key(), tuple(x.shape), float(mean), float(std),
+                    x.dtype)
+    x._value = out._value
+    return x
+
+
+def rand_like(x, dtype=None, name=None):
+    return uniform(tuple(x.shape), dtype or x.dtype, 0.0, 1.0)
+
+
+def randn_like(x, dtype=None, name=None):
+    return gaussian(tuple(x.shape), 0.0, 1.0, dtype=dtype or x.dtype)

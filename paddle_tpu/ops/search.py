@@ -1,0 +1,146 @@
+"""Search/sort ops: argmax/argmin/argsort/sort/topk/kthvalue/searchsorted/mode.
+
+Reference analog: python/paddle/tensor/search.py. Index outputs are marked
+non-differentiable so the tape's vjp skips them (the reference does the same
+via grad-op registration).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework import dtype as dtypes
+from ..framework.dispatch import defop
+from ..framework.tensor import Tensor
+
+
+def _axis(a):
+    return None if a is None else int(a)
+
+
+@defop("argmax")
+def _argmax(x, axis, keepdim, dtype):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(dtype)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return _argmax(x, _axis(axis), bool(keepdim), dtypes.convert_dtype(dtype))
+
+
+@defop("argmin")
+def _argmin(x, axis, keepdim, dtype):
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(dtype)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return _argmin(x, _axis(axis), bool(keepdim), dtypes.convert_dtype(dtype))
+
+
+@defop("argsort")
+def _argsort(x, axis, descending, stable):
+    out = jnp.argsort(x, axis=axis, stable=stable, descending=descending)
+    return out.astype(dtypes.canonicalize(np.int64))
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    return _argsort(x, int(axis), bool(descending), bool(stable))
+
+
+@defop("sort")
+def _sort(x, axis, descending):
+    out = jnp.sort(x, axis=axis, descending=descending)
+    return out
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    return _sort(x, int(axis), bool(descending))
+
+
+@defop("topk", nondiff_outputs=(1,))
+def _topk(x, k, axis, largest, sorted):  # noqa: A002
+    if not largest:
+        vals, idx = jax.lax.top_k(jnp.moveaxis(-x, axis, -1), k)
+        vals = -vals
+    else:
+        vals, idx = jax.lax.top_k(jnp.moveaxis(x, axis, -1), k)
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis).astype(dtypes.canonicalize(np.int64))
+    return vals, idx
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):  # noqa: A002
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    vals, idx = _topk(x, int(k), int(axis), bool(largest), bool(sorted))
+    return vals, idx
+
+
+@defop("kthvalue", nondiff_outputs=(1,))
+def _kthvalue(x, k, axis, keepdim):
+    srt = jnp.sort(x, axis=axis)
+    asrt = jnp.argsort(x, axis=axis).astype(dtypes.canonicalize(np.int64))
+    vals = jnp.take(srt, k - 1, axis=axis)
+    idx = jnp.take(asrt, k - 1, axis=axis)
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        idx = jnp.expand_dims(idx, axis)
+    return vals, idx
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    return tuple(_kthvalue(x, int(k), int(axis), bool(keepdim)))
+
+
+@defop("mode", nondiff_outputs=(1,))
+def _mode(x, axis, keepdim):
+    # mode along axis: emulate via sort + run-length
+    srt = jnp.sort(x, axis=axis)
+    n = x.shape[axis]
+    occ = jnp.stack([jnp.sum(srt == jnp.expand_dims(
+        jnp.take(srt, i, axis=axis), axis), axis=axis)
+        for i in range(n)], axis=0)
+    best = jnp.argmax(occ, axis=0)
+    vals = jnp.take_along_axis(srt, jnp.expand_dims(best, axis), axis=axis)
+    idx = jnp.argmax(x == vals, axis=axis)
+    vals = jnp.squeeze(vals, axis=axis)
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        idx = jnp.expand_dims(idx, axis)
+    return vals, idx.astype(dtypes.canonicalize(np.int64))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    return tuple(_mode(x, int(axis) % (x.ndim if x.ndim else 1)
+                       if int(axis) < 0 else int(axis), bool(keepdim)))
+
+
+@defop("searchsorted_op")
+def _searchsorted(sorted_sequence, values, right, out_int32):
+    side = "right" if right else "left"
+    if sorted_sequence.ndim == 1:
+        out = jnp.searchsorted(sorted_sequence, values, side=side)
+    else:
+        out = jax.vmap(lambda s, v: jnp.searchsorted(s, v, side=side))(
+            sorted_sequence.reshape(-1, sorted_sequence.shape[-1]),
+            values.reshape(-1, values.shape[-1]))
+        out = out.reshape(values.shape)
+    return out.astype(np.int32 if out_int32 else dtypes.canonicalize(np.int64))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    return _searchsorted(sorted_sequence, values, bool(right), bool(out_int32))
+
+
+@defop("bucketize_op")
+def _bucketize(x, sorted_sequence, right, out_int32):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(sorted_sequence, x, side=side)
+    return out.astype(np.int32 if out_int32 else dtypes.canonicalize(np.int64))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return _bucketize(x, sorted_sequence, bool(right), bool(out_int32))

@@ -1,0 +1,5 @@
+"""paddle_tpu.optimizer (reference: python/paddle/optimizer/)."""
+from .optimizer import Optimizer  # noqa: F401
+from .optimizers import (  # noqa: F401
+    SGD, Momentum, Adagrad, RMSProp, Adadelta, Adam, AdamW, Adamax, Lamb)
+from . import lr  # noqa: F401

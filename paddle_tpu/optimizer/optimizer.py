@@ -1,0 +1,191 @@
+"""Optimizer base.
+
+Reference analog: python/paddle/optimizer/optimizer.py:91. TPU-native: the
+whole parameter-set update is ONE jitted pytree computation (the reference's
+fused multi-tensor adam, generalized) — one device dispatch per step, with lr
+and the step counter fed as device scalars so nothing recompiles. Subclasses
+implement `_update(p, g, state, lr)` as pure jax math.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework import dtype as dtypes
+from ..framework.autograd import no_grad
+from ..framework.tensor import Tensor
+from .lr import LRScheduler
+
+
+class L2DecayRegularizer:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+
+class Optimizer:
+    _state_keys: List[str] = []
+
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 multi_precision=False):
+        if parameters is None:
+            raise ValueError(
+                "paddle_tpu optimizers require an explicit parameter list "
+                "(pass model.parameters())")
+        self._parameter_list = [p for p in parameters]
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        if isinstance(weight_decay, float) or isinstance(weight_decay, int):
+            self._weight_decay_coeff = float(weight_decay)
+        elif weight_decay is not None and hasattr(weight_decay, "coeff"):
+            self._weight_decay_coeff = float(weight_decay.coeff)
+        else:
+            self._weight_decay_coeff = 0.0
+        # state: param id -> dict key -> jax array
+        self._state: Dict[int, Dict[str, jnp.ndarray]] = {}
+        self._step_count = 0
+        self._jitted_step = None
+
+    # -- lr --------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    # -- state -----------------------------------------------------------
+    def _init_state(self, p) -> Dict[str, jnp.ndarray]:
+        return {k: jnp.zeros(p._value.shape, jnp.float32)
+                for k in self._state_keys}
+
+    def _ensure_state(self):
+        for p in self._parameter_list:
+            if id(p) not in self._state:
+                self._state[id(p)] = self._init_state(p)
+
+    # -- the pure update -------------------------------------------------
+    def _update(self, p, g, state, lr, step):
+        """Return (new_p, new_state). Pure jax; overridden by subclasses."""
+        raise NotImplementedError
+
+    def _apply_decay_to_grad(self) -> bool:
+        """L2Decay folded into grads (SGD-family); AdamW overrides decay."""
+        return True
+
+    # -- public API ------------------------------------------------------
+    @no_grad()
+    def step(self):
+        self._ensure_state()
+        params = [p for p in self._parameter_list
+                  if p.grad is not None and p.trainable]
+        if not params:
+            return
+        if self._jitted_step is None or \
+                len(params) != getattr(self, "_n_jitted", -1):
+            self._full_params = params
+            self._n_jitted = len(params)
+            self._jitted_step = self._build_step_fn_for(params)
+        grads = [p.grad._value for p in params]
+        states = [[self._state[id(p)][k] for k in self._state_keys]
+                  for p in params]
+        self._step_count += 1
+        lr = jnp.asarray(self.get_lr(), jnp.float32)
+        step = jnp.asarray(self._step_count, jnp.float32)
+        new_params, new_states = self._jitted_step(
+            lr, step, [p._value for p in params], grads, states)
+        for p, npv, nst in zip(params, new_params, new_states):
+            p._value = npv
+            self._state[id(p)] = dict(zip(self._state_keys, nst))
+
+    def _build_step_fn_for(self, params):
+        decay = self._weight_decay_coeff
+        clip = self._grad_clip
+        lr_mults = tuple(p.optimize_attr.get("learning_rate", 1.0)
+                         for p in params)
+        reg_coeffs = tuple(
+            (p.regularizer.coeff if getattr(p, "regularizer", None) is not None
+             and hasattr(p.regularizer, "coeff") else None)
+            for p in params)
+        no_clip = tuple(not getattr(p, "need_clip", True) for p in params)
+        decay_in_grad = self._apply_decay_to_grad()
+        update = self._update
+        keys = self._state_keys
+
+        def step_fn(lr, step, pvals, gvals, svals):
+            gs = [g.astype(jnp.float32) for g in gvals]
+            if clip is not None:
+                clipped = clip._clip_values(gs)
+                gs = [g if skip else c
+                      for g, c, skip in zip(gs, clipped, no_clip)]
+            new_params, new_states = [], []
+            for i, (p, g, st) in enumerate(zip(pvals, gs, svals)):
+                coeff = reg_coeffs[i] if reg_coeffs[i] is not None else (
+                    decay if decay_in_grad else 0.0)
+                if coeff:
+                    g = g + coeff * p.astype(jnp.float32)
+                state = dict(zip(keys, st))
+                np_, ns_ = update(p, g, state, lr * lr_mults[i], step)
+                new_params.append(np_.astype(p.dtype))
+                new_states.append([ns_[k] for k in keys])
+            return new_params, new_states
+
+        return jax.jit(step_fn, donate_argnums=(2, 4))
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def state_dict(self):
+        sd = {}
+        for i, p in enumerate(self._parameter_list):
+            st = self._state.get(id(p))
+            if st is None:
+                continue
+            for k, v in st.items():
+                sd[f"{p.name or i}_{k}"] = Tensor(v)
+        sd["LR_Scheduler"] = (
+            self._learning_rate.state_dict()
+            if isinstance(self._learning_rate, LRScheduler) else {})
+        sd["@step"] = self._step_count
+        return sd
+
+    def set_state_dict(self, state_dict):
+        self._ensure_state()
+        self._step_count = int(state_dict.get("@step", self._step_count))
+        if isinstance(self._learning_rate, LRScheduler) and \
+                state_dict.get("LR_Scheduler"):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        for i, p in enumerate(self._parameter_list):
+            for k in self._state_keys:
+                key = f"{p.name or i}_{k}"
+                if key in state_dict:
+                    v = state_dict[key]
+                    arr = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+                    self._state[id(p)][k] = arr
+
+    @property
+    def _parameter_groups(self):
+        return self._parameter_list
+
+    def _param_state(self, p, key):
+        self._ensure_state()
+        return Tensor(self._state[id(p)][key])

@@ -1,0 +1,228 @@
+"""Concrete optimizers: SGD, Momentum, Adagrad, RMSProp, Adadelta, Adam,
+AdamW, Adamax, Lamb, LBFGS-lite.
+
+Reference analog: python/paddle/optimizer/{sgd,momentum,adam,adamw,lamb}.py
+over phi sgd/adam kernels and fused_adam. Each `_update` is pure jax math;
+the base class fuses all parameters into one jitted step.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+
+class SGD(Optimizer):
+    _state_keys = []
+
+    def _init_state(self, p):
+        return {}
+
+    def _update(self, p, g, state, lr, step):
+        return p.astype(jnp.float32) - lr * g, state
+
+
+class Momentum(Optimizer):
+    _state_keys = ["velocity"]
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._momentum = float(momentum)
+        self._nesterov = bool(use_nesterov)
+
+    def _update(self, p, g, state, lr, step):
+        v = self._momentum * state["velocity"] + g
+        if self._nesterov:
+            new_p = p.astype(jnp.float32) - lr * (g + self._momentum * v)
+        else:
+            new_p = p.astype(jnp.float32) - lr * v
+        return new_p, {"velocity": v}
+
+
+class Adagrad(Optimizer):
+    _state_keys = ["moment"]
+
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 initial_accumulator_value=0.0, multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._epsilon = float(epsilon)
+        self._init_acc = float(initial_accumulator_value)
+
+    def _init_state(self, p):
+        return {"moment": jnp.full(p._value.shape, self._init_acc,
+                                   jnp.float32)}
+
+    def _update(self, p, g, state, lr, step):
+        m = state["moment"] + jnp.square(g)
+        new_p = p.astype(jnp.float32) - lr * g / (jnp.sqrt(m) + self._epsilon)
+        return new_p, {"moment": m}
+
+
+class RMSProp(Optimizer):
+    _state_keys = ["mean_square", "mean_grad", "momentum"]
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._rho = float(rho)
+        self._epsilon = float(epsilon)
+        self._momentum = float(momentum)
+        self._centered = bool(centered)
+
+    def _update(self, p, g, state, lr, step):
+        ms = self._rho * state["mean_square"] + (1 - self._rho) * jnp.square(g)
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * g
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._epsilon)
+        else:
+            mg = state["mean_grad"]
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * state["momentum"] + lr * g / denom
+        return p.astype(jnp.float32) - mom, \
+            {"mean_square": ms, "mean_grad": mg, "momentum": mom}
+
+
+class Adadelta(Optimizer):
+    _state_keys = ["avg_squared_grad", "avg_squared_update"]
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None, multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._epsilon = float(epsilon)
+        self._rho = float(rho)
+
+    def _update(self, p, g, state, lr, step):
+        asg = self._rho * state["avg_squared_grad"] + \
+            (1 - self._rho) * jnp.square(g)
+        upd = g * jnp.sqrt(state["avg_squared_update"] + self._epsilon) / \
+            jnp.sqrt(asg + self._epsilon)
+        asu = self._rho * state["avg_squared_update"] + \
+            (1 - self._rho) * jnp.square(upd)
+        return p.astype(jnp.float32) - lr * upd, \
+            {"avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+class Adam(Optimizer):
+    _state_keys = ["moment1", "moment2"]
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 use_multi_tensor=False, name=None, amsgrad=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1 = float(beta1) if not hasattr(beta1, "numpy") else float(beta1.numpy())
+        self._beta2 = float(beta2) if not hasattr(beta2, "numpy") else float(beta2.numpy())
+        self._epsilon = float(epsilon)
+        self._amsgrad = bool(amsgrad)
+        if self._amsgrad:
+            type(self)._state_keys = ["moment1", "moment2", "moment2_max"]
+
+    def _update(self, p, g, state, lr, step):
+        b1, b2 = self._beta1, self._beta2
+        m1 = b1 * state["moment1"] + (1 - b1) * g
+        m2 = b2 * state["moment2"] + (1 - b2) * jnp.square(g)
+        bc1 = 1 - b1 ** step
+        bc2 = 1 - b2 ** step
+        m1_hat = m1 / bc1
+        if self._amsgrad:
+            m2m = jnp.maximum(state["moment2_max"], m2)
+            m2_hat = m2m / bc2
+            denom = jnp.sqrt(m2_hat) + self._epsilon
+            new_p = p.astype(jnp.float32) - lr * m1_hat / denom
+            return new_p, {"moment1": m1, "moment2": m2, "moment2_max": m2m}
+        m2_hat = m2 / bc2
+        denom = jnp.sqrt(m2_hat) + self._epsilon
+        new_p = p.astype(jnp.float32) - lr * m1_hat / denom
+        return new_p, {"moment1": m1, "moment2": m2}
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None,
+                 amsgrad=False):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision,
+                         False, name, amsgrad)
+        self._coeff = float(weight_decay)
+        self._apply_decay_fn = apply_decay_param_fun
+        self._decay_mask = tuple(
+            (apply_decay_param_fun(p.name) if apply_decay_param_fun else True)
+            for p in self._parameter_list)
+
+    def _apply_decay_to_grad(self):
+        return False
+
+    def _build_step_fn_for(self, params):
+        base = super()._build_step_fn_for(params)
+        coeff = self._coeff
+        fn = self._apply_decay_fn
+        masks = tuple((fn(p.name) if fn else True) for p in params)
+        import jax
+
+        def step_fn(lr, step, pvals, gvals, svals):
+            # decoupled decay applied before the adam update, matching the
+            # reference adamw kernel (p *= (1 - lr*coeff))
+            pvals = [p * (1.0 - lr * coeff) if m else p
+                     for p, m in zip(pvals, masks)]
+            return base(lr, step, pvals, gvals, svals)
+        return jax.jit(step_fn, donate_argnums=(2, 4))
+
+
+class Adamax(Optimizer):
+    _state_keys = ["moment", "inf_norm"]
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1, self._beta2 = float(beta1), float(beta2)
+        self._epsilon = float(epsilon)
+
+    def _update(self, p, g, state, lr, step):
+        m = self._beta1 * state["moment"] + (1 - self._beta1) * g
+        inf = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(g))
+        lr_t = lr / (1 - self._beta1 ** step)
+        new_p = p.astype(jnp.float32) - lr_t * m / (inf + self._epsilon)
+        return new_p, {"moment": m, "inf_norm": inf}
+
+
+class Lamb(Optimizer):
+    _state_keys = ["moment1", "moment2"]
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name,
+                         multi_precision)
+        self._beta1, self._beta2 = float(beta1), float(beta2)
+        self._epsilon = float(epsilon)
+        self._lamb_decay = float(lamb_weight_decay)
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _update(self, p, g, state, lr, step):
+        b1, b2 = self._beta1, self._beta2
+        m1 = b1 * state["moment1"] + (1 - b1) * g
+        m2 = b2 * state["moment2"] + (1 - b2) * jnp.square(g)
+        m1_hat = m1 / (1 - b1 ** step)
+        m2_hat = m2 / (1 - b2 ** step)
+        pf = p.astype(jnp.float32)
+        r = m1_hat / (jnp.sqrt(m2_hat) + self._epsilon) + \
+            self._lamb_decay * pf
+        w_norm = jnp.linalg.norm(pf)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return pf - lr * trust * r, {"moment1": m1, "moment2": m2}

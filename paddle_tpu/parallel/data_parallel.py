@@ -1,0 +1,71 @@
+"""paddle.DataParallel.
+
+Reference analog: python/paddle/distributed/parallel.py:186 (DataParallel
+wrapping + EagerReducer bucketed allreduce, collective/reducer.cc:89).
+
+TPU-native: under one single-controller program, DP is a sharding of the
+batch axis — gradients come out of the (single) backward already globally
+summed by XLA's psum when the loss is a mean over the dp-sharded batch. So
+DataParallel here shards params replicated + inputs on 'dp' and needs NO
+reducer, no buckets, no comm/calc stream overlap machinery: the compiler
+already overlaps the grad all-reduce with remaining backward compute.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ..framework.tensor import Tensor
+from .mesh import get_mesh, shard_value, build_mesh, set_global_mesh
+from .env import init_parallel_env
+
+
+class DataParallel:
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        init_parallel_env()
+        self._layers = layers
+        mesh = get_mesh()
+        if mesh is None:
+            ndev = jax.device_count()
+            if ndev > 1:
+                mesh = build_mesh({"dp": ndev})
+                set_global_mesh(mesh)
+        self._mesh = mesh
+        if mesh is not None:
+            for p in layers.parameters():
+                p._value = shard_value(p._value, P(), mesh)
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_layers"], name)
+
+    def __call__(self, *args, **kwargs):
+        mesh = self._mesh
+        if mesh is not None and "dp" in mesh.axis_names:
+            n = mesh.shape["dp"]
+            new_args = []
+            for a in args:
+                if isinstance(a, Tensor) and a.ndim >= 1 and \
+                        a.shape[0] % n == 0:
+                    a = Tensor(shard_value(a._value, P("dp"), mesh),
+                               stop_gradient=a.stop_gradient)
+                new_args.append(a)
+            args = new_args
+        return self._layers(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        return self(*args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)

@@ -1,0 +1,89 @@
+"""Distributed environment bootstrap.
+
+Reference analog: paddle.distributed.init_parallel_env
+(python/paddle/distributed/parallel.py:915) + TCPStore rendezvous
+(/root/reference/paddle/phi/core/distributed/store/tcp_store.h).
+
+TPU-native: JAX is single-controller-per-host; multi-host jobs rendezvous
+through the JAX coordination service (jax.distributed.initialize) instead of
+a TCPStore — PADDLE_TRAINER_ID/PADDLE_TRAINERS_NUM-style env vars map onto
+process_id/num_processes. Within one host, all local TPU chips belong to this
+one process (no per-GPU process forking), so "rank" here is the *process*
+(host) index, and per-chip parallelism is expressed with a Mesh.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+_initialized = False
+
+
+def init_parallel_env(strategy=None):
+    """Bootstraps multi-host JAX if the launch env asks for it; no-op single
+    host. Safe to call multiple times."""
+    global _initialized
+    if _initialized:
+        return
+    n = int(os.environ.get("PADDLE_TRAINERS_NUM",
+                           os.environ.get("WORLD_SIZE", "1")))
+    if n > 1 and jax.process_count() == 1:
+        rank = int(os.environ.get("PADDLE_TRAINER_ID",
+                                  os.environ.get("RANK", "0")))
+        coord = os.environ.get(
+            "PADDLE_MASTER",
+            os.environ.get("MASTER_ADDR", "127.0.0.1") + ":" +
+            os.environ.get("MASTER_PORT", "12355"))
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=n, process_id=rank)
+    _initialized = True
+
+
+def get_rank(group=None):
+    if group is not None:
+        return group.rank
+    return jax.process_index()
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.nranks
+    return jax.process_count()
+
+
+def is_initialized():
+    return _initialized
+
+
+def device_count():
+    return jax.device_count()
+
+
+def local_device_count():
+    return jax.local_device_count()
+
+
+class ParallelEnv:
+    """reference: python/paddle/distributed/parallel.py ParallelEnv."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:6170")
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else ["127.0.0.1:6170"]

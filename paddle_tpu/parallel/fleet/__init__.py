@@ -1,0 +1,21 @@
+"""paddle_tpu.parallel.fleet (reference: python/paddle/distributed/fleet/)."""
+from .strategy import DistributedStrategy  # noqa: F401
+from .fleet import (  # noqa: F401
+    init, is_initialized, distributed_model, distributed_optimizer,
+    HybridParallelOptimizer, worker_num, worker_index, is_first_worker,
+    is_worker, is_server, barrier_worker, stop_worker)
+from ..topology import get_hybrid_communicate_group  # noqa: F401
+from ..random import get_rng_state_tracker  # noqa: F401
+from .recompute import recompute, recompute_sequential  # noqa: F401
+
+
+class UtilBase:
+    def all_reduce(self, input, mode="sum"):  # noqa: A002
+        return input
+
+    def barrier(self):
+        from .fleet import barrier_worker
+        barrier_worker()
+
+
+util = UtilBase()

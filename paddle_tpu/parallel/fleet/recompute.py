@@ -1,0 +1,60 @@
+"""Activation recomputation (gradient checkpointing).
+
+Reference analog: paddle.distributed.fleet.recompute
+(fleet/recompute/recompute.py:332, PyLayer-based re-forward in backward)
+and recompute_hybrid.py.
+
+TPU-native: the region becomes ONE fused op (via the to_static capture
+machinery) whose pure function is wrapped in jax.checkpoint — XLA
+rematerializes the region's activations in backward. The tape then stores
+only the region's *inputs* instead of every intermediate op's saved
+tensors, which is the memory win the reference gets from PyLayer.
+"""
+from __future__ import annotations
+
+from ...jit.static_function import StaticFunction
+
+_recompute_cache = {}
+
+
+def recompute(function, *args, **kwargs):
+    kwargs.pop("use_reentrant", None)
+    kwargs.pop("preserve_rng_state", None)
+    offload_indices = kwargs.pop("offload_indices", None)
+    fn = function.forward if hasattr(function, "forward") and not callable(
+        function) else function
+    key = id(getattr(fn, "__func__", fn))
+    sf = _recompute_cache.get(key)
+    if sf is None:
+        sf = StaticFunction(fn if not hasattr(fn, "forward") else fn.forward,
+                            remat=True)
+        if hasattr(function, "training"):
+            sf._layer = function
+        _recompute_cache[key] = sf
+    return sf(*args, **kwargs)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """reference: recompute_sequential — checkpoint each segment of a
+    Sequential."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    layers = list(functions) if not hasattr(functions, "_sub_layers") else \
+        list(functions._sub_layers.values())
+    n = len(layers)
+    per = max(1, n // segments)
+    x = args[0] if args else kwargs.pop("x")
+    i = 0
+    while i < n:
+        seg = layers[i:i + per]
+
+        def seg_fn(inp, _seg=tuple(seg)):
+            for l in _seg:
+                inp = l(inp)
+            return inp
+        x = recompute(seg_fn, x)
+        i += per
+    return x
+
+
+def recompute_hybrid(ctx, function, *args, **kwargs):
+    return recompute(function, *args, **kwargs)

@@ -1,0 +1,77 @@
+"""DistributedStrategy.
+
+Reference analog: fleet.DistributedStrategy
+(python/paddle/distributed/fleet/base/distributed_strategy.py:113, backed by
+distributed_strategy.proto:324). Same switchboard surface, plain Python
+instead of protobuf — the strategy resolves to mesh axes + jit options
+rather than graph passes.
+"""
+from __future__ import annotations
+
+import copy
+
+
+class HybridConfig(dict):
+    pass
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "sep_degree": 1,
+            "order": ["dp", "pp", "sharding", "sep", "mp"],
+        }
+        self.amp = False
+        self.amp_configs = {
+            "init_loss_scaling": 32768.0, "use_dynamic_loss_scaling": True,
+            "custom_white_list": [], "custom_black_list": [],
+            "use_pure_fp16": False, "use_bf16": True,
+        }
+        self.recompute = False
+        self.recompute_configs = {"checkpoints": []}
+        self.sharding = False
+        self.sharding_configs = {"stage": 1, "degree": 8}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1,
+                                 "micro_batch_size": 1,
+                                 "schedule_mode": "1F1B"}
+        self.lamb = False
+        self.lars = False
+        self.dgc = False
+        self.localsgd = False
+        self.fp16_allreduce = False
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.nccl_comm_num = 1
+        self.gradient_scale_configs = {"scale_strategy": "avg"}
+        self.heter_ccl_mode = False
+        self.a_sync = False
+        self.a_sync_configs = {}
+        self.asp = False
+        self.qat = False
+        self.auto_search = False
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {}
+        self.without_graph_optimization = True
+
+    def _set_hybrid(self, **kwargs):
+        self.hybrid_configs.update(kwargs)
+
+    @property
+    def hybrid_parallel_order(self):
+        return self.hybrid_configs.get("order")
+
+    def __repr__(self):
+        fields = {k: v for k, v in self.__dict__.items()
+                  if not k.startswith("_")}
+        return f"DistributedStrategy({fields})"
+
+    def __deepcopy__(self, memo):
+        new = DistributedStrategy()
+        new.__dict__.update(copy.deepcopy(
+            {k: v for k, v in self.__dict__.items()}, memo))
+        return new

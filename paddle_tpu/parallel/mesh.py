@@ -1,0 +1,122 @@
+"""Device mesh management — the heart of the distributed design.
+
+Reference analog: the 4-axis CommunicateTopology / HybridCommunicateGroup
+(python/paddle/distributed/fleet/base/topology.py:54,140), which builds
+cartesian NCCL groups per axis. TPU-native: ONE `jax.sharding.Mesh` with
+named axes replaces the whole process-group zoo — XLA GSPMD emits the right
+ICI/DCN collectives from shardings, so "creating a comm group" becomes
+"naming a mesh axis".
+
+Axis convention (SURVEY.md §7): ('dp', 'fsdp', 'pp', 'mp'); 'sp' (sequence /
+context parallel) reuses 'mp' Megatron-style or its own axis for ring
+attention; 'ep' (expert parallel) typically aliases 'fsdp'×'mp'.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+P = PartitionSpec
+
+_state = threading.local()
+
+
+def _mesh_stack() -> List[Mesh]:
+    if not hasattr(_state, "stack"):
+        _state.stack = []
+    return _state.stack
+
+
+def build_mesh(axes: Dict[str, int], devices=None) -> Mesh:
+    """Build a Mesh from {'dp': 2, 'mp': 4, ...}; -1 on one axis = infer."""
+    devices = list(devices if devices is not None else jax.devices())
+    shape = dict(axes)
+    known = 1
+    infer_key = None
+    for k, v in shape.items():
+        if v in (-1, None):
+            if infer_key is not None:
+                raise ValueError("only one mesh axis may be -1")
+            infer_key = k
+        else:
+            known *= v
+    if infer_key is not None:
+        shape[infer_key] = len(devices) // known
+    total = int(np.prod(list(shape.values())))
+    if total != len(devices):
+        if total < len(devices):
+            devices = devices[:total]
+        else:
+            raise ValueError(
+                f"mesh {shape} needs {total} devices, have {len(devices)}")
+    arr = np.array(devices).reshape(tuple(shape.values()))
+    return Mesh(arr, tuple(shape.keys()))
+
+
+def set_global_mesh(mesh: Mesh):
+    _mesh_stack().clear()
+    _mesh_stack().append(mesh)
+
+
+def get_mesh() -> Optional[Mesh]:
+    stack = _mesh_stack()
+    return stack[-1] if stack else None
+
+
+class use_mesh:
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+
+    def __enter__(self):
+        _mesh_stack().append(self.mesh)
+        return self.mesh
+
+    def __exit__(self, *exc):
+        _mesh_stack().pop()
+        return False
+
+
+def sharding_for(spec: PartitionSpec, mesh: Optional[Mesh] = None
+                 ) -> Optional[NamedSharding]:
+    mesh = mesh or get_mesh()
+    if mesh is None:
+        return None
+    # drop axes the mesh doesn't have (lets the same model run on smaller
+    # meshes — e.g. TP spec on a dp-only mesh degrades to replicated)
+    cleaned = []
+    for entry in spec:
+        if entry is None:
+            cleaned.append(None)
+        elif isinstance(entry, (tuple, list)):
+            keep = tuple(a for a in entry if a in mesh.axis_names)
+            cleaned.append(keep if keep else None)
+        else:
+            cleaned.append(entry if entry in mesh.axis_names else None)
+    return NamedSharding(mesh, PartitionSpec(*cleaned))
+
+
+def shard_value(value, spec: PartitionSpec, mesh: Optional[Mesh] = None):
+    """device_put a jax array with a named sharding (Resharder analog —
+    reference auto_parallel/static/reshard.py:1010; XLA inserts the actual
+    collectives)."""
+    s = sharding_for(spec, mesh)
+    if s is None:
+        return value
+    return jax.device_put(value, s)
+
+
+def constraint(value, spec: PartitionSpec, mesh: Optional[Mesh] = None):
+    """with_sharding_constraint that degrades to identity outside a mesh or
+    outside a trace."""
+    mesh = mesh or get_mesh()
+    if mesh is None:
+        return value
+    try:
+        return jax.lax.with_sharding_constraint(
+            value, NamedSharding(mesh, spec))
+    except Exception:
+        return value

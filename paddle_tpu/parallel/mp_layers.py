@@ -1,0 +1,127 @@
+"""Tensor-parallel (model-parallel) layers.
+
+Reference analog: ColumnParallelLinear / RowParallelLinear /
+VocabParallelEmbedding / ParallelCrossEntropy
+(python/paddle/distributed/fleet/layers/mpu/mp_layers.py:35,173,343,524)
+plus the comm primitives in mp_ops.py (_c_identity/_c_concat/_mp_allreduce).
+
+TPU-native: the math is the SAME single-program Linear/Embedding — TP is
+expressed as weight sharding annotations (Parameter.sharding_spec) plus
+activation sharding constraints; XLA GSPMD inserts the all-reduce that
+mp_ops.py issues by hand. On one chip these layers are exactly Linear —
+which is also how the reference's unit tests check them (mp parity tests,
+test/collective/fleet/hybrid_parallel_mp_layers.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..framework.dispatch import apply
+from ..nn.layer import Layer
+from ..nn import functional as F
+from ..nn import initializer as I
+from .mesh import P, get_mesh, constraint
+
+
+def _constraint_op(x, spec):
+    """with_sharding_constraint as a traced op (identity w/o a mesh)."""
+    mesh = get_mesh()
+    if mesh is None:
+        return x
+
+    def _fn(v, spec=None, mesh_id=None):
+        return constraint(v, P(*spec))
+    return apply("sharding_constraint", _fn, x,
+                 spec=tuple(spec), mesh_id=id(mesh))
+
+
+class ColumnParallelLinear(Layer):
+    """W: [in, out] sharded on out (mp); y = xW gathered or kept sharded."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierUniform())
+        self.weight.sharding_spec = P(None, "mp")
+        self.weight.is_distributed = True
+        if has_bias:
+            self.bias = self.create_parameter(
+                shape=[out_features], is_bias=True)
+            self.bias.sharding_spec = P("mp")
+            self.bias.is_distributed = True
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        y = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            y = _constraint_op(y, (None,) * (len(y.shape) - 1) + (None,))
+        else:
+            y = _constraint_op(y, (None,) * (len(y.shape) - 1) + ("mp",))
+        return y
+
+
+class RowParallelLinear(Layer):
+    """W: [in, out] sharded on in (mp); x arrives mp-sharded on features;
+    XLA inserts the psum the reference's _mp_allreduce does manually."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierUniform())
+        self.weight.sharding_spec = P("mp", None)
+        self.weight.is_distributed = True
+        self.bias = self.create_parameter(
+            shape=[out_features], is_bias=True) if has_bias else None
+
+    def forward(self, x):
+        if not self.input_is_parallel:
+            x = _constraint_op(x, (None,) * (len(x.shape) - 1) + ("mp",))
+        y = F.linear(x, self.weight, None)
+        y = _constraint_op(y, (None,) * (len(y.shape) - 1) + (None,))
+        if self.bias is not None:
+            y = y + self.bias
+        return y
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding table sharded on the vocab axis (reference mp_layers.py:35);
+    GSPMD turns the masked-lookup + allreduce into the same collective."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            shape=[num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=I.Normal(0.0, 0.02))
+        self.weight.sharding_spec = P("mp", None)
+        self.weight.is_distributed = True
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+
+class ParallelCrossEntropy(Layer):
+    """reference mp_layers.py:524 — softmax xent over mp-sharded logits.
+    Under GSPMD the standard fused xent works on sharded logits directly."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):  # noqa: A002
+        return F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self.ignore_index)

@@ -1,0 +1,92 @@
+"""TP-aware RNG state tracking.
+
+Reference analog: RNGStatesTracker
+(python/paddle/distributed/fleet/layers/mpu/random.py:34) — separate RNG
+streams so that TP-replicated regions (layernorm dropout) draw identical
+masks on every model-parallel rank while TP-sharded regions (attention
+dropout on sharded heads) draw different ones.
+
+TPU-native: under GSPMD a dropout op is *one* program, so the mask sharding
+follows the activation sharding automatically — replicated activations get a
+replicated mask, mp-sharded activations get per-shard slices of one global
+mask. That makes the tracker semantically a name→seed-stream map, which we
+keep for API parity and for shard_map-manual regions where the distinction
+is real (key folded with the axis index).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict
+
+import jax
+
+from ..framework import random as global_random
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_: Dict[str, jax.Array] = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        self.seeds_.add(seed)
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.states_[name] = jax.random.PRNGKey(seed)
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+    @contextlib.contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        orig = global_random.get_rng_state()
+        global_random.set_rng_state(self.states_[name])
+        try:
+            yield
+        finally:
+            self.states_[name] = global_random.get_rng_state()
+            global_random.set_rng_state(orig)
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _tracker
+
+
+def model_parallel_random_seed(seed=None):
+    import random as pyrandom
+    seed = seed or (pyrandom.randint(0, 10000) + 100)
+    global_seed = seed
+    local_seed = seed + 1024
+    _tracker.reset()
+    global_random.seed(global_seed)
+    _tracker.add(MODEL_PARALLEL_RNG, local_seed)
+
+
+def determinate_seed(rng_name):
+    return global_random.default_seed()
+
+
+def dropout(x, p=0.5, axis=None, rng_name=None, training=True,
+            mode="upscale_in_train", name=None):
+    """mpu.random.dropout — draws from the named tracker stream."""
+    from ..nn import functional as F
+    if rng_name is None:
+        return F.dropout(x, p, axis=axis, training=training, mode=mode)
+    with _tracker.rng_state(rng_name):
+        return F.dropout(x, p, axis=axis, training=training, mode=mode)

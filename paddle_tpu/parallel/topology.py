@@ -1,0 +1,185 @@
+"""Hybrid-parallel topology.
+
+Reference analog: CommunicateTopology + HybridCommunicateGroup
+(python/paddle/distributed/fleet/base/topology.py:54,140). The reference
+builds one NCCL communicator per axis-slice; here each "communicate group"
+is just a named mesh axis — kept as an API-compatible object so fleet-shaped
+user code (hcg.get_model_parallel_world_size() etc.) ports unchanged.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax
+
+from .mesh import build_mesh, set_global_mesh, get_mesh
+from .env import get_rank, get_world_size
+
+
+class CommGroup:
+    """Stand-in for a ProcessGroup: identifies a mesh axis (or axes)."""
+
+    def __init__(self, axis_name, mesh, rank=0, nranks=1):
+        self.axis_name = axis_name
+        self.mesh = mesh
+        self.rank = rank
+        self.nranks = nranks
+        self.id = hash((axis_name, id(mesh))) % (2 ** 31)
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        return rank % self.nranks
+
+    def __repr__(self):
+        return f"CommGroup(axis={self.axis_name}, nranks={self.nranks})"
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "model"),
+                 dims=(1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    def world_size(self):
+        return int(np.prod(self._dims))
+
+
+_AXIS_MAP = {"data": "dp", "sharding": "fsdp", "pipe": "pp", "model": "mp",
+             "sep": "sp", "expert": "ep"}
+
+
+class HybridCommunicateGroup:
+    """Builds the global Mesh from hybrid degrees and exposes the reference's
+    accessor surface (topology.py:140)."""
+
+    def __init__(self, topology: Optional[CommunicateTopology] = None,
+                 dp_degree=1, mp_degree=1, pp_degree=1, sharding_degree=1,
+                 sep_degree=1, order=None):
+        if topology is not None:
+            dims = dict(zip(topology.get_hybrid_group_names(),
+                            topology._dims))
+            dp_degree = dims.get("data", 1)
+            pp_degree = dims.get("pipe", 1)
+            sharding_degree = dims.get("sharding", 1)
+            mp_degree = dims.get("model", 1)
+            sep_degree = dims.get("sep", 1)
+        self._dp_degree = dp_degree
+        self._mp_degree = mp_degree
+        self._pp_degree = pp_degree
+        self._sharding_degree = sharding_degree
+        self._sep_degree = sep_degree
+
+        axes = {}
+        if dp_degree > 1 or True:
+            axes["dp"] = dp_degree
+        if sharding_degree > 1:
+            axes["fsdp"] = sharding_degree
+        if pp_degree > 1:
+            axes["pp"] = pp_degree
+        if sep_degree > 1:
+            axes["sp"] = sep_degree
+        if mp_degree > 1:
+            axes["mp"] = mp_degree
+        total = int(np.prod(list(axes.values())))
+        ndev = jax.device_count()
+        if total > ndev:
+            raise ValueError(
+                f"hybrid degrees {axes} need {total} devices, have {ndev}")
+        self._mesh = build_mesh(axes)
+        set_global_mesh(self._mesh)
+        self.global_rank = get_rank()
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    def _group(self, axis, degree):
+        present = axis in self._mesh.axis_names
+        return CommGroup(axis if present else None, self._mesh,
+                         rank=0, nranks=degree)
+
+    # --- reference accessor surface ---
+    def get_parallel_mode(self):
+        if self._pp_degree > 1:
+            return "pipeline"
+        if self._sharding_degree > 1:
+            return "sharding"
+        if self._mp_degree > 1:
+            return "model"
+        return "data"
+
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_data_parallel_group(self):
+        return self._group("dp", self._dp_degree)
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_model_parallel_group(self):
+        return self._group("mp", self._mp_degree)
+
+    def get_stage_id(self):
+        return 0
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_pipe_parallel_group(self):
+        return self._group("pp", self._pp_degree)
+
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sharding_parallel_group(self):
+        return self._group("fsdp", self._sharding_degree)
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    def get_sep_parallel_group(self):
+        return self._group("sp", self._sep_degree)
+
+    def get_check_parallel_group(self, *a, **k):
+        return self._group(None, 1)
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        return stage_id
+
+    def topology(self):
+        return CommunicateTopology(
+            ("data", "pipe", "sharding", "model"),
+            (self._dp_degree, self._pp_degree, self._sharding_degree,
+             self._mp_degree))
+
+
+_hcg: Optional[HybridCommunicateGroup] = None
+
+
+def set_hybrid_communicate_group(hcg):
+    global _hcg
+    _hcg = hcg
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _hcg

@@ -1,0 +1,21 @@
+"""Regularizers (reference: python/paddle/regularizer.py)."""
+from __future__ import annotations
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __repr__(self):
+        return f"L2Decay({self.coeff})"
+
+
+class L1Decay:
+    """L1 is applied grad-side as coeff*sign(p) by the fused optimizer step."""
+
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+        self.is_l1 = True
+
+    def __repr__(self):
+        return f"L1Decay({self.coeff})"

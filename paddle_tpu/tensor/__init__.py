@@ -1,0 +1,178 @@
+"""paddle_tpu.tensor — the flat tensor-function namespace, plus Tensor method
+monkey-patching (reference: python/paddle/tensor/__init__.py, which patches
+python methods onto the C++ tensor the same way)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.tensor import Tensor, to_tensor
+from ..framework import dtype as dtypes
+from ..ops.creation import *  # noqa: F401,F403
+from ..ops.math import *  # noqa: F401,F403
+from ..ops.manipulation import *  # noqa: F401,F403
+from ..ops.logic import *  # noqa: F401,F403
+from ..ops.search import *  # noqa: F401,F403
+from ..ops.random_ops import *  # noqa: F401,F403
+from ..ops.linalg import (  # noqa: F401
+    norm, vector_norm, matrix_norm, cholesky, cholesky_solve, qr, svd, eigh,
+    eigvalsh, eig, eigvals, inv, inverse, pinv, solve, triangular_solve,
+    lstsq, matrix_power, matrix_rank, slogdet, det, lu, multi_dot,
+    householder_product, corrcoef, cov, cond, matrix_exp)
+from ..ops import math as _math
+from ..ops import manipulation as _manip
+from ..ops import logic as _logic
+from ..ops import search as _search
+from ..ops import creation as _creation
+from ..ops import linalg as _linalg
+from ..ops import random_ops as _random_ops
+from ..ops import indexing as _indexing
+
+
+def _scalar_or_tensor(other):
+    return other
+
+
+def _patch_methods():
+    T = Tensor
+
+    # arithmetic dunders
+    T.__add__ = lambda s, o: _math.add(s, o)
+    T.__radd__ = lambda s, o: _math.add(s, o)
+    T.__sub__ = lambda s, o: _math.subtract(s, o)
+    T.__rsub__ = lambda s, o: _math.subtract(to_tensor(np.asarray(o)) if not isinstance(o, Tensor) else o, s)
+    T.__mul__ = lambda s, o: _math.multiply(s, o)
+    T.__rmul__ = lambda s, o: _math.multiply(s, o)
+    T.__truediv__ = lambda s, o: _math.divide(s, o)
+    T.__rtruediv__ = lambda s, o: _math.divide(to_tensor(np.asarray(o)) if not isinstance(o, Tensor) else o, s)
+    T.__floordiv__ = lambda s, o: _math.floor_divide(s, o)
+    T.__rfloordiv__ = lambda s, o: _math.floor_divide(to_tensor(np.asarray(o)) if not isinstance(o, Tensor) else o, s)
+    T.__mod__ = lambda s, o: _math.remainder(s, o)
+    T.__pow__ = lambda s, o: _math.pow(s, o)
+    T.__rpow__ = lambda s, o: _math.pow(to_tensor(np.asarray(o)) if not isinstance(o, Tensor) else o, s)
+    T.__neg__ = lambda s: _math.neg(s)
+    T.__abs__ = lambda s: _math.abs(s)
+    T.__matmul__ = lambda s, o: _math.matmul(s, o)
+    T.__rmatmul__ = lambda s, o: _math.matmul(o if isinstance(o, Tensor) else to_tensor(np.asarray(o)), s)
+
+    # comparisons
+    T.__eq__ = lambda s, o: _logic.equal(s, o)
+    T.__ne__ = lambda s, o: _logic.not_equal(s, o)
+    T.__lt__ = lambda s, o: _logic.less_than(s, o)
+    T.__le__ = lambda s, o: _logic.less_equal(s, o)
+    T.__gt__ = lambda s, o: _logic.greater_than(s, o)
+    T.__ge__ = lambda s, o: _logic.greater_equal(s, o)
+    T.__invert__ = lambda s: _logic.logical_not(s) if s.dtype == np.bool_ else _logic.bitwise_not(s)
+    T.__and__ = lambda s, o: _logic.logical_and(s, o) if s.dtype == np.bool_ else _logic.bitwise_and(s, o)
+    T.__or__ = lambda s, o: _logic.logical_or(s, o) if s.dtype == np.bool_ else _logic.bitwise_or(s, o)
+    T.__xor__ = lambda s, o: _logic.logical_xor(s, o) if s.dtype == np.bool_ else _logic.bitwise_xor(s, o)
+
+    # indexing
+    T.__getitem__ = lambda s, idx: _indexing.getitem(s, idx)
+    T.__setitem__ = lambda s, idx, v: _indexing.setitem(s, idx, v)
+
+    # method surface — everything the reference patches in
+    method_sources = {
+        "add": _math.add, "subtract": _math.subtract,
+        "multiply": _math.multiply, "divide": _math.divide,
+        "floor_divide": _math.floor_divide, "remainder": _math.remainder,
+        "mod": _math.mod, "pow": _math.pow, "matmul": _math.matmul,
+        "maximum": _math.maximum, "minimum": _math.minimum,
+        "fmax": _math.fmax, "fmin": _math.fmin, "scale": _math.scale,
+        "exp": _math.exp, "log": _math.log, "log2": _math.log2,
+        "log10": _math.log10, "log1p": _math.log1p, "sqrt": _math.sqrt,
+        "rsqrt": _math.rsqrt, "square": _math.square, "abs": _math.abs,
+        "ceil": _math.ceil, "floor": _math.floor, "round": _math.round,
+        "trunc": _math.trunc, "sign": _math.sign, "sin": _math.sin,
+        "cos": _math.cos, "tan": _math.tan, "asin": _math.asin,
+        "acos": _math.acos, "atan": _math.atan, "sinh": _math.sinh,
+        "cosh": _math.cosh, "tanh": _math.tanh, "erf": _math.erf,
+        "erfinv": _math.erfinv, "reciprocal": _math.reciprocal,
+        "neg": _math.neg, "clip": _math.clip, "lerp": _math.lerp,
+        "sum": _math.sum, "mean": _math.mean, "max": _math.max,
+        "min": _math.min, "prod": _math.prod, "amax": _math.amax,
+        "amin": _math.amin, "median": _math.median,
+        "logsumexp": _math.logsumexp, "all": _math.all, "any": _math.any,
+        "var": _math.var, "std": _math.std, "cumsum": _math.cumsum,
+        "cumprod": _math.cumprod, "isnan": _math.isnan,
+        "isinf": _math.isinf, "isfinite": _math.isfinite,
+        "dot": _math.dot, "mm": _math.mm, "bmm": _math.bmm, "mv": _math.mv,
+        "outer": _math.outer, "inner": _math.inner, "cross": _math.cross,
+        "trace": _math.trace, "diagonal": _math.diagonal,
+        "kron": _math.kron, "nan_to_num": _math.nan_to_num,
+        "count_nonzero": _math.count_nonzero,
+        # manipulation
+        "cast": _manip.cast, "astype": _manip.cast,
+        "reshape": _manip.reshape, "reshape_": _manip.reshape_,
+        "transpose": _manip.transpose, "t": _manip.t,
+        "squeeze": _manip.squeeze, "squeeze_": _manip.squeeze_,
+        "unsqueeze": _manip.unsqueeze, "unsqueeze_": _manip.unsqueeze_,
+        "flatten": _manip.flatten, "expand": _manip.expand,
+        "expand_as": _manip.expand_as, "tile": _manip.tile,
+        "broadcast_to": _manip.broadcast_to, "flip": _manip.flip,
+        "roll": _manip.roll, "gather": _manip.gather,
+        "gather_nd": _manip.gather_nd, "scatter": _manip.scatter,
+        "scatter_": _manip.scatter,
+        "index_select": _manip.index_select,
+        "index_sample": _manip.index_sample,
+        "index_add": _manip.index_add,
+        "masked_select": _manip.masked_select,
+        "masked_fill": _manip.masked_fill, "where": _manip.where,
+        "split": _manip.split, "chunk": _manip.chunk,
+        "unbind": _manip.unbind, "nonzero": _manip.nonzero,
+        "take_along_axis": _manip.take_along_axis,
+        "put_along_axis": _manip.put_along_axis,
+        "repeat_interleave": _manip.repeat_interleave,
+        "tensordot": _manip.tensordot,
+        "tril": _creation.tril, "triu": _creation.triu,
+        "diag": _creation.diag,
+        # logic
+        "equal": _logic.equal, "not_equal": _logic.not_equal,
+        "less_than": _logic.less_than, "less_equal": _logic.less_equal,
+        "greater_than": _logic.greater_than,
+        "greater_equal": _logic.greater_equal,
+        "logical_and": _logic.logical_and, "logical_or": _logic.logical_or,
+        "logical_xor": _logic.logical_xor,
+        "logical_not": _logic.logical_not, "isclose": _logic.isclose,
+        "allclose": _logic.allclose, "equal_all": _logic.equal_all,
+        "bitwise_and": _logic.bitwise_and, "bitwise_or": _logic.bitwise_or,
+        "bitwise_xor": _logic.bitwise_xor,
+        "bitwise_not": _logic.bitwise_not,
+        # search
+        "argmax": _search.argmax, "argmin": _search.argmin,
+        "argsort": _search.argsort, "sort": _search.sort,
+        "topk": _search.topk, "kthvalue": _search.kthvalue,
+        "mode": _search.mode,
+        # linalg
+        "norm": _linalg.norm, "cholesky": _linalg.cholesky,
+        "inverse": _linalg.inv, "matrix_power": _linalg.matrix_power,
+        # random in-place
+        "uniform_": _random_ops.uniform_, "normal_": _random_ops.normal_,
+        "exponential_": _random_ops.exponential_,
+    }
+    for name, fn in method_sources.items():
+        setattr(T, name, fn)
+
+    # in-place arithmetic (functional under the hood, like set_value)
+    def _make_inplace(fn):
+        def method(s, o, *a, **k):
+            out = fn(s, o, *a, **k)
+            s._value, s._node, s._out_idx = out._value, out._node, out._out_idx
+            s.stop_gradient = s.stop_gradient and out.stop_gradient
+            return s
+        return method
+
+    T.add_ = _make_inplace(_math.add)
+    T.subtract_ = _make_inplace(_math.subtract)
+    T.multiply_ = _make_inplace(_math.multiply)
+    T.divide_ = _make_inplace(_math.divide)
+    T.scale_ = _make_inplace(_math.scale)
+    T.clip_ = _make_inplace(_math.clip)
+    T.__iadd__ = T.add_
+    T.__isub__ = T.subtract_
+    T.__imul__ = T.multiply_
+    T.__itruediv__ = T.divide_
+    T.fill_ = lambda s, v: s.set_value(np.full(s.shape, v, s.dtype))
+    T.zero_ = lambda s: s.set_value(np.zeros(s.shape, s.dtype))
+
+
+_patch_methods()

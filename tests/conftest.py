@@ -1,0 +1,36 @@
+"""Test harness config.
+
+Mirrors the reference's test strategy (SURVEY.md §4): CPU-hosted, with a
+virtual 8-device mesh for distributed tests
+(xla_force_host_platform_device_count — the TPU-world analog of the
+reference's single-node multi-process CUDA_VISIBLE_DEVICES splitting).
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_PLATFORM_NAME"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+import jax  # noqa: E402
+
+# the environment's TPU plugin overrides JAX_PLATFORMS from the env, so pin
+# the platform through the config API before any backend initializes
+jax.config.update("jax_platforms", "cpu")
+
+# numeric-parity tests compare against float64-ish numpy; XLA's default
+# matmul precision is bf16-based (the TPU/TF32 tradeoff the reference also
+# makes on CUDA) — pin to highest for the test suite.
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture(autouse=True)
+def _seed_all():
+    import paddle_tpu as paddle
+    paddle.seed(2024)
+    np.random.seed(2024)
+    yield

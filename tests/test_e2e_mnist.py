@@ -1,0 +1,151 @@
+"""End-to-end MNIST-style training — BASELINE config 1.
+
+Mirrors the reference's test/book/test_recognize_digits.py: train a small
+MLP + a conv net on synthetic digits, assert the loss drops, and assert
+eager vs to_static parity (the dy2static numeric-parity strategy from
+test/dygraph_to_static/).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as O
+from paddle_tpu.io import DataLoader, Dataset
+
+
+class SynthDigits(Dataset):
+    """Deterministic separable synthetic 'digits' (class-dependent blobs)."""
+
+    def __init__(self, n=256, image=False):
+        rng = np.random.RandomState(0)
+        self.labels = rng.randint(0, 10, n)
+        base = rng.rand(10, 784).astype(np.float32)
+        self.x = (base[self.labels] +
+                  0.1 * rng.randn(n, 784).astype(np.float32))
+        self.image = image
+
+    def __getitem__(self, i):
+        x = self.x[i]
+        if self.image:
+            x = x.reshape(1, 28, 28)
+        return x, np.int64(self.labels[i])
+
+    def __len__(self):
+        return len(self.labels)
+
+
+def build_mlp():
+    return nn.Sequential(nn.Linear(784, 128), nn.ReLU(),
+                         nn.Linear(128, 64), nn.ReLU(),
+                         nn.Linear(64, 10))
+
+
+class TestMNISTTraining:
+    def test_mlp_eager_converges(self):
+        paddle.seed(1)
+        model = build_mlp()
+        opt = O.Adam(learning_rate=1e-3, parameters=model.parameters())
+        loader = DataLoader(SynthDigits(), batch_size=64, shuffle=True)
+        first = last = None
+        for epoch in range(3):
+            for x, y in loader:
+                loss = F.cross_entropy(model(x), y)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                if first is None:
+                    first = float(loss)
+                last = float(loss)
+        assert last < first * 0.5, (first, last)
+
+    def test_conv_net_trains(self):
+        paddle.seed(1)
+        model = nn.Sequential(
+            nn.Conv2D(1, 8, 3, padding=1), nn.ReLU(), nn.MaxPool2D(2),
+            nn.Conv2D(8, 16, 3, padding=1), nn.ReLU(), nn.MaxPool2D(2),
+            nn.Flatten(), nn.Linear(16 * 7 * 7, 10))
+        opt = O.Adam(learning_rate=1e-3, parameters=model.parameters())
+        loader = DataLoader(SynthDigits(n=128, image=True), batch_size=32)
+        losses = []
+        for x, y in loader:
+            loss = F.cross_entropy(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        for x, y in loader:
+            loss = F.cross_entropy(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_eager_vs_jit_parity(self):
+        """dy2static parity: same weights, same data → same loss/grads."""
+        paddle.seed(3)
+        model = build_mlp()
+        model.eval()
+        x = paddle.randn([8, 784])
+        y = paddle.randint(0, 10, [8])
+
+        loss_eager = F.cross_entropy(model(x), y)
+        static_forward = paddle.jit.to_static(model.forward)
+        loss_jit = F.cross_entropy(static_forward(x), y)
+        np.testing.assert_allclose(float(loss_eager), float(loss_jit),
+                                   rtol=1e-5)
+
+        loss_eager.backward()
+        g_eager = model[0].weight.grad.numpy().copy()
+        model[0].weight.clear_grad()
+        loss_jit.backward()
+        g_jit = model[0].weight.grad.numpy()
+        np.testing.assert_allclose(g_eager, g_jit, rtol=1e-4, atol=1e-6)
+
+    def test_jit_compiled_train_step(self):
+        """Whole train step (fwd+bwd-able graph) as one compiled fn."""
+        paddle.seed(4)
+        model = build_mlp()
+        opt = O.Adam(learning_rate=1e-3, parameters=model.parameters())
+
+        def loss_fn(x, y):
+            return F.cross_entropy(model(x), y)
+        compiled = paddle.jit.to_static(loss_fn)
+        data = SynthDigits(n=128)
+        loader = DataLoader(data, batch_size=64)
+        losses = []
+        for _ in range(4):
+            for x, y in loader:
+                loss = compiled(x, y)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.7
+        # only two specializations should exist (full + remainder batch)
+        assert len(compiled.program_cache) <= 2
+
+    def test_jit_save_load_inference(self, tmp_path):
+        paddle.seed(5)
+        model = build_mlp()
+        model.eval()
+        x = paddle.randn([2, 784])
+        expect = model(x).numpy()
+        path = str(tmp_path / "mnist_model")
+        paddle.jit.save(model, path,
+                        input_spec=[paddle.jit.InputSpec([2, 784],
+                                                         "float32")])
+        loaded = paddle.jit.load(path)
+        got = loaded(x).numpy()
+        np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+
+    def test_dataloader_shapes(self):
+        loader = DataLoader(SynthDigits(n=10), batch_size=4, drop_last=True,
+                            num_workers=2)
+        batches = list(loader)
+        assert len(batches) == 2
+        x, y = batches[0]
+        assert x.shape == [4, 784]
+        assert y.dtype == np.int32 or y.dtype == np.int64

@@ -1,0 +1,309 @@
+"""Layer-system tests (reference: test/legacy_test layer tests +
+test/dygraph_to_static parity tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class TestLayerSystem:
+    def test_parameters_and_state_dict(self):
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        assert len(m.parameters()) == 4
+        sd = m.state_dict()
+        assert set(sd) == {"0.weight", "0.bias", "2.weight", "2.bias"}
+        m2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        m2.set_state_dict(sd)
+        np.testing.assert_array_equal(m2[0].weight.numpy(),
+                                      m[0].weight.numpy())
+
+    def test_train_eval_mode(self):
+        m = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5))
+        m.eval()
+        assert not m[1].training
+        x = paddle.randn([8, 4])
+        np.testing.assert_array_equal(m(x).numpy(), m(x).numpy())
+        m.train()
+        assert m[1].training
+
+    def test_hooks(self):
+        lin = nn.Linear(2, 2)
+        calls = []
+        h = lin.register_forward_post_hook(
+            lambda layer, inp, out: calls.append(1))
+        lin(paddle.randn([1, 2]))
+        assert calls == [1]
+        h.remove()
+        lin(paddle.randn([1, 2]))
+        assert calls == [1]
+
+    def test_buffers(self):
+        bn = nn.BatchNorm1D(3)
+        names = [n for n, _ in bn.named_buffers()]
+        assert "_mean" in names and "_variance" in names
+        sd = bn.state_dict()
+        assert "_mean" in sd
+
+    def test_layerlist_dict(self):
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        assert len(ll) == 3
+        ll.append(nn.Linear(2, 2))
+        assert len(list(ll.parameters())) == 8
+        ld = nn.LayerDict({"a": nn.Linear(2, 2)})
+        assert "a" in ld
+
+    def test_apply_and_to_dtype(self):
+        m = nn.Linear(2, 2)
+        m.to(dtype="bfloat16")
+        assert m.weight.dtype == paddle.bfloat16
+
+
+class TestCoreLayersNumeric:
+    def test_linear_matches_numpy(self):
+        lin = nn.Linear(3, 4)
+        x = np.random.rand(5, 3).astype(np.float32)
+        out = lin(paddle.to_tensor(x)).numpy()
+        expect = x @ lin.weight.numpy() + lin.bias.numpy()
+        np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        idx = paddle.to_tensor(np.array([[1, 0, 3]]))
+        out = emb(idx)
+        assert out.shape == [1, 3, 4]
+        np.testing.assert_array_equal(out.numpy()[0, 1], np.zeros(4))
+
+    def test_layernorm_matches_numpy(self):
+        ln = nn.LayerNorm(8)
+        x = np.random.rand(2, 5, 8).astype(np.float32)
+        out = ln(paddle.to_tensor(x)).numpy()
+        mu = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        expect = (x - mu) / np.sqrt(var + 1e-5)
+        np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-4)
+
+    def test_batchnorm_train_updates_stats(self):
+        bn = nn.BatchNorm1D(3, data_format="NCL")
+        x = paddle.randn([4, 3, 5]) * 2 + 1
+        bn.train()
+        bn(x)
+        assert not np.allclose(bn._mean.numpy(), 0)
+        bn.eval()
+        y1 = bn(x).numpy()
+        y2 = bn(x).numpy()
+        np.testing.assert_array_equal(y1, y2)
+
+    def test_conv2d_matches_scipy(self):
+        from scipy.signal import correlate
+        conv = nn.Conv2D(1, 1, 3, bias_attr=False)
+        x = np.random.rand(1, 1, 6, 6).astype(np.float32)
+        out = conv(paddle.to_tensor(x)).numpy()
+        w = conv.weight.numpy()[0, 0]
+        expect = correlate(x[0, 0], w, mode="valid")
+        np.testing.assert_allclose(out[0, 0], expect, rtol=1e-4, atol=1e-5)
+
+    def test_conv2d_transpose_shape(self):
+        deconv = nn.Conv2DTranspose(3, 6, 4, stride=2, padding=1)
+        x = paddle.randn([2, 3, 8, 8])
+        assert deconv(x).shape == [2, 6, 16, 16]
+
+    def test_grouped_conv(self):
+        conv = nn.Conv2D(4, 8, 3, groups=2, padding=1)
+        assert conv(paddle.randn([1, 4, 5, 5])).shape == [1, 8, 5, 5]
+
+    def test_pool(self):
+        x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(
+            1, 1, 4, 4))
+        out = F.max_pool2d(x, 2)
+        np.testing.assert_array_equal(out.numpy()[0, 0],
+                                      [[5, 7], [13, 15]])
+        out = F.avg_pool2d(x, 2)
+        np.testing.assert_allclose(out.numpy()[0, 0],
+                                   [[2.5, 4.5], [10.5, 12.5]])
+        out = F.adaptive_avg_pool2d(x, 1)
+        np.testing.assert_allclose(out.numpy()[0, 0], [[7.5]])
+
+    def test_dropout_scaling(self):
+        x = paddle.ones([1000])
+        out = F.dropout(x, 0.5, training=True)
+        kept = out.numpy()
+        assert set(np.round(np.unique(kept), 3)).issubset({0.0, 2.0})
+        out_eval = F.dropout(x, 0.5, training=False)
+        np.testing.assert_array_equal(out_eval.numpy(), x.numpy())
+
+    def test_softmax_cross_entropy_matches_numpy(self):
+        logits = np.random.rand(4, 5).astype(np.float32)
+        labels = np.array([0, 2, 4, 1])
+        loss = F.cross_entropy(paddle.to_tensor(logits),
+                               paddle.to_tensor(labels)).numpy()
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        expect = -np.log(p[np.arange(4), labels]).mean()
+        np.testing.assert_allclose(loss, expect, rtol=1e-5)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = np.random.rand(4, 5).astype(np.float32)
+        labels = np.array([0, -100, 4, -100])
+        loss = F.cross_entropy(paddle.to_tensor(logits),
+                               paddle.to_tensor(labels),
+                               ignore_index=-100).numpy()
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        expect = -np.log(p[[0, 2], [0, 4]]).mean()
+        np.testing.assert_allclose(loss, expect, rtol=1e-5)
+
+    def test_soft_label_cross_entropy(self):
+        logits = np.random.rand(3, 4).astype(np.float32)
+        soft = np.float32([[0.7, 0.1, 0.1, 0.1]] * 3)
+        loss = F.cross_entropy(paddle.to_tensor(logits),
+                               paddle.to_tensor(soft),
+                               soft_label=True).numpy()
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        logp = np.log(e / e.sum(-1, keepdims=True))
+        np.testing.assert_allclose(loss, -(soft * logp).sum(-1).mean(),
+                                   rtol=1e-5)
+
+    def test_attention_matches_dense(self):
+        q = np.random.rand(2, 6, 4, 8).astype(np.float32)
+        out, _ = F.flash_attention(paddle.to_tensor(q), paddle.to_tensor(q),
+                                   paddle.to_tensor(q), causal=True)
+        ref = F.scaled_dot_product_attention(
+            paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
+            is_causal=True)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_mha_shapes(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = paddle.randn([2, 5, 16])
+        assert mha(x).shape == [2, 5, 16]
+
+    def test_transformer_full(self):
+        model = nn.Transformer(d_model=16, nhead=2, num_encoder_layers=1,
+                               num_decoder_layers=1, dim_feedforward=32)
+        src = paddle.randn([2, 4, 16])
+        tgt = paddle.randn([2, 3, 16])
+        assert model(src, tgt).shape == [2, 3, 16]
+
+    def test_lstm_gradients(self):
+        lstm = nn.LSTM(4, 8)
+        x = paddle.randn([2, 5, 4])
+        out, _ = lstm(x)
+        out.sum().backward()
+        assert lstm.weight_ih_l0.grad is not None
+
+    def test_rnn_cell_wrapper_matches_scan_lstm(self):
+        paddle.seed(7)
+        cell = nn.LSTMCell(3, 5)
+        rnn = nn.RNN(cell)
+        x = paddle.randn([2, 4, 3])
+        out, (h, c) = rnn(x)
+        assert out.shape == [2, 4, 5]
+        assert h.shape == [2, 5]
+
+
+class TestOptimizers:
+    def _quadratic_converges(self, opt_cls, **kwargs):
+        w = paddle.to_tensor(np.float32([5.0, -3.0]), stop_gradient=False)
+        from paddle_tpu.nn.parameter import Parameter
+        p = Parameter(w._value)
+        opt = opt_cls(parameters=[p], **kwargs)
+        for _ in range(80):
+            loss = (p * p).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert float((p * p).sum()) < 1e-2, opt_cls.__name__
+
+    def test_sgd(self):
+        import paddle_tpu.optimizer as O
+        self._quadratic_converges(O.SGD, learning_rate=0.1)
+
+    def test_momentum(self):
+        import paddle_tpu.optimizer as O
+        self._quadratic_converges(O.Momentum, learning_rate=0.05)
+
+    def test_adam(self):
+        import paddle_tpu.optimizer as O
+        self._quadratic_converges(O.Adam, learning_rate=0.1)
+
+    def test_adamw_decay(self):
+        import paddle_tpu.optimizer as O
+        self._quadratic_converges(O.AdamW, learning_rate=0.1,
+                                  weight_decay=0.01)
+
+    def test_others_run(self):
+        import paddle_tpu.optimizer as O
+        for cls, kw in [(O.RMSProp, {"learning_rate": 0.05}),
+                        (O.Adagrad, {"learning_rate": 0.5}),
+                        (O.Adadelta, {"learning_rate": 1.0}),
+                        (O.Adamax, {"learning_rate": 0.1}),
+                        (O.Lamb, {"learning_rate": 0.1})]:
+            self._quadratic_converges(cls, **kw)
+
+    def test_grad_clip_global_norm(self):
+        import paddle_tpu.optimizer as O
+        from paddle_tpu.nn.parameter import Parameter
+        p = Parameter(np.float32([10.0]))
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        opt = O.SGD(learning_rate=1.0, parameters=[p], grad_clip=clip)
+        (p * 100).sum().backward()
+        opt.step()
+        # grad 100 clipped to norm 1 → p = 10 - 1
+        np.testing.assert_allclose(p.numpy(), [9.0], rtol=1e-5)
+
+    def test_lr_scheduler(self):
+        import paddle_tpu.optimizer as O
+        from paddle_tpu.nn.parameter import Parameter
+        sched = O.lr.StepDecay(learning_rate=0.1, step_size=2, gamma=0.5)
+        p = Parameter(np.float32([1.0]))
+        opt = O.SGD(learning_rate=sched, parameters=[p])
+        assert abs(opt.get_lr() - 0.1) < 1e-9
+        sched.step()
+        sched.step()
+        assert abs(opt.get_lr() - 0.05) < 1e-9
+
+    def test_optimizer_state_dict_roundtrip(self):
+        import paddle_tpu.optimizer as O
+        from paddle_tpu.nn.parameter import Parameter
+        p = Parameter(np.float32([1.0, 2.0]))
+        opt = O.Adam(parameters=[p], learning_rate=0.1)
+        (p * p).sum().backward()
+        opt.step()
+        sd = opt.state_dict()
+        opt2 = O.Adam(parameters=[p], learning_rate=0.1)
+        opt2.set_state_dict(sd)
+        np.testing.assert_array_equal(
+            opt2._state[id(p)]["moment1"], opt._state[id(p)]["moment1"])
+
+
+class TestAmp:
+    def test_autocast_bf16_matmul(self):
+        x = paddle.randn([4, 4])
+        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+            y = paddle.matmul(x, x)
+        assert y.dtype == paddle.bfloat16
+        # black-listed op stays fp32
+        with paddle.amp.auto_cast(level="O1"):
+            s = F.softmax(x)
+        assert s.dtype == np.float32
+
+    def test_grad_scaler_noop_path(self):
+        scaler = paddle.amp.GradScaler(enable=False)
+        loss = paddle.to_tensor(np.float32(2.0))
+        assert float(scaler.scale(loss)) == 2.0
+
+    def test_grad_scaler_dynamic(self):
+        import paddle_tpu.optimizer as O
+        from paddle_tpu.nn.parameter import Parameter
+        p = Parameter(np.float32([1.0]))
+        opt = O.SGD(learning_rate=0.1, parameters=[p])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=2.0)
+        loss = (p * p).sum()
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        scaler.step(opt)
+        scaler.update()
+        np.testing.assert_allclose(p.numpy(), [0.8], rtol=1e-6)

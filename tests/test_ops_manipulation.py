@@ -1,0 +1,222 @@
+"""Manipulation / indexing / search op parity tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+A = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+
+
+class TestShapeOps:
+    def test_reshape_transpose(self):
+        t = paddle.to_tensor(A)
+        np.testing.assert_array_equal(t.reshape([6, 4]).numpy(),
+                                      A.reshape(6, 4))
+        np.testing.assert_array_equal(t.reshape([-1]).numpy(), A.reshape(-1))
+        np.testing.assert_array_equal(
+            t.transpose([2, 0, 1]).numpy(), A.transpose(2, 0, 1))
+
+    def test_concat_stack_split(self):
+        t = paddle.to_tensor(A)
+        c = paddle.concat([t, t], axis=1)
+        np.testing.assert_array_equal(c.numpy(),
+                                      np.concatenate([A, A], axis=1))
+        s = paddle.stack([t, t], axis=0)
+        np.testing.assert_array_equal(s.numpy(), np.stack([A, A]))
+        parts = paddle.split(t, 3, axis=1)
+        assert len(parts) == 3
+        np.testing.assert_array_equal(parts[1].numpy(), A[:, 1:2])
+        parts = paddle.split(t, [1, 3], axis=2)
+        assert parts[1].shape == [2, 3, 3]
+        parts = paddle.split(t, [1, -1], axis=2)
+        assert parts[1].shape == [2, 3, 3]
+
+    def test_squeeze_unsqueeze_flatten(self):
+        t = paddle.to_tensor(A[None])
+        assert t.squeeze(0).shape == [2, 3, 4]
+        assert t.squeeze().shape == [2, 3, 4]
+        assert paddle.to_tensor(A).unsqueeze(1).shape == [2, 1, 3, 4]
+        assert paddle.to_tensor(A).unsqueeze([0, -1]).shape == [1, 2, 3, 4, 1]
+        assert paddle.flatten(paddle.to_tensor(A), 1).shape == [2, 12]
+
+    def test_expand_tile(self):
+        t = paddle.to_tensor(np.float32([[1], [2]]))
+        assert paddle.expand(t, [2, 3]).shape == [2, 3]
+        assert paddle.tile(t, [2, 2]).shape == [4, 2]
+        assert paddle.broadcast_to(t, [4, 2, 3]).shape == [4, 2, 3]
+
+    def test_gather_scatter(self):
+        t = paddle.to_tensor(A)
+        idx = paddle.to_tensor(np.array([0, 2]))
+        np.testing.assert_array_equal(
+            paddle.gather(t, idx, axis=1).numpy(), A[:, [0, 2]])
+        base = paddle.zeros([4, 3])
+        upd = paddle.to_tensor(np.ones((2, 3), np.float32))
+        out = paddle.scatter(base, paddle.to_tensor(np.array([1, 3])), upd)
+        expect = np.zeros((4, 3), np.float32)
+        expect[[1, 3]] = 1
+        np.testing.assert_array_equal(out.numpy(), expect)
+
+    def test_gather_nd(self):
+        t = paddle.to_tensor(A)
+        idx = paddle.to_tensor(np.array([[0, 1], [1, 2]]))
+        np.testing.assert_array_equal(paddle.gather_nd(t, idx).numpy(),
+                                      A[[0, 1], [1, 2]])
+
+    def test_where(self):
+        x = paddle.to_tensor(np.float32([1, -1, 2]))
+        y = paddle.zeros([3])
+        out = paddle.where(x > 0, x, y)
+        np.testing.assert_array_equal(out.numpy(), [1, 0, 2])
+
+    def test_pad(self):
+        t = paddle.to_tensor(np.ones((1, 1, 2, 2), np.float32))
+        out = paddle.nn.functional.pad(t, [1, 1, 0, 2])
+        assert out.shape == [1, 1, 4, 4]  # t/b=0,2 on H? paddle: last dim l,r
+
+    def test_flip_roll(self):
+        t = paddle.to_tensor(A)
+        np.testing.assert_array_equal(paddle.flip(t, [0]).numpy(),
+                                      np.flip(A, 0))
+        np.testing.assert_array_equal(paddle.roll(t, 1, 0).numpy(),
+                                      np.roll(A, 1, 0))
+
+    def test_cast(self):
+        t = paddle.to_tensor(A)
+        assert t.astype("int32").dtype == np.int32
+        assert paddle.cast(t, "bool").dtype == np.bool_
+
+
+class TestIndexing:
+    def test_basic(self):
+        t = paddle.to_tensor(A)
+        np.testing.assert_array_equal(t[0].numpy(), A[0])
+        np.testing.assert_array_equal(t[0, 1].numpy(), A[0, 1])
+        np.testing.assert_array_equal(t[:, 1:, ::2].numpy(), A[:, 1:, ::2])
+        np.testing.assert_array_equal(t[..., -1].numpy(), A[..., -1])
+        np.testing.assert_array_equal(t[None].numpy(), A[None])
+
+    def test_tensor_index(self):
+        t = paddle.to_tensor(A)
+        idx = paddle.to_tensor(np.array([1, 0]))
+        np.testing.assert_array_equal(t[idx].numpy(), A[[1, 0]])
+
+    def test_bool_mask(self):
+        t = paddle.to_tensor(np.float32([1, -2, 3, -4]))
+        out = t[t > 0]
+        np.testing.assert_array_equal(out.numpy(), [1, 3])
+
+    def test_setitem(self):
+        t = paddle.to_tensor(A.copy())
+        t[0, 0] = 99.0
+        assert t.numpy()[0, 0, 0] == 99.0
+        t[:, 1] = 0.0
+        assert t.numpy()[:, 1].sum() == 0
+
+    def test_setitem_grad(self):
+        x = paddle.to_tensor(A.copy(), stop_gradient=False)
+        y = x * 2.0
+        y[0] = 0.0
+        y.sum().backward()
+        expect = np.full_like(A, 2.0)
+        expect[0] = 0.0
+        np.testing.assert_allclose(x.grad.numpy(), expect)
+
+
+class TestSearch:
+    def test_argmax_sort_topk(self):
+        t = paddle.to_tensor(A)
+        np.testing.assert_array_equal(paddle.argmax(t, axis=2).numpy(),
+                                      np.argmax(A, axis=2))
+        np.testing.assert_array_equal(paddle.sort(t, axis=1).numpy(),
+                                      np.sort(A, axis=1))
+        vals, idx = paddle.topk(paddle.to_tensor(np.float32([3, 1, 4, 1, 5])),
+                                2)
+        np.testing.assert_array_equal(vals.numpy(), [5, 4])
+        np.testing.assert_array_equal(idx.numpy(), [4, 2])
+
+    def test_unique(self):
+        out = paddle.unique(paddle.to_tensor(np.array([3, 1, 2, 1, 3])))
+        np.testing.assert_array_equal(out.numpy(), [1, 2, 3])
+
+    def test_masked_select_nonzero(self):
+        t = paddle.to_tensor(np.float32([1, -2, 3]))
+        np.testing.assert_array_equal(
+            paddle.masked_select(t, t > 0).numpy(), [1, 3])
+        nz = paddle.nonzero(t > 0)
+        np.testing.assert_array_equal(nz.numpy(), [[0], [2]])
+
+
+class TestLogic:
+    def test_comparisons(self):
+        x = paddle.to_tensor(np.float32([1, 2, 3]))
+        y = paddle.to_tensor(np.float32([2, 2, 2]))
+        np.testing.assert_array_equal((x < y).numpy(), [True, False, False])
+        np.testing.assert_array_equal((x == y).numpy(), [False, True, False])
+        assert paddle.allclose(x, x).item()
+        assert not paddle.equal_all(x, y).item()
+
+    def test_logical(self):
+        a = paddle.to_tensor(np.array([True, False]))
+        b = paddle.to_tensor(np.array([True, True]))
+        np.testing.assert_array_equal(paddle.logical_and(a, b).numpy(),
+                                      [True, False])
+        np.testing.assert_array_equal((~a).numpy(), [False, True])
+
+
+class TestLinalg:
+    def test_solve_inv_det(self):
+        m = np.float32([[4, 1], [2, 3]])
+        t = paddle.to_tensor(m)
+        np.testing.assert_allclose(paddle.linalg.inv(t).numpy(),
+                                   np.linalg.inv(m), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(paddle.linalg.det(t).numpy(),
+                                   np.linalg.det(m), rtol=1e-5)
+        b = np.float32([1, 2])
+        np.testing.assert_allclose(
+            paddle.linalg.solve(t, paddle.to_tensor(b)).numpy(),
+            np.linalg.solve(m, b), rtol=1e-4, atol=1e-5)
+
+    def test_svd_qr_cholesky(self):
+        m = np.random.rand(4, 3).astype(np.float32)
+        u, s, v = paddle.linalg.svd(paddle.to_tensor(m))
+        np.testing.assert_allclose(
+            (u.numpy() * s.numpy()) @ v.numpy().T, m, atol=1e-4)
+        q, r = paddle.linalg.qr(paddle.to_tensor(m))
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), m, atol=1e-4)
+        spd = m.T @ m + 3 * np.eye(3, dtype=np.float32)
+        L = paddle.linalg.cholesky(paddle.to_tensor(spd))
+        np.testing.assert_allclose(L.numpy() @ L.numpy().T, spd, atol=1e-4)
+
+    def test_norm_einsum(self):
+        m = np.random.rand(3, 4).astype(np.float32)
+        np.testing.assert_allclose(paddle.linalg.norm(
+            paddle.to_tensor(m)).numpy(), np.linalg.norm(m), rtol=1e-5)
+        out = paddle.einsum("ij,kj->ik", paddle.to_tensor(m),
+                            paddle.to_tensor(m))
+        np.testing.assert_allclose(out.numpy(), m @ m.T, rtol=1e-5)
+
+
+class TestCreation:
+    def test_factories(self):
+        assert paddle.zeros([2, 3]).numpy().sum() == 0
+        assert paddle.ones([2, 3]).numpy().sum() == 6
+        assert paddle.full([2], 7, "int32").numpy().tolist() == [7, 7]
+        np.testing.assert_array_equal(paddle.arange(5).numpy(),
+                                      np.arange(5))
+        np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(),
+                                   np.linspace(0, 1, 5))
+        np.testing.assert_array_equal(paddle.eye(3).numpy(), np.eye(3))
+        t = paddle.to_tensor(A[0])
+        np.testing.assert_array_equal(paddle.tril(t).numpy(), np.tril(A[0]))
+
+    def test_random_determinism(self):
+        paddle.seed(42)
+        a = paddle.rand([4]).numpy()
+        paddle.seed(42)
+        b = paddle.rand([4]).numpy()
+        np.testing.assert_array_equal(a, b)
+        c = paddle.randint(0, 10, [100]).numpy()
+        assert c.min() >= 0 and c.max() < 10
+        p = paddle.randperm(10).numpy()
+        assert sorted(p.tolist()) == list(range(10))
