@@ -82,6 +82,22 @@ class enable_grad:
         return False
 
 
+class Edge:
+    """Frozen producer reference, snapshotted at record time (the reference's
+    TensorWrapper role, paddle/fluid/eager/tensor_wrapper.h): later in-place
+    rebinding of the live tensor (setitem/reshape_/increment) can neither
+    create tape cycles nor corrupt graphs recorded earlier. ``target`` keeps
+    the live tensor for leaf-grad accumulation and hooks."""
+
+    __slots__ = ("node", "out_idx", "stop_gradient", "target")
+
+    def __init__(self, t):
+        self.node = t._node
+        self.out_idx = t._out_idx
+        self.stop_gradient = t.stop_gradient
+        self.target = t
+
+
 class TapeNode:
     """One recorded op application (GradNodeBase analog).
 
@@ -100,7 +116,7 @@ class TapeNode:
         self.name = name
         self.closure = closure
         self.saved_vals = saved_vals
-        self.inputs = list(inputs)          # Tensor refs (edges)
+        self.inputs = [e if isinstance(e, Edge) else Edge(e) for e in inputs]
         self.diff_in_mask = list(diff_in_mask)
         self.diff_out_mask = list(diff_out_mask)
         self.out_avals = list(out_avals)    # (shape, dtype) per output
@@ -244,8 +260,8 @@ def run_backward(tensors: Sequence, grad_tensors: Sequence,
     seen = set(nodes.keys())
     while pending:
         node = pending.pop()
-        for inp in (node.inputs or []):
-            pn = inp._node
+        for edge in (node.inputs or []):
+            pn = edge.node
             if pn is not None and pn.id not in seen:
                 seen.add(pn.id)
                 nodes[pn.id] = pn
@@ -264,15 +280,15 @@ def run_backward(tensors: Sequence, grad_tensors: Sequence,
             continue
         in_grads = node.vjp(out_grads)
         processed.append(node)
-        for inp, g in zip(node.inputs, in_grads):
-            if g is None or inp.stop_gradient:
+        for edge, g in zip(node.inputs, in_grads):
+            if g is None or edge.stop_gradient:
                 continue
-            pn = inp._node
+            pn = edge.node
             if pn is None:
-                _accumulate(inp, g, leaf_accum)
+                _accumulate(edge.target, g, leaf_accum)
             else:
                 h = holders.setdefault(pn.id, [None] * len(pn.out_avals))
-                idx = inp._out_idx
+                idx = edge.out_idx
                 h[idx] = g if h[idx] is None else h[idx] + g
                 if pn.id not in in_heap:
                     heapq.heappush(heap, -pn.id)

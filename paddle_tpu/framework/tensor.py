@@ -251,3 +251,19 @@ def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
     if place is not None and isinstance(place, Place):
         v = jax.device_put(v, place.jax_device)
     return Tensor(v, stop_gradient=stop_gradient)
+
+
+def inplace_rebind(x: Tensor, out: Tensor) -> Tensor:
+    """Give `x` the value/lineage of `out` (in-place op semantics, e.g.
+    set_value / increment / reshape_).
+
+    Tape edges are frozen at record time (autograd.Edge), so rebinding the
+    live tensor can neither create cycles nor corrupt graphs recorded before
+    the mutation — an earlier `y = f(x)` still backprops to the pre-mutation
+    x. `stop_gradient` is preserved: in-place assignment into a frozen tensor
+    does not make it start recording (matches the reference's set_value).
+    """
+    x._value = out._value
+    x._node = out._node
+    x._out_idx = out._out_idx
+    return x

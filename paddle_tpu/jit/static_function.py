@@ -105,7 +105,10 @@ class StaticFunction:
         self._input_spec = input_spec
         self._remat = remat
         self._cache: Dict[Tuple, ConcreteProgram] = {}
-        self._name = getattr(fn, "__name__", f"sfn{next(_fn_counter)}")
+        # A process-unique id keeps dispatch-cache keys distinct even when
+        # two StaticFunctions wrap same-named fns (e.g. two "<lambda>"s).
+        self._uid = next(_fn_counter)
+        self._name = getattr(fn, "__name__", "sfn") + f"_{self._uid}"
         self.__name__ = self._name
         self._layer = getattr(fn, "__self__", None)
 

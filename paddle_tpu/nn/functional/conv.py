@@ -100,15 +100,18 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
 def _conv_transpose_nd(x, w, bias, stride, padding, output_padding, dilation,
                        groups, nd, data_format):
     chan_first = data_format.startswith("NC")
-    # paddle weight layout for transpose conv: [in, out/groups, *k]
+    # paddle weight layout for transpose conv: [in, out/groups, *k].
+    # Express as a forward conv on the stride-dilated input: flip the kernel
+    # spatially and swap its channel axes to [out/groups, in, *k] (OI layout).
     if nd == 1:
-        spec = ("NCH", "IOH", "NCH") if chan_first else ("NHC", "IOH", "NHC")
+        spec = ("NCH", "OIH", "NCH") if chan_first else ("NHC", "OIH", "NHC")
     elif nd == 2:
-        spec = ("NCHW", "IOHW", "NCHW") if chan_first else \
-            ("NHWC", "IOHW", "NHWC")
+        spec = ("NCHW", "OIHW", "NCHW") if chan_first else \
+            ("NHWC", "OIHW", "NHWC")
     else:
-        spec = ("NCDHW", "IODHW", "NCDHW") if chan_first else \
-            ("NDHWC", "IODHW", "NDHWC")
+        spec = ("NCDHW", "OIDHW", "NCDHW") if chan_first else \
+            ("NDHWC", "OIDHW", "NDHWC")
+    w = jnp.swapaxes(jnp.flip(w, axis=tuple(range(2, 2 + nd))), 0, 1)
     dn = jax.lax.conv_dimension_numbers(x.shape, w.shape, spec)
     if isinstance(padding, str):
         pad = padding
@@ -124,18 +127,17 @@ def _conv_transpose_nd(x, w, bias, stride, padding, output_padding, dilation,
         pad = tuple(pad)
     if groups > 1:
         xs = jnp.split(x, groups, axis=1 if chan_first else -1)
-        ws = jnp.split(w, groups, axis=0)
+        ws = jnp.split(w, groups, axis=1)
         outs = [jax.lax.conv_general_dilated(
             xg, wg, window_strides=(1,) * nd, padding=pad,
             lhs_dilation=stride, rhs_dilation=dilation,
-            dimension_numbers=dn,
-            transpose_kernel=True) for xg, wg in zip(xs, ws)]
+            dimension_numbers=dn) for xg, wg in zip(xs, ws)]
         out = jnp.concatenate(outs, axis=1 if chan_first else -1)
     else:
         out = jax.lax.conv_general_dilated(
             x, w, window_strides=(1,) * nd, padding=pad,
             lhs_dilation=stride, rhs_dilation=dilation,
-            dimension_numbers=dn, transpose_kernel=True)
+            dimension_numbers=dn)
     if bias is not None:
         bshape = [1] * out.ndim
         bshape[1 if chan_first else -1] = bias.shape[0]

@@ -12,7 +12,7 @@ import jax.numpy as jnp
 
 from ..framework import dtype as dtypes
 from ..framework.dispatch import defop, apply
-from ..framework.tensor import Tensor, to_tensor
+from ..framework.tensor import Tensor, to_tensor, inplace_rebind
 from ..framework import random as _random
 
 
@@ -183,9 +183,7 @@ def assign(x, output=None):
         x = to_tensor(np.asarray(x))
     out = _assign(x)
     if output is not None:
-        output._value = out._value
-        output._node = out._node
-        output._out_idx = out._out_idx
+        inplace_rebind(output, out)
         output.stop_gradient = out.stop_gradient
         return output
     return out

@@ -13,7 +13,7 @@ import jax
 import jax.numpy as jnp
 
 from ..framework.dispatch import apply
-from ..framework.tensor import Tensor, to_tensor
+from ..framework.tensor import Tensor, to_tensor, inplace_rebind
 
 
 def _normalize(index):
@@ -107,8 +107,4 @@ def setitem(x: Tensor, index, value):
         value = to_tensor(np.asarray(value))
     out = apply("setitem", _fn, x, *tensors, value, pattern=pattern)
     # in-place semantics with tape-correct lineage (like the set_value op)
-    x._value = out._value
-    x._node = out._node
-    x._out_idx = out._out_idx
-    x.stop_gradient = out.stop_gradient if not x.stop_gradient else x.stop_gradient
-    return x
+    return inplace_rebind(x, out)

@@ -14,7 +14,7 @@ import jax.numpy as jnp
 
 from ..framework import dtype as dtypes
 from ..framework.dispatch import defop, apply
-from ..framework.tensor import Tensor
+from ..framework.tensor import Tensor, inplace_rebind
 
 
 def _ints(v):
@@ -47,9 +47,7 @@ def reshape(x, shape, name=None):
 
 
 def reshape_(x, shape, name=None):
-    out = reshape(x, shape)
-    x._value, x._node, x._out_idx = out._value, out._node, out._out_idx
-    return x
+    return inplace_rebind(x, reshape(x, shape))
 
 
 @defop("transpose")
@@ -150,16 +148,17 @@ def squeeze(x, axis=None, name=None):
 
 
 def squeeze_(x, axis=None, name=None):
-    out = squeeze(x, axis)
-    x._value, x._node, x._out_idx = out._value, out._node, out._out_idx
-    return x
+    return inplace_rebind(x, squeeze(x, axis))
 
 
 @defop("unsqueeze")
 def _unsqueeze(x, axis):
     axes = axis if isinstance(axis, tuple) else (axis,)
-    for a in sorted(a if a >= 0 else a + x.ndim + 1 for a in axes):
-        x = jnp.expand_dims(x, a)
+    # sequential insertion with the rank growing per axis — negative axes are
+    # relative to the rank-so-far +1, and repeated axes are legal (reference:
+    # GetUnsqueezeShape, paddle/phi/kernels/funcs/unsqueeze.h:106)
+    for a in axes:
+        x = jnp.expand_dims(x, a if a >= 0 else a + x.ndim + 1)
     return x
 
 
@@ -168,9 +167,7 @@ def unsqueeze(x, axis, name=None):
 
 
 def unsqueeze_(x, axis, name=None):
-    out = unsqueeze(x, axis)
-    x._value, x._node, x._out_idx = out._value, out._node, out._out_idx
-    return x
+    return inplace_rebind(x, unsqueeze(x, axis))
 
 
 @defop("flatten")

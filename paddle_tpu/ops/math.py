@@ -14,7 +14,7 @@ import jax.numpy as jnp
 
 from ..framework import dtype as dtypes
 from ..framework.dispatch import defop, apply
-from ..framework.tensor import Tensor
+from ..framework.tensor import Tensor, inplace_rebind
 
 
 def _axis(axis):
@@ -492,11 +492,7 @@ def _increment(x, value):
 
 
 def increment(x, value=1.0, name=None):
-    out = _increment(x, value)
-    x._value = out._value
-    x._node = out._node
-    x._out_idx = out._out_idx
-    return x
+    return inplace_rebind(x, _increment(x, value))
 
 
 @defop("broadcast_shape_op")
