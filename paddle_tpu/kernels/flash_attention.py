@@ -4,11 +4,16 @@ Reference analog: the external flashattn CUDA lib wired via
 cmake/external/flashattn.cmake + phi flash_attn kernels
 (/root/reference/paddle/phi/kernels/gpu/flash_attn_kernel.cu).
 
-Round-1 implementation: a blockwise-softmax (online softmax) attention written
-with lax.scan over KV blocks — O(S) memory like flash attention, fully
-XLA-fusable, works on TPU and CPU. A hand-tiled Pallas kernel slots in behind
-the same entry point (see pallas_flash_attention below) and is used when the
-backend is TPU and shapes meet its tiling constraints.
+Two forward paths behind one entry:
+- Pallas hand-tiled kernel (pallas_attention.mha_fwd) when the backend is TPU;
+- a blockwise online-softmax lax.scan path that XLA fuses, used on CPU and as
+  the safety net.
+
+Both return the softmax log-normalizer (lse), and the backward is the
+standard flash-attention recompute pass written at the jax level (scan over
+kv blocks, f32): p is rebuilt from lse, so no O(S²) tensor is ever saved.
+Wired via jax.custom_vjp, so the eager tape, jit.to_static and grad
+transforms all pick up the memory-efficient backward.
 """
 from __future__ import annotations
 
@@ -27,8 +32,31 @@ def available() -> bool:
     return True
 
 
-def _blockwise_attention(q, k, v, causal):
-    """Online-softmax attention, scanning KV blocks. Layout: [B,S,H,D]."""
+def _dense_attention_lse(q, k, v, causal):
+    """O(S²) dense softmax attention. [B,S,H,D] → (out, lse [B,H,S])."""
+    B, Sq, H, D = q.shape
+    Skv = k.shape[1]
+    scale = 1.0 / math.sqrt(D)
+    qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32) * scale
+    kt = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+    vt = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+    s = jnp.einsum("bhsd,bhtd->bhst", qt, kt)
+    if causal:
+        s = jnp.where(jnp.tril(jnp.ones((Sq, Skv), bool)), s, -jnp.inf)
+    m = jnp.max(s, -1)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, -1)
+    out = jnp.einsum("bhst,bhtd->bhsd", p / l[..., None], vt)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype), m + jnp.log(l)
+
+
+def _dense_reference(q, k, v, causal):
+    """O(S²) reference (testing / tiny shapes). [B,S,H,D]."""
+    return _dense_attention_lse(q, k, v, causal)[0]
+
+
+def _blockwise_attention_lse(q, k, v, causal):
+    """Online-softmax attention over KV blocks. [B,S,H,D] → (out, lse)."""
     B, Sq, H, D = q.shape
     Skv = k.shape[1]
     scale = 1.0 / math.sqrt(D)
@@ -38,13 +66,7 @@ def _blockwise_attention(q, k, v, causal):
 
     blk = min(_BLOCK_KV, Skv)
     if Skv % blk != 0:
-        # fall back to dense for awkward sizes
-        scores = jnp.einsum("bhsd,bhtd->bhst", qt, kt)
-        if causal:
-            scores = jnp.where(jnp.tril(jnp.ones((Sq, Skv), bool)), scores,
-                               -jnp.inf)
-        out = jnp.einsum("bhst,bhtd->bhsd", jax.nn.softmax(scores, -1), vt)
-        return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+        return _dense_attention_lse(q, k, v, causal)
 
     nblk = Skv // blk
     kb = kt.reshape(B, H, nblk, blk, D)
@@ -60,7 +82,6 @@ def _blockwise_attention(q, k, v, causal):
             mask = q_pos[:, None] >= kv_pos[None, :]
             scores = jnp.where(mask, scores, -jnp.inf)
         m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
-        # guard fully-masked rows
         m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
         p = jnp.exp(scores - m_safe[..., None])
         p = jnp.where(jnp.isneginf(scores), 0.0, p)
@@ -77,18 +98,96 @@ def _blockwise_attention(q, k, v, causal):
     (m, l, acc), _ = jax.lax.scan(
         step, (m0, l0, acc0),
         (jnp.moveaxis(kb, 2, 0), jnp.moveaxis(vb, 2, 0), jnp.arange(nblk)))
-    out = acc / jnp.maximum(l[..., None], 1e-37)
-    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+    l_safe = jnp.maximum(l, 1e-37)
+    out = acc / l_safe[..., None]
+    lse = jnp.where(jnp.isneginf(m), -jnp.inf, m + jnp.log(l_safe))
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype), lse
+
+
+# Debug switch: set False to force the XLA blockwise path on TPU. A Mosaic
+# compile failure under an outer jit cannot be caught by try/except (it fires
+# at top-level compile time), so selection is an explicit gate, not a fallback.
+use_pallas = True
+
+
+def _fwd_with_lse(q, k, v, causal):
+    if use_pallas and jax.default_backend() == "tpu":
+        from .pallas_attention import mha_fwd
+        return mha_fwd(q, k, v, causal=causal)
+    return _blockwise_attention_lse(q, k, v, causal)
+
+
+def _flash_bwd(q, k, v, out, lse, do, causal):
+    """Flash-attention backward: recompute p per kv block from lse.
+
+    delta = rowsum(do ⊙ out);  ds = p ⊙ (do·vᵀ − delta) · scale
+    dq = Σ_j ds_j k_j ;  dk_j = ds_jᵀ q ;  dv_j = p_jᵀ do
+    """
+    B, Sq, H, D = q.shape
+    Skv = k.shape[1]
+    scale = 1.0 / math.sqrt(D)
+    qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32)          # B,H,Sq,D
+    kt = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+    vt = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+    ot = jnp.swapaxes(out, 1, 2).astype(jnp.float32)
+    dot_ = jnp.swapaxes(do, 1, 2).astype(jnp.float32)
+    delta = jnp.sum(dot_ * ot, axis=-1)                     # B,H,Sq
+
+    blk = min(_BLOCK_KV, Skv)
+    if Skv % blk != 0:
+        blk = Skv
+    nblk = Skv // blk
+    kb = jnp.moveaxis(kt.reshape(B, H, nblk, blk, D), 2, 0)
+    vb = jnp.moveaxis(vt.reshape(B, H, nblk, blk, D), 2, 0)
+    q_pos = jnp.arange(Sq)
+
+    def step(dq, inputs):
+        kblk, vblk, blk_idx = inputs
+        s = jnp.einsum("bhsd,bhtd->bhst", qt, kblk) * scale
+        p = jnp.exp(s - lse[..., None])                     # B,H,Sq,blk
+        if causal:
+            kv_pos = blk_idx * blk + jnp.arange(blk)
+            mask = q_pos[:, None] >= kv_pos[None, :]
+            p = jnp.where(mask, p, 0.0)
+        dv_j = jnp.einsum("bhst,bhsd->bhtd", p, dot_)
+        dp = jnp.einsum("bhsd,bhtd->bhst", dot_, vblk)
+        ds = p * (dp - delta[..., None]) * scale
+        dq = dq + jnp.einsum("bhst,bhtd->bhsd", ds, kblk)
+        dk_j = jnp.einsum("bhst,bhsd->bhtd", ds, qt)
+        return dq, (dk_j, dv_j)
+
+    dq0 = jnp.zeros((B, H, Sq, D), jnp.float32)
+    dq, (dk_blocks, dv_blocks) = jax.lax.scan(
+        step, dq0, (kb, vb, jnp.arange(nblk)))
+    dk = jnp.moveaxis(dk_blocks, 0, 2).reshape(B, H, Skv, D)
+    dv = jnp.moveaxis(dv_blocks, 0, 2).reshape(B, H, Skv, D)
+    return (jnp.swapaxes(dq, 1, 2).astype(q.dtype),
+            jnp.swapaxes(dk, 1, 2).astype(k.dtype),
+            jnp.swapaxes(dv, 1, 2).astype(v.dtype))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash_mha(q, k, v, causal):
+    out, _ = _fwd_with_lse(q, k, v, causal)
+    return out
+
+
+def _flash_mha_fwd(q, k, v, causal):
+    out, lse = _fwd_with_lse(q, k, v, causal)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_mha_bwd(causal, res, do):
+    q, k, v, out, lse = res
+    return _flash_bwd(q, k, v, out, lse, do, causal)
+
+
+_flash_mha.defvjp(_flash_mha_fwd, _flash_mha_bwd)
 
 
 @defop("flash_attention_kernel")
 def _flash_attention_op(q, k, v, causal):
-    if jax.default_backend() == "tpu":
-        try:
-            return pallas_flash_attention(q, k, v, causal=causal)
-        except Exception:
-            pass
-    return _blockwise_attention(q, k, v, causal)
+    return _flash_mha(q, k, v, causal)
 
 
 def flash_attention(q, k, v, causal=False):
@@ -96,10 +195,7 @@ def flash_attention(q, k, v, causal=False):
     return _flash_attention_op(q, k, v, bool(causal))
 
 
-# ---------------------------------------------------------------------------
-# Pallas TPU kernel (filled in by paddle_tpu.kernels round work); the jax-level
-# blockwise path above is the portable fallback with the same math.
-# ---------------------------------------------------------------------------
-def pallas_flash_attention(q, k, v, causal=False):
-    from .pallas_attention import mha as _mha
-    return _mha(q, k, v, causal=causal)
+def flash_attention_fn(q, k, v, causal=False):
+    """Raw jax-level entry (for models that work on arrays, e.g. models.gpt)."""
+    return _flash_mha(q, k, v, bool(causal))
+

@@ -1,0 +1,147 @@
+"""Hand-tiled Pallas TPU flash-attention forward kernel.
+
+Reference analog: the external flash-attention CUDA library the reference
+wires in via cmake/external/flashattn.cmake and exposes through
+paddle/phi/kernels/gpu/flash_attn_kernel.cu. Here the kernel is written
+TPU-first with Pallas: the score matmul and the PV matmul hit the MXU per
+(block_q × block_k) tile, the online-softmax state (m, l, acc) lives in VMEM
+scratch across the kv-block grid dimension, and HBM traffic is O(S·D) instead
+of O(S²).
+
+Layout convention matches the reference flash_attn API: [B, S, H, D].
+The kernel internally works on [B*H, S, D].
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+# lse is a scalar per q row; store it 8 lanes wide (min f32 sublane tile is
+# (8,128) in VMEM regardless, but HBM traffic/storage shrink 16x vs 128 lanes)
+_LSE_LANES = 8
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, scale, causal, block_q, block_k, kv_len):
+    i = pl.program_id(1)          # q block
+    j = pl.program_id(2)          # kv block (innermost: scratch carries over)
+    nkv = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    def _body():
+        q = q_ref[0].astype(jnp.float32) * scale            # (BQ, D)
+        k = k_ref[0].astype(jnp.float32)                    # (BK, D)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        kpos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = kpos < kv_len
+        if causal:
+            qpos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            valid = jnp.logical_and(valid, qpos >= kpos)
+        s = jnp.where(valid, s, _NEG_INF)
+
+        m_prev = m_ref[:, :1]                               # (BQ, 1)
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    if causal:
+        # skip fully-masked kv blocks (upper-triangular block region)
+        @pl.when(j * block_k <= i * block_q + block_q - 1)
+        def _():
+            _body()
+    else:
+        _body()
+
+    @pl.when(j == nkv - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        lse = m_ref[:, :1] + jnp.log(jnp.maximum(l_ref[:, :1], 1e-30))
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+
+
+def _pad_to(x, axis, mult):
+    size = x.shape[axis]
+    pad = (-size) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+                                             "interpret"))
+def mha_fwd(q, k, v, causal=False, block_q=128, block_k=128, interpret=False):
+    """[B,S,H,D] → (out [B,S,H,D], lse [B,H,S]).  lse = m + log l, the
+    softmax log-normalizer the jax-level flash backward recomputes p from."""
+    B, Sq, H, D = q.shape
+    Skv = k.shape[1]
+    scale = 1.0 / math.sqrt(D)
+
+    # fixed 128-aligned blocks: sublane/lane tiling is always legal and the
+    # padding below absorbs any sequence length
+    bq, bk = block_q, block_k
+    q2 = _pad_to(jnp.swapaxes(q, 1, 2).reshape(B * H, Sq, D), 1, bq)
+    k2 = _pad_to(jnp.swapaxes(k, 1, 2).reshape(B * H, Skv, D), 1, bk)
+    v2 = _pad_to(jnp.swapaxes(v, 1, 2).reshape(B * H, Skv, D), 1, bk)
+    Sqp, Skp = q2.shape[1], k2.shape[1]
+    grid = (B * H, Sqp // bq, Skp // bk)
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
+        kv_len=Skv)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, _LSE_LANES), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Sqp, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, Sqp, _LSE_LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, D), jnp.float32),     # acc
+            pltpu.VMEM((bq, 128), jnp.float32),   # m (lane-broadcast)
+            pltpu.VMEM((bq, 128), jnp.float32),   # l
+        ],
+        interpret=interpret,
+    )(q2, k2, v2)
+
+    out = jnp.swapaxes(out[:, :Sq].reshape(B, H, Sq, D), 1, 2)
+    lse = lse[:, :Sq, 0].reshape(B, H, Sq)
+    return out, lse
+
+
+def mha(q, k, v, causal=False, interpret=False):
+    out, _ = mha_fwd(q, k, v, causal=causal, interpret=interpret)
+    return out

@@ -181,8 +181,8 @@ def _attention(x, w_qkv, b_qkv, w_out, b_out, cfg, mask_causal=True):
     q = q.reshape(B, S, H, hd)
     k_ = k_.reshape(B, S, H, hd)
     v = v.reshape(B, S, H, hd)
-    from ..kernels.flash_attention import _blockwise_attention
-    ctx = _blockwise_attention(q, k_, v, causal=mask_causal)
+    from ..kernels.flash_attention import flash_attention_fn
+    ctx = flash_attention_fn(q, k_, v, causal=mask_causal)
     ctx = ctx.reshape(B, S, D)
     out = jnp.einsum("bsd,df->bsf", ctx, w_out.astype(x.dtype))
     if b_out is not None:
