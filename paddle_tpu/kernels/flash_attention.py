@@ -32,8 +32,9 @@ def available() -> bool:
     return True
 
 
-def _dense_attention_lse(q, k, v, causal):
-    """O(S²) dense softmax attention. [B,S,H,D] → (out, lse [B,H,S])."""
+def _dense_attention_lse(q, k, v, causal, kv_len=None):
+    """O(S²) dense softmax attention. [B,S,H,D] → (out, lse [B,H,S]).
+    kv_len: number of valid kv positions (suffix is masked), default all."""
     B, Sq, H, D = q.shape
     Skv = k.shape[1]
     scale = 1.0 / math.sqrt(D)
@@ -41,6 +42,8 @@ def _dense_attention_lse(q, k, v, causal):
     kt = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
     vt = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
     s = jnp.einsum("bhsd,bhtd->bhst", qt, kt)
+    if kv_len is not None and kv_len < Skv:
+        s = jnp.where(jnp.arange(Skv)[None, :] < kv_len, s, -jnp.inf)
     if causal:
         s = jnp.where(jnp.tril(jnp.ones((Sq, Skv), bool)), s, -jnp.inf)
     m = jnp.max(s, -1)
@@ -50,12 +53,12 @@ def _dense_attention_lse(q, k, v, causal):
     return jnp.swapaxes(out, 1, 2).astype(q.dtype), m + jnp.log(l)
 
 
-def _dense_reference(q, k, v, causal):
+def _dense_reference(q, k, v, causal, kv_len=None):
     """O(S²) reference (testing / tiny shapes). [B,S,H,D]."""
-    return _dense_attention_lse(q, k, v, causal)[0]
+    return _dense_attention_lse(q, k, v, causal, kv_len)[0]
 
 
-def _blockwise_attention_lse(q, k, v, causal):
+def _blockwise_attention_lse(q, k, v, causal, kv_len=None):
     """Online-softmax attention over KV blocks. [B,S,H,D] → (out, lse)."""
     B, Sq, H, D = q.shape
     Skv = k.shape[1]
@@ -66,7 +69,7 @@ def _blockwise_attention_lse(q, k, v, causal):
 
     blk = min(_BLOCK_KV, Skv)
     if Skv % blk != 0:
-        return _dense_attention_lse(q, k, v, causal)
+        return _dense_attention_lse(q, k, v, causal, kv_len)
 
     nblk = Skv // blk
     kb = kt.reshape(B, H, nblk, blk, D)
@@ -77,8 +80,10 @@ def _blockwise_attention_lse(q, k, v, causal):
         m, l, acc = carry
         kblk, vblk, blk_idx = inputs
         scores = jnp.einsum("bhsd,bhtd->bhst", qt, kblk)
+        kv_pos = blk_idx * blk + jnp.arange(blk)
+        if kv_len is not None and kv_len < Skv:
+            scores = jnp.where(kv_pos[None, :] < kv_len, scores, -jnp.inf)
         if causal:
-            kv_pos = blk_idx * blk + jnp.arange(blk)
             mask = q_pos[:, None] >= kv_pos[None, :]
             scores = jnp.where(mask, scores, -jnp.inf)
         m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
@@ -92,9 +97,10 @@ def _blockwise_attention_lse(q, k, v, causal):
             jnp.einsum("bhst,bhtd->bhsd", p, vblk)
         return (m_new, l_new, acc_new), None
 
-    m0 = jnp.full((B, H, Sq), -jnp.inf)
-    l0 = jnp.zeros((B, H, Sq))
-    acc0 = jnp.zeros((B, H, Sq, D))
+    # carries derive from inputs so shard_map varying-axes tracking matches
+    m0 = jnp.full_like(qt[..., 0], -jnp.inf)
+    l0 = jnp.zeros_like(qt[..., 0])
+    acc0 = jnp.zeros_like(qt)
     (m, l, acc), _ = jax.lax.scan(
         step, (m0, l0, acc0),
         (jnp.moveaxis(kb, 2, 0), jnp.moveaxis(vb, 2, 0), jnp.arange(nblk)))
@@ -110,14 +116,14 @@ def _blockwise_attention_lse(q, k, v, causal):
 use_pallas = True
 
 
-def _fwd_with_lse(q, k, v, causal):
+def _fwd_with_lse(q, k, v, causal, kv_len=None):
     if use_pallas and jax.default_backend() == "tpu":
         from .pallas_attention import mha_fwd
-        return mha_fwd(q, k, v, causal=causal)
-    return _blockwise_attention_lse(q, k, v, causal)
+        return mha_fwd(q, k, v, causal=causal, kv_len=kv_len)
+    return _blockwise_attention_lse(q, k, v, causal, kv_len)
 
 
-def _flash_bwd(q, k, v, out, lse, do, causal):
+def _flash_bwd(q, k, v, out, lse, do, causal, kv_len=None):
     """Flash-attention backward: recompute p per kv block from lse.
 
     delta = rowsum(do ⊙ out);  ds = p ⊙ (do·vᵀ − delta) · scale
@@ -145,8 +151,10 @@ def _flash_bwd(q, k, v, out, lse, do, causal):
         kblk, vblk, blk_idx = inputs
         s = jnp.einsum("bhsd,bhtd->bhst", qt, kblk) * scale
         p = jnp.exp(s - lse[..., None])                     # B,H,Sq,blk
+        kv_pos = blk_idx * blk + jnp.arange(blk)
+        if kv_len is not None and kv_len < Skv:
+            p = jnp.where(kv_pos[None, :] < kv_len, p, 0.0)
         if causal:
-            kv_pos = blk_idx * blk + jnp.arange(blk)
             mask = q_pos[:, None] >= kv_pos[None, :]
             p = jnp.where(mask, p, 0.0)
         dv_j = jnp.einsum("bhst,bhsd->bhtd", p, dot_)
@@ -156,7 +164,7 @@ def _flash_bwd(q, k, v, out, lse, do, causal):
         dk_j = jnp.einsum("bhst,bhsd->bhtd", ds, qt)
         return dq, (dk_j, dv_j)
 
-    dq0 = jnp.zeros((B, H, Sq, D), jnp.float32)
+    dq0 = jnp.zeros_like(qt)
     dq, (dk_blocks, dv_blocks) = jax.lax.scan(
         step, dq0, (kb, vb, jnp.arange(nblk)))
     dk = jnp.moveaxis(dk_blocks, 0, 2).reshape(B, H, Skv, D)
@@ -166,20 +174,20 @@ def _flash_bwd(q, k, v, out, lse, do, causal):
             jnp.swapaxes(dv, 1, 2).astype(v.dtype))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _flash_mha(q, k, v, causal):
-    out, _ = _fwd_with_lse(q, k, v, causal)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_mha(q, k, v, causal, kv_len=None):
+    out, _ = _fwd_with_lse(q, k, v, causal, kv_len)
     return out
 
 
-def _flash_mha_fwd(q, k, v, causal):
-    out, lse = _fwd_with_lse(q, k, v, causal)
+def _flash_mha_fwd(q, k, v, causal, kv_len=None):
+    out, lse = _fwd_with_lse(q, k, v, causal, kv_len)
     return out, (q, k, v, out, lse)
 
 
-def _flash_mha_bwd(causal, res, do):
+def _flash_mha_bwd(causal, kv_len, res, do):
     q, k, v, out, lse = res
-    return _flash_bwd(q, k, v, out, lse, do, causal)
+    return _flash_bwd(q, k, v, out, lse, do, causal, kv_len)
 
 
 _flash_mha.defvjp(_flash_mha_fwd, _flash_mha_bwd)

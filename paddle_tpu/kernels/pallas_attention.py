@@ -93,8 +93,9 @@ def _pad_to(x, axis, mult):
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
-                                             "interpret"))
-def mha_fwd(q, k, v, causal=False, block_q=128, block_k=128, interpret=False):
+                                             "interpret", "kv_len"))
+def mha_fwd(q, k, v, causal=False, block_q=128, block_k=128, interpret=False,
+            kv_len=None):
     """[B,S,H,D] → (out [B,S,H,D], lse [B,H,S]).  lse = m + log l, the
     softmax log-normalizer the jax-level flash backward recomputes p from."""
     B, Sq, H, D = q.shape
@@ -112,7 +113,7 @@ def mha_fwd(q, k, v, causal=False, block_q=128, block_k=128, interpret=False):
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
-        kv_len=Skv)
+        kv_len=Skv if kv_len is None else min(int(kv_len), Skv))
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,

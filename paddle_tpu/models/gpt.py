@@ -51,6 +51,9 @@ class GPTConfig:
     param_dtype: Any = jnp.float32
     remat: bool = True                        # jax.checkpoint each block
     sequence_parallel: bool = True            # SP on the 'mp' axis
+    # context parallelism for long sequences: "none" | "ring" | "ulysses";
+    # shards the sequence axis over the mesh's 'sp' axis ('mp' if absent)
+    context_parallel: str = "none"
     # MoE (expert parallel) — 0 experts = dense FFN
     num_experts: int = 0
     expert_capacity_factor: float = 1.25
@@ -181,8 +184,18 @@ def _attention(x, w_qkv, b_qkv, w_out, b_out, cfg, mask_causal=True):
     q = q.reshape(B, S, H, hd)
     k_ = k_.reshape(B, S, H, hd)
     v = v.reshape(B, S, H, hd)
-    from ..kernels.flash_attention import flash_attention_fn
-    ctx = flash_attention_fn(q, k_, v, causal=mask_causal)
+    if cfg.context_parallel in ("ring", "ulysses"):
+        from ..parallel.mesh import get_mesh
+        from ..parallel.context_parallel import (ring_attention,
+                                                 ulysses_attention)
+        mesh = get_mesh()
+        axis = "sp" if "sp" in mesh.axis_names else "mp"
+        cp_fn = ring_attention if cfg.context_parallel == "ring" else \
+            ulysses_attention
+        ctx = cp_fn(q, k_, v, mesh, axis=axis, causal=mask_causal)
+    else:
+        from ..kernels.flash_attention import flash_attention_fn
+        ctx = flash_attention_fn(q, k_, v, causal=mask_causal)
     ctx = ctx.reshape(B, S, D)
     out = jnp.einsum("bsd,df->bsf", ctx, w_out.astype(x.dtype))
     if b_out is not None:
