@@ -5,37 +5,106 @@ metric = fused train-step (fwd+bwd+AdamW) throughput in tokens/sec/chip on
 the flagship GPT; vs_baseline = achieved MFU / 0.45 (the BASELINE.json
 north-star MFU target — the reference publishes no in-repo numbers, see
 BASELINE.md).
+
+Robustness (the round-1 run died on a transient `Unable to initialize
+backend 'axon'` and a later manual run hung): the top-level invocation is an
+orchestrator that runs the measurement in a subprocess under a hard timeout,
+walking a config ladder — flagship TPU -> small TPU -> CPU smoke — until one
+rung produces a JSON line. Backend init inside the measurement retries with
+backoff and falls back to the CPU platform via the config API (the env's
+TPU plugin ignores JAX_PLATFORMS env vars). All diagnostics go to stderr;
+stdout carries only the final JSON line.
 """
 from __future__ import annotations
 
 import functools
 import json
+import os
+import subprocess
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
+
+def _log(msg: str) -> None:
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
-def main():
+# ---------------------------------------------------------------- configs
+# name -> (model kwargs, batch, seq, iters, timeout_s)
+LADDER = [
+    ("tpu", dict(vocab_size=32768, hidden_size=1024, num_layers=24,
+                 num_heads=16, max_seq_len=1024, remat=True,
+                 dtype="bfloat16"), 8, 1024, 10, 1500),
+    ("tpu-small", dict(vocab_size=8192, hidden_size=512, num_layers=8,
+                       num_heads=8, max_seq_len=512, remat=False,
+                       dtype="bfloat16"), 4, 512, 10, 600),
+    ("cpu", dict(vocab_size=512, hidden_size=128, num_layers=2,
+                 num_heads=4, max_seq_len=128, remat=False,
+                 dtype="float32"), 2, 64, 3, 300),
+]
+
+# bf16 peak FLOPs/s per chip by TPU generation (device_kind substring)
+PEAK_FLOPS = [
+    ("v6", 918e12), ("v5p", 459e12), ("v5e", 197e12), ("v5", 197e12),
+    ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
+]
+
+
+def _peak_for(device_kind: str, platform: str) -> float:
+    if platform not in ("tpu", "axon"):
+        return 1e12  # nominal CPU figure; MFU is not meaningful off-chip
+    kind = device_kind.lower()
+    for key, peak in PEAK_FLOPS:
+        if key in kind:
+            return peak
+    return 197e12  # conservative default (v5e-class)
+
+
+def _init_devices(want_tpu: bool):
+    """Single backend-init attempt; exits 17 when the required platform is
+    unavailable so the orchestrator descends the ladder. Retrying inside
+    one process is useless — jax caches the partially-initialized backend
+    set after the first failure — so retries happen at the ladder level in
+    fresh subprocesses."""
+    import jax
+    if not want_tpu:
+        from paddle_tpu.device import pin_cpu
+        if not pin_cpu(1):
+            _log("could not pin CPU platform")
+            sys.exit(17)
+    try:
+        devs = jax.devices()
+    except RuntimeError as e:  # axon tunnel: transient UNAVAILABLE
+        _log(f"backend init failed: {e}")
+        sys.exit(17)
+    _log(f"backend ready: {devs[0].platform} x{len(devs)} "
+         f"({devs[0].device_kind})")
+    if want_tpu and devs[0].platform not in ("tpu", "axon"):
+        # never publish CPU-class numbers under a TPU rung label
+        _log(f"wanted TPU but got {devs[0].platform}; abandoning rung")
+        sys.exit(17)
+    return devs
+
+
+def run_measurement(rung: str) -> None:
+    """Run one ladder rung and print the JSON line to stdout."""
+    name, kw, batch, seq, iters, _ = next(c for c in LADDER if c[0] == rung)
+    want_tpu = name.startswith("tpu")
+
+    import jax
+    import jax.numpy as jnp
+
+    devs = _init_devices(want_tpu)
+    platform = devs[0].platform
+
     from paddle_tpu.models.gpt import (GPTConfig, init_gpt_params,
                                        init_opt_state, train_step)
+    kw = dict(kw)
+    kw["dtype"] = jnp.bfloat16 if kw["dtype"] == "bfloat16" else jnp.float32
+    cfg = GPTConfig(sequence_parallel=False, **kw)
 
-    on_tpu = jax.default_backend() == "tpu"
-    if on_tpu:
-        cfg = GPTConfig(vocab_size=32768, hidden_size=1024, num_layers=24,
-                        num_heads=16, max_seq_len=1024,
-                        sequence_parallel=False, remat=True,
-                        dtype=jnp.bfloat16)
-        batch, seq = 8, 1024
-        iters = 20
-    else:  # CI smoke
-        cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
-                        num_heads=4, max_seq_len=128,
-                        sequence_parallel=False, remat=False,
-                        dtype=jnp.float32)
-        batch, seq = 2, 64
-        iters = 3
-
+    _log(f"rung={name}: init params ({cfg.num_layers}L x "
+         f"{cfg.hidden_size}d, batch={batch}, seq={seq})")
     params = init_gpt_params(cfg, jax.random.PRNGKey(0))
     opt_state = init_opt_state(params)
     tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq + 1), 0,
@@ -43,14 +112,19 @@ def main():
 
     step = jax.jit(functools.partial(train_step, cfg=cfg, lr=1e-4),
                    donate_argnums=(0, 1))
+    _log("compiling + first step...")
+    t0 = time.perf_counter()
     loss, params, opt_state = step(params, opt_state, tokens)
-    float(loss)  # force (block_until_ready is unreliable over the tunnel)
+    loss_v = float(loss)  # forces; block_until_ready unreliable over tunnel
+    _log(f"first step done in {time.perf_counter() - t0:.1f}s "
+         f"(loss={loss_v:.4f})")
 
     t0 = time.perf_counter()
-    for _ in range(iters):
+    for i in range(iters):
         loss, params, opt_state = step(params, opt_state, tokens)
     float(loss)  # forces the whole chained sequence
     dt = (time.perf_counter() - t0) / iters
+    _log(f"steady state: {dt * 1e3:.1f} ms/step over {iters} iters")
 
     tokens_per_step = batch * seq
     tps = tokens_per_step / dt
@@ -59,7 +133,7 @@ def main():
     n_params = sum(int(v.size) for v in params.values())
     flops_per_token = 6.0 * n_params + \
         12.0 * cfg.num_layers * cfg.hidden_size * seq
-    peak = 197e12 if on_tpu else 1e12  # TPU v5e bf16 peak per chip
+    peak = _peak_for(devs[0].device_kind, platform)
     mfu = flops_per_token * tps / peak
 
     print(json.dumps({
@@ -67,8 +141,72 @@ def main():
         "value": round(tps, 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.45, 4),
-    }))
+        "mfu": round(mfu, 4),
+        "backend": platform,
+        "config": name,
+        "ms_per_step": round(dt * 1e3, 2),
+    }), flush=True)
+
+
+def _probe_tpu(here: str, tries: int = 2, timeout_s: int = 360) -> bool:
+    """Cheap bounded check that the TPU tunnel is alive before committing to
+    the long TPU-rung timeouts."""
+    code = "import jax; print('PROBE', jax.devices()[0].platform)"
+    for i in range(tries):
+        try:
+            res = subprocess.run([sys.executable, "-c", code], cwd=here,
+                                 stdout=subprocess.PIPE, timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            _log(f"TPU probe {i + 1}/{tries} timed out ({timeout_s}s)")
+            continue
+        out = res.stdout.decode()
+        if res.returncode == 0 and "PROBE" in out:
+            platform = out.split("PROBE", 1)[1].strip().split()[0]
+            _log(f"TPU probe: platform={platform}")
+            return platform in ("tpu", "axon")
+        _log(f"TPU probe {i + 1}/{tries} failed (rc={res.returncode})")
+    return False
+
+
+def main() -> None:
+    here = os.path.dirname(os.path.abspath(__file__))
+    ladder = LADDER
+    if not _probe_tpu(here):
+        _log("no live TPU backend; skipping TPU rungs")
+        ladder = [c for c in LADDER if not c[0].startswith("tpu")]
+    for name, _, _, _, _, timeout_s in ladder:
+        attempts = 2 if name.startswith("tpu") else 1  # transient tunnel
+        for attempt in range(attempts):
+            _log(f"=== rung '{name}' attempt {attempt + 1}/{attempts} "
+                 f"(timeout {timeout_s}s) ===")
+            try:
+                res = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--run", name],
+                    cwd=here, stdout=subprocess.PIPE, timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                _log(f"rung '{name}' timed out after {timeout_s}s")
+                break  # a hang is not transient; descend the ladder
+            out = res.stdout.decode().strip().splitlines()
+            line = next((ln for ln in reversed(out)
+                         if ln.startswith("{")), None)
+            if res.returncode == 0 and line:
+                try:
+                    json.loads(line)
+                except json.JSONDecodeError:
+                    _log(f"rung '{name}' emitted unparseable stdout")
+                    continue
+                print(line, flush=True)
+                return
+            _log(f"rung '{name}' failed (rc={res.returncode})")
+            if res.returncode != 17:
+                break  # real error, not a backend-availability exit
+    _log("all rungs failed")
+    sys.exit(1)
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--run":
+        run_measurement(sys.argv[2])
+    else:
+        main()

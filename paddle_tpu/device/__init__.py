@@ -14,6 +14,63 @@ from ..framework.place import (Place, TPUPlace, CPUPlace, CUDAPlace,
 _current_device = None
 
 
+def cpu_pin_env(n_devices: int, base_env=None) -> dict:
+    """Environment for a CPU-pinned (child) process: JAX_PLATFORMS et al.
+    plus XLA_FLAGS with any pre-existing host-device-count flag replaced.
+    The one place the pin recipe's env half lives (pin_cpu applies it
+    in-process; __graft_entry__'s re-exec path passes it to subprocess)."""
+    import os
+    env = dict(os.environ if base_env is None else base_env)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_PLATFORM_NAME"] = "cpu"
+    keep = [f for f in env.get("XLA_FLAGS", "").split()
+            if "host_platform_device_count" not in f]
+    env["XLA_FLAGS"] = " ".join(
+        keep + [f"--xla_force_host_platform_device_count={n_devices}"])
+    return env
+
+
+def pin_cpu(n_devices: int = 1) -> bool:
+    """Pin this process to the CPU platform with >= n_devices virtual
+    devices. Must run before any jax backend initializes; returns True when
+    the pin took effect. On failure every env/config mutation is rolled
+    back, so a long-lived caller is never left half-pinned.
+
+    This is the single shared workaround for the environment trap where the
+    TPU plugin overrides the JAX_PLATFORMS env var: the pin must also go
+    through the jax config API (tests/conftest.py, __graft_entry__.py and
+    bench.py all route through here).
+    """
+    import os
+    saved_env = {k: os.environ.get(k)
+                 for k in ("JAX_PLATFORMS", "JAX_PLATFORM_NAME",
+                           "XLA_FLAGS")}
+    saved_cfg = getattr(jax.config, "jax_platforms", None)
+    os.environ.update(cpu_pin_env(n_devices))
+
+    def _rollback():
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        try:
+            jax.config.update("jax_platforms", saved_cfg)
+        except Exception:
+            pass
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        devs = jax.devices()
+    except Exception:
+        _rollback()
+        return False
+    if devs[0].platform != "cpu" or len(devs) < n_devices:
+        _rollback()
+        return False
+    return True
+
+
 def set_device(device):
     global _current_device
     if isinstance(device, Place):

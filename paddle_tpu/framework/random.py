@@ -21,11 +21,20 @@ import numpy as np
 
 class _RNGState(threading.local):
     def __init__(self):
-        self.key = jax.random.PRNGKey(0)
+        # lazily materialized: creating a PRNGKey initializes the jax
+        # backend, which must not happen at import time (a congested TPU
+        # tunnel would hang every `import paddle_tpu`)
+        self.key = None
         self.seed_value = 0
 
 
 _state = _RNGState()
+
+
+def _current_key():
+    if _state.key is None:
+        _state.key = jax.random.PRNGKey(_state.seed_value)
+    return _state.key
 
 
 def seed(s: int):
@@ -36,7 +45,7 @@ def seed(s: int):
 
 
 def get_rng_state():
-    return _state.key
+    return _current_key()
 
 
 def set_rng_state(key):
@@ -52,7 +61,7 @@ def next_key():
     ctx = active_rng()
     if ctx is not None:
         return ctx.next_key()
-    _state.key, sub = jax.random.split(_state.key)
+    _state.key, sub = jax.random.split(_current_key())
     return sub
 
 

@@ -5,22 +5,17 @@ virtual 8-device mesh for distributed tests
 (xla_force_host_platform_device_count — the TPU-world analog of the
 reference's single-node multi-process CUDA_VISIBLE_DEVICES splitting).
 """
-import os
+import numpy as np
+import pytest
+import jax
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["JAX_PLATFORM_NAME"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+# the environment's TPU plugin overrides JAX_PLATFORMS from the env; the
+# shared pin_cpu helper applies the env + config-API pin before any backend
+# initializes (importing paddle_tpu is backend-free by design)
+from paddle_tpu.device import pin_cpu
 
-import numpy as np  # noqa: E402
-import pytest  # noqa: E402
-import jax  # noqa: E402
-
-# the environment's TPU plugin overrides JAX_PLATFORMS from the env, so pin
-# the platform through the config API before any backend initializes
-jax.config.update("jax_platforms", "cpu")
+if not pin_cpu(8):
+    raise RuntimeError("could not pin the 8-device virtual CPU platform")
 
 # numeric-parity tests compare against float64-ish numpy; XLA's default
 # matmul precision is bf16-based (the TPU/TF32 tradeoff the reference also
