@@ -10,10 +10,11 @@ Robustness (the round-1 run died on a transient `Unable to initialize
 backend 'axon'` and a later manual run hung): the top-level invocation is an
 orchestrator that runs the measurement in a subprocess under a hard timeout,
 walking a config ladder — flagship TPU -> small TPU -> CPU smoke — until one
-rung produces a JSON line. Backend init inside the measurement retries with
-backoff and falls back to the CPU platform via the config API (the env's
-TPU plugin ignores JAX_PLATFORMS env vars). All diagnostics go to stderr;
-stdout carries only the final JSON line.
+rung produces a JSON line. Each rung makes ONE backend-init attempt in a
+fresh subprocess (jax caches a partially-initialized backend set, so
+in-process retry is useless) and exits 17 when its platform is unavailable;
+the orchestrator retries TPU rungs once and then descends. All diagnostics
+go to stderr; stdout carries only the final JSON line.
 """
 from __future__ import annotations
 

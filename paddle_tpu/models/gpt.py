@@ -57,6 +57,12 @@ class GPTConfig:
     # MoE (expert parallel) — 0 experts = dense FFN
     num_experts: int = 0
     expert_capacity_factor: float = 1.25
+    # real pipeline parallelism (reference 1F1B/interleaved schedules,
+    # fleet/meta_parallel/pipeline_parallel.py:188,565): >1 microbatches +
+    # a pp>1 mesh routes the block stack through parallel.pipeline's SPMD
+    # ppermute-ring schedule; 0/1 = layer-weight sharding only
+    pipeline_microbatches: int = 0
+    pipeline_interleave: int = 1
 
     def __post_init__(self):
         if self.ffn_hidden is None:
@@ -272,6 +278,60 @@ _BLOCK_KEYS_MOE = ("ln1_scale", "ln1_bias", "ln2_scale", "ln2_bias",
                    "moe_down_b")
 
 
+def _pipeline_active(cfg: GPTConfig) -> int:
+    """Return the pp degree when the pipelined path should run, else 0."""
+    if cfg.pipeline_microbatches <= 1:
+        return 0
+    mesh = get_mesh()
+    if mesh is None or "pp" not in mesh.axis_names:
+        return 0
+    pp = mesh.shape["pp"]
+    return pp if pp > 1 else 0
+
+
+def _apply_stack(stacked, x, cfg: GPTConfig):
+    """Apply the transformer block stack: pipelined over the 'pp' mesh axis
+    when configured, else a layer-axis lax.scan (layer-weight sharding)."""
+    pp = _pipeline_active(cfg)
+    if pp:
+        from ..parallel.pipeline import pipeline_forward
+        m, v = cfg.pipeline_microbatches, cfg.pipeline_interleave
+        n_chunks = pp * v
+        L = cfg.num_layers
+        B = x.shape[0]
+        if L % n_chunks != 0:
+            raise ValueError(
+                f"num_layers={L} must be a multiple of "
+                f"pp*interleave={n_chunks}")
+        if B % m != 0:
+            raise ValueError(
+                f"batch={B} must be a multiple of "
+                f"pipeline_microbatches={m}")
+        chunked = {k: val.reshape((n_chunks, L // n_chunks) + val.shape[1:])
+                   for k, val in stacked.items()}
+
+        def stage_fn(chunk_params, h):
+            def body_fn(h, lp):
+                return _block(lp, h, cfg), None
+            h, _ = jax.lax.scan(body_fn, h, chunk_params)
+            return h
+
+        x_mb = x.reshape((m, B // m) + x.shape[1:])
+        y = pipeline_forward(stage_fn, chunked, x_mb, pp, m,
+                             interleave=v, remat=cfg.remat)
+        return y.reshape(x.shape)
+
+    body = functools.partial(_block, cfg=cfg)
+    if cfg.remat:
+        body = jax.checkpoint(body)
+
+    def scan_fn(h, layer_params):
+        return body(layer_params, h), None
+
+    x, _ = jax.lax.scan(scan_fn, x, stacked)
+    return x
+
+
 def gpt_forward(params, tokens, cfg: GPTConfig):
     """tokens [B, S] int32 → logits [B, S, V] (compute dtype cfg.dtype)."""
     B, S = tokens.shape
@@ -282,14 +342,7 @@ def gpt_forward(params, tokens, cfg: GPTConfig):
     block_keys = _BLOCK_KEYS_MOE if cfg.num_experts > 0 else _BLOCK_KEYS_DENSE
     stacked = {k: params[k] for k in block_keys if k in params}
 
-    body = functools.partial(_block, cfg=cfg)
-    if cfg.remat:
-        body = jax.checkpoint(body)
-
-    def scan_fn(h, layer_params):
-        return body(layer_params, h), None
-
-    x, _ = jax.lax.scan(scan_fn, x, stacked)
+    x = _apply_stack(stacked, x, cfg)
     x = _ln(x, params["ln_f_scale"], params["ln_f_bias"], cfg.layer_norm_eps)
     # tied LM head (vocab-parallel matmul — mp shards the vocab dim)
     logits = jnp.einsum("bsd,vd->bsv", x, params["wte"].astype(x.dtype))

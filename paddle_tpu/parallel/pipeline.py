@@ -30,84 +30,136 @@ from jax.sharding import PartitionSpec as P, NamedSharding
 from .mesh import get_mesh
 
 
+def bubble_fraction(n_stages: int, n_microbatches: int,
+                    interleave: int = 1) -> float:
+    """Idle fraction of the SPMD schedule: warmup+cooldown ticks over total.
+    GPipe-circulate (interleave=1): (p-1)/(m+p-1)."""
+    dead = interleave * n_stages - 1
+    return dead / (n_microbatches + dead)
+
+
+def naive_bubble_fraction(n_stages: int) -> float:
+    """Layer-sharded sequential execution: only 1/p stages busy at a time."""
+    return 1.0 - 1.0 / n_stages
+
+
 def spmd_pipeline(stage_fn: Callable, n_stages: int, n_microbatches: int,
-                  axis_name: str = "pp"):
-    """Lift `stage_fn(stage_params, x) -> y` into a pipelined
+                  axis_name: str = "pp", interleave: int = 1):
+    """Lift `stage_fn(chunk_params, x) -> y` into a pipelined
     `fn(stacked_params, microbatched_x) -> microbatched_y`.
 
-    stacked_params: pytree with leading dim n_stages (shard it P('pp')).
-    microbatched_x: [n_microbatches, micro_batch, ...] (stage-0 input).
-    Returns [n_microbatches, micro_batch, ...] (stage-(L-1) output).
+    stacked_params: pytree with leading dim n_stages*interleave, ordered
+    device-major (position d*interleave + s holds chunk s*n_stages + d —
+    the round-robin "virtual stage" placement of the reference's
+    interleaved 1F1B, pipeline_parallel.py:565). pipeline_forward applies
+    this permutation for you. microbatched_x: [n_microbatches, mb, ...].
+
+    Schedule: one lax.scan over m + v*p - 1 ticks. Each device carries v
+    activation slots; slot s on device d holds the microbatch at hop
+    s*p + d of its v*p-chunk journey. Every tick computes all local slots
+    (vmap over chunk weights — one full stage-equivalent of FLOPs),
+    ppermutes every slot to the next device, and advances a slot on ring
+    wraparound. Backward is jax autodiff through the scan: the reverse
+    replays the schedule in reverse (cooldown/warmup swap), which IS the
+    1F1B-shaped backward, scheduled by XLA with the ppermute overlapping
+    the next tick's compute. Note: with scan-synchronous ticks the bubble
+    is (v*p-1)/(m+v*p-1), so interleave=1 is the throughput-optimal
+    setting; interleave>1 exists for placement parity with the reference
+    and for relaxing the layers%stages divisibility constraint.
 
     Must be called inside a shard_map manual over `axis_name`, where each
-    rank holds params[1/n_stages] with leading dim 1.
+    rank holds the leading-dim slice of size `interleave`.
     """
+    v, p = interleave, n_stages
+
     def pipelined(local_params, x_mb):
-        # local_params leading dim is 1 (this rank's stage); squeeze it
-        params = jax.tree_util.tree_map(lambda a: a[0], local_params)
+        # local_params leading dim is v (this rank's chunk slots)
         stage = jax.lax.axis_index(axis_name)
-        n_ticks = n_microbatches + n_stages - 1
+        n_ticks = n_microbatches + v * p - 1
         mb_shape = x_mb.shape[1:]
-        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        perm = [(i, (i + 1) % p) for i in range(p)]
 
         def tick(carry, t):
-            state, outputs = carry
-            # stage 0 ingests microbatch t (clamped); others take the
-            # circulated activation from the previous stage
+            state, outputs = carry            # state: [v, *mb_shape]
+            # stage 0, slot 0 ingests microbatch t (clamped); every other
+            # (device, slot) keeps its circulating activation
             idx = jnp.clip(t, 0, n_microbatches - 1)
-            inject = jax.lax.dynamic_index_in_dim(x_mb, idx, 0,
-                                                  keepdims=False)
-            inp = jnp.where(stage == 0, inject, state)
-            out = stage_fn(params, inp)
-            # last stage emits microbatch t-(n_stages-1)
-            out_idx = t - (n_stages - 1)
-            emit = jnp.logical_and(stage == n_stages - 1, out_idx >= 0)
+            inject = jax.lax.pcast(
+                jax.lax.dynamic_index_in_dim(x_mb, idx, 0, keepdims=False),
+                axis_name, to="varying")
+            inp = state.at[0].set(
+                jnp.where(stage == 0, inject, state[0]))
+            out = jax.vmap(stage_fn)(local_params, inp)
+            # device p-1, slot v-1 finishes hop v*p-1: emit microbatch
+            # t - (v*p - 1)
+            out_idx = t - (v * p - 1)
+            emit = jnp.logical_and(stage == p - 1, out_idx >= 0)
             outputs = jax.lax.cond(
                 emit,
                 lambda o: jax.lax.dynamic_update_index_in_dim(
-                    o, out, jnp.maximum(out_idx, 0), 0),
+                    o, out[v - 1], jnp.maximum(out_idx, 0), 0),
                 lambda o: o, outputs)
-            state = jax.lax.ppermute(out, axis_name, perm)
+            shifted = jax.lax.ppermute(out, axis_name, perm)
+            # ring wraparound (p-1 -> 0) advances each activation one slot
+            rolled = jnp.roll(shifted, 1, axis=0)
+            state = jnp.where(stage == 0, rolled, shifted)
             return (state, outputs), None
 
-        state0 = jnp.zeros(mb_shape, x_mb.dtype)
-        outputs0 = jnp.zeros((n_microbatches,) + mb_shape, x_mb.dtype)
-        (state, outputs), _ = jax.lax.scan(
+        # pcast-to-varying: carries are device-varying over pp from tick one,
+        # and scan/cond require carry vma types to be invariant
+        state0 = jax.lax.pcast(jnp.zeros((v,) + mb_shape, x_mb.dtype),
+                               axis_name, to="varying")
+        outputs0 = jax.lax.pcast(
+            jnp.zeros((n_microbatches,) + mb_shape, x_mb.dtype),
+            axis_name, to="varying")
+        (_, outputs), _ = jax.lax.scan(
             tick, (state0, outputs0), jnp.arange(n_ticks))
-        # only the last stage holds real outputs; broadcast them to all pp
-        # ranks so the loss is computable everywhere (psum-style fan-out)
-        outputs = jax.lax.ppermute(
-            outputs, axis_name,
-            [(n_stages - 1, i) for i in range(n_stages)]) \
-            if n_stages > 1 else outputs
+        # only the last stage holds real outputs; masked psum broadcasts
+        # them to every pp rank so the loss is computable everywhere
+        if p > 1:
+            mask = (stage == p - 1).astype(outputs.dtype)
+            outputs = jax.lax.psum(outputs * mask, axis_name)
         return outputs
 
     return pipelined
 
 
 def pipeline_forward(stage_fn, stacked_params, x_mb, n_stages,
-                     n_microbatches, mesh=None, data_axes=("dp",),
+                     n_microbatches, mesh=None, interleave: int = 1,
                      remat=True):
     """Run the SPMD pipeline as a global computation via shard_map.
 
-    stacked_params: global arrays with leading dim n_stages.
+    stacked_params: global arrays with leading dim n_stages*interleave in
+    natural chunk order (chunk c = layers [c*per:(c+1)*per]).
     x_mb: [n_micro, micro_batch, ...] global input.
+    Only the 'pp' axis goes manual; dp/mp/fsdp shardings inside stage_fn
+    stay under GSPMD (partial-auto shard_map).
     """
     mesh = mesh or get_mesh()
-    from jax.experimental.shard_map import shard_map
     body = stage_fn
     if remat:
         body = jax.checkpoint(stage_fn)
-    piped = spmd_pipeline(body, n_stages, n_microbatches)
+    piped = spmd_pipeline(body, n_stages, n_microbatches,
+                          interleave=interleave)
+    if interleave > 1:
+        # natural chunk order -> device-major round-robin placement
+        v, p = interleave, n_stages
+        perm = np.array([s * p + d for d in range(p) for s in range(v)])
+        stacked_params = jax.tree_util.tree_map(
+            lambda a: a[perm], stacked_params)
 
     param_specs = jax.tree_util.tree_map(lambda _: P("pp"), stacked_params)
-    other = tuple(a for a in mesh.axis_names if a != "pp")
-    sm = shard_map(
+    # check_vma=True is load-bearing: partial-manual shard_map with
+    # check_vma=False is broken in jax 0.9 (its internal _unmatch builds a
+    # spec over ALL mesh axes and rejects itself). The masked-psum output
+    # broadcast makes the result genuinely replicated over pp, so the vma
+    # check passes.
+    sm = jax.shard_map(
         piped, mesh=mesh,
-        in_specs=(param_specs, P(*(None,) * x_mb.ndim)),
-        out_specs=P(*(None,) * x_mb.ndim),
-        check_rep=False,
-        auto=frozenset(other))
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+        axis_names={"pp"},
+        check_vma=True)
     return sm(stacked_params, x_mb)
 
 

@@ -1,0 +1,124 @@
+"""Pipeline parallelism tests.
+
+Mirrors the reference's pipeline-parallel test strategy
+(test/collective/fleet/hybrid_parallel_pp_alexnet.py style: train the same
+model pipelined and non-pipelined and compare losses) on the virtual
+8-device CPU mesh. Covers the SPMD ppermute-ring schedule
+(parallel/pipeline.py) for GPipe-circulate and interleaved placements,
+gradient flow, the GPT flagship wiring, and the bubble-fraction model.
+"""
+import functools
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.parallel.mesh import build_mesh, use_mesh
+from paddle_tpu.parallel.pipeline import (pipeline_forward, bubble_fraction,
+                                          naive_bubble_fraction)
+
+
+def _stage_fn(w, h):
+    return jax.nn.gelu(h @ w)
+
+
+def _ref_fwd(W, x):
+    h = x
+    for s in range(W.shape[0]):
+        h = jax.nn.gelu(h @ W[s])
+    return h
+
+
+@pytest.mark.parametrize("interleave", [1, 2])
+def test_spmd_pipeline_forward_parity(interleave):
+    p, m, mb, d = 4, 8, 2, 16
+    rng = np.random.RandomState(0)
+    W = jnp.asarray(rng.randn(p * interleave, d, d).astype(np.float32) * .3)
+    x = jnp.asarray(rng.randn(m, mb, d).astype(np.float32))
+    mesh = build_mesh({"pp": 4, "mp": 2})
+    with use_mesh(mesh):
+        y = pipeline_forward(_stage_fn, W, x, p, m, mesh=mesh,
+                             interleave=interleave)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(_ref_fwd(W, x)),
+                               atol=1e-5)
+
+
+@pytest.mark.parametrize("interleave", [1, 2])
+def test_spmd_pipeline_grad_parity(interleave):
+    p, m, mb, d = 4, 4, 2, 8
+    rng = np.random.RandomState(1)
+    W = jnp.asarray(rng.randn(p * interleave, d, d).astype(np.float32) * .3)
+    x = jnp.asarray(rng.randn(m, mb, d).astype(np.float32))
+    mesh = build_mesh({"pp": 4})
+
+    def loss(W, x):
+        return pipeline_forward(_stage_fn, W, x, p, m, mesh=mesh,
+                                interleave=interleave).sum()
+
+    with use_mesh(mesh):
+        gW, gx = jax.grad(loss, argnums=(0, 1))(W, x)
+    rW, rx = jax.grad(lambda W, x: _ref_fwd(W, x).sum(), argnums=(0, 1))(W, x)
+    np.testing.assert_allclose(np.asarray(gW), np.asarray(rW), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(rx), atol=1e-4)
+
+
+def test_gpt_pipelined_loss_parity():
+    """pp=4 pipelined loss == pp=1 loss on the same params/data (the
+    reference's pp-vs-single-card loss-parity test shape)."""
+    from paddle_tpu.models.gpt import (GPTConfig, init_gpt_params,
+                                       shard_gpt_params, gpt_loss)
+    base = dict(vocab_size=128, hidden_size=32, num_layers=8, num_heads=2,
+                ffn_hidden=64, max_seq_len=32, sequence_parallel=False,
+                remat=True, dtype=jnp.float32)
+    cfg0 = GPTConfig(**base)
+    params = init_gpt_params(cfg0, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 17), 0, 128)
+    l_ref = float(gpt_loss(params, tokens, cfg0))
+
+    mesh = build_mesh({"dp": 1, "pp": 4, "mp": 2})
+    for interleave in (1, 2):
+        cfg = GPTConfig(**base, pipeline_microbatches=4,
+                        pipeline_interleave=interleave)
+        with use_mesh(mesh):
+            sp = shard_gpt_params(params, mesh)
+            l_pp = float(jax.jit(functools.partial(gpt_loss, cfg=cfg))(
+                sp, tokens))
+        assert abs(l_pp - l_ref) < 1e-4, (interleave, l_pp, l_ref)
+
+
+def test_gpt_pipelined_train_step():
+    """One full fwd+bwd+AdamW step through the pipelined path trains (loss
+    decreases over a few steps on a fixed batch)."""
+    from paddle_tpu.models.gpt import (GPTConfig, init_gpt_params,
+                                       shard_gpt_params, init_opt_state,
+                                       train_step)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=4, num_heads=2,
+                    ffn_hidden=64, max_seq_len=32, sequence_parallel=False,
+                    remat=True, dtype=jnp.float32, pipeline_microbatches=2)
+    mesh = build_mesh({"dp": 2, "pp": 2, "mp": 2})
+    with use_mesh(mesh):
+        params = shard_gpt_params(init_gpt_params(cfg, jax.random.PRNGKey(0)),
+                                  mesh)
+        opt = init_opt_state(params)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 17), 0, 64)
+        step = jax.jit(functools.partial(train_step, cfg=cfg, lr=1e-2))
+        losses = []
+        for _ in range(5):
+            loss, params, opt = step(params, opt, tokens)
+            losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+
+def test_bubble_fraction_model():
+    """The pipelined schedule's bubble beats the naive layer-sharded
+    sequential execution, and more microbatches shrink it."""
+    p = 4
+    assert bubble_fraction(p, 8) < naive_bubble_fraction(p)
+    assert bubble_fraction(p, 16) < bubble_fraction(p, 8)
+    # GPipe-circulate is the throughput-optimal setting under scan ticks
+    assert bubble_fraction(p, 8, interleave=1) <= \
+        bubble_fraction(p, 8, interleave=2)
+    # sanity: formulas
+    assert bubble_fraction(4, 8) == pytest.approx(3 / 11)
+    assert naive_bubble_fraction(4) == pytest.approx(0.75)
