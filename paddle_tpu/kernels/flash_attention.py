@@ -110,14 +110,26 @@ def _blockwise_attention_lse(q, k, v, causal, kv_len=None):
     return jnp.swapaxes(out, 1, 2).astype(q.dtype), lse
 
 
-# Debug switch: set False to force the XLA blockwise path on TPU. A Mosaic
-# compile failure under an outer jit cannot be caught by try/except (it fires
-# at top-level compile time), so selection is an explicit gate, not a fallback.
+# Kernel selection: the Pallas path runs on TPU-class backends ('tpu', and
+# the tunneled 'axon' plugin) unless disabled. A Mosaic compile failure
+# under an outer jit cannot be caught by try/except (it fires at top-level
+# compile time), so selection is an explicit gate, not a fallback:
+# - module global `use_pallas = False` (programmatic), or
+# - env PADDLE_TPU_DISABLE_PALLAS=1 (operational escape hatch, re-read per
+#   trace so a failed compile can be retried without editing code).
 use_pallas = True
 
 
+def _pallas_enabled() -> bool:
+    import os
+    if os.environ.get("PADDLE_TPU_DISABLE_PALLAS", "") in ("1", "true",
+                                                           "True"):
+        return False
+    return use_pallas
+
+
 def _fwd_with_lse(q, k, v, causal, kv_len=None):
-    if use_pallas and jax.default_backend() == "tpu":
+    if _pallas_enabled() and jax.default_backend() in ("tpu", "axon"):
         from .pallas_attention import mha_fwd
         return mha_fwd(q, k, v, causal=causal, kv_len=kv_len)
     return _blockwise_attention_lse(q, k, v, causal, kv_len)

@@ -195,7 +195,23 @@ def _attention(x, w_qkv, b_qkv, w_out, b_out, cfg, mask_causal=True):
         from ..parallel.context_parallel import (ring_attention,
                                                  ulysses_attention)
         mesh = get_mesh()
-        axis = "sp" if "sp" in mesh.axis_names else "mp"
+        if mesh is None:
+            raise ValueError(
+                f"context_parallel={cfg.context_parallel!r} needs an active "
+                "mesh (use paddle_tpu.parallel.mesh.use_mesh / "
+                "set_global_mesh) with an 'sp' (or 'mp') axis")
+        if "sp" in mesh.axis_names:
+            axis = "sp"
+        elif "mp" in mesh.axis_names:
+            # Megatron-style reuse of the tensor-parallel axis: heads are
+            # then gathered inside the CP shard_map, costing redundant
+            # compute when mp>1 is also used for TP — prefer a dedicated
+            # 'sp' axis for long-context runs
+            axis = "mp"
+        else:
+            raise ValueError(
+                f"context_parallel={cfg.context_parallel!r}: mesh "
+                f"{dict(mesh.shape)} has neither an 'sp' nor an 'mp' axis")
         cp_fn = ring_attention if cfg.context_parallel == "ring" else \
             ulysses_attention
         ctx = cp_fn(q, k_, v, mesh, axis=axis, causal=mask_causal)

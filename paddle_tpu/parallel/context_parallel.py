@@ -32,11 +32,11 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def _chunk_update(carry, q, k, v, q_off, k_off, causal, scale,
-                  kv_len=None):
+def _chunk_update(carry, q, k, v, q_off, k_off, causal, kv_len=None):
     """One online-softmax update of (m, l, acc) against a KV chunk.
 
-    q: [B,H,Sq,D] (f32, pre-scaled), k/v: [B,H,Sc,D] (f32);
+    q: [B,H,Sq,D] (f32, pre-scaled by 1/sqrt(D) at the call site),
+    k/v: [B,H,Sc,D] (f32);
     q_off/k_off: global position offsets of the local chunks (traced ints).
     """
     m, l, acc = carry
@@ -75,7 +75,7 @@ def _ring_fwd_local(q, k, v, axis_name, causal, kv_len=None):
         m, l, acc, k_cur, v_cur = carry
         src = (idx - s) % n            # home device of the chunk we hold
         carry2 = _chunk_update((m, l, acc), qt, k_cur, v_cur,
-                               q_off, src * Sl, causal, scale, kv_len)
+                               q_off, src * Sl, causal, kv_len)
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
         return (*carry2, k_nxt, v_nxt)
@@ -196,9 +196,7 @@ def _pad_seq(x, mult):
 def _cp_call(local_fn, q, k, v, mesh, axis, causal):
     """Shared wrapper: pad S to a multiple of the axis size, run the sharded
     local fn with kv_len masking, slice the padding back off."""
-    n = int(np.prod([s for name, s in
-                     zip(mesh.axis_names, mesh.devices.shape)
-                     if name == axis])) if axis in mesh.axis_names else 1
+    n = mesh.shape[axis] if axis in mesh.axis_names else 1
     S = q.shape[1]
     qp, kp, vp = _pad_seq(q, n), _pad_seq(k, n), _pad_seq(v, n)
     kv_len = k.shape[1] if kp.shape[1] != k.shape[1] else None
@@ -218,4 +216,11 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal=False):
 
 
 def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal=False):
+    n = mesh.shape[axis] if axis in mesh.axis_names else 1
+    H = q.shape[2]
+    if H % max(n, 1) != 0:
+        raise ValueError(
+            f"ulysses_attention needs num_heads ({H}) to be a multiple of "
+            f"the '{axis}' axis size ({n}); use ring attention for this "
+            f"head count")
     return _cp_call(ulysses_attention_local, q, k, v, mesh, axis, causal)
