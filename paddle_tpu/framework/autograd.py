@@ -159,6 +159,69 @@ class TapeNode:
             grads[i] = g
         return grads
 
+    def vjp_recorded(self, out_grads: List[Optional[Any]]
+                     ) -> List[Optional[Any]]:
+        """create_graph=True backward: run this node's vjp THROUGH the
+        dispatch layer so the grads are themselves tape-recorded Tensors —
+        a second backward() can then differentiate through them (the
+        reference's retain+create_graph path, eager/backward.cc:446)."""
+        from .dispatch import apply
+        from .tensor import Tensor
+        if self.released:
+            raise RuntimeError(
+                f"TapeNode {self.name} has been released; pass "
+                "retain_graph=True to the first backward().")
+        diff_idx = tuple(i for i, m in enumerate(self.diff_in_mask) if m)
+        if not diff_idx:
+            return [None] * len(self.diff_in_mask)
+        present = tuple(g is not None for g, m in zip(
+            out_grads, self.diff_out_mask) if m)
+        cot_tensors = [g for g, m in zip(out_grads, self.diff_out_mask)
+                       if m and g is not None]
+        # reconstruct tape-linked input tensors from the frozen edges +
+        # value snapshots (live tensors may have been rebound in place)
+        in_tensors = []
+        for edge, val in zip(self.inputs, self.saved_vals):
+            t = Tensor(val, stop_gradient=edge.stop_gradient)
+            t._node = edge.node
+            t._out_idx = edge.out_idx
+            in_tensors.append(t)
+        outs = apply(
+            f"{self.name}.vjp", _vjp_op_generic, *in_tensors, *cot_tensors,
+            _closure=self.closure, _n=len(self.saved_vals),
+            _diff_idx=diff_idx, _present=present,
+            _diff_out_mask=tuple(self.diff_out_mask),
+            _out_avals=tuple((tuple(s), str(np.dtype(d)))
+                             for s, d in self.out_avals))
+        outs = outs if isinstance(outs, list) else [outs]
+        # The recorded vjp node's edges target the reconstructed proxies;
+        # retarget them at the ORIGINAL live tensors so second-order leaf
+        # grads accumulate on the user's tensors, not the proxies.
+        new_node = next((o._node for o in outs
+                         if getattr(o, "_node", None) is not None), None)
+        if new_node is not None:
+            for new_edge, orig_edge in zip(new_node.inputs, self.inputs):
+                new_edge.target = orig_edge.target
+        grads: List[Optional[Any]] = [None] * len(self.diff_in_mask)
+        for i, g in zip(diff_idx, outs):
+            grads[i] = g
+        return grads
+
+
+def _vjp_op_generic(*vals, _closure=None, _n=None, _diff_idx=(),
+                    _present=(), _diff_out_mask=(), _out_avals=()):
+    """The recorded-backward op body (create_graph=True): computes one tape
+    node's vjp as a pure function of (saved inputs..., cotangents...). All
+    node-specific configuration arrives as static kwargs so dispatch.apply's
+    (name, plan, static) cache key fully determines behavior."""
+    saved = vals[:_n]
+    cots = tuple(vals[_n:])
+    run = _get_vjp_executable(
+        _closure, _diff_idx, _diff_out_mask, _present,
+        tuple((tuple(v.shape), str(np.dtype(v.dtype))) for v in saved),
+        _out_avals)
+    return tuple(run.raw(saved, cots))
+
 
 class _VjpExecutable:
     __slots__ = ("raw", "jitted")
@@ -213,12 +276,16 @@ def _get_vjp_executable(closure, diff_idx, diff_out_mask, present,
 
 
 def _accumulate(tensor, grad_val, grad_accum: dict):
-    """Accumulate into a leaf tensor's .grad (GradNodeAccumulation analog)."""
+    """Accumulate into a leaf tensor's .grad (GradNodeAccumulation analog).
+    grad_val is a raw array, or a tape-linked Tensor under create_graph."""
     from .tensor import Tensor
     for hook in tensor._grad_hooks:
-        out = hook(Tensor(grad_val, stop_gradient=True))
+        hook_in = grad_val if isinstance(grad_val, Tensor) else \
+            Tensor(grad_val, stop_gradient=True)
+        out = hook(hook_in)
         if out is not None:
-            grad_val = out._value if isinstance(out, Tensor) else out
+            grad_val = out if isinstance(grad_val, Tensor) else (
+                out._value if isinstance(out, Tensor) else out)
     prev = grad_accum.get(id(tensor))
     if prev is None:
         grad_accum[id(tensor)] = (tensor, grad_val)
@@ -227,8 +294,12 @@ def _accumulate(tensor, grad_val, grad_accum: dict):
 
 
 def run_backward(tensors: Sequence, grad_tensors: Sequence,
-                 retain_graph: bool = False):
-    """Reverse traversal (egr::RunBackward analog, backward.cc:104)."""
+                 retain_graph: bool = False, create_graph: bool = False):
+    """Reverse traversal (egr::RunBackward analog, backward.cc:104).
+
+    create_graph=True runs each node's vjp through the dispatch layer so
+    the computed grads are themselves on the tape (double grad)."""
+    from .tensor import Tensor
     # node id -> per-output grad accumulation (GradTensorHolder analog)
     holders: dict = {}
     nodes: dict = {}
@@ -250,9 +321,14 @@ def run_backward(tensors: Sequence, grad_tensors: Sequence,
             raise RuntimeError(
                 "Tensor used in backward() has stop_gradient=True and no "
                 "recorded history")
-        gv = g._value if hasattr(g, "_value") else g
-        if gv is None:
-            gv = jnp.ones(t.shape, t.dtype)
+        if create_graph:
+            gv = g if isinstance(g, Tensor) else (
+                Tensor(g, stop_gradient=True) if g is not None else
+                Tensor(jnp.ones(t.shape, t.dtype), stop_gradient=True))
+        else:
+            gv = g._value if hasattr(g, "_value") else g
+            if gv is None:
+                gv = jnp.ones(t.shape, t.dtype)
         seed(t, gv)
 
     # Discover all reachable nodes so partially-seeded nodes still fire.
@@ -278,7 +354,8 @@ def run_backward(tensors: Sequence, grad_tensors: Sequence,
         out_grads = holders.pop(nid, None)
         if out_grads is None or all(g is None for g in out_grads):
             continue
-        in_grads = node.vjp(out_grads)
+        in_grads = (node.vjp_recorded(out_grads) if create_graph
+                    else node.vjp(out_grads))
         processed.append(node)
         for edge, g in zip(node.inputs, in_grads):
             if g is None or edge.stop_gradient:
@@ -294,10 +371,14 @@ def run_backward(tensors: Sequence, grad_tensors: Sequence,
                     heapq.heappush(heap, -pn.id)
                     in_heap.add(pn.id)
 
-    # Write leaf grads.
-    from .tensor import Tensor
+    # Write leaf grads. Under create_graph the accumulated grad is a
+    # tape-linked Tensor and must keep its history (double grad flows
+    # through .grad).
     for tensor, gval in leaf_accum.values():
-        if tensor._grad is None:
+        if isinstance(gval, Tensor):
+            tensor._grad = gval if tensor._grad is None else \
+                tensor._grad + gval
+        elif tensor._grad is None:
             tensor._grad = Tensor(gval, stop_gradient=True)
         else:
             tensor._grad = Tensor(tensor._grad._value + gval,
@@ -330,7 +411,8 @@ def grad_fn_of(outputs, inputs, grad_outputs=None, retain_graph=None,
     for t in inputs:
         t._grad = None
     try:
-        run_backward(outputs, grad_outputs, retain_graph=retain_graph)
+        run_backward(outputs, grad_outputs, retain_graph=retain_graph,
+                     create_graph=create_graph)
         results = []
         for t in inputs:
             if t._grad is None:

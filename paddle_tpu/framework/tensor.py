@@ -93,8 +93,11 @@ class Tensor:
 
     clear_gradient = clear_grad
 
-    def backward(self, grad_tensor=None, retain_graph: bool = False):
-        run_backward([self], [grad_tensor], retain_graph=retain_graph)
+    def backward(self, grad_tensor=None, retain_graph: bool = False,
+                 create_graph: bool = False):
+        run_backward([self], [grad_tensor],
+                     retain_graph=retain_graph or create_graph,
+                     create_graph=create_graph)
 
     def register_hook(self, hook):
         self._grad_hooks.append(hook)

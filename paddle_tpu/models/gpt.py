@@ -57,6 +57,8 @@ class GPTConfig:
     # MoE (expert parallel) — 0 experts = dense FFN
     num_experts: int = 0
     expert_capacity_factor: float = 1.25
+    moe_gate: str = "switch"          # parallel.moe.GATES: naive|switch|gshard
+    moe_aux_weight: float = 0.01      # load-balancing loss coefficient
     # real pipeline parallelism (reference 1F1B/interleaved schedules,
     # fleet/meta_parallel/pipeline_parallel.py:188,565): >1 microbatches +
     # a pp>1 mesh routes the block stack through parallel.pipeline's SPMD
@@ -237,35 +239,19 @@ def _dense_ffn(x, up_w, up_b, down_w, down_b):
 
 
 def _moe_ffn(x, gate_w, up_w, up_b, down_w, down_b, cfg):
-    """Top-1 switch MoE (reference: incubate MoELayer moe_layer.py:261 with
-    gshard/switch gates + global_scatter/global_gather all-to-all).
-
-    TPU-native: experts carry an 'ep'-sharded weight axis; the dispatch is a
-    dense einsum over a one-hot combine tensor — GSPMD turns the expert
-    contraction into the all-to-all when tokens and experts live on
-    different mesh axes."""
-    B, S, D = x.shape
-    E = cfg.num_experts
-    logits = jnp.einsum("bsd,de->bse", x.astype(jnp.float32),
-                        gate_w.astype(jnp.float32))
-    probs = jax.nn.softmax(logits, -1)
-    expert_idx = jnp.argmax(probs, -1)                    # [B,S]
-    onehot = jax.nn.one_hot(expert_idx, E, dtype=x.dtype)  # [B,S,E]
-    gate = jnp.take_along_axis(probs, expert_idx[..., None],
-                               -1)[..., 0].astype(x.dtype)
-    # dispatch: xe[e] = tokens routed to expert e (dense masked form)
-    xe = jnp.einsum("bsd,bse->ebsd", x, onehot)
-    h = jnp.einsum("ebsd,edf->ebsf", xe, up_w.astype(x.dtype))
-    h = h + up_b[:, None, None, :].astype(x.dtype)
-    h = jax.nn.gelu(h)
-    ye = jnp.einsum("ebsf,efd->ebsd", h, down_w.astype(x.dtype))
-    ye = ye + down_b[:, None, None, :].astype(x.dtype)
-    y = jnp.einsum("ebsd,bse->bsd", ye, onehot)
-    return y * gate[..., None]
+    """Capacity-based expert-parallel MoE (parallel.moe GShard dispatch;
+    reference incubate MoELayer moe_layer.py:261 + moe/gate zoo). Returns
+    (y, aux load-balancing loss); expert_capacity_factor and moe_gate come
+    from the config."""
+    from ..parallel.moe import moe_ffn
+    return moe_ffn(x, gate_w, up_w, up_b, down_w, down_b,
+                   gate=cfg.moe_gate,
+                   capacity_factor=cfg.expert_capacity_factor)
 
 
 def _block(params_l, x, cfg):
-    """One transformer block on stacked-layer slice params_l."""
+    """One transformer block on stacked-layer slice params_l.
+    Returns (x, aux) — aux is the MoE load-balancing loss (0 for dense)."""
     h = _sp_constraint(x, cfg)
     a_in = _ln(h, params_l["ln1_scale"], params_l["ln1_bias"],
                cfg.layer_norm_eps)
@@ -275,14 +261,15 @@ def _block(params_l, x, cfg):
     h = _sp_constraint(h + a, cfg)
     m_in = _ln(h, params_l["ln2_scale"], params_l["ln2_bias"],
                cfg.layer_norm_eps)
+    aux = jnp.zeros((), jnp.float32)
     if cfg.num_experts > 0:
-        m = _moe_ffn(m_in, params_l["gate_w"], params_l["moe_up_w"],
-                     params_l["moe_up_b"], params_l["moe_down_w"],
-                     params_l["moe_down_b"], cfg)
+        m, aux = _moe_ffn(m_in, params_l["gate_w"], params_l["moe_up_w"],
+                          params_l["moe_up_b"], params_l["moe_down_w"],
+                          params_l["moe_down_b"], cfg)
     else:
         m = _dense_ffn(m_in, params_l["mlp_up_w"], params_l.get("mlp_up_b"),
                        params_l["mlp_down_w"], params_l.get("mlp_down_b"))
-    return _sp_constraint(h + m, cfg)
+    return _sp_constraint(h + m, cfg), aux
 
 
 _BLOCK_KEYS_DENSE = ("ln1_scale", "ln1_bias", "ln2_scale", "ln2_bias",
@@ -307,7 +294,9 @@ def _pipeline_active(cfg: GPTConfig) -> int:
 
 def _apply_stack(stacked, x, cfg: GPTConfig):
     """Apply the transformer block stack: pipelined over the 'pp' mesh axis
-    when configured, else a layer-axis lax.scan (layer-weight sharding)."""
+    when configured, else a layer-axis lax.scan (layer-weight sharding).
+    Returns (x, aux) — summed MoE load-balancing loss (0 under the
+    pipelined path: per-stage aux does not circulate with activations)."""
     pp = _pipeline_active(cfg)
     if pp:
         from ..parallel.pipeline import pipeline_forward
@@ -328,28 +317,38 @@ def _apply_stack(stacked, x, cfg: GPTConfig):
 
         def stage_fn(chunk_params, h):
             def body_fn(h, lp):
-                return _block(lp, h, cfg), None
+                h2, _aux = _block(lp, h, cfg)
+                return h2, None
             h, _ = jax.lax.scan(body_fn, h, chunk_params)
             return h
 
+        if cfg.num_experts > 0 and cfg.moe_aux_weight != 0.0:
+            raise ValueError(
+                "MoE aux loss is not accumulated under the pipelined path "
+                "(per-stage aux does not circulate with activations); set "
+                "moe_aux_weight=0.0 explicitly to acknowledge dropping it "
+                "when combining num_experts>0 with pipeline_microbatches>1")
         x_mb = x.reshape((m, B // m) + x.shape[1:])
         y = pipeline_forward(stage_fn, chunked, x_mb, pp, m,
                              interleave=v, remat=cfg.remat)
-        return y.reshape(x.shape)
+        return y.reshape(x.shape), jnp.zeros((), jnp.float32)
 
     body = functools.partial(_block, cfg=cfg)
     if cfg.remat:
         body = jax.checkpoint(body)
 
-    def scan_fn(h, layer_params):
-        return body(layer_params, h), None
+    def scan_fn(carry, layer_params):
+        h, aux = carry
+        h2, aux_l = body(layer_params, h)
+        return (h2, aux + aux_l), None
 
-    x, _ = jax.lax.scan(scan_fn, x, stacked)
-    return x
+    (x, aux), _ = jax.lax.scan(
+        scan_fn, (x, jnp.zeros((), jnp.float32)), stacked)
+    return x, aux
 
 
-def gpt_forward(params, tokens, cfg: GPTConfig):
-    """tokens [B, S] int32 → logits [B, S, V] (compute dtype cfg.dtype)."""
+def _gpt_forward_impl(params, tokens, cfg: GPTConfig):
+    """→ (logits [B,S,V], aux MoE loss)."""
     B, S = tokens.shape
     x = jnp.take(params["wte"], tokens, axis=0).astype(cfg.dtype)
     x = x + params["wpe"][:S][None].astype(cfg.dtype)
@@ -358,23 +357,33 @@ def gpt_forward(params, tokens, cfg: GPTConfig):
     block_keys = _BLOCK_KEYS_MOE if cfg.num_experts > 0 else _BLOCK_KEYS_DENSE
     stacked = {k: params[k] for k in block_keys if k in params}
 
-    x = _apply_stack(stacked, x, cfg)
+    x, aux = _apply_stack(stacked, x, cfg)
     x = _ln(x, params["ln_f_scale"], params["ln_f_bias"], cfg.layer_norm_eps)
     # tied LM head (vocab-parallel matmul — mp shards the vocab dim)
     logits = jnp.einsum("bsd,vd->bsv", x, params["wte"].astype(x.dtype))
     logits = mesh_constraint(logits, P(("dp", "fsdp"), None, "mp"))
-    return logits
+    return logits, aux
+
+
+def gpt_forward(params, tokens, cfg: GPTConfig):
+    """tokens [B, S] int32 → logits [B, S, V] (compute dtype cfg.dtype)."""
+    return _gpt_forward_impl(params, tokens, cfg)[0]
 
 
 def gpt_loss(params, batch, cfg: GPTConfig):
-    """Causal LM loss; batch = (tokens[B,S+1]) or dict with input/labels."""
+    """Causal LM loss (+ MoE aux loss when experts are active);
+    batch = (tokens[B,S+1]) or dict with input/labels."""
     tokens = batch["tokens"] if isinstance(batch, dict) else batch
     inp, tgt = tokens[:, :-1], tokens[:, 1:]
-    logits = gpt_forward(params, inp, cfg).astype(jnp.float32)
+    logits, aux = _gpt_forward_impl(params, inp, cfg)
+    logits = logits.astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, -1)
     ll = jnp.take_along_axis(logp, tgt[..., None].astype(jnp.int32),
                              -1)[..., 0]
-    return -jnp.mean(ll)
+    loss = -jnp.mean(ll)
+    if cfg.num_experts > 0:
+        loss = loss + cfg.moe_aux_weight * aux
+    return loss
 
 
 # --------------------------------------------------------------------------

@@ -29,6 +29,8 @@ from .mp_layers import (  # noqa: F401
     ParallelCrossEntropy)
 from .random import (  # noqa: F401
     RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed)
+from .moe import (  # noqa: F401
+    MoELayer, moe_ffn, topk_gating, compute_capacity, GATES)
 from . import fleet  # noqa: F401
 
 

@@ -1,0 +1,78 @@
+"""Double-grad (create_graph) tests.
+
+Reference analog: the eager double-grad path (eager/backward.cc:446,
+test_imperative_double_grad.py). The tape records each node's vjp through
+the dispatch layer under create_graph=True, so grads are themselves
+differentiable Tensors.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_second_order_via_grad_twice():
+    x = paddle.to_tensor(np.array([2.0, 3.0], np.float32),
+                         stop_gradient=False)
+    y = (x ** 3).sum()
+    (g,) = paddle.grad(y, x, create_graph=True)
+    np.testing.assert_allclose(g.numpy(), 3 * np.array([4., 9.]), rtol=1e-6)
+    (g2,) = paddle.grad(g.sum(), x)
+    np.testing.assert_allclose(g2.numpy(), 6 * np.array([2., 3.]),
+                               rtol=1e-6)
+
+
+def test_second_order_via_backward():
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32),
+                         stop_gradient=False)
+    y = (x ** 4).sum()
+    (g,) = paddle.grad(y, x, create_graph=True)       # 4x^3
+    z = (g * g).sum()                                  # 16x^6
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(),
+                               96 * np.array([1., 32.]), rtol=1e-6)
+
+
+def test_second_order_matches_torch_mlp():
+    """Grad-of-grad through a small nonlinear MLP vs torch.autograd."""
+    torch = pytest.importorskip("torch")
+    rng = np.random.RandomState(0)
+    w_np = rng.randn(3, 3).astype(np.float32)
+    x_np = rng.randn(2, 3).astype(np.float32)
+
+    # paddle_tpu
+    w = paddle.to_tensor(w_np, stop_gradient=False)
+    x = paddle.to_tensor(x_np, stop_gradient=False)
+    h = paddle.tanh(paddle.matmul(x, w))
+    loss = (h * h).sum()
+    (gx,) = paddle.grad(loss, x, create_graph=True)
+    (ggx,) = paddle.grad((gx * gx).sum(), x)
+
+    # torch
+    wt = torch.tensor(w_np, requires_grad=True)
+    xt = torch.tensor(x_np, requires_grad=True)
+    ht = torch.tanh(xt @ wt)
+    lt = (ht * ht).sum()
+    gxt, = torch.autograd.grad(lt, xt, create_graph=True)
+    ggxt, = torch.autograd.grad((gxt * gxt).sum(), xt)
+
+    np.testing.assert_allclose(gx.numpy(), gxt.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(ggx.numpy(), ggxt.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_third_order():
+    x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    y = x ** 4
+    (g1,) = paddle.grad(y, x, create_graph=True)       # 4x^3
+    (g2,) = paddle.grad(g1, x, create_graph=True)      # 12x^2
+    (g3,) = paddle.grad(g2, x)                         # 24x
+    np.testing.assert_allclose(g3.numpy(), [48.0], rtol=1e-6)
+
+
+def test_create_graph_false_grads_are_detached():
+    x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    y = x ** 3
+    (g,) = paddle.grad(y, x)
+    assert g._node is None          # no history without create_graph
