@@ -101,8 +101,11 @@ def save_sharded(state, path: str, process_index: Optional[int] = None):
     pidx = jax.process_index() if process_index is None else process_index
     flat = _flatten(state)
     manifest: Dict[str, Any] = {"leaves": {}}
+    from ..framework.tensor import Tensor
     for key, leaf in flat.items():
-        if hasattr(leaf, "_value"):          # paddle Tensor/Parameter
+        # unwrap ONLY paddle Tensors: raw jax.Array also has a private
+        # `_value`, and pulling it would materialize the full array on host
+        if isinstance(leaf, Tensor):
             leaf = leaf._value
         safe = key.replace("/", "%")
         if np.isscalar(leaf) or (isinstance(leaf, (np.ndarray, jax.Array))
@@ -155,7 +158,13 @@ def _read_block(path, entry, want):
                  for (a, b), (w0, w1) in zip(zip(starts, stops), win)]
         if any(a >= b for a, b in inter):
             continue
-        data = np.load(os.path.join(path, sh["file"]), mmap_mode="r")
+        try:
+            data = np.load(os.path.join(path, sh["file"]), mmap_mode="r")
+        except FileNotFoundError as e:
+            raise ValueError(
+                f"checkpoint is missing data: shard file {sh['file']!r} is "
+                f"listed in the manifest but absent on disk — partial or "
+                f"corrupted checkpoint directory") from e
         src = tuple(slice(a - w0, b - w0)
                     for (a, b), (w0, w1) in zip(inter, win))
         dst = tuple(slice(a - s, b - s)

@@ -31,18 +31,46 @@ def test_roundtrip_unsharded(tmp_path):
 
 
 def test_sharded_files_not_full_arrays(tmp_path):
-    """Each saved file holds one shard, not the full array (no host pickle
-    of the global value — VERDICT weak #8 / missing #5)."""
+    """Each saved file holds one true shard, not the full array (no host
+    ever materializes the global value), and the manifest records the
+    PartitionSpec + one window per mesh device."""
+    import json
     mesh = build_mesh({"dp": 2, "mp": 4})
-    x = jnp.arange(8 * 6, dtype=jnp.float32).reshape(8, 6)
+    x = jnp.arange(8 * 8, dtype=jnp.float32).reshape(8, 8)
     with use_mesh(mesh):
         xs = shard_value(x, P("dp", "mp"), mesh)
         save_sharded({"w": xs}, str(tmp_path / "ck"))
     files = [f for f in (tmp_path / "ck").iterdir()
              if f.suffix == ".npy"]
-    assert len(files) == 8          # 2x4 shards
+    assert len(files) == 8          # nshards == mesh size (2x4)
     for f in files:
-        assert np.load(f).shape == (4, 1)          # 8/2 x 6/4... (4, 1.5)?
+        assert np.load(f).shape == (4, 2)          # 8/2 x 8/4
+    manifest = json.loads((tmp_path / "ck" / "manifest.json").read_text())
+    entry = manifest["leaves"]["w"]
+    assert entry["spec"] == ["dp", "mp"]           # spec round-trips
+    assert len(entry["shards"]) == 8
+
+
+def test_raw_jax_array_params_save_true_shards(tmp_path):
+    """Regression for the hasattr(leaf, '_value') bug: raw jax.Array state
+    (the GPT functional-params path) must save per-device shards with a
+    recorded spec — NOT one replicated full-array file with spec []."""
+    import json
+    mesh = build_mesh({"dp": 2, "mp": 4})
+    w = jnp.arange(16 * 4, dtype=jnp.float32).reshape(16, 4)
+    with use_mesh(mesh):
+        ws = shard_value(w, P("dp", None), mesh)
+        assert isinstance(ws, jax.Array)           # raw array, no facade
+        save_sharded({"w": ws}, str(tmp_path / "ck"))
+    manifest = json.loads((tmp_path / "ck" / "manifest.json").read_text())
+    entry = manifest["leaves"]["w"]
+    assert entry["spec"] == ["dp", None]
+    # dp=2 halves of the array, replicated over mp (replica_id>0 deduped)
+    assert len(entry["shards"]) == 2
+    windows = sorted(tuple(map(tuple, s["window"])) for s in entry["shards"])
+    assert windows == [(((0, 8)), ((0, 4))), (((8, 16)), ((0, 4)))]
+    for s in entry["shards"]:
+        assert np.load(tmp_path / "ck" / s["file"]).shape == (8, 4)
 
 
 def test_mesh_reshape_dp2mp4_to_dp4mp2(tmp_path):
