@@ -26,11 +26,11 @@ from .grad_scaler import GradScaler, AmpScaler  # noqa: F401
 WHITE_LIST = {
     "matmul", "mm", "linear", "linear_nobias", "conv1d_op", "conv2d_op",
     "conv3d_op", "conv1d_transpose_op", "conv2d_transpose_op",
-    "conv3d_transpose_op", "einsum", "bmm", "mv", "addmm",
+    "conv3d_transpose_op", "einsum", "mv", "addmm",
     "sdpa_op", "flash_attention_kernel", "memory_efficient_attention_op",
 }
 BLACK_LIST = {
-    "exp", "square", "log", "mean", "sum", "cos_sim", "softmax",
+    "exp", "square", "log", "mean", "sum", "cosine_similarity_op", "softmax",
     "log_softmax", "cross_entropy_hard", "cross_entropy_soft",
     "layer_norm_op", "rms_norm_op", "batch_norm_train", "batch_norm_eval",
     "group_norm_op", "instance_norm_op", "logsumexp", "erf", "erfinv",

@@ -1,0 +1,134 @@
+"""paddle_tpu.fft — discrete Fourier transforms.
+
+Reference analog: python/paddle/fft.py (paddle.fft namespace over the phi
+fft_c2c / fft_r2c / fft_c2r kernels backed by pocketfft/cuFFT —
+/root/reference/paddle/phi/kernels/funcs/fft.h). On TPU the transforms lower
+to XLA's FFT HLO; every function routes through the dispatch layer so tape
+gradients and to_static traces work like any other op.
+
+Norm conventions match the reference ("backward" | "ortho" | "forward").
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .framework.dispatch import apply
+from .framework.tensor import Tensor
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2",
+    "fftn", "ifftn", "rfftn", "irfftn", "hfftn", "ihfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+
+def _norm(norm):
+    if norm is None:
+        return "backward"
+    if norm not in ("backward", "ortho", "forward"):
+        raise ValueError(
+            f"norm must be 'backward'|'ortho'|'forward', got {norm!r}")
+    return norm
+
+
+def _mk1d(opname, jfn):
+    def f(x, n=None, axis=-1, norm="backward", name=None):
+        def _op(v, n, axis, norm):
+            return jfn(v, n=n, axis=axis, norm=norm)
+        return apply(opname, _op, x, n=None if n is None else int(n),
+                     axis=int(axis), norm=_norm(norm))
+    f.__name__ = opname
+    f.__doc__ = f"Reference: paddle.fft.{opname} (phi fft kernels)."
+    return f
+
+
+def _mkNd(opname, jfn, default_axes):
+    def f(x, s=None, axes=default_axes, norm="backward", name=None):
+        def _op(v, s, axes, norm):
+            return jfn(v, s=s, axes=axes, norm=norm)
+        return apply(opname, _op, x,
+                     s=None if s is None else tuple(int(v) for v in s),
+                     axes=None if axes is None
+                     else tuple(int(a) for a in axes),
+                     norm=_norm(norm))
+    f.__name__ = opname
+    f.__doc__ = f"Reference: paddle.fft.{opname} (phi fft kernels)."
+    return f
+
+
+fft = _mk1d("fft", jnp.fft.fft)          # c2c
+ifft = _mk1d("ifft", jnp.fft.ifft)
+rfft = _mk1d("rfft", jnp.fft.rfft)       # r2c
+irfft = _mk1d("irfft", jnp.fft.irfft)    # c2r
+hfft = _mk1d("hfft", jnp.fft.hfft)
+ihfft = _mk1d("ihfft", jnp.fft.ihfft)
+
+def _hfftn_impl(v, s, axes, norm):
+    """Hermitian-input n-D FFT (numpy relation: ifftn over the leading axes,
+    hfft over the last). axes=None means all axes; s follows axes order."""
+    if axes is None:
+        axes = tuple(range(v.ndim))
+    s_list = [None] * len(axes) if s is None else list(s)
+    if len(axes) > 1:
+        lead = None if s is None else tuple(s_list[:-1])
+        v = jnp.fft.ifftn(v, s=lead, axes=axes[:-1], norm=norm)
+    return jnp.fft.hfft(v, n=s_list[-1], axis=axes[-1], norm=norm)
+
+
+def _ihfftn_impl(v, s, axes, norm):
+    if axes is None:
+        axes = tuple(range(v.ndim))
+    s_list = [None] * len(axes) if s is None else list(s)
+    v = jnp.fft.ihfft(v, n=s_list[-1], axis=axes[-1], norm=norm)
+    if len(axes) > 1:
+        lead = None if s is None else tuple(s_list[:-1])
+        v = jnp.fft.fftn(v, s=lead, axes=axes[:-1], norm=norm)
+    return v
+
+
+fft2 = _mkNd("fft2", jnp.fft.fftn, (-2, -1))
+ifft2 = _mkNd("ifft2", jnp.fft.ifftn, (-2, -1))
+rfft2 = _mkNd("rfft2", jnp.fft.rfftn, (-2, -1))
+irfft2 = _mkNd("irfft2", jnp.fft.irfftn, (-2, -1))
+hfft2 = _mkNd("hfft2", _hfftn_impl, (-2, -1))
+ihfft2 = _mkNd("ihfft2", _ihfftn_impl, (-2, -1))
+
+fftn = _mkNd("fftn", jnp.fft.fftn, None)
+ifftn = _mkNd("ifftn", jnp.fft.ifftn, None)
+rfftn = _mkNd("rfftn", jnp.fft.rfftn, None)
+irfftn = _mkNd("irfftn", jnp.fft.irfftn, None)
+hfftn = _mkNd("hfftn", _hfftn_impl, None)
+ihfftn = _mkNd("ihfftn", _ihfftn_impl, None)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .framework.tensor import to_tensor
+    return to_tensor(np.fft.fftfreq(int(n), float(d)).astype(
+        np.dtype(dtype) if dtype else np.float32))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .framework.tensor import to_tensor
+    return to_tensor(np.fft.rfftfreq(int(n), float(d)).astype(
+        np.dtype(dtype) if dtype else np.float32))
+
+
+def fftshift(x, axes=None, name=None):
+    def _op(v, axes):
+        return jnp.fft.fftshift(v, axes=axes)
+    return apply("fftshift", _op, x,
+                 axes=None if axes is None else tuple(
+                     int(a) for a in (axes if isinstance(axes, (list, tuple))
+                                      else [axes])))
+
+
+def ifftshift(x, axes=None, name=None):
+    def _op(v, axes):
+        return jnp.fft.ifftshift(v, axes=axes)
+    return apply("ifftshift", _op, x,
+                 axes=None if axes is None else tuple(
+                     int(a) for a in (axes if isinstance(axes, (list, tuple))
+                                      else [axes])))

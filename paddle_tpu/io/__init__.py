@@ -303,9 +303,20 @@ class DataLoader:
                 yield self.collate_fn([self.dataset[i] for i in indices])
 
     def __iter__(self):
+        # reader-cost hooks for the ips timer (reference: profiler/timer.py
+        # Benchmark auto-attached to DataLoader)
+        from ..profiler.timer import benchmark
+        bm = benchmark()
         if self.num_workers == 0:
-            yield from self._batches()
-            return
+            it = self._batches()
+            while True:
+                bm.before_reader()
+                try:
+                    b = next(it)
+                except StopIteration:
+                    return
+                bm.after_reader()
+                yield b
         # thread prefetch pipeline
         q: "queue.Queue" = queue.Queue(
             maxsize=self.num_workers * self.prefetch_factor)
@@ -321,9 +332,11 @@ class DataLoader:
         t = threading.Thread(target=producer, daemon=True)
         t.start()
         while True:
+            bm.before_reader()
             item = q.get()
             if item is sentinel:
-                break
+                break          # sentinel pop is not a reader-cost sample
+            bm.after_reader()
             yield item
 
 

@@ -197,8 +197,19 @@ def _flash_mha_fwd(q, k, v, causal, kv_len=None):
     return out, (q, k, v, out, lse)
 
 
+def _pallas_bwd_enabled() -> bool:
+    import os
+    if os.environ.get("PADDLE_TPU_DISABLE_PALLAS_BWD", "") in ("1", "true",
+                                                               "True"):
+        return False
+    return _pallas_enabled()
+
+
 def _flash_mha_bwd(causal, kv_len, res, do):
     q, k, v, out, lse = res
+    if _pallas_bwd_enabled() and jax.default_backend() in ("tpu", "axon"):
+        from .pallas_attention import mha_bwd
+        return mha_bwd(q, k, v, out, lse, do, causal=causal, kv_len=kv_len)
     return _flash_bwd(q, k, v, out, lse, do, causal, kv_len)
 
 

@@ -50,6 +50,12 @@ class GPTConfig:
     dtype: Any = jnp.bfloat16                 # activation/compute dtype
     param_dtype: Any = jnp.float32
     remat: bool = True                        # jax.checkpoint each block
+    # remat selectivity (VERDICT r2: full-stack remat costs ~1/3 extra FLOPs
+    # on models that fit without it): "full" rematerializes everything;
+    # "dots" saves matmul/einsum outputs across the backward (XLA then only
+    # recomputes cheap elementwise/norm work — the flash-attention kernel
+    # keeps its own O(S·D) residuals via custom_vjp either way)
+    remat_policy: str = "full"                # "full" | "dots"
     sequence_parallel: bool = True            # SP on the 'mp' axis
     # context parallelism for long sequences: "none" | "ring" | "ulysses";
     # shards the sequence axis over the mesh's 'sp' axis ('mp' if absent)
@@ -335,7 +341,12 @@ def _apply_stack(stacked, x, cfg: GPTConfig):
 
     body = functools.partial(_block, cfg=cfg)
     if cfg.remat:
-        body = jax.checkpoint(body)
+        if cfg.remat_policy == "dots":
+            body = jax.checkpoint(
+                body,
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+        else:
+            body = jax.checkpoint(body)
 
     def scan_fn(carry, layer_params):
         h, aux = carry

@@ -9,7 +9,7 @@ from .common import (  # noqa: F401
     linear, embedding, dropout, dropout2d, dropout3d, alpha_dropout,
     normalize, label_smooth, pad, cosine_similarity, pixel_shuffle,
     pixel_unshuffle, channel_shuffle, interpolate, upsample, unfold, fold,
-    bilinear, sequence_mask)
+    bilinear, sequence_mask, grid_sample)
 from .conv import (  # noqa: F401
     conv1d, conv2d, conv3d, conv1d_transpose, conv2d_transpose,
     conv3d_transpose)

@@ -310,3 +310,74 @@ def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
         r = jnp.arange(maxlen)
         return (r[None, :] < lengths[..., None]).astype(dtype)
     return _sequence_mask(lengths, int(maxlen), dtypes.convert_dtype(dtype))
+
+
+@defop("grid_sample_op")
+def _grid_sample(x, grid, mode, padding_mode, align_corners):
+    N, C, H, W = x.shape
+    gx, gy = grid[..., 0], grid[..., 1]          # [N,Hg,Wg]
+
+    def unnorm(g, size):
+        if align_corners:
+            return (g + 1.0) * (size - 1) / 2.0
+        return ((g + 1.0) * size - 1.0) / 2.0
+
+    fx, fy = unnorm(gx, W), unnorm(gy, H)
+
+    def reflect(v, lo, hi):
+        # triangle wave into [lo, hi]: lo→lo, hi→hi, hi+d→hi-d
+        rng = hi - lo
+        if rng <= 0:
+            return jnp.full_like(v, lo)
+        t = jnp.mod(v - lo, 2 * rng)
+        return lo + (rng - jnp.abs(t - rng))
+
+    if padding_mode == "reflection":
+        if align_corners:
+            fx = reflect(fx, 0.0, W - 1.0)
+            fy = reflect(fy, 0.0, H - 1.0)
+        else:
+            fx = jnp.clip(reflect(fx, -0.5, W - 0.5), 0, W - 1)
+            fy = jnp.clip(reflect(fy, -0.5, H - 0.5), 0, H - 1)
+
+    def sample(ix, iy):
+        # gather x[n, :, iy, ix] with out-of-range handling
+        inb = ((ix >= 0) & (ix <= W - 1) & (iy >= 0)
+               & (iy <= H - 1))                  # [N,Hg,Wg]
+        ixc = jnp.clip(ix, 0, W - 1).astype(jnp.int32)
+        iyc = jnp.clip(iy, 0, H - 1).astype(jnp.int32)
+        nidx = jnp.arange(N)[:, None, None]
+        vals = x[nidx, :, iyc, ixc]              # [N,Hg,Wg,C]
+        if padding_mode == "zeros":
+            vals = jnp.where(inb[..., None], vals, 0.0)
+        return vals, inb
+
+    if mode == "nearest":
+        vals, _ = sample(jnp.round(fx), jnp.round(fy))
+        return jnp.moveaxis(vals, -1, 1).astype(x.dtype)
+
+    x0, y0 = jnp.floor(fx), jnp.floor(fy)
+    x1, y1 = x0 + 1, y0 + 1
+    wx1, wy1 = fx - x0, fy - y0
+    wx0, wy0 = 1.0 - wx1, 1.0 - wy1
+    out = 0.0
+    for ix, wx in ((x0, wx0), (x1, wx1)):
+        for iy, wy in ((y0, wy0), (y1, wy1)):
+            vals, _ = sample(ix, iy)
+            out = out + vals * (wx * wy)[..., None]
+    return jnp.moveaxis(out, -1, 1).astype(x.dtype)
+
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Spatial sampling by a flow field (reference: ops.yaml `grid_sample`,
+    phi grid_sample_kernel). x [N,C,H,W], grid [N,Hg,Wg,2] with xy in
+    [-1,1] → [N,C,Hg,Wg]. Gather+lerp — XLA fuses it into one kernel."""
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"grid_sample mode must be bilinear|nearest, "
+                         f"got {mode!r}")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(f"bad padding_mode {padding_mode!r}")
+    return _grid_sample(x, grid, mode=mode, padding_mode=padding_mode,
+                        align_corners=bool(align_corners))

@@ -161,27 +161,32 @@ def _nll_loss(input, label, weight, ignore_index, reduction):
     return _reduce(loss, reduction)
 
 
+@defop("nll_loss_gather")
+def _nll_gather(input, label, weight, ignore_index, reduction):  # noqa: A002
+    valid = (label != ignore_index)
+    safe = jnp.where(valid, label, 0).astype(jnp.int32)
+    picked = jnp.take_along_axis(input, safe[:, None, ...], axis=1)
+    picked = jnp.squeeze(picked, axis=1)
+    loss = -picked
+    if weight is not None:
+        w = jnp.take(weight, safe, axis=0)
+        loss = jnp.where(valid, loss * w, 0.0)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.sum(jnp.where(valid, w, 0.0))
+    else:
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            # total_weight = count of non-ignored labels (paddle/torch)
+            return jnp.sum(loss) / jnp.maximum(
+                jnp.sum(valid.astype(loss.dtype)), 1.0)
+    loss = jnp.where(valid, loss, 0.0)
+    return _reduce(loss, reduction)
+
+
 def nll_loss(input, label, weight=None, ignore_index=-100,  # noqa: A002
              reduction="mean", name=None):
     # input: log-probabilities [N, C, ...]; gather along class dim
-    if input.ndim > 2:
-        # flatten spatial dims into batch
-        pass
-    @defop("nll_loss_gather")
-    def _nll(input, label, weight, ignore_index, reduction):
-        valid = (label != ignore_index)
-        safe = jnp.where(valid, label, 0).astype(jnp.int32)
-        picked = jnp.take_along_axis(input, safe[:, None, ...], axis=1)
-        picked = jnp.squeeze(picked, axis=1)
-        loss = -picked
-        if weight is not None:
-            w = jnp.take(weight, safe, axis=0)
-            loss = jnp.where(valid, loss * w, 0.0)
-            if reduction == "mean":
-                return jnp.sum(loss) / jnp.sum(jnp.where(valid, w, 0.0))
-        loss = jnp.where(valid, loss, 0.0)
-        return _reduce(loss, reduction)
-    return _nll(input, label, weight, int(ignore_index), reduction)
+    return _nll_gather(input, label, weight, int(ignore_index), reduction)
 
 
 @defop("bce_loss_op")

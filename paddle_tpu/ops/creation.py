@@ -201,3 +201,109 @@ def one_hot(x, num_classes, name=None):
 
 def to_paddle_tensor(x):
     return to_tensor(x)
+
+
+# ---- op-gap closure (reference ops.yaml parity; see ops/optable.py) -------
+import builtins  # noqa: E402  (shadow-safe names for max/min/abs below)
+
+
+@defop("logspace")
+def _logspace(start, stop, num, base, dtype):
+    return jnp.logspace(start, stop, int(num), base=base,
+                        dtype=dtype or dtypes.get_default_dtype())
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    """Reference: ops.yaml `logspace`."""
+    return _logspace(float(start), float(stop), int(num), float(base),
+                     _dt(dtype))
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64", name=None):
+    """Reference: ops.yaml `tril_indices` (returns [2, n] like paddle)."""
+    col = row if col is None else col
+    r, c = np.tril_indices(int(row), int(offset), int(col))
+    return to_tensor(np.stack([r, c]).astype(np.dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64", name=None):
+    """Reference: ops.yaml `triu_indices`."""
+    col = row if col is None else col
+    r, c = np.triu_indices(int(row), int(offset), int(col))
+    return to_tensor(np.stack([r, c]).astype(np.dtype(dtype)))
+
+
+@defop("complex")
+def _complex(real, imag):
+    return jax.lax.complex(real, imag)
+
+
+def complex(real, imag, name=None):
+    """Reference: ops.yaml `complex` (build complex from re/im parts)."""
+    return _complex(real, imag)
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    """Reference: ops.yaml `diag_embed` — batch vectors → diagonal mats."""
+    def _embed(x, offset):
+        n = x.shape[-1] + builtins.abs(int(offset))
+        out = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+        idx = jnp.arange(x.shape[-1])
+        r = idx + builtins.max(0, -int(offset))
+        c = idx + builtins.max(0, int(offset))
+        return out.at[..., r, c].set(x)
+    out = apply("diag_embed_impl", _embed, input, offset=int(offset))
+    if (dim1, dim2) not in ((-2, -1), (input.ndim - 1, input.ndim)):
+        from .manipulation import moveaxis
+        out = moveaxis(out, (-2, -1), (dim1, dim2))
+    return out
+
+
+def broadcast_tensors(inputs, name=None):
+    """Reference: ops.yaml `broadcast_tensors`."""
+    def _bc(*xs):
+        shape = jnp.broadcast_shapes(*[x.shape for x in xs])
+        return tuple(jnp.broadcast_to(x, shape) for x in xs)
+    return apply("broadcast_tensors", _bc, *inputs)
+
+
+def fill_(x, value):
+    """In-place fill (reference legacy `fill`/`full_`)."""
+    def _fill(v, value):
+        return jnp.full_like(v, value)
+    out = apply("fill_", _fill, x, value=float(value))
+    inplace_rebind(x, out)
+    return x
+
+
+full_ = fill_
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    """Reference: ops.yaml `fill_diagonal` (in-place). wrap=True continues
+    the diagonal past the bottom of a tall 2-D matrix (one skipped row per
+    wrap, the torch/paddle convention)."""
+    def _fd(v, value, offset, wrap):
+        H, W = v.shape[-2], v.shape[-1]
+        if wrap:
+            if v.ndim != 2:
+                raise ValueError("fill_diagonal_(wrap=True) needs a 2-D "
+                                 "tensor")
+            start = offset if offset >= 0 else -offset * W
+            idx = jnp.arange(start, H * W, W + 1)
+            return v.ravel().at[idx].set(value).reshape(v.shape)
+        # diagonal length on (possibly) non-square matrices
+        if offset >= 0:
+            L = builtins.min(H, W - offset)
+        else:
+            L = builtins.min(H + offset, W)
+        if L <= 0:
+            return v
+        idx = jnp.arange(L)
+        r = idx + builtins.max(0, -int(offset))
+        c = idx + builtins.max(0, int(offset))
+        return v.at[..., r, c].set(value)
+    out = apply("fill_diagonal_", _fd, x, value=float(value),
+                offset=int(offset), wrap=builtins.bool(wrap))
+    inplace_rebind(x, out)
+    return x

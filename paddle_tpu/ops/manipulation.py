@@ -595,3 +595,46 @@ def is_integer(x):
 def rank(x):
     from ..framework.tensor import to_tensor
     return to_tensor(np.asarray(x.ndim, dtype=np.int32))
+
+
+# ---- op-gap closure (reference ops.yaml parity; see ops/optable.py) -------
+def reverse(x, axis, name=None):
+    """Reference: legacy `reverse` — alias of flip."""
+    return flip(x, axis)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    """Reference: ops.yaml `crop` (slice a window out of x); shape=-1 takes
+    everything from the offset to the end of that dim (CropInferMeta)."""
+    shape = [int(s) for s in (shape if shape is not None else x.shape)]
+    offsets = [int(o) for o in (offsets if offsets is not None
+                                else [0] * len(shape))]
+    shape = [xs - o if s == -1 else s
+             for s, o, xs in zip(shape, offsets, x.shape)]
+
+    def _crop(v, offsets, shape):
+        return jax.lax.slice(v, offsets,
+                             [o + s for o, s in zip(offsets, shape)])
+    return apply("crop", _crop, x, offsets=tuple(offsets),
+                 shape=tuple(shape))
+
+
+def gather_tree(ids, parents, name=None):
+    """Beam-search ancestor walk (reference: ops.yaml `gather_tree`,
+    phi gather_tree_kernel): ids/parents [max_time, batch, beam] → full
+    backtracked sequences. lax.scan backward over time."""
+    def _gather_tree(ids, parents):
+        T = ids.shape[0]
+        beam_idx = jnp.arange(ids.shape[2])[None, :]         # [1, beam]
+
+        def step(carry, t):
+            parent = carry                                    # [batch, beam]
+            out_t = jnp.take_along_axis(ids[t], parent, axis=1)
+            next_parent = jnp.take_along_axis(parents[t], parent, axis=1)
+            return next_parent, out_t
+
+        init = jnp.broadcast_to(beam_idx,
+                                (ids.shape[1], ids.shape[2]))
+        _, outs = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+        return jnp.flip(outs, axis=0)
+    return apply("gather_tree", _gather_tree, ids, parents)

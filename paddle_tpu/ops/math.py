@@ -8,12 +8,13 @@ why there is no separate "fused elementwise" zoo.
 """
 from __future__ import annotations
 
+import builtins
 import numpy as np
 import jax
 import jax.numpy as jnp
 
 from ..framework import dtype as dtypes
-from ..framework.dispatch import defop, apply
+from ..framework.dispatch import defop, apply, register_op
 from ..framework.tensor import Tensor, inplace_rebind
 
 
@@ -436,9 +437,14 @@ def kron(x, y, name=None):
     return _kron(x, y)
 
 
+def _einsum(*ops, eq=None):
+    return jnp.einsum(eq, *ops)
+
+
+register_op("einsum", _einsum)   # AMP white-list + op-table visibility
+
+
 def einsum(equation, *operands):
-    def _einsum(*ops, eq=None):
-        return jnp.einsum(eq, *ops)
     return apply("einsum", _einsum, *operands, eq=equation)
 
 
@@ -542,3 +548,94 @@ def bincount(x, weights=None, minlength=0, name=None):
     out = np.bincount(xs, weights=ws, minlength=minlength)
     from ..framework.tensor import to_tensor
     return to_tensor(out)
+
+
+# ---- op-gap closure (reference ops.yaml parity; see ops/optable.py) -------
+@defop("logit")
+def _logit(x, eps):
+    xc = jnp.clip(x, eps, 1.0 - eps) if eps is not None else x
+    return jnp.log(xc) - jnp.log1p(-xc)
+
+
+def logit(x, eps=None, name=None):
+    """Reference: ops.yaml `logit` (inverse sigmoid)."""
+    return _logit(x, eps=None if eps is None else float(eps))
+
+
+@defop("dist")
+def _dist(x, y, p):
+    d = (x - y).ravel()
+    if p == 0:
+        return jnp.count_nonzero(d).astype(x.dtype)
+    if np.isinf(p):
+        return (jnp.max(jnp.abs(d)) if p > 0
+                else jnp.min(jnp.abs(d))).astype(x.dtype)
+    return (jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)).astype(x.dtype)
+
+
+def dist(x, y, p=2, name=None):
+    """Reference: ops.yaml `dist` (p-norm of x - y)."""
+    return _dist(x, y, p=float(p))
+
+
+def add_n(inputs, name=None):
+    """Reference: legacy_ops.yaml `add_n` (sum a list of tensors)."""
+    if isinstance(inputs, Tensor):
+        return inputs
+
+    def _add_n(*xs):
+        out = xs[0]
+        for v in xs[1:]:
+            out = out + v
+        return out
+    return apply("add_n", _add_n, *inputs)
+
+
+@defop("clip_by_norm")
+def _clip_by_norm(x, max_norm):
+    nrm = jnp.sqrt(jnp.maximum(jnp.sum(jnp.square(x)), 1e-12))
+    return jnp.where(nrm > max_norm, x * (max_norm / nrm), x)
+
+
+def clip_by_norm(x, max_norm, name=None):
+    """Reference: ops.yaml `clip_by_norm` (L2-norm clip)."""
+    return _clip_by_norm(x, float(max_norm))
+
+
+def mean_all(x, name=None):
+    """Reference: legacy `mean_all` (global mean — the `mean` op's
+    all-reduce form)."""
+    return mean(x)
+
+
+def frobenius_norm(x, axis=None, keepdim=False, name=None):
+    """Reference: legacy_ops.yaml `frobenius_norm`."""
+    def _fro(v, axis, keepdim):
+        return jnp.sqrt(jnp.sum(jnp.square(v), axis=axis, keepdims=keepdim))
+    return apply("frobenius_norm", _fro, x,
+                 axis=_axis(axis), keepdim=builtins.bool(keepdim))
+
+
+def p_norm(x, p=2, axis=None, epsilon=1e-12, keepdim=False, as_vector=False,
+           name=None):
+    """Reference: ops.yaml `p_norm` (the kernel behind paddle.norm's
+    vector form)."""
+    def _pn(v, p, axis, keepdim, flat, eps):
+        if flat:
+            v = v.ravel()
+            axis = None
+        if np.isinf(p):
+            r = jnp.max(jnp.abs(v), axis=axis, keepdims=keepdim) if p > 0 \
+                else jnp.min(jnp.abs(v), axis=axis, keepdims=keepdim)
+            return r
+        if p == 0:
+            return jnp.count_nonzero(v, axis=axis, keepdims=keepdim) \
+                .astype(v.dtype)
+        # epsilon floors the power sum (reference kernel semantics): keeps
+        # the zero-vector norm and its gradient finite
+        s = jnp.maximum(jnp.sum(jnp.abs(v) ** p, axis=axis,
+                                keepdims=keepdim), eps)
+        return s ** (1.0 / p)
+    return apply("p_norm", _pn, x, p=float(p), axis=_axis(axis),
+                 keepdim=builtins.bool(keepdim),
+                 flat=builtins.bool(as_vector), eps=float(epsilon))

@@ -176,3 +176,37 @@ def rand_like(x, dtype=None, name=None):
 
 def randn_like(x, dtype=None, name=None):
     return gaussian(tuple(x.shape), 0.0, 1.0, dtype=dtype or x.dtype)
+
+
+# ---- op-gap closure (reference ops.yaml parity; see ops/optable.py) -------
+@defop("dirichlet")
+def _dirichlet(key, alpha):
+    return jax.random.dirichlet(key, alpha)
+
+
+def dirichlet(alpha, name=None):
+    """Reference: ops.yaml `dirichlet` — sample Dirichlet(alpha) along the
+    last axis of alpha."""
+    return _dirichlet(next_key(), alpha)
+
+
+@defop("truncated_gaussian_random")
+def _trunc_normal(key, shape, mean, std, a, b, dtype):
+    z = jax.random.truncated_normal(key, a, b, shape, jnp.float32)
+    return (z * std + mean).astype(dtype)
+
+
+def standard_gamma(alpha, name=None):
+    """Sample Gamma(alpha, 1) (reference: distribution kernels)."""
+    def _g(key, a):
+        return jax.random.gamma(key, a)
+    return apply("standard_gamma", _g, next_key(), alpha)
+
+
+def truncated_normal(shape, mean=0.0, std=1.0, a=-2.0, b=2.0, dtype=None,
+                     name=None):
+    """Reference: legacy `truncated_gaussian_random` (init kernels)."""
+    from ..framework import dtype as _dt
+    dt = np.dtype(dtype) if dtype is not None else _dt.get_default_dtype()
+    return _trunc_normal(next_key(), tuple(int(s) for s in shape),
+                         float(mean), float(std), float(a), float(b), dt)

@@ -1,0 +1,292 @@
+"""paddle_tpu.profiler — tracing/profiling subsystem.
+
+Reference analog: python/paddle/profiler/profiler.py:340 (`Profiler` with
+scheduler states), utils.py:37 (`RecordEvent`), profiler_statistic.py (stats
+tables), timer.py (throughput/ips benchmark auto-attached to DataLoader);
+C++ substrate paddle/fluid/platform/profiler/ (RecordEvent spans into a
+host-event recorder + CUPTI tracer, chrome-trace export).
+
+TPU-native design — two complementary recorders behind one API:
+- Host spans: `RecordEvent` keeps a process-local span log (name, wall-time,
+  nesting depth). On TPU the host side is dispatch/input-pipeline work; this
+  is what `summary()` tabulates and what the ips timer reads. Zero deps.
+- Device/XLA trace: when a trace dir is configured (`on_trace_ready=
+  export_chrome_tracing(dir)` or `Profiler(trace_dir=...)`), start/stop wrap
+  `jax.profiler.start_trace/stop_trace`, producing a TensorBoard-loadable
+  XLA trace with per-op device timelines; `RecordEvent` doubles as a
+  `jax.profiler.TraceAnnotation` so host spans appear on that timeline too.
+"""
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from typing import Callable, Iterable, Optional
+
+from .timer import benchmark  # noqa: F401  (reference: profiler/timer.py)
+
+
+class ProfilerState(enum.Enum):
+    """Scheduler states (reference profiler.py:79)."""
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1          # accepted for API compat; mapped onto the device trace
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """Step-indexed state machine: skip_first CLOSED steps, then cycles of
+    [closed CLOSED, ready READY, record RECORD(last=RECORD_AND_RETURN)],
+    `repeat` times (0 = forever). Reference: profiler.py make_scheduler."""
+    cycle = closed + ready + record
+
+    def schedule(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        step -= skip_first
+        if repeat and step >= repeat * cycle:
+            return ProfilerState.CLOSED
+        pos = step % cycle
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return schedule
+
+
+def _default_scheduler(_step: int) -> ProfilerState:
+    return ProfilerState.RECORD
+
+
+# ------------------------------------------------------------- span recorder
+class _SpanLog:
+    """Process-local completed-span log (the HostEventRecorder analog)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self.spans = []          # (name, start, dur_s, depth)
+        self.enabled = True
+
+    def depth(self) -> int:
+        return getattr(self._tls, "depth", 0)
+
+    def push(self):
+        self._tls.depth = self.depth() + 1
+
+    def pop(self, name: str, start: float):
+        d = self.depth() - 1
+        self._tls.depth = d
+        if self.enabled:
+            with self._lock:
+                self.spans.append((name, start, time.perf_counter() - start,
+                                   d))
+
+    def clear(self):
+        with self._lock:
+            self.spans = []
+
+
+_LOG = _SpanLog()
+
+
+class RecordEvent:
+    """Span context manager / decorator (reference utils.py:37). Records a
+    host span and annotates the XLA trace when one is active."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._start = None
+        self._annot = None
+
+    def begin(self):
+        self._start = time.perf_counter()
+        _LOG.push()
+        try:
+            import jax
+            self._annot = jax.profiler.TraceAnnotation(self.name)
+            self._annot.__enter__()
+        except Exception:
+            self._annot = None
+        return self
+
+    def end(self):
+        if self._annot is not None:
+            self._annot.__exit__(None, None, None)
+            self._annot = None
+        if self._start is not None:
+            _LOG.pop(self.name, self._start)
+            self._start = None
+
+    __enter__ = begin
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapped(*a, **k):
+            with RecordEvent(self.name):
+                return fn(*a, **k)
+        return wrapped
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    """on_trace_ready factory: configures the XLA trace dir (TensorBoard /
+    chrome-trace loadable — reference ChromeTracingLogger analog)."""
+
+    def handler(prof: "Profiler"):
+        prof._trace_dir = dir_name
+    handler._trace_dir = dir_name
+    return handler
+
+
+def export_protobuf(dir_name: str, worker_name: Optional[str] = None):
+    """Alias of export_chrome_tracing: the jax trace IS a protobuf dump."""
+    return export_chrome_tracing(dir_name, worker_name)
+
+
+class Profiler:
+    """Reference-shaped profiler (profiler.py:340).
+
+    with profiler.Profiler(scheduler=(2, 5)) as p:
+        for batch in loader:
+            train_step(...)
+            p.step()
+    print(p.summary())
+    """
+
+    def __init__(self, *, targets: Optional[Iterable] = None,
+                 scheduler=None, on_trace_ready=None, timer_only: bool = False,
+                 trace_dir: Optional[str] = None):
+        if scheduler is None:
+            self._schedule = _default_scheduler
+        elif callable(scheduler):
+            self._schedule = scheduler
+        else:  # (start, end) step-range tuple, reference-accepted form
+            lo, hi = scheduler
+            self._schedule = make_scheduler(closed=lo, ready=0, record=hi - lo,
+                                            repeat=1)
+        self.targets = list(targets) if targets else [ProfilerTarget.CPU]
+        self._trace_dir = trace_dir
+        if on_trace_ready is not None:
+            td = getattr(on_trace_ready, "_trace_dir", None)
+            if td:
+                self._trace_dir = td
+        self._on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self.step_num = 0
+        self.current_state = ProfilerState.CLOSED
+        self._device_tracing = False
+        self._step_times = []
+        self._last_step_t = None
+
+    # -------------------------------------------------------------- control
+    def start(self):
+        benchmark().begin()
+        self.current_state = self._schedule(self.step_num)
+        self._sync_device_trace()
+        self._last_step_t = time.perf_counter()
+        return self
+
+    def stop(self):
+        if self._device_tracing:
+            import jax
+            jax.profiler.stop_trace()
+            self._device_tracing = False
+        benchmark().end()
+        if self._on_trace_ready is not None:
+            self._on_trace_ready(self)
+        self.current_state = ProfilerState.CLOSED
+
+    def step(self, num_samples: Optional[int] = None):
+        now = time.perf_counter()
+        if self._last_step_t is not None:
+            self._step_times.append(now - self._last_step_t)
+        self._last_step_t = now
+        benchmark().step(num_samples)
+        self.step_num += 1
+        prev = self.current_state
+        self.current_state = self._schedule(self.step_num)
+        if prev != self.current_state:
+            self._sync_device_trace()
+
+    def _sync_device_trace(self):
+        want = (self.current_state in (ProfilerState.RECORD,
+                                       ProfilerState.RECORD_AND_RETURN)
+                and self._trace_dir is not None and not self.timer_only)
+        if want and not self._device_tracing:
+            import jax
+            jax.profiler.start_trace(self._trace_dir)
+            self._device_tracing = True
+        elif not want and self._device_tracing:
+            import jax
+            jax.profiler.stop_trace()
+            self._device_tracing = False
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # ------------------------------------------------------------- reporting
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms") -> str:
+        """Host-span stats table + step-time stats (the reference's
+        profiler_statistic tables, host side)."""
+        unit = {"s": 1.0, "ms": 1e3, "us": 1e6}.get(time_unit, 1e3)
+        agg = {}
+        for name, _start, dur, _depth in _LOG.spans:
+            c, tot, mx = agg.get(name, (0, 0.0, 0.0))
+            agg[name] = (c + 1, tot + dur, max(mx, dur))
+        lines = [f"{'name':<40} {'calls':>6} {'total':>10} {'avg':>10} "
+                 f"{'max':>10}  ({time_unit})"]
+        for name, (c, tot, mx) in sorted(agg.items(), key=lambda kv: -kv[1][1]):
+            lines.append(f"{name:<40} {c:>6} {tot * unit:>10.3f} "
+                         f"{tot / c * unit:>10.3f} {mx * unit:>10.3f}")
+        if self._step_times:
+            st = sorted(self._step_times)
+            n = len(st)
+            lines.append("")
+            lines.append(
+                f"steps: {n}  avg {sum(st) / n * unit:.3f}{time_unit}  "
+                f"p50 {st[n // 2] * unit:.3f}{time_unit}  "
+                f"min {st[0] * unit:.3f}{time_unit}  "
+                f"max {st[-1] * unit:.3f}{time_unit}")
+        return "\n".join(lines)
+
+    @property
+    def step_times(self):
+        return list(self._step_times)
+
+
+def get_profiler_spans():
+    """Raw completed host spans [(name, start, dur_s, depth), ...]."""
+    return list(_LOG.spans)
+
+
+def clear_profiler_spans():
+    _LOG.clear()
+
+
+def load_profiler_result(filename: str):
+    raise NotImplementedError(
+        "XLA traces are TensorBoard artifacts; point TensorBoard at the "
+        "trace dir passed to export_chrome_tracing instead.")

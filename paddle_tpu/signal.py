@@ -1,0 +1,144 @@
+"""paddle_tpu.signal — frame / overlap_add / stft / istft.
+
+Reference analog: python/paddle/signal.py over the phi `frame`,
+`overlap_add` kernels (/root/reference/paddle/phi/kernels/frame_kernel.h)
+and fft. TPU-native: frame is a strided gather (XLA lowers it to one
+dynamic-slice fusion), overlap_add is a segment scatter-add, stft/istft
+compose them with paddle_tpu.fft — all differentiable through the tape.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .framework.dispatch import apply
+from .framework.tensor import Tensor
+from . import fft as _fft
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice x into overlapping frames along `axis`.
+    [..., seq_len] -> [..., frame_length, num_frames] (axis=-1, the
+    reference layout) or [num_frames, frame_length, ...] for axis=0."""
+    fl, hl = int(frame_length), int(hop_length)
+
+    def _frame(v, fl, hl, axis):
+        if axis in (0,):
+            v = jnp.moveaxis(v, 0, -1)
+        n = v.shape[-1]
+        num = (n - fl) // hl + 1
+        idx = (jnp.arange(fl)[None, :]
+               + hl * jnp.arange(num)[:, None])       # [num, fl]
+        out = v[..., idx]                             # [..., num, fl]
+        out = jnp.swapaxes(out, -1, -2)               # [..., fl, num]
+        if axis in (0,):
+            out = jnp.moveaxis(jnp.moveaxis(out, -1, 0), -1, 1)
+        return out
+    return apply("frame", _frame, x, fl=fl, hl=hl, axis=int(axis))
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame: [..., frame_length, num_frames] -> [..., seq_len]
+    with overlapping frames summed (segment scatter-add)."""
+    hl = int(hop_length)
+
+    def _ola(v, hl, axis):
+        if axis in (0,):
+            v = jnp.moveaxis(jnp.moveaxis(v, 0, -1), 0, -2)  # [..., fl, num]
+        fl, num = v.shape[-2], v.shape[-1]
+        n = (num - 1) * hl + fl
+        idx = (jnp.arange(fl)[:, None]
+               + hl * jnp.arange(num)[None, :])       # [fl, num]
+        out = jnp.zeros(v.shape[:-2] + (n,), v.dtype)
+        out = out.at[..., idx].add(v)
+        if axis in (0,):
+            out = jnp.moveaxis(out, -1, 0)
+        return out
+    return apply("overlap_add", _ola, x, hl=hl, axis=int(axis))
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """Short-time Fourier transform, reference-shaped
+    (python/paddle/signal.py:stft): x [B, T] (or [T]) ->
+    [B, n_fft//2+1 (or n_fft), num_frames] complex."""
+    n_fft = int(n_fft)
+    hop_length = n_fft // 4 if hop_length is None else int(hop_length)
+    win_length = n_fft if win_length is None else int(win_length)
+
+    if window is None:
+        win = jnp.ones((win_length,), jnp.float32)
+    else:
+        win = window._value if isinstance(window, Tensor) else jnp.asarray(
+            window)
+    if win_length < n_fft:      # center-pad the window to n_fft
+        lp = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lp, n_fft - win_length - lp))
+
+    def _stft(v, w, n_fft, hop, center, pad_mode, normalized, onesided):
+        if center:
+            pw = [(0, 0)] * (v.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            v = jnp.pad(v, pw, mode=pad_mode)
+        n = v.shape[-1]
+        num = (n - n_fft) // hop + 1
+        idx = jnp.arange(n_fft)[None, :] + hop * jnp.arange(num)[:, None]
+        frames = v[..., idx] * w                       # [..., num, n_fft]
+        spec = (jnp.fft.rfft(frames, axis=-1) if onesided
+                else jnp.fft.fft(frames, axis=-1))     # [..., num, nbin]
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.float32(n_fft))
+        return jnp.swapaxes(spec, -1, -2)              # [..., nbin, num]
+    return apply("stft", _stft, x, win, n_fft=n_fft, hop=hop_length,
+                 center=bool(center), pad_mode=str(pad_mode),
+                 normalized=bool(normalized), onesided=bool(onesided))
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT with window-envelope normalization (reference
+    signal.py:istft)."""
+    n_fft = int(n_fft)
+    hop_length = n_fft // 4 if hop_length is None else int(hop_length)
+    win_length = n_fft if win_length is None else int(win_length)
+    if window is None:
+        win = jnp.ones((win_length,), jnp.float32)
+    else:
+        win = window._value if isinstance(window, Tensor) else jnp.asarray(
+            window)
+    if win_length < n_fft:
+        lp = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lp, n_fft - win_length - lp))
+
+    def _istft(v, w, n_fft, hop, center, normalized, onesided, length,
+               return_complex):
+        v = jnp.swapaxes(v, -1, -2)                    # [..., num, nbin]
+        if normalized:
+            v = v * jnp.sqrt(jnp.float32(n_fft))
+        frames = (jnp.fft.irfft(v, n=n_fft, axis=-1) if onesided
+                  else jnp.fft.ifft(v, axis=-1))
+        if not return_complex:
+            frames = jnp.real(frames)
+        frames = frames * w                            # [..., num, n_fft]
+        num = frames.shape[-2]
+        n = (num - 1) * hop + n_fft
+        idx = jnp.arange(n_fft)[None, :] + hop * jnp.arange(num)[:, None]
+        out = jnp.zeros(frames.shape[:-2] + (n,), frames.dtype)
+        out = out.at[..., idx].add(frames)
+        env = jnp.zeros((n,), jnp.float32).at[idx.ravel()].add(
+            jnp.tile(jnp.square(w), (num,)))
+        out = out / jnp.maximum(env, 1e-11)
+        if center:
+            out = out[..., n_fft // 2: n - n_fft // 2]
+        if length is not None:
+            out = out[..., :length]
+        return out
+    return apply("istft", _istft, x, win, n_fft=n_fft, hop=hop_length,
+                 center=bool(center), normalized=bool(normalized),
+                 onesided=bool(onesided),
+                 length=None if length is None else int(length),
+                 return_complex=bool(return_complex))

@@ -1,0 +1,28 @@
+"""paddle_tpu.static — static-graph-mode API surface.
+
+Reference analog: python/paddle/static (Program/Executor over ProgramDesc +
+InterpreterCore, SURVEY.md §2.3). TPU-native collapse: the XLA computation
+IS the static program — `paddle_tpu.jit.to_static` traces once and compiles
+— so this namespace provides the reference-shaped entry points that remain
+meaningful (InputSpec, control flow, save/load_inference_model) instead of a
+Program/Block graph-construction frontend.
+"""
+from __future__ import annotations
+
+from ..jit.static_function import InputSpec  # noqa: F401
+from . import nn  # noqa: F401
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         **kwargs):
+    raise NotImplementedError(
+        "program-based save_inference_model has no analog here: decorate "
+        "the model with paddle_tpu.jit.to_static and use paddle_tpu.jit."
+        "save (StableHLO + weights), then paddle_tpu.inference.Predictor "
+        "or paddle_tpu.jit.load to serve it.")
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    raise NotImplementedError(
+        "use paddle_tpu.jit.load(path) (reference jit.save/load artifact) "
+        "or paddle_tpu.inference.create_predictor.")

@@ -1,0 +1,1 @@
+from .control_flow import cond, while_loop, case, switch_case  # noqa: F401

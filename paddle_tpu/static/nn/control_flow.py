@@ -1,0 +1,164 @@
+"""Control-flow ops: cond / while_loop / case / switch_case.
+
+Reference analog: python/paddle/static/nn/control_flow.py over the fluid
+`conditional_block` / `while` operators
+(/root/reference/paddle/fluid/operators/controlflow/conditional_block_op.cc,
+while_op.cc).
+
+TPU-native semantics, two modes from one API:
+- Eager (concrete pred): Python branching/looping. The taken branch's ops
+  record on the tape, so gradients work through `cond` and through an
+  unrolled `while_loop` exactly like any eager code.
+- Traced (pred is a jax Tracer, i.e. inside `paddle_tpu.jit.to_static` or a
+  jax transform): lowers to `jax.lax.cond` / `jax.lax.while_loop` —
+  compiler-friendly structured control flow, no Python-level unrolling.
+  `lax.cond` is reverse-differentiable through the enclosing trace;
+  `lax.while_loop` (like the reference's while grad in dygraph) is
+  forward-only — use a bounded loop / scan for training-time recurrences.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor
+from ...framework.dispatch import raw_value
+
+
+def _is_tracer(v) -> bool:
+    return isinstance(v, jax.core.Tracer)
+
+
+def _flatten(out):
+    leaves, treedef = jax.tree_util.tree_flatten(
+        out, is_leaf=lambda x: isinstance(x, Tensor))
+    return leaves, treedef
+
+
+def _to_arrays(leaves):
+    return [raw_value(x) if isinstance(x, Tensor) else jnp.asarray(x)
+            for x in leaves]
+
+
+def _rewrap(treedef, arrays):
+    return jax.tree_util.tree_unflatten(
+        treedef, [Tensor(a, stop_gradient=True) for a in arrays])
+
+
+def cond(pred, true_fn: Callable = None, false_fn: Callable = None,
+         name=None, return_names=None):
+    """Run `true_fn()` if pred else `false_fn()` (reference
+    control_flow.py:cond — branch fns are closures taking no arguments)."""
+    pv = raw_value(pred)
+    if not _is_tracer(pv):
+        # eager: execute only the taken branch; tape records it
+        pv = bool(jnp.asarray(pv))
+        if pv:
+            return true_fn() if true_fn is not None else None
+        return false_fn() if false_fn is not None else None
+
+    # traced: structured lax.cond. Each branch traces lazily inside its
+    # lambda; closures see the outer trace's Tensors (Tracer-backed), so the
+    # untaken branch is compiled, not executed.
+    structs = {}
+
+    def mk(fn, tag):
+        def branch(_):
+            out = fn() if fn is not None else None
+            leaves, treedef = _flatten(out)
+            structs[tag] = treedef
+            return _to_arrays(leaves)
+        return branch
+
+    try:
+        vals = jax.lax.cond(jnp.asarray(pv).astype(bool).reshape(()),
+                            mk(true_fn, "t"), mk(false_fn, "f"), 0)
+    except TypeError as e:
+        raise ValueError(
+            f"cond branches returned different structures: "
+            f"{structs.get('t')} vs {structs.get('f')} (the reference "
+            f"requires matching outputs too, control_flow.py select_input)"
+        ) from e
+    if str(structs["t"]) != str(structs["f"]):
+        raise ValueError(
+            f"cond branches returned different structures: "
+            f"{structs['t']} vs {structs['f']}")
+    return _rewrap(structs["t"], vals)
+
+
+def while_loop(cond_fn: Callable, body_fn: Callable,
+               loop_vars: Sequence[Any], is_test=False, name=None):
+    """Repeat `body_fn(*vars)` while `cond_fn(*vars)` (reference
+    control_flow.py:while_loop)."""
+    loop_vars = list(loop_vars)
+    probe = raw_value(cond_fn(*loop_vars))
+    if not _is_tracer(probe) and not any(
+            _is_tracer(raw_value(v)) for v in loop_vars):
+        # eager: Python loop; every iteration's ops record on the tape
+        # (grads flow through the unrolled graph, the dygraph semantics)
+        vars_ = loop_vars
+        while bool(jnp.asarray(raw_value(cond_fn(*vars_)))):
+            out = body_fn(*vars_)
+            vars_ = list(out) if isinstance(out, (list, tuple)) else [out]
+        return vars_
+
+    # traced: lax.while_loop over the flattened arrays
+    leaves, treedef = _flatten(loop_vars)
+
+    def c(arrs):
+        vars_ = jax.tree_util.tree_unflatten(
+            treedef, [Tensor(a, stop_gradient=True) for a in arrs])
+        return jnp.asarray(raw_value(cond_fn(*vars_))).reshape(())
+
+    def b(arrs):
+        vars_ = jax.tree_util.tree_unflatten(
+            treedef, [Tensor(a, stop_gradient=True) for a in arrs])
+        out = body_fn(*vars_)
+        out = list(out) if isinstance(out, (list, tuple)) else [out]
+        out_leaves, out_def = _flatten(out)
+        if str(out_def) != str(treedef):
+            raise ValueError(
+                f"while_loop body returned structure {out_def}, expected "
+                f"{treedef}")
+        return _to_arrays(out_leaves)
+
+    vals = jax.lax.while_loop(c, b, _to_arrays(leaves))
+    return jax.tree_util.tree_unflatten(
+        treedef, [Tensor(a, stop_gradient=True) for a in vals])
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """First-match multi-branch (reference control_flow.py:case)."""
+    pairs = list(pred_fn_pairs)
+
+    def build(i):
+        if i >= len(pairs):
+            return (default() if default is not None else None)
+        pred, fn = pairs[i]
+        return cond(pred, fn, lambda: build(i + 1))
+    return build(0)
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Index-selected branch (reference control_flow.py:switch_case)."""
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    else:
+        items = list(enumerate(branch_fns))
+    iv = raw_value(branch_index)
+    if not _is_tracer(iv):
+        idx = int(jnp.asarray(iv))
+        for k, fn in items:
+            if k == idx:
+                return fn()
+        return default() if default is not None else None
+
+    def build(pos):
+        if pos >= len(items):
+            return default() if default is not None else None
+        k, fn = items[pos]
+        eq = Tensor(jnp.asarray(iv) == k, stop_gradient=True)
+        return cond(eq, fn, lambda: build(pos + 1))
+    return build(0)

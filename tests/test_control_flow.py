@@ -1,0 +1,123 @@
+"""Control-flow op tests: cond/while_loop/case in eager + to_static modes,
+including gradients (reference: while_op.cc / conditional_block_op.cc test
+discipline)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.static.nn import cond, while_loop, case, switch_case
+
+
+class TestCondEager:
+    def test_takes_true_branch(self):
+        x = paddle.to_tensor(np.array([3.0], np.float32))
+        out = cond(x.sum() > 0, lambda: x * 2, lambda: x - 1)
+        np.testing.assert_allclose(out.numpy(), [6.0])
+
+    def test_takes_false_branch(self):
+        x = paddle.to_tensor(np.array([-3.0], np.float32))
+        out = cond(x.sum() > 0, lambda: x * 2, lambda: x - 1)
+        np.testing.assert_allclose(out.numpy(), [-4.0])
+
+    def test_grad_through_taken_branch(self):
+        x = paddle.to_tensor(np.array([2.0], np.float32),
+                             stop_gradient=False)
+        out = cond(x.sum() > 0, lambda: x * 3, lambda: x * 5)
+        out.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [3.0])
+
+    def test_nested_structure_output(self):
+        x = paddle.to_tensor(np.array([1.0], np.float32))
+        out = cond(x.sum() > 0, lambda: {"a": x, "b": [x * 2, x * 3]},
+                   lambda: {"a": x * 0, "b": [x, x]})
+        np.testing.assert_allclose(out["b"][1].numpy(), [3.0])
+
+
+class TestCondTraced:
+    def test_lax_cond_under_to_static(self):
+        @paddle.jit.to_static
+        def f(x):
+            return cond(x.sum() > 0, lambda: x * 2, lambda: x - 1)
+
+        pos = f(paddle.to_tensor(np.array([3.0], np.float32)))
+        neg = f(paddle.to_tensor(np.array([-3.0], np.float32)))
+        np.testing.assert_allclose(pos.numpy(), [6.0])
+        np.testing.assert_allclose(neg.numpy(), [-4.0])
+
+    def test_grad_under_to_static(self):
+        @paddle.jit.to_static
+        def f(x):
+            y = cond(x.sum() > 0, lambda: x * 3, lambda: x * 5)
+            return y.sum()
+
+        x = paddle.to_tensor(np.array([2.0], np.float32),
+                             stop_gradient=False)
+        f(x).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [3.0])
+        x2 = paddle.to_tensor(np.array([-2.0], np.float32),
+                              stop_gradient=False)
+        f(x2).backward()
+        np.testing.assert_allclose(x2.grad.numpy(), [5.0])
+
+    def test_mismatched_structures_raise(self):
+        @paddle.jit.to_static
+        def f(x):
+            return cond(x.sum() > 0, lambda: (x, x), lambda: x)
+
+        with pytest.raises(ValueError, match="different structures"):
+            f(paddle.to_tensor(np.ones(2, np.float32)))
+
+
+class TestWhileLoop:
+    def test_eager_loop(self):
+        i = paddle.to_tensor(np.array(0.0, np.float32))
+        s = paddle.to_tensor(np.array(0.0, np.float32))
+        i, s = while_loop(lambda i, s: i < 5, lambda i, s: [i + 1, s + i],
+                          [i, s])
+        assert float(i.numpy()) == 5.0
+        assert float(s.numpy()) == 10.0          # 0+1+2+3+4
+
+    def test_eager_grad_through_unrolled(self):
+        x = paddle.to_tensor(np.array(2.0, np.float32), stop_gradient=False)
+        i = paddle.to_tensor(np.array(0.0, np.float32))
+        y = x * 1.0
+        # y = x * 2^3 after 3 doublings
+        _, y = while_loop(lambda i, y: i < 3, lambda i, y: [i + 1, y * 2],
+                          [i, y])
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), 8.0)
+
+    def test_traced_lax_while(self):
+        @paddle.jit.to_static
+        def f(n):
+            i = paddle.to_tensor(np.array(0, np.int32))
+            s = paddle.to_tensor(np.array(0, np.int32))
+            i, s = while_loop(lambda i, s: i < n,
+                              lambda i, s: [i + 1, s + i], [i, s])
+            return s
+
+        out = f(paddle.to_tensor(np.array(5, np.int32)))
+        assert int(out.numpy()) == 10
+        out = f(paddle.to_tensor(np.array(3, np.int32)))
+        assert int(out.numpy()) == 3
+
+
+class TestCaseSwitch:
+    def test_case_first_match(self):
+        x = paddle.to_tensor(np.array(3.0, np.float32))
+        out = case([(x > 5, lambda: x * 10), (x > 1, lambda: x * 2)],
+                   default=lambda: x)
+        np.testing.assert_allclose(out.numpy(), 6.0)
+
+    def test_switch_case(self):
+        idx = paddle.to_tensor(np.array(1, np.int32))
+        out = switch_case(idx, {0: lambda: paddle.to_tensor(0.0),
+                                1: lambda: paddle.to_tensor(10.0)},
+                          default=lambda: paddle.to_tensor(-1.0))
+        assert float(out.numpy()) == 10.0
+
+    def test_switch_case_default(self):
+        idx = paddle.to_tensor(np.array(9, np.int32))
+        out = switch_case(idx, {0: lambda: paddle.to_tensor(0.0)},
+                          default=lambda: paddle.to_tensor(-1.0))
+        assert float(out.numpy()) == -1.0

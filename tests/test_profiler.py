@@ -1,0 +1,136 @@
+"""Profiler subsystem tests.
+
+Reference analog: test coverage for python/paddle/profiler (scheduler state
+machine, RecordEvent spans, stats summary, timer ips).
+"""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.profiler import (Profiler, ProfilerState, ProfilerTarget,
+                                 RecordEvent, make_scheduler,
+                                 export_chrome_tracing, get_profiler_spans,
+                                 clear_profiler_spans, benchmark)
+
+
+class TestScheduler:
+    def test_make_scheduler_cycle(self):
+        s = make_scheduler(closed=1, ready=1, record=2, repeat=1)
+        states = [s(i) for i in range(6)]
+        assert states[:4] == [ProfilerState.CLOSED, ProfilerState.READY,
+                              ProfilerState.RECORD,
+                              ProfilerState.RECORD_AND_RETURN]
+        assert states[4] == ProfilerState.CLOSED      # repeat=1 exhausted
+        assert states[5] == ProfilerState.CLOSED
+
+    def test_skip_first(self):
+        s = make_scheduler(closed=0, ready=0, record=1, skip_first=3)
+        assert s(2) == ProfilerState.CLOSED
+        assert s(3) == ProfilerState.RECORD_AND_RETURN
+
+    def test_repeat_forever(self):
+        s = make_scheduler(closed=1, ready=0, record=1, repeat=0)
+        assert s(101) == ProfilerState.RECORD_AND_RETURN
+
+
+class TestRecordEvent:
+    def test_spans_recorded_with_nesting(self):
+        clear_profiler_spans()
+        with RecordEvent("outer"):
+            with RecordEvent("inner"):
+                time.sleep(0.01)
+        spans = get_profiler_spans()
+        names = {s[0] for s in spans}
+        assert names == {"outer", "inner"}
+        by = {s[0]: s for s in spans}
+        assert by["inner"][3] == 1          # depth
+        assert by["outer"][3] == 0
+        assert by["inner"][2] >= 0.009      # duration
+        assert by["outer"][2] >= by["inner"][2]
+
+    def test_decorator_form(self):
+        clear_profiler_spans()
+
+        @RecordEvent("fn_span")
+        def f(x):
+            return x + 1
+
+        assert f(1) == 2
+        assert any(s[0] == "fn_span" for s in get_profiler_spans())
+
+    def test_begin_end_form(self):
+        clear_profiler_spans()
+        ev = RecordEvent("manual")
+        ev.begin()
+        ev.end()
+        assert any(s[0] == "manual" for s in get_profiler_spans())
+
+
+class TestProfiler:
+    def test_step_loop_and_summary(self):
+        clear_profiler_spans()
+        with Profiler(targets=[ProfilerTarget.CPU]) as p:
+            for _ in range(4):
+                with RecordEvent("train_step"):
+                    np.dot(np.ones((64, 64)), np.ones((64, 64)))
+                p.step(num_samples=32)
+        assert p.step_num == 4
+        assert len(p.step_times) == 4
+        s = p.summary()
+        assert "train_step" in s
+        assert "steps: 4" in s
+
+    def test_scheduler_tuple_form(self):
+        p = Profiler(scheduler=(1, 3))
+        p.start()
+        assert p.current_state == ProfilerState.CLOSED
+        p.step()
+        assert p.current_state in (ProfilerState.RECORD,
+                                   ProfilerState.RECORD_AND_RETURN)
+        p.stop()
+
+    def test_chrome_tracing_configures_dir(self, tmp_path):
+        p = Profiler(on_trace_ready=export_chrome_tracing(str(tmp_path)),
+                     timer_only=True)
+        assert p._trace_dir == str(tmp_path)
+
+    def test_lazy_namespace(self):
+        assert paddle.profiler.Profiler is Profiler
+
+
+class TestTimer:
+    def test_benchmark_ips(self):
+        bm = benchmark()
+        bm.reset()
+        bm.begin()
+        for _ in range(5):
+            time.sleep(0.002)
+            bm.step(num_samples=10)
+        bm.end()
+        s = bm.summary(skip=1)
+        assert s["steps"] == 4
+        assert s["ips"] > 0
+        assert s["avg_batch_cost_s"] >= 0.002
+
+    def test_dataloader_reader_cost_hook(self):
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class DS(Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                return np.float32(i)
+
+        bm = benchmark()
+        bm.reset()
+        bm.begin()
+        n = 0
+        for _batch in DataLoader(DS(), batch_size=4):
+            bm.step(num_samples=4)
+            n += 1
+        assert n == 2
+        s = bm.summary(skip=0)
+        assert "avg_reader_cost_s" in s

@@ -1,0 +1,111 @@
+"""Offline TPU sweep for the bench train step: remat policy x flash blocks.
+
+Each variant runs in a fresh subprocess under a timeout (the tunnel can hang)
+and prints one JSON line; the parent prints a ranked summary at the end.
+Results feed the shipped defaults (GPTConfig.remat/remat_policy, the bench
+ladder, and PADDLE_TPU_FLASH_BLOCK_* defaults) plus BASELINE.md.
+
+Usage:  python tools/sweep_gpt_step.py            # orchestrate the sweep
+        python tools/sweep_gpt_step.py --run '<json>'   # one variant (internal)
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+VARIANTS = [
+    # name, remat, policy, (bq, bk, bwd_q, bwd_k), extra env
+    ("dots-jaxbwd", True, "dots", (128, 128, 128, 128),
+     {"PADDLE_TPU_DISABLE_PALLAS_BWD": "1"}),
+    ("dots-nopallas", True, "dots", (128, 128, 128, 128),
+     {"PADDLE_TPU_DISABLE_PALLAS": "1"}),
+    ("dots-256", True, "dots", (256, 256, 256, 256), {}),
+    ("dots-bwdq128k512", True, "dots", (128, 128, 128, 512), {}),
+    ("dots-512", True, "dots", (512, 512, 512, 512), {}),
+    ("full-jaxbwd", True, "full", (128, 128, 128, 128),
+     {"PADDLE_TPU_DISABLE_PALLAS_BWD": "1"}),
+]
+
+MODEL = dict(vocab_size=32768, hidden_size=1024, num_layers=24,
+             num_heads=16, max_seq_len=1024)
+BATCH, SEQ, ITERS = 8, 1024, 8
+
+
+def run_one(spec: dict) -> None:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import jax
+    import jax.numpy as jnp
+    import functools
+    from paddle_tpu.models.gpt import (GPTConfig, init_gpt_params,
+                                       init_opt_state, train_step)
+    devs = jax.devices()
+    cfg = GPTConfig(sequence_parallel=False, remat=spec["remat"],
+                    remat_policy=spec["policy"], dtype=jnp.bfloat16,
+                    **MODEL)
+    params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+    opt_state = init_opt_state(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (BATCH, SEQ + 1), 0,
+                                cfg.vocab_size)
+    import functools
+    step = jax.jit(functools.partial(train_step, cfg=cfg, lr=1e-4),
+                   donate_argnums=(0, 1))
+    t0 = time.perf_counter()
+    loss, params, opt_state = step(params, opt_state, tokens)
+    float(loss)
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        loss, params, opt_state = step(params, opt_state, tokens)
+    float(loss)
+    dt = (time.perf_counter() - t0) / ITERS
+    print(json.dumps({"name": spec["name"], "ms_per_step": round(dt * 1e3, 2),
+                      "tokens_per_sec": round(BATCH * SEQ / dt, 1),
+                      "compile_s": round(compile_s, 1),
+                      "platform": devs[0].platform}), flush=True)
+
+
+def main() -> None:
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    results = []
+    for name, remat, policy, (bq, bk, bwq, bwk), extra in VARIANTS:
+        spec = {"name": name, "remat": remat, "policy": policy}
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TPU_FLASH_BLOCK_Q": str(bq),
+            "PADDLE_TPU_FLASH_BLOCK_K": str(bk),
+            "PADDLE_TPU_FLASH_BLOCK_BWD_Q": str(bwq),
+            "PADDLE_TPU_FLASH_BLOCK_BWD_K": str(bwk),
+        })
+        env.update(extra)
+        print(f"[sweep] === {name} ===", file=sys.stderr, flush=True)
+        try:
+            res = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--run",
+                 json.dumps(spec)],
+                cwd=here, env=env, stdout=subprocess.PIPE, timeout=900)
+        except subprocess.TimeoutExpired:
+            print(f"[sweep] {name}: TIMEOUT", file=sys.stderr, flush=True)
+            continue
+        out = res.stdout.decode().strip().splitlines()
+        line = next((ln for ln in reversed(out) if ln.startswith("{")), None)
+        if res.returncode == 0 and line:
+            rec = json.loads(line)
+            results.append(rec)
+            print(f"[sweep] {name}: {rec['ms_per_step']} ms/step",
+                  file=sys.stderr, flush=True)
+        else:
+            print(f"[sweep] {name}: FAILED rc={res.returncode}",
+                  file=sys.stderr, flush=True)
+    results.sort(key=lambda r: r["ms_per_step"])
+    print(json.dumps({"ranked": results}, indent=1), flush=True)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) >= 3 and sys.argv[1] == "--run":
+        run_one(json.loads(sys.argv[2]))
+    else:
+        main()
