@@ -14,3 +14,10 @@ _sys.modules[__name__ + ".fleet"] = fleet
 _sys.modules[__name__ + ".sharding"] = sharding
 from ..parallel import collective as _collective  # noqa: E402
 _sys.modules[__name__ + ".collective"] = _collective
+from ..parallel import auto_parallel  # noqa: E402,F401
+from ..parallel.auto_parallel import (  # noqa: E402,F401
+    ProcessMesh, shard_tensor, shard_op, reshard)
+_sys.modules[__name__ + ".auto_parallel"] = auto_parallel
+# reference spelling: paddle.distributed.fleet.auto (Engine lives there)
+fleet.auto = auto_parallel
+_sys.modules[__name__ + ".fleet.auto"] = auto_parallel

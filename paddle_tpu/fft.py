@@ -67,25 +67,28 @@ hfft = _mk1d("hfft", jnp.fft.hfft)
 ihfft = _mk1d("ihfft", jnp.fft.ihfft)
 
 def _hfftn_impl(v, s, axes, norm):
-    """Hermitian-input n-D FFT (numpy relation: ifftn over the leading axes,
-    hfft over the last). axes=None means all axes; s follows axes order."""
+    """Hermitian-input n-D FFT. scipy relation: hfftn = hfft over the last
+    axis of fftn over the leading axes (so ihfftn∘hfftn is identity and
+    ihfftn(y) == conj(rfftn(y))/N). axes=None = all axes; s follows axes."""
     if axes is None:
         axes = tuple(range(v.ndim))
     s_list = [None] * len(axes) if s is None else list(s)
     if len(axes) > 1:
         lead = None if s is None else tuple(s_list[:-1])
-        v = jnp.fft.ifftn(v, s=lead, axes=axes[:-1], norm=norm)
+        v = jnp.fft.fftn(v, s=lead, axes=axes[:-1], norm=norm)
     return jnp.fft.hfft(v, n=s_list[-1], axis=axes[-1], norm=norm)
 
 
 def _ihfftn_impl(v, s, axes, norm):
+    """ihfftn = ifftn over the leading axes of ihfft over the last axis
+    (== conj(rfftn)/N, the scipy/paddle convention)."""
     if axes is None:
         axes = tuple(range(v.ndim))
     s_list = [None] * len(axes) if s is None else list(s)
     v = jnp.fft.ihfft(v, n=s_list[-1], axis=axes[-1], norm=norm)
     if len(axes) > 1:
         lead = None if s is None else tuple(s_list[:-1])
-        v = jnp.fft.fftn(v, s=lead, axes=axes[:-1], norm=norm)
+        v = jnp.fft.ifftn(v, s=lead, axes=axes[:-1], norm=norm)
     return v
 
 

@@ -25,7 +25,7 @@ from .autograd import run_backward
 class Tensor:
     __slots__ = ("_value", "stop_gradient", "_grad", "_node", "_out_idx",
                  "name", "persistable", "_grad_hooks", "is_leaf_override",
-                 "__weakref__")
+                 "sharding_spec", "__weakref__")
 
     def __init__(self, value, stop_gradient: bool = True, name: str = ""):
         if isinstance(value, Tensor):

@@ -1,0 +1,174 @@
+"""paddle_tpu.metric — training metrics.
+
+Reference analog: python/paddle/metric/metrics.py (`Metric` abstract base
+with name/reset/update/accumulate/compute, `Accuracy`, `Precision`,
+`Recall`, `Auc`). Metrics accumulate on host in numpy — they sit outside
+the compiled step, so they cost nothing on-device.
+"""
+from __future__ import annotations
+
+import abc
+from typing import Optional
+
+import numpy as np
+
+
+def _to_np(x):
+    return x.numpy() if hasattr(x, "numpy") else np.asarray(x)
+
+
+class Metric(abc.ABC):
+    """Reference metrics.py Metric: reset/update/accumulate/name, optional
+    compute(pred, label) that runs before update."""
+
+    @abc.abstractmethod
+    def reset(self): ...
+
+    @abc.abstractmethod
+    def update(self, *args): ...
+
+    @abc.abstractmethod
+    def accumulate(self): ...
+
+    @abc.abstractmethod
+    def name(self): ...
+
+    def compute(self, *args):
+        raise NotImplementedError
+
+
+class Accuracy(Metric):
+    """Top-k accuracy (reference metrics.py Accuracy)."""
+
+    def __init__(self, topk=(1,), name=None):
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def reset(self):
+        self.total = np.zeros(len(self.topk))
+        self.count = np.zeros(len(self.topk))
+
+    def compute(self, pred, label):
+        """→ correctness matrix [N, maxk] (1 where the true class is in the
+        top-i predictions)."""
+        pred = _to_np(pred)
+        label = _to_np(label).reshape(-1)
+        idx = np.argsort(-pred, axis=-1)[..., :self.maxk]
+        return (idx == label[:, None]).astype(np.float32)
+
+    def update(self, correct):
+        correct = _to_np(correct)
+        for i, k in enumerate(self.topk):
+            self.total[i] += correct[:, :k].sum()
+            self.count[i] += correct.shape[0]
+        acc = self.total / np.maximum(self.count, 1)
+        return acc[0] if len(self.topk) == 1 else acc
+
+    def accumulate(self):
+        acc = self.total / np.maximum(self.count, 1)
+        return float(acc[0]) if len(self.topk) == 1 else [float(a)
+                                                          for a in acc]
+
+    def name(self):
+        return self._name
+
+
+class Precision(Metric):
+    """Binary precision (reference metrics.py Precision)."""
+
+    def __init__(self, name=None):
+        self._name = name or "precision"
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        preds = (_to_np(preds).reshape(-1) > 0.5).astype(np.int64)
+        labels = _to_np(labels).reshape(-1).astype(np.int64)
+        self.tp += int(np.sum((preds == 1) & (labels == 1)))
+        self.fp += int(np.sum((preds == 1) & (labels == 0)))
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return float(self.tp) / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    """Binary recall (reference metrics.py Recall)."""
+
+    def __init__(self, name=None):
+        self._name = name or "recall"
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds = (_to_np(preds).reshape(-1) > 0.5).astype(np.int64)
+        labels = _to_np(labels).reshape(-1).astype(np.int64)
+        self.tp += int(np.sum((preds == 1) & (labels == 1)))
+        self.fn += int(np.sum((preds == 0) & (labels == 1)))
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return float(self.tp) / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    """ROC-AUC via the reference's bucketed statistics approach
+    (metrics.py Auc: num_thresholds buckets over [0,1])."""
+
+    def __init__(self, curve="ROC", num_thresholds=4095, name=None):
+        self.num_thresholds = num_thresholds
+        self._name = name or "auc"
+        self.reset()
+
+    def reset(self):
+        n = self.num_thresholds + 1
+        self._stat_pos = np.zeros(n)
+        self._stat_neg = np.zeros(n)
+
+    def update(self, preds, labels):
+        preds = _to_np(preds)
+        if preds.ndim == 2 and preds.shape[1] == 2:
+            preds = preds[:, 1]
+        preds = preds.reshape(-1)
+        labels = _to_np(labels).reshape(-1)
+        buckets = np.minimum((preds * self.num_thresholds).astype(np.int64),
+                             self.num_thresholds)
+        np.add.at(self._stat_pos, buckets[labels > 0.5], 1)
+        np.add.at(self._stat_neg, buckets[labels <= 0.5], 1)
+
+    def accumulate(self):
+        tot_pos = tot_neg = auc = 0.0
+        for i in range(self.num_thresholds, -1, -1):
+            p, n = self._stat_pos[i], self._stat_neg[i]
+            auc += n * tot_pos + p * n / 2.0
+            tot_pos += p
+            tot_neg += n
+        return float(auc / (tot_pos * tot_neg)) if tot_pos and tot_neg \
+            else 0.0
+
+    def name(self):
+        return self._name
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Functional top-k accuracy (reference metrics.py accuracy op)."""
+    from ..framework.tensor import to_tensor
+    pred = _to_np(input)
+    lab = _to_np(label).reshape(-1)
+    idx = np.argsort(-pred, axis=-1)[..., :k]
+    acc = float(np.mean(np.any(idx == lab[:, None], axis=-1)))
+    return to_tensor(np.asarray(acc, np.float32))

@@ -13,8 +13,9 @@ _param_counter = itertools.count()
 
 
 class Parameter(Tensor):
-    __slots__ = ("trainable", "optimize_attr", "regularizer", "do_model_average",
-                 "need_clip", "is_distributed", "sharding_spec")
+    # NOTE: sharding_spec slot lives on the Tensor base class now
+    __slots__ = ("trainable", "optimize_attr", "regularizer",
+                 "do_model_average", "need_clip", "is_distributed")
 
     def __init__(self, value, trainable: bool = True, name: str = ""):
         super().__init__(value, stop_gradient=not trainable,

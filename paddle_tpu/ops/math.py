@@ -639,3 +639,14 @@ def p_norm(x, p=2, axis=None, epsilon=1e-12, keepdim=False, as_vector=False,
     return apply("p_norm", _pn, x, p=float(p), axis=_axis(axis),
                  keepdim=builtins.bool(keepdim),
                  flat=builtins.bool(as_vector), eps=float(epsilon))
+
+
+@defop("squared_l2_norm")
+def _squared_l2_norm(x):
+    return jnp.sum(jnp.square(x))
+
+
+def squared_l2_norm(x, name=None):
+    """Reference: legacy `squared_l2_norm` — sum(x^2), NO square root (the
+    grad-clip accounting kernel)."""
+    return _squared_l2_norm(x)

@@ -98,7 +98,7 @@ ALIASES: Dict[str, str] = {
     "sigmoid_cross_entropy_with_logits":
         "nn.functional:binary_cross_entropy_with_logits",
     "split_with_num": "ops.manipulation:chunk",
-    "squared_l2_norm": "ops.math:frobenius_norm",
+    "squared_l2_norm": "op:squared_l2_norm",
     "stack": "tensor:stack",
     "tanh_shrink": "nn.functional:tanhshrink",
     "tril_indices": "ops.creation:tril_indices",

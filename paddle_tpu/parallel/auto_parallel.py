@@ -1,0 +1,385 @@
+"""Auto-parallel markup API: ProcessMesh / shard_tensor / shard_op / Engine.
+
+Reference analog: python/paddle/distributed/auto_parallel —
+`ProcessMesh` (process_mesh.py:71), markup `shard_tensor`/`shard_op`
+(interface.py:28,117), and `Engine` fit/evaluate/predict
+(static/engine.py:55,854). The reference lowers markup through its own
+Completer → Partitioner → Resharder pipeline; SURVEY §7 calls that stack
+"largely free from XLA GSPMD propagation" on TPU — and that is exactly this
+implementation: markup maps to `NamedSharding`s, GSPMD propagates them and
+inserts collectives, `jax.device_put`/`with_sharding_constraint` is the
+Resharder.
+
+Semantics:
+- `shard_tensor` on a concrete Tensor re-lays it out across the mesh
+  (device_put — an eager reshard); on a traced value it becomes a sharding
+  constraint inside the compiled graph.
+- `shard_op` wraps a callable with input/output constraints.
+- `Engine` drives the paddle-shaped object API (nn.Layer + paddle
+  optimizer + DataLoader) as a mesh-aware train/eval/predict loop:
+  parameters are resharded per their markup (or replicated) at prepare
+  time, and every step runs under the mesh so GSPMD partitions it.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import (build_mesh, get_mesh, set_global_mesh, use_mesh,
+                   sharding_for, constraint as mesh_constraint)
+from ..framework.tensor import Tensor
+
+
+class ProcessMesh:
+    """Logical mesh of processes/devices (reference process_mesh.py:71).
+
+    ProcessMesh(mesh=[[0,1],[2,3]], dim_names=["dp","mp"]) maps the listed
+    device ids onto a named jax Mesh. Also usable as a context manager: ops
+    inside run under this mesh (the reference's dist-attr default mesh).
+    """
+
+    def __init__(self, mesh=None, dim_names: Optional[Sequence[str]] = None,
+                 shape: Optional[Sequence[int]] = None,
+                 process_ids: Optional[Sequence[int]] = None):
+        if mesh is not None:
+            arr = np.asarray(mesh)
+        elif shape is not None:
+            ids = (np.asarray(process_ids) if process_ids is not None
+                   else np.arange(int(np.prod(shape))))
+            arr = ids.reshape(tuple(shape))
+        else:
+            raise ValueError("ProcessMesh needs `mesh` or `shape`")
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        if len(dim_names) != arr.ndim:
+            raise ValueError(
+                f"dim_names {dim_names} rank != mesh rank {arr.ndim}")
+        self._ids = arr
+        self._dim_names = tuple(dim_names)
+        self._jax_mesh: Optional[Mesh] = None
+        self._ctx = None
+
+    # reference-shaped accessors
+    @property
+    def shape(self):
+        return list(self._ids.shape)
+
+    @property
+    def process_ids(self):
+        return [int(i) for i in self._ids.ravel()]
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def ndim(self):
+        return self._ids.ndim
+
+    def get_dim_size(self, dim_name: str) -> int:
+        return self._ids.shape[self._dim_names.index(dim_name)]
+
+    @property
+    def mesh(self) -> Mesh:
+        """The backing jax Mesh (device ids resolved against jax.devices)."""
+        if self._jax_mesh is None:
+            devs = jax.devices()
+            by_id = {d.id: d for d in devs}
+            try:
+                arr = np.vectorize(lambda i: by_id[int(i)])(self._ids)
+            except KeyError as e:
+                raise ValueError(
+                    f"ProcessMesh names device id {e} but only "
+                    f"{sorted(by_id)} exist") from e
+            self._jax_mesh = Mesh(arr, self._dim_names)
+        return self._jax_mesh
+
+    def __enter__(self):
+        self._ctx = use_mesh(self.mesh)
+        self._ctx.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        ctx, self._ctx = self._ctx, None
+        return ctx.__exit__(*exc)
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and self._dim_names == other._dim_names
+                and np.array_equal(self._ids, other._ids))
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self.shape}, "
+                f"dim_names={list(self._dim_names)})")
+
+
+def _as_spec(shard_spec, ndim) -> P:
+    if shard_spec is None:
+        return P()
+    entries = list(shard_spec)
+    if len(entries) < ndim:
+        entries += [None] * (ndim - len(entries))
+    return P(*entries)
+
+
+def _resolve_mesh(process_mesh) -> Mesh:
+    if isinstance(process_mesh, ProcessMesh):
+        return process_mesh.mesh
+    if isinstance(process_mesh, Mesh):
+        return process_mesh
+    mesh = get_mesh()
+    if mesh is None:
+        raise ValueError(
+            "no process_mesh given and no active mesh (use "
+            "ProcessMesh(...) as context, use_mesh, or set_global_mesh)")
+    return mesh
+
+
+def shard_tensor(x, process_mesh=None, shard_spec: Optional[Sequence] = None,
+                 stop_gradient=None, **kwargs):
+    """Mark/lay out `x` as sharded over `process_mesh` per `shard_spec`
+    (reference interface.py:28: spec entries are mesh dim names or None).
+
+    Concrete Tensor → eager reshard (device_put); traced value → sharding
+    constraint compiled into the surrounding graph. Returns the same kind
+    of value; Tensors keep identity-relevant metadata and record the spec
+    on `.sharding_spec` (the dist_attr analog)."""
+    mesh = _resolve_mesh(process_mesh)
+    is_tensor = isinstance(x, Tensor)
+    val = x._value if is_tensor else x
+    spec = _as_spec(shard_spec, getattr(val, "ndim", 0))
+    if isinstance(val, jax.core.Tracer):
+        out = jax.lax.with_sharding_constraint(val, sharding_for(spec, mesh))
+    else:
+        out = jax.device_put(val, NamedSharding(mesh, spec))
+    if is_tensor:
+        x._value = out
+        x.sharding_spec = spec
+        if stop_gradient is not None:
+            x.stop_gradient = stop_gradient
+        return x
+    return out
+
+
+def shard_op(op_fn: Callable, process_mesh=None,
+             in_shard_specs: Optional[Sequence] = None,
+             out_shard_specs: Optional[Sequence] = None, **kwargs):
+    """Wrap a callable so its inputs/outputs carry sharding markup
+    (reference interface.py:117). Specs align positionally with the
+    tensor args / outputs; None entries leave GSPMD free to choose."""
+    def wrapped(*args, **kw):
+        mesh = _resolve_mesh(process_mesh)
+        args = list(args)
+        if in_shard_specs is not None:
+            for i, spec in enumerate(in_shard_specs):
+                if spec is not None and i < len(args) and isinstance(
+                        args[i], (Tensor, jax.Array, jax.core.Tracer)):
+                    args[i] = shard_tensor(args[i], mesh, spec)
+        with use_mesh(mesh):
+            out = op_fn(*args, **kw)
+        if out_shard_specs is not None:
+            outs = list(out) if isinstance(out, (tuple, list)) else [out]
+            for i, spec in enumerate(out_shard_specs):
+                if spec is not None and i < len(outs):
+                    outs[i] = shard_tensor(outs[i], mesh, spec)
+            if isinstance(out, tuple) and hasattr(out, "_fields"):
+                out = type(out)(*outs)           # namedtuple
+            elif isinstance(out, (tuple, list)):
+                out = type(out)(outs)
+            else:
+                out = outs[0]
+        return out
+    wrapped.__name__ = getattr(op_fn, "__name__", "sharded_op")
+    return wrapped
+
+
+def reshard(x, process_mesh, shard_spec):
+    """Explicit relayout (the reference Resharder's user-facing form)."""
+    return shard_tensor(x, process_mesh, shard_spec)
+
+
+class Strategy:
+    """Auto-parallel strategy knobs (reference auto_parallel/strategy.py).
+    Holds the mesh axes used by Engine plus pass toggles (the reference's
+    amp/recompute/sharding sub-configs map onto the paddle_tpu.amp /
+    remat / ZeRO-spec machinery)."""
+
+    def __init__(self, mesh_axes: Optional[Dict[str, int]] = None,
+                 amp: bool = False, recompute: bool = False,
+                 sharding: Optional[dict] = None):
+        self.mesh_axes = mesh_axes
+        self.amp = amp
+        self.recompute = recompute
+        self.sharding = sharding or {}
+
+
+class Engine:
+    """Auto-parallel driver (reference static/engine.py:55): wraps model /
+    loss / optimizer / metrics and runs fit / evaluate / predict under a
+    mesh, with parameters laid out per their markup."""
+
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 cluster=None, strategy: Optional[Strategy] = None):
+        self.model = model
+        self.loss = loss
+        self.optimizer = optimizer
+        self.metrics = (metrics if isinstance(metrics, (list, tuple))
+                        else [metrics]) if metrics else []
+        self.strategy = strategy or Strategy()
+        self._mesh: Optional[Mesh] = None
+        self._prepared = False
+        self.history: Dict[str, List[float]] = {}
+
+    # ------------------------------------------------------------ prepare
+    def _ensure_mesh(self) -> Mesh:
+        if self._mesh is None:
+            if self.strategy.mesh_axes:
+                self._mesh = build_mesh(self.strategy.mesh_axes)
+            else:
+                self._mesh = get_mesh() or build_mesh(
+                    {"dp": len(jax.devices())})
+        return self._mesh
+
+    def prepare(self, *args, **kwargs):
+        """Reshard parameters onto the mesh: marked params follow their
+        `sharding_spec` (shard_tensor markup / mp_layers), everything else
+        replicates — GSPMD propagates from there."""
+        mesh = self._ensure_mesh()
+        if self.model is not None:
+            for p in self.model.parameters():
+                spec = getattr(p, "sharding_spec", None)
+                spec = spec if spec is not None else P()
+                if not isinstance(p._value, jax.core.Tracer):
+                    # sharding_for drops axes the mesh doesn't have, so a
+                    # model marked for dp×fsdp×pp×mp degrades gracefully
+                    p._value = jax.device_put(
+                        p._value, sharding_for(spec, mesh))
+        self._prepared = True
+        return self
+
+    # ------------------------------------------------------------- loops
+    def _loader(self, data, batch_size, collate_fn):
+        from ..io import DataLoader
+        if hasattr(data, "__iter__") and not hasattr(data, "__getitem__"):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=False,
+                          collate_fn=collate_fn, drop_last=True)
+
+    def _step(self, batch, train: bool):
+        inputs, labels = (batch if isinstance(batch, (tuple, list))
+                          and len(batch) == 2 else (batch, None))
+        from ..framework.tensor import to_tensor
+        inputs = inputs if isinstance(inputs, Tensor) else to_tensor(inputs)
+        out = self.model(inputs)
+        loss_v = None
+        if self.loss is not None and labels is not None:
+            labels = labels if isinstance(labels, Tensor) \
+                else to_tensor(labels)
+            loss_v = self.loss(out, labels)
+            if train:
+                loss_v.backward()
+                self.optimizer.step()
+                self.optimizer.clear_grad()
+        if labels is not None:
+            for m in self.metrics:
+                if hasattr(m, "compute"):
+                    m.update(m.compute(out, labels))
+                else:
+                    m.update(out, labels)
+        return out, loss_v
+
+    def fit(self, train_data, epochs=1, batch_size=1, steps_per_epoch=None,
+            valid_data=None, collate_fn=None, verbose=1, **kwargs):
+        if not self._prepared:
+            self.prepare()
+        mesh = self._ensure_mesh()
+        from ..profiler.timer import benchmark
+        bm = benchmark()
+        bm.begin()
+        with use_mesh(mesh):
+            for ep in range(epochs):
+                for m in self.metrics:
+                    m.reset()
+                losses = []
+                for step, batch in enumerate(
+                        self._loader(train_data, batch_size, collate_fn)):
+                    if steps_per_epoch and step >= steps_per_epoch:
+                        break
+                    _, loss_v = self._step(batch, train=True)
+                    if loss_v is not None:
+                        losses.append(float(loss_v.numpy()))
+                    bm.step(num_samples=batch_size)
+                self.history.setdefault("loss", []).append(
+                    float(np.mean(losses)) if losses else float("nan"))
+                for m in self.metrics:
+                    self.history.setdefault(
+                        getattr(m, "name", lambda: "metric")(), []).append(
+                        m.accumulate())
+        bm.end()
+        return self.history
+
+    def evaluate(self, valid_data, batch_size=1, steps=None,
+                 collate_fn=None, **kwargs):
+        if not self._prepared:
+            self.prepare()
+        mesh = self._ensure_mesh()
+        losses = []
+        for m in self.metrics:
+            m.reset()
+        with use_mesh(mesh):
+            for step, batch in enumerate(
+                    self._loader(valid_data, batch_size, collate_fn)):
+                if steps and step >= steps:
+                    break
+                _, loss_v = self._step(batch, train=False)
+                if loss_v is not None:
+                    losses.append(float(loss_v.numpy()))
+        out = {"loss": float(np.mean(losses)) if losses else None}
+        for m in self.metrics:
+            out[getattr(m, "name", lambda: "metric")()] = m.accumulate()
+        return out
+
+    def predict(self, test_data, batch_size=1, steps=None, collate_fn=None,
+                **kwargs):
+        if not self._prepared:
+            self.prepare()
+        mesh = self._ensure_mesh()
+        outs = []
+        with use_mesh(mesh):
+            for step, batch in enumerate(
+                    self._loader(test_data, batch_size, collate_fn)):
+                if steps and step >= steps:
+                    break
+                inputs = batch[0] if (isinstance(batch, (tuple, list))
+                                      and len(batch) == 2) else batch
+                from ..framework.tensor import to_tensor
+                inputs = inputs if isinstance(inputs, Tensor) \
+                    else to_tensor(inputs)
+                outs.append(self.model(inputs).numpy())
+        return outs
+
+    # --------------------------------------------------------- save/load
+    def save(self, path: str, training=True):
+        from ..framework_io import save as fsave
+        state = {k: v for k, v in self.model.state_dict().items()}
+        fsave(state, path + ".pdparams")
+        if training and self.optimizer is not None and hasattr(
+                self.optimizer, "state_dict"):
+            fsave(self.optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path: str, strict=True):
+        from ..framework_io import load as fload
+        self.model.set_state_dict(fload(path + ".pdparams"))
+        import os
+        if self.optimizer is not None and os.path.exists(path + ".pdopt") \
+                and hasattr(self.optimizer, "set_state_dict"):
+            self.optimizer.set_state_dict(fload(path + ".pdopt"))
+
+
+def create_mesh(axes: Dict[str, int]) -> ProcessMesh:
+    """Convenience: ProcessMesh over the first prod(axes) local devices."""
+    shape = list(axes.values())
+    return ProcessMesh(shape=shape, dim_names=list(axes.keys()))

@@ -51,6 +51,19 @@ class TestFFT:
         # Parseval: d/dx sum|X|^2 = 2*n*... nonzero, finite
         assert np.isfinite(g).all() and np.abs(g).max() > 0
 
+    def test_ihfftn_matches_scipy_convention(self):
+        """ihfftn(y) == conj(rfftn(y)) / N (the scipy/paddle relation)."""
+        y = np.random.RandomState(7).randn(4, 6).astype(np.float32)
+        ours = pfft.ihfftn(paddle.to_tensor(y)).numpy()
+        ref = np.conj(np.fft.rfftn(y)) / y.size
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+    def test_hfftn_roundtrip(self):
+        y = np.random.RandomState(8).randn(4, 6).astype(np.float32)
+        spec = pfft.ihfftn(paddle.to_tensor(y))
+        back = pfft.hfftn(spec, s=[4, 6]).numpy()
+        np.testing.assert_allclose(back, y, atol=1e-4)
+
     def test_invalid_norm_raises(self):
         with pytest.raises(ValueError, match="norm"):
             pfft.fft(paddle.to_tensor(np.zeros(4, np.float32)), norm="bad")
