@@ -1,0 +1,173 @@
+"""paddle_tpu.inference — the serving Predictor.
+
+Reference analog: paddle_infer (`AnalysisConfig` analysis_config.cc,
+`AnalysisPredictor` inference/api/analysis_predictor.h:94, created via
+`create_predictor`): load a saved program + params, run the analysis pass
+pipeline, serve named inputs/outputs.
+
+TPU-native collapse: the saved artifact is the jit.save StableHLO module +
+weights; "analysis passes" are XLA's compile (fusion/layout happen there),
+so Config keeps the knobs that still mean something (model paths, device)
+and accepts-and-ignores the GPU/TRT/MKLDNN toggles for port compatibility.
+The named-handle API (get_input_handle / copy_from_cpu / run /
+copy_to_cpu) matches the reference serving loop shape.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+__all__ = ["Config", "Predictor", "create_predictor", "PrecisionType"]
+
+
+class PrecisionType:
+    Float32 = "float32"
+    Half = "float16"
+    Bfloat16 = "bfloat16"
+    Int8 = "int8"
+
+
+class Config:
+    """AnalysisConfig analog. `Config(prog_file, params_file)` or
+    `Config(model_dir)` with the jit.save prefix inside."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        if prog_file is not None and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[:-len(".pdmodel")]
+        self._prefix = prog_file
+        self._params_file = params_file
+        self._device = "tpu"
+        self._precision = PrecisionType.Float32
+        self._enabled = {}
+
+    # ---------------------------------------------------------- ref shape
+    def set_model(self, prog_file: str, params_file: Optional[str] = None):
+        if prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[:-len(".pdmodel")]
+        self._prefix = prog_file
+        self._params_file = params_file
+
+    def model_dir(self):
+        return os.path.dirname(self._prefix or "")
+
+    def prog_file(self):
+        return (self._prefix or "") + ".pdmodel"
+
+    def params_file(self):
+        return self._params_file or (self._prefix or "") + ".pdiparams"
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._device = "tpu"      # accepted for compat; XLA owns placement
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def enable_xpu(self, *a, **k):
+        self._device = "tpu"
+
+    def use_gpu(self):
+        return False
+
+    def enable_tensorrt_engine(self, *a, **k):
+        self._enabled["tensorrt"] = False    # no-op: XLA is the compiler
+
+    def enable_mkldnn(self):
+        self._enabled["mkldnn"] = False
+
+    def switch_ir_optim(self, flag=True):
+        pass                                  # XLA passes always run
+
+    def enable_memory_optim(self):
+        pass
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+    def summary(self) -> str:
+        return (f"Config(prefix={self._prefix!r}, device={self._device}, "
+                f"precision={self._precision})")
+
+
+class _IOHandle:
+    """Named input/output tensor handle (reference ZeroCopyTensor)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._data: Optional[np.ndarray] = None
+
+    def reshape(self, shape):
+        if self._data is None:
+            self._data = np.zeros(shape, np.float32)
+        else:
+            self._data = np.reshape(self._data, shape)
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        self._data = np.asarray(arr)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return self._data
+
+    def shape(self):
+        return list(self._data.shape) if self._data is not None else []
+
+
+class Predictor:
+    """AnalysisPredictor analog over the jit.save artifact."""
+
+    def __init__(self, config: Config):
+        from ..jit import load as jit_load
+        self.config = config
+        self._layer = jit_load(config._prefix)
+        meta = self._layer._meta
+        shapes = meta.get("input_shapes", [])
+        names = meta.get("input_names") or [f"x{i}"
+                                            for i in range(len(shapes))]
+        self._in_names = list(names)
+        self._inputs: Dict[str, _IOHandle] = {
+            n: _IOHandle(n) for n in self._in_names}
+        self._out_names: List[str] = []
+        self._outputs: Dict[str, _IOHandle] = {}
+
+    # ------------------------------------------------------------ ref API
+    def get_input_names(self) -> List[str]:
+        return list(self._in_names)
+
+    def get_input_handle(self, name: str) -> _IOHandle:
+        return self._inputs[name]
+
+    def get_output_names(self) -> List[str]:
+        return list(self._out_names)
+
+    def get_output_handle(self, name: str) -> _IOHandle:
+        return self._outputs[name]
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """Execute. Either positional `inputs` (returns arrays, the
+        paddle_infer convenience form) or via the named handles."""
+        if inputs is None:
+            inputs = [self._inputs[n].copy_to_cpu() for n in self._in_names]
+        outs = self._layer(*[jnp.asarray(a) for a in inputs])
+        outs = outs if isinstance(outs, list) else [outs]
+        arrs = [np.asarray(o._value if isinstance(o, Tensor) else o)
+                for o in outs]
+        self._out_names = [f"out{i}" for i in range(len(arrs))]
+        self._outputs = {}
+        for n, a in zip(self._out_names, arrs):
+            h = _IOHandle(n)
+            h.copy_from_cpu(a)
+            self._outputs[n] = h
+        return arrs
+
+    def clone(self):
+        return Predictor(self.config)
+
+
+def create_predictor(config: Config) -> Predictor:
+    """Reference: paddle_infer.create_predictor."""
+    return Predictor(config)

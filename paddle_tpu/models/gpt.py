@@ -519,3 +519,120 @@ GPT3_CONFIGS = {
     "13b": GPTConfig(hidden_size=5120, num_layers=40, num_heads=40,
                      max_seq_len=2048),
 }
+
+
+# --------------------------------------------------------------------------
+# KV-cache decode path (reference: FusedMultiTransformer inference decoder,
+# incubate/nn/layer/fused_transformer.py:1022, and the inference
+# AnalysisPredictor's decoder workloads). TPU-native: the cache is one
+# stacked [L, B, max_len, H, hd] buffer per k/v whose layer axis scans with
+# the stacked params; prefill writes the prompt's k/v while running the
+# causal forward, decode steps are single-token dense attention over the
+# cache (a bandwidth-bound matvec — flash tiling buys nothing at T=1, and
+# dense masking keeps kv_len dynamic under jit).
+# --------------------------------------------------------------------------
+def init_kv_cache(cfg: GPTConfig, batch: int, max_len: int):
+    """→ {"k","v": [L, B, max_len, H, hd]} in the activation dtype."""
+    shape = (cfg.num_layers, batch, max_len, cfg.num_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def _cached_attention(x, params_l, kc, vc, pos, cfg):
+    """One block's attention with cache update. x [B,T,D]; kc/vc
+    [B,max_len,H,hd]; pos = number of tokens already in the cache.
+    Returns (attn_out, kc, vc)."""
+    B, T, D = x.shape
+    H, hd = cfg.num_heads, cfg.head_dim
+    qkv = jnp.einsum("bsd,df->bsf", x, params_l["qkv_w"].astype(x.dtype))
+    if params_l.get("qkv_b") is not None:
+        qkv = qkv + params_l["qkv_b"].astype(x.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, T, H, hd)
+    k = k.reshape(B, T, H, hd)
+    v = v.reshape(B, T, H, hd)
+    kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype), (0, pos, 0, 0))
+    vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype), (0, pos, 0, 0))
+    # dense masked attention over the cache: query i (global pos+i) sees
+    # cache slots <= pos+i
+    scale = 1.0 / math.sqrt(hd)
+    qf = jnp.swapaxes(q, 1, 2).astype(jnp.float32) * scale   # B,H,T,hd
+    kf = jnp.swapaxes(kc, 1, 2).astype(jnp.float32)          # B,H,S,hd
+    vf = jnp.swapaxes(vc, 1, 2).astype(jnp.float32)
+    s = jnp.einsum("bhtd,bhsd->bhts", qf, kf)
+    kvpos = jnp.arange(kc.shape[1])[None, :]                 # 1,S
+    qpos = pos + jnp.arange(T)[:, None]                      # T,1
+    s = jnp.where(kvpos <= qpos, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    ctx = jnp.einsum("bhts,bhsd->bhtd", p, vf)
+    ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, T, D).astype(x.dtype)
+    out = jnp.einsum("bsd,df->bsf", ctx,
+                     params_l["attn_out_w"].astype(x.dtype))
+    if params_l.get("attn_out_b") is not None:
+        out = out + params_l["attn_out_b"].astype(x.dtype)
+    return out, kc, vc
+
+
+def gpt_forward_cached(params, tokens, cache, pos, cfg: GPTConfig):
+    """Forward `tokens` [B,T] against a cache holding `pos` tokens.
+    → (logits [B,T,V], updated cache). Works for prefill (pos=0, T=prompt)
+    and decode (T=1). Dense-FFN configs only (MoE decode: v2)."""
+    if cfg.num_experts > 0:
+        raise NotImplementedError("KV-cache decode with MoE experts")
+    B, T = tokens.shape
+    x = jnp.take(params["wte"], tokens, axis=0).astype(cfg.dtype)
+    wpe = jax.lax.dynamic_slice_in_dim(params["wpe"], pos, T, axis=0)
+    x = x + wpe[None].astype(cfg.dtype)
+
+    stacked = {k: params[k] for k in _BLOCK_KEYS_DENSE if k in params}
+
+    def scan_fn(x, layer_in):
+        params_l, kc, vc = layer_in
+        h = x
+        a_in = _ln(h, params_l["ln1_scale"], params_l["ln1_bias"],
+                   cfg.layer_norm_eps)
+        a, kc, vc = _cached_attention(a_in, params_l, kc, vc, pos, cfg)
+        h = h + a
+        m_in = _ln(h, params_l["ln2_scale"], params_l["ln2_bias"],
+                   cfg.layer_norm_eps)
+        m = _dense_ffn(m_in, params_l["mlp_up_w"], params_l.get("mlp_up_b"),
+                       params_l["mlp_down_w"], params_l.get("mlp_down_b"))
+        return h + m, (kc, vc)
+
+    x, (kcs, vcs) = jax.lax.scan(scan_fn, x,
+                                 (stacked, cache["k"], cache["v"]))
+    x = _ln(x, params["ln_f_scale"], params["ln_f_bias"], cfg.layer_norm_eps)
+    logits = jnp.einsum("bsd,vd->bsv", x, params["wte"].astype(x.dtype))
+    return logits, {"k": kcs, "v": vcs}
+
+
+def greedy_generate(params, prompt, cfg: GPTConfig, max_new_tokens: int,
+                    max_len: Optional[int] = None):
+    """Greedy decode: prefill the prompt once, then scan single-token steps
+    through the cache. prompt [B, T0] → [B, T0 + max_new_tokens]."""
+    B, T0 = prompt.shape
+    max_len = max_len or min(cfg.max_seq_len, T0 + max_new_tokens)
+    if T0 + max_new_tokens > max_len:
+        raise ValueError(
+            f"prompt ({T0}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"max_len ({max_len}): the cache/wpe slices would clamp and "
+            f"silently corrupt the tail")
+    cache = init_kv_cache(cfg, B, max_len)
+    logits, cache = gpt_forward_cached(params, prompt, cache, 0, cfg)
+    next_tok = jnp.argmax(logits[:, -1].astype(jnp.float32), axis=-1)
+
+    def step(carry, i):
+        tok, cache = carry
+        lg, cache = gpt_forward_cached(params, tok[:, None], cache,
+                                       T0 + i, cfg)
+        nxt = jnp.argmax(lg[:, -1].astype(jnp.float32), axis=-1)
+        return (nxt, cache), tok
+
+    # N-1 decode steps: ys collects gen tokens 1..N-1, the final carry is
+    # gen token N (no wasted extra forward)
+    (last, _), toks = jax.lax.scan(
+        step, (next_tok, cache), jnp.arange(max_new_tokens - 1))
+    gen = jnp.concatenate(
+        [jnp.moveaxis(toks, 0, 1).astype(prompt.dtype),
+         last[:, None].astype(prompt.dtype)], 1)
+    return jnp.concatenate([prompt, gen], axis=1)

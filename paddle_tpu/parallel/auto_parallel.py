@@ -60,7 +60,7 @@ class ProcessMesh:
         self._ids = arr
         self._dim_names = tuple(dim_names)
         self._jax_mesh: Optional[Mesh] = None
-        self._ctx = None
+        self._ctx_stack: List[Any] = []      # reentrant context support
 
     # reference-shaped accessors
     @property
@@ -98,13 +98,13 @@ class ProcessMesh:
         return self._jax_mesh
 
     def __enter__(self):
-        self._ctx = use_mesh(self.mesh)
-        self._ctx.__enter__()
+        ctx = use_mesh(self.mesh)
+        ctx.__enter__()
+        self._ctx_stack.append(ctx)
         return self
 
     def __exit__(self, *exc):
-        ctx, self._ctx = self._ctx, None
-        return ctx.__exit__(*exc)
+        return self._ctx_stack.pop().__exit__(*exc)
 
     def __eq__(self, other):
         return (isinstance(other, ProcessMesh)
@@ -261,12 +261,14 @@ class Engine:
         return self
 
     # ------------------------------------------------------------- loops
-    def _loader(self, data, batch_size, collate_fn):
+    def _loader(self, data, batch_size, collate_fn, train=False):
         from ..io import DataLoader
         if hasattr(data, "__iter__") and not hasattr(data, "__getitem__"):
             return data
+        # drop_last only while training (uniform batches for dp sharding);
+        # eval/predict must score the trailing partial batch
         return DataLoader(data, batch_size=batch_size, shuffle=False,
-                          collate_fn=collate_fn, drop_last=True)
+                          collate_fn=collate_fn, drop_last=train)
 
     def _step(self, batch, train: bool):
         inputs, labels = (batch if isinstance(batch, (tuple, list))
@@ -284,8 +286,15 @@ class Engine:
                 self.optimizer.step()
                 self.optimizer.clear_grad()
         if labels is not None:
+            from ..metric import Metric as _MetricBase
             for m in self.metrics:
-                if hasattr(m, "compute"):
+                # use compute() only when actually overridden — the Metric
+                # ABC's default raises NotImplementedError
+                overridden = (hasattr(m, "compute")
+                              and not (isinstance(m, _MetricBase)
+                                       and type(m).compute
+                                       is _MetricBase.compute))
+                if overridden:
                     m.update(m.compute(out, labels))
                 else:
                     m.update(out, labels)
@@ -299,13 +308,16 @@ class Engine:
         from ..profiler.timer import benchmark
         bm = benchmark()
         bm.begin()
+        if hasattr(self.model, "train"):
+            self.model.train()
         with use_mesh(mesh):
             for ep in range(epochs):
                 for m in self.metrics:
                     m.reset()
                 losses = []
                 for step, batch in enumerate(
-                        self._loader(train_data, batch_size, collate_fn)):
+                        self._loader(train_data, batch_size, collate_fn,
+                                     train=True)):
                     if steps_per_epoch and step >= steps_per_epoch:
                         break
                     _, loss_v = self._step(batch, train=True)
@@ -329,6 +341,8 @@ class Engine:
         losses = []
         for m in self.metrics:
             m.reset()
+        if hasattr(self.model, "eval"):
+            self.model.eval()
         with use_mesh(mesh):
             for step, batch in enumerate(
                     self._loader(valid_data, batch_size, collate_fn)):
@@ -348,6 +362,8 @@ class Engine:
             self.prepare()
         mesh = self._ensure_mesh()
         outs = []
+        if hasattr(self.model, "eval"):
+            self.model.eval()
         with use_mesh(mesh):
             for step, batch in enumerate(
                     self._loader(test_data, batch_size, collate_fn)):

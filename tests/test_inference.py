@@ -1,0 +1,136 @@
+"""Inference Predictor + KV-cache decode tests.
+
+Reference analog: AnalysisPredictor serving loop
+(inference/api/analysis_predictor.h:94) and the FusedMultiTransformer
+cached decoder (incubate/nn/layer/fused_transformer.py:1022).
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.inference import Config, create_predictor
+from paddle_tpu.models.gpt import (GPTConfig, init_gpt_params, gpt_forward,
+                                   init_kv_cache, gpt_forward_cached,
+                                   greedy_generate)
+
+
+def _small_cfg():
+    return GPTConfig(vocab_size=64, hidden_size=32, num_layers=3,
+                     num_heads=2, ffn_hidden=64, max_seq_len=32,
+                     sequence_parallel=False, remat=False,
+                     dtype=jnp.float32)
+
+
+class TestPredictor:
+    def _save_model(self, tmp_path):
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        from paddle_tpu.jit import InputSpec
+        path = str(tmp_path / "m" / "model")
+        paddle.jit.save(model, path,
+                        input_spec=[InputSpec([2, 8], "float32")])
+        return model, path
+
+    def test_named_handle_serving_loop(self, tmp_path):
+        model, path = self._save_model(tmp_path)
+        config = Config(path + ".pdmodel")
+        predictor = create_predictor(config)
+        names = predictor.get_input_names()
+        assert len(names) == 1
+        x = np.random.RandomState(0).randn(2, 8).astype(np.float32)
+        h = predictor.get_input_handle(names[0])
+        h.reshape([2, 8])
+        h.copy_from_cpu(x)
+        predictor.run()
+        out_names = predictor.get_output_names()
+        assert len(out_names) == 1
+        got = predictor.get_output_handle(out_names[0]).copy_to_cpu()
+        model.eval()
+        want = model(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_positional_run(self, tmp_path):
+        model, path = self._save_model(tmp_path)
+        predictor = create_predictor(Config(path))
+        x = np.ones((2, 8), np.float32)
+        outs = predictor.run([x])
+        assert outs[0].shape == (2, 4)
+
+    def test_clone(self, tmp_path):
+        _, path = self._save_model(tmp_path)
+        p1 = create_predictor(Config(path))
+        p2 = p1.clone()
+        x = np.ones((2, 8), np.float32)
+        np.testing.assert_array_equal(p1.run([x])[0], p2.run([x])[0])
+
+    def test_config_compat_surface(self):
+        c = Config("/tmp/foo.pdmodel")
+        c.enable_use_gpu(100, 0)       # accepted, XLA owns placement
+        c.enable_tensorrt_engine()
+        c.switch_ir_optim(True)
+        assert not c.use_gpu()
+        assert "Config" in c.summary()
+
+
+class TestKVCacheDecode:
+    def test_prefill_matches_full_forward(self):
+        cfg = _small_cfg()
+        params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+        toks = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, 64)
+        cache = init_kv_cache(cfg, 2, 16)
+        lg_c, cache = gpt_forward_cached(params, toks, cache, 0, cfg)
+        lg_f = gpt_forward(params, toks, cfg)
+        np.testing.assert_allclose(np.asarray(lg_c), np.asarray(lg_f),
+                                   atol=1e-5)
+        # cache holds the prompt k/v (nonzero), tail empty
+        assert float(jnp.abs(cache["k"][:, :, :8]).sum()) > 0
+        assert float(jnp.abs(cache["k"][:, :, 8:]).sum()) == 0
+
+    def test_decode_step_matches_full_forward(self):
+        cfg = _small_cfg()
+        params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+        toks = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, 64)
+        cache = init_kv_cache(cfg, 2, 16)
+        _, cache = gpt_forward_cached(params, toks, cache, 0, cfg)
+        nxt = jax.random.randint(jax.random.PRNGKey(2), (2, 1), 0, 64)
+        lg_d, _ = gpt_forward_cached(params, nxt, cache, 8, cfg)
+        lg_f = gpt_forward(params, jnp.concatenate([toks, nxt], 1), cfg)
+        np.testing.assert_allclose(np.asarray(lg_d[:, 0]),
+                                   np.asarray(lg_f[:, -1]), atol=1e-5)
+
+    def test_greedy_generate_parity_vs_nocache(self):
+        """The VERDICT acceptance test: greedy decode with KV cache equals
+        argmax over the no-cache full forward at every step."""
+        cfg = _small_cfg()
+        params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 5), 0, 64)
+        out = greedy_generate(params, prompt, cfg, 7, max_len=16)
+        cur = prompt
+        for _ in range(7):
+            lg = gpt_forward(params, cur, cfg)
+            nx = jnp.argmax(lg[:, -1].astype(jnp.float32), -1)[:, None]
+            cur = jnp.concatenate([cur, nx], 1)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(cur))
+
+    def test_moe_decode_raises(self):
+        cfg = _small_cfg()
+        cfg.num_experts = 2
+        params = {"wte": jnp.zeros((64, 32))}
+        with pytest.raises(NotImplementedError, match="MoE"):
+            gpt_forward_cached(params, jnp.zeros((1, 1), jnp.int32),
+                               {}, 0, cfg)
+
+    def test_generate_jits_once(self):
+        """greedy_generate is scan-based: wrap in jit and run twice with
+        different prompts — same compiled fn, consistent outputs."""
+        cfg = _small_cfg()
+        params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+        import functools
+        gen = jax.jit(functools.partial(greedy_generate, cfg=cfg,
+                                        max_new_tokens=4, max_len=16))
+        p1 = jax.random.randint(jax.random.PRNGKey(1), (1, 4), 0, 64)
+        p2 = jax.random.randint(jax.random.PRNGKey(2), (1, 4), 0, 64)
+        o1, o2 = gen(params, p1), gen(params, p2)
+        assert o1.shape == o2.shape == (1, 8)
