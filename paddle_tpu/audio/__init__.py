@@ -1,0 +1,9 @@
+"""paddle_tpu.audio — audio feature extraction.
+
+Reference analog: python/paddle/audio/ (features/layers.py Spectrogram/
+MelSpectrogram/LogMelSpectrogram/MFCC, functional/functional.py
+hz_to_mel/mel_to_hz/compute_fbank_matrix/create_dct + window functions).
+Built on paddle_tpu.signal.stft/fft — all traceable ops.
+"""
+from . import functional  # noqa: F401
+from . import features  # noqa: F401

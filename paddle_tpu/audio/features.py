@@ -1,0 +1,100 @@
+"""audio.features layers (reference python/paddle/audio/features/layers.py:
+Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..nn.layer import Layer
+from .. import signal as psignal
+from . import functional as F
+
+
+class Spectrogram(Layer):
+    """|STFT|^power (reference features/layers.py Spectrogram)."""
+
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.window = F.get_window(window, self.win_length, dtype=dtype)
+
+    def forward(self, x):
+        spec = psignal.stft(x, self.n_fft, self.hop_length, self.win_length,
+                            window=self.window, center=self.center,
+                            pad_mode=self.pad_mode)
+        mag = spec.abs()
+        if self.power != 1.0:
+            mag = mag ** self.power
+        return mag
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False,
+                 norm="slaney", dtype="float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                       window, power, center, pad_mode,
+                                       dtype)
+        self.fbank = F.compute_fbank_matrix(
+            sr=sr, n_fft=n_fft, n_mels=n_mels, f_min=f_min, f_max=f_max,
+            htk=htk, norm=norm, dtype=dtype)
+
+    def forward(self, x):
+        spec = self.spectrogram(x)            # [..., n_fft//2+1, frames]
+        from ..ops.math import matmul
+        return matmul(self.fbank, spec)       # [..., n_mels, frames]
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, *args, ref_value=1.0, amin=1e-10, top_db=None,
+                 **kwargs):
+        super().__init__()
+        self.melspectrogram = MelSpectrogram(*args, **kwargs)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = self.melspectrogram(x)
+        from ..ops import math as m
+        log_spec = m.multiply(
+            m.log10(m.maximum(mel, Tensor(jnp.asarray(self.amin,
+                                                      np.float32)))),
+            Tensor(jnp.asarray(10.0, np.float32)))
+        ref = max(self.amin, self.ref_value)
+        log_spec = log_spec - 10.0 * np.log10(ref)
+        if self.top_db is not None:
+            peak = float(log_spec.max().numpy())
+            log_spec = m.maximum(
+                log_spec, Tensor(jnp.asarray(peak - self.top_db,
+                                             np.float32)))
+        return log_spec
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 n_mels=64, f_min=50.0, f_max=None, dtype="float32",
+                 **kwargs):
+        super().__init__()
+        self.log_mel = LogMelSpectrogram(sr=sr, n_fft=n_fft,
+                                         hop_length=hop_length,
+                                         n_mels=n_mels, f_min=f_min,
+                                         f_max=f_max, dtype=dtype, **kwargs)
+        self.dct = F.create_dct(n_mfcc, n_mels, dtype=dtype)
+
+    def forward(self, x):
+        mel = self.log_mel(x)                  # [..., n_mels, frames]
+        from ..ops.math import matmul
+        from ..ops.manipulation import transpose
+        # [n_mels, n_mfcc]^T @ [..., n_mels, frames]
+        return matmul(transpose(self.dct, [1, 0]), mel)

@@ -1,0 +1,104 @@
+"""audio.functional (reference python/paddle/audio/functional/functional.py
++ window.py)."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..framework.tensor import Tensor, to_tensor
+
+
+def hz_to_mel(freq, htk=False):
+    """Reference functional.py hz_to_mel (slaney default, htk option)."""
+    scalar = not isinstance(freq, (np.ndarray, Tensor, list, tuple))
+    f = np.asarray(freq.numpy() if isinstance(freq, Tensor) else freq,
+                   np.float64)
+    if htk:
+        mel = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mel = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mel = np.where(f >= min_log_hz,
+                       min_log_mel + np.log(np.maximum(f, 1e-10)
+                                            / min_log_hz) / logstep, mel)
+    return float(mel) if scalar else mel
+
+
+def mel_to_hz(mel, htk=False):
+    scalar = not isinstance(mel, (np.ndarray, Tensor, list, tuple))
+    m = np.asarray(mel.numpy() if isinstance(mel, Tensor) else mel,
+                   np.float64)
+    if htk:
+        hz = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        hz = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        hz = np.where(m >= min_log_mel,
+                      min_log_hz * np.exp(logstep * (m - min_log_mel)), hz)
+    return float(hz) if scalar else hz
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False):
+    mels = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk), n_mels)
+    return mel_to_hz(mels, htk)
+
+
+def fft_frequencies(sr, n_fft):
+    return np.linspace(0, float(sr) / 2, n_fft // 2 + 1)
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """Mel filterbank [n_mels, n_fft//2+1] (reference
+    compute_fbank_matrix)."""
+    f_max = f_max or float(sr) / 2
+    fftfreqs = fft_frequencies(sr, n_fft)
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk)
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = np.maximum(0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2: n_mels + 2] - mel_f[:n_mels])
+        weights *= enorm[:, None]
+    return to_tensor(weights.astype(np.dtype(dtype)))
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """DCT-II matrix [n_mels, n_mfcc] (reference create_dct)."""
+    n = np.arange(n_mels, dtype=np.float64)
+    k = np.arange(n_mfcc, dtype=np.float64)[None, :]
+    dct = np.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / math.sqrt(2)
+        dct *= math.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return to_tensor(dct.astype(np.dtype(dtype)))
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    """Reference window.py get_window: hann/hamming/blackman/rect."""
+    name = window if isinstance(window, str) else str(window)
+    M = win_length + (0 if fftbins else -1)
+    n = np.arange(win_length, dtype=np.float64)
+    if name in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * math.pi * n / max(M, 1))
+    elif name == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * math.pi * n / max(M, 1))
+    elif name == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * math.pi * n / max(M, 1))
+             + 0.08 * np.cos(4 * math.pi * n / max(M, 1)))
+    elif name in ("rect", "boxcar", "ones"):
+        w = np.ones(win_length)
+    else:
+        raise ValueError(f"unsupported window {name!r}")
+    return to_tensor(w.astype(np.dtype(dtype)))

@@ -1,0 +1,5 @@
+"""paddle_tpu.callbacks — re-export of hapi.callbacks (the reference's
+paddle.callbacks namespace, python/paddle/__init__.py)."""
+from ..hapi.callbacks import (  # noqa: F401
+    Callback, CallbackList, ProgBarLogger, ModelCheckpoint, EarlyStopping,
+    LRScheduler)

@@ -1,0 +1,471 @@
+"""paddle_tpu.distribution — probability distributions + KL registry.
+
+Reference analog: python/paddle/distribution/ (Distribution base kl.py
+registry, Normal/Uniform/Categorical/Bernoulli/Beta/Dirichlet/Gamma/
+Exponential/Laplace/LogNormal/Gumbel/Geometric/Cauchy/Multinomial +
+TransformedDistribution). TPU-native: sampling uses jax.random through the
+framework's seeded key stream, log_prob/entropy are traceable ops, so
+distributions compose with jit/grad like everything else.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Tuple, Type
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor, to_tensor
+from ..framework.random import next_key
+
+__all__ = [
+    "Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+    "Beta", "Dirichlet", "Gamma", "Exponential", "Laplace", "LogNormal",
+    "Gumbel", "Geometric", "Cauchy", "Multinomial", "kl_divergence",
+    "register_kl",
+]
+
+
+def _v(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x, jnp.float32) if not isinstance(x, jax.Array) \
+        else x
+
+
+def _t(v):
+    return Tensor(v, stop_gradient=True)
+
+
+class Distribution:
+    """Base (reference distribution.py): sample/rsample/log_prob/prob/
+    entropy/mean/variance/kl_divergence."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return _t(jnp.exp(_v(self.log_prob(value))))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return _t(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return _t(jnp.broadcast_to(jnp.square(self.scale),
+                                   self.batch_shape))
+
+    def rsample(self, shape=()):
+        z = jax.random.normal(next_key(), tuple(shape) + self.batch_shape)
+        return _t(self.loc + self.scale * z)
+
+    sample = rsample
+
+    def log_prob(self, value):
+        v = _v(value)
+        var = jnp.square(self.scale)
+        return _t(-jnp.square(v - self.loc) / (2 * var)
+                  - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        e = 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+        return _t(jnp.broadcast_to(e, self.batch_shape))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _v(low)
+        self.high = _v(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape,
+                                              self.high.shape))
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(next_key(),
+                               tuple(shape) + self.batch_shape)
+        return _t(self.low + (self.high - self.low) * u)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _v(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return _t(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return _t(jnp.broadcast_to(jnp.log(self.high - self.low),
+                                   self.batch_shape))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs = _v(probs)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return _t(self.probs)
+
+    @property
+    def variance(self):
+        return _t(self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(next_key(),
+                               tuple(shape) + self.batch_shape)
+        return _t((u < self.probs).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _v(value)
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return _t(v * jnp.log(p) + (1 - v) * jnp.log1p(-p))
+
+    def entropy(self):
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return _t(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is None and probs is None:
+            raise ValueError("Categorical needs logits or probs")
+        if logits is not None:
+            self.logits = jax.nn.log_softmax(_v(logits), axis=-1)
+        else:
+            self.logits = jnp.log(jnp.clip(_v(probs), 1e-37, None))
+            self.logits = jax.nn.log_softmax(self.logits, axis=-1)
+        super().__init__(self.logits.shape[:-1])
+
+    @property
+    def probs(self):
+        return _t(jnp.exp(self.logits))
+
+    def sample(self, shape=()):
+        return _t(jax.random.categorical(
+            next_key(), self.logits, shape=tuple(shape) + self.batch_shape))
+
+    def log_prob(self, value):
+        v = _v(value).astype(jnp.int32)
+        # broadcast logits against arbitrary sample shapes (e.g. a vector
+        # of draws from a scalar-batch Categorical)
+        logits = jnp.broadcast_to(self.logits,
+                                  v.shape + self.logits.shape[-1:])
+        return _t(jnp.take_along_axis(logits, v[..., None],
+                                      axis=-1)[..., 0])
+
+    def entropy(self):
+        p = jnp.exp(self.logits)
+        return _t(-jnp.sum(p * self.logits, axis=-1))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _v(alpha)
+        self.beta = _v(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    @property
+    def mean(self):
+        return _t(self.alpha / (self.alpha + self.beta))
+
+    def sample(self, shape=()):
+        k1, k2 = jax.random.split(next_key())
+        sh = tuple(shape) + self.batch_shape
+        ga = jax.random.gamma(k1, jnp.broadcast_to(self.alpha, sh))
+        gb = jax.random.gamma(k2, jnp.broadcast_to(self.beta, sh))
+        return _t(ga / (ga + gb))
+
+    def log_prob(self, value):
+        v = _v(value)
+        from jax.scipy.special import betaln
+        return _t((self.alpha - 1) * jnp.log(v)
+                  + (self.beta - 1) * jnp.log1p(-v)
+                  - betaln(self.alpha, self.beta))
+
+    def entropy(self):
+        from jax.scipy.special import betaln, digamma
+        a, b = self.alpha, self.beta
+        return _t(betaln(a, b) - (a - 1) * digamma(a)
+                  - (b - 1) * digamma(b)
+                  + (a + b - 2) * digamma(a + b))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _v(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    def sample(self, shape=()):
+        return _t(jax.random.dirichlet(
+            next_key(), self.concentration,
+            shape=tuple(shape) + self.batch_shape))
+
+    def log_prob(self, value):
+        v = _v(value)
+        from jax.scipy.special import gammaln
+        a = self.concentration
+        return _t(jnp.sum((a - 1) * jnp.log(v), -1)
+                  + gammaln(jnp.sum(a, -1)) - jnp.sum(gammaln(a), -1))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _v(concentration)
+        self.rate = _v(rate)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    @property
+    def mean(self):
+        return _t(self.concentration / self.rate)
+
+    def sample(self, shape=()):
+        sh = tuple(shape) + self.batch_shape
+        g = jax.random.gamma(next_key(),
+                             jnp.broadcast_to(self.concentration, sh))
+        return _t(g / self.rate)
+
+    def log_prob(self, value):
+        v = _v(value)
+        from jax.scipy.special import gammaln
+        a, r = self.concentration, self.rate
+        return _t(a * jnp.log(r) + (a - 1) * jnp.log(v) - r * v
+                  - gammaln(a))
+
+    def entropy(self):
+        from jax.scipy.special import gammaln, digamma
+        a, r = self.concentration, self.rate
+        return _t(a - jnp.log(r) + gammaln(a) + (1 - a) * digamma(a))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _v(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return _t(1.0 / self.rate)
+
+    def sample(self, shape=()):
+        u = jax.random.exponential(next_key(),
+                                   tuple(shape) + self.batch_shape)
+        return _t(u / self.rate)
+
+    def log_prob(self, value):
+        return _t(jnp.log(self.rate) - self.rate * _v(value))
+
+    def entropy(self):
+        return _t(1.0 - jnp.log(self.rate))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        z = jax.random.laplace(next_key(),
+                               tuple(shape) + self.batch_shape)
+        return _t(self.loc + self.scale * z)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _v(value)
+        return _t(-jnp.abs(v - self.loc) / self.scale
+                  - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return _t(1 + jnp.log(2 * self.scale)
+                  + jnp.zeros(self.batch_shape))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        self._normal = Normal(loc, scale)
+        super().__init__(self._normal.batch_shape)
+
+    def sample(self, shape=()):
+        return _t(jnp.exp(_v(self._normal.sample(shape))))
+
+    def log_prob(self, value):
+        v = _v(value)
+        return _t(_v(self._normal.log_prob(_t(jnp.log(v)))) - jnp.log(v))
+
+    def entropy(self):
+        return _t(_v(self._normal.entropy()) + self.loc)
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        g = jax.random.gumbel(next_key(), tuple(shape) + self.batch_shape)
+        return _t(self.loc + self.scale * g)
+
+    def log_prob(self, value):
+        z = (_v(value) - self.loc) / self.scale
+        return _t(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+    def entropy(self):
+        # Euler-Mascheroni
+        return _t(jnp.log(self.scale) + 1.0 + 0.5772156649015329)
+
+
+class Geometric(Distribution):
+    """P(k) = (1-p)^k p, k = number of failures before first success."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _v(probs)
+        super().__init__(self.probs.shape)
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(next_key(),
+                               tuple(shape) + self.batch_shape,
+                               minval=1e-7, maxval=1.0)
+        return _t(jnp.floor(jnp.log(u) / jnp.log1p(-self.probs)))
+
+    def log_prob(self, value):
+        v = _v(value)
+        return _t(v * jnp.log1p(-self.probs) + jnp.log(self.probs))
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        z = jax.random.cauchy(next_key(), tuple(shape) + self.batch_shape)
+        return _t(self.loc + self.scale * z)
+
+    def log_prob(self, value):
+        z = (_v(value) - self.loc) / self.scale
+        return _t(-jnp.log(math.pi * self.scale * (1 + jnp.square(z))))
+
+    def entropy(self):
+        return _t(jnp.log(4 * math.pi * self.scale))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _v(probs)
+        super().__init__(self.probs.shape[:-1], self.probs.shape[-1:])
+
+    def sample(self, shape=()):
+        n = self.total_count
+        cat = Categorical(probs=_t(self.probs))
+        draws = _v(cat.sample((n,) + tuple(shape)))       # [n, *shape, *b]
+        k = self.probs.shape[-1]
+        onehot = jax.nn.one_hot(draws, k)
+        return _t(jnp.sum(onehot, axis=0))
+
+    def log_prob(self, value):
+        v = _v(value)
+        from jax.scipy.special import gammaln
+        logp = jnp.log(jnp.clip(self.probs, 1e-37, None))
+        return _t(gammaln(self.total_count + 1.0)
+                  - jnp.sum(gammaln(v + 1.0), -1)
+                  + jnp.sum(v * logp, -1))
+
+
+# ------------------------------------------------------------- KL registry
+_KL_REGISTRY: Dict[Tuple[type, type], callable] = {}
+
+
+def register_kl(p_cls: type, q_cls: type):
+    """Decorator registering a KL(p||q) rule (reference kl.py:register_kl)."""
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    """Dispatch KL(p||q) through the registry with MRO fallback
+    (reference kl.py:kl_divergence)."""
+    for (pc, qc), fn in _KL_REGISTRY.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            return fn(p, q)
+    raise NotImplementedError(
+        f"no KL rule registered for ({type(p).__name__}, "
+        f"{type(q).__name__})")
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    var_p, var_q = jnp.square(p.scale), jnp.square(q.scale)
+    return _t(0.5 * (var_p / var_q + jnp.square(q.loc - p.loc) / var_q
+                     - 1.0 + jnp.log(var_q) - jnp.log(var_p)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_cat_cat(p, q):
+    pp = jnp.exp(p.logits)
+    return _t(jnp.sum(pp * (p.logits - q.logits), axis=-1))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bern_bern(p, q):
+    pp = jnp.clip(p.probs, 1e-7, 1 - 1e-7)
+    qq = jnp.clip(q.probs, 1e-7, 1 - 1e-7)
+    return _t(pp * (jnp.log(pp) - jnp.log(qq))
+              + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qq)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_unif_unif(p, q):
+    return _t(jnp.log((q.high - q.low) / (p.high - p.low)))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exp_exp(p, q):
+    return _t(jnp.log(p.rate) - jnp.log(q.rate) + q.rate / p.rate - 1.0)

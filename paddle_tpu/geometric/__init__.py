@@ -1,0 +1,116 @@
+"""paddle_tpu.geometric — graph message passing.
+
+Reference analog: python/paddle/geometric/ (send_u_recv / send_ue_recv /
+send_uv message passing over `graph_send_recv` CUDA kernels, segment pool
+ops). TPU-native: gathers + `jax.ops.segment_*` — XLA lowers segment
+reductions to sorted scatter-adds that run well on TPU; `out_size` (the
+number of destination nodes) must be static under jit, as all TPU shapes
+must.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.dispatch import apply
+from ..framework.tensor import Tensor
+
+__all__ = ["send_u_recv", "send_ue_recv", "send_uv",
+           "segment_sum", "segment_mean", "segment_max", "segment_min"]
+
+_REDUCES = {
+    "sum": jax.ops.segment_sum,
+    "mean": None,                      # composed below
+    "max": jax.ops.segment_max,
+    "min": jax.ops.segment_min,
+}
+
+
+def _num_segments(dst_index, out_size):
+    if out_size is not None:
+        return int(out_size)
+    idx = dst_index.numpy() if isinstance(dst_index, Tensor) else dst_index
+    import numpy as np
+    return int(np.asarray(idx).max()) + 1 if np.asarray(idx).size else 0
+
+
+def _segment_reduce(msg, dst, n, op):
+    if op == "mean":
+        s = jax.ops.segment_sum(msg, dst, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((msg.shape[0],), msg.dtype), dst,
+                                  num_segments=n)
+        return s / jnp.maximum(cnt, 1.0)[(...,) + (None,) * (msg.ndim - 1)]
+    out = _REDUCES[op](msg, dst, num_segments=n)
+    if op in ("max", "min"):
+        # zero empty segments (the reference convention) without the
+        # isfinite trap: integer empties come back as iinfo min/max, so
+        # detect emptiness by count, not by value, preserving the dtype
+        cnt = jax.ops.segment_sum(jnp.ones((msg.shape[0],), jnp.int32),
+                                  dst, num_segments=n)
+        mask = (cnt > 0)[(...,) + (None,) * (msg.ndim - 1)]
+        out = jnp.where(mask, out, jnp.zeros((), out.dtype))
+    return out
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x[src] → reduce onto dst (reference geometric
+    message_passing/send_recv.py send_u_recv)."""
+    if reduce_op not in ("sum", "mean", "max", "min"):
+        raise ValueError(f"bad reduce_op {reduce_op!r}")
+    n = _num_segments(dst_index, out_size)
+
+    def _op(x, src, dst, n, op):
+        msg = jnp.take(x, src, axis=0)
+        return _segment_reduce(msg, dst, n, op)
+    return apply("send_u_recv", _op, x, src_index, dst_index, n=n,
+                 op=reduce_op)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Gather x[src], combine with edge feature y, reduce onto dst
+    (reference send_ue_recv: message_op add/sub/mul/div)."""
+    ops = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+           "div": jnp.true_divide}
+    if message_op not in ops:
+        raise ValueError(f"bad message_op {message_op!r}")
+    if reduce_op not in ("sum", "mean", "max", "min"):
+        raise ValueError(f"bad reduce_op {reduce_op!r}")
+    n = _num_segments(dst_index, out_size)
+
+    def _op(x, y, src, dst, n, mop, rop):
+        msg = ops[mop](jnp.take(x, src, axis=0), y)
+        return _segment_reduce(msg, dst, n, rop)
+    return apply("send_ue_recv", _op, x, y, src_index, dst_index, n=n,
+                 mop=message_op, rop=reduce_op)
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message x[src] ⊕ y[dst] (reference send_uv)."""
+    ops = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+           "div": jnp.true_divide}
+    if message_op not in ops:
+        raise ValueError(f"bad message_op {message_op!r}")
+
+    def _op(x, y, src, dst, mop):
+        return ops[mop](jnp.take(x, src, axis=0), jnp.take(y, dst, axis=0))
+    return apply("send_uv", _op, x, y, src_index, dst_index, mop=message_op)
+
+
+def _segment_api(op):
+    def f(data, segment_ids, name=None):
+        n = _num_segments(segment_ids, None)
+
+        def _op(data, seg, n):
+            return _segment_reduce(data, seg, n, op)
+        return apply(f"segment_{op}", _op, data, segment_ids, n=n)
+    f.__name__ = f"segment_{op}"
+    f.__doc__ = f"Reference: paddle.geometric.segment_{op}."
+    return f
+
+
+segment_sum = _segment_api("sum")
+segment_mean = _segment_api("mean")
+segment_max = _segment_api("max")
+segment_min = _segment_api("min")

@@ -1,0 +1,5 @@
+"""paddle_tpu.hapi — Keras-like high-level API (reference
+python/paddle/hapi: Model, callbacks, model_summary)."""
+from .model import Model  # noqa: F401
+from . import callbacks  # noqa: F401
+from .model_summary import summary  # noqa: F401

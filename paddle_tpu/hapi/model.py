@@ -1,0 +1,242 @@
+"""hapi.Model — the Keras-like high-level training API.
+
+Reference analog: python/paddle/hapi/model.py:1050 (`Model` with
+prepare/fit/evaluate/predict/save/load/summary over a nn.Layer), callbacks
+wiring, and train_batch/eval_batch/predict_batch single-step entries.
+
+TPU-native: the step itself is the eager tape + per-op jit (or the user can
+to_static the underlying network); hapi adds the loop, metrics, callbacks,
+and checkpoint glue. Distribution comes from the active mesh — run fit
+inside `use_mesh`/ProcessMesh and the dp axis shards the batch exactly as
+in the auto-parallel Engine.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..framework.tensor import Tensor, to_tensor
+from .callbacks import config_callbacks
+
+
+class Model:
+    """paddle.Model analog (reference hapi/model.py:1050)."""
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+
+    # ------------------------------------------------------------- prepare
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        ms = metrics if isinstance(metrics, (list, tuple)) else (
+            [metrics] if metrics else [])
+        self._metrics = list(ms)
+        return self
+
+    # -------------------------------------------------------- batch steps
+    def _forward(self, inputs):
+        if isinstance(inputs, (list, tuple)):
+            return self.network(*inputs)
+        return self.network(inputs)
+
+    def _compute_loss(self, outputs, labels):
+        if self._loss is None:
+            return None
+        if isinstance(labels, (list, tuple)):
+            return self._loss(outputs, *labels)
+        return self._loss(outputs, labels)
+
+    def train_batch(self, inputs, labels=None, update=True):
+        """One optimizer step → [loss, metrics...] (reference
+        Model.train_batch)."""
+        self.network.train()
+        inputs = _to_tensors(inputs)
+        labels = _to_tensors(labels)
+        outputs = self._forward(inputs)
+        loss = self._compute_loss(outputs, labels)
+        if loss is not None:
+            loss.backward()
+            if update:
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+        metrics = self._update_metrics(outputs, labels)
+        loss_np = float(loss.numpy()) if loss is not None else None
+        return ([loss_np] + metrics) if metrics else [loss_np]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = _to_tensors(inputs)
+        labels = _to_tensors(labels)
+        outputs = self._forward(inputs)
+        loss = self._compute_loss(outputs, labels)
+        metrics = self._update_metrics(outputs, labels)
+        loss_np = float(loss.numpy()) if loss is not None else None
+        return ([loss_np] + metrics) if metrics else [loss_np]
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        out = self._forward(_to_tensors(inputs))
+        if isinstance(out, (list, tuple)):
+            return [np.asarray(o.numpy()) for o in out]
+        return np.asarray(out.numpy())
+
+    def _update_metrics(self, outputs, labels):
+        from ..metric import Metric
+        vals = []
+        if labels is None:
+            return vals
+        for m in self._metrics:
+            overridden = (hasattr(m, "compute")
+                          and not (isinstance(m, Metric)
+                                   and type(m).compute is Metric.compute))
+            if overridden:
+                res = m.update(m.compute(outputs, labels))
+            else:
+                res = m.update(outputs, labels)
+            vals.append(res if res is not None else m.accumulate())
+        return vals
+
+    # ---------------------------------------------------------------- fit
+    def _loader(self, data, batch_size, shuffle, train=False):
+        from ..io import DataLoader, Dataset
+        if data is None:
+            return None
+        if hasattr(data, "__iter__") and not hasattr(data, "__getitem__"):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          drop_last=train)
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            shuffle=True, num_workers=0, callbacks=None, **kwargs):
+        """Training loop with callbacks + optional eval (reference
+        Model.fit)."""
+        assert self._optimizer is not None, "call prepare() first"
+        self.stop_training = False
+        loader = self._loader(train_data, batch_size, shuffle, train=True)
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs,
+                                verbose=verbose, save_freq=save_freq,
+                                save_dir=save_dir, metrics=[
+                                    m.name() for m in self._metrics])
+        cbks.on_train_begin()
+        history = {"loss": []}
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            losses = []
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
+                inputs, labels = _split_batch(batch)
+                vals = self.train_batch(inputs, labels)
+                if vals[0] is not None:
+                    losses.append(vals[0])
+                logs = {"loss": vals[0]}
+                for m in self._metrics:
+                    logs[m.name()] = m.accumulate()
+                # every batch: non-logging callbacks (LRScheduler by_step,
+                # EarlyStopping...) rely on this; ProgBarLogger applies its
+                # own log_freq gate
+                cbks.on_train_batch_end(step, logs)
+            epoch_logs = {"loss": float(np.mean(losses)) if losses
+                          else float("nan")}
+            for m in self._metrics:
+                epoch_logs[m.name()] = m.accumulate()
+            history["loss"].append(epoch_logs["loss"])
+            for m in self._metrics:
+                history.setdefault(m.name(), []).append(m.accumulate())
+            cbks.on_epoch_end(epoch, epoch_logs)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_data, batch_size=batch_size,
+                                          verbose=0)
+                cbks.on_eval_end(eval_logs)
+            if self.stop_training:
+                break
+        cbks.on_train_end()
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, **kwargs):
+        loader = self._loader(eval_data, batch_size, shuffle=False)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for step, batch in enumerate(loader):
+            inputs, labels = _split_batch(batch)
+            vals = self.eval_batch(inputs, labels)
+            if vals[0] is not None:
+                losses.append(vals[0])
+        logs = {"loss": float(np.mean(losses)) if losses else None}
+        for m in self._metrics:
+            logs[m.name()] = m.accumulate()
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=1, **kwargs):
+        loader = self._loader(test_data, batch_size, shuffle=False)
+        outs = []
+        for batch in loader:
+            inputs, _ = _split_batch(batch, allow_no_label=True)
+            outs.append(self.predict_batch(inputs))
+        if stack_outputs and outs:
+            outs = [np.concatenate(outs, axis=0)]
+        return outs
+
+    # ---------------------------------------------------------- save/load
+    def save(self, path, training=True):
+        """training=True → .pdparams/.pdopt checkpoint; False → jit.save
+        inference artifact (reference Model.save semantics)."""
+        if training:
+            from ..framework_io import save as fsave
+            fsave(self.network.state_dict(), path + ".pdparams")
+            if self._optimizer is not None and hasattr(self._optimizer,
+                                                       "state_dict"):
+                fsave(self._optimizer.state_dict(), path + ".pdopt")
+        else:
+            from .. import jit as pjit
+            spec = self._inputs
+            pjit.save(self.network, path, input_spec=spec)
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework_io import load as fload
+        self.network.set_state_dict(fload(path + ".pdparams"))
+        import os
+        if (not reset_optimizer and self._optimizer is not None
+                and os.path.exists(path + ".pdopt")
+                and hasattr(self._optimizer, "set_state_dict")):
+            self._optimizer.set_state_dict(fload(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        from .model_summary import summary
+        return summary(self.network, input_size, dtypes=dtype)
+
+
+def _to_tensors(x):
+    if x is None or isinstance(x, Tensor):
+        return x
+    if isinstance(x, (list, tuple)):
+        return [_to_tensors(v) for v in x]
+    return to_tensor(np.asarray(x))
+
+
+def _split_batch(batch, allow_no_label=False):
+    if isinstance(batch, (list, tuple)):
+        if len(batch) == 2:
+            return batch[0], batch[1]
+        if len(batch) == 1:
+            return batch[0], None
+        # (input..., label) convention: last element is the label
+        return list(batch[:-1]), batch[-1]
+    return batch, None

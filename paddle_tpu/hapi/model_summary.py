@@ -1,0 +1,81 @@
+"""Model summary table (reference python/paddle/hapi/model_summary.py).
+
+Walks the layer tree with forward hooks to record output shapes and
+parameter counts, prints the familiar table, and returns
+{'total_params', 'trainable_params'}.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Reference: paddle.summary(net, input_size) — run a forward on zeros
+    of `input_size` (or the given `input`) recording per-layer output
+    shapes + param counts."""
+    rows = []
+    hooks = []
+
+    def register(layer, prefix):
+        def hook(l, inp, out):
+            n_params = sum(int(np.prod(p.shape)) for p in
+                           l.parameters(include_sublayers=False))
+            shape = list(out.shape) if isinstance(out, Tensor) else (
+                [list(o.shape) for o in out
+                 if isinstance(o, Tensor)] if isinstance(out, (list, tuple))
+                else None)
+            rows.append((prefix or type(l).__name__, type(l).__name__,
+                         shape, n_params))
+        hooks.append(layer.register_forward_post_hook(hook))
+
+    for name, sub in net.named_sublayers(include_self=False):
+        register(sub, name)
+    if not hooks:
+        register(net, type(net).__name__)
+
+    try:
+        if input is not None:
+            x = input
+        else:
+            if input_size is None:
+                raise ValueError("summary needs input_size or input")
+            sizes = input_size if isinstance(input_size, (list, tuple)) and \
+                isinstance(input_size[0], (list, tuple)) else [input_size]
+            dts = dtypes if isinstance(dtypes, (list, tuple)) else \
+                [dtypes] * len(sizes)
+            x = [Tensor(jnp.zeros([d if d and d > 0 else 1 for d in s],
+                                  np.dtype(dt) if dt else np.float32))
+                 for s, dt in zip(sizes, dts)]
+            x = x[0] if len(x) == 1 else x
+        net.eval()
+        if isinstance(x, list):
+            net(*x)
+        else:
+            net(x)
+    finally:
+        for h in hooks:
+            h.remove()
+
+    total = sum(int(np.prod(p.shape)) for p in net.parameters())
+    trainable = sum(int(np.prod(p.shape)) for p in net.parameters()
+                    if not p.stop_gradient)
+
+    width = 72
+    lines = ["-" * width,
+             f"{'Layer (type)':<34}{'Output Shape':<24}{'Param #':>12}",
+             "=" * width]
+    for name, tname, shape, n in rows:
+        lines.append(f"{name + ' (' + tname + ')':<34}"
+                     f"{str(shape):<24}{n:>12,}")
+    lines += ["=" * width,
+              f"Total params: {total:,}",
+              f"Trainable params: {trainable:,}",
+              f"Non-trainable params: {total - trainable:,}",
+              "-" * width]
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}

@@ -1,0 +1,25 @@
+"""paddle_tpu.incubate (reference python/paddle/incubate/ — experimental
+APIs that graduated into the core here; this namespace re-exports them at
+the reference's import paths)."""
+from . import autograd  # noqa: F401
+from . import nn  # noqa: F401
+
+
+def _softmax_mask(x, mask):
+    import jax
+    return jax.nn.softmax(x + mask, axis=-1)
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """Reference incubate/operators/softmax_mask_fuse.py — one op here;
+    XLA fuses the mask+softmax chain natively."""
+    from ..framework.dispatch import apply
+    return apply("softmax_mask_fuse", _softmax_mask, x, mask)
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """Reference incubate graph message passing (moved to geometric)."""
+    from ..geometric import send_u_recv
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)

@@ -1,0 +1,7 @@
+"""incubate.autograd (reference python/paddle/incubate/autograd/functional.py
+vjp/jvp/Jacobian/Hessian — graduated: re-export of paddle_tpu.autograd)."""
+from ..autograd.functional import (  # noqa: F401
+    vjp, jvp, jacobian, hessian)
+
+Jacobian = jacobian
+Hessian = hessian

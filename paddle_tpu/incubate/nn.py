@@ -1,0 +1,10 @@
+"""incubate.nn (reference python/paddle/incubate/nn/ — fused transformer
+layers + memory-efficient attention; here they live in the core nn/kernels,
+re-exported at the reference paths)."""
+from ..nn.layers.transformer import (  # noqa: F401
+    TransformerEncoderLayer as FusedTransformerEncoderLayer,
+    MultiHeadAttention as FusedMultiHeadAttention)
+from ..kernels.flash_attention import (  # noqa: F401
+    flash_attention as memory_efficient_attention)
+
+from ..parallel.moe import MoELayer  # noqa: F401

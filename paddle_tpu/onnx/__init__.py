@@ -1,0 +1,13 @@
+"""paddle_tpu.onnx (reference python/paddle/onnx/export.py — a thin shim
+over the EXTERNAL paddle2onnx package; the reference itself cannot export
+without it). Here the portable interchange artifact is StableHLO via
+paddle_tpu.jit.save — ONNX export is descoped with this honest error."""
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    raise NotImplementedError(
+        "ONNX export relied on the external paddle2onnx package in the "
+        "reference and is descoped here. Use paddle_tpu.jit.save(layer, "
+        "path, input_spec=...) — the StableHLO artifact is this "
+        "framework's portable serialized-model format (loadable by "
+        "paddle_tpu.jit.load and paddle_tpu.inference.Predictor).")

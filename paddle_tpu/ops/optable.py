@@ -125,6 +125,13 @@ ALIASES: Dict[str, str] = {
     "depthwise_conv2d_transpose": "nn.functional:conv2d_transpose",
     "rnn": "nn.layers.rnn:RNN",
     "warpctc": "op:ctc_loss_op",
+    "nms": "vision.ops:nms",
+    "roi_align": "vision.ops:roi_align",
+    "send_u_recv": "geometric:send_u_recv",
+    "send_ue_recv": "geometric:send_ue_recv",
+    "send_uv": "geometric:send_uv",
+    "segment_pool": "geometric:segment_sum",
+    "viterbi_decode": "text:viterbi_decode",
     "assign_out_": "ops.creation:assign",
     "assign_value_": "ops.creation:assign",
 }
@@ -148,16 +155,12 @@ DESCOPED: Dict[str, str] = {
                        "in v1; revisit with a Pallas gather kernel",
     "decode_jpeg": "host-side image IO (nvjpeg) — feed decoded arrays; "
                    "DataLoader does host decode",
-    "rrelu": "train-time randomized ReLU — nn.functional rrelu exists as "
-             "registered op (rrelu); row kept for the in-place variant",
-    # graph / geometric (reference python/paddle/geometric)
-    "reindex_graph": "graph-sampling support op — geometric pack descoped "
-                     "in v1 (segment ops cover message passing)",
-    "send_u_recv": "graph message passing — descoped with geometric pack",
-    "send_ue_recv": "graph message passing — descoped with geometric pack",
-    "send_uv": "graph message passing — descoped with geometric pack",
-    "weighted_sample_neighbors": "graph sampler — descoped with geometric",
-    "segment_pool": "graph segment pool — descoped with geometric pack",
+    # graph / geometric (message passing IS implemented — geometric/)
+    "reindex_graph": "graph-sampling support op (dynamic output shapes — "
+                     "hostile to TPU static shapes); send_u_recv/segment "
+                     "ops cover message passing",
+    "weighted_sample_neighbors": "host-side graph sampler — same "
+                                 "dynamic-shape descope as reindex_graph",
     # sparse / selected-rows runtime
     "merge_selected_rows": "SelectedRows is a CPU/PS embedding-gradient "
                            "format; XLA grads are dense",
@@ -180,7 +183,6 @@ DESCOPED: Dict[str, str] = {
     "margin_cross_entropy": "hybrid-parallel face-rec loss — same descope",
     # audio/text decoding externals
     "warprnnt": "external warp-rnnt CUDA lib; ctc_loss is the covered path",
-    "viterbi_decode": "CRF decode util — text pack v2",
     "edit_distance": "metric util — text pack v2",
     # misc legacy
     "full_batch_size_like": "fluid-era shape-inference helper — static "

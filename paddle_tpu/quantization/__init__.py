@@ -1,0 +1,190 @@
+"""paddle_tpu.quantization — PTQ observers + QAT fake-quant.
+
+Reference analog: python/paddle/quantization/ (QuantConfig config.py,
+`PTQ`/`QAT` drivers ptq.py/qat.py, observer/quanter factories, quanted
+layer wrappers) over the slim quant passes.
+
+TPU-native scope: the TPU int8 story is *simulated* quantization in the
+compiled graph — fake-quant (quantize→dequantize) ops around weights and
+activations, which XLA folds into the surrounding fusions. PTQ = run
+calibration batches through observers → freeze scales; QAT = train with
+fake-quant in the graph (straight-through estimator on the rounding).
+Conversion to a true int8 serving graph is the deploy step and stays out
+of scope (the reference also delegates that to Paddle-Lite/Inference).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..framework.dispatch import defop
+from ..nn.layer import Layer
+
+__all__ = ["QuantConfig", "AbsmaxObserver", "MovingAverageObserver",
+           "FakeQuant", "QuantedLinear", "PTQ", "QAT",
+           "quant_dequant", "QAT_READY_LAYERS"]
+
+
+@defop("fake_quant_dequant")
+def _fake_qdq(x, scale, bits):
+    """Symmetric fake quant-dequant with straight-through gradient: the
+    rounding is wrapped in stop_gradient(round(x)-x)+x so backward sees
+    identity inside the clip range."""
+    qmax = 2.0 ** (bits - 1) - 1
+    s = jnp.maximum(scale, 1e-8)
+    scaled = jnp.clip(x / s * qmax, -qmax, qmax)
+    rounded = scaled + jax.lax.stop_gradient(jnp.round(scaled) - scaled)
+    return rounded * s / qmax
+
+
+def quant_dequant(x, scale, bits=8):
+    """Functional fake-quant (reference quanters/abs_max.py forward)."""
+    if isinstance(scale, Tensor):
+        scale = float(scale.numpy())
+    return _fake_qdq(x, float(scale), int(bits))
+
+
+class AbsmaxObserver:
+    """Calibration observer: running abs-max (reference
+    observers/abs_max.py)."""
+
+    def __init__(self, quant_bits=8):
+        self.quant_bits = quant_bits
+        self._max = 0.0
+
+    def observe(self, x):
+        v = x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+        self._max = max(self._max, float(np.abs(v).max()))
+
+    def scale(self) -> float:
+        return self._max if self._max > 0 else 1.0
+
+
+class MovingAverageObserver:
+    """EMA abs-max observer (reference observers/emd style)."""
+
+    def __init__(self, quant_bits=8, momentum=0.9):
+        self.quant_bits = quant_bits
+        self.momentum = momentum
+        self._max: Optional[float] = None
+
+    def observe(self, x):
+        v = x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+        m = float(np.abs(v).max())
+        self._max = m if self._max is None else \
+            self.momentum * self._max + (1 - self.momentum) * m
+
+    def scale(self) -> float:
+        return self._max if self._max else 1.0
+
+
+class QuantConfig:
+    """Which layers get quantized, with which observer/quanter
+    (reference config.py QuantConfig add_type_config/add_layer_config)."""
+
+    def __init__(self, activation=None, weight=None, quant_bits=8):
+        self.activation_factory = activation or AbsmaxObserver
+        self.weight_factory = weight or AbsmaxObserver
+        self.quant_bits = quant_bits
+        self._types: List[type] = []
+
+    def add_type_config(self, layer_types, activation=None, weight=None):
+        ts = layer_types if isinstance(layer_types, (list, tuple)) \
+            else [layer_types]
+        self._types.extend(ts)
+        if activation:
+            self.activation_factory = activation
+        if weight:
+            self.weight_factory = weight
+        return self
+
+    def matches(self, layer) -> bool:
+        from ..nn.layers.common import Linear
+        types = self._types or [Linear]
+        return isinstance(layer, tuple(types))
+
+
+class FakeQuant(Layer):
+    """QAT fake-quant node with a learned-by-observation scale."""
+
+    def __init__(self, quant_bits=8, momentum=0.9):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.observer = MovingAverageObserver(quant_bits, momentum)
+
+    def forward(self, x):
+        if self.training:
+            self.observer.observe(x)
+        return quant_dequant(x, self.observer.scale(), self.quant_bits)
+
+
+class QuantedLinear(Layer):
+    """Linear with fake-quant on input activation + weight (reference
+    nn/quant_layers QuantedLinear)."""
+
+    def __init__(self, linear, config: QuantConfig):
+        super().__init__()
+        self.linear = linear
+        self.act_quant = FakeQuant(config.quant_bits)
+        self.w_observer = config.weight_factory(config.quant_bits)
+        self.quant_bits = config.quant_bits
+
+    def forward(self, x):
+        x = self.act_quant(x)
+        self.w_observer.observe(self.linear.weight)
+        w = quant_dequant(self.linear.weight, self.w_observer.scale(),
+                          self.quant_bits)
+        from ..nn import functional as F
+        return F.linear(x, w, self.linear.bias)
+
+
+QAT_READY_LAYERS = ["Linear"]
+
+
+def _swap_layers(model: Layer, config: QuantConfig):
+    replaced = 0
+    for name, child in list(model.named_children()):
+        if config.matches(child):
+            setattr(model, name, QuantedLinear(child, config))
+            replaced += 1
+        else:
+            replaced += _swap_layers(child, config)
+    return replaced
+
+
+class QAT:
+    """Quantization-aware training driver (reference qat.py QAT):
+    `quantize(model)` swaps matching layers for fake-quant wrappers;
+    train as usual; scales track activations."""
+
+    def __init__(self, config: Optional[QuantConfig] = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model: Layer, inplace=True) -> Layer:
+        n = _swap_layers(model, self.config)
+        if n == 0:
+            raise ValueError("QAT.quantize found no layers matching the "
+                             "QuantConfig")
+        return model
+
+
+class PTQ:
+    """Post-training quantization driver (reference ptq.py PTQ):
+    `quantize(model)` inserts observers, run calibration data through the
+    model, then `convert(model)` freezes scales into fake-quant."""
+
+    def __init__(self, config: Optional[QuantConfig] = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model: Layer, inplace=True) -> Layer:
+        _swap_layers(model, self.config)
+        model.train()          # observers update during calibration
+        return model
+
+    def convert(self, model: Layer, inplace=True) -> Layer:
+        model.eval()           # freeze: observers stop updating
+        return model

@@ -1,0 +1,186 @@
+"""paddle_tpu.sparse — COO/CSR sparse tensors.
+
+Reference analog: python/paddle/sparse/ over paddle/phi/kernels/sparse/
+(SparseCooTensor/SparseCsrTensor + sparse ops + sparse_ops.yaml, 39 ops).
+
+TPU-native scope note: XLA has no native sparse storage — TPU "sparsity"
+is dense masking or gather/segment kernels. This module keeps the
+reference's COO/CSR construction/conversion surface and the ops whose
+gather/scatter lowering is genuinely TPU-viable (elementwise on values,
+masked matmul via segment_sum); the full 39-op sparse kernel zoo stays
+descoped per OPS_COVERAGE.md.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor, to_tensor
+
+__all__ = ["SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+           "sparse_csr_tensor", "is_sparse_coo", "is_sparse_csr",
+           "add", "multiply", "matmul", "relu", "to_dense"]
+
+
+class SparseCooTensor:
+    """COO: indices [sparse_ndim, nnz] + values [nnz, ...dense_dims]."""
+
+    def __init__(self, indices, values, shape):
+        self.indices_ = jnp.asarray(
+            indices._value if isinstance(indices, Tensor) else indices,
+            jnp.int32)
+        self.values_ = (values._value if isinstance(values, Tensor)
+                        else jnp.asarray(values))
+        self.shape = list(int(s) for s in shape)
+
+    def indices(self):
+        return Tensor(self.indices_)
+
+    def values(self):
+        return Tensor(self.values_)
+
+    def nnz(self) -> int:
+        return int(self.indices_.shape[1])
+
+    @property
+    def dtype(self):
+        return np.dtype(self.values_.dtype)
+
+    def to_dense(self) -> Tensor:
+        out = jnp.zeros(self.shape, self.values_.dtype)
+        idx = tuple(self.indices_[i] for i in range(self.indices_.shape[0]))
+        return Tensor(out.at[idx].add(self.values_))
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        if len(self.shape) != 2:
+            raise ValueError("to_sparse_csr supports 2-D tensors")
+        order = jnp.lexsort((self.indices_[1], self.indices_[0]))
+        rows = self.indices_[0][order]
+        cols = self.indices_[1][order]
+        vals = self.values_[order]
+        crows = jnp.concatenate([
+            jnp.zeros((1,), jnp.int32),
+            jnp.cumsum(jnp.bincount(rows, length=self.shape[0])
+                       .astype(jnp.int32))])
+        return SparseCsrTensor(crows, cols, vals, self.shape)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor:
+    """CSR: crows [nrows+1], cols [nnz], values [nnz]."""
+
+    def __init__(self, crows, cols, values, shape):
+        self.crows_ = jnp.asarray(
+            crows._value if isinstance(crows, Tensor) else crows, jnp.int32)
+        self.cols_ = jnp.asarray(
+            cols._value if isinstance(cols, Tensor) else cols, jnp.int32)
+        self.values_ = (values._value if isinstance(values, Tensor)
+                        else jnp.asarray(values))
+        self.shape = list(int(s) for s in shape)
+
+    def crows(self):
+        return Tensor(self.crows_)
+
+    def cols(self):
+        return Tensor(self.cols_)
+
+    def values(self):
+        return Tensor(self.values_)
+
+    def nnz(self) -> int:
+        return int(self.cols_.shape[0])
+
+    def _row_indices(self):
+        counts = self.crows_[1:] - self.crows_[:-1]
+        return jnp.repeat(jnp.arange(self.shape[0], dtype=jnp.int32),
+                          counts, total_repeat_length=self.nnz())
+
+    def to_dense(self) -> Tensor:
+        rows = self._row_indices()
+        out = jnp.zeros(self.shape, self.values_.dtype)
+        return Tensor(out.at[rows, self.cols_].add(self.values_))
+
+    def to_sparse_coo(self, sparse_dim=2) -> SparseCooTensor:
+        rows = self._row_indices()
+        return SparseCooTensor(jnp.stack([rows, self.cols_]),
+                               self.values_, self.shape)
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz()})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    """Reference: paddle.sparse.sparse_coo_tensor."""
+    idx = np.asarray(indices.numpy() if isinstance(indices, Tensor)
+                     else indices)
+    if shape is None:
+        shape = list(idx.max(axis=1) + 1)
+    return SparseCooTensor(idx, values, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True):
+    return SparseCsrTensor(crows, cols, values, shape)
+
+
+def is_sparse_coo(x) -> bool:
+    return isinstance(x, SparseCooTensor)
+
+
+def is_sparse_csr(x) -> bool:
+    return isinstance(x, SparseCsrTensor)
+
+
+def to_dense(x):
+    return x.to_dense() if isinstance(x, (SparseCooTensor,
+                                          SparseCsrTensor)) else x
+
+
+def _coerce_coo(x) -> SparseCooTensor:
+    if isinstance(x, SparseCsrTensor):
+        return x.to_sparse_coo()
+    return x
+
+
+def add(x, y):
+    """sparse + sparse/dense (reference sparse/binary.py add)."""
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        return Tensor(_coerce_coo(x).to_dense()._value
+                      + _coerce_coo(y).to_dense()._value)
+    yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+    return Tensor(_coerce_coo(x).to_dense()._value + yv)
+
+
+def multiply(x, y):
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        y = _coerce_coo(y).to_dense()
+    yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+    return Tensor(_coerce_coo(x).to_dense()._value * yv)
+
+
+def matmul(x, y):
+    """sparse @ dense via gather + segment-sum (the TPU-viable lowering —
+    no dense materialization of x)."""
+    coo = _coerce_coo(x)
+    if len(coo.shape) != 2:
+        raise ValueError("sparse.matmul supports 2-D sparse lhs")
+    yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+    rows, cols = coo.indices_[0], coo.indices_[1]
+    contrib = coo.values_[:, None] * jnp.take(yv, cols, axis=0)
+    out = jax.ops.segment_sum(contrib, rows, num_segments=coo.shape[0])
+    return Tensor(out)
+
+
+def relu(x):
+    """Elementwise on values only — structure preserved (reference
+    sparse/unary.py relu)."""
+    coo = _coerce_coo(x)
+    return SparseCooTensor(coo.indices_, jnp.maximum(coo.values_, 0),
+                           coo.shape)

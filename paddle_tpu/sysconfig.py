@@ -1,0 +1,12 @@
+"""paddle_tpu.sysconfig (reference python/paddle/sysconfig.py:
+get_include/get_lib for building extensions against the framework)."""
+import os
+
+
+def get_include() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "include")
+
+
+def get_lib() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "libs")

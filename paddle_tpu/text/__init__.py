@@ -1,0 +1,91 @@
+"""paddle_tpu.text — text utilities.
+
+Reference analog: python/paddle/text/ (dataset downloaders for Conll05,
+Imdb, Imikolov, Movielens, UCIHousing, WMT14/16) plus the text decoding
+ops (viterbi_decode in paddle.text.viterbi_decode / ops). The reference
+datasets are thin downloaders over external corpora — no egress here, so
+`datasets` raises a pointed error; the compute pieces (viterbi decode for
+CRF models) are real.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.dispatch import apply
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets"]
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True, name=None):
+    """CRF Viterbi decode (reference legacy op `viterbi_decode`,
+    text/viterbi_decode.py): potentials [B, T, N], transitions [N, N]
+    → (scores [B], paths [B, T]). lax.scan forward pass + backtrace."""
+    def _viterbi(pot, trans, lens):
+        B, T, N = pot.shape
+
+        def fwd(carry, inp):
+            alpha = carry
+            emit, t = inp                                 # [B, N], scalar
+            scores = alpha[:, :, None] + trans[None]      # B, N, N
+            best = jnp.max(scores, axis=1) + emit
+            back = jnp.argmax(scores, axis=1)             # B, N
+            if lens is not None:
+                # frozen past each sequence's end: alpha keeps its final
+                # value and backtrace passes through (identity pointers)
+                active = (t < lens)[:, None]              # [B, 1]
+                best = jnp.where(active, best, alpha)
+                back = jnp.where(active, back,
+                                 jnp.arange(N)[None, :])
+            return best, back
+
+        alpha0 = pot[:, 0]
+        alpha, backs = jax.lax.scan(
+            fwd, alpha0,
+            (jnp.moveaxis(pot[:, 1:], 1, 0), jnp.arange(1, T)))
+        last = jnp.argmax(alpha, axis=-1)                 # [B]
+        score = jnp.max(alpha, axis=-1)
+
+        # walk backs in reverse: carry = tag at t+1, output = tag at t
+        def backtrace(tok, back):
+            prev = jnp.take_along_axis(back, tok[:, None], axis=1)[:, 0]
+            return prev, prev
+
+        _, prefix = jax.lax.scan(backtrace, last, backs, reverse=True)
+        paths = jnp.concatenate(
+            [jnp.moveaxis(prefix, 0, 1), last[:, None]], axis=1)  # [B, T]
+        return score, paths.astype(jnp.int64)
+
+    if lengths is None:
+        def _vit_full(pot, trans):
+            return _viterbi(pot, trans, None)
+        return apply("viterbi_decode", _vit_full, potentials,
+                     transition_params)
+    return apply("viterbi_decode_len", _viterbi, potentials,
+                 transition_params, lengths)
+
+
+class ViterbiDecoder:
+    """Layer-shaped wrapper (reference text.ViterbiDecoder)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+class _DatasetsStub:
+    _MSG = ("paddle_tpu.text.datasets ({name}) are thin downloaders over "
+            "external corpora in the reference; this environment has no "
+            "network egress. Load your corpus with numpy/paddle_tpu.io."
+            "Dataset instead.")
+
+    def __getattr__(self, name):
+        raise NotImplementedError(self._MSG.format(name=name))
+
+
+datasets = _DatasetsStub()

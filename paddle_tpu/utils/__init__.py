@@ -1,0 +1,66 @@
+"""paddle_tpu.utils (reference python/paddle/utils: try_import, deprecated,
+unique_name, run_check, dlpack bridge)."""
+from __future__ import annotations
+
+import functools
+import importlib
+import itertools
+import warnings
+
+from . import unique_name  # noqa: F401
+
+
+def try_import(module_name: str, err_msg: str = None):
+    """Reference utils/lazy_import.py try_import."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(err_msg or f"{module_name} is required but not "
+                          f"installed") from e
+
+
+def deprecated(update_to: str = "", since: str = "", reason: str = "",
+               level: int = 0):
+    """Reference utils/deprecated.py decorator."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            msg = (f"API '{fn.__module__}.{fn.__name__}' is deprecated "
+                   f"since {since or 'an earlier release'}")
+            if update_to:
+                msg += f", use '{update_to}' instead"
+            if reason:
+                msg += f". Reason: {reason}"
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        return wrapper
+    return deco
+
+
+def run_check():
+    """Reference utils/install_check.py run_check: compile + run a tiny
+    computation on the default backend and report."""
+    import jax
+    import jax.numpy as jnp
+    x = jnp.ones((4, 4))
+    y = jax.jit(lambda a: (a @ a).sum())(x)
+    dev = jax.devices()[0]
+    print(f"paddle_tpu is installed successfully! backend="
+          f"{dev.platform} ({dev.device_kind}), check value "
+          f"{float(y):.1f} == 16.0")
+    return True
+
+
+def to_dlpack(tensor):
+    """DLPack export (reference utils/dlpack.py). jax arrays implement the
+    __dlpack__ protocol directly (the legacy to_dlpack capsule API was
+    removed), so the array itself IS the dlpack-exportable object."""
+    from ..framework.tensor import Tensor
+    v = tensor._value if isinstance(tensor, Tensor) else tensor
+    return v
+
+
+def from_dlpack(capsule):
+    import jax
+    from ..framework.tensor import Tensor
+    return Tensor(jax.dlpack.from_dlpack(capsule))

@@ -1,0 +1,36 @@
+"""Unique name generator (reference python/paddle/utils/unique_name.py —
+the fluid name dedup used by Layer/param naming)."""
+from __future__ import annotations
+
+import contextlib
+from collections import defaultdict
+
+_counters = defaultdict(int)
+
+
+def generate(key: str) -> str:
+    _counters[key] += 1
+    return f"{key}_{_counters[key] - 1}"
+
+
+def generate_with_ignorable_key(key: str) -> str:
+    return generate(key)
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    """Fresh counter scope (reference unique_name.guard)."""
+    global _counters
+    old = _counters
+    _counters = defaultdict(int)
+    try:
+        yield
+    finally:
+        _counters = old
+
+
+def switch(new_generator=None):
+    global _counters
+    old = _counters
+    _counters = defaultdict(int)
+    return old

@@ -1,0 +1,20 @@
+"""paddle_tpu.vision — model zoo, transforms, datasets, vision ops.
+
+Reference analog: python/paddle/vision/ (models/resnet.py:195 et al.,
+transforms/, datasets/, ops.py). BASELINE config 4's ResNet-50 path lives
+here.
+"""
+from . import models  # noqa: F401
+from . import transforms  # noqa: F401
+from . import datasets  # noqa: F401
+from . import ops  # noqa: F401
+from .ops import nms, roi_align  # noqa: F401
+
+
+def set_image_backend(backend):
+    if backend not in ("pil", "cv2", "numpy"):
+        raise ValueError(f"unknown image backend {backend!r}")
+
+
+def get_image_backend():
+    return "numpy"

@@ -1,0 +1,121 @@
+"""Vision datasets (reference python/paddle/vision/datasets/ — MNIST,
+Cifar10 etc. download external archives; no egress here, so the classes
+read LOCAL files in the original formats, and FakeData provides the
+synthetic path the benches use)."""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "FakeData"]
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic image classification data (the bench/test
+    fixture — reference tests use the same trick via numpy fixtures)."""
+
+    def __init__(self, num_samples=1024, image_shape=(3, 224, 224),
+                 num_classes=1000, transform=None, seed=0):
+        self.num_samples = num_samples
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self._rng = np.random.RandomState(seed)
+        self._images = self._rng.randn(
+            min(num_samples, 64), *self.image_shape).astype(np.float32)
+        self._labels = self._rng.randint(
+            0, num_classes, num_samples).astype(np.int64)
+
+    def __len__(self):
+        return self.num_samples
+
+    def __getitem__(self, idx):
+        img = self._images[idx % len(self._images)]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self._labels[idx]
+
+
+class MNIST(Dataset):
+    """Reads the original IDX files from `image_path`/`label_path`
+    (reference datasets/mnist.py minus the downloader)."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        if download and (image_path is None or label_path is None):
+            raise NotImplementedError(
+                "MNIST download needs network egress; pass image_path/"
+                "label_path to local IDX files (train-images-idx3-ubyte.gz"
+                " / train-labels-idx1-ubyte.gz)")
+        self.transform = transform
+        self.images, self.labels = self._load(image_path, label_path)
+
+    @staticmethod
+    def _load(image_path, label_path):
+        opener = gzip.open if str(image_path).endswith(".gz") else open
+        with opener(image_path, "rb") as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            images = np.frombuffer(f.read(), np.uint8).reshape(
+                n, rows, cols)
+        opener = gzip.open if str(label_path).endswith(".gz") else open
+        with opener(label_path, "rb") as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            labels = np.frombuffer(f.read(), np.uint8).astype(np.int64)
+        return images, labels
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32)[None] / 255.0
+        return img, self.labels[idx]
+
+
+class FashionMNIST(MNIST):
+    """Same IDX format as MNIST (reference datasets/fashion_mnist)."""
+
+
+class Cifar10(Dataset):
+    """Reads the original python-pickle batches from a local
+    cifar-10-python.tar.gz (reference datasets/cifar.py minus the
+    downloader)."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        if download and data_file is None:
+            raise NotImplementedError(
+                "Cifar10 download needs network egress; pass data_file "
+                "pointing at a local cifar-10-python.tar.gz")
+        self.transform = transform
+        names = [f"data_batch_{i}" for i in range(1, 6)] \
+            if mode == "train" else ["test_batch"]
+        xs, ys = [], []
+        with tarfile.open(data_file, "r:gz") as tf:
+            for m in tf.getmembers():
+                base = os.path.basename(m.name)
+                if base in names:
+                    d = pickle.load(tf.extractfile(m), encoding="bytes")
+                    xs.append(np.asarray(d[b"data"]))
+                    ys.extend(d[b"labels"])
+        self.images = np.concatenate(xs).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(ys, np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32) / 255.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]

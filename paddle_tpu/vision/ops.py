@@ -1,0 +1,156 @@
+"""Vision ops: nms, roi_align (reference python/paddle/vision/ops.py over
+phi nms/roi_align kernels — the two vision ops the op-coverage ledger
+tracks; the wider detection zoo is descoped there with reasons).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.dispatch import apply
+from ..framework.tensor import Tensor, to_tensor
+
+__all__ = ["nms", "roi_align", "box_iou"]
+
+
+def box_iou(boxes1, boxes2):
+    """IoU matrix [N, M] for [x1,y1,x2,y2] boxes."""
+    def _iou(b1, b2):
+        area1 = (b1[:, 2] - b1[:, 0]) * (b1[:, 3] - b1[:, 1])
+        area2 = (b2[:, 2] - b2[:, 0]) * (b2[:, 3] - b2[:, 1])
+        lt = jnp.maximum(b1[:, None, :2], b2[None, :, :2])
+        rb = jnp.minimum(b1[:, None, 2:], b2[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / jnp.maximum(area1[:, None] + area2[None] - inter,
+                                   1e-10)
+    return apply("box_iou", _iou, boxes1, boxes2)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Hard NMS (reference vision/ops.py:nms / phi nms_kernel). Returns
+    kept indices sorted by descending score. TPU-shaped: a fixed-length
+    lax.fori_loop over the score-sorted suppression mask (static shapes),
+    with the final variable-length index extraction on host."""
+    n = boxes.shape[0]
+    bv = boxes._value if isinstance(boxes, Tensor) else jnp.asarray(boxes)
+    if scores is None:
+        order = jnp.arange(n)
+        sv = None
+    else:
+        sv = scores._value if isinstance(scores, Tensor) \
+            else jnp.asarray(scores)
+        order = jnp.argsort(-sv)
+
+    if category_idxs is not None:
+        # per-category NMS: offset boxes per category so categories never
+        # overlap (the standard batched-NMS trick)
+        cv = (category_idxs._value if isinstance(category_idxs, Tensor)
+              else jnp.asarray(category_idxs)).astype(bv.dtype)
+        span = jnp.max(bv) - jnp.min(bv) + 1.0
+        bv = bv + (cv * span)[:, None]
+
+    keep = np.asarray(_nms_suppress(bv, order, float(iou_threshold)))
+    kept = np.asarray(order)[keep]
+    if top_k is not None:
+        kept = kept[:top_k]
+    return to_tensor(kept.astype(np.int64))
+
+
+import functools
+
+
+@functools.partial(jax.jit, static_argnames=("iou_threshold",))
+def _nms_suppress(bv, order, iou_threshold):
+    """Module-level jitted suppression loop: compiles once per (shape,
+    threshold), not per nms() call."""
+    n = bv.shape[0]
+    b = bv[order]
+    iou = _pairwise_iou(b)
+
+    def body(i, keep):
+        # suppress j>i overlapping with kept i
+        sup = (iou[i] > iou_threshold) & (jnp.arange(n) > i) & keep[i]
+        return keep & ~sup
+    return jax.lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+
+
+def _pairwise_iou(b):
+    area = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    lt = jnp.maximum(b[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(b[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / jnp.maximum(area[:, None] + area[None] - inter, 1e-10)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """ROI Align (reference vision/ops.py:roi_align / phi
+    roi_align_kernel): x [N,C,H,W], boxes [R,4] (x1,y1,x2,y2),
+    boxes_num [N] rois per image → [R, C, out_h, out_w].
+    Bilinear-sampled grid per ROI — gathers + lerp, one fused XLA kernel."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    out_h, out_w = output_size
+
+    bn = (boxes_num.numpy() if isinstance(boxes_num, Tensor)
+          else np.asarray(boxes_num)).astype(np.int64)
+    batch_idx = np.repeat(np.arange(len(bn)), bn)
+
+    def _roi(x, boxes, bidx, out_h, out_w, scale, ratio, aligned):
+        R = boxes.shape[0]
+        N, C, H, W = x.shape
+        off = 0.5 if aligned else 0.0
+        x1 = boxes[:, 0] * scale - off
+        y1 = boxes[:, 1] * scale - off
+        x2 = boxes[:, 2] * scale - off
+        y2 = boxes[:, 3] * scale - off
+        rw = x2 - x1
+        rh = y2 - y1
+        if not aligned:
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        sr_h = ratio if ratio > 0 else 2
+        sr_w = ratio if ratio > 0 else 2
+        # sample points: [R, out_h*sr_h] y coords, [R, out_w*sr_w] x
+        ys = (y1[:, None] + rh[:, None]
+              * (jnp.arange(out_h * sr_h) + 0.5) / (out_h * sr_h))
+        xs = (x1[:, None] + rw[:, None]
+              * (jnp.arange(out_w * sr_w) + 0.5) / (out_w * sr_w))
+
+        # bilinear sample one image at a [Sy, Sx] coordinate grid → [Sy,Sx,C]
+        def bilinear(img, yy, xx):
+            y0 = jnp.clip(jnp.floor(yy), 0, H - 1)
+            x0 = jnp.clip(jnp.floor(xx), 0, W - 1)
+            y1_ = jnp.clip(y0 + 1, 0, H - 1)
+            x1_ = jnp.clip(x0 + 1, 0, W - 1)
+            wy1 = jnp.clip(yy - y0, 0, 1)
+            wx1 = jnp.clip(xx - x0, 0, 1)
+            out = 0.0
+            for iy, wy in ((y0, 1 - wy1), (y1_, wy1)):
+                for ix, wx in ((x0, 1 - wx1), (x1_, wx1)):
+                    v = img[iy.astype(jnp.int32), ix.astype(jnp.int32)]
+                    out = out + v * (wy * wx)[:, :, None]
+            return out
+
+        imgs = jnp.moveaxis(x, 1, -1)[bidx]          # [R, H, W, C]
+
+        # vectorize over ROIs
+        def sample_one(img, yy, xx):
+            # yy [Sy], xx [Sx] -> grid [Sy, Sx, C]
+            yg = jnp.broadcast_to(yy[:, None], (yy.shape[0], xx.shape[0]))
+            xg = jnp.broadcast_to(xx[None, :], (yy.shape[0], xx.shape[0]))
+            return bilinear(img, yg, xg)
+
+        grids = jax.vmap(sample_one)(imgs, ys, xs)   # [R, Sy, Sx, C]
+        # average pool each (sr_h, sr_w) cell -> [R, out_h, out_w, C]
+        g = grids.reshape(R, out_h, sr_h, out_w, sr_w, C)
+        pooled = jnp.mean(g, axis=(2, 4))
+        return jnp.moveaxis(pooled, -1, 1)           # [R, C, out_h, out_w]
+
+    return apply("roi_align", _roi, x, boxes, batch_idx, out_h=int(out_h),
+                 out_w=int(out_w), scale=float(spatial_scale),
+                 ratio=int(sampling_ratio), aligned=bool(aligned))

@@ -1,0 +1,214 @@
+"""Vision transforms (reference python/paddle/vision/transforms/ —
+numpy-backed host preprocessing; the DataLoader runs these per sample)."""
+from __future__ import annotations
+
+import numbers
+import random
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ...framework.tensor import Tensor, to_tensor
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Transpose", "Resize",
+           "CenterCrop", "RandomCrop", "RandomHorizontalFlip",
+           "RandomVerticalFlip", "BaseTransform", "to_tensor_transform",
+           "normalize", "resize", "hflip", "center_crop"]
+
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(np.asarray(img))
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+class Compose:
+    """Chain transforms (reference transforms.py Compose)."""
+
+    def __init__(self, transforms: Sequence):
+        self.transforms = list(transforms)
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+def _as_float(img):
+    img = np.asarray(img)
+    if img.dtype == np.uint8:
+        return img.astype(np.float32) / 255.0
+    return img.astype(np.float32)
+
+
+class ToTensor(BaseTransform):
+    """HWC uint8/float → CHW float32 Tensor in [0,1] (reference
+    ToTensor)."""
+
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        img = _as_float(img)
+        if img.ndim == 2:
+            img = img[:, :, None]
+        if self.data_format == "CHW":
+            img = np.transpose(img, (2, 0, 1))
+        return to_tensor(np.ascontiguousarray(img))
+
+
+def to_tensor_transform(img, data_format="CHW"):
+    return ToTensor(data_format)(img)
+
+
+class Normalize(BaseTransform):
+    """(x - mean) / std per channel (reference Normalize)."""
+
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW",
+                 to_rgb=False):
+        self.mean = np.asarray(mean, np.float32).reshape(-1)
+        self.std = np.asarray(std, np.float32).reshape(-1)
+        self.data_format = data_format
+
+    def __call__(self, img):
+        if isinstance(img, Tensor):
+            img = img.numpy()
+        img = np.asarray(img, np.float32)
+        if self.data_format == "CHW":
+            shape = (-1, 1, 1)
+        else:
+            shape = (1, 1, -1)
+        return to_tensor((img - self.mean.reshape(shape))
+                         / self.std.reshape(shape))
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    return Normalize(mean, std, data_format)(img)
+
+
+class Transpose(BaseTransform):
+    """HWC→CHW permute (reference Transpose)."""
+
+    def __init__(self, order=(2, 0, 1)):
+        self.order = tuple(order)
+
+    def __call__(self, img):
+        if isinstance(img, Tensor):
+            img = img.numpy()
+        img = np.asarray(img)
+        if img.ndim == 2:
+            img = img[:, :, None]
+        return np.transpose(img, self.order)
+
+
+def _resize_np(img, size):
+    """Nearest+bilinear numpy resize (no PIL/cv2 dependency)."""
+    h, w = img.shape[:2]
+    if isinstance(size, numbers.Number):
+        # shorter side → size, keep aspect (the reference convention)
+        if h < w:
+            oh, ow = int(size), int(size * w / h)
+        else:
+            oh, ow = int(size * h / w), int(size)
+    else:
+        oh, ow = size
+    ys = np.linspace(0, h - 1, oh)
+    xs = np.linspace(0, w - 1, ow)
+    y0 = np.floor(ys).astype(int)
+    x0 = np.floor(xs).astype(int)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[:, None]
+    wx = (xs - x0)[None, :]
+    img_f = img.astype(np.float32)
+    if img.ndim == 2:
+        img_f = img_f[:, :, None]
+    out = ((1 - wy)[..., None] * ((1 - wx)[..., None] * img_f[y0][:, x0]
+                                  + wx[..., None] * img_f[y0][:, x1])
+           + wy[..., None] * ((1 - wx)[..., None] * img_f[y1][:, x0]
+                              + wx[..., None] * img_f[y1][:, x1]))
+    if img.ndim == 2:
+        out = out[:, :, 0]
+    return out.astype(img.dtype) if img.dtype == np.uint8 else out
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = size
+
+    def _apply_image(self, img):
+        return _resize_np(np.asarray(img), self.size)
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(img)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, numbers.Number) \
+            else tuple(size)
+
+    def _apply_image(self, img):
+        h, w = img.shape[:2]
+        th, tw = self.size
+        i = max(0, (h - th) // 2)
+        j = max(0, (w - tw) // 2)
+        return img[i:i + th, j:j + tw]
+
+
+def center_crop(img, size):
+    return CenterCrop(size)(np.asarray(img))
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False):
+        self.size = (size, size) if isinstance(size, numbers.Number) \
+            else tuple(size)
+        self.padding = padding
+        self.pad_if_needed = pad_if_needed
+
+    def _apply_image(self, img):
+        if self.padding:
+            p = self.padding
+            p = (p, p) if isinstance(p, numbers.Number) else p
+            pads = [(p[0], p[0]), (p[1], p[1])] + \
+                [(0, 0)] * (img.ndim - 2)
+            img = np.pad(img, pads)
+        h, w = img.shape[:2]
+        th, tw = self.size
+        if self.pad_if_needed and (h < th or w < tw):
+            ph, pw = max(0, th - h), max(0, tw - w)
+            pads = [(ph - ph // 2, ph // 2), (pw - pw // 2, pw // 2)] + \
+                [(0, 0)] * (img.ndim - 2)
+            img = np.pad(img, pads)
+            h, w = img.shape[:2]
+        i = random.randint(0, max(0, h - th))
+        j = random.randint(0, max(0, w - tw))
+        return img[i:i + th, j:j + tw]
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if random.random() < self.prob:
+            return img[:, ::-1].copy()
+        return img
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if random.random() < self.prob:
+            return img[::-1].copy()
+        return img
+
+
+def hflip(img):
+    return np.asarray(img)[:, ::-1].copy()

@@ -1,0 +1,158 @@
+"""distribution module tests: log_prob/entropy vs scipy, sampling moments,
+KL registry (reference python/paddle/distribution test discipline)."""
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import (Normal, Uniform, Categorical,
+                                     Bernoulli, Beta, Dirichlet, Gamma,
+                                     Exponential, Laplace, LogNormal,
+                                     Gumbel, Geometric, Cauchy,
+                                     Multinomial, kl_divergence,
+                                     register_kl, Distribution)
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+class TestLogProb:
+    def test_normal(self):
+        d = Normal(1.0, 2.0)
+        x = np.array([0.0, 1.0, 3.0], np.float32)
+        np.testing.assert_allclose(_np(d.log_prob(paddle.to_tensor(x))),
+                                   st.norm(1, 2).logpdf(x), rtol=1e-5)
+
+    def test_uniform(self):
+        d = Uniform(0.0, 4.0)
+        x = np.array([1.0, 3.9], np.float32)
+        np.testing.assert_allclose(_np(d.log_prob(paddle.to_tensor(x))),
+                                   st.uniform(0, 4).logpdf(x), rtol=1e-5)
+
+    def test_beta(self):
+        d = Beta(2.0, 3.0)
+        x = np.array([0.2, 0.7], np.float32)
+        np.testing.assert_allclose(_np(d.log_prob(paddle.to_tensor(x))),
+                                   st.beta(2, 3).logpdf(x), rtol=1e-4)
+
+    def test_gamma(self):
+        d = Gamma(2.0, 3.0)
+        x = np.array([0.5, 1.5], np.float32)
+        np.testing.assert_allclose(_np(d.log_prob(paddle.to_tensor(x))),
+                                   st.gamma(2, scale=1 / 3).logpdf(x),
+                                   rtol=1e-4)
+
+    def test_exponential_laplace_cauchy_gumbel(self):
+        x = np.array([0.5, 2.0], np.float32)
+        np.testing.assert_allclose(
+            _np(Exponential(1.5).log_prob(paddle.to_tensor(x))),
+            st.expon(scale=1 / 1.5).logpdf(x), rtol=1e-5)
+        np.testing.assert_allclose(
+            _np(Laplace(0.0, 2.0).log_prob(paddle.to_tensor(x))),
+            st.laplace(0, 2).logpdf(x), rtol=1e-5)
+        np.testing.assert_allclose(
+            _np(Cauchy(0.0, 1.0).log_prob(paddle.to_tensor(x))),
+            st.cauchy(0, 1).logpdf(x), rtol=1e-5)
+        np.testing.assert_allclose(
+            _np(Gumbel(0.0, 1.0).log_prob(paddle.to_tensor(x))),
+            st.gumbel_r(0, 1).logpdf(x), rtol=1e-5)
+
+    def test_lognormal(self):
+        d = LogNormal(0.5, 0.8)
+        x = np.array([0.5, 2.0], np.float32)
+        np.testing.assert_allclose(
+            _np(d.log_prob(paddle.to_tensor(x))),
+            st.lognorm(0.8, scale=np.exp(0.5)).logpdf(x), rtol=1e-4)
+
+    def test_categorical_bernoulli(self):
+        c = Categorical(probs=paddle.to_tensor(
+            np.array([0.2, 0.3, 0.5], np.float32)))
+        lp = _np(c.log_prob(paddle.to_tensor(np.array([2]))))
+        np.testing.assert_allclose(lp, [np.log(0.5)], rtol=1e-5)
+        b = Bernoulli(0.3)
+        np.testing.assert_allclose(
+            float(_np(b.log_prob(paddle.to_tensor(1.0)))),
+            np.log(0.3), rtol=1e-5)
+
+    def test_dirichlet_multinomial(self):
+        d = Dirichlet(paddle.to_tensor(np.array([2.0, 3.0, 4.0],
+                                                np.float32)))
+        x = np.array([0.2, 0.3, 0.5], np.float32)
+        np.testing.assert_allclose(
+            float(_np(d.log_prob(paddle.to_tensor(x)))),
+            st.dirichlet([2, 3, 4]).logpdf(x[:2] if False else x),
+            rtol=1e-4)
+        m = Multinomial(10, paddle.to_tensor(
+            np.array([0.2, 0.3, 0.5], np.float32)))
+        x = np.array([2.0, 3.0, 5.0], np.float32)
+        np.testing.assert_allclose(
+            float(_np(m.log_prob(paddle.to_tensor(x)))),
+            st.multinomial(10, [0.2, 0.3, 0.5]).logpmf(x), rtol=1e-4)
+
+
+class TestSampling:
+    def test_moments(self):
+        paddle.seed(0)
+        s = _np(Normal(2.0, 0.5).sample((20000,)))
+        assert abs(s.mean() - 2.0) < 0.02
+        assert abs(s.std() - 0.5) < 0.02
+        u = _np(Uniform(1.0, 3.0).sample((20000,)))
+        assert abs(u.mean() - 2.0) < 0.03
+        g = _np(Gamma(3.0, 2.0).sample((20000,)))
+        assert abs(g.mean() - 1.5) < 0.05
+        geo = _np(Geometric(0.4).sample((20000,)))
+        assert abs(geo.mean() - 0.6 / 0.4) < 0.1
+
+    def test_rsample_differentiable_path(self):
+        """Normal.rsample is loc + scale*eps — reparameterized."""
+        paddle.seed(0)
+        d = Normal(paddle.to_tensor(np.float32(0.0)),
+                   paddle.to_tensor(np.float32(1.0)))
+        s = d.rsample((4,))
+        assert s.shape == [4]
+
+    def test_multinomial_counts(self):
+        paddle.seed(0)
+        m = Multinomial(100, paddle.to_tensor(
+            np.array([0.5, 0.5], np.float32)))
+        s = _np(m.sample())
+        assert s.sum() == 100
+
+
+class TestEntropyKL:
+    def test_entropy_matches_scipy(self):
+        np.testing.assert_allclose(float(_np(Normal(0.0, 2.0).entropy())),
+                                   st.norm(0, 2).entropy(), rtol=1e-5)
+        np.testing.assert_allclose(
+            float(_np(Exponential(1.5).entropy())),
+            st.expon(scale=1 / 1.5).entropy(), rtol=1e-5)
+
+    def test_kl_normal(self):
+        p, q = Normal(0.0, 1.0), Normal(1.0, 2.0)
+        kl = float(_np(kl_divergence(p, q)))
+        # closed form
+        want = np.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+        np.testing.assert_allclose(kl, want, rtol=1e-5)
+
+    def test_kl_categorical_sanity(self):
+        p = Categorical(probs=paddle.to_tensor(
+            np.array([0.5, 0.5], np.float32)))
+        q = Categorical(probs=paddle.to_tensor(
+            np.array([0.9, 0.1], np.float32)))
+        assert float(_np(kl_divergence(p, q))) > 0
+        same = float(_np(kl_divergence(p, p)))
+        np.testing.assert_allclose(same, 0.0, atol=1e-6)
+
+    def test_register_kl_custom(self):
+        class A(Distribution): ...
+
+        class B(Distribution): ...
+
+        @register_kl(A, B)
+        def _kl_ab(p, q):
+            return 42.0
+
+        assert kl_divergence(A(), B()) == 42.0
+        with pytest.raises(NotImplementedError):
+            kl_divergence(B(), A())

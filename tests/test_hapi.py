@@ -1,0 +1,136 @@
+"""hapi Model / callbacks / summary tests (reference hapi/model.py:1050
+test discipline: MNIST-style fit + eval + predict + save/load)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.metric import Accuracy
+
+
+class _DS:
+    def __init__(self, n=256, seed=0):
+        rng = np.random.RandomState(seed)
+        self.x = rng.randn(n, 16).astype(np.float32)
+        w = rng.randn(16, 4).astype(np.float32)
+        self.y = np.argmax(self.x @ w, -1).astype(np.int64)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+def _model():
+    net = nn.Sequential(nn.Linear(16, 64), nn.ReLU(), nn.Linear(64, 4))
+    model = paddle.Model(net)
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=net.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss(), Accuracy())
+    return model
+
+
+class TestModelFit:
+    def test_fit_learns_and_history(self):
+        model = _model()
+        hist = model.fit(_DS(), epochs=3, batch_size=32, verbose=0)
+        assert len(hist["loss"]) == 3
+        assert hist["loss"][-1] < hist["loss"][0]
+        assert hist["acc"][-1] > 0.6
+
+    def test_evaluate_and_predict(self):
+        model = _model()
+        model.fit(_DS(), epochs=2, batch_size=32, verbose=0)
+        ev = model.evaluate(_DS(seed=1), batch_size=32, verbose=0)
+        assert set(ev) == {"loss", "acc"}
+        preds = model.predict(_DS(seed=1), batch_size=32,
+                              stack_outputs=True)
+        assert preds[0].shape == (256, 4)
+
+    def test_train_eval_predict_batch(self):
+        model = _model()
+        ds = _DS()
+        out = model.train_batch(ds.x[:8], ds.y[:8])
+        assert np.isfinite(out[0])
+        out2 = model.eval_batch(ds.x[:8], ds.y[:8])
+        assert np.isfinite(out2[0])
+        p = model.predict_batch(ds.x[:8])
+        assert p.shape == (8, 4)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        model = _model()
+        model.fit(_DS(), epochs=1, batch_size=64, verbose=0)
+        w0 = model.network.parameters()[0].numpy().copy()
+        model.save(str(tmp_path / "ck"))
+        model.network.parameters()[0].set_value(np.zeros_like(w0))
+        model.load(str(tmp_path / "ck"))
+        np.testing.assert_array_equal(
+            model.network.parameters()[0].numpy(), w0)
+
+    def test_save_inference_artifact(self, tmp_path):
+        model = _model()
+        from paddle_tpu.jit import InputSpec
+        model._inputs = [InputSpec([4, 16], "float32")]
+        model.save(str(tmp_path / "inf"), training=False)
+        layer = paddle.jit.load(str(tmp_path / "inf"))
+        out = layer(paddle.to_tensor(np.zeros((4, 16), np.float32)))
+        assert list(out.shape) == [4, 4]
+
+    def test_paddle_model_lazy_attr(self):
+        assert paddle.Model is not None
+
+
+class TestCallbacks:
+    def test_early_stopping(self):
+        from paddle_tpu.hapi.callbacks import EarlyStopping
+        model = _model()
+        es = EarlyStopping(monitor="loss", patience=0, mode="min")
+        # eval loss won't improve with lr=0-style: force by training on
+        # random labels with tiny model; just check the mechanism
+        es.set_model(model)
+        es.on_train_begin()
+        es.on_eval_end({"loss": 1.0})
+        assert not es.stop_training            # first eval = improvement
+        es.on_eval_end({"loss": 2.0})
+        assert es.stop_training                # worse + patience 0
+
+    def test_model_checkpoint(self, tmp_path):
+        from paddle_tpu.hapi.callbacks import ModelCheckpoint
+        model = _model()
+        model.fit(_DS(), epochs=1, batch_size=64, verbose=0,
+                  save_dir=str(tmp_path), save_freq=1)
+        import os
+        assert os.path.exists(str(tmp_path / "0.pdparams"))
+        assert os.path.exists(str(tmp_path / "final.pdparams"))
+
+    def test_lr_scheduler_callback(self):
+        from paddle_tpu.hapi.callbacks import LRScheduler
+        net = nn.Linear(4, 2)
+        sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1,
+                                              step_size=1, gamma=0.5)
+        opt = paddle.optimizer.SGD(learning_rate=sched,
+                                   parameters=net.parameters())
+        model = paddle.Model(net)
+        model.prepare(opt, nn.MSELoss())
+
+        class _Reg:
+            def __len__(self):
+                return 32
+
+            def __getitem__(self, i):
+                return (np.ones(4, np.float32),
+                        np.ones(2, np.float32))
+
+        model.fit(_Reg(), epochs=2, batch_size=16, verbose=0,
+                  callbacks=[LRScheduler()])
+        assert sched.last_lr < 0.1
+
+
+class TestSummary:
+    def test_summary_counts_params(self):
+        net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                            nn.Linear(32, 4))
+        info = paddle.summary(net, (1, 16))
+        assert info["total_params"] == 16 * 32 + 32 + 32 * 4 + 4
+        assert info["trainable_params"] == info["total_params"]

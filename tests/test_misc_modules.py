@@ -1,0 +1,270 @@
+"""Tests for sparse / quantization / geometric / audio / text / utils /
+incubate — the remaining reference namespaces."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestSparse:
+    def test_coo_roundtrip(self):
+        from paddle_tpu import sparse
+        idx = np.array([[0, 1, 2], [1, 0, 2]])
+        vals = np.array([1.0, 2.0, 3.0], np.float32)
+        s = sparse.sparse_coo_tensor(idx, vals, [3, 3])
+        d = s.to_dense().numpy()
+        want = np.zeros((3, 3), np.float32)
+        want[0, 1], want[1, 0], want[2, 2] = 1, 2, 3
+        np.testing.assert_array_equal(d, want)
+        assert s.nnz() == 3
+
+    def test_coo_csr_conversion(self):
+        from paddle_tpu import sparse
+        idx = np.array([[0, 0, 2], [0, 2, 1]])
+        s = sparse.sparse_coo_tensor(idx, np.array([1., 2., 3.],
+                                                   np.float32), [3, 3])
+        csr = s.to_sparse_csr()
+        np.testing.assert_array_equal(csr.crows().numpy(), [0, 2, 2, 3])
+        np.testing.assert_array_equal(csr.to_dense().numpy(),
+                                      s.to_dense().numpy())
+        coo2 = csr.to_sparse_coo()
+        np.testing.assert_array_equal(coo2.to_dense().numpy(),
+                                      s.to_dense().numpy())
+
+    def test_sparse_matmul_no_densify(self):
+        from paddle_tpu import sparse
+        rng = np.random.RandomState(0)
+        dense = rng.randn(4, 4).astype(np.float32)
+        dense[dense < 0.3] = 0
+        idx = np.array(np.nonzero(dense))
+        s = sparse.sparse_coo_tensor(idx, dense[tuple(idx)], [4, 4])
+        y = rng.randn(4, 3).astype(np.float32)
+        out = sparse.matmul(s, paddle.to_tensor(y)).numpy()
+        np.testing.assert_allclose(out, dense @ y, rtol=1e-5, atol=1e-5)
+
+    def test_sparse_relu_keeps_structure(self):
+        from paddle_tpu import sparse
+        s = sparse.sparse_coo_tensor(np.array([[0, 1], [1, 0]]),
+                                     np.array([-1.0, 2.0], np.float32),
+                                     [2, 2])
+        r = sparse.relu(s)
+        assert r.nnz() == 2
+        np.testing.assert_array_equal(r.values().numpy(), [0.0, 2.0])
+
+
+class TestQuantization:
+    def test_fake_quant_roundtrip_error_small(self):
+        from paddle_tpu.quantization import quant_dequant
+        x = paddle.to_tensor(np.linspace(-1, 1, 101).astype(np.float32))
+        q = quant_dequant(x, scale=1.0, bits=8)
+        err = np.abs(q.numpy() - x.numpy()).max()
+        assert err <= 1.0 / 127 + 1e-6
+
+    def test_fake_quant_straight_through_grad(self):
+        from paddle_tpu.quantization import quant_dequant
+        x = paddle.to_tensor(np.array([0.3, -0.7], np.float32),
+                             stop_gradient=False)
+        quant_dequant(x, 1.0).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), 1.0, atol=1e-6)
+
+    def test_qat_swaps_and_trains(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.quantization import QAT, QuantConfig, QuantedLinear
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+        QAT(QuantConfig()).quantize(net)
+        quanted = [l for l in net.sublayers()
+                   if isinstance(l, QuantedLinear)]
+        assert len(quanted) == 2
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(4, 8).astype(np.float32))
+        out = net(x)
+        out.sum().backward()
+        g = net.parameters()[0].grad
+        assert g is not None and np.abs(g.numpy()).sum() > 0
+
+    def test_ptq_calibrate_convert(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.quantization import PTQ
+        net = nn.Sequential(nn.Linear(4, 4))
+        ptq = PTQ()
+        ptq.quantize(net)
+        for _ in range(3):
+            net(paddle.to_tensor(np.random.RandomState(0)
+                                 .randn(2, 4).astype(np.float32)))
+        ptq.convert(net)
+        assert not net.training
+
+
+class TestGeometric:
+    def test_send_u_recv_sum_mean_max(self):
+        from paddle_tpu import geometric as G
+        x = paddle.to_tensor(np.array([[1.0], [2.0], [4.0]], np.float32))
+        src = paddle.to_tensor(np.array([0, 1, 2, 0], np.int64))
+        dst = paddle.to_tensor(np.array([1, 1, 0, 0], np.int64))
+        out = G.send_u_recv(x, src, dst, "sum").numpy()
+        np.testing.assert_allclose(out, [[5.0], [3.0]])
+        out = G.send_u_recv(x, src, dst, "mean").numpy()
+        np.testing.assert_allclose(out, [[2.5], [1.5]])
+        out = G.send_u_recv(x, src, dst, "max", out_size=3).numpy()
+        np.testing.assert_allclose(out, [[4.0], [2.0], [0.0]])
+
+    def test_send_ue_recv_and_uv(self):
+        from paddle_tpu import geometric as G
+        x = paddle.to_tensor(np.array([[1.0], [2.0]], np.float32))
+        e = paddle.to_tensor(np.array([[10.0], [20.0]], np.float32))
+        src = paddle.to_tensor(np.array([0, 1], np.int64))
+        dst = paddle.to_tensor(np.array([1, 0], np.int64))
+        out = G.send_ue_recv(x, e, src, dst, "add", "sum").numpy()
+        np.testing.assert_allclose(out, [[22.0], [11.0]])
+        uv = G.send_uv(x, x, src, dst, "mul").numpy()
+        np.testing.assert_allclose(uv, [[2.0], [2.0]])
+
+    def test_segment_ops(self):
+        from paddle_tpu import geometric as G
+        data = paddle.to_tensor(np.array([1.0, 2.0, 3.0, 4.0], np.float32))
+        seg = paddle.to_tensor(np.array([0, 0, 1, 1], np.int64))
+        np.testing.assert_allclose(G.segment_sum(data, seg).numpy(),
+                                   [3.0, 7.0])
+        np.testing.assert_allclose(G.segment_mean(data, seg).numpy(),
+                                   [1.5, 3.5])
+        np.testing.assert_allclose(G.segment_min(data, seg).numpy(),
+                                   [1.0, 3.0])
+
+    def test_segment_max_int_empty_segment(self):
+        """Empty segments zero-fill without dtype promotion (int stays
+        int, no iinfo.min leak)."""
+        from paddle_tpu import geometric as G
+        data = paddle.to_tensor(np.array([5, 7, 9], np.int32))
+        seg = paddle.to_tensor(np.array([0, 0, 2], np.int64))
+        out = G.segment_max(data, seg).numpy()
+        assert out.dtype == np.int32
+        np.testing.assert_array_equal(out, [7, 0, 9])
+
+    def test_grad_through_send_u_recv(self):
+        from paddle_tpu import geometric as G
+        x = paddle.to_tensor(np.ones((3, 2), np.float32),
+                             stop_gradient=False)
+        src = paddle.to_tensor(np.array([0, 1, 2], np.int64))
+        dst = paddle.to_tensor(np.array([0, 0, 1], np.int64))
+        G.send_u_recv(x, src, dst, "sum").sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), 1.0)
+
+
+class TestAudio:
+    def test_hz_mel_roundtrip(self):
+        from paddle_tpu.audio import functional as AF
+        freqs = np.array([100.0, 440.0, 4000.0])
+        back = AF.mel_to_hz(AF.hz_to_mel(freqs))
+        np.testing.assert_allclose(back, freqs, rtol=1e-6)
+
+    def test_fbank_shape_and_rowsums(self):
+        from paddle_tpu.audio import functional as AF
+        fb = AF.compute_fbank_matrix(16000, 512, n_mels=40).numpy()
+        assert fb.shape == (40, 257)
+        assert (fb >= 0).all()
+        assert (fb.sum(axis=1) > 0).all()     # every filter hits some bin
+
+    def test_mel_spectrogram_runs(self):
+        from paddle_tpu.audio.features import MelSpectrogram, MFCC
+        sig = paddle.to_tensor(np.sin(
+            2 * np.pi * 440 * np.arange(4000) / 16000).astype(np.float32))
+        mel = MelSpectrogram(sr=16000, n_fft=512, n_mels=32)(sig)
+        assert mel.shape[0] == 32
+        mf = MFCC(sr=16000, n_mfcc=13, n_fft=512, n_mels=32)(sig)
+        assert mf.shape[0] == 13
+
+
+class TestText:
+    def test_viterbi_matches_bruteforce(self):
+        from paddle_tpu.text import viterbi_decode
+        rng = np.random.RandomState(0)
+        B, T, N = 2, 5, 3
+        pot = rng.randn(B, T, N).astype(np.float32)
+        trans = rng.randn(N, N).astype(np.float32)
+        score, path = viterbi_decode(paddle.to_tensor(pot),
+                                     paddle.to_tensor(trans))
+        # brute force over all tag sequences
+        import itertools
+        for b in range(B):
+            best, best_seq = -1e9, None
+            for seq in itertools.product(range(N), repeat=T):
+                s = pot[b, 0, seq[0]]
+                for t in range(1, T):
+                    s += trans[seq[t - 1], seq[t]] + pot[b, t, seq[t]]
+                if s > best:
+                    best, best_seq = s, seq
+            np.testing.assert_allclose(float(score.numpy()[b]), best,
+                                       rtol=1e-5)
+            np.testing.assert_array_equal(path.numpy()[b], best_seq)
+
+    def test_viterbi_with_lengths_ignores_padding(self):
+        from paddle_tpu.text import viterbi_decode
+        rng = np.random.RandomState(3)
+        N = 3
+        pot_short = rng.randn(1, 3, N).astype(np.float32)
+        # pad to T=6 with junk that MUST not affect the result
+        pot_pad = np.concatenate(
+            [pot_short, 100 * rng.randn(1, 3, N).astype(np.float32)], 1)
+        trans = rng.randn(N, N).astype(np.float32)
+        s_ref, p_ref = viterbi_decode(paddle.to_tensor(pot_short),
+                                      paddle.to_tensor(trans))
+        s_pad, p_pad = viterbi_decode(
+            paddle.to_tensor(pot_pad), paddle.to_tensor(trans),
+            lengths=paddle.to_tensor(np.array([3], np.int32)))
+        np.testing.assert_allclose(s_pad.numpy(), s_ref.numpy(), rtol=1e-5)
+        np.testing.assert_array_equal(p_pad.numpy()[:, :3], p_ref.numpy())
+
+    def test_datasets_raise_pointedly(self):
+        from paddle_tpu import text
+        with pytest.raises(NotImplementedError, match="egress"):
+            text.datasets.Imdb
+
+
+class TestUtilsIncubate:
+    def test_unique_name(self):
+        from paddle_tpu.utils import unique_name
+        with unique_name.guard():
+            a = unique_name.generate("fc")
+            b = unique_name.generate("fc")
+        assert a == "fc_0" and b == "fc_1"
+
+    def test_deprecated_warns(self):
+        from paddle_tpu.utils import deprecated
+        import warnings
+
+        @deprecated(update_to="new_api", since="2.0")
+        def old():
+            return 1
+
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            assert old() == 1
+        assert any("new_api" in str(x.message) for x in w)
+
+    def test_run_check(self, capsys):
+        from paddle_tpu.utils import run_check
+        assert run_check()
+
+    def test_dlpack_roundtrip(self):
+        from paddle_tpu.utils import to_dlpack, from_dlpack
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32))
+        back = from_dlpack(to_dlpack(x))
+        np.testing.assert_array_equal(back.numpy(), x.numpy())
+
+    def test_incubate_reexports(self):
+        import paddle_tpu.incubate as inc
+        assert hasattr(inc.autograd, "vjp")
+        assert hasattr(inc.nn, "FusedMultiHeadAttention")
+        out = inc.softmax_mask_fuse(
+            paddle.to_tensor(np.zeros((2, 4), np.float32)),
+            paddle.to_tensor(np.zeros((2, 4), np.float32)))
+        np.testing.assert_allclose(out.numpy().sum(-1), 1.0, rtol=1e-5)
+
+    def test_sysconfig(self):
+        from paddle_tpu import sysconfig
+        assert sysconfig.get_include().endswith("include")
+
+    def test_onnx_export_pointed_error(self):
+        from paddle_tpu import onnx
+        with pytest.raises(NotImplementedError, match="StableHLO"):
+            onnx.export(None, "/tmp/x")

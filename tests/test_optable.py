@@ -17,8 +17,8 @@ def test_coverage_thresholds():
     # or on the short to-implement list (vision-pack ops)
     assert (len(cov["implemented"]) + len(cov["descoped"])
             + len(cov["missing"])) == cov["total_ref"] == 358
-    assert len(cov["implemented"]) >= 310
-    assert set(cov["missing"]) <= {"nms", "roi_align"}
+    assert len(cov["implemented"]) >= 320
+    assert cov["missing"] == []        # every reference op accounted for
     assert cov["registry_size"] >= 300
 
 

@@ -1,0 +1,155 @@
+"""Vision pack tests: ResNet/LeNet forward+train, transforms, datasets,
+nms/roi_align numerics (reference vision test discipline)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.vision import models, transforms, datasets, ops
+
+
+class TestModels:
+    def test_resnet18_forward_shape(self):
+        net = models.resnet18(num_classes=10)
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(2, 3, 64, 64).astype(np.float32))
+        net.eval()
+        out = net(x)
+        assert list(out.shape) == [2, 10]
+
+    def test_resnet50_bottleneck_structure(self):
+        net = models.resnet50(num_classes=8)
+        # 50-layer: conv1 + 3*3 + 4*3 + 6*3 + 3*3 bottleneck convs + fc
+        n_params = sum(int(np.prod(p.shape)) for p in net.parameters())
+        assert n_params > 23e6                      # ~23.5M + fc
+        x = paddle.to_tensor(np.zeros((1, 3, 32, 32), np.float32))
+        net.eval()
+        assert list(net(x).shape) == [1, 8]
+
+    def test_lenet_trains_via_hapi(self):
+        net = models.LeNet(num_classes=4)
+        model = paddle.Model(net)
+        opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                    parameters=net.parameters())
+        from paddle_tpu.metric import Accuracy
+        model.prepare(opt, nn.CrossEntropyLoss(), Accuracy())
+        ds = datasets.FakeData(num_samples=64, image_shape=(1, 28, 28),
+                               num_classes=4)
+        hist = model.fit(ds, epochs=2, batch_size=16, verbose=0)
+        assert hist["loss"][-1] < hist["loss"][0]
+
+    def test_pretrained_raises(self):
+        with pytest.raises(NotImplementedError, match="egress"):
+            models.resnet50(pretrained=True)
+
+
+class TestTransforms:
+    def test_compose_to_tensor_normalize(self):
+        t = transforms.Compose([
+            transforms.ToTensor(),
+            transforms.Normalize(mean=[0.5], std=[0.5])])
+        img = (np.ones((8, 8), np.uint8) * 255)
+        out = t(img)
+        np.testing.assert_allclose(out.numpy(), np.ones((1, 8, 8)),
+                                   atol=1e-6)
+
+    def test_resize_aspect_and_exact(self):
+        img = np.arange(12 * 16, dtype=np.float32).reshape(12, 16)
+        out = transforms.Resize((6, 8))(img)
+        assert out.shape == (6, 8)
+        out2 = transforms.Resize(6)(img)      # short side -> 6
+        assert out2.shape == (6, 8)
+
+    def test_crops_and_flips(self):
+        img = np.arange(64, dtype=np.float32).reshape(8, 8)
+        cc = transforms.CenterCrop(4)(img)
+        np.testing.assert_array_equal(cc, img[2:6, 2:6])
+        rc = transforms.RandomCrop(4)(img)
+        assert rc.shape == (4, 4)
+        fl = transforms.hflip(img)
+        np.testing.assert_array_equal(fl, img[:, ::-1])
+
+
+class TestDatasets:
+    def test_fakedata_deterministic(self):
+        ds = datasets.FakeData(num_samples=32, image_shape=(3, 8, 8),
+                               num_classes=5, seed=1)
+        x, y = ds[0]
+        assert x.shape == (3, 8, 8) and 0 <= y < 5
+        x2, _ = datasets.FakeData(num_samples=32, image_shape=(3, 8, 8),
+                                  num_classes=5, seed=1)[0]
+        np.testing.assert_array_equal(x, x2)
+
+    def test_mnist_reads_idx(self, tmp_path):
+        import gzip
+        import struct
+        imgs = np.random.RandomState(0).randint(
+            0, 255, (4, 28, 28)).astype(np.uint8)
+        labs = np.array([1, 2, 3, 4], np.uint8)
+        ip = tmp_path / "imgs.gz"
+        lp = tmp_path / "labs.gz"
+        with gzip.open(ip, "wb") as f:
+            f.write(struct.pack(">IIII", 2051, 4, 28, 28))
+            f.write(imgs.tobytes())
+        with gzip.open(lp, "wb") as f:
+            f.write(struct.pack(">II", 2049, 4))
+            f.write(labs.tobytes())
+        ds = datasets.MNIST(image_path=str(ip), label_path=str(lp))
+        assert len(ds) == 4
+        x, y = ds[2]
+        assert y == 3 and x.shape == (1, 28, 28)
+
+
+class TestVisionOps:
+    def test_nms_suppresses_overlaps(self):
+        boxes = np.array([[0, 0, 10, 10],
+                          [1, 1, 11, 11],       # IoU ~0.68 with box 0
+                          [20, 20, 30, 30]], np.float32)
+        scores = np.array([0.9, 0.8, 0.7], np.float32)
+        keep = ops.nms(paddle.to_tensor(boxes), iou_threshold=0.5,
+                       scores=paddle.to_tensor(scores)).numpy()
+        np.testing.assert_array_equal(np.sort(keep), [0, 2])
+
+    def test_nms_categories_dont_suppress(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11]], np.float32)
+        scores = np.array([0.9, 0.8], np.float32)
+        cats = np.array([0, 1])
+        keep = ops.nms(paddle.to_tensor(boxes), 0.5,
+                       paddle.to_tensor(scores),
+                       category_idxs=paddle.to_tensor(cats),
+                       categories=[0, 1]).numpy()
+        assert len(keep) == 2                  # different classes: both kept
+
+    def test_roi_align_constant_field(self):
+        """On a constant feature map every aligned ROI pools to that
+        constant."""
+        x = paddle.to_tensor(np.full((1, 2, 16, 16), 3.5, np.float32))
+        boxes = paddle.to_tensor(np.array([[2, 2, 10, 10],
+                                           [0, 0, 15, 15]], np.float32))
+        out = ops.roi_align(x, boxes,
+                            paddle.to_tensor(np.array([2], np.int32)),
+                            output_size=4)
+        assert list(out.shape) == [2, 2, 4, 4]
+        np.testing.assert_allclose(out.numpy(), 3.5, rtol=1e-5)
+
+    def test_roi_align_gradient_field(self):
+        """Linear-in-x feature map: pooled value equals the ROI cell's
+        center x coordinate (bilinear exactness on affine fields)."""
+        H = W = 16
+        ramp = np.tile(np.arange(W, dtype=np.float32), (H, 1))
+        x = paddle.to_tensor(ramp[None, None])
+        boxes = paddle.to_tensor(np.array([[4, 4, 12, 12]], np.float32))
+        out = ops.roi_align(x, boxes,
+                            paddle.to_tensor(np.array([1], np.int32)),
+                            output_size=2, aligned=False).numpy()[0, 0]
+        # cells span x in [4,8] and [8,12]; centers 6 and 10
+        np.testing.assert_allclose(out[:, 0], 6.0, atol=0.26)
+        np.testing.assert_allclose(out[:, 1], 10.0, atol=0.26)
+
+    def test_box_iou(self):
+        a = paddle.to_tensor(np.array([[0, 0, 10, 10]], np.float32))
+        b = paddle.to_tensor(np.array([[5, 5, 15, 15],
+                                       [20, 20, 30, 30]], np.float32))
+        iou = ops.box_iou(a, b).numpy()
+        np.testing.assert_allclose(iou[0, 0], 25 / 175, rtol=1e-5)
+        assert iou[0, 1] == 0
