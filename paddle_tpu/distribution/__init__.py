@@ -430,11 +430,20 @@ def register_kl(p_cls: type, q_cls: type):
 
 
 def kl_divergence(p: Distribution, q: Distribution):
-    """Dispatch KL(p||q) through the registry with MRO fallback
-    (reference kl.py:kl_divergence)."""
+    """Dispatch KL(p||q) to the MOST SPECIFIC registered rule (reference
+    kl.py:kl_divergence total-order dispatch): among matching (pc, qc)
+    pairs, pick the one closest in both arguments' MROs — so a rule for a
+    subclass beats the base-class rule regardless of insertion order."""
+    best, best_key = None, None
     for (pc, qc), fn in _KL_REGISTRY.items():
         if isinstance(p, pc) and isinstance(q, qc):
-            return fn(p, q)
+            dp = type(p).__mro__.index(pc)
+            dq = type(q).__mro__.index(qc)
+            key = (dp + dq, dp, dq)
+            if best_key is None or key < best_key:
+                best, best_key = fn, key
+    if best is not None:
+        return best(p, q)
     raise NotImplementedError(
         f"no KL rule registered for ({type(p).__name__}, "
         f"{type(q).__name__})")

@@ -111,6 +111,11 @@ class Model:
         if data is None:
             return None
         if hasattr(data, "__iter__") and not hasattr(data, "__getitem__"):
+            # a one-shot iterator (generator) would be exhausted after the
+            # first epoch, silently training on nothing afterwards —
+            # materialize it once so every epoch sees the data
+            if iter(data) is data:
+                return list(data)
             return data
         return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
                           drop_last=train)

@@ -301,8 +301,9 @@ def _pipeline_active(cfg: GPTConfig) -> int:
 def _apply_stack(stacked, x, cfg: GPTConfig):
     """Apply the transformer block stack: pipelined over the 'pp' mesh axis
     when configured, else a layer-axis lax.scan (layer-weight sharding).
-    Returns (x, aux) — summed MoE load-balancing loss (0 under the
-    pipelined path: per-stage aux does not circulate with activations)."""
+    Returns (x, aux) — the MoE load-balancing loss. Under the pipelined
+    path the aux rides the ppermute ring with the activations
+    (spmd_pipeline with_aux) and comes back as the microbatch mean."""
     pp = _pipeline_active(cfg)
     if pp:
         from ..parallel.pipeline import pipeline_forward
@@ -321,20 +322,36 @@ def _apply_stack(stacked, x, cfg: GPTConfig):
         chunked = {k: val.reshape((n_chunks, L // n_chunks) + val.shape[1:])
                    for k, val in stacked.items()}
 
-        def stage_fn(chunk_params, h):
-            def body_fn(h, lp):
-                h2, _aux = _block(lp, h, cfg)
-                return h2, None
-            h, _ = jax.lax.scan(body_fn, h, chunk_params)
-            return h
+        moe = cfg.num_experts > 0 and cfg.moe_aux_weight != 0.0
 
-        if cfg.num_experts > 0 and cfg.moe_aux_weight != 0.0:
-            raise ValueError(
-                "MoE aux loss is not accumulated under the pipelined path "
-                "(per-stage aux does not circulate with activations); set "
-                "moe_aux_weight=0.0 explicitly to acknowledge dropping it "
-                "when combining num_experts>0 with pipeline_microbatches>1")
+        if moe:
+            # aux rides the ppermute ring with the activations (per-stage
+            # accumulation, the reference's 1F1B aux handling)
+            def stage_fn(chunk_params, h):
+                def body_fn(carry, lp):
+                    h, aux = carry
+                    h2, aux_l = _block(lp, h, cfg)
+                    return (h2, aux + aux_l), None
+                # runs inside the pp-manual shard_map: the zero init must be
+                # marked device-varying to match the scan's carry vma type
+                aux0 = jax.lax.pcast(jnp.zeros((), jnp.float32), "pp",
+                                     to="varying")
+                (h, aux), _ = jax.lax.scan(body_fn, (h, aux0), chunk_params)
+                return h, aux
+        else:
+            def stage_fn(chunk_params, h):
+                def body_fn(h, lp):
+                    h2, _aux = _block(lp, h, cfg)
+                    return h2, None
+                h, _ = jax.lax.scan(body_fn, h, chunk_params)
+                return h
+
         x_mb = x.reshape((m, B // m) + x.shape[1:])
+        if moe:
+            y, aux_mb = pipeline_forward(stage_fn, chunked, x_mb, pp, m,
+                                         interleave=v, remat=cfg.remat,
+                                         with_aux=True)
+            return y.reshape(x.shape), jnp.mean(aux_mb)
         y = pipeline_forward(stage_fn, chunked, x_mb, pp, m,
                              interleave=v, remat=cfg.remat)
         return y.reshape(x.shape), jnp.zeros((), jnp.float32)

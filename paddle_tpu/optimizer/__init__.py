@@ -3,3 +3,4 @@ from .optimizer import Optimizer  # noqa: F401
 from .optimizers import (  # noqa: F401
     SGD, Momentum, Adagrad, RMSProp, Adadelta, Adam, AdamW, Adamax, Lamb)
 from . import lr  # noqa: F401
+from .gradient_merge import GradientMergeOptimizer, merge_grads  # noqa: F401

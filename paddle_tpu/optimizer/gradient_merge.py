@@ -1,0 +1,85 @@
+"""Gradient merge / accumulation.
+
+Reference analog: fleet/meta_optimizers/gradient_merge_optimizer.py and the
+auto_parallel_gradient_merge pass — accumulate K micro-batch gradients
+before one optimizer update (same math as a K×-bigger batch, constant
+memory).
+
+Two TPU-native forms:
+- `GradientMergeOptimizer`: eager wrapper. The tape already accumulates
+  into `.grad` across backward() calls, so the wrapper simply gates
+  step()/clear_grad() to every k-th call and rescales by 1/k for the
+  mean-loss convention.
+- `merge_grads(grad_fn, params, microbatches)`: functional/jit form — a
+  lax.scan over microbatches summing grads, for fused train steps.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+
+class GradientMergeOptimizer:
+    """Wrap any paddle_tpu optimizer; step() applies only every `k_steps`
+    calls, with grads accumulated by the tape in between (do NOT call
+    clear_grad between micro-steps — this wrapper gates it)."""
+
+    def __init__(self, inner_opt, k_steps: int = 1, avg: bool = True):
+        if k_steps < 1:
+            raise ValueError(f"k_steps must be >= 1, got {k_steps}")
+        self._inner_opt = inner_opt
+        self.k_steps = k_steps
+        self.avg = avg
+        self._acc = 0
+
+    def __getattr__(self, name):
+        return getattr(self._inner_opt, name)
+
+    @property
+    def inner_opt(self):
+        return self._inner_opt
+
+    def step(self):
+        self._acc += 1
+        if self._acc < self.k_steps:
+            return                      # keep accumulating
+        if self.avg and self.k_steps > 1:
+            for p in self._inner_opt._parameter_list:
+                if p.grad is not None:
+                    p.grad._value = p.grad._value / self.k_steps
+        self._inner_opt.step()
+        self._inner_opt.clear_grad()
+        self._acc = 0
+
+    def clear_grad(self, set_to_zero=False):
+        # only clears at merge boundaries; mid-accumulation calls are the
+        # usual train-loop idiom and must not wipe pending grads
+        if self._acc == 0:
+            self._inner_opt.clear_grad(set_to_zero)
+
+    def minimize(self, loss, **kwargs):
+        loss.backward()
+        self.step()
+
+
+def merge_grads(grad_fn: Callable, params: Any, microbatches: Any,
+                avg: bool = True):
+    """Functional form for fused/jit train steps: scan `grad_fn(params,
+    microbatch) -> (loss, grads)` over the leading microbatch axis,
+    accumulating. → (mean loss, merged grads)."""
+    def body(carry, mb):
+        loss_acc, grads_acc = carry
+        loss, grads = grad_fn(params, mb)
+        grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, grads)
+        return (loss_acc + loss, grads_acc), None
+
+    zeros = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), params)
+    n = jax.tree_util.tree_leaves(microbatches)[0].shape[0]
+    (loss_sum, grads), _ = jax.lax.scan(
+        body, (jnp.zeros((), jnp.float32), zeros), microbatches)
+    if avg:
+        grads = jax.tree_util.tree_map(lambda g: g / n, grads)
+    return loss_sum / n, grads

@@ -155,7 +155,9 @@ class HybridParallelOptimizer:
 
     def _shard_states(self):
         mesh = self._hcg.mesh
-        opt = self._inner_opt
+        # unwrap GradientMergeOptimizer etc.: the hook must land on the
+        # object whose _init_state actually runs
+        opt = getattr(self._inner_opt, "inner_opt", self._inner_opt)
         orig_init = opt._init_state
 
         def sharded_init(p):
@@ -183,8 +185,14 @@ class HybridParallelOptimizer:
 def distributed_optimizer(optimizer, strategy=None):
     if not _state.initialized:
         init(strategy=strategy)
-    return HybridParallelOptimizer(optimizer, _state.hcg,
-                                   strategy or _state.strategy)
+    strategy = strategy or _state.strategy
+    if strategy is not None and getattr(strategy, "gradient_merge", False):
+        from ...optimizer.gradient_merge import GradientMergeOptimizer
+        cfg = getattr(strategy, "gradient_merge_configs", {})
+        optimizer = GradientMergeOptimizer(
+            optimizer, k_steps=int(cfg.get("k_steps", 1)),
+            avg=bool(cfg.get("avg", True)))
+    return HybridParallelOptimizer(optimizer, _state.hcg, strategy)
 
 
 # ------- worker-info surface (reference fleet.py worker_num etc.) -------

@@ -44,7 +44,8 @@ def naive_bubble_fraction(n_stages: int) -> float:
 
 
 def spmd_pipeline(stage_fn: Callable, n_stages: int, n_microbatches: int,
-                  axis_name: str = "pp", interleave: int = 1):
+                  axis_name: str = "pp", interleave: int = 1,
+                  with_aux: bool = False):
     """Lift `stage_fn(chunk_params, x) -> y` into a pipelined
     `fn(stacked_params, microbatched_x) -> microbatched_y`.
 
@@ -69,6 +70,14 @@ def spmd_pipeline(stage_fn: Callable, n_stages: int, n_microbatches: int,
 
     Must be called inside a shard_map manual over `axis_name`, where each
     rank holds the leading-dim slice of size `interleave`.
+
+    with_aux=True: `stage_fn(chunk_params, x) -> (y, aux_scalar)` and each
+    microbatch's aux accumulates ALONG ITS JOURNEY — a per-slot f32 rides
+    the same ppermute ring as the activation (zeroed at ingestion, summed
+    per stage hop, emitted with the final activation). This is how the MoE
+    load-balancing loss circulates under pipeline parallelism (the
+    reference accumulates it per stage in the 1F1B loop). Returns
+    (outputs, aux_per_microbatch [m]).
     """
     v, p = interleave, n_stages
 
@@ -79,8 +88,14 @@ def spmd_pipeline(stage_fn: Callable, n_stages: int, n_microbatches: int,
         mb_shape = x_mb.shape[1:]
         perm = [(i, (i + 1) % p) for i in range(p)]
 
+        # with_aux is a trace-time constant: the aux ring (its carry slots,
+        # ppermute, roll) exists ONLY when requested — the dense pipeline
+        # carries no dead collectives
         def tick(carry, t):
-            state, outputs = carry            # state: [v, *mb_shape]
+            if with_aux:
+                state, aux_state, outputs, aux_out = carry
+            else:
+                state, outputs = carry
             # stage 0, slot 0 ingests microbatch t (clamped); every other
             # (device, slot) keeps its circulating activation
             idx = jnp.clip(t, 0, n_microbatches - 1)
@@ -89,36 +104,68 @@ def spmd_pipeline(stage_fn: Callable, n_stages: int, n_microbatches: int,
                 axis_name, to="varying")
             inp = state.at[0].set(
                 jnp.where(stage == 0, inject, state[0]))
-            out = jax.vmap(stage_fn)(local_params, inp)
             # device p-1, slot v-1 finishes hop v*p-1: emit microbatch
             # t - (v*p - 1)
             out_idx = t - (v * p - 1)
             emit = jnp.logical_and(stage == p - 1, out_idx >= 0)
-            outputs = jax.lax.cond(
-                emit,
-                lambda o: jax.lax.dynamic_update_index_in_dim(
-                    o, out[v - 1], jnp.maximum(out_idx, 0), 0),
-                lambda o: o, outputs)
+            if with_aux:
+                aux_in = aux_state.at[0].set(
+                    jnp.where(stage == 0, 0.0, aux_state[0]))
+                out, aux_delta = jax.vmap(stage_fn)(local_params, inp)
+                aux_new = aux_in + aux_delta
+                outputs, aux_out = jax.lax.cond(
+                    emit,
+                    lambda o, a: (
+                        jax.lax.dynamic_update_index_in_dim(
+                            o, out[v - 1], jnp.maximum(out_idx, 0), 0),
+                        jax.lax.dynamic_update_index_in_dim(
+                            a, aux_new[v - 1], jnp.maximum(out_idx, 0), 0)),
+                    lambda o, a: (o, a), outputs, aux_out)
+            else:
+                out = jax.vmap(stage_fn)(local_params, inp)
+                outputs = jax.lax.cond(
+                    emit,
+                    lambda o: jax.lax.dynamic_update_index_in_dim(
+                        o, out[v - 1], jnp.maximum(out_idx, 0), 0),
+                    lambda o: o, outputs)
             shifted = jax.lax.ppermute(out, axis_name, perm)
             # ring wraparound (p-1 -> 0) advances each activation one slot
             rolled = jnp.roll(shifted, 1, axis=0)
             state = jnp.where(stage == 0, rolled, shifted)
+            if with_aux:
+                aux_shifted = jax.lax.ppermute(aux_new, axis_name, perm)
+                aux_rolled = jnp.roll(aux_shifted, 1, axis=0)
+                aux_state = jnp.where(stage == 0, aux_rolled, aux_shifted)
+                return (state, aux_state, outputs, aux_out), None
             return (state, outputs), None
 
         # pcast-to-varying: carries are device-varying over pp from tick one,
         # and scan/cond require carry vma types to be invariant
-        state0 = jax.lax.pcast(jnp.zeros((v,) + mb_shape, x_mb.dtype),
-                               axis_name, to="varying")
-        outputs0 = jax.lax.pcast(
-            jnp.zeros((n_microbatches,) + mb_shape, x_mb.dtype),
-            axis_name, to="varying")
-        (_, outputs), _ = jax.lax.scan(
-            tick, (state0, outputs0), jnp.arange(n_ticks))
+        def vary(z):
+            return jax.lax.pcast(z, axis_name, to="varying")
+
+        state0 = vary(jnp.zeros((v,) + mb_shape, x_mb.dtype))
+        outputs0 = vary(jnp.zeros((n_microbatches,) + mb_shape, x_mb.dtype))
+        if with_aux:
+            aux0 = vary(jnp.zeros((v,), jnp.float32))
+            aux_out0 = vary(jnp.zeros((n_microbatches,), jnp.float32))
+            (_, _, outputs, aux_out), _ = jax.lax.scan(
+                tick, (state0, aux0, outputs0, aux_out0),
+                jnp.arange(n_ticks))
+        else:
+            (_, outputs), _ = jax.lax.scan(
+                tick, (state0, outputs0), jnp.arange(n_ticks))
         # only the last stage holds real outputs; masked psum broadcasts
         # them to every pp rank so the loss is computable everywhere
         if p > 1:
             mask = (stage == p - 1).astype(outputs.dtype)
             outputs = jax.lax.psum(outputs * mask, axis_name)
+            if with_aux:
+                aux_out = jax.lax.psum(
+                    aux_out * (stage == p - 1).astype(aux_out.dtype),
+                    axis_name)
+        if with_aux:
+            return outputs, aux_out
         return outputs
 
     return pipelined
@@ -126,7 +173,7 @@ def spmd_pipeline(stage_fn: Callable, n_stages: int, n_microbatches: int,
 
 def pipeline_forward(stage_fn, stacked_params, x_mb, n_stages,
                      n_microbatches, mesh=None, interleave: int = 1,
-                     remat=True):
+                     remat=True, with_aux: bool = False):
     """Run the SPMD pipeline as a global computation via shard_map.
 
     stacked_params: global arrays with leading dim n_stages*interleave in
@@ -134,13 +181,14 @@ def pipeline_forward(stage_fn, stacked_params, x_mb, n_stages,
     x_mb: [n_micro, micro_batch, ...] global input.
     Only the 'pp' axis goes manual; dp/mp/fsdp shardings inside stage_fn
     stay under GSPMD (partial-auto shard_map).
+    with_aux: stage_fn returns (y, aux_scalar); result is (y_mb, aux [m]).
     """
     mesh = mesh or get_mesh()
     body = stage_fn
     if remat:
         body = jax.checkpoint(stage_fn)
     piped = spmd_pipeline(body, n_stages, n_microbatches,
-                          interleave=interleave)
+                          interleave=interleave, with_aux=with_aux)
     if interleave > 1:
         # natural chunk order -> device-major round-robin placement
         v, p = interleave, n_stages
@@ -157,7 +205,7 @@ def pipeline_forward(stage_fn, stacked_params, x_mb, n_stages,
     sm = jax.shard_map(
         piped, mesh=mesh,
         in_specs=(param_specs, P()),
-        out_specs=P(),
+        out_specs=(P(), P()) if with_aux else P(),
         axis_names={"pp"},
         check_vma=True)
     return sm(stacked_params, x_mb)

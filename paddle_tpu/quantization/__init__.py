@@ -42,10 +42,13 @@ def _fake_qdq(x, scale, bits):
 
 
 def quant_dequant(x, scale, bits=8):
-    """Functional fake-quant (reference quanters/abs_max.py forward)."""
+    """Functional fake-quant (reference quanters/abs_max.py forward).
+    `scale` enters as a TRACED array, not a baked literal: QAT updates it
+    every step, and a literal would mint a fresh jit cache entry (a full
+    recompile) per step."""
     if isinstance(scale, Tensor):
-        scale = float(scale.numpy())
-    return _fake_qdq(x, float(scale), int(bits))
+        scale = scale._value
+    return _fake_qdq(x, jnp.asarray(scale, jnp.float32), int(bits))
 
 
 class AbsmaxObserver:
@@ -108,16 +111,29 @@ class QuantConfig:
         return isinstance(layer, tuple(types))
 
 
+def _is_traced(x):
+    import jax as _jax
+    v = x._value if isinstance(x, Tensor) else x
+    return isinstance(v, _jax.core.Tracer)
+
+
 class FakeQuant(Layer):
-    """QAT fake-quant node with a learned-by-observation scale."""
+    """QAT fake-quant node with a learned-by-observation scale.
+
+    Observation runs when training (QAT) or when `calibrating` (PTQ — a
+    dedicated flag so calibration doesn't need train() mode, which would
+    fire Dropout / update BN stats). Under a jit/to_static trace the
+    observation is skipped (host-side stat; scales are frozen inside
+    compiled graphs) instead of crashing on a tracer."""
 
     def __init__(self, quant_bits=8, momentum=0.9):
         super().__init__()
         self.quant_bits = quant_bits
+        self.calibrating = False
         self.observer = MovingAverageObserver(quant_bits, momentum)
 
     def forward(self, x):
-        if self.training:
+        if (self.training or self.calibrating) and not _is_traced(x):
             self.observer.observe(x)
         return quant_dequant(x, self.observer.scale(), self.quant_bits)
 
@@ -132,10 +148,13 @@ class QuantedLinear(Layer):
         self.act_quant = FakeQuant(config.quant_bits)
         self.w_observer = config.weight_factory(config.quant_bits)
         self.quant_bits = config.quant_bits
+        self.calibrating = False
 
     def forward(self, x):
         x = self.act_quant(x)
-        self.w_observer.observe(self.linear.weight)
+        if (self.training or self.calibrating) and not _is_traced(
+                self.linear.weight):
+            self.w_observer.observe(self.linear.weight)
         w = quant_dequant(self.linear.weight, self.w_observer.scale(),
                           self.quant_bits)
         from ..nn import functional as F
@@ -175,16 +194,25 @@ class QAT:
 class PTQ:
     """Post-training quantization driver (reference ptq.py PTQ):
     `quantize(model)` inserts observers, run calibration data through the
-    model, then `convert(model)` freezes scales into fake-quant."""
+    model, then `convert(model)` freezes scales into fake-quant. Uses the
+    dedicated `calibrating` flag — NOT train() mode — so Dropout stays off
+    and BatchNorm running stats are untouched during calibration."""
 
     def __init__(self, config: Optional[QuantConfig] = None):
         self.config = config or QuantConfig()
 
+    @staticmethod
+    def _set_calibrating(model: Layer, flag: bool):
+        for layer in model.sublayers(include_self=True):
+            if isinstance(layer, (FakeQuant, QuantedLinear)):
+                layer.calibrating = flag
+
     def quantize(self, model: Layer, inplace=True) -> Layer:
         _swap_layers(model, self.config)
-        model.train()          # observers update during calibration
+        model.eval()
+        self._set_calibrating(model, True)
         return model
 
     def convert(self, model: Layer, inplace=True) -> Layer:
-        model.eval()           # freeze: observers stop updating
+        self._set_calibrating(model, False)   # freeze scales
         return model

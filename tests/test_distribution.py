@@ -144,6 +144,26 @@ class TestEntropyKL:
         same = float(_np(kl_divergence(p, p)))
         np.testing.assert_allclose(same, 0.0, atol=1e-6)
 
+    def test_kl_most_specific_rule_wins(self):
+        """A rule registered for a subclass beats the base-class rule
+        regardless of registration order."""
+        class MyNormal(Normal):
+            pass
+
+        @register_kl(MyNormal, MyNormal)
+        def _kl_mine(p, q):
+            return "specific"
+
+        try:
+            assert kl_divergence(MyNormal(0.0, 1.0),
+                                 MyNormal(0.0, 1.0)) == "specific"
+            # base pair still uses the generic rule
+            v = kl_divergence(Normal(0.0, 1.0), Normal(0.0, 1.0))
+            assert float(np.asarray(v.numpy())) == pytest.approx(0.0)
+        finally:
+            from paddle_tpu.distribution import _KL_REGISTRY
+            _KL_REGISTRY.pop((MyNormal, MyNormal), None)
+
     def test_register_kl_custom(self):
         class A(Distribution): ...
 

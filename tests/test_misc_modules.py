@@ -84,15 +84,49 @@ class TestQuantization:
 
     def test_ptq_calibrate_convert(self):
         import paddle_tpu.nn as nn
-        from paddle_tpu.quantization import PTQ
-        net = nn.Sequential(nn.Linear(4, 4))
+        from paddle_tpu.quantization import PTQ, FakeQuant
+        # Dropout in the net: calibration must NOT run in train mode
+        net = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5))
         ptq = PTQ()
         ptq.quantize(net)
+        assert not net.training                # eval mode during calib
         for _ in range(3):
             net(paddle.to_tensor(np.random.RandomState(0)
                                  .randn(2, 4).astype(np.float32)))
+        fq = [l for l in net.sublayers() if isinstance(l, FakeQuant)][0]
+        scale_after_calib = fq.observer.scale()
+        assert scale_after_calib != 1.0        # observers did run
         ptq.convert(net)
-        assert not net.training
+        net(paddle.to_tensor(100 * np.ones((1, 4), np.float32)))
+        assert fq.observer.scale() == scale_after_calib   # frozen
+
+    def test_qat_scale_update_does_not_recompile(self):
+        """QAT changes the scale every step; the fake-quant op must pass
+        it as a traced value, not bake it into the jit cache key."""
+        from paddle_tpu.framework.dispatch import _JIT_CACHE
+        from paddle_tpu.quantization import quant_dequant
+        x = paddle.to_tensor(np.ones(4, np.float32))
+        quant_dequant(x, 0.5)
+        before = len(_JIT_CACHE)
+        for s in (0.6, 0.7, 0.8, 0.9):
+            quant_dequant(x, s)
+        assert len(_JIT_CACHE) == before       # no per-scale cache entries
+
+    def test_qat_under_to_static_trace(self):
+        """Fake-quant compiles into the graph; observation is skipped
+        under the trace instead of crashing on a tracer."""
+        import paddle_tpu.nn as nn
+        from paddle_tpu.quantization import QAT
+        net = nn.Sequential(nn.Linear(4, 4))
+        QAT().quantize(net)
+        net.eval()
+
+        @paddle.jit.to_static
+        def f(x):
+            return net(x)
+
+        out = f(paddle.to_tensor(np.ones((2, 4), np.float32)))
+        assert list(out.shape) == [2, 4]
 
 
 class TestGeometric:

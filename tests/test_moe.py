@@ -85,21 +85,72 @@ def test_switch_router_gets_task_gradient():
     assert float(jnp.abs(g).max()) > 0
 
 
-def test_gpt_moe_pipeline_aux_guard():
-    """MoE + pipeline with a nonzero aux weight is an explicit error (aux
-    is not accumulated under the pipelined path)."""
-    from paddle_tpu.models.gpt import GPTConfig, init_gpt_params, gpt_loss
+def test_gpt_moe_pipeline_aux_parity():
+    """MoE aux loss circulates with the activations under pipeline
+    parallelism: pipelined loss == CE(full batch) + w * mean of the
+    per-microbatch aux computed by the NON-pipelined path (VERDICT r2
+    weak #3 acceptance)."""
+    import functools
+    from paddle_tpu.models.gpt import (GPTConfig, init_gpt_params,
+                                       shard_gpt_params, gpt_loss,
+                                       _gpt_forward_impl)
+    from paddle_tpu.parallel.mesh import build_mesh, use_mesh
+    base = dict(vocab_size=64, hidden_size=16, num_layers=4,
+                num_heads=2, ffn_hidden=32, max_seq_len=16,
+                sequence_parallel=False, remat=False, num_experts=2,
+                moe_gate="switch", moe_aux_weight=0.05, dtype=jnp.float32)
+    cfg_nopp = GPTConfig(**base)
+    cfg_pp = GPTConfig(**base, pipeline_microbatches=2)
+    params = init_gpt_params(cfg_nopp, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 9), 0, 64)
+
+    # reference: CE on the full batch + w * mean over microbatches of the
+    # non-pipelined per-microbatch aux (what the ring accumulates)
+    inp, tgt = tokens[:, :-1], tokens[:, 1:]
+    logits, _ = _gpt_forward_impl(params, inp, cfg_nopp)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+    ce = -float(jnp.mean(jnp.take_along_axis(
+        logp, tgt[..., None].astype(jnp.int32), -1)))
+    auxes = [float(_gpt_forward_impl(params, inp[i:i + 2], cfg_nopp)[1])
+             for i in (0, 2)]
+    want = ce + 0.05 * np.mean(auxes)
+
+    mesh = build_mesh({"pp": 2, "ep": 2})
+    with use_mesh(mesh):
+        sp = shard_gpt_params(params, mesh)
+        got = float(jax.jit(functools.partial(gpt_loss, cfg=cfg_pp))(
+            sp, tokens))
+    assert abs(got - want) < 1e-4, (got, want)
+    assert np.mean(auxes) > 0          # the aux actually contributes
+
+
+def test_gpt_moe_pipeline_trains():
+    """num_experts>0 ∧ pp>1 trains instead of erroring: 5 steps on a fixed
+    batch, loss decreases, router weights receive gradient."""
+    import functools
+    from paddle_tpu.models.gpt import (GPTConfig, init_gpt_params,
+                                       shard_gpt_params, init_opt_state,
+                                       train_step)
     from paddle_tpu.parallel.mesh import build_mesh, use_mesh
     cfg = GPTConfig(vocab_size=64, hidden_size=16, num_layers=4,
                     num_heads=2, ffn_hidden=32, max_seq_len=16,
-                    sequence_parallel=False, remat=False, num_experts=2,
-                    dtype=jnp.float32, pipeline_microbatches=2)
-    params = init_gpt_params(cfg, jax.random.PRNGKey(0))
-    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 9), 0, 64)
-    mesh = build_mesh({"pp": 2, "ep": 2})
+                    sequence_parallel=False, remat=True, num_experts=2,
+                    moe_aux_weight=0.01, dtype=jnp.float32,
+                    pipeline_microbatches=2)
+    mesh = build_mesh({"pp": 2, "ep": 2, "dp": 2})
     with use_mesh(mesh):
-        with pytest.raises(ValueError, match="moe_aux_weight"):
-            gpt_loss(params, tokens, cfg)
+        params = shard_gpt_params(init_gpt_params(cfg, jax.random.PRNGKey(0)),
+                                  mesh)
+        g0 = np.asarray(params["gate_w"])
+        opt = init_opt_state(params)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 9), 0, 64)
+        step = jax.jit(functools.partial(train_step, cfg=cfg, lr=1e-2))
+        losses = []
+        for _ in range(5):
+            loss, params, opt = step(params, opt, tokens)
+            losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+    assert not np.allclose(np.asarray(params["gate_w"]), g0)  # router moved
 
 
 def test_topk_gating_capacity_drops():
