@@ -30,7 +30,7 @@ def cpu_pin_env(n_devices: int, base_env=None) -> dict:
     return env
 
 
-def pin_cpu(n_devices: int = 1) -> bool:
+def pin_cpu(n_devices: int = 1, verify: bool = True) -> bool:
     """Pin this process to the CPU platform with >= n_devices virtual
     devices. Must run before any jax backend initializes; returns True when
     the pin took effect. On failure every env/config mutation is rolled
@@ -61,6 +61,11 @@ def pin_cpu(n_devices: int = 1) -> bool:
 
     try:
         jax.config.update("jax_platforms", "cpu")
+        if not verify:
+            # verification initializes the backend — callers that must run
+            # jax.distributed.initialize afterwards (launch workers) pin
+            # blind and let distributed init be the first backend touch
+            return True
         devs = jax.devices()
     except Exception:
         _rollback()

@@ -1,0 +1,8 @@
+"""python -m paddle_tpu.distributed.launch — the multi-host job launcher.
+
+Reference analog: python/paddle/distributed/launch/main.py:18 with the
+collective controller (launch/controllers/collective.py), pod/job model
+(launch/job/), master rendezvous and elastic restart
+(fleet/elastic/manager.py:124).
+"""
+from .main import main, launch  # noqa: F401

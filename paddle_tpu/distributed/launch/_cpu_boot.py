@@ -1,0 +1,23 @@
+"""Worker bootstrap for `launch --devices cpu`.
+
+The environment trap (see device.pin_cpu): a TPU PJRT plugin can override
+the JAX_PLATFORMS env var, so pinning the CPU platform must ALSO go through
+the jax config API inside the worker process — an env block alone leaves
+workers opening the TPU backend. This runner pins, then executes the user
+script as __main__.
+"""
+import os
+import runpy
+import sys
+
+from paddle_tpu.device import pin_cpu
+
+n = int(os.environ.get("PADDLE_LAUNCH_CPU_DEVICES", "1"))
+# verify=False: verification would initialize the backend, which must not
+# happen before the worker's jax.distributed.initialize
+if not pin_cpu(n, verify=False):
+    print("[launch] could not pin the CPU platform", file=sys.stderr)
+    sys.exit(17)
+
+sys.argv = sys.argv[1:]
+runpy.run_path(sys.argv[0], run_name="__main__")

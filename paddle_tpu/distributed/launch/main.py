@@ -1,0 +1,176 @@
+"""Launcher CLI (reference launch/main.py:18 + controllers/collective.py).
+
+TPU-native process model: one process per HOST (JAX single-controller),
+not one per accelerator — a v5p-16 pod slice with 4 hosts is
+`--nnodes 4`, each host process sees its 4 local chips and
+`jax.distributed.initialize` federates them. The launcher:
+
+- on a single node (`--nnodes 1`, the default) can still spawn N local
+  processes with a virtual CPU mesh for testing multi-process rendezvous
+  (`--nproc_per_node N --devices cpu`) — the reference's
+  single-node-multi-proc dev loop;
+- exports the PADDLE_* env contract consumed by parallel/env.py
+  (PADDLE_TRAINER_ID, PADDLE_TRAINERS_NUM, PADDLE_MASTER), mirroring the
+  reference's env contract;
+- elastic-lite: `--max_restart K` watches children and restarts the whole
+  local pod up to K times when any worker exits nonzero (the reference
+  ElasticManager's restart loop, minus etcd — the coordination service
+  owns membership).
+
+Usage:
+  python -m paddle_tpu.distributed.launch --nnodes 2 --node_rank 0 \
+      --master 10.0.0.1:12355 train.py --my-args ...
+  python -m paddle_tpu.distributed.launch --nproc_per_node 2 \
+      --devices cpu smoke.py
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="multi-host launcher (reference launch/main.py)")
+    p.add_argument("--nnodes", type=int, default=int(
+        os.environ.get("PADDLE_NNODES", "1")),
+        help="number of hosts in the job")
+    p.add_argument("--node_rank", type=int, default=int(
+        os.environ.get("PADDLE_NODE_RANK", "0")),
+        help="this host's rank [0, nnodes)")
+    p.add_argument("--master", default=os.environ.get(
+        "PADDLE_MASTER", "127.0.0.1:12355"),
+        help="coordinator address host:port (rank-0 host)")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="local worker processes (1 for TPU hosts; >1 only "
+                        "for CPU-mesh testing)")
+    p.add_argument("--devices", default=None,
+                   help="'cpu' forces the CPU platform with a virtual "
+                        "device count per proc (testing)")
+    p.add_argument("--cpus_per_proc", type=int, default=1,
+                   help="virtual CPU devices per process when "
+                        "--devices cpu")
+    p.add_argument("--max_restart", type=int, default=0,
+                   help="elastic-lite: restart the local pod up to K "
+                        "times on worker failure")
+    p.add_argument("--log_dir", default=None,
+                   help="write per-worker logs under this dir")
+    p.add_argument("--run_mode", default="collective",
+                   help="collective (the only mode; ps is descoped)")
+    p.add_argument("training_script", help="entry script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _worker_env(args, local_rank: int) -> dict:
+    """The PADDLE_* env contract (reference launch/controllers/collective.py
+    builds the same block per worker)."""
+    nprocs = args.nnodes * args.nproc_per_node
+    rank = args.node_rank * args.nproc_per_node + local_rank
+    env = dict(os.environ)
+    host, port = args.master.rsplit(":", 1)
+    env.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(nprocs),
+        "PADDLE_MASTER": args.master,
+        "PADDLE_LOCAL_RANK": str(local_rank),
+        "PADDLE_NNODES": str(args.nnodes),
+        "PADDLE_NODE_RANK": str(args.node_rank),
+        # torch-style aliases (env.py accepts both)
+        "RANK": str(rank),
+        "WORLD_SIZE": str(nprocs),
+        "MASTER_ADDR": host,
+        "MASTER_PORT": port,
+    })
+    if args.devices == "cpu":
+        from ...device import cpu_pin_env
+        env = cpu_pin_env(args.cpus_per_proc, base_env=env)
+        env["PADDLE_LAUNCH_CPU_DEVICES"] = str(args.cpus_per_proc)
+    return env
+
+
+def _spawn(args) -> List[subprocess.Popen]:
+    procs = []
+    for lr in range(args.nproc_per_node):
+        out = None
+        if args.log_dir:
+            os.makedirs(args.log_dir, exist_ok=True)
+            out = open(os.path.join(
+                args.log_dir,
+                f"worker.{args.node_rank}.{lr}.log"), "ab")
+        if args.devices == "cpu":
+            # route through the pin-then-run bootstrap: a TPU PJRT plugin
+            # can override JAX_PLATFORMS, so the CPU pin must happen
+            # in-process (see _cpu_boot / device.pin_cpu)
+            cmd = [sys.executable, "-m",
+                   "paddle_tpu.distributed.launch._cpu_boot",
+                   args.training_script, *args.training_script_args]
+        else:
+            cmd = [sys.executable, args.training_script,
+                   *args.training_script_args]
+        procs.append(subprocess.Popen(
+            cmd, env=_worker_env(args, lr), stdout=out,
+            stderr=subprocess.STDOUT if out else None))
+    return procs
+
+
+def _wait(procs: List[subprocess.Popen]) -> int:
+    """Wait for all workers; on first nonzero exit, kill the rest and
+    return that code (the collective controller's fail-fast)."""
+    try:
+        while procs:
+            for pr in list(procs):
+                rc = pr.poll()
+                if rc is None:
+                    continue
+                procs.remove(pr)
+                if rc != 0:
+                    for other in procs:
+                        other.send_signal(signal.SIGTERM)
+                    for other in procs:
+                        try:
+                            other.wait(timeout=10)
+                        except subprocess.TimeoutExpired:
+                            other.kill()
+                    return rc
+            time.sleep(0.2)
+        return 0
+    except KeyboardInterrupt:
+        for pr in procs:
+            pr.send_signal(signal.SIGTERM)
+        return 130
+
+
+def launch(argv: Optional[List[str]] = None) -> int:
+    """Programmatic entry (returns the job's exit code)."""
+    args = _parse_args(argv)
+    attempt = 0
+    while True:
+        if attempt:
+            print(f"[launch] elastic restart {attempt}/{args.max_restart}",
+                  file=sys.stderr, flush=True)
+        rc = _wait(_spawn(args))
+        if rc == 0:
+            return 0
+        if rc == 130:
+            # user interrupt is not a worker failure — never restart it
+            return rc
+        if attempt >= args.max_restart:
+            print(f"[launch] workers failed (rc={rc}); restarts exhausted",
+                  file=sys.stderr, flush=True)
+            return rc
+        attempt += 1
+
+
+def main():
+    sys.exit(launch())
+
+
+if __name__ == "__main__":
+    main()

@@ -28,15 +28,24 @@ def init_parallel_env(strategy=None):
         return
     n = int(os.environ.get("PADDLE_TRAINERS_NUM",
                            os.environ.get("WORLD_SIZE", "1")))
-    if n > 1 and jax.process_count() == 1:
+    if n > 1:
+        # IMPORTANT: do NOT touch jax.process_count()/devices() here — any
+        # backend query initializes the runtime, after which distributed
+        # init can no longer federate the processes
         rank = int(os.environ.get("PADDLE_TRAINER_ID",
                                   os.environ.get("RANK", "0")))
         coord = os.environ.get(
             "PADDLE_MASTER",
             os.environ.get("MASTER_ADDR", "127.0.0.1") + ":" +
             os.environ.get("MASTER_PORT", "12355"))
-        jax.distributed.initialize(coordinator_address=coord,
-                                   num_processes=n, process_id=rank)
+        try:
+            jax.distributed.initialize(coordinator_address=coord,
+                                       num_processes=n, process_id=rank)
+        except RuntimeError as e:
+            # jax 0.9: "distributed.initialize should only be called once."
+            msg = str(e).lower()
+            if "once" not in msg and "already" not in msg:
+                raise
     _initialized = True
 
 

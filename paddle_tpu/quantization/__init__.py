@@ -131,11 +131,18 @@ class FakeQuant(Layer):
         self.quant_bits = quant_bits
         self.calibrating = False
         self.observer = MovingAverageObserver(quant_bits, momentum)
+        # the learned scale is a persisted buffer: it round-trips through
+        # state_dict so a reloaded quantized model serves with the
+        # calibrated scale (observers are host-side stats, not saved)
+        self.register_buffer("scale",
+                             Tensor(jnp.ones((), jnp.float32)))
 
     def forward(self, x):
         if (self.training or self.calibrating) and not _is_traced(x):
             self.observer.observe(x)
-        return quant_dequant(x, self.observer.scale(), self.quant_bits)
+            self.scale._value = jnp.asarray(self.observer.scale(),
+                                            jnp.float32)
+        return quant_dequant(x, self.scale, self.quant_bits)
 
 
 class QuantedLinear(Layer):
@@ -149,13 +156,17 @@ class QuantedLinear(Layer):
         self.w_observer = config.weight_factory(config.quant_bits)
         self.quant_bits = config.quant_bits
         self.calibrating = False
+        self.register_buffer("w_scale",
+                             Tensor(jnp.ones((), jnp.float32)))
 
     def forward(self, x):
         x = self.act_quant(x)
         if (self.training or self.calibrating) and not _is_traced(
                 self.linear.weight):
             self.w_observer.observe(self.linear.weight)
-        w = quant_dequant(self.linear.weight, self.w_observer.scale(),
+            self.w_scale._value = jnp.asarray(self.w_observer.scale(),
+                                              jnp.float32)
+        w = quant_dequant(self.linear.weight, self.w_scale,
                           self.quant_bits)
         from ..nn import functional as F
         return F.linear(x, w, self.linear.bias)

@@ -1,0 +1,124 @@
+"""Launch CLI + elastic-lite tests.
+
+Reference analog: launch/main.py:18 test style — spawn real worker
+processes on localhost with the env contract, assert rendezvous and
+restart behavior. Uses --devices cpu (virtual CPU platform), the
+TPU-world analog of the reference's CUDA_VISIBLE_DEVICES splitting
+(SURVEY §4).
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    """Fresh port per run: a stale coordinator from a crashed previous run
+    on a fixed port would wedge the rendezvous."""
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run_launch(tmp_path, script_body, extra_args, timeout=240):
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(script_body))
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           *extra_args, str(script)]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # the workers must not inherit this test process's TPU/axon backend
+    env.pop("JAX_PLATFORMS", None)
+    return subprocess.run(cmd, cwd=REPO, env=env, timeout=timeout,
+                          stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+
+class TestLaunchCLI:
+    def test_env_contract_single_proc(self, tmp_path):
+        res = _run_launch(tmp_path, """
+            import os
+            assert os.environ["PADDLE_TRAINER_ID"] == "0"
+            assert os.environ["PADDLE_TRAINERS_NUM"] == "1"
+            assert os.environ["PADDLE_MASTER"] == "127.0.0.1:23471"
+            print("ENV_OK")
+        """, ["--master", "127.0.0.1:23471", "--devices", "cpu"])
+        assert res.returncode == 0, res.stdout.decode()
+        assert b"ENV_OK" in res.stdout
+
+    def test_two_process_cpu_rendezvous(self, tmp_path):
+        """The VERDICT acceptance case: two processes rendezvous through
+        jax.distributed.initialize on localhost and run a psum."""
+        res = _run_launch(tmp_path, """
+            import os
+            import paddle_tpu.distributed as dist
+            dist.init_parallel_env()
+            import jax, jax.numpy as jnp
+            assert jax.process_count() == 2, jax.process_count()
+            rank = dist.get_rank()
+            # cross-process collective over the global cpu mesh
+            n = jax.device_count()
+            assert n == 2  # 1 cpu device per proc, federated
+            mesh = jax.sharding.Mesh(jax.devices(), ("dp",))
+            val = jax.make_array_from_callback(
+                (2,), jax.sharding.NamedSharding(
+                    mesh, jax.sharding.PartitionSpec("dp")),
+                lambda idx: jnp.asarray(
+                    [float(jax.process_index() + 1)]))
+            total = jax.jit(
+                lambda v: jax.numpy.sum(v),
+                out_shardings=jax.sharding.NamedSharding(
+                    mesh, jax.sharding.PartitionSpec()))(val)
+            # float() would need the FULLY addressable array; read the
+            # local replica instead (multi-process idiom)
+            got = float(total.addressable_shards[0].data)
+            assert got == 3.0, got
+            print(f"RANK{rank}_OK")
+        """, ["--nproc_per_node", "2", "--devices", "cpu",
+              "--master", f"127.0.0.1:{_free_port()}"])
+        out = res.stdout.decode()
+        assert res.returncode == 0, out
+        assert "RANK0_OK" in out and "RANK1_OK" in out
+
+    def test_failfast_kills_peers(self, tmp_path):
+        res = _run_launch(tmp_path, """
+            import os, sys, time
+            if os.environ["PADDLE_LOCAL_RANK"] == "1":
+                sys.exit(3)
+            time.sleep(60)   # would hang without fail-fast
+        """, ["--nproc_per_node", "2", "--devices", "cpu"], timeout=60)
+        assert res.returncode == 3
+
+    def test_elastic_restart_recovers(self, tmp_path):
+        """elastic-lite: worker fails once, the relaunch succeeds."""
+        marker = tmp_path / "attempted"
+        res = _run_launch(tmp_path, f"""
+            import os, sys
+            marker = {str(marker)!r}
+            if not os.path.exists(marker):
+                open(marker, "w").write("x")
+                sys.exit(1)          # first attempt dies
+            print("RECOVERED")
+        """, ["--devices", "cpu", "--max_restart", "2"])
+        out = res.stdout.decode()
+        assert res.returncode == 0, out
+        assert "RECOVERED" in out
+
+    def test_restarts_exhausted(self, tmp_path):
+        res = _run_launch(tmp_path, """
+            import sys
+            sys.exit(7)
+        """, ["--devices", "cpu", "--max_restart", "1"])
+        assert res.returncode == 7
+
+    def test_log_dir(self, tmp_path):
+        res = _run_launch(tmp_path, """
+            print("HELLO_LOG")
+        """, ["--devices", "cpu", "--log_dir", str(tmp_path / "logs")])
+        assert res.returncode == 0
+        log = (tmp_path / "logs" / "worker.0.0.log").read_text()
+        assert "HELLO_LOG" in log

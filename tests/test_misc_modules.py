@@ -112,6 +112,33 @@ class TestQuantization:
             quant_dequant(x, s)
         assert len(_JIT_CACHE) == before       # no per-scale cache entries
 
+    def test_quantized_model_scale_survives_save_load(self):
+        """Calibrated scales are buffers: a reloaded quantized model
+        serves with them in eval mode (no observer re-run needed)."""
+        import paddle_tpu.nn as nn
+        from paddle_tpu.quantization import QAT, QuantedLinear
+        net = nn.Sequential(nn.Linear(4, 4))
+        QAT().quantize(net)
+        net.train()
+        x = paddle.to_tensor(7 * np.random.RandomState(0)
+                             .randn(8, 4).astype(np.float32))
+        net(x)                                      # observe scales
+        sd = {k: v.numpy().copy() for k, v in net.state_dict().items()}
+        ql = [l for l in net.sublayers()
+              if isinstance(l, QuantedLinear)][0]
+        want_scale = float(ql.w_scale.numpy())
+        assert want_scale != 1.0
+        out_ref = net.eval()(x).numpy()
+
+        net2 = nn.Sequential(nn.Linear(4, 4))
+        QAT().quantize(net2)
+        net2.set_state_dict(sd)
+        net2.eval()
+        ql2 = [l for l in net2.sublayers()
+               if isinstance(l, QuantedLinear)][0]
+        assert float(ql2.w_scale.numpy()) == want_scale
+        np.testing.assert_allclose(net2(x).numpy(), out_ref, rtol=1e-6)
+
     def test_qat_under_to_static_trace(self):
         """Fake-quant compiles into the graph; observation is skipped
         under the trace instead of crashing on a tracer."""
