@@ -32,10 +32,20 @@ def _log(msg: str) -> None:
 
 # ---------------------------------------------------------------- configs
 # name -> (model kwargs, batch, seq, iters, timeout_s)
+# Remat/backward choices follow the round-3 sweep evidence
+# (tools/sweep_gpt_step.py, BASELINE.md): remat=False OOMs at B=8
+# (18.3G > 15.75G HBM); the hand-tiled Pallas flash BACKWARD measured
+# slower than the jax-level recompute backward (517 vs 439 ms/step), so
+# the bench keeps the Pallas forward + jax backward and selective "dots"
+# remat. B=16 is tried first (more tokens/step amortize the update).
 LADDER = [
+    ("tpu-b16", dict(vocab_size=32768, hidden_size=1024, num_layers=24,
+                     num_heads=16, max_seq_len=1024, remat=True,
+                     remat_policy="dots", dtype="bfloat16"),
+     16, 1024, 10, 1500),
     ("tpu", dict(vocab_size=32768, hidden_size=1024, num_layers=24,
                  num_heads=16, max_seq_len=1024, remat=True,
-                 dtype="bfloat16"), 8, 1024, 10, 1500),
+                 remat_policy="dots", dtype="bfloat16"), 8, 1024, 10, 1500),
     ("tpu-small", dict(vocab_size=8192, hidden_size=512, num_layers=8,
                        num_heads=8, max_seq_len=512, remat=False,
                        dtype="bfloat16"), 4, 512, 10, 600),
@@ -91,6 +101,11 @@ def run_measurement(rung: str) -> None:
     """Run one ladder rung and print the JSON line to stdout."""
     name, kw, batch, seq, iters, _ = next(c for c in LADDER if c[0] == rung)
     want_tpu = name.startswith("tpu")
+
+    # sweep verdict: jax-level flash backward beats the Pallas backward on
+    # this config; opt back in with PADDLE_TPU_ENABLE_PALLAS_BWD=1
+    if want_tpu and os.environ.get("PADDLE_TPU_ENABLE_PALLAS_BWD") != "1":
+        os.environ.setdefault("PADDLE_TPU_DISABLE_PALLAS_BWD", "1")
 
     import jax
     import jax.numpy as jnp

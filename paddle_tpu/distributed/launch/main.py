@@ -104,25 +104,47 @@ def _spawn(args) -> List[subprocess.Popen]:
             out = open(os.path.join(
                 args.log_dir,
                 f"worker.{args.node_rank}.{lr}.log"), "ab")
-        if args.devices == "cpu":
-            # route through the pin-then-run bootstrap: a TPU PJRT plugin
-            # can override JAX_PLATFORMS, so the CPU pin must happen
-            # in-process (see _cpu_boot / device.pin_cpu)
-            cmd = [sys.executable, "-m",
-                   "paddle_tpu.distributed.launch._cpu_boot",
-                   args.training_script, *args.training_script_args]
-        else:
-            cmd = [sys.executable, args.training_script,
-                   *args.training_script_args]
-        procs.append(subprocess.Popen(
-            cmd, env=_worker_env(args, lr), stdout=out,
-            stderr=subprocess.STDOUT if out else None))
+        try:
+            procs.append(_popen(args, lr, out))
+        finally:
+            if out is not None:
+                out.close()          # the child inherited the fd
     return procs
 
 
-def _wait(procs: List[subprocess.Popen]) -> int:
+def _popen(args, lr, out):
+    if args.devices == "cpu":
+        # route through the pin-then-run bootstrap: a TPU PJRT plugin
+        # can override JAX_PLATFORMS, so the CPU pin must happen
+        # in-process (see _cpu_boot / device.pin_cpu)
+        cmd = [sys.executable, "-m",
+               "paddle_tpu.distributed.launch._cpu_boot",
+               args.training_script, *args.training_script_args]
+    else:
+        cmd = [sys.executable, args.training_script,
+               *args.training_script_args]
+    return subprocess.Popen(
+        cmd, env=_worker_env(args, lr), stdout=out,
+        stderr=subprocess.STDOUT if out else None)
+
+
+def _terminate(procs: List[subprocess.Popen]):
+    """SIGTERM then escalate to SIGKILL: a worker wedged in backend init
+    can mask/ignore SIGTERM and would otherwise orphan, holding the
+    coordinator port."""
+    for pr in procs:
+        pr.send_signal(signal.SIGTERM)
+    for pr in procs:
+        try:
+            pr.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pr.kill()
+
+
+def _wait(procs: List[subprocess.Popen]) -> Optional[int]:
     """Wait for all workers; on first nonzero exit, kill the rest and
-    return that code (the collective controller's fail-fast)."""
+    return that code (the collective controller's fail-fast). Returns
+    None on KeyboardInterrupt — distinct from any worker exit code."""
     try:
         while procs:
             for pr in list(procs):
@@ -131,20 +153,13 @@ def _wait(procs: List[subprocess.Popen]) -> int:
                     continue
                 procs.remove(pr)
                 if rc != 0:
-                    for other in procs:
-                        other.send_signal(signal.SIGTERM)
-                    for other in procs:
-                        try:
-                            other.wait(timeout=10)
-                        except subprocess.TimeoutExpired:
-                            other.kill()
+                    _terminate(procs)
                     return rc
             time.sleep(0.2)
         return 0
     except KeyboardInterrupt:
-        for pr in procs:
-            pr.send_signal(signal.SIGTERM)
-        return 130
+        _terminate(procs)
+        return None
 
 
 def launch(argv: Optional[List[str]] = None) -> int:
@@ -158,9 +173,10 @@ def launch(argv: Optional[List[str]] = None) -> int:
         rc = _wait(_spawn(args))
         if rc == 0:
             return 0
-        if rc == 130:
-            # user interrupt is not a worker failure — never restart it
-            return rc
+        if rc is None:
+            # launcher-level interrupt is not a worker failure — never
+            # restart it (a worker's own exit 130 still restarts)
+            return 130
         if attempt >= args.max_restart:
             print(f"[launch] workers failed (rc={rc}); restarts exhausted",
                   file=sys.stderr, flush=True)

@@ -241,7 +241,9 @@ def default_collate_fn(batch):
         return to_tensor(np.stack([s.numpy() for s in batch]))
     if isinstance(sample, (int, np.integer)):
         return to_tensor(np.asarray(batch, np.int64))
-    if isinstance(sample, float):
+    if isinstance(sample, (float, np.floating)):
+        # np.float32 scalars are NOT python floats — without this branch a
+        # float32-item dataset collated to a raw python list
         return to_tensor(np.asarray(batch, np.float32))
     if isinstance(sample, (list, tuple)):
         transposed = list(zip(*batch))

@@ -1,0 +1,165 @@
+"""Measure the BASELINE.md ladder rows beyond the headline GPT bench:
+MNIST-MLP steps/sec, BERT-base-ish jit tokens/sec, ResNet-50 images/sec.
+
+Each row runs in a subprocess under a timeout (tunnel resilience, like
+bench.py) and prints one JSON line; run on the TPU-attached host:
+    python tools/bench_ladder.py            # all rows
+    python tools/bench_ladder.py --run mnist
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROWS = ["mnist", "bert", "resnet50"]
+
+
+def _bench_loop(step, iters=10):
+    t0 = time.perf_counter()
+    out = step()
+    _force(out)
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = step()
+    _force(out)
+    return compile_s, (time.perf_counter() - t0) / iters
+
+
+def _force(out):
+    import jax
+    for leaf in jax.tree_util.tree_leaves(out):
+        if hasattr(leaf, "block_until_ready"):
+            float(leaf.ravel()[0])
+            break
+
+
+def run_row(row: str) -> None:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import jax
+    import jax.numpy as jnp
+    import functools
+    import numpy as np
+    devs = jax.devices()
+    platform = devs[0].platform
+
+    if row == "mnist":
+        # BASELINE config 1: MNIST MLP train step (784-512-512-10)
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(784, 512), nn.ReLU(),
+                            nn.Linear(512, 512), nn.ReLU(),
+                            nn.Linear(512, 10))
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=net.parameters())
+        loss_fn = nn.CrossEntropyLoss()
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(256, 784).astype(np.float32))
+        y = paddle.to_tensor(np.random.RandomState(1)
+                             .randint(0, 10, 256).astype(np.int64))
+
+        def step():
+            loss = loss_fn(net(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss._value
+        compile_s, dt = _bench_loop(step, iters=20)
+        print(json.dumps({"row": "mnist_mlp", "metric": "steps_per_sec",
+                          "value": round(1.0 / dt, 2),
+                          "batch": 256, "compile_s": round(compile_s, 1),
+                          "platform": platform}), flush=True)
+
+    elif row == "bert":
+        # BASELINE config 2: BERT-base-ish (12L, 768d, S=512) fwd+bwd via
+        # one jitted graph (the dygraph-to-static path)
+        from paddle_tpu.models.gpt import (GPTConfig, init_gpt_params,
+                                           init_opt_state, train_step)
+        cfg = GPTConfig(vocab_size=30522, hidden_size=768, num_layers=12,
+                        num_heads=12, max_seq_len=512,
+                        sequence_parallel=False, remat=False,
+                        dtype=jnp.bfloat16)
+        params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+        opt_state = init_opt_state(params)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (16, 513), 0,
+                                    cfg.vocab_size)
+        step = jax.jit(functools.partial(train_step, cfg=cfg, lr=1e-4),
+                       donate_argnums=(0, 1))
+
+        def run():
+            nonlocal params, opt_state
+            loss, params, opt_state = step(params, opt_state, tokens)
+            return loss
+        compile_s, dt = _bench_loop(run, iters=10)
+        tps = 16 * 512 / dt
+        n_params = sum(int(v.size) for v in params.values())
+        flops_per_tok = 6.0 * n_params + 12.0 * 12 * 768 * 512
+        peak = 197e12 if platform in ("tpu", "axon") else 1e12
+        print(json.dumps({"row": "bert_base_jit",
+                          "metric": "tokens_per_sec_per_chip",
+                          "value": round(tps, 1),
+                          "mfu": round(flops_per_tok * tps / peak, 4),
+                          "compile_s": round(compile_s, 1),
+                          "platform": platform}), flush=True)
+
+    elif row == "resnet50":
+        # BASELINE config 4: ResNet-50 fwd+bwd images/sec (functional core
+        # jitted in one graph)
+        import paddle_tpu as paddle
+        from paddle_tpu.vision.models import resnet50
+        paddle.seed(0)
+        net = resnet50(num_classes=1000)
+        import paddle_tpu.nn as nn
+        opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                        parameters=net.parameters())
+        loss_fn = nn.CrossEntropyLoss()
+        B = 64 if platform in ("tpu", "axon") else 4
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(B, 3, 224, 224).astype(np.float32))
+        y = paddle.to_tensor(np.random.RandomState(1)
+                             .randint(0, 1000, B).astype(np.int64))
+
+        def step():
+            loss = loss_fn(net(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss._value
+        compile_s, dt = _bench_loop(step, iters=5)
+        print(json.dumps({"row": "resnet50", "metric": "images_per_sec",
+                          "value": round(B / dt, 1), "batch": B,
+                          "compile_s": round(compile_s, 1),
+                          "platform": platform}), flush=True)
+
+
+def main():
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for row in ROWS:
+        print(f"[ladder] === {row} ===", file=sys.stderr, flush=True)
+        try:
+            res = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--run", row],
+                cwd=here, stdout=subprocess.PIPE, timeout=1500)
+        except subprocess.TimeoutExpired:
+            print(f"[ladder] {row}: TIMEOUT", file=sys.stderr, flush=True)
+            continue
+        out = res.stdout.decode().strip()
+        line = next((ln for ln in reversed(out.splitlines())
+                     if ln.startswith("{")), None)
+        if res.returncode == 0 and line:
+            print(line, flush=True)
+        else:
+            print(f"[ladder] {row}: FAILED rc={res.returncode}",
+                  file=sys.stderr, flush=True)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) >= 3 and sys.argv[1] == "--run":
+        run_row(sys.argv[2])
+    else:
+        main()
