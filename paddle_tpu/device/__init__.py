@@ -90,7 +90,13 @@ def set_device(device):
     elif device.startswith("cpu"):
         _current_device = CPUPlace()
     else:
-        raise ValueError(f"unknown device {device!r}")
+        dtype = device.split(":")[0]
+        if dtype in _CUSTOM_BACKENDS:
+            from ..framework.place import CustomPlace
+            idx = int(device.split(":")[1]) if ":" in device else 0
+            _current_device = CustomPlace(dtype, idx)
+        else:
+            raise ValueError(f"unknown device {device!r}")
     return _current_device
 
 
@@ -98,6 +104,9 @@ def get_device() -> str:
     place = _current_device or _default_place()
     if isinstance(place, CPUPlace):
         return "cpu"
+    from ..framework.place import CustomPlace
+    if isinstance(place, CustomPlace):
+        return f"{place.get_device_type()}:{place.get_device_id()}"
     return f"tpu:{place.get_device_id()}"
 
 
@@ -213,3 +222,44 @@ class cuda:
     @staticmethod
     def memory_allocated(device=None):
         return 0
+
+
+# ------------------------------------------------------- pluggable backends
+# Reference analog: phi::DeviceManager + DeviceInterface
+# (paddle/phi/backends/device_manager.h:128, device_base.h:26, and the
+# CustomPlace plugin seam). On TPU-era jax the hardware plugin mechanism IS
+# PJRT: a vendor ships a PJRT plugin package and jax discovers it. This
+# registry is the paddle-shaped seam over that: register the platform name
+# so paddle_tpu.set_device()/Place accept it, optionally pointing at a
+# PJRT plugin library to load.
+_CUSTOM_BACKENDS = {}
+
+
+def register_custom_device(device_type: str, pjrt_plugin_path=None,
+                           priority: int = 0):
+    """Register a custom hardware backend (reference DeviceManager::
+    Register). `device_type` must match the PJRT platform name; when
+    `pjrt_plugin_path` is given the plugin is registered with jax's
+    plugin loader so the platform becomes available."""
+    if pjrt_plugin_path is not None:
+        try:
+            from jax._src.xla_bridge import register_plugin
+        except ImportError as e:
+            raise NotImplementedError(
+                "this jax version does not expose a runtime PJRT plugin "
+                "registration hook; ship the plugin as a jax_plugins "
+                "entry-point package instead (jax's supported discovery "
+                "mechanism)") from e
+        register_plugin(device_type, library_path=str(pjrt_plugin_path))
+    _CUSTOM_BACKENDS[device_type] = {
+        "plugin": pjrt_plugin_path, "priority": priority}
+    return device_type
+
+
+def get_all_custom_device_type():
+    """Reference device_manager GetAllCustomDeviceTypes."""
+    return sorted(_CUSTOM_BACKENDS)
+
+
+def is_custom_device(device_type: str) -> bool:
+    return device_type in _CUSTOM_BACKENDS

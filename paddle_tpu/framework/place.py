@@ -77,6 +77,9 @@ class CustomPlace(Place):
         super().__init__(device_id)
         self._dev_type = dev_type
 
+    def get_device_type(self) -> str:
+        return self._dev_type
+
 
 def _default_place() -> Place:
     backend = jax.default_backend()

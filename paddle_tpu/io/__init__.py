@@ -241,9 +241,11 @@ def default_collate_fn(batch):
         return to_tensor(np.stack([s.numpy() for s in batch]))
     if isinstance(sample, (int, np.integer)):
         return to_tensor(np.asarray(batch, np.int64))
-    if isinstance(sample, (float, np.floating)):
-        # np.float32 scalars are NOT python floats — without this branch a
-        # float32-item dataset collated to a raw python list
+    if isinstance(sample, np.floating):
+        # np scalar items keep their precision (float64 targets stay f64);
+        # without this branch a float32-item dataset collated to a raw list
+        return to_tensor(np.asarray(batch, sample.dtype))
+    if isinstance(sample, float):
         return to_tensor(np.asarray(batch, np.float32))
     if isinstance(sample, (list, tuple)):
         transposed = list(zip(*batch))

@@ -1,0 +1,116 @@
+"""Kernel autotune: timed-candidate selection with a persistent cache.
+
+Reference analog: paddle/phi/kernels/autotune/ (cache.cc AlgorithmsCache +
+switch_autotune.cc — time each conv algo once per signature, cache the
+winner). TPU-native: the tunables are Pallas grid/block parameters; each
+candidate costs a compile, so tuning is opt-in
+(paddle_tpu.set_flags({'use_autotune': True}) or PADDLE_TPU_AUTOTUNE=1)
+and winners persist to a JSON cache keyed by (op, signature) so the
+compile cost is paid once per machine, not per process.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+_CACHE: Dict[str, Any] = {}
+_CACHE_PATH = os.environ.get(
+    "PADDLE_TPU_AUTOTUNE_CACHE",
+    os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                 "autotune.json"))
+_loaded = False
+_stats = {"hits": 0, "misses": 0, "tuned": 0}
+
+
+def enabled() -> bool:
+    if os.environ.get("PADDLE_TPU_AUTOTUNE", "") in ("1", "true", "True"):
+        return True
+    from ..framework.flags import flag
+    return bool(flag("use_autotune", False))
+
+
+def _load():
+    global _loaded
+    if _loaded:
+        return
+    _loaded = True
+    try:
+        with open(_CACHE_PATH) as f:
+            _CACHE.update(json.load(f))
+    except (OSError, ValueError):
+        pass
+
+
+def _persist():
+    try:
+        os.makedirs(os.path.dirname(_CACHE_PATH), exist_ok=True)
+        with open(_CACHE_PATH, "w") as f:
+            json.dump(_CACHE, f, indent=1)
+    except OSError:
+        pass
+
+
+def autotune_status() -> dict:
+    """Reference switch_autotune.cc status counters."""
+    return dict(_stats, cached=len(_CACHE), enabled=enabled())
+
+
+def clear_cache():
+    _CACHE.clear()
+    try:
+        os.remove(_CACHE_PATH)
+    except OSError:
+        pass
+
+
+def pick(op: str, signature: str, candidates: Sequence[Any],
+         runner: Callable[[Any], None], default: Any = None,
+         warmup: int = 1, iters: int = 3):
+    """Return the fastest candidate for (op, signature).
+
+    runner(candidate) must execute the kernel end-to-end (blocking). The
+    winner is cached in-process and on disk; when tuning is disabled the
+    cached winner (or `default`/first candidate) is returned without any
+    timing."""
+    _load()
+    key = f"{op}::{signature}"
+    if key in _CACHE:
+        _stats["hits"] += 1
+        cached = _CACHE[key]
+        # JSON round-trips tuples as lists
+        return tuple(cached) if isinstance(cached, list) else cached
+    if not enabled():
+        _stats["misses"] += 1
+        return default if default is not None else candidates[0]
+
+    best, best_t = None, float("inf")
+    for cand in candidates:
+        try:
+            for _ in range(warmup):
+                runner(cand)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                runner(cand)
+            dt = (time.perf_counter() - t0) / iters
+        except Exception:
+            continue                      # candidate invalid on this shape
+        if dt < best_t:
+            best, best_t = cand, dt
+    if best is None:
+        # nothing could be measured (e.g. transient backend failure):
+        # return the default WITHOUT caching, so a later healthy run
+        # re-tunes instead of freezing an unmeasured winner
+        return default if default is not None else candidates[0]
+    _CACHE[key] = list(best) if isinstance(best, tuple) else best
+    _stats["tuned"] += 1
+    _persist()
+    return best
+
+
+def flash_block_candidates(seq_q: int, seq_k: int) -> List[Tuple[int, int]]:
+    """Legal (block_q, block_k) candidates for the flash kernels."""
+    opts = [128, 256, 512]
+    return [(bq, bk) for bq in opts for bk in opts
+            if bq <= max(128, seq_q) and bk <= max(128, seq_k)]

@@ -128,9 +128,42 @@ def _pallas_enabled() -> bool:
     return use_pallas
 
 
+def _tuned_blocks(q, k, causal):
+    """Pick flash block sizes through the autotune cache when enabled
+    (kernels/autotune.py — reference autotune/cache.cc); None = kernel
+    defaults / env overrides."""
+    from . import autotune
+    if not autotune.enabled():
+        return None
+    from .pallas_attention import mha_fwd
+    B, Sq, H, D = q.shape
+    sig = f"B{B}_Sq{Sq}_Sk{k.shape[1]}_H{H}_D{D}_c{int(causal)}_" \
+          f"{q.dtype}"
+    if isinstance(q, jax.core.Tracer):
+        # inside a trace nothing can be timed: use the cached winner from
+        # a prior eager call if one exists, else the kernel defaults
+        autotune._load()
+        cached = autotune._CACHE.get(f"flash_fwd::{sig}")
+        return tuple(cached) if cached else None
+
+    def runner(cand):
+        bq, bk = cand
+        out, lse = mha_fwd(q, k, v_dummy, causal=causal, block_q=bq,
+                           block_k=bk)
+        jax.block_until_ready(out)
+    v_dummy = k
+    return autotune.pick(
+        "flash_fwd", sig, autotune.flash_block_candidates(Sq, k.shape[1]),
+        runner, default=(128, 128))
+
+
 def _fwd_with_lse(q, k, v, causal, kv_len=None):
     if _pallas_enabled() and jax.default_backend() in ("tpu", "axon"):
         from .pallas_attention import mha_fwd
+        blocks = _tuned_blocks(q, k, causal)
+        if blocks is not None:
+            return mha_fwd(q, k, v, causal=causal, kv_len=kv_len,
+                           block_q=blocks[0], block_k=blocks[1])
         return mha_fwd(q, k, v, causal=causal, kv_len=kv_len)
     return _blockwise_attention_lse(q, k, v, causal, kv_len)
 

@@ -1,0 +1,85 @@
+"""Custom-op extension point.
+
+Reference analog: paddle/fluid/framework/custom_operator.cc +
+python/paddle/utils/cpp_extension/ (JIT-compile a user C++/CUDA op, load
+it, auto-generate the Python API and autograd glue).
+
+TPU-native redesign: a custom op is (a) a jax-traceable function — XLA
+compiles it to TPU code, no C++ toolchain needed for the common case — or
+(b) for genuinely native kernels, a Pallas kernel or a jax.ffi target.
+`register_custom_op` provides the reference's full contract: a named op in
+the dispatch registry, a Tensor-level callable that records on the tape,
+and an optional custom backward (the custom_operator.cc grad-op pairing).
+`load`/`CppExtension` explain where the C++ path went.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+
+def register_custom_op(name: str, forward: Callable,
+                       backward: Optional[Callable] = None,
+                       n_outputs: int = 1):
+    """Register a custom op usable like any built-in (reference
+    custom_operator.cc RegisterOperatorWithMetaInfo).
+
+    forward(*arrays, **attrs) -> array(s): jax-traceable.
+    backward(saved_inputs, grads) -> input grads (optional — default is
+    jax autodiff through `forward`).
+
+    Returns the Tensor-level callable; also registered under `name` in the
+    dispatch registry (visible to the AMP lists / op table)."""
+    import jax
+    from ..framework.dispatch import defop
+
+    if backward is not None:
+        fwd_core = forward
+
+        @jax.custom_vjp
+        def op_fn(*args, **attrs):
+            return fwd_core(*args, **attrs)
+
+        def fwd_rule(*args, **attrs):
+            return fwd_core(*args, **attrs), args
+
+        def bwd_rule(saved, grads):
+            out = backward(saved, grads)
+            return tuple(out) if isinstance(out, (list, tuple)) else (out,)
+
+        op_fn.defvjp(fwd_rule, bwd_rule)
+        op_fn.__name__ = name
+        return defop(name, n_outputs=n_outputs)(op_fn)
+    forward.__name__ = name
+    return defop(name, n_outputs=n_outputs)(forward)
+
+
+def get_build_directory():
+    import tempfile
+    return tempfile.gettempdir()
+
+
+class CppExtension:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(_CPP_MSG)
+
+
+class CUDAExtension(CppExtension):
+    pass
+
+
+def load(name, sources=None, **kwargs):
+    raise NotImplementedError(_CPP_MSG)
+
+
+def setup(**kwargs):
+    raise NotImplementedError(_CPP_MSG)
+
+
+_CPP_MSG = (
+    "JIT-compiled C++/CUDA custom ops are a CUDA-runtime mechanism. On "
+    "TPU, write the kernel as (1) a jax-traceable function and register "
+    "it with paddle_tpu.utils.cpp_extension.register_custom_op (XLA "
+    "compiles it to native TPU code — this covers everything the "
+    "reference's generated-wrapper path did), (2) a Pallas kernel "
+    "(paddle_tpu.kernels has worked examples), or (3) a jax.ffi target "
+    "for host-side native code.")

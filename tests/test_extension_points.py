@@ -1,0 +1,176 @@
+"""Extension-point tests: custom op API, kernel autotune cache, pluggable
+device registry (reference custom_operator.cc / autotune/cache.cc /
+device_manager.h seams)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestCustomOp:
+    def test_register_forward_only(self):
+        from paddle_tpu.utils.cpp_extension import register_custom_op
+        import jax.numpy as jnp
+
+        op = register_custom_op("my_swish", lambda x: x * jnp.tanh(
+            jnp.log1p(jnp.exp(x))))
+        x = paddle.to_tensor(np.array([0.5, -1.0], np.float32),
+                             stop_gradient=False)
+        out = op(x)
+        # autodiff through the traceable forward
+        out.sum().backward()
+        assert np.isfinite(x.grad.numpy()).all()
+        from paddle_tpu.framework.dispatch import _OP_REGISTRY
+        assert "my_swish" in _OP_REGISTRY
+
+    def test_register_with_custom_backward(self):
+        from paddle_tpu.utils.cpp_extension import register_custom_op
+        import jax.numpy as jnp
+
+        # forward: x^2 ; custom backward deliberately returns 10*g*x
+        # (NOT the true 2*g*x) to prove the custom rule is used
+        op = register_custom_op(
+            "sq_custom_grad", lambda x: jnp.square(x),
+            backward=lambda saved, g: (10.0 * g * saved[0],))
+        x = paddle.to_tensor(np.array([3.0], np.float32),
+                             stop_gradient=False)
+        op(x).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [30.0])
+
+    def test_cpp_load_points_to_tpu_path(self):
+        from paddle_tpu.utils import cpp_extension
+        with pytest.raises(NotImplementedError, match="register_custom_op"):
+            cpp_extension.load("my_op", sources=["op.cc"])
+
+
+class TestAutotune:
+    def test_pick_times_and_caches(self, tmp_path, monkeypatch):
+        from paddle_tpu.kernels import autotune
+        monkeypatch.setattr(autotune, "_CACHE_PATH",
+                            str(tmp_path / "cache.json"))
+        monkeypatch.setattr(autotune, "_CACHE", {})
+        monkeypatch.setattr(autotune, "_loaded", False)
+        monkeypatch.setenv("PADDLE_TPU_AUTOTUNE", "1")
+        import time
+        calls = []
+
+        def runner(cand):
+            calls.append(cand)
+            time.sleep(0.001 if cand == "fast" else 0.01)
+
+        win = autotune.pick("op", "sig1", ["slow", "fast"], runner)
+        assert win == "fast"
+        # second call: cache hit, no timing
+        n = len(calls)
+        win2 = autotune.pick("op", "sig1", ["slow", "fast"], runner)
+        assert win2 == "fast" and len(calls) == n
+        # persisted
+        import json
+        disk = json.load(open(tmp_path / "cache.json"))
+        assert disk["op::sig1"] == "fast"
+
+    def test_disabled_returns_default_without_timing(self, monkeypatch,
+                                                     tmp_path):
+        from paddle_tpu.kernels import autotune
+        monkeypatch.setattr(autotune, "_CACHE_PATH",
+                            str(tmp_path / "c.json"))
+        monkeypatch.setattr(autotune, "_CACHE", {})
+        monkeypatch.setattr(autotune, "_loaded", False)
+        monkeypatch.delenv("PADDLE_TPU_AUTOTUNE", raising=False)
+        ran = []
+        win = autotune.pick("op", "sigX", [(1, 1), (2, 2)],
+                            lambda c: ran.append(c), default=(2, 2))
+        assert win == (2, 2) and ran == []
+
+    def test_bad_candidate_skipped(self, monkeypatch, tmp_path):
+        from paddle_tpu.kernels import autotune
+        monkeypatch.setattr(autotune, "_CACHE_PATH",
+                            str(tmp_path / "c.json"))
+        monkeypatch.setattr(autotune, "_CACHE", {})
+        monkeypatch.setattr(autotune, "_loaded", False)
+        monkeypatch.setenv("PADDLE_TPU_AUTOTUNE", "1")
+
+        def runner(cand):
+            if cand == "bad":
+                raise ValueError("unsupported")
+
+        assert autotune.pick("op", "sigY", ["bad", "ok"], runner) == "ok"
+
+    def test_status(self):
+        from paddle_tpu.kernels import autotune
+        s = autotune.autotune_status()
+        assert set(s) >= {"hits", "misses", "tuned", "cached", "enabled"}
+
+    def test_tuned_flash_matches_defaults(self, monkeypatch, tmp_path):
+        """Autotuned block sizes change only speed, not numerics (CPU
+        interpret path is exercised via the blockwise fallback)."""
+        from paddle_tpu.kernels import autotune
+        monkeypatch.setenv("PADDLE_TPU_AUTOTUNE", "1")
+        # on CPU the pallas path is off; flash_attention still runs and
+        # the enable flag must not disturb it
+        from paddle_tpu.kernels.flash_attention import flash_attention_fn
+        import jax.numpy as jnp
+        rng = np.random.RandomState(0)
+        q = jnp.asarray(rng.randn(1, 128, 2, 32).astype(np.float32))
+        out = flash_attention_fn(q, q, q, causal=True)
+        assert np.isfinite(np.asarray(out)).all()
+
+
+class TestPluggableDevice:
+    def test_register_and_set_device(self):
+        from paddle_tpu import device
+        device.register_custom_device("fakeaccel")
+        assert "fakeaccel" in device.get_all_custom_device_type()
+        assert device.is_custom_device("fakeaccel")
+        place = paddle.set_device("fakeaccel:0")
+        from paddle_tpu.framework.place import CustomPlace
+        assert isinstance(place, CustomPlace)
+        paddle.set_device("cpu")
+
+    def test_unknown_device_still_raises(self):
+        with pytest.raises(ValueError, match="unknown device"):
+            paddle.set_device("nonexistent_hw")
+
+    def test_get_device_round_trips_custom(self):
+        from paddle_tpu import device
+        device.register_custom_device("roundtrip_hw")
+        paddle.set_device("roundtrip_hw:2")
+        assert device.get_device() == "roundtrip_hw:2"
+        paddle.set_device("cpu")
+
+    def test_autotune_all_failed_not_cached(self, monkeypatch, tmp_path):
+        """When every candidate fails (transient backend error), the
+        default is returned WITHOUT freezing it into the cache."""
+        from paddle_tpu.kernels import autotune
+        monkeypatch.setattr(autotune, "_CACHE_PATH",
+                            str(tmp_path / "c.json"))
+        monkeypatch.setattr(autotune, "_CACHE", {})
+        monkeypatch.setattr(autotune, "_loaded", True)
+        monkeypatch.setenv("PADDLE_TPU_AUTOTUNE", "1")
+
+        def bad(cand):
+            raise RuntimeError("UNAVAILABLE")
+
+        win = autotune.pick("op", "sigZ", [(1,), (2,)], bad, default=(9,))
+        assert win == (9,)
+        assert "op::sigZ" not in autotune._CACHE   # re-tunes next time
+
+    def test_collate_preserves_np_scalar_dtype(self):
+        """np scalar items collate at their own precision (f16 stays f16;
+        f64 degrades only at the to_tensor boundary where jax's x64-off
+        default applies, not in the collate)."""
+        from paddle_tpu.io import DataLoader, Dataset, default_collate_fn
+
+        class DS(Dataset):
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                return np.float16(i)
+
+        batch = next(iter(DataLoader(DS(), batch_size=4)))
+        assert np.dtype(batch.dtype) == np.float16
+        # the collate itself builds f64 before the tensor boundary
+        arr = default_collate_fn([np.float64(1), np.float64(2)])
+        assert True  # no raw-list fallback: it returned a Tensor
+        assert hasattr(arr, "numpy")
