@@ -266,6 +266,9 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = max(2, prefetch_factor)
+        self.use_shared_memory = use_shared_memory
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -306,11 +309,53 @@ class DataLoader:
             for indices in self.batch_sampler:
                 yield self.collate_fn([self.dataset[i] for i in indices])
 
+    def _multiprocess_ok(self) -> bool:
+        """Multiprocess workers need the native shm ring (Linux + fork +
+        g++) and use_shared_memory=True; anything else falls back to the
+        thread prefetcher."""
+        if self.num_workers <= 0 or not self.use_shared_memory:
+            return False
+        from .shm_ring import available
+        return available()
+
+    def _iter_multiprocess(self, bm):
+        from .multiprocess import MultiprocessIterator, np_collate
+        if self._iterable_mode:
+            batch_indices = None
+        else:
+            if self.batch_sampler is None:
+                batch_indices = [[i] for i in range(len(self.dataset))]
+            else:
+                batch_indices = [list(ix) for ix in self.batch_sampler]
+        # the worker must stay off the accelerator: the default collate
+        # runs as its numpy clone there and Tensor assembly happens here
+        user_collate = self.collate_fn is not default_collate_fn
+        worker_collate = self.collate_fn if user_collate else np_collate
+        it = MultiprocessIterator(
+            self.dataset, batch_indices, worker_collate,
+            self.num_workers, prefetch_factor=self.prefetch_factor,
+            timeout=self.timeout, worker_init_fn=self.worker_init_fn,
+            batch_size=getattr(self, "batch_size", None),
+            drop_last=getattr(self, "drop_last", False))
+        from .multiprocess import _to_tensor_tree
+        gen = iter(it)
+        while True:
+            bm.before_reader()
+            try:
+                b = next(gen)
+            except StopIteration:
+                return
+            bm.after_reader()
+            yield _to_tensor_tree(b)
+
     def __iter__(self):
         # reader-cost hooks for the ips timer (reference: profiler/timer.py
         # Benchmark auto-attached to DataLoader)
         from ..profiler.timer import benchmark
         bm = benchmark()
+        if self._multiprocess_ok():
+            yield from self._iter_multiprocess(bm)
+            return
         if self.num_workers == 0:
             it = self._batches()
             while True:
@@ -345,4 +390,8 @@ class DataLoader:
 
 
 def get_worker_info():
-    return None
+    """In a multiprocess DataLoader worker: that worker's WorkerInfo
+    (id / num_workers / seed / dataset); None in the trainer process.
+    (reference python/paddle/io/dataloader/worker.py get_worker_info)"""
+    from .multiprocess import get_worker_info as _gwi
+    return _gwi()

@@ -1,0 +1,224 @@
+// Native shared-memory SPSC ring — the DataLoader's zero-copy batch
+// transport between worker processes and the trainer process.
+//
+// Reference analog: the C++ side of the reference's multiprocess DataLoader
+// (paddle/fluid/operators/reader/ + core.LoDTensor shared-memory transport
+// used by python/paddle/io/dataloader/dataloader_iter.py:358 and
+// worker.py's _share_memory path). The reference moves batches between
+// Python workers and the trainer over /dev/shm LoDTensors with a
+// file-descriptor handshake; here the transport is one anonymous
+// MAP_SHARED region created BEFORE fork (no /dev/shm names to leak, no fd
+// passing) holding a fixed ring of slots plus a control block of
+// process-shared POSIX semaphores.
+//
+// Design: single-producer / single-consumer per ring (the Python side
+// gives each worker its own ring and round-robins reads, preserving batch
+// order deterministically — no cross-worker contention, no reordering
+// buffer). Producer and consumer each own one cursor; the semaphores carry
+// the full/empty counts, so no mutex is needed and a blocked side sleeps
+// in the kernel (sem_timedwait) instead of spinning.
+//
+// Messages larger than one slot span consecutive slots (SPSC FIFO makes
+// spanning safe); the first chunk's header carries the total payload size
+// so the consumer knows how many chunks to drain.
+//
+// Built at first use by paddle_tpu.io.shm_ring (g++ -O2 -shared -fPIC,
+// cached by source hash); loaded via ctypes. C ABI only.
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+#include <semaphore.h>
+
+namespace {
+
+struct SlotHeader {
+  uint64_t nbytes;     // payload bytes in this slot
+  uint64_t total;      // total message bytes (set on first chunk)
+  uint32_t first;      // 1 when this slot starts a message
+  uint32_t _pad;
+};
+
+struct Control {
+  uint32_t magic;
+  uint32_t n_slots;
+  uint64_t slot_bytes;
+  sem_t sem_free;      // slots available to the producer
+  sem_t sem_full;      // slots ready for the consumer
+  std::atomic<uint64_t> head;  // producer cursor (absolute slot count)
+  std::atomic<uint64_t> tail;  // consumer cursor
+  std::atomic<uint32_t> producer_done;  // producer hangup flag
+};
+
+constexpr uint32_t kMagic = 0x52494e47;  // "RING"
+
+inline Control* ctrl(void* mem) { return static_cast<Control*>(mem); }
+
+inline SlotHeader* slot_hdr(void* mem, uint64_t idx) {
+  Control* c = ctrl(mem);
+  char* base = static_cast<char*>(mem) + sizeof(Control);
+  return reinterpret_cast<SlotHeader*>(
+      base + (idx % c->n_slots) * (sizeof(SlotHeader) + c->slot_bytes));
+}
+
+inline char* slot_data(void* mem, uint64_t idx) {
+  return reinterpret_cast<char*>(slot_hdr(mem, idx)) + sizeof(SlotHeader);
+}
+
+int timed_wait(sem_t* sem, long timeout_ms) {
+  if (timeout_ms < 0) {  // infinite
+    while (sem_wait(sem) != 0) {
+      if (errno != EINTR) return -1;
+    }
+    return 0;
+  }
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  ts.tv_sec += timeout_ms / 1000;
+  ts.tv_nsec += (timeout_ms % 1000) * 1000000L;
+  if (ts.tv_nsec >= 1000000000L) { ts.tv_sec += 1; ts.tv_nsec -= 1000000000L; }
+  while (sem_timedwait(sem, &ts) != 0) {
+    if (errno == EINTR) continue;
+    return -1;  // ETIMEDOUT or error
+  }
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Total bytes the caller must mmap (MAP_SHARED) for a ring.
+uint64_t ring_region_size(uint32_t n_slots, uint64_t slot_bytes) {
+  return sizeof(Control) +
+         static_cast<uint64_t>(n_slots) * (sizeof(SlotHeader) + slot_bytes);
+}
+
+// Initialize the control block in an already-mapped shared region.
+// Call once, in the parent, BEFORE forking workers. Returns 0 on success.
+int ring_init(void* mem, uint32_t n_slots, uint64_t slot_bytes) {
+  if (mem == nullptr || n_slots == 0 || slot_bytes == 0) return -1;
+  Control* c = ctrl(mem);
+  std::memset(c, 0, sizeof(Control));
+  c->n_slots = n_slots;
+  c->slot_bytes = slot_bytes;
+  if (sem_init(&c->sem_free, /*pshared=*/1, n_slots) != 0) return -2;
+  if (sem_init(&c->sem_full, /*pshared=*/1, 0) != 0) return -2;
+  c->head.store(0);
+  c->tail.store(0);
+  c->producer_done.store(0);
+  c->magic = kMagic;
+  return 0;
+}
+
+// ---- producer side -------------------------------------------------------
+
+// Write one message (possibly spanning slots). Blocks until enough slots
+// free up. Returns 0 on success, -1 timeout, -2 bad ring, -3 message can
+// never fit (should not happen: spanning handles any size).
+int ring_put(void* mem, const char* data, uint64_t nbytes, long timeout_ms) {
+  Control* c = ctrl(mem);
+  if (c->magic != kMagic) return -2;
+  uint64_t sent = 0;
+  int first = 1;
+  do {
+    if (timed_wait(&c->sem_free, timeout_ms) != 0) return -1;
+    uint64_t idx = c->head.load(std::memory_order_relaxed);
+    SlotHeader* h = slot_hdr(mem, idx);
+    uint64_t chunk = nbytes - sent;
+    if (chunk > c->slot_bytes) chunk = c->slot_bytes;
+    h->nbytes = chunk;
+    h->total = nbytes;
+    h->first = first;
+    if (chunk) std::memcpy(slot_data(mem, idx), data + sent, chunk);
+    sent += chunk;
+    first = 0;
+    c->head.store(idx + 1, std::memory_order_release);
+    sem_post(&c->sem_full);
+  } while (sent < nbytes);
+  return 0;
+}
+
+// Mark the producer as finished; a blocked/future consumer read returns -4.
+void ring_close_producer(void* mem) {
+  Control* c = ctrl(mem);
+  c->producer_done.store(1, std::memory_order_release);
+  sem_post(&c->sem_full);  // wake a blocked consumer
+}
+
+// ---- consumer side -------------------------------------------------------
+
+// Peek the size of the next full message. Blocks for the first chunk.
+// Returns total message bytes (>=0), -1 timeout, -2 bad ring, -4 producer
+// closed and ring drained. Does NOT consume; call ring_get next.
+int64_t ring_next_size(void* mem, long timeout_ms) {
+  Control* c = ctrl(mem);
+  if (c->magic != kMagic) return -2;
+  for (;;) {
+    if (timed_wait(&c->sem_full, timeout_ms) != 0) {
+      if (c->producer_done.load(std::memory_order_acquire) &&
+          c->tail.load() == c->head.load())
+        return -4;
+      return -1;
+    }
+    // the hangup post carries no data; re-check emptiness
+    if (c->tail.load() == c->head.load()) {
+      if (c->producer_done.load(std::memory_order_acquire)) return -4;
+      continue;  // spurious
+    }
+    sem_post(&c->sem_full);  // undo the decrement: ring_get re-waits
+    return static_cast<int64_t>(slot_hdr(mem, c->tail.load())->total);
+  }
+}
+
+// Read one full message into out (caller sized it via ring_next_size).
+// Returns bytes read, -1 timeout, -2 bad ring, -4 producer closed+drained.
+int64_t ring_get(void* mem, char* out, uint64_t out_cap, long timeout_ms) {
+  Control* c = ctrl(mem);
+  if (c->magic != kMagic) return -2;
+  uint64_t got = 0, total = 0;
+  int first = 1;
+  do {
+    if (timed_wait(&c->sem_full, timeout_ms) != 0) {
+      if (first && c->producer_done.load(std::memory_order_acquire) &&
+          c->tail.load() == c->head.load())
+        return -4;
+      return -1;
+    }
+    uint64_t idx = c->tail.load(std::memory_order_relaxed);
+    if (idx == c->head.load(std::memory_order_acquire)) {
+      // hangup wakeup with no data
+      if (c->producer_done.load(std::memory_order_acquire) && first)
+        return -4;
+      continue;
+    }
+    SlotHeader* h = slot_hdr(mem, idx);
+    if (first) {
+      total = h->total;
+      if (total > out_cap) return -3;
+      first = 0;
+    }
+    if (h->nbytes) std::memcpy(out + got, slot_data(mem, idx), h->nbytes);
+    got += h->nbytes;
+    c->tail.store(idx + 1, std::memory_order_release);
+    sem_post(&c->sem_free);
+  } while (got < total);
+  return static_cast<int64_t>(got);
+}
+
+// Introspection for tests: messages currently buffered (full slots).
+int ring_full_slots(void* mem) {
+  Control* c = ctrl(mem);
+  if (c->magic != kMagic) return -2;
+  int v = 0;
+  sem_getvalue(&c->sem_full, &v);
+  return v;
+}
+
+int ring_producer_done(void* mem) {
+  return static_cast<int>(ctrl(mem)->producer_done.load());
+}
+
+}  // extern "C"

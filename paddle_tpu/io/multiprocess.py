@@ -1,0 +1,240 @@
+"""Multiprocess DataLoader workers over the native shared-memory ring.
+
+Reference analog: python/paddle/io/dataloader/dataloader_iter.py:358
+(_DataLoaderIterMultiProcess) + worker.py (_worker_loop, WorkerInfo) +
+the C++ shared-memory LoDTensor transport. TPU-native shape of the same
+idea: W forked worker processes each own one SPSC shm ring
+(io/shm_ring.py, native C++); batch k is produced by worker k % W and the
+trainer round-robins the rings, so batch order is deterministic and equal
+to the single-process order — no reordering buffer, no cross-worker lock.
+
+Workers must stay off the accelerator: the default collate here is a
+numpy-only clone of io.default_collate_fn, and Tensor leaves coming out of
+a custom collate_fn are converted to numpy before pickling (first jax use
+in a forked child would re-enter the parent's TPU client). The trainer
+side converts numpy leaves back to Tensor, so `num_workers=N` yields
+exactly what `num_workers=0` yields.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import signal
+import sys
+import traceback
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+from .shm_ring import ShmRing, RingClosed, RingTimeout
+
+_worker_info = None
+
+
+class WorkerInfo:
+    def __init__(self, id: int, num_workers: int, seed: int, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.seed = seed
+        self.dataset = dataset
+
+    def __repr__(self):
+        return (f"WorkerInfo(id={self.id}, num_workers={self.num_workers}, "
+                f"seed={self.seed})")
+
+
+def get_worker_info():
+    """Inside a worker process: this worker's WorkerInfo; else None.
+    (reference python/paddle/io/dataloader/worker.py get_worker_info)"""
+    return _worker_info
+
+
+def np_collate(batch):
+    """Numpy-only collate (same stacking rules as io.default_collate_fn,
+    minus Tensor construction — that happens trainer-side)."""
+    sample = batch[0]
+    if hasattr(sample, "numpy") and callable(sample.numpy):
+        # Tensor items (e.g. TensorDataset): pull to numpy in the worker —
+        # mirrors default_collate_fn's Tensor branch so num_workers=N
+        # stacks to one [B,...] batch exactly like num_workers=0
+        return np.stack([np.asarray(s.numpy()) for s in batch])
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, np.int64)
+    if isinstance(sample, np.floating):
+        return np.asarray(batch, sample.dtype)
+    if isinstance(sample, float):
+        return np.asarray(batch, np.float32)
+    if isinstance(sample, (list, tuple)):
+        return [np_collate(list(g)) for g in zip(*batch)]
+    if isinstance(sample, dict):
+        return {k: np_collate([s[k] for s in batch]) for k in sample}
+    return batch
+
+
+def _to_numpy_tree(obj):
+    if hasattr(obj, "numpy") and callable(obj.numpy):  # Tensor / jax.Array
+        return np.asarray(obj.numpy())
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_numpy_tree(v) for v in obj)
+    if isinstance(obj, dict):
+        return {k: _to_numpy_tree(v) for k, v in obj.items()}
+    return obj
+
+
+def _to_tensor_tree(obj):
+    from ..framework.tensor import to_tensor
+    if isinstance(obj, np.ndarray):
+        return to_tensor(obj)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_tensor_tree(v) for v in obj)
+    if isinstance(obj, dict):
+        return {k: _to_tensor_tree(v) for k, v in obj.items()}
+    return obj
+
+
+class _WorkerError:
+    def __init__(self, worker_id: int, tb: str):
+        self.worker_id = worker_id
+        self.tb = tb
+
+
+class WorkerError(RuntimeError):
+    pass
+
+
+def _worker_loop(ring: ShmRing, worker_id: int, num_workers: int,
+                 dataset, batch_indices: Optional[List[Sequence[int]]],
+                 collate_fn, worker_init_fn, base_seed: int,
+                 batch_size: Optional[int], drop_last: bool) -> None:
+    """Child body. batch_indices=None → IterableDataset replica mode."""
+    global _worker_info
+    seed = base_seed + worker_id
+    _worker_info = WorkerInfo(worker_id, num_workers, seed, dataset)
+    np.random.seed(seed % (2 ** 32))
+    try:
+        if worker_init_fn is not None:
+            worker_init_fn(worker_id)
+        if batch_indices is None:
+            import itertools
+            it = iter(dataset)
+            while True:
+                batch = list(itertools.islice(it, batch_size))
+                if not batch or (len(batch) < batch_size and drop_last):
+                    break
+                out = _to_numpy_tree(collate_fn(batch))
+                ring.put(pickle.dumps(out, protocol=4))
+        else:
+            for j in range(worker_id, len(batch_indices), num_workers):
+                items = [dataset[i] for i in batch_indices[j]]
+                out = _to_numpy_tree(collate_fn(items))
+                ring.put(pickle.dumps(out, protocol=4))
+    except BaseException:
+        try:
+            err = _WorkerError(worker_id, traceback.format_exc())
+            ring.put(pickle.dumps(err, protocol=4), timeout=10.0)
+        except Exception:
+            pass
+    finally:
+        ring.close_producer()
+
+
+class MultiprocessIterator:
+    """One epoch of batches produced by forked workers.
+
+    Map-style: deterministic order — batch j comes from worker j % W.
+    Iterable-style: each worker iterates its own dataset replica (split
+    via get_worker_info, reference semantics); parent round-robins
+    whichever rings still produce.
+    """
+
+    def __init__(self, dataset, batch_indices, collate_fn, num_workers,
+                 prefetch_factor=2, timeout=0.0, worker_init_fn=None,
+                 slot_bytes=1 << 22, batch_size=None, drop_last=False):
+        self._timeout = None if not timeout else float(timeout)
+        self._nw = num_workers
+        self._batch_indices = batch_indices
+        self._rings = [ShmRing(n_slots=max(2, prefetch_factor),
+                               slot_bytes=slot_bytes)
+                       for _ in range(num_workers)]
+        self._pids: List[int] = []
+        base_seed = int.from_bytes(os.urandom(4), "little")
+        for w in range(num_workers):
+            pid = os.fork()
+            if pid == 0:
+                # child: never run parent atexit/finally frames
+                try:
+                    _worker_loop(self._rings[w], w, num_workers, dataset,
+                                 batch_indices, collate_fn, worker_init_fn,
+                                 base_seed, batch_size, drop_last)
+                finally:
+                    os._exit(0)
+            self._pids.append(pid)
+
+    def __iter__(self):
+        try:
+            if self._batch_indices is not None:
+                # map-style, deterministic order: batch j IS worker j%W's
+                # next message. Worker w owns exactly the global batches
+                # ≡ w (mod W), so the first closed+drained ring proves no
+                # batch at the current position exists — epoch over.
+                j = 0
+                while True:
+                    try:
+                        data = self._rings[j % self._nw].get(
+                            timeout=self._timeout)
+                    except RingClosed:
+                        break
+                    except RingTimeout:
+                        raise WorkerError(
+                            f"DataLoader worker {j % self._nw} timed out "
+                            f"after {self._timeout}s") from None
+                    yield self._decode(j % self._nw, data)
+                    j += 1
+            else:
+                # iterable-style: workers produce independent streams;
+                # round-robin whatever is still open
+                open_rings = list(range(self._nw))
+                i = 0
+                while open_rings:
+                    w = open_rings[i % len(open_rings)]
+                    try:
+                        data = self._rings[w].get(timeout=self._timeout)
+                    except RingClosed:
+                        open_rings.remove(w)
+                        continue
+                    except RingTimeout:
+                        raise WorkerError(
+                            f"DataLoader worker {w} timed out after "
+                            f"{self._timeout}s") from None
+                    yield self._decode(w, data)
+                    i += 1
+        finally:
+            self.close()
+
+    def _decode(self, w, data):
+        obj = pickle.loads(data)
+        if isinstance(obj, _WorkerError):
+            raise WorkerError(
+                f"DataLoader worker {obj.worker_id} failed:\n{obj.tb}")
+        return obj
+
+    def close(self):
+        for pid in self._pids:
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+        for pid in self._pids:
+            try:
+                os.waitpid(pid, 0)
+            except ChildProcessError:
+                pass
+        self._pids = []
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,161 @@
+"""ctypes bindings for the native shared-memory ring (io/_native/shm_ring.cpp).
+
+The ring is the DataLoader's worker→trainer batch transport (reference:
+the shared-memory LoDTensor path of python/paddle/io/dataloader/worker.py +
+dataloader_iter.py:358). One anonymous MAP_SHARED region per worker,
+created before fork, holding a control block (process-shared POSIX
+semaphores + SPSC cursors) and a fixed ring of slots; messages larger than
+one slot span consecutive slots.
+
+The .so is built from source on first use (g++ -O2 -shared -fPIC) into
+paddle_tpu/io/_native/_build/, cached by source hash. `available()` is the
+gate the DataLoader uses to fall back to the thread prefetcher when there
+is no compiler or no Linux shm semantics.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import mmap
+import os
+import subprocess
+import sys
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "_native", "shm_ring.cpp")
+_BUILD_DIR = os.path.join(_HERE, "_native", "_build")
+
+_lib = None
+_lib_err = None
+_lock = threading.Lock()
+
+
+def _build_lib():
+    with open(_SRC, "rb") as f:
+        src = f.read()
+    tag = hashlib.sha256(src).hexdigest()[:16]
+    so_path = os.path.join(_BUILD_DIR, f"libshm_ring-{tag}.so")
+    if not os.path.exists(so_path):
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        tmp = so_path + f".tmp{os.getpid()}"
+        cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+               "-o", tmp, _SRC, "-lpthread"]
+        subprocess.run(cmd, check=True, capture_output=True)
+        os.replace(tmp, so_path)  # atomic: concurrent builders race safely
+    lib = ctypes.CDLL(so_path)
+    lib.ring_region_size.restype = ctypes.c_uint64
+    lib.ring_region_size.argtypes = [ctypes.c_uint32, ctypes.c_uint64]
+    lib.ring_init.restype = ctypes.c_int
+    lib.ring_init.argtypes = [ctypes.c_void_p, ctypes.c_uint32,
+                              ctypes.c_uint64]
+    lib.ring_put.restype = ctypes.c_int
+    lib.ring_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                             ctypes.c_uint64, ctypes.c_long]
+    lib.ring_close_producer.restype = None
+    lib.ring_close_producer.argtypes = [ctypes.c_void_p]
+    lib.ring_next_size.restype = ctypes.c_int64
+    lib.ring_next_size.argtypes = [ctypes.c_void_p, ctypes.c_long]
+    lib.ring_get.restype = ctypes.c_int64
+    lib.ring_get.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                             ctypes.c_uint64, ctypes.c_long]
+    lib.ring_full_slots.restype = ctypes.c_int
+    lib.ring_full_slots.argtypes = [ctypes.c_void_p]
+    lib.ring_producer_done.restype = ctypes.c_int
+    lib.ring_producer_done.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def _get_lib():
+    global _lib, _lib_err
+    if _lib is not None or _lib_err is not None:
+        return _lib
+    with _lock:
+        if _lib is None and _lib_err is None:
+            try:
+                _lib = _build_lib()
+            except Exception as e:  # no g++, build error, exotic libc...
+                _lib_err = e
+    return _lib
+
+
+def available() -> bool:
+    """True when the native transport can be used (Linux + fork + g++)."""
+    if sys.platform != "linux" or not hasattr(os, "fork"):
+        return False
+    return _get_lib() is not None
+
+
+def unavailable_reason():
+    if sys.platform != "linux":
+        return f"platform {sys.platform} (need linux shm semantics)"
+    return repr(_lib_err) if _lib_err else None
+
+
+class RingTimeout(Exception):
+    pass
+
+
+class RingClosed(Exception):
+    """Producer hung up and the ring is drained."""
+
+
+class ShmRing:
+    """SPSC shared-memory ring. Create in the parent BEFORE fork; both
+    sides then use the same object (the mmap is inherited)."""
+
+    def __init__(self, n_slots: int = 4, slot_bytes: int = 1 << 22):
+        lib = _get_lib()
+        if lib is None:
+            raise RuntimeError(
+                f"native shm ring unavailable: {unavailable_reason()}")
+        self._lib = lib
+        self.n_slots = int(n_slots)
+        self.slot_bytes = int(slot_bytes)
+        size = lib.ring_region_size(self.n_slots, self.slot_bytes)
+        self._mm = mmap.mmap(-1, size)  # anonymous, MAP_SHARED
+        self._addr = ctypes.addressof(ctypes.c_char.from_buffer(self._mm))
+        rc = lib.ring_init(self._addr, self.n_slots, self.slot_bytes)
+        if rc != 0:
+            raise RuntimeError(f"ring_init failed (rc={rc})")
+
+    # ---- producer ----
+    def put(self, data, timeout: float | None = None) -> None:
+        data = bytes(data) if not isinstance(data, (bytes, bytearray)) \
+            else data
+        t_ms = -1 if timeout is None else max(0, int(timeout * 1000))
+        rc = self._lib.ring_put(self._addr, bytes(data), len(data), t_ms)
+        if rc == -1:
+            raise RingTimeout(f"ring_put timed out after {timeout}s")
+        if rc != 0:
+            raise RuntimeError(f"ring_put failed (rc={rc})")
+
+    def close_producer(self) -> None:
+        self._lib.ring_close_producer(self._addr)
+
+    # ---- consumer ----
+    def get(self, timeout: float | None = None) -> bytes:
+        t_ms = -1 if timeout is None else max(0, int(timeout * 1000))
+        size = self._lib.ring_next_size(self._addr, t_ms)
+        if size == -4:
+            raise RingClosed
+        if size == -1:
+            raise RingTimeout(f"ring_get timed out after {timeout}s")
+        if size < 0:
+            raise RuntimeError(f"ring_next_size failed (rc={size})")
+        buf = ctypes.create_string_buffer(int(size))
+        got = self._lib.ring_get(self._addr, buf, int(size), t_ms)
+        if got == -4:
+            raise RingClosed
+        if got == -1:
+            raise RingTimeout(f"ring_get timed out after {timeout}s")
+        if got < 0:
+            raise RuntimeError(f"ring_get failed (rc={got})")
+        return buf.raw[:got]
+
+    # ---- introspection ----
+    def buffered(self) -> int:
+        return max(0, self._lib.ring_full_slots(self._addr))
+
+    def producer_done(self) -> bool:
+        return bool(self._lib.ring_producer_done(self._addr))

@@ -44,10 +44,15 @@ def _load():
 
 
 def _persist():
+    # tmp + os.replace: concurrent processes (multi-host launch) each write
+    # a whole valid file and the last rename wins — never a torn JSON that
+    # _load would silently discard
     try:
         os.makedirs(os.path.dirname(_CACHE_PATH), exist_ok=True)
-        with open(_CACHE_PATH, "w") as f:
+        tmp = f"{_CACHE_PATH}.tmp{os.getpid()}"
+        with open(tmp, "w") as f:
             json.dump(_CACHE, f, indent=1)
+        os.replace(tmp, _CACHE_PATH)
     except OSError:
         pass
 

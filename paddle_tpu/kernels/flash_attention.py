@@ -140,18 +140,34 @@ def _tuned_blocks(q, k, causal):
     sig = f"B{B}_Sq{Sq}_Sk{k.shape[1]}_H{H}_D{D}_c{int(causal)}_" \
           f"{q.dtype}"
     if isinstance(q, jax.core.Tracer):
-        # inside a trace nothing can be timed: use the cached winner from
-        # a prior eager call if one exists, else the kernel defaults
-        autotune._load()
-        cached = autotune._CACHE.get(f"flash_fwd::{sig}")
-        return tuple(cached) if cached else None
+        # Inside a trace (the normal path: eager dispatch jits every op,
+        # and models run under jit) the tracers can't be timed — but
+        # CONCRETE dummies of the same shape/dtype can: timing them here
+        # runs eagerly while the outer trace is being built, i.e. tuning
+        # happens once at compile time per signature (the reference's
+        # switch_autotune does the same one-off timed pass). Shapes under
+        # jit are static ints; bail to defaults if not (shape-polymorphic
+        # export).
+        try:
+            shape_q = tuple(int(s) for s in q.shape)
+            shape_k = tuple(int(s) for s in k.shape)
+        except TypeError:
+            autotune._load()
+            cached = autotune._CACHE.get(f"flash_fwd::{sig}")
+            return tuple(cached) if cached else None
+        q_c = jnp.zeros(shape_q, q.dtype)
+        k_c = jnp.zeros(shape_k, k.dtype)
+    else:
+        q_c, k_c = q, k
 
     def runner(cand):
         bq, bk = cand
-        out, lse = mha_fwd(q, k, v_dummy, causal=causal, block_q=bq,
+        out, lse = mha_fwd(q_c, k_c, k_c, causal=causal, block_q=bq,
                            block_k=bk)
-        jax.block_until_ready(out)
-    v_dummy = k
+        # block_until_ready is unreliable over the axon tunnel; a scalar
+        # device_get genuinely waits (same forcing bench.py uses)
+        import numpy as _np
+        _np.asarray(jax.device_get(out[(0,) * out.ndim]))
     return autotune.pick(
         "flash_fwd", sig, autotune.flash_block_candidates(Sq, k.shape[1]),
         runner, default=(128, 128))

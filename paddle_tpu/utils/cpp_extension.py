@@ -35,18 +35,26 @@ def register_custom_op(name: str, forward: Callable,
     if backward is not None:
         fwd_core = forward
 
-        @jax.custom_vjp
+        # jax.custom_vjp rejects call-time keyword args, so the vjp pair is
+        # built per call with the attrs closed over (attrs are static in
+        # the dispatch layer — the trace cache keys on them, so each attr
+        # combination traces its own instance exactly once)
         def op_fn(*args, **attrs):
-            return fwd_core(*args, **attrs)
+            @jax.custom_vjp
+            def inner(*arrays):
+                return fwd_core(*arrays, **attrs)
 
-        def fwd_rule(*args, **attrs):
-            return fwd_core(*args, **attrs), args
+            def fwd_rule(*arrays):
+                return fwd_core(*arrays, **attrs), arrays
 
-        def bwd_rule(saved, grads):
-            out = backward(saved, grads)
-            return tuple(out) if isinstance(out, (list, tuple)) else (out,)
+            def bwd_rule(saved, grads):
+                out = backward(saved, grads)
+                return tuple(out) if isinstance(out, (list, tuple)) \
+                    else (out,)
 
-        op_fn.defvjp(fwd_rule, bwd_rule)
+            inner.defvjp(fwd_rule, bwd_rule)
+            return inner(*args)
+
         op_fn.__name__ = name
         return defop(name, n_outputs=n_outputs)(op_fn)
     forward.__name__ = name

@@ -174,6 +174,25 @@ class TestLRSchedulers:
 
 # ------------------------------------------------------------ DataLoader
 class TestDataLoader:
+    def test_collate_preserves_np_scalar_dtype(self):
+        """np scalar items collate at their own precision (f16 stays f16;
+        f64 degrades only at the to_tensor boundary where jax's x64-off
+        default applies, not in the collate)."""
+        from paddle_tpu.io import DataLoader, Dataset, default_collate_fn
+
+        class DS(Dataset):
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                return np.float16(i)
+
+        batch = next(iter(DataLoader(DS(), batch_size=4)))
+        assert np.dtype(batch.dtype) == np.float16
+        # the collate returned a Tensor, not a raw python list
+        arr = default_collate_fn([np.float64(1), np.float64(2)])
+        assert hasattr(arr, "numpy")
+
     def _ds(self, n=20):
         from paddle_tpu.io import Dataset
 

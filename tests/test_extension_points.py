@@ -44,6 +44,53 @@ class TestCustomOp:
 
 
 class TestAutotune:
+    def test_autotune_all_failed_not_cached(self, monkeypatch, tmp_path):
+        """When every candidate fails (transient backend error), the
+        default is returned WITHOUT freezing it into the cache."""
+        from paddle_tpu.kernels import autotune
+        monkeypatch.setattr(autotune, "_CACHE_PATH",
+                            str(tmp_path / "c.json"))
+        monkeypatch.setattr(autotune, "_CACHE", {})
+        monkeypatch.setattr(autotune, "_loaded", True)
+        monkeypatch.setenv("PADDLE_TPU_AUTOTUNE", "1")
+
+        def bad(cand):
+            raise RuntimeError("UNAVAILABLE")
+
+        win = autotune.pick("op", "sigZ", [(1,), (2,)], bad, default=(9,))
+        assert win == (9,)
+        assert "op::sigZ" not in autotune._CACHE   # re-tunes next time
+
+    def test_tunes_inside_jit_trace(self, monkeypatch, tmp_path):
+        """The framework's own op path always runs under jit (eager
+        dispatch jits every op), so tuning must fire from inside a trace
+        via concrete same-shape dummies — not silently no-op."""
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.kernels import autotune, flash_attention as fa
+        monkeypatch.setattr(autotune, "_CACHE_PATH",
+                            str(tmp_path / "c.json"))
+        monkeypatch.setattr(autotune, "_CACHE", {})
+        monkeypatch.setattr(autotune, "_loaded", True)
+        monkeypatch.setenv("PADDLE_TPU_AUTOTUNE", "1")
+        picked = {}
+        orig_pick = autotune.pick
+
+        def spy(op, sig, cands, runner, **kw):
+            out = orig_pick(op, sig, cands, runner, **kw)
+            picked[sig] = out
+            return out
+        monkeypatch.setattr(autotune, "pick", spy)
+
+        @jax.jit
+        def f(q, k, v):
+            blocks = fa._tuned_blocks(q, k, True)
+            return q if blocks is None else q * blocks[0]
+
+        q = jnp.zeros((1, 128, 2, 64), jnp.float32)
+        f(q, q, q)
+        assert picked, "pick() must run during the trace"
+
     def test_pick_times_and_caches(self, tmp_path, monkeypatch):
         from paddle_tpu.kernels import autotune
         monkeypatch.setattr(autotune, "_CACHE_PATH",
@@ -138,39 +185,3 @@ class TestPluggableDevice:
         assert device.get_device() == "roundtrip_hw:2"
         paddle.set_device("cpu")
 
-    def test_autotune_all_failed_not_cached(self, monkeypatch, tmp_path):
-        """When every candidate fails (transient backend error), the
-        default is returned WITHOUT freezing it into the cache."""
-        from paddle_tpu.kernels import autotune
-        monkeypatch.setattr(autotune, "_CACHE_PATH",
-                            str(tmp_path / "c.json"))
-        monkeypatch.setattr(autotune, "_CACHE", {})
-        monkeypatch.setattr(autotune, "_loaded", True)
-        monkeypatch.setenv("PADDLE_TPU_AUTOTUNE", "1")
-
-        def bad(cand):
-            raise RuntimeError("UNAVAILABLE")
-
-        win = autotune.pick("op", "sigZ", [(1,), (2,)], bad, default=(9,))
-        assert win == (9,)
-        assert "op::sigZ" not in autotune._CACHE   # re-tunes next time
-
-    def test_collate_preserves_np_scalar_dtype(self):
-        """np scalar items collate at their own precision (f16 stays f16;
-        f64 degrades only at the to_tensor boundary where jax's x64-off
-        default applies, not in the collate)."""
-        from paddle_tpu.io import DataLoader, Dataset, default_collate_fn
-
-        class DS(Dataset):
-            def __len__(self):
-                return 4
-
-            def __getitem__(self, i):
-                return np.float16(i)
-
-        batch = next(iter(DataLoader(DS(), batch_size=4)))
-        assert np.dtype(batch.dtype) == np.float16
-        # the collate itself builds f64 before the tensor boundary
-        arr = default_collate_fn([np.float64(1), np.float64(2)])
-        assert True  # no raw-list fallback: it returned a Tensor
-        assert hasattr(arr, "numpy")

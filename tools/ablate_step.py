@@ -1,0 +1,277 @@
+"""Ablation bench: where do the GPT train-step milliseconds go?
+
+Runs on the real TPU. Each variant rebuilds + jits the step and measures
+steady-state ms/step; differences between variants attribute time to the
+ablated component. Also calibrates the achievable matmul rate (bf16 and
+fp32) so MFU targets are grounded in what the chip actually delivers
+through the tunnel, not the datasheet.
+
+Usage: python tools/ablate_step.py [variant ...]   (default: all)
+Output: one JSON line per variant on stdout; diagnostics on stderr.
+"""
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def log(m):
+    print(f"[ablate] {m}", file=sys.stderr, flush=True)
+
+
+def emit(name, ms, extra=None):
+    rec = {"variant": name, "ms": round(ms, 2)}
+    if extra:
+        rec.update(extra)
+    print(json.dumps(rec), flush=True)
+
+
+def _force(out):
+    """block_until_ready is unreliable over the axon tunnel (see bench.py);
+    pulling a scalar to host genuinely waits. Device execution is FIFO, so
+    waiting on the last submission bounds the whole timed span."""
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    np.asarray(jax.device_get(leaf)).ravel()[:1]
+
+
+def timeit(fn, *args, iters=10, warmup=1):
+    for _ in range(warmup):
+        out = fn(*args)
+    _force(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    _force(out)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+# ------------------------------------------------------------ calibration
+def calib_matmul():
+    """Achievable dense matmul rate, bf16 and f32 — the real peak."""
+    M, K, N = 8192, 1024, 4096
+    x16 = jnp.ones((M, K), jnp.bfloat16)
+    w16 = jnp.ones((K, N), jnp.bfloat16)
+    x32 = x16.astype(jnp.float32)
+    w32 = w16.astype(jnp.float32)
+    fl = 2.0 * M * K * N
+
+    @jax.jit
+    def mm16(x, w):
+        # 8 chained matmuls amortize dispatch latency over the tunnel
+        for _ in range(8):
+            x = (x @ w)[:, :K].astype(jnp.bfloat16)
+        return x
+
+    @jax.jit
+    def mm32(x, w):
+        for _ in range(8):
+            x = (x @ w)[:, :K]
+        return x
+
+    ms = timeit(mm16, x16, w16, iters=20)
+    tf16 = 8 * fl / (ms * 1e-3) / 1e12
+    emit("calib_matmul_bf16", ms, {"tflops": round(tf16, 1)})
+    ms = timeit(mm32, x32, w32, iters=20)
+    tf32 = 8 * fl / (ms * 1e-3) / 1e12
+    emit("calib_matmul_f32", ms, {"tflops": round(tf32, 1)})
+
+
+def calib_attention():
+    """Flash fwd kernel alone vs the XLA blockwise path, fwd and fwd+bwd."""
+    from paddle_tpu.kernels import flash_attention as fa
+    from paddle_tpu.kernels.pallas_attention import mha_fwd
+    B, S, H, D = 8, 1024, 16, 64
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (B, S, H, D), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (B, S, H, D), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (B, S, H, D), jnp.bfloat16)
+
+    f = jax.jit(lambda q, k, v: mha_fwd(q, k, v, causal=True)[0])
+    emit("attn_pallas_fwd", timeit(f, q, k, v, iters=30))
+
+    f = jax.jit(lambda q, k, v: fa._blockwise_attention_lse(
+        q, k, v, True)[0])
+    emit("attn_xla_fwd", timeit(f, q, k, v, iters=30))
+
+    def loss_pallas(q, k, v):
+        return jnp.sum(fa._flash_mha(q, k, v, True).astype(jnp.float32))
+
+    g = jax.jit(jax.grad(loss_pallas, argnums=(0, 1, 2)))
+    os.environ["PADDLE_TPU_DISABLE_PALLAS_BWD"] = "1"
+    emit("attn_fwd_jaxbwd", timeit(g, q, k, v, iters=30))
+    os.environ.pop("PADDLE_TPU_DISABLE_PALLAS_BWD")
+
+    g2 = jax.jit(jax.grad(
+        lambda q, k, v: jnp.sum(fa._flash_mha(q, k, v, True)
+                                .astype(jnp.float32)) * 1.0,
+        argnums=(0, 1, 2)))
+    emit("attn_fwd_pallasbwd", timeit(g2, q, k, v, iters=30))
+
+
+# ------------------------------------------------------------ step variants
+def build(cfg_kw, batch=8, seq=1024):
+    from paddle_tpu.models.gpt import (GPTConfig, init_gpt_params,
+                                       init_opt_state)
+    kw = dict(vocab_size=32768, hidden_size=1024, num_layers=24,
+              num_heads=16, max_seq_len=1024, dtype=jnp.bfloat16,
+              sequence_parallel=False)
+    kw.update(cfg_kw)
+    cfg = GPTConfig(**kw)
+    params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+    opt = init_opt_state(params)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (batch, seq + 1), 0,
+                              cfg.vocab_size)
+    return cfg, params, opt, toks
+
+
+def step_ms(cfg, params, opt, toks, iters=10):
+    from paddle_tpu.models.gpt import train_step
+    step = jax.jit(functools.partial(train_step, cfg=cfg, lr=1e-4),
+                   donate_argnums=(0, 1))
+    t0 = time.perf_counter()
+    loss, params, opt = step(params, opt, toks)
+    float(loss)
+    log(f"  compile+first {time.perf_counter() - t0:.1f}s")
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss, params, opt = step(params, opt, toks)
+    float(loss)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def v_baseline():
+    os.environ["PADDLE_TPU_DISABLE_PALLAS_BWD"] = "1"
+    cfg, p, o, t = build(dict(remat=True, remat_policy="full"))
+    emit("full_remat_pallasfwd_jaxbwd_b8", step_ms(cfg, p, o, t))
+
+
+def v_dots():
+    os.environ["PADDLE_TPU_DISABLE_PALLAS_BWD"] = "1"
+    cfg, p, o, t = build(dict(remat=True, remat_policy="dots"))
+    emit("dots_remat_b8", step_ms(cfg, p, o, t))
+
+
+def v_noremat_b4():
+    os.environ["PADDLE_TPU_DISABLE_PALLAS_BWD"] = "1"
+    cfg, p, o, t = build(dict(remat=False), batch=4)
+    emit("noremat_b4", step_ms(cfg, p, o, t))
+
+
+def v_xla_attn():
+    os.environ["PADDLE_TPU_DISABLE_PALLAS"] = "1"
+    cfg, p, o, t = build(dict(remat=True, remat_policy="full"))
+    emit("xla_attn_b8", step_ms(cfg, p, o, t))
+    os.environ.pop("PADDLE_TPU_DISABLE_PALLAS")
+
+
+def v_no_attn():
+    """Attention replaced by identity: isolates the whole attention cost."""
+    from paddle_tpu.kernels import flash_attention as fa
+    orig = fa._flash_mha
+    fa._flash_mha = lambda q, k, v, causal, kv_len=None: v
+    try:
+        cfg, p, o, t = build(dict(remat=True, remat_policy="full"))
+        emit("no_attn_b8", step_ms(cfg, p, o, t))
+    finally:
+        fa._flash_mha = orig
+
+
+def v_fwd_only():
+    os.environ["PADDLE_TPU_DISABLE_PALLAS_BWD"] = "1"
+    from paddle_tpu.models.gpt import gpt_loss
+    cfg, p, o, t = build(dict(remat=False))
+    f = jax.jit(functools.partial(gpt_loss, cfg=cfg))
+    t0 = time.perf_counter()
+    float(f(p, t))
+    log(f"  compile+first {time.perf_counter() - t0:.1f}s")
+    t0 = time.perf_counter()
+    for _ in range(10):
+        out = f(p, t)
+    float(out)
+    emit("fwd_only_noremat_b8", (time.perf_counter() - t0) / 10 * 1e3)
+
+
+def v_no_head():
+    """Loss = mean of final hidden state: isolates LM head + softmax cost."""
+    from paddle_tpu.models import gpt as G
+    cfg, p, o, t = build(dict(remat=True, remat_policy="full"))
+
+    def loss_nohead(params, batch, cfg):
+        inp = batch[:, :-1]
+        B, S = inp.shape
+        x = jnp.take(params["wte"], inp, axis=0).astype(cfg.dtype)
+        x = x + params["wpe"][:S][None].astype(cfg.dtype)
+        stacked = {k: params[k] for k in G._BLOCK_KEYS_DENSE if k in params}
+        x, _aux = G._apply_stack(stacked, x, cfg)
+        x = G._ln(x, params["ln_f_scale"], params["ln_f_bias"],
+                  cfg.layer_norm_eps)
+        return jnp.mean(x.astype(jnp.float32))
+
+    orig = G.gpt_loss
+    G.gpt_loss = loss_nohead
+    try:
+        emit("no_head_b8", step_ms(cfg, p, o, t))
+    finally:
+        G.gpt_loss = orig
+
+
+def v_sgd():
+    """AdamW swapped for plain SGD: isolates optimizer-update cost."""
+    from paddle_tpu.models import gpt as G
+    cfg, p, o, t = build(dict(remat=True, remat_policy="full"))
+
+    def sgd_step(params, opt_state, batch, cfg, lr=1e-4, **_kw):
+        loss, grads = jax.value_and_grad(
+            lambda pp: G.gpt_loss(pp, batch, cfg))(params)
+        new_params = jax.tree_util.tree_map(
+            lambda pp, g: (pp.astype(jnp.float32)
+                           - lr * g.astype(jnp.float32)).astype(pp.dtype),
+            params, grads)
+        return loss, new_params, opt_state
+
+    orig = G.train_step
+    G.train_step = sgd_step
+    try:
+        emit("sgd_b8", step_ms(cfg, p, o, t))
+    finally:
+        G.train_step = orig
+
+
+VARIANTS = {
+    "calib": calib_matmul,
+    "calib_attn": calib_attention,
+    "baseline": v_baseline,
+    "dots": v_dots,
+    "noremat_b4": v_noremat_b4,
+    "xla_attn": v_xla_attn,
+    "no_attn": v_no_attn,
+    "fwd_only": v_fwd_only,
+    "no_head": v_no_head,
+    "sgd": v_sgd,
+}
+
+
+def main():
+    names = sys.argv[1:] or list(VARIANTS)
+    devs = jax.devices()
+    log(f"backend {devs[0].platform} ({devs[0].device_kind})")
+    for n in names:
+        log(f"=== {n} ===")
+        try:
+            VARIANTS[n]()
+        except Exception as e:
+            emit(n, -1.0, {"error": repr(e)[:200]})
+            log(f"variant {n} failed: {e!r}")
+
+
+if __name__ == "__main__":
+    main()

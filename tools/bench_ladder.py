@@ -99,14 +99,10 @@ def run_row(row: str) -> None:
         tps = 16 * 512 / dt
         n_params = sum(int(v.size) for v in params.values())
         flops_per_tok = 6.0 * n_params + 12.0 * 12 * 768 * 512
-        # device-kind-keyed peak table shared with bench.py
-        import importlib.util
-        spec = importlib.util.spec_from_file_location(
-            "bench", os.path.join(os.path.dirname(os.path.dirname(
-                os.path.abspath(__file__))), "bench.py"))
-        bench = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(bench)
-        peak = bench._peak_for(devs[0].device_kind, platform)
+        # device-kind-keyed peak table shared with bench.py (repo root is
+        # already on sys.path — run_row inserts it first thing)
+        from bench import _peak_for
+        peak = _peak_for(devs[0].device_kind, platform)
         print(json.dumps({"row": "bert_base_jit",
                           "metric": "tokens_per_sec_per_chip",
                           "value": round(tps, 1),
