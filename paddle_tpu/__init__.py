@@ -86,23 +86,25 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
 
 
 def disable_static(place=None):
-    """Dygraph is the default (and only) eager mode here."""
-    return None
+    """Back to dygraph (the default mode)."""
+    from .static.program import disable_static as _ds
+    _ds()
 
 
 def enable_static():
-    raise NotImplementedError(
-        "paddle_tpu has no legacy static-graph mode; use "
-        "paddle_tpu.jit.to_static to compile (the XLA graph IS the static "
-        "program).")
+    """Enter static-graph mode: ops record into the default Program and
+    run via static.Executor (reference paddle.enable_static; see
+    paddle_tpu/static/program.py for the TPU-native design)."""
+    from .static.program import enable_static as _es
+    _es()
 
 
 def in_dynamic_mode():
-    return True
+    from .static.program import in_static_graph_mode
+    return not in_static_graph_mode()
 
 
-def in_dygraph_mode():
-    return True
+in_dygraph_mode = in_dynamic_mode
 
 
 def set_printoptions(**kwargs):

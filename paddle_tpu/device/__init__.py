@@ -189,6 +189,63 @@ def current_stream(device=None):
     return Stream(device)
 
 
+# ------------------------------------------------------------ memory stats
+# Reference analog: paddle/fluid/memory/stats.h (DeviceMemoryStat
+# Allocated/Reserved counters) surfaced as paddle.device.cuda.
+# memory_allocated/max_memory_allocated. TPU-native: PJRT owns the
+# allocator; its live counters come back through Device.memory_stats().
+def _stats_device(device=None):
+    import jax
+    devs = jax.devices()
+    if device is None:
+        return devs[0]
+    if isinstance(device, int):
+        return devs[device]
+    s = str(device)
+    if ":" in s:
+        kind, _, idx = s.partition(":")
+        cand = [d for d in devs if d.platform == kind or kind in ("gpu",
+                                                                  "cuda")]
+        if cand:
+            return cand[int(idx) % len(cand)]
+    return devs[0]
+
+
+def memory_stats(device=None) -> dict:
+    """Raw allocator counters for a device (PJRT memory_stats: keys like
+    bytes_in_use, peak_bytes_in_use, bytes_limit...). Empty dict when the
+    backend doesn't report (CPU)."""
+    try:
+        return dict(_stats_device(device).memory_stats() or {})
+    except Exception:
+        return {}
+
+
+def memory_allocated(device=None) -> int:
+    """Bytes currently held by live arrays on the device (reference
+    DeviceMemoryStatCurrentValue("Allocated"))."""
+    return int(memory_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None) -> int:
+    """High-water mark of device bytes (reference
+    DeviceMemoryStatPeakValue("Allocated"))."""
+    return int(memory_stats(device).get("peak_bytes_in_use", 0))
+
+
+def memory_reserved(device=None) -> int:
+    """Bytes reserved from the platform by the allocator pool; PJRT
+    reports a hard limit rather than a growing reservation."""
+    st = memory_stats(device)
+    return int(st.get("bytes_reserved", st.get("bytes_in_use", 0)))
+
+
+def max_memory_reserved(device=None) -> int:
+    st = memory_stats(device)
+    return int(st.get("peak_bytes_reserved",
+                      st.get("peak_bytes_in_use", 0)))
+
+
 class cuda:
     """paddle.device.cuda compat namespace."""
     Stream = Stream
@@ -217,11 +274,19 @@ class cuda:
 
     @staticmethod
     def max_memory_allocated(device=None):
-        return 0
+        return max_memory_allocated(device)
 
     @staticmethod
     def memory_allocated(device=None):
-        return 0
+        return memory_allocated(device)
+
+    @staticmethod
+    def memory_reserved(device=None):
+        return memory_reserved(device)
+
+    @staticmethod
+    def max_memory_reserved(device=None):
+        return max_memory_reserved(device)
 
 
 # ------------------------------------------------------- pluggable backends

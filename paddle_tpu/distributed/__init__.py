@@ -18,6 +18,7 @@ from ..parallel import auto_parallel  # noqa: E402,F401
 from ..parallel.auto_parallel import (  # noqa: E402,F401
     ProcessMesh, shard_tensor, shard_op, reshard)
 _sys.modules[__name__ + ".auto_parallel"] = auto_parallel
+from . import rpc  # noqa: E402,F401
 # reference spelling: paddle.distributed.fleet.auto (Engine lives there)
 fleet.auto = auto_parallel
 _sys.modules[__name__ + ".fleet.auto"] = auto_parallel

@@ -1,0 +1,295 @@
+"""paddle_tpu.distributed.rpc — remote procedure calls between workers.
+
+Reference analog: python/paddle/distributed/rpc (init_rpc over a TCP
+master, rpc_sync/rpc_async executing a python callable on a named remote
+worker, WorkerInfo registry, shutdown barrier). The reference rides
+brpc+protobuf; TPU-native there is nothing accelerator-specific about the
+control plane, so this is a dependency-free TCP implementation: one
+length-prefixed-pickle server thread per worker, a rank-0 master that
+collects (name, addr) registrations and publishes the worker table, and
+concurrent.futures for the async surface.
+
+Security note (same trust model as the reference): payloads are pickled
+python callables — only ever bind these endpoints inside a trusted
+training cluster.
+
+Host-side only: callables run in the worker's interpreter; anything
+jax-valued they return is pulled to numpy before the wire.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+_LEN = struct.Struct("!Q")
+
+
+class WorkerInfo:
+    def __init__(self, name: str, rank: int, ip: str, port: int):
+        self.name = name
+        self.rank = rank
+        self.ip = ip
+        self.port = port
+
+    def __repr__(self):
+        return (f"WorkerInfo(name={self.name!r}, rank={self.rank}, "
+                f"ip={self.ip!r}, port={self.port})")
+
+
+class _State:
+    def __init__(self):
+        self.name: Optional[str] = None
+        self.rank = -1
+        self.world_size = 0
+        self.workers: Dict[str, WorkerInfo] = {}
+        self.server: Optional[socket.socket] = None
+        self.server_thread: Optional[threading.Thread] = None
+        self.master_thread: Optional[threading.Thread] = None
+        self.pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
+        self.shutting_down = False
+
+
+_state = _State()
+
+
+def _send_msg(sock: socket.socket, obj: Any) -> None:
+    # cloudpickle serializes lambdas/closures by value (the reference's
+    # rpc also ships callables this way); stdlib pickle.loads reads its
+    # output fine on the other side
+    try:
+        import cloudpickle
+        data = cloudpickle.dumps(obj, protocol=4)
+    except Exception:
+        data = pickle.dumps(obj, protocol=4)
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_msg(sock: socket.socket) -> Any:
+    hdr = _recv_exact(sock, _LEN.size)
+    (n,) = _LEN.unpack(hdr)
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("rpc peer closed the connection")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _to_host(obj):
+    """Pull jax/Tensor values to numpy before pickling onto the wire."""
+    import numpy as np
+    if hasattr(obj, "numpy") and callable(obj.numpy):
+        return np.asarray(obj.numpy())
+    if type(obj).__module__.startswith("jaxlib"):
+        return np.asarray(obj)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_host(v) for v in obj)
+    if isinstance(obj, dict):
+        return {k: _to_host(v) for k, v in obj.items()}
+    return obj
+
+
+# ------------------------------------------------------------------ server
+def _serve_conn(conn: socket.socket):
+    try:
+        while True:
+            try:
+                msg = _recv_msg(conn)
+            except (ConnectionError, OSError):
+                return
+            kind = msg[0]
+            if kind == "call":
+                _, fn, args, kwargs = msg
+                try:
+                    out = fn(*args, **kwargs)
+                    _send_msg(conn, ("ok", _to_host(out)))
+                except BaseException as e:  # propagate to caller
+                    import traceback
+                    _send_msg(conn, ("err", repr(e),
+                                     traceback.format_exc()))
+            elif kind == "ping":
+                _send_msg(conn, ("ok", None))
+            elif kind == "bye":
+                return
+    finally:
+        conn.close()
+
+
+def _server_loop(srv: socket.socket):
+    while not _state.shutting_down:
+        try:
+            conn, _ = srv.accept()
+        except OSError:
+            return
+        threading.Thread(target=_serve_conn, args=(conn,),
+                         daemon=True).start()
+
+
+# ------------------------------------------------------------------ master
+def _master_loop(msock: socket.socket, world_size: int):
+    """Rank-0 registration service: collect world_size (name, rank, addr)
+    entries, then answer the full table to each registrant."""
+    entries: Dict[int, WorkerInfo] = {}
+    conns: List[socket.socket] = []
+    while len(entries) < world_size:
+        conn, _ = msock.accept()
+        msg = _recv_msg(conn)
+        assert msg[0] == "register", msg
+        _, name, rank, ip, port = msg
+        entries[rank] = WorkerInfo(name, rank, ip, port)
+        conns.append(conn)
+    table = {wi.name: wi for wi in entries.values()}
+    for conn in conns:
+        _send_msg(conn, ("table", table))
+        conn.close()
+    msock.close()
+
+
+# ------------------------------------------------------------------ api
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None):
+    """Join the RPC group (reference rpc.init_rpc). Blocks until all
+    world_size workers registered with the master (rank 0 hosts it)."""
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None \
+        else rank
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) \
+        if world_size is None else world_size
+    master_endpoint = master_endpoint or os.environ.get(
+        "PADDLE_MASTER", "127.0.0.1:29511")
+    mhost, _, mport = master_endpoint.partition(":")
+    mport = int(mport)
+
+    # worker server on an ephemeral port
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("0.0.0.0", 0))
+    srv.listen(64)
+    port = srv.getsockname()[1]
+    _state.server = srv
+    _state.shutting_down = False
+    _state.server_thread = threading.Thread(
+        target=_server_loop, args=(srv,), daemon=True)
+    _state.server_thread.start()
+
+    if rank == 0:
+        msock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        msock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        msock.bind((mhost if mhost else "0.0.0.0", mport))
+        msock.listen(world_size + 8)
+        _state.master_thread = threading.Thread(
+            target=_master_loop, args=(msock, world_size), daemon=True)
+        _state.master_thread.start()
+
+    # register and receive the table (retry while the master comes up)
+    deadline = time.time() + 60.0
+    while True:
+        try:
+            c = socket.create_connection((mhost or "127.0.0.1", mport),
+                                         timeout=5.0)
+            break
+        except OSError:
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"rpc master {master_endpoint} unreachable")
+            time.sleep(0.05)
+    ip = c.getsockname()[0]
+    # the table only arrives once ALL workers registered: lift the 5s
+    # connect timeout so normal multi-host startup skew doesn't kill the
+    # early registrants mid-recv
+    c.settimeout(max(5.0, deadline - time.time()))
+    _send_msg(c, ("register", name, rank, ip, port))
+    kind, table = _recv_msg(c)
+    assert kind == "table"
+    c.close()
+
+    _state.name = name
+    _state.rank = rank
+    _state.world_size = world_size
+    _state.workers = table
+    _state.pool = concurrent.futures.ThreadPoolExecutor(
+        max_workers=max(4, world_size))
+
+
+def get_current_worker_info() -> WorkerInfo:
+    _require_init()
+    return _state.workers[_state.name]
+
+
+def get_worker_info(name: str) -> WorkerInfo:
+    _require_init()
+    return _state.workers[name]
+
+
+def get_all_worker_infos() -> List[WorkerInfo]:
+    _require_init()
+    return sorted(_state.workers.values(), key=lambda w: w.rank)
+
+
+def _require_init():
+    if not _state.workers:
+        raise RuntimeError("call paddle_tpu.distributed.rpc.init_rpc first")
+
+
+class _RemoteError(RuntimeError):
+    pass
+
+
+def _call(to: str, fn, args, kwargs, timeout):
+    _require_init()
+    wi = _state.workers[to]
+    with socket.create_connection((wi.ip, wi.port),
+                                  timeout=timeout or None) as c:
+        if timeout:
+            c.settimeout(timeout)
+        _send_msg(c, ("call", fn, tuple(args or ()), dict(kwargs or {})))
+        msg = _recv_msg(c)
+    if msg[0] == "ok":
+        return msg[1]
+    raise _RemoteError(
+        f"rpc to {to!r} failed: {msg[1]}\nremote traceback:\n{msg[2]}")
+
+
+def rpc_sync(to: str, fn, args=None, kwargs=None, timeout=None):
+    """Execute fn(*args, **kwargs) on worker `to`, return its result
+    (reference rpc.rpc_sync)."""
+    return _call(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to: str, fn, args=None, kwargs=None, timeout=None):
+    """Async variant: returns a concurrent.futures.Future with .wait()
+    aliasing .result() (the reference FutureWrapper surface)."""
+    _require_init()
+    fut = _state.pool.submit(_call, to, fn, args, kwargs, timeout)
+    if not hasattr(fut, "wait"):
+        fut.wait = fut.result  # paddle's Future spells it wait()
+    return fut
+
+
+def shutdown():
+    """Drain and leave the group (reference rpc.shutdown). Barrier-free by
+    design: each worker closes its own server; in-flight calls finish on
+    their connection threads."""
+    if _state.pool is not None:
+        _state.pool.shutdown(wait=True)
+        _state.pool = None
+    _state.shutting_down = True
+    if _state.server is not None:
+        try:
+            _state.server.close()
+        except OSError:
+            pass
+        _state.server = None
+    _state.workers = {}
+    _state.name = None

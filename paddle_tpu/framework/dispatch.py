@@ -30,6 +30,8 @@ from .tensor import Tensor
 _OP_REGISTRY: Dict[str, Callable] = {}
 _JIT_CACHE: Dict[Tuple, Callable] = {}
 _amp_mod = None
+_static_graph_mode = None   # cached static.program.in_static_graph_mode
+_record_apply = None
 
 
 def _check_nan_inf(name, out_vals):
@@ -98,8 +100,24 @@ def apply(name: str, fn: Callable, *args, _nondiff_outputs=(), **static):
     or python scalars / None / tuples (baked literals). `static` kwargs are
     always baked. `_nondiff_outputs`: indices of outputs excluded from vjp
     (e.g. argmax indices).
+
+    Static-graph mode (paddle.enable_static + Program recording): the op is
+    appended to the default Program instead of executing; shapes come from
+    jax.eval_shape. Same fn, two consumers — the reference's dygraph/static
+    duality with one kernel corpus.
     """
     static = {k: _thaw_static(v) for k, v in static.items()}
+
+    # import deferred once to dodge the framework<->static cycle, then
+    # cached: this is the hottest path in eager mode
+    global _static_graph_mode, _record_apply
+    if _static_graph_mode is None:
+        from ..static.program import in_static_graph_mode, record_apply
+        _static_graph_mode = in_static_graph_mode
+        _record_apply = record_apply
+    if _static_graph_mode():
+        return _record_apply(name, fn, args, static,
+                             nondiff_outputs=_nondiff_outputs)
 
     input_tensors = []   # Tensor objects, in positional order of array slots
     arg_plan = []        # per arg: _Lit or slot index

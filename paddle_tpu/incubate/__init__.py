@@ -3,6 +3,7 @@ APIs that graduated into the core here; this namespace re-exports them at
 the reference's import paths)."""
 from . import autograd  # noqa: F401
 from . import nn  # noqa: F401
+from . import asp  # noqa: F401
 
 
 def _softmax_mask(x, mask):

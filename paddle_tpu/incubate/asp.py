@@ -1,0 +1,147 @@
+"""paddle_tpu.incubate.asp — automatic structured (n:m) sparsity.
+
+Reference analog: python/paddle/incubate/asp (prune_model computing 2:4
+masks per supported layer, decorate() wrapping the optimizer so masks are
+re-applied after every step, calculate_density, excluded-layer registry —
+asp/asp.py + supported_layer_list.py).
+
+TPU note: n:m sparsity is an Ampere tensor-core execution feature; the
+MXU has no sparse mode, so here ASP is a *model sparsification workflow*
+(train with masks → export a provably 2:4-sparse model) rather than a
+speedup. The mask math is pure jax and runs on device.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+_excluded_layers: Dict[int, List[str]] = {}
+_masks: Dict[str, jnp.ndarray] = {}
+
+
+# ------------------------------------------------------------------ masks
+def compute_mask_1d(weight, n: int = 2, m: int = 4):
+    """n:m mask along the LAST axis: in every group of m consecutive
+    elements keep the n largest |w| (reference asp/utils.py
+    compute_valid_2d_patterns/get_mask_1d)."""
+    w = jnp.asarray(weight)
+    size = w.shape[-1]
+    if size % m != 0:
+        raise ValueError(f"last dim {size} not divisible by m={m}")
+    g = w.reshape(w.shape[:-1] + (size // m, m))
+    # rank within each group; keep the top-n magnitudes
+    order = jnp.argsort(jnp.abs(g), axis=-1)          # ascending
+    ranks = jnp.argsort(order, axis=-1)               # rank of each elem
+    mask = (ranks >= (m - n)).astype(w.dtype)
+    return mask.reshape(w.shape)
+
+
+def compute_mask_2d_greedy(weight, n: int = 2, m: int = 4):
+    """Greedy 2D variant: mask both the last axis in n:m groups AND
+    approximately balance rows (reference get_mask_2d_greedy). Here: 1D
+    masks computed on w and wᵀ, intersected where both agree, then
+    repaired per-group to keep exactly n survivors by magnitude."""
+    w = jnp.asarray(weight)
+    if w.ndim != 2 or w.shape[0] % m or w.shape[1] % m:
+        return compute_mask_1d(w, n, m)
+    # favor elements that survive in both row- and column-group ranking
+    row_mask = compute_mask_1d(w, n, m)
+    col_mask = compute_mask_1d(w.T, n, m).T
+    score = jnp.abs(w) * (1.0 + row_mask + col_mask)
+    size = w.shape[-1]
+    g = score.reshape(score.shape[:-1] + (size // m, m))
+    order = jnp.argsort(g, axis=-1)
+    ranks = jnp.argsort(order, axis=-1)
+    mask = (ranks >= (m - n)).astype(w.dtype)
+    return mask.reshape(w.shape)
+
+
+MASK_ALGOS = {
+    "mask_1d": compute_mask_1d,
+    "mask_2d_greedy": compute_mask_2d_greedy,
+    "mask_2d_best": compute_mask_2d_greedy,   # greedy is the tractable best
+}
+
+
+def check_mask_1d(weight, n: int = 2, m: int = 4) -> bool:
+    """True iff every m-group of the last axis has ≤ (m-n) nonzeros
+    masked out, i.e. ≥ m-n zeros... i.e. at most n nonzeros."""
+    w = np.asarray(weight)
+    if w.shape[-1] % m:
+        return False
+    g = (w.reshape(-1, m) != 0).sum(axis=-1)
+    return bool((g <= n).all())
+
+
+def calculate_density(tensor) -> float:
+    w = np.asarray(tensor.numpy() if hasattr(tensor, "numpy") else tensor)
+    return float((w != 0).sum() / max(1, w.size))
+
+
+# ----------------------------------------------------------- layer registry
+def set_excluded_layers(model, param_names: List[str]):
+    """Skip these parameters in prune_model/decorate (reference
+    asp.set_excluded_layers)."""
+    _excluded_layers.setdefault(id(model), []).extend(param_names)
+
+
+def reset_excluded_layers(model=None):
+    if model is None:
+        _excluded_layers.clear()
+    else:
+        _excluded_layers.pop(id(model), None)
+
+
+def _prunable_params(model):
+    excluded = set(_excluded_layers.get(id(model), []))
+    out = []
+    for name, p in model.named_parameters():
+        if name in excluded:
+            continue
+        shape = tuple(p.shape)
+        # the reference prunes FC/conv weight matrices, not biases/norms
+        if len(shape) >= 2 and shape[-1] % 4 == 0:
+            out.append((name, p))
+    return out
+
+
+# ------------------------------------------------------------- workflow
+def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
+                with_mask: bool = True):
+    """Compute and apply n:m masks to the model's prunable weights
+    (reference asp.prune_model). Masks are remembered so a decorated
+    optimizer keeps re-applying them each step."""
+    algo = MASK_ALGOS[mask_algo]
+    pruned = {}
+    for name, p in _prunable_params(model):
+        mask = algo(p._value.astype(jnp.float32), n, m).astype(p.dtype)
+        p._value = (p._value * mask)
+        if with_mask:
+            # keyed by Parameter identity: the object persists across
+            # steps (step() swaps p._value in place), so the decorated
+            # optimizer can find its mask regardless of naming scheme
+            _masks[id(p)] = mask
+        pruned[name] = mask
+    return pruned
+
+
+def decorate(optimizer):
+    """Wrap optimizer.step so the ASP masks are re-applied after every
+    update (reference asp.decorate → OptimizerWithSparsityGuarantee)."""
+    orig_step = optimizer.step
+    params = list(optimizer._parameter_list)
+
+    def masked_step(*a, **kw):
+        out = orig_step(*a, **kw)
+        for p in params:
+            mask = _masks.get(id(p))
+            if mask is not None and mask.shape == tuple(p.shape):
+                p._value = p._value * mask.astype(p.dtype)
+        return out
+
+    optimizer.step = masked_step
+    optimizer._asp_decorated = True
+    return optimizer

@@ -32,9 +32,13 @@ class Optimizer:
                  weight_decay=None, grad_clip=None, name=None,
                  multi_precision=False):
         if parameters is None:
-            raise ValueError(
-                "paddle_tpu optimizers require an explicit parameter list "
-                "(pass model.parameters())")
+            from ..static.program import in_static_graph_mode
+            if not in_static_graph_mode():
+                raise ValueError(
+                    "paddle_tpu optimizers require an explicit parameter "
+                    "list (pass model.parameters()); in static-graph mode "
+                    "parameters come from the Program via minimize(loss)")
+            parameters = []
         self._parameter_list = [p for p in parameters]
         self._learning_rate = learning_rate
         self._grad_clip = grad_clip
@@ -150,6 +154,14 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        from ..static.program import Variable, set_train_spec
+        if isinstance(loss, Variable):
+            # static-graph mode: record the train spec on the program the
+            # loss actually lives in (NOT the current default — minimize
+            # may be called outside the program_guard); the Executor
+            # compiles grad + this optimizer's pure _update as one step
+            set_train_spec(loss.block.program, self, loss)
+            return None, None
         loss.backward()
         self.step()
         return None, None

@@ -1,0 +1,66 @@
+"""paddle_tpu.pir — the IR surface.
+
+Reference analog: paddle/pir/ + paddle/fluid/pir/ (the new IR: typed ops in
+SSA form, translated from ProgramDesc by translate_to_pir, lowered by
+pass pipelines). TPU-native collapse: the SSA IR of record here is the
+jaxpr → StableHLO pipeline jax/XLA already maintains — this module makes
+it inspectable at the paddle API shape instead of re-implementing an IR.
+
+- translate_to_pir(program) → the composed jaxpr of a static Program
+  (paddle_tpu.static.Program), i.e. what the reference's
+  ProgramDesc→pir translator produces: one SSA module for the graph.
+- get_jaxpr(fn, *args) / get_stablehlo(fn, *args) — the same two levels
+  for any jax-traceable callable (jit.to_static'ed models included).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def translate_to_pir(program=None):
+    """Compose a static Program's recorded ops into one function and
+    return its ClosedJaxpr — the SSA-form IR of the whole graph
+    (reference pir::Program from translate_to_pir). str() it for the
+    textual form."""
+    from .static.program import (default_main_program, _replay,
+                                 _replay_guard, _DYN_DIM)
+    program = program or default_main_program()
+    block = program.global_block()
+
+    feed_vars = [v for v in block.vars.values() if v.is_feed]
+    param_vars = [v for v in block.vars.values() if v.is_parameter]
+    names = [v.name for v in feed_vars + param_vars]
+    avals = [jax.ShapeDtypeStruct(
+        tuple(8 if s == _DYN_DIM else s for s in v._value.shape),
+        v._value.dtype) for v in feed_vars + param_vars]
+
+    def composed(*vals):
+        env = dict(zip(names, vals))
+        with _replay_guard():
+            _replay(block, env)
+        outs = [env[nm] for op in block.ops for nm in op.out_names
+                if nm in env]
+        return outs[-1] if outs else ()
+
+    return jax.make_jaxpr(composed)(*avals)
+
+
+def get_jaxpr(fn, *example_args, **kwargs):
+    """ClosedJaxpr of any jax-traceable callable (the tier below
+    StableHLO; reference analog: the pir program before lowering)."""
+    return jax.make_jaxpr(fn, **kwargs)(*example_args)
+
+
+def get_stablehlo(fn, *example_args) -> str:
+    """StableHLO text of the lowered computation — the serialized,
+    versioned IR (what paddle_tpu.jit.save persists)."""
+    return jax.jit(fn).lower(*example_args).as_text()
+
+
+def core_uses_pir() -> bool:
+    """Reference paddle.base.framework.in_pir_mode analog: the jaxpr/
+    StableHLO pipeline is always on."""
+    return True

@@ -1,16 +1,31 @@
 """paddle_tpu.static — static-graph-mode API surface.
 
 Reference analog: python/paddle/static (Program/Executor over ProgramDesc +
-InterpreterCore, SURVEY.md §2.3). TPU-native collapse: the XLA computation
-IS the static program — `paddle_tpu.jit.to_static` traces once and compiles
-— so this namespace provides the reference-shaped entry points that remain
-meaningful (InputSpec, control flow, save/load_inference_model) instead of a
-Program/Block graph-construction frontend.
+InterpreterCore, SURVEY.md §2.3). Two complementary paths here:
+
+- `paddle_tpu.jit.to_static` — trace a dygraph callable once into one XLA
+  computation (the dy2static bridge, the TPU-native main road).
+- This namespace's Program/Block frontend (static/program.py) — the
+  reference's graph-construction API: `enable_static()`, `data()`, ops
+  recorded into a Program, `Executor.run(feed, fetch_list)`, with
+  `Optimizer.minimize` compiling one fused differentiate-and-update step.
+  The recorded Program's composed jaxpr is the IR surface
+  (paddle_tpu.pir.translate_to_pir).
 """
 from __future__ import annotations
 
 from ..jit.static_function import InputSpec  # noqa: F401
+from .program import (Program, Variable, Executor, program_guard,  # noqa
+                      default_main_program, default_startup_program,
+                      data, global_scope, scope_guard, Scope,
+                      create_parameter, append_backward,
+                      enable_static, disable_static,
+                      in_static_graph_mode)
 from . import nn  # noqa: F401
+
+
+def cpu_places(device_count=1):
+    return ["cpu"] * device_count
 
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,

@@ -1,1 +1,49 @@
+"""paddle_tpu.static.nn — static-graph layer helpers.
+
+Reference analog: python/paddle/static/nn (fc, embedding, batch_norm ...,
+static_nn.py). Layers create their parameters via
+static.create_parameter (initializer ops recorded into the startup
+program) and record their math through the normal op dispatch.
+"""
 from .control_flow import cond, while_loop, case, switch_case  # noqa: F401
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """Fully-connected layer (reference static.nn.fc): flattens trailing
+    dims, y = act(x @ W + b). W is Xavier-uniform, b zeros (the reference
+    defaults)."""
+    from ..program import create_parameter
+    in_dim = 1
+    for s in x.shape[num_flatten_dims:]:
+        if s == -1:
+            raise ValueError(
+                "fc needs static trailing dims to size its weight; got "
+                f"shape {x.shape} with num_flatten_dims={num_flatten_dims}")
+        in_dim *= int(s)
+    w = create_parameter([in_dim, size], x.dtype, name=name and f"{name}.w")
+    use_bias = bias_attr is not False
+    import paddle_tpu as paddle
+    h = x
+    if len(x.shape) > num_flatten_dims + 1 or num_flatten_dims != 1:
+        lead = list(x.shape[:num_flatten_dims])
+        lead = [(-1 if s == -1 else int(s)) for s in lead]
+        h = paddle.reshape(h, lead + [in_dim])
+    y = paddle.matmul(h, w)
+    if use_bias:
+        b = create_parameter([size], x.dtype, name=name and f"{name}.b",
+                             is_bias=True)
+        y = y + b
+    if activation:
+        import paddle_tpu.nn.functional as F
+        y = getattr(F, activation)(y)
+    return y
+
+
+def embedding(input, size, padding_idx=None, weight_attr=None, name=None):
+    """Static embedding lookup (reference static.nn.embedding)."""
+    from ..program import create_parameter
+    import paddle_tpu.nn.functional as F
+    w = create_parameter(list(size), "float32",
+                         name=name and f"{name}.w")
+    return F.embedding(input, w, padding_idx=padding_idx)

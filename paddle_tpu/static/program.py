@@ -1,0 +1,614 @@
+"""Program/Block static-graph frontend.
+
+Reference analog: python/paddle/static (Program, Block, program_guard,
+data, Executor, global_scope — fluid/framework.py + executor.py over
+ProgramDesc + InterpreterCore, SURVEY.md §2.3).
+
+TPU-native redesign: a Program is a recorded list of op nodes — each node
+is the SAME pure jax function the eager dispatch layer runs, plus a
+binding plan from variable names to its arguments. Executing a program
+composes the nodes into one pure function (feeds, params) -> fetches and
+jit-compiles it: the XLA computation IS the InterpreterCore plan, and the
+jaxpr of that composed function IS the IR (exposed via paddle_tpu.pir).
+Gradients don't need per-op grad kernels: `Optimizer.minimize` records a
+train spec and the Executor differentiates the composed function with
+jax.value_and_grad, then applies the optimizer's pure `_update` rule —
+one fused train step per (program, feeds, fetches) signature.
+
+Variables are symbolic Tensors: `_value` holds a jax.ShapeDtypeStruct, so
+the whole Tensor operator surface (x + y, x.matmul, paddle.* functional
+ops) works unchanged — the dispatch layer sees static mode and records
+instead of executing.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+# record-time stand-ins for unknown (-1 / None) dims: shape inference runs
+# with BOTH sizes and dims that differ between the runs are dynamic, so a
+# real dim that happens to equal a sentinel is never misclassified
+_DYN_DIM = 97
+_DYN_DIM2 = 89
+
+
+class _Mode(threading.local):
+    def __init__(self):
+        self.static = False      # paddle.enable_static() state
+        self.replaying = False   # executor is tracing a compiled replay
+
+
+_mode = _Mode()
+
+
+def in_static_graph_mode() -> bool:
+    return _mode.static and not _mode.replaying
+
+
+def enable_static():
+    _mode.static = True
+
+
+def disable_static():
+    _mode.static = False
+
+
+@contextlib.contextmanager
+def _replay_guard():
+    prev = _mode.replaying
+    _mode.replaying = True
+    try:
+        yield
+    finally:
+        _mode.replaying = prev
+
+
+class Variable(Tensor):
+    """Symbolic tensor in a Program (reference framework.py Variable).
+    `_value` is a jax.ShapeDtypeStruct; any attempt to read data eagerly
+    raises with a pointer to Executor.run."""
+
+    __slots__ = ("block", "is_parameter", "is_feed", "_dyn_dims")
+
+    def __init__(self, name: str, shape, dtype, block,
+                 is_parameter=False, is_feed=False, stop_gradient=True):
+        dt = np.dtype(dtype) if not isinstance(dtype, np.dtype) else dtype
+        shp = tuple(int(s) for s in shape)
+        self._dyn_dims = tuple(i for i, s in enumerate(shp) if s in (-1,))
+        aval_shape = tuple(_DYN_DIM if s == -1 else s for s in shp)
+        self._value = jax.ShapeDtypeStruct(aval_shape, dt)
+        self.stop_gradient = stop_gradient
+        self._grad = None
+        self._node = None
+        self._out_idx = 0
+        self.name = name
+        self.block = block
+        self.is_parameter = is_parameter
+        self.is_feed = is_feed
+
+    @property
+    def shape(self):
+        # _dyn_dims is authoritative (differential inference in
+        # record_apply); everything else is a true static size
+        return [-1 if i in self._dyn_dims else int(s)
+                for i, s in enumerate(self._value.shape)]
+
+    def numpy(self):
+        raise RuntimeError(
+            f"Variable '{self.name}' is symbolic (static-graph mode): run "
+            "it through paddle_tpu.static.Executor.run(feed=..., "
+            "fetch_list=[...]) to get values")
+
+    def __repr__(self):
+        kind = "param" if self.is_parameter else \
+            ("feed" if self.is_feed else "var")
+        return (f"Variable(name={self.name!r}, shape={self.shape}, "
+                f"dtype={self._value.dtype}, {kind})")
+
+    __str__ = __repr__
+
+    def __format__(self, spec):
+        # Tensor.__format__ pulls .item() for 0-d values; symbolic
+        # variables format as their repr instead
+        return repr(self)
+
+
+class _Ref:
+    """Argument-plan entry that names a variable."""
+    __slots__ = ("name",)
+
+    def __init__(self, name):
+        self.name = name
+
+
+class _Lit:
+    """Argument-plan entry holding a baked literal (incl. concrete arrays
+    from eager Tensors mixed into a static graph)."""
+    __slots__ = ("v",)
+
+    def __init__(self, v):
+        self.v = v
+
+
+class OpNode:
+    __slots__ = ("type", "fn", "arg_plan", "attrs", "out_names")
+
+    def __init__(self, type, fn, arg_plan, attrs, out_names):
+        self.type = type
+        self.fn = fn
+        self.arg_plan = arg_plan
+        self.attrs = attrs
+        self.out_names = out_names
+
+    def input_names(self):
+        return [a.name for a in self.arg_plan if isinstance(a, _Ref)]
+
+    def __repr__(self):
+        ins = ", ".join(self.input_names())
+        outs = ", ".join(self.out_names)
+        return f"{{Op({self.type}): ({ins}) -> ({outs})}}"
+
+
+class Block:
+    def __init__(self, program, idx=0):
+        self.program = program
+        self.idx = idx
+        self.ops: List[OpNode] = []
+        self.vars: Dict[str, Variable] = {}
+
+    def var(self, name):
+        if name not in self.vars:
+            raise ValueError(f"no variable named {name!r} in this block")
+        return self.vars[name]
+
+    def create_var(self, name, shape, dtype, **kw):
+        v = Variable(name, shape, dtype, self, **kw)
+        self.vars[name] = v
+        return v
+
+    def append_op(self, node: OpNode):
+        self.ops.append(node)
+        self.program._version += 1
+
+
+class Program:
+    """An ordered op recording (reference Program over ProgramDesc). One
+    global block in this design — control flow stays INSIDE ops as
+    lax.cond/scan (static/nn/control_flow.py), which is the XLA-native
+    sub-block representation."""
+
+    _uid_counter = 0
+
+    def __init__(self):
+        self.blocks = [Block(self, 0)]
+        self.random_seed = 0
+        self._version = 0
+        self._train_spec = None       # set by Optimizer.minimize
+        self._param_counter = 0
+        # identity for executor caches: id() can be reused after gc, a
+        # monotonic uid cannot
+        Program._uid_counter += 1
+        self._uid = Program._uid_counter
+
+    def global_block(self) -> Block:
+        return self.blocks[0]
+
+    def current_block(self) -> Block:
+        return self.blocks[-1]
+
+    def list_vars(self):
+        return list(self.global_block().vars.values())
+
+    def all_parameters(self):
+        return [v for v in self.list_vars() if v.is_parameter]
+
+    def clone(self, for_test=False):
+        import copy
+        p = Program()
+        p.random_seed = self.random_seed
+        b = p.global_block()
+        b.ops = list(self.global_block().ops)
+        b.vars = dict(self.global_block().vars)
+        p._train_spec = None if for_test else self._train_spec
+        p._version = self._version
+        return p
+
+    def to_string(self, throw_on_error=False, with_details=False):
+        lines = [f"{{ // block 0"]
+        for v in self.global_block().vars.values():
+            lines.append(f"    {v}")
+        for op in self.global_block().ops:
+            lines.append(f"    {op}")
+        lines.append("}")
+        return "\n".join(lines)
+
+    __str__ = to_string
+
+    def _unique_name(self, stem):
+        self._param_counter += 1
+        return f"{stem}_{self._param_counter}"
+
+
+_default_main = Program()
+_default_startup = Program()
+
+
+def default_main_program() -> Program:
+    return _default_main
+
+
+def default_startup_program() -> Program:
+    return _default_startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    global _default_main, _default_startup
+    prev = (_default_main, _default_startup)
+    _default_main = main_program
+    if startup_program is not None:
+        _default_startup = startup_program
+    try:
+        yield
+    finally:
+        _default_main, _default_startup = prev
+
+
+def data(name: str, shape, dtype="float32", lod_level=0):
+    """Feed placeholder (reference static.data). dim -1/None = set per
+    Executor.run from the actual feed (each feed shape compiles once)."""
+    shape = [(-1 if s is None else int(s)) for s in shape]
+    return default_main_program().global_block().create_var(
+        name, shape, dtype, is_feed=True)
+
+
+# ------------------------------------------------------------------ record
+def record_apply(op_name: str, fn: Callable, args, static: dict,
+                 nondiff_outputs=()):
+    """dispatch.apply's static-graph branch: infer output avals with
+    jax.eval_shape and append an OpNode instead of executing.
+
+    Dynamic (-1) dims propagate by differential inference: shapes are
+    evaluated with two different stand-in sizes for the dynamic dims, and
+    output dims that change between the two runs are dynamic — so a real
+    size-97 dim is never mistaken for a batch dim."""
+    block = default_main_program().current_block()
+    arg_plan, avals, avals2 = [], [], []
+    for a in args:
+        if isinstance(a, Variable):
+            arg_plan.append(_Ref(a.name))
+            avals.append(a._value)
+            shp2 = tuple(_DYN_DIM2 if i in a._dyn_dims else s
+                         for i, s in enumerate(a._value.shape))
+            avals2.append(jax.ShapeDtypeStruct(shp2, a._value.dtype))
+        elif isinstance(a, Tensor):
+            arg_plan.append(_Lit(a._value))      # concrete eager mixed in
+        elif isinstance(a, (jax.Array, np.ndarray)):
+            arg_plan.append(_Lit(jnp.asarray(a)))
+        else:
+            arg_plan.append(_Lit(a))
+
+    def shaped(*var_avals):
+        it = iter(var_avals)
+        full = [next(it) if isinstance(p, _Ref) else p.v for p in arg_plan]
+        return fn(*full, **static)
+
+    out_avals = jax.eval_shape(shaped, *avals)
+    multi = isinstance(out_avals, (tuple, list))
+    outs_a = tuple(out_avals) if multi else (out_avals,)
+
+    any_dyn = any(a.shape != b.shape for a, b in zip(avals, avals2))
+    outs_b = outs_a
+    if any_dyn:
+        try:
+            ob = jax.eval_shape(shaped, *avals2)
+            outs_b = tuple(ob) if multi else (ob,)
+        except Exception:
+            outs_b = outs_a                  # shape-sensitive op: fall back
+
+    out_vars = []
+    prog = default_main_program()
+    for av, av2 in zip(outs_a, outs_b):
+        nm = prog._unique_name(f"{op_name}.out")
+        v = block.create_var(nm, av.shape, av.dtype)
+        v._value = av                       # keep exact aval (incl. 97s)
+        v._dyn_dims = tuple(i for i, (s1, s2) in
+                            enumerate(zip(av.shape, av2.shape)) if s1 != s2)
+        out_vars.append(v)
+    block.append_op(OpNode(op_name, fn, arg_plan, dict(static),
+                           [v.name for v in out_vars]))
+    return out_vars[0] if not multi else list(out_vars)
+
+
+def create_parameter(shape, dtype="float32", name=None, initializer=None,
+                     is_bias=False, stop_gradient=False):
+    """Create a trainable parameter: the Variable lives in the main
+    program; its initializer op is recorded into the startup program
+    (reference: framework.py create_parameter + startup ProgramDesc)."""
+    main, startup = default_main_program(), default_startup_program()
+    nm = name or main._unique_name("param_b" if is_bias else "param_w")
+    v = main.global_block().create_var(nm, shape, dtype, is_parameter=True,
+                                       stop_gradient=stop_gradient)
+    shape = tuple(int(s) for s in shape)
+    if initializer is None:
+        if is_bias:
+            def initializer(key, shape=shape, dtype=dtype):
+                return jnp.zeros(shape, dtype)
+        else:
+            # Xavier/Glorot uniform — the reference fc default
+            fan_in = shape[0] if len(shape) > 1 else max(1, shape[0])
+            fan_out = shape[-1] if len(shape) > 1 else max(1, shape[0])
+            limit = float(np.sqrt(6.0 / (fan_in + fan_out)))
+
+            def initializer(key, shape=shape, dtype=dtype, limit=limit):
+                return jax.random.uniform(key, shape, jnp.float32,
+                                          -limit, limit).astype(dtype)
+    seed_idx = len(startup.global_block().ops)
+
+    def init_fn(seed=None, _init=initializer, _idx=seed_idx):
+        base = default_startup_program().random_seed or 0
+        key = jax.random.PRNGKey(base * 1000003 + _idx)
+        return _init(key)
+
+    startup.global_block().append_op(
+        OpNode("fill_parameter", init_fn, [], {}, [nm]))
+    startup.global_block().vars[nm] = v
+    return v
+
+
+# ------------------------------------------------------------------- scope
+class _ScopeVar:
+    def __init__(self, scope, name):
+        self._scope = scope
+        self._name = name
+
+    def get_tensor(self):
+        return self
+
+    def set(self, value, place=None):
+        self._scope._vars[self._name] = jnp.asarray(value)
+
+    def numpy(self):
+        return np.asarray(self._scope._vars[self._name])
+
+    def __array__(self):
+        return self.numpy()
+
+
+class Scope:
+    """name -> device array store (reference framework::Scope)."""
+
+    def __init__(self):
+        self._vars: Dict[str, jnp.ndarray] = {}
+
+    def var(self, name):
+        return _ScopeVar(self, name)
+
+    def find_var(self, name):
+        return _ScopeVar(self, name) if name in self._vars else None
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    global _global_scope
+    prev = _global_scope
+    _global_scope = scope
+    try:
+        yield
+    finally:
+        _global_scope = prev
+
+
+# ---------------------------------------------------------------- executor
+def _replay(block: Block, env: Dict[str, Any]):
+    """Execute a block's ops in order against an environment."""
+    for node in block.ops:
+        args = [env[a.name] if isinstance(a, _Ref) else a.v
+                for a in node.arg_plan]
+        out = node.fn(*args, **node.attrs)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        for nm, val in zip(node.out_names, outs):
+            env[nm] = val
+    return env
+
+
+class Executor:
+    """Compile-and-run a Program (reference static.Executor over
+    InterpreterCore). Each (program version, feed signature, fetch list)
+    compiles once; parameters live in the scope between runs."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache: Dict[tuple, Any] = {}
+        self._opt_states: Dict[int, Any] = {}
+
+    def close(self):
+        self._cache.clear()
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            scope=None, return_numpy=True):
+        program = program or default_main_program()
+        feed = feed or {}
+        scope = scope or global_scope()
+        fetch_list = fetch_list or []
+        fetch_names = [f.name if isinstance(f, Variable) else str(f)
+                       for f in fetch_list]
+
+        block = program.global_block()
+        param_names = sorted(
+            {v.name for v in block.vars.values() if v.is_parameter}
+            | {nm for op in block.ops if op.type == "fill_parameter"
+               for nm in op.out_names})
+
+        # startup-style program: no feeds needed, writes params into scope
+        is_startup = all(op.type == "fill_parameter" for op in block.ops) \
+            and block.ops
+        if is_startup and not fetch_names:
+            with _replay_guard():
+                env = _replay(block, {})
+            scope._vars.update(env)
+            return []
+
+        feed_names = sorted(feed)
+        feed_vals = [jnp.asarray(feed[k].numpy()
+                                 if isinstance(feed[k], Tensor)
+                                 else feed[k]) for k in feed_names]
+        missing = [p for p in param_names if p not in scope._vars]
+        if missing:
+            raise RuntimeError(
+                f"parameters {missing} are uninitialized: run the startup "
+                "program first (exe.run(paddle_tpu.static."
+                "default_startup_program()))")
+        param_vals = [scope._vars[p] for p in param_names]
+
+        key = (program._uid, program._version, tuple(feed_names),
+               tuple(v.shape + (str(v.dtype),) for v in feed_vals),
+               tuple(fetch_names), bool(program._train_spec))
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = self._compile(program, feed_names, fetch_names,
+                               param_names)
+            self._cache[key] = fn
+
+        if program._train_spec:
+            opt = program._train_spec["optimizer"]
+            st_key = (program._uid, tuple(param_names))
+            if st_key not in self._opt_states:
+                self._opt_states[st_key] = {
+                    "state": [[jnp.zeros(v.shape, jnp.float32)
+                               for _ in opt._state_keys]
+                              for v in param_vals],
+                    "step": 0}
+            ost = self._opt_states[st_key]
+            ost["step"] += 1
+            lr = jnp.asarray(opt.get_lr(), jnp.float32)
+            step = jnp.asarray(ost["step"], jnp.float32)
+            fetches, new_params, new_state = fn(
+                param_vals, feed_vals, ost["state"], lr, step)
+            ost["state"] = new_state
+            scope._vars.update(zip(param_names, new_params))
+        else:
+            fetches = fn(param_vals, feed_vals)
+        if return_numpy:
+            return [np.asarray(v) for v in fetches]
+        return list(fetches)
+
+    def _compile(self, program, feed_names, fetch_names, param_names):
+        block = program.global_block()
+        spec = program._train_spec
+
+        grad_requests = [f for f in fetch_names if f.endswith("@GRAD")]
+        plain_fetches = [f for f in fetch_names if not f.endswith("@GRAD")]
+
+        def forward(param_vals, feed_vals):
+            env = dict(zip(param_names, param_vals))
+            env.update(zip(feed_names, feed_vals))
+            with _replay_guard():
+                _replay(block, env)
+            return env
+
+        if spec is None and not grad_requests:
+            @jax.jit
+            def infer_fn(param_vals, feed_vals):
+                env = forward(param_vals, feed_vals)
+                return [env[f] for f in fetch_names]
+            return infer_fn
+
+        loss_name = (spec or {}).get("loss") or \
+            (grad_requests and _loss_for_grads(program))
+        opt = (spec or {}).get("optimizer")
+
+        def loss_and_env(param_vals, feed_vals):
+            env = forward(param_vals, feed_vals)
+            loss = env[loss_name]
+            if loss.ndim != 0:
+                loss = jnp.mean(loss)
+            return loss, env
+
+        if opt is None:
+            # append_backward path: grads fetched, no update
+            @jax.jit
+            def grad_fn(param_vals, feed_vals):
+                (loss, env), grads = jax.value_and_grad(
+                    loss_and_env, has_aux=True)(param_vals, feed_vals)
+                gmap = dict(zip(param_names, grads))
+                out = []
+                for f in fetch_names:
+                    out.append(gmap[f[:-5]] if f.endswith("@GRAD")
+                               else env[f])
+                return out
+            return grad_fn
+
+        keys = opt._state_keys
+        decay = opt._weight_decay_coeff
+        decay_in_grad = opt._apply_decay_to_grad()
+        # AdamW-family decoupled decay (p *= 1 - lr*coeff before the
+        # update) — same math its eager _build_step_fn_for applies
+        decoupled = 0.0 if decay_in_grad else \
+            float(getattr(opt, "_coeff", 0.0))
+        clip = opt._grad_clip
+        update = opt._update
+
+        @jax.jit
+        def train_fn(param_vals, feed_vals, states, lr, step):
+            (loss, env), grads = jax.value_and_grad(
+                loss_and_env, has_aux=True)(param_vals, feed_vals)
+            gs = [g.astype(jnp.float32) for g in grads]
+            if clip is not None:
+                gs = clip._clip_values(gs)
+            new_params, new_states = [], []
+            for p, g, st in zip(param_vals, gs, states):
+                if decay and decay_in_grad:
+                    g = g + decay * p.astype(jnp.float32)
+                if decoupled:
+                    p = p * (1.0 - lr * decoupled)
+                np_, ns_ = update(p, g, dict(zip(keys, st)), lr, step)
+                new_params.append(np_.astype(p.dtype))
+                new_states.append([ns_[k] for k in keys])
+            gmap = dict(zip(param_names, grads))
+            out = []
+            for f in fetch_names:
+                out.append(gmap[f[:-5]] if f.endswith("@GRAD")
+                           else env[f])
+            return out, new_params, new_states
+        return train_fn
+
+
+def _loss_for_grads(program):
+    bl = getattr(program, "_backward_loss", None)
+    if bl is None:
+        raise RuntimeError(
+            "fetching @GRAD variables requires append_backward(loss) or "
+            "optimizer.minimize(loss) on this program first")
+    return bl
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None):
+    """Mark `loss` for differentiation (reference static append_backward).
+    Returns [(param, grad_name)]; fetch '<param>@GRAD' to read gradients —
+    the Executor computes them with jax.value_and_grad over the composed
+    program, no per-op grad graph needed."""
+    prog = default_main_program()
+    prog._backward_loss = loss.name
+    prog._version += 1
+    return [(p, f"{p.name}@GRAD") for p in prog.all_parameters()]
+
+
+def set_train_spec(program, optimizer, loss):
+    program._train_spec = {"optimizer": optimizer, "loss": loss.name}
+    program._backward_loss = loss.name
+    program._version += 1

@@ -1,0 +1,260 @@
+"""Program/Block static-graph frontend (static/program.py).
+
+Reference behaviors matched: python/paddle/static — enable_static +
+program_guard + data + recorded ops, Executor.run(feed, fetch_list),
+startup-program initialization, optimizer.minimize training,
+append_backward gradient fetches, Program.clone(for_test), and the pir
+translation surface.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+
+
+@pytest.fixture
+def static_mode():
+    paddle.enable_static()
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        yield main, startup
+    paddle.disable_static()
+
+
+def _init(exe, main, startup):
+    with static.program_guard(main, startup):
+        exe.run(startup)
+
+
+class TestProgramBuild:
+    def test_data_and_op_recording(self, static_mode):
+        main, startup = static_mode
+        x = static.data("x", [-1, 4], "float32")
+        y = x * 2.0 + 1.0
+        assert isinstance(y, static.Variable)
+        assert y.shape == [-1, 4]
+        assert len(main.global_block().ops) == 2
+        # recorded, not executed
+        with pytest.raises(RuntimeError, match="symbolic"):
+            y.numpy()
+
+    def test_fc_creates_params_in_startup(self, static_mode):
+        main, startup = static_mode
+        x = static.data("x", [-1, 6], "float32")
+        static.nn.fc(x, 3)
+        params = main.all_parameters()
+        assert len(params) == 2                      # W and b
+        assert sorted(tuple(p.shape) for p in params) == [(3,), (6, 3)]
+        inits = [op for op in startup.global_block().ops
+                 if op.type == "fill_parameter"]
+        assert len(inits) == 2
+
+    def test_program_str_lists_ops(self, static_mode):
+        main, _ = static_mode
+        x = static.data("x", [2, 2], "float32")
+        paddle.exp(x)
+        s = str(main)
+        assert "exp" in s and "Variable" in s
+
+
+class TestExecutor:
+    def test_forward_matches_eager(self, static_mode):
+        main, startup = static_mode
+        x = static.data("x", [-1, 3], "float32")
+        y = paddle.exp(x) + paddle.tanh(x)
+        exe = static.Executor()
+        _init(exe, main, startup)
+        X = np.random.RandomState(0).randn(5, 3).astype(np.float32)
+        out, = exe.run(main, feed={"x": X}, fetch_list=[y])
+        np.testing.assert_allclose(out, np.exp(X) + np.tanh(X), rtol=1e-5)
+
+    def test_uninitialized_params_raise(self, static_mode):
+        main, startup = static_mode
+        x = static.data("x", [-1, 3], "float32")
+        y = static.nn.fc(x, 2)
+        exe = static.Executor()
+        with pytest.raises(RuntimeError, match="uninitialized"):
+            exe.run(main, feed={"x": np.zeros((2, 3), np.float32)},
+                    fetch_list=[y])
+
+    def test_multiple_feed_shapes_recompile(self, static_mode):
+        main, startup = static_mode
+        x = static.data("x", [-1, 2], "float32")
+        y = x.sum()
+        exe = static.Executor()
+        _init(exe, main, startup)
+        for n in (2, 5):
+            X = np.ones((n, 2), np.float32)
+            out, = exe.run(main, feed={"x": X}, fetch_list=[y])
+            assert float(out) == 2.0 * n
+
+    def test_scope_holds_params_between_runs(self, static_mode):
+        main, startup = static_mode
+        x = static.data("x", [-1, 4], "float32")
+        y = static.nn.fc(x, 2, bias_attr=False)
+        exe = static.Executor()
+        _init(exe, main, startup)
+        w_name = main.all_parameters()[0].name
+        w = static.global_scope().find_var(w_name).get_tensor().numpy()
+        assert w.shape == (4, 2)
+        X = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+        out, = exe.run(main, feed={"x": X}, fetch_list=[y])
+        np.testing.assert_allclose(out, X @ w, rtol=1e-5, atol=1e-6)
+
+
+class TestTraining:
+    def test_sgd_minimize_converges(self, static_mode):
+        main, startup = static_mode
+        x = static.data("x", [-1, 4], "float32")
+        y = static.data("y", [-1, 1], "float32")
+        pred = static.nn.fc(static.nn.fc(x, 8, activation="relu"), 1)
+        loss = paddle.mean((pred - y) ** 2)
+        paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = static.Executor()
+        _init(exe, main, startup)
+        rng = np.random.RandomState(0)
+        X = rng.randn(16, 4).astype(np.float32)
+        Y = X @ rng.randn(4, 1).astype(np.float32)
+        first = last = None
+        for _ in range(40):
+            lv, = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+            first = float(lv) if first is None else first
+            last = float(lv)
+        assert last < first * 0.2
+
+    def test_adam_minimize_matches_eager_training(self, static_mode):
+        """Static Adam must optimize as well as the eager path on the same
+        problem (not necessarily identical trajectories: init differs)."""
+        main, startup = static_mode
+        x = static.data("x", [-1, 2], "float32")
+        y = static.data("y", [-1, 1], "float32")
+        pred = static.nn.fc(x, 1)
+        loss = paddle.mean((pred - y) ** 2)
+        paddle.optimizer.Adam(learning_rate=0.05).minimize(loss)
+        exe = static.Executor()
+        _init(exe, main, startup)
+        rng = np.random.RandomState(3)
+        X = rng.randn(32, 2).astype(np.float32)
+        Y = (X @ np.array([[1.5], [-2.0]], np.float32) + 0.3)
+        for _ in range(150):
+            lv, = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+        assert float(lv) < 0.01
+
+    def test_clone_for_test_drops_training(self, static_mode):
+        main, startup = static_mode
+        x = static.data("x", [-1, 2], "float32")
+        y = static.data("y", [-1, 1], "float32")
+        pred = static.nn.fc(x, 1)
+        loss = paddle.mean((pred - y) ** 2)
+        paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        test_prog = main.clone(for_test=True)
+        assert test_prog._train_spec is None
+        assert main._train_spec is not None
+        exe = static.Executor()
+        _init(exe, main, startup)
+        X = np.ones((4, 2), np.float32)
+        Y = np.ones((4, 1), np.float32)
+        before, = exe.run(test_prog, feed={"x": X, "y": Y},
+                          fetch_list=[loss])
+        after, = exe.run(test_prog, feed={"x": X, "y": Y},
+                         fetch_list=[loss])
+        assert float(before) == float(after)       # eval run didn't train
+
+    def test_adamw_static_applies_decoupled_decay(self, static_mode):
+        """Regression: the static train step must honor AdamW's decoupled
+        weight decay (and accept grad_clip), not silently train as plain
+        Adam. With zero grads the adam term vanishes and one step must
+        shrink w by exactly (1 - lr*coeff)."""
+        import paddle_tpu.nn as nn
+        main, startup = static_mode
+        x = static.data("x", [-1, 4], "float32")
+        pred = static.nn.fc(x, 1, bias_attr=False)
+        loss = paddle.mean(pred) * 0.0        # zero grads, still depends
+        paddle.optimizer.AdamW(
+            learning_rate=0.1, weight_decay=0.5,
+            grad_clip=nn.ClipGradByGlobalNorm(1.0)).minimize(loss)
+        exe = static.Executor()
+        _init(exe, main, startup)
+        w_name = main.all_parameters()[0].name
+        w0 = static.global_scope().find_var(w_name).get_tensor().numpy()
+        X = np.ones((2, 4), np.float32)
+        exe.run(main, feed={"x": X}, fetch_list=[loss])
+        w1 = static.global_scope().find_var(w_name).get_tensor().numpy()
+        np.testing.assert_allclose(w1, w0 * (1.0 - 0.1 * 0.5), rtol=1e-5)
+
+    def test_minimize_outside_guard_still_trains(self, static_mode):
+        """Regression: minimize must attach to the loss's own program,
+        not whatever the default is at call time."""
+        main, startup = static_mode
+        x = static.data("x", [-1, 2], "float32")
+        y = static.data("y", [-1, 1], "float32")
+        loss = paddle.mean((static.nn.fc(x, 1) - y) ** 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.2)
+        # call minimize under a DIFFERENT default program
+        other = static.Program()
+        with static.program_guard(other):
+            opt.minimize(loss)
+        assert main._train_spec is not None
+        assert other._train_spec is None
+        exe = static.Executor()
+        _init(exe, main, startup)
+        X = np.ones((4, 2), np.float32)
+        Y = np.zeros((4, 1), np.float32)
+        l0, = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+        for _ in range(20):
+            l1, = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+        assert float(l1) < float(l0)
+
+    def test_real_97_dim_stays_static(self, static_mode):
+        """Regression: a true size-97 dim must not be reported as -1."""
+        main, _ = static_mode
+        x = static.data("x", [-1, 97], "float32")
+        h = paddle.nn.functional.relu(x)
+        assert h.shape == [-1, 97]
+        y = static.nn.fc(h, 4)       # must not reject the static 97
+        assert y.shape[-1] == 4
+
+    def test_append_backward_grad_fetch(self, static_mode):
+        main, startup = static_mode
+        x = static.data("x", [-1, 3], "float32")
+        w = static.create_parameter([3, 1], "float32", name="w0")
+        loss = paddle.mean(paddle.matmul(x, w) ** 2)
+        grads = static.append_backward(loss)
+        assert grads and grads[0][1] == "w0@GRAD"
+        exe = static.Executor()
+        _init(exe, main, startup)
+        X = np.random.RandomState(0).randn(8, 3).astype(np.float32)
+        wv = static.global_scope().find_var("w0").get_tensor().numpy()
+        lv, gv = exe.run(main, feed={"x": X},
+                         fetch_list=[loss, "w0@GRAD"])
+        expect = 2.0 * X.T @ (X @ wv) / (8)
+        np.testing.assert_allclose(gv, expect, rtol=1e-4, atol=1e-5)
+
+
+class TestPir:
+    def test_translate_to_pir(self, static_mode):
+        main, _ = static_mode
+        x = static.data("x", [4, 4], "float32")
+        paddle.mean(paddle.exp(x))
+        import paddle_tpu.pir as pir
+        jx = pir.translate_to_pir(main)
+        txt = str(jx)
+        assert "exp" in txt
+        assert pir.core_uses_pir()
+
+    def test_get_stablehlo(self):
+        import jax.numpy as jnp
+        import paddle_tpu.pir as pir
+        hlo = pir.get_stablehlo(lambda a: jnp.tanh(a) * 2,
+                                jnp.ones((2, 2), jnp.float32))
+        assert "stablehlo" in hlo or "tanh" in hlo
+
+
+class TestModeIsolation:
+    def test_eager_unaffected_after_disable(self):
+        paddle.enable_static()
+        paddle.disable_static()
+        t = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        np.testing.assert_allclose((t * 2).numpy(), [2.0, 4.0])
+        assert paddle.in_dynamic_mode()
