@@ -139,20 +139,36 @@ def _server_loop(srv: socket.socket):
 # ------------------------------------------------------------------ master
 def _master_loop(msock: socket.socket, world_size: int):
     """Rank-0 registration service: collect world_size (name, rank, addr)
-    entries, then answer the full table to each registrant."""
+    entries, then answer the full table to each registrant. A stray
+    connection (port scan, health check, worker dying mid-register) must
+    not stall or kill the rendezvous: each registration recv is bounded
+    and failures just drop that connection."""
     entries: Dict[int, WorkerInfo] = {}
     conns: List[socket.socket] = []
     while len(entries) < world_size:
         conn, _ = msock.accept()
-        msg = _recv_msg(conn)
-        assert msg[0] == "register", msg
-        _, name, rank, ip, port = msg
+        try:
+            conn.settimeout(10.0)
+            msg = _recv_msg(conn)
+            if not (isinstance(msg, tuple) and msg
+                    and msg[0] == "register"):
+                conn.close()
+                continue
+            _, name, rank, ip, port = msg
+            conn.settimeout(None)
+        except Exception:
+            conn.close()
+            continue
         entries[rank] = WorkerInfo(name, rank, ip, port)
         conns.append(conn)
     table = {wi.name: wi for wi in entries.values()}
     for conn in conns:
-        _send_msg(conn, ("table", table))
-        conn.close()
+        try:
+            _send_msg(conn, ("table", table))
+        except OSError:
+            pass
+        finally:
+            conn.close()
     msock.close()
 
 

@@ -166,17 +166,30 @@ def apply(name: str, fn: Callable, *args, _nondiff_outputs=(), **static):
         vals = _amp_mod.maybe_autocast_inputs(name, vals)
 
     tracing = any(isinstance(v, jax.core.Tracer) for v in vals)
-    if tracing or not _eager_jit:
-        out_vals = closure(*vals)
-    else:
-        jitted = getattr(closure, "_jitted", None)
-        if jitted is None:
-            jitted = jax.jit(closure)
-            closure._jitted = jitted
-        out_vals = jitted(*vals)
-        from .flags import flag as _flag
-        if _flag("check_nan_inf", False):
-            _check_nan_inf(name, out_vals)
+    try:
+        if tracing or not _eager_jit:
+            out_vals = closure(*vals)
+        else:
+            jitted = getattr(closure, "_jitted", None)
+            if jitted is None:
+                jitted = jax.jit(closure)
+                closure._jitted = jitted
+            out_vals = jitted(*vals)
+            from .flags import flag as _flag
+            if _flag("check_nan_inf", False):
+                _check_nan_inf(name, out_vals)
+    except FloatingPointError:
+        raise
+    except Exception as e:
+        # Enforce-style op context frame (reference
+        # paddle/phi/core/enforce.h "[operator < x > error]"): name the
+        # failing op and its input signature on the exception itself
+        shapes = ", ".join(f"{tuple(v.shape)}:{np.dtype(v.dtype).name}"
+                           for v in vals)
+        if hasattr(e, "add_note"):
+            e.add_note(f"[operator < {name} > error] "
+                       f"input signature: ({shapes})")
+        raise
 
     multi = isinstance(out_vals, (tuple, list))
     outs = tuple(out_vals) if multi else (out_vals,)

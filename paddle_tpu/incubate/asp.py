@@ -120,10 +120,13 @@ def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
         mask = algo(p._value.astype(jnp.float32), n, m).astype(p.dtype)
         p._value = (p._value * mask)
         if with_mask:
-            # keyed by Parameter identity: the object persists across
-            # steps (step() swaps p._value in place), so the decorated
-            # optimizer can find its mask regardless of naming scheme
+            # keyed by Parameter identity (the object persists across
+            # steps — step() swaps p._value in place); a weakref
+            # finalizer evicts the entry when the param is collected so a
+            # reused id can never pick up a stale mask
+            import weakref
             _masks[id(p)] = mask
+            weakref.finalize(p, _masks.pop, id(p), None)
         pruned[name] = mask
     return pruned
 

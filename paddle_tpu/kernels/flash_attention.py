@@ -63,9 +63,11 @@ def _blockwise_attention_lse(q, k, v, causal, kv_len=None):
     B, Sq, H, D = q.shape
     Skv = k.shape[1]
     scale = 1.0 / math.sqrt(D)
-    qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32) * scale  # B,H,Sq,D
-    kt = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
-    vt = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+    # operands keep their dtype (bf16 stays MXU-native); scores/state
+    # accumulate in f32 via preferred_element_type
+    qt = jnp.swapaxes(q, 1, 2)                              # B,H,Sq,D
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
 
     blk = min(_BLOCK_KV, Skv)
     if Skv % blk != 0:
@@ -79,7 +81,8 @@ def _blockwise_attention_lse(q, k, v, causal, kv_len=None):
     def step(carry, inputs):
         m, l, acc = carry
         kblk, vblk, blk_idx = inputs
-        scores = jnp.einsum("bhsd,bhtd->bhst", qt, kblk)
+        scores = jnp.einsum("bhsd,bhtd->bhst", qt, kblk,
+                            preferred_element_type=jnp.float32) * scale
         kv_pos = blk_idx * blk + jnp.arange(blk)
         if kv_len is not None and kv_len < Skv:
             scores = jnp.where(kv_pos[None, :] < kv_len, scores, -jnp.inf)
@@ -94,13 +97,15 @@ def _blockwise_attention_lse(q, k, v, causal, kv_len=None):
         correction = jnp.where(jnp.isneginf(m), 0.0, correction)
         l_new = l * correction + jnp.sum(p, axis=-1)
         acc_new = acc * correction[..., None] + \
-            jnp.einsum("bhst,bhtd->bhsd", p, vblk)
+            jnp.einsum("bhst,bhtd->bhsd", p.astype(vblk.dtype), vblk,
+                       preferred_element_type=jnp.float32)
         return (m_new, l_new, acc_new), None
 
-    # carries derive from inputs so shard_map varying-axes tracking matches
-    m0 = jnp.full_like(qt[..., 0], -jnp.inf)
-    l0 = jnp.zeros_like(qt[..., 0])
-    acc0 = jnp.zeros_like(qt)
+    # carries derive from inputs so shard_map varying-axes tracking
+    # matches; m/l/acc state is f32 regardless of input dtype
+    m0 = jnp.full_like(qt[..., 0], -jnp.inf, dtype=jnp.float32)
+    l0 = jnp.zeros_like(qt[..., 0], dtype=jnp.float32)
+    acc0 = jnp.zeros_like(qt, dtype=jnp.float32)
     (m, l, acc), _ = jax.lax.scan(
         step, (m0, l0, acc0),
         (jnp.moveaxis(kb, 2, 0), jnp.moveaxis(vb, 2, 0), jnp.arange(nblk)))
@@ -193,12 +198,16 @@ def _flash_bwd(q, k, v, out, lse, do, causal, kv_len=None):
     B, Sq, H, D = q.shape
     Skv = k.shape[1]
     scale = 1.0 / math.sqrt(D)
-    qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32)          # B,H,Sq,D
-    kt = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
-    vt = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+    # operands keep their dtype (bf16 stays MXU-native); every einsum
+    # accumulates f32 via preferred_element_type, and ds drops back to
+    # the input dtype before its two dots — the standard mixed-precision
+    # flash backward
+    qt = jnp.swapaxes(q, 1, 2)                              # B,H,Sq,D
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
     ot = jnp.swapaxes(out, 1, 2).astype(jnp.float32)
-    dot_ = jnp.swapaxes(do, 1, 2).astype(jnp.float32)
-    delta = jnp.sum(dot_ * ot, axis=-1)                     # B,H,Sq
+    dot_ = jnp.swapaxes(do, 1, 2)
+    delta = jnp.sum(dot_.astype(jnp.float32) * ot, axis=-1)  # B,H,Sq
 
     blk = min(_BLOCK_KV, Skv)
     if Skv % blk != 0:
@@ -210,7 +219,8 @@ def _flash_bwd(q, k, v, out, lse, do, causal, kv_len=None):
 
     def step(dq, inputs):
         kblk, vblk, blk_idx = inputs
-        s = jnp.einsum("bhsd,bhtd->bhst", qt, kblk) * scale
+        s = jnp.einsum("bhsd,bhtd->bhst", qt, kblk,
+                       preferred_element_type=jnp.float32) * scale
         p = jnp.exp(s - lse[..., None])                     # B,H,Sq,blk
         kv_pos = blk_idx * blk + jnp.arange(blk)
         if kv_len is not None and kv_len < Skv:
@@ -218,14 +228,18 @@ def _flash_bwd(q, k, v, out, lse, do, causal, kv_len=None):
         if causal:
             mask = q_pos[:, None] >= kv_pos[None, :]
             p = jnp.where(mask, p, 0.0)
-        dv_j = jnp.einsum("bhst,bhsd->bhtd", p, dot_)
-        dp = jnp.einsum("bhsd,bhtd->bhst", dot_, vblk)
-        ds = p * (dp - delta[..., None]) * scale
-        dq = dq + jnp.einsum("bhst,bhtd->bhsd", ds, kblk)
-        dk_j = jnp.einsum("bhst,bhsd->bhtd", ds, qt)
+        dv_j = jnp.einsum("bhst,bhsd->bhtd", p.astype(dot_.dtype), dot_,
+                          preferred_element_type=jnp.float32)
+        dp = jnp.einsum("bhsd,bhtd->bhst", dot_, vblk,
+                        preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta[..., None]) * scale).astype(qt.dtype)
+        dq = dq + jnp.einsum("bhst,bhtd->bhsd", ds, kblk,
+                             preferred_element_type=jnp.float32)
+        dk_j = jnp.einsum("bhst,bhsd->bhtd", ds, qt,
+                          preferred_element_type=jnp.float32)
         return dq, (dk_j, dv_j)
 
-    dq0 = jnp.zeros_like(qt)
+    dq0 = jnp.zeros_like(qt, dtype=jnp.float32)
     dq, (dk_blocks, dv_blocks) = jax.lax.scan(
         step, dq0, (kb, vb, jnp.arange(nblk)))
     dk = jnp.moveaxis(dk_blocks, 0, 2).reshape(B, H, Skv, D)

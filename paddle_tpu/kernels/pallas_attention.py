@@ -53,10 +53,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
     def _body():
-        q = q_ref[0].astype(jnp.float32) * scale            # (BQ, D)
-        k = k_ref[0].astype(jnp.float32)                    # (BK, D)
+        # operands stay in the input dtype (bf16 on the bench path) so
+        # the MXU runs in its native mode; accumulation is f32 via
+        # preferred_element_type, and the softmax scale is applied to the
+        # f32 scores post-dot (exact, and off the matmul critical path)
+        q = q_ref[0]                                        # (BQ, D)
+        k = k_ref[0]                                        # (BK, D)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         kpos = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         valid = kpos < kv_len
@@ -203,18 +207,20 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
     def _body():
-        u = q_ref[0].astype(jnp.float32) * scale            # (BQ, D)
-        k = k_ref[0].astype(jnp.float32)                    # (BK, D)
-        s = jax.lax.dot_general(u, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+        # bf16 operands + f32 accumulation on every dot (MXU-native);
+        # only the small elementwise ds/p math runs in f32 on the VPU
+        q = q_ref[0]                                        # (BQ, D)
+        k = k_ref[0]                                        # (BK, D)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
         p = jnp.exp(s - lse_ref[0, :, :1])
         p = _mask_p(p, i, j, block_q, block_k, kv_len, causal)
         dp = jax.lax.dot_general(
-            do_ref[0].astype(jnp.float32), v_ref[0].astype(jnp.float32),
+            do_ref[0], v_ref[0],
             (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         ds = p * (dp - delta_ref[0, :, :1])
         dq_acc[...] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
@@ -242,22 +248,23 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
     def _body():
-        u = q_ref[0].astype(jnp.float32) * scale            # (BQ, D)
-        k = k_ref[0].astype(jnp.float32)                    # (BK, D)
-        s = jax.lax.dot_general(u, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+        q = q_ref[0]                                        # (BQ, D)
+        k = k_ref[0]                                        # (BK, D)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
         p = jnp.exp(s - lse_ref[0, :, :1])                  # (BQ, BK)
         p = _mask_p(p, i, j, block_q, block_k, kv_len, causal)
-        do = do_ref[0].astype(jnp.float32)                  # (BQ, D)
+        do = do_ref[0]                                      # (BQ, D)
         dv_acc[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
-            do, v_ref[0].astype(jnp.float32),
+            do, v_ref[0],
             (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         ds = p * (dp - delta_ref[0, :, :1])                 # (BQ, BK)
+        # dk = scale · dsᵀ·q — scale folded in at finalize (f32, exact)
         dk_acc[...] += jax.lax.dot_general(
-            ds, u, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
@@ -269,7 +276,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(i == nq - 1)
     def _finalize():
-        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dk_ref[0] = (dk_acc[...] * scale).astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 

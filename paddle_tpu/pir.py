@@ -26,15 +26,18 @@ def translate_to_pir(program=None):
     (reference pir::Program from translate_to_pir). str() it for the
     textual form."""
     from .static.program import (default_main_program, _replay,
-                                 _replay_guard, _DYN_DIM)
+                                 _replay_guard)
     program = program or default_main_program()
     block = program.global_block()
 
     feed_vars = [v for v in block.vars.values() if v.is_feed]
     param_vars = [v for v in block.vars.values() if v.is_parameter]
     names = [v.name for v in feed_vars + param_vars]
+    # dynamic dims (per the Variable's authoritative _dyn_dims, NOT the
+    # sentinel value — a real size-97 dim stays 97) trace at a nominal 8
     avals = [jax.ShapeDtypeStruct(
-        tuple(8 if s == _DYN_DIM else s for s in v._value.shape),
+        tuple(8 if i in v._dyn_dims else s
+              for i, s in enumerate(v._value.shape)),
         v._value.dtype) for v in feed_vars + param_vars]
 
     def composed(*vals):

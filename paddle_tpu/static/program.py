@@ -279,6 +279,15 @@ def record_apply(op_name: str, fn: Callable, args, static: dict,
     evaluated with two different stand-in sizes for the dynamic dims, and
     output dims that change between the two runs are dynamic — so a real
     size-97 dim is never mistaken for a batch dim."""
+    from ..amp import amp_state
+    if amp_state().enabled:
+        import warnings
+        warnings.warn(
+            "paddle.amp.auto_cast has no effect while RECORDING a static "
+            "Program (the reference's static AMP is a separate "
+            "static.amp.decorate pass): ops are recorded at their stated "
+            "dtypes. Build the model in bf16, or use dygraph/to_static "
+            "where autocast applies.", RuntimeWarning, stacklevel=3)
     block = default_main_program().current_block()
     arg_plan, avals, avals2 = [], [], []
     for a in args:
@@ -306,12 +315,22 @@ def record_apply(op_name: str, fn: Callable, args, static: dict,
 
     any_dyn = any(a.shape != b.shape for a, b in zip(avals, avals2))
     outs_b = outs_a
+    fallback_heuristic = False
     if any_dyn:
         try:
             ob = jax.eval_shape(shaped, *avals2)
             outs_b = tuple(ob) if multi else (ob,)
         except Exception:
-            outs_b = outs_a                  # shape-sensitive op: fall back
+            # shape-sensitive op (e.g. a reshape whose literals only
+            # divide the first sentinel): fall back to treating dims that
+            # EQUAL the sentinel as dynamic — conservative in the right
+            # direction (a dynamic dim must never be reported static)
+            fallback_heuristic = True
+            import warnings
+            warnings.warn(
+                f"static-graph shape inference for op '{op_name}' could "
+                "not separate dynamic dims exactly; dims equal to "
+                f"{_DYN_DIM} are assumed dynamic", RuntimeWarning)
 
     out_vars = []
     prog = default_main_program()
@@ -319,8 +338,13 @@ def record_apply(op_name: str, fn: Callable, args, static: dict,
         nm = prog._unique_name(f"{op_name}.out")
         v = block.create_var(nm, av.shape, av.dtype)
         v._value = av                       # keep exact aval (incl. 97s)
-        v._dyn_dims = tuple(i for i, (s1, s2) in
-                            enumerate(zip(av.shape, av2.shape)) if s1 != s2)
+        if fallback_heuristic:
+            v._dyn_dims = tuple(i for i, s in enumerate(av.shape)
+                                if s == _DYN_DIM)
+        else:
+            v._dyn_dims = tuple(
+                i for i, (s1, s2) in
+                enumerate(zip(av.shape, av2.shape)) if s1 != s2)
         out_vars.append(v)
     block.append_op(OpNode(op_name, fn, arg_plan, dict(static),
                            [v.name for v in out_vars]))
@@ -486,7 +510,11 @@ class Executor:
 
         if program._train_spec:
             opt = program._train_spec["optimizer"]
-            st_key = (program._uid, tuple(param_names))
+            # keyed on the spec sequence number too: a second minimize()
+            # (new/changed optimizer) must start from fresh state, not
+            # inherit the previous optimizer's moments
+            st_key = (program._uid, program._train_spec["seq"],
+                      tuple(param_names))
             if st_key not in self._opt_states:
                 self._opt_states[st_key] = {
                     "state": [[jnp.zeros(v.shape, jnp.float32)
@@ -557,9 +585,13 @@ class Executor:
         decay = opt._weight_decay_coeff
         decay_in_grad = opt._apply_decay_to_grad()
         # AdamW-family decoupled decay (p *= 1 - lr*coeff before the
-        # update) — same math its eager _build_step_fn_for applies
+        # update) — same math its eager _build_step_fn_for applies,
+        # honoring apply_decay_param_fun by parameter name
         decoupled = 0.0 if decay_in_grad else \
             float(getattr(opt, "_coeff", 0.0))
+        decay_fn = getattr(opt, "_apply_decay_fn", None)
+        decay_mask = tuple((decay_fn(nm) if decay_fn else True)
+                           for nm in param_names)
         clip = opt._grad_clip
         update = opt._update
 
@@ -571,10 +603,10 @@ class Executor:
             if clip is not None:
                 gs = clip._clip_values(gs)
             new_params, new_states = [], []
-            for p, g, st in zip(param_vals, gs, states):
-                if decay and decay_in_grad:
+            for i, (p, g, st) in enumerate(zip(param_vals, gs, states)):
+                if decay and decay_in_grad and decay_mask[i]:
                     g = g + decay * p.astype(jnp.float32)
-                if decoupled:
+                if decoupled and decay_mask[i]:
                     p = p * (1.0 - lr * decoupled)
                 np_, ns_ = update(p, g, dict(zip(keys, st)), lr, step)
                 new_params.append(np_.astype(p.dtype))
@@ -602,13 +634,21 @@ def append_backward(loss, parameter_list=None, no_grad_set=None):
     Returns [(param, grad_name)]; fetch '<param>@GRAD' to read gradients —
     the Executor computes them with jax.value_and_grad over the composed
     program, no per-op grad graph needed."""
-    prog = default_main_program()
+    # the loss's own program, not the current default — append_backward
+    # may be called outside the program_guard (same hazard minimize dodges)
+    prog = loss.block.program
     prog._backward_loss = loss.name
     prog._version += 1
     return [(p, f"{p.name}@GRAD") for p in prog.all_parameters()]
 
 
+_train_spec_seq = 0
+
+
 def set_train_spec(program, optimizer, loss):
-    program._train_spec = {"optimizer": optimizer, "loss": loss.name}
+    global _train_spec_seq
+    _train_spec_seq += 1
+    program._train_spec = {"optimizer": optimizer, "loss": loss.name,
+                           "seq": _train_spec_seq}
     program._backward_loss = loss.name
     program._version += 1
