@@ -290,3 +290,39 @@ def load_profiler_result(filename: str):
     raise NotImplementedError(
         "XLA traces are TensorBoard artifacts; point TensorBoard at the "
         "trace dir passed to export_chrome_tracing instead.")
+
+
+def cost_analysis(fn, *example_args, **jit_kwargs):
+    """XLA's own static cost model for a jitted callable (reference
+    analog: paddle/fluid/framework/ir/cost_model.py + the profiler's op
+    FLOPs accounting). Returns a dict with flops, bytes accessed, and
+    (when the backend reports it) optimal_seconds — computable without
+    running the program, so it works even when no accelerator is
+    reachable. Use it to sanity-check an MFU measurement: measured_time /
+    (flops / peak_flops) is the achievable-vs-actual gap.
+
+    Caveat: XLA counts a lax.scan/while body ONCE, not per iteration —
+    for scan-stacked models (models.gpt) the reported flops are a lower
+    bound; multiply the body's share by the trip count for truth."""
+    import jax
+    compiled = jax.jit(fn, **jit_kwargs).lower(*example_args).compile()
+    raw = compiled.cost_analysis()
+    if isinstance(raw, (list, tuple)):
+        raw = raw[0] if raw else {}
+    out = {"flops": float(raw.get("flops", 0.0)),
+           "bytes_accessed": float(raw.get("bytes accessed", 0.0)),
+           "optimal_seconds": float(raw.get("optimal_seconds", 0.0))}
+    mem = getattr(compiled, "memory_analysis", None)
+    if callable(mem):
+        try:
+            m = mem()
+            out["temp_size_bytes"] = int(
+                getattr(m, "temp_size_in_bytes", 0))
+            out["argument_size_bytes"] = int(
+                getattr(m, "argument_size_in_bytes", 0))
+            out["output_size_bytes"] = int(
+                getattr(m, "output_size_in_bytes", 0))
+        except Exception:
+            pass
+    out["raw"] = dict(raw)
+    return out

@@ -29,15 +29,116 @@ def cpu_places(device_count=1):
 
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
-                         **kwargs):
-    raise NotImplementedError(
-        "program-based save_inference_model has no analog here: decorate "
-        "the model with paddle_tpu.jit.to_static and use paddle_tpu.jit."
-        "save (StableHLO + weights), then paddle_tpu.inference.Predictor "
-        "or paddle_tpu.jit.load to serve it.")
+                         program=None, **kwargs):
+    """Serialize the inference slice of a Program (reference
+    static.save_inference_model → __model__ + params). The artifact is
+    the SAME StableHLO + weights + meta layout paddle_tpu.jit.save
+    writes, so paddle_tpu.jit.load and inference.Predictor both serve
+    it. Dynamic (-1) dims export as symbolic dimensions (jax.export
+    shape polymorphism), so any batch size runs.
+
+    Parameters are baked from the current global_scope() (run the
+    startup program + training first)."""
+    import pickle
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax import export as jax_export
+    from .program import (Variable, global_scope, _replay, _replay_guard)
+    from ..jit import MODEL_SUFFIX, PARAMS_SUFFIX, META_SUFFIX
+
+    feed_vars = list(feed_vars)
+    fetch_vars = list(fetch_vars)
+    if not feed_vars or not all(isinstance(v, Variable) for v in feed_vars):
+        raise ValueError("feed_vars must be static.data Variables")
+    program = program or feed_vars[0].block.program
+    block = program.global_block()
+    param_names = sorted(
+        {v.name for v in block.vars.values() if v.is_parameter})
+    scope = global_scope()
+    missing = [p for p in param_names if p not in scope._vars]
+    if missing:
+        raise RuntimeError(
+            f"parameters {missing} uninitialized: run the startup program "
+            "(and training) before save_inference_model")
+    feed_names = [v.name for v in feed_vars]
+    fetch_names = [v.name if isinstance(v, Variable) else str(v)
+                   for v in fetch_vars]
+
+    # backward slice from the fetch targets (the reference's prune pass):
+    # only ops feeding the fetches are exported — training-only ops (loss,
+    # metrics) and their feeds drop out
+    needed = set(fetch_names)
+    kept = []
+    for node in reversed(block.ops):
+        if any(nm in needed for nm in node.out_names):
+            kept.append(node)
+            needed.update(node.input_names())
+    kept.reverse()
+    required_feeds = [n for n in needed
+                      if n in block.vars and block.vars[n].is_feed]
+    missing_feeds = [n for n in required_feeds if n not in feed_names]
+    if missing_feeds:
+        raise ValueError(
+            f"fetch targets depend on feeds {missing_feeds} not listed in "
+            "feed_vars")
+    param_names = sorted(n for n in needed if n in param_names)
+    param_vals = [np.asarray(scope._vars[p]) for p in param_names]
+
+    def pure_fn(key, *vals):
+        env = dict(zip(param_names, vals[:len(param_names)]))
+        env.update(zip(feed_names, vals[len(param_names):]))
+        with _replay_guard():
+            _replay(kept, env)
+        return [env[f] for f in fetch_names]
+
+    feed_avals = []
+    for i, v in enumerate(feed_vars):
+        if v._dyn_dims:
+            dims = ",".join(f"d{i}_{j}" if j in v._dyn_dims else str(s)
+                            for j, s in enumerate(v._value.shape))
+            shape = jax_export.symbolic_shape(f"({dims})")
+        else:
+            shape = v._value.shape
+        feed_avals.append(jax.ShapeDtypeStruct(shape, v._value.dtype))
+
+    key_aval = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    exported = jax_export.export(jax.jit(pure_fn))(
+        key_aval,
+        *[jax.ShapeDtypeStruct(p.shape, p.dtype) for p in param_vals],
+        *feed_avals)
+    import os
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + MODEL_SUFFIX, "wb") as f:
+        f.write(exported.serialize())
+    with open(path_prefix + PARAMS_SUFFIX, "wb") as f:
+        np.savez(f, **{f"p{i}": v for i, v in enumerate(param_vals)})
+    meta = {
+        "n_user_outputs": len(fetch_names),
+        "n_captured": len(param_vals),
+        "out_treedef": None,
+        "input_shapes": [(tuple(v.shape), str(v._value.dtype))
+                         for v in feed_vars],
+        "param_trainable": [False] * len(param_vals),
+        "feed_names": feed_names,
+        "fetch_names": fetch_names,
+    }
+    with open(path_prefix + META_SUFFIX, "wb") as f:
+        pickle.dump(meta, f)
+    return path_prefix
 
 
 def load_inference_model(path_prefix, executor=None, **kwargs):
-    raise NotImplementedError(
-        "use paddle_tpu.jit.load(path) (reference jit.save/load artifact) "
-        "or paddle_tpu.inference.create_predictor.")
+    """Load a saved inference artifact (reference returns
+    [inference_program, feed_target_names, fetch_targets]); here the
+    "program" is a TranslatedLayer over the StableHLO computation, which
+    Executor.run also accepts directly:
+
+        layer, feed_names, fetch_names = static.load_inference_model(p, exe)
+        outs = exe.run(layer, feed={...}, fetch_list=fetch_names)
+    """
+    from ..jit import load as jit_load
+    layer = jit_load(path_prefix)
+    meta = layer._meta
+    return [layer, list(meta.get("feed_names", [])),
+            list(meta.get("fetch_names", []))]

@@ -438,9 +438,10 @@ def scope_guard(scope):
 
 
 # ---------------------------------------------------------------- executor
-def _replay(block: Block, env: Dict[str, Any]):
-    """Execute a block's ops in order against an environment."""
-    for node in block.ops:
+def _replay(block, env: Dict[str, Any]):
+    """Execute a block's ops (or an explicit op list, e.g. a pruned
+    slice) in order against an environment."""
+    for node in (block.ops if isinstance(block, Block) else block):
         args = [env[a.name] if isinstance(a, _Ref) else a.v
                 for a in node.arg_plan]
         out = node.fn(*args, **node.attrs)
@@ -467,6 +468,36 @@ class Executor:
             scope=None, return_numpy=True):
         program = program or default_main_program()
         feed = feed or {}
+        if hasattr(program, "_exported"):
+            # a TranslatedLayer from static.load_inference_model: drive
+            # the StableHLO computation with feeds in saved order
+            meta = program._meta
+            feed_names = meta.get("feed_names")
+            if not feed_names:
+                if len(feed) > 1:
+                    raise ValueError(
+                        "this artifact (paddle_tpu.jit.save) records no "
+                        "feed names, so a multi-input feed dict is "
+                        "ambiguous: call the loaded layer positionally "
+                        "(layer(x, y)) instead of Executor.run")
+                feed_names = list(feed)
+            outs = program(*[feed[n] for n in feed_names])
+            outs = outs if isinstance(outs, list) else [outs]
+            fetch_names = meta.get("fetch_names") or []
+            if fetch_list:
+                want = [f.name if isinstance(f, Variable) else str(f)
+                        for f in fetch_list]
+                idx = {n: i for i, n in enumerate(fetch_names)}
+                unknown = [w for w in want if w not in idx]
+                if unknown:
+                    raise ValueError(
+                        f"fetch targets {unknown} not in this artifact's "
+                        f"outputs {fetch_names or '(unnamed)'}; for "
+                        "unnamed jit.save artifacts call the layer "
+                        "directly")
+                outs = [outs[idx[w]] for w in want]
+            return [np.asarray(o.numpy()) for o in outs] \
+                if return_numpy else outs
         scope = scope or global_scope()
         fetch_list = fetch_list or []
         fetch_names = [f.name if isinstance(f, Variable) else str(f)

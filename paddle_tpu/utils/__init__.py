@@ -8,6 +8,7 @@ import itertools
 import warnings
 
 from . import unique_name  # noqa: F401
+from . import log_util  # noqa: F401
 
 
 def try_import(module_name: str, err_msg: str = None):

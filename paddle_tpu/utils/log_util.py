@@ -1,0 +1,51 @@
+"""paddle_tpu.utils.log_util — framework logger.
+
+Reference analog: python/paddle/distributed/utils/log_utils.py get_logger
++ fleet's logger_utils (per-rank prefixed logging). The logger tags each
+record with the process's distributed rank (PADDLE_TRAINER_ID) so
+multi-host logs interleave legibly.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_loggers = {}
+
+
+class _RankFilter(logging.Filter):
+    """Injects the CURRENT distributed rank into each record — read per
+    record, not at import, so launchers that set PADDLE_TRAINER_ID after
+    this module loads still tag correctly."""
+
+    def filter(self, record):
+        record.rank = os.environ.get("PADDLE_TRAINER_ID", "0")
+        return True
+
+
+def get_logger(level=logging.INFO, name: str = "paddle_tpu"):
+    """Reference get_logger: a namespaced logger with a rank-tagged
+    stream handler (idempotent — repeat calls reuse the handler)."""
+    logger = _loggers.get(name)
+    if logger is not None:
+        logger.setLevel(level)
+        return logger
+    logger = logging.getLogger(name)
+    logger.setLevel(level)
+    logger.propagate = False
+    handler = logging.StreamHandler(sys.stderr)
+    handler.addFilter(_RankFilter())
+    handler.setFormatter(logging.Formatter(
+        "%(asctime)s [rank %(rank)s] %(levelname)s %(name)s: %(message)s"))
+    logger.addHandler(handler)
+    _loggers[name] = logger
+    return logger
+
+
+def set_log_level(level):
+    """fleet.utils log level switch (accepts logging level or name)."""
+    if isinstance(level, str):
+        level = getattr(logging, level.upper())
+    get_logger(level).setLevel(level)
+    return level

@@ -232,6 +232,55 @@ class TestTraining:
         np.testing.assert_allclose(gv, expect, rtol=1e-4, atol=1e-5)
 
 
+class TestSaveInference:
+    def _trained(self, static_mode):
+        main, startup = static_mode
+        x = static.data("x", [-1, 4], "float32")
+        y = static.data("y", [-1, 1], "float32")
+        pred = static.nn.fc(x, 1)
+        loss = paddle.mean((pred - y) ** 2)
+        paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = static.Executor()
+        _init(exe, main, startup)
+        X = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+        Y = np.ones((8, 1), np.float32)
+        exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+        return main, exe, x, y, pred, loss, X, Y
+
+    def test_save_prunes_training_ops_and_serves(self, static_mode,
+                                                 tmp_path):
+        main, exe, x, y, pred, loss, X, Y = self._trained(static_mode)
+        p = str(tmp_path / "m")
+        # only feed x: the loss ops (and feed y) must be pruned away
+        static.save_inference_model(p, [x], [pred], exe, program=main)
+        layer, feeds, fetches = static.load_inference_model(p, exe)
+        assert feeds == ["x"]
+        # dynamic batch via symbolic export
+        for n in (2, 8):
+            out, = exe.run(layer, feed={"x": X[:n]}, fetch_list=fetches)
+            assert out.shape == (n, 1)
+        ref, = exe.run(main.clone(for_test=True),
+                       feed={"x": X, "y": Y}, fetch_list=[pred])
+        got, = exe.run(layer, feed={"x": X}, fetch_list=fetches)
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    def test_fetch_depending_on_unlisted_feed_raises(self, static_mode,
+                                                     tmp_path):
+        main, exe, x, y, pred, loss, X, Y = self._trained(static_mode)
+        with pytest.raises(ValueError, match="depend on feeds"):
+            static.save_inference_model(str(tmp_path / "m2"), [x], [loss],
+                                        exe, program=main)
+
+    def test_jit_load_serves_artifact(self, static_mode, tmp_path):
+        main, exe, x, y, pred, loss, X, Y = self._trained(static_mode)
+        p = str(tmp_path / "m3")
+        static.save_inference_model(p, [x], [pred], exe, program=main)
+        import paddle_tpu.jit as jit
+        layer = jit.load(p)
+        out = layer(X[:3])
+        assert tuple(out.shape) == (3, 1)
+
+
 class TestPir:
     def test_translate_to_pir(self, static_mode):
         main, _ = static_mode
