@@ -269,6 +269,8 @@ class DataLoader:
         self.use_shared_memory = use_shared_memory
         self.timeout = timeout
         self.worker_init_fn = worker_init_fn
+        self.persistent_workers = persistent_workers
+        self._pool = None          # PersistentWorkerPool when persistent
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -319,7 +321,8 @@ class DataLoader:
         return available()
 
     def _iter_multiprocess(self, bm):
-        from .multiprocess import MultiprocessIterator, np_collate
+        from .multiprocess import (MultiprocessIterator, np_collate,
+                                   PersistentWorkerPool, _to_tensor_tree)
         if self._iterable_mode:
             batch_indices = None
         else:
@@ -331,14 +334,28 @@ class DataLoader:
         # runs as its numpy clone there and Tensor assembly happens here
         user_collate = self.collate_fn is not default_collate_fn
         worker_collate = self.collate_fn if user_collate else np_collate
-        it = MultiprocessIterator(
-            self.dataset, batch_indices, worker_collate,
-            self.num_workers, prefetch_factor=self.prefetch_factor,
-            timeout=self.timeout, worker_init_fn=self.worker_init_fn,
-            batch_size=getattr(self, "batch_size", None),
-            drop_last=getattr(self, "drop_last", False))
-        from .multiprocess import _to_tensor_tree
-        gen = iter(it)
+        if self.persistent_workers:
+            # workers survive across epochs; per-epoch work orders go
+            # over each worker's command ring. A pool torn down by a
+            # worker error/timeout is rebuilt fresh.
+            if self._pool is not None and not self._pool._pids:
+                self._pool = None
+            if self._pool is None:
+                self._pool = PersistentWorkerPool(
+                    self.dataset, worker_collate, self.num_workers,
+                    prefetch_factor=self.prefetch_factor,
+                    timeout=self.timeout,
+                    worker_init_fn=self.worker_init_fn)
+            gen = self._pool.run_epoch(
+                batch_indices, batch_size=getattr(self, "batch_size", None),
+                drop_last=getattr(self, "drop_last", False))
+        else:
+            gen = iter(MultiprocessIterator(
+                self.dataset, batch_indices, worker_collate,
+                self.num_workers, prefetch_factor=self.prefetch_factor,
+                timeout=self.timeout, worker_init_fn=self.worker_init_fn,
+                batch_size=getattr(self, "batch_size", None),
+                drop_last=getattr(self, "drop_last", False)))
         while True:
             bm.before_reader()
             try:

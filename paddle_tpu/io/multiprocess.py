@@ -100,6 +100,11 @@ class _WorkerError:
         self.tb = tb
 
 
+class _EpochEnd:
+    """Data-ring marker a persistent worker emits after its last batch of
+    an epoch (the ring stays open across epochs, so hangup can't signal)."""
+
+
 class WorkerError(RuntimeError):
     pass
 
@@ -221,6 +226,185 @@ class MultiprocessIterator:
         return obj
 
     def close(self):
+        for pid in self._pids:
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+        for pid in self._pids:
+            try:
+                os.waitpid(pid, 0)
+            except ChildProcessError:
+                pass
+        self._pids = []
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# --------------------------------------------------------------------------
+# Persistent worker pool (reference persistent_workers=True: workers stay
+# alive across epochs, dataloader_iter.py _try_shutdown_workers keep-alive
+# path). Each worker gets a COMMAND ring (parent is the producer) carrying
+# per-epoch work orders, and emits an _EpochEnd marker on its data ring
+# after the epoch's last batch — the data ring never closes, so epoch
+# boundaries are explicit messages instead of hangups.
+# --------------------------------------------------------------------------
+def _persistent_worker_loop(cmd_ring: ShmRing, data_ring: ShmRing,
+                            worker_id: int, num_workers: int, dataset,
+                            collate_fn, worker_init_fn, base_seed: int):
+    global _worker_info
+    seed = base_seed + worker_id
+    _worker_info = WorkerInfo(worker_id, num_workers, seed, dataset)
+    np.random.seed(seed % (2 ** 32))
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+    try:
+        _persistent_epochs(cmd_ring, data_ring, dataset, collate_fn,
+                           worker_id)
+    finally:
+        # parity with the one-shot _worker_loop: a dead/stopping worker
+        # marks its ring closed so the parent gets RingClosed, never an
+        # indefinite hang
+        data_ring.close_producer()
+
+
+def _persistent_epochs(cmd_ring, data_ring, dataset, collate_fn, worker_id):
+    while True:
+        try:
+            cmd = pickle.loads(cmd_ring.get(timeout=None))
+        except (RingClosed, Exception):
+            return
+        if cmd[0] == "stop":
+            return
+        kind, payload = cmd
+        try:
+            if kind == "epoch_map":
+                for indices in payload:
+                    items = [dataset[i] for i in indices]
+                    out = _to_numpy_tree(collate_fn(items))
+                    data_ring.put(pickle.dumps(out, protocol=4))
+            elif kind == "epoch_iter":
+                batch_size, drop_last = payload
+                import itertools
+                it = iter(dataset)
+                while True:
+                    batch = list(itertools.islice(it, batch_size))
+                    if not batch or (len(batch) < batch_size and drop_last):
+                        break
+                    out = _to_numpy_tree(collate_fn(batch))
+                    data_ring.put(pickle.dumps(out, protocol=4))
+        except BaseException:
+            import traceback as _tb
+            try:
+                data_ring.put(pickle.dumps(
+                    _WorkerError(worker_id, _tb.format_exc()), protocol=4),
+                    timeout=10.0)
+            except Exception:
+                pass
+        data_ring.put(pickle.dumps(_EpochEnd(), protocol=4))
+
+
+class PersistentWorkerPool:
+    """Forked workers that survive across epochs. One pool per DataLoader
+    when persistent_workers=True."""
+
+    def __init__(self, dataset, collate_fn, num_workers, prefetch_factor=2,
+                 timeout=0.0, worker_init_fn=None, slot_bytes=1 << 22):
+        self._nw = num_workers
+        self._timeout = None if not timeout else float(timeout)
+        self._data_rings = [ShmRing(n_slots=max(2, prefetch_factor),
+                                    slot_bytes=slot_bytes)
+                            for _ in range(num_workers)]
+        self._cmd_rings = [ShmRing(n_slots=4, slot_bytes=1 << 16)
+                           for _ in range(num_workers)]
+        self._pids: List[int] = []
+        base_seed = int.from_bytes(os.urandom(4), "little")
+        for w in range(num_workers):
+            pid = os.fork()
+            if pid == 0:
+                try:
+                    _persistent_worker_loop(
+                        self._cmd_rings[w], self._data_rings[w], w,
+                        num_workers, dataset, collate_fn, worker_init_fn,
+                        base_seed)
+                finally:
+                    os._exit(0)
+            self._pids.append(pid)
+
+    def run_epoch(self, batch_indices, batch_size=None, drop_last=False):
+        """Yield one epoch's batches in deterministic order (map-style:
+        batch j from worker j%W; iterable: round-robin until all workers
+        end the epoch). An abandoned generator (early break) drains the
+        rest of the epoch on exit so the rings are clean for the next
+        one — workers were already ordered to finish it."""
+        ended = [False] * self._nw
+        try:
+            if batch_indices is not None:
+                for w in range(self._nw):
+                    sub = [batch_indices[j] for j in
+                           range(w, len(batch_indices), self._nw)]
+                    self._cmd_rings[w].put(pickle.dumps(("epoch_map",
+                                                         sub)))
+                for j in range(len(batch_indices)):
+                    obj = self._get(j % self._nw)
+                    if isinstance(obj, _EpochEnd):
+                        ended[j % self._nw] = True
+                        break
+                    yield obj
+            else:
+                for w in range(self._nw):
+                    self._cmd_rings[w].put(pickle.dumps(
+                        ("epoch_iter", (batch_size, drop_last))))
+                open_w = list(range(self._nw))
+                i = 0
+                while open_w:
+                    w = open_w[i % len(open_w)]
+                    obj = self._get(w)
+                    if isinstance(obj, _EpochEnd):
+                        ended[w] = True
+                        open_w.remove(w)
+                        continue
+                    yield obj
+                    i += 1
+        finally:
+            if self._pids:          # pool alive (not torn down by error)
+                for w in range(self._nw):
+                    while not ended[w]:
+                        if isinstance(self._get(w), _EpochEnd):
+                            ended[w] = True
+
+    def _get(self, w):
+        try:
+            data = self._data_rings[w].get(timeout=self._timeout)
+        except RingClosed:
+            self.close()
+            raise WorkerError(
+                f"DataLoader worker {w} exited unexpectedly") from None
+        except RingTimeout:
+            self.close()       # undefined ring state: next epoch refreshes
+            raise WorkerError(
+                f"DataLoader worker {w} timed out after "
+                f"{self._timeout}s") from None
+        obj = pickle.loads(data)
+        if isinstance(obj, _WorkerError):
+            # in-flight batches/markers make the rings unusable: tear the
+            # pool down; the DataLoader builds a fresh one next epoch
+            self.close()
+            raise WorkerError(
+                f"DataLoader worker {obj.worker_id} failed:\n{obj.tb}")
+        return obj
+
+    def close(self):
+        for w in range(self._nw):
+            try:
+                self._cmd_rings[w].put(pickle.dumps(("stop",)),
+                                       timeout=1.0)
+            except Exception:
+                pass
         for pid in self._pids:
             try:
                 os.kill(pid, signal.SIGTERM)

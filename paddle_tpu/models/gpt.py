@@ -593,15 +593,17 @@ def _cached_attention(x, params_l, kc, vc, pos, cfg):
 def gpt_forward_cached(params, tokens, cache, pos, cfg: GPTConfig):
     """Forward `tokens` [B,T] against a cache holding `pos` tokens.
     → (logits [B,T,V], updated cache). Works for prefill (pos=0, T=prompt)
-    and decode (T=1). Dense-FFN configs only (MoE decode: v2)."""
-    if cfg.num_experts > 0:
-        raise NotImplementedError("KV-cache decode with MoE experts")
+    and decode (T=1), for dense and MoE configs (reference: the inference
+    decoder's global_scatter path — here the same capacity dispatch runs
+    on the decode tokens; the aux load-balancing loss is discarded at
+    inference)."""
     B, T = tokens.shape
     x = jnp.take(params["wte"], tokens, axis=0).astype(cfg.dtype)
     wpe = jax.lax.dynamic_slice_in_dim(params["wpe"], pos, T, axis=0)
     x = x + wpe[None].astype(cfg.dtype)
 
-    stacked = {k: params[k] for k in _BLOCK_KEYS_DENSE if k in params}
+    block_keys = _BLOCK_KEYS_MOE if cfg.num_experts > 0 else _BLOCK_KEYS_DENSE
+    stacked = {k: params[k] for k in block_keys if k in params}
 
     def scan_fn(x, layer_in):
         params_l, kc, vc = layer_in
@@ -612,8 +614,16 @@ def gpt_forward_cached(params, tokens, cache, pos, cfg: GPTConfig):
         h = h + a
         m_in = _ln(h, params_l["ln2_scale"], params_l["ln2_bias"],
                    cfg.layer_norm_eps)
-        m = _dense_ffn(m_in, params_l["mlp_up_w"], params_l.get("mlp_up_b"),
-                       params_l["mlp_down_w"], params_l.get("mlp_down_b"))
+        if cfg.num_experts > 0:
+            m, _aux = _moe_ffn(m_in, params_l["gate_w"],
+                               params_l["moe_up_w"], params_l["moe_up_b"],
+                               params_l["moe_down_w"],
+                               params_l["moe_down_b"], cfg)
+        else:
+            m = _dense_ffn(m_in, params_l["mlp_up_w"],
+                           params_l.get("mlp_up_b"),
+                           params_l["mlp_down_w"],
+                           params_l.get("mlp_down_b"))
         return h + m, (kc, vc)
 
     x, (kcs, vcs) = jax.lax.scan(scan_fn, x,

@@ -15,14 +15,18 @@ TPU-native: the three stages collapse into sharding declarations over the
   stage 3  = + parameters sharded      (XLA all-gathers at use)
 XLA GSPMD derives the reduce-scatter/all-gather schedule from those specs,
 which is exactly the hand-written choreography of the reference's stage-2/3
-wrappers. offload maps to jax.device_put(..., may_alias host memory) and is
-deferred to a later round.
+wrappers. `offload=True` places optimizer state in the host memory space
+(PJRT memory kinds, NamedSharding(..., memory_kind="pinned_host")) — the
+reference's CPUAdam-style offload, with XLA emitting the H2D/D2H transfers
+around the update instead of a hand-written pinned-buffer pump.
 """
 from __future__ import annotations
 
+import warnings
+
 import numpy as np
 import jax
-from jax.sharding import PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..framework.tensor import Tensor
 from .mesh import get_mesh, build_mesh, set_global_mesh, shard_value
@@ -41,6 +45,20 @@ def _shardable(p, n):
     return p.ndim >= 1 and p.shape[0] % n == 0 and p.size >= 1024
 
 
+def host_memory_kind():
+    """The host memory space's name when this backend supports memory
+    kinds (TPU PJRT: 'pinned_host'), else None."""
+    try:
+        dev = jax.devices()[0]
+        kinds = {m.kind for m in dev.addressable_memories()}
+    except Exception:
+        return None
+    for kind in ("pinned_host", "unpinned_host"):
+        if kind in kinds:
+            return kind
+    return None
+
+
 def shard_model_stage3(model, mesh=None):
     """Parameter sharding (ZeRO-3): each param's dim-0 over the fsdp axis."""
     mesh = mesh or get_mesh()
@@ -55,20 +73,62 @@ def shard_model_stage3(model, mesh=None):
     return model
 
 
-def shard_optimizer_state(optimizer, mesh=None):
-    """Stage-1/2: optimizer moments (and thus grad reductions) sharded."""
+def shard_optimizer_state(optimizer, mesh=None, offload=False):
+    """Stage-1/2: optimizer moments (and thus grad reductions) sharded.
+    offload=True additionally places the moments in the host memory space
+    (reference GroupShardedOptimizerStage2(offload=True) / CPUAdam): XLA
+    then streams them through HBM only around the update."""
     mesh = mesh or get_mesh()
     ax = _fsdp_axis(mesh)
-    if ax is None:
+    if ax is None and not offload:
         return optimizer
-    n = mesh.shape[ax]
+    n = mesh.shape[ax] if ax is not None else 1
+    mem_kind = host_memory_kind() if offload else None
+    if offload and mem_kind is None:
+        warnings.warn(
+            "offload=True requested but this backend reports no host "
+            "memory space (pinned_host); optimizer state stays in device "
+            "memory", RuntimeWarning)
     orig_init = optimizer._init_state
 
     def sharded_init(p):
         state = orig_init(p)
-        spec = P(ax) if _shardable(p, n) else P()
+        spec = P(ax) if (ax is not None and _shardable(p, n)) else P()
+        if mem_kind is not None and mesh is not None:
+            sh = NamedSharding(mesh, spec, memory_kind=mem_kind)
+            return {k: jax.device_put(v, sh) for k, v in state.items()}
+        if mem_kind is not None:
+            dst = jax.sharding.SingleDeviceSharding(
+                jax.devices()[0], memory_kind=mem_kind)
+            return {k: jax.device_put(v, dst) for k, v in state.items()}
         return {k: shard_value(v, spec, mesh) for k, v in state.items()}
     optimizer._init_state = sharded_init
+
+    if mem_kind is not None:
+        # XLA refuses mixed memory spaces inside one computation, so the
+        # jitted update runs on device copies: moments stream host→HBM
+        # before the update and back after — the CPUAdam data motion,
+        # with PJRT doing the DMA
+        orig_build = optimizer._build_step_fn_for
+
+        def build_offloaded(params):
+            inner = orig_build(params)
+
+            def to_dev(v):
+                return jax.device_put(
+                    v, v.sharding.with_memory_kind("device"))
+
+            def to_host(v):
+                return jax.device_put(
+                    v, v.sharding.with_memory_kind(mem_kind))
+
+            def stepped(lr, step, pvals, gvals, svals):
+                svals = [[to_dev(v) for v in st] for st in svals]
+                new_p, new_s = inner(lr, step, pvals, gvals, svals)
+                new_s = [[to_host(v) for v in st] for st in new_s]
+                return new_p, new_s
+            return stepped
+        optimizer._build_step_fn_for = build_offloaded
     return optimizer
 
 
@@ -77,9 +137,12 @@ class GroupShardedStage2:
 
     def __init__(self, layer, optimizer, group=None, sync_buffers=False,
                  buffer_max_size=2 ** 23, auto_refresh_trainable=True,
-                 device="tpu", dp_group=None):
+                 device="tpu", dp_group=None, offload=False):
+        # sync_buffers/buffer_max_size are the reference's hand-written
+        # grad-bucket machinery; under GSPMD the compiler owns bucketing,
+        # and buffers are replicated by construction in SPMD
         self._layer = layer
-        self._optimizer = shard_optimizer_state(optimizer)
+        self._optimizer = shard_optimizer_state(optimizer, offload=offload)
 
     def __getattr__(self, name):
         return getattr(self.__dict__["_layer"], name)
@@ -98,7 +161,7 @@ class GroupShardedStage3(GroupShardedStage2):
                  offload=False, sync_comm=False, dp_group=None,
                  exclude_layer=None):
         shard_model_stage3(layer)
-        super().__init__(layer, optimizer, group)
+        super().__init__(layer, optimizer, group, offload=offload)
 
 
 class GroupShardedOptimizerStage2:
@@ -106,7 +169,7 @@ class GroupShardedOptimizerStage2:
 
     def __init__(self, params, optim, group=None, offload=False, device="tpu",
                  pretrain_sync_models=True, dp_group=None, **kw):
-        self._optim = shard_optimizer_state(optim)
+        self._optim = shard_optimizer_state(optim, offload=offload)
 
     def __getattr__(self, name):
         return getattr(self.__dict__["_optim"], name)
@@ -123,7 +186,7 @@ def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
     if mesh is None and jax.device_count() > 1:
         set_global_mesh(build_mesh({"fsdp": jax.device_count()}))
     if level in ("os", "os_g", "p_g_os"):
-        optimizer = shard_optimizer_state(optimizer)
+        optimizer = shard_optimizer_state(optimizer, offload=offload)
     if level == "p_g_os":
         shard_model_stage3(model)
     return model, optimizer, scaler

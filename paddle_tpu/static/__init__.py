@@ -56,11 +56,6 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
     param_names = sorted(
         {v.name for v in block.vars.values() if v.is_parameter})
     scope = global_scope()
-    missing = [p for p in param_names if p not in scope._vars]
-    if missing:
-        raise RuntimeError(
-            f"parameters {missing} uninitialized: run the startup program "
-            "(and training) before save_inference_model")
     feed_names = [v.name for v in feed_vars]
     fetch_names = [v.name if isinstance(v, Variable) else str(v)
                    for v in fetch_vars]
@@ -82,7 +77,14 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
         raise ValueError(
             f"fetch targets depend on feeds {missing_feeds} not listed in "
             "feed_vars")
+    # init check AFTER the prune: parameters outside the exported slice
+    # don't need to exist (reference prunes first too)
     param_names = sorted(n for n in needed if n in param_names)
+    missing = [p for p in param_names if p not in scope._vars]
+    if missing:
+        raise RuntimeError(
+            f"parameters {missing} uninitialized: run the startup program "
+            "(and training) before save_inference_model")
     param_vals = [np.asarray(scope._vars[p]) for p in param_names]
 
     def pure_fn(key, *vals):
@@ -92,12 +94,20 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
             _replay(kept, env)
         return [env[f] for f in fetch_names]
 
+    # ONE SymbolicScope shared by every dynamic feed (jax requires all
+    # argument-shape symbols of an export to come from the same scope),
+    # and one symbol PER DIM POSITION shared across feeds: dynamic dim 0
+    # of every feed is the same batch size, dynamic dim 1 the same
+    # sequence length, etc. — the reference's -1 dims carry exactly this
+    # all-feeds-agree meaning, and ops relating two feeds (loss(pred, y))
+    # need the shared symbol to typecheck.
+    scope_sym = jax_export.SymbolicScope()
     feed_avals = []
-    for i, v in enumerate(feed_vars):
+    for v in feed_vars:
         if v._dyn_dims:
-            dims = ",".join(f"d{i}_{j}" if j in v._dyn_dims else str(s)
+            dims = ",".join(f"d{j}" if j in v._dyn_dims else str(s)
                             for j, s in enumerate(v._value.shape))
-            shape = jax_export.symbolic_shape(f"({dims})")
+            shape = jax_export.symbolic_shape(f"({dims})", scope=scope_sym)
         else:
             shape = v._value.shape
         feed_avals.append(jax.ShapeDtypeStruct(shape, v._value.dtype))

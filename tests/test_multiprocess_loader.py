@@ -161,6 +161,100 @@ class TestMultiprocessLoader:
         vals = sorted(int(v) for b in out for v in b.numpy().ravel())
         assert vals == list(range(12))
 
+    def test_persistent_workers_same_pids_across_epochs(self):
+        ds = ArrayDataset(n=12)
+
+        class PidProbe(Dataset):
+            def __len__(self):
+                return 12
+
+            def __getitem__(self, i):
+                import os
+                return np.asarray([i, os.getpid()], np.int64)
+
+        dl = DataLoader(PidProbe(), batch_size=3, num_workers=2,
+                        persistent_workers=True)
+        try:
+            e1 = np.concatenate([b.numpy() for b in dl])
+            e2 = np.concatenate([b.numpy() for b in dl])
+            # deterministic order both epochs
+            assert e1[:, 0].tolist() == list(range(12))
+            assert e2[:, 0].tolist() == list(range(12))
+            # same worker processes served both epochs
+            assert set(e1[:, 1]) == set(e2[:, 1])
+            assert len(set(e1[:, 1])) == 2
+        finally:
+            dl._pool.close()
+
+    def test_persistent_early_break_keeps_next_epoch_clean(self):
+        """Regression: abandoning an epoch mid-way (break) must not leak
+        stale batches into the next epoch."""
+        ds = ArrayDataset(n=12)
+        dl = DataLoader(ds, batch_size=2, num_workers=2,
+                        persistent_workers=True)
+        try:
+            it = iter(dl)
+            first = next(it)                  # peek one batch, abandon
+            del it
+            import gc
+            gc.collect()                      # trigger generator finally
+            full = [x.numpy().copy() for x, _ in dl]
+            ref = [x.numpy().copy()
+                   for x, _ in DataLoader(ds, batch_size=2, num_workers=0)]
+            assert len(full) == len(ref) == 6
+            for a, b in zip(full, ref):
+                np.testing.assert_array_equal(a, b)
+        finally:
+            if dl._pool is not None:
+                dl._pool.close()
+
+    def test_persistent_iterable_epochs(self):
+        class Stream(IterableDataset):
+            def __iter__(self):
+                wi = get_worker_info()
+                wid, nw = (wi.id, wi.num_workers) if wi else (0, 1)
+                for i in range(wid, 8, nw):
+                    yield np.asarray([i], np.int64)
+
+        dl = DataLoader(Stream(), batch_size=2, num_workers=2,
+                        persistent_workers=True)
+        try:
+            for _ in range(2):
+                vals = sorted(int(v) for b in dl for v in b.numpy().ravel())
+                assert vals == list(range(8))
+        finally:
+            dl._pool.close()
+
+    def test_persistent_worker_error_recovers_next_epoch(self):
+        state = {"armed": True}
+
+        class Flaky(Dataset):
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                import os
+                if i == 2 and os.environ.get("FLAKY_ARM") == "1":
+                    raise ValueError("flaky boom")
+                return np.zeros(2, np.float32)
+
+        import os
+        from paddle_tpu.io.multiprocess import WorkerError
+        os.environ["FLAKY_ARM"] = "1"
+        dl = DataLoader(Flaky(), batch_size=1, num_workers=2,
+                        persistent_workers=True)
+        try:
+            with pytest.raises(WorkerError, match="flaky boom"):
+                list(dl)
+            # the broken pool tore down; disarm and iterate again — a
+            # fresh pool serves the next epoch
+            os.environ["FLAKY_ARM"] = "0"
+            assert len(list(dl)) == 4
+        finally:
+            os.environ.pop("FLAKY_ARM", None)
+            if dl._pool is not None:
+                dl._pool.close()
+
     def test_fallback_without_shared_memory(self):
         ds = ArrayDataset(n=8)
         dl = DataLoader(ds, batch_size=2, num_workers=2,

@@ -271,6 +271,23 @@ class TestSaveInference:
             static.save_inference_model(str(tmp_path / "m2"), [x], [loss],
                                         exe, program=main)
 
+    def test_two_dynamic_feeds_export(self, static_mode, tmp_path):
+        """Regression: multiple dynamic feeds must share one symbolic
+        scope or jax.export rejects the mix."""
+        main, startup = static_mode
+        x = static.data("x", [-1, 3], "float32")
+        y = static.data("y", [-1, 3], "float32")
+        out = x + y
+        exe = static.Executor()
+        _init(exe, main, startup)
+        p = str(tmp_path / "two_feed")
+        static.save_inference_model(p, [x, y], [out], exe, program=main)
+        layer, feeds, fetches = static.load_inference_model(p, exe)
+        a = np.ones((4, 3), np.float32)
+        got, = exe.run(layer, feed={"x": a, "y": 2 * a},
+                       fetch_list=fetches)
+        np.testing.assert_allclose(got, 3 * a)
+
     def test_jit_load_serves_artifact(self, static_mode, tmp_path):
         main, exe, x, y, pred, loss, X, Y = self._trained(static_mode)
         p = str(tmp_path / "m3")
