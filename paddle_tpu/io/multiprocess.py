@@ -338,10 +338,21 @@ class PersistentWorkerPool:
     def run_epoch(self, batch_indices, batch_size=None, drop_last=False):
         """Yield one epoch's batches in deterministic order (map-style:
         batch j from worker j%W; iterable: round-robin until all workers
-        end the epoch). An abandoned generator (early break) drains the
-        rest of the epoch on exit so the rings are clean for the next
-        one — workers were already ordered to finish it."""
+        end the epoch). An abandoned generator (early break) tears the
+        pool down on exit — the rings hold an epoch nobody will consume,
+        and respawning workers is cheaper than draining it; the
+        DataLoader rebuilds the pool on the next epoch. Only ONE epoch
+        may be in flight: the rings carry no epoch tags, so a second
+        concurrent iterator would steal this one's batches."""
+        if getattr(self, "_epoch_active", False):
+            raise RuntimeError(
+                "a persistent-workers DataLoader supports one in-flight "
+                "iterator at a time (finish or abandon the previous epoch "
+                "first, or use persistent_workers=False for concurrent "
+                "iterators)")
+        self._epoch_active = True
         ended = [False] * self._nw
+        completed = False
         try:
             if batch_indices is not None:
                 for w in range(self._nw):
@@ -355,6 +366,7 @@ class PersistentWorkerPool:
                         ended[j % self._nw] = True
                         break
                     yield obj
+                completed = True
             else:
                 for w in range(self._nw):
                     self._cmd_rings[w].put(pickle.dumps(
@@ -370,12 +382,21 @@ class PersistentWorkerPool:
                         continue
                     yield obj
                     i += 1
+                completed = True
         finally:
-            if self._pids:          # pool alive (not torn down by error)
+            self._epoch_active = False
+            if self._pids and completed:
+                # normal completion: the end markers are already in the
+                # rings (map-style never read them) — drain so the next
+                # epoch starts clean; this is bounded and instant
                 for w in range(self._nw):
                     while not ended[w]:
                         if isinstance(self._get(w), _EpochEnd):
                             ended[w] = True
+            elif self._pids:
+                # abandoned mid-epoch: don't block computing batches
+                # nobody will read — respawn instead
+                self.close()
 
     def _get(self, w):
         try:

@@ -160,7 +160,10 @@ class Optimizer:
             # loss actually lives in (NOT the current default — minimize
             # may be called outside the program_guard); the Executor
             # compiles grad + this optimizer's pure _update as one step
-            set_train_spec(loss.block.program, self, loss)
+            prog = loss.block.program
+            if getattr(self, "_static_amp", None):
+                prog._amp_mode = self._static_amp   # static.amp.decorate
+            set_train_spec(prog, self, loss)
             return None, None
         loss.backward()
         self.step()

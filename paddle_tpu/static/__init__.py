@@ -22,6 +22,7 @@ from .program import (Program, Variable, Executor, program_guard,  # noqa
                       enable_static, disable_static,
                       in_static_graph_mode)
 from . import nn  # noqa: F401
+from . import amp  # noqa: F401
 
 
 def cpu_places(device_count=1):
@@ -95,18 +96,22 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
         return [env[f] for f in fetch_names]
 
     # ONE SymbolicScope shared by every dynamic feed (jax requires all
-    # argument-shape symbols of an export to come from the same scope),
-    # and one symbol PER DIM POSITION shared across feeds: dynamic dim 0
-    # of every feed is the same batch size, dynamic dim 1 the same
-    # sequence length, etc. — the reference's -1 dims carry exactly this
-    # all-feeds-agree meaning, and ops relating two feeds (loss(pred, y))
-    # need the shared symbol to typecheck.
+    # argument-shape symbols of an export to come from the same scope).
+    # Dim 0 ("batch") shares one symbol across feeds — ops that relate
+    # two feeds (x + y, loss(pred, label)) need it to typecheck, and a
+    # dynamic leading dim means per-example batching in every reference
+    # model. Other dynamic dims stay per-feed (two feeds' sequence
+    # lengths are independent unless an op says otherwise — if one does,
+    # jax.export raises a clear constraint error at save time rather
+    # than this code silently over-constraining serving).
     scope_sym = jax_export.SymbolicScope()
     feed_avals = []
     for v in feed_vars:
         if v._dyn_dims:
-            dims = ",".join(f"d{j}" if j in v._dyn_dims else str(s)
-                            for j, s in enumerate(v._value.shape))
+            dims = ",".join(
+                ("batch" if j == 0 else f"{v.name}_d{j}")
+                if j in v._dyn_dims else str(s)
+                for j, s in enumerate(v._value.shape))
             shape = jax_export.symbolic_shape(f"({dims})", scope=scope_sym)
         else:
             shape = v._value.shape

@@ -1,0 +1,43 @@
+"""paddle_tpu.static.amp — mixed precision for static Programs.
+
+Reference analog: python/paddle/static/amp/decorate.py (decorate()
+wrapping the optimizer so the inserted program passes cast ops per the
+O1 black/white lists, plus loss scaling).
+
+TPU-native: the Executor replays the SAME op functions eager mode runs,
+so static AMP reuses eager AMP's exact autocast decision
+(amp.maybe_autocast_inputs, the O1 allow/deny lists) at replay time —
+no cast-op insertion pass, the casts trace straight into the compiled
+computation. Loss scaling is accepted for API compatibility but inert:
+bf16 carries f32's exponent range, so TPU mixed precision does not
+underflow the way fp16 did (the reference's dynamic loss scaler existed
+for fp16 CUDA)."""
+from __future__ import annotations
+
+
+class CustomOpLists:
+    """reference AutoMixedPrecisionLists."""
+
+    def __init__(self, custom_white_list=None, custom_black_list=None):
+        self.white_list = set(custom_white_list or ())
+        self.black_list = set(custom_black_list or ())
+
+
+AutoMixedPrecisionLists = CustomOpLists
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=2.0 ** 15,
+             incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+             incr_ratio=2.0, decr_ratio=0.8,
+             use_dynamic_loss_scaling=True, use_pure_bf16=False,
+             use_fp16_guard=None, level="O1", dtype="bfloat16"):
+    """Mark the optimizer so its minimize() records an AMP train spec:
+    the Executor then autocasts every replayed op through the eager O1
+    lists (reference static.amp.decorate)."""
+    optimizer._static_amp = {"level": level, "dtype": dtype,
+                             "lists": amp_lists}
+    return optimizer
+
+
+def is_amp_program(program) -> bool:
+    return bool(getattr(program, "_amp_mode", None))

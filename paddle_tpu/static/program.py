@@ -217,6 +217,7 @@ class Program:
         b.ops = list(self.global_block().ops)
         b.vars = dict(self.global_block().vars)
         p._train_spec = None if for_test else self._train_spec
+        p._amp_mode = getattr(self, "_amp_mode", None)
         p._version = self._version
         return p
 
@@ -284,10 +285,10 @@ def record_apply(op_name: str, fn: Callable, args, static: dict,
         import warnings
         warnings.warn(
             "paddle.amp.auto_cast has no effect while RECORDING a static "
-            "Program (the reference's static AMP is a separate "
-            "static.amp.decorate pass): ops are recorded at their stated "
-            "dtypes. Build the model in bf16, or use dygraph/to_static "
-            "where autocast applies.", RuntimeWarning, stacklevel=3)
+            "Program: ops are recorded at their stated dtypes. Use "
+            "paddle_tpu.static.amp.decorate(optimizer) — the Executor "
+            "then autocasts every replayed op through the same O1 "
+            "lists at compile time.", RuntimeWarning, stacklevel=3)
     block = default_main_program().current_block()
     arg_plan, avals, avals2 = [], [], []
     for a in args:
@@ -438,16 +439,36 @@ def scope_guard(scope):
 
 
 # ---------------------------------------------------------------- executor
-def _replay(block, env: Dict[str, Any]):
+def _replay(block, env: Dict[str, Any], amp=None):
     """Execute a block's ops (or an explicit op list, e.g. a pruned
-    slice) in order against an environment."""
-    for node in (block.ops if isinstance(block, Block) else block):
-        args = [env[a.name] if isinstance(a, _Ref) else a.v
-                for a in node.arg_plan]
-        out = node.fn(*args, **node.attrs)
-        outs = out if isinstance(out, (tuple, list)) else (out,)
-        for nm, val in zip(node.out_names, outs):
-            env[nm] = val
+    slice) in order against an environment. `amp` = {'level','dtype',
+    'lists'} applies the eager O1/O2 autocast decision per op (the
+    static.amp.decorate path — same lists, no cast-op insertion pass)."""
+    ops = block.ops if isinstance(block, Block) else block
+    if amp:
+        from ..amp import auto_cast, maybe_autocast_inputs
+        lists = amp.get("lists")
+        cm = auto_cast(enable=True, level=amp.get("level", "O1"),
+                       dtype=amp.get("dtype", "bfloat16"),
+                       custom_white_list=getattr(lists, "white_list", None),
+                       custom_black_list=getattr(lists, "black_list", None))
+    else:
+        cm = contextlib.nullcontext()
+    with cm:
+        for node in ops:
+            args = [env[a.name] if isinstance(a, _Ref) else a.v
+                    for a in node.arg_plan]
+            if amp:
+                arr_ix = [i for i, a in enumerate(args)
+                          if hasattr(a, "dtype") and hasattr(a, "shape")]
+                cast = maybe_autocast_inputs(
+                    node.type, [args[i] for i in arr_ix])
+                for i, v in zip(arr_ix, cast):
+                    args[i] = v
+            out = node.fn(*args, **node.attrs)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            for nm, val in zip(node.out_names, outs):
+                env[nm] = val
     return env
 
 
@@ -573,11 +594,13 @@ class Executor:
         grad_requests = [f for f in fetch_names if f.endswith("@GRAD")]
         plain_fetches = [f for f in fetch_names if not f.endswith("@GRAD")]
 
+        amp_mode = getattr(program, "_amp_mode", None)
+
         def forward(param_vals, feed_vals):
             env = dict(zip(param_names, param_vals))
             env.update(zip(feed_names, feed_vals))
             with _replay_guard():
-                _replay(block, env)
+                _replay(block, env, amp=amp_mode)
             return env
 
         if spec is None and not grad_requests:

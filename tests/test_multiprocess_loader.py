@@ -162,8 +162,6 @@ class TestMultiprocessLoader:
         assert vals == list(range(12))
 
     def test_persistent_workers_same_pids_across_epochs(self):
-        ds = ArrayDataset(n=12)
-
         class PidProbe(Dataset):
             def __len__(self):
                 return 12
@@ -208,6 +206,25 @@ class TestMultiprocessLoader:
             if dl._pool is not None:
                 dl._pool.close()
 
+    def test_persistent_concurrent_iterators_rejected(self):
+        """The rings carry no epoch tags: a second in-flight iterator
+        would steal batches, so it must raise instead."""
+        ds = ArrayDataset(n=8)
+        dl = DataLoader(ds, batch_size=2, num_workers=2,
+                        persistent_workers=True)
+        try:
+            it1 = iter(dl)
+            next(it1)
+            it2 = iter(dl)
+            with pytest.raises(RuntimeError, match="one in-flight"):
+                next(it2)
+        finally:
+            del it1
+            import gc
+            gc.collect()
+            if dl._pool is not None:
+                dl._pool.close()
+
     def test_persistent_iterable_epochs(self):
         class Stream(IterableDataset):
             def __iter__(self):
@@ -226,8 +243,6 @@ class TestMultiprocessLoader:
             dl._pool.close()
 
     def test_persistent_worker_error_recovers_next_epoch(self):
-        state = {"armed": True}
-
         class Flaky(Dataset):
             def __len__(self):
                 return 4

@@ -232,6 +232,39 @@ class TestTraining:
         np.testing.assert_allclose(gv, expect, rtol=1e-4, atol=1e-5)
 
 
+class TestStaticAmp:
+    def test_decorated_optimizer_trains_in_bf16(self, static_mode):
+        """static.amp.decorate: matmuls run bf16 under the O1 lists and
+        training still converges (reference static/amp/decorate.py)."""
+        main, startup = static_mode
+        x = static.data("x", [-1, 4], "float32")
+        y = static.data("y", [-1, 1], "float32")
+        pred = static.nn.fc(static.nn.fc(x, 16, activation="relu"), 1)
+        loss = paddle.mean((pred - y) ** 2)
+        opt = static.amp.decorate(paddle.optimizer.SGD(learning_rate=0.1))
+        opt.minimize(loss)
+        assert main._amp_mode and main._amp_mode["level"] == "O1"
+        exe = static.Executor()
+        _init(exe, main, startup)
+        rng = np.random.RandomState(0)
+        X = rng.randn(16, 4).astype(np.float32)
+        Y = X @ rng.randn(4, 1).astype(np.float32)
+        first = last = None
+        for _ in range(40):
+            lv, = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+            first = float(lv) if first is None else first
+            last = float(lv)
+        assert last < first * 0.5
+
+    def test_recording_under_autocast_warns(self, static_mode):
+        import paddle_tpu.amp as amp
+        main, _ = static_mode
+        x = static.data("x", [2, 2], "float32")
+        with amp.auto_cast(enable=True):
+            with pytest.warns(RuntimeWarning, match="static.amp.decorate"):
+                paddle.exp(x)
+
+
 class TestSaveInference:
     def _trained(self, static_mode):
         main, startup = static_mode
