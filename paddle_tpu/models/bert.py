@@ -1,0 +1,242 @@
+"""BERT/ERNIE-style bidirectional encoder — the text model family beside
+GPT (BASELINE config 2: ERNIE-3.0/BERT-base via jit → one XLA graph).
+
+Reference analog: the ERNIE/BERT workloads the reference's fleet configs
+train (fused_attention/fused_feedforward encoder stacks, and the
+PaddleNLP-side bert modeling the framework was benched with).
+
+TPU-native architecture mirrors models/gpt.py: one stacked-params
+functional core (per-layer weights stacked on a leading axis, applied
+with lax.scan — O(1) compile in depth, 'pp'-shardable), declarative
+PartitionSpecs for TP/FSDP, bf16 compute with f32 layernorm/softmax.
+Attention is bidirectional with an additive padding mask; at encoder
+lengths (≤512) the masked dense form is MXU-friendly and XLA fuses the
+softmax chain (the flash kernel's O(S·D) memory win only matters at
+long-context lengths, which the GPT/CP path owns).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import constraint as mesh_constraint
+from .gpt import _ln
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    ffn_hidden: Optional[int] = None      # default 4*hidden
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    def __post_init__(self):
+        if self.ffn_hidden is None:
+            self.ffn_hidden = 4 * self.hidden_size
+        assert self.hidden_size % self.num_heads == 0
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+
+PARAM_SPECS: Dict[str, P] = {
+    "wte":        P("mp", "fsdp"),
+    "wpe":        P(None, "fsdp"),
+    "wtt":        P(None, "fsdp"),
+    "emb_ln_scale": P(None),
+    "emb_ln_bias":  P(None),
+    "qkv_w":      P("pp", "fsdp", "mp"),
+    "qkv_b":      P("pp", "mp"),
+    "attn_out_w": P("pp", "mp", "fsdp"),
+    "attn_out_b": P("pp", None),
+    "ln1_scale":  P("pp", None),
+    "ln1_bias":   P("pp", None),
+    "mlp_up_w":   P("pp", "fsdp", "mp"),
+    "mlp_up_b":   P("pp", "mp"),
+    "mlp_down_w": P("pp", "mp", "fsdp"),
+    "mlp_down_b": P("pp", None),
+    "ln2_scale":  P("pp", None),
+    "ln2_bias":   P("pp", None),
+    "pooler_w":   P("fsdp", "mp"),
+    "pooler_b":   P("mp"),
+    "mlm_dense_w": P("fsdp", "mp"),
+    "mlm_dense_b": P("mp"),
+    "mlm_ln_scale": P(None),
+    "mlm_ln_bias":  P(None),
+    "mlm_bias":   P("mp"),
+}
+
+
+def init_bert_params(cfg: BertConfig, key) -> Dict[str, jax.Array]:
+    k = jax.random.split(key, 12)
+    D, F, L, V = (cfg.hidden_size, cfg.ffn_hidden, cfg.num_layers,
+                  cfg.vocab_size)
+    std = 0.02
+    pd = cfg.param_dtype
+
+    def norm(key, shape, scale=std):
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(pd)
+
+    return {
+        "wte": norm(k[0], (V, D)),
+        "wpe": norm(k[1], (cfg.max_seq_len, D)),
+        "wtt": norm(k[2], (cfg.type_vocab_size, D)),
+        "emb_ln_scale": jnp.ones((D,), pd),
+        "emb_ln_bias": jnp.zeros((D,), pd),
+        "qkv_w": norm(k[3], (L, D, 3 * D)),
+        "qkv_b": jnp.zeros((L, 3 * D), pd),
+        "attn_out_w": norm(k[4], (L, D, D), std / math.sqrt(2 * L)),
+        "attn_out_b": jnp.zeros((L, D), pd),
+        "ln1_scale": jnp.ones((L, D), pd),
+        "ln1_bias": jnp.zeros((L, D), pd),
+        "mlp_up_w": norm(k[5], (L, D, F)),
+        "mlp_up_b": jnp.zeros((L, F), pd),
+        "mlp_down_w": norm(k[6], (L, F, D), std / math.sqrt(2 * L)),
+        "mlp_down_b": jnp.zeros((L, D), pd),
+        "ln2_scale": jnp.ones((L, D), pd),
+        "ln2_bias": jnp.zeros((L, D), pd),
+        "pooler_w": norm(k[7], (D, D)),
+        "pooler_b": jnp.zeros((D,), pd),
+        "mlm_dense_w": norm(k[8], (D, D)),
+        "mlm_dense_b": jnp.zeros((D,), pd),
+        "mlm_ln_scale": jnp.ones((D,), pd),
+        "mlm_ln_bias": jnp.zeros((D,), pd),
+        "mlm_bias": jnp.zeros((V,), pd),
+    }
+
+
+def _constraint(x):
+    return mesh_constraint(x, P(("dp", "fsdp"), None, None))
+
+
+def _encoder_block(pl_, x, mask_bias, cfg: BertConfig):
+    """Post-LN encoder block (BERT ordering: sublayer → add → LN)."""
+    B, S, D = x.shape
+    H, hd = cfg.num_heads, cfg.head_dim
+    qkv = jnp.einsum("bsd,df->bsf", x, pl_["qkv_w"].astype(x.dtype))
+    qkv = qkv + pl_["qkv_b"].astype(x.dtype)
+    qkv = mesh_constraint(qkv, P(("dp", "fsdp"), None, "mp"))
+    q, k_, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+    k_ = k_.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+    v = v.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+    s = jnp.einsum("bhsd,bhtd->bhst", q, k_,
+                   preferred_element_type=jnp.float32)
+    s = s / math.sqrt(hd) + mask_bias                     # [B,1,1,S] bias
+    p = jax.nn.softmax(s, axis=-1)
+    ctx = jnp.einsum("bhst,bhtd->bhsd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32).astype(x.dtype)
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, D)
+    a = jnp.einsum("bsd,df->bsf", ctx, pl_["attn_out_w"].astype(x.dtype))
+    a = a + pl_["attn_out_b"].astype(x.dtype)
+    x = _ln(x + a, pl_["ln1_scale"], pl_["ln1_bias"], cfg.layer_norm_eps)
+
+    h = jnp.einsum("bsd,df->bsf", x, pl_["mlp_up_w"].astype(x.dtype))
+    h = jax.nn.gelu(h + pl_["mlp_up_b"].astype(x.dtype))
+    m = jnp.einsum("bsf,fd->bsd", h, pl_["mlp_down_w"].astype(x.dtype))
+    m = m + pl_["mlp_down_b"].astype(x.dtype)
+    x = _ln(x + m, pl_["ln2_scale"], pl_["ln2_bias"], cfg.layer_norm_eps)
+    return _constraint(x)
+
+
+_BLOCK_KEYS = ("qkv_w", "qkv_b", "attn_out_w", "attn_out_b",
+               "ln1_scale", "ln1_bias", "mlp_up_w", "mlp_up_b",
+               "mlp_down_w", "mlp_down_b", "ln2_scale", "ln2_bias")
+
+
+def bert_encode(params, tokens, token_types=None, attention_mask=None,
+                cfg: BertConfig = None):
+    """tokens [B,S] (+ optional token_types [B,S], attention_mask [B,S]
+    with 1=real, 0=pad) → (sequence_output [B,S,D], pooled [B,D])."""
+    B, S = tokens.shape
+    x = jnp.take(params["wte"], tokens, axis=0)
+    x = x + params["wpe"][:S][None]
+    if token_types is None:
+        token_types = jnp.zeros_like(tokens)
+    x = x + jnp.take(params["wtt"], token_types, axis=0)
+    x = _ln(x.astype(cfg.dtype), params["emb_ln_scale"],
+            params["emb_ln_bias"], cfg.layer_norm_eps)
+    x = _constraint(x)
+
+    if attention_mask is None:
+        mask_bias = jnp.zeros((B, 1, 1, S), jnp.float32)
+    else:
+        mask_bias = jnp.where(attention_mask[:, None, None, :] > 0,
+                              0.0, -1e9).astype(jnp.float32)
+
+    stacked = {k: params[k] for k in _BLOCK_KEYS}
+
+    def scan_fn(h, pl_):
+        return _encoder_block(pl_, h, mask_bias, cfg), None
+
+    x, _ = jax.lax.scan(scan_fn, x, stacked)
+    pooled = jnp.tanh(
+        jnp.einsum("bd,df->bf", x[:, 0],
+                   params["pooler_w"].astype(x.dtype))
+        + params["pooler_b"].astype(x.dtype))
+    return x, pooled
+
+
+def bert_mlm_logits(params, seq_out, cfg: BertConfig):
+    """MLM head: dense→gelu→LN→tied-embedding projection + bias."""
+    h = jnp.einsum("bsd,df->bsf", seq_out,
+                   params["mlm_dense_w"].astype(seq_out.dtype))
+    h = jax.nn.gelu(h + params["mlm_dense_b"].astype(seq_out.dtype))
+    h = _ln(h, params["mlm_ln_scale"], params["mlm_ln_bias"],
+            cfg.layer_norm_eps)
+    logits = jnp.einsum("bsd,vd->bsv", h, params["wte"].astype(h.dtype))
+    return logits + params["mlm_bias"].astype(h.dtype)
+
+
+def bert_mlm_loss(params, batch, cfg: BertConfig):
+    """Masked-LM loss. batch: dict(tokens [B,S], labels [B,S] with -100 =
+    unmasked (ignored), optional attention_mask/token_types). Fused CE
+    (logsumexp - target), averaged over masked positions only."""
+    from .losses import fused_softmax_ce
+    tokens = batch["tokens"]
+    labels = batch["labels"]
+    seq, _ = bert_encode(params, tokens, batch.get("token_types"),
+                         batch.get("attention_mask"), cfg)
+    logits = bert_mlm_logits(params, seq, cfg)
+    return fused_softmax_ce(logits, jnp.maximum(labels, 0),
+                            valid_mask=labels >= 0)
+
+
+def init_cls_head(cfg: BertConfig, num_classes: int, key):
+    return {"cls_w": (jax.random.normal(key, (cfg.hidden_size, num_classes),
+                                        jnp.float32) * 0.02
+                      ).astype(cfg.param_dtype),
+            "cls_b": jnp.zeros((num_classes,), cfg.param_dtype)}
+
+
+def bert_cls_loss(params, head, batch, cfg: BertConfig):
+    """Sequence classification over the pooled [CLS] output."""
+    from .losses import fused_softmax_ce
+    _, pooled = bert_encode(params, batch["tokens"],
+                            batch.get("token_types"),
+                            batch.get("attention_mask"), cfg)
+    logits = (pooled @ head["cls_w"].astype(pooled.dtype)
+              + head["cls_b"].astype(pooled.dtype))
+    return fused_softmax_ce(logits, batch["labels"])
+
+
+# canonical sizes (BERT paper / ERNIE-3.0-base)
+BERT_CONFIGS = {
+    "base": BertConfig(),
+    "large": BertConfig(hidden_size=1024, num_layers=24, num_heads=16),
+    "ernie3-base": BertConfig(vocab_size=40000, hidden_size=768,
+                              num_layers=12, num_heads=12),
+}

@@ -408,14 +408,11 @@ def gpt_loss(params, batch, cfg: GPTConfig):
     the target gather each stream the logits once, an HBM-bandwidth win
     at V=32k+ (the reference's fused softmax_with_cross_entropy kernel,
     phi/kernels/gpu/cross_entropy_kernel.cu, made the same trade)."""
+    from .losses import fused_softmax_ce
     tokens = batch["tokens"] if isinstance(batch, dict) else batch
     inp, tgt = tokens[:, :-1], tokens[:, 1:]
     logits, aux = _gpt_forward_impl(params, inp, cfg)
-    lf = logits.astype(jnp.float32)
-    lse = jax.scipy.special.logsumexp(lf, axis=-1)            # [B,S]
-    tgt_logit = jnp.take_along_axis(
-        lf, tgt[..., None].astype(jnp.int32), -1)[..., 0]     # [B,S]
-    loss = jnp.mean(lse - tgt_logit)
+    loss = fused_softmax_ce(logits, tgt)
     if cfg.num_experts > 0:
         loss = loss + cfg.moe_aux_weight * aux
     return loss

@@ -1,0 +1,28 @@
+"""Shared model-loss kernels.
+
+fused_softmax_ce is the one fused cross-entropy implementation the model
+zoo uses (gpt_loss, bert MLM/classification): loss_i = logsumexp(logits_i)
+− logits_i[target_i], mathematically identical to −log_softmax[target]
+but never materializing the [.., V] f32 log-prob tensor — the reference's
+fused softmax_with_cross_entropy kernel
+(phi/kernels/gpu/cross_entropy_kernel.cu) made the same HBM trade.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def fused_softmax_ce(logits, targets, valid_mask=None):
+    """logits [..., V] (any float dtype; upcast to f32 here), targets
+    [...] int. valid_mask [...] (bool/0-1) selects which positions count;
+    None = all. Returns the mean loss over counted positions."""
+    lf = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(lf, axis=-1)
+    tgt = jnp.take_along_axis(
+        lf, targets[..., None].astype(jnp.int32), -1)[..., 0]
+    per_pos = lse - tgt
+    if valid_mask is None:
+        return jnp.mean(per_pos)
+    m = valid_mask.astype(jnp.float32)
+    return jnp.sum(per_pos * m) / jnp.maximum(jnp.sum(m), 1.0)

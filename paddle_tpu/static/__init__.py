@@ -30,13 +30,20 @@ def cpu_places(device_count=1):
 
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
-                         program=None, **kwargs):
+                         program=None, dynamic_dim_names=None, **kwargs):
     """Serialize the inference slice of a Program (reference
     static.save_inference_model → __model__ + params). The artifact is
     the SAME StableHLO + weights + meta layout paddle_tpu.jit.save
     writes, so paddle_tpu.jit.load and inference.Predictor both serve
     it. Dynamic (-1) dims export as symbolic dimensions (jax.export
     shape polymorphism), so any batch size runs.
+
+    Dynamic dims at the same position share one symbol by default (the
+    reference's -1 semantics: tokens and attention_mask agree on batch
+    AND seq len). When two feeds' dynamic dims at the same position are
+    genuinely independent (encoder/decoder src vs tgt lengths), name
+    them apart via `dynamic_dim_names={var_name: {dim_index: "sym"}}` —
+    same name = constrained equal, different names = independent.
 
     Parameters are baked from the current global_scope() (run the
     startup program + training first)."""
@@ -97,21 +104,23 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
 
     # ONE SymbolicScope shared by every dynamic feed (jax requires all
     # argument-shape symbols of an export to come from the same scope).
-    # Dim 0 ("batch") shares one symbol across feeds — ops that relate
-    # two feeds (x + y, loss(pred, label)) need it to typecheck, and a
-    # dynamic leading dim means per-example batching in every reference
-    # model. Other dynamic dims stay per-feed (two feeds' sequence
-    # lengths are independent unless an op says otherwise — if one does,
-    # jax.export raises a clear constraint error at save time rather
-    # than this code silently over-constraining serving).
+    import re
+    dynamic_dim_names = dynamic_dim_names or {}
+
+    def _sym(v, j):
+        name = dynamic_dim_names.get(v.name, {}).get(j, f"d{j}")
+        if not re.fullmatch(r"[A-Za-z_][A-Za-z0-9_]*", name):
+            raise ValueError(
+                f"dynamic_dim_names[{v.name!r}][{j}] = {name!r} is not a "
+                "valid symbol identifier ([A-Za-z_][A-Za-z0-9_]*)")
+        return name
+
     scope_sym = jax_export.SymbolicScope()
     feed_avals = []
     for v in feed_vars:
         if v._dyn_dims:
-            dims = ",".join(
-                ("batch" if j == 0 else f"{v.name}_d{j}")
-                if j in v._dyn_dims else str(s)
-                for j, s in enumerate(v._value.shape))
+            dims = ",".join(_sym(v, j) if j in v._dyn_dims else str(s)
+                            for j, s in enumerate(v._value.shape))
             shape = jax_export.symbolic_shape(f"({dims})", scope=scope_sym)
         else:
             shape = v._value.shape

@@ -321,6 +321,29 @@ class TestSaveInference:
                        fetch_list=fetches)
         np.testing.assert_allclose(got, 3 * a)
 
+    def test_shared_seq_dim_and_independent_override(self, static_mode,
+                                                     tmp_path):
+        """Default: same-position dynamic dims share a symbol (tokens ×
+        mask works). Override: dynamic_dim_names separates them."""
+        main, startup = static_mode
+        x = static.data("x", [-1, -1], "float32")
+        m = static.data("m", [-1, -1], "float32")
+        out = paddle.mean(x * m, axis=1)
+        exe = static.Executor()
+        _init(exe, main, startup)
+        p = str(tmp_path / "seqshare")
+        static.save_inference_model(p, [x, m], [out], exe, program=main)
+        layer, feeds, fetches = static.load_inference_model(p, exe)
+        a = np.ones((3, 7), np.float32)
+        got, = exe.run(layer, feed={"x": a, "m": 2 * a},
+                       fetch_list=fetches)
+        np.testing.assert_allclose(got, np.full((3,), 2.0, np.float32))
+        # invalid override names are rejected up front
+        with pytest.raises(ValueError, match="identifier"):
+            static.save_inference_model(
+                str(tmp_path / "bad"), [x, m], [out], exe, program=main,
+                dynamic_dim_names={"x": {1: "has.dot"}})
+
     def test_jit_load_serves_artifact(self, static_mode, tmp_path):
         main, exe, x, y, pred, loss, X, Y = self._trained(static_mode)
         p = str(tmp_path / "m3")

@@ -76,28 +76,39 @@ def run_row(row: str) -> None:
                           "platform": platform}), flush=True)
 
     elif row == "bert":
-        # BASELINE config 2: BERT-base-ish (12L, 768d, S=512) fwd+bwd via
-        # one jitted graph (the dygraph-to-static path)
-        from paddle_tpu.models.gpt import (GPTConfig, init_gpt_params,
-                                           init_opt_state, train_step)
-        cfg = GPTConfig(vocab_size=30522, hidden_size=768, num_layers=12,
-                        num_heads=12, max_seq_len=512,
-                        sequence_parallel=False, remat=False,
-                        dtype=jnp.bfloat16)
-        params = init_gpt_params(cfg, jax.random.PRNGKey(0))
-        opt_state = init_opt_state(params)
-        tokens = jax.random.randint(jax.random.PRNGKey(1), (16, 513), 0,
+        # BASELINE config 2: BERT-base MLM train step (the real encoder,
+        # models/bert.py) via one jitted graph
+        import optax
+        from paddle_tpu.models.bert import (BertConfig, init_bert_params,
+                                            bert_mlm_loss)
+        cfg = BertConfig(vocab_size=30522, hidden_size=768, num_layers=12,
+                        num_heads=12, max_seq_len=512, dtype=jnp.bfloat16)
+        params = init_bert_params(cfg, jax.random.PRNGKey(0))
+        opt = optax.adamw(1e-4)
+        opt_state = opt.init(params)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (16, 512), 0,
                                     cfg.vocab_size)
-        step = jax.jit(functools.partial(train_step, cfg=cfg, lr=1e-4),
-                       donate_argnums=(0, 1))
+        # 15% MLM masking
+        labels = jnp.where(
+            jax.random.uniform(jax.random.PRNGKey(2), (16, 512)) < 0.15,
+            tokens, -100)
+        batch = {"tokens": tokens, "labels": labels}
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def step(params, opt_state, batch):
+            loss, g = jax.value_and_grad(
+                functools.partial(bert_mlm_loss, cfg=cfg))(params, batch)
+            upd, opt_state = opt.update(g, opt_state, params)
+            return loss, optax.apply_updates(params, upd), opt_state
 
         def run():
             nonlocal params, opt_state
-            loss, params, opt_state = step(params, opt_state, tokens)
+            loss, params, opt_state = step(params, opt_state, batch)
             return loss
         compile_s, dt = _bench_loop(run, iters=10)
         tps = 16 * 512 / dt
-        n_params = sum(int(v.size) for v in params.values())
+        n_params = sum(int(v.size)
+                       for v in jax.tree_util.tree_leaves(params))
         flops_per_tok = 6.0 * n_params + 12.0 * 12 * 768 * 512
         # device-kind-keyed peak table shared with bench.py (repo root is
         # already on sys.path — run_row inserts it first thing)
