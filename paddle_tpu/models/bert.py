@@ -20,7 +20,6 @@ import dataclasses
 import math
 from typing import Any, Dict, Optional
 
-import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
@@ -158,7 +157,7 @@ _BLOCK_KEYS = ("qkv_w", "qkv_b", "attn_out_w", "attn_out_b",
 
 
 def bert_encode(params, tokens, token_types=None, attention_mask=None,
-                cfg: BertConfig = None):
+                *, cfg: BertConfig):
     """tokens [B,S] (+ optional token_types [B,S], attention_mask [B,S]
     with 1=real, 0=pad) → (sequence_output [B,S,D], pooled [B,D])."""
     B, S = tokens.shape
@@ -209,7 +208,7 @@ def bert_mlm_loss(params, batch, cfg: BertConfig):
     tokens = batch["tokens"]
     labels = batch["labels"]
     seq, _ = bert_encode(params, tokens, batch.get("token_types"),
-                         batch.get("attention_mask"), cfg)
+                         batch.get("attention_mask"), cfg=cfg)
     logits = bert_mlm_logits(params, seq, cfg)
     return fused_softmax_ce(logits, jnp.maximum(labels, 0),
                             valid_mask=labels >= 0)
@@ -227,7 +226,7 @@ def bert_cls_loss(params, head, batch, cfg: BertConfig):
     from .losses import fused_softmax_ce
     _, pooled = bert_encode(params, batch["tokens"],
                             batch.get("token_types"),
-                            batch.get("attention_mask"), cfg)
+                            batch.get("attention_mask"), cfg=cfg)
     logits = (pooled @ head["cls_w"].astype(pooled.dtype)
               + head["cls_b"].astype(pooled.dtype))
     return fused_softmax_ce(logits, batch["labels"])

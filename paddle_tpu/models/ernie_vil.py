@@ -1,0 +1,105 @@
+"""ERNIE-ViL-style dual-encoder (BASELINE config 5: multimodal under
+sharding).
+
+Reference analog: ERNIE-ViL 2.0 — a cross-modal contrastive dual-encoder
+(image tower + text tower, in-batch InfoNCE) the reference benches under
+hybrid parallel.
+
+TPU-native composition: the text tower IS models/bert.bert_encode and
+the image tower IS models/vit.vit_encode (both stacked-scan cores with
+TP/FSDP PartitionSpecs); each tower projects into a shared embedding
+space and the symmetric contrastive loss runs on the [B, B] similarity
+matrix. Under dp sharding the in-batch negatives are the LOCAL batch per
+the declarative specs; global-batch negatives ride an all_gather of the
+embeddings, which XLA inserts when the similarity matmul requests
+replicated features (the reference's cross-rank negative sharing)."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .bert import BertConfig, init_bert_params, bert_encode
+from .bert import PARAM_SPECS as BERT_SPECS
+from .vit import ViTConfig, init_vit_params, vit_encode
+from .vit import PARAM_SPECS as VIT_SPECS
+
+
+@dataclasses.dataclass
+class ErnieViLConfig:
+    text: BertConfig = None
+    vision: ViTConfig = None
+    embed_dim: int = 512
+    logit_scale_init: float = 2.6592          # ln(1/0.07), CLIP init
+    dtype: Any = jnp.bfloat16
+
+    def __post_init__(self):
+        if self.text is None:
+            self.text = BertConfig(dtype=self.dtype)
+        if self.vision is None:
+            self.vision = ViTConfig(dtype=self.dtype)
+
+
+PARAM_SPECS: Dict[str, P] = {
+    **{f"text.{k}": v for k, v in BERT_SPECS.items()},
+    **{f"vision.{k}": v for k, v in VIT_SPECS.items()},
+    "text_proj":   P("fsdp", "mp"),
+    "vision_proj": P("fsdp", "mp"),
+    "logit_scale": P(),
+}
+
+
+def init_ernie_vil_params(cfg: ErnieViLConfig, key):
+    kt, kv, kp = jax.random.split(key, 3)
+    params = {}
+    for k, v in init_bert_params(cfg.text, kt).items():
+        params[f"text.{k}"] = v
+    for k, v in init_vit_params(cfg.vision, kv).items():
+        params[f"vision.{k}"] = v
+    k1, k2 = jax.random.split(kp)
+    params["text_proj"] = (
+        jax.random.normal(k1, (cfg.text.hidden_size, cfg.embed_dim),
+                          jnp.float32) * 0.02).astype(jnp.float32)
+    params["vision_proj"] = (
+        jax.random.normal(k2, (cfg.vision.hidden_size, cfg.embed_dim),
+                          jnp.float32) * 0.02).astype(jnp.float32)
+    params["logit_scale"] = jnp.asarray(cfg.logit_scale_init, jnp.float32)
+    return params
+
+
+def _split(params, prefix):
+    n = len(prefix)
+    return {k[n:]: v for k, v in params.items() if k.startswith(prefix)}
+
+
+def encode_text(params, tokens, cfg: ErnieViLConfig, attention_mask=None):
+    """tokens [B, S] → L2-normalized text embeddings [B, E]."""
+    _, pooled = bert_encode(_split(params, "text."), tokens,
+                            attention_mask=attention_mask, cfg=cfg.text)
+    z = pooled.astype(jnp.float32) @ params["text_proj"]
+    return z / jnp.linalg.norm(z, axis=-1, keepdims=True)
+
+
+def encode_image(params, images, cfg: ErnieViLConfig):
+    """images [B, C, H, W] → L2-normalized image embeddings [B, E]."""
+    _, cls = vit_encode(_split(params, "vision."), images, cfg.vision)
+    z = cls.astype(jnp.float32) @ params["vision_proj"]
+    return z / jnp.linalg.norm(z, axis=-1, keepdims=True)
+
+
+def contrastive_loss(params, batch, cfg: ErnieViLConfig):
+    """Symmetric in-batch InfoNCE over the [B, B] similarity matrix.
+    batch: dict(images [B,C,H,W], tokens [B,S], optional
+    attention_mask)."""
+    from .losses import fused_softmax_ce
+    zt = encode_text(params, batch["tokens"], cfg,
+                     batch.get("attention_mask"))
+    zi = encode_image(params, batch["images"], cfg)
+    scale = jnp.exp(jnp.clip(params["logit_scale"], 0.0, 4.6052))  # ≤100
+    sim = scale * (zi @ zt.T)                                  # [B, B]
+    labels = jnp.arange(sim.shape[0])
+    return 0.5 * (fused_softmax_ce(sim, labels)
+                  + fused_softmax_ce(sim.T, labels))

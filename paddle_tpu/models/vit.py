@@ -1,0 +1,147 @@
+"""Vision Transformer encoder — the image tower of the multimodal family
+(BASELINE config 5: ERNIE-ViL 2.0 under sharding) and a standalone
+classifier.
+
+Reference analog: the ViT/ERNIE-ViL image encoders the reference's
+multimodal workloads train (PaddleNLP/PaddleMIX side; in-repo the
+building blocks are the fused attention/ffn ops).
+
+TPU-native: patchify is ONE reshape+matmul (a [P*P*C, D] projection —
+the conv with stride=patch collapses to it exactly), and the block stack
+reuses models/bert.py's post-LN encoder block (stacked params + lax.scan,
+TP/FSDP PartitionSpecs).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .bert import _encoder_block, _BLOCK_KEYS
+from .gpt import _ln
+
+
+@dataclasses.dataclass
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    in_channels: int = 3
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    ffn_hidden: Optional[int] = None
+    layer_norm_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    def __post_init__(self):
+        if self.ffn_hidden is None:
+            self.ffn_hidden = 4 * self.hidden_size
+        assert self.image_size % self.patch_size == 0
+        assert self.hidden_size % self.num_heads == 0
+
+    @property
+    def num_patches(self):
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+
+PARAM_SPECS: Dict[str, P] = {
+    "patch_w":   P(None, "fsdp"),
+    "patch_b":   P(None),
+    "cls_token": P(None, None, "fsdp"),
+    "pos_emb":   P(None, "fsdp"),
+    "qkv_w":      P("pp", "fsdp", "mp"),
+    "qkv_b":      P("pp", "mp"),
+    "attn_out_w": P("pp", "mp", "fsdp"),
+    "attn_out_b": P("pp", None),
+    "ln1_scale":  P("pp", None),
+    "ln1_bias":   P("pp", None),
+    "mlp_up_w":   P("pp", "fsdp", "mp"),
+    "mlp_up_b":   P("pp", "mp"),
+    "mlp_down_w": P("pp", "mp", "fsdp"),
+    "mlp_down_b": P("pp", None),
+    "ln2_scale":  P("pp", None),
+    "ln2_bias":   P("pp", None),
+    "ln_post_scale": P(None),
+    "ln_post_bias":  P(None),
+}
+
+
+def init_vit_params(cfg: ViTConfig, key) -> Dict[str, jax.Array]:
+    k = jax.random.split(key, 10)
+    D, F, L = cfg.hidden_size, cfg.ffn_hidden, cfg.num_layers
+    patch_dim = cfg.patch_size * cfg.patch_size * cfg.in_channels
+    std = 0.02
+    pd = cfg.param_dtype
+
+    def norm(key, shape, scale=std):
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(pd)
+
+    return {
+        "patch_w": norm(k[0], (patch_dim, D), 1.0 / math.sqrt(patch_dim)),
+        "patch_b": jnp.zeros((D,), pd),
+        "cls_token": norm(k[1], (1, 1, D)),
+        "pos_emb": norm(k[2], (cfg.num_patches + 1, D)),
+        "qkv_w": norm(k[3], (L, D, 3 * D)),
+        "qkv_b": jnp.zeros((L, 3 * D), pd),
+        "attn_out_w": norm(k[4], (L, D, D), std / math.sqrt(2 * L)),
+        "attn_out_b": jnp.zeros((L, D), pd),
+        "ln1_scale": jnp.ones((L, D), pd),
+        "ln1_bias": jnp.zeros((L, D), pd),
+        "mlp_up_w": norm(k[5], (L, D, F)),
+        "mlp_up_b": jnp.zeros((L, F), pd),
+        "mlp_down_w": norm(k[6], (L, F, D), std / math.sqrt(2 * L)),
+        "mlp_down_b": jnp.zeros((L, D), pd),
+        "ln2_scale": jnp.ones((L, D), pd),
+        "ln2_bias": jnp.zeros((L, D), pd),
+        "ln_post_scale": jnp.ones((D,), pd),
+        "ln_post_bias": jnp.zeros((D,), pd),
+    }
+
+
+def patchify(images, cfg: ViTConfig):
+    """[B, C, H, W] → [B, N, P·P·C]: the stride-P conv as one reshape."""
+    B, C, H, W = images.shape
+    p = cfg.patch_size
+    x = images.reshape(B, C, H // p, p, W // p, p)
+    x = x.transpose(0, 2, 4, 3, 5, 1)            # B, Hp, Wp, p, p, C
+    return x.reshape(B, (H // p) * (W // p), p * p * C)
+
+
+def vit_encode(params, images, cfg: ViTConfig):
+    """images [B, C, H, W] → (tokens [B, N+1, D], cls [B, D])."""
+    B = images.shape[0]
+    x = patchify(images.astype(cfg.dtype), cfg)
+    x = jnp.einsum("bnp,pd->bnd", x, params["patch_w"].astype(x.dtype))
+    x = x + params["patch_b"].astype(x.dtype)
+    cls = jnp.broadcast_to(params["cls_token"].astype(x.dtype),
+                           (B, 1, x.shape[-1]))
+    x = jnp.concatenate([cls, x], axis=1)
+    x = x + params["pos_emb"][None].astype(x.dtype)
+
+    S = x.shape[1]
+    mask_bias = jnp.zeros((B, 1, 1, S), jnp.float32)
+    stacked = {k: params[k] for k in _BLOCK_KEYS}
+
+    def scan_fn(h, pl_):
+        return _encoder_block(pl_, h, mask_bias, cfg), None
+
+    x, _ = jax.lax.scan(scan_fn, x, stacked)
+    x = _ln(x, params["ln_post_scale"], params["ln_post_bias"],
+            cfg.layer_norm_eps)
+    return x, x[:, 0]
+
+
+VIT_CONFIGS = {
+    "base16": ViTConfig(),
+    "large16": ViTConfig(hidden_size=1024, num_layers=24, num_heads=16),
+    "small16": ViTConfig(hidden_size=384, num_layers=12, num_heads=6),
+}

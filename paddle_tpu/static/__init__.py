@@ -106,6 +106,20 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
     # argument-shape symbols of an export to come from the same scope).
     import re
     dynamic_dim_names = dynamic_dim_names or {}
+    # catch typos up front: every override must name a real feed and one
+    # of its dynamic dims, else it would be silently ignored
+    by_name = {v.name: v for v in feed_vars}
+    for vn, dims in dynamic_dim_names.items():
+        if vn not in by_name:
+            raise ValueError(
+                f"dynamic_dim_names key {vn!r} matches no feed var "
+                f"(feeds: {sorted(by_name)})")
+        bad = [j for j in dims if j not in by_name[vn]._dyn_dims]
+        if bad:
+            raise ValueError(
+                f"dynamic_dim_names[{vn!r}] names dims {bad} that are not "
+                f"dynamic on that feed (dynamic dims: "
+                f"{list(by_name[vn]._dyn_dims)})")
 
     def _sym(v, j):
         name = dynamic_dim_names.get(v.name, {}).get(j, f"d{j}")

@@ -343,6 +343,30 @@ class TestSaveInference:
             static.save_inference_model(
                 str(tmp_path / "bad"), [x, m], [out], exe, program=main,
                 dynamic_dim_names={"x": {1: "has.dot"}})
+        # typo'd feed names / non-dynamic dims are rejected, not ignored
+        with pytest.raises(ValueError, match="matches no feed"):
+            static.save_inference_model(
+                str(tmp_path / "bad2"), [x, m], [out], exe, program=main,
+                dynamic_dim_names={"xx": {1: "s"}})
+
+    def test_independent_dims_via_override(self, static_mode, tmp_path):
+        """The override happy path: name dim-1 apart and serve feeds of
+        DIFFERENT lengths (encoder/decoder style)."""
+        main, startup = static_mode
+        x = static.data("x", [-1, -1], "float32")
+        m = static.data("m", [-1, -1], "float32")
+        out = paddle.mean(x, axis=1) + paddle.mean(m, axis=1)  # only batch tied
+        exe = static.Executor()
+        _init(exe, main, startup)
+        p = str(tmp_path / "indep")
+        static.save_inference_model(
+            p, [x, m], [out], exe, program=main,
+            dynamic_dim_names={"x": {1: "x_len"}, "m": {1: "m_len"}})
+        layer, feeds, fetches = static.load_inference_model(p, exe)
+        a = np.ones((3, 7), np.float32)
+        b = np.full((3, 4), 3.0, np.float32)
+        got, = exe.run(layer, feed={"x": a, "m": b}, fetch_list=fetches)
+        np.testing.assert_allclose(got, np.full((3,), 4.0, np.float32))
 
     def test_jit_load_serves_artifact(self, static_mode, tmp_path):
         main, exe, x, y, pred, loss, X, Y = self._trained(static_mode)

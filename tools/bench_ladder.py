@@ -14,7 +14,7 @@ import subprocess
 import sys
 import time
 
-ROWS = ["mnist", "bert", "resnet50"]
+ROWS = ["mnist", "bert", "resnet50", "ernie_vil"]
 
 
 def _bench_loop(step, iters=10):
@@ -146,6 +146,43 @@ def run_row(row: str) -> None:
             return loss._value
         compile_s, dt = _bench_loop(step, iters=5)
         print(json.dumps({"row": "resnet50", "metric": "images_per_sec",
+                          "value": round(B / dt, 1), "batch": B,
+                          "compile_s": round(compile_s, 1),
+                          "platform": platform}), flush=True)
+
+    elif row == "ernie_vil":
+        # BASELINE config 5: ERNIE-ViL dual-encoder contrastive step,
+        # samples/sec/chip (ViT-base image tower + BERT-base text tower)
+        import optax
+        from paddle_tpu.models.ernie_vil import (ErnieViLConfig,
+                                                 init_ernie_vil_params,
+                                                 contrastive_loss)
+        cfg = ErnieViLConfig()
+        B = 32 if platform in ("tpu", "axon") else 2
+        params = init_ernie_vil_params(cfg, jax.random.PRNGKey(0))
+        opt = optax.adamw(1e-4)
+        opt_state = opt.init(params)
+        batch = {
+            "tokens": jax.random.randint(jax.random.PRNGKey(1), (B, 64),
+                                         0, cfg.text.vocab_size),
+            "images": jax.random.normal(jax.random.PRNGKey(2),
+                                        (B, 3, 224, 224), jnp.float32),
+        }
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def step(params, opt_state, batch):
+            loss, g = jax.value_and_grad(functools.partial(
+                contrastive_loss, cfg=cfg))(params, batch)
+            upd, opt_state = opt.update(g, opt_state, params)
+            return loss, optax.apply_updates(params, upd), opt_state
+
+        def run():
+            nonlocal params, opt_state
+            loss, params, opt_state = step(params, opt_state, batch)
+            return loss
+        compile_s, dt = _bench_loop(run, iters=5)
+        print(json.dumps({"row": "ernie_vil_dual_encoder",
+                          "metric": "samples_per_sec_per_chip",
                           "value": round(B / dt, 1), "batch": B,
                           "compile_s": round(compile_s, 1),
                           "platform": platform}), flush=True)
