@@ -1,0 +1,62 @@
+"""paddle_tpu.models — the model zoo's language/multimodal families.
+
+- gpt: causal-LM flagship (TP/PP/DP/SP/EP hybrid parallel, flash
+  attention, KV-cache decode) — BASELINE config 3.
+- bert: bidirectional encoder (MLM + classification) — config 2.
+- vit / ernie_vil: image encoder + contrastive dual-encoder — config 5.
+- losses: shared fused kernels (fused_softmax_ce).
+- facade: the shared Layer-style plumbing the *Model classes ride.
+
+Vision CNNs (ResNet et al.) live in paddle_tpu.vision.models, matching
+the reference's paddle.vision.models split.
+"""
+from . import gpt  # noqa: F401
+from . import bert  # noqa: F401
+from . import vit  # noqa: F401
+from . import ernie_vil  # noqa: F401
+from . import losses  # noqa: F401
+from .facade import FacadeModel  # noqa: F401
+from .gpt import GPTModel, GPTConfig, GPT3_CONFIGS  # noqa: F401
+from .bert import BertConfig, BERT_CONFIGS  # noqa: F401
+from .vit import ViTConfig, VIT_CONFIGS  # noqa: F401
+from .ernie_vil import ErnieViLConfig  # noqa: F401
+
+
+class BertModel(FacadeModel):
+    """Paddle-shaped BERT facade over models/bert's functional core:
+    forward(tokens, token_types, attention_mask) -> (sequence, pooled)."""
+
+    def __init__(self, cfg: BertConfig = None, seed: int = 0):
+        from .bert import init_bert_params, PARAM_SPECS
+        super().__init__(cfg or BertConfig(), init_bert_params,
+                         PARAM_SPECS, seed)
+
+    def forward(self, tokens, token_types=None, attention_mask=None):
+        from .bert import bert_encode
+        cfg = self.cfg
+
+        def fn(params, tok, tt, am):
+            return bert_encode(params, tok, tt, am, cfg=cfg)
+        return self._dispatch("bert_forward", fn, tokens, token_types,
+                              attention_mask)
+
+    __call__ = forward
+
+
+class ViTModel(FacadeModel):
+    """Paddle-shaped ViT facade: forward(images) -> (tokens, cls)."""
+
+    def __init__(self, cfg: ViTConfig = None, seed: int = 0):
+        from .vit import init_vit_params, PARAM_SPECS
+        super().__init__(cfg or ViTConfig(), init_vit_params,
+                         PARAM_SPECS, seed)
+
+    def forward(self, images):
+        from .vit import vit_encode
+        cfg = self.cfg
+
+        def fn(params, imgs):
+            return vit_encode(params, imgs, cfg)
+        return self._dispatch("vit_forward", fn, images)
+
+    __call__ = forward

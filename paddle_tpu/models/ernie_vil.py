@@ -56,6 +56,8 @@ def init_ernie_vil_params(cfg: ErnieViLConfig, key):
     kt, kv, kp = jax.random.split(key, 3)
     params = {}
     for k, v in init_bert_params(cfg.text, kt).items():
+        if k.startswith("mlm_"):
+            continue       # MLM head is dead weight in the dual encoder
         params[f"text.{k}"] = v
     for k, v in init_vit_params(cfg.vision, kv).items():
         params[f"vision.{k}"] = v

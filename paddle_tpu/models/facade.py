@@ -1,0 +1,67 @@
+"""Layer-style facade over a functional model core.
+
+One implementation of the paddle-shaped plumbing (parameters /
+state_dict / train-eval / tape-recorded forward) shared by GPTModel,
+BertModel and ViTModel: the functional params become tape Parameters and
+forward dispatches the whole core as ONE differentiable op.
+
+Closure hygiene matters here: dispatch caches the op closure globally
+(framework/dispatch.py _JIT_CACHE keyed by op name + qualname + static
+args), so nothing passed to apply() may capture the model instance or
+the call's input tensors — only the param-name tuple, the input count,
+and the (small, immutable) config travel in the closure.
+"""
+from __future__ import annotations
+
+
+class FacadeModel:
+    _fwd_op_name = "model_forward"
+
+    def __init__(self, cfg, init_fn, specs, seed=0):
+        import jax
+        from ..nn.parameter import Parameter
+        self.cfg = cfg
+        raw = init_fn(cfg, jax.random.PRNGKey(seed))
+        self._param_names = tuple(raw.keys())
+        self._params = {n: Parameter(v, name=f"{type(self).__name__}.{n}")
+                        for n, v in raw.items()}
+        for n, p in self._params.items():
+            p.sharding_spec = specs[n]
+        self.training = True
+
+    def parameters(self):
+        return list(self._params.values())
+
+    def named_parameters(self, *a, **k):
+        return list(self._params.items())
+
+    def state_dict(self):
+        return dict(self._params)
+
+    def set_state_dict(self, sd):
+        for k_, v in sd.items():
+            if k_ in self._params:
+                self._params[k_].set_value(
+                    v.numpy() if hasattr(v, "numpy") else v)
+
+    def train(self):
+        self.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        return self
+
+    def _dispatch(self, op_name, fn, *inputs):
+        """fn(params_dict, *inputs) -> outputs; fn must not capture the
+        model instance (close over the config value, not self)."""
+        from ..framework.dispatch import apply
+        names = self._param_names
+        n_in = len(inputs)
+
+        def _fwd(*vals, cfg_id=None, _fn=fn, _names=names, _n=n_in):
+            return _fn(dict(zip(_names, vals[_n:])), *vals[:_n])
+        _fwd.__qualname__ = f"{type(self).__name__}.{op_name}"
+        return apply(op_name, _fwd, *inputs,
+                     *[self._params[n] for n in names],
+                     cfg_id=repr(self.cfg))

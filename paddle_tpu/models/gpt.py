@@ -34,6 +34,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.mesh import get_mesh, constraint as mesh_constraint
+from .facade import FacadeModel
 
 
 @dataclasses.dataclass
@@ -463,70 +464,31 @@ def train_step(params, opt_state, batch, cfg: GPTConfig, lr=3e-4,
 # --------------------------------------------------------------------------
 # nn.Layer facade (paddle-shaped API over the functional core)
 # --------------------------------------------------------------------------
-class GPTModel:
+class GPTModel(FacadeModel):
     """Paddle-shaped facade: .parameters(), forward(tokens)->logits, works
-    eagerly and under paddle_tpu.jit.to_static (the functional core runs as
-    one traced op through the dispatch layer)."""
+    eagerly and under paddle_tpu.jit.to_static (the functional core runs
+    as one traced op through the dispatch layer — plumbing shared with
+    BertModel/ViTModel via models/facade.py)."""
 
     def __init__(self, cfg: GPTConfig, seed: int = 0):
-        from ..nn.parameter import Parameter
-        from ..framework.tensor import Tensor
-        self.cfg = cfg
-        raw = init_gpt_params(cfg, jax.random.PRNGKey(seed))
-        raw = shard_gpt_params(raw)
-        self._param_names = list(raw.keys())
-        self._params = {name: Parameter(v, name=f"gpt.{name}")
-                        for name, v in raw.items()}
-        for name, p in self._params.items():
-            p.sharding_spec = PARAM_SPECS[name]
-        self.training = True
-
-    def parameters(self):
-        return list(self._params.values())
-
-    def named_parameters(self, *a, **k):
-        return list(self._params.items())
-
-    def state_dict(self):
-        return dict(self._params)
-
-    def set_state_dict(self, sd):
-        for k_, v in sd.items():
-            if k_ in self._params:
-                self._params[k_].set_value(
-                    v.numpy() if hasattr(v, "numpy") else v)
-
-    def train(self):
-        self.training = True
-        return self
-
-    def eval(self):
-        self.training = False
-        return self
+        super().__init__(
+            cfg,
+            lambda c, key: shard_gpt_params(init_gpt_params(c, key)),
+            PARAM_SPECS, seed)
 
     def forward(self, tokens):
-        from ..framework.dispatch import apply
-        names = self._param_names
-
-        def _fwd(tok, *pvals, cfg_id=None):
-            params = dict(zip(names, pvals))
-            return gpt_forward(params, tok, self.cfg)
-        return apply("gpt_forward", _fwd, tokens,
-                     *[self._params[n] for n in names],
-                     cfg_id=repr(self.cfg))
+        cfg = self.cfg
+        return self._dispatch(
+            "gpt_forward",
+            lambda params, tok: gpt_forward(params, tok, cfg), tokens)
 
     __call__ = forward
 
     def loss(self, tokens):
-        from ..framework.dispatch import apply
-        names = self._param_names
-
-        def _loss(tok, *pvals, cfg_id=None):
-            params = dict(zip(names, pvals))
-            return gpt_loss(params, tok, self.cfg)
-        return apply("gpt_loss", _loss, tokens,
-                     *[self._params[n] for n in names],
-                     cfg_id=repr(self.cfg))
+        cfg = self.cfg
+        return self._dispatch(
+            "gpt_loss",
+            lambda params, tok: gpt_loss(params, tok, cfg), tokens)
 
 
 # canonical configs (reference: GPT-3 table; 6.7B is BASELINE config 3)

@@ -136,6 +136,36 @@ class TestClassification:
         assert float(lf(both, batch)) < l0 * 0.3
 
 
+class TestFacade:
+    def test_bert_model_facade_tape_grads(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.models import BertModel
+        m = BertModel(_cfg())
+        toks = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, 128, (2, 8))
+            .astype(np.int32))
+        seq, pooled = m(toks)
+        assert list(seq.shape) == [2, 8, 32]
+        (pooled ** 2).mean().backward()
+        grads = [p.grad for p in m.parameters() if p.grad is not None]
+        assert grads, "facade must record on the tape"
+
+    def test_vit_model_facade(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.models import ViTModel
+        from paddle_tpu.models.vit import ViTConfig
+        import jax.numpy as jnp
+        v = ViTModel(ViTConfig(image_size=16, patch_size=4, hidden_size=32,
+                               num_layers=2, num_heads=4,
+                               dtype=jnp.float32))
+        imgs = paddle.to_tensor(
+            np.random.RandomState(1).randn(2, 3, 16, 16)
+            .astype(np.float32))
+        toks, cls = v(imgs)
+        assert list(toks.shape) == [2, 17, 32]
+        assert list(cls.shape) == [2, 32]
+
+
 class TestSharded:
     def test_tp_sharded_encode_matches_single(self):
         """TP/FSDP sharding over the 8-device mesh: numerics match the
