@@ -14,7 +14,8 @@ import jax.numpy as jnp
 
 from ..framework.dispatch import apply
 
-__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets"]
+__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets",
+           "FasterTokenizer"]
 
 
 def viterbi_decode(potentials, transition_params, lengths=None,
@@ -89,3 +90,6 @@ class _DatasetsStub:
 
 
 datasets = _DatasetsStub()
+
+
+from .tokenizer import FasterTokenizer  # noqa: E402,F401

@@ -1,0 +1,163 @@
+// Native WordPiece tokenizer — greedy longest-match-first subword encode.
+//
+// Reference analog: the FasterTokenizer C++ op the reference ships
+// (paddle/fluid/operators/string/faster_tokenizer_op.cc — BertTokenizer/
+// WordPieceTokenizer over a vocab, exposed as an operator). Here the
+// native core is the hot inner loop (basic whitespace/punct split +
+// greedy wordpiece over a hash vocab) with a C ABI for ctypes; the
+// Python wrapper (paddle_tpu/text/tokenizer.py) owns vocab loading,
+// special tokens, and padding/truncation policy.
+//
+// Built on demand by the wrapper (g++ -O2 -shared -fPIC, cached by
+// source hash). UTF-8 aware at the codepoint-boundary level: multi-byte
+// sequences are kept intact; CJK codepoints split as single "words"
+// (BasicTokenizer's tokenize_chinese_chars behavior).
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Vocab {
+  std::unordered_map<std::string, int32_t> tok2id;
+  int32_t unk_id = 0;
+  int32_t max_word_len = 100;
+};
+
+inline bool is_ws(unsigned char c) {
+  return c == ' ' || c == '\t' || c == '\n' || c == '\r';
+}
+
+inline bool is_punct(unsigned char c) {
+  return (c >= 33 && c <= 47) || (c >= 58 && c <= 64) ||
+         (c >= 91 && c <= 96) || (c >= 123 && c <= 126);
+}
+
+inline int utf8_len(unsigned char c) {
+  if (c < 0x80) return 1;
+  if ((c >> 5) == 0x6) return 2;
+  if ((c >> 4) == 0xe) return 3;
+  if ((c >> 3) == 0x1e) return 4;
+  return 1;  // invalid byte: treat as single
+}
+
+inline bool is_cjk(const std::string& s, size_t i, int len) {
+  if (len < 3) return false;
+  // decode the codepoint (3-byte range covers the main CJK blocks)
+  uint32_t cp = 0;
+  unsigned char c0 = s[i];
+  if (len == 3) {
+    cp = ((c0 & 0x0f) << 12) | ((s[i + 1] & 0x3f) << 6) | (s[i + 2] & 0x3f);
+  } else if (len == 4) {
+    cp = ((c0 & 0x07) << 18) | ((s[i + 1] & 0x3f) << 12) |
+         ((s[i + 2] & 0x3f) << 6) | (s[i + 3] & 0x3f);
+  }
+  return (cp >= 0x4E00 && cp <= 0x9FFF) || (cp >= 0x3400 && cp <= 0x4DBF) ||
+         (cp >= 0xF900 && cp <= 0xFAFF) || (cp >= 0x20000 && cp <= 0x2A6DF);
+}
+
+void split_words(const std::string& text, std::vector<std::string>* words) {
+  std::string cur;
+  size_t i = 0;
+  while (i < text.size()) {
+    unsigned char c = text[i];
+    int len = utf8_len(c);
+    if (len == 1 && is_ws(c)) {
+      if (!cur.empty()) { words->push_back(cur); cur.clear(); }
+      i += 1;
+      continue;
+    }
+    if (len == 1 && is_punct(c)) {
+      if (!cur.empty()) { words->push_back(cur); cur.clear(); }
+      words->push_back(std::string(1, (char)c));
+      i += 1;
+      continue;
+    }
+    if (is_cjk(text, i, len)) {
+      if (!cur.empty()) { words->push_back(cur); cur.clear(); }
+      words->push_back(text.substr(i, len));
+      i += len;
+      continue;
+    }
+    cur.append(text, i, len);
+    i += len;
+  }
+  if (!cur.empty()) words->push_back(cur);
+}
+
+void wordpiece(const Vocab& v, const std::string& word,
+               std::vector<int32_t>* out) {
+  if ((int32_t)word.size() > v.max_word_len) {
+    out->push_back(v.unk_id);
+    return;
+  }
+  size_t start = 0;
+  std::vector<int32_t> pieces;
+  while (start < word.size()) {
+    size_t end = word.size();
+    int32_t cur_id = -1;
+    while (start < end) {
+      std::string sub = word.substr(start, end - start);
+      if (start > 0) sub = "##" + sub;
+      auto it = v.tok2id.find(sub);
+      if (it != v.tok2id.end()) { cur_id = it->second; break; }
+      // back off one UTF-8 codepoint, not one byte
+      size_t e = end - 1;
+      while (e > start && ((unsigned char)word[e] & 0xC0) == 0x80) e--;
+      end = e;
+    }
+    if (cur_id < 0) {  // no piece matched: whole word is UNK
+      out->push_back(v.unk_id);
+      return;
+    }
+    pieces.push_back(cur_id);
+    start = end;
+  }
+  out->insert(out->end(), pieces.begin(), pieces.end());
+}
+
+}  // namespace
+
+extern "C" {
+
+void* vocab_create(int32_t unk_id, int32_t max_word_len) {
+  Vocab* v = new Vocab();
+  v->unk_id = unk_id;
+  v->max_word_len = max_word_len;
+  return v;
+}
+
+void vocab_add(void* vp, const char* token, int32_t id) {
+  static_cast<Vocab*>(vp)->tok2id.emplace(token, id);
+}
+
+void vocab_free(void* vp) { delete static_cast<Vocab*>(vp); }
+
+int64_t vocab_size(void* vp) {
+  return (int64_t)static_cast<Vocab*>(vp)->tok2id.size();
+}
+
+// Encode one UTF-8 string (lowercasing is the Python side's job when
+// do_lower_case). Writes at most out_cap ids; returns the number of ids
+// the full encode produces (callers re-try with a bigger buffer when
+// return > out_cap).
+int64_t encode(void* vp, const char* text, int64_t text_len,
+               int32_t* out, int64_t out_cap) {
+  const Vocab& v = *static_cast<Vocab*>(vp);
+  std::string s(text, (size_t)text_len);
+  std::vector<std::string> words;
+  split_words(s, &words);
+  std::vector<int32_t> ids;
+  for (const auto& w : words) wordpiece(v, w, &ids);
+  int64_t n = (int64_t)ids.size();
+  if (out != nullptr) {
+    int64_t m = n < out_cap ? n : out_cap;
+    std::memcpy(out, ids.data(), (size_t)m * sizeof(int32_t));
+  }
+  return n;
+}
+
+}  // extern "C"

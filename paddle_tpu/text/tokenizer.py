@@ -1,0 +1,244 @@
+"""FasterTokenizer — native WordPiece encode (text/_native/wordpiece.cpp).
+
+Reference analog: the FasterTokenizer operator
+(paddle/fluid/operators/string/faster_tokenizer_op.cc): BasicTokenizer
+(whitespace/punct/CJK split) + WordPieceTokenizer (greedy longest-match
+over a vocab) in C++, exposed to Python with padding/truncation policy.
+The native core does the per-string hot loop; this wrapper owns vocab
+loading, lowercasing, special tokens, batching, and the numpy output
+(input_ids / token_type_ids / attention_mask like the reference op).
+
+Falls back to a pure-Python implementation of the same algorithm when
+the toolchain can't build the extension.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Union
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "_native", "wordpiece.cpp")
+_BUILD_DIR = os.path.join(_HERE, "_native", "_build")
+
+_lib = None
+_lib_err = None
+_lock = threading.Lock()
+
+
+def _get_lib():
+    global _lib, _lib_err
+    if _lib is not None or _lib_err is not None:
+        return _lib
+    with _lock:
+        if _lib is not None or _lib_err is not None:
+            return _lib
+        try:
+            with open(_SRC, "rb") as f:
+                tag = hashlib.sha256(f.read()).hexdigest()[:16]
+            so = os.path.join(_BUILD_DIR, f"libwordpiece-{tag}.so")
+            if not os.path.exists(so):
+                os.makedirs(_BUILD_DIR, exist_ok=True)
+                tmp = so + f".tmp{os.getpid()}"
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                     "-o", tmp, _SRC], check=True, capture_output=True)
+                os.replace(tmp, so)
+            lib = ctypes.CDLL(so)
+            lib.vocab_create.restype = ctypes.c_void_p
+            lib.vocab_create.argtypes = [ctypes.c_int32, ctypes.c_int32]
+            lib.vocab_add.restype = None
+            lib.vocab_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_int32]
+            lib.vocab_free.restype = None
+            lib.vocab_free.argtypes = [ctypes.c_void_p]
+            lib.vocab_size.restype = ctypes.c_int64
+            lib.vocab_size.argtypes = [ctypes.c_void_p]
+            lib.encode.restype = ctypes.c_int64
+            lib.encode.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_int64,
+                                   ctypes.POINTER(ctypes.c_int32),
+                                   ctypes.c_int64]
+            _lib = lib
+        except Exception as e:
+            _lib_err = e
+    return _lib
+
+
+def native_available() -> bool:
+    return _get_lib() is not None
+
+
+def _py_wordpiece(vocab, word, unk_id, max_word_len=100):
+    if len(word.encode("utf-8")) > max_word_len:
+        return [unk_id]
+    pieces, start = [], 0
+    while start < len(word):
+        end, cur = len(word), None
+        while start < end:
+            sub = word[start:end]
+            if start > 0:
+                sub = "##" + sub
+            if sub in vocab:
+                cur = vocab[sub]
+                break
+            end -= 1
+        if cur is None:
+            return [unk_id]
+        pieces.append(cur)
+        start = end
+    return pieces
+
+
+def _is_cjk_cp(cp: int) -> bool:
+    return (0x4E00 <= cp <= 0x9FFF or 0x3400 <= cp <= 0x4DBF or
+            0xF900 <= cp <= 0xFAFF or 0x20000 <= cp <= 0x2A6DF)
+
+
+def _py_split(text):
+    """Pure-Python mirror of the native split (wordpiece.cpp
+    split_words): whitespace, ASCII punctuation, and CJK codepoints as
+    boundaries. Kept byte-for-byte consistent with the native rules —
+    both deviate from full-Unicode-punctuation BasicTokenizer the same
+    way, so an environment without g++ tokenizes identically to one
+    with it."""
+    out, cur = [], []
+    for ch in text:
+        o = ord(ch)
+        if ch in " \t\n\r":
+            if cur:
+                out.append("".join(cur))
+                cur = []
+        elif (33 <= o <= 47 or 58 <= o <= 64 or 91 <= o <= 96 or
+              123 <= o <= 126):
+            if cur:
+                out.append("".join(cur))
+                cur = []
+            out.append(ch)
+        elif _is_cjk_cp(o):
+            if cur:
+                out.append("".join(cur))
+                cur = []
+            out.append(ch)
+        else:
+            cur.append(ch)
+    if cur:
+        out.append("".join(cur))
+    return out
+
+
+class FasterTokenizer:
+    """BERT-style WordPiece tokenizer with the native C++ core.
+
+    vocab: dict token->id, a path to a vocab.txt (one token per line), or
+    an iterable of tokens (ids = line numbers)."""
+
+    def __init__(self, vocab: Union[Dict[str, int], str, Iterable[str]],
+                 do_lower_case: bool = True, unk_token: str = "[UNK]",
+                 cls_token: str = "[CLS]", sep_token: str = "[SEP]",
+                 pad_token: str = "[PAD]", max_word_len: int = 100):
+        if isinstance(vocab, str):
+            with open(vocab, encoding="utf-8") as f:
+                vocab = {ln.rstrip("\n"): i for i, ln in enumerate(f)}
+        elif not isinstance(vocab, dict):
+            vocab = {t: i for i, t in enumerate(vocab)}
+        self.vocab: Dict[str, int] = dict(vocab)
+        self.do_lower_case = do_lower_case
+        self.unk_id = self.vocab.get(unk_token, 0)
+        self.cls_id = self.vocab.get(cls_token)
+        self.sep_id = self.vocab.get(sep_token)
+        self.pad_id = self.vocab.get(pad_token, 0)
+        self.max_word_len = max_word_len
+        self._native = None
+        lib = _get_lib()
+        if lib is not None:
+            vp = lib.vocab_create(self.unk_id, max_word_len)
+            for tok, i in self.vocab.items():
+                lib.vocab_add(vp, tok.encode("utf-8"), i)
+            self._native = (lib, vp)
+
+    def __del__(self):
+        if getattr(self, "_native", None) is not None:
+            lib, vp = self._native
+            try:
+                lib.vocab_free(vp)
+            except Exception:
+                pass
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.vocab)
+
+    def _encode_one(self, text: str) -> List[int]:
+        if self.do_lower_case:
+            text = text.lower()
+        if self._native is not None:
+            lib, vp = self._native
+            data = text.encode("utf-8")
+            cap = max(16, len(data) + 8)
+            while True:
+                buf = (ctypes.c_int32 * cap)()
+                n = lib.encode(vp, data, len(data), buf, cap)
+                if n <= cap:
+                    return list(buf[:n])
+                cap = int(n)
+        ids = []
+        for w in _py_split(text):
+            ids.extend(_py_wordpiece(self.vocab, w, self.unk_id,
+                                     self.max_word_len))
+        return ids
+
+    def __call__(self, text, text_pair=None, max_seq_len: int = 128,
+                 pad_to_max_seq_len: bool = True):
+        """Encode a string / list of strings (reference faster_tokenizer
+        op contract): returns dict(input_ids, token_type_ids,
+        attention_mask) as int32/int64 numpy [B, S]."""
+        texts = [text] if isinstance(text, str) else list(text)
+        pairs = None
+        if text_pair is not None:
+            pairs = [text_pair] if isinstance(text_pair, str) \
+                else list(text_pair)
+            assert len(pairs) == len(texts)
+
+        rows, types = [], []
+        for i, t in enumerate(texts):
+            a = self._encode_one(t)
+            b = self._encode_one(pairs[i]) if pairs else []
+            # [CLS] a [SEP] (b [SEP]) with truncation to max_seq_len
+            budget = max(0, max_seq_len - 2 - (1 if b else 0))
+            if b:
+                # longest-first truncation; stops when both drained
+                while len(a) + len(b) > budget and (a or b):
+                    (a if len(a) >= len(b) else b).pop()
+            else:
+                a = a[:budget]
+            ids = ([self.cls_id] if self.cls_id is not None else []) + a
+            tts = [0] * len(ids)
+            if self.sep_id is not None:
+                ids.append(self.sep_id)
+                tts.append(0)
+            if b:
+                ids += b + ([self.sep_id] if self.sep_id is not None
+                            else [])
+                tts += [1] * (len(ids) - len(tts))
+            rows.append(ids)
+            types.append(tts)
+
+        S = max_seq_len if pad_to_max_seq_len else \
+            max(len(r) for r in rows)
+        B = len(rows)
+        input_ids = np.full((B, S), self.pad_id, np.int32)
+        token_types = np.zeros((B, S), np.int32)
+        mask = np.zeros((B, S), np.int32)
+        for i, (r, tt) in enumerate(zip(rows, types)):
+            n = min(len(r), S)
+            input_ids[i, :n] = r[:n]
+            token_types[i, :n] = tt[:n]
+            mask[i, :n] = 1
+        return {"input_ids": input_ids, "token_type_ids": token_types,
+                "attention_mask": mask}

@@ -1,0 +1,116 @@
+"""FasterTokenizer — native WordPiece core + Python fallback parity
+(text/tokenizer.py, text/_native/wordpiece.cpp).
+
+Reference behaviors matched: faster_tokenizer_op.cc — basic split
+(whitespace/punct/CJK), greedy longest-match wordpiece with ## prefixes,
+[CLS]/[SEP] assembly, pair encoding with token_type_ids, padding +
+attention_mask, truncation.
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu.text import FasterTokenizer
+from paddle_tpu.text.tokenizer import (native_available, _py_split,
+                                       _py_wordpiece)
+
+VOCAB = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "the", "quick", "brown",
+         "fox", "jump", "##ed", "##s", "over", "lazy", "dog", ",", "!",
+         "un", "##want", "我", "爱", "play", "##ing"]
+
+
+@pytest.fixture
+def tok():
+    return FasterTokenizer({t: i for i, t in enumerate(VOCAB)})
+
+
+class TestWordpiece:
+    def test_greedy_longest_match(self, tok):
+        ids = tok._encode_one("jumped playing")
+        assert ids == [8, 9, 20, 21]          # jump ##ed play ##ing
+
+    def test_unknown_word_is_unk(self, tok):
+        assert tok._encode_one("zzz") == [1]
+        # partial match that dead-ends is a single UNK, not pieces
+        assert tok._encode_one("unzzz") == [1]
+
+    def test_punct_and_cjk_split(self, tok):
+        ids = tok._encode_one("dog, 我爱!")
+        assert ids == [13, 14, 18, 19, 15]
+
+    def test_lowercase(self, tok):
+        assert tok._encode_one("The QUICK") == [4, 5]
+
+    @pytest.mark.skipif(not native_available(),
+                        reason="no native tokenizer (needs g++)")
+    def test_native_matches_python_fallback(self, tok):
+        texts = ["the quick brown fox jumped over the lazy dog!",
+                 "unwanted zzz 我爱 playing,",
+                 "", "   ", "!!!", "a" * 200,
+                 # non-ASCII punct (U+2019) and the extended CJK ranges
+                 # must split identically in both implementations
+                 "don’t stop", "豈豈", "x\U00020000y"]
+        for t in texts:
+            native = tok._encode_one(t)
+            py = []
+            for w in _py_split(t.lower()):
+                py.extend(_py_wordpiece(tok.vocab, w, tok.unk_id,
+                                        tok.max_word_len))
+            assert native == py, (t, native, py)
+
+
+class TestBatchEncode:
+    def test_batch_shapes_and_mask(self, tok):
+        out = tok(["the fox", "the quick brown fox jumped"],
+                  max_seq_len=8)
+        assert out["input_ids"].shape == (2, 8)
+        assert out["attention_mask"].tolist()[0][:4] == [1, 1, 1, 1]
+        assert out["input_ids"][0][0] == 2          # [CLS]
+        assert 3 in out["input_ids"][0]             # [SEP]
+        # padding after the mask runs out
+        assert (out["input_ids"][0][out["attention_mask"][0] == 0]
+                == 0).all()
+
+    def test_truncation(self, tok):
+        out = tok("the quick brown fox jumped over the lazy dog",
+                  max_seq_len=6)
+        assert out["input_ids"].shape == (1, 6)
+        assert (out["attention_mask"][0] == 1).all()
+        assert out["input_ids"][0][-1] == 3         # [SEP] preserved
+
+    def test_tiny_max_seq_len_degenerates_gracefully(self, tok):
+        out = tok("don", text_pair="t", max_seq_len=2)
+        assert out["input_ids"].shape == (1, 2)   # no crash
+
+    def test_pair_encoding_token_types(self, tok):
+        out = tok("the fox", text_pair="lazy dog", max_seq_len=12)
+        ids = out["input_ids"][0]
+        tts = out["token_type_ids"][0]
+        # [CLS] the fox [SEP] lazy dog [SEP]
+        assert ids[:7].tolist() == [2, 4, 7, 3, 12, 13, 3]
+        assert tts[:7].tolist() == [0, 0, 0, 0, 1, 1, 1]
+
+    def test_vocab_from_file(self, tok, tmp_path):
+        p = tmp_path / "vocab.txt"
+        p.write_text("\n".join(VOCAB) + "\n", encoding="utf-8")
+        tok2 = FasterTokenizer(str(p))
+        a = tok("the quick fox")["input_ids"]
+        b = tok2("the quick fox")["input_ids"]
+        np.testing.assert_array_equal(a, b)
+
+    def test_feeds_bert_model(self, tok):
+        """End-to-end: tokenizer output drives the BERT encoder."""
+        import jax.numpy as jnp
+        from paddle_tpu.models.bert import (BertConfig, init_bert_params,
+                                            bert_encode)
+        import jax
+        cfg = BertConfig(vocab_size=len(VOCAB), hidden_size=32,
+                         num_layers=2, num_heads=4, max_seq_len=16,
+                         dtype=jnp.float32)
+        params = init_bert_params(cfg, jax.random.PRNGKey(0))
+        enc = tok(["the quick fox", "lazy dog"], max_seq_len=10)
+        seq, pooled = bert_encode(
+            params, jnp.asarray(enc["input_ids"]),
+            jnp.asarray(enc["token_type_ids"]),
+            jnp.asarray(enc["attention_mask"]), cfg=cfg)
+        assert seq.shape == (2, 10, 32)
+        assert np.isfinite(np.asarray(pooled)).all()
