@@ -15,10 +15,8 @@ is no compiler or no Linux shm semantics.
 from __future__ import annotations
 
 import ctypes
-import hashlib
 import mmap
 import os
-import subprocess
 import sys
 import threading
 
@@ -32,18 +30,8 @@ _lock = threading.Lock()
 
 
 def _build_lib():
-    with open(_SRC, "rb") as f:
-        src = f.read()
-    tag = hashlib.sha256(src).hexdigest()[:16]
-    so_path = os.path.join(_BUILD_DIR, f"libshm_ring-{tag}.so")
-    if not os.path.exists(so_path):
-        os.makedirs(_BUILD_DIR, exist_ok=True)
-        tmp = so_path + f".tmp{os.getpid()}"
-        cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-               "-o", tmp, _SRC, "-lpthread"]
-        subprocess.run(cmd, check=True, capture_output=True)
-        os.replace(tmp, so_path)  # atomic: concurrent builders race safely
-    lib = ctypes.CDLL(so_path)
+    from ..utils.native_build import build_native_lib
+    lib = build_native_lib(_SRC, "shm_ring", extra_flags=["-lpthread"])
     lib.ring_region_size.restype = ctypes.c_uint64
     lib.ring_region_size.argtypes = [ctypes.c_uint32, ctypes.c_uint64]
     lib.ring_init.restype = ctypes.c_int

@@ -83,11 +83,26 @@ def _shard_model_params(model, mesh):
 
 class HybridParallelModelWrapper:
     """distributed_model return value: applies input sharding (dp on batch)
-    and delegates; params already sharded."""
+    and delegates; params already sharded. strategy.amp autocasts the
+    forward; strategy.recompute routes it through the checkpointed
+    StaticFunction path."""
 
-    def __init__(self, model, hcg):
+    def __init__(self, model, hcg, strategy=None):
         self._layers = model
         self._hcg = hcg
+        self._amp_cfg = None
+        self._recompute = False
+        if strategy is not None and getattr(strategy, "amp", False):
+            c = getattr(strategy, "amp_configs", {}) or {}
+            self._amp_cfg = {
+                "dtype": "bfloat16" if c.get("use_bf16", True)
+                else "float16",
+                "level": "O2" if c.get("use_pure_fp16") else "O1",
+                "white": c.get("custom_white_list") or None,
+                "black": c.get("custom_black_list") or None,
+            }
+        if strategy is not None and getattr(strategy, "recompute", False):
+            self._recompute = True
 
     def __getattr__(self, name):
         return getattr(self._layers, name)
@@ -104,7 +119,21 @@ class HybridParallelModelWrapper:
                         a._value, P(batch_axes), mesh),
                         stop_gradient=a.stop_gradient)
             new_args.append(a)
-        return self._layers(*new_args, **kwargs)
+
+        def call(*ca, **ck):
+            if self._recompute:
+                from .recompute import recompute
+                return recompute(self._layers, *ca, **ck)
+            return self._layers(*ca, **ck)
+
+        if self._amp_cfg is not None:
+            from ... import amp as _amp
+            with _amp.auto_cast(enable=True, level=self._amp_cfg["level"],
+                                dtype=self._amp_cfg["dtype"],
+                                custom_white_list=self._amp_cfg["white"],
+                                custom_black_list=self._amp_cfg["black"]):
+                return call(*new_args, **kwargs)
+        return call(*new_args, **kwargs)
 
     def forward(self, *args, **kwargs):
         return self(*args, **kwargs)
@@ -133,12 +162,21 @@ class HybridParallelModelWrapper:
 
 
 def distributed_model(model):
-    """fleet.distributed_model (reference fleet/model.py:30)."""
+    """fleet.distributed_model (reference fleet/model.py:30). Honors
+    strategy.sharding stage 3 (parameter sharding), strategy.amp and
+    strategy.recompute via the wrapper."""
     if not _state.initialized:
         init()
     mesh = _state.hcg.mesh
+    strategy = _state.strategy
     _shard_model_params(model, mesh)
-    return HybridParallelModelWrapper(model, _state.hcg)
+    if strategy is not None and getattr(strategy, "sharding", False):
+        stage = int(getattr(strategy, "sharding_configs",
+                            {}).get("stage", 1))
+        if stage >= 3:
+            from ..sharding import shard_model_stage3
+            shard_model_stage3(model, mesh)
+    return HybridParallelModelWrapper(model, _state.hcg, strategy)
 
 
 class HybridParallelOptimizer:
@@ -164,8 +202,16 @@ class HybridParallelOptimizer:
             state = orig_init(p)
             sharding = getattr(p._value, "sharding", None)
             if sharding is not None:
-                state = {k: jax.device_put(v, sharding)
-                         for k, v in state.items()}
+                # don't clobber an inner placement that already decided a
+                # memory space (strategy.sharding offload puts moments in
+                # pinned_host — re-device_put here would silently pull
+                # them back to HBM)
+                state = {
+                    k: (v if getattr(getattr(v, "sharding", None),
+                                     "memory_kind", None)
+                        not in (None, "device")
+                        else jax.device_put(v, sharding))
+                    for k, v in state.items()}
             return state
         opt._init_state = sharded_init
 
@@ -183,15 +229,46 @@ class HybridParallelOptimizer:
 
 
 def distributed_optimizer(optimizer, strategy=None):
+    """Every consumed strategy toggle acts here; toggles whose mechanism
+    has no TPU analog raise instead of silently doing nothing."""
     if not _state.initialized:
         init(strategy=strategy)
     strategy = strategy or _state.strategy
-    if strategy is not None and getattr(strategy, "gradient_merge", False):
-        from ...optimizer.gradient_merge import GradientMergeOptimizer
-        cfg = getattr(strategy, "gradient_merge_configs", {})
-        optimizer = GradientMergeOptimizer(
-            optimizer, k_steps=int(cfg.get("k_steps", 1)),
-            avg=bool(cfg.get("avg", True)))
+    if strategy is not None:
+        for inert in ("dgc", "localsgd", "fp16_allreduce"):
+            if getattr(strategy, inert, False):
+                raise NotImplementedError(
+                    f"DistributedStrategy.{inert} is a CUDA/NCCL ring "
+                    "mechanism with no XLA analog: gradient compression/"
+                    "local-sgd are not applied by GSPMD collectives. "
+                    "Unset it (grad reduction is already fused and "
+                    "overlapped by the compiler).")
+        if getattr(strategy, "lamb", False):
+            from ...optimizer import Lamb
+            if not isinstance(optimizer, Lamb):
+                # carry the scheduler OBJECT and grad_clip over, not a
+                # frozen float / nothing
+                optimizer = Lamb(
+                    learning_rate=optimizer._learning_rate,
+                    lamb_weight_decay=(getattr(strategy, "lamb_configs",
+                                               None) or
+                                       {}).get("lamb_weight_decay", 0.01),
+                    grad_clip=optimizer._grad_clip,
+                    parameters=optimizer._parameter_list)
+        if getattr(strategy, "sharding", False):
+            from ..sharding import shard_optimizer_state
+            cfg = getattr(strategy, "sharding_configs", {}) or {}
+            optimizer = shard_optimizer_state(
+                optimizer, offload=bool(cfg.get("offload", False)))
+        if getattr(strategy, "gradient_merge", False):
+            from ...optimizer.gradient_merge import GradientMergeOptimizer
+            cfg = getattr(strategy, "gradient_merge_configs", {})
+            optimizer = GradientMergeOptimizer(
+                optimizer, k_steps=int(cfg.get("k_steps", 1)),
+                avg=bool(cfg.get("avg", True)))
+        if getattr(strategy, "asp", False):
+            from ...incubate import asp as _asp
+            optimizer = _asp.decorate(optimizer)
     return HybridParallelOptimizer(optimizer, _state.hcg, strategy)
 
 

@@ -65,6 +65,7 @@ void split_words(const std::string& text, std::vector<std::string>* words) {
   while (i < text.size()) {
     unsigned char c = text[i];
     int len = utf8_len(c);
+    if (i + (size_t)len > text.size()) len = 1;  // truncated multibyte
     if (len == 1 && is_ws(c)) {
       if (!cur.empty()) { words->push_back(cur); cur.clear(); }
       i += 1;

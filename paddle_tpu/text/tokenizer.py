@@ -14,9 +14,7 @@ the toolchain can't build the extension.
 from __future__ import annotations
 
 import ctypes
-import hashlib
 import os
-import subprocess
 import threading
 from typing import Dict, Iterable, List, Optional, Sequence, Union
 
@@ -39,17 +37,8 @@ def _get_lib():
         if _lib is not None or _lib_err is not None:
             return _lib
         try:
-            with open(_SRC, "rb") as f:
-                tag = hashlib.sha256(f.read()).hexdigest()[:16]
-            so = os.path.join(_BUILD_DIR, f"libwordpiece-{tag}.so")
-            if not os.path.exists(so):
-                os.makedirs(_BUILD_DIR, exist_ok=True)
-                tmp = so + f".tmp{os.getpid()}"
-                subprocess.run(
-                    ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-                     "-o", tmp, _SRC], check=True, capture_output=True)
-                os.replace(tmp, so)
-            lib = ctypes.CDLL(so)
+            from ..utils.native_build import build_native_lib
+            lib = build_native_lib(_SRC, "wordpiece")
             lib.vocab_create.restype = ctypes.c_void_p
             lib.vocab_create.argtypes = [ctypes.c_int32, ctypes.c_int32]
             lib.vocab_add.restype = None

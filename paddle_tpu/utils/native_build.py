@@ -1,0 +1,34 @@
+"""Shared build-and-load for the native C++ extensions.
+
+One implementation of the hash-tagged g++ build (used by io/shm_ring.py
+and text/tokenizer.py): compile `src_path` into a .so cached by source
+hash next to the source (_build/ dir), atomically (tmp + os.replace, so
+concurrent builders race safely), and load it with ctypes. The caller
+declares argtypes/restypes on the returned CDLL.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+from typing import Optional, Sequence
+
+
+def build_native_lib(src_path: str, lib_name: str,
+                     extra_flags: Sequence[str] = ()) -> ctypes.CDLL:
+    """Compile + load `src_path`. Raises on any failure (no compiler,
+    compile error) — callers catch and fall back."""
+    with open(src_path, "rb") as f:
+        tag = hashlib.sha256(f.read()).hexdigest()[:16]
+    build_dir = os.path.join(os.path.dirname(src_path), "_build")
+    so_path = os.path.join(build_dir, f"lib{lib_name}-{tag}.so")
+    if not os.path.exists(so_path):
+        os.makedirs(build_dir, exist_ok=True)
+        tmp = so_path + f".tmp{os.getpid()}"
+        cxx = os.environ.get("CXX", "g++")
+        cmd = [cxx, "-O2", "-shared", "-fPIC", "-std=c++17",
+               "-o", tmp, src_path, *extra_flags]
+        subprocess.run(cmd, check=True, capture_output=True)
+        os.replace(tmp, so_path)
+    return ctypes.CDLL(so_path)
