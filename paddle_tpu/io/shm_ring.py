@@ -22,7 +22,6 @@ import threading
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "_native", "shm_ring.cpp")
-_BUILD_DIR = os.path.join(_HERE, "_native", "_build")
 
 _lib = None
 _lib_err = None

@@ -1,6 +1,6 @@
 """paddle_tpu.optimizer (reference: python/paddle/optimizer/)."""
 from .optimizer import Optimizer  # noqa: F401
 from .optimizers import (  # noqa: F401
-    SGD, Momentum, Adagrad, RMSProp, Adadelta, Adam, AdamW, Adamax, Lamb)
+    SGD, Momentum, Adagrad, RMSProp, Adadelta, Adam, AdamW, Adamax, Lamb, Lars)
 from . import lr  # noqa: F401
 from .gradient_merge import GradientMergeOptimizer, merge_grads  # noqa: F401

@@ -226,3 +226,33 @@ class Lamb(Optimizer):
         r_norm = jnp.linalg.norm(r)
         trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
         return pf - lr * trust * r, {"moment1": m1, "moment2": m2}
+
+
+class Lars(Optimizer):
+    """LARS momentum (reference lars_momentum op,
+    phi/kernels/gpu/lars_momentum_kernel.cu + fleet's strategy.lars
+    meta-optimizer): layer-wise trust ratio scales the learning rate by
+    ||w|| / (||g|| + decay·||w||) before a momentum update."""
+    _state_keys = ["velocity"]
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 lars_coeff=0.001, lars_weight_decay=0.0005,
+                 parameters=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name,
+                         multi_precision)
+        self._momentum = float(momentum)
+        self._lars_coeff = float(lars_coeff)
+        self._lars_decay = float(lars_weight_decay)
+
+    def _update(self, p, g, state, lr, step):
+        pf = p.astype(jnp.float32)
+        w_norm = jnp.linalg.norm(pf)
+        g_norm = jnp.linalg.norm(g)
+        denom = g_norm + self._lars_decay * w_norm
+        local_lr = jnp.where(
+            (w_norm > 0) & (denom > 0),
+            lr * self._lars_coeff * w_norm / denom, lr)
+        v = self._momentum * state["velocity"] + \
+            local_lr * (g + self._lars_decay * pf)
+        return pf - v, {"velocity": v}

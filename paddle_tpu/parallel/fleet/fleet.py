@@ -38,12 +38,22 @@ _state = _FleetState()
 
 
 def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
-    """fleet.init (reference fleet.py:167)."""
+    """fleet.init (reference fleet.py:167). When the hybrid degrees don't
+    account for every device, dp absorbs the remainder (the reference's
+    topology does the same: dp = world // (mp·pp·sharding·sep))."""
     init_parallel_env()
     strategy = strategy or DistributedStrategy()
     hc = strategy.hybrid_configs
+    dp = int(hc.get("dp_degree", 1))
+    others = (int(hc.get("mp_degree", 1)) * int(hc.get("pp_degree", 1)) *
+              int(hc.get("sharding_degree", 1)) *
+              int(hc.get("sep_degree", 1)))
+    import jax
+    world = jax.device_count()
+    if dp * others < world and world % others == 0:
+        dp = world // others
     hcg = HybridCommunicateGroup(
-        dp_degree=hc.get("dp_degree", 1),
+        dp_degree=dp,
         mp_degree=hc.get("mp_degree", 1),
         pp_degree=hc.get("pp_degree", 1),
         sharding_degree=hc.get("sharding_degree", 1),
@@ -63,17 +73,22 @@ def get_hybrid_communicate_group_():
     return _state.hcg
 
 
-def _shard_model_params(model, mesh):
+def _shard_model_params(model, mesh, zero3=False):
     """Place every parameter according to its sharding_spec (TP layers set
-    one); default spec: replicated over dp/mp, FSDP-sharded along 'fsdp' on
-    the largest axis when the mesh has one (ZeRO-3 semantics)."""
+    one); default spec: replicated over dp/mp, FSDP-sharded along 'fsdp'
+    when the mesh has one. zero3 (strategy.sharding stage 3) lowers the
+    size threshold to the group-sharded module's (>=1024), sharding
+    everything shardable — TP specs always win over the default."""
     has_fsdp = "fsdp" in mesh.axis_names
+    threshold = 1024 if zero3 else 4096
     for p in model.parameters():
         spec = p.sharding_spec
         if spec is None:
             if has_fsdp and p.ndim >= 1 and \
-                    p.shape[0] % mesh.shape["fsdp"] == 0 and p.size > 4096:
+                    p.shape[0] % mesh.shape["fsdp"] == 0 and \
+                    p.size >= threshold:
                 spec = P("fsdp")
+                p.sharding_spec = spec
             else:
                 spec = P()
         p._value = shard_value(p._value, spec, mesh)
@@ -169,13 +184,13 @@ def distributed_model(model):
         init()
     mesh = _state.hcg.mesh
     strategy = _state.strategy
-    _shard_model_params(model, mesh)
+    stage = 0
     if strategy is not None and getattr(strategy, "sharding", False):
         stage = int(getattr(strategy, "sharding_configs",
                             {}).get("stage", 1))
-        if stage >= 3:
-            from ..sharding import shard_model_stage3
-            shard_model_stage3(model, mesh)
+    # one placement mechanism: TP specs always win; stage 3 widens the
+    # fsdp default to everything shardable
+    _shard_model_params(model, mesh, zero3=stage >= 3)
     return HybridParallelModelWrapper(model, _state.hcg, strategy)
 
 
@@ -196,6 +211,11 @@ class HybridParallelOptimizer:
         # unwrap GradientMergeOptimizer etc.: the hook must land on the
         # object whose _init_state actually runs
         opt = getattr(self._inner_opt, "inner_opt", self._inner_opt)
+        if getattr(opt._init_state, "_zero_sharded", False):
+            # strategy.sharding already installed a deliberate placement
+            # (ZeRO specs, possibly host-offloaded) — re-placing onto the
+            # param's sharding would silently undo it
+            return
         orig_init = opt._init_state
 
         def sharded_init(p):
@@ -233,6 +253,11 @@ def distributed_optimizer(optimizer, strategy=None):
     has no TPU analog raise instead of silently doing nothing."""
     if not _state.initialized:
         init(strategy=strategy)
+    if strategy is not None:
+        # the reference treats the strategy handed to
+        # distributed_optimizer as THE user strategy — distributed_model
+        # called later must see the same toggles
+        _state.strategy = strategy
     strategy = strategy or _state.strategy
     if strategy is not None:
         for inert in ("dgc", "localsgd", "fp16_allreduce"):
@@ -243,6 +268,18 @@ def distributed_optimizer(optimizer, strategy=None):
                     "local-sgd are not applied by GSPMD collectives. "
                     "Unset it (grad reduction is already fused and "
                     "overlapped by the compiler).")
+        if getattr(strategy, "lars", False):
+            from ...optimizer import Lars
+            if not isinstance(optimizer, Lars):
+                cfg = getattr(strategy, "lars_configs", None) or {}
+                optimizer = Lars(
+                    learning_rate=optimizer._learning_rate,
+                    momentum=getattr(optimizer, "_momentum", 0.9),
+                    lars_coeff=cfg.get("lars_coeff", 0.001),
+                    lars_weight_decay=cfg.get("lars_weight_decay",
+                                              0.0005),
+                    grad_clip=optimizer._grad_clip,
+                    parameters=optimizer._parameter_list)
         if getattr(strategy, "lamb", False):
             from ...optimizer import Lamb
             if not isinstance(optimizer, Lamb):

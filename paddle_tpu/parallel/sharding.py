@@ -102,6 +102,9 @@ def shard_optimizer_state(optimizer, mesh=None, offload=False):
                 jax.devices()[0], memory_kind=mem_kind)
             return {k: jax.device_put(v, dst) for k, v in state.items()}
         return {k: shard_value(v, spec, mesh) for k, v in state.items()}
+    # marker for outer wrappers (fleet's HybridParallelOptimizer): this
+    # init already placed the state deliberately — don't re-place it
+    sharded_init._zero_sharded = True
     optimizer._init_state = sharded_init
 
     if mem_kind is not None:

@@ -22,7 +22,6 @@ import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "_native", "wordpiece.cpp")
-_BUILD_DIR = os.path.join(_HERE, "_native", "_build")
 
 _lib = None
 _lib_err = None

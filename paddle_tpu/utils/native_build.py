@@ -20,7 +20,12 @@ def build_native_lib(src_path: str, lib_name: str,
     """Compile + load `src_path`. Raises on any failure (no compiler,
     compile error) — callers catch and fall back."""
     with open(src_path, "rb") as f:
-        tag = hashlib.sha256(f.read()).hexdigest()[:16]
+        h = hashlib.sha256(f.read())
+    # the flags and compiler are part of the binary's identity: a flag
+    # change must not reuse a stale .so built without it
+    h.update(repr(tuple(extra_flags)).encode())
+    h.update(os.environ.get("CXX", "g++").encode())
+    tag = h.hexdigest()[:16]
     build_dir = os.path.join(os.path.dirname(src_path), "_build")
     so_path = os.path.join(build_dir, f"lib{lib_name}-{tag}.so")
     if not os.path.exists(so_path):

@@ -57,7 +57,12 @@ class TestStrategyToggles:
         s = _strategy(sharding=True)
         s.sharding_configs = {"stage": 1}
         fleet.init(is_collective=True, strategy=s)
-        net = _net()
+        paddle.seed(0)
+        # params must clear the shardable threshold (>=1024 elems, dim0
+        # divisible by the mesh axis) for ZeRO specs to apply
+        net = nn.Sequential(nn.Linear(16, 64), nn.ReLU(),
+                            nn.Linear(64, 256), nn.ReLU(),
+                            nn.Linear(256, 4))
         model = fleet.distributed_model(net)
         opt = fleet.distributed_optimizer(
             paddle.optimizer.Adam(learning_rate=1e-3,
@@ -71,6 +76,47 @@ class TestStrategyToggles:
         opt.step()
         opt.clear_grad()
         assert np.isfinite(float(loss.numpy()))
+        # the toggle must ACT: moments carry a distributed spec (the
+        # hybrid wrapper must not re-place them onto the param sharding)
+        inner = opt._inner_opt
+        sharded = [
+            st for st in inner._state.values()
+            if any(getattr(v.sharding, "spec", None) and
+                   v.sharding.spec[0] is not None
+                   for v in st.values())]
+        assert sharded, "ZeRO stage-1 moments must be sharded"
+
+    def test_lars_swaps_optimizer(self):
+        fleet.init(is_collective=True, strategy=_strategy(lars=True))
+        from paddle_tpu.optimizer import Lars
+        net = _net()
+        opt = fleet.distributed_optimizer(
+            paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                      parameters=net.parameters()))
+        assert isinstance(opt._inner_opt, Lars)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(8, 16).astype(np.float32))
+        y = paddle.to_tensor(
+            np.random.RandomState(1).randint(0, 4, 8).astype(np.int64))
+        loss = nn.CrossEntropyLoss()(net(x), y)
+        loss.backward()
+        opt.step()
+        assert np.isfinite(float(loss.numpy()))
+
+    def test_strategy_via_distributed_optimizer_reaches_model(self):
+        """Reference usage order: init() plain, pass the strategy to
+        distributed_optimizer, THEN distributed_model — the model must
+        still see the toggles."""
+        fleet.init(is_collective=True)
+        net = _net()
+        fleet.distributed_optimizer(
+            paddle.optimizer.Adam(learning_rate=1e-3,
+                                  parameters=net.parameters()),
+            strategy=_strategy(amp=True))
+        model = fleet.distributed_model(net)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 16).astype(np.float32))
+        assert str(model(x).dtype) in ("bfloat16", "uint16")
 
     def test_asp_preserves_sparsity_through_fleet(self):
         from paddle_tpu.incubate import asp

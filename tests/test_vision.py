@@ -153,3 +153,42 @@ class TestVisionOps:
         iou = ops.box_iou(a, b).numpy()
         np.testing.assert_allclose(iou[0, 0], 25 / 175, rtol=1e-5)
         assert iou[0, 1] == 0
+
+
+class TestNewModelFamilies:
+    """MobileNetV2 / VGG / AlexNet (reference vision/models families)."""
+
+    def test_mobilenet_v2_forward_and_grads(self):
+        from paddle_tpu.vision.models import mobilenet_v2
+        paddle.seed(0)
+        m = mobilenet_v2(num_classes=7)
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(2, 3, 64, 64).astype(np.float32))
+        out = m(x)
+        assert list(out.shape) == [2, 7]
+        out.sum().backward()
+        assert all(p.grad is not None for p in m.parameters()
+                   if p.trainable)
+
+    def test_mobilenet_width_multiplier(self):
+        from paddle_tpu.vision.models import MobileNetV2
+        m = MobileNetV2(scale=0.5, num_classes=5)
+        x = paddle.to_tensor(np.zeros((1, 3, 64, 64), np.float32))
+        assert list(m(x).shape) == [1, 5]
+
+    def test_vgg_variants(self):
+        from paddle_tpu.vision.models import vgg11, vgg16
+        x = paddle.to_tensor(np.zeros((1, 3, 224, 224), np.float32))
+        assert list(vgg11(num_classes=4)(x).shape) == [1, 4]
+        v = vgg16(batch_norm=True, num_classes=3)
+        assert list(v(x).shape) == [1, 3]
+
+    def test_alexnet(self):
+        from paddle_tpu.vision.models import alexnet
+        x = paddle.to_tensor(np.zeros((1, 3, 224, 224), np.float32))
+        assert list(alexnet(num_classes=4)(x).shape) == [1, 4]
+
+    def test_pretrained_raises_honestly(self):
+        from paddle_tpu.vision.models import mobilenet_v2
+        with pytest.raises(NotImplementedError, match="state_dict"):
+            mobilenet_v2(pretrained=True)
