@@ -50,7 +50,9 @@ def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
               int(hc.get("sep_degree", 1)))
     import jax
     world = jax.device_count()
-    if dp * others < world and world % others == 0:
+    dp_explicit = "dp_degree" in getattr(strategy, "_user_hybrid_keys",
+                                         ())
+    if not dp_explicit and dp * others < world and world % others == 0:
         dp = world // others
     hcg = HybridCommunicateGroup(
         dp_degree=dp,
@@ -75,19 +77,29 @@ def get_hybrid_communicate_group_():
 
 def _shard_model_params(model, mesh, zero3=False):
     """Place every parameter according to its sharding_spec (TP layers set
-    one); default spec: replicated over dp/mp, FSDP-sharded along 'fsdp'
-    when the mesh has one. zero3 (strategy.sharding stage 3) lowers the
-    size threshold to the group-sharded module's (>=1024), sharding
-    everything shardable — TP specs always win over the default."""
-    has_fsdp = "fsdp" in mesh.axis_names
+    one); default spec: replicated over dp/mp, FSDP-sharded along the
+    ZeRO axis when the mesh has one. zero3 (strategy.sharding stage 3)
+    lowers the size threshold to the group-sharded module's (>=1024),
+    sharding everything shardable — TP specs always win."""
+    from ..sharding import _fsdp_axis
+    if zero3:
+        # ZeRO-3 axis: 'fsdp' when the topology has one, else fall back
+        # to 'dp' (users set the stage without a sharding_degree all the
+        # time; the dp replicas then host the shards — reference
+        # DygraphShardingOptimizer). Without stage 3, plain DP keeps
+        # params replicated and only an explicit fsdp axis shards.
+        ax = _fsdp_axis(mesh)
+    else:
+        ax = "fsdp" if ("fsdp" in mesh.axis_names and
+                        mesh.shape["fsdp"] > 1) else None
     threshold = 1024 if zero3 else 4096
     for p in model.parameters():
         spec = p.sharding_spec
         if spec is None:
-            if has_fsdp and p.ndim >= 1 and \
-                    p.shape[0] % mesh.shape["fsdp"] == 0 and \
+            if ax is not None and p.ndim >= 1 and \
+                    p.shape[0] % mesh.shape[ax] == 0 and \
                     p.size >= threshold:
-                spec = P("fsdp")
+                spec = P(ax)
                 p.sharding_spec = spec
             else:
                 spec = P()
@@ -269,17 +281,28 @@ def distributed_optimizer(optimizer, strategy=None):
                     "Unset it (grad reduction is already fused and "
                     "overlapped by the compiler).")
         if getattr(strategy, "lars", False):
-            from ...optimizer import Lars
-            if not isinstance(optimizer, Lars):
+            from ...optimizer import Lars, Momentum
+            if isinstance(optimizer, Momentum):
                 cfg = getattr(strategy, "lars_configs", None) or {}
                 optimizer = Lars(
                     learning_rate=optimizer._learning_rate,
                     momentum=getattr(optimizer, "_momentum", 0.9),
                     lars_coeff=cfg.get("lars_coeff", 0.001),
-                    lars_weight_decay=cfg.get("lars_weight_decay",
-                                              0.0005),
+                    lars_weight_decay=cfg.get(
+                        "lars_weight_decay",
+                        optimizer._weight_decay_coeff or 0.0005),
                     grad_clip=optimizer._grad_clip,
                     parameters=optimizer._parameter_list)
+            elif not isinstance(optimizer, Lars):
+                # reference LarsOptimizer meta-opt applies to Momentum
+                # only; replacing Adam et al. would change the training
+                # math behind the user's back
+                import warnings
+                warnings.warn(
+                    f"strategy.lars applies to Momentum optimizers only "
+                    f"(reference LarsOptimizer); "
+                    f"{type(optimizer).__name__} left unchanged",
+                    RuntimeWarning)
         if getattr(strategy, "lamb", False):
             from ...optimizer import Lamb
             if not isinstance(optimizer, Lamb):

@@ -17,11 +17,12 @@ class HybridConfig(dict):
 
 class DistributedStrategy:
     def __init__(self):
-        self.hybrid_configs = {
+        self._hybrid_configs = {
             "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
             "sharding_degree": 1, "sep_degree": 1,
             "order": ["dp", "pp", "sharding", "sep", "mp"],
         }
+        self._user_hybrid_keys = set()
         self.amp = False
         self.amp_configs = {
             "init_loss_scaling": 32768.0, "use_dynamic_loss_scaling": True,
@@ -58,8 +59,21 @@ class DistributedStrategy:
         self.tensor_parallel_configs = {}
         self.without_graph_optimization = True
 
+    @property
+    def hybrid_configs(self):
+        return self._hybrid_configs
+
+    @hybrid_configs.setter
+    def hybrid_configs(self, cfg):
+        """MERGE into the defaults (a partial dict keeps the rest), and
+        remember which keys the user set explicitly — fleet.init only
+        auto-fills dp when dp_degree was NOT explicit."""
+        self._user_hybrid_keys.update(cfg)
+        self._hybrid_configs.update(cfg)
+
     def _set_hybrid(self, **kwargs):
-        self.hybrid_configs.update(kwargs)
+        self._user_hybrid_keys.update(kwargs)
+        self._hybrid_configs.update(kwargs)
 
     @property
     def hybrid_parallel_order(self):

@@ -47,10 +47,49 @@ def _rewrap(treedef, arrays):
         treedef, [Tensor(a, stop_gradient=True) for a in arrays])
 
 
+def _in_static_program(*vals) -> bool:
+    from ..program import Variable, in_static_graph_mode
+    return in_static_graph_mode() and any(
+        isinstance(v, Variable) for v in vals)
+
+
 def cond(pred, true_fn: Callable = None, false_fn: Callable = None,
          name=None, return_names=None):
     """Run `true_fn()` if pred else `false_fn()` (reference
-    control_flow.py:cond — branch fns are closures taking no arguments)."""
+    control_flow.py:cond — branch fns are closures taking no arguments).
+
+    Static-graph (Program recording) mode: BOTH branches record their
+    ops and a select joins them — all ops here are pure, so
+    compute-both-then-select is semantically exact (the reference's
+    select_input after two conditional_blocks), and branch closures over
+    Variables record naturally."""
+    if _in_static_program(pred):
+        t_out = true_fn() if true_fn is not None else None
+        f_out = false_fn() if false_fn is not None else None
+        if t_out is None and f_out is None:
+            return None
+        if t_out is None or f_out is None:
+            raise ValueError(
+                "static-mode cond needs BOTH branches when a value is "
+                "returned (a missing branch has no value to select when "
+                "pred goes the other way — the reference requires "
+                "symmetric outputs too)")
+        import paddle_tpu as paddle
+        import jax.tree_util as jtu
+        t_l, t_def = _flatten(t_out)
+        f_l, f_def = _flatten(f_out)
+        if str(t_def) != str(f_def):
+            raise ValueError(
+                f"cond branches returned different structures: "
+                f"{t_def} vs {f_def}")
+        for a, b in zip(t_l, f_l):
+            if tuple(a.shape) != tuple(b.shape):
+                raise ValueError(
+                    f"cond branches returned different shapes: "
+                    f"{a.shape} vs {b.shape} (select cannot broadcast "
+                    "them; the traced lax.cond path rejects this too)")
+        sel = [paddle.where(pred, a, b) for a, b in zip(t_l, f_l)]
+        return jtu.tree_unflatten(t_def, sel)
     pv = raw_value(pred)
     if not _is_tracer(pv):
         # eager: execute only the taken branch; tape records it
@@ -91,8 +130,41 @@ def cond(pred, true_fn: Callable = None, false_fn: Callable = None,
 def while_loop(cond_fn: Callable, body_fn: Callable,
                loop_vars: Sequence[Any], is_test=False, name=None):
     """Repeat `body_fn(*vars)` while `cond_fn(*vars)` (reference
-    control_flow.py:while_loop)."""
+    control_flow.py:while_loop).
+
+    Static-graph mode: records ONE deferred node whose replay runs the
+    traced lax.while_loop — cond_fn/body_fn receive the loop vars as
+    arguments, so they resolve at replay; values they CLOSE over must be
+    constants (a closed-over Variable has no replay binding)."""
     loop_vars = list(loop_vars)
+    lv_leaves, lv_def = _flatten(loop_vars)
+    if _in_static_program(*lv_leaves):
+        from ...framework.dispatch import apply
+
+        def loop_op(*arrs):
+            def wrap(xs):
+                return jax.tree_util.tree_unflatten(
+                    lv_def, [Tensor(x, stop_gradient=True) for x in xs])
+
+            def body(xs):
+                out = body_fn(*wrap(xs))
+                out = list(out) if isinstance(out, (list, tuple)) \
+                    else [out]
+                out_leaves, out_def = _flatten(out)
+                if str(out_def) != str(lv_def):
+                    raise ValueError(
+                        f"while_loop body returned structure {out_def}, "
+                        f"expected {lv_def}")
+                return _to_arrays(out_leaves)
+
+            leaves = jax.lax.while_loop(
+                lambda xs: jnp.asarray(
+                    raw_value(cond_fn(*wrap(xs)))).reshape(()),
+                body, [jnp.asarray(a) for a in arrs])
+            return tuple(leaves)
+        out = apply("while_loop", loop_op, *lv_leaves)
+        out = out if isinstance(out, list) else [out]
+        return jax.tree_util.tree_unflatten(lv_def, out)
     probe = raw_value(cond_fn(*loop_vars))
     if not _is_tracer(probe) and not any(
             _is_tracer(raw_value(v)) for v in loop_vars):
@@ -132,6 +204,11 @@ def while_loop(cond_fn: Callable, body_fn: Callable,
 def case(pred_fn_pairs, default=None, name=None):
     """First-match multi-branch (reference control_flow.py:case)."""
     pairs = list(pred_fn_pairs)
+    if default is None and pairs and _in_static_program(
+            *[p for p, _ in pairs]):
+        raise ValueError(
+            "static-mode case requires a default branch (the select "
+            "chain needs a value when no predicate matches)")
 
     def build(i):
         if i >= len(pairs):
@@ -147,6 +224,19 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
         items = sorted(branch_fns.items())
     else:
         items = list(enumerate(branch_fns))
+    if _in_static_program(branch_index):
+        if default is None:
+            raise ValueError(
+                "static-mode switch_case requires a default branch (the "
+                "select chain needs a value when no index matches)")
+
+        def build_static(pos):
+            if pos >= len(items):
+                return default() if default is not None else None
+            k, fn = items[pos]
+            return cond(branch_index == k, fn,
+                        lambda: build_static(pos + 1))
+        return build_static(0)
     iv = raw_value(branch_index)
     if not _is_tracer(iv):
         idx = int(jnp.asarray(iv))

@@ -308,7 +308,10 @@ def record_apply(op_name: str, fn: Callable, args, static: dict,
     def shaped(*var_avals):
         it = iter(var_avals)
         full = [next(it) if isinstance(p, _Ref) else p.v for p in arg_plan]
-        return fn(*full, **static)
+        # composite fns (control-flow bodies) may call Tensor-level ops:
+        # those must EXECUTE on the tracers here, not re-record
+        with _replay_guard():
+            return fn(*full, **static)
 
     out_avals = jax.eval_shape(shaped, *avals)
     multi = isinstance(out_avals, (tuple, list))

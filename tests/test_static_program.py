@@ -378,6 +378,59 @@ class TestSaveInference:
         assert tuple(out.shape) == (3, 1)
 
 
+class TestStaticControlFlow:
+    def test_cond_records_and_selects(self, static_mode):
+        main, startup = static_mode
+        x = static.data("x", [4], "float32")
+        out = static.nn.cond(x.sum() > 0, lambda: x * 2.0,
+                             lambda: x - 1.0)
+        exe = static.Executor()
+        _init(exe, main, startup)
+        pos, = exe.run(main, feed={"x": np.ones(4, np.float32)},
+                       fetch_list=[out])
+        neg, = exe.run(main, feed={"x": -np.ones(4, np.float32)},
+                       fetch_list=[out])
+        np.testing.assert_allclose(pos, 2.0)
+        np.testing.assert_allclose(neg, -2.0)
+
+    def test_cond_structure_mismatch_raises(self, static_mode):
+        main, _ = static_mode
+        x = static.data("x", [4], "float32")
+        with pytest.raises(ValueError, match="different structures"):
+            static.nn.cond(x.sum() > 0, lambda: (x, x), lambda: x)
+
+    def test_while_loop_records_one_node(self, static_mode):
+        main, startup = static_mode
+        x = static.data("x", [4], "float32")
+        n0 = paddle.to_tensor(np.int32(0))
+        n, s = static.nn.while_loop(
+            lambda n, s: n < 5,
+            lambda n, s: [n + 1, s + n.astype("float32")],
+            [n0, x.sum() * 0.0])
+        assert any(op.type == "while_loop"
+                   for op in main.global_block().ops)
+        exe = static.Executor()
+        _init(exe, main, startup)
+        nv, sv = exe.run(main, feed={"x": np.zeros(4, np.float32)},
+                         fetch_list=[n, s])
+        assert int(nv) == 5 and float(sv) == 10.0
+
+    def test_switch_case_static(self, static_mode):
+        main, startup = static_mode
+        x = static.data("x", [2], "float32")
+        i = static.data("i", [], "int32")
+        sw = static.nn.switch_case(
+            i, {0: lambda: x + 10.0, 1: lambda: x + 20.0},
+            default=lambda: x)
+        exe = static.Executor()
+        _init(exe, main, startup)
+        for iv, want in [(0, 11.0), (1, 21.0), (7, 1.0)]:
+            got, = exe.run(main, feed={"x": np.ones(2, np.float32),
+                                       "i": np.int32(iv)},
+                           fetch_list=[sw])
+            np.testing.assert_allclose(got, want)
+
+
 class TestPir:
     def test_translate_to_pir(self, static_mode):
         main, _ = static_mode
