@@ -37,7 +37,8 @@ _LAZY_SUBMODULES = (
     "distributed", "parallel", "distribution", "vision", "audio", "text",
     "metric", "static", "inference", "profiler", "incubate", "sparse",
     "onnx", "hapi", "callbacks", "fft", "signal", "quantization", "utils",
-    "regularizer", "sysconfig", "geometric",
+    "regularizer", "sysconfig", "geometric", "hub", "cost_model", "pir",
+    "models", "kernels",
 )
 
 

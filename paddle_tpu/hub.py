@@ -1,0 +1,82 @@
+"""paddle_tpu.hub — load models from a local hubconf.
+
+Reference analog: python/paddle/hub.py (hub.list/help/load over a
+github/gitee/local "repo" exposing entrypoints in hubconf.py). The
+network sources required downloads; this environment has zero egress, so
+the LOCAL source (a directory containing hubconf.py) is fully supported
+and the remote sources raise an explanatory error.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+from typing import List
+
+_NO_NET = ("hub source {src!r} needs network access (github/gitee "
+           "download); this build supports source='local' — point "
+           "repo_dir at a directory containing hubconf.py")
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, "hubconf.py")
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no hubconf.py under {repo_dir!r}")
+    # deterministic module name registered in sys.modules: classes
+    # defined in hubconf.py must be picklable (paddle.save of a loaded
+    # model resolves __module__ through sys.modules)
+    import hashlib
+    tag = hashlib.sha256(os.path.abspath(repo_dir).encode()) \
+        .hexdigest()[:12]
+    mod_name = f"paddle_tpu_hubconf_{tag}"
+    if mod_name in sys.modules:
+        return sys.modules[mod_name]
+    spec = importlib.util.spec_from_file_location(mod_name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[mod_name] = mod
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(mod)
+    except BaseException:
+        sys.modules.pop(mod_name, None)
+        raise
+    finally:
+        sys.path.remove(repo_dir)
+    return mod
+
+
+def _entrypoints(mod) -> List[str]:
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def list(repo_dir: str, source: str = "local", force_reload: bool = False):
+    """Entrypoint names exposed by the repo's hubconf (reference
+    hub.list)."""
+    if source != "local":
+        raise NotImplementedError(_NO_NET.format(src=source))
+    return _entrypoints(_load_hubconf(repo_dir))
+
+
+def help(repo_dir: str, model: str, source: str = "local",
+         force_reload: bool = False):
+    """The entrypoint's docstring (reference hub.help)."""
+    if source != "local":
+        raise NotImplementedError(_NO_NET.format(src=source))
+    mod = _load_hubconf(repo_dir)
+    if not hasattr(mod, model):
+        raise ValueError(f"no entrypoint {model!r}; available: "
+                         f"{_entrypoints(mod)}")
+    return getattr(mod, model).__doc__
+
+
+def load(repo_dir: str, model: str, source: str = "local",
+         force_reload: bool = False, **kwargs):
+    """Instantiate an entrypoint (reference hub.load)."""
+    if source != "local":
+        raise NotImplementedError(_NO_NET.format(src=source))
+    mod = _load_hubconf(repo_dir)
+    if not hasattr(mod, model):
+        raise ValueError(f"no entrypoint {model!r}; available: "
+                         f"{_entrypoints(mod)}")
+    return getattr(mod, model)(**kwargs)

@@ -24,3 +24,6 @@ def graph_send_recv(x, src_index, dst_index, pool_type="sum",
     from ..geometric import send_u_recv
     return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
                        out_size=out_size)
+
+
+from . import multiprocessing  # noqa: E402,F401

@@ -1,0 +1,22 @@
+"""paddle_tpu.incubate.multiprocessing — shared-memory tensor transport.
+
+Reference analog: python/paddle/incubate/multiprocessing (CUDA-IPC /
+shared-memory tensor pickling for DataLoader workers,
+reductions.py). Here the shared-memory transport is the native SPSC ring
+the DataLoader already uses (io/_native/shm_ring.cpp) — exposed for
+direct use by custom worker topologies. A real module (not just an
+attribute) so `import paddle_tpu.incubate.multiprocessing` works like
+the reference idiom.
+"""
+from __future__ import annotations
+
+
+def shm_ring(n_slots: int = 4, slot_bytes: int = 1 << 22):
+    """A fresh SPSC shared-memory ring (create BEFORE fork)."""
+    from ..io.shm_ring import ShmRing
+    return ShmRing(n_slots=n_slots, slot_bytes=slot_bytes)
+
+
+def available() -> bool:
+    from ..io.shm_ring import available as _a
+    return _a()

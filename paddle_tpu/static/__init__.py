@@ -23,6 +23,9 @@ from .program import (Program, Variable, Executor, program_guard,  # noqa
                       in_static_graph_mode)
 from . import nn  # noqa: F401
 from . import amp  # noqa: F401
+# reference static.quantization: the PTQ/QAT machinery is mode-agnostic
+# here (observers/fake-quant trace into whatever graph records them)
+from .. import quantization  # noqa: F401
 
 
 def cpu_places(device_count=1):

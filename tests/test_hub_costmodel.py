@@ -1,0 +1,80 @@
+"""paddle.hub (local source), cost_model, incubate.multiprocessing,
+static.quantization alias.
+
+Reference behaviors matched: hub.list/help/load over a hubconf.py
+(python/paddle/hub.py, local source), CostModel.profile_measure
+(python/paddle/cost_model/cost_model.py) via XLA's cost analysis,
+incubate.multiprocessing shared-memory transport.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+HUBCONF = '''
+def tiny_mlp(hidden=8):
+    """A tiny MLP entrypoint."""
+    import paddle_tpu.nn as nn
+    return nn.Sequential(nn.Linear(4, hidden), nn.ReLU(),
+                         nn.Linear(hidden, 2))
+'''
+
+
+class TestHub:
+    def test_list_help_load(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(HUBCONF)
+        d = str(tmp_path)
+        assert "tiny_mlp" in paddle.hub.list(d)
+        assert "tiny MLP" in paddle.hub.help(d, "tiny_mlp")
+        net = paddle.hub.load(d, "tiny_mlp", hidden=16)
+        x = paddle.to_tensor(np.zeros((2, 4), np.float32))
+        assert list(net(x).shape) == [2, 2]
+
+    def test_remote_sources_raise(self):
+        with pytest.raises(NotImplementedError, match="local"):
+            paddle.hub.load("user/repo", "m", source="github")
+
+    def test_unknown_entrypoint_lists_available(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(HUBCONF)
+        with pytest.raises(ValueError, match="tiny_mlp"):
+            paddle.hub.load(str(tmp_path), "nope")
+
+
+class TestCostModel:
+    def test_profile_measure_static_program(self):
+        import paddle_tpu.static as static
+        paddle.enable_static()
+        try:
+            main, startup = static.Program(), static.Program()
+            with static.program_guard(main, startup):
+                x = static.data("x", [-1, 8], "float32")
+                static.nn.fc(x, 4)
+            cost = paddle.cost_model.CostModel().profile_measure(main)
+            # fc at batch 8: 2*8*8*4 matmul + 8*4 bias adds = 544
+            assert cost["flops"] == 544.0
+        finally:
+            paddle.disable_static()
+
+    def test_estimate_cost_functional(self):
+        import jax.numpy as jnp
+        c = paddle.cost_model.estimate_cost(
+            lambda a: a @ a, jnp.ones((16, 16), jnp.float32))
+        assert c["flops"] == 2 * 16 * 16 * 16
+
+
+class TestAliases:
+    def test_incubate_multiprocessing_ring(self):
+        from paddle_tpu.incubate import multiprocessing as mp
+        if not mp.available():
+            pytest.skip("native ring unavailable")
+        r = mp.shm_ring(n_slots=2, slot_bytes=64)
+        r.put(b"payload")
+        assert r.get(timeout=2) == b"payload"
+
+    def test_static_quantization_alias(self):
+        import paddle_tpu.static as static
+        assert hasattr(static.quantization, "PTQ")
+        assert hasattr(static.quantization, "QAT")
