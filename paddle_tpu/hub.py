@@ -18,7 +18,7 @@ _NO_NET = ("hub source {src!r} needs network access (github/gitee "
            "repo_dir at a directory containing hubconf.py")
 
 
-def _load_hubconf(repo_dir: str):
+def _load_hubconf(repo_dir: str, force_reload: bool = False):
     path = os.path.join(repo_dir, "hubconf.py")
     if not os.path.isfile(path):
         raise FileNotFoundError(f"no hubconf.py under {repo_dir!r}")
@@ -29,6 +29,8 @@ def _load_hubconf(repo_dir: str):
     tag = hashlib.sha256(os.path.abspath(repo_dir).encode()) \
         .hexdigest()[:12]
     mod_name = f"paddle_tpu_hubconf_{tag}"
+    if force_reload:
+        sys.modules.pop(mod_name, None)
     if mod_name in sys.modules:
         return sys.modules[mod_name]
     spec = importlib.util.spec_from_file_location(mod_name, path)
@@ -55,7 +57,7 @@ def list(repo_dir: str, source: str = "local", force_reload: bool = False):
     hub.list)."""
     if source != "local":
         raise NotImplementedError(_NO_NET.format(src=source))
-    return _entrypoints(_load_hubconf(repo_dir))
+    return _entrypoints(_load_hubconf(repo_dir, force_reload))
 
 
 def help(repo_dir: str, model: str, source: str = "local",
@@ -63,7 +65,7 @@ def help(repo_dir: str, model: str, source: str = "local",
     """The entrypoint's docstring (reference hub.help)."""
     if source != "local":
         raise NotImplementedError(_NO_NET.format(src=source))
-    mod = _load_hubconf(repo_dir)
+    mod = _load_hubconf(repo_dir, force_reload)
     if not hasattr(mod, model):
         raise ValueError(f"no entrypoint {model!r}; available: "
                          f"{_entrypoints(mod)}")
@@ -75,7 +77,7 @@ def load(repo_dir: str, model: str, source: str = "local",
     """Instantiate an entrypoint (reference hub.load)."""
     if source != "local":
         raise NotImplementedError(_NO_NET.format(src=source))
-    mod = _load_hubconf(repo_dir)
+    mod = _load_hubconf(repo_dir, force_reload)
     if not hasattr(mod, model):
         raise ValueError(f"no entrypoint {model!r}; available: "
                          f"{_entrypoints(mod)}")

@@ -150,4 +150,58 @@ class TracedLayer:
 
 
 def enable_to_static(flag=True):
-    pass
+    """Global dy2static switch (reference paddle.jit.enable_to_static):
+    False makes every to_static-decorated callable run plain dygraph."""
+    from .static_function import set_to_static_enabled
+    set_to_static_enabled(flag)
+
+
+def _unwrap_dygraph_fn(dygraph_func):
+    """The underlying python callable behind a to_static decoration, a
+    Layer (whose forward may itself be decorated), or a plain function."""
+    fn = dygraph_func
+    if isinstance(fn, StaticFunction):
+        fn = fn.forward_fn
+    fwd = getattr(fn, "forward", None)
+    if fwd is not None and not isinstance(fn, type):
+        fn = fwd
+    if isinstance(fn, StaticFunction):
+        fn = fn.forward_fn
+    return fn
+
+
+class ProgramTranslator:
+    """Legacy dy2static singleton (reference
+    jit/dy2static/program_translator.py ProgramTranslator): enable() is
+    the global to_static switch, get_code/get_program surface what the
+    trace produced — here that's the python source and the jaxpr."""
+
+    _instance = None
+    enabled = True
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, enable_to_static_flag=True):
+        self.enabled = bool(enable_to_static_flag)
+        enable_to_static(self.enabled)
+
+    def get_code(self, dygraph_func):
+        import inspect
+        return inspect.getsource(_unwrap_dygraph_fn(dygraph_func))
+
+    def get_program(self, dygraph_func, *args, **kwargs):
+        """The traced computation's jaxpr (the ProgramDesc analog).
+        args/kwargs are the example inputs (kwargs tensors included —
+        the same flattening the trace itself uses)."""
+        sf = dygraph_func if isinstance(dygraph_func, StaticFunction) \
+            else StaticFunction(dygraph_func)
+        prog, in_tensors = sf.get_concrete_program(*args, **kwargs)
+        import jax
+        key = jax.random.PRNGKey(0)
+        caps = [c._value for c in prog.captured]
+        return jax.make_jaxpr(prog.pure_fn)(
+            key, *caps, *[t._value for t in in_tensors])

@@ -36,6 +36,18 @@ from .trace_context import CaptureRecorder, TraceRngContext
 
 _fn_counter = itertools.count()
 
+# the global dy2static switch (reference ProgramTranslator.enable /
+# paddle.jit.enable_to_static): off = decorated callables run dygraph
+_TO_STATIC = {"enabled": True}
+
+
+def _to_static_enabled() -> bool:
+    return _TO_STATIC["enabled"]
+
+
+def set_to_static_enabled(flag: bool) -> None:
+    _TO_STATIC["enabled"] = bool(flag)
+
 
 class InputSpec:
     """reference: python/paddle/static/input.py InputSpec."""
@@ -216,6 +228,10 @@ class StaticFunction:
         return prog, in_tensors
 
     def __call__(self, *args, **kwargs):
+        if not _to_static_enabled():
+            # ProgramTranslator().enable(False): decorated callables fall
+            # back to plain dygraph execution (reference semantics)
+            return self._fn(*args, **kwargs)
         if self._layer is None and args and hasattr(args[0], "training") and \
                 getattr(self._fn, "__name__", "") == "forward":
             self._layer = args[0]

@@ -1,0 +1,25 @@
+"""Every example script must run end to end (examples/*.py) — the
+user-facing recipes are part of the product surface."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_EXAMPLES = sorted(
+    f for f in os.listdir(os.path.join(_ROOT, "examples"))
+    if f.endswith(".py"))
+
+
+@pytest.mark.parametrize("script", _EXAMPLES)
+def test_example_runs(script):
+    env = dict(os.environ)
+    # the example pins itself to CPU (PADDLE_TPU_EXAMPLE_BACKEND defaults
+    # to "cpu"); clear the suite's pin so the example's own path runs
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("PADDLE_TPU_EXAMPLE_BACKEND", None)
+    res = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "examples", script)],
+        cwd=_ROOT, env=env, capture_output=True, timeout=420)
+    assert res.returncode == 0, res.stderr.decode()[-2000:]

@@ -78,3 +78,53 @@ class TestAliases:
         import paddle_tpu.static as static
         assert hasattr(static.quantization, "PTQ")
         assert hasattr(static.quantization, "QAT")
+
+
+class TestProgramTranslator:
+    def test_get_code_and_program(self):
+        import paddle_tpu.jit as jit
+        import numpy as np
+
+        @jit.to_static
+        def f(a, scale=None):
+            return a * scale
+
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        pt = jit.ProgramTranslator.get_instance()
+        assert pt.enabled
+        code = pt.get_code(f)
+        assert "a * scale" in code
+        jaxpr = pt.get_program(f, x, scale=x)   # kwarg tensor included
+        assert "mul" in str(jaxpr)
+
+    def test_enable_false_runs_dygraph(self):
+        import paddle_tpu.jit as jit
+        import numpy as np
+        calls = []
+
+        @jit.to_static
+        def g(a):
+            calls.append(1)              # python side effect: only eager
+            return a + 1.0
+
+        x = paddle.to_tensor(np.zeros((2,), np.float32))
+        pt = jit.ProgramTranslator.get_instance()
+        try:
+            pt.enable(False)
+            g(x)
+            g(x)
+            assert len(calls) == 2       # ran eagerly both times
+        finally:
+            pt.enable(True)
+        out = g(x)                       # traced path works again
+        np.testing.assert_allclose(out.numpy(), 1.0)
+
+    def test_hub_force_reload(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(HUBCONF)
+        d = str(tmp_path)
+        assert "tiny_mlp" in paddle.hub.list(d)
+        (tmp_path / "hubconf.py").write_text(
+            HUBCONF + "\ndef extra():\n    return 42\n")
+        assert "extra" not in paddle.hub.list(d)           # cached
+        assert "extra" in paddle.hub.list(d, force_reload=True)
+        assert paddle.hub.load(d, "extra", force_reload=True) == 42
