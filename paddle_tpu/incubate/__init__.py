@@ -27,3 +27,4 @@ def graph_send_recv(x, src_index, dst_index, pool_type="sum",
 
 
 from . import multiprocessing  # noqa: E402,F401
+from . import checkpoint  # noqa: E402,F401

@@ -1,0 +1,149 @@
+"""Auto-checkpoint: transparent epoch-range training snapshots.
+
+Reference analog: python/paddle/fluid/incubate/checkpoint/
+auto_checkpoint.py (TrainEpochRange:642 — iterate epochs under a context
+that snapshots trainer state keyed by job id, so a restarted job resumes
+from the last completed epoch instead of epoch 0; reference target was
+HDFS, keyed by PADDLE_JOB_ID).
+
+TPU-native shape: any object with state_dict/set_state_dict (Layer,
+Optimizer, hapi Model, GradScaler) registers on the range; each completed
+epoch atomically writes
+    <dir>/<job_id>/<name>/epoch_<N>/
+and construction restores the newest complete epoch, with the iterator
+yielding only the REMAINING epochs. Works with the launch CLI's
+restart-on-failure: the relaunched process resumes where the dead one
+checkpointed.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Dict, Optional
+
+def _save_dir() -> str:
+    return os.environ.get("PADDLE_AUTO_CHECKPOINT_DIR",
+                          os.path.join(".", "auto_checkpoint"))
+
+
+def _job_id() -> str:
+    return os.environ.get("PADDLE_JOB_ID", "default_job")
+
+
+class TrainEpochRange:
+    """for epoch in TrainEpochRange(90, "resnet-run"): ... train ...
+
+    Register stateful objects before iterating:
+        tr = TrainEpochRange(10, "run1")
+        tr.add("model", model); tr.add("opt", opt)
+    Each completed epoch checkpoints; a restarted process resumes."""
+
+    def __init__(self, max_epoch_num: int, name: str,
+                 checkpoint_inter: int = 1, save_dir: Optional[str] = None):
+        self.max_epoch_num = int(max_epoch_num)
+        self.name = name
+        self.checkpoint_inter = max(1, int(checkpoint_inter))
+        self._root = os.path.join(save_dir or _save_dir(), _job_id(), name)
+        self._objects: Dict[str, object] = {}
+        self._restored_epoch = self._find_latest()
+        self._restored = False
+
+    # -- registration ------------------------------------------------------
+    def add(self, name: str, obj):
+        if not (hasattr(obj, "state_dict") and
+                hasattr(obj, "set_state_dict")):
+            raise TypeError(
+                f"{name!r} must expose state_dict/set_state_dict")
+        self._objects[name] = obj
+        return self
+
+    # -- persistence -------------------------------------------------------
+    def _meta_path(self, epoch):
+        return os.path.join(self._root, f"epoch_{epoch}", "META.json")
+
+    def _find_latest(self) -> int:
+        """Newest COMPLETE epoch (META.json is written last), else -1."""
+        if not os.path.isdir(self._root):
+            return -1
+        best = -1
+        for d in os.listdir(self._root):
+            if d.startswith("epoch_"):
+                try:
+                    e = int(d.split("_", 1)[1])
+                except ValueError:
+                    continue
+                if e > best and os.path.exists(self._meta_path(e)):
+                    best = e
+        return best
+
+    def _restore(self):
+        self._restored = True
+        if self._restored_epoch < 0:
+            return
+        from .. import framework_io
+        base = os.path.join(self._root, f"epoch_{self._restored_epoch}")
+        for name, obj in self._objects.items():
+            path = os.path.join(base, f"{name}.pdparams")
+            if not os.path.exists(path):
+                # object added to the recipe after the checkpoint was
+                # written: restore what exists, keep fresh state for the
+                # rest (resume must not crash the job it exists to save)
+                import warnings
+                warnings.warn(
+                    f"auto_checkpoint: no saved state for {name!r} in "
+                    f"epoch_{self._restored_epoch}; keeping fresh init",
+                    RuntimeWarning)
+                continue
+            obj.set_state_dict(framework_io.load(path))
+
+    def save(self, epoch: int):
+        # rank-0 writes, everyone else trusts it (multi-process launch:
+        # ranks hold replicated state in SPMD); tmp dir is pid-unique so
+        # a straggler from a dead process can't clobber a live writer
+        from ..parallel import get_rank
+        if get_rank() != 0:
+            return
+        from .. import framework_io
+        base = os.path.join(self._root, f"epoch_{epoch}")
+        tmp = base + f".tmp{os.getpid()}"
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp, exist_ok=True)
+        for name, obj in self._objects.items():
+            framework_io.save(obj.state_dict(),
+                              os.path.join(tmp, f"{name}.pdparams"))
+        with open(os.path.join(tmp, "META.json"), "w") as f:
+            json.dump({"epoch": epoch, "name": self.name}, f)
+        shutil.rmtree(base, ignore_errors=True)
+        os.replace(tmp, base)
+        # retire epochs older than one checkpoint interval (always at
+        # least two complete checkpoints on disk)
+        for d in os.listdir(self._root):
+            if d.startswith("epoch_") and ".tmp" not in d:
+                try:
+                    e = int(d.split("_", 1)[1])
+                except ValueError:
+                    continue
+                if e < epoch - self.checkpoint_inter:
+                    shutil.rmtree(os.path.join(self._root, d),
+                                  ignore_errors=True)
+
+    # -- iteration ---------------------------------------------------------
+    def __iter__(self):
+        if not self._restored:
+            self._restore()
+        for epoch in range(self._restored_epoch + 1, self.max_epoch_num):
+            yield epoch
+            if (epoch % self.checkpoint_inter == 0 or
+                    epoch == self.max_epoch_num - 1):
+                self.save(epoch)
+
+    @property
+    def restored_from_epoch(self) -> int:
+        return self._restored_epoch
+
+
+def train_epoch_range(max_epoch_num, save_checkpoint_inter=1, name="auto"):
+    """Reference module-level helper auto_checkpoint.train_epoch_range."""
+    return TrainEpochRange(max_epoch_num, name,
+                           checkpoint_inter=save_checkpoint_inter)

@@ -177,28 +177,54 @@ class ProgramTranslator:
     trace produced — here that's the python source and the jaxpr."""
 
     _instance = None
-    enabled = True
+
+    def __new__(cls):
+        # singleton: a "fresh" ProgramTranslator() is the same object, so
+        # mode queries can never disagree between instances
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
 
     @classmethod
     def get_instance(cls):
-        if cls._instance is None:
-            cls._instance = cls()
-        return cls._instance
+        return cls()
+
+    @property
+    def enabled(self):
+        # single source of truth: the same global switch
+        # jit.enable_to_static flips
+        from .static_function import _to_static_enabled
+        return _to_static_enabled()
 
     def enable(self, enable_to_static_flag=True):
-        self.enabled = bool(enable_to_static_flag)
-        enable_to_static(self.enabled)
+        enable_to_static(enable_to_static_flag)
 
     def get_code(self, dygraph_func):
         import inspect
         return inspect.getsource(_unwrap_dygraph_fn(dygraph_func))
 
+    _sf_cache = None
+
+    def _wrap(self, dygraph_func):
+        if isinstance(dygraph_func, StaticFunction):
+            return dygraph_func
+        import weakref
+        if ProgramTranslator._sf_cache is None:
+            ProgramTranslator._sf_cache = weakref.WeakKeyDictionary()
+        sf = ProgramTranslator._sf_cache.get(dygraph_func)
+        if sf is None:
+            sf = StaticFunction(dygraph_func)
+            try:
+                ProgramTranslator._sf_cache[dygraph_func] = sf
+            except TypeError:
+                pass               # unhashable/unweakreffable callable
+        return sf
+
     def get_program(self, dygraph_func, *args, **kwargs):
         """The traced computation's jaxpr (the ProgramDesc analog).
         args/kwargs are the example inputs (kwargs tensors included —
         the same flattening the trace itself uses)."""
-        sf = dygraph_func if isinstance(dygraph_func, StaticFunction) \
-            else StaticFunction(dygraph_func)
+        sf = self._wrap(dygraph_func)
         prog, in_tensors = sf.get_concrete_program(*args, **kwargs)
         import jax
         key = jax.random.PRNGKey(0)

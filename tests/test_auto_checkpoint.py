@@ -1,0 +1,106 @@
+"""Auto-checkpoint TrainEpochRange (incubate/checkpoint.py).
+
+Reference behaviors matched: fluid/incubate/checkpoint/auto_checkpoint.py
+— epoch-range iteration that snapshots registered state per epoch and
+resumes a restarted job from the last COMPLETE epoch.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.incubate.checkpoint import TrainEpochRange
+
+
+def _net():
+    paddle.seed(0)
+    return nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+
+
+def _train_one(net, opt):
+    x = paddle.to_tensor(np.ones((4, 4), np.float32))
+    y = paddle.to_tensor(np.zeros(4, np.int64))
+    loss = nn.CrossEntropyLoss()(net(x), y)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+
+
+class TestTrainEpochRange:
+    def test_full_run_then_resume_is_noop(self, tmp_path):
+        net = _net()
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        tr = TrainEpochRange(3, "run", save_dir=str(tmp_path))
+        tr.add("model", net).add("opt", opt)
+        seen = [e for e in tr]
+        assert seen == [0, 1, 2]
+        # a "restarted job" has nothing left to do
+        tr2 = TrainEpochRange(3, "run", save_dir=str(tmp_path))
+        tr2.add("model", _net())
+        assert [e for e in tr2] == []
+        assert tr2.restored_from_epoch == 2
+
+    def test_crash_resumes_from_last_complete_epoch(self, tmp_path):
+        net = _net()
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        tr = TrainEpochRange(5, "job", save_dir=str(tmp_path))
+        tr.add("model", net).add("opt", opt)
+        it = iter(tr)
+        for _ in range(3):                 # complete epochs 0,1 (+2 dies)
+            e = next(it)
+            _train_one(net, opt)
+        # "crash" mid-epoch-2 (no save for 2); weights after epoch 1:
+        w_after_1_path = os.path.join(str(tmp_path), "default_job", "job",
+                                      "epoch_1")
+        assert os.path.exists(os.path.join(w_after_1_path, "META.json"))
+        it.close()
+
+        # restart: fresh process state
+        net2 = _net()
+        opt2 = paddle.optimizer.SGD(learning_rate=0.1,
+                                    parameters=net2.parameters())
+        tr2 = TrainEpochRange(5, "job", save_dir=str(tmp_path))
+        tr2.add("model", net2).add("opt", opt2)
+        remaining = []
+        for e in tr2:
+            remaining.append(e)
+        assert remaining == [2, 3, 4]
+        assert tr2.restored_from_epoch == 1
+
+    def test_restore_brings_back_weights(self, tmp_path):
+        net = _net()
+        opt = paddle.optimizer.SGD(learning_rate=0.5,
+                                   parameters=net.parameters())
+        tr = TrainEpochRange(2, "w", save_dir=str(tmp_path))
+        tr.add("model", net)
+        for e in tr:
+            _train_one(net, opt)
+        trained = net.parameters()[0].numpy().copy()
+
+        net2 = _net()        # fresh init differs from trained
+        assert not np.allclose(net2.parameters()[0].numpy(), trained)
+        tr2 = TrainEpochRange(2, "w", save_dir=str(tmp_path))
+        tr2.add("model", net2)
+        list(tr2)            # triggers restore; no epochs remain
+        np.testing.assert_allclose(net2.parameters()[0].numpy(), trained)
+
+    def test_checkpoint_inter(self, tmp_path):
+        net = _net()
+        tr = TrainEpochRange(4, "k", checkpoint_inter=2,
+                             save_dir=str(tmp_path))
+        tr.add("model", net)
+        list(tr)
+        root = os.path.join(str(tmp_path), "default_job", "k")
+        epochs = sorted(d for d in os.listdir(root)
+                        if d.startswith("epoch_"))
+        # final epoch always saved; older than newest-1 retired
+        assert "epoch_3" in epochs
+
+    def test_rejects_stateless_objects(self, tmp_path):
+        tr = TrainEpochRange(1, "x", save_dir=str(tmp_path))
+        with pytest.raises(TypeError, match="state_dict"):
+            tr.add("thing", object())
