@@ -46,8 +46,29 @@ class TrainEpochRange:
         self.checkpoint_inter = max(1, int(checkpoint_inter))
         self._root = os.path.join(save_dir or _save_dir(), _job_id(), name)
         self._objects: Dict[str, object] = {}
+        self._purge_stale_tmp()
         self._restored_epoch = self._find_latest()
         self._restored = False
+        from ..parallel import get_world_size
+        if get_world_size() > 1 and save_dir is None and \
+                "PADDLE_AUTO_CHECKPOINT_DIR" not in os.environ:
+            import warnings
+            warnings.warn(
+                "auto_checkpoint on a multi-process job needs a SHARED "
+                "filesystem (set PADDLE_AUTO_CHECKPOINT_DIR): rank 0 "
+                "writes the snapshots, and every rank must see them to "
+                "agree on the resume epoch", RuntimeWarning)
+
+    def _purge_stale_tmp(self):
+        """Tmp dirs from crashed saves (pid-suffixed) leak one full
+        snapshot per crash — exactly the jobs this feature serves; purge
+        them at startup."""
+        if not os.path.isdir(self._root):
+            return
+        for d in os.listdir(self._root):
+            if ".tmp" in d:
+                shutil.rmtree(os.path.join(self._root, d),
+                              ignore_errors=True)
 
     # -- registration ------------------------------------------------------
     def add(self, name: str, obj):

@@ -208,16 +208,22 @@ class ProgramTranslator:
     def _wrap(self, dygraph_func):
         if isinstance(dygraph_func, StaticFunction):
             return dygraph_func
-        import weakref
+        # bounded LRU keyed by identity: the StaticFunction holds the
+        # callable strongly, so a weak-key cache would be immortal; a
+        # small LRU gives repeat-inspection speed without the leak
+        from collections import OrderedDict
         if ProgramTranslator._sf_cache is None:
-            ProgramTranslator._sf_cache = weakref.WeakKeyDictionary()
-        sf = ProgramTranslator._sf_cache.get(dygraph_func)
-        if sf is None:
-            sf = StaticFunction(dygraph_func)
-            try:
-                ProgramTranslator._sf_cache[dygraph_func] = sf
-            except TypeError:
-                pass               # unhashable/unweakreffable callable
+            ProgramTranslator._sf_cache = OrderedDict()
+        cache = ProgramTranslator._sf_cache
+        key = id(dygraph_func)
+        hit = cache.get(key)
+        if hit is not None and hit[0] is dygraph_func:
+            cache.move_to_end(key)
+            return hit[1]
+        sf = StaticFunction(dygraph_func)
+        cache[key] = (dygraph_func, sf)
+        while len(cache) > 32:
+            cache.popitem(last=False)
         return sf
 
     def get_program(self, dygraph_func, *args, **kwargs):

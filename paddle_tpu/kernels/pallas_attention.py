@@ -99,14 +99,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
-def _pad_to(x, axis, mult):
+def _pad_to(x, axis, mult, value=0):
+    """Pad `axis` up to a multiple of `mult` (shared tile-padding helper
+    for the Pallas kernel family — pallas_ce imports it too)."""
     size = x.shape[axis]
     pad = (-size) % mult
     if pad == 0:
         return x
     widths = [(0, 0)] * x.ndim
     widths[axis] = (0, pad)
-    return jnp.pad(x, widths)
+    return jnp.pad(x, widths, constant_values=value)
 
 
 def mha_fwd(q, k, v, causal=False, block_q=None, block_k=None,

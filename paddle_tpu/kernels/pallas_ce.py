@@ -1,0 +1,177 @@
+"""Hand-tiled Pallas softmax-cross-entropy over a large vocab.
+
+Reference analog: the fused softmax_with_cross_entropy kernel
+(paddle/phi/kernels/gpu/cross_entropy_kernel.cu) — per-row loss without
+materializing the probability tensor.
+
+TPU-native design: tokens tile the grid's outer axis, vocab tiles the
+inner axis with the online-logsumexp state (m, l) and the gathered
+target logit living in VMEM scratch across vocab tiles — HBM reads the
+bf16 logits exactly ONCE and never writes an f32 [T, V] intermediate
+(the jax-level fused CE upcasts the whole logits tensor to f32 first).
+Backward is one pass: d_logits tile = (softmax − onehot) · g, rebuilt
+from the saved per-row logsumexp.
+
+Wired via jax.custom_vjp behind losses.fused_softmax_ce when the
+backend is TPU-class and shapes tile; the jax-level form remains the
+fallback and the numerics oracle.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .pallas_attention import _pad_to as _pad_dim  # shared tile padding
+
+_NEG_INF = -1e30
+_LANES = 8      # per-row scalars stored 8 lanes wide (min f32 tile)
+
+
+def _fwd_kernel(x_ref, tgt_ref, loss_ref, lse_ref, m_ref, l_ref, t_ref,
+                *, block_t, block_v, n_valid_v):
+    j = pl.program_id(1)                   # vocab tile (innermost)
+    nv = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        t_ref[...] = jnp.zeros_like(t_ref)
+
+    s = x_ref[...].astype(jnp.float32)                    # (BT, BV)
+    vpos = j * block_v + jax.lax.broadcasted_iota(
+        jnp.int32, (block_t, block_v), 1)
+    s = jnp.where(vpos < n_valid_v, s, _NEG_INF)          # pad tiles
+
+    m_prev = m_ref[:, :1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    corr = jnp.exp(m_prev - m_new)
+    l_new = l_ref[:, :1] * corr + jnp.sum(jnp.exp(s - m_new), axis=-1,
+                                          keepdims=True)
+    m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    # target logit: exactly one tile holds it per row
+    tgt = tgt_ref[:, :1]                                   # (BT, 1) int32
+    hit = (vpos == tgt)
+    t_ref[...] += jnp.broadcast_to(
+        jnp.sum(jnp.where(hit, s, 0.0), axis=-1, keepdims=True),
+        t_ref.shape)
+
+    @pl.when(j == nv - 1)
+    def _finalize():
+        lse = m_ref[:, :1] + jnp.log(jnp.maximum(l_ref[:, :1], 1e-30))
+        loss_ref[...] = jnp.broadcast_to(lse - t_ref[:, :1],
+                                         loss_ref.shape)
+        lse_ref[...] = jnp.broadcast_to(lse, lse_ref.shape)
+
+
+def _bwd_kernel(x_ref, tgt_ref, lse_ref, g_ref, dx_ref,
+                *, block_t, block_v, n_valid_v):
+    j = pl.program_id(1)
+    s = x_ref[...].astype(jnp.float32)
+    vpos = j * block_v + jax.lax.broadcasted_iota(
+        jnp.int32, (block_t, block_v), 1)
+    p = jnp.exp(s - lse_ref[:, :1])
+    p = jnp.where(vpos < n_valid_v, p, 0.0)
+    onehot = (vpos == tgt_ref[:, :1]).astype(jnp.float32)
+    dx_ref[...] = ((p - onehot) * g_ref[:, :1]).astype(dx_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_t", "block_v",
+                                             "interpret"))
+def _ce_fwd(logits2d, targets, block_t=128, block_v=512, interpret=False):
+    T, V = logits2d.shape
+    x = _pad_dim(_pad_dim(logits2d, 0, block_t), 1, block_v)
+    tg = _pad_dim(targets.astype(jnp.int32), 0, block_t, value=0)
+    tg = jnp.broadcast_to(tg[:, None], (x.shape[0], _LANES))
+    grid = (x.shape[0] // block_t, x.shape[1] // block_v)
+
+    loss, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, block_t=block_t, block_v=block_v,
+                          n_valid_v=V),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_t, block_v), lambda i, j: (i, j)),
+            pl.BlockSpec((block_t, _LANES), lambda i, j: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_t, _LANES), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_t, _LANES), lambda i, j: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((x.shape[0], _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((x.shape[0], _LANES), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_t, 128), jnp.float32),
+                        pltpu.VMEM((block_t, 128), jnp.float32),
+                        pltpu.VMEM((block_t, 128), jnp.float32)],
+        interpret=interpret,
+    )(x, tg)
+    return loss[:T, 0], lse[:T, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("block_t", "block_v",
+                                             "interpret"))
+def _ce_bwd(logits2d, targets, lse, g, block_t=128, block_v=512,
+            interpret=False):
+    T, V = logits2d.shape
+    x = _pad_dim(_pad_dim(logits2d, 0, block_t), 1, block_v)
+    tg = _pad_dim(targets.astype(jnp.int32), 0, block_t, value=-1)
+    tg = jnp.broadcast_to(tg[:, None], (x.shape[0], _LANES))
+    # padded rows: lse=+inf makes p=0 so their dx is 0
+    lse2 = _pad_dim(lse, 0, block_t, value=3.4e38)
+    lse2 = jnp.broadcast_to(lse2[:, None], (x.shape[0], _LANES))
+    g2 = jnp.broadcast_to(_pad_dim(g, 0, block_t)[:, None],
+                          (x.shape[0], _LANES))
+    grid = (x.shape[0] // block_t, x.shape[1] // block_v)
+
+    dx = pl.pallas_call(
+        functools.partial(_bwd_kernel, block_t=block_t, block_v=block_v,
+                          n_valid_v=V),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_t, block_v), lambda i, j: (i, j)),
+            pl.BlockSpec((block_t, _LANES), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_t, _LANES), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_t, _LANES), lambda i, j: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_t, block_v), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, logits2d.dtype),
+        interpret=interpret,
+    )(x, tg, lse2, g2)
+    return dx[:T, :V]
+
+
+# ------------------------------------------------------------- public entry
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def ce_with_logits(logits2d, targets, interpret=False):
+    """Per-row cross entropy: [T, V] float, [T] int → [T] f32 loss."""
+    loss, _ = _ce_fwd(logits2d, targets, interpret=interpret)
+    return loss
+
+
+def _ce_vjp_fwd(logits2d, targets, interpret=False):
+    loss, lse = _ce_fwd(logits2d, targets, interpret=interpret)
+    return loss, (logits2d, targets, lse)
+
+
+def _ce_vjp_bwd(interpret, res, g):
+    logits2d, targets, lse = res
+    dx = _ce_bwd(logits2d, targets, lse, g.astype(jnp.float32),
+                 interpret=interpret)
+    return dx, None
+
+
+ce_with_logits.defvjp(_ce_vjp_fwd, _ce_vjp_bwd)
+
+
+def suitable(logits_shape) -> bool:
+    """The kernel pays off when the vocab axis is large; tiny vocabs stay
+    on the jax path (padding waste dominates below one tile)."""
+    return logits_shape[-1] >= 512

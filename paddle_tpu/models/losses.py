@@ -13,15 +13,44 @@ import jax
 import jax.numpy as jnp
 
 
+def _pallas_ce_enabled() -> bool:
+    import os
+    # ONE kill-switch family: the attention module's gate covers the
+    # global PADDLE_TPU_DISABLE_PALLAS env AND the use_pallas module
+    # global (the documented escape for Mosaic compile failures); the CE
+    # kernel adds only its own targeted env on top
+    from ..kernels.flash_attention import _pallas_enabled
+    if not _pallas_enabled():
+        return False
+    if os.environ.get("PADDLE_TPU_DISABLE_PALLAS_CE", "") in (
+            "1", "true", "True"):
+        return False
+    return jax.default_backend() in ("tpu", "axon")
+
+
 def fused_softmax_ce(logits, targets, valid_mask=None):
-    """logits [..., V] (any float dtype; upcast to f32 here), targets
-    [...] int. valid_mask [...] (bool/0-1) selects which positions count;
-    None = all. Returns the mean loss over counted positions."""
-    lf = logits.astype(jnp.float32)
-    lse = jax.scipy.special.logsumexp(lf, axis=-1)
-    tgt = jnp.take_along_axis(
-        lf, targets[..., None].astype(jnp.int32), -1)[..., 0]
-    per_pos = lse - tgt
+    """logits [..., V] (any float dtype), targets [...] int. valid_mask
+    [...] (bool/0-1) selects which positions count; None = all. Returns
+    the mean loss over counted positions.
+
+    On TPU with a large vocab the per-position loss runs through the
+    hand-tiled Pallas kernel (kernels/pallas_ce.py): bf16 logits stream
+    through VMEM once with online logsumexp — no [T, V] f32
+    materialization. Elsewhere (and as the numerics oracle) the jax-level
+    form computes the same logsumexp − target gather in f32."""
+    from ..kernels import pallas_ce
+    lead = logits.shape[:-1]
+    V = logits.shape[-1]
+    if _pallas_ce_enabled() and pallas_ce.suitable(logits.shape):
+        per_pos = pallas_ce.ce_with_logits(
+            logits.reshape(-1, V),
+            targets.reshape(-1).astype(jnp.int32)).reshape(lead)
+    else:
+        lf = logits.astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(lf, axis=-1)
+        tgt = jnp.take_along_axis(
+            lf, targets[..., None].astype(jnp.int32), -1)[..., 0]
+        per_pos = lse - tgt
     if valid_mask is None:
         return jnp.mean(per_pos)
     m = valid_mask.astype(jnp.float32)
