@@ -61,14 +61,25 @@ class TrainEpochRange:
 
     def _purge_stale_tmp(self):
         """Tmp dirs from crashed saves (pid-suffixed) leak one full
-        snapshot per crash — exactly the jobs this feature serves; purge
-        them at startup."""
-        if not os.path.isdir(self._root):
+        snapshot per crash — exactly the jobs this feature serves. Only
+        the WRITER rank purges, and only dirs that have been idle for a
+        while: an elastic restart of one rank must never delete another
+        live rank's in-progress save."""
+        from ..parallel import get_rank
+        if get_rank() != 0 or not os.path.isdir(self._root):
             return
+        import time
+        now = time.time()
         for d in os.listdir(self._root):
-            if ".tmp" in d:
-                shutil.rmtree(os.path.join(self._root, d),
-                              ignore_errors=True)
+            if ".tmp" not in d:
+                continue
+            path = os.path.join(self._root, d)
+            try:
+                idle = now - os.path.getmtime(path)
+            except OSError:
+                continue
+            if idle > 3600:
+                shutil.rmtree(path, ignore_errors=True)
 
     # -- registration ------------------------------------------------------
     def add(self, name: str, obj):

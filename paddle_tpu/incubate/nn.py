@@ -8,3 +8,4 @@ from ..kernels.flash_attention import (  # noqa: F401
     flash_attention as memory_efficient_attention)
 
 from ..parallel.moe import MoELayer  # noqa: F401
+from .fused_multi_transformer import FusedMultiTransformer  # noqa: F401
