@@ -1,0 +1,11 @@
+"""Shared helpers for the vision model factories."""
+from __future__ import annotations
+
+
+def check_pretrained(pretrained: bool) -> None:
+    """All factories share one pretrained story: weights were an external
+    download in the reference; here load a state_dict explicitly."""
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights are an external download in the "
+            "reference; load a state_dict via set_state_dict instead")

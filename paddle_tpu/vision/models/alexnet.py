@@ -3,6 +3,8 @@ from __future__ import annotations
 
 import paddle_tpu.nn as nn
 
+from ._utils import check_pretrained
+
 
 class AlexNet(nn.Layer):
     def __init__(self, num_classes=1000):
@@ -34,8 +36,5 @@ class AlexNet(nn.Layer):
 
 
 def alexnet(pretrained=False, **kwargs):
-    if pretrained:
-        raise NotImplementedError(
-            "pretrained weights are an external download in the "
-            "reference; load a state_dict via set_state_dict instead")
+    check_pretrained(pretrained)
     return AlexNet(**kwargs)

@@ -4,6 +4,8 @@ from __future__ import annotations
 
 import paddle_tpu.nn as nn
 
+from ._utils import check_pretrained
+
 
 def _make_divisible(v, divisor=8, min_value=None):
     if min_value is None:
@@ -86,8 +88,5 @@ class MobileNetV2(nn.Layer):
 
 
 def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
-    if pretrained:
-        raise NotImplementedError(
-            "pretrained weights are an external download in the "
-            "reference; load a state_dict via set_state_dict instead")
+    check_pretrained(pretrained)
     return MobileNetV2(scale=scale, **kwargs)

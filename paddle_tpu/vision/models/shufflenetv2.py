@@ -1,0 +1,129 @@
+"""ShuffleNetV2 (reference python/paddle/vision/models/shufflenetv2.py —
+channel-split units with channel shuffle, depthwise 3x3)."""
+from __future__ import annotations
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+from ._utils import check_pretrained
+
+_STAGE_OUT = {
+    0.25: [24, 24, 48, 96, 512],
+    0.33: [24, 32, 64, 128, 512],
+    0.5: [24, 48, 96, 192, 1024],
+    1.0: [24, 116, 232, 464, 1024],
+    1.5: [24, 176, 352, 704, 1024],
+    2.0: [24, 244, 488, 976, 2048],
+}
+_REPEATS = [4, 8, 4]
+
+
+def _channel_shuffle(x, groups=2):
+    B, C, H, W = x.shape
+    x = paddle.reshape(x, [B, groups, C // groups, H, W])
+    x = paddle.transpose(x, [0, 2, 1, 3, 4])
+    return paddle.reshape(x, [B, C, H, W])
+
+
+def _act_layer(act):
+    """Reference create_activation_layer: relu / swish, reject others."""
+    if act == "relu":
+        return nn.ReLU()
+    if act == "swish":
+        return nn.Swish()
+    raise ValueError(f"unsupported activation {act!r} (relu|swish)")
+
+
+def _conv_bn(in_ch, out_ch, k, stride=1, groups=1, act="relu"):
+    layers = [nn.Conv2D(in_ch, out_ch, k, stride, (k - 1) // 2,
+                        groups=groups, bias_attr=False),
+              nn.BatchNorm2D(out_ch)]
+    if act is not None:
+        layers.append(_act_layer(act))
+    return nn.Sequential(*layers)
+
+
+class _Unit(nn.Layer):
+    def __init__(self, in_ch, out_ch, stride, act="relu"):
+        super().__init__()
+        self.stride = stride
+        branch_ch = out_ch // 2
+        if stride == 1:
+            self.branch2 = nn.Sequential(
+                _conv_bn(in_ch // 2, branch_ch, 1, act=act),
+                _conv_bn(branch_ch, branch_ch, 3, groups=branch_ch,
+                         act=None),
+                _conv_bn(branch_ch, branch_ch, 1, act=act))
+            self.branch1 = None
+        else:
+            self.branch1 = nn.Sequential(
+                _conv_bn(in_ch, in_ch, 3, stride, groups=in_ch,
+                         act=None),
+                _conv_bn(in_ch, branch_ch, 1, act=act))
+            self.branch2 = nn.Sequential(
+                _conv_bn(in_ch, branch_ch, 1, act=act),
+                _conv_bn(branch_ch, branch_ch, 3, stride,
+                         groups=branch_ch, act=None),
+                _conv_bn(branch_ch, branch_ch, 1, act=act))
+
+    def forward(self, x):
+        if self.stride == 1:
+            c = x.shape[1] // 2
+            x1 = x[:, :c]
+            x2 = x[:, c:]
+            out = paddle.concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = paddle.concat([self.branch1(x), self.branch2(x)],
+                                axis=1)
+        return _channel_shuffle(out)
+
+
+class ShuffleNetV2(nn.Layer):
+    """Reference ShuffleNetV2(scale, num_classes, with_pool)."""
+
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        if scale not in _STAGE_OUT:
+            raise ValueError(f"unsupported scale {scale}")
+        outs = _STAGE_OUT[scale]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        _act_layer(act)          # validate up front
+        self.conv1 = _conv_bn(3, outs[0], 3, stride=2, act=act)
+        self.pool1 = nn.MaxPool2D(kernel_size=3, stride=2, padding=1)
+        stages = []
+        in_ch = outs[0]
+        for stage_i, reps in enumerate(_REPEATS):
+            out_ch = outs[stage_i + 1]
+            stages.append(_Unit(in_ch, out_ch, stride=2, act=act))
+            for _ in range(reps - 1):
+                stages.append(_Unit(out_ch, out_ch, stride=1, act=act))
+            in_ch = out_ch
+        self.stages = nn.Sequential(*stages)
+        self.conv_last = _conv_bn(in_ch, outs[-1], 1, act=act)
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(outs[-1], num_classes)
+
+    def forward(self, x):
+        x = self.pool1(self.conv1(x))
+        x = self.stages(x)
+        x = self.conv_last(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.fc(x)
+        return x
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kw):
+    check_pretrained(pretrained)
+    return ShuffleNetV2(scale=1.0, **kw)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kw):
+    check_pretrained(pretrained)
+    return ShuffleNetV2(scale=0.5, **kw)

@@ -4,6 +4,8 @@ from __future__ import annotations
 
 import paddle_tpu.nn as nn
 
+from ._utils import check_pretrained
+
 _CFGS = {
     "A": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
     "B": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
@@ -57,10 +59,7 @@ class VGG(nn.Layer):
 
 
 def _vgg(cfg, batch_norm, pretrained, **kwargs):
-    if pretrained:
-        raise NotImplementedError(
-            "pretrained weights are an external download in the "
-            "reference; load a state_dict via set_state_dict instead")
+    check_pretrained(pretrained)
     return VGG(_make_layers(_CFGS[cfg], batch_norm), **kwargs)
 
 

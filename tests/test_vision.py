@@ -192,3 +192,66 @@ class TestNewModelFamilies:
         from paddle_tpu.vision.models import mobilenet_v2
         with pytest.raises(NotImplementedError, match="state_dict"):
             mobilenet_v2(pretrained=True)
+
+
+class TestDeeperFamilies:
+    """DenseNet / SqueezeNet / ShuffleNetV2 (reference vision families)."""
+
+    def _drive(self, net, size=64, classes=5):
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(2, 3, size, size).astype(np.float32))
+        out = net(x)
+        assert list(out.shape) == [2, classes]
+        out.sum().backward()
+        # EVERY trainable param must receive a gradient (a disconnected
+        # branch would otherwise pass silently)
+        missing = [n for n, p in net.named_parameters()
+                   if p.trainable and p.grad is None]
+        assert not missing, missing
+        return out
+
+    def test_densenet121(self):
+        from paddle_tpu.vision.models import densenet121
+        paddle.seed(0)
+        self._drive(densenet121(num_classes=5))
+
+    def test_squeezenet(self):
+        from paddle_tpu.vision.models import squeezenet1_1
+        paddle.seed(0)
+        self._drive(squeezenet1_1(num_classes=5), size=96)
+
+    def test_shufflenet_v2(self):
+        from paddle_tpu.vision.models import shufflenet_v2_x0_5
+        paddle.seed(0)
+        self._drive(shufflenet_v2_x0_5(num_classes=5))
+
+    def test_shufflenet_act_validated(self):
+        from paddle_tpu.vision.models import ShuffleNetV2
+        with pytest.raises(ValueError, match="unsupported activation"):
+            ShuffleNetV2(scale=0.5, act="bogus")
+        paddle.seed(0)
+        self._drive(ShuffleNetV2(scale=0.25, act="swish", num_classes=5))
+
+    def test_densenet_dropout_applied(self):
+        from paddle_tpu.vision.models import DenseNet
+        paddle.seed(0)
+        m = DenseNet(layers=121, dropout=0.5, num_classes=3)
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(1, 3, 64, 64).astype(np.float32))
+        m.train()
+        a = m(x).numpy()
+        b = m(x).numpy()
+        assert not np.allclose(a, b), "train-mode dropout must be active"
+        m.eval()
+        c = m(x).numpy()
+        d = m(x).numpy()
+        np.testing.assert_array_equal(c, d)
+
+    def test_channel_shuffle_is_permutation(self):
+        from paddle_tpu.vision.models.shufflenetv2 import _channel_shuffle
+        x = paddle.to_tensor(
+            np.arange(2 * 8 * 2 * 2, dtype=np.float32)
+            .reshape(2, 8, 2, 2))
+        y = _channel_shuffle(x, groups=2)
+        assert sorted(y.numpy().ravel()) == sorted(x.numpy().ravel())
+        assert not np.array_equal(y.numpy(), x.numpy())
