@@ -1,6 +1,7 @@
 """Vision model zoo (reference python/paddle/vision/models/)."""
 from .resnet import (  # noqa: F401
     ResNet, resnet18, resnet34, resnet50, resnet101, resnet152,
+    resnext50_32x4d, resnext101_64x4d, wide_resnet50_2, wide_resnet101_2,
     BasicBlock, BottleneckBlock)
 from .lenet import LeNet  # noqa: F401
 from .mobilenetv2 import MobileNetV2, mobilenet_v2  # noqa: F401

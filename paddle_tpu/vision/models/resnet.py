@@ -134,11 +134,8 @@ class ResNet(nn.Layer):
 
 
 def _resnet(depth, pretrained=False, **kwargs):
-    if pretrained:
-        raise NotImplementedError(
-            "pretrained weights are downloaded from the paddle model zoo "
-            "in the reference; no egress here — load a local state_dict "
-            "via set_state_dict instead")
+    from ._utils import check_pretrained
+    check_pretrained(pretrained)
     return ResNet(depth=depth, **kwargs)
 
 
@@ -162,3 +159,21 @@ def resnet101(pretrained=False, **kwargs):
 
 def resnet152(pretrained=False, **kwargs):
     return _resnet(152, pretrained, **kwargs)
+
+
+def resnext50_32x4d(pretrained=False, **kwargs):
+    """Reference resnet.py resnext50_32x4d — grouped bottlenecks."""
+    return _resnet(50, pretrained, groups=32, width=4, **kwargs)
+
+
+def resnext101_64x4d(pretrained=False, **kwargs):
+    return _resnet(101, pretrained, groups=64, width=4, **kwargs)
+
+
+def wide_resnet50_2(pretrained=False, **kwargs):
+    """Reference resnet.py wide_resnet50_2 — double-width bottlenecks."""
+    return _resnet(50, pretrained, width=128, **kwargs)
+
+
+def wide_resnet101_2(pretrained=False, **kwargs):
+    return _resnet(101, pretrained, width=128, **kwargs)

@@ -89,7 +89,6 @@ class ShuffleNetV2(nn.Layer):
         outs = _STAGE_OUT[scale]
         self.num_classes = num_classes
         self.with_pool = with_pool
-        _act_layer(act)          # validate up front
         self.conv1 = _conv_bn(3, outs[0], 3, stride=2, act=act)
         self.pool1 = nn.MaxPool2D(kernel_size=3, stride=2, padding=1)
         stages = []

@@ -42,7 +42,7 @@ class SqueezeNet(nn.Layer):
                 _Fire(512, 64, 256, 256))
         elif version == "1.1":
             self.features = nn.Sequential(
-                nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(),
+                nn.Conv2D(3, 64, 3, stride=2, padding=1), nn.ReLU(),
                 nn.MaxPool2D(kernel_size=3, stride=2),
                 _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
                 nn.MaxPool2D(kernel_size=3, stride=2),
@@ -52,19 +52,23 @@ class SqueezeNet(nn.Layer):
                 _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256))
         else:
             raise ValueError(f"unknown SqueezeNet version {version!r}")
+        # reference gating: num_classes>0 adds dropout+1x1-conv head;
+        # with_pool independently adds relu+avgpool+squeeze
         if num_classes > 0:
-            self.classifier = nn.Sequential(
-                nn.Dropout(0.5), nn.Conv2D(512, num_classes, 1),
-                nn.ReLU(), nn.AdaptiveAvgPool2D(1))
+            self.drop = nn.Dropout(0.5)
+            self.conv9 = nn.Conv2D(512, num_classes, 1)
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
 
     def forward(self, x):
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
         x = self.features(x)
         if self.num_classes > 0:
-            x = self.classifier(x)
-            x = x.flatten(1)
-        elif self.with_pool:
-            import paddle_tpu.nn.functional as F
-            x = F.adaptive_avg_pool2d(x, 1)
+            x = self.conv9(self.drop(x))
+        if self.with_pool:
+            x = self.avgpool(F.relu(x))
+            x = paddle.squeeze(x, axis=[2, 3])
         return x
 
 

@@ -39,7 +39,7 @@ class TestModels:
         assert hist["loss"][-1] < hist["loss"][0]
 
     def test_pretrained_raises(self):
-        with pytest.raises(NotImplementedError, match="egress"):
+        with pytest.raises(NotImplementedError, match="state_dict"):
             models.resnet50(pretrained=True)
 
 
@@ -255,3 +255,11 @@ class TestDeeperFamilies:
         y = _channel_shuffle(x, groups=2)
         assert sorted(y.numpy().ravel()) == sorted(x.numpy().ravel())
         assert not np.array_equal(y.numpy(), x.numpy())
+
+    def test_resnext_and_wide_resnet(self):
+        from paddle_tpu.vision.models import (resnext50_32x4d,
+                                              wide_resnet50_2)
+        paddle.seed(0)
+        self._drive(resnext50_32x4d(num_classes=5))
+        paddle.seed(0)
+        self._drive(wide_resnet50_2(num_classes=5))
