@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import importlib
 
-__version__ = "0.1.0"
+from .version import full_version as __version__  # noqa: E402
 
 from .framework import (
     Tensor, to_tensor, no_grad, enable_grad, is_grad_enabled,
@@ -38,7 +38,7 @@ _LAZY_SUBMODULES = (
     "metric", "static", "inference", "profiler", "incubate", "sparse",
     "onnx", "hapi", "callbacks", "fft", "signal", "quantization", "utils",
     "regularizer", "sysconfig", "geometric", "hub", "cost_model", "pir",
-    "models", "kernels",
+    "models", "kernels", "version",
 )
 
 
@@ -106,6 +106,80 @@ def in_dynamic_mode():
 
 
 in_dygraph_mode = in_dynamic_mode
+
+
+def iinfo(dtype):
+    """Integer dtype limits (reference paddle.iinfo over numpy's)."""
+    import numpy as _np
+    from .framework.dtype import convert_dtype
+    return _np.iinfo(_np.dtype(convert_dtype(dtype)))
+
+
+def finfo(dtype):
+    """Float dtype limits (reference paddle.finfo). bfloat16/float8 go
+    through ml_dtypes.finfo (numpy's finfo rejects extension dtypes)."""
+    import numpy as _np
+    from .framework.dtype import convert_dtype
+    dt = _np.dtype(convert_dtype(dtype))
+    try:
+        return _np.finfo(dt)
+    except ValueError:
+        import ml_dtypes
+        return ml_dtypes.finfo(dt)
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Legacy reader decorator (reference paddle.batch /
+    python/paddle/reader/decorator.py): wrap a sample generator into a
+    batch generator."""
+    if batch_size <= 0:
+        raise ValueError(
+            f"batch_size must be a positive integer, got {batch_size}")
+
+    def batched():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Model forward FLOPs (reference paddle.flops / hapi dynamic_flops).
+    TPU-native: XLA's own cost analysis counts the compiled forward —
+    exact for the whole graph, no per-layer-type hook table needed
+    (custom_ops therefore has no effect and warns).
+    `input_size` is one shape list or a list of shapes."""
+    if custom_ops:
+        import warnings
+        warnings.warn(
+            "paddle_tpu.flops counts via XLA's cost analysis; custom_ops "
+            "per-layer overrides are ignored", RuntimeWarning)
+    import jax.numpy as _jnp
+    from .profiler import cost_analysis
+    from .framework.tensor import Tensor
+
+    shapes = input_size if isinstance(input_size[0], (list, tuple)) \
+        else [input_size]
+    examples = [_jnp.zeros(tuple(s), _jnp.float32) for s in shapes]
+
+    def fwd(*arrs):
+        outs = net(*[Tensor(a) for a in arrs])
+        import jax
+        return [o._value if isinstance(o, Tensor) else o
+                for o in jax.tree_util.tree_leaves(outs)]
+
+    total = int(cost_analysis(fwd, *examples)["flops"])
+    if print_detail:
+        import builtins
+        # builtins.sum: this module's namespace holds the paddle `sum` op
+        n_params = builtins.sum(int(p.size) for p in net.parameters())
+        print(f"Total Flops: {total}     Total Params: {n_params}")
+    return total
 
 
 def set_printoptions(**kwargs):

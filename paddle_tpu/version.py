@@ -1,0 +1,31 @@
+"""paddle_tpu.version (reference python/paddle/version.py, generated at
+build time there). Versioned against the reference capability snapshot
+this framework reimplements."""
+full_version = "2.5.0+tpu"
+major = "2"
+minor = "5"
+patch = "0"
+rc = "0"
+cuda_version = "False"          # reference spells non-CUDA builds this way
+cudnn_version = "False"
+xpu_version = "False"
+istaged = True
+commit = "tpu-native"
+with_pip_cuda_libraries = "OFF"
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"major: {major}")
+    print(f"minor: {minor}")
+    print(f"patch: {patch}")
+    print(f"commit: {commit}")
+    print("tpu: True (jax/XLA/PJRT backend)")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version

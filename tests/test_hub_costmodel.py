@@ -128,3 +128,30 @@ class TestProgramTranslator:
         assert "extra" not in paddle.hub.list(d)           # cached
         assert "extra" in paddle.hub.list(d, force_reload=True)
         assert paddle.hub.load(d, "extra", force_reload=True) == 42
+
+
+class TestTopLevelApis:
+    def test_iinfo_finfo(self):
+        assert paddle.iinfo("int8").max == 127
+        assert float(paddle.finfo("bfloat16").eps) == 0.0078125
+        assert paddle.finfo("float32").max > 3e38
+
+    def test_version(self):
+        assert paddle.version.full_version.endswith("+tpu")
+        paddle.version.show()
+
+    def test_batch_reader(self):
+        def reader():
+            for i in range(7):
+                yield i
+        assert [len(b) for b in paddle.batch(reader, 3)()] == [3, 3, 1]
+        assert [len(b) for b in
+                paddle.batch(reader, 3, drop_last=True)()] == [3, 3]
+
+    def test_flops_exact_for_linear(self):
+        import paddle_tpu.nn as nn
+        paddle.seed(0)
+        net = nn.Linear(16, 4)
+        # XLA fuses the bias add into the matmul; its count is the
+        # matmul's 2*M*K*N
+        assert paddle.flops(net, [2, 16]) == 2 * 2 * 16 * 4
