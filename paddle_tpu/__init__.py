@@ -38,7 +38,7 @@ _LAZY_SUBMODULES = (
     "metric", "static", "inference", "profiler", "incubate", "sparse",
     "onnx", "hapi", "callbacks", "fft", "signal", "quantization", "utils",
     "regularizer", "sysconfig", "geometric", "hub", "cost_model", "pir",
-    "models", "kernels", "version",
+    "models", "kernels",
 )
 
 
@@ -108,19 +108,31 @@ def in_dynamic_mode():
 in_dygraph_mode = in_dynamic_mode
 
 
+def _limits_dtype(d):
+    """Resolve a dtype for limits queries WITHOUT jax canonicalization:
+    iinfo('int64') must describe int64 even though x32 execution would
+    lower it — the query is about the dtype, not the backend."""
+    import numpy as _np
+    name = getattr(d, "name", None) or str(d)
+    name = name.split(".")[-1]
+    try:
+        return _np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return _np.dtype(getattr(ml_dtypes, name))
+
+
 def iinfo(dtype):
     """Integer dtype limits (reference paddle.iinfo over numpy's)."""
     import numpy as _np
-    from .framework.dtype import convert_dtype
-    return _np.iinfo(_np.dtype(convert_dtype(dtype)))
+    return _np.iinfo(_limits_dtype(dtype))
 
 
 def finfo(dtype):
     """Float dtype limits (reference paddle.finfo). bfloat16/float8 go
     through ml_dtypes.finfo (numpy's finfo rejects extension dtypes)."""
     import numpy as _np
-    from .framework.dtype import convert_dtype
-    dt = _np.dtype(convert_dtype(dtype))
+    dt = _limits_dtype(dtype)
     try:
         return _np.finfo(dt)
     except ValueError:

@@ -13,3 +13,5 @@ from .squeezenet import (  # noqa: F401
     SqueezeNet, squeezenet1_0, squeezenet1_1)
 from .shufflenetv2 import (  # noqa: F401
     ShuffleNetV2, shufflenet_v2_x1_0, shufflenet_v2_x0_5)
+from .mobilenetv1 import MobileNetV1, mobilenet_v1  # noqa: F401
+from .googlenet import GoogLeNet, googlenet  # noqa: F401

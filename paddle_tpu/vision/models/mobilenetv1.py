@@ -1,0 +1,64 @@
+"""MobileNetV1 (reference python/paddle/vision/models/mobilenetv1.py —
+depthwise-separable conv stacks with width multiplier)."""
+from __future__ import annotations
+
+import paddle_tpu.nn as nn
+
+from ._utils import check_pretrained
+
+
+def _conv_bn(in_ch, out_ch, k, stride=1, groups=1):
+    return nn.Sequential(
+        nn.Conv2D(in_ch, out_ch, k, stride, (k - 1) // 2, groups=groups,
+                  bias_attr=False),
+        nn.BatchNorm2D(out_ch), nn.ReLU())
+
+
+def _depthwise_separable(in_ch, out_ch, stride):
+    return nn.Sequential(
+        _conv_bn(in_ch, in_ch, 3, stride, groups=in_ch),
+        _conv_bn(in_ch, out_ch, 1))
+
+
+class MobileNetV1(nn.Layer):
+    """Reference MobileNetV1(scale, num_classes, with_pool)."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            # exact reference channel math (int(ch*scale), no floor) so
+            # reference state_dicts load shape-for-shape at any scale
+            return int(ch * scale)
+
+        cfg = [  # (out_ch, stride)
+            (64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+            (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2),
+            (1024, 1),
+        ]
+        layers = [_conv_bn(3, c(32), 3, stride=2)]
+        in_ch = c(32)
+        for out_ch, stride in cfg:
+            layers.append(_depthwise_separable(in_ch, c(out_ch), stride))
+            in_ch = c(out_ch)
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.fc(x)
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    check_pretrained(pretrained)
+    return MobileNetV1(scale=scale, **kwargs)

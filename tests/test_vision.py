@@ -263,3 +263,25 @@ class TestDeeperFamilies:
         self._drive(resnext50_32x4d(num_classes=5))
         paddle.seed(0)
         self._drive(wide_resnet50_2(num_classes=5))
+
+    def test_mobilenet_v1(self):
+        from paddle_tpu.vision.models import mobilenet_v1
+        paddle.seed(0)
+        self._drive(mobilenet_v1(scale=0.5, num_classes=5))
+
+    def test_googlenet_triple_output(self):
+        from paddle_tpu.vision.models import googlenet
+        paddle.seed(0)
+        net = googlenet(num_classes=5)
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(2, 3, 96, 96).astype(np.float32))
+        out, aux1, aux2 = net(x)
+        assert list(out.shape) == [2, 5]
+        assert list(aux1.shape) == [2, 5]
+        assert list(aux2.shape) == [2, 5]
+        # reference training recipe: main + 0.3*(aux1 + aux2)
+        loss = out.sum() + 0.3 * (aux1.sum() + aux2.sum())
+        loss.backward()
+        missing = [n for n, p in net.named_parameters()
+                   if p.trainable and p.grad is None]
+        assert not missing, missing
