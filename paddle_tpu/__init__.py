@@ -111,10 +111,15 @@ in_dygraph_mode = in_dynamic_mode
 def _limits_dtype(d):
     """Resolve a dtype for limits queries WITHOUT jax canonicalization:
     iinfo('int64') must describe int64 even though x32 execution would
-    lower it — the query is about the dtype, not the backend."""
+    lower it — the query is about the dtype, not the backend. Accepts
+    everything np.dtype does (np scalar types, python int/float, dtype
+    objects) plus extension-dtype names (bfloat16, float8_*)."""
     import numpy as _np
-    name = getattr(d, "name", None) or str(d)
-    name = name.split(".")[-1]
+    try:
+        return _np.dtype(d)
+    except TypeError:
+        pass
+    name = (getattr(d, "name", None) or str(d)).split(".")[-1]
     try:
         return _np.dtype(name)
     except TypeError:

@@ -15,3 +15,6 @@ from .shufflenetv2 import (  # noqa: F401
     ShuffleNetV2, shufflenet_v2_x1_0, shufflenet_v2_x0_5)
 from .mobilenetv1 import MobileNetV1, mobilenet_v1  # noqa: F401
 from .googlenet import GoogLeNet, googlenet  # noqa: F401
+from .mobilenetv3 import (  # noqa: F401
+    MobileNetV3Large, MobileNetV3Small, mobilenet_v3_large,
+    mobilenet_v3_small)

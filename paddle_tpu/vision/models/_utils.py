@@ -9,3 +9,15 @@ def check_pretrained(pretrained: bool) -> None:
         raise NotImplementedError(
             "pretrained weights are an external download in the "
             "reference; load a state_dict via set_state_dict instead")
+
+
+def conv_bn_act(in_ch, out_ch, k, stride=1, groups=1, act_layer=None):
+    """The family-shared Conv2D(bias-free, same-pad) + BatchNorm2D (+
+    activation instance) builder."""
+    import paddle_tpu.nn as nn
+    layers = [nn.Conv2D(in_ch, out_ch, k, stride, (k - 1) // 2,
+                        groups=groups, bias_attr=False),
+              nn.BatchNorm2D(out_ch)]
+    if act_layer is not None:
+        layers.append(act_layer)
+    return nn.Sequential(*layers)

@@ -1,6 +1,9 @@
 """GoogLeNet / Inception v1 (reference
-python/paddle/vision/models/googlenet.py — inception modules with four
-parallel branches plus two auxiliary classifier heads in train mode)."""
+python/paddle/vision/models/googlenet.py — inception modules whose four
+branches concat then ReLU ONCE, padding-0 max pools, and two auxiliary
+heads off ince4a/ince4d; forward returns [out, out1, out2]). Mirrored
+block-for-block: linear convs (no per-conv activation), AvgPool2D(5,3)
+aux pooling (1152-wide flatten at 224 input), ReLU on aux1's fc only."""
 from __future__ import annotations
 
 import paddle_tpu as paddle
@@ -9,92 +12,94 @@ import paddle_tpu.nn as nn
 from ._utils import check_pretrained
 
 
-def _conv_relu(in_ch, out_ch, k, stride=1, padding=0):
-    return nn.Sequential(
-        nn.Conv2D(in_ch, out_ch, k, stride, padding), nn.ReLU())
+def _conv(in_ch, out_ch, k, stride=1):
+    """Reference ConvLayer: conv only, no activation."""
+    return nn.Conv2D(in_ch, out_ch, k, stride, (k - 1) // 2,
+                     bias_attr=False)
 
 
 class _Inception(nn.Layer):
-    def __init__(self, in_ch, c1, c2_red, c2, c3_red, c3, c4):
+    def __init__(self, in_ch, c1, c3r, c3, c5r, c5, proj):
         super().__init__()
-        self.b1 = _conv_relu(in_ch, c1, 1)
-        self.b2 = nn.Sequential(_conv_relu(in_ch, c2_red, 1),
-                                _conv_relu(c2_red, c2, 3, padding=1))
-        self.b3 = nn.Sequential(_conv_relu(in_ch, c3_red, 1),
-                                _conv_relu(c3_red, c3, 5, padding=2))
-        self.b4 = nn.Sequential(
-            nn.MaxPool2D(kernel_size=3, stride=1, padding=1),
-            _conv_relu(in_ch, c4, 1))
-
-    def forward(self, x):
-        return paddle.concat(
-            [self.b1(x), self.b2(x), self.b3(x), self.b4(x)], axis=1)
-
-
-class _AuxHead(nn.Layer):
-    def __init__(self, in_ch, num_classes):
-        super().__init__()
-        self.pool = nn.AdaptiveAvgPool2D(4)
-        self.conv = _conv_relu(in_ch, 128, 1)
-        self.fc1 = nn.Linear(128 * 16, 1024)
+        self.conv1 = _conv(in_ch, c1, 1)
+        self.conv3r = _conv(in_ch, c3r, 1)
+        self.conv3 = _conv(c3r, c3, 3)
+        self.conv5r = _conv(in_ch, c5r, 1)
+        self.conv5 = _conv(c5r, c5, 5)
+        self.pool = nn.MaxPool2D(kernel_size=3, stride=1, padding=1)
+        self.convprj = _conv(in_ch, proj, 1)
         self.relu = nn.ReLU()
-        self.drop = nn.Dropout(0.7)
-        self.fc2 = nn.Linear(1024, num_classes)
 
     def forward(self, x):
-        x = self.conv(self.pool(x))
-        x = self.relu(self.fc1(x.flatten(1)))
-        return self.fc2(self.drop(x))
+        cat = paddle.concat(
+            [self.conv1(x), self.conv3(self.conv3r(x)),
+             self.conv5(self.conv5r(x)), self.convprj(self.pool(x))],
+            axis=1)
+        return self.relu(cat)              # one ReLU after the concat
 
 
 class GoogLeNet(nn.Layer):
     """Reference GoogLeNet(num_classes, with_pool): forward returns
-    (out, aux1, aux2) — the reference always returns the triple, with
-    the aux heads meaningful in train mode."""
+    [out, out1, out2] (aux heads off ince4a / ince4d)."""
 
     def __init__(self, num_classes=1000, with_pool=True):
         super().__init__()
         self.num_classes = num_classes
         self.with_pool = with_pool
-        self.stem = nn.Sequential(
-            _conv_relu(3, 64, 7, stride=2, padding=3),
-            nn.MaxPool2D(kernel_size=3, stride=2, padding=1),
-            _conv_relu(64, 64, 1),
-            _conv_relu(64, 192, 3, padding=1),
-            nn.MaxPool2D(kernel_size=3, stride=2, padding=1))
-        self.inc3a = _Inception(192, 64, 96, 128, 16, 32, 32)
-        self.inc3b = _Inception(256, 128, 128, 192, 32, 96, 64)
-        self.pool3 = nn.MaxPool2D(kernel_size=3, stride=2, padding=1)
-        self.inc4a = _Inception(480, 192, 96, 208, 16, 48, 64)
-        self.inc4b = _Inception(512, 160, 112, 224, 24, 64, 64)
-        self.inc4c = _Inception(512, 128, 128, 256, 24, 64, 64)
-        self.inc4d = _Inception(512, 112, 144, 288, 32, 64, 64)
-        self.inc4e = _Inception(528, 256, 160, 320, 32, 128, 128)
-        self.pool4 = nn.MaxPool2D(kernel_size=3, stride=2, padding=1)
-        self.inc5a = _Inception(832, 256, 160, 320, 32, 128, 128)
-        self.inc5b = _Inception(832, 384, 192, 384, 48, 128, 128)
+        self.conv = _conv(3, 64, 7, 2)
+        self.pool = nn.MaxPool2D(kernel_size=3, stride=2)  # padding=0
+        self.conv_1 = _conv(64, 64, 1)
+        self.conv_2 = _conv(64, 192, 3)
+        self.ince3a = _Inception(192, 64, 96, 128, 16, 32, 32)
+        self.ince3b = _Inception(256, 128, 128, 192, 32, 96, 64)
+        self.ince4a = _Inception(480, 192, 96, 208, 16, 48, 64)
+        self.ince4b = _Inception(512, 160, 112, 224, 24, 64, 64)
+        self.ince4c = _Inception(512, 128, 128, 256, 24, 64, 64)
+        self.ince4d = _Inception(512, 112, 144, 288, 32, 64, 64)
+        self.ince4e = _Inception(528, 256, 160, 320, 32, 128, 128)
+        self.ince5a = _Inception(832, 256, 160, 320, 32, 128, 128)
+        self.ince5b = _Inception(832, 384, 192, 384, 48, 128, 128)
         if with_pool:
-            self.avgpool = nn.AdaptiveAvgPool2D(1)
+            self.pool_5 = nn.AdaptiveAvgPool2D(1)
+            self.pool_o1 = nn.AvgPool2D(kernel_size=5, stride=3)
+            self.pool_o2 = nn.AvgPool2D(kernel_size=5, stride=3)
         if num_classes > 0:
             self.drop = nn.Dropout(0.4)
-            self.fc = nn.Linear(1024, num_classes)
-            self.aux1 = _AuxHead(512, num_classes)
-            self.aux2 = _AuxHead(528, num_classes)
+            self.fc_out = nn.Linear(1024, num_classes)
+            self.conv_o1 = _conv(512, 128, 1)
+            self.fc_o1 = nn.Linear(1152, 1024)
+            self.relu_o1 = nn.ReLU()
+            self.drop_o1 = nn.Dropout(0.7)
+            self.out1 = nn.Linear(1024, num_classes)
+            self.conv_o2 = _conv(528, 128, 1)
+            self.fc_o2 = nn.Linear(1152, 1024)
+            self.drop_o2 = nn.Dropout(0.7)
+            self.out2 = nn.Linear(1024, num_classes)
 
     def forward(self, x):
-        x = self.stem(x)
-        x = self.pool3(self.inc3b(self.inc3a(x)))
-        x = self.inc4a(x)
-        aux1 = self.aux1(x) if self.num_classes > 0 else None
-        x = self.inc4d(self.inc4c(self.inc4b(x)))
-        aux2 = self.aux2(x) if self.num_classes > 0 else None
-        x = self.pool4(self.inc4e(x))
-        x = self.inc5b(self.inc5a(x))
+        x = self.pool(self.conv(x))
+        x = self.pool(self.conv_2(self.conv_1(x)))
+        x = self.pool(self.ince3b(self.ince3a(x)))
+        ince4a = self.ince4a(x)
+        x = self.ince4c(self.ince4b(ince4a))
+        ince4d = self.ince4d(x)
+        x = self.pool(self.ince4e(ince4d))
+        ince5b = self.ince5b(self.ince5a(x))
+
+        out, out1, out2 = ince5b, ince4a, ince4d
         if self.with_pool:
-            x = self.avgpool(x)
+            out = self.pool_5(out)
+            out1 = self.pool_o1(out1)
+            out2 = self.pool_o2(out2)
         if self.num_classes > 0:
-            x = self.fc(self.drop(x.flatten(1)))
-        return x, aux1, aux2
+            out = self.fc_out(paddle.squeeze(self.drop(out),
+                                             axis=[2, 3]))
+            out1 = self.fc_o1(self.conv_o1(out1).flatten(1))
+            out1 = self.out1(self.drop_o1(self.relu_o1(out1)))
+            # reference applies no relu on the second aux head
+            out2 = self.fc_o2(self.conv_o2(out2).flatten(1))
+            out2 = self.out2(self.drop_o2(out2))
+        return [out, out1, out2]
 
 
 def googlenet(pretrained=False, **kwargs):

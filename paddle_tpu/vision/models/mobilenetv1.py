@@ -4,14 +4,12 @@ from __future__ import annotations
 
 import paddle_tpu.nn as nn
 
-from ._utils import check_pretrained
+from ._utils import check_pretrained, conv_bn_act
 
 
 def _conv_bn(in_ch, out_ch, k, stride=1, groups=1):
-    return nn.Sequential(
-        nn.Conv2D(in_ch, out_ch, k, stride, (k - 1) // 2, groups=groups,
-                  bias_attr=False),
-        nn.BatchNorm2D(out_ch), nn.ReLU())
+    return conv_bn_act(in_ch, out_ch, k, stride, groups,
+                       act_layer=nn.ReLU())
 
 
 def _depthwise_separable(in_ch, out_ch, stride):

@@ -1,0 +1,146 @@
+"""MobileNetV3 (reference python/paddle/vision/models/mobilenetv3.py —
+inverted residuals with squeeze-excitation and hardswish, small/large
+configs)."""
+from __future__ import annotations
+
+import paddle_tpu.nn as nn
+
+from ._utils import check_pretrained, conv_bn_act
+from .mobilenetv2 import _make_divisible
+
+
+# (kernel, expanded, out, use_se, activation, stride) per block
+_LARGE = [
+    (3, 16, 16, False, "relu", 1),
+    (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1),
+    (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1),
+    (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2),
+    (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1),
+    (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2),
+    (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1),
+]
+_SMALL = [
+    (3, 16, 16, True, "relu", 2),
+    (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1),
+    (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1),
+    (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1),
+    (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2),
+    (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1),
+]
+
+
+def _act(name):
+    return nn.Hardswish() if name == "hardswish" else nn.ReLU()
+
+
+def _conv_bn_act(in_ch, out_ch, k, stride=1, groups=1, act="hardswish"):
+    return conv_bn_act(in_ch, out_ch, k, stride, groups,
+                       act_layer=None if act is None else _act(act))
+
+
+class _SqueezeExcite(nn.Layer):
+    def __init__(self, ch, reduction=4):
+        super().__init__()
+        mid = _make_divisible(ch // reduction)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(ch, mid, 1)
+        self.relu = nn.ReLU()
+        self.fc2 = nn.Conv2D(mid, ch, 1)
+        self.hsig = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _InvertedResidual(nn.Layer):
+    def __init__(self, in_ch, k, exp, out_ch, use_se, act, stride):
+        super().__init__()
+        self.use_res = stride == 1 and in_ch == out_ch
+        layers = []
+        if exp != in_ch:
+            layers.append(_conv_bn_act(in_ch, exp, 1, act=act))
+        layers.append(_conv_bn_act(exp, exp, k, stride, groups=exp,
+                                   act=act))
+        if use_se:
+            layers.append(_SqueezeExcite(exp))
+        layers.append(_conv_bn_act(exp, out_ch, 1, act=None))
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        y = self.block(x)
+        return x + y if self.use_res else y
+
+
+class MobileNetV3(nn.Layer):
+    def __init__(self, cfg, last_channel, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return _make_divisible(ch * scale)
+
+        in_ch = c(16)
+        layers = [_conv_bn_act(3, in_ch, 3, stride=2, act="hardswish")]
+        for k, exp, out, use_se, act, stride in cfg:
+            layers.append(_InvertedResidual(
+                in_ch, k, c(exp), c(out), use_se, act, stride))
+            in_ch = c(out)
+        # reference: lastconv_output_channels = 6 * adjusted last out
+        last_conv = 6 * c(cfg[-1][2])
+        layers.append(_conv_bn_act(in_ch, last_conv, 1, act="hardswish"))
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(last_conv, last_channel), nn.Hardswish(),
+                nn.Dropout(0.2), nn.Linear(last_channel, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.classifier(x)
+        return x
+
+
+class MobileNetV3Large(MobileNetV3):
+    """Reference MobileNetV3Large(scale, num_classes, with_pool)."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_LARGE, 1280, scale, num_classes, with_pool)
+
+
+class MobileNetV3Small(MobileNetV3):
+    """Reference MobileNetV3Small(scale, num_classes, with_pool)."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_SMALL, 1024, scale, num_classes, with_pool)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kw):
+    check_pretrained(pretrained)
+    return MobileNetV3Large(scale=scale, **kw)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kw):
+    check_pretrained(pretrained)
+    return MobileNetV3Small(scale=scale, **kw)

@@ -273,8 +273,9 @@ class TestDeeperFamilies:
         from paddle_tpu.vision.models import googlenet
         paddle.seed(0)
         net = googlenet(num_classes=5)
+        # aux heads' 1152-wide flatten assumes the canonical 224 input
         x = paddle.to_tensor(np.random.RandomState(0)
-                             .randn(2, 3, 96, 96).astype(np.float32))
+                             .randn(2, 3, 224, 224).astype(np.float32))
         out, aux1, aux2 = net(x)
         assert list(out.shape) == [2, 5]
         assert list(aux1.shape) == [2, 5]
@@ -285,3 +286,13 @@ class TestDeeperFamilies:
         missing = [n for n, p in net.named_parameters()
                    if p.trainable and p.grad is None]
         assert not missing, missing
+
+    def test_mobilenet_v3(self):
+        from paddle_tpu.vision.models import (mobilenet_v3_small,
+                                              mobilenet_v3_large)
+        paddle.seed(0)
+        self._drive(mobilenet_v3_small(num_classes=5))
+        # large config builds (forward-only: full grad drive is slow)
+        net = mobilenet_v3_large(scale=0.5, num_classes=3)
+        x = paddle.to_tensor(np.zeros((1, 3, 64, 64), np.float32))
+        assert list(net(x).shape) == [1, 3]
