@@ -12,6 +12,7 @@ _CFGS = {
     161: (96, 48, [6, 12, 36, 24]),
     169: (64, 32, [6, 12, 32, 32]),
     201: (64, 32, [6, 12, 48, 32]),
+    264: (64, 32, [6, 12, 64, 48]),
 }
 
 
@@ -104,3 +105,7 @@ def densenet169(pretrained=False, **kw):
 
 def densenet201(pretrained=False, **kw):
     return _densenet(201, pretrained, **kw)
+
+
+def densenet264(pretrained=False, **kw):
+    return _densenet(264, pretrained, **kw)

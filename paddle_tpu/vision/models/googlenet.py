@@ -64,16 +64,16 @@ class GoogLeNet(nn.Layer):
             self.pool_o1 = nn.AvgPool2D(kernel_size=5, stride=3)
             self.pool_o2 = nn.AvgPool2D(kernel_size=5, stride=3)
         if num_classes > 0:
-            self.drop = nn.Dropout(0.4)
+            self.drop = nn.Dropout(0.4, mode="downscale_in_infer")
             self.fc_out = nn.Linear(1024, num_classes)
             self.conv_o1 = _conv(512, 128, 1)
             self.fc_o1 = nn.Linear(1152, 1024)
             self.relu_o1 = nn.ReLU()
-            self.drop_o1 = nn.Dropout(0.7)
+            self.drop_o1 = nn.Dropout(0.7, mode="downscale_in_infer")
             self.out1 = nn.Linear(1024, num_classes)
             self.conv_o2 = _conv(528, 128, 1)
             self.fc_o2 = nn.Linear(1152, 1024)
-            self.drop_o2 = nn.Dropout(0.7)
+            self.drop_o2 = nn.Dropout(0.7, mode="downscale_in_infer")
             self.out2 = nn.Linear(1024, num_classes)
 
     def forward(self, x):

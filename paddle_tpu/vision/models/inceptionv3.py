@@ -1,0 +1,189 @@
+"""Inception v3 (reference python/paddle/vision/models/inceptionv3.py:471 —
+stem of five conv-bn-relu layers with two 3x3/2 max pools, then the
+A(x3)/B/C(x4)/D/E(x2) block ladder from layers_config, adaptive avg pool
+and a 2048-wide fc; every conv is Conv-BN-ReLU with bias-free convs).
+
+Blocks mirror the reference channel plan: A(in, pool_features) =
+[64 | 48>64(5x5) | 64>96>96(3x3 dbl) | avgpool>pool_features];
+B(in) = strided reduction [384(3x3/2) | 64>96>96(3x3 dbl,/2) | maxpool/2];
+C(in, c7) = factorized 7x7 [192 | c7>(1,7)>(7,1)192 | five-step dbl | 192];
+D(in) = strided [192>320(3x3/2) | 192>(1,7)>(7,1)>192(3x3/2) | maxpool/2];
+E(in) = split 3x3 [320 | 384>{(1,3),(3,1)} | 448>384>{(1,3),(3,1)} | 192].
+"""
+from __future__ import annotations
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+from ._utils import check_pretrained
+
+
+class _CBR(nn.Sequential):
+    """ConvNormActivation analog: bias-free conv + BN + ReLU."""
+
+    def __init__(self, in_ch, out_ch, kernel_size, stride=1, padding=0):
+        super().__init__(
+            nn.Conv2D(in_ch, out_ch, kernel_size, stride, padding,
+                      bias_attr=False),
+            nn.BatchNorm2D(out_ch),
+            nn.ReLU())
+
+
+def _avgpool3():
+    # reference pools with exclusive=False (count_include_pad)
+    return nn.AvgPool2D(kernel_size=3, stride=1, padding=1, exclusive=False)
+
+
+class InceptionStem(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.conv_1a_3x3 = _CBR(3, 32, 3, stride=2)
+        self.conv_2a_3x3 = _CBR(32, 32, 3)
+        self.conv_2b_3x3 = _CBR(32, 64, 3, padding=1)
+        self.max_pool = nn.MaxPool2D(kernel_size=3, stride=2)
+        self.conv_3b_1x1 = _CBR(64, 80, 1)
+        self.conv_4a_3x3 = _CBR(80, 192, 3)
+
+    def forward(self, x):
+        x = self.conv_2b_3x3(self.conv_2a_3x3(self.conv_1a_3x3(x)))
+        x = self.conv_4a_3x3(self.conv_3b_1x1(self.max_pool(x)))
+        return self.max_pool(x)
+
+
+class InceptionA(nn.Layer):
+    def __init__(self, in_ch, pool_features):
+        super().__init__()
+        self.branch1x1 = _CBR(in_ch, 64, 1)
+        self.branch5x5 = nn.Sequential(_CBR(in_ch, 48, 1),
+                                       _CBR(48, 64, 5, padding=2))
+        self.branch3x3dbl = nn.Sequential(_CBR(in_ch, 64, 1),
+                                          _CBR(64, 96, 3, padding=1),
+                                          _CBR(96, 96, 3, padding=1))
+        self.branch_pool = nn.Sequential(_avgpool3(),
+                                         _CBR(in_ch, pool_features, 1))
+
+    def forward(self, x):
+        return paddle.concat(
+            [self.branch1x1(x), self.branch5x5(x), self.branch3x3dbl(x),
+             self.branch_pool(x)], axis=1)
+
+
+class InceptionB(nn.Layer):
+    def __init__(self, in_ch):
+        super().__init__()
+        self.branch3x3 = _CBR(in_ch, 384, 3, stride=2)
+        self.branch3x3dbl = nn.Sequential(_CBR(in_ch, 64, 1),
+                                          _CBR(64, 96, 3, padding=1),
+                                          _CBR(96, 96, 3, stride=2))
+        self.branch_pool = nn.MaxPool2D(kernel_size=3, stride=2)
+
+    def forward(self, x):
+        return paddle.concat(
+            [self.branch3x3(x), self.branch3x3dbl(x), self.branch_pool(x)],
+            axis=1)
+
+
+class InceptionC(nn.Layer):
+    def __init__(self, in_ch, channels_7x7):
+        super().__init__()
+        c7 = channels_7x7
+        self.branch1x1 = _CBR(in_ch, 192, 1)
+        self.branch7x7 = nn.Sequential(
+            _CBR(in_ch, c7, 1),
+            _CBR(c7, c7, (1, 7), padding=(0, 3)),
+            _CBR(c7, 192, (7, 1), padding=(3, 0)))
+        self.branch7x7dbl = nn.Sequential(
+            _CBR(in_ch, c7, 1),
+            _CBR(c7, c7, (7, 1), padding=(3, 0)),
+            _CBR(c7, c7, (1, 7), padding=(0, 3)),
+            _CBR(c7, c7, (7, 1), padding=(3, 0)),
+            _CBR(c7, 192, (1, 7), padding=(0, 3)))
+        self.branch_pool = nn.Sequential(_avgpool3(), _CBR(in_ch, 192, 1))
+
+    def forward(self, x):
+        return paddle.concat(
+            [self.branch1x1(x), self.branch7x7(x), self.branch7x7dbl(x),
+             self.branch_pool(x)], axis=1)
+
+
+class InceptionD(nn.Layer):
+    def __init__(self, in_ch):
+        super().__init__()
+        self.branch3x3 = nn.Sequential(_CBR(in_ch, 192, 1),
+                                       _CBR(192, 320, 3, stride=2))
+        self.branch7x7x3 = nn.Sequential(
+            _CBR(in_ch, 192, 1),
+            _CBR(192, 192, (1, 7), padding=(0, 3)),
+            _CBR(192, 192, (7, 1), padding=(3, 0)),
+            _CBR(192, 192, 3, stride=2))
+        self.branch_pool = nn.MaxPool2D(kernel_size=3, stride=2)
+
+    def forward(self, x):
+        return paddle.concat(
+            [self.branch3x3(x), self.branch7x7x3(x), self.branch_pool(x)],
+            axis=1)
+
+
+class InceptionE(nn.Layer):
+    def __init__(self, in_ch):
+        super().__init__()
+        self.branch1x1 = _CBR(in_ch, 320, 1)
+        self.branch3x3_1 = _CBR(in_ch, 384, 1)
+        self.branch3x3_2a = _CBR(384, 384, (1, 3), padding=(0, 1))
+        self.branch3x3_2b = _CBR(384, 384, (3, 1), padding=(1, 0))
+        self.branch3x3dbl_1 = nn.Sequential(_CBR(in_ch, 448, 1),
+                                            _CBR(448, 384, 3, padding=1))
+        self.branch3x3dbl_3a = _CBR(384, 384, (1, 3), padding=(0, 1))
+        self.branch3x3dbl_3b = _CBR(384, 384, (3, 1), padding=(1, 0))
+        self.branch_pool = nn.Sequential(_avgpool3(), _CBR(in_ch, 192, 1))
+
+    def forward(self, x):
+        b3 = self.branch3x3_1(x)
+        b3 = paddle.concat([self.branch3x3_2a(b3), self.branch3x3_2b(b3)],
+                           axis=1)
+        bd = self.branch3x3dbl_1(x)
+        bd = paddle.concat([self.branch3x3dbl_3a(bd),
+                            self.branch3x3dbl_3b(bd)], axis=1)
+        return paddle.concat(
+            [self.branch1x1(x), b3, bd, self.branch_pool(x)], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    """Reference InceptionV3(num_classes, with_pool); input 299x299,
+    output [N, num_classes] (no aux head in the reference port)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.inception_stem = InceptionStem()
+        blocks = [InceptionA(192, 32), InceptionA(256, 64),
+                  InceptionA(288, 64),
+                  InceptionB(288),
+                  InceptionC(768, 128), InceptionC(768, 160),
+                  InceptionC(768, 160), InceptionC(768, 192),
+                  InceptionD(768),
+                  InceptionE(1280), InceptionE(2048)]
+        self.inception_block_list = nn.LayerList(blocks)
+        if with_pool:
+            self.avg_pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            # reference uses downscale_in_infer: eval scales by (1-p)
+            self.dropout = nn.Dropout(p=0.2, mode="downscale_in_infer")
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.inception_stem(x)
+        for block in self.inception_block_list:
+            x = block(x)
+        if self.with_pool:
+            x = self.avg_pool(x)
+        if self.num_classes > 0:
+            x = paddle.reshape(x, [-1, 2048])
+            x = self.fc(self.dropout(x))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    check_pretrained(pretrained)
+    return InceptionV3(**kwargs)

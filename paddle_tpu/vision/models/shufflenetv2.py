@@ -13,7 +13,9 @@ _STAGE_OUT = {
     0.5: [24, 48, 96, 192, 1024],
     1.0: [24, 116, 232, 464, 1024],
     1.5: [24, 176, 352, 704, 1024],
-    2.0: [24, 244, 488, 976, 2048],
+    # NB: the reference's x2.0 table (shufflenetv2.py:241) uses 224, not
+    # the paper's 244 — mirror the reference
+    2.0: [24, 224, 488, 976, 2048],
 }
 _REPEATS = [4, 8, 4]
 
@@ -126,3 +128,29 @@ def shufflenet_v2_x1_0(pretrained=False, **kw):
 def shufflenet_v2_x0_5(pretrained=False, **kw):
     check_pretrained(pretrained)
     return ShuffleNetV2(scale=0.5, **kw)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kw):
+    check_pretrained(pretrained)
+    return ShuffleNetV2(scale=0.25, **kw)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kw):
+    check_pretrained(pretrained)
+    return ShuffleNetV2(scale=0.33, **kw)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kw):
+    check_pretrained(pretrained)
+    return ShuffleNetV2(scale=1.5, **kw)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kw):
+    check_pretrained(pretrained)
+    return ShuffleNetV2(scale=2.0, **kw)
+
+
+def shufflenet_v2_swish(pretrained=False, **kw):
+    """Reference shufflenet_v2_swish: scale=1.0 with swish activations."""
+    check_pretrained(pretrained)
+    return ShuffleNetV2(scale=1.0, act="swish", **kw)

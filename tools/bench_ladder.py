@@ -138,8 +138,14 @@ def run_row(row: str) -> None:
         y = paddle.to_tensor(np.random.RandomState(1)
                              .randint(0, 1000, B).astype(np.int64))
 
+        # fwd+loss as ONE traced op (to_static): eager per-op dispatch
+        # would mean 100+ separate remote compiles over the tunnel; the
+        # reference's analog row also runs the conv stack as one graph
+        net.train()
+        fwd_loss = paddle.jit.to_static(lambda xx, yy: loss_fn(net(xx), yy))
+
         def step():
-            loss = loss_fn(net(x), y)
+            loss = fwd_loss(x, y)
             loss.backward()
             opt.step()
             opt.clear_grad()
