@@ -26,6 +26,8 @@ class _RNGState(threading.local):
         # tunnel would hang every `import paddle_tpu`)
         self.key = None
         self.seed_value = 0
+        # host-only stream counter (next_host_seed) — no device involved
+        self.host_counter = 0
 
 
 _state = _RNGState()
@@ -41,6 +43,7 @@ def seed(s: int):
     """paddle.seed analog — resets the global generator."""
     _state.seed_value = int(s)
     _state.key = jax.random.PRNGKey(int(s))
+    _state.host_counter = 0
     return _state
 
 
@@ -67,3 +70,13 @@ def next_key():
 
 def default_seed() -> int:
     return _state.seed_value
+
+
+def next_host_seed() -> tuple:
+    """Host-side analog of next_key for data-prep ops (graph sampling,
+    loader shuffles): a (seed, counter) entropy pair that replays under
+    paddle.seed without touching the jax backend — over the tunneled TPU
+    even a single device dispatch per minibatch costs ~70-170 ms."""
+    c = _state.host_counter
+    _state.host_counter = c + 1
+    return (_state.seed_value, c)

@@ -16,7 +16,9 @@ from ..framework.dispatch import apply
 from ..framework.tensor import Tensor
 
 __all__ = ["send_u_recv", "send_ue_recv", "send_uv",
-           "segment_sum", "segment_mean", "segment_max", "segment_min"]
+           "segment_sum", "segment_mean", "segment_max", "segment_min",
+           "sample_neighbors", "weighted_sample_neighbors",
+           "reindex_graph", "reindex_heter_graph"]
 
 _REDUCES = {
     "sum": jax.ops.segment_sum,
@@ -114,3 +116,8 @@ segment_sum = _segment_api("sum")
 segment_mean = _segment_api("mean")
 segment_max = _segment_api("max")
 segment_min = _segment_api("min")
+
+
+from .sampling import (  # noqa: E402,F401
+    sample_neighbors, weighted_sample_neighbors)
+from .reindex import reindex_graph, reindex_heter_graph  # noqa: E402,F401

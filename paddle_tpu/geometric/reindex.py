@@ -1,0 +1,65 @@
+"""Graph reindex (reference python/paddle/geometric/reindex.py:25,136 —
+`graph_reindex` kernel). Maps a sampled subgraph's global node ids onto
+dense local ids: out_nodes lists the input nodes first (in order) then
+first-seen new neighbors; reindex_src/_dst express the sampled edges in
+local ids. Host-side numpy for the same reason as sampling.py — the
+output node count is data-dependent."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+from .sampling import _host
+
+
+def _reindex(xs, neighbor_lists, count_lists):
+    id2local = {}
+    out_nodes = []
+
+    def local(g):
+        g = int(g)
+        if g not in id2local:
+            id2local[g] = len(out_nodes)
+            out_nodes.append(g)
+        return id2local[g]
+
+    for g in xs:
+        local(g)
+    src, dst = [], []
+    for neighbors, counts in zip(neighbor_lists, count_lists):
+        pos = 0
+        for i, c in enumerate(counts.tolist()):
+            for g in neighbors[pos:pos + int(c)].tolist():
+                src.append(local(g))
+                dst.append(i)
+            pos += int(c)
+    return src, dst, out_nodes
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None,
+                  index_buffer=None, name=None):
+    """reference reindex.py:25 — returns (reindex_src, reindex_dst,
+    out_nodes)."""
+    xh = _host(x)
+    src, dst, out_nodes = _reindex(
+        xh.tolist(), [_host(neighbors)], [_host(count)])
+    dt = xh.dtype
+    return (Tensor(np.asarray(src, dt), stop_gradient=True),
+            Tensor(np.asarray(dst, dt), stop_gradient=True),
+            Tensor(np.asarray(out_nodes, dt), stop_gradient=True))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """reference reindex.py:136 — same mapping shared across the
+    heterogeneous graphs' neighbor/count pairs; edges are emitted graph
+    by graph against ONE hashtable, so out_nodes dedups across graphs."""
+    xh = _host(x)
+    src, dst, out_nodes = _reindex(
+        xh.tolist(),
+        [_host(n) for n in neighbors],
+        [_host(c) for c in count])
+    dt = xh.dtype
+    return (Tensor(np.asarray(src, dt), stop_gradient=True),
+            Tensor(np.asarray(dst, dt), stop_gradient=True),
+            Tensor(np.asarray(out_nodes, dt), stop_gradient=True))

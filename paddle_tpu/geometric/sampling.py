@@ -1,0 +1,101 @@
+"""Graph neighbor sampling (reference
+python/paddle/geometric/sampling/neighbors.py:23,175 —
+`graph_sample_neighbors` / `weighted_sample_neighbors` CUDA kernels).
+
+TPU-native design: neighbor sampling has data-dependent output shapes
+(the total sampled-edge count varies per minibatch), which can never live
+inside an XLA computation with static shapes. In the reference it runs as
+a GPU kernel feeding the GNN step; here it is a HOST op (numpy over the
+CSC arrays) executed in the DataLoader/prep stage — the device step then
+consumes the fixed-shape reindexed minibatch. RNG derives from the
+framework seed via the host-only stream (framework.random.next_host_seed)
+so sampling replays under paddle_tpu.seed without paying a device
+dispatch per minibatch."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework import random as framework_random
+from ..framework.tensor import Tensor
+
+
+def _host(x):
+    if isinstance(x, Tensor):
+        return np.asarray(x._value).reshape(-1)
+    return np.asarray(x).reshape(-1)
+
+
+def _rng():
+    return np.random.default_rng(framework_random.next_host_seed())
+
+
+def _wrap(arr, like_dtype):
+    return Tensor(np.ascontiguousarray(arr.astype(like_dtype)),
+                  stop_gradient=True)
+
+
+def _sample(row, colptr, input_nodes, eids, return_eids, select):
+    """Shared driver: `select(lo, hi, rng)` returns the chosen edge
+    indices for one node's CSC range [lo, hi)."""
+    if return_eids and eids is None:
+        raise ValueError(
+            "return_eids=True requires eids (reference neighbors.py "
+            "raises the same)")
+    rowh = _host(row)
+    ptrh = _host(colptr)
+    nodes = _host(input_nodes)
+    eidh = _host(eids) if eids is not None else None
+    rng = _rng()
+
+    out_n, out_c, out_e = [], [], []
+    for n in nodes.tolist():
+        lo, hi = int(ptrh[n]), int(ptrh[n + 1])
+        sel = select(lo, hi, rng)
+        out_n.append(rowh[sel])
+        out_c.append(len(sel))
+        if eidh is not None:
+            out_e.append(eidh[sel])
+
+    neighbors = np.concatenate(out_n) if out_n else np.empty(
+        (0,), rowh.dtype)
+    count = np.asarray(out_c, dtype=nodes.dtype)
+    if return_eids:
+        e = np.concatenate(out_e) if out_e else np.empty((0,), rowh.dtype)
+        return (_wrap(neighbors, rowh.dtype), _wrap(count, nodes.dtype),
+                _wrap(e, rowh.dtype))
+    return _wrap(neighbors, rowh.dtype), _wrap(count, nodes.dtype)
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Uniformly sample up to `sample_size` neighbors of each input node
+    from the CSC graph (row, colptr). Returns (out_neighbors, out_count)
+    and, when return_eids, the matching edge ids."""
+
+    def select(lo, hi, rng):
+        deg = hi - lo
+        if sample_size < 0 or deg <= sample_size:
+            return np.arange(lo, hi)
+        return lo + rng.choice(deg, size=sample_size, replace=False)
+
+    return _sample(row, colptr, input_nodes, eids, return_eids, select)
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None,
+                              return_eids=False, name=None):
+    """Weighted (A-Res reservoir, the reference kernel's scheme) neighbor
+    sampling: per-edge inclusion probability proportional to its weight."""
+    wh = _host(edge_weight).astype(np.float64)
+
+    def select(lo, hi, rng):
+        deg = hi - lo
+        if sample_size < 0 or deg <= sample_size:
+            return np.arange(lo, hi)
+        w = wh[lo:hi]
+        # A-Res: top-k of u^(1/w) draws == weighted sample w/o
+        # replacement (the reference GPU kernel's method)
+        keys = rng.random(deg) ** (1.0 / np.maximum(w, 1e-12))
+        return lo + np.argsort(-keys)[:sample_size]
+
+    return _sample(row, colptr, input_nodes, eids, return_eids, select)

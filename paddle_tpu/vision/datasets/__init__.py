@@ -14,7 +14,8 @@ import numpy as np
 
 from ...io import Dataset
 
-__all__ = ["MNIST", "FashionMNIST", "Cifar10", "FakeData"]
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "Flowers",
+           "VOC2012", "DatasetFolder", "ImageFolder", "FakeData"]
 
 
 class FakeData(Dataset):
@@ -119,3 +120,239 @@ class Cifar10(Dataset):
         if self.transform is not None:
             img = self.transform(img)
         return img, self.labels[idx]
+
+
+class Cifar100(Cifar10):
+    """Reference datasets/cifar.py Cifar100: same pickle format, members
+    named train/test inside cifar-100-python.tar.gz, fine_labels."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        if download and data_file is None:
+            raise NotImplementedError(
+                "Cifar100 download needs network egress; pass data_file "
+                "pointing at a local cifar-100-python.tar.gz")
+        self.transform = transform
+        names = ["train"] if mode == "train" else ["test"]
+        xs, ys = [], []
+        with tarfile.open(data_file, "r:gz") as tf:
+            for m in tf.getmembers():
+                if os.path.basename(m.name) in names:
+                    d = pickle.load(tf.extractfile(m), encoding="bytes")
+                    xs.append(np.asarray(d[b"data"]))
+                    # reference cifar.py:166 falls back labels->fine_labels
+                    ys.extend(d.get(b"labels", d.get(b"fine_labels")))
+        self.images = np.concatenate(xs).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(ys, np.int64)
+
+
+class _TarReader:
+    """Per-process lazy tar handle: forked DataLoader workers would
+    otherwise share one fd (and its seek offset) with the parent, racing
+    extractfile reads across processes. Each process reopens on first
+    use."""
+
+    def __init__(self, path):
+        self._path = path
+        self._pid = None
+        self._tar = None
+
+    def read(self, name):
+        if self._tar is None or self._pid != os.getpid():
+            self._tar = tarfile.open(self._path)
+            self._pid = os.getpid()
+        return self._tar.extractfile(name).read()
+
+    def close(self):
+        if self._tar is not None and self._pid == os.getpid():
+            try:
+                self._tar.close()
+            except Exception:
+                pass
+        self._tar = None
+
+
+class Flowers(Dataset):
+    """Reference datasets/flowers.py: 102-category flowers; reads the
+    local 102flowers tgz (jpg/image_%05d.jpg), imagelabels.mat and
+    setid.mat. NB the reference's MODE_FLAG_MAP (flowers.py:38) maps
+    train->tstid and test->trnid on purpose (the official test split is
+    the larger one) — mirrored here."""
+
+    _MODE_FLAG = {"train": "tstid", "test": "trnid", "valid": "valid"}
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=False,
+                 backend=None):
+        if download and data_file is None:
+            raise NotImplementedError(
+                "Flowers download needs network egress; pass data_file/"
+                "label_file/setid_file paths to the local archives")
+        if mode.lower() not in self._MODE_FLAG:
+            raise AssertionError(
+                f"mode should be 'train', 'valid' or 'test', got {mode}")
+        import scipy.io as scio
+        self.transform = transform
+        self.indexes = scio.loadmat(setid_file)[
+            self._MODE_FLAG[mode.lower()]][0]
+        self.labels = scio.loadmat(label_file)["labels"][0]
+        self._tar = _TarReader(data_file)
+
+    def __len__(self):
+        return len(self.indexes)
+
+    def __getitem__(self, idx):
+        import io as _io
+        from PIL import Image
+        index = int(self.indexes[idx])
+        label = np.array([self.labels[index - 1]])
+        raw = self._tar.read("jpg/image_%05d.jpg" % index)
+        img = np.array(Image.open(_io.BytesIO(raw)))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __del__(self):
+        try:
+            self._tar.close()
+        except Exception:
+            pass
+
+
+class VOC2012(Dataset):
+    """Reference datasets/voc2012.py: segmentation pairs out of the local
+    VOCtrainval tar (ImageSets/Segmentation/{mode}.txt ->
+    JPEGImages/*.jpg + SegmentationClass/*.png)."""
+
+    _SET = "VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt"
+    _DATA = "VOCdevkit/VOC2012/JPEGImages/{}.jpg"
+    _LABEL = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        if download and data_file is None:
+            raise NotImplementedError(
+                "VOC2012 download needs network egress; pass data_file "
+                "pointing at the local VOCtrainval tar")
+        if mode.lower() not in ("train", "valid", "test"):
+            raise AssertionError(
+                f"mode should be 'train', 'valid' or 'test', got {mode}")
+        # reference MODE_FLAG_MAP (voc2012.py:36): train reads the larger
+        # trainval split, test reads train
+        flag = {"train": "trainval", "valid": "val",
+                "test": "train"}[mode.lower()]
+        self.transform = transform
+        self._tar = _TarReader(data_file)
+        names = self._tar.read(self._SET.format(flag)).split()
+        self.data = [self._DATA.format(n.decode()) for n in names]
+        self.labels = [self._LABEL.format(n.decode()) for n in names]
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, idx):
+        import io as _io
+        from PIL import Image
+        img = np.array(Image.open(_io.BytesIO(
+            self._tar.read(self.data[idx]))))
+        label = np.array(Image.open(_io.BytesIO(
+            self._tar.read(self.labels[idx]))))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __del__(self):
+        try:
+            self._tar.close()
+        except Exception:
+            pass
+
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm",
+                  ".tif", ".tiff", ".webp")
+
+
+def _pil_loader(path):
+    from PIL import Image
+    with open(path, "rb") as f:
+        return np.array(Image.open(f).convert("RGB"))
+
+
+class DatasetFolder(Dataset):
+    """Reference datasets/folder.py DatasetFolder: root/class_x/xxx.ext
+    layout -> (sample, class_index); classes sorted alphabetically."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or _pil_loader
+        self.transform = transform
+        if extensions is None and is_valid_file is None:
+            extensions = IMG_EXTENSIONS
+        if extensions is not None and is_valid_file is not None:
+            raise ValueError(
+                "both extensions and is_valid_file cannot be passed")
+        if is_valid_file is None:
+            def is_valid_file(p):
+                return p.lower().endswith(tuple(extensions))
+        self.classes = sorted(
+            d.name for d in os.scandir(root) if d.is_dir())
+        if not self.classes:
+            raise RuntimeError(f"found 0 class directories in {root}")
+        self.class_to_idx = {c: i for i, c in enumerate(self.classes)}
+        self.samples = []
+        for c in self.classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _dirs, files in sorted(os.walk(cdir)):
+                for fname in sorted(files):
+                    p = os.path.join(dirpath, fname)
+                    if is_valid_file(p):
+                        self.samples.append((p, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(
+                f"found 0 files in subfolders of {root} "
+                f"(supported extensions: {extensions})")
+        self.targets = [t for _p, t in self.samples]
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+
+class ImageFolder(Dataset):
+    """Reference datasets/folder.py ImageFolder: flat/recursive image
+    list, returns [sample] (no labels)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or _pil_loader
+        self.transform = transform
+        if extensions is None and is_valid_file is None:
+            extensions = IMG_EXTENSIONS
+        if is_valid_file is None:
+            def is_valid_file(p):
+                return p.lower().endswith(tuple(extensions))
+        self.samples = []
+        for dirpath, _dirs, files in sorted(os.walk(root)):
+            for fname in sorted(files):
+                p = os.path.join(dirpath, fname)
+                if is_valid_file(p):
+                    self.samples.append(p)
+        if not self.samples:
+            raise RuntimeError(f"found 0 files in {root}")
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        sample = self.loader(self.samples[idx])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]

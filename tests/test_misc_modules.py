@@ -210,6 +210,76 @@ class TestGeometric:
         G.send_u_recv(x, src, dst, "sum").sum().backward()
         np.testing.assert_allclose(x.grad.numpy(), 1.0)
 
+    def test_reindex_graph_reference_example(self):
+        # the worked example in reference geometric/reindex.py:37
+        from paddle_tpu import geometric as G
+        x = paddle.to_tensor(np.array([0, 1, 2], np.int64))
+        nbr = paddle.to_tensor(np.array([8, 9, 0, 4, 7, 6, 7], np.int64))
+        cnt = paddle.to_tensor(np.array([2, 3, 2], np.int64))
+        src, dst, out = G.reindex_graph(x, nbr, cnt)
+        assert src.numpy().tolist() == [3, 4, 0, 5, 6, 7, 6]
+        assert dst.numpy().tolist() == [0, 0, 1, 1, 1, 2, 2]
+        assert out.numpy().tolist() == [0, 1, 2, 8, 9, 4, 7, 6]
+
+    def test_reindex_heter_graph_reference_example(self):
+        # reference geometric/reindex.py:148
+        from paddle_tpu import geometric as G
+        x = paddle.to_tensor(np.array([0, 1, 2], np.int64))
+        nb = [paddle.to_tensor(np.array([8, 9, 0, 4, 7, 6, 7], np.int64)),
+              paddle.to_tensor(np.array([0, 2, 3, 5, 1], np.int64))]
+        cb = [paddle.to_tensor(np.array([2, 3, 2], np.int64)),
+              paddle.to_tensor(np.array([1, 3, 1], np.int64))]
+        src, dst, out = G.reindex_heter_graph(x, nb, cb)
+        assert src.numpy().tolist() == [3, 4, 0, 5, 6, 7, 6,
+                                        0, 2, 8, 9, 1]
+        assert dst.numpy().tolist() == [0, 0, 1, 1, 1, 2, 2,
+                                        0, 1, 1, 1, 2]
+        assert out.numpy().tolist() == [0, 1, 2, 8, 9, 4, 7, 6, 3, 5]
+
+    def test_sample_neighbors(self):
+        from paddle_tpu import geometric as G
+        row = paddle.to_tensor(np.array([1, 2, 0, 2, 3, 0], np.int64))
+        colptr = paddle.to_tensor(np.array([0, 2, 5, 5, 6], np.int64))
+        nodes = paddle.to_tensor(np.array([0, 1, 2, 3], np.int64))
+        paddle.seed(7)
+        nbr, cnt = G.sample_neighbors(row, colptr, nodes, sample_size=2)
+        assert cnt.numpy().tolist() == [2, 2, 0, 1]
+        assert len(nbr.numpy()) == 5
+        # full sampling returns all neighbors in CSC order
+        one = paddle.to_tensor(np.array([1], np.int64))
+        nbr, cnt = G.sample_neighbors(row, colptr, one)
+        assert nbr.numpy().tolist() == [0, 2, 3]
+        assert cnt.numpy().tolist() == [3]
+        # host-seed stream: replays under paddle.seed, no device dispatch
+        paddle.seed(3)
+        a = G.sample_neighbors(row, colptr, nodes, sample_size=1)[0]
+        paddle.seed(3)
+        b = G.sample_neighbors(row, colptr, nodes, sample_size=1)[0]
+        assert a.numpy().tolist() == b.numpy().tolist()
+        # eids plumb through; return_eids without eids raises
+        nbr, cnt, e = G.sample_neighbors(
+            row, colptr, one,
+            eids=paddle.to_tensor(np.arange(6, dtype=np.int64)),
+            return_eids=True)
+        assert e.numpy().tolist() == [2, 3, 4]
+        with pytest.raises(ValueError, match="eids"):
+            G.sample_neighbors(row, colptr, one, return_eids=True)
+
+    def test_weighted_sample_neighbors(self):
+        from paddle_tpu import geometric as G
+        row = paddle.to_tensor(np.array([1, 2, 0, 2, 3, 0], np.int64))
+        colptr = paddle.to_tensor(np.array([0, 2, 5, 5, 6], np.int64))
+        one = paddle.to_tensor(np.array([1], np.int64))
+        w = paddle.to_tensor(np.array([1e-9, 1e-9, 1e9, 1e-9, 1e-9, 1.0],
+                                      np.float32))
+        paddle.seed(11)
+        hits = 0
+        for _ in range(20):
+            nbr, _cnt = G.weighted_sample_neighbors(
+                row, colptr, w, one, sample_size=1)
+            hits += nbr.numpy().tolist() == [0]
+        assert hits >= 18, hits
+
 
 class TestAudio:
     def test_hz_mel_roundtrip(self):

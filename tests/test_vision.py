@@ -325,3 +325,140 @@ class TestDeeperFamilies:
             net.eval()
             x = paddle.to_tensor(np.zeros((1, 3, 64, 64), np.float32))
             assert list(net(x).shape) == [1, 3], factory.__name__
+
+
+class TestNewDatasets:
+    def _png(self, arr, path):
+        from PIL import Image
+        Image.fromarray(arr).save(path)
+
+    def test_cifar100_reads_fine_labels(self, tmp_path):
+        import pickle, tarfile
+        data = {b"data": np.random.RandomState(0).randint(
+                    0, 255, (10, 3072), dtype=np.uint8).astype(np.uint8),
+                b"fine_labels": list(range(10))}
+        p = tmp_path / "cifar-100-python"
+        p.mkdir()
+        with open(p / "train", "wb") as f:
+            pickle.dump(data, f)
+        tar = tmp_path / "cifar-100-python.tar.gz"
+        with tarfile.open(tar, "w:gz") as tf:
+            tf.add(p / "train", arcname="cifar-100-python/train")
+        from paddle_tpu.vision.datasets import Cifar100
+        ds = Cifar100(data_file=str(tar), mode="train")
+        assert len(ds) == 10
+        img, label = ds[3]
+        assert img.shape == (3, 32, 32) and int(label) == 3
+
+    def test_flowers_split_quirk_and_read(self, tmp_path):
+        import tarfile
+        import scipy.io as scio
+        jpg = tmp_path / "jpg"
+        jpg.mkdir()
+        for i in range(1, 5):
+            self._png(np.full((8, 8, 3), i * 10, np.uint8),
+                      jpg / f"image_{i:05d}.jpg")
+        tar = tmp_path / "102flowers.tgz"
+        with tarfile.open(tar, "w:gz") as tf:
+            for i in range(1, 5):
+                tf.add(jpg / f"image_{i:05d}.jpg",
+                       arcname=f"jpg/image_{i:05d}.jpg")
+        scio.savemat(tmp_path / "imagelabels.mat",
+                     {"labels": np.array([[1, 1, 2, 2]])})
+        # reference MODE_FLAG_MAP: train reads tstid
+        scio.savemat(tmp_path / "setid.mat",
+                     {"tstid": np.array([[1, 2, 3]]),
+                      "trnid": np.array([[4]]),
+                      "valid": np.array([[4]])})
+        from paddle_tpu.vision.datasets import Flowers
+        ds = Flowers(data_file=str(tar),
+                     label_file=str(tmp_path / "imagelabels.mat"),
+                     setid_file=str(tmp_path / "setid.mat"), mode="train")
+        assert len(ds) == 3
+        img, label = ds[0]
+        assert img.shape[-1] == 3 and label.tolist() == [1]
+        ds_test = Flowers(data_file=str(tar),
+                          label_file=str(tmp_path / "imagelabels.mat"),
+                          setid_file=str(tmp_path / "setid.mat"),
+                          mode="test")
+        assert len(ds_test) == 1
+
+    def test_voc2012_pairs(self, tmp_path):
+        import tarfile
+        base = tmp_path / "VOCdevkit" / "VOC2012"
+        (base / "ImageSets" / "Segmentation").mkdir(parents=True)
+        (base / "JPEGImages").mkdir()
+        (base / "SegmentationClass").mkdir()
+        for n in ("a", "b"):
+            self._png(np.zeros((6, 6, 3), np.uint8),
+                      base / "JPEGImages" / f"{n}.jpg")
+            self._png(np.ones((6, 6), np.uint8),
+                      base / "SegmentationClass" / f"{n}.png")
+        # reference MODE_FLAG_MAP: train->trainval, valid->val, test->train
+        (base / "ImageSets" / "Segmentation" / "trainval.txt").write_text(
+            "a\nb\n")
+        (base / "ImageSets" / "Segmentation" / "val.txt").write_text("a\n")
+        (base / "ImageSets" / "Segmentation" / "train.txt").write_text(
+            "b\n")
+        tar = tmp_path / "voc.tar"
+        with tarfile.open(tar, "w") as tf:
+            tf.add(tmp_path / "VOCdevkit", arcname="VOCdevkit")
+        from paddle_tpu.vision.datasets import VOC2012
+        ds = VOC2012(data_file=str(tar), mode="train")
+        assert len(ds) == 2                       # trainval split
+        assert len(VOC2012(data_file=str(tar), mode="valid")) == 1
+        assert len(VOC2012(data_file=str(tar), mode="test")) == 1
+        img, mask = ds[0]
+        assert img.shape == (6, 6, 3) and mask.shape == (6, 6)
+        assert (mask == 1).all()
+
+    def test_dataset_folder_and_image_folder(self, tmp_path):
+        root = tmp_path / "ds"
+        for cls in ("cat", "dog"):
+            (root / cls).mkdir(parents=True)
+            for i in range(2):
+                self._png(np.full((4, 4, 3), i, np.uint8),
+                          root / cls / f"{i}.png")
+        from paddle_tpu.vision.datasets import DatasetFolder, ImageFolder
+        ds = DatasetFolder(str(root))
+        assert ds.classes == ["cat", "dog"]
+        assert len(ds) == 4
+        sample, target = ds[0]
+        assert sample.shape == (4, 4, 3) and target == 0
+        flat = ImageFolder(str(root))
+        assert len(flat) == 4
+        [only] = flat[0]
+        assert only.shape == (4, 4, 3)
+
+    def test_tar_datasets_survive_forked_workers(self, tmp_path):
+        """Flowers/VOC keep a lazy per-process tar handle; forked
+        DataLoader workers must re-open rather than share the parent fd."""
+        import tarfile
+        import scipy.io as scio
+        from paddle_tpu.io import DataLoader
+        jpg = tmp_path / "jpg"
+        jpg.mkdir()
+        for i in range(1, 9):
+            self._png(np.full((8, 8, 3), i * 7 % 255, np.uint8),
+                      jpg / f"image_{i:05d}.jpg")
+        tar = tmp_path / "102flowers.tgz"
+        with tarfile.open(tar, "w:gz") as tf:
+            for i in range(1, 9):
+                tf.add(jpg / f"image_{i:05d}.jpg",
+                       arcname=f"jpg/image_{i:05d}.jpg")
+        scio.savemat(tmp_path / "imagelabels.mat",
+                     {"labels": np.arange(1, 9)[None]})
+        scio.savemat(tmp_path / "setid.mat",
+                     {"tstid": np.arange(1, 9)[None],
+                      "trnid": np.array([[1]]),
+                      "valid": np.array([[1]])})
+        from paddle_tpu.vision.datasets import Flowers
+        ds = Flowers(data_file=str(tar),
+                     label_file=str(tmp_path / "imagelabels.mat"),
+                     setid_file=str(tmp_path / "setid.mat"), mode="train")
+        loader = DataLoader(ds, batch_size=2, num_workers=2)
+        seen = 0
+        for img, label in loader:
+            assert tuple(img.shape[1:]) == (8, 8, 3)
+            seen += int(img.shape[0])
+        assert seen == 8
