@@ -1,9 +1,14 @@
-"""paddle_tpu.audio — audio feature extraction.
+"""paddle_tpu.audio — audio feature extraction, IO backends, datasets.
 
 Reference analog: python/paddle/audio/ (features/layers.py Spectrogram/
 MelSpectrogram/LogMelSpectrogram/MFCC, functional/functional.py
-hz_to_mel/mel_to_hz/compute_fbank_matrix/create_dct + window functions).
-Built on paddle_tpu.signal.stft/fft — all traceable ops.
+hz_to_mel/mel_to_hz/compute_fbank_matrix/create_dct + window functions,
+backends/wave_backend.py info/load/save, datasets/{esc50,tess}.py).
+Features are built on paddle_tpu.signal.stft/fft — all traceable ops;
+IO and datasets are host-side.
 """
 from . import functional  # noqa: F401
 from . import features  # noqa: F401
+from . import backends  # noqa: F401
+from . import datasets  # noqa: F401
+from .backends import info, load, save  # noqa: F401

@@ -1,0 +1,130 @@
+"""Audio IO backends (reference python/paddle/audio/backends/ —
+wave_backend.py:37,89,168 info/load/save over Python's wave module, with
+an optional soundfile backend selected by init_backend.py:135).
+
+No egress / no soundfile wheel here, so the stdlib wave backend is the
+one real backend; the selection API mirrors the reference so code
+written against it ports unchanged."""
+from __future__ import annotations
+
+import wave
+from typing import List, Tuple
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+
+
+class AudioInfo:
+    """reference backends/backend.py:21."""
+
+    def __init__(self, sample_rate: int, num_samples: int,
+                 num_channels: int, bits_per_sample: int, encoding: str):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+
+_BACKENDS = ["wave_backend"]
+_current = {"backend": "wave_backend"}
+
+
+def list_available_backends() -> List[str]:
+    """reference init_backend.py:37 (soundfile appears only when its
+    wheel is importable — it is not in this image)."""
+    return list(_BACKENDS)
+
+
+def get_current_backend() -> str:
+    return _current["backend"]
+
+
+def set_backend(backend_name: str):
+    """reference init_backend.py:135."""
+    if backend_name not in _BACKENDS:
+        raise NotImplementedError(
+            f"backend {backend_name!r} unavailable; choices: {_BACKENDS}")
+    _current["backend"] = backend_name
+
+
+def info(filepath) -> AudioInfo:
+    """reference wave_backend.py:37 — WAV header info. A caller-provided
+    file object stays open (the caller owns it); paths are opened and
+    closed here."""
+    owns = not hasattr(filepath, "read")
+    file_obj = open(filepath, "rb") if owns else filepath
+    try:
+        f = wave.open(file_obj)
+        out = AudioInfo(f.getframerate(), f.getnframes(),
+                        f.getnchannels(), f.getsampwidth() * 8, "PCM_S")
+    except wave.Error:
+        raise NotImplementedError(
+            "only WAV is supported by the wave backend (the reference's "
+            "fallback backend has the same limit)")
+    finally:
+        if owns:
+            file_obj.close()
+    return out
+
+
+def load(filepath, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True,
+         channels_first: bool = True) -> Tuple[Tensor, int]:
+    """reference wave_backend.py:89 — returns (waveform, sample_rate);
+    waveform is [C, T] (channels_first) float32 in [-1, 1] when
+    normalize, else the integer PCM values as float32."""
+    owns = not hasattr(filepath, "read")
+    file_obj = open(filepath, "rb") if owns else filepath
+    try:
+        f = wave.open(file_obj)
+        channels = f.getnchannels()
+        width = f.getsampwidth()
+        sr = f.getframerate()
+        total = f.getnframes()
+        f.setpos(min(frame_offset, total))
+        n = total - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(max(n, 0))
+    except wave.Error:
+        raise NotImplementedError(
+            "only WAV is supported by the wave backend")
+    finally:
+        if owns:
+            file_obj.close()
+
+    dtype = {1: np.uint8, 2: np.int16, 4: np.int32}.get(width)
+    if dtype is None:
+        raise NotImplementedError(f"unsupported sample width {width}")
+    data = np.frombuffer(raw, dtype=dtype).astype(np.float32)
+    if width == 1:                       # 8-bit WAV is unsigned
+        data = data - 128.0
+    data = data.reshape(-1, channels).T  # [C, T]
+    if normalize:
+        data = data / float(2 ** (8 * width - 1))
+    if not channels_first:
+        data = data.T
+    return Tensor(np.ascontiguousarray(data), stop_gradient=True), sr
+
+
+def save(filepath: str, src, sample_rate: int, channels_first: bool = True,
+         encoding: str = "PCM_S", bits_per_sample: int = 16):
+    """reference wave_backend.py:168 — writes 16-bit PCM WAV."""
+    x = np.asarray(src._value if isinstance(src, Tensor) else src)
+    if x.ndim == 1:
+        x = x[None]
+    if not channels_first:
+        x = x.T
+    if bits_per_sample != 16:
+        raise NotImplementedError(
+            "wave backend writes 16-bit PCM (reference limit)")
+    if np.issubdtype(x.dtype, np.floating):
+        x = np.clip(x, -1.0, 1.0)
+        x = (x * 32767.0).astype(np.int16)
+    else:
+        x = x.astype(np.int16)
+    with wave.open(str(filepath), "wb") as f:
+        f.setnchannels(x.shape[0])
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(np.ascontiguousarray(x.T).tobytes())

@@ -1,0 +1,174 @@
+"""Audio classification datasets (reference
+python/paddle/audio/datasets/{dataset,esc50,tess}.py).
+
+The reference downloads archives into DATA_HOME; with no egress the
+classes here take a local `root` directory in the original extracted
+layout (ESC-50-master/..., TESS_Toronto_emotional_speech_set/...)."""
+from __future__ import annotations
+
+import collections
+import os
+from typing import List, Tuple
+
+import numpy as np
+
+from ..io import Dataset
+from . import features as _features
+from . import backends as _backends
+
+feat_classes = {
+    "raw": None,
+    "melspectrogram": _features.MelSpectrogram,
+    "mfcc": _features.MFCC,
+    "logmelspectrogram": _features.LogMelSpectrogram,
+    "spectrogram": _features.Spectrogram,
+}
+
+
+class AudioClassificationDataset(Dataset):
+    """reference datasets/dataset.py:29 — (feature, label) pairs; feature
+    is the raw waveform or the configured front-end feature."""
+
+    def __init__(self, files: List[str], labels: List[int],
+                 feat_type: str = "raw", sample_rate: int = None,
+                 **kwargs):
+        super().__init__()
+        if feat_type not in feat_classes:
+            raise RuntimeError(
+                f"Unknown feat_type: {feat_type}, it must be one in "
+                f"{list(feat_classes.keys())}")
+        self.files = files
+        self.labels = labels
+        self.feat_type = feat_type
+        self.sample_rate = sample_rate
+        self.feat_config = kwargs
+        # extractor cache keyed by sample rate: building MelSpectrogram/
+        # MFCC means computing the fbank/DCT matrices — far too costly
+        # per __getitem__ over thousands of clips
+        self._extractors = {}
+
+    def _extractor_for(self, sr):
+        ex = self._extractors.get(sr)
+        if ex is None:
+            feat_cls = feat_classes[self.feat_type]
+            if self.feat_type != "spectrogram":
+                ex = feat_cls(sr=sr, **self.feat_config)
+            else:
+                ex = feat_cls(**self.feat_config)
+            self._extractors[sr] = ex
+        return ex
+
+    def _convert_to_record(self, idx):
+        from ..framework.tensor import Tensor
+        file, label = self.files[idx], self.labels[idx]
+        waveform, sr = _backends.load(file)
+        wav = np.asarray(waveform._value)
+        if wav.ndim == 2:
+            wav = wav[0]                      # 1D mono input
+        if feat_classes[self.feat_type] is None:
+            return Tensor(wav.astype(np.float32),
+                          stop_gradient=True), label
+        x = Tensor(wav[None].astype(np.float32), stop_gradient=True)
+        return self._extractor_for(sr)(x).squeeze(0), label
+
+    def __getitem__(self, idx):
+        return self._convert_to_record(idx)
+
+    def __len__(self):
+        return len(self.files)
+
+
+class ESC50(AudioClassificationDataset):
+    """reference datasets/esc50.py:26 — 2000 5-second clips, 50 classes,
+    5 official folds from meta/esc50.csv; mode='train' keeps folds !=
+    split, anything else keeps fold == split."""
+
+    meta = os.path.join("ESC-50-master", "meta", "esc50.csv")
+    audio_path = os.path.join("ESC-50-master", "audio")
+    meta_info = collections.namedtuple(
+        "META_INFO",
+        ("filename", "fold", "target", "category", "esc10", "src_file",
+         "take"))
+
+    def __init__(self, mode: str = "train", split: int = 1,
+                 feat_type: str = "raw", root: str = None, **kwargs):
+        assert split in range(1, 6), (
+            f"The selected split should be integer, and 1 <= split <= 5, "
+            f"but got {split}")
+        if root is None:
+            raise NotImplementedError(
+                "ESC50 download needs network egress; pass root= pointing "
+                "at the extracted ESC-50-master parent directory")
+        self._root = root
+        files, labels = self._get_data(mode, split)
+        super().__init__(files=files, labels=labels, feat_type=feat_type,
+                         **kwargs)
+
+    def _get_meta_info(self):
+        ret = []
+        with open(os.path.join(self._root, self.meta)) as rf:
+            for line in rf.readlines()[1:]:
+                ret.append(self.meta_info(*line.strip().split(",")))
+        return ret
+
+    def _get_data(self, mode: str,
+                  split: int) -> Tuple[List[str], List[int]]:
+        files, labels = [], []
+        for sample in self._get_meta_info():
+            keep = (int(sample.fold) != split if mode == "train"
+                    else int(sample.fold) == split)
+            if keep:
+                files.append(os.path.join(self._root, self.audio_path,
+                                          sample.filename))
+                labels.append(int(sample.target))
+        return files, labels
+
+
+class TESS(AudioClassificationDataset):
+    """reference datasets/tess.py:26 — 2800 emotional-speech clips;
+    labels parsed from {speaker}_{word}_{emotion}.wav filenames; round-
+    robin n_folds split (tess.py:145: fold = idx % n_folds + 1)."""
+
+    label_list = ["angry", "disgust", "fear", "happy", "neutral", "ps",
+                  "sad"]
+    meta_info = collections.namedtuple(
+        "META_INFO", ("speaker", "word", "emotion"))
+    audio_path = "TESS_Toronto_emotional_speech_set"
+
+    def __init__(self, mode: str = "train", n_folds: int = 5,
+                 split: int = 1, feat_type: str = "raw", root: str = None,
+                 **kwargs):
+        assert isinstance(n_folds, int) and n_folds >= 1, (
+            f"the n_folds should be integer and n_folds >= 1, "
+            f"but got {n_folds}")
+        assert split in range(1, n_folds + 1), (
+            f"The selected split should be integer and should be "
+            f"1 <= split <= {n_folds}, but got {split}")
+        if root is None:
+            raise NotImplementedError(
+                "TESS download needs network egress; pass root= pointing "
+                "at the extracted TESS_Toronto_emotional_speech_set "
+                "parent directory")
+        self._root = root
+        files, labels = self._get_data(mode, n_folds, split)
+        super().__init__(files=files, labels=labels, feat_type=feat_type,
+                         **kwargs)
+
+    def _get_data(self, mode: str, n_folds: int,
+                  split: int) -> Tuple[List[str], List[int]]:
+        wav_files = []
+        for dirpath, _dirs, fnames in sorted(
+                os.walk(os.path.join(self._root, self.audio_path))):
+            for f in sorted(fnames):
+                if f.endswith(".wav"):
+                    wav_files.append(os.path.join(dirpath, f))
+        files, labels = [], []
+        for idx, path in enumerate(wav_files):
+            emotion = os.path.basename(path)[:-4].split("_")[-1]
+            target = self.label_list.index(emotion)
+            fold = idx % n_folds + 1
+            keep = (fold != split if mode == "train" else fold == split)
+            if keep:
+                files.append(path)
+                labels.append(target)
+        return files, labels

@@ -26,8 +26,14 @@ class _RNGState(threading.local):
         # tunnel would hang every `import paddle_tpu`)
         self.key = None
         self.seed_value = 0
-        # host-only stream counter (next_host_seed) — no device involved
-        self.host_counter = 0
+
+
+# host-only stream for data-prep entropy: deliberately NOT thread-local —
+# DataLoader producer threads must continue the user's seeded stream, not
+# restart an unseeded one. Guarded by a lock; forked workers additionally
+# mix in their worker id (see next_host_seed).
+_host_state = {"seed": 0, "counter": 0}
+_host_lock = threading.Lock()
 
 
 _state = _RNGState()
@@ -43,7 +49,9 @@ def seed(s: int):
     """paddle.seed analog — resets the global generator."""
     _state.seed_value = int(s)
     _state.key = jax.random.PRNGKey(int(s))
-    _state.host_counter = 0
+    with _host_lock:
+        _host_state["seed"] = int(s)
+        _host_state["counter"] = 0
     return _state
 
 
@@ -74,9 +82,21 @@ def default_seed() -> int:
 
 def next_host_seed() -> tuple:
     """Host-side analog of next_key for data-prep ops (graph sampling,
-    loader shuffles): a (seed, counter) entropy pair that replays under
-    paddle.seed without touching the jax backend — over the tunneled TPU
-    even a single device dispatch per minibatch costs ~70-170 ms."""
-    c = _state.host_counter
-    _state.host_counter = c + 1
-    return (_state.seed_value, c)
+    loader shuffles): a (seed, counter, worker_id) entropy tuple that
+    replays under paddle.seed without touching the jax backend — over the
+    tunneled TPU even a single device dispatch per minibatch costs
+    ~70-170 ms. The state is process-global (not thread-local) so loader
+    producer threads continue the user's stream; forked DataLoader
+    workers inherit the counter snapshot but mix in their worker id, so
+    their streams are decorrelated yet reproducible (the loader's batch
+    order is deterministic)."""
+    from ..io import get_worker_info
+    with _host_lock:
+        c = _host_state["counter"]
+        _host_state["counter"] = c + 1
+        s = _host_state["seed"]
+    info = get_worker_info()
+    # SeedSequence entropy must be non-negative: 0 = trainer process,
+    # workers are 1-based
+    wid = 0 if info is None else int(info.id) + 1
+    return (s, c, wid)

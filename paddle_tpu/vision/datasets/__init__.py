@@ -90,25 +90,29 @@ class FashionMNIST(MNIST):
 class Cifar10(Dataset):
     """Reads the original python-pickle batches from a local
     cifar-10-python.tar.gz (reference datasets/cifar.py minus the
-    downloader)."""
+    downloader). Cifar100 differs only in the member names (class
+    attribute _MEMBERS) — label lookup already covers both via the
+    reference's labels->fine_labels fallback (cifar.py:166)."""
+
+    _NAME = "Cifar10"
+    _MEMBERS = {"train": [f"data_batch_{i}" for i in range(1, 6)],
+                "test": ["test_batch"]}
 
     def __init__(self, data_file=None, mode="train", transform=None,
                  download=False, backend=None):
         if download and data_file is None:
             raise NotImplementedError(
-                "Cifar10 download needs network egress; pass data_file "
-                "pointing at a local cifar-10-python.tar.gz")
+                f"{self._NAME} download needs network egress; pass "
+                f"data_file pointing at the local python-version tar.gz")
         self.transform = transform
-        names = [f"data_batch_{i}" for i in range(1, 6)] \
-            if mode == "train" else ["test_batch"]
+        names = self._MEMBERS["train" if mode == "train" else "test"]
         xs, ys = [], []
         with tarfile.open(data_file, "r:gz") as tf:
             for m in tf.getmembers():
-                base = os.path.basename(m.name)
-                if base in names:
+                if os.path.basename(m.name) in names:
                     d = pickle.load(tf.extractfile(m), encoding="bytes")
                     xs.append(np.asarray(d[b"data"]))
-                    ys.extend(d[b"labels"])
+                    ys.extend(d.get(b"labels", d.get(b"fine_labels")))
         self.images = np.concatenate(xs).reshape(-1, 3, 32, 32)
         self.labels = np.asarray(ys, np.int64)
 
@@ -126,24 +130,8 @@ class Cifar100(Cifar10):
     """Reference datasets/cifar.py Cifar100: same pickle format, members
     named train/test inside cifar-100-python.tar.gz, fine_labels."""
 
-    def __init__(self, data_file=None, mode="train", transform=None,
-                 download=False, backend=None):
-        if download and data_file is None:
-            raise NotImplementedError(
-                "Cifar100 download needs network egress; pass data_file "
-                "pointing at a local cifar-100-python.tar.gz")
-        self.transform = transform
-        names = ["train"] if mode == "train" else ["test"]
-        xs, ys = [], []
-        with tarfile.open(data_file, "r:gz") as tf:
-            for m in tf.getmembers():
-                if os.path.basename(m.name) in names:
-                    d = pickle.load(tf.extractfile(m), encoding="bytes")
-                    xs.append(np.asarray(d[b"data"]))
-                    # reference cifar.py:166 falls back labels->fine_labels
-                    ys.extend(d.get(b"labels", d.get(b"fine_labels")))
-        self.images = np.concatenate(xs).reshape(-1, 3, 32, 32)
-        self.labels = np.asarray(ys, np.int64)
+    _NAME = "Cifar100"
+    _MEMBERS = {"train": ["train"], "test": ["test"]}
 
 
 class _TarReader:
@@ -336,6 +324,9 @@ class ImageFolder(Dataset):
         self.transform = transform
         if extensions is None and is_valid_file is None:
             extensions = IMG_EXTENSIONS
+        if extensions is not None and is_valid_file is not None:
+            raise ValueError(
+                "both extensions and is_valid_file cannot be passed")
         if is_valid_file is None:
             def is_valid_file(p):
                 return p.lower().endswith(tuple(extensions))

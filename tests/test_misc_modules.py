@@ -265,6 +265,36 @@ class TestGeometric:
         with pytest.raises(ValueError, match="eids"):
             G.sample_neighbors(row, colptr, one, return_eids=True)
 
+    def test_host_seed_stream_survives_loader_threads(self):
+        """next_host_seed state is process-global: the DataLoader's
+        producer thread must continue the user's seeded stream, not
+        restart an unseeded thread-local one (regression)."""
+        from paddle_tpu import geometric as G
+        from paddle_tpu.io import DataLoader, Dataset
+
+        row = np.array([1, 2, 0, 2, 3, 0], np.int64)
+        colptr = np.array([0, 2, 5, 5, 6], np.int64)
+
+        class SamplingDS(Dataset):
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, idx):
+                nbr, _c = G.sample_neighbors(
+                    paddle.to_tensor(row), paddle.to_tensor(colptr),
+                    paddle.to_tensor(np.array([0, 1], np.int64)),
+                    sample_size=1)
+                return nbr.numpy()
+
+        def run():
+            paddle.seed(21)
+            out = []
+            for batch in DataLoader(SamplingDS(), batch_size=2):
+                out.append(np.asarray(batch).ravel().tolist())
+            return out
+
+        assert run() == run()
+
     def test_weighted_sample_neighbors(self):
         from paddle_tpu import geometric as G
         row = paddle.to_tensor(np.array([1, 2, 0, 2, 3, 0], np.int64))
@@ -303,6 +333,81 @@ class TestAudio:
         assert mel.shape[0] == 32
         mf = MFCC(sr=16000, n_mfcc=13, n_fft=512, n_mels=32)(sig)
         assert mf.shape[0] == 13
+
+    def test_wav_save_load_info_roundtrip(self, tmp_path):
+        import paddle_tpu.audio as audio
+        t = np.arange(1600, dtype=np.float32) / 1600
+        wav = (0.5 * np.sin(2 * np.pi * 440 * t)).astype(np.float32)
+        p = tmp_path / "t.wav"
+        audio.save(str(p), paddle.to_tensor(wav[None]), 16000)
+        meta = audio.info(str(p))
+        assert (meta.sample_rate, meta.num_channels,
+                meta.num_samples, meta.bits_per_sample) == (
+            16000, 1, 1600, 16)
+        back, sr = audio.load(str(p))
+        assert sr == 16000 and tuple(back.shape) == (1, 1600)
+        np.testing.assert_allclose(back.numpy()[0], wav, atol=2e-4)
+        # frame windowing
+        part, _ = audio.load(str(p), frame_offset=100, num_frames=50)
+        np.testing.assert_allclose(part.numpy()[0],
+                                   back.numpy()[0][100:150], atol=1e-7)
+        assert audio.backends.list_available_backends() == [
+            "wave_backend"]
+        with pytest.raises(NotImplementedError):
+            audio.backends.set_backend("soundfile")
+        # caller-provided file objects stay open (caller owns them)
+        with open(p, "rb") as fh:
+            audio.info(fh)
+            assert not fh.closed
+            fh.seek(0)
+            audio.load(fh)
+            assert not fh.closed
+
+    def _fake_tess(self, tmp_path, n=10):
+        import paddle_tpu.audio as audio
+        d = tmp_path / "TESS_Toronto_emotional_speech_set"
+        d.mkdir()
+        emotions = ["angry", "happy", "sad", "neutral", "fear"]
+        for i in range(n):
+            wav = np.full(800, 0.01 * (i + 1), np.float32)
+            audio.save(str(d / f"OAF_word{i}_{emotions[i % 5]}.wav"),
+                       paddle.to_tensor(wav[None]), 16000)
+        return tmp_path
+
+    def test_tess_folds_and_features(self, tmp_path):
+        from paddle_tpu.audio.datasets import TESS
+        root = str(self._fake_tess(tmp_path))
+        train = TESS(mode="train", n_folds=5, split=1, root=root)
+        dev = TESS(mode="dev", n_folds=5, split=1, root=root)
+        assert len(train) == 8 and len(dev) == 2     # round-robin folds
+        x, label = train[0]
+        assert x.numpy().ndim == 1 and 0 <= label < 7
+        # front-end feature path
+        mel = TESS(mode="dev", n_folds=5, split=1, root=root,
+                   feat_type="melspectrogram", n_fft=256, n_mels=16)
+        feat, _ = mel[0]
+        assert feat.shape[0] == 16
+
+    def test_esc50_meta_split(self, tmp_path):
+        import paddle_tpu.audio as audio
+        from paddle_tpu.audio.datasets import ESC50
+        base = tmp_path / "ESC-50-master"
+        (base / "meta").mkdir(parents=True)
+        (base / "audio").mkdir()
+        rows = ["filename,fold,target,category,esc10,src_file,take"]
+        for i in range(10):
+            name = f"clip{i}.wav"
+            fold = i % 5 + 1
+            rows.append(f"{name},{fold},{i % 50},cat,False,x,A")
+            audio.save(str(base / "audio" / name),
+                       paddle.to_tensor(
+                           np.zeros(160, np.float32)[None]), 8000)
+        (base / "meta" / "esc50.csv").write_text("\n".join(rows) + "\n")
+        train = ESC50(mode="train", split=1, root=str(tmp_path))
+        dev = ESC50(mode="dev", split=1, root=str(tmp_path))
+        assert len(train) == 8 and len(dev) == 2
+        x, label = dev[0]
+        assert x.numpy().shape == (160,) and isinstance(label, int)
 
 
 class TestText:
