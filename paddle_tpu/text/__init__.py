@@ -2,10 +2,10 @@
 
 Reference analog: python/paddle/text/ (dataset downloaders for Conll05,
 Imdb, Imikolov, Movielens, UCIHousing, WMT14/16) plus the text decoding
-ops (viterbi_decode in paddle.text.viterbi_decode / ops). The reference
-datasets are thin downloaders over external corpora — no egress here, so
-`datasets` raises a pointed error; the compute pieces (viterbi decode for
-CRF models) are real.
+ops (viterbi_decode in paddle.text.viterbi_decode / ops). The dataset
+classes (text/datasets.py here) read the reference archive formats from
+local paths; the compute pieces (viterbi decode for CRF models) are
+jax ops.
 """
 from __future__ import annotations
 
@@ -15,7 +15,8 @@ import jax.numpy as jnp
 from ..framework.dispatch import apply
 
 __all__ = ["viterbi_decode", "ViterbiDecoder", "datasets",
-           "FasterTokenizer"]
+           "FasterTokenizer", "Imdb", "Imikolov", "UCIHousing",
+           "Movielens", "WMT14", "WMT16", "Conll05st"]
 
 
 def viterbi_decode(potentials, transition_params, lengths=None,
@@ -79,17 +80,7 @@ class ViterbiDecoder:
                               self.include_bos_eos_tag)
 
 
-class _DatasetsStub:
-    _MSG = ("paddle_tpu.text.datasets ({name}) are thin downloaders over "
-            "external corpora in the reference; this environment has no "
-            "network egress. Load your corpus with numpy/paddle_tpu.io."
-            "Dataset instead.")
-
-    def __getattr__(self, name):
-        raise NotImplementedError(self._MSG.format(name=name))
-
-
-datasets = _DatasetsStub()
-
-
+from . import datasets  # noqa: E402,F401
+from .datasets import (  # noqa: E402,F401
+    Imdb, Imikolov, UCIHousing, Movielens, WMT14, WMT16, Conll05st)
 from .tokenizer import FasterTokenizer  # noqa: E402,F401

@@ -1,0 +1,578 @@
+"""Text datasets (reference python/paddle/text/datasets/ — imdb.py:31,
+imikolov.py:29, uci_housing.py:42, movielens.py:96, wmt14.py:40,
+wmt16.py:40, conll05.py:39).
+
+The reference downloads each corpus; with no egress these classes read
+the SAME archive formats from local paths (`data_file=`/`root=`), with
+parsing, vocabulary construction and id assignment mirroring the
+reference so models trained against it see identical inputs."""
+from __future__ import annotations
+
+import collections
+import gzip
+import os
+import re
+import string
+import tarfile
+import zipfile
+from typing import List
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["Imdb", "Imikolov", "UCIHousing", "Movielens", "WMT14",
+           "WMT16", "Conll05st"]
+
+
+def _need(data_file, name, what="data_file"):
+    if data_file is None:
+        raise NotImplementedError(
+            f"{name} download needs network egress; pass {what} pointing "
+            f"at the local archive (reference layout)")
+
+
+# ---------------------------------------------------------------- Imdb
+class Imdb(Dataset):
+    """reference imdb.py:31 — aclImdb sentiment; ad-hoc tokenization
+    (strip punctuation, lowercase), vocabulary over BOTH splits with
+    freq>cutoff, '<unk>' last; pos label 0, neg label 1."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=True):
+        _need(data_file, "Imdb")
+        self.data_file = data_file
+        self.mode = mode
+        self.word_idx = self._build_word_dict(cutoff)
+        self._load_anno()
+
+    def _tokenize(self, pattern):
+        # same ad-hoc tokenization as the reference (imdb.py:112); tokens
+        # decoded to str (the reference leaves bytes keys in word_idx —
+        # an artifact, not a behavior)
+        docs = []
+        with tarfile.open(self.data_file) as tf:
+            m = tf.next()
+            while m is not None:
+                if pattern.match(m.name):
+                    raw = (tf.extractfile(m).read().rstrip(b"\n\r")
+                           .translate(None,
+                                      string.punctuation.encode("latin-1"))
+                           .lower().split())
+                    docs.append([w.decode("latin-1") for w in raw])
+                m = tf.next()
+        return docs
+
+    def _build_word_dict(self, cutoff):
+        freq = collections.defaultdict(int)
+        pat = re.compile(r"aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$")
+        for doc in self._tokenize(pat):
+            for w in doc:
+                freq[w] += 1
+        kept = sorted(((w, c) for w, c in freq.items() if c > cutoff),
+                      key=lambda x: (-x[1], x[0]))
+        word_idx = {w: i for i, (w, _c) in enumerate(kept)}
+        word_idx["<unk>"] = len(word_idx)
+        return word_idx
+
+    def _load_anno(self):
+        unk = self.word_idx["<unk>"]
+        self.docs, self.labels = [], []
+        for label, sub in ((0, "pos"), (1, "neg")):
+            pat = re.compile(rf"aclImdb/{self.mode}/{sub}/.*\.txt$")
+            for doc in self._tokenize(pat):
+                self.docs.append([self.word_idx.get(w, unk) for w in doc])
+                self.labels.append(label)
+
+    def __getitem__(self, idx):
+        return np.array(self.docs[idx]), np.array([self.labels[idx]])
+
+    def __len__(self):
+        return len(self.docs)
+
+
+# ------------------------------------------------------------ Imikolov
+class Imikolov(Dataset):
+    """reference imikolov.py:29 — PTB language modelling; NGRAM windows
+    or SEQ (src, trg) pairs; vocab from train+valid with freq >
+    min_word_freq, '<s>'/'<e>' counted per line, '<unk>' last."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=-1,
+                 mode="train", min_word_freq=50, download=True):
+        _need(data_file, "Imikolov")
+        assert data_type.upper() in ("NGRAM", "SEQ"), (
+            f"data_type should be 'NGRAM' or 'SEQ', but got {data_type}")
+        self.data_file = data_file
+        self.data_type = data_type.upper()
+        self.window_size = window_size
+        self.mode = {"train": "train", "test": "valid"}.get(mode, mode)
+        self.min_word_freq = min_word_freq
+        self.word_idx = self._build_word_dict()
+        self._load_anno()
+
+    @staticmethod
+    def _word_count(f, freq):
+        for line in f:
+            for w in line.strip().split():
+                freq[w] += 1
+            freq[b"<s>"] += 1
+            freq[b"<e>"] += 1
+        return freq
+
+    def _build_word_dict(self):
+        with tarfile.open(self.data_file) as tf:
+            freq = collections.defaultdict(int)
+            self._word_count(
+                tf.extractfile("./simple-examples/data/ptb.train.txt"),
+                freq)
+            self._word_count(
+                tf.extractfile("./simple-examples/data/ptb.valid.txt"),
+                freq)
+        freq.pop(b"<unk>", None)
+        kept = sorted(((w, c) for w, c in freq.items()
+                       if c > self.min_word_freq),
+                      key=lambda x: (-x[1], x[0]))
+        word_idx = {w.decode(): i for i, (w, _c) in enumerate(kept)}
+        word_idx["<unk>"] = len(word_idx)
+        return word_idx
+
+    def _load_anno(self):
+        self.data = []
+        unk = self.word_idx["<unk>"]
+        with tarfile.open(self.data_file) as tf:
+            f = tf.extractfile(
+                f"./simple-examples/data/ptb.{self.mode}.txt")
+            for line in f:
+                words = line.decode().strip().split()
+                if self.data_type == "NGRAM":
+                    assert self.window_size > -1, "Invalid gram length"
+                    seq = ["<s>"] + words + ["<e>"]
+                    if len(seq) >= self.window_size:
+                        ids = [self.word_idx.get(w, unk) for w in seq]
+                        for i in range(self.window_size, len(ids) + 1):
+                            self.data.append(
+                                tuple(ids[i - self.window_size:i]))
+                else:
+                    ids = [self.word_idx.get(w, unk) for w in words]
+                    src = [self.word_idx.get("<s>", unk)] + ids
+                    trg = ids + [self.word_idx.get("<e>", unk)]
+                    if 0 < self.window_size < len(src):
+                        continue
+                    self.data.append((src, trg))
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+# ---------------------------------------------------------- UCIHousing
+class UCIHousing(Dataset):
+    """reference uci_housing.py:42 — 13 features + price; per-feature
+    (x-avg)/(max-min) normalization computed over the WHOLE file, 80/20
+    train/test split in file order."""
+
+    def __init__(self, data_file=None, mode="train", download=True):
+        _need(data_file, "UCIHousing")
+        self.mode = mode
+        data = np.fromfile(data_file, sep=" ")
+        data = data.reshape(-1, 14)
+        mx, mn, avg = data.max(0), data.min(0), data.mean(0)
+        for i in range(13):
+            data[:, i] = (data[:, i] - avg[i]) / (mx[i] - mn[i])
+        offset = int(data.shape[0] * 0.8)
+        self.data = data[:offset] if mode == "train" else data[offset:]
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return (row[:-1].astype(np.float32),
+                row[-1:].astype(np.float32))
+
+    def __len__(self):
+        return len(self.data)
+
+
+# ----------------------------------------------------------- Movielens
+class MovieInfo:
+    """reference movielens.py:31."""
+
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self, categories_dict, movie_title_dict):
+        return [
+            [self.index],
+            [categories_dict[c] for c in self.categories],
+            [movie_title_dict[w.lower()] for w in self.title.split()],
+        ]
+
+
+class UserInfo:
+    """reference movielens.py:62 — gender M=0/F=1, age bucketed by the
+    fixed [1,18,25,35,45,50,56] table."""
+
+    AGES = [1, 18, 25, 35, 45, 50, 56]
+
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = self.AGES.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [[self.index], [0 if self.is_male else 1], [self.age],
+                [self.job_id]]
+
+
+class Movielens(Dataset):
+    """reference movielens.py:96 — ml-1m zip; rating rescaled to
+    r*2-5; random train/test split with test_ratio using numpy's global
+    RandomState (seed via paddle_tpu.seed is NOT wired in the reference
+    either — it uses np.random.random per line)."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=True):
+        _need(data_file, "Movielens")
+        self.data_file = data_file
+        self.mode = mode
+        self.test_ratio = test_ratio
+        np.random.seed(rand_seed)
+        self._load_meta_info()
+        self._load_data()
+
+    def _load_meta_info(self):
+        pat = re.compile(r"^(.*)\((\d+)\)$")
+        self.movie_info, self.user_info = {}, {}
+        title_words, categories = set(), set()
+        with zipfile.ZipFile(self.data_file) as z:
+            with z.open("ml-1m/movies.dat") as f:
+                for line in f:
+                    mid, title, cats = line.decode("latin").strip() \
+                        .split("::")
+                    cats = cats.split("|")
+                    categories.update(cats)
+                    title = pat.match(title).group(1).strip()
+                    self.movie_info[int(mid)] = MovieInfo(mid, cats, title)
+                    title_words.update(w.lower() for w in title.split())
+            with z.open("ml-1m/users.dat") as f:
+                for line in f:
+                    uid, gender, age, job, _zip = line.decode("latin") \
+                        .strip().split("::")
+                    self.user_info[int(uid)] = UserInfo(uid, gender, age,
+                                                        job)
+        self.movie_title_dict = {w: i for i, w in
+                                 enumerate(sorted(title_words))}
+        self.categories_dict = {c: i for i, c in
+                                enumerate(sorted(categories))}
+
+    def _load_data(self):
+        self.data = []
+        is_test = self.mode == "test"
+        with zipfile.ZipFile(self.data_file) as z:
+            with z.open("ml-1m/ratings.dat") as f:
+                for line in f:
+                    if (np.random.random() < self.test_ratio) != is_test:
+                        continue
+                    uid, mid, rating, _ts = line.decode("latin").strip() \
+                        .split("::")
+                    usr = self.user_info[int(uid)]
+                    mov = self.movie_info[int(mid)]
+                    self.data.append(
+                        usr.value()
+                        + mov.value(self.categories_dict,
+                                    self.movie_title_dict)
+                        + [[float(rating) * 2 - 5.0]])
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+# -------------------------------------------------------------- WMT14
+class WMT14(Dataset):
+    """reference wmt14.py:40 — pre-tokenized en->fr with shipped
+    src.dict/trg.dict; sequences longer than 80 dropped; <s>/<e>/<unk>
+    at indices 0/1/2."""
+
+    START, END, UNK, UNK_IDX = "<s>", "<e>", "<unk>", 2
+
+    def __init__(self, data_file=None, mode="train", dict_size=-1,
+                 download=True):
+        _need(data_file, "WMT14")
+        assert mode.lower() in ("train", "test", "gen"), (
+            f"mode should be 'train', 'test' or 'gen', but got {mode}")
+        self.mode = mode.lower()
+        self.data_file = data_file
+        self.dict_size = dict_size if dict_size > 0 else float("inf")
+        self._load_data()
+
+    def _to_dict(self, fd):
+        out = {}
+        for i, line in enumerate(fd):
+            if i >= self.dict_size:
+                break
+            out[line.strip().decode()] = i
+        return out
+
+    def _load_data(self):
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        with tarfile.open(self.data_file) as tf:
+            names = [m.name for m in tf if m.name.endswith("src.dict")]
+            assert len(names) == 1
+            self.src_dict = self._to_dict(tf.extractfile(names[0]))
+            names = [m.name for m in tf if m.name.endswith("trg.dict")]
+            assert len(names) == 1
+            self.trg_dict = self._to_dict(tf.extractfile(names[0]))
+            suffix = f"{self.mode}/{self.mode}"
+            for name in [m.name for m in tf if m.name.endswith(suffix)]:
+                for line in tf.extractfile(name):
+                    parts = line.decode().strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    src = [self.src_dict.get(w, self.UNK_IDX) for w in
+                           [self.START] + parts[0].split() + [self.END]]
+                    trg = [self.trg_dict.get(w, self.UNK_IDX)
+                           for w in parts[1].split()]
+                    if len(src) > 80 or len(trg) > 80:
+                        continue
+                    self.trg_ids_next.append(trg + [self.trg_dict[
+                        self.END]])
+                    self.trg_ids.append([self.trg_dict[self.START]] + trg)
+                    self.src_ids.append(src)
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]), np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)
+
+    def get_dict(self, reverse=False):
+        if reverse:
+            return ({v: k for k, v in self.src_dict.items()},
+                    {v: k for k, v in self.trg_dict.items()})
+        return self.src_dict, self.trg_dict
+
+
+# -------------------------------------------------------------- WMT16
+class WMT16(Dataset):
+    """reference wmt16.py:40 — en<->de; vocabulary built from the train
+    split by frequency with <s>/<e>/<unk> at 0/1/2 (built in memory —
+    the reference caches the same ordering to a dict file)."""
+
+    START, END, UNK = "<s>", "<e>", "<unk>"
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang="en", download=True):
+        _need(data_file, "WMT16")
+        assert mode.lower() in ("train", "test", "val"), (
+            f"mode should be 'train', 'test' or 'val', but got {mode}")
+        assert src_dict_size > 0 and trg_dict_size > 0, (
+            "dict_size should be set as positive number")
+        self.mode = mode.lower()
+        self.data_file = data_file
+        self.lang = lang
+        # one decompress+scan of the train split counts BOTH columns
+        # (building each vocab separately would re-read the gzip'd tar)
+        en_freq, de_freq = self._count_train()
+        src_freq = en_freq if lang == "en" else de_freq
+        trg_freq = de_freq if lang == "en" else en_freq
+        self.src_dict = self._build_dict(src_freq, src_dict_size)
+        self.trg_dict = self._build_dict(trg_freq, trg_dict_size)
+        self._load_data()
+
+    def _count_train(self):
+        en = collections.defaultdict(int)
+        de = collections.defaultdict(int)
+        with tarfile.open(self.data_file) as tf:
+            for line in tf.extractfile("wmt16/train"):
+                parts = line.decode().strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                for w in parts[0].split():
+                    en[w] += 1
+                for w in parts[1].split():
+                    de[w] += 1
+        return en, de
+
+    def _build_dict(self, freq, dict_size):
+        words = [self.START, self.END, self.UNK]
+        for w, _c in sorted(freq.items(), key=lambda x: x[1],
+                            reverse=True):
+            if len(words) == dict_size:
+                break
+            words.append(w)
+        return {w: i for i, w in enumerate(words)}
+
+    def _load_data(self):
+        start_id = self.src_dict[self.START]
+        end_id = self.src_dict[self.END]
+        unk_id = self.src_dict[self.UNK]
+        src_col = 0 if self.lang == "en" else 1
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        with tarfile.open(self.data_file) as tf:
+            for line in tf.extractfile(f"wmt16/{self.mode}"):
+                parts = line.decode().strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                src = ([start_id]
+                       + [self.src_dict.get(w, unk_id)
+                          for w in parts[src_col].split()]
+                       + [end_id])
+                trg = [self.trg_dict.get(w, unk_id)
+                       for w in parts[1 - src_col].split()]
+                self.src_ids.append(src)
+                self.trg_ids_next.append(trg + [end_id])
+                self.trg_ids.append([start_id] + trg)
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]), np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)
+
+    def get_dict(self, lang, reverse=False):
+        d = self.src_dict if lang == self.lang else self.trg_dict
+        return {v: k for k, v in d.items()} if reverse else d
+
+
+# ------------------------------------------------------------ Conll05st
+class Conll05st(Dataset):
+    """reference conll05.py:39 — WSJ-test SRL: bracketed props expanded
+    to BIO tags, one (sentence, predicate, labels) record per verb;
+    __getitem__ adds the 5-word predicate context windows and mark
+    vector."""
+
+    UNK_IDX = 0
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None,
+                 emb_file=None, download=True):
+        _need(data_file, "Conll05st")
+        _need(word_dict_file, "Conll05st", "word_dict_file")
+        _need(verb_dict_file, "Conll05st", "verb_dict_file")
+        _need(target_dict_file, "Conll05st", "target_dict_file")
+        self.data_file = data_file
+        self.emb_file = emb_file
+        self.word_dict = self._load_dict(word_dict_file)
+        self.predicate_dict = self._load_dict(verb_dict_file)
+        self.label_dict = self._load_label_dict(target_dict_file)
+        self._load_anno()
+
+    @staticmethod
+    def _load_dict(path):
+        with open(path) as f:
+            return {line.strip(): i for i, line in enumerate(f)}
+
+    @staticmethod
+    def _load_label_dict(path):
+        tags = set()
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line.startswith(("B-", "I-")):
+                    tags.add(line[2:])
+        d, i = {}, 0
+        for tag in tags:
+            d["B-" + tag] = i
+            d["I-" + tag] = i + 1
+            i += 2
+        d["O"] = i
+        return d
+
+    @staticmethod
+    def _expand_bio(lbl: List[str]) -> List[str]:
+        seq, cur, inside = [], "O", False
+        for l in lbl:
+            if l == "*":
+                seq.append("I-" + cur if inside else "O")
+            elif l == "*)":
+                seq.append("I-" + cur)
+                inside = False
+            elif "(" in l and ")" in l:
+                cur = l[1:l.find("*")]
+                seq.append("B-" + cur)
+                inside = False
+            elif "(" in l:
+                cur = l[1:l.find("*")]
+                seq.append("B-" + cur)
+                inside = True
+            else:
+                raise RuntimeError(f"Unexpected label: {l}")
+        return seq
+
+    def _load_anno(self):
+        self.sentences, self.predicates, self.labels = [], [], []
+        with tarfile.open(self.data_file) as tf:
+            wf = tf.extractfile(
+                "conll05st-release/test.wsj/words/test.wsj.words.gz")
+            pf = tf.extractfile(
+                "conll05st-release/test.wsj/props/test.wsj.props.gz")
+            with gzip.GzipFile(fileobj=wf) as words, \
+                    gzip.GzipFile(fileobj=pf) as props:
+                sentence, cols = [], []
+                for word, prop in zip(words, props):
+                    word = word.strip().decode()
+                    prop = prop.strip().decode().split()
+                    if prop:
+                        sentence.append(word)
+                        cols.append(prop)
+                        continue
+                    # end of sentence: column 0 is the verbs, columns
+                    # 1.. are one bracketed tag sequence per verb
+                    if cols:
+                        seqs = list(zip(*cols))
+                        verbs = [v for v in seqs[0] if v != "-"]
+                        for i, lbl in enumerate(seqs[1:]):
+                            self.sentences.append(sentence)
+                            self.predicates.append(verbs[i])
+                            self.labels.append(
+                                self._expand_bio(list(lbl)))
+                    sentence, cols = [], []
+
+    def __getitem__(self, idx):
+        sentence = self.sentences[idx]
+        labels = self.labels[idx]
+        n = len(sentence)
+        v = labels.index("B-V")
+        mark = [0] * n
+
+        def ctx(off, fallback):
+            j = v + off
+            if 0 <= j < n:
+                mark[j] = 1
+                return sentence[j]
+            return fallback
+
+        ctx_n2 = ctx(-2, "bos")
+        ctx_n1 = ctx(-1, "bos")
+        ctx_0 = ctx(0, None)
+        ctx_p1 = ctx(1, "eos")
+        ctx_p2 = ctx(2, "eos")
+
+        wd = self.word_dict
+        word_idx = [wd.get(w, self.UNK_IDX) for w in sentence]
+        rep = lambda w: [wd.get(w, self.UNK_IDX)] * n  # noqa: E731
+        pred_idx = [self.predicate_dict.get(self.predicates[idx])] * n
+        label_idx = [self.label_dict.get(w) for w in labels]
+        return (np.array(word_idx), np.array(rep(ctx_n2)),
+                np.array(rep(ctx_n1)), np.array(rep(ctx_0)),
+                np.array(rep(ctx_p1)), np.array(rep(ctx_p2)),
+                np.array(pred_idx), np.array(mark), np.array(label_idx))
+
+    def __len__(self):
+        return len(self.sentences)
+
+    def get_dict(self):
+        return self.word_dict, self.predicate_dict, self.label_dict
+
+    def get_embedding(self):
+        """reference conll05.py:344 — path of the embedding file as
+        passed in (the reference returns the downloaded path)."""
+        return self.emb_file

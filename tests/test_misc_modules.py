@@ -450,10 +450,14 @@ class TestText:
         np.testing.assert_allclose(s_pad.numpy(), s_ref.numpy(), rtol=1e-5)
         np.testing.assert_array_equal(p_pad.numpy()[:, :3], p_ref.numpy())
 
-    def test_datasets_raise_pointedly(self):
+    def test_datasets_need_local_archives(self):
+        # the dataset classes are real parsers now; constructing without
+        # a local archive still raises the pointed egress error
         from paddle_tpu import text
         with pytest.raises(NotImplementedError, match="egress"):
-            text.datasets.Imdb
+            text.datasets.Imdb()
+        with pytest.raises(NotImplementedError, match="egress"):
+            text.Imikolov()
 
 
 class TestUtilsIncubate:

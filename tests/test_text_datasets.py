@@ -1,0 +1,224 @@
+"""Text dataset parsers against synthetic archives in the reference's
+exact layouts (reference test discipline: test/legacy_test/test_datasets
+builds tiny fixtures rather than downloading)."""
+import gzip
+import io
+import os
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle  # noqa: F401  (conftest pins the CPU mesh)
+from paddle_tpu.text import datasets as D
+
+
+def _add_bytes(tf, name, data):
+    ti = tarfile.TarInfo(name)
+    ti.size = len(data)
+    tf.addfile(ti, io.BytesIO(data))
+
+
+class TestImdb:
+    def _archive(self, tmp_path):
+        p = tmp_path / "aclImdb_v1.tar.gz"
+        reviews = {
+            "aclImdb/train/pos/0.txt": b"good great good film!",
+            "aclImdb/train/neg/0.txt": b"bad, awful film.",
+            "aclImdb/test/pos/0.txt": b"great good",
+            "aclImdb/test/neg/0.txt": b"awful bad bad",
+        }
+        with tarfile.open(p, "w:gz") as tf:
+            for name, data in reviews.items():
+                _add_bytes(tf, name, data)
+        return str(p)
+
+    def test_vocab_and_labels(self, tmp_path):
+        ds = D.Imdb(data_file=self._archive(tmp_path), mode="train",
+                    cutoff=1)
+        # freq>1 over both splits: good(4) great(2) bad(3) film(2) awful(2)
+        assert set(ds.word_idx) == {"good", "bad", "great", "awful",
+                                    "film", "<unk>"}
+        # freqs: good 3, bad 3, great 2, awful 2, film 2; ties sort
+        # alphabetically -> bad, good, awful, film, great
+        assert ds.word_idx["bad"] == 0 and ds.word_idx["good"] == 1
+        assert ds.word_idx["<unk>"] == 5
+        assert len(ds) == 2
+        doc, label = ds[0]
+        assert label.tolist() == [0]          # pos first
+        assert doc.tolist() == [ds.word_idx["good"], ds.word_idx["great"],
+                                ds.word_idx["good"], ds.word_idx["film"]]
+
+
+class TestImikolov:
+    def _archive(self, tmp_path):
+        p = tmp_path / "simple-examples.tgz"
+        train = b"a b c\nb c d\n"
+        valid = b"a b\n"
+        with tarfile.open(p, "w:gz") as tf:
+            _add_bytes(tf, "./simple-examples/data/ptb.train.txt", train)
+            _add_bytes(tf, "./simple-examples/data/ptb.valid.txt", valid)
+        return str(p)
+
+    def test_ngram_windows(self, tmp_path):
+        ds = D.Imikolov(data_file=self._archive(tmp_path),
+                        data_type="NGRAM", window_size=2, mode="train",
+                        min_word_freq=0)
+        # every line becomes <s> w.. <e>; window=2 -> len+1 pairs per line
+        assert len(ds) == 4 + 4
+        first = ds[0]
+        assert len(first) == 2
+
+    def test_seq_pairs(self, tmp_path):
+        ds = D.Imikolov(data_file=self._archive(tmp_path),
+                        data_type="SEQ", mode="test", min_word_freq=0)
+        src, trg = ds[0]
+        # src starts with <s>, trg ends with <e>, shifted by one
+        assert src[0] == ds.word_idx["<s>"]
+        assert trg[-1] == ds.word_idx["<e>"]
+        assert src[1:].tolist() == trg[:-1].tolist()
+
+
+class TestUCIHousing:
+    def test_normalization_and_split(self, tmp_path):
+        rng = np.random.RandomState(0)
+        rows = rng.rand(10, 14) * 10
+        p = tmp_path / "housing.data"
+        with open(p, "w") as f:
+            for r in rows:
+                f.write(" ".join(f"{v:.6f}" for v in r) + "\n")
+        tr = D.UCIHousing(data_file=str(p), mode="train")
+        te = D.UCIHousing(data_file=str(p), mode="test")
+        assert len(tr) == 8 and len(te) == 2
+        x, y = tr[0]
+        assert x.shape == (13,) and y.shape == (1,)
+        # feature normalization: (x - mean) / (max - min) over all rows
+        exp = (rows[0, 0] - rows[:, 0].mean()) / (
+            rows[:, 0].max() - rows[:, 0].min())
+        np.testing.assert_allclose(x[0], exp, rtol=1e-5)
+        # target column is NOT normalized
+        np.testing.assert_allclose(y[0], rows[0, 13], rtol=1e-5)
+
+
+class TestMovielens:
+    def _archive(self, tmp_path):
+        p = tmp_path / "ml-1m.zip"
+        movies = ("1::Toy Story (1995)::Animation|Comedy\n"
+                  "2::Heat (1995)::Action\n").encode("latin")
+        users = ("1::M::25::4::90210\n"
+                 "2::F::35::7::10021\n").encode("latin")
+        ratings = ("1::1::5::978300760\n"
+                   "1::2::3::978300761\n"
+                   "2::1::4::978300762\n").encode("latin")
+        with zipfile.ZipFile(p, "w") as z:
+            z.writestr("ml-1m/movies.dat", movies)
+            z.writestr("ml-1m/users.dat", users)
+            z.writestr("ml-1m/ratings.dat", ratings)
+        return str(p)
+
+    def test_records(self, tmp_path):
+        ds = D.Movielens(data_file=self._archive(tmp_path), mode="train",
+                         test_ratio=0.0)
+        assert len(ds) == 3
+        rec = ds[0]
+        # uid, gender, age, job, mov_id, categories, title, rating
+        assert len(rec) == 8
+        uid, gender, age, job, mid, cats, title, rating = rec
+        assert uid.tolist() == [1] and gender.tolist() == [0]
+        assert age.tolist() == [2]            # bucket index of 25
+        assert mid.tolist() == [1]
+        assert len(cats) == 2                 # Animation|Comedy
+        assert rating.tolist() == [5.0]       # 5*2-5
+
+
+class TestWMT14:
+    def _archive(self, tmp_path):
+        p = tmp_path / "wmt14.tgz"
+        src_dict = b"<s>\n<e>\n<unk>\nhello\nworld\n"
+        trg_dict = b"<s>\n<e>\n<unk>\nbonjour\nmonde\n"
+        train = b"hello world\tbonjour monde\nhello\tbonjour\n"
+        with tarfile.open(p, "w:gz") as tf:
+            _add_bytes(tf, "wmt14/src.dict", src_dict)
+            _add_bytes(tf, "wmt14/trg.dict", trg_dict)
+            _add_bytes(tf, "wmt14/train/train", train)
+        return str(p)
+
+    def test_ids_and_shift(self, tmp_path):
+        ds = D.WMT14(data_file=self._archive(tmp_path), mode="train",
+                     dict_size=5)
+        assert len(ds) == 2
+        src, trg, trg_next = ds[0]
+        # <s> hello world <e>
+        assert src.tolist() == [0, 3, 4, 1]
+        assert trg.tolist() == [0, 3, 4]
+        assert trg_next.tolist() == [3, 4, 1]
+        d_src, _d_trg = ds.get_dict()
+        assert d_src["hello"] == 3
+
+
+class TestWMT16:
+    def _archive(self, tmp_path):
+        p = tmp_path / "wmt16.tar.gz"
+        train = (b"a b a\tx y\n" b"b a\ty\n")
+        val = b"a\tx\n"
+        with tarfile.open(p, "w:gz") as tf:
+            _add_bytes(tf, "wmt16/train", train)
+            _add_bytes(tf, "wmt16/val", val)
+        return str(p)
+
+    def test_vocab_by_frequency(self, tmp_path):
+        ds = D.WMT16(data_file=self._archive(tmp_path), mode="val",
+                     src_dict_size=5, trg_dict_size=5, lang="en")
+        # en vocab: specials then a(3) b(2)
+        assert ds.src_dict["<s>"] == 0 and ds.src_dict["<e>"] == 1
+        assert ds.src_dict["a"] == 3 and ds.src_dict["b"] == 4
+        src, trg, trg_next = ds[0]
+        assert src.tolist() == [0, 3, 1]      # <s> a <e>
+        assert trg[0] == 0 and trg_next[-1] == 1
+        # reversed direction swaps columns
+        ds_de = D.WMT16(data_file=self._archive(tmp_path), mode="val",
+                        src_dict_size=5, trg_dict_size=5, lang="de")
+        src_de, _t, _tn = ds_de[0]
+        assert src_de.tolist() == [0, ds_de.src_dict["x"], 1]
+
+
+class TestConll05:
+    def _fixture(self, tmp_path):
+        # two-word sentence, one predicate "eat"
+        words = b"John\neat\n\n"
+        props = b"-  (A0*)\neat  (V*)\n\n"
+        wbuf, pbuf = io.BytesIO(), io.BytesIO()
+        with gzip.GzipFile(fileobj=wbuf, mode="wb") as g:
+            g.write(words)
+        with gzip.GzipFile(fileobj=pbuf, mode="wb") as g:
+            g.write(props)
+        p = tmp_path / "conll05st-tests.tar.gz"
+        with tarfile.open(p, "w:gz") as tf:
+            _add_bytes(tf,
+                       "conll05st-release/test.wsj/words/"
+                       "test.wsj.words.gz", wbuf.getvalue())
+            _add_bytes(tf,
+                       "conll05st-release/test.wsj/props/"
+                       "test.wsj.props.gz", pbuf.getvalue())
+        (tmp_path / "words.dict").write_text("John\neat\n")
+        (tmp_path / "verbs.dict").write_text("eat\n")
+        (tmp_path / "targets.dict").write_text("B-A0\nI-A0\nB-V\nI-V\nO\n")
+        return p
+
+    def test_bio_expansion_and_context(self, tmp_path):
+        p = self._fixture(tmp_path)
+        ds = D.Conll05st(data_file=str(p),
+                         word_dict_file=str(tmp_path / "words.dict"),
+                         verb_dict_file=str(tmp_path / "verbs.dict"),
+                         target_dict_file=str(tmp_path / "targets.dict"))
+        assert len(ds) == 1
+        (word_idx, c_n2, c_n1, c_0, c_p1, c_p2, pred, mark,
+         label_idx) = ds[0]
+        assert word_idx.tolist() == [0, 1]
+        assert pred.tolist() == [0, 0]
+        assert mark.tolist() == [1, 1]        # ctx window covers both
+        labels = ds.labels[0]
+        assert labels == ["B-A0", "B-V"]
+        wd, pd, ld = ds.get_dict()
+        assert label_idx.tolist() == [ld["B-A0"], ld["B-V"]]
