@@ -24,6 +24,12 @@ __all__ = [
     "Beta", "Dirichlet", "Gamma", "Exponential", "Laplace", "LogNormal",
     "Gumbel", "Geometric", "Cauchy", "Multinomial", "kl_divergence",
     "register_kl",
+    # transforms + wrappers (imported at the module tail)
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+    "TransformedDistribution", "Independent",
 ]
 
 
@@ -478,3 +484,19 @@ def _kl_unif_unif(p, q):
 @register_kl(Exponential, Exponential)
 def _kl_exp_exp(p, q):
     return _t(jnp.log(p.rate) - jnp.log(q.rate) + q.rate / p.rate - 1.0)
+
+
+# -------------------------------------------------------------------------
+# Transforms + transformed/independent distributions (reference
+# distribution/transform.py, transformed_distribution.py, independent.py)
+# -------------------------------------------------------------------------
+from . import constraint  # noqa: E402,F401
+from . import variable  # noqa: E402,F401
+from . import transform  # noqa: E402,F401
+from .transform import (  # noqa: E402,F401
+    Transform, AbsTransform, AffineTransform, ChainTransform,
+    ExpTransform, IndependentTransform, PowerTransform, ReshapeTransform,
+    SigmoidTransform, SoftmaxTransform, StackTransform,
+    StickBreakingTransform, TanhTransform)
+from .transformed_distribution import (  # noqa: E402,F401
+    TransformedDistribution, Independent)

@@ -1,0 +1,42 @@
+"""Value constraints (reference
+python/paddle/distribution/constraint.py:17 — Constraint/Real/Range/
+Positive/Simplex predicate objects used by the transform
+domain/codomain machinery)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class Constraint:
+    def __call__(self, value):
+        raise NotImplementedError
+
+
+class Real(Constraint):
+    def __call__(self, value):
+        return value == value
+
+
+class Range(Constraint):
+    def __init__(self, lower, upper):
+        self._lower = lower
+        self._upper = upper
+
+    def __call__(self, value):
+        return (self._lower <= value) & (value <= self._upper)
+
+
+class Positive(Constraint):
+    def __call__(self, value):
+        return value >= 0.0
+
+
+class Simplex(Constraint):
+    def __call__(self, value):
+        return ((value >= 0).all(-1)
+                & (jnp.abs(value.sum(-1) - 1.0) < 1e-6))
+
+
+real = Real()
+positive = Positive()
+simplex = Simplex()

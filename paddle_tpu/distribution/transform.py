@@ -1,0 +1,587 @@
+"""Random-variable transforms (reference
+python/paddle/distribution/transform.py:59 — Transform +
+Abs/Affine/Chain/Exp/Independent/Power/Reshape/Sigmoid/Softmax/Stack/
+StickBreaking/Tanh; the change-of-variables machinery behind
+TransformedDistribution).
+
+TPU-native: every forward/inverse/log-det is a pure jnp expression, so
+transforms compose under jit/vmap/grad like any other op here."""
+from __future__ import annotations
+
+import enum
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from . import constraint
+from . import variable
+
+__all__ = [
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform", "Type",
+]
+
+
+class Type(enum.Enum):
+    """reference transform.py:45 — injectivity classes."""
+    BIJECTION = "bijection"
+    INJECTION = "injection"
+    SURJECTION = "surjection"
+    OTHER = "other"
+
+    @classmethod
+    def is_injective(cls, t):
+        return t in (cls.BIJECTION, cls.INJECTION)
+
+
+# the package-level value/Tensor helpers (distribution/__init__.py:30):
+# transform.py is imported at the tail of __init__, after they exist —
+# sharing them keeps scalar-arg dtype coercion (float32) identical
+# between transforms and distributions
+from . import _t, _v  # noqa: E402
+
+
+def _sum_rightmost(value, n):
+    return value.sum(tuple(range(-n, 0))) if n > 0 else value
+
+
+class Transform:
+    """reference transform.py:59. Subclasses implement _forward,
+    _inverse, _forward_log_det_jacobian (and _forward_shape/
+    _inverse_shape when the event shape changes)."""
+
+    _type = Type.INJECTION
+
+    def _is_injective(self):
+        return Type.is_injective(self._type)
+
+    @property
+    def _domain(self):
+        return variable.real
+
+    @property
+    def _codomain(self):
+        return variable.real
+
+    def __call__(self, input):
+        from .transformed_distribution import TransformedDistribution
+        from . import Distribution
+        if isinstance(input, Distribution):
+            return TransformedDistribution(input, [self])
+        if isinstance(input, Transform):
+            return ChainTransform([self, input])
+        return self.forward(input)
+
+    def forward(self, x):
+        return _t(self._forward(_v(x)))
+
+    def inverse(self, y):
+        return _t(self._inverse(_v(y)))
+
+    def forward_log_det_jacobian(self, x):
+        if not self._is_injective():
+            raise NotImplementedError(
+                "forward_log_det_jacobian is only defined for injective "
+                "transforms")
+        return _t(self._call_forward_ldj(_v(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        return _t(self._call_inverse_ldj(_v(y)))
+
+    def _call_forward_ldj(self, x):
+        if hasattr(self, "_forward_log_det_jacobian"):
+            return self._forward_log_det_jacobian(x)
+        if hasattr(self, "_inverse_log_det_jacobian"):
+            return -self._inverse_log_det_jacobian(self._forward(x))
+        raise NotImplementedError(
+            "Neither _forward_log_det_jacobian nor "
+            "_inverse_log_det_jacobian is implemented. One of them is "
+            "required.")
+
+    def _call_inverse_ldj(self, y):
+        if hasattr(self, "_inverse_log_det_jacobian"):
+            return self._inverse_log_det_jacobian(y)
+        if hasattr(self, "_forward_log_det_jacobian"):
+            return -self._forward_log_det_jacobian(self._inverse(y))
+        raise NotImplementedError(
+            "Neither _forward_log_det_jacobian nor "
+            "_inverse_log_det_jacobian is implemented. One of them is "
+            "required.")
+
+    def forward_shape(self, shape):
+        return tuple(self._forward_shape(tuple(shape)))
+
+    def inverse_shape(self, shape):
+        return tuple(self._inverse_shape(tuple(shape)))
+
+    def _forward_shape(self, shape):
+        return shape
+
+    def _inverse_shape(self, shape):
+        return shape
+
+
+class AbsTransform(Transform):
+    """y = |x| (reference transform.py:342) — surjective; inverse gives
+    the (-y, y) pre-image pair."""
+
+    _type = Type.SURJECTION
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return -y, y
+
+    def inverse(self, y):
+        neg, pos = self._inverse(_v(y))
+        return _t(neg), _t(pos)
+
+    @property
+    def _codomain(self):
+        return variable.positive
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x (reference transform.py:414)."""
+
+    _type = Type.BIJECTION
+
+    def __init__(self, loc, scale):
+        self._loc = _v(loc)
+        self._scale = _v(scale)
+
+    @property
+    def loc(self):
+        return _t(self._loc)
+
+    @property
+    def scale(self):
+        return _t(self._scale)
+
+    def _forward(self, x):
+        return self._loc + self._scale * x
+
+    def _inverse(self, y):
+        return (y - self._loc) / self._scale
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self._scale)), x.shape)
+
+    def _forward_shape(self, shape):
+        return jnp.broadcast_shapes(shape, self._loc.shape,
+                                    self._scale.shape)
+
+    _inverse_shape = _forward_shape
+
+
+class ChainTransform(Transform):
+    """Function composition t_n ∘ ... ∘ t_1 (reference
+    transform.py:496); the log-det sums per-stage contributions with
+    event-rank-aware rightmost reduction."""
+
+    def __init__(self, transforms):
+        if not isinstance(transforms, Sequence) or not all(
+                isinstance(t, Transform) for t in transforms):
+            raise TypeError(
+                "transforms must be a Sequence of Transform")
+        self.transforms = list(transforms)
+
+    def _is_injective(self):
+        return all(t._is_injective() for t in self.transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        value = 0.0
+        event_rank = self._domain.event_rank
+        for t in self.transforms:
+            value = value + _sum_rightmost(
+                t._call_forward_ldj(x),
+                event_rank - t._domain.event_rank)
+            x = t._forward(x)
+            event_rank += (t._codomain.event_rank
+                           - t._domain.event_rank)
+        return value
+
+    def _forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return shape
+
+    def _inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return shape
+
+    @property
+    def _domain(self):
+        # the reference's dynamic-programming lower bound on the input
+        # event rank (transform.py:560)
+        domain = self.transforms[0]._domain
+        event_rank = self.transforms[-1]._codomain.event_rank
+        for t in reversed(self.transforms):
+            event_rank -= t._codomain.event_rank - t._domain.event_rank
+            event_rank = max(event_rank, t._domain.event_rank)
+        return variable.Independent(domain,
+                                    event_rank - domain.event_rank)
+
+    @property
+    def _codomain(self):
+        codomain = self.transforms[-1]._codomain
+        event_rank = self.transforms[0]._domain.event_rank
+        for t in self.transforms:
+            event_rank += t._codomain.event_rank - t._domain.event_rank
+            event_rank = max(event_rank, t._codomain.event_rank)
+        return variable.Independent(codomain,
+                                    event_rank - codomain.event_rank)
+
+
+class ExpTransform(Transform):
+    """y = exp(x) (reference transform.py:621)."""
+
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+    @property
+    def _codomain(self):
+        return variable.positive
+
+
+class IndependentTransform(Transform):
+    """Promotes rightmost batch dims of a base transform into the event
+    (reference transform.py:670): the log-det sums over them."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        if not isinstance(base, Transform):
+            raise TypeError("base must be a Transform")
+        if reinterpreted_batch_rank <= 0:
+            raise ValueError(
+                "reinterpreted_batch_rank must be positive")
+        self._base = base
+        self._reinterpreted_batch_rank = reinterpreted_batch_rank
+        self._type = base._type
+
+    def _forward(self, x):
+        return self._base._forward(x)
+
+    def _inverse(self, y):
+        return self._base._inverse(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return _sum_rightmost(self._base._call_forward_ldj(x),
+                              self._reinterpreted_batch_rank)
+
+    def _forward_shape(self, shape):
+        return self._base.forward_shape(shape)
+
+    def _inverse_shape(self, shape):
+        return self._base.inverse_shape(shape)
+
+    @property
+    def _domain(self):
+        return variable.Independent(self._base._domain,
+                                    self._reinterpreted_batch_rank)
+
+    @property
+    def _codomain(self):
+        return variable.Independent(self._base._codomain,
+                                    self._reinterpreted_batch_rank)
+
+
+class PowerTransform(Transform):
+    """y = x^p on the positive half-line (reference transform.py:765)."""
+
+    _type = Type.BIJECTION
+
+    def __init__(self, power):
+        self._power = _v(power)
+
+    @property
+    def power(self):
+        return _t(self._power)
+
+    def _forward(self, x):
+        return jnp.power(x, self._power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self._power)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self._power * jnp.power(
+            x, self._power - 1.0)))
+
+    def _forward_shape(self, shape):
+        return jnp.broadcast_shapes(shape, self._power.shape)
+
+    _inverse_shape = _forward_shape
+
+    @property
+    def _domain(self):
+        return variable.positive
+
+    @property
+    def _codomain(self):
+        return variable.positive
+
+
+class ReshapeTransform(Transform):
+    """Reshapes the event part (reference transform.py:829); volume-
+    preserving so the log-det is zero over the event."""
+
+    _type = Type.BIJECTION
+
+    def __init__(self, in_event_shape, out_event_shape):
+        in_event_shape = tuple(in_event_shape)
+        out_event_shape = tuple(out_event_shape)
+        if (math.prod(in_event_shape) != math.prod(out_event_shape)):
+            raise ValueError(
+                f"The numel of 'in_event_shape' should be 'out_event_"
+                f"shape', but got {math.prod(in_event_shape)} != "
+                f"{math.prod(out_event_shape)}")
+        self._in_event_shape = in_event_shape
+        self._out_event_shape = out_event_shape
+
+    @property
+    def in_event_shape(self):
+        return self._in_event_shape
+
+    @property
+    def out_event_shape(self):
+        return self._out_event_shape
+
+    def _forward(self, x):
+        batch = x.shape[:x.ndim - len(self._in_event_shape)]
+        return x.reshape(batch + self._out_event_shape)
+
+    def _inverse(self, y):
+        batch = y.shape[:y.ndim - len(self._out_event_shape)]
+        return y.reshape(batch + self._in_event_shape)
+
+    def _forward_log_det_jacobian(self, x):
+        batch = x.shape[:x.ndim - len(self._in_event_shape)]
+        return jnp.zeros(batch, x.dtype)
+
+    def _forward_shape(self, shape):
+        n = len(self._in_event_shape)
+        if len(shape) < n or tuple(
+                shape[len(shape) - n:]) != self._in_event_shape:
+            raise ValueError(
+                f"Expected shape ends with {self._in_event_shape}, "
+                f"but got {shape}")
+        return tuple(shape[:len(shape) - n]) + self._out_event_shape
+
+    def _inverse_shape(self, shape):
+        n = len(self._out_event_shape)
+        if len(shape) < n or tuple(
+                shape[len(shape) - n:]) != self._out_event_shape:
+            raise ValueError(
+                f"Expected shape ends with {self._out_event_shape}, "
+                f"but got {shape}")
+        return tuple(shape[:len(shape) - n]) + self._in_event_shape
+
+    @property
+    def _domain(self):
+        return variable.Independent(variable.real,
+                                    len(self._in_event_shape))
+
+    @property
+    def _codomain(self):
+        return variable.Independent(variable.real,
+                                    len(self._out_event_shape))
+
+
+class SigmoidTransform(Transform):
+    """y = sigmoid(x) (reference transform.py:952)."""
+
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+    @property
+    def _codomain(self):
+        return variable.Variable(False, 0, constraint.Range(0.0, 1.0))
+
+
+class SoftmaxTransform(Transform):
+    """y = softmax(x) (reference transform.py:995) — not injective, so
+    no log-det; inverse is log (a representative pre-image)."""
+
+    _type = Type.OTHER
+
+    def _forward(self, x):
+        z = jnp.exp(x - x.max(-1, keepdims=True))
+        return z / z.sum(-1, keepdims=True)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_shape(self, shape):
+        if len(shape) < 1:
+            raise ValueError(
+                f"Expected length of shape is grater than 1, "
+                f"but got {len(shape)}")
+        return shape
+
+    _inverse_shape = _forward_shape
+
+    @property
+    def _domain(self):
+        return variable.Independent(variable.real, 1)
+
+    @property
+    def _codomain(self):
+        return variable.Variable(False, 1, constraint.simplex)
+
+
+class StackTransform(Transform):
+    """Applies transforms[i] to slice i along `axis` (reference
+    transform.py:1051)."""
+
+    def __init__(self, transforms, axis=0):
+        if not transforms or not all(
+                isinstance(t, Transform) for t in transforms):
+            raise TypeError(
+                "transforms must be a non-empty Sequence of Transform")
+        self._transforms = list(transforms)
+        self._axis = axis
+
+    @property
+    def transforms(self):
+        return self._transforms
+
+    @property
+    def axis(self):
+        return self._axis
+
+    def _is_injective(self):
+        return all(t._is_injective() for t in self._transforms)
+
+    def _map(self, fns, x):
+        parts = [
+            fn(jnp.squeeze(s, self._axis))
+            for fn, s in zip(fns, jnp.split(x, len(fns), self._axis))
+        ]
+        return jnp.stack(parts, self._axis)
+
+    def _forward(self, x):
+        return self._map([t._forward for t in self._transforms], x)
+
+    def _inverse(self, y):
+        return self._map([t._inverse for t in self._transforms], y)
+
+    def _forward_log_det_jacobian(self, x):
+        return self._map(
+            [t._call_forward_ldj for t in self._transforms], x)
+
+    @property
+    def _domain(self):
+        return variable.Stack(
+            [t._domain for t in self._transforms], self._axis)
+
+    @property
+    def _codomain(self):
+        return variable.Stack(
+            [t._codomain for t in self._transforms], self._axis)
+
+
+class StickBreakingTransform(Transform):
+    """R^K -> (K+1)-simplex by stick-breaking (reference
+    transform.py:1147)."""
+
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        K = x.shape[-1]
+        offset = K + 1 - jnp.cumsum(jnp.ones((K,), x.dtype), -1)
+        z = jax.nn.sigmoid(x - jnp.log(offset))
+        z_cumprod = jnp.cumprod(1 - z, -1)
+        pad = [(0, 0)] * (x.ndim - 1)
+        return (jnp.pad(z, pad + [(0, 1)], constant_values=1.0)
+                * jnp.pad(z_cumprod, pad + [(1, 0)],
+                          constant_values=1.0))
+
+    def _inverse(self, y):
+        y_crop = y[..., :-1]
+        K = y_crop.shape[-1]
+        offset = (y.shape[-1]
+                  - jnp.cumsum(jnp.ones((K,), y.dtype), -1))
+        sf = 1.0 - jnp.cumsum(y_crop, -1)
+        return jnp.log(y_crop) - jnp.log(sf) + jnp.log(offset)
+
+    def _forward_log_det_jacobian(self, x):
+        y = self._forward(x)
+        K = x.shape[-1]
+        offset = K + 1 - jnp.cumsum(jnp.ones((K,), x.dtype), -1)
+        x = x - jnp.log(offset)
+        return (-x + jax.nn.log_sigmoid(x)
+                + jnp.log(y[..., :-1])).sum(-1)
+
+    def _forward_shape(self, shape):
+        if not shape:
+            raise ValueError(
+                f"Expected 'shape' is not empty, but got {shape}")
+        return shape[:-1] + (shape[-1] + 1,)
+
+    def _inverse_shape(self, shape):
+        if not shape:
+            raise ValueError(
+                f"Expected 'shape' is not empty, but got {shape}")
+        return shape[:-1] + (shape[-1] - 1,)
+
+    @property
+    def _domain(self):
+        return variable.Independent(variable.real, 1)
+
+    @property
+    def _codomain(self):
+        return variable.Variable(False, 1, constraint.simplex)
+
+
+class TanhTransform(Transform):
+    """y = tanh(x) (reference transform.py:1200)."""
+
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        # 2*(log2 - x - softplus(-2x)): numerically better than
+        # log1p(-tanh^2) (the reference cites the same TFP trick)
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+    @property
+    def _codomain(self):
+        return variable.Variable(False, 0, constraint.Range(-1.0, 1.0))

@@ -1,0 +1,124 @@
+"""TransformedDistribution + Independent (reference
+python/paddle/distribution/transformed_distribution.py:20 and
+independent.py:18)."""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from . import Distribution
+from .transform import ChainTransform, Transform, _sum_rightmost, _t, _v
+
+
+class Independent(Distribution):
+    """reference independent.py:18 — reinterprets the rightmost
+    `reinterpreted_batch_rank` batch dims as event dims (log_prob sums
+    over them)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        if not isinstance(base, Distribution):
+            raise TypeError(
+                f"Expected type of 'base' is Distribution, but got "
+                f"{type(base)}")
+        if not 0 < reinterpreted_batch_rank <= len(base.batch_shape):
+            raise ValueError(
+                f"Expected 0 < reinterpreted_batch_rank <= "
+                f"{len(base.batch_shape)}, but got "
+                f"{reinterpreted_batch_rank}")
+        self._base = base
+        self._reinterpreted_batch_rank = reinterpreted_batch_rank
+        cut = len(base.batch_shape) - reinterpreted_batch_rank
+        super().__init__(
+            base.batch_shape[:cut],
+            base.batch_shape[cut:] + base.event_shape)
+
+    @property
+    def mean(self):
+        return self._base.mean
+
+    @property
+    def variance(self):
+        return self._base.variance
+
+    def sample(self, shape=()):
+        return self._base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self._base.rsample(shape)
+
+    def log_prob(self, value):
+        return _t(_sum_rightmost(_v(self._base.log_prob(value)),
+                                 self._reinterpreted_batch_rank))
+
+    def entropy(self):
+        return _t(_sum_rightmost(_v(self._base.entropy()),
+                                 self._reinterpreted_batch_rank))
+
+
+class TransformedDistribution(Distribution):
+    """reference transformed_distribution.py:20 — base distribution
+    pushed through a transform sequence; log_prob applies the inverse
+    chain accumulating -log|det J| with event-rank-aware reduction."""
+
+    def __init__(self, base, transforms):
+        if not isinstance(base, Distribution):
+            raise TypeError(
+                f"Expected type of 'base' is Distribution, but got "
+                f"{type(base)}.")
+        if not isinstance(transforms, Sequence) or not all(
+                isinstance(t, Transform) for t in transforms):
+            raise TypeError(
+                "Expected type of 'transforms' is Sequence[Transform].")
+        chain = ChainTransform(transforms)
+        self._base = base
+        self._transforms = list(transforms)
+        if not transforms:
+            super().__init__(base.batch_shape, base.event_shape)
+            return
+        base_shape = base.batch_shape + base.event_shape
+        if len(base_shape) < chain._domain.event_rank:
+            raise ValueError(
+                f"'base' needs to have shape with size at least "
+                f"{chain._domain.event_rank}, but got {len(base_shape)}.")
+        if chain._domain.event_rank > len(base.event_shape):
+            base = Independent(
+                base, chain._domain.event_rank - len(base.event_shape))
+            self._base = base
+        transformed_shape = chain.forward_shape(
+            base.batch_shape + base.event_shape)
+        transformed_event_rank = chain._codomain.event_rank + max(
+            len(base.event_shape) - chain._domain.event_rank, 0)
+        cut = len(transformed_shape) - transformed_event_rank
+        super().__init__(transformed_shape[:cut],
+                         transformed_shape[cut:])
+
+    def sample(self, shape=()):
+        x = self._base.sample(shape)
+        for t in self._transforms:
+            x = t.forward(x)
+        return x
+
+    def rsample(self, shape=()):
+        x = self._base.rsample(shape)
+        for t in self._transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        log_prob = 0.0
+        y = _v(value)
+        event_rank = len(self.event_shape)
+        for t in reversed(self._transforms):
+            x = t._inverse(y)
+            event_rank += (t._domain.event_rank
+                           - t._codomain.event_rank)
+            log_prob = log_prob - _sum_rightmost(
+                t._call_forward_ldj(x),
+                event_rank - t._domain.event_rank)
+            y = x
+        log_prob = log_prob + _sum_rightmost(
+            _v(self._base.log_prob(_t(y))),
+            event_rank - len(self._base.event_shape))
+        return _t(jnp.asarray(log_prob))

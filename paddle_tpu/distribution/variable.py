@@ -1,0 +1,96 @@
+"""Random-variable descriptors (reference
+python/paddle/distribution/variable.py:19 — Variable/Real/Positive/
+Independent/Stack carrying is_discrete/event_rank/constraint for the
+transform domain machinery)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import constraint as C
+
+
+class Variable:
+    def __init__(self, is_discrete=False, event_rank=0, constraint=None):
+        self._is_discrete = is_discrete
+        self._event_rank = event_rank
+        self._constraint = constraint
+
+    @property
+    def is_discrete(self):
+        return self._is_discrete
+
+    @property
+    def event_rank(self):
+        return self._event_rank
+
+    def constraint(self, value):
+        return self._constraint(value)
+
+
+class Real(Variable):
+    def __init__(self, event_rank=0):
+        super().__init__(False, event_rank, C.real)
+
+
+class Positive(Variable):
+    def __init__(self, event_rank=0):
+        super().__init__(False, event_rank, C.positive)
+
+
+class Independent(Variable):
+    """Reinterprets the rightmost batch dims of a base variable as part
+    of the event (reference variable.py:56)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self._base = base
+        self._reinterpreted_batch_rank = reinterpreted_batch_rank
+        super().__init__(
+            base.is_discrete,
+            base.event_rank + reinterpreted_batch_rank)
+
+    def constraint(self, value):
+        ret = self._base.constraint(value)
+        if ret.ndim < self._reinterpreted_batch_rank:
+            raise ValueError(
+                f"Input dimensions must be equal or grater than "
+                f"{self._reinterpreted_batch_rank}")
+        if self._reinterpreted_batch_rank == 0:
+            return ret
+        return ret.reshape(
+            ret.shape[:ret.ndim - self._reinterpreted_batch_rank]
+            + (-1,)).all(-1)
+
+
+class Stack(Variable):
+    """Per-slice variables along `axis` (reference variable.py:85)."""
+
+    def __init__(self, vars_, axis=0):
+        self._vars = vars_
+        self._axis = axis
+
+    @property
+    def is_discrete(self):
+        return any(v.is_discrete for v in self._vars)
+
+    @property
+    def event_rank(self):
+        # reference variable.py:95: a negative stack axis that falls
+        # inside the event block extends the event rank by one
+        rank = max(v.event_rank for v in self._vars)
+        if self._axis + rank < 0:
+            rank += 1
+        return rank
+
+    def constraint(self, value):
+        if not (-value.ndim <= self._axis < value.ndim):
+            raise ValueError(
+                f"Input dimensions {value.ndim} should be grater than "
+                f"stack constraint axis {self._axis}.")
+        slices = jnp.split(value, len(self._vars), self._axis)
+        return jnp.stack(
+            [v.constraint(jnp.squeeze(s, self._axis))
+             for v, s in zip(self._vars, slices)], self._axis)
+
+
+real = Real()
+positive = Positive()

@@ -1,0 +1,183 @@
+"""Transform zoo + TransformedDistribution/Independent (reference
+distribution/transform.py, transformed_distribution.py, independent.py;
+test strategy: closed-form pushforwards + autodiff log-det parity)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distribution as D
+
+
+class TestTransforms:
+    def test_affine_pushforward_matches_normal(self):
+        td = D.TransformedDistribution(
+            D.Normal(0.0, 1.0), [D.AffineTransform(1.0, 2.0)])
+        ref = D.Normal(1.0, 2.0)
+        for v in (-1.0, 0.3, 2.5):
+            a = float(td.log_prob(paddle.to_tensor(np.float32(v)))
+                      .numpy())
+            b = float(ref.log_prob(paddle.to_tensor(np.float32(v)))
+                      .numpy())
+            assert abs(a - b) < 1e-5
+
+    def test_exp_pushforward_is_lognormal(self):
+        td = D.TransformedDistribution(D.Normal(0.0, 1.0),
+                                       [D.ExpTransform()])
+        ln = D.LogNormal(0.0, 1.0)
+        for v in (0.5, 1.0, 3.0):
+            a = float(td.log_prob(paddle.to_tensor(np.float32(v)))
+                      .numpy())
+            b = float(ln.log_prob(paddle.to_tensor(np.float32(v)))
+                      .numpy())
+            assert abs(a - b) < 1e-5
+
+    def test_roundtrip_and_autodiff_ldj(self):
+        x = paddle.to_tensor(np.array([0.3, 0.9], np.float32))
+        cases = [
+            (D.ExpTransform(), jnp.exp),
+            (D.SigmoidTransform(), jax.nn.sigmoid),
+            (D.TanhTransform(), jnp.tanh),
+            (D.PowerTransform(2.0), None),
+            (D.AffineTransform(0.5, -3.0), None),
+        ]
+        for t, f in cases:
+            y = t.forward(x)
+            np.testing.assert_allclose(t.inverse(y).numpy(), x.numpy(),
+                                       rtol=1e-5, atol=1e-6)
+            if f is not None:
+                g = jax.vmap(jax.grad(lambda z: f(z)))(x._value)
+                np.testing.assert_allclose(
+                    t.forward_log_det_jacobian(x).numpy(),
+                    np.log(np.abs(g)), rtol=1e-5)
+
+    def test_abs_surjection(self):
+        t = D.AbsTransform()
+        x = paddle.to_tensor(np.array([-2.0, 3.0], np.float32))
+        np.testing.assert_array_equal(t.forward(x).numpy(), [2.0, 3.0])
+        neg, pos = t.inverse(paddle.to_tensor(
+            np.array([2.0], np.float32)))
+        assert neg.numpy()[0] == -2.0 and pos.numpy()[0] == 2.0
+        with pytest.raises(NotImplementedError):
+            t.forward_log_det_jacobian(x)
+
+    def test_stick_breaking(self):
+        t = D.StickBreakingTransform()
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        y = t.forward(x)
+        assert abs(float(y.numpy().sum()) - 1.0) < 1e-5
+        np.testing.assert_allclose(t.inverse(y).numpy(), x.numpy(),
+                                   rtol=1e-4)
+        assert t.forward_shape((3,)) == (4,)
+        assert t.inverse_shape((4,)) == (3,)
+
+    def test_softmax_not_injective(self):
+        t = D.SoftmaxTransform()
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        y = t.forward(x).numpy()
+        assert abs(y.sum() - 1.0) < 1e-6
+        assert not t._is_injective()
+
+    def test_chain_composes_and_sums_ldj(self):
+        t = D.ChainTransform([D.AffineTransform(0.0, 2.0),
+                              D.ExpTransform()])
+        x = paddle.to_tensor(np.array([0.5], np.float32))
+        np.testing.assert_allclose(t.forward(x).numpy(), np.exp(1.0),
+                                   rtol=1e-6)
+        # ldj = log|2| + (2x)
+        np.testing.assert_allclose(
+            t.forward_log_det_jacobian(x).numpy(),
+            np.log(2.0) + 1.0, rtol=1e-6)
+
+    def test_stack_per_slice(self):
+        t = D.StackTransform([D.ExpTransform(),
+                              D.AffineTransform(0.0, 3.0)], axis=0)
+        x = paddle.to_tensor(np.array([[1.0], [1.0]], np.float32))
+        out = t.forward(x).numpy()
+        np.testing.assert_allclose(out[0], np.exp(1.0), rtol=1e-6)
+        np.testing.assert_allclose(out[1], 3.0, rtol=1e-6)
+
+    def test_reshape_transform(self):
+        t = D.ReshapeTransform((4,), (2, 2))
+        x = paddle.to_tensor(np.arange(4, dtype=np.float32))
+        assert tuple(t.forward(x).numpy().shape) == (2, 2)
+        with pytest.raises(ValueError):
+            D.ReshapeTransform((4,), (3,))
+
+    def test_independent_transform_sums_event(self):
+        base = D.ExpTransform()
+        t = D.IndependentTransform(base, 1)
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        np.testing.assert_allclose(
+            t.forward_log_det_jacobian(x).numpy(), 3.0, rtol=1e-6)
+
+    def test_callable_dispatch(self):
+        assert isinstance(D.ExpTransform()(D.Normal(0.0, 1.0)),
+                          D.TransformedDistribution)
+        assert isinstance(D.ExpTransform()(D.AffineTransform(0.0, 1.0)),
+                          D.ChainTransform)
+
+
+class TestIndependentDistribution:
+    def test_log_prob_sums_event(self):
+        beta = D.Beta(np.array([0.5, 0.5], np.float32),
+                      np.array([0.5, 0.5], np.float32))
+        ind = D.Independent(beta, 1)
+        assert ind.batch_shape == () and ind.event_shape == (2,)
+        v = paddle.to_tensor(np.array([0.2, 0.8], np.float32))
+        assert abs(float(ind.log_prob(v).numpy())
+                   - float(beta.log_prob(v).numpy().sum())) < 1e-5
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            D.Independent(D.Normal(0.0, 1.0), 1)  # scalar batch
+        with pytest.raises(TypeError):
+            D.Independent("not a distribution", 1)
+
+
+class TestTransformedDistribution:
+    def test_sample_shapes_through_reshape(self):
+        td = D.TransformedDistribution(
+            D.Normal(np.zeros((4,), np.float32),
+                     np.ones((4,), np.float32)),
+            [D.ReshapeTransform((4,), (2, 2))])
+        assert tuple(td.sample((5,)).shape) == (5, 2, 2)
+        lp = td.log_prob(paddle.to_tensor(np.zeros((2, 2), np.float32)))
+        base = 4 * float(D.Normal(0.0, 1.0).log_prob(
+            paddle.to_tensor(np.float32(0))).numpy())
+        assert abs(float(lp.numpy()) - base) < 1e-5
+
+    def test_type_validation(self):
+        with pytest.raises(TypeError):
+            D.TransformedDistribution(D.Normal(0.0, 1.0), "nope")
+        with pytest.raises(TypeError):
+            D.TransformedDistribution("nope", [])
+
+
+class TestInjectivityWiring:
+    def test_chain_of_noninjective_guards_ldj(self):
+        t = D.ChainTransform([D.SoftmaxTransform()])
+        assert not t._is_injective()
+        with pytest.raises(NotImplementedError, match="injective"):
+            t.forward_log_det_jacobian(
+                paddle.to_tensor(np.array([1.0, 2.0], np.float32)))
+
+    def test_independent_of_noninjective_guards_ldj(self):
+        t = D.IndependentTransform(D.AbsTransform(), 1)
+        assert not t._is_injective()
+        with pytest.raises(NotImplementedError, match="injective"):
+            t.forward_log_det_jacobian(
+                paddle.to_tensor(np.array([1.0, 2.0], np.float32)))
+
+    def test_stack_negative_axis_event_rank(self):
+        # reference variable.py:95: axis=-1 under scalar slice ranks
+        # extends the event rank
+        t = D.StackTransform([D.ExpTransform(), D.ExpTransform()],
+                             axis=-1)
+        assert t._domain.event_rank == 1
+
+    def test_affine_scalar_args_coerce_float32(self):
+        t = D.AffineTransform(1, 2)      # ints: must coerce like Normal
+        out = t.forward(paddle.to_tensor(np.array([1.0], np.float32)))
+        assert out.numpy().dtype == np.float32
