@@ -29,9 +29,12 @@ class SparseCooTensor:
     """COO: indices [sparse_ndim, nnz] + values [nnz, ...dense_dims]."""
 
     def __init__(self, indices, values, shape):
-        self.indices_ = jnp.asarray(
-            indices._value if isinstance(indices, Tensor) else indices,
-            jnp.int32)
+        idx = jnp.asarray(
+            indices._value if isinstance(indices, Tensor) else indices)
+        # keep an existing integer dtype (cast(index_dtype=...) must
+        # stick); only coerce non-integer inputs
+        self.indices_ = idx if jnp.issubdtype(idx.dtype, jnp.integer) \
+            else idx.astype(jnp.int32)
         self.values_ = (values._value if isinstance(values, Tensor)
                         else jnp.asarray(values))
         self.shape = list(int(s) for s in shape)
@@ -76,10 +79,12 @@ class SparseCsrTensor:
     """CSR: crows [nrows+1], cols [nnz], values [nnz]."""
 
     def __init__(self, crows, cols, values, shape):
-        self.crows_ = jnp.asarray(
-            crows._value if isinstance(crows, Tensor) else crows, jnp.int32)
-        self.cols_ = jnp.asarray(
-            cols._value if isinstance(cols, Tensor) else cols, jnp.int32)
+        def _idx(v):
+            a = jnp.asarray(v._value if isinstance(v, Tensor) else v)
+            return a if jnp.issubdtype(a.dtype, jnp.integer) \
+                else a.astype(jnp.int32)
+        self.crows_ = _idx(crows)
+        self.cols_ = _idx(cols)
         self.values_ = (values._value if isinstance(values, Tensor)
                         else jnp.asarray(values))
         self.shape = list(int(s) for s in shape)
@@ -184,3 +189,240 @@ def relu(x):
     coo = _coerce_coo(x)
     return SparseCooTensor(coo.indices_, jnp.maximum(coo.values_, 0),
                            coo.shape)
+
+
+# ---------------------------------------------------------------------
+# Unary value-wise zoo (reference sparse/unary.py — structure preserved,
+# same storage format out as in)
+# ---------------------------------------------------------------------
+def _same_format(x, new_values):
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor(x.crows_, x.cols_, new_values, x.shape)
+    return SparseCooTensor(x.indices_, new_values, x.shape)
+
+
+def _unary(fn, name):
+    def op(x, *args, **kw):
+        if not isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+            raise TypeError(f"sparse.{name} expects a sparse tensor")
+        return _same_format(x, fn(x.values_, *args, **kw))
+    op.__name__ = name
+    op.__doc__ = (f"Elementwise {name} on the non-zero values "
+                  f"(reference sparse/unary.py {name})")
+    return op
+
+
+sin = _unary(jnp.sin, "sin")
+tan = _unary(jnp.tan, "tan")
+asin = _unary(jnp.arcsin, "asin")
+atan = _unary(jnp.arctan, "atan")
+sinh = _unary(jnp.sinh, "sinh")
+tanh = _unary(jnp.tanh, "tanh")
+asinh = _unary(jnp.arcsinh, "asinh")
+atanh = _unary(jnp.arctanh, "atanh")
+sqrt = _unary(jnp.sqrt, "sqrt")
+square = _unary(jnp.square, "square")
+log1p = _unary(jnp.log1p, "log1p")
+abs = _unary(jnp.abs, "abs")  # noqa: A001 — reference exports `abs`
+neg = _unary(jnp.negative, "neg")
+deg2rad = _unary(jnp.deg2rad, "deg2rad")
+rad2deg = _unary(jnp.rad2deg, "rad2deg")
+expm1 = _unary(jnp.expm1, "expm1")
+isnan = _unary(jnp.isnan, "isnan")
+
+
+def pow(x, factor):  # noqa: A001 — reference exports `pow`
+    return _same_format(x, jnp.power(x.values_, factor))
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    """reference sparse/unary.py cast — index and/or value dtype.
+    NB: with jax's default x64-disabled config, int64/float64 requests
+    canonicalize to 32-bit (a jax-wide behavior, not sparse-specific)."""
+    from ..framework import dtype as _dt
+    values = x.values_
+    if value_dtype is not None:
+        values = values.astype(_dt.convert_dtype(value_dtype))
+    if isinstance(x, SparseCsrTensor):
+        crows, cols = x.crows_, x.cols_
+        if index_dtype is not None:
+            jdt = _dt.convert_dtype(index_dtype)
+            crows, cols = crows.astype(jdt), cols.astype(jdt)
+        return SparseCsrTensor(crows, cols, values, x.shape)
+    indices = x.indices_
+    if index_dtype is not None:
+        indices = indices.astype(_dt.convert_dtype(index_dtype))
+    return SparseCooTensor(indices, values, x.shape)
+
+
+# ---------------------------------------------------------------------
+# Binary / matrix ops (reference sparse/binary.py, multiary.py)
+# ---------------------------------------------------------------------
+def subtract(x, y):
+    return add(x, neg(y) if isinstance(
+        y, (SparseCooTensor, SparseCsrTensor)) else Tensor(
+            -(y._value if isinstance(y, Tensor) else jnp.asarray(y))))
+
+
+def divide(x, y):
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        y = _coerce_coo(y).to_dense()
+    yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+    return Tensor(_coerce_coo(x).to_dense()._value / yv)
+
+
+def mv(x, vec):
+    """sparse [M,N] @ dense vector [N] (reference sparse/binary.py mv)."""
+    v = vec._value if isinstance(vec, Tensor) else jnp.asarray(vec)
+    return Tensor(matmul(x, Tensor(v[:, None]))._value[:, 0])
+
+
+def masked_matmul(x, y, mask):
+    """dense @ dense evaluated ONLY at mask's sparsity pattern
+    (reference sparse/binary.py masked_matmul, SDDMM): out.values[k] =
+    x[row_k] . y[:, col_k] — never materializes the dense product."""
+    xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+    coo = _coerce_coo(mask)
+    rows, cols = coo.indices_[0], coo.indices_[1]
+    vals = jnp.einsum("nk,nk->n", jnp.take(xv, rows, axis=0),
+                      jnp.take(yv.T, cols, axis=0))
+    if isinstance(mask, SparseCsrTensor):
+        return SparseCsrTensor(mask.crows_, mask.cols_, vals, mask.shape)
+    return SparseCooTensor(coo.indices_, vals, coo.shape)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    """beta*input + alpha*(x@y) with sparse x (reference
+    sparse/multiary.py addmm)."""
+    iv = input._value if isinstance(input, Tensor) else jnp.asarray(input)
+    return Tensor(beta * iv + alpha * matmul(x, y)._value)
+
+
+# ---------------------------------------------------------------------
+# Structure ops (reference sparse/unary.py transpose/sum/reshape/slice,
+# sparse/creation coalesce / is_same_shape)
+# ---------------------------------------------------------------------
+def _restore_format(inp, coo_out):
+    """Structure ops share one format contract: CSR in -> CSR out
+    (when the result is 2-D and CSR-representable), else COO."""
+    if isinstance(inp, SparseCsrTensor) and len(coo_out.shape) == 2:
+        return coo_out.to_sparse_csr()
+    return coo_out
+
+
+def transpose(x, perm):
+    """COO transpose: permute index rows + shape (reference
+    sparse/unary.py transpose)."""
+    coo = _coerce_coo(x)
+    perm = list(perm)
+    idx = jnp.stack([coo.indices_[p] for p in perm])
+    shape = [coo.shape[p] for p in perm]
+    return _restore_format(x, SparseCooTensor(idx, coo.values_, shape))
+
+
+def sum(x, axis=None, dtype=None, keepdim=False):  # noqa: A001
+    """Sum of non-zero values; axis=None -> scalar, else densified
+    reduction (XLA has no sparse reduce — documented collapse)."""
+    if dtype is not None:
+        from ..framework import dtype as _dt
+        dtype = _dt.convert_dtype(dtype)
+    if axis is None:
+        v = jnp.sum(x.values_, dtype=dtype)
+        if keepdim:
+            v = v.reshape((1,) * len(x.shape))
+        return Tensor(v)
+    dense = _coerce_coo(x).to_dense()._value
+    return Tensor(jnp.sum(dense, axis=axis, dtype=dtype,
+                          keepdims=keepdim))
+
+
+def coalesce(x):
+    """Merge duplicate COO indices, summing values; indices come back
+    lexically sorted (reference sparse_coo_tensor_kernel coalesce)."""
+    coo = _coerce_coo(x)
+    nd = coo.indices_.shape[0]
+    # int32 linear index: fine below 2**31 elements (x64 is disabled
+    # jax-wide here anyway)
+    lin = jnp.zeros((coo.nnz(),), jnp.int32)
+    for i in range(nd):
+        lin = lin * coo.shape[i] + coo.indices_[i].astype(jnp.int32)
+    uniq, inv = jnp.unique(lin, return_inverse=True)
+    vals = jax.ops.segment_sum(coo.values_, inv,
+                               num_segments=uniq.shape[0])
+    idx = []
+    rem = uniq
+    for i in reversed(range(nd)):
+        idx.append((rem % coo.shape[i]).astype(jnp.int32))
+        rem = rem // coo.shape[i]
+    return SparseCooTensor(jnp.stack(idx[::-1]), vals, coo.shape)
+
+
+def is_same_shape(x, y):
+    def _shape(t):
+        return list(t.shape) if isinstance(
+            t, (SparseCooTensor, SparseCsrTensor, Tensor)) else list(
+                jnp.asarray(t).shape)
+    return _shape(x) == _shape(y)
+
+
+def reshape(x, shape):
+    """COO reshape via linearized indices (reference sparse/unary.py
+    reshape)."""
+    coo = _coerce_coo(x)
+    shape = list(shape)
+    numel = int(np.prod(coo.shape))
+    if int(np.prod(shape)) != numel:
+        raise ValueError(
+            f"reshape cannot change the number of elements: "
+            f"{coo.shape} -> {shape}")
+    lin = jnp.zeros((coo.nnz(),), jnp.int32)
+    for i in range(coo.indices_.shape[0]):
+        lin = lin * coo.shape[i] + coo.indices_[i].astype(jnp.int32)
+    idx = []
+    rem = lin
+    for s in reversed(shape):
+        idx.append((rem % s).astype(jnp.int32))
+        rem = rem // s
+    return _restore_format(
+        x, SparseCooTensor(jnp.stack(idx[::-1]), coo.values_, shape))
+
+
+def slice(x, axes, starts, ends):  # noqa: A001
+    """COO slice: keep entries inside the window, shift indices
+    (reference sparse/unary.py slice)."""
+    coo = _coerce_coo(x)
+    axes = [a % len(coo.shape) for a in axes]
+    # numpy-style normalization: negative starts/ends count from the
+    # end; both clamp into [0, dim]
+    lo, hi = {}, {}
+    for a, s, e in zip(axes, starts, ends):
+        dim = coo.shape[a]
+        s = s + dim if s < 0 else s
+        e = e + dim if e < 0 else e
+        lo[a] = max(0, min(s, dim))
+        hi[a] = max(lo[a], min(e, dim))
+    keep = jnp.ones((coo.nnz(),), bool)
+    for a in axes:
+        keep = keep & (coo.indices_[a] >= lo[a]) & (
+            coo.indices_[a] < hi[a])
+    keep_idx = jnp.where(keep)[0]
+    idx = coo.indices_[:, keep_idx]
+    shifts = jnp.asarray([lo.get(i, 0)
+                          for i in range(len(coo.shape))],
+                         jnp.int32)[:, None]
+    new_shape = [hi[i] - lo[i] if i in lo else s
+                 for i, s in enumerate(coo.shape)]
+    return _restore_format(
+        x, SparseCooTensor(idx - shifts, coo.values_[keep_idx],
+                           new_shape))
+
+
+__all__ += ["sin", "tan", "asin", "atan", "sinh", "tanh", "asinh",
+            "atanh", "sqrt", "square", "log1p", "abs", "pow", "cast",
+            "neg", "deg2rad", "rad2deg", "expm1", "isnan", "subtract",
+            "divide", "mv", "masked_matmul", "addmm", "transpose",
+            "sum", "coalesce", "is_same_shape", "reshape", "slice",
+            "nn"]
+
+from . import nn  # noqa: E402,F401

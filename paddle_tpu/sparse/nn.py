@@ -1,0 +1,267 @@
+"""paddle_tpu.sparse.nn (reference python/paddle/sparse/nn/ —
+layer/activation.py:25 ReLU/ReLU6/LeakyReLU/Softmax,
+layer/norm.py:28 BatchNorm (+SyncBatchNorm), layer/conv.py:190
+Conv2D/Conv3D/SubmConv2D/SubmConv3D, layer/pooling.py:20 MaxPool3D).
+
+TPU-native scope: activations/norm operate on the value buffer with
+structure preserved — genuinely sparse. Convolutions and pooling
+DENSIFY: XLA has no sparse voxel storage, and on the MXU a dense conv
+over the region of interest is the fast lowering; the API (NDHWC sparse
+COO in, sparse COO out) matches the reference while the compute runs
+dense under jit. SubmConv masks the output back to the input's active
+sites (submanifold semantics)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer import Layer
+from . import SparseCooTensor, SparseCsrTensor, _same_format
+
+__all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax", "BatchNorm",
+           "SyncBatchNorm", "Conv2D", "Conv3D", "SubmConv2D",
+           "SubmConv3D", "MaxPool3D"]
+
+
+class _ValueActivation(Layer):
+    def forward(self, x):
+        return _same_format(x, self._fn(x.values_))
+
+
+class ReLU(_ValueActivation):
+    """reference sparse/nn/layer/activation.py ReLU."""
+
+    @staticmethod
+    def _fn(v):
+        return jnp.maximum(v, 0)
+
+
+class ReLU6(_ValueActivation):
+    @staticmethod
+    def _fn(v):
+        return jnp.clip(v, 0, 6)
+
+
+class LeakyReLU(_ValueActivation):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self._slope = negative_slope
+
+    def _fn(self, v):
+        return jnp.where(v >= 0, v, self._slope * v)
+
+
+class Softmax(Layer):
+    """Per-row softmax over a CSR matrix's stored values (reference
+    sparse/nn/layer/activation.py Softmax — CSR, axis=-1 only)."""
+
+    def __init__(self, axis=-1):
+        super().__init__()
+        if axis != -1:
+            raise ValueError("sparse Softmax only supports axis=-1 "
+                             "(reference limit)")
+
+    def forward(self, x):
+        if not isinstance(x, SparseCsrTensor):
+            raise TypeError("sparse Softmax expects a SparseCsrTensor")
+        rows = x._row_indices()
+        nrows = x.shape[0]
+        vmax = jax.ops.segment_max(x.values_, rows, num_segments=nrows)
+        ex = jnp.exp(x.values_ - jnp.take(vmax, rows))
+        denom = jax.ops.segment_sum(ex, rows, num_segments=nrows)
+        return SparseCsrTensor(x.crows_, x.cols_,
+                               ex / jnp.take(denom, rows), x.shape)
+
+
+class BatchNorm(Layer):
+    """Channel-last batch norm over COO values (reference
+    sparse/nn/layer/norm.py BatchNorm: input [N,D,H,W,C] sparse, norm
+    over the channel axis of the value buffer)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 name=None):
+        super().__init__()
+        if data_format != "NDHWC":
+            raise ValueError("sparse BatchNorm only supports NDHWC")
+        self._eps = epsilon
+        self._momentum = momentum
+        from ..nn import initializer as I
+        self.weight = self.create_parameter(
+            (num_features,), default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter(
+            (num_features,), default_initializer=I.Constant(0.0))
+        self._mean = np.zeros((num_features,), np.float32)
+        self._var = np.ones((num_features,), np.float32)
+
+    def forward(self, x):
+        if not isinstance(x, SparseCooTensor):
+            raise TypeError("sparse BatchNorm expects a SparseCooTensor")
+        v = x.values_
+        if self.training:
+            mean = v.mean(axis=0)
+            var = v.var(axis=0)
+            m = self._momentum
+            self._mean = m * self._mean + (1 - m) * np.asarray(mean)
+            self._var = m * self._var + (1 - m) * np.asarray(var)
+        else:
+            mean = jnp.asarray(self._mean)
+            var = jnp.asarray(self._var)
+        out = (v - mean) / jnp.sqrt(var + self._eps)
+        out = out * self.weight._value + self.bias._value
+        return SparseCooTensor(x.indices_, out, x.shape)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Single-controller SPMD: batch stats are global under GSPMD, so
+    sync-BN == BN (reference sparse/nn/layer/norm.py SyncBatchNorm)."""
+
+
+class _SparseConvBase(Layer):
+    _ndim = 3          # spatial dims
+    _subm = False
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format=None):
+        super().__init__()
+        nd = self._ndim
+        expected = "NDHWC" if nd == 3 else "NHWC"
+        if data_format not in (None, expected):
+            raise ValueError(f"sparse conv expects {expected}")
+        ks = (kernel_size,) * nd if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._stride = (stride,) * nd if isinstance(stride, int) \
+            else tuple(stride)
+        self._padding = (padding,) * nd if isinstance(padding, int) \
+            else tuple(padding)
+        self._dilation = (dilation,) * nd if isinstance(dilation, int) \
+            else tuple(dilation)
+        if groups != 1:
+            raise NotImplementedError("sparse conv groups>1 descoped")
+        # channel-last kernel [*ks, Cin, Cout] (reference layout)
+        self.weight = self.create_parameter(
+            ks + (in_channels, out_channels))
+        self.bias = self.create_parameter(
+            (out_channels,), is_bias=True) \
+            if bias_attr is not False else None
+
+    def forward(self, x):
+        if not isinstance(x, SparseCooTensor):
+            raise TypeError("sparse conv expects a SparseCooTensor")
+        nd = self._ndim
+        if x.indices_.shape[0] != nd + 1:
+            raise ValueError(
+                f"sparse conv expects COO indices over the (N, *spatial) "
+                f"dims with channels dense in the value buffer "
+                f"([nnz, C]); got {x.indices_.shape[0]} index dims for "
+                f"{nd} spatial dims")
+        dense = x.to_dense()._value          # [N, *spatial, C]
+        dn = jax.lax.conv_dimension_numbers(
+            dense.shape, self.weight._value.shape,
+            ("NDHWC", "DHWIO", "NDHWC") if nd == 3
+            else ("NHWC", "HWIO", "NHWC"))
+        pad = [(p, p) for p in self._padding]
+        out = jax.lax.conv_general_dilated(
+            dense, self.weight._value, self._stride, pad,
+            rhs_dilation=self._dilation, dimension_numbers=dn)
+        if self.bias is not None:
+            out = out + self.bias._value
+        if self._subm:
+            # submanifold contract: the output sparsity pattern IS the
+            # input's, which requires identical spatial shape
+            if out.shape[:-1] != dense.shape[:-1]:
+                raise ValueError(
+                    f"SubmConv requires the output spatial shape to "
+                    f"equal the input's (got {out.shape[:-1]} vs "
+                    f"{dense.shape[:-1]}); use stride=1 and 'same' "
+                    f"padding ((kernel_size-1)//2 for odd kernels)")
+            idx = x.indices_
+            vals = out[tuple(idx[i] for i in range(idx.shape[0]))]
+            return SparseCooTensor(idx, vals, list(out.shape))
+        # output pattern = union of receptive fields of active input
+        # sites (the reference's rulebook) — NOT `out != 0`, which a
+        # nonzero bias would light up everywhere
+        active = jnp.zeros(dense.shape[:-1] + (1,), dense.dtype)
+        active = active.at[tuple(
+            x.indices_[i] for i in range(x.indices_.shape[0]))].set(1.0)
+        ones = jnp.ones(self.weight._value.shape[:-2] + (1, 1),
+                        dense.dtype)
+        reach = jax.lax.conv_general_dilated(
+            active, ones, self._stride, pad,
+            rhs_dilation=self._dilation, dimension_numbers=dn)
+        mask = reach[..., 0] > 0
+        nz = jnp.where(mask.reshape(-1))[0]
+        coords = jnp.stack(jnp.unravel_index(nz, mask.shape))
+        vals = out.reshape(-1, out.shape[-1])[nz]
+        return SparseCooTensor(coords, vals, list(out.shape))
+
+
+class Conv3D(_SparseConvBase):
+    """reference sparse/nn/layer/conv.py Conv3D (NDHWC)."""
+    _ndim = 3
+
+
+class SubmConv3D(_SparseConvBase):
+    """reference sparse/nn/layer/conv.py SubmConv3D — output sparsity
+    pattern equals the input's."""
+    _ndim = 3
+    _subm = True
+
+
+class Conv2D(_SparseConvBase):
+    _ndim = 2
+
+
+class SubmConv2D(_SparseConvBase):
+    _ndim = 2
+    _subm = True
+
+
+class MaxPool3D(Layer):
+    """reference sparse/nn/layer/pooling.py MaxPool3D (NDHWC COO in,
+    COO out) — dense reduce-window under the hood."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NDHWC", name=None):
+        super().__init__()
+        if data_format != "NDHWC":
+            raise ValueError("sparse MaxPool3D expects NDHWC")
+        ks = (kernel_size,) * 3 if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        st = ks if stride is None else (
+            (stride,) * 3 if isinstance(stride, int) else tuple(stride))
+        self._ks, self._st = ks, st
+        self._pad = (padding,) * 3 if isinstance(padding, int) \
+            else tuple(padding)
+
+    def forward(self, x):
+        if not isinstance(x, SparseCooTensor):
+            raise TypeError("sparse MaxPool3D expects a SparseCooTensor")
+        if x.indices_.shape[0] != 4:
+            raise ValueError(
+                "sparse MaxPool3D expects COO indices over (N, D, H, W) "
+                "with channels dense in the value buffer")
+        # max over STORED values only: inactive sites are -inf, not 0,
+        # so negative actives survive; the output pattern is "window
+        # touched any active site"
+        site_idx = tuple(x.indices_[i] for i in range(4))
+        neg = jnp.full(tuple(x.shape[:4]) + (x.values_.shape[-1],),
+                       -jnp.inf, x.values_.dtype)
+        neg = neg.at[site_idx].set(x.values_)
+        active = jnp.zeros(tuple(x.shape[:4]), jnp.float32)
+        active = active.at[site_idx].set(1.0)
+        window = (1,) + self._ks + (1,)
+        strides = (1,) + self._st + (1,)
+        pads = ((0, 0),) + tuple((p, p) for p in self._pad) + ((0, 0),)
+        out = jax.lax.reduce_window(
+            neg, -jnp.inf, jax.lax.max, window, strides, pads)
+        pooled_active = jax.lax.reduce_window(
+            active, 0.0, jax.lax.max, window[:-1], strides[:-1],
+            pads[:-1])
+        mask = pooled_active > 0
+        nz = jnp.where(mask.reshape(-1))[0]
+        coords = jnp.stack(jnp.unravel_index(nz, mask.shape))
+        vals = out.reshape(-1, out.shape[-1])[nz]
+        return SparseCooTensor(coords, vals, list(out.shape))

@@ -1,0 +1,211 @@
+"""Sparse op zoo + sparse.nn (reference python/paddle/sparse/ —
+unary/binary/multiary ops and nn layers; numerics vs dense oracles)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.sparse as S
+import paddle_tpu.sparse.nn as SNN
+
+
+@pytest.fixture
+def coo():
+    idx = np.array([[0, 1, 2], [1, 0, 2]])
+    vals = np.array([0.5, -1.0, 2.0], np.float32)
+    return S.sparse_coo_tensor(idx, vals, [3, 3])
+
+
+class TestUnary:
+    def test_structure_and_format_preserved(self, coo):
+        vals = coo.values().numpy()
+        out = S.sin(coo)
+        assert S.is_sparse_coo(out)
+        np.testing.assert_allclose(out.values().numpy(), np.sin(vals),
+                                   rtol=1e-6)
+        csr = coo.to_sparse_csr()
+        out = S.sqrt(S.abs(csr))
+        assert S.is_sparse_csr(out)
+        np.testing.assert_allclose(
+            np.sort(out.values().numpy()),
+            np.sort(np.sqrt(np.abs(vals))), rtol=1e-6)
+
+    def test_cast(self, coo):
+        out = S.cast(coo, index_dtype="int64", value_dtype="float64")
+        assert out.values().numpy().dtype in (np.float64, np.float32)
+
+    def test_pow_isnan(self, coo):
+        np.testing.assert_allclose(
+            S.pow(coo, 2).values().numpy(),
+            coo.values().numpy() ** 2, rtol=1e-6)
+        assert not S.isnan(coo).values().numpy().any()
+
+
+class TestMatrixOps:
+    def test_mv(self, coo):
+        dense = coo.to_dense().numpy()
+        v = np.array([1., 2., 3.], np.float32)
+        np.testing.assert_allclose(
+            S.mv(coo, paddle.to_tensor(v)).numpy(), dense @ v,
+            rtol=1e-5)
+
+    def test_masked_matmul_sddmm(self, coo):
+        A = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        B = np.random.RandomState(1).randn(4, 3).astype(np.float32)
+        mm = S.masked_matmul(paddle.to_tensor(A), paddle.to_tensor(B),
+                             coo)
+        full = A @ B
+        idx = np.asarray(coo.indices_)
+        for k in range(coo.nnz()):
+            np.testing.assert_allclose(
+                mm.values().numpy()[k], full[idx[0][k], idx[1][k]],
+                rtol=1e-5)
+
+    def test_addmm(self, coo):
+        dense = coo.to_dense().numpy()
+        inp = np.random.RandomState(2).randn(3, 3).astype(np.float32)
+        Y = np.random.RandomState(3).randn(3, 3).astype(np.float32)
+        got = S.addmm(paddle.to_tensor(inp), coo, paddle.to_tensor(Y),
+                      beta=0.5, alpha=2.0).numpy()
+        np.testing.assert_allclose(got, 0.5 * inp + 2.0 * (dense @ Y),
+                                   rtol=1e-5)
+
+    def test_subtract_divide(self, coo):
+        dense = coo.to_dense().numpy()
+        other = np.full((3, 3), 2.0, np.float32)
+        np.testing.assert_allclose(
+            S.subtract(coo, paddle.to_tensor(other)).numpy(),
+            dense - other, rtol=1e-6)
+        np.testing.assert_allclose(
+            S.divide(coo, paddle.to_tensor(other)).numpy(),
+            dense / other, rtol=1e-6)
+
+
+class TestStructureOps:
+    def test_transpose_reshape_slice(self, coo):
+        dense = coo.to_dense().numpy()
+        np.testing.assert_allclose(
+            S.transpose(coo, [1, 0]).to_dense().numpy(), dense.T,
+            rtol=1e-6)
+        r = S.reshape(coo, [9])
+        np.testing.assert_allclose(r.to_dense().numpy(),
+                                   dense.reshape(9), rtol=1e-6)
+        sl = S.slice(coo, [0, 1], [0, 0], [2, 2])
+        np.testing.assert_allclose(sl.to_dense().numpy(),
+                                   dense[:2, :2], rtol=1e-6)
+        with pytest.raises(ValueError):
+            S.reshape(coo, [4])
+
+    def test_coalesce_merges_duplicates(self):
+        dup = S.sparse_coo_tensor(np.array([[0, 0], [1, 1]]),
+                                  np.array([1., 2.], np.float32),
+                                  [2, 2])
+        co = S.coalesce(dup)
+        assert co.nnz() == 1
+        assert float(co.values().numpy()[0]) == 3.0
+
+    def test_sum_and_same_shape(self, coo):
+        dense = coo.to_dense().numpy()
+        assert abs(float(S.sum(coo).numpy()) - dense.sum()) < 1e-6
+        np.testing.assert_allclose(S.sum(coo, axis=0).numpy(),
+                                   dense.sum(0), rtol=1e-6)
+        assert S.is_same_shape(coo, coo.to_sparse_csr())
+        assert not S.is_same_shape(coo, S.reshape(coo, [9]))
+
+
+class TestSparseNN:
+    def test_activations(self):
+        idx = np.array([[0, 1, 2], [1, 0, 2]])
+        x = S.sparse_coo_tensor(
+            idx, np.array([-1., 3., 9.], np.float32), [3, 3])
+        np.testing.assert_allclose(SNN.ReLU()(x).values().numpy(),
+                                   [0., 3., 9.])
+        np.testing.assert_allclose(SNN.ReLU6()(x).values().numpy(),
+                                   [0., 3., 6.])
+        np.testing.assert_allclose(
+            SNN.LeakyReLU(0.1)(x).values().numpy(), [-0.1, 3., 9.],
+            rtol=1e-6)
+
+    def test_csr_softmax_matches_dense_rows(self, coo):
+        csr = coo.to_sparse_csr()
+        sm = SNN.Softmax()(csr)
+        d = csr.to_dense().numpy()
+        out = sm.to_dense().numpy()
+        for r0 in range(3):
+            nz = d[r0] != 0
+            if nz.any():
+                row = d[r0][nz]
+                e = np.exp(row - row.max())
+                e /= e.sum()
+                np.testing.assert_allclose(np.sort(out[r0][nz]),
+                                           np.sort(e), rtol=1e-5)
+
+    def _voxels(self):
+        paddle.seed(0)
+        nidx = np.array([[0, 0], [1, 2], [0, 3], [2, 1]])
+        x = S.sparse_coo_tensor(
+            nidx,
+            np.random.RandomState(0).randn(2, 2).astype(np.float32),
+            [1, 4, 4, 4, 2])      # hybrid COO: channel dim is dense
+        return nidx, x
+
+    def test_subm_conv_preserves_pattern(self):
+        nidx, x = self._voxels()
+        conv = SNN.SubmConv3D(2, 4, kernel_size=3, padding=1)
+        y = conv(x)
+        assert y.nnz() == 2
+        np.testing.assert_array_equal(np.asarray(y.indices_), nidx)
+        assert y.values().numpy().shape == (2, 4)
+        bn = SNN.BatchNorm(4)
+        assert bn(y).values().numpy().shape == (2, 4)
+
+    def test_maxpool3d(self):
+        _nidx, x = self._voxels()
+        p = SNN.MaxPool3D(kernel_size=2)(x)
+        assert p.to_dense().numpy().shape == (1, 2, 2, 2, 2)
+
+    def test_subm_conv_rejects_shape_change(self):
+        _nidx, x = self._voxels()
+        conv = SNN.SubmConv3D(2, 4, kernel_size=3)   # padding=0 shrinks
+        with pytest.raises(ValueError, match="spatial shape"):
+            conv(x)
+
+    def test_conv_pattern_is_receptive_field_union(self):
+        # nonzero bias must NOT light up every voxel
+        paddle.seed(0)
+        nidx = np.array([[0], [1], [1], [1]])
+        x = S.sparse_coo_tensor(
+            nidx, np.ones((1, 2), np.float32), [1, 4, 4, 4, 2])
+        conv = SNN.Conv3D(2, 3, kernel_size=3, padding=1)
+        import numpy as _np
+        conv.bias.set_value(paddle.to_tensor(
+            _np.full((3,), 5.0, _np.float32)))
+        y = conv(x)
+        # receptive-field union of one site under a 3^3 kernel: 27 sites
+        assert y.nnz() == 27, y.nnz()
+
+    def test_maxpool_keeps_negative_actives(self):
+        nidx = np.array([[0], [0], [0], [0]])
+        x = S.sparse_coo_tensor(
+            nidx, np.array([[-1.0]], np.float32), [1, 2, 2, 2, 1])
+        p = SNN.MaxPool3D(kernel_size=2)(x)
+        assert p.nnz() == 1
+        np.testing.assert_allclose(p.values().numpy(), [[-1.0]])
+
+
+class TestSliceNormalization:
+    def test_negative_starts(self, coo):
+        dense = coo.to_dense().numpy()
+        sl = S.slice(coo, [0], [-2], [3])
+        np.testing.assert_allclose(sl.to_dense().numpy(), dense[-2:],
+                                   rtol=1e-6)
+
+    def test_sum_keepdim_rank(self, coo):
+        out = S.sum(coo, keepdim=True)
+        assert tuple(out.numpy().shape) == (1, 1)
+
+    def test_csr_format_contract(self, coo):
+        csr = coo.to_sparse_csr()
+        assert S.is_sparse_csr(S.transpose(csr, [1, 0]))
+        assert S.is_sparse_csr(S.slice(csr, [0], [0], [2]))
+        # 1-D result can't be CSR: documented COO fallback
+        assert S.is_sparse_coo(S.reshape(csr, [9]))
