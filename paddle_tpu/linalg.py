@@ -2,6 +2,6 @@
 from .ops.linalg import (  # noqa: F401
     norm, vector_norm, matrix_norm, cholesky, cholesky_solve, qr, svd, eigh,
     eigvalsh, eig, eigvals, inv, pinv, solve, triangular_solve, lstsq,
-    matrix_power, matrix_rank, slogdet, det, lu, multi_dot,
-    householder_product, corrcoef, cov, cond, matrix_exp)
+    matrix_power, matrix_rank, slogdet, det, lu, lu_unpack, multi_dot,
+    householder_product, corrcoef, cov, cond, matrix_exp, cdist)
 from .ops.math import matmul, dot, bmm, mv, outer, cross  # noqa: F401

@@ -315,3 +315,70 @@ def _matrix_exp(x):
 
 def matrix_exp(x, name=None):
     return _matrix_exp(x)
+
+
+@defop("lu_unpack_op")
+def _lu_unpack(lu_data, pivots):
+    m, n = lu_data.shape[-2], lu_data.shape[-1]
+    k = min(m, n)
+    L = jnp.tril(lu_data[..., :, :k], -1) + jnp.eye(
+        m, k, dtype=lu_data.dtype)
+    U = jnp.triu(lu_data[..., :k, :])
+    # pivots are 1-based sequential row swaps (scipy lu_factor piv):
+    # P = swap(I, i <-> pivots[i]-1) applied in order; A = P @ L @ U.
+    # fori_loop keeps the HLO O(1) in m (an unrolled Python loop would
+    # emit thousands of gather/scatters for large matrices)
+    def one_perm(piv):
+        def body(i, perm):
+            j = piv[i] - 1
+            pi, pj = perm[i], perm[j]
+            return perm.at[i].set(pj).at[j].set(pi)
+        perm = jax.lax.fori_loop(0, piv.shape[-1], body, jnp.arange(m))
+        return jnp.eye(m, dtype=lu_data.dtype)[:, perm]
+
+    if pivots.ndim == 1:
+        P = one_perm(pivots)
+    else:
+        flat = pivots.reshape(-1, pivots.shape[-1])
+        P = jax.vmap(one_perm)(flat).reshape(
+            pivots.shape[:-1] + (m, m))
+    return P, L, U
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """reference tensor/linalg.py:2205 — unpack (LU, pivots) into
+    (P, L, U); A = P @ L @ U."""
+    P, L, U = _lu_unpack(x, y)
+    return (P if unpack_pivots else None,
+            L if unpack_ludata else None,
+            U if unpack_ludata else None)
+
+
+@defop("cdist_op")
+def _cdist(x, y, *, p):
+    import math as _math
+    diff = x[..., :, None, :] - y[..., None, :, :]
+    # zero-distance pairs (incl. the diagonal of cdist(x, x)) need the
+    # masked-root trick: d sqrt(s)/ds -> inf at s=0, and inf*0 = NaN in
+    # the backward — route s=0 through a constant so its grad is 0
+    def _safe_root(s, root):
+        pos = s > 0
+        return jnp.where(pos, root(jnp.where(pos, s, 1.0)), 0.0)
+
+    if p == 2.0:
+        s = jnp.sum(diff * diff, axis=-1)
+        return _safe_root(s, jnp.sqrt)
+    if p == 0.0:
+        return jnp.sum((diff != 0).astype(x.dtype), axis=-1)
+    if _math.isinf(p):
+        return jnp.max(jnp.abs(diff), axis=-1)
+    s = jnp.sum(jnp.power(jnp.abs(diff), p), axis=-1)
+    return _safe_root(s, lambda v: jnp.power(v, 1.0 / p))
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    """reference tensor/linalg.py cdist — batched pairwise p-distance:
+    x [..,P,D], y [..,M,D] -> [..,P,M]. compute_mode is accepted for
+    API parity; XLA fuses the one einsum-style path here either way."""
+    return _cdist(x, y, p=float(p))

@@ -43,6 +43,7 @@ ALIASES: Dict[str, str] = {
     "check_finite_and_unscale_": "amp.grad_scaler:GradScaler",
     "update_loss_scaling_": "amp.grad_scaler:GradScaler",
     # naming differences / op-level vs function-level
+    "lu_unpack": "op:lu_unpack_op",
     "add_n": "ops.math:add_n",
     "batch_norm": "nn.functional:batch_norm",
     "bilinear": "nn.functional:bilinear",
@@ -194,8 +195,6 @@ DESCOPED: Dict[str, str] = {
     "auc": "metric — paddle_tpu.metric.Auc (hapi pack)",
     "affine_grid": "spatial-transformer util — vision pack v2",
     "bilinear_interp_v1": "legacy duplicate",
-    "lu_unpack": "LU factor unpack — linalg.lu returns packed+pivots; "
-                 "unpack helper v2",
     "matrix_rank_tol": "matrix_rank covers (tol arg)",
     "temporal_shift": "video model util — out of v1 scope",
     "spectral_norm": "nn.utils.spectral_norm — weight-norm util v2",

@@ -16,8 +16,9 @@ from ..ops.random_ops import *  # noqa: F401,F403
 from ..ops.linalg import (  # noqa: F401
     norm, vector_norm, matrix_norm, cholesky, cholesky_solve, qr, svd, eigh,
     eigvalsh, eig, eigvals, inv, inverse, pinv, solve, triangular_solve,
-    lstsq, matrix_power, matrix_rank, slogdet, det, lu, multi_dot,
-    householder_product, corrcoef, cov, cond, matrix_exp)
+    lstsq, matrix_power, matrix_rank, slogdet, det, lu, lu_unpack,
+    multi_dot, householder_product, corrcoef, cov, cond, matrix_exp,
+    cdist)
 from ..ops import math as _math
 from ..ops import manipulation as _manip
 from ..ops import logic as _logic

@@ -198,3 +198,69 @@ class TestInplaceAndAutograd:
         x._value = paddle.zeros(x.shape)._value  # simulate optimizer step
         y.backward()
         np.testing.assert_allclose(x.grad.numpy(), 2 * A, rtol=1e-5)
+
+
+class TestLuUnpackCdist:
+    """lu_unpack + cdist (reference tensor/linalg.py:2205, cdist)."""
+
+    def test_lu_unpack_reconstructs(self):
+        rng = np.random.RandomState(0)
+        A = rng.randn(5, 5).astype(np.float32)
+        lu, piv = paddle.linalg.lu(paddle.to_tensor(A))
+        P, L, U = paddle.linalg.lu_unpack(lu, piv)
+        np.testing.assert_allclose(P.numpy() @ L.numpy() @ U.numpy(), A,
+                                   atol=1e-5)
+        # L unit-lower-triangular, U upper-triangular
+        np.testing.assert_allclose(np.diag(L.numpy()), 1.0, atol=1e-6)
+        assert np.allclose(np.tril(U.numpy(), -1), 0.0)
+
+    def test_lu_unpack_batched_and_rect(self):
+        rng = np.random.RandomState(1)
+        B = rng.randn(3, 4, 4).astype(np.float32)
+        lu, piv = paddle.linalg.lu(paddle.to_tensor(B))
+        P, L, U = paddle.linalg.lu_unpack(lu, piv)
+        np.testing.assert_allclose(
+            np.einsum("bij,bjk,bkl->bil", P.numpy(), L.numpy(),
+                      U.numpy()), B, atol=1e-5)
+        R = rng.randn(5, 3).astype(np.float32)
+        lu, piv = paddle.linalg.lu(paddle.to_tensor(R))
+        P, L, U = paddle.linalg.lu_unpack(lu, piv)
+        assert L.numpy().shape == (5, 3) and U.numpy().shape == (3, 3)
+        np.testing.assert_allclose(P.numpy() @ L.numpy() @ U.numpy(), R,
+                                   atol=1e-5)
+
+    def test_lu_unpack_flags(self):
+        A = np.eye(3, dtype=np.float32)
+        lu, piv = paddle.linalg.lu(paddle.to_tensor(A))
+        P, L, U = paddle.linalg.lu_unpack(lu, piv, unpack_ludata=False)
+        assert L is None and U is None and P is not None
+
+    def test_cdist_matches_scipy(self):
+        import scipy.spatial.distance as sd
+        rng = np.random.RandomState(2)
+        x = rng.randn(4, 3).astype(np.float32)
+        y = rng.randn(6, 3).astype(np.float32)
+        for p in (1.0, 2.0, 3.0, float("inf")):
+            got = paddle.cdist(paddle.to_tensor(x), paddle.to_tensor(y),
+                               p=p).numpy()
+            want = (sd.cdist(x, y, "chebyshev") if np.isinf(p)
+                    else sd.cdist(x, y, "minkowski", p=p))
+            np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_cdist_batched(self):
+        import scipy.spatial.distance as sd
+        rng = np.random.RandomState(3)
+        xb = rng.randn(2, 4, 3).astype(np.float32)
+        yb = rng.randn(2, 5, 3).astype(np.float32)
+        got = paddle.cdist(paddle.to_tensor(xb),
+                           paddle.to_tensor(yb)).numpy()
+        assert got.shape == (2, 4, 5)
+        np.testing.assert_allclose(got[1], sd.cdist(xb[1], yb[1]),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_cdist_zero_distance_gradients_finite(self):
+        # regression: sqrt'(0)=inf made cdist(x,x) backprop NaN
+        x = paddle.to_tensor(np.array([[0., 0.], [1., 1.]], np.float32),
+                             stop_gradient=False)
+        paddle.cdist(x, x).sum().backward()
+        assert np.isfinite(x.grad.numpy()).all(), x.grad.numpy()
