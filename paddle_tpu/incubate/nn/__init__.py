@@ -1,0 +1,27 @@
+"""incubate.nn (reference python/paddle/incubate/nn/__init__.py:27 —
+fused transformer layers + memory-efficient attention + the functional
+fused-op surface). The attention/encoder classes live in the core
+nn/kernels and are re-exported at the reference paths; the fused layer
+zoo (FusedLinear/FusedFeedForward/FusedBiasDropoutResidualLayerNorm/
+FusedEcMoe/FusedDropoutAdd) wraps incubate.nn.functional."""
+from ...nn.layers.transformer import (  # noqa: F401
+    TransformerEncoderLayer as FusedTransformerEncoderLayer,
+    MultiHeadAttention as FusedMultiHeadAttention)
+from ...kernels.flash_attention import (  # noqa: F401
+    flash_attention as memory_efficient_attention)
+
+from ...parallel.moe import MoELayer  # noqa: F401
+from ..fused_multi_transformer import FusedMultiTransformer  # noqa: F401
+
+from . import functional  # noqa: F401
+from .layers import (  # noqa: F401
+    FusedLinear, FusedDropoutAdd, FusedBiasDropoutResidualLayerNorm,
+    FusedFeedForward, FusedEcMoe)
+
+__all__ = [
+    "FusedMultiHeadAttention", "FusedFeedForward",
+    "FusedTransformerEncoderLayer", "FusedMultiTransformer",
+    "FusedLinear", "FusedBiasDropoutResidualLayerNorm", "FusedEcMoe",
+    "FusedDropoutAdd", "MoELayer", "memory_efficient_attention",
+    "functional",
+]

@@ -1,0 +1,148 @@
+"""incubate.nn fused layers (reference
+python/paddle/incubate/nn/layer/fused_linear.py:20,
+fused_transformer.py:498 (FusedFeedForward), :379
+(FusedBiasDropoutResidualLayerNorm), fused_ec_moe.py:20,
+fused_dropout_add.py:20) — thin Layer wrappers over the functional
+surface; XLA does the fusing."""
+from __future__ import annotations
+
+from ...nn.layer import Layer
+from . import functional as FF
+
+
+class FusedLinear(Layer):
+    """reference incubate/nn/layer/fused_linear.py FusedLinear."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self._transpose = transpose_weight
+        shape = ((out_features, in_features) if transpose_weight
+                 else (in_features, out_features))
+        self.weight = self.create_parameter(shape, attr=weight_attr)
+        self.bias = self.create_parameter((out_features,),
+                                          attr=bias_attr, is_bias=True)
+
+    def forward(self, input):
+        return FF.fused_linear(input, self.weight, self.bias,
+                               transpose_weight=self._transpose)
+
+
+class FusedDropoutAdd(Layer):
+    """reference incubate/nn/layer/fused_dropout_add.py FusedDropoutAdd."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        return FF.fused_dropout_add(x, y, p=self.p,
+                                    training=self.training,
+                                    mode=self.mode)
+
+    def extra_repr(self):
+        return f"p={self.p}, mode={self.mode}"
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """reference incubate/nn/layer/fused_transformer.py:379."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        self._dropout_rate = dropout_rate
+        self._epsilon = epsilon
+        self.linear_bias = self.create_parameter(
+            (embed_dim,), attr=bias_attr, is_bias=True)
+        self.ln_scale = self.create_parameter(
+            (embed_dim,), attr=weight_attr,
+            default_initializer=self._ones)
+        self.ln_bias = self.create_parameter(
+            (embed_dim,), is_bias=True)
+
+    @staticmethod
+    def _ones(shape, dtype):
+        import jax.numpy as jnp
+        return jnp.ones(shape, dtype)
+
+    def forward(self, x, residual):
+        return FF.fused_bias_dropout_residual_layer_norm(
+            x, residual, bias=self.linear_bias, ln_scale=self.ln_scale,
+            ln_bias=self.ln_bias, dropout_rate=self._dropout_rate,
+            ln_epsilon=self._epsilon, training=self.training)
+
+
+class FusedFeedForward(Layer):
+    """reference incubate/nn/layer/fused_transformer.py:498."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self._dropout_rate = dropout_rate
+        self._act_dropout = (dropout_rate if act_dropout_rate is None
+                             else act_dropout_rate)
+        self._act = activation
+        self._epsilon = epsilon
+        self._pre_ln = normalize_before
+        self.linear1_weight = self.create_parameter(
+            (d_model, dim_feedforward), attr=linear1_weight_attr)
+        self.linear1_bias = self.create_parameter(
+            (dim_feedforward,), attr=linear1_bias_attr, is_bias=True)
+        self.linear2_weight = self.create_parameter(
+            (dim_feedforward, d_model), attr=linear2_weight_attr)
+        self.linear2_bias = self.create_parameter(
+            (d_model,), attr=linear2_bias_attr, is_bias=True)
+        import jax.numpy as jnp
+        ones = lambda s, d: jnp.ones(s, d)  # noqa: E731
+        self.ln1_scale = self.create_parameter(
+            (d_model,), attr=ln1_scale_attr, default_initializer=ones)
+        self.ln1_bias = self.create_parameter(
+            (d_model,), attr=ln1_bias_attr, is_bias=True)
+        self.ln2_scale = self.create_parameter(
+            (d_model,), attr=ln2_scale_attr, default_initializer=ones)
+        self.ln2_bias = self.create_parameter(
+            (d_model,), attr=ln2_bias_attr, is_bias=True)
+
+    def forward(self, src, cache=None):
+        return FF.fused_feedforward(
+            src, self.linear1_weight, self.linear2_weight,
+            linear1_bias=self.linear1_bias,
+            linear2_bias=self.linear2_bias,
+            ln1_scale=self.ln1_scale, ln1_bias=self.ln1_bias,
+            ln2_scale=self.ln2_scale, ln2_bias=self.ln2_bias,
+            dropout1_rate=self._act_dropout,
+            dropout2_rate=self._dropout_rate,
+            activation=self._act, ln1_epsilon=self._epsilon,
+            ln2_epsilon=self._epsilon, pre_layer_norm=self._pre_ln,
+            training=self.training)
+
+
+class FusedEcMoe(Layer):
+    """reference incubate/nn/layer/fused_ec_moe.py FusedEcMoe —
+    expert-choice MoE over dense batched matmuls."""
+
+    def __init__(self, hidden_size, inter_size, num_experts,
+                 act_type="gelu", weight_attr=None, bias_attr=None):
+        super().__init__()
+        if act_type not in ("gelu", "relu"):
+            raise ValueError(f"unsupported act_type {act_type!r}")
+        self._act = act_type
+        self.bmm_weight0 = self.create_parameter(
+            (num_experts, hidden_size, inter_size), attr=weight_attr)
+        self.bmm_bias0 = self.create_parameter(
+            (num_experts, 1, inter_size), attr=bias_attr, is_bias=True)
+        self.bmm_weight1 = self.create_parameter(
+            (num_experts, inter_size, hidden_size), attr=weight_attr)
+        self.bmm_bias1 = self.create_parameter(
+            (num_experts, 1, hidden_size), attr=bias_attr, is_bias=True)
+
+    def forward(self, x, gate):
+        return FF.fused_ec_moe(x, gate, self.bmm_weight0, self.bmm_bias0,
+                               self.bmm_weight1, self.bmm_bias1,
+                               self._act)

@@ -357,7 +357,7 @@ def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
 @defop("cdist_op")
 def _cdist(x, y, *, p):
     import math as _math
-    diff = x[..., :, None, :] - y[..., None, :, :]
+
     # zero-distance pairs (incl. the diagonal of cdist(x, x)) need the
     # masked-root trick: d sqrt(s)/ds -> inf at s=0, and inf*0 = NaN in
     # the backward — route s=0 through a constant so its grad is 0
@@ -366,8 +366,17 @@ def _cdist(x, y, *, p):
         return jnp.where(pos, root(jnp.where(pos, s, 1.0)), 0.0)
 
     if p == 2.0:
-        s = jnp.sum(diff * diff, axis=-1)
+        # mm form (|x|^2 + |y|^2 - 2 x.y^T): the [P,M,D] broadcast
+        # difference would be O(P*M*D) memory — 205 GB at 20k x 20k x
+        # 128 — where this needs only the [P,M] output (the MXU path
+        # the reference's compute_mode selects)
+        x2 = jnp.sum(x * x, axis=-1)
+        y2 = jnp.sum(y * y, axis=-1)
+        xy = jnp.einsum("...pd,...md->...pm", x, y)
+        s = jnp.maximum(x2[..., :, None] + y2[..., None, :] - 2 * xy,
+                        0.0)
         return _safe_root(s, jnp.sqrt)
+    diff = x[..., :, None, :] - y[..., None, :, :]
     if p == 0.0:
         return jnp.sum((diff != 0).astype(x.dtype), axis=-1)
     if _math.isinf(p):
