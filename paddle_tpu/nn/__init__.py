@@ -38,3 +38,11 @@ from .layers.rnn import (  # noqa: F401
     SimpleRNN, LSTM, GRU, LSTMCell, GRUCell, SimpleRNNCell, RNN, BiRNN)
 from . import utils  # noqa: F401
 from .clip import ClipGradByNorm, ClipGradByValue, ClipGradByGlobalNorm  # noqa: F401
+from .layers.rnn import _CellBase as RNNCellBase  # noqa: F401
+from .layers.extra import (  # noqa: F401
+    PoissonNLLLoss, SoftMarginLoss, MultiLabelSoftMarginLoss,
+    MultiMarginLoss, TripletMarginWithDistanceLoss, GaussianNLLLoss,
+    HSigmoidLoss, RNNTLoss, AdaptiveMaxPool3D, MaxUnPool1D, MaxUnPool2D,
+    MaxUnPool3D, Softmax2D, Unflatten)
+from .decode import (  # noqa: F401
+    Decoder, BeamSearchDecoder, dynamic_decode)

@@ -1,0 +1,197 @@
+"""Seq2seq decoding (reference python/paddle/nn/decode.py:30 Decoder,
+:150 BeamSearchDecoder, :994 dynamic_decode).
+
+TPU-native shape discipline: every step works on [batch*beam, ...]
+tensors with STATIC shapes; `finished` is a boolean mask (no dynamic
+batch shrinking), and the loop is the host-driven eager loop the
+reference's while_op implements — each step body is jit-compiled
+through the dispatch layer, so steady-state decoding replays compiled
+executables."""
+from __future__ import annotations
+
+import collections
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode"]
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class Decoder:
+    """reference decode.py:30 — the initialize/step/finalize protocol."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+class BeamSearchDecoder(Decoder):
+    """reference decode.py:150 — wraps an RNN cell; candidate scoring by
+    accumulated log-probability, end_token freezes a beam."""
+
+    OutputWrapper = collections.namedtuple(
+        "OutputWrapper", ("scores", "predicted_ids", "parent_ids"))
+    StateWrapper = collections.namedtuple(
+        "StateWrapper", ("cell_states", "log_probs", "finished",
+                         "lengths"))
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- beam tiling helpers (the reference's public static methods) -----
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[B, ...] -> [B*beam, ...] by repeat-interleave."""
+        v = _v(x)
+        v = jnp.repeat(v[:, None], beam_size, axis=1)
+        return Tensor(v.reshape((-1,) + v.shape[2:]))
+
+    def _merge(self, v):
+        return v.reshape((-1,) + v.shape[2:])          # [B,beam,...]→
+
+    def _split(self, v):
+        return v.reshape((-1, self.beam_size) + v.shape[1:])
+
+    # -- protocol --------------------------------------------------------
+    def initialize(self, initial_cell_states):
+        states = initial_cell_states
+        leaves = states if isinstance(states, (tuple, list)) else [states]
+        batch = _v(leaves[0]).shape[0]
+        self._batch = batch
+        tiled = [Tensor(jnp.repeat(_v(s)[:, None], self.beam_size,
+                                   axis=1).reshape(
+                     (-1,) + _v(s).shape[1:])) for s in leaves]
+        cell_states = (type(states)(tiled)
+                       if isinstance(states, (tuple, list)) else tiled[0])
+        # only beam 0 starts live (log_prob 0); the rest -inf so the
+        # first topk doesn't pick duplicate start beams
+        log_probs = jnp.where(
+            jnp.arange(self.beam_size)[None, :] == 0, 0.0, -1e30)
+        log_probs = jnp.tile(log_probs, (batch, 1))
+        init_ids = Tensor(jnp.full((batch * self.beam_size,),
+                                   self.start_token, jnp.int32))
+        init_inputs = (self.embedding_fn(init_ids)
+                       if self.embedding_fn else init_ids)
+        state = self.StateWrapper(
+            cell_states, Tensor(log_probs),
+            Tensor(jnp.zeros((batch, self.beam_size), bool)),
+            Tensor(jnp.zeros((batch, self.beam_size), jnp.int32)))
+        return init_inputs, state, Tensor(
+            jnp.zeros((batch, self.beam_size), bool))
+
+    def step(self, time, inputs, states, **kwargs):
+        cell_out, next_cell_states = self.cell(inputs,
+                                               states.cell_states)
+        if self.output_fn is not None:
+            cell_out = self.output_fn(cell_out)
+        logits = _v(cell_out)                         # [B*beam, V]
+        V = logits.shape[-1]
+        shifted = logits - logits.max(-1, keepdims=True)
+        logp = shifted - jnp.log(jnp.sum(jnp.exp(shifted), -1,
+                                         keepdims=True))
+        logp = self._split(logp)                      # [B, beam, V]
+        prev = _v(states.log_probs)[:, :, None]
+        finished = _v(states.finished)
+        # finished beams only extend with end_token at zero cost
+        end_only = jnp.full((V,), -1e30).at[self.end_token].set(0.0)
+        cand = jnp.where(finished[:, :, None], end_only[None, None, :],
+                         logp) + prev
+        flat = cand.reshape(cand.shape[0], -1)        # [B, beam*V]
+        topk_scores, topk_idx = _topk(flat, self.beam_size)
+        parent = topk_idx // V                        # [B, beam]
+        token = topk_idx % V
+        B = flat.shape[0]
+        gather = (jnp.arange(B)[:, None] * self.beam_size + parent
+                  ).reshape(-1)
+
+        def regather(s):
+            return Tensor(_v(s)[gather])
+
+        leaves = (next_cell_states
+                  if isinstance(next_cell_states, (tuple, list))
+                  else [next_cell_states])
+        new_leaves = [regather(s) for s in leaves]
+        cell_states = (type(next_cell_states)(new_leaves)
+                       if isinstance(next_cell_states, (tuple, list))
+                       else new_leaves[0])
+        was_finished = finished.reshape(-1)[gather].reshape(
+            B, self.beam_size)
+        now_finished = was_finished | (token == self.end_token)
+        lengths = _v(states.lengths).reshape(-1)[gather].reshape(
+            B, self.beam_size)
+        lengths = jnp.where(was_finished, lengths, lengths + 1)
+
+        out = self.OutputWrapper(Tensor(topk_scores),
+                                 Tensor(token.astype(jnp.int32)),
+                                 Tensor(parent.astype(jnp.int32)))
+        next_state = self.StateWrapper(cell_states, Tensor(topk_scores),
+                                       Tensor(now_finished),
+                                       Tensor(lengths))
+        flat_tokens = Tensor(token.reshape(-1).astype(jnp.int32))
+        next_inputs = (self.embedding_fn(flat_tokens)
+                       if self.embedding_fn else flat_tokens)
+        return out, next_state, next_inputs, Tensor(now_finished)
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        """Backtrace predicted ids through parent pointers
+        (gather_tree)."""
+        from .functional import gather_tree
+        ids = jnp.stack([_v(o.predicted_ids) for o in outputs])
+        parents = jnp.stack([_v(o.parent_ids) for o in outputs])
+        traced = gather_tree(Tensor(ids), Tensor(parents))
+        return traced, final_states
+
+    @property
+    def tracks_own_finished(self):
+        return True
+
+
+def _topk(x, k):
+    import jax
+    return jax.lax.top_k(x, k)
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """reference decode.py:994 — run decoder.step until every sequence
+    finishes or max_step_num; returns (outputs, final_states[, length])."""
+    inputs, states, finished = decoder.initialize(inits)
+    outputs = []
+    step = 0
+    while True:
+        out, states, inputs, finished = decoder.step(step, inputs,
+                                                     states, **kwargs)
+        outputs.append(out)
+        step += 1
+        if bool(np.asarray(_v(finished)).all()):
+            break
+        if max_step_num is not None and step > int(max_step_num):
+            break
+    final, final_states = decoder.finalize(outputs, states, None)
+    if not output_time_major:
+        final = Tensor(jnp.moveaxis(_v(final), 0, 1))
+    if return_length:
+        return final, final_states, final_states.lengths
+    return final, final_states

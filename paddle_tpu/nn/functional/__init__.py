@@ -32,3 +32,12 @@ from .attention import (  # noqa: F401
 from ...ops.creation import one_hot  # noqa: F401
 from ...ops.manipulation import gather, gather_nd, scatter, scatter_nd  # noqa: F401
 from ...ops.math import scale  # noqa: F401
+from .extra import (  # noqa: F401
+    pairwise_distance, elu_, relu_, softmax_, tanh_, diag_embed,
+    zeropad2d, max_unpool1d, max_unpool2d, max_unpool3d,
+    adaptive_max_pool3d, dice_loss, hsigmoid_loss,
+    multi_label_soft_margin_loss, poisson_nll_loss,
+    margin_cross_entropy, rnnt_loss, affine_grid, gather_tree,
+    temporal_shift, class_center_sample,
+    triplet_margin_with_distance_loss, multi_margin_loss,
+    soft_margin_loss, gaussian_nll_loss)

@@ -1,0 +1,613 @@
+"""nn.functional long-tail parity (reference
+python/paddle/nn/functional/__init__.py names missing from the v1
+surface): distance/pad/diag helpers, the loss zoo
+(dice/hsigmoid/poisson-nll/margin-CE/rnnt/triplet-distance/multi-margin/
+soft-margin/gaussian-nll/multi-label), vision warps
+(affine_grid/temporal_shift), beam-search gather_tree,
+class_center_sample, inplace activation variants, and the max-unpool
+family."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...framework.dispatch import apply, defop
+from ...framework.tensor import Tensor, inplace_rebind
+
+__all__ = [
+    "pairwise_distance", "elu_", "relu_", "softmax_", "tanh_",
+    "diag_embed", "zeropad2d", "max_unpool1d", "max_unpool2d",
+    "max_unpool3d", "adaptive_max_pool3d", "dice_loss", "hsigmoid_loss",
+    "multi_label_soft_margin_loss", "poisson_nll_loss",
+    "margin_cross_entropy", "rnnt_loss", "affine_grid", "gather_tree",
+    "temporal_shift", "class_center_sample",
+    "triplet_margin_with_distance_loss", "multi_margin_loss",
+    "soft_margin_loss", "gaussian_nll_loss",
+]
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    if reduction == "none":
+        return loss
+    raise ValueError(
+        f"reduction should be 'mean', 'sum' or 'none', got {reduction}")
+
+
+# ------------------------------------------------------------ distances
+@defop("pairwise_distance_op")
+def _pairwise_distance(x, y, *, p, epsilon, keepdim):
+    d = x - y + epsilon
+    if math.isinf(p):
+        return jnp.max(jnp.abs(d), axis=-1, keepdims=keepdim)
+    s = jnp.sum(jnp.power(jnp.abs(d), p), axis=-1, keepdims=keepdim)
+    pos = s > 0
+    return jnp.where(pos, jnp.power(jnp.where(pos, s, 1.0), 1.0 / p),
+                     0.0)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False,
+                      name=None):
+    """reference nn/functional/distance.py pairwise_distance —
+    ||x - y + eps||_p along the last dim."""
+    return _pairwise_distance(x, y, p=float(p), epsilon=float(epsilon),
+                              keepdim=bool(keepdim))
+
+
+# ---------------------------------------------------- inplace activations
+def relu_(x, name=None):
+    from .activation import relu
+    return inplace_rebind(x, relu(x))
+
+
+def elu_(x, alpha=1.0, name=None):
+    from .activation import elu
+    return inplace_rebind(x, elu(x, alpha))
+
+
+def tanh_(x, name=None):
+    from ...ops.math import tanh
+    return inplace_rebind(x, tanh(x))
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    from .activation import softmax
+    return inplace_rebind(x, softmax(x, axis=axis, dtype=dtype))
+
+
+# ------------------------------------------------------------- reshape/pad
+@defop("diag_embed_op")
+def _diag_embed(x, *, offset, dim1, dim2):
+    n = x.shape[-1] + abs(offset)
+    nd = x.ndim + 1
+    d1, d2 = dim1 % nd, dim2 % nd
+    base = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    r = idx + max(-offset, 0)
+    c = idx + max(offset, 0)
+    out = base.at[..., r, c].set(x)
+    # move the two new trailing dims to (dim1, dim2)
+    perm = list(range(x.ndim - 1))
+    pos = {d1: x.ndim - 1, d2: x.ndim}
+    full = []
+    src = iter(perm)
+    for i in range(nd):
+        full.append(pos[i] if i in pos else next(src))
+    return jnp.transpose(out, full)
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    """reference tensor/creation.py diag_embed: last-dim vectors become
+    diagonals of new (dim1, dim2) planes."""
+    return _diag_embed(input, offset=int(offset), dim1=int(dim1),
+                       dim2=int(dim2))
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    """reference nn/functional/common.py zeropad2d — [l, r, t, b]."""
+    from .common import pad
+    return pad(x, padding, mode="constant", value=0.0,
+               data_format=data_format)
+
+
+# ---------------------------------------------------------------- losses
+@defop("dice_loss_op")
+def _dice_loss(input, label, *, epsilon):
+    lab = jax.nn.one_hot(label[..., 0], input.shape[-1],
+                         dtype=input.dtype)
+    reduce_dims = tuple(range(1, input.ndim))
+    inter = jnp.sum(input * lab, axis=reduce_dims)
+    union = jnp.sum(input, reduce_dims) + jnp.sum(lab, reduce_dims)
+    dice = (2 * inter + epsilon) / (union + epsilon)
+    return jnp.mean(1 - dice)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """reference nn/functional/loss.py:35."""
+    return _dice_loss(input, label, epsilon=float(epsilon))
+
+
+@defop("soft_margin_loss_op")
+def _soft_margin_loss(input, label):
+    return jnp.log1p(jnp.exp(-label.astype(input.dtype) * input))
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    """reference loss.py soft_margin_loss: log(1+exp(-y*x)),
+    y in {-1, 1}."""
+    return apply("soft_margin_reduced",
+                 lambda i, l, red=None: _reduce(
+                     _soft_margin_loss._raw_fn(i, l), red),
+                 input, label, red=reduction)
+
+
+@defop("poisson_nll_loss_op")
+def _poisson_nll_loss(input, label, *, log_input, full, epsilon):
+    if log_input:
+        loss = jnp.exp(input) - label * input
+    else:
+        loss = input - label * jnp.log(input + epsilon)
+    if full:
+        # Stirling approximation for label! (only where label > 1)
+        stirling = (label * jnp.log(label) - label
+                    + 0.5 * jnp.log(2 * jnp.pi * label))
+        loss = loss + jnp.where(label > 1, stirling, 0.0)
+    return loss
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False,
+                     epsilon=1e-8, reduction="mean", name=None):
+    """reference loss.py poisson_nll_loss."""
+    if epsilon <= 0:
+        raise ValueError(
+            f"The value of `epsilon` in PoissonNLLLoss should be "
+            f"positive, but received {epsilon}")
+    out = _poisson_nll_loss(input, label, log_input=bool(log_input),
+                            full=bool(full), epsilon=float(epsilon))
+    return apply("reduce_loss", lambda v, red=None: _reduce(v, red),
+                 out, red=reduction)
+
+
+@defop("multi_label_soft_margin_op")
+def _ml_soft_margin(input, label, weight):
+    # loss = -mean_c [ y log sigmoid(x) + (1-y) log sigmoid(-x) ]
+    term = (label * jax.nn.log_sigmoid(input)
+            + (1 - label) * jax.nn.log_sigmoid(-input))
+    if weight is not None:
+        term = term * weight
+    return -jnp.mean(term, axis=-1)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    """reference loss.py multi_label_soft_margin_loss."""
+    return apply("ml_soft_margin_reduced",
+                 lambda i, l, w, red=None: _reduce(
+                     _ml_soft_margin._raw_fn(i, l, w), red),
+                 input, label, weight, red=reduction)
+
+
+@defop("multi_margin_loss_op")
+def _multi_margin(input, label, weight, *, p, margin):
+    N, C = input.shape
+    tgt = input[jnp.arange(N), label]
+    diff = jnp.maximum(margin - tgt[:, None] + input, 0.0)
+    diff = jnp.power(diff, p)
+    if weight is not None:
+        diff = diff * weight[label][:, None]
+    mask = jax.nn.one_hot(label, C, dtype=input.dtype)
+    return jnp.sum(diff * (1 - mask), axis=1) / C
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """reference loss.py multi_margin_loss."""
+    return apply("multi_margin_reduced",
+                 lambda i, l, w, red=None, pp=1, mg=1.0: _reduce(
+                     _multi_margin._raw_fn(i, l, w, p=pp, margin=mg),
+                     red),
+                 input, label, weight, red=reduction, pp=int(p),
+                 mg=float(margin))
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None,
+                                      margin=1.0, swap=False,
+                                      reduction="mean", name=None):
+    """reference loss.py triplet_margin_with_distance_loss."""
+    dist = distance_function or (
+        lambda a, b: pairwise_distance(a, b))
+    dp = dist(input, positive)
+    dn = dist(input, negative)
+    if swap:
+        from ...ops.math import minimum
+        dn = minimum(dn, dist(positive, negative))
+    return apply(
+        "triplet_dist_reduced",
+        lambda a, b, red=None, mg=1.0: _reduce(
+            jnp.maximum(a - b + mg, 0.0), red),
+        dp, dn, red=reduction, mg=float(margin))
+
+
+@defop("gaussian_nll_loss_op")
+def _gaussian_nll(input, label, variance, *, full, epsilon):
+    var = jnp.maximum(variance, epsilon)
+    loss = 0.5 * (jnp.log(var) + jnp.square(input - label) / var)
+    if full:
+        loss = loss + 0.5 * jnp.log(2 * jnp.asarray(jnp.pi, input.dtype))
+    return loss
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    """reference loss.py gaussian_nll_loss."""
+    out = _gaussian_nll(input, label, variance, full=bool(full),
+                        epsilon=float(epsilon))
+    return apply("reduce_loss", lambda v, red=None: _reduce(v, red),
+                 out, red=reduction)
+
+
+@defop("hsigmoid_loss_op")
+def _hsigmoid_loss(x, label, weight, bias, path_table, path_code,
+                   *, num_classes):
+    """Hierarchical sigmoid (reference phi SimpleCode tree when
+    path_table is None: code(c) = c + num_classes, node index at bit j =
+    (code >> (j+1)) - 1, bit j = (code >> j) & 1, path length =
+    floor(log2(code)))."""
+    N = x.shape[0]
+    if path_table is None:
+        code = label + num_classes
+        # max path length over the tree; per-sample mask trims the rest
+        L = int(math.floor(math.log2(2 * num_classes - 1)))
+        js = jnp.arange(L)
+        idxs = (code[:, None] >> (js[None, :] + 1)) - 1     # [N, L]
+        bits = (code[:, None] >> js[None, :]) & 1
+        lengths = jnp.floor(
+            jnp.log2(code.astype(jnp.float32))).astype(jnp.int32)
+        valid = js[None, :] < lengths[:, None]
+    else:
+        idxs = path_table
+        bits = path_code
+        valid = idxs >= 0
+        idxs = jnp.maximum(idxs, 0)
+    w = weight[idxs]                                  # [N, L, D]
+    z = jnp.einsum("nld,nd->nl", w, x)
+    if bias is not None:
+        z = z + bias[idxs][..., 0] if bias.ndim == 2 else z + bias[idxs]
+    t = bits.astype(x.dtype)
+    bce = jax.nn.softplus(z) - t * z
+    return jnp.sum(jnp.where(valid, bce, 0.0), axis=1, keepdims=True)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """reference nn/functional/loss.py hsigmoid_loss — [N, 1] per-sample
+    loss."""
+    return _hsigmoid_loss(input, label, weight, bias, path_table,
+                          path_code, num_classes=int(num_classes))
+
+
+@defop("margin_cross_entropy_op", n_outputs=2, nondiff_outputs=(1,))
+def _margin_ce(logits, label, *, margin1, margin2, margin3, scale):
+    theta = jnp.arccos(jnp.clip(logits, -1.0, 1.0))
+    mod = jnp.cos(margin1 * theta + margin2) - margin3
+    onehot = jax.nn.one_hot(label, logits.shape[-1],
+                            dtype=logits.dtype)
+    adjusted = jnp.where(onehot > 0, mod, logits) * scale
+    lse = jax.scipy.special.logsumexp(adjusted, axis=-1)
+    tgt = jnp.sum(adjusted * onehot, axis=-1)
+    loss = (lse - tgt)[:, None]
+    softmax = jnp.exp(adjusted - lse[:, None])
+    return loss, softmax
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """reference loss.py margin_cross_entropy (ArcFace-family margin
+    softmax): target cosine -> cos(m1*theta + m2) - m3, scaled by s."""
+    loss, softmax = _margin_ce(logits, label, margin1=float(margin1),
+                               margin2=float(margin2),
+                               margin3=float(margin3),
+                               scale=float(scale))
+    if reduction is not None:
+        loss = apply("reduce_loss", lambda v, red=None: _reduce(v, red),
+                     loss, red=reduction)
+    if return_softmax:
+        return loss, softmax
+    return loss
+
+
+@defop("rnnt_loss_op")
+def _rnnt_loss(logits, labels, logit_lengths, label_lengths, *, blank,
+               fastemit_lambda):
+    """Transducer loss (Graves 2012): alpha DP over the [T, U+1]
+    lattice, log domain; lax.scan over t, inner scan over u. FastEmit
+    (Yu et al. 2021, the reference's fastemit_lambda) scales the EMIT
+    branch's gradient by (1+lambda) — implemented value-preservingly as
+    e' = (1+l)*e - stop_gradient(l*e)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    B, T, U1, V = logp.shape
+    U = U1 - 1
+    neg_inf = jnp.asarray(-1e30, logp.dtype)
+
+    blank_lp = logp[..., blank]                       # [B, T, U+1]
+    emit_lp = jnp.take_along_axis(
+        logp[:, :, :U, :], labels[:, None, :, None], axis=-1
+    )[..., 0]                                         # [B, T, U]
+    if fastemit_lambda != 0.0:
+        emit_lp = ((1.0 + fastemit_lambda) * emit_lp
+                   - jax.lax.stop_gradient(fastemit_lambda * emit_lp))
+
+    def step_t(alpha_prev, t):
+        # horizontal (blank) move from alpha[t-1, u]
+        from_blank = jnp.where(
+            t > 0, alpha_prev + blank_lp[:, jnp.maximum(t - 1, 0), :],
+            jnp.where(jnp.arange(U1)[None, :] == 0, 0.0, neg_inf))
+
+        # vertical (emit) moves within row t: sequential in u
+        def step_u(carry, u):
+            # carry = alpha[t, u-1]
+            prev = carry
+            horiz = from_blank[:, u]
+            vert = jnp.where(
+                u > 0,
+                prev + emit_lp[:, t, jnp.maximum(u - 1, 0)],
+                neg_inf)
+            a = jnp.logaddexp(horiz, vert)
+            a = jnp.where(t == 0,
+                          jnp.where(u == 0, 0.0, vert), a)
+            return a, a
+
+        _, rows = jax.lax.scan(step_u, jnp.full((B,), neg_inf),
+                               jnp.arange(U1))
+        alpha_t = jnp.moveaxis(rows, 0, 1)            # [B, U+1]
+        return alpha_t, alpha_t
+
+    _, alphas = jax.lax.scan(step_t, jnp.zeros((B, U1), logp.dtype),
+                             jnp.arange(T))
+    alphas = jnp.moveaxis(alphas, 0, 1)               # [B, T, U+1]
+    bidx = jnp.arange(B)
+    t_last = logit_lengths - 1
+    u_last = label_lengths
+    final = alphas[bidx, t_last, u_last] + blank_lp[bidx, t_last, u_last]
+    return -final
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """reference loss.py rnnt_loss — input [B, T, U+1, V] joint-network
+    logits, label [B, U]."""
+    out = _rnnt_loss(input, label, input_lengths, label_lengths,
+                     blank=int(blank),
+                     fastemit_lambda=float(fastemit_lambda))
+    return apply("reduce_loss", lambda v, red=None: _reduce(v, red),
+                 out, red=reduction)
+
+
+# ---------------------------------------------------------- vision warps
+@defop("affine_grid_op")
+def _affine_grid(theta, *, out_shape, align_corners):
+    N, C, H, W = out_shape
+
+    def axis(n):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, n)
+        step = 2.0 / n
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, n)
+
+    ys, xs = jnp.meshgrid(axis(H), axis(W), indexing="ij")
+    ones = jnp.ones_like(xs)
+    base = jnp.stack([xs, ys, ones], axis=-1)         # [H, W, 3]
+    grid = jnp.einsum("hwk,nck->nhwc", base.astype(theta.dtype), theta)
+    return grid                                       # [N, H, W, 2]
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """reference nn/functional/vision.py affine_grid — 2D only here
+    (theta [N, 2, 3] -> grid [N, H, W, 2])."""
+    shape = tuple(int(s) for s in (
+        out_shape.numpy() if isinstance(out_shape, Tensor)
+        else out_shape))
+    if len(shape) != 4:
+        raise NotImplementedError(
+            "affine_grid supports 4-D out_shape (2D warps)")
+    return _affine_grid(theta, out_shape=shape,
+                        align_corners=bool(align_corners))
+
+
+@defop("temporal_shift_op")
+def _temporal_shift(x, *, seg_num, shift_ratio):
+    NT, C, H, W = x.shape
+    N = NT // seg_num
+    v = x.reshape(N, seg_num, C, H, W)
+    fold = int(C * shift_ratio)
+    pad = jnp.zeros((N, 1, fold, H, W), x.dtype)
+    # fold 0: shifted from t-1 (pad the first step)
+    a = jnp.concatenate([pad, v[:, :-1, :fold]], axis=1)
+    # fold 1: shifted from t+1
+    b = jnp.concatenate([v[:, 1:, fold:2 * fold], pad], axis=1)
+    rest = v[:, :, 2 * fold:]
+    return jnp.concatenate([a, b, rest], axis=2).reshape(NT, C, H, W)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None,
+                   data_format="NCHW"):
+    """reference nn/functional/extension.py temporal_shift."""
+    if data_format != "NCHW":
+        raise NotImplementedError("temporal_shift supports NCHW")
+    return _temporal_shift(x, seg_num=int(seg_num),
+                           shift_ratio=float(shift_ratio))
+
+
+@defop("gather_tree_op")
+def _gather_tree(ids, parents):
+    T, B, beam = ids.shape
+
+    def step(carry, t):
+        beams = carry                                  # [B, beam]
+        out = jnp.take_along_axis(ids[t], beams, axis=1)
+        nxt = jnp.take_along_axis(parents[t], beams, axis=1)
+        return nxt, out
+
+    init = jnp.tile(jnp.arange(beam)[None, :], (B, 1))
+    _, outs = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+    return outs[::-1]
+
+
+def gather_tree(ids, parents):
+    """reference nn/functional/extension.py gather_tree — backtrace beam
+    ids along parent pointers, [T, B, beam]."""
+    return _gather_tree(ids, parents)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """reference nn/functional/common.py class_center_sample — keep the
+    positive classes, top up with random negatives to num_samples, and
+    remap labels into the sampled index space. Host-side op (the output
+    is a data-dependent *selection*; the reference runs it as a CUDA
+    kernel feeding PartialFC) using the framework host seed stream."""
+    from ...framework import random as frandom
+    lab = np.asarray(label._value if isinstance(label, Tensor)
+                     else label).reshape(-1)
+    pos = np.unique(lab)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        rng = np.random.default_rng(frandom.next_host_seed())
+        rest = np.setdiff1d(np.arange(num_classes), pos,
+                            assume_unique=False)
+        extra = rng.choice(rest, size=num_samples - len(pos),
+                           replace=False)
+        sampled = np.sort(np.concatenate([pos, extra]))
+    remap = -np.ones((num_classes,), np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    return (Tensor(jnp.asarray(remap[lab]), stop_gradient=True),
+            Tensor(jnp.asarray(sampled.astype(np.int64)),
+                   stop_gradient=True))
+
+
+# ---------------------------------------------------------- max-unpool
+def _unpool_nd(x, indices, spatial_out, nd):
+    """Scatter pooled values back to `spatial_out` positions given the
+    per-(N, C) flattened argmax indices (the paddle mask convention)."""
+    xv = x
+    N, C = xv.shape[0], xv.shape[1]
+    flat_sz = 1
+    for s in spatial_out:
+        flat_sz *= s
+    xf = xv.reshape(N, C, -1)
+    idxf = indices.reshape(N, C, -1)
+    out = jnp.zeros((N, C, flat_sz), xv.dtype)
+    n_i = jnp.arange(N)[:, None, None]
+    c_i = jnp.arange(C)[None, :, None]
+    out = out.at[n_i, c_i, idxf].set(xf)
+    return out.reshape((N, C) + tuple(spatial_out))
+
+
+def _resolve_unpool_out(in_spatial, kernel_size, stride, padding,
+                        output_size, nd):
+    ks = (kernel_size,) * nd if isinstance(kernel_size, int) \
+        else tuple(kernel_size)
+    st = ks if stride is None else (
+        (stride,) * nd if isinstance(stride, int) else tuple(stride))
+    pd = (padding,) * nd if isinstance(padding, int) else tuple(padding)
+    if output_size is not None:
+        out = tuple(int(s) for s in output_size)
+        if len(out) > nd:                    # [N, C, ...] form accepted
+            out = out[-nd:]
+        return out
+    return tuple((in_spatial[i] - 1) * st[i] - 2 * pd[i] + ks[i]
+                 for i in range(nd))
+
+
+def _max_unpool(x, indices, kernel_size, stride, padding, output_size,
+                nd, data_format):
+    expected = {1: "NCL", 2: "NCHW", 3: "NCDHW"}[nd]
+    if data_format != expected:
+        raise NotImplementedError(
+            f"max_unpool{nd}d supports {expected} only")
+    spatial = tuple(x.shape[2:])
+    out_sp = _resolve_unpool_out(spatial, kernel_size, stride, padding,
+                                 output_size, nd)
+    # the pool that produced `indices` must be reconstructible from
+    # out_sp — otherwise indices can address cells outside the output
+    # and jax's clipping scatter would corrupt silently (the reference
+    # raises on inconsistent output_size too)
+    ks = (kernel_size,) * nd if isinstance(kernel_size, int) \
+        else tuple(kernel_size)
+    st = ks if stride is None else (
+        (stride,) * nd if isinstance(stride, int) else tuple(stride))
+    pd = (padding,) * nd if isinstance(padding, int) else tuple(padding)
+    for i in range(nd):
+        back = (out_sp[i] + 2 * pd[i] - ks[i]) // st[i] + 1
+        if back != spatial[i]:
+            raise ValueError(
+                f"max_unpool{nd}d: output_size {out_sp} is inconsistent "
+                f"with pooled input {spatial} for kernel={ks}, "
+                f"stride={st}, padding={pd}")
+    return apply(f"max_unpool{nd}d_op",
+                 lambda xv, iv, out_sp_=None, nd_=None: _unpool_nd(
+                     xv, iv, out_sp_, nd_),
+                 x, indices, out_sp_=out_sp, nd_=nd)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    """reference nn/functional/pooling.py max_unpool1d."""
+    return _max_unpool(x, indices, kernel_size, stride, padding,
+                       output_size, 1, data_format)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    """reference nn/functional/pooling.py max_unpool2d."""
+    return _max_unpool(x, indices, kernel_size, stride, padding,
+                       output_size, 2, data_format)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    """reference nn/functional/pooling.py max_unpool3d."""
+    return _max_unpool(x, indices, kernel_size, stride, padding,
+                       output_size, 3, data_format)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    """reference nn/functional/pooling.py adaptive_max_pool3d — bucket
+    max over the three spatial axes; return_mask rides the divisible
+    fast path (kernel == stride == in/out)."""
+    from .pooling import _masked_max_pool, _tuplize
+    outs = _tuplize(output_size, 3)
+    spatial = tuple(int(s) for s in x.shape[2:])
+    if return_mask:
+        if any(spatial[i] % outs[i] for i in range(3)):
+            raise NotImplementedError(
+                "adaptive_max_pool3d(return_mask=True) needs input "
+                "spatial dims divisible by output_size")
+        ks = tuple(spatial[i] // outs[i] for i in range(3))
+        return _masked_max_pool(x, ks, ks, 0, 3, "NCDHW",
+                                "adaptive_max_pool3d_mask_op")
+
+    @defop("adaptive_max_pool3d_op")
+    def _amp3(xv, *, out_dhw):
+        for i in range(3):
+            axis = 2 + i
+            size = xv.shape[axis]
+            out = out_dhw[i]
+            splits = [size * j // out for j in range(out + 1)]
+            parts = [jnp.max(
+                jax.lax.slice_in_dim(xv, splits[j], splits[j + 1],
+                                     axis=axis), axis=axis,
+                keepdims=True) for j in range(out)]
+            xv = jnp.concatenate(parts, axis=axis)
+        return xv
+
+    return _amp3(x, out_dhw=outs)

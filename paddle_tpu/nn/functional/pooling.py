@@ -6,7 +6,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ...framework.dispatch import defop
+from ...framework.dispatch import apply, defop
 from ...framework.tensor import Tensor
 
 
@@ -95,6 +95,10 @@ def _max_pool1d(x, k, s, p, ceil_mode):
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, name=None):
     stride = stride or kernel_size
+    if return_mask:
+        return _masked_max_pool(x, kernel_size, stride, padding, 1,
+                                "NCL", "max_pool1d_mask_op",
+                                ceil_mode=ceil_mode)
     return _max_pool1d(x, _tuplize(kernel_size, 1), _tuplize(stride, 1),
                        _pool_padding(padding, 1), bool(ceil_mode))
 
@@ -107,6 +111,10 @@ def _max_pool2d(x, k, s, p, ceil_mode, chan_first):
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
     stride = stride or kernel_size
+    if return_mask:
+        return _masked_max_pool(x, kernel_size, stride, padding, 2,
+                                data_format, "max_pool2d_mask_op",
+                                ceil_mode=ceil_mode)
     return _max_pool2d(x, _tuplize(kernel_size, 2), _tuplize(stride, 2),
                        _pool_padding(padding, 2), bool(ceil_mode),
                        data_format == "NCHW")
@@ -120,6 +128,10 @@ def _max_pool3d(x, k, s, p, ceil_mode, chan_first):
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
     stride = stride or kernel_size
+    if return_mask:
+        return _masked_max_pool(x, kernel_size, stride, padding, 3,
+                                data_format, "max_pool3d_mask_op",
+                                ceil_mode=ceil_mode)
     return _max_pool3d(x, _tuplize(kernel_size, 3), _tuplize(stride, 3),
                        _pool_padding(padding, 3), bool(ceil_mode),
                        data_format == "NCDHW")
@@ -283,3 +295,77 @@ def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
     return _lp(x, float(norm_type), _tuplize(kernel_size, 2),
                _tuplize(stride, 2), _pool_padding(padding, 2),
                data_format == "NCHW")
+
+
+# ------------------------------------------------------------------
+# max-pool argmax masks (reference return_mask=True: indices flattened
+# over the spatial dims per (N, C) — the contract max_unpool consumes).
+# Static kernel-offset stacking: for each of the prod(k) offsets, a
+# strided slice of the (-inf padded) input aligns all windows; argmax
+# over the offset axis picks the winner, and the winning offset maps
+# back to flat input coordinates. Fully static shapes, no dynamic
+# gather.
+# ------------------------------------------------------------------
+def _max_pool_with_mask(x, ks, st, pd, nd, ceil_mode=False):
+    import itertools
+    spatial = x.shape[2:]
+    if ceil_mode:
+        out_sp = tuple(
+            -(-(spatial[i] + pd[i][0] + pd[i][1] - ks[i]) // st[i]) + 1
+            for i in range(nd))
+        extra = tuple(
+            max(0, (out_sp[i] - 1) * st[i] + ks[i]
+                - (spatial[i] + pd[i][0] + pd[i][1]))
+            for i in range(nd))
+        pd = tuple((pd[i][0], pd[i][1] + extra[i]) for i in range(nd))
+    else:
+        out_sp = tuple(
+            (spatial[i] + pd[i][0] + pd[i][1] - ks[i]) // st[i] + 1
+            for i in range(nd))
+    pads = [(0, 0), (0, 0)] + [(p[0], p[1]) for p in pd]
+    neg = jnp.asarray(-jnp.inf, x.dtype)
+    xp = jnp.pad(x, pads, constant_values=neg)
+
+    slabs, flat_idx = [], []
+    for off in itertools.product(*[range(k) for k in ks]):
+        sl = [slice(None), slice(None)]
+        for i in range(nd):
+            stop = off[i] + (out_sp[i] - 1) * st[i] + 1
+            sl.append(slice(off[i], stop, st[i]))
+        slabs.append(xp[tuple(sl)])
+        # flat input index of this offset at every output position
+        coords = []
+        for i in range(nd):
+            c = (jnp.arange(out_sp[i]) * st[i] + off[i] - pd[i][0])
+            coords.append(c)
+        mesh = jnp.meshgrid(*coords, indexing="ij")
+        flat = jnp.zeros(out_sp, jnp.int32)
+        for i in range(nd):
+            flat = flat * spatial[i] + jnp.clip(mesh[i], 0,
+                                                spatial[i] - 1)
+        flat_idx.append(flat)
+    stack = jnp.stack(slabs)                      # [K, N, C, *out]
+    idx_stack = jnp.stack(flat_idx)               # [K, *out]
+    win = jnp.argmax(stack, axis=0)               # [N, C, *out]
+    out = jnp.max(stack, axis=0)
+    P = int(np.prod(out_sp))
+    idx_flat = idx_stack.reshape(idx_stack.shape[0], P)   # [K, P]
+    win_flat = win.reshape(win.shape[0], win.shape[1], P)
+    mask = idx_flat[win_flat, jnp.arange(P)[None, None, :]]
+    mask = mask.reshape(win.shape)
+    return out, mask
+
+
+def _masked_max_pool(x, kernel_size, stride, padding, nd, data_format,
+                     op_name, ceil_mode=False):
+    expected = {1: "NCL", 2: "NCHW", 3: "NCDHW"}[nd]
+    if data_format != expected:
+        raise NotImplementedError(
+            f"return_mask=True supports {expected} only")
+    return apply(
+        op_name,
+        lambda xv, ks=None, st=None, pd=None, nd_=None, cm=False:
+            _max_pool_with_mask(xv, ks, st, pd, nd_, ceil_mode=cm),
+        x, _nondiff_outputs=(1,), ks=_tuplize(kernel_size, nd),
+        st=_tuplize(stride, nd), pd=_pool_padding(padding, nd), nd_=nd,
+        cm=bool(ceil_mode))

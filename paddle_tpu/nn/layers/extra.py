@@ -1,0 +1,211 @@
+"""nn layer long-tail parity (reference python/paddle/nn/__init__.py
+names missing from the v1 surface): loss-layer wrappers over
+functional/extra.py, the max-unpool family, AdaptiveMaxPool3D,
+Softmax2D, Unflatten."""
+from __future__ import annotations
+
+from ..layer import Layer
+from .. import functional as F
+
+__all__ = [
+    "PoissonNLLLoss", "SoftMarginLoss", "MultiLabelSoftMarginLoss",
+    "MultiMarginLoss", "TripletMarginWithDistanceLoss",
+    "GaussianNLLLoss", "HSigmoidLoss", "RNNTLoss", "AdaptiveMaxPool3D",
+    "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D", "Softmax2D",
+    "Unflatten",
+]
+
+
+class PoissonNLLLoss(Layer):
+    """reference nn/layer/loss.py PoissonNLLLoss."""
+
+    def __init__(self, log_input=True, full=False, epsilon=1e-8,
+                 reduction="mean", name=None):
+        super().__init__()
+        self._args = (log_input, full, epsilon, reduction)
+
+    def forward(self, input, label):
+        log_input, full, epsilon, reduction = self._args
+        return F.poisson_nll_loss(input, label, log_input=log_input,
+                                  full=full, epsilon=epsilon,
+                                  reduction=reduction)
+
+
+class SoftMarginLoss(Layer):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__()
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        return F.soft_margin_loss(input, label,
+                                  reduction=self._reduction)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self._weight = weight
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        return F.multi_label_soft_margin_loss(
+            input, label, weight=self._weight,
+            reduction=self._reduction)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self._args = (p, margin, weight, reduction)
+
+    def forward(self, input, label):
+        p, margin, weight, reduction = self._args
+        return F.multi_margin_loss(input, label, p=p, margin=margin,
+                                   weight=weight, reduction=reduction)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self._args = (distance_function, margin, swap, reduction)
+
+    def forward(self, input, positive, negative):
+        fn, margin, swap, reduction = self._args
+        return F.triplet_margin_with_distance_loss(
+            input, positive, negative, distance_function=fn,
+            margin=margin, swap=swap, reduction=reduction)
+
+
+class GaussianNLLLoss(Layer):
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean",
+                 name=None):
+        super().__init__()
+        self._args = (full, epsilon, reduction)
+
+    def forward(self, input, label, variance):
+        full, epsilon, reduction = self._args
+        return F.gaussian_nll_loss(input, label, variance, full=full,
+                                   epsilon=epsilon, reduction=reduction)
+
+
+class HSigmoidLoss(Layer):
+    """reference nn/layer/loss.py HSigmoidLoss — owns the internal-node
+    weight [num_classes-1, feature_size] (SimpleCode tree) unless
+    custom path tables supply a larger node space."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if not is_custom and num_classes < 2:
+            raise ValueError("num_classes must not be less than 2 "
+                             "with default tree")
+        self._num_classes = num_classes
+        self._is_custom = is_custom
+        # default tree has num_classes - 1 internal nodes; custom trees
+        # may address up to num_classes nodes
+        rows = num_classes if is_custom else num_classes - 1
+        # SimpleCode indices reach 2*num_classes-2 internal slots in the
+        # worst (non-power-of-two) case — size generously like the
+        # reference's C (=num_classes) x D parameterization
+        rows = max(rows, 2 * num_classes - 1)
+        self.weight = self.create_parameter((rows, feature_size),
+                                            attr=weight_attr)
+        self.bias = self.create_parameter((rows, 1), attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self._num_classes,
+                               self.weight, self.bias,
+                               path_table=path_table,
+                               path_code=path_code)
+
+
+class RNNTLoss(Layer):
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self._args = (blank, fastemit_lambda, reduction)
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        blank, fe, reduction = self._args
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           blank=blank, fastemit_lambda=fe,
+                           reduction=reduction)
+
+
+class AdaptiveMaxPool3D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self._output_size = output_size
+        self._return_mask = return_mask
+
+    def forward(self, x):
+        return F.adaptive_max_pool3d(x, self._output_size,
+                                     return_mask=self._return_mask)
+
+
+class _MaxUnPoolBase(Layer):
+    _nd = 2
+    _fmt = "NCHW"
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format=None, output_size=None, name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding,
+                      data_format or self._fmt, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, fmt, out = self._args
+        fn = getattr(F, f"max_unpool{self._nd}d")
+        return fn(x, indices, k, stride=s, padding=p, data_format=fmt,
+                  output_size=out)
+
+
+class MaxUnPool1D(_MaxUnPoolBase):
+    _nd = 1
+    _fmt = "NCL"
+
+
+class MaxUnPool2D(_MaxUnPoolBase):
+    _nd = 2
+    _fmt = "NCHW"
+
+
+class MaxUnPool3D(_MaxUnPoolBase):
+    _nd = 3
+    _fmt = "NCDHW"
+
+
+class Softmax2D(Layer):
+    """reference nn/layer/activation.py Softmax2D — softmax over the
+    channel axis of NCHW (or CHW) inputs."""
+
+    def forward(self, x):
+        if x.ndim not in (3, 4):
+            raise ValueError(
+                f"Softmax2D requires a 3D or 4D tensor as input, "
+                f"got {x.ndim}")
+        return F.softmax(x, axis=-3)
+
+
+class Unflatten(Layer):
+    """reference nn/layer/common.py Unflatten — expand `axis` into
+    `shape`."""
+
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self._axis = axis
+        self._shape = list(shape)
+
+    def forward(self, x):
+        from ...ops.manipulation import reshape
+        axis = self._axis % x.ndim
+        new_shape = (list(x.shape[:axis]) + self._shape
+                     + list(x.shape[axis + 1:]))
+        return reshape(x, new_shape)
+
+    def extra_repr(self):
+        return f"axis={self._axis}, shape={self._shape}"
