@@ -363,7 +363,9 @@ def index_sample(x, index):
 
 @defop("index_add_op")
 def _index_add(x, index, axis, value):
-    ix = [slice(None)] * x.ndim
+    import builtins
+    # NB: this module defines a `slice` op that shadows the builtin
+    ix = [builtins.slice(None)] * x.ndim
     ix[axis] = index
     return x.at[tuple(ix)].add(value)
 

@@ -1,0 +1,303 @@
+"""Top-level namespace tail (reference python/paddle/__init__.py names
+without a home in the existing op modules: tensor/math.py quantile/
+nanquantile/diff/sgn/frexp/trapezoid/cumulative_trapezoid/vander,
+tensor/creation.py polar, tensor/manipulation.py vsplit/take/unflatten/
+index_add_/index_put_/scatter_, framework/random.py cuda-rng shims,
+LazyGuard, create_parameter, disable_signal_handler)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.dispatch import apply, defop
+from ..framework.tensor import Tensor, inplace_rebind
+
+__all__ = [
+    "vsplit", "quantile", "nanquantile", "tolist", "tanh_", "scatter_",
+    "diff", "index_add_", "index_put_", "sgn", "take", "frexp",
+    "trapezoid", "cumulative_trapezoid", "polar", "vander", "unflatten",
+    "get_cuda_rng_state", "set_cuda_rng_state", "disable_signal_handler",
+    "LazyGuard", "create_parameter", "check_shape",
+]
+
+
+# ------------------------------------------------------------- math tail
+@defop("quantile_op")
+def _quantile(x, *, q, axis, keepdim, nan_aware):
+    fn = jnp.nanquantile if nan_aware else jnp.quantile
+    return fn(x, jnp.asarray(q), axis=axis, keepdims=keepdim)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear",
+             name=None):
+    """reference tensor/stat.py quantile."""
+    if interpolation != "linear":
+        raise NotImplementedError(
+            "quantile supports linear interpolation (reference default)")
+    return _quantile(x, q=(tuple(q) if isinstance(q, (list, tuple))
+                           else float(q)),
+                     axis=axis, keepdim=bool(keepdim), nan_aware=False)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear",
+                name=None):
+    if interpolation != "linear":
+        raise NotImplementedError(
+            "nanquantile supports linear interpolation")
+    return _quantile(x, q=(tuple(q) if isinstance(q, (list, tuple))
+                           else float(q)),
+                     axis=axis, keepdim=bool(keepdim), nan_aware=True)
+
+
+@defop("diff_op")
+def _diff(x, *, n, axis):
+    return jnp.diff(x, n=n, axis=axis)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    """reference tensor/math.py diff."""
+    parts = []
+    if prepend is not None:
+        parts.append(prepend)
+    parts.append(x)
+    if append is not None:
+        parts.append(append)
+    if len(parts) > 1:
+        from .manipulation import concat
+        x = concat(parts, axis=axis)
+    return _diff(x, n=int(n), axis=int(axis))
+
+
+@defop("sgn_op")
+def _sgn(x):
+    if jnp.iscomplexobj(x):
+        mag = jnp.abs(x)
+        return jnp.where(mag == 0, 0, x / jnp.where(mag == 0, 1, mag))
+    return jnp.sign(x)
+
+
+def sgn(x, name=None):
+    """reference tensor/math.py sgn — sign, or x/|x| for complex."""
+    return _sgn(x)
+
+
+@defop("frexp_op", n_outputs=2)
+def _frexp(x):
+    m, e = jnp.frexp(x)
+    return m, e.astype(x.dtype)
+
+
+def frexp(x, name=None):
+    """reference tensor/math.py frexp -> (mantissa, exponent)."""
+    return _frexp(x)
+
+
+@defop("trapezoid_op")
+def _trapezoid(y, x, *, dx, axis, cumulative):
+    if cumulative:
+        # cumulative trapezoid along axis
+        y1 = jax.lax.slice_in_dim(y, 1, y.shape[axis], axis=axis)
+        y0 = jax.lax.slice_in_dim(y, 0, y.shape[axis] - 1, axis=axis)
+        if x is not None:
+            x1 = jax.lax.slice_in_dim(x, 1, x.shape[axis], axis=axis)
+            x0 = jax.lax.slice_in_dim(x, 0, x.shape[axis] - 1, axis=axis)
+            widths = x1 - x0
+        else:
+            widths = dx
+        return jnp.cumsum((y0 + y1) * widths / 2.0, axis=axis)
+    if x is not None:
+        return jnp.trapezoid(y, x=x, axis=axis)
+    return jnp.trapezoid(y, dx=dx, axis=axis)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """reference tensor/math.py trapezoid."""
+    if x is not None and dx is not None:
+        raise ValueError("trapezoid: pass x or dx, not both")
+    return _trapezoid(y, x, dx=1.0 if dx is None else float(dx),
+                      axis=int(axis), cumulative=False)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """reference tensor/math.py cumulative_trapezoid."""
+    if x is not None and dx is not None:
+        raise ValueError(
+            "cumulative_trapezoid: pass x or dx, not both")
+    return _trapezoid(y, x, dx=1.0 if dx is None else float(dx),
+                      axis=int(axis), cumulative=True)
+
+
+@defop("vander_op")
+def _vander(x, *, n, increasing):
+    return jnp.vander(x, N=n, increasing=increasing)
+
+
+def vander(x, n=None, increasing=False, name=None):
+    """reference tensor/math.py vander."""
+    n = int(n) if n is not None else int(x.shape[0])
+    return _vander(x, n=n, increasing=bool(increasing))
+
+
+@defop("polar_op")
+def _polar(abs_, angle):
+    return (abs_ * jnp.cos(angle)).astype(jnp.complex64) + \
+        1j * (abs_ * jnp.sin(angle)).astype(jnp.complex64)
+
+
+def polar(abs, angle, name=None):  # noqa: A002
+    """reference tensor/creation.py polar — complex from magnitude and
+    phase."""
+    return _polar(abs, angle)
+
+
+def tolist(x):
+    """reference tensor/math.py tolist."""
+    return np.asarray(x._value if isinstance(x, Tensor) else x).tolist()
+
+
+def tanh_(x, name=None):
+    from ..nn.functional import tanh_ as _t
+    return _t(x)
+
+
+# ------------------------------------------------------- manipulation tail
+def vsplit(x, num_or_sections, name=None):
+    """reference tensor/manipulation.py:2078 vsplit — split along dim 0;
+    a list argument is SECTION SIZES (split's contract, -1 allowed), not
+    cut indices. Requires ndim >= 2 like the reference."""
+    if x.ndim < 2:
+        raise ValueError(
+            f"vsplit expects at least a 2-D tensor, got {x.ndim}-D")
+    from .manipulation import split
+    return split(x, num_or_sections, axis=0)
+
+
+@defop("take_op")
+def _take(x, index, *, mode):
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    if mode == "wrap":
+        idx = ((index % n) + n) % n
+    else:
+        idx = jnp.clip(index, -n, n - 1)
+        idx = jnp.where(idx < 0, idx + n, idx)
+    return jnp.take(flat, idx)
+
+
+def take(x, index, mode="raise", name=None):
+    """reference tensor/math.py take — flat-index gather with
+    wrap/clip modes (mode='raise' validates on host like the
+    reference's eager path)."""
+    if mode not in ("raise", "wrap", "clip"):
+        raise ValueError(
+            f"'mode' in 'take' should be 'raise', 'wrap', 'clip', "
+            f"but received {mode}.")
+    if mode == "raise":
+        # host-side range check — only in eager; under tracing
+        # (to_static / static Program) fall through to clip semantics,
+        # matching the reference static path which cannot raise either
+        iv = index._value if isinstance(index, Tensor) else index
+        if not isinstance(iv, jax.core.Tracer):
+            n = 1
+            for s in x.shape:
+                n *= int(s)
+            iv = np.asarray(iv)
+            if (iv < -n).any() or (iv >= n).any():
+                raise ValueError("take(): index out of range")
+        mode = "clip"
+    return _take(x, index, mode=mode)
+
+
+def unflatten(x, axis, shape, name=None):
+    """reference tensor/manipulation.py unflatten."""
+    from .manipulation import reshape
+    axis = axis % x.ndim
+    new_shape = (list(x.shape[:axis]) + list(shape)
+                 + list(x.shape[axis + 1:]))
+    return reshape(x, new_shape)
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    """In-place scatter (reference tensor/manipulation.py scatter_)."""
+    from .manipulation import scatter
+    return inplace_rebind(x, scatter(x, index, updates,
+                                     overwrite=overwrite))
+
+
+def index_add_(x, index, axis, value, name=None):
+    """reference tensor/manipulation.py index_add_ — in-place rebind
+    over the existing ops.manipulation.index_add op."""
+    from .manipulation import index_add
+    return inplace_rebind(x, index_add(x, index, axis, value))
+
+
+def index_put_(x, indices, value, accumulate=False, name=None):
+    """reference tensor/manipulation.py index_put_ — in-place rebind
+    over ops.manipulation.index_put."""
+    from .manipulation import index_put
+    return inplace_rebind(x, index_put(x, indices, value, accumulate))
+
+
+# -------------------------------------------------------- framework shims
+def get_cuda_rng_state():
+    """reference framework/random.py get_cuda_rng_state — here the one
+    device RNG state is the framework key (no separate CUDA stream)."""
+    from ..framework.random import get_rng_state
+    return [get_rng_state()]
+
+
+def set_cuda_rng_state(state_list):
+    from ..framework.random import set_rng_state
+    if isinstance(state_list, (list, tuple)):
+        state_list = state_list[0]
+    set_rng_state(state_list)
+
+
+def disable_signal_handler():
+    """reference disable_signal_handler — the C++ runtime installed
+    SIGSEGV etc. hooks; this runtime installs none, so disabling is a
+    no-op kept for API compatibility."""
+
+
+class LazyGuard:
+    """reference fluid/lazy_init.py LazyGuard — defers parameter
+    allocation. Param init here is a host-side jax computation that
+    XLA only materializes on first use, so the guard's memory goal
+    holds by construction; the context manager is kept for API parity
+    (entering sets a flag user code can query)."""
+
+    _active = False
+
+    def __enter__(self):
+        type(self)._active = True
+        return self
+
+    def __exit__(self, *exc):
+        type(self)._active = False
+        return False
+
+
+def create_parameter(shape, dtype, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """reference tensor/creation.py create_parameter."""
+    from ..nn.layer import Layer
+    helper = Layer()
+    p = helper.create_parameter(shape, attr=attr, dtype=dtype,
+                                is_bias=is_bias,
+                                default_initializer=default_initializer)
+    if name:
+        p.name = name
+    return p
+
+
+def check_shape(shape):
+    """reference tensor/creation.py check_shape — validates a shape
+    argument."""
+    if isinstance(shape, Tensor):
+        return
+    for s in shape:
+        if not isinstance(s, (int, np.integer)) and not isinstance(
+                s, Tensor):
+            raise TypeError(
+                f"shape entries must be ints or Tensors, got {type(s)}")

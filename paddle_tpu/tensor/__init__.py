@@ -112,7 +112,7 @@ def _patch_methods():
         "broadcast_to": _manip.broadcast_to, "flip": _manip.flip,
         "roll": _manip.roll, "gather": _manip.gather,
         "gather_nd": _manip.gather_nd, "scatter": _manip.scatter,
-        "scatter_": _manip.scatter,
+        
         "index_select": _manip.index_select,
         "index_sample": _manip.index_sample,
         "index_add": _manip.index_add,
@@ -177,3 +177,16 @@ def _patch_methods():
 
 
 _patch_methods()
+from ..ops.misc_tail import (  # noqa: F401
+    vsplit, quantile, nanquantile, tolist, tanh_, scatter_, diff,
+    index_add_, index_put_, sgn, take, frexp,
+    trapezoid, cumulative_trapezoid, polar, vander, unflatten,
+    get_cuda_rng_state, set_cuda_rng_state, disable_signal_handler,
+    LazyGuard, create_parameter, check_shape)
+from ..ops import misc_tail as _misc_tail
+
+# in-place Tensor methods must bind the rebinding variants — the plain
+# op would silently leave the receiver unchanged
+for _n in ("scatter_", "index_add_", "index_put_", "tanh_"):
+    setattr(Tensor, _n, getattr(_misc_tail, _n))
+del _n
