@@ -149,3 +149,16 @@ class debugging:
     @staticmethod
     def disable_operator_stats_collection():
         pass
+
+
+def is_float16_supported(device=None):
+    """reference amp/__init__.py is_float16_supported — TPUs compute in
+    bf16 natively; fp16 storage works but matmul paths prefer bf16."""
+    import jax
+    return jax.default_backend() in ("tpu", "axon", "gpu")
+
+
+def is_bfloat16_supported(device=None):
+    """reference amp/__init__.py is_bfloat16_supported — always true on
+    TPU (the native mixed-precision dtype) and on CPU via XLA."""
+    return True

@@ -22,3 +22,25 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
 
 from .py_layer import PyLayer, PyLayerContext  # noqa: E402
 from .functional import jacobian, hessian, vjp, jvp  # noqa: E402
+
+
+class saved_tensors_hooks:
+    """reference python/paddle/autograd/saved_tensors_hooks.py:20 — a
+    context registering (pack_hook, unpack_hook) over every tensor the
+    tape snapshots for backward (e.g. offload-to-host-numpy packing).
+    Hooks apply to nodes RECORDED inside the context; backward may run
+    after exit."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        from ..framework.autograd import set_saved_tensors_hooks
+        set_saved_tensors_hooks((self.pack_hook, self.unpack_hook))
+        return self
+
+    def __exit__(self, *exc):
+        from ..framework.autograd import set_saved_tensors_hooks
+        set_saved_tensors_hooks(None)
+        return False

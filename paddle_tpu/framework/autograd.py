@@ -98,6 +98,16 @@ class Edge:
         self.target = t
 
 
+_saved_tensors_hooks = None
+
+
+def set_saved_tensors_hooks(hooks):
+    """(pack, unpack) pair applied to every tensor snapshot the tape
+    saves (reference saved_tensors_hooks); None disables."""
+    global _saved_tensors_hooks
+    _saved_tensors_hooks = hooks
+
+
 class TapeNode:
     """One recorded op application (GradNodeBase analog).
 
@@ -106,7 +116,8 @@ class TapeNode:
     parameter (optimizer step) cannot corrupt this node's backward.
     """
 
-    __slots__ = ("id", "name", "closure", "saved_vals", "inputs", "diff_in_mask",
+    __slots__ = ("id", "name", "closure", "_saved_store", "_unpack_hook",
+                 "inputs", "diff_in_mask",
                  "diff_out_mask", "out_avals", "released")
 
     def __init__(self, name: str, closure: Callable, saved_vals: Tuple,
@@ -115,16 +126,38 @@ class TapeNode:
         self.id = next(_node_counter)
         self.name = name
         self.closure = closure
-        self.saved_vals = saved_vals
+        hooks = _saved_tensors_hooks
+        if hooks is not None:
+            # reference autograd/saved_tensors_hooks.py: pack each saved
+            # tensor at record time, unpack at backward time
+            from .tensor import Tensor
+            pack, self._unpack_hook = hooks
+            self._saved_store = tuple(
+                pack(Tensor(v, stop_gradient=True)) for v in saved_vals)
+        else:
+            self._unpack_hook = None
+            self._saved_store = saved_vals
         self.inputs = [e if isinstance(e, Edge) else Edge(e) for e in inputs]
         self.diff_in_mask = list(diff_in_mask)
         self.diff_out_mask = list(diff_out_mask)
         self.out_avals = list(out_avals)    # (shape, dtype) per output
         self.released = False
 
+    @property
+    def saved_vals(self):
+        store = self._saved_store
+        if store is None or self._unpack_hook is None:
+            return store
+        from .tensor import Tensor
+        out = []
+        for v in store:
+            u = self._unpack_hook(v)
+            out.append(u._value if isinstance(u, Tensor) else u)
+        return tuple(out)
+
     def release(self):
         self.closure = None
-        self.saved_vals = None
+        self._saved_store = None
         self.inputs = None
         self.released = True
 
@@ -179,16 +212,19 @@ class TapeNode:
         cot_tensors = [g for g, m in zip(out_grads, self.diff_out_mask)
                        if m and g is not None]
         # reconstruct tape-linked input tensors from the frozen edges +
-        # value snapshots (live tensors may have been rebound in place)
+        # value snapshots (live tensors may have been rebound in place);
+        # read the property ONCE — each read runs the saved-tensors
+        # unpack hook over every snapshot
+        saved = self.saved_vals
         in_tensors = []
-        for edge, val in zip(self.inputs, self.saved_vals):
+        for edge, val in zip(self.inputs, saved):
             t = Tensor(val, stop_gradient=edge.stop_gradient)
             t._node = edge.node
             t._out_idx = edge.out_idx
             in_tensors.append(t)
         outs = apply(
             f"{self.name}.vjp", _vjp_op_generic, *in_tensors, *cot_tensors,
-            _closure=self.closure, _n=len(self.saved_vals),
+            _closure=self.closure, _n=len(saved),
             _diff_idx=diff_idx, _present=present,
             _diff_out_mask=tuple(self.diff_out_mask),
             _out_avals=tuple((tuple(s), str(np.dtype(d)))

@@ -237,3 +237,21 @@ class ProgramTranslator:
         caps = [c._value for c in prog.captured]
         return jax.make_jaxpr(prog.pure_fn)(
             key, *caps, *[t._value for t in in_tensors])
+
+
+# dy2static debug knobs (reference jit/dy2static/logging_utils.py
+# set_code_level/set_verbosity). There is no AST transformation stage
+# here — tracing replaces it, so there is no transformed code to print:
+# these are API-parity no-ops (like disable_signal_handler); the level
+# is retained so callers can read it back.
+_DEBUG = {"verbosity": 0, "code_level": 0}
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """API-parity no-op: there is no dy2static AST pipeline to log."""
+    _DEBUG["verbosity"] = int(level)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """API-parity no-op: tracing leaves no transformed code to print."""
+    _DEBUG["code_level"] = int(level)

@@ -363,7 +363,6 @@ def index_sample(x, index):
 
 @defop("index_add_op")
 def _index_add(x, index, axis, value):
-    import builtins
     # NB: this module defines a `slice` op that shadows the builtin
     ix = [builtins.slice(None)] * x.ndim
     ix[axis] = index
@@ -457,9 +456,9 @@ def repeat_interleave(x, repeats, axis=None, name=None):
 
 @defop("slice_op")
 def _slice_op(x, axes, starts, ends):
-    idx = [slice(None)] * x.ndim
+    idx = [builtins.slice(None)] * x.ndim
     for a, s, e in zip(axes, starts, ends):
-        idx[a] = slice(s, e)
+        idx[a] = builtins.slice(s, e)
     return x[tuple(idx)]
 
 

@@ -1,0 +1,228 @@
+"""L-BFGS optimizer (reference python/paddle/optimizer/lbfgs.py:327 —
+closure-driven quasi-Newton with two-loop recursion and an optional
+strong-Wolfe cubic line search).
+
+Host-driven by design: L-BFGS is inherently sequential (each inner
+iteration re-evaluates the closure), so the driver loop lives in Python
+while every closure evaluation runs through the normal eager/jit
+dispatch path. History (s, y, rho) is kept as flat jax vectors."""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from .optimizer import Optimizer
+
+
+def _gather_flat(params, attr):
+    vecs = []
+    for p in params:
+        v = p._value if attr == "value" else (
+            p.grad._value if p.grad is not None
+            else jnp.zeros(p._value.shape, p._value.dtype))
+        vecs.append(jnp.ravel(v.astype(jnp.float32)))
+    return jnp.concatenate(vecs)
+
+
+def _set_flat(params, flat):
+    off = 0
+    for p in params:
+        n = int(np.prod(p._value.shape)) if p._value.shape else 1
+        chunk = flat[off:off + n].reshape(p._value.shape)
+        p._value = chunk.astype(p._value.dtype)
+        off += n
+
+
+def _cubic_interpolate(x1, f1, g1, x2, f2, g2, bounds=None):
+    if bounds is not None:
+        lo, hi = bounds
+    else:
+        lo, hi = (x1, x2) if x1 <= x2 else (x2, x1)
+    d1 = g1 + g2 - 3 * (f1 - f2) / (x1 - x2)
+    d2_sq = d1 ** 2 - g1 * g2
+    if d2_sq >= 0:
+        d2 = np.sqrt(d2_sq)
+        if x1 <= x2:
+            pos = x2 - (x2 - x1) * ((g2 + d2 - d1) / (g2 - g1 + 2 * d2))
+        else:
+            pos = x1 - (x1 - x2) * ((g1 + d2 - d1) / (g1 - g2 + 2 * d2))
+        return min(max(pos, lo), hi)
+    return (lo + hi) / 2.0
+
+
+def _strong_wolfe(obj, x0, t, d, f0, g0, gtd0, c1=1e-4, c2=0.9,
+                  tol_change=1e-9, max_ls=25):
+    """Line search satisfying the strong Wolfe conditions (the
+    reference's _strong_wolfe port of minFunc)."""
+    d_norm = float(jnp.abs(d).max())
+    f_prev, g_prev, t_prev = f0, g0, 0.0
+    gtd_prev = gtd0
+    ls_iter = 0
+    done = False
+    while ls_iter < max_ls:
+        f_new, g_new = obj(x0 + t * d)
+        gtd_new = float(jnp.dot(g_new, d))
+        if f_new > f0 + c1 * t * gtd0 or (ls_iter > 0
+                                          and f_new >= f_prev):
+            bracket = [(t_prev, f_prev, g_prev, gtd_prev),
+                       (t, f_new, g_new, gtd_new)]
+            break
+        if abs(gtd_new) <= -c2 * gtd0:
+            return t, f_new, g_new
+        if gtd_new >= 0:
+            bracket = [(t_prev, f_prev, g_prev, gtd_prev),
+                       (t, f_new, g_new, gtd_new)]
+            break
+        t_next = _cubic_interpolate(t_prev, f_prev, gtd_prev, t, f_new,
+                                    gtd_new,
+                                    bounds=(t + 0.01 * (t - t_prev),
+                                            t * 10))
+        t_prev, f_prev, g_prev, gtd_prev = t, f_new, g_new, gtd_new
+        t = t_next
+        ls_iter += 1
+    else:
+        bracket = [(0.0, f0, g0, gtd0), (t, f_new, g_new, gtd_new)]
+
+    # zoom
+    while not done and ls_iter < max_ls:
+        (lo_t, lo_f, lo_g, lo_gtd), (hi_t, hi_f, hi_g, hi_gtd) = bracket
+        if abs(hi_t - lo_t) * d_norm < tol_change:
+            break
+        t = _cubic_interpolate(lo_t, lo_f, lo_gtd, hi_t, hi_f, hi_gtd)
+        f_new, g_new = obj(x0 + t * d)
+        gtd_new = float(jnp.dot(g_new, d))
+        if f_new > f0 + c1 * t * gtd0 or f_new >= lo_f:
+            bracket = [(lo_t, lo_f, lo_g, lo_gtd),
+                       (t, f_new, g_new, gtd_new)]
+        else:
+            if abs(gtd_new) <= -c2 * gtd0:
+                # the new point satisfies strong Wolfe — it must become
+                # the bracket low so the final min() returns IT, not the
+                # stale previous low
+                done = True
+                bracket = [(t, f_new, g_new, gtd_new),
+                           (hi_t, hi_f, hi_g, hi_gtd)]
+            elif gtd_new * (hi_t - lo_t) >= 0:
+                bracket = [(t, f_new, g_new, gtd_new),
+                           (lo_t, lo_f, lo_g, lo_gtd)]
+            else:
+                bracket = [(t, f_new, g_new, gtd_new),
+                           (hi_t, hi_f, hi_g, hi_gtd)]
+        ls_iter += 1
+    lo = min(bracket, key=lambda b: b[1])
+    return lo[0], lo[1], lo[2]
+
+
+class LBFGS(Optimizer):
+    """reference optimizer/lbfgs.py:327 — `opt.step(closure)` where the
+    closure clears grads, computes loss, calls backward, and returns the
+    loss tensor."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate=learning_rate,
+                         parameters=parameters,
+                         weight_decay=weight_decay, grad_clip=grad_clip,
+                         name=name)
+        self.max_iter = max_iter
+        self.max_eval = max_eval if max_eval is not None \
+            else max_iter * 5 // 4
+        self.tolerance_grad = tolerance_grad
+        self.tolerance_change = tolerance_change
+        self.history_size = history_size
+        if line_search_fn not in (None, "strong_wolfe"):
+            raise ValueError(
+                "line_search_fn must be 'strong_wolfe' or None")
+        self.line_search_fn = line_search_fn
+        self._s: List = []
+        self._y: List = []
+        self._rho: List = []
+        self._prev_flat_grad = None
+        self._H_diag = 1.0
+        self._n_evals = 0
+
+    def _evaluate(self, closure, flat_x):
+        params = self._parameter_list
+        _set_flat(params, flat_x)
+        loss = closure()
+        self._n_evals += 1
+        g = _gather_flat(params, "grad")
+        return float(np.asarray(loss._value
+                                if isinstance(loss, Tensor) else loss)
+                     ), g
+
+    def step(self, closure):
+        """Run up to max_iter L-BFGS iterations; returns the closure's
+        final loss."""
+        params = self._parameter_list
+        lr = self.get_lr()
+        self._n_evals = 0
+
+        x = _gather_flat(params, "value")
+        f, g = self._evaluate(closure, x)
+        if float(jnp.abs(g).max()) <= self.tolerance_grad:
+            return Tensor(jnp.asarray(f))
+
+        for _ in range(self.max_iter):
+            # two-loop recursion: d = -H g
+            q = g
+            alphas = []
+            for s, y_, rho in zip(reversed(self._s), reversed(self._y),
+                                  reversed(self._rho)):
+                a = rho * float(jnp.dot(s, q))
+                alphas.append(a)
+                q = q - a * y_
+            d = q * self._H_diag
+            for (s, y_, rho), a in zip(
+                    zip(self._s, self._y, self._rho),
+                    reversed(alphas)):
+                b = rho * float(jnp.dot(y_, d))
+                d = d + s * (a - b)
+            d = -d
+
+            gtd = float(jnp.dot(g, d))
+            if gtd > -self.tolerance_change:
+                break
+            t = lr if self._prev_flat_grad is not None else min(
+                1.0, 1.0 / float(jnp.abs(g).sum())) * lr
+            self._prev_flat_grad = g
+
+            if self.line_search_fn == "strong_wolfe":
+                obj = lambda xx: self._evaluate(closure, xx)  # noqa: E731
+                t, f_new, g_new = _strong_wolfe(obj, x, t, d, f, g, gtd)
+                x_new = x + t * d
+            else:
+                x_new = x + t * d
+                f_new, g_new = self._evaluate(closure, x_new)
+
+            s = x_new - x
+            y_ = g_new - g
+            ys = float(jnp.dot(y_, s))
+            if ys > 1e-10:
+                if len(self._s) >= self.history_size:
+                    self._s.pop(0)
+                    self._y.pop(0)
+                    self._rho.pop(0)
+                self._s.append(s)
+                self._y.append(y_)
+                self._rho.append(1.0 / ys)
+                self._H_diag = ys / float(jnp.dot(y_, y_))
+
+            x_prev, f_prev = x, f
+            x, f, g = x_new, f_new, g_new
+            if self._n_evals >= self.max_eval:
+                break
+            if float(jnp.abs(g).max()) <= self.tolerance_grad:
+                break
+            if float(jnp.abs(x - x_prev).max()) <= self.tolerance_change:
+                break
+            if abs(f - f_prev) < self.tolerance_change:
+                break
+
+        _set_flat(params, x)
+        return Tensor(jnp.asarray(f))

@@ -11,10 +11,34 @@ from . import ops  # noqa: F401
 from .ops import nms, roi_align  # noqa: F401
 
 
+_image_backend = {"name": "pil"}
+
+
 def set_image_backend(backend):
+    """reference vision/image.py set_image_backend (cv2 is not in this
+    image; pil and numpy are the working backends)."""
     if backend not in ("pil", "cv2", "numpy"):
-        raise ValueError(f"unknown image backend {backend!r}")
+        raise ValueError(
+            f"Expected backend are one of ['pil', 'cv2', 'numpy'], "
+            f"but got {backend}")
+    if backend == "cv2":
+        raise NotImplementedError("cv2 is not installed in this image")
+    _image_backend["name"] = backend
 
 
 def get_image_backend():
-    return "numpy"
+    return _image_backend["name"]
+
+
+def image_load(path, backend=None):
+    """reference vision/image.py image_load — PIL image (pil backend)
+    or HWC numpy array (numpy backend)."""
+    from PIL import Image
+    backend = backend or get_image_backend()
+    if backend == "cv2":
+        raise NotImplementedError("cv2 is not installed in this image")
+    img = Image.open(path)
+    if backend == "numpy":
+        import numpy as np
+        return np.array(img)
+    return img

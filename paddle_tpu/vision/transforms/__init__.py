@@ -212,3 +212,495 @@ class RandomVerticalFlip(BaseTransform):
 
 def hflip(img):
     return np.asarray(img)[:, ::-1].copy()
+
+
+# ---------------------------------------------------------------------
+# functional tail (reference transforms/functional.py — numpy/scipy
+# host implementations; inputs HWC or HW numpy arrays / PIL images)
+# ---------------------------------------------------------------------
+def vflip(img):
+    """reference functional.py vflip."""
+    return np.asarray(img)[::-1].copy()
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    """reference functional.py pad — padding int | [l/r, t/b] |
+    [left, top, right, bottom] (the reference order)."""
+    img = np.asarray(img)
+    if isinstance(padding, numbers.Number):
+        l = r = t = b = int(padding)
+    elif len(padding) == 2:
+        l = r = int(padding[0])
+        t = b = int(padding[1])
+    else:
+        l, t, r, b = (int(p) for p in padding)
+    spec = [(t, b), (l, r)] + [(0, 0)] * (img.ndim - 2)
+    if padding_mode == "constant":
+        return np.pad(img, spec, mode="constant", constant_values=fill)
+    mode = {"edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    return np.pad(img, spec, mode=mode)
+
+
+def to_grayscale(img, num_output_channels=1):
+    """reference functional.py to_grayscale — ITU-R 601-2 luma."""
+    img = np.asarray(img)
+    if img.ndim == 2:
+        g = img.astype(np.float32)
+    else:
+        g = (0.299 * img[..., 0] + 0.587 * img[..., 1]
+             + 0.114 * img[..., 2]).astype(np.float32)
+    if img.dtype == np.uint8:
+        g = np.clip(np.round(g), 0, 255).astype(np.uint8)
+    out = g[..., None]
+    if num_output_channels == 3:
+        out = np.repeat(out, 3, axis=-1)
+    return out
+
+
+def rotate(img, angle, interpolation="nearest", expand=False,
+           center=None, fill=0):
+    """reference functional.py rotate (degrees, counter-clockwise);
+    `center` pivots the rotation (the default is the image center)."""
+    from scipy import ndimage
+    img = np.asarray(img)
+    order = {"nearest": 0, "bilinear": 1, "bicubic": 3}[interpolation]
+    if center is not None and not expand:
+        # off-center pivot == affine rotation about that pivot
+        return affine(img, angle, (0, 0), 1.0, (0, 0),
+                      interpolation=interpolation, fill=fill,
+                      center=center)
+    if center is not None and expand:
+        raise NotImplementedError(
+            "rotate with both center and expand is unsupported "
+            "(the reference PIL backend has the same restriction)")
+    axes = (1, 0)
+    return ndimage.rotate(img, angle, axes=axes, reshape=bool(expand),
+                          order=order, mode="constant", cval=fill)
+
+
+def _affine_matrix(angle, translate, scale, shear, center):
+    a = np.deg2rad(angle)
+    sx, sy = (np.deg2rad(s) for s in shear)
+    cx, cy = center
+    tx, ty = translate
+    # torch/paddle convention: M = T(center) T(translate) R(angle)
+    # Shear Scale T(-center)
+    # torchvision/paddle RSS decomposition (functional.py
+    # _get_inverse_affine_matrix)
+    rot = np.array([
+        [np.cos(a - sy) / np.cos(sy),
+         -np.cos(a - sy) * np.tan(sx) / np.cos(sy) - np.sin(a)],
+        [np.sin(a - sy) / np.cos(sy),
+         -np.sin(a - sy) * np.tan(sx) / np.cos(sy) + np.cos(a)],
+    ]) * scale
+    m = np.eye(3)
+    m[:2, :2] = rot
+    m[0, 2] = cx + tx - rot[0, 0] * cx - rot[0, 1] * cy
+    m[1, 2] = cy + ty - rot[1, 0] * cx - rot[1, 1] * cy
+    return m
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """reference functional.py affine: rotate/translate/scale/shear
+    about the image center (inverse-map resampling)."""
+    from scipy import ndimage
+    img = np.asarray(img)
+    h, w = img.shape[:2]
+    if center is None:
+        center = ((w - 1) * 0.5, (h - 1) * 0.5)
+    if isinstance(shear, numbers.Number):
+        shear = (shear, 0.0)
+    m = _affine_matrix(angle, translate, scale, shear, center)
+    minv = np.linalg.inv(m)
+    order = {"nearest": 0, "bilinear": 1, "bicubic": 3}[interpolation]
+    # map output (x, y) -> input; ndimage works in (row, col)
+    mat = np.array([[minv[1, 1], minv[1, 0]],
+                    [minv[0, 1], minv[0, 0]]])
+    off = np.array([minv[1, 2], minv[0, 2]])
+
+    def warp_plane(p):
+        return ndimage.affine_transform(p, mat, offset=off, order=order,
+                                        mode="constant", cval=fill)
+
+    if img.ndim == 2:
+        return warp_plane(img)
+    return np.stack([warp_plane(img[..., c])
+                     for c in range(img.shape[-1])], axis=-1)
+
+
+def _perspective_coeffs(startpoints, endpoints):
+    # solve the 8-dof homography mapping endpoints -> startpoints
+    a = []
+    b = []
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        a.append([ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey])
+        a.append([0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey])
+        b.extend([sx, sy])
+    coeffs = np.linalg.solve(np.asarray(a, np.float64),
+                             np.asarray(b, np.float64))
+    return coeffs
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """reference functional.py perspective — warp so that startpoints
+    map onto endpoints."""
+    from scipy import ndimage
+    img = np.asarray(img)
+    h, w = img.shape[:2]
+    c = _perspective_coeffs(startpoints, endpoints)
+    order = {"nearest": 0, "bilinear": 1, "bicubic": 3}[interpolation]
+    ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    den = c[6] * xs + c[7] * ys + 1.0
+    src_x = (c[0] * xs + c[1] * ys + c[2]) / den
+    src_y = (c[3] * xs + c[4] * ys + c[5]) / den
+    coords = np.stack([src_y.ravel(), src_x.ravel()])
+
+    def warp_plane(p):
+        out = ndimage.map_coordinates(p.astype(np.float32), coords,
+                                      order=order, mode="constant",
+                                      cval=fill)
+        return out.reshape(h, w).astype(p.dtype)
+
+    if img.ndim == 2:
+        return warp_plane(img)
+    return np.stack([warp_plane(img[..., ch])
+                     for ch in range(img.shape[-1])], axis=-1)
+
+
+# ------------------------------------------------------ color adjusters
+def _blend(a, b, factor):
+    out = a.astype(np.float32) * factor + b.astype(np.float32) * (
+        1.0 - factor)
+    return out
+
+
+def _finish_color(img, ref):
+    if np.asarray(ref).dtype == np.uint8:
+        return np.clip(np.round(img), 0, 255).astype(np.uint8)
+    return img.astype(np.float32)
+
+
+def adjust_brightness(img, brightness_factor):
+    arr = np.asarray(img)
+    return _finish_color(arr.astype(np.float32) * brightness_factor, arr)
+
+
+def adjust_contrast(img, contrast_factor):
+    arr = np.asarray(img)
+    gray = to_grayscale(arr).astype(np.float32)
+    mean = gray.mean()
+    return _finish_color(_blend(arr, np.full_like(
+        arr, mean, dtype=np.float32), contrast_factor), arr)
+
+
+def adjust_saturation(img, saturation_factor):
+    arr = np.asarray(img)
+    gray = to_grayscale(arr, 3).astype(np.float32)
+    return _finish_color(_blend(arr, gray, saturation_factor), arr)
+
+
+def adjust_hue(img, hue_factor):
+    """hue_factor in [-0.5, 0.5] — shift in HSV space (reference
+    functional adjust_hue)."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    arr = np.asarray(img)
+    f = arr.astype(np.float32) / (255.0 if arr.dtype == np.uint8
+                                  else 1.0)
+    r, g, b = f[..., 0], f[..., 1], f[..., 2]
+    maxc = f[..., :3].max(-1)
+    minc = f[..., :3].min(-1)
+    v = maxc
+    d = maxc - minc
+    s = np.where(maxc > 0, d / np.maximum(maxc, 1e-12), 0.0)
+    dd = np.maximum(d, 1e-12)
+    # priority select — a tied max channel must pick ONE branch
+    hue = np.where(
+        maxc == r, ((g - b) / dd) % 6,
+        np.where(maxc == g, (b - r) / dd + 2, (r - g) / dd + 4))
+    hue = np.where(d > 0, hue, 0.0) / 6.0
+    hue = (hue + hue_factor) % 1.0
+    # hsv -> rgb
+    i = np.floor(hue * 6.0)
+    fphase = hue * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - s * fphase)
+    t = v * (1 - s * (1 - fphase))
+    i = (i.astype(np.int32) % 6)[..., None]
+    rgb = np.select(
+        [i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+        [np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+         np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+         np.stack([t, p, v], -1), np.stack([v, p, q], -1)])
+    if arr.dtype == np.uint8:
+        return np.clip(np.round(rgb * 255.0), 0, 255).astype(np.uint8)
+    return rgb.astype(np.float32)
+
+
+# ------------------------------------------------------- class transforms
+class Pad(BaseTransform):
+    """reference transforms.py Pad."""
+
+    def __init__(self, padding, fill=0, padding_mode="constant",
+                 keys=None):
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class BrightnessTransform(BaseTransform):
+    """reference transforms.py BrightnessTransform — factor drawn from
+    [max(0, 1-value), 1+value]."""
+
+    def __init__(self, value, keys=None):
+        self.value = float(value)
+
+    def _factor(self):
+        return random.uniform(max(0.0, 1 - self.value), 1 + self.value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return np.asarray(img)
+        return adjust_brightness(img, self._factor())
+
+
+class ContrastTransform(BrightnessTransform):
+    def _apply_image(self, img):
+        if self.value == 0:
+            return np.asarray(img)
+        return adjust_contrast(img, self._factor())
+
+
+class SaturationTransform(BrightnessTransform):
+    def _apply_image(self, img):
+        if self.value == 0:
+            return np.asarray(img)
+        return adjust_saturation(img, self._factor())
+
+
+class HueTransform(BaseTransform):
+    """factor drawn from [-value, value], value in [0, 0.5]."""
+
+    def __init__(self, value, keys=None):
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return np.asarray(img)
+        return adjust_hue(img, random.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    """reference transforms.py ColorJitter — random order of the four
+    adjusters."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        self.transforms = [BrightnessTransform(brightness),
+                           ContrastTransform(contrast),
+                           SaturationTransform(saturation),
+                           HueTransform(hue)]
+
+    def _apply_image(self, img):
+        order = list(range(4))
+        random.shuffle(order)
+        for i in order:
+            img = self.transforms[i]._apply_image(img)
+        return img
+
+
+class RandomResizedCrop(BaseTransform):
+    """reference transforms.py RandomResizedCrop — random area/aspect
+    crop then resize."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        img = np.asarray(img)
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ar = np.exp(random.uniform(np.log(self.ratio[0]),
+                                       np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                top = random.randint(0, h - ch)
+                left = random.randint(0, w - cw)
+                crop = img[top:top + ch, left:left + cw]
+                return _resize_np(crop, self.size)
+        # fallback: center crop of the feasible aspect
+        return _resize_np(img, self.size)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.kw = dict(interpolation=interpolation, expand=expand,
+                       center=center, fill=fill)
+
+    def _apply_image(self, img):
+        return rotate(img, random.uniform(*self.degrees), **self.kw)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.kw = dict(interpolation=interpolation, fill=fill,
+                       center=center)
+
+    def _apply_image(self, img):
+        img = np.asarray(img)
+        h, w = img.shape[:2]
+        angle = random.uniform(*self.degrees)
+        if self.translate is not None:
+            tx = random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = random.uniform(-self.translate[1], self.translate[1]) * h
+        else:
+            tx = ty = 0.0
+        sc = random.uniform(*self.scale) if self.scale else 1.0
+        if self.shear is None:
+            sh = (0.0, 0.0)
+        elif isinstance(self.shear, numbers.Number):
+            sh = (random.uniform(-self.shear, self.shear), 0.0)
+        else:
+            sh = (random.uniform(-self.shear[0], self.shear[0]),
+                  random.uniform(-self.shear[1], self.shear[1])
+                  if len(self.shear) > 1 else 0.0)
+        return affine(img, angle, (tx, ty), sc, sh, **self.kw)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def _apply_image(self, img):
+        if random.random() >= self.prob:
+            return np.asarray(img)
+        img = np.asarray(img)
+        h, w = img.shape[:2]
+        d = self.distortion_scale
+        half_w, half_h = int(w * d / 2), int(h * d / 2)
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [
+            (random.randint(0, half_w), random.randint(0, half_h)),
+            (w - 1 - random.randint(0, half_w),
+             random.randint(0, half_h)),
+            (w - 1 - random.randint(0, half_w),
+             h - 1 - random.randint(0, half_h)),
+            (random.randint(0, half_w),
+             h - 1 - random.randint(0, half_h)),
+        ]
+        return perspective(img, start, end, self.interpolation,
+                           self.fill)
+
+
+class RandomErasing(BaseTransform):
+    """reference transforms.py RandomErasing — zero/mean/random-fill a
+    random rectangle (applies to CHW tensors or HWC arrays)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def __call__(self, img):
+        chw_tensor = isinstance(img, Tensor)
+        arr = np.array(img.numpy() if chw_tensor else img)
+        if random.random() >= self.prob:
+            return to_tensor(arr) if chw_tensor else arr
+        if chw_tensor or (arr.ndim == 3 and arr.shape[0] in (1, 3)
+                          and arr.shape[-1] not in (1, 3)):
+            h_ax, w_ax = 1, 2                # CHW
+        else:
+            h_ax, w_ax = 0, 1                # HWC / HW
+        h, w = arr.shape[h_ax], arr.shape[w_ax]
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ar = np.exp(random.uniform(np.log(self.ratio[0]),
+                                       np.log(self.ratio[1])))
+            eh = int(round(np.sqrt(target / ar)))
+            ew = int(round(np.sqrt(target * ar)))
+            if eh < h and ew < w:
+                top = random.randint(0, h - eh)
+                left = random.randint(0, w - ew)
+                sl = [slice(None)] * arr.ndim
+                sl[h_ax] = slice(top, top + eh)
+                sl[w_ax] = slice(left, left + ew)
+                if self.value == "random":
+                    arr[tuple(sl)] = np.random.randn(
+                        *arr[tuple(sl)].shape).astype(arr.dtype)
+                else:
+                    arr[tuple(sl)] = self.value
+                break
+        return to_tensor(arr) if chw_tensor else arr
+
+    def _apply_image(self, img):
+        return self.__call__(img)
+
+
+__all__ += ["vflip", "pad", "to_grayscale", "rotate", "affine",
+            "perspective", "adjust_brightness", "adjust_contrast",
+            "adjust_saturation", "adjust_hue", "Pad", "Grayscale",
+            "BrightnessTransform", "ContrastTransform",
+            "SaturationTransform", "HueTransform", "ColorJitter",
+            "RandomResizedCrop", "RandomRotation", "RandomAffine",
+            "RandomPerspective", "RandomErasing"]
+
+
+def crop(img, top, left, height, width):
+    """reference functional.py crop."""
+    return np.asarray(img)[top:top + height, left:left + width].copy()
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """reference functional.py erase — fill img[i:i+h, j:j+w] with v
+    (HWC arrays / CHW Tensors)."""
+    if isinstance(img, Tensor):
+        arr = np.array(img.numpy())
+        arr[..., i:i + h, j:j + w] = v
+        return to_tensor(arr)
+    arr = np.asarray(img) if inplace else np.array(img)
+    arr[i:i + h, j:j + w] = v
+    return arr
+
+
+__all__ += ["crop", "erase"]

@@ -337,3 +337,73 @@ class TestReviewRegressions:
         v1, g1 = loss(0.5)
         assert abs(v0 - v1) < 1e-5          # value unchanged
         assert not np.allclose(g0, g1)      # gradient differs
+
+
+class TestSmallShims:
+    def test_lbfgs_quadratic(self):
+        import jax.numpy as jnp
+        from paddle_tpu.nn.parameter import Parameter
+        p = Parameter(jnp.asarray([5.0, -3.0], jnp.float32))
+        target = np.array([1.0, 2.0], np.float32)
+        opt = paddle.optimizer.LBFGS(
+            learning_rate=1.0, max_iter=20,
+            line_search_fn="strong_wolfe", parameters=[p])
+
+        def closure():
+            opt.clear_grad()
+            diff = p - paddle.to_tensor(target)
+            loss = (diff * diff).sum()
+            loss.backward()
+            return loss
+
+        loss = opt.step(closure)
+        assert float(loss.numpy()) < 1e-8
+        np.testing.assert_allclose(p.numpy(), target, atol=1e-4)
+
+    def test_saved_tensors_hooks(self):
+        packed, unpacked = [], []
+
+        def pack(t):
+            packed.append(tuple(t.shape))
+            return np.asarray(t.numpy())
+
+        def unpack(v):
+            unpacked.append(v.shape)
+            return paddle.to_tensor(v)
+
+        x = paddle.to_tensor(np.array([2.0, 3.0], np.float32),
+                             stop_gradient=False)
+        with paddle.autograd.saved_tensors_hooks(pack, unpack):
+            y = (x * x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+        assert packed and unpacked
+        # outside the context: hooks no longer fire
+        packed.clear()
+        x2 = paddle.to_tensor(np.array([1.0], np.float32),
+                              stop_gradient=False)
+        (x2 * 2).sum().backward()
+        assert not packed
+
+    def test_amp_support_flags_and_jit_knobs(self):
+        assert paddle.amp.is_bfloat16_supported() is True
+        assert isinstance(paddle.amp.is_float16_supported(), bool)
+        paddle.jit.set_verbosity(3)
+        paddle.jit.set_code_level(100)
+
+    def test_image_backend_and_load(self, tmp_path):
+        from PIL import Image
+        from paddle_tpu import vision
+        arr = np.zeros((4, 4, 3), np.uint8)
+        Image.fromarray(arr).save(tmp_path / "t.png")
+        assert vision.get_image_backend() == "pil"
+        img = vision.image_load(str(tmp_path / "t.png"))
+        assert img.size == (4, 4)
+        vision.set_image_backend("numpy")
+        try:
+            out = vision.image_load(str(tmp_path / "t.png"))
+            assert out.shape == (4, 4, 3)
+        finally:
+            vision.set_image_backend("pil")
+        with pytest.raises(ValueError):
+            vision.set_image_backend("bogus")

@@ -1,0 +1,78 @@
+"""Transforms tail (reference vision/transforms functional + classes)."""
+import random
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import transforms as T
+
+rng = np.random.RandomState(0)
+
+
+class TestFunctionalTail:
+    def test_flip_pad_grayscale(self):
+        img = rng.randint(0, 255, (8, 10, 3)).astype(np.uint8)
+        np.testing.assert_array_equal(T.vflip(img), img[::-1])
+        p = T.pad(img, [1, 2, 3, 4])          # [l, t, r, b]
+        assert p.shape == (8 + 2 + 4, 10 + 1 + 3, 3)
+        g = T.to_grayscale(img)
+        assert g.shape == (8, 10, 1) and g.dtype == np.uint8
+        g3 = T.to_grayscale(img, 3)
+        assert (g3[..., 0] == g3[..., 1]).all()
+
+    def test_rotate_affine_perspective_identities(self):
+        sq = rng.randint(0, 255, (6, 6)).astype(np.uint8)
+        np.testing.assert_array_equal(T.rotate(sq, 90), np.rot90(sq))
+        np.testing.assert_array_equal(
+            T.affine(sq, 0, (0, 0), 1.0, (0, 0)), sq)
+        shifted = T.affine(sq.astype(np.float32), 0, (1, 0), 1.0,
+                           (0, 0))
+        np.testing.assert_array_equal(shifted[:, 1:],
+                                      sq[:, :-1].astype(np.float32))
+        corners = [(0, 0), (5, 0), (5, 5), (0, 5)]
+        np.testing.assert_array_equal(
+            T.perspective(sq, corners, corners), sq)
+
+    def test_color_adjusters(self):
+        img = rng.randint(0, 255, (8, 10, 3)).astype(np.uint8)
+        f = img.astype(np.float32)
+        np.testing.assert_allclose(T.adjust_brightness(f, 0.5), f * 0.5,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(T.adjust_contrast(f, 1.0), f,
+                                   rtol=1e-5)
+        np.testing.assert_allclose(T.adjust_saturation(f, 1.0), f,
+                                   rtol=1e-5)
+        u = f / 255.0
+        np.testing.assert_allclose(T.adjust_hue(u, 0.0), u, atol=1e-4)
+        # period-1 hue: +0.5 twice round-trips
+        np.testing.assert_allclose(T.adjust_hue(T.adjust_hue(u, 0.5),
+                                                0.5), u, atol=2e-2)
+        with pytest.raises(ValueError):
+            T.adjust_hue(u, 0.7)
+
+
+class TestClassTail:
+    def test_random_transforms_shapes(self):
+        random.seed(0)
+        img = rng.randint(0, 255, (8, 10, 3)).astype(np.uint8)
+        sq = rng.randint(0, 255, (6, 6)).astype(np.uint8)
+        assert T.RandomResizedCrop(4)(img).shape[:2] == (4, 4)
+        assert T.ColorJitter(0.2, 0.2, 0.2, 0.1)(img).shape == img.shape
+        assert T.RandomRotation(30)(sq).shape == sq.shape
+        assert T.RandomAffine(10, translate=(0.1, 0.1),
+                              scale=(0.9, 1.1), shear=5)(sq).shape == \
+            sq.shape
+        assert T.RandomPerspective(prob=1.0)(sq).shape == sq.shape
+        assert T.Grayscale(3)(img).shape == (8, 10, 3)
+        assert T.Pad(2)(img).shape == (12, 14, 3)
+
+    def test_random_erasing_both_layouts(self):
+        random.seed(0)
+        img = rng.randint(1, 255, (8, 10, 3)).astype(np.uint8)
+        er = T.RandomErasing(prob=1.0)(img.copy())
+        assert er.shape == img.shape and (er == 0).any()
+        tens = paddle.to_tensor(
+            img.transpose(2, 0, 1).astype(np.float32))
+        ert = T.RandomErasing(prob=1.0)(tens)
+        assert tuple(ert.shape) == (3, 8, 10)
