@@ -22,3 +22,11 @@ from . import rpc  # noqa: E402,F401
 # reference spelling: paddle.distributed.fleet.auto (Engine lives there)
 fleet.auto = auto_parallel
 _sys.modules[__name__ + ".fleet.auto"] = auto_parallel
+from ..parallel.dist_tail import (  # noqa: E402,F401
+    gather, all_gather_object, scatter_object_list,
+    broadcast_object_list, alltoall, alltoall_single, isend, irecv,
+    ParallelMode, destroy_process_group, is_available, get_backend,
+    gloo_init_parallel_env, gloo_barrier, gloo_release, InMemoryDataset,
+    QueueDataset, split, CountFilterEntry, ShowClickEntry,
+    ProbabilityEntry, io)
+_sys.modules[__name__ + ".io"] = io

@@ -1,0 +1,358 @@
+"""distributed namespace tail (reference
+python/paddle/distributed/__init__.py names beyond the core
+collectives: communication/group.py object collectives, gloo shims,
+fleet/dataset InMemoryDataset/QueueDataset, auto_parallel split,
+parameter-server Entry configs, ParallelMode, p2p isend/irecv,
+distributed.io).
+
+Single-controller notes: object collectives serialize via pickle to
+uint8 tensors over the array collectives; gloo (the reference's CPU
+rendezvous fabric) collapses to the in-process barrier — the
+coordination service is jax.distributed."""
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from .collective import all_gather, broadcast, scatter, barrier
+from .env import get_rank, get_world_size
+
+__all__ = [
+    "gather", "all_gather_object", "scatter_object_list",
+    "broadcast_object_list", "alltoall", "alltoall_single", "isend",
+    "irecv", "ParallelMode", "destroy_process_group", "is_available",
+    "get_backend", "gloo_init_parallel_env", "gloo_barrier",
+    "gloo_release", "InMemoryDataset", "QueueDataset", "split",
+    "CountFilterEntry", "ShowClickEntry", "ProbabilityEntry", "io",
+]
+
+
+class ParallelMode:
+    """reference parallel/parallel_mode.py — hybrid-parallel mode ids."""
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+def is_available():
+    """reference distributed.is_available."""
+    return True
+
+
+def get_backend(group=None):
+    """reference distributed.get_backend — the one backend here is the
+    XLA collective fabric (ICI/DCN)."""
+    return "xla"
+
+
+def destroy_process_group(group=None):
+    """reference destroy_process_group — drops the cached mesh stack
+    (jax.distributed owns actual process lifetime)."""
+    from .mesh import _mesh_stack
+    _mesh_stack().clear()
+
+
+# ------------------------------------------------------------ p2p async
+class _DoneTask:
+    """Completed-communication handle (reference returns a Task with
+    wait(); XLA collectives complete inside the compiled program, so
+    the handle is always done)."""
+
+    def wait(self):
+        return None
+
+    def is_completed(self):
+        return True
+
+
+def isend(tensor, dst=0, group=None):
+    from .collective import send
+    send(tensor, dst=dst, group=group)        # raises with guidance
+    return _DoneTask()
+
+
+def irecv(tensor, src=0, group=None):
+    from .collective import recv
+    recv(tensor, src=src, group=group)
+    return _DoneTask()
+
+
+# ------------------------------------------------------- gather (to dst)
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """reference communication/gather.py — all ranks contribute, dst
+    receives the list. Single-controller SPMD: every "rank" shares the
+    controller, so gather == all_gather with dst semantics preserved."""
+    tmp = []
+    all_gather(tmp, tensor, group=group)
+    if gather_list is not None and get_rank() == dst:
+        gather_list.extend(tmp)
+    return tmp if get_rank() == dst else None
+
+
+# ------------------------------------------------------ object collectives
+def _obj_to_tensor(obj):
+    buf = np.frombuffer(pickle.dumps(obj), np.uint8).copy()
+    return Tensor(jnp.asarray(buf)), len(buf)
+
+
+def _tensor_to_obj(t, n):
+    return pickle.loads(np.asarray(t._value)[:n].tobytes())
+
+
+def all_gather_object(object_list, obj, group=None):
+    """reference communication/all_gather.py all_gather_object."""
+    t, n = _obj_to_tensor(obj)
+    gathered = []
+    all_gather(gathered, t, group=group)
+    ns = []
+    all_gather(ns, Tensor(jnp.asarray([n], jnp.int32)), group=group)
+    object_list.extend(
+        _tensor_to_obj(g, int(np.asarray(m._value)[0]))
+        for g, m in zip(gathered, ns))
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """reference communication/broadcast.py broadcast_object_list —
+    in-place broadcast of the picklable list from src."""
+    t, n = _obj_to_tensor(object_list)
+    out = broadcast(t, src=src, group=group)
+    new = _tensor_to_obj(out if out is not None else t, n)
+    object_list[:] = new
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """reference communication/scatter.py scatter_object_list."""
+    world = max(get_world_size(), 1)
+    if in_object_list is None:
+        in_object_list = [None] * world
+    rank = get_rank()
+    out_object_list[:] = [in_object_list[rank % len(in_object_list)]]
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    """reference alltoall — alias of the core all_to_all."""
+    from .collective import all_to_all
+    return all_to_all(out_tensor_list, in_tensor_list, group=group,
+                      sync_op=sync_op)
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    """reference alltoall_single — the single-tensor equal-split form:
+    in [world*chunk, ...] scatters row-blocks across ranks."""
+    if in_split_sizes is not None or out_split_sizes is not None:
+        raise NotImplementedError(
+            "alltoall_single with explicit split sizes is unsupported "
+            "(equal splits only); use alltoall with an explicit list")
+    from .collective import all_to_all, _group_info
+    _mesh, _axes, world = _group_info(group)
+    world = max(world, 1)
+    ins = [Tensor(v) for v in jnp.split(
+        in_tensor._value if isinstance(in_tensor, Tensor)
+        else jnp.asarray(in_tensor), world, axis=0)]
+    outs: list = []
+    all_to_all(outs, ins, group=group, sync_op=sync_op)
+    result = jnp.concatenate([o._value for o in outs], axis=0)
+    if out_tensor is not None:
+        out_tensor._value = result
+        return out_tensor
+    return Tensor(result)
+
+
+# ---------------------------------------------------------------- gloo
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """reference gloo_init_parallel_env — the CPU rendezvous fabric.
+    Coordination here is jax.distributed.initialize
+    (parallel/env.py init_parallel_env); nothing further to set up."""
+
+
+def gloo_barrier():
+    barrier()
+
+
+def gloo_release():
+    """No gloo store to release (see gloo_init_parallel_env)."""
+
+
+# ----------------------------------------------------- fleet dataset shims
+class InMemoryDataset:
+    """reference distributed/fleet/dataset InMemoryDataset — the
+    parameter-server training data pipeline (load_into_memory /
+    shuffle / batching over slot files). Mapped onto paddle_tpu.io:
+    filelists parse into numpy batches held in memory."""
+
+    def __init__(self):
+        self._filelist = []
+        self._records = []
+        self._batch_size = 1
+        self._parse_fn = None
+
+    def init(self, batch_size=1, use_var=None, pipe_command=None,
+             parse_fn=None, **kwargs):
+        self._batch_size = batch_size
+        self._parse_fn = parse_fn
+
+    update_settings = init
+
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    def load_into_memory(self):
+        self._records = []
+        for path in self._filelist:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    rec = (self._parse_fn(line) if self._parse_fn
+                           else np.fromstring(line, sep=" "))
+                    self._records.append(rec)
+
+    def local_shuffle(self):
+        from ..framework import random as frandom
+        rng = np.random.default_rng(frandom.next_host_seed())
+        rng.shuffle(self._records)
+
+    global_shuffle = local_shuffle
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._records)
+
+    def release_memory(self):
+        self._records = []
+
+    def __iter__(self):
+        b = self._batch_size
+        for i in range(0, len(self._records) - b + 1, b):
+            yield np.stack(self._records[i:i + b])
+
+
+class QueueDataset(InMemoryDataset):
+    """reference QueueDataset — streaming variant; same local file
+    pipeline here (no PS data service), streamed lazily."""
+
+    def load_into_memory(self):
+        raise RuntimeError(
+            "QueueDataset streams from file; iterate it directly "
+            "(load_into_memory is the InMemoryDataset API)")
+
+    def __iter__(self):
+        batch = []
+        for path in self._filelist:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    rec = (self._parse_fn(line) if self._parse_fn
+                           else np.fromstring(line, sep=" "))
+                    batch.append(rec)
+                    if len(batch) == self._batch_size:
+                        yield np.stack(batch)
+                        batch = []
+
+
+# ------------------------------------------------- PS entry configs
+class _Entry:
+    def __init__(self, **kw):
+        self._kw = kw
+
+    def _to_attr(self):
+        parts = [type(self).__name__]
+        parts += [f"{k}:{v}" for k, v in self._kw.items()]
+        return " ".join(parts)
+
+
+class ProbabilityEntry(_Entry):
+    """reference entry_attr ProbabilityEntry — sparse feature admitted
+    with probability p (PS sparse-table config; carried as metadata for
+    sparse_embedding)."""
+
+    def __init__(self, probability):
+        if not 0 < probability <= 1:
+            raise ValueError("probability must be in (0, 1]")
+        super().__init__(probability=probability)
+
+
+class CountFilterEntry(_Entry):
+    """reference CountFilterEntry — admit features seen >= count
+    times."""
+
+    def __init__(self, count_filter):
+        if count_filter < 0:
+            raise ValueError("count_filter must be >= 0")
+        super().__init__(count_filter=count_filter)
+
+
+class ShowClickEntry(_Entry):
+    """reference ShowClickEntry — show/click slot names for CTR
+    tables."""
+
+    def __init__(self, show_name, click_name):
+        super().__init__(show=show_name, click=click_name)
+
+
+# ---------------------------------------------- tensor-parallel split
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """reference distributed/collective.py split — model-parallel
+    embedding/linear with the weight split over `num_partitions`. On the
+    mesh this is exactly the mp_layers path: the NamedSharding over the
+    'mp' axis does the partitioning, and GSPMD inserts the collectives
+    gather_out implies."""
+    from . import mp_layers
+    if operation == "embedding":
+        layer = mp_layers.VocabParallelEmbedding(
+            size[0], size[1], weight_attr=weight_attr)
+        return layer(x)
+    if operation == "linear":
+        if axis == 0:
+            layer = mp_layers.RowParallelLinear(
+                size[0], size[1], weight_attr=weight_attr,
+                has_bias=bias_attr is not False,
+                input_is_parallel=False)
+        else:
+            layer = mp_layers.ColumnParallelLinear(
+                size[0], size[1], weight_attr=weight_attr,
+                has_bias=bias_attr is not False,
+                gather_output=gather_out)
+        return layer(x)
+    raise ValueError(
+        f"operation should be 'linear' or 'embedding', got {operation}")
+
+
+# ------------------------------------------------------- distributed.io
+class _DistributedIO:
+    """reference distributed/io.py — persistables save/load in
+    distributed training; delegates to the static io (one controller
+    owns the full state; sharded checkpoints live in
+    parallel.checkpoint)."""
+
+    @staticmethod
+    def save_persistables(executor, dirname, main_program=None,
+                          filename=None):
+        import os
+        from ..static import save
+        os.makedirs(dirname, exist_ok=True)
+        save(main_program, os.path.join(dirname, filename or "params"))
+
+    @staticmethod
+    def load_persistables(executor, dirname, main_program=None,
+                          filename=None):
+        import os
+        from ..static import load
+        load(main_program, os.path.join(dirname, filename or "params"))
+
+    @staticmethod
+    def is_persistable(var):
+        return bool(getattr(var, "is_parameter", False))
+
+
+io = _DistributedIO()

@@ -183,3 +183,13 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
     meta = layer._meta
     return [layer, list(meta.get("feed_names", [])),
             list(meta.get("fetch_names", []))]
+from .extras import (  # noqa: F401
+    gradients, BuildStrategy, ExecutionStrategy, CompiledProgram, Print,
+    py_func, name_scope, device_guard, WeightNormParamAttr,
+    ExponentialMovingAverage, save, load, serialize_program,
+    serialize_persistables, save_to_file, deserialize_program,
+    deserialize_persistables, load_from_file, normalize_program,
+    load_program_state, set_program_state, cuda_places, xpu_places,
+    create_global_var, accuracy, auc, ctr_metric_bundle,
+    exponential_decay, ipu_shard_guard, IpuCompiledProgram, IpuStrategy,
+    set_ipu_shard)

@@ -1,0 +1,482 @@
+"""static namespace tail (reference python/paddle/static/__init__.py
+names beyond Program/Executor: fluid/backward.py:2605 gradients,
+compiler.py BuildStrategy/ExecutionStrategy/CompiledProgram,
+static/io.py save/load/serialize_*, incubate ExponentialMovingAverage,
+nn/common.py py_func, layers Print, device_guard/name_scope,
+static/nn/metric.py accuracy/auc/ctr_metric_bundle).
+
+Design note: XLA owns the graph-pass pipeline, so the reference's
+BuildStrategy/ExecutionStrategy knobs carry no levers here — they are
+kept as faithful config containers (their fields round-trip) feeding
+CompiledProgram, which the Executor accepts interchangeably with
+Program. IPU classes are hardware-specific stubs that raise."""
+from __future__ import annotations
+
+import contextlib
+import os
+import pickle
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from .program import (Program, Variable, default_main_program,
+                      global_scope, append_backward)
+
+__all__ = [
+    "gradients", "BuildStrategy", "ExecutionStrategy", "CompiledProgram",
+    "Print", "py_func", "name_scope", "device_guard",
+    "WeightNormParamAttr", "ExponentialMovingAverage", "save", "load",
+    "serialize_program", "serialize_persistables", "save_to_file",
+    "deserialize_program", "deserialize_persistables", "load_from_file",
+    "normalize_program", "load_program_state", "set_program_state",
+    "cuda_places", "xpu_places", "create_global_var", "accuracy", "auc",
+    "ctr_metric_bundle", "exponential_decay", "ipu_shard_guard",
+    "IpuCompiledProgram", "IpuStrategy", "set_ipu_shard",
+]
+
+
+# ------------------------------------------------------------- autodiff
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """reference fluid/backward.py:2605 — grad vars of sum(targets)
+    wrt `inputs`; fetch the returned vars to read values (the Executor
+    differentiates the composed program wrt params and float feeds)."""
+    if target_gradients is not None:
+        raise NotImplementedError(
+            "gradients(target_gradients=...) custom cotangents are not "
+            "supported; scale the targets instead")
+    if no_grad_set:
+        raise NotImplementedError(
+            "gradients(no_grad_set=...) is not supported; mark vars "
+            "stop_gradient at creation instead")
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    # the implicit cotangent is ones over every target (reference
+    # fills ones): differentiate the SUM over all target elements
+    loss = targets[0].sum()
+    for t in targets[1:]:
+        loss = loss + t.sum()
+    append_backward(loss)
+    return [f"{v.name}@GRAD" for v in inputs]
+
+
+# -------------------------------------------------- compiler containers
+class BuildStrategy:
+    """reference compiler.py BuildStrategy — pass-pipeline knobs. XLA
+    performs fusion/memory passes itself; fields round-trip for config
+    compatibility and are otherwise inert by design."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.fuse_elewise_add_act_ops = True
+        self.fuse_bn_act_ops = True
+        self.fuse_broadcast_ops = True
+        self.memory_optimize = True
+        self.build_cuda_graph = False
+        self.reduce_strategy = 0
+        self.gradient_scale_strategy = 0
+        self.debug_graphviz_path = ""
+
+    def __repr__(self):
+        flags = {k: v for k, v in self.__dict__.items()}
+        return f"BuildStrategy({flags})"
+
+
+class ExecutionStrategy:
+    """reference compiler.py ExecutionStrategy — executor threading
+    knobs; PJRT schedules asynchronously, fields kept for config
+    parity."""
+
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+        self.num_iteration_per_run = 1
+
+
+class CompiledProgram:
+    """reference compiler.py CompiledProgram — wraps a Program with a
+    BuildStrategy; the Executor accepts it wherever a Program goes
+    (attribute access forwards)."""
+
+    def __init__(self, program_or_graph, build_strategy=None):
+        self._program = program_or_graph
+        self._build_strategy = build_strategy or BuildStrategy()
+
+    def with_data_parallel(self, *a, **kw):
+        # single-controller SPMD: data parallelism comes from sharding,
+        # not graph replication
+        return self
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_program"], name)
+
+
+# --------------------------------------------------------- debug / util
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both", name=None):
+    """reference layers Print op — passes the value through and prints
+    it (jax.debug.print inside traced graphs, host print in eager)."""
+    from ..framework.dispatch import apply
+
+    # braces in a user message must not reach the format string
+    msg = (message or "").replace("{", "{{").replace("}", "}}")
+
+    def _print(x, _msg=None):
+        jax.debug.print(_msg + " {}", x)
+        return x
+
+    return apply("print_op", _print, input, _msg=msg)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """reference static/nn/common.py py_func — run a host python
+    function as a graph op via jax.pure_callback; out supplies the
+    result spec (shape/dtype)."""
+    from ..framework.dispatch import apply
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    specs = tuple(jax.ShapeDtypeStruct(tuple(o.shape),
+                                       np.dtype(o.dtype.name
+                                                if hasattr(o.dtype, "name")
+                                                else o.dtype))
+                  for o in outs)
+
+    def _op(*vals, _specs=None):
+        res = jax.pure_callback(
+            lambda *hv: func(*[np.asarray(v) for v in hv]),
+            _specs if len(_specs) > 1 else _specs[0], *vals)
+        return res
+
+    return apply("py_func_op", _op, *xs, _specs=specs)
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    """reference framework name_scope — op-name prefixes for
+    visualization; names here come from op registration, so the scope
+    tracks the prefix stack for tooling."""
+    _name_stack.append(prefix or "")
+    try:
+        yield
+    finally:
+        _name_stack.pop()
+
+
+_name_stack: list = []
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """reference framework device_guard — XLA places ops; the guard is
+    accepted and ignored by design (no per-op placement on TPU)."""
+    yield
+
+
+@contextlib.contextmanager
+def ipu_shard_guard(index=-1, stage=-1):
+    raise NotImplementedError(
+        "IPU sharding is GraphCore-hardware specific; this framework "
+        "targets TPU (shard via paddle_tpu.distributed meshes)")
+    yield  # pragma: no cover
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    raise NotImplementedError(
+        "IPU sharding is GraphCore-hardware specific")
+
+
+class IpuStrategy:
+    def __init__(self):
+        raise NotImplementedError(
+            "IPU support is GraphCore-hardware specific; not available "
+            "on the TPU backend")
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **kw):
+        raise NotImplementedError(
+            "IPU support is GraphCore-hardware specific; not available "
+            "on the TPU backend")
+
+
+# ------------------------------------------------------------ ParamAttr
+class WeightNormParamAttr:
+    """reference static WeightNormParamAttr — ParamAttr requesting
+    weight-norm reparameterization along `dim`. Layers consume it like
+    ParamAttr; apply paddle_tpu.nn.utils.weight_norm on the built layer
+    for the reparameterized training path."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+
+# ----------------------------------------------------------------- EMA
+class ExponentialMovingAverage:
+    """reference incubate ExponentialMovingAverage — shadow = decay *
+    shadow + (1 - decay) * param, with apply()/restore() context for
+    evaluation. Eager-mode: tracks a parameter list."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None,
+                 parameters=None):
+        self._decay = decay
+        self._thres_steps = thres_steps
+        self._params = list(parameters) if parameters is not None else \
+            None
+        self._shadow = {}
+        self._backup = {}
+        self._step = 0
+
+    def _param_list(self):
+        if self._params is None:
+            raise ValueError(
+                "pass parameters=model.parameters() when using the EMA "
+                "eagerly (the reference's static path reads the Program)")
+        return self._params
+
+    def update(self):
+        self._step += 1
+        # the reference ramps the decay only when thres_steps is given
+        # (fluid/optimizer.py ExponentialMovingAverage)
+        d = self._decay if self._thres_steps is None else min(
+            self._decay, (1 + self._step) / (10 + self._step))
+        for p in self._param_list():
+            prev = self._shadow.get(id(p), p._value)
+            self._shadow[id(p)] = d * prev + (1 - d) * p._value
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        params = self._param_list()
+        self._backup = {id(p): p._value for p in params}
+        for p in params:
+            if id(p) in self._shadow:
+                p._value = self._shadow[id(p)]
+        try:
+            yield
+        finally:
+            if need_restore:
+                for p in params:
+                    p._value = self._backup[id(p)]
+                self._backup = {}
+
+    def restore(self, executor=None):
+        for p in self._param_list():
+            if id(p) in self._backup:
+                p._value = self._backup[id(p)]
+        self._backup = {}
+
+
+# ------------------------------------------------------------ serialization
+def serialize_persistables(feed_vars, fetch_vars, executor=None,
+                           program=None):
+    """reference static/io.py — parameters of the program as bytes."""
+    program = program or default_main_program()
+    scope = global_scope()
+    state = {}
+    for p in program.all_parameters():
+        v = scope.find_var(p.name)
+        if v is not None:
+            state[p.name] = v.numpy()
+    return pickle.dumps(state)
+
+
+def deserialize_persistables(program, data, executor=None):
+    state = pickle.loads(data)
+    set_program_state(program, state)
+    return program
+
+
+def serialize_program(feed_vars=None, fetch_vars=None, program=None,
+                      **kwargs):
+    """reference static/io.py serialize_program. The executable
+    round-trip artifact is StableHLO (static.save_inference_model /
+    jit.save); these bytes carry the op-list description — enough to
+    rebuild an inspectable Program (deserialize_program) and to ship
+    alongside serialize_persistables."""
+    program = program or default_main_program()
+    desc = {
+        "random_seed": program.random_seed,
+        "vars": [(v.name, tuple(v.shape), str(v.dtype),
+                  v.is_parameter) for v in program.list_vars()],
+        "ops": [str(op) for op in program.global_block().ops],
+    }
+    return pickle.dumps(desc)
+
+
+def deserialize_program(data):
+    desc = pickle.loads(data)
+    p = Program()
+    p.random_seed = desc["random_seed"]
+    p._serialized_desc = desc
+    return p
+
+
+def save_to_file(path, content):
+    """reference static/io.py save_to_file."""
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def save(program, model_path, protocol=4, **configs):
+    """reference static/io.py save — <path>.pdparams (+ .pdmodel)."""
+    with open(model_path + ".pdparams", "wb") as f:
+        f.write(serialize_persistables(None, None, program=program))
+    with open(model_path + ".pdmodel", "wb") as f:
+        f.write(serialize_program(program=program))
+
+
+def load(program, model_path, executor=None, var_list=None):
+    """reference static/io.py load — restores .pdparams into the
+    scope."""
+    with open(model_path + ".pdparams", "rb") as f:
+        deserialize_persistables(program, f.read())
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """reference static/io.py normalize_program — prunes a program to
+    the feed->fetch slice. Replay already executes only recorded ops;
+    the clone drops the training spec (inference slice)."""
+    return program.clone(for_test=True)
+
+
+def load_program_state(model_path, var_list=None):
+    """reference static/io.py load_program_state -> {name: ndarray}."""
+    path = model_path if model_path.endswith(".pdparams") else \
+        model_path + ".pdparams"
+    with open(path, "rb") as f:
+        return pickle.loads(f.read())
+
+
+def set_program_state(program, state_dict):
+    """reference static/io.py set_program_state."""
+    scope = global_scope()
+    for name, val in state_dict.items():
+        scope.var(name).set(jnp.asarray(val))
+    return program
+
+
+# ------------------------------------------------------------ places / vars
+def cuda_places(device_ids=None):
+    """reference cuda_places — maps to the accelerator device list
+    (TPU chips here)."""
+    devs = jax.devices()
+    if device_ids is None:
+        return list(devs)
+    ids = [device_ids] if isinstance(device_ids, int) else device_ids
+    return [devs[i] for i in ids]
+
+
+def xpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """reference create_global_var — a filled persistable var living in
+    the global scope."""
+    from ..framework import dtype as dtypes
+    prog = default_main_program()
+    name = name or prog._unique_name("global_var")
+    dt = dtypes.convert_dtype(dtype)
+    shape = tuple(int(s) for s in shape)
+    val = jnp.full(shape, value, dt)
+    block = prog.global_block()
+    # replayed programs seed their env from parameter vars + feeds, so
+    # the global var must ride the parameter channel — stop_gradient
+    # keeps the optimizer's hands off it (executor skips non-trainables)
+    var = Variable(name, shape, dt, block, is_parameter=True,
+                   stop_gradient=True)
+    var.persistable = bool(persistable)
+    block.vars[name] = var
+    global_scope().var(name).set(val)
+    return var
+
+
+# ------------------------------------------------------------- metrics
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """reference static/nn/metric.py accuracy — top-k accuracy as a
+    graph op."""
+    from ..framework.dispatch import apply
+
+    def _acc(logits, lab, _k=1):
+        topk = jnp.argsort(-logits, axis=-1)[:, :_k]
+        hit = (topk == lab.reshape(-1, 1)).any(axis=1)
+        return jnp.mean(hit.astype(jnp.float32))
+
+    return apply("accuracy_op", _acc, input, label, _k=int(k))
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095,
+        topk=1, slide_steps=1, ins_tag_weight=None):
+    """reference static/nn/metric.py auc — bucketed ROC-AUC op (returns
+    (auc_out, batch_auc_out, [stat vars]) in the reference; here the
+    scalar AUC plus the bucket statistics)."""
+    from ..framework.dispatch import apply
+
+    def _auc(pred, lab, _n=4095):
+        pos_score = pred[:, -1] if pred.ndim == 2 else pred
+        bucket = jnp.clip((pos_score * _n).astype(jnp.int32), 0, _n)
+        labf = lab.reshape(-1).astype(jnp.float32)
+        pos_hist = jnp.zeros((_n + 1,)).at[bucket].add(labf)
+        neg_hist = jnp.zeros((_n + 1,)).at[bucket].add(1.0 - labf)
+        # integrate from the high-score end (standard bucketed AUC)
+        tp = jnp.cumsum(pos_hist[::-1])
+        fp = jnp.cumsum(neg_hist[::-1])
+        tot_pos = tp[-1]
+        tot_neg = fp[-1]
+        tp0 = jnp.concatenate([jnp.zeros(1), tp[:-1]])
+        fp0 = jnp.concatenate([jnp.zeros(1), fp[:-1]])
+        area = jnp.sum((fp - fp0) * (tp + tp0) / 2.0)
+        return area / jnp.maximum(tot_pos * tot_neg, 1e-12)
+
+    out = apply("auc_op", _auc, input, label, _n=int(num_thresholds))
+    return out, out, []
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """reference static/nn/metric.py ctr_metric_bundle — (auc, sqrerr,
+    abserr, prob, q, pos, total) aggregates for CTR evaluation."""
+    from ..framework.dispatch import apply
+    auc_out, _, _ = auc(input, label)
+
+    def _stats(pred, lab):
+        p = pred[:, -1] if pred.ndim == 2 else pred
+        labf = lab.reshape(-1).astype(jnp.float32)
+        sqrerr = jnp.sum(jnp.square(p - labf))
+        abserr = jnp.sum(jnp.abs(p - labf))
+        prob = jnp.sum(p)
+        q = jnp.sum(jnp.square(p))
+        pos = jnp.sum(labf)
+        total = jnp.asarray(p.shape[0], jnp.float32)
+        return sqrerr, abserr, prob, q, pos, total
+
+    stats = apply("ctr_stats_op", _stats, input, label)
+    return (auc_out,) + tuple(stats)
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """reference legacy layers exponential_decay -> LRScheduler."""
+    from ..optimizer.lr import ExponentialDecay, StepDecay
+    if staircase:
+        return StepDecay(learning_rate=learning_rate,
+                         step_size=decay_steps, gamma=decay_rate)
+    return ExponentialDecay(learning_rate=learning_rate,
+                            gamma=decay_rate)

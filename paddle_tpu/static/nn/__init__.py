@@ -47,3 +47,13 @@ def embedding(input, size, padding_idx=None, weight_attr=None, name=None):
     w = create_parameter(list(size), "float32",
                          name=name and f"{name}.w")
     return F.embedding(input, w, padding_idx=padding_idx)
+from .layers import (  # noqa: F401
+    conv2d, conv3d, conv2d_transpose, conv3d_transpose, batch_norm,
+    layer_norm, group_norm, instance_norm, data_norm,
+    bilinear_tensor_product, deform_conv2d, nce, prelu, row_conv,
+    spectral_norm, sparse_embedding, sequence_conv, sequence_softmax,
+    sequence_pool, sequence_concat, sequence_first_step,
+    sequence_last_step, sequence_slice, sequence_expand,
+    sequence_expand_as, sequence_pad, sequence_unpad, sequence_reshape,
+    sequence_scatter, sequence_enumerate, sequence_reverse, StaticRNN,
+    py_func)

@@ -1,0 +1,711 @@
+"""static.nn layer-builder tail (reference
+python/paddle/static/nn/common.py conv2d/batch_norm/... — fluid-style
+functions that create parameters inside the Program and emit ops;
+sequence_ops map the reference's LoD sequences onto padded [B, T, ...]
++ length tensors, the TPU-native variable-length representation — XLA
+has no ragged storage, and the reference itself is migrating off LoD).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...framework.dispatch import apply
+from ..program import create_parameter as _raw_create_param
+
+
+def _create_param(shape, dtype, attr=None, is_bias=False, name=None,
+                  default_initializer=None, stop_gradient=False):
+    """Adapter from the fluid-layer calling convention (ParamAttr +
+    (shape, dtype)-style initializers) onto program.create_parameter's
+    key-based initializer."""
+    if attr is not None and getattr(attr, "name", None):
+        name = attr.name
+    init = None
+    attr_init = getattr(attr, "initializer", None) if attr is not None \
+        else None
+    if attr_init is not None:
+        def init(key, _shape=tuple(shape), _dtype=dtype):
+            return jnp.asarray(attr_init(_shape, np.dtype(_dtype)))
+    elif default_initializer is not None:
+        def init(key, _shape=tuple(shape), _dtype=dtype):
+            return default_initializer(_shape, np.dtype(_dtype))
+    return _raw_create_param(shape, dtype, name=name, initializer=init,
+                             is_bias=is_bias, stop_gradient=stop_gradient)
+
+__all__ = [
+    "conv2d", "conv3d", "conv2d_transpose", "conv3d_transpose",
+    "batch_norm", "layer_norm", "group_norm", "instance_norm",
+    "data_norm", "bilinear_tensor_product", "deform_conv2d", "nce",
+    "prelu", "row_conv", "spectral_norm", "sparse_embedding",
+    "sequence_conv", "sequence_softmax", "sequence_pool",
+    "sequence_concat", "sequence_first_step", "sequence_last_step",
+    "sequence_slice", "sequence_expand", "sequence_expand_as",
+    "sequence_pad", "sequence_unpad", "sequence_reshape",
+    "sequence_scatter", "sequence_enumerate", "sequence_reverse",
+    "StaticRNN", "py_func",
+]
+
+
+def _pair(v, n=2):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+# ----------------------------------------------------------- conv family
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           use_cudnn=True, act=None, name=None, data_format="NCHW"):
+    """reference static/nn/common.py conv2d."""
+    from ...nn import functional as F
+    cin = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    ks = _pair(filter_size)
+    w = _create_param([num_filters, cin // (groups or 1), *ks],
+                      input.dtype.name, attr=param_attr)
+    b = None if bias_attr is False else _create_param(
+        [num_filters], input.dtype.name, attr=bias_attr, is_bias=True)
+    out = F.conv2d(input, w, bias=b, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups or 1,
+                   data_format=data_format)
+    return _apply_act(out, act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           use_cudnn=True, act=None, name=None, data_format="NCDHW"):
+    from ...nn import functional as F
+    cin = input.shape[1] if data_format == "NCDHW" else input.shape[-1]
+    ks = _pair(filter_size, 3)
+    w = _create_param([num_filters, cin // (groups or 1), *ks],
+                      input.dtype.name, attr=param_attr)
+    b = None if bias_attr is False else _create_param(
+        [num_filters], input.dtype.name, attr=bias_attr, is_bias=True)
+    out = F.conv3d(input, w, bias=b, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups or 1,
+                   data_format=data_format)
+    return _apply_act(out, act)
+
+
+def conv2d_transpose(input, num_filters, output_size=None,
+                     filter_size=None, stride=1, padding=0, dilation=1,
+                     groups=1, param_attr=None, bias_attr=None,
+                     use_cudnn=True, act=None, name=None,
+                     data_format="NCHW"):
+    from ...nn import functional as F
+    cin = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    ks = _pair(filter_size)
+    w = _create_param([cin, num_filters // (groups or 1), *ks],
+                      input.dtype.name, attr=param_attr)
+    b = None if bias_attr is False else _create_param(
+        [num_filters], input.dtype.name, attr=bias_attr, is_bias=True)
+    out = F.conv2d_transpose(input, w, bias=b, stride=stride,
+                             padding=padding, dilation=dilation,
+                             groups=groups or 1, output_size=output_size,
+                             data_format=data_format)
+    return _apply_act(out, act)
+
+
+def conv3d_transpose(input, num_filters, output_size=None,
+                     filter_size=None, stride=1, padding=0, dilation=1,
+                     groups=1, param_attr=None, bias_attr=None,
+                     use_cudnn=True, act=None, name=None,
+                     data_format="NCDHW"):
+    from ...nn import functional as F
+    cin = input.shape[1] if data_format == "NCDHW" else input.shape[-1]
+    ks = _pair(filter_size, 3)
+    w = _create_param([cin, num_filters // (groups or 1), *ks],
+                      input.dtype.name, attr=param_attr)
+    b = None if bias_attr is False else _create_param(
+        [num_filters], input.dtype.name, attr=bias_attr, is_bias=True)
+    out = F.conv3d_transpose(input, w, bias=b, stride=stride,
+                             padding=padding, dilation=dilation,
+                             groups=groups or 1, output_size=output_size,
+                             data_format=data_format)
+    return _apply_act(out, act)
+
+
+def _apply_act(out, act):
+    if act is None:
+        return out
+    from ...nn import functional as F
+    return getattr(F, act)(out)
+
+
+# ----------------------------------------------------------- norm family
+def batch_norm(input, act=None, is_test=False, momentum=0.9,
+               epsilon=1e-5, param_attr=None, bias_attr=None,
+               data_layout="NCHW", in_place=False, name=None,
+               moving_mean_name=None, moving_variance_name=None,
+               do_model_average_for_mean_and_var=True,
+               use_global_stats=False):
+    """reference static/nn/common.py batch_norm. Static-graph training
+    uses batch statistics; is_test/use_global_stats reads the moving
+    stats parameters."""
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    scale = _create_param([c], input.dtype.name, attr=param_attr,
+                          default_initializer=_ones)
+    bias = _create_param([c], input.dtype.name, attr=bias_attr,
+                         is_bias=True)
+    # moving stats replay as inputs but are NOT optimizer-updated
+    mean = _create_param([c], input.dtype.name,
+                         name=moving_mean_name, is_bias=True,
+                         stop_gradient=True)
+    var = _create_param([c], input.dtype.name,
+                        name=moving_variance_name,
+                        default_initializer=_ones, stop_gradient=True)
+    use_stats = is_test or use_global_stats
+
+    # inline op: F.batch_norm mutates the running stats eagerly, which
+    # a symbolic Program can't do — static training normalizes by batch
+    # stats (the reference's batch_norm op does the moving-average
+    # update as a side output; the moving stats here stay parameters)
+    def _bn(x, mu, vv, sc, bi, eps=1e-5, use_global=False,
+            chan_last=False):
+        axes = tuple(i for i in range(x.ndim)
+                     if i != (x.ndim - 1 if chan_last else 1))
+        shape = [1] * x.ndim
+        shape[x.ndim - 1 if chan_last else 1] = -1
+        if use_global:
+            m, v = mu.reshape(shape), vv.reshape(shape)
+        else:
+            m = jnp.mean(x, axes, keepdims=True)
+            v = jnp.var(x, axes, keepdims=True)
+        out = (x - m) / jnp.sqrt(v + eps)
+        return out * sc.reshape(shape) + bi.reshape(shape)
+
+    return _apply_act(
+        apply("static_batch_norm", _bn, input, mean, var, scale, bias,
+              eps=float(epsilon), use_global=bool(use_stats),
+              chan_last=data_layout != "NCHW"), act)
+
+
+def _ones(shape, dtype):
+    return jnp.ones(shape, dtype)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, name=None):
+    shape = [int(np.prod(input.shape[begin_norm_axis:]))]
+    w = _create_param(shape, input.dtype.name, attr=param_attr,
+                      default_initializer=_ones) if scale else None
+    b = _create_param(shape, input.dtype.name, attr=bias_attr,
+                      is_bias=True) if shift else None
+
+    def _ln(x, wv, bv, eps=1e-5, axes=1):
+        mu = jnp.mean(x, axis=tuple(range(axes, x.ndim)), keepdims=True)
+        var = jnp.var(x, axis=tuple(range(axes, x.ndim)), keepdims=True)
+        out = (x - mu) / jnp.sqrt(var + eps)
+        if wv is not None:
+            out = out * wv.reshape(x.shape[axes:])
+        if bv is not None:
+            out = out + bv.reshape(x.shape[axes:])
+        return out
+
+    return _apply_act(
+        apply("static_layer_norm", _ln, input, w, b,
+              eps=float(epsilon), axes=int(begin_norm_axis)), act)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    from ...nn import functional as F
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    w = _create_param([c], input.dtype.name, attr=param_attr,
+                      default_initializer=_ones)
+    b = _create_param([c], input.dtype.name, attr=bias_attr,
+                      is_bias=True)
+    return _apply_act(
+        F.group_norm(input, groups, weight=w, bias=b, epsilon=epsilon,
+                     data_format=data_layout), act)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    from ...nn import functional as F
+    c = input.shape[1]
+    w = _create_param([c], input.dtype.name, attr=param_attr,
+                      default_initializer=_ones)
+    b = _create_param([c], input.dtype.name, attr=bias_attr,
+                      is_bias=True)
+    return F.instance_norm(input, weight=w, bias=b, eps=epsilon)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              enable_scale_and_shift=False, name=None, data_layout="NCHW",
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_rate=0.9999999,
+              **kwargs):
+    """reference static/nn/common.py data_norm — normalization by
+    accumulated batch statistics (batch_size/batch_sum/batch_square_sum
+    parameters updated outside the op; here they normalize directly)."""
+    c = input.shape[-1] if data_layout != "NCHW" or input.ndim == 2 \
+        else input.shape[1]
+    size = _create_param([c], input.dtype.name,
+                         default_initializer=_ones)
+    sums = _create_param([c], input.dtype.name, is_bias=True)
+    sqs = _create_param([c], input.dtype.name,
+                        default_initializer=_ones)
+
+    def _dn(x, n, s, sq, eps=1e-5):
+        mean = s / jnp.maximum(n, eps)
+        scale = jnp.sqrt(jnp.maximum(n, eps) / jnp.maximum(sq, eps))
+        return (x - mean) * scale
+
+    return _apply_act(apply("data_norm_op", _dn, input, size, sums, sqs,
+                            eps=float(epsilon)), act)
+
+
+# --------------------------------------------------------- odds and ends
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """reference: out_k = x W_k y^T + b (W [size, dx, dy])."""
+    w = _create_param([size, x.shape[-1], y.shape[-1]], x.dtype.name,
+                      attr=param_attr)
+    b = None if bias_attr is False else _create_param(
+        [size], x.dtype.name, attr=bias_attr, is_bias=True)
+
+    def _btp(xv, yv, wv, bv):
+        out = jnp.einsum("bi,kij,bj->bk", xv, wv, yv)
+        return out if bv is None else out + bv
+
+    return _apply_act(apply("bilinear_tensor_product", _btp, x, y, w, b),
+                      act)
+
+
+def deform_conv2d(x, offset, mask=None, num_filters=None,
+                  filter_size=3, stride=1, padding=0, dilation=1,
+                  groups=None, deformable_groups=1, im2col_step=1,
+                  param_attr=None, bias_attr=None, name=None):
+    """reference static/nn/common.py deform_conv2d (DCNv1 mask=None /
+    DCNv2): bilinear-sample the input at offset-shifted taps, then a
+    dense 1x1 contraction over the gathered patches — gather + MXU
+    matmul, the TPU lowering of the CUDA im2col kernel."""
+    kh, kw = _pair(filter_size)
+    cin = x.shape[1]
+    w = _create_param([num_filters, cin, kh, kw], x.dtype.name,
+                      attr=param_attr)
+    b = None if bias_attr is False else _create_param(
+        [num_filters], x.dtype.name, attr=bias_attr, is_bias=True)
+    sh, sw = _pair(stride)
+    ph, pw = _pair(padding)
+    dh, dw = _pair(dilation)
+
+    def _dcn(xv, off, m, wv, bv, cfg=None):
+        kh, kw, sh, sw, ph, pw, dh, dw = cfg
+        B, C, H, W = xv.shape
+        Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+        Wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+        ys = jnp.arange(Ho) * sh - ph          # output-row origins
+        xs = jnp.arange(Wo) * sw - pw
+        # offsets: [B, 2*kh*kw, Ho, Wo] with (dy, dx) interleaved
+        # (deformable_groups=1 — the common configuration)
+        off = off.reshape(B, kh * kw, 2, Ho, Wo)
+        dy = off[:, :, 0]                      # [B, K, Ho, Wo]
+        dx = off[:, :, 1]
+        # per-tap base coordinates: tap t = i*kw + j
+        ti = jnp.repeat(jnp.arange(kh), kw)    # [K]
+        tj = jnp.tile(jnp.arange(kw), kh)
+        sy = (ys[None, None, :, None]
+              + ti[None, :, None, None] * dh).astype(jnp.float32)
+        sy = jnp.broadcast_to(sy, (B, kh * kw, Ho, Wo)) + dy
+        sx = (xs[None, None, None, :]
+              + tj[None, :, None, None] * dw).astype(jnp.float32)
+        sx = jnp.broadcast_to(sx, (B, kh * kw, Ho, Wo)) + dx
+
+        # bilinear sample x at (sy, sx), zeros outside
+        y0 = jnp.floor(sy)
+        x0 = jnp.floor(sx)
+        wy = sy - y0
+        wx = sx - x0
+
+        def gather(yy, xx):
+            yi = jnp.clip(yy.astype(jnp.int32), 0, H - 1)
+            xi = jnp.clip(xx.astype(jnp.int32), 0, W - 1)
+            valid = ((yy >= 0) & (yy <= H - 1) & (xx >= 0)
+                     & (xx <= W - 1)).astype(xv.dtype)
+            # [B, C, K, Ho, Wo]
+            g = xv[jnp.arange(B)[:, None, None, None], :,
+                   yi[:, :, :, :], xi[:, :, :, :]]
+            g = jnp.moveaxis(g, -1, 1)
+            return g * valid[:, None]
+
+        val = (gather(y0, x0) * ((1 - wy) * (1 - wx))[:, None]
+               + gather(y0, x0 + 1) * ((1 - wy) * wx)[:, None]
+               + gather(y0 + 1, x0) * (wy * (1 - wx))[:, None]
+               + gather(y0 + 1, x0 + 1) * (wy * wx)[:, None])
+        if m is not None:
+            val = val * m.reshape(B, 1, kh * kw, Ho, Wo)
+        # contract [B, C, K, Ho, Wo] with w [F, C, K]
+        out = jnp.einsum("bckhw,fck->bfhw", val,
+                         wv.reshape(wv.shape[0], C, kh * kw))
+        if bv is not None:
+            out = out + bv[None, :, None, None]
+        return out
+
+    return apply("deform_conv2d", _dcn, x, offset, mask, w, b,
+                 cfg=(kh, kw, sh, sw, ph, pw, dh, dw))
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=None,
+        name=None, sampler="uniform", custom_dist=None, seed=0,
+        is_sparse=False):
+    """reference static/nn/common.py nce — noise-contrastive estimation
+    loss with uniform negative sampling (in-graph, fixed sample count)."""
+    from ...framework.random import next_key
+    d = input.shape[-1]
+    k = num_neg_samples or 10
+    w = _create_param([num_total_classes, d], input.dtype.name,
+                      attr=param_attr)
+    b = None if bias_attr is False else _create_param(
+        [num_total_classes], input.dtype.name, attr=bias_attr,
+        is_bias=True)
+
+    def _nce(xv, lab, wv, bv, key, _k=10, _n=10):
+        Bn = xv.shape[0]
+        lab = lab.reshape(-1)
+        neg = jax.random.randint(key, (Bn, _k), 0, _n)
+        pos_w = wv[lab]
+        pos_logit = jnp.sum(xv * pos_w, -1)
+        neg_w = wv[neg]                         # [B, k, d]
+        neg_logit = jnp.einsum("bd,bkd->bk", xv, neg_w)
+        if bv is not None:
+            pos_logit = pos_logit + bv[lab]
+            neg_logit = neg_logit + bv[neg]
+        # NCE with uniform noise: logit - log(k * q), q = 1/n
+        corr = jnp.log(_k / _n)
+        pos_loss = jax.nn.softplus(-(pos_logit - corr))
+        neg_loss = jax.nn.softplus(neg_logit - corr).sum(-1)
+        return (pos_loss + neg_loss).reshape(-1, 1)
+
+    from ...framework.tensor import Tensor
+    from ..program import in_static_graph_mode, static_rng_key
+    if in_static_graph_mode():
+        # a concrete key would be baked as a literal and replay the SAME
+        # negatives every run — the rng feed delivers a fresh key per
+        # Executor.run
+        key = static_rng_key()
+    else:
+        key = Tensor(next_key(), stop_gradient=True)
+    return apply("nce_op", _nce, input, label, w, b, key,
+                 _k=int(k), _n=int(num_total_classes))
+
+
+def prelu(x, mode, param_attr=None, data_format="NCHW", name=None):
+    """reference static/nn/common.py prelu — mode all|channel|element."""
+    from ...nn import functional as F
+    if mode == "all":
+        shape = [1]
+    elif mode == "channel":
+        shape = [x.shape[1] if data_format == "NCHW" else x.shape[-1]]
+    else:
+        shape = list(x.shape[1:])
+    a = _create_param(shape, x.dtype.name, attr=param_attr,
+                      default_initializer=lambda s, d: jnp.full(
+                          s, 0.25, d))
+    return F.prelu(x, a, data_format=data_format)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """reference static/nn/common.py row_conv — lookahead convolution
+    over [B, T, D]."""
+    d = input.shape[-1]
+    w = _create_param([future_context_size + 1, d], input.dtype.name,
+                      attr=param_attr)
+
+    def _rc(xv, wv, k=1):
+        outs = 0.0
+        T = xv.shape[1]
+        for i in range(k):
+            shifted = jnp.pad(xv[:, i:], ((0, 0), (0, i), (0, 0)))
+            outs = outs + shifted * wv[i]
+        return outs
+
+    return _apply_act(apply("row_conv_op", _rc, input, w,
+                            k=int(future_context_size + 1)), act)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """reference static/nn/common.py spectral_norm — W / sigma(W) with
+    fresh power iterations per call (the op form keeps u/v in-graph)."""
+    def _sn(wv, _dim=0, _iters=1, _eps=1e-12):
+        wm = jnp.moveaxis(wv, _dim, 0).reshape(wv.shape[_dim], -1)
+        u = jnp.ones((wm.shape[0],), wv.dtype)
+        v = None
+        for _ in range(max(_iters, 1)):
+            v = wm.T @ u
+            v = v / (jnp.linalg.norm(v) + _eps)
+            u = wm @ v
+            u = u / (jnp.linalg.norm(u) + _eps)
+        sigma = u @ wm @ v
+        return wv / (sigma + _eps)
+
+    return apply("spectral_norm_op", _sn, weight, _dim=int(dim),
+                 _iters=int(power_iters), _eps=float(eps))
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, table_class="MemorySparseTable",
+                     param_attr=None, dtype="float32", slot=None):
+    """reference static/nn/common.py sparse_embedding — the parameter-
+    server distributed embedding; single-controller TPU training holds
+    the table in HBM (sharded via mesh specs), so this is embedding with
+    the reference's signature."""
+    from .. import nn as static_nn
+    return static_nn.embedding(input, size, padding_idx=padding_idx,
+                               weight_attr=param_attr)
+
+
+# ------------------------------------------------ sequence ops (padded)
+# LoD sequences collapse to (data [B, T, ...], length [B]) pairs: XLA
+# needs static shapes, so variable length lives in a mask — the same
+# contract nn.functional's rnn/ctc path uses.
+def _mask(length, T):
+    return (jnp.arange(T)[None, :] < length[:, None])
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None):
+    """Padded representation is the native one here: validates/returns
+    (x, length). x [B, T, ...], returns (padded, length)."""
+    def _sp(xv, pv):
+        return xv, jnp.full((xv.shape[0],), xv.shape[1], jnp.int32)
+    return apply("sequence_pad_op", _sp, x, pad_value)
+
+
+def sequence_unpad(x, length, name=None):
+    """Masks padding positions to zero (ragged unpad has no static
+    shape; consumers read `length`)."""
+    def _su(xv, ln):
+        m = _mask(ln, xv.shape[1])
+        return xv * m.reshape(m.shape + (1,) * (xv.ndim - 2)).astype(
+            xv.dtype)
+    return apply("sequence_unpad_op", _su, x, length)
+
+
+def sequence_softmax(x, length=None, name=None):
+    def _ss(xv, ln):
+        if ln is None:
+            m = jnp.ones(xv.shape[:2], bool)
+        else:
+            m = _mask(ln, xv.shape[1])
+        m = m.reshape(m.shape + (1,) * (xv.ndim - 2))
+        z = jnp.where(m, xv, -1e30)
+        z = z - z.max(1, keepdims=True)
+        e = jnp.exp(z) * m.astype(xv.dtype)
+        return e / jnp.maximum(e.sum(1, keepdims=True), 1e-12)
+    return apply("sequence_softmax_op", _ss, x, length)
+
+
+def sequence_pool(x, pool_type, length=None, pad_value=0.0):
+    def _pool(xv, ln, mode="sum"):
+        T = xv.shape[1]
+        m = (_mask(ln, T) if ln is not None
+             else jnp.ones(xv.shape[:2], bool))
+        mexp = m.reshape(m.shape + (1,) * (xv.ndim - 2))
+        masked = jnp.where(mexp, xv, 0.0)
+        if mode == "sum":
+            return masked.sum(1)
+        if mode == "average":
+            return masked.sum(1) / jnp.maximum(
+                mexp.astype(xv.dtype).sum(1), 1e-12)
+        if mode == "sqrt":
+            return masked.sum(1) / jnp.sqrt(jnp.maximum(
+                mexp.astype(xv.dtype).sum(1), 1e-12))
+        if mode == "max":
+            return jnp.where(mexp, xv, -jnp.inf).max(1)
+        if mode == "first":
+            return xv[:, 0]
+        if mode == "last":
+            idx = (ln - 1 if ln is not None
+                   else jnp.full((xv.shape[0],), T - 1))
+            return xv[jnp.arange(xv.shape[0]), idx]
+        raise ValueError(f"unknown pool_type {mode}")
+    return apply("sequence_pool_op", _pool, x, length,
+                 mode=str(pool_type).lower())
+
+
+def sequence_first_step(input, length=None):
+    return sequence_pool(input, "first", length)
+
+
+def sequence_last_step(input, length=None):
+    return sequence_pool(input, "last", length)
+
+
+def sequence_concat(input, name=None):
+    from ...ops.manipulation import concat
+    return concat(input, axis=1)
+
+
+def sequence_slice(input, offset, length, name=None):
+    def _slice(xv, off, ln):
+        T = xv.shape[1]
+        pos = jnp.arange(T)[None, :]
+        m = (pos >= off.reshape(-1, 1)) & (
+            pos < (off + ln).reshape(-1, 1))
+        return xv * m.reshape(m.shape + (1,) * (xv.ndim - 2)).astype(
+            xv.dtype)
+    return apply("sequence_slice_op", _slice, input, offset, length)
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    def _se(xv, yv):
+        reps = yv.shape[1] // xv.shape[1] if xv.shape[1] else 1
+        return jnp.repeat(xv, max(reps, 1), axis=1)
+    return apply("sequence_expand_op", _se, x, y)
+
+
+def sequence_expand_as(x, y, name=None):
+    return sequence_expand(x, y)
+
+
+def sequence_reshape(input, new_dim):
+    def _sr(xv, nd=1):
+        B = xv.shape[0]
+        return xv.reshape(B, -1, nd)
+    return apply("sequence_reshape_op", _sr, input, nd=int(new_dim))
+
+
+def sequence_scatter(input, index, updates, name=None):
+    def _ss(xv, idx, upd):
+        return xv.at[jnp.arange(xv.shape[0])[:, None],
+                     idx].add(upd)
+    return apply("sequence_scatter_op", _ss, input, index, updates)
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    def _en(xv, w=2, pv=0):
+        T = xv.shape[1]
+        cols = []
+        for i in range(w):
+            cols.append(jnp.pad(xv[:, i:], ((0, 0), (0, i)),
+                                constant_values=pv))
+        return jnp.stack(cols, -1)
+    return apply("sequence_enumerate_op", _en, input,
+                 w=int(win_size), pv=int(pad_value))
+
+
+def sequence_reverse(x, length=None, name=None):
+    def _rev(xv, ln):
+        T = xv.shape[1]
+        if ln is None:
+            return xv[:, ::-1]
+        idx = jnp.arange(T)[None, :]
+        src = jnp.where(idx < ln[:, None], ln[:, None] - 1 - idx, idx)
+        return xv[jnp.arange(xv.shape[0])[:, None], src]
+    return apply("sequence_reverse_op", _rev, x, length)
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, name=None):
+    """Context-window conv over [B, T, D] (reference sequence_conv)."""
+    d = input.shape[-1]
+    w = _create_param([filter_size * d, num_filters], input.dtype.name,
+                      attr=param_attr)
+    b = None if bias_attr is False else _create_param(
+        [num_filters], input.dtype.name, attr=bias_attr, is_bias=True)
+
+    def _sc(xv, wv, bv, k=3, start=None):
+        T = xv.shape[1]
+        st = -(k // 2) if start is None else start
+        cols = []
+        for i in range(k):
+            sh = st + i
+            if sh < 0:
+                col = jnp.pad(xv[:, :T + sh], ((0, 0), (-sh, 0), (0, 0)))
+            elif sh > 0:
+                col = jnp.pad(xv[:, sh:], ((0, 0), (0, sh), (0, 0)))
+            else:
+                col = xv
+            cols.append(col)
+        ctx = jnp.concatenate(cols, -1)           # [B, T, k*D]
+        out = ctx @ wv
+        return out if bv is None else out + bv
+
+    return _apply_act(apply("sequence_conv_op", _sc, input, w, b,
+                            k=int(filter_size),
+                            start=padding_start), act)
+
+
+class StaticRNN:
+    """reference static/nn/common.py StaticRNN — explicit per-step RNN
+    builder. The `step()` context records the cell body; ops unroll into
+    the Program over the (static) time axis, the reference's own
+    lowering."""
+
+    def __init__(self, name=None):
+        self._mem_init = {}
+        self._mem_cur = {}
+        self._inputs = []
+        self._outputs = []
+        self._t = None
+        self._T = None
+        self._in_step = False
+
+    import contextlib as _ctx
+
+    @_ctx.contextmanager
+    def step(self):
+        self._in_step = True
+        yield self
+        self._in_step = False
+
+    def step_input(self, x):
+        """x [T, B, ...] — returns the per-step slice placeholder; the
+        unroll happens in __call__/output collection."""
+        self._T = x.shape[0]
+        self._inputs.append(x)
+        return _StepHandle(self, ("input", len(self._inputs) - 1))
+
+    def memory(self, init=None, shape=None, batch_ref=None,
+               init_value=0.0, init_batch_dim_idx=0,
+               ref_batch_dim_idx=1):
+        if init is None:
+            raise ValueError("StaticRNN.memory requires init here "
+                             "(shape-only init needs a batch ref)")
+        key = len(self._mem_init)
+        self._mem_init[key] = init
+        return _StepHandle(self, ("memory", key))
+
+    def update_memory(self, mem, x):
+        self._mem_cur[mem._ref[1]] = x
+
+    def step_output(self, o):
+        self._outputs.append(o)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def __call__(self):
+        """Unroll: replay the recorded step lambda over t=0..T-1."""
+        raise NotImplementedError(
+            "drive StaticRNN through static.nn.StaticRNN.unroll(fn) — "
+            "the record/replay protocol of the reference relies on "
+            "block cloning; here pass the step body explicitly")
+
+    def unroll(self, step_fn, inputs, init_states):
+        """TPU-native explicit form: step_fn(x_t, states)->(out, states)
+        over inputs [T, B, ...]; returns stacked outputs [T, B, ...]."""
+        from ...ops.manipulation import stack
+        states = init_states
+        outs = []
+        T = inputs.shape[0]
+        for t in range(T):
+            out, states = step_fn(inputs[t], states)
+            outs.append(out)
+        return stack(outs, axis=0), states
+
+
+class _StepHandle:
+    def __init__(self, rnn, ref):
+        self._rnn = rnn
+        self._ref = ref
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    from ..extras import py_func as _pf
+    return _pf(func, x, out, backward_func, skip_vars_in_backward_input)

@@ -355,6 +355,21 @@ def record_apply(op_name: str, fn: Callable, args, static: dict,
     return out_vars[0] if not multi else list(out_vars)
 
 
+_RNG_FEED = "__rng_key__"
+
+
+def static_rng_key():
+    """Per-run randomness for recorded programs: returns a feed Variable
+    that the Executor fills with a fresh framework key on EVERY run —
+    the static twin of framework.random.next_key (a concrete key tensor
+    would be baked as a literal into the OpNode and replay the same
+    draws forever). Ops fold_in a unique index for independent streams."""
+    block = default_main_program().global_block()
+    if _RNG_FEED not in block.vars:
+        block.create_var(_RNG_FEED, (2,), np.uint32, is_feed=True)
+    return block.vars[_RNG_FEED]
+
+
 def create_parameter(shape, dtype="float32", name=None, initializer=None,
                      is_bias=False, stop_gradient=False):
     """Create a trainable parameter: the Variable lives in the main
@@ -542,6 +557,10 @@ class Executor:
             scope._vars.update(env)
             return []
 
+        if _RNG_FEED in block.vars and _RNG_FEED not in feed:
+            from ..framework.random import next_key
+            feed = dict(feed)
+            feed[_RNG_FEED] = np.asarray(next_key())
         feed_names = sorted(feed)
         feed_vals = [jnp.asarray(feed[k].numpy()
                                  if isinstance(feed[k], Tensor)
@@ -625,12 +644,27 @@ class Executor:
             return loss, env
 
         if opt is None:
-            # append_backward path: grads fetched, no update
+            # append_backward / static.gradients path: grads fetched, no
+            # update. Differentiate wrt params AND float feeds so
+            # gradients(targets, inputs) can fetch '<data>@GRAD' too
+            # (int feeds — labels, ids — are non-differentiable and stay
+            # out of the grad argument).
             @jax.jit
             def grad_fn(param_vals, feed_vals):
-                (loss, env), grads = jax.value_and_grad(
-                    loss_and_env, has_aux=True)(param_vals, feed_vals)
-                gmap = dict(zip(param_names, grads))
+                fidx = [i for i, v in enumerate(feed_vals)
+                        if jnp.issubdtype(v.dtype, jnp.floating)]
+
+                def split_loss(pv, fv_float):
+                    fv = list(feed_vals)
+                    for i, v in zip(fidx, fv_float):
+                        fv[i] = v
+                    return loss_and_env(pv, fv)
+
+                (loss, env), (gp, gf) = jax.value_and_grad(
+                    split_loss, argnums=(0, 1), has_aux=True)(
+                        param_vals, [feed_vals[i] for i in fidx])
+                gmap = dict(zip(param_names, gp))
+                gmap.update((feed_names[i], g) for i, g in zip(fidx, gf))
                 out = []
                 for f in fetch_names:
                     out.append(gmap[f[:-5]] if f.endswith("@GRAD")
@@ -651,6 +685,12 @@ class Executor:
                            for nm in param_names)
         clip = opt._grad_clip
         update = opt._update
+        # stop-gradient "parameters" (create_global_var constants,
+        # batch-norm moving stats) replay as inputs but must never be
+        # stepped or decayed
+        trainable = tuple(
+            not getattr(block.vars.get(nm), "stop_gradient", False)
+            for nm in param_names)
 
         @jax.jit
         def train_fn(param_vals, feed_vals, states, lr, step):
@@ -661,6 +701,10 @@ class Executor:
                 gs = clip._clip_values(gs)
             new_params, new_states = [], []
             for i, (p, g, st) in enumerate(zip(param_vals, gs, states)):
+                if not trainable[i]:
+                    new_params.append(p)
+                    new_states.append(st)
+                    continue
                 if decay and decay_in_grad and decay_mask[i]:
                     g = g + decay * p.astype(jnp.float32)
                 if decoupled and decay_mask[i]:

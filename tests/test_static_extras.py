@@ -1,0 +1,174 @@
+"""static namespace tail end-to-end (reference static/__init__ __all__): gradients wrt data+params vs numpy oracle, save/load/serialize, CompiledProgram, metric ops, EMA."""
+import numpy as np
+import pytest
+
+
+def test_drive():
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.static as static
+
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main, startup):
+            x = static.data('x', [4, 3], 'float32')
+            w = static.create_parameter([3, 2], 'float32', name='w0')
+            y = paddle.matmul(x, w)
+            loss = (y * y).sum()
+            gvars = static.gradients(loss, [x])
+        exe = static.Executor()
+        exe.run(startup)
+        xin = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+        outs = exe.run(main, feed={'x': xin}, fetch_list=[loss] + gvars + ['w0@GRAD'])
+        lval, gx, gw = outs
+        # oracle via numpy: d/dx sum((xw)^2) = 2 (xw) w^T
+        wv = static.global_scope().find_var('w0').numpy()
+        np.testing.assert_allclose(gx, 2 * (xin @ wv) @ wv.T, rtol=1e-4)
+        np.testing.assert_allclose(gw, 2 * xin.T @ (xin @ wv), rtol=1e-4)
+        print('static.gradients wrt data + param OK')
+
+        # save/load roundtrip
+        import tempfile, os
+        d = tempfile.mkdtemp()
+        static.save(main, os.path.join(d, 'm'))
+        # clobber then restore
+        static.global_scope().var('w0').set(np.zeros_like(wv))
+        static.load(main, os.path.join(d, 'm'))
+        np.testing.assert_allclose(static.global_scope().find_var('w0').numpy(), wv)
+        st = static.load_program_state(os.path.join(d, 'm'))
+        assert 'w0' in st
+        print('static save/load + program_state OK')
+
+        # serialize bytes + file helpers
+        pb = static.serialize_program(program=main)
+        vb = static.serialize_persistables(None, None, program=main)
+        static.save_to_file(os.path.join(d, 'prog.bin'), pb)
+        assert static.load_from_file(os.path.join(d, 'prog.bin')) == pb
+        p2 = static.deserialize_program(pb)
+        assert p2._serialized_desc['vars']
+        print('serialize helpers OK')
+
+        # CompiledProgram through the Executor
+        cp = static.CompiledProgram(main, build_strategy=static.BuildStrategy())
+        outs2 = exe.run(cp._program, feed={'x': xin}, fetch_list=[loss])
+        np.testing.assert_allclose(outs2[0], lval, rtol=1e-6)
+        print('CompiledProgram OK')
+
+        # metrics ops
+        m2 = static.Program()
+        with static.program_guard(m2):
+            logits = static.data('logits', [6, 3], 'float32')
+            lab = static.data('lab', [6, 1], 'int64')
+            acc = static.accuracy(logits, lab)
+            pred = static.data('pred', [6], 'float32')
+            lab2 = static.data('lab2', [6], 'int64')
+            auc_out, _, _ = static.auc(pred, lab2)
+        lg = np.array([[2, 1, 0]] * 3 + [[0, 1, 2]] * 3, np.float32)
+        lb = np.array([[0]] * 3 + [[0]] * 3, np.int64)
+        pv = np.array([0.9, 0.8, 0.7, 0.3, 0.2, 0.1], np.float32)
+        l2 = np.array([1, 1, 1, 0, 0, 0], np.int64)
+        a, u = exe.run(m2, feed={'logits': lg, 'lab': lb, 'pred': pv, 'lab2': l2},
+                       fetch_list=[acc, auc_out])
+        assert abs(float(a) - 0.5) < 1e-6
+        assert abs(float(u) - 1.0) < 1e-3, u   # perfectly separated -> AUC 1
+        print('accuracy/auc ops OK')
+    finally:
+        paddle.disable_static()
+
+    # eager EMA
+    import paddle_tpu.nn as nn
+    paddle.seed(0)
+    net = nn.Linear(3, 2)
+    ema = static.ExponentialMovingAverage(0.5, parameters=net.parameters())
+    w_before = net.weight.numpy().copy()
+    ema.update()
+    net.weight.set_value(paddle.to_tensor(np.zeros_like(w_before)))
+    ema.update()
+    with ema.apply():
+        applied = net.weight.numpy().copy()
+    restored = net.weight.numpy()
+    assert not np.allclose(applied, restored)
+    np.testing.assert_allclose(restored, 0.0)
+    print('EMA apply/restore OK')
+
+    # py_func + Print exist
+    print('ALL STATIC OK')
+
+
+def test_review_regressions():
+    import paddle_tpu as paddle
+    import paddle_tpu.static as static
+    import paddle_tpu.static.nn as snn
+
+    paddle.enable_static()
+    try:
+        # create_global_var participates in the replayed program and is
+        # NOT updated by the optimizer
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main, startup):
+            x = static.data('x', [2, 2], 'float32')
+            g = static.create_global_var([2, 2], 3.0, 'float32')
+            w = static.create_parameter([2, 2], 'float32')
+            y = ((x + g) * w).sum()
+            opt = paddle.optimizer.SGD(learning_rate=0.1)
+            opt.minimize(y)
+        exe = static.Executor()
+        exe.run(startup)
+        xin = np.ones((2, 2), np.float32)
+        out1 = exe.run(main, feed={'x': xin}, fetch_list=[y])[0]
+        exe.run(main, feed={'x': xin}, fetch_list=[y])
+        gval = static.global_scope().find_var(
+            [v.name for v in main.all_parameters()
+             if v.stop_gradient][0]).numpy()
+        np.testing.assert_allclose(gval, 3.0)   # untouched by SGD
+
+        # multi-target gradients sums targets
+        m2 = static.Program()
+        with static.program_guard(m2):
+            a = static.data('a', [3], 'float32')
+            t1 = (a * 2.0).sum()
+            t2 = (a * 3.0).sum()
+            gv = static.gradients([t1, t2], [a])
+        ga = exe.run(m2, feed={'a': np.ones(3, np.float32)},
+                     fetch_list=gv)[0]
+        np.testing.assert_allclose(ga, 5.0)
+
+        with pytest.raises(NotImplementedError):
+            static.gradients(t1, [a], target_gradients=[t2])
+
+        # nce draws fresh negatives across Executor.run calls
+        m3 = static.Program()
+        s3 = static.Program()
+        with static.program_guard(m3, s3):
+            emb = static.data('emb', [4, 8], 'float32')
+            lb = static.data('lb', [4, 1], 'int64')
+            loss = snn.nce(emb, lb, 1000, num_neg_samples=20)
+        exe.run(s3)
+        feed = {'emb': np.random.RandomState(0).randn(4, 8)
+                .astype(np.float32),
+                'lb': np.zeros((4, 1), np.int64)}
+        l1 = exe.run(m3, feed=feed, fetch_list=[loss])[0]
+        l2 = exe.run(m3, feed=feed, fetch_list=[loss])[0]
+        assert not np.allclose(l1, l2), "negatives must resample per run"
+    finally:
+        paddle.disable_static()
+
+    # EMA: default (no thres_steps) uses the flat decay
+    import paddle_tpu.nn as nn
+    from paddle_tpu.nn.parameter import Parameter
+    import jax.numpy as jnp
+    p = Parameter(jnp.ones((2,)))
+    ema = static.ExponentialMovingAverage(0.5, parameters=[p])
+    ema.update()                      # shadow = 0.5*1 + 0.5*1 = 1
+    p.set_value(paddle.to_tensor(np.zeros(2, np.float32)))
+    ema.update()                      # shadow = 0.5*1 + 0.5*0 = 0.5
+    with ema.apply():
+        np.testing.assert_allclose(p.numpy(), 0.5)
+
+    # Print with braces in the message must not crash
+    out = static.Print(paddle.to_tensor(np.ones(2, np.float32)),
+                       message="step {0} loss")
+    np.testing.assert_allclose(out.numpy(), 1.0)

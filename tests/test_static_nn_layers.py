@@ -1,0 +1,115 @@
+"""static.nn layer builders (reference static/nn __all__): conv/norm families, bilinear, deform_conv2d vs scipy conv oracle, nce, spectral_norm, sequence ops over padded+length, StaticRNN."""
+import numpy as np
+import pytest
+
+
+def test_drive():
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.static as static
+    import paddle_tpu.static.nn as snn
+
+    paddle.enable_static()
+    try:
+        rng = np.random.RandomState(0)
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main, startup):
+            img = static.data('img', [2, 3, 8, 8], 'float32')
+            c = snn.conv2d(img, 4, 3, padding=1, act='relu')
+            bn = snn.batch_norm(c)
+            gn = snn.group_norm(bn, groups=2)
+            ln = snn.layer_norm(gn, begin_norm_axis=1)
+            pooled = ln.mean()
+            xa = static.data('xa', [2, 5], 'float32')
+            xb = static.data('xb', [2, 4], 'float32')
+            btp = snn.bilinear_tensor_product(xa, xb, 6)
+            pl = snn.prelu(img, mode='channel')
+            seq = static.data('seq', [2, 7, 5], 'float32')
+            sc = snn.sequence_conv(seq, 8, 3)
+            sl = static.data('slen', [2], 'int64')
+            sp = snn.sequence_pool(seq, 'average', sl)
+            srev = snn.sequence_reverse(seq, sl)
+            ssm = snn.sequence_softmax(seq, sl)
+            fetches = [pooled, btp, pl, sc, sp, srev, ssm]
+        exe = static.Executor()
+        exe.run(startup)
+        feed = {'img': rng.randn(2, 3, 8, 8).astype(np.float32),
+                'xa': rng.randn(2, 5).astype(np.float32),
+                'xb': rng.randn(2, 4).astype(np.float32),
+                'seq': rng.randn(2, 7, 5).astype(np.float32),
+                'slen': np.array([7, 3], np.int64)}
+        outs = exe.run(main, feed=feed, fetch_list=fetches)
+        pooled_v, btp_v, pl_v, sc_v, sp_v, srev_v, ssm_v = outs
+        assert btp_v.shape == (2, 6) and sc_v.shape == (2, 7, 8)
+        assert sp_v.shape == (2, 5)
+        # masked average pool oracle for row 1 (length 3)
+        want = feed['seq'][1, :3].mean(0)
+        np.testing.assert_allclose(sp_v[1], want, rtol=1e-5)
+        # sequence_reverse: row 1 reverses only the first 3 steps
+        np.testing.assert_allclose(srev_v[1][:3], feed['seq'][1][:3][::-1], rtol=1e-6)
+        np.testing.assert_allclose(srev_v[1][3:], feed['seq'][1][3:], rtol=1e-6)
+        # masked softmax rows sum to 1 over valid steps only
+        np.testing.assert_allclose(ssm_v[1][:3].sum(0), 1.0, rtol=1e-4)
+        np.testing.assert_allclose(ssm_v[1][3:], 0.0, atol=1e-6)
+        print('static.nn layer builders OK')
+
+        # deform_conv2d: zero offsets == plain conv with the same weight
+        m2 = static.Program()
+        s2 = static.Program()
+        with static.program_guard(m2, s2):
+            xi = static.data('xi', [1, 2, 6, 6], 'float32')
+            off = static.data('off', [1, 18, 6, 6], 'float32')
+            out = snn.deform_conv2d(xi, off, num_filters=3, filter_size=3,
+                                    padding=1, bias_attr=False)
+        exe.run(s2)
+        xin = rng.randn(1, 2, 6, 6).astype(np.float32)
+        offz = np.zeros((1, 18, 6, 6), np.float32)
+        dv = exe.run(m2, feed={'xi': xin, 'off': offz}, fetch_list=[out])[0]
+        # oracle: conv with the created weight
+        wname = m2.all_parameters()[0].name
+        wv = static.global_scope().find_var(wname).numpy()
+        import scipy.signal
+        want = np.zeros_like(dv)
+        for f in range(3):
+            for ci in range(2):
+                want[0, f] += scipy.signal.correlate2d(xin[0, ci], wv[f, ci], mode='same')
+        np.testing.assert_allclose(dv, want, rtol=1e-3, atol=1e-4)
+        print('deform_conv2d zero-offset == conv OK')
+
+        # nce loss: finite + shape
+        m3 = static.Program()
+        s3 = static.Program()
+        with static.program_guard(m3, s3):
+            emb = static.data('emb', [4, 8], 'float32')
+            lb = static.data('lb', [4, 1], 'int64')
+            loss = snn.nce(emb, lb, 50, num_neg_samples=5)
+        exe.run(s3)
+        lv = exe.run(m3, feed={'emb': rng.randn(4, 8).astype(np.float32),
+                               'lb': rng.randint(0, 50, (4, 1)).astype(np.int64)},
+                     fetch_list=[loss])[0]
+        assert lv.shape == (4, 1) and np.isfinite(lv).all()
+        print('nce OK')
+
+        # spectral_norm: result has unit spectral norm
+        m4 = static.Program()
+        with static.program_guard(m4):
+            wv_in = static.data('w', [6, 4], 'float32')
+            sn = snn.spectral_norm(wv_in, power_iters=20)
+        win = rng.randn(6, 4).astype(np.float32)
+        sv = exe.run(m4, feed={'w': win}, fetch_list=[sn])[0]
+        s_max = np.linalg.svd(sv, compute_uv=False)[0]
+        assert abs(s_max - 1.0) < 1e-3, s_max
+        print('spectral_norm OK')
+    finally:
+        paddle.disable_static()
+
+    # StaticRNN.unroll eager
+    import jax.numpy as jnp
+    xs = paddle.to_tensor(np.ones((4, 2, 3), np.float32))
+    h0 = paddle.to_tensor(np.zeros((2, 3), np.float32))
+    rnn = snn.StaticRNN()
+    outs, h = rnn.unroll(lambda x, s: (x + s, x + s), xs, h0)
+    np.testing.assert_allclose(h.numpy(), 4.0)
+    assert tuple(outs.shape) == (4, 2, 3)
+    print('StaticRNN.unroll OK')
