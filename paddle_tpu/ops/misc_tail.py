@@ -301,3 +301,58 @@ def check_shape(shape):
                 s, Tensor):
             raise TypeError(
                 f"shape entries must be ints or Tensors, got {type(s)}")
+
+
+# ---------------------------------------------- inplace variant family
+# (reference tensor_method_func's trailing-underscore methods: same op,
+# rebinds the receiver — tensor/__init__.py binds these as methods)
+def _make_inplace(base_name):
+    def op(x, *args, **kw):
+        from .. import tensor as T
+        return inplace_rebind(x, getattr(T, base_name)(x, *args, **kw))
+    op.__name__ = base_name + "_"
+    op.__doc__ = (f"In-place {base_name} (reference {base_name}_): "
+                  f"same value, rebinds the receiver tensor.")
+    return op
+
+
+ceil_ = _make_inplace("ceil")
+erfinv_ = _make_inplace("erfinv")
+exp_ = _make_inplace("exp")
+flatten_ = _make_inplace("flatten")
+floor_ = _make_inplace("floor")
+lerp_ = _make_inplace("lerp")
+put_along_axis_ = _make_inplace("put_along_axis")
+reciprocal_ = _make_inplace("reciprocal")
+remainder_ = _make_inplace("remainder")
+round_ = _make_inplace("round")
+rsqrt_ = _make_inplace("rsqrt")
+sqrt_ = _make_inplace("sqrt")
+
+
+def sigmoid(x, name=None):
+    """reference tensor/ops.py sigmoid (also nn.functional.sigmoid)."""
+    from ..nn.functional import sigmoid as _sig
+    return _sig(x)
+
+
+sigmoid_ = _make_inplace("sigmoid")
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    """reference tensor/creation.py create_tensor — an empty tensor of
+    the dtype (static mode: a Variable shell)."""
+    from ..framework import dtype as dtypes
+    from ..static.program import in_static_graph_mode, \
+        default_main_program
+    dt = dtypes.convert_dtype(dtype)
+    if in_static_graph_mode():
+        prog = default_main_program()
+        nm = name or prog._unique_name("created_tensor")
+        return prog.global_block().create_var(nm, (0,), dt)
+    return Tensor(jnp.zeros((0,), dt))
+
+
+__all__ += ["ceil_", "erfinv_", "exp_", "flatten_", "floor_", "lerp_",
+            "put_along_axis_", "reciprocal_", "remainder_", "round_",
+            "rsqrt_", "sqrt_", "sigmoid", "sigmoid_", "create_tensor"]

@@ -203,17 +203,18 @@ class InMemoryDataset:
     def set_filelist(self, filelist):
         self._filelist = list(filelist)
 
-    def load_into_memory(self):
-        self._records = []
+    def _iter_records(self):
         for path in self._filelist:
             with open(path) as f:
                 for line in f:
                     line = line.strip()
                     if not line:
                         continue
-                    rec = (self._parse_fn(line) if self._parse_fn
+                    yield (self._parse_fn(line) if self._parse_fn
                            else np.fromstring(line, sep=" "))
-                    self._records.append(rec)
+
+    def load_into_memory(self):
+        self._records = list(self._iter_records())
 
     def local_shuffle(self):
         from ..framework import random as frandom
@@ -245,18 +246,11 @@ class QueueDataset(InMemoryDataset):
 
     def __iter__(self):
         batch = []
-        for path in self._filelist:
-            with open(path) as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    rec = (self._parse_fn(line) if self._parse_fn
-                           else np.fromstring(line, sep=" "))
-                    batch.append(rec)
-                    if len(batch) == self._batch_size:
-                        yield np.stack(batch)
-                        batch = []
+        for rec in self._iter_records():
+            batch.append(rec)
+            if len(batch) == self._batch_size:
+                yield np.stack(batch)
+                batch = []
 
 
 # ------------------------------------------------- PS entry configs

@@ -58,6 +58,14 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
     loss = targets[0].sum()
     for t in targets[1:]:
         loss = loss + t.sum()
+    prog = loss.block.program
+    prev = getattr(prog, "_backward_loss", None)
+    if prev is not None and prev != loss.name:
+        raise NotImplementedError(
+            "this program already has a backward target "
+            f"({prev!r}); one gradients()/append_backward per program "
+            "— the '@GRAD' fetch names resolve against a single loss "
+            "(build a second Program for a second target set)")
     append_backward(loss)
     return [f"{v.name}@GRAD" for v in inputs]
 

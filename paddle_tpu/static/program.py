@@ -696,7 +696,11 @@ class Executor:
         def train_fn(param_vals, feed_vals, states, lr, step):
             (loss, env), grads = jax.value_and_grad(
                 loss_and_env, has_aux=True)(param_vals, feed_vals)
-            gs = [g.astype(jnp.float32) for g in grads]
+            # non-trainables (create_global_var, moving stats) must not
+            # contaminate the global-norm clip with their unused grads
+            gs = [g.astype(jnp.float32) if trainable[i]
+                  else jnp.zeros_like(g, jnp.float32)
+                  for i, g in enumerate(grads)]
             if clip is not None:
                 gs = clip._clip_values(gs)
             new_params, new_states = [], []

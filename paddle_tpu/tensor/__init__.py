@@ -190,3 +190,39 @@ from ..ops import misc_tail as _misc_tail
 for _n in ("scatter_", "index_add_", "index_put_", "tanh_"):
     setattr(Tensor, _n, getattr(_misc_tail, _n))
 del _n
+
+from ..ops.misc_tail import (  # noqa: F401
+    ceil_, erfinv_, exp_, flatten_, floor_, lerp_, put_along_axis_,
+    reciprocal_, remainder_, round_, rsqrt_, sqrt_, sigmoid, sigmoid_,
+    create_tensor)
+
+# ---------------------------------------------------------------------
+# Bind the reference's full tensor_method_func surface: every name the
+# reference patches onto Tensor that exists in this namespace becomes a
+# method here too (reference python/paddle/tensor/__init__.py:311 loops
+# the same way over its function table).
+# ---------------------------------------------------------------------
+import os as _os
+
+
+def _bind_reference_methods():
+    import sys
+    here = sys.modules[__name__]
+    ref_list = _os.path.join(_os.path.dirname(__file__),
+                             "reference_methods.txt")
+    with open(ref_list) as f:
+        names = f.read().split()
+    for n in names:
+        if hasattr(Tensor, n):
+            continue
+        fn = getattr(here, n, None)
+        if fn is None:
+            import paddle_tpu as _p
+            fn = getattr(_p, n, None)
+        if fn is None and hasattr(_p, "linalg"):
+            fn = getattr(_p.linalg, n, None)
+        if callable(fn):
+            setattr(Tensor, n, fn)
+
+
+_bind_reference_methods()

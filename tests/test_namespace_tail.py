@@ -137,3 +137,34 @@ class TestShims:
                        for e in node.value.elts]
         missing = [r for r in ref if r and not hasattr(paddle, r)]
         assert not missing, missing
+
+
+class TestTensorMethodSurface:
+    def test_reference_method_list_complete(self):
+        import os
+        from paddle_tpu.framework.tensor import Tensor
+        ref = open(os.path.join(os.path.dirname(
+            paddle.__file__), "tensor", "reference_methods.txt")).read() \
+            .split()
+        missing = sorted(set(n for n in ref if not hasattr(Tensor, n)))
+        assert not missing, missing
+
+    def test_method_dispatch_and_grads(self):
+        t = paddle.to_tensor(np.array([[4.0, 9.0]], np.float32))
+        np.testing.assert_allclose(t.sqrt().numpy(),
+                                   np.sqrt(t.numpy()), rtol=1e-6)
+        assert t.is_floating_point()
+        g = paddle.to_tensor(np.array([4.0], np.float32),
+                             stop_gradient=False)
+        g.sqrt().backward()
+        np.testing.assert_allclose(g.grad.numpy(), [0.25])
+
+    def test_inplace_method_family(self):
+        x = paddle.to_tensor(np.array([4.0, 9.0], np.float32))
+        x.sqrt_()
+        np.testing.assert_allclose(x.numpy(), [2.0, 3.0])
+        x.round_()
+        np.testing.assert_allclose(x.numpy(), [2.0, 3.0])
+        s = paddle.to_tensor(np.array([0.0], np.float32))
+        s.sigmoid_()
+        np.testing.assert_allclose(s.numpy(), [0.5])
