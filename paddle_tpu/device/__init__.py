@@ -328,3 +328,57 @@ def get_all_custom_device_type():
 
 def is_custom_device(device_type: str) -> bool:
     return device_type in _CUSTOM_BACKENDS
+
+
+def get_cudnn_version():
+    """reference device get_cudnn_version — None: no cuDNN in the XLA
+    TPU stack."""
+    return None
+
+
+class XPUPlace:
+    def __init__(self, dev_id=0):
+        raise NotImplementedError(
+            "XPU (Kunlun) hardware is not available on the TPU backend")
+
+
+class IPUPlace:
+    def __init__(self, dev_id=0):
+        raise NotImplementedError(
+            "IPU (GraphCore) hardware is not available on the TPU "
+            "backend")
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_cinn():
+    # XLA is the compiler here; CINN is the reference's own stack
+    return False
+
+
+def is_compiled_with_custom_device(device_type):
+    return is_custom_device(device_type)
+
+
+def get_all_device_type():
+    import jax
+    kinds = {d.platform for d in jax.devices()}
+    return sorted(kinds | set(_CUSTOM_BACKENDS))
+
+
+def set_stream(stream=None):
+    """reference device.set_stream — PJRT schedules streams; returns the
+    previous (nominal) stream for API parity."""
+    return Stream()
+
+
+import contextlib as _ctx
+
+
+@_ctx.contextmanager
+def stream_guard(stream=None):
+    """reference device.stream_guard — no-op scope (PJRT async
+    dispatch owns ordering)."""
+    yield

@@ -20,7 +20,8 @@ from ..framework.tensor import Tensor, to_tensor
 from ..framework.random import next_key
 
 __all__ = [
-    "Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+    "Distribution", "ExponentialFamily", "Normal", "Uniform",
+    "Categorical", "Bernoulli",
     "Beta", "Dirichlet", "Gamma", "Exponential", "Laplace", "LogNormal",
     "Gumbel", "Geometric", "Cauchy", "Multinomial", "kl_divergence",
     "register_kl",
@@ -500,3 +501,33 @@ from .transform import (  # noqa: E402,F401
     StickBreakingTransform, TanhTransform)
 from .transformed_distribution import (  # noqa: E402,F401
     TransformedDistribution, Independent)
+
+
+class ExponentialFamily(Distribution):
+    """reference distribution/exponential_family.py — base class whose
+    entropy falls out of the log-normalizer via autodiff (Bregman
+    identity): H = F(theta) - <theta, grad F(theta)> + E[carrier]."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        raise NotImplementedError
+
+    def entropy(self):
+        nparams = [jnp.asarray(_v(p), jnp.float32)
+                   for p in self._natural_parameters]
+        # elementwise log-normalizer F; dF/dtheta via grad-of-sum (exact
+        # for the pointwise F every member uses)
+        lg = self._log_normalizer(*nparams)
+        grads = jax.grad(lambda ps: jnp.sum(self._log_normalizer(*ps)))(
+            tuple(nparams))
+        ent = lg - self._mean_carrier_measure
+        for th, g in zip(nparams, grads):
+            ent = ent - th * g
+        return _t(ent)

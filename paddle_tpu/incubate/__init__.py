@@ -28,3 +28,92 @@ def graph_send_recv(x, src_index, dst_index, pool_type="sum",
 
 from . import multiprocessing  # noqa: E402,F401
 from . import checkpoint  # noqa: E402,F401
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """Reference incubate softmax_mask_fuse_upper_triangle — causal-mask
+    softmax over [B, H, S, S] scores (XLA fuses the chain)."""
+    import jax
+    import jax.numpy as jnp
+    from ..framework.dispatch import apply
+
+    def _op(scores):
+        S = scores.shape[-1]
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        return jax.nn.softmax(jnp.where(mask, scores, -1e30), axis=-1)
+
+    return apply("softmax_mask_fuse_upper_triangle", _op, x)
+
+
+def identity_loss(x, reduction="none"):
+    """reference incubate/nn/loss.py identity_loss — marks x as a loss
+    (IPU artifact); reduces per `reduction`."""
+    from ..nn.functional.extra import _reduce
+    from ..framework.dispatch import apply
+    red = {0: "sum", 1: "mean", 2: "none"}.get(reduction, reduction)
+    return apply("identity_loss", lambda v, red_=None: _reduce(v, red_),
+                 x, red_=red)
+
+
+from .optimizer import LookAhead, ModelAverage  # noqa: E402,F401
+# graph ops graduated into paddle_tpu.geometric; re-export at the
+# incubate paths the reference still documents
+from ..geometric import (  # noqa: E402,F401
+    segment_sum, segment_mean, segment_max, segment_min,
+    sample_neighbors as graph_sample_neighbors,
+    reindex_graph as graph_reindex)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """reference incubate/operators/graph_khop_sampler — multi-hop
+    neighbor sampling with one shared local-id space: input nodes get
+    ids first, then first-seen sampled neighbors; edges are (src local,
+    dst local) across all hops. Host-side like the geometric samplers
+    (data-dependent output counts)."""
+    if return_eids:
+        raise NotImplementedError(
+            "graph_khop_sampler(return_eids=True) is unsupported; use "
+            "geometric.sample_neighbors(return_eids=True) per hop")
+    import numpy as np
+    from ..framework.tensor import Tensor
+    from ..geometric import sample_neighbors
+
+    def _host(t):
+        return np.asarray(t._value if isinstance(t, Tensor) else t
+                          ).reshape(-1)
+
+    id2local = {}
+    out_nodes = []
+
+    def local(g):
+        g = int(g)
+        if g not in id2local:
+            id2local[g] = len(out_nodes)
+            out_nodes.append(g)
+        return id2local[g]
+
+    frontier = _host(input_nodes)
+    for g in frontier:
+        local(g)
+    src_l, dst_l, counts = [], [], []
+    for k in sample_sizes:
+        nbr, cnt = sample_neighbors(row, colptr, Tensor(
+            np.asarray(frontier, np.int64)), sample_size=k)
+        nbr_h, cnt_h = _host(nbr), _host(cnt)
+        counts.append(cnt_h)
+        pos = 0
+        for node, c in zip(frontier, cnt_h):
+            dloc = local(node)
+            for g in nbr_h[pos:pos + int(c)]:
+                src_l.append(local(g))
+                dst_l.append(dloc)
+            pos += int(c)
+        # next frontier: the distinct nodes just discovered
+        frontier = np.unique(nbr_h)
+    dt = np.int64
+    return (Tensor(np.asarray(src_l, dt)),
+            Tensor(np.asarray(dst_l, dt)),
+            Tensor(np.asarray(out_nodes, dt)),
+            Tensor(np.concatenate(counts).astype(dt)
+                   if counts else np.zeros(0, dt)))

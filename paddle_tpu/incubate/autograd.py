@@ -5,3 +5,44 @@ from ..autograd.functional import (  # noqa: F401
 
 Jacobian = jacobian
 Hessian = hessian
+
+
+def enable_prim():
+    """reference incubate/autograd/primapi enable_prim — switches the
+    reference to primitive-op decomposition for higher-order autodiff.
+    Decomposition IS the default here (every vjp is a jax primitive
+    composition), so the switch records intent only."""
+    _prim_state["enabled"] = True
+
+
+def disable_prim():
+    _prim_state["enabled"] = False
+
+
+def prim_enabled():
+    return _prim_state["enabled"]
+
+
+_prim_state = {"enabled": True}
+
+
+def forward_grad(outputs, inputs, grad_inputs=None):
+    """reference primapi.py:25 — forward-mode JVP of outputs wrt
+    inputs."""
+    from ..autograd.functional import jvp as _jvp
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if callable(outputs):
+        _, tangents = _jvp(outputs, ins, v=grad_inputs)
+        return tangents
+    raise NotImplementedError(
+        "forward_grad needs the function form: pass a callable producing "
+        "outputs (paddle_tpu.autograd.functional.jvp semantics); tape-"
+        "recorded eager outputs support reverse mode via incubate."
+        "autograd.grad")
+
+
+def grad(outputs, inputs, grad_outputs=None):
+    """reference primapi.py:108 — reverse-mode gradients; same contract
+    as paddle.grad."""
+    import paddle_tpu
+    return paddle_tpu.grad(outputs, inputs, grad_outputs=grad_outputs)

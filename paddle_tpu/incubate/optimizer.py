@@ -1,0 +1,106 @@
+"""incubate optimizer wrappers (reference
+python/paddle/incubate/optimizer/lookahead.py:25 LookAhead,
+modelaverage.py:28 ModelAverage) — eager wrappers over any inner
+optimizer."""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+import jax.numpy as jnp
+
+
+class LookAhead:
+    """reference lookahead.py:25 — slow weights track the fast weights:
+    every k inner steps, slow += alpha * (fast - slow); fast = slow."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha should be in [0, 1]")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = int(k)
+        self._step = 0
+        self._slow = {}
+
+    @property
+    def _params(self):
+        return self.inner_optimizer._parameter_list
+
+    def clear_grad(self, set_to_zero=False):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step += 1
+        if self._step % self.k:
+            return
+        for p in self._params:
+            slow = self._slow.get(id(p))
+            if slow is None:
+                slow = p._value
+            slow = slow + self.alpha * (p._value - slow)
+            self._slow[id(p)] = slow
+            p._value = slow
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+
+class ModelAverage:
+    """reference modelaverage.py:28 — running average of parameters over
+    a sliding window; apply()/restore() swap the averages in for
+    evaluation."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self._rate = average_window_rate
+        self._min_w = min_average_window
+        self._max_w = max_average_window
+        self._params = list(parameters) if parameters is not None else []
+        self._sum = {}
+        self._count = {}
+        self._updates = 0
+        self._backup = {}
+
+    def step(self):
+        self._updates += 1
+        window = max(self._min_w,
+                     min(self._max_w, self._updates * self._rate))
+        for p in self._params:
+            s = self._sum.get(id(p), jnp.zeros_like(p._value))
+            c = self._count.get(id(p), 0)
+            s = s + p._value
+            c += 1
+            if c > window:
+                # restart the accumulation window (the reference rolls
+                # sum_1/sum_2/sum_3 blocks; a restart bounds the same
+                # window length)
+                s = p._value.astype(s.dtype)
+                c = 1
+            self._sum[id(p)] = s
+            self._count[id(p)] = c
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        self._backup = {id(p): p._value for p in self._params}
+        for p in self._params:
+            c = self._count.get(id(p), 0)
+            if c:
+                p._value = (self._sum[id(p)] / c).astype(p._value.dtype)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._value = self._backup[id(p)]
+        self._backup = {}

@@ -19,3 +19,115 @@ class UtilBase:
 
 
 util = UtilBase()
+
+
+# ------------------------------------------------------------ fleet tail
+# (reference distributed/fleet/__init__.py __all__: Fleet class, role
+# makers, topology classes, PS data generators)
+from ..topology import (  # noqa: E402,F401
+    CommunicateTopology, HybridCommunicateGroup)
+from . import fleet as _fleet_mod
+
+
+class Role:
+    """reference base/role_maker.py:31."""
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class PaddleCloudRoleMaker:
+    """reference base/role_maker.py:547 — resolves the process's role
+    from the cluster environment. Collective TPU training has workers
+    only; rank/size come from the same PADDLE_* env contract
+    parallel.env reads."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        self._is_collective = is_collective
+
+    def _worker_index(self):
+        from ..env import get_rank
+        return get_rank()
+
+    def _worker_num(self):
+        from ..env import get_world_size
+        return get_world_size()
+
+    def _role(self):
+        return Role.WORKER
+
+    def _is_worker(self):
+        return True
+
+    def _is_server(self):
+        return False
+
+    def _is_first_worker(self):
+        return self._worker_index() == 0
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """reference base/role_maker.py:1183 — explicit role assignment."""
+
+    def __init__(self, is_collective=True, init_gloo=False, **kwargs):
+        super().__init__(is_collective=is_collective)
+        self._kwargs = kwargs
+
+
+class MultiSlotDataGenerator:
+    """reference data_generator — emits the PS text format
+    'slot:count id id ...' per sample; subclass generate_sample."""
+
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "implement generate_sample(line) -> iterator of "
+            "[(slot_name, [ids...]), ...]")
+
+    def _format(self, record):
+        parts = []
+        for _name, ids in record:
+            parts.append(str(len(ids)))
+            parts.extend(str(i) for i in ids)
+        return " ".join(parts)
+
+    def run_from_stdin(self):
+        import sys
+        for line in sys.stdin:
+            for rec in self.generate_sample(line)():
+                sys.stdout.write(self._format(rec) + "\n")
+
+    def run_from_memory(self, lines):
+        out = []
+        for line in lines:
+            for rec in self.generate_sample(line)():
+                out.append(self._format(rec))
+        return out
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    """reference — string-id variant (same line format, ids kept as
+    strings)."""
+
+
+class Fleet:
+    """reference fleet/fleet.py:99 — the unified distributed-training
+    facade as a class; the module-level `fleet` object in the reference
+    is an instance of this. Methods delegate to the functional core."""
+
+    def __init__(self):
+        self._role_maker = None
+
+    def init(self, role_maker=None, is_collective=True, strategy=None,
+             log_level="INFO"):
+        self._role_maker = role_maker or PaddleCloudRoleMaker(
+            is_collective=is_collective)
+        return _fleet_mod.init(role_maker=role_maker,
+                               is_collective=is_collective,
+                               strategy=strategy, log_level=log_level)
+
+    def __getattr__(self, name):
+        # every other fleet API (distributed_model/optimizer/worker_num/
+        # barrier_worker/...) lives in the functional module
+        return getattr(_fleet_mod, name)

@@ -326,3 +326,28 @@ def cost_analysis(fn, *example_args, **jit_kwargs):
             pass
     out["raw"] = dict(raw)
     return out
+
+
+class SortedKeys(enum.Enum):
+    """reference profiler_statistic.py:49 — summary-table sort keys."""
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class SummaryView(enum.Enum):
+    """reference profiler.py:46 — summary views."""
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8

@@ -227,3 +227,43 @@ class PTQ:
     def convert(self, model: Layer, inplace=True) -> Layer:
         self._set_calibrating(model, False)   # freeze scales
         return model
+
+
+class BaseQuanter(Layer):
+    """reference quantization/base_quanter.py — abstract fake-quant
+    layer: subclasses implement forward and report scales/zero-points."""
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        raise NotImplementedError
+
+
+class BaseObserver(BaseQuanter):
+    """reference quantization/base_observer.py — an observing quanter
+    (collects statistics in forward)."""
+
+
+class _QuanterFactory:
+    """reference quantization/factory.py quanter decorator: registers a
+    quanter class and returns a partial-like config handle."""
+
+    def __init__(self, cls):
+        self._cls = cls
+
+    def __call__(self, *args, **kwargs):
+        factory = self
+
+        class _Config:
+            def _instance(self, layer):
+                return factory._cls(layer, *args, **kwargs)
+        return _Config()
+
+
+def quanter(name):
+    """reference factory.py quanter(name) class decorator."""
+    def deco(cls):
+        globals()[name] = _QuanterFactory(cls)
+        return cls
+    return deco

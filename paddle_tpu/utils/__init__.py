@@ -65,3 +65,26 @@ def from_dlpack(capsule):
     import jax
     from ..framework.tensor import Tensor
     return Tensor(jax.dlpack.from_dlpack(capsule))
+
+
+def require_version(min_version, max_version=None):
+    """reference utils/__init__ require_version — validates the
+    installed framework version against [min, max]."""
+    from ..version import full_version
+
+    def _tuple(v):
+        parts = []
+        for piece in str(v).split("."):
+            num = "".join(ch for ch in piece if ch.isdigit())
+            parts.append(int(num) if num else 0)
+        return tuple(parts)
+
+    cur = _tuple(full_version)
+    if _tuple(min_version) > cur:
+        raise Exception(
+            f"VersionError: paddle_tpu version {full_version} is below "
+            f"the required minimum {min_version}")
+    if max_version is not None and _tuple(max_version) < cur:
+        raise Exception(
+            f"VersionError: paddle_tpu version {full_version} exceeds "
+            f"the allowed maximum {max_version}")

@@ -10,6 +10,15 @@ import jax.numpy as jnp
 
 from ..framework.dispatch import apply
 from ..framework.tensor import Tensor, to_tensor
+from ..nn.layer import Layer
+
+__all__ = [
+    "box_iou", "nms", "roi_align", "roi_pool", "RoIPool", "RoIAlign",
+    "psroi_pool", "PSRoIPool", "deform_conv2d", "DeformConv2D",
+    "box_coder", "prior_box", "yolo_box", "yolo_loss", "matrix_nms",
+    "distribute_fpn_proposals", "generate_proposals", "read_file",
+    "decode_jpeg",
+]
 
 __all__ = ["nms", "roi_align", "box_iou"]
 
@@ -154,3 +163,724 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
     return apply("roi_align", _roi, x, boxes, batch_idx, out_h=int(out_h),
                  out_w=int(out_w), scale=float(spatial_scale),
                  ratio=int(sampling_ratio), aligned=bool(aligned))
+
+
+# ======================================================================
+# Detection-op pack (reference python/paddle/vision/ops.py:267 yolo_box,
+# :428 prior_box, :574 box_coder, :700+ deform_conv2d/DeformConv2D,
+# roi_pool/psroi_pool, distribute_fpn_proposals, generate_proposals,
+# matrix_nms, read_file/decode_jpeg, and the yolo_loss training op).
+# Box-space math is pure jnp (jit/grad-friendly); proposal selection
+# with data-dependent counts runs top-k/padded — the TPU contract.
+# ======================================================================
+
+def read_file(filename, name=None):
+    """reference ops.py read_file — raw bytes as a uint8 tensor."""
+    with open(filename, "rb") as f:
+        data = f.read()
+    return Tensor(jnp.asarray(np.frombuffer(data, np.uint8)))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """reference ops.py decode_jpeg — CHW uint8 (PIL backend here; the
+    reference uses nvjpeg on GPU)."""
+    import io as _io
+    from PIL import Image
+    buf = np.asarray(x._value if isinstance(x, Tensor) else x,
+                     np.uint8).tobytes()
+    img = Image.open(_io.BytesIO(buf))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode in ("rgb", "unchanged"):
+        img = img.convert("RGB") if mode == "rgb" else img
+    arr = np.array(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """reference ops.py deform_conv2d (DCNv1 when mask is None, DCNv2
+    with mask): bilinear-sampled taps + MXU contraction — the functional
+    core static.nn.deform_conv2d builds its params around."""
+    from ..framework.dispatch import apply
+
+    def _pair(v):
+        return (v,) * 2 if isinstance(v, int) else tuple(v)
+
+    kh, kw = weight.shape[2], weight.shape[3]
+    sh, sw = _pair(stride)
+    ph, pw = _pair(padding)
+    dh, dw = _pair(dilation)
+    if deformable_groups != 1 or groups != 1:
+        raise NotImplementedError(
+            "deform_conv2d supports groups == deformable_groups == 1")
+
+    def _dcn(xv, off, m, wv, bv, cfg=None):
+        kh, kw, sh, sw, ph, pw, dh, dw = cfg
+        B, C, H, W = xv.shape
+        Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+        Wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+        ys = jnp.arange(Ho) * sh - ph
+        xs = jnp.arange(Wo) * sw - pw
+        off = off.reshape(B, kh * kw, 2, Ho, Wo)
+        dy, dx = off[:, :, 0], off[:, :, 1]
+        ti = jnp.repeat(jnp.arange(kh), kw)
+        tj = jnp.tile(jnp.arange(kw), kh)
+        sy = (ys[None, None, :, None]
+              + ti[None, :, None, None] * dh).astype(jnp.float32)
+        sy = jnp.broadcast_to(sy, (B, kh * kw, Ho, Wo)) + dy
+        sx = (xs[None, None, None, :]
+              + tj[None, :, None, None] * dw).astype(jnp.float32)
+        sx = jnp.broadcast_to(sx, (B, kh * kw, Ho, Wo)) + dx
+        y0 = jnp.floor(sy)
+        x0 = jnp.floor(sx)
+        wy = sy - y0
+        wx = sx - x0
+
+        def gather(yy, xx):
+            yi = jnp.clip(yy.astype(jnp.int32), 0, H - 1)
+            xi = jnp.clip(xx.astype(jnp.int32), 0, W - 1)
+            valid = ((yy >= 0) & (yy <= H - 1) & (xx >= 0)
+                     & (xx <= W - 1)).astype(xv.dtype)
+            g = xv[jnp.arange(B)[:, None, None, None], :,
+                   yi[:, :, :, :], xi[:, :, :, :]]
+            g = jnp.moveaxis(g, -1, 1)
+            return g * valid[:, None]
+
+        val = (gather(y0, x0) * ((1 - wy) * (1 - wx))[:, None]
+               + gather(y0, x0 + 1) * ((1 - wy) * wx)[:, None]
+               + gather(y0 + 1, x0) * (wy * (1 - wx))[:, None]
+               + gather(y0 + 1, x0 + 1) * (wy * wx)[:, None])
+        if m is not None:
+            val = val * m.reshape(B, 1, kh * kw, Ho, Wo)
+        out = jnp.einsum("bckhw,fck->bfhw", val,
+                         wv.reshape(wv.shape[0], C, kh * kw))
+        if bv is not None:
+            out = out + bv[None, :, None, None]
+        return out
+
+    return apply("deform_conv2d_fn", _dcn, x, offset, mask, weight,
+                 bias, cfg=(kh, kw, sh, sw, ph, pw, dh, dw))
+
+
+class DeformConv2D(Layer):
+    """reference ops.py DeformConv2D layer over deform_conv2d."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        ks = (kernel_size,) * 2 if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._cfg = (stride, padding, dilation, deformable_groups,
+                     groups)
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups, *ks), attr=weight_attr)
+        self.bias = self.create_parameter(
+            (out_channels,), attr=bias_attr, is_bias=True)
+
+    def forward(self, x, offset, mask=None):
+        s, p, d, dg, g = self._cfg
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             stride=s, padding=p, dilation=d,
+                             deformable_groups=dg, groups=g, mask=mask)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+             name=None):
+    """reference ops.py roi_pool — max pooling over ROI bins."""
+    from ..framework.dispatch import apply
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def _roi_pool(xv, bx, bnum, _oh=7, _ow=7, _scale=1.0):
+        N = bx.shape[0]
+        counts = jnp.cumsum(bnum)
+        batch_idx = jnp.searchsorted(counts,
+                                     jnp.arange(N), side="right")
+        scaled = bx * _scale
+        x1, y1, x2, y2 = (scaled[:, 0], scaled[:, 1], scaled[:, 2],
+                          scaled[:, 3])
+        H, W = xv.shape[2], xv.shape[3]
+
+        def one_box(b, xx1, yy1, xx2, yy2):
+            img = xv[b]                      # [C, H, W]
+            ys = jnp.linspace(yy1, yy2, _oh + 1)
+            xs = jnp.linspace(xx1, xx2, _ow + 1)
+            pos_y = jnp.arange(H)[None, :]
+            pos_x = jnp.arange(W)[None, :]
+            rowm = (pos_y >= jnp.floor(ys[:-1, None])) & \
+                (pos_y < jnp.maximum(jnp.ceil(ys[1:, None]),
+                                     jnp.floor(ys[:-1, None]) + 1))
+            colm = (pos_x >= jnp.floor(xs[:-1, None])) & \
+                (pos_x < jnp.maximum(jnp.ceil(xs[1:, None]),
+                                     jnp.floor(xs[:-1, None]) + 1))
+            # [oh, H] x [ow, W] -> bin max via masked max
+            m = rowm[:, None, :, None] & colm[None, :, None, :]
+            vals = jnp.where(m[None], img[:, None, None, :, :],
+                             -jnp.inf)
+            return vals.max((-1, -2))        # [C, oh, ow]
+
+        return jax.vmap(one_box)(batch_idx, x1, y1, x2, y2)
+
+    return apply("roi_pool_op", _roi_pool, x, boxes, boxes_num,
+                 _oh=int(oh), _ow=int(ow), _scale=float(spatial_scale))
+
+
+class RoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._args = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, *self._args)
+
+
+class RoIAlign(Layer):
+    """reference ops.py RoIAlign layer over roi_align."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._args = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self._args[0],
+                         spatial_scale=self._args[1], aligned=aligned)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """reference ops.py psroi_pool (R-FCN position-sensitive average
+    pooling): input channels = C_out * oh * ow; bin (i, j) reads its own
+    channel group."""
+    from ..framework.dispatch import apply
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def _psroi(xv, bx, bnum, _oh=7, _ow=7, _scale=1.0):
+        N = bx.shape[0]
+        C = xv.shape[1] // (_oh * _ow)
+        counts = jnp.cumsum(bnum)
+        batch_idx = jnp.searchsorted(counts, jnp.arange(N),
+                                     side="right")
+        scaled = bx * _scale
+        H, W = xv.shape[2], xv.shape[3]
+
+        def one_box(b, box):
+            x1, y1, x2, y2 = box
+            img = xv[b].reshape(_oh * _ow * C, H, W)
+            ys = jnp.linspace(y1, y2, _oh + 1)
+            xs = jnp.linspace(x1, x2, _ow + 1)
+            pos_y = jnp.arange(H)[None, :]
+            pos_x = jnp.arange(W)[None, :]
+            rowm = (pos_y >= jnp.floor(ys[:-1, None])) & \
+                (pos_y < jnp.maximum(jnp.ceil(ys[1:, None]),
+                                     jnp.floor(ys[:-1, None]) + 1))
+            colm = (pos_x >= jnp.floor(xs[:-1, None])) & \
+                (pos_x < jnp.maximum(jnp.ceil(xs[1:, None]),
+                                     jnp.floor(xs[:-1, None]) + 1))
+            m = (rowm[:, None, :, None]
+                 & colm[None, :, None, :])   # [oh, ow, H, W]
+            imgg = img.reshape(_oh, _ow, C, H, W)
+            # bin (i,j) pools channel group (i,j)
+            s = jnp.sum(jnp.where(m[:, :, None], imgg, 0.0), (-1, -2))
+            cnt = jnp.maximum(m.sum((-1, -2)), 1)[:, :, None]
+            return jnp.moveaxis(s / cnt, -1, 0)     # [C, oh, ow]
+
+        return jax.vmap(one_box)(batch_idx, scaled)
+
+    return apply("psroi_pool_op", _psroi, x, boxes, boxes_num,
+                 _oh=int(oh), _ow=int(ow), _scale=float(spatial_scale))
+
+
+class PSRoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._args = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, *self._args)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """reference ops.py:574 box_coder — encode boxes against priors or
+    decode deltas back to boxes (center-size parameterization)."""
+    from ..framework.dispatch import apply
+
+    def _coder(pb, pbv, tb, ct=None, norm=True, ax=0):
+        one = 0.0 if norm else 1.0
+        pw = pb[:, 2] - pb[:, 0] + one
+        ph = pb[:, 3] - pb[:, 1] + one
+        pcx = pb[:, 0] + pw * 0.5
+        pcy = pb[:, 1] + ph * 0.5
+        if pbv is None:
+            var = jnp.ones((4,), jnp.float32)
+            vslice = lambda i: var[i]        # noqa: E731
+        elif pbv.ndim == 1:
+            vslice = lambda i: pbv[i]        # noqa: E731
+        else:
+            vslice = lambda i: pbv[:, i]     # noqa: E731
+        if ct == "encode_center_size":
+            tw = tb[:, 2] - tb[:, 0] + one
+            th = tb[:, 3] - tb[:, 1] + one
+            tcx = tb[:, 0] + tw * 0.5
+            tcy = tb[:, 1] + th * 0.5
+            # every target against every prior: [T, P]
+            dx = (tcx[:, None] - pcx[None, :]) / pw[None, :] / \
+                vslice(0)
+            dy = (tcy[:, None] - pcy[None, :]) / ph[None, :] / \
+                vslice(1)
+            dw = jnp.log(tw[:, None] / pw[None, :]) / vslice(2)
+            dh = jnp.log(th[:, None] / ph[None, :]) / vslice(3)
+            return jnp.stack([dx, dy, dw, dh], -1)
+        # decode: tb [N, P, 4] deltas; `ax` names the dim the priors
+        # broadcast along (reference ops.py:640 — axis=0: prior per
+        # column, axis=1: prior per row)
+        if tb.ndim == 2:
+            tb = tb[:, None, :]
+        if ax == 1:
+            pw, ph = pw[:, None], ph[:, None]
+            pcx, pcy = pcx[:, None], pcy[:, None]
+            vs = vslice
+            vslice = (lambda i, _vs=vs: jnp.atleast_1d(_vs(i))[..., None]
+                      if jnp.ndim(_vs(i)) else _vs(i))
+        dcx = vslice(0) * tb[..., 0] * pw + pcx
+        dcy = vslice(1) * tb[..., 1] * ph + pcy
+        dw = jnp.exp(vslice(2) * tb[..., 2]) * pw
+        dh = jnp.exp(vslice(3) * tb[..., 3]) * ph
+        return jnp.stack([dcx - dw * 0.5, dcy - dh * 0.5,
+                          dcx + dw * 0.5 - one, dcy + dh * 0.5 - one],
+                         -1)
+
+    return apply("box_coder_op", _coder, prior_box, prior_box_var,
+                 target_box, ct=code_type, norm=bool(box_normalized),
+                 ax=int(axis))
+
+
+def prior_box(input, image, min_sizes, max_sizes=None,
+              aspect_ratios=[1.0], variance=[0.1, 0.1, 0.2, 0.2],
+              flip=False, clip=False, steps=[0.0, 0.0], offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """reference ops.py:428 prior_box — SSD anchors per feature-map
+    cell; returns (boxes [H, W, A, 4], variances [H, W, A, 4])."""
+    H, W = int(input.shape[2]), int(input.shape[3])
+    img_h, img_w = int(image.shape[2]), int(image.shape[3])
+    step_h = steps[1] or img_h / H
+    step_w = steps[0] or img_w / W
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+    mins = np.atleast_1d(np.asarray(min_sizes, np.float32))
+    maxs = (np.atleast_1d(np.asarray(max_sizes, np.float32))
+            if max_sizes is not None else None)
+    if maxs is not None and len(maxs) != len(mins):
+        raise ValueError(
+            "max_sizes must pair index-wise with min_sizes "
+            f"(got {len(maxs)} vs {len(mins)})")
+    whs = []
+    for idx, ms in enumerate(mins):
+        ratio_whs = [(ms * np.sqrt(ar), ms / np.sqrt(ar)) for ar in ars]
+        if maxs is None:
+            whs.extend(ratio_whs)
+        elif min_max_aspect_ratios_order:
+            # [min, max, remaining ratios] (reference flag semantics)
+            sq = np.sqrt(ms * maxs[idx])
+            whs.append(ratio_whs[0])
+            whs.append((sq, sq))
+            whs.extend(ratio_whs[1:])
+        else:
+            sq = np.sqrt(ms * maxs[idx])
+            whs.extend(ratio_whs)
+            whs.append((sq, sq))
+    whs = np.asarray(whs, np.float32)          # [A, 2]
+    cx = (np.arange(W) + offset) * step_w
+    cy = (np.arange(H) + offset) * step_h
+    cxg, cyg = np.meshgrid(cx, cy)             # [H, W]
+    boxes = np.stack([
+        (cxg[..., None] - whs[:, 0] / 2) / img_w,
+        (cyg[..., None] - whs[:, 1] / 2) / img_h,
+        (cxg[..., None] + whs[:, 0] / 2) / img_w,
+        (cyg[..., None] + whs[:, 1] / 2) / img_h,
+    ], -1).astype(np.float32)                  # [H, W, A, 4]
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    vars_ = np.broadcast_to(np.asarray(variance, np.float32),
+                            boxes.shape).copy()
+    return Tensor(jnp.asarray(boxes)), Tensor(jnp.asarray(vars_))
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    """reference ops.py:267 yolo_box — decode a YOLOv3 head [B, A*(5+C),
+    H, W] into (boxes [B, H*W*A, 4], scores [B, H*W*A, C])."""
+    from ..framework.dispatch import apply
+    A = len(anchors) // 2
+
+    def _yolo_box(xv, imgs, anc=None, C=80, thr=0.01, ds=32, clip=True,
+                  sxy=1.0, ia=False, iaf=0.5):
+        B, _, H, W = xv.shape
+        A_ = len(anc) // 2
+        if ia:
+            # iou-aware head: first A channels are IoU predictions
+            iou_pred = jax.nn.sigmoid(xv[:, :A_].reshape(B, A_, H, W))
+            v = xv[:, A_:].reshape(B, A_, 5 + C, H, W)
+        else:
+            iou_pred = None
+            v = xv.reshape(B, A_, 5 + C, H, W)
+        gx = jnp.arange(W)[None, None, None, :]
+        gy = jnp.arange(H)[None, None, :, None]
+        bx = (jax.nn.sigmoid(v[:, :, 0]) * sxy - (sxy - 1) / 2 + gx) \
+            / W
+        by = (jax.nn.sigmoid(v[:, :, 1]) * sxy - (sxy - 1) / 2 + gy) \
+            / H
+        aw = jnp.asarray(anc[0::2], jnp.float32)[None, :, None, None]
+        ah = jnp.asarray(anc[1::2], jnp.float32)[None, :, None, None]
+        in_w, in_h = W * ds, H * ds
+        bw = jnp.exp(v[:, :, 2]) * aw / in_w
+        bh = jnp.exp(v[:, :, 3]) * ah / in_h
+        obj = jax.nn.sigmoid(v[:, :, 4])
+        if iou_pred is not None:
+            obj = jnp.power(obj, 1.0 - iaf) * jnp.power(iou_pred, iaf)
+        cls = jax.nn.sigmoid(v[:, :, 5:])
+        score = obj[:, :, None] * cls          # [B, A, C, H, W]
+        # scale to the original image
+        ih = imgs[:, 0].astype(jnp.float32)[:, None, None, None]
+        iw = imgs[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (bx - bw / 2) * iw
+        y1 = (by - bh / 2) * ih
+        x2 = (bx + bw / 2) * iw
+        y2 = (by + bh / 2) * ih
+        if clip:
+            x1 = jnp.clip(x1, 0, iw - 1)
+            y1 = jnp.clip(y1, 0, ih - 1)
+            x2 = jnp.clip(x2, 0, iw - 1)
+            y2 = jnp.clip(y2, 0, ih - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], -1)     # [B, A, H, W, 4]
+        boxes = boxes.transpose(0, 2, 3, 1, 4).reshape(B, -1, 4)
+        score = score.transpose(0, 3, 4, 1, 2).reshape(B, -1, C)
+        keep = (obj.transpose(0, 2, 3, 1).reshape(B, -1) > thr)
+        score = score * keep[..., None]
+        return boxes, score
+
+    return apply("yolo_box_op", _yolo_box, x, img_size,
+                 anc=tuple(anchors), C=int(class_num),
+                 thr=float(conf_thresh), ds=int(downsample_ratio),
+                 clip=bool(clip_bbox), sxy=float(scale_x_y),
+                 ia=bool(iou_aware), iaf=float(iou_aware_factor))
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """reference ops.py yolo_loss (yolov3_loss op): per-cell anchor
+    assignment by best IoU with each gt, BCE on xy/obj/class, L1 on wh,
+    objectness ignore above ignore_thresh. Returns [B] loss."""
+    from ..framework.dispatch import apply
+    A = len(anchor_mask)
+
+    def _loss(xv, gtb, gtl, gts, anc=None, msk=None, C=20, ig=0.7,
+              ds=32, sxy=1.0, smooth=True):
+        B, _, H, W = xv.shape
+        A_ = len(msk)
+        v = xv.reshape(B, A_, 5 + C, H, W)
+        in_w, in_h = W * ds, H * ds
+        # gt in [0,1] center-size (the reference contract): [B, G, 4]
+        gx, gy, gw, gh = (gtb[..., 0], gtb[..., 1], gtb[..., 2],
+                          gtb[..., 3])
+        valid = (gw > 0) & (gh > 0)
+        # best anchor (over the FULL anchor set) per gt by shape IoU
+        all_aw = jnp.asarray(anc[0::2], jnp.float32) / in_w
+        all_ah = jnp.asarray(anc[1::2], jnp.float32) / in_h
+        inter = (jnp.minimum(gw[..., None], all_aw)
+                 * jnp.minimum(gh[..., None], all_ah))
+        union = gw[..., None] * gh[..., None] + all_aw * all_ah - inter
+        best = jnp.argmax(inter / jnp.maximum(union, 1e-10), -1)
+        mask_arr = jnp.asarray(msk)
+        # local anchor slot of the best anchor (or -1)
+        local = jnp.argmax(
+            (best[..., None] == mask_arr).astype(jnp.int32), -1)
+        has_local = (best[..., None] == mask_arr).any(-1) & valid
+        ci = jnp.clip((gx * W).astype(jnp.int32), 0, W - 1)
+        cj = jnp.clip((gy * H).astype(jnp.int32), 0, H - 1)
+        # route invalid gts out of bounds and DROP them: scatter-max
+        # would clamp negative targets (log(gw/aw) < 0) to the zero base
+        ci_s = jnp.where(has_local, ci, W)
+        bidx = jnp.arange(B)[:, None] * jnp.ones_like(local)
+
+        def scat(upd):
+            base = jnp.zeros((B, A_, H, W), jnp.float32)
+            return base.at[bidx, local, cj, ci_s].set(upd, mode="drop")
+
+        score_w = (jnp.ones_like(gx) if gts is None
+                   else gts.astype(jnp.float32))
+        obj_tgt = scat(score_w)                # mixup gt_score target
+        tx = scat(gx * W - ci)
+        ty = scat(gy * H - cj)
+        aw_sel = all_aw[mask_arr][None, :, None, None]
+        ah_sel = all_ah[mask_arr][None, :, None, None]
+        tw = scat(jnp.log(jnp.maximum(gw, 1e-9)
+                          / jnp.maximum(all_aw[best], 1e-9)))
+        th = scat(jnp.log(jnp.maximum(gh, 1e-9)
+                          / jnp.maximum(all_ah[best], 1e-9)))
+        scale = scat(2.0 - gw * gh)
+        cls_tgt = jnp.zeros((B, A_, H, W, C), jnp.float32)
+        cls_tgt = cls_tgt.at[bidx, local, cj, ci_s,
+                             jnp.clip(gtl, 0, C - 1)].set(
+            1.0, mode="drop")
+        if smooth:
+            delta = 1.0 / C
+            cls_tgt = jnp.where(obj_tgt[..., None] > 0,
+                                cls_tgt * (1 - delta) + delta * 0.5 / C,
+                                cls_tgt)
+
+        def bce(logit, tgt):
+            return jax.nn.softplus(logit) - tgt * logit
+
+        px, py = v[:, :, 0], v[:, :, 1]
+        pw, ph = v[:, :, 2], v[:, :, 3]
+        pobj = v[:, :, 4]
+        pcls = v[:, :, 5:].transpose(0, 1, 3, 4, 2)
+        pos = obj_tgt > 0
+        w_map = jnp.where(pos, obj_tgt, 1.0)   # per-gt mixup weight
+        loss_xy = jnp.where(pos,
+                            w_map * scale * (bce(px, tx) + bce(py, ty)),
+                            0.0)
+        loss_wh = jnp.where(pos,
+                            w_map * scale * 0.5 * (jnp.abs(pw - tw)
+                                                   + jnp.abs(ph - th)),
+                            0.0)
+        # ignore mask: predicted boxes with IoU>thresh against ANY gt
+        bx = (jax.nn.sigmoid(px) + jnp.arange(W)[None, None, None, :]) \
+            / W
+        by = (jax.nn.sigmoid(py) + jnp.arange(H)[None, None, :, None]) \
+            / H
+        bw = jnp.exp(jnp.clip(pw, -10, 10)) * aw_sel
+        bh = jnp.exp(jnp.clip(ph, -10, 10)) * ah_sel
+        bx1, by1 = bx - bw / 2, by - bh / 2
+        bx2, by2 = bx + bw / 2, by + bh / 2
+        gx1, gy1 = gx - gw / 2, gy - gh / 2
+        gx2, gy2 = gx + gw / 2, gy + gh / 2
+        ix1 = jnp.maximum(bx1[..., None], gx1[:, None, None, None, :])
+        iy1 = jnp.maximum(by1[..., None], gy1[:, None, None, None, :])
+        ix2 = jnp.minimum(bx2[..., None], gx2[:, None, None, None, :])
+        iy2 = jnp.minimum(by2[..., None], gy2[:, None, None, None, :])
+        iw_ = jnp.maximum(ix2 - ix1, 0)
+        ih_ = jnp.maximum(iy2 - iy1, 0)
+        inter_p = iw_ * ih_
+        union_p = (bw * bh)[..., None] + (gw * gh)[:, None, None, None,
+                                                   :] - inter_p
+        iou_p = jnp.where(valid[:, None, None, None, :],
+                          inter_p / jnp.maximum(union_p, 1e-10), 0.0)
+        ignore = (iou_p.max(-1) > ig) & ~pos
+        loss_obj = jnp.where(ignore, 0.0, bce(pobj, obj_tgt))
+        loss_cls = (jnp.where(pos[..., None], bce(pcls, cls_tgt), 0.0)
+                    * w_map[..., None]).sum(-1)
+        total = (loss_xy + loss_wh + loss_obj + loss_cls)
+        return total.sum((1, 2, 3))
+
+    gts = gt_score
+    return apply("yolo_loss_op", _loss, x, gt_box, gt_label, gts,
+                 anc=tuple(anchors), msk=tuple(anchor_mask),
+                 C=int(class_num), ig=float(ignore_thresh),
+                 ds=int(downsample_ratio), sxy=float(scale_x_y),
+                 smooth=bool(use_label_smooth))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold,
+               nms_top_k, keep_top_k, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """reference ops.py matrix_nms (SOLOv2): parallel decayed-score NMS
+    — decay_j = min_i f(iou_ij) / max_i f(iou_i,label) over higher-
+    scored boxes. Host-side selection (data-dependent output count)."""
+    bv = np.asarray(bboxes._value if isinstance(bboxes, Tensor)
+                    else bboxes)
+    sv = np.asarray(scores._value if isinstance(scores, Tensor)
+                    else scores)
+    outs, idxs, nums = [], [], []
+    B, C, N = sv.shape
+    for b in range(B):
+        cand = []
+        for c in range(C):
+            if c == background_label:
+                continue
+            sc = sv[b, c]
+            keep = np.nonzero(sc > score_threshold)[0]
+            for i in keep:
+                cand.append((float(sc[i]), c, i))
+        cand.sort(reverse=True)
+        cand = cand[:nms_top_k]
+        if not cand:
+            outs.append(np.zeros((0, 6), np.float32))
+            idxs.append(np.zeros((0,), np.int64))
+            nums.append(0)
+            continue
+        boxes_b = np.stack([bv[b, i] for _s, _c, i in cand])
+        scores_b = np.asarray([s for s, _c, _i in cand], np.float32)
+        labels_b = np.asarray([c for _s, c, _i in cand])
+        x1, y1, x2, y2 = boxes_b.T
+        one = 0.0 if normalized else 1.0
+        area = (x2 - x1 + one) * (y2 - y1 + one)
+        n = len(cand)
+        ix1 = np.maximum(x1[:, None], x1[None, :])
+        iy1 = np.maximum(y1[:, None], y1[None, :])
+        ix2 = np.minimum(x2[:, None], x2[None, :])
+        iy2 = np.minimum(y2[:, None], y2[None, :])
+        inter = np.maximum(ix2 - ix1 + one, 0) * \
+            np.maximum(iy2 - iy1 + one, 0)
+        iou = inter / (area[:, None] + area[None, :] - inter)
+        same = labels_b[:, None] == labels_b[None, :]
+        # pair (i, j) is "live" when i is higher-scored than j (i < j in
+        # the desc-sorted order) and same-class
+        live = np.triu(np.ones((n, n), bool), 1) & same
+        M = np.where(live, iou, 0.0)
+
+        def f(x):
+            return (np.exp(-(x ** 2) / gaussian_sigma) if use_gaussian
+                    else 1.0 - x)
+
+        # SOLOv2 eq. 5: decay_j = min_{i<j} f(iou_ij) / f(comp_i),
+        # comp_i = max_{k<i} iou_ki
+        comp = M.max(0)
+        decay = np.where(live,
+                         f(iou) / np.maximum(f(comp)[:, None], 1e-10),
+                         np.inf)
+        decay_j = np.minimum(decay.min(0), 1.0)
+        dscores = scores_b * np.where(np.isfinite(decay_j), decay_j,
+                                      1.0)
+        keep = dscores > post_threshold
+        order = np.argsort(-dscores[keep])[:keep_top_k]
+        sel = np.nonzero(keep)[0][order]
+        det = np.concatenate(
+            [labels_b[sel, None].astype(np.float32),
+             dscores[sel, None], boxes_b[sel]], 1)
+        outs.append(det.astype(np.float32))
+        idxs.append(np.asarray([cand[i][2] for i in sel], np.int64))
+        nums.append(len(sel))
+    out = Tensor(jnp.asarray(np.concatenate(outs)
+                             if outs else np.zeros((0, 6), np.float32)))
+    rois_num = Tensor(jnp.asarray(np.asarray(nums, np.int32)))
+    index = Tensor(jnp.asarray(np.concatenate(idxs)
+                               if idxs else np.zeros(0, np.int64)))
+    if return_index:
+        return (out, index, rois_num) if return_rois_num else (out,
+                                                               index)
+    return (out, rois_num) if return_rois_num else out
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level,
+                             refer_level, refer_scale,
+                             pixel_offset=False, rois_num=None,
+                             name=None):
+    """reference ops.py distribute_fpn_proposals — assign each RoI to an
+    FPN level by sqrt-area scale (FPN paper eq. 1); returns per-level
+    RoI lists + the restore index. Host-side selection."""
+    rv = np.asarray(fpn_rois._value if isinstance(fpn_rois, Tensor)
+                    else fpn_rois)
+    off = 1.0 if pixel_offset else 0.0
+    w = rv[:, 2] - rv[:, 0] + off
+    h = rv[:, 3] - rv[:, 1] + off
+    scale = np.sqrt(np.maximum(w * h, 1e-12))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    outs, nums, order = [], [], []
+    for level in range(min_level, max_level + 1):
+        sel = np.nonzero(lvl == level)[0]
+        outs.append(Tensor(jnp.asarray(rv[sel])))
+        nums.append(Tensor(jnp.asarray(
+            np.asarray([len(sel)], np.int32))))
+        order.extend(sel.tolist())
+    restore = np.argsort(np.asarray(order)).astype(np.int32)
+    return outs, Tensor(jnp.asarray(restore[:, None])), nums
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors,
+                       variances, pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """reference ops.py generate_proposals (RPN): decode deltas against
+    anchors, clip to the image, drop tiny boxes, top-k + NMS. Host-side
+    selection pipeline over jnp box math."""
+    sv = np.asarray(scores._value if isinstance(scores, Tensor)
+                    else scores)             # [B, A, H, W]
+    dv = np.asarray(bbox_deltas._value if isinstance(bbox_deltas, Tensor)
+                    else bbox_deltas)        # [B, 4A, H, W]
+    im = np.asarray(img_size._value if isinstance(img_size, Tensor)
+                    else img_size)           # [B, 2]
+    av = np.asarray(anchors._value if isinstance(anchors, Tensor)
+                    else anchors).reshape(-1, 4)
+    vv = np.asarray(variances._value if isinstance(variances, Tensor)
+                    else variances).reshape(-1, 4)
+    B = sv.shape[0]
+    off = 1.0 if pixel_offset else 0.0
+    rois_out, num_out, score_out = [], [], []
+    for b in range(B):
+        s = sv[b].transpose(1, 2, 0).reshape(-1)
+        d = dv[b].reshape(-1, 4, sv.shape[2],
+                          sv.shape[3]).transpose(2, 3, 0, 1).reshape(
+            -1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s_k, d_k, a_k, v_k = s[order], d[order], av[order % len(av)], \
+            vv[order % len(vv)]
+        aw = a_k[:, 2] - a_k[:, 0] + off
+        ah = a_k[:, 3] - a_k[:, 1] + off
+        acx = a_k[:, 0] + aw * 0.5
+        acy = a_k[:, 1] + ah * 0.5
+        cx = v_k[:, 0] * d_k[:, 0] * aw + acx
+        cy = v_k[:, 1] * d_k[:, 1] * ah + acy
+        wfull = np.exp(np.minimum(v_k[:, 2] * d_k[:, 2], 10.0)) * aw
+        hfull = np.exp(np.minimum(v_k[:, 3] * d_k[:, 3], 10.0)) * ah
+        x1 = np.clip(cx - wfull / 2, 0, im[b, 1] - off)
+        y1 = np.clip(cy - hfull / 2, 0, im[b, 0] - off)
+        x2 = np.clip(cx + wfull / 2 - off, 0, im[b, 1] - off)
+        y2 = np.clip(cy + hfull / 2 - off, 0, im[b, 0] - off)
+        keep = ((x2 - x1 + off) >= min_size) & \
+            ((y2 - y1 + off) >= min_size)
+        boxes = np.stack([x1, y1, x2, y2], 1)[keep]
+        s_k = s_k[keep]
+        # standard hard NMS
+        sel = []
+        idx = np.argsort(-s_k)
+        areas = (boxes[:, 2] - boxes[:, 0] + off) * \
+            (boxes[:, 3] - boxes[:, 1] + off)
+        while len(idx) and len(sel) < post_nms_top_n:
+            i = idx[0]
+            sel.append(i)
+            if len(idx) == 1:
+                break
+            rest = idx[1:]
+            ix1 = np.maximum(boxes[i, 0], boxes[rest, 0])
+            iy1 = np.maximum(boxes[i, 1], boxes[rest, 1])
+            ix2 = np.minimum(boxes[i, 2], boxes[rest, 2])
+            iy2 = np.minimum(boxes[i, 3], boxes[rest, 3])
+            inter = np.maximum(ix2 - ix1 + off, 0) * \
+                np.maximum(iy2 - iy1 + off, 0)
+            iou = inter / (areas[i] + areas[rest] - inter)
+            idx = rest[iou <= nms_thresh]
+        rois_out.append(boxes[sel])
+        score_out.append(s_k[sel])
+        num_out.append(len(sel))
+    rois = Tensor(jnp.asarray(np.concatenate(rois_out)
+                              if rois_out else np.zeros((0, 4),
+                                                        np.float32)))
+    rscores = Tensor(jnp.asarray(np.concatenate(score_out)
+                                 if score_out else np.zeros(
+                                     0, np.float32)))
+    nums = Tensor(jnp.asarray(np.asarray(num_out, np.int32)))
+    if return_rois_num:
+        return rois, rscores, nums
+    return rois, rscores

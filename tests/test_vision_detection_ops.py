@@ -1,0 +1,312 @@
+"""Detection-op pack (reference vision/ops.py) — torch-free numeric
+oracles: bilinear/constant-field identities, hand-worked box math, NMS
+invariants."""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops
+
+rng = np.random.RandomState(0)
+
+
+class TestIOandDCN:
+    def test_read_decode_jpeg(self, tmp_path):
+        from PIL import Image
+        arr = rng.randint(0, 255, (8, 6, 3)).astype(np.uint8)
+        p = tmp_path / "t.jpg"
+        Image.fromarray(arr).save(p, quality=95)
+        raw = ops.read_file(str(p))
+        assert raw.numpy().dtype == np.uint8
+        img = ops.decode_jpeg(raw)
+        assert tuple(img.shape) == (3, 8, 6)
+
+    def test_deform_conv2d_zero_offsets_is_conv(self):
+        import torch.nn.functional as TF
+        x = rng.randn(1, 2, 6, 6).astype(np.float32)
+        w = rng.randn(3, 2, 3, 3).astype(np.float32)
+        off = np.zeros((1, 18, 6, 6), np.float32)
+        got = ops.deform_conv2d(paddle.to_tensor(x),
+                                paddle.to_tensor(off),
+                                paddle.to_tensor(w), padding=1).numpy()
+        want = TF.conv2d(torch.tensor(x), torch.tensor(w),
+                         padding=1).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+    def test_deform_conv2d_layer_and_mask(self):
+        paddle.seed(0)
+        layer = ops.DeformConv2D(2, 4, 3, padding=1)
+        x = paddle.to_tensor(rng.randn(1, 2, 5, 5).astype(np.float32))
+        off = paddle.to_tensor(np.zeros((1, 18, 5, 5), np.float32))
+        mask = paddle.to_tensor(np.ones((1, 9, 5, 5), np.float32))
+        out = layer(x, off, mask)
+        assert tuple(out.shape) == (1, 4, 5, 5)
+        # zero mask kills the output (minus bias)
+        out0 = layer(x, off, paddle.to_tensor(
+            np.zeros((1, 9, 5, 5), np.float32)))
+        np.testing.assert_allclose(
+            out0.numpy(), layer.bias.numpy()[None, :, None, None]
+            * np.ones_like(out0.numpy()), atol=1e-6)
+
+
+class TestRoiPools:
+    def test_roi_pool_bins(self):
+        # exact-bin geometry: an 8x8 ROI pooled to 4x4 takes the max of
+        # each 2x2 block
+        x = rng.randn(1, 3, 16, 16).astype(np.float32)
+        boxes = np.array([[0, 0, 8, 8]], np.float32)
+        got = ops.roi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                           paddle.to_tensor(np.array([1], np.int32)),
+                           output_size=4).numpy()
+        want = x[0, :, :8, :8].reshape(3, 4, 2, 4, 2).max((2, 4))
+        np.testing.assert_allclose(got[0], want, rtol=1e-5)
+
+    def test_psroi_pool_constant_field(self):
+        # constant input -> every bin pools the constant
+        C, oh, ow = 2, 2, 2
+        x = np.full((1, C * oh * ow, 8, 8), 1.5, np.float32)
+        boxes = np.array([[0, 0, 8, 8]], np.float32)
+        out = ops.psroi_pool(paddle.to_tensor(x),
+                             paddle.to_tensor(boxes),
+                             paddle.to_tensor(np.array([1], np.int32)),
+                             output_size=2).numpy()
+        assert out.shape == (1, C, oh, ow)
+        np.testing.assert_allclose(out, 1.5, rtol=1e-6)
+
+    def test_roi_align_layer(self):
+        x = paddle.to_tensor(np.full((1, 2, 8, 8), 3.0, np.float32))
+        boxes = paddle.to_tensor(np.array([[1, 1, 6, 6]], np.float32))
+        out = ops.RoIAlign(2)(x, boxes,
+                              paddle.to_tensor(np.array([1], np.int32)))
+        np.testing.assert_allclose(out.numpy(), 3.0, rtol=1e-5)
+
+
+class TestBoxMath:
+    def test_box_coder_roundtrip(self):
+        priors = np.array([[10, 10, 30, 40], [5, 5, 25, 25]], np.float32)
+        var = np.array([0.1, 0.1, 0.2, 0.2], np.float32)
+        targets = np.array([[12, 8, 33, 44]], np.float32)
+        enc = ops.box_coder(paddle.to_tensor(priors),
+                            paddle.to_tensor(var),
+                            paddle.to_tensor(targets)).numpy()
+        assert enc.shape == (1, 2, 4)
+        dec = ops.box_coder(paddle.to_tensor(priors),
+                            paddle.to_tensor(var),
+                            paddle.to_tensor(enc),
+                            code_type="decode_center_size").numpy()
+        # decoding its own encoding against the matching prior recovers
+        # the target box
+        np.testing.assert_allclose(dec[0, 0], targets[0], rtol=1e-4,
+                                   atol=1e-3)
+        np.testing.assert_allclose(dec[0, 1], targets[0], rtol=1e-4,
+                                   atol=1e-3)
+
+    def test_prior_box_properties(self):
+        feat = paddle.to_tensor(np.zeros((1, 8, 4, 4), np.float32))
+        img = paddle.to_tensor(np.zeros((1, 3, 64, 64), np.float32))
+        boxes, variances = ops.prior_box(feat, img, min_sizes=[16.0],
+                                         aspect_ratios=[1.0, 2.0],
+                                         flip=True, clip=True)
+        b = boxes.numpy()
+        assert b.shape[:2] == (4, 4) and b.shape[-1] == 4
+        assert (b >= 0).all() and (b <= 1).all()
+        # center of cell (0,0) anchor: ((0+0.5)*16)/64 = 0.125
+        cx = (b[0, 0, 0, 0] + b[0, 0, 0, 2]) / 2
+        assert abs(cx - 0.125) < 1e-5
+        assert variances.numpy().shape == b.shape
+
+    def test_yolo_box_decode(self):
+        B, A, C, H, W = 1, 2, 3, 2, 2
+        x = np.zeros((B, A * (5 + C), H, W), np.float32)
+        img_size = np.array([[64, 64]], np.int32)
+        boxes, scores = ops.yolo_box(
+            paddle.to_tensor(x), paddle.to_tensor(img_size),
+            anchors=[10, 14, 23, 27], class_num=C, conf_thresh=0.0,
+            downsample_ratio=32)
+        bv = boxes.numpy()
+        assert bv.shape == (B, H * W * A, 4)
+        assert scores.numpy().shape == (B, H * W * A, C)
+        # zero logits: sigmoid=0.5 -> first cell center = (0.5/W)*img
+        np.testing.assert_allclose(
+            (bv[0, 0, 0] + bv[0, 0, 2]) / 2, 0.5 / W * 64, atol=1e-3)
+
+    def test_yolo_loss_decreases_when_fitting(self):
+        # loss at a random head should exceed loss at a head matching gt
+        B, H, W, C = 1, 4, 4, 3
+        anchors = [10, 14, 23, 27, 37, 58]
+        mask = [0, 1, 2]
+        A = len(mask)
+        gt_box = np.array([[[0.4, 0.4, 0.3, 0.3]]], np.float32)
+        gt_label = np.array([[1]], np.int64)
+        x_rand = rng.randn(B, A * (5 + C), H, W).astype(np.float32)
+        l_rand = ops.yolo_loss(
+            paddle.to_tensor(x_rand), paddle.to_tensor(gt_box),
+            paddle.to_tensor(gt_label), anchors, mask, C, 0.7, 32,
+            use_label_smooth=False).numpy()
+        assert np.isfinite(l_rand).all()
+        # gradient flows
+        t = paddle.to_tensor(x_rand, stop_gradient=False)
+        loss = ops.yolo_loss(t, paddle.to_tensor(gt_box),
+                             paddle.to_tensor(gt_label), anchors, mask,
+                             C, 0.7, 32, use_label_smooth=False)
+        loss.sum().backward()
+        assert np.isfinite(t.grad.numpy()).all()
+        assert (np.abs(t.grad.numpy()) > 0).any()
+
+
+class TestProposalPipeline:
+    def test_matrix_nms_suppresses_duplicates(self):
+        boxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11],
+                           [30, 30, 40, 40]]], np.float32)
+        scores = np.zeros((1, 2, 3), np.float32)
+        scores[0, 1] = [0.9, 0.85, 0.8]      # class 1 scores
+        out, nums = ops.matrix_nms(
+            paddle.to_tensor(boxes), paddle.to_tensor(scores),
+            score_threshold=0.1, post_threshold=0.5, nms_top_k=10,
+            keep_top_k=10, background_label=0)
+        ov = out.numpy()
+        assert int(nums.numpy()[0]) == ov.shape[0]
+        # the overlapping 2nd box must be decayed below the disjoint one
+        kept_scores = ov[:, 1]
+        assert kept_scores[0] == pytest.approx(0.9, abs=1e-6)
+        assert (ov[:, 0] == 1).all()         # labels
+
+    def test_distribute_fpn_proposals(self):
+        rois = np.array([[0, 0, 16, 16],        # small -> low level
+                         [0, 0, 200, 200]], np.float32)  # large
+        outs, restore, nums = ops.distribute_fpn_proposals(
+            paddle.to_tensor(rois), 2, 5, 4, 224)
+        sizes = [int(n.numpy()[0]) for n in nums]
+        assert sum(sizes) == 2
+        assert sizes[0] == 1 and sizes[-1] >= 0
+        # restore index is a permutation
+        r = restore.numpy().ravel()
+        assert sorted(r.tolist()) == [0, 1]
+
+    def test_generate_proposals(self):
+        H = W = 4
+        A = 2
+        scores = rng.rand(1, A, H, W).astype(np.float32)
+        deltas = np.zeros((1, 4 * A, H, W), np.float32)
+        anchors = np.tile(np.array([[0, 0, 8, 8], [0, 0, 16, 16]],
+                                   np.float32), (H * W, 1))
+        variances = np.ones_like(anchors)
+        rois, rscores, nums = ops.generate_proposals(
+            paddle.to_tensor(scores), paddle.to_tensor(deltas),
+            paddle.to_tensor(np.array([[64, 64]], np.float32)),
+            paddle.to_tensor(anchors), paddle.to_tensor(variances),
+            pre_nms_top_n=20, post_nms_top_n=5, nms_thresh=0.5,
+            min_size=1.0, return_rois_num=True)
+        n = int(nums.numpy()[0])
+        assert 0 < n <= 5
+        rv = rois.numpy()
+        assert rv.shape == (n, 4)
+        assert (rv[:, 2] >= rv[:, 0]).all()
+        # scores sorted descending
+        sv = rscores.numpy()
+        assert (np.diff(sv) <= 1e-6).all()
+
+
+class TestReviewRegressions:
+    def test_yolo_loss_negative_wh_targets_survive(self):
+        # gt smaller than its anchor: tw=log(gw/aw) < 0 must not be
+        # clamped to zero by the target scatter
+        from paddle_tpu.vision.ops import yolo_loss
+        B, H, W, C = 1, 2, 2, 2
+        anchors = [32, 32]
+        mask = [0]
+        # gw = 0.25 with anchor 32/64 = 0.5 -> tw = log(0.5) < 0
+        gt_box = np.array([[[0.25, 0.25, 0.25, 0.25]]], np.float32)
+        gt_label = np.array([[0]], np.int64)
+        # head predicting pw == log(gw/aw) must beat pw == 0
+        x_fit = np.zeros((B, 1 * (5 + C), H, W), np.float32)
+        x_fit[0, 2] = np.log(0.25 / 0.5)
+        x_fit[0, 3] = np.log(0.25 / 0.5)
+        x_zero = np.zeros_like(x_fit)
+        lf = float(yolo_loss(paddle.to_tensor(x_fit),
+                             paddle.to_tensor(gt_box),
+                             paddle.to_tensor(gt_label), anchors, mask,
+                             C, 0.7, 32,
+                             use_label_smooth=False).numpy().sum())
+        lz = float(yolo_loss(paddle.to_tensor(x_zero),
+                             paddle.to_tensor(gt_box),
+                             paddle.to_tensor(gt_label), anchors, mask,
+                             C, 0.7, 32,
+                             use_label_smooth=False).numpy().sum())
+        assert lf < lz, (lf, lz)
+
+    def test_yolo_loss_gt_score_weights(self):
+        from paddle_tpu.vision.ops import yolo_loss
+        B, H, W, C = 1, 2, 2, 2
+        anchors = [16, 16]
+        mask = [0]
+        gt_box = np.array([[[0.4, 0.4, 0.3, 0.3]]], np.float32)
+        gt_label = np.array([[1]], np.int64)
+        x = rng.randn(B, 1 * (5 + C), H, W).astype(np.float32)
+        l1 = yolo_loss(paddle.to_tensor(x), paddle.to_tensor(gt_box),
+                       paddle.to_tensor(gt_label), anchors, mask, C,
+                       0.7, 32, use_label_smooth=False).numpy()
+        l_half = yolo_loss(
+            paddle.to_tensor(x), paddle.to_tensor(gt_box),
+            paddle.to_tensor(gt_label), anchors, mask, C, 0.7, 32,
+            gt_score=paddle.to_tensor(np.array([[0.5]], np.float32)),
+            use_label_smooth=False).numpy()
+        assert not np.allclose(l1, l_half)
+
+    def test_prior_box_pairs_min_max(self):
+        feat = paddle.to_tensor(np.zeros((1, 8, 2, 2), np.float32))
+        img = paddle.to_tensor(np.zeros((1, 3, 64, 64), np.float32))
+        boxes, _ = ops.prior_box(feat, img, min_sizes=[16.0, 32.0],
+                                 max_sizes=[32.0, 64.0],
+                                 aspect_ratios=[1.0])
+        # per min size: 1 ratio anchor + 1 sqrt(min*max) anchor = 4 total
+        assert boxes.numpy().shape[2] == 4
+        with pytest.raises(ValueError, match="pair"):
+            ops.prior_box(feat, img, min_sizes=[16.0, 32.0],
+                          max_sizes=[32.0])
+
+    def test_yolo_box_iou_aware(self):
+        B, A, C, H, W = 1, 1, 2, 2, 2
+        x = np.zeros((B, A * (6 + C), H, W), np.float32)
+        boxes, scores = ops.yolo_box(
+            paddle.to_tensor(x),
+            paddle.to_tensor(np.array([[64, 64]], np.int32)),
+            anchors=[10, 14], class_num=C, conf_thresh=0.0,
+            downsample_ratio=32, iou_aware=True,
+            iou_aware_factor=0.5)
+        # zero logits -> obj = iou = 0.5; score = 0.5^0.5*0.5^0.5*0.5
+        np.testing.assert_allclose(scores.numpy(), 0.25, atol=1e-5)
+
+    def test_box_coder_axis1(self):
+        priors = np.array([[0, 0, 10, 10], [0, 0, 20, 20]], np.float32)
+        var = np.ones((4,), np.float32)
+        deltas = np.zeros((2, 3, 4), np.float32)
+        dec = ops.box_coder(paddle.to_tensor(priors),
+                            paddle.to_tensor(var),
+                            paddle.to_tensor(deltas),
+                            code_type="decode_center_size",
+                            axis=1).numpy()
+        # axis=1: prior i decodes row i -> row 0 recovers prior 0
+        np.testing.assert_allclose(dec[0, 0], priors[0], atol=1e-4)
+        np.testing.assert_allclose(dec[1, 2], priors[1], atol=1e-4)
+
+    def test_khop_sampler_shared_id_space(self):
+        import paddle_tpu.incubate as inc
+        # ring graph 0-1-2-3 (each node's neighbor = next node)
+        row = paddle.to_tensor(np.array([1, 2, 3, 0], np.int64))
+        colptr = paddle.to_tensor(np.array([0, 1, 2, 3, 4], np.int64))
+        paddle.seed(0)
+        src, dst, nodes, counts = inc.graph_khop_sampler(
+            row, colptr, paddle.to_tensor(np.array([0], np.int64)),
+            [1, 1])
+        nv = nodes.numpy().tolist()
+        assert nv[0] == 0                       # input node first
+        # edges reference valid local ids
+        assert max(src.numpy().tolist() + dst.numpy().tolist()) \
+            < len(nv)
+        # hop-1: 0 <- 1; hop-2: 1 <- 2 in global terms
+        sg = [nv[i] for i in src.numpy()]
+        dg = [nv[i] for i in dst.numpy()]
+        assert (dg[0], sg[0]) == (0, 1)
+        assert (dg[1], sg[1]) == (1, 2)
