@@ -11,6 +11,16 @@ import sys as _sys
 
 # submodule aliases so `import paddle_tpu.distributed.fleet` etc. work
 _sys.modules[__name__ + ".fleet"] = fleet
+# alias EVERY fleet submodule so both spellings import identically —
+# a hand-kept list would let the unaliased ones re-import under the
+# distributed name and break their relative imports
+import importlib as _importlib
+import pkgutil as _pkgutil
+for _m in _pkgutil.iter_modules(fleet.__path__):
+    _sub = _importlib.import_module(f"{fleet.__name__}.{_m.name}")
+    _sys.modules[f"{__name__}.fleet.{_m.name}"] = _sub
+from ..parallel import dist_utils as utils
+_sys.modules[__name__ + ".utils"] = utils
 _sys.modules[__name__ + ".sharding"] = sharding
 from ..parallel import collective as _collective  # noqa: E402
 _sys.modules[__name__ + ".collective"] = _collective

@@ -1,0 +1,47 @@
+"""distributed.utils (reference python/paddle/distributed/utils/ — the
+MoE global_scatter/global_gather pair + process helpers).
+
+global_scatter/gather are the reference's expert-parallel all-to-alls
+(moe/global_scatter op): counts say how many rows each rank exchanges.
+The mesh-native MoE lives in parallel.moe (GShard capacity dispatch);
+these entry points serve ported code with equal-count exchanges."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+
+def _world(group):
+    from .collective import _group_info
+    _m, _a, n = _group_info(group)
+    return max(n, 1)
+
+
+def global_scatter(x, local_count, global_count, group=None,
+                   use_calc_stream=True):
+    """Rows routed rank->rank by counts. Equal-count fast path runs the
+    real all_to_all; ragged counts need the capacity-dispatch MoE
+    (parallel.moe) — the TPU-native form of this op."""
+    from .collective import all_to_all
+    n = _world(group)
+    lc = np.asarray(local_count._value if isinstance(local_count, Tensor)
+                    else local_count).reshape(-1)
+    if len(set(lc.tolist())) > 1:
+        raise NotImplementedError(
+            "global_scatter with ragged per-rank counts has data-"
+            "dependent shapes; route through paddle_tpu.parallel.moe "
+            "(GShard capacity dispatch) for the TPU-native path")
+    ins = [Tensor(v) for v in jnp.split(
+        x._value if isinstance(x, Tensor) else jnp.asarray(x), n,
+        axis=0)]
+    outs: list = []
+    all_to_all(outs, ins, group=group)
+    return Tensor(jnp.concatenate([o._value for o in outs], axis=0))
+
+
+def global_gather(x, local_count, global_count, group=None,
+                  use_calc_stream=True):
+    """Inverse of global_scatter (same equal-count contract)."""
+    return global_scatter(x, global_count, local_count, group=group)

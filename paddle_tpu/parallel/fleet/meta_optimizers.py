@@ -1,0 +1,11 @@
+"""fleet.meta_optimizers (reference
+python/paddle/distributed/fleet/meta_optimizers/ — the strategy-driven
+optimizer rewrites). The switchboard lives in fleet/strategy.py; the
+gradient-merge rewrite is a real optimizer here, and the sharding/
+recompute/amp rewrites act through distributed_optimizer (fleet.py)."""
+from __future__ import annotations
+
+from ...optimizer.gradient_merge import (  # noqa: F401
+    GradientMergeOptimizer)
+from ..sharding import (  # noqa: F401
+    GroupShardedOptimizerStage2 as ShardingOptimizer)

@@ -1,0 +1,112 @@
+"""fleet.meta_parallel (reference
+python/paddle/distributed/fleet/meta_parallel/ — the hybrid-parallel
+layer library: parallel_layers/mp_layers.py Column/Row/VocabParallel,
+random.py get_rng_state_tracker, pp_layers.py:56 LayerDesc /
+SharedLayerDesc / :259 PipelineLayer).
+
+TPU-native: the mp layers come from parallel.mp_layers (NamedSharding
+over the 'mp' axis; GSPMD inserts the collectives). PipelineLayer keeps
+the reference's description surface — the single controller owns ALL
+stages, so forward composes every layer; stage placement happens through
+parameter sharding specs, and the pipelined schedule itself runs in
+parallel.pipeline (spmd_pipeline) when the fleet model wrapper drives a
+pp mesh."""
+from __future__ import annotations
+
+from ...nn.layer import Layer
+from ..mp_layers import (  # noqa: F401
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding)
+from ..random import get_rng_state_tracker  # noqa: F401
+from .recompute import recompute  # noqa: F401
+
+
+class LayerDesc:
+    """reference pp_layers.py:56 — deferred layer construction."""
+
+    def __init__(self, layer_func, *inputs, **kwargs):
+        if not (isinstance(layer_func, type)
+                and issubclass(layer_func, Layer)):
+            raise TypeError(
+                "The input(layer_func) should be a derived class of "
+                "Layer.")
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+
+    def build_layer(self):
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_func.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """reference pp_layers.py:76 — a layer shared across stages (e.g.
+    tied embeddings); single-controller SPMD holds ONE instance, so
+    sharing is by construction."""
+
+    def __init__(self, key, layer_func, forward_func=None,
+                 shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    """reference pp_layers.py:259 — builds the layer list from descs and
+    runs them in order. num_stages/topology describe the intended pp
+    split; seg_method='uniform' partitioning is recorded in
+    `stage_of_layer` for schedulers that want it."""
+
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seg_method="uniform",
+                 recompute_interval=0, recompute_ctx=None, name=None,
+                 **kwargs):
+        super().__init__()
+        self._descs = list(layers)
+        self._loss_fn = loss_fn
+        self._num_stages = num_stages or 1
+        self._recompute_interval = recompute_interval
+        self._shared = {}
+        self.run_function = []
+        for i, d in enumerate(self._descs):
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name in self._shared:
+                    layer = self._shared[d.layer_name]
+                else:
+                    layer = d.build_layer()
+                    self._shared[d.layer_name] = layer
+                fwd = d.forward_func
+                self.run_function.append(
+                    (lambda x, _l=layer, _f=fwd:
+                     _f(_l, x) if _f else _l(x)))
+                self.add_sublayer(str(i), layer)
+            elif isinstance(d, LayerDesc):
+                layer = d.build_layer()
+                self.run_function.append(layer)
+                self.add_sublayer(str(i), layer)
+            elif isinstance(d, Layer):
+                self.run_function.append(d)
+                self.add_sublayer(str(i), d)
+            elif callable(d):
+                self.run_function.append(d)
+            else:
+                raise TypeError(f"unsupported pipeline entry {d!r}")
+        n = len(self.run_function)
+        per = max(1, n // self._num_stages)
+        self.stage_of_layer = [min(i // per, self._num_stages - 1)
+                               for i in range(n)]
+
+    def get_num_stages(self):
+        return self._num_stages
+
+    def forward(self, x):
+        for i, fn in enumerate(self.run_function):
+            if (self._recompute_interval
+                    and i % self._recompute_interval == 0
+                    and isinstance(fn, Layer)):
+                x = recompute(fn, x)
+            else:
+                x = fn(x)
+        return x

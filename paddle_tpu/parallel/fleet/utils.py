@@ -1,0 +1,61 @@
+"""fleet.utils (reference python/paddle/distributed/fleet/utils/ —
+recompute + the fs clients + hybrid-parallel helpers)."""
+from __future__ import annotations
+
+import os
+import shutil
+
+from .recompute import recompute  # noqa: F401
+
+
+class LocalFS:
+    """reference utils/fs.py LocalFS — the file-system client the fleet
+    checkpoint utilities use."""
+
+    def ls_dir(self, path):
+        dirs, files = [], []
+        for e in os.scandir(path):
+            (dirs if e.is_dir() else files).append(e.name)
+        return dirs, files
+
+    def mkdirs(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def is_exist(self, path):
+        return os.path.exists(path)
+
+    def is_dir(self, path):
+        return os.path.isdir(path)
+
+    def is_file(self, path):
+        return os.path.isfile(path)
+
+    def delete(self, path):
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def rename(self, src, dst):
+        os.rename(src, dst)
+
+    def touch(self, path, exist_ok=True):
+        if os.path.exists(path) and not exist_ok:
+            raise FileExistsError(path)
+        open(path, "a").close()
+
+    def upload(self, local, remote):
+        shutil.copy(local, remote)
+
+    def download(self, remote, local):
+        shutil.copy(remote, local)
+
+
+class HDFSClient:
+    """reference utils/fs.py HDFSClient — requires a hadoop deployment;
+    not available in this environment."""
+
+    def __init__(self, hadoop_home=None, configs=None):
+        raise NotImplementedError(
+            "HDFSClient needs a hadoop deployment; use LocalFS (or mount "
+            "the remote store) in this environment")

@@ -80,3 +80,60 @@ def test_drive():
     except ValueError:
         pass
     print('entries OK')
+
+
+def test_fleet_submodules(tmp_path):
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.fleet import meta_parallel as mp
+    from paddle_tpu.distributed.fleet import utils as futils
+    from paddle_tpu.distributed.fleet import meta_optimizers  # noqa
+    import paddle_tpu.distributed.utils as dutils
+    import paddle_tpu.nn as nn
+
+    # PipelineLayer from LayerDescs runs end to end
+    paddle.seed(0)
+    pipe = mp.PipelineLayer(
+        layers=[mp.LayerDesc(nn.Linear, 4, 8), mp.LayerDesc(nn.ReLU),
+                mp.LayerDesc(nn.Linear, 8, 2)],
+        num_stages=2)
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(3, 4).astype(np.float32))
+    out = pipe(x)
+    assert tuple(out.shape) == (3, 2)
+    assert pipe.stage_of_layer == [0, 1, 1]
+    assert len(list(pipe.parameters())) == 4
+
+    # SharedLayerDesc reuses ONE instance
+    shared = mp.SharedLayerDesc("emb", nn.Linear, None, "weight", 4, 4)
+    pipe2 = mp.PipelineLayer(layers=[shared, mp.LayerDesc(nn.ReLU),
+                                     mp.SharedLayerDesc(
+                                         "emb", nn.Linear, None,
+                                         "weight", 4, 4)])
+    assert len({id(p) for p in pipe2.parameters()}) == 2  # one w, one b
+
+    # LocalFS roundtrip (tmp_path: auto-cleaned)
+    fs = futils.LocalFS()
+    fs.mkdirs(str(tmp_path / "sub"))
+    fs.touch(str(tmp_path / "f.txt"))
+    dirs, files = fs.ls_dir(str(tmp_path))
+    assert dirs == ["sub"] and files == ["f.txt"]
+
+    # every fleet submodule imports under the distributed spelling
+    import importlib
+    import pkgutil
+    from paddle_tpu.parallel import fleet as _fl
+    for m in pkgutil.iter_modules(_fl.__path__):
+        importlib.import_module(
+            f"paddle_tpu.distributed.fleet.{m.name}")
+
+    # global_scatter/gather equal-count exchange on the 8-dev mesh
+    from paddle_tpu.parallel.mesh import build_mesh, use_mesh
+    with use_mesh(build_mesh({'dp': 8})):
+        xt = paddle.to_tensor(np.arange(16, dtype=np.float32)
+                              .reshape(16, 1))
+        counts = paddle.to_tensor(np.full(8, 2, np.int64))
+        out = dutils.global_scatter(xt, counts, counts)
+        assert tuple(out.shape) == (16, 1)
+        back = dutils.global_gather(out, counts, counts)
+        assert tuple(back.shape) == (16, 1)
