@@ -93,3 +93,37 @@ def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
     del layer._parameters[name]
     layer.register_forward_pre_hook(_pre_hook)
     return layer
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """reference nn/utils/clip_grad_norm_.py — in-place gradient clip by
+    total norm across the parameter list; returns the pre-clip norm."""
+    import math
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    from ...framework.tensor import Tensor
+
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+    norm_type = float(norm_type)
+    if math.isinf(norm_type):
+        total = jnp.max(jnp.asarray(
+            [jnp.max(jnp.abs(g._value)) for g in grads]))
+    else:
+        total = jnp.power(
+            sum(jnp.sum(jnp.power(jnp.abs(g._value), norm_type))
+                for g in grads), 1.0 / norm_type)
+    if error_if_nonfinite and not bool(np.isfinite(np.asarray(total))):
+        raise RuntimeError(
+            f"The total norm of {norm_type} order of the gradients is "
+            "non-finite, so it cannot be clipped")
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for g in grads:
+        g._value = (g._value * scale).astype(g._value.dtype)
+    return Tensor(total)

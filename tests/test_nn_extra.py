@@ -407,3 +407,33 @@ class TestSmallShims:
             vision.set_image_backend("pil")
         with pytest.raises(ValueError):
             vision.set_image_backend("bogus")
+
+
+class TestClipGradNorm:
+    def test_matches_torch(self):
+        import torch as _torch
+        paddle.seed(0)
+        w = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(4, 3).astype(np.float32),
+                             stop_gradient=False)
+        (w * w * 3).sum().backward()
+        g0 = w.grad.numpy().copy()
+        total = nn.utils.clip_grad_norm_([w], max_norm=1.0)
+        np.testing.assert_allclose(float(total.numpy()),
+                                   np.linalg.norm(g0), rtol=1e-5)
+        tw = _torch.tensor(np.random.RandomState(0)
+                           .randn(4, 3).astype(np.float32),
+                           requires_grad=True)
+        (tw * tw * 3).sum().backward()
+        _torch.nn.utils.clip_grad_norm_([tw], max_norm=1.0)
+        np.testing.assert_allclose(w.grad.numpy(), tw.grad.numpy(),
+                                   rtol=1e-4)
+
+    def test_inf_norm(self):
+        w = paddle.to_tensor(np.array([3.0, -4.0], np.float32),
+                             stop_gradient=False)
+        (w * w).sum().backward()
+        t = nn.utils.clip_grad_norm_([w], 2.0, norm_type=float("inf"))
+        assert abs(float(t.numpy()) - 8.0) < 1e-5
+        np.testing.assert_allclose(np.abs(w.grad.numpy()).max(), 2.0,
+                                   rtol=1e-4)
