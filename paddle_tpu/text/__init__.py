@@ -14,7 +14,7 @@ import jax.numpy as jnp
 
 from ..framework.dispatch import apply
 
-__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets",
+__all__ = ["viterbi_decode", "ViterbiDecoder", "edit_distance", "datasets",
            "FasterTokenizer", "Imdb", "Imikolov", "UCIHousing",
            "Movielens", "WMT14", "WMT16", "Conll05st"]
 
@@ -66,6 +66,103 @@ def viterbi_decode(potentials, transition_params, lengths=None,
                      transition_params)
     return apply("viterbi_decode_len", _viterbi, potentials,
                  transition_params, lengths)
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None, name=None):
+    """Batched Levenshtein distance (reference op `edit_distance`,
+    paddle/phi/kernels/cpu/edit_distance_kernel.cc; python API
+    python/paddle/nn/functional/loss.py edit_distance).
+
+    input [B, T1] / label [B, T2] int token ids, optional per-sequence
+    lengths [B]. Returns (distance [B, 1] float32, sequence_num [1]).
+    TPU-native: the DP table is computed on full static shapes with a
+    lax.scan over hypothesis positions (inner scan over label positions)
+    and the (input_length, label_length) cell is gathered at the end, so
+    no dynamic shapes ever reach XLA. ignored_tokens are compacted out
+    with a stable argsort on the keep-mask (static-shape filtering)."""
+    def _compact(seq, length, ignored):
+        """Drop ignored tokens, keeping order, under static shapes."""
+        T = seq.shape[1]
+        pos = jnp.arange(T)[None, :]
+        keep = pos < length[:, None]
+        for tok in ignored:
+            keep = jnp.logical_and(keep, seq != tok)
+        # stable sort on (not keep): kept tokens slide to the front in
+        # their original order; tail is padding
+        order = jnp.argsort(jnp.logical_not(keep), axis=1, stable=True)
+        return (jnp.take_along_axis(seq, order, axis=1),
+                jnp.sum(keep, axis=1))
+
+    def _fn(hyp, ref, hyp_len, ref_len, *, norm, ign):
+        B, T1 = hyp.shape
+        T2 = ref.shape[1]
+        hyp_len = hyp_len.astype(jnp.int32)
+        ref_len = ref_len.astype(jnp.int32)
+        if ign:
+            hyp, hyp_len = _compact(hyp, hyp_len, ign)
+            ref, ref_len = _compact(ref, ref_len, ign)
+
+        def one(h, r, hl, rl):
+            row0 = jnp.arange(T2 + 1, dtype=jnp.int32)
+
+            def outer(prev_row, i):
+                cost = (h[i - 1] != r).astype(jnp.int32)    # [T2]
+
+                def inner(left, j):
+                    val = jnp.minimum(
+                        jnp.minimum(left + 1, prev_row[j] + 1),
+                        prev_row[j - 1] + cost[j - 1])
+                    return val, val
+
+                _, tail = jax.lax.scan(inner, i.astype(jnp.int32),
+                                       jnp.arange(1, T2 + 1))
+                row = jnp.concatenate([i[None].astype(jnp.int32), tail])
+                return row, row
+
+            _, rows = jax.lax.scan(outer, row0,
+                                   jnp.arange(1, T1 + 1))
+            full = jnp.concatenate([row0[None], rows])      # [T1+1, T2+1]
+            return full[hl, rl].astype(jnp.float32)
+
+        d = jax.vmap(one)(hyp, ref, hyp_len, ref_len)
+        if norm:
+            # reference rejects empty references under normalization; data
+            # under jit can't raise, so surface the invalid rows as inf
+            # (loud in any CER/WER aggregation) instead of silently
+            # returning the raw distance
+            d = jnp.where(ref_len > 0,
+                          d / jnp.maximum(ref_len.astype(jnp.float32), 1.0),
+                          jnp.inf)
+        return d[:, None], jnp.array([B], dtype=jnp.int32)
+
+    B, T1 = input.shape[0], input.shape[1]
+    T2 = label.shape[1]
+
+    def _check_len(length, dim, what):
+        # eager values get the reference kernel's loud bounds check; traced
+        # values can't be inspected (the DP gather clamps, best effort)
+        val = getattr(length, "_value", length)
+        if val is not None and not isinstance(val, jax.core.Tracer):
+            import numpy as _np
+            arr = _np.asarray(val)
+            if arr.size and (arr.max() > dim or arr.min() < 0):
+                raise ValueError(
+                    f"edit_distance: {what} out of range [0, {dim}]: "
+                    f"max={arr.max()}, min={arr.min()}")
+
+    if input_length is None:
+        input_length = jnp.full((B,), T1, jnp.int32)
+    else:
+        _check_len(input_length, T1, "input_length")
+    if label_length is None:
+        label_length = jnp.full((B,), T2, jnp.int32)
+    else:
+        _check_len(label_length, T2, "label_length")
+    return apply("edit_distance", _fn, input, label, input_length,
+                 label_length, norm=bool(normalized),
+                 ign=tuple(int(t) for t in ignored_tokens)
+                 if ignored_tokens else ())
 
 
 class ViterbiDecoder:

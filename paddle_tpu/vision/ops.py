@@ -1,6 +1,8 @@
-"""Vision ops: nms, roi_align (reference python/paddle/vision/ops.py over
-phi nms/roi_align kernels — the two vision ops the op-coverage ledger
-tracks; the wider detection zoo is descoped there with reasons).
+"""Vision ops (reference python/paddle/vision/ops.py over the phi
+detection kernel zoo): nms/roi ops plus the detection pack — box_coder,
+prior_box, yolo_box/yolo_loss, matrix_nms, FPN proposal ops,
+deform_conv2d. The op-coverage ledger (ops/optable.py) aliases the
+reference YAML ops onto these entry points.
 """
 from __future__ import annotations
 
@@ -19,8 +21,6 @@ __all__ = [
     "distribute_fpn_proposals", "generate_proposals", "read_file",
     "decode_jpeg",
 ]
-
-__all__ = ["nms", "roi_align", "box_iou"]
 
 
 def box_iou(boxes1, boxes2):

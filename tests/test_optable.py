@@ -17,9 +17,75 @@ def test_coverage_thresholds():
     # or on the short to-implement list (vision-pack ops)
     assert (len(cov["implemented"]) + len(cov["descoped"])
             + len(cov["missing"])) == cov["total_ref"] == 358
-    assert len(cov["implemented"]) >= 320
+    assert len(cov["implemented"]) >= 335
     assert cov["missing"] == []        # every reference op accounted for
     assert cov["registry_size"] >= 300
+
+
+def test_ledger_has_no_false_descopes():
+    """Round-3 verdict weak #2: ops the code implements must not sit in the
+    DESCOPED table. validate() now mechanically rejects resolvable
+    descopes; this spot-checks the 2024-round-3 offenders are aliases."""
+    cov = optable.coverage()
+    for name in ("yolo_box", "yolo_loss", "matrix_nms", "box_coder",
+                 "prior_box", "psroi_pool", "roi_pool", "deformable_conv",
+                 "affine_grid", "temporal_shift", "class_center_sample",
+                 "margin_cross_entropy", "hsigmoid_loss", "unpool",
+                 "spectral_norm", "warprnnt", "edit_distance"):
+        assert name in cov["implemented"], name
+        assert name not in cov["descoped"], name
+
+
+def test_vision_ops_all_is_complete():
+    """vision/ops.py carried a second, narrowing __all__ that hid the
+    detection pack (round-3 verdict weak #3)."""
+    import paddle_tpu.vision.ops as vops
+    assert "yolo_box" in vops.__all__ and "deform_conv2d" in vops.__all__
+    for n in vops.__all__:
+        assert hasattr(vops, n), n
+
+
+def test_edit_distance_matches_oracle():
+    def lev(a, b):
+        dp = list(range(len(b) + 1))
+        for i, ca in enumerate(a, 1):
+            prev, dp[0] = dp[0], i
+            for j, cb in enumerate(b, 1):
+                prev, dp[j] = dp[j], min(dp[j] + 1, dp[j - 1] + 1,
+                                         prev + (ca != cb))
+        return dp[-1]
+
+    rng = np.random.RandomState(0)
+    hyp = rng.randint(0, 5, (4, 7)).astype(np.int64)
+    ref = rng.randint(0, 5, (4, 9)).astype(np.int64)
+    hyp_len = np.array([7, 3, 5, 1], np.int64)
+    ref_len = np.array([9, 4, 2, 6], np.int64)
+    from paddle_tpu.text import edit_distance
+    d, n = edit_distance(paddle.to_tensor(hyp), paddle.to_tensor(ref),
+                         normalized=False,
+                         input_length=paddle.to_tensor(hyp_len),
+                         label_length=paddle.to_tensor(ref_len))
+    assert int(np.asarray(n._value)[0]) == 4
+    for b in range(4):
+        exp = lev(list(hyp[b][:hyp_len[b]]), list(ref[b][:ref_len[b]]))
+        assert float(np.asarray(d._value)[b, 0]) == exp
+    # normalized divides by the label length
+    dn, _ = edit_distance(paddle.to_tensor(hyp), paddle.to_tensor(ref),
+                          normalized=True,
+                          input_length=paddle.to_tensor(hyp_len),
+                          label_length=paddle.to_tensor(ref_len))
+    np.testing.assert_allclose(
+        np.asarray(dn._value)[:, 0],
+        np.asarray(d._value)[:, 0] / ref_len, rtol=1e-6)
+    # ignored tokens are removed from both sides before the DP
+    di, _ = edit_distance(paddle.to_tensor(hyp), paddle.to_tensor(ref),
+                          normalized=False, ignored_tokens=[0],
+                          input_length=paddle.to_tensor(hyp_len),
+                          label_length=paddle.to_tensor(ref_len))
+    for b in range(4):
+        exp = lev([t for t in hyp[b][:hyp_len[b]] if t != 0],
+                  [t for t in ref[b][:ref_len[b]] if t != 0])
+        assert float(np.asarray(di._value)[b, 0]) == exp
 
 
 def test_every_alias_resolves():
