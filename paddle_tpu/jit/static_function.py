@@ -114,6 +114,7 @@ class StaticFunction:
     def __init__(self, fn: Callable, input_spec=None, build_strategy=None,
                  full_graph=True, property_=False, remat=False):
         self._fn = fn
+        self._converted_fn = None        # lazily AST-converted (dy2static)
         self._input_spec = input_spec
         self._remat = remat
         self._cache: Dict[Tuple, ConcreteProgram] = {}
@@ -146,12 +147,22 @@ class StaticFunction:
         return (avals, str(treedef), static_key, mode, amp_key)
 
     def _trace(self, args, kwargs, in_tensors, mask, statics, treedef):
-        fn = self._fn
+        # dy2static AST pass: Python if/while/for-range on tensor values
+        # become lax.cond / lax.while_loop through the runtime converters
+        # (reference dy2static/ast_transformer.py:62); unconvertible
+        # functions run unchanged and hit the guided floor error below
+        from . import dy2static
+        if self._converted_fn is None:
+            self._converted_fn = dy2static.convert_function(self._fn)
+        fn = self._converted_fn
 
         # Phase 1 — capture pre-pass (eager; discovers params/buffers/consts)
         rec = CaptureRecorder(in_tensors)
-        with rec:
-            sample_out = fn(*args, **kwargs)
+        try:
+            with rec:
+                sample_out = fn(*args, **kwargs)
+        except dy2static._TRACER_ERRORS as e:
+            dy2static.guided_reraise(e, fn)
         captured = rec.captured
 
         out_tensors, out_mask, out_statics, out_treedef = \
@@ -204,9 +215,14 @@ class StaticFunction:
 
         # Phase 2 — trace once abstractly to fix mutated-buffer slots
         key0 = next_key()
-        jax.eval_shape(pure, key0,
-                       *[c._value for c in captured],
-                       *[t._value for t in in_tensors])
+        try:
+            jax.eval_shape(pure, key0,
+                           *[c._value for c in captured],
+                           *[t._value for t in in_tensors])
+        except dy2static._TRACER_ERRORS as e:
+            # data-dependent Python control flow the AST pass could not
+            # convert: re-raise with the paddle-shaped rewrite guidance
+            dy2static.guided_reraise(e, fn)
         mutated = [captured[i] for i in mutated_slots]
 
         return ConcreteProgram(
