@@ -4,13 +4,15 @@ Reference analog: python/paddle/quantization/ (QuantConfig config.py,
 `PTQ`/`QAT` drivers ptq.py/qat.py, observer/quanter factories, quanted
 layer wrappers) over the slim quant passes.
 
-TPU-native scope: the TPU int8 story is *simulated* quantization in the
-compiled graph — fake-quant (quantize→dequantize) ops around weights and
-activations, which XLA folds into the surrounding fusions. PTQ = run
-calibration batches through observers → freeze scales; QAT = train with
-fake-quant in the graph (straight-through estimator on the rounding).
-Conversion to a true int8 serving graph is the deploy step and stays out
-of scope (the reference also delegates that to Paddle-Lite/Inference).
+TPU-native scope: PTQ = run calibration batches through observers →
+freeze scales; QAT = train with fake-quant in the graph
+(straight-through estimator on the rounding); XLA folds the fake-quant
+ops into the surrounding fusions. Conversion to a TRUE int8 serving
+graph is `int8.convert_to_int8` (round-4): calibrated wrappers freeze
+into Int8Linear / Int8Conv2D, which run real int8 `dot_general` / conv
+with i32 accumulation and a fused dequant epilogue — the XLA-native
+analog of the reference's quant2_int8 kernel-substitution pass
+(python/paddle/static/quantization/post_training_quantization.py:1).
 """
 from __future__ import annotations
 
@@ -25,8 +27,9 @@ from ..framework.dispatch import defop
 from ..nn.layer import Layer
 
 __all__ = ["QuantConfig", "AbsmaxObserver", "MovingAverageObserver",
-           "FakeQuant", "QuantedLinear", "PTQ", "QAT",
-           "quant_dequant", "QAT_READY_LAYERS"]
+           "FakeQuant", "QuantedLinear", "QuantedConv2D", "PTQ", "QAT",
+           "quant_dequant", "QAT_READY_LAYERS",
+           "Int8Linear", "Int8Conv2D", "convert_to_int8"]
 
 
 @defop("fake_quant_dequant")
@@ -107,7 +110,8 @@ class QuantConfig:
 
     def matches(self, layer) -> bool:
         from ..nn.layers.common import Linear
-        types = self._types or [Linear]
+        from ..nn.layers.conv import Conv2D
+        types = self._types or [Linear, Conv2D]
         return isinstance(layer, tuple(types))
 
 
@@ -126,11 +130,15 @@ class FakeQuant(Layer):
     observation is skipped (host-side stat; scales are frozen inside
     compiled graphs) instead of crashing on a tracer."""
 
-    def __init__(self, quant_bits=8, momentum=0.9):
+    def __init__(self, quant_bits=8, momentum=0.9, observer=None):
         super().__init__()
         self.quant_bits = quant_bits
         self.calibrating = False
-        self.observer = MovingAverageObserver(quant_bits, momentum)
+        # default: EMA abs-max (the QAT quanter); PTQ passes its
+        # config.activation_factory (running abs-max — EMA would
+        # under-estimate the range and clip eval activations)
+        self.observer = observer or MovingAverageObserver(quant_bits,
+                                                          momentum)
         # the learned scale is a persisted buffer: it round-trips through
         # state_dict so a reloaded quantized model serves with the
         # calibrated scale (observers are host-side stats, not saved)
@@ -152,7 +160,9 @@ class QuantedLinear(Layer):
     def __init__(self, linear, config: QuantConfig):
         super().__init__()
         self.linear = linear
-        self.act_quant = FakeQuant(config.quant_bits)
+        self.act_quant = FakeQuant(
+            config.quant_bits,
+            observer=config.activation_factory(config.quant_bits))
         self.w_observer = config.weight_factory(config.quant_bits)
         self.quant_bits = config.quant_bits
         self.calibrating = False
@@ -172,14 +182,52 @@ class QuantedLinear(Layer):
         return F.linear(x, w, self.linear.bias)
 
 
-QAT_READY_LAYERS = ["Linear"]
+class QuantedConv2D(Layer):
+    """Conv2D with fake-quant on input activation + weight (reference
+    nn/quant_layers QuantedConv2D). Freezes to Int8Conv2D via
+    quantization.int8.convert_to_int8."""
+
+    def __init__(self, conv, config: QuantConfig):
+        super().__init__()
+        self.conv = conv
+        self.act_quant = FakeQuant(
+            config.quant_bits,
+            observer=config.activation_factory(config.quant_bits))
+        self.w_observer = config.weight_factory(config.quant_bits)
+        self.quant_bits = config.quant_bits
+        self.calibrating = False
+        self.register_buffer("w_scale",
+                             Tensor(jnp.ones((), jnp.float32)))
+
+    def forward(self, x):
+        x = self.act_quant(x)
+        if (self.training or self.calibrating) and not _is_traced(
+                self.conv.weight):
+            self.w_observer.observe(self.conv.weight)
+            self.w_scale._value = jnp.asarray(self.w_observer.scale(),
+                                              jnp.float32)
+        w = quant_dequant(self.conv.weight, self.w_scale, self.quant_bits)
+        from ..nn import functional as F
+        return F.conv2d(x, w, self.conv.bias, self.conv._stride,
+                        self.conv._padding, self.conv._dilation,
+                        self.conv._groups, self.conv._data_format)
+
+
+QAT_READY_LAYERS = ["Linear", "Conv2D"]
+
+
+def _wrapper_for(child, config):
+    from ..nn.layers.conv import Conv2D
+    if isinstance(child, Conv2D):
+        return QuantedConv2D(child, config)
+    return QuantedLinear(child, config)
 
 
 def _swap_layers(model: Layer, config: QuantConfig):
     replaced = 0
     for name, child in list(model.named_children()):
         if config.matches(child):
-            setattr(model, name, QuantedLinear(child, config))
+            setattr(model, name, _wrapper_for(child, config))
             replaced += 1
         else:
             replaced += _swap_layers(child, config)
@@ -224,8 +272,11 @@ class PTQ:
         self._set_calibrating(model, True)
         return model
 
-    def convert(self, model: Layer, inplace=True) -> Layer:
+    def convert(self, model: Layer, inplace=True, to_int8=False) -> Layer:
         self._set_calibrating(model, False)   # freeze scales
+        if to_int8:
+            from .int8 import convert_to_int8
+            return convert_to_int8(model)
         return model
 
 
@@ -267,3 +318,7 @@ def quanter(name):
         globals()[name] = _QuanterFactory(cls)
         return cls
     return deco
+
+
+from .int8 import (  # noqa: E402
+    Int8Linear, Int8Conv2D, convert_to_int8, quantize_weight)

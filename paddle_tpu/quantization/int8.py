@@ -1,0 +1,222 @@
+"""True int8 execution (round-3 verdict item 3).
+
+Reference analog:
+python/paddle/static/quantization/post_training_quantization.py:1 (the
+PTQ driver that rewrites the calibrated graph to real int8 kernels) and
+quant2_int8_mkldnn_pass.py:1 (the int8 kernel substitution pass).
+
+TPU-native: the "int8 kernel" is an XLA `dot_general` /
+`conv_general_dilated` on int8 operands with an int32 accumulator —
+XLA lowers that onto the MXU's native int8 mode on TPU (and emulates on
+CPU, keeping the parity tests hardware-independent). The quantize step
+(fp -> int8 on the activation) and the dequant epilogue (i32 * scale +
+bias) sit inside the same jitted op, so XLA fuses them around the
+matmul. Weights are stored int8 with per-output-channel scales (the
+reference's channel_wise_abs_max for weights + abs_max for activations).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..framework.dispatch import defop
+from ..nn.layer import Layer
+
+__all__ = ["Int8Linear", "Int8Conv2D", "convert_to_int8",
+           "quantize_weight"]
+
+_Q = 127.0
+
+
+def quantize_weight(w: np.ndarray, channel_axis: Optional[int] = None):
+    """fp weight -> (int8 weight, fp32 scale). Per-channel over
+    `channel_axis` (reference channel_wise_abs_max), else per-tensor."""
+    w = np.asarray(w, np.float32)
+    if channel_axis is None:
+        scale = np.maximum(np.abs(w).max(), 1e-8).astype(np.float32)
+    else:
+        axes = tuple(i for i in range(w.ndim) if i != channel_axis)
+        scale = np.maximum(np.abs(w).max(axis=axes), 1e-8) \
+            .astype(np.float32)
+        shape = [1] * w.ndim
+        shape[channel_axis] = -1
+        scale_b = scale.reshape(shape)
+        return (np.clip(np.round(w / scale_b * _Q), -_Q, _Q)
+                .astype(np.int8), scale)
+    return (np.clip(np.round(w / scale * _Q), -_Q, _Q).astype(np.int8),
+            scale)
+
+
+def _quant_act(x, x_scale):
+    xs = jnp.maximum(x_scale, 1e-8)
+    return (jnp.clip(jnp.round(x.astype(jnp.float32) / xs * _Q), -_Q, _Q)
+            .astype(jnp.int8), xs)
+
+
+@defop("int8_linear")
+def _int8_linear(x, w_q, bias, x_scale, w_scale):
+    """y = dequant(quant(x) @ w_q): int8 x int8 -> i32 accumulate, then
+    the fused epilogue i32 * (s_x * s_w / 127^2) + b."""
+    x_q, xs = _quant_act(x, x_scale)
+    y = jax.lax.dot_general(
+        x_q, w_q, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    y = y.astype(jnp.float32) * (xs * w_scale / (_Q * _Q))
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+@defop("int8_conv2d")
+def _int8_conv2d(x, w_q, bias, x_scale, w_scale, stride, padding, dilation,
+                 groups, data_format):
+    x_q, xs = _quant_act(x, x_scale)
+    fmt = ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" else \
+        ("NHWC", "OIHW", "NHWC")
+    dn = jax.lax.conv_dimension_numbers(x.shape, w_q.shape, fmt)
+    y = jax.lax.conv_general_dilated(
+        x_q, w_q, window_strides=stride, padding=padding,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups, preferred_element_type=jnp.int32)
+    ch = ((None, slice(None), None, None) if data_format == "NCHW"
+          else (None, None, None, slice(None)))
+    y = y.astype(jnp.float32) * (xs * w_scale[ch] / (_Q * _Q))
+    if bias is not None:
+        y = y + bias[ch]
+    return y
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+class Int8Linear(Layer):
+    """Serving Linear with int8 weights + real int8 matmul (reference
+    quant2_int8 pass output). Buffers only — int8 weight, per-out-channel
+    weight scales, the calibrated activation scale — so it serializes
+    through state_dict and serves through Predictor / jit.to_static."""
+
+    def __init__(self, in_features, out_features, has_bias=True):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.register_buffer("weight_q", Tensor(
+            jnp.zeros((in_features, out_features), jnp.int8)))
+        self.register_buffer("w_scale", Tensor(
+            jnp.ones((out_features,), jnp.float32)))
+        self.register_buffer("act_scale", Tensor(
+            jnp.ones((), jnp.float32)))
+        if has_bias:
+            self.register_buffer("bias", Tensor(
+                jnp.zeros((out_features,), jnp.float32)))
+        else:
+            self.bias = None
+
+    @classmethod
+    def from_quanted(cls, ql) -> "Int8Linear":
+        """Freeze a calibrated QuantedLinear into the int8 layer."""
+        lin = ql.linear
+        w = np.asarray(lin.weight.numpy(), np.float32)
+        w_q, w_scale = quantize_weight(w, channel_axis=1)  # [in, out]
+        layer = cls(w.shape[0], w.shape[1], has_bias=lin.bias is not None)
+        layer.weight_q.set_value(w_q)
+        layer.w_scale.set_value(w_scale)
+        layer.act_scale.set_value(
+            np.asarray(ql.act_quant.scale.numpy(), np.float32))
+        if lin.bias is not None:
+            layer.bias.set_value(np.asarray(lin.bias.numpy(), np.float32))
+        return layer
+
+    def forward(self, x):
+        return _int8_linear(x, self.weight_q, self.bias, self.act_scale,
+                            self.w_scale)
+
+    def extra_repr(self):
+        return (f"in_features={self.in_features}, "
+                f"out_features={self.out_features}, int8")
+
+
+class Int8Conv2D(Layer):
+    """Serving Conv2D with int8 weights + real int8 convolution."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, has_bias=True,
+                 data_format="NCHW"):
+        super().__init__()
+        # normalize exactly like the fp conv path so every paddle padding
+        # form (int, pair, 4-int, per-dim pairs, 'same'/'valid') survives
+        # the freeze
+        from ..nn.functional.conv import _padding, _tuplize
+        ks = _pair(kernel_size)
+        self._stride = _tuplize(stride, 2)
+        self._dilation = _tuplize(dilation, 2)
+        self._groups = int(groups)
+        self._padding = _padding(padding, 2)
+        self._data_format = data_format
+        self.register_buffer("weight_q", Tensor(jnp.zeros(
+            (out_channels, in_channels // groups, *ks), jnp.int8)))
+        self.register_buffer("w_scale", Tensor(
+            jnp.ones((out_channels,), jnp.float32)))
+        self.register_buffer("act_scale", Tensor(
+            jnp.ones((), jnp.float32)))
+        if has_bias:
+            self.register_buffer("bias", Tensor(
+                jnp.zeros((out_channels,), jnp.float32)))
+        else:
+            self.bias = None
+
+    @classmethod
+    def from_quanted(cls, qc) -> "Int8Conv2D":
+        conv = qc.conv
+        w = np.asarray(conv.weight.numpy(), np.float32)
+        w_q, w_scale = quantize_weight(w, channel_axis=0)  # [out,in,kh,kw]
+        layer = cls(w.shape[1] * conv._groups, w.shape[0], w.shape[2:],
+                    stride=conv._stride, padding=conv._padding,
+                    dilation=conv._dilation, groups=conv._groups,
+                    has_bias=conv.bias is not None,
+                    data_format=conv._data_format)
+        layer.weight_q.set_value(w_q)
+        layer.w_scale.set_value(w_scale)
+        layer.act_scale.set_value(
+            np.asarray(qc.act_quant.scale.numpy(), np.float32))
+        if conv.bias is not None:
+            layer.bias.set_value(np.asarray(conv.bias.numpy(), np.float32))
+        return layer
+
+    def forward(self, x):
+        return _int8_conv2d(x, self.weight_q, self.bias, self.act_scale,
+                            self.w_scale, self._stride, self._padding,
+                            self._dilation, self._groups,
+                            self._data_format)
+
+
+def convert_to_int8(model: Layer) -> Layer:
+    """Swap every calibrated fake-quant wrapper for its real int8 layer
+    (the reference PTQ driver's save_quantized_model int8 path). Call
+    after PTQ calibration (or QAT training); the model then executes
+    int8 dot_general/conv and can be served via jit.to_static /
+    inference.Predictor."""
+    if _convert_children(model) == 0:
+        raise ValueError("convert_to_int8 found no calibrated quantized "
+                         "layers (run PTQ/QAT quantize + calibration "
+                         "first)")
+    return model
+
+
+def _convert_children(model: Layer) -> int:
+    from . import QuantedLinear, QuantedConv2D
+    n = 0
+    for name, child in list(model.named_children()):
+        if isinstance(child, QuantedLinear):
+            setattr(model, name, Int8Linear.from_quanted(child))
+            n += 1
+        elif isinstance(child, QuantedConv2D):
+            setattr(model, name, Int8Conv2D.from_quanted(child))
+            n += 1
+        else:
+            n += _convert_children(child)
+    return n
