@@ -1,0 +1,56 @@
+"""Worker-side liveness heartbeat (reference
+fleet/elastic/manager.py:124 — the ElasticManager keeps an etcd lease
+alive per worker and the master watches for expiry; here the lease is a
+file mtime the local controller watches, no external store needed).
+
+The launch bootstrap calls start_from_env() before the user script runs,
+so liveness needs no user code. A worker can call stop() to simulate (or
+deliberately signal) loss of liveness — the controller then treats it as
+hung and restarts the pod.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+_thread: Optional[threading.Thread] = None
+_stop = threading.Event()
+
+ENV_FILE = "PADDLE_HEARTBEAT_FILE"
+ENV_INTERVAL = "PADDLE_HEARTBEAT_INTERVAL"
+
+
+def _touch(path: str) -> None:
+    try:
+        with open(path, "a"):
+            os.utime(path, None)
+    except OSError:
+        pass
+
+
+def start_from_env() -> bool:
+    """Start the beat thread if the controller exported the contract;
+    idempotent. Returns True when beating."""
+    global _thread
+    path = os.environ.get(ENV_FILE)
+    if not path or (_thread is not None and _thread.is_alive()):
+        return _thread is not None
+    interval = float(os.environ.get(ENV_INTERVAL, "1.0"))
+    _stop.clear()
+    _touch(path)
+
+    def beat():
+        while not _stop.wait(interval):
+            _touch(path)
+
+    _thread = threading.Thread(target=beat, name="paddle-heartbeat",
+                               daemon=True)
+    _thread.start()
+    return True
+
+
+def stop() -> None:
+    """Stop beating (the controller will see this worker as hung after
+    its --hang_timeout)."""
+    _stop.set()

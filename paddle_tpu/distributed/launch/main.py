@@ -15,7 +15,15 @@ not one per accelerator — a v5p-16 pod slice with 4 hosts is
 - elastic-lite: `--max_restart K` watches children and restarts the whole
   local pod up to K times when any worker exits nonzero (the reference
   ElasticManager's restart loop, minus etcd — the coordination service
-  owns membership).
+  owns membership);
+- liveness (reference fleet/elastic/manager.py:124): with
+  `--hang_timeout S` each worker heartbeats a file through the boot shim
+  and the controller restarts the pod when any worker's beat goes stale —
+  hung workers (deadlock, wedged backend init), not just exited ones;
+- scale-down continuation: `--min_procs M` lets the pod relaunch with
+  one fewer worker (down to M) after restarts are exhausted — the
+  reference's nnodes-1 "job proceeds after grace period" behavior, with
+  the world size re-exported so rendezvous re-forms at the smaller size.
 
 Usage:
   python -m paddle_tpu.distributed.launch --nnodes 2 --node_rank 0 \
@@ -59,6 +67,19 @@ def _parse_args(argv=None):
     p.add_argument("--max_restart", type=int, default=0,
                    help="elastic-lite: restart the local pod up to K "
                         "times on worker failure")
+    p.add_argument("--hang_timeout", type=float, default=0.0,
+                   help="liveness: treat a worker as failed when its "
+                        "heartbeat file is older than this many seconds "
+                        "(0 disables the watchdog)")
+    p.add_argument("--heartbeat_interval", type=float, default=1.0,
+                   help="worker heartbeat period when --hang_timeout is "
+                        "set")
+    p.add_argument("--min_procs", type=int, default=0,
+                   help="scale-down floor: after restarts are exhausted, "
+                        "relaunch with one fewer local worker down to "
+                        "this count (0 disables scale-down)")
+    p.add_argument("--scale_grace", type=float, default=3.0,
+                   help="grace period before a scaled-down relaunch")
     p.add_argument("--log_dir", default=None,
                    help="write per-worker logs under this dir")
     p.add_argument("--run_mode", default="collective",
@@ -95,8 +116,40 @@ def _worker_env(args, local_rank: int) -> dict:
     return env
 
 
-def _spawn(args) -> List[subprocess.Popen]:
-    procs = []
+class _Worker:
+    """One spawned worker + the liveness state the watchdog tracks."""
+
+    def __init__(self, proc: subprocess.Popen, hb_path: Optional[str]):
+        self.proc = proc
+        self.hb_path = hb_path
+        self.started = time.time()
+
+    def stale_for(self) -> float:
+        """Seconds since the last heartbeat (spawn time counts as the
+        first beat, so slow boots are not misread as hangs)."""
+        last = self.started
+        if self.hb_path:
+            try:
+                last = max(last, os.stat(self.hb_path).st_mtime)
+            except OSError:
+                pass
+        return time.time() - last
+
+
+def _hb_dir(args) -> str:
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+        return args.log_dir
+    import tempfile
+    d = getattr(args, "_hb_tmp", None)
+    if d is None:
+        d = tempfile.mkdtemp(prefix="paddle_launch_hb_")
+        args._hb_tmp = d
+    return d
+
+
+def _spawn(args) -> List[_Worker]:
+    workers = []
     for lr in range(args.nproc_per_node):
         out = None
         if args.log_dir:
@@ -105,60 +158,88 @@ def _spawn(args) -> List[subprocess.Popen]:
                 args.log_dir,
                 f"worker.{args.node_rank}.{lr}.log"), "ab")
         try:
-            procs.append(_popen(args, lr, out))
+            workers.append(_popen(args, lr, out))
         finally:
             if out is not None:
                 out.close()          # the child inherited the fd
-    return procs
+    return workers
 
 
-def _popen(args, lr, out):
-    if args.devices == "cpu":
-        # route through the pin-then-run bootstrap: a TPU PJRT plugin
-        # can override JAX_PLATFORMS, so the CPU pin must happen
-        # in-process (see _cpu_boot / device.pin_cpu)
+def _popen(args, lr, out) -> _Worker:
+    env = _worker_env(args, lr)
+    hb_path = None
+    if args.hang_timeout > 0:
+        hb_path = os.path.join(
+            _hb_dir(args), f"hb.{args.node_rank}.{lr}")
+        try:                         # fresh lease per (re)spawn
+            os.remove(hb_path)
+        except OSError:
+            pass
+        env["PADDLE_HEARTBEAT_FILE"] = hb_path
+        env["PADDLE_HEARTBEAT_INTERVAL"] = str(args.heartbeat_interval)
+    if args.devices == "cpu" or hb_path:
+        # route through the bootstrap: the CPU pin must happen in-process
+        # (a TPU PJRT plugin can override JAX_PLATFORMS — see
+        # device.pin_cpu) and the heartbeat thread must start before the
+        # user script (see heartbeat.py)
         cmd = [sys.executable, "-m",
-               "paddle_tpu.distributed.launch._cpu_boot",
+               "paddle_tpu.distributed.launch._boot",
                args.training_script, *args.training_script_args]
     else:
         cmd = [sys.executable, args.training_script,
                *args.training_script_args]
-    return subprocess.Popen(
-        cmd, env=_worker_env(args, lr), stdout=out,
+    proc = subprocess.Popen(
+        cmd, env=env, stdout=out,
         stderr=subprocess.STDOUT if out else None)
+    return _Worker(proc, hb_path)
 
 
-def _terminate(procs: List[subprocess.Popen]):
+def _terminate(workers: List[_Worker]):
     """SIGTERM then escalate to SIGKILL: a worker wedged in backend init
     can mask/ignore SIGTERM and would otherwise orphan, holding the
     coordinator port."""
-    for pr in procs:
-        pr.send_signal(signal.SIGTERM)
-    for pr in procs:
+    for w in workers:
+        w.proc.send_signal(signal.SIGTERM)
+    for w in workers:
         try:
-            pr.wait(timeout=10)
+            w.proc.wait(timeout=10)
         except subprocess.TimeoutExpired:
-            pr.kill()
+            w.proc.kill()
 
 
-def _wait(procs: List[subprocess.Popen]) -> Optional[int]:
+# _wait's sentinel for "a worker stopped heartbeating": distinct from any
+# real exit code so launch() can log the right reason
+HUNG = -257
+
+
+def _wait(workers: List[_Worker], hang_timeout: float = 0.0) \
+        -> Optional[int]:
     """Wait for all workers; on first nonzero exit, kill the rest and
-    return that code (the collective controller's fail-fast). Returns
-    None on KeyboardInterrupt — distinct from any worker exit code."""
+    return that code (the collective controller's fail-fast). With
+    hang_timeout > 0 a worker whose heartbeat goes stale counts as failed
+    (returns HUNG). Returns None on KeyboardInterrupt — distinct from any
+    worker exit code."""
     try:
-        while procs:
-            for pr in list(procs):
-                rc = pr.poll()
+        while workers:
+            for w in list(workers):
+                rc = w.proc.poll()
                 if rc is None:
+                    if hang_timeout > 0 and w.stale_for() > hang_timeout:
+                        print(f"[launch] worker pid={w.proc.pid} hung "
+                              f"(no heartbeat for "
+                              f"{w.stale_for():.1f}s); restarting pod",
+                              file=sys.stderr, flush=True)
+                        _terminate(workers)
+                        return HUNG
                     continue
-                procs.remove(pr)
+                workers.remove(w)
                 if rc != 0:
-                    _terminate(procs)
+                    _terminate(workers)
                     return rc
             time.sleep(0.2)
         return 0
     except KeyboardInterrupt:
-        _terminate(procs)
+        _terminate(workers)
         return None
 
 
@@ -170,7 +251,7 @@ def launch(argv: Optional[List[str]] = None) -> int:
         if attempt:
             print(f"[launch] elastic restart {attempt}/{args.max_restart}",
                   file=sys.stderr, flush=True)
-        rc = _wait(_spawn(args))
+        rc = _wait(_spawn(args), args.hang_timeout)
         if rc == 0:
             return 0
         if rc is None:
@@ -178,9 +259,29 @@ def launch(argv: Optional[List[str]] = None) -> int:
             # restart it (a worker's own exit 130 still restarts)
             return 130
         if attempt >= args.max_restart:
+            if (args.min_procs > 0
+                    and args.nnodes == 1
+                    and args.nproc_per_node - 1 >= args.min_procs):
+                # single-node only: shrinking one host's proc count in a
+                # multi-node job would desync WORLD_SIZE/rank bases across
+                # hosts — true multi-node membership changes belong to the
+                # coordination service (reference: etcd in
+                # fleet/elastic/manager.py)
+                # scale-down continuation (reference elastic manager's
+                # "nnodes-1 proceeds after the grace window"): re-form
+                # the pod one worker smaller; the env contract re-exports
+                # the reduced world size so rendezvous matches
+                args.nproc_per_node -= 1
+                attempt = 0
+                print(f"[launch] restarts exhausted (rc={rc}); scaling "
+                      f"down to {args.nproc_per_node} workers after "
+                      f"{args.scale_grace}s grace",
+                      file=sys.stderr, flush=True)
+                time.sleep(args.scale_grace)
+                continue
             print(f"[launch] workers failed (rc={rc}); restarts exhausted",
                   file=sys.stderr, flush=True)
-            return rc
+            return 1 if rc == HUNG else rc
         attempt += 1
 
 

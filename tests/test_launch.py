@@ -122,3 +122,53 @@ class TestLaunchCLI:
         assert res.returncode == 0
         log = (tmp_path / "logs" / "worker.0.0.log").read_text()
         assert "HELLO_LOG" in log
+
+    def test_hung_worker_detected_and_restarted(self, tmp_path):
+        """Liveness (reference fleet/elastic/manager.py:124): a worker
+        that stops heartbeating — without exiting — is killed and the
+        pod restarts; the second attempt recovers."""
+        marker = tmp_path / "hung_once"
+        res = _run_launch(tmp_path, f"""
+            import os, sys, time
+            marker = {str(marker)!r}
+            if not os.path.exists(marker):
+                open(marker, "w").write("x")
+                from paddle_tpu.distributed.launch import heartbeat
+                heartbeat.stop()       # go silent: simulate a wedge
+                time.sleep(120)        # never exits on its own
+            print("RECOVERED_FROM_HANG")
+        """, ["--devices", "cpu", "--max_restart", "2",
+              "--hang_timeout", "5", "--heartbeat_interval", "0.5"])
+        out = res.stdout.decode()
+        assert res.returncode == 0, out
+        assert "RECOVERED_FROM_HANG" in out
+        assert "hung" in out           # the controller named the cause
+
+    def test_scale_down_continuation(self, tmp_path):
+        """Scale-down (the reference's nnodes-1 continuation): one rank
+        always dies at world size 3; after restarts are exhausted the
+        pod re-forms at 2 workers and the job completes."""
+        res = _run_launch(tmp_path, """
+            import os, sys
+            world = os.environ["PADDLE_TRAINERS_NUM"]
+            rank = os.environ["PADDLE_TRAINER_ID"]
+            if world == "3" and rank == "2":
+                sys.exit(5)
+            if world == "2":
+                print(f"OK_{rank}_OF_{world}")
+        """, ["--nproc_per_node", "3", "--devices", "cpu",
+              "--min_procs", "2", "--scale_grace", "0.5"])
+        out = res.stdout.decode()
+        assert res.returncode == 0, out
+        assert "OK_0_OF_2" in out and "OK_1_OF_2" in out
+        assert "scaling down to 2" in out
+
+    def test_scale_down_respects_floor(self, tmp_path):
+        """Below --min_procs the job fails with the worker's exit code
+        instead of shrinking forever."""
+        res = _run_launch(tmp_path, """
+            import sys
+            sys.exit(9)
+        """, ["--nproc_per_node", "2", "--devices", "cpu",
+              "--min_procs", "2", "--scale_grace", "0.1"])
+        assert res.returncode == 9
