@@ -209,6 +209,14 @@ def main():
         line = next((ln for ln in reversed(out.splitlines())
                      if ln.startswith("{")), None)
         if res.returncode == 0 and line:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                rec = None
+            if rec and rec.get("platform") in ("tpu", "axon"):
+                sys.path.insert(0, here)
+                from bench import record_window
+                record_window(f"ladder_{row}", rec, here)
             print(line, flush=True)
         else:
             print(f"[ladder] {row}: FAILED rc={res.returncode}",

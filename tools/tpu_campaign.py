@@ -1,0 +1,245 @@
+"""Tunnel-burst measurement campaign (round-4 VERDICT item 1).
+
+The axon TPU tunnel flaps in multi-hour windows; live minutes are scarce.
+This orchestrator probes the tunnel cheaply on a loop and, the moment a
+probe succeeds, drains a priority queue of measurement jobs — ablation
+matrix, kernel autotune sweep, step-variant A/B, headline bench, ladder
+rows — each in a subprocess with stdout/stderr captured to files so a
+window that closes mid-job still yields every JSON line emitted before
+the kill (VERDICT round-3 weak #4: hardware evidence must survive a dead
+tunnel).
+
+Artifacts:
+  perf/window_<ts>/<job>.out|.err   raw per-job output (partial on kill)
+  perf/campaign_state.json          job ledger (resume across restarts)
+  BENCH_window_<ts>.json            repo-root aggregate: every JSON line
+                                    measured in that window, timestamped
+  perf/TPU_BUSY                     lockfile while a job is running, so
+                                    local work can avoid contending with
+                                    timing runs (the host has ONE core)
+
+Usage:
+  python tools/tpu_campaign.py                 # default phase-1 queue
+  python tools/tpu_campaign.py --jobs bench,ladder_resnet50
+  python tools/tpu_campaign.py --once          # one probe, no sleep loop
+"""
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PERF = os.path.join(HERE, "perf")
+STATE_PATH = os.path.join(PERF, "campaign_state.json")
+BUSY_PATH = os.path.join(PERF, "TPU_BUSY")
+PROBE_TIMEOUT = 240
+PROBE_SLEEP = 600          # between probes while the tunnel is dead
+MIDQUEUE_PROBE_TIMEOUT = 180
+
+# name -> (argv-tail, timeout_s, env-extra)
+# Priority order follows VERDICT round-3 "next round" item 1:
+# attribution first, then kernel tuning, then A/B, then the headline
+# bench + missing ladder rows.
+JOBS = [
+    ("ablate", [sys.executable, "tools/ablate_step.py"], 4200, {}),
+    ("autotune", [sys.executable, "tools/autotune_kernels.py"], 2700, {}),
+    ("sweep", [sys.executable, "tools/sweep_gpt_step.py"], 4500, {}),
+    ("bench", [sys.executable, "bench.py"], 2700, {}),
+    ("ladder_resnet50",
+     [sys.executable, "tools/bench_ladder.py", "--run", "resnet50"],
+     1500, {}),
+    ("ladder_ernie_vil",
+     [sys.executable, "tools/bench_ladder.py", "--run", "ernie_vil"],
+     1500, {}),
+]
+
+
+def log(msg: str) -> None:
+    ts = datetime.datetime.now(datetime.timezone.utc).strftime("%H:%M:%S")
+    print(f"[campaign {ts}] {msg}", file=sys.stderr, flush=True)
+
+
+def load_state() -> dict:
+    try:
+        with open(STATE_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def save_state(state: dict) -> None:
+    os.makedirs(PERF, exist_ok=True)
+    tmp = f"{STATE_PATH}.tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(state, f, indent=1)
+    os.replace(tmp, STATE_PATH)
+
+
+def probe(timeout_s: int = PROBE_TIMEOUT) -> bool:
+    """One bounded live-tunnel check in a fresh subprocess (jax caches a
+    failed backend in-process, so probing must fork)."""
+    code = "import jax; print('PROBE', jax.devices()[0].platform)"
+    try:
+        res = subprocess.run([sys.executable, "-c", code], cwd=HERE,
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.DEVNULL, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return False
+    out = res.stdout.decode()
+    return (res.returncode == 0 and "PROBE" in out
+            and out.split("PROBE", 1)[1].strip().split()[0]
+            in ("tpu", "axon"))
+
+
+def run_job(name, argv, timeout_s, env_extra, window_dir) -> dict:
+    """Run one job with stdout/stderr captured to files; kill the whole
+    process group on timeout (bench.py forks its own children)."""
+    os.makedirs(window_dir, exist_ok=True)
+    out_path = os.path.join(window_dir, f"{name}.out")
+    err_path = os.path.join(window_dir, f"{name}.err")
+    env = dict(os.environ)
+    env.update(env_extra)
+    t0 = time.time()
+    with open(out_path, "wb") as fo, open(err_path, "wb") as fe, \
+            open(BUSY_PATH, "w") as fb:
+        fb.write(f"{name} since {datetime.datetime.now()}\n")
+        proc = subprocess.Popen(argv, cwd=HERE, env=env, stdout=fo,
+                                stderr=fe, start_new_session=True)
+        try:
+            rc = proc.wait(timeout=timeout_s)
+            timed_out = False
+        except subprocess.TimeoutExpired:
+            os.killpg(proc.pid, signal.SIGKILL)
+            proc.wait()
+            rc, timed_out = -9, True
+    try:
+        os.remove(BUSY_PATH)
+    except OSError:
+        pass
+    dur = round(time.time() - t0, 1)
+    with open(out_path, "rb") as f:
+        lines = [ln for ln in f.read().decode(errors="replace").splitlines()
+                 if ln.startswith("{")]
+    recs = []
+    for ln in lines:
+        try:
+            recs.append(json.loads(ln))
+        except ValueError:
+            pass
+    return {"rc": rc, "timed_out": timed_out, "seconds": dur,
+            "json_lines": recs, "out": out_path}
+
+
+def append_window_artifact(window_ts: str, job: str, recs: list) -> None:
+    """Repo-root machine-readable record of everything measured in this
+    window — bench/judge artifacts must not depend on the tunnel staying
+    alive (VERDICT weak #4)."""
+    path = os.path.join(HERE, f"BENCH_window_{window_ts}.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        doc = {"window_utc": window_ts, "results": []}
+    doc["results"].extend(
+        {"job": job, "measured_utc":
+         datetime.datetime.now(datetime.timezone.utc).isoformat(
+             timespec="seconds"), **r} for r in recs)
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+    os.replace(tmp, path)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--jobs", default=None,
+                    help="comma-separated subset/order override")
+    ap.add_argument("--once", action="store_true",
+                    help="single probe; exit 3 if tunnel dead")
+    ap.add_argument("--force-rerun", action="store_true",
+                    help="ignore done-markers in campaign_state.json")
+    args = ap.parse_args()
+
+    queue = JOBS
+    if args.jobs:
+        want = args.jobs.split(",")
+        by_name = {j[0]: j for j in JOBS}
+        queue = [by_name[w] for w in want]
+
+    state = load_state()
+    pending = [j for j in queue
+               if args.force_rerun or state.get(j[0], {}).get("status")
+               != "done"]
+    if not pending:
+        log("queue already drained; nothing to do")
+        return
+    log(f"queue: {[j[0] for j in pending]}")
+
+    while pending:
+        if not probe():
+            if args.once:
+                log("tunnel dead (--once); exiting 3")
+                sys.exit(3)
+            log(f"tunnel dead; sleeping {PROBE_SLEEP}s "
+                f"({len(pending)} jobs pending)")
+            time.sleep(PROBE_SLEEP)
+            continue
+        window_ts = datetime.datetime.now(
+            datetime.timezone.utc).strftime("%Y%m%dT%H%M%SZ")
+        window_dir = os.path.join(PERF, f"window_{window_ts}")
+        log(f"TUNNEL ALIVE — window {window_ts}, draining queue")
+        dead_probes = 0
+        while pending and dead_probes < 2:
+            name, argv, timeout_s, env_extra = pending[0]
+            log(f"job {name} (timeout {timeout_s}s)")
+            res = run_job(name, argv, timeout_s, env_extra, window_dir)
+            n = len(res["json_lines"])
+            log(f"job {name}: rc={res['rc']} {res['seconds']}s, "
+                f"{n} JSON records"
+                + (" [TIMEOUT, salvaged partial]" if res["timed_out"]
+                   else ""))
+            if res["json_lines"]:
+                append_window_artifact(window_ts, name, res["json_lines"])
+            state[name] = {
+                "status": ("done" if res["rc"] == 0 and n else
+                           "partial" if n else "failed"),
+                "window": window_ts, "rc": res["rc"],
+                "seconds": res["seconds"], "records": n,
+            }
+            save_state(state)
+            if res["rc"] == 0 and n:
+                pending.pop(0)
+                dead_probes = 0
+                continue
+            # job died: distinguish "tunnel dropped" from "job broken"
+            if probe(MIDQUEUE_PROBE_TIMEOUT):
+                log(f"tunnel still alive; {name} itself failed — "
+                    f"moving it to the back of the queue")
+                pending.append(pending.pop(0))
+                dead_probes = 0
+                # a job that failed twice in live windows is broken, not
+                # unlucky: drop it so it can't starve the queue
+                fails = state[name].get("fails", 0) + 1
+                state[name]["fails"] = fails
+                if fails >= 2:
+                    log(f"job {name} failed {fails}x live; dropping")
+                    pending = [j for j in pending if j[0] != name]
+                save_state(state)
+            else:
+                dead_probes += 1
+                log(f"tunnel no longer answers (strike {dead_probes}/2)")
+        log(f"window {window_ts} closed; "
+            f"{len(pending)} jobs still pending")
+        if args.once:
+            break
+    log("campaign complete" if not pending else "campaign exiting")
+
+
+if __name__ == "__main__":
+    main()
