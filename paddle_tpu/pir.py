@@ -67,3 +67,181 @@ def core_uses_pir() -> bool:
     """Reference paddle.base.framework.in_pir_mode analog: the jaxpr/
     StableHLO pipeline is always on."""
     return True
+
+
+# --------------------------------------------------------------------------
+# Pass surface (reference paddle/ir/pass/pass_manager.h + pass.h): a
+# user-visible transform seam over the recorded static Program. XLA owns
+# the heavy optimization of the lowered graph; these passes act one level
+# up, on the Program's op list — the tier the reference's pir passes
+# (dead-code elimination, constant folding) operate on.
+# --------------------------------------------------------------------------
+
+class Pass:
+    """Base pass (reference pir::Pass): subclass and implement
+    apply(program) -> stats dict."""
+
+    name = "pass"
+
+    def apply(self, program) -> dict:                # pragma: no cover
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"<pir.Pass {self.name}>"
+
+
+def _live_set(block, outputs):
+    """Transitive closure of ops needed for `outputs` (names)."""
+    live = set(outputs)
+    needed = []
+    for node in reversed(block.ops):
+        if any(nm in live for nm in node.out_names):
+            needed.append(node)
+            live.update(node.input_names())
+    needed.reverse()
+    return needed
+
+
+class DeadCodeEliminationPass(Pass):
+    """Drop ops not needed for the graph outputs (reference
+    dead_code_elimination_pass.cc). `outputs` names the fetch set; when
+    omitted, the last op's outputs are taken as the graph result (the
+    same convention translate_to_pir uses)."""
+
+    name = "dead_code_elimination"
+
+    def __init__(self, outputs=None):
+        self.outputs = list(outputs) if outputs else None
+
+    def apply(self, program) -> dict:
+        block = program.global_block()
+        if not block.ops:
+            return {"removed": 0}
+        outs = self.outputs or list(block.ops[-1].out_names)
+        before = len(block.ops)
+        block.ops[:] = _live_set(block, outs)
+        removed = before - len(block.ops)
+        if removed:
+            # only a real change invalidates the Executor's compiled cache
+            program._version += 1
+        return {"removed": removed}
+
+
+class ConstantFoldingPass(Pass):
+    """Precompute ops whose inputs are all baked literals (reference
+    constant_folding_pass.cc). The node is replaced by a zero-input node
+    returning the folded arrays — downstream refs are untouched, and
+    under the Executor's jit composition the values become XLA
+    constants."""
+
+    name = "constant_folding"
+
+    # never folded: nondeterministic or stateful op families
+    _SKIP = ("dropout", "random", "gaussian", "uniform", "bernoulli",
+             "randint", "poisson", "multinomial", "exponential",
+             "dirichlet", "shuffle", "while_loop", "all_reduce",
+             "all_gather", "broadcast", "reduce_scatter", "send", "recv")
+
+    def apply(self, program) -> dict:
+        from .static.program import OpNode
+        block = program.global_block()
+        folded = 0
+        for i, node in enumerate(list(block.ops)):
+            if node.input_names():
+                continue
+            if any(s in node.type for s in self._SKIP):
+                continue
+            try:
+                args = [a.v for a in node.arg_plan]
+                out = node.fn(*args, **node.attrs)
+            except Exception:
+                continue                      # leave unfoldable ops alone
+            outs = tuple(out) if isinstance(out, (tuple, list)) else (out,)
+
+            def const_fn(*_a, _outs=outs):
+                return _outs if len(_outs) > 1 else _outs[0]
+
+            block.ops[i] = OpNode(f"pir.folded::{node.type}", const_fn,
+                                  [], {}, node.out_names)
+            folded += 1
+        if folded:
+            program._version += 1
+        return {"folded": folded}
+
+
+_PASS_REGISTRY = {
+    DeadCodeEliminationPass.name: DeadCodeEliminationPass,
+    ConstantFoldingPass.name: ConstantFoldingPass,
+}
+
+
+def register_pass(name: str, cls=None):
+    """Register a custom pass class (reference REGISTER_IR_PASS)."""
+    if cls is None:
+        def deco(c):
+            _PASS_REGISTRY[name] = c
+            return c
+        return deco
+    _PASS_REGISTRY[name] = cls
+    return cls
+
+
+class PassManager:
+    """Ordered pass pipeline (reference pir::PassManager). add_pass by
+    registered name (kwargs forwarded) or instance; run(program) applies
+    in order and records per-pass statistics."""
+
+    def __init__(self, passes=None):
+        self._passes = []
+        self.stats = []
+        self._print_ir = False
+        for p in passes or []:
+            self.add_pass(p)
+
+    def add_pass(self, p, **kwargs) -> "PassManager":
+        if isinstance(p, str):
+            if p not in _PASS_REGISTRY:
+                raise ValueError(
+                    f"unknown pass {p!r}; registered: "
+                    f"{sorted(_PASS_REGISTRY)}")
+            p = _PASS_REGISTRY[p](**kwargs)
+        self._passes.append(p)
+        return self
+
+    @property
+    def passes(self):
+        return [p.name for p in self._passes]
+
+    def enable_ir_printing(self):
+        self._print_ir = True
+        return self
+
+    def run(self, program=None) -> list:
+        from .static.program import default_main_program
+        program = program or default_main_program()
+        self.stats = []
+        for p in self._passes:
+            if self._print_ir:
+                print(f"// ===== before {p.name} =====\n"
+                      f"{program_to_string(program)}")
+            st = p.apply(program)
+            self.stats.append({"pass": p.name, **(st or {})})
+            if self._print_ir:
+                print(f"// ===== after {p.name} =====\n"
+                      f"{program_to_string(program)}")
+        return self.stats
+
+    def __len__(self):
+        return len(self._passes)
+
+
+def program_to_string(program) -> str:
+    """Textual form of a Program's op list (reference Program::Print)."""
+    block = program.global_block()
+    lines = []
+    for node in block.ops:
+        ins = ", ".join(node.input_names())
+        outs = ", ".join(node.out_names)
+        attrs = f" {{{node.attrs}}}" if node.attrs else ""
+        lines.append(f"  ({outs}) = \"{node.type}\"({ins}){attrs}")
+    return "{\n" + "\n".join(lines) + "\n}"

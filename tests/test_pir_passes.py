@@ -1,0 +1,104 @@
+"""PIR pass-manager tests (round-3 verdict missing #5; reference
+paddle/ir/pass/pass_manager.h — a user-visible transform seam over the
+IR, here the recorded static Program)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+from paddle_tpu import pir
+
+
+@pytest.fixture
+def static_mode():
+    paddle.enable_static()
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        yield main, startup
+    paddle.disable_static()
+
+
+def test_dce_prunes_unused_ops(static_mode):
+    main, startup = static_mode
+    x = static.data("x", [2, 2], "float32")
+    y = x * 2.0
+    _dead = paddle.exp(x) + 1.0          # never feeds the result
+    out = y + 1.0
+    n_before = len(main.global_block().ops)
+    pm = pir.PassManager().add_pass("dead_code_elimination",
+                                    outputs=[out.name])
+    stats = pm.run(main)
+    assert stats[0]["removed"] == 2      # exp + its add
+    assert len(main.global_block().ops) == n_before - 2
+    # the pruned program still computes the right value
+    exe = static.Executor()
+    with static.program_guard(main, startup):
+        res = exe.run(feed={"x": np.ones((2, 2), np.float32)},
+                      fetch_list=[out])
+    np.testing.assert_allclose(res[0], np.full((2, 2), 3.0))
+
+
+def test_dce_defaults_to_last_op_outputs(static_mode):
+    main, _ = static_mode
+    x = static.data("x", [2], "float32")
+    _dead = paddle.exp(x)
+    keep = x + 1.0
+    stats = pir.PassManager().add_pass("dead_code_elimination").run(main)
+    assert stats[0]["removed"] == 1
+    assert [op.type for op in main.global_block().ops] != []
+
+
+def test_constant_folding_precomputes_literal_ops(static_mode):
+    main, startup = static_mode
+    x = static.data("x", [2], "float32")
+    c = paddle.ones([2], "float32") * 3.0     # literal subgraph
+    out = x + c
+    pm = pir.PassManager(["constant_folding"])
+    stats = pm.run(main)
+    assert stats[0]["folded"] >= 1
+    assert any(op.type.startswith("pir.folded::")
+               for op in main.global_block().ops)
+    exe = static.Executor()
+    with static.program_guard(main, startup):
+        res = exe.run(feed={"x": np.ones(2, np.float32)},
+                      fetch_list=[out])
+    np.testing.assert_allclose(res[0], [4.0, 4.0])
+
+
+def test_constant_folding_skips_random(static_mode):
+    main, _ = static_mode
+    r = paddle.rand([2])
+    stats = pir.PassManager(["constant_folding"]).run(main)
+    folded_types = [op.type for op in main.global_block().ops
+                    if op.type.startswith("pir.folded::")]
+    assert not any("rand" in t or "uniform" in t or "gaussian" in t
+                   for t in folded_types)
+
+
+def test_custom_pass_registration(static_mode):
+    main, _ = static_mode
+    x = static.data("x", [2], "float32")
+    x + 1.0
+
+    @pir.register_pass("count_ops")
+    class CountOps(pir.Pass):
+        name = "count_ops"
+
+        def apply(self, program):
+            return {"n": len(program.global_block().ops)}
+
+    stats = pir.PassManager(["count_ops"]).run(main)
+    assert stats == [{"pass": "count_ops", "n": 1}]
+
+
+def test_unknown_pass_raises():
+    with pytest.raises(ValueError, match="unknown pass"):
+        pir.PassManager().add_pass("nope")
+
+
+def test_program_to_string(static_mode):
+    main, _ = static_mode
+    x = static.data("x", [2], "float32")
+    paddle.exp(x)
+    s = pir.program_to_string(main)
+    assert "exp" in s and s.startswith("{")
