@@ -149,22 +149,36 @@ def _ce_bwd(logits2d, targets, lse, g, block_t=128, block_v=512,
 
 
 # ------------------------------------------------------------- public entry
+def _tuned_ce_blocks(logits2d):
+    """(block_t, block_v) from the persistent autotune cache (populated by
+    tools/autotune_kernels.py; key matches its `ce::T{T}_V{V}_{dtype}`),
+    else the shipped 128/512 defaults."""
+    from .flash_attention import _cached_blocks
+    sig = f"T{logits2d.shape[0]}_V{logits2d.shape[1]}_{logits2d.dtype}"
+    return _cached_blocks("ce", sig) or (128, 512)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
 def ce_with_logits(logits2d, targets, interpret=False):
     """Per-row cross entropy: [T, V] float, [T] int → [T] f32 loss."""
-    loss, _ = _ce_fwd(logits2d, targets, interpret=interpret)
+    bt, bv = _tuned_ce_blocks(logits2d)
+    loss, _ = _ce_fwd(logits2d, targets, block_t=bt, block_v=bv,
+                      interpret=interpret)
     return loss
 
 
 def _ce_vjp_fwd(logits2d, targets, interpret=False):
-    loss, lse = _ce_fwd(logits2d, targets, interpret=interpret)
+    bt, bv = _tuned_ce_blocks(logits2d)
+    loss, lse = _ce_fwd(logits2d, targets, block_t=bt, block_v=bv,
+                        interpret=interpret)
     return loss, (logits2d, targets, lse)
 
 
 def _ce_vjp_bwd(interpret, res, g):
     logits2d, targets, lse = res
+    bt, bv = _tuned_ce_blocks(logits2d)
     dx = _ce_bwd(logits2d, targets, lse, g.astype(jnp.float32),
-                 interpret=interpret)
+                 block_t=bt, block_v=bv, interpret=interpret)
     return dx, None
 
 

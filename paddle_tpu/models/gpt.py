@@ -56,7 +56,14 @@ class GPTConfig:
     # "dots" saves matmul/einsum outputs across the backward (XLA then only
     # recomputes cheap elementwise/norm work — the flash-attention kernel
     # keeps its own O(S·D) residuals via custom_vjp either way)
-    remat_policy: str = "full"                # "full" | "dots"
+    # "full" | "dots" | "offload_dots" ("dots" saved to pinned host memory
+    # instead of HBM — trades ICI/PCIe traffic for HBM headroom, raced in
+    # tools/sweep_gpt_step.py like every remat choice)
+    remat_policy: str = "full"
+    # lax.scan unroll factor over the layer axis: >1 lets XLA fuse across
+    # adjacent blocks at the cost of compile time; raced on hardware, the
+    # default stays 1 (numerics identical either way)
+    scan_unroll: int = 1
     sequence_parallel: bool = True            # SP on the 'mp' axis
     # context parallelism for long sequences: "none" | "ring" | "ulysses";
     # shards the sequence axis over the mesh's 'sp' axis ('mp' if absent)
@@ -363,6 +370,11 @@ def _apply_stack(stacked, x, cfg: GPTConfig):
             body = jax.checkpoint(
                 body,
                 policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+        elif cfg.remat_policy == "offload_dots":
+            body = jax.checkpoint(
+                body,
+                policy=jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+                    "device", "pinned_host"))
         else:
             body = jax.checkpoint(body)
 
@@ -372,7 +384,8 @@ def _apply_stack(stacked, x, cfg: GPTConfig):
         return (h2, aux + aux_l), None
 
     (x, aux), _ = jax.lax.scan(
-        scan_fn, (x, jnp.zeros((), jnp.float32)), stacked)
+        scan_fn, (x, jnp.zeros((), jnp.float32)), stacked,
+        unroll=cfg.scan_unroll)
     return x, aux
 
 

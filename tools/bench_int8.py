@@ -1,0 +1,112 @@
+"""int8 vs bf16 matmul microbench row (round-3 verdict item 3: show the
+real-int8 path's on-chip rate next to the bf16 MXU rate).
+
+Times three variants of the serving matmul shape [B*S, D] @ [D, 4D]
+chained through a lax.scan (one dispatch, the tunnel-latency rule from
+CLAUDE.md):
+  - bf16 @ bf16 -> f32 accumulate (the fp serving path)
+  - int8 @ int8 -> i32 accumulate (raw MXU int8 rate)
+  - the full Int8Linear op (quantize epilogue + int8 dot + dequant)
+Emits one JSON line per variant; campaign persists them per-window.
+"""
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+M, K, N = 8192, 1024, 4096
+REPS = 8
+
+
+def log(m):
+    print(f"[int8bench] {m}", file=sys.stderr, flush=True)
+
+
+def emit(rec):
+    print(json.dumps(rec), flush=True)
+
+
+def _force(out):
+    np.asarray(jax.device_get(jax.tree_util.tree_leaves(out)[0])).ravel()[:1]
+
+
+def timeit(fn, *args, iters=10):
+    out = fn(*args)
+    _force(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    _force(out)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def main():
+    devs = jax.devices()
+    log(f"backend {devs[0].platform} ({devs[0].device_kind})")
+    fl = 2.0 * M * K * N * REPS
+
+    # bf16 path
+    a16 = jnp.full((M, K), 0.01, jnp.bfloat16)
+    b16 = jnp.full((K, N), 0.01, jnp.bfloat16)
+
+    @jax.jit
+    def mm_bf16(a, b):
+        def body(h, _):
+            out = jax.lax.dot_general(h, b, (((1,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+            return out[:, :K].astype(jnp.bfloat16), None
+        h, _ = jax.lax.scan(body, a, None, length=REPS)
+        return h
+
+    ms = timeit(mm_bf16, a16, b16)
+    emit({"metric": "matmul_bf16", "ms": round(ms, 3),
+          "tflops": round(fl / (ms * 1e-3) / 1e12, 1),
+          "backend": devs[0].platform})
+
+    # raw int8 path
+    a8 = jnp.ones((M, K), jnp.int8)
+    b8 = jnp.ones((K, N), jnp.int8)
+
+    @jax.jit
+    def mm_int8(a, b):
+        def body(h, _):
+            out = jax.lax.dot_general(h, b, (((1,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.int32)
+            return jnp.clip(out[:, :K], -127, 127).astype(jnp.int8), None
+        h, _ = jax.lax.scan(body, a, None, length=REPS)
+        return h
+
+    ms = timeit(mm_int8, a8, b8)
+    emit({"metric": "matmul_int8", "ms": round(ms, 3),
+          "tops": round(fl / (ms * 1e-3) / 1e12, 1),
+          "backend": devs[0].platform})
+
+    # full Int8Linear op (quant + int8 dot + dequant epilogue)
+    from paddle_tpu.quantization.int8 import _int8_linear
+    x = jnp.full((M, K), 0.5, jnp.float32)
+    w_q = jnp.ones((K, N), jnp.int8)
+    w_scale = jnp.ones((N,), jnp.float32)
+    bias = jnp.zeros((N,), jnp.float32)
+
+    raw = _int8_linear._raw_fn
+    fn = jax.jit(lambda xx: raw(xx, w_q, bias, jnp.float32(1.0), w_scale))
+    try:
+        ms = timeit(fn, x)
+        emit({"metric": "int8_linear_op", "ms": round(ms, 3),
+              "tops": round(2.0 * M * K * N / (ms * 1e-3) / 1e12, 1),
+              "backend": devs[0].platform})
+    except Exception as e:
+        emit({"metric": "int8_linear_op", "error": repr(e)[:160]})
+
+
+if __name__ == "__main__":
+    main()

@@ -34,6 +34,15 @@ VARIANTS = [
     ("dots-jaxbwd-q256k512", True, "dots", (256, 512, 128, 128),
      {"PADDLE_TPU_DISABLE_PALLAS_BWD": "1"}),
     ("dots-512", True, "dots", (512, 512, 512, 512), {}),
+    # round-4 additions: scan unrolling (cross-block fusion), host-offloaded
+    # dot saves (HBM headroom — the no-remat config OOMed at B=8), and the
+    # unroll x jax-bwd combination
+    ("dots-jaxbwd-unroll4", True, "dots", (128, 128, 128, 128),
+     {"PADDLE_TPU_DISABLE_PALLAS_BWD": "1", "SWEEP_SCAN_UNROLL": "4"}),
+    ("dots-jaxbwd-unroll2", True, "dots", (128, 128, 128, 128),
+     {"PADDLE_TPU_DISABLE_PALLAS_BWD": "1", "SWEEP_SCAN_UNROLL": "2"}),
+    ("offload-jaxbwd", True, "offload_dots", (128, 128, 128, 128),
+     {"PADDLE_TPU_DISABLE_PALLAS_BWD": "1"}),
 ]
 
 MODEL = dict(vocab_size=32768, hidden_size=1024, num_layers=24,
@@ -52,6 +61,8 @@ def run_one(spec: dict) -> None:
     devs = jax.devices()
     cfg = GPTConfig(sequence_parallel=False, remat=spec["remat"],
                     remat_policy=spec["policy"], dtype=jnp.bfloat16,
+                    scan_unroll=int(os.environ.get("SWEEP_SCAN_UNROLL",
+                                                   "1")),
                     **MODEL)
     params = init_gpt_params(cfg, jax.random.PRNGKey(0))
     opt_state = init_opt_state(params)

@@ -57,6 +57,7 @@ JOBS = [
     ("ladder_ernie_vil",
      [sys.executable, "tools/bench_ladder.py", "--run", "ernie_vil"],
      1500, {}),
+    ("int8_micro", [sys.executable, "tools/bench_int8.py"], 1200, {}),
 ]
 
 
@@ -170,6 +171,10 @@ def main() -> None:
     if args.jobs:
         want = args.jobs.split(",")
         by_name = {j[0]: j for j in JOBS}
+        unknown = [w for w in want if w not in by_name]
+        if unknown:
+            ap.error(f"unknown job(s) {unknown}; known: "
+                     f"{sorted(by_name)}")
         queue = [by_name[w] for w in want]
 
     state = load_state()
@@ -206,11 +211,13 @@ def main() -> None:
                    else ""))
             if res["json_lines"]:
                 append_window_artifact(window_ts, name, res["json_lines"])
+            prev_fails = state.get(name, {}).get("fails", 0)
             state[name] = {
                 "status": ("done" if res["rc"] == 0 and n else
                            "partial" if n else "failed"),
                 "window": window_ts, "rc": res["rc"],
                 "seconds": res["seconds"], "records": n,
+                "fails": prev_fails,      # carried; bumped on live failure
             }
             save_state(state)
             if res["rc"] == 0 and n:
