@@ -1,0 +1,85 @@
+"""Post-training quantization to REAL int8 serving, end to end
+(reference workflow:
+python/paddle/static/quantization/post_training_quantization.py — here
+the int8 kernels are XLA int8 dot_general/conv on the MXU):
+
+train fp32 -> PTQ calibrate -> convert_to_int8 -> serve via to_static.
+
+    python examples/ptq_int8_serving.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+if os.environ.get("PADDLE_TPU_EXAMPLE_BACKEND", "cpu") == "cpu":
+    from paddle_tpu.device import pin_cpu
+    assert pin_cpu(1), "could not pin the CPU backend"
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.quantization import PTQ, QuantConfig
+
+
+class ConvNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.conv = nn.Conv2D(1, 8, 3, padding=1)
+        self.relu = nn.ReLU()
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.head = nn.Linear(8, 10)
+
+    def forward(self, x):
+        h = self.pool(self.relu(self.conv(x)))
+        return self.head(h.reshape([h.shape[0], 8]))
+
+
+def main():
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    xs = rng.randn(256, 1, 8, 8).astype(np.float32)
+    w_true = rng.randn(8 * 8, 10).astype(np.float32)
+    ys = np.argmax(xs.reshape(256, -1)[:, :64] @ w_true, -1).astype(
+        np.int64)
+
+    # 1. a briefly trained fp model
+    net = ConvNet()
+    opt = paddle.optimizer.Adam(learning_rate=3e-3,
+                                parameters=net.parameters())
+    loss_fn = nn.CrossEntropyLoss()
+    for i in range(0, 256, 64):
+        loss = loss_fn(net(paddle.to_tensor(xs[i:i + 64])),
+                       paddle.to_tensor(ys[i:i + 64]))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    net.eval()
+    fp_pred = np.argmax(net(paddle.to_tensor(xs)).numpy(), -1)
+
+    # 2. PTQ: wrap + calibrate on representative batches
+    ptq = PTQ(QuantConfig())
+    ptq.quantize(net)
+    for i in range(0, 256, 64):
+        net(paddle.to_tensor(xs[i:i + 64]))
+
+    # 3. freeze into REAL int8 layers (int8 weights, int8 matmul/conv)
+    int8_net = ptq.convert(net, to_int8=True)
+    q_pred = np.argmax(int8_net(paddle.to_tensor(xs)).numpy(), -1)
+    agree = float((q_pred == fp_pred).mean())
+    print(f"int8 vs fp top-1 agreement: {agree:.3f}")
+    assert agree >= 0.98, agree
+
+    # 4. serve the int8 model as ONE compiled graph
+    served = paddle.jit.to_static(lambda t: int8_net(t))
+    out = served(paddle.to_tensor(xs[:16]))
+    np.testing.assert_allclose(
+        out.numpy(),
+        int8_net(paddle.to_tensor(xs[:16])).numpy(), rtol=1e-5, atol=1e-5)
+    print("int8 serving graph OK:", out.shape)
+
+
+if __name__ == "__main__":
+    main()
