@@ -56,9 +56,14 @@ class GPTConfig:
     # "dots" saves matmul/einsum outputs across the backward (XLA then only
     # recomputes cheap elementwise/norm work — the flash-attention kernel
     # keeps its own O(S·D) residuals via custom_vjp either way)
-    # "full" | "dots" | "offload_dots" ("dots" saved to pinned host memory
-    # instead of HBM — trades ICI/PCIe traffic for HBM headroom, raced in
-    # tools/sweep_gpt_step.py like every remat choice)
+    # "full" | "dots" | "dots_flash" | "offload_dots":
+    # - "dots" saves dot_general outputs (XLA recomputes elementwise only,
+    #   but the Pallas attention — a pallas_call, not a dot — still reruns
+    #   in the backward);
+    # - "dots_flash" additionally saves the named flash-attention outputs
+    #   (~B*S*D bf16 per layer) so no attention forward is recomputed;
+    # - "offload_dots" saves dots to pinned host memory (HBM headroom).
+    # All raced on hardware in tools/sweep_gpt_step.py.
     remat_policy: str = "full"
     # lax.scan unroll factor over the layer axis: >1 lets XLA fuse across
     # adjacent blocks at the cost of compile time; raced on hardware, the
@@ -234,6 +239,11 @@ def _attention(x, w_qkv, b_qkv, w_out, b_out, cfg, mask_causal=True):
     else:
         from ..kernels.flash_attention import flash_attention_fn
         ctx = flash_attention_fn(q, k_, v, causal=mask_causal)
+    # named so remat_policy="dots_flash" can SAVE the attention output:
+    # the flash kernel is a pallas_call, not a dot_general, so the "dots"
+    # policy alone recomputes all attention forwards in the backward
+    from jax.ad_checkpoint import checkpoint_name
+    ctx = checkpoint_name(ctx, "flash_out")
     ctx = ctx.reshape(B, S, D)
     out = jnp.einsum("bsd,df->bsf", ctx, w_out.astype(x.dtype))
     if b_out is not None:
@@ -370,6 +380,13 @@ def _apply_stack(stacked, x, cfg: GPTConfig):
             body = jax.checkpoint(
                 body,
                 policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+        elif cfg.remat_policy == "dots_flash":
+            body = jax.checkpoint(
+                body,
+                policy=jax.checkpoint_policies.save_from_both_policies(
+                    jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                    jax.checkpoint_policies.save_only_these_names(
+                        "flash_out")))
         elif cfg.remat_policy == "offload_dots":
             body = jax.checkpoint(
                 body,

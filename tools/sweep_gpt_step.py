@@ -43,6 +43,11 @@ VARIANTS = [
      {"PADDLE_TPU_DISABLE_PALLAS_BWD": "1", "SWEEP_SCAN_UNROLL": "2"}),
     ("offload-jaxbwd", True, "offload_dots", (128, 128, 128, 128),
      {"PADDLE_TPU_DISABLE_PALLAS_BWD": "1"}),
+    # save the named flash outputs too: no attention fwd recompute in bwd
+    ("dotsflash-jaxbwd", True, "dots_flash", (128, 128, 128, 128),
+     {"PADDLE_TPU_DISABLE_PALLAS_BWD": "1"}),
+    ("dotsflash-jaxbwd-unroll2", True, "dots_flash", (128, 128, 128, 128),
+     {"PADDLE_TPU_DISABLE_PALLAS_BWD": "1", "SWEEP_SCAN_UNROLL": "2"}),
 ]
 
 MODEL = dict(vocab_size=32768, hidden_size=1024, num_layers=24,
