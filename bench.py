@@ -157,6 +157,9 @@ def run_measurement(rung: str) -> None:
     if (want_tpu and kw.get("remat")
             and kw.get("remat_policy") == "dots"
             and os.environ.get("PADDLE_TPU_BENCH_NO_RACE") != "1"):
+        # dots_flash first (saves the named attention outputs — the only
+        # policy that skips the flash recompute in backward), then full
+        variants.append(dict(remat_policy="dots_flash"))
         variants.append(dict(remat_policy="full"))
 
     def emit(dt, cfg, n_params, vkw):
