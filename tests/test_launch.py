@@ -138,7 +138,10 @@ class TestLaunchCLI:
                 time.sleep(120)        # never exits on its own
             print("RECOVERED_FROM_HANG")
         """, ["--devices", "cpu", "--max_restart", "2",
-              "--hang_timeout", "5", "--heartbeat_interval", "0.5"])
+              # generous timeout: the worker's paddle_tpu import can take
+              # >5s on this 1-core host under load, and a false hang
+              # during boot would burn the restart budget
+              "--hang_timeout", "12", "--heartbeat_interval", "0.5"])
         out = res.stdout.decode()
         assert res.returncode == 0, out
         assert "RECOVERED_FROM_HANG" in out
