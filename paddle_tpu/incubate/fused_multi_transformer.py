@@ -3,7 +3,8 @@
 Reference analog: python/paddle/incubate/nn/layer/fused_transformer.py:1022
 (FusedMultiTransformer: N pre-LN transformer layers with fused QKV and a
 [2, B, H, max_len, hd]-per-layer KV cache, driven by the inference
-predictor's generation loop).
+predictor's generation loop); the int8 serving variant is
+paddle/fluid/operators/fused/fused_multi_transformer_int8_op.cu:1.
 
 TPU-native: per-layer weights live STACKED on a leading axis and the
 whole stack applies with lax.scan (O(1) compile depth — the "fused"
@@ -12,6 +13,11 @@ reference's hand-fused CUDA kernels bought); the KV cache is one stacked
 [L, B, max_len, H, hd] buffer per k/v updated via dynamic_update_slice,
 exactly the models/gpt.py decode design, exposed at the reference's
 class surface (Parameters, cache_kvs list, time_step).
+
+weight_only_quant() converts the four weight families to int8 with
+per-(layer, out-channel) scales: single-token decode is weight-HBM-bound,
+so halving the weight bytes is the int8 win on TPU — the convert feeding
+the dot fuses into the operand load, and XLA reads int8 from HBM.
 """
 from __future__ import annotations
 
@@ -74,6 +80,58 @@ class FusedMultiTransformer(Layer):
             norm((L, F, D), std / math.sqrt(2 * L)))
         self.ffn2_biases = Parameter(jnp.zeros((L, D), jnp.float32))
 
+    # -- weight-only int8 ---------------------------------------------------
+    _W_NAMES = ("qkv_weights", "linear_weights", "ffn1_weights",
+                "ffn2_weights")
+
+    def weight_only_quant(self):
+        """Convert the four stacked weight families to int8 with
+        per-(layer, out-channel) scales (reference
+        fused_multi_transformer_int8_op.cu's weight path). Serving-only:
+        the fp Parameters are replaced by int8 + scale buffers, so the
+        layer no longer trains. Idempotent."""
+        if getattr(self, "_weight_only", False):
+            return self
+        from ..quantization.int8 import quantize_weight
+        for name in self._W_NAMES:
+            w = np.asarray(getattr(self, name).numpy(), np.float32)
+            # [L, in, out]: per-layer channel-wise abs-max over the
+            # contraction axis (the shared quantize_weight recipe)
+            per_layer = [quantize_weight(w[l], channel_axis=1)
+                         for l in range(w.shape[0])]
+            w_q = np.stack([q for q, _ in per_layer])
+            # stored pre-divided so the dequant epilogue is one multiply
+            scale = np.stack([s for _, s in per_layer]) / 127.0
+            delattr(self, name)
+            self.register_buffer(name, Tensor(jnp.asarray(w_q)))
+            self.register_buffer(f"{name[:-1]}_scales",
+                                 Tensor(jnp.asarray(scale)))
+        self._weight_only = True
+        return self
+
+    def _adopt_weight_only_structure(self):
+        """Reshape params into the int8-buffer layout (values overwritten
+        by the incoming state_dict)."""
+        for name in self._W_NAMES:
+            w = getattr(self, name)
+            L, _, out = w.shape
+            delattr(self, name)
+            self.register_buffer(name, Tensor(
+                jnp.zeros(tuple(w.shape), jnp.int8)))
+            self.register_buffer(f"{name[:-1]}_scales", Tensor(
+                jnp.ones((L, out), jnp.float32)))
+        self._weight_only = True
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        """A quantized model's state_dict (int8 weights + *_weight_scales)
+        restores into a FRESH layer: the structure converts first, so the
+        int8 codes land in int8 buffers instead of being miscast into fp
+        Parameters."""
+        if ("qkv_weight_scales" in state_dict
+                and not getattr(self, "_weight_only", False)):
+            self._adopt_weight_only_structure()
+        return super().set_state_dict(state_dict, *args, **kwargs)
+
     # -- cache --------------------------------------------------------------
     def gen_cache(self, batch: int, max_len: int):
         """→ [k_cache, v_cache], each [L, B, max_len, H, hd] (the
@@ -96,11 +154,15 @@ class FusedMultiTransformer(Layer):
                  self.ffn_ln_scales, self.ffn_ln_biases,
                  self.ffn1_weights, self.ffn1_biases,
                  self.ffn2_weights, self.ffn2_biases]
+        if getattr(self, "_weight_only", False):
+            pvals += [self.qkv_weight_scales, self.linear_weight_scales,
+                      self.ffn1_weight_scales, self.ffn2_weight_scales]
         act = self.activation
         H, hd = self.num_heads, self.head_dim
         # config must live in the dispatch cache key: the closure bakes
         # H/hd/act, and two models sharing (L, D) would otherwise collide
-        cfg = f"L{self.num_layers}_H{H}_hd{hd}_{act}"
+        cfg = f"L{self.num_layers}_H{H}_hd{hd}_{act}" + \
+            ("_w8" if getattr(self, "_weight_only", False) else "")
         pos_t = Tensor(jnp.asarray(
             int(time_step) if time_step is not None else 0, jnp.int32))
         B = src.shape[0]
@@ -131,9 +193,20 @@ class FusedMultiTransformer(Layer):
         return y, [kc, vc]
 
 
+def _mm(x, w, scale=None):
+    """x @ w with optional weight-only dequant: int8 w upcasts into the
+    dot (XLA fuses the convert into the operand load — HBM reads stay
+    int8) and the per-out-channel scale applies as an epilogue."""
+    y = jnp.einsum("btd,df->btf",
+                   x, w.astype(x.dtype) if w.dtype == jnp.int8 else w)
+    if scale is not None:
+        y = y * scale
+    return y
+
+
 def _stack_forward(x, kcache, vcache, pv, pos, H, hd, act, bias=None):
-    (ln_s, ln_b, qkv_w, qkv_b, lin_w, lin_b, fln_s, fln_b,
-     f1_w, f1_b, f2_w, f2_b) = pv
+    # pv is already in scan order: 12 stacked tensors, +4 weight scales
+    # when weight-only-quantized (block unpacks per-layer slices by count)
     B, T, D = x.shape
     act_fn = jax.nn.gelu if act == "gelu" else jax.nn.relu
 
@@ -149,13 +222,14 @@ def _stack_forward(x, kcache, vcache, pv, pos, H, hd, act, bias=None):
 
     def block(h, layer):
         if use_cache:
-            (ls, lb, qw, qb, lw, lbias, fs, fb, f1w, f1b, f2w, f2b,
-             kc, vc) = layer
+            *ws, kc, vc = layer
         else:
-            (ls, lb, qw, qb, lw, lbias, fs, fb, f1w, f1b, f2w, f2b) = layer
-            kc = vc = None
+            ws, kc, vc = list(layer), None, None
+        (ls, lb, qw, qb, lw, lbias, fs, fb, f1w, f1b, f2w, f2b) = ws[:12]
+        qkv_sc, lin_sc, f1_sc, f2_sc = (tuple(ws[12:16]) if len(ws) >= 16
+                                        else (None,) * 4)
         a_in = _ln(h, ls, lb)
-        qkv = jnp.einsum("btd,df->btf", a_in, qw) + qb
+        qkv = _mm(a_in, qw, qkv_sc) + qb
         q, k_, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(B, T, H, hd)
         k_ = k_.reshape(B, T, H, hd)
@@ -186,30 +260,29 @@ def _stack_forward(x, kcache, vcache, pv, pos, H, hd, act, bias=None):
         p = jax.nn.softmax(s, axis=-1)
         ctx = jnp.einsum("bhts,bshd->bthd", p, vf.astype(jnp.float32))
         ctx = ctx.reshape(B, T, D).astype(h.dtype)
-        a = jnp.einsum("btd,df->btf", ctx, lw) + lbias
+        a = _mm(ctx, lw, lin_sc) + lbias
         h = h + a
         m_in = _ln(h, fs, fb)
-        m = jnp.einsum("btd,df->btf", m_in, f1w) + f1b
+        m = _mm(m_in, f1w, f1_sc) + f1b
         m = act_fn(m)
-        m = jnp.einsum("btf,fd->btd", m, f2w) + f2b
+        m = _mm(m, f2w, f2_sc) + f2b
         h = h + m
         if use_cache:
             return h, (kc, vc)
         return h, None
 
+    xs = list(pv)
+
     if use_cache:
         def scan_fn(h, layer):
             h, caches = block(h, layer)
             return h, caches
-        h, (kcs, vcs) = jax.lax.scan(
-            scan_fn, x, (ln_s, ln_b, qkv_w, qkv_b, lin_w, lin_b, fln_s,
-                         fln_b, f1_w, f1_b, f2_w, f2_b, kcache, vcache))
+        h, (kcs, vcs) = jax.lax.scan(scan_fn, x,
+                                     tuple(xs + [kcache, vcache]))
         return h, kcs, vcs
 
     def scan_fn(h, layer):
         h, _ = block(h, layer)
         return h, None
-    h, _ = jax.lax.scan(scan_fn, x, (ln_s, ln_b, qkv_w, qkv_b, lin_w,
-                                     lin_b, fln_s, fln_b, f1_w, f1_b,
-                                     f2_w, f2_b))
+    h, _ = jax.lax.scan(scan_fn, x, tuple(xs))
     return (h,)

@@ -108,3 +108,65 @@ class TestCachedDecode:
         m3 = FusedMultiTransformer(32, 4, 64, num_layers=1)
         np.testing.assert_array_equal(m1.qkv_weights.numpy(),
                                       m3.qkv_weights.numpy())
+
+
+class TestWeightOnlyInt8:
+    """weight_only_quant: int8 weights + per-(layer,channel) scales
+    (reference fused_multi_transformer_int8_op.cu serving path)."""
+
+    def test_quant_parity_uncached(self, model):
+        src = _src()
+        ref = model(src).numpy()
+        model.weight_only_quant()
+        assert np.asarray(model.qkv_weights._value).dtype == np.int8
+        assert model.qkv_weight_scales.shape[0] == 3     # [L, out]
+        got = model(src).numpy()
+        # int8 weight round-trip: small relative error, same argmaxes
+        err = np.abs(got - ref).max()
+        assert err < 0.05 * np.abs(ref).max() + 1e-3, err
+
+    def test_quant_is_idempotent(self, model):
+        model.weight_only_quant()
+        w_before = np.asarray(model.qkv_weights._value).copy()
+        model.weight_only_quant()
+        np.testing.assert_array_equal(
+            np.asarray(model.qkv_weights._value), w_before)
+
+    def test_quant_decode_matches_quant_full(self, model):
+        """The decode loop stays self-consistent after quantization (the
+        acceptance criterion the fp path has)."""
+        model.weight_only_quant()
+        src = _src(T=6)
+        full = model(src).numpy()
+        prefix = paddle.to_tensor(src.numpy()[:, :4])
+        caches = model.gen_cache(batch=2, max_len=10)
+        _, caches = model(prefix, caches=caches, time_step=0)
+        for t in (4, 5):
+            step_in = paddle.to_tensor(src.numpy()[:, t:t + 1])
+            out, caches = model(step_in, caches=caches, time_step=t)
+        np.testing.assert_allclose(out.numpy()[:, 0], full[:, 5],
+                                   atol=1e-4, rtol=1e-4)
+
+    def test_quanted_weights_leave_parameters(self, model):
+        n_params_before = len(model.parameters())
+        model.weight_only_quant()
+        # the four weight families moved from parameters to buffers
+        assert len(model.parameters()) == n_params_before - 4
+        sd = model.state_dict()
+        assert "qkv_weight_scales" in sd
+
+    def test_quantized_state_dict_restores_into_fresh_layer(self):
+        paddle.seed(3)
+        m = FusedMultiTransformer(embed_dim=32, num_heads=4,
+                                  dim_feedforward=64, num_layers=2)
+        src = _src(D=32)
+        m.weight_only_quant()
+        want = m(src).numpy()
+        sd = {k: v.numpy() for k, v in m.state_dict().items()}
+
+        paddle.seed(99)                       # different init, overwritten
+        fresh = FusedMultiTransformer(embed_dim=32, num_heads=4,
+                                      dim_feedforward=64, num_layers=2)
+        fresh.set_state_dict(sd)
+        assert np.asarray(fresh.qkv_weights._value).dtype == np.int8
+        np.testing.assert_allclose(fresh(src).numpy(), want, atol=1e-6)

@@ -107,6 +107,72 @@ def main():
     except Exception as e:
         emit({"metric": "int8_linear_op", "error": repr(e)[:160]})
 
+    bench_decode(devs)
+
+
+def bench_decode(devs):
+    """KV-cache single-token decode, fp32 weights vs weight-only int8
+    (incubate.FusedMultiTransformer.weight_only_quant) — decode is
+    weight-HBM-bound, so int8 weights should approach a 4x step-time cut
+    vs f32 on chip. The decode steps are CHAINED inside one jit via
+    lax.scan (CLAUDE.md: per-dispatch tunnel latency is ~70-170 ms; an
+    eager per-token loop would measure the tunnel, not the chip)."""
+    import functools
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn import FusedMultiTransformer
+    from paddle_tpu.incubate.fused_multi_transformer import _stack_forward
+    paddle.seed(0)
+    B, D, L, MAXLEN, STEPS = 8, 1024, 24, 1024, 16
+    model = FusedMultiTransformer(embed_dim=D, num_heads=16,
+                                  dim_feedforward=4 * D, num_layers=L)
+    rng = np.random.RandomState(0)
+    prefix = paddle.to_tensor(rng.randn(B, 512, D).astype(np.float32) * .1)
+    x0 = jnp.asarray(rng.randn(B, 1, D).astype(np.float32) * .1)
+
+    def decode_ms(m, caches, label):
+        names = ["ln_scales", "ln_biases", "qkv_weights", "qkv_biases",
+                 "linear_weights", "linear_biases", "ffn_ln_scales",
+                 "ffn_ln_biases", "ffn1_weights", "ffn1_biases",
+                 "ffn2_weights", "ffn2_biases"]
+        if getattr(m, "_weight_only", False):
+            names += ["qkv_weight_scales", "linear_weight_scales",
+                      "ffn1_weight_scales", "ffn2_weight_scales"]
+        pv = [getattr(m, n)._value for n in names]
+
+        @jax.jit
+        def chained(x, kc, vc, *pvv):
+            def step(carry, t):
+                x, kc, vc = carry
+                y, kc, vc = _stack_forward(x, kc, vc, pvv, 512 + t,
+                                           m.num_heads, m.head_dim,
+                                           m.activation)
+                return (y, kc, vc), None
+            (y, kc, vc), _ = jax.lax.scan(
+                step, (x, kc, vc), jnp.arange(STEPS))
+            return y
+
+        kc, vc = caches[0]._value, caches[1]._value
+        out = chained(x0, kc, vc, *pv)
+        _force(out)                                        # compile
+        t0 = time.perf_counter()
+        out = chained(x0, kc, vc, *pv)
+        _force(out)
+        ms = (time.perf_counter() - t0) / STEPS * 1e3
+        emit({"metric": label, "ms_per_token": round(ms, 3),
+              "chained_steps": STEPS, "backend": devs[0].platform})
+        return ms
+
+    try:
+        caches = model.gen_cache(batch=B, max_len=MAXLEN)
+        _, caches = model(prefix, caches=caches, time_step=0)
+        fp_ms = decode_ms(model, caches, "decode_fp32")
+        model.weight_only_quant()
+        q_ms = decode_ms(model, caches, "decode_weight_only_int8")
+        emit({"metric": "decode_speedup_int8_vs_fp32",
+              "x": round(fp_ms / q_ms, 2), "backend": devs[0].platform})
+    except Exception as e:
+        emit({"metric": "decode_bench", "error": repr(e)[:200]})
+
 
 if __name__ == "__main__":
     main()
