@@ -144,3 +144,26 @@ def test_convert_without_calibration_raises():
     model = nn.Sequential(nn.Linear(4, 2))
     with pytest.raises(ValueError, match="no calibrated"):
         convert_to_int8(model)
+
+
+def test_int8_model_serves_through_predictor(tmp_path):
+    """The verdict acceptance criterion: an int8 path a Predictor can
+    serve (StableHLO save -> inference.Config -> create_predictor)."""
+    rng = np.random.RandomState(6)
+    model, ptq = _calibrated_mlp(rng)
+    int8_model = ptq.convert(model, to_int8=True)
+    x = rng.randn(4, 16).astype(np.float32)
+    want = int8_model(paddle.to_tensor(x)).numpy()
+
+    path = str(tmp_path / "int8_model")
+    paddle.jit.save(int8_model, path,
+                    input_spec=[paddle.static.InputSpec([4, 16],
+                                                        "float32")])
+    from paddle_tpu import inference
+    cfg = inference.Config(path + ".pdmodel", path + ".pdiparams")
+    pred = inference.create_predictor(cfg)
+    inp = pred.get_input_handle(pred.get_input_names()[0])
+    inp.copy_from_cpu(x)
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
