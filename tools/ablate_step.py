@@ -173,6 +173,13 @@ def v_dots():
     emit("dots_remat_b8", step_ms(cfg, p, o, t))
 
 
+def v_dots_flash():
+    """dots + saved flash outputs: no attention recompute in backward."""
+    os.environ["PADDLE_TPU_DISABLE_PALLAS_BWD"] = "1"
+    cfg, p, o, t = build(dict(remat=True, remat_policy="dots_flash"))
+    emit("dots_flash_remat_b8", step_ms(cfg, p, o, t))
+
+
 def v_noremat_b4():
     os.environ["PADDLE_TPU_DISABLE_PALLAS_BWD"] = "1"
     cfg, p, o, t = build(dict(remat=False), batch=4)
@@ -264,6 +271,7 @@ VARIANTS = {
     "calib_attn": calib_attention,
     "baseline": v_baseline,
     "dots": v_dots,
+    "dots_flash": v_dots_flash,
     "noremat_b4": v_noremat_b4,
     "xla_attn": v_xla_attn,
     "no_attn": v_no_attn,
