@@ -83,6 +83,21 @@ class FusedMultiTransformer(Layer):
     # -- weight-only int8 ---------------------------------------------------
     _W_NAMES = ("qkv_weights", "linear_weights", "ffn1_weights",
                 "ffn2_weights")
+    _PV_NAMES = ("ln_scales", "ln_biases", "qkv_weights", "qkv_biases",
+                 "linear_weights", "linear_biases", "ffn_ln_scales",
+                 "ffn_ln_biases", "ffn1_weights", "ffn1_biases",
+                 "ffn2_weights", "ffn2_biases")
+    _SCALE_NAMES = ("qkv_weight_scales", "linear_weight_scales",
+                    "ffn1_weight_scales", "ffn2_weight_scales")
+
+    def _scan_inputs(self):
+        """The stacked tensors _stack_forward scans over, in order — the
+        single source of the pv layout (forward and the decode bench both
+        use it)."""
+        names = self._PV_NAMES + (
+            self._SCALE_NAMES if getattr(self, "_weight_only", False)
+            else ())
+        return [getattr(self, n) for n in names]
 
     def weight_only_quant(self):
         """Convert the four stacked weight families to int8 with
@@ -149,14 +164,7 @@ class FusedMultiTransformer(Layer):
         scalar Tensor; it traces as a dynamic index, so every decode step
         reuses ONE compiled computation."""
         from ..framework.dispatch import apply
-        pvals = [self.ln_scales, self.ln_biases, self.qkv_weights,
-                 self.qkv_biases, self.linear_weights, self.linear_biases,
-                 self.ffn_ln_scales, self.ffn_ln_biases,
-                 self.ffn1_weights, self.ffn1_biases,
-                 self.ffn2_weights, self.ffn2_biases]
-        if getattr(self, "_weight_only", False):
-            pvals += [self.qkv_weight_scales, self.linear_weight_scales,
-                      self.ffn1_weight_scales, self.ffn2_weight_scales]
+        pvals = self._scan_inputs()
         act = self.activation
         H, hd = self.num_heads, self.head_dim
         # config must live in the dispatch cache key: the closure bakes

@@ -91,7 +91,7 @@ class UndefinedVar:
 for _dunder in ("add radd sub rsub mul rmul truediv rtruediv matmul "
                 "rmatmul getitem setitem len eq ne lt le gt ge neg "
                 "float int index").split():
-    def _op(self, *a, _d=_dunder, **k):
+    def _op(self, *a, **k):
         self._raise()
     setattr(UndefinedVar, f"__{_dunder}__", _op)
 

@@ -57,6 +57,17 @@ def _persist():
         pass
 
 
+def cached(op: str, signature: str):
+    """Cache READ (no timing): a persisted winner — from a prior
+    in-process tune or an offline tools/autotune_kernels.py sweep —
+    applies even when live tuning is off (reference cache.cc reads
+    unconditionally; switch_autotune only gates the timed pass).
+    Returns the winner (lists back as tuples) or None."""
+    _load()
+    hit = _CACHE.get(f"{op}::{signature}")
+    return tuple(hit) if isinstance(hit, list) else hit
+
+
 def autotune_status() -> dict:
     """Reference switch_autotune.cc status counters."""
     return dict(_stats, cached=len(_CACHE), enabled=enabled())

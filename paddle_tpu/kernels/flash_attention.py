@@ -138,21 +138,11 @@ def _flash_sig(q, k, causal):
     return f"B{B}_Sq{Sq}_Sk{k.shape[1]}_H{H}_D{D}_c{int(causal)}_{q.dtype}"
 
 
-def _cached_blocks(kernel, sig):
-    """Cache READ (no timing): a persisted winner — from a prior in-process
-    tune or an offline tools/autotune_kernels.py sweep — applies even when
-    live tuning is off (reference cache.cc reads unconditionally;
-    switch_autotune only gates the timed pass)."""
-    from . import autotune
-    autotune._load()
-    cached = autotune._CACHE.get(f"{kernel}::{sig}")
-    return tuple(cached) if cached else None
-
-
 def _tuned_blocks_bwd(q, k, causal):
     """Backward block sizes from the cache (populated by the offline
     sweep); None = env/defaults."""
-    return _cached_blocks("flash_bwd", _flash_sig(q, k, causal))
+    from .autotune import cached
+    return cached("flash_bwd", _flash_sig(q, k, causal))
 
 
 def _tuned_blocks(q, k, causal):
@@ -162,7 +152,7 @@ def _tuned_blocks(q, k, causal):
     enabled; None = kernel defaults / env overrides."""
     from . import autotune
     sig = _flash_sig(q, k, causal)
-    hit = _cached_blocks("flash_fwd", sig)
+    hit = autotune.cached("flash_fwd", sig)
     if hit is not None:
         return hit
     if not autotune.enabled():

@@ -153,9 +153,9 @@ def _tuned_ce_blocks(logits2d):
     """(block_t, block_v) from the persistent autotune cache (populated by
     tools/autotune_kernels.py; key matches its `ce::T{T}_V{V}_{dtype}`),
     else the shipped 128/512 defaults."""
-    from .flash_attention import _cached_blocks
+    from .autotune import cached
     sig = f"T{logits2d.shape[0]}_V{logits2d.shape[1]}_{logits2d.dtype}"
-    return _cached_blocks("ce", sig) or (128, 512)
+    return cached("ce", sig) or (128, 512)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))

@@ -23,6 +23,7 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(1, os.path.dirname(os.path.abspath(__file__)))
 
 import jax
 import jax.numpy as jnp
@@ -42,20 +43,7 @@ def emit(rec):
     print(json.dumps(rec), flush=True)
 
 
-def _force(out):
-    leaf = jax.tree_util.tree_leaves(out)[0]
-    np.asarray(jax.device_get(leaf)).ravel()[:1]
-
-
-def timeit(fn, *args, iters=10, warmup=1):
-    for _ in range(warmup):
-        out = fn(*args)
-    _force(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    _force(out)
-    return (time.perf_counter() - t0) / iters * 1e3
+from bench_util import force as _force, timeit  # noqa: E402
 
 
 def _update_cache(key, value):

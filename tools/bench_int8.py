@@ -18,6 +18,7 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(1, os.path.dirname(os.path.abspath(__file__)))
 
 import jax
 import jax.numpy as jnp
@@ -35,18 +36,7 @@ def emit(rec):
     print(json.dumps(rec), flush=True)
 
 
-def _force(out):
-    np.asarray(jax.device_get(jax.tree_util.tree_leaves(out)[0])).ravel()[:1]
-
-
-def timeit(fn, *args, iters=10):
-    out = fn(*args)
-    _force(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    _force(out)
-    return (time.perf_counter() - t0) / iters * 1e3
+from bench_util import force as _force, timeit  # noqa: E402
 
 
 def main():
@@ -130,14 +120,7 @@ def bench_decode(devs):
     x0 = jnp.asarray(rng.randn(B, 1, D).astype(np.float32) * .1)
 
     def decode_ms(m, caches, label):
-        names = ["ln_scales", "ln_biases", "qkv_weights", "qkv_biases",
-                 "linear_weights", "linear_biases", "ffn_ln_scales",
-                 "ffn_ln_biases", "ffn1_weights", "ffn1_biases",
-                 "ffn2_weights", "ffn2_biases"]
-        if getattr(m, "_weight_only", False):
-            names += ["qkv_weight_scales", "linear_weight_scales",
-                      "ffn1_weight_scales", "ffn2_weight_scales"]
-        pv = [getattr(m, n)._value for n in names]
+        pv = [t._value for t in m._scan_inputs()]
 
         @jax.jit
         def chained(x, kc, vc, *pvv):

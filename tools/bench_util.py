@@ -1,0 +1,31 @@
+"""Shared timing helpers for the measurement tools (ablate_step,
+autotune_kernels, bench_int8). One copy of the tunnel-safe forcing rule:
+block_until_ready can return early over the axon tunnel, so results are
+forced with a host scalar pull (see CLAUDE.md / bench.py)."""
+from __future__ import annotations
+
+import time
+
+import jax
+import numpy as np
+
+
+def force(out):
+    """Genuinely wait for `out` (first leaf) by pulling a host scalar.
+    Device execution is FIFO, so waiting on the last submission bounds
+    the whole timed span."""
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    np.asarray(jax.device_get(leaf)).ravel()[:1]
+
+
+def timeit(fn, *args, iters=10, warmup=1):
+    """Steady-state ms per call of fn(*args)."""
+    out = None
+    for _ in range(warmup):
+        out = fn(*args)
+    force(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    force(out)
+    return (time.perf_counter() - t0) / iters * 1e3
