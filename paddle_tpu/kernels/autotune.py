@@ -65,7 +65,52 @@ def cached(op: str, signature: str):
     Returns the winner (lists back as tuples) or None."""
     _load()
     hit = _CACHE.get(f"{op}::{signature}")
-    return tuple(hit) if isinstance(hit, list) else hit
+    if isinstance(hit, list):
+        # the cache file is hand-editable: an empty list means "no
+        # winner", not a zero-length block tuple
+        return tuple(hit) or None
+    return hit
+
+
+def cached_any_batch(op: str, signature: str):
+    """Batch-agnostic cache READ: exact signature first, then any entry
+    for the same op whose signature differs only in the leading `B{n}_`
+    batch field. Pallas block sizes tile the sequence/head dims, not the
+    batch (batch is a grid axis), so a winner tuned at one batch is the
+    right default at another when the exact key misses."""
+    hit = cached(op, signature)
+    if hit is not None:
+        return hit
+    head, _, suffix = signature.partition("_")
+    if not suffix:
+        return None
+    try:
+        want_b = int(head[1:])
+    except ValueError:
+        return None
+    # deterministic choice when several batches share the suffix: nearest
+    # batch wins, key order breaks ties (cache write order must not
+    # change which blocks a bench runs with)
+    best = None
+    for key in sorted(_CACHE):
+        if not key.startswith(f"{op}::B"):
+            continue
+        sig = key.split("::", 1)[1]
+        b_field, _, sig_suffix = sig.partition("_")
+        if sig_suffix != suffix:
+            continue
+        try:
+            dist = abs(int(b_field[1:]) - want_b)
+        except ValueError:
+            continue
+        if best is None or dist < best[0]:
+            best = (dist, _CACHE[key])
+    if best is None:
+        return None
+    val = best[1]
+    if isinstance(val, list):
+        return tuple(val) or None
+    return val
 
 
 def autotune_status() -> dict:

@@ -133,16 +133,40 @@ def _pallas_enabled() -> bool:
     return use_pallas
 
 
+def _pallas_attn_enabled() -> bool:
+    """Attention-only gate layered on the global one (CE kernel
+    unaffected — it gates through _pallas_enabled directly): the round-4
+    ablation measured the XLA attention path faster than the Pallas flash
+    forward at S=1024, so benches race the two per-shape via
+    PADDLE_TPU_DISABLE_PALLAS_ATTN."""
+    import os
+    if os.environ.get("PADDLE_TPU_DISABLE_PALLAS_ATTN", "") in (
+            "1", "true", "True"):
+        return False
+    return _pallas_enabled()
+
+
 def _flash_sig(q, k, causal):
     B, Sq, H, D = q.shape
     return f"B{B}_Sq{Sq}_Sk{k.shape[1]}_H{H}_D{D}_c{int(causal)}_{q.dtype}"
 
 
+def _env_blocks_set(*names) -> bool:
+    """Explicit PADDLE_TPU_FLASH_BLOCK_* env overrides outrank the
+    autotune cache — they are the operator's (and the block sweep's) way
+    of forcing a size the cache would otherwise shadow."""
+    import os
+    return any(os.environ.get(n) for n in names)
+
+
 def _tuned_blocks_bwd(q, k, causal):
     """Backward block sizes from the cache (populated by the offline
-    sweep); None = env/defaults."""
-    from .autotune import cached
-    return cached("flash_bwd", _flash_sig(q, k, causal))
+    sweep); batch-agnostic fallback; None = env/defaults."""
+    if _env_blocks_set("PADDLE_TPU_FLASH_BLOCK_BWD_Q",
+                       "PADDLE_TPU_FLASH_BLOCK_BWD_K"):
+        return None
+    from .autotune import cached_any_batch
+    return cached_any_batch("flash_bwd", _flash_sig(q, k, causal))
 
 
 def _tuned_blocks(q, k, causal):
@@ -151,8 +175,11 @@ def _tuned_blocks(q, k, causal):
     always, a timed tuning pass additionally runs when autotune is
     enabled; None = kernel defaults / env overrides."""
     from . import autotune
+    if _env_blocks_set("PADDLE_TPU_FLASH_BLOCK_Q",
+                       "PADDLE_TPU_FLASH_BLOCK_K"):
+        return None
     sig = _flash_sig(q, k, causal)
-    hit = autotune.cached("flash_fwd", sig)
+    hit = autotune.cached_any_batch("flash_fwd", sig)
     if hit is not None:
         return hit
     if not autotune.enabled():
@@ -192,7 +219,7 @@ def _tuned_blocks(q, k, causal):
 
 
 def _fwd_with_lse(q, k, v, causal, kv_len=None):
-    if _pallas_enabled() and jax.default_backend() in ("tpu", "axon"):
+    if _pallas_attn_enabled() and jax.default_backend() in ("tpu", "axon"):
         from .pallas_attention import mha_fwd
         blocks = _tuned_blocks(q, k, causal)
         if blocks is not None:
@@ -278,7 +305,7 @@ def _pallas_bwd_enabled() -> bool:
     if os.environ.get("PADDLE_TPU_DISABLE_PALLAS_BWD", "") in ("1", "true",
                                                                "True"):
         return False
-    return _pallas_enabled()
+    return _pallas_attn_enabled()
 
 
 def _flash_mha_bwd(causal, kv_len, res, do):

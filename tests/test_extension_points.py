@@ -116,6 +116,25 @@ class TestAutotune:
         disk = json.load(open(tmp_path / "cache.json"))
         assert disk["op::sig1"] == "fast"
 
+    def test_cached_any_batch_falls_back_across_batch(self, monkeypatch,
+                                                      tmp_path):
+        # a winner tuned at B=8 applies at B=4 (blocks tile the sequence,
+        # not the batch); exact hits still win over the relaxed match
+        from paddle_tpu.kernels import autotune
+        monkeypatch.setattr(autotune, "_CACHE", {
+            "flash_fwd::B8_Sq1024_Sk1024_H16_D64_c1_bfloat16": [512, 256],
+            "flash_fwd::B4_Sq2048_Sk2048_H16_D64_c1_bfloat16": [256, 256],
+        })
+        monkeypatch.setattr(autotune, "_loaded", True)
+        assert autotune.cached_any_batch(
+            "flash_fwd", "B4_Sq1024_Sk1024_H16_D64_c1_bfloat16") == (512, 256)
+        assert autotune.cached_any_batch(
+            "flash_fwd", "B4_Sq2048_Sk2048_H16_D64_c1_bfloat16") == (256, 256)
+        assert autotune.cached_any_batch(
+            "flash_fwd", "B4_Sq512_Sk512_H16_D64_c1_bfloat16") is None
+        assert autotune.cached_any_batch(
+            "flash_bwd", "B4_Sq1024_Sk1024_H16_D64_c1_bfloat16") is None
+
     def test_disabled_returns_default_without_timing(self, monkeypatch,
                                                      tmp_path):
         from paddle_tpu.kernels import autotune

@@ -221,3 +221,40 @@ class TestPallasCrossEntropy:
         kernel_val = float(losses.fused_softmax_ce(logits3, tgt3,
                                                    valid_mask=mask))
         assert abs(jax_val - kernel_val) < 1e-5
+
+
+class TestKillSwitchGates:
+    """The kill-switch family must stay layered: global > attention-only
+    > backward-only, with the CE kernel on the global gate only."""
+
+    def test_attn_kill_leaves_ce_enabled(self, monkeypatch):
+        from paddle_tpu.kernels import flash_attention as fa
+        monkeypatch.setenv("PADDLE_TPU_DISABLE_PALLAS_ATTN", "1")
+        assert not fa._pallas_attn_enabled()
+        assert not fa._pallas_bwd_enabled()
+        assert fa._pallas_enabled()      # CE gate path stays live
+
+    def test_global_kill_covers_all(self, monkeypatch):
+        from paddle_tpu.kernels import flash_attention as fa
+        monkeypatch.setenv("PADDLE_TPU_DISABLE_PALLAS", "1")
+        assert not fa._pallas_enabled()
+        assert not fa._pallas_attn_enabled()
+        assert not fa._pallas_bwd_enabled()
+
+    def test_env_blocks_outrank_autotune_cache(self, monkeypatch):
+        import jax.numpy as jnp
+        from paddle_tpu.kernels import flash_attention as fa
+        from paddle_tpu.kernels import autotune
+        q = jnp.zeros((8, 1024, 16, 64), jnp.bfloat16)
+        sig = fa._flash_sig(q, q, True)
+        monkeypatch.setattr(autotune, "_CACHE",
+                            {f"flash_fwd::{sig}": [512, 256],
+                             f"flash_bwd::{sig}": [256, 256]})
+        monkeypatch.setattr(autotune, "_loaded", True)
+        assert fa._tuned_blocks(q, q, True) == (512, 256)
+        assert fa._tuned_blocks_bwd(q, q, True) == (256, 256)
+        monkeypatch.setenv("PADDLE_TPU_FLASH_BLOCK_Q", "256")
+        assert fa._tuned_blocks(q, q, True) is None
+        assert fa._tuned_blocks_bwd(q, q, True) == (256, 256)
+        monkeypatch.setenv("PADDLE_TPU_FLASH_BLOCK_BWD_K", "128")
+        assert fa._tuned_blocks_bwd(q, q, True) is None

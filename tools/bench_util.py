@@ -20,10 +20,8 @@ def force(out):
 
 def timeit(fn, *args, iters=10, warmup=1):
     """Steady-state ms per call of fn(*args)."""
-    out = None
     for _ in range(warmup):
-        out = fn(*args)
-    force(out)
+        force(fn(*args))
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(*args)

@@ -16,38 +16,40 @@ import subprocess
 import sys
 import time
 
+JAXBWD = {"PADDLE_TPU_DISABLE_PALLAS_BWD": "1"}
+XLA_ATTN = {"PADDLE_TPU_DISABLE_PALLAS_ATTN": "1", **JAXBWD}
+
 VARIANTS = [
-    # name, remat, policy, (bq, bk, bwd_q, bwd_k), extra env
-    # round-3 kernels are bf16-operand MXU-native and the loss runs the
-    # Pallas CE kernel by default: re-rank everything.
-    ("dots-jaxbwd", True, "dots", (128, 128, 128, 128),
-     {"PADDLE_TPU_DISABLE_PALLAS_BWD": "1"}),
-    ("dots-pallasbwd", True, "dots", (128, 128, 128, 128), {}),
-    ("full-jaxbwd", True, "full", (128, 128, 128, 128),
-     {"PADDLE_TPU_DISABLE_PALLAS_BWD": "1"}),
-    ("dots-jaxbwd-noCE", True, "dots", (128, 128, 128, 128),
-     {"PADDLE_TPU_DISABLE_PALLAS_BWD": "1",
-      "PADDLE_TPU_DISABLE_PALLAS_CE": "1"}),
-    ("dots-nopallas", True, "dots", (128, 128, 128, 128),
-     {"PADDLE_TPU_DISABLE_PALLAS": "1"}),
-    ("dots-256", True, "dots", (256, 256, 256, 256), {}),
-    ("dots-jaxbwd-q256k512", True, "dots", (256, 512, 128, 128),
-     {"PADDLE_TPU_DISABLE_PALLAS_BWD": "1"}),
-    ("dots-512", True, "dots", (512, 512, 512, 512), {}),
-    # round-4 additions: scan unrolling (cross-block fusion), host-offloaded
-    # dot saves (HBM headroom — the no-remat config OOMed at B=8), and the
-    # unroll x jax-bwd combination
-    ("dots-jaxbwd-unroll4", True, "dots", (128, 128, 128, 128),
-     {"PADDLE_TPU_DISABLE_PALLAS_BWD": "1", "SWEEP_SCAN_UNROLL": "4"}),
-    ("dots-jaxbwd-unroll2", True, "dots", (128, 128, 128, 128),
-     {"PADDLE_TPU_DISABLE_PALLAS_BWD": "1", "SWEEP_SCAN_UNROLL": "2"}),
-    ("offload-jaxbwd", True, "offload_dots", (128, 128, 128, 128),
-     {"PADDLE_TPU_DISABLE_PALLAS_BWD": "1"}),
-    # save the named flash outputs too: no attention fwd recompute in bwd
-    ("dotsflash-jaxbwd", True, "dots_flash", (128, 128, 128, 128),
-     {"PADDLE_TPU_DISABLE_PALLAS_BWD": "1"}),
-    ("dotsflash-jaxbwd-unroll2", True, "dots_flash", (128, 128, 128, 128),
-     {"PADDLE_TPU_DISABLE_PALLAS_BWD": "1", "SWEEP_SCAN_UNROLL": "2"}),
+    # name, remat, policy, (bq, bk, bwd_q, bwd_k), extra env[, batch]
+    # Ordered by the round-4 ablation matrix (perf/window_*/ablate.out):
+    # no-remat at reduced batch beat every remat variant per-token
+    # (42.5 ms/sample at B=4 vs 53.4 best remat at B=8), and the XLA
+    # attention path beat the Pallas flash fwd in the full step (399.7 vs
+    # 435.5 ms). Race the combos; tokens_per_sec is the cross-batch metric.
+    # Default blocks are the round-4 autotune winners (perf/autotune.json:
+    # fwd 512/256 measured 3.4x faster than the old 128/128; bwd 128/128).
+    # Explicit FLASH_BLOCK env settings outrank the autotune cache, so
+    # these tuples really do control every variant.
+    ("noremat-b4", False, "dots", (512, 256, 128, 128), JAXBWD, 4),
+    ("noremat-xlaattn-b4", False, "dots", (512, 256, 128, 128),
+     XLA_ATTN, 4),
+    ("noremat-b6", False, "dots", (512, 256, 128, 128), JAXBWD, 6),
+    ("noremat-pallasbwd-b4", False, "dots", (512, 256, 128, 128), {}, 4),
+    # autotune's bwd microbench flipped the round-3 verdict (Pallas bwd
+    # 116 ms vs jax-level 170.6): re-litigate at step level, tuned blocks
+    ("dots-pallasbwd-tuned", True, "dots", (512, 256, 128, 128), {}),
+    ("dotsflash-jaxbwd", True, "dots_flash", (512, 256, 128, 128), JAXBWD),
+    ("dots-jaxbwd", True, "dots", (512, 256, 128, 128), JAXBWD),
+    ("xlaattn-dots-b8", True, "dots", (512, 256, 128, 128), XLA_ATTN, 8),
+    ("noremat-b5", False, "dots", (512, 256, 128, 128), JAXBWD, 5),
+    # host-offloaded dot saves: HBM headroom without recompute
+    ("offload-jaxbwd", True, "offload_dots", (512, 256, 128, 128), JAXBWD),
+    ("dotsflash-jaxbwd-unroll2", True, "dots_flash", (512, 256, 128, 128),
+     {**JAXBWD, "SWEEP_SCAN_UNROLL": "2"}),
+    ("noremat-xlaattn-b6", False, "dots", (512, 256, 128, 128),
+     XLA_ATTN, 6),
+    ("dots-jaxbwd-noCE", True, "dots", (512, 256, 128, 128),
+     {**JAXBWD, "PADDLE_TPU_DISABLE_PALLAS_CE": "1"}),
 ]
 
 MODEL = dict(vocab_size=32768, hidden_size=1024, num_layers=24,
@@ -69,9 +71,10 @@ def run_one(spec: dict) -> None:
                     scan_unroll=int(os.environ.get("SWEEP_SCAN_UNROLL",
                                                    "1")),
                     **MODEL)
+    batch = int(spec.get("batch", BATCH))
     params = init_gpt_params(cfg, jax.random.PRNGKey(0))
     opt_state = init_opt_state(params)
-    tokens = jax.random.randint(jax.random.PRNGKey(1), (BATCH, SEQ + 1), 0,
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, SEQ + 1), 0,
                                 cfg.vocab_size)
     step = jax.jit(functools.partial(train_step, cfg=cfg, lr=1e-4),
                    donate_argnums=(0, 1))
@@ -85,17 +88,22 @@ def run_one(spec: dict) -> None:
     float(loss)
     dt = (time.perf_counter() - t0) / ITERS
     print(json.dumps({"name": spec["name"], "ms_per_step": round(dt * 1e3, 2),
-                      "tokens_per_sec": round(BATCH * SEQ / dt, 1),
-                      "compile_s": round(compile_s, 1),
+                      "tokens_per_sec": round(batch * SEQ / dt, 1),
+                      "batch": batch, "compile_s": round(compile_s, 1),
                       "platform": devs[0].platform}), flush=True)
 
 
 def main() -> None:
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     results = []
-    for name, remat, policy, (bq, bk, bwq, bwk), extra in VARIANTS:
+    for name, remat, policy, (bq, bk, bwq, bwk), extra, *rest in VARIANTS:
         spec = {"name": name, "remat": remat, "policy": policy}
+        if rest:
+            spec["batch"] = rest[0]
         env = dict(os.environ)
+        cache = os.path.join(here, "perf", "autotune.json")
+        if os.path.exists(cache):
+            env.setdefault("PADDLE_TPU_AUTOTUNE_CACHE", cache)
         env.update({
             "PADDLE_TPU_FLASH_BLOCK_Q": str(bq),
             "PADDLE_TPU_FLASH_BLOCK_K": str(bk),
@@ -122,7 +130,8 @@ def main() -> None:
         else:
             print(f"[sweep] {name}: FAILED rc={res.returncode}",
                   file=sys.stderr, flush=True)
-    results.sort(key=lambda r: r["ms_per_step"])
+    # batches differ across variants: rank by throughput, not step time
+    results.sort(key=lambda r: -r["tokens_per_sec"])
     print(json.dumps({"ranked": results}, indent=1), flush=True)
 
 
