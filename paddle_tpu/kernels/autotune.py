@@ -57,19 +57,28 @@ def _persist():
         pass
 
 
+def _read(op: str, signature: str):
+    """ONE home for the raw cache-entry semantics: returns
+    ('hit', winner) with lists back as tuples, ('optout',) for a
+    hand-edited empty entry (the documented "no tuned winner" escape
+    hatch), or ('miss',)."""
+    _load()
+    hit = _CACHE.get(f"{op}::{signature}")
+    if hit is None:
+        return ("miss",)
+    if isinstance(hit, list):
+        return ("hit", tuple(hit)) if hit else ("optout",)
+    return ("hit", hit)
+
+
 def cached(op: str, signature: str):
     """Cache READ (no timing): a persisted winner — from a prior
     in-process tune or an offline tools/autotune_kernels.py sweep —
     applies even when live tuning is off (reference cache.cc reads
     unconditionally; switch_autotune only gates the timed pass).
     Returns the winner (lists back as tuples) or None."""
-    _load()
-    hit = _CACHE.get(f"{op}::{signature}")
-    if isinstance(hit, list):
-        # the cache file is hand-editable: an empty list means "no
-        # winner", not a zero-length block tuple
-        return tuple(hit) or None
-    return hit
+    state = _read(op, signature)
+    return state[1] if state[0] == "hit" else None
 
 
 def cached_any_batch(op: str, signature: str):
@@ -77,10 +86,13 @@ def cached_any_batch(op: str, signature: str):
     for the same op whose signature differs only in the leading `B{n}_`
     batch field. Pallas block sizes tile the sequence/head dims, not the
     batch (batch is a grid axis), so a winner tuned at one batch is the
-    right default at another when the exact key misses."""
-    hit = cached(op, signature)
-    if hit is not None:
-        return hit
+    right default at another when the exact key misses. An exact-key
+    opt-out entry is honored: it never falls back to another batch."""
+    state = _read(op, signature)
+    if state[0] == "hit":
+        return state[1]
+    if state[0] == "optout":
+        return None
     head, _, suffix = signature.partition("_")
     if not suffix:
         return None
@@ -97,20 +109,16 @@ def cached_any_batch(op: str, signature: str):
             continue
         sig = key.split("::", 1)[1]
         b_field, _, sig_suffix = sig.partition("_")
-        if sig_suffix != suffix:
+        state = _read(op, sig)
+        if sig_suffix != suffix or state[0] != "hit":
             continue
         try:
             dist = abs(int(b_field[1:]) - want_b)
         except ValueError:
             continue
         if best is None or dist < best[0]:
-            best = (dist, _CACHE[key])
-    if best is None:
-        return None
-    val = best[1]
-    if isinstance(val, list):
-        return tuple(val) or None
-    return val
+            best = (dist, state[1])
+    return best[1] if best else None
 
 
 def autotune_status() -> dict:
@@ -135,14 +143,13 @@ def pick(op: str, signature: str, candidates: Sequence[Any],
     winner is cached in-process and on disk; when tuning is disabled the
     cached winner (or `default`/first candidate) is returned without any
     timing."""
-    _load()
-    key = f"{op}::{signature}"
-    if key in _CACHE:
+    state = _read(op, signature)
+    if state[0] == "hit":
         _stats["hits"] += 1
-        cached = _CACHE[key]
-        # JSON round-trips tuples as lists
-        return tuple(cached) if isinstance(cached, list) else cached
-    if not enabled():
+        return state[1]
+    # an explicit opt-out entry behaves exactly like a disabled tuner
+    # for this signature
+    if state[0] == "optout" or not enabled():
         _stats["misses"] += 1
         return default if default is not None else candidates[0]
 
@@ -164,7 +171,8 @@ def pick(op: str, signature: str, candidates: Sequence[Any],
         # return the default WITHOUT caching, so a later healthy run
         # re-tunes instead of freezing an unmeasured winner
         return default if default is not None else candidates[0]
-    _CACHE[key] = list(best) if isinstance(best, tuple) else best
+    _CACHE[f"{op}::{signature}"] = (list(best) if isinstance(best, tuple)
+                                    else best)
     _stats["tuned"] += 1
     _persist()
     return best

@@ -143,6 +143,8 @@ def _pallas_attn_enabled() -> bool:
     if os.environ.get("PADDLE_TPU_DISABLE_PALLAS_ATTN", "") in (
             "1", "true", "True"):
         return False
+    if _attn_impl() == "xla":
+        return False
     return _pallas_enabled()
 
 
@@ -324,9 +326,44 @@ def _flash_mha_bwd(causal, kv_len, res, do):
 _flash_mha.defvjp(_flash_mha_fwd, _flash_mha_bwd)
 
 
+def _attn_impl() -> str:
+    """Attention implementation selector (PADDLE_TPU_ATTN_IMPL):
+    - 'pallas'   homegrown kernel + the gates above (default)
+    - 'jax_flash' jax.experimental.pallas.ops.tpu.flash_attention — the
+      upstream-tuned TPU kernel with its own fwd+bwd Pallas passes
+    - 'xla'      the blockwise lax.scan path (same as the ATTN kill)
+    Re-read per trace like the kill switches."""
+    import os
+    return os.environ.get("PADDLE_TPU_ATTN_IMPL", "pallas")
+
+
+def _jax_flash_mha(q, k, v, causal):
+    """The upstream TPU flash kernel ([B,H,S,D] layout, own custom_vjp —
+    backward runs its dq/dkv Pallas kernels, not ours)."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention as tpu_flash)
+    D = q.shape[-1]
+    out = tpu_flash(jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                    jnp.swapaxes(v, 1, 2), causal=causal,
+                    sm_scale=1.0 / math.sqrt(D))
+    return jnp.swapaxes(out, 1, 2)
+
+
+def _dispatch_mha(q, k, v, causal):
+    # the upstream kernel is still Pallas: the global and attention kill
+    # switches outrank the impl selector, preserving the documented
+    # global > attention-only > impl layering
+    if (_attn_impl() == "jax_flash" and _pallas_attn_enabled()
+            and jax.default_backend() in ("tpu", "axon")):
+        return _jax_flash_mha(q, k, v, causal)
+    # 'xla' needs no branch here: _pallas_attn_enabled() reads the impl
+    # and routes _flash_mha onto the blockwise fwd + jax-level bwd
+    return _flash_mha(q, k, v, causal)
+
+
 @defop("flash_attention_kernel")
 def _flash_attention_op(q, k, v, causal):
-    return _flash_mha(q, k, v, causal)
+    return _dispatch_mha(q, k, v, causal)
 
 
 def flash_attention(q, k, v, causal=False):
@@ -336,5 +373,5 @@ def flash_attention(q, k, v, causal=False):
 
 def flash_attention_fn(q, k, v, causal=False):
     """Raw jax-level entry (for models that work on arrays, e.g. models.gpt)."""
-    return _flash_mha(q, k, v, bool(causal))
+    return _dispatch_mha(q, k, v, bool(causal))
 

@@ -134,6 +134,14 @@ class TestAutotune:
             "flash_fwd", "B4_Sq512_Sk512_H16_D64_c1_bfloat16") is None
         assert autotune.cached_any_batch(
             "flash_bwd", "B4_Sq1024_Sk1024_H16_D64_c1_bfloat16") is None
+        # a hand-edited empty entry is an explicit opt-out at its exact
+        # key, and never shadows other batches' fallback lookups
+        autotune._CACHE["flash_fwd::B2_Sq1024_Sk1024_H16_D64_c1_bfloat16"] \
+            = []
+        assert autotune.cached_any_batch(
+            "flash_fwd", "B2_Sq1024_Sk1024_H16_D64_c1_bfloat16") is None
+        assert autotune.cached_any_batch(
+            "flash_fwd", "B3_Sq1024_Sk1024_H16_D64_c1_bfloat16") == (512, 256)
 
     def test_disabled_returns_default_without_timing(self, monkeypatch,
                                                      tmp_path):

@@ -258,3 +258,21 @@ class TestKillSwitchGates:
         assert fa._tuned_blocks_bwd(q, q, True) == (256, 256)
         monkeypatch.setenv("PADDLE_TPU_FLASH_BLOCK_BWD_K", "128")
         assert fa._tuned_blocks_bwd(q, q, True) is None
+
+    def test_attn_impl_selector(self, monkeypatch):
+        import jax
+        from paddle_tpu.kernels import flash_attention as fa
+        calls = []
+        monkeypatch.setattr(fa, "_jax_flash_mha",
+                            lambda q, k, v, c: calls.append("jax") or v)
+        monkeypatch.setattr(fa, "_flash_mha",
+                            lambda q, k, v, c: calls.append("own") or v)
+        q = jnp.zeros((1, 8, 2, 4), jnp.float32)
+        fa._dispatch_mha(q, q, q, True)
+        assert calls == ["own"]          # default impl
+        monkeypatch.setenv("PADDLE_TPU_ATTN_IMPL", "jax_flash")
+        fa._dispatch_mha(q, q, q, True)
+        # CPU backend: upstream TPU kernel must NOT be selected
+        expected = "jax" if jax.default_backend() in ("tpu", "axon") \
+            else "own"
+        assert calls[-1] == expected

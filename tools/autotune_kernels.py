@@ -43,7 +43,8 @@ def emit(rec):
     print(json.dumps(rec), flush=True)
 
 
-from bench_util import force as _force, timeit  # noqa: E402
+from bench_util import (chained_ms, force as _force,  # noqa: E402
+                        mix_grads, timeit)
 
 
 def _update_cache(key, value):
@@ -69,10 +70,14 @@ def sweep_flash_fwd():
     cands = [(bq, bk) for bq in (128, 256, 512) for bk in (128, 256, 512)]
     best = None
     for bq, bk in cands:
-        f = jax.jit(functools.partial(mha_fwd, causal=True, block_q=bq,
-                                      block_k=bk))
         try:
-            ms = timeit(lambda: f(q, k, v)[0], iters=20)
+            # chained: kernel-sized work per dispatch sits far below the
+            # tunnel RTT, so per-call timing measures the tunnel (the
+            # first run of this sweep ranked candidates by RTT noise)
+            ms = chained_ms(
+                lambda qc: mha_fwd(qc, k, v, causal=True, block_q=bq,
+                                   block_k=bk)[0].astype(q.dtype),
+                q, length=32, iters=3)
         except Exception as e:
             emit({"kernel": "flash_fwd", "block_q": bq, "block_k": bk,
                   "error": repr(e)[:160]})
@@ -105,10 +110,12 @@ def sweep_flash_bwd():
              (128, 512), (256, 512), (512, 256), (512, 512)]
     best = None
     for bq, bk in cands:
-        f = jax.jit(functools.partial(mha_bwd, causal=True, block_q=bq,
-                                      block_k=bk))
         try:
-            ms = timeit(lambda: f(q, k, v, out, lse, do), iters=10)
+            ms = chained_ms(
+                lambda d: mix_grads(
+                    mha_bwd(q, k, v, out, lse, d, causal=True,
+                            block_q=bq, block_k=bk), do.dtype),
+                do, length=32, iters=3)
         except Exception as e:
             emit({"kernel": "flash_bwd", "block_q": bq, "block_k": bk,
                   "error": repr(e)[:160]})
@@ -119,8 +126,10 @@ def sweep_flash_bwd():
             best = (ms, bq, bk)
     # the jax-level recompute backward, same quantities, for the A/B
     from paddle_tpu.kernels.flash_attention import _flash_bwd
-    g = jax.jit(functools.partial(_flash_bwd, causal=True))
-    ms = timeit(lambda: g(q, k, v, out, lse, do), iters=10)
+    ms = chained_ms(
+        lambda d: mix_grads(
+            _flash_bwd(q, k, v, out, lse, d, causal=True), do.dtype),
+        do, length=32, iters=3)
     emit({"kernel": "flash_bwd_jaxlevel", "ms": round(ms, 3)})
     if best:
         sig = f"B{B}_Sq{S}_Sk{S}_H{H}_D{D}_c1_bfloat16"
@@ -139,19 +148,20 @@ def sweep_ce():
     cands = [(bt, bv) for bt in (128, 256) for bv in (512, 1024, 2048)]
     best = None
     for bt, bv in cands:
+        def fwd_bwd(xc, bt=bt, bv=bv):
+            # one application = fwd + bwd; dx has x's shape so it can
+            # carry the chain (ranking uses the fwd+bwd total anyway)
+            _, lse = _ce_fwd(xc, tgt, block_t=bt, block_v=bv)
+            return _ce_bwd(xc, tgt, lse, g, block_t=bt,
+                           block_v=bv).astype(x.dtype)
         try:
-            f = functools.partial(_ce_fwd, block_t=bt, block_v=bv)
-            ms_f = timeit(lambda: f(x, tgt)[0], iters=10)
-            loss, lse = f(x, tgt)
-            bw = functools.partial(_ce_bwd, block_t=bt, block_v=bv)
-            ms_b = timeit(lambda: bw(x, tgt, lse, g), iters=10)
+            tot = chained_ms(fwd_bwd, x, length=16, iters=3)
         except Exception as e:
             emit({"kernel": "ce", "block_t": bt, "block_v": bv,
                   "error": repr(e)[:160]})
             continue
         emit({"kernel": "ce", "block_t": bt, "block_v": bv,
-              "fwd_ms": round(ms_f, 3), "bwd_ms": round(ms_b, 3)})
-        tot = ms_f + ms_b
+              "fwd_bwd_ms": round(tot, 3)})
         if best is None or tot < best[0]:
             best = (tot, bt, bv)
     if best:

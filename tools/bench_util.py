@@ -19,7 +19,13 @@ def force(out):
 
 
 def timeit(fn, *args, iters=10, warmup=1):
-    """Steady-state ms per call of fn(*args)."""
+    """Steady-state ms per call of fn(*args).
+
+    ONLY sound when one call's device time well exceeds the tunnel's
+    per-dispatch RTT (~70-170 ms) — i.e. model-step-sized work. For
+    kernel-sized work use chained_ms: the round-4 ablate/autotune calib
+    rows measured the tunnel with this helper (e.g. 2.9 TF/s for a bf16
+    matmul chain the model path drives at ~40 TF/s)."""
     for _ in range(warmup):
         force(fn(*args))
     t0 = time.perf_counter()
@@ -27,3 +33,30 @@ def timeit(fn, *args, iters=10, warmup=1):
         out = fn(*args)
     force(out)
     return (time.perf_counter() - t0) / iters * 1e3
+
+
+def mix_grads(grads, dtype):
+    """Fold a (dq, dk, dv) triple into one dq-shaped carry for
+    chained_ms. Summing all three defeats jaxpr DCE — a dq-only carry
+    lets the dkv kernel (a separate pallas_call / scan) be dropped from
+    the timed chain. Assumes Sq == Skv so the shapes line up."""
+    dq, dk, dv = grads
+    return (dq + 1e-3 * dk + 1e-3 * dv).astype(dtype)
+
+
+def chained_ms(step, carry, length=64, iters=3):
+    """ms per application of `step`, amortizing dispatch latency.
+
+    Runs `length` applications inside ONE jit as a lax.scan whose carry
+    is the step's own output (data dependence defeats CSE), so per-call
+    device time is length x kernel-time >> tunnel RTT; `iters` outer
+    calls then pipeline like the model-step benches. `step` must map
+    carry -> same shape/dtype carry."""
+    run = jax.jit(lambda c: jax.lax.scan(
+        lambda c, _: (step(c), None), c, None, length=length)[0])
+    force(run(carry))                      # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        carry = run(carry)
+    force(carry)
+    return (time.perf_counter() - t0) / (iters * length) * 1e3

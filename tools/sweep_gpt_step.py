@@ -30,6 +30,13 @@ VARIANTS = [
     # fwd 512/256 measured 3.4x faster than the old 128/128; bwd 128/128).
     # Explicit FLASH_BLOCK env settings outrank the autotune cache, so
     # these tuples really do control every variant.
+    # upstream jax.experimental TPU flash kernel (own tuned fwd+bwd):
+    # the homegrown kernel measured ~6 TF/s effective in the ablation —
+    # if the upstream kernel wins, it becomes the default impl
+    ("jaxflash-dotsflash-b8", True, "dots_flash", (512, 256, 128, 128),
+     {"PADDLE_TPU_ATTN_IMPL": "jax_flash"}),
+    ("jaxflash-noremat-b4", False, "dots", (512, 256, 128, 128),
+     {"PADDLE_TPU_ATTN_IMPL": "jax_flash"}, 4),
     ("noremat-b4", False, "dots", (512, 256, 128, 128), JAXBWD, 4),
     ("noremat-xlaattn-b4", False, "dots", (512, 256, 128, 128),
      XLA_ATTN, 4),
@@ -95,13 +102,15 @@ def run_one(spec: dict) -> None:
 
 def main() -> None:
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # feeds only the CE kernel's block lookup — every variant pins the
+    # four FLASH_BLOCK vars, which outrank the cache
+    cache = os.path.join(here, "perf", "autotune.json")
     results = []
     for name, remat, policy, (bq, bk, bwq, bwk), extra, *rest in VARIANTS:
         spec = {"name": name, "remat": remat, "policy": policy}
         if rest:
             spec["batch"] = rest[0]
         env = dict(os.environ)
-        cache = os.path.join(here, "perf", "autotune.json")
         if os.path.exists(cache):
             env.setdefault("PADDLE_TPU_AUTOTUNE_CACHE", cache)
         env.update({

@@ -58,6 +58,13 @@ JOBS = [
      [sys.executable, "tools/bench_ladder.py", "--run", "ernie_vil"],
      1500, {}),
     ("int8_micro", [sys.executable, "tools/bench_int8.py"], 1200, {}),
+    # phase 2 (run with --jobs ablate2 after the first queue drains):
+    # re-measure the calib + attention micro rows with chained timing
+    # (the first run's per-call numbers measured the tunnel RTT), plus
+    # the new segment rows and the upstream-kernel A/B
+    ("ablate2",
+     [sys.executable, "tools/ablate_step.py", "calib", "calib_attn",
+      "no_ln", "no_mlp", "jaxflash"], 3600, {}),
 ]
 
 
