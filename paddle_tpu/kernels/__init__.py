@@ -1,3 +1,5 @@
 """Pallas TPU kernels for the hot fused ops (the reference's
-paddle/fluid/operators/fused/ zoo, rebuilt as TPU kernels)."""
-from . import flash_attention  # noqa: F401
+paddle/fluid/operators/fused/ zoo, rebuilt as TPU kernels) plus the
+kernel-primitive library (the reference's KPS layer,
+paddle/phi/kernels/primitive/kernel_primitives.h) they are built from."""
+from . import flash_attention, primitives  # noqa: F401

@@ -21,23 +21,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-_NEG_INF = -1e30
-
-
-def _env_block(name: str, default: int) -> int:
-    """Block-size override hook (PADDLE_TPU_FLASH_BLOCK_Q/K) so the offline
-    sweep (tools/sweep_gpt_step.py) can tune without code edits; the shipped
-    defaults are the sweep winners for the bench shapes. Must be resolved
-    OUTSIDE the jitted kernels: the jit cache keys on the resolved ints, so
-    reading env inside the trace would freeze the first-seen value."""
-    import os
-    try:
-        return int(os.environ.get(name, default))
-    except ValueError:
-        return default
-# lse is a scalar per q row; store it 8 lanes wide (min f32 sublane tile is
-# (8,128) in VMEM regardless, but HBM traffic/storage shrink 16x vs 128 lanes)
-_LSE_LANES = 8
+from .primitives import (NEG_INF as _NEG_INF,
+                         ROW_SCALAR_LANES, bounds_mask, causal_block_live,
+                         causal_mask, env_block as _env_block,
+                         logsumexp_finalize, online_softmax_update,
+                         pad_to, softmax_finalize, tile_positions)
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
@@ -61,22 +49,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         k = k_ref[0]                                        # (BK, D)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        kpos = j * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        valid = kpos < kv_len
+        kpos = tile_positions(j, block_k, (block_q, block_k), 1)
+        valid = bounds_mask(kpos, kv_len)
         if causal:
-            qpos = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            valid = jnp.logical_and(valid, qpos >= kpos)
+            qpos = tile_positions(i, block_q, (block_q, block_k), 0)
+            valid = jnp.logical_and(valid, causal_mask(qpos, kpos))
         s = jnp.where(valid, s, _NEG_INF)
 
-        m_prev = m_ref[:, :1]                               # (BQ, 1)
-        l_prev = l_ref[:, :1]
-        m_cur = jnp.max(s, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)
-        corr = jnp.exp(m_prev - m_new)
-        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        m_new, l_new, p, corr = online_softmax_update(
+            m_ref[:, :1], l_ref[:, :1], s)
         acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
             p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -85,7 +66,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
 
     if causal:
         # skip fully-masked kv blocks (upper-triangular block region)
-        @pl.when(j * block_k <= i * block_q + block_q - 1)
+        @pl.when(causal_block_live(i, j, block_q, block_k))
         def _():
             _body()
     else:
@@ -93,22 +74,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
 
     @pl.when(j == nkv - 1)
     def _finalize():
-        l = jnp.maximum(l_ref[:, :1], 1e-30)
-        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
-        lse = m_ref[:, :1] + jnp.log(jnp.maximum(l_ref[:, :1], 1e-30))
+        o_ref[0] = softmax_finalize(acc_ref[...],
+                                    l_ref[:, :1]).astype(o_ref.dtype)
+        lse = logsumexp_finalize(m_ref[:, :1], l_ref[:, :1])
         lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
-def _pad_to(x, axis, mult, value=0):
-    """Pad `axis` up to a multiple of `mult` (shared tile-padding helper
-    for the Pallas kernel family — pallas_ce imports it too)."""
-    size = x.shape[axis]
-    pad = (-size) % mult
-    if pad == 0:
-        return x
-    widths = [(0, 0)] * x.ndim
-    widths[axis] = (0, pad)
-    return jnp.pad(x, widths, constant_values=value)
 
 
 def mha_fwd(q, k, v, causal=False, block_q=None, block_k=None,
@@ -136,9 +107,9 @@ def _mha_fwd_jit(q, k, v, causal, block_q, block_k, interpret, kv_len):
     # 128-aligned blocks: sublane/lane tiling is always legal and the
     # padding below absorbs any sequence length
     bq, bk = block_q, block_k
-    q2 = _pad_to(jnp.swapaxes(q, 1, 2).reshape(B * H, Sq, D), 1, bq)
-    k2 = _pad_to(jnp.swapaxes(k, 1, 2).reshape(B * H, Skv, D), 1, bk)
-    v2 = _pad_to(jnp.swapaxes(v, 1, 2).reshape(B * H, Skv, D), 1, bk)
+    q2 = pad_to(jnp.swapaxes(q, 1, 2).reshape(B * H, Sq, D), 1, bq)
+    k2 = pad_to(jnp.swapaxes(k, 1, 2).reshape(B * H, Skv, D), 1, bk)
+    v2 = pad_to(jnp.swapaxes(v, 1, 2).reshape(B * H, Skv, D), 1, bk)
     Sqp, Skp = q2.shape[1], k2.shape[1]
     grid = (B * H, Sqp // bq, Skp // bk)
 
@@ -155,11 +126,11 @@ def _mha_fwd_jit(q, k, v, causal, block_q, block_k, interpret, kv_len):
         ],
         out_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq, _LSE_LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, ROW_SCALAR_LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, Sqp, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, Sqp, _LSE_LANES), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, Sqp, ROW_SCALAR_LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, D), jnp.float32),     # acc
@@ -188,13 +159,11 @@ def mha(q, k, v, causal=False, interpret=False):
 # level (one fused elementwise pass).
 
 def _mask_p(p, i, j, block_q, block_k, kv_len, causal):
-    kpos = j * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, p.shape, 1)
-    valid = kpos < kv_len
+    kpos = tile_positions(j, block_k, p.shape, 1)
+    valid = bounds_mask(kpos, kv_len)
     if causal:
-        qpos = i * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, p.shape, 0)
-        valid = jnp.logical_and(valid, qpos >= kpos)
+        qpos = tile_positions(i, block_q, p.shape, 0)
+        valid = jnp.logical_and(valid, causal_mask(qpos, kpos))
     return jnp.where(valid, p, 0.0)
 
 
@@ -226,7 +195,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             preferred_element_type=jnp.float32)
 
     if causal:
-        @pl.when(j * block_k <= i * block_q + block_q - 1)
+        @pl.when(causal_block_live(i, j, block_q, block_k))
         def _():
             _body()
     else:
@@ -270,7 +239,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)
 
     if causal:
-        @pl.when(i * block_q + block_q - 1 >= j * block_k)
+        @pl.when(causal_block_live(i, j, block_q, block_k))
         def _():
             _body()
     else:
@@ -304,23 +273,23 @@ def _mha_bwd_jit(q, k, v, out, lse, do, causal, block_q, block_k,
     scale = 1.0 / math.sqrt(D)
     bq, bk = block_q, block_k
 
-    q2 = _pad_to(jnp.swapaxes(q, 1, 2).reshape(B * H, Sq, D), 1, bq)
-    do2 = _pad_to(jnp.swapaxes(do, 1, 2).reshape(B * H, Sq, D), 1, bq)
-    k2 = _pad_to(jnp.swapaxes(k, 1, 2).reshape(B * H, Skv, D), 1, bk)
-    v2 = _pad_to(jnp.swapaxes(v, 1, 2).reshape(B * H, Skv, D), 1, bk)
+    q2 = pad_to(jnp.swapaxes(q, 1, 2).reshape(B * H, Sq, D), 1, bq)
+    do2 = pad_to(jnp.swapaxes(do, 1, 2).reshape(B * H, Sq, D), 1, bq)
+    k2 = pad_to(jnp.swapaxes(k, 1, 2).reshape(B * H, Skv, D), 1, bk)
+    v2 = pad_to(jnp.swapaxes(v, 1, 2).reshape(B * H, Skv, D), 1, bk)
     # delta = rowsum(do ⊙ out): one fused elementwise+reduce pass in XLA
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), -1)
     delta = jnp.swapaxes(delta, 1, 2).reshape(B * H, Sq)  # via [B,S,H]->[B,H,S]
     # lse pad must kill padded q rows' p (exp(s - BIG) = 0) so they don't
     # pollute dk/dv; delta pad value is then irrelevant (ds = p * (...) = 0)
-    lse2 = _pad_to(lse.reshape(B * H, Sq, 1), 1, bq)
+    lse2 = pad_to(lse.reshape(B * H, Sq, 1), 1, bq)
     lse2 = jnp.where(
         jax.lax.broadcasted_iota(jnp.int32, lse2.shape, 1) < Sq,
         lse2, jnp.float32(1e30))
-    lse2 = jnp.broadcast_to(lse2, (B * H, lse2.shape[1], _LSE_LANES))
+    lse2 = jnp.broadcast_to(lse2, (B * H, lse2.shape[1], ROW_SCALAR_LANES))
     delta2 = jnp.broadcast_to(
-        _pad_to(delta.reshape(B * H, Sq, 1), 1, bq),
-        (B * H, lse2.shape[1], _LSE_LANES))
+        pad_to(delta.reshape(B * H, Sq, 1), 1, bq),
+        (B * H, lse2.shape[1], ROW_SCALAR_LANES))
 
     Sqp, Skp = q2.shape[1], k2.shape[1]
     klen = Skv if kv_len is None else min(int(kv_len), Skv)
@@ -336,7 +305,7 @@ def _mha_bwd_jit(q, k, v, out, lse, do, causal, block_q, block_k,
         return pl.BlockSpec((1, bk, D), ix)
 
     def _lspec(ix):
-        return pl.BlockSpec((1, bq, _LSE_LANES), ix)
+        return pl.BlockSpec((1, bq, ROW_SCALAR_LANES), ix)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, **common),

@@ -25,10 +25,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .pallas_attention import _pad_to as _pad_dim  # shared tile padding
-
-_NEG_INF = -1e30
-_LANES = 8      # per-row scalars stored 8 lanes wide (min f32 tile)
+from .primitives import (NEG_INF as _NEG_INF, ROW_SCALAR_LANES as _LANES,
+                         bounds_mask, logsumexp_finalize,
+                         online_softmax_update, pad_to as _pad_dim,
+                         tile_positions)
 
 
 def _fwd_kernel(x_ref, tgt_ref, loss_ref, lse_ref, m_ref, l_ref, t_ref,
@@ -43,16 +43,11 @@ def _fwd_kernel(x_ref, tgt_ref, loss_ref, lse_ref, m_ref, l_ref, t_ref,
         t_ref[...] = jnp.zeros_like(t_ref)
 
     s = x_ref[...].astype(jnp.float32)                    # (BT, BV)
-    vpos = j * block_v + jax.lax.broadcasted_iota(
-        jnp.int32, (block_t, block_v), 1)
-    s = jnp.where(vpos < n_valid_v, s, _NEG_INF)          # pad tiles
+    vpos = tile_positions(j, block_v, (block_t, block_v), 1)
+    s = jnp.where(bounds_mask(vpos, n_valid_v), s, _NEG_INF)  # pad tiles
 
-    m_prev = m_ref[:, :1]
-    m_cur = jnp.max(s, axis=-1, keepdims=True)
-    m_new = jnp.maximum(m_prev, m_cur)
-    corr = jnp.exp(m_prev - m_new)
-    l_new = l_ref[:, :1] * corr + jnp.sum(jnp.exp(s - m_new), axis=-1,
-                                          keepdims=True)
+    m_new, l_new, _p, _corr = online_softmax_update(
+        m_ref[:, :1], l_ref[:, :1], s)
     m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
     l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
@@ -65,7 +60,7 @@ def _fwd_kernel(x_ref, tgt_ref, loss_ref, lse_ref, m_ref, l_ref, t_ref,
 
     @pl.when(j == nv - 1)
     def _finalize():
-        lse = m_ref[:, :1] + jnp.log(jnp.maximum(l_ref[:, :1], 1e-30))
+        lse = logsumexp_finalize(m_ref[:, :1], l_ref[:, :1])
         loss_ref[...] = jnp.broadcast_to(lse - t_ref[:, :1],
                                          loss_ref.shape)
         lse_ref[...] = jnp.broadcast_to(lse, lse_ref.shape)
@@ -75,10 +70,9 @@ def _bwd_kernel(x_ref, tgt_ref, lse_ref, g_ref, dx_ref,
                 *, block_t, block_v, n_valid_v):
     j = pl.program_id(1)
     s = x_ref[...].astype(jnp.float32)
-    vpos = j * block_v + jax.lax.broadcasted_iota(
-        jnp.int32, (block_t, block_v), 1)
+    vpos = tile_positions(j, block_v, (block_t, block_v), 1)
     p = jnp.exp(s - lse_ref[:, :1])
-    p = jnp.where(vpos < n_valid_v, p, 0.0)
+    p = jnp.where(bounds_mask(vpos, n_valid_v), p, 0.0)
     onehot = (vpos == tgt_ref[:, :1]).astype(jnp.float32)
     dx_ref[...] = ((p - onehot) * g_ref[:, :1]).astype(dx_ref.dtype)
 

@@ -1,0 +1,128 @@
+"""Kernel primitives: the reusable block-level pieces TPU Pallas kernels
+are assembled from.
+
+Reference analog: the KPS layer (Kernel Primitive API) at
+paddle/phi/kernels/primitive/kernel_primitives.h — portable block-level
+compute primitives (ElementwiseUnary/Binary, Reduce) and data movers
+(ReadData/WriteData with boundary handling) that the reference's CUDA
+kernels are written against, so kernel bodies express algorithms, not
+addressing. The TPU translation: Pallas refs already own data movement,
+so the primitives here are the recurring *algorithmic* building blocks —
+grid/tile arithmetic, boundary + causal masks over block-local iota, the
+online-softmax/log-sum-exp update, per-row scalar storage conventions —
+shared by the production kernels (pallas_attention, pallas_ce) and
+importable by custom-op authors as paddle_tpu.kernels.primitives.
+
+Everything is a pure jax function usable BOTH inside a Pallas kernel
+body (on values read from refs) and in jax-level blockwise fallbacks.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+# Large-negative float used for masked scores: exp(_NEG_INF - m) == 0 in
+# f32 without the NaN hazards of -inf arithmetic inside kernels.
+NEG_INF = -1e30
+
+# Per-row scalars (lse, loss, running max) are stored this many lanes
+# wide: the minimum f32 VMEM tile is (8, 128) sublanes x lanes, so lane
+# widths below 128 don't shrink VMEM, but HBM traffic/storage for the
+# materialized output shrinks 16x vs broadcasting to a full 128 lanes.
+ROW_SCALAR_LANES = 8
+
+
+# ------------------------------------------------------------ tile math
+def cdiv(a: int, b: int) -> int:
+    """Ceil division for grid sizing."""
+    return -(-a // b)
+
+
+def round_up(a: int, b: int) -> int:
+    """Round `a` up to a multiple of `b`."""
+    return cdiv(a, b) * b
+
+
+def pad_to(x, axis: int, mult: int, value=0):
+    """Pad `axis` up to a multiple of `mult` (the KPS ReadData boundary
+    analog: kernels then run on full tiles and slice the tail off after
+    the pallas_call instead of branching per element)."""
+    size = x.shape[axis]
+    pad = (-size) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths, constant_values=value)
+
+
+def env_block(name: str, default: int) -> int:
+    """Block-size override hook (PADDLE_TPU_FLASH_BLOCK_*, ...) so the
+    offline sweeps can tune without code edits. Must be resolved OUTSIDE
+    the jitted kernels: the jit cache keys on the resolved ints, so
+    reading env inside a trace would freeze the first-seen value."""
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+# ------------------------------------------------------- block positions
+def tile_positions(block_idx, block_size: int, shape, dim: int):
+    """Global positions of one tile's elements along `dim`: an int32
+    tensor of `shape` whose entries are block_idx*block_size + local
+    offset. The building block for every boundary/causal/gather mask."""
+    return block_idx * block_size + jax.lax.broadcasted_iota(
+        jnp.int32, shape, dim)
+
+
+def bounds_mask(positions, limit):
+    """True where a global position is in-range (KPS boundary handling:
+    applied to scores/probabilities instead of predicating loads)."""
+    return positions < limit
+
+
+def causal_mask(q_positions, k_positions):
+    """True where attention is allowed (query position >= key position)."""
+    return q_positions >= k_positions
+
+
+def causal_block_live(i, j, block_q: int, block_k: int):
+    """Whether kv block j overlaps the causal region of q block i at all
+    — the grid-level skip that removes the upper-triangular half of the
+    flash-attention work."""
+    return j * block_k <= i * block_q + block_q - 1
+
+
+# --------------------------------------------------------- online softmax
+def online_softmax_update(m_prev, l_prev, s):
+    """One streaming-softmax state update over a new score tile `s`
+    ([rows, block] f32; masked entries at NEG_INF).
+
+    Returns (m_new, l_new, p, corr):
+      m_new  [rows,1] running max
+      l_new  [rows,1] running normalizer (corrected + this tile's sum)
+      p      [rows,block] this tile's unnormalized probabilities
+      corr   [rows,1] factor that rescales any accumulator built under
+             m_prev (acc = acc*corr + p @ v is the flash-attention use;
+             cross-entropy has no accumulator and ignores it).
+    """
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    p = jnp.exp(s - m_new)
+    corr = jnp.exp(m_prev - m_new)
+    l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+    return m_new, l_new, p, corr
+
+
+def logsumexp_finalize(m, l):
+    """Final log-normalizer from streamed (m, l) state; the 1e-30 floor
+    keeps fully-masked rows finite (they produce lse = m - 69)."""
+    return m + jnp.log(jnp.maximum(l, 1e-30))
+
+
+def softmax_finalize(acc, l):
+    """Normalize a p@v-style accumulator by the streamed l."""
+    return acc / jnp.maximum(l, 1e-30)

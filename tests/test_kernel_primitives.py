@@ -1,0 +1,101 @@
+"""Kernel-primitive unit tests (reference KPS analog:
+paddle/phi/kernels/primitive/kernel_primitives.h — here the block-level
+building blocks the Pallas kernels are assembled from, testable as pure
+jax functions on CPU)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.kernels import primitives as kp
+
+
+class TestTileMath:
+    def test_cdiv_round_up(self):
+        assert kp.cdiv(1024, 128) == 8
+        assert kp.cdiv(1025, 128) == 9
+        assert kp.round_up(1025, 128) == 1152
+        assert kp.round_up(1024, 128) == 1024
+
+    @pytest.mark.parametrize("size,mult", [(100, 128), (128, 128),
+                                           (300, 128)])
+    def test_pad_to(self, size, mult):
+        x = jnp.arange(size, dtype=jnp.float32)[None, :].repeat(2, 0)
+        p = kp.pad_to(x, 1, mult, value=-1.0)
+        assert p.shape[1] == kp.round_up(size, mult)
+        np.testing.assert_array_equal(np.asarray(p[:, :size]),
+                                      np.asarray(x))
+        if p.shape[1] > size:
+            assert float(p[0, size]) == -1.0
+
+    def test_env_block(self, monkeypatch):
+        monkeypatch.setenv("KP_TEST_BLOCK", "256")
+        assert kp.env_block("KP_TEST_BLOCK", 128) == 256
+        monkeypatch.setenv("KP_TEST_BLOCK", "junk")
+        assert kp.env_block("KP_TEST_BLOCK", 128) == 128
+        monkeypatch.delenv("KP_TEST_BLOCK")
+        assert kp.env_block("KP_TEST_BLOCK", 64) == 64
+
+
+class TestMasks:
+    def test_tile_positions(self):
+        pos = kp.tile_positions(3, 128, (4, 128), 1)
+        assert pos.shape == (4, 128)
+        assert int(pos[0, 0]) == 384 and int(pos[0, 127]) == 511
+        assert int(pos[3, 0]) == 384          # constant along dim 0
+
+    def test_bounds_and_causal_masks_match_dense(self):
+        bq = bk = 4
+        i, j = 1, 1
+        qpos = kp.tile_positions(i, bq, (bq, bk), 0)
+        kpos = kp.tile_positions(j, bk, (bq, bk), 1)
+        valid = np.asarray(
+            jnp.logical_and(kp.bounds_mask(kpos, 7),
+                            kp.causal_mask(qpos, kpos)))
+        for r in range(bq):
+            for c in range(bk):
+                qg, kg = i * bq + r, j * bk + c
+                assert valid[r, c] == (kg < 7 and qg >= kg)
+
+    def test_causal_block_live_covers_exactly_lower_blocks(self):
+        bq, bk = 2, 4
+        for i in range(4):
+            for j in range(2):
+                # block (i,j) holds q rows [2i,2i+1], k cols [4j,4j+3]
+                any_live = any(qg >= kg
+                               for qg in range(i * bq, (i + 1) * bq)
+                               for kg in range(j * bk, (j + 1) * bk))
+                assert bool(kp.causal_block_live(i, j, bq, bk)) == any_live
+
+
+class TestOnlineSoftmax:
+    def test_streaming_matches_dense_softmax(self):
+        rng = np.random.RandomState(0)
+        s_full = jnp.asarray(rng.randn(8, 512).astype(np.float32))
+        m = jnp.full((8, 1), kp.NEG_INF)
+        l = jnp.zeros((8, 1))
+        acc = jnp.zeros((8, 16))
+        v_full = jnp.asarray(rng.randn(512, 16).astype(np.float32))
+        for blk in range(4):
+            s = s_full[:, blk * 128:(blk + 1) * 128]
+            v = v_full[blk * 128:(blk + 1) * 128]
+            m, l, p, corr = kp.online_softmax_update(m, l, s)
+            acc = acc * corr + p @ v
+        out = kp.softmax_finalize(acc, l)
+        want = jax.nn.softmax(s_full, -1) @ v_full
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+        lse = kp.logsumexp_finalize(m, l)
+        want_lse = jax.scipy.special.logsumexp(s_full, -1, keepdims=True)
+        np.testing.assert_allclose(np.asarray(lse), np.asarray(want_lse),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_masked_rows_stay_finite(self):
+        m = jnp.full((2, 1), kp.NEG_INF)
+        l = jnp.zeros((2, 1))
+        s = jnp.full((2, 64), kp.NEG_INF)      # fully masked tile
+        m, l, p, corr = kp.online_softmax_update(m, l, s)
+        lse = kp.logsumexp_finalize(m, l)
+        assert np.all(np.isfinite(np.asarray(lse)))
+        out = kp.softmax_finalize(jnp.zeros((2, 4)), l)
+        assert np.all(np.isfinite(np.asarray(out)))
