@@ -147,8 +147,8 @@ def run_measurement(rung: str) -> None:
         return dt, n_params
 
     # variant race: the rung's OWN config is the baseline; TPU remat
-    # rungs additionally race the round-4 ablation winners (no-remat at
-    # reduced batch, XLA attention, dots_flash — one extra compile each)
+    # rungs additionally race the round-4 candidates (attention impls x
+    # remat policy, no-remat at reduced batch — one extra compile each)
     # and keep whichever has the best TOKEN THROUGHPUT on THIS chip/day.
     # Every variant runs the full iteration count — per-call steps enqueue
     # asynchronously and only the final float(loss) syncs, so the
@@ -160,15 +160,19 @@ def run_measurement(rung: str) -> None:
             and kw.get("remat_policy") == "dots"
             and os.environ.get("PADDLE_TPU_BENCH_NO_RACE") != "1"):
         # Race set follows the round-4 TPU ablation matrix
-        # (perf/window_*/ablate.out): the XLA attention path beat the
-        # Pallas flash forward in the full step, and no-remat at reduced
-        # batch beat every remat variant per-token (OOMs above ~B=4-6, so
-        # raced at B=4 — throughput, not step time, decides the winner).
-        xla_attn = {"PADDLE_TPU_DISABLE_PALLAS_ATTN": "1"}
-        variants.append((dict(remat=False), 4, xla_attn))
-        variants.append((dict(remat=False), 4, {}))
-        variants.append((dict(), None, xla_attn))
+        # (perf/window_*/ablate.out): attention is ~66% of the step, so
+        # the candidates vary the attention impl (upstream splash /
+        # jax_flash kernels vs the homegrown Pallas one) and the remat
+        # policy, plus no-remat at reduced batch (beat every remat
+        # variant per-token; OOMs above ~B=4-6). Throughput, not step
+        # time, decides the winner across batches.
+        splash = {"PADDLE_TPU_ATTN_IMPL": "splash"}
+        jaxflash = {"PADDLE_TPU_ATTN_IMPL": "jax_flash"}
+        variants.append((dict(remat_policy="dots_flash"), None, splash))
+        variants.append((dict(remat_policy="dots_flash"), None, jaxflash))
         variants.append((dict(remat_policy="dots_flash"), None, {}))
+        variants.append((dict(remat=False), 4, splash))
+        variants.append((dict(remat=False), 4, {}))
 
     def emit(dt, cfg, n_params, vkw, vbatch):
         tps = vbatch * seq / dt

@@ -331,6 +331,8 @@ def _attn_impl() -> str:
     - 'pallas'   homegrown kernel + the gates above (default)
     - 'jax_flash' jax.experimental.pallas.ops.tpu.flash_attention — the
       upstream-tuned TPU kernel with its own fwd+bwd Pallas passes
+    - 'splash'   jax.experimental splash attention (block-sparse mask
+      pipeline; usually the fastest causal kernel)
     - 'xla'      the blockwise lax.scan path (same as the ATTN kill)
     Re-read per trace like the kill switches."""
     import os
@@ -349,13 +351,38 @@ def _jax_flash_mha(q, k, v, causal):
     return jnp.swapaxes(out, 1, 2)
 
 
+@functools.lru_cache(maxsize=16)
+def _splash_kernel(num_heads, seq_q, seq_k, causal, interpret=False):
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as sk, splash_attention_mask as sm)
+    mk = (sm.CausalMask if causal else sm.FullMask)
+    mask = sm.MultiHeadMask(
+        [mk((seq_q, seq_k)) for _ in range(num_heads)])
+    return sk.make_splash_mha_single_device(mask=mask, interpret=interpret)
+
+
+def _splash_mha(q, k, v, causal, interpret=False):
+    """The upstream splash-attention kernel: block-sparse mask pipeline
+    that skips masked tiles at the grid level (newer than flash_attention
+    and usually faster on long causal sequences). Single-device form,
+    vmapped over batch; q is pre-scaled (splash has no sm_scale)."""
+    B, S, H, D = q.shape
+    kernel = _splash_kernel(H, S, k.shape[1], causal, interpret)
+    scaled_q = jnp.swapaxes(q, 1, 2) * (1.0 / math.sqrt(D))
+    out = jax.vmap(kernel)(scaled_q, jnp.swapaxes(k, 1, 2),
+                           jnp.swapaxes(v, 1, 2))
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
 def _dispatch_mha(q, k, v, causal):
     # the upstream kernel is still Pallas: the global and attention kill
     # switches outrank the impl selector, preserving the documented
     # global > attention-only > impl layering
-    if (_attn_impl() == "jax_flash" and _pallas_attn_enabled()
+    impl = _attn_impl()
+    if (impl in ("jax_flash", "splash") and _pallas_attn_enabled()
             and jax.default_backend() in ("tpu", "axon")):
-        return _jax_flash_mha(q, k, v, causal)
+        fn = _splash_mha if impl == "splash" else _jax_flash_mha
+        return fn(q, k, v, causal)
     # 'xla' needs no branch here: _pallas_attn_enabled() reads the impl
     # and routes _flash_mha onto the blockwise fwd + jax-level bwd
     return _flash_mha(q, k, v, causal)

@@ -276,3 +276,16 @@ class TestKillSwitchGates:
         expected = "jax" if jax.default_backend() in ("tpu", "axon") \
             else "own"
         assert calls[-1] == expected
+
+
+class TestUpstreamImpls:
+    """PADDLE_TPU_ATTN_IMPL backends (upstream jax.experimental kernels)
+    against the dense oracle, interpret mode on CPU."""
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_splash_matches_dense(self, causal):
+        from paddle_tpu.kernels import flash_attention as fa
+        q, k, v = _rand_qkv(B=2, S=256, H=4, D=64)
+        got = np.asarray(fa._splash_mha(q, k, v, causal, interpret=True))
+        want = np.asarray(fa._dense_reference(q, k, v, causal))
+        np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)

@@ -121,21 +121,15 @@ def calib_attention():
     def loss_pallas(q, k, v):
         return jnp.sum(fa._flash_mha(q, k, v, True).astype(jnp.float32))
 
-    flag = "PADDLE_TPU_DISABLE_PALLAS_BWD"
-    prior = os.environ.get(flag)
-    try:
-        os.environ[flag] = "1"
-        emit("attn_fwd_jaxbwd",
-             chained_ms(grad_q(loss_pallas), q, length=16, iters=3))
-        os.environ[flag] = "0"
-        emit("attn_fwd_pallasbwd",
-             chained_ms(grad_q(lambda q, k, v: loss_pallas(q, k, v) * 1.0),
-                        q, length=16, iters=3))
-    finally:
-        if prior is None:
-            os.environ.pop(flag, None)
-        else:
-            os.environ[flag] = prior
+    # main() snapshots/restores the whole env around each variant, so
+    # plain sets are safe here
+    os.environ["PADDLE_TPU_DISABLE_PALLAS_BWD"] = "1"
+    emit("attn_fwd_jaxbwd",
+         chained_ms(grad_q(loss_pallas), q, length=16, iters=3))
+    os.environ["PADDLE_TPU_DISABLE_PALLAS_BWD"] = "0"
+    emit("attn_fwd_pallasbwd",
+         chained_ms(grad_q(lambda q, k, v: loss_pallas(q, k, v) * 1.0),
+                    q, length=16, iters=3))
 
 
 # ------------------------------------------------------------ step variants
@@ -198,7 +192,6 @@ def v_xla_attn():
     os.environ["PADDLE_TPU_DISABLE_PALLAS"] = "1"
     cfg, p, o, t = build(dict(remat=True, remat_policy="full"))
     emit("xla_attn_b8", step_ms(cfg, p, o, t))
-    os.environ.pop("PADDLE_TPU_DISABLE_PALLAS")
 
 
 def v_no_attn():
@@ -281,32 +274,38 @@ def v_no_mlp():
 
 
 def v_jaxflash():
-    """Upstream jax.experimental TPU flash kernel as the attention impl."""
+    """Upstream jax.experimental TPU flash kernel as the attention impl.
+    Numerics first: the step timing means nothing if the upstream kernel
+    disagrees with the dense oracle on this backend."""
+    _impl_variant("jax_flash", "jaxflash_dotsflash_b8")
+
+
+def _impl_variant(impl, row_name):
+    """Parity-check `impl` against the dense oracle on-device, then time
+    the full step with it (dots_flash remat so the kernel's forward is
+    saved, not recomputed)."""
     from paddle_tpu.kernels import flash_attention as fa
-    # numerics first: the step timing below means nothing if the
-    # upstream kernel disagrees with the dense oracle on this backend
+    fn = {"jax_flash": fa._jax_flash_mha, "splash": fa._splash_mha}[impl]
     ks = jax.random.split(jax.random.PRNGKey(7), 3)
     q = jax.random.normal(ks[0], (2, 512, 4, 64), jnp.bfloat16)
     k = jax.random.normal(ks[1], (2, 512, 4, 64), jnp.bfloat16)
     v = jax.random.normal(ks[2], (2, 512, 4, 64), jnp.bfloat16)
-    got = np.asarray(jax.jit(fa._jax_flash_mha, static_argnums=3)(
-        q, k, v, True), np.float32)
+    got = np.asarray(jax.jit(fn, static_argnums=3)(q, k, v, True),
+                     np.float32)
     want = np.asarray(fa._dense_reference(q, k, v, True), np.float32)
     err = float(np.max(np.abs(got - want)))
     if err > 0.05:
-        emit("jaxflash_parity", -1.0, {"max_abs_err": err})
+        emit(f"{row_name}_parity", -1.0, {"max_abs_err": err})
         return
-    prior = os.environ.get("PADDLE_TPU_ATTN_IMPL")
-    os.environ["PADDLE_TPU_ATTN_IMPL"] = "jax_flash"
-    try:
-        cfg, p, o, t = build(dict(remat=True, remat_policy="dots_flash"))
-        emit("jaxflash_dotsflash_b8", step_ms(cfg, p, o, t),
-             {"parity_max_abs_err": round(err, 5)})
-    finally:
-        if prior is None:
-            os.environ.pop("PADDLE_TPU_ATTN_IMPL", None)
-        else:
-            os.environ["PADDLE_TPU_ATTN_IMPL"] = prior
+    os.environ["PADDLE_TPU_ATTN_IMPL"] = impl
+    cfg, p, o, t = build(dict(remat=True, remat_policy="dots_flash"))
+    emit(row_name, step_ms(cfg, p, o, t),
+         {"parity_max_abs_err": round(err, 5)})
+
+
+def v_splash():
+    """Upstream splash-attention kernel as the attention impl."""
+    _impl_variant("splash", "splash_dotsflash_b8")
 
 
 def v_sgd():
@@ -346,6 +345,7 @@ VARIANTS = {
     "no_ln": v_no_ln,
     "no_mlp": v_no_mlp,
     "jaxflash": v_jaxflash,
+    "splash": v_splash,
 }
 
 
@@ -355,11 +355,18 @@ def main():
     log(f"backend {devs[0].platform} ({devs[0].device_kind})")
     for n in names:
         log(f"=== {n} ===")
+        # whole-environment snapshot: variants may set any kill-switch /
+        # impl env freely and never leak it into the next variant, even
+        # when they raise mid-flight
+        snapshot = dict(os.environ)
         try:
             VARIANTS[n]()
         except Exception as e:
             emit(n, -1.0, {"error": repr(e)[:200]})
             log(f"variant {n} failed: {e!r}")
+        finally:
+            os.environ.clear()
+            os.environ.update(snapshot)
 
 
 if __name__ == "__main__":

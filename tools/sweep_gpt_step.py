@@ -35,8 +35,12 @@ VARIANTS = [
     # if the upstream kernel wins, it becomes the default impl
     ("jaxflash-dotsflash-b8", True, "dots_flash", (512, 256, 128, 128),
      {"PADDLE_TPU_ATTN_IMPL": "jax_flash"}),
+    ("splash-dotsflash-b8", True, "dots_flash", (512, 256, 128, 128),
+     {"PADDLE_TPU_ATTN_IMPL": "splash"}),
     ("jaxflash-noremat-b4", False, "dots", (512, 256, 128, 128),
      {"PADDLE_TPU_ATTN_IMPL": "jax_flash"}, 4),
+    ("splash-noremat-b4", False, "dots", (512, 256, 128, 128),
+     {"PADDLE_TPU_ATTN_IMPL": "splash"}, 4),
     ("noremat-b4", False, "dots", (512, 256, 128, 128), JAXBWD, 4),
     ("noremat-xlaattn-b4", False, "dots", (512, 256, 128, 128),
      XLA_ATTN, 4),

@@ -64,7 +64,7 @@ JOBS = [
     # the new segment rows and the upstream-kernel A/B
     ("ablate2",
      [sys.executable, "tools/ablate_step.py", "calib", "calib_attn",
-      "no_ln", "no_mlp", "jaxflash"], 3600, {}),
+      "no_ln", "no_mlp", "jaxflash", "splash"], 3600, {}),
 ]
 
 
