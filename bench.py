@@ -168,9 +168,10 @@ def run_measurement(rung: str) -> None:
         # time, decides the winner across batches.
         splash = {"PADDLE_TPU_ATTN_IMPL": "splash"}
         jaxflash = {"PADDLE_TPU_ATTN_IMPL": "jax_flash"}
+        variants.append((dict(remat_policy="all_but_mlp"), None, splash))
+        variants.append((dict(remat_policy="all_but_mlp"), None, {}))
         variants.append((dict(remat_policy="dots_flash"), None, splash))
         variants.append((dict(remat_policy="dots_flash"), None, jaxflash))
-        variants.append((dict(remat_policy="dots_flash"), None, {}))
         variants.append((dict(remat=False), 4, splash))
         variants.append((dict(remat=False), 4, {}))
 

@@ -62,7 +62,10 @@ class GPTConfig:
     #   in the backward);
     # - "dots_flash" additionally saves the named flash-attention outputs
     #   (~B*S*D bf16 per layer) so no attention forward is recomputed;
-    # - "offload_dots" saves dots to pinned host memory (HBM headroom).
+    # - "offload_dots" saves dots to pinned host memory (HBM headroom);
+    # - "all_but_mlp" saves everything EXCEPT the named 4D-wide MLP
+    #   hidden — near-no-remat speed at batches where true no-remat
+    #   OOMs (recompute = one up-proj + gelu per layer).
     # All raced on hardware in tools/sweep_gpt_step.py.
     remat_policy: str = "full"
     # lax.scan unroll factor over the layer axis: >1 lets XLA fuse across
@@ -256,6 +259,10 @@ def _dense_ffn(x, up_w, up_b, down_w, down_b):
     if up_b is not None:
         h = h + up_b.astype(x.dtype)
     h = jax.nn.gelu(h)
+    # named so remat_policy="all_but_mlp" can DROP just this 4D-wide
+    # activation (everything else saved — near-no-remat memory shape)
+    from jax.ad_checkpoint import checkpoint_name
+    h = checkpoint_name(h, "mlp_hidden")
     out = jnp.einsum("bsf,fd->bsd", h, down_w.astype(x.dtype))
     if down_b is not None:
         out = out + down_b.astype(x.dtype)
@@ -392,6 +399,16 @@ def _apply_stack(stacked, x, cfg: GPTConfig):
                 body,
                 policy=jax.checkpoint_policies.offload_dot_with_no_batch_dims(
                     "device", "pinned_host"))
+        elif cfg.remat_policy == "all_but_mlp":
+            # near-no-remat: save EVERYTHING except the tagged 4D-wide
+            # MLP hidden (the activation that pushes true no-remat past
+            # HBM at the bench batch) — recompute is one up-proj matmul
+            # + gelu per layer, ~8% of step FLOPs for a 4*B*S*4H byte/
+            # layer saving
+            body = jax.checkpoint(
+                body,
+                policy=jax.checkpoint_policies
+                .save_anything_except_these_names("mlp_hidden"))
         else:
             body = jax.checkpoint(body)
 

@@ -1,0 +1,40 @@
+"""Remat-policy equivalence: every checkpoint policy is a pure
+memory/compute trade — the training step's numerics must be identical
+to the no-remat step (reference analog: fleet recompute correctness,
+python/paddle/distributed/fleet/recompute/recompute.py check_recompute
+semantics)."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.models.gpt import (GPTConfig, init_gpt_params,
+                                   init_opt_state, train_step)
+
+CFG = dict(vocab_size=512, hidden_size=128, num_layers=2, num_heads=4,
+           max_seq_len=64, dtype=jnp.float32, sequence_parallel=False)
+
+
+def _loss(remat, policy):
+    cfg = GPTConfig(remat=remat, remat_policy=policy, **CFG)
+    params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+    opt = init_opt_state(params)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 65), 0, 512)
+    step = jax.jit(functools.partial(train_step, cfg=cfg, lr=1e-4))
+    loss, params, _ = step(params, opt, toks)
+    return float(loss), float(jnp.sum(params["wte"].astype(jnp.float32)))
+
+
+@functools.cache
+def _noremat_baseline():
+    return _loss(False, "dots")
+
+
+@pytest.mark.parametrize("policy", ["full", "dots", "dots_flash",
+                                    "all_but_mlp"])
+def test_policy_matches_noremat(policy):
+    want = _noremat_baseline()
+    got = _loss(True, policy)
+    assert got[0] == pytest.approx(want[0], abs=1e-5)
+    assert got[1] == pytest.approx(want[1], rel=1e-6)

@@ -25,7 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 M, K, N = 8192, 1024, 4096
-REPS = 8
+REPS = 64      # hops per dispatch: ~4.4 TFLOP >> tunnel RTT work
 
 
 def log(m):
@@ -36,63 +36,57 @@ def emit(rec):
     print(json.dumps(rec), flush=True)
 
 
-from bench_util import force as _force, timeit  # noqa: E402
+from bench_util import chained_ms, force as _force  # noqa: E402
 
 
 def main():
     devs = jax.devices()
     log(f"backend {devs[0].platform} ({devs[0].device_kind})")
-    fl = 2.0 * M * K * N * REPS
 
-    # bf16 path
-    a16 = jnp.full((M, K), 0.01, jnp.bfloat16)
-    b16 = jnp.full((K, N), 0.01, jnp.bfloat16)
+    # all three micro rows run through chained_ms (CLAUDE.md: a single
+    # [8192,1024]@[1024,4096] dispatch is single-digit-ms device work vs
+    # ~70-170 ms tunnel RTT — the first version of this file measured
+    # the tunnel). The slice back to [:, :K] adds one copy per hop to
+    # BOTH paths, so the bf16-vs-int8 ratio is unaffected.
+    fl_hop = 2.0 * M * K * N
 
-    @jax.jit
-    def mm_bf16(a, b):
-        def body(h, _):
-            out = jax.lax.dot_general(h, b, (((1,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
-            return out[:, :K].astype(jnp.bfloat16), None
-        h, _ = jax.lax.scan(body, a, None, length=REPS)
-        return h
-
-    ms = timeit(mm_bf16, a16, b16)
+    # bf16 path (1/K-weight row-mean keeps magnitudes neutral)
+    b16 = jnp.full((K, N), 1.0 / K, jnp.bfloat16)
+    ms = chained_ms(
+        lambda h: jax.lax.dot_general(
+            h, b16, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)[:, :K].astype(jnp.bfloat16),
+        jnp.full((M, K), 0.5, jnp.bfloat16), length=REPS, iters=3)
     emit({"metric": "matmul_bf16", "ms": round(ms, 3),
-          "tflops": round(fl / (ms * 1e-3) / 1e12, 1),
+          "tflops": round(fl_hop / (ms * 1e-3) / 1e12, 1),
           "backend": devs[0].platform})
 
     # raw int8 path
-    a8 = jnp.ones((M, K), jnp.int8)
     b8 = jnp.ones((K, N), jnp.int8)
-
-    @jax.jit
-    def mm_int8(a, b):
-        def body(h, _):
-            out = jax.lax.dot_general(h, b, (((1,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.int32)
-            return jnp.clip(out[:, :K], -127, 127).astype(jnp.int8), None
-        h, _ = jax.lax.scan(body, a, None, length=REPS)
-        return h
-
-    ms = timeit(mm_int8, a8, b8)
+    ms = chained_ms(
+        lambda h: jnp.clip(jax.lax.dot_general(
+            h, b8, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)[:, :K],
+            -127, 127).astype(jnp.int8),
+        jnp.ones((M, K), jnp.int8), length=REPS, iters=3)
     emit({"metric": "matmul_int8", "ms": round(ms, 3),
-          "tops": round(fl / (ms * 1e-3) / 1e12, 1),
+          "tops": round(fl_hop / (ms * 1e-3) / 1e12, 1),
           "backend": devs[0].platform})
 
-    # full Int8Linear op (quant + int8 dot + dequant epilogue)
+    # full Int8Linear op (quant + int8 dot + dequant epilogue);
+    # 1/K output scale keeps the f32 carry at 0.5 across hops
     from paddle_tpu.quantization.int8 import _int8_linear
-    x = jnp.full((M, K), 0.5, jnp.float32)
     w_q = jnp.ones((K, N), jnp.int8)
-    w_scale = jnp.ones((N,), jnp.float32)
+    w_scale = jnp.full((N,), 1.0 / K, jnp.float32)
     bias = jnp.zeros((N,), jnp.float32)
-
     raw = _int8_linear._raw_fn
-    fn = jax.jit(lambda xx: raw(xx, w_q, bias, jnp.float32(1.0), w_scale))
     try:
-        ms = timeit(fn, x)
+        ms = chained_ms(
+            lambda h: raw(h, w_q, bias, jnp.float32(1.0),
+                          w_scale)[:, :K].astype(jnp.float32),
+            jnp.full((M, K), 0.5, jnp.float32), length=REPS, iters=3)
         emit({"metric": "int8_linear_op", "ms": round(ms, 3),
-              "tops": round(2.0 * M * K * N / (ms * 1e-3) / 1e12, 1),
+              "tops": round(fl_hop / (ms * 1e-3) / 1e12, 1),
               "backend": devs[0].platform})
     except Exception as e:
         emit({"metric": "int8_linear_op", "error": repr(e)[:160]})

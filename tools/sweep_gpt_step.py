@@ -41,6 +41,11 @@ VARIANTS = [
      {"PADDLE_TPU_ATTN_IMPL": "jax_flash"}, 4),
     ("splash-noremat-b4", False, "dots", (512, 256, 128, 128),
      {"PADDLE_TPU_ATTN_IMPL": "splash"}, 4),
+    # save everything except the tagged MLP hidden: near-no-remat memory
+    # at full batch (true no-remat OOMs at B=8)
+    ("allbutmlp-b8", True, "all_but_mlp", (512, 256, 128, 128), JAXBWD),
+    ("allbutmlp-splash-b8", True, "all_but_mlp", (512, 256, 128, 128),
+     {"PADDLE_TPU_ATTN_IMPL": "splash"}),
     ("noremat-b4", False, "dots", (512, 256, 128, 128), JAXBWD, 4),
     ("noremat-xlaattn-b4", False, "dots", (512, 256, 128, 128),
      XLA_ATTN, 4),
