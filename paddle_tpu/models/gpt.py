@@ -63,9 +63,9 @@ class GPTConfig:
     # - "dots_flash" additionally saves the named flash-attention outputs
     #   (~B*S*D bf16 per layer) so no attention forward is recomputed;
     # - "offload_dots" saves dots to pinned host memory (HBM headroom);
-    # - "all_but_mlp" saves everything EXCEPT the named 4D-wide MLP
-    #   hidden — near-no-remat speed at batches where true no-remat
-    #   OOMs (recompute = one up-proj + gelu per layer).
+    # - "all_but_mlp" checkpoints ONLY the dense FFN (nested, inside an
+    #   otherwise unremat'd block) — near-no-remat speed at batches
+    #   where true no-remat OOMs; recompute = the FFN forward per layer.
     # All raced on hardware in tools/sweep_gpt_step.py.
     remat_policy: str = "full"
     # lax.scan unroll factor over the layer axis: >1 lets XLA fuse across
@@ -259,10 +259,6 @@ def _dense_ffn(x, up_w, up_b, down_w, down_b):
     if up_b is not None:
         h = h + up_b.astype(x.dtype)
     h = jax.nn.gelu(h)
-    # named so remat_policy="all_but_mlp" can DROP just this 4D-wide
-    # activation (everything else saved — near-no-remat memory shape)
-    from jax.ad_checkpoint import checkpoint_name
-    h = checkpoint_name(h, "mlp_hidden")
     out = jnp.einsum("bsf,fd->bsd", h, down_w.astype(x.dtype))
     if down_b is not None:
         out = out + down_b.astype(x.dtype)
@@ -298,8 +294,17 @@ def _block(params_l, x, cfg):
                           params_l["moe_up_b"], params_l["moe_down_w"],
                           params_l["moe_down_b"], cfg)
     else:
-        m = _dense_ffn(m_in, params_l["mlp_up_w"], params_l.get("mlp_up_b"),
-                       params_l["mlp_down_w"], params_l.get("mlp_down_b"))
+        ffn = _dense_ffn
+        if cfg.remat and cfg.remat_policy == "all_but_mlp":
+            # nested checkpoint JUST around the FFN: everything else in
+            # the block is saved (no block-level remat for this policy —
+            # see _apply_stack), but none of the 4D-wide FFN internals
+            # can be (a names-based policy fails here: gelu decomposes
+            # into unnamed elementwise primitives whose outputs remain
+            # saveable, so the cut just moves onto them)
+            ffn = jax.checkpoint(_dense_ffn)
+        m = ffn(m_in, params_l["mlp_up_w"], params_l.get("mlp_up_b"),
+                params_l["mlp_down_w"], params_l.get("mlp_down_b"))
     return _sp_constraint(h + m, cfg), aux
 
 
@@ -372,13 +377,17 @@ def _apply_stack(stacked, x, cfg: GPTConfig):
                 return h
 
         x_mb = x.reshape((m, B // m) + x.shape[1:])
+        # "all_but_mlp" already nests its checkpoint around the FFN in
+        # _block; stacking the stage-level checkpoint on top would pay
+        # full remat PLUS an extra FFN recompute
+        stage_remat = cfg.remat and cfg.remat_policy != "all_but_mlp"
         if moe:
             y, aux_mb = pipeline_forward(stage_fn, chunked, x_mb, pp, m,
-                                         interleave=v, remat=cfg.remat,
+                                         interleave=v, remat=stage_remat,
                                          with_aux=True)
             return y.reshape(x.shape), jnp.mean(aux_mb)
         y = pipeline_forward(stage_fn, chunked, x_mb, pp, m,
-                             interleave=v, remat=cfg.remat)
+                             interleave=v, remat=stage_remat)
         return y.reshape(x.shape), jnp.zeros((), jnp.float32)
 
     body = functools.partial(_block, cfg=cfg)
@@ -400,15 +409,12 @@ def _apply_stack(stacked, x, cfg: GPTConfig):
                 policy=jax.checkpoint_policies.offload_dot_with_no_batch_dims(
                     "device", "pinned_host"))
         elif cfg.remat_policy == "all_but_mlp":
-            # near-no-remat: save EVERYTHING except the tagged 4D-wide
-            # MLP hidden (the activation that pushes true no-remat past
-            # HBM at the bench batch) — recompute is one up-proj matmul
-            # + gelu per layer, ~8% of step FLOPs for a 4*B*S*4H byte/
-            # layer saving
-            body = jax.checkpoint(
-                body,
-                policy=jax.checkpoint_policies
-                .save_anything_except_these_names("mlp_hidden"))
+            # near-no-remat: NO block-level checkpoint — _block instead
+            # nests jax.checkpoint around just the dense FFN, so the
+            # 4D-wide hidden activations (what pushes true no-remat past
+            # HBM at the bench batch) are recomputed (~16% of step
+            # FLOPs) and everything else is saved
+            pass
         else:
             body = jax.checkpoint(body)
 
