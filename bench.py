@@ -32,20 +32,15 @@ def _log(msg: str) -> None:
 
 # ---------------------------------------------------------------- configs
 # name -> (model kwargs, batch, seq, iters, timeout_s)
-# Remat/backward choices follow the round-3 sweep evidence
-# (tools/sweep_gpt_step.py, BASELINE.md): remat=False OOMs at B=8
-# (18.3G > 15.75G HBM); the hand-tiled Pallas flash BACKWARD measured
-# slower than the jax-level recompute backward (517 vs 439 ms/step), so
-# the bench keeps the Pallas forward + jax backward and selective "dots"
-# remat. B=16 is tried first (more tokens/step amortize the update).
+# The single TPU rung's DEFAULT is the round-3 winner (dots remat,
+# Pallas fwd + jax bwd, B=8); the variant race inside the rung covers
+# the round-4 candidates across attention impls, remat policies, and
+# batches 4-16, emitting best-so-far after every variant so a dying
+# tunnel still leaves the best measured row.
 LADDER = [
-    ("tpu-b16", dict(vocab_size=32768, hidden_size=1024, num_layers=24,
-                     num_heads=16, max_seq_len=1024, remat=True,
-                     remat_policy="dots", dtype="bfloat16"),
-     16, 1024, 10, 1500),
     ("tpu", dict(vocab_size=32768, hidden_size=1024, num_layers=24,
                  num_heads=16, max_seq_len=1024, remat=True,
-                 remat_policy="dots", dtype="bfloat16"), 8, 1024, 10, 1500),
+                 remat_policy="dots", dtype="bfloat16"), 8, 1024, 10, 2100),
     ("tpu-small", dict(vocab_size=8192, hidden_size=512, num_layers=8,
                        num_heads=8, max_seq_len=512, remat=False,
                        dtype="bfloat16"), 4, 512, 10, 600),
@@ -97,22 +92,27 @@ def _init_devices(want_tpu: bool):
     return devs
 
 
-def run_measurement(rung: str) -> None:
-    """Run one ladder rung and print the JSON line to stdout."""
-    name, kw, batch, seq, iters, _ = next(c for c in LADDER if c[0] == rung)
-    want_tpu = name.startswith("tpu")
-
-    # sweep verdict: jax-level flash backward beats the Pallas backward on
-    # this config; opt back in with PADDLE_TPU_ENABLE_PALLAS_BWD=1
-    if want_tpu and os.environ.get("PADDLE_TPU_ENABLE_PALLAS_BWD") != "1":
+def apply_perf_env_defaults() -> None:
+    """The shipped TPU measurement defaults, shared by bench.py rungs and
+    tools/bench_ladder.py rows so the two can never drift:
+    - jax-level flash backward (the sweep verdict; opt back into the
+      Pallas backward with PADDLE_TPU_ENABLE_PALLAS_BWD=1), and
+    - the repo-committed autotune winners as pure cache READS — no
+      in-bench timing passes."""
+    if os.environ.get("PADDLE_TPU_ENABLE_PALLAS_BWD") != "1":
         os.environ.setdefault("PADDLE_TPU_DISABLE_PALLAS_BWD", "1")
-
-    # repo-committed autotune winners (tools/autotune_kernels.py) apply as
-    # pure cache READS — no in-bench timing passes
     cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "perf", "autotune.json")
     if os.path.exists(cache):
         os.environ.setdefault("PADDLE_TPU_AUTOTUNE_CACHE", cache)
+
+
+def run_measurement(rung: str) -> None:
+    """Run one ladder rung and print the JSON line to stdout."""
+    name, kw, batch, seq, iters, _ = next(c for c in LADDER if c[0] == rung)
+    want_tpu = name.startswith("tpu")
+    if want_tpu:
+        apply_perf_env_defaults()
 
     import jax
     import jax.numpy as jnp
@@ -174,6 +174,10 @@ def run_measurement(rung: str) -> None:
         variants.append((dict(remat_policy="dots_flash"), None, jaxflash))
         variants.append((dict(remat=False), 4, splash))
         variants.append((dict(remat=False), 4, {}))
+        # batch crossings (the old tpu-b16 rung, now one race): more
+        # tokens/step amortize the update; OOMs are caught and skipped
+        variants.append((dict(remat_policy="all_but_mlp"), 12, splash))
+        variants.append((dict(), 16, {}))
 
     def emit(dt, cfg, n_params, vkw, vbatch):
         tps = vbatch * seq / dt

@@ -40,6 +40,11 @@ def _force(out):
 def run_row(row: str) -> None:
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
+    # shared with bench.py so the two measurement paths can't drift
+    # (applies BEFORE any jax trace so env gates read the right values);
+    # --run is also how tpu_campaign invokes single rows
+    from bench import apply_perf_env_defaults
+    apply_perf_env_defaults()
     import jax
     import jax.numpy as jnp
     import functools

@@ -50,7 +50,10 @@ JOBS = [
     ("ablate", [sys.executable, "tools/ablate_step.py"], 4200, {}),
     ("autotune", [sys.executable, "tools/autotune_kernels.py"], 2700, {}),
     ("sweep", [sys.executable, "tools/sweep_gpt_step.py"], 4500, {}),
-    ("bench", [sys.executable, "bench.py"], 2700, {}),
+    # budget > probe retries (720s) + tpu rung (2100s) + its short
+    # retry (1260s): the campaign must never kill bench mid-rung and
+    # discard measured variants
+    ("bench", [sys.executable, "bench.py"], 4500, {}),
     ("ladder_resnet50",
      [sys.executable, "tools/bench_ladder.py", "--run", "resnet50"],
      1500, {}),
