@@ -1,12 +1,24 @@
-"""Offline TPU sweep for the bench train step: remat policy x flash blocks.
+"""Offline TPU sweep for the bench train step: attention impl x remat
+policy x batch (x flash blocks via env).
 
-Each variant runs in a fresh subprocess under a timeout (the tunnel can hang)
-and prints one JSON line; the parent prints a ranked summary at the end.
-Results feed the shipped defaults (GPTConfig.remat/remat_policy, the bench
-ladder, and PADDLE_TPU_FLASH_BLOCK_* defaults) plus BASELINE.md.
+Variants run IN-PROCESS inside one child (one interpreter + jax import +
+backend init for the whole list — per-variant subprocesses burned
+~25-40 s of scarce tunnel-window time each). The orchestrator watches
+the child's stdout and respawns it with the remaining variants if it
+crashes (e.g. a Mosaic abort) or stalls past the per-variant budget
+(tunnel hang), dropping only the variant that was in flight. Every
+variant's env (kill switches, impl selector, flash blocks) is applied
+around its own run from a whole-env snapshot, and every gate re-reads
+env per trace, so in-process racing is sound — bench.py races its
+variants the same way.
 
-Usage:  python tools/sweep_gpt_step.py            # orchestrate the sweep
-        python tools/sweep_gpt_step.py --run '<json>'   # one variant (internal)
+Each variant prints one JSON line; the parent prints a ranked summary
+(by tokens/sec — batches differ) at the end. Results feed the shipped
+defaults (GPTConfig.remat/remat_policy, PADDLE_TPU_ATTN_IMPL, the bench
+ladder, PADDLE_TPU_FLASH_BLOCK_* defaults) plus BASELINE.md.
+
+Usage:  python tools/sweep_gpt_step.py                 # orchestrate
+        python tools/sweep_gpt_step.py --run-list '<json>'   # internal
 """
 from __future__ import annotations
 
@@ -23,13 +35,11 @@ VARIANTS = [
     # name, remat, policy, (bq, bk, bwd_q, bwd_k), extra env[, batch]
     # Ordered by the round-4 ablation matrix (perf/window_*/ablate.out):
     # no-remat at reduced batch beat every remat variant per-token
-    # (42.5 ms/sample at B=4 vs 53.4 best remat at B=8), and the XLA
-    # attention path beat the Pallas flash fwd in the full step (399.7 vs
-    # 435.5 ms). Race the combos; tokens_per_sec is the cross-batch metric.
-    # Default blocks are the round-4 autotune winners (perf/autotune.json:
-    # fwd 512/256 measured 3.4x faster than the old 128/128; bwd 128/128).
-    # Explicit FLASH_BLOCK env settings outrank the autotune cache, so
-    # these tuples really do control every variant.
+    # (42.5 ms/sample at B=4 vs 53.4 best remat at B=8), and attention
+    # is ~66% of the step. Default blocks are the round-4 autotune
+    # winners (perf/autotune.json: fwd 512/256; bwd 128/128). Explicit
+    # FLASH_BLOCK env settings outrank the autotune cache, so these
+    # tuples really do control every variant.
     # HIGHEST-VALUE HYPOTHESES FIRST: a congested window may only get
     # through a handful of variants before the tunnel drops.
     # all_but_mlp: nested checkpoint around just the dense FFN (block
@@ -77,90 +87,185 @@ VARIANTS = [
 MODEL = dict(vocab_size=32768, hidden_size=1024, num_layers=24,
              num_heads=16, max_seq_len=1024)
 BATCH, SEQ, ITERS = 8, 1024, 8
+VARIANT_BUDGET_S = 900      # stall bound: no output for this long → kill
+
+
+def _specs() -> list:
+    """VARIANTS table → self-contained spec dicts (env folded in)."""
+    specs = []
+    for name, remat, policy, (bq, bk, bwq, bwk), extra, *rest in VARIANTS:
+        env = {
+            "PADDLE_TPU_FLASH_BLOCK_Q": str(bq),
+            "PADDLE_TPU_FLASH_BLOCK_K": str(bk),
+            "PADDLE_TPU_FLASH_BLOCK_BWD_Q": str(bwq),
+            "PADDLE_TPU_FLASH_BLOCK_BWD_K": str(bwk),
+            **extra,
+        }
+        specs.append({"name": name, "remat": remat, "policy": policy,
+                      "env": env, "batch": rest[0] if rest else BATCH})
+    return specs
+
+
+def _child_env() -> dict:
+    """Env for the child INTERPRETER (not per-variant): the autotune
+    cache path is read by kernels/autotune.py at module import time, so
+    per-variant application would be a silent no-op — it is uniform
+    across variants anyway (feeds only the CE kernel's block lookup;
+    every variant pins the FLASH_BLOCK vars, which outrank the cache)."""
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    cache = os.path.join(here, "perf", "autotune.json")
+    if os.path.exists(cache):
+        env.setdefault("PADDLE_TPU_AUTOTUNE_CACHE", cache)
+    return env
 
 
 def run_one(spec: dict) -> None:
-    sys.path.insert(0, os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
+    """One variant, in the current process; env applied from a snapshot
+    (all kernel gates re-read env per trace)."""
     import jax
     import jax.numpy as jnp
     import functools
     from paddle_tpu.models.gpt import (GPTConfig, init_gpt_params,
                                        init_opt_state, train_step)
-    devs = jax.devices()
-    cfg = GPTConfig(sequence_parallel=False, remat=spec["remat"],
-                    remat_policy=spec["policy"], dtype=jnp.bfloat16,
-                    scan_unroll=int(os.environ.get("SWEEP_SCAN_UNROLL",
-                                                   "1")),
-                    **MODEL)
-    batch = int(spec.get("batch", BATCH))
-    params = init_gpt_params(cfg, jax.random.PRNGKey(0))
-    opt_state = init_opt_state(params)
-    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, SEQ + 1), 0,
-                                cfg.vocab_size)
-    step = jax.jit(functools.partial(train_step, cfg=cfg, lr=1e-4),
-                   donate_argnums=(0, 1))
-    t0 = time.perf_counter()
-    loss, params, opt_state = step(params, opt_state, tokens)
-    float(loss)
-    compile_s = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    for _ in range(ITERS):
+    snapshot = dict(os.environ)
+    try:
+        os.environ.update(spec.get("env", {}))
+        devs = jax.devices()
+        cfg = GPTConfig(sequence_parallel=False, remat=spec["remat"],
+                        remat_policy=spec["policy"], dtype=jnp.bfloat16,
+                        scan_unroll=int(os.environ.get(
+                            "SWEEP_SCAN_UNROLL", "1")),
+                        **spec.get("model", MODEL))
+        batch = int(spec.get("batch", BATCH))
+        seq = int(spec.get("seq", SEQ))
+        params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+        opt_state = init_opt_state(params)
+        tokens = jax.random.randint(jax.random.PRNGKey(1),
+                                    (batch, seq + 1), 0, cfg.vocab_size)
+        step = jax.jit(functools.partial(train_step, cfg=cfg, lr=1e-4),
+                       donate_argnums=(0, 1))
+        t0 = time.perf_counter()
         loss, params, opt_state = step(params, opt_state, tokens)
-    float(loss)
-    dt = (time.perf_counter() - t0) / ITERS
-    print(json.dumps({"name": spec["name"], "ms_per_step": round(dt * 1e3, 2),
-                      "tokens_per_sec": round(batch * SEQ / dt, 1),
-                      "batch": batch, "compile_s": round(compile_s, 1),
-                      "platform": devs[0].platform}), flush=True)
+        float(loss)
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            loss, params, opt_state = step(params, opt_state, tokens)
+        float(loss)
+        dt = (time.perf_counter() - t0) / ITERS
+        print(json.dumps({"name": spec["name"],
+                          "ms_per_step": round(dt * 1e3, 2),
+                          "tokens_per_sec": round(batch * seq / dt, 1),
+                          "batch": batch, "compile_s": round(compile_s, 1),
+                          "platform": devs[0].platform}), flush=True)
+    finally:
+        os.environ.clear()
+        os.environ.update(snapshot)
+
+
+def run_list(specs: list) -> None:
+    """Child entry: race every spec in this one process. A failed
+    variant (OOM, Mosaic error) is reported and skipped; a hard crash
+    ends the process and the orchestrator respawns with the rest."""
+    if os.environ.get("SWEEP_PIN_CPU") == "1":
+        # dev/smoke hook: the axon plugin hijacks backend init even
+        # under JAX_PLATFORMS=cpu (CLAUDE.md trap) — only pin_cpu works
+        from paddle_tpu.device import pin_cpu
+        pin_cpu(1)
+    for spec in specs:
+        print(f"[sweep-child] === {spec['name']} ===", file=sys.stderr,
+              flush=True)
+        if spec.get("_crash"):      # orchestrator-respawn test hook
+            os._exit(9)
+        try:
+            run_one(spec)
+        except Exception as e:
+            print(json.dumps({"name": spec["name"],
+                              "error": repr(e)[:200]}), flush=True)
 
 
 def main() -> None:
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    # feeds only the CE kernel's block lookup — every variant pins the
-    # four FLASH_BLOCK vars, which outrank the cache
-    cache = os.path.join(here, "perf", "autotune.json")
-    results = []
-    for name, remat, policy, (bq, bk, bwq, bwk), extra, *rest in VARIANTS:
-        spec = {"name": name, "remat": remat, "policy": policy}
-        if rest:
-            spec["batch"] = rest[0]
-        env = dict(os.environ)
-        if os.path.exists(cache):
-            env.setdefault("PADDLE_TPU_AUTOTUNE_CACHE", cache)
-        env.update({
-            "PADDLE_TPU_FLASH_BLOCK_Q": str(bq),
-            "PADDLE_TPU_FLASH_BLOCK_K": str(bk),
-            "PADDLE_TPU_FLASH_BLOCK_BWD_Q": str(bwq),
-            "PADDLE_TPU_FLASH_BLOCK_BWD_K": str(bwk),
-        })
-        env.update(extra)
-        print(f"[sweep] === {name} ===", file=sys.stderr, flush=True)
-        try:
-            res = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--run",
-                 json.dumps(spec)],
-                cwd=here, env=env, stdout=subprocess.PIPE, timeout=900)
-        except subprocess.TimeoutExpired:
-            print(f"[sweep] {name}: TIMEOUT", file=sys.stderr, flush=True)
-            continue
-        out = res.stdout.decode().strip().splitlines()
-        line = next((ln for ln in reversed(out) if ln.startswith("{")), None)
-        if res.returncode == 0 and line:
-            rec = json.loads(line)
-            results.append(rec)
-            print(f"[sweep] {name}: {rec['ms_per_step']} ms/step",
+    pending = _specs()
+    results, failed = [], []
+
+    while pending:
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--run-list",
+             json.dumps(pending)],
+            cwd=here, env=_child_env(), stdout=subprocess.PIPE, text=True)
+        done_this_child = 0
+        import select
+        last_line = time.time()
+        while True:
+            r, _, _ = select.select([proc.stdout], [], [], 10.0)
+            if r:
+                line = proc.stdout.readline()
+                if not line:
+                    break                      # child exited
+                line = line.strip()
+                last_line = time.time()
+                # a record is only the next pending variant's line —
+                # stray {-prefixed stdout noise (jax/libtpu) must
+                # neither crash the sweep nor desync the pending slice
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if (done_this_child >= len(pending)
+                        or not isinstance(rec, dict)
+                        or rec.get("name") !=
+                        pending[done_this_child]["name"]):
+                    continue
+                done_this_child += 1
+                if "error" in rec:
+                    failed.append(rec)
+                    print(f"[sweep] {rec['name']}: FAILED "
+                          f"{rec['error'][:80]}", file=sys.stderr,
+                          flush=True)
+                else:
+                    results.append(rec)
+                    print(f"[sweep] {rec['name']}: {rec['ms_per_step']} "
+                          f"ms/step ({rec['tokens_per_sec']} tok/s)",
+                          file=sys.stderr, flush=True)
+            elif proc.poll() is not None:
+                break
+            elif time.time() - last_line > VARIANT_BUDGET_S:
+                # in-flight variant hung (tunnel): kill, drop it, respawn
+                proc.kill()
+                proc.wait()
+                break
+        if proc.poll() is None:
+            proc.wait()
+        survived = pending[done_this_child:]
+        if proc.returncode == 0 and done_this_child >= len(pending):
+            pending = []
+        elif survived:
+            dropped = survived[0]
+            print(f"[sweep] child died/stalled on {dropped['name']}; "
+                  f"dropping it, {len(survived) - 1} remain",
                   file=sys.stderr, flush=True)
+            failed.append({"name": dropped["name"],
+                           "error": "child crashed or stalled"})
+            pending = survived[1:]
         else:
-            print(f"[sweep] {name}: FAILED rc={res.returncode}",
-                  file=sys.stderr, flush=True)
+            pending = []
+
     # batches differ across variants: rank by throughput, not step time
     results.sort(key=lambda r: -r["tokens_per_sec"])
-    print(json.dumps({"ranked": results}, indent=1), flush=True)
+    print(json.dumps({"ranked": results, "failed": failed}, indent=1),
+          flush=True)
 
 
 if __name__ == "__main__":
-    if len(sys.argv) >= 3 and sys.argv[1] == "--run":
+    if len(sys.argv) >= 3 and sys.argv[1] == "--run-list":
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        run_list(json.loads(sys.argv[2]))
+    elif len(sys.argv) >= 3 and sys.argv[1] == "--run":
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
         run_one(json.loads(sys.argv[2]))
     else:
         main()
