@@ -48,7 +48,7 @@ SMOKE_FILES = {
     "test_e2e_mnist.py", "test_kernels.py", "test_kernel_primitives.py",
     # distributed (mesh-light representatives)
     "test_collective.py", "test_sharding_stages.py", "test_auto_parallel.py",
-    "test_fleet_e2e.py", "test_distributed_tail.py",
+    "test_fleet_e2e.py", "test_distributed_tail.py", "test_67b_lowering.py",
     # io / inference / serving
     "test_multiprocess_loader.py", "test_inference.py", "test_int8.py",
     # high-level API + aux subsystems

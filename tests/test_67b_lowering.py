@@ -1,0 +1,67 @@
+"""The BASELINE north-star config (GPT-3 6.7B, fleet-style hybrid
+TP x PP x DP over a pod mesh) must LOWER shape-level on a virtual mesh —
+no 27 GB of weights materialized, just the abstract trace + StableHLO of
+the full sharded training step (reference analog: the fleet hybrid topo
+in python/paddle/distributed/fleet/meta_parallel/ driving the 6.7B GPT
+benchmark configs).
+
+This is the compile-side half of what a v5p-64 run would do; it catches
+sharding-spec mismatches, pipeline/microbatch shape bugs, and remat
+policy breakage at the production scale the single-chip bench can't
+reach. (Execution correctness at small scale is dryrun_multichip's job.)
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models.gpt import (GPTConfig, PARAM_SPECS,
+                                   init_gpt_params, init_opt_state,
+                                   train_step)
+from paddle_tpu.parallel.mesh import (P, build_mesh, sharding_for,
+                                      use_mesh)
+
+
+def test_gpt_6p7b_hybrid_step_lowers():
+    # GPT-3 6.7B: 32L x 4096d x 32 heads, S=2048 (BASELINE.json row 3)
+    cfg = GPTConfig(vocab_size=50304, hidden_size=4096, num_layers=32,
+                    num_heads=32, max_seq_len=2048,
+                    sequence_parallel=True, remat=True,
+                    remat_policy="dots", dtype=jnp.bfloat16,
+                    pipeline_microbatches=4)
+    mesh = build_mesh({"dp": 2, "pp": 2, "mp": 2})
+
+    with use_mesh(mesh):
+        p_shapes = jax.eval_shape(
+            lambda k: init_gpt_params(cfg, k), jax.random.PRNGKey(0))
+        import math
+        n_params = sum(math.prod(v.shape) for v in p_shapes.values())
+        assert 6.3e9 < n_params < 7.3e9, n_params   # really 6.7B-class
+
+        o_shapes = jax.eval_shape(init_opt_state, p_shapes)
+        tokens = jax.ShapeDtypeStruct((8, 2049), jnp.int32)
+
+        def sharded(tree):
+            # sharding_for prunes spec axes the mesh doesn't carry
+            # (e.g. 'fsdp'), same normalization shard_gpt_params uses
+            return {k: jax.ShapeDtypeStruct(
+                        v.shape, v.dtype,
+                        sharding=sharding_for(PARAM_SPECS[k], mesh))
+                    for k, v in tree.items()}
+
+        p_sh = sharded(p_shapes)
+        o_sh = {"m": sharded(o_shapes["m"]), "v": sharded(o_shapes["v"]),
+                "step": o_shapes["step"]}
+        t_sh = jax.ShapeDtypeStruct(
+            tokens.shape, tokens.dtype,
+            sharding=sharding_for(P("dp", None), mesh))
+
+        step = jax.jit(functools.partial(train_step, cfg=cfg, lr=1e-4),
+                       donate_argnums=(0, 1))
+        lowered = step.lower(p_sh, o_sh, t_sh)
+        hlo = lowered.as_text()
+        # the sharded step really is SPMD over the 8-way mesh
+        assert "num_partitions = 8" in hlo
+        out_shapes = jax.tree_util.tree_map(
+            lambda x: x.shape, lowered.out_info)
+        assert out_shapes[0] == ()          # scalar loss

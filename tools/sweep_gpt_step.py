@@ -194,44 +194,55 @@ def main() -> None:
         proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--run-list",
              json.dumps(pending)],
-            cwd=here, env=_child_env(), stdout=subprocess.PIPE, text=True)
+            cwd=here, env=_child_env(), stdout=subprocess.PIPE)
         done_this_child = 0
         import select
-        last_line = time.time()
+        fd = proc.stdout.fileno()
+        buf = b""
+        # the stall deadline is measured from the last ACCEPTED record —
+        # stray stdout noise (jax/libtpu retry chatter) must not keep a
+        # hung variant alive, and raw os.read avoids the buffered-
+        # readline-vs-select trap where a completed record sits unread
+        last_rec = time.time()
+
+        def handle(raw: bytes) -> None:
+            nonlocal done_this_child, last_rec
+            # a record is only the next pending variant's line — noise
+            # must neither crash the sweep nor desync the pending slice
+            try:
+                rec = json.loads(raw.decode(errors="replace").strip())
+            except ValueError:
+                return
+            if (done_this_child >= len(pending)
+                    or not isinstance(rec, dict)
+                    or rec.get("name") !=
+                    pending[done_this_child]["name"]):
+                return
+            done_this_child += 1
+            last_rec = time.time()
+            if "error" in rec:
+                failed.append(rec)
+                print(f"[sweep] {rec['name']}: FAILED "
+                      f"{rec['error'][:80]}", file=sys.stderr, flush=True)
+            else:
+                results.append(rec)
+                print(f"[sweep] {rec['name']}: {rec['ms_per_step']} "
+                      f"ms/step ({rec['tokens_per_sec']} tok/s)",
+                      file=sys.stderr, flush=True)
+
         while True:
-            r, _, _ = select.select([proc.stdout], [], [], 10.0)
+            r, _, _ = select.select([fd], [], [], 10.0)
             if r:
-                line = proc.stdout.readline()
-                if not line:
-                    break                      # child exited
-                line = line.strip()
-                last_line = time.time()
-                # a record is only the next pending variant's line —
-                # stray {-prefixed stdout noise (jax/libtpu) must
-                # neither crash the sweep nor desync the pending slice
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    continue
-                if (done_this_child >= len(pending)
-                        or not isinstance(rec, dict)
-                        or rec.get("name") !=
-                        pending[done_this_child]["name"]):
-                    continue
-                done_this_child += 1
-                if "error" in rec:
-                    failed.append(rec)
-                    print(f"[sweep] {rec['name']}: FAILED "
-                          f"{rec['error'][:80]}", file=sys.stderr,
-                          flush=True)
-                else:
-                    results.append(rec)
-                    print(f"[sweep] {rec['name']}: {rec['ms_per_step']} "
-                          f"ms/step ({rec['tokens_per_sec']} tok/s)",
-                          file=sys.stderr, flush=True)
+                chunk = os.read(fd, 65536)
+                if not chunk:
+                    break                      # EOF: child exited
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    handle(line)
             elif proc.poll() is not None:
                 break
-            elif time.time() - last_line > VARIANT_BUDGET_S:
+            elif time.time() - last_rec > VARIANT_BUDGET_S:
                 # in-flight variant hung (tunnel): kill, drop it, respawn
                 proc.kill()
                 proc.wait()
@@ -263,9 +274,5 @@ if __name__ == "__main__":
         sys.path.insert(0, os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
         run_list(json.loads(sys.argv[2]))
-    elif len(sys.argv) >= 3 and sys.argv[1] == "--run":
-        sys.path.insert(0, os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))))
-        run_one(json.loads(sys.argv[2]))
     else:
         main()
