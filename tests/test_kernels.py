@@ -77,6 +77,20 @@ class TestPallasKernel:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=1e-4, atol=1e-5)
 
+    def test_autotuned_blocks_512_256(self):
+        # the committed autotune winner (perf/autotune.json fwd 512/256)
+        # exercises the uneven block_q != block_k masking path — parity
+        # must hold at the blocks production actually runs
+        q, k, v = _rand_qkv(B=1, S=1024, H=2, D=64)
+        out, lse = mha_fwd(q, k, v, causal=True, block_q=512,
+                           block_k=256, interpret=True)
+        ref = _dense_reference(q, k, v, True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(lse),
+                                   np.asarray(_dense_lse(q, k, v, True)),
+                                   rtol=1e-4, atol=1e-5)
+
 
 class TestFlashBackward:
     @pytest.mark.parametrize("causal", [False, True])

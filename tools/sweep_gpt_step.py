@@ -235,14 +235,20 @@ def main() -> None:
             if r:
                 chunk = os.read(fd, 65536)
                 if not chunk:
+                    if buf:
+                        handle(buf)            # unterminated final line
                     break                      # EOF: child exited
                 buf += chunk
                 while b"\n" in buf:
                     line, buf = buf.split(b"\n", 1)
                     handle(line)
             elif proc.poll() is not None:
+                if buf:
+                    handle(buf)
                 break
-            elif time.time() - last_rec > VARIANT_BUDGET_S:
+            # checked EVERY iteration — stdout noise must not postpone
+            # the deadline (only accepted records reset last_rec)
+            if time.time() - last_rec > VARIANT_BUDGET_S:
                 # in-flight variant hung (tunnel): kill, drop it, respawn
                 proc.kill()
                 proc.wait()
