@@ -158,19 +158,24 @@ class FusedMultiTransformer(Layer):
                 Tensor(jnp.zeros(shape, jnp.float32))]
 
     # -- forward ------------------------------------------------------------
-    def forward(self, src, attn_mask=None, caches=None, time_step=None):
+    def forward(self, src, attn_mask=None, caches=None, time_step=None,
+                rotary_embs=None, rotary_emb_dims=0):
         """attn_mask: [B, S] (1=real, 0=pad) or an additive [B, 1, T, S]
         bias, combined with the causal mask. time_step may be an int or a
         scalar Tensor; it traces as a dynamic index, so every decode step
-        reuses ONE compiled computation."""
+        reuses ONE compiled computation. rotary_embs: the reference's
+        [2, B, 1, S, head_dim] cos/sin table (rotary_emb_dims groups the
+        head dim) applied to q/k in every layer."""
         from ..framework.dispatch import apply
         pvals = self._scan_inputs()
         act = self.activation
         H, hd = self.num_heads, self.head_dim
+        rot_dims = int(rotary_emb_dims) if rotary_embs is not None else 0
         # config must live in the dispatch cache key: the closure bakes
         # H/hd/act, and two models sharing (L, D) would otherwise collide
         cfg = f"L{self.num_layers}_H{H}_hd{hd}_{act}" + \
-            ("_w8" if getattr(self, "_weight_only", False) else "")
+            ("_w8" if getattr(self, "_weight_only", False) else "") + \
+            (f"_rot{rot_dims}" if rot_dims else "")
         pos_t = Tensor(jnp.asarray(
             int(time_step) if time_step is not None else 0, jnp.int32))
         B = src.shape[0]
@@ -186,17 +191,31 @@ class FusedMultiTransformer(Layer):
             else:                                  # additive bias
                 bias = Tensor(av.astype(jnp.float32))
 
+        rot = ()
+        if rot_dims:
+            cos, sin = _rotary_tables(rotary_embs)
+            rot = (Tensor(cos), Tensor(sin))
+
+        def _rotary_of(r):
+            return (r[0], r[1]) if r else None
+
         if caches is None:
-            def fn(x, pos, bias_, *pv, cfg_id=None):
+            def fn(x, pos, bias_, *rest, cfg_id=None):
+                r, pv = rest[:len(rot)], rest[len(rot):]
                 return _stack_forward(x, None, None, pv, pos, H, hd, act,
-                                      bias_)[0]
+                                      bias_, rotary=_rotary_of(r),
+                                      rotary_dims=rot_dims)[0]
             return apply("fused_multi_transformer", fn, src, pos_t, bias,
-                         *pvals, cfg_id=cfg)
+                         *rot, *pvals, cfg_id=cfg)
         out = apply(
             "fused_multi_transformer_cached",
-            lambda x, pos, bias_, kc, vc, *pv, cfg_id=None:
-                _stack_forward(x, kc, vc, pv, pos, H, hd, act, bias_),
-            src, pos_t, bias, caches[0], caches[1], *pvals, cfg_id=cfg)
+            lambda x, pos, bias_, kc, vc, *rest, cfg_id=None:
+                _stack_forward(x, kc, vc, rest[len(rot):], pos, H, hd,
+                               act, bias_,
+                               rotary=_rotary_of(rest[:len(rot)]),
+                               rotary_dims=rot_dims),
+            src, pos_t, bias, caches[0], caches[1], *rot, *pvals,
+            cfg_id=cfg)
         y, kc, vc = out
         return y, [kc, vc]
 
@@ -212,7 +231,46 @@ def _mm(x, w, scale=None):
     return y
 
 
-def _stack_forward(x, kcache, vcache, pv, pos, H, hd, act, bias=None):
+def _rotary_tables(rotary_embs):
+    """Unpack the reference's [2, B, 1, S, hd] rotary_embs tensor into
+    per-position (cos [B,S,hd], sin [B,S,hd]) f32 tables — the ONE home
+    for this extraction (layer forward + functional entry share it)."""
+    rv = rotary_embs._value if isinstance(rotary_embs, Tensor) \
+        else jnp.asarray(rotary_embs)
+    if rv.ndim != 5 or rv.shape[0] != 2:
+        raise ValueError(
+            f"rotary_embs must be the reference's [2, B, 1, S, head_dim] "
+            f"cos/sin table; got shape {tuple(rv.shape)}")
+    return (rv[0, :, 0].astype(jnp.float32),
+            rv[1, :, 0].astype(jnp.float32))
+
+
+def _apply_rotary(x, cos, sin, dims):
+    """Reference rotary (fused_multi_transformer_op.cu.h:1546
+    RotrayKernel): the head dim splits into `dims` groups of
+    last = hd/dims; within a group, out_left = l*cos - r*sin and
+    out_right = r*cos + l*sin (rotate-half / GPT-NeoX form), with
+    cos/sin indexed by the group's first half.
+
+    x [B,T,H,hd]; cos/sin [B,T,hd] (the reference's [2,B,1,S,hd]
+    rotary_embs viewed per position, already sliced to this call's T
+    positions)."""
+    B, T, Hn, hd_ = x.shape
+    last = hd_ // dims
+    half = last // 2
+    xr = x.reshape(B, T, Hn, dims, last)
+    left, right = xr[..., :half], xr[..., half:]
+    # the kernel reads cos/sin at the group's FIRST-half offsets
+    cs = cos.reshape(B, T, 1, dims, last)[..., :half]
+    sn = sin.reshape(B, T, 1, dims, last)[..., :half]
+    out_left = left * cs - right * sn
+    out_right = right * cs + left * sn
+    return jnp.concatenate([out_left, out_right],
+                           axis=-1).reshape(B, T, Hn, hd_).astype(x.dtype)
+
+
+def _stack_forward(x, kcache, vcache, pv, pos, H, hd, act, bias=None,
+                   rotary=None, rotary_dims=1):
     # pv is already in scan order: 12 stacked tensors, +4 weight scales
     # when weight-only-quantized (block unpacks per-layer slices by count)
     B, T, D = x.shape
@@ -228,6 +286,28 @@ def _stack_forward(x, kcache, vcache, pv, pos, H, hd, act, bias=None):
     use_cache = kcache is not None
     scale = 1.0 / math.sqrt(hd)
 
+    # cos/sin for THIS call's T positions are layer-invariant: slice ONCE
+    # here, not inside the scan body (XLA won't reliably hoist a
+    # loop-invariant dynamic_slice out of the compiled While loop)
+    rot_t = None
+    if rotary is not None:
+        cos_full, sin_full = rotary
+        S_table = cos_full.shape[1]
+        S_need = kcache.shape[2] if use_cache else T
+        if S_table < S_need:
+            # dynamic_slice would silently CLAMP the start index and
+            # rotate late tokens with the wrong positions — fail loudly
+            # at trace time instead
+            raise ValueError(
+                f"rotary_embs covers {S_table} positions but the "
+                f"{'cache length' if use_cache else 'sequence'} is "
+                f"{S_need}")
+        p0 = jnp.asarray(pos, jnp.int32).reshape(())
+        zero = jnp.zeros((), jnp.int32)
+        rot_t = (
+            jax.lax.dynamic_slice(cos_full, (zero, p0, zero), (B, T, hd)),
+            jax.lax.dynamic_slice(sin_full, (zero, p0, zero), (B, T, hd)))
+
     def block(h, layer):
         if use_cache:
             *ws, kc, vc = layer
@@ -242,6 +322,9 @@ def _stack_forward(x, kcache, vcache, pv, pos, H, hd, act, bias=None):
         q = q.reshape(B, T, H, hd)
         k_ = k_.reshape(B, T, H, hd)
         v = v.reshape(B, T, H, hd)
+        if rot_t is not None:
+            q = _apply_rotary(q, rot_t[0], rot_t[1], rotary_dims)
+            k_ = _apply_rotary(k_, rot_t[0], rot_t[1], rotary_dims)
         if use_cache:
             # pos is a traced scalar: one compiled computation serves
             # every decode step (dynamic_update_slice takes traced starts)

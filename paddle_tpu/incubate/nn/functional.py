@@ -200,7 +200,7 @@ def fused_multi_transformer(
     if not pre_layer_norm:
         raise NotImplementedError(
             "fused_multi_transformer is pre-LN only (reference default)")
-    if cache_kvs is not None or rotary_embs is not None:
+    if cache_kvs is not None:
         raise NotImplementedError(
             "functional fused_multi_transformer here serves the no-cache "
             "forward; use the FusedMultiTransformer layer for cached "
@@ -234,8 +234,13 @@ def fused_multi_transformer(
     pos = jnp.asarray(0, jnp.int32)
     bias = (_v(attn_mask).astype(jnp.float32)
             if attn_mask is not None else None)
+    rot = None
+    if rotary_embs is not None and rotary_emb_dims:
+        from ..fused_multi_transformer import _rotary_tables
+        rot = _rotary_tables(rotary_embs)
     out = _stack_forward(_v(x), None, None, pv, pos, H, hd, activation,
-                         bias)[0]
+                         bias, rotary=rot,
+                         rotary_dims=int(rotary_emb_dims))[0]
     return Tensor(out)
 
 

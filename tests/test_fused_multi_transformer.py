@@ -170,3 +170,93 @@ class TestWeightOnlyInt8:
         fresh.set_state_dict(sd)
         assert np.asarray(fresh.qkv_weights._value).dtype == np.int8
         np.testing.assert_allclose(fresh(src).numpy(), want, atol=1e-6)
+
+
+class TestRotary:
+    """rotary_embs parity with the reference RotrayKernel semantics
+    (fused_multi_transformer_op.cu.h:1546): rotate-half per
+    rotary_emb_dims group, cos/sin from the [2, B, 1, S, hd] table."""
+
+    def _rotary_table(self, B, S, hd, seed=3):
+        # real RoPE-style table (repeated half layout like the
+        # reference's GPT rotary helpers build)
+        inv = 1.0 / (10000 ** (np.arange(0, hd, 2) / hd))
+        t = np.arange(S)[:, None] * inv[None, :]          # [S, hd/2]
+        emb = np.concatenate([t, t], axis=-1)             # [S, hd]
+        cos = np.cos(emb)[None].repeat(B, 0)              # [B, S, hd]
+        sin = np.sin(emb)[None].repeat(B, 0)
+        return np.stack([cos, sin])[:, :, None].astype(np.float32)
+
+    @staticmethod
+    def _oracle(x, cos, sin, dims):
+        """Direct numpy mirror of the CUDA RotrayKernel loop."""
+        B, T, H, hd = x.shape
+        last = hd // dims
+        half = last // 2
+        out = x.copy()
+        for b in range(B):
+            for t in range(T):
+                for h in range(H):
+                    for d in range(dims):
+                        for i in range(half):
+                            li = d * last + i
+                            ri = d * last + i + half
+                            c = cos[b, t, li]
+                            s = sin[b, t, li]
+                            l_, r_ = x[b, t, h, li], x[b, t, h, ri]
+                            out[b, t, h, li] = l_ * c - r_ * s
+                            out[b, t, h, ri] = r_ * c + l_ * s
+        return out
+
+    @pytest.mark.parametrize("dims", [1, 2])
+    def test_apply_rotary_matches_reference_kernel(self, dims):
+        from paddle_tpu.incubate.fused_multi_transformer import \
+            _apply_rotary
+        rng = np.random.RandomState(0)
+        B, T, H, hd = 2, 5, 3, 8
+        x = rng.randn(B, T, H, hd).astype(np.float32)
+        tab = self._rotary_table(B, T, hd)
+        cos, sin = tab[0][:, 0], tab[1][:, 0]             # [B, T, hd]
+        got = np.asarray(_apply_rotary(jnp.asarray(x), jnp.asarray(cos),
+                                       jnp.asarray(sin), dims))
+        want = self._oracle(x, cos, sin, dims)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_rotary_decode_matches_full_forward(self, model):
+        """Cached decode with rotary must agree with the uncached
+        rotary forward — positions must line up through time_step."""
+        src = _src(T=6)
+        tab = paddle.to_tensor(self._rotary_table(2, 10, 8))
+        full = model(src, rotary_embs=paddle.to_tensor(
+            self._rotary_table(2, 6, 8)), rotary_emb_dims=1).numpy()
+        caches = model.gen_cache(batch=2, max_len=10)
+        prefix = paddle.to_tensor(src.numpy()[:, :4])
+        _, caches = model(prefix, caches=caches, time_step=0,
+                          rotary_embs=tab, rotary_emb_dims=1)
+        for t in (4, 5):
+            step_in = paddle.to_tensor(src.numpy()[:, t:t + 1])
+            out, caches = model(step_in, caches=caches, time_step=t,
+                                rotary_embs=tab, rotary_emb_dims=1)
+        np.testing.assert_allclose(out.numpy()[:, 0], full[:, 5],
+                                   atol=1e-4, rtol=1e-4)
+
+    def test_rotary_changes_output(self, model):
+        src = _src()
+        plain = model(src).numpy()
+        rot = model(src, rotary_embs=paddle.to_tensor(
+            self._rotary_table(2, 6, 8)), rotary_emb_dims=1).numpy()
+        # near-init attention scores are ~0, so softmax dampens the
+        # rotation's effect — assert measurable, not large (the oracle
+        # parity test above pins the exact rotation semantics)
+        assert np.abs(plain - rot).max() > 1e-5
+
+    def test_rotary_table_too_short_fails_loudly(self, model):
+        """A table shorter than the cache would silently clamp the
+        dynamic_slice and rotate late tokens at wrong positions —
+        must raise at trace time instead."""
+        src = _src(T=4)
+        caches = model.gen_cache(batch=2, max_len=16)
+        short = paddle.to_tensor(self._rotary_table(2, 8, 8))
+        with pytest.raises(Exception, match="rotary_embs covers"):
+            model(src, caches=caches, time_step=0,
+                  rotary_embs=short, rotary_emb_dims=1)
