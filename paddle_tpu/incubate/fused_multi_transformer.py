@@ -193,6 +193,13 @@ class FusedMultiTransformer(Layer):
 
         rot = ()
         if rot_dims:
+            if caches is None and time_step is not None:
+                # without caches the stack always runs from position 0;
+                # honoring time_step here would make the rotary slice
+                # clamp silently past a full-length table
+                raise ValueError(
+                    "time_step requires caches; the no-cache forward "
+                    "rotates from position 0")
             cos, sin = _rotary_tables(rotary_embs)
             rot = (Tensor(cos), Tensor(sin))
 
@@ -237,7 +244,10 @@ def _rotary_tables(rotary_embs):
     for this extraction (layer forward + functional entry share it)."""
     rv = rotary_embs._value if isinstance(rotary_embs, Tensor) \
         else jnp.asarray(rotary_embs)
-    if rv.ndim != 5 or rv.shape[0] != 2:
+    if rv.ndim != 5 or rv.shape[0] != 2 or rv.shape[2] != 1:
+        # shape[2] must be the literal 1 of the reference layout — a
+        # per-head [2, B, H, S, hd] table would otherwise silently
+        # reduce to head 0's angles for every head
         raise ValueError(
             f"rotary_embs must be the reference's [2, B, 1, S, head_dim] "
             f"cos/sin table; got shape {tuple(rv.shape)}")

@@ -74,15 +74,58 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     return out, None
 
 
+@defop("flash_attn_unpadded_op")
+def _flash_attn_unpadded(q, k, v, cu_q, cu_k, key, scale, dropout_p,
+                         causal, training, want_softmax):
+    # packed varlen: q/k/v [total, H, D]; cu_* [B+1] cumulative lengths.
+    # TPU-native form: segment ids from searchsorted give a static-shape
+    # block-diagonal mask — the data-dependent raggedness lives in the
+    # mask VALUES, not the shapes, so one compiled graph serves every
+    # packing (XLA requires static shapes; a CUDA varlen kernel indexes
+    # ragged rows instead).
+    total_q, total_k = q.shape[0], k.shape[0]
+    cu_q = cu_q.astype(jnp.int32)
+    cu_k = cu_k.astype(jnp.int32)
+    seg_q = jnp.searchsorted(cu_q, jnp.arange(total_q), side="right") - 1
+    seg_k = jnp.searchsorted(cu_k, jnp.arange(total_k), side="right") - 1
+    pos_q = jnp.arange(total_q) - cu_q[seg_q]
+    pos_k = jnp.arange(total_k) - cu_k[seg_k]
+    valid = seg_q[:, None] == seg_k[None, :]
+    if causal:
+        valid = jnp.logical_and(valid, pos_q[:, None] >= pos_k[None, :])
+    scores = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    scores = jnp.where(valid[None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    # rows whose segment has zero kv tokens: all-masked → force 0
+    probs = jnp.where(valid[None], probs, 0.0).astype(q.dtype)
+    if dropout_p > 0.0 and training:
+        keep = 1.0 - dropout_p
+        dmask = jax.random.bernoulli(key, keep, probs.shape)
+        probs = jnp.where(dmask, probs / keep, 0.0).astype(q.dtype)
+    out = jnp.einsum("hqk,khd->qhd", probs, v.astype(probs.dtype))
+    out = out.astype(q.dtype)
+    # want_softmax is a static (literal-baked) arg: the O(H*total^2)
+    # probs buffer is only a compiled output when asked for — returned
+    # op outputs can't be DCE'd by XLA
+    return (out, probs) if want_softmax else out
+
+
 def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                         max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
                         causal=False, return_softmax=False,
                         fixed_seed_offset=None, rng_name="", training=True,
                         name=None):
-    # varlen packing: fall back to dense with mask built from cu_seqlens
-    raise NotImplementedError(
-        "varlen flash attention: pack ragged batches densely; TPU path "
-        "requires static shapes")
+    """Varlen (packed, unpadded) attention: query/key/value
+    [total_seq_len, num_heads, head_dim] with cu_seqlens_* [batch+1]
+    boundaries; returns the packed [total_seq_len, num_heads, head_dim]
+    output (reference flash_attention.py:269). Sequences attend only
+    within their own segment."""
+    args = (query, key, value, cu_seqlens_q, cu_seqlens_k, next_key(),
+            float(scale), float(dropout), bool(causal), bool(training))
+    if return_softmax:
+        return _flash_attn_unpadded(*args, True)
+    return _flash_attn_unpadded(*args, False), None
 
 
 @defop("memory_efficient_attention_op")
@@ -111,11 +154,48 @@ def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0,
 
 
 @defop("sparse_attention_op")
-def _sparse_attention(q, k, v, offset, columns):
-    raise NotImplementedError
+def _sparse_attention(q, k, v, offset, columns, kp_mask, attn_mask):
+    # q/k/v [B, H, S, D]; offset [B, H, S+1] CSR row starts; columns
+    # [B, H, nnz] allowed column ids. TPU-native: the CSR layout
+    # scatters into a static [S, S] boolean mask per (b, h) — ragged
+    # row lengths live in mask VALUES, keeping shapes static for XLA —
+    # then one masked-softmax attention body runs on the MXU.
+    B, H, S, D = q.shape
+    nnz = columns.shape[-1]
+    offset = offset.astype(jnp.int32).reshape(B * H, S + 1)
+    columns = columns.astype(jnp.int32).reshape(B * H, nnz)
+
+    def one_mask(off, cols):
+        row = jnp.searchsorted(off, jnp.arange(nnz), side="right") - 1
+        live = jnp.arange(nnz) < off[-1]       # entries past nnz tail
+        row = jnp.clip(row, 0, S - 1)
+        m = jnp.zeros((S, S), bool)
+        return m.at[row, cols].max(live)
+
+    mask = jax.vmap(one_mask)(offset, columns).reshape(B, H, S, S)
+    scale = 1.0 / math.sqrt(D)
+    scores = jnp.einsum("bhsd,bhtd->bhst", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    if kp_mask is not None:
+        # [B, S] key-padding mask, 0 = masked (reference contract)
+        mask = jnp.logical_and(mask,
+                               (kp_mask != 0)[:, None, None, :])
+    if attn_mask is not None:
+        # [S, S], 0 = masked
+        mask = jnp.logical_and(mask, (attn_mask != 0)[None, None])
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    probs = jnp.where(mask, probs, 0.0)        # all-masked rows → 0
+    return jnp.einsum("bhst,bhtd->bhsd", probs,
+                      v.astype(jnp.float32)).astype(q.dtype)
 
 
-def sparse_attention(*args, **kwargs):
-    raise NotImplementedError(
-        "block-sparse attention: use flash_attention with causal masking; "
-        "a Pallas block-sparse kernel is on the roadmap")
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """CSR block-sparse attention (reference
+    python/paddle/nn/functional/sparse_attention.py:19): each query row
+    attends only to its CSR row's columns."""
+    return _sparse_attention(query, key, value, sparse_csr_offset,
+                             sparse_csr_columns, key_padding_mask,
+                             attn_mask)

@@ -71,7 +71,7 @@ ALIASES: Dict[str, str] = {
     "fft_c2r": "fft:irfft",
     "fft_r2c": "fft:rfft",
     "flash_attn": "kernels.flash_attention:flash_attention",
-    "flash_attn_unpadded": "kernels.flash_attention:flash_attention",
+    "flash_attn_unpadded": "nn.functional.attention:flash_attn_unpadded",
     "frame": "signal:frame",
     "frobenius_norm": "ops.math:frobenius_norm",
     "fold": "nn.functional:fold",

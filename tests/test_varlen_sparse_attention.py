@@ -1,0 +1,177 @@
+"""Varlen (packed) and CSR block-sparse attention vs per-sequence /
+per-row dense oracles (reference
+python/paddle/nn/functional/flash_attention.py:269 flash_attn_unpadded,
+python/paddle/nn/functional/sparse_attention.py:19)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def _softmax(x, axis=-1):
+    m = x.max(axis=axis, keepdims=True)
+    e = np.exp(x - m)
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+class TestFlashAttnUnpadded:
+    def _packed(self, lens, H=2, D=16, seed=0):
+        rng = np.random.RandomState(seed)
+        total = sum(lens)
+        q = rng.randn(total, H, D).astype(np.float32)
+        k = rng.randn(total, H, D).astype(np.float32)
+        v = rng.randn(total, H, D).astype(np.float32)
+        cu = np.cumsum([0] + list(lens)).astype(np.int32)
+        return q, k, v, cu
+
+    def _oracle(self, q, k, v, cu, scale, causal):
+        out = np.zeros_like(q)
+        for b in range(len(cu) - 1):
+            s, e = cu[b], cu[b + 1]
+            for h in range(q.shape[1]):
+                sc = q[s:e, h] @ k[s:e, h].T * scale
+                if causal:
+                    L = e - s
+                    sc = np.where(np.tril(np.ones((L, L), bool)), sc,
+                                  -1e30)
+                out[s:e, h] = _softmax(sc) @ v[s:e, h]
+        return out
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_per_sequence_oracle(self, causal):
+        lens = [3, 7, 5]
+        q, k, v, cu = self._packed(lens)
+        scale = 1.0 / np.sqrt(q.shape[-1])
+        out, sm = F.flash_attn_unpadded(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            paddle.to_tensor(cu), paddle.to_tensor(cu), max(lens),
+            max(lens), float(scale), causal=causal)
+        assert sm is None
+        want = self._oracle(q, k, v, cu, scale, causal)
+        np.testing.assert_allclose(out.numpy(), want, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_no_cross_sequence_leakage(self):
+        """Scrambling sequence 2 must not change sequence 1's output."""
+        lens = [4, 6]
+        q, k, v, cu = self._packed(lens)
+        scale = 1.0 / np.sqrt(q.shape[-1])
+
+        def run(kv_mod):
+            out, _ = F.flash_attn_unpadded(
+                paddle.to_tensor(q), paddle.to_tensor(kv_mod),
+                paddle.to_tensor(v), paddle.to_tensor(cu),
+                paddle.to_tensor(cu), 6, 6, float(scale))
+            return out.numpy()
+
+        a = run(k)
+        k2 = k.copy()
+        # perturb ONE key of sequence 2 (a uniform shift across a whole
+        # segment is a per-row constant in the scores — softmax-invariant)
+        k2[5] += 9.0
+        b = run(k2)
+        np.testing.assert_allclose(a[:4], b[:4], atol=1e-5)
+        assert np.abs(a[4:] - b[4:]).max() > 1e-3
+
+    def test_return_softmax(self):
+        lens = [3, 5]
+        q, k, v, cu = self._packed(lens)
+        out, sm = F.flash_attn_unpadded(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            paddle.to_tensor(cu), paddle.to_tensor(cu), 5, 5,
+            float(1.0 / np.sqrt(16)), return_softmax=True)
+        p = sm.numpy()
+        assert p.shape == (2, 8, 8)
+        # rows sum to 1 inside their segment, cross-segment mass is 0
+        np.testing.assert_allclose(p.sum(-1), 1.0, atol=1e-5)
+        assert np.abs(p[:, :3, 3:]).max() == 0
+
+
+class TestSparseAttention:
+    def _data(self, B=2, H=2, S=8, D=16, seed=1):
+        rng = np.random.RandomState(seed)
+        q = rng.randn(B, H, S, D).astype(np.float32)
+        k = rng.randn(B, H, S, D).astype(np.float32)
+        v = rng.randn(B, H, S, D).astype(np.float32)
+        # random CSR sparsity: each row keeps a random subset
+        offset = np.zeros((B, H, S + 1), np.int32)
+        cols = []
+        for b in range(B):
+            for h in range(H):
+                row_cols = []
+                for s in range(S):
+                    keep = sorted(rng.choice(
+                        S, rng.randint(1, S + 1), replace=False))
+                    offset[b, h, s + 1] = offset[b, h, s] + len(keep)
+                    row_cols.extend(keep)
+                cols.append(row_cols)
+        nnz = max(len(c) for c in cols)
+        columns = np.zeros((B, H, nnz), np.int32)
+        for i, c in enumerate(cols):
+            columns[i // H, i % H, :len(c)] = c
+        return q, k, v, offset, columns
+
+    def _oracle(self, q, k, v, offset, columns, kp=None, am=None):
+        B, H, S, D = q.shape
+        out = np.zeros_like(q)
+        scale = 1.0 / np.sqrt(D)
+        for b in range(B):
+            for h in range(H):
+                sc = q[b, h] @ k[b, h].T * scale
+                mask = np.zeros((S, S), bool)
+                for s in range(S):
+                    cs = columns[b, h, offset[b, h, s]:offset[b, h, s + 1]]
+                    mask[s, cs] = True
+                if kp is not None:
+                    mask &= (kp[b] != 0)[None, :]
+                if am is not None:
+                    mask &= (am != 0)
+                sc = np.where(mask, sc, -1e30)
+                p = _softmax(sc)
+                p = np.where(mask, p, 0.0)
+                out[b, h] = p @ v[b, h]
+        return out
+
+    def test_matches_dense_oracle(self):
+        q, k, v, offset, columns = self._data()
+        out = F.sparse_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            paddle.to_tensor(offset), paddle.to_tensor(columns))
+        want = self._oracle(q, k, v, offset, columns)
+        np.testing.assert_allclose(out.numpy(), want, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_masks_compose(self):
+        q, k, v, offset, columns = self._data()
+        kp = np.ones((2, 8), np.float32)
+        kp[:, -2:] = 0
+        am = np.ones((8, 8), np.float32)
+        am[0, :4] = 0
+        out = F.sparse_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            paddle.to_tensor(offset), paddle.to_tensor(columns),
+            key_padding_mask=paddle.to_tensor(kp),
+            attn_mask=paddle.to_tensor(am))
+        want = self._oracle(q, k, v, offset, columns, kp, am)
+        np.testing.assert_allclose(out.numpy(), want, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_masked_columns_do_not_leak(self):
+        """Values at columns outside a row's CSR set must not affect it."""
+        q, k, v, offset, columns = self._data(B=1, H=1)
+        out_a = F.sparse_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            paddle.to_tensor(offset), paddle.to_tensor(columns)).numpy()
+        # perturb v at a column row 0 does NOT attend to (if any)
+        row0 = set(columns[0, 0, offset[0, 0, 0]:offset[0, 0, 1]])
+        outside = [c for c in range(8) if c not in row0]
+        if outside:
+            v2 = v.copy()
+            v2[0, 0, outside[0]] += 50.0
+            out_b = F.sparse_attention(
+                paddle.to_tensor(q), paddle.to_tensor(k),
+                paddle.to_tensor(v2), paddle.to_tensor(offset),
+                paddle.to_tensor(columns)).numpy()
+            np.testing.assert_allclose(out_a[0, 0, 0], out_b[0, 0, 0],
+                                       atol=1e-5)
