@@ -39,7 +39,9 @@ PERF = os.path.join(HERE, "perf")
 STATE_PATH = os.path.join(PERF, "campaign_state.json")
 BUSY_PATH = os.path.join(PERF, "TPU_BUSY")
 PROBE_TIMEOUT = 240
-PROBE_SLEEP = 600          # between probes while the tunnel is dead
+# round-3 windows were as short as ~10 min: a long sleep can consume
+# most of one. A probe is one cheap subprocess; keep the cadence tight.
+PROBE_SLEEP = 240          # between probes while the tunnel is dead
 MIDQUEUE_PROBE_TIMEOUT = 180
 
 # name -> (argv-tail, timeout_s, env-extra)
