@@ -93,8 +93,7 @@ class ESC50(AudioClassificationDataset):
     def __init__(self, mode: str = "train", split: int = 1,
                  feat_type: str = "raw", root: str = None, **kwargs):
         assert split in range(1, 6), (
-            f"The selected split should be integer, and 1 <= split <= 5, "
-            f"but got {split}")
+            f"split picks one of ESC-50's 5 folds (1-5); got {split}")
         if root is None:
             raise NotImplementedError(
                 "ESC50 download needs network egress; pass root= pointing "
@@ -139,11 +138,9 @@ class TESS(AudioClassificationDataset):
                  split: int = 1, feat_type: str = "raw", root: str = None,
                  **kwargs):
         assert isinstance(n_folds, int) and n_folds >= 1, (
-            f"the n_folds should be integer and n_folds >= 1, "
-            f"but got {n_folds}")
+            f"n_folds needs to be a positive integer; got {n_folds}")
         assert split in range(1, n_folds + 1), (
-            f"The selected split should be integer and should be "
-            f"1 <= split <= {n_folds}, but got {split}")
+            f"split picks a fold in 1..{n_folds}; got {split}")
         if root is None:
             raise NotImplementedError(
                 "TESS download needs network egress; pass root= pointing "

@@ -86,32 +86,38 @@ class Transform:
     def forward_log_det_jacobian(self, x):
         if not self._is_injective():
             raise NotImplementedError(
-                "forward_log_det_jacobian is only defined for injective "
-                "transforms")
+                f"{type(self).__name__} is not injective, so its forward "
+                "Jacobian log-determinant is undefined")
         return _t(self._call_forward_ldj(_v(x)))
 
     def inverse_log_det_jacobian(self, y):
         return _t(self._call_inverse_ldj(_v(y)))
 
+    # a subclass may implement either direction of the log-det; the other
+    # is recovered by sign flip through the pullback
     def _call_forward_ldj(self, x):
-        if hasattr(self, "_forward_log_det_jacobian"):
-            return self._forward_log_det_jacobian(x)
-        if hasattr(self, "_inverse_log_det_jacobian"):
-            return -self._inverse_log_det_jacobian(self._forward(x))
+        fwd = getattr(self, "_forward_log_det_jacobian", None)
+        if fwd is not None:
+            return fwd(x)
+        inv = getattr(self, "_inverse_log_det_jacobian", None)
+        if inv is not None:
+            return -inv(self._forward(x))
         raise NotImplementedError(
-            "Neither _forward_log_det_jacobian nor "
-            "_inverse_log_det_jacobian is implemented. One of them is "
-            "required.")
+            f"{type(self).__name__} defines no Jacobian log-determinant; "
+            "implement _forward_log_det_jacobian or "
+            "_inverse_log_det_jacobian")
 
     def _call_inverse_ldj(self, y):
-        if hasattr(self, "_inverse_log_det_jacobian"):
-            return self._inverse_log_det_jacobian(y)
-        if hasattr(self, "_forward_log_det_jacobian"):
-            return -self._forward_log_det_jacobian(self._inverse(y))
+        inv = getattr(self, "_inverse_log_det_jacobian", None)
+        if inv is not None:
+            return inv(y)
+        fwd = getattr(self, "_forward_log_det_jacobian", None)
+        if fwd is not None:
+            return -fwd(self._inverse(y))
         raise NotImplementedError(
-            "Neither _forward_log_det_jacobian nor "
-            "_inverse_log_det_jacobian is implemented. One of them is "
-            "required.")
+            f"{type(self).__name__} defines no Jacobian log-determinant; "
+            "implement _forward_log_det_jacobian or "
+            "_inverse_log_det_jacobian")
 
     def forward_shape(self, shape):
         return tuple(self._forward_shape(tuple(shape)))
@@ -189,7 +195,8 @@ class ChainTransform(Transform):
         if not isinstance(transforms, Sequence) or not all(
                 isinstance(t, Transform) for t in transforms):
             raise TypeError(
-                "transforms must be a Sequence of Transform")
+                "ChainTransform takes a sequence of Transform instances; "
+                f"got {transforms!r}")
         self.transforms = list(transforms)
 
     def _is_injective(self):
@@ -275,10 +282,12 @@ class IndependentTransform(Transform):
 
     def __init__(self, base, reinterpreted_batch_rank):
         if not isinstance(base, Transform):
-            raise TypeError("base must be a Transform")
+            raise TypeError(
+                f"base should be a Transform; got {type(base).__name__}")
         if reinterpreted_batch_rank <= 0:
             raise ValueError(
-                "reinterpreted_batch_rank must be positive")
+                "reinterpreted_batch_rank should be a positive integer; "
+                f"got {reinterpreted_batch_rank}")
         self._base = base
         self._reinterpreted_batch_rank = reinterpreted_batch_rank
         self._type = base._type
@@ -357,8 +366,10 @@ class ReshapeTransform(Transform):
         out_event_shape = tuple(out_event_shape)
         if (math.prod(in_event_shape) != math.prod(out_event_shape)):
             raise ValueError(
-                f"The numel of 'in_event_shape' should be 'out_event_"
-                f"shape', but got {math.prod(in_event_shape)} != "
+                "a reshape cannot change the element count: "
+                f"in_event_shape {in_event_shape} holds "
+                f"{math.prod(in_event_shape)} elements while "
+                f"out_event_shape {out_event_shape} holds "
                 f"{math.prod(out_event_shape)}")
         self._in_event_shape = in_event_shape
         self._out_event_shape = out_event_shape
@@ -388,8 +399,8 @@ class ReshapeTransform(Transform):
         if len(shape) < n or tuple(
                 shape[len(shape) - n:]) != self._in_event_shape:
             raise ValueError(
-                f"Expected shape ends with {self._in_event_shape}, "
-                f"but got {shape}")
+                f"shape {shape} does not end in the event shape "
+                f"{self._in_event_shape} this transform reshapes")
         return tuple(shape[:len(shape) - n]) + self._out_event_shape
 
     def _inverse_shape(self, shape):
@@ -397,8 +408,8 @@ class ReshapeTransform(Transform):
         if len(shape) < n or tuple(
                 shape[len(shape) - n:]) != self._out_event_shape:
             raise ValueError(
-                f"Expected shape ends with {self._out_event_shape}, "
-                f"but got {shape}")
+                f"shape {shape} does not end in the event shape "
+                f"{self._out_event_shape} this transform reshapes")
         return tuple(shape[:len(shape) - n]) + self._in_event_shape
 
     @property
@@ -447,8 +458,8 @@ class SoftmaxTransform(Transform):
     def _forward_shape(self, shape):
         if len(shape) < 1:
             raise ValueError(
-                f"Expected length of shape is grater than 1, "
-                f"but got {len(shape)}")
+                "softmax needs at least one axis to normalize over; "
+                f"got a rank-{len(shape)} shape")
         return shape
 
     _inverse_shape = _forward_shape
@@ -470,7 +481,8 @@ class StackTransform(Transform):
         if not transforms or not all(
                 isinstance(t, Transform) for t in transforms):
             raise TypeError(
-                "transforms must be a non-empty Sequence of Transform")
+                "StackTransform takes a non-empty sequence of Transform "
+                f"instances; got {transforms!r}")
         self._transforms = list(transforms)
         self._axis = axis
 
@@ -515,46 +527,52 @@ class StackTransform(Transform):
 
 class StickBreakingTransform(Transform):
     """R^K -> (K+1)-simplex by stick-breaking (reference
-    transform.py:1147)."""
+    transform.py:1147).
+
+    Break k of the unit stick takes fraction sigmoid(x_k - log(K - k))
+    of what remains; the shift centres x = 0 on the uniform simplex."""
 
     _type = Type.BIJECTION
 
+    @staticmethod
+    def _countdown(k, dtype):
+        # [K, K-1, ..., 1]: sticks still unbroken at each step
+        return jnp.arange(k, 0, -1, dtype=dtype)
+
     def _forward(self, x):
-        K = x.shape[-1]
-        offset = K + 1 - jnp.cumsum(jnp.ones((K,), x.dtype), -1)
-        z = jax.nn.sigmoid(x - jnp.log(offset))
-        z_cumprod = jnp.cumprod(1 - z, -1)
-        pad = [(0, 0)] * (x.ndim - 1)
-        return (jnp.pad(z, pad + [(0, 1)], constant_values=1.0)
-                * jnp.pad(z_cumprod, pad + [(1, 0)],
-                          constant_values=1.0))
+        frac = jax.nn.sigmoid(
+            x - jnp.log(self._countdown(x.shape[-1], x.dtype)))
+        # left[k] = stick remaining before break k; the leading 1 keeps
+        # the K=0 degenerate case on the 1-point simplex
+        left = jnp.concatenate(
+            [jnp.ones(x.shape[:-1] + (1,), x.dtype),
+             jnp.cumprod(1.0 - frac, -1)], -1)
+        return jnp.concatenate(
+            [frac * left[..., :-1], left[..., -1:]], -1)
 
     def _inverse(self, y):
-        y_crop = y[..., :-1]
-        K = y_crop.shape[-1]
-        offset = (y.shape[-1]
-                  - jnp.cumsum(jnp.ones((K,), y.dtype), -1))
-        sf = 1.0 - jnp.cumsum(y_crop, -1)
-        return jnp.log(y_crop) - jnp.log(sf) + jnp.log(offset)
+        probs = y[..., :-1]
+        left = 1.0 - jnp.cumsum(probs, -1)  # stick remaining before break k+1
+        down = self._countdown(probs.shape[-1], y.dtype)
+        return jnp.log(probs) - jnp.log(left) + jnp.log(down)
 
     def _forward_log_det_jacobian(self, x):
+        t = x - jnp.log(self._countdown(x.shape[-1], x.dtype))
         y = self._forward(x)
-        K = x.shape[-1]
-        offset = K + 1 - jnp.cumsum(jnp.ones((K,), x.dtype), -1)
-        x = x - jnp.log(offset)
-        return (-x + jax.nn.log_sigmoid(x)
-                + jnp.log(y[..., :-1])).sum(-1)
+        return (jax.nn.log_sigmoid(t) - t + jnp.log(y[..., :-1])).sum(-1)
 
     def _forward_shape(self, shape):
         if not shape:
             raise ValueError(
-                f"Expected 'shape' is not empty, but got {shape}")
+                "stick-breaking needs a trailing stick axis; got a "
+                "rank-0 shape")
         return shape[:-1] + (shape[-1] + 1,)
 
     def _inverse_shape(self, shape):
         if not shape:
             raise ValueError(
-                f"Expected 'shape' is not empty, but got {shape}")
+                "stick-breaking needs a trailing simplex axis; got a "
+                "rank-0 shape")
         return shape[:-1] + (shape[-1] - 1,)
 
     @property

@@ -20,13 +20,13 @@ class Independent(Distribution):
     def __init__(self, base, reinterpreted_batch_rank):
         if not isinstance(base, Distribution):
             raise TypeError(
-                f"Expected type of 'base' is Distribution, but got "
-                f"{type(base)}")
+                "Independent wraps a Distribution; got "
+                f"{type(base).__name__}")
         if not 0 < reinterpreted_batch_rank <= len(base.batch_shape):
             raise ValueError(
-                f"Expected 0 < reinterpreted_batch_rank <= "
-                f"{len(base.batch_shape)}, but got "
-                f"{reinterpreted_batch_rank}")
+                f"reinterpreted_batch_rank {reinterpreted_batch_rank} "
+                "is outside the base distribution's batch rank "
+                f"(1..{len(base.batch_shape)})")
         self._base = base
         self._reinterpreted_batch_rank = reinterpreted_batch_rank
         cut = len(base.batch_shape) - reinterpreted_batch_rank
@@ -65,12 +65,13 @@ class TransformedDistribution(Distribution):
     def __init__(self, base, transforms):
         if not isinstance(base, Distribution):
             raise TypeError(
-                f"Expected type of 'base' is Distribution, but got "
-                f"{type(base)}.")
+                "TransformedDistribution wraps a Distribution; got "
+                f"{type(base).__name__}")
         if not isinstance(transforms, Sequence) or not all(
                 isinstance(t, Transform) for t in transforms):
             raise TypeError(
-                "Expected type of 'transforms' is Sequence[Transform].")
+                "transforms should be a sequence of Transform "
+                f"instances; got {transforms!r}")
         chain = ChainTransform(transforms)
         self._base = base
         self._transforms = list(transforms)
@@ -80,8 +81,10 @@ class TransformedDistribution(Distribution):
         base_shape = base.batch_shape + base.event_shape
         if len(base_shape) < chain._domain.event_rank:
             raise ValueError(
-                f"'base' needs to have shape with size at least "
-                f"{chain._domain.event_rank}, but got {len(base_shape)}.")
+                f"the transform chain consumes rank-"
+                f"{chain._domain.event_rank} events but the base "
+                f"distribution only produces rank-{len(base_shape)} "
+                "values")
         if chain._domain.event_rank > len(base.event_shape):
             base = Independent(
                 base, chain._domain.event_rank - len(base.event_shape))

@@ -49,16 +49,15 @@ class Independent(Variable):
             base.event_rank + reinterpreted_batch_rank)
 
     def constraint(self, value):
-        ret = self._base.constraint(value)
-        if ret.ndim < self._reinterpreted_batch_rank:
+        ok = self._base.constraint(value)
+        n = self._reinterpreted_batch_rank
+        if ok.ndim < n:
             raise ValueError(
-                f"Input dimensions must be equal or grater than "
-                f"{self._reinterpreted_batch_rank}")
-        if self._reinterpreted_batch_rank == 0:
-            return ret
-        return ret.reshape(
-            ret.shape[:ret.ndim - self._reinterpreted_batch_rank]
-            + (-1,)).all(-1)
+                f"cannot fold {n} batch axes into the event: the base "
+                f"constraint check only has rank {ok.ndim}")
+        if n == 0:
+            return ok
+        return ok.reshape(ok.shape[:ok.ndim - n] + (-1,)).all(-1)
 
 
 class Stack(Variable):
@@ -84,8 +83,8 @@ class Stack(Variable):
     def constraint(self, value):
         if not (-value.ndim <= self._axis < value.ndim):
             raise ValueError(
-                f"Input dimensions {value.ndim} should be grater than "
-                f"stack constraint axis {self._axis}.")
+                f"stack axis {self._axis} is out of range for a "
+                f"rank-{value.ndim} value")
         slices = jnp.split(value, len(self._vars), self._axis)
         return jnp.stack(
             [v.constraint(jnp.squeeze(s, self._axis))

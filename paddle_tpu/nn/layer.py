@@ -115,7 +115,7 @@ class Layer:
             if d is not None and name in d:
                 return d[name]
         raise AttributeError(
-            f"'{type(self).__name__}' object has no attribute {name!r}")
+            f"{type(self).__name__!r} object has no attribute {name!r}")
 
     def __delattr__(self, name):
         for store in ("_parameters", "_sub_layers", "_buffers"):

@@ -191,8 +191,7 @@ def take(x, index, mode="raise", name=None):
     reference's eager path)."""
     if mode not in ("raise", "wrap", "clip"):
         raise ValueError(
-            f"'mode' in 'take' should be 'raise', 'wrap', 'clip', "
-            f"but received {mode}.")
+            f"take() mode {mode!r} is not one of 'raise'/'wrap'/'clip'")
     if mode == "raise":
         # host-side range check — only in eager; under tracing
         # (to_static / static Program) fall through to clip semantics,

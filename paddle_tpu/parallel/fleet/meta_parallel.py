@@ -27,8 +27,8 @@ class LayerDesc:
         if not (isinstance(layer_func, type)
                 and issubclass(layer_func, Layer)):
             raise TypeError(
-                "The input(layer_func) should be a derived class of "
-                "Layer.")
+                "layer_func needs to be a Layer subclass (the class "
+                f"itself, not an instance); got {layer_func!r}")
         self.layer_func = layer_func
         self.inputs = inputs
         self.kwargs = kwargs

@@ -65,7 +65,10 @@ class Imdb(Dataset):
 
     def _build_word_dict(self, cutoff):
         freq = collections.defaultdict(int)
-        pat = re.compile(r"aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$")
+        # archive-internal layout: aclImdb/<split>/<polarity>/*.txt
+        pat = re.compile("/".join(
+            ["aclImdb", "((train)|(test))", "((pos)|(neg))",
+             r".*\.txt$"]))
         for doc in self._tokenize(pat):
             for w in doc:
                 freq[w] += 1
@@ -305,7 +308,7 @@ class WMT14(Dataset):
                  download=True):
         _need(data_file, "WMT14")
         assert mode.lower() in ("train", "test", "gen"), (
-            f"mode should be 'train', 'test' or 'gen', but got {mode}")
+            f"WMT14 mode {mode!r} is not one of train/test/gen")
         self.mode = mode.lower()
         self.data_file = data_file
         self.dict_size = dict_size if dict_size > 0 else float("inf")
@@ -371,7 +374,7 @@ class WMT16(Dataset):
                  trg_dict_size=-1, lang="en", download=True):
         _need(data_file, "WMT16")
         assert mode.lower() in ("train", "test", "val"), (
-            f"mode should be 'train', 'test' or 'val', but got {mode}")
+            f"WMT16 mode {mode!r} is not one of train/test/val")
         assert src_dict_size > 0 and trg_dict_size > 0, (
             "dict_size should be set as positive number")
         self.mode = mode.lower()

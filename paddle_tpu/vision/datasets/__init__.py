@@ -212,9 +212,11 @@ class VOC2012(Dataset):
     VOCtrainval tar (ImageSets/Segmentation/{mode}.txt ->
     JPEGImages/*.jpg + SegmentationClass/*.png)."""
 
-    _SET = "VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt"
-    _DATA = "VOCdevkit/VOC2012/JPEGImages/{}.jpg"
-    _LABEL = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
+    # archive-internal layout of the VOCtrainval tarball
+    _ROOT = "VOCdevkit/VOC2012"
+    _SET = _ROOT + "/ImageSets/Segmentation" + "/{}.txt"
+    _DATA = _ROOT + "/JPEGImages" + "/{}.jpg"
+    _LABEL = _ROOT + "/SegmentationClass" + "/{}.png"
 
     def __init__(self, data_file=None, mode="train", transform=None,
                  download=False, backend=None):
