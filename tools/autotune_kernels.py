@@ -44,10 +44,19 @@ def emit(rec):
 
 
 from bench_util import (chained_ms, force as _force,  # noqa: E402
-                        mix_grads, timeit)
+                        gate_ms, mix_grads, timeit)
+
+# Arithmetic/memory volume of ONE application on the sweep shapes, for
+# the plausibility gate. Attention fwd: QK^T + PV at 2 flops/MAC, causal
+# halves the work; bwd recomputes + 3 grad matmuls (~2.5x fwd). CE is
+# HBM-bound: fwd reads the (T,V) logits once, bwd reads them again and
+# writes dx.
+FLASH_FWD_FLOPS = 2 * B * H * S * S * D
+FLASH_BWD_FLOPS = 5 * B * H * S * S * D
+CE_BYTES = 3 * (B * S) * V * 2
 
 
-def _update_cache(key, value):
+def _update_cache(key, value, window=None):
     os.makedirs(os.path.dirname(CACHE_PATH), exist_ok=True)
     try:
         with open(CACHE_PATH) as f:
@@ -55,6 +64,13 @@ def _update_cache(key, value):
     except (OSError, ValueError):
         cache = {}
     cache[key] = value
+    # provenance: which measurement window produced the current winners
+    meta = cache.setdefault("_meta", {})
+    meta[key] = {
+        "window": window or os.environ.get("PADDLE_TPU_WINDOW", ""),
+        "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "gated": True,
+    }
     tmp = f"{CACHE_PATH}.tmp{os.getpid()}"
     with open(tmp, "w") as f:
         json.dump(cache, f, indent=1)
@@ -82,8 +98,11 @@ def sweep_flash_fwd():
             emit({"kernel": "flash_fwd", "block_q": bq, "block_k": bk,
                   "error": repr(e)[:160]})
             continue
+        bad = gate_ms(ms, flops=FLASH_FWD_FLOPS)
         emit({"kernel": "flash_fwd", "block_q": bq, "block_k": bk,
-              "ms": round(ms, 3)})
+              "ms": round(ms, 3), **({"rejected": bad} if bad else {})})
+        if bad:
+            continue
         if best is None or ms < best[0]:
             best = (ms, bq, bk)
     if best:
@@ -120,8 +139,11 @@ def sweep_flash_bwd():
             emit({"kernel": "flash_bwd", "block_q": bq, "block_k": bk,
                   "error": repr(e)[:160]})
             continue
+        bad = gate_ms(ms, flops=FLASH_BWD_FLOPS)
         emit({"kernel": "flash_bwd", "block_q": bq, "block_k": bk,
-              "ms": round(ms, 3)})
+              "ms": round(ms, 3), **({"rejected": bad} if bad else {})})
+        if bad:
+            continue
         if best is None or ms < best[0]:
             best = (ms, bq, bk)
     # the jax-level recompute backward, same quantities, for the A/B
@@ -160,8 +182,12 @@ def sweep_ce():
             emit({"kernel": "ce", "block_t": bt, "block_v": bv,
                   "error": repr(e)[:160]})
             continue
+        bad = gate_ms(tot, bytes_moved=CE_BYTES)
         emit({"kernel": "ce", "block_t": bt, "block_v": bv,
-              "fwd_bwd_ms": round(tot, 3)})
+              "fwd_bwd_ms": round(tot, 3),
+              **({"rejected": bad} if bad else {})})
+        if bad:
+            continue
         if best is None or tot < best[0]:
             best = (tot, bt, bv)
     if best:

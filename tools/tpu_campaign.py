@@ -118,6 +118,9 @@ def run_job(name, argv, timeout_s, env_extra, window_dir) -> dict:
     err_path = os.path.join(window_dir, f"{name}.err")
     env = dict(os.environ)
     env.update(env_extra)
+    # jobs stamp their artifacts (e.g. perf/autotune.json provenance)
+    # with the window they were measured in
+    env["PADDLE_TPU_WINDOW"] = os.path.basename(window_dir)
     t0 = time.time()
     with open(out_path, "wb") as fo, open(err_path, "wb") as fe, \
             open(BUSY_PATH, "w") as fb:
