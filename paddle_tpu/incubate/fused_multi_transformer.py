@@ -201,6 +201,18 @@ class FusedMultiTransformer(Layer):
                     "time_step requires caches; the no-cache forward "
                     "rotates from position 0")
             cos, sin = _rotary_tables(rotary_embs)
+            # time_step is concrete here (int() above), so the real
+            # bound is checkable at call time: the stack only reads
+            # table positions [time_step, time_step+T) — a table sized
+            # to the decode horizon with a larger-allocated cache is
+            # fine; reading past the table is not (dynamic_slice would
+            # clamp and rotate late tokens at wrong positions)
+            end = (int(time_step) if time_step is not None else 0) \
+                + src.shape[1]
+            if cos.shape[1] < end:
+                raise ValueError(
+                    f"rotary_embs covers {cos.shape[1]} positions but "
+                    f"this call reads up to position {end}")
             rot = (Tensor(cos), Tensor(sin))
 
         def _rotary_of(r):
@@ -303,15 +315,25 @@ def _stack_forward(x, kcache, vcache, pv, pos, H, hd, act, bias=None,
     if rotary is not None:
         cos_full, sin_full = rotary
         S_table = cos_full.shape[1]
-        S_need = kcache.shape[2] if use_cache else T
+        # only positions [pos, pos+T) are ever read, so the table needs
+        # to cover pos+T — NOT the whole cache capacity (a rotary table
+        # sized to the decode horizon with a larger-allocated cache is a
+        # valid call pattern). With a traced `pos` the end position is
+        # unknowable at trace time; require the T floor and rely on the
+        # caller keeping pos+T within the table (dynamic_slice clamps,
+        # which would rotate late tokens with the last table positions).
+        static_pos = isinstance(pos, int) or (
+            hasattr(pos, "item") and not isinstance(pos, jax.core.Tracer)
+            and getattr(pos, "ndim", 1) == 0)
+        S_need = (int(pos) + T) if (use_cache and static_pos) \
+            else T
         if S_table < S_need:
             # dynamic_slice would silently CLAMP the start index and
             # rotate late tokens with the wrong positions — fail loudly
             # at trace time instead
             raise ValueError(
-                f"rotary_embs covers {S_table} positions but the "
-                f"{'cache length' if use_cache else 'sequence'} is "
-                f"{S_need}")
+                f"rotary_embs covers {S_table} positions but this call "
+                f"reads up to position {S_need}")
         p0 = jnp.asarray(pos, jnp.int32).reshape(())
         zero = jnp.zeros((), jnp.int32)
         rot_t = (

@@ -74,6 +74,74 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     return out, None
 
 
+# dense varlen is only used when the probs matrix must exist anyway
+# (dropout / return_softmax) or the packing is small enough that the
+# [H, total, total] buffer is cheaper than a scan
+_VARLEN_DENSE_MAX = 1024 * 1024   # total_q * total_k
+_VARLEN_BLOCK_KV = 512
+
+
+def _varlen_segments(cu, total):
+    """Segment id and within-segment position for each packed row."""
+    cu = cu.astype(jnp.int32)
+    seg = jnp.searchsorted(cu, jnp.arange(total), side="right") - 1
+    pos = jnp.arange(total) - cu[seg]
+    return seg, pos
+
+
+def _varlen_blockwise(q, k, v, seg_q, pos_q, seg_k, pos_k, scale, causal):
+    """Online-softmax over KV blocks for the packed form: memory is
+    O(H * total_q * block) instead of the dense O(H * total_q * total_k)
+    — the varlen analog of kernels.flash_attention._blockwise_attention_lse
+    with the block-diagonal segment mask folded into each block."""
+    total_q, H, D = q.shape
+    total_k = k.shape[0]
+    blk = min(_VARLEN_BLOCK_KV, total_k)
+    pad = (-total_k) % blk
+    if pad:
+        k = jnp.concatenate([k, jnp.zeros((pad, H, D), k.dtype)], 0)
+        v = jnp.concatenate([v, jnp.zeros((pad, H, D), v.dtype)], 0)
+        # padding rows get segment -1: never equal to any real seg_q >= 0
+        seg_k = jnp.concatenate(
+            [seg_k, jnp.full((pad,), -1, seg_k.dtype)], 0)
+        pos_k = jnp.concatenate([pos_k, jnp.zeros((pad,), pos_k.dtype)], 0)
+    nblk = (total_k + pad) // blk
+    kb = k.reshape(nblk, blk, H, D)
+    vb = v.reshape(nblk, blk, H, D)
+    sb = seg_k.reshape(nblk, blk)
+    pb = pos_k.reshape(nblk, blk)
+
+    def step(carry, inputs):
+        m, l, acc = carry
+        kblk, vblk, segs, poss = inputs
+        scores = jnp.einsum("qhd,khd->hqk", q, kblk,
+                            preferred_element_type=jnp.float32) * scale
+        valid = seg_q[:, None] == segs[None, :]
+        if causal:
+            valid = jnp.logical_and(valid,
+                                    pos_q[:, None] >= poss[None, :])
+        scores = jnp.where(valid[None], scores, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
+        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.exp(scores - m_safe[..., None])
+        p = jnp.where(jnp.isneginf(scores), 0.0, p)
+        corr = jnp.exp(jnp.where(jnp.isneginf(m), 0.0, m) - m_safe)
+        corr = jnp.where(jnp.isneginf(m), 0.0, corr)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = acc * corr[..., None] + jnp.einsum(
+            "hqk,khd->hqd", p.astype(vblk.dtype), vblk,
+            preferred_element_type=jnp.float32)
+        return (m_new, l_new, acc_new), None
+
+    m0 = jnp.full((H, total_q), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((H, total_q), jnp.float32)
+    acc0 = jnp.zeros((H, total_q, D), jnp.float32)
+    (m, l, acc), _ = jax.lax.scan(step, (m0, l0, acc0), (kb, vb, sb, pb))
+    # rows whose segment has zero kv tokens stay all-masked: l == 0 → 0
+    out = acc / jnp.maximum(l, 1e-37)[..., None]
+    return jnp.swapaxes(out, 0, 1).astype(q.dtype)   # [total_q, H, D]
+
+
 @defop("flash_attn_unpadded_op")
 def _flash_attn_unpadded(q, k, v, cu_q, cu_k, key, scale, dropout_p,
                          causal, training, want_softmax):
@@ -84,12 +152,12 @@ def _flash_attn_unpadded(q, k, v, cu_q, cu_k, key, scale, dropout_p,
     # packing (XLA requires static shapes; a CUDA varlen kernel indexes
     # ragged rows instead).
     total_q, total_k = q.shape[0], k.shape[0]
-    cu_q = cu_q.astype(jnp.int32)
-    cu_k = cu_k.astype(jnp.int32)
-    seg_q = jnp.searchsorted(cu_q, jnp.arange(total_q), side="right") - 1
-    seg_k = jnp.searchsorted(cu_k, jnp.arange(total_k), side="right") - 1
-    pos_q = jnp.arange(total_q) - cu_q[seg_q]
-    pos_k = jnp.arange(total_k) - cu_k[seg_k]
+    seg_q, pos_q = _varlen_segments(cu_q, total_q)
+    seg_k, pos_k = _varlen_segments(cu_k, total_k)
+    dense_needed = want_softmax or (dropout_p > 0.0 and training)
+    if not dense_needed and total_q * total_k > _VARLEN_DENSE_MAX:
+        return _varlen_blockwise(q, k, v, seg_q, pos_q, seg_k, pos_k,
+                                 scale, causal)
     valid = seg_q[:, None] == seg_k[None, :]
     if causal:
         valid = jnp.logical_and(valid, pos_q[:, None] >= pos_k[None, :])
@@ -120,7 +188,12 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
     [total_seq_len, num_heads, head_dim] with cu_seqlens_* [batch+1]
     boundaries; returns the packed [total_seq_len, num_heads, head_dim]
     output (reference flash_attention.py:269). Sequences attend only
-    within their own segment."""
+    within their own segment.
+
+    Large packings run the blockwise online-softmax path (O(total*block)
+    memory, flash-style); the dense O(total^2) scores buffer is built
+    only for small inputs or when dropout / return_softmax force the
+    full probs matrix to exist."""
     args = (query, key, value, cu_seqlens_q, cu_seqlens_k, next_key(),
             float(scale), float(dropout), bool(causal), bool(training))
     if return_softmax:
@@ -174,8 +247,11 @@ def _sparse_attention(q, k, v, offset, columns, kp_mask, attn_mask):
 
     mask = jax.vmap(one_mask)(offset, columns).reshape(B, H, S, S)
     scale = 1.0 / math.sqrt(D)
-    scores = jnp.einsum("bhsd,bhtd->bhst", q.astype(jnp.float32),
-                        k.astype(jnp.float32)) * scale
+    # accumulate in the input precision when it exceeds f32 (the
+    # reference supports float64); otherwise f32
+    acc_dt = jnp.promote_types(q.dtype, jnp.float32)
+    scores = jnp.einsum("bhsd,bhtd->bhst", q.astype(acc_dt),
+                        k.astype(acc_dt)) * scale
     if kp_mask is not None:
         # [B, S] key-padding mask, 0 = masked (reference contract)
         mask = jnp.logical_and(mask,
@@ -187,7 +263,7 @@ def _sparse_attention(q, k, v, offset, columns, kp_mask, attn_mask):
     probs = jax.nn.softmax(scores, axis=-1)
     probs = jnp.where(mask, probs, 0.0)        # all-masked rows → 0
     return jnp.einsum("bhst,bhtd->bhsd", probs,
-                      v.astype(jnp.float32)).astype(q.dtype)
+                      v.astype(acc_dt)).astype(q.dtype)
 
 
 def sparse_attention(query, key, value, sparse_csr_offset,
@@ -195,7 +271,13 @@ def sparse_attention(query, key, value, sparse_csr_offset,
                      attn_mask=None, name=None):
     """CSR block-sparse attention (reference
     python/paddle/nn/functional/sparse_attention.py:19): each query row
-    attends only to its CSR row's columns."""
+    attends only to its CSR row's columns.
+
+    Correct-but-dense fallback: the CSR pattern is scattered into a full
+    [B, H, S, S] mask and scores are computed densely, so compute/memory
+    are O(S^2) regardless of sparsity — fine for the reference's
+    moderate S, not a long-context kernel (use flash/splash paths for
+    that)."""
     return _sparse_attention(query, key, value, sparse_csr_offset,
                              sparse_csr_columns, key_padding_mask,
                              attn_mask)

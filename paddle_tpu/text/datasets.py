@@ -66,9 +66,7 @@ class Imdb(Dataset):
     def _build_word_dict(self, cutoff):
         freq = collections.defaultdict(int)
         # archive-internal layout: aclImdb/<split>/<polarity>/*.txt
-        pat = re.compile("/".join(
-            ["aclImdb", "((train)|(test))", "((pos)|(neg))",
-             r".*\.txt$"]))
+        pat = re.compile(r"aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$")
         for doc in self._tokenize(pat):
             for w in doc:
                 freq[w] += 1

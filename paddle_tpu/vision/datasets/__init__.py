@@ -213,10 +213,9 @@ class VOC2012(Dataset):
     JPEGImages/*.jpg + SegmentationClass/*.png)."""
 
     # archive-internal layout of the VOCtrainval tarball
-    _ROOT = "VOCdevkit/VOC2012"
-    _SET = _ROOT + "/ImageSets/Segmentation" + "/{}.txt"
-    _DATA = _ROOT + "/JPEGImages" + "/{}.jpg"
-    _LABEL = _ROOT + "/SegmentationClass" + "/{}.png"
+    _SET = "VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt"
+    _DATA = "VOCdevkit/VOC2012/JPEGImages/{}.jpg"
+    _LABEL = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
 
     def __init__(self, data_file=None, mode="train", transform=None,
                  download=False, backend=None):

@@ -251,12 +251,25 @@ class TestRotary:
         assert np.abs(plain - rot).max() > 1e-5
 
     def test_rotary_table_too_short_fails_loudly(self, model):
-        """A table shorter than the cache would silently clamp the
+        """Reading past the table would silently clamp the
         dynamic_slice and rotate late tokens at wrong positions —
-        must raise at trace time instead."""
+        must raise at call time instead. The bound is the positions
+        actually read (time_step+T), not the cache capacity."""
         src = _src(T=4)
         caches = model.gen_cache(batch=2, max_len=16)
         short = paddle.to_tensor(self._rotary_table(2, 8, 8))
         with pytest.raises(Exception, match="rotary_embs covers"):
-            model(src, caches=caches, time_step=0,
+            # positions read: [6, 10) > table's 8
+            model(src, caches=caches, time_step=6,
                   rotary_embs=short, rotary_emb_dims=1)
+
+    def test_rotary_table_horizon_sized_accepted(self, model):
+        """A table sized to the decode horizon is valid even when the
+        cache is allocated larger (the reference reads only up to the
+        current timestep)."""
+        src = _src(T=4)
+        caches = model.gen_cache(batch=2, max_len=16)
+        short = paddle.to_tensor(self._rotary_table(2, 8, 8))
+        out, caches = model(src, caches=caches, time_step=2,
+                            rotary_embs=short, rotary_emb_dims=1)
+        assert out.shape[1] == 4

@@ -24,14 +24,40 @@ REPO = Path(__file__).resolve().parent.parent
 REFERENCE = Path("/root/reference")
 
 # strings that are forced by the public API or the domain, not authored
-# prose: bare op/arg names, dtype lists, URLs, file suffixes
+# prose: bare op/arg names, dtype lists, URLs, file suffixes — and any
+# whitespace-free string (paths, regexes, archive layouts: prose always
+# contains spaces, format-forced strings rarely do)
 _FORCED = re.compile(
     r"^[\w\.\-/:,\[\] ]*$"  # no sentence-like punctuation at all
 )
 
 
+def _is_forced(s: str) -> bool:
+    return " " not in s or bool(_FORCED.match(s))
+
+
 def _norm(s: str) -> str:
     return re.sub(r"\s+", " ", s).strip()
+
+
+def _fold(node):
+    """Constant-fold string expressions so splitting a copied literal
+    (BinOp '+' chains, '/'.join([...])) cannot hide it from the gate."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        lhs, rhs = _fold(node.left), _fold(node.right)
+        if lhs is not None and rhs is not None:
+            return lhs + rhs
+    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "join" and not node.keywords
+            and len(node.args) == 1
+            and isinstance(node.args[0], (ast.List, ast.Tuple))):
+        sep = _fold(node.func.value)
+        parts = [_fold(e) for e in node.args[0].elts]
+        if sep is not None and all(p is not None for p in parts):
+            return sep.join(parts)
+    return None
 
 
 def harvest(py: Path, min_len: int):
@@ -40,10 +66,13 @@ def harvest(py: Path, min_len: int):
     except SyntaxError:
         return
     for node in ast.walk(tree):
-        if isinstance(node, ast.Constant) and isinstance(node.value, str):
-            v = _norm(node.value)
+        if isinstance(node, (ast.BinOp, ast.Call, ast.Constant)):
+            folded = _fold(node)
+            if folded is None:
+                continue
+            v = _norm(folded)
             if len(v) >= min_len:
-                yield v, node.lineno
+                yield v, getattr(node, "lineno", 0)
     # docstring-only files still covered by the walk above
 
 
@@ -72,7 +101,7 @@ def main():
 
     # docstrings cite reference paths like 'python/paddle/x.py:12' — those
     # literals are citations, not copies; drop pure-path/identifier strings
-    probe = {s: w for s, w in wanted.items() if not _FORCED.match(s)}
+    probe = {s: w for s, w in wanted.items() if not _is_forced(s)}
 
     if not probe:
         print("no prose-like strings to probe")
