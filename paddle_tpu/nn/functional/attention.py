@@ -76,8 +76,9 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
 
 # dense varlen is only used when the probs matrix must exist anyway
 # (dropout / return_softmax) or the packing is small enough that the
-# [H, total, total] buffer is cheaper than a scan
-_VARLEN_DENSE_MAX = 1024 * 1024   # total_q * total_k
+# [H, total_q, total_k] buffer is cheaper than a scan — the threshold is
+# on that buffer's ELEMENT count so head count is priced in
+_VARLEN_DENSE_MAX = 16 * 1024 * 1024   # H * total_q * total_k
 _VARLEN_BLOCK_KV = 512
 
 
@@ -155,7 +156,8 @@ def _flash_attn_unpadded(q, k, v, cu_q, cu_k, key, scale, dropout_p,
     seg_q, pos_q = _varlen_segments(cu_q, total_q)
     seg_k, pos_k = _varlen_segments(cu_k, total_k)
     dense_needed = want_softmax or (dropout_p > 0.0 and training)
-    if not dense_needed and total_q * total_k > _VARLEN_DENSE_MAX:
+    if (not dense_needed
+            and q.shape[1] * total_q * total_k > _VARLEN_DENSE_MAX):
         return _varlen_blockwise(q, k, v, seg_q, pos_q, seg_k, pos_k,
                                  scale, causal)
     valid = seg_q[:, None] == seg_k[None, :]

@@ -205,9 +205,14 @@ class Strategy:
     """Auto-parallel strategy knobs (reference auto_parallel/strategy.py).
     Holds the mesh axes used by Engine plus pass toggles (the reference's
     amp/recompute/sharding sub-configs map onto the paddle_tpu.amp /
-    remat / ZeRO-spec machinery)."""
+    remat / ZeRO-spec machinery).
 
-    def __init__(self, mesh_axes: Optional[Dict[str, int]] = None,
+    mesh_axes="auto" asks the planner to choose: Engine derives the
+    model's parameter-state size and lets parallel.planner.best_mesh_axes
+    pick dp vs dp×fsdp (the reference's parallel_tuner, collapsed to the
+    decision GSPMD can't make for you)."""
+
+    def __init__(self, mesh_axes=None,
                  amp: bool = False, recompute: bool = False,
                  sharding: Optional[dict] = None):
         self.mesh_axes = mesh_axes
@@ -236,8 +241,17 @@ class Engine:
     # ------------------------------------------------------------ prepare
     def _ensure_mesh(self) -> Mesh:
         if self._mesh is None:
-            if self.strategy.mesh_axes:
-                self._mesh = build_mesh(self.strategy.mesh_axes)
+            axes = self.strategy.mesh_axes
+            if axes == "auto":
+                from .planner import best_mesh_axes
+                param_count = 0
+                if self.model is not None:
+                    param_count = sum(int(np.prod(p.shape))
+                                      for p in self.model.parameters())
+                axes = best_mesh_axes(param_count, len(jax.devices()))
+                self.strategy.mesh_axes = axes   # surface the decision
+            if axes:
+                self._mesh = build_mesh(axes)
             else:
                 self._mesh = get_mesh() or build_mesh(
                     {"dp": len(jax.devices())})
@@ -399,3 +413,11 @@ def create_mesh(axes: Dict[str, int]) -> ProcessMesh:
     """Convenience: ProcessMesh over the first prod(axes) local devices."""
     shape = list(axes.values())
     return ProcessMesh(shape=shape, dim_names=list(axes.keys()))
+
+
+# the tuner surface (reference tuner/parallel_tuner.py) lives in
+# parallel.planner; re-exported here so paddle.distributed.fleet.auto
+# carries it like the reference's auto namespace does
+from .planner import (  # noqa: E402,F401
+    ChipSpec, ModelSpec, Plan, enumerate_plans, plan_parallel,
+    spec_from_gpt_config, best_mesh_axes)

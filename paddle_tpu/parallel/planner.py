@@ -1,0 +1,286 @@
+"""Auto-parallel planner: cost-model search over hybrid degrees.
+
+Reference analog: the auto-parallel tuner stack
+(python/paddle/distributed/auto_parallel/static/tuner/parallel_tuner.py:40
+searching process-mesh topologies, pruned and ranked by
+auto_parallel/static/cost/base_cost.py estimates). On TPU, GSPMD already
+propagates shardings inside one assignment — the one thing it does NOT
+do is pick the assignment. This module does: given a transformer spec, a
+device count, and a chip profile, it enumerates every legal
+(dp, mp, pp, fsdp) factorization, prices each with an analytical
+compute + collective + pipeline-bubble + HBM model, prunes the ones that
+don't fit memory, and returns the ranking.
+
+The absolute times are nominal (a fixed MFU guess, linear collective
+models); what the search relies on — and what the validation test pins —
+is the ORDERING, which is driven by the relative volumes: TP pays
+activation all-reduces every layer, DP pays one gradient reduction, FSDP
+pays parameter all-gathers, PP pays its bubble.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+__all__ = ["ChipSpec", "ModelSpec", "Plan", "enumerate_plans",
+           "plan_parallel", "spec_from_gpt_config", "best_mesh_axes"]
+
+
+@dataclass(frozen=True)
+class ChipSpec:
+    """Per-chip hardware profile (v5e-class defaults; override for other
+    parts — only ratios matter for the ranking)."""
+    peak_flops: float = 197e12        # bf16 MXU peak
+    hbm_bytes: float = 16e9
+    ici_bw: float = 9e10              # bytes/s per link, all-reduce model
+    dcn_bw: float = 6.25e9            # bytes/s across slices (unused yet)
+    mfu: float = 0.35                 # nominal achievable fraction
+    coll_latency: float = 2e-6        # fixed cost per collective launch
+
+
+@dataclass(frozen=True)
+class ModelSpec:
+    """Transformer shape the cost model prices."""
+    num_layers: int
+    hidden_size: int
+    num_heads: int
+    ffn_hidden: int
+    vocab_size: int
+    seq_len: int
+    param_bytes_per_elem: int = 4     # f32 master params
+    act_bytes_per_elem: int = 2       # bf16 activations
+    remat_policy: str = "full"
+    sequence_parallel: bool = True
+
+    @property
+    def block_params(self) -> int:
+        d, f = self.hidden_size, self.ffn_hidden
+        return self.num_layers * (4 * d * d + 2 * d * f)
+
+    @property
+    def embed_params(self) -> int:
+        return (self.vocab_size + self.seq_len) * self.hidden_size
+
+    @property
+    def total_params(self) -> int:
+        return self.block_params + self.embed_params
+
+
+def spec_from_gpt_config(cfg) -> ModelSpec:
+    """Build a ModelSpec from models.gpt.GPTConfig."""
+    return ModelSpec(
+        num_layers=cfg.num_layers, hidden_size=cfg.hidden_size,
+        num_heads=cfg.num_heads, ffn_hidden=cfg.ffn_hidden,
+        vocab_size=cfg.vocab_size, seq_len=cfg.max_seq_len,
+        remat_policy=cfg.remat_policy if cfg.remat else "none",
+        sequence_parallel=cfg.sequence_parallel)
+
+
+# How many residual-sized buffers per layer survive the forward, by remat
+# policy (drives the activation-memory estimate; calibrated against the
+# ablation notes in BASELINE.md: no-remat ~33 GB vs full-remat ~11 GB
+# temp on the 350M sweep shapes).
+_ACT_BUFFERS = {"full": 2.0, "dots": 9.0, "dots_flash": 10.0,
+                "offload_dots": 3.0, "all_but_mlp": 14.0, "none": 20.0}
+
+
+@dataclass
+class Plan:
+    dp: int = 1
+    mp: int = 1
+    pp: int = 1
+    fsdp: int = 1
+    microbatches: int = 1
+    step_s: float = float("inf")
+    mem_bytes: float = 0.0
+    fits: bool = True
+    breakdown: Dict[str, float] = field(default_factory=dict)
+
+    @property
+    def n_devices(self) -> int:
+        return self.dp * self.mp * self.pp * self.fsdp
+
+    def mesh_axes(self) -> Dict[str, int]:
+        axes = {}
+        if self.dp > 1 or (self.mp == self.pp == self.fsdp == 1):
+            axes["dp"] = self.dp
+        if self.fsdp > 1:
+            axes["fsdp"] = self.fsdp
+        if self.pp > 1:
+            axes["pp"] = self.pp
+        if self.mp > 1:
+            axes["mp"] = self.mp
+        return axes
+
+    def __repr__(self):
+        keys = f"dp{self.dp}_mp{self.mp}_pp{self.pp}_fsdp{self.fsdp}"
+        if self.pp > 1:
+            keys += f"_mb{self.microbatches}"
+        return (f"Plan({keys}, est {self.step_s * 1e3:.1f} ms, "
+                f"mem {self.mem_bytes / 1e9:.1f} GB"
+                + ("" if self.fits else ", OOM") + ")")
+
+
+def _ring_factor(n: int) -> float:
+    """Per-chip all-reduce volume multiplier: ring moves 2(n-1)/n of the
+    buffer through each chip."""
+    return 2.0 * (n - 1) / n if n > 1 else 0.0
+
+
+def _estimate(plan: Plan, spec: ModelSpec, global_batch: int,
+              chip: ChipSpec) -> Plan:
+    """Fill in step_s / mem_bytes / fits for one assignment."""
+    dp, mp, pp, fsdp = plan.dp, plan.mp, plan.pp, plan.fsdp
+    L, D, S = spec.num_layers, spec.hidden_size, spec.seq_len
+    V, F = spec.vocab_size, spec.ffn_hidden
+    tokens = global_batch * S
+    b_local = max(global_batch // (dp * fsdp), 1)   # batch shards dp×fsdp
+    tok_local = b_local * S
+    abytes = spec.act_bytes_per_elem
+
+    # ---- compute: fwd 2*P_used*tokens + attention, bwd 2x fwd --------
+    matmul_flops = 2 * (spec.block_params + 2 * V * D) * tokens
+    attn_flops = 4 * tokens * S * D * L            # QK^T + PV, non-causal
+    remat_extra = {"full": 1.0 / 3.0, "dots": 0.15, "dots_flash": 0.1,
+                   "offload_dots": 0.2, "all_but_mlp": 0.12,
+                   "none": 0.0}.get(spec.remat_policy, 1.0 / 3.0)
+    flops = (matmul_flops + attn_flops) * 3.0 * (1.0 + remat_extra / 3.0)
+    compute_s = flops / plan.n_devices / (chip.peak_flops * chip.mfu)
+    # pipeline bubble: (pp-1) idle slots per m microbatch slots
+    if pp > 1:
+        compute_s *= 1.0 + (pp - 1) / max(plan.microbatches, 1)
+
+    # ---- communication (per chip, bytes / ici_bw) --------------------
+    # TP: 2 activation all-reduces fwd + 2 bwd per layer (or the
+    # reduce-scatter/all-gather pair under SP — same moved volume)
+    tp_bytes = (_ring_factor(mp) * 4 * L * tok_local * D * abytes
+                if mp > 1 else 0.0)
+    # DP: one gradient all-reduce of this chip's param shard (f32)
+    shard_params = spec.total_params / (mp * pp * fsdp)
+    dp_bytes = _ring_factor(dp) * shard_params * 4
+    # FSDP/ZeRO-3: all-gather params in fwd and again in bwd, reduce-
+    # scatter grads — ~3 all-gather-sized moves of the fsdp shard
+    fsdp_bytes = (3.0 * (fsdp - 1) / fsdp
+                  * (spec.total_params / (mp * pp)) * abytes
+                  if fsdp > 1 else 0.0)
+    # PP: boundary activations each way per microbatch
+    pp_bytes = (2 * plan.microbatches
+                * (tok_local / max(plan.microbatches, 1)) * D * abytes
+                * (pp - 1) / pp if pp > 1 else 0.0)
+    # overlap discounts: DP grad reduction overlaps the backward well;
+    # TP all-reduces sit on the critical path. Collective LAUNCHES also
+    # carry a fixed latency — TP pays 4 per layer on the critical path,
+    # DP's gradient reduction fuses into a handful, FSDP buckets too —
+    # which is what prices TP out for small models where byte volumes
+    # alone would call it free.
+    tp_ops = 4 * L if mp > 1 else 0
+    dp_ops = 2 if dp > 1 else 0
+    fsdp_ops = 3 if fsdp > 1 else 0
+    pp_ops = 2 * plan.microbatches if pp > 1 else 0
+    comm_s = ((tp_bytes * 1.0 + dp_bytes * 0.3 + fsdp_bytes * 0.6
+               + pp_bytes * 0.5) / chip.ici_bw
+              + (tp_ops + dp_ops + fsdp_ops + pp_ops)
+              * chip.coll_latency)
+
+    # ---- memory ------------------------------------------------------
+    # master param + grad + adam m/v, all f32, sharded by mp*pp*fsdp
+    state_bytes = shard_params * 16
+    seq_shard = mp if (spec.sequence_parallel and mp > 1) else 1
+    act_bytes = (_ACT_BUFFERS.get(spec.remat_policy, 2.0)
+                 * (L / pp) * tok_local * D * abytes / seq_shard)
+    # logits working set (vocab-parallel over mp)
+    logit_bytes = tok_local * V * 4 / mp / max(plan.microbatches, 1)
+    mem = state_bytes + act_bytes + logit_bytes
+    plan.step_s = compute_s + comm_s
+    plan.mem_bytes = mem
+    plan.fits = mem <= 0.9 * chip.hbm_bytes
+    plan.breakdown = {
+        "compute_s": compute_s, "tp_s": tp_bytes / chip.ici_bw,
+        "dp_s": dp_bytes * 0.3 / chip.ici_bw,
+        "fsdp_s": fsdp_bytes * 0.6 / chip.ici_bw,
+        "pp_s": pp_bytes * 0.5 / chip.ici_bw,
+        "state_gb": state_bytes / 1e9, "act_gb": act_bytes / 1e9,
+    }
+    return plan
+
+
+def _factorizations(n: int) -> List[tuple]:
+    out = []
+    for dp in (d for d in range(1, n + 1) if n % d == 0):
+        rem = n // dp
+        for mp in (d for d in range(1, rem + 1) if rem % d == 0):
+            rem2 = rem // mp
+            for pp in (d for d in range(1, rem2 + 1) if rem2 % d == 0):
+                out.append((dp, mp, pp, rem2 // pp))
+    return out
+
+
+def enumerate_plans(spec: ModelSpec, n_devices: int, global_batch: int,
+                    chip: Optional[ChipSpec] = None,
+                    microbatches: Optional[int] = None,
+                    max_mp: Optional[int] = None) -> List[Plan]:
+    """All legal assignments, priced, sorted best-first (OOM plans sink
+    to the bottom, still priced so the caller can see why)."""
+    chip = chip or ChipSpec()
+    plans = []
+    for dp, mp, pp, fsdp in _factorizations(n_devices):
+        # legality: mp divides heads and ffn; pp divides layers;
+        # dp*fsdp divides the global batch
+        if spec.num_heads % mp or spec.ffn_hidden % mp:
+            continue
+        if max_mp and mp > max_mp:
+            continue
+        if spec.num_layers % pp:
+            continue
+        if global_batch % (dp * fsdp):
+            continue
+        mb = microbatches or (4 * pp if pp > 1 else 1)
+        mb = min(mb, max(global_batch // (dp * fsdp), 1))
+        plans.append(_estimate(
+            Plan(dp=dp, mp=mp, pp=pp, fsdp=fsdp, microbatches=mb),
+            spec, global_batch, chip))
+    plans.sort(key=lambda p: (not p.fits, p.step_s))
+    return plans
+
+
+def plan_parallel(cfg_or_spec, n_devices: int, global_batch: int,
+                  chip: Optional[ChipSpec] = None, **kw) -> Plan:
+    """The best assignment for a GPTConfig or ModelSpec (the reference
+    parallel_tuner's `tune()` surface collapsed to a function)."""
+    spec = (cfg_or_spec if isinstance(cfg_or_spec, ModelSpec)
+            else spec_from_gpt_config(cfg_or_spec))
+    plans = enumerate_plans(spec, n_devices, global_batch, chip, **kw)
+    if not plans:
+        raise ValueError(
+            f"no legal (dp, mp, pp, fsdp) assignment for {n_devices} "
+            f"devices with heads={spec.num_heads}, "
+            f"layers={spec.num_layers}, batch={global_batch}")
+    return plans[0]
+
+
+def best_mesh_axes(param_count: int, n_devices: int,
+                   chip: Optional[ChipSpec] = None) -> Dict[str, int]:
+    """Generic-model auto mode for Engine: with no layer structure to
+    reason about, the only sound choice is dp vs fsdp — shard the
+    parameter state across fsdp only when the optimizer state would not
+    fit replicated (fsdp costs all-gathers every step; dp's gradient
+    reduction overlaps the backward).
+
+    `param_count` is the parameter ELEMENT count: optimizer state is
+    priced as f32 master + grad + adam m/v (16 bytes/elem) regardless of
+    the model's storage dtype. fsdp only takes degrees that divide
+    n_devices — a non-divisor would silently strand devices."""
+    chip = chip or ChipSpec()
+    state = param_count * 16
+    if state <= 0.5 * chip.hbm_bytes or n_devices == 1:
+        return {"dp": n_devices}
+    divisors = [d for d in range(2, n_devices + 1) if n_devices % d == 0]
+    fsdp = next((d for d in divisors
+                 if state / d <= 0.5 * chip.hbm_bytes),
+                divisors[-1] if divisors else 1)
+    axes = {}
+    if n_devices // fsdp > 1:
+        axes["dp"] = n_devices // fsdp
+    axes["fsdp"] = fsdp
+    return axes
