@@ -84,7 +84,10 @@ class GPTConfig:
     # real pipeline parallelism (reference 1F1B/interleaved schedules,
     # fleet/meta_parallel/pipeline_parallel.py:188,565): >1 microbatches +
     # a pp>1 mesh routes the block stack through parallel.pipeline's SPMD
-    # ppermute-ring schedule; 0/1 = layer-weight sharding only
+    # ppermute-ring schedule; 0/1 = layer-weight sharding only.
+    # pipeline_interleave must stay 1: virtual stages are a measured
+    # throughput loss in the scan formulation (perf/pipeline_ab.json);
+    # interleaved 1F1B lives in parallel.host_pipeline.HostPipeline.
     pipeline_microbatches: int = 0
     pipeline_interleave: int = 1
 

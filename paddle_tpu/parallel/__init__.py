@@ -21,6 +21,7 @@ from .sharding import (  # noqa: F401
     group_sharded_parallel, save_group_sharded_model, GroupShardedStage2,
     GroupShardedStage3, GroupShardedOptimizerStage2, shard_model_stage3,
     shard_optimizer_state)
+from .host_pipeline import HostPipeline  # noqa: F401
 from .pipeline import (  # noqa: F401
     spmd_pipeline, pipeline_forward, PipelineLayer, LayerDesc,
     SharedLayerDesc)

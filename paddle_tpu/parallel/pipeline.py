@@ -22,7 +22,6 @@ from __future__ import annotations
 import functools
 from typing import Callable, List, Optional
 
-import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P, NamedSharding
@@ -49,27 +48,19 @@ def spmd_pipeline(stage_fn: Callable, n_stages: int, n_microbatches: int,
     """Lift `stage_fn(chunk_params, x) -> y` into a pipelined
     `fn(stacked_params, microbatched_x) -> microbatched_y`.
 
-    stacked_params: pytree with leading dim n_stages*interleave, ordered
-    device-major (position d*interleave + s holds chunk s*n_stages + d —
-    the round-robin "virtual stage" placement of the reference's
-    interleaved 1F1B, pipeline_parallel.py:565). pipeline_forward applies
-    this permutation for you. microbatched_x: [n_microbatches, mb, ...].
+    stacked_params: pytree with leading dim n_stages (one chunk per
+    stage). microbatched_x: [n_microbatches, mb, ...].
 
-    Schedule: one lax.scan over m + v*p - 1 ticks. Each device carries v
-    activation slots; slot s on device d holds the microbatch at hop
-    s*p + d of its v*p-chunk journey. Every tick computes all local slots
-    (vmap over chunk weights — one full stage-equivalent of FLOPs),
-    ppermutes every slot to the next device, and advances a slot on ring
-    wraparound. Backward is jax autodiff through the scan: the reverse
+    Schedule: one lax.scan over m + p - 1 ticks. Every tick computes the
+    local stage (one stage-equivalent of FLOPs), ppermutes the
+    activation to the next device, and stage 0 ingests the next
+    microbatch. Backward is jax autodiff through the scan: the reverse
     replays the schedule in reverse (cooldown/warmup swap), which IS the
     1F1B-shaped backward, scheduled by XLA with the ppermute overlapping
-    the next tick's compute. Note: with scan-synchronous ticks the bubble
-    is (v*p-1)/(m+v*p-1), so interleave=1 is the throughput-optimal
-    setting; interleave>1 exists for placement parity with the reference
-    and for relaxing the layers%stages divisibility constraint.
+    the next tick's compute.
 
     Must be called inside a shard_map manual over `axis_name`, where each
-    rank holds the leading-dim slice of size `interleave`.
+    rank holds its leading-dim slice.
 
     with_aux=True: `stage_fn(chunk_params, x) -> (y, aux_scalar)` and each
     microbatch's aux accumulates ALONG ITS JOURNEY — a per-slot f32 rides
@@ -78,64 +69,72 @@ def spmd_pipeline(stage_fn: Callable, n_stages: int, n_microbatches: int,
     load-balancing loss circulates under pipeline parallelism (the
     reference accumulates it per stage in the 1F1B loop). Returns
     (outputs, aux_per_microbatch [m]).
+
+    interleave>1 is NOT supported here: with scan-synchronous ticks the
+    bubble is (v*p-1)/(m+v*p-1), strictly worse than v=1 — measured
+    +14% step time at v=2 on the A/B harness (tools/ab_pipeline.py,
+    perf/pipeline_ab.json). Virtual-stage interleaving genuinely helps
+    only under the host-driven schedule, where it lives:
+    parallel.host_pipeline.HostPipeline (measured -21% at v=2).
     """
-    v, p = interleave, n_stages
+    if interleave != 1:
+        raise ValueError(
+            "spmd_pipeline no longer takes interleave>1: the scan-"
+            "synchronous formulation makes virtual stages a strict "
+            "throughput loss (see perf/pipeline_ab.json). Use "
+            "parallel.host_pipeline.HostPipeline for interleaved 1F1B.")
+    p = n_stages
 
     def pipelined(local_params, x_mb):
-        # local_params leading dim is v (this rank's chunk slots)
+        # local_params leading dim is 1 (this rank's chunk)
+        chunk = jax.tree_util.tree_map(lambda a: a[0], local_params)
         stage = jax.lax.axis_index(axis_name)
-        n_ticks = n_microbatches + v * p - 1
+        n_ticks = n_microbatches + p - 1
         mb_shape = x_mb.shape[1:]
         perm = [(i, (i + 1) % p) for i in range(p)]
 
-        # with_aux is a trace-time constant: the aux ring (its carry slots,
-        # ppermute, roll) exists ONLY when requested — the dense pipeline
+        # with_aux is a trace-time constant: the aux ring (its carry,
+        # ppermute) exists ONLY when requested — the dense pipeline
         # carries no dead collectives
         def tick(carry, t):
             if with_aux:
                 state, aux_state, outputs, aux_out = carry
             else:
                 state, outputs = carry
-            # stage 0, slot 0 ingests microbatch t (clamped); every other
-            # (device, slot) keeps its circulating activation
+            # stage 0 ingests microbatch t (clamped); every other stage
+            # keeps its circulating activation
             idx = jnp.clip(t, 0, n_microbatches - 1)
             inject = jax.lax.pcast(
                 jax.lax.dynamic_index_in_dim(x_mb, idx, 0, keepdims=False),
                 axis_name, to="varying")
-            inp = state.at[0].set(
-                jnp.where(stage == 0, inject, state[0]))
-            # device p-1, slot v-1 finishes hop v*p-1: emit microbatch
-            # t - (v*p - 1)
-            out_idx = t - (v * p - 1)
+            inp = jnp.where(stage == 0, inject, state)
+            # the last stage finishes hop p-1: emit microbatch t - (p-1)
+            out_idx = t - (p - 1)
             emit = jnp.logical_and(stage == p - 1, out_idx >= 0)
             if with_aux:
-                aux_in = aux_state.at[0].set(
-                    jnp.where(stage == 0, 0.0, aux_state[0]))
-                out, aux_delta = jax.vmap(stage_fn)(local_params, inp)
+                aux_in = jnp.where(stage == 0, 0.0, aux_state)
+                out, aux_delta = stage_fn(chunk, inp)
                 aux_new = aux_in + aux_delta
                 outputs, aux_out = jax.lax.cond(
                     emit,
                     lambda o, a: (
                         jax.lax.dynamic_update_index_in_dim(
-                            o, out[v - 1], jnp.maximum(out_idx, 0), 0),
+                            o, out, jnp.maximum(out_idx, 0), 0),
                         jax.lax.dynamic_update_index_in_dim(
-                            a, aux_new[v - 1], jnp.maximum(out_idx, 0), 0)),
+                            a, aux_new, jnp.maximum(out_idx, 0), 0)),
                     lambda o, a: (o, a), outputs, aux_out)
             else:
-                out = jax.vmap(stage_fn)(local_params, inp)
+                out = stage_fn(chunk, inp)
                 outputs = jax.lax.cond(
                     emit,
                     lambda o: jax.lax.dynamic_update_index_in_dim(
-                        o, out[v - 1], jnp.maximum(out_idx, 0), 0),
+                        o, out, jnp.maximum(out_idx, 0), 0),
                     lambda o: o, outputs)
-            shifted = jax.lax.ppermute(out, axis_name, perm)
-            # ring wraparound (p-1 -> 0) advances each activation one slot
-            rolled = jnp.roll(shifted, 1, axis=0)
-            state = jnp.where(stage == 0, rolled, shifted)
+            # the ring hop p-1 -> 0 delivers a finished activation to
+            # stage 0, where the next tick's injection overwrites it
+            state = jax.lax.ppermute(out, axis_name, perm)
             if with_aux:
-                aux_shifted = jax.lax.ppermute(aux_new, axis_name, perm)
-                aux_rolled = jnp.roll(aux_shifted, 1, axis=0)
-                aux_state = jnp.where(stage == 0, aux_rolled, aux_shifted)
+                aux_state = jax.lax.ppermute(aux_new, axis_name, perm)
                 return (state, aux_state, outputs, aux_out), None
             return (state, outputs), None
 
@@ -144,10 +143,10 @@ def spmd_pipeline(stage_fn: Callable, n_stages: int, n_microbatches: int,
         def vary(z):
             return jax.lax.pcast(z, axis_name, to="varying")
 
-        state0 = vary(jnp.zeros((v,) + mb_shape, x_mb.dtype))
+        state0 = vary(jnp.zeros(mb_shape, x_mb.dtype))
         outputs0 = vary(jnp.zeros((n_microbatches,) + mb_shape, x_mb.dtype))
         if with_aux:
-            aux0 = vary(jnp.zeros((v,), jnp.float32))
+            aux0 = vary(jnp.zeros((), jnp.float32))
             aux_out0 = vary(jnp.zeros((n_microbatches,), jnp.float32))
             (_, _, outputs, aux_out), _ = jax.lax.scan(
                 tick, (state0, aux0, outputs0, aux_out0),
@@ -176,11 +175,11 @@ def pipeline_forward(stage_fn, stacked_params, x_mb, n_stages,
                      remat=True, with_aux: bool = False):
     """Run the SPMD pipeline as a global computation via shard_map.
 
-    stacked_params: global arrays with leading dim n_stages*interleave in
-    natural chunk order (chunk c = layers [c*per:(c+1)*per]).
-    x_mb: [n_micro, micro_batch, ...] global input.
-    Only the 'pp' axis goes manual; dp/mp/fsdp shardings inside stage_fn
-    stay under GSPMD (partial-auto shard_map).
+    stacked_params: global arrays with leading dim n_stages (stage s =
+    layers [s*per:(s+1)*per]). x_mb: [n_micro, micro_batch, ...] global
+    input. Only the 'pp' axis goes manual; dp/mp/fsdp shardings inside
+    stage_fn stay under GSPMD (partial-auto shard_map). interleave must
+    be 1 (see spmd_pipeline; HostPipeline owns virtual stages).
     with_aux: stage_fn returns (y, aux_scalar); result is (y_mb, aux [m]).
     """
     mesh = mesh or get_mesh()
@@ -189,13 +188,6 @@ def pipeline_forward(stage_fn, stacked_params, x_mb, n_stages,
         body = jax.checkpoint(stage_fn)
     piped = spmd_pipeline(body, n_stages, n_microbatches,
                           interleave=interleave, with_aux=with_aux)
-    if interleave > 1:
-        # natural chunk order -> device-major round-robin placement
-        v, p = interleave, n_stages
-        perm = np.array([s * p + d for d in range(p) for s in range(v)])
-        stacked_params = jax.tree_util.tree_map(
-            lambda a: a[perm], stacked_params)
-
     param_specs = jax.tree_util.tree_map(lambda _: P("pp"), stacked_params)
     # check_vma=True is load-bearing: partial-manual shard_map with
     # check_vma=False is broken in jax 0.9 (its internal _unmatch builds a
