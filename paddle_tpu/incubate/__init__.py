@@ -56,6 +56,7 @@ def identity_loss(x, reduction="none"):
 
 
 from .optimizer import LookAhead, ModelAverage  # noqa: E402,F401
+from .ps_embedding import HostShardedEmbedding  # noqa: E402,F401
 # graph ops graduated into paddle_tpu.geometric; re-export at the
 # incubate paths the reference still documents
 from ..geometric import (  # noqa: E402,F401

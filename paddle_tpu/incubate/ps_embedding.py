@@ -1,0 +1,196 @@
+"""Host-resident sparse embedding table — the parameter-server
+sparse-table analog for beyond-HBM vocabularies.
+
+Reference analog: the PS sparse table + trainer pull/push loop
+(paddle/fluid/distributed/ps/table/memory_sparse_table.cc:1 hash-sharded
+rows, ssd_sparse_table.cc:1 beyond-RAM spill, accessor SGD rules, entry
+admission policies; trainer side paddle/fluid/framework/device_worker.h:266
+DownpourWorker pull -> compute -> push). TPU-native collapse
+(docs/ps_embedding_on_tpu.md): the multi-node brpc service becomes ONE
+host-resident table beside the single-controller loop — `pull(ids)`
+ships only the touched rows to device, the compiled step differentiates
+w.r.t. those rows, and `push(ids, grads)` applies the update rule
+host-side, exactly where the PS applied it server-side. In-HBM tables
+(the default tier) are `parallel.mp_layers.VocabParallelEmbedding`; this
+class is the spill tier.
+
+Rows are allocated lazily in a grow-by-doubling arena keyed by feature
+id (the memory_sparse_table hash-table semantics: ids are sparse,
+unbounded, and mostly absent), with the reference's entry admission
+policies honored: a `CountFilterEntry(k)` row reads as zeros and drops
+updates until its id has been seen k times; `ProbabilityEntry(p)`
+admits at first sight with probability p.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+
+class HostShardedEmbedding:
+    """Pull/push sparse embedding with host-side optimizer rules.
+
+    optimizer: 'sgd' | 'adagrad' (the reference ctr accessor's naive and
+    adagrad SGD rules).
+    entry: parallel.dist_tail.CountFilterEntry / ProbabilityEntry / None.
+    """
+
+    def __init__(self, embedding_dim: int, lr: float = 0.05,
+                 optimizer: str = "adagrad", entry=None,
+                 init_scale: float = 0.01, seed: int = 0,
+                 dtype=np.float32):
+        if optimizer not in ("sgd", "adagrad"):
+            raise ValueError(
+                f"optimizer {optimizer!r} is not one of sgd/adagrad")
+        if entry is not None:
+            from ..parallel.dist_tail import (CountFilterEntry,
+                                              ProbabilityEntry)
+            if not isinstance(entry, (CountFilterEntry,
+                                      ProbabilityEntry)):
+                raise ValueError(
+                    f"entry {type(entry).__name__} is not an admission "
+                    "policy this table understands (CountFilterEntry / "
+                    "ProbabilityEntry; ShowClickEntry configures CTR "
+                    "slot decay, which has no analog here)")
+        self.dim = int(embedding_dim)
+        self.lr = float(lr)
+        self.optimizer = optimizer
+        self.entry = entry
+        self.init_scale = float(init_scale)
+        self.dtype = np.dtype(dtype)
+        self._rng = np.random.default_rng(seed)
+        self._slot: Dict[int, int] = {}       # feature id -> arena row
+        self._table = np.zeros((0, self.dim), self.dtype)
+        self._accum = np.zeros((0, self.dim), np.float32)  # adagrad G
+        self._seen: Dict[int, int] = {}       # admission counters
+        self._size = 0
+
+    # ------------------------------------------------------------ arena
+    def _grow(self, need: int):
+        cap = self._table.shape[0]
+        if need <= cap:
+            return
+        new_cap = max(16, cap)
+        while new_cap < need:
+            new_cap *= 2
+        pad = new_cap - cap
+        self._table = np.concatenate(
+            [self._table,
+             np.zeros((pad, self.dim), self.dtype)], 0)
+        self._accum = np.concatenate(
+            [self._accum, np.zeros((pad, self.dim), np.float32)], 0)
+
+    def _admit(self, fid: int) -> bool:
+        """One sighting of `fid`; True when the row is (now) admitted."""
+        if fid in self._slot:
+            return True
+        ent = self.entry
+        name = type(ent).__name__ if ent is not None else ""
+        if name == "CountFilterEntry":
+            c = self._seen.get(fid, 0) + 1
+            self._seen[fid] = c
+            if c < ent._kw["count_filter"]:
+                return False
+        elif name == "ProbabilityEntry":
+            if fid in self._seen:             # previously rejected
+                return False
+            if self._rng.random() >= ent._kw["probability"]:
+                self._seen[fid] = 0
+                return False
+        self._grow(self._size + 1)
+        self._slot[fid] = self._size
+        self._table[self._size] = self._rng.normal(
+            0.0, self.init_scale, (self.dim,)).astype(self.dtype)
+        self._size += 1
+        return True
+
+    # -------------------------------------------------------- pull/push
+    def pull(self, ids) -> jnp.ndarray:
+        """[n] feature ids -> [n, dim] rows on device. Unadmitted ids
+        read as zeros (reference entry semantics); each UNIQUE id counts
+        one sighting per pull, and admission resolves before any row is
+        read — duplicate ids in one batch always see the same value (the
+        table holds one value per key, like the reference's)."""
+        ids = np.asarray(ids).ravel()
+        id_list = ids.tolist()
+        admitted = {fid: self._admit(fid) for fid in dict.fromkeys(id_list)}
+        out = np.zeros((ids.shape[0], self.dim), self.dtype)
+        for i, fid in enumerate(id_list):
+            if admitted[fid]:
+                out[i] = self._table[self._slot[fid]]
+        return jnp.asarray(out)
+
+    def push(self, ids, grads):
+        """Apply the update rule to the touched rows. Duplicate ids in
+        the batch accumulate their gradients before ONE rule application
+        (the reference merges by key before the table update)."""
+        ids = np.asarray(ids).ravel()
+        grads = np.asarray(grads).reshape(ids.shape[0], self.dim)
+        merged: Dict[int, np.ndarray] = {}
+        for i, fid in enumerate(ids.tolist()):
+            if fid not in self._slot:
+                continue                      # unadmitted: drop update
+            if fid in merged:
+                merged[fid] = merged[fid] + grads[i]
+            else:
+                merged[fid] = grads[i].astype(np.float32)
+        if not merged:
+            return
+        rows = np.fromiter((self._slot[f] for f in merged), dtype=np.int64,
+                           count=len(merged))
+        g = np.stack(list(merged.values()))
+        if self.optimizer == "adagrad":
+            self._accum[rows] += g * g
+            step = self.lr * g / (np.sqrt(self._accum[rows]) + 1e-10)
+        else:
+            step = self.lr * g
+        self._table[rows] -= step.astype(self.dtype)
+
+    # ------------------------------------------------------- inspection
+    def __len__(self):
+        return self._size
+
+    def rows(self, ids) -> np.ndarray:
+        """Host-side read (no admission side effects); zeros when
+        absent."""
+        ids = np.asarray(ids).ravel()
+        out = np.zeros((ids.shape[0], self.dim), self.dtype)
+        for i, fid in enumerate(ids.tolist()):
+            slot = self._slot.get(fid)
+            if slot is not None:
+                out[i] = self._table[slot]
+        return out
+
+    # ------------------------------------------------------- save/load
+    def state_dict(self) -> dict:
+        ids = np.fromiter(self._slot.keys(), dtype=np.int64,
+                          count=len(self._slot))
+        rows = np.fromiter(self._slot.values(), dtype=np.int64,
+                           count=len(self._slot))
+        return {
+            "ids": ids,
+            "table": self._table[rows].copy(),
+            "accum": self._accum[rows].copy(),
+            "optimizer": self.optimizer,
+            "lr": self.lr,
+            "dim": self.dim,
+        }
+
+    def load_state_dict(self, state: dict):
+        if int(state["dim"]) != self.dim:
+            raise ValueError(
+                f"checkpoint rows have dim {state['dim']}, table has "
+                f"{self.dim}")
+        if state.get("optimizer", self.optimizer) != self.optimizer:
+            raise ValueError(
+                f"checkpoint was trained with {state['optimizer']!r} "
+                f"but this table applies {self.optimizer!r}; restoring "
+                "it would silently change the update rule")
+        n = state["ids"].shape[0]
+        self._slot = {int(f): i for i, f in enumerate(state["ids"])}
+        self._size = n
+        self._table = np.asarray(state["table"], self.dtype).copy()
+        self._accum = np.asarray(state["accum"], np.float32).copy()
+        self._seen = {}

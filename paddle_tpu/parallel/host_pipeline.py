@@ -69,7 +69,6 @@ class HostPipeline:
         self.m = n_microbatches
         self.n_chunks = n_stages * interleave
         self.devs = stage_devices(mesh, "pp")
-        self._stage_fn = stage_fn
 
         @jax.jit
         def fwd(params, x):

@@ -1,0 +1,163 @@
+"""Host-resident sparse embedding (the PS sparse-table analog,
+docs/ps_embedding_on_tpu.md): pull/push parity with a dense in-device
+oracle, duplicate-id merge, entry admission policies, and end-to-end
+training through jax.grad (reference
+paddle/fluid/distributed/ps/table/memory_sparse_table.cc + entry_attr
+semantics)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.incubate.ps_embedding import HostShardedEmbedding
+from paddle_tpu.parallel.dist_tail import (CountFilterEntry,
+                                           ProbabilityEntry)
+
+
+class TestPullPush:
+    def test_sgd_matches_dense_oracle(self):
+        emb = HostShardedEmbedding(4, lr=0.1, optimizer="sgd", seed=3)
+        ids = np.array([7, 42, 7, 1000003])
+        first = np.asarray(emb.pull(ids))
+        # duplicate id pulls the same row
+        np.testing.assert_array_equal(first[0], first[2])
+
+        g = np.arange(16, dtype=np.float32).reshape(4, 4) * 0.1
+        emb.push(ids, g)
+        # dense oracle: scatter-ADD duplicate grads, one sgd step
+        want = {7: first[0] - 0.1 * (g[0] + g[2]),
+                42: first[1] - 0.1 * g[1],
+                1000003: first[3] - 0.1 * g[3]}
+        got = emb.rows(np.array([7, 42, 1000003]))
+        for i, fid in enumerate([7, 42, 1000003]):
+            np.testing.assert_allclose(got[i], want[fid], atol=1e-6)
+
+    def test_adagrad_rule(self):
+        emb = HostShardedEmbedding(2, lr=0.5, optimizer="adagrad",
+                                   seed=0)
+        ids = np.array([5])
+        r0 = np.asarray(emb.pull(ids))[0]
+        g = np.array([[0.2, -0.4]], np.float32)
+        emb.push(ids, g)
+        want = r0 - 0.5 * g[0] / (np.sqrt(g[0] * g[0]) + 1e-10)
+        np.testing.assert_allclose(emb.rows(ids)[0], want, atol=1e-5)
+        # second push divides by the accumulated sqrt(G)
+        emb.push(ids, g)
+        want = want - 0.5 * g[0] / (np.sqrt(2 * g[0] * g[0]) + 1e-10)
+        np.testing.assert_allclose(emb.rows(ids)[0], want, atol=1e-5)
+
+    def test_unknown_optimizer_rejected(self):
+        with pytest.raises(ValueError, match="sgd/adagrad"):
+            HostShardedEmbedding(4, optimizer="ftrl")
+
+
+class TestAdmission:
+    def test_count_filter_admits_after_k_sightings(self):
+        emb = HostShardedEmbedding(3, entry=CountFilterEntry(3), seed=1)
+        ids = np.array([9])
+        # sightings 1 and 2: zeros, updates dropped
+        assert np.all(np.asarray(emb.pull(ids)) == 0)
+        emb.push(ids, np.ones((1, 3), np.float32))
+        assert len(emb) == 0
+        assert np.all(np.asarray(emb.pull(ids)) == 0)
+        # third sighting admits, row becomes real
+        row = np.asarray(emb.pull(ids))
+        assert len(emb) == 1 and np.any(row != 0)
+        # and now updates apply
+        before = emb.rows(ids)[0].copy()
+        emb.push(ids, np.ones((1, 3), np.float32))
+        assert np.any(emb.rows(ids)[0] != before)
+
+    def test_probability_entry_rejects_forever_or_admits(self):
+        always = HostShardedEmbedding(2, entry=ProbabilityEntry(1.0))
+        assert np.any(np.asarray(always.pull(np.array([4]))) != 0)
+        # p≈0: effectively never admitted (rng.random() >= 1e-12 a.s.)
+        never = HostShardedEmbedding(2, entry=ProbabilityEntry(1e-12))
+        for _ in range(3):
+            assert np.all(np.asarray(never.pull(np.array([4]))) == 0)
+        assert len(never) == 0
+
+
+class TestTraining:
+    def test_ctr_style_loss_decreases(self):
+        """pull -> jax.grad step -> push loop trains (the DownpourWorker
+        loop collapsed to one host)."""
+        emb = HostShardedEmbedding(8, lr=0.3, optimizer="adagrad",
+                                   seed=0)
+        rng = np.random.default_rng(0)
+        w = jnp.asarray(rng.normal(0, 0.1, (8,)), jnp.float32)
+        ids = rng.integers(0, 50, (16, 3))      # 3 slots per example
+        y = jnp.asarray(rng.integers(0, 2, (16,)), jnp.float32)
+
+        def loss_fn(rows, w, y):
+            feat = rows.reshape(16, 3, 8).sum(1)
+            logits = feat @ w
+            return jnp.mean(
+                jnp.maximum(logits, 0) - logits * y
+                + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+
+        losses = []
+        for _ in range(30):
+            rows = emb.pull(ids.ravel())
+            val, g = jax.value_and_grad(loss_fn)(rows, w, y)
+            losses.append(float(val))
+            emb.push(ids.ravel(), np.asarray(g))
+        assert losses[-1] < losses[0] * 0.8, losses[::10]
+
+    def test_state_dict_roundtrip(self):
+        emb = HostShardedEmbedding(4, lr=0.1, seed=2)
+        ids = np.array([3, 11, 3000])
+        emb.pull(ids)
+        emb.push(ids, np.ones((3, 4), np.float32))
+        state = emb.state_dict()
+
+        emb2 = HostShardedEmbedding(4, lr=0.1, seed=99)
+        emb2.load_state_dict(state)
+        np.testing.assert_array_equal(emb2.rows(ids), emb.rows(ids))
+        # adagrad state survives too: same next step on both
+        emb.push(ids, np.ones((3, 4), np.float32))
+        emb2.push(ids, np.ones((3, 4), np.float32))
+        np.testing.assert_allclose(emb2.rows(ids), emb.rows(ids),
+                                   atol=1e-6)
+
+    def test_dim_mismatch_rejected(self):
+        emb = HostShardedEmbedding(4)
+        emb.pull(np.array([1]))
+        state = emb.state_dict()
+        with pytest.raises(ValueError, match="dim"):
+            HostShardedEmbedding(8).load_state_dict(state)
+
+    def test_optimizer_mismatch_rejected_on_load(self):
+        emb = HostShardedEmbedding(4, optimizer="adagrad")
+        emb.pull(np.array([1]))
+        state = emb.state_dict()
+        with pytest.raises(ValueError, match="update rule"):
+            HostShardedEmbedding(4, optimizer="sgd").load_state_dict(
+                state)
+
+
+class TestEntryValidation:
+    def test_unknown_entry_rejected(self):
+        from paddle_tpu.parallel.dist_tail import ShowClickEntry
+        with pytest.raises(ValueError, match="admission policy"):
+            HostShardedEmbedding(4, entry=ShowClickEntry("s", "c"))
+
+    def test_duplicate_ids_same_row_even_at_admission(self):
+        """Admission resolves before any row is read: a batch that
+        admits an id must pull the SAME value at every occurrence (one
+        value per key, like the reference table)."""
+        emb = HostShardedEmbedding(3, entry=CountFilterEntry(1), seed=4)
+        rows = np.asarray(emb.pull(np.array([5, 5, 5])))
+        np.testing.assert_array_equal(rows[0], rows[1])
+        np.testing.assert_array_equal(rows[1], rows[2])
+        assert np.any(rows[0] != 0)
+
+    def test_count_filter_counts_unique_per_pull(self):
+        """A pull with k duplicates of an unseen id is ONE sighting."""
+        emb = HostShardedEmbedding(3, entry=CountFilterEntry(2), seed=4)
+        assert np.all(np.asarray(emb.pull(np.array([7, 7, 7]))) == 0)
+        assert len(emb) == 0
+        # second pull = second sighting -> admitted
+        rows = np.asarray(emb.pull(np.array([7])))
+        assert len(emb) == 1 and np.any(rows != 0)
