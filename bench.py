@@ -107,6 +107,28 @@ def apply_perf_env_defaults() -> None:
         os.environ.setdefault("PADDLE_TPU_AUTOTUNE_CACHE", cache)
 
 
+def _sweep_winner_variant():
+    """The campaign-adopted sweep winner (perf/sweep_winner.json) as a
+    bench race variant (cfg overrides, batch, env) — None when no sweep
+    has landed or the spec doesn't parse."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "perf", "sweep_winner.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        cfg = {}
+        if doc.get("remat") is False:
+            cfg["remat"] = False
+        elif doc.get("policy"):
+            cfg["remat_policy"] = doc["policy"]
+        from paddle_tpu.kernels.flash_attention import impl_from_winner_env
+        impl = impl_from_winner_env(doc.get("env") or {})
+        env = {"PADDLE_TPU_ATTN_IMPL": impl} if impl else {}
+        return (cfg, doc.get("batch"), env)
+    except (OSError, ValueError, TypeError):
+        return None
+
+
 def run_measurement(rung: str) -> None:
     """Run one ladder rung and print the JSON line to stdout."""
     name, kw, batch, seq, iters, _ = next(c for c in LADDER if c[0] == rung)
@@ -168,16 +190,33 @@ def run_measurement(rung: str) -> None:
         # time, decides the winner across batches.
         splash = {"PADDLE_TPU_ATTN_IMPL": "splash"}
         jaxflash = {"PADDLE_TPU_ATTN_IMPL": "jax_flash"}
+        xla = {"PADDLE_TPU_ATTN_IMPL": "xla"}
+        # pallas is pinned EXPLICITLY on its variants: with the env
+        # unset, _attn_impl now follows perf/sweep_winner.json, which
+        # would silently turn the homegrown-kernel baselines into
+        # duplicates of the winner's impl
+        pallas = {"PADDLE_TPU_ATTN_IMPL": "pallas"}
+        # the adopted sweep winner (if a sweep has landed) races FIRST:
+        # a congested window that only fits one extra variant still
+        # re-validates the measured best
+        winner = _sweep_winner_variant()
+        if winner is not None:
+            variants.append(winner)
         variants.append((dict(remat_policy="all_but_mlp"), None, splash))
-        variants.append((dict(remat_policy="all_but_mlp"), None, {}))
+        variants.append((dict(remat_policy="all_but_mlp"), None, pallas))
+        # window-1 ablation: plain XLA attention beat every Pallas-fwd
+        # variant (399.7 vs 427.6+ ms) — it races at both remat poles
+        variants.append((dict(), None, xla))
+        variants.append((dict(remat_policy="all_but_mlp"), None, xla))
         variants.append((dict(remat_policy="dots_flash"), None, splash))
         variants.append((dict(remat_policy="dots_flash"), None, jaxflash))
         variants.append((dict(remat=False), 4, splash))
-        variants.append((dict(remat=False), 4, {}))
+        variants.append((dict(remat=False), 4, pallas))
+        variants.append((dict(remat=False), 4, xla))
         # batch crossings (the old tpu-b16 rung, now one race): more
         # tokens/step amortize the update; OOMs are caught and skipped
         variants.append((dict(remat_policy="all_but_mlp"), 12, splash))
-        variants.append((dict(), 16, {}))
+        variants.append((dict(), 16, pallas))
 
     def emit(dt, cfg, n_params, vkw, vbatch):
         tps = vbatch * seq / dt

@@ -31,6 +31,67 @@ from .shm_ring import ShmRing, RingClosed, RingTimeout
 _worker_info = None
 
 
+def worker_start_method() -> str:
+    """How DataLoader workers are created. 'fork' (default, zero-copy:
+    the dataset/collate cross into the child by address space, matching
+    the reference's Linux default) or 'spawn' (PADDLE_TPU_WORKER_START=
+    spawn): fresh processes that receive everything by pickle and attach
+    the shm rings by name. Use spawn on multi-host jobs where the jax
+    backend (and its thread pool) initializes before the first
+    DataLoader — fork() in a thread-heavy process is a latent deadlock
+    (jax emits the RuntimeWarning); spawn side-steps it at the cost of
+    picklable datasets/collate_fns and slower worker startup."""
+    m = os.environ.get("PADDLE_TPU_WORKER_START", "fork")
+    if m not in ("fork", "spawn"):
+        raise ValueError(
+            f"PADDLE_TPU_WORKER_START={m!r} is not fork or spawn")
+    return m
+
+
+def _start_worker(target, args):
+    """Start one worker by the configured method; returns its pid."""
+    if worker_start_method() == "fork":
+        pid = os.fork()
+        if pid == 0:
+            # child: never run parent atexit/finally frames
+            try:
+                target(*args)
+            finally:
+                os._exit(0)
+        return pid
+    import multiprocessing as mp
+    proc = mp.get_context("spawn").Process(
+        target=target, args=args, daemon=True)
+    proc.start()
+    return proc.pid
+
+
+def _get_checked(ring, pid, timeout):
+    """ring.get that survives a worker dying WITHOUT closing its ring
+    (possible in spawn mode: the fresh interpreter can fail before the
+    worker loop even starts — e.g. an unpicklable __main__, an import
+    error). With no user timeout we poll and probe the pid so the parent
+    raises instead of blocking forever; fork workers can't fail that
+    way (the loop is entered in the already-running child) but the
+    probe is harmless there."""
+    if timeout is not None:
+        return ring.get(timeout=timeout)
+    while True:
+        try:
+            return ring.get(timeout=5.0)
+        except RingTimeout:
+            try:
+                done, _ = os.waitpid(pid, os.WNOHANG)
+            except ChildProcessError:
+                done = pid        # already reaped elsewhere: it IS dead
+            if done:
+                raise WorkerError(
+                    f"DataLoader worker (pid {pid}) exited without "
+                    "producing; with start_method=spawn check that the "
+                    "dataset/collate_fn are picklable and importable "
+                    "from the child") from None
+
+
 class WorkerInfo:
     def __init__(self, id: int, num_workers: int, seed: int, dataset):
         self.id = id
@@ -166,16 +227,11 @@ class MultiprocessIterator:
         self._pids: List[int] = []
         base_seed = int.from_bytes(os.urandom(4), "little")
         for w in range(num_workers):
-            pid = os.fork()
-            if pid == 0:
-                # child: never run parent atexit/finally frames
-                try:
-                    _worker_loop(self._rings[w], w, num_workers, dataset,
-                                 batch_indices, collate_fn, worker_init_fn,
-                                 base_seed, batch_size, drop_last)
-                finally:
-                    os._exit(0)
-            self._pids.append(pid)
+            self._pids.append(_start_worker(
+                _worker_loop,
+                (self._rings[w], w, num_workers, dataset, batch_indices,
+                 collate_fn, worker_init_fn, base_seed, batch_size,
+                 drop_last)))
 
     def __iter__(self):
         try:
@@ -187,8 +243,9 @@ class MultiprocessIterator:
                 j = 0
                 while True:
                     try:
-                        data = self._rings[j % self._nw].get(
-                            timeout=self._timeout)
+                        data = _get_checked(
+                            self._rings[j % self._nw],
+                            self._pids[j % self._nw], self._timeout)
                     except RingClosed:
                         break
                     except RingTimeout:
@@ -205,7 +262,8 @@ class MultiprocessIterator:
                 while open_rings:
                     w = open_rings[i % len(open_rings)]
                     try:
-                        data = self._rings[w].get(timeout=self._timeout)
+                        data = _get_checked(self._rings[w],
+                                            self._pids[w], self._timeout)
                     except RingClosed:
                         open_rings.remove(w)
                         continue
@@ -324,16 +382,11 @@ class PersistentWorkerPool:
         self._pids: List[int] = []
         base_seed = int.from_bytes(os.urandom(4), "little")
         for w in range(num_workers):
-            pid = os.fork()
-            if pid == 0:
-                try:
-                    _persistent_worker_loop(
-                        self._cmd_rings[w], self._data_rings[w], w,
-                        num_workers, dataset, collate_fn, worker_init_fn,
-                        base_seed)
-                finally:
-                    os._exit(0)
-            self._pids.append(pid)
+            self._pids.append(_start_worker(
+                _persistent_worker_loop,
+                (self._cmd_rings[w], self._data_rings[w], w,
+                 num_workers, dataset, collate_fn, worker_init_fn,
+                 base_seed)))
 
     def run_epoch(self, batch_indices, batch_size=None, drop_last=False):
         """Yield one epoch's batches in deterministic order (map-style:
@@ -400,7 +453,8 @@ class PersistentWorkerPool:
 
     def _get(self, w):
         try:
-            data = self._data_rings[w].get(timeout=self._timeout)
+            data = _get_checked(self._data_rings[w], self._pids[w],
+                                self._timeout)
         except RingClosed:
             self.close()
             raise WorkerError(

@@ -15,7 +15,6 @@ is no compiler or no Linux shm semantics.
 from __future__ import annotations
 
 import ctypes
-import mmap
 import os
 import sys
 import threading
@@ -88,10 +87,17 @@ class RingClosed(Exception):
 
 
 class ShmRing:
-    """SPSC shared-memory ring. Create in the parent BEFORE fork; both
-    sides then use the same object (the mmap is inherited)."""
+    """SPSC shared-memory ring over a NAMED POSIX shm region.
 
-    def __init__(self, n_slots: int = 4, slot_bytes: int = 1 << 22):
+    Fork-mode workers inherit the mapping; spawn-mode workers attach by
+    name (the ring pickles as its name + geometry), which is what lets
+    the DataLoader offer start_method='spawn' — the fork-after-jax-init
+    deadlock escape hatch. The creating process owns the region and
+    unlinks it on close()."""
+
+    def __init__(self, n_slots: int = 4, slot_bytes: int = 1 << 22,
+                 _attach: str | None = None):
+        from multiprocessing import shared_memory
         lib = _get_lib()
         if lib is None:
             raise RuntimeError(
@@ -100,30 +106,103 @@ class ShmRing:
         self.n_slots = int(n_slots)
         self.slot_bytes = int(slot_bytes)
         size = lib.ring_region_size(self.n_slots, self.slot_bytes)
-        self._mm = mmap.mmap(-1, size)  # anonymous, MAP_SHARED
-        self._addr = ctypes.addressof(ctypes.c_char.from_buffer(self._mm))
-        rc = lib.ring_init(self._addr, self.n_slots, self.slot_bytes)
-        if rc != 0:
-            raise RuntimeError(f"ring_init failed (rc={rc})")
+        if _attach is None:
+            self._shm = shared_memory.SharedMemory(create=True, size=size)
+            self._name = self._shm.name
+            self._owner = True
+            self._addr = ctypes.addressof(
+                ctypes.c_char.from_buffer(self._shm.buf))
+            rc = lib.ring_init(self._addr, self.n_slots, self.slot_bytes)
+            if rc != 0:
+                raise RuntimeError(f"ring_init failed (rc={rc})")
+        else:
+            # raw mmap of the named region: SharedMemory(name=...) would
+            # enroll the attaching process with the resource tracker,
+            # whose cleanup then races the owner's unlink (KeyError noise
+            # / early unlink); the child needs only the mapping
+            import mmap as _mmap
+            fd = os.open(f"/dev/shm/{_attach}", os.O_RDWR)
+            try:
+                self._mm = _mmap.mmap(fd, size)
+            finally:
+                os.close(fd)
+            self._shm = None
+            self._name = _attach
+            self._owner = False
+            self._addr = ctypes.addressof(
+                ctypes.c_char.from_buffer(self._mm))
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    # pickling = attach-by-name (spawn-mode workers)
+    def __getstate__(self):
+        return {"n_slots": self.n_slots, "slot_bytes": self.slot_bytes,
+                "name": self._name}
+
+    def __setstate__(self, state):
+        self.__init__(state["n_slots"], state["slot_bytes"],
+                      _attach=state["name"])
+
+    def close(self):
+        """Drop this process's mapping; the owner also unlinks the
+        region. Idempotent."""
+        shm = getattr(self, "_shm", None)
+        mm = getattr(self, "_mm", None)
+        self._shm = None
+        self._mm = None
+        self._addr = None
+        if mm is not None:
+            try:
+                mm.close()
+            except Exception:
+                pass
+        if shm is not None:
+            try:
+                shm.close()
+            except Exception:
+                pass
+            if getattr(self, "_owner", False):
+                try:
+                    shm.unlink()
+                except Exception:
+                    pass
+
+    def __del__(self):
+        # named regions persist in /dev/shm until unlinked (anonymous
+        # mmaps did not) — GC of the owner must reclaim them
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def _live_addr(self):
+        # a closed ring must fail as a Python error, not a NULL deref
+        # inside the native code
+        if self._addr is None:
+            raise RingClosed("ring is closed")
+        return self._addr
 
     # ---- producer ----
     def put(self, data, timeout: float | None = None) -> None:
         data = bytes(data) if not isinstance(data, (bytes, bytearray)) \
             else data
         t_ms = -1 if timeout is None else max(0, int(timeout * 1000))
-        rc = self._lib.ring_put(self._addr, bytes(data), len(data), t_ms)
+        rc = self._lib.ring_put(self._live_addr(), bytes(data), len(data),
+                                t_ms)
         if rc == -1:
             raise RingTimeout(f"ring_put timed out after {timeout}s")
         if rc != 0:
             raise RuntimeError(f"ring_put failed (rc={rc})")
 
     def close_producer(self) -> None:
-        self._lib.ring_close_producer(self._addr)
+        self._lib.ring_close_producer(self._live_addr())
 
     # ---- consumer ----
     def get(self, timeout: float | None = None) -> bytes:
         t_ms = -1 if timeout is None else max(0, int(timeout * 1000))
-        size = self._lib.ring_next_size(self._addr, t_ms)
+        size = self._lib.ring_next_size(self._live_addr(), t_ms)
         if size == -4:
             raise RingClosed
         if size == -1:
@@ -131,7 +210,7 @@ class ShmRing:
         if size < 0:
             raise RuntimeError(f"ring_next_size failed (rc={size})")
         buf = ctypes.create_string_buffer(int(size))
-        got = self._lib.ring_get(self._addr, buf, int(size), t_ms)
+        got = self._lib.ring_get(self._live_addr(), buf, int(size), t_ms)
         if got == -4:
             raise RingClosed
         if got == -1:
@@ -142,7 +221,7 @@ class ShmRing:
 
     # ---- introspection ----
     def buffered(self) -> int:
-        return max(0, self._lib.ring_full_slots(self._addr))
+        return max(0, self._lib.ring_full_slots(self._live_addr()))
 
     def producer_done(self) -> bool:
-        return bool(self._lib.ring_producer_done(self._addr))
+        return bool(self._lib.ring_producer_done(self._live_addr()))

@@ -326,17 +326,64 @@ def _flash_mha_bwd(causal, kv_len, res, do):
 _flash_mha.defvjp(_flash_mha_fwd, _flash_mha_bwd)
 
 
+_sweep_winner_impl = None     # memoized perf/sweep_winner.json read
+
+
+def impl_from_winner_env(env: dict) -> str:
+    """ONE home for the sweep-spec env -> impl translation (bench.py's
+    race seeding uses it too): the sweep spells 'xla' as the
+    PADDLE_TPU_DISABLE_PALLAS_ATTN kill switch. '' when the env names no
+    recognizable impl."""
+    impl = env.get("PADDLE_TPU_ATTN_IMPL", "")
+    if not impl and env.get("PADDLE_TPU_DISABLE_PALLAS_ATTN") == "1":
+        impl = "xla"
+    return impl if impl in ("pallas", "jax_flash", "splash", "xla") \
+        else ""
+
+
+def _winner_impl():
+    """Attention impl adopted by the latest hardware sweep
+    (perf/sweep_winner.json, written by tools/tpu_campaign.py when the
+    sweep job lands) — the measured winner ships as the TPU default
+    without a code edit. Only consulted on TPU-class backends: the CPU
+    suite must keep exercising the documented 'pallas' path (interpret-
+    mode parity coverage would silently vanish otherwise). Memoized for
+    the process lifetime; absent/invalid file -> None."""
+    global _sweep_winner_impl
+    if jax.default_backend() not in ("tpu", "axon"):
+        return None
+    if _sweep_winner_impl is None:
+        import json
+        import os
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))), "perf",
+            "sweep_winner.json")
+        env = {}
+        try:
+            with open(path) as f:
+                env = json.load(f).get("env", {})
+        except (OSError, ValueError):
+            pass
+        _sweep_winner_impl = impl_from_winner_env(env)
+    return _sweep_winner_impl or None
+
+
 def _attn_impl() -> str:
     """Attention implementation selector (PADDLE_TPU_ATTN_IMPL):
-    - 'pallas'   homegrown kernel + the gates above (default)
+    - 'pallas'   homegrown kernel + the gates above
     - 'jax_flash' jax.experimental.pallas.ops.tpu.flash_attention — the
       upstream-tuned TPU kernel with its own fwd+bwd Pallas passes
     - 'splash'   jax.experimental splash attention (block-sparse mask
       pipeline; usually the fastest causal kernel)
     - 'xla'      the blockwise lax.scan path (same as the ATTN kill)
-    Re-read per trace like the kill switches."""
+    The ENV VAR is re-read per trace like the kill switches; with it
+    unset, TPU-class backends follow the latest measured sweep winner
+    (perf/sweep_winner.json, memoized per process — a sweep landing
+    mid-process applies from the next process), falling back to
+    'pallas'. CPU keeps the 'pallas' default for parity coverage."""
     import os
-    return os.environ.get("PADDLE_TPU_ATTN_IMPL", "pallas")
+    return (os.environ.get("PADDLE_TPU_ATTN_IMPL")
+            or _winner_impl() or "pallas")
 
 
 def _jax_flash_mha(q, k, v, causal):

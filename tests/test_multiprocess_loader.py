@@ -276,3 +276,39 @@ class TestMultiprocessLoader:
                         use_shared_memory=False)
         assert not dl._multiprocess_ok()
         assert len(list(dl)) == 4
+
+
+class TestSpawnWorkers:
+    """start_method='spawn' (PADDLE_TPU_WORKER_START=spawn): the
+    fork-after-jax-init escape hatch — rings attach by NAME in fresh
+    processes, everything else crosses by pickle. Same batches as fork
+    and as num_workers=0."""
+
+    def test_ring_pickles_and_attaches_by_name(self):
+        import pickle as pkl
+        ring = ShmRing(n_slots=2, slot_bytes=1 << 12)
+        clone = pkl.loads(pkl.dumps(ring))
+        ring.put(b"hello-spawn")
+        assert clone.get(timeout=5.0) == b"hello-spawn"
+        clone.close()
+        ring.close()
+
+    def test_spawn_loader_matches_serial(self, monkeypatch):
+        ds = ArrayDataset(n=20, shape=(2,))
+        monkeypatch.setenv("PADDLE_TPU_WORKER_START", "spawn")
+        got = [np.asarray(b[0].numpy()) for b in
+               DataLoader(ds, batch_size=4, num_workers=2,
+                          shuffle=False)]
+        monkeypatch.delenv("PADDLE_TPU_WORKER_START")
+        want = [np.asarray(b[0].numpy()) for b in
+                DataLoader(ds, batch_size=4, num_workers=0,
+                           shuffle=False)]
+        assert len(got) == len(want) > 0
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(g, w)
+
+    def test_bad_start_method_rejected(self, monkeypatch):
+        from paddle_tpu.io.multiprocess import worker_start_method
+        monkeypatch.setenv("PADDLE_TPU_WORKER_START", "forkserver")
+        with pytest.raises(ValueError, match="fork or spawn"):
+            worker_start_method()

@@ -152,6 +152,44 @@ def run_job(name, argv, timeout_s, env_extra, window_dir) -> dict:
             "json_lines": recs, "out": out_path}
 
 
+def adopt_sweep_winner(json_lines: list, window_ts: str) -> None:
+    """Self-executing adoption (round-5): when the sweep lands, persist
+    the best tokens/sec variant with its full spec to
+    perf/sweep_winner.json. kernels.flash_attention._attn_impl and the
+    bench race consult it, so the measured winner becomes the shipped
+    default without waiting for a human to read the window artifact."""
+    try:
+        rows = [r for r in json_lines
+                if isinstance(r, dict) and r.get("tokens_per_sec")
+                and r.get("platform") in ("tpu", "axon")]
+        if not rows:
+            return
+        best = max(rows, key=lambda r: r["tokens_per_sec"])
+        sys.path.insert(0, os.path.join(HERE, "tools"))
+        from sweep_gpt_step import _specs
+        spec = next((s for s in _specs() if s["name"] == best["name"]),
+                    {})
+        doc = {
+            "name": best["name"],
+            "tokens_per_sec": best["tokens_per_sec"],
+            "ms_per_step": best["ms_per_step"],
+            "batch": best.get("batch"),
+            "env": spec.get("env", {}),
+            "remat": spec.get("remat"),
+            "policy": spec.get("policy"),
+            "window": window_ts,
+        }
+        path = os.path.join(PERF, "sweep_winner.json")
+        tmp = f"{path}.tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, path)
+        log(f"adopted sweep winner {best['name']} "
+            f"({best['tokens_per_sec']} tok/s) -> perf/sweep_winner.json")
+    except Exception as e:
+        log(f"sweep winner adoption failed (non-fatal): {e!r}")
+
+
 def append_window_artifact(window_ts: str, job: str, recs: list) -> None:
     """Repo-root machine-readable record of everything measured in this
     window — bench/judge artifacts must not depend on the tunnel staying
@@ -236,6 +274,8 @@ def main() -> None:
             }
             save_state(state)
             if res["rc"] == 0 and n:
+                if name == "sweep":
+                    adopt_sweep_winner(res["json_lines"], window_ts)
                 pending.pop(0)
                 dead_probes = 0
                 continue
