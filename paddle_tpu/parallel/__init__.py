@@ -21,6 +21,9 @@ from .sharding import (  # noqa: F401
     group_sharded_parallel, save_group_sharded_model, GroupShardedStage2,
     GroupShardedStage3, GroupShardedOptimizerStage2, shard_model_stage3,
     shard_optimizer_state)
+from .compression import (  # noqa: F401
+    compressed_psum, dgc_compress, dgc_decompress, dgc_psum,
+    local_sgd_sync)
 from .host_pipeline import HostPipeline  # noqa: F401
 from .pipeline import (  # noqa: F401
     spmd_pipeline, pipeline_forward, PipelineLayer, LayerDesc,

@@ -1,0 +1,105 @@
+"""Gradient-compression collectives for bandwidth-starved links.
+
+Reference analog: the fleet meta-optimizers that trade gradient fidelity
+for reduction bandwidth —
+python/paddle/distributed/fleet/meta_optimizers/dgc_optimizer.py:1 (deep
+gradient compression: momentum-corrected top-k sparsification with error
+feedback), fp16_allreduce_optimizer.py (reduce in half precision),
+localsgd_optimizer.py (local steps + periodic parameter averaging).
+
+TPU-native position (docs in fleet/fleet.py): on an ICI-connected slice
+these are counterproductive — the interconnect outruns the compression
+math, and GSPMD already fuses/overlaps the reduction. They earn their
+keep on DCN-crossing multi-slice data parallelism, where the cross-slice
+link is ~10-100x slower than ICI. Accordingly they are expressed as
+building blocks for the explicit shard_map path (the only place a
+DCN-crossing reduction is explicit), not as silent rewrites of the
+single-program GSPMD step:
+
+- `compressed_psum`: psum with the wire dtype dropped to bf16/f16.
+- `dgc_compress` / `dgc_decompress`: top-k sparsification with error
+  feedback (the residual accumulates what was not sent — DGC's core
+  invariant), shaped for a gather-based exchange.
+- `local_sgd_sync`: periodic cross-replica parameter averaging for
+  local-update training.
+
+All are pure jax functions usable inside jit/shard_map.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["compressed_psum", "dgc_compress", "dgc_decompress",
+           "dgc_psum", "local_sgd_sync"]
+
+
+def compressed_psum(x, axis_name: str, wire_dtype=jnp.bfloat16):
+    """All-reduce `x` with the on-wire dtype reduced to `wire_dtype`
+    (reference fp16_allreduce). The accumulation error is bounded by the
+    cast; the result is upcast back to x.dtype. Call inside shard_map
+    over `axis_name`."""
+    return jax.lax.psum(x.astype(wire_dtype), axis_name).astype(x.dtype)
+
+
+def dgc_compress(grad, residual, k_frac: float = 0.01
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Deep-gradient-compression sparsification of one gradient tensor.
+
+    Adds the error-feedback residual, keeps the top ceil(k_frac*n)
+    entries by magnitude, and returns (values, indices, new_residual):
+    the unsent mass STAYS in the residual so no gradient signal is ever
+    dropped, only delayed (the DGC invariant; reference
+    dgc_optimizer.py + the dgc_op CUDA kernels). Static output shapes —
+    k is a trace-time constant — so the exchange compiles on TPU."""
+    if not 0.0 < k_frac <= 1.0:
+        raise ValueError(f"k_frac must be in (0, 1]; got {k_frac}")
+    import math
+    acc = (residual + grad).ravel()
+    k = max(1, math.ceil(acc.shape[0] * k_frac))
+    vals, idx = jax.lax.top_k(jnp.abs(acc), k)
+    sent = acc[idx]
+    new_residual = acc.at[idx].set(0.0).reshape(grad.shape)
+    return sent, idx, new_residual
+
+
+def dgc_decompress(sent, idx, shape) -> jnp.ndarray:
+    """Scatter the exchanged (values, indices) back to a dense tensor."""
+    n = 1
+    for s in shape:
+        n *= s
+    return jnp.zeros((n,), sent.dtype).at[idx].add(sent).reshape(shape)
+
+
+def dgc_psum(grad, residual, axis_name: str, k_frac: float = 0.01):
+    """One DGC-compressed all-reduce step inside shard_map: each member
+    all-gathers only its top-k (values, indices) — wire volume ~2*k*W
+    floats instead of the dense n per member — then scatter-sums
+    everyone's sparse contributions locally. The residual carries the
+    unsent mass to the next step."""
+    sent, idx, new_residual = dgc_compress(grad, residual, k_frac)
+    # the EXCHANGE is sparse (this is where the bandwidth saving lives);
+    # densification happens after the collective, locally. Spelled as a
+    # psum of per-member [W, k] rows rather than all_gather: identical
+    # wire content, and psum's output is vma-invariant so the caller can
+    # declare replicated out_specs (all_gather's isn't inferred).
+    w = jax.lax.axis_size(axis_name)
+    me = jax.lax.axis_index(axis_name)
+    k = sent.shape[0]
+    all_sent = jax.lax.psum(
+        jnp.zeros((w, k), sent.dtype).at[me].set(sent), axis_name)
+    all_idx = jax.lax.psum(
+        jnp.zeros((w, k), jnp.int32).at[me].set(idx.astype(jnp.int32)),
+        axis_name)
+    total = dgc_decompress(all_sent.ravel(), all_idx.ravel(), grad.shape)
+    return total, new_residual
+
+
+def local_sgd_sync(params, axis_name: str):
+    """Average parameters across `axis_name` (reference localsgd's
+    periodic sync). Call every k-th step inside the shard_map-per-replica
+    training loop; between syncs each member steps on its own shard."""
+    return jax.tree_util.tree_map(
+        lambda p: jax.lax.pmean(p, axis_name), params)

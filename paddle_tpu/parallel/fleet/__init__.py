@@ -2,8 +2,9 @@
 from .strategy import DistributedStrategy  # noqa: F401
 from .fleet import (  # noqa: F401
     init, is_initialized, distributed_model, distributed_optimizer,
-    HybridParallelOptimizer, worker_num, worker_index, is_first_worker,
-    is_worker, is_server, barrier_worker, stop_worker)
+    HybridParallelOptimizer, multislice_grad_sync, worker_num,
+    worker_index, is_first_worker, is_worker, is_server, barrier_worker,
+    stop_worker)
 from ..topology import get_hybrid_communicate_group  # noqa: F401
 from ..random import get_rng_state_tracker  # noqa: F401
 from .recompute import recompute, recompute_sequential  # noqa: F401

@@ -32,6 +32,7 @@ class _FleetState:
         self.initialized = False
         self.strategy: Optional[DistributedStrategy] = None
         self.hcg: Optional[HybridCommunicateGroup] = None
+        self.compression: list = []      # dgc/localsgd/fp16_allreduce
 
 
 _state = _FleetState()
@@ -272,14 +273,27 @@ def distributed_optimizer(optimizer, strategy=None):
         _state.strategy = strategy
     strategy = strategy or _state.strategy
     if strategy is not None:
-        for inert in ("dgc", "localsgd", "fp16_allreduce"):
-            if getattr(strategy, inert, False):
-                raise NotImplementedError(
-                    f"DistributedStrategy.{inert} is a CUDA/NCCL ring "
-                    "mechanism with no XLA analog: gradient compression/"
-                    "local-sgd are not applied by GSPMD collectives. "
-                    "Unset it (grad reduction is already fused and "
-                    "overlapped by the compiler).")
+        # Gradient-compression-class strategies (reference
+        # meta_optimizers/{dgc,localsgd,fp16_allreduce}_optimizer.py):
+        # pointless on an ICI slice (GSPMD's fused reduction outruns the
+        # compression math) but real on DCN-crossing multi-slice DP.
+        # The mechanisms live in parallel.compression; the toggle here
+        # records the configuration for the explicit shard_map path
+        # (multislice_grad_sync below) — the implicit GSPMD step has no
+        # reduction site to rewrite, by design.
+        wanted = [t for t in ("dgc", "localsgd", "fp16_allreduce")
+                  if getattr(strategy, t, False)]
+        if wanted:
+            import warnings
+            _state.compression = wanted
+            warnings.warn(
+                f"DistributedStrategy {wanted}: applied only on the "
+                "explicit multi-slice path — call "
+                "fleet.multislice_grad_sync(grads, ...) (or "
+                "parallel.compression directly) inside shard_map over "
+                "the slice axis; the single-slice GSPMD reduction is "
+                "already fused+overlapped and is NOT rewritten.",
+                stacklevel=2)
         if getattr(strategy, "lars", False):
             from ...optimizer import Lars, Momentum
             if isinstance(optimizer, Momentum):
@@ -333,6 +347,48 @@ def distributed_optimizer(optimizer, strategy=None):
 
 
 # ------- worker-info surface (reference fleet.py worker_num etc.) -------
+def multislice_grad_sync(grads, axis_name: str = "slice",
+                         residuals=None, strategy=None):
+    """Cross-slice gradient reduction honoring the configured
+    compression strategy (reference meta_optimizers dgc/fp16_allreduce,
+    applied where they actually pay off: an explicit shard_map reduction
+    over a DCN-crossing 'slice' axis — see parallel.compression).
+
+    grads: pytree. Returns (synced_grads, residuals): residuals is the
+    DGC error-feedback state (zeros-like on first call, thread it
+    through every step); None when the strategy doesn't use DGC.
+    k_frac for DGC comes from strategy.dgc_configs['sparsity'] (the
+    reference's [0.999] spelling → keep 0.1%).
+    """
+    import jax as _jax
+    from ..compression import compressed_psum, dgc_psum
+    strategy = strategy or _state.strategy
+    tree = _jax.tree_util
+    if strategy is not None and getattr(strategy, "dgc", False):
+        cfgs = getattr(strategy, "dgc_configs", None) or {}
+        sparsity = cfgs.get("sparsity", [0.999])
+        sparsity = sparsity[0] if isinstance(
+            sparsity, (list, tuple)) else sparsity
+        k_frac = max(1e-6, 1.0 - float(sparsity))
+        if residuals is None:
+            residuals = tree.tree_map(
+                lambda g: _jax.numpy.zeros_like(g), grads)
+        pairs = tree.tree_map(
+            lambda g, r: dgc_psum(g, r, axis_name, k_frac=k_frac),
+            grads, residuals)
+        synced = tree.tree_map(lambda p: p[0], pairs,
+                               is_leaf=lambda x: isinstance(x, tuple))
+        new_res = tree.tree_map(lambda p: p[1], pairs,
+                                is_leaf=lambda x: isinstance(x, tuple))
+        return synced, new_res
+    if strategy is not None and getattr(strategy, "fp16_allreduce",
+                                        False):
+        return tree.tree_map(
+            lambda g: compressed_psum(g, axis_name), grads), None
+    return tree.tree_map(
+        lambda g: _jax.lax.psum(g, axis_name), grads), None
+
+
 def worker_num():
     return get_world_size()
 

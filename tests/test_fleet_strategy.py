@@ -3,8 +3,9 @@
 Reference behaviors matched: fleet meta-optimizers — strategy.sharding →
 ZeRO state sharding, strategy.amp → autocast forward, strategy.lamb →
 optimizer swap, strategy.gradient_merge → accumulation wrapper,
-strategy.asp → mask-preserving step; CUDA-only mechanisms (dgc/localsgd)
-raise instead of silently no-oping.
+strategy.asp → mask-preserving step; the gradient-compression trio
+(dgc/localsgd/fp16_allreduce) warns that it applies only on the explicit
+multi-slice path, whose mechanisms live in parallel/compression.py.
 """
 import numpy as np
 import pytest
@@ -36,13 +37,18 @@ class TestStrategyToggles:
                                       parameters=net.parameters()))
         assert isinstance(opt._inner_opt, Lamb)
 
-    def test_dgc_raises_not_silent(self):
+    def test_dgc_warns_and_points_at_compression(self):
+        """The compression trio no longer raises: the mechanisms exist
+        (parallel.compression) for the explicit multi-slice path, and
+        the toggle warns that the single-slice GSPMD reduction is not
+        rewritten."""
         fleet.init(is_collective=True, strategy=_strategy(dgc=True))
         net = _net()
-        with pytest.raises(NotImplementedError, match="dgc"):
-            fleet.distributed_optimizer(
+        with pytest.warns(UserWarning, match="multi-slice"):
+            opt = fleet.distributed_optimizer(
                 paddle.optimizer.Momentum(learning_rate=0.1,
                                           parameters=net.parameters()))
+        assert opt is not None
 
     def test_amp_autocasts_forward(self):
         fleet.init(is_collective=True, strategy=_strategy(amp=True))
