@@ -47,3 +47,18 @@ def estimate_cost(fn, *example_args):
     the functional entry the Program-less paths use."""
     from .profiler import cost_analysis
     return cost_analysis(fn, *example_args)
+
+
+def rank_parallel_plans(model, n_devices, global_batch, **kw):
+    """Rank hybrid-parallel assignments for a transformer spec — the
+    consumer the reference's cost model exists to feed
+    (auto_parallel/static/cost/base_cost.py pricing parallel_tuner.py
+    candidates). Delegates to parallel.planner's analytical model
+    (compute + collective volumes + pipeline bubble + HBM pruning);
+    `model` is a models.gpt.GPTConfig or parallel.planner.ModelSpec.
+    Returns plans sorted best-first."""
+    from .parallel.planner import enumerate_plans, spec_from_gpt_config
+    from .parallel.planner import ModelSpec
+    spec = model if isinstance(model, ModelSpec) \
+        else spec_from_gpt_config(model)
+    return enumerate_plans(spec, n_devices, global_batch, **kw)
