@@ -57,8 +57,5 @@ def rank_parallel_plans(model, n_devices, global_batch, **kw):
     (compute + collective volumes + pipeline bubble + HBM pruning);
     `model` is a models.gpt.GPTConfig or parallel.planner.ModelSpec.
     Returns plans sorted best-first."""
-    from .parallel.planner import enumerate_plans, spec_from_gpt_config
-    from .parallel.planner import ModelSpec
-    spec = model if isinstance(model, ModelSpec) \
-        else spec_from_gpt_config(model)
-    return enumerate_plans(spec, n_devices, global_batch, **kw)
+    from .parallel.planner import enumerate_plans
+    return enumerate_plans(model, n_devices, global_batch, **kw)

@@ -216,12 +216,22 @@ def _factorizations(n: int) -> List[tuple]:
     return out
 
 
-def enumerate_plans(spec: ModelSpec, n_devices: int, global_batch: int,
+def _coerce_spec(model) -> ModelSpec:
+    """ONE home for the ModelSpec-or-GPTConfig dispatch (plan_parallel,
+    enumerate_plans, and cost_model.rank_parallel_plans all take
+    either)."""
+    return model if isinstance(model, ModelSpec) \
+        else spec_from_gpt_config(model)
+
+
+def enumerate_plans(spec, n_devices: int, global_batch: int,
                     chip: Optional[ChipSpec] = None,
                     microbatches: Optional[int] = None,
                     max_mp: Optional[int] = None) -> List[Plan]:
     """All legal assignments, priced, sorted best-first (OOM plans sink
-    to the bottom, still priced so the caller can see why)."""
+    to the bottom, still priced so the caller can see why). `spec` is a
+    ModelSpec or a GPTConfig."""
+    spec = _coerce_spec(spec)
     chip = chip or ChipSpec()
     plans = []
     for dp, mp, pp, fsdp in _factorizations(n_devices):
@@ -248,8 +258,7 @@ def plan_parallel(cfg_or_spec, n_devices: int, global_batch: int,
                   chip: Optional[ChipSpec] = None, **kw) -> Plan:
     """The best assignment for a GPTConfig or ModelSpec (the reference
     parallel_tuner's `tune()` surface collapsed to a function)."""
-    spec = (cfg_or_spec if isinstance(cfg_or_spec, ModelSpec)
-            else spec_from_gpt_config(cfg_or_spec))
+    spec = _coerce_spec(cfg_or_spec)
     plans = enumerate_plans(spec, n_devices, global_batch, chip, **kw)
     if not plans:
         raise ValueError(
