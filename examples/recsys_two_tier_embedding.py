@@ -1,0 +1,67 @@
+"""Two-tier recsys embeddings: hot slots in a device-resident dense
+table, the unbounded long-tail in the host-resident sparse spill tier —
+the parameter-server workload mapped onto one TPU host
+(docs/ps_embedding_on_tpu.md; reference
+paddle/fluid/distributed/ps/table/memory_sparse_table.cc + the
+DownpourWorker pull/compute/push loop).
+
+Run: python examples/recsys_two_tier_embedding.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+if os.environ.get("PADDLE_TPU_EXAMPLE_BACKEND", "cpu") == "cpu":
+    from paddle_tpu.device import pin_cpu
+    assert pin_cpu(1), "could not pin the CPU backend"
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.incubate import HostShardedEmbedding
+from paddle_tpu.parallel.dist_tail import CountFilterEntry
+
+DIM, HOT_VOCAB, BATCH = 16, 1000, 64
+rng = np.random.default_rng(0)
+
+# hot tier: dense table in device memory (at scale: mesh-sharded
+# VocabParallelEmbedding); cold tier: host arena with admission — a
+# long-tail id must be seen twice before it earns a row
+hot = jnp.asarray(rng.normal(0, 0.05, (HOT_VOCAB, DIM)), jnp.float32)
+cold = HostShardedEmbedding(DIM, lr=0.1, optimizer="adagrad",
+                            entry=CountFilterEntry(2), seed=1)
+w = jnp.asarray(rng.normal(0, 0.1, (2 * DIM,)), jnp.float32)
+
+# CTR-ish batches: one hot slot + one long-tail slot per example
+hot_ids = rng.integers(0, HOT_VOCAB, (BATCH,))
+tail_ids = rng.integers(1_000_000_000, 1_000_000_200, (BATCH,))
+clicks = jnp.asarray(rng.integers(0, 2, (BATCH,)), jnp.float32)
+
+
+def loss_fn(hot_tab, cold_rows, w):
+    feat = jnp.concatenate([hot_tab[hot_ids], cold_rows], -1)
+    logits = feat @ w
+    # numerically-stable BCE-with-logits
+    return jnp.mean(jnp.maximum(logits, 0) - logits * clicks
+                    + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+
+
+grad_fn = jax.value_and_grad(loss_fn, argnums=(0, 1, 2))
+for step in range(20):
+    rows = cold.pull(tail_ids)               # PS "pull"
+    loss, (g_hot, g_cold, g_w) = grad_fn(hot, rows, w)
+    hot = hot - 0.1 * g_hot                  # dense tier: device update
+    w = w - 0.1 * g_w
+    cold.push(tail_ids, np.asarray(g_cold))  # PS "push" (host rule)
+    if step % 5 == 0:
+        print(f"step {step}: loss {float(loss):.4f}, "
+              f"{len(cold)} tail rows admitted")
+
+print(f"final loss {float(loss):.4f}; cold tier holds {len(cold)} rows "
+      f"of an unbounded id space")
+state = cold.state_dict()
+print(f"checkpointable: {state['ids'].shape[0]} rows, "
+      f"dim {state['dim']}")

@@ -117,8 +117,10 @@ class Plan:
         keys = f"dp{self.dp}_mp{self.mp}_pp{self.pp}_fsdp{self.fsdp}"
         if self.pp > 1:
             keys += f"_mb{self.microbatches}"
-        return (f"Plan({keys}, est {self.step_s * 1e3:.1f} ms, "
-                f"mem {self.mem_bytes / 1e9:.1f} GB"
+        ms = self.step_s * 1e3
+        gb = self.mem_bytes / 1e9
+        return (f"Plan({keys}, est {ms:.{3 if ms < 1 else 1}f} ms, "
+                f"mem {gb:.{2 if gb < 1 else 1}f} GB"
                 + ("" if self.fits else ", OOM") + ")")
 
 
