@@ -24,7 +24,8 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
 __all__ = ["ChipSpec", "ModelSpec", "Plan", "enumerate_plans",
-           "plan_parallel", "spec_from_gpt_config", "best_mesh_axes"]
+           "plan_parallel", "spec_from_config", "spec_from_gpt_config",
+           "best_mesh_axes"]
 
 
 @dataclass(frozen=True)
@@ -67,14 +68,39 @@ class ModelSpec:
         return self.block_params + self.embed_params
 
 
-def spec_from_gpt_config(cfg) -> ModelSpec:
-    """Build a ModelSpec from models.gpt.GPTConfig."""
+def spec_from_config(cfg) -> ModelSpec:
+    """Build a ModelSpec from a single-tower model config
+    (models.gpt.GPTConfig, models.bert.BertConfig, models.vit.ViTConfig):
+    the transformer fields are duck-typed; ViT-style configs derive the
+    sequence length from the patch grid. Composite dual-tower configs
+    (ErnieViLConfig) don't fit one transformer spec — plan a tower
+    explicitly (spec_from_config(cfg.text) / (cfg.vision))."""
+    if hasattr(cfg, "text") and hasattr(cfg, "vision"):
+        raise ValueError(
+            f"{type(cfg).__name__} is a dual-tower composite; plan one "
+            "tower at a time: spec_from_config(cfg.text) or "
+            "spec_from_config(cfg.vision)")
+    seq = getattr(cfg, "max_seq_len", None)
+    if seq is None and hasattr(cfg, "num_patches"):
+        seq = cfg.num_patches + 1                          # + [CLS]
+    elif seq is None and hasattr(cfg, "image_size") and hasattr(
+            cfg, "patch_size"):
+        seq = (cfg.image_size // cfg.patch_size) ** 2 + 1
+    if seq is None:
+        raise ValueError(
+            f"{type(cfg).__name__} has neither max_seq_len nor an "
+            "image/patch geometry to derive a sequence length from")
     return ModelSpec(
         num_layers=cfg.num_layers, hidden_size=cfg.hidden_size,
         num_heads=cfg.num_heads, ffn_hidden=cfg.ffn_hidden,
-        vocab_size=cfg.vocab_size, seq_len=cfg.max_seq_len,
-        remat_policy=cfg.remat_policy if cfg.remat else "none",
-        sequence_parallel=cfg.sequence_parallel)
+        vocab_size=getattr(cfg, "vocab_size", 0), seq_len=seq,
+        remat_policy=(getattr(cfg, "remat_policy", "full")
+                      if getattr(cfg, "remat", False) else "none"),
+        sequence_parallel=getattr(cfg, "sequence_parallel", False))
+
+
+# historical name (round-5 introduced the planner GPT-first)
+spec_from_gpt_config = spec_from_config
 
 
 # How many residual-sized buffers per layer survive the forward, by remat
@@ -219,11 +245,11 @@ def _factorizations(n: int) -> List[tuple]:
 
 
 def _coerce_spec(model) -> ModelSpec:
-    """ONE home for the ModelSpec-or-GPTConfig dispatch (plan_parallel,
-    enumerate_plans, and cost_model.rank_parallel_plans all take
-    either)."""
+    """ONE home for the ModelSpec-or-model-config dispatch
+    (plan_parallel, enumerate_plans, and cost_model.rank_parallel_plans
+    all take either)."""
     return model if isinstance(model, ModelSpec) \
-        else spec_from_gpt_config(model)
+        else spec_from_config(model)
 
 
 def enumerate_plans(spec, n_devices: int, global_batch: int,

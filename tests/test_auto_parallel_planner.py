@@ -230,3 +230,43 @@ class TestPredictedVsMeasured:
         assert ok(measured), (measured, predicted)
         # (2) the bubble config prices behind pure DP
         assert predicted["pp2mb2"] > predicted["dp8"]
+
+
+class TestAllModelFamilyConfigs:
+    """spec_from_config duck-types the single-tower family configs
+    (GPT/BERT/ViT); the ERNIE-ViL composite is rejected with per-tower
+    guidance."""
+
+    def test_bert_config(self):
+        from paddle_tpu.models.bert import BertConfig
+        from paddle_tpu.parallel.planner import spec_from_config
+        spec = spec_from_config(BertConfig())
+        assert spec.seq_len == 512 and spec.vocab_size == 30522
+        best = plan_parallel(BertConfig(), 8, 32)
+        assert best.fits
+
+    def test_vit_config_derives_seq_from_patches(self):
+        from paddle_tpu.models.vit import ViTConfig
+        from paddle_tpu.parallel.planner import spec_from_config
+        spec = spec_from_config(ViTConfig())
+        assert spec.seq_len == (224 // 16) ** 2 + 1
+        best = plan_parallel(ViTConfig(), 8, 64)
+        assert best.fits
+
+    def test_unplannable_config_rejected(self):
+        from paddle_tpu.parallel.planner import spec_from_config
+
+        class Odd:
+            num_layers, hidden_size, num_heads, ffn_hidden = 2, 8, 2, 32
+        with pytest.raises(ValueError, match="sequence length"):
+            spec_from_config(Odd())
+
+    def test_ernie_vil_composite_plans_per_tower(self):
+        from paddle_tpu.models.ernie_vil import ErnieViLConfig
+        from paddle_tpu.parallel.planner import spec_from_config
+        cfg = ErnieViLConfig()
+        with pytest.raises(ValueError, match="tower"):
+            spec_from_config(cfg)
+        # each tower plans fine
+        assert plan_parallel(cfg.text, 8, 32).fits
+        assert plan_parallel(cfg.vision, 8, 64).fits
