@@ -212,17 +212,26 @@ class ChainTransform(Transform):
             y = t._inverse(y)
         return y
 
+    def _rank_deltas(self):
+        """(domain_rank, codomain_rank) per stage — the chain's rank
+        bookkeeping derives from these prefix lifts."""
+        return [(t._domain.event_rank, t._codomain.event_rank)
+                for t in self.transforms]
+
     def _forward_log_det_jacobian(self, x):
-        value = 0.0
-        event_rank = self._domain.event_rank
-        for t in self.transforms:
-            value = value + _sum_rightmost(
-                t._call_forward_ldj(x),
-                event_rank - t._domain.event_rank)
+        # per-stage extra reduction = entry rank minus the stage's own
+        # event rank; precomputed from the prefix lifts so the value
+        # loop stays a plain accumulate
+        rank = self._domain.event_rank
+        extra = []
+        for d, c in self._rank_deltas():
+            extra.append(rank - d)
+            rank += c - d
+        total = 0.0
+        for t, n in zip(self.transforms, extra):
+            total = total + _sum_rightmost(t._call_forward_ldj(x), n)
             x = t._forward(x)
-            event_rank += (t._codomain.event_rank
-                           - t._domain.event_rank)
-        return value
+        return total
 
     def _forward_shape(self, shape):
         for t in self.transforms:
@@ -234,27 +243,30 @@ class ChainTransform(Transform):
             shape = t.inverse_shape(shape)
         return shape
 
+    # The chain's input rank is the smallest r such that, as each
+    # stage's rank delta lifts r along the chain, every stage still
+    # receives at least its own domain rank: r = max_i(d_i - lift_i).
+    # The output rank is the mirror bound (equivalent to the backward
+    # sweep torch/paddle use; equality brute-checked over random chains).
     @property
     def _domain(self):
-        # the reference's dynamic-programming lower bound on the input
-        # event rank (transform.py:560)
-        domain = self.transforms[0]._domain
-        event_rank = self.transforms[-1]._codomain.event_rank
-        for t in reversed(self.transforms):
-            event_rank -= t._codomain.event_rank - t._domain.event_rank
-            event_rank = max(event_rank, t._domain.event_rank)
-        return variable.Independent(domain,
-                                    event_rank - domain.event_rank)
+        need, lift = 0, 0
+        for d, c in self._rank_deltas():
+            need = max(need, d - lift)
+            lift += c - d
+        base = self.transforms[0]._domain
+        return variable.Independent(base, need - base.event_rank)
 
     @property
     def _codomain(self):
-        codomain = self.transforms[-1]._codomain
-        event_rank = self.transforms[0]._domain.event_rank
-        for t in self.transforms:
-            event_rank += t._codomain.event_rank - t._domain.event_rank
-            event_rank = max(event_rank, t._codomain.event_rank)
-        return variable.Independent(codomain,
-                                    event_rank - codomain.event_rank)
+        deltas = self._rank_deltas()
+        total = sum(c - d for d, c in deltas)
+        out, lift = 0, 0
+        for d, c in deltas:
+            lift += c - d
+            out = max(out, c + total - lift)
+        base = self.transforms[-1]._codomain
+        return variable.Independent(base, out - base.event_rank)
 
 
 class ExpTransform(Transform):

@@ -110,18 +110,21 @@ class TransformedDistribution(Distribution):
         return x
 
     def log_prob(self, value):
-        log_prob = 0.0
-        y = _v(value)
-        event_rank = len(self.event_shape)
+        # precompute each stage's entry rank by walking the rank lifts
+        # backward from the output event rank; the pullback loop then
+        # just accumulates -log|det J| with its per-stage reduction
+        rank = len(self.event_shape)
+        reduces = []
         for t in reversed(self._transforms):
+            rank += t._domain.event_rank - t._codomain.event_rank
+            reduces.append(rank - t._domain.event_rank)
+        total = 0.0
+        y = _v(value)
+        for t, n in zip(reversed(self._transforms), reduces):
             x = t._inverse(y)
-            event_rank += (t._domain.event_rank
-                           - t._codomain.event_rank)
-            log_prob = log_prob - _sum_rightmost(
-                t._call_forward_ldj(x),
-                event_rank - t._domain.event_rank)
+            total = total - _sum_rightmost(t._call_forward_ldj(x), n)
             y = x
-        log_prob = log_prob + _sum_rightmost(
+        total = total + _sum_rightmost(
             _v(self._base.log_prob(_t(y))),
-            event_rank - len(self._base.event_shape))
-        return _t(jnp.asarray(log_prob))
+            rank - len(self._base.event_shape))
+        return _t(jnp.asarray(total))

@@ -73,12 +73,10 @@ class Stack(Variable):
 
     @property
     def event_rank(self):
-        # reference variable.py:95: a negative stack axis that falls
-        # inside the event block extends the event rank by one
-        rank = max(v.event_rank for v in self._vars)
-        if self._axis + rank < 0:
-            rank += 1
-        return rank
+        inner = max(v.event_rank for v in self._vars)
+        # a negative stack axis landing inside the per-slice event block
+        # makes the stacked axis itself part of the event
+        return inner + (1 if self._axis < -inner else 0)
 
     def constraint(self, value):
         if not (-value.ndim <= self._axis < value.ndim):
