@@ -43,7 +43,7 @@ def run_row(row: str) -> None:
     # shared with bench.py so the two measurement paths can't drift
     # (applies BEFORE any jax trace so env gates read the right values);
     # --run is also how tpu_campaign invokes single rows
-    from bench import apply_perf_env_defaults
+    from bench import apply_perf_env_defaults, sync_compile_cache_for
     apply_perf_env_defaults()
     import jax
     import jax.numpy as jnp
@@ -51,6 +51,8 @@ def run_row(row: str) -> None:
     import numpy as np
     devs = jax.devices()
     platform = devs[0].platform
+    # TPU-only compile cache: undo the env-inherited dir on CPU runs
+    sync_compile_cache_for(platform)
 
     if row == "mnist":
         # BASELINE config 1: MNIST MLP train step (784-512-512-10)

@@ -121,6 +121,14 @@ def run_job(name, argv, timeout_s, env_extra, window_dir) -> dict:
     # jobs stamp their artifacts (e.g. perf/autotune.json provenance)
     # with the window they were measured in
     env["PADDLE_TPU_WINDOW"] = os.path.basename(window_dir)
+    # share one persistent XLA compile cache across jobs and windows —
+    # remote compiles over the tunnel cost minutes; paying them once per
+    # graph (not once per job process) stretches every window. Path
+    # comes from bench.xla_cache_dir (ONE home); jobs that resolve to
+    # CPU disable it again via bench.sync_compile_cache_for
+    sys.path.insert(0, HERE)
+    from bench import xla_cache_dir
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", xla_cache_dir())
     t0 = time.time()
     with open(out_path, "wb") as fo, open(err_path, "wb") as fe, \
             open(BUSY_PATH, "w") as fb:
