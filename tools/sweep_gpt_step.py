@@ -48,6 +48,11 @@ VARIANTS = [
     # homegrown kernel measured ~6 TF/s effective in the ablation)
     ("allbutmlp-splash-b8", True, "all_but_mlp", (512, 256, 128, 128),
      {"PADDLE_TPU_ATTN_IMPL": "splash"}),
+    # cheapest remat x the attention impl the window-1 ablation crowned
+    # (xla 399.7 ms vs 427+ for every pallas fwd) — the most likely
+    # winner cross, so it races near the front
+    ("allbutmlp-xlaattn-b8", True, "all_but_mlp", (512, 256, 128, 128),
+     XLA_ATTN),
     ("allbutmlp-b8", True, "all_but_mlp", (512, 256, 128, 128), JAXBWD),
     ("splash-dotsflash-b8", True, "dots_flash", (512, 256, 128, 128),
      {"PADDLE_TPU_ATTN_IMPL": "splash"}),
