@@ -2,6 +2,8 @@
 
 - gpt: causal-LM flagship (TP/PP/DP/SP/EP hybrid parallel, flash
   attention, KV-cache decode) — BASELINE config 3.
+- llama: modern decoder (RMSNorm + RoPE + GQA + SwiGLU) on the same
+  stacked-scan core and sharding rules.
 - bert: bidirectional encoder (MLM + classification) — config 2.
 - vit / ernie_vil: image encoder + contrastive dual-encoder — config 5.
 - losses: shared fused kernels (fused_softmax_ce).
@@ -17,6 +19,7 @@ from . import ernie_vil  # noqa: F401
 from . import losses  # noqa: F401
 from .facade import FacadeModel  # noqa: F401
 from .gpt import GPTModel, GPTConfig, GPT3_CONFIGS  # noqa: F401
+from .llama import LlamaModel, LlamaConfig  # noqa: F401
 from .bert import BertConfig, BERT_CONFIGS  # noqa: F401
 from .vit import ViTConfig, VIT_CONFIGS  # noqa: F401
 from .ernie_vil import ErnieViLConfig  # noqa: F401

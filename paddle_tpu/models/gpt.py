@@ -488,10 +488,11 @@ def init_opt_state(params):
     }
 
 
-def train_step(params, opt_state, batch, cfg: GPTConfig, lr=3e-4,
-               beta1=0.9, beta2=0.95, eps=1e-8, weight_decay=0.1):
-    loss, grads = jax.value_and_grad(
-        lambda p: gpt_loss(p, batch, cfg))(params)
+def apply_adamw(grads, params, opt_state, lr, beta1=0.9, beta2=0.95,
+                eps=1e-8, weight_decay=0.1):
+    """One fused AdamW update over the param tree (f32 master math,
+    params cast back to their storage dtype). Shared by every flagship
+    family's train_step (gpt, llama) so the update rule cannot drift."""
     step = opt_state["step"] + 1.0
     bc1 = 1.0 - beta1 ** step
     bc2 = 1.0 - beta2 ** step
@@ -514,7 +515,17 @@ def train_step(params, opt_state, batch, cfg: GPTConfig, lr=3e-4,
     new_params = jax.tree_util.tree_unflatten(treedef, [n[0] for n in new])
     new_m = jax.tree_util.tree_unflatten(treedef, [n[1] for n in new])
     new_v = jax.tree_util.tree_unflatten(treedef, [n[2] for n in new])
-    return loss, new_params, {"m": new_m, "v": new_v, "step": step}
+    return new_params, {"m": new_m, "v": new_v, "step": step}
+
+
+def train_step(params, opt_state, batch, cfg: GPTConfig, lr=3e-4,
+               beta1=0.9, beta2=0.95, eps=1e-8, weight_decay=0.1):
+    loss, grads = jax.value_and_grad(
+        lambda p: gpt_loss(p, batch, cfg))(params)
+    new_params, new_opt = apply_adamw(
+        grads, params, opt_state, lr, beta1=beta1, beta2=beta2, eps=eps,
+        weight_decay=weight_decay)
+    return loss, new_params, new_opt
 
 
 # --------------------------------------------------------------------------

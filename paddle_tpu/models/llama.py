@@ -1,0 +1,229 @@
+"""Llama-family decoder: RMSNorm + RoPE + GQA + SwiGLU on the
+stacked-scan functional core.
+
+The reference snapshot predates this family (its llm/ zoo arrived
+later); it is included because a modern framework's flagship decoder is
+table stakes, and every building block here is the shared machinery:
+stacked per-layer params scanned with lax.scan (models/gpt.py design),
+PARAM_SPECS declarative sharding over (dp, fsdp, pp, mp), the selectable
+flash-attention kernels (paddle_tpu.kernels), the fused CE head
+(models/losses.py), and the same fused AdamW step shape. Reference
+analogs for the pieces: rotary embeddings mirror
+incubate/fused_multi_transformer's RotaryKernel semantics; the fused CE
+head matches phi/kernels/gpu/cross_entropy_kernel.cu's trade.
+
+Grouped-query attention: num_kv_heads < num_heads shares each KV head
+across num_heads // num_kv_heads query heads (the KV projections and
+cache shrink by that factor — the modern decode-bandwidth trade).
+
+Reference analogs, checkable: rotary semantics as
+paddle/fluid/operators/fused/fused_multi_transformer_op.cu:29 (the
+RotaryKernel) via incubate/fused_multi_transformer.py:243; fused CE head
+as paddle/phi/kernels/gpu/cross_entropy_kernel.cu:1 via
+models/losses.py; sharding rules as models/gpt.py:105 PARAM_SPECS.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import constraint as mesh_constraint
+from .facade import FacadeModel
+
+__all__ = ["LlamaConfig", "PARAM_SPECS", "init_llama_params",
+           "llama_forward", "llama_loss", "train_step", "LlamaModel"]
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    num_kv_heads: Optional[int] = None        # None -> MHA
+    ffn_hidden: Optional[int] = None          # None -> 8/3 * D, mult of 256
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True                        # checkpoint each block
+
+    def __post_init__(self):
+        if self.num_kv_heads is None:
+            self.num_kv_heads = self.num_heads
+        if self.ffn_hidden is None:
+            self.ffn_hidden = ((8 * self.hidden_size // 3 + 255)
+                               // 256) * 256
+        assert self.hidden_size % self.num_heads == 0
+        assert self.num_heads % self.num_kv_heads == 0
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+
+# leaf name -> PartitionSpec over (dp, fsdp, pp, mp); stacked block
+# params carry the leading layer axis on 'pp' (same rules as
+# models/gpt.py PARAM_SPECS: column-parallel up/qkv, row-parallel down/o)
+PARAM_SPECS: Dict[str, P] = {
+    "wte":          P("mp", "fsdp"),
+    "norm_f":       P(None),
+    "attn_norm":    P("pp", None),
+    "q_w":          P("pp", "fsdp", "mp"),
+    "k_w":          P("pp", "fsdp", "mp"),
+    "v_w":          P("pp", "fsdp", "mp"),
+    "o_w":          P("pp", "mp", "fsdp"),
+    "ffn_norm":     P("pp", None),
+    "gate_w":       P("pp", "fsdp", "mp"),
+    "up_w":         P("pp", "fsdp", "mp"),
+    "down_w":       P("pp", "mp", "fsdp"),
+}
+
+_BLOCK_KEYS = ("attn_norm", "q_w", "k_w", "v_w", "o_w",
+               "ffn_norm", "gate_w", "up_w", "down_w")
+
+
+def init_llama_params(cfg: LlamaConfig, key) -> Dict[str, jax.Array]:
+    D, F, L = cfg.hidden_size, cfg.ffn_hidden, cfg.num_layers
+    H, KV, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    ks = jax.random.split(key, 8)
+    pd = cfg.param_dtype
+
+    def norm(k, shape, scale):
+        return (jax.random.normal(k, shape) * scale).astype(pd)
+
+    return {
+        "wte": norm(ks[0], (cfg.vocab_size, D), 0.02),
+        "norm_f": jnp.ones((D,), pd),
+        "attn_norm": jnp.ones((L, D), pd),
+        "q_w": norm(ks[1], (L, D, H * hd), 0.02),
+        "k_w": norm(ks[2], (L, D, KV * hd), 0.02),
+        "v_w": norm(ks[3], (L, D, KV * hd), 0.02),
+        "o_w": norm(ks[4], (L, H * hd, D), 0.02 / math.sqrt(2 * L)),
+        "ffn_norm": jnp.ones((L, D), pd),
+        "gate_w": norm(ks[5], (L, D, F), 0.02),
+        "up_w": norm(ks[6], (L, D, F), 0.02),
+        "down_w": norm(ks[7], (L, F, D), 0.02 / math.sqrt(2 * L)),
+    }
+
+
+def _rmsnorm(x, scale, eps):
+    xf = x.astype(jnp.float32)
+    r = jax.lax.rsqrt(jnp.mean(jnp.square(xf), -1, keepdims=True) + eps)
+    return (xf * r * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+def _rope_tables(seq: int, hd: int, theta: float):
+    """(cos, sin) [S, hd/2] f32 — the half-dim frequency ladder."""
+    inv = 1.0 / (theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
+    ang = jnp.arange(seq, dtype=jnp.float32)[:, None] * inv[None, :]
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def _apply_rope(x, cos, sin):
+    """x [B, S, H, hd]; rotate interleaved pairs by the position angle."""
+    B, S, H, hd = x.shape
+    xf = x.astype(jnp.float32).reshape(B, S, H, hd // 2, 2)
+    x1, x2 = xf[..., 0], xf[..., 1]
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    rot = jnp.stack([x1 * c - x2 * s, x1 * s + x2 * c], -1)
+    return rot.reshape(B, S, H, hd).astype(x.dtype)
+
+
+def _data_constraint(x):
+    return mesh_constraint(x, P(("dp", "fsdp"), None, None))
+
+
+def _block(lp, x, cfg: LlamaConfig, cos, sin):
+    B, S, D = x.shape
+    H, KV, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+
+    h = _rmsnorm(x, lp["attn_norm"], cfg.rms_eps)
+    q = (h @ lp["q_w"].astype(h.dtype)).reshape(B, S, H, hd)
+    k = (h @ lp["k_w"].astype(h.dtype)).reshape(B, S, KV, hd)
+    v = (h @ lp["v_w"].astype(h.dtype)).reshape(B, S, KV, hd)
+    q = _apply_rope(q, cos, sin)
+    k = _apply_rope(k, cos, sin)
+    if KV != H:
+        # GQA: each KV head serves H//KV query heads
+        k = jnp.repeat(k, H // KV, axis=2)
+        v = jnp.repeat(v, H // KV, axis=2)
+    from ..kernels.flash_attention import flash_attention_fn
+    ctx = flash_attention_fn(q, k, v, causal=True)
+    x = x + (ctx.reshape(B, S, H * hd)
+             @ lp["o_w"].astype(x.dtype))
+
+    h = _rmsnorm(x, lp["ffn_norm"], cfg.rms_eps)
+    gated = jax.nn.silu(h @ lp["gate_w"].astype(h.dtype)) * (
+        h @ lp["up_w"].astype(h.dtype))
+    x = x + gated @ lp["down_w"].astype(x.dtype)
+    return _data_constraint(x)
+
+
+def llama_forward(params, tokens, cfg: LlamaConfig):
+    """tokens [B, S] int32 -> logits [B, S, V] in cfg.dtype."""
+    B, S = tokens.shape
+    x = jnp.take(params["wte"], tokens, axis=0).astype(cfg.dtype)
+    x = _data_constraint(x)
+    cos, sin = _rope_tables(S, cfg.head_dim, cfg.rope_theta)
+
+    stacked = {k: params[k] for k in _BLOCK_KEYS}
+    body = functools.partial(_block, cfg=cfg, cos=cos, sin=sin)
+    if cfg.remat:
+        body = jax.checkpoint(body)
+
+    def step(h, lp):
+        return body(lp, h), None
+
+    x, _ = jax.lax.scan(step, x, stacked)
+    x = _rmsnorm(x, params["norm_f"], cfg.rms_eps)
+    logits = jnp.einsum("bsd,vd->bsv", x, params["wte"].astype(x.dtype))
+    return mesh_constraint(logits, P(("dp", "fsdp"), None, "mp"))
+
+
+def llama_loss(params, batch, cfg: LlamaConfig):
+    """Causal LM loss over tokens [B, S+1] (input = [:, :-1],
+    target = [:, 1:]); the fused CE head streams the logits once."""
+    from .losses import fused_softmax_ce
+    tokens = batch["tokens"] if isinstance(batch, dict) else batch
+    inp, tgt = tokens[:, :-1], tokens[:, 1:]
+    return fused_softmax_ce(llama_forward(params, inp, cfg), tgt)
+
+
+def train_step(params, opt_state, batch, cfg: LlamaConfig, lr=3e-4,
+               **adamw_kw):
+    """Fused fwd + bwd + AdamW, sharing the GPT step's update rule
+    (gpt.apply_adamw) so the two flagships cannot drift."""
+    from .gpt import apply_adamw
+    loss, grads = jax.value_and_grad(
+        lambda p: llama_loss(p, batch, cfg))(params)
+    new_params, new_opt = apply_adamw(grads, params, opt_state, lr,
+                                      **adamw_kw)
+    return loss, new_params, new_opt
+
+
+class LlamaModel(FacadeModel):
+    """Paddle-shaped facade over the functional core (parameters /
+    state_dict / tape-recorded forward as ONE differentiable op)."""
+
+    _fwd_op_name = "llama_forward"
+
+    def __init__(self, cfg: LlamaConfig, seed: int = 0):
+        super().__init__(cfg, init_llama_params, PARAM_SPECS, seed)
+
+    def forward(self, tokens):
+        cfg = self.cfg
+        return self._dispatch(
+            self._fwd_op_name,
+            lambda params, toks: llama_forward(params, toks, cfg),
+            tokens)
+
+    __call__ = forward
