@@ -1,0 +1,48 @@
+"""Shared greedy-decode driver for the cached model families
+(reference: the inference decoder loops of
+incubate/nn/layer/fused_transformer.py:1022 and the hapi/predictor
+generate paths). One implementation parameterized by the family's
+`forward_cached(params, tokens, cache, pos, cfg)` — the same
+anti-drift extraction as gpt.apply_adamw: gpt and llama must not carry
+diverging copies of the prefill/scan/concat plumbing."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def greedy_generate_with(forward_cached, init_cache, params, prompt,
+                         cfg, max_new_tokens: int, max_len=None):
+    """Greedy decode: prefill the prompt once, then scan single-token
+    steps through the cache. prompt [B, T0] -> [B, T0+max_new_tokens]."""
+    B, T0 = prompt.shape
+    if max_new_tokens < 0:
+        raise ValueError(f"max_new_tokens must be >= 0; "
+                         f"got {max_new_tokens}")
+    if max_new_tokens == 0:
+        return prompt
+    max_len = max_len or min(cfg.max_seq_len, T0 + max_new_tokens)
+    if T0 + max_new_tokens > max_len:
+        raise ValueError(
+            f"prompt ({T0}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"max_len ({max_len}): the cache/position slices would "
+            "clamp and silently corrupt the tail")
+    cache = init_cache(cfg, B, max_len)
+    logits, cache = forward_cached(params, prompt, cache, 0, cfg)
+    next_tok = jnp.argmax(logits[:, -1].astype(jnp.float32), axis=-1)
+
+    def step(carry, i):
+        tok, cache = carry
+        lg, cache = forward_cached(params, tok[:, None], cache,
+                                   T0 + i, cfg)
+        nxt = jnp.argmax(lg[:, -1].astype(jnp.float32), axis=-1)
+        return (nxt, cache), tok
+
+    # N-1 decode steps: ys collects gen tokens 1..N-1, the final carry
+    # is gen token N (no wasted extra forward)
+    (last, _), toks = jax.lax.scan(
+        step, (next_tok, cache), jnp.arange(max_new_tokens - 1))
+    gen = jnp.concatenate(
+        [jnp.moveaxis(toks, 0, 1).astype(prompt.dtype),
+         last[:, None].astype(prompt.dtype)], 1)
+    return jnp.concatenate([prompt, gen], axis=1)

@@ -668,31 +668,9 @@ def gpt_forward_cached(params, tokens, cache, pos, cfg: GPTConfig):
 
 def greedy_generate(params, prompt, cfg: GPTConfig, max_new_tokens: int,
                     max_len: Optional[int] = None):
-    """Greedy decode: prefill the prompt once, then scan single-token steps
-    through the cache. prompt [B, T0] → [B, T0 + max_new_tokens]."""
-    B, T0 = prompt.shape
-    max_len = max_len or min(cfg.max_seq_len, T0 + max_new_tokens)
-    if T0 + max_new_tokens > max_len:
-        raise ValueError(
-            f"prompt ({T0}) + max_new_tokens ({max_new_tokens}) exceeds "
-            f"max_len ({max_len}): the cache/wpe slices would clamp and "
-            f"silently corrupt the tail")
-    cache = init_kv_cache(cfg, B, max_len)
-    logits, cache = gpt_forward_cached(params, prompt, cache, 0, cfg)
-    next_tok = jnp.argmax(logits[:, -1].astype(jnp.float32), axis=-1)
-
-    def step(carry, i):
-        tok, cache = carry
-        lg, cache = gpt_forward_cached(params, tok[:, None], cache,
-                                       T0 + i, cfg)
-        nxt = jnp.argmax(lg[:, -1].astype(jnp.float32), axis=-1)
-        return (nxt, cache), tok
-
-    # N-1 decode steps: ys collects gen tokens 1..N-1, the final carry is
-    # gen token N (no wasted extra forward)
-    (last, _), toks = jax.lax.scan(
-        step, (next_tok, cache), jnp.arange(max_new_tokens - 1))
-    gen = jnp.concatenate(
-        [jnp.moveaxis(toks, 0, 1).astype(prompt.dtype),
-         last[:, None].astype(prompt.dtype)], 1)
-    return jnp.concatenate([prompt, gen], axis=1)
+    """Greedy decode through the KV cache (shared driver:
+    models/decode.py). prompt [B, T0] → [B, T0 + max_new_tokens]."""
+    from .decode import greedy_generate_with
+    return greedy_generate_with(gpt_forward_cached, init_kv_cache,
+                                params, prompt, cfg, max_new_tokens,
+                                max_len)

@@ -210,6 +210,84 @@ def train_step(params, opt_state, batch, cfg: LlamaConfig, lr=3e-4,
     return loss, new_params, new_opt
 
 
+# --------------------------------------------------------------------------
+# KV-cache decode (same design as models/gpt.py:575 — stacked [L, ...]
+# cache scanned with the stacked params; dense masked attention over the
+# cache at decode). The GQA payoff lands here: the cache holds KV heads,
+# not query heads, shrinking HBM traffic per decoded token by H/KV.
+# --------------------------------------------------------------------------
+def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int):
+    """-> {"k","v": [L, B, max_len, KV, hd]} in the activation dtype."""
+    shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads,
+             cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def llama_forward_cached(params, tokens, cache, pos, cfg: LlamaConfig):
+    """Forward tokens [B,T] against a cache holding `pos` tokens ->
+    (logits [B,T,V], updated cache). Prefill (pos=0) and decode (T=1)
+    share the graph; RoPE is applied at the absolute positions."""
+    B, T = tokens.shape
+    H, KV, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    x = jnp.take(params["wte"], tokens, axis=0).astype(cfg.dtype)
+    cos_full, sin_full = _rope_tables(cache["k"].shape[2], hd,
+                                      cfg.rope_theta)
+    cos = jax.lax.dynamic_slice_in_dim(cos_full, pos, T, axis=0)
+    sin = jax.lax.dynamic_slice_in_dim(sin_full, pos, T, axis=0)
+
+    stacked = {k: params[k] for k in _BLOCK_KEYS}
+
+    def scan_fn(x, layer_in):
+        lp, kc, vc = layer_in
+        h = _rmsnorm(x, lp["attn_norm"], cfg.rms_eps)
+        q = (h @ lp["q_w"].astype(h.dtype)).reshape(B, T, H, hd)
+        k = (h @ lp["k_w"].astype(h.dtype)).reshape(B, T, KV, hd)
+        v = (h @ lp["v_w"].astype(h.dtype)).reshape(B, T, KV, hd)
+        q = _apply_rope(q, cos, sin)
+        k = _apply_rope(k, cos, sin)
+        kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype),
+                                          (0, pos, 0, 0))
+        vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype),
+                                          (0, pos, 0, 0))
+        # grouped dense attention over the cache: fold the group axis
+        # into the batch of the einsum, never materializing repeated KV
+        scale = 1.0 / math.sqrt(hd)
+        G = H // KV
+        qf = q.reshape(B, T, KV, G, hd).astype(jnp.float32) * scale
+        kf = kc.astype(jnp.float32)                       # B,S,KV,hd
+        s = jnp.einsum("btkgd,bskd->bkgts", qf, kf)
+        kvpos = jnp.arange(kc.shape[1])[None, :]
+        qpos = pos + jnp.arange(T)[:, None]
+        s = jnp.where(kvpos <= qpos, s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        ctx = jnp.einsum("bkgts,bskd->btkgd", p,
+                         vc.astype(jnp.float32))
+        ctx = ctx.reshape(B, T, H * hd).astype(x.dtype)
+        x = x + ctx @ lp["o_w"].astype(x.dtype)
+        h = _rmsnorm(x, lp["ffn_norm"], cfg.rms_eps)
+        gated = jax.nn.silu(h @ lp["gate_w"].astype(h.dtype)) * (
+            h @ lp["up_w"].astype(h.dtype))
+        return x + gated @ lp["down_w"].astype(x.dtype), (kc, vc)
+
+    x, (kcs, vcs) = jax.lax.scan(scan_fn, x,
+                                 (stacked, cache["k"], cache["v"]))
+    x = _rmsnorm(x, params["norm_f"], cfg.rms_eps)
+    logits = jnp.einsum("bsd,vd->bsv", x, params["wte"].astype(x.dtype))
+    return logits, {"k": kcs, "v": vcs}
+
+
+def greedy_generate(params, prompt, cfg: LlamaConfig,
+                    max_new_tokens: int,
+                    max_len: Optional[int] = None):
+    """Greedy decode through the grouped KV cache (shared driver:
+    models/decode.py). prompt [B, T0] -> [B, T0 + max_new_tokens]."""
+    from .decode import greedy_generate_with
+    return greedy_generate_with(llama_forward_cached, init_kv_cache,
+                                params, prompt, cfg, max_new_tokens,
+                                max_len)
+
+
 class LlamaModel(FacadeModel):
     """Paddle-shaped facade over the functional core (parameters /
     state_dict / tape-recorded forward as ONE differentiable op)."""

@@ -46,6 +46,7 @@ SMOKE_FILES = {
     "test_dy2static.py",
     # models + kernels (smallest end-to-end slices)
     "test_e2e_mnist.py", "test_kernels.py", "test_kernel_primitives.py",
+    "test_llama.py",
     # distributed (mesh-light representatives)
     "test_collective.py", "test_sharding_stages.py", "test_auto_parallel.py",
     "test_fleet_e2e.py", "test_distributed_tail.py", "test_67b_lowering.py",

@@ -170,3 +170,61 @@ class TestFacade:
         out.sum().backward()
         g = model._params["q_w"].grad
         assert g is not None and np.isfinite(g.numpy()).all()
+
+
+class TestDecode:
+    def test_cached_forward_matches_uncached(self):
+        """Prefill + per-token cached decode logits == the plain causal
+        forward at every position (the gpt decode-parity convention)."""
+        from paddle_tpu.models.llama import (init_kv_cache,
+                                             llama_forward_cached)
+        cfg = _cfg(num_kv_heads=2)
+        params = init_llama_params(cfg, jax.random.PRNGKey(7))
+        tokens = jnp.asarray(
+            np.random.RandomState(7).randint(0, 128, (2, 10)), jnp.int32)
+        full = np.asarray(llama_forward(params, tokens, cfg))
+
+        cache = init_kv_cache(cfg, 2, 10)
+        lg, cache = llama_forward_cached(params, tokens[:, :6], cache,
+                                         0, cfg)
+        np.testing.assert_allclose(np.asarray(lg), full[:, :6],
+                                   rtol=2e-4, atol=2e-4)
+        for t in range(6, 10):
+            lg, cache = llama_forward_cached(
+                params, tokens[:, t:t + 1], cache, t, cfg)
+            np.testing.assert_allclose(np.asarray(lg)[:, 0], full[:, t],
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_greedy_generate_shapes_and_determinism(self):
+        from paddle_tpu.models.llama import greedy_generate
+        cfg = _cfg()
+        params = init_llama_params(cfg, jax.random.PRNGKey(8))
+        prompt = jnp.asarray(
+            np.random.RandomState(8).randint(0, 128, (2, 4)), jnp.int32)
+        out = greedy_generate(params, prompt, cfg, max_new_tokens=5)
+        assert out.shape == (2, 9)
+        np.testing.assert_array_equal(np.asarray(out[:, :4]),
+                                      np.asarray(prompt))
+        out2 = greedy_generate(params, prompt, cfg, max_new_tokens=5)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+
+    def test_overrun_rejected(self):
+        from paddle_tpu.models.llama import greedy_generate
+        cfg = _cfg()
+        params = init_llama_params(cfg, jax.random.PRNGKey(9))
+        prompt = jnp.zeros((1, 4), jnp.int32)
+        with pytest.raises(ValueError, match="exceeds"):
+            greedy_generate(params, prompt, cfg, max_new_tokens=8,
+                            max_len=10)
+
+    def test_zero_new_tokens_returns_prompt(self):
+        from paddle_tpu.models.llama import greedy_generate
+        cfg = _cfg()
+        params = init_llama_params(cfg, jax.random.PRNGKey(10))
+        prompt = jnp.asarray(
+            np.random.RandomState(10).randint(0, 128, (2, 5)), jnp.int32)
+        out = greedy_generate(params, prompt, cfg, max_new_tokens=0)
+        np.testing.assert_array_equal(np.asarray(out),
+                                      np.asarray(prompt))
+        with pytest.raises(ValueError, match=">= 0"):
+            greedy_generate(params, prompt, cfg, max_new_tokens=-1)
