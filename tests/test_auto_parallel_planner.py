@@ -261,6 +261,16 @@ class TestAllModelFamilyConfigs:
         with pytest.raises(ValueError, match="sequence length"):
             spec_from_config(Odd())
 
+    def test_llama_config_plans(self):
+        from paddle_tpu.models.llama import LlamaConfig
+        from paddle_tpu.parallel.planner import spec_from_config
+        cfg = LlamaConfig(hidden_size=256, num_layers=4, num_heads=8,
+                          num_kv_heads=4, vocab_size=1024,
+                          max_seq_len=128)
+        spec = spec_from_config(cfg)
+        assert spec.ffn_hidden == cfg.ffn_hidden
+        assert plan_parallel(cfg, 8, 16).fits
+
     def test_ernie_vil_composite_plans_per_tower(self):
         from paddle_tpu.models.ernie_vil import ErnieViLConfig
         from paddle_tpu.parallel.planner import spec_from_config
