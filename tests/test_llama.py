@@ -228,3 +228,33 @@ class TestDecode:
                                       np.asarray(prompt))
         with pytest.raises(ValueError, match=">= 0"):
             greedy_generate(params, prompt, cfg, max_new_tokens=-1)
+
+
+class TestServing:
+    def test_jit_save_predictor_roundtrip(self, tmp_path):
+        """The new family rides the serving path end to end: facade ->
+        jit.save (StableHLO artifact) -> inference.Predictor, with
+        logits parity against the live model (the cross-subsystem
+        integration every family must pass)."""
+        import paddle_tpu as paddle
+        from paddle_tpu.jit import InputSpec
+        from paddle_tpu.inference import Config, create_predictor
+
+        cfg = _cfg()
+        model = LlamaModel(cfg, seed=0).eval()
+        tokens = np.random.RandomState(11).randint(
+            0, 128, (2, 8)).astype(np.int64)
+        want = np.asarray(model(paddle.to_tensor(tokens)).numpy())
+
+        path = str(tmp_path / "llama" / "model")
+        paddle.jit.save(model, path,
+                        input_spec=[InputSpec([2, 8], "int64")])
+        predictor = create_predictor(Config(path + ".pdmodel"))
+        names = predictor.get_input_names()
+        h = predictor.get_input_handle(names[0])
+        h.reshape([2, 8])
+        h.copy_from_cpu(tokens)
+        predictor.run()
+        out = predictor.get_output_handle(
+            predictor.get_output_names()[0]).copy_to_cpu()
+        np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-4)
