@@ -129,6 +129,8 @@ def run_job(name, argv, timeout_s, env_extra, window_dir) -> dict:
     sys.path.insert(0, HERE)
     from bench import xla_cache_dir
     env.setdefault("JAX_COMPILATION_CACHE_DIR", xla_cache_dir())
+    # LRU cap so a long campaign can't fill the disk with executables
+    env.setdefault("JAX_COMPILATION_CACHE_MAX_SIZE", str(2 << 30))
     t0 = time.time()
     with open(out_path, "wb") as fo, open(err_path, "wb") as fe, \
             open(BUSY_PATH, "w") as fb:
