@@ -59,6 +59,12 @@ class HostPipeline:
     like the reference's virtual stages. The per-stage executables are
     created once here and reused every step (jax.jit caches on the
     committed device: p forward + p backward compiles total).
+
+    Scope: pure-pp, single-controller-local. Each stage runs on ONE
+    device (the first along every other mesh axis) — on a hybrid
+    dp x pp x mp mesh the other axes sit idle here; hybrid topologies
+    pipeline through parallel.pipeline's SPMD formulation, which keeps
+    dp/mp under GSPMD inside each stage.
     """
 
     def __init__(self, stage_fn: Callable, loss_fn: Callable,
