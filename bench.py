@@ -243,17 +243,20 @@ def run_measurement(rung: str) -> None:
         winner = _sweep_winner_variant()
         if winner is not None:
             variants.append(winner)
+        # ORDER IS EXPECTED VALUE: a congested window dies mid-race and
+        # keeps best-so-far, so the measured-best configs go first —
+        # window-1 ablation crowned plain XLA attention (399.7 ms vs
+        # 427.6+ for every Pallas fwd) and noremat@B4 per-token (42.5
+        # vs 53.4 ms/sample); the cheapest-remat crosses follow
+        variants.append((dict(), None, xla))
+        variants.append((dict(remat=False), 4, xla))
+        variants.append((dict(remat_policy="all_but_mlp"), None, xla))
         variants.append((dict(remat_policy="all_but_mlp"), None, splash))
         variants.append((dict(remat_policy="all_but_mlp"), None, pallas))
-        # window-1 ablation: plain XLA attention beat every Pallas-fwd
-        # variant (399.7 vs 427.6+ ms) — it races at both remat poles
-        variants.append((dict(), None, xla))
-        variants.append((dict(remat_policy="all_but_mlp"), None, xla))
         variants.append((dict(remat_policy="dots_flash"), None, splash))
         variants.append((dict(remat_policy="dots_flash"), None, jaxflash))
         variants.append((dict(remat=False), 4, splash))
         variants.append((dict(remat=False), 4, pallas))
-        variants.append((dict(remat=False), 4, xla))
         # batch crossings (the old tpu-b16 rung, now one race): more
         # tokens/step amortize the update; OOMs are caught and skipped
         variants.append((dict(remat_policy="all_but_mlp"), 12, splash))
