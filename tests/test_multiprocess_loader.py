@@ -312,3 +312,37 @@ class TestSpawnWorkers:
         monkeypatch.setenv("PADDLE_TPU_WORKER_START", "forkserver")
         with pytest.raises(ValueError, match="fork or spawn"):
             worker_start_method()
+
+
+class TestRingLifecycle:
+    def test_close_is_idempotent_and_guards_native_calls(self):
+        """A closed ring must fail as RingClosed, never hand NULL to the
+        native code; double-close is a no-op."""
+        ring = ShmRing(n_slots=2, slot_bytes=1 << 12)
+        ring.put(b"x")
+        ring.close()
+        ring.close()
+        for op in (lambda: ring.put(b"y"),
+                   lambda: ring.get(timeout=0.1),
+                   lambda: ring.close_producer(),
+                   lambda: ring.buffered(),
+                   lambda: ring.producer_done()):
+            with pytest.raises(RingClosed):
+                op()
+
+    def test_dead_worker_surfaces_instead_of_blocking(self):
+        """_get_checked: a worker that dies WITHOUT closing its ring
+        (possible in spawn mode) must raise WorkerError from the
+        timeout-probe loop, not block forever."""
+        import os
+        import time
+        from paddle_tpu.io.multiprocess import _get_checked, WorkerError
+        ring = ShmRing(n_slots=2, slot_bytes=1 << 12)
+        pid = os.fork()
+        if pid == 0:
+            os._exit(1)          # dies immediately, ring left open
+        t0 = time.time()
+        with pytest.raises(WorkerError, match="exited without"):
+            _get_checked(ring, pid, None)
+        assert time.time() - t0 < 30
+        ring.close()
