@@ -18,7 +18,6 @@ go to stderr; stdout carries only the final JSON line.
 """
 from __future__ import annotations
 
-import functools
 import json
 import os
 import subprocess
@@ -193,8 +192,8 @@ def run_measurement(rung: str) -> None:
         opt_state = init_opt_state(params)
         tokens = jax.random.randint(jax.random.PRNGKey(1),
                                     (vbatch, seq + 1), 0, cfg.vocab_size)
-        step = jax.jit(functools.partial(train_step, cfg=cfg, lr=1e-4),
-                       donate_argnums=(0, 1))
+        from paddle_tpu.models.facade import make_train_step
+        step = make_train_step(train_step, cfg=cfg, lr=1e-4)
         t0 = time.perf_counter()
         loss, params, opt_state = step(params, opt_state, tokens)
         loss_v = float(loss)   # forces; block_until_ready unreliable

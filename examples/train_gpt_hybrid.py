@@ -19,7 +19,6 @@ if os.environ.get("PADDLE_TPU_EXAMPLE_BACKEND", "cpu") == "cpu":
     from paddle_tpu.device import pin_cpu
     assert pin_cpu(8), "could not pin the CPU backend"
 
-import functools
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -48,8 +47,8 @@ def main():
         params = shard_gpt_params(init_gpt_params(
             cfg, jax.random.PRNGKey(0)), mesh)
         opt_state = init_opt_state(params)
-        step = jax.jit(functools.partial(train_step, cfg=cfg, lr=1e-3),
-                       donate_argnums=(0, 1))
+        from paddle_tpu.models.facade import make_train_step
+        step = make_train_step(train_step, cfg=cfg, lr=1e-3)
         rng = np.random.RandomState(0)
         for it in range(5):
             tokens = jnp.asarray(rng.randint(

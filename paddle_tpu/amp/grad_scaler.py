@@ -6,11 +6,29 @@ scale stays 1).
 """
 from __future__ import annotations
 
+import functools
+
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from ..framework.tensor import Tensor
 from ..framework.autograd import no_grad
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _unscale_and_check(gs, inv):
+    """One fused device pass over the whole grad list: multiply by the
+    inverse scale (f32 math, storage dtype preserved) and AND together
+    the per-grad finite checks. The old path dispatched one isfinite +
+    one host sync PER PARAMETER; this is one executable and ONE host
+    pull (the scalar verdict). The incoming grad buffers are donated —
+    each output grad aliases its input, so unscaling allocates nothing."""
+    new = [(g.astype(jnp.float32) * inv).astype(g.dtype) for g in gs]
+    ok = jnp.asarray(True)
+    for g in new:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(g)))
+    return new, ok
 
 
 class GradScaler:
@@ -50,18 +68,18 @@ class GradScaler:
     def unscale_(self, optimizer):
         if not self._enable:
             return
-        inv = 1.0 / self._scale
-        found = False
-        for p in optimizer._parameter_list:
-            if p.grad is None:
-                continue
-            g = p.grad._value
-            if self._scale != 1.0:
-                g = (g.astype(jnp.float32) * inv).astype(g.dtype)
-                p.grad._value = g
-            if not bool(jnp.isfinite(g).all()):
-                found = True
-        self._found_inf = found
+        with_grad = [p for p in optimizer._parameter_list
+                     if p.grad is not None]
+        if not with_grad:
+            self._found_inf = False
+            self._unscaled = True
+            return
+        new, ok = _unscale_and_check(
+            [p.grad._value for p in with_grad],
+            jnp.asarray(1.0 / self._scale, jnp.float32))
+        for p, g in zip(with_grad, new):
+            p.grad._value = g
+        self._found_inf = not bool(ok)
         self._unscaled = True
 
     def step(self, optimizer):

@@ -18,8 +18,9 @@ Rows are allocated lazily in a grow-by-doubling arena keyed by feature
 id (the memory_sparse_table hash-table semantics: ids are sparse,
 unbounded, and mostly absent), with the reference's entry admission
 policies honored: a `CountFilterEntry(k)` row reads as zeros and drops
-updates until its id has been seen k times; `ProbabilityEntry(p)`
-admits at first sight with probability p.
+updates until its id has been seen k times; `ProbabilityEntry(p)` gives
+every sighting of an unadmitted id an independent admission draw at
+probability p (memoryless, like the reference's creation attempts).
 """
 from __future__ import annotations
 
@@ -94,10 +95,14 @@ class HostShardedEmbedding:
             if c < ent._kw["count_filter"]:
                 return False
         elif name == "ProbabilityEntry":
-            if fid in self._seen:             # previously rejected
-                return False
+            # MEMORYLESS: every sighting of an unadmitted id gets a
+            # fresh draw (the reference PS table keeps no rejection
+            # state — a creation attempt either succeeds or leaves no
+            # trace), so long-run admission probability for a feature
+            # sighted k times is 1-(1-p)^k, not p. The old permanent
+            # rejected-id memo could lock a frequent feature out of the
+            # table forever on one unlucky draw.
             if self._rng.random() >= ent._kw["probability"]:
-                self._seen[fid] = 0
                 return False
         self._grow(self._size + 1)
         self._slot[fid] = self._size

@@ -133,7 +133,7 @@ def _pallas_enabled() -> bool:
     return use_pallas
 
 
-def _pallas_attn_enabled() -> bool:
+def _pallas_attn_enabled(seq: int | None = None) -> bool:
     """Attention-only gate layered on the global one (CE kernel
     unaffected — it gates through _pallas_enabled directly): the round-4
     ablation measured the XLA attention path faster than the Pallas flash
@@ -143,7 +143,7 @@ def _pallas_attn_enabled() -> bool:
     if os.environ.get("PADDLE_TPU_DISABLE_PALLAS_ATTN", "") in (
             "1", "true", "True"):
         return False
-    if _attn_impl() == "xla":
+    if _attn_impl(seq) == "xla":
         return False
     return _pallas_enabled()
 
@@ -221,7 +221,8 @@ def _tuned_blocks(q, k, causal):
 
 
 def _fwd_with_lse(q, k, v, causal, kv_len=None):
-    if _pallas_attn_enabled() and jax.default_backend() in ("tpu", "axon"):
+    if _pallas_attn_enabled(q.shape[1]) \
+            and jax.default_backend() in ("tpu", "axon"):
         from .pallas_attention import mha_fwd
         blocks = _tuned_blocks(q, k, causal)
         if blocks is not None:
@@ -302,17 +303,18 @@ def _flash_mha_fwd(q, k, v, causal, kv_len=None):
     return out, (q, k, v, out, lse)
 
 
-def _pallas_bwd_enabled() -> bool:
+def _pallas_bwd_enabled(seq: int | None = None) -> bool:
     import os
     if os.environ.get("PADDLE_TPU_DISABLE_PALLAS_BWD", "") in ("1", "true",
                                                                "True"):
         return False
-    return _pallas_attn_enabled()
+    return _pallas_attn_enabled(seq)
 
 
 def _flash_mha_bwd(causal, kv_len, res, do):
     q, k, v, out, lse = res
-    if _pallas_bwd_enabled() and jax.default_backend() in ("tpu", "axon"):
+    if _pallas_bwd_enabled(q.shape[1]) \
+            and jax.default_backend() in ("tpu", "axon"):
         from .pallas_attention import mha_bwd
         blocks = _tuned_blocks_bwd(q, k, causal)
         if blocks is not None:
@@ -368,7 +370,19 @@ def _winner_impl():
     return _sweep_winner_impl or None
 
 
-def _attn_impl() -> str:
+def _registry_impl(seq: int | None = None):
+    """Evidence-gated registry winner for the current backend class
+    (kernels/registry.py; perf/kernel_registry.json). Seeded so that
+    TPU-class backends default to 'xla' — the only hardware ablation's
+    winner — and CPU keeps 'pallas' for parity coverage. Exact
+    shape bucket first, then the wildcard row."""
+    from . import registry
+    cls = registry.backend_class(jax.default_backend())
+    bucket = registry.seq_bucket(seq) if seq else "*"
+    return registry.winner("attention", backend=cls, bucket=bucket)
+
+
+def _attn_impl(seq: int | None = None) -> str:
     """Attention implementation selector (PADDLE_TPU_ATTN_IMPL):
     - 'pallas'   homegrown kernel + the gates above
     - 'jax_flash' jax.experimental.pallas.ops.tpu.flash_attention — the
@@ -379,11 +393,14 @@ def _attn_impl() -> str:
     The ENV VAR is re-read per trace like the kill switches; with it
     unset, TPU-class backends follow the latest measured sweep winner
     (perf/sweep_winner.json, memoized per process — a sweep landing
-    mid-process applies from the next process), falling back to
-    'pallas'. CPU keeps the 'pallas' default for parity coverage."""
+    mid-process applies from the next process), then BOTH backend
+    classes consult the kernel-selection registry
+    (perf/kernel_registry.json, evidence-gated), and only then the
+    hardcoded 'pallas'. `seq` (when the caller knows it) picks the
+    registry's shape bucket."""
     import os
     return (os.environ.get("PADDLE_TPU_ATTN_IMPL")
-            or _winner_impl() or "pallas")
+            or _winner_impl() or _registry_impl(seq) or "pallas")
 
 
 def _jax_flash_mha(q, k, v, causal):
@@ -425,8 +442,8 @@ def _dispatch_mha(q, k, v, causal):
     # the upstream kernel is still Pallas: the global and attention kill
     # switches outrank the impl selector, preserving the documented
     # global > attention-only > impl layering
-    impl = _attn_impl()
-    if (impl in ("jax_flash", "splash") and _pallas_attn_enabled()
+    impl = _attn_impl(q.shape[1])
+    if (impl in ("jax_flash", "splash") and _pallas_attn_enabled(q.shape[1])
             and jax.default_backend() in ("tpu", "axon")):
         fn = _splash_mha if impl == "splash" else _jax_flash_mha
         return fn(q, k, v, causal)

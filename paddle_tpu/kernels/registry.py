@@ -1,0 +1,240 @@
+"""Evidence-gated kernel selection registry.
+
+Reference analog: the autotune subsystem's cached algorithm choice
+(paddle/phi/kernels/autotune/cache.cc:1 AlgorithmsCache +
+switch_autotune.cc:1), generalized from per-shape block sizes
+(kernels/autotune.py) to WHICH IMPLEMENTATION a selectable kernel ships
+with: a persistent per-(kernel, backend-class, shape-bucket) winner
+table in perf/kernel_registry.json.
+
+Why a registry and not a fallback chain: the round-5 verdict found the
+TPU attention default silently resolving to the homegrown Pallas kernel
+— the one implementation the only hardware ablation measured as a net
+loss (399.7 ms/step for xla vs 427.6+ for every Pallas forward) —
+because the evidence lived in window artifacts nothing consulted. Here
+the evidence IS the table: every `measured` entry carries the ms and
+the arithmetic/memory volume that justify it, and `adopt()` refuses to
+persist a row the roofline plausibility gate rejects — a single
+tunnel-artifact-inflated sweep timing can never become the shipped
+default (the round-4 failure mode BASELINE.md disavows).
+
+Entry kinds:
+- `measured`: impl + ms + flops/bytes evidence; must sit inside the
+  physical window (`gate_ms` returns None) to load OR to be adopted.
+- `policy`: impl + human reason, no perf claim — e.g. CPU keeps the
+  homegrown Pallas attention so interpret-mode parity coverage keeps
+  running in the test suite.
+
+Selection precedence at the consult sites stays: explicit env override
+> freshly-adopted sweep winner (attention, TPU only) > registry winner
+> hardcoded default.
+
+The roofline gate (plausible_ms / gate_ms) lives HERE so the package's
+adoption path and the measurement tools share one rule;
+tools/bench_util.py re-exports it for the existing tool callers.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional
+
+# ---------------------------------------------------------------- gate
+# Roofline anchors for the plausibility gate (v5-litepod class defaults;
+# override via env for other parts).
+PEAK_BF16_TFLOPS = float(os.environ.get("PADDLE_TPU_PEAK_TFLOPS", "197"))
+PEAK_HBM_GBS = float(os.environ.get("PADDLE_TPU_PEAK_HBM_GBS", "819"))
+# Below these effective rates a kernel-sized timing is measuring the
+# tunnel/host, not the chip — the round-4 sweep persisted CE rows at
+# 3.4-7.9 s for a ~15 ms kernel, which this floor rejects.
+FLOOR_TFLOPS = 0.5
+FLOOR_GBS = 20.0
+
+
+def plausible_ms(flops: float = 0.0, bytes_moved: float = 0.0):
+    """Physical window (lo_ms, hi_ms) for ONE application of a kernel of
+    known arithmetic/memory volume. lo = half the roofline time (nothing
+    runs 2x faster than the roofline); hi = the time implied by the
+    FLOOR_* effective rates (anything slower is a measurement artifact,
+    not a slow kernel)."""
+    lo_s = max(flops / (PEAK_BF16_TFLOPS * 1e12),
+               bytes_moved / (PEAK_HBM_GBS * 1e9)) / 2.0
+    hi_s = max(flops / (FLOOR_TFLOPS * 1e12),
+               bytes_moved / (FLOOR_GBS * 1e9), 1e-6)
+    return lo_s * 1e3, hi_s * 1e3
+
+
+def gate_ms(ms: float, flops: float = 0.0, bytes_moved: float = 0.0):
+    """None if `ms` is physically plausible for the given volumes, else a
+    short reason string for the record."""
+    lo, hi = plausible_ms(flops, bytes_moved)
+    if ms < lo:
+        return f"implausibly fast: {ms:.3f} ms < {lo:.3f} ms (2x roofline)"
+    if ms > hi:
+        return (f"implausibly slow: {ms:.3f} ms > {hi:.1f} ms "
+                "(sub-floor effective rate; likely RTT/host-bound)")
+    return None
+
+
+# ------------------------------------------------------------- registry
+REGISTRY_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "perf", "kernel_registry.json")
+
+# selectable kernels and their legal impl names — an entry naming
+# anything else is invalid (catches a hand-edit typo before it silently
+# falls through to the hardcoded default)
+KNOWN_IMPLS: Dict[str, tuple] = {
+    "attention": ("pallas", "jax_flash", "splash", "xla"),
+    "ce": ("pallas", "jax"),
+    "varlen_attention": ("blockwise", "dense"),
+}
+
+_DOCS: Dict[str, Optional[dict]] = {}   # path -> parsed doc (memoized)
+
+
+def backend_class(platform: Optional[str] = None) -> str:
+    """'tpu' for TPU-class backends (real 'tpu' and the tunneled 'axon'
+    plugin), 'cpu' for everything else. The registry buckets by CLASS,
+    not platform string: a winner measured over the tunnel is the same
+    chip as a directly-attached one."""
+    if platform is None:
+        import jax
+        platform = jax.default_backend()
+    return "tpu" if platform in ("tpu", "axon") else "cpu"
+
+
+def seq_bucket(n: int) -> str:
+    """Power-of-two shape bucket for sequence-sized dims ('S1024').
+    Winners generalize within a bucket; an exact-shape table would never
+    get a hit outside the swept shapes."""
+    b = 1
+    while b < max(int(n), 1):
+        b *= 2
+    return f"S{b}"
+
+
+def _key(kernel: str, backend: str, bucket: str) -> str:
+    return f"{kernel}::{backend}::{bucket}"
+
+
+def _load(path: Optional[str] = None) -> dict:
+    path = path or REGISTRY_PATH
+    if path not in _DOCS:
+        try:
+            with open(path) as f:
+                _DOCS[path] = json.load(f)
+        except (OSError, ValueError):
+            _DOCS[path] = {}
+    return _DOCS[path] or {}
+
+
+def _reset() -> None:
+    """Drop the memoized file reads (tests; a registry landing mid-process
+    otherwise applies from the next process, like the sweep winner)."""
+    _DOCS.clear()
+
+
+def _entry_problem(key: str, ent) -> Optional[str]:
+    """One entry's validation verdict: None when well-formed AND
+    evidence-gated, else the reason. ONE rule for load-time trust,
+    adopt-time gating and the CI check."""
+    parts = key.split("::")
+    if len(parts) != 3:
+        return f"{key}: key is not kernel::backend::bucket"
+    kernel, backend, _bucket = parts
+    if backend not in ("tpu", "cpu"):
+        return f"{key}: unknown backend class {backend!r}"
+    if not isinstance(ent, dict):
+        return f"{key}: entry is not an object"
+    impl = ent.get("impl")
+    legal = KNOWN_IMPLS.get(kernel)
+    if legal is not None and impl not in legal:
+        return f"{key}: impl {impl!r} not one of {legal}"
+    kind = ent.get("kind")
+    if kind == "policy":
+        if not ent.get("reason"):
+            return f"{key}: policy entry with no reason"
+        return None
+    if kind != "measured":
+        return f"{key}: kind {kind!r} is neither measured nor policy"
+    ms = ent.get("ms")
+    flops = float(ent.get("flops", 0.0) or 0.0)
+    bytes_moved = float(ent.get("bytes_moved", 0.0) or 0.0)
+    if not isinstance(ms, (int, float)) or ms <= 0:
+        return f"{key}: measured entry with no ms"
+    if flops <= 0 and bytes_moved <= 0:
+        return (f"{key}: measured entry carries no arithmetic/memory "
+                "volume, so plausibility cannot be checked")
+    reason = gate_ms(float(ms), flops=flops, bytes_moved=bytes_moved)
+    if reason:
+        return f"{key}: {reason}"
+    return None
+
+
+def validate(doc: Optional[dict] = None,
+             path: Optional[str] = None) -> list:
+    """Every problem in the registry file (empty list = clean). The CI
+    check and the load path share this; an entry that fails here is
+    never served by winner()."""
+    if doc is None:
+        doc = _load(path)
+    return [p for key, ent in (doc.get("entries") or {}).items()
+            for p in [_entry_problem(key, ent)] if p]
+
+
+def winner(kernel: str, backend: Optional[str] = None,
+           bucket: str = "*", path: Optional[str] = None) -> Optional[str]:
+    """The registered impl for (kernel, backend-class, bucket), falling
+    back from the exact bucket to the '*' wildcard; None when the table
+    has no trustworthy row. Entries that fail validation are skipped —
+    a hand-edited or corrupted row degrades to the hardcoded default
+    instead of shipping."""
+    backend = backend or backend_class()
+    entries = _load(path).get("entries") or {}
+    for b in dict.fromkeys((bucket, "*")):
+        ent = entries.get(_key(kernel, backend, b))
+        if ent is not None and _entry_problem(_key(kernel, backend, b),
+                                              ent) is None:
+            return ent.get("impl")
+    return None
+
+
+def entry(kernel: str, backend: str, bucket: str = "*",
+          path: Optional[str] = None) -> Optional[dict]:
+    """Raw entry read (inspection/tests); no validation applied."""
+    return (_load(path).get("entries") or {}).get(
+        _key(kernel, backend, bucket))
+
+
+def adopt(kernel: str, impl: str, ms: float, flops: float = 0.0,
+          bytes_moved: float = 0.0, backend: Optional[str] = None,
+          bucket: str = "*", source: str = "", window: str = "",
+          path: Optional[str] = None) -> Optional[str]:
+    """Persist a measured winner — THE only write path, and it refuses
+    anything the plausibility gate rejects. Returns None on success or
+    the rejection reason (the caller logs it; the file is untouched).
+    Atomic tmp+rename write, like the autotune cache."""
+    backend = backend or backend_class()
+    path = path or REGISTRY_PATH
+    ent = {"impl": impl, "kind": "measured", "ms": round(float(ms), 3),
+           "flops": float(flops), "bytes_moved": float(bytes_moved),
+           "source": source, "window": window}
+    key = _key(kernel, backend, bucket)
+    problem = _entry_problem(key, ent)
+    if problem:
+        return problem
+    doc = dict(_load(path))
+    entries = dict(doc.get("entries") or {})
+    entries[key] = ent
+    doc["entries"] = entries
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+    except OSError as e:
+        return f"registry write failed: {e}"
+    _DOCS[path] = doc
+    return None

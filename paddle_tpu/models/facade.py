@@ -14,6 +14,27 @@ and the (small, immutable) config travel in the closure.
 from __future__ import annotations
 
 
+def make_train_step(step_fn, cfg=None, donate=True, **step_kw):
+    """jit the stacked-params functional train step with the params and
+    optimizer-state buffers DONATED — step_fn(params, opt_state, batch,
+    ...) -> (loss, new_params, new_opt_state) consumes both trees and
+    returns same-shaped replacements, so XLA aliases the output buffers
+    onto the inputs instead of holding two copies of the model + Adam
+    moments live across the update (the same donate_argnums=(2, 4)
+    pattern optimizer.Optimizer._build_step_fn_for already uses).
+
+    ONE home for the pattern: bench.py, the sweep/ablation tools and the
+    examples all jitted `functools.partial(train_step, cfg=cfg, ...)`
+    with hand-rolled donation; they now build their step here so the
+    donation (and any future jit policy) cannot drift per caller."""
+    import functools
+    import jax
+    if cfg is not None:
+        step_kw["cfg"] = cfg
+    fn = functools.partial(step_fn, **step_kw) if step_kw else step_fn
+    return jax.jit(fn, donate_argnums=(0, 1) if donate else ())
+
+
 class FacadeModel:
     _fwd_op_name = "model_forward"
 

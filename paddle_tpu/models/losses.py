@@ -25,7 +25,13 @@ def _pallas_ce_enabled() -> bool:
     if os.environ.get("PADDLE_TPU_DISABLE_PALLAS_CE", "") in (
             "1", "true", "True"):
         return False
-    return jax.default_backend() in ("tpu", "axon")
+    if jax.default_backend() not in ("tpu", "axon"):
+        return False
+    # evidence-gated selection: a registered (and plausibility-gated)
+    # 'jax' winner for the CE kernel routes the loss onto the jax-level
+    # form without a code edit; no entry keeps the Pallas default
+    from ..kernels import registry
+    return registry.winner("ce", backend="tpu") != "jax"
 
 
 def fused_softmax_ce(logits, targets, valid_mask=None):

@@ -82,6 +82,30 @@ _VARLEN_DENSE_MAX = 16 * 1024 * 1024   # H * total_q * total_k
 _VARLEN_BLOCK_KV = 512
 
 
+def _varlen_impl(n_elements: int) -> str:
+    """'blockwise' | 'dense' for a packing whose probs buffer would hold
+    n_elements (= H * total_q * total_k). Precedence mirrors the
+    attention selector: env override (PADDLE_TPU_VARLEN_IMPL, the
+    operator's absolute escape hatch), then the evidence-gated kernel
+    registry's winner for this backend class, then the element-count
+    heuristic. A registry 'dense' winner is a PREFERENCE, not a license
+    to OOM: it only applies while the probs buffer stays under the
+    memory guard — a wildcard row measured on a small packing must not
+    force an O(n_elements) materialization at every size."""
+    import os
+    impl = os.environ.get("PADDLE_TPU_VARLEN_IMPL", "")
+    if impl in ("blockwise", "dense"):
+        return impl
+    from ...kernels import registry
+    impl = registry.winner("varlen_attention",
+                           backend=registry.backend_class()) or ""
+    if impl == "dense" and n_elements > _VARLEN_DENSE_MAX:
+        impl = "blockwise"
+    if impl not in ("blockwise", "dense"):
+        impl = "blockwise" if n_elements > _VARLEN_DENSE_MAX else "dense"
+    return impl
+
+
 def _varlen_segments(cu, total):
     """Segment id and within-segment position for each packed row."""
     cu = cu.astype(jnp.int32)
@@ -137,7 +161,13 @@ def _varlen_blockwise(q, k, v, seg_q, pos_q, seg_k, pos_k, scale, causal):
     m0 = jnp.full((H, total_q), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((H, total_q), jnp.float32)
     acc0 = jnp.zeros((H, total_q, D), jnp.float32)
-    (m, l, acc), _ = jax.lax.scan(step, (m0, l0, acc0), (kb, vb, sb, pb))
+    # reverse-mode AD over a plain scan saves every block's residuals
+    # (p, scores: O(H·total_q·blk) EACH, × nblk = the dense blowup this
+    # path exists to avoid); checkpointing the body stores only the
+    # (m, l, acc) carry per block and rebuilds p in the backward — the
+    # same recompute trade the flash backward makes
+    (m, l, acc), _ = jax.lax.scan(jax.checkpoint(step), (m0, l0, acc0),
+                                  (kb, vb, sb, pb))
     # rows whose segment has zero kv tokens stay all-masked: l == 0 → 0
     out = acc / jnp.maximum(l, 1e-37)[..., None]
     return jnp.swapaxes(out, 0, 1).astype(q.dtype)   # [total_q, H, D]
@@ -157,7 +187,8 @@ def _flash_attn_unpadded(q, k, v, cu_q, cu_k, key, scale, dropout_p,
     seg_k, pos_k = _varlen_segments(cu_k, total_k)
     dense_needed = want_softmax or (dropout_p > 0.0 and training)
     if (not dense_needed
-            and q.shape[1] * total_q * total_k > _VARLEN_DENSE_MAX):
+            and _varlen_impl(q.shape[1] * total_q * total_k)
+            == "blockwise"):
         return _varlen_blockwise(q, k, v, seg_q, pos_q, seg_k, pos_k,
                                  scale, causal)
     valid = seg_q[:, None] == seg_k[None, :]

@@ -1,278 +1,292 @@
-"""Transformer layers.
+"""Transformer layer stack.
 
 Reference analog: python/paddle/nn/layer/transformer.py (MultiHeadAttention,
 TransformerEncoder/Decoder) and the fused variants in
-python/paddle/incubate/nn/layer/fused_transformer.py:193,498,726. Here the
-"fused" path is the default: attention goes through F.flash_attention (one
-fused kernel), and XLA fuses the FFN — no separate Fused* class hierarchy is
-needed, though compat aliases exist in paddle_tpu.incubate.
+python/paddle/incubate/nn/layer/fused_transformer.py:193,498,726.
+
+TPU-native shape of this file: there is no separate Fused* hierarchy
+because fusion is the compiler's job here — attention lands on
+F.scaled_dot_product_attention (one fused kernel under the dispatch
+layer) and XLA fuses the FFN matmul chain on its own; compat aliases for
+the reference's Fused* names live in paddle_tpu.incubate. The pre/post
+LayerNorm residual wiring, which the reference spells out longhand in
+every sublayer, is factored into one `_residual` helper so the encoder
+and decoder layers state only their sublayer bodies.
 """
 from __future__ import annotations
 
-import collections
 import copy
-import numpy as np
+from collections import namedtuple
 
-from ...framework.tensor import Tensor
 from ..layer import Layer
-from .common import Linear, Dropout, Embedding
+from .common import Linear, Dropout
 from .norm import LayerNorm
 from .container import LayerList
 from .. import functional as F
 
 
 def _convert_attention_mask(attn_mask, dtype):
-    if attn_mask is None:
-        return None
-    import numpy as _np
-    if attn_mask.dtype == _np.bool_:
-        return attn_mask
+    """Paddle contract: bool masks select, float masks add. Both forms
+    pass through — F.scaled_dot_product_attention branches on dtype."""
     return attn_mask
+
+
+def _residual(x, sublayer, norm, dropout, pre_norm):
+    """One residual sublayer with the normalize_before toggle:
+    pre-norm  -> x + drop(f(norm(x)))
+    post-norm -> norm(x + drop(f(x)))
+    """
+    if pre_norm:
+        return x + dropout(sublayer(norm(x)))
+    return norm(x + dropout(sublayer(x)))
 
 
 class MultiHeadAttention(Layer):
     """reference: python/paddle/nn/layer/transformer.py MultiHeadAttention."""
 
-    Cache = collections.namedtuple("Cache", ["k", "v"])
-    StaticCache = collections.namedtuple("StaticCache", ["k", "v"])
+    Cache = namedtuple("Cache", ["k", "v"])
+    StaticCache = namedtuple("StaticCache", ["k", "v"])
 
     def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None,
                  vdim=None, need_weights=False, weight_attr=None,
                  bias_attr=None):
         super().__init__()
+        if embed_dim % num_heads:
+            raise ValueError(
+                f"num_heads {num_heads} must evenly divide "
+                f"embed_dim {embed_dim}")
         self.embed_dim = embed_dim
-        self.kdim = kdim or embed_dim
-        self.vdim = vdim or embed_dim
         self.num_heads = num_heads
         self.head_dim = embed_dim // num_heads
+        self.kdim = kdim if kdim is not None else embed_dim
+        self.vdim = vdim if vdim is not None else embed_dim
         self.dropout = dropout
         self.need_weights = need_weights
-        self.q_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
-        self.k_proj = Linear(self.kdim, embed_dim, weight_attr, bias_attr)
-        self.v_proj = Linear(self.vdim, embed_dim, weight_attr, bias_attr)
-        self.out_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
+        mk = lambda d_in: Linear(d_in, embed_dim, weight_attr, bias_attr)  # noqa: E731
+        self.q_proj = mk(embed_dim)
+        self.k_proj = mk(self.kdim)
+        self.v_proj = mk(self.vdim)
+        self.out_proj = mk(embed_dim)
 
-    def _reshape_heads(self, x):
+    def _heads(self, x):
+        """[B, S, E] -> [B, S, H, hd] (the fused-attention layout)."""
         from ...ops.manipulation import reshape
-        b, s = x.shape[0], x.shape[1]
-        return reshape(x, [b, s, self.num_heads, self.head_dim])
+        return reshape(x, [x.shape[0], x.shape[1], self.num_heads,
+                           self.head_dim])
+
+    def _project_kv(self, key, value, cache):
+        """Resolve k/v heads through the cache protocol:
+        - StaticCache: precomputed cross-attention k/v, reused as-is;
+        - Cache: grow the autoregressive k/v along the time axis;
+        - None: plain projection. Returns (k, v, updated_cache)."""
+        from ...ops.manipulation import concat
+        if isinstance(cache, MultiHeadAttention.StaticCache):
+            return cache.k, cache.v, cache
+        k = self._heads(self.k_proj(key))
+        v = self._heads(self.v_proj(value))
+        if isinstance(cache, MultiHeadAttention.Cache):
+            k = concat([cache.k, k], axis=1)
+            v = concat([cache.v, v], axis=1)
+            return k, v, MultiHeadAttention.Cache(k, v)
+        return k, v, None
 
     def gen_cache(self, key, value=None, type=Cache):  # noqa: A002
+        """Build the decode-time cache (reference gen_cache contract):
+        StaticCache projects `key`/`value` once for cross-attention; the
+        default Cache starts empty (S=0) and grows per step; passing
+        both tensors seeds a Cache directly."""
         from ...ops.creation import zeros
         if type == MultiHeadAttention.StaticCache:
-            k = self._reshape_heads(self.k_proj(key))
-            v = self._reshape_heads(self.v_proj(value if value is not None
-                                                else key))
-            return self.StaticCache(k, v)
-        if value is None:
-            b = key.shape[0]
-            k = zeros([b, 0, self.num_heads, self.head_dim], key.dtype)
-            v = zeros([b, 0, self.num_heads, self.head_dim], key.dtype)
-            return self.Cache(k, v)
-        return self.Cache(key, value)
+            v_src = key if value is None else value
+            return self.StaticCache(self._heads(self.k_proj(key)),
+                                    self._heads(self.v_proj(v_src)))
+        if value is not None:
+            return self.Cache(key, value)
+        empty = [key.shape[0], 0, self.num_heads, self.head_dim]
+        return self.Cache(zeros(empty, key.dtype), zeros(empty, key.dtype))
 
     def forward(self, query, key=None, value=None, attn_mask=None,
                 cache=None):
-        from ...ops.manipulation import reshape, concat
-        key = query if key is None else key
-        value = query if value is None else value
-        q = self._reshape_heads(self.q_proj(query))
-        if isinstance(cache, MultiHeadAttention.StaticCache):
-            k, v = cache.k, cache.v
-        else:
-            k = self._reshape_heads(self.k_proj(key))
-            v = self._reshape_heads(self.v_proj(value))
-            if isinstance(cache, MultiHeadAttention.Cache):
-                k = concat([cache.k, k], axis=1)
-                v = concat([cache.v, v], axis=1)
-                cache = self.Cache(k, v)
-        # [B,S,H,D] fused attention
-        mask = _convert_attention_mask(attn_mask, q.dtype)
-        out = F.scaled_dot_product_attention(
-            q, k, v, attn_mask=mask, dropout_p=self.dropout,
-            training=self.training)
-        b, s = out.shape[0], out.shape[1]
-        out = reshape(out, [b, s, self.embed_dim])
-        out = self.out_proj(out)
-        if cache is not None:
-            return (out, cache) if not self.need_weights else (out, None,
-                                                               cache)
+        from ...ops.manipulation import reshape
+        # reference defaulting: BOTH omitted tensors fall back to query
+        # (an omitted value does NOT follow key)
+        key = key if key is not None else query
+        value = value if value is not None else query
+        q = self._heads(self.q_proj(query))
+        k, v, new_cache = self._project_kv(key, value, cache)
+        ctx = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=_convert_attention_mask(attn_mask, q.dtype),
+            dropout_p=self.dropout, training=self.training)
+        ctx = reshape(ctx, [ctx.shape[0], ctx.shape[1], self.embed_dim])
+        out = self.out_proj(ctx)
+        # the fused kernel never materializes the probability matrix, so
+        # need_weights yields None (documented reference behavior for the
+        # fused path)
+        outs = (out,)
         if self.need_weights:
-            return out, None
-        return out
+            outs += (None,)
+        if cache is not None:
+            outs += (new_cache,)
+        return outs if len(outs) > 1 else out
 
 
-class TransformerEncoderLayer(Layer):
+class _FFNMixin:
+    """linear -> activation -> dropout -> linear, shared by the encoder
+    and decoder layers. A mixin (not a sub-Layer) so the linears stay
+    registered once under the reference's attribute names — state_dict
+    keys and parameter traversal match the reference exactly."""
+
+    def _init_ffn(self, d_model, d_hidden, drop, activation, weight_attr,
+                  bias_attr):
+        self.linear1 = Linear(d_model, d_hidden, weight_attr, bias_attr)
+        self.linear2 = Linear(d_hidden, d_model, weight_attr, bias_attr)
+        self.dropout = Dropout(drop)
+        self.activation = getattr(F, activation)
+
+    def _ffn(self, x):
+        return self.linear2(self.dropout(self.activation(self.linear1(x))))
+
+
+class TransformerEncoderLayer(Layer, _FFNMixin):
     def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
                  activation="relu", attn_dropout=None, act_dropout=None,
                  normalize_before=False, weight_attr=None, bias_attr=None,
                  layer_norm_eps=1e-5):
         super().__init__()
-        attn_dropout = dropout if attn_dropout is None else attn_dropout
-        act_dropout = dropout if act_dropout is None else act_dropout
         self.normalize_before = normalize_before
-        self.self_attn = MultiHeadAttention(d_model, nhead,
-                                            dropout=attn_dropout,
-                                            weight_attr=weight_attr,
-                                            bias_attr=bias_attr)
-        self.linear1 = Linear(d_model, dim_feedforward, weight_attr,
-                              bias_attr)
-        self.dropout = Dropout(act_dropout)
-        self.linear2 = Linear(dim_feedforward, d_model, weight_attr,
-                              bias_attr)
+        self.self_attn = MultiHeadAttention(
+            d_model, nhead,
+            dropout=dropout if attn_dropout is None else attn_dropout,
+            weight_attr=weight_attr, bias_attr=bias_attr)
+        self._init_ffn(d_model, dim_feedforward,
+                       dropout if act_dropout is None else act_dropout,
+                       activation, weight_attr, bias_attr)
         self.norm1 = LayerNorm(d_model, layer_norm_eps)
         self.norm2 = LayerNorm(d_model, layer_norm_eps)
         self.dropout1 = Dropout(dropout)
         self.dropout2 = Dropout(dropout)
-        self.activation = getattr(F, activation)
 
     def forward(self, src, src_mask=None, cache=None):
-        residual = src
-        if self.normalize_before:
-            src = self.norm1(src)
-        if cache is None:
-            src = self.self_attn(src, src, src, src_mask)
-        else:
-            src, incremental_cache = self.self_attn(src, src, src, src_mask,
-                                                    cache)
-        src = residual + self.dropout1(src)
-        if not self.normalize_before:
-            src = self.norm1(src)
-        residual = src
-        if self.normalize_before:
-            src = self.norm2(src)
-        src = self.linear2(self.dropout(self.activation(self.linear1(src))))
-        src = residual + self.dropout2(src)
-        if not self.normalize_before:
-            src = self.norm2(src)
-        return src if cache is None else (src, incremental_cache)
+        new_cache = None
+
+        def attn(x):
+            nonlocal new_cache
+            if cache is None:
+                return self.self_attn(x, x, x, src_mask)
+            y, new_cache = self.self_attn(x, x, x, src_mask, cache)
+            return y
+
+        pre = self.normalize_before
+        src = _residual(src, attn, self.norm1, self.dropout1, pre)
+        src = _residual(src, self._ffn, self.norm2, self.dropout2, pre)
+        return src if cache is None else (src, new_cache)
 
     def gen_cache(self, src):
         return self.self_attn.gen_cache(src)
 
 
-class TransformerEncoder(Layer):
-    def __init__(self, encoder_layer, num_layers, norm=None):
-        super().__init__()
-        self.layers = LayerList([encoder_layer] + [
-            copy.deepcopy(encoder_layer) for _ in range(num_layers - 1)])
-        self.num_layers = num_layers
-        self.norm = norm
-
-    def forward(self, src, src_mask=None, cache=None):
-        output = src
-        new_caches = []
-        for i, mod in enumerate(self.layers):
-            if cache is None:
-                output = mod(output, src_mask)
-            else:
-                output, new_cache = mod(output, src_mask, cache[i])
-                new_caches.append(new_cache)
-        if self.norm is not None:
-            output = self.norm(output)
-        return output if cache is None else (output, new_caches)
-
-    def gen_cache(self, src):
-        return [layer.gen_cache(src) for layer in self.layers]
-
-
-class TransformerDecoderLayer(Layer):
+class TransformerDecoderLayer(Layer, _FFNMixin):
     def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
                  activation="relu", attn_dropout=None, act_dropout=None,
                  normalize_before=False, weight_attr=None, bias_attr=None,
                  layer_norm_eps=1e-5):
         super().__init__()
-        attn_dropout = dropout if attn_dropout is None else attn_dropout
-        act_dropout = dropout if act_dropout is None else act_dropout
         self.normalize_before = normalize_before
-        self.self_attn = MultiHeadAttention(d_model, nhead, attn_dropout,
+        adrop = dropout if attn_dropout is None else attn_dropout
+        self.self_attn = MultiHeadAttention(d_model, nhead, adrop,
                                             weight_attr=weight_attr,
                                             bias_attr=bias_attr)
-        self.cross_attn = MultiHeadAttention(d_model, nhead, attn_dropout,
+        self.cross_attn = MultiHeadAttention(d_model, nhead, adrop,
                                              weight_attr=weight_attr,
                                              bias_attr=bias_attr)
-        self.linear1 = Linear(d_model, dim_feedforward, weight_attr,
-                              bias_attr)
-        self.dropout = Dropout(act_dropout)
-        self.linear2 = Linear(dim_feedforward, d_model, weight_attr,
-                              bias_attr)
+        self._init_ffn(d_model, dim_feedforward,
+                       dropout if act_dropout is None else act_dropout,
+                       activation, weight_attr, bias_attr)
         self.norm1 = LayerNorm(d_model, layer_norm_eps)
         self.norm2 = LayerNorm(d_model, layer_norm_eps)
         self.norm3 = LayerNorm(d_model, layer_norm_eps)
         self.dropout1 = Dropout(dropout)
         self.dropout2 = Dropout(dropout)
         self.dropout3 = Dropout(dropout)
-        self.activation = getattr(F, activation)
 
     def forward(self, tgt, memory, tgt_mask=None, memory_mask=None,
                 cache=None):
-        residual = tgt
-        if self.normalize_before:
-            tgt = self.norm1(tgt)
-        if cache is None:
-            tgt = self.self_attn(tgt, tgt, tgt, tgt_mask)
-        else:
-            tgt, incremental_cache = self.self_attn(tgt, tgt, tgt, tgt_mask,
-                                                    cache[0])
-        tgt = residual + self.dropout1(tgt)
-        if not self.normalize_before:
-            tgt = self.norm1(tgt)
-        residual = tgt
-        if self.normalize_before:
-            tgt = self.norm2(tgt)
-        if cache is None:
-            tgt = self.cross_attn(tgt, memory, memory, memory_mask)
-        else:
-            tgt, static_cache = self.cross_attn(tgt, memory, memory,
-                                                memory_mask, cache[1])
-        tgt = residual + self.dropout2(tgt)
-        if not self.normalize_before:
-            tgt = self.norm2(tgt)
-        residual = tgt
-        if self.normalize_before:
-            tgt = self.norm3(tgt)
-        tgt = self.linear2(self.dropout(self.activation(self.linear1(tgt))))
-        tgt = residual + self.dropout3(tgt)
-        if not self.normalize_before:
-            tgt = self.norm3(tgt)
-        return tgt if cache is None else (tgt, (incremental_cache,
-                                                static_cache))
+        self_cache = cross_cache = None
+
+        def self_attention(x):
+            nonlocal self_cache
+            if cache is None:
+                return self.self_attn(x, x, x, tgt_mask)
+            y, self_cache = self.self_attn(x, x, x, tgt_mask, cache[0])
+            return y
+
+        def cross_attention(x):
+            nonlocal cross_cache
+            if cache is None:
+                return self.cross_attn(x, memory, memory, memory_mask)
+            y, cross_cache = self.cross_attn(x, memory, memory,
+                                             memory_mask, cache[1])
+            return y
+
+        pre = self.normalize_before
+        tgt = _residual(tgt, self_attention, self.norm1, self.dropout1, pre)
+        tgt = _residual(tgt, cross_attention, self.norm2, self.dropout2, pre)
+        tgt = _residual(tgt, self._ffn, self.norm3, self.dropout3, pre)
+        return tgt if cache is None else (tgt, (self_cache, cross_cache))
 
     def gen_cache(self, memory):
-        incremental_cache = self.self_attn.gen_cache(memory)
-        static_cache = self.cross_attn.gen_cache(
-            memory, memory, type=MultiHeadAttention.StaticCache)
-        return incremental_cache, static_cache
+        return (self.self_attn.gen_cache(memory),
+                self.cross_attn.gen_cache(
+                    memory, memory, type=MultiHeadAttention.StaticCache))
 
 
-class TransformerDecoder(Layer):
-    def __init__(self, decoder_layer, num_layers, norm=None):
+def _clone_stack(layer, n):
+    """n copies of `layer` (the given instance is copy 0, like the
+    reference: the prototype joins the stack rather than being a dead
+    template)."""
+    return LayerList([layer] + [copy.deepcopy(layer) for _ in range(n - 1)])
+
+
+class _LayerStack(Layer):
+    """Shared encoder/decoder chassis: run the cloned layers in order,
+    threading per-layer caches when decoding, then the optional final
+    norm."""
+
+    def __init__(self, layer, num_layers, norm=None):
         super().__init__()
-        self.layers = LayerList([decoder_layer] + [
-            copy.deepcopy(decoder_layer) for _ in range(num_layers - 1)])
+        self.layers = _clone_stack(layer, num_layers)
         self.num_layers = num_layers
         self.norm = norm
 
+    def _run(self, x, per_layer_args, cache):
+        updated = []
+        for i, layer in enumerate(self.layers):
+            if cache is None:
+                x = layer(x, *per_layer_args)
+            else:
+                x, c = layer(x, *per_layer_args, cache[i])
+                updated.append(c)
+        if self.norm is not None:
+            x = self.norm(x)
+        return x if cache is None else (x, updated)
+
+
+class TransformerEncoder(_LayerStack):
+    def forward(self, src, src_mask=None, cache=None):
+        return self._run(src, (src_mask,), cache)
+
+    def gen_cache(self, src):
+        return [layer.gen_cache(src) for layer in self.layers]
+
+
+class TransformerDecoder(_LayerStack):
     def forward(self, tgt, memory, tgt_mask=None, memory_mask=None,
                 cache=None):
-        output = tgt
-        new_caches = []
-        for i, mod in enumerate(self.layers):
-            if cache is None:
-                output = mod(output, memory, tgt_mask, memory_mask)
-            else:
-                output, new_cache = mod(output, memory, tgt_mask,
-                                        memory_mask, cache[i])
-                new_caches.append(new_cache)
-        if self.norm is not None:
-            output = self.norm(output)
-        return output if cache is None else (output, new_caches)
+        return self._run(tgt, (memory, tgt_mask, memory_mask), cache)
 
     def gen_cache(self, memory, do_zip=False):
-        cache = [layer.gen_cache(memory) for layer in self.layers]
-        if do_zip:
-            cache = list(zip(*cache))
-        return cache
+        caches = [layer.gen_cache(memory) for layer in self.layers]
+        return list(zip(*caches)) if do_zip else caches
 
 
 class Transformer(Layer):
@@ -282,26 +296,20 @@ class Transformer(Layer):
                  normalize_before=False, weight_attr=None, bias_attr=None,
                  custom_encoder=None, custom_decoder=None):
         super().__init__()
-        if custom_encoder is not None:
-            self.encoder = custom_encoder
-        else:
-            enc_layer = TransformerEncoderLayer(
-                d_model, nhead, dim_feedforward, dropout, activation,
-                attn_dropout, act_dropout, normalize_before, weight_attr,
-                bias_attr)
-            enc_norm = LayerNorm(d_model) if normalize_before else None
-            self.encoder = TransformerEncoder(enc_layer, num_encoder_layers,
-                                              enc_norm)
-        if custom_decoder is not None:
-            self.decoder = custom_decoder
-        else:
-            dec_layer = TransformerDecoderLayer(
-                d_model, nhead, dim_feedforward, dropout, activation,
-                attn_dropout, act_dropout, normalize_before, weight_attr,
-                bias_attr)
-            dec_norm = LayerNorm(d_model) if normalize_before else None
-            self.decoder = TransformerDecoder(dec_layer, num_decoder_layers,
-                                              dec_norm)
+        common = (dim_feedforward, dropout, activation, attn_dropout,
+                  act_dropout, normalize_before, weight_attr, bias_attr)
+        if custom_encoder is None:
+            custom_encoder = TransformerEncoder(
+                TransformerEncoderLayer(d_model, nhead, *common),
+                num_encoder_layers,
+                LayerNorm(d_model) if normalize_before else None)
+        if custom_decoder is None:
+            custom_decoder = TransformerDecoder(
+                TransformerDecoderLayer(d_model, nhead, *common),
+                num_decoder_layers,
+                LayerNorm(d_model) if normalize_before else None)
+        self.encoder = custom_encoder
+        self.decoder = custom_decoder
         self.d_model = d_model
         self.nhead = nhead
 
@@ -312,7 +320,8 @@ class Transformer(Layer):
 
     @staticmethod
     def generate_square_subsequent_mask(length):
-        import numpy as _np
+        import numpy as np
         from ...framework.tensor import to_tensor
-        mask = _np.triu(_np.full((length, length), -_np.inf, _np.float32), 1)
-        return to_tensor(mask)
+        strictly_upper = np.triu(np.ones((length, length), bool), 1)
+        return to_tensor(np.where(strictly_upper, -np.inf,
+                                  0.0).astype(np.float32))

@@ -376,10 +376,16 @@ def multislice_grad_sync(grads, axis_name: str = "slice",
         pairs = tree.tree_map(
             lambda g, r: dgc_psum(g, r, axis_name, k_frac=k_frac),
             grads, residuals)
-        synced = tree.tree_map(lambda p: p[0], pairs,
-                               is_leaf=lambda x: isinstance(x, tuple))
-        new_res = tree.tree_map(lambda p: p[1], pairs,
-                                is_leaf=lambda x: isinstance(x, tuple))
+        # structural unzip: `pairs` has the grads tree's structure with a
+        # (synced, residual) 2-tuple at every LEAF position. A tuple
+        # is_leaf sniff would misfire when the grads pytree itself
+        # contains tuples (e.g. the tuple jax.grad(..., argnums=(0, 1))
+        # returns) and silently hand one leaf's residual out as another
+        # leaf's gradient; tree_transpose flips outer/inner by structure
+        # instead, so container tuples are never mistaken for pairs.
+        synced, new_res = tree.tree_transpose(
+            tree.tree_structure(grads), tree.tree_structure((0, 0)),
+            pairs)
         return synced, new_res
     if strategy is not None and getattr(strategy, "fp16_allreduce",
                                         False):

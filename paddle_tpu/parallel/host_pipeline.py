@@ -30,6 +30,7 @@ tools/ab_pipeline.py (results in perf/pipeline_ab.json):
 """
 from __future__ import annotations
 
+import functools
 from typing import Callable, Dict, List
 
 import jax
@@ -78,15 +79,26 @@ class HostPipeline:
 
         @jax.jit
         def fwd(params, x):
+            # x is NOT donated: the same buffer is held in `acts` until
+            # this microbatch's backward replays the stage
             return stage_fn(params, x)
 
-        @jax.jit
+        # dy is consumed at its only use, so its buffer is donated and
+        # dx aliases it (same shape/dtype for equal-width stages) —
+        # one fewer activation-sized live buffer per in-flight backward.
+        # x is NOT donated even though acts has popped it: for chunk 0
+        # the device_put in issue_fwd is a no-op when the microbatch
+        # already lives on stage 0, so the saved activation IS the
+        # caller's input buffer and donating it would invalidate x_mb
+        # between steps. params stay undonated (reused every microbatch).
+        @functools.partial(jax.jit, donate_argnums=(2,))
         def bwd(params, x, dy):
             # recompute-in-backward: vjp replays the stage forward
             _, pull = jax.vjp(stage_fn, params, x)
             return pull(dy)
 
-        @jax.jit
+        # y (the last stage's output) is consumed here; dy aliases it
+        @functools.partial(jax.jit, donate_argnums=(0,))
         def loss_and_grad(y):
             return jax.value_and_grad(loss_fn)(y)
 

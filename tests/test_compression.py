@@ -219,3 +219,43 @@ class TestMultisliceGradSync:
         np.testing.assert_allclose(
             np.asarray(res).sum(0) + want, np.asarray(g).sum(0),
             atol=1e-5)
+
+    def test_dgc_tuple_grads_pytree_not_corrupted(self, monkeypatch):
+        """Regression (round-5 advisor): a TUPLE-valued grads pytree —
+        the shape jax.grad(..., argnums=(0, 1)) returns — must unzip
+        STRUCTURALLY. The old is_leaf=isinstance(x, tuple) sniff treated
+        the outer container tuple as one (synced, residual) pair and
+        silently returned leaf A's residual as leaf B's gradient
+        (shapes matched, so training corrupted with no error). dgc_psum
+        is stubbed with a per-leaf marker transform so the unzip is
+        isolated from the collective (and from jax-version drift in the
+        axis primitives)."""
+        from paddle_tpu.parallel import compression
+        from paddle_tpu.parallel.fleet import multislice_grad_sync
+        monkeypatch.setattr(
+            compression, "dgc_psum",
+            lambda g, r, axis_name, k_frac: (g * 2.0, g + 100.0))
+        rng = np.random.RandomState(7)
+        ga = jnp.asarray(rng.randn(4, 3), jnp.float32)
+        gb = jnp.asarray(rng.randn(4, 3), jnp.float32)   # same shape: the
+        # old bug produced a same-shaped WRONG answer, not a crash
+
+        class S:
+            dgc = True
+            dgc_configs = {"sparsity": [0.75]}
+
+        synced, res = multislice_grad_sync((ga, gb), axis_name="slice",
+                                           strategy=S())
+        assert isinstance(synced, tuple) and len(synced) == 2
+        assert isinstance(res, tuple) and len(res) == 2
+        # each leaf's synced grad is ITS OWN transform (the old sniff
+        # returned (2*ga, ga+100) as the whole synced tree)...
+        np.testing.assert_allclose(np.asarray(synced[0]),
+                                   np.asarray(ga) * 2.0, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(synced[1]),
+                                   np.asarray(gb) * 2.0, atol=1e-6)
+        # ...and each residual is its own leaf's error-feedback state
+        np.testing.assert_allclose(np.asarray(res[0]),
+                                   np.asarray(ga) + 100.0, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(res[1]),
+                                   np.asarray(gb) + 100.0, atol=1e-6)

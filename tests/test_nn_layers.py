@@ -180,6 +180,21 @@ class TestCoreLayersNumeric:
         x = paddle.randn([2, 5, 16])
         assert mha(x).shape == [2, 5, 16]
 
+    def test_mha_omitted_value_defaults_to_query(self):
+        """Reference contract (python/paddle/nn/layer/transformer.py):
+        an omitted `value` falls back to QUERY, not to key — same
+        shapes either way, silently different numerics if confused."""
+        paddle.seed(5)
+        mha = nn.MultiHeadAttention(16, 4)
+        mha.eval()
+        q = paddle.randn([2, 5, 16])
+        k = paddle.randn([2, 5, 16])
+        got = mha(q, k)                       # value omitted
+        want = mha(q, k, q)                   # explicit value=query
+        np.testing.assert_allclose(got.numpy(), want.numpy(), atol=1e-6)
+        other = mha(q, k, k)
+        assert np.abs(got.numpy() - other.numpy()).max() > 1e-4
+
     def test_transformer_full(self):
         model = nn.Transformer(d_model=16, nhead=2, num_encoder_layers=1,
                                num_decoder_layers=1, dim_feedforward=32)

@@ -78,6 +78,35 @@ class TestAdmission:
             assert np.all(np.asarray(never.pull(np.array([4]))) == 0)
         assert len(never) == 0
 
+    def test_probability_entry_is_memoryless(self):
+        """Reference PS creation attempts keep no rejection state: each
+        sighting of an unadmitted id draws afresh, so (a) a single-
+        sighting population admits at ~p, and (b) a feature sighted k
+        times admits with probability 1-(1-p)^k — a frequent feature
+        cannot be locked out of the table forever by one unlucky draw
+        (the old permanent rejected-id memo did exactly that)."""
+        p = 0.3
+        emb = HostShardedEmbedding(2, entry=ProbabilityEntry(p), seed=3)
+        n = 4000
+        # (a) one sighting each: admission rate ~ p
+        emb.pull(np.arange(n))
+        rate1 = len(emb) / n
+        assert abs(rate1 - p) < 0.03, rate1
+        # (b) re-sight the SAME population: the ~(1-p)n rejected ids get
+        # fresh draws, so the cumulative rate climbs toward 1-(1-p)^2
+        emb.pull(np.arange(n))
+        rate2 = len(emb) / n
+        want2 = 1.0 - (1.0 - p) ** 2
+        assert abs(rate2 - want2) < 0.03, (rate2, want2)
+        # (c) long run: a persistent feature is admitted almost surely
+        stubborn = HostShardedEmbedding(2, entry=ProbabilityEntry(p),
+                                        seed=4)
+        for _ in range(60):                    # P(all miss) = 0.7^60
+            stubborn.pull(np.array([7]))
+            if len(stubborn):
+                break
+        assert len(stubborn) == 1
+
 
 class TestTraining:
     def test_ctr_style_loss_decreases(self):

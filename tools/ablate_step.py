@@ -150,8 +150,8 @@ def build(cfg_kw, batch=8, seq=1024):
 
 def step_ms(cfg, params, opt, toks, iters=10):
     from paddle_tpu.models.gpt import train_step
-    step = jax.jit(functools.partial(train_step, cfg=cfg, lr=1e-4),
-                   donate_argnums=(0, 1))
+    from paddle_tpu.models.facade import make_train_step
+    step = make_train_step(train_step, cfg=cfg, lr=1e-4)
     t0 = time.perf_counter()
     loss, params, opt = step(params, opt, toks)
     float(loss)

@@ -101,7 +101,9 @@ def run_row(row: str) -> None:
             tokens, -100)
         batch = {"tokens": tokens, "labels": labels}
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        from paddle_tpu.models.facade import make_train_step
+
+        @make_train_step
         def step(params, opt_state, batch):
             loss, g = jax.value_and_grad(
                 functools.partial(bert_mlm_loss, cfg=cfg))(params, batch)
@@ -182,7 +184,9 @@ def run_row(row: str) -> None:
                                         (B, 3, 224, 224), jnp.float32),
         }
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        from paddle_tpu.models.facade import make_train_step
+
+        @make_train_step
         def step(params, opt_state, batch):
             loss, g = jax.value_and_grad(functools.partial(
                 contrastive_loss, cfg=cfg))(params, batch)

@@ -4,46 +4,18 @@ block_until_ready can return early over the axon tunnel, so results are
 forced with a host scalar pull (see CLAUDE.md / bench.py)."""
 from __future__ import annotations
 
-import os
 import time
 
 import jax
 import numpy as np
 
-# Roofline anchors for the plausibility gate (v5-litepod class defaults;
-# override via env for other parts).
-PEAK_BF16_TFLOPS = float(os.environ.get("PADDLE_TPU_PEAK_TFLOPS", "197"))
-PEAK_HBM_GBS = float(os.environ.get("PADDLE_TPU_PEAK_HBM_GBS", "819"))
-# Below these effective rates a kernel-sized timing is measuring the
-# tunnel/host, not the chip — the round-4 sweep persisted CE rows at
-# 3.4-7.9 s for a ~15 ms kernel, which this floor rejects.
-FLOOR_TFLOPS = 0.5
-FLOOR_GBS = 20.0
-
-
-def plausible_ms(flops: float = 0.0, bytes_moved: float = 0.0):
-    """Physical window (lo_ms, hi_ms) for ONE application of a kernel of
-    known arithmetic/memory volume. lo = half the roofline time (nothing
-    runs 2x faster than the roofline); hi = the time implied by the
-    FLOOR_* effective rates (anything slower is a measurement artifact,
-    not a slow kernel)."""
-    lo_s = max(flops / (PEAK_BF16_TFLOPS * 1e12),
-               bytes_moved / (PEAK_HBM_GBS * 1e9)) / 2.0
-    hi_s = max(flops / (FLOOR_TFLOPS * 1e12),
-               bytes_moved / (FLOOR_GBS * 1e9), 1e-6)
-    return lo_s * 1e3, hi_s * 1e3
-
-
-def gate_ms(ms: float, flops: float = 0.0, bytes_moved: float = 0.0):
-    """None if `ms` is physically plausible for the given volumes, else a
-    short reason string for the record."""
-    lo, hi = plausible_ms(flops, bytes_moved)
-    if ms < lo:
-        return f"implausibly fast: {ms:.3f} ms < {lo:.3f} ms (2x roofline)"
-    if ms > hi:
-        return (f"implausibly slow: {ms:.3f} ms > {hi:.1f} ms "
-                "(sub-floor effective rate; likely RTT/host-bound)")
-    return None
+# The roofline plausibility gate moved into the package
+# (paddle_tpu/kernels/registry.py) so the kernel-selection registry's
+# adoption path and the tools share ONE rule; re-exported here for the
+# existing tool callers.
+from paddle_tpu.kernels.registry import (  # noqa: F401
+    FLOOR_GBS, FLOOR_TFLOPS, PEAK_BF16_TFLOPS, PEAK_HBM_GBS, gate_ms,
+    plausible_ms)
 
 
 def force(out):

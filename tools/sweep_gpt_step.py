@@ -130,7 +130,7 @@ def run_one(spec: dict) -> None:
     (all kernel gates re-read env per trace)."""
     import jax
     import jax.numpy as jnp
-    import functools
+
     from paddle_tpu.models.gpt import (GPTConfig, init_gpt_params,
                                        init_opt_state, train_step)
     snapshot = dict(os.environ)
@@ -148,8 +148,8 @@ def run_one(spec: dict) -> None:
         opt_state = init_opt_state(params)
         tokens = jax.random.randint(jax.random.PRNGKey(1),
                                     (batch, seq + 1), 0, cfg.vocab_size)
-        step = jax.jit(functools.partial(train_step, cfg=cfg, lr=1e-4),
-                       donate_argnums=(0, 1))
+        from paddle_tpu.models.facade import make_train_step
+        step = make_train_step(train_step, cfg=cfg, lr=1e-4)
         t0 = time.perf_counter()
         loss, params, opt_state = step(params, opt_state, tokens)
         float(loss)

@@ -162,12 +162,34 @@ def run_job(name, argv, timeout_s, env_extra, window_dir) -> dict:
             "json_lines": recs, "out": out_path}
 
 
+def _sweep_step_flops(spec: dict, row: dict) -> float:
+    """Approximate train-step arithmetic volume for one sweep row —
+    the input the plausibility gate needs. Analytic param count from
+    the sweep's model dims (6N flops/token + the attention score/context
+    matmul terms, matching bench.py's MFU accounting); precision well
+    inside the gate's 2x-roofline..sub-floor window."""
+    import sweep_gpt_step as sw
+    m = {**sw.MODEL, **(spec.get("model") or {})}
+    h, L = m["hidden_size"], m["num_layers"]
+    seq = int(spec.get("seq", sw.SEQ))
+    batch = int(row.get("batch") or spec.get("batch") or sw.BATCH)
+    n_params = m["vocab_size"] * h + m["max_seq_len"] * h + 12 * L * h * h
+    return (6.0 * n_params + 12.0 * L * h * seq) * batch * seq
+
+
 def adopt_sweep_winner(json_lines: list, window_ts: str) -> None:
     """Self-executing adoption (round-5): when the sweep lands, persist
     the best tokens/sec variant with its full spec to
-    perf/sweep_winner.json. kernels.flash_attention._attn_impl and the
-    bench race consult it, so the measured winner becomes the shipped
-    default without waiting for a human to read the window artifact."""
+    perf/sweep_winner.json AND the kernel-selection registry.
+    kernels.flash_attention._attn_impl and the bench race consult these,
+    so the measured winner becomes the shipped default without waiting
+    for a human to read the window artifact.
+
+    ADOPTION IS EVIDENCE-GATED (ADVICE round-5 item 3): the winning
+    row's ms_per_step must sit inside the physical window implied by the
+    step's arithmetic volume (registry.gate_ms), so a tunnel-artifact
+    timing — implausibly fast clock skew or an RTT-dominated slow row —
+    can never ship as the default."""
     try:
         rows = [r for r in json_lines
                 if isinstance(r, dict) and r.get("tokens_per_sec")
@@ -179,6 +201,13 @@ def adopt_sweep_winner(json_lines: list, window_ts: str) -> None:
         from sweep_gpt_step import _specs
         spec = next((s for s in _specs() if s["name"] == best["name"]),
                     {})
+        from paddle_tpu.kernels import registry
+        flops = _sweep_step_flops(spec, best)
+        reason = registry.gate_ms(float(best["ms_per_step"]), flops=flops)
+        if reason:
+            log(f"sweep winner {best['name']} REJECTED by the "
+                f"plausibility gate ({reason}); NOT adopting")
+            return
         doc = {
             "name": best["name"],
             "tokens_per_sec": best["tokens_per_sec"],
@@ -188,6 +217,7 @@ def adopt_sweep_winner(json_lines: list, window_ts: str) -> None:
             "remat": spec.get("remat"),
             "policy": spec.get("policy"),
             "window": window_ts,
+            "gate": {"flops": flops, "passed": True},
         }
         path = os.path.join(PERF, "sweep_winner.json")
         tmp = f"{path}.tmp{os.getpid()}"
@@ -196,6 +226,23 @@ def adopt_sweep_winner(json_lines: list, window_ts: str) -> None:
         os.replace(tmp, path)
         log(f"adopted sweep winner {best['name']} "
             f"({best['tokens_per_sec']} tok/s) -> perf/sweep_winner.json")
+        # persist the attention impl into the registry too (the durable
+        # per-backend-class table consulted when no fresh sweep file is
+        # around); adopt() re-runs the same gate before writing
+        from paddle_tpu.kernels.flash_attention import impl_from_winner_env
+        impl = impl_from_winner_env(spec.get("env", {}))
+        if impl:
+            seq = int(spec.get("seq", 0) or 1024)
+            err = registry.adopt(
+                "attention", impl, ms=float(best["ms_per_step"]),
+                flops=flops, backend="tpu",
+                bucket=registry.seq_bucket(seq),
+                source=f"sweep {best['name']} "
+                       f"({best['tokens_per_sec']} tok/s)",
+                window=window_ts,
+                path=os.path.join(PERF, "kernel_registry.json"))
+            log(f"registry adoption: attention::tpu -> {impl}"
+                + (f" REJECTED ({err})" if err else ""))
     except Exception as e:
         log(f"sweep winner adoption failed (non-fatal): {e!r}")
 
