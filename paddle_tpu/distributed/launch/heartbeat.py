@@ -19,6 +19,19 @@ _stop = threading.Event()
 
 ENV_FILE = "PADDLE_HEARTBEAT_FILE"
 ENV_INTERVAL = "PADDLE_HEARTBEAT_INTERVAL"
+# "1" -> the beat thread is NOT started; only explicit pulse() calls
+# touch the lease. With the resilient step loop pulsing per committed
+# step, --hang_timeout then measures STEP progress (a hung dispatch goes
+# stale even though the process is alive) instead of thread liveness.
+ENV_STEP_MODE = "PADDLE_HEARTBEAT_STEP_MODE"
+
+# The elastic-protocol exit code (reference fleet/elastic/manager.py:30
+# ELASTIC_EXIT_CODE = 101): a worker exiting with this code is asking the
+# launcher for a restart-and-resume (it will reload from the checkpoint
+# LATEST pointer), distinct from a crash that burns the failure budget.
+# Lives here — not in launch/main or parallel/resilience — because this is
+# the one liveness module both the controller and the worker import.
+ELASTIC_EXIT_CODE = 101
 
 
 def _touch(path: str) -> None:
@@ -36,6 +49,12 @@ def start_from_env() -> bool:
     path = os.environ.get(ENV_FILE)
     if not path or (_thread is not None and _thread.is_alive()):
         return _thread is not None
+    if os.environ.get(ENV_STEP_MODE) == "1":
+        # step mode: the first touch covers boot; after that only
+        # pulse() (per committed step) keeps the lease fresh
+        _stop.clear()
+        _touch(path)
+        return True
     interval = float(os.environ.get(ENV_INTERVAL, "1.0"))
     _stop.clear()
     _touch(path)
@@ -54,3 +73,15 @@ def stop() -> None:
     """Stop beating (the controller will see this worker as hung after
     its --hang_timeout)."""
     _stop.set()
+
+
+def pulse() -> None:
+    """Touch the lease file immediately. The resilient step loop calls
+    this per completed step; under ENV_STEP_MODE (launcher
+    --step_heartbeat) it is the ONLY thing refreshing the lease, so the
+    controller's staleness clock tracks step progress directly and a
+    hung dispatch trips --hang_timeout even though the process (and the
+    default mode's beat thread) is alive."""
+    path = os.environ.get(ENV_FILE)
+    if path and not _stop.is_set():
+        _touch(path)

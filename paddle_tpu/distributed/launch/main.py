@@ -41,6 +41,8 @@ import sys
 import time
 from typing import List, Optional
 
+from .heartbeat import ELASTIC_EXIT_CODE
+
 
 def _parse_args(argv=None):
     p = argparse.ArgumentParser(
@@ -74,6 +76,19 @@ def _parse_args(argv=None):
     p.add_argument("--heartbeat_interval", type=float, default=1.0,
                    help="worker heartbeat period when --hang_timeout is "
                         "set")
+    p.add_argument("--step_heartbeat", action="store_true",
+                   help="liveness tracks STEP progress: no background "
+                        "beat thread; only the resilient step loop's "
+                        "per-step pulse refreshes the lease, so a hung "
+                        "dispatch goes stale after --hang_timeout even "
+                        "while the process lives (size the timeout for "
+                        "boot + compile + slowest step)")
+    p.add_argument("--max_elastic_restart", type=int, default=16,
+                   help="restarts granted to workers that exit with the "
+                        "elastic protocol code "
+                        f"({ELASTIC_EXIT_CODE}: 'restart me, I will "
+                        "resume from my checkpoint') — budgeted "
+                        "separately from --max_restart crash restarts")
     p.add_argument("--min_procs", type=int, default=0,
                    help="scale-down floor: after restarts are exhausted, "
                         "relaunch with one fewer local worker down to "
@@ -177,6 +192,8 @@ def _popen(args, lr, out) -> _Worker:
             pass
         env["PADDLE_HEARTBEAT_FILE"] = hb_path
         env["PADDLE_HEARTBEAT_INTERVAL"] = str(args.heartbeat_interval)
+        if args.step_heartbeat:
+            env["PADDLE_HEARTBEAT_STEP_MODE"] = "1"
     if args.devices == "cpu" or hb_path:
         # route through the bootstrap: the CPU pin must happen in-process
         # (a TPU PJRT plugin can override JAX_PLATFORMS — see
@@ -247,10 +264,13 @@ def launch(argv: Optional[List[str]] = None) -> int:
     """Programmatic entry (returns the job's exit code)."""
     args = _parse_args(argv)
     attempt = 0
+    elastic = 0
     while True:
         if attempt:
-            print(f"[launch] elastic restart {attempt}/{args.max_restart}",
-                  file=sys.stderr, flush=True)
+            # crash-budget restarts; rc=ELASTIC_EXIT_CODE restarts print
+            # their own distinctly-worded line below
+            print(f"[launch] pod restart {attempt}/{args.max_restart} "
+                  f"(crash budget)", file=sys.stderr, flush=True)
         rc = _wait(_spawn(args), args.hang_timeout)
         if rc == 0:
             return 0
@@ -258,6 +278,17 @@ def launch(argv: Optional[List[str]] = None) -> int:
             # launcher-level interrupt is not a worker failure — never
             # restart it (a worker's own exit 130 still restarts)
             return 130
+        if rc == ELASTIC_EXIT_CODE and elastic < args.max_elastic_restart:
+            # the worker ASKED for this restart (resilience watchdog: a
+            # hung step it will recover from by resuming at the LATEST
+            # snapshot) — reference ELASTIC_EXIT_CODE=101 protocol,
+            # fleet/elastic/manager.py:30. Budgeted separately so tunnel
+            # flaps don't consume the crash-restart budget.
+            elastic += 1
+            print(f"[launch] worker requested elastic restart "
+                  f"({elastic}/{args.max_elastic_restart}, "
+                  f"rc={ELASTIC_EXIT_CODE})", file=sys.stderr, flush=True)
+            continue
         if attempt >= args.max_restart:
             if (args.min_procs > 0
                     and args.nnodes == 1

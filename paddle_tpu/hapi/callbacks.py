@@ -130,20 +130,55 @@ def _fmt(v):
 
 class ModelCheckpoint(Callback):
     """Save model/optimizer every `save_freq` epochs (reference
-    ModelCheckpoint)."""
+    ModelCheckpoint), with the fault-tolerance runtime's retention
+    semantics: `max_to_keep` prunes old epoch checkpoints (0 keeps all —
+    the reference behavior) and a `LATEST` pointer file is atomically
+    updated after each save so a restarted job can find the newest
+    epoch without globbing. NOTE: the pointer names an epoch FILE PREFIX
+    (`"3"` -> `3.pdparams`), not a snapshot directory — read it directly
+    rather than via checkpoint.read_latest (which resolves dirs)."""
 
-    def __init__(self, save_freq=1, save_dir=None):
+    def __init__(self, save_freq=1, save_dir=None, max_to_keep=0):
         super().__init__()
         self.save_freq = save_freq
         self.save_dir = save_dir
+        self.max_to_keep = int(max_to_keep)
 
     def on_epoch_end(self, epoch, logs=None):
         if self.save_dir and self.model and (epoch + 1) % self.save_freq == 0:
             self.model.save(f"{self.save_dir}/{epoch}")
+            self._point_latest(str(epoch))
+            self._prune()
 
     def on_train_end(self, logs=None):
         if self.save_dir and self.model:
             self.model.save(f"{self.save_dir}/final")
+
+    def _point_latest(self, name):
+        import os
+        from ..parallel.checkpoint import _atomic_write
+        _atomic_write(os.path.join(self.save_dir, "LATEST"), name + "\n")
+
+    def _epochs_on_disk(self):
+        import os
+        out = []
+        for fname in os.listdir(self.save_dir):
+            base, ext = os.path.splitext(fname)
+            if ext == ".pdparams" and base.isdigit():
+                out.append(int(base))
+        return sorted(out)
+
+    def _prune(self):
+        import os
+        if self.max_to_keep <= 0:
+            return
+        for epoch in self._epochs_on_disk()[:-self.max_to_keep]:
+            for ext in (".pdparams", ".pdopt"):
+                try:
+                    os.remove(os.path.join(self.save_dir,
+                                           f"{epoch}{ext}"))
+                except OSError:
+                    pass
 
 
 class EarlyStopping(Callback):

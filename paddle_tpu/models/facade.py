@@ -26,7 +26,11 @@ def make_train_step(step_fn, cfg=None, donate=True, **step_kw):
     ONE home for the pattern: bench.py, the sweep/ablation tools and the
     examples all jitted `functools.partial(train_step, cfg=cfg, ...)`
     with hand-rolled donation; they now build their step here so the
-    donation (and any future jit policy) cannot drift per caller."""
+    donation (and any future jit policy) cannot drift per caller.
+    `parallel.resilience.make_resilient_step` layers the fault-tolerance
+    guard (non-finite skip-step + rollback/watchdog plumbing) over this
+    same builder — use it instead when the loop must survive NaNs, hung
+    dispatch, or restarts (docs/fault_tolerance.md)."""
     import functools
     import jax
     if cfg is not None:

@@ -17,6 +17,13 @@ from .collective import (  # noqa: F401
     reduce_scatter, all_to_all, send, recv, new_group, get_group, wait,
     psum, pmean, pmax, ppermute, axis_index)
 from .data_parallel import DataParallel  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    save_sharded, load_sharded, save_train_state, load_train_state,
+    verify_checkpoint, CheckpointManager, CheckpointCorruptError,
+    Converter)
+# NOTE: .resilience is NOT imported here — it imports
+# distributed.launch.heartbeat, and distributed/__init__ imports this
+# package; import it directly (paddle_tpu.parallel.resilience).
 from .sharding import (  # noqa: F401
     group_sharded_parallel, save_group_sharded_model, GroupShardedStage2,
     GroupShardedStage3, GroupShardedOptimizerStage2, shard_model_stage3,

@@ -1,0 +1,332 @@
+"""Resilient training step loop: non-finite skip, rollback, watchdog.
+
+Reference analog: the ElasticManager fault watch + restart protocol
+(/root/reference/python/paddle/distributed/fleet/elastic/manager.py:124,
+exit codes manager.py:30-31) and the AMP GradScaler's found_inf
+skip-update semantics (amp/grad_scaler.py here generalizes the same
+guard to ANY train step, not just scaled ones). The reference has no
+step-level watchdog or automatic rollback; this module exceeds it
+because our hardware path (the flapping TPU tunnel, CLAUDE.md) makes a
+hung dispatch an expected fault, not an anomaly.
+
+Three guards compose around `models.facade.make_train_step`:
+
+- **skip-step**: the jitted step returns `(loss, params', opt', ok)`
+  where `ok = isfinite(loss)`; when not ok the new params/opt trees are
+  replaced IN-JIT by the old ones (`jnp.where` select, so donation stays
+  legal), i.e. a non-finite step is a no-op update — the GradScaler
+  found_inf pattern without a scaler.
+- **rollback**: after `rollback_after` consecutive skipped steps the
+  trainer reloads the newest intact snapshot from its CheckpointManager
+  (checksum-verified, falls back past corrupt ones) and rewinds its step
+  counter — divergence that a skip cannot absorb gets cut at the last
+  good state.
+- **watchdog**: host pulls of the step's results run under a wall-clock
+  budget with bounded retry + exponential backoff (a tunnel flap stalls
+  ANY pull for minutes; re-polling the same future is the only safe
+  retry since donated buffers cannot be re-dispatched). When the budget
+  is exhausted the worker exits with ELASTIC_EXIT_CODE (101, the
+  reference's elastic protocol) so the launcher restarts the pod and
+  the restarted process resumes from the LATEST pointer.
+"""
+from __future__ import annotations
+
+import functools
+import sys
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from .checkpoint import _UNSET, CheckpointManager
+from ..distributed.launch.heartbeat import ELASTIC_EXIT_CODE  # noqa: F401
+
+# Fault-injection seam (paddle_tpu.testing.faults): called with the step
+# index about to run; returns a loss multiplier (1.0, or nan to poison)
+# and may side-effect (kill the process, stall the heartbeat). Production
+# code never sets it.
+_STEP_HOOK: Optional[Callable[[int], float]] = None
+
+
+class StepHungError(RuntimeError):
+    """A device->host pull outlived the watchdog budget (hung dispatch —
+    on this hardware usually the TPU tunnel flapping)."""
+
+
+@dataclass
+class ResilienceConfig:
+    """Knobs for ResilientTrainer (defaults are safe-but-lenient)."""
+    rollback_after: int = 3        # consecutive skipped steps -> rollback
+    max_rollbacks: int = 5         # give up (raise) after this many
+    checkpoint_every: int = 0      # steps between snapshots (0 = manual)
+    watchdog_timeout: float = 0.0  # seconds per host pull (0 = no watchdog)
+    retries: int = 3               # extra backoff waits after the timeout
+    backoff_base: float = 2.0      # first retry wait, doubling each retry
+    backoff_max: float = 60.0      # per-retry wait ceiling
+    exit_on_hang: bool = False     # sys.exit(ELASTIC_EXIT_CODE) on hang
+
+
+def make_resilient_step(step_fn, cfg=None, donate: bool = True, **step_kw):
+    """Build the guarded jitted step:
+    `(params, opt_state, batch, poison) -> (loss, params', opt', ok)`.
+
+    `step_fn(params, opt_state, batch, ...) -> (loss, new_params,
+    new_opt)` is the same contract `models.facade.make_train_step` takes;
+    params/opt buffers are donated identically. `poison` is a loss
+    multiplier (normally 1.0) that the chaos harness sets to nan —
+    multiplying INSIDE the jit means injected and organic non-finite
+    losses exercise the exact same guard. `ok` requires the loss AND
+    every updated param/opt leaf to be finite (a backward pass can
+    overflow while the loss is still finite — committing, let alone
+    snapshotting, NaN params would defeat rollback); when not ok the
+    returned trees are the (unchanged) inputs and the returned loss is
+    nan, so ONE host pull of the loss communicates both values."""
+    import jax
+    import jax.numpy as jnp
+    from ..models.facade import make_train_step
+    if cfg is not None:
+        step_kw["cfg"] = cfg
+    inner = functools.partial(step_fn, **step_kw) if step_kw else step_fn
+
+    def tree_finite(tree):
+        fin = jnp.asarray(True)
+        for leaf in jax.tree_util.tree_leaves(tree):
+            if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.inexact):
+                fin &= jnp.all(jnp.isfinite(leaf))
+        return fin
+
+    def guarded(params, opt_state, batch, poison):
+        loss, new_params, new_opt = inner(params, opt_state, batch)
+        loss = loss * poison
+        ok = (jnp.isfinite(loss) & tree_finite(new_params)
+              & tree_finite(new_opt))
+
+        def keep(new, old):
+            return jnp.where(ok, new, old)
+
+        new_params = jax.tree_util.tree_map(keep, new_params, params)
+        new_opt = jax.tree_util.tree_map(keep, new_opt, opt_state)
+        return jnp.where(ok, loss, jnp.nan), new_params, new_opt, ok
+
+    # the facade owns the jit/donation policy (ONE home — see
+    # models/facade.py); the guard only adds the select + ok flag
+    return make_train_step(guarded, donate=donate)
+
+
+def pull_with_watchdog(value, timeout: float, retries: int = 3,
+                       backoff_base: float = 2.0,
+                       backoff_max: float = 60.0,
+                       label: str = "step") -> np.ndarray:
+    """Force `value` to a host array under a wall-clock budget.
+
+    `jax.block_until_ready` can return early over the tunnel (CLAUDE.md),
+    so forcing is a real `np.asarray` pull, run in a worker thread. The
+    first wait is `timeout`; each of `retries` further waits doubles from
+    `backoff_base` (capped at `backoff_max`) — re-polling the SAME pending
+    future, because with donated input buffers a re-dispatch is illegal.
+    Raises StepHungError when the budget is exhausted."""
+    if timeout <= 0:
+        return np.asarray(value)
+    box: dict = {}
+
+    def work():
+        try:
+            box["val"] = np.asarray(value)
+        except BaseException as e:          # surfaced to the caller
+            box["err"] = e
+
+    t = threading.Thread(target=work, name="paddle-watchdog-pull",
+                         daemon=True)
+    t.start()
+    waited = 0.0
+    for attempt in range(retries + 1):
+        grace = timeout if attempt == 0 else min(
+            backoff_base * (2.0 ** (attempt - 1)), backoff_max)
+        t.join(grace)
+        waited += grace
+        if not t.is_alive():
+            break
+        if attempt < retries:
+            print(f"[resilience] {label} pull stalled {waited:.1f}s "
+                  f"(attempt {attempt + 1}/{retries + 1}); backing off",
+                  file=sys.stderr, flush=True)
+    if t.is_alive():
+        raise StepHungError(
+            f"{label} result did not arrive within {waited:.1f}s "
+            f"(watchdog {timeout}s + {retries} backoff retries) — hung "
+            f"dispatch (tunnel flap?)")
+    if "err" in box:
+        raise box["err"]
+    return box["val"]
+
+
+class ResilientTrainer:
+    """Owns (params, opt_state, step) and runs guarded steps with
+    skip/rollback/watchdog + heartbeat + periodic snapshots.
+
+    Typical wiring (the chaos drill's worker is the executable version):
+
+        mgr = CheckpointManager(ckpt_root, max_to_keep=3)
+        tr = ResilientTrainer(train_step, params, opt_state, cfg=cfg,
+                              manager=mgr,
+                              config=ResilienceConfig(checkpoint_every=1))
+        tr.maybe_resume()            # restart -> continue from LATEST
+        while tr.step < total:
+            loss, ok = tr.train_step(batch_for(tr.step))
+    """
+
+    def __init__(self, step_fn, params, opt_state, *, cfg=None,
+                 manager: Optional[CheckpointManager] = None,
+                 config: Optional[ResilienceConfig] = None,
+                 step: int = 0, donate: bool = True, mesh=_UNSET,
+                 specs=None, **step_kw):
+        self.config = config or ResilienceConfig()
+        # restore layout: rollback must reload onto the SAME mesh/specs
+        # the trainer resumed/trained with, not whatever mesh is ambient
+        # at rollback time
+        self._mesh = mesh
+        self._specs = specs
+        self._guarded = make_resilient_step(step_fn, cfg=cfg,
+                                            donate=donate, **step_kw)
+        self.params = params
+        self.opt_state = opt_state
+        self.step = int(step)
+        self.manager = manager
+        self.skipped = 0
+        self.rollbacks = 0
+        self._bad_streak = 0
+        # liveness: no-op unless the launcher exported the contract
+        from ..distributed.launch import heartbeat
+        heartbeat.start_from_env()
+        self._heartbeat = heartbeat
+
+    # ------------------------------------------------------------- resume
+    def maybe_resume(self, mesh=_UNSET, specs=None) -> bool:
+        """Load the newest intact snapshot (LATEST-pointed first) if one
+        exists; returns True when state was restored. An explicit
+        `mesh`/`specs` here also becomes the layout rollbacks reload
+        onto."""
+        if self.manager is None:
+            return False
+        if mesh is not _UNSET:
+            self._mesh = mesh
+        if specs is not None:
+            self._specs = specs
+        state, step = self.manager.restore(mesh=self._mesh,
+                                           specs=self._specs)
+        if state is None:
+            return False
+        self.params = state["params"]
+        self.opt_state = state.get("opt_state", self.opt_state)
+        saved = state.get("step")
+        self.step = int(saved) if saved is not None else int(step or 0)
+        return True
+
+    # --------------------------------------------------------------- save
+    def save(self) -> Optional[str]:
+        if self.manager is None:
+            return None
+        return self.manager.save(
+            {"params": self.params, "opt_state": self.opt_state,
+             "step": np.int64(self.step)}, self.step)
+
+    # --------------------------------------------------------------- step
+    def train_step(self, batch) -> tuple:
+        """Run one guarded step on `batch`. Returns `(loss, ok)` with
+        `loss` a host float (nan on a skipped step). Raises StepHungError
+        when the watchdog budget is exhausted and `exit_on_hang` is off;
+        exits with ELASTIC_EXIT_CODE when it is on. After a hang the
+        trainer's buffers are donated-away — a restarted process must
+        resume via `maybe_resume()`."""
+        c = self.config
+        poison = 1.0
+        if _STEP_HOOK is not None:
+            poison = _STEP_HOOK(self.step)
+        loss, params, opt, ok = self._guarded(
+            self.params, self.opt_state, batch, poison)
+        del ok                 # the guarded step folds every badness
+        #                        (non-finite loss OR params OR opt) into a
+        #                        nan loss, so ok derives from the one loss
+        #                        pull — a second device->host pull would
+        #                        cost another ~70-170 ms tunnel round trip
+        #                        per step AND could hang if the tunnel
+        #                        flaps between pulls
+        try:
+            loss_host = float(pull_with_watchdog(
+                loss, c.watchdog_timeout, c.retries, c.backoff_base,
+                c.backoff_max, label=f"step {self.step}"))
+        except StepHungError as e:
+            if c.exit_on_hang:
+                print(f"[resilience] {e}; exiting "
+                      f"{ELASTIC_EXIT_CODE} for elastic restart",
+                      file=sys.stderr, flush=True)
+                sys.exit(ELASTIC_EXIT_CODE)
+            raise
+        ok_host = bool(np.isfinite(loss_host))
+        self.params, self.opt_state = params, opt
+        self._heartbeat.pulse()
+        self.step += 1
+        if ok_host:
+            self._bad_streak = 0
+            if (self.manager is not None and c.checkpoint_every > 0
+                    and self.step % c.checkpoint_every == 0):
+                self.save()
+        else:
+            self.skipped += 1
+            self._bad_streak += 1
+            print(f"[resilience] non-finite loss at step "
+                  f"{self.step - 1}: update skipped "
+                  f"({self._bad_streak}/{c.rollback_after} before "
+                  f"rollback)", file=sys.stderr, flush=True)
+            if self._bad_streak >= c.rollback_after:
+                self._rollback()
+        return loss_host, ok_host
+
+    def _rollback(self) -> None:
+        if self.manager is None:
+            # nothing to roll back to: reset the streak so training can
+            # limp on with skips alone
+            self._bad_streak = 0
+            return
+        if self.rollbacks >= self.config.max_rollbacks:
+            raise RuntimeError(
+                f"resilience: {self.rollbacks} rollbacks exhausted and "
+                f"the loss is still non-finite — giving up")
+        state, step = self.manager.restore(mesh=self._mesh,
+                                           specs=self._specs)
+        if state is None:
+            # non-finite before the FIRST snapshot (bad init/LR, or a
+            # fault injected at step 0): dying here would turn a
+            # recoverable run into a crash that burns the launcher's
+            # restart budget — limp on with skips like the manager-less
+            # path and let max_rollbacks bound organic divergence later
+            print("[resilience] rollback requested but no snapshot "
+                  "exists yet; continuing with skip-only recovery",
+                  file=sys.stderr, flush=True)
+            self._bad_streak = 0
+            return
+        self.params = state["params"]
+        self.opt_state = state.get("opt_state", self.opt_state)
+        saved = state.get("step")
+        self.step = int(saved) if saved is not None else int(step or 0)
+        self.rollbacks += 1
+        self._bad_streak = 0
+        print(f"[resilience] rolled back to step {self.step} "
+              f"(rollback {self.rollbacks}/{self.config.max_rollbacks})",
+              file=sys.stderr, flush=True)
+
+
+def run_resilient(trainer: ResilientTrainer, batch_fn, total_steps: int,
+                  on_step: Optional[Callable[[int, float, bool], Any]]
+                  = None):
+    """Drive `trainer` to `total_steps`, fetching `batch_fn(step)` per
+    step (deterministic batches keyed by step index make post-rollback
+    re-runs bit-identical — the chaos drill relies on this). `on_step`
+    observes `(step_just_run, loss, ok)`."""
+    while trainer.step < total_steps:
+        step = trainer.step
+        loss, ok = trainer.train_step(batch_fn(step))
+        if on_step is not None:
+            on_step(step, loss, ok)
+    return trainer

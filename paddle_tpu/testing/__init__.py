@@ -1,0 +1,10 @@
+"""paddle_tpu.testing — deterministic fault injection for chaos drills.
+
+The reference has no systematic fault-injection harness (SURVEY.md
+§"Failure detection": only unit-level elastic tests under
+test/collective/fleet) — this package exceeds it. Production modules
+expose hook seams (parallel.checkpoint._SHARD_WRITE_HOOK,
+parallel.resilience._STEP_HOOK); `faults.install()` arms them from a
+declarative spec so the SAME binaries run clean or under chaos.
+"""
+from . import faults  # noqa: F401

@@ -31,6 +31,28 @@ def _seed_all():
     yield
 
 
+@pytest.fixture(autouse=True)
+def _checkpoint_write_audit():
+    """Integrity guard: every checkpoint save_sharded committed during a
+    test must pass manifest checksum verification at teardown — an
+    unchecksummed or torn write path cannot land silently. Tests that
+    corrupt checkpoints ON PURPOSE go through paddle_tpu.testing.faults
+    (whose corruptors call checkpoint.audit_forget)."""
+    import sys
+    mod = sys.modules.get("paddle_tpu.parallel.checkpoint")
+    if mod is not None:
+        mod._AUDIT.clear()
+    yield
+    mod = sys.modules.get("paddle_tpu.parallel.checkpoint")
+    if mod is None:
+        return
+    paths, mod._AUDIT[:] = list(mod._AUDIT), []
+    import os
+    for p in paths:
+        if os.path.isdir(p):
+            mod.verify_checkpoint(p)   # raises CheckpointCorruptError
+
+
 # ---------------------------------------------------------------- smoke tier
 # `pytest -m smoke` — a <5-minute slice covering every subsystem (the full
 # suite measures ~27 min on the 1-core build host). File-level membership:
@@ -57,6 +79,9 @@ SMOKE_FILES = {
     # high-level API + aux subsystems
     "test_hapi.py", "test_profiler.py", "test_checkpoint.py",
     "test_tokenizer.py", "test_misc_modules.py",
+    # fault-tolerance runtime (in-process; the subprocess chaos drills in
+    # test_chaos_drill.py stay full-suite-only)
+    "test_fault_tolerance.py", "test_checkpoint_edges.py",
 }
 
 

@@ -1,0 +1,81 @@
+"""Chaos drill subprocess scenarios: the elastic-lite launcher under
+injected faults on the 8-virtual-device CPU mesh.
+
+Reference analog: the elastic restart tests under test/collective/fleet
+— except the reference only restarts; these assert the RESUMED LOSS
+TRAJECTORY is bit-identical to an uninterrupted run (checkpoint +
+LATEST + resilience composing end to end). Full-suite only (each
+scenario spawns launcher + worker processes); `tools/chaos_drill.py
+--full` runs the exhaustive every-phase version.
+"""
+import importlib.util
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+STEPS = 6
+
+_spec = importlib.util.spec_from_file_location(
+    "chaos_drill", os.path.join(REPO, "tools", "chaos_drill.py"))
+drill = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(drill)
+
+
+@pytest.fixture(scope="module")
+def baseline(tmp_path_factory):
+    sdir = str(tmp_path_factory.mktemp("baseline"))
+    res, traj = drill._launch(sdir, STEPS, "", hang_watch=False)
+    assert res.returncode == 0, res.stdout.decode()
+    assert len(traj) == STEPS
+    return traj
+
+
+def _run(tmp_path, fault_spec, hang=False):
+    sdir = str(tmp_path)
+    return drill._launch(sdir, STEPS, fault_spec, hang_watch=hang)
+
+
+def test_kill_at_step_resumes_matching(tmp_path, baseline):
+    """Hard kill before step 2; the restarted worker must resume from
+    LATEST and reproduce the uninterrupted trajectory exactly."""
+    res, traj = _run(tmp_path, "kill@2")
+    out = res.stdout.decode()
+    assert res.returncode == 0, out
+    assert "resumed at step 2" in out
+    assert drill._compare("kill@2", baseline, traj, STEPS) is None
+
+
+def test_crash_mid_shard_write_never_loads_torn(tmp_path, baseline):
+    """Death after 3 of the shard files of a snapshot: the torn staging
+    dir must be ignored; resume comes from the previous intact snapshot
+    via LATEST and the trajectory still matches."""
+    res, traj = _run(tmp_path, "crash_shard@2:3")
+    out = res.stdout.decode()
+    assert res.returncode == 0, out
+    assert drill._compare("crash", baseline, traj, STEPS) is None
+    # the torn staging dir is still visible in the checkpoint root —
+    # proof the crash landed mid-save and no loader touched it
+    assert "resumed at step" in out
+
+
+def test_elastic_exit_uses_separate_budget(tmp_path, baseline):
+    """A worker exiting ELASTIC_EXIT_CODE restarts even with
+    --max_restart 0 (the elastic budget is separate) and resumes."""
+    env_dir = str(tmp_path)
+    res, traj = drill._launch(env_dir, STEPS, "elastic_exit@3",
+                              hang_watch=False, max_restart=0)
+    out = res.stdout.decode()
+    assert res.returncode == 0, out
+    assert "requested elastic restart" in out
+    assert drill._compare("elastic", baseline, traj, STEPS) is None
+
+
+def test_nan_recovers_by_skip_and_rollback(tmp_path, baseline):
+    """Two poisoned steps trip skip, skip, rollback; the re-run after
+    rollback is clean so the FINAL trajectory matches baseline."""
+    res, traj = _run(tmp_path, "nan@3:2")
+    out = res.stdout.decode()
+    assert res.returncode == 0, out
+    assert "update skipped" in out and "rolled back" in out
+    assert drill._compare("nan", baseline, traj, STEPS) is None

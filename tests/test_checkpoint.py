@@ -143,14 +143,13 @@ def test_gpt_train_state_roundtrip_across_meshes(tmp_path):
 
 
 def test_missing_data_raises(tmp_path):
+    from paddle_tpu.testing import faults
     mesh = build_mesh({"mp": 2})
     with use_mesh(mesh):
         save_sharded({"w": shard_value(jnp.ones((4, 4)), P("mp"), mesh)},
                      str(tmp_path / "ck"))
     # delete one shard file -> load must fail loudly, not zero-fill
-    import os
-    gone = [f for f in (tmp_path / "ck").iterdir()
-            if f.suffix == ".npy"][0]
-    os.remove(gone)
+    # (faults.remove_shard also exempts the dir from the write audit)
+    faults.remove_shard(str(tmp_path / "ck"))
     with pytest.raises(ValueError, match="missing data"):
         load_sharded(str(tmp_path / "ck"), mesh=None)

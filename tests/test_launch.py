@@ -147,6 +147,32 @@ class TestLaunchCLI:
         assert "RECOVERED_FROM_HANG" in out
         assert "hung" in out           # the controller named the cause
 
+    def test_step_heartbeat_detects_stalled_step(self, tmp_path):
+        """--step_heartbeat: no background beat thread, so a worker that
+        stops making step progress (while very much alive) goes stale
+        and the pod restarts — the hung-dispatch story without the
+        worker-side watchdog."""
+        marker = tmp_path / "stalled_once"
+        res = _run_launch(tmp_path, f"""
+            import os, sys, time
+            from paddle_tpu.distributed.launch import heartbeat
+            marker = {str(marker)!r}
+            if not os.path.exists(marker):
+                open(marker, "w").write("x")
+                for _ in range(3):          # a few healthy "steps"
+                    heartbeat.pulse()
+                    time.sleep(0.3)
+                time.sleep(120)             # step hangs; thread can't mask it
+            print("RECOVERED_FROM_STALL")
+        """, ["--devices", "cpu", "--max_restart", "2",
+              "--step_heartbeat",
+              # boot (paddle_tpu import) must fit inside the timeout
+              "--hang_timeout", "15"])
+        out = res.stdout.decode()
+        assert res.returncode == 0, out
+        assert "RECOVERED_FROM_STALL" in out
+        assert "hung" in out
+
     def test_scale_down_continuation(self, tmp_path):
         """Scale-down (the reference's nnodes-1 continuation): one rank
         always dies at world size 3; after restarts are exhausted the

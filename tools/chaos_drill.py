@@ -1,0 +1,364 @@
+"""Chaos drill: run training under injected faults, assert recovery.
+
+The executable acceptance test for the fault-tolerance runtime
+(docs/fault_tolerance.md). The reference stack has nothing like it
+(SURVEY.md: "no systematic fault-injection harness") — here every
+scenario spawns the REAL elastic-lite launcher on the 8-virtual-device
+CPU mesh, injects a declared fault (paddle_tpu.testing.faults), and
+asserts the restarted/resumed run's loss trajectory matches an
+uninterrupted baseline step for step.
+
+Scenarios:
+  kill@S          worker hard-killed before step S; restart resumes LATEST
+  crash_shard@S:K worker dies mid-save_sharded; torn staging dir ignored
+  nan@S:2         two poisoned steps -> skip, skip, rollback, clean re-run
+  elastic_exit@S  worker exits 101; launcher's elastic budget restarts it
+  hb_stale@S      heartbeat wedge; launcher hang watchdog kills + restarts
+  corrupt         newest snapshot truncated/bit-flipped between two legs;
+                  resume must fall back to the previous intact snapshot
+
+Usage:
+  python tools/chaos_drill.py --quick          # representative phases
+  python tools/chaos_drill.py --full           # kill/crash at EVERY step
+  python tools/chaos_drill.py --bench          # save/verify overhead JSON
+(The launcher re-enters this file with --worker; not for direct use.)
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+STEPS_ENV = "PADDLE_TPU_DRILL_STEPS"
+CKPT_ENV = "PADDLE_TPU_DRILL_CKPT"
+OUT_ENV = "PADDLE_TPU_DRILL_OUT"
+
+DIM_IN, DIM_H = 16, 32
+BATCH = 8
+
+
+# =========================================================== worker side
+def _batch(step: int):
+    import numpy as np
+    rng = np.random.RandomState(10_000 + step)
+    x = rng.randn(BATCH, DIM_IN).astype(np.float32)
+    y = rng.randn(BATCH).astype(np.float32)
+    return x, y
+
+
+def worker_main() -> int:
+    from paddle_tpu.testing import faults
+    faults.install()
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.parallel.mesh import build_mesh, use_mesh, \
+        shard_value, P
+    from paddle_tpu.parallel.checkpoint import CheckpointManager
+    from paddle_tpu.parallel.resilience import (ResilientTrainer,
+                                                ResilienceConfig,
+                                                run_resilient)
+
+    steps = int(os.environ[STEPS_ENV])
+    mgr = CheckpointManager(os.environ[CKPT_ENV], max_to_keep=3)
+    out = open(os.environ[OUT_ENV], "a")
+
+    def init_params(key):
+        k1, k2 = jax.random.split(key)
+        return {"w1": jax.random.normal(k1, (DIM_IN, DIM_H)) * 0.3,
+                "w2": jax.random.normal(k2, (DIM_H,)) * 0.3}
+
+    def train_step(params, opt_state, batch, lr=0.05, mu=0.9):
+        x, y = batch
+
+        def loss_fn(p):
+            h = jnp.maximum(x @ p["w1"], 0.0)
+            return jnp.mean((h @ p["w2"] - y) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        new_opt = jax.tree_util.tree_map(
+            lambda m, g: mu * m + g, opt_state, grads)
+        new_params = jax.tree_util.tree_map(
+            lambda p, m: p - lr * m, params, new_opt)
+        return loss, new_params, new_opt
+
+    mesh = build_mesh({"dp": 2, "mp": 4})
+    specs = {"w1": P(None, "mp"), "w2": P("mp")}
+    with use_mesh(mesh):
+        params = {k: shard_value(v, specs[k], mesh)
+                  for k, v in init_params(jax.random.PRNGKey(0)).items()}
+        opt_state = jax.tree_util.tree_map(jnp.zeros_like, params)
+        tr = ResilientTrainer(
+            train_step, params, opt_state, manager=mgr,
+            config=ResilienceConfig(checkpoint_every=1, rollback_after=2,
+                                    max_rollbacks=5))
+        if tr.maybe_resume():
+            print(f"[drill-worker] resumed at step {tr.step}",
+                  file=sys.stderr, flush=True)
+
+        def record(step, loss, ok):
+            out.write(json.dumps(
+                {"step": step, "loss": loss, "ok": ok}) + "\n")
+            out.flush()
+            os.fsync(out.fileno())
+
+        def sharded_batch(step):
+            x, y = _batch(step)
+            return (shard_value(jnp.asarray(x), P("dp", None), mesh),
+                    shard_value(jnp.asarray(y), P("dp"), mesh))
+
+        run_resilient(tr, sharded_batch, steps, on_step=record)
+    print(f"[drill-worker] done: {tr.step} steps, {tr.skipped} skipped, "
+          f"{tr.rollbacks} rollbacks", file=sys.stderr, flush=True)
+    return 0
+
+
+# =========================================================== driver side
+def _trajectory(out_path: str):
+    """results.jsonl -> {step: last recorded loss} (re-runs after a
+    restart/rollback overwrite earlier occurrences)."""
+    traj = {}
+    if not os.path.exists(out_path):
+        return traj
+    with open(out_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            traj[rec["step"]] = rec["loss"]
+    return traj
+
+
+def _launch(scenario_dir: str, steps: int, fault_spec: str,
+            hang_watch: bool, max_restart: int = 10,
+            timeout: int = 600):
+    ckpt = os.path.join(scenario_dir, "ckpt")
+    outp = os.path.join(scenario_dir, "out.jsonl")
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)   # workers pin CPU via the boot shim
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env[STEPS_ENV] = str(steps)
+    env[CKPT_ENV] = ckpt
+    env[OUT_ENV] = outp
+    if fault_spec:
+        env["PADDLE_TPU_FAULTS"] = fault_spec
+        env["PADDLE_TPU_FAULTS_ONCE_DIR"] = os.path.join(
+            scenario_dir, "once")
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--devices", "cpu", "--cpus_per_proc", "8",
+           "--max_restart", str(max_restart),
+           "--max_elastic_restart", "8"]
+    if hang_watch:
+        # generous: worker boot (paddle_tpu + jax import) takes >5s on a
+        # loaded 1-core host and a false hang burns the restart budget
+        cmd += ["--hang_timeout", "15", "--heartbeat_interval", "0.5"]
+    cmd += [os.path.join(REPO, "tools", "chaos_drill.py"), "--worker"]
+    res = subprocess.run(cmd, cwd=REPO, env=env, timeout=timeout,
+                         stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT)
+    return res, _trajectory(outp)
+
+
+def _compare(name: str, base: dict, got: dict, steps: int,
+             atol: float = 1e-10):
+    missing = [s for s in range(steps) if s not in got]
+    if missing:
+        return f"{name}: steps never recorded: {missing[:10]}"
+    for s in range(steps):
+        d = abs(base[s] - got[s])
+        if not (d <= atol):
+            return (f"{name}: loss diverged at step {s}: baseline "
+                    f"{base[s]!r} vs {got[s]!r} (|d|={d:g})")
+    return None
+
+
+def run_drill(steps: int, full: bool, keep_logs: bool = False) -> int:
+    root = tempfile.mkdtemp(prefix="chaos_drill_")
+    failures = []
+    t0 = time.time()
+
+    def scenario(name: str, spec: str, hang: bool = False):
+        sdir = os.path.join(root, name.replace("@", "_").replace(":", "_"))
+        os.makedirs(sdir, exist_ok=True)
+        t = time.time()
+        res, traj = _launch(sdir, steps, spec, hang)
+        dt = time.time() - t
+        err = None
+        if res.returncode != 0:
+            err = f"{name}: launcher rc={res.returncode}"
+        else:
+            err = _compare(name, baseline, traj, steps)
+        tag = "FAIL" if err else "ok"
+        print(f"[drill] {name:<24} {tag}  ({dt:.1f}s)", flush=True)
+        if err:
+            failures.append(err)
+            tail = res.stdout.decode(errors="replace")[-2000:]
+            print(tail, flush=True)
+        elif keep_logs:
+            print(res.stdout.decode(errors="replace")[-800:], flush=True)
+        return res, traj
+
+    # baseline: uninterrupted run
+    bdir = os.path.join(root, "baseline")
+    os.makedirs(bdir)
+    res, baseline = _launch(bdir, steps, "", hang_watch=False)
+    if res.returncode != 0 or len(baseline) != steps:
+        print(res.stdout.decode(errors="replace")[-3000:])
+        print(f"[drill] baseline failed (rc={res.returncode}, "
+              f"{len(baseline)}/{steps} steps)")
+        return 2
+    print(f"[drill] baseline: {steps} steps ok "
+          f"({time.time() - t0:.1f}s)", flush=True)
+
+    kill_phases = range(steps) if full else \
+        sorted({0, 1, steps // 2, steps - 1})
+    crash_phases = range(steps) if full else sorted({1, steps // 2})
+    for s in kill_phases:
+        scenario(f"kill@{s}", f"kill@{s}")
+    for s in crash_phases:
+        # die after 3 of the 9 shard files of a snapshot (w1:4, w2:4,
+        # scalars in manifest) — squarely mid-save
+        scenario(f"crash_shard@{s}", f"crash_shard@{s}:3")
+    scenario(f"nan@{max(1, steps // 3)}",
+             f"nan@{max(1, steps // 3)}:2")
+    scenario(f"elastic_exit@{max(1, steps // 2)}",
+             f"elastic_exit@{max(1, steps // 2)}")
+    scenario(f"hb_stale@{max(1, steps // 2)}",
+             f"hb_stale@{max(1, steps // 2)}", hang=True)
+
+    # corrupt-newest: two legs with driver-side file damage in between —
+    # resume must CRC-reject the newest snapshot and fall back
+    for mode in ("truncate", "bitflip"):
+        name = f"corrupt_{mode}"
+        sdir = os.path.join(root, name)
+        os.makedirs(sdir, exist_ok=True)
+        leg1 = steps // 2
+        res, _ = _launch(sdir, leg1, "", hang_watch=False)
+        if res.returncode != 0:
+            failures.append(f"{name}: leg1 rc={res.returncode}")
+            continue
+        ckpt = os.path.join(sdir, "ckpt")
+        with open(os.path.join(ckpt, "LATEST")) as f:
+            newest = os.path.join(ckpt, f.read().strip())
+        # the corruptors pull in paddle_tpu (and transitively jax) into
+        # the DRIVER process — pin CPU first, unconditionally, per the
+        # CLAUDE.md tunnel trap
+        from paddle_tpu.device import pin_cpu
+        pin_cpu(1)
+        from paddle_tpu.testing import faults as fmod
+        if mode == "truncate":
+            fmod.truncate_shard(newest, index=0)
+        else:
+            fmod.bitflip_shard(newest, index=0)
+        res, traj = _launch(sdir, steps, "", hang_watch=False)
+        err = None
+        if res.returncode != 0:
+            err = f"{name}: leg2 rc={res.returncode}"
+        else:
+            err = _compare(name, baseline, traj, steps)
+        print(f"[drill] {name:<24} {'FAIL' if err else 'ok'}", flush=True)
+        if err:
+            failures.append(err)
+            print(res.stdout.decode(errors="replace")[-2000:], flush=True)
+
+    dt = time.time() - t0
+    if failures:
+        print(f"[drill] {len(failures)} FAILURES in {dt:.1f}s:")
+        for f in failures:
+            print("  -", f)
+        return 1
+    print(f"[drill] ALL SCENARIOS PASSED ({steps}-step run, "
+          f"full={full}) in {dt:.1f}s")
+    return 0
+
+
+# ============================================================ bench mode
+def bench_main(repeats: int = 5) -> int:
+    """Measure checkpoint save/verify overhead (the BASELINE.md
+    Robustness numbers) on the 8-virtual-device CPU mesh."""
+    from paddle_tpu.device import pin_cpu
+    assert pin_cpu(8), "could not pin the CPU platform"
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.parallel.mesh import build_mesh, use_mesh, \
+        shard_value, P
+    from paddle_tpu.parallel.checkpoint import (save_sharded,
+                                                verify_checkpoint,
+                                                CheckpointManager)
+
+    mesh = build_mesh({"dp": 2, "mp": 4})
+    rng = np.random.RandomState(0)
+    with use_mesh(mesh):
+        # ~8 MB of fp32 state: a model-scaled-down-but-not-trivial tree
+        state = {
+            "params": {
+                "emb": shard_value(jnp.asarray(
+                    rng.randn(1024, 512).astype(np.float32)),
+                    P(None, "mp"), mesh),
+                "w": shard_value(jnp.asarray(
+                    rng.randn(512, 2048).astype(np.float32)),
+                    P("mp", None), mesh),
+            },
+            "opt_state": {
+                "m": shard_value(jnp.asarray(
+                    rng.randn(1024, 512).astype(np.float32)),
+                    P(None, "mp"), mesh),
+            },
+            "step": np.int64(1),
+        }
+        nbytes = (1024 * 512 * 2 + 512 * 2048) * 4
+        with tempfile.TemporaryDirectory() as td:
+            mgr = CheckpointManager(td, max_to_keep=3)
+            save_ms, verify_ms = [], []
+            for i in range(repeats):
+                t = time.time()
+                path = mgr.save(state, i)
+                save_ms.append((time.time() - t) * 1e3)
+                t = time.time()
+                verify_checkpoint(path)
+                verify_ms.append((time.time() - t) * 1e3)
+        line = {
+            "bench": "checkpoint_overhead",
+            "state_mb": round(nbytes / 2 ** 20, 2),
+            "save_ms_median": round(sorted(save_ms)[len(save_ms) // 2], 2),
+            "verify_ms_median": round(
+                sorted(verify_ms)[len(verify_ms) // 2], 2),
+            "repeats": repeats,
+        }
+        print(json.dumps(line))
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--worker", action="store_true",
+                    help="internal: run as the training worker")
+    ap.add_argument("--full", action="store_true",
+                    help="kill/crash at EVERY step phase (slow)")
+    ap.add_argument("--quick", action="store_true",
+                    help="representative phases only (default)")
+    ap.add_argument("--bench", action="store_true",
+                    help="measure save/verify overhead, print one JSON")
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--keep-logs", action="store_true")
+    args = ap.parse_args()
+    if args.worker:
+        return worker_main()
+    if args.bench:
+        return bench_main()
+    return run_drill(args.steps, full=args.full, keep_logs=args.keep_logs)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
