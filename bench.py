@@ -193,18 +193,56 @@ def run_measurement(rung: str) -> None:
         tokens = jax.random.randint(jax.random.PRNGKey(1),
                                     (vbatch, seq + 1), 0, cfg.vocab_size)
         from paddle_tpu.models.facade import make_train_step
-        step = make_train_step(train_step, cfg=cfg, lr=1e-4)
+        # PADDLE_TPU_TELEMETRY_JSONL=path: measure WITH the batched
+        # step-metrics pipeline in the jitted step (the BASELINE.md
+        # "Observability" overhead numbers come from on/off runs of the
+        # CPU rung). Flush cadence via PADDLE_TPU_TELEMETRY_EVERY
+        # (default 5, sized so a run flushes at least once).
+        tele_path = os.environ.get("PADDLE_TPU_TELEMETRY_JSONL")
+        tele = tstate = None
+        if tele_path:
+            from paddle_tpu.profiler.telemetry import (TelemetryPipeline,
+                                                       instrument_train_step)
+            tele = TelemetryPipeline(
+                tele_path,
+                every=int(os.environ.get("PADDLE_TPU_TELEMETRY_EVERY", "5")),
+                meta={"samples_per_step": vbatch * seq, "rung": name})
+            step = instrument_train_step(train_step, tele, cfg=cfg, lr=1e-4,
+                                         beta1=0.9)
+            tstate = tele.device_init()
+        else:
+            step = make_train_step(train_step, cfg=cfg, lr=1e-4)
+
+        def run_one(i):
+            nonlocal params, opt_state, tstate
+            if tele is None:
+                loss, params, opt_state = step(params, opt_state, tokens)
+            else:
+                loss, params, opt_state, tstate = step(
+                    params, opt_state, tokens, tstate)
+                tstate = tele.tick(i, tstate)
+            return loss
         t0 = time.perf_counter()
-        loss, params, opt_state = step(params, opt_state, tokens)
+        loss = run_one(0)
         loss_v = float(loss)   # forces; block_until_ready unreliable
         _log(f"  compile+first {time.perf_counter() - t0:.1f}s "
              f"(loss={loss_v:.4f})")
         t0 = time.perf_counter()
-        for _ in range(warm_iters):
-            loss, params, opt_state = step(params, opt_state, tokens)
+        for i in range(warm_iters):
+            loss = run_one(i + 1)
         float(loss)            # forces the whole chained sequence
         dt = (time.perf_counter() - t0) / warm_iters
         n_params = sum(int(v.size) for v in params.values())
+        if tele is not None:
+            tele.close(tstate)
+            try:
+                sys.path.insert(0, os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)), "tools"))
+                from telemetry_report import summarize
+                _log("telemetry: " + json.dumps(
+                    summarize(tele_path).get("step_time", {})))
+            except Exception as e:   # report failure must not kill the rung
+                _log(f"telemetry report failed: {e}")
         del params, opt_state
         return dt, n_params
 

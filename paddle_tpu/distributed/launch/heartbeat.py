@@ -75,13 +75,26 @@ def stop() -> None:
     _stop.set()
 
 
+_last_pulse = 0.0
+
+
 def pulse() -> None:
     """Touch the lease file immediately. The resilient step loop calls
     this per completed step; under ENV_STEP_MODE (launcher
     --step_heartbeat) it is the ONLY thing refreshing the lease, so the
     controller's staleness clock tracks step progress directly and a
     hung dispatch trips --hang_timeout even though the process (and the
-    default mode's beat thread) is alive."""
+    default mode's beat thread) is alive. Each pulse publishes the gap
+    since the previous one as the `heartbeat_staleness_s` monitor gauge
+    — the worker-side view of how close it is sailing to the
+    controller's --hang_timeout."""
+    global _last_pulse
+    import time as _time
+    now = _time.time()
+    if _last_pulse:
+        from ...profiler import monitor
+        monitor.gauge("heartbeat_staleness_s").set(now - _last_pulse)
+    _last_pulse = now
     path = os.environ.get(ENV_FILE)
     if path and not _stop.is_set():
         _touch(path)

@@ -128,6 +128,12 @@ def _worker_env(args, local_rank: int) -> dict:
         from ...device import cpu_pin_env
         env = cpu_pin_env(args.cpus_per_proc, base_env=env)
         env["PADDLE_LAUNCH_CPU_DEVICES"] = str(args.cpus_per_proc)
+    # crash flight recorder (profiler/flight_recorder.py): every worker
+    # gets a dump directory so a dead pod leaves a black box the operator
+    # (and tools/chaos_drill.py) can read — an explicit
+    # PADDLE_TPU_FLIGHT_DIR in the caller's env wins
+    if "PADDLE_TPU_FLIGHT_DIR" not in env:
+        env["PADDLE_TPU_FLIGHT_DIR"] = os.path.join(_hb_dir(args), "flight")
     return env
 
 
@@ -262,6 +268,15 @@ def _wait(workers: List[_Worker], hang_timeout: float = 0.0) \
 
 def launch(argv: Optional[List[str]] = None) -> int:
     """Programmatic entry (returns the job's exit code)."""
+    # controller-side observability: phase spans + restart counters
+    # (import-light — profiler/monitor pulls in no jax); the worker-side
+    # black box is env-wired in _worker_env
+    from ...profiler import RecordEvent, monitor
+    from ...profiler import flight_recorder
+    mon_restart = monitor.counter("launch_pod_restart")
+    mon_elastic = monitor.counter("launch_elastic_restart")
+    mon_hung = monitor.counter("launch_hung_worker")
+    mon_scale = monitor.counter("launch_scale_down")
     args = _parse_args(argv)
     attempt = 0
     elastic = 0
@@ -271,7 +286,14 @@ def launch(argv: Optional[List[str]] = None) -> int:
             # their own distinctly-worded line below
             print(f"[launch] pod restart {attempt}/{args.max_restart} "
                   f"(crash budget)", file=sys.stderr, flush=True)
-        rc = _wait(_spawn(args), args.hang_timeout)
+        with RecordEvent("launch.spawn"):
+            workers = _spawn(args)
+        with RecordEvent("launch.wait"):
+            rc = _wait(workers, args.hang_timeout)
+        flight_recorder.note(phase="pod_exit", rc=rc, attempt=attempt,
+                             elastic=elastic)
+        if rc == HUNG:
+            mon_hung.add()
         if rc == 0:
             return 0
         if rc is None:
@@ -285,6 +307,7 @@ def launch(argv: Optional[List[str]] = None) -> int:
             # fleet/elastic/manager.py:30. Budgeted separately so tunnel
             # flaps don't consume the crash-restart budget.
             elastic += 1
+            mon_elastic.add()
             print(f"[launch] worker requested elastic restart "
                   f"({elastic}/{args.max_elastic_restart}, "
                   f"rc={ELASTIC_EXIT_CODE})", file=sys.stderr, flush=True)
@@ -304,6 +327,7 @@ def launch(argv: Optional[List[str]] = None) -> int:
                 # the reduced world size so rendezvous matches
                 args.nproc_per_node -= 1
                 attempt = 0
+                mon_scale.add()
                 print(f"[launch] restarts exhausted (rc={rc}); scaling "
                       f"down to {args.nproc_per_node} workers after "
                       f"{args.scale_grace}s grace",
@@ -312,8 +336,15 @@ def launch(argv: Optional[List[str]] = None) -> int:
                 continue
             print(f"[launch] workers failed (rc={rc}); restarts exhausted",
                   file=sys.stderr, flush=True)
+            # the job is dying: leave the CONTROLLER's black box (pod
+            # exit history + restart counters) beside the workers' dumps
+            flight_recorder.recorder().set_dir(
+                os.environ.get("PADDLE_TPU_FLIGHT_DIR")
+                or os.path.join(_hb_dir(args), "flight"))
+            flight_recorder.dump("launch_failed")
             return 1 if rc == HUNG else rc
         attempt += 1
+        mon_restart.add()
 
 
 def main():

@@ -32,17 +32,48 @@ _JIT_CACHE: Dict[Tuple, Callable] = {}
 _amp_mod = None
 _static_graph_mode = None   # cached static.program.in_static_graph_mode
 _record_apply = None
+_MONITOR = None             # cached counter handles (hot path: one call +
+#                             one lock-add per dispatch, no registry lookup)
+
+
+class _DispatchMonitor:
+    __slots__ = ("cache_hit", "cache_miss", "nan_inf_trip")
+
+    def __init__(self):
+        from ..profiler import monitor as _m
+        self.cache_hit = _m.counter("dispatch_cache_hit")
+        self.cache_miss = _m.counter("dispatch_cache_miss")
+        self.nan_inf_trip = _m.counter("dispatch_nan_inf_trip")
+
+
+def _mon() -> "_DispatchMonitor":
+    global _MONITOR
+    if _MONITOR is None:
+        _MONITOR = _DispatchMonitor()
+    return _MONITOR
 
 
 def _check_nan_inf(name, out_vals):
     """FLAGS_check_nan_inf numerical sanitizer (reference:
-    paddle/fluid/eager/nan_inf_utils.cc)."""
+    paddle/fluid/eager/nan_inf_utils.cc). The per-output finiteness
+    flags are stacked on device and pulled in ONE batched transfer —
+    the naive per-output `bool(...)` paid one ~70-170 ms tunnel round
+    trip per float output (CLAUDE.md); the error names the producing op
+    and every offending output index."""
     outs = out_vals if isinstance(out_vals, (tuple, list)) else (out_vals,)
+    idx, flags = [], []
     for i, v in enumerate(outs):
         if np.issubdtype(np.dtype(v.dtype), np.floating):
-            if not bool(jnp.isfinite(v).all()):
-                raise FloatingPointError(
-                    f"nan/inf detected in output {i} of op '{name}'")
+            idx.append(i)
+            flags.append(jnp.isfinite(v).all())
+    if not flags:
+        return
+    finite = np.asarray(jax.device_get(jnp.stack(flags)))
+    if not finite.all():
+        bad = [o for o, f in zip(idx, finite) if not f]
+        _mon().nan_inf_trip.add()
+        raise FloatingPointError(
+            f"nan/inf detected in output(s) {bad} of op '{name}'")
 
 # Toggle: disable per-op jit (debugging / op-by-op numpy-style execution).
 _eager_jit = True
@@ -150,12 +181,16 @@ def apply(name: str, fn: Callable, *args, _nondiff_outputs=(), **static):
 
     closure = _JIT_CACHE.get(cache_key)
     if closure is None:
+        _mon().cache_miss.add()
+
         def raw(*arrs, _plan=tuple(arg_plan), _static=static, _fn=fn):
             full = [p.v if isinstance(p, _Lit) else arrs[p] for p in _plan]
             return _fn(*full, **_static)
         raw._raw = raw
         _JIT_CACHE[cache_key] = raw
         closure = raw
+    else:
+        _mon().cache_hit.add()
 
     # AMP autocast (O1/O2 allow/deny lists — reference eager_amp_auto_cast.h)
     global _amp_mod

@@ -102,12 +102,24 @@ class ProgBarLogger(Callback):
         if self.verbose > 1 and step % self.log_freq == 0:
             msg = " - ".join(f"{k}: {_fmt(v)}"
                              for k, v in (logs or {}).items())
+            # ips comes FROM the global Benchmark timer that Model.fit
+            # drives (reference timer.py auto-attach) — one measurement,
+            # not a per-callback recomputation
+            from ..profiler.timer import benchmark
+            ips = benchmark().current_event.ips
+            if ips:
+                msg = f"{msg} - ips: {ips:.1f}" if msg else f"ips: {ips:.1f}"
             print(f"step {step}: {msg}", file=sys.stderr)
 
     def on_epoch_end(self, epoch, logs=None):
         if self.verbose:
             msg = " - ".join(f"{k}: {_fmt(v)}"
                              for k, v in (logs or {}).items())
+            from ..profiler.timer import benchmark
+            s = benchmark().summary(skip=1)
+            if s.get("ips"):
+                msg += (f" - ips: {s['ips']:.1f} "
+                        f"(p95 step {s['p95_batch_cost_s'] * 1e3:.1f} ms)")
             dt = time.time() - self._t0
             print(f"epoch {epoch + 1} done in {dt:.1f}s - {msg}",
                   file=sys.stderr)
@@ -179,6 +191,46 @@ class ModelCheckpoint(Callback):
                                            f"{epoch}{ext}"))
                 except OSError:
                     pass
+
+
+class TelemetryCallback(Callback):
+    """Feed the hapi loop into the observability substrate
+    (docs/observability.md): every train batch's logs go into the crash
+    flight recorder's ring (so a dying fit leaves the last-N batch
+    records + monitor snapshot), `hapi_steps`/`hapi_epochs` monitor
+    counters advance, and on_train_end dumps a final black box.
+    `config_callbacks` auto-attaches it when $PADDLE_TPU_FLIGHT_DIR is
+    set (the launcher exports it per worker)."""
+
+    def __init__(self, dump_dir=None):
+        super().__init__()
+        from ..profiler import flight_recorder, monitor
+        self._flight = flight_recorder.recorder()
+        if dump_dir is not None:
+            self._flight.set_dir(dump_dir)
+        self._flight.install_exit_hooks()
+        self._mon_steps = monitor.counter("hapi_steps")
+        self._mon_epochs = monitor.counter("hapi_epochs")
+
+    def on_train_begin(self, logs=None):
+        self._flight.configure(loop="hapi.Model.fit",
+                               epochs=self.params.get("epochs"))
+
+    def on_train_batch_end(self, step, logs=None):
+        self._mon_steps.add()
+        rec = {"step": step}
+        for k, v in (logs or {}).items():
+            try:
+                rec[k] = float(np.ravel(v)[0])
+            except (TypeError, ValueError):
+                pass
+        self._flight.note(**rec)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._mon_epochs.add()
+
+    def on_train_end(self, logs=None):
+        self._flight.dump("hapi_train_end")
 
 
 class EarlyStopping(Callback):
@@ -260,6 +312,10 @@ def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,
         cbks.append(ProgBarLogger(verbose=verbose))
     if save_dir and not any(isinstance(c, ModelCheckpoint) for c in cbks):
         cbks.append(ModelCheckpoint(save_freq, save_dir))
+    import os
+    if (os.environ.get("PADDLE_TPU_FLIGHT_DIR")
+            and not any(isinstance(c, TelemetryCallback) for c in cbks)):
+        cbks.append(TelemetryCallback())
     lst = CallbackList(cbks)
     lst.set_model(model)
     lst.set_params({"epochs": epochs, "steps": steps, "verbose": verbose,

@@ -132,6 +132,13 @@ class Model:
                                 verbose=verbose, save_freq=save_freq,
                                 save_dir=save_dir, metrics=[
                                     m.name() for m in self._metrics])
+        # the global throughput timer (profiler/timer.py, the reference's
+        # DataLoader auto-attach): fit drives begin/step and ProgBarLogger
+        # READS ips from it instead of recomputing its own
+        from ..profiler.timer import benchmark
+        bm = benchmark()
+        bm.reset()
+        bm.begin()
         cbks.on_train_begin()
         history = {"loss": []}
         for epoch in range(epochs):
@@ -143,6 +150,7 @@ class Model:
                 cbks.on_train_batch_begin(step)
                 inputs, labels = _split_batch(batch)
                 vals = self.train_batch(inputs, labels)
+                bm.step(num_samples=_batch_count(inputs))
                 if vals[0] is not None:
                     losses.append(vals[0])
                 logs = {"loss": vals[0]}
@@ -161,11 +169,17 @@ class Model:
                 history.setdefault(m.name(), []).append(m.accumulate())
             cbks.on_epoch_end(epoch, epoch_logs)
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                # pause the step timer: without this the NEXT epoch's
+                # first bm.step() would book the whole eval pass as one
+                # train-batch cost (a fake p95 tail)
+                bm.end()
                 eval_logs = self.evaluate(eval_data, batch_size=batch_size,
                                           verbose=0)
                 cbks.on_eval_end(eval_logs)
+                bm.begin()
             if self.stop_training:
                 break
+        bm.end()
         cbks.on_train_end()
         return history
 
@@ -226,6 +240,13 @@ class Model:
     def summary(self, input_size=None, dtype=None):
         from .model_summary import summary
         return summary(self.network, input_size, dtypes=dtype)
+
+
+def _batch_count(inputs):
+    """Leading-dim sample count of a batch (first array-like input)."""
+    x = inputs[0] if isinstance(inputs, (list, tuple)) and inputs else inputs
+    shape = getattr(x, "shape", None)
+    return int(shape[0]) if shape else None
 
 
 def _to_tensors(x):

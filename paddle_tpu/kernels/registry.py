@@ -190,13 +190,20 @@ def winner(kernel: str, backend: Optional[str] = None,
     has no trustworthy row. Entries that fail validation are skipped —
     a hand-edited or corrupted row degrades to the hardcoded default
     instead of shipping."""
+    from ..profiler import monitor
     backend = backend or backend_class()
     entries = _load(path).get("entries") or {}
     for b in dict.fromkeys((bucket, "*")):
         ent = entries.get(_key(kernel, backend, b))
         if ent is not None and _entry_problem(_key(kernel, backend, b),
                                               ent) is None:
+            # which impl the registry actually served, per kernel — the
+            # observable that caught the round-5 silent-default regression
+            monitor.counter(
+                f"kernel_registry_resolution.{kernel}."
+                f"{ent.get('impl')}").add()
             return ent.get("impl")
+    monitor.counter(f"kernel_registry_miss.{kernel}").add()
     return None
 
 

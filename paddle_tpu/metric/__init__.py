@@ -172,3 +172,47 @@ def accuracy(input, label, k=1, correct=None, total=None, name=None):
     idx = np.argsort(-pred, axis=-1)[..., :k]
     acc = float(np.mean(np.any(idx == lab[:, None], axis=-1)))
     return to_tensor(np.asarray(acc, np.float32))
+
+
+# ------------------------------------------------------- device-side AUC
+def _auc_device(preds, labels, num_thresholds=4095):
+    """Histogram ROC-AUC computed entirely on device (reference
+    paddle/fluid/framework/fleet/metrics.cc:1, the fleet's global AUC:
+    the same bucketed stat_pos/stat_neg reduction over all-reduced
+    histograms; sklearn-free by construction). Pure jnp, so it runs
+    inside jit / a sharded eval step; the (num_thresholds+1,) histogram
+    is the only reduction state, making it pmean/all-reduce friendly.
+    Bucketing is identical to the host `Auc` metric above, so the two
+    agree exactly on the same data (parity test in
+    tests/test_telemetry.py)."""
+    import jax.numpy as jnp
+    preds = jnp.asarray(preds)
+    if preds.ndim == 2 and preds.shape[1] == 2:
+        preds = preds[:, 1]           # [N, 2] softmax -> positive-class p
+    preds = preds.reshape(-1)
+    labels = jnp.asarray(labels).reshape(-1).astype(jnp.float32)
+    n = num_thresholds
+    buckets = jnp.clip((preds * n).astype(jnp.int32), 0, n)
+    pos_w = (labels > 0.5).astype(jnp.float32)
+    stat_pos = jnp.zeros(n + 1, jnp.float32).at[buckets].add(pos_w)
+    stat_neg = jnp.zeros(n + 1, jnp.float32).at[buckets].add(1.0 - pos_w)
+    # trapezoid sweep from the highest threshold down, vectorized: at
+    # bucket i (descending), tot_pos so far is the exclusive suffix sum
+    rp = stat_pos[::-1]
+    rn = stat_neg[::-1]
+    tot_pos_before = jnp.cumsum(rp) - rp
+    auc = jnp.sum(rn * tot_pos_before + rp * rn / 2.0)
+    tot_pos = jnp.sum(stat_pos)
+    tot_neg = jnp.sum(stat_neg)
+    denom = tot_pos * tot_neg
+    return jnp.where(denom > 0, auc / jnp.maximum(denom, 1.0), 0.0)
+
+
+def _register_auc_op():
+    from ..framework.dispatch import defop
+    return defop("auc", nondiff_outputs=(0,))(_auc_device)
+
+
+auc = _register_auc_op()
+"""Functional device AUC: `metric.auc(preds, labels)` -> scalar Tensor
+(dispatch op "auc"; OPS_COVERAGE.md ledger entry op:auc)."""

@@ -14,7 +14,8 @@ and the (small, immutable) config travel in the closure.
 from __future__ import annotations
 
 
-def make_train_step(step_fn, cfg=None, donate=True, **step_kw):
+def make_train_step(step_fn, cfg=None, donate=True, extra_donate=(),
+                    **step_kw):
     """jit the stacked-params functional train step with the params and
     optimizer-state buffers DONATED — step_fn(params, opt_state, batch,
     ...) -> (loss, new_params, new_opt_state) consumes both trees and
@@ -30,13 +31,20 @@ def make_train_step(step_fn, cfg=None, donate=True, **step_kw):
     `parallel.resilience.make_resilient_step` layers the fault-tolerance
     guard (non-finite skip-step + rollback/watchdog plumbing) over this
     same builder — use it instead when the loop must survive NaNs, hung
-    dispatch, or restarts (docs/fault_tolerance.md)."""
+    dispatch, or restarts (docs/fault_tolerance.md). `extra_donate`
+    names additional positional arg indices to donate — the telemetry
+    accumulator (profiler/telemetry.py) rides through the step donated
+    exactly like the params/opt buffers."""
     import functools
     import jax
+    from ..profiler import RecordEvent, monitor
     if cfg is not None:
         step_kw["cfg"] = cfg
     fn = functools.partial(step_fn, **step_kw) if step_kw else step_fn
-    return jax.jit(fn, donate_argnums=(0, 1) if donate else ())
+    donate_argnums = ((0, 1) + tuple(extra_donate)) if donate else ()
+    with RecordEvent("facade.make_train_step"):
+        monitor.counter("facade_train_step_builds").add()
+        return jax.jit(fn, donate_argnums=donate_argnums)
 
 
 class FacadeModel:

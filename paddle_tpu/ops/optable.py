@@ -158,7 +158,9 @@ ALIASES: Dict[str, str] = {
     "spectral_norm": "nn.utils:spectral_norm",
     "warprnnt": "nn.functional:rnnt_loss",
     "accuracy": "metric:accuracy",
-    "auc": "metric:Auc",
+    # device-side histogram AUC op (metric.Auc remains the host
+    # accumulator facade over the same bucketing)
+    "auc": "op:auc",
     "edit_distance": "text:edit_distance",
 }
 
@@ -211,7 +213,7 @@ def _registry():
     for m in ("paddle_tpu.ops", "paddle_tpu.nn.functional", "paddle_tpu.nn",
               "paddle_tpu.optimizer", "paddle_tpu.amp", "paddle_tpu.linalg",
               "paddle_tpu.fft", "paddle_tpu.signal",
-              "paddle_tpu.kernels.flash_attention"):
+              "paddle_tpu.kernels.flash_attention", "paddle_tpu.metric"):
         importlib.import_module(m)
     return _OP_REGISTRY
 

@@ -244,7 +244,23 @@ def save_sharded(state, path: str, process_index: Optional[int] = None,
     sharded checkpoint directory — crash-safely. Each host writes only its
     addressable replica-0 shards; host 0 writes the manifest (the commit
     marker) and, when `update_pointer`, the sibling `LATEST` file. Every
-    shard records a CRC32 + byte size in the manifest. Returns `path`."""
+    shard records a CRC32 + byte size in the manifest. Returns `path`.
+
+    Observability (docs/observability.md): a `checkpoint.save` host span
+    plus `checkpoint_save` / `checkpoint_save_ms` monitor stats."""
+    from ..profiler import RecordEvent, monitor
+    import time as _time
+    t0 = _time.perf_counter()
+    with RecordEvent("checkpoint.save"):
+        out = _save_sharded_impl(state, path, process_index, update_pointer)
+    monitor.counter("checkpoint_save").add()
+    monitor.gauge("checkpoint_save_ms").set(
+        (_time.perf_counter() - t0) * 1e3)
+    return out
+
+
+def _save_sharded_impl(state, path: str, process_index: Optional[int],
+                       update_pointer: bool) -> str:
     path = os.path.abspath(path)
     pidx = jax.process_index() if process_index is None else process_index
     # an EXPLICIT process_index means "simulate one host of a multi-host
@@ -417,12 +433,15 @@ def verify_checkpoint(path: str) -> Dict[str, Any]:
     """Full integrity pass: manifest parses and every shard file matches
     its recorded byte size and CRC32. Raises CheckpointCorruptError on the
     first violation; returns the manifest on success."""
-    manifest = _load_manifest(path)
-    for entry in manifest["leaves"].values():
-        if entry["kind"] != "array":
-            continue
-        for sh in entry["shards"]:
-            _verify_shard_stream(path, sh)
+    from ..profiler import RecordEvent, monitor
+    with RecordEvent("checkpoint.verify"):
+        manifest = _load_manifest(path)
+        for entry in manifest["leaves"].values():
+            if entry["kind"] != "array":
+                continue
+            for sh in entry["shards"]:
+                _verify_shard_stream(path, sh)
+    monitor.counter("checkpoint_verify").add()
     return manifest
 
 
@@ -515,6 +534,14 @@ def load_sharded(path: str, mesh=_UNSET, specs: Optional[Dict[str, P]] = None,
     pointer (a CheckpointManager root), the pointed-to snapshot is loaded
     — with transparent fallback to the newest previous intact snapshot
     when the pointed one is truncated or corrupt."""
+    from ..profiler import RecordEvent, monitor
+    with RecordEvent("checkpoint.load"):
+        out = _load_sharded_impl(path, mesh, specs, template, verify)
+    monitor.counter("checkpoint_load").add()
+    return out
+
+
+def _load_sharded_impl(path, mesh, specs, template, verify):
     if mesh is _UNSET:
         mesh = get_mesh()
     if not os.path.exists(os.path.join(path, _MANIFEST)):
@@ -718,6 +745,7 @@ class CheckpointManager:
         CRC/manifest verification are skipped (newest-first), so a torn or
         bit-flipped newest snapshot transparently falls back to the
         previous one."""
+        from ..profiler import monitor
         for cand in self._candidates():
             try:
                 verify_checkpoint(cand)
@@ -726,7 +754,12 @@ class CheckpointManager:
                 state = load_sharded(cand, mesh=mesh, specs=specs,
                                      template=template, verify=False)
             except CheckpointCorruptError:
+                # the pointed/newest snapshot was torn or bit-rotted and
+                # the restore is falling back to an older one — the count
+                # a production run alerts on (docs/observability.md)
+                monitor.counter("checkpoint_fallback_restore").add()
                 continue
+            monitor.counter("checkpoint_restore").add()
             return state, self._step_of(cand)
         return None, None
 

@@ -67,7 +67,8 @@ class ResilienceConfig:
     exit_on_hang: bool = False     # sys.exit(ELASTIC_EXIT_CODE) on hang
 
 
-def make_resilient_step(step_fn, cfg=None, donate: bool = True, **step_kw):
+def make_resilient_step(step_fn, cfg=None, donate: bool = True,
+                        telemetry=None, **step_kw):
     """Build the guarded jitted step:
     `(params, opt_state, batch, poison) -> (loss, params', opt', ok)`.
 
@@ -81,7 +82,15 @@ def make_resilient_step(step_fn, cfg=None, donate: bool = True, **step_kw):
     overflow while the loss is still finite — committing, let alone
     snapshotting, NaN params would defeat rollback); when not ok the
     returned trees are the (unchanged) inputs and the returned loss is
-    nan, so ONE host pull of the loss communicates both values."""
+    nan, so ONE host pull of the loss communicates both values.
+
+    With `telemetry` (a profiler.telemetry.TelemetryPipeline) the step
+    additionally takes and returns the donated device accumulator —
+    `(params, opt_state, batch, poison, tstate) -> (loss, params',
+    opt', ok, tstate')` — recording the RAW (pre-select) loss, update
+    global-norm, param global-norm and non-finite count in-jit, so a
+    diverging run's telemetry shows the actual blow-up, not the
+    nan-folded skip."""
     import jax
     import jax.numpy as jnp
     from ..models.facade import make_train_step
@@ -96,7 +105,7 @@ def make_resilient_step(step_fn, cfg=None, donate: bool = True, **step_kw):
                 fin &= jnp.all(jnp.isfinite(leaf))
         return fin
 
-    def guarded(params, opt_state, batch, poison):
+    def guard(params, opt_state, batch, poison):
         loss, new_params, new_opt = inner(params, opt_state, batch)
         loss = loss * poison
         ok = (jnp.isfinite(loss) & tree_finite(new_params)
@@ -105,13 +114,48 @@ def make_resilient_step(step_fn, cfg=None, donate: bool = True, **step_kw):
         def keep(new, old):
             return jnp.where(ok, new, old)
 
-        new_params = jax.tree_util.tree_map(keep, new_params, params)
-        new_opt = jax.tree_util.tree_map(keep, new_opt, opt_state)
-        return jnp.where(ok, loss, jnp.nan), new_params, new_opt, ok
+        kept_params = jax.tree_util.tree_map(keep, new_params, params)
+        kept_opt = jax.tree_util.tree_map(keep, new_opt, opt_state)
+        return loss, new_params, kept_params, kept_opt, ok
 
-    # the facade owns the jit/donation policy (ONE home — see
-    # models/facade.py); the guard only adds the select + ok flag
-    return make_train_step(guarded, donate=donate)
+    def guarded(params, opt_state, batch, poison):
+        loss, _raw_params, kept_params, kept_opt, ok = guard(
+            params, opt_state, batch, poison)
+        return jnp.where(ok, loss, jnp.nan), kept_params, kept_opt, ok
+
+    if telemetry is None:
+        # the facade owns the jit/donation policy (ONE home — see
+        # models/facade.py); the guard only adds the select + ok flag
+        return make_train_step(guarded, donate=donate)
+
+    from ..profiler.telemetry import global_norm, nonfinite_count
+
+    def guarded_telemetry(params, opt_state, batch, poison, tstate):
+        loss, raw_params, kept_params, kept_opt, ok = guard(
+            params, opt_state, batch, poison)
+        scalars = {
+            "loss": loss,                      # raw: shows the divergence
+            "update_norm": global_norm(jax.tree_util.tree_map(
+                lambda n, o: jnp.asarray(n, jnp.float32)
+                - jnp.asarray(o, jnp.float32), raw_params, params)),
+            "param_norm": global_norm(kept_params),
+            "nonfinite": nonfinite_count(raw_params),
+            "ok": ok,
+        }
+        tstate = telemetry.device_record(
+            tstate, **{k: v for k, v in scalars.items()
+                       if k in telemetry.fields})
+        return (jnp.where(ok, loss, jnp.nan), kept_params, kept_opt, ok,
+                tstate)
+
+    return make_train_step(guarded_telemetry, donate=donate,
+                           extra_donate=(4,))
+
+
+# telemetry field layout for the resilient trainer's pipeline (the
+# default DEFAULT_FIELDS carries grad_norm/lr, which the guarded step
+# cannot see — pass these to TelemetryPipeline(fields=...))
+RESILIENT_FIELDS = ("loss", "update_norm", "param_norm", "nonfinite", "ok")
 
 
 def pull_with_watchdog(value, timeout: float, retries: int = 3,
@@ -180,15 +224,22 @@ class ResilientTrainer:
                  manager: Optional[CheckpointManager] = None,
                  config: Optional[ResilienceConfig] = None,
                  step: int = 0, donate: bool = True, mesh=_UNSET,
-                 specs=None, **step_kw):
+                 specs=None, telemetry=None, **step_kw):
         self.config = config or ResilienceConfig()
         # restore layout: rollback must reload onto the SAME mesh/specs
         # the trainer resumed/trained with, not whatever mesh is ambient
         # at rollback time
         self._mesh = mesh
         self._specs = specs
+        self.telemetry = telemetry
         self._guarded = make_resilient_step(step_fn, cfg=cfg,
-                                            donate=donate, **step_kw)
+                                            donate=donate,
+                                            telemetry=telemetry, **step_kw)
+        # created lazily at the first step so the device cursor seeds
+        # from the RESUMED step (maybe_resume runs after __init__): a
+        # restarted worker's records then continue the shared JSONL's id
+        # space instead of re-emitting step 0.. over the pre-crash ones
+        self._tstate = None
         self.params = params
         self.opt_state = opt_state
         self.step = int(step)
@@ -200,6 +251,24 @@ class ResilientTrainer:
         from ..distributed.launch import heartbeat
         heartbeat.start_from_env()
         self._heartbeat = heartbeat
+        # observability: monitor counters + the crash flight recorder
+        # (dumps are no-ops until $PADDLE_TPU_FLIGHT_DIR is set — the
+        # launcher exports it per worker)
+        from ..profiler import monitor
+        from ..profiler import flight_recorder
+        self._mon_skip = monitor.counter("resilience_skip_step")
+        self._mon_rollback = monitor.counter("resilience_rollback")
+        self._mon_hang = monitor.counter("resilience_watchdog_hang")
+        self._mon_steps = monitor.counter("resilience_steps")
+        self._mon_step_ms = monitor.gauge("resilience_step_ms")
+        self._flight = flight_recorder.recorder()
+        self._flight.install_exit_hooks()
+        c = self.config
+        self._flight.configure(
+            trainer="ResilientTrainer", start_step=self.step,
+            rollback_after=c.rollback_after, max_rollbacks=c.max_rollbacks,
+            checkpoint_every=c.checkpoint_every,
+            watchdog_timeout=c.watchdog_timeout)
 
     # ------------------------------------------------------------- resume
     def maybe_resume(self, mesh=_UNSET, specs=None) -> bool:
@@ -239,12 +308,20 @@ class ResilientTrainer:
         exits with ELASTIC_EXIT_CODE when it is on. After a hang the
         trainer's buffers are donated-away — a restarted process must
         resume via `maybe_resume()`."""
+        import time as _time
         c = self.config
+        t0 = _time.perf_counter()
         poison = 1.0
         if _STEP_HOOK is not None:
             poison = _STEP_HOOK(self.step)
-        loss, params, opt, ok = self._guarded(
-            self.params, self.opt_state, batch, poison)
+        if self.telemetry is not None:
+            if self._tstate is None:
+                self._tstate = self.telemetry.device_init(start=self.step)
+            loss, params, opt, ok, self._tstate = self._guarded(
+                self.params, self.opt_state, batch, poison, self._tstate)
+        else:
+            loss, params, opt, ok = self._guarded(
+                self.params, self.opt_state, batch, poison)
         del ok                 # the guarded step folds every badness
         #                        (non-finite loss OR params OR opt) into a
         #                        nan loss, so ok derives from the one loss
@@ -257,16 +334,27 @@ class ResilientTrainer:
                 loss, c.watchdog_timeout, c.retries, c.backoff_base,
                 c.backoff_max, label=f"step {self.step}"))
         except StepHungError as e:
+            self._mon_hang.add()
+            self._flight.configure(last_error=str(e))
             if c.exit_on_hang:
+                self._flight.dump("watchdog_elastic_exit")
                 print(f"[resilience] {e}; exiting "
                       f"{ELASTIC_EXIT_CODE} for elastic restart",
                       file=sys.stderr, flush=True)
                 sys.exit(ELASTIC_EXIT_CODE)
+            self._flight.dump("watchdog_hang")
             raise
         ok_host = bool(np.isfinite(loss_host))
         self.params, self.opt_state = params, opt
         self._heartbeat.pulse()
         self.step += 1
+        dur_s = _time.perf_counter() - t0
+        self._mon_steps.add()
+        self._mon_step_ms.set(dur_s * 1e3)
+        self._flight.note(step=self.step - 1, loss=loss_host, ok=ok_host,
+                          dur_s=round(dur_s, 6))
+        if self.telemetry is not None:
+            self._tstate = self.telemetry.tick(self.step - 1, self._tstate)
         if ok_host:
             self._bad_streak = 0
             if (self.manager is not None and c.checkpoint_every > 0
@@ -275,6 +363,7 @@ class ResilientTrainer:
         else:
             self.skipped += 1
             self._bad_streak += 1
+            self._mon_skip.add()
             print(f"[resilience] non-finite loss at step "
                   f"{self.step - 1}: update skipped "
                   f"({self._bad_streak}/{c.rollback_after} before "
@@ -284,6 +373,9 @@ class ResilientTrainer:
         return loss_host, ok_host
 
     def _rollback(self) -> None:
+        self._mon_rollback.add()
+        # the black box captures the bad streak BEFORE the state rewinds
+        self._flight.dump("rollback")
         if self.manager is None:
             # nothing to roll back to: reset the streak so training can
             # limp on with skips alone

@@ -15,15 +15,25 @@ TPU-native design — two complementary recorders behind one API:
   `jax.profiler.start_trace/stop_trace`, producing a TensorBoard-loadable
   XLA trace with per-op device timelines; `RecordEvent` doubles as a
   `jax.profiler.TraceAnnotation` so host spans appear on that timeline too.
+  `export_chrome_trace(path)` additionally renders the host spans as a
+  standalone chrome-trace JSON (Perfetto / chrome://tracing), written
+  beside the device trace on Profiler.stop().
+
+Runtime telemetry substrate (docs/observability.md): `monitor` is the
+thread-safe counter/gauge registry (platform/monitor.h analog) the
+instrumented hot paths publish into; `telemetry` is the batched
+step-metrics JSONL pipeline; `flight_recorder` is the crash black box.
 """
 from __future__ import annotations
 
 import enum
+import json
 import threading
 import time
 from typing import Callable, Iterable, Optional
 
 from .timer import benchmark  # noqa: F401  (reference: profiler/timer.py)
+from . import monitor  # noqa: F401  (reference: platform/monitor.h)
 
 
 class ProfilerState(enum.Enum):
@@ -77,7 +87,7 @@ class _SpanLog:
     def __init__(self):
         self._lock = threading.Lock()
         self._tls = threading.local()
-        self.spans = []          # (name, start, dur_s, depth)
+        self.spans = []          # (name, start, dur_s, depth, tid)
         self.enabled = True
 
     def depth(self) -> int:
@@ -92,7 +102,7 @@ class _SpanLog:
         if self.enabled:
             with self._lock:
                 self.spans.append((name, start, time.perf_counter() - start,
-                                   d))
+                                   d, threading.get_ident()))
 
     def clear(self):
         with self._lock:
@@ -144,6 +154,39 @@ class RecordEvent:
             with RecordEvent(self.name):
                 return fn(*a, **k)
         return wrapped
+
+
+def export_chrome_trace(path: str, spans=None) -> str:
+    """Write the completed host spans as a chrome-trace JSON file
+    (reference ChromeTracingLogger, chrometracing_logger.h:31): complete
+    "X" events with microsecond ts/dur keyed by pid/tid, loadable in
+    Perfetto / chrome://tracing and by TensorBoard's trace viewer. The
+    jax.profiler device trace (when a trace dir is active) is a separate
+    TensorBoard artifact; this file covers the HOST side — dispatch,
+    checkpoint IO, launcher phases — with zero device involvement.
+
+    Atomic tmp+rename write; returns `path`."""
+    import os
+    spans = _LOG.spans if spans is None else spans
+    pid = os.getpid()
+    events = []
+    for rec in list(spans):
+        name, start, dur = rec[0], rec[1], rec[2]
+        tid = rec[4] if len(rec) > 4 else 0
+        events.append({
+            "name": name, "ph": "X", "cat": "host",
+            "ts": round(start * 1e6, 3), "dur": round(dur * 1e6, 3),
+            "pid": pid, "tid": tid,
+        })
+    doc = {"traceEvents": events, "displayTimeUnit": "ms",
+           "otherData": {"producer": "paddle_tpu.profiler"}}
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp-{pid}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+    return path
 
 
 def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
@@ -212,6 +255,15 @@ class Profiler:
         benchmark().end()
         if self._on_trace_ready is not None:
             self._on_trace_ready(self)
+        if self._trace_dir is not None and not self.timer_only:
+            # host spans beside the jax.profiler device trace: one
+            # Perfetto/chrome://tracing-loadable JSON per process
+            import os
+            try:
+                export_chrome_trace(os.path.join(
+                    self._trace_dir, f"host_trace.{os.getpid()}.json"))
+            except OSError:
+                pass
         self.current_state = ProfilerState.CLOSED
 
     def step(self, num_samples: Optional[int] = None):
@@ -253,7 +305,7 @@ class Profiler:
         profiler_statistic tables, host side)."""
         unit = {"s": 1.0, "ms": 1e3, "us": 1e6}.get(time_unit, 1e3)
         agg = {}
-        for name, _start, dur, _depth in _LOG.spans:
+        for name, _start, dur, _depth, *_tid in _LOG.spans:
             c, tot, mx = agg.get(name, (0, 0.0, 0.0))
             agg[name] = (c + 1, tot + dur, max(mx, dur))
         lines = [f"{'name':<40} {'calls':>6} {'total':>10} {'avg':>10} "
@@ -326,6 +378,15 @@ def cost_analysis(fn, *example_args, **jit_kwargs):
             pass
     out["raw"] = dict(raw)
     return out
+
+
+def __getattr__(name):
+    # telemetry / flight_recorder pull in jax lazily; loading them only
+    # on attribute access keeps `import paddle_tpu.profiler` backend-free
+    if name in ("telemetry", "flight_recorder"):
+        import importlib
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 class SortedKeys(enum.Enum):

@@ -1,0 +1,198 @@
+"""Crash flight recorder: the black box for the fault-tolerant runtime.
+
+Reference analog: none in-tree — the reference's post-mortem story is
+log scraping (SURVEY §5). PR 2's runtime (skip-step, rollback, watchdog,
+elastic restart) recovers from faults but kept no record of what the
+last steps looked like; this module is that record: a bounded ring of
+the last N host-side step records plus the monitor snapshot, the run
+config, and the most recent host spans, dumped as ONE JSON file via the
+checkpoint module's tmp+rename idiom.
+
+Dump triggers (wired in parallel/resilience.py, distributed/launch/
+main.py and hapi/callbacks.py):
+- watchdog fire (StepHungError / elastic exit-101),
+- rollback,
+- process exit with a failure (atexit + sys.excepthook),
+- and a low-cost per-step autodump (no fsync: an `os._exit` hard kill
+  skips atexit, but page-cache contents survive process death — only a
+  machine crash can lose the last autodump, and that scenario is the
+  checkpoint manifest's job, not the flight recorder's).
+
+The dump directory comes from $PADDLE_TPU_FLIGHT_DIR (the launcher
+exports it per worker); with no directory configured every call is a
+cheap no-op, so production code paths stay instrumented
+unconditionally.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from . import monitor
+
+ENV_DIR = "PADDLE_TPU_FLIGHT_DIR"
+ENV_N = "PADDLE_TPU_FLIGHT_N"            # ring size (default 64)
+ENV_AUTODUMP = "PADDLE_TPU_FLIGHT_AUTODUMP"  # steps between autodumps (1)
+
+
+class FlightRecorder:
+    """Bounded ring of step records + context, atomically dumpable."""
+
+    def __init__(self, dir: Optional[str] = None, n: Optional[int] = None,
+                 autodump_every: Optional[int] = None):
+        self._lock = threading.Lock()
+        self.dir = dir if dir is not None else os.environ.get(ENV_DIR)
+        n = n if n is not None else int(os.environ.get(ENV_N, "64"))
+        self._ring: deque = deque(maxlen=max(int(n), 1))
+        self.autodump_every = (autodump_every if autodump_every is not None
+                               else int(os.environ.get(ENV_AUTODUMP, "1")))
+        self.config: dict = {}
+        self._notes = 0
+        self._hooks_installed = False
+
+    # ------------------------------------------------------------ recording
+    def set_dir(self, dir: Optional[str]) -> None:
+        self.dir = dir
+
+    def configure(self, **run_config) -> None:
+        """Merge run-level context (model/resilience config, world size,
+        argv...) into the dump header."""
+        with self._lock:
+            self.config.update(run_config)
+
+    def note(self, **record) -> None:
+        """Append one step record (host-side scalars only — this runs
+        after the step's own host pull, it must never force one)."""
+        record.setdefault("t", time.time())
+        with self._lock:
+            self._ring.append(record)
+            self._notes += 1
+            due = (self.dir and self.autodump_every > 0
+                   and self._notes % self.autodump_every == 0)
+        if due:
+            self.dump("periodic", fsync=False)
+
+    def records(self) -> list:
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._notes = 0
+            self.config = {}
+
+    # -------------------------------------------------------------- dumping
+    def _default_path(self, reason: str) -> Optional[str]:
+        if not self.dir:
+            return None
+        # rolling reasons share one file; eventful triggers (rollback,
+        # watchdog, exception...) get a reason-tagged file so later
+        # periodic autodumps cannot overwrite the evidence
+        if reason in ("periodic", "exit"):
+            return os.path.join(self.dir, f"flight-{os.getpid()}.json")
+        safe = "".join(c if c.isalnum() or c in "-_" else "_"
+                       for c in reason)
+        return os.path.join(self.dir, f"flight-{os.getpid()}-{safe}.json")
+
+    def dump(self, reason: str, path: Optional[str] = None,
+             fsync: bool = True) -> Optional[str]:
+        """Write the black box as one JSON file via tmp+rename (the
+        checkpoint crash-safety idiom: readers never see a torn file).
+        Returns the path, or None when no directory is configured."""
+        path = path or self._default_path(reason)
+        if path is None:
+            return None
+        with self._lock:
+            doc = {
+                "kind": "flight_recorder",
+                "reason": reason,
+                "t": time.time(),
+                "pid": os.getpid(),
+                "config": dict(self.config),
+                "steps": list(self._ring),
+                "monitor": monitor.snapshot(),
+            }
+        try:
+            from .. import profiler as _prof
+            doc["spans"] = [
+                {"name": n, "start": s, "dur_s": d, "depth": depth}
+                for (n, s, d, depth, *_t) in _prof.get_profiler_spans()[-64:]]
+        except Exception:
+            pass
+        try:
+            os.makedirs(os.path.dirname(os.path.abspath(path)),
+                        exist_ok=True)
+            tmp = f"{path}.tmp-{os.getpid()}"
+            with open(tmp, "w") as f:
+                f.write(json.dumps(doc))
+                if fsync:
+                    f.flush()
+                    os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except OSError as e:
+            print(f"[flight] dump failed: {e}", file=sys.stderr, flush=True)
+            return None
+        return path
+
+    # ----------------------------------------------------------- exit hooks
+    def install_exit_hooks(self) -> None:
+        """Dump on process exit (atexit) and on uncaught exceptions.
+        Idempotent; a no-op until a dump directory is configured —
+        ResilientTrainer calls this unconditionally."""
+        if self._hooks_installed:
+            return
+        self._hooks_installed = True
+        import atexit
+
+        def _on_exit():
+            if self._ring and self.dir:
+                self.dump("exit")
+        atexit.register(_on_exit)
+
+        prev = sys.excepthook
+
+        def _on_exc(exc_type, exc, tb):
+            try:
+                self.configure(last_exception=f"{exc_type.__name__}: {exc}")
+                if self.dir:
+                    self.dump("exception")
+            finally:
+                prev(exc_type, exc, tb)
+        sys.excepthook = _on_exc
+
+
+_RECORDER: Optional[FlightRecorder] = None
+_RECORDER_LOCK = threading.Lock()
+
+
+def recorder() -> FlightRecorder:
+    """The process-wide recorder (created lazily so $PADDLE_TPU_FLIGHT_DIR
+    set by the launcher's boot shim is read after it is exported)."""
+    global _RECORDER
+    with _RECORDER_LOCK:
+        if _RECORDER is None:
+            _RECORDER = FlightRecorder()
+        return _RECORDER
+
+
+def note(**record) -> None:
+    recorder().note(**record)
+
+
+def dump(reason: str, path: Optional[str] = None) -> Optional[str]:
+    return recorder().dump(reason, path)
+
+
+def load_dump(path: str) -> dict:
+    """Parse a flight dump back (chaos-drill assertions / post-mortems)."""
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("kind") != "flight_recorder":
+        raise ValueError(f"{path!r} is not a flight-recorder dump")
+    return doc

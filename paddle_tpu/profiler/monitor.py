@@ -1,0 +1,174 @@
+"""Exported runtime monitors: a thread-safe counter/gauge registry.
+
+Reference analog: paddle/fluid/platform/monitor.h:1 (the whole small
+header: `StatValue<T>` slots + the `StatRegistry<int64_t>` /
+`StatRegistry<float>` singletons PS and fleet components publish into
+via `STAT_ADD(item, t)` / `STAT_INT(item)`; monitor.cc:1 instantiates
+the registries — SURVEY §5 "Metrics/logging/observability"). Here one
+registry holds both kinds — `Counter` (monotonic int, the STAT_INT
+analog) and `Gauge` (last-written float, the STAT_FLOAT analog) — and
+`snapshot()` renders it for the telemetry JSONL stream and the flight
+recorder.
+
+Design constraints:
+- import-light: framework/dispatch.py increments counters on the eager
+  hot path, so this module must not import jax/numpy at module load.
+- thread-safe: the resilient trainer's watchdog pull thread, the
+  telemetry writer thread and user threads all publish concurrently
+  (tests/test_telemetry.py hammers one counter from N threads).
+- cheap: one small lock per stat; handles are resolved once and cached
+  by the instrumented call sites, so the steady-state cost is
+  lock+add.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, Union
+
+
+class Counter:
+    """Monotonic integer stat (STAT_INT analog)."""
+
+    kind = "counter"
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def add(self, n: int = 1) -> int:
+        with self._lock:
+            self._value += int(n)
+            return self._value
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+    def reset(self) -> None:
+        with self._lock:
+            self._value = 0
+
+
+class Gauge:
+    """Last-written float stat (STAT_FLOAT analog)."""
+
+    kind = "gauge"
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, v: float) -> float:
+        with self._lock:
+            self._value = float(v)
+            return self._value
+
+    def add(self, v: float = 1.0) -> float:
+        with self._lock:
+            self._value += float(v)
+            return self._value
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def reset(self) -> None:
+        with self._lock:
+            self._value = 0.0
+
+
+Stat = Union[Counter, Gauge]
+
+
+class MonitorRegistry:
+    """The process-wide stat table (StatRegistry::Instance analog)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats: Dict[str, Stat] = {}
+
+    def _get(self, name: str, cls) -> Stat:
+        with self._lock:
+            stat = self._stats.get(name)
+            if stat is None:
+                stat = cls(name)
+                self._stats[name] = stat
+            elif not isinstance(stat, cls):
+                raise TypeError(
+                    f"monitor stat {name!r} already registered as "
+                    f"{stat.kind}, requested {cls.kind}")
+            return stat
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def get(self, name: str):
+        with self._lock:
+            return self._stats.get(name)
+
+    def snapshot(self) -> Dict[str, Union[int, float]]:
+        """name -> value for every registered stat, name-sorted (stable
+        JSONL/flight-dump layout)."""
+        with self._lock:
+            stats = list(self._stats.values())
+        return {s.name: s.value for s in sorted(stats, key=lambda s: s.name)}
+
+    def reset(self) -> None:
+        """Zero every stat (tests). Handles stay valid — call sites cache
+        them."""
+        with self._lock:
+            stats = list(self._stats.values())
+        for s in stats:
+            s.reset()
+
+    def export_jsonl(self, path: str) -> None:
+        """Append one monitor-snapshot line to a telemetry JSONL file
+        (the schema tools/telemetry_report.py consumes)."""
+        line = json.dumps({"kind": "monitor", "t": time.time(),
+                           "pid": os.getpid(), "stats": self.snapshot()})
+        with open(path, "a") as f:
+            f.write(line + "\n")
+
+
+_REGISTRY = MonitorRegistry()
+
+
+def registry() -> MonitorRegistry:
+    """The process-wide registry singleton."""
+    return _REGISTRY
+
+
+def counter(name: str) -> Counter:
+    """Get-or-create the named counter (resolve once, cache the handle
+    at hot call sites)."""
+    return _REGISTRY.counter(name)
+
+
+def gauge(name: str) -> Gauge:
+    """Get-or-create the named gauge."""
+    return _REGISTRY.gauge(name)
+
+
+def snapshot() -> Dict[str, Union[int, float]]:
+    return _REGISTRY.snapshot()
+
+
+# reference-shaped conveniences (monitor.h STAT_ADD / STAT_SETTER)
+def stat_add(name: str, n: int = 1) -> int:
+    return _REGISTRY.counter(name).add(n)
+
+
+def stat_set(name: str, v: float) -> float:
+    return _REGISTRY.gauge(name).set(v)

@@ -77,10 +77,15 @@ class Benchmark:
             return {"steps": 0}
         total = sum(costs)
         n = len(costs)
+        ordered = sorted(costs)
         out = {
             "steps": n,
+            "samples": sum(samples),
             "avg_batch_cost_s": total / n,
-            "p50_batch_cost_s": sorted(costs)[n // 2],
+            "p50_batch_cost_s": ordered[n // 2],
+            # nearest-rank p95: the tail a p50/avg pair hides (one slow
+            # reader stall or tunnel flap per 20 steps shows up here)
+            "p95_batch_cost_s": ordered[max(0, -(-95 * n // 100) - 1)],
         }
         tot_samples = sum(samples)
         if tot_samples:

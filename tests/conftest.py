@@ -78,7 +78,7 @@ SMOKE_FILES = {
     "test_multiprocess_loader.py", "test_inference.py", "test_int8.py",
     # high-level API + aux subsystems
     "test_hapi.py", "test_profiler.py", "test_checkpoint.py",
-    "test_tokenizer.py", "test_misc_modules.py",
+    "test_tokenizer.py", "test_misc_modules.py", "test_telemetry.py",
     # fault-tolerance runtime (in-process; the subprocess chaos drills in
     # test_chaos_drill.py stay full-suite-only)
     "test_fault_tolerance.py", "test_checkpoint_edges.py",

@@ -1,0 +1,478 @@
+"""Observability substrate tests (PR 3).
+
+Reference analog: test coverage for paddle/fluid/platform/monitor.h
+(STAT registries), the profiler's chrome-trace export
+(chrome_tracing.cc) and the fleet AUC metrics (fleet/metrics.cc) — plus
+the TPU-native contracts those analogs never needed: the batched
+step-metrics pipeline's "zero extra host syncs between flush
+boundaries" rule and the crash flight recorder's dump round-trip.
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.profiler import (RecordEvent, clear_profiler_spans,
+                                 export_chrome_trace, monitor)
+
+
+# ---------------------------------------------------------------- monitor
+class TestMonitor:
+    def test_counter_gauge_snapshot(self):
+        reg = monitor.MonitorRegistry()
+        c = reg.counter("a_count")
+        c.add()
+        c.add(4)
+        g = reg.gauge("b_ms")
+        g.set(12.5)
+        assert reg.snapshot() == {"a_count": 5, "b_ms": 12.5}
+        assert reg.counter("a_count") is c          # get-or-create
+        reg.reset()
+        assert reg.snapshot() == {"a_count": 0, "b_ms": 0.0}
+
+    def test_kind_clash_raises(self):
+        reg = monitor.MonitorRegistry()
+        reg.counter("x")
+        with pytest.raises(TypeError):
+            reg.gauge("x")
+
+    def test_concurrent_updates_exact(self):
+        """The monitor.h analog must survive concurrent STAT_ADDs: N
+        threads x M increments land exactly."""
+        reg = monitor.MonitorRegistry()
+        c = reg.counter("hammer")
+        g = reg.gauge("hammer_g")
+        threads, per = 8, 2000
+
+        def work():
+            for _ in range(per):
+                c.add()
+                g.add(1.0)
+
+        ts = [threading.Thread(target=work) for _ in range(threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert c.value == threads * per
+        assert g.value == float(threads * per)
+
+    def test_jsonl_export(self, tmp_path):
+        reg = monitor.MonitorRegistry()
+        reg.counter("n").add(3)
+        path = str(tmp_path / "mon.jsonl")
+        reg.export_jsonl(path)
+        reg.export_jsonl(path)
+        lines = [json.loads(ln) for ln in open(path)]
+        assert len(lines) == 2
+        assert lines[0]["kind"] == "monitor"
+        assert lines[0]["stats"]["n"] == 3
+
+    def test_global_registry_helpers(self):
+        name = "test_global_helper_stat"
+        before = monitor.counter(name).value
+        monitor.stat_add(name, 2)
+        assert monitor.snapshot()[name] == before + 2
+
+
+# ---------------------------------------------------------- chrome trace
+class TestChromeTrace:
+    def test_export_valid_json_with_nesting(self, tmp_path):
+        clear_profiler_spans()
+        with RecordEvent("outer"):
+            with RecordEvent("inner"):
+                time.sleep(0.002)
+        path = export_chrome_trace(str(tmp_path / "trace.json"))
+        with open(path) as f:
+            doc = json.load(f)                      # valid JSON
+        events = doc["traceEvents"]
+        by = {e["name"]: e for e in events}
+        assert set(by) >= {"outer", "inner"}
+        for e in events:
+            assert e["ph"] == "X"
+            assert e["dur"] >= 0
+            assert {"ts", "pid", "tid", "name"} <= set(e)
+        # X-event nesting: the inner span's [ts, ts+dur] window sits
+        # inside the outer's on the same tid
+        o, i = by["outer"], by["inner"]
+        assert o["tid"] == i["tid"]
+        assert i["ts"] >= o["ts"]
+        assert i["ts"] + i["dur"] <= o["ts"] + o["dur"] + 1e-3
+
+    def test_export_after_profiled_block(self, tmp_path):
+        from paddle_tpu.profiler import Profiler
+        clear_profiler_spans()
+        with Profiler(timer_only=True) as p:
+            with RecordEvent("step"):
+                pass
+            p.step()
+        path = export_chrome_trace(str(tmp_path / "t.json"))
+        assert json.load(open(path))["traceEvents"]
+
+
+# ------------------------------------------------------ telemetry pipeline
+def _toy_step(params, opt_state, batch, lr=0.1):
+    import jax
+    import jax.numpy as jnp
+    x, y = batch
+
+    def loss_fn(p):
+        return jnp.mean((x @ p["w"] - y) ** 2)
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    new_m = jax.tree_util.tree_map(
+        lambda m, g: 0.9 * m + 0.1 * g, opt_state["m"], grads)
+    new_params = jax.tree_util.tree_map(
+        lambda p, m: p - lr * m, params, new_m)
+    return loss, new_params, {"m": new_m}
+
+
+class TestTelemetryPipeline:
+    def _run(self, tmp_path, steps=8, every=4):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.profiler import telemetry
+        path = str(tmp_path / "run.jsonl")
+        tele = telemetry.TelemetryPipeline(path, every=every,
+                                           meta={"samples_per_step": 3})
+        params = {"w": jnp.ones((4, 2))}
+        opt = {"m": {"w": jnp.zeros((4, 2))}}
+        batch = (jnp.ones((3, 4)), jnp.zeros((3, 2)))
+        step = telemetry.instrument_train_step(_toy_step, tele, lr=0.1,
+                                               beta1=0.9)
+        tstate = tele.device_init()
+        pulls = []
+        orig_pull = telemetry._host_pull
+
+        def counting_pull(x):
+            pulls.append(1)
+            return orig_pull(x)
+
+        telemetry._host_pull = counting_pull
+        try:
+            # zero extra host syncs between flush boundaries: the whole
+            # loop runs under transfer_guard("disallow") — the flush's
+            # jax.device_get is an EXPLICIT transfer and stays legal,
+            # while any per-step implicit pull/push trips the guard
+            with jax.transfer_guard("disallow"):
+                for i in range(steps):
+                    loss, params, opt, tstate = step(params, opt, batch,
+                                                     tstate)
+                    tstate = tele.tick(i, tstate)
+        finally:
+            telemetry._host_pull = orig_pull
+        tele.close(tstate)
+        return path, pulls
+
+    def test_flush_cadence_one_pull_per_window(self, tmp_path):
+        path, pulls = self._run(tmp_path, steps=8, every=4)
+        assert len(pulls) == 2                     # 8 steps / every=4
+        recs = [json.loads(ln) for ln in open(path)]
+        steps = [r for r in recs if r["kind"] == "step"]
+        assert [r["step"] for r in steps] == list(range(8))
+        assert all(np.isfinite(r["loss"]) for r in steps)
+        assert all(r["nonfinite"] == 0 for r in steps)
+        # losses decrease on this convex toy problem
+        assert steps[-1]["loss"] < steps[0]["loss"]
+        flushes = [r for r in recs if r["kind"] == "flush"]
+        assert [f["step"] for f in flushes] == [3, 7]
+        monitors = [r for r in recs if r["kind"] == "monitor"]
+        assert len(monitors) == len(flushes)
+
+    def test_partial_tail_flushes_once_on_close(self, tmp_path):
+        path, pulls = self._run(tmp_path, steps=6, every=4)
+        recs = [json.loads(ln) for ln in open(path)]
+        steps = [r["step"] for r in recs if r["kind"] == "step"]
+        assert steps == list(range(6))             # no re-emits, no gaps
+
+    def test_grad_norm_matches_oracle(self, tmp_path):
+        """The moment-delta grad recovery is exact: step 0 from zero
+        moments gives norm(0.1*g)/0.1... i.e. the recorded grad_norm
+        equals the true gradient global-norm."""
+        import jax
+        import jax.numpy as jnp
+        path, _ = self._run(tmp_path, steps=4, every=4)
+        rec0 = next(json.loads(ln) for ln in open(path)
+                    if json.loads(ln)["kind"] == "step")
+        x = jnp.ones((3, 4))
+        y = jnp.zeros((3, 2))
+        g = jax.grad(lambda p: jnp.mean((x @ p["w"] - y) ** 2))(
+            {"w": jnp.ones((4, 2))})
+        true_norm = float(jnp.sqrt(sum(
+            jnp.sum(jnp.square(v)) for v in jax.tree_util.tree_leaves(g))))
+        assert rec0["grad_norm"] == pytest.approx(true_norm, rel=1e-4)
+
+    def test_resume_seeded_cursor_no_phantom_rows(self, tmp_path):
+        """A restarted trainer resumes mid-window (start % every != 0):
+        the first flush must emit only the rows THIS process wrote, not
+        null phantoms for the nan-filled slots below the seed."""
+        from paddle_tpu.profiler import telemetry
+        path = str(tmp_path / "resume.jsonl")
+        tele = telemetry.TelemetryPipeline(path, every=4)
+        ts = tele.device_init(start=6)
+        ts = tele.device_record(ts, loss=6.0)
+        ts = tele.device_record(ts, loss=7.0)
+        tele.flush(ts)                         # cursor at 8, a boundary
+        tele.close()
+        steps = [json.loads(ln) for ln in open(path)
+                 if json.loads(ln)["kind"] == "step"]
+        assert [r["step"] for r in steps] == [6, 7]
+        assert [r["loss"] for r in steps] == [6.0, 7.0]
+
+    def test_report_windows_split_at_restart(self, tmp_path):
+        """Flush windows must not span a kill/restart boundary — the
+        downtime + recompile gap would corrupt the step-time tail."""
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "tools"))
+        from telemetry_report import summarize
+        path = str(tmp_path / "restart.jsonl")
+        recs = [
+            {"kind": "run", "t": 0.0, "every": 2, "fields": ["loss"]},
+            {"kind": "step", "step": 0, "loss": 1.0},
+            {"kind": "step", "step": 1, "loss": 1.0},
+            {"kind": "flush", "t": 1.0, "step": 1, "n": 2},
+            {"kind": "step", "step": 2, "loss": 1.0},
+            {"kind": "step", "step": 3, "loss": 1.0},
+            {"kind": "flush", "t": 1.02, "step": 3, "n": 2},
+            # killed here; restart appends a new header 100s later
+            {"kind": "run", "t": 101.0, "every": 2, "fields": ["loss"]},
+            {"kind": "step", "step": 4, "loss": 1.0},
+            {"kind": "step", "step": 5, "loss": 1.0},
+            {"kind": "flush", "t": 102.0, "step": 5, "n": 2},
+            {"kind": "step", "step": 6, "loss": 1.0},
+            {"kind": "step", "step": 7, "loss": 1.0},
+            {"kind": "flush", "t": 102.02, "step": 7, "n": 2},
+        ]
+        with open(path, "w") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+        doc = summarize(path)
+        assert doc["runs"] == 2
+        # one 10ms window per run; the 100s restart gap must NOT appear
+        assert doc["step_time"]["windows"] == 2
+        assert doc["step_time"]["max_ms"] < 100.0
+
+    def test_unknown_field_raises(self, tmp_path):
+        from paddle_tpu.profiler import telemetry
+        tele = telemetry.TelemetryPipeline(str(tmp_path / "x.jsonl"),
+                                           every=2)
+        with pytest.raises(ValueError):
+            tele.device_record(tele.device_init(), bogus=1.0)
+        tele.close()
+
+
+# -------------------------------------------------------- telemetry report
+class TestTelemetryReport:
+    def test_summary_from_real_run(self, tmp_path):
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "tools"))
+        from telemetry_report import summarize
+        path, _ = TestTelemetryPipeline()._run(tmp_path, steps=8, every=2)
+        doc = summarize(path)
+        assert doc["steps_recorded"] == 8
+        assert doc["flushes"] == 4
+        st = doc["step_time"]
+        assert st["steps"] == 6          # first (compile) window excluded
+        assert st["p50_ms"] >= 0 and st["p95_ms"] >= st["p50_ms"]
+        assert st["ips"] > 0             # samples_per_step from the header
+        assert "loss" in doc["fields"]
+        assert doc["bad_steps"] == []
+        assert "monitor" in doc
+
+    def test_tolerates_torn_tail(self, tmp_path):
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "tools"))
+        from telemetry_report import summarize
+        path, _ = TestTelemetryPipeline()._run(tmp_path, steps=4, every=2)
+        with open(path, "a") as f:
+            f.write('{"kind": "step", "step": 99, "loss"')   # killed writer
+        doc = summarize(path)
+        assert doc["bad_lines"] == 1
+        assert doc["steps_recorded"] == 4
+
+
+# --------------------------------------------------------- flight recorder
+class TestFlightRecorder:
+    def test_note_dump_roundtrip(self, tmp_path):
+        from paddle_tpu.profiler.flight_recorder import (FlightRecorder,
+                                                         load_dump)
+        fr = FlightRecorder(dir=str(tmp_path), n=4, autodump_every=0)
+        fr.configure(job="unit-test", world=1)
+        for i in range(7):
+            fr.note(step=i, loss=float(i), ok=True)
+        path = fr.dump("unit_test")
+        doc = load_dump(path)
+        assert doc["reason"] == "unit_test"
+        assert doc["config"]["job"] == "unit-test"
+        assert [r["step"] for r in doc["steps"]] == [3, 4, 5, 6]  # last N
+        assert isinstance(doc["monitor"], dict)
+
+    def test_autodump_survives_abrupt_death(self, tmp_path):
+        """Per-step autodump is what a SIGKILLed worker leaves behind —
+        the file must be present and parse after every note()."""
+        from paddle_tpu.profiler.flight_recorder import (FlightRecorder,
+                                                         load_dump)
+        fr = FlightRecorder(dir=str(tmp_path), n=8, autodump_every=1)
+        fr.note(step=0, loss=1.0, ok=True)
+        files = [f for f in os.listdir(tmp_path) if f.endswith(".json")]
+        assert len(files) == 1
+        doc = load_dump(str(tmp_path / files[0]))
+        assert doc["reason"] == "periodic"
+        assert doc["steps"][0]["step"] == 0
+
+    def test_resilient_trainer_dumps_on_rollback(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.parallel import resilience
+        from paddle_tpu.parallel.checkpoint import CheckpointManager
+        from paddle_tpu.profiler import flight_recorder
+
+        fr = flight_recorder.recorder()
+        old_dir, old_every = fr.dir, fr.autodump_every
+        fr.set_dir(str(tmp_path))
+        fr.autodump_every = 0
+        poisons = [3]                    # poison exactly 2 steps, once
+
+        def hook(step):
+            if step >= 2 and poisons[0] > 0:
+                poisons[0] -= 1
+                return float("nan")
+            return 1.0
+
+        resilience._STEP_HOOK = hook
+        try:
+            params = {"w": jnp.ones((4, 2)) * 0.3}
+            opt = {"m": {"w": jnp.zeros((4, 2))}}
+            mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=2)
+            tr = resilience.ResilientTrainer(
+                _toy_step, params, opt, manager=mgr,
+                config=resilience.ResilienceConfig(checkpoint_every=1,
+                                                   rollback_after=2))
+            batch = (jnp.ones((3, 4)), jnp.zeros((3, 2)))
+            resilience.run_resilient(tr, lambda s: batch, 6)
+        finally:
+            resilience._STEP_HOOK = None
+            fr.set_dir(old_dir)
+            fr.autodump_every = old_every
+        assert tr.rollbacks >= 1
+        dumps = [f for f in os.listdir(tmp_path)
+                 if f.startswith("flight-") and "rollback" in f]
+        assert dumps, os.listdir(tmp_path)
+        doc = flight_recorder.load_dump(str(tmp_path / dumps[0]))
+        assert doc["reason"] == "rollback"
+        assert any(not r["ok"] for r in doc["steps"])
+        assert doc["monitor"]["resilience_rollback"] >= 1
+        assert doc["monitor"]["resilience_skip_step"] >= 2
+
+
+# ----------------------------------------------------- nan/inf op naming
+class TestCheckNanInf:
+    def test_seeded_nan_names_producing_op(self):
+        from paddle_tpu.framework import flags
+        flags.set_flags({"check_nan_inf": True})
+        try:
+            with pytest.raises(FloatingPointError) as ei:
+                paddle.log(paddle.to_tensor(np.float32(-1.0)))
+            msg = str(ei.value)
+            assert "log" in msg                  # producing op named
+            assert "output(s) [0]" in msg        # offending output index
+        finally:
+            flags.set_flags({"check_nan_inf": False})
+
+    def test_finite_ops_pass(self):
+        from paddle_tpu.framework import flags
+        flags.set_flags({"check_nan_inf": True})
+        try:
+            out = paddle.log(paddle.to_tensor(np.float32(2.0)))
+            assert np.isfinite(out.numpy())
+        finally:
+            flags.set_flags({"check_nan_inf": False})
+
+
+# ------------------------------------------------------------- device AUC
+class TestAucOp:
+    def test_parity_host_and_exact(self):
+        rng = np.random.RandomState(7)
+        preds = rng.rand(400).astype(np.float32)
+        labels = (rng.rand(400) < preds).astype(np.int64)
+        from paddle_tpu.metric import Auc, auc
+        dev = float(auc(paddle.to_tensor(preds),
+                        paddle.to_tensor(labels)).numpy())
+        host = Auc()
+        host.update(preds, labels)
+        # identical bucketing -> near-exact agreement with the host metric
+        assert dev == pytest.approx(host.accumulate(), abs=1e-6)
+        # exact rank AUC (sklearn-free oracle; bucketing costs <= ~1e-3)
+        order = preds.argsort(kind="mergesort")
+        ranks = np.empty(len(preds))
+        ranks[order] = np.arange(1, len(preds) + 1)
+        npos = labels.sum()
+        nneg = len(labels) - npos
+        exact = (ranks[labels == 1].sum() - npos * (npos + 1) / 2) \
+            / (npos * nneg)
+        assert dev == pytest.approx(exact, abs=5e-3)
+
+    def test_two_column_softmax_input(self):
+        preds = np.array([[0.9, 0.1], [0.2, 0.8], [0.6, 0.4], [0.3, 0.7]],
+                         np.float32)
+        labels = np.array([0, 1, 0, 1])
+        from paddle_tpu.metric import auc
+        v = float(auc(paddle.to_tensor(preds),
+                      paddle.to_tensor(labels)).numpy())
+        assert v == 1.0                            # perfectly separable
+
+    def test_degenerate_single_class(self):
+        from paddle_tpu.metric import auc
+        v = float(auc(paddle.to_tensor(np.array([0.1, 0.9], np.float32)),
+                      paddle.to_tensor(np.array([1, 1]))).numpy())
+        assert v == 0.0                            # no negatives -> 0
+
+
+# -------------------------------------------------------------- timer p95
+class TestTimerPercentiles:
+    def test_summary_p95_and_samples(self):
+        from paddle_tpu.profiler.timer import Benchmark
+        bm = Benchmark()
+        bm.begin()
+        t = [0.0]
+
+        def fake_step(dt, n):
+            bm._costs.append(dt)
+            bm._samples.append(n)
+
+        for _ in range(19):
+            fake_step(0.010, 4)
+        fake_step(0.100, 4)                        # one tail stall
+        s = bm.summary(skip=0)
+        assert s["steps"] == 20
+        assert s["samples"] == 80
+        assert s["p50_batch_cost_s"] == pytest.approx(0.010)
+        assert s["p95_batch_cost_s"] == pytest.approx(0.010)
+        fake_step(0.100, 4)
+        fake_step(0.100, 4)
+        s = bm.summary(skip=0)
+        assert s["p95_batch_cost_s"] == pytest.approx(0.100)
+        assert s["ips"] > 0
+
+
+# ----------------------------------------------------- dispatch counters
+class TestDispatchCounters:
+    def test_cache_hit_miss_advance(self):
+        hit = monitor.counter("dispatch_cache_hit")
+        miss = monitor.counter("dispatch_cache_miss")
+        h0, m0 = hit.value, miss.value
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        y = x * 2
+        (y + y).numpy()
+        assert hit.value + miss.value > h0 + m0
+        # a repeated identical op is a cache hit
+        h1 = hit.value
+        (x * 2).numpy()
+        assert hit.value > h1

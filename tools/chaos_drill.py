@@ -40,6 +40,7 @@ if REPO not in sys.path:
 STEPS_ENV = "PADDLE_TPU_DRILL_STEPS"
 CKPT_ENV = "PADDLE_TPU_DRILL_CKPT"
 OUT_ENV = "PADDLE_TPU_DRILL_OUT"
+TELE_ENV = "PADDLE_TPU_DRILL_TELEMETRY"
 
 DIM_IN, DIM_H = 16, 32
 BATCH = 8
@@ -71,6 +72,13 @@ def worker_main() -> int:
     steps = int(os.environ[STEPS_ENV])
     mgr = CheckpointManager(os.environ[CKPT_ENV], max_to_keep=3)
     out = open(os.environ[OUT_ENV], "a")
+    telemetry = None
+    if os.environ.get(TELE_ENV):
+        from paddle_tpu.profiler.telemetry import TelemetryPipeline
+        from paddle_tpu.parallel.resilience import RESILIENT_FIELDS
+        telemetry = TelemetryPipeline(os.environ[TELE_ENV], every=4,
+                                      fields=RESILIENT_FIELDS,
+                                      meta={"samples_per_step": BATCH})
 
     def init_params(key):
         k1, k2 = jax.random.split(key)
@@ -100,7 +108,8 @@ def worker_main() -> int:
         tr = ResilientTrainer(
             train_step, params, opt_state, manager=mgr,
             config=ResilienceConfig(checkpoint_every=1, rollback_after=2,
-                                    max_rollbacks=5))
+                                    max_rollbacks=5),
+            telemetry=telemetry)
         if tr.maybe_resume():
             print(f"[drill-worker] resumed at step {tr.step}",
                   file=sys.stderr, flush=True)
@@ -117,12 +126,60 @@ def worker_main() -> int:
                     shard_value(jnp.asarray(y), P("dp"), mesh))
 
         run_resilient(tr, sharded_batch, steps, on_step=record)
+    if telemetry is not None:
+        telemetry.close(tr._tstate)
     print(f"[drill-worker] done: {tr.step} steps, {tr.skipped} skipped, "
           f"{tr.rollbacks} rollbacks", file=sys.stderr, flush=True)
     return 0
 
 
 # =========================================================== driver side
+def _check_flight(scenario_dir: str, min_steps: int = 1):
+    """A killed/restarted worker must leave at least one parseable
+    flight-recorder dump carrying step records and a monitor snapshot
+    (the PR-3 acceptance criterion). Returns an error string or None."""
+    fdir = os.path.join(scenario_dir, "flight")
+    dumps = sorted(f for f in (os.listdir(fdir) if os.path.isdir(fdir)
+                               else []) if f.endswith(".json"))
+    if not dumps:
+        return f"no flight-recorder dump under {fdir}"
+    for name in dumps:
+        try:
+            with open(os.path.join(fdir, name)) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            return f"flight dump {name} unparseable: {e}"
+        if doc.get("kind") != "flight_recorder":
+            return f"flight dump {name}: wrong kind {doc.get('kind')!r}"
+        if "monitor" not in doc:
+            return f"flight dump {name}: no monitor snapshot"
+    best = 0
+    for name in dumps:
+        with open(os.path.join(fdir, name)) as f:
+            best = max(best, len(json.load(f).get("steps") or []))
+    if best < min_steps:
+        return (f"flight dumps under {fdir} carry {best} step records "
+                f"(< {min_steps})")
+    return None
+
+
+def _check_telemetry(scenario_dir: str):
+    """The scenario's telemetry JSONL must summarize cleanly and carry
+    step records (torn tails from kills are tolerated by the parser)."""
+    path = os.path.join(scenario_dir, "telemetry.jsonl")
+    if not os.path.exists(path):
+        return f"no telemetry JSONL at {path}"
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from telemetry_report import summarize
+    try:
+        doc = summarize(path)
+    except Exception as e:
+        return f"telemetry summary failed for {path}: {e}"
+    if doc.get("steps_recorded", 0) < 1:
+        return f"telemetry JSONL {path} has no step records"
+    return None
+
+
 def _trajectory(out_path: str):
     """results.jsonl -> {step: last recorded loss} (re-runs after a
     restart/rollback overwrite earlier occurrences)."""
@@ -150,6 +207,10 @@ def _launch(scenario_dir: str, steps: int, fault_spec: str,
     env[STEPS_ENV] = str(steps)
     env[CKPT_ENV] = ckpt
     env[OUT_ENV] = outp
+    # observability riders: every worker leaves a crash flight recorder
+    # black box + a batched-telemetry JSONL the driver parses back
+    env["PADDLE_TPU_FLIGHT_DIR"] = os.path.join(scenario_dir, "flight")
+    env[TELE_ENV] = os.path.join(scenario_dir, "telemetry.jsonl")
     if fault_spec:
         env["PADDLE_TPU_FAULTS"] = fault_spec
         env["PADDLE_TPU_FAULTS_ONCE_DIR"] = os.path.join(
@@ -198,6 +259,11 @@ def run_drill(steps: int, full: bool, keep_logs: bool = False) -> int:
             err = f"{name}: launcher rc={res.returncode}"
         else:
             err = _compare(name, baseline, traj, steps)
+        if err is None and spec.startswith(("kill@", "crash_shard@")):
+            # the killed leg must have left a readable black box
+            err = _check_flight(sdir) or _check_telemetry(sdir)
+            if err:
+                err = f"{name}: {err}"
         tag = "FAIL" if err else "ok"
         print(f"[drill] {name:<24} {tag}  ({dt:.1f}s)", flush=True)
         if err:

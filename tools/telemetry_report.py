@@ -1,0 +1,179 @@
+"""Summarize a run's telemetry JSONL (profiler/telemetry.py stream).
+
+Turns the batched step-metrics stream into the post-run numbers an
+operator (or bench.py / tools/chaos_drill.py) wants: step-time
+percentiles EXCLUDING the compile window, items/sec, per-field loss/
+norm statistics, monitor-counter deltas, and the event timeline.
+
+Step timing comes from the `flush` boundary records (the pipeline's
+whole point is that individual steps never touch the host clock): each
+flush stamps wall time and the number of steps it covers, so
+ms/step = (t_flush[i] - t_flush[i-1]) / n[i]. The first flush window
+absorbs the jit compile and is excluded from the percentiles (it is
+reported separately as compile_window_ms_per_step).
+
+Usage:
+  python tools/telemetry_report.py RUN.jsonl          # one JSON line
+  python tools/telemetry_report.py RUN.jsonl --pretty
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+from typing import Optional
+
+
+def _percentile(ordered, q: float):
+    """Nearest-rank percentile of an ascending list."""
+    if not ordered:
+        return None
+    n = len(ordered)
+    return ordered[max(0, math.ceil(q / 100.0 * n) - 1)]
+
+
+def _field_stats(values):
+    vals = [v for v in values if v is not None and not math.isnan(v)]
+    if not vals:
+        return None
+    ordered = sorted(vals)
+    return {"n": len(vals), "first": vals[0], "last": vals[-1],
+            "min": ordered[0], "max": ordered[-1],
+            "mean": sum(vals) / len(vals)}
+
+
+def summarize(path: str, samples_per_step: Optional[float] = None) -> dict:
+    """Parse a telemetry JSONL file into one summary dict."""
+    run = {}
+    runs = []          # every header, in order (restarts append new ones)
+    steps = []
+    flushes = []
+    flush_groups = []  # flushes bucketed per run header, in file order —
+    #                    windows must not span a kill/restart boundary
+    monitors = []
+    events = []
+    bad_lines = 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                bad_lines += 1       # torn tail of a killed writer
+                continue
+            kind = rec.get("kind")
+            if kind == "run":
+                run = rec
+                runs.append(rec)
+                flush_groups.append([])
+            elif kind == "step":
+                steps.append(rec)
+            elif kind == "flush":
+                flushes.append(rec)
+                if not flush_groups:
+                    flush_groups.append([])
+                flush_groups[-1].append(rec)
+            elif kind == "monitor":
+                monitors.append(rec)
+            elif kind == "event":
+                events.append(rec)
+
+    out = {"path": path, "run": {k: v for k, v in run.items()
+                                 if k not in ("kind",)},
+           "runs": len(runs),
+           "steps_recorded": len(steps), "flushes": len(flushes),
+           "bad_lines": bad_lines}
+
+    # ---- step time from flush deltas, per run group (each process's
+    # first window absorbs ITS jit compile; pairing flushes across a
+    # restart boundary would count the kill-to-restart gap + recompile
+    # as a step-time tail) ----
+    win_ms = []            # (ms_per_step, steps_in_window)
+    for group in flush_groups:
+        for prev, cur in zip(group, group[1:]):
+            n = cur.get("n") or 0
+            dt = cur["t"] - prev["t"]
+            if n > 0 and dt >= 0:
+                win_ms.append((dt * 1e3 / n, n))
+    if flushes and steps and runs:
+        # FIRST header vs its first flush (a later header belongs to a
+        # restarted process)
+        first_n = flushes[0].get("n") or 0
+        dt0 = flushes[0]["t"] - runs[0].get("t", flushes[0]["t"])
+        if first_n and dt0 >= 0:
+            out["compile_window_ms_per_step"] = round(dt0 * 1e3 / first_n,
+                                                      3)
+    if win_ms:
+        per_step = sorted(m for m, _ in win_ms)
+        total_steps = sum(n for _, n in win_ms)
+        total_s = sum(m * n for m, n in win_ms) / 1e3
+        st = {
+            "windows": len(win_ms),
+            "steps": total_steps,
+            "mean_ms": round(total_s * 1e3 / total_steps, 3),
+            "p50_ms": round(_percentile(per_step, 50), 3),
+            "p95_ms": round(_percentile(per_step, 95), 3),
+            "max_ms": round(per_step[-1], 3),
+        }
+        sps = samples_per_step if samples_per_step is not None \
+            else run.get("samples_per_step")
+        if sps and total_s > 0:
+            st["ips"] = round(total_steps * float(sps) / total_s, 1)
+        out["step_time"] = st
+
+    # ---- per-field scalar stats ----
+    fields = run.get("fields") or sorted(
+        {k for r in steps for k in r} - {"kind", "step"})
+    fstats = {}
+    for f in fields:
+        s = _field_stats([r.get(f) for r in steps])
+        if s is not None:
+            fstats[f] = {k: (round(v, 6) if isinstance(v, float) else v)
+                         for k, v in s.items()}
+    if fstats:
+        out["fields"] = fstats
+    nonfinite = [r for r in steps
+                 if (r.get("nonfinite") or 0) > 0
+                 or (r.get("ok") is not None and r.get("ok") == 0.0)]
+    out["bad_steps"] = [r["step"] for r in nonfinite][:32]
+
+    # ---- monitor counter deltas (first vs last snapshot) ----
+    if monitors:
+        first, last = monitors[0]["stats"], monitors[-1]["stats"]
+        out["monitor"] = last
+        out["monitor_delta"] = {
+            k: round(last[k] - first.get(k, 0), 6)
+            for k in sorted(last) if last[k] != first.get(k, 0)}
+
+    # ---- event timeline ----
+    if events:
+        t0 = events[0]["t"]
+        out["events"] = [
+            {"name": e.get("name"), "at_s": round(e["t"] - t0, 3),
+             "dur_s": round(e.get("dur_s") or 0.0, 6)}
+            for e in sorted(events, key=lambda e: e["t"])[:64]]
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("jsonl", help="telemetry JSONL file")
+    ap.add_argument("--pretty", action="store_true")
+    ap.add_argument("--samples-per-step", type=float, default=None,
+                    help="items per step for ips (overrides the run "
+                         "header)")
+    args = ap.parse_args()
+    try:
+        doc = summarize(args.jsonl, samples_per_step=args.samples_per_step)
+    except OSError as e:
+        print(f"cannot read {args.jsonl}: {e}", file=sys.stderr)
+        return 2
+    print(json.dumps(doc, indent=1 if args.pretty else None))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
