@@ -25,6 +25,19 @@ import sys
 import time
 
 
+# ONE home for the persistent XLA compile-cache wiring is
+# paddle_tpu/utils/compile_cache.py; re-exported LAZILY (PEP 562) for
+# the existing tool callers (tools/bench_ladder.py) so the orchestrator
+# process stays import-light — framework import failures must surface
+# inside the subprocess rungs, not here.
+def __getattr__(name):
+    if name in ("seed_cache_env", "sync_compile_cache_for",
+                "xla_cache_dir"):
+        from paddle_tpu.utils import compile_cache
+        return getattr(compile_cache, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 def _log(msg: str) -> None:
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
@@ -104,46 +117,11 @@ def apply_perf_env_defaults() -> None:
     cache = os.path.join(here, "perf", "autotune.json")
     if os.path.exists(cache):
         os.environ.setdefault("PADDLE_TPU_AUTOTUNE_CACHE", cache)
-    # persistent XLA compilation cache: a tunnel window must not re-pay
-    # multi-minute remote compiles for graphs an earlier job/window
-    # already built. The env var is read at interpreter start (the axon
-    # site hook imports jax before user code), so ALSO push it through
-    # the config API. The cache is TPU-only: every measurement entry
-    # point calls sync_compile_cache_for(platform) after resolving the
-    # backend, which disables it again for CPU runs (XLA:CPU's AOT
-    # reload warns about machine-feature mismatches even same-host).
-    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", xla_cache_dir())
-    try:
-        import jax
-        if jax.config.jax_compilation_cache_dir is None:
-            jax.config.update("jax_compilation_cache_dir",
-                              os.environ["JAX_COMPILATION_CACHE_DIR"])
-    except Exception:
-        pass
-
-
-def xla_cache_dir() -> str:
-    """ONE home for the shared persistent-compile-cache location (bench,
-    bench_ladder, and tpu_campaign all point at it)."""
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "perf", "xla_cache")
-    os.makedirs(path, exist_ok=True)
-    return path
-
-
-def sync_compile_cache_for(platform: str) -> None:
-    """Enforce the TPU-only compile-cache policy AFTER the backend is
-    known: a job that inherited JAX_COMPILATION_CACHE_DIR (campaign env)
-    but resolved to CPU — mid-window tunnel drop, ladder run on a
-    TPU-less host — must not cache XLA:CPU executables (their AOT reload
-    machine-feature checks are unreliable even same-host)."""
-    import jax
-    if platform in ("tpu", "axon"):
-        if jax.config.jax_compilation_cache_dir is None:
-            jax.config.update("jax_compilation_cache_dir",
-                              xla_cache_dir())
-    elif jax.config.jax_compilation_cache_dir is not None:
-        jax.config.update("jax_compilation_cache_dir", None)
+    # persistent XLA compilation cache (TPU-only; see
+    # paddle_tpu/utils/compile_cache.py — every measurement entry point
+    # calls sync_compile_cache_for(platform) after resolving the backend)
+    from paddle_tpu.utils.compile_cache import seed_cache_env
+    seed_cache_env()
 
 
 def _sweep_winner_variant():
@@ -180,6 +158,7 @@ def run_measurement(rung: str) -> None:
 
     devs = _init_devices(want_tpu)
     platform = devs[0].platform
+    from paddle_tpu.utils.compile_cache import sync_compile_cache_for
     sync_compile_cache_for(platform)
 
     from paddle_tpu.models.gpt import (GPTConfig, init_gpt_params,

@@ -210,6 +210,20 @@ class Model:
             outs = [np.concatenate(outs, axis=0)]
         return outs
 
+    def generate(self, prompts, max_new_tokens, **kw):
+        """Continuous-batching generation passthrough: available when
+        the wrapped network is a cached decoder facade (GPTModel /
+        LlamaModel — models/facade.py generate drives the
+        inference/serving.py slot-pool engine). prompts: list of 1-D
+        int token-id sequences of mixed lengths."""
+        gen = getattr(self.network, "generate", None)
+        if gen is None:
+            raise NotImplementedError(
+                f"{type(self.network).__name__} does not expose "
+                "generate(); wrap a cached decoder facade "
+                "(GPTModel/LlamaModel)")
+        return gen(prompts, max_new_tokens, **kw)
+
     # ---------------------------------------------------------- save/load
     def save(self, path, training=True):
         """training=True → .pdparams/.pdopt checkpoint; False → jit.save

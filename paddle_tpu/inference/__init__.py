@@ -22,7 +22,9 @@ import jax.numpy as jnp
 
 from ..framework.tensor import Tensor
 
-__all__ = ["Config", "Predictor", "create_predictor", "PrecisionType"]
+__all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
+           "ServingEngine", "Request", "create_serving_engine",
+           "family_for"]
 
 
 class PrecisionType:
@@ -74,8 +76,26 @@ class Config:
     def use_gpu(self):
         return False
 
-    def enable_tensorrt_engine(self, *a, **k):
+    def set_precision(self, precision: str):
+        """Select the serving precision (PrecisionType.*). The
+        Predictor applies it to the loaded params at build — see
+        Predictor for the exact semantics per precision."""
+        if precision not in (PrecisionType.Float32, PrecisionType.Half,
+                             PrecisionType.Bfloat16, PrecisionType.Int8):
+            raise ValueError(f"unknown precision {precision!r}")
+        self._precision = precision
+        return self
+
+    def enable_tensorrt_engine(self, workspace_size=1 << 30,
+                               max_batch_size=1, min_subgraph_size=3,
+                               precision_mode=None, use_static=False,
+                               use_calib_mode=False):
         self._enabled["tensorrt"] = False    # no-op: XLA is the compiler
+        # ... but the reference call's precision_mode is the one knob
+        # that still means something (the round-5 satellite: _precision
+        # was silently ignored)
+        if precision_mode is not None:
+            self.set_precision(precision_mode)
 
     def enable_mkldnn(self):
         self._enabled["mkldnn"] = False
@@ -124,6 +144,7 @@ class Predictor:
         from ..jit import load as jit_load
         self.config = config
         self._layer = jit_load(config._prefix)
+        self._apply_precision(config._precision)
         meta = self._layer._meta
         shapes = meta.get("input_shapes", [])
         names = meta.get("input_names") or [f"x{i}"
@@ -133,6 +154,30 @@ class Predictor:
             n: _IOHandle(n) for n in self._in_names}
         self._out_names: List[str] = []
         self._outputs: Dict[str, _IOHandle] = {}
+
+    def _apply_precision(self, precision: str) -> None:
+        """Honor Config._precision on the loaded params. The StableHLO
+        artifact pins its compute dtypes at jit.save time, so reduced
+        precision lands as a weight ROUND-TRIP cast (f32 -> bf16/f16 ->
+        f32): the weights carry the quantized values while the program
+        keeps its saved dtypes (the trade the reference's fp16 load
+        makes when the program itself stays fp32). Int8 needs the
+        calibrated quantization pass (paddle_tpu.quantization) and is
+        refused loudly instead of silently serving fp32."""
+        if precision == PrecisionType.Int8:
+            raise NotImplementedError(
+                "Config precision Int8 is not supported by the "
+                "Predictor: Int8 serving needs a calibrated "
+                "quantization pass (see paddle_tpu.quantization); "
+                "use Float32/Bfloat16/Half or quantize the model "
+                "before jit.save")
+        if precision in (PrecisionType.Half, PrecisionType.Bfloat16):
+            tgt = (jnp.float16 if precision == PrecisionType.Half
+                   else jnp.bfloat16)
+            self._layer._params = [
+                p.astype(tgt).astype(p.dtype)
+                if jnp.issubdtype(p.dtype, jnp.floating) else p
+                for p in self._layer._params]
 
     # ------------------------------------------------------------ ref API
     def get_input_names(self) -> List[str]:
@@ -149,19 +194,21 @@ class Predictor:
 
     def run(self, inputs: Optional[List[np.ndarray]] = None):
         """Execute. Either positional `inputs` (returns arrays, the
-        paddle_infer convenience form) or via the named handles."""
+        paddle_infer convenience form) or via the named handles. Output
+        handles are created once and refilled in place on later runs —
+        a serving loop that resolved get_output_handle keeps valid
+        handles instead of paying a dict rebuild per call."""
         if inputs is None:
             inputs = [self._inputs[n].copy_to_cpu() for n in self._in_names]
         outs = self._layer(*[jnp.asarray(a) for a in inputs])
         outs = outs if isinstance(outs, list) else [outs]
         arrs = [np.asarray(o._value if isinstance(o, Tensor) else o)
                 for o in outs]
-        self._out_names = [f"out{i}" for i in range(len(arrs))]
-        self._outputs = {}
+        if len(self._out_names) != len(arrs):
+            self._out_names = [f"out{i}" for i in range(len(arrs))]
+            self._outputs = {n: _IOHandle(n) for n in self._out_names}
         for n, a in zip(self._out_names, arrs):
-            h = _IOHandle(n)
-            h.copy_from_cpu(a)
-            self._outputs[n] = h
+            self._outputs[n].copy_from_cpu(a)
         return arrs
 
     def clone(self):
@@ -171,3 +218,10 @@ class Predictor:
 def create_predictor(config: Config) -> Predictor:
     """Reference: paddle_infer.create_predictor."""
     return Predictor(config)
+
+
+# the continuous-batching serving engine (slot-pool KV cache, bucketed
+# prefill, one jitted decode step) — the throughput path the Predictor's
+# one-request-per-run loop cannot provide
+from .serving import (ServingEngine, Request,          # noqa: E402,F401
+                      create_serving_engine, family_for)

@@ -1,0 +1,497 @@
+"""Continuous-batching serving engine: slot-pool KV cache, bucketed
+prefill, one jitted decode step.
+
+Reference analog: the dedicated serving runtime — AnalysisPredictor
+(inference/api/analysis_predictor.h:94) driving the
+FusedMultiTransformer decode loops
+(incubate/nn/layer/fused_transformer.py:1022) — generalized to
+iteration-level scheduling (cf. Orca's continuous batching, OSDI '22,
+and vLLM's paged KV cache, SOSP '23): requests join and leave the
+running batch between decode steps instead of start-and-finish
+together.
+
+TPU-native design (everything jit-shaped, nothing dynamic on device):
+
+- **Slot pool.** A fixed pool of N decode slots backed by one donated
+  stacked KV cache ({"k","v"} buffers of [L, N, max_len, KV, hd] — the
+  k/v pair realizes the single [L, 2, N, ...] buffer of the design
+  with per-leaf donation, so XLA aliases both across ticks and the
+  cache never leaves the device). All writes are in-place
+  `dynamic_update_slice`es (kernels/decode_attention.write_kv).
+- **One jitted mixed decode step.** Every tick advances ALL slots one
+  token under per-slot position/active masks: the per-row-position
+  `forward_cached` (models/gpt.py, models/llama.py) runs the N tokens
+  as one batch, and greedy + temperature/top-k sampling happens inside
+  the jit (per-request PRNG streams derived by folding the request id
+  and token index into the engine key, so sampled streams are
+  reproducible regardless of slot placement or batch composition).
+  The tick's signature is shape-stable -> one trace per sampling mode
+  (greedy-only ticks skip the sampling machinery via a static flag)
+  for the engine's lifetime.
+- **Bucketed prefill.** Prompts pad to the power-of-two bucket
+  (models/decode.prompt_bucket — the same policy as the bucketed
+  greedy driver, which is what makes engine token streams
+  bit-identical to per-request `greedy_generate`); the true length and
+  target slot ride through the trace as scalars, so any prompt length
+  hits one of ~log(max_len) compiled executables.
+- **Python-side scheduler.** Admission queue, slot allocation,
+  EOS/max-token/cache-full eviction, and mid-decode join of new
+  requests into freed slots all happen on the host between ticks; the
+  device only ever sees the fixed-shape tick.
+
+Stale cache contents (a freed slot's previous request, bucket-pad
+garbage) are never attended: the decode-attention mask admits cache
+slots <= the query's own position only, and decode writes overwrite
+the pad region in order (kernels/decode_attention.py).
+
+Observability: serving.* monitor counters/gauges (slot occupancy,
+queue depth, tokens emitted, prefills, decode ticks) and
+RecordEvent spans around every prefill/decode tick —
+tools/telemetry_report.py summarizes them, tools/bench_serving.py
+measures the engine against sequential per-request decode.
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import functools
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..models.decode import prompt_bucket
+from ..profiler import RecordEvent, monitor
+
+__all__ = ["ServingEngine", "Request", "ModelFamily", "family_for",
+           "create_serving_engine"]
+
+
+# --------------------------------------------------------------- families
+@dataclasses.dataclass(frozen=True)
+class ModelFamily:
+    """The seam a model family exposes to the engine: a cached forward
+    that accepts per-row positions (slot-indexed writes) and a cache
+    factory. Both flagship decoders qualify; any future family that
+    implements the same contract plugs in here."""
+    name: str
+    forward_cached: Callable    # (params, tokens[B,T], cache, pos, cfg)
+    init_cache: Callable        # (cfg, batch, max_len) -> {"k","v"}
+
+
+def family_for(name: str) -> ModelFamily:
+    if name == "gpt":
+        from ..models import gpt
+        return ModelFamily("gpt", gpt.gpt_forward_cached,
+                           gpt.init_kv_cache)
+    if name == "llama":
+        from ..models import llama
+        return ModelFamily("llama", llama.llama_forward_cached,
+                           llama.init_kv_cache)
+    raise ValueError(f"unknown model family {name!r} (gpt|llama)")
+
+
+# --------------------------------------------------------------- requests
+class Request:
+    """One generation request riding through the engine."""
+
+    __slots__ = ("id", "prompt", "max_new_tokens", "temperature",
+                 "top_k", "eos_id", "tokens", "done", "finish_reason",
+                 "slot")
+
+    def __init__(self, req_id, prompt, max_new_tokens, temperature,
+                 top_k, eos_id):
+        self.id = req_id
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.temperature = temperature
+        self.top_k = top_k
+        self.eos_id = eos_id
+        self.tokens: List[int] = []     # generated ids, in order
+        self.done = False
+        self.finish_reason: Optional[str] = None
+        self.slot: Optional[int] = None
+
+    def __repr__(self):
+        return (f"Request(id={self.id}, len={len(self.prompt)}, "
+                f"gen={len(self.tokens)}/{self.max_new_tokens}, "
+                f"done={self.done})")
+
+
+# ------------------------------------------------------- in-jit sampling
+def _slot_keys(base_key, req_ids, gen_idx):
+    """Per-slot PRNG keys: fold (request id, token index) into the
+    engine key — streams depend on the request, never on slot placement
+    or batch composition."""
+    def one(rid, gi):
+        return jax.random.fold_in(jax.random.fold_in(base_key, rid), gi)
+    return jax.vmap(one)(req_ids, gen_idx)
+
+
+def _sample(lg, temps, top_ks, keys, max_top_k: int):
+    """lg [N,V] f32 -> next token [N] int32. Greedy where temp <= 0
+    (bit-identical to the greedy driver's argmax); otherwise
+    temperature softmax sampling, truncated to the request's top_k
+    (<= the engine's static max_top_k) when top_k > 0."""
+    greedy = jnp.argmax(lg, axis=-1)
+    safe_t = jnp.maximum(temps, 1e-6)[:, None]
+    full = jax.vmap(jax.random.categorical)(keys, lg / safe_t)
+    sampled = full
+    if max_top_k > 0:
+        vals, idx = jax.lax.top_k(lg, max_top_k)           # [N,K]
+        k_eff = jnp.minimum(jnp.where(top_ks <= 0, max_top_k, top_ks),
+                            max_top_k)
+        masked = jnp.where(jnp.arange(max_top_k)[None, :] < k_eff[:, None],
+                           vals, -jnp.inf)
+        choice = jax.vmap(jax.random.categorical)(keys, masked / safe_t)
+        trunc = jnp.take_along_axis(idx, choice[:, None], axis=1)[:, 0]
+        sampled = jnp.where(top_ks > 0, trunc, full)
+    return jnp.where(temps <= 0.0, greedy, sampled).astype(jnp.int32)
+
+
+# --------------------------------------------------------- jitted bodies
+# slot-state tuple riding through the decode tick (all [N], device-
+# resident and DONATED alongside the cache — the host only downloads
+# the sampled tokens, one small pull per tick)
+#   (cur_tok, positions, active, temps, top_ks, req_ids, gen_idx)
+def _decode_tick(params, cache, state, base_key, *, fwd, cfg, max_top_k,
+                 sampling):
+    """THE mixed step: all N slots advance one token. Each slot's
+    current token is written at its own position; sampling runs in-jit;
+    inactive slots compute too (fixed shape) but their output is masked
+    and their slot region is overwritten at the next prefill.
+    `sampling` is STATIC: greedy-only ticks skip the key-fold +
+    categorical machinery entirely (~0.4 ms/tick on the CPU rung), so
+    the tick has at most two traces for the engine's lifetime."""
+    toks, positions, active, temps, top_ks, req_ids, gen_idx = state
+    logits, cache = fwd(params, toks[:, None], cache, positions, cfg)
+    lg = logits[:, 0].astype(jnp.float32)
+    if sampling:
+        keys = _slot_keys(base_key, req_ids, gen_idx)
+        nxt = _sample(lg, temps, top_ks, keys, max_top_k)
+    else:
+        nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+    nxt = jnp.where(active, nxt, 0).astype(jnp.int32)
+    inc = active.astype(jnp.int32)
+    state = (nxt, positions + inc, active, temps, top_ks, req_ids,
+             gen_idx + inc)
+    return nxt, cache, state
+
+
+def _prefill_slot(params, cache, padded, true_len, slot, temps, top_ks,
+                  req_ids, base_key, *, fwd, init_cache, cfg, max_top_k,
+                  sampling):
+    """Bucketed prefill of ONE request into slot `slot`: run the padded
+    prompt through a fresh single-row BUCKET-length cache (bit-identical
+    K/V and logits to the greedy driver's full-length prefill — the
+    masked softmax gives padded/absent positions an exact 0), sample the
+    first token from the last REAL position's logits, and write the row
+    into the pool, wiping the slot's previous occupant up to the bucket
+    (anything staler is masked until decode overwrites it). Trace key:
+    the bucket length only (true_len/slot are traced scalars)."""
+    mini = init_cache(cfg, 1, padded.shape[1])
+    logits, mini = fwd(params, padded, mini, 0, cfg)
+    last = jax.lax.dynamic_slice_in_dim(
+        logits, true_len - 1, 1, axis=1)[:, 0].astype(jnp.float32)
+    if sampling:
+        keys = _slot_keys(base_key, req_ids, jnp.zeros((1,), jnp.int32))
+        first = _sample(last, temps, top_ks, keys, max_top_k)[0]
+    else:
+        first = jnp.argmax(last, axis=-1).astype(jnp.int32)[0]
+    cache = {
+        "k": jax.lax.dynamic_update_slice(
+            cache["k"], mini["k"], (0, slot, 0, 0, 0)),
+        "v": jax.lax.dynamic_update_slice(
+            cache["v"], mini["v"], (0, slot, 0, 0, 0)),
+    }
+    return first, cache
+
+
+# ----------------------------------------------------------- the engine
+class ServingEngine:
+    """Iteration-level scheduler over a fixed slot pool.
+
+    >>> eng = ServingEngine(params, cfg, family="gpt", num_slots=8)
+    >>> req = eng.submit(prompt_ids, max_new_tokens=32)
+    >>> while eng.has_work():
+    ...     for r, tok in eng.step():   # (request, token) emissions
+    ...         ...
+    >>> req.tokens
+
+    `generate(prompts, ...)` wraps submit+drain for batch use.
+    """
+
+    def __init__(self, params, cfg, family="gpt", num_slots: int = 8,
+                 max_len: Optional[int] = None, max_top_k: int = 0,
+                 seed: int = 0, bucket_lo: int = 8,
+                 decode_unroll: int = 0):
+        self.family = (family_for(family) if isinstance(family, str)
+                       else family)
+        self.cfg = cfg
+        self.num_slots = int(num_slots)
+        self.max_len = int(max_len or cfg.max_seq_len)
+        if self.max_len > getattr(cfg, "max_seq_len", self.max_len):
+            # positions past the table (gpt wpe / llama rope cache) would
+            # CLAMP, silently corrupting every later token
+            raise ValueError(
+                f"max_len ({self.max_len}) exceeds the model's "
+                f"max_seq_len ({cfg.max_seq_len}): position embeddings "
+                "beyond the table would clamp, not error")
+        self.max_top_k = int(max_top_k)
+        self.bucket_lo = int(bucket_lo)
+        self._params = params
+        self._cache = self.family.init_cache(cfg, self.num_slots,
+                                             self.max_len)
+        self._base_key = jax.random.PRNGKey(seed)
+
+        # at T=1 the layer scan's cache slice/restack dominates the
+        # matvecs: fully unroll shallow stacks (bit-identical numerics —
+        # models/gpt.py decode_scan_unroll). 0 = auto, 1 = keep the scan.
+        # Auto only applies when the config still carries the field's
+        # default (1): an explicitly tuned cfg.decode_scan_unroll wins.
+        cfg_unroll = getattr(cfg, "decode_scan_unroll", None)
+        if decode_unroll == 0:
+            if cfg_unroll not in (None, 1):
+                decode_unroll = cfg_unroll
+            else:
+                layers = getattr(cfg, "num_layers", 0)
+                decode_unroll = layers if 0 < layers <= 8 else 1
+        run_cfg = cfg
+        if cfg_unroll not in (None, decode_unroll):
+            try:
+                run_cfg = dataclasses.replace(
+                    cfg, decode_scan_unroll=decode_unroll)
+            except TypeError:        # non-dataclass custom family config
+                run_cfg = cfg
+
+        n = self.num_slots
+        # host MIRRORS of the slot state (scheduling reads these); the
+        # device copies ride donated through the tick and are rebuilt
+        # from the mirrors only when admission/eviction dirties them
+        self._positions = np.zeros(n, np.int32)   # tokens in each slot
+        self._active = np.zeros(n, bool)
+        self._cur_tok = np.zeros(n, np.int32)     # last sampled token
+        self._temps = np.zeros(n, np.float32)
+        self._top_ks = np.zeros(n, np.int32)
+        self._req_ids = np.zeros(n, np.int32)
+        self._gen_idx = np.zeros(n, np.int32)     # next sample index
+        self._dstate = None                       # device state tuple
+        self._dirty = True
+        self._slot_req: List[Optional[Request]] = [None] * n
+        self._queue: collections.deque = collections.deque()
+        self._next_id = 0
+
+        self._decode = jax.jit(
+            functools.partial(_decode_tick, fwd=self.family.forward_cached,
+                              cfg=run_cfg, max_top_k=self.max_top_k),
+            donate_argnums=(1, 2), static_argnames=("sampling",))
+        self._prefill = jax.jit(
+            functools.partial(_prefill_slot,
+                              fwd=self.family.forward_cached,
+                              init_cache=self.family.init_cache,
+                              cfg=run_cfg, max_top_k=self.max_top_k),
+            donate_argnums=(1,), static_argnames=("sampling",))
+
+        self._m_occ = monitor.gauge("serving.slot_occupancy")
+        self._m_queue = monitor.gauge("serving.queue_depth")
+        self._m_tok = monitor.counter("serving.tokens_emitted")
+        self._m_pre = monitor.counter("serving.prefills")
+        self._m_tick = monitor.counter("serving.decode_ticks")
+        self._m_sub = monitor.counter("serving.requests_submitted")
+        self._m_done = monitor.counter("serving.requests_completed")
+
+    # ------------------------------------------------------- observables
+    def trace_counts(self):
+        """(decode traces, prefill traces) — the zero-recompile
+        acceptance observable: decode holds at one trace per sampling
+        mode (<= 2 forever); prefill grows only with NEW (prompt
+        bucket, sampling mode) pairs — ceiling 2·log2(max_len)."""
+        return self._decode._cache_size(), self._prefill._cache_size()
+
+    def has_work(self) -> bool:
+        return bool(self._queue) or bool(self._active.any())
+
+    @property
+    def active_requests(self):
+        return [r for r in self._slot_req if r is not None]
+
+    # --------------------------------------------------------- admission
+    def submit(self, prompt, max_new_tokens: int, temperature: float = 0.0,
+               top_k: int = 0, eos_id: Optional[int] = None) -> Request:
+        """Queue one request. prompt: 1-D int token ids. Returns the
+        live Request; its .tokens fills in as the engine steps."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        t0 = prompt.shape[0]
+        if t0 < 1:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1; "
+                             f"got {max_new_tokens}")
+        if t0 + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({t0}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds the engine's max_len ({self.max_len})")
+        if top_k > 0 and self.max_top_k <= 0:
+            raise ValueError(
+                "engine was built with max_top_k=0 (greedy/temperature "
+                "only); rebuild with max_top_k >= the largest top_k "
+                "you will request")
+        if top_k > self.max_top_k:
+            raise ValueError(f"top_k={top_k} exceeds the engine's "
+                             f"static max_top_k={self.max_top_k}")
+        req = Request(self._next_id, prompt, int(max_new_tokens),
+                      float(temperature), int(top_k), eos_id)
+        self._next_id += 1
+        self._queue.append(req)
+        self._m_sub.add()
+        self._m_queue.set(len(self._queue))
+        return req
+
+    # --------------------------------------------------------- the tick
+    def step(self):
+        """One engine tick: admit queued requests into free slots
+        (one bucketed prefill each), then advance all active slots one
+        token through the single jitted decode step. Returns this
+        tick's (request, token) emissions in slot order."""
+        events: List[tuple] = []
+        while self._queue:
+            slot = self._free_slot()
+            if slot is None:
+                break
+            self._admit(slot, self._queue.popleft(), events)
+
+        if self._active.any():
+            if self._dirty:
+                self._dstate = (
+                    jnp.asarray(self._cur_tok), jnp.asarray(self._positions),
+                    jnp.asarray(self._active), jnp.asarray(self._temps),
+                    jnp.asarray(self._top_ks), jnp.asarray(self._req_ids),
+                    jnp.asarray(self._gen_idx))
+                self._dirty = False
+            sampling = bool(np.any(self._temps[self._active] > 0.0))
+            with RecordEvent("serving.decode_tick"):
+                nxt, self._cache, self._dstate = self._decode(
+                    self._params, self._cache, self._dstate,
+                    self._base_key, sampling=sampling)
+                toks = np.asarray(nxt)     # ONE host pull per tick
+            self._m_tick.add()
+            for i in np.nonzero(self._active)[0]:
+                req = self._slot_req[i]
+                tok = int(toks[i])
+                # mirror exactly what the tick did on device (positions
+                # and gen_idx advanced under the active mask) — no
+                # download, and the device state stays clean unless an
+                # eviction below dirties it
+                self._positions[i] += 1
+                self._cur_tok[i] = tok
+                self._gen_idx[i] += 1
+                req.tokens.append(tok)
+                events.append((req, tok))
+                self._m_tok.add()
+                self._maybe_finish(i, req)
+
+        self._m_occ.set(int(self._active.sum()))
+        self._m_queue.set(len(self._queue))
+        return events
+
+    def drain(self, max_ticks: Optional[int] = None):
+        """Step until idle (or max_ticks); returns all emissions."""
+        events = []
+        ticks = 0
+        while self.has_work():
+            events.extend(self.step())
+            ticks += 1
+            if max_ticks is not None and ticks >= max_ticks:
+                break
+        return events
+
+    def generate(self, prompts: Sequence, max_new_tokens: int,
+                 temperature: float = 0.0, top_k: int = 0,
+                 eos_id: Optional[int] = None) -> List[np.ndarray]:
+        """Batch convenience: submit every prompt, drain, return each
+        request's generated ids (submission order)."""
+        reqs = [self.submit(p, max_new_tokens, temperature=temperature,
+                            top_k=top_k, eos_id=eos_id) for p in prompts]
+        self.drain()
+        return [np.asarray(r.tokens, np.int32) for r in reqs]
+
+    # ---------------------------------------------------------- plumbing
+    def _free_slot(self) -> Optional[int]:
+        for i in range(self.num_slots):
+            if self._slot_req[i] is None:
+                return i
+        return None
+
+    def _admit(self, slot: int, req: Request, events: list) -> None:
+        t0 = len(req.prompt)
+        tb = prompt_bucket(t0, self.max_len, self.bucket_lo)
+        padded = np.zeros((1, tb), np.int32)
+        padded[0, :t0] = req.prompt
+        with RecordEvent("serving.prefill"):
+            first, self._cache = self._prefill(
+                self._params, self._cache, jnp.asarray(padded),
+                jnp.asarray(t0, jnp.int32), jnp.asarray(slot, jnp.int32),
+                jnp.asarray([req.temperature], jnp.float32),
+                jnp.asarray([req.top_k], jnp.int32),
+                jnp.asarray([req.id], jnp.int32), self._base_key,
+                sampling=req.temperature > 0.0)
+            tok = int(first)               # first generated token
+        self._m_pre.add()
+        req.slot = slot
+        self._slot_req[slot] = req
+        self._positions[slot] = t0
+        self._active[slot] = True
+        self._cur_tok[slot] = tok
+        self._temps[slot] = req.temperature
+        self._top_ks[slot] = req.top_k
+        self._req_ids[slot] = req.id
+        self._gen_idx[slot] = 1
+        self._dirty = True
+        req.tokens.append(tok)
+        events.append((req, tok))
+        self._m_tok.add()
+        self._maybe_finish(slot, req)
+
+    def _maybe_finish(self, slot: int, req: Request) -> None:
+        reason = None
+        if req.eos_id is not None and req.tokens[-1] == req.eos_id:
+            reason = "eos"
+        elif len(req.tokens) >= req.max_new_tokens:
+            reason = "length"
+        elif self._positions[slot] >= self.max_len:
+            reason = "cache_full"      # unreachable via submit's check
+        if reason is None:
+            return
+        req.done = True
+        req.finish_reason = reason
+        req.slot = None
+        self._slot_req[slot] = None
+        self._active[slot] = False
+        self._positions[slot] = 0
+        self._cur_tok[slot] = 0
+        self._temps[slot] = 0.0
+        self._top_ks[slot] = 0
+        self._gen_idx[slot] = 0
+        self._dirty = True
+        self._m_done.add()
+
+
+def create_serving_engine(model_or_params, cfg=None, **kw) -> ServingEngine:
+    """Build a ServingEngine from a facade model (GPTModel/LlamaModel —
+    family and params are inferred) or from a raw (params, cfg) pair
+    plus family=..."""
+    from ..models.facade import FacadeModel
+    if isinstance(model_or_params, FacadeModel):
+        model = model_or_params
+        family = kw.pop("family", getattr(model, "_serving_family", None))
+        if family is None:
+            raise ValueError(f"{type(model).__name__} does not name a "
+                             "_serving_family; pass family=...")
+        from ..framework.dispatch import raw_value
+        params = {n: raw_value(p) for n, p in model._params.items()}
+        return ServingEngine(params, model.cfg, family=family, **kw)
+    if cfg is None:
+        raise ValueError("create_serving_engine(params, cfg, ...) needs "
+                         "the model config")
+    return ServingEngine(model_or_params, cfg, **kw)

@@ -1,0 +1,110 @@
+"""Decode-path attention over a KV cache — the shared seam for every
+cached forward (greedy decode, the continuous-batching serving engine).
+
+Reference analog: the FusedMultiTransformer decode attention
+(paddle/fluid/operators/fused/fused_multi_transformer_op.cu:29, the
+masked single-step branch) reached via
+incubate/nn/layer/fused_transformer.py:1022. TPU-native collapse: at
+T=1 the attention is a bandwidth-bound matvec over the cache — flash
+tiling buys nothing — so the implementations here are dense masked
+einsums; what stays selectable is the precision trade.
+
+One implementation serves BOTH cache-position shapes:
+- scalar `pos` — the whole batch sits at one position (whole-batch
+  greedy decode, models/decode.py);
+- per-row `pos` [B] — every row advances independently (the serving
+  engine's slot pool, inference/serving.py: requests join and leave
+  mid-decode, so slot i holds `pos[i]` tokens).
+
+GQA is native: kc/vc carry KV heads; queries fold their group axis into
+the einsum so repeated KV is never materialized (models/llama.py's
+decode-bandwidth trade).
+
+Implementation selection (the kernels/registry.py seam — env >
+registry winner > default, same precedence as flash_attention._attn_impl):
+- 'dense'  f32 scores AND f32 context accumulation (default: exactly
+  the training forward's numerics, required for the serving engine's
+  bit-parity guarantee against per-request greedy decode);
+- 'mixed'  QK^T and P·V run in the cache dtype with an f32 softmax —
+  halves decode HBM traffic for bf16 caches; opt in per backend via
+  the registry or PADDLE_TPU_DECODE_ATTN_IMPL.
+"""
+from __future__ import annotations
+
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["write_kv", "cached_attention", "decode_attn_impl"]
+
+
+def decode_attn_impl() -> str:
+    """Selector: env PADDLE_TPU_DECODE_ATTN_IMPL > registry winner
+    ('decode_attention', current backend class) > 'dense'. The env var
+    is re-read per trace like the Pallas kill switches."""
+    env = os.environ.get("PADDLE_TPU_DECODE_ATTN_IMPL")
+    if env:
+        return env
+    from . import registry
+    win = registry.winner("decode_attention",
+                          backend=registry.backend_class(
+                              jax.default_backend()))
+    return win or "dense"
+
+
+def write_kv(kc, k, pos):
+    """Write the step's k (or v) [B, T, KV, hd] into the cache
+    [B, S, KV, hd] at position(s) `pos` — scalar (one
+    dynamic_update_slice; XLA aliases the donated buffer) or [B]
+    per-row (vmapped per-slot update: each slot writes at its own
+    offset, the serving engine's in-place slot write)."""
+    k = k.astype(kc.dtype)
+    if jnp.ndim(pos) == 0:
+        return jax.lax.dynamic_update_slice(kc, k, (0, pos, 0, 0))
+    return jax.vmap(
+        lambda c, u, p: jax.lax.dynamic_update_slice(c, u, (p, 0, 0))
+    )(kc, k, pos)
+
+
+def _query_positions(pos, B, T):
+    """Absolute positions of the T queries per row -> [B, T]."""
+    offs = jnp.arange(T, dtype=jnp.int32)[None, :]
+    if jnp.ndim(pos) == 0:
+        return jnp.broadcast_to(pos + offs, (B, T))
+    return pos[:, None] + offs
+
+
+def cached_attention(q, kc, vc, pos, impl: str | None = None):
+    """Masked attention of q [B, T, H, hd] against the cache kc/vc
+    [B, S, KV, hd]; query t of row b sits at absolute position
+    `pos[b] + t` (pos scalar or [B]) and sees cache slots <= that
+    position. Returns ctx [B, T, H, hd] float32 (callers cast).
+
+    Slots above the row's own position are masked to -inf before the
+    softmax, so stale cache contents (a freed slot's previous request,
+    bucket-pad garbage beyond the true prompt length) contribute an
+    exact 0.0 — the serving engine's correctness rests on this."""
+    B, T, H, hd = q.shape
+    S, KV = kc.shape[1], kc.shape[2]
+    G = H // KV
+    impl = impl or decode_attn_impl()
+    if impl not in ("dense", "mixed"):
+        raise ValueError(
+            f"unknown decode_attention impl {impl!r} (dense|mixed)")
+    dot_dt = kc.dtype if impl == "mixed" else jnp.float32
+    scale = 1.0 / math.sqrt(hd)
+
+    qf = q.reshape(B, T, KV, G, hd).astype(dot_dt) * jnp.asarray(
+        scale, dot_dt)
+    s = jnp.einsum("btkgd,bskd->bkgts", qf, kc.astype(dot_dt))
+    qpos = _query_positions(pos, B, T)                             # B,T
+    # mask [B,1,1,T,S] broadcast over the (kv-head, group) axes
+    mask = (jnp.arange(S, dtype=jnp.int32)[None, :]
+            <= qpos[..., None])[:, None, None, :, :]
+    s = jnp.where(mask, s.astype(jnp.float32), -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    ctx = jnp.einsum("bkgts,bskd->btkgd", p.astype(dot_dt)
+                     if impl == "mixed" else p, vc.astype(dot_dt))
+    return ctx.reshape(B, T, H, hd).astype(jnp.float32)

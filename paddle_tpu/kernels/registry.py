@@ -88,6 +88,12 @@ KNOWN_IMPLS: Dict[str, tuple] = {
     "attention": ("pallas", "jax_flash", "splash", "xla"),
     "ce": ("pallas", "jax"),
     "varlen_attention": ("blockwise", "dense"),
+    # decode-path attention over the KV cache (greedy decode + the
+    # serving engine's slot pool): 'dense' = f32 scores/context (the
+    # bit-parity default), 'mixed' = cache-dtype QK^T and P.V with an
+    # f32 softmax (halves bf16 decode HBM traffic) — see
+    # kernels/decode_attention.py
+    "decode_attention": ("dense", "mixed"),
 }
 
 _DOCS: Dict[str, Optional[dict]] = {}   # path -> parsed doc (memoized)

@@ -4,45 +4,112 @@ incubate/nn/layer/fused_transformer.py:1022 and the hapi/predictor
 generate paths). One implementation parameterized by the family's
 `forward_cached(params, tokens, cache, pos, cfg)` — the same
 anti-drift extraction as gpt.apply_adamw: gpt and llama must not carry
-diverging copies of the prefill/scan/concat plumbing."""
+diverging copies of the prefill/scan/concat plumbing.
+
+Prompt-length bucketing: a raw jit over the prefill retraces for every
+distinct prompt length (the round-5 serving gap). Here the prompt is
+padded to a power-of-two bucket and the TRUE length rides through the
+trace as a scalar — the prefill's last-real-token logits come from a
+dynamic slice at `true_len - 1`, decode positions are `true_len + i`,
+and the pad's garbage K/V beyond the true length is never attended
+(the decode-attention mask admits cache slots <= the query position
+only, and decode writes overwrite the pad slots in order). Repeated
+calls with varying prompt lengths therefore reuse one compiled
+executable per (bucket, max_new_tokens, max_len) — ~log(max_len)
+traces total, asserted by tests/test_serving.py via `generate_fn`'s
+jit cache size. The serving engine's bucketed prefill
+(inference/serving.py) uses the same `prompt_bucket` policy, which is
+what makes its token streams bit-identical to this driver's."""
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
 
 
+def next_pow2(n: int, lo: int = 8) -> int:
+    """Smallest power of two >= max(n, lo)."""
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+def prompt_bucket(n: int, max_len: int, lo: int = 8) -> int:
+    """Padded prompt length for a true length `n`: the power-of-two
+    bucket, clamped to the cache length. `lo` floors the bucket set so
+    tiny prompts don't each mint an executable."""
+    if n > max_len:
+        raise ValueError(f"prompt length {n} exceeds max_len {max_len}")
+    return min(next_pow2(n, lo), max_len)
+
+
+_GEN_FNS = {}    # (fwd, init, repr(cfg), max_new, max_len) -> jitted fn
+
+
+def generate_fn(forward_cached, init_cache, cfg, max_new_tokens: int,
+                max_len: int):
+    """The memoized jitted generate body. Exposed so tests can assert
+    the trace count (`generate_fn(...)._cache_size()`): one trace per
+    (batch, prompt bucket), regardless of true prompt lengths."""
+    key = (forward_cached, init_cache, repr(cfg), max_new_tokens, max_len)
+    fn = _GEN_FNS.get(key)
+    if fn is not None:
+        return fn
+
+    def gen(params, padded, true_len):
+        """padded [B, Tb]; true_len scalar — real prompt length.
+        -> generated tokens [B, max_new_tokens]."""
+        B = padded.shape[0]
+        cache = init_cache(cfg, B, max_len)
+        logits, cache = forward_cached(params, padded, cache, 0, cfg)
+        last = jax.lax.dynamic_slice_in_dim(
+            logits, true_len - 1, 1, axis=1)[:, 0]
+        next_tok = jnp.argmax(last.astype(jnp.float32), axis=-1)
+
+        def step(carry, i):
+            tok, cache = carry
+            lg, cache = forward_cached(params, tok[:, None], cache,
+                                       true_len + i, cfg)
+            nxt = jnp.argmax(lg[:, -1].astype(jnp.float32), axis=-1)
+            return (nxt, cache), tok
+
+        # N-1 decode steps: ys collects gen tokens 1..N-1, the final
+        # carry is gen token N (no wasted extra forward)
+        (last_tok, _), toks = jax.lax.scan(
+            step, (next_tok, cache), jnp.arange(max_new_tokens - 1))
+        return jnp.concatenate(
+            [jnp.moveaxis(toks, 0, 1).astype(padded.dtype),
+             last_tok[:, None].astype(padded.dtype)], 1)
+
+    fn = _GEN_FNS[key] = jax.jit(gen)
+    return fn
+
+
 def greedy_generate_with(forward_cached, init_cache, params, prompt,
                          cfg, max_new_tokens: int, max_len=None):
-    """Greedy decode: prefill the prompt once, then scan single-token
-    steps through the cache. prompt [B, T0] -> [B, T0+max_new_tokens]."""
+    """Greedy decode: prefill the bucketed prompt once, then scan
+    single-token steps through the cache. prompt [B, T0] ->
+    [B, T0+max_new_tokens]."""
     B, T0 = prompt.shape
     if max_new_tokens < 0:
         raise ValueError(f"max_new_tokens must be >= 0; "
                          f"got {max_new_tokens}")
     if max_new_tokens == 0:
         return prompt
-    max_len = max_len or min(cfg.max_seq_len, T0 + max_new_tokens)
+    if max_len is None:
+        # depend on the BUCKET, not T0, so every prompt length in a
+        # bucket lands on the same executable (the old
+        # min(max_seq_len, T0 + max_new) default retraced per length)
+        tb0 = next_pow2(T0)
+        max_len = min(cfg.max_seq_len, next_pow2(tb0 + max_new_tokens))
     if T0 + max_new_tokens > max_len:
         raise ValueError(
             f"prompt ({T0}) + max_new_tokens ({max_new_tokens}) exceeds "
             f"max_len ({max_len}): the cache/position slices would "
             "clamp and silently corrupt the tail")
-    cache = init_cache(cfg, B, max_len)
-    logits, cache = forward_cached(params, prompt, cache, 0, cfg)
-    next_tok = jnp.argmax(logits[:, -1].astype(jnp.float32), axis=-1)
-
-    def step(carry, i):
-        tok, cache = carry
-        lg, cache = forward_cached(params, tok[:, None], cache,
-                                   T0 + i, cfg)
-        nxt = jnp.argmax(lg[:, -1].astype(jnp.float32), axis=-1)
-        return (nxt, cache), tok
-
-    # N-1 decode steps: ys collects gen tokens 1..N-1, the final carry
-    # is gen token N (no wasted extra forward)
-    (last, _), toks = jax.lax.scan(
-        step, (next_tok, cache), jnp.arange(max_new_tokens - 1))
-    gen = jnp.concatenate(
-        [jnp.moveaxis(toks, 0, 1).astype(prompt.dtype),
-         last[:, None].astype(prompt.dtype)], 1)
-    return jnp.concatenate([prompt, gen], axis=1)
+    tb = prompt_bucket(T0, max_len)
+    padded = jnp.pad(prompt, ((0, 0), (0, tb - T0)))
+    gen = generate_fn(forward_cached, init_cache, cfg, max_new_tokens,
+                      max_len)
+    out = gen(params, padded, jnp.asarray(T0, jnp.int32))
+    return jnp.concatenate([prompt, out], axis=1)

@@ -49,6 +49,10 @@ def make_train_step(step_fn, cfg=None, donate=True, extra_donate=(),
 
 class FacadeModel:
     _fwd_op_name = "model_forward"
+    # decoder families name their serving family ("gpt"/"llama") so
+    # generate() can build a continuous-batching engine over the same
+    # params (inference/serving.py)
+    _serving_family = None
 
     def __init__(self, cfg, init_fn, specs, seed=0):
         import jax
@@ -84,6 +88,42 @@ class FacadeModel:
     def eval(self):
         self.training = False
         return self
+
+    def generate(self, prompts, max_new_tokens, num_slots=8,
+                 max_len=None, temperature=0.0, top_k=0, eos_id=None,
+                 max_top_k=0, seed=0):
+        """Continuous-batching generation over this model's params
+        (inference/serving.py): prompts is a list of 1-D int token-id
+        sequences of MIXED lengths; returns one generated-id array per
+        prompt, in order. The engine (slot pool + donated KV cache +
+        compiled prefill/decode executables) is cached on the model and
+        reused while the pool knobs AND the param values stay the same;
+        set_value/load/train-step replace the underlying arrays, which
+        the identity check below catches, rebuilding the engine so it
+        never serves stale weights."""
+        if self._serving_family is None:
+            raise NotImplementedError(
+                f"{type(self).__name__} is not a cached decoder family; "
+                "generate() needs _serving_family")
+        from ..framework.dispatch import raw_value
+        key = (num_slots, max_len, max_top_k, seed,
+               tuple(raw_value(self._params[n])
+                     for n in self._param_names))
+        eng = getattr(self, "_serving_engine", None)
+        cached_key = getattr(self, "_serving_engine_key", None)
+        if (eng is None or cached_key is None
+                or cached_key[:4] != key[:4]
+                or any(a is not b
+                       for a, b in zip(cached_key[4], key[4]))):
+            from ..inference.serving import create_serving_engine
+            eng = create_serving_engine(
+                self, num_slots=num_slots, max_len=max_len,
+                max_top_k=max_top_k, seed=seed)
+            self._serving_engine = eng
+            self._serving_engine_key = key
+        return eng.generate(prompts, max_new_tokens,
+                            temperature=temperature, top_k=top_k,
+                            eos_id=eos_id)
 
     def _dispatch(self, op_name, fn, *inputs):
         """fn(params_dict, *inputs) -> outputs; fn must not capture the

@@ -72,6 +72,12 @@ class GPTConfig:
     # adjacent blocks at the cost of compile time; raced on hardware, the
     # default stays 1 (numerics identical either way)
     scan_unroll: int = 1
+    # unroll for the CACHED decode path's layer scan (forward_cached):
+    # at T=1 the scan's per-layer cache slice/restack dominates the tiny
+    # matvecs (measured 3.3 -> 2.0 ms/tick on the CPU serving bench at
+    # 2L x 128d x 8 slots), so the serving engine auto-raises this for
+    # shallow models; numerics are bit-identical either way
+    decode_scan_unroll: int = 1
     sequence_parallel: bool = True            # SP on the 'mp' axis
     # context parallelism for long sequences: "none" | "ring" | "ulysses";
     # shards the sequence axis over the mesh's 'sp' axis ('mp' if absent)
@@ -537,6 +543,8 @@ class GPTModel(FacadeModel):
     as one traced op through the dispatch layer — plumbing shared with
     BertModel/ViTModel via models/facade.py)."""
 
+    _serving_family = "gpt"
+
     def __init__(self, cfg: GPTConfig, seed: int = 0):
         super().__init__(
             cfg,
@@ -590,8 +598,12 @@ def init_kv_cache(cfg: GPTConfig, batch: int, max_len: int):
 
 def _cached_attention(x, params_l, kc, vc, pos, cfg):
     """One block's attention with cache update. x [B,T,D]; kc/vc
-    [B,max_len,H,hd]; pos = number of tokens already in the cache.
-    Returns (attn_out, kc, vc)."""
+    [B,max_len,H,hd]; pos = number of tokens already in the cache — a
+    scalar (whole-batch decode) or a [B] vector of per-row positions
+    (the serving engine's slot pool, where every slot advances
+    independently). Returns (attn_out, kc, vc). The cache write and the
+    masked attention go through the selectable decode-attention seam
+    (kernels/decode_attention.py; registry kernel 'decode_attention')."""
     B, T, D = x.shape
     H, hd = cfg.num_heads, cfg.head_dim
     qkv = jnp.einsum("bsd,df->bsf", x, params_l["qkv_w"].astype(x.dtype))
@@ -601,21 +613,11 @@ def _cached_attention(x, params_l, kc, vc, pos, cfg):
     q = q.reshape(B, T, H, hd)
     k = k.reshape(B, T, H, hd)
     v = v.reshape(B, T, H, hd)
-    kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype), (0, pos, 0, 0))
-    vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype), (0, pos, 0, 0))
-    # dense masked attention over the cache: query i (global pos+i) sees
-    # cache slots <= pos+i
-    scale = 1.0 / math.sqrt(hd)
-    qf = jnp.swapaxes(q, 1, 2).astype(jnp.float32) * scale   # B,H,T,hd
-    kf = jnp.swapaxes(kc, 1, 2).astype(jnp.float32)          # B,H,S,hd
-    vf = jnp.swapaxes(vc, 1, 2).astype(jnp.float32)
-    s = jnp.einsum("bhtd,bhsd->bhts", qf, kf)
-    kvpos = jnp.arange(kc.shape[1])[None, :]                 # 1,S
-    qpos = pos + jnp.arange(T)[:, None]                      # T,1
-    s = jnp.where(kvpos <= qpos, s, -jnp.inf)
-    p = jax.nn.softmax(s, axis=-1)
-    ctx = jnp.einsum("bhts,bhsd->bhtd", p, vf)
-    ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, T, D).astype(x.dtype)
+    from ..kernels.decode_attention import cached_attention, write_kv
+    kc = write_kv(kc, k, pos)
+    vc = write_kv(vc, v, pos)
+    ctx = cached_attention(q, kc, vc, pos)
+    ctx = ctx.reshape(B, T, D).astype(x.dtype)
     out = jnp.einsum("bsd,df->bsf", ctx,
                      params_l["attn_out_w"].astype(x.dtype))
     if params_l.get("attn_out_b") is not None:
@@ -629,11 +631,19 @@ def gpt_forward_cached(params, tokens, cache, pos, cfg: GPTConfig):
     and decode (T=1), for dense and MoE configs (reference: the inference
     decoder's global_scatter path — here the same capacity dispatch runs
     on the decode tokens; the aux load-balancing loss is discarded at
-    inference)."""
+    inference). `pos` may be a traced scalar (whole-batch decode; the
+    bucketed models/decode.py driver passes the true prompt length) or a
+    [B] vector of per-row slot positions (inference/serving.py: each
+    slot holds its own request mid-stream)."""
     B, T = tokens.shape
     x = jnp.take(params["wte"], tokens, axis=0).astype(cfg.dtype)
-    wpe = jax.lax.dynamic_slice_in_dim(params["wpe"], pos, T, axis=0)
-    x = x + wpe[None].astype(cfg.dtype)
+    if jnp.ndim(pos) == 0:
+        wpe = jax.lax.dynamic_slice_in_dim(params["wpe"], pos, T,
+                                           axis=0)[None]
+    else:
+        wpe = jnp.take(params["wpe"],
+                       pos[:, None] + jnp.arange(T), axis=0)
+    x = x + wpe.astype(cfg.dtype)
 
     block_keys = _BLOCK_KEYS_MOE if cfg.num_experts > 0 else _BLOCK_KEYS_DENSE
     stacked = {k: params[k] for k in block_keys if k in params}
@@ -660,7 +670,9 @@ def gpt_forward_cached(params, tokens, cache, pos, cfg: GPTConfig):
         return h + m, (kc, vc)
 
     x, (kcs, vcs) = jax.lax.scan(scan_fn, x,
-                                 (stacked, cache["k"], cache["v"]))
+                                 (stacked, cache["k"], cache["v"]),
+                                 unroll=getattr(cfg, "decode_scan_unroll",
+                                                1))
     x = _ln(x, params["ln_f_scale"], params["ln_f_bias"], cfg.layer_norm_eps)
     logits = jnp.einsum("bsd,vd->bsv", x, params["wte"].astype(x.dtype))
     return logits, {"k": kcs, "v": vcs}

@@ -54,6 +54,10 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = True                        # checkpoint each block
+    # layer-scan unroll for the cached decode path (see
+    # models/gpt.py GPTConfig.decode_scan_unroll — same trade,
+    # bit-identical numerics; the serving engine auto-raises it)
+    decode_scan_unroll: int = 1
 
     def __post_init__(self):
         if self.num_kv_heads is None:
@@ -128,12 +132,18 @@ def _rope_tables(seq: int, hd: int, theta: float):
 
 
 def _apply_rope(x, cos, sin):
-    """x [B, S, H, hd]; rotate interleaved pairs by the position angle."""
+    """x [B, S, H, hd]; rotate interleaved pairs by the position angle.
+    cos/sin are [S, hd/2] (shared positions) or [B, S, hd/2] (per-row
+    positions — the serving engine's slot decode)."""
     B, S, H, hd = x.shape
     xf = x.astype(jnp.float32).reshape(B, S, H, hd // 2, 2)
     x1, x2 = xf[..., 0], xf[..., 1]
-    c = cos[None, :, None, :]
-    s = sin[None, :, None, :]
+    if cos.ndim == 2:
+        c = cos[None, :, None, :]
+        s = sin[None, :, None, :]
+    else:
+        c = cos[:, :, None, :]
+        s = sin[:, :, None, :]
     rot = jnp.stack([x1 * c - x2 * s, x1 * s + x2 * c], -1)
     return rot.reshape(B, S, H, hd).astype(x.dtype)
 
@@ -227,16 +237,27 @@ def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int):
 def llama_forward_cached(params, tokens, cache, pos, cfg: LlamaConfig):
     """Forward tokens [B,T] against a cache holding `pos` tokens ->
     (logits [B,T,V], updated cache). Prefill (pos=0) and decode (T=1)
-    share the graph; RoPE is applied at the absolute positions."""
+    share the graph; RoPE is applied at the absolute positions. `pos`
+    is a traced scalar (whole-batch decode) or a [B] vector of per-row
+    slot positions (inference/serving.py). The cache write and the
+    grouped masked attention (KV heads in the cache, never-materialized
+    query groups — the GQA decode-bandwidth payoff) go through the
+    selectable seam in kernels/decode_attention.py."""
     B, T = tokens.shape
     H, KV, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     x = jnp.take(params["wte"], tokens, axis=0).astype(cfg.dtype)
     cos_full, sin_full = _rope_tables(cache["k"].shape[2], hd,
                                       cfg.rope_theta)
-    cos = jax.lax.dynamic_slice_in_dim(cos_full, pos, T, axis=0)
-    sin = jax.lax.dynamic_slice_in_dim(sin_full, pos, T, axis=0)
+    if jnp.ndim(pos) == 0:
+        cos = jax.lax.dynamic_slice_in_dim(cos_full, pos, T, axis=0)
+        sin = jax.lax.dynamic_slice_in_dim(sin_full, pos, T, axis=0)
+    else:
+        idx = pos[:, None] + jnp.arange(T)
+        cos = jnp.take(cos_full, idx, axis=0)        # [B, T, hd/2]
+        sin = jnp.take(sin_full, idx, axis=0)
 
     stacked = {k: params[k] for k in _BLOCK_KEYS}
+    from ..kernels.decode_attention import cached_attention, write_kv
 
     def scan_fn(x, layer_in):
         lp, kc, vc = layer_in
@@ -246,23 +267,9 @@ def llama_forward_cached(params, tokens, cache, pos, cfg: LlamaConfig):
         v = (h @ lp["v_w"].astype(h.dtype)).reshape(B, T, KV, hd)
         q = _apply_rope(q, cos, sin)
         k = _apply_rope(k, cos, sin)
-        kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype),
-                                          (0, pos, 0, 0))
-        vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype),
-                                          (0, pos, 0, 0))
-        # grouped dense attention over the cache: fold the group axis
-        # into the batch of the einsum, never materializing repeated KV
-        scale = 1.0 / math.sqrt(hd)
-        G = H // KV
-        qf = q.reshape(B, T, KV, G, hd).astype(jnp.float32) * scale
-        kf = kc.astype(jnp.float32)                       # B,S,KV,hd
-        s = jnp.einsum("btkgd,bskd->bkgts", qf, kf)
-        kvpos = jnp.arange(kc.shape[1])[None, :]
-        qpos = pos + jnp.arange(T)[:, None]
-        s = jnp.where(kvpos <= qpos, s, -jnp.inf)
-        p = jax.nn.softmax(s, axis=-1)
-        ctx = jnp.einsum("bkgts,bskd->btkgd", p,
-                         vc.astype(jnp.float32))
+        kc = write_kv(kc, k, pos)
+        vc = write_kv(vc, v, pos)
+        ctx = cached_attention(q, kc, vc, pos)
         ctx = ctx.reshape(B, T, H * hd).astype(x.dtype)
         x = x + ctx @ lp["o_w"].astype(x.dtype)
         h = _rmsnorm(x, lp["ffn_norm"], cfg.rms_eps)
@@ -271,7 +278,9 @@ def llama_forward_cached(params, tokens, cache, pos, cfg: LlamaConfig):
         return x + gated @ lp["down_w"].astype(x.dtype), (kc, vc)
 
     x, (kcs, vcs) = jax.lax.scan(scan_fn, x,
-                                 (stacked, cache["k"], cache["v"]))
+                                 (stacked, cache["k"], cache["v"]),
+                                 unroll=getattr(cfg, "decode_scan_unroll",
+                                                1))
     x = _rmsnorm(x, params["norm_f"], cfg.rms_eps)
     logits = jnp.einsum("bsd,vd->bsv", x, params["wte"].astype(x.dtype))
     return logits, {"k": kcs, "v": vcs}
@@ -293,6 +302,7 @@ class LlamaModel(FacadeModel):
     state_dict / tape-recorded forward as ONE differentiable op)."""
 
     _fwd_op_name = "llama_forward"
+    _serving_family = "llama"
 
     def __init__(self, cfg: LlamaConfig, seed: int = 0):
         super().__init__(cfg, init_llama_params, PARAM_SPECS, seed)

@@ -1,0 +1,63 @@
+"""ONE home for the persistent XLA compile-cache wiring.
+
+Reference analog: the autotune/program caches the reference persists
+across runs (paddle/phi/kernels/autotune/cache.cc:1) — here the cached
+artifact is the XLA executable itself. Remote compiles over the axon
+tunnel cost minutes; a scarce tunnel window must never re-pay them for
+graphs an earlier job/window already built, so every measurement entry
+point (bench.py rungs, tools/bench_ladder.py rows, the
+tools/tpu_campaign.py job env, __graft_entry__'s compile checks) routes
+through these three helpers instead of hand-rolling the env wiring —
+the duplication this module replaces had already drifted once
+(bench.py carried two copies of the dir+config dance).
+
+Policy (enforced by sync_compile_cache_for): the cache is TPU-only.
+XLA:CPU's AOT reload warns about machine-feature mismatches even
+same-host, so a job that inherited JAX_COMPILATION_CACHE_DIR (campaign
+env) but resolved to CPU — mid-window tunnel drop, ladder run on a
+TPU-less host — disables it again after the backend is known.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["xla_cache_dir", "seed_cache_env", "sync_compile_cache_for"]
+
+
+def xla_cache_dir() -> str:
+    """The shared persistent-compile-cache location (repo-root
+    perf/xla_cache; override with PADDLE_TPU_XLA_CACHE_DIR)."""
+    path = os.environ.get("PADDLE_TPU_XLA_CACHE_DIR") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "perf", "xla_cache")
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+def seed_cache_env() -> None:
+    """Point JAX_COMPILATION_CACHE_DIR at the shared cache. The env var
+    is read at interpreter start (the axon site hook imports jax before
+    user code), so ALSO push it through the config API. Call before (or
+    regardless of) backend init; pair with sync_compile_cache_for once
+    the platform is known."""
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", xla_cache_dir())
+    try:
+        import jax
+        if jax.config.jax_compilation_cache_dir is None:
+            jax.config.update("jax_compilation_cache_dir",
+                              os.environ["JAX_COMPILATION_CACHE_DIR"])
+    except Exception:
+        pass
+
+
+def sync_compile_cache_for(platform: str) -> None:
+    """Enforce the TPU-only policy AFTER the backend is known: enable
+    the shared cache for TPU-class platforms ('tpu'/'axon'), disable it
+    for everything else (XLA:CPU AOT reloads are unreliable)."""
+    import jax
+    if platform in ("tpu", "axon"):
+        if jax.config.jax_compilation_cache_dir is None:
+            jax.config.update("jax_compilation_cache_dir",
+                              xla_cache_dir())
+    elif jax.config.jax_compilation_cache_dir is not None:
+        jax.config.update("jax_compilation_cache_dir", None)

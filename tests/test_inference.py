@@ -73,6 +73,48 @@ class TestPredictor:
         assert not c.use_gpu()
         assert "Config" in c.summary()
 
+    def test_precision_applied_to_params(self, tmp_path):
+        """Config._precision is honored (the round-5 silent-ignore
+        fix): bf16/f16 land as a weight round-trip cast on the loaded
+        params (the StableHLO artifact pins compute dtypes at save)."""
+        from paddle_tpu.inference import PrecisionType
+        _, path = self._save_model(tmp_path)
+        for prec, dt in ((PrecisionType.Bfloat16, jnp.bfloat16),
+                         (PrecisionType.Half, jnp.float16)):
+            p = create_predictor(Config(path).set_precision(prec))
+            for w in p._layer._params:
+                np.testing.assert_array_equal(
+                    np.asarray(w),
+                    np.asarray(w.astype(dt).astype(w.dtype)))
+            p.run([np.ones((2, 8), np.float32)])   # still serves
+
+    def test_precision_int8_refused(self, tmp_path):
+        from paddle_tpu.inference import PrecisionType
+        _, path = self._save_model(tmp_path)
+        with pytest.raises(NotImplementedError):
+            create_predictor(Config(path).set_precision(
+                PrecisionType.Int8))
+        with pytest.raises(ValueError):
+            Config(path).set_precision("int4")
+
+    def test_tensorrt_precision_mode_sets_precision(self):
+        from paddle_tpu.inference import PrecisionType
+        c = Config("/tmp/foo.pdmodel")
+        c.enable_tensorrt_engine(precision_mode=PrecisionType.Half)
+        assert c._precision == PrecisionType.Half
+
+    def test_output_handles_cached_across_runs(self, tmp_path):
+        _, path = self._save_model(tmp_path)
+        p = create_predictor(Config(path))
+        x = np.ones((2, 8), np.float32)
+        out1 = p.run([x])[0]
+        h1 = p.get_output_handle(p.get_output_names()[0])
+        out2 = p.run([x + 1])[0]
+        h2 = p.get_output_handle(p.get_output_names()[0])
+        assert h1 is h2                 # refilled in place, not rebuilt
+        np.testing.assert_array_equal(h2.copy_to_cpu(), out2)
+        assert not np.array_equal(out1, out2)
+
 
 class TestKVCacheDecode:
     def test_prefill_matches_full_forward(self):

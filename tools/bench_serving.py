@@ -1,0 +1,185 @@
+"""Serving-engine benchmark: continuous batching vs sequential decode.
+
+Measures aggregate generated tokens/sec on a mixed-prompt-length
+workload two ways —
+  (a) sequential per-request `greedy_generate` (the pre-engine serving
+      story: each request prefills and decodes alone), and
+  (b) the continuous-batching ServingEngine (inference/serving.py:
+      slot-pool KV cache, bucketed prefill, one jitted decode tick)
+— and prints ONE JSON line with both numbers, the speedup, and the
+post-warmup trace counts (the zero-recompile acceptance observable).
+
+Methodology: both paths run the full workload once to warm every
+compiled executable (all prompt buckets + the decode step), then the
+timed pass runs on warm caches. Work is step-sized per dispatch — each
+engine tick advances every slot one token through one jit call, each
+sequential step is a whole scan-fused generate — so per-call wall
+timing is sound on the CPU rung (no tunnel in the loop; see
+tools/bench_util.timeit's rule). The engine's per-tick host pull of
+the sampled tokens is PART of the measured loop: that round trip is
+the real serving cost, not an artifact.
+
+Usage:
+  python tools/bench_serving.py                # acceptance workload
+  python tools/bench_serving.py --requests 32 --gen 64 --slots 16
+  PADDLE_TPU_TELEMETRY_JSONL=serve.jsonl python tools/bench_serving.py
+
+The default workload is the BASELINE.md "Serving" row: 16 requests,
+prompt lengths uniform in [8, 96], 32 generated tokens each, GPT
+2L x 128d, greedy.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# CPU by default: the axon tunnel flaps and ANY backend init then hangs
+# (CLAUDE.md trap). --tpu opts into the real backend.
+if "--tpu" not in sys.argv:
+    from paddle_tpu.device import pin_cpu
+    pin_cpu(1)
+
+import numpy as np                                    # noqa: E402
+import jax                                            # noqa: E402
+import jax.numpy as jnp                               # noqa: E402
+
+
+def _log(msg):
+    print(f"[bench_serving] {msg}", file=sys.stderr, flush=True)
+
+
+def build_workload(n_requests, lo, hi, vocab, seed=0):
+    rng = np.random.RandomState(seed)
+    lens = rng.randint(lo, hi + 1, n_requests)
+    return [rng.randint(0, vocab, L).astype(np.int32) for L in lens]
+
+
+def run_sequential(params, cfg, prompts, gen, max_len, greedy_generate):
+    for p in prompts:
+        out = greedy_generate(params, jnp.asarray(p)[None], cfg, gen,
+                              max_len=max_len)
+    np.asarray(out)          # force the tail
+    t0 = time.perf_counter()
+    outs = []
+    for p in prompts:
+        out = greedy_generate(params, jnp.asarray(p)[None], cfg, gen,
+                              max_len=max_len)
+        outs.append(np.asarray(out)[0, len(p):])   # per-request pull —
+        #                                the sequential loop's real shape
+    return time.perf_counter() - t0, outs
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--requests", type=int, default=16)
+    ap.add_argument("--gen", type=int, default=32)
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--prompt-lo", type=int, default=8)
+    ap.add_argument("--prompt-hi", type=int, default=96)
+    ap.add_argument("--family", choices=("gpt", "llama"), default="gpt")
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--hidden", type=int, default=128)
+    ap.add_argument("--vocab", type=int, default=512)
+    ap.add_argument("--max-len", type=int, default=0,
+                    help="cache length (0 = next pow2 of hi+gen)")
+    ap.add_argument("--tpu", action="store_true",
+                    help="run on the default (TPU) backend")
+    args = ap.parse_args()
+
+    from paddle_tpu.models.decode import next_pow2
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.profiler import monitor
+
+    max_len = args.max_len or next_pow2(args.prompt_hi + args.gen)
+    if args.family == "gpt":
+        from paddle_tpu.models.gpt import (GPTConfig, init_gpt_params,
+                                           greedy_generate)
+        cfg = GPTConfig(vocab_size=args.vocab, hidden_size=args.hidden,
+                        num_layers=args.layers,
+                        num_heads=max(args.hidden // 32, 1),
+                        max_seq_len=2 * max_len, sequence_parallel=False,
+                        remat=False, dtype=jnp.float32)
+        params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+    else:
+        from paddle_tpu.models.llama import (LlamaConfig,
+                                             init_llama_params,
+                                             greedy_generate)
+        cfg = LlamaConfig(vocab_size=args.vocab, hidden_size=args.hidden,
+                          num_layers=args.layers,
+                          num_heads=max(args.hidden // 32, 1),
+                          num_kv_heads=max(args.hidden // 64, 1),
+                          max_seq_len=2 * max_len, remat=False,
+                          dtype=jnp.float32)
+        params = init_llama_params(cfg, jax.random.PRNGKey(0))
+
+    prompts = build_workload(args.requests, args.prompt_lo,
+                             args.prompt_hi, args.vocab)
+    total_tokens = args.requests * args.gen
+    _log(f"workload: {args.requests} reqs, prompts "
+         f"{args.prompt_lo}-{args.prompt_hi}, gen {args.gen}, "
+         f"{args.family} {args.layers}Lx{args.hidden}d, "
+         f"slots={args.slots}, max_len={max_len}")
+
+    # ---- sequential per-request baseline (warm pass then timed pass)
+    seq_s, seq_outs = run_sequential(params, cfg, prompts, args.gen,
+                                     max_len, greedy_generate)
+    seq_tps = total_tokens / seq_s
+    _log(f"sequential: {seq_s * 1e3:.1f} ms total ({seq_tps:.1f} tok/s)")
+
+    # ---- continuous batching: warm pass, then timed on warm traces
+    tele_path = os.environ.get("PADDLE_TPU_TELEMETRY_JSONL")
+    eng = ServingEngine(params, cfg, family=args.family,
+                        num_slots=args.slots, max_len=max_len)
+    eng.generate(prompts, args.gen)
+    traces_warm = eng.trace_counts()
+    if tele_path:
+        monitor.registry().export_jsonl(tele_path)
+    t0 = time.perf_counter()
+    outs = eng.generate(prompts, args.gen)
+    eng_s = time.perf_counter() - t0
+    traces_after = eng.trace_counts()
+    if tele_path:
+        monitor.registry().export_jsonl(tele_path)
+        try:
+            from telemetry_report import summarize
+            _log("telemetry: " + json.dumps(
+                summarize(tele_path).get("serving", {})))
+        except Exception as e:
+            _log(f"telemetry report failed: {e}")
+    eng_tps = total_tokens / eng_s
+    _log(f"engine: {eng_s * 1e3:.1f} ms total ({eng_tps:.1f} tok/s)")
+
+    # correctness on the way out: greedy engine streams must equal the
+    # per-request sequential ones token for token
+    mismatches = sum(1 for a, b in zip(seq_outs, outs)
+                     if not np.array_equal(a, b))
+    recompiles = (traces_after[0] - traces_warm[0],
+                  traces_after[1] - traces_warm[1])
+    srv = {k[len("serving."):]: v for k, v in monitor.snapshot().items()
+           if k.startswith("serving.")}
+    print(json.dumps({
+        "metric": "serving_tokens_per_sec",
+        "value": round(eng_tps, 1),
+        "unit": "tokens/s",
+        "backend": jax.devices()[0].platform,
+        "sequential_tokens_per_sec": round(seq_tps, 1),
+        "speedup_vs_sequential": round(eng_tps / seq_tps, 2),
+        "requests": args.requests, "gen": args.gen,
+        "slots": args.slots, "family": args.family,
+        "prompt_range": [args.prompt_lo, args.prompt_hi],
+        "model": f"{args.layers}Lx{args.hidden}d",
+        "recompiles_after_warmup": list(recompiles),
+        "stream_mismatches": mismatches,
+        "monitor": srv,
+    }), flush=True)
+    return 0 if mismatches == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

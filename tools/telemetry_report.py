@@ -148,6 +148,26 @@ def summarize(path: str, samples_per_step: Optional[float] = None) -> dict:
             k: round(last[k] - first.get(k, 0), 6)
             for k in sorted(last) if last[k] != first.get(k, 0)}
 
+    # ---- serving-engine stats (inference/serving.py monitor names:
+    # slot occupancy/queue depth gauges, token/prefill/tick counters;
+    # tools/bench_serving.py snapshots the registry into this stream).
+    # Counters report first-to-last DELTAS (consistent with the
+    # monitor_delta section and with tokens_per_s); gauges report their
+    # last value. ----
+    _SERVING_GAUGES = ("serving.slot_occupancy", "serving.queue_depth")
+    if monitors:
+        first_s, last_s = monitors[0]["stats"], monitors[-1]["stats"]
+        srv = {k[len("serving."):]:
+               (last_s[k] if k in _SERVING_GAUGES
+                else last_s[k] - first_s.get(k, 0))
+               for k in sorted(last_s) if k.startswith("serving.")}
+        if srv:
+            dtok = srv.get("tokens_emitted", 0)
+            dt = monitors[-1]["t"] - monitors[0]["t"]
+            if dtok and dt > 0:
+                srv["tokens_per_s"] = round(dtok / dt, 1)
+            out["serving"] = srv
+
     # ---- event timeline ----
     if events:
         t0 = events[0]["t"]

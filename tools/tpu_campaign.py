@@ -124,10 +124,10 @@ def run_job(name, argv, timeout_s, env_extra, window_dir) -> dict:
     # share one persistent XLA compile cache across jobs and windows —
     # remote compiles over the tunnel cost minutes; paying them once per
     # graph (not once per job process) stretches every window. Path
-    # comes from bench.xla_cache_dir (ONE home); jobs that resolve to
-    # CPU disable it again via bench.sync_compile_cache_for
+    # comes from paddle_tpu.utils.compile_cache (ONE home); jobs that
+    # resolve to CPU disable it again via sync_compile_cache_for
     sys.path.insert(0, HERE)
-    from bench import xla_cache_dir
+    from paddle_tpu.utils.compile_cache import xla_cache_dir
     env.setdefault("JAX_COMPILATION_CACHE_DIR", xla_cache_dir())
     # LRU cap so a long campaign can't fill the disk with executables
     env.setdefault("JAX_COMPILATION_CACHE_MAX_SIZE", str(2 << 30))
