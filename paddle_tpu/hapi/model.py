@@ -215,7 +215,10 @@ class Model:
         the wrapped network is a cached decoder facade (GPTModel /
         LlamaModel — models/facade.py generate drives the
         inference/serving.py slot-pool engine). prompts: list of 1-D
-        int token-id sequences of mixed lengths."""
+        int token-id sequences of mixed lengths. SLO guardrail knobs
+        (deadline_s/deadline_ticks/max_ticks, plus engine knobs like
+        max_queue/queue_ttl_s/watchdog_timeout/guardrails) pass
+        through to the facade and on to the engine."""
         gen = getattr(self.network, "generate", None)
         if gen is None:
             raise NotImplementedError(

@@ -24,7 +24,8 @@ from ..framework.tensor import Tensor
 
 __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
            "ServingEngine", "Request", "create_serving_engine",
-           "family_for"]
+           "family_for", "BackpressureError", "ServingFaultError",
+           "TERMINAL_REASONS"]
 
 
 class PrecisionType:
@@ -224,4 +225,6 @@ def create_predictor(config: Config) -> Predictor:
 # prefill, one jitted decode step) — the throughput path the Predictor's
 # one-request-per-run loop cannot provide
 from .serving import (ServingEngine, Request,          # noqa: E402,F401
-                      create_serving_engine, family_for)
+                      create_serving_engine, family_for,
+                      BackpressureError, ServingFaultError,
+                      TERMINAL_REASONS)

@@ -44,17 +44,61 @@ garbage) are never attended: the decode-attention mask admits cache
 slots <= the query's own position only, and decode writes overwrite
 the pad region in order (kernels/decode_attention.py).
 
+SLO guardrails (the robustness layer around the scheduler — the
+serving analog of parallel/resilience.py's skip/rollback/watchdog, cf.
+the reference's predictor error handling and the per-request isolation
+requirement of Orca/vLLM-class serving stacks):
+
+- **Admission control.** `max_queue` bounds the queue; an over-full
+  submit raises a typed `BackpressureError` (policy "reject") or sheds
+  the oldest queued request (policy "shed_oldest"); `queue_ttl_s`
+  expires requests that wait too long. `Request.cancel()` frees the
+  slot mid-decode.
+- **Deadlines.** Per-request wall (`deadline_s`) and engine-tick
+  (`deadline_ticks`) deadlines are enforced by the scheduler; every
+  submitted request resolves EXACTLY ONCE with a terminal
+  `finish_reason` from {eos, length, timeout, cancelled, poisoned,
+  evicted} (`TERMINAL_REASONS`) — `_finish` is the one place the
+  transition happens.
+- **Poisoned-slot quarantine.** With `guardrails=True` (default) the
+  decode tick checks `isfinite` over each slot's logit row IN-JIT and
+  folds the verdict into the sampled token (`-1` sentinel — real ids
+  are never negative), so the one-host-pull-per-tick invariant and the
+  trace-count ceilings are untouched. The host evicts only the
+  poisoned slot (`finish_reason="poisoned"`); co-batched streams are
+  bit-identical because per-slot attention and per-request PRNG
+  streams never mix rows. Prefill guards its first-token logits the
+  same way.
+- **Self-healing tick.** The two device calls (+ the one host pull)
+  run under bounded retry/backoff; a failed tick resyncs `_dstate`
+  from the host mirrors (`_dirty=True`) — the mirrors only advance
+  AFTER a successful pull, so a re-run of the tick is idempotent
+  (same state -> same KV writes) and engine state can never desync. A
+  hung pull (watchdog — parallel/resilience.WatchdogPuller, the
+  persistent-thread variant of the trainer's pull guard) or an
+  exhausted retry budget triggers `_hard_reset`: every in-flight
+  request terminates as "evicted" and the cache is reallocated.
+  Every serving fault dumps a flight-recorder black box
+  (profiler/flight_recorder.py).
+
 Observability: serving.* monitor counters/gauges (slot occupancy,
-queue depth, tokens emitted, prefills, decode ticks) and
-RecordEvent spans around every prefill/decode tick —
-tools/telemetry_report.py summarizes them, tools/bench_serving.py
-measures the engine against sequential per-request decode.
+queue depth, tokens emitted, prefills, decode ticks, plus
+rejected/timeout/cancelled/poisoned/evicted/retries/faults and the
+queue_wait_ms gauge) and RecordEvent spans around every
+prefill/decode tick — tools/telemetry_report.py summarizes them
+(including TTFT / inter-token-latency percentiles from
+`export_slo_jsonl`), tools/bench_serving.py measures the engine
+against sequential per-request decode, and tools/chaos_serving.py is
+the executable acceptance test for the guardrails.
 """
 from __future__ import annotations
 
 import collections
 import dataclasses
 import functools
+import json
+import sys
+import time
 from typing import Callable, List, Optional, Sequence
 
 import numpy as np
@@ -65,7 +109,34 @@ from ..models.decode import prompt_bucket
 from ..profiler import RecordEvent, monitor
 
 __all__ = ["ServingEngine", "Request", "ModelFamily", "family_for",
-           "create_serving_engine"]
+           "create_serving_engine", "BackpressureError",
+           "ServingFaultError", "TERMINAL_REASONS"]
+
+# every submitted request ends in exactly one of these (the
+# finish-reason state machine — docs/serving.md "Robustness")
+TERMINAL_REASONS = frozenset(
+    {"eos", "length", "timeout", "cancelled", "poisoned", "evicted"})
+
+# fault-injection seam (paddle_tpu.testing.faults.install wires it):
+# called with the tick index about to run, returns an action dict
+# ({"poison_slot": i} | {"stall_s": s} | {"raise_prefill": True} |
+# {"raise_decode": True}). Production code never sets it.
+_FAULT_HOOK: Optional[Callable[[int], dict]] = None
+
+
+class BackpressureError(RuntimeError):
+    """submit() refused: the admission queue is at max_queue (policy
+    "reject"). Carries .queue_depth so callers can report/shed."""
+
+    def __init__(self, msg: str, queue_depth: int = 0):
+        super().__init__(msg)
+        self.queue_depth = queue_depth
+
+
+class ServingFaultError(RuntimeError):
+    """An injected serving fault (testing.faults prefill_raise /
+    decode_raise) — raised at the device-call seam so the retry path
+    exercises exactly what an organic dispatch failure would."""
 
 
 # --------------------------------------------------------------- families
@@ -98,20 +169,34 @@ class Request:
 
     __slots__ = ("id", "prompt", "max_new_tokens", "temperature",
                  "top_k", "eos_id", "tokens", "done", "finish_reason",
-                 "slot")
+                 "slot", "deadline_s", "deadline_ticks", "t_submit",
+                 "_tick_submit", "_t_last", "_engine")
 
     def __init__(self, req_id, prompt, max_new_tokens, temperature,
-                 top_k, eos_id):
+                 top_k, eos_id, deadline_s=None, deadline_ticks=None):
         self.id = req_id
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.temperature = temperature
         self.top_k = top_k
         self.eos_id = eos_id
+        self.deadline_s = deadline_s       # wall seconds from submit
+        self.deadline_ticks = deadline_ticks  # engine ticks from submit
         self.tokens: List[int] = []     # generated ids, in order
         self.done = False
         self.finish_reason: Optional[str] = None
         self.slot: Optional[int] = None
+        self.t_submit = 0.0
+        self._tick_submit = 0
+        self._t_last = 0.0              # last emission (SLO samples)
+        self._engine = None
+
+    def cancel(self) -> bool:
+        """Terminate this request NOW (finish_reason "cancelled"):
+        dequeues it if still waiting, frees its slot if mid-decode.
+        Returns False when the request already resolved."""
+        eng = self._engine
+        return False if eng is None else eng.cancel(self)
 
     def __repr__(self):
         return (f"Request(id={self.id}, len={len(self.prompt)}, "
@@ -155,24 +240,37 @@ def _sample(lg, temps, top_ks, keys, max_top_k: int):
 # resident and DONATED alongside the cache — the host only downloads
 # the sampled tokens, one small pull per tick)
 #   (cur_tok, positions, active, temps, top_ks, req_ids, gen_idx)
-def _decode_tick(params, cache, state, base_key, *, fwd, cfg, max_top_k,
-                 sampling):
+def _decode_tick(params, cache, state, base_key, poison, *, fwd, cfg,
+                 max_top_k, sampling, guard):
     """THE mixed step: all N slots advance one token. Each slot's
     current token is written at its own position; sampling runs in-jit;
     inactive slots compute too (fixed shape) but their output is masked
     and their slot region is overwritten at the next prefill.
     `sampling` is STATIC: greedy-only ticks skip the key-fold +
     categorical machinery entirely (~0.4 ms/tick on the CPU rung), so
-    the tick has at most two traces for the engine's lifetime."""
+    the tick has at most two traces for the engine's lifetime.
+    `guard` is baked per engine (guardrails=): the per-row isfinite
+    quarantine verdict folds into the token as a -1 sentinel (real ids
+    are never negative), so flagging costs no extra host pull and no
+    extra trace. `poison` [N] is the fault-injection multiplier
+    (all-ones in production; testing.faults nan_logits sets one lane to
+    nan INSIDE the jit so injected and organic non-finite logits
+    exercise the exact same guard); multiplying by 1.0 is exact in
+    IEEE fp, so guarded greedy/sampled streams stay bit-identical."""
     toks, positions, active, temps, top_ks, req_ids, gen_idx = state
     logits, cache = fwd(params, toks[:, None], cache, positions, cfg)
     lg = logits[:, 0].astype(jnp.float32)
+    if guard:
+        lg = lg * poison[:, None]
     if sampling:
         keys = _slot_keys(base_key, req_ids, gen_idx)
         nxt = _sample(lg, temps, top_ks, keys, max_top_k)
     else:
         nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
     nxt = jnp.where(active, nxt, 0).astype(jnp.int32)
+    if guard:
+        row_ok = jnp.all(jnp.isfinite(lg), axis=-1)
+        nxt = jnp.where(active & ~row_ok, -1, nxt)
     inc = active.astype(jnp.int32)
     state = (nxt, positions + inc, active, temps, top_ks, req_ids,
              gen_idx + inc)
@@ -181,7 +279,7 @@ def _decode_tick(params, cache, state, base_key, *, fwd, cfg, max_top_k,
 
 def _prefill_slot(params, cache, padded, true_len, slot, temps, top_ks,
                   req_ids, base_key, *, fwd, init_cache, cfg, max_top_k,
-                  sampling):
+                  sampling, guard):
     """Bucketed prefill of ONE request into slot `slot`: run the padded
     prompt through a fresh single-row BUCKET-length cache (bit-identical
     K/V and logits to the greedy driver's full-length prefill — the
@@ -189,7 +287,10 @@ def _prefill_slot(params, cache, padded, true_len, slot, temps, top_ks,
     first token from the last REAL position's logits, and write the row
     into the pool, wiping the slot's previous occupant up to the bucket
     (anything staler is masked until decode overwrites it). Trace key:
-    the bucket length only (true_len/slot are traced scalars)."""
+    the bucket length only (true_len/slot are traced scalars). With
+    `guard` (static, baked per engine) a non-finite first-token logit
+    row folds into a -1 sentinel token — the quarantine verdict rides
+    the pull the admission already makes."""
     mini = init_cache(cfg, 1, padded.shape[1])
     logits, mini = fwd(params, padded, mini, 0, cfg)
     last = jax.lax.dynamic_slice_in_dim(
@@ -199,6 +300,8 @@ def _prefill_slot(params, cache, padded, true_len, slot, temps, top_ks,
         first = _sample(last, temps, top_ks, keys, max_top_k)[0]
     else:
         first = jnp.argmax(last, axis=-1).astype(jnp.int32)[0]
+    if guard:
+        first = jnp.where(jnp.all(jnp.isfinite(last)), first, -1)
     cache = {
         "k": jax.lax.dynamic_update_slice(
             cache["k"], mini["k"], (0, slot, 0, 0, 0)),
@@ -225,11 +328,27 @@ class ServingEngine:
     def __init__(self, params, cfg, family="gpt", num_slots: int = 8,
                  max_len: Optional[int] = None, max_top_k: int = 0,
                  seed: int = 0, bucket_lo: int = 8,
-                 decode_unroll: int = 0):
+                 decode_unroll: int = 0, max_queue: int = 0,
+                 queue_policy: str = "reject", queue_ttl_s: float = 0.0,
+                 watchdog_timeout: float = 0.0, retries: int = 2,
+                 backoff_base: float = 0.05, backoff_max: float = 2.0,
+                 guardrails: bool = True):
         self.family = (family_for(family) if isinstance(family, str)
                        else family)
         self.cfg = cfg
         self.num_slots = int(num_slots)
+        # ------------------------------------------------ SLO guardrails
+        if queue_policy not in ("reject", "shed_oldest"):
+            raise ValueError(f"queue_policy {queue_policy!r} "
+                             "(reject|shed_oldest)")
+        self.max_queue = int(max_queue)       # 0 = unbounded
+        self.queue_policy = queue_policy
+        self.queue_ttl_s = float(queue_ttl_s)  # 0 = no TTL
+        self.watchdog_timeout = float(watchdog_timeout)  # 0 = no watchdog
+        self.retries = int(retries)           # device-call retry budget
+        self.backoff_base = float(backoff_base)
+        self.backoff_max = float(backoff_max)
+        self.guardrails = bool(guardrails)    # in-jit isfinite quarantine
         self.max_len = int(max_len or cfg.max_seq_len)
         if self.max_len > getattr(cfg, "max_seq_len", self.max_len):
             # positions past the table (gpt wpe / llama rope cache) would
@@ -281,25 +400,48 @@ class ServingEngine:
         self._slot_req: List[Optional[Request]] = [None] * n
         self._queue: collections.deque = collections.deque()
         self._next_id = 0
+        self._ticks = 0                  # step() calls (fault/deadline clock)
+        self._poison_ones = jnp.ones((n,), jnp.float32)  # reused: the
+        #                      steady-state tick uploads NO poison array
+        # SLO samples (host wall-clock, ms): TTFT includes queue wait;
+        # inter-token latency is per-emission, quantized to tick times
+        self._slo_ttft: collections.deque = collections.deque(maxlen=8192)
+        self._slo_itl: collections.deque = collections.deque(maxlen=8192)
 
         self._decode = jax.jit(
             functools.partial(_decode_tick, fwd=self.family.forward_cached,
-                              cfg=run_cfg, max_top_k=self.max_top_k),
+                              cfg=run_cfg, max_top_k=self.max_top_k,
+                              guard=self.guardrails),
             donate_argnums=(1, 2), static_argnames=("sampling",))
         self._prefill = jax.jit(
             functools.partial(_prefill_slot,
                               fwd=self.family.forward_cached,
                               init_cache=self.family.init_cache,
-                              cfg=run_cfg, max_top_k=self.max_top_k),
+                              cfg=run_cfg, max_top_k=self.max_top_k,
+                              guard=self.guardrails),
             donate_argnums=(1,), static_argnames=("sampling",))
+
+        from ..profiler import flight_recorder
+        self._flight = flight_recorder.recorder()
+        self._puller = None            # lazy persistent watchdog worker
 
         self._m_occ = monitor.gauge("serving.slot_occupancy")
         self._m_queue = monitor.gauge("serving.queue_depth")
+        self._m_qwait = monitor.gauge("serving.queue_wait_ms")
         self._m_tok = monitor.counter("serving.tokens_emitted")
         self._m_pre = monitor.counter("serving.prefills")
         self._m_tick = monitor.counter("serving.decode_ticks")
         self._m_sub = monitor.counter("serving.requests_submitted")
         self._m_done = monitor.counter("serving.requests_completed")
+        self._m_rej = monitor.counter("serving.rejected")
+        self._m_retry = monitor.counter("serving.retries")
+        self._m_fault = monitor.counter("serving.faults")
+        self._reason_ctr = {
+            "timeout": monitor.counter("serving.timeout"),
+            "cancelled": monitor.counter("serving.cancelled"),
+            "poisoned": monitor.counter("serving.poisoned"),
+            "evicted": monitor.counter("serving.evicted"),
+        }
 
     # ------------------------------------------------------- observables
     def trace_counts(self):
@@ -318,9 +460,17 @@ class ServingEngine:
 
     # --------------------------------------------------------- admission
     def submit(self, prompt, max_new_tokens: int, temperature: float = 0.0,
-               top_k: int = 0, eos_id: Optional[int] = None) -> Request:
+               top_k: int = 0, eos_id: Optional[int] = None,
+               deadline_s: Optional[float] = None,
+               deadline_ticks: Optional[int] = None) -> Request:
         """Queue one request. prompt: 1-D int token ids. Returns the
-        live Request; its .tokens fills in as the engine steps."""
+        live Request; its .tokens fills in as the engine steps.
+        `deadline_s` / `deadline_ticks` bound the request's TOTAL
+        lifetime (queue wait included) in wall seconds / engine ticks —
+        exceeding either resolves it with finish_reason "timeout".
+        Raises BackpressureError when the queue is at max_queue under
+        the "reject" policy; under "shed_oldest" the oldest queued
+        request is evicted to make room."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         t0 = prompt.shape[0]
         if t0 < 1:
@@ -340,8 +490,24 @@ class ServingEngine:
         if top_k > self.max_top_k:
             raise ValueError(f"top_k={top_k} exceeds the engine's "
                              f"static max_top_k={self.max_top_k}")
+        if self.max_queue > 0 and len(self._queue) >= self.max_queue:
+            if self.queue_policy == "shed_oldest":
+                self._finish(self._queue.popleft(), "evicted")
+            else:
+                self._m_rej.add()
+                raise BackpressureError(
+                    f"admission queue full ({len(self._queue)} waiting, "
+                    f"max_queue={self.max_queue})",
+                    queue_depth=len(self._queue))
         req = Request(self._next_id, prompt, int(max_new_tokens),
-                      float(temperature), int(top_k), eos_id)
+                      float(temperature), int(top_k), eos_id,
+                      deadline_s=(None if deadline_s is None
+                                  else float(deadline_s)),
+                      deadline_ticks=(None if deadline_ticks is None
+                                      else int(deadline_ticks)))
+        req.t_submit = time.perf_counter()
+        req._tick_submit = self._ticks
+        req._engine = self
         self._next_id += 1
         self._queue.append(req)
         self._m_sub.add()
@@ -350,53 +516,44 @@ class ServingEngine:
 
     # --------------------------------------------------------- the tick
     def step(self):
-        """One engine tick: admit queued requests into free slots
-        (one bucketed prefill each), then advance all active slots one
-        token through the single jitted decode step. Returns this
-        tick's (request, token) emissions in slot order."""
+        """One engine tick: expire queued requests past their TTL or
+        deadline, admit queued requests into free slots (one bucketed
+        prefill each, retried under the fault guard), advance all
+        active slots one token through the single jitted decode step
+        (quarantining poisoned rows), then enforce deadlines on the
+        survivors. Returns this tick's (request, token) emissions in
+        slot order."""
         events: List[tuple] = []
+        actions = {}
+        if _FAULT_HOOK is not None:
+            actions = _FAULT_HOOK(self._ticks) or {}
+        now = time.perf_counter()
+        self._expire_queued(now)
         while self._queue:
             slot = self._free_slot()
             if slot is None:
                 break
-            self._admit(slot, self._queue.popleft(), events)
+            req = self._queue.popleft()
+            if self._deadline_expired(req, now):
+                self._finish(req, "timeout")
+                continue
+            self._admit_guarded(slot, req, events, actions)
 
         if self._active.any():
-            if self._dirty:
-                self._dstate = (
-                    jnp.asarray(self._cur_tok), jnp.asarray(self._positions),
-                    jnp.asarray(self._active), jnp.asarray(self._temps),
-                    jnp.asarray(self._top_ks), jnp.asarray(self._req_ids),
-                    jnp.asarray(self._gen_idx))
-                self._dirty = False
-            sampling = bool(np.any(self._temps[self._active] > 0.0))
-            with RecordEvent("serving.decode_tick"):
-                nxt, self._cache, self._dstate = self._decode(
-                    self._params, self._cache, self._dstate,
-                    self._base_key, sampling=sampling)
-                toks = np.asarray(nxt)     # ONE host pull per tick
-            self._m_tick.add()
-            for i in np.nonzero(self._active)[0]:
-                req = self._slot_req[i]
-                tok = int(toks[i])
-                # mirror exactly what the tick did on device (positions
-                # and gen_idx advanced under the active mask) — no
-                # download, and the device state stays clean unless an
-                # eviction below dirties it
-                self._positions[i] += 1
-                self._cur_tok[i] = tok
-                self._gen_idx[i] += 1
-                req.tokens.append(tok)
-                events.append((req, tok))
-                self._m_tok.add()
-                self._maybe_finish(i, req)
+            self._decode_guarded(events, actions)
+            self._enforce_deadlines(time.perf_counter())
 
+        self._ticks += 1
         self._m_occ.set(int(self._active.sum()))
         self._m_queue.set(len(self._queue))
         return events
 
     def drain(self, max_ticks: Optional[int] = None):
-        """Step until idle (or max_ticks); returns all emissions."""
+        """Step until idle (or max_ticks); returns all emissions.
+        NOTE: with max_ticks the engine may still hold live requests on
+        return — call `abort_pending()` (or use `generate(...,
+        max_ticks=)`, which does) when partial delivery must still
+        resolve every request."""
         events = []
         ticks = 0
         while self.has_work():
@@ -406,15 +563,328 @@ class ServingEngine:
                 break
         return events
 
+    def abort_pending(self, reason: str = "evicted") -> int:
+        """Resolve EVERY live request (queued and in-slot) with the
+        terminal `reason` — after this no request is in limbo. Returns
+        the number aborted."""
+        if reason not in TERMINAL_REASONS:
+            raise ValueError(f"reason {reason!r} not in "
+                             f"{sorted(TERMINAL_REASONS)}")
+        n = 0
+        while self._queue:
+            self._finish(self._queue.popleft(), reason)
+            n += 1
+        for req in list(self._slot_req):
+            if req is not None:
+                self._finish(req, reason)
+                n += 1
+        self._m_occ.set(int(self._active.sum()))
+        self._m_queue.set(len(self._queue))
+        return n
+
     def generate(self, prompts: Sequence, max_new_tokens: int,
                  temperature: float = 0.0, top_k: int = 0,
-                 eos_id: Optional[int] = None) -> List[np.ndarray]:
+                 eos_id: Optional[int] = None,
+                 deadline_s: Optional[float] = None,
+                 deadline_ticks: Optional[int] = None,
+                 max_ticks: Optional[int] = None) -> List[np.ndarray]:
         """Batch convenience: submit every prompt, drain, return each
-        request's generated ids (submission order)."""
+        request's generated ids (submission order). Never returns with
+        a request in limbo: whatever `max_ticks` (or a deadline) left
+        undelivered is resolved with a terminal finish_reason
+        ("evicted") before returning, so `.done` is True for every
+        request this call submitted."""
         reqs = [self.submit(p, max_new_tokens, temperature=temperature,
-                            top_k=top_k, eos_id=eos_id) for p in prompts]
-        self.drain()
+                            top_k=top_k, eos_id=eos_id,
+                            deadline_s=deadline_s,
+                            deadline_ticks=deadline_ticks)
+                for p in prompts]
+        self.drain(max_ticks)
+        for r in reqs:
+            if not r.done:
+                if r.slot is None:
+                    try:
+                        self._queue.remove(r)
+                    except ValueError:
+                        pass
+                self._finish(r, "evicted")
+        self._m_queue.set(len(self._queue))
         return [np.asarray(r.tokens, np.int32) for r in reqs]
+
+    # ------------------------------------------------------ terminality
+    def _clear_slot(self, slot: int) -> None:
+        """Return a slot to the free pool: registry, every host mirror,
+        and the device-state dirty flag (the ONE place a slot's mirrors
+        reset — _finish and _rollback_slot both route here)."""
+        self._slot_req[slot] = None
+        self._active[slot] = False
+        self._positions[slot] = 0
+        self._cur_tok[slot] = 0
+        self._temps[slot] = 0.0
+        self._top_ks[slot] = 0
+        self._gen_idx[slot] = 0
+        self._dirty = True
+
+    def _finish(self, req: Request, reason: str) -> None:
+        """THE terminal transition: exactly-once by construction (a
+        resolved request is never re-finished), frees the slot and
+        dirties the device mirror when the request was mid-decode."""
+        if req.done:
+            return
+        if req.slot is not None:
+            self._clear_slot(req.slot)
+        req.slot = None
+        req.done = True
+        req.finish_reason = reason
+        self._m_done.add()
+        ctr = self._reason_ctr.get(reason)
+        if ctr is not None:
+            ctr.add()
+
+    def cancel(self, req: Request) -> bool:
+        """Resolve `req` with finish_reason "cancelled" right now:
+        dequeues a waiting request, frees the slot of a mid-decode one.
+        Returns False when it already resolved."""
+        if req.done:
+            return False
+        if req.slot is None:
+            try:
+                self._queue.remove(req)
+            except ValueError:
+                pass                   # not ours / already dequeued
+        self._finish(req, "cancelled")
+        self._m_queue.set(len(self._queue))
+        return True
+
+    # -------------------------------------------------------- deadlines
+    def _deadline_expired(self, req: Request, now: float) -> bool:
+        if (req.deadline_s is not None
+                and now - req.t_submit >= req.deadline_s):
+            return True
+        if (req.deadline_ticks is not None
+                and self._ticks - req._tick_submit >= req.deadline_ticks):
+            return True
+        return False
+
+    def _expire_queued(self, now: float) -> None:
+        if not self._queue:
+            return
+        keep: collections.deque = collections.deque()
+        for req in self._queue:
+            ttl_hit = (self.queue_ttl_s > 0.0
+                       and now - req.t_submit >= self.queue_ttl_s)
+            if ttl_hit or self._deadline_expired(req, now):
+                self._finish(req, "timeout")
+            else:
+                keep.append(req)
+        self._queue = keep
+
+    def _enforce_deadlines(self, now: float) -> None:
+        for req in list(self._slot_req):
+            if req is not None and self._deadline_expired(req, now):
+                self._finish(req, "timeout")
+
+    # ----------------------------------------------- self-healing calls
+    def _on_fault(self, kind: str, exc: BaseException) -> None:
+        """Every serving fault leaves a black box (no-op without
+        $PADDLE_TPU_FLIGHT_DIR) and a counter bump."""
+        self._m_fault.add()
+        self._flight.configure(last_serving_fault=f"{kind}: {exc}")
+        self._flight.note(serving_fault=kind, tick=self._ticks,
+                          error=str(exc))
+        self._flight.dump(f"serving_{kind}_fault")
+        print(f"[serving] {kind} fault at tick {self._ticks}: {exc}",
+              file=sys.stderr, flush=True)
+
+    def _backoff(self, attempt: int) -> None:
+        self._m_retry.add()
+        time.sleep(min(self.backoff_base * (2.0 ** attempt),
+                       self.backoff_max))
+
+    def _rollback_slot(self, slot: int, req: Request, n_tok: int) -> None:
+        """Undo a partially-applied admission: host mirrors, the slot
+        registry and the request's token list return to their pre-admit
+        state, and the device mirror is marked stale."""
+        self._clear_slot(slot)
+        req.slot = None
+        del req.tokens[n_tok:]
+
+    def _cache_dead(self) -> bool:
+        """True when the pool cache's buffers were consumed by a FAILED
+        donated dispatch (execution died after donation — possible on a
+        real accelerator; CPU ignores donation): re-dispatching would
+        only raise 'array deleted', so the caller must hard-reset."""
+        try:
+            return any(getattr(leaf, "is_deleted", lambda: False)()
+                       for leaf in jax.tree_util.tree_leaves(self._cache))
+        except Exception:                          # noqa: BLE001
+            return False
+
+    def _hard_reset(self, reason: str) -> None:
+        """Last-resort recovery after an exhausted retry budget or a
+        hung pull (re-dispatching donated buffers is illegal): every
+        in-flight request terminates as "evicted" and the pool cache is
+        reallocated; queued requests stay queued — if the fault was
+        transient they admit cleanly into the fresh pool."""
+        for req in list(self._slot_req):
+            if req is not None:
+                self._finish(req, "evicted")
+        self._cache = self.family.init_cache(self.cfg, self.num_slots,
+                                             self.max_len)
+        self._dstate = None
+        self._dirty = True
+        self._flight.configure(last_serving_fault=f"hard_reset: {reason}")
+        self._flight.dump("serving_hard_reset")
+        print(f"[serving] hard reset at tick {self._ticks} ({reason}): "
+              f"pool cache reallocated", file=sys.stderr, flush=True)
+
+    def _pull(self, value, stall_s: float = 0.0) -> np.ndarray:
+        """The one device->host pull, optionally under the resilience
+        watchdog (re-polls the SAME future with backoff — donated
+        buffers cannot be re-dispatched). The persistent WatchdogPuller
+        is the ~2 ms-tick-rate variant of the trainer's per-step pull
+        thread. `stall_s` is the injected tick_stall: it sleeps INSIDE
+        the watchdog-monitored pull so the drill exercises the real
+        budget/backoff path."""
+        src = value
+        if stall_s > 0.0:
+            def src():
+                time.sleep(stall_s)
+                return np.asarray(value)
+        if self.watchdog_timeout > 0.0:
+            if self._puller is None:
+                from ..parallel.resilience import WatchdogPuller
+                self._puller = WatchdogPuller(label="serving tick")
+            return self._puller.pull(
+                src, self.watchdog_timeout, self.retries,
+                self.backoff_base, self.backoff_max,
+                on_retry=self._on_stall_retry)
+        return np.asarray(src() if callable(src) else src)
+
+    def _on_stall_retry(self, attempt: int) -> None:
+        """Watchdog backoff observer: count it, and leave a black box
+        on the FIRST stall of a tick — a pull that needed backoff is
+        the tunnel-flap post-mortem case even when it recovers."""
+        self._m_retry.add()
+        self._flight.note(serving_stall_attempt=attempt,
+                          tick=self._ticks)
+        if attempt == 0:
+            self._flight.dump("serving_stall")
+
+    def _admit_guarded(self, slot: int, req: Request, events: list,
+                       actions: dict) -> None:
+        """Admission under the fault guard: a raising prefill rolls the
+        slot back and retries with backoff; an exhausted budget resolves
+        the request as "evicted" (never limbo). A hung pull or a cache
+        lost to a failed donated dispatch is NOT retryable — re-waiting
+        the watchdog budget / re-dispatching deleted buffers can only
+        fail again — and escalates to `_hard_reset` like the tick's."""
+        n_tok = len(req.tokens)
+        from ..parallel.resilience import StepHungError
+        for attempt in range(self.retries + 1):
+            try:
+                if actions.pop("raise_prefill", None):
+                    raise ServingFaultError("injected prefill fault")
+                self._admit(slot, req, events)
+                return
+            except StepHungError as e:
+                self._rollback_slot(slot, req, n_tok)
+                self._on_fault("prefill_hang", e)
+                self._finish(req, "evicted")
+                self._hard_reset("prefill watchdog hang")
+                return
+            except Exception as e:                 # noqa: BLE001
+                self._rollback_slot(slot, req, n_tok)
+                self._on_fault("prefill", e)
+                dead = self._cache_dead()
+                if dead or attempt >= self.retries:
+                    self._finish(req, "evicted")
+                    if dead:
+                        self._hard_reset("prefill lost the donated cache")
+                    return
+                self._backoff(attempt)
+
+    def _decode_guarded(self, events: list, actions: dict) -> None:
+        """One decode tick under the fault guard. Mirrors advance only
+        after a successful pull, so a failed attempt resyncs `_dstate`
+        from them and re-runs the tick idempotently (same state -> same
+        KV writes). A hung pull or exhausted budget hard-resets."""
+        poison_slot = actions.pop("poison_slot", None)
+        stall_s = actions.pop("stall_s", 0.0)
+        from ..parallel.resilience import StepHungError
+        for attempt in range(self.retries + 1):
+            try:
+                if actions.pop("raise_decode", None):
+                    raise ServingFaultError("injected decode fault")
+                if self._dirty:
+                    self._dstate = (
+                        jnp.asarray(self._cur_tok),
+                        jnp.asarray(self._positions),
+                        jnp.asarray(self._active),
+                        jnp.asarray(self._temps),
+                        jnp.asarray(self._top_ks),
+                        jnp.asarray(self._req_ids),
+                        jnp.asarray(self._gen_idx))
+                    self._dirty = False
+                sampling = bool(np.any(self._temps[self._active] > 0.0))
+                poison = self._poison_ones
+                if poison_slot is not None and self.guardrails:
+                    p = np.ones(self.num_slots, np.float32)
+                    p[int(poison_slot) % self.num_slots] = np.nan
+                    poison = jnp.asarray(p)
+                poison_slot = None        # injected at most once
+                with RecordEvent("serving.decode_tick"):
+                    nxt, self._cache, self._dstate = self._decode(
+                        self._params, self._cache, self._dstate,
+                        self._base_key, poison, sampling=sampling)
+                    # ONE host pull per tick
+                    toks = self._pull(nxt, stall_s)
+                stall_s = 0.0
+                break
+            except StepHungError as e:
+                # the future may still land later; re-polling already
+                # exhausted the budget and re-dispatch is illegal
+                self._on_fault("decode_hang", e)
+                self._hard_reset("watchdog hang")
+                return
+            except Exception as e:                 # noqa: BLE001
+                self._dirty = True        # resync _dstate from mirrors
+                self._on_fault("decode", e)
+                dead = self._cache_dead()
+                if dead or attempt >= self.retries:
+                    self._hard_reset("decode lost the donated cache"
+                                     if dead else
+                                     "decode retries exhausted")
+                    return
+                self._backoff(attempt)
+
+        self._m_tick.add()
+        tick_now = time.perf_counter()
+        for i in np.nonzero(self._active)[0]:
+            req = self._slot_req[i]
+            tok = int(toks[i])
+            if tok < 0:
+                # in-jit quarantine verdict: evict ONLY this slot; the
+                # device state is stale (its row advanced) -> _finish
+                # dirties it, co-batched rows rebuild from their clean
+                # mirrors and stay bit-identical
+                self._on_fault("poisoned", RuntimeError(
+                    f"non-finite logits in slot {i} (request {req.id})"))
+                self._finish(req, "poisoned")
+                continue
+            # mirror exactly what the tick did on device (positions
+            # and gen_idx advanced under the active mask) — no
+            # download, and the device state stays clean unless an
+            # eviction dirties it
+            self._positions[i] += 1
+            self._cur_tok[i] = tok
+            self._gen_idx[i] += 1
+            req.tokens.append(tok)
+            events.append((req, tok))
+            self._m_tok.add()
+            self._slo_itl.append((tick_now - req._t_last) * 1e3)
+            req._t_last = tick_now
+            self._maybe_finish(req)
 
     # ---------------------------------------------------------- plumbing
     def _free_slot(self) -> Optional[int]:
@@ -436,8 +906,22 @@ class ServingEngine:
                 jnp.asarray([req.top_k], jnp.int32),
                 jnp.asarray([req.id], jnp.int32), self._base_key,
                 sampling=req.temperature > 0.0)
-            tok = int(first)               # first generated token
+            # first generated token — the admission's one host pull,
+            # under the same watchdog as the tick's
+            tok = int(self._pull(first))
         self._m_pre.add()
+        if tok < 0:
+            # prefill quarantine: the slot was never activated — its
+            # (possibly non-finite) cache row is masked stale garbage
+            # until the next occupant's prefill overwrites it
+            self._on_fault("poisoned", RuntimeError(
+                f"non-finite prefill logits (request {req.id})"))
+            self._finish(req, "poisoned")
+            return
+        now = time.perf_counter()
+        self._m_qwait.set((now - req.t_submit) * 1e3)
+        self._slo_ttft.append((now - req.t_submit) * 1e3)
+        req._t_last = now
         req.slot = slot
         self._slot_req[slot] = req
         self._positions[slot] = t0
@@ -451,30 +935,38 @@ class ServingEngine:
         req.tokens.append(tok)
         events.append((req, tok))
         self._m_tok.add()
-        self._maybe_finish(slot, req)
+        self._maybe_finish(req)
 
-    def _maybe_finish(self, slot: int, req: Request) -> None:
-        reason = None
+    def _maybe_finish(self, req: Request) -> None:
+        slot = req.slot
         if req.eos_id is not None and req.tokens[-1] == req.eos_id:
-            reason = "eos"
+            self._finish(req, "eos")
         elif len(req.tokens) >= req.max_new_tokens:
-            reason = "length"
-        elif self._positions[slot] >= self.max_len:
-            reason = "cache_full"      # unreachable via submit's check
-        if reason is None:
-            return
-        req.done = True
-        req.finish_reason = reason
-        req.slot = None
-        self._slot_req[slot] = None
-        self._active[slot] = False
-        self._positions[slot] = 0
-        self._cur_tok[slot] = 0
-        self._temps[slot] = 0.0
-        self._top_ks[slot] = 0
-        self._gen_idx[slot] = 0
-        self._dirty = True
-        self._m_done.add()
+            self._finish(req, "length")
+        elif slot is not None and self._positions[slot] >= self.max_len:
+            self._finish(req, "evicted")  # cache full — unreachable via
+            #                               submit's length check
+
+    # --------------------------------------------------------- SLO stats
+    def slo_snapshot(self) -> dict:
+        """The raw SLO samples (ms): time-to-first-token (queue wait
+        included) and inter-token latency, bounded rings."""
+        return {"ttft_ms": [round(v, 3) for v in self._slo_ttft],
+                "itl_ms": [round(v, 3) for v in self._slo_itl]}
+
+    def export_slo_jsonl(self, path: str) -> None:
+        """Append one serving_slo record to a telemetry JSONL file and
+        DRAIN the sample rings: each record covers the window since the
+        previous export, so a periodic exporter (the natural cadence,
+        alongside monitor.export_jsonl) never double-counts —
+        tools/telemetry_report.py merges all records' samples into the
+        serving section's TTFT / inter-token p50/p95/p99."""
+        rec = {"kind": "serving_slo", "t": time.time(),
+               **self.slo_snapshot()}
+        self._slo_ttft.clear()
+        self._slo_itl.clear()
+        with open(path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
 
 
 def create_serving_engine(model_or_params, cfg=None, **kw) -> ServingEngine:

@@ -91,7 +91,8 @@ class FacadeModel:
 
     def generate(self, prompts, max_new_tokens, num_slots=8,
                  max_len=None, temperature=0.0, top_k=0, eos_id=None,
-                 max_top_k=0, seed=0):
+                 max_top_k=0, seed=0, deadline_s=None,
+                 deadline_ticks=None, max_ticks=None, **engine_kw):
         """Continuous-batching generation over this model's params
         (inference/serving.py): prompts is a list of 1-D int token-id
         sequences of MIXED lengths; returns one generated-id array per
@@ -100,30 +101,41 @@ class FacadeModel:
         reused while the pool knobs AND the param values stay the same;
         set_value/load/train-step replace the underlying arrays, which
         the identity check below catches, rebuilding the engine so it
-        never serves stale weights."""
+        never serves stale weights.
+
+        SLO guardrails pass through: `deadline_s`/`deadline_ticks`
+        bound each request, `max_ticks` bounds the drain (undelivered
+        requests still resolve — never limbo), and `**engine_kw`
+        reaches the ServingEngine (max_queue, queue_policy,
+        queue_ttl_s, watchdog_timeout, guardrails, ... — part of the
+        engine cache key, so switching knobs rebuilds)."""
         if self._serving_family is None:
             raise NotImplementedError(
                 f"{type(self).__name__} is not a cached decoder family; "
                 "generate() needs _serving_family")
         from ..framework.dispatch import raw_value
         key = (num_slots, max_len, max_top_k, seed,
+               tuple(sorted(engine_kw.items())),
                tuple(raw_value(self._params[n])
                      for n in self._param_names))
         eng = getattr(self, "_serving_engine", None)
         cached_key = getattr(self, "_serving_engine_key", None)
         if (eng is None or cached_key is None
-                or cached_key[:4] != key[:4]
+                or cached_key[:5] != key[:5]
+                or len(cached_key) != 6
                 or any(a is not b
-                       for a, b in zip(cached_key[4], key[4]))):
+                       for a, b in zip(cached_key[5], key[5]))):
             from ..inference.serving import create_serving_engine
             eng = create_serving_engine(
                 self, num_slots=num_slots, max_len=max_len,
-                max_top_k=max_top_k, seed=seed)
+                max_top_k=max_top_k, seed=seed, **engine_kw)
             self._serving_engine = eng
             self._serving_engine_key = key
         return eng.generate(prompts, max_new_tokens,
                             temperature=temperature, top_k=top_k,
-                            eos_id=eos_id)
+                            eos_id=eos_id, deadline_s=deadline_s,
+                            deadline_ticks=deadline_ticks,
+                            max_ticks=max_ticks)
 
     def _dispatch(self, op_name, fn, *inputs):
         """fn(params_dict, *inputs) -> outputs; fn must not capture the

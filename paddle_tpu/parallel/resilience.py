@@ -161,7 +161,8 @@ RESILIENT_FIELDS = ("loss", "update_norm", "param_norm", "nonfinite", "ok")
 def pull_with_watchdog(value, timeout: float, retries: int = 3,
                        backoff_base: float = 2.0,
                        backoff_max: float = 60.0,
-                       label: str = "step") -> np.ndarray:
+                       label: str = "step",
+                       on_retry=None) -> np.ndarray:
     """Force `value` to a host array under a wall-clock budget.
 
     `jax.block_until_ready` can return early over the tunnel (CLAUDE.md),
@@ -169,14 +170,23 @@ def pull_with_watchdog(value, timeout: float, retries: int = 3,
     first wait is `timeout`; each of `retries` further waits doubles from
     `backoff_base` (capped at `backoff_max`) — re-polling the SAME pending
     future, because with donated input buffers a re-dispatch is illegal.
-    Raises StepHungError when the budget is exhausted."""
+    Raises StepHungError when the budget is exhausted.
+
+    `value` may be a zero-arg callable producing the array — the whole
+    call then runs under the watchdog clock (the serving engine wraps
+    its pull this way so injected stalls are monitored too). `on_retry`
+    (if given) observes each backoff attempt index — the serving
+    engine's retries counter hangs off it."""
+    def force():
+        return np.asarray(value() if callable(value) else value)
+
     if timeout <= 0:
-        return np.asarray(value)
+        return force()
     box: dict = {}
 
     def work():
         try:
-            box["val"] = np.asarray(value)
+            box["val"] = force()
         except BaseException as e:          # surfaced to the caller
             box["err"] = e
 
@@ -195,6 +205,8 @@ def pull_with_watchdog(value, timeout: float, retries: int = 3,
             print(f"[resilience] {label} pull stalled {waited:.1f}s "
                   f"(attempt {attempt + 1}/{retries + 1}); backing off",
                   file=sys.stderr, flush=True)
+            if on_retry is not None:
+                on_retry(attempt)
     if t.is_alive():
         raise StepHungError(
             f"{label} result did not arrive within {waited:.1f}s "
@@ -203,6 +215,88 @@ def pull_with_watchdog(value, timeout: float, retries: int = 3,
     if "err" in box:
         raise box["err"]
     return box["val"]
+
+
+class WatchdogPuller:
+    """Persistent-thread variant of `pull_with_watchdog` for
+    high-frequency callers (the serving engine's ~2 ms decode tick:
+    spawning a fresh pull thread per tick costs more than the guard
+    protects). ONE daemon worker is reused across pulls; each pull is
+    a queue round-trip under the same budget/backoff semantics.
+    Responses are sequence-tagged so a pull that outlives its budget
+    (StepHungError) cannot deliver its late result to a later call."""
+
+    def __init__(self, label: str = "pull"):
+        import queue
+        self._label = label
+        self._req: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._res: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._seq = 0
+        self._thread: Optional[threading.Thread] = None
+
+    def _ensure(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._loop, name=f"paddle-watchdog-{self._label}",
+                daemon=True)
+            self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            seq, value = self._req.get()
+            try:
+                arr = np.asarray(value() if callable(value) else value)
+                self._res.put((seq, "ok", arr))
+            except BaseException as e:      # surfaced to the caller
+                self._res.put((seq, "err", e))
+
+    def pull(self, value, timeout: float, retries: int = 3,
+             backoff_base: float = 2.0, backoff_max: float = 60.0,
+             on_retry=None) -> np.ndarray:
+        """Same contract as `pull_with_watchdog` (callable values run
+        under the clock; `on_retry` observes backoffs; StepHungError
+        on an exhausted budget)."""
+        import queue
+        if timeout <= 0:
+            return np.asarray(value() if callable(value) else value)
+        self._ensure()
+        self._seq += 1
+        seq = self._seq
+        self._req.put((seq, value))
+        waited, attempt = 0.0, 0
+        while attempt <= retries:
+            grace = timeout if attempt == 0 else min(
+                backoff_base * (2.0 ** (attempt - 1)), backoff_max)
+            try:
+                rseq, kind, payload = self._res.get(timeout=grace)
+            except queue.Empty:
+                waited += grace
+                if attempt < retries:
+                    print(f"[resilience] {self._label} pull stalled "
+                          f"{waited:.1f}s (attempt {attempt + 1}/"
+                          f"{retries + 1}); backing off",
+                          file=sys.stderr, flush=True)
+                    if on_retry is not None:
+                        on_retry(attempt)
+                attempt += 1
+                continue
+            if rseq != seq:
+                continue       # late result of a previously hung pull
+            if kind == "err":
+                raise payload
+            return payload
+        # the worker is wedged in the hung pull: abandon it (fresh
+        # queues + a fresh thread on the next call) so ONE dead dispatch
+        # cannot queue-block every later, healthy pull behind it — the
+        # old daemon thread leaks until its pull resolves, same as a
+        # pull_with_watchdog thread would
+        self._thread = None
+        self._req = queue.SimpleQueue()
+        self._res = queue.SimpleQueue()
+        raise StepHungError(
+            f"{self._label} result did not arrive within {waited:.1f}s "
+            f"(watchdog {timeout}s + {retries} backoff retries) — hung "
+            f"dispatch (tunnel flap?)")
 
 
 class ResilientTrainer:

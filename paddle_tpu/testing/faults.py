@@ -29,6 +29,24 @@ Spec grammar — comma-separated `kind@a[:b]` tokens:
                         resilience watchdog's hung-dispatch escape,
                         made deterministic).
 
+Serving fault kinds (inference/serving.py consults `on_serving_tick`
+through `serving._FAULT_HOOK`; the "step" coordinate is the ENGINE
+TICK index; each fires at most once via the same marker scheme —
+shared by tests/test_serving_robustness.py and
+tools/chaos_serving.py):
+
+- ``nan_logits@T:S``    — poison decode slot S's logit row with nan at
+                          tick T (in-jit multiply, so injected and
+                          organic non-finite logits hit the same
+                          quarantine guard). S defaults to 0.
+- ``tick_stall@T:MS``   — stall the tick's host pull for MS
+                          milliseconds at tick T (inside the watchdog
+                          clock — exercises the budget/backoff path).
+- ``prefill_raise@T``   — raise at the prefill device-call seam on
+                          tick T (the admission retry/rollback path).
+- ``decode_raise@T``    — raise at the decode device-call seam on
+                          tick T (the resync-from-mirrors retry path).
+
 File corruptors (`truncate_shard` / `bitflip_shard` / `remove_shard`)
 damage committed checkpoints in place for restore-fallback tests; they
 call `checkpoint.audit_forget` so the test-suite write audit knows the
@@ -49,7 +67,10 @@ ENV_ONCE_DIR = "PADDLE_TPU_FAULTS_ONCE_DIR"
 # (101) and from real crashes' usual 1, so drill logs attribute deaths.
 KILL_EXIT = 37
 
-_KINDS = ("kill", "crash_shard", "nan", "hb_stale", "elastic_exit")
+_KINDS = ("kill", "crash_shard", "nan", "hb_stale", "elastic_exit",
+          "nan_logits", "tick_stall", "prefill_raise", "decode_raise")
+_SERVING_KINDS = frozenset(
+    {"nan_logits", "tick_stall", "prefill_raise", "decode_raise"})
 
 
 @dataclass
@@ -78,6 +99,8 @@ class FaultPlan:
                 kind, _, rest = token.partition("@")
                 a, _, b = rest.partition(":")
                 step, arg = int(a), int(b) if b else 1
+                if kind == "nan_logits" and not b:
+                    arg = 0            # default: poison slot 0
             except ValueError as e:
                 raise ValueError(
                     f"bad fault token {token!r} (grammar: kind@step[:arg], "
@@ -164,6 +187,28 @@ class FaultPlan:
                   f"{count} shard files)", file=sys.stderr, flush=True)
             os._exit(KILL_EXIT)
 
+    def on_serving_tick(self, tick: int) -> dict:
+        """serving._FAULT_HOOK: called with the engine tick about to
+        run; returns the action dict the engine applies this tick
+        (keys: poison_slot, stall_s, raise_prefill, raise_decode).
+        Each fault fires at most once (marker scheme)."""
+        actions: dict = {}
+        for f in self.faults:
+            if f.done or f.kind not in _SERVING_KINDS or tick < f.step:
+                continue
+            self._mark_fired(f)
+            print(f"[faults] {f.kind} at serving tick {tick} "
+                  f"(arg={f.arg})", file=sys.stderr, flush=True)
+            if f.kind == "nan_logits":
+                actions["poison_slot"] = f.arg
+            elif f.kind == "tick_stall":
+                actions["stall_s"] = f.arg / 1000.0
+            elif f.kind == "prefill_raise":
+                actions["raise_prefill"] = True
+            elif f.kind == "decode_raise":
+                actions["raise_decode"] = True
+        return actions
+
 
 _PLAN: Optional[FaultPlan] = None
 
@@ -181,8 +226,10 @@ def install(spec: Optional[str] = None,
         else os.environ.get(ENV_ONCE_DIR) or None
     plan = FaultPlan(spec, once_dir=once)
     from ..parallel import checkpoint, resilience
+    from ..inference import serving
     resilience._STEP_HOOK = plan.on_step
     checkpoint._SHARD_WRITE_HOOK = plan.on_shard_write
+    serving._FAULT_HOOK = plan.on_serving_tick
     _PLAN = plan
     return plan
 
@@ -190,8 +237,10 @@ def install(spec: Optional[str] = None,
 def uninstall() -> None:
     global _PLAN
     from ..parallel import checkpoint, resilience
+    from ..inference import serving
     resilience._STEP_HOOK = None
     checkpoint._SHARD_WRITE_HOOK = None
+    serving._FAULT_HOOK = None
     _PLAN = None
 
 

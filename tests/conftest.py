@@ -76,12 +76,12 @@ SMOKE_FILES = {
     "test_kernel_registry.py",
     # io / inference / serving
     "test_multiprocess_loader.py", "test_inference.py", "test_int8.py",
-    "test_serving.py",
+    "test_serving.py", "test_serving_robustness.py",
     # high-level API + aux subsystems
     "test_hapi.py", "test_profiler.py", "test_checkpoint.py",
     "test_tokenizer.py", "test_misc_modules.py", "test_telemetry.py",
-    # fault-tolerance runtime (in-process; the subprocess chaos drills in
-    # test_chaos_drill.py stay full-suite-only)
+    # fault-tolerance runtime (in-process; the chaos drills in
+    # test_chaos_drill.py / test_chaos_serving.py stay full-suite-only)
     "test_fault_tolerance.py", "test_checkpoint_edges.py",
 }
 

@@ -1,0 +1,35 @@
+"""Serving chaos drill scenarios: the continuous-batching engine under
+injected faults (tools/chaos_serving.py run in-process).
+
+The serving sibling of tests/test_chaos_drill.py — full-suite only
+(each scenario builds engines and compiles executables). The drill
+itself asserts the three guardrail invariants per scenario (exactly-
+once terminal resolution, bit-identical survivors / exact-prefix early
+exits, parseable flight dumps + trace ceilings); this test runs the
+quick drill end to end and the guardrail-overhead bench's correctness
+side (stream parity between guardrails on/off engines).
+"""
+import importlib.util
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_spec = importlib.util.spec_from_file_location(
+    "chaos_serving", os.path.join(REPO, "tools", "chaos_serving.py"))
+drill = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(drill)
+
+
+def test_quick_drill_all_green():
+    """Every scenario of the quick serving chaos drill passes: under
+    nan-logits, tick-stall, raise-mid-prefill, raise-mid-decode, queue
+    flood (both policies) and cancel/deadline, every submitted request
+    reaches exactly one terminal finish_reason and surviving streams
+    are bit-identical to the fault-free run."""
+    assert drill.run_drill(quick=True) == 0
+
+
+def test_guardrail_bench_stream_parity():
+    """The overhead bench's correctness gate: guardrails-on and -off
+    engines produce identical streams (exit 0 = zero mismatches)."""
+    assert drill.bench_main(requests=4, gen=8, slots=2, repeats=1) == 0

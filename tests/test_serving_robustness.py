@@ -1,0 +1,510 @@
+"""Serving SLO guardrail tests (inference/serving.py robustness layer).
+
+Reference analog: the predictor error-handling / service-recovery seam
+around the inference runtime; the per-request-isolation requirement is
+the Orca/vLLM correctness bar (requests sharing a batch must not be
+able to corrupt each other).
+
+The load-bearing guarantees under test:
+- every submitted request resolves EXACTLY ONCE with a terminal
+  finish_reason from TERMINAL_REASONS — backpressure, TTL, deadlines,
+  cancellation, quarantine, eviction and max_ticks all funnel through
+  the same `_finish` transition;
+- the in-jit poisoned-slot quarantine evicts ONLY the poisoned slot
+  and co-batched streams stay bit-identical to their solo greedy runs;
+- a raising/stalling device call self-heals (slot rollback, mirror
+  resync, bounded retry) without perturbing surviving streams, and the
+  guardrails cost zero recompiles (trace-count ceilings unchanged).
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.inference.serving import (ServingEngine, BackpressureError,
+                                          TERMINAL_REASONS)
+from paddle_tpu.models.gpt import (GPTConfig, init_gpt_params,
+                                   greedy_generate)
+from paddle_tpu.profiler import monitor
+from paddle_tpu.testing import faults
+
+MAXLEN = 32
+
+
+def _gpt_cfg():
+    return GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                     num_heads=2, ffn_hidden=64, max_seq_len=64,
+                     sequence_parallel=False, remat=False,
+                     dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def gpt_setup():
+    cfg = _gpt_cfg()
+    return cfg, init_gpt_params(cfg, jax.random.PRNGKey(0))
+
+
+@pytest.fixture
+def clean_faults():
+    yield
+    faults.uninstall()
+
+
+@pytest.fixture(autouse=True)
+def _clean_flight_ring():
+    """The engine notes serving faults into the PROCESS-GLOBAL flight
+    recorder ring; leaving them behind would leak into other tests'
+    dumps (e.g. the resilient trainer's rollback dump asserts over its
+    step records). Clear the ring after every test here."""
+    from paddle_tpu.profiler import flight_recorder
+    yield
+    rec = flight_recorder.recorder()
+    rec.clear()
+    rec.set_dir(None)
+
+
+def _prompts(lens, seed=0, vocab=64):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, vocab, L).astype(np.int32) for L in lens]
+
+
+def _want(params, cfg, prompt, n):
+    out = greedy_generate(params, jnp.asarray(prompt)[None], cfg, n,
+                          max_len=MAXLEN)
+    return np.asarray(out)[0, len(prompt):]
+
+
+def _engine(params, cfg, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_len", MAXLEN)
+    return ServingEngine(params, cfg, family="gpt", **kw)
+
+
+def _assert_resolved(reqs):
+    for r in reqs:
+        assert r.done and r.finish_reason in TERMINAL_REASONS, \
+            (r.id, r.done, r.finish_reason)
+        assert r.slot is None
+
+
+def _assert_clean(eng):
+    """Engine invariant after faults: no slot leaked, mirrors agree."""
+    assert all(r is None for r in eng._slot_req)
+    assert not eng._active.any()
+    assert not eng._queue
+
+
+# --------------------------------------------------------------------------
+# admission control: backpressure, TTL, cancellation
+# --------------------------------------------------------------------------
+class TestAdmissionControl:
+    def test_backpressure_reject(self, gpt_setup):
+        cfg, params = gpt_setup
+        rej0 = monitor.counter("serving.rejected").value
+        eng = _engine(params, cfg, num_slots=1, max_queue=2)
+        prompts = _prompts([3, 4, 5, 6], seed=1)
+        ok = [eng.submit(prompts[0], 3), eng.submit(prompts[1], 3)]
+        with pytest.raises(BackpressureError) as ei:
+            eng.submit(prompts[2], 3)
+        assert ei.value.queue_depth == 2
+        assert monitor.counter("serving.rejected").value == rej0 + 1
+        eng.drain()
+        _assert_resolved(ok)
+        for p, r in zip(prompts, ok):
+            np.testing.assert_array_equal(
+                np.asarray(r.tokens, np.int32), _want(params, cfg, p, 3))
+
+    def test_shed_oldest_policy(self, gpt_setup):
+        cfg, params = gpt_setup
+        eng = _engine(params, cfg, num_slots=1, max_queue=1,
+                      queue_policy="shed_oldest")
+        prompts = _prompts([3, 4, 5], seed=2)
+        reqs = [eng.submit(p, 3) for p in prompts]   # never raises
+        # r1 was shed from the queue to make room for r2
+        assert reqs[1].done and reqs[1].finish_reason == "evicted"
+        assert reqs[1].tokens == []
+        eng.drain()
+        _assert_resolved(reqs)
+        np.testing.assert_array_equal(
+            np.asarray(reqs[2].tokens, np.int32),
+            _want(params, cfg, prompts[2], 3))
+
+    def test_queue_ttl_expires_waiting_request(self, gpt_setup):
+        cfg, params = gpt_setup
+        eng = _engine(params, cfg, num_slots=1, queue_ttl_s=0.05)
+        long_p, short_p = _prompts([4, 3], seed=3)
+        r1 = eng.submit(long_p, 8)
+        r2 = eng.submit(short_p, 8)
+        eng.step()               # r1 admitted; r2 still queued
+        assert r1.slot is not None and not r2.done
+        time.sleep(0.1)          # r2's wait exceeds the TTL
+        eng.drain()
+        assert r2.finish_reason == "timeout" and r2.tokens == []
+        assert r1.finish_reason == "length"
+        np.testing.assert_array_equal(
+            np.asarray(r1.tokens, np.int32),
+            _want(params, cfg, long_p, 8))
+
+    def test_cancel_queued_and_mid_decode(self, gpt_setup):
+        cfg, params = gpt_setup
+        eng = _engine(params, cfg, num_slots=1)
+        pa, pb = _prompts([5, 7], seed=4)
+        ra = eng.submit(pa, 8)
+        rb = eng.submit(pb, 6)
+        eng.step()
+        eng.step()
+        assert ra.slot is not None
+        assert ra.cancel() is True          # mid-decode: frees the slot
+        assert ra.finish_reason == "cancelled" and ra.done
+        assert ra.cancel() is False         # exactly-once
+        assert ra.finish_reason == "cancelled"
+        # the freed slot admits rb, whose stream is still exact
+        eng.drain()
+        assert rb.finish_reason == "length"
+        np.testing.assert_array_equal(
+            np.asarray(rb.tokens, np.int32), _want(params, cfg, pb, 6))
+        # the cancelled stream is an exact prefix of its solo run
+        want_a = _want(params, cfg, pa, 8)
+        np.testing.assert_array_equal(
+            np.asarray(ra.tokens, np.int32), want_a[:len(ra.tokens)])
+
+    def test_cancel_queued_removes_from_queue(self, gpt_setup):
+        cfg, params = gpt_setup
+        eng = _engine(params, cfg, num_slots=1)
+        pa, pb = _prompts([4, 5], seed=5)
+        eng.submit(pa, 4)
+        rb = eng.submit(pb, 4)
+        assert rb.cancel() is True          # still queued
+        assert rb.finish_reason == "cancelled"
+        eng.drain()
+        _assert_clean(eng)
+
+
+# --------------------------------------------------------------------------
+# deadlines
+# --------------------------------------------------------------------------
+class TestDeadlines:
+    def test_deadline_ticks(self, gpt_setup):
+        cfg, params = gpt_setup
+        eng = _engine(params, cfg, num_slots=2)
+        pa, pb = _prompts([4, 6], seed=6)
+        ra = eng.submit(pa, 20)
+        rb = eng.submit(pb, 20, deadline_ticks=3)
+        eng.drain()
+        assert rb.finish_reason == "timeout"
+        assert 0 < len(rb.tokens) < 20
+        # survivor unperturbed
+        assert ra.finish_reason == "length"
+        np.testing.assert_array_equal(
+            np.asarray(ra.tokens, np.int32), _want(params, cfg, pa, 20))
+        # timed-out stream is an exact prefix
+        np.testing.assert_array_equal(
+            np.asarray(rb.tokens, np.int32),
+            _want(params, cfg, pb, 20)[:len(rb.tokens)])
+
+    def test_deadline_s_dead_on_arrival(self, gpt_setup):
+        cfg, params = gpt_setup
+        t0 = monitor.counter("serving.timeout").value
+        eng = _engine(params, cfg)
+        r = eng.submit(_prompts([4], seed=7)[0], 4, deadline_s=0.0)
+        eng.drain()
+        assert r.finish_reason == "timeout" and r.tokens == []
+        assert monitor.counter("serving.timeout").value == t0 + 1
+        assert not eng.has_work()
+
+    def test_generate_deadline_passthrough(self, gpt_setup):
+        cfg, params = gpt_setup
+        eng = _engine(params, cfg)
+        outs = eng.generate(_prompts([3, 5], seed=8), 12,
+                            deadline_ticks=4)
+        for o in outs:
+            # prefill token + decode tokens until the tick clock passes
+            # the deadline (enforced after the tick's emissions)
+            assert 0 < len(o) == 6 < 12
+
+
+# --------------------------------------------------------------------------
+# poisoned-slot quarantine
+# --------------------------------------------------------------------------
+class TestQuarantine:
+    def test_nan_logits_evicts_only_poisoned_slot(self, gpt_setup,
+                                                  clean_faults):
+        cfg, params = gpt_setup
+        p0 = monitor.counter("serving.poisoned").value
+        prompts = _prompts([3, 5, 8, 10], seed=9)
+        faults.install("nan_logits@2:1")
+        eng = _engine(params, cfg, num_slots=2)
+        reqs = [eng.submit(p, 6) for p in prompts]
+        eng.drain()
+        reasons = [r.finish_reason for r in reqs]
+        assert reasons.count("poisoned") == 1, reasons
+        assert monitor.counter("serving.poisoned").value == p0 + 1
+        for p, r in zip(prompts, reqs):
+            want = _want(params, cfg, p, 6)
+            got = np.asarray(r.tokens, np.int32)
+            if r.finish_reason == "poisoned":
+                np.testing.assert_array_equal(got, want[:len(got)])
+            else:                       # survivors: bit-identical
+                assert r.finish_reason == "length"
+                np.testing.assert_array_equal(got, want)
+        _assert_clean(eng)
+
+    def test_prefill_quarantine_on_nan_params(self, gpt_setup):
+        """Organic non-finite logits at PREFILL: the request resolves
+        as "poisoned" at admission and never occupies a slot."""
+        cfg, params = gpt_setup
+        bad = dict(params)
+        bad["wte"] = jnp.full_like(params["wte"], jnp.nan)
+        eng = _engine(bad, cfg)
+        r = eng.submit(_prompts([4], seed=10)[0], 4)
+        eng.drain()
+        assert r.finish_reason == "poisoned" and r.tokens == []
+        _assert_clean(eng)
+
+    def test_zero_recompiles_with_guardrails(self, gpt_setup,
+                                             clean_faults):
+        """Acceptance: guardrails (quarantine + a fired poison event)
+        add zero traces — decode holds one trace per sampling mode."""
+        cfg, params = gpt_setup
+        eng = _engine(params, cfg, num_slots=2)
+        eng.generate(_prompts([3, 9, 5, 16], seed=11), 3)
+        dec0, pre0 = eng.trace_counts()
+        assert dec0 == 1
+        faults.install("nan_logits@0:0")
+        eng.generate(_prompts([7, 2, 11], seed=12), 5)
+        faults.uninstall()
+        assert eng.trace_counts() == (dec0, pre0)
+
+
+# --------------------------------------------------------------------------
+# self-healing tick (satellite: exception safety in step()/_admit())
+# --------------------------------------------------------------------------
+class TestSelfHealing:
+    def test_prefill_raise_rolls_back_and_retries(self, gpt_setup,
+                                                  clean_faults):
+        cfg, params = gpt_setup
+        f0 = monitor.counter("serving.faults").value
+        prompts = _prompts([3, 5, 8], seed=13)
+        faults.install("prefill_raise@0")
+        eng = _engine(params, cfg, num_slots=2)
+        reqs = [eng.submit(p, 5) for p in prompts]
+        eng.drain()
+        assert monitor.counter("serving.faults").value > f0
+        _assert_resolved(reqs)
+        for p, r in zip(prompts, reqs):       # fault fully transparent
+            assert r.finish_reason == "length"
+            np.testing.assert_array_equal(
+                np.asarray(r.tokens, np.int32), _want(params, cfg, p, 5))
+        _assert_clean(eng)
+
+    def test_decode_raise_resyncs_and_retries(self, gpt_setup,
+                                              clean_faults):
+        cfg, params = gpt_setup
+        prompts = _prompts([4, 7], seed=14)
+        faults.install("decode_raise@2")
+        eng = _engine(params, cfg, num_slots=2)
+        reqs = [eng.submit(p, 6) for p in prompts]
+        eng.drain()
+        for p, r in zip(prompts, reqs):
+            assert r.finish_reason == "length"
+            np.testing.assert_array_equal(
+                np.asarray(r.tokens, np.int32), _want(params, cfg, p, 6))
+
+    def test_prefill_retries_exhausted_evicts_not_limbo(self, gpt_setup):
+        """Regression (satellite): a persistently-raising prefill must
+        roll the slot back and resolve the request — the pre-fix code
+        lost the popped request and left step() raising."""
+        cfg, params = gpt_setup
+        eng = _engine(params, cfg, retries=1, backoff_base=0.0)
+        boom = {"n": 0}
+        real = eng._prefill
+
+        def raising(*a, **k):
+            boom["n"] += 1
+            raise RuntimeError("injected dispatch failure")
+        eng._prefill = raising
+        r = eng.submit(_prompts([4], seed=15)[0], 4)
+        eng.drain()
+        assert boom["n"] == 2                 # initial + 1 retry
+        assert r.finish_reason == "evicted" and r.tokens == []
+        _assert_clean(eng)
+        # the engine still serves: restore and run an exact stream
+        eng._prefill = real
+        p = _prompts([5], seed=16)[0]
+        out = eng.generate([p], 4)[0]
+        np.testing.assert_array_equal(out, _want(params, cfg, p, 4))
+
+    def test_decode_retries_exhausted_hard_resets(self, gpt_setup):
+        cfg, params = gpt_setup
+        eng = _engine(params, cfg, retries=0)
+        real = eng._decode
+
+        def raising(*a, **k):
+            raise RuntimeError("injected dispatch failure")
+        prompts = _prompts([4, 6], seed=17)
+        reqs = [eng.submit(p, 6) for p in prompts]
+        eng.step()                        # admits both
+        eng._decode = raising
+        eng.step()                        # decode fails -> hard reset
+        _assert_resolved(reqs)
+        assert all(r.finish_reason == "evicted" for r in reqs)
+        _assert_clean(eng)
+        # fresh pool cache serves exact streams afterwards
+        eng._decode = real
+        out = eng.generate([prompts[0]], 4)[0]
+        np.testing.assert_array_equal(out,
+                                      _want(params, cfg, prompts[0], 4))
+
+    def test_watchdog_puller_recovers_after_hang(self):
+        """A pull that exhausts the budget abandons the wedged worker:
+        the next, healthy pull must not queue behind the dead one."""
+        from paddle_tpu.parallel.resilience import (WatchdogPuller,
+                                                    StepHungError)
+        p = WatchdogPuller(label="test")
+        with pytest.raises(StepHungError):
+            p.pull(lambda: (time.sleep(2.0), np.ones(1))[1],
+                   timeout=0.05, retries=1, backoff_base=0.05,
+                   backoff_max=0.05)
+        t0 = time.perf_counter()
+        out = p.pull(lambda: np.full((2,), 7.0), timeout=1.0, retries=1)
+        assert time.perf_counter() - t0 < 1.0
+        np.testing.assert_array_equal(out, np.full((2,), 7.0))
+
+    def test_tick_stall_recovers_under_watchdog(self, gpt_setup,
+                                                clean_faults):
+        cfg, params = gpt_setup
+        r0 = monitor.counter("serving.retries").value
+        faults.install("tick_stall@1:300")
+        eng = _engine(params, cfg, watchdog_timeout=0.1, retries=3,
+                      backoff_base=0.2)
+        p = _prompts([4], seed=18)[0]
+        out = eng.generate([p], 5)[0]
+        assert monitor.counter("serving.retries").value > r0
+        np.testing.assert_array_equal(out, _want(params, cfg, p, 5))
+
+
+# --------------------------------------------------------------------------
+# no-limbo: abort_pending / generate(max_ticks=) (satellite)
+# --------------------------------------------------------------------------
+class TestNoLimbo:
+    def test_abort_pending_resolves_everything(self, gpt_setup):
+        cfg, params = gpt_setup
+        eng = _engine(params, cfg, num_slots=1)
+        prompts = _prompts([4, 5, 6], seed=19)
+        reqs = [eng.submit(p, 10) for p in prompts]
+        eng.step()
+        n = eng.abort_pending()
+        assert n == 3
+        _assert_resolved(reqs)
+        assert all(r.finish_reason == "evicted" for r in reqs)
+        assert not eng.has_work()
+        with pytest.raises(ValueError):
+            eng.abort_pending(reason="nonsense")
+
+    def test_generate_max_ticks_never_limbo(self, gpt_setup):
+        cfg, params = gpt_setup
+        eng = _engine(params, cfg, num_slots=1)
+        prompts = _prompts([4, 5, 6, 7], seed=20)
+        outs = eng.generate(prompts, 10, max_ticks=3)
+        assert not eng.has_work()           # nothing left behind
+        _assert_clean(eng)
+        for p, o in zip(prompts, outs):     # partials are exact prefixes
+            want = _want(params, cfg, p, 10)
+            np.testing.assert_array_equal(o, want[:len(o)])
+        assert any(len(o) < 10 for o in outs)
+
+    def test_drain_without_max_ticks_still_completes(self, gpt_setup):
+        cfg, params = gpt_setup
+        eng = _engine(params, cfg)
+        outs = eng.generate(_prompts([3, 5], seed=21), 4)
+        assert all(len(o) == 4 for o in outs)
+
+
+# --------------------------------------------------------------------------
+# observability: counters, queue_wait gauge, SLO percentiles
+# --------------------------------------------------------------------------
+class TestObservability:
+    def test_queue_wait_gauge_and_cancel_counter(self, gpt_setup):
+        cfg, params = gpt_setup
+        c0 = monitor.counter("serving.cancelled").value
+        eng = _engine(params, cfg)
+        r = eng.submit(_prompts([4], seed=22)[0], 6)
+        eng.step()
+        assert monitor.gauge("serving.queue_wait_ms").value >= 0.0
+        r.cancel()
+        assert monitor.counter("serving.cancelled").value == c0 + 1
+
+    def test_slo_export_and_report(self, gpt_setup, tmp_path):
+        cfg, params = gpt_setup
+        import sys
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools"))
+        from telemetry_report import summarize
+        eng = _engine(params, cfg)
+        eng.generate(_prompts([4, 6, 9], seed=23), 6)
+        snap = eng.slo_snapshot()
+        assert len(snap["ttft_ms"]) == 3
+        assert len(snap["itl_ms"]) == 15        # 3 requests x 5 ticks
+        path = str(tmp_path / "slo.jsonl")
+        monitor.registry().export_jsonl(path)
+        eng.export_slo_jsonl(path)
+        doc = summarize(path)
+        srv = doc["serving"]
+        for section in ("ttft", "inter_token"):
+            assert {"n", "p50_ms", "p95_ms", "p99_ms"} <= set(srv[section])
+            assert srv[section]["p50_ms"] <= srv[section]["p99_ms"]
+        # export DRAINS the rings: a periodic re-export contributes no
+        # duplicate samples, so merged percentile counts are stable
+        eng.export_slo_jsonl(path)
+        doc2 = summarize(path)
+        assert doc2["serving"]["ttft"]["n"] == srv["ttft"]["n"]
+        assert doc2["serving"]["inter_token"]["n"] == \
+            srv["inter_token"]["n"]
+
+    def test_flight_dump_on_poison(self, gpt_setup, tmp_path,
+                                   clean_faults):
+        from paddle_tpu.profiler import flight_recorder
+        cfg, params = gpt_setup
+        rec = flight_recorder.recorder()
+        rec.clear()
+        rec.set_dir(str(tmp_path))
+        try:
+            faults.install("nan_logits@1:0")
+            eng = _engine(params, cfg)
+            eng.generate(_prompts([4], seed=24), 6)
+        finally:
+            rec.set_dir(None)
+            faults.uninstall()
+        dumps = [f for f in os.listdir(str(tmp_path))
+                 if f.endswith(".json") and "poisoned" in f]
+        assert dumps
+        doc = flight_recorder.load_dump(
+            os.path.join(str(tmp_path), dumps[0]))
+        assert doc["kind"] == "flight_recorder"
+        assert "monitor" in doc
+
+
+# --------------------------------------------------------------------------
+# facade passthrough
+# --------------------------------------------------------------------------
+class TestFacadePassthrough:
+    def test_engine_kw_and_deadline_passthrough(self, gpt_setup):
+        cfg, _ = gpt_setup
+        from paddle_tpu.models.gpt import GPTModel
+        gm = GPTModel(cfg)
+        prompts = _prompts([4, 6], seed=25)
+        outs = gm.generate(prompts, 8, num_slots=2, max_len=MAXLEN,
+                           deadline_ticks=3, max_ticks=16)
+        assert all(0 < len(o) == 5 < 8 for o in outs)
+        eng = gm._serving_engine
+        # same knobs -> cached engine; new engine knob -> rebuild
+        gm.generate(prompts, 4, num_slots=2, max_len=MAXLEN)
+        assert gm._serving_engine is eng
+        gm.generate(prompts, 4, num_slots=2, max_len=MAXLEN,
+                    max_queue=8)
+        assert gm._serving_engine is not eng
+        assert gm._serving_engine.max_queue == 8

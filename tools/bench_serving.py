@@ -146,6 +146,7 @@ def main():
     traces_after = eng.trace_counts()
     if tele_path:
         monitor.registry().export_jsonl(tele_path)
+        eng.export_slo_jsonl(tele_path)    # TTFT / inter-token samples
         try:
             from telemetry_report import summarize
             _log("telemetry: " + json.dumps(

@@ -1,0 +1,416 @@
+"""Serving chaos drill: the continuous-batching engine under faults.
+
+The executable acceptance test for the serving SLO guardrails
+(docs/serving.md "Robustness") — the serving sibling of
+tools/chaos_drill.py, in-process because the engine is a single-host
+runtime (no launcher/mesh in the loop). Every scenario drives the REAL
+ServingEngine over a mixed-length workload with a declared fault
+(paddle_tpu.testing.faults serving kinds) and asserts the three
+guardrail invariants:
+
+1. every submitted request ends in EXACTLY ONE terminal finish_reason
+   (TERMINAL_REASONS — no request in limbo, ever);
+2. surviving streams are BIT-IDENTICAL to the fault-free run, and
+   early-terminated streams (poisoned/cancelled/timeout/evicted) are
+   exact PREFIXES of it — per-request isolation inside the shared
+   batch, the Orca/vLLM correctness requirement;
+3. eventful faults leave a parseable flight-recorder dump, and the
+   trace-count ceilings hold (decode <= 2; prefill <= 2*log2(max_len))
+   — the guardrails cost no recompiles.
+
+Scenarios:
+  nan_logits@T:S   in-jit poisoned logit row -> only slot S's request
+                   ends "poisoned"; co-batched survivors exact
+  tick_stall@T:MS  host pull stalls mid-drill -> watchdog backoff
+                   recovers, serving.retries > 0, streams exact
+  prefill_raise@T  device call raises during admission -> slot rolled
+                   back, retry succeeds, streams exact
+  decode_raise@T   device call raises during the tick -> _dstate
+                   resyncs from mirrors, retry re-runs idempotently
+  queue_flood      max_queue overflow -> BackpressureError (reject) /
+                   oldest evicted (shed_oldest); admitted streams exact
+  cancel_deadline  mid-decode cancel + tick deadline -> "cancelled" /
+                   "timeout", survivors exact
+
+Usage:
+  python tools/chaos_serving.py            # the full drill
+  python tools/chaos_serving.py --quick    # smaller workload (CI)
+  python tools/chaos_serving.py --bench    # guardrail overhead JSON
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+# CPU unconditionally: the axon tunnel flaps and ANY backend init then
+# hangs (CLAUDE.md trap); the drill's assertions are platform-free.
+from paddle_tpu.device import pin_cpu            # noqa: E402
+pin_cpu(1)
+
+import numpy as np                               # noqa: E402
+import jax                                       # noqa: E402
+import jax.numpy as jnp                          # noqa: E402
+
+
+def _log(msg):
+    print(f"[chaos_serving] {msg}", flush=True)
+
+
+# ------------------------------------------------------------- fixture
+def build_model(hidden=32, layers=2, vocab=64):
+    from paddle_tpu.models.gpt import GPTConfig, init_gpt_params
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden,
+                    num_layers=layers, num_heads=max(hidden // 16, 1),
+                    ffn_hidden=2 * hidden, max_seq_len=128,
+                    sequence_parallel=False, remat=False,
+                    dtype=jnp.float32)
+    return init_gpt_params(cfg, jax.random.PRNGKey(0)), cfg
+
+
+def build_workload(n, lo, hi, vocab, seed=0):
+    rng = np.random.RandomState(seed)
+    lens = rng.randint(lo, hi + 1, n)
+    return [rng.randint(0, vocab, L).astype(np.int32) for L in lens]
+
+
+def make_engine(params, cfg, max_len, **kw):
+    from paddle_tpu.inference.serving import ServingEngine
+    kw.setdefault("num_slots", 3)
+    return ServingEngine(params, cfg, family="gpt", max_len=max_len, **kw)
+
+
+# ------------------------------------------------------------ checking
+def check_terminal(reqs):
+    """Invariant 1: exactly-once terminal resolution."""
+    from paddle_tpu.inference.serving import TERMINAL_REASONS
+    for r in reqs:
+        if not r.done:
+            return f"request {r.id} not done (limbo)"
+        if r.finish_reason not in TERMINAL_REASONS:
+            return (f"request {r.id} finish_reason "
+                    f"{r.finish_reason!r} not terminal")
+        if r.slot is not None:
+            return f"request {r.id} resolved but still owns slot {r.slot}"
+    return None
+
+def check_streams(reqs, baseline, full_reasons=("length", "eos")):
+    """Invariant 2: survivors bit-identical, early exits exact
+    prefixes. `baseline[i]` is request i's fault-free stream."""
+    for i, r in enumerate(reqs):
+        got = np.asarray(r.tokens, np.int32)
+        want = baseline[i]
+        if r.finish_reason in full_reasons:
+            if not np.array_equal(got, want):
+                return (f"request {i} ({r.finish_reason}) diverged: "
+                        f"{got.tolist()} vs {want.tolist()}")
+        else:
+            if not np.array_equal(got, want[:len(got)]):
+                return (f"request {i} ({r.finish_reason}) is not a "
+                        f"prefix of its fault-free stream: "
+                        f"{got.tolist()} vs {want.tolist()}")
+    return None
+
+
+def check_traces(eng):
+    """Invariant 3b: guardrails cost no recompiles."""
+    dec, pre = eng.trace_counts()
+    ceiling = 2 * max(int(math.log2(eng.max_len)), 1)
+    if dec > 2:
+        return f"decode traces {dec} > 2"
+    if pre > ceiling:
+        return f"prefill traces {pre} > {ceiling}"
+    return None
+
+
+def check_flight(fdir):
+    """Invariant 3a: eventful faults leave a parseable black box."""
+    from paddle_tpu.profiler.flight_recorder import load_dump
+    names = sorted(f for f in (os.listdir(fdir) if os.path.isdir(fdir)
+                               else []) if f.endswith(".json"))
+    if not names:
+        return f"no flight dump under {fdir}"
+    for name in names:
+        try:
+            doc = load_dump(os.path.join(fdir, name))
+        except (OSError, ValueError) as e:
+            return f"flight dump {name} unparseable: {e}"
+        if "monitor" not in doc:
+            return f"flight dump {name}: no monitor snapshot"
+    return None
+
+
+# ------------------------------------------------------------ the drill
+def run_drill(quick: bool = False, keep_root: bool = False) -> int:
+    from paddle_tpu.inference.serving import BackpressureError
+    from paddle_tpu.profiler import flight_recorder, monitor
+    from paddle_tpu.testing import faults
+
+    t_start = time.time()
+    n_req, gen = (6, 6) if quick else (10, 10)
+    params, cfg = build_model()
+    max_len = 64
+    prompts = build_workload(n_req, 3, 20, cfg.vocab_size)
+    root = tempfile.mkdtemp(prefix="chaos_serving_")
+    failures = []
+
+    # fault-free baseline: per-request streams (bit-parity makes these
+    # independent of pool size / join order, which is exactly what the
+    # scenarios below re-assert under faults)
+    eng = make_engine(params, cfg, max_len)
+    base_reqs = [eng.submit(p, gen) for p in prompts]
+    eng.drain()
+    err = check_terminal(base_reqs) or check_traces(eng)
+    if err:
+        _log(f"baseline FAILED: {err}")
+        return 2
+    baseline = [np.asarray(r.tokens, np.int32) for r in base_reqs]
+    _log(f"baseline: {n_req} requests x {gen} tokens ok")
+
+    rec = flight_recorder.recorder()
+
+    def scenario(name, body, spec=None, want_flight=True):
+        sdir = os.path.join(root, name)
+        fdir = os.path.join(sdir, "flight")
+        os.makedirs(fdir, exist_ok=True)
+        rec.clear()
+        rec.set_dir(fdir)
+        if spec:
+            faults.install(spec, once_dir=os.path.join(sdir, "once"))
+        t0 = time.time()
+        try:
+            err = body()
+        finally:
+            if spec:
+                faults.uninstall()
+            rec.set_dir(None)
+        if err is None and want_flight:
+            err = check_flight(fdir)
+        tag = "FAIL" if err else "ok"
+        _log(f"{name:<28} {tag}  ({time.time() - t0:.1f}s)")
+        if err:
+            failures.append(f"{name}: {err}")
+
+    # --- nan_logits: poisoned-slot quarantine isolation -------------
+    def nan_body():
+        eng = make_engine(params, cfg, max_len)
+        reqs = [eng.submit(p, gen) for p in prompts]
+        eng.drain()
+        reasons = [r.finish_reason for r in reqs]
+        if reasons.count("poisoned") != 1:
+            return f"expected exactly one poisoned request: {reasons}"
+        return (check_terminal(reqs) or check_streams(reqs, baseline)
+                or check_traces(eng))
+    scenario("nan_logits@2:1", nan_body, spec="nan_logits@2:1")
+
+    # --- tick_stall: watchdog budget/backoff recovery ----------------
+    def stall_body():
+        r0 = monitor.counter("serving.retries").value
+        eng = make_engine(params, cfg, max_len, watchdog_timeout=0.1,
+                          retries=3, backoff_base=0.2)
+        reqs = [eng.submit(p, gen) for p in prompts]
+        eng.drain()
+        if monitor.counter("serving.retries").value <= r0:
+            return "watchdog never retried (stall not exercised)"
+        return (check_terminal(reqs) or check_streams(reqs, baseline)
+                or check_traces(eng))
+    scenario("tick_stall@2:400", stall_body, spec="tick_stall@2:400")
+
+    # --- raise-mid-prefill / raise-mid-decode: self-healing tick -----
+    def raise_body(spec_kind):
+        def body():
+            f0 = monitor.counter("serving.faults").value
+            eng = make_engine(params, cfg, max_len)
+            reqs = [eng.submit(p, gen) for p in prompts]
+            eng.drain()
+            if monitor.counter("serving.faults").value <= f0:
+                return "fault never fired"
+            err = check_terminal(reqs) or check_traces(eng)
+            if err:
+                return err
+            # the retry makes the fault fully transparent: EVERY
+            # stream completes and matches
+            if any(r.finish_reason != "length" for r in reqs):
+                return ("retry was not transparent: "
+                        f"{[r.finish_reason for r in reqs]}")
+            return check_streams(reqs, baseline)
+        return body
+    scenario("prefill_raise@0", raise_body("prefill"),
+             spec="prefill_raise@0")
+    scenario("decode_raise@2", raise_body("decode"),
+             spec="decode_raise@2")
+
+    # --- queue flood: backpressure under both policies ---------------
+    def flood_reject():
+        eng = make_engine(params, cfg, max_len, num_slots=2, max_queue=2)
+        accepted, rejected = [], 0
+        for i, p in enumerate(prompts):
+            try:
+                accepted.append((i, eng.submit(p, gen)))
+            except BackpressureError as e:
+                rejected += 1
+                if e.queue_depth < 2:
+                    return f"rejected at depth {e.queue_depth} < max_queue"
+        if rejected == 0:
+            return "queue flood never tripped backpressure"
+        eng.drain()
+        reqs = [r for _, r in accepted]
+        err = check_terminal(reqs) or check_traces(eng)
+        if err:
+            return err
+        for i, r in accepted:
+            if not np.array_equal(np.asarray(r.tokens, np.int32),
+                                  baseline[i]):
+                return f"accepted request {i} diverged under flood"
+        return None
+    scenario("queue_flood_reject", flood_reject, want_flight=False)
+
+    def flood_shed():
+        eng = make_engine(params, cfg, max_len, num_slots=2, max_queue=2,
+                          queue_policy="shed_oldest")
+        reqs = [eng.submit(p, gen) for p in prompts]  # never raises
+        eng.drain()
+        err = check_terminal(reqs) or check_traces(eng)
+        if err:
+            return err
+        shed = [r for r in reqs if r.finish_reason == "evicted"]
+        if not shed:
+            return "shed_oldest never shed"
+        return check_streams(reqs, baseline)
+    scenario("queue_flood_shed", flood_shed, want_flight=False)
+
+    # --- cancel + deadlines ------------------------------------------
+    def cancel_deadline():
+        eng = make_engine(params, cfg, max_len)
+        reqs = []
+        for i, p in enumerate(prompts):
+            reqs.append(eng.submit(
+                p, gen, deadline_ticks=3 if i == 1 else None))
+        eng.step()
+        eng.step()
+        victim = next(r for r in reqs if r.slot is not None
+                      and r.finish_reason is None and r is not reqs[1])
+        if not victim.cancel():
+            return "cancel() returned False on a live request"
+        eng.drain()
+        err = check_terminal(reqs) or check_streams(reqs, baseline)
+        if err:
+            return err
+        if victim.finish_reason != "cancelled":
+            return f"victim finished {victim.finish_reason!r}"
+        if reqs[1].finish_reason != "timeout":
+            return f"deadline request finished {reqs[1].finish_reason!r}"
+        return None
+    scenario("cancel_deadline", cancel_deadline, want_flight=False)
+
+    rec.clear()          # don't leak scenario records into the caller's
+    #                      process-global ring (in-process test usage)
+    dt = time.time() - t_start
+    if keep_root:
+        _log(f"artifacts kept under {root}")
+    if failures:
+        _log(f"{len(failures)} FAILURES in {dt:.1f}s:")
+        for f in failures:
+            _log(f"  - {f}")
+        return 1
+    _log(f"ALL SCENARIOS PASSED (quick={quick}) in {dt:.1f}s")
+    return 0
+
+
+# ------------------------------------------------------------ bench mode
+def bench_main(requests=16, gen=32, slots=8, repeats=5) -> int:
+    """Measure the guardrail overhead on serving throughput: the same
+    workload through an engine with guardrails OFF (PR-4 shape: no
+    in-jit isfinite/poison, no watchdog, no deadlines) and ON (the
+    default: quarantine guard + watchdog + per-request deadlines that
+    never fire). Timed passes ALTERNATE between the two warm engines
+    and each side reports its best — on the loaded 1-core build host
+    run-to-run noise exceeds the effect, so paired best-of-N is the
+    honest estimator. One JSON line — the BASELINE.md "Serving SLO"
+    row."""
+    from paddle_tpu.models.decode import next_pow2
+    from paddle_tpu.models.gpt import GPTConfig, init_gpt_params
+    from paddle_tpu.inference.serving import ServingEngine
+
+    hidden, layers, vocab = 128, 2, 512
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden,
+                    num_layers=layers, num_heads=hidden // 32,
+                    max_seq_len=2 * next_pow2(96 + gen),
+                    sequence_parallel=False, remat=False,
+                    dtype=jnp.float32)
+    params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+    max_len = next_pow2(96 + gen)
+    prompts = build_workload(requests, 8, 96, vocab)
+    total = requests * gen
+
+    def build(**kw):
+        sub = dict(kw.pop("_submit", {}))
+        eng = ServingEngine(params, cfg, family="gpt", num_slots=slots,
+                            max_len=max_len, **kw)
+        warm = eng.generate(prompts, gen, **sub)     # compile everything
+        return eng, sub, warm
+
+    def timed(eng, sub):
+        t0 = time.perf_counter()
+        outs = eng.generate(prompts, gen, **sub)
+        return time.perf_counter() - t0, outs
+
+    eng_off, sub_off, warm_off = build(guardrails=False)
+    eng_on, sub_on, warm_on = build(
+        guardrails=True, watchdog_timeout=5.0,
+        _submit=dict(deadline_s=300.0, deadline_ticks=100_000))
+    mismatch = sum(1 for a, b in zip(warm_off, warm_on)
+                   if not np.array_equal(a, b))
+    best_off = best_on = 1e18
+    for _ in range(repeats):
+        dt, outs = timed(eng_off, sub_off)
+        best_off = min(best_off, dt)
+        mismatch += sum(1 for a, b in zip(warm_off, outs)
+                        if not np.array_equal(a, b))
+        dt, outs = timed(eng_on, sub_on)
+        best_on = min(best_on, dt)
+        mismatch += sum(1 for a, b in zip(warm_off, outs)
+                        if not np.array_equal(a, b))
+    tps_off, tps_on = total / best_off, total / best_on
+    traces_off, traces_on = eng_off.trace_counts(), eng_on.trace_counts()
+    overhead = (tps_off - tps_on) / tps_off * 100.0
+    print(json.dumps({
+        "metric": "serving_guardrail_overhead",
+        "value": round(overhead, 2),
+        "unit": "%",
+        "backend": jax.devices()[0].platform,
+        "tokens_per_sec_guardrails_off": round(tps_off, 1),
+        "tokens_per_sec_guardrails_on": round(tps_on, 1),
+        "requests": requests, "gen": gen, "slots": slots,
+        "repeats": repeats,
+        "model": f"{layers}Lx{hidden}d",
+        "decode_traces": [traces_off[0], traces_on[0]],
+        "stream_mismatches": mismatch,
+    }), flush=True)
+    return 0 if mismatch == 0 else 1
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--quick", action="store_true",
+                    help="smaller workload (CI-sized)")
+    ap.add_argument("--bench", action="store_true",
+                    help="measure guardrail overhead, print one JSON")
+    ap.add_argument("--keep", action="store_true",
+                    help="keep scenario artifacts")
+    args = ap.parse_args()
+    if args.bench:
+        return bench_main()
+    return run_drill(quick=args.quick, keep_root=args.keep)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

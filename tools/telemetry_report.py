@@ -53,6 +53,7 @@ def summarize(path: str, samples_per_step: Optional[float] = None) -> dict:
     #                    windows must not span a kill/restart boundary
     monitors = []
     events = []
+    slo_ttft, slo_itl = [], []   # serving SLO samples (serving_slo recs)
     bad_lines = 0
     with open(path) as f:
         for line in f:
@@ -80,6 +81,9 @@ def summarize(path: str, samples_per_step: Optional[float] = None) -> dict:
                 monitors.append(rec)
             elif kind == "event":
                 events.append(rec)
+            elif kind == "serving_slo":
+                slo_ttft.extend(rec.get("ttft_ms") or [])
+                slo_itl.extend(rec.get("itl_ms") or [])
 
     out = {"path": path, "run": {k: v for k, v in run.items()
                                  if k not in ("kind",)},
@@ -154,7 +158,8 @@ def summarize(path: str, samples_per_step: Optional[float] = None) -> dict:
     # Counters report first-to-last DELTAS (consistent with the
     # monitor_delta section and with tokens_per_s); gauges report their
     # last value. ----
-    _SERVING_GAUGES = ("serving.slot_occupancy", "serving.queue_depth")
+    _SERVING_GAUGES = ("serving.slot_occupancy", "serving.queue_depth",
+                       "serving.queue_wait_ms")
     if monitors:
         first_s, last_s = monitors[0]["stats"], monitors[-1]["stats"]
         srv = {k[len("serving."):]:
@@ -167,6 +172,21 @@ def summarize(path: str, samples_per_step: Optional[float] = None) -> dict:
             if dtok and dt > 0:
                 srv["tokens_per_s"] = round(dtok / dt, 1)
             out["serving"] = srv
+
+    # ---- serving SLO percentiles (ServingEngine.export_slo_jsonl
+    # records: raw TTFT / inter-token-latency samples in ms) ----
+    def _slo_pcts(vals):
+        ordered = sorted(vals)
+        return {"n": len(vals),
+                "p50_ms": round(_percentile(ordered, 50), 3),
+                "p95_ms": round(_percentile(ordered, 95), 3),
+                "p99_ms": round(_percentile(ordered, 99), 3)}
+    if slo_ttft or slo_itl:
+        srv = out.setdefault("serving", {})
+        if slo_ttft:
+            srv["ttft"] = _slo_pcts(slo_ttft)
+        if slo_itl:
+            srv["inter_token"] = _slo_pcts(slo_itl)
 
     # ---- event timeline ----
     if events:
