@@ -24,8 +24,8 @@ from ..framework.tensor import Tensor
 
 __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
            "ServingEngine", "Request", "create_serving_engine",
-           "family_for", "BackpressureError", "ServingFaultError",
-           "TERMINAL_REASONS"]
+           "family_for", "BackpressureError", "PoolExhaustedError",
+           "ServingFaultError", "TERMINAL_REASONS"]
 
 
 class PrecisionType:
@@ -226,5 +226,5 @@ def create_predictor(config: Config) -> Predictor:
 # one-request-per-run loop cannot provide
 from .serving import (ServingEngine, Request,          # noqa: E402,F401
                       create_serving_engine, family_for,
-                      BackpressureError, ServingFaultError,
-                      TERMINAL_REASONS)
+                      BackpressureError, PoolExhaustedError,
+                      ServingFaultError, TERMINAL_REASONS)

@@ -81,21 +81,66 @@ requirement of Orca/vLLM-class serving stacks):
   Every serving fault dumps a flight-recorder black box
   (profiler/flight_recorder.py).
 
+Paged KV cache (kv_layout="paged", selectable via
+PADDLE_TPU_DECODE_ATTN_IMPL=paged / the kernel registry — the
+capacity layer, cf. vLLM's PagedAttention SOSP '23 and SGLang's
+RadixAttention):
+
+- **Block pool.** K/V live in fixed-size pages ({"k","v"} buffers of
+  [L, num_pages, page_size, KV, hd]) instead of one dense
+  [L, N, max_len, ...] block; a device-resident per-slot page table
+  ("pt" [N, max_pages] int32, riding the donated cache dict) maps
+  logical positions to physical pages. HBM scales with TOKENS HELD,
+  not num_slots * max_len — the concurrent-stream capacity lever.
+  Page 0 is reserved scratch: freed slots and out-of-range positions
+  write there, and the position mask keeps its garbage at an exact
+  softmax 0. All allocation/refcount/free runs on the host scheduler
+  (`_PagePool`) between ticks; the jitted tick only ever sees
+  gather/scatter indexing (kernels/decode_attention.gather_pages /
+  write_kv_paged) — bit-identical streams vs the dense layout.
+- **Prefix sharing + copy-on-write.** Admission hashes the prompt per
+  page (a rolled prefix hash: page j's key covers tokens
+  [0, (j+1)*page_size)) and maps already-materialized pages instead
+  of recomputing them, bumping refcounts; the suffix (always >= 1
+  token, so the first-token logits are always computed) prefills
+  normally. A slot that must WRITE into a shared/registered page
+  first materializes a private copy (`_ensure_private` — the COW
+  seam, one jitted in-pool page copy). Finished requests' registered
+  pages linger in an LRU "cached" state (refcount 0, evictable on
+  demand), so a system prompt's pages survive across request
+  lifetimes — the RadixAttention-style cross-request reuse.
+- **Chunked prefill.** Prompts whose un-shared suffix exceeds
+  `prefill_chunk` split into chunks run ONE PER TICK, interleaved
+  with the decode tick, so a max-length prompt can never stall
+  co-batched streams past their inter-token deadline. Chunks reuse
+  the bucketed-prefill trace policy (power-of-two chunk buckets,
+  traced true_len/start/slot), so the prefill executable ceiling is
+  unchanged.
+- **Pool-exhaustion admission.** Every admission RESERVES its
+  worst-case page need (minus shared credit) up front; a request
+  that cannot reserve stays queued (never a wedged slot), and one
+  that could never fit the configured pool raises the typed
+  `PoolExhaustedError` at submit.
+
 Observability: serving.* monitor counters/gauges (slot occupancy,
 queue depth, tokens emitted, prefills, decode ticks, plus
-rejected/timeout/cancelled/poisoned/evicted/retries/faults and the
-queue_wait_ms gauge) and RecordEvent spans around every
-prefill/decode tick — tools/telemetry_report.py summarizes them
-(including TTFT / inter-token-latency percentiles from
-`export_slo_jsonl`), tools/bench_serving.py measures the engine
-against sequential per-request decode, and tools/chaos_serving.py is
-the executable acceptance test for the guardrails.
+rejected/timeout/cancelled/poisoned/evicted/retries/faults, the
+queue_wait_ms gauge, and the kv-pool surface: pages_in_use /
+pages_shared gauges, cow_copies / prefill_chunks counters) and
+RecordEvent spans around every prefill/decode tick —
+tools/telemetry_report.py summarizes them (including TTFT /
+inter-token-latency percentiles from `export_slo_jsonl` and a
+"kv pool" block), tools/bench_serving.py measures the engine against
+sequential per-request decode (--capacity races paged vs dense at
+equal HBM), and tools/chaos_serving.py is the executable acceptance
+test for the guardrails.
 """
 from __future__ import annotations
 
 import collections
 import dataclasses
 import functools
+import hashlib
 import json
 import sys
 import time
@@ -110,7 +155,7 @@ from ..profiler import RecordEvent, monitor
 
 __all__ = ["ServingEngine", "Request", "ModelFamily", "family_for",
            "create_serving_engine", "BackpressureError",
-           "ServingFaultError", "TERMINAL_REASONS"]
+           "PoolExhaustedError", "ServingFaultError", "TERMINAL_REASONS"]
 
 # every submitted request ends in exactly one of these (the
 # finish-reason state machine — docs/serving.md "Robustness")
@@ -120,7 +165,8 @@ TERMINAL_REASONS = frozenset(
 # fault-injection seam (paddle_tpu.testing.faults.install wires it):
 # called with the tick index about to run, returns an action dict
 # ({"poison_slot": i} | {"stall_s": s} | {"raise_prefill": True} |
-# {"raise_decode": True}). Production code never sets it.
+# {"raise_decode": True} | {"raise_cow": True}). Production code never
+# sets it.
 _FAULT_HOOK: Optional[Callable[[int], dict]] = None
 
 
@@ -133,10 +179,23 @@ class BackpressureError(RuntimeError):
         self.queue_depth = queue_depth
 
 
+class PoolExhaustedError(RuntimeError):
+    """submit() refused: the request's worst-case page need exceeds
+    the ENTIRE configured pool — it could never be admitted. Requests
+    that merely have to wait for pages are queued, not refused."""
+
+    def __init__(self, msg: str, pages_needed: int = 0,
+                 pages_total: int = 0):
+        super().__init__(msg)
+        self.pages_needed = pages_needed
+        self.pages_total = pages_total
+
+
 class ServingFaultError(RuntimeError):
     """An injected serving fault (testing.faults prefill_raise /
-    decode_raise) — raised at the device-call seam so the retry path
-    exercises exactly what an organic dispatch failure would."""
+    decode_raise / cow_raise) — raised at the device-call seam so the
+    retry path exercises exactly what an organic dispatch failure
+    would."""
 
 
 # --------------------------------------------------------------- families
@@ -163,6 +222,123 @@ def family_for(name: str) -> ModelFamily:
     raise ValueError(f"unknown model family {name!r} (gpt|llama)")
 
 
+# -------------------------------------------------------------- page pool
+class _PagePool:
+    """Host-side allocator for the paged KV pool (the scheduler half of
+    the vLLM block manager). Every page is in exactly one state:
+
+    - free      never registered; on the free list;
+    - live      refcount > 0 (mapped by >= 1 slot page tables);
+    - cached    refcount == 0 but registered under a prompt-prefix key
+                (LRU; evictable on demand — cross-request prefix reuse).
+
+    Page 0 is the reserved scratch page (permanently live, never
+    handed out): freed slots' table rows and out-of-range positions
+    point at it, so stray scatter writes land in garbage the position
+    mask never admits.
+
+    `reserved` tracks admission-time worst-case reservations not yet
+    turned into allocations — `available()` is what a NEW admission
+    may claim without starving an already-admitted slot."""
+
+    def __init__(self, num_pages: int, page_size: int):
+        if num_pages < 2:
+            raise ValueError(f"num_pages must be >= 2 (page 0 is "
+                             f"reserved scratch); got {num_pages}")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.ref = np.zeros(num_pages, np.int64)
+        self.ref[0] = 1                      # scratch: pinned forever
+        # pop() takes from the end -> low page ids hand out first
+        self.free: List[int] = list(range(num_pages - 1, 0, -1))
+        self.cached: "collections.OrderedDict[int, tuple]" = \
+            collections.OrderedDict()        # page_id -> key, LRU order
+        self.by_key: dict = {}               # prefix key -> page_id
+        self.key_of: dict = {}               # page_id -> prefix key
+        self.reserved = 0                    # admission reservations
+
+    def available(self) -> int:
+        """Pages a new admission may still reserve: free + evictable
+        cached, minus what prior admissions already reserved."""
+        return len(self.free) + len(self.cached) - self.reserved
+
+    def alloc(self) -> int:
+        """One private page (ref=1), evicting the LRU cached page (and
+        its prefix-map entry) when the free list is dry. Raises
+        PoolExhaustedError when nothing is evictable — unreachable for
+        reserved admissions by construction."""
+        if self.free:
+            pid = self.free.pop()
+        elif self.cached:
+            pid, key = self.cached.popitem(last=False)     # LRU
+            del self.by_key[key]
+            del self.key_of[pid]
+        else:
+            raise PoolExhaustedError(
+                "page pool exhausted (no free or evictable page)",
+                pages_needed=1, pages_total=self.num_pages)
+        self.ref[pid] = 1
+        return pid
+
+    def retain(self, pid: int) -> None:
+        """One more reference (prefix sharing): a cached page comes
+        back live; its prefix-map registration survives."""
+        if self.ref[pid] == 0:
+            self.cached.pop(pid, None)
+        self.ref[pid] += 1
+
+    def release(self, pid: int) -> None:
+        """Drop one reference. At zero a registered page parks in the
+        LRU cache (prefix reuse across request lifetimes); an
+        unregistered one returns to the free list."""
+        if pid == 0:
+            return                           # scratch never releases
+        self.ref[pid] -= 1
+        assert self.ref[pid] >= 0, f"refcount underflow on page {pid}"
+        if self.ref[pid] == 0:
+            key = self.key_of.get(pid)
+            if key is not None:
+                self.cached[pid] = key
+                self.cached.move_to_end(pid)
+            else:
+                self.free.append(pid)
+
+    def register(self, pid: int, key) -> None:
+        """Publish `pid` under the prompt-prefix `key` (first writer
+        wins — a racing identical prefix keeps its private copy)."""
+        if key not in self.by_key and pid not in self.key_of:
+            self.by_key[key] = pid
+            self.key_of[pid] = key
+
+    def lookup(self, key) -> Optional[int]:
+        return self.by_key.get(key)
+
+    def is_frozen(self, pid: int) -> bool:
+        """True when writing `pid` requires a private copy first:
+        shared (ref > 1) or published in the prefix map (another slot
+        may map it at any moment)."""
+        return self.ref[pid] > 1 or pid in self.key_of
+
+    def stats(self) -> dict:
+        live = int((self.ref[1:] > 0).sum())
+        return {"num_pages": self.num_pages,
+                "page_size": self.page_size,
+                "pages_in_use": live,
+                "pages_free": len(self.free),
+                "pages_cached": len(self.cached),
+                "pages_shared": int((self.ref[1:] > 1).sum()),
+                "pages_reserved": int(self.reserved)}
+
+
+def _prefix_key(prompt: np.ndarray, n: int) -> tuple:
+    """The rolled prompt-prefix hash for the page ending at token `n`:
+    identical token prefixes -> identical K/V bits (causality), so the
+    digest of tokens [0, n) keys a reusable page. Length rides in the
+    key so a digest collision across lengths cannot alias."""
+    return (n, hashlib.blake2b(prompt[:n].tobytes(),
+                               digest_size=16).digest())
+
+
 # --------------------------------------------------------------- requests
 class Request:
     """One generation request riding through the engine."""
@@ -170,7 +346,8 @@ class Request:
     __slots__ = ("id", "prompt", "max_new_tokens", "temperature",
                  "top_k", "eos_id", "tokens", "done", "finish_reason",
                  "slot", "deadline_s", "deadline_ticks", "t_submit",
-                 "_tick_submit", "_t_last", "_engine")
+                 "_tick_submit", "_t_last", "_engine", "_pf_next",
+                 "shared_tokens", "_pfx_keys")
 
     def __init__(self, req_id, prompt, max_new_tokens, temperature,
                  top_k, eos_id, deadline_s=None, deadline_ticks=None):
@@ -190,6 +367,10 @@ class Request:
         self._tick_submit = 0
         self._t_last = 0.0              # last emission (SLO samples)
         self._engine = None
+        self._pf_next = None            # next chunked-prefill position
+        self._pfx_keys = None           # memoized per-page prefix hashes
+        self.shared_tokens = 0          # prompt tokens served from
+        #                                 shared pages (prefix reuse)
 
     def cancel(self) -> bool:
         """Terminate this request NOW (finish_reason "cancelled"):
@@ -241,7 +422,7 @@ def _sample(lg, temps, top_ks, keys, max_top_k: int):
 # the sampled tokens, one small pull per tick)
 #   (cur_tok, positions, active, temps, top_ks, req_ids, gen_idx)
 def _decode_tick(params, cache, state, base_key, poison, *, fwd, cfg,
-                 max_top_k, sampling, guard):
+                 max_top_k, sampling, guard, oor_pos=None):
     """THE mixed step: all N slots advance one token. Each slot's
     current token is written at its own position; sampling runs in-jit;
     inactive slots compute too (fixed shape) but their output is masked
@@ -258,7 +439,15 @@ def _decode_tick(params, cache, state, base_key, poison, *, fwd, cfg,
     exercise the exact same guard); multiplying by 1.0 is exact in
     IEEE fp, so guarded greedy/sampled streams stay bit-identical."""
     toks, positions, active, temps, top_ks, req_ids, gen_idx = state
-    logits, cache = fwd(params, toks[:, None], cache, positions, cfg)
+    # under the paged layout the pool is SHARED across rows, so an
+    # inactive row (mid-chunked-prefill, its table already mapping
+    # real — possibly shared — pages) must not scatter its garbage
+    # K/V through the table: route its write past the table, onto the
+    # scratch page (oor_pos = max_pages * page_size; dense rows own
+    # their cache row outright, so oor_pos stays None there)
+    fpos = (positions if oor_pos is None
+            else jnp.where(active, positions, oor_pos))
+    logits, cache = fwd(params, toks[:, None], cache, fpos, cfg)
     lg = logits[:, 0].astype(jnp.float32)
     if guard:
         lg = lg * poison[:, None]
@@ -311,6 +500,50 @@ def _prefill_slot(params, cache, padded, true_len, slot, temps, top_ks,
     return first, cache
 
 
+def _prefill_chunk(params, cache, padded, true_len, start, slot, temps,
+                   top_ks, req_ids, base_key, *, fwd, cfg, max_top_k,
+                   sampling, guard):
+    """Paged/chunked prefill of ONE chunk into slot `slot`: run the
+    padded chunk [1, cb] at absolute positions start.. against the
+    slot's single-row paged view (its page-table row sliced out of the
+    pool's "pt"), scattering the chunk's K/V into the pool pages, and
+    sample a token from the chunk's LAST REAL position — meaningful
+    only for the prompt's final chunk (logits at t0-1); the host
+    ignores it (and skips the pull entirely) for earlier chunks.
+    Trace key: the chunk bucket length only (true_len/start/slot are
+    traced scalars), so chunking reuses the bucketed-prefill
+    executable ceiling. Bit-parity: per-position K/V and the masked
+    softmax are bit-identical whether the prompt runs as one pass or
+    as chunks (pad/absent positions contribute an exact 0)."""
+    row = jax.lax.dynamic_slice_in_dim(cache["pt"], slot, 1, axis=0)
+    sub = {"k": cache["k"], "v": cache["v"], "pt": row}
+    posv = jnp.reshape(start, (1,)).astype(jnp.int32)
+    logits, sub = fwd(params, padded, sub, posv, cfg)
+    last = jax.lax.dynamic_slice_in_dim(
+        logits, true_len - 1, 1, axis=1)[:, 0].astype(jnp.float32)
+    if sampling:
+        keys = _slot_keys(base_key, req_ids, jnp.zeros((1,), jnp.int32))
+        first = _sample(last, temps, top_ks, keys, max_top_k)[0]
+    else:
+        first = jnp.argmax(last, axis=-1).astype(jnp.int32)[0]
+    if guard:
+        first = jnp.where(jnp.all(jnp.isfinite(last)), first, -1)
+    return first, {"k": sub["k"], "v": sub["v"], "pt": cache["pt"]}
+
+
+def _cow_copy(cache, src, dst):
+    """Copy page `src` onto page `dst` across every layer of the pool
+    (both k and v) — THE copy-on-write materialization, one jitted
+    in-pool dynamic slice/update on the donated buffers; src/dst are
+    traced scalars so the engine holds exactly one trace of this."""
+    out = dict(cache)
+    for key in ("k", "v"):
+        pg = jax.lax.dynamic_slice_in_dim(cache[key], src, 1, axis=1)
+        out[key] = jax.lax.dynamic_update_slice(
+            cache[key], pg, (0, dst, 0, 0, 0))
+    return out
+
+
 # ----------------------------------------------------------- the engine
 class ServingEngine:
     """Iteration-level scheduler over a fixed slot pool.
@@ -332,11 +565,25 @@ class ServingEngine:
                  queue_policy: str = "reject", queue_ttl_s: float = 0.0,
                  watchdog_timeout: float = 0.0, retries: int = 2,
                  backoff_base: float = 0.05, backoff_max: float = 2.0,
-                 guardrails: bool = True):
+                 guardrails: bool = True, kv_layout: str = "auto",
+                 page_size: int = 16, num_pages: int = 0,
+                 prefill_chunk: int = 0, prefix_sharing: bool = True):
         self.family = (family_for(family) if isinstance(family, str)
                        else family)
         self.cfg = cfg
         self.num_slots = int(num_slots)
+        # ------------------------------------------------- cache layout
+        if kv_layout == "auto":
+            from ..kernels.decode_attention import decode_attn_impl
+            kv_layout = ("paged" if decode_attn_impl() == "paged"
+                         else "dense")
+        if kv_layout not in ("dense", "paged"):
+            raise ValueError(f"kv_layout {kv_layout!r} "
+                             "(auto|dense|paged)")
+        self.paged = kv_layout == "paged"
+        self.page_size = int(page_size)
+        self.prefill_chunk = int(prefill_chunk)
+        self.prefix_sharing = bool(prefix_sharing)
         # ------------------------------------------------ SLO guardrails
         if queue_policy not in ("reject", "shed_oldest"):
             raise ValueError(f"queue_policy {queue_policy!r} "
@@ -360,8 +607,25 @@ class ServingEngine:
         self.max_top_k = int(max_top_k)
         self.bucket_lo = int(bucket_lo)
         self._params = params
-        self._cache = self.family.init_cache(cfg, self.num_slots,
-                                             self.max_len)
+        if self.paged:
+            if self.page_size < 1:
+                raise ValueError(f"page_size must be >= 1; "
+                                 f"got {self.page_size}")
+            ps = self.page_size
+            self.max_pages = -(-self.max_len // ps)      # ceil
+            # dense-equivalent capacity by default (+1 scratch); a
+            # smaller num_pages is the capacity lever (bench_serving
+            # --capacity races paged vs dense at equal HBM)
+            self.num_pages = int(num_pages) or \
+                self.num_slots * self.max_pages + 1
+            self._pool = _PagePool(self.num_pages, ps)
+            self._ptab = np.zeros((self.num_slots, self.max_pages),
+                                  np.int32)
+            self._pt_dirty = False
+            self._cache = self._init_paged_cache()
+        else:
+            self._cache = self.family.init_cache(cfg, self.num_slots,
+                                                 self.max_len)
         self._base_key = jax.random.PRNGKey(seed)
 
         # at T=1 the layer scan's cache slice/restack dominates the
@@ -411,15 +675,29 @@ class ServingEngine:
         self._decode = jax.jit(
             functools.partial(_decode_tick, fwd=self.family.forward_cached,
                               cfg=run_cfg, max_top_k=self.max_top_k,
-                              guard=self.guardrails),
+                              guard=self.guardrails,
+                              oor_pos=(self.max_pages * self.page_size
+                                       if self.paged else None)),
             donate_argnums=(1, 2), static_argnames=("sampling",))
-        self._prefill = jax.jit(
-            functools.partial(_prefill_slot,
-                              fwd=self.family.forward_cached,
-                              init_cache=self.family.init_cache,
-                              cfg=run_cfg, max_top_k=self.max_top_k,
-                              guard=self.guardrails),
-            donate_argnums=(1,), static_argnames=("sampling",))
+        if self.paged:
+            self._prefill = jax.jit(
+                functools.partial(_prefill_chunk,
+                                  fwd=self.family.forward_cached,
+                                  cfg=run_cfg, max_top_k=self.max_top_k,
+                                  guard=self.guardrails),
+                donate_argnums=(1,), static_argnames=("sampling",))
+            self._cow = jax.jit(_cow_copy, donate_argnums=(0,))
+            self._slot_reserve = np.zeros(self.num_slots, np.int64)
+            self._prefilling: collections.deque = collections.deque()
+            self._raise_cow = False          # injected cow_raise fault
+        else:
+            self._prefill = jax.jit(
+                functools.partial(_prefill_slot,
+                                  fwd=self.family.forward_cached,
+                                  init_cache=self.family.init_cache,
+                                  cfg=run_cfg, max_top_k=self.max_top_k,
+                                  guard=self.guardrails),
+                donate_argnums=(1,), static_argnames=("sampling",))
 
         from ..profiler import flight_recorder
         self._flight = flight_recorder.recorder()
@@ -442,6 +720,42 @@ class ServingEngine:
             "poisoned": monitor.counter("serving.poisoned"),
             "evicted": monitor.counter("serving.evicted"),
         }
+        # kv-pool surface (stay 0 under the dense layout)
+        self._m_pages = monitor.gauge("serving.pages_in_use")
+        self._m_shared = monitor.gauge("serving.pages_shared")
+        self._m_cow = monitor.counter("serving.cow_copies")
+        self._m_chunks = monitor.counter("serving.prefill_chunks")
+
+    # -------------------------------------------------------- page pool
+    def _init_paged_cache(self):
+        """The paged pool buffers: {"k","v": [L, P, page_size, KV, hd]}
+        in the family's cache dtype (probed shape-only via eval_shape —
+        no dense allocation) + the device page table "pt"."""
+        probe = jax.eval_shape(
+            lambda: self.family.init_cache(self.cfg, 1, 1))
+        shp = probe["k"].shape                 # [L, 1, 1, KV, hd]
+        pages = (shp[0], self.num_pages, self.page_size) + shp[3:]
+        return {"k": jnp.zeros(pages, probe["k"].dtype),
+                "v": jnp.zeros(pages, probe["v"].dtype),
+                "pt": jnp.asarray(self._ptab)}
+
+    def pool_stats(self) -> dict:
+        """The kv-pool observable (paged layout only): page states,
+        shared/COW/chunk counters, and the HBM the pool holds vs what
+        the dense layout would."""
+        if not self.paged:
+            return {"layout": "dense"}
+        st = self._pool.stats()
+        st["layout"] = "paged"
+        st["cow_copies"] = self._m_cow.value
+        st["prefill_chunks"] = self._m_chunks.value
+        return st
+
+    def _publish_pool_gauges(self) -> None:
+        if not self.paged:
+            return
+        self._m_pages.set(int((self._pool.ref[1:] > 0).sum()))
+        self._m_shared.set(int((self._pool.ref[1:] > 1).sum()))
 
     # ------------------------------------------------------- observables
     def trace_counts(self):
@@ -452,7 +766,10 @@ class ServingEngine:
         return self._decode._cache_size(), self._prefill._cache_size()
 
     def has_work(self) -> bool:
-        return bool(self._queue) or bool(self._active.any())
+        # a slot mid-chunked-prefill holds a request but is not yet
+        # active for decode — still work
+        return (bool(self._queue) or bool(self._active.any())
+                or any(r is not None for r in self._slot_req))
 
     @property
     def active_requests(self):
@@ -490,6 +807,14 @@ class ServingEngine:
         if top_k > self.max_top_k:
             raise ValueError(f"top_k={top_k} exceeds the engine's "
                              f"static max_top_k={self.max_top_k}")
+        if self.paged:
+            need = self._pages_needed(t0, max_new_tokens)
+            if need > self.num_pages - 1:
+                raise PoolExhaustedError(
+                    f"request needs {need} pages worst-case but the "
+                    f"pool holds {self.num_pages - 1} allocatable "
+                    f"pages (page_size={self.page_size})",
+                    pages_needed=need, pages_total=self.num_pages - 1)
         if self.max_queue > 0 and len(self._queue) >= self.max_queue:
             if self.queue_policy == "shed_oldest":
                 self._finish(self._queue.popleft(), "evicted")
@@ -517,35 +842,51 @@ class ServingEngine:
     # --------------------------------------------------------- the tick
     def step(self):
         """One engine tick: expire queued requests past their TTL or
-        deadline, admit queued requests into free slots (one bucketed
-        prefill each, retried under the fault guard), advance all
-        active slots one token through the single jitted decode step
-        (quarantining poisoned rows), then enforce deadlines on the
-        survivors. Returns this tick's (request, token) emissions in
-        slot order."""
+        deadline, advance ONE mid-prefill slot by one chunk (the
+        chunked-prefill interleave), admit queued requests into free
+        slots (reserving their worst-case page need first under the
+        paged layout — a request that cannot reserve stays queued),
+        advance all active slots one token through the single jitted
+        decode step (quarantining poisoned rows), then enforce
+        deadlines on the survivors. Returns this tick's
+        (request, token) emissions in slot order."""
         events: List[tuple] = []
         actions = {}
         if _FAULT_HOOK is not None:
             actions = _FAULT_HOOK(self._ticks) or {}
+        if self.paged and actions.pop("raise_cow", None):
+            self._raise_cow = True
         now = time.perf_counter()
         self._expire_queued(now)
+        if self.paged:
+            self._advance_prefill(events, actions)
         while self._queue:
             slot = self._free_slot()
             if slot is None:
                 break
-            req = self._queue.popleft()
-            if self._deadline_expired(req, now):
-                self._finish(req, "timeout")
+            head = self._queue[0]
+            if self._deadline_expired(head, now):
+                self._queue.popleft()
+                self._finish(head, "timeout")
                 continue
-            self._admit_guarded(slot, req, events, actions)
+            if (self.paged
+                    and self._plan_admission(head)[4]
+                    > self._pool.available()):
+                break       # head-of-line waits for pages (FCFS); live
+                #             slots free pages as they finish
+            self._queue.popleft()
+            self._admit_guarded(slot, head, events, actions)
 
         if self._active.any():
             self._decode_guarded(events, actions)
-            self._enforce_deadlines(time.perf_counter())
+        # outside the decode branch: a slot mid-chunked-prefill must
+        # honor its deadline even when no stream is decoding yet
+        self._enforce_deadlines(time.perf_counter())
 
         self._ticks += 1
         self._m_occ.set(int(self._active.sum()))
         self._m_queue.set(len(self._queue))
+        self._publish_pool_gauges()
         return events
 
     def drain(self, max_ticks: Optional[int] = None):
@@ -615,7 +956,11 @@ class ServingEngine:
     def _clear_slot(self, slot: int) -> None:
         """Return a slot to the free pool: registry, every host mirror,
         and the device-state dirty flag (the ONE place a slot's mirrors
-        reset — _finish and _rollback_slot both route here)."""
+        reset — _finish and _rollback_slot both route here). Under the
+        paged layout this is also where the slot's pages release:
+        refcounts drop, registered pages park in the LRU cache, the
+        table row snaps back to scratch, and any un-spent admission
+        reservation returns to the pool."""
         self._slot_req[slot] = None
         self._active[slot] = False
         self._positions[slot] = 0
@@ -624,6 +969,18 @@ class ServingEngine:
         self._top_ks[slot] = 0
         self._gen_idx[slot] = 0
         self._dirty = True
+        if self.paged:
+            row = self._ptab[slot]
+            for j in np.nonzero(row)[0]:
+                self._pool.release(int(row[j]))
+            row[:] = 0
+            self._pool.reserved -= int(self._slot_reserve[slot])
+            self._slot_reserve[slot] = 0
+            self._pt_dirty = True
+            try:
+                self._prefilling.remove(slot)
+            except ValueError:
+                pass
 
     def _finish(self, req: Request, reason: str) -> None:
         """THE terminal transition: exactly-once by construction (a
@@ -703,10 +1060,13 @@ class ServingEngine:
 
     def _rollback_slot(self, slot: int, req: Request, n_tok: int) -> None:
         """Undo a partially-applied admission: host mirrors, the slot
-        registry and the request's token list return to their pre-admit
-        state, and the device mirror is marked stale."""
+        registry (and under the paged layout the slot's pages and
+        reservation) and the request's token list return to their
+        pre-admit state, and the device mirror is marked stale."""
         self._clear_slot(slot)
         req.slot = None
+        req._pf_next = None
+        req.shared_tokens = 0
         del req.tokens[n_tok:]
 
     def _cache_dead(self) -> bool:
@@ -729,8 +1089,17 @@ class ServingEngine:
         for req in list(self._slot_req):
             if req is not None:
                 self._finish(req, "evicted")
-        self._cache = self.family.init_cache(self.cfg, self.num_slots,
-                                             self.max_len)
+        if self.paged:
+            # prefix-map contents died with the buffers: fresh pool
+            self._pool = _PagePool(self.num_pages, self.page_size)
+            self._ptab[:] = 0
+            self._slot_reserve[:] = 0
+            self._prefilling.clear()
+            self._cache = self._init_paged_cache()
+            self._pt_dirty = False
+        else:
+            self._cache = self.family.init_cache(self.cfg, self.num_slots,
+                                                 self.max_len)
         self._dstate = None
         self._dirty = True
         self._flight.configure(last_serving_fault=f"hard_reset: {reason}")
@@ -816,6 +1185,14 @@ class ServingEngine:
             try:
                 if actions.pop("raise_decode", None):
                     raise ServingFaultError("injected decode fault")
+                if self.paged:
+                    # every active slot's write page must exist and be
+                    # private before the scatter (idempotent: a retry
+                    # finds them already allocated)
+                    self._prepare_tick_pages()
+                    if self._pt_dirty:
+                        self._cache["pt"] = jnp.asarray(self._ptab)
+                        self._pt_dirty = False
                 if self._dirty:
                     self._dstate = (
                         jnp.asarray(self._cur_tok),
@@ -894,6 +1271,8 @@ class ServingEngine:
         return None
 
     def _admit(self, slot: int, req: Request, events: list) -> None:
+        if self.paged:
+            return self._admit_paged(slot, req, events)
         t0 = len(req.prompt)
         tb = prompt_bucket(t0, self.max_len, self.bucket_lo)
         padded = np.zeros((1, tb), np.int32)
@@ -918,13 +1297,20 @@ class ServingEngine:
                 f"non-finite prefill logits (request {req.id})"))
             self._finish(req, "poisoned")
             return
+        self._activate_slot(slot, req, tok, events)
+
+    def _activate_slot(self, slot: int, req: Request, tok: int,
+                       events: list) -> None:
+        """Prefill complete: emit the first token, arm every host
+        mirror, and hand the slot to the decode tick (shared by the
+        dense admission and the paged final chunk)."""
         now = time.perf_counter()
         self._m_qwait.set((now - req.t_submit) * 1e3)
         self._slo_ttft.append((now - req.t_submit) * 1e3)
         req._t_last = now
         req.slot = slot
         self._slot_req[slot] = req
-        self._positions[slot] = t0
+        self._positions[slot] = len(req.prompt)
         self._active[slot] = True
         self._cur_tok[slot] = tok
         self._temps[slot] = req.temperature
@@ -936,6 +1322,231 @@ class ServingEngine:
         events.append((req, tok))
         self._m_tok.add()
         self._maybe_finish(req)
+
+    # ------------------------------------------------- paged scheduling
+    def _pages_needed(self, t0: int, max_new: int) -> int:
+        """Worst-case page envelope for one request: positions
+        0 .. t0 + max_new - 2 get written (the final sampled token
+        never is), so ceil((t0 + max_new - 1) / page_size)."""
+        return -(-(t0 + max_new - 1) // self.page_size)
+
+    def _plan_admission(self, req: Request):
+        """The admission plan: (matched shared page ids, aligned_full,
+        suffix_start, need, gross). `need` is the worst-case pages the
+        request will still allocate privately (envelope minus
+        kept-shared credit); `gross` additionally counts cached pages
+        the match pulls back live — they stop being evictable for
+        other admissions' reservations the moment we retain them. The
+        suffix always re-runs >= 1 prompt token (the first-token
+        logits must be computed), so a fully page-aligned match COWs
+        its last matched page (aligned_full) and recomputes the last
+        prompt token into the private copy."""
+        t0 = len(req.prompt)
+        ps = self.page_size
+        matched: List[int] = []
+        if self.prefix_sharing:
+            for key in self._prefix_keys(req):
+                pid = self._pool.lookup(key)
+                if pid is None:
+                    break
+                matched.append(pid)
+        aligned_full = (bool(matched) and len(matched) == t0 // ps
+                        and t0 % ps == 0)
+        suffix_start = (t0 - 1) if aligned_full else len(matched) * ps
+        shared_keep = len(matched) - (1 if aligned_full else 0)
+        need = self._pages_needed(t0, req.max_new_tokens) - shared_keep
+        gross = need + sum(1 for pid in matched
+                           if self._pool.ref[pid] == 0)
+        if gross > self.num_pages - 1:
+            # an aligned-full match costs one page over the bare
+            # envelope (the COW of its last matched page); in a pool
+            # sized exactly to the envelope that can NEVER be
+            # satisfied and the request would queue forever — admit
+            # unshared instead (submit() guaranteed the envelope fits)
+            matched, aligned_full, suffix_start = [], False, 0
+            need = gross = self._pages_needed(t0, req.max_new_tokens)
+        return matched, aligned_full, suffix_start, need, gross
+
+    def _prefix_keys(self, req: Request):
+        """The request's per-page rolled prefix hashes, memoized on the
+        Request (the prompt is immutable) — the head-of-line plan runs
+        every tick while it waits for pages, and must not re-hash
+        O(len(prompt)^2 / page_size) bytes each time."""
+        if req._pfx_keys is None:
+            ps = self.page_size
+            req._pfx_keys = [
+                _prefix_key(req.prompt, (j + 1) * ps)
+                for j in range(len(req.prompt) // ps)]
+        return req._pfx_keys
+
+    def _admit_paged(self, slot: int, req: Request, events: list) -> None:
+        """Paged admission: map the shared prompt-prefix pages (bumping
+        refcounts), reserve the worst-case remainder, then prefill the
+        un-shared suffix — inline when it fits one chunk, otherwise one
+        chunk per tick through `_advance_prefill`. The caller
+        (`step()`) already checked the reservation fits."""
+        matched, aligned_full, suffix_start, need, _ = \
+            self._plan_admission(req)
+        self._pool.reserved += need
+        self._slot_reserve[slot] = need
+        for j, pid in enumerate(matched):
+            self._pool.retain(pid)
+            self._ptab[slot, j] = pid
+        if matched:
+            self._pt_dirty = True
+        req.slot = slot
+        self._slot_req[slot] = req
+        req.shared_tokens = suffix_start
+        req._pf_next = suffix_start
+        if aligned_full:
+            # the suffix rewrites the last prompt token's K/V into the
+            # last matched page — materialize a private copy first
+            self._ensure_private(slot, (len(req.prompt) - 1)
+                                 // self.page_size)
+        t0 = len(req.prompt)
+        if self.prefill_chunk <= 0 or t0 - suffix_start <= \
+                self.prefill_chunk:
+            self._run_chunk(slot, req, events)
+        else:
+            self._prefilling.append(slot)
+
+    def _run_chunk(self, slot: int, req: Request, events: list) -> None:
+        """One prefill chunk for `slot`: allocate/privatize the pages
+        its real tokens land in, run the jitted paged chunk prefill,
+        and — on the prompt's FINAL chunk — pull the first token,
+        register the full prompt pages for future sharers, and
+        activate the slot. Non-final chunks make no host pull."""
+        t0 = len(req.prompt)
+        ps = self.page_size
+        start = req._pf_next
+        end = (t0 if self.prefill_chunk <= 0
+               else min(start + self.prefill_chunk, t0))
+        clen = end - start
+        for j in range(start // ps, (end - 1) // ps + 1):
+            self._ensure_private(slot, j)
+        cb = prompt_bucket(clen, self.max_len, self.bucket_lo)
+        padded = np.zeros((1, cb), np.int32)
+        padded[0, :clen] = req.prompt[start:end]
+        if self._pt_dirty:
+            self._cache["pt"] = jnp.asarray(self._ptab)
+            self._pt_dirty = False
+        final = end == t0
+        with RecordEvent("serving.prefill"):
+            first, self._cache = self._prefill(
+                self._params, self._cache, jnp.asarray(padded),
+                jnp.asarray(clen, jnp.int32),
+                jnp.asarray(start, jnp.int32),
+                jnp.asarray(slot, jnp.int32),
+                jnp.asarray([req.temperature], jnp.float32),
+                jnp.asarray([req.top_k], jnp.int32),
+                jnp.asarray([req.id], jnp.int32), self._base_key,
+                sampling=final and req.temperature > 0.0)
+            tok = int(self._pull(first)) if final else None
+        self._m_chunks.add()
+        if not final:
+            req._pf_next = end
+            return
+        req._pf_next = None
+        self._m_pre.add()
+        if tok < 0:
+            # prefill quarantine BEFORE registration: a poisoned
+            # prompt's pages are never published to the prefix map
+            self._on_fault("poisoned", RuntimeError(
+                f"non-finite prefill logits (request {req.id})"))
+            self._finish(req, "poisoned")
+            return
+        if self.prefix_sharing:
+            for j, key in enumerate(self._prefix_keys(req)):
+                self._pool.register(int(self._ptab[slot, j]), key)
+        self._activate_slot(slot, req, tok, events)
+
+    def _advance_prefill(self, events: list, actions: dict) -> None:
+        """The chunked-prefill interleave: at most ONE chunk runs per
+        tick (FCFS across mid-prefill slots), so co-batched decode
+        streams pay at most one chunk of latency per token no matter
+        how long a joining prompt is."""
+        while self._prefilling:
+            slot = self._prefilling[0]
+            req = self._slot_req[slot]
+            if req is None or req.done or req._pf_next is None:
+                self._prefilling.popleft()     # evicted/cancelled
+                continue
+            self._chunk_guarded(slot, req, events, actions)
+            if req.done or req._pf_next is None:
+                if self._prefilling and self._prefilling[0] == slot:
+                    self._prefilling.popleft()
+            return
+
+    def _chunk_guarded(self, slot: int, req: Request, events: list,
+                       actions: dict) -> None:
+        """One chunk under the fault guard. A chunk re-run is
+        idempotent (the same pages re-scatter the same K/V), so a
+        raising device call just retries with backoff; an exhausted
+        budget evicts the request (its pages free via _clear_slot) and
+        a hung pull / dead donated cache hard-resets."""
+        from ..parallel.resilience import StepHungError
+        for attempt in range(self.retries + 1):
+            try:
+                if actions.pop("raise_prefill", None):
+                    raise ServingFaultError("injected prefill fault")
+                self._run_chunk(slot, req, events)
+                return
+            except StepHungError as e:
+                self._on_fault("prefill_hang", e)
+                self._finish(req, "evicted")
+                self._hard_reset("prefill watchdog hang")
+                return
+            except Exception as e:                 # noqa: BLE001
+                self._on_fault("prefill", e)
+                dead = self._cache_dead()
+                if dead or attempt >= self.retries:
+                    self._finish(req, "evicted")
+                    if dead:
+                        self._hard_reset("prefill lost the donated cache")
+                    return
+                self._backoff(attempt)
+
+    def _alloc_slot_page(self, slot: int, j: int) -> int:
+        """Allocate a private page for table entry (slot, j),
+        consuming the slot's admission reservation when one remains."""
+        pid = self._pool.alloc()
+        if self._slot_reserve[slot] > 0:
+            self._slot_reserve[slot] -= 1
+            self._pool.reserved -= 1
+        self._ptab[slot, j] = pid
+        self._pt_dirty = True
+        return pid
+
+    def _ensure_private(self, slot: int, j: int) -> int:
+        """THE copy-on-write seam: make table entry (slot, j) safe to
+        write. Unmapped -> allocate; mapped but frozen (shared refcount
+        or prefix-registered) -> allocate a fresh page, jitted-copy the
+        frozen page's contents into it, swap the table entry, and drop
+        the reference; already private -> no-op."""
+        pid = int(self._ptab[slot, j])
+        if pid != 0 and not self._pool.is_frozen(pid):
+            return pid
+        if pid != 0 and self._raise_cow:
+            self._raise_cow = False
+            raise ServingFaultError("injected cow fault")
+        new = self._alloc_slot_page(slot, j)
+        if pid != 0:
+            self._cache = self._cow(self._cache,
+                                    jnp.asarray(pid, jnp.int32),
+                                    jnp.asarray(new, jnp.int32))
+            self._pool.release(pid)
+            self._m_cow.add()
+        return new
+
+    def _prepare_tick_pages(self) -> None:
+        """Paged pre-tick: every active slot's write page (where its
+        position lands this tick) must exist and be private before the
+        jitted scatter runs. Allocation draws on the slot's admission
+        reservation, so it cannot fail mid-decode."""
+        for i in np.nonzero(self._active)[0]:
+            j = int(self._positions[i]) // self.page_size
+            if j < self.max_pages:
+                self._ensure_private(int(i), j)
 
     def _maybe_finish(self, req: Request) -> None:
         slot = req.slot

@@ -27,7 +27,18 @@ registry winner > default, same precedence as flash_attention._attn_impl):
   bit-parity guarantee against per-request greedy decode);
 - 'mixed'  QK^T and P·V run in the cache dtype with an f32 softmax —
   halves decode HBM traffic for bf16 caches; opt in per backend via
-  the registry or PADDLE_TPU_DECODE_ATTN_IMPL.
+  the registry or PADDLE_TPU_DECODE_ATTN_IMPL;
+- 'paged'  the serving engine's block-pool cache layout (vLLM's
+  PagedAttention, SOSP '23): K/V live in fixed-size pages
+  [P, page_size, KV, hd] shared by every slot, and a per-slot page
+  table [B, max_pages] maps logical cache positions to physical
+  pages. `gather_pages` re-linearizes a slot's view (logical position
+  p lands at view index p, so the attention math — and therefore the
+  token stream — is BIT-IDENTICAL to 'dense'); `write_kv_paged`
+  scatters the step's K/V through the table. The selector only
+  changes the CACHE LAYOUT the serving engine allocates; the
+  attention math of a gathered view is 'dense' (attn_math_impl).
+  Kill switch: PADDLE_TPU_DECODE_ATTN_IMPL=dense.
 """
 from __future__ import annotations
 
@@ -37,7 +48,8 @@ import os
 import jax
 import jax.numpy as jnp
 
-__all__ = ["write_kv", "cached_attention", "decode_attn_impl"]
+__all__ = ["write_kv", "cached_attention", "decode_attn_impl",
+           "gather_pages", "write_kv_paged", "attn_math_impl"]
 
 
 def decode_attn_impl() -> str:
@@ -52,6 +64,53 @@ def decode_attn_impl() -> str:
                           backend=registry.backend_class(
                               jax.default_backend()))
     return win or "dense"
+
+
+def attn_math_impl(impl: str | None = None) -> str:
+    """The attention-math flavor for a given selector: 'paged' is a
+    cache LAYOUT — its gathered per-slot view runs the 'dense' f32
+    math (bit-parity with the dense pool is the whole point)."""
+    impl = impl or decode_attn_impl()
+    return "dense" if impl == "paged" else impl
+
+
+def gather_pages(pages, table):
+    """Re-linearize per-slot cache views from the page pool.
+
+    pages [P, page_size, KV, hd]; table [B, max_pages] int32 of
+    physical page ids. -> [B, max_pages * page_size, KV, hd] where
+    view index p holds the K/V written at logical position p (page
+    p // page_size at offset p % page_size) — so `cached_attention`
+    over the view is bit-identical to the dense [B, S, ...] cache.
+    Unmapped table entries point at the reserved scratch page 0; the
+    position mask keeps its garbage at an exact softmax 0."""
+    B, mp = table.shape
+    ps = pages.shape[1]
+    v = jnp.take(pages, table.reshape(-1), axis=0)     # [B*mp, ps, KV, hd]
+    return v.reshape(B, mp * ps, *pages.shape[2:])
+
+
+def write_kv_paged(pages, table, k, pos):
+    """Scatter the step's k (or v) [B, T, KV, hd] into the page pool
+    [P, page_size, KV, hd] through the per-slot table [B, max_pages].
+    Token t of row b sits at logical position pos(+t) -> physical
+    (table[b, p // ps], p % ps). Rows whose table maps to the scratch
+    page (freed slots, positions past a slot's allocation) write
+    garbage there — never attended. The scatter is the paged analog of
+    write_kv's dynamic_update_slice: XLA keeps it in-place on the
+    donated pool buffer."""
+    B, T = k.shape[:2]
+    ps = pages.shape[1]
+    qpos = _query_positions(pos, B, T)                 # [B, T]
+    raw_idx = qpos // ps
+    page_idx = jnp.clip(raw_idx, 0, table.shape[1] - 1)
+    page_id = jnp.take_along_axis(table, page_idx, axis=1)      # [B, T]
+    # positions past the table (bucket pad beyond max_len) go to the
+    # scratch page, never clamp onto a real tail page
+    page_id = jnp.where(raw_idx < table.shape[1], page_id, 0)
+    off = qpos % ps
+    upd = k.astype(pages.dtype).reshape(B * T, *k.shape[2:])
+    return pages.at[page_id.reshape(-1), off.reshape(-1)].set(upd)
 
 
 def write_kv(kc, k, pos):
@@ -89,10 +148,10 @@ def cached_attention(q, kc, vc, pos, impl: str | None = None):
     B, T, H, hd = q.shape
     S, KV = kc.shape[1], kc.shape[2]
     G = H // KV
-    impl = impl or decode_attn_impl()
+    impl = attn_math_impl(impl)
     if impl not in ("dense", "mixed"):
         raise ValueError(
-            f"unknown decode_attention impl {impl!r} (dense|mixed)")
+            f"unknown decode_attention impl {impl!r} (dense|mixed|paged)")
     dot_dt = kc.dtype if impl == "mixed" else jnp.float32
     scale = 1.0 / math.sqrt(hd)
 

@@ -596,14 +596,18 @@ def init_kv_cache(cfg: GPTConfig, batch: int, max_len: int):
             "v": jnp.zeros(shape, cfg.dtype)}
 
 
-def _cached_attention(x, params_l, kc, vc, pos, cfg):
+def _cached_attention(x, params_l, kc, vc, pos, cfg, pt=None):
     """One block's attention with cache update. x [B,T,D]; kc/vc
-    [B,max_len,H,hd]; pos = number of tokens already in the cache — a
-    scalar (whole-batch decode) or a [B] vector of per-row positions
-    (the serving engine's slot pool, where every slot advances
+    [B,max_len,H,hd] (dense) or [P,page_size,H,hd] pages with the
+    per-slot page table `pt` [B,max_pages] (the serving engine's paged
+    pool); pos = number of tokens already in the cache — a scalar
+    (whole-batch decode) or a [B] vector of per-row positions (the
+    serving engine's slot pool, where every slot advances
     independently). Returns (attn_out, kc, vc). The cache write and the
     masked attention go through the selectable decode-attention seam
-    (kernels/decode_attention.py; registry kernel 'decode_attention')."""
+    (kernels/decode_attention.py; registry kernel 'decode_attention');
+    the paged path scatters the write through the table and attends a
+    gathered per-slot view — bit-identical to the dense layout."""
     B, T, D = x.shape
     H, hd = cfg.num_heads, cfg.head_dim
     qkv = jnp.einsum("bsd,df->bsf", x, params_l["qkv_w"].astype(x.dtype))
@@ -613,10 +617,17 @@ def _cached_attention(x, params_l, kc, vc, pos, cfg):
     q = q.reshape(B, T, H, hd)
     k = k.reshape(B, T, H, hd)
     v = v.reshape(B, T, H, hd)
-    from ..kernels.decode_attention import cached_attention, write_kv
-    kc = write_kv(kc, k, pos)
-    vc = write_kv(vc, v, pos)
-    ctx = cached_attention(q, kc, vc, pos)
+    from ..kernels.decode_attention import (cached_attention, gather_pages,
+                                            write_kv, write_kv_paged)
+    if pt is None:
+        kc = write_kv(kc, k, pos)
+        vc = write_kv(vc, v, pos)
+        ctx = cached_attention(q, kc, vc, pos)
+    else:
+        kc = write_kv_paged(kc, pt, k, pos)
+        vc = write_kv_paged(vc, pt, v, pos)
+        ctx = cached_attention(q, gather_pages(kc, pt),
+                               gather_pages(vc, pt), pos)
     ctx = ctx.reshape(B, T, D).astype(x.dtype)
     out = jnp.einsum("bsd,df->bsf", ctx,
                      params_l["attn_out_w"].astype(x.dtype))
@@ -634,15 +645,28 @@ def gpt_forward_cached(params, tokens, cache, pos, cfg: GPTConfig):
     inference). `pos` may be a traced scalar (whole-batch decode; the
     bucketed models/decode.py driver passes the true prompt length) or a
     [B] vector of per-row slot positions (inference/serving.py: each
-    slot holds its own request mid-stream)."""
+    slot holds its own request mid-stream).
+
+    Cache layouts: dense {"k","v": [L, B, max_len, H, hd]} or the
+    serving engine's paged pool {"k","v": [L, P, page_size, H, hd],
+    "pt": [B, max_pages]} — the page table rides the cache dict and is
+    returned unchanged; the per-layer write/attend goes through the
+    paged seam (kernels/decode_attention.py) and is bit-identical to
+    the dense layout."""
     B, T = tokens.shape
+    pt = cache.get("pt")
     x = jnp.take(params["wte"], tokens, axis=0).astype(cfg.dtype)
     if jnp.ndim(pos) == 0:
         wpe = jax.lax.dynamic_slice_in_dim(params["wpe"], pos, T,
                                            axis=0)[None]
     else:
+        # mode="clip": the serving decode tick parks inactive rows at
+        # an out-of-table sentinel position (their K/V scatters to the
+        # scratch page); the default "fill" would embed them as NaN,
+        # and NaN written to scratch poisons every later gather of it
         wpe = jnp.take(params["wpe"],
-                       pos[:, None] + jnp.arange(T), axis=0)
+                       pos[:, None] + jnp.arange(T), axis=0,
+                       mode="clip")
     x = x + wpe.astype(cfg.dtype)
 
     block_keys = _BLOCK_KEYS_MOE if cfg.num_experts > 0 else _BLOCK_KEYS_DENSE
@@ -653,7 +677,8 @@ def gpt_forward_cached(params, tokens, cache, pos, cfg: GPTConfig):
         h = x
         a_in = _ln(h, params_l["ln1_scale"], params_l["ln1_bias"],
                    cfg.layer_norm_eps)
-        a, kc, vc = _cached_attention(a_in, params_l, kc, vc, pos, cfg)
+        a, kc, vc = _cached_attention(a_in, params_l, kc, vc, pos, cfg,
+                                      pt=pt)
         h = h + a
         m_in = _ln(h, params_l["ln2_scale"], params_l["ln2_bias"],
                    cfg.layer_norm_eps)
@@ -675,7 +700,10 @@ def gpt_forward_cached(params, tokens, cache, pos, cfg: GPTConfig):
                                                 1))
     x = _ln(x, params["ln_f_scale"], params["ln_f_bias"], cfg.layer_norm_eps)
     logits = jnp.einsum("bsd,vd->bsv", x, params["wte"].astype(x.dtype))
-    return logits, {"k": kcs, "v": vcs}
+    out = {"k": kcs, "v": vcs}
+    if pt is not None:
+        out["pt"] = pt
+    return logits, out
 
 
 def greedy_generate(params, prompt, cfg: GPTConfig, max_new_tokens: int,

@@ -242,22 +242,35 @@ def llama_forward_cached(params, tokens, cache, pos, cfg: LlamaConfig):
     slot positions (inference/serving.py). The cache write and the
     grouped masked attention (KV heads in the cache, never-materialized
     query groups — the GQA decode-bandwidth payoff) go through the
-    selectable seam in kernels/decode_attention.py."""
+    selectable seam in kernels/decode_attention.py. Cache layouts:
+    dense {"k","v": [L, B, max_len, KV, hd]} or the serving engine's
+    paged pool {"k","v": [L, P, page_size, KV, hd], "pt":
+    [B, max_pages]} — same contract as models/gpt.py, bit-identical
+    across layouts."""
     B, T = tokens.shape
+    pt = cache.get("pt")
     H, KV, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     x = jnp.take(params["wte"], tokens, axis=0).astype(cfg.dtype)
-    cos_full, sin_full = _rope_tables(cache["k"].shape[2], hd,
-                                      cfg.rope_theta)
+    # rope positions span the logical cache: dense = the cache axis,
+    # paged = max_pages * page_size (the re-linearized view length)
+    s_cache = (cache["k"].shape[2] if pt is None
+               else pt.shape[1] * cache["k"].shape[2])
+    cos_full, sin_full = _rope_tables(s_cache, hd, cfg.rope_theta)
     if jnp.ndim(pos) == 0:
         cos = jax.lax.dynamic_slice_in_dim(cos_full, pos, T, axis=0)
         sin = jax.lax.dynamic_slice_in_dim(sin_full, pos, T, axis=0)
     else:
+        # mode="clip": the serving decode tick parks inactive rows at
+        # an out-of-table sentinel position (their K/V scatters to the
+        # scratch page); the default "fill" would rope them to NaN,
+        # and NaN written to scratch poisons every later gather of it
         idx = pos[:, None] + jnp.arange(T)
-        cos = jnp.take(cos_full, idx, axis=0)        # [B, T, hd/2]
-        sin = jnp.take(sin_full, idx, axis=0)
+        cos = jnp.take(cos_full, idx, axis=0, mode="clip")  # [B,T,hd/2]
+        sin = jnp.take(sin_full, idx, axis=0, mode="clip")
 
     stacked = {k: params[k] for k in _BLOCK_KEYS}
-    from ..kernels.decode_attention import cached_attention, write_kv
+    from ..kernels.decode_attention import (cached_attention, gather_pages,
+                                            write_kv, write_kv_paged)
 
     def scan_fn(x, layer_in):
         lp, kc, vc = layer_in
@@ -267,9 +280,15 @@ def llama_forward_cached(params, tokens, cache, pos, cfg: LlamaConfig):
         v = (h @ lp["v_w"].astype(h.dtype)).reshape(B, T, KV, hd)
         q = _apply_rope(q, cos, sin)
         k = _apply_rope(k, cos, sin)
-        kc = write_kv(kc, k, pos)
-        vc = write_kv(vc, v, pos)
-        ctx = cached_attention(q, kc, vc, pos)
+        if pt is None:
+            kc = write_kv(kc, k, pos)
+            vc = write_kv(vc, v, pos)
+            ctx = cached_attention(q, kc, vc, pos)
+        else:
+            kc = write_kv_paged(kc, pt, k, pos)
+            vc = write_kv_paged(vc, pt, v, pos)
+            ctx = cached_attention(q, gather_pages(kc, pt),
+                                   gather_pages(vc, pt), pos)
         ctx = ctx.reshape(B, T, H * hd).astype(x.dtype)
         x = x + ctx @ lp["o_w"].astype(x.dtype)
         h = _rmsnorm(x, lp["ffn_norm"], cfg.rms_eps)
@@ -283,7 +302,10 @@ def llama_forward_cached(params, tokens, cache, pos, cfg: LlamaConfig):
                                                 1))
     x = _rmsnorm(x, params["norm_f"], cfg.rms_eps)
     logits = jnp.einsum("bsd,vd->bsv", x, params["wte"].astype(x.dtype))
-    return logits, {"k": kcs, "v": vcs}
+    out = {"k": kcs, "v": vcs}
+    if pt is not None:
+        out["pt"] = pt
+    return logits, out
 
 
 def greedy_generate(params, prompt, cfg: LlamaConfig,

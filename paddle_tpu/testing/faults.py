@@ -43,9 +43,16 @@ tools/chaos_serving.py):
                           milliseconds at tick T (inside the watchdog
                           clock — exercises the budget/backoff path).
 - ``prefill_raise@T``   — raise at the prefill device-call seam on
-                          tick T (the admission retry/rollback path).
+                          tick T (the admission retry/rollback path —
+                          under the paged engine this is also the
+                          chunked-prefill retry path).
 - ``decode_raise@T``    — raise at the decode device-call seam on
                           tick T (the resync-from-mirrors retry path).
+- ``cow_raise@T``       — raise at the copy-on-write page-copy seam
+                          (paged KV engine `_ensure_private`) the next
+                          time a COW fires at/after tick T — the
+                          admission rollback must release the shared
+                          pages it retained.
 
 File corruptors (`truncate_shard` / `bitflip_shard` / `remove_shard`)
 damage committed checkpoints in place for restore-fallback tests; they
@@ -68,9 +75,11 @@ ENV_ONCE_DIR = "PADDLE_TPU_FAULTS_ONCE_DIR"
 KILL_EXIT = 37
 
 _KINDS = ("kill", "crash_shard", "nan", "hb_stale", "elastic_exit",
-          "nan_logits", "tick_stall", "prefill_raise", "decode_raise")
+          "nan_logits", "tick_stall", "prefill_raise", "decode_raise",
+          "cow_raise")
 _SERVING_KINDS = frozenset(
-    {"nan_logits", "tick_stall", "prefill_raise", "decode_raise"})
+    {"nan_logits", "tick_stall", "prefill_raise", "decode_raise",
+     "cow_raise"})
 
 
 @dataclass
@@ -190,8 +199,8 @@ class FaultPlan:
     def on_serving_tick(self, tick: int) -> dict:
         """serving._FAULT_HOOK: called with the engine tick about to
         run; returns the action dict the engine applies this tick
-        (keys: poison_slot, stall_s, raise_prefill, raise_decode).
-        Each fault fires at most once (marker scheme)."""
+        (keys: poison_slot, stall_s, raise_prefill, raise_decode,
+        raise_cow). Each fault fires at most once (marker scheme)."""
         actions: dict = {}
         for f in self.faults:
             if f.done or f.kind not in _SERVING_KINDS or tick < f.step:
@@ -207,6 +216,8 @@ class FaultPlan:
                 actions["raise_prefill"] = True
             elif f.kind == "decode_raise":
                 actions["raise_decode"] = True
+            elif f.kind == "cow_raise":
+                actions["raise_cow"] = True
         return actions
 
 

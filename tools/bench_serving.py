@@ -22,11 +22,21 @@ the real serving cost, not an artifact.
 Usage:
   python tools/bench_serving.py                # acceptance workload
   python tools/bench_serving.py --requests 32 --gen 64 --slots 16
+  python tools/bench_serving.py --capacity     # paged-vs-dense @ equal HBM
   PADDLE_TPU_TELEMETRY_JSONL=serve.jsonl python tools/bench_serving.py
 
 The default workload is the BASELINE.md "Serving" row: 16 requests,
 prompt lengths uniform in [8, 96], 32 generated tokens each, GPT
 2L x 128d, greedy.
+
+--capacity is the paged-KV acceptance bench (BASELINE.md "Serving
+capacity"): at a FIXED page budget (the HBM of a --slots dense pool)
+it measures (a) max concurrent streams and aggregate tokens/s for the
+paged engine vs the dense engine on a shared-prefix workload (N
+streams behind one long system prompt — the "millions of users" shape)
+and (b) the kv-pool reuse stats (shared pages, shared prompt tokens,
+COW copies). Streams must stay bit-identical to dense and post-warmup
+recompiles zero.
 """
 from __future__ import annotations
 
@@ -75,6 +85,189 @@ def run_sequential(params, cfg, prompts, gen, max_len, greedy_generate):
     return time.perf_counter() - t0, outs
 
 
+def _drain_tracking_streams(eng):
+    """Drain the engine, tracking the peak number of co-resident
+    requests (active + mid-prefill slots) — the concurrency the pool
+    actually sustained."""
+    peak = 0
+    while eng.has_work():
+        eng.step()
+        live = sum(1 for r in eng._slot_req if r is not None)
+        peak = max(peak, live)
+    return peak
+
+
+def capacity_main(args):
+    """--capacity: paged vs dense at EQUAL KV HBM on a shared-prefix
+    workload. The page budget is what a dense pool of --slots slots
+    occupies; the paged engine gets the same bytes and as many slots
+    as requests. One JSON line."""
+    from paddle_tpu.models.decode import next_pow2
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.models.gpt import (GPTConfig, init_gpt_params)
+
+    gen = args.gen
+    sys_len, tail_lo, tail_hi = 96, 4, 12
+    n_req = args.requests
+    max_len = args.max_len or next_pow2(sys_len + tail_hi + gen)
+    page_size = 16
+    cfg = GPTConfig(vocab_size=args.vocab, hidden_size=args.hidden,
+                    num_layers=args.layers,
+                    num_heads=max(args.hidden // 32, 1),
+                    max_seq_len=2 * max_len, sequence_parallel=False,
+                    remat=False, dtype=jnp.float32)
+    params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+
+    rng = np.random.RandomState(0)
+    system = rng.randint(0, args.vocab, sys_len).astype(np.int32)
+    prompts = [np.concatenate([
+        system, rng.randint(0, args.vocab,
+                            rng.randint(tail_lo, tail_hi + 1))
+        .astype(np.int32)]) for _ in range(n_req)]
+    total_tokens = n_req * gen
+
+    # equal-HBM budget: the dense pool's pages (+1 scratch page, the
+    # paged layout's only fixed overhead)
+    budget = args.slots * (max_len // page_size) + 1
+    _log(f"capacity workload: {n_req} reqs, system prompt {sys_len} + "
+         f"tail {tail_lo}-{tail_hi}, gen {gen}, page budget {budget} "
+         f"pages x {page_size} (= {args.slots} dense slots @ "
+         f"max_len {max_len})")
+
+    def run(eng):
+        reqs = [eng.submit(p, gen) for p in prompts]
+        peak = _drain_tracking_streams(eng)
+        outs = [np.asarray(r.tokens, np.int32) for r in reqs]
+        return peak, outs
+
+    # dense at the budget: exactly --slots concurrent streams fit
+    dense = ServingEngine(params, cfg, family=args.family,
+                          num_slots=args.slots, max_len=max_len)
+    run(dense)                                     # warm
+    t0 = time.perf_counter()
+    dense_peak, dense_outs = run(dense)
+    dense_s = time.perf_counter() - t0
+    dense_traces = dense.trace_counts()
+
+    # paged at the SAME budget: slots are no longer the capacity
+    # limit — the pool is
+    paged = ServingEngine(params, cfg, family=args.family,
+                          num_slots=n_req, max_len=max_len,
+                          kv_layout="paged", page_size=page_size,
+                          num_pages=budget, prefill_chunk=64)
+    run(paged)                                     # warm
+    traces_warm = paged.trace_counts()
+    t0 = time.perf_counter()
+    paged_peak, paged_outs = run(paged)
+    paged_s = time.perf_counter() - t0
+    traces_after = paged.trace_counts()
+    pool = paged.pool_stats()
+
+    mismatches = sum(1 for a, b in zip(dense_outs, paged_outs)
+                     if not np.array_equal(a, b))
+    dense_tps = total_tokens / dense_s
+    paged_tps = total_tokens / paged_s
+    print(json.dumps({
+        "metric": "serving_capacity_streams",
+        "value": paged_peak,
+        "unit": "concurrent streams @ equal KV HBM",
+        "backend": jax.devices()[0].platform,
+        "dense_streams": dense_peak,
+        "capacity_ratio": round(paged_peak / max(dense_peak, 1), 2),
+        "paged_tokens_per_sec": round(paged_tps, 1),
+        "dense_tokens_per_sec": round(dense_tps, 1),
+        "throughput_ratio": round(paged_tps / dense_tps, 2),
+        "page_budget": budget, "page_size": page_size,
+        "requests": n_req, "gen": gen,
+        "system_prompt": sys_len,
+        "model": f"{args.layers}Lx{args.hidden}d",
+        "family": args.family, "max_len": max_len,
+        "recompiles_after_warmup": [
+            traces_after[0] - traces_warm[0],
+            traces_after[1] - traces_warm[1]],
+        "stream_mismatches": mismatches,
+        "pool": pool,
+    }), flush=True)
+    ok = (mismatches == 0 and paged_peak >= 2 * dense_peak
+          and traces_after == traces_warm)
+    return 0 if ok else 1
+
+
+def chunk_slo_main(args):
+    """--chunk-slo: the chunked-prefill SLO acceptance (BASELINE.md
+    "Serving capacity"): inter-token latency percentiles of co-batched
+    decode streams WHILE a near-max-length prompt joins mid-decode,
+    monolithic suffix prefill vs chunked. The p99/max gap is the stall
+    the interleave removes. One JSON line."""
+    from paddle_tpu.models.decode import next_pow2
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.models.gpt import GPTConfig, init_gpt_params
+
+    gen = args.gen
+    # defaults scaled UP vs the throughput bench: the stall only shows
+    # when a monolithic prefill (quadratic in prompt length) costs many
+    # decode ticks — a 2L x 128d model prefills 1k tokens in ~2 ticks
+    max_len = args.max_len or max(next_pow2(96 + gen), 2048)
+    hidden = args.hidden if args.hidden != 128 else 512
+    layers = args.layers
+    long_len = max_len - gen - 1            # near-max-length joiner
+    cfg = GPTConfig(vocab_size=args.vocab, hidden_size=hidden,
+                    num_layers=layers,
+                    num_heads=max(hidden // 32, 1),
+                    max_seq_len=2 * max_len, sequence_parallel=False,
+                    remat=False, dtype=jnp.float32)
+    params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    short = [rng.randint(0, args.vocab, L).astype(np.int32)
+             for L in rng.randint(8, 24, 3)]
+    long_p = rng.randint(0, args.vocab, long_len).astype(np.int32)
+
+    def run(chunk):
+        # sharing OFF: the warm pass would otherwise cache the long
+        # prompt's pages and the measured join would prefill ~nothing
+        # (the right behavior in production, but this mode measures
+        # the chunking of a REAL prefill)
+        eng = ServingEngine(params, cfg, family=args.family,
+                            num_slots=4, max_len=max_len,
+                            kv_layout="paged", page_size=16,
+                            prefill_chunk=chunk, prefix_sharing=False)
+        eng.generate(short + [long_p], 4)          # warm every bucket
+        srt = [eng.submit(p, gen) for p in short]
+        for _ in range(4):                         # streams mid-decode
+            eng.step()
+        # measure the co-batched streams' inter-token latency INSIDE
+        # the joiner's prefill window (submit -> its first token) —
+        # the stall chunking bounds; steady-state ticks outside the
+        # window would drown it
+        eng._slo_itl.clear()
+        lr = eng.submit(long_p, 4)
+        while not lr.tokens and not lr.done and eng.has_work():
+            eng.step()
+        itl = sorted(eng.slo_snapshot()["itl_ms"])
+        eng.drain()
+        import math as m
+        pct = lambda q: itl[max(0, m.ceil(q / 100 * len(itl)) - 1)]  # noqa: E731
+        return ({"p50_ms": round(pct(50), 2), "p99_ms": round(pct(99), 2),
+                 "max_ms": round(itl[-1], 2), "n": len(itl)},
+                all(r.finish_reason in ("length", "eos") for r in srt))
+
+    mono, ok_m = run(0)
+    chunked, ok_c = run(64)
+    print(json.dumps({
+        "metric": "serving_chunked_prefill_itl_p99",
+        "value": chunked["p99_ms"],
+        "unit": "ms inter-token p99 while a max-length prompt prefills",
+        "backend": jax.devices()[0].platform,
+        "monolithic": mono, "chunked": chunked,
+        "stall_reduction_max":
+            round(mono["max_ms"] / chunked["max_ms"], 2),
+        "long_prompt": long_len, "prefill_chunk": 64,
+        "model": f"{layers}Lx{hidden}d",
+        "all_resolved": bool(ok_m and ok_c),
+    }), flush=True)
+    return 0
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--requests", type=int, default=16)
@@ -90,7 +283,16 @@ def main():
                     help="cache length (0 = next pow2 of hi+gen)")
     ap.add_argument("--tpu", action="store_true",
                     help="run on the default (TPU) backend")
+    ap.add_argument("--capacity", action="store_true",
+                    help="paged-vs-dense capacity bench at equal KV HBM")
+    ap.add_argument("--chunk-slo", action="store_true",
+                    help="inter-token p99 while a max-length prompt "
+                         "prefills: monolithic vs chunked")
     args = ap.parse_args()
+    if args.capacity:
+        return capacity_main(args)
+    if args.chunk_slo:
+        return chunk_slo_main(args)
 
     from paddle_tpu.models.decode import next_pow2
     from paddle_tpu.inference.serving import ServingEngine

@@ -32,6 +32,18 @@ Scenarios:
   cancel_deadline  mid-decode cancel + tick deadline -> "cancelled" /
                    "timeout", survivors exact
 
+Paged-KV scenarios (the block-pool layout, docs/serving.md "Paged KV
+cache"):
+  paged_pool_flood more demand than pages -> later requests WAIT for
+                   pages (never a wedged slot), every stream completes
+                   bit-identical, zero pages/reservations leak
+  paged_nan_poison nan_logits on the paged engine -> the poisoned
+                   slot's pages free (pages_in_use drains to 0),
+                   survivors exact
+  cow_raise@T      the copy-on-write page copy raises -> admission
+                   rolls back (shared refcounts released), retry
+                   succeeds, the sharer's stream stays exact
+
 Usage:
   python tools/chaos_serving.py            # the full drill
   python tools/chaos_serving.py --quick    # smaller workload (CI)
@@ -286,6 +298,77 @@ def run_drill(quick: bool = False, keep_root: bool = False) -> int:
             return "shed_oldest never shed"
         return check_streams(reqs, baseline)
     scenario("queue_flood_shed", flood_shed, want_flight=False)
+
+    # --- paged KV: pool exhaustion under flood -----------------------
+    def paged_flood():
+        # ~3 requests' worth of pages for the whole flood: later
+        # requests must WAIT for pages (head-of-line), admit as
+        # earlier ones free, and complete bit-identical — never a
+        # wedged slot, never a leaked page
+        eng = make_engine(params, cfg, max_len, num_slots=4,
+                          kv_layout="paged", page_size=8, num_pages=13)
+        reqs = [eng.submit(p, gen) for p in prompts]
+        eng.drain()
+        err = check_terminal(reqs) or check_traces(eng)
+        if err:
+            return err
+        st = eng.pool_stats()
+        if st["pages_in_use"] or st["pages_reserved"]:
+            return f"pool leaked after flood: {st}"
+        if any(r.finish_reason not in ("length", "eos") for r in reqs):
+            return ("flood evicted instead of queueing: "
+                    f"{[r.finish_reason for r in reqs]}")
+        return check_streams(reqs, baseline)
+    scenario("paged_pool_flood", paged_flood, want_flight=False)
+
+    # --- paged KV: poisoned slot frees its pages ---------------------
+    def paged_poison():
+        eng = make_engine(params, cfg, max_len, kv_layout="paged",
+                          page_size=8)
+        reqs = [eng.submit(p, gen) for p in prompts]
+        eng.drain()
+        reasons = [r.finish_reason for r in reqs]
+        if reasons.count("poisoned") != 1:
+            return f"expected exactly one poisoned request: {reasons}"
+        st = eng.pool_stats()
+        if st["pages_in_use"] or st["pages_reserved"]:
+            return f"poisoned slot leaked pages: {st}"
+        return (check_terminal(reqs) or check_streams(reqs, baseline)
+                or check_traces(eng))
+    scenario("paged_nan_poison@2:1", paged_poison, spec="nan_logits@2:1")
+
+    # --- paged KV: COW page-copy fault -------------------------------
+    # the dense reference runs OUTSIDE the fault window (its ticks
+    # would consume the once-only fault marker)
+    aligned = build_workload(1, 16, 16, cfg.vocab_size, seed=99)[0]
+    aligned_want = make_engine(params, cfg, max_len).generate(
+        [aligned], gen)[0]
+
+    def cow_fault():
+        want = aligned_want
+        f0 = monitor.counter("serving.faults").value
+        eng = make_engine(params, cfg, max_len, kv_layout="paged",
+                          page_size=8)
+        donor = eng.submit(aligned, gen)
+        eng.drain()                  # donor registers its full pages
+        sharer = eng.submit(aligned, gen)   # aligned full match -> COW
+        eng.drain()
+        if monitor.counter("serving.faults").value <= f0:
+            return "cow fault never fired"
+        err = check_terminal([donor, sharer]) or check_traces(eng)
+        if err:
+            return err
+        if sharer.finish_reason != "length":
+            return ("cow retry was not transparent: "
+                    f"{sharer.finish_reason!r}")
+        for r in (donor, sharer):
+            if not np.array_equal(np.asarray(r.tokens, np.int32), want):
+                return "stream diverged across the cow fault"
+        st = eng.pool_stats()
+        if st["pages_reserved"]:
+            return f"cow fault leaked reservations: {st}"
+        return None
+    scenario("cow_raise@0", cow_fault, spec="cow_raise@0")
 
     # --- cancel + deadlines ------------------------------------------
     def cancel_deadline():

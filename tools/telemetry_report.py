@@ -159,7 +159,13 @@ def summarize(path: str, samples_per_step: Optional[float] = None) -> dict:
     # monitor_delta section and with tokens_per_s); gauges report their
     # last value. ----
     _SERVING_GAUGES = ("serving.slot_occupancy", "serving.queue_depth",
-                       "serving.queue_wait_ms")
+                       "serving.queue_wait_ms", "serving.pages_in_use",
+                       "serving.pages_shared")
+    # the paged-KV pool surface (inference/serving.py "kv pool"):
+    # occupancy/sharing gauges + COW and chunked-prefill counters,
+    # grouped under serving.kv_pool when any of them moved
+    _KV_POOL = ("pages_in_use", "pages_shared", "cow_copies",
+                "prefill_chunks")
     if monitors:
         first_s, last_s = monitors[0]["stats"], monitors[-1]["stats"]
         srv = {k[len("serving."):]:
@@ -171,6 +177,9 @@ def summarize(path: str, samples_per_step: Optional[float] = None) -> dict:
             dt = monitors[-1]["t"] - monitors[0]["t"]
             if dtok and dt > 0:
                 srv["tokens_per_s"] = round(dtok / dt, 1)
+            pool = {k: srv.pop(k) for k in _KV_POOL if k in srv}
+            if any(pool.values()):
+                srv["kv_pool"] = pool
             out["serving"] = srv
 
     # ---- serving SLO percentiles (ServingEngine.export_slo_jsonl
