@@ -217,8 +217,10 @@ class Model:
         inference/serving.py slot-pool engine). prompts: list of 1-D
         int token-id sequences of mixed lengths. SLO guardrail knobs
         (deadline_s/deadline_ticks/max_ticks, plus engine knobs like
-        max_queue/queue_ttl_s/watchdog_timeout/guardrails) pass
-        through to the facade and on to the engine."""
+        max_queue/queue_ttl_s/watchdog_timeout/guardrails) and the
+        speculative-decode knobs (spec_decode/gamma/draft_layers —
+        inference/spec_decode.py) pass through to the facade and on to
+        the engine, joining its cache key."""
         gen = getattr(self.network, "generate", None)
         if gen is None:
             raise NotImplementedError(

@@ -122,11 +122,47 @@ RadixAttention):
   that could never fit the configured pool raises the typed
   `PoolExhaustedError` at submit.
 
+Speculative decoding (spec_decode="spec" / env PADDLE_TPU_SPEC_DECODE
+/ the "spec_decode" registry kernel — the single-stream latency layer,
+cf. Leviathan et al. 2023; OFF by default):
+
+- **Self-draft propose + one-pass verify, one tick.** Each tick runs
+  `gamma` truncated-depth draft steps (the first `draft_layers` layers
+  of the stacked scan, sharing the target's params and KV cache/pages
+  — inference/spec_decode.py) and ONE full-depth verify pass over all
+  gamma+1 positions, accepting drafts by the greedy rule
+  (models/decode.greedy_accept). A tick emits 1..gamma+1 tokens, every
+  one of them the TARGET model's own argmax — greedy streams are
+  bit-identical to the non-spec engine on both cache layouts.
+- **Invariants preserved.** Still ONE host pull per tick (the
+  [N, gamma+1] emission matrix: col 0 = token or -1 quarantine
+  sentinel, accepted tokens, then the SPEC_PAD fill); still <= 2
+  decode traces (gamma/draft_layers baked per engine, `sampling` the
+  only static flag); exactly-once unchanged (mid-block EOS/length
+  finishes drop the unconsumed tail, exactly what non-spec would
+  never have generated). Sampled slots ride the same tick, emitting
+  one reproducible token from verify row 0 (mixed spec/non-spec
+  batches) — multi-token rejection sampling is deliberately not
+  implemented (it would change sampled streams vs non-spec).
+- **Paged interplay.** The tick's write span (gamma+1 positions)
+  prepares pages up front, clamped to the request's envelope;
+  rejected drafts' pages roll back to the pool after acceptance
+  (`_rollback_spec_pages`), so speculation never inflates a slot's
+  page footprint between ticks. Draft positions past the envelope
+  scatter to the scratch page through the unmapped table.
+- **Degradation, not quarantine, on draft failure.** Non-finite DRAFT
+  logits force acceptance 0 for that slot (verify row 0 — the
+  target's own logits — still emits); only target-model non-finite
+  logits quarantine, and only over emitted rows. testing/faults.py
+  `draft_nan` + tools/chaos_serving.py drill this.
+
 Observability: serving.* monitor counters/gauges (slot occupancy,
 queue depth, tokens emitted, prefills, decode ticks, plus
 rejected/timeout/cancelled/poisoned/evicted/retries/faults, the
-queue_wait_ms gauge, and the kv-pool surface: pages_in_use /
-pages_shared gauges, cow_copies / prefill_chunks counters) and
+queue_wait_ms gauge, the kv-pool surface: pages_in_use /
+pages_shared gauges, cow_copies / prefill_chunks counters, and the
+speculative surface: spec_proposed / spec_accepted counters + the
+per-engine spec_accept_rate gauge) and
 RecordEvent spans around every prefill/decode tick —
 tools/telemetry_report.py summarizes them (including TTFT /
 inter-token-latency percentiles from `export_slo_jsonl` and a
@@ -164,9 +200,9 @@ TERMINAL_REASONS = frozenset(
 
 # fault-injection seam (paddle_tpu.testing.faults.install wires it):
 # called with the tick index about to run, returns an action dict
-# ({"poison_slot": i} | {"stall_s": s} | {"raise_prefill": True} |
-# {"raise_decode": True} | {"raise_cow": True}). Production code never
-# sets it.
+# ({"poison_slot": i} | {"draft_poison_slot": i} | {"stall_s": s} |
+# {"raise_prefill": True} | {"raise_decode": True} |
+# {"raise_cow": True}). Production code never sets it.
 _FAULT_HOOK: Optional[Callable[[int], dict]] = None
 
 
@@ -567,11 +603,39 @@ class ServingEngine:
                  backoff_base: float = 0.05, backoff_max: float = 2.0,
                  guardrails: bool = True, kv_layout: str = "auto",
                  page_size: int = 16, num_pages: int = 0,
-                 prefill_chunk: int = 0, prefix_sharing: bool = True):
+                 prefill_chunk: int = 0, prefix_sharing: bool = True,
+                 spec_decode: str = "auto", gamma: int = 4,
+                 draft_layers: int = 0):
         self.family = (family_for(family) if isinstance(family, str)
                        else family)
         self.cfg = cfg
         self.num_slots = int(num_slots)
+        # ------------------------------------------- speculative decode
+        # knob 'auto' consults env > registry ('spec_decode') > off;
+        # the env's off values kill-switch even an explicit 'spec'
+        # (inference/spec_decode.resolve_spec)
+        from .spec_decode import resolve_spec
+        self.spec = resolve_spec(spec_decode)
+        n_layers = int(getattr(cfg, "num_layers", 0))
+        self.spec_gamma = int(gamma)
+        self.spec_draft_layers = int(draft_layers) or max(1, n_layers // 2)
+        if self.spec:
+            if self.spec_gamma < 1:
+                raise ValueError(f"gamma must be >= 1; got {gamma}")
+            if not 1 <= self.spec_draft_layers <= max(n_layers, 1):
+                raise ValueError(
+                    f"draft_layers ({self.spec_draft_layers}) must be in "
+                    f"1..num_layers ({n_layers})")
+            import inspect
+            try:
+                sig = inspect.signature(self.family.forward_cached)
+            except (TypeError, ValueError):
+                sig = None
+            if sig is not None and "layers" not in sig.parameters:
+                raise ValueError(
+                    f"family {self.family.name!r}: forward_cached does "
+                    "not accept layers= — the truncated-depth self-draft "
+                    "needs it (see models/gpt.py gpt_forward_cached)")
         # ------------------------------------------------- cache layout
         if kv_layout == "auto":
             from ..kernels.decode_attention import decode_attn_impl
@@ -672,13 +736,25 @@ class ServingEngine:
         self._slo_ttft: collections.deque = collections.deque(maxlen=8192)
         self._slo_itl: collections.deque = collections.deque(maxlen=8192)
 
-        self._decode = jax.jit(
-            functools.partial(_decode_tick, fwd=self.family.forward_cached,
-                              cfg=run_cfg, max_top_k=self.max_top_k,
-                              guard=self.guardrails,
-                              oor_pos=(self.max_pages * self.page_size
-                                       if self.paged else None)),
-            donate_argnums=(1, 2), static_argnames=("sampling",))
+        _oor = (self.max_pages * self.page_size if self.paged else None)
+        if self.spec:
+            from .spec_decode import spec_tick
+            self._decode = jax.jit(
+                functools.partial(spec_tick,
+                                  fwd=self.family.forward_cached,
+                                  cfg=run_cfg, max_top_k=self.max_top_k,
+                                  guard=self.guardrails,
+                                  gamma=self.spec_gamma,
+                                  draft_layers=self.spec_draft_layers,
+                                  oor_pos=_oor),
+                donate_argnums=(1, 2), static_argnames=("sampling",))
+        else:
+            self._decode = jax.jit(
+                functools.partial(_decode_tick,
+                                  fwd=self.family.forward_cached,
+                                  cfg=run_cfg, max_top_k=self.max_top_k,
+                                  guard=self.guardrails, oor_pos=_oor),
+                donate_argnums=(1, 2), static_argnames=("sampling",))
         if self.paged:
             self._prefill = jax.jit(
                 functools.partial(_prefill_chunk,
@@ -725,6 +801,15 @@ class ServingEngine:
         self._m_shared = monitor.gauge("serving.pages_shared")
         self._m_cow = monitor.counter("serving.cow_copies")
         self._m_chunks = monitor.counter("serving.prefill_chunks")
+        # speculative-decode surface (stay 0 with spec off): proposed =
+        # gamma per greedy slot per tick, accepted = drafts the verify
+        # kept; the rate gauge is THIS ENGINE's cumulative
+        # accepted/proposed (the counters are process-global)
+        self._m_spec_prop = monitor.counter("serving.spec_proposed")
+        self._m_spec_acc = monitor.counter("serving.spec_accepted")
+        self._m_spec_rate = monitor.gauge("serving.spec_accept_rate")
+        self._spec_prop_total = 0
+        self._spec_acc_total = 0
 
     # -------------------------------------------------------- page pool
     def _init_paged_cache(self):
@@ -1179,6 +1264,7 @@ class ServingEngine:
         from them and re-runs the tick idempotently (same state -> same
         KV writes). A hung pull or exhausted budget hard-resets."""
         poison_slot = actions.pop("poison_slot", None)
+        draft_slot = actions.pop("draft_poison_slot", None)
         stall_s = actions.pop("stall_s", 0.0)
         from ..parallel.resilience import StepHungError
         for attempt in range(self.retries + 1):
@@ -1211,10 +1297,23 @@ class ServingEngine:
                     poison = jnp.asarray(p)
                 poison_slot = None        # injected at most once
                 with RecordEvent("serving.decode_tick"):
-                    nxt, self._cache, self._dstate = self._decode(
-                        self._params, self._cache, self._dstate,
-                        self._base_key, poison, sampling=sampling)
-                    # ONE host pull per tick
+                    if self.spec:
+                        dpoison = self._poison_ones
+                        if draft_slot is not None:
+                            dp = np.ones(self.num_slots, np.float32)
+                            dp[int(draft_slot) % self.num_slots] = np.nan
+                            dpoison = jnp.asarray(dp)
+                        draft_slot = None     # injected at most once
+                        nxt, self._cache, self._dstate = self._decode(
+                            self._params, self._cache, self._dstate,
+                            self._base_key, poison, dpoison,
+                            sampling=sampling)
+                    else:
+                        nxt, self._cache, self._dstate = self._decode(
+                            self._params, self._cache, self._dstate,
+                            self._base_key, poison, sampling=sampling)
+                    # ONE host pull per tick ([N] non-spec; the
+                    # [N, gamma+1] emission matrix under spec)
                     toks = self._pull(nxt, stall_s)
                 stall_s = 0.0
                 break
@@ -1237,6 +1336,9 @@ class ServingEngine:
 
         self._m_tick.add()
         tick_now = time.perf_counter()
+        if self.spec:
+            self._apply_spec_emissions(toks, events, tick_now)
+            return
         for i in np.nonzero(self._active)[0]:
             req = self._slot_req[i]
             tok = int(toks[i])
@@ -1253,15 +1355,76 @@ class ServingEngine:
             # and gen_idx advanced under the active mask) — no
             # download, and the device state stays clean unless an
             # eviction dirties it
-            self._positions[i] += 1
-            self._cur_tok[i] = tok
-            self._gen_idx[i] += 1
-            req.tokens.append(tok)
-            events.append((req, tok))
-            self._m_tok.add()
-            self._slo_itl.append((tick_now - req._t_last) * 1e3)
-            req._t_last = tick_now
-            self._maybe_finish(req)
+            self._emit_token(i, req, tok, events, tick_now)
+
+    def _emit_token(self, i: int, req: Request, tok: int,
+                    events: list, tick_now: float) -> None:
+        """The per-token bookkeeping both decode paths share: advance
+        the host mirrors (positions/_cur_tok/_gen_idx), record the
+        token + SLO sample, and run the finish checks. The non-spec
+        tick is the cut=1 case of the spec loop — one seam so a future
+        accounting change can't silently miss one copy."""
+        self._positions[i] += 1
+        self._cur_tok[i] = tok
+        self._gen_idx[i] += 1
+        req.tokens.append(tok)
+        events.append((req, tok))
+        self._m_tok.add()
+        self._slo_itl.append((tick_now - req._t_last) * 1e3)
+        req._t_last = tick_now
+        self._maybe_finish(req)
+
+    def _apply_spec_emissions(self, toks, events: list,
+                              tick_now: float) -> None:
+        """Spec-mode post-pull bookkeeping: `toks` is the [N, gamma+1]
+        emission matrix (column 0 = the always-emitted token or the -1
+        quarantine sentinel; SPEC_PAD beyond the accepted prefix). The
+        device advanced each active slot by its accepted count + 1;
+        the mirrors advance identically UNLESS the request finishes
+        mid-block (EOS / max_new_tokens inside the accepted prefix) —
+        then _finish/_clear_slot dirties the device mirror, exactly
+        the non-spec eviction path, and the unconsumed tail tokens are
+        dropped (the non-spec engine would never have generated them).
+        Under the paged layout, pages past every surviving slot's new
+        position are speculative only and roll back to the pool."""
+        from .spec_decode import SPEC_PAD
+        for i in np.nonzero(self._active)[0]:
+            req = self._slot_req[i]
+            row = [int(t) for t in np.asarray(toks[i]).reshape(-1)]
+            if row[0] < -1:                      # defensive: never PAD
+                row[0] = -1
+            if row[0] < 0:
+                self._on_fault("poisoned", RuntimeError(
+                    f"non-finite logits in slot {i} (request {req.id})"))
+                self._finish(req, "poisoned")
+                continue
+            cut = row.index(SPEC_PAD) if SPEC_PAD in row else len(row)
+            if self._temps[i] <= 0.0:
+                # acceptance telemetry counts GREEDY slots only —
+                # sampled slots never propose
+                self._spec_prop_total += self.spec_gamma
+                self._spec_acc_total += cut - 1
+                self._m_spec_prop.add(self.spec_gamma)
+                self._m_spec_acc.add(cut - 1)
+            # mirror the device advance TOKEN BY TOKEN, not as one
+            # block: _maybe_finish's cache-full eviction check reads
+            # the position mirror, and advancing the whole block up
+            # front would let `positions >= max_len` fire mid-block on
+            # a boundary-legal request (prompt + max_new within gamma
+            # of max_len), dropping accepted tokens the non-spec
+            # engine would emit. A surviving slot's mirror still lands
+            # exactly at the device's pos + cut; a mid-block finish
+            # dirties the device state as before.
+            for tok in row[:cut]:
+                self._emit_token(i, req, tok, events, tick_now)
+                if req.done:
+                    break
+        if self._spec_prop_total:
+            self._m_spec_rate.set(
+                self._spec_acc_total / self._spec_prop_total)
+        if self.paged:
+            for i in np.nonzero(self._active)[0]:
+                self._rollback_spec_pages(int(i))
 
     # ---------------------------------------------------------- plumbing
     def _free_slot(self) -> Optional[int]:
@@ -1542,11 +1705,60 @@ class ServingEngine:
         """Paged pre-tick: every active slot's write page (where its
         position lands this tick) must exist and be private before the
         jitted scatter runs. Allocation draws on the slot's admission
-        reservation, so it cannot fail mid-decode."""
+        reservation, so it cannot fail mid-decode. Under speculative
+        decode the tick writes gamma+1 positions, so the whole span's
+        pages prepare — CLAMPED to the request's write envelope
+        (position t0 + max_new - 2 is the last ever written; draft
+        positions past it scatter to the scratch page through the
+        unmapped table instead of drawing pages the admission never
+        reserved)."""
+        span = (self.spec_gamma + 1) if self.spec else 1
         for i in np.nonzero(self._active)[0]:
-            j = int(self._positions[i]) // self.page_size
-            if j < self.max_pages:
-                self._ensure_private(int(i), j)
+            pos = int(self._positions[i])
+            last = pos + span - 1
+            req = self._slot_req[int(i)]
+            if req is not None:
+                last = min(last,
+                           len(req.prompt) + req.max_new_tokens - 2)
+            # positions pos..last are contiguous -> iterate the pages
+            # they cover once each (<= ceil(span/ps)+1), not once per
+            # position: _ensure_private is a host table read + set
+            # lookup on the scheduler hot path
+            for j in range(pos // self.page_size,
+                           last // self.page_size + 1):
+                if j < self.max_pages:
+                    self._ensure_private(int(i), j)
+
+    def _rollback_spec_pages(self, slot: int) -> None:
+        """Undo speculative page allocation: after acceptance, any
+        page mapped past the slot's live position holds ONLY rejected
+        drafts' K/V — release it to the pool and restore the slot's
+        admission reservation, so between ticks the pool accounting is
+        byte-identical to the single-token path's (speculation can
+        never starve other admissions of pages). Decode-range pages
+        are always private and unregistered (registration happens at
+        prefill, for prompt pages, which all sit below the live
+        position), so release() returns them straight to the free
+        list."""
+        pos = int(self._positions[slot])
+        ps = self.page_size
+        row = self._ptab[slot]
+        first = -(-pos // ps)        # page j holds a token iff j*ps < pos
+        # only THIS tick's prepared span can be mapped past `first`
+        # (rollback restores the invariant every tick, and positions
+        # only grow): its last write position is pos_before + gamma
+        # <= pos - 1 + gamma, so the scan is O(gamma/page_size), not
+        # O(max_pages), per slot per tick
+        last = min((pos + self.spec_gamma - 1) // ps + 1, self.max_pages)
+        for j in range(first, last):
+            pid = int(row[j])
+            if pid == 0:
+                continue
+            self._pool.release(pid)
+            self._slot_reserve[slot] += 1
+            self._pool.reserved += 1
+            row[j] = 0
+            self._pt_dirty = True
 
     def _maybe_finish(self, req: Request) -> None:
         slot = req.slot

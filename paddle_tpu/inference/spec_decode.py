@@ -1,0 +1,243 @@
+"""Speculative decoding inside the serving tick: self-draft propose +
+one-pass verify (Leviathan et al. 2023, "Fast Inference from
+Transformers via Speculative Decoding"; Chen et al. 2023,
+"Accelerating LLM Decoding with Speculative Sampling").
+
+Reference analog: the inference decoder loops of
+incubate/nn/layer/fused_transformer.py:1022 emit ONE token per full
+forward — the latency wall PR 4's serving tick inherited. Here each
+tick runs a cheap DRAFT pass that proposes `gamma` tokens and ONE
+full-depth VERIFY pass that scores all gamma+1 positions, so a tick
+emits between 1 and gamma+1 tokens while every emitted token is still
+the TARGET model's token (bit-identical greedy streams — the property
+every kernel in this repo ships behind).
+
+Self-draft (the default and only built-in draft): the first
+`draft_layers` layers of the existing stacked lax.scan, sharing the
+target's params AND its KV cache/pages — the stacked-params layout
+makes truncated depth a static slice (`forward_cached(...,
+layers=K)`), and the draft needs no cache of its own because the
+verify pass rewrites every drafted position at full depth anyway. The
+draft's working cache is a throwaway first-K-layers view, discarded at
+the end of the tick (a separate small draft model would need its own
+prefill/cache lifecycle; the seam is `draft_layers` — depth IS the
+draft-quality knob here).
+
+The whole propose+verify runs as ONE jitted tick (`spec_tick`) with
+the same state tuple, donation, and trace ceiling as the non-spec
+`_decode_tick`, preserving the PR 4-6 invariants:
+
+- ONE host pull per tick — the pull is the [N, gamma+1] emission
+  matrix instead of an [N] vector; column 0 is always a real token
+  (or the -1 quarantine sentinel), accepted tokens follow, and PAD
+  (-2) fills the rest, so the host derives the per-slot acceptance
+  count with no extra download.
+- zero recompiles after warmup — gamma/draft_layers are baked per
+  engine; `sampling` stays the only static flag (<= 2 traces).
+- exactly-once — host bookkeeping mirrors the device advance
+  (positions += accepted+1) and the quarantine/finish paths reuse the
+  non-spec seams unchanged.
+
+Correctness of greedy acceptance (why emitted streams are
+bit-identical to non-spec decode): the verify pass writes K/V for all
+gamma+1 positions BEFORE attending (kernels/decode_attention.py write-
+then-attend order), and the position mask admits cache slots <= the
+query's own position only, so verify row i sees exactly the cache the
+incremental path would have — including nothing of rows > i. Every
+emitted token is `argmax` of a verify row whose input prefix matched
+the true stream, i.e. exactly the token the one-token-per-tick path
+would have produced. Rejected rows' K/V is stale garbage past the new
+position: masked until the next tick's writes overwrite it in order
+(dense), or rolled back page-by-page by the engine (paged — see
+ServingEngine._rollback_spec_pages).
+
+Mixed spec/non-spec batches: sampled slots (temperature > 0) ride the
+SAME tick — their token samples from verify row 0 (the exact logits
+the non-spec tick computes, under the same fold_in PRNG stream) and
+their acceptance is forced to 0, so greedy slots speculate while
+sampled slots advance one reproducible token. Rejection-sampled
+multi-token speculation for temperature > 0 is deliberately out of
+scope: greedy acceptance is exact and bit-verifiable; a sampled
+acceptance rule would change sampled streams vs the non-spec engine.
+
+Draft-failure degradation: a non-finite draft logit row forces that
+slot's acceptance to 0 — the slot degrades to non-spec decode for the
+tick (verify row 0 is still the target's own healthy logits). Only
+TARGET-model non-finite logits quarantine (the -1 sentinel), and only
+over rows the slot actually emits. `testing/faults.py draft_nan`
+injects the draft lane; tools/chaos_serving.py asserts the degrade.
+
+Selection (the kernels/registry.py seam, same precedence story as
+decode_attention): kernel "spec_decode", impls "off" | "spec".
+`PADDLE_TPU_SPEC_DECODE` is the env override AND the kill switch —
+an explicit off value ("0"/"off"/"dense"/"false") disables
+speculation even on engines built with spec_decode="spec", so a
+misbehaving deployment can be flattened without a code change.
+Default: off (adoption only via env > sweep-winner > registry —
+tools/bench_serving.py --spec --adopt is the evidence-gated writer).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["SPEC_PAD", "spec_decode_impl", "resolve_spec", "spec_tick"]
+
+ENV_SPEC_DECODE = "PADDLE_TPU_SPEC_DECODE"
+
+# emission-matrix pad sentinel: -1 is the quarantine verdict, real ids
+# are never negative — -2 marks "no token emitted in this column"
+SPEC_PAD = -2
+
+_OFF_VALUES = frozenset({"0", "off", "dense", "false", "no"})
+_ON_VALUES = frozenset({"1", "spec", "on", "true", "yes"})
+
+
+def _env_value() -> str:
+    """Read + classify PADDLE_TPU_SPEC_DECODE: '' (unset), 'off',
+    or 'spec'. An unrecognized value is treated as OFF with a stderr
+    warning — this env var is the kill switch, and a typo that
+    silently ENABLED speculation would do the exact opposite of what
+    the operator reached for."""
+    env = os.environ.get(ENV_SPEC_DECODE, "").strip().lower()
+    if not env:
+        return ""
+    if env in _ON_VALUES:
+        return "spec"
+    if env not in _OFF_VALUES:
+        import sys
+        print(f"[spec_decode] {ENV_SPEC_DECODE}={env!r} is not one of "
+              f"{sorted(_ON_VALUES)} / {sorted(_OFF_VALUES)}; treating "
+              "as 'off' (the kill switch fails safe)",
+              file=sys.stderr, flush=True)
+    return "off"
+
+
+def spec_decode_impl() -> str:
+    """Selector: env PADDLE_TPU_SPEC_DECODE > registry winner
+    ('spec_decode', current backend class) > 'off'. The env var is
+    re-read per engine build like the Pallas kill switches."""
+    env = _env_value()
+    if env:
+        return env
+    from ..kernels import registry
+    win = registry.winner("spec_decode",
+                          backend=registry.backend_class(
+                              jax.default_backend()))
+    return win or "off"
+
+
+def resolve_spec(knob: str) -> bool:
+    """Engine-build resolution of the spec_decode knob ('auto' | 'off'
+    | 'spec') against the selector. The env KILL SWITCH is absolute: an
+    off value disables speculation even for knob='spec' (the only
+    selector in the repo where env beats an explicit caller choice —
+    that asymmetry is what makes it a kill switch, docs/serving.md).
+    Unrecognized env values count as off (_env_value fails safe)."""
+    if _env_value() == "off":
+        return False
+    if knob == "off":
+        return False
+    if knob == "spec":
+        return True
+    if knob == "auto":
+        return spec_decode_impl() == "spec"
+    raise ValueError(f"spec_decode {knob!r} (auto|off|spec)")
+
+
+def spec_tick(params, cache, state, base_key, poison, draft_poison, *,
+              fwd, cfg, max_top_k, sampling, guard, gamma, draft_layers,
+              oor_pos=None):
+    """THE speculative mixed step (the spec-mode replacement for
+    serving._decode_tick, same state tuple / donation / static
+    `sampling` flag). Per active slot: gamma truncated-depth draft
+    steps propose tokens, one full-depth verify pass scores all
+    gamma+1 positions, and the greedy acceptance rule
+    (models/decode.greedy_accept) picks how many to emit. Returns the
+    [N, gamma+1] emission matrix (column 0 = the always-emitted token
+    or the -1 quarantine sentinel; SPEC_PAD beyond the accepted
+    prefix), the updated cache, and the advanced state.
+
+    `draft_poison` [N] is the draft-lane fault multiplier (all-ones in
+    production; testing.faults draft_nan sets one lane to nan INSIDE
+    the jit): a non-finite draft row forces acceptance 0 — the slot
+    degrades to non-spec decode, never quarantine, because verify row
+    0 is the target's own logits. `poison` is the TARGET lane, handled
+    exactly as in the non-spec tick."""
+    from .serving import _sample, _slot_keys
+    from ..models.decode import greedy_accept
+
+    toks, positions, active, temps, top_ks, req_ids, gen_idx = state
+    n = toks.shape[0]
+
+    # ---- draft: gamma greedy steps through the first draft_layers
+    # layers on a THROWAWAY view of the cache (the verify pass is the
+    # only authoritative writer; the view exists so draft step i+1 can
+    # attend draft step i's K/V within this tick)
+    dcache = {"k": cache["k"][:draft_layers],
+              "v": cache["v"][:draft_layers]}
+    if "pt" in cache:
+        dcache["pt"] = cache["pt"]
+    d_tok = toks
+    draft_cols = []
+    draft_ok = jnp.ones((n,), bool)
+    for i in range(gamma):
+        dpos = positions + i
+        fpos = (dpos if oor_pos is None
+                else jnp.where(active, dpos, oor_pos))
+        lg_d, dcache = fwd(params, d_tok[:, None], dcache, fpos, cfg,
+                           layers=draft_layers)
+        row = lg_d[:, 0].astype(jnp.float32) * draft_poison[:, None]
+        draft_ok &= jnp.all(jnp.isfinite(row), axis=-1)
+        d_tok = jnp.argmax(row, axis=-1).astype(jnp.int32)
+        draft_cols.append(d_tok)
+    del dcache                                # discarded by design
+    draft = jnp.stack(draft_cols, axis=1)     # [N, gamma]
+
+    # ---- verify: ONE full-depth pass over [cur, d1..dgamma]; its
+    # writes land at positions pos..pos+gamma through the same
+    # write-then-attend seam as prefill, so row i attends exactly the
+    # incremental path's cache (the position mask zeroes rows > i)
+    vt = jnp.concatenate([toks[:, None], draft], axis=1)
+    fpos = (positions if oor_pos is None
+            else jnp.where(active, positions, oor_pos))
+    logits, cache = fwd(params, vt, cache, fpos, cfg)
+    lg = logits.astype(jnp.float32)           # [N, gamma+1, V]
+    if guard:
+        lg = lg * poison[:, None, None]
+    tgt = jnp.argmax(lg, axis=-1).astype(jnp.int32)   # [N, gamma+1]
+
+    # ---- acceptance: leading drafts matching the target's argmax;
+    # a poisoned draft degrades to 0 (non-spec for this tick)
+    m = greedy_accept(draft, tgt)
+    m = jnp.where(draft_ok, m, 0)
+    if sampling:
+        # sampled slots take verify row 0 — the exact logits (and the
+        # exact fold_in key stream) of the non-spec tick — and never
+        # accept drafts, so their streams stay bit-identical
+        keys = _slot_keys(base_key, req_ids, gen_idx)
+        first = _sample(lg[:, 0], temps, top_ks, keys, max_top_k)
+        m = jnp.where(temps > 0.0, 0, m)
+        emit0 = jnp.where(temps > 0.0, first, tgt[:, 0]).astype(jnp.int32)
+    else:
+        emit0 = tgt[:, 0]
+    cols = jnp.arange(gamma + 1, dtype=jnp.int32)[None, :]
+    emit = jnp.where(cols <= m[:, None], tgt, SPEC_PAD)
+    emit = emit.at[:, 0].set(jnp.where(active, emit0, 0))
+    emit = jnp.where(active[:, None] | (cols == 0), emit, SPEC_PAD)
+    if guard:
+        # quarantine ONLY over rows the slot emits: rejected drafts'
+        # rows may hold garbage-token logits and must not evict
+        row_ok = jnp.all(jnp.isfinite(lg), axis=-1)   # [N, gamma+1]
+        bad = jnp.any(~row_ok & (cols <= m[:, None]), axis=1)
+        emit = emit.at[:, 0].set(
+            jnp.where(active & bad, -1, emit[:, 0]))
+
+    adv = jnp.where(active, m + 1, 0).astype(jnp.int32)
+    last = jnp.take_along_axis(emit, m[:, None], axis=1)[:, 0]
+    new_tok = jnp.where(active, last, toks).astype(jnp.int32)
+    new_state = (new_tok, positions + adv, active, temps, top_ks,
+                 req_ids, gen_idx + adv)
+    return emit, cache, new_state

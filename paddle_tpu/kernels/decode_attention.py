@@ -14,7 +14,11 @@ One implementation serves BOTH cache-position shapes:
   greedy decode, models/decode.py);
 - per-row `pos` [B] — every row advances independently (the serving
   engine's slot pool, inference/serving.py: requests join and leave
-  mid-decode, so slot i holds `pos[i]` tokens).
+  mid-decode, so slot i holds `pos[i]` tokens). T may exceed 1 here:
+  the speculative verify pass (inference/spec_decode.py) runs the
+  current token + gamma drafts as one [B, gamma+1] step — the mask
+  stays per-query-position causal, and multi-token per-row writes
+  drop (never clamp) positions past the cache end.
 
 GQA is native: kc/vc carry KV heads; queries fold their group axis into
 the einsum so repeated KV is never materialized (models/llama.py's
@@ -117,14 +121,25 @@ def write_kv(kc, k, pos):
     """Write the step's k (or v) [B, T, KV, hd] into the cache
     [B, S, KV, hd] at position(s) `pos` — scalar (one
     dynamic_update_slice; XLA aliases the donated buffer) or [B]
-    per-row (vmapped per-slot update: each slot writes at its own
-    offset, the serving engine's in-place slot write)."""
+    per-row (each slot writes at its own offset, the serving engine's
+    in-place slot write). Per-row multi-token writes (T > 1 — the
+    speculative verify pass lands the current token + gamma drafts in
+    one call) go through a scatter whose out-of-bounds rows DROP: a
+    draft position past the cache end must vanish, not clamp onto (and
+    corrupt) the row's tail the way dynamic_update_slice's
+    start-index clamping would."""
     k = k.astype(kc.dtype)
     if jnp.ndim(pos) == 0:
         return jax.lax.dynamic_update_slice(kc, k, (0, pos, 0, 0))
-    return jax.vmap(
-        lambda c, u, p: jax.lax.dynamic_update_slice(c, u, (p, 0, 0))
-    )(kc, k, pos)
+    B, T = k.shape[:2]
+    if T == 1:
+        return jax.vmap(
+            lambda c, u, p: jax.lax.dynamic_update_slice(c, u, (p, 0, 0))
+        )(kc, k, pos)
+    qpos = _query_positions(pos, B, T)                 # [B, T]
+    rows = jnp.broadcast_to(jnp.arange(B, dtype=jnp.int32)[:, None],
+                            (B, T))
+    return kc.at[rows, qpos].set(k, mode="drop")
 
 
 def _query_positions(pos, B, T):

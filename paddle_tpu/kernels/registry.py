@@ -94,6 +94,13 @@ KNOWN_IMPLS: Dict[str, tuple] = {
     # f32 softmax (halves bf16 decode HBM traffic) — see
     # kernels/decode_attention.py
     "decode_attention": ("dense", "mixed"),
+    # speculative decoding inside the serving tick (self-draft propose
+    # + one-pass verify, inference/spec_decode.py): 'off' = one target
+    # token per tick (the PR-4 shape), 'spec' = gamma-draft/verify
+    # ticks. Env PADDLE_TPU_SPEC_DECODE overrides AND kill-switches;
+    # tools/bench_serving.py --spec --adopt is the evidence-gated
+    # writer
+    "spec_decode": ("off", "spec"),
 }
 
 _DOCS: Dict[str, Optional[dict]] = {}   # path -> parsed doc (memoized)

@@ -26,6 +26,22 @@ import jax
 import jax.numpy as jnp
 
 
+def greedy_accept(draft, target):
+    """The greedy speculative acceptance rule (Leviathan et al. 2023,
+    specialized to argmax decoding, where it is EXACT — accepted
+    prefixes reproduce the target-only stream bit for bit): draft
+    [N, g] proposed tokens, target [N, g+1] the target model's greedy
+    tokens at the same query positions (target[:, i] is what the
+    target emits from the position draft[:, i] would occupy). Returns
+    m [N] in 0..g — the number of leading draft tokens that match the
+    target's own choice; the emitter then takes target[:, :m+1]
+    (accepted drafts == target tokens, plus the free bonus token from
+    the first mismatching row). One home for the rule so the serving
+    tick (inference/spec_decode.py) and the tests cannot drift."""
+    ok = (draft == target[:, :draft.shape[1]]).astype(jnp.int32)
+    return jnp.sum(jnp.cumprod(ok, axis=1), axis=1)
+
+
 def next_pow2(n: int, lo: int = 8) -> int:
     """Smallest power of two >= max(n, lo)."""
     b = lo

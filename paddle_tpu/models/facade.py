@@ -92,7 +92,8 @@ class FacadeModel:
     def generate(self, prompts, max_new_tokens, num_slots=8,
                  max_len=None, temperature=0.0, top_k=0, eos_id=None,
                  max_top_k=0, seed=0, deadline_s=None,
-                 deadline_ticks=None, max_ticks=None, **engine_kw):
+                 deadline_ticks=None, max_ticks=None, spec_decode=None,
+                 gamma=None, draft_layers=None, **engine_kw):
         """Continuous-batching generation over this model's params
         (inference/serving.py): prompts is a list of 1-D int token-id
         sequences of MIXED lengths; returns one generated-id array per
@@ -108,7 +109,19 @@ class FacadeModel:
         requests still resolve — never limbo), and `**engine_kw`
         reaches the ServingEngine (max_queue, queue_policy,
         queue_ttl_s, watchdog_timeout, guardrails, ... — part of the
-        engine cache key, so switching knobs rebuilds)."""
+        engine cache key, so switching knobs rebuilds).
+
+        Speculative decoding passes through the same way:
+        `spec_decode` ("auto"|"off"|"spec"), `gamma` (draft length)
+        and `draft_layers` (self-draft depth) reach the ServingEngine
+        (inference/spec_decode.py; PADDLE_TPU_SPEC_DECODE is the kill
+        switch) and join the engine cache key — switching gamma or
+        draft depth rebuilds the engine rather than serving a tick
+        compiled for the old knobs."""
+        for k, v in (("spec_decode", spec_decode), ("gamma", gamma),
+                     ("draft_layers", draft_layers)):
+            if v is not None:
+                engine_kw[k] = v
         if self._serving_family is None:
             raise NotImplementedError(
                 f"{type(self).__name__} is not a cached decoder family; "

@@ -636,7 +636,8 @@ def _cached_attention(x, params_l, kc, vc, pos, cfg, pt=None):
     return out, kc, vc
 
 
-def gpt_forward_cached(params, tokens, cache, pos, cfg: GPTConfig):
+def gpt_forward_cached(params, tokens, cache, pos, cfg: GPTConfig,
+                       layers: Optional[int] = None):
     """Forward `tokens` [B,T] against a cache holding `pos` tokens.
     → (logits [B,T,V], updated cache). Works for prefill (pos=0, T=prompt)
     and decode (T=1), for dense and MoE configs (reference: the inference
@@ -646,6 +647,14 @@ def gpt_forward_cached(params, tokens, cache, pos, cfg: GPTConfig):
     bucketed models/decode.py driver passes the true prompt length) or a
     [B] vector of per-row slot positions (inference/serving.py: each
     slot holds its own request mid-stream).
+
+    `layers` (static) truncates the stacked scan to the FIRST `layers`
+    blocks, with the final norm + tied LM head applied to the
+    truncated stack's output — the self-draft pass of speculative
+    decoding (inference/spec_decode.py). The cache must then be the
+    matching first-`layers` view ({"k","v": [layers, ...]}); layer k's
+    K/V depends only on layers below it, so the truncated pass's
+    writes are bit-identical to the full pass's first `layers` layers.
 
     Cache layouts: dense {"k","v": [L, B, max_len, H, hd]} or the
     serving engine's paged pool {"k","v": [L, P, page_size, H, hd],
@@ -671,6 +680,10 @@ def gpt_forward_cached(params, tokens, cache, pos, cfg: GPTConfig):
 
     block_keys = _BLOCK_KEYS_MOE if cfg.num_experts > 0 else _BLOCK_KEYS_DENSE
     stacked = {k: params[k] for k in block_keys if k in params}
+    n_layers = cfg.num_layers
+    if layers is not None:
+        stacked = {k: v[:layers] for k, v in stacked.items()}
+        n_layers = int(layers)
 
     def scan_fn(x, layer_in):
         params_l, kc, vc = layer_in
@@ -694,10 +707,10 @@ def gpt_forward_cached(params, tokens, cache, pos, cfg: GPTConfig):
                            params_l.get("mlp_down_b"))
         return h + m, (kc, vc)
 
-    x, (kcs, vcs) = jax.lax.scan(scan_fn, x,
-                                 (stacked, cache["k"], cache["v"]),
-                                 unroll=getattr(cfg, "decode_scan_unroll",
-                                                1))
+    x, (kcs, vcs) = jax.lax.scan(
+        scan_fn, x, (stacked, cache["k"], cache["v"]),
+        unroll=max(1, min(getattr(cfg, "decode_scan_unroll", 1),
+                          n_layers)))
     x = _ln(x, params["ln_f_scale"], params["ln_f_bias"], cfg.layer_norm_eps)
     logits = jnp.einsum("bsd,vd->bsv", x, params["wte"].astype(x.dtype))
     out = {"k": kcs, "v": vcs}

@@ -234,7 +234,8 @@ def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int):
             "v": jnp.zeros(shape, cfg.dtype)}
 
 
-def llama_forward_cached(params, tokens, cache, pos, cfg: LlamaConfig):
+def llama_forward_cached(params, tokens, cache, pos, cfg: LlamaConfig,
+                         layers: Optional[int] = None):
     """Forward tokens [B,T] against a cache holding `pos` tokens ->
     (logits [B,T,V], updated cache). Prefill (pos=0) and decode (T=1)
     share the graph; RoPE is applied at the absolute positions. `pos`
@@ -242,9 +243,13 @@ def llama_forward_cached(params, tokens, cache, pos, cfg: LlamaConfig):
     slot positions (inference/serving.py). The cache write and the
     grouped masked attention (KV heads in the cache, never-materialized
     query groups — the GQA decode-bandwidth payoff) go through the
-    selectable seam in kernels/decode_attention.py. Cache layouts:
-    dense {"k","v": [L, B, max_len, KV, hd]} or the serving engine's
-    paged pool {"k","v": [L, P, page_size, KV, hd], "pt":
+    selectable seam in kernels/decode_attention.py. `layers` (static)
+    truncates the stacked scan to the first `layers` blocks with the
+    final RMSNorm + tied head on top — the speculative self-draft pass
+    (inference/spec_decode.py; the cache must be the matching
+    first-`layers` view, same contract as models/gpt.py). Cache
+    layouts: dense {"k","v": [L, B, max_len, KV, hd]} or the serving
+    engine's paged pool {"k","v": [L, P, page_size, KV, hd], "pt":
     [B, max_pages]} — same contract as models/gpt.py, bit-identical
     across layouts."""
     B, T = tokens.shape
@@ -269,6 +274,10 @@ def llama_forward_cached(params, tokens, cache, pos, cfg: LlamaConfig):
         sin = jnp.take(sin_full, idx, axis=0, mode="clip")
 
     stacked = {k: params[k] for k in _BLOCK_KEYS}
+    n_layers = cfg.num_layers
+    if layers is not None:
+        stacked = {k: v[:layers] for k, v in stacked.items()}
+        n_layers = int(layers)
     from ..kernels.decode_attention import (cached_attention, gather_pages,
                                             write_kv, write_kv_paged)
 
@@ -296,10 +305,10 @@ def llama_forward_cached(params, tokens, cache, pos, cfg: LlamaConfig):
             h @ lp["up_w"].astype(h.dtype))
         return x + gated @ lp["down_w"].astype(x.dtype), (kc, vc)
 
-    x, (kcs, vcs) = jax.lax.scan(scan_fn, x,
-                                 (stacked, cache["k"], cache["v"]),
-                                 unroll=getattr(cfg, "decode_scan_unroll",
-                                                1))
+    x, (kcs, vcs) = jax.lax.scan(
+        scan_fn, x, (stacked, cache["k"], cache["v"]),
+        unroll=max(1, min(getattr(cfg, "decode_scan_unroll", 1),
+                          n_layers)))
     x = _rmsnorm(x, params["norm_f"], cfg.rms_eps)
     logits = jnp.einsum("bsd,vd->bsv", x, params["wte"].astype(x.dtype))
     out = {"k": kcs, "v": vcs}

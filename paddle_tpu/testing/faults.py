@@ -39,6 +39,14 @@ tools/chaos_serving.py):
                           tick T (in-jit multiply, so injected and
                           organic non-finite logits hit the same
                           quarantine guard). S defaults to 0.
+- ``draft_nan@T:S``     — poison slot S's DRAFT logits (the
+                          speculative self-draft lane,
+                          inference/spec_decode.py) at tick T: the
+                          slot must DEGRADE to non-spec decode for
+                          that tick (acceptance forced to 0), never
+                          quarantine — the target stream stays
+                          bit-identical. S defaults to 0. No-op on a
+                          non-spec engine.
 - ``tick_stall@T:MS``   — stall the tick's host pull for MS
                           milliseconds at tick T (inside the watchdog
                           clock — exercises the budget/backoff path).
@@ -76,10 +84,10 @@ KILL_EXIT = 37
 
 _KINDS = ("kill", "crash_shard", "nan", "hb_stale", "elastic_exit",
           "nan_logits", "tick_stall", "prefill_raise", "decode_raise",
-          "cow_raise")
+          "cow_raise", "draft_nan")
 _SERVING_KINDS = frozenset(
     {"nan_logits", "tick_stall", "prefill_raise", "decode_raise",
-     "cow_raise"})
+     "cow_raise", "draft_nan"})
 
 
 @dataclass
@@ -108,7 +116,7 @@ class FaultPlan:
                 kind, _, rest = token.partition("@")
                 a, _, b = rest.partition(":")
                 step, arg = int(a), int(b) if b else 1
-                if kind == "nan_logits" and not b:
+                if kind in ("nan_logits", "draft_nan") and not b:
                     arg = 0            # default: poison slot 0
             except ValueError as e:
                 raise ValueError(
@@ -210,6 +218,8 @@ class FaultPlan:
                   f"(arg={f.arg})", file=sys.stderr, flush=True)
             if f.kind == "nan_logits":
                 actions["poison_slot"] = f.arg
+            elif f.kind == "draft_nan":
+                actions["draft_poison_slot"] = f.arg
             elif f.kind == "tick_stall":
                 actions["stall_s"] = f.arg / 1000.0
             elif f.kind == "prefill_raise":

@@ -527,3 +527,115 @@ class TestPoolTelemetry:
         assert "kv_pool" in srv
         assert srv["kv_pool"]["cow_copies"] >= 1
         assert srv["kv_pool"]["prefill_chunks"] >= 1
+
+
+# --------------------------------------------------------------------------
+# speculative decode: gamma-token writes + rejected-page rollback
+# --------------------------------------------------------------------------
+class TestSpecMultiTokenWrites:
+    def test_gamma_token_paged_write_matches_sequential(self):
+        """A gamma+1-token write_kv_paged (the speculative verify
+        pass's shape) must land byte-identical to gamma+1 sequential
+        single-token writes — including the rows that cross a page
+        boundary mid-block."""
+        from paddle_tpu.kernels.decode_attention import write_kv_paged
+        rng = np.random.RandomState(3)
+        B, KV, hd, ps, mp, T = 2, 2, 4, 8, 4, 5
+        pages0 = jnp.asarray(rng.randn(1 + B * mp, ps, KV, hd),
+                             jnp.float32)
+        table = jnp.arange(1, B * mp + 1, dtype=jnp.int32).reshape(B, mp)
+        pos = jnp.asarray([6, 13], jnp.int32)      # both cross a page
+        k = jnp.asarray(rng.randn(B, T, KV, hd), jnp.float32)
+        got = write_kv_paged(pages0, table, k, pos)
+        seq = pages0
+        for t in range(T):
+            seq = write_kv_paged(seq, table, k[:, t:t + 1], pos + t)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(seq))
+
+    def test_gamma_token_dense_write_drops_past_cache_end(self):
+        """Per-row multi-token dense writes (write_kv, T > 1) must DROP
+        positions past the cache end — dynamic_update_slice's clamping
+        would shift the whole block down and corrupt the row's tail."""
+        from paddle_tpu.kernels.decode_attention import write_kv
+        rng = np.random.RandomState(4)
+        B, S, KV, hd, T = 2, 16, 1, 2, 4
+        kc0 = jnp.asarray(rng.randn(B, S, KV, hd), jnp.float32)
+        k = jnp.asarray(rng.randn(B, T, KV, hd), jnp.float32)
+        pos = jnp.asarray([S - 2, 3], jnp.int32)   # row 0: 2 of 4 OOB
+        out = np.asarray(write_kv(kc0, k, pos))
+        want = np.asarray(kc0).copy()
+        want[0, S - 2:] = np.asarray(k)[0, :2]     # in-range only
+        want[1, 3:3 + T] = np.asarray(k)[1]
+        np.testing.assert_array_equal(out, want)
+
+    def test_spec_rollback_keeps_shared_pages_and_accounting(
+            self, gpt_setup):
+        """The satellite guarantee: gamma-token verify writes +
+        rejected-token page rollback leave (a) shared/COW prefix pages
+        byte-identical to the single-token path and (b) the pool
+        accounting identical between ticks — speculation never inflates
+        a slot's page footprint or starves other admissions."""
+        cfg, params = gpt_setup
+        rng = np.random.RandomState(23)
+        system = rng.randint(0, 64, 2 * PS).astype(np.int32)
+        prompts = [np.concatenate(
+            [system, rng.randint(0, 64, k).astype(np.int32)])
+            for k in (2, 3)]
+        want = _dense(params, cfg).generate(prompts, 8)
+
+        # the single-token paged reference: pool + shared-page bytes
+        ref = _paged(params, cfg)
+        ref_reqs = [ref.submit(p, 8) for p in prompts]
+        ref.drain()
+        ref_pids = sorted(ref._pool.by_key.values())
+        ref_pages = np.asarray(ref._cache["k"])[:, ref_pids].copy()
+        ref_stats = ref.pool_stats()
+
+        eng = _paged(params, cfg, spec_decode="spec", gamma=3,
+                     draft_layers=cfg.num_layers)
+        reqs = [eng.submit(p, 8) for p in prompts]
+        while eng.has_work():
+            eng.step()
+            _check_pool(eng)
+            # between ticks no slot may hold a page past its live
+            # position (the rollback invariant)
+            for i in np.nonzero(eng._active)[0]:
+                row = eng._ptab[i]
+                first = -(-int(eng._positions[i]) // PS)
+                assert not row[first:].any(), (
+                    "speculative pages survived the rollback: "
+                    f"slot {i} row {row.tolist()} pos "
+                    f"{eng._positions[i]}")
+        for r, w in zip(reqs, want):
+            np.testing.assert_array_equal(
+                np.asarray(r.tokens, np.int32), w)
+        pids = sorted(eng._pool.by_key.values())
+        np.testing.assert_array_equal(
+            np.asarray(eng._cache["k"])[:, pids], ref_pages,
+            err_msg="spec decode perturbed shared prefix pages")
+        got_stats = eng.pool_stats()
+        for key in ("pages_in_use", "pages_cached", "pages_shared",
+                    "pages_reserved"):
+            assert got_stats[key] == ref_stats[key], (key, got_stats,
+                                                      ref_stats)
+
+    def test_spec_cow_sharer_isolated_from_speculating_writer(
+            self, gpt_setup):
+        """A speculating writer COWs into a shared page exactly like
+        the single-token path: the sharer's stream and the registered
+        page bytes stay untouched while the writer's verify scatters
+        gamma+1 tokens per tick."""
+        cfg, params = gpt_setup
+        prompt = _prompts([2 * PS], seed=24)[0]        # page-aligned
+        want = _dense(params, cfg).generate([prompt], 8)[0]
+        eng = _paged(params, cfg, spec_decode="spec", gamma=4,
+                     draft_layers=cfg.num_layers)
+        ra = eng.submit(prompt, 8)
+        rb = eng.submit(prompt, 8)                     # aligned-full COW
+        cow0 = eng.pool_stats()["cow_copies"]
+        eng.drain()
+        assert eng.pool_stats()["cow_copies"] > cow0
+        for r in (ra, rb):
+            np.testing.assert_array_equal(
+                np.asarray(r.tokens, np.int32), want)
+        _check_pool(eng)

@@ -23,7 +23,25 @@ Usage:
   python tools/bench_serving.py                # acceptance workload
   python tools/bench_serving.py --requests 32 --gen 64 --slots 16
   python tools/bench_serving.py --capacity     # paged-vs-dense @ equal HBM
+  python tools/bench_serving.py --spec         # speculative A/B (1 slot)
+  python tools/bench_serving.py --spec --sweep # acceptance vs gamma/K
   PADDLE_TPU_TELEMETRY_JSONL=serve.jsonl python tools/bench_serving.py
+
+--spec is the speculative-decoding acceptance bench (BASELINE.md
+"Speculative decoding"): SINGLE-STREAM (num_slots=1) greedy decode,
+non-spec engine vs spec engine (inference/spec_decode.py), same
+workload, warm traces, bit-parity asserted on the way out. Tunnel
+safety per CLAUDE.md: each tick is one step-sized dispatch + one host
+pull, and the spec win is precisely FEWER ticks for the same tokens —
+the per-tick round trip is the real serving cost, so per-call wall
+timing measures the thing being optimized on CPU and TPU alike.
+Self-draft depth defaults to the FULL stack (draft == target,
+acceptance 1.0): bench params are random-init, so a truncated draft
+has no learned signal and the full-depth ceiling is what isolates the
+ENGINE mechanics; --sweep additionally races truncated depths and
+reports their acceptance. --adopt writes the evidence-gated registry
+row ("spec_decode" -> "spec") only when the measured speedup clears
+1.5x and the per-tick timing passes the roofline gate.
 
 The default workload is the BASELINE.md "Serving" row: 16 requests,
 prompt lengths uniform in [8, 96], 32 generated tokens each, GPT
@@ -268,6 +286,150 @@ def chunk_slo_main(args):
     return 0
 
 
+def spec_main(args):
+    """--spec: single-stream speculative A/B. One JSON line with both
+    tokens/s numbers, the speedup, acceptance rate, tick counts, and
+    (with --sweep) the acceptance-vs-gamma/draft-depth table."""
+    from paddle_tpu.models.decode import next_pow2
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.profiler import monitor
+
+    gen = args.gen
+    max_len = args.max_len or next_pow2(args.prompt_hi + gen + args.gamma)
+    if args.family == "gpt":
+        from paddle_tpu.models.gpt import GPTConfig, init_gpt_params
+        cfg = GPTConfig(vocab_size=args.vocab, hidden_size=args.hidden,
+                        num_layers=args.layers,
+                        num_heads=max(args.hidden // 32, 1),
+                        max_seq_len=2 * max_len, sequence_parallel=False,
+                        remat=False, dtype=jnp.float32)
+        params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+    else:
+        from paddle_tpu.models.llama import LlamaConfig, init_llama_params
+        cfg = LlamaConfig(vocab_size=args.vocab, hidden_size=args.hidden,
+                          num_layers=args.layers,
+                          num_heads=max(args.hidden // 32, 1),
+                          num_kv_heads=max(args.hidden // 64, 1),
+                          max_seq_len=2 * max_len, remat=False,
+                          dtype=jnp.float32)
+        params = init_llama_params(cfg, jax.random.PRNGKey(0))
+    kd = args.draft_layers or args.layers      # full depth = ceiling
+    prompts = build_workload(args.requests, args.prompt_lo,
+                             args.prompt_hi, args.vocab)
+    total_tokens = args.requests * gen
+    _log(f"spec workload: {args.requests} single streams x {gen} tok, "
+         f"{args.family} {args.layers}Lx{args.hidden}d, gamma={args.gamma}, "
+         f"draft_layers={kd}, max_len={max_len}")
+
+    def run(eng):
+        t0 = time.perf_counter()
+        outs = eng.generate(prompts, gen)
+        return time.perf_counter() - t0, outs
+
+    def ticks():
+        return monitor.counter("serving.decode_ticks").value
+
+    base = ServingEngine(params, cfg, family=args.family, num_slots=1,
+                         max_len=max_len)
+    run(base)                                        # warm
+    k0 = ticks()
+    base_s, base_outs = run(base)
+    base_ticks = ticks() - k0
+
+    spec = ServingEngine(params, cfg, family=args.family, num_slots=1,
+                         max_len=max_len, spec_decode="spec",
+                         gamma=args.gamma, draft_layers=kd)
+    run(spec)                                        # warm
+    traces_warm = spec.trace_counts()
+    k0 = ticks()
+    spec_s, spec_outs = run(spec)
+    spec_ticks = ticks() - k0
+    traces_after = spec.trace_counts()
+
+    mismatches = sum(1 for a, b in zip(base_outs, spec_outs)
+                     if not np.array_equal(a, b))
+    base_tps = total_tokens / base_s
+    spec_tps = total_tokens / spec_s
+    accept = (spec._spec_acc_total / spec._spec_prop_total
+              if spec._spec_prop_total else 0.0)
+    doc = {
+        "metric": "serving_spec_tokens_per_sec",
+        "value": round(spec_tps, 1),
+        "unit": "single-stream tokens/s",
+        "backend": jax.devices()[0].platform,
+        "nonspec_tokens_per_sec": round(base_tps, 1),
+        "speedup_vs_nonspec": round(spec_tps / base_tps, 2),
+        "acceptance_rate": round(accept, 3),
+        "gamma": args.gamma, "draft_layers": kd,
+        "decode_ticks": [base_ticks, spec_ticks],
+        "requests": args.requests, "gen": gen,
+        "model": f"{args.layers}Lx{args.hidden}d",
+        "family": args.family, "max_len": max_len,
+        "recompiles_after_warmup": [
+            traces_after[0] - traces_warm[0],
+            traces_after[1] - traces_warm[1]],
+        "stream_mismatches": mismatches,
+    }
+
+    if args.sweep:
+        # acceptance vs (gamma, draft depth): random-init params give
+        # truncated drafts no learned signal — the sweep documents the
+        # graceful-degradation floor next to the full-depth ceiling
+        table = []
+        for g in (2, 4, 8):
+            for k in sorted({1, max(1, args.layers // 2), args.layers}):
+                e = ServingEngine(params, cfg, family=args.family,
+                                  num_slots=1, max_len=max_len,
+                                  spec_decode="spec", gamma=g,
+                                  draft_layers=k)
+                run(e)                               # warm
+                dt, outs = run(e)
+                bad = sum(1 for a, b in zip(base_outs, outs)
+                          if not np.array_equal(a, b))
+                acc = (e._spec_acc_total / e._spec_prop_total
+                       if e._spec_prop_total else 0.0)
+                table.append({"gamma": g, "draft_layers": k,
+                              "acceptance_rate": round(acc, 3),
+                              "tokens_per_sec":
+                                  round(total_tokens / dt, 1),
+                              "speedup":
+                                  round(total_tokens / dt / base_tps, 2),
+                              "stream_mismatches": bad})
+                mismatches += bad      # sweep parity gates the exit too
+        doc["sweep"] = table
+        # the ONE JSON line must agree with the exit code: fold sweep
+        # mismatches into the top-level count too (per-row counts stay
+        # in the table)
+        doc["stream_mismatches"] = mismatches
+
+    if args.adopt:
+        from paddle_tpu.kernels import registry
+        ok = (mismatches == 0
+              and doc["speedup_vs_nonspec"] >= 1.5
+              and doc["recompiles_after_warmup"] == [0, 0])
+        if not ok:
+            doc["adopt"] = "refused: speedup/parity/recompile gate failed"
+        else:
+            # evidence: per-tick ms + the weight bytes a spec tick
+            # streams (target pass over gamma+1 positions + gamma
+            # truncated draft passes) — the roofline gate re-checks
+            pbytes = sum(np.asarray(v).nbytes for v in params.values())
+            per_tick_ms = spec_s * 1e3 / max(spec_ticks, 1)
+            bytes_moved = pbytes * (1.0 + args.gamma * kd / args.layers)
+            problem = registry.adopt(
+                "spec_decode", "spec", per_tick_ms,
+                bytes_moved=bytes_moved,
+                source=(f"bench_serving --spec: {doc['speedup_vs_nonspec']}x "
+                        f"single-stream GREEDY vs non-spec "
+                        f"(gamma={args.gamma}, K={kd}, "
+                        f"accept={doc['acceptance_rate']}; sampled-only "
+                        "workloads were not measured — they pay the draft "
+                        "with acceptance forced to 0)"))
+            doc["adopt"] = problem or "adopted"
+    print(json.dumps(doc), flush=True)
+    return 0 if mismatches == 0 else 1
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--requests", type=int, default=16)
@@ -288,11 +450,26 @@ def main():
     ap.add_argument("--chunk-slo", action="store_true",
                     help="inter-token p99 while a max-length prompt "
                          "prefills: monolithic vs chunked")
+    ap.add_argument("--spec", action="store_true",
+                    help="single-stream speculative-decode A/B "
+                         "(non-spec vs spec engine, bit-parity checked)")
+    ap.add_argument("--gamma", type=int, default=4,
+                    help="--spec: draft length per tick")
+    ap.add_argument("--draft-layers", type=int, default=0,
+                    help="--spec: self-draft depth (0 = full stack, "
+                         "the acceptance ceiling on random-init params)")
+    ap.add_argument("--sweep", action="store_true",
+                    help="--spec: acceptance vs gamma/draft-depth table")
+    ap.add_argument("--adopt", action="store_true",
+                    help="--spec: write the evidence-gated registry row "
+                         "when the speedup clears 1.5x")
     args = ap.parse_args()
     if args.capacity:
         return capacity_main(args)
     if args.chunk_slo:
         return chunk_slo_main(args)
+    if args.spec:
+        return spec_main(args)
 
     from paddle_tpu.models.decode import next_pow2
     from paddle_tpu.inference.serving import ServingEngine

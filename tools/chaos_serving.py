@@ -32,6 +32,16 @@ Scenarios:
   cancel_deadline  mid-decode cancel + tick deadline -> "cancelled" /
                    "timeout", survivors exact
 
+Speculative-decode scenarios (docs/serving.md "Speculative decoding"):
+  spec_draft_nan@T:S nan injected into slot S's DRAFT logits on a spec
+                   engine -> the slot DEGRADES to non-spec decode for
+                   that tick (acceptance 0), is NEVER quarantined, and
+                   every stream stays bit-identical to the non-spec
+                   baseline; exactly-once + trace ceilings hold
+  spec_nan_logits@T:S nan in the TARGET logits on a spec engine -> the
+                   quarantine verdict still rides the emission matrix:
+                   only slot S poisons, survivors exact
+
 Paged-KV scenarios (the block-pool layout, docs/serving.md "Paged KV
 cache"):
   paged_pool_flood more demand than pages -> later requests WAIT for
@@ -369,6 +379,45 @@ def run_drill(quick: bool = False, keep_root: bool = False) -> int:
             return f"cow fault leaked reservations: {st}"
         return None
     scenario("cow_raise@0", cow_fault, spec="cow_raise@0")
+
+    # --- speculative decode: draft nan degrades, never quarantines ---
+    def spec_draft_nan():
+        eng = make_engine(params, cfg, max_len, spec_decode="spec",
+                          gamma=3, draft_layers=cfg.num_layers)
+        reqs = [eng.submit(p, gen) for p in prompts]
+        eng.drain()
+        if any(r.finish_reason == "poisoned" for r in reqs):
+            return ("draft nan quarantined the target stream: "
+                    f"{[r.finish_reason for r in reqs]}")
+        err = check_terminal(reqs) or check_traces(eng)
+        if err:
+            return err
+        if any(r.finish_reason != "length" for r in reqs):
+            return ("degrade was not transparent: "
+                    f"{[r.finish_reason for r in reqs]}")
+        # full-depth self-draft accepts everything EXCEPT the poisoned
+        # tick — a clean acceptance ledger means the fault never bit
+        if eng._spec_acc_total >= eng._spec_prop_total:
+            return "draft fault never degraded acceptance"
+        # streams equal the NON-SPEC baseline: speculation's bit-parity
+        # AND the degrade in one assertion
+        return check_streams(reqs, baseline)
+    scenario("spec_draft_nan@2:1", spec_draft_nan,
+             spec="draft_nan@2:1", want_flight=False)
+
+    # --- speculative decode: target nan still quarantines exactly ----
+    def spec_target_nan():
+        eng = make_engine(params, cfg, max_len, spec_decode="spec",
+                          gamma=3, draft_layers=cfg.num_layers)
+        reqs = [eng.submit(p, gen) for p in prompts]
+        eng.drain()
+        reasons = [r.finish_reason for r in reqs]
+        if reasons.count("poisoned") != 1:
+            return f"expected exactly one poisoned request: {reasons}"
+        return (check_terminal(reqs) or check_streams(reqs, baseline)
+                or check_traces(eng))
+    scenario("spec_nan_logits@2:1", spec_target_nan,
+             spec="nan_logits@2:1")
 
     # --- cancel + deadlines ------------------------------------------
     def cancel_deadline():

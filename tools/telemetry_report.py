@@ -160,12 +160,16 @@ def summarize(path: str, samples_per_step: Optional[float] = None) -> dict:
     # last value. ----
     _SERVING_GAUGES = ("serving.slot_occupancy", "serving.queue_depth",
                        "serving.queue_wait_ms", "serving.pages_in_use",
-                       "serving.pages_shared")
+                       "serving.pages_shared", "serving.spec_accept_rate")
     # the paged-KV pool surface (inference/serving.py "kv pool"):
     # occupancy/sharing gauges + COW and chunked-prefill counters,
     # grouped under serving.kv_pool when any of them moved
     _KV_POOL = ("pages_in_use", "pages_shared", "cow_copies",
                 "prefill_chunks")
+    # the speculative-decode surface (inference/spec_decode.py):
+    # proposed/accepted counter deltas + the per-engine acceptance-rate
+    # gauge, grouped under serving.spec when any of them moved
+    _SPEC = ("spec_proposed", "spec_accepted", "spec_accept_rate")
     if monitors:
         first_s, last_s = monitors[0]["stats"], monitors[-1]["stats"]
         srv = {k[len("serving."):]:
@@ -180,6 +184,9 @@ def summarize(path: str, samples_per_step: Optional[float] = None) -> dict:
             pool = {k: srv.pop(k) for k in _KV_POOL if k in srv}
             if any(pool.values()):
                 srv["kv_pool"] = pool
+            spec = {k: srv.pop(k) for k in _SPEC if k in srv}
+            if any(spec.values()):
+                srv["spec"] = spec
             out["serving"] = srv
 
     # ---- serving SLO percentiles (ServingEngine.export_slo_jsonl
