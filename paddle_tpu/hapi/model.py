@@ -217,10 +217,13 @@ class Model:
         inference/serving.py slot-pool engine). prompts: list of 1-D
         int token-id sequences of mixed lengths. SLO guardrail knobs
         (deadline_s/deadline_ticks/max_ticks, plus engine knobs like
-        max_queue/queue_ttl_s/watchdog_timeout/guardrails) and the
+        max_queue/queue_ttl_s/watchdog_timeout/guardrails), the
         speculative-decode knobs (spec_decode/gamma/draft_layers —
-        inference/spec_decode.py) pass through to the facade and on to
-        the engine, joining its cache key."""
+        inference/spec_decode.py) and the tensor-parallel `mesh` /
+        `tp_axis` knobs (inference/serving.py mesh= — the mesh
+        topology + tp degree join the cache key, so a resharded model
+        rebuilds rather than reusing a single-device engine) pass
+        through to the facade and on to the engine."""
         gen = getattr(self.network, "generate", None)
         if gen is None:
             raise NotImplementedError(

@@ -25,7 +25,8 @@ from ..framework.tensor import Tensor
 __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
            "ServingEngine", "Request", "create_serving_engine",
            "family_for", "BackpressureError", "PoolExhaustedError",
-           "ServingFaultError", "TERMINAL_REASONS"]
+           "ServingFaultError", "TERMINAL_REASONS",
+           "EngineRouter", "RouterRequest", "create_router"]
 
 
 class PrecisionType:
@@ -228,3 +229,7 @@ from .serving import (ServingEngine, Request,          # noqa: E402,F401
                       create_serving_engine, family_for,
                       BackpressureError, PoolExhaustedError,
                       ServingFaultError, TERMINAL_REASONS)
+# the replicated-engine router (least-loaded admission, replica-death
+# requeue) — horizontal traffic scaling over N engine replicas
+from .router import (EngineRouter, RouterRequest,      # noqa: E402,F401
+                     create_router)

@@ -1,0 +1,510 @@
+"""Multi-engine serving router: data-parallel ServingEngine replicas
+behind least-loaded admission, with replica-death requeue.
+
+Reference analog: the fleet serving deployments that front N identical
+AnalysisPredictor workers with a dispatcher (the multi-stream serving
+shape of inference/api/analysis_predictor.h:94's `clone()` contract —
+one predictor per stream, a router above). Here each replica is a full
+continuous-batching ServingEngine (inference/serving.py) — its own slot
+pool, KV cache (dense or paged), compiled executables and SLO
+guardrails — and the router is a THIN host-side layer: it owns no
+device state, so it composes with everything the engine already does
+(paged KV, chunked prefill, speculative decode, tensor-parallel
+`mesh=` — a router over tp-sharded engines is the dp x tp serving
+story).
+
+Scheduling: `submit` places each request on the live replica with the
+smallest load (in-slot + queued requests — join-shortest-queue, the
+classic latency-optimal dispatch for identical servers); a replica that
+refuses (its own `max_queue` backpressure or page-pool admission) falls
+through to the next-least-loaded, and only when EVERY live replica
+refuses does the router queue (bounded by ITS `max_queue` with the same
+reject/shed_oldest policies, reusing BackpressureError). The engines'
+own machinery keeps doing what PR 5 built — deadlines, TTL, cancel,
+quarantine, self-healing — the router only translates inner terminals
+to its own EXACTLY-ONCE resolution.
+
+Replica death (`kill_replica`, or any exception escaping a replica's
+step — the engines self-heal internally, so an escape means the
+replica is gone): every un-terminal request mapped to the dead replica
+REQUEUES at the head of the router queue and replays FROM SCRATCH on a
+survivor — the engine has no cross-replica KV migration, and greedy
+streams are deterministic, so a replayed request's final token stream
+is bit-identical to an undisturbed run. Migration semantics are
+therefore at-least-once token DELIVERY (tokens emitted before the
+death are re-emitted by the replay; `RouterRequest.tokens` is reset so
+the final list never duplicates) with exactly-once TERMINAL
+resolution — the same contract a resumable stream gives its client.
+Requests already terminal on the dead replica stay resolved (never
+re-run); a death with zero live replicas left resolves everything
+"evicted" (never limbo). Every death leaves a flight-recorder dump.
+
+Observability: serving.router.* monitor names — the replicas_live
+gauge, the requeues/rejected counters, per-replica queue-depth gauges
+(serving.router.queue_depth.r<i>) and dispatch counters
+(serving.router.dispatched.r<i> — the admission-balance observable) —
+summarized by tools/telemetry_report.py's "router" block;
+tools/bench_serving.py --router measures aggregate tokens/s vs replica
+count and tools/chaos_serving.py's replica_death scenario is the
+executable acceptance test.
+"""
+from __future__ import annotations
+
+import collections
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .serving import (BackpressureError, PoolExhaustedError,
+                      ServingEngine, TERMINAL_REASONS)
+from ..profiler import monitor
+
+__all__ = ["EngineRouter", "RouterRequest", "create_router"]
+
+
+class RouterRequest:
+    """One generation request riding through the router. Mirrors the
+    engine Request surface the schedulers and chaos checks read
+    (tokens / done / finish_reason / slot / cancel()); `replica` is the
+    index currently serving it (None while queued), `requeues` counts
+    replica-death migrations."""
+
+    __slots__ = ("id", "prompt", "max_new_tokens", "temperature",
+                 "top_k", "eos_id", "deadline_s", "deadline_ticks",
+                 "tokens", "done", "finish_reason", "replica",
+                 "requeues", "t_submit", "_tick_submit", "_inner",
+                 "_router")
+
+    def __init__(self, req_id, prompt, max_new_tokens, temperature,
+                 top_k, eos_id, deadline_s, deadline_ticks):
+        self.id = req_id
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.temperature = temperature
+        self.top_k = top_k
+        self.eos_id = eos_id
+        self.deadline_s = deadline_s
+        self.deadline_ticks = deadline_ticks
+        self.tokens: List[int] = []
+        self.done = False
+        self.finish_reason: Optional[str] = None
+        self.replica: Optional[int] = None
+        self.requeues = 0
+        self.t_submit = 0.0
+        self._tick_submit = 0
+        self._inner = None              # live engine Request, if placed
+        self._router = None
+
+    @property
+    def slot(self):
+        """The engine slot currently decoding this request (None while
+        queued or terminal) — the surface chaos_serving's
+        check_terminal reads."""
+        inner = self._inner
+        return None if inner is None else inner.slot
+
+    def cancel(self) -> bool:
+        r = self._router
+        return False if r is None else r.cancel(self)
+
+    def __repr__(self):
+        return (f"RouterRequest(id={self.id}, replica={self.replica}, "
+                f"gen={len(self.tokens)}/{self.max_new_tokens}, "
+                f"requeues={self.requeues}, done={self.done})")
+
+
+class _Replica:
+    def __init__(self, idx: int, eng: ServingEngine):
+        self.idx = idx
+        self.eng = eng
+        self.alive = True
+        self.inner = {}                 # inner request id -> RouterRequest
+        self.m_depth = monitor.gauge(f"serving.router.queue_depth.r{idx}")
+        self.m_disp = monitor.counter(f"serving.router.dispatched.r{idx}")
+
+    def load(self) -> int:
+        """In-flight demand: occupied slots (active or mid-prefill) +
+        the engine's own admission queue."""
+        eng = self.eng
+        return (sum(1 for r in eng._slot_req if r is not None)
+                + len(eng._queue))
+
+
+class EngineRouter:
+    """Least-loaded admission over N ServingEngine replicas.
+
+    >>> router = create_router(params, cfg, family="gpt", replicas=2)
+    >>> req = router.submit(prompt_ids, max_new_tokens=32)
+    >>> while router.has_work():
+    ...     for r, tok in router.step():
+    ...         ...
+
+    `step()` advances EVERY live replica one engine tick and returns
+    the merged (request, token) emissions; `generate` wraps
+    submit+drain like the engine's. Greedy streams are bit-identical
+    to a single engine serving the same request (engine streams are
+    slot/batch-invariant, and replicas share params + seed); sampled
+    streams are reproducible per (replica, submission order) but not
+    router-placement-invariant — the engine folds ITS request id into
+    the PRNG stream."""
+
+    def __init__(self, engines: Sequence[ServingEngine],
+                 max_queue: int = 0, queue_policy: str = "reject",
+                 concurrent: bool = True):
+        if not engines:
+            raise ValueError("EngineRouter needs >= 1 engine replica")
+        if queue_policy not in ("reject", "shed_oldest"):
+            raise ValueError(f"queue_policy {queue_policy!r} "
+                             "(reject|shed_oldest)")
+        self.replicas = [_Replica(i, e) for i, e in enumerate(engines)]
+        self.max_queue = int(max_queue)       # bound on the ROUTER queue
+        self.queue_policy = queue_policy
+        # concurrent=True steps the replicas in parallel threads: each
+        # tick's device work runs in the backend's own pool and the
+        # blocking host pull releases the GIL, so R replicas' ticks
+        # OVERLAP — the source of the aggregate-throughput win on one
+        # host (each engine is only ever touched by its own worker per
+        # tick; all router bookkeeping stays on the calling thread, so
+        # emission order is deterministic: replica index, slot order)
+        self.concurrent = bool(concurrent)
+        self._exec = None                     # lazy, one worker/replica
+        self._pending: collections.deque = collections.deque()
+        self._next_id = 0
+        self._ticks = 0
+        from ..profiler import flight_recorder
+        self._flight = flight_recorder.recorder()
+        self._m_live = monitor.gauge("serving.router.replicas_live")
+        self._m_pending = monitor.gauge("serving.router.pending")
+        self._m_requeue = monitor.counter("serving.router.requeues")
+        self._m_rej = monitor.counter("serving.router.rejected")
+        self._m_sub = monitor.counter("serving.router.requests_submitted")
+        self._m_done = monitor.counter("serving.router.requests_completed")
+        self._m_deaths = monitor.counter("serving.router.replica_deaths")
+        self._m_live.set(len(self.replicas))
+
+    # ------------------------------------------------------- observables
+    def live(self) -> List[_Replica]:
+        return [r for r in self.replicas if r.alive]
+
+    def has_work(self) -> bool:
+        return (bool(self._pending)
+                or any(r.eng.has_work() for r in self.live()))
+
+    def stats(self) -> dict:
+        """Host-side router observable: per-replica liveness/load and
+        the admission balance (dispatch counts)."""
+        return {"replicas": len(self.replicas),
+                "replicas_live": len(self.live()),
+                "pending": len(self._pending),
+                "requeues": self._m_requeue.value,
+                "per_replica": [
+                    {"idx": r.idx, "alive": r.alive,
+                     "load": r.load() if r.alive else 0,
+                     "dispatched": r.m_disp.value}
+                    for r in self.replicas]}
+
+    # --------------------------------------------------------- admission
+    def submit(self, prompt, max_new_tokens: int,
+               temperature: float = 0.0, top_k: int = 0,
+               eos_id: Optional[int] = None,
+               deadline_s: Optional[float] = None,
+               deadline_ticks: Optional[int] = None) -> RouterRequest:
+        """Queue one request with the least-loaded live replica (falling
+        through replicas that refuse admission); raises
+        BackpressureError when every replica refuses AND the router
+        queue is at max_queue under "reject" (shed_oldest evicts the
+        oldest router-queued request instead). PoolExhaustedError
+        propagates only when NO live replica could EVER hold the
+        request."""
+        if not self.live():
+            raise BackpressureError("no live replicas", queue_depth=0)
+        req = RouterRequest(self._next_id,
+                            np.asarray(prompt, np.int32).reshape(-1),
+                            int(max_new_tokens), float(temperature),
+                            int(top_k), eos_id,
+                            None if deadline_s is None
+                            else float(deadline_s),
+                            None if deadline_ticks is None
+                            else int(deadline_ticks))
+        self._next_id += 1
+        req.t_submit = time.perf_counter()
+        req._tick_submit = self._ticks
+        req._router = self
+        # requests_submitted counts ACCEPTED requests only (same as the
+        # engine's: a reject raises before anything is admitted), so
+        # submitted - completed is a true in-flight gauge
+        if self._try_dispatch(req):
+            self._m_sub.add()
+            return req
+        if self.max_queue > 0 and len(self._pending) >= self.max_queue:
+            if self.queue_policy == "shed_oldest":
+                self._finish(self._pending.popleft(), "evicted")
+            else:
+                self._m_rej.add()
+                raise BackpressureError(
+                    f"router queue full ({len(self._pending)} waiting, "
+                    f"max_queue={self.max_queue})",
+                    queue_depth=len(self._pending))
+        self._pending.append(req)
+        self._m_pending.set(len(self._pending))
+        self._m_sub.add()
+        return req
+
+    def _try_dispatch(self, req: RouterRequest) -> bool:
+        """Place `req` on the least-loaded live replica that accepts
+        it. Deadlines re-scope to the REMAINING budget (wall seconds
+        since the router submit; router ticks double as engine ticks —
+        every router step ticks every live replica once)."""
+        never_fits = 0
+        live = sorted(self.live(), key=_Replica.load)
+        for rep in live:
+            dl_s = req.deadline_s
+            if dl_s is not None:
+                dl_s = max(dl_s - (time.perf_counter() - req.t_submit),
+                           1e-6)
+            dl_t = req.deadline_ticks
+            if dl_t is not None:
+                dl_t = max(dl_t - (self._ticks - req._tick_submit), 1)
+            try:
+                inner = rep.eng.submit(
+                    req.prompt, req.max_new_tokens,
+                    temperature=req.temperature, top_k=req.top_k,
+                    eos_id=req.eos_id, deadline_s=dl_s,
+                    deadline_ticks=dl_t)
+            except PoolExhaustedError:
+                never_fits += 1
+                continue
+            except BackpressureError:
+                continue
+            rep.inner[inner.id] = req
+            rep.m_disp.add()
+            req.replica = rep.idx
+            req._inner = inner
+            return True
+        if never_fits and never_fits == len(live):
+            raise PoolExhaustedError(
+                "request exceeds every live replica's page pool")
+        return False
+
+    # --------------------------------------------------------- the tick
+    def step(self):
+        """One router tick: dispatch what fits, advance every live
+        replica one engine tick, merge their emissions onto the outer
+        requests, and translate inner terminals exactly once. A replica
+        whose step ESCAPES (the engine self-heals internally — an
+        escape means the replica is gone) dies here and its in-flight
+        requests requeue."""
+        events: List[tuple] = []
+        self._dispatch_pending()
+        live = self.live()
+        results = {}
+        if self.concurrent and len(live) > 1:
+            if self._exec is None:
+                from concurrent.futures import ThreadPoolExecutor
+                self._exec = ThreadPoolExecutor(
+                    max_workers=len(self.replicas),
+                    thread_name_prefix="router")
+            futs = [(rep, self._exec.submit(rep.eng.step))
+                    for rep in live]
+            for rep, fut in futs:
+                try:
+                    results[rep.idx] = fut.result()
+                except Exception as e:             # noqa: BLE001
+                    results[rep.idx] = e
+        else:
+            for rep in live:
+                try:
+                    results[rep.idx] = rep.eng.step()
+                except Exception as e:             # noqa: BLE001
+                    results[rep.idx] = e
+        for rep in live:
+            res = results[rep.idx]
+            if isinstance(res, BaseException):
+                self.kill_replica(rep.idx, reason=f"step raised: {res}")
+                continue
+            for ireq, tok in res:
+                outer = rep.inner.get(ireq.id)
+                if outer is not None and not outer.done:
+                    outer.tokens.append(int(tok))
+                    events.append((outer, int(tok)))
+            self._sweep_terminals(rep)
+        self._ticks += 1
+        if not self.live():
+            self.abort_pending("evicted")
+        self._publish_gauges()
+        return events
+
+    def _dispatch_pending(self) -> None:
+        while self._pending:
+            head = self._pending[0]
+            if head.done:                     # cancelled while queued
+                self._pending.popleft()
+                continue
+            try:
+                placed = self._try_dispatch(head)
+            except PoolExhaustedError:
+                # a request that was queued because the one replica
+                # that could hold it backpressured now fits NO live
+                # replica (that replica died): resolve it terminally —
+                # PoolExhaustedError escapes submit() only, never
+                # step()/drain(), and no request is left in limbo
+                self._pending.popleft()
+                self._finish(head, "evicted")
+                continue
+            if not placed:
+                break
+            self._pending.popleft()
+        self._m_pending.set(len(self._pending))
+
+    def _sweep_terminals(self, rep: _Replica) -> None:
+        """Translate inner terminal resolutions (including ones with no
+        emission this tick — timeout/cancel/evict) to the outer
+        requests, exactly once."""
+        for iid in [iid for iid, outer in rep.inner.items()
+                    if outer._inner is not None and outer._inner.done]:
+            outer = rep.inner.pop(iid)
+            self._finish(outer, outer._inner.finish_reason)
+
+    def _publish_gauges(self) -> None:
+        self._m_live.set(len(self.live()))
+        self._m_pending.set(len(self._pending))
+        for rep in self.replicas:
+            rep.m_depth.set(rep.load() if rep.alive else 0)
+
+    # ------------------------------------------------------ terminality
+    def _finish(self, req: RouterRequest, reason: str) -> None:
+        if req.done:
+            return
+        req.done = True
+        req.finish_reason = reason
+        req._inner = None
+        self._m_done.add()
+
+    def cancel(self, req: RouterRequest) -> bool:
+        """Resolve `req` with finish_reason "cancelled" right now.
+        Returns False when it already resolved."""
+        if req.done:
+            return False
+        if req._inner is not None:
+            rep = self.replicas[req.replica]
+            rep.inner.pop(req._inner.id, None)
+            if rep.alive:
+                req._inner.cancel()       # frees the engine slot
+        else:
+            try:
+                self._pending.remove(req)
+            except ValueError:
+                pass
+            self._m_pending.set(len(self._pending))
+        self._finish(req, "cancelled")
+        return True
+
+    def abort_pending(self, reason: str = "evicted") -> int:
+        """Resolve EVERY live request (router-queued and on-replica)
+        with the terminal `reason` — no request in limbo. Returns the
+        number aborted."""
+        if reason not in TERMINAL_REASONS:
+            raise ValueError(f"reason {reason!r} not in "
+                             f"{sorted(TERMINAL_REASONS)}")
+        n = 0
+        while self._pending:
+            self._finish(self._pending.popleft(), reason)
+            n += 1
+        for rep in self.replicas:
+            for outer in list(rep.inner.values()):
+                if outer.done:
+                    continue
+                if rep.alive and outer._inner is not None:
+                    outer._inner.cancel()
+                self._finish(outer, reason)
+                n += 1
+            rep.inner.clear()
+        self._publish_gauges()
+        return n
+
+    # ---------------------------------------------------- replica death
+    def kill_replica(self, idx: int, reason: str = "killed") -> int:
+        """Take replica `idx` out of rotation NOW. Un-terminal requests
+        it held requeue at the HEAD of the router queue (they waited
+        longest) and replay from scratch on a survivor — their token
+        lists reset so the final streams carry no duplicates; already-
+        terminal requests stay resolved (exactly-once). Returns the
+        number requeued. Idempotent; leaves a flight-recorder dump."""
+        rep = self.replicas[idx]
+        if not rep.alive:
+            return 0
+        rep.alive = False
+        self._m_deaths.add()
+        victims = [o for o in rep.inner.values() if not o.done]
+        rep.inner.clear()
+        for outer in victims:
+            outer.tokens.clear()          # replay regenerates the stream
+            outer._inner = None
+            outer.replica = None
+            outer.requeues += 1
+            self._m_requeue.add()
+        self._pending.extendleft(reversed(victims))
+        self._flight.note(router_replica_death=idx, reason=reason,
+                          requeued=len(victims), tick=self._ticks)
+        self._flight.dump("router_replica_death")
+        if not self.live():
+            self.abort_pending("evicted")
+        self._publish_gauges()
+        return len(victims)
+
+    # ------------------------------------------------------ conveniences
+    def drain(self, max_ticks: Optional[int] = None):
+        events = []
+        ticks = 0
+        while self.has_work():
+            events.extend(self.step())
+            ticks += 1
+            if max_ticks is not None and ticks >= max_ticks:
+                break
+        return events
+
+    def generate(self, prompts: Sequence, max_new_tokens: int,
+                 temperature: float = 0.0, top_k: int = 0,
+                 eos_id: Optional[int] = None,
+                 deadline_s: Optional[float] = None,
+                 deadline_ticks: Optional[int] = None,
+                 max_ticks: Optional[int] = None) -> List[np.ndarray]:
+        """Batch convenience mirroring ServingEngine.generate: submit
+        every prompt, drain, resolve stragglers ("evicted" — never
+        limbo), return each request's generated ids in order."""
+        reqs = [self.submit(p, max_new_tokens, temperature=temperature,
+                            top_k=top_k, eos_id=eos_id,
+                            deadline_s=deadline_s,
+                            deadline_ticks=deadline_ticks)
+                for p in prompts]
+        self.drain(max_ticks)
+        for r in reqs:
+            if not r.done:
+                self.cancel(r)
+                r.finish_reason = "evicted"
+        return [np.asarray(r.tokens, np.int32) for r in reqs]
+
+
+def create_router(params, cfg, replicas: int = 2, family: str = "gpt",
+                  max_queue: int = 0, queue_policy: str = "reject",
+                  concurrent: bool = True,
+                  meshes: Optional[Sequence] = None,
+                  **engine_kw) -> EngineRouter:
+    """Build an EngineRouter over `replicas` identical ServingEngines
+    sharing ONE param tree (read-only at decode — on a single host the
+    replicas share the arrays; in a real deployment each replica's
+    params live on its own devices). `meshes` optionally gives each
+    replica its own tensor-parallel mesh (inference/serving.py mesh=)
+    — the dp(router) x tp(engine) composition."""
+    if replicas < 1:
+        raise ValueError(f"replicas must be >= 1; got {replicas}")
+    if meshes is not None and len(meshes) != replicas:
+        raise ValueError(f"meshes ({len(meshes)}) must match "
+                         f"replicas ({replicas})")
+    engines = [ServingEngine(params, cfg, family=family,
+                             mesh=None if meshes is None else meshes[i],
+                             **engine_kw)
+               for i in range(replicas)]
+    return EngineRouter(engines, max_queue=max_queue,
+                        queue_policy=queue_policy, concurrent=concurrent)

@@ -156,6 +156,41 @@ cf. Leviathan et al. 2023; OFF by default):
   logits quarantine, and only over emitted rows. testing/faults.py
   `draft_nan` + tools/chaos_serving.py drill this.
 
+Tensor-parallel serving (mesh= — the scale-UP layer, cf. the
+reference's hybrid-parallel fleet topology
+fleet/base/topology.py:54,140 applied to the decode path, and
+SNIPPETS.md [3]'s PartitionSpec layout):
+
+- **One engine, one mesh.** `mesh=build_mesh({'tp': N})` shards THIS
+  engine's jitted bodies (decode tick, bucketed/chunked prefill, COW
+  copy, spec tick) over the mesh's `tp` axis: params per the family's
+  module-level SERVING_PARAM_SPECS (the training PARAM_SPECS TP split
+  remapped by parallel.mesh.tp_specs — column-parallel qkv/up,
+  row-parallel out/down, vocab-parallel embedding), the KV cache/page
+  pool head-sharded per kernels/decode_attention.cache_pspecs (the
+  page table replicated; a KV-head count the tp degree doesn't divide
+  degrades that leaf to replicated — deep GQA), the per-slot decode
+  state replicated. GSPMD inserts the two activation all-reduces per
+  layer the reference's mp_ops issues by hand.
+- **Invariants per mesh.** Still ONE host pull per tick (the pulled
+  token array is replicated — one small fetch); zero recompiles after
+  warmup (`_pin_cache` pins every jitted body's returned cache leaves
+  to their input NamedShardings, so donation aliases exactly and
+  propagation heuristics can't shift layouts between calls); every
+  host->device upload routes through `_rep` (replicated device_put)
+  so placements are mesh-consistent by construction. Token streams
+  are BIT-IDENTICAL to the unsharded engine (greedy argmax and the
+  partitionable-threefry sampled path both survive sharding — the
+  8-virtual-device CPU-mesh suite tests/test_tp_serving.py pins it).
+- **Composition.** tp composes with everything above: paged pool,
+  chunked prefill, speculative decode (the draft's first-K-layers
+  cache view inherits the head sharding). Horizontal scaling stacks
+  on top via the replicated-engine router (inference/router.py):
+  data-parallel engine replicas behind least-loaded admission —
+  dp(router) x tp(engine). parallel.planner.plan_serving_tp prices
+  when tp pays (the decode tick is weight-bandwidth bound; a model
+  bigger than one chip forces tp > 1).
+
 Observability: serving.* monitor counters/gauges (slot occupancy,
 queue depth, tokens emitted, prefills, decode ticks, plus
 rejected/timeout/cancelled/poisoned/evicted/retries/faults, the
@@ -240,21 +275,27 @@ class ModelFamily:
     """The seam a model family exposes to the engine: a cached forward
     that accepts per-row positions (slot-indexed writes) and a cache
     factory. Both flagship decoders qualify; any future family that
-    implements the same contract plugs in here."""
+    implements the same contract plugs in here. `serving_specs` is the
+    family's module-level tensor-parallel spec table (leaf name ->
+    PartitionSpec over the serving mesh's 'tp' axis — models/gpt.py /
+    models/llama.py SERVING_PARAM_SPECS); None means the family cannot
+    shard (mesh= is then refused)."""
     name: str
     forward_cached: Callable    # (params, tokens[B,T], cache, pos, cfg)
     init_cache: Callable        # (cfg, batch, max_len) -> {"k","v"}
+    serving_specs: Optional[dict] = None
 
 
 def family_for(name: str) -> ModelFamily:
     if name == "gpt":
         from ..models import gpt
         return ModelFamily("gpt", gpt.gpt_forward_cached,
-                           gpt.init_kv_cache)
+                           gpt.init_kv_cache, gpt.SERVING_PARAM_SPECS)
     if name == "llama":
         from ..models import llama
         return ModelFamily("llama", llama.llama_forward_cached,
-                           llama.init_kv_cache)
+                           llama.init_kv_cache,
+                           llama.SERVING_PARAM_SPECS)
     raise ValueError(f"unknown model family {name!r} (gpt|llama)")
 
 
@@ -452,13 +493,29 @@ def _sample(lg, temps, top_ks, keys, max_top_k: int):
     return jnp.where(temps <= 0.0, greedy, sampled).astype(jnp.int32)
 
 
+def _pin_cache(cache, pin):
+    """Pin the returned cache leaves to their input NamedShardings
+    (tensor-parallel serving, `mesh=`): GSPMD would usually propagate
+    the same layout, but pinning makes it a contract — the donated
+    buffers alias exactly (out sharding == in sharding) and the tick's
+    executable count cannot drift with propagation heuristics. `pin`
+    is a {leaf: NamedSharding} dict closed over the jit (hashable,
+    non-traced); None/missing leaves pass through untouched."""
+    if not pin:
+        return cache
+    return {k: (jax.lax.with_sharding_constraint(v, pin[k])
+                if pin.get(k) is not None else v)
+            for k, v in cache.items()}
+
+
 # --------------------------------------------------------- jitted bodies
 # slot-state tuple riding through the decode tick (all [N], device-
 # resident and DONATED alongside the cache — the host only downloads
 # the sampled tokens, one small pull per tick)
 #   (cur_tok, positions, active, temps, top_ks, req_ids, gen_idx)
 def _decode_tick(params, cache, state, base_key, poison, *, fwd, cfg,
-                 max_top_k, sampling, guard, oor_pos=None):
+                 max_top_k, sampling, guard, oor_pos=None,
+                 cache_pin=None):
     """THE mixed step: all N slots advance one token. Each slot's
     current token is written at its own position; sampling runs in-jit;
     inactive slots compute too (fixed shape) but their output is masked
@@ -499,12 +556,12 @@ def _decode_tick(params, cache, state, base_key, poison, *, fwd, cfg,
     inc = active.astype(jnp.int32)
     state = (nxt, positions + inc, active, temps, top_ks, req_ids,
              gen_idx + inc)
-    return nxt, cache, state
+    return nxt, _pin_cache(cache, cache_pin), state
 
 
 def _prefill_slot(params, cache, padded, true_len, slot, temps, top_ks,
                   req_ids, base_key, *, fwd, init_cache, cfg, max_top_k,
-                  sampling, guard):
+                  sampling, guard, cache_pin=None):
     """Bucketed prefill of ONE request into slot `slot`: run the padded
     prompt through a fresh single-row BUCKET-length cache (bit-identical
     K/V and logits to the greedy driver's full-length prefill — the
@@ -533,12 +590,12 @@ def _prefill_slot(params, cache, padded, true_len, slot, temps, top_ks,
         "v": jax.lax.dynamic_update_slice(
             cache["v"], mini["v"], (0, slot, 0, 0, 0)),
     }
-    return first, cache
+    return first, _pin_cache(cache, cache_pin)
 
 
 def _prefill_chunk(params, cache, padded, true_len, start, slot, temps,
                    top_ks, req_ids, base_key, *, fwd, cfg, max_top_k,
-                   sampling, guard):
+                   sampling, guard, cache_pin=None):
     """Paged/chunked prefill of ONE chunk into slot `slot`: run the
     padded chunk [1, cb] at absolute positions start.. against the
     slot's single-row paged view (its page-table row sliced out of the
@@ -564,10 +621,11 @@ def _prefill_chunk(params, cache, padded, true_len, start, slot, temps,
         first = jnp.argmax(last, axis=-1).astype(jnp.int32)[0]
     if guard:
         first = jnp.where(jnp.all(jnp.isfinite(last)), first, -1)
-    return first, {"k": sub["k"], "v": sub["v"], "pt": cache["pt"]}
+    out = {"k": sub["k"], "v": sub["v"], "pt": cache["pt"]}
+    return first, _pin_cache(out, cache_pin)
 
 
-def _cow_copy(cache, src, dst):
+def _cow_copy(cache, src, dst, *, cache_pin=None):
     """Copy page `src` onto page `dst` across every layer of the pool
     (both k and v) — THE copy-on-write materialization, one jitted
     in-pool dynamic slice/update on the donated buffers; src/dst are
@@ -577,7 +635,7 @@ def _cow_copy(cache, src, dst):
         pg = jax.lax.dynamic_slice_in_dim(cache[key], src, 1, axis=1)
         out[key] = jax.lax.dynamic_update_slice(
             cache[key], pg, (0, dst, 0, 0, 0))
-    return out
+    return _pin_cache(out, cache_pin)
 
 
 # ----------------------------------------------------------- the engine
@@ -605,11 +663,39 @@ class ServingEngine:
                  page_size: int = 16, num_pages: int = 0,
                  prefill_chunk: int = 0, prefix_sharing: bool = True,
                  spec_decode: str = "auto", gamma: int = 4,
-                 draft_layers: int = 0):
+                 draft_layers: int = 0, mesh=None, tp_axis: str = "tp"):
         self.family = (family_for(family) if isinstance(family, str)
                        else family)
         self.cfg = cfg
         self.num_slots = int(num_slots)
+        # --------------------------------------- tensor-parallel serving
+        # mesh= shards THIS engine's decode tick over `tp_axis`: params
+        # per the family's module-level SERVING_PARAM_SPECS (the
+        # training TP split remapped — parallel.mesh.tp_specs), the KV
+        # cache/page pool per kernels/decode_attention.cache_pspecs
+        # (head-sharded, shape-aware degrade to replicated), page
+        # tables and the per-slot decode state replicated. Every
+        # host->device upload goes through _rep so the jitted bodies
+        # only ever see mesh-consistent placements; the host pull stays
+        # ONE small (replicated) array per tick per mesh.
+        self.mesh = mesh
+        self.tp_axis = str(tp_axis)
+        if mesh is not None:
+            if self.tp_axis not in mesh.axis_names:
+                raise ValueError(
+                    f"mesh {dict(mesh.shape)} has no {self.tp_axis!r} "
+                    "axis (build it via parallel.mesh.build_mesh("
+                    "{'tp': N}) or pass tp_axis=)")
+            if self.family.serving_specs is None:
+                raise ValueError(
+                    f"family {self.family.name!r} has no "
+                    "SERVING_PARAM_SPECS — it cannot run tensor-"
+                    "parallel (see models/gpt.py)")
+        self.tp = int(mesh.shape[self.tp_axis]) if mesh is not None else 1
+        self._rep_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            self._rep_sharding = NamedSharding(mesh, PartitionSpec())
         # ------------------------------------------- speculative decode
         # knob 'auto' consults env > registry ('spec_decode') > off;
         # the env's off values kill-switch even an explicit 'spec'
@@ -670,7 +756,9 @@ class ServingEngine:
                 "beyond the table would clamp, not error")
         self.max_top_k = int(max_top_k)
         self.bucket_lo = int(bucket_lo)
-        self._params = params
+        self._params = (self._shard_params(params) if mesh is not None
+                        else params)
+        self._cache_pin = None        # leaf -> NamedSharding under mesh=
         if self.paged:
             if self.page_size < 1:
                 raise ValueError(f"page_size must be >= 1; "
@@ -686,11 +774,8 @@ class ServingEngine:
             self._ptab = np.zeros((self.num_slots, self.max_pages),
                                   np.int32)
             self._pt_dirty = False
-            self._cache = self._init_paged_cache()
-        else:
-            self._cache = self.family.init_cache(cfg, self.num_slots,
-                                                 self.max_len)
-        self._base_key = jax.random.PRNGKey(seed)
+        self._cache = self._new_cache()
+        self._base_key = self._rep(jax.random.PRNGKey(seed))
 
         # at T=1 the layer scan's cache slice/restack dominates the
         # matvecs: fully unroll shallow stacks (bit-identical numerics —
@@ -729,8 +814,8 @@ class ServingEngine:
         self._queue: collections.deque = collections.deque()
         self._next_id = 0
         self._ticks = 0                  # step() calls (fault/deadline clock)
-        self._poison_ones = jnp.ones((n,), jnp.float32)  # reused: the
-        #                      steady-state tick uploads NO poison array
+        self._poison_ones = self._rep(np.ones(n, np.float32))  # reused:
+        #                  the steady-state tick uploads NO poison array
         # SLO samples (host wall-clock, ms): TTFT includes queue wait;
         # inter-token latency is per-emission, quantized to tick times
         self._slo_ttft: collections.deque = collections.deque(maxlen=8192)
@@ -746,23 +831,29 @@ class ServingEngine:
                                   guard=self.guardrails,
                                   gamma=self.spec_gamma,
                                   draft_layers=self.spec_draft_layers,
-                                  oor_pos=_oor),
+                                  oor_pos=_oor,
+                                  cache_pin=self._cache_pin),
                 donate_argnums=(1, 2), static_argnames=("sampling",))
         else:
             self._decode = jax.jit(
                 functools.partial(_decode_tick,
                                   fwd=self.family.forward_cached,
                                   cfg=run_cfg, max_top_k=self.max_top_k,
-                                  guard=self.guardrails, oor_pos=_oor),
+                                  guard=self.guardrails, oor_pos=_oor,
+                                  cache_pin=self._cache_pin),
                 donate_argnums=(1, 2), static_argnames=("sampling",))
         if self.paged:
             self._prefill = jax.jit(
                 functools.partial(_prefill_chunk,
                                   fwd=self.family.forward_cached,
                                   cfg=run_cfg, max_top_k=self.max_top_k,
-                                  guard=self.guardrails),
+                                  guard=self.guardrails,
+                                  cache_pin=self._cache_pin),
                 donate_argnums=(1,), static_argnames=("sampling",))
-            self._cow = jax.jit(_cow_copy, donate_argnums=(0,))
+            self._cow = jax.jit(
+                functools.partial(_cow_copy,
+                                  cache_pin=self._cache_pin),
+                donate_argnums=(0,))
             self._slot_reserve = np.zeros(self.num_slots, np.int64)
             self._prefilling: collections.deque = collections.deque()
             self._raise_cow = False          # injected cow_raise fault
@@ -772,7 +863,8 @@ class ServingEngine:
                                   fwd=self.family.forward_cached,
                                   init_cache=self.family.init_cache,
                                   cfg=run_cfg, max_top_k=self.max_top_k,
-                                  guard=self.guardrails),
+                                  guard=self.guardrails,
+                                  cache_pin=self._cache_pin),
                 donate_argnums=(1,), static_argnames=("sampling",))
 
         from ..profiler import flight_recorder
@@ -823,6 +915,67 @@ class ServingEngine:
         return {"k": jnp.zeros(pages, probe["k"].dtype),
                 "v": jnp.zeros(pages, probe["v"].dtype),
                 "pt": jnp.asarray(self._ptab)}
+
+    # --------------------------------------------- tensor-parallel seams
+    def _rep(self, x, dtype=None):
+        """Upload one host value to the device(s): plain jnp.asarray on
+        a single-device engine; REPLICATED over the serving mesh under
+        mesh= (a committed single-device array mixed into a sharded jit
+        would be a placement error). Every host->device upload in the
+        engine routes here, so the tick's inputs are mesh-consistent by
+        construction."""
+        if self._rep_sharding is None:
+            return (jnp.asarray(x) if dtype is None
+                    else jnp.asarray(x, dtype))
+        a = np.asarray(x, dtype) if dtype is not None else np.asarray(x)
+        return jax.device_put(a, self._rep_sharding)
+
+    def _shard_params(self, params):
+        """device_put the param tree per the family's module-level
+        SERVING_PARAM_SPECS (heads/ffn column-row split on the tp
+        axis, embeddings vocab-parallel, norms replicated); leaves the
+        table doesn't name — and dims the tp degree doesn't divide —
+        replicate (parallel.mesh.sharding_for's shape-aware degrade)."""
+        from jax.sharding import PartitionSpec
+        from ..parallel.mesh import sharding_for
+        specs = self.family.serving_specs or {}
+        return {name: jax.device_put(
+                    v, sharding_for(specs.get(name, PartitionSpec()),
+                                    self.mesh, shape=np.shape(v)))
+                for name, v in params.items()}
+
+    def _new_cache(self):
+        """Allocate the pool cache (dense slot pool or paged block
+        pool), sharded over the serving mesh when one is set — the KV-
+        head axis per kernels/decode_attention.cache_pspecs, the page
+        table replicated. Shared by __init__ and _hard_reset so a
+        recovery reallocation can never come back with a different
+        layout (the jitted tick would silently recompile). Under mesh=
+        the pool is born sharded — jit with out_shardings, shapes from
+        eval_shape — so no device ever holds the WHOLE pool, even
+        transiently: the point of tp is a KV pool bigger than one
+        chip's HBM, and a full-pool staging allocation would OOM at
+        construction exactly when tp matters. (Params take the same
+        no-staging path for free: _shard_params device_puts the host
+        tree straight to its NamedShardings.)"""
+        def mk():
+            if self.paged:
+                return self._init_paged_cache()
+            return self.family.init_cache(self.cfg, self.num_slots,
+                                          self.max_len)
+        if self.mesh is None:
+            return mk()
+        if self._cache_pin is None:
+            from ..kernels.decode_attention import cache_pspecs
+            from ..parallel.mesh import sharding_for
+            from jax.sharding import PartitionSpec
+            specs = cache_pspecs(self.paged, self.tp_axis)
+            shapes = jax.eval_shape(mk)
+            self._cache_pin = {
+                k: sharding_for(specs.get(k, PartitionSpec()),
+                                self.mesh, shape=v.shape)
+                for k, v in shapes.items()}
+        return jax.jit(mk, out_shardings=self._cache_pin)()
 
     def pool_stats(self) -> dict:
         """The kv-pool observable (paged layout only): page states,
@@ -1180,11 +1333,8 @@ class ServingEngine:
             self._ptab[:] = 0
             self._slot_reserve[:] = 0
             self._prefilling.clear()
-            self._cache = self._init_paged_cache()
             self._pt_dirty = False
-        else:
-            self._cache = self.family.init_cache(self.cfg, self.num_slots,
-                                                 self.max_len)
+        self._cache = self._new_cache()
         self._dstate = None
         self._dirty = True
         self._flight.configure(last_serving_fault=f"hard_reset: {reason}")
@@ -1277,24 +1427,24 @@ class ServingEngine:
                     # finds them already allocated)
                     self._prepare_tick_pages()
                     if self._pt_dirty:
-                        self._cache["pt"] = jnp.asarray(self._ptab)
+                        self._cache["pt"] = self._rep(self._ptab)
                         self._pt_dirty = False
                 if self._dirty:
                     self._dstate = (
-                        jnp.asarray(self._cur_tok),
-                        jnp.asarray(self._positions),
-                        jnp.asarray(self._active),
-                        jnp.asarray(self._temps),
-                        jnp.asarray(self._top_ks),
-                        jnp.asarray(self._req_ids),
-                        jnp.asarray(self._gen_idx))
+                        self._rep(self._cur_tok),
+                        self._rep(self._positions),
+                        self._rep(self._active),
+                        self._rep(self._temps),
+                        self._rep(self._top_ks),
+                        self._rep(self._req_ids),
+                        self._rep(self._gen_idx))
                     self._dirty = False
                 sampling = bool(np.any(self._temps[self._active] > 0.0))
                 poison = self._poison_ones
                 if poison_slot is not None and self.guardrails:
                     p = np.ones(self.num_slots, np.float32)
                     p[int(poison_slot) % self.num_slots] = np.nan
-                    poison = jnp.asarray(p)
+                    poison = self._rep(p)
                 poison_slot = None        # injected at most once
                 with RecordEvent("serving.decode_tick"):
                     if self.spec:
@@ -1302,7 +1452,7 @@ class ServingEngine:
                         if draft_slot is not None:
                             dp = np.ones(self.num_slots, np.float32)
                             dp[int(draft_slot) % self.num_slots] = np.nan
-                            dpoison = jnp.asarray(dp)
+                            dpoison = self._rep(dp)
                         draft_slot = None     # injected at most once
                         nxt, self._cache, self._dstate = self._decode(
                             self._params, self._cache, self._dstate,
@@ -1442,11 +1592,11 @@ class ServingEngine:
         padded[0, :t0] = req.prompt
         with RecordEvent("serving.prefill"):
             first, self._cache = self._prefill(
-                self._params, self._cache, jnp.asarray(padded),
-                jnp.asarray(t0, jnp.int32), jnp.asarray(slot, jnp.int32),
-                jnp.asarray([req.temperature], jnp.float32),
-                jnp.asarray([req.top_k], jnp.int32),
-                jnp.asarray([req.id], jnp.int32), self._base_key,
+                self._params, self._cache, self._rep(padded),
+                self._rep(t0, np.int32), self._rep(slot, np.int32),
+                self._rep([req.temperature], np.float32),
+                self._rep([req.top_k], np.int32),
+                self._rep([req.id], np.int32), self._base_key,
                 sampling=req.temperature > 0.0)
             # first generated token — the admission's one host pull,
             # under the same watchdog as the tick's
@@ -1591,18 +1741,18 @@ class ServingEngine:
         padded = np.zeros((1, cb), np.int32)
         padded[0, :clen] = req.prompt[start:end]
         if self._pt_dirty:
-            self._cache["pt"] = jnp.asarray(self._ptab)
+            self._cache["pt"] = self._rep(self._ptab)
             self._pt_dirty = False
         final = end == t0
         with RecordEvent("serving.prefill"):
             first, self._cache = self._prefill(
-                self._params, self._cache, jnp.asarray(padded),
-                jnp.asarray(clen, jnp.int32),
-                jnp.asarray(start, jnp.int32),
-                jnp.asarray(slot, jnp.int32),
-                jnp.asarray([req.temperature], jnp.float32),
-                jnp.asarray([req.top_k], jnp.int32),
-                jnp.asarray([req.id], jnp.int32), self._base_key,
+                self._params, self._cache, self._rep(padded),
+                self._rep(clen, np.int32),
+                self._rep(start, np.int32),
+                self._rep(slot, np.int32),
+                self._rep([req.temperature], np.float32),
+                self._rep([req.top_k], np.int32),
+                self._rep([req.id], np.int32), self._base_key,
                 sampling=final and req.temperature > 0.0)
             tok = int(self._pull(first)) if final else None
         self._m_chunks.add()
@@ -1695,8 +1845,8 @@ class ServingEngine:
         new = self._alloc_slot_page(slot, j)
         if pid != 0:
             self._cache = self._cow(self._cache,
-                                    jnp.asarray(pid, jnp.int32),
-                                    jnp.asarray(new, jnp.int32))
+                                    self._rep(pid, np.int32),
+                                    self._rep(new, np.int32))
             self._pool.release(pid)
             self._m_cow.add()
         return new

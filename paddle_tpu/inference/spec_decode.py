@@ -149,7 +149,7 @@ def resolve_spec(knob: str) -> bool:
 
 def spec_tick(params, cache, state, base_key, poison, draft_poison, *,
               fwd, cfg, max_top_k, sampling, guard, gamma, draft_layers,
-              oor_pos=None):
+              oor_pos=None, cache_pin=None):
     """THE speculative mixed step (the spec-mode replacement for
     serving._decode_tick, same state tuple / donation / static
     `sampling` flag). Per active slot: gamma truncated-depth draft
@@ -165,8 +165,16 @@ def spec_tick(params, cache, state, base_key, poison, draft_poison, *,
     the jit): a non-finite draft row forces acceptance 0 — the slot
     degrades to non-spec decode, never quarantine, because verify row
     0 is the target's own logits. `poison` is the TARGET lane, handled
-    exactly as in the non-spec tick."""
-    from .serving import _sample, _slot_keys
+    exactly as in the non-spec tick.
+
+    Tensor-parallel serving (ServingEngine mesh=): the draft's
+    first-K-layers throwaway cache view inherits the pool's head
+    sharding (a leading-axis slice never moves the KV-head axis), the
+    verify pass writes through the same sharded seam, and `cache_pin`
+    pins the returned pool leaves to their input NamedShardings
+    exactly like the non-spec tick (serving._pin_cache) — donation
+    aliases, zero recompiles, still one [N, gamma+1] pull per mesh."""
+    from .serving import _pin_cache, _sample, _slot_keys
     from ..models.decode import greedy_accept
 
     toks, positions, active, temps, top_ks, req_ids, gen_idx = state
@@ -240,4 +248,4 @@ def spec_tick(params, cache, state, base_key, poison, draft_poison, *,
     new_tok = jnp.where(active, last, toks).astype(jnp.int32)
     new_state = (new_tok, positions + adv, active, temps, top_ks,
                  req_ids, gen_idx + adv)
-    return emit, cache, new_state
+    return emit, _pin_cache(cache, cache_pin), new_state

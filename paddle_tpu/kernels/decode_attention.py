@@ -53,7 +53,30 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["write_kv", "cached_attention", "decode_attn_impl",
-           "gather_pages", "write_kv_paged", "attn_math_impl"]
+           "gather_pages", "write_kv_paged", "attn_math_impl",
+           "cache_pspecs"]
+
+
+def cache_pspecs(paged: bool, tp_axis: str = "tp"):
+    """PartitionSpecs for the decode-cache leaves on a tensor-parallel
+    serving mesh (inference/serving.py `mesh=`). Both layouts are
+    rank-5 with the KV-head axis at position 3 — dense
+    [L, N, max_len, KV, hd] and paged [L, P, page_size, KV, hd] — so
+    ONE spec head-shards either: every device holds every slot's (or
+    page's) full position range for ITS heads, which keeps write_kv /
+    write_kv_paged's scatters and gather_pages' page gather local
+    (no resharding inside the tick). The page table is replicated —
+    it indexes pages, not heads, and every shard needs the whole map.
+    When tp does not divide the KV heads (deep-GQA, e.g. 2 KV heads on
+    tp=4) the engine's shape-aware degrade (parallel.mesh.sharding_for
+    with shape=) drops the head axis to replicated — the
+    "replicated-or-head-sharded" choice, made per leaf."""
+    from jax.sharding import PartitionSpec as P
+    kv = P(None, None, None, tp_axis, None)
+    specs = {"k": kv, "v": kv}
+    if paged:
+        specs["pt"] = P()
+    return specs
 
 
 def decode_attn_impl() -> str:

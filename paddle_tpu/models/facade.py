@@ -93,7 +93,8 @@ class FacadeModel:
                  max_len=None, temperature=0.0, top_k=0, eos_id=None,
                  max_top_k=0, seed=0, deadline_s=None,
                  deadline_ticks=None, max_ticks=None, spec_decode=None,
-                 gamma=None, draft_layers=None, **engine_kw):
+                 gamma=None, draft_layers=None, mesh=None,
+                 tp_axis="tp", **engine_kw):
         """Continuous-batching generation over this model's params
         (inference/serving.py): prompts is a list of 1-D int token-id
         sequences of MIXED lengths; returns one generated-id array per
@@ -117,7 +118,15 @@ class FacadeModel:
         (inference/spec_decode.py; PADDLE_TPU_SPEC_DECODE is the kill
         switch) and join the engine cache key — switching gamma or
         draft depth rebuilds the engine rather than serving a tick
-        compiled for the old knobs."""
+        compiled for the old knobs.
+
+        Tensor-parallel serving: `mesh` (a jax Mesh with a `tp_axis`
+        axis — parallel.mesh.build_mesh({'tp': N})) shards the engine's
+        decode tick, KV pool and params over the mesh
+        (inference/serving.py mesh=). The mesh TOPOLOGY (axis sizes,
+        device order, tp_axis) joins the engine cache key: a resharded
+        model silently reusing an engine compiled for another mesh (or
+        for one device) would serve from the wrong layout."""
         for k, v in (("spec_decode", spec_decode), ("gamma", gamma),
                      ("draft_layers", draft_layers)):
             if v is not None:
@@ -126,22 +135,31 @@ class FacadeModel:
             raise NotImplementedError(
                 f"{type(self).__name__} is not a cached decoder family; "
                 "generate() needs _serving_family")
+        # mesh topology + tp degree, canonicalized (two meshes over the
+        # same devices in the same order are the same engine; anything
+        # else — axis sizes, device set/order, the tp axis name — is a
+        # rebuild)
+        mesh_key = None
+        if mesh is not None:
+            mesh_key = (str(tp_axis), tuple(mesh.shape.items()),
+                        tuple(str(d) for d in mesh.devices.flat))
         from ..framework.dispatch import raw_value
-        key = (num_slots, max_len, max_top_k, seed,
+        key = (num_slots, max_len, max_top_k, seed, mesh_key,
                tuple(sorted(engine_kw.items())),
                tuple(raw_value(self._params[n])
                      for n in self._param_names))
         eng = getattr(self, "_serving_engine", None)
         cached_key = getattr(self, "_serving_engine_key", None)
         if (eng is None or cached_key is None
-                or cached_key[:5] != key[:5]
-                or len(cached_key) != 6
+                or len(cached_key) != 7
+                or cached_key[:6] != key[:6]
                 or any(a is not b
-                       for a, b in zip(cached_key[5], key[5]))):
+                       for a, b in zip(cached_key[6], key[6]))):
             from ..inference.serving import create_serving_engine
             eng = create_serving_engine(
                 self, num_slots=num_slots, max_len=max_len,
-                max_top_k=max_top_k, seed=seed, **engine_kw)
+                max_top_k=max_top_k, seed=seed, mesh=mesh,
+                tp_axis=tp_axis, **engine_kw)
             self._serving_engine = eng
             self._serving_engine_key = key
         return eng.generate(prompts, max_new_tokens,

@@ -138,6 +138,16 @@ PARAM_SPECS: Dict[str, P] = {
 }
 
 
+# Serving/decode tensor-parallel specs: the SAME column/row split as
+# PARAM_SPECS, remapped onto the serving mesh's single 'tp' axis
+# (parallel.mesh.tp_specs — dp/fsdp/pp drop: the slot pool owns the
+# batch and the layer stack scans on-chip at decode). Consumed by
+# inference/serving.py `mesh=`; the KV cache's head axis shards
+# through kernels/decode_attention.cache_pspecs.
+from ..parallel.mesh import tp_specs as _tp_specs
+SERVING_PARAM_SPECS: Dict[str, P] = _tp_specs(PARAM_SPECS)
+
+
 def init_gpt_params(cfg: GPTConfig, key) -> Dict[str, jax.Array]:
     """Initialize the parameter pytree (host-side, then shard via
     paddle_tpu.parallel.mesh.shard_value per PARAM_SPECS)."""

@@ -93,6 +93,12 @@ PARAM_SPECS: Dict[str, P] = {
 _BLOCK_KEYS = ("attn_norm", "q_w", "k_w", "v_w", "o_w",
                "ffn_norm", "gate_w", "up_w", "down_w")
 
+# serving/decode tensor-parallel specs (same derivation as
+# models/gpt.py SERVING_PARAM_SPECS: the training TP split remapped
+# onto the serving mesh's 'tp' axis; inference/serving.py `mesh=`)
+from ..parallel.mesh import tp_specs as _tp_specs
+SERVING_PARAM_SPECS: Dict[str, P] = _tp_specs(PARAM_SPECS)
+
 
 def init_llama_params(cfg: LlamaConfig, key) -> Dict[str, jax.Array]:
     D, F, L = cfg.hidden_size, cfg.ffn_hidden, cfg.num_layers

@@ -80,7 +80,8 @@ class use_mesh:
         return False
 
 
-def sharding_for(spec: PartitionSpec, mesh: Optional[Mesh] = None
+def sharding_for(spec: PartitionSpec, mesh: Optional[Mesh] = None,
+                 shape: Optional[Sequence[int]] = None
                  ) -> Optional[NamedSharding]:
     mesh = mesh or get_mesh()
     if mesh is None:
@@ -96,7 +97,53 @@ def sharding_for(spec: PartitionSpec, mesh: Optional[Mesh] = None
             cleaned.append(keep if keep else None)
         else:
             cleaned.append(entry if entry in mesh.axis_names else None)
+    if shape is not None:
+        # shape-aware degrade: an axis whose mesh size does not divide
+        # the dim drops to replicated instead of erroring (e.g. a GQA
+        # cache with 2 KV heads on a tp=4 mesh keeps its pages
+        # replicated — the serving engine's "replicated-or-head-
+        # sharded" choice, made per leaf)
+        for i, entry in enumerate(cleaned):
+            if entry is None or i >= len(shape):
+                continue
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            n = int(np.prod([mesh.shape[a] for a in axes]))
+            if n == 0 or shape[i] % n != 0:
+                cleaned[i] = None
     return NamedSharding(mesh, PartitionSpec(*cleaned))
+
+
+def remap_spec_axes(spec: PartitionSpec, mapping: Dict[str, str]
+                    ) -> PartitionSpec:
+    """Rename mesh axes inside a PartitionSpec: entries map through
+    `mapping`; axes absent from the mapping drop to None (replicated).
+    Tuple entries keep only their mapped members."""
+    out = []
+    for entry in spec:
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, (tuple, list)):
+            keep = tuple(mapping[a] for a in entry if a in mapping)
+            out.append(keep if len(keep) > 1
+                       else (keep[0] if keep else None))
+        else:
+            out.append(mapping.get(entry))
+    return PartitionSpec(*out)
+
+
+def tp_specs(param_specs: Dict[str, PartitionSpec], src: str = "mp",
+             axis: str = "tp") -> Dict[str, PartitionSpec]:
+    """Derive a decode/serving tensor-parallel spec table from a
+    training PARAM_SPECS table: the TP split (the reference's
+    ColumnParallel/RowParallel layout on `src`, conventionally 'mp')
+    survives on `axis`; every other training axis drops to replicated —
+    dp/fsdp batch-shard the step, pp shards the stacked layer axis, and
+    at decode the layer stack scans on-chip while the slot pool owns the
+    batch. ONE derivation so the serving layout can never drift from
+    the training split (models/gpt.py, models/llama.py
+    SERVING_PARAM_SPECS)."""
+    return {k: remap_spec_axes(s, {src: axis})
+            for k, s in param_specs.items()}
 
 
 def shard_value(value, spec: PartitionSpec, mesh: Optional[Mesh] = None):

@@ -25,7 +25,7 @@ from typing import Dict, List, Optional, Sequence
 
 __all__ = ["ChipSpec", "ModelSpec", "Plan", "enumerate_plans",
            "plan_parallel", "spec_from_config", "spec_from_gpt_config",
-           "best_mesh_axes"]
+           "best_mesh_axes", "plan_serving_tp"]
 
 
 @dataclass(frozen=True)
@@ -34,6 +34,7 @@ class ChipSpec:
     parts — only ratios matter for the ranking)."""
     peak_flops: float = 197e12        # bf16 MXU peak
     hbm_bytes: float = 16e9
+    hbm_bw: float = 8.1e11            # bytes/s HBM stream (decode model)
     ici_bw: float = 9e10              # bytes/s per link, all-reduce model
     dcn_bw: float = 6.25e9            # bytes/s across slices (unused yet)
     mfu: float = 0.35                 # nominal achievable fraction
@@ -294,6 +295,48 @@ def plan_parallel(cfg_or_spec, n_devices: int, global_batch: int,
             f"devices with heads={spec.num_heads}, "
             f"layers={spec.num_layers}, batch={global_batch}")
     return plans[0]
+
+
+def plan_serving_tp(cfg_or_spec, n_devices: int, num_slots: int = 8,
+                    max_len: Optional[int] = None,
+                    chip: Optional[ChipSpec] = None,
+                    cache_bytes_per_elem: int = 2) -> Dict[str, int]:
+    """Pick the tensor-parallel degree for the serving decode tick
+    (inference/serving.py mesh= / tools/bench_serving.py --tp): the
+    tick is weight-BANDWIDTH bound — every decode step streams every
+    weight byte once, plus the live KV pool — so tp divides the bytes
+    each chip streams, while paying ~2 activation all-reduces per
+    layer whose tiny [slots, D] payloads make the fixed collective
+    LAUNCH latency the real price (the same term that prices TP out
+    of small-model training above). Memory is a hard gate: weights +
+    the KV pool must fit per chip, so a model bigger than one chip
+    FORCES tp > 1 — the "models bigger than one chip" half of ROADMAP
+    item 3. Returns mesh axes for parallel.mesh.build_mesh, e.g.
+    {'tp': 4}; only degrees dividing both n_devices and num_heads are
+    considered (head-sharded attention)."""
+    spec = _coerce_spec(cfg_or_spec)
+    chip = chip or ChipSpec()
+    S = max_len or spec.seq_len
+    # per-tick streamed bytes: weights in the serving compute dtype +
+    # the worst-case live KV pool (dense-equivalent envelope)
+    w_bytes = spec.total_params * spec.act_bytes_per_elem
+    kv_bytes = (2 * spec.num_layers * num_slots * S
+                * spec.hidden_size * cache_bytes_per_elem)
+    degrees = [d for d in range(1, n_devices + 1)
+               if n_devices % d == 0 and spec.num_heads % d == 0]
+    best, best_t, best_fits = None, float("inf"), False
+    for tp in degrees:
+        shard = (w_bytes + kv_bytes) / tp
+        fits = shard <= 0.9 * chip.hbm_bytes
+        ar_bytes = (_ring_factor(tp) * 2 * spec.num_layers * num_slots
+                    * spec.hidden_size * spec.act_bytes_per_elem)
+        t = (shard / chip.hbm_bw + ar_bytes / chip.ici_bw
+             + (2 * spec.num_layers * chip.coll_latency
+                if tp > 1 else 0.0))
+        # a non-fitting degree only wins over another non-fitting one
+        if best is None or (not fits, t) < (not best_fits, best_t):
+            best, best_t, best_fits = tp, t, fits
+    return {"tp": best}      # tp=1 always qualifies, so best is set
 
 
 def best_mesh_axes(param_count: int, n_devices: int,

@@ -1,0 +1,435 @@
+"""Sharded serving tests: tensor-parallel decode tick + the
+replicated-engine router (ROADMAP item 3; inference/serving.py mesh=,
+inference/router.py).
+
+The load-bearing guarantees, on the 8-virtual-device CPU mesh
+(tests/conftest.py pin):
+
+- tp-sharded decode produces BIT-IDENTICAL token streams to the
+  unsharded engine, for gpt AND llama/GQA, dense AND paged layouts,
+  spec on/off, greedy and sampled — with ONE host pull per tick and
+  zero new recompiles after warmup;
+- shardings are asserted via `.sharding.spec` (CLAUDE.md convention):
+  params per the family SERVING_PARAM_SPECS (the training TP split
+  remapped by parallel.mesh.tp_specs), the KV cache/page pool
+  head-sharded per kernels/decode_attention.cache_pspecs, with the
+  shape-aware degrade to replicated when tp doesn't divide the heads;
+- the router balances admission, survives replica death with
+  exactly-once resolution and bit-identical final streams, and the
+  facade engine cache key is distinct per mesh topology + tp degree
+  (a resharded model must never reuse a single-device engine).
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.inference.serving import ServingEngine
+from paddle_tpu.inference.router import EngineRouter, create_router
+from paddle_tpu.parallel.mesh import build_mesh
+from paddle_tpu.models.gpt import GPTConfig, init_gpt_params
+from paddle_tpu.models import llama as llama_mod
+
+MAXLEN = 32
+
+
+def _gpt_cfg():
+    return GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                     num_heads=2, ffn_hidden=64, max_seq_len=64,
+                     sequence_parallel=False, remat=False,
+                     dtype=jnp.float32)
+
+
+def _llama_cfg():
+    return llama_mod.LlamaConfig(vocab_size=64, hidden_size=32,
+                                 num_layers=2, num_heads=4,
+                                 num_kv_heads=2, max_seq_len=64,
+                                 dtype=jnp.float32, remat=False)
+
+
+@pytest.fixture(scope="module")
+def gpt_setup():
+    cfg = _gpt_cfg()
+    return cfg, init_gpt_params(cfg, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def llama_setup():
+    cfg = _llama_cfg()
+    return cfg, llama_mod.init_llama_params(cfg, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def tp2_mesh():
+    return build_mesh({"tp": 2})
+
+
+def _prompts(lens, seed=0, vocab=64):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, vocab, L).astype(np.int32) for L in lens]
+
+
+LENS = (5, 9, 13, 3, 7)
+
+
+def _count_pulls(eng):
+    """Wrap eng._pull to count host pulls (the one-pull-per-tick-per-
+    mesh invariant's direct observable)."""
+    counts = [0]
+    orig = eng._pull
+
+    def counted(value, stall_s=0.0):
+        counts[0] += 1
+        return orig(value, stall_s)
+    eng._pull = counted
+    return counts
+
+
+# --------------------------------------------------------------------------
+# bit-parity: sharded vs unsharded, every layout combination
+# --------------------------------------------------------------------------
+@pytest.mark.parametrize("family,layout", [
+    ("gpt", "dense"), ("gpt", "paged"),
+    ("llama", "dense"), ("llama", "paged"),
+])
+def test_tp_bit_parity(family, layout, gpt_setup, llama_setup, tp2_mesh):
+    cfg, params = gpt_setup if family == "gpt" else llama_setup
+    prompts = _prompts(LENS, seed=1)
+    kw = dict(kv_layout=layout)
+    if layout == "paged":
+        kw.update(page_size=8, prefill_chunk=4)
+    base = ServingEngine(params, cfg, family=family, num_slots=3,
+                         max_len=MAXLEN, **kw)
+    want = base.generate(prompts, 8)
+    eng = ServingEngine(params, cfg, family=family, num_slots=3,
+                        max_len=MAXLEN, mesh=tp2_mesh, **kw)
+    got = eng.generate(prompts, 8)
+    for a, b in zip(want, got):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_tp_spec_bit_parity(gpt_setup, tp2_mesh):
+    """Speculative tick on the mesh: streams equal the NON-SPEC
+    unsharded engine (spec parity and tp parity in one assertion)."""
+    cfg, params = gpt_setup
+    prompts = _prompts(LENS, seed=2)
+    base = ServingEngine(params, cfg, family="gpt", num_slots=3,
+                         max_len=MAXLEN)
+    want = base.generate(prompts, 8)
+    for layout in ("dense", "paged"):
+        kw = {} if layout == "dense" else dict(page_size=8)
+        eng = ServingEngine(params, cfg, family="gpt", num_slots=3,
+                            max_len=MAXLEN, mesh=tp2_mesh,
+                            kv_layout=layout, spec_decode="spec",
+                            gamma=3, draft_layers=cfg.num_layers, **kw)
+        got = eng.generate(prompts, 8)
+        for a, b in zip(want, got):
+            np.testing.assert_array_equal(a, b)
+        assert eng._spec_acc_total > 0      # speculation actually ran
+
+
+def test_tp_sampled_parity(gpt_setup, tp2_mesh):
+    """Sampled streams are placement-invariant too: the fold_in PRNG
+    stream and jax's partitionable threefry make the sharded
+    categorical bit-identical to the unsharded one."""
+    cfg, params = gpt_setup
+    prompts = _prompts(LENS, seed=3)
+    base = ServingEngine(params, cfg, family="gpt", num_slots=3,
+                         max_len=MAXLEN, max_top_k=8)
+    want = base.generate(prompts, 8, temperature=0.8, top_k=4)
+    eng = ServingEngine(params, cfg, family="gpt", num_slots=3,
+                        max_len=MAXLEN, max_top_k=8, mesh=tp2_mesh)
+    got = eng.generate(prompts, 8, temperature=0.8, top_k=4)
+    for a, b in zip(want, got):
+        np.testing.assert_array_equal(a, b)
+
+
+# --------------------------------------------------------------------------
+# shardings asserted via .sharding.spec (CLAUDE.md convention)
+# --------------------------------------------------------------------------
+def test_param_and_cache_shardings(gpt_setup, tp2_mesh):
+    cfg, params = gpt_setup
+    eng = ServingEngine(params, cfg, family="gpt", num_slots=3,
+                        max_len=MAXLEN, mesh=tp2_mesh)
+    # column-parallel qkv/up: last dim on tp; row-parallel out/down:
+    # contraction dim on tp; embeddings vocab-parallel; norms replicated
+    assert eng._params["qkv_w"].sharding.spec == P(None, None, "tp")
+    assert eng._params["mlp_up_w"].sharding.spec == P(None, None, "tp")
+    assert eng._params["attn_out_w"].sharding.spec == P(None, "tp", None)
+    assert eng._params["mlp_down_w"].sharding.spec == P(None, "tp", None)
+    assert eng._params["wte"].sharding.spec == P("tp", None)
+    assert eng._params["ln_f_scale"].sharding.is_fully_replicated
+    # dense cache [L, N, max_len, KV, hd]: head axis sharded
+    assert eng._cache["k"].sharding.spec == P(None, None, None, "tp",
+                                              None)
+    assert eng._cache["v"].sharding.spec == P(None, None, None, "tp",
+                                              None)
+
+
+def test_paged_cache_shardings(gpt_setup, tp2_mesh):
+    cfg, params = gpt_setup
+    eng = ServingEngine(params, cfg, family="gpt", num_slots=3,
+                        max_len=MAXLEN, mesh=tp2_mesh,
+                        kv_layout="paged", page_size=8)
+    assert eng._cache["k"].sharding.spec == P(None, None, None, "tp",
+                                              None)
+    # the page table is replicated — every shard needs the whole map
+    assert eng._cache["pt"].sharding.is_fully_replicated
+    prompts = _prompts((5, 9), seed=4)
+    eng.generate(prompts, 4)
+    # shardings survive the tick (the _pin_cache contract): the donated
+    # pool comes back with the same layout it went in with
+    assert "tp" in str(eng._cache["k"].sharding.spec)
+
+
+def test_gqa_degrade_to_replicated(llama_setup):
+    """tp=4 with 2 KV heads: the cache head axis cannot shard -> the
+    shape-aware degrade replicates the pool while q_w (4 heads) stays
+    sharded; streams still bit-identical."""
+    cfg, params = llama_setup
+    mesh4 = build_mesh({"tp": 4})
+    base = ServingEngine(params, cfg, family="llama", num_slots=2,
+                         max_len=MAXLEN)
+    prompts = _prompts((5, 9), seed=5)
+    want = base.generate(prompts, 6)
+    eng = ServingEngine(params, cfg, family="llama", num_slots=2,
+                        max_len=MAXLEN, mesh=mesh4)
+    assert eng._params["q_w"].sharding.spec == P(None, None, "tp")
+    assert eng._cache["k"].sharding.spec == P(None, None, None, None,
+                                              None)
+    got = eng.generate(prompts, 6)
+    for a, b in zip(want, got):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_mesh_without_tp_axis_refused(gpt_setup):
+    cfg, params = gpt_setup
+    mesh = build_mesh({"dp": 2})
+    with pytest.raises(ValueError, match="has no 'tp' axis"):
+        ServingEngine(params, cfg, family="gpt", num_slots=2,
+                      max_len=MAXLEN, mesh=mesh)
+
+
+# --------------------------------------------------------------------------
+# invariants: one pull per tick per mesh, zero recompiles after warmup
+# --------------------------------------------------------------------------
+def test_one_pull_per_tick_and_trace_ceiling(gpt_setup, tp2_mesh):
+    cfg, params = gpt_setup
+    eng = ServingEngine(params, cfg, family="gpt", num_slots=3,
+                        max_len=MAXLEN, mesh=tp2_mesh)
+    prompts = _prompts(LENS, seed=6)
+    eng.generate(prompts, 8)                       # warm every bucket
+    warm = eng.trace_counts()
+    counts = _count_pulls(eng)
+    reqs = [eng.submit(p, 8) for p in prompts[:3]]
+    t0 = eng._ticks
+    while eng.has_work():
+        eng.step()
+    decode_ticks = eng._ticks - t0
+    # same-length requests join and finish together: exactly one pull
+    # per prefill (admission) + one per decode tick, for the whole mesh
+    assert all(len(r.tokens) == 8 for r in reqs)
+    assert counts[0] == decode_ticks + len(reqs)
+    assert eng.trace_counts() == warm              # zero recompiles
+
+
+def test_zero_recompiles_after_warmup_paged_spec(gpt_setup, tp2_mesh):
+    cfg, params = gpt_setup
+    eng = ServingEngine(params, cfg, family="gpt", num_slots=3,
+                        max_len=MAXLEN, mesh=tp2_mesh,
+                        kv_layout="paged", page_size=8,
+                        spec_decode="spec", gamma=2,
+                        draft_layers=cfg.num_layers)
+    prompts = _prompts(LENS, seed=7)
+    eng.generate(prompts, 8)
+    warm = eng.trace_counts()
+    eng.generate(_prompts(LENS, seed=8), 8)        # same buckets
+    assert eng.trace_counts() == warm
+    assert warm[0] <= 2                            # decode ceiling
+
+
+# --------------------------------------------------------------------------
+# facade cache key: mesh topology + tp degree (satellite)
+# --------------------------------------------------------------------------
+def test_facade_engine_cache_key_mesh_distinct(gpt_setup, tp2_mesh):
+    from paddle_tpu.models.gpt import GPTModel
+    cfg, _ = gpt_setup
+    gm = GPTModel(cfg)
+    prompts = _prompts((5, 9), seed=9)
+    want = gm.generate(prompts, 4, num_slots=2, max_len=MAXLEN)
+    eng_plain = gm._serving_engine
+    # mesh engine: distinct from the single-device one, same streams
+    outs = gm.generate(prompts, 4, num_slots=2, max_len=MAXLEN,
+                       mesh=tp2_mesh)
+    eng_tp2 = gm._serving_engine
+    assert eng_tp2 is not eng_plain
+    assert eng_tp2.tp == 2
+    for a, b in zip(want, outs):
+        np.testing.assert_array_equal(a, b)
+    # same mesh -> cached engine
+    gm.generate(prompts, 4, num_slots=2, max_len=MAXLEN, mesh=tp2_mesh)
+    assert gm._serving_engine is eng_tp2
+    # different tp degree -> rebuild (the resharded-model trap)
+    gm.generate(prompts, 4, num_slots=2, max_len=MAXLEN,
+                mesh=build_mesh({"tp": 4}))
+    assert gm._serving_engine is not eng_tp2
+    assert gm._serving_engine.tp == 4
+    # and back to no mesh -> rebuild again, not the stale tp engine
+    gm.generate(prompts, 4, num_slots=2, max_len=MAXLEN)
+    assert gm._serving_engine.mesh is None
+
+
+# --------------------------------------------------------------------------
+# router: balance, terminality, death requeue, backpressure
+# --------------------------------------------------------------------------
+class TestRouter:
+    def test_parity_and_balance(self, gpt_setup):
+        cfg, params = gpt_setup
+        prompts = _prompts(tuple(range(3, 13)), seed=10)
+        base = ServingEngine(params, cfg, family="gpt", num_slots=3,
+                             max_len=MAXLEN)
+        want = base.generate(prompts, 6)
+        router = create_router(params, cfg, replicas=2, family="gpt",
+                               num_slots=3, max_len=MAXLEN,
+                               concurrent=False)
+        got = router.generate(prompts, 6)
+        for a, b in zip(want, got):
+            np.testing.assert_array_equal(a, b)
+        st = router.stats()
+        disp = [r["dispatched"] for r in st["per_replica"]]
+        assert sum(disp) == len(prompts)
+        assert min(disp) >= len(prompts) // 2 - 1    # least-loaded
+
+    def test_replica_death_requeue(self, gpt_setup):
+        cfg, params = gpt_setup
+        prompts = _prompts(tuple(range(3, 13)), seed=11)
+        base = ServingEngine(params, cfg, family="gpt", num_slots=3,
+                             max_len=MAXLEN)
+        want = base.generate(prompts, 6)
+        router = create_router(params, cfg, replicas=2, family="gpt",
+                               num_slots=3, max_len=MAXLEN,
+                               concurrent=False)
+        reqs = [router.submit(p, 6) for p in prompts]
+        for _ in range(3):
+            router.step()
+        assert router.kill_replica(0) > 0
+        assert router.kill_replica(0) == 0            # idempotent
+        router.drain()
+        assert all(r.done for r in reqs)
+        assert all(r.finish_reason in ("length", "eos") for r in reqs)
+        assert any(r.requeues == 1 for r in reqs)
+        for r, w in zip(reqs, want):
+            np.testing.assert_array_equal(
+                np.asarray(r.tokens, np.int32), w)
+
+    def test_all_replicas_dead_never_limbo(self, gpt_setup):
+        cfg, params = gpt_setup
+        router = create_router(params, cfg, replicas=2, family="gpt",
+                               num_slots=2, max_len=MAXLEN,
+                               concurrent=False)
+        reqs = [router.submit(p, 6) for p in _prompts((5, 7, 9),
+                                                      seed=12)]
+        router.step()
+        router.kill_replica(0)
+        router.kill_replica(1)
+        assert all(r.done for r in reqs)
+        assert all(r.finish_reason == "evicted" for r in reqs)
+        assert not router.has_work()
+        from paddle_tpu.inference.serving import BackpressureError
+        with pytest.raises(BackpressureError):
+            router.submit(_prompts((5,), seed=13)[0], 4)
+
+    def test_router_backpressure_and_cancel(self, gpt_setup):
+        cfg, params = gpt_setup
+        from paddle_tpu.inference.serving import BackpressureError
+        # tiny replicas with bounded ENGINE queues (max_queue=1 each)
+        # force router-queue growth; the router's own max_queue=2 then
+        # rejects — the PR-5 backpressure machinery reused at both tiers
+        engines = [ServingEngine(params, cfg, family="gpt",
+                                 num_slots=1, max_len=MAXLEN,
+                                 max_queue=1) for _ in range(2)]
+        router = EngineRouter(engines, max_queue=2, concurrent=False)
+        prompts = _prompts(tuple(range(3, 13)), seed=14)
+        accepted, rejected = [], 0
+        for p in prompts:
+            try:
+                accepted.append(router.submit(p, 4))
+            except BackpressureError:
+                rejected += 1
+        assert rejected > 0
+        victim = accepted[-1]
+        assert victim.cancel()
+        assert victim.finish_reason == "cancelled"
+        assert not victim.cancel()                    # exactly-once
+        router.drain()
+        assert all(r.done for r in accepted)
+
+    def test_pool_exhausted_on_dispatch_never_limbo(self, gpt_setup):
+        """A router-queued request whose ONLY viable replica dies must
+        resolve "evicted" when redispatch finds no live replica can
+        ever hold it — PoolExhaustedError escapes submit() only, never
+        step()/drain() (regression: it used to escape _dispatch_pending
+        and strand the request at the queue head forever)."""
+        cfg, params = gpt_setup
+        big_ok = ServingEngine(params, cfg, family="gpt", num_slots=1,
+                               max_len=MAXLEN, max_queue=1)
+        tiny = ServingEngine(params, cfg, family="gpt", num_slots=1,
+                             max_len=MAXLEN, kv_layout="paged",
+                             page_size=8, num_pages=2)  # 1 usable page
+        router = EngineRouter([big_ok, tiny], concurrent=False)
+        small = _prompts((4, 4, 4), seed=20)
+        router.submit(small[0], 2)        # rep0's slot
+        router.submit(small[1], 2)        # rep1 (least-loaded)
+        router.submit(small[2], 2)        # rep0's queue (now full)
+        big = _prompts((20,), seed=21)[0]
+        # tiny can NEVER hold 20+4 positions; big_ok backpressures ->
+        # router-queued, waiting for the one replica that fits it
+        r_big = router.submit(big, 4)
+        assert r_big.replica is None and not r_big.done
+        router.kill_replica(0)            # the only fit dies
+        router.drain()                    # must not raise
+        assert r_big.done and r_big.finish_reason == "evicted"
+        assert not router.has_work()
+
+    def test_router_over_tp_engines(self, gpt_setup, tp2_mesh):
+        """dp(router) x tp(engine): 2 replicas, each tp-sharded over
+        its own 2-device mesh slice — streams still exact."""
+        cfg, params = gpt_setup
+        devs = jax.devices()
+        meshes = [build_mesh({"tp": 2}, devices=devs[:2]),
+                  build_mesh({"tp": 2}, devices=devs[2:4])]
+        prompts = _prompts((5, 9, 13, 3), seed=15)
+        base = ServingEngine(params, cfg, family="gpt", num_slots=2,
+                             max_len=MAXLEN)
+        want = base.generate(prompts, 6)
+        router = create_router(params, cfg, replicas=2, family="gpt",
+                               num_slots=2, max_len=MAXLEN,
+                               meshes=meshes, concurrent=False)
+        got = router.generate(prompts, 6)
+        for a, b in zip(want, got):
+            np.testing.assert_array_equal(a, b)
+        for rep in router.replicas:
+            assert rep.eng.tp == 2
+
+
+# --------------------------------------------------------------------------
+# planner: serving tp degree (satellite)
+# --------------------------------------------------------------------------
+def test_plan_serving_tp():
+    from paddle_tpu.parallel.planner import ModelSpec, plan_serving_tp
+    small = ModelSpec(num_layers=2, hidden_size=128, num_heads=4,
+                      ffn_hidden=512, vocab_size=512, seq_len=128)
+    big = ModelSpec(num_layers=32, hidden_size=4096, num_heads=32,
+                    ffn_hidden=16384, vocab_size=50304, seq_len=2048)
+    # tiny model: collective launch latency prices tp out
+    assert plan_serving_tp(small, 8) == {"tp": 1}
+    # one-chip-OOM model: memory forces sharding
+    tp = plan_serving_tp(big, 8)["tp"]
+    assert tp > 1 and 8 % tp == 0 and 32 % tp == 0
+    # the degree always divides the heads: with 3 heads on 6 devices
+    # the candidate set is {1, 3} (2 and 6 divide the devices but not
+    # the heads — a returned 2 or 6 would be the bug this pins)
+    odd = ModelSpec(num_layers=2, hidden_size=96, num_heads=3,
+                    ffn_hidden=384, vocab_size=512, seq_len=128)
+    assert plan_serving_tp(odd, 6)["tp"] in (1, 3)

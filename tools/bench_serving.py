@@ -25,7 +25,21 @@ Usage:
   python tools/bench_serving.py --capacity     # paged-vs-dense @ equal HBM
   python tools/bench_serving.py --spec         # speculative A/B (1 slot)
   python tools/bench_serving.py --spec --sweep # acceptance vs gamma/K
+  python tools/bench_serving.py --tp 2         # tp-sharded decode parity
+  python tools/bench_serving.py --router 2     # replicated-engine router
   PADDLE_TPU_TELEMETRY_JSONL=serve.jsonl python tools/bench_serving.py
+
+--tp N shards the decode tick over an N-way virtual-CPU build_mesh
+('tp' axis — inference/serving.py mesh=): bit-parity vs the unsharded
+engine, sharding specs asserted on the live engine, zero recompiles
+after warmup. The CPU rung proves MECHANICS; tp wall-clock wins need
+real chips (parallel.planner.plan_serving_tp prices when). --router R
+races R replicated engines (inference/router.py least-loaded
+admission) against one engine on a concurrency-limited workload —
+near-linear aggregate tokens/s at R=2 is the BASELINE.md "Sharded
+serving" acceptance bar. Both modes pin the virtual-CPU platform
+UNCONDITIONALLY before jax init (CLAUDE.md tunnel trap: build_mesh
+touches jax.devices()).
 
 --spec is the speculative-decoding acceptance bench (BASELINE.md
 "Speculative decoding"): SINGLE-STREAM (num_slots=1) greedy decode,
@@ -68,10 +82,36 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 # CPU by default: the axon tunnel flaps and ANY backend init then hangs
-# (CLAUDE.md trap). --tpu opts into the real backend.
-if "--tpu" not in sys.argv:
+# (CLAUDE.md trap). --tpu opts into the real backend — EXCEPT for the
+# mesh-building modes (--tp), which pin the virtual-CPU platform
+# UNCONDITIONALLY before any jax init: build_mesh touches jax.devices(),
+# and a tunnel flap there hangs for minutes with no timeout in the
+# loop (the bench_serving tp rung is a CPU-mesh parity/mechanics bench;
+# TPU tp numbers come from the tpu_campaign harness, which owns its own
+# timeouts).
+
+
+def _argv_int(flag: str, default: int = 0) -> int:
+    """Pre-argparse scan: the pin must happen before jax initializes,
+    which is before argparse can run."""
+    for i, a in enumerate(sys.argv):
+        if a == flag and i + 1 < len(sys.argv):
+            try:
+                return int(sys.argv[i + 1])
+            except ValueError:
+                return default
+        if a.startswith(flag + "="):
+            try:
+                return int(a.split("=", 1)[1])
+            except ValueError:
+                return default
+    return default
+
+
+_TP = max(_argv_int("--tp"), 1)
+if _TP > 1 or "--tpu" not in sys.argv:
     from paddle_tpu.device import pin_cpu
-    pin_cpu(1)
+    pin_cpu(_TP)
 
 import numpy as np                                    # noqa: E402
 import jax                                            # noqa: E402
@@ -430,9 +470,198 @@ def spec_main(args):
     return 0 if mismatches == 0 else 1
 
 
+def _build_family(args, max_len):
+    """(params, cfg) for the bench family/shape at a given cache len —
+    shared by the tp/router modes (the other modes predate it)."""
+    if args.family == "gpt":
+        from paddle_tpu.models.gpt import GPTConfig, init_gpt_params
+        cfg = GPTConfig(vocab_size=args.vocab, hidden_size=args.hidden,
+                        num_layers=args.layers,
+                        num_heads=max(args.hidden // 32, 1),
+                        max_seq_len=2 * max_len, sequence_parallel=False,
+                        remat=False, dtype=jnp.float32)
+        return init_gpt_params(cfg, jax.random.PRNGKey(0)), cfg
+    from paddle_tpu.models.llama import LlamaConfig, init_llama_params
+    cfg = LlamaConfig(vocab_size=args.vocab, hidden_size=args.hidden,
+                      num_layers=args.layers,
+                      num_heads=max(args.hidden // 32, 1),
+                      num_kv_heads=max(args.hidden // 64, 1),
+                      max_seq_len=2 * max_len, remat=False,
+                      dtype=jnp.float32)
+    return init_llama_params(cfg, jax.random.PRNGKey(0)), cfg
+
+
+def tp_main(args):
+    """--tp N: tensor-parallel decode tick on an N-way CPU mesh vs the
+    unsharded engine — the BASELINE.md "Sharded serving" parity +
+    mechanics rung. The CPU mesh measures MECHANICS (bit-parity, trace
+    ceilings, one pull per tick); tp wall-clock WINS need real chips
+    (the tick is weight-bandwidth bound — parallel.planner
+    plan_serving_tp prices when tp pays). One JSON line."""
+    from paddle_tpu.models.decode import next_pow2
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.parallel.mesh import build_mesh
+    from paddle_tpu.parallel.planner import plan_serving_tp
+
+    gen = args.gen
+    max_len = args.max_len or next_pow2(args.prompt_hi + gen)
+    params, cfg = _build_family(args, max_len)
+    prompts = build_workload(args.requests, args.prompt_lo,
+                             args.prompt_hi, args.vocab)
+    total_tokens = args.requests * gen
+    mesh = build_mesh({"tp": args.tp})
+    _log(f"tp workload: {args.requests} reqs, gen {gen}, "
+         f"{args.family} {args.layers}Lx{args.hidden}d, tp={args.tp} "
+         f"over {jax.device_count()} devices, "
+         f"planner says {plan_serving_tp(cfg, args.tp)}")
+
+    def run(eng):
+        t0 = time.perf_counter()
+        outs = eng.generate(prompts, gen)
+        return time.perf_counter() - t0, outs
+
+    def warm(eng):
+        # warm to a FIXED POINT, not one pass: under the paged layout
+        # with prefix sharing the SECOND run of the same prompts hits
+        # the warm run's cached prefixes and takes the aligned-full-
+        # match path (a prefill bucket the first pass never compiled),
+        # so one warm run undercounts the steady-state executables
+        run(eng)
+        while True:
+            before = eng.trace_counts()
+            run(eng)
+            if eng.trace_counts() == before:
+                return
+
+    base = ServingEngine(params, cfg, family=args.family,
+                         num_slots=args.slots, max_len=max_len,
+                         kv_layout=args.kv_layout)
+    warm(base)
+    base_s, base_outs = run(base)
+
+    eng = ServingEngine(params, cfg, family=args.family,
+                        num_slots=args.slots, max_len=max_len,
+                        kv_layout=args.kv_layout, mesh=mesh)
+    warm(eng)
+    traces_warm = eng.trace_counts()
+    tp_s, tp_outs = run(eng)
+    traces_after = eng.trace_counts()
+
+    mismatches = sum(1 for a, b in zip(base_outs, tp_outs)
+                     if not np.array_equal(a, b))
+    # the sharding contract, asserted on the live engine (the same
+    # .sharding.spec checks the CPU-mesh test suite pins): params carry
+    # the tp axis; the cache does too UNLESS the documented shape-aware
+    # degrade applies (tp doesn't divide the KV heads — deep GQA — and
+    # the pool legitimately replicates, kernels/decode_attention
+    # cache_pspecs)
+    kv_heads = getattr(cfg, "num_kv_heads", None) or cfg.num_heads
+    cache_sharded = "tp" in str(eng._cache["k"].sharding.spec)
+    shard_ok = (any("tp" in str(v.sharding.spec)
+                    for v in eng._params.values())
+                and (cache_sharded or kv_heads % args.tp != 0))
+    print(json.dumps({
+        "metric": "serving_tp_tokens_per_sec",
+        "value": round(total_tokens / tp_s, 1),
+        "unit": f"tokens/s @ tp={args.tp}",
+        "backend": jax.devices()[0].platform,
+        "unsharded_tokens_per_sec": round(total_tokens / base_s, 1),
+        "tp_vs_unsharded": round(base_s / tp_s, 2),
+        "tp": args.tp, "kv_layout": args.kv_layout,
+        "requests": args.requests, "gen": gen, "slots": args.slots,
+        "model": f"{args.layers}Lx{args.hidden}d",
+        "family": args.family, "max_len": max_len,
+        "params_sharded": shard_ok, "cache_sharded": cache_sharded,
+        "recompiles_after_warmup": [
+            traces_after[0] - traces_warm[0],
+            traces_after[1] - traces_warm[1]],
+        "stream_mismatches": mismatches,
+    }), flush=True)
+    ok = (mismatches == 0 and shard_ok
+          and traces_after == traces_warm)
+    return 0 if ok else 1
+
+
+def router_main(args):
+    """--router R: aggregate tokens/s through the replicated-engine
+    router (inference/router.py) vs ONE engine at the same per-replica
+    shape, on a workload deep enough that concurrency is the limit
+    (requests >> one replica's slots). Near-linear scaling at R=2 on
+    the CPU rung is the acceptance bar: the tick cost is dispatch-
+    dominated at bench scale, so R replicas serve R x the streams in
+    the same number of tick rounds. One JSON line — the BASELINE.md
+    "Sharded serving" router row."""
+    from paddle_tpu.models.decode import next_pow2
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.inference.router import create_router
+    from paddle_tpu.profiler import monitor
+
+    gen = args.gen
+    max_len = args.max_len or next_pow2(args.prompt_hi + gen)
+    params, cfg = _build_family(args, max_len)
+    # concurrency-limited workload unless the operator sized it: 4
+    # waves for the single engine, 4/R waves behind the router (an
+    # EXPLICIT --requests always wins — the flag defaults to None so
+    # "--requests 16" is 16, not this auto-sizing)
+    n_req = (args.requests if args.requests is not None
+             else 4 * args.slots)
+    prompts = build_workload(n_req, args.prompt_lo, args.prompt_hi,
+                             args.vocab)
+    total_tokens = n_req * gen
+    _log(f"router workload: {n_req} reqs, gen {gen}, {args.family} "
+         f"{args.layers}Lx{args.hidden}d, {args.router} replicas x "
+         f"{args.slots} slots")
+
+    single = ServingEngine(params, cfg, family=args.family,
+                           num_slots=args.slots, max_len=max_len)
+    single.generate(prompts, gen)                # warm
+    t0 = time.perf_counter()
+    base_outs = single.generate(prompts, gen)
+    base_s = time.perf_counter() - t0
+
+    router = create_router(params, cfg, replicas=args.router,
+                           family=args.family, num_slots=args.slots,
+                           max_len=max_len)
+    router.generate(prompts, gen)                # warm
+    # snapshot the (process-global) dispatch counters so the reported
+    # balance covers the MEASURED pass only, not the warm run
+    disp0 = [r["dispatched"] for r in router.stats()["per_replica"]]
+    t0 = time.perf_counter()
+    outs = router.generate(prompts, gen)
+    rt_s = time.perf_counter() - t0
+
+    mismatches = sum(1 for a, b in zip(base_outs, outs)
+                     if not np.array_equal(a, b))
+    st = router.stats()
+    disp = [r["dispatched"] - d0
+            for r, d0 in zip(st["per_replica"], disp0)]
+    scaling = base_s / rt_s
+    tele_path = os.environ.get("PADDLE_TPU_TELEMETRY_JSONL")
+    if tele_path:
+        monitor.registry().export_jsonl(tele_path)
+    print(json.dumps({
+        "metric": "serving_router_tokens_per_sec",
+        "value": round(total_tokens / rt_s, 1),
+        "unit": f"aggregate tokens/s @ {args.router} replicas",
+        "backend": jax.devices()[0].platform,
+        "single_engine_tokens_per_sec": round(total_tokens / base_s, 1),
+        "scaling_vs_single": round(scaling, 2),
+        "replicas": args.router,
+        "requests": n_req, "gen": gen, "slots": args.slots,
+        "model": f"{args.layers}Lx{args.hidden}d",
+        "family": args.family, "max_len": max_len,
+        "dispatched_per_replica": disp,
+        "replicas_live": st["replicas_live"],
+        "stream_mismatches": mismatches,
+    }), flush=True)
+    return 0 if mismatches == 0 else 1
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--requests", type=int, default=16)
+    ap.add_argument("--requests", type=int, default=None,
+                    help="workload size (default 16; --router defaults "
+                         "to 4*slots unless set explicitly)")
     ap.add_argument("--gen", type=int, default=32)
     ap.add_argument("--slots", type=int, default=8)
     ap.add_argument("--prompt-lo", type=int, default=8)
@@ -463,7 +692,26 @@ def main():
     ap.add_argument("--adopt", action="store_true",
                     help="--spec: write the evidence-gated registry row "
                          "when the speedup clears 1.5x")
+    ap.add_argument("--tp", type=int, default=0,
+                    help="tensor-parallel decode on an N-way CPU mesh "
+                         "vs unsharded (bit-parity + mechanics)")
+    ap.add_argument("--router", type=int, default=0,
+                    help="aggregate tokens/s through N replicated "
+                         "engines (inference/router.py) vs one engine")
+    ap.add_argument("--kv-layout", choices=("auto", "dense", "paged"),
+                    default="auto", help="--tp: cache layout under test")
     args = ap.parse_args()
+    if args.tp and args.tp != _TP:
+        ap.error("--tp was read pre-init for the CPU pin; don't "
+                 "rewrite sys.argv between import and main()")
+    if args.tp:
+        if args.requests is None:
+            args.requests = 16
+        return tp_main(args)
+    if args.router:
+        return router_main(args)          # sizes its own default
+    if args.requests is None:
+        args.requests = 16
     if args.capacity:
         return capacity_main(args)
     if args.chunk_slo:

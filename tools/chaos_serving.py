@@ -42,6 +42,16 @@ Speculative-decode scenarios (docs/serving.md "Speculative decoding"):
                    quarantine verdict still rides the emission matrix:
                    only slot S poisons, survivors exact
 
+Router scenario (the replicated-engine router, inference/router.py;
+docs/serving.md "Sharded serving & routing"):
+  router_replica_death 2 engine replicas, one killed mid-decode ->
+                   its un-terminal requests requeue and REPLAY on the
+                   survivor; every request still resolves exactly
+                   once, final streams are bit-identical to the
+                   fault-free run (at-least-once delivery, exactly-
+                   once resolution), the survivor holds its trace
+                   ceilings, and the death leaves a flight dump
+
 Paged-KV scenarios (the block-pool layout, docs/serving.md "Paged KV
 cache"):
   paged_pool_flood more demand than pages -> later requests WAIT for
@@ -418,6 +428,52 @@ def run_drill(quick: bool = False, keep_root: bool = False) -> int:
                 or check_traces(eng))
     scenario("spec_nan_logits@2:1", spec_target_nan,
              spec="nan_logits@2:1")
+
+    # --- router: replica death mid-decode ----------------------------
+    def replica_death():
+        from paddle_tpu.inference.router import create_router
+        from paddle_tpu.inference.serving import TERMINAL_REASONS
+        r0 = monitor.counter("serving.router.requeues").value
+        router = create_router(params, cfg, replicas=2, family="gpt",
+                               num_slots=3, max_len=max_len,
+                               concurrent=False)   # deterministic drill
+        reqs = [router.submit(p, gen) for p in prompts]
+        for _ in range(3):
+            router.step()                 # streams mid-decode on BOTH
+        killed = router.kill_replica(0)
+        if killed == 0:
+            return "kill_replica(0) found nothing to requeue"
+        if monitor.counter("serving.router.requeues").value <= r0:
+            return "requeues counter never moved"
+        router.drain()
+        # invariant 1 on the OUTER requests (exactly-once terminal)
+        for r in reqs:
+            if not r.done:
+                return f"request {r.id} not done (limbo)"
+            if r.finish_reason not in TERMINAL_REASONS:
+                return (f"request {r.id} finish_reason "
+                        f"{r.finish_reason!r} not terminal")
+            if r.slot is not None:
+                return f"request {r.id} resolved but owns a slot"
+        # migration semantics: every request COMPLETES (requeued ones
+        # replay from scratch on the survivor) and final streams are
+        # bit-identical to the fault-free run — at-least-once token
+        # delivery, exactly-once resolution, exact final streams
+        if any(r.finish_reason not in ("length", "eos") for r in reqs):
+            return ("death was not transparent: "
+                    f"{[r.finish_reason for r in reqs]}")
+        if not any(r.requeues for r in reqs):
+            return "no surviving request records a requeue"
+        err = check_streams(reqs, baseline)
+        if err:
+            return err
+        st = router.stats()
+        if st["replicas_live"] != 1:
+            return f"expected 1 live replica: {st}"
+        # the survivor's engine must hold its trace ceilings through
+        # the requeue wave (migration costs no recompiles)
+        return check_traces(router.replicas[1].eng)
+    scenario("router_replica_death", replica_death)
 
     # --- cancel + deadlines ------------------------------------------
     def cancel_deadline():

@@ -160,7 +160,15 @@ def summarize(path: str, samples_per_step: Optional[float] = None) -> dict:
     # last value. ----
     _SERVING_GAUGES = ("serving.slot_occupancy", "serving.queue_depth",
                        "serving.queue_wait_ms", "serving.pages_in_use",
-                       "serving.pages_shared", "serving.spec_accept_rate")
+                       "serving.pages_shared", "serving.spec_accept_rate",
+                       "serving.router.replicas_live",
+                       "serving.router.pending")
+
+    def _is_gauge(k):
+        # per-replica queue-depth gauges carry a dynamic suffix
+        # (serving.router.queue_depth.r<i>, inference/router.py)
+        return (k in _SERVING_GAUGES
+                or k.startswith("serving.router.queue_depth."))
     # the paged-KV pool surface (inference/serving.py "kv pool"):
     # occupancy/sharing gauges + COW and chunked-prefill counters,
     # grouped under serving.kv_pool when any of them moved
@@ -173,7 +181,7 @@ def summarize(path: str, samples_per_step: Optional[float] = None) -> dict:
     if monitors:
         first_s, last_s = monitors[0]["stats"], monitors[-1]["stats"]
         srv = {k[len("serving."):]:
-               (last_s[k] if k in _SERVING_GAUGES
+               (last_s[k] if _is_gauge(k)
                 else last_s[k] - first_s.get(k, 0))
                for k in sorted(last_s) if k.startswith("serving.")}
         if srv:
@@ -187,6 +195,15 @@ def summarize(path: str, samples_per_step: Optional[float] = None) -> dict:
             spec = {k: srv.pop(k) for k in _SPEC if k in srv}
             if any(spec.values()):
                 srv["spec"] = spec
+            # the replicated-engine router surface (inference/router.py
+            # serving.router.*): liveness/requeue/balance, grouped —
+            # per-replica queue depths and dispatch counters keep their
+            # r<i> suffixes inside the block
+            router = {k[len("router."):]: srv.pop(k)
+                      for k in [k for k in srv
+                                if k.startswith("router.")]}
+            if any(router.values()):
+                srv["router"] = router
             out["serving"] = srv
 
     # ---- serving SLO percentiles (ServingEngine.export_slo_jsonl
