@@ -219,8 +219,10 @@ class Model:
         (deadline_s/deadline_ticks/max_ticks, plus engine knobs like
         max_queue/queue_ttl_s/watchdog_timeout/guardrails), the
         speculative-decode knobs (spec_decode/gamma/draft_layers —
-        inference/spec_decode.py) and the tensor-parallel `mesh` /
-        `tp_axis` knobs (inference/serving.py mesh= — the mesh
+        inference/spec_decode.py), the weight-only int8 knob (quant —
+        inference/serving.py quant=, kernels/quant_matmul.py) and the
+        tensor-parallel `mesh` / `tp_axis` knobs
+        (inference/serving.py mesh= — the mesh
         topology + tp degree join the cache key, so a resharded model
         rebuilds rather than reusing a single-device engine) pass
         through to the facade and on to the engine."""

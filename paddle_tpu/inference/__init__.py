@@ -160,19 +160,45 @@ class Predictor:
     def _apply_precision(self, precision: str) -> None:
         """Honor Config._precision on the loaded params. The StableHLO
         artifact pins its compute dtypes at jit.save time, so reduced
-        precision lands as a weight ROUND-TRIP cast (f32 -> bf16/f16 ->
-        f32): the weights carry the quantized values while the program
-        keeps its saved dtypes (the trade the reference's fp16 load
-        makes when the program itself stays fp32). Int8 needs the
-        calibrated quantization pass (paddle_tpu.quantization) and is
-        refused loudly instead of silently serving fp32."""
+        precision lands as a weight ROUND-TRIP on the loaded params:
+        the weights carry the reduced-precision values while the
+        program keeps its saved dtypes (the trade the reference's fp16
+        load makes when the program itself stays fp32).
+
+        - bf16/f16: per-weight dtype round-trip cast.
+        - Int8: the WEIGHT-ONLY quantizer — every floating ndim >= 2
+          param round-trips through per-output-channel int8
+          (quantization.int8.quantize_weight, the reference's
+          channel_wise_abs_max). The channel axis follows the
+          codebase's own int8-layer conventions: rank-4 conv kernels
+          [O, I, kh, kw] quantize per OUTPUT channel (axis 0, the
+          Int8Conv2D.from_quanted convention); matmul weights
+          [.., K, N] per their LAST axis (Int8Linear). Vectors
+          (biases, norms) stay fp. The saved artifact's param list
+          carries no names, so unlike the serving engines' named-leaf
+          rewrite (quantization/serving.py, which keeps embeddings
+          fp) a [V, D] embedding table quantizes like any matrix —
+          the documented coarseness of the graph-blind path. A model
+          that needs CALIBRATED activation quant should run the
+          PTQ/QAT pass + quantization.convert_to_int8 BEFORE
+          jit.save — the saved program then already contains real
+          int8 dot_generals and loads here under any precision."""
         if precision == PrecisionType.Int8:
-            raise NotImplementedError(
-                "Config precision Int8 is not supported by the "
-                "Predictor: Int8 serving needs a calibrated "
-                "quantization pass (see paddle_tpu.quantization); "
-                "use Float32/Bfloat16/Half or quantize the model "
-                "before jit.save")
+            from ..quantization.int8 import _Q, quantize_weight
+
+            def rt(p):
+                if (not jnp.issubdtype(p.dtype, jnp.floating)
+                        or p.ndim < 2):
+                    return p
+                w = np.asarray(p, np.float32)
+                axis = 0 if w.ndim == 4 else w.ndim - 1
+                w_q, scale = quantize_weight(w, channel_axis=axis)
+                shape = [1] * w.ndim
+                shape[axis] = -1
+                return jnp.asarray(
+                    w_q.astype(np.float32)
+                    * (scale / _Q).reshape(shape), p.dtype)
+            self._layer._params = [rt(p) for p in self._layer._params]
         if precision in (PrecisionType.Half, PrecisionType.Bfloat16):
             tgt = (jnp.float16 if precision == PrecisionType.Half
                    else jnp.bfloat16)

@@ -191,6 +191,43 @@ SNIPPETS.md [3]'s PartitionSpec layout):
   when tp pays (the decode tick is weight-bandwidth bound; a model
   bigger than one chip forces tp > 1).
 
+Quantized serving (quant="int8" / env PADDLE_TPU_QUANT / the
+"quant_matmul" registry kernel — the weight-HBM layer, cf. the
+reference PTQ driver's channel_wise_abs_max weight path; OFF by
+default):
+
+- **Weight-only int8, quantize-at-build.** The engine rewrites its
+  params tree once at construction (quantization/serving.py): every
+  stacked matmul weight in the family's QUANT_LEAVES (attention
+  qkv/proj, MLP in/out) becomes an int8 `<name>_q` plus per-output-
+  channel fp32 `<name>_scale` (abs-max/127, the ready dequant
+  multiplier), the tied LM head gets a transposed int8 copy
+  (`head_q`/`head_scale`) while `wte` stays fp for the embedding
+  gather, and the fp leaves are DROPPED — weight HBM falls to ~0.26x
+  (f32) / ~0.52x (bf16) for the block weights, which compounds with
+  the paged pool (more KV pages at fixed HBM) and tp (bigger models
+  per chip).
+- **Dequant inside the matmul.** The cached forwards route every
+  block matmul through kernels/quant_matmul.leaf_matmul, which picks
+  the int8 pair up FROM THE TREE — no flag reaches the jitted bodies,
+  so the tick invariants (one host pull, trace ceilings, donation)
+  are untouched and dense/paged/spec-draft/tp compose for free. The
+  fused dequant-matmul runs as 'xla' (portable, the CPU-tested real
+  path) or 'pallas' (hand-tiled, int8->f32 in registers), selected
+  via env > registry > 'xla'.
+- **Determinism tiers.** Weight-only dequant is deterministic: a
+  quantized engine's streams are BIT-IDENTICAL across layouts and
+  meshes (dense/paged, spec on/off, tp degrees), and the Pallas and
+  XLA impls are bitwise-identical to each other. Versus the fp
+  engine, streams carry a measured logit-error budget instead
+  (BASELINE.md "Quantized serving"); greedy streams may diverge —
+  that is the accuracy/HBM trade, recorded, not hidden.
+- **Kill switch + evidence gate.** PADDLE_TPU_QUANT off-values
+  disable quantization for new engines even when quant="int8"
+  (unrecognized values fail SAFE to off); adoption into the registry
+  goes through tools/bench_serving.py --quant --adopt, which refuses
+  unless weight bytes <= 0.55x fp AND tokens/s >= 0.95x fp.
+
 Observability: serving.* monitor counters/gauges (slot occupancy,
 queue depth, tokens emitted, prefills, decode ticks, plus
 rejected/timeout/cancelled/poisoned/evicted/retries/faults, the
@@ -663,7 +700,8 @@ class ServingEngine:
                  page_size: int = 16, num_pages: int = 0,
                  prefill_chunk: int = 0, prefix_sharing: bool = True,
                  spec_decode: str = "auto", gamma: int = 4,
-                 draft_layers: int = 0, mesh=None, tp_axis: str = "tp"):
+                 draft_layers: int = 0, mesh=None, tp_axis: str = "tp",
+                 quant: str = "auto"):
         self.family = (family_for(family) if isinstance(family, str)
                        else family)
         self.cfg = cfg
@@ -756,6 +794,26 @@ class ServingEngine:
                 "beyond the table would clamp, not error")
         self.max_top_k = int(max_top_k)
         self.bucket_lo = int(bucket_lo)
+        # --------------------------------------- weight-only int8 quant
+        # knob 'auto' consults env > registry ('quant_matmul') > off;
+        # PADDLE_TPU_QUANT's off values kill-switch even an explicit
+        # 'int8' (kernels/quant_matmul.resolve_quant). Quantization is
+        # a LEAF REWRITE at build: the fp matmul weights become
+        # <name>_q/<name>_scale pairs (plus the transposed head copy),
+        # the cached forwards pick them up from the tree through
+        # kernels/quant_matmul.leaf_matmul, and the jitted bodies /
+        # tick invariants are untouched — same state tuple, same one
+        # pull per tick, same trace ceilings.
+        from ..kernels.quant_matmul import resolve_quant
+        self.quant = resolve_quant(quant)
+        self._serving_specs = self.family.serving_specs
+        self._quant_info = None
+        if self.quant:
+            from ..quantization.serving import quantize_serving_params
+            params, qspecs, self._quant_info = quantize_serving_params(
+                params, self.family.name, self._serving_specs)
+            if self._serving_specs is not None:
+                self._serving_specs = qspecs
         self._params = (self._shard_params(params) if mesh is not None
                         else params)
         self._cache_pin = None        # leaf -> NamedSharding under mesh=
@@ -902,6 +960,25 @@ class ServingEngine:
         self._m_spec_rate = monitor.gauge("serving.spec_accept_rate")
         self._spec_prop_total = 0
         self._spec_acc_total = 0
+        # weight-only quant surface (stays 0/unset with quant off):
+        # the bytes gauges report THIS engine's weight tree before and
+        # after the int8 rewrite (the HBM halving observable); the
+        # counter advances by the number of fused dequant-matmuls each
+        # device pass executes (per_layer quantized leaves x depth +
+        # the head — a full pass per decode tick / prefill chunk, plus
+        # gamma truncated draft passes per spec tick)
+        self._m_qw = monitor.gauge("serving.quant_weights_bytes")
+        self._m_fpw = monitor.gauge("serving.fp_weights_bytes")
+        self._m_qmm = monitor.counter("serving.quant_matmuls")
+        self._qmm_full = self._qmm_draft = 0
+        if self._quant_info:
+            self._m_qw.set(self._quant_info["quant_bytes"])
+            self._m_fpw.set(self._quant_info["fp_bytes"])
+            self._qmm_full = (self._quant_info["per_layer"] * n_layers
+                              + self._quant_info["head"])
+            self._qmm_draft = (self._quant_info["per_layer"]
+                               * self.spec_draft_layers
+                               + self._quant_info["head"])
 
     # -------------------------------------------------------- page pool
     def _init_paged_cache(self):
@@ -938,7 +1015,7 @@ class ServingEngine:
         replicate (parallel.mesh.sharding_for's shape-aware degrade)."""
         from jax.sharding import PartitionSpec
         from ..parallel.mesh import sharding_for
-        specs = self.family.serving_specs or {}
+        specs = self._serving_specs or {}
         return {name: jax.device_put(
                     v, sharding_for(specs.get(name, PartitionSpec()),
                                     self.mesh, shape=np.shape(v)))
@@ -988,6 +1065,14 @@ class ServingEngine:
         st["cow_copies"] = self._m_cow.value
         st["prefill_chunks"] = self._m_chunks.value
         return st
+
+    def quant_stats(self) -> dict:
+        """The weight-only quant observable: fp vs int8 weight bytes
+        and the per-pass fused-matmul counts (quantization/serving.py
+        info dict), or {"quant": "off"}."""
+        if not self._quant_info:
+            return {"quant": "off"}
+        return {"quant": "int8", **self._quant_info}
 
     def _publish_pool_gauges(self) -> None:
         if not self.paged:
@@ -1485,6 +1570,10 @@ class ServingEngine:
                 self._backoff(attempt)
 
         self._m_tick.add()
+        if self._quant_info:
+            self._m_qmm.add(self._qmm_full
+                            + (self.spec_gamma * self._qmm_draft
+                               if self.spec else 0))
         tick_now = time.perf_counter()
         if self.spec:
             self._apply_spec_emissions(toks, events, tick_now)
@@ -1602,6 +1691,8 @@ class ServingEngine:
             # under the same watchdog as the tick's
             tok = int(self._pull(first))
         self._m_pre.add()
+        if self._quant_info:
+            self._m_qmm.add(self._qmm_full)
         if tok < 0:
             # prefill quarantine: the slot was never activated — its
             # (possibly non-finite) cache row is masked stale garbage
@@ -1756,6 +1847,8 @@ class ServingEngine:
                 sampling=final and req.temperature > 0.0)
             tok = int(self._pull(first)) if final else None
         self._m_chunks.add()
+        if self._quant_info:
+            self._m_qmm.add(self._qmm_full)
         if not final:
             req._pf_next = end
             return

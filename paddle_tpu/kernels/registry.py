@@ -101,6 +101,15 @@ KNOWN_IMPLS: Dict[str, tuple] = {
     # tools/bench_serving.py --spec --adopt is the evidence-gated
     # writer
     "spec_decode": ("off", "spec"),
+    # weight-only int8 serving (fused dequant-matmul over the stacked
+    # serving weights, kernels/quant_matmul.py): 'off' = fp weights,
+    # 'xla'/'pallas' = quantize at engine build and run the named
+    # matmul impl. Env PADDLE_TPU_QUANT overrides AND kill-switches
+    # (unrecognized values fail safe to off);
+    # tools/bench_serving.py --quant --adopt is the evidence-gated
+    # writer (refuses unless weight bytes <= 0.55x fp AND tokens/s
+    # >= 0.95x fp)
+    "quant_matmul": ("off", "xla", "pallas"),
 }
 
 _DOCS: Dict[str, Optional[dict]] = {}   # path -> parsed doc (memoized)

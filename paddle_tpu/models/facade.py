@@ -93,7 +93,7 @@ class FacadeModel:
                  max_len=None, temperature=0.0, top_k=0, eos_id=None,
                  max_top_k=0, seed=0, deadline_s=None,
                  deadline_ticks=None, max_ticks=None, spec_decode=None,
-                 gamma=None, draft_layers=None, mesh=None,
+                 gamma=None, draft_layers=None, quant=None, mesh=None,
                  tp_axis="tp", **engine_kw):
         """Continuous-batching generation over this model's params
         (inference/serving.py): prompts is a list of 1-D int token-id
@@ -120,6 +120,12 @@ class FacadeModel:
         draft depth rebuilds the engine rather than serving a tick
         compiled for the old knobs.
 
+        Quantized serving: `quant` ("auto"|"off"|"int8") selects the
+        weight-only int8 path (inference/serving.py quant=;
+        PADDLE_TPU_QUANT is the kill switch) and joins the engine
+        cache key — a quant engine compiled over the int8 tree is
+        never reused for fp serving or vice versa.
+
         Tensor-parallel serving: `mesh` (a jax Mesh with a `tp_axis`
         axis — parallel.mesh.build_mesh({'tp': N})) shards the engine's
         decode tick, KV pool and params over the mesh
@@ -128,7 +134,7 @@ class FacadeModel:
         model silently reusing an engine compiled for another mesh (or
         for one device) would serve from the wrong layout."""
         for k, v in (("spec_decode", spec_decode), ("gamma", gamma),
-                     ("draft_layers", draft_layers)):
+                     ("draft_layers", draft_layers), ("quant", quant)):
             if v is not None:
                 engine_kw[k] = v
         if self._serving_family is None:

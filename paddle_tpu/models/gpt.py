@@ -620,7 +620,8 @@ def _cached_attention(x, params_l, kc, vc, pos, cfg, pt=None):
     gathered per-slot view — bit-identical to the dense layout."""
     B, T, D = x.shape
     H, hd = cfg.num_heads, cfg.head_dim
-    qkv = jnp.einsum("bsd,df->bsf", x, params_l["qkv_w"].astype(x.dtype))
+    from ..kernels.quant_matmul import leaf_matmul
+    qkv = leaf_matmul(x, params_l, "qkv_w")
     if params_l.get("qkv_b") is not None:
         qkv = qkv + params_l["qkv_b"].astype(x.dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -639,8 +640,7 @@ def _cached_attention(x, params_l, kc, vc, pos, cfg, pt=None):
         ctx = cached_attention(q, gather_pages(kc, pt),
                                gather_pages(vc, pt), pos)
     ctx = ctx.reshape(B, T, D).astype(x.dtype)
-    out = jnp.einsum("bsd,df->bsf", ctx,
-                     params_l["attn_out_w"].astype(x.dtype))
+    out = leaf_matmul(ctx, params_l, "attn_out_w")
     if params_l.get("attn_out_b") is not None:
         out = out + params_l["attn_out_b"].astype(x.dtype)
     return out, kc, vc
@@ -689,11 +689,18 @@ def gpt_forward_cached(params, tokens, cache, pos, cfg: GPTConfig,
     x = x + wpe.astype(cfg.dtype)
 
     block_keys = _BLOCK_KEYS_MOE if cfg.num_experts > 0 else _BLOCK_KEYS_DENSE
+    # weight-only int8 serving (quantization/serving.py): quantized
+    # trees drop the fp matmul leaves and carry <name>_q/<name>_scale
+    # instead — both stacked on the same leading layer axis, so they
+    # ride the scan (and the layers= draft slice) like the fp weights
+    block_keys = block_keys + tuple(
+        k2 for k in block_keys for k2 in (k + "_q", k + "_scale"))
     stacked = {k: params[k] for k in block_keys if k in params}
     n_layers = cfg.num_layers
     if layers is not None:
         stacked = {k: v[:layers] for k, v in stacked.items()}
         n_layers = int(layers)
+    from ..kernels.quant_matmul import leaf_matmul, quant_matmul
 
     def scan_fn(x, layer_in):
         params_l, kc, vc = layer_in
@@ -711,10 +718,16 @@ def gpt_forward_cached(params, tokens, cache, pos, cfg: GPTConfig,
                                params_l["moe_down_w"],
                                params_l["moe_down_b"], cfg)
         else:
-            m = _dense_ffn(m_in, params_l["mlp_up_w"],
-                           params_l.get("mlp_up_b"),
-                           params_l["mlp_down_w"],
-                           params_l.get("mlp_down_b"))
+            # leaf_matmul-routed FFN (same contraction as _dense_ffn;
+            # the quantized tree swaps each matmul for the fused
+            # dequant-matmul per leaf)
+            mh = leaf_matmul(m_in, params_l, "mlp_up_w")
+            if params_l.get("mlp_up_b") is not None:
+                mh = mh + params_l["mlp_up_b"].astype(mh.dtype)
+            mh = jax.nn.gelu(mh)
+            m = leaf_matmul(mh, params_l, "mlp_down_w")
+            if params_l.get("mlp_down_b") is not None:
+                m = m + params_l["mlp_down_b"].astype(m.dtype)
         return h + m, (kc, vc)
 
     x, (kcs, vcs) = jax.lax.scan(
@@ -722,7 +735,14 @@ def gpt_forward_cached(params, tokens, cache, pos, cfg: GPTConfig,
         unroll=max(1, min(getattr(cfg, "decode_scan_unroll", 1),
                           n_layers)))
     x = _ln(x, params["ln_f_scale"], params["ln_f_bias"], cfg.layer_norm_eps)
-    logits = jnp.einsum("bsd,vd->bsv", x, params["wte"].astype(x.dtype))
+    if "head_q" in params:
+        # quantized tied head: a transposed int8 copy ([D, V] +
+        # per-vocab scales) so `wte` itself stays fp for the embedding
+        # gather (quantization/serving.py)
+        logits = quant_matmul(x, params["head_q"], params["head_scale"])
+    else:
+        logits = jnp.einsum("bsd,vd->bsv", x,
+                            params["wte"].astype(x.dtype))
     out = {"k": kcs, "v": vcs}
     if pt is not None:
         out["pt"] = pt

@@ -279,20 +279,27 @@ def llama_forward_cached(params, tokens, cache, pos, cfg: LlamaConfig,
         cos = jnp.take(cos_full, idx, axis=0, mode="clip")  # [B,T,hd/2]
         sin = jnp.take(sin_full, idx, axis=0, mode="clip")
 
-    stacked = {k: params[k] for k in _BLOCK_KEYS}
+    # weight-only int8 serving (quantization/serving.py): quantized
+    # trees drop the fp matmul leaves and carry <name>_q/<name>_scale
+    # instead — both stacked on the same leading layer axis, so they
+    # ride the scan (and the layers= draft slice) like the fp weights
+    block_keys = _BLOCK_KEYS + tuple(
+        k2 for k in _BLOCK_KEYS for k2 in (k + "_q", k + "_scale"))
+    stacked = {k: params[k] for k in block_keys if k in params}
     n_layers = cfg.num_layers
     if layers is not None:
         stacked = {k: v[:layers] for k, v in stacked.items()}
         n_layers = int(layers)
     from ..kernels.decode_attention import (cached_attention, gather_pages,
                                             write_kv, write_kv_paged)
+    from ..kernels.quant_matmul import leaf_matmul, quant_matmul
 
     def scan_fn(x, layer_in):
         lp, kc, vc = layer_in
         h = _rmsnorm(x, lp["attn_norm"], cfg.rms_eps)
-        q = (h @ lp["q_w"].astype(h.dtype)).reshape(B, T, H, hd)
-        k = (h @ lp["k_w"].astype(h.dtype)).reshape(B, T, KV, hd)
-        v = (h @ lp["v_w"].astype(h.dtype)).reshape(B, T, KV, hd)
+        q = leaf_matmul(h, lp, "q_w").reshape(B, T, H, hd)
+        k = leaf_matmul(h, lp, "k_w").reshape(B, T, KV, hd)
+        v = leaf_matmul(h, lp, "v_w").reshape(B, T, KV, hd)
         q = _apply_rope(q, cos, sin)
         k = _apply_rope(k, cos, sin)
         if pt is None:
@@ -305,18 +312,24 @@ def llama_forward_cached(params, tokens, cache, pos, cfg: LlamaConfig,
             ctx = cached_attention(q, gather_pages(kc, pt),
                                    gather_pages(vc, pt), pos)
         ctx = ctx.reshape(B, T, H * hd).astype(x.dtype)
-        x = x + ctx @ lp["o_w"].astype(x.dtype)
+        x = x + leaf_matmul(ctx, lp, "o_w")
         h = _rmsnorm(x, lp["ffn_norm"], cfg.rms_eps)
-        gated = jax.nn.silu(h @ lp["gate_w"].astype(h.dtype)) * (
-            h @ lp["up_w"].astype(h.dtype))
-        return x + gated @ lp["down_w"].astype(x.dtype), (kc, vc)
+        gated = jax.nn.silu(leaf_matmul(h, lp, "gate_w")) * \
+            leaf_matmul(h, lp, "up_w")
+        return x + leaf_matmul(gated, lp, "down_w"), (kc, vc)
 
     x, (kcs, vcs) = jax.lax.scan(
         scan_fn, x, (stacked, cache["k"], cache["v"]),
         unroll=max(1, min(getattr(cfg, "decode_scan_unroll", 1),
                           n_layers)))
     x = _rmsnorm(x, params["norm_f"], cfg.rms_eps)
-    logits = jnp.einsum("bsd,vd->bsv", x, params["wte"].astype(x.dtype))
+    if "head_q" in params:
+        # quantized tied head (transposed int8 copy + per-vocab scales;
+        # `wte` stays fp for the embedding — quantization/serving.py)
+        logits = quant_matmul(x, params["head_q"], params["head_scale"])
+    else:
+        logits = jnp.einsum("bsd,vd->bsv", x,
+                            params["wte"].astype(x.dtype))
     out = {"k": kcs, "v": vcs}
     if pt is not None:
         out["pt"] = pt

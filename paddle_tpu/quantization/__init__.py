@@ -321,4 +321,7 @@ def quanter(name):
 
 
 from .int8 import (  # noqa: E402
-    Int8Linear, Int8Conv2D, convert_to_int8, quantize_weight)
+    Int8Linear, Int8Conv2D, convert_to_int8, quantize_weight,
+    quantize_weight_stacked)
+from .serving import (  # noqa: E402
+    QUANT_LEAVES, quantize_serving_params)

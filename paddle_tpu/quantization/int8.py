@@ -27,7 +27,7 @@ from ..framework.dispatch import defop
 from ..nn.layer import Layer
 
 __all__ = ["Int8Linear", "Int8Conv2D", "convert_to_int8",
-           "quantize_weight"]
+           "quantize_weight", "quantize_weight_stacked"]
 
 _Q = 127.0
 
@@ -49,6 +49,28 @@ def quantize_weight(w: np.ndarray, channel_axis: Optional[int] = None):
                 .astype(np.int8), scale)
     return (np.clip(np.round(w / scale * _Q), -_Q, _Q).astype(np.int8),
             scale)
+
+
+def quantize_weight_stacked(w: np.ndarray):
+    """Stacked fp weight [L, ..., N] -> (int8 weight [L, ..., N], fp32
+    scales [L, N]): per-OUTPUT-CHANNEL abs-max over every reduction
+    axis, vectorized over the leading layer axis — numerically
+    IDENTICAL to quantize_weight(w[l], channel_axis=w[l].ndim - 1) per
+    layer (tests/test_quant_serving.py pins the parity). This is the
+    load-time quantizer for the stacked-scan serving weights
+    (quantization/serving.py): one call covers the whole layer stack,
+    and the scales keep the [L, N] leading layer axis so they ride the
+    same lax.scan as the weights they dequantize."""
+    w = np.asarray(w, np.float32)
+    if w.ndim < 3:
+        raise ValueError(f"stacked weight must be [L, ..., N] with at "
+                         f"least one reduction axis; got shape {w.shape}")
+    red = tuple(range(1, w.ndim - 1))
+    scale = np.maximum(np.abs(w).max(axis=red), 1e-8).astype(np.float32)
+    scale_b = scale.reshape(
+        (w.shape[0],) + (1,) * (w.ndim - 2) + (w.shape[-1],))
+    w_q = np.clip(np.round(w / scale_b * _Q), -_Q, _Q).astype(np.int8)
+    return w_q, scale
 
 
 def _quant_act(x, x_scale):

@@ -88,14 +88,42 @@ class TestPredictor:
                     np.asarray(w.astype(dt).astype(w.dtype)))
             p.run([np.ones((2, 8), np.float32)])   # still serves
 
-    def test_precision_int8_refused(self, tmp_path):
+    def test_precision_int8_round_trip(self, tmp_path):
+        """Int8 routes to the weight-only converter (per-output-
+        channel round-trip on every floating matrix param — the
+        serving engines' quant= path applied at Predictor load):
+        weights land exactly on their int8 grid, vectors stay fp, and
+        the served outputs sit inside a logit-error budget vs fp."""
         from paddle_tpu.inference import PrecisionType
+        from paddle_tpu.quantization.int8 import quantize_weight
         _, path = self._save_model(tmp_path)
-        with pytest.raises(NotImplementedError):
-            create_predictor(Config(path).set_precision(
-                PrecisionType.Int8))
+        fp = create_predictor(Config(path))
+        p8 = create_predictor(Config(path).set_precision(
+            PrecisionType.Int8))
+        changed = 0
+        for w_fp, w_q in zip(fp._layer._params, p8._layer._params):
+            w_fp, w_q = np.asarray(w_fp), np.asarray(w_q)
+            if w_fp.ndim < 2:
+                np.testing.assert_array_equal(w_fp, w_q)  # vectors fp
+                continue
+            # round-tripping the quantized weights is a FIXED POINT:
+            # they already sit on their per-channel int8 grid
+            q, s = quantize_weight(w_q.astype(np.float32),
+                                   channel_axis=w_q.ndim - 1)
+            shape = (1,) * (w_q.ndim - 1) + (-1,)
+            np.testing.assert_allclose(
+                w_q, q.astype(np.float32) * (s / 127.0).reshape(shape),
+                rtol=1e-6, atol=1e-7)
+            changed += int(not np.array_equal(w_fp, w_q))
+        assert changed > 0
+        x = np.random.RandomState(0).randn(2, 8).astype(np.float32)
+        out_fp, out_q = fp.run([x])[0], p8.run([x])[0]
+        span = max(float(np.abs(out_fp).max()), 1.0)
+        assert float(np.abs(out_fp - out_q).max()) < 0.05 * span
+
+    def test_precision_unknown_refused(self):
         with pytest.raises(ValueError):
-            Config(path).set_precision("int4")
+            Config("/tmp/foo.pdmodel").set_precision("int4")
 
     def test_tensorrt_precision_mode_sets_precision(self):
         from paddle_tpu.inference import PrecisionType

@@ -25,6 +25,7 @@ Usage:
   python tools/bench_serving.py --capacity     # paged-vs-dense @ equal HBM
   python tools/bench_serving.py --spec         # speculative A/B (1 slot)
   python tools/bench_serving.py --spec --sweep # acceptance vs gamma/K
+  python tools/bench_serving.py --quant        # weight-only int8 A/B
   python tools/bench_serving.py --tp 2         # tp-sharded decode parity
   python tools/bench_serving.py --router 2     # replicated-engine router
   PADDLE_TPU_TELEMETRY_JSONL=serve.jsonl python tools/bench_serving.py
@@ -470,6 +471,136 @@ def spec_main(args):
     return 0 if mismatches == 0 else 1
 
 
+def quant_main(args):
+    """--quant: weight-only int8 A/B (BASELINE.md "Quantized serving")
+    — fp engine vs quant="int8" engine on the same workload, same
+    slots. Reports tokens/s both ways, the weight-HBM bytes both ways
+    (the halving observable), the logit max-abs-error budget from a
+    prefill-shaped probe through both param trees, and the intra-quant
+    determinism check (quant dense vs quant paged must be
+    BIT-IDENTICAL — weight-only dequant is deterministic; only the
+    quant-vs-fp comparison carries an error budget). --adopt writes
+    the evidence-gated registry row ("quant_matmul" -> the measured
+    impl) and refuses unless weight bytes <= 0.55x fp AND tokens/s
+    >= 0.95x fp with zero recompiles and exact intra-quant parity.
+    One JSON line."""
+    from paddle_tpu.models.decode import next_pow2
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.profiler import monitor
+
+    gen = args.gen
+    max_len = args.max_len or next_pow2(args.prompt_hi + gen)
+    params, cfg = _build_family(args, max_len)
+    prompts = build_workload(args.requests, args.prompt_lo,
+                             args.prompt_hi, args.vocab)
+    total_tokens = args.requests * gen
+    _log(f"quant workload: {args.requests} reqs, gen {gen}, "
+         f"{args.family} {args.layers}Lx{args.hidden}d, "
+         f"slots={args.slots}, max_len={max_len}")
+
+    def run(eng):
+        t0 = time.perf_counter()
+        outs = eng.generate(prompts, gen)
+        return time.perf_counter() - t0, outs
+
+    def ticks():
+        return monitor.counter("serving.decode_ticks").value
+
+    # quant="off" EXPLICITLY: after a successful --adopt the registry
+    # winner would make the default "auto" quantize this baseline too,
+    # and the A/B would silently compare quant vs quant forever after
+    base = ServingEngine(params, cfg, family=args.family,
+                         num_slots=args.slots, max_len=max_len,
+                         quant="off")
+    run(base)                                        # warm
+    base_s, _base_outs = run(base)
+
+    eng = ServingEngine(params, cfg, family=args.family,
+                        num_slots=args.slots, max_len=max_len,
+                        quant="int8")
+    run(eng)                                         # warm
+    traces_warm = eng.trace_counts()
+    k0 = ticks()
+    q_s, q_outs = run(eng)
+    q_ticks = ticks() - k0
+    traces_after = eng.trace_counts()
+
+    # intra-quant determinism: the paged engine over the SAME int8
+    # tree must stream bit-identically (the exact-parity tier)
+    paged = ServingEngine(params, cfg, family=args.family,
+                          num_slots=args.slots, max_len=max_len,
+                          quant="int8", kv_layout="paged",
+                          page_size=16)
+    run(paged)                                       # warm
+    _, paged_outs = run(paged)
+    mismatches = sum(1 for a, b in zip(q_outs, paged_outs)
+                     if not np.array_equal(a, b))
+
+    # logit error budget: one prefill-shaped probe through both trees
+    probe = jnp.asarray(prompts[0])[None]
+    fam = eng.family
+    lg_fp, _ = fam.forward_cached(
+        params, probe, fam.init_cache(cfg, 1, probe.shape[1]), 0, cfg)
+    lg_q, _ = fam.forward_cached(
+        eng._params, probe, fam.init_cache(cfg, 1, probe.shape[1]), 0,
+        cfg)
+    err = float(jnp.max(jnp.abs(lg_fp.astype(jnp.float32)
+                                - lg_q.astype(jnp.float32))))
+    lg_span = float(jnp.max(jnp.abs(lg_fp.astype(jnp.float32))))
+
+    st = eng.quant_stats()
+    bytes_ratio = st["quant_bytes"] / st["fp_bytes"]
+    base_tps = total_tokens / base_s
+    q_tps = total_tokens / q_s
+    recompiles = [traces_after[0] - traces_warm[0],
+                  traces_after[1] - traces_warm[1]]
+    doc = {
+        "metric": "serving_quant_tokens_per_sec",
+        "value": round(q_tps, 1),
+        "unit": "tokens/s (weight-only int8)",
+        "backend": jax.devices()[0].platform,
+        "fp_tokens_per_sec": round(base_tps, 1),
+        "tokens_ratio_vs_fp": round(q_tps / base_tps, 2),
+        "fp_weight_bytes": st["fp_bytes"],
+        "quant_weight_bytes": st["quant_bytes"],
+        "weight_bytes_ratio": round(bytes_ratio, 3),
+        "logit_max_abs_err": round(err, 5),
+        "logit_max_abs": round(lg_span, 3),
+        "quant_leaves": list(st["quant_leaf_names"]) + ["head"],
+        "requests": args.requests, "gen": gen, "slots": args.slots,
+        "model": f"{args.layers}Lx{args.hidden}d",
+        "family": args.family, "max_len": max_len,
+        "recompiles_after_warmup": recompiles,
+        "stream_mismatches": mismatches,     # quant dense vs paged
+    }
+
+    if args.adopt:
+        from paddle_tpu.kernels import registry
+        from paddle_tpu.kernels.quant_matmul import matmul_impl
+        ok = (mismatches == 0
+              and bytes_ratio <= 0.55
+              and doc["tokens_ratio_vs_fp"] >= 0.95
+              and recompiles == [0, 0])
+        if not ok:
+            doc["adopt"] = ("refused: bytes/<=0.55x, tokens/s>=0.95x, "
+                            "parity or recompile gate failed")
+        else:
+            # evidence: per-tick ms + the int8 weight bytes a decode
+            # tick streams — the roofline gate re-checks plausibility
+            per_tick_ms = q_s * 1e3 / max(q_ticks, 1)
+            problem = registry.adopt(
+                "quant_matmul", matmul_impl(), per_tick_ms,
+                bytes_moved=float(st["quant_bytes"]),
+                source=(f"bench_serving --quant: weight bytes "
+                        f"{doc['weight_bytes_ratio']}x fp, tokens/s "
+                        f"{doc['tokens_ratio_vs_fp']}x fp, logit "
+                        f"max-abs-err {doc['logit_max_abs_err']} "
+                        f"(|logit| max {doc['logit_max_abs']})"))
+            doc["adopt"] = problem or "adopted"
+    print(json.dumps(doc), flush=True)
+    return 0 if mismatches == 0 else 1
+
+
 def _build_family(args, max_len):
     """(params, cfg) for the bench family/shape at a given cache len —
     shared by the tp/router modes (the other modes predate it)."""
@@ -690,8 +821,12 @@ def main():
     ap.add_argument("--sweep", action="store_true",
                     help="--spec: acceptance vs gamma/draft-depth table")
     ap.add_argument("--adopt", action="store_true",
-                    help="--spec: write the evidence-gated registry row "
-                         "when the speedup clears 1.5x")
+                    help="--spec/--quant: write the evidence-gated "
+                         "registry row (spec: speedup >= 1.5x; quant: "
+                         "weight bytes <= 0.55x AND tokens/s >= 0.95x)")
+    ap.add_argument("--quant", action="store_true",
+                    help="weight-only int8 A/B: fp vs quant engine, "
+                         "weight bytes + tokens/s + logit error budget")
     ap.add_argument("--tp", type=int, default=0,
                     help="tensor-parallel decode on an N-way CPU mesh "
                          "vs unsharded (bit-parity + mechanics)")
@@ -718,6 +853,8 @@ def main():
         return chunk_slo_main(args)
     if args.spec:
         return spec_main(args)
+    if args.quant:
+        return quant_main(args)
 
     from paddle_tpu.models.decode import next_pow2
     from paddle_tpu.inference.serving import ServingEngine

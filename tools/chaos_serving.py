@@ -42,6 +42,15 @@ Speculative-decode scenarios (docs/serving.md "Speculative decoding"):
                    quarantine verdict still rides the emission matrix:
                    only slot S poisons, survivors exact
 
+Quantized-engine scenario (weight-only int8 serving, docs/serving.md
+"Quantized serving"):
+  quant_nan_logits@T:S nan_logits on a quant="int8" engine -> only
+                   slot S's request ends "poisoned", survivors are
+                   bit-identical to the fault-free QUANT baseline
+                   (the quant engine's own parity class), the
+                   serving.quant_matmuls counter moved (the int8 path
+                   actually served), exactly-once + trace ceilings
+
 Router scenario (the replicated-engine router, inference/router.py;
 docs/serving.md "Sharded serving & routing"):
   router_replica_death 2 engine replicas, one killed mid-decode ->
@@ -428,6 +437,30 @@ def run_drill(quick: bool = False, keep_root: bool = False) -> int:
                 or check_traces(eng))
     scenario("spec_nan_logits@2:1", spec_target_nan,
              spec="nan_logits@2:1")
+
+    # --- quantized engine: quarantine + exactly-once still hold ------
+    # the quantized engine's streams are its OWN parity class (weight-
+    # only dequant shifts logits vs fp by the recorded budget), so the
+    # survivors compare against a fault-free QUANT baseline, not the
+    # fp one — the guardrail claim is isolation, not fp equality
+    quant_want = make_engine(params, cfg, max_len,
+                             quant="int8").generate(prompts, gen)
+
+    def quant_nan():
+        from paddle_tpu.profiler import monitor
+        q0 = monitor.counter("serving.quant_matmuls").value
+        eng = make_engine(params, cfg, max_len, quant="int8")
+        reqs = [eng.submit(p, gen) for p in prompts]
+        eng.drain()
+        reasons = [r.finish_reason for r in reqs]
+        if reasons.count("poisoned") != 1:
+            return f"expected exactly one poisoned request: {reasons}"
+        if monitor.counter("serving.quant_matmuls").value <= q0:
+            return "quant_matmuls counter never moved (fp path served?)"
+        return (check_terminal(reqs)
+                or check_streams(reqs, quant_want)
+                or check_traces(eng))
+    scenario("quant_nan_logits@2:1", quant_nan, spec="nan_logits@2:1")
 
     # --- router: replica death mid-decode ----------------------------
     def replica_death():

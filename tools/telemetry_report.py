@@ -161,6 +161,8 @@ def summarize(path: str, samples_per_step: Optional[float] = None) -> dict:
     _SERVING_GAUGES = ("serving.slot_occupancy", "serving.queue_depth",
                        "serving.queue_wait_ms", "serving.pages_in_use",
                        "serving.pages_shared", "serving.spec_accept_rate",
+                       "serving.quant_weights_bytes",
+                       "serving.fp_weights_bytes",
                        "serving.router.replicas_live",
                        "serving.router.pending")
 
@@ -178,6 +180,11 @@ def summarize(path: str, samples_per_step: Optional[float] = None) -> dict:
     # proposed/accepted counter deltas + the per-engine acceptance-rate
     # gauge, grouped under serving.spec when any of them moved
     _SPEC = ("spec_proposed", "spec_accepted", "spec_accept_rate")
+    # the weight-only quant surface (inference/serving.py quant=):
+    # fp-vs-int8 weight-bytes gauges + the fused dequant-matmul
+    # counter, grouped under serving.quant when any of them moved
+    _QUANT = ("quant_weights_bytes", "fp_weights_bytes",
+              "quant_matmuls")
     if monitors:
         first_s, last_s = monitors[0]["stats"], monitors[-1]["stats"]
         srv = {k[len("serving."):]:
@@ -195,6 +202,14 @@ def summarize(path: str, samples_per_step: Optional[float] = None) -> dict:
             spec = {k: srv.pop(k) for k in _SPEC if k in srv}
             if any(spec.values()):
                 srv["spec"] = spec
+            quant = {k: srv.pop(k) for k in _QUANT if k in srv}
+            if any(quant.values()):
+                if quant.get("quant_weights_bytes") and \
+                        quant.get("fp_weights_bytes"):
+                    quant["weight_bytes_ratio"] = round(
+                        quant["quant_weights_bytes"]
+                        / quant["fp_weights_bytes"], 3)
+                srv["quant"] = quant
             # the replicated-engine router surface (inference/router.py
             # serving.router.*): liveness/requeue/balance, grouped —
             # per-replica queue depths and dispatch counters keep their
