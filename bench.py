@@ -68,6 +68,18 @@ PEAK_FLOPS = [
 ]
 
 
+def train_flops_per_token(n_params: int, num_layers: int,
+                          hidden_size: int, seq: int) -> float:
+    """ONE home for the train-step MFU accounting: 6N matmul FLOPs per
+    token (fwd+bwd) plus the attention score/context matmul term. The
+    plan3d rung (tools/bench_plan3d.py), the sharded-step ablation rows
+    (tools/ablate_step.py) and the campaign's sweep plausibility gate
+    (tools/tpu_campaign.py) all price against THIS formula, so their
+    MFU/evidence rows stay comparable with the BENCH_window best_tpu
+    rows — adjust it here and every consumer moves together."""
+    return 6.0 * n_params + 12.0 * num_layers * hidden_size * seq
+
+
 def _peak_for(device_kind: str, platform: str) -> float:
     if platform not in ("tpu", "axon"):
         return 1e12  # nominal CPU figure; MFU is not meaningful off-chip
@@ -292,8 +304,8 @@ def run_measurement(rung: str) -> None:
 
     def emit(dt, cfg, n_params, vkw, vbatch):
         tps = vbatch * seq / dt
-        flops_per_token = 6.0 * n_params + \
-            12.0 * cfg.num_layers * cfg.hidden_size * seq
+        flops_per_token = train_flops_per_token(
+            n_params, cfg.num_layers, cfg.hidden_size, seq)
         peak = _peak_for(devs[0].device_kind, platform)
         mfu = flops_per_token * tps / peak
         # the orchestrator takes the LAST JSON line: emitting after each
@@ -426,16 +438,34 @@ def best_tpu(here: str = None) -> dict | None:
     return max(recs, key=lambda r: r.get("value", 0)) if recs else None
 
 
-def _probe_tpu(here: str, tries: int = 2, timeout_s: int = 360) -> bool:
-    """Cheap bounded check that the TPU tunnel is alive before committing to
-    the long TPU-rung timeouts."""
+def _probe_tpu(here: str, tries: int = 2, timeout_s: int = 360,
+               first_timeout_s: int = 120) -> bool:
+    """Cheap bounded check that the TPU tunnel is alive before committing
+    to the long TPU-rung timeouts.
+
+    Tunnel-down economics (BENCH_r05 tail burned 2x360 s here before the
+    CPU fallback even started): a LIVE tunnel answers a probe in seconds,
+    while a dead one HANGS until the timeout — so the first probe runs
+    under a short budget, and a first-probe TIMEOUT (the dead-tunnel
+    signature) skips the retry entirely. The long retry is reserved for
+    fast non-zero exits (a transient init error with the tunnel up).
+    `PADDLE_TPU_SKIP_TPU_PROBE=1` skips probing altogether — straight to
+    the CPU rungs (CI / known-dead-tunnel runs)."""
+    if os.environ.get("PADDLE_TPU_SKIP_TPU_PROBE") == "1":
+        _log("PADDLE_TPU_SKIP_TPU_PROBE=1: skipping TPU probe")
+        return False
     code = "import jax; print('PROBE', jax.devices()[0].platform)"
     for i in range(tries):
+        t_s = first_timeout_s if i == 0 else timeout_s
         try:
             res = subprocess.run([sys.executable, "-c", code], cwd=here,
-                                 stdout=subprocess.PIPE, timeout=timeout_s)
+                                 stdout=subprocess.PIPE, timeout=t_s)
         except subprocess.TimeoutExpired:
-            _log(f"TPU probe {i + 1}/{tries} timed out ({timeout_s}s)")
+            _log(f"TPU probe {i + 1}/{tries} timed out ({t_s}s)"
+                 + ("; dead-tunnel signature, not retrying"
+                    if i == 0 else ""))
+            if i == 0:
+                return False
             continue
         out = res.stdout.decode()
         if res.returncode == 0 and "PROBE" in out:

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 
 def make_train_step(step_fn, cfg=None, donate=True, extra_donate=(),
-                    **step_kw):
+                    mesh=None, plan=None, **step_kw):
     """jit the stacked-params functional train step with the params and
     optimizer-state buffers DONATED — step_fn(params, opt_state, batch,
     ...) -> (loss, new_params, new_opt_state) consumes both trees and
@@ -34,7 +34,22 @@ def make_train_step(step_fn, cfg=None, donate=True, extra_donate=(),
     dispatch, or restarts (docs/fault_tolerance.md). `extra_donate`
     names additional positional arg indices to donate — the telemetry
     accumulator (profiler/telemetry.py) rides through the step donated
-    exactly like the params/opt buffers."""
+    exactly like the params/opt buffers.
+
+    3D auto-parallel (docs/parallel_training.md): with `mesh` (a
+    build_mesh Mesh) and `plan` (parallel.planner.plan_train's
+    TrainPlan) the step compiles as ONE GSPMD computation with its
+    in/out shardings PINNED: params, grads-as-moments and both Adam
+    moment trees land per the plan's remapped PARAM_SPECS (shape-aware
+    degrade to replicated per leaf), the batch shards over the plan's
+    dp×fsdp axes, everything else replicates. Pinning is the serving
+    engine's `_pin_cache` discipline applied to the train state —
+    out sharding == in sharding per leaf, so the donated buffers alias
+    exactly and propagation heuristics cannot shift layouts (or force
+    a recompile) between calls. The pins derive from the FIRST call's
+    shapes; subsequent calls reuse the one compiled executable (the
+    `trace_count` property observes this — the zero-recompiles-after-
+    warmup test gate)."""
     import functools
     import jax
     from ..profiler import RecordEvent, monitor
@@ -44,7 +59,150 @@ def make_train_step(step_fn, cfg=None, donate=True, extra_donate=(),
     donate_argnums = ((0, 1) + tuple(extra_donate)) if donate else ()
     with RecordEvent("facade.make_train_step"):
         monitor.counter("facade_train_step_builds").add()
-        return jax.jit(fn, donate_argnums=donate_argnums)
+        if mesh is None:
+            return jax.jit(fn, donate_argnums=donate_argnums)
+        return _ShardedTrainStep(fn, mesh, plan,
+                                 donate_argnums=donate_argnums)
+
+
+class _ShardedTrainStep:
+    """The planner-driven GSPMD train step: a jit whose in/out shardings
+    are pinned from (plan, first-call shapes) — see make_train_step.
+
+    Pin rules (the facade step contract `(params, opt_state, batch,
+    *rest) -> (loss, new_params, new_opt, *extras)`):
+    - every params/opt leaf pins by its LEAF NAME through the plan's
+      remapped spec table (Adam's m/v mirror the param tree leaf for
+      leaf, so the same name-keyed lookup shards the moments like
+      their params; unknown names — e.g. the opt 'step' scalar —
+      replicate), shape-aware per parallel.mesh.sharding_for;
+    - batch leaves shard their leading dim over the plan's dp×fsdp
+      axes (degrading to replicated when the dim doesn't divide);
+    - all other args (poison scalars, the telemetry accumulator) and
+      all non-params/opt outputs replicate, so extra_donate aliases
+      stay exact (replicated in == replicated out).
+    Outputs index 1/2 reuse the INPUT pins verbatim — donation aliasing
+    by construction, executables that cannot drift."""
+
+    def __init__(self, fn, mesh, plan, donate_argnums=()):
+        self._fn = fn
+        self.mesh = mesh
+        self.plan = plan
+        self._donate = tuple(donate_argnums)
+        self._jit = None
+        self.in_pins = None
+        self.out_pins = None
+
+    @staticmethod
+    def _leaf_name(path):
+        import jax.tree_util as jtu
+        for entry in reversed(path):
+            if isinstance(entry, jtu.DictKey):
+                return str(entry.key)
+            if isinstance(entry, jtu.GetAttrKey):
+                return str(entry.name)
+        return ""
+
+    def _state_pins(self, tree):
+        """Name-keyed spec lookup, shape-aware (params AND opt trees)."""
+        import jax.tree_util as jtu
+        from jax.sharding import PartitionSpec as P
+        from ..parallel.mesh import sharding_for
+        specs = (self.plan.specs if self.plan is not None
+                 and self.plan.specs else {})
+
+        def pin(path, leaf):
+            spec = specs.get(self._leaf_name(path), P())
+            return sharding_for(spec, self.mesh,
+                                shape=getattr(leaf, "shape", ()))
+        return jtu.tree_map_with_path(pin, tree)
+
+    def _batch_pins(self, tree):
+        import jax
+        from jax.sharding import PartitionSpec as P
+        from ..parallel.mesh import sharding_for
+
+        def pin(leaf):
+            shape = getattr(leaf, "shape", ())
+            spec = (self.plan.batch_spec(len(shape))
+                    if self.plan is not None and len(shape) else P())
+            return sharding_for(spec, self.mesh, shape=shape)
+        return jax.tree_util.tree_map(pin, tree)
+
+    def _replicated_pins(self, tree):
+        import jax
+        from jax.sharding import PartitionSpec as P
+        from ..parallel.mesh import sharding_for
+        rep = sharding_for(P(), self.mesh)
+        return jax.tree_util.tree_map(lambda _: rep, tree)
+
+    def shard_args(self, params, opt_state, batch, *rest):
+        """device_put the step arguments onto their pins (host trees or
+        arrays laid out for another mesh land on this plan's layout —
+        the Resharder move, paid once at setup/first call)."""
+        import jax
+        pins = (self._state_pins(params), self._state_pins(opt_state),
+                self._batch_pins(batch),
+                *(self._replicated_pins(r) for r in rest))
+        return tuple(jax.device_put(a, p)
+                     for a, p in zip((params, opt_state, batch) + rest,
+                                     pins))
+
+    def _build(self, args):
+        import jax
+        in_pins = (self._state_pins(args[0]), self._state_pins(args[1]),
+                   self._batch_pins(args[2]),
+                   *(self._replicated_pins(a) for a in args[3:]))
+        out_struct = jax.eval_shape(self._fn, *args)
+        if not (isinstance(out_struct, (tuple, list))
+                and len(out_struct) >= 3):
+            raise TypeError(
+                "sharded make_train_step needs the facade step contract "
+                "(loss, new_params, new_opt, ...); got output structure "
+                f"{jax.tree_util.tree_structure(out_struct)}")
+        out_pins = []
+        for i, sub in enumerate(out_struct):
+            if i == 1:
+                out_pins.append(in_pins[0])       # new params == params
+            elif i == 2:
+                out_pins.append(in_pins[1])       # new opt == opt
+            else:
+                out_pins.append(self._replicated_pins(sub))
+        self.in_pins, self.out_pins = in_pins, tuple(out_pins)
+        self._jit = jax.jit(self._fn, in_shardings=in_pins,
+                            out_shardings=self.out_pins,
+                            donate_argnums=self._donate)
+
+    def __call__(self, params, opt_state, batch, *rest):
+        import jax
+        args = (params, opt_state, batch) + rest
+        if self._jit is None:
+            self._build(args)
+            args = self.shard_args(*args)
+        else:
+            # steady state: params/opt arrive as the previous call's
+            # pinned outputs; the batch (and any scalar extras like the
+            # guard's poison) come fresh from host each step. Committing
+            # them here keeps the jit cache key IDENTICAL to the warmup
+            # call's (committed+pinned across the board) — one
+            # executable, ever (a no-op alias when the caller already
+            # placed them).
+            args = (params, opt_state,
+                    jax.device_put(batch, self._batch_pins(batch)),
+                    *(jax.device_put(r, self._replicated_pins(r))
+                      for r in rest))
+        return self._jit(*args)
+
+    @property
+    def trace_count(self) -> int:
+        """Compiled-executable count (0 before the first call) — the
+        zero-recompiles-after-warmup observable."""
+        if self._jit is None:
+            return 0
+        try:
+            return self._jit._cache_size()
+        except AttributeError:       # jax moved the private counter
+            return -1
 
 
 class FacadeModel:

@@ -8,7 +8,7 @@ from .env import (  # noqa: F401
     ParallelEnv, device_count, local_device_count)
 from .mesh import (  # noqa: F401
     build_mesh, set_global_mesh, get_mesh, use_mesh, sharding_for,
-    shard_value, constraint, P)
+    shard_value, constraint, remap_spec_axes, remap_specs, tp_specs, P)
 from .topology import (  # noqa: F401
     CommunicateTopology, HybridCommunicateGroup, CommGroup,
     set_hybrid_communicate_group, get_hybrid_communicate_group)
@@ -20,7 +20,7 @@ from .data_parallel import DataParallel  # noqa: F401
 from .checkpoint import (  # noqa: F401
     save_sharded, load_sharded, save_train_state, load_train_state,
     verify_checkpoint, CheckpointManager, CheckpointCorruptError,
-    Converter)
+    AsyncSaveError, HostSnapshot, Converter)
 # NOTE: .resilience is NOT imported here — it imports
 # distributed.launch.heartbeat, and distributed/__init__ imports this
 # package; import it directly (paddle_tpu.parallel.resilience).

@@ -45,6 +45,7 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import threading
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -74,6 +75,12 @@ _UNSET = _Unset()
 class CheckpointCorruptError(ValueError):
     """A checkpoint directory failed integrity verification (missing or
     truncated shard file, checksum mismatch, unparseable manifest)."""
+
+
+class AsyncSaveError(RuntimeError):
+    """A background checkpoint write (CheckpointManager.save_async)
+    failed; raised at the next wait()/save_async()/save() barrier so the
+    failure cannot pass silently. The original exception is chained."""
 
 
 # Committed checkpoint paths this process wrote — the test-suite audit
@@ -237,6 +244,70 @@ def read_latest(parent: str) -> Optional[str]:
     return cand if os.path.isdir(cand) else None
 
 
+# ------------------------------------------------------- host snapshots
+class _HostLeaf:
+    """One array leaf pulled to host, shard by shard: global shape/dtype/
+    spec plus [(shard_index, window, np.ndarray), ...] replica-0 shards —
+    exactly what the manifest records, so a snapshot taken on the step
+    path can be WRITTEN later by a background thread (save_async) while
+    the device buffers it came from get donated away by the next step."""
+
+    __slots__ = ("shape", "dtype", "spec", "shards")
+
+    def __init__(self, shape, dtype, spec, shards):
+        self.shape = shape
+        self.dtype = dtype
+        self.spec = spec
+        self.shards = shards
+
+
+def _leaf_shards(arr):
+    """Replica-0 addressable shards of a jax Array as
+    (shard_index, global window, host ndarray) triples — a GENERATOR,
+    so the synchronous save path keeps its one-shard-live-at-a-time
+    memory profile (HostSnapshot materializes the list: an async save
+    deliberately trades host RAM for step-path time)."""
+    for si, shard in enumerate(arr.addressable_shards):
+        if shard.replica_id != 0:
+            continue                          # replicas dedupe
+        window = []
+        for dim, sl in enumerate(shard.index):
+            start = 0 if sl.start is None else int(sl.start)
+            stop = arr.shape[dim] if sl.stop is None else int(sl.stop)
+            window.append([start, stop])
+        yield si, window, np.asarray(shard.data)
+
+
+class HostSnapshot:
+    """A state tree fully materialized in sharded HOST buffers (flat
+    {key: scalar ndarray | _HostLeaf}): save_sharded accepts one in
+    place of the live tree. The device->host pull happens at
+    construction — the only part of an async save the step path pays."""
+
+    def __init__(self, state):
+        from ..framework.tensor import Tensor
+        self.flat = {}
+        for key, leaf in _flatten(state).items():
+            # unwrap ONLY paddle Tensors (see _save_sharded_impl)
+            if isinstance(leaf, Tensor):
+                leaf = leaf._value
+            if np.isscalar(leaf) or (
+                    isinstance(leaf, (np.ndarray, jax.Array))
+                    and getattr(leaf, "ndim", 1) == 0):
+                self.flat[key] = np.asarray(leaf)
+                continue
+            arr = leaf if isinstance(leaf, jax.Array) else jnp.asarray(leaf)
+            self.flat[key] = _HostLeaf(
+                list(arr.shape), str(np.dtype(arr.dtype)),
+                _leaf_spec(arr), list(_leaf_shards(arr)))
+
+    @property
+    def nbytes(self) -> int:
+        return sum(sum(a.nbytes for _si, _w, a in leaf.shards)
+                   if isinstance(leaf, _HostLeaf) else leaf.nbytes
+                   for leaf in self.flat.values())
+
+
 # ------------------------------------------------------------------- save
 def save_sharded(state, path: str, process_index: Optional[int] = None,
                  update_pointer: bool = True) -> str:
@@ -287,9 +358,12 @@ def _save_sharded_impl(state, path: str, process_index: Optional[int],
             if f".p{pidx}.s" in name and name.endswith(".npy"):
                 os.remove(os.path.join(stage, name))
 
-    flat = _flatten(state)
-    manifest: Dict[str, Any] = {"format": 2, "leaves": {}}
+    if isinstance(state, HostSnapshot):
+        flat = state.flat
+    else:
+        flat = _flatten(state)
     from ..framework.tensor import Tensor
+    manifest: Dict[str, Any] = {"format": 2, "leaves": {}}
     written = 0
     for key, leaf in flat.items():
         # unwrap ONLY paddle Tensors: raw jax.Array also has a private
@@ -297,8 +371,10 @@ def _save_sharded_impl(state, path: str, process_index: Optional[int],
         if isinstance(leaf, Tensor):
             leaf = leaf._value
         safe = key.replace("/", "%")
-        if np.isscalar(leaf) or (isinstance(leaf, (np.ndarray, jax.Array))
-                                 and getattr(leaf, "ndim", 1) == 0):
+        if not isinstance(leaf, _HostLeaf) and (
+                np.isscalar(leaf)
+                or (isinstance(leaf, (np.ndarray, jax.Array))
+                    and getattr(leaf, "ndim", 1) == 0)):
             np_leaf = np.asarray(leaf)
             manifest["leaves"][key] = {
                 "kind": "scalar",
@@ -309,25 +385,22 @@ def _save_sharded_impl(state, path: str, process_index: Optional[int],
                 "dtype": str(np_leaf.dtype),
             }
             continue
-        arr = leaf if isinstance(leaf, jax.Array) else jnp.asarray(leaf)
+        if isinstance(leaf, _HostLeaf):
+            host = leaf                     # async path: already pulled
+        else:
+            arr = leaf if isinstance(leaf, jax.Array) else jnp.asarray(leaf)
+            host = _HostLeaf(list(arr.shape), str(np.dtype(arr.dtype)),
+                             _leaf_spec(arr), _leaf_shards(arr))
         entry = {
             "kind": "array",
-            "shape": list(arr.shape),
-            "dtype": str(np.dtype(arr.dtype)),
-            "spec": _leaf_spec(arr),
+            "shape": host.shape,
+            "dtype": host.dtype,
+            "spec": host.spec,
             "shards": [],
         }
-        for si, shard in enumerate(arr.addressable_shards):
-            if shard.replica_id != 0:
-                continue                      # replicas dedupe
-            window = []
-            for dim, sl in enumerate(shard.index):
-                start = 0 if sl.start is None else int(sl.start)
-                stop = arr.shape[dim] if sl.stop is None else int(sl.stop)
-                window.append([start, stop])
+        for si, window, data in host.shards:
             fname = f"{safe}.p{pidx}.s{si}.npy"
-            w = _write_shard(os.path.join(stage, fname),
-                             np.asarray(shard.data))
+            w = _write_shard(os.path.join(stage, fname), data)
             entry["shards"].append({
                 "file": fname,
                 "window": window,
@@ -717,16 +790,99 @@ class CheckpointManager:
         self.max_to_keep = int(max_to_keep)
         self.prefix = prefix
         os.makedirs(self.root, exist_ok=True)
+        # async-save state: AT MOST ONE write in flight (the invariant
+        # the step-overlap design rests on — docs/parallel_training.md);
+        # _async_err carries a failed writer's exception to the next
+        # barrier
+        self._async_lock = threading.Lock()
+        self._async_thread: Optional[threading.Thread] = None
+        self._async_err: Optional[BaseException] = None
 
     def _path(self, step: int) -> str:
         return os.path.join(self.root, f"{self.prefix}-{int(step)}")
 
     def save(self, state, step: int) -> str:
         """Atomically snapshot `state` as step `step`, advance LATEST and
-        prune beyond `max_to_keep`."""
+        prune beyond `max_to_keep`. Waits out any in-flight async save
+        first (two writers racing on LATEST/gc would break atomicity)."""
+        self.wait()
         path = save_sharded(state, self._path(step))
         self._gc()
         return path
+
+    # ------------------------------------------------------------ async
+    def save_async(self, state, step: int) -> str:
+        """Snapshot `state` as step `step` WITHOUT blocking the step path
+        on the disk write: the device->host pull (a HostSnapshot) happens
+        here — it must, the next train step DONATES the device buffers
+        away — and the staged-tmp-dir + CRC + fsync + atomic-rename
+        commit (the exact save_sharded machinery, `checkpoint.save` span
+        included) runs on a background writer thread. Returns the target
+        path immediately; the snapshot is not LOADABLE until the writer
+        commits (use wait() as the barrier — restore()/save() take it
+        implicitly).
+
+        At most one save is in flight: a second save_async first waits
+        out the previous writer (surfacing its failure as AsyncSaveError
+        here rather than losing it). A failed write additionally dumps
+        the flight recorder ('checkpoint_async_fail') with the step and
+        error. Observability: `checkpoint_async_save` counter at
+        submission, `checkpoint_async_pending` gauge 1 while the writer
+        runs, plus the usual checkpoint_save counter/span from the
+        writer itself."""
+        from ..profiler import RecordEvent, flight_recorder, monitor
+        self.wait()                       # one in flight + surface errors
+        with RecordEvent("checkpoint.snapshot"):
+            snap = HostSnapshot(state)
+        path = self._path(step)
+        monitor.counter("checkpoint_async_save").add()
+        monitor.gauge("checkpoint_async_pending").set(1)
+
+        def work():
+            try:
+                save_sharded(snap, path)
+                self._gc()
+            except BaseException as e:    # surfaced at the next barrier
+                self._async_err = e
+                rec = flight_recorder.recorder()
+                rec.configure(last_error=f"async checkpoint save of "
+                                         f"step {step} failed: {e!r}")
+                rec.dump("checkpoint_async_fail")
+            finally:
+                monitor.gauge("checkpoint_async_pending").set(0)
+
+        with self._async_lock:
+            t = threading.Thread(target=work, name="paddle-ckpt-async",
+                                 daemon=True)
+            self._async_thread = t
+            t.start()
+        return path
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        """Barrier: block until the in-flight async save (if any) has
+        committed. Raises AsyncSaveError if that writer failed (once —
+        the error is consumed), TimeoutError when `timeout` expires with
+        the writer still running."""
+        with self._async_lock:
+            t, self._async_thread = self._async_thread, None
+        if t is not None:
+            t.join(timeout)
+            if t.is_alive():
+                with self._async_lock:
+                    self._async_thread = t   # still pending; keep it
+                raise TimeoutError(
+                    f"async checkpoint write still running after "
+                    f"{timeout}s")
+        if self._async_err is not None:
+            err, self._async_err = self._async_err, None
+            raise AsyncSaveError(
+                f"background checkpoint save failed: {err!r}") from err
+
+    @property
+    def async_pending(self) -> bool:
+        """True while a background save is still writing."""
+        t = self._async_thread
+        return t is not None and t.is_alive()
 
     def steps(self) -> List[int]:
         return [s for s, _ in _snapshot_steps(self.root, self.prefix)]
@@ -744,8 +900,15 @@ class CheckpointManager:
         `(None, None)` when no intact snapshot exists. Snapshots that fail
         CRC/manifest verification are skipped (newest-first), so a torn or
         bit-flipped newest snapshot transparently falls back to the
-        previous one."""
+        previous one. An in-flight async save is waited out first (its
+        snapshot may be the newest); a FAILED async writer is absorbed
+        here — restore's contract is best-effort newest-INTACT, and the
+        failure was already flight-dumped and counted."""
         from ..profiler import monitor
+        try:
+            self.wait()
+        except AsyncSaveError:
+            monitor.counter("checkpoint_fallback_restore").add()
         for cand in self._candidates():
             try:
                 verify_checkpoint(cand)

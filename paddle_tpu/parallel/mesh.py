@@ -80,14 +80,13 @@ class use_mesh:
         return False
 
 
-def sharding_for(spec: PartitionSpec, mesh: Optional[Mesh] = None,
-                 shape: Optional[Sequence[int]] = None
-                 ) -> Optional[NamedSharding]:
-    mesh = mesh or get_mesh()
-    if mesh is None:
-        return None
-    # drop axes the mesh doesn't have (lets the same model run on smaller
-    # meshes — e.g. TP spec on a dp-only mesh degrades to replicated)
+def _clean_spec(spec: PartitionSpec, mesh: Mesh,
+                shape: Optional[Sequence[int]] = None) -> PartitionSpec:
+    """Adapt `spec` to `mesh`: drop axes the mesh doesn't have (per
+    entry, so a spec naming both known and unknown axes keeps the known
+    ones), and — with `shape` — degrade any entry whose mesh size does
+    not divide the dim to replicated. ONE home for the degrade rule,
+    shared by sharding_for and constraint."""
     cleaned = []
     for entry in spec:
         if entry is None:
@@ -110,7 +109,18 @@ def sharding_for(spec: PartitionSpec, mesh: Optional[Mesh] = None,
             n = int(np.prod([mesh.shape[a] for a in axes]))
             if n == 0 or shape[i] % n != 0:
                 cleaned[i] = None
-    return NamedSharding(mesh, PartitionSpec(*cleaned))
+    return PartitionSpec(*cleaned)
+
+
+def sharding_for(spec: PartitionSpec, mesh: Optional[Mesh] = None,
+                 shape: Optional[Sequence[int]] = None
+                 ) -> Optional[NamedSharding]:
+    mesh = mesh or get_mesh()
+    if mesh is None:
+        return None
+    # drop axes the mesh doesn't have (lets the same model run on smaller
+    # meshes — e.g. TP spec on a dp-only mesh degrades to replicated)
+    return NamedSharding(mesh, _clean_spec(spec, mesh, shape))
 
 
 def remap_spec_axes(spec: PartitionSpec, mapping: Dict[str, str]
@@ -131,6 +141,23 @@ def remap_spec_axes(spec: PartitionSpec, mapping: Dict[str, str]
     return PartitionSpec(*out)
 
 
+def remap_specs(param_specs: Dict[str, PartitionSpec],
+                mapping: Dict[str, str]) -> Dict[str, PartitionSpec]:
+    """Remap a whole PARAM_SPECS table onto another mesh's axis names —
+    the multi-axis generalization of tp_specs: every axis named in
+    `mapping` survives under its new name, every axis absent from it
+    drops to replicated (remap_spec_axes semantics, applied per leaf).
+    The 3D training planner (parallel/planner.plan_train) uses this to
+    land the family tables — declared over ('dp','fsdp','pp','mp') —
+    on a dp×fsdp×tp mesh ({'fsdp': 'fsdp', 'mp': 'tp'}: the TP split
+    survives on 'tp', ZeRO-3 on 'fsdp', and 'pp' drops because the 3D
+    plan scans the stacked layer axis on-chip). Shape-aware
+    degrade-to-replicated stays where it always was: sharding_for(spec,
+    mesh, shape) at materialization time, per leaf."""
+    return {k: remap_spec_axes(s, mapping)
+            for k, s in param_specs.items()}
+
+
 def tp_specs(param_specs: Dict[str, PartitionSpec], src: str = "mp",
              axis: str = "tp") -> Dict[str, PartitionSpec]:
     """Derive a decode/serving tensor-parallel spec table from a
@@ -141,9 +168,8 @@ def tp_specs(param_specs: Dict[str, PartitionSpec], src: str = "mp",
     at decode the layer stack scans on-chip while the slot pool owns the
     batch. ONE derivation so the serving layout can never drift from
     the training split (models/gpt.py, models/llama.py
-    SERVING_PARAM_SPECS)."""
-    return {k: remap_spec_axes(s, {src: axis})
-            for k, s in param_specs.items()}
+    SERVING_PARAM_SPECS). The single-axis case of remap_specs."""
+    return remap_specs(param_specs, {src: axis})
 
 
 def shard_value(value, spec: PartitionSpec, mesh: Optional[Mesh] = None):
@@ -157,8 +183,14 @@ def shard_value(value, spec: PartitionSpec, mesh: Optional[Mesh] = None):
 
 
 def constraint(value, spec: PartitionSpec, mesh: Optional[Mesh] = None):
-    """with_sharding_constraint that degrades to identity outside a mesh or
-    outside a trace."""
+    """with_sharding_constraint that degrades to identity outside a mesh,
+    outside a trace, or on a mesh whose axis names don't match the spec
+    (ALL-or-nothing, deliberately: the model-internal activation specs
+    engage only on meshes built for them — a leftover ambient mesh with
+    other axis names must NOT be partially adopted, e.g. an 8-device
+    fsdp mesh leaking into a single-device Predictor export. The 3D
+    planner-driven step doesn't rely on these hints at all: its layouts
+    are pinned through make_train_step's in/out shardings)."""
     mesh = mesh or get_mesh()
     if mesh is None:
         return value

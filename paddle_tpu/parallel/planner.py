@@ -23,8 +23,9 @@ import math
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
-__all__ = ["ChipSpec", "ModelSpec", "Plan", "enumerate_plans",
-           "plan_parallel", "spec_from_config", "spec_from_gpt_config",
+__all__ = ["ChipSpec", "ModelSpec", "Plan", "TrainPlan",
+           "enumerate_plans", "plan_parallel", "plan_train",
+           "spec_from_config", "spec_from_gpt_config",
            "best_mesh_axes", "plan_serving_tp"]
 
 
@@ -283,18 +284,176 @@ def enumerate_plans(spec, n_devices: int, global_batch: int,
     return plans
 
 
+def _diagnose_empty(spec: ModelSpec, n_devices: int, global_batch: int,
+                    max_mp: Optional[int],
+                    max_pp: Optional[int] = None) -> str:
+    """Why enumerate_plans returned nothing: re-walk every factorization
+    and name the constraint(s) that pruned it, so the caller's error
+    says WHICH divisibility failed instead of a generic 'no legal
+    assignment' (the _factorizations edge cases — prime device counts,
+    a global batch no dp×fsdp split divides, single-device — all land
+    here with an actionable message). `max_pp` restricts the walk the
+    same way the caller restricted its search (plan_train excludes
+    pp>1), so the diagnosis prices exactly the space that came up
+    empty — a pp=8 escape hatch the caller forbids must not mask the
+    real batch/heads blocker."""
+    facts = [f for f in _factorizations(n_devices)
+             if max_pp is None or f[2] <= max_pp]
+    if not facts:
+        return f"n_devices={n_devices} has no factorization (must be >= 1)"
+    reasons = []
+    mp_legal = [mp for _, mp, _, _ in facts
+                if spec.num_heads % mp == 0 and spec.ffn_hidden % mp == 0
+                and not (max_mp and mp > max_mp)]
+    if not mp_legal:
+        reasons.append(
+            f"num_heads={spec.num_heads}/ffn_hidden={spec.ffn_hidden} "
+            f"admit no mp degree dividing n_devices={n_devices}"
+            + (f" under max_mp={max_mp}" if max_mp else ""))
+    pp_legal = [pp for _, _, pp, _ in facts if spec.num_layers % pp == 0]
+    if not pp_legal:
+        reasons.append(
+            f"num_layers={spec.num_layers} admits no pp degree dividing "
+            f"n_devices={n_devices}")
+    # the batch constraint interacts with the others: only dp×fsdp
+    # splits that survive the mp/pp pruning count
+    dpxfsdp = sorted({dp * fsdp for dp, mp, pp, fsdp in facts
+                      if spec.num_heads % mp == 0
+                      and spec.ffn_hidden % mp == 0
+                      and not (max_mp and mp > max_mp)
+                      and spec.num_layers % pp == 0})
+    if dpxfsdp and not any(global_batch % d == 0 for d in dpxfsdp):
+        reasons.append(
+            f"global_batch={global_batch} is not divisible by any legal "
+            f"dp*fsdp split of {n_devices} devices "
+            f"(candidates: {dpxfsdp})")
+    return "; ".join(reasons) or "every assignment was pruned"
+
+
 def plan_parallel(cfg_or_spec, n_devices: int, global_batch: int,
                   chip: Optional[ChipSpec] = None, **kw) -> Plan:
     """The best assignment for a GPTConfig or ModelSpec (the reference
-    parallel_tuner's `tune()` surface collapsed to a function)."""
+    parallel_tuner's `tune()` surface collapsed to a function). When no
+    assignment is legal the error names the failing divisibility
+    constraint (heads/ffn vs mp, layers vs pp, global batch vs
+    dp×fsdp)."""
     spec = _coerce_spec(cfg_or_spec)
     plans = enumerate_plans(spec, n_devices, global_batch, chip, **kw)
     if not plans:
         raise ValueError(
             f"no legal (dp, mp, pp, fsdp) assignment for {n_devices} "
-            f"devices with heads={spec.num_heads}, "
-            f"layers={spec.num_layers}, batch={global_batch}")
+            f"devices: "
+            + _diagnose_empty(spec, n_devices, global_batch,
+                              kw.get("max_mp")))
     return plans[0]
+
+
+# ------------------------------------------------------- executable plans
+@dataclass
+class TrainPlan:
+    """An EXECUTABLE 3D assignment: what models.facade.make_train_step
+    (mesh=, plan=) consumes. `axes` materializes through
+    parallel.mesh.build_mesh; `specs` is the family's module-level
+    PARAM_SPECS table remapped onto those axes (parallel.mesh.remap_specs
+    — the TP split lands on `tp`, ZeRO-3 on `fsdp`, 'pp' drops because
+    the stacked layer axis scans on-chip in the 3D formulation);
+    `batch_axes` names the axes the global batch shards over (dp×fsdp).
+    `plan` keeps the priced cost-model row the choice came from."""
+    axes: Dict[str, int]
+    mapping: Dict[str, str]
+    batch_axes: tuple
+    plan: Plan
+    specs: Optional[Dict] = None
+
+    @property
+    def name(self) -> str:
+        return "_".join(f"{a}{n}" for a, n in self.axes.items())
+
+    def build_mesh(self, devices=None):
+        from .mesh import build_mesh
+        return build_mesh(self.axes, devices=devices)
+
+    def batch_spec(self, ndim: int = 2):
+        """PartitionSpec for a batch leaf: leading dim over dp×fsdp,
+        the rest replicated."""
+        from jax.sharding import PartitionSpec as P
+        return P(tuple(self.batch_axes), *([None] * (ndim - 1)))
+
+    def __repr__(self):
+        return f"TrainPlan({self.name}, {self.plan!r})"
+
+
+def _resolve_param_specs(cfg) -> Optional[Dict]:
+    """The module-level PARAM_SPECS table of the config's model family
+    (GPTConfig -> models.gpt.PARAM_SPECS, LlamaConfig -> models.llama's,
+    ...): the family declares its sharding next to its init/forward, so
+    the planner never hardcodes a layout. None for bare ModelSpecs and
+    configs whose module declares no table — pass param_specs= then."""
+    if isinstance(cfg, ModelSpec):
+        return None
+    import sys
+    mod = sys.modules.get(type(cfg).__module__)
+    return getattr(mod, "PARAM_SPECS", None)
+
+
+def plan_train(cfg_or_spec, n_devices: int, global_batch: int,
+               chip: Optional[ChipSpec] = None, dp: Optional[int] = None,
+               fsdp: Optional[int] = None, tp: Optional[int] = None,
+               tp_axis: str = "tp", param_specs: Optional[Dict] = None,
+               **kw) -> TrainPlan:
+    """The executable dp×fsdp×tp assignment for a model config: search
+    the cost model (pp excluded — the 3D train step scans the stacked
+    layer axis on-chip; pass explicit dp/fsdp/tp degrees to skip the
+    search), then emit the {axes -> PartitionSpec tree} contract:
+    mesh axes for build_mesh, the family PARAM_SPECS remapped onto them,
+    and the dp×fsdp batch spec. Illegal explicit degrees raise naming
+    the violated constraint, same as plan_parallel.
+
+    Also publishes the chosen degrees as the `train.plan.*` monitor
+    gauge family (docs/observability.md) so a run's telemetry stream
+    records WHICH plan it executed."""
+    spec = _coerce_spec(cfg_or_spec)
+    if any(d is not None for d in (dp, fsdp, tp)):
+        dp, fsdp, tp = (int(d or 1) for d in (dp, fsdp, tp))
+        problems = []
+        if dp * fsdp * tp != n_devices:
+            problems.append(f"dp*fsdp*tp = {dp}*{fsdp}*{tp} = "
+                            f"{dp * fsdp * tp} != n_devices={n_devices}")
+        if spec.num_heads % tp or spec.ffn_hidden % tp:
+            problems.append(f"tp={tp} does not divide num_heads="
+                            f"{spec.num_heads}/ffn_hidden="
+                            f"{spec.ffn_hidden}")
+        if global_batch % (dp * fsdp):
+            problems.append(f"global_batch={global_batch} is not "
+                            f"divisible by dp*fsdp={dp * fsdp}")
+        if problems:
+            raise ValueError("illegal 3D plan: " + "; ".join(problems))
+        best = _estimate(Plan(dp=dp, mp=tp, fsdp=fsdp), spec,
+                         global_batch, chip or ChipSpec())
+    else:
+        plans = [p for p in enumerate_plans(spec, n_devices, global_batch,
+                                            chip, **kw) if p.pp == 1]
+        if not plans:
+            raise ValueError(
+                f"no legal (dp, fsdp, tp) assignment for {n_devices} "
+                f"devices (pp excluded — 3D train plan): "
+                + _diagnose_empty(spec, n_devices, global_batch,
+                                  kw.get("max_mp"), max_pp=1))
+        best = plans[0]
+    axes = {"dp": best.dp, "fsdp": best.fsdp, tp_axis: best.mp}
+    mapping = {"dp": "dp", "fsdp": "fsdp", "mp": tp_axis}
+    if param_specs is None:
+        param_specs = _resolve_param_specs(cfg_or_spec)
+    specs = None
+    if param_specs is not None:
+        from .mesh import remap_specs
+        specs = remap_specs(param_specs, mapping)
+    from ..profiler import monitor
+    for ax, n in axes.items():
+        monitor.gauge(f"train.plan.{ax}").set(n)
+    monitor.gauge("train.plan.n_devices").set(best.n_devices)
+    return TrainPlan(axes=axes, mapping=mapping,
+                     batch_axes=("dp", "fsdp"), plan=best, specs=specs)
 
 
 def plan_serving_tp(cfg_or_spec, n_devices: int, num_slots: int = 8,

@@ -60,6 +60,9 @@ class ResilienceConfig:
     rollback_after: int = 3        # consecutive skipped steps -> rollback
     max_rollbacks: int = 5         # give up (raise) after this many
     checkpoint_every: int = 0      # steps between snapshots (0 = manual)
+    async_checkpoint: bool = False  # save via manager.save_async: the
+    #                                 disk write leaves the step path
+    #                                 (docs/parallel_training.md)
     watchdog_timeout: float = 0.0  # seconds per host pull (0 = no watchdog)
     retries: int = 3               # extra backoff waits after the timeout
     backoff_base: float = 2.0      # first retry wait, doubling each retry
@@ -68,9 +71,15 @@ class ResilienceConfig:
 
 
 def make_resilient_step(step_fn, cfg=None, donate: bool = True,
-                        telemetry=None, **step_kw):
+                        telemetry=None, mesh=None, plan=None, **step_kw):
     """Build the guarded jitted step:
     `(params, opt_state, batch, poison) -> (loss, params', opt', ok)`.
+
+    `mesh`/`plan` (parallel.planner.plan_train) pass straight through to
+    models.facade.make_train_step: the guard (select + ok flag) and the
+    telemetry accumulator ride the planner-driven GSPMD step unchanged —
+    the select is elementwise (sharding-preserving) and the ok/loss
+    scalars replicate, so the sharded pins hold leaf for leaf.
 
     `step_fn(params, opt_state, batch, ...) -> (loss, new_params,
     new_opt)` is the same contract `models.facade.make_train_step` takes;
@@ -126,7 +135,8 @@ def make_resilient_step(step_fn, cfg=None, donate: bool = True,
     if telemetry is None:
         # the facade owns the jit/donation policy (ONE home — see
         # models/facade.py); the guard only adds the select + ok flag
-        return make_train_step(guarded, donate=donate)
+        return make_train_step(guarded, donate=donate, mesh=mesh,
+                               plan=plan)
 
     from ..profiler.telemetry import global_norm, nonfinite_count
 
@@ -149,7 +159,7 @@ def make_resilient_step(step_fn, cfg=None, donate: bool = True,
                 tstate)
 
     return make_train_step(guarded_telemetry, donate=donate,
-                           extra_donate=(4,))
+                           extra_donate=(4,), mesh=mesh, plan=plan)
 
 
 # telemetry field layout for the resilient trainer's pipeline (the
@@ -318,7 +328,7 @@ class ResilientTrainer:
                  manager: Optional[CheckpointManager] = None,
                  config: Optional[ResilienceConfig] = None,
                  step: int = 0, donate: bool = True, mesh=_UNSET,
-                 specs=None, telemetry=None, **step_kw):
+                 specs=None, telemetry=None, plan=None, **step_kw):
         self.config = config or ResilienceConfig()
         # restore layout: rollback must reload onto the SAME mesh/specs
         # the trainer resumed/trained with, not whatever mesh is ambient
@@ -326,9 +336,27 @@ class ResilientTrainer:
         self._mesh = mesh
         self._specs = specs
         self.telemetry = telemetry
+        # a real mesh + plan makes the guarded step the planner-driven
+        # GSPMD one (docs/parallel_training.md); restore then reloads
+        # onto that same mesh via the layout fields above. With a plan
+        # and no explicit specs, rollbacks/resume re-slice per the
+        # plan's remapped PARAM_SPECS so the restored trees come back
+        # in the executing layout. GATED ON plan: mesh= alone keeps its
+        # historical meaning (restore layout ONLY, the step a plain jit
+        # honoring caller-committed shardings) — without a spec table
+        # the sharded builder would pin every leaf REPLICATED, silently
+        # un-sharding an fsdp-laid-out trainer.
+        step_mesh = mesh if (plan is not None
+                             and mesh not in (_UNSET, None)) else None
+        if plan is not None and specs is None and plan.specs:
+            self._specs = {"params": plan.specs,
+                           "opt_state": {"m": plan.specs,
+                                         "v": plan.specs}}
         self._guarded = make_resilient_step(step_fn, cfg=cfg,
                                             donate=donate,
-                                            telemetry=telemetry, **step_kw)
+                                            telemetry=telemetry,
+                                            mesh=step_mesh, plan=plan,
+                                            **step_kw)
         # created lazily at the first step so the device cursor seeds
         # from the RESUMED step (maybe_resume runs after __init__): a
         # restarted worker's records then continue the shared JSONL's id
@@ -388,11 +416,18 @@ class ResilientTrainer:
 
     # --------------------------------------------------------------- save
     def save(self) -> Optional[str]:
+        """Snapshot the live state. With config.async_checkpoint the
+        host snapshot is taken here (the donated buffers are about to be
+        consumed by the next step) and the commit happens off the step
+        path — manager.wait() is the barrier; rollback/restore take it
+        implicitly."""
         if self.manager is None:
             return None
-        return self.manager.save(
-            {"params": self.params, "opt_state": self.opt_state,
-             "step": np.int64(self.step)}, self.step)
+        state = {"params": self.params, "opt_state": self.opt_state,
+                 "step": np.int64(self.step)}
+        if self.config.async_checkpoint:
+            return self.manager.save_async(state, self.step)
+        return self.manager.save(state, self.step)
 
     # --------------------------------------------------------------- step
     def train_step(self, batch) -> tuple:

@@ -323,7 +323,8 @@ class TelemetryPipeline:
 # --------------------------------------------------- facade-style wrapper
 def instrument_train_step(step_fn, pipeline: TelemetryPipeline, cfg=None,
                           lr=None, beta1: float = 0.9, beta2: float = 0.95,
-                          donate: bool = True, **step_kw):
+                          donate: bool = True, mesh=None, plan=None,
+                          **step_kw):
     """Wrap a facade-contract step (`step_fn(params, opt_state, batch,
     ...) -> (loss, new_params, new_opt)`) with in-jit telemetry.
 
@@ -339,7 +340,11 @@ def instrument_train_step(step_fn, pipeline: TelemetryPipeline, cfg=None,
     `lr` is FORWARDED to the wrapped step exactly like
     make_train_step's kwargs (and recorded); `beta1`/`beta2` are
     recorder-only — they must DESCRIBE the optimizer the step already
-    uses, they do not configure it."""
+    uses, they do not configure it. `mesh`/`plan` pass through to the
+    facade builder: the accumulator rides the planner-driven GSPMD
+    step as a replicated donated leaf (docs/parallel_training.md), and
+    the recorded scalars — global norms, the moment-sum identity — are
+    full-tree reductions, so their values match the unsharded step's."""
     import functools
     from ..models.facade import make_train_step
     if cfg is not None:
@@ -365,4 +370,5 @@ def instrument_train_step(step_fn, pipeline: TelemetryPipeline, cfg=None,
         tstate = pipeline.device_record(tstate, **scalars)
         return loss, new_params, new_opt, tstate
 
-    return make_train_step(instrumented, donate=donate, extra_donate=(3,))
+    return make_train_step(instrumented, donate=donate, extra_donate=(3,),
+                           mesh=mesh, plan=plan)

@@ -51,6 +51,73 @@ class TestEnumeration:
         assert all(4 % (p.dp * p.fsdp) == 0 for p in plans)
 
 
+class TestFactorizationEdgeCases:
+    """_factorizations / plan_parallel edge cases that previously relied
+    on the caller: prime device counts, a global batch no dp×fsdp split
+    divides, single-device — each either plans cleanly or fails with an
+    error NAMING the violated constraint (ISSUE 10 satellite)."""
+
+    def test_prime_device_count(self):
+        from paddle_tpu.parallel.planner import _factorizations
+        facts = _factorizations(7)
+        assert all(dp * mp * pp * fsdp == 7
+                   for dp, mp, pp, fsdp in facts)
+        # a prime n admits exactly the 4 one-hot assignments
+        assert len(facts) == 4 and (7, 1, 1, 1) in facts \
+            and (1, 1, 1, 7) in facts
+        # 8 heads / 8 layers: mp=7 and pp=7 are pruned, dp/fsdp legal
+        plans = enumerate_plans(_spec(), 7, global_batch=7)
+        keys = {(p.dp, p.mp, p.pp, p.fsdp) for p in plans}
+        assert keys == {(7, 1, 1, 1), (1, 1, 1, 7)}
+
+    def test_single_device(self):
+        from paddle_tpu.parallel.planner import _factorizations
+        assert _factorizations(1) == [(1, 1, 1, 1)]
+        best = plan_parallel(_spec(), 1, 3)   # odd batch fine at n=1
+        assert (best.dp, best.mp, best.pp, best.fsdp) == (1, 1, 1, 1)
+
+    def test_batch_indivisible_names_the_constraint(self):
+        # heads=7 forces mp=1 on 16 devices; layers=7 forces pp=1; so
+        # every surviving split needs dp*fsdp=16 to divide batch=13
+        with pytest.raises(ValueError, match=r"global_batch=13"):
+            plan_parallel(_spec(num_heads=7, ffn_hidden=7 * 256,
+                                num_layers=7), 16, 13)
+
+    def test_candidates_reflect_heads_and_layers_pruning(self):
+        # heads=3 forces mp=1 and layers=8 caps pp at 8, so dp*fsdp=1
+        # (which WOULD divide batch=13) is impossible on 16 devices —
+        # the error's candidate list shows exactly the surviving splits
+        with pytest.raises(ValueError,
+                           match=r"candidates: \[2, 4, 8, 16\]"):
+            plan_parallel(_spec(num_heads=3, ffn_hidden=3 * 256,
+                                num_layers=8), 16, 13)
+
+    def test_max_mp_named_when_it_prunes_everything(self):
+        # heads=16 on 16 devices, but batch 13 kills every dp*fsdp>1
+        # split and max_mp=1 kills the mp escape: both named
+        with pytest.raises(ValueError, match="global_batch=13"):
+            plan_parallel(_spec(num_heads=16, num_layers=7), 16, 13,
+                          max_mp=1)
+
+    def test_plan_train_search_excludes_pp_and_reports(self):
+        from paddle_tpu.parallel.planner import plan_train
+        with pytest.raises(ValueError, match="pp excluded"):
+            # heads=7/layers=7 on 16 devices with batch 13: nothing legal
+            plan_train(_spec(num_heads=7, ffn_hidden=7 * 256,
+                             num_layers=7), 16, 13)
+
+    def test_plan_train_diagnosis_restricted_to_pp1(self):
+        from paddle_tpu.parallel.planner import plan_train
+        # layers=8 leaves pp=8/pp=16 escapes that plan_parallel WOULD
+        # accept (dp*fsdp=1 divides 13) — plan_train forbids them, and
+        # its diagnosis must price the pp=1 space it actually searched,
+        # naming the batch constraint instead of 'every assignment was
+        # pruned'
+        with pytest.raises(ValueError, match=r"global_batch=13"):
+            plan_train(_spec(num_heads=7, ffn_hidden=7 * 256,
+                             num_layers=8), 16, 13)
+
+
 class TestCostModelOrderings:
     """The qualitative orders the model must encode (each mirrors a cost
     the reference tuner prices)."""

@@ -1,0 +1,324 @@
+"""Async checkpointing (CheckpointManager.save_async) + parallel-degree
+reshard round trips (ISSUE 10: the off-step-path save prong).
+
+Pinned here:
+- save_async returns while the writer still runs (the step path pays
+  only the host snapshot), and the snapshot is DECOUPLED from the
+  device buffers — donating/clobbering them after save_async returns
+  cannot corrupt the write;
+- at most ONE save in flight (a second save_async barriers on the
+  first), wait() is the explicit barrier;
+- a failed background write surfaces as AsyncSaveError at the next
+  barrier and dumps the flight recorder;
+- atomicity/CRC/keep-K semantics are UNCHANGED: committed async
+  snapshots pass full verification, retention prunes, restore falls
+  back past corruption exactly as for sync saves;
+- reshard: a train state saved under dp2×fsdp2×tp2 restores onto an
+  fsdp8 mesh AND onto a single device, values exact, scalar dtypes
+  (the int64 step counter) preserved bit-for-bit.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.parallel import checkpoint as ck
+from paddle_tpu.parallel.checkpoint import (AsyncSaveError,
+                                            CheckpointManager,
+                                            HostSnapshot, load_sharded,
+                                            verify_checkpoint)
+from paddle_tpu.parallel.mesh import build_mesh, sharding_for
+
+
+def _state(mesh=None, seed=0):
+    w = np.random.RandomState(seed).rand(8, 16).astype(np.float32)
+    b = np.random.RandomState(seed + 1).rand(16).astype(np.float32)
+    if mesh is not None:
+        w = jax.device_put(w, sharding_for(P(("dp", "fsdp"), "tp"), mesh))
+        b = jax.device_put(b, sharding_for(P("tp"), mesh))
+    return {"params": {"w": w, "b": b}, "step": np.int64(7)}
+
+
+class TestAsyncSemantics:
+    def test_round_trip_and_span_semantics(self, tmp_path):
+        from paddle_tpu.profiler import monitor
+        mgr = CheckpointManager(str(tmp_path), max_to_keep=3)
+        mesh = build_mesh({"dp": 2, "fsdp": 2, "tp": 2})
+        state = _state(mesh)
+        saves0 = monitor.counter("checkpoint_save").value
+        path = mgr.save_async(state, 1)
+        mgr.wait()
+        # the background writer runs the REAL save_sharded: span counter
+        # bumps, full CRC verification passes, LATEST points at it
+        assert monitor.counter("checkpoint_save").value == saves0 + 1
+        verify_checkpoint(path)
+        assert mgr.latest_path() == path
+        got = load_sharded(path, mesh=None)
+        np.testing.assert_array_equal(np.asarray(got["params"]["w"]),
+                                      np.asarray(state["params"]["w"]))
+        assert got["step"] == 7 and got["step"].dtype == np.int64
+
+    def test_save_returns_before_write_completes(self, tmp_path,
+                                                 monkeypatch):
+        """The overlap contract: with the writer slowed, save_async
+        returns immediately (pending gauge 1, thread alive) and the
+        commit finishes in the background."""
+        from paddle_tpu.profiler import monitor
+        mgr = CheckpointManager(str(tmp_path))
+        state = _state()
+        orig = ck._write_shard
+        ev = {"writes": 0}
+
+        def slow(path, arr):
+            ev["writes"] += 1
+            time.sleep(0.15)
+            return orig(path, arr)
+        monkeypatch.setattr(ck, "_write_shard", slow)
+        t0 = time.perf_counter()
+        mgr.save_async(state, 1)
+        returned_in = time.perf_counter() - t0
+        assert mgr.async_pending
+        assert monitor.gauge("checkpoint_async_pending").value == 1
+        # 2 shard writes x 150 ms sit ahead; the submit path paid neither
+        assert returned_in < 0.14, returned_in
+        mgr.wait()
+        assert not mgr.async_pending
+        assert monitor.gauge("checkpoint_async_pending").value == 0
+        assert ev["writes"] == 2
+        verify_checkpoint(os.path.join(str(tmp_path), "ckpt-1"))
+
+    def test_snapshot_decoupled_from_donated_buffers(self, tmp_path,
+                                                     monkeypatch):
+        """After save_async returns, the device state can be donated
+        away (here: overwritten) without corrupting the in-flight
+        write — the HostSnapshot owns its bytes."""
+        mgr = CheckpointManager(str(tmp_path))
+        mesh = build_mesh({"dp": 2, "fsdp": 2, "tp": 2})
+        state = _state(mesh)
+        want = np.asarray(state["params"]["w"]).copy()
+        orig = ck._write_shard
+        monkeypatch.setattr(
+            ck, "_write_shard",
+            lambda path, arr: (time.sleep(0.05), orig(path, arr))[1])
+        mgr.save_async(state, 1)
+        # clobber the device arrays while the writer is mid-flight (the
+        # next train step's donation would do exactly this)
+        state["params"]["w"] = jax.device_put(
+            np.zeros_like(want), state["params"]["w"].sharding)
+        mgr.wait()
+        got = load_sharded(os.path.join(str(tmp_path), "ckpt-1"),
+                           mesh=None)
+        np.testing.assert_array_equal(np.asarray(got["params"]["w"]),
+                                      want)
+
+    def test_one_in_flight_barrier(self, tmp_path, monkeypatch):
+        """A second save_async waits out the first: writes never
+        interleave, both snapshots commit intact."""
+        mgr = CheckpointManager(str(tmp_path), max_to_keep=5)
+        state = _state()
+        active = {"n": 0, "max": 0}
+        orig = ck._write_shard
+
+        def tracked(path, arr):
+            active["n"] += 1
+            active["max"] = max(active["max"], active["n"])
+            time.sleep(0.05)
+            out = orig(path, arr)
+            active["n"] -= 1
+            return out
+        monkeypatch.setattr(ck, "_write_shard", tracked)
+        mgr.save_async(state, 1)
+        mgr.save_async(state, 2)        # barriers on save 1
+        mgr.wait()
+        assert active["max"] == 1       # never two writers at once
+        assert mgr.steps() == [1, 2]
+        for s in (1, 2):
+            verify_checkpoint(os.path.join(str(tmp_path), f"ckpt-{s}"))
+
+    def test_writer_failure_surfaces_and_flight_dumps(self, tmp_path,
+                                                      monkeypatch):
+        from paddle_tpu.profiler import flight_recorder
+        flight_dir = tmp_path / "flight"
+        monkeypatch.setenv(flight_recorder.ENV_DIR, str(flight_dir))
+        # fresh recorder so the env is honored
+        monkeypatch.setattr(flight_recorder, "_RECORDER", None,
+                            raising=False)
+        mgr = CheckpointManager(str(tmp_path / "root"))
+        monkeypatch.setattr(
+            ck, "_write_shard",
+            lambda path, arr: (_ for _ in ()).throw(OSError("disk full")))
+        mgr.save_async(_state(), 1)
+        with pytest.raises(AsyncSaveError, match="disk full"):
+            mgr.wait()
+        # the error is consumed at the barrier: the next save is clean
+        assert not mgr.async_pending
+        dumps = [f for f in os.listdir(flight_dir)] \
+            if flight_dir.exists() else []
+        assert any("checkpoint_async_fail" in f for f in dumps), dumps
+        ck.audit_forget(mgr._path(1))
+
+    def test_sync_save_and_restore_take_the_barrier(self, tmp_path,
+                                                    monkeypatch):
+        """save() and restore() wait out an in-flight async save — no
+        LATEST/gc races, and restore sees the newest snapshot."""
+        mgr = CheckpointManager(str(tmp_path), max_to_keep=2)
+        orig = ck._write_shard
+        monkeypatch.setattr(
+            ck, "_write_shard",
+            lambda path, arr: (time.sleep(0.05), orig(path, arr))[1])
+        mgr.save_async(_state(seed=3), 1)
+        monkeypatch.setattr(ck, "_write_shard", orig)
+        mgr.save(_state(seed=4), 2)     # implicit barrier
+        state, step = mgr.restore(mesh=None)
+        assert step == 2
+        # keep-K retention across the mixed sync/async history
+        for s in (3, 4, 5):
+            mgr.save_async(_state(seed=s), s)
+        mgr.wait()
+        assert mgr.steps() == [4, 5]
+
+    def test_host_snapshot_is_savable_directly(self, tmp_path):
+        """HostSnapshot is a first-class save_sharded input (what the
+        background writer consumes), windows and specs preserved."""
+        mesh = build_mesh({"dp": 2, "fsdp": 2, "tp": 2})
+        state = _state(mesh)
+        snap = HostSnapshot(state)
+        assert snap.nbytes > 0
+        path = str(tmp_path / "snap")
+        ck.save_sharded(snap, path)
+        manifest = verify_checkpoint(path)
+        ent = manifest["leaves"]["params/w"]
+        assert ent["spec"] == [["dp", "fsdp"], "tp"]
+        assert len(ent["shards"]) == 8      # one replica-0 shard/device
+        got = load_sharded(path, mesh=None)
+        np.testing.assert_array_equal(np.asarray(got["params"]["w"]),
+                                      np.asarray(state["params"]["w"]))
+
+
+class TestResilientTrainerAsync:
+    def test_periodic_async_saves_and_resume(self, tmp_path):
+        """ResilienceConfig(async_checkpoint=True): the trainer's
+        periodic snapshots go through save_async; a restarted trainer
+        resumes from them bit-identically (the barrier is implicit in
+        restore), and a torn async snapshot falls back like a sync one
+        — the chaos drill's semantics, unchanged."""
+        import jax.numpy as jnp
+        from paddle_tpu.parallel.resilience import (ResilienceConfig,
+                                                    ResilientTrainer)
+
+        def step_fn(params, opt_state, batch):
+            loss = jnp.mean((params["w"] - batch) ** 2)
+            new_w = params["w"] - 0.1 * (params["w"] - batch)
+            return loss, {"w": new_w}, opt_state
+
+        mgr = CheckpointManager(str(tmp_path), max_to_keep=3)
+        params = {"w": jnp.zeros((4,), jnp.float32)}
+        opt = {"step": jnp.zeros((), jnp.float32)}
+        cfgr = ResilienceConfig(checkpoint_every=2, async_checkpoint=True)
+        tr = ResilientTrainer(step_fn, params, opt, manager=mgr,
+                              config=cfgr)
+        batch = jnp.ones((4,), jnp.float32)
+        for _ in range(6):
+            tr.train_step(batch)
+        mgr.wait()
+        assert mgr.steps() == [2, 4, 6]
+        want = np.asarray(tr.params["w"])
+
+        # fresh trainer resumes from the async snapshot
+        tr2 = ResilientTrainer(step_fn, {"w": jnp.zeros((4,), jnp.float32)},
+                               {"step": jnp.zeros((), jnp.float32)},
+                               manager=mgr, config=cfgr)
+        assert tr2.maybe_resume()
+        assert tr2.step == 6
+        np.testing.assert_array_equal(np.asarray(tr2.params["w"]), want)
+
+        # corrupt the newest snapshot: restore falls back to step 4
+        newest = os.path.join(str(tmp_path), "ckpt-6")
+        from paddle_tpu.parallel.checkpoint import audit_forget
+        audit_forget(newest)
+        shard = next(f for f in os.listdir(newest) if f.endswith(".npy"))
+        with open(os.path.join(newest, shard), "wb") as f:
+            f.write(b"torn")
+        state, step = mgr.restore(mesh=None)
+        assert step == 4
+
+
+# --------------------------------------------------------------------------
+# reshard round trips across parallel-degree changes (satellite)
+# --------------------------------------------------------------------------
+class TestReshardRoundTrip:
+    def test_dp2fsdp2tp2_to_fsdp8_and_single_device(self, tmp_path):
+        """A GPT train state saved under dp2×fsdp2×tp2 restores under
+        fsdp8 (re-sliced onto the new mesh per its plan specs) and onto
+        a single device, exactly — the manifest IS the reshape
+        contract; the int64 step counter survives bit-for-bit."""
+        from paddle_tpu.models.gpt import (GPTConfig, init_gpt_params,
+                                           init_opt_state)
+        from paddle_tpu.parallel.planner import plan_train
+        cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                        num_heads=4, max_seq_len=32, dtype=jnp.float32,
+                        remat=False, sequence_parallel=False)
+        plan_a = plan_train(cfg, 8, 8, dp=2, fsdp=2, tp=2)
+        mesh_a = plan_a.build_mesh()
+        params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+        params = {k: jax.device_put(
+            v, sharding_for(plan_a.specs[k], mesh_a, shape=v.shape))
+            for k, v in params.items()}
+        opt = init_opt_state(params)
+        state = {"params": params, "opt_state": opt,
+                 "step": np.int64(2**40 + 13)}
+        want = {k: np.asarray(v) for k, v in params.items()}
+
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save_async(state, 13)
+        mgr.wait()
+
+        # restore under fsdp8: every leaf lands with the fsdp8 plan's
+        # sharding and the original values
+        plan_b = plan_train(cfg, 8, 8, fsdp=8)
+        mesh_b = plan_b.build_mesh()
+        specs_b = {"params": plan_b.specs,
+                   "opt_state": {"m": plan_b.specs, "v": plan_b.specs}}
+        got, step = mgr.restore(mesh=mesh_b, specs=specs_b)
+        assert step == 13
+        assert int(got["step"]) == 2**40 + 13
+        assert got["step"].dtype == np.int64
+        for k, v in got["params"].items():
+            np.testing.assert_array_equal(np.asarray(v), want[k])
+            want_spec = sharding_for(plan_b.specs[k], mesh_b,
+                                     shape=v.shape).spec
+            assert v.sharding.spec == want_spec, (k, v.sharding.spec)
+        np.testing.assert_array_equal(
+            np.asarray(got["opt_state"]["m"]["qkv_w"]),
+            np.zeros_like(want["qkv_w"]))
+
+        # and onto a single device (mesh=None): plain host arrays
+        got1, step1 = mgr.restore(mesh=None)
+        assert step1 == 13
+        for k, v in got1["params"].items():
+            np.testing.assert_array_equal(np.asarray(v), want[k])
+
+    def test_scalar_dtype_exactness_across_reshard(self, tmp_path):
+        """Every scalar kind survives a save/reshard/load exactly —
+        int64 past 2**53 (json float would round), float32, bool."""
+        mesh = build_mesh({"dp": 2, "fsdp": 2, "tp": 2})
+        state = {"w": jax.device_put(
+                     np.arange(32, dtype=np.float32).reshape(8, 4),
+                     sharding_for(P(("dp", "fsdp"), None), mesh)),
+                 "big_step": np.int64(2**60 + 1),
+                 "lr": np.float32(3e-4),
+                 "done": np.bool_(True)}
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save_async(state, 1)
+        mgr.wait()
+        got = load_sharded(mgr.latest_path(), mesh=None)
+        assert got["big_step"] == 2**60 + 1
+        assert got["big_step"].dtype == np.int64
+        assert got["lr"].dtype == np.float32
+        assert float(got["lr"]) == float(np.float32(3e-4))
+        assert got["done"].dtype == np.bool_ and bool(got["done"])

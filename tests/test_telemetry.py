@@ -285,6 +285,40 @@ class TestTelemetryReport:
         assert doc["bad_steps"] == []
         assert "monitor" in doc
 
+    def test_train_plan_block(self, tmp_path):
+        """The train.plan.* gauge family (plan_train publishes it) plus
+        the async-checkpoint stats surface as a 'train_plan' block —
+        counters as first-to-last deltas, gauges as last value."""
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "tools"))
+        from telemetry_report import summarize
+        path = str(tmp_path / "plan.jsonl")
+        recs = [
+            {"kind": "run", "t": 0.0, "every": 2, "fields": ["loss"]},
+            {"kind": "monitor", "t": 1.0, "pid": 1, "stats": {
+                "train.plan.dp": 2, "train.plan.fsdp": 2,
+                "train.plan.tp": 2, "train.plan.n_devices": 8,
+                "checkpoint_async_save": 1,
+                "checkpoint_async_pending": 1.0}},
+            {"kind": "monitor", "t": 9.0, "pid": 1, "stats": {
+                "train.plan.dp": 2, "train.plan.fsdp": 2,
+                "train.plan.tp": 2, "train.plan.n_devices": 8,
+                "checkpoint_async_save": 4,
+                "checkpoint_async_pending": 0.0,
+                "checkpoint_save_ms": 12.5}},
+        ]
+        with open(path, "w") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+        doc = summarize(path)
+        tp = doc["train_plan"]
+        assert (tp["dp"], tp["fsdp"], tp["tp"]) == (2, 2, 2)
+        assert tp["n_devices"] == 8
+        assert tp["checkpoint"]["async_saves"] == 3
+        assert tp["checkpoint"]["async_pending"] == 0.0
+        assert tp["checkpoint"]["last_save_ms"] == 12.5
+
     def test_tolerates_torn_tail(self, tmp_path):
         import sys
         sys.path.insert(0, os.path.join(os.path.dirname(

@@ -308,6 +308,82 @@ def v_splash():
     _impl_variant("splash", "splash_dotsflash_b8")
 
 
+# ------------------------------------------------- 3D sharded-step rows
+def _step_flops(cfg, params, batch, seq):
+    """Step arithmetic volume (bench.train_flops_per_token — ONE home
+    for the MFU accounting, real param count) — the evidence field the
+    kernel-registry plausibility gate (registry.gate_ms) needs, so a
+    tunnel-artifact plan3d timing can be rejected like any other row."""
+    from bench import train_flops_per_token
+    n_params = sum(int(v.size) for v in params.values())
+    return train_flops_per_token(n_params, cfg.num_layers,
+                                 cfg.hidden_size, seq) * batch * seq
+
+
+def _plan3d_variant(row_name, cfg_kw, donate=True, batch=8, seq=1024):
+    """One sharded-step ablation row: plan the 3D dp×fsdp×tp assignment
+    for THIS backend's device count (on one TPU chip the plan degrades
+    to dp1 — the row then isolates the pin/donate overhead itself),
+    build the planner-driven GSPMD step with the given remat policy and
+    donation setting, and emit steady-state ms/step in the
+    kernel-registry evidence format (ms + flops + the knobs), so the
+    TPU-window gap hunt — attention impl x remat x donation — is one
+    `tools/ablate_step.py plan3d...` command."""
+    from paddle_tpu.models.facade import make_train_step
+    from paddle_tpu.models.gpt import train_step
+    from paddle_tpu.parallel.planner import plan_train
+    n = len(jax.devices())
+    cfg, params, opt, toks = build(cfg_kw, batch=batch, seq=seq)
+    plan = plan_train(cfg, n, batch)
+    mesh = plan.build_mesh()
+    step = make_train_step(train_step, cfg=cfg, lr=1e-4, donate=donate,
+                           mesh=mesh, plan=plan)
+    t0 = time.perf_counter()
+    loss, params, opt = step(params, opt, toks)
+    float(loss)
+    log(f"  compile+first {time.perf_counter() - t0:.1f}s "
+        f"(plan {plan.name})")
+    t0 = time.perf_counter()
+    for _ in range(10):
+        loss, params, opt = step(params, opt, toks)
+    float(loss)
+    ms = (time.perf_counter() - t0) / 10 * 1e3
+    emit(row_name, ms, {
+        "flops": _step_flops(cfg, params, batch, seq),
+        "knobs": {"plan": plan.name, "donate": donate,
+                  "remat": cfg.remat,
+                  "remat_policy": cfg.remat_policy if cfg.remat
+                  else "none", "n_devices": n},
+        "traces": step.trace_count,
+    })
+
+
+def v_plan3d():
+    os.environ["PADDLE_TPU_DISABLE_PALLAS_BWD"] = "1"
+    _plan3d_variant("plan3d_dots_b8", dict(remat=True,
+                                           remat_policy="dots"))
+
+
+def v_plan3d_full():
+    os.environ["PADDLE_TPU_DISABLE_PALLAS_BWD"] = "1"
+    _plan3d_variant("plan3d_full_b8", dict(remat=True,
+                                           remat_policy="full"))
+
+
+def v_plan3d_noremat():
+    os.environ["PADDLE_TPU_DISABLE_PALLAS_BWD"] = "1"
+    _plan3d_variant("plan3d_noremat_b4", dict(remat=False), batch=4)
+
+
+def v_plan3d_nodonate():
+    """Donation OFF over the same plan as plan3d_dots: the delta prices
+    what the pinned donation aliasing buys (two live copies of params +
+    Adam moments, extra HBM traffic)."""
+    os.environ["PADDLE_TPU_DISABLE_PALLAS_BWD"] = "1"
+    _plan3d_variant("plan3d_dots_nodonate_b8",
+                    dict(remat=True, remat_policy="dots"), donate=False)
+
+
 def v_sgd():
     """AdamW swapped for plain SGD: isolates optimizer-update cost."""
     from paddle_tpu.models import gpt as G
@@ -346,6 +422,12 @@ VARIANTS = {
     "no_mlp": v_no_mlp,
     "jaxflash": v_jaxflash,
     "splash": v_splash,
+    # 3D sharded-step rows (ISSUE 10): remat x donation over the
+    # planner-driven GSPMD step — run all four for the gap hunt
+    "plan3d": v_plan3d,
+    "plan3d_full": v_plan3d_full,
+    "plan3d_noremat": v_plan3d_noremat,
+    "plan3d_nodonate": v_plan3d_nodonate,
 }
 
 

@@ -1,0 +1,194 @@
+"""plan3d rung: the planner-driven dp×fsdp×tp sharded train step, timed.
+
+The measurement half of ROADMAP item 5's "claim the next best_tpu MFU
+high-water mark": run `parallel.planner.plan_train`'s chosen (or an
+explicitly requested) 3D assignment end to end — GSPMD train step with
+pinned shardings, donation on — and report steady-state ms/step,
+tokens/s and MFU in the MULTICHIP-format JSON the driver artifacts use
+({"n_devices", "rc", "ok", "skipped", "tail", ...} — one line per leg).
+
+Robustness follows bench.py: the orchestrator runs each leg in a fresh
+subprocess under a hard timeout. The CPU leg pins the 8-virtual-device
+platform UNCONDITIONALLY (CLAUDE.md: never gate the pin on the env) so
+it runs with the tunnel dead; the TPU leg is attempted only with --tpu
+AND a live tunnel probe (bench._probe_tpu — short first timeout,
+PADDLE_TPU_SKIP_TPU_PROBE honored), and is marked "skipped" otherwise.
+
+Usage:
+  python tools/bench_plan3d.py            # CPU 8-virtual-device leg
+  python tools/bench_plan3d.py --tpu      # + TPU leg when tunnel is up
+  python tools/bench_plan3d.py --run cpu8 # one leg, in-process (driver)
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+
+def log(m):
+    print(f"[plan3d] {m}", file=sys.stderr, flush=True)
+
+
+# leg -> (want_tpu, n_devices (0 = all), model kw, batch, seq, iters,
+#         timeout_s, explicit degrees or None). CPU shapes follow the
+# bench.py cpu rung scaled to the 8-device mesh and PIN the canonical
+# dp2×fsdp2×tp2 layout (the cost model would rightly pick pure dp for
+# shapes this small — the rung's job is to exercise the 3D path); the
+# TPU leg uses the flagship bench shapes with the SEARCHED plan so its
+# MFU is comparable with BENCH_window best_tpu rows.
+LEGS = {
+    "cpu8": (False, 8, dict(vocab_size=512, hidden_size=128, num_layers=2,
+                            num_heads=4, max_seq_len=128, remat=False,
+                            dtype="float32"), 8, 64, 3, 600,
+             dict(dp=2, fsdp=2, tp=2)),
+    "tpu": (True, 0, dict(vocab_size=32768, hidden_size=1024,
+                          num_layers=24, num_heads=16, max_seq_len=1024,
+                          remat=True, remat_policy="dots",
+                          dtype="bfloat16"), 8, 1024, 10, 2100, None),
+}
+
+
+def run_leg(name: str) -> None:
+    """One leg, in-process: measure and print the inner JSON line."""
+    want_tpu, n_dev, kw, batch, seq, iters, _t, degrees = LEGS[name]
+    if not want_tpu:
+        # pinned UNCONDITIONALLY (the env's TPU plugin overrides
+        # JAX_PLATFORMS; a flapping tunnel would otherwise hang init)
+        from paddle_tpu.device import pin_cpu
+        if not pin_cpu(n_dev):
+            log("could not pin the virtual CPU platform")
+            sys.exit(17)
+    else:
+        from bench import apply_perf_env_defaults
+        apply_perf_env_defaults()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    devs = jax.devices()
+    platform = devs[0].platform
+    if want_tpu and platform not in ("tpu", "axon"):
+        log(f"wanted TPU, got {platform}; abandoning leg")
+        sys.exit(17)
+    n = n_dev or len(devs)
+    from paddle_tpu.utils.compile_cache import sync_compile_cache_for
+    sync_compile_cache_for(platform)
+
+    from paddle_tpu.models.facade import make_train_step
+    from paddle_tpu.models.gpt import (GPTConfig, init_gpt_params,
+                                       init_opt_state, train_step)
+    from paddle_tpu.parallel.planner import plan_train
+    kw = dict(kw)
+    kw["dtype"] = jnp.bfloat16 if kw["dtype"] == "bfloat16" else jnp.float32
+    cfg = GPTConfig(sequence_parallel=False, **kw)
+    plan = plan_train(cfg, n, batch, **(degrees or {}))
+    log(f"leg={name} n={n} plan={plan.name} "
+        f"({cfg.num_layers}L x {cfg.hidden_size}d, B={batch}, S={seq})")
+    mesh = plan.build_mesh()
+    params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+    opt = init_opt_state(params)
+    toks = np.random.RandomState(1).randint(
+        0, cfg.vocab_size, (batch, seq + 1)).astype(np.int32)
+    step = make_train_step(train_step, cfg=cfg, lr=1e-4, mesh=mesh,
+                           plan=plan)
+    t0 = time.perf_counter()
+    loss, params, opt = step(params, opt, toks)
+    loss_v = float(loss)     # forces; block_until_ready unreliable (CLAUDE.md)
+    log(f"  compile+first {time.perf_counter() - t0:.1f}s "
+        f"(loss={loss_v:.4f})")
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss, params, opt = step(params, opt, toks)
+    float(loss)              # forces the chained sequence
+    dt = (time.perf_counter() - t0) / iters
+    n_params = sum(int(v.size) for v in params.values())
+    tps = batch * seq / dt
+    from bench import _peak_for, train_flops_per_token
+    flops_per_token = train_flops_per_token(
+        n_params, cfg.num_layers, cfg.hidden_size, seq)
+    # MFU against the WHOLE mesh's peak (n chips) — the multi-chip MFU
+    # claim the ROADMAP's >=45% target is stated in
+    mfu = flops_per_token * tps / (_peak_for(devs[0].device_kind,
+                                             platform) * n)
+    print(json.dumps({
+        "metric": "gpt_train_plan3d",
+        "n_devices": n,
+        "plan": plan.name,
+        "backend": platform,
+        "ms_per_step": round(dt * 1e3, 2),
+        "tokens_per_sec": round(tps, 1),
+        "mfu": round(mfu, 4),
+        "traces_after_warmup": step.trace_count,
+        "batch": batch, "seq": seq,
+    }), flush=True)
+
+
+def orchestrate(want_tpu: bool) -> int:
+    """Run the legs in subprocesses; print ONE MULTICHIP-format JSON
+    line per leg ({"n_devices", "rc", "ok", "skipped", "tail"} + the
+    measured record when the leg produced one)."""
+    legs = ["cpu8"] + (["tpu"] if want_tpu else [])
+    worst = 0
+    for name in legs:
+        _wt, n_dev, _kw, _b, _s, _i, timeout_s, _deg = LEGS[name]
+        if name == "tpu":
+            from bench import _probe_tpu
+            if not _probe_tpu(HERE):
+                log("tunnel dead; TPU leg skipped")
+                print(json.dumps({"n_devices": n_dev or 1, "rc": 0,
+                                  "ok": False, "skipped": True,
+                                  "tail": "tpu leg skipped: tunnel dead "
+                                          "or probe disabled"}),
+                      flush=True)
+                continue
+        try:
+            res = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--run", name],
+                cwd=HERE, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                timeout=timeout_s)
+            rc, out, err = res.returncode, res.stdout, res.stderr
+        except subprocess.TimeoutExpired as te:
+            rc = -9
+            out = te.stdout or b""
+            err = (te.stderr or b"") + f"\n[timeout {timeout_s}s]".encode()
+        tail = err.decode(errors="replace")[-2000:]
+        line = next((ln for ln in reversed(
+            out.decode(errors="replace").splitlines())
+            if ln.startswith("{")), None)
+        rec = {"n_devices": n_dev or 1, "rc": rc, "ok": False,
+               "skipped": False, "tail": tail}
+        if line:
+            try:
+                inner = json.loads(line)
+                rec.update(inner)
+                rec["ok"] = rc == 0
+            except json.JSONDecodeError:
+                pass
+        print(json.dumps(rec), flush=True)
+        if not rec["ok"] and not rec["skipped"]:
+            worst = 1
+    return worst
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--tpu", action="store_true",
+                    help="also attempt the TPU leg (tunnel-gated)")
+    ap.add_argument("--run", default=None, choices=sorted(LEGS),
+                    help="run ONE leg in-process (orchestrator internal)")
+    args = ap.parse_args()
+    if args.run:
+        run_leg(args.run)
+        return 0
+    return orchestrate(args.tpu)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -152,6 +152,28 @@ def summarize(path: str, samples_per_step: Optional[float] = None) -> dict:
             k: round(last[k] - first.get(k, 0), 6)
             for k in sorted(last) if last[k] != first.get(k, 0)}
 
+    # ---- 3D training plan (parallel/planner.plan_train publishes the
+    # chosen degrees as the train.plan.* gauge family; the async-
+    # checkpoint counters ride the same snapshots). Counters report
+    # first-to-last deltas, gauges their last value. ----
+    if monitors:
+        first_s, last_s = monitors[0]["stats"], monitors[-1]["stats"]
+        tplan = {k[len("train.plan."):]: last_s[k]
+                 for k in sorted(last_s) if k.startswith("train.plan.")}
+        if tplan:
+            ck = {}
+            if "checkpoint_async_save" in last_s:
+                ck["async_saves"] = (last_s["checkpoint_async_save"]
+                                     - first_s.get("checkpoint_async_save",
+                                                   0))
+            if "checkpoint_async_pending" in last_s:
+                ck["async_pending"] = last_s["checkpoint_async_pending"]
+            if "checkpoint_save_ms" in last_s:
+                ck["last_save_ms"] = last_s["checkpoint_save_ms"]
+            if ck:
+                tplan["checkpoint"] = ck
+            out["train_plan"] = tplan
+
     # ---- serving-engine stats (inference/serving.py monitor names:
     # slot occupancy/queue depth gauges, token/prefill/tick counters;
     # tools/bench_serving.py snapshots the registry into this stream).

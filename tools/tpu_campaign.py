@@ -70,6 +70,17 @@ JOBS = [
     ("ablate2",
      [sys.executable, "tools/ablate_step.py", "calib", "calib_attn",
       "no_ln", "no_mlp", "jaxflash", "splash"], 3600, {}),
+    # the 3D auto-parallel rung (ISSUE 10 / ROADMAP item 5): the
+    # planner-driven dp×fsdp×tp sharded step, MULTICHIP-format JSON.
+    # Its CPU leg pins the 8-virtual-device platform unconditionally
+    # (runs even with the tunnel dead — `--plan3d` shortcuts to it);
+    # the --tpu leg is probe-gated inside the tool
+    ("plan3d", [sys.executable, "tools/bench_plan3d.py", "--tpu"],
+     3000, {}),
+    # the sharded-step ablation rows (remat x donation over the plan)
+    ("ablate_plan3d",
+     [sys.executable, "tools/ablate_step.py", "plan3d", "plan3d_full",
+      "plan3d_noremat", "plan3d_nodonate"], 3600, {}),
 ]
 
 
@@ -169,12 +180,13 @@ def _sweep_step_flops(spec: dict, row: dict) -> float:
     matmul terms, matching bench.py's MFU accounting); precision well
     inside the gate's 2x-roofline..sub-floor window."""
     import sweep_gpt_step as sw
+    from bench import train_flops_per_token
     m = {**sw.MODEL, **(spec.get("model") or {})}
     h, L = m["hidden_size"], m["num_layers"]
     seq = int(spec.get("seq", sw.SEQ))
     batch = int(row.get("batch") or spec.get("batch") or sw.BATCH)
     n_params = m["vocab_size"] * h + m["max_seq_len"] * h + 12 * L * h * h
-    return (6.0 * n_params + 12.0 * L * h * seq) * batch * seq
+    return train_flops_per_token(n_params, L, h, seq) * batch * seq
 
 
 def adopt_sweep_winner(json_lines: list, window_ts: str) -> None:
@@ -275,7 +287,31 @@ def main() -> None:
                     help="single probe; exit 3 if tunnel dead")
     ap.add_argument("--force-rerun", action="store_true",
                     help="ignore done-markers in campaign_state.json")
+    ap.add_argument("--plan3d", action="store_true",
+                    help="run the plan3d rung NOW (no tunnel gate: its "
+                         "CPU leg pins the 8-virtual-device platform "
+                         "unconditionally; the TPU leg stays "
+                         "probe-gated inside the tool)")
     args = ap.parse_args()
+
+    if args.plan3d:
+        # no probe loop: the rung must produce its CPU-mesh evidence
+        # even with the tunnel dead — TPU execution is gated inside
+        # bench_plan3d.py
+        window_ts = datetime.datetime.now(
+            datetime.timezone.utc).strftime("%Y%m%dT%H%M%SZ")
+        window_dir = os.path.join(PERF, f"window_{window_ts}")
+        job = next(j for j in JOBS if j[0] == "plan3d")
+        name, argv, timeout_s, env_extra = job
+        log(f"--plan3d: running {name} (timeout {timeout_s}s)")
+        res = run_job(name, argv, timeout_s, env_extra, window_dir)
+        log(f"plan3d: rc={res['rc']} {res['seconds']}s, "
+            f"{len(res['json_lines'])} JSON records")
+        if res["json_lines"]:
+            append_window_artifact(window_ts, name, res["json_lines"])
+            for rec in res["json_lines"]:
+                print(json.dumps(rec), flush=True)
+        sys.exit(0 if res["rc"] == 0 and res["json_lines"] else 1)
 
     queue = JOBS
     if args.jobs:
