@@ -6,6 +6,19 @@ framework/ir/cost_model.cc. TPU-native: XLA's own cost analysis IS the
 benchmark table — per-computation flops/bytes come from the compiler
 (profiler.cost_analysis), and a static Program's cost is measured on its
 composed function.
+
+Serving-tick ledger (`serving_tick_ledger`): the analytical per-phase
+FLOPs/bytes price of ONE decode tick — attention math vs KV gather vs
+matmuls vs dequant epilogue vs LM head — parameterized by the engine's
+layout (dense/paged), quantization, and speculative config. Unlike
+`cost_analysis` (which needs a lowered computation and undercounts
+scan bodies) the ledger is closed-form over the model dims, so it
+prices exactly the work the serving tick dispatches and splits it into
+the phases an operator can act on. tools/serving_attrib.py joins it
+with measured per-tick milliseconds (the in-tick telemetry stream,
+profiler/serving_telemetry) into the achieved-vs-roofline report — the
+measurement half of the MFU campaign that works on the CPU rung while
+the TPU tunnel is down.
 """
 from __future__ import annotations
 
@@ -47,6 +60,188 @@ def estimate_cost(fn, *example_args):
     the functional entry the Program-less paths use."""
     from .profiler import cost_analysis
     return cost_analysis(fn, *example_args)
+
+
+# --------------------------------------------------------------------
+# serving-tick ledger (tools/serving_attrib.py's pricing half)
+# --------------------------------------------------------------------
+def _family_dims(cfg, family: str) -> dict:
+    """Model dims + per-layer matmul structure for the two serving
+    families. `mats` lists every stacked matmul as (in, out) so the
+    matmul/dequant phases can price FLOPs, weight bytes and epilogue
+    work leaf-accurately (mirrors models/gpt.py qkv/attn_out/mlp and
+    models/llama.py q/k/v/o/gate/up/down — and
+    quantization/serving.py's QUANT_LEAVES)."""
+    D = int(cfg.hidden_size)
+    L = int(cfg.num_layers)
+    V = int(cfg.vocab_size)
+    H = int(cfg.num_heads)
+    KV = int(getattr(cfg, "num_kv_heads", H) or H)
+    F = int(getattr(cfg, "ffn_hidden", 0) or 4 * D)
+    hd = D // H
+    if family == "gpt":
+        mats = [(D, 3 * D), (D, D), (D, F), (F, D)]
+    elif family == "llama":
+        kvd = KV * hd
+        mats = [(D, D), (D, kvd), (D, kvd), (D, D),
+                (D, F), (D, F), (F, D)]
+    else:
+        raise ValueError(f"unknown family {family!r} (gpt|llama)")
+    return {"D": D, "L": L, "V": V, "H": H, "KV": KV, "F": F,
+            "hd": hd, "mats": mats,
+            "layer_params": sum(i * o for i, o in mats),
+            "layer_out_features": sum(o for _, o in mats)}
+
+
+def serving_tick_ledger(cfg, family: str = "gpt",
+                        layout: str = "dense", quant: str = "off",
+                        spec: bool = False, gamma: int = 0,
+                        draft_layers: int = 0, active: float = 1.0,
+                        attended: float = 1.0,
+                        num_slots: Optional[float] = None,
+                        max_len: int = 0, page_size: int = 16,
+                        max_pages: int = 0,
+                        dtype_bytes: int = 4) -> dict:
+    """Per-phase FLOPs/bytes for ONE serving decode tick.
+
+    The tick is FIXED-SHAPE: every one of the engine's `num_slots`
+    rows computes whether active or not (serving._decode_tick —
+    "inactive slots compute too"), and the attention einsum runs over
+    the FULL cache view under the mask. The ledger therefore prices
+    DISPATCHED work by `num_slots` and the view extent (that is what
+    measured milliseconds pay for), and carries the USEFUL-work
+    numbers — from the telemetry stream's `active` slots and
+    `attended` cache tokens (kernels/decode_attention.attended_tokens)
+    — as the `*_useful`/`*_ideal` columns whose gap is the occupancy/
+    masked-waste overhead an operator can act on. `num_slots` defaults
+    to `active` (a fully-occupied tick). Phases:
+
+    - matmuls:  the stacked block matmuls — FLOPs scale with rows
+      computed this tick; BYTES are the weight read (per device pass
+      all L layers stream once; each spec draft pass streams the
+      first draft_layers), which is what makes the small-batch decode
+      tick weight-bandwidth bound (parallel/planner.plan_serving_tp's
+      premise, priced per phase here);
+    - attention: QK^T + PV — dispatched FLOPs run over the full view
+      for every row; `flops_useful` counts only mask-admitted tokens
+      of active rows (the `attended` tap);
+    - kv_gather: the cache read — bytes price the full view (dense:
+      max_len; paged: the max_pages*page_size gathered view —
+      decode_attention.kv_view_extent) across all rows; `bytes_ideal`
+      prices only the attended tokens — the gap is the masked-waste
+      column of the attribution report;
+    - dequant:  (quant="int8") the scale-multiply epilogue per matmul
+      output element, plus the int8->f32 widening read already
+      reflected in the matmul phase's smaller weight bytes;
+    - head:     the LM-head projection for every scored row.
+
+    `tokens computed` per row = gamma+1 under spec (the verify pass
+    scores every draft) plus gamma single-token draft passes."""
+    dims = _family_dims(cfg, family)
+    if layout not in ("dense", "paged"):
+        raise ValueError(f"layout {layout!r} (dense|paged)")
+    if quant not in ("off", "int8"):
+        raise ValueError(f"quant {quant!r} (off|int8)")
+    D, L, V = dims["D"], dims["L"], dims["V"]
+    KV, hd = dims["KV"], dims["hd"]
+    max_len = int(max_len or cfg.max_seq_len)
+    from .kernels.decode_attention import kv_view_extent
+    if not max_pages:
+        max_pages = -(-max_len // page_size)
+    view = kv_view_extent(layout == "paged", max_len, max_pages,
+                          page_size)
+    rows = float(num_slots) if num_slots else float(active)
+
+    T = (gamma + 1) if spec else 1            # verify-pass tokens/slot
+    dL = int(draft_layers or max(1, L // 2)) if spec else 0
+    full_tokens = rows * T                    # full-depth pass
+    draft_tokens = rows * gamma if spec else 0.0   # x dL layers each
+
+    # weight bytes: int8 drops the fp matmul weights to 1 byte + an
+    # f32 scale per output channel (quantization/serving.py)
+    if quant == "int8":
+        w_layer = (dims["layer_params"]
+                   + 4 * dims["layer_out_features"])
+        w_head = D * V + 4 * V
+    else:
+        w_layer = dims["layer_params"] * dtype_bytes
+        w_head = D * V * dtype_bytes
+
+    n_draft_passes = gamma if spec else 0
+    matmul = {
+        "flops": 2.0 * dims["layer_params"]
+                 * (L * full_tokens + dL * draft_tokens),
+        # one weight stream per device pass: the full-depth pass reads
+        # all L layers, each draft pass its first dL
+        "bytes": w_layer * (L + dL * n_draft_passes),
+    }
+    # attention math: QK^T (2*S*D) + PV (2*S*D) per query per layer,
+    # queries folded over the GQA group so the einsum runs at D = H*hd
+    # regardless of KV. Dispatched S = the full view, every row;
+    # useful S = the mask-admitted tokens of active rows.
+    layer_passes = T + gamma * (dL / max(L, 1))
+    attention = {
+        "flops": 4.0 * D * L * view * rows * layer_passes,
+        "bytes": 0.0,
+        "flops_useful": 4.0 * D * L * attended * layer_passes,
+    }
+    # cache read: k+v over the full view per row per layer per pass
+    # (drafts read their dL-layer slice of the same pool)
+    kv_bytes_pass = 2.0 * view * KV * hd * dtype_bytes * rows
+    kv_gather = {
+        "flops": 0.0,
+        "bytes": kv_bytes_pass * (L + dL * n_draft_passes),
+        "bytes_ideal": 2.0 * attended * KV * hd * dtype_bytes
+                       * (L + dL * n_draft_passes),
+    }
+    dequant = {"flops": 0.0, "bytes": 0.0}
+    if quant == "int8":
+        dequant["flops"] = (dims["layer_out_features"]
+                            * (L * full_tokens + dL * draft_tokens)
+                            + V * full_tokens)      # head epilogue
+    head = {
+        "flops": 2.0 * D * V * (full_tokens + draft_tokens),
+        "bytes": w_head * (1 + n_draft_passes),
+    }
+    phases = {"matmuls": matmul, "attention": attention,
+              "kv_gather": kv_gather, "dequant": dequant, "head": head}
+    total = {"flops": sum(p["flops"] for p in phases.values()),
+             "bytes": sum(p["bytes"] for p in phases.values())}
+    return {"phases": phases, "total": total,
+            "config": {"family": family, "layout": layout,
+                       "quant": quant, "spec": bool(spec),
+                       "gamma": gamma, "draft_layers": dL,
+                       "active": active, "attended": attended,
+                       "num_slots": rows,
+                       "kv_view": view, "max_len": max_len,
+                       "dtype_bytes": dtype_bytes}}
+
+
+def roofline_attribution(ledger: dict, peak_flops: float = None,
+                         hbm_bw: float = None, chip=None) -> dict:
+    """Price a serving_tick_ledger against a chip roofline: per phase,
+    the bound time is max(flops/peak, bytes/bw) and the binding side
+    names itself; the attribution column is each phase's share of the
+    summed bound time. `chip` defaults to parallel.planner.ChipSpec
+    (the same numbers plan_serving_tp prices with)."""
+    if peak_flops is None or hbm_bw is None:
+        from .parallel.planner import ChipSpec
+        chip = chip or ChipSpec()
+        peak_flops = peak_flops or chip.peak_flops
+        hbm_bw = hbm_bw or chip.hbm_bw
+    per_phase = {}
+    for name, p in ledger["phases"].items():
+        t_c = p["flops"] / peak_flops
+        t_b = p["bytes"] / hbm_bw
+        per_phase[name] = {
+            "flops": p["flops"], "bytes": p["bytes"],
+            "bound_s": max(t_c, t_b),
+            "bound": "compute" if t_c >= t_b else "bandwidth"}
+    total_s = sum(p["bound_s"] for p in per_phase.values())
+    for p in per_phase.values():
+        p["share"] = round(p["bound_s"] / total_s, 4) if total_s else 0.0
+    return {"per_phase": per_phase, "roofline_s": total_s,
+            "peak_flops": peak_flops, "hbm_bw": hbm_bw}
 
 
 def rank_parallel_plans(model, n_devices, global_batch, **kw):

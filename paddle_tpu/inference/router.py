@@ -74,7 +74,7 @@ class RouterRequest:
                  "top_k", "eos_id", "deadline_s", "deadline_ticks",
                  "tokens", "done", "finish_reason", "replica",
                  "requeues", "t_submit", "_tick_submit", "_inner",
-                 "_router")
+                 "_router", "trace")
 
     def __init__(self, req_id, prompt, max_new_tokens, temperature,
                  top_k, eos_id, deadline_s, deadline_ticks):
@@ -95,6 +95,8 @@ class RouterRequest:
         self._tick_submit = 0
         self._inner = None              # live engine Request, if placed
         self._router = None
+        self.trace = None               # RequestTrace (tracing=True) —
+        #                                 ONE tree across dispatch/replay
 
     @property
     def slot(self):
@@ -151,7 +153,7 @@ class EngineRouter:
 
     def __init__(self, engines: Sequence[ServingEngine],
                  max_queue: int = 0, queue_policy: str = "reject",
-                 concurrent: bool = True):
+                 concurrent: bool = True, tracing: bool = False):
         if not engines:
             raise ValueError("EngineRouter needs >= 1 engine replica")
         if queue_policy not in ("reject", "shed_oldest"):
@@ -174,6 +176,18 @@ class EngineRouter:
         self._ticks = 0
         from ..profiler import flight_recorder
         self._flight = flight_recorder.recorder()
+        # request-scoped tracing (profiler/tracing): the router mints
+        # the trace at ITS submit and passes it down through engine
+        # submit(_trace=), so router admission, dispatch, replica death
+        # (severed subtree + replay link) and the terminal resolution
+        # all land in one span tree per request
+        self._tracer = None
+        if tracing:
+            from ..profiler import tracing as _tracing
+            self._tracer = _tracing.tracer()
+        # dispatch latency is a distribution (the router half of queue
+        # wait) — histogram, not a last-write-wins gauge
+        self._m_disp_ms = monitor.histogram("serving.router.dispatch_ms")
         self._m_live = monitor.gauge("serving.router.replicas_live")
         self._m_pending = monitor.gauge("serving.router.pending")
         self._m_requeue = monitor.counter("serving.router.requeues")
@@ -231,10 +245,24 @@ class EngineRouter:
         req.t_submit = time.perf_counter()
         req._tick_submit = self._ticks
         req._router = self
+        if self._tracer is not None:
+            req.trace = self._tracer.trace(
+                f"request-r{req.id}", request_id=req.id,
+                prompt_len=int(req.prompt.shape[0]),
+                max_new_tokens=req.max_new_tokens, router=True)
         # requests_submitted counts ACCEPTED requests only (same as the
         # engine's: a reject raises before anything is admitted), so
-        # submitted - completed is a true in-flight gauge
-        if self._try_dispatch(req):
+        # submitted - completed is a true in-flight gauge. A REJECTED
+        # submit still owns a freshly-minted trace — finish it
+        # ("rejected") before raising, or the open root span would
+        # leak in the tracer forever (Tracer._open is unbounded).
+        try:
+            placed = self._try_dispatch(req)
+        except PoolExhaustedError:
+            if req.trace is not None:
+                req.trace.finish("rejected", tokens=0)
+            raise
+        if placed:
             self._m_sub.add()
             return req
         if self.max_queue > 0 and len(self._pending) >= self.max_queue:
@@ -242,6 +270,8 @@ class EngineRouter:
                 self._finish(self._pending.popleft(), "evicted")
             else:
                 self._m_rej.add()
+                if req.trace is not None:
+                    req.trace.finish("rejected", tokens=0)
                 raise BackpressureError(
                     f"router queue full ({len(self._pending)} waiting, "
                     f"max_queue={self.max_queue})",
@@ -257,6 +287,7 @@ class EngineRouter:
         since the router submit; router ticks double as engine ticks —
         every router step ticks every live replica once)."""
         never_fits = 0
+        t_disp0 = time.perf_counter()
         live = sorted(self.live(), key=_Replica.load)
         for rep in live:
             dl_s = req.deadline_s
@@ -271,7 +302,7 @@ class EngineRouter:
                     req.prompt, req.max_new_tokens,
                     temperature=req.temperature, top_k=req.top_k,
                     eos_id=req.eos_id, deadline_s=dl_s,
-                    deadline_ticks=dl_t)
+                    deadline_ticks=dl_t, _trace=req.trace)
             except PoolExhaustedError:
                 never_fits += 1
                 continue
@@ -279,8 +310,13 @@ class EngineRouter:
                 continue
             rep.inner[inner.id] = req
             rep.m_disp.add()
+            self._m_disp_ms.observe(
+                (time.perf_counter() - t_disp0) * 1e3)
             req.replica = rep.idx
             req._inner = inner
+            if req.trace is not None:
+                req.trace.instant("dispatch", replica=rep.idx,
+                                  attempt=req.trace.attempt)
             return True
         if never_fits and never_fits == len(live):
             raise PoolExhaustedError(
@@ -379,6 +415,12 @@ class EngineRouter:
         req.done = True
         req.finish_reason = reason
         req._inner = None
+        if req.trace is not None:
+            # exactly-once terminal span: an inner engine _finish that
+            # already emitted it makes this a no-op (the once-only
+            # flag); router-side terminals (requeue-then-abort, cancel
+            # while pending) emit here
+            req.trace.finish(reason, tokens=len(req.tokens))
         self._m_done.add()
 
     def cancel(self, req: RouterRequest) -> bool:
@@ -444,6 +486,13 @@ class EngineRouter:
             outer.replica = None
             outer.requeues += 1
             self._m_requeue.add()
+            if outer.trace is not None:
+                # close the dead replica's span subtree (tagged
+                # severed, trace NOT finished) and link the replay
+                # attempt — the survivor's spans carry the bumped
+                # attempt index
+                outer.trace.sever("replica_death", replica=idx)
+                outer.trace.link_replay(replica_died=idx)
         self._pending.extendleft(reversed(victims))
         self._flight.note(router_replica_death=idx, reason=reason,
                           requeued=len(victims), tick=self._ticks)
@@ -490,21 +539,32 @@ def create_router(params, cfg, replicas: int = 2, family: str = "gpt",
                   max_queue: int = 0, queue_policy: str = "reject",
                   concurrent: bool = True,
                   meshes: Optional[Sequence] = None,
+                  tracing: bool = False,
                   **engine_kw) -> EngineRouter:
     """Build an EngineRouter over `replicas` identical ServingEngines
     sharing ONE param tree (read-only at decode — on a single host the
     replicas share the arrays; in a real deployment each replica's
     params live on its own devices). `meshes` optionally gives each
     replica its own tensor-parallel mesh (inference/serving.py mesh=)
-    — the dp(router) x tp(engine) composition."""
+    — the dp(router) x tp(engine) composition. `tracing` turns on
+    request-scoped tracing at the ROUTER (the engines inherit the
+    trace through dispatch — they need no tracer of their own). A
+    `telemetry_jsonl=` engine kwarg fans out per replica
+    (`<path>.r<i>`), so each replica streams its own serving_tick
+    JSONL — the per-replica files tools/telemetry_report.py's fleet
+    mode merges."""
     if replicas < 1:
         raise ValueError(f"replicas must be >= 1; got {replicas}")
     if meshes is not None and len(meshes) != replicas:
         raise ValueError(f"meshes ({len(meshes)}) must match "
                          f"replicas ({replicas})")
+    tele = engine_kw.pop("telemetry_jsonl", None)
     engines = [ServingEngine(params, cfg, family=family,
                              mesh=None if meshes is None else meshes[i],
+                             telemetry_jsonl=(f"{tele}.r{i}" if tele
+                                              else None),
                              **engine_kw)
                for i in range(replicas)]
     return EngineRouter(engines, max_queue=max_queue,
-                        queue_policy=queue_policy, concurrent=concurrent)
+                        queue_policy=queue_policy, concurrent=concurrent,
+                        tracing=tracing)

@@ -231,10 +231,16 @@ default):
 Observability: serving.* monitor counters/gauges (slot occupancy,
 queue depth, tokens emitted, prefills, decode ticks, plus
 rejected/timeout/cancelled/poisoned/evicted/retries/faults, the
-queue_wait_ms gauge, the kv-pool surface: pages_in_use /
+queue_wait_ms HISTOGRAM (bounded reservoir, p50/p95/p99 in
+snapshots), the kv-pool surface: pages_in_use /
 pages_shared gauges, cow_copies / prefill_chunks counters, and the
 speculative surface: spec_proposed / spec_accepted counters + the
-per-engine spec_accept_rate gauge) and
+per-engine spec_accept_rate gauge), in-tick DEVICE telemetry
+(telemetry= — the TICK_FIELDS int32 row computed in-jit and riding
+the tick's one host pull; profiler/serving_telemetry, records via
+tick_records() / telemetry_jsonl=), request-scoped tracing
+(tracing= — parented spans submit -> prefill chunks -> decode ->
+the exactly-once terminal _finish; profiler/tracing) and
 RecordEvent spans around every prefill/decode tick —
 tools/telemetry_report.py summarizes them (including TTFT /
 inter-token-latency percentiles from `export_slo_jsonl` and a
@@ -461,7 +467,8 @@ class Request:
                  "top_k", "eos_id", "tokens", "done", "finish_reason",
                  "slot", "deadline_s", "deadline_ticks", "t_submit",
                  "_tick_submit", "_t_last", "_engine", "_pf_next",
-                 "shared_tokens", "_pfx_keys")
+                 "shared_tokens", "_pfx_keys", "trace", "_sp_queue",
+                 "_sp_decode")
 
     def __init__(self, req_id, prompt, max_new_tokens, temperature,
                  top_k, eos_id, deadline_s=None, deadline_ticks=None):
@@ -485,6 +492,10 @@ class Request:
         self._pfx_keys = None           # memoized per-page prefix hashes
         self.shared_tokens = 0          # prompt tokens served from
         #                                 shared pages (prefix reuse)
+        self.trace = None               # RequestTrace (tracing=True /
+        #                                 router-passed; profiler/tracing)
+        self._sp_queue = None           # open queue-span id
+        self._sp_decode = None          # open decode-span id
 
     def cancel(self) -> bool:
         """Terminate this request NOW (finish_reason "cancelled"):
@@ -552,7 +563,7 @@ def _pin_cache(cache, pin):
 #   (cur_tok, positions, active, temps, top_ks, req_ids, gen_idx)
 def _decode_tick(params, cache, state, base_key, poison, *, fwd, cfg,
                  max_top_k, sampling, guard, oor_pos=None,
-                 cache_pin=None):
+                 cache_pin=None, tele=False):
     """THE mixed step: all N slots advance one token. Each slot's
     current token is written at its own position; sampling runs in-jit;
     inactive slots compute too (fixed shape) but their output is masked
@@ -567,7 +578,11 @@ def _decode_tick(params, cache, state, base_key, poison, *, fwd, cfg,
     (all-ones in production; testing.faults nan_logits sets one lane to
     nan INSIDE the jit so injected and organic non-finite logits
     exercise the exact same guard); multiplying by 1.0 is exact in
-    IEEE fp, so guarded greedy/sampled streams stay bit-identical."""
+    IEEE fp, so guarded greedy/sampled streams stay bit-identical.
+    `tele` (static, baked per engine) additionally returns the
+    TICK_FIELDS int32 row (profiler/serving_telemetry) computed from
+    values the tick already holds — it rides the same host pull as
+    the token array and never touches the stream math."""
     toks, positions, active, temps, top_ks, req_ids, gen_idx = state
     # under the paged layout the pool is SHARED across rows, so an
     # inactive row (mid-chunked-prefill, its table already mapping
@@ -587,13 +602,26 @@ def _decode_tick(params, cache, state, base_key, poison, *, fwd, cfg,
     else:
         nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
     nxt = jnp.where(active, nxt, 0).astype(jnp.int32)
+    bad = jnp.zeros_like(active)
     if guard:
         row_ok = jnp.all(jnp.isfinite(lg), axis=-1)
-        nxt = jnp.where(active & ~row_ok, -1, nxt)
+        bad = active & ~row_ok
+        nxt = jnp.where(bad, -1, nxt)
     inc = active.astype(jnp.int32)
     state = (nxt, positions + inc, active, temps, top_ks, req_ids,
              gen_idx + inc)
-    return nxt, _pin_cache(cache, cache_pin), state
+    if not tele:
+        return nxt, _pin_cache(cache, cache_pin), state
+    # in-tick telemetry row riding the SAME host pull as `nxt` (zero
+    # extra transfers — profiler/serving_telemetry): what the tick
+    # emitted/advanced/flagged, plus the attention tap
+    from ..kernels.decode_attention import attended_tokens
+    from ..profiler.serving_telemetry import pack_tick_fields
+    trow = pack_tick_fields(
+        tokens=jnp.sum(active & ~bad), active=jnp.sum(active),
+        poisoned=jnp.sum(bad),
+        attended=attended_tokens(positions, active))
+    return nxt, trow, _pin_cache(cache, cache_pin), state
 
 
 def _prefill_slot(params, cache, padded, true_len, slot, temps, top_ks,
@@ -701,7 +729,9 @@ class ServingEngine:
                  prefill_chunk: int = 0, prefix_sharing: bool = True,
                  spec_decode: str = "auto", gamma: int = 4,
                  draft_layers: int = 0, mesh=None, tp_axis: str = "tp",
-                 quant: str = "auto"):
+                 quant: str = "auto", telemetry: str = "auto",
+                 telemetry_jsonl: Optional[str] = None,
+                 telemetry_every: int = 32, tracing: bool = False):
         self.family = (family_for(family) if isinstance(family, str)
                        else family)
         self.cfg = cfg
@@ -879,6 +909,41 @@ class ServingEngine:
         self._slo_ttft: collections.deque = collections.deque(maxlen=8192)
         self._slo_itl: collections.deque = collections.deque(maxlen=8192)
 
+        # ----------------------------------------- in-tick telemetry
+        # the decode tick computes the TICK_FIELDS int32 row in-jit and
+        # returns it NEXT TO the token array; both ride the ONE host
+        # pull the tick already makes (profiler/serving_telemetry —
+        # zero extra pulls, zero extra traces, kill switch
+        # PADDLE_TPU_SERVING_TELEMETRY). The host joins scheduler-side
+        # fields (queue depth, prefilling, pages in use) + tick wall ms
+        # into serving_tick records: a bounded in-memory ring
+        # (`tick_records()`) and optionally a JSONL stream
+        # (`telemetry_jsonl=`, flushed every `telemetry_every` records
+        # on a background writer).
+        from ..profiler.serving_telemetry import (ServingTelemetry,
+                                                  resolve_serving_telemetry)
+        self._tick_tele = resolve_serving_telemetry(telemetry)
+        self._tick_log = None
+        if self._tick_tele:
+            self._tick_log = ServingTelemetry(
+                path=telemetry_jsonl, every=telemetry_every,
+                meta={"family": self.family.name,
+                      "layout": "paged" if self.paged else "dense",
+                      "spec": bool(self.spec),
+                      "quant": "int8" if self.quant else "off",
+                      "tp": self.tp, "num_slots": self.num_slots,
+                      "max_len": self.max_len})
+        # ---------------------------------------- request-scoped traces
+        # opt-in (tracing=True): submit() mints a RequestTrace
+        # (profiler/tracing) and the scheduler emits parented spans
+        # through queue -> prefill chunks -> decode -> the terminal
+        # _finish; a router passes its own trace down via submit(_trace=)
+        # so routed requests keep ONE tree across dispatch and replay.
+        self._tracer = None
+        if tracing:
+            from ..profiler import tracing as _tracing
+            self._tracer = _tracing.tracer()
+
         _oor = (self.max_pages * self.page_size if self.paged else None)
         if self.spec:
             from .spec_decode import spec_tick
@@ -890,7 +955,8 @@ class ServingEngine:
                                   gamma=self.spec_gamma,
                                   draft_layers=self.spec_draft_layers,
                                   oor_pos=_oor,
-                                  cache_pin=self._cache_pin),
+                                  cache_pin=self._cache_pin,
+                                  tele=self._tick_tele),
                 donate_argnums=(1, 2), static_argnames=("sampling",))
         else:
             self._decode = jax.jit(
@@ -898,7 +964,8 @@ class ServingEngine:
                                   fwd=self.family.forward_cached,
                                   cfg=run_cfg, max_top_k=self.max_top_k,
                                   guard=self.guardrails, oor_pos=_oor,
-                                  cache_pin=self._cache_pin),
+                                  cache_pin=self._cache_pin,
+                                  tele=self._tick_tele),
                 donate_argnums=(1, 2), static_argnames=("sampling",))
         if self.paged:
             self._prefill = jax.jit(
@@ -931,7 +998,10 @@ class ServingEngine:
 
         self._m_occ = monitor.gauge("serving.slot_occupancy")
         self._m_queue = monitor.gauge("serving.queue_depth")
-        self._m_qwait = monitor.gauge("serving.queue_wait_ms")
+        # queue wait is a DISTRIBUTION (the admission-latency half of
+        # TTFT): a last-write-wins gauge hid the tail, the bounded-
+        # reservoir histogram snapshots p50/p95/p99
+        self._m_qwait = monitor.histogram("serving.queue_wait_ms")
         self._m_tok = monitor.counter("serving.tokens_emitted")
         self._m_pre = monitor.counter("serving.prefills")
         self._m_tick = monitor.counter("serving.decode_ticks")
@@ -1088,6 +1158,19 @@ class ServingEngine:
         bucket, sampling mode) pairs — ceiling 2·log2(max_len)."""
         return self._decode._cache_size(), self._prefill._cache_size()
 
+    def tick_records(self) -> list:
+        """The in-tick telemetry ring (profiler/serving_telemetry
+        serving_tick / serving_prefill records, newest-last); empty
+        with telemetry off. tools/serving_attrib.py joins these with
+        the cost-model ledger."""
+        return [] if self._tick_log is None else self._tick_log.records()
+
+    def flush_telemetry(self, timeout: Optional[float] = None) -> None:
+        """Block until every pending serving_tick record is on disk
+        (no-op without telemetry_jsonl=)."""
+        if self._tick_log is not None:
+            self._tick_log.flush(timeout=timeout)
+
     def has_work(self) -> bool:
         # a slot mid-chunked-prefill holds a request but is not yet
         # active for decode — still work
@@ -1102,7 +1185,8 @@ class ServingEngine:
     def submit(self, prompt, max_new_tokens: int, temperature: float = 0.0,
                top_k: int = 0, eos_id: Optional[int] = None,
                deadline_s: Optional[float] = None,
-               deadline_ticks: Optional[int] = None) -> Request:
+               deadline_ticks: Optional[int] = None,
+               _trace=None) -> Request:
         """Queue one request. prompt: 1-D int token ids. Returns the
         live Request; its .tokens fills in as the engine steps.
         `deadline_s` / `deadline_ticks` bound the request's TOTAL
@@ -1110,7 +1194,10 @@ class ServingEngine:
         exceeding either resolves it with finish_reason "timeout".
         Raises BackpressureError when the queue is at max_queue under
         the "reject" policy; under "shed_oldest" the oldest queued
-        request is evicted to make room."""
+        request is evicted to make room. `_trace` lets a router thread
+        ITS RequestTrace through so a dispatched (or replayed) request
+        keeps one span tree; with tracing=True and no _trace the
+        engine mints its own."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         t0 = prompt.shape[0]
         if t0 < 1:
@@ -1156,6 +1243,16 @@ class ServingEngine:
         req.t_submit = time.perf_counter()
         req._tick_submit = self._ticks
         req._engine = self
+        if _trace is not None:
+            req.trace = _trace
+        elif self._tracer is not None:
+            req.trace = self._tracer.trace(
+                f"request-{req.id}", request_id=req.id,
+                prompt_len=t0, max_new_tokens=int(max_new_tokens))
+        if req.trace is not None:
+            req._sp_queue = req.trace.begin(
+                "queue", queue_depth=len(self._queue),
+                attempt=req.trace.attempt)
         self._next_id += 1
         self._queue.append(req)
         self._m_sub.add()
@@ -1316,6 +1413,11 @@ class ServingEngine:
         req.slot = None
         req.done = True
         req.finish_reason = reason
+        if req.trace is not None:
+            # the ONE terminal span — exactly-once because _finish is
+            # the one terminal seam AND RequestTrace.finish is once-
+            # only (a router's own _finish then no-ops)
+            req.trace.finish(reason, tokens=len(req.tokens))
         self._m_done.add()
         ctr = self._reason_ctr.get(reason)
         if ctr is not None:
@@ -1427,19 +1529,24 @@ class ServingEngine:
         print(f"[serving] hard reset at tick {self._ticks} ({reason}): "
               f"pool cache reallocated", file=sys.stderr, flush=True)
 
-    def _pull(self, value, stall_s: float = 0.0) -> np.ndarray:
+    def _pull(self, value, stall_s: float = 0.0):
         """The one device->host pull, optionally under the resilience
         watchdog (re-polls the SAME future with backoff — donated
         buffers cannot be re-dispatched). The persistent WatchdogPuller
         is the ~2 ms-tick-rate variant of the trainer's per-step pull
-        thread. `stall_s` is the injected tick_stall: it sleeps INSIDE
-        the watchdog-monitored pull so the drill exercises the real
-        budget/backoff path."""
-        src = value
-        if stall_s > 0.0:
-            def src():
+        thread. `value` may be a TUPLE of device arrays (the tick's
+        token array + the in-tick telemetry row): the pair fetches in
+        this ONE call, so the pull count the invariant tests wrap stays
+        one per tick with telemetry on. `stall_s` is the injected
+        tick_stall: it sleeps INSIDE the watchdog-monitored pull so the
+        drill exercises the real budget/backoff path."""
+        def src():
+            if stall_s > 0.0:
                 time.sleep(stall_s)
-                return np.asarray(value)
+            if isinstance(value, tuple):
+                return tuple(np.asarray(v)
+                             for v in jax.device_get(list(value)))
+            return np.asarray(value)
         if self.watchdog_timeout > 0.0:
             if self._puller is None:
                 from ..parallel.resilience import WatchdogPuller
@@ -1448,7 +1555,7 @@ class ServingEngine:
                 src, self.watchdog_timeout, self.retries,
                 self.backoff_base, self.backoff_max,
                 on_retry=self._on_stall_retry)
-        return np.asarray(src() if callable(src) else src)
+        return src()
 
     def _on_stall_retry(self, attempt: int) -> None:
         """Watchdog backoff observer: count it, and leave a black box
@@ -1531,6 +1638,7 @@ class ServingEngine:
                     p[int(poison_slot) % self.num_slots] = np.nan
                     poison = self._rep(p)
                 poison_slot = None        # injected at most once
+                t_dev0 = time.perf_counter()
                 with RecordEvent("serving.decode_tick"):
                     if self.spec:
                         dpoison = self._poison_ones
@@ -1539,17 +1647,26 @@ class ServingEngine:
                             dp[int(draft_slot) % self.num_slots] = np.nan
                             dpoison = self._rep(dp)
                         draft_slot = None     # injected at most once
-                        nxt, self._cache, self._dstate = self._decode(
+                        out = self._decode(
                             self._params, self._cache, self._dstate,
                             self._base_key, poison, dpoison,
                             sampling=sampling)
                     else:
-                        nxt, self._cache, self._dstate = self._decode(
+                        out = self._decode(
                             self._params, self._cache, self._dstate,
                             self._base_key, poison, sampling=sampling)
                     # ONE host pull per tick ([N] non-spec; the
-                    # [N, gamma+1] emission matrix under spec)
-                    toks = self._pull(nxt, stall_s)
+                    # [N, gamma+1] emission matrix under spec) — with
+                    # in-tick telemetry the TICK_FIELDS row rides the
+                    # SAME pull (a tuple fetch through the one _pull)
+                    if self._tick_tele:
+                        nxt, trow, self._cache, self._dstate = out
+                        toks, tele_row = self._pull((nxt, trow), stall_s)
+                    else:
+                        nxt, self._cache, self._dstate = out
+                        toks = self._pull(nxt, stall_s)
+                        tele_row = None
+                tick_ms = (time.perf_counter() - t_dev0) * 1e3
                 stall_s = 0.0
                 break
             except StepHungError as e:
@@ -1574,6 +1691,13 @@ class ServingEngine:
             self._m_qmm.add(self._qmm_full
                             + (self.spec_gamma * self._qmm_draft
                                if self.spec else 0))
+        if self._tick_log is not None:
+            host = {"queue_depth": len(self._queue)}
+            if self.paged:
+                host["prefilling"] = len(self._prefilling)
+                host["pages_in_use"] = int((self._pool.ref[1:] > 0).sum())
+            self._tick_log.record_tick(self._ticks, tele_row, host,
+                                       tick_ms)
         tick_now = time.perf_counter()
         if self.spec:
             self._apply_spec_emissions(toks, events, tick_now)
@@ -1606,6 +1730,9 @@ class ServingEngine:
         self._positions[i] += 1
         self._cur_tok[i] = tok
         self._gen_idx[i] += 1
+        if req.trace is not None:
+            req.trace.instant("decode.tick", parent=req._sp_decode,
+                              tick=self._ticks, token=tok)
         req.tokens.append(tok)
         events.append((req, tok))
         self._m_tok.add()
@@ -1679,6 +1806,12 @@ class ServingEngine:
         tb = prompt_bucket(t0, self.max_len, self.bucket_lo)
         padded = np.zeros((1, tb), np.int32)
         padded[0, :t0] = req.prompt
+        if req.trace is not None:
+            req.trace.end(req._sp_queue)
+            req._sp_queue = None
+            sp_pf = req.trace.begin("prefill", slot=slot, true_len=t0,
+                                    bucket=tb, attempt=req.trace.attempt)
+        t_pf0 = time.perf_counter()
         with RecordEvent("serving.prefill"):
             first, self._cache = self._prefill(
                 self._params, self._cache, self._rep(padded),
@@ -1690,6 +1823,12 @@ class ServingEngine:
             # first generated token — the admission's one host pull,
             # under the same watchdog as the tick's
             tok = int(self._pull(first))
+        pf_ms = (time.perf_counter() - t_pf0) * 1e3
+        if req.trace is not None:
+            req.trace.end(sp_pf, final=True)
+        if self._tick_log is not None:
+            self._tick_log.record_prefill(self._ticks, pf_ms, t0, tb,
+                                          True, slot)
         self._m_pre.add()
         if self._quant_info:
             self._m_qmm.add(self._qmm_full)
@@ -1709,7 +1848,7 @@ class ServingEngine:
         mirror, and hand the slot to the decode tick (shared by the
         dense admission and the paged final chunk)."""
         now = time.perf_counter()
-        self._m_qwait.set((now - req.t_submit) * 1e3)
+        self._m_qwait.observe((now - req.t_submit) * 1e3)
         self._slo_ttft.append((now - req.t_submit) * 1e3)
         req._t_last = now
         req.slot = slot
@@ -1722,6 +1861,11 @@ class ServingEngine:
         self._req_ids[slot] = req.id
         self._gen_idx[slot] = 1
         self._dirty = True
+        if req.trace is not None:
+            req._sp_decode = req.trace.begin(
+                "decode", slot=slot, attempt=req.trace.attempt)
+            req.trace.instant("decode.tick", parent=req._sp_decode,
+                              tick=self._ticks, token=tok)
         req.tokens.append(tok)
         events.append((req, tok))
         self._m_tok.add()
@@ -1802,6 +1946,9 @@ class ServingEngine:
         self._slot_req[slot] = req
         req.shared_tokens = suffix_start
         req._pf_next = suffix_start
+        if req.trace is not None:
+            req.trace.end(req._sp_queue, shared_tokens=suffix_start)
+            req._sp_queue = None
         if aligned_full:
             # the suffix rewrites the last prompt token's K/V into the
             # last matched page — materialize a private copy first
@@ -1835,6 +1982,13 @@ class ServingEngine:
             self._cache["pt"] = self._rep(self._ptab)
             self._pt_dirty = False
         final = end == t0
+        sp_pf = None
+        if req.trace is not None:
+            sp_pf = req.trace.begin("prefill", slot=slot,
+                                    chunk_start=start, chunk_len=clen,
+                                    bucket=cb, final=final,
+                                    attempt=req.trace.attempt)
+        t_pf0 = time.perf_counter()
         with RecordEvent("serving.prefill"):
             first, self._cache = self._prefill(
                 self._params, self._cache, self._rep(padded),
@@ -1846,6 +2000,12 @@ class ServingEngine:
                 self._rep([req.id], np.int32), self._base_key,
                 sampling=final and req.temperature > 0.0)
             tok = int(self._pull(first)) if final else None
+        pf_ms = (time.perf_counter() - t_pf0) * 1e3
+        if req.trace is not None:
+            req.trace.end(sp_pf)
+        if self._tick_log is not None:
+            self._tick_log.record_prefill(self._ticks, pf_ms, clen, cb,
+                                          final, slot)
         self._m_chunks.add()
         if self._quant_info:
             self._m_qmm.add(self._qmm_full)

@@ -149,7 +149,7 @@ def resolve_spec(knob: str) -> bool:
 
 def spec_tick(params, cache, state, base_key, poison, draft_poison, *,
               fwd, cfg, max_top_k, sampling, guard, gamma, draft_layers,
-              oor_pos=None, cache_pin=None):
+              oor_pos=None, cache_pin=None, tele=False):
     """THE speculative mixed step (the spec-mode replacement for
     serving._decode_tick, same state tuple / donation / static
     `sampling` flag). Per active slot: gamma truncated-depth draft
@@ -248,4 +248,23 @@ def spec_tick(params, cache, state, base_key, poison, draft_poison, *,
     new_tok = jnp.where(active, last, toks).astype(jnp.int32)
     new_state = (new_tok, positions + adv, active, temps, top_ks,
                  req_ids, gen_idx + adv)
-    return emit, _pin_cache(cache, cache_pin), new_state
+    if not tele:
+        return emit, _pin_cache(cache, cache_pin), new_state
+    # in-tick telemetry row riding the emission-matrix pull (zero extra
+    # transfers — profiler/serving_telemetry). DEVICE-side truth: a
+    # mid-block host finish may drop tail tokens from the stream, but
+    # the device did the work these fields price. Proposed counts
+    # greedy slots only (sampled slots never speculate — same rule as
+    # the host acceptance ledger); accepted sums the kept drafts.
+    from ..kernels.decode_attention import attended_tokens
+    from ..profiler.serving_telemetry import pack_tick_fields
+    flagged = active & (emit[:, 0] < 0)
+    greedy = (active & (temps <= 0.0)) if sampling else active
+    trow = pack_tick_fields(
+        tokens=jnp.sum(jnp.where(active & ~flagged, adv, 0)),
+        active=jnp.sum(active),
+        poisoned=jnp.sum(flagged),
+        attended=attended_tokens(positions, active),
+        spec_proposed=gamma * jnp.sum(greedy),
+        spec_accepted=jnp.sum(jnp.where(greedy & ~flagged, m, 0)))
+    return emit, trow, _pin_cache(cache, cache_pin), new_state

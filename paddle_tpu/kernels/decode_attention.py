@@ -54,7 +54,7 @@ import jax.numpy as jnp
 
 __all__ = ["write_kv", "cached_attention", "decode_attn_impl",
            "gather_pages", "write_kv_paged", "attn_math_impl",
-           "cache_pspecs"]
+           "cache_pspecs", "attended_tokens", "kv_view_extent"]
 
 
 def cache_pspecs(paged: bool, tp_axis: str = "tp"):
@@ -163,6 +163,29 @@ def write_kv(kc, k, pos):
     rows = jnp.broadcast_to(jnp.arange(B, dtype=jnp.int32)[:, None],
                             (B, T))
     return kc.at[rows, qpos].set(k, mode="drop")
+
+
+def attended_tokens(positions, active):
+    """In-jit telemetry tap: total cache tokens this tick's attention
+    ADMITS (the `<= position` mask of `cached_attention`) — per active
+    row, positions[b] cache slots plus the current token. This is the
+    roofline-attribution observable (profiler/serving_telemetry
+    `attended` field): the attention-math FLOPs and the *useful* KV
+    bytes scale with it, while the implementation's KV read scales
+    with the full view extent (`kv_view_extent`) — the gap between the
+    two is the masked-waste column of tools/serving_attrib.py."""
+    return jnp.sum(jnp.where(active, positions + 1, 0)).astype(jnp.int32)
+
+
+def kv_view_extent(paged: bool, max_len: int, max_pages: int = 0,
+                   page_size: int = 0) -> int:
+    """Host-side: the per-row cache positions one decode-attention call
+    actually READS — the dense pool attends its whole [*, max_len]
+    row under the mask, and the paged gather materializes the full
+    [*, max_pages * page_size] table view (unmapped entries hit the
+    scratch page but their bytes still move). The cost-model's
+    KV-gather phase prices against this, not against live tokens."""
+    return max_pages * page_size if paged else max_len
 
 
 def _query_positions(pos, B, T):

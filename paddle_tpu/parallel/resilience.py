@@ -255,7 +255,13 @@ class WatchdogPuller:
         while True:
             seq, value = self._req.get()
             try:
-                arr = np.asarray(value() if callable(value) else value)
+                res = value() if callable(value) else value
+                # tuple results pass through element-wise (the serving
+                # tick's token + telemetry pair rides ONE pull); a
+                # ragged tuple must not collapse into an object array
+                arr = (tuple(np.asarray(v) for v in res)
+                       if isinstance(res, tuple)
+                       else np.asarray(res))
                 self._res.put((seq, "ok", arr))
             except BaseException as e:      # surfaced to the caller
                 self._res.put((seq, "err", e))
@@ -268,7 +274,9 @@ class WatchdogPuller:
         on an exhausted budget)."""
         import queue
         if timeout <= 0:
-            return np.asarray(value() if callable(value) else value)
+            res = value() if callable(value) else value
+            return (tuple(np.asarray(v) for v in res)
+                    if isinstance(res, tuple) else np.asarray(res))
         self._ensure()
         self._seq += 1
         seq = self._seq

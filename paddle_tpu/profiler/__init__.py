@@ -383,7 +383,10 @@ def cost_analysis(fn, *example_args, **jit_kwargs):
 def __getattr__(name):
     # telemetry / flight_recorder pull in jax lazily; loading them only
     # on attribute access keeps `import paddle_tpu.profiler` backend-free
-    if name in ("telemetry", "flight_recorder"):
+    # (serving_telemetry / tracing / slo are jax-free but ride the same
+    # lazy seam so the profiler package stays import-light)
+    if name in ("telemetry", "flight_recorder", "serving_telemetry",
+                "tracing", "slo"):
         import importlib
         return importlib.import_module(f".{name}", __name__)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

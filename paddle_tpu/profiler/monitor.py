@@ -1,14 +1,20 @@
-"""Exported runtime monitors: a thread-safe counter/gauge registry.
+"""Exported runtime monitors: a thread-safe counter/gauge/histogram
+registry.
 
 Reference analog: paddle/fluid/platform/monitor.h:1 (the whole small
 header: `StatValue<T>` slots + the `StatRegistry<int64_t>` /
 `StatRegistry<float>` singletons PS and fleet components publish into
 via `STAT_ADD(item, t)` / `STAT_INT(item)`; monitor.cc:1 instantiates
 the registries — SURVEY §5 "Metrics/logging/observability"). Here one
-registry holds both kinds — `Counter` (monotonic int, the STAT_INT
-analog) and `Gauge` (last-written float, the STAT_FLOAT analog) — and
+registry holds three kinds — `Counter` (monotonic int, the STAT_INT
+analog), `Gauge` (last-written float, the STAT_FLOAT analog), and
+`Histogram` (bounded-reservoir latency distribution: the SLO-grade
+upgrade over a last-write-wins gauge, cf. the reference profiler's
+stat tables which report avg/max but lose percentiles) — and
 `snapshot()` renders it for the telemetry JSONL stream and the flight
-recorder.
+recorder. A histogram renders as a small dict
+({"n","min","max","mean","p50","p95","p99"}), so snapshot values are
+either numbers or dicts — tools/telemetry_report.py handles both.
 
 Design constraints:
 - import-light: framework/dispatch.py increments counters on the eager
@@ -86,7 +92,90 @@ class Gauge:
             self._value = 0.0
 
 
-Stat = Union[Counter, Gauge]
+class Histogram:
+    """Latency/size distribution over a bounded reservoir.
+
+    Reservoir sampling (Vitter's algorithm R) with a deterministic
+    per-histogram PRNG: every observation is a candidate, the kept set
+    is a uniform sample of everything ever observed, and memory is
+    bounded at `reservoir` floats no matter how long the process
+    serves. min/max/mean/count are tracked EXACTLY over all
+    observations (they are not sampled); percentiles come from the
+    reservoir. Determinism: the replacement stream is seeded from the
+    stat name, so two runs observing the same sequence snapshot the
+    same percentiles — test-assertable, like everything else in this
+    registry."""
+
+    kind = "histogram"
+    DEFAULT_RESERVOIR = 2048
+    __slots__ = ("name", "_lock", "_samples", "_cap", "_n", "_sum",
+                 "_min", "_max", "_rng")
+
+    def __init__(self, name: str, reservoir: int = DEFAULT_RESERVOIR):
+        import random
+        import zlib
+        self.name = name
+        self._lock = threading.Lock()
+        self._cap = max(int(reservoir), 1)
+        self._samples = []
+        self._n = 0
+        self._sum = 0.0
+        self._min = None
+        self._max = None
+        self._rng = random.Random(zlib.crc32(name.encode()))
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            self._n += 1
+            self._sum += v
+            self._min = v if self._min is None else min(self._min, v)
+            self._max = v if self._max is None else max(self._max, v)
+            if len(self._samples) < self._cap:
+                self._samples.append(v)
+            else:
+                j = self._rng.randrange(self._n)
+                if j < self._cap:
+                    self._samples[j] = v
+
+    def percentile(self, q: float):
+        """Nearest-rank percentile over the reservoir (None when
+        empty)."""
+        with self._lock:
+            ordered = sorted(self._samples)
+        if not ordered:
+            return None
+        import math
+        k = max(0, math.ceil(q / 100.0 * len(ordered)) - 1)
+        return ordered[k]
+
+    @property
+    def value(self) -> dict:
+        """The snapshot rendering: exact n/min/max/mean + reservoir
+        percentiles, all rounded for stable JSONL output."""
+        with self._lock:
+            n, s = self._n, self._sum
+            mn, mx = self._min, self._max
+            ordered = sorted(self._samples)
+        if not n:
+            return {"n": 0}
+        import math
+
+        def pct(q):
+            return ordered[max(0, math.ceil(q / 100.0 * len(ordered)) - 1)]
+        return {"n": n, "min": round(mn, 3), "max": round(mx, 3),
+                "mean": round(s / n, 3), "p50": round(pct(50), 3),
+                "p95": round(pct(95), 3), "p99": round(pct(99), 3)}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._samples = []
+            self._n = 0
+            self._sum = 0.0
+            self._min = self._max = None
+
+
+Stat = Union[Counter, Gauge, Histogram]
 
 
 class MonitorRegistry:
@@ -113,6 +202,9 @@ class MonitorRegistry:
 
     def gauge(self, name: str) -> Gauge:
         return self._get(name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(name, Histogram)
 
     def get(self, name: str):
         with self._lock:
@@ -159,6 +251,12 @@ def counter(name: str) -> Counter:
 def gauge(name: str) -> Gauge:
     """Get-or-create the named gauge."""
     return _REGISTRY.gauge(name)
+
+
+def histogram(name: str) -> Histogram:
+    """Get-or-create the named histogram (bounded reservoir; snapshot
+    renders p50/p95/p99)."""
+    return _REGISTRY.histogram(name)
 
 
 def snapshot() -> Dict[str, Union[int, float]]:

@@ -1,0 +1,189 @@
+"""In-tick serving telemetry: device-computed per-tick fields riding
+the token pull.
+
+Reference analog: the profiler/monitor export loops that stream serving
+stats (paddle/fluid/platform/monitor.h:1 registries fed by the fleet
+serving deployments; python/paddle/profiler/profiler.py:340 stats
+pipeline) — the serving sibling of profiler/telemetry.py's training
+accumulator.
+
+TPU-native design: the training pipeline batches K steps into a donated
+device accumulator because the train loop makes NO per-step host pull.
+The serving tick is different — it already pays EXACTLY ONE pull per
+tick (the sampled-token array, inference/serving.py `_pull`), so the
+cheapest possible telemetry is to PIGGYBACK on that pull: the jitted
+tick computes a small int32 field vector (tokens emitted, active
+slots, poisoned rows, cache tokens attended, spec proposed/accepted)
+and returns it NEXT TO the token array; the host fetches both in the
+same `_pull` call (one `jax.device_get` of the pair). Zero extra
+pulls, zero extra traces — the field math is a handful of masked
+reductions baked into the existing tick executable, and the engine's
+one-pull/trace-ceiling tests run with telemetry ON
+(tests/test_serving_observability.py asserts both).
+
+Host-side, each tick's device row joins the scheduler's own knowledge
+(queue depth, mid-prefill slot count, pages in use) plus the tick's
+wall duration into one `serving_tick` record, kept in a bounded ring
+and optionally streamed to a JSONL file through the same background
+writer the training pipeline uses (profiler/telemetry.TelemetryWriter
+— flush boundaries never block the tick on json/disk). Prefill device
+calls get their own `serving_prefill` records. tools/telemetry_report.py
+summarizes the stream; tools/serving_attrib.py joins per-tick ms with
+the cost-model ledger into the achieved-vs-roofline report — the
+`attended` field (kernels/decode_attention.attended_tokens) is what
+prices the attention/KV-gather phases against what the tick actually
+read.
+
+JSONL schema (appended to the telemetry stream, same file as monitor
+snapshots / serving_slo records):
+  {"kind": "serving_run",     "t", "pid", "fields", ...meta}
+  {"kind": "serving_tick",    "tick", "t", "dur_ms", <field>: int, ...,
+                              "queue_depth", "prefilling", "pages_in_use"}
+  {"kind": "serving_prefill", "tick", "t", "dur_ms", "chunk_len",
+                              "bucket", "final", "slot"}
+
+Kill switch: PADDLE_TPU_SERVING_TELEMETRY — off values disable the
+in-tick fields for new engines (the tick then returns exactly the
+PR-4..9 shape); default ON (the fields are a few reductions riding a
+pull that happens anyway; measured overhead is recorded in BASELINE.md
+"Serving observability").
+"""
+from __future__ import annotations
+
+import collections
+import os
+import time
+from typing import Optional
+
+ENV_SERVING_TELEMETRY = "PADDLE_TPU_SERVING_TELEMETRY"
+
+# device-computed per-tick fields, in row order (int32):
+#   tokens        tokens this tick emitted (poisoned rows excluded)
+#   active        slots the tick advanced
+#   poisoned      rows the in-jit quarantine flagged this tick
+#   attended      cache tokens the tick's attention admitted
+#                 (kernels/decode_attention.attended_tokens — the
+#                 roofline-attribution tap)
+#   spec_proposed drafts proposed this tick (greedy slots x gamma)
+#   spec_accepted drafts the verify pass kept
+TICK_FIELDS = ("tokens", "active", "poisoned", "attended",
+               "spec_proposed", "spec_accepted")
+
+_OFF_VALUES = frozenset({"0", "off", "false", "no"})
+_ON_VALUES = frozenset({"1", "on", "true", "yes"})
+
+
+def resolve_serving_telemetry(knob: str = "auto") -> bool:
+    """Engine-build resolution of the telemetry knob ('auto' | 'on' |
+    'off') against the env kill switch. Unlike the spec/quant
+    selectors the default is ON — the fields ride a pull that happens
+    anyway — but the env override is a KILL SWITCH, so it only wins in
+    the OFF direction: an env off value disables even knob='on', while
+    an env on value never overrides an explicit knob='off' (an
+    exported leftover must not silently re-enable the instrumented
+    tick — e.g. bench_serving's A/B baseline pins telemetry='off' and
+    must stay off). Unrecognized env values warn and defer to the
+    knob."""
+    env = os.environ.get(ENV_SERVING_TELEMETRY, "").strip().lower()
+    if env and env in _OFF_VALUES:
+        return False
+    if env and env not in _ON_VALUES:
+        import sys
+        print(f"[serving_telemetry] {ENV_SERVING_TELEMETRY}={env!r} is "
+              f"not one of {sorted(_ON_VALUES)} / {sorted(_OFF_VALUES)}; "
+              "ignoring", file=sys.stderr, flush=True)
+    if knob == "off":
+        return False
+    if knob in ("auto", "on"):
+        return True
+    raise ValueError(f"telemetry {knob!r} (auto|on|off)")
+
+
+def pack_tick_fields(**fields):
+    """In-jit: stack the named scalars into the TICK_FIELDS int32 row
+    the tick returns beside the token array (missing fields record 0;
+    unknown names raise at trace time)."""
+    import jax.numpy as jnp
+    unknown = set(fields) - set(TICK_FIELDS)
+    if unknown:
+        raise ValueError(f"unknown tick fields {sorted(unknown)}; "
+                         f"declared fields are {TICK_FIELDS}")
+    return jnp.stack([jnp.asarray(fields.get(f, 0), jnp.int32)
+                      for f in TICK_FIELDS])
+
+
+class ServingTelemetry:
+    """Host half of the in-tick pipeline: a bounded in-memory ring of
+    per-tick records (always on — tools and tests read it through
+    `ServingEngine.tick_records()`) plus an optional JSONL stream
+    drained by a background writer thread."""
+
+    def __init__(self, path: Optional[str] = None, every: int = 32,
+                 ring: int = 4096, meta: Optional[dict] = None):
+        if every < 1:
+            raise ValueError("every must be >= 1")
+        self.path = path
+        self.every = int(every)
+        self._ring: collections.deque = collections.deque(
+            maxlen=max(int(ring), 1))
+        self._pending: list = []
+        self._writer = None
+        if path:
+            from .telemetry import TelemetryWriter
+            self._writer = TelemetryWriter(path)
+            header = {"kind": "serving_run", "t": time.time(),
+                      "pid": os.getpid(), "fields": list(TICK_FIELDS)}
+            if meta:
+                header.update(meta)
+            self._writer.put([header])
+
+    # ------------------------------------------------------------ records
+    def record_tick(self, tick: int, dev_row, host: dict,
+                    dur_ms: float) -> None:
+        """One decode tick: `dev_row` is the pulled TICK_FIELDS int32
+        vector (None when the device fields are disabled), `host` the
+        scheduler-side fields, `dur_ms` the tick's wall time (device
+        dispatch + the shared pull)."""
+        rec = {"kind": "serving_tick", "tick": int(tick),
+               "t": time.time(), "dur_ms": round(float(dur_ms), 3)}
+        if dev_row is not None:
+            for f, v in zip(TICK_FIELDS, dev_row):
+                rec[f] = int(v)
+        rec.update(host)
+        self._push(rec)
+
+    def record_prefill(self, tick: int, dur_ms: float, chunk_len: int,
+                       bucket: int, final: bool, slot: int) -> None:
+        self._push({"kind": "serving_prefill", "tick": int(tick),
+                    "t": time.time(), "dur_ms": round(float(dur_ms), 3),
+                    "chunk_len": int(chunk_len), "bucket": int(bucket),
+                    "final": bool(final), "slot": int(slot)})
+
+    def _push(self, rec: dict) -> None:
+        self._ring.append(rec)
+        if self._writer is not None:
+            self._pending.append(rec)
+            if len(self._pending) >= self.every:
+                self._writer.put(self._pending)
+                self._pending = []
+
+    # ------------------------------------------------------------- access
+    def records(self) -> list:
+        """The in-memory ring (newest-last)."""
+        return list(self._ring)
+
+    def flush(self, timeout: Optional[float] = None) -> None:
+        """Push any pending batch and block until it is on disk (no-op
+        without a JSONL path)."""
+        if self._writer is None:
+            return
+        if self._pending:
+            self._writer.put(self._pending)
+            self._pending = []
+        self._writer.flush(timeout=timeout)
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self.flush(timeout=30)
+            self._writer.close()
+            self._writer = None

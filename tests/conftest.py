@@ -32,6 +32,23 @@ def _seed_all():
 
 
 @pytest.fixture(autouse=True)
+def _reset_monitor_registry():
+    """Cross-test isolation for the PROCESS-GLOBAL monitor registry —
+    the same fix PR 7 applied to the flight-recorder ring, hoisted to
+    conftest: counters/gauges/histograms accumulate across tests, so a
+    counter-delta assert could pass or fail depending on which files
+    ran before it (file-ordering poisoning). Zeroing every stat at
+    test START keeps cached handles valid (call sites hold Stat
+    objects, reset() only zeroes values) and leaves post-test state
+    inspectable on failure."""
+    import sys
+    mod = sys.modules.get("paddle_tpu.profiler.monitor")
+    if mod is not None:
+        mod.registry().reset()
+    yield
+
+
+@pytest.fixture(autouse=True)
 def _checkpoint_write_audit():
     """Integrity guard: every checkpoint save_sharded committed during a
     test must pass manifest checksum verification at teardown — an
@@ -78,6 +95,7 @@ SMOKE_FILES = {
     "test_multiprocess_loader.py", "test_inference.py", "test_int8.py",
     "test_serving.py", "test_serving_robustness.py", "test_paged_kv.py",
     "test_spec_decode.py", "test_tp_serving.py", "test_quant_serving.py",
+    "test_serving_observability.py",
     # high-level API + aux subsystems
     "test_hapi.py", "test_profiler.py", "test_checkpoint.py",
     "test_tokenizer.py", "test_misc_modules.py", "test_telemetry.py",
@@ -91,6 +109,11 @@ SMOKE_FILES = {
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "smoke: fast cross-subsystem slice (<5 min; see conftest)")
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 gate (`-m 'not "
+        "slow'` — the ROADMAP verify command); full-suite-only. For "
+        "redundant bench-style re-measurements on this noisy host, "
+        "not for unique coverage")
 
 
 def pytest_collection_modifyitems(config, items):

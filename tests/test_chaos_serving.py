@@ -29,7 +29,16 @@ def test_quick_drill_all_green():
     assert drill.run_drill(quick=True) == 0
 
 
+import pytest
+
+
+@pytest.mark.slow
 def test_guardrail_bench_stream_parity():
     """The overhead bench's correctness gate: guardrails-on and -off
-    engines produce identical streams (exit 0 = zero mismatches)."""
+    engines produce identical streams (exit 0 = zero mismatches).
+    Marked slow (full-suite-only): the quick drill above already
+    asserts bit-identical survivors per scenario, so this re-run of
+    the bench machinery is redundant in the tier-1 gate — it rebuilds
+    two 128d engines purely to re-check stream parity the drill
+    covers."""
     assert drill.bench_main(requests=4, gen=8, slots=2, repeats=1) == 0

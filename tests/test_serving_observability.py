@@ -1,0 +1,579 @@
+"""Serving-fleet observability: in-tick device telemetry, request-
+scoped tracing, SLO burn-rate alerts, roofline attribution, and the
+Histogram monitor kind.
+
+What this file pins (docs/observability.md "Serving"):
+- the TICK_FIELDS row rides the tick's ONE host pull (counted through
+  the `_pull` wrap) and adds ZERO traces, across dense/paged/spec/tp,
+  with streams bit-identical to telemetry-off;
+- a request's lifecycle exports as ONE parented span tree with exactly
+  one terminal span — including across router replica death/replay
+  (severed subtree + replay link);
+- burn rates follow the multiwindow error-budget math and alerts leave
+  flight dumps;
+- the cost-model ledger prices the tick per phase and the attribution
+  report joins it with measured ms.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models.gpt import GPTConfig, init_gpt_params
+from paddle_tpu.inference.serving import ServingEngine
+from paddle_tpu.profiler import monitor, tracing
+from paddle_tpu.profiler.serving_telemetry import TICK_FIELDS
+from paddle_tpu.profiler.slo import Alert, BurnRateMonitor, Objective
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools"))
+
+MAX_LEN = 64
+GEN = 6
+LENS = (5, 9, 13)
+
+
+def _gpt_cfg():
+    return GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                     num_heads=2, ffn_hidden=64, max_seq_len=128,
+                     sequence_parallel=False, remat=False,
+                     dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def gpt_setup():
+    cfg = _gpt_cfg()
+    return cfg, init_gpt_params(cfg, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def base_streams(gpt_setup):
+    """Telemetry-OFF reference streams for the default prompt set —
+    built once; every parity test below compares against these."""
+    cfg, params = gpt_setup
+    eng = ServingEngine(params, cfg, family="gpt", max_len=MAX_LEN,
+                        num_slots=3, telemetry="off")
+    return eng.generate(_prompts(), GEN)
+
+
+def _prompts(lens=LENS, seed=7):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, 60, L).astype(np.int32) for L in lens]
+
+
+def _engine(params, cfg, **kw):
+    kw.setdefault("num_slots", 3)
+    return ServingEngine(params, cfg, family="gpt", max_len=MAX_LEN,
+                         **kw)
+
+
+def _count_pulls(eng):
+    counts = [0]
+    orig = eng._pull
+
+    def counted(value, stall_s=0.0):
+        counts[0] += 1
+        return orig(value, stall_s)
+    eng._pull = counted
+    return counts
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    tracing.clear()
+    yield
+
+
+# --------------------------------------------------------------------------
+# Histogram monitor kind
+# --------------------------------------------------------------------------
+class TestHistogram:
+    def test_percentiles_exact_under_capacity(self):
+        h = monitor.histogram("t.hist.exact")
+        for v in range(1, 101):                 # 1..100
+            h.observe(v)
+        snap = h.value
+        assert snap["n"] == 100
+        assert snap["min"] == 1.0 and snap["max"] == 100.0
+        assert snap["p50"] == 50.0
+        assert snap["p95"] == 95.0
+        assert snap["p99"] == 99.0
+        assert abs(snap["mean"] - 50.5) < 1e-9
+
+    def test_reservoir_bounds_memory_exact_minmax(self):
+        from paddle_tpu.profiler.monitor import Histogram
+        h = Histogram("t.hist.res", reservoir=64)
+        for v in range(10_000):
+            h.observe(v)
+        assert len(h._samples) == 64            # bounded
+        snap = h.value
+        assert snap["n"] == 10_000              # counts stay exact
+        assert snap["min"] == 0.0 and snap["max"] == 9999.0
+        # reservoir percentiles are sampled but must be sane
+        assert 0 <= snap["p50"] <= 9999
+
+    def test_kind_conflict_and_reset(self):
+        monitor.histogram("t.hist.kind")
+        with pytest.raises(TypeError):
+            monitor.gauge("t.hist.kind")
+        h = monitor.histogram("t.hist.kind")
+        h.observe(5.0)
+        h.reset()
+        assert h.value == {"n": 0}
+
+    def test_snapshot_renders_dict(self):
+        monitor.histogram("t.hist.snap").observe(3.0)
+        snap = monitor.snapshot()
+        assert isinstance(snap["t.hist.snap"], dict)
+        assert snap["t.hist.snap"]["n"] == 1
+
+    def test_report_handles_histogram_stats(self, tmp_path):
+        from telemetry_report import summarize
+        monitor.histogram("serving.queue_wait_ms").observe(12.0)
+        monitor.counter("serving.tokens_emitted").add(5)
+        path = str(tmp_path / "t.jsonl")
+        monitor.registry().export_jsonl(path)
+        monitor.counter("serving.tokens_emitted").add(5)
+        monitor.registry().export_jsonl(path)
+        doc = summarize(path)
+        assert doc["serving"]["tokens_emitted"] == 5      # delta
+        assert doc["serving"]["queue_wait_ms"]["n"] == 1  # last dict
+
+
+# --------------------------------------------------------------------------
+# in-tick device telemetry
+# --------------------------------------------------------------------------
+class TestTickTelemetry:
+    def test_pulls_traces_fields_and_parity_dense(self, gpt_setup,
+                                                  base_streams):
+        """One engine, the core invariants: streams bit-identical to
+        telemetry-off, the field row rides the token pull (no extra
+        pulls), zero extra traces, and the field accounting holds."""
+        cfg, params = gpt_setup
+        eng = _engine(params, cfg)                # telemetry defaults on
+        assert eng._tick_tele
+        outs = eng.generate(_prompts(), GEN)      # warm
+        for a, b in zip(base_streams, outs):
+            assert np.array_equal(a, b)
+        warm = eng.trace_counts()
+        counts = _count_pulls(eng)
+        t0 = eng._ticks
+        n0 = len(eng.tick_records())
+        eng.generate(_prompts(), GEN)
+        ticks_n = eng._ticks - t0
+        # the telemetry row RIDES the token pull: one per tick + one
+        # per prefill, same as telemetry-off
+        assert counts[0] == ticks_n + len(LENS)
+        assert eng.trace_counts() == warm         # zero extra traces
+        recs = eng.tick_records()[n0:]
+        ticks = [r for r in recs if r["kind"] == "serving_tick"]
+        pre = [r for r in recs if r["kind"] == "serving_prefill"]
+        assert len(ticks) == ticks_n
+        assert set(TICK_FIELDS) <= set(ticks[0])
+        # every generated token is either a prefill first-token or a
+        # tick emission
+        assert sum(r["tokens"] for r in ticks) + len(pre) \
+            == len(LENS) * GEN
+        assert all(r["dur_ms"] >= 0 for r in ticks)
+        assert all(r["queue_depth"] >= 0 for r in ticks)
+        # attended grows with positions: the tap is per-tick work
+        assert ticks[0]["attended"] > 0
+
+    def test_paged_fields_and_parity(self, gpt_setup, base_streams):
+        cfg, params = gpt_setup
+        eng = _engine(params, cfg, kv_layout="paged", page_size=8,
+                      prefill_chunk=4)
+        outs = eng.generate(_prompts(), GEN)
+        for a, b in zip(base_streams, outs):
+            assert np.array_equal(a, b)
+        ticks = [r for r in eng.tick_records()
+                 if r["kind"] == "serving_tick"]
+        assert "pages_in_use" in ticks[0] and "prefilling" in ticks[0]
+        assert any(r["pages_in_use"] > 0 for r in ticks)
+        # chunked prefill interleaves with decode: some tick saw a
+        # mid-prefill slot
+        assert any(r["prefilling"] > 0 for r in ticks)
+
+    def test_spec_fields_and_parity(self, gpt_setup, base_streams):
+        cfg, params = gpt_setup
+        eng = _engine(params, cfg, spec_decode="spec", gamma=3,
+                      draft_layers=cfg.num_layers)
+        outs = eng.generate(_prompts(), GEN)
+        for a, b in zip(base_streams, outs):
+            assert np.array_equal(a, b)
+        ticks = [r for r in eng.tick_records()
+                 if r["kind"] == "serving_tick"]
+        prop = sum(r["spec_proposed"] for r in ticks)
+        acc = sum(r["spec_accepted"] for r in ticks)
+        assert prop > 0 and 0 <= acc <= prop
+        # device ledger == the engine's host acceptance ledger
+        assert prop == eng._spec_prop_total
+        assert acc == eng._spec_acc_total
+
+    def test_poisoned_field_counts_quarantine(self, gpt_setup):
+        from paddle_tpu.testing import faults
+        cfg, params = gpt_setup
+        eng = _engine(params, cfg)
+        faults.install("nan_logits@2:1")
+        try:
+            reqs = [eng.submit(p, GEN) for p in _prompts()]
+            eng.drain()
+        finally:
+            faults.uninstall()
+        assert [r.finish_reason for r in reqs].count("poisoned") == 1
+        ticks = [r for r in eng.tick_records()
+                 if r["kind"] == "serving_tick"]
+        assert sum(r["poisoned"] for r in ticks) == 1
+
+    def test_jsonl_stream_and_report(self, gpt_setup, tmp_path):
+        from telemetry_report import summarize
+        cfg, params = gpt_setup
+        path = str(tmp_path / "serve.jsonl")
+        eng = _engine(params, cfg, telemetry_jsonl=path,
+                      telemetry_every=4)
+        eng.generate(_prompts(), GEN)
+        eng.flush_telemetry(timeout=10)
+        doc = summarize(path)
+        blk = doc["serving_ticks"]
+        assert blk["ticks"] > 0 and blk["tokens"] > 0
+        assert blk["dur_ms_p50"] <= blk["dur_ms_p95"]
+        assert blk["prefills"] == len(LENS)
+        assert blk["engine"]["layout"] == "dense"
+
+    def test_env_kill_switch(self, gpt_setup, monkeypatch):
+        cfg, params = gpt_setup
+        monkeypatch.setenv("PADDLE_TPU_SERVING_TELEMETRY", "off")
+        eng = _engine(params, cfg, telemetry="on")
+        assert not eng._tick_tele
+        eng.generate(_prompts([5]), 3)
+        assert eng.tick_records() == []
+
+    def test_tp_parity_one_pull(self, gpt_setup, base_streams):
+        from paddle_tpu.parallel.mesh import build_mesh
+        cfg, params = gpt_setup
+        mesh = build_mesh({"tp": 2})
+        eng = _engine(params, cfg, mesh=mesh)
+        assert eng._tick_tele
+        counts = _count_pulls(eng)
+        outs = eng.generate(_prompts(), GEN)
+        for a, b in zip(base_streams, outs):
+            assert np.array_equal(a, b)
+        ticks = [r for r in eng.tick_records()
+                 if r["kind"] == "serving_tick"]
+        assert len(ticks) > 0
+        # one pull per tick per mesh, telemetry riding it
+        assert counts[0] == len(ticks) + len(LENS)
+
+
+# --------------------------------------------------------------------------
+# request-scoped tracing
+# --------------------------------------------------------------------------
+class TestRequestTracing:
+    def test_full_lifecycle_parented_chrome_trace(self, gpt_setup,
+                                                  tmp_path):
+        cfg, params = gpt_setup
+        # paged + chunked prefill: the lifecycle the acceptance names
+        # (submit -> chunked prefill -> decode ticks -> finish)
+        eng = _engine(params, cfg, tracing=True, kv_layout="paged",
+                      page_size=8, prefill_chunk=4,
+                      prefix_sharing=False)
+        req = eng.submit(_prompts([13])[0], GEN)
+        eng.drain()
+        assert req.done and req.finish_reason == "length"
+        tr = tracing.tracer()
+        spans = tr.spans(req.trace.trace_id)
+        by_name = {}
+        for s in spans:
+            by_name.setdefault(s.name, []).append(s)
+        root = by_name[f"request-{req.id}"][0]
+        assert root.parent_id is None
+        # queue -> prefill chunks -> decode, all parented at the root
+        assert len(by_name["prefill"]) >= 2        # 13 tokens / 4-chunks
+        for name in ("queue", "prefill", "decode"):
+            for s in by_name[name]:
+                assert s.parent_id == root.span_id
+        # decode ticks are instants under the decode span
+        decode_id = by_name["decode"][0].span_id
+        ticks = [s for s in spans if s.name == "decode.tick"]
+        assert len(ticks) == GEN
+        assert all(s.parent_id == decode_id for s in ticks)
+        # exactly one terminal span, reason attached
+        terms = tr.terminal_spans(req.trace.trace_id)
+        assert len(terms) == 1
+        assert terms[0].attrs["reason"] == "length"
+        # chrome export round-trips
+        path = str(tmp_path / "trace.json")
+        tr.export_chrome_trace(path)
+        doc = json.load(open(path))
+        evs = doc["traceEvents"]
+        assert any(e.get("cat") == "terminal" for e in evs)
+        assert any(e["ph"] == "X" and e["name"] == "prefill"
+                   for e in evs)
+
+    def test_terminal_reasons_cancel_timeout(self, gpt_setup):
+        cfg, params = gpt_setup
+        eng = _engine(params, cfg, num_slots=1, tracing=True)
+        ps = _prompts([5, 5, 5])
+        r0 = eng.submit(ps[0], GEN)
+        r1 = eng.submit(ps[1], GEN)
+        r2 = eng.submit(ps[2], GEN, deadline_ticks=1)
+        eng.step()
+        r1.cancel()
+        eng.drain()
+        tr = tracing.tracer()
+        for req, want in ((r0, "length"), (r1, "cancelled"),
+                          (r2, "timeout")):
+            terms = tr.terminal_spans(req.trace.trace_id)
+            assert len(terms) == 1, req
+            assert terms[0].attrs["reason"] == want
+
+    def test_router_death_severs_and_replays_once(self, gpt_setup):
+        from paddle_tpu.inference.router import create_router
+        cfg, params = gpt_setup
+        router = create_router(params, cfg, replicas=2, family="gpt",
+                               num_slots=2, max_len=MAX_LEN,
+                               concurrent=False, tracing=True)
+        reqs = [router.submit(p, GEN)
+                for p in _prompts((5, 9, 13, 4, 7, 11))]
+        # dispatch latency lands on the histogram (satellite: the
+        # last-write-wins gauge is gone)
+        h = monitor.histogram("serving.router.dispatch_ms").value
+        assert h["n"] >= 1 and h["p99"] >= h["p50"] >= 0.0
+        for _ in range(3):
+            router.step()
+        killed = router.kill_replica(0)
+        assert killed > 0
+        router.drain()
+        tr = tracing.tracer()
+        replayed = [r for r in reqs if r.requeues]
+        assert replayed
+        for r in reqs:
+            # EXACTLY one terminal span per request, replay or not
+            terms = tr.terminal_spans(r.trace.trace_id)
+            assert len(terms) == 1, r
+            assert terms[0].attrs["reason"] in ("length", "eos")
+        for r in replayed:
+            spans = tr.spans(r.trace.trace_id)
+            names = [s.name for s in spans]
+            # old tree closed (severed marks), replay linked, and the
+            # replayed attempt re-ran its prefill
+            assert "severed" in names and "replay" in names
+            severed = [s for s in spans if s.attrs.get("severed")]
+            assert severed, "no span closed by the sever"
+            replay = [s for s in spans if s.name == "replay"][0]
+            assert replay.attrs["attempt"] == 1
+            attempts = {s.attrs.get("attempt")
+                        for s in spans if s.name == "prefill"}
+            assert 1 in attempts
+        # zero-live-replica abort still terminates exactly once
+        router2 = create_router(params, cfg, replicas=1, family="gpt",
+                                num_slots=2, max_len=MAX_LEN,
+                                concurrent=False, tracing=True)
+        rq = router2.submit(_prompts([5])[0], GEN)
+        router2.step()
+        router2.kill_replica(0)
+        assert rq.done and rq.finish_reason == "evicted"
+        assert len(tr.terminal_spans(rq.trace.trace_id)) == 1
+
+
+# --------------------------------------------------------------------------
+# SLO burn-rate monitor
+# --------------------------------------------------------------------------
+class TestBurnRate:
+    def _mon(self, clock, **kw):
+        kw.setdefault("pairs", ((300.0, 30.0),))
+        kw.setdefault("cooldown_s", 0.0)
+        return BurnRateMonitor(
+            [Objective("ttft_p99", "ttft", "latency",
+                       threshold_ms=100.0, budget=0.1),
+             Objective("errors", "errors", "event", budget=0.01)],
+            clock=clock, **kw)
+
+    def test_burn_rate_math(self):
+        now = [1000.0]
+        mon = self._mon(lambda: now[0])
+        # 20 samples, 4 bad (> 100ms) -> bad_frac 0.2, budget 0.1 -> 2x
+        mon.observe_latency("ttft", [50.0] * 16 + [500.0] * 4)
+        assert mon.burn_rate("ttft_p99", 300.0) == pytest.approx(2.0)
+        # outside the short window the burn decays
+        now[0] += 60.0
+        assert mon.burn_rate("ttft_p99", 30.0) == 0.0
+        assert mon.burn_rate("ttft_p99", 300.0) == pytest.approx(2.0)
+
+    def test_multiwindow_gating_and_cooldown(self):
+        now = [1000.0]
+        mon = self._mon(lambda: now[0], cooldown_s=120.0)
+        # burn in the long window only (samples older than short):
+        mon.observe_latency("ttft", [500.0] * 10, t=now[0] - 60.0)
+        assert mon.check(flight=False) == []      # short window clean
+        # fresh burn trips BOTH windows
+        mon.observe_latency("ttft", [500.0] * 10)
+        alerts = mon.check(flight=False)
+        assert len(alerts) == 1
+        assert isinstance(alerts[0], Alert)
+        assert alerts[0].objective == "ttft_p99"
+        # cooldown: a sustained burn does not re-alert immediately
+        assert mon.check(flight=False) == []
+        now[0] += 121.0
+        mon.observe_latency("ttft", [500.0] * 10)
+        assert len(mon.check(flight=False)) == 1
+
+    def test_event_objective_counters_and_flight(self, tmp_path):
+        from paddle_tpu.profiler import flight_recorder
+        now = [1000.0]
+        mon = self._mon(lambda: now[0])
+        c0 = monitor.counter("slo.alerts").value
+        mon.observe_events("errors", bad=5, total=10)   # 50x budget
+        rec = flight_recorder.recorder()
+        rec.set_dir(str(tmp_path))
+        try:
+            alerts = mon.check()
+        finally:
+            rec.set_dir(None)
+        assert len(alerts) == 1
+        assert monitor.counter("slo.alerts").value == c0 + 1
+        assert monitor.counter("slo.alerts.errors").value >= 1
+        dumps = [f for f in os.listdir(tmp_path)
+                 if "slo_burn_alert" in f]
+        assert dumps
+        doc = flight_recorder.load_dump(
+            os.path.join(tmp_path, dumps[0]))
+        assert doc["reason"] == "slo_burn_alert"
+        assert doc["config"]["last_slo_alert"]["objective"] == "errors"
+
+    def test_feeds_engine_slo_records(self, gpt_setup, tmp_path):
+        cfg, params = gpt_setup
+        eng = _engine(params, cfg)
+        eng.generate(_prompts(), GEN)
+        path = str(tmp_path / "slo.jsonl")
+        eng.export_slo_jsonl(path)
+        mon = BurnRateMonitor(
+            [Objective("itl", "itl", "latency", threshold_ms=0.0001,
+                       budget=0.001)], pairs=((300.0, 30.0),))
+        with open(path) as f:
+            for line in f:
+                rec = json.loads(line)
+                if rec.get("kind") == "serving_slo":
+                    mon.feed_slo_record(rec)
+        # every real sample exceeds a 0.1us threshold: budget burns
+        assert mon.check(flight=False)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            Objective("x", "s", "nope")
+        with pytest.raises(ValueError):
+            Objective("x", "s", budget=0.0)
+        with pytest.raises(ValueError):
+            BurnRateMonitor([Objective("x", "s")], pairs=((5.0, 60.0),))
+        mon = BurnRateMonitor([Objective("x", "s")])
+        with pytest.raises(TypeError):
+            mon.observe_events("s", 1, 2)         # latency objective
+
+
+# --------------------------------------------------------------------------
+# roofline attribution
+# --------------------------------------------------------------------------
+class TestAttribution:
+    def test_ledger_phases_and_quant(self):
+        from paddle_tpu.cost_model import (roofline_attribution,
+                                           serving_tick_ledger)
+        cfg = _gpt_cfg()
+        fp = serving_tick_ledger(cfg, active=4, attended=100,
+                                 max_len=MAX_LEN)
+        q = serving_tick_ledger(cfg, quant="int8", active=4,
+                                attended=100, max_len=MAX_LEN)
+        assert fp["total"]["flops"] > 0 and fp["total"]["bytes"] > 0
+        # int8 cuts the weight stream, adds a dequant epilogue
+        assert q["phases"]["matmuls"]["bytes"] \
+            < 0.5 * fp["phases"]["matmuls"]["bytes"]
+        assert q["phases"]["dequant"]["flops"] > 0
+        assert fp["phases"]["dequant"]["flops"] == 0
+        # the kv view prices the implementation, bytes_ideal the mask
+        kv = fp["phases"]["kv_gather"]
+        assert kv["bytes"] > kv["bytes_ideal"] > 0
+        # the tick is fixed-shape: dispatched work scales with
+        # num_slots, not active occupancy (useful columns keep the gap)
+        part = serving_tick_ledger(cfg, active=2, attended=100,
+                                   num_slots=8, max_len=MAX_LEN)
+        assert part["phases"]["kv_gather"]["bytes"] == pytest.approx(
+            2 * fp["phases"]["kv_gather"]["bytes"])   # 8 rows vs 4
+        assert part["phases"]["kv_gather"]["bytes_ideal"] \
+            == fp["phases"]["kv_gather"]["bytes_ideal"]
+        att = part["phases"]["attention"]
+        assert 0 < att["flops_useful"] < att["flops"]
+        roof = roofline_attribution(fp)
+        shares = sum(p["share"] for p in roof["per_phase"].values())
+        assert shares == pytest.approx(1.0, abs=2e-3)
+        assert roof["roofline_s"] > 0
+
+    def test_spec_ledger_adds_draft_passes(self):
+        from paddle_tpu.cost_model import serving_tick_ledger
+        cfg = _gpt_cfg()
+        non = serving_tick_ledger(cfg, active=2, attended=50,
+                                  max_len=MAX_LEN)
+        spec = serving_tick_ledger(cfg, spec=True, gamma=4,
+                                   draft_layers=1, active=2,
+                                   attended=50, max_len=MAX_LEN)
+        assert spec["total"]["flops"] > non["total"]["flops"]
+        assert spec["total"]["bytes"] > non["total"]["bytes"]
+
+    def test_measure_layout_joins_telemetry(self, gpt_setup):
+        import serving_attrib
+        cfg, params = gpt_setup
+        row = serving_attrib.measure_layout(
+            "dense_fp", params, cfg, _prompts(), 4, MAX_LEN,
+            {"num_slots": 3}, None, None)
+        assert row["ticks"] > 0
+        assert row["measured_ms_per_tick_p50"] > 0
+        assert row["roofline_ms_per_tick"] > 0
+        assert 0 < row["achieved_vs_roofline"]
+        assert set(row["phases"]) == {"matmuls", "attention",
+                                      "kv_gather", "dequant", "head"}
+        assert serving_attrib.render_table([row])
+
+
+# --------------------------------------------------------------------------
+# fleet report
+# --------------------------------------------------------------------------
+class TestFleetReport:
+    def test_router_fan_out_and_fleet_merge(self, gpt_setup, tmp_path):
+        """create_router fans telemetry_jsonl out per replica and
+        summarize_fleet merges the per-replica files: balance,
+        fleet-wide percentiles over the union of samples, burn-rate
+        summary."""
+        from paddle_tpu.inference.router import create_router
+        from telemetry_report import summarize_fleet
+        cfg, params = gpt_setup
+        base = str(tmp_path / "fleet.jsonl")
+        router = create_router(params, cfg, replicas=2, family="gpt",
+                               num_slots=2, max_len=MAX_LEN,
+                               concurrent=False, telemetry_jsonl=base,
+                               telemetry_every=1)
+        n_req = 4
+        router.generate(_prompts((5, 9, 13, 4)), 4)
+        paths = []
+        for i, rep in enumerate(router.replicas):
+            p = f"{base}.r{i}"
+            rep.eng.flush_telemetry(timeout=10)
+            rep.eng.export_slo_jsonl(p)
+            assert os.path.isfile(p)
+            paths.append(p)
+        doc = summarize_fleet(paths, ttft_slo_ms=1e9, itl_slo_ms=1e9)
+        assert doc["replicas"] == 2
+        assert len(doc["per_replica"]) == 2
+        # tick emissions only: each request's FIRST token rides its
+        # serving_prefill record, the other gen-1 ride serving_ticks
+        assert doc["tokens_total"] == sum(
+            r["tokens"] for r in doc["per_replica"]) == n_req * (4 - 1)
+        assert doc["balance"]["tokens"] == [6, 6]   # JSQ split 2/2
+        assert doc["fleet"]["ttft"]["n"] == n_req
+        assert doc["fleet"]["inter_token"]["n"] > 0
+        # generous objectives -> no burn
+        br = doc["burn_rate"]["burn_rates"]
+        assert all(v == 0.0 for w in br.values() for v in w.values())
+        assert doc["burn_rate"]["alerts"] == []

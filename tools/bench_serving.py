@@ -77,6 +77,7 @@ import argparse
 import json
 import os
 import sys
+import tempfile
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
@@ -713,6 +714,83 @@ def tp_main(args):
     return 0 if ok else 1
 
 
+def telemetry_main(args):
+    """--telemetry-overhead: the same workload through an engine with
+    in-tick telemetry OFF (the PR-4..9 tick shape) and ON (the
+    TICK_FIELDS row riding the token pull + the host-side record ring
+    + a live JSONL stream). Timed passes ALTERNATE between the two
+    warm engines and each side reports its best — the PR-5 paired
+    best-of-N methodology (host noise exceeds the effect). One JSON
+    line — the BASELINE.md "Serving observability" row."""
+    from paddle_tpu.models.decode import next_pow2
+    from paddle_tpu.inference.serving import ServingEngine
+
+    gen = args.gen
+    max_len = args.max_len or next_pow2(args.prompt_hi + gen)
+    params, cfg = _build_family(args, max_len)
+    prompts = build_workload(args.requests, args.prompt_lo,
+                             args.prompt_hi, args.vocab)
+    total = args.requests * gen
+    tele_path = os.environ.get("PADDLE_TPU_TELEMETRY_JSONL") or \
+        os.path.join(tempfile.mkdtemp(prefix="bench_tele_"),
+                     "serve.jsonl")
+    _log(f"telemetry A/B: {args.requests} reqs, gen {gen}, "
+         f"{args.family} {args.layers}Lx{args.hidden}d -> {tele_path}")
+
+    def build(**kw):
+        eng = ServingEngine(params, cfg, family=args.family,
+                            num_slots=args.slots, max_len=max_len, **kw)
+        warm = eng.generate(prompts, gen)         # compile everything
+        return eng, warm
+
+    eng_off, warm_off = build(telemetry="off")
+    eng_on, warm_on = build(telemetry="on", telemetry_jsonl=tele_path)
+    mismatch = sum(1 for a, b in zip(warm_off, warm_on)
+                   if not np.array_equal(a, b))
+    best_off = best_on = 1e18
+    repeats = 3
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        outs = eng_off.generate(prompts, gen)
+        best_off = min(best_off, time.perf_counter() - t0)
+        mismatch += sum(1 for a, b in zip(warm_off, outs)
+                        if not np.array_equal(a, b))
+        t0 = time.perf_counter()
+        outs = eng_on.generate(prompts, gen)
+        best_on = min(best_on, time.perf_counter() - t0)
+        mismatch += sum(1 for a, b in zip(warm_off, outs)
+                        if not np.array_equal(a, b))
+    eng_on.flush_telemetry()
+    eng_on.export_slo_jsonl(tele_path)
+    ticks = [r for r in eng_on.tick_records()
+             if r["kind"] == "serving_tick"]
+    tps_off, tps_on = total / best_off, total / best_on
+    overhead = (tps_off - tps_on) / tps_off * 100.0
+    try:
+        from telemetry_report import summarize
+        parseable = bool(summarize(tele_path).get("serving_ticks"))
+    except Exception:
+        parseable = False
+    print(json.dumps({
+        "metric": "serving_telemetry_overhead",
+        "value": round(overhead, 2),
+        "unit": "%",
+        "backend": jax.devices()[0].platform,
+        "tokens_per_sec_telemetry_off": round(tps_off, 1),
+        "tokens_per_sec_telemetry_on": round(tps_on, 1),
+        "requests": args.requests, "gen": gen, "slots": args.slots,
+        "repeats": repeats,
+        "model": f"{args.layers}Lx{args.hidden}d",
+        "family": args.family,
+        "decode_traces": [eng_off.trace_counts()[0],
+                          eng_on.trace_counts()[0]],
+        "tick_records": len(ticks),
+        "jsonl_parseable": parseable,
+        "stream_mismatches": mismatch,
+    }), flush=True)
+    return 0 if mismatch == 0 and parseable else 1
+
+
 def router_main(args):
     """--router R: aggregate tokens/s through the replicated-engine
     router (inference/router.py) vs ONE engine at the same per-replica
@@ -750,9 +828,11 @@ def router_main(args):
     base_outs = single.generate(prompts, gen)
     base_s = time.perf_counter() - t0
 
+    tele_path = os.environ.get("PADDLE_TPU_TELEMETRY_JSONL")
     router = create_router(params, cfg, replicas=args.router,
                            family=args.family, num_slots=args.slots,
-                           max_len=max_len)
+                           max_len=max_len,
+                           telemetry_jsonl=tele_path)  # fans out .r<i>
     router.generate(prompts, gen)                # warm
     # snapshot the (process-global) dispatch counters so the reported
     # balance covers the MEASURED pass only, not the warm run
@@ -767,9 +847,25 @@ def router_main(args):
     disp = [r["dispatched"] - d0
             for r, d0 in zip(st["per_replica"], disp0)]
     scaling = base_s / rt_s
-    tele_path = os.environ.get("PADDLE_TPU_TELEMETRY_JSONL")
+    fleet = None
     if tele_path:
         monitor.registry().export_jsonl(tele_path)
+        # per-replica serving JSONLs (tick stream + SLO samples) ->
+        # the fleet aggregate report (telemetry_report --fleet)
+        paths = []
+        for i, rep in enumerate(router.replicas):
+            p = f"{tele_path}.r{i}"
+            rep.eng.flush_telemetry()
+            rep.eng.export_slo_jsonl(p)
+            paths.append(p)
+        try:
+            from telemetry_report import summarize_fleet
+            fleet = summarize_fleet(paths)
+            _log("fleet: " + json.dumps(
+                {k: fleet[k] for k in ("balance", "fleet", "burn_rate")
+                 if k in fleet}))
+        except Exception as e:
+            _log(f"fleet report failed: {e}")
     print(json.dumps({
         "metric": "serving_router_tokens_per_sec",
         "value": round(total_tokens / rt_s, 1),
@@ -784,6 +880,7 @@ def router_main(args):
         "dispatched_per_replica": disp,
         "replicas_live": st["replicas_live"],
         "stream_mismatches": mismatches,
+        "fleet_balance": None if fleet is None else fleet.get("balance"),
     }), flush=True)
     return 0 if mismatches == 0 else 1
 
@@ -835,6 +932,9 @@ def main():
                          "engines (inference/router.py) vs one engine")
     ap.add_argument("--kv-layout", choices=("auto", "dense", "paged"),
                     default="auto", help="--tp: cache layout under test")
+    ap.add_argument("--telemetry-overhead", action="store_true",
+                    help="A/B in-tick telemetry off vs on (paired "
+                         "best-of-3, bit-parity checked)")
     args = ap.parse_args()
     if args.tp and args.tp != _TP:
         ap.error("--tp was read pre-init for the CPU pin; don't "
@@ -847,6 +947,8 @@ def main():
         return router_main(args)          # sizes its own default
     if args.requests is None:
         args.requests = 16
+    if args.telemetry_overhead:
+        return telemetry_main(args)
     if args.capacity:
         return capacity_main(args)
     if args.chunk_slo:

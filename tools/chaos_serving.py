@@ -73,6 +73,16 @@ cache"):
                    rolls back (shared refcounts released), retry
                    succeeds, the sharer's stream stays exact
 
+Observability requirements (every scenario, the PR-3 "parseable black
+box" pattern extended to serving): a parseable serving-telemetry JSONL
+with >= 1 serving_tick record (profiler/serving_telemetry — engines in
+scenarios stream to <scenario>/telemetry.jsonl) and >= 1 COMPLETE
+request trace (queue + prefill + decode + exactly one terminal span,
+profiler/tracing). The nan_logits and router_replica_death scenarios
+additionally feed their outcome into an SLO burn-rate monitor
+(profiler/slo) with a tight error budget and require the alert to fire
+AND leave a parseable slo_burn_alert flight dump.
+
 Usage:
   python tools/chaos_serving.py            # the full drill
   python tools/chaos_serving.py --quick    # smaller workload (CI)
@@ -123,10 +133,31 @@ def build_workload(n, lo, hi, vocab, seed=0):
     return [rng.randint(0, vocab, L).astype(np.int32) for L in lens]
 
 
+# per-scenario observability context: every engine a scenario builds
+# streams its serving_tick JSONL into the scenario dir and emits
+# request-scoped traces, and the drill REQUIRES both to be present and
+# parseable (the PR-3 "chaos requires a parseable black box" pattern
+# extended to serving telemetry + traces)
+_SCEN = {"tele": None, "engines": []}
+
+
 def make_engine(params, cfg, max_len, **kw):
     from paddle_tpu.inference.serving import ServingEngine
     kw.setdefault("num_slots", 3)
-    return ServingEngine(params, cfg, family="gpt", max_len=max_len, **kw)
+    kw.setdefault("telemetry_jsonl", _SCEN["tele"])
+    kw.setdefault("tracing", True)
+    eng = ServingEngine(params, cfg, family="gpt", max_len=max_len, **kw)
+    _SCEN["engines"].append(eng)
+    return eng
+
+
+def make_router(params, cfg, max_len, **kw):
+    from paddle_tpu.inference.router import create_router
+    router = create_router(params, cfg, max_len=max_len, tracing=True,
+                           telemetry_jsonl=_SCEN["tele"], **kw)
+    for rep in router.replicas:
+        _SCEN["engines"].append(rep.eng)
+    return router
 
 
 # ------------------------------------------------------------ checking
@@ -172,13 +203,16 @@ def check_traces(eng):
     return None
 
 
-def check_flight(fdir):
-    """Invariant 3a: eventful faults leave a parseable black box."""
+def check_flight(fdir, want_reason=None):
+    """Invariant 3a: eventful faults leave a parseable black box.
+    `want_reason` additionally requires a dump whose reason matches
+    (e.g. the SLO monitor's "slo_burn_alert")."""
     from paddle_tpu.profiler.flight_recorder import load_dump
     names = sorted(f for f in (os.listdir(fdir) if os.path.isdir(fdir)
                                else []) if f.endswith(".json"))
     if not names:
         return f"no flight dump under {fdir}"
+    reasons = set()
     for name in names:
         try:
             doc = load_dump(os.path.join(fdir, name))
@@ -186,7 +220,72 @@ def check_flight(fdir):
             return f"flight dump {name} unparseable: {e}"
         if "monitor" not in doc:
             return f"flight dump {name}: no monitor snapshot"
+        reasons.add(doc.get("reason"))
+    if want_reason is not None and want_reason not in reasons:
+        return (f"no {want_reason!r} flight dump (reasons: "
+                f"{sorted(r for r in reasons if r)})")
     return None
+
+
+def check_telemetry(tele_path):
+    """Observability invariant A: every scenario leaves a parseable
+    serving-telemetry JSONL with >= 1 serving_tick record (router
+    scenarios fan out to <path>.r<i> — any replica's file counts)."""
+    import glob
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from telemetry_report import summarize
+    paths = sorted(glob.glob(tele_path + "*"))
+    if not paths:
+        return f"no serving-telemetry JSONL at {tele_path}*"
+    ticks = 0
+    for p in paths:
+        try:
+            doc = summarize(p)
+        except Exception as e:                     # noqa: BLE001
+            return f"telemetry JSONL {p} unparseable: {e}"
+        ticks += (doc.get("serving_ticks") or {}).get("ticks", 0)
+    if ticks == 0:
+        return f"no serving_tick records under {tele_path}*"
+    return None
+
+
+def check_request_trace():
+    """Observability invariant B: >= 1 COMPLETE request trace — a
+    span tree with queue + prefill + decode spans and EXACTLY one
+    terminal span (profiler/tracing; the scenario cleared the tracer
+    on entry, so these traces are its own)."""
+    from paddle_tpu.profiler import tracing
+    tr = tracing.tracer()
+    seen = 0
+    for tid in tr.trace_ids():
+        spans = tr.spans(tid)
+        names = {s.name for s in spans}
+        terms = [s for s in spans if s.kind == "terminal"]
+        if len(terms) > 1:
+            return f"trace {tid} has {len(terms)} terminal spans"
+        if len(terms) == 1 and {"queue", "prefill", "decode"} <= names:
+            seen += 1
+    if not seen:
+        return ("no complete request trace "
+                "(queue+prefill+decode+terminal)")
+    return None
+
+
+def check_burn_alert(fdir, stream, bad, total):
+    """Observability invariant C (nan_logits / router_replica_death):
+    feeding the scenario's outcome into an SLO burn-rate monitor with
+    a tight error budget fires an alert, and the alert leaves a
+    parseable slo_burn_alert flight dump."""
+    from paddle_tpu.profiler.slo import BurnRateMonitor, Objective
+    mon = BurnRateMonitor(
+        [Objective(f"{stream}_rate", stream, "event", budget=0.001)],
+        pairs=((60.0, 5.0),), cooldown_s=0.0)
+    mon.observe_events(stream, bad=bad, total=total)
+    alerts = mon.check()
+    if not alerts:
+        return (f"burn-rate monitor fired no alert for {bad}/{total} "
+                f"bad {stream} events at budget 0.001")
+    return check_flight(fdir, want_reason="slo_burn_alert")
 
 
 # ------------------------------------------------------------ the drill
@@ -219,11 +318,15 @@ def run_drill(quick: bool = False, keep_root: bool = False) -> int:
     rec = flight_recorder.recorder()
 
     def scenario(name, body, spec=None, want_flight=True):
+        from paddle_tpu.profiler import tracing
         sdir = os.path.join(root, name)
         fdir = os.path.join(sdir, "flight")
         os.makedirs(fdir, exist_ok=True)
         rec.clear()
         rec.set_dir(fdir)
+        tracing.clear()
+        _SCEN["tele"] = os.path.join(sdir, "telemetry.jsonl")
+        _SCEN["engines"] = []
         if spec:
             faults.install(spec, once_dir=os.path.join(sdir, "once"))
         t0 = time.time()
@@ -232,9 +335,21 @@ def run_drill(quick: bool = False, keep_root: bool = False) -> int:
         finally:
             if spec:
                 faults.uninstall()
+            for eng in _SCEN["engines"]:
+                try:
+                    eng.flush_telemetry(timeout=10)
+                except Exception:                  # noqa: BLE001
+                    pass
+            tele_path, _SCEN["tele"] = _SCEN["tele"], None
+            _SCEN["engines"] = []
             rec.set_dir(None)
         if err is None and want_flight:
             err = check_flight(fdir)
+        # every scenario must leave a parseable serving-telemetry
+        # JSONL and >= 1 complete request trace (the PR-3 black-box
+        # requirement extended to the serving observability layer)
+        if err is None:
+            err = check_telemetry(tele_path) or check_request_trace()
         tag = "FAIL" if err else "ok"
         _log(f"{name:<28} {tag}  ({time.time() - t0:.1f}s)")
         if err:
@@ -248,8 +363,15 @@ def run_drill(quick: bool = False, keep_root: bool = False) -> int:
         reasons = [r.finish_reason for r in reqs]
         if reasons.count("poisoned") != 1:
             return f"expected exactly one poisoned request: {reasons}"
-        return (check_terminal(reqs) or check_streams(reqs, baseline)
-                or check_traces(eng))
+        err = (check_terminal(reqs) or check_streams(reqs, baseline)
+               or check_traces(eng))
+        if err:
+            return err
+        # the poisoned finish burns the error budget: the SLO monitor
+        # must alert and leave a parseable slo_burn_alert flight dump
+        fdir = os.path.join(root, "nan_logits@2:1", "flight")
+        return check_burn_alert(fdir, "errors",
+                                reasons.count("poisoned"), len(reqs))
     scenario("nan_logits@2:1", nan_body, spec="nan_logits@2:1")
 
     # --- tick_stall: watchdog budget/backoff recovery ----------------
@@ -464,12 +586,11 @@ def run_drill(quick: bool = False, keep_root: bool = False) -> int:
 
     # --- router: replica death mid-decode ----------------------------
     def replica_death():
-        from paddle_tpu.inference.router import create_router
         from paddle_tpu.inference.serving import TERMINAL_REASONS
         r0 = monitor.counter("serving.router.requeues").value
-        router = create_router(params, cfg, replicas=2, family="gpt",
-                               num_slots=3, max_len=max_len,
-                               concurrent=False)   # deterministic drill
+        router = make_router(params, cfg, max_len, replicas=2,
+                             family="gpt", num_slots=3,
+                             concurrent=False)     # deterministic drill
         reqs = [router.submit(p, gen) for p in prompts]
         for _ in range(3):
             router.step()                 # streams mid-decode on BOTH
@@ -505,7 +626,28 @@ def run_drill(quick: bool = False, keep_root: bool = False) -> int:
             return f"expected 1 live replica: {st}"
         # the survivor's engine must hold its trace ceilings through
         # the requeue wave (migration costs no recompiles)
-        return check_traces(router.replicas[1].eng)
+        err = check_traces(router.replicas[1].eng)
+        if err:
+            return err
+        # trace-context propagation across the death: the replayed
+        # requests' traces carry a severed subtree + a replay link and
+        # still end in EXACTLY one terminal span
+        from paddle_tpu.profiler import tracing as _tracing
+        tr = _tracing.tracer()
+        replayed = [r for r in reqs if r.requeues]
+        for r in replayed:
+            spans = tr.spans(r.trace.trace_id)
+            names = [s.name for s in spans]
+            if "severed" not in names or "replay" not in names:
+                return (f"request {r.id}: replayed trace lacks "
+                        f"severed/replay marks: {sorted(set(names))}")
+            terms = [s for s in spans if s.kind == "terminal"]
+            if len(terms) != 1:
+                return (f"request {r.id}: {len(terms)} terminal "
+                        "spans after replay")
+        # the requeue churn burns the budget: alert + parseable dump
+        fdir = os.path.join(root, "router_replica_death", "flight")
+        return check_burn_alert(fdir, "requeues", killed, len(reqs))
     scenario("router_replica_death", replica_death)
 
     # --- cancel + deadlines ------------------------------------------

@@ -12,9 +12,18 @@ ms/step = (t_flush[i] - t_flush[i-1]) / n[i]. The first flush window
 absorbs the jit compile and is excluded from the percentiles (it is
 reported separately as compile_window_ms_per_step).
 
+Fleet mode (`--fleet`): merge multiple per-replica serving JSONLs
+(a router's engines each stream their own `<path>.r<i>` —
+inference/router.create_router) into ONE aggregate report: per-replica
+balance (ticks/tokens/throughput per file), fleet-wide TTFT /
+inter-token percentiles over the union of samples, and an SLO
+burn-rate summary (profiler/slo) against the --ttft-slo-ms /
+--itl-slo-ms objectives. tools/bench_serving.py --router drives it.
+
 Usage:
   python tools/telemetry_report.py RUN.jsonl          # one JSON line
   python tools/telemetry_report.py RUN.jsonl --pretty
+  python tools/telemetry_report.py --fleet R.jsonl.r0 R.jsonl.r1 ...
 """
 from __future__ import annotations
 
@@ -54,6 +63,9 @@ def summarize(path: str, samples_per_step: Optional[float] = None) -> dict:
     monitors = []
     events = []
     slo_ttft, slo_itl = [], []   # serving SLO samples (serving_slo recs)
+    srv_run = {}                 # serving_run header (engine layout)
+    srv_ticks = []               # in-tick serving telemetry records
+    srv_prefills = []
     bad_lines = 0
     with open(path) as f:
         for line in f:
@@ -84,6 +96,12 @@ def summarize(path: str, samples_per_step: Optional[float] = None) -> dict:
             elif kind == "serving_slo":
                 slo_ttft.extend(rec.get("ttft_ms") or [])
                 slo_itl.extend(rec.get("itl_ms") or [])
+            elif kind == "serving_run":
+                srv_run = rec
+            elif kind == "serving_tick":
+                srv_ticks.append(rec)
+            elif kind == "serving_prefill":
+                srv_prefills.append(rec)
 
     out = {"path": path, "run": {k: v for k, v in run.items()
                                  if k not in ("kind",)},
@@ -144,12 +162,17 @@ def summarize(path: str, samples_per_step: Optional[float] = None) -> dict:
                  or (r.get("ok") is not None and r.get("ok") == 0.0)]
     out["bad_steps"] = [r["step"] for r in nonfinite][:32]
 
-    # ---- monitor counter deltas (first vs last snapshot) ----
+    # ---- monitor counter deltas (first vs last snapshot).
+    # Histogram stats render as dicts (profiler/monitor.Histogram
+    # snapshots {"n","p50","p95","p99",...}) — they report their LAST
+    # snapshot, not a delta ----
     if monitors:
         first, last = monitors[0]["stats"], monitors[-1]["stats"]
         out["monitor"] = last
         out["monitor_delta"] = {
-            k: round(last[k] - first.get(k, 0), 6)
+            k: (last[k] if isinstance(last[k], dict)
+                or isinstance(first.get(k, 0), dict)
+                else round(last[k] - first.get(k, 0), 6))
             for k in sorted(last) if last[k] != first.get(k, 0)}
 
     # ---- 3D training plan (parallel/planner.plan_train publishes the
@@ -207,11 +230,17 @@ def summarize(path: str, samples_per_step: Optional[float] = None) -> dict:
     # counter, grouped under serving.quant when any of them moved
     _QUANT = ("quant_weights_bytes", "fp_weights_bytes",
               "quant_matmuls")
+    def _stat_val(k, last_s, first_s):
+        # gauges and histograms (dict snapshots) report last value;
+        # counters report the first-to-last delta
+        v = last_s[k]
+        if _is_gauge(k) or isinstance(v, dict) \
+                or isinstance(first_s.get(k, 0), dict):
+            return v
+        return v - first_s.get(k, 0)
     if monitors:
         first_s, last_s = monitors[0]["stats"], monitors[-1]["stats"]
-        srv = {k[len("serving."):]:
-               (last_s[k] if _is_gauge(k)
-                else last_s[k] - first_s.get(k, 0))
+        srv = {k[len("serving."):]: _stat_val(k, last_s, first_s)
                for k in sorted(last_s) if k.startswith("serving.")}
         if srv:
             dtok = srv.get("tokens_emitted", 0)
@@ -258,6 +287,38 @@ def summarize(path: str, samples_per_step: Optional[float] = None) -> dict:
         if slo_itl:
             srv["inter_token"] = _slo_pcts(slo_itl)
 
+    # ---- in-tick serving telemetry (profiler/serving_telemetry
+    # serving_tick / serving_prefill records: the per-tick device
+    # fields riding the token pull + tick wall ms) ----
+    if srv_ticks:
+        dur = sorted(r.get("dur_ms", 0.0) for r in srv_ticks)
+        blk = {
+            "ticks": len(srv_ticks),
+            "tokens": sum(r.get("tokens") or 0 for r in srv_ticks),
+            "dur_ms_p50": round(_percentile(dur, 50), 3),
+            "dur_ms_p95": round(_percentile(dur, 95), 3),
+            "mean_active": round(sum(r.get("active") or 0
+                                     for r in srv_ticks)
+                                 / len(srv_ticks), 2),
+            "poisoned": sum(r.get("poisoned") or 0 for r in srv_ticks),
+        }
+        att = [r["attended"] for r in srv_ticks if "attended" in r]
+        if att:
+            blk["mean_attended"] = round(sum(att) / len(att), 1)
+        prop = sum(r.get("spec_proposed") or 0 for r in srv_ticks)
+        if prop:
+            acc = sum(r.get("spec_accepted") or 0 for r in srv_ticks)
+            blk["spec_accept_rate"] = round(acc / prop, 3)
+        if srv_prefills:
+            pdur = sorted(r.get("dur_ms", 0.0) for r in srv_prefills)
+            blk["prefills"] = len(srv_prefills)
+            blk["prefill_ms_p50"] = round(_percentile(pdur, 50), 3)
+        if srv_run:
+            blk["engine"] = {k: srv_run[k] for k in
+                             ("family", "layout", "spec", "quant", "tp")
+                             if k in srv_run}
+        out["serving_ticks"] = blk
+
     # ---- event timeline ----
     if events:
         t0 = events[0]["t"]
@@ -268,16 +329,131 @@ def summarize(path: str, samples_per_step: Optional[float] = None) -> dict:
     return out
 
 
+def summarize_fleet(paths, ttft_slo_ms: float = 1000.0,
+                    itl_slo_ms: float = 200.0,
+                    error_budget: float = 0.01) -> dict:
+    """Merge per-replica serving JSONLs (router + N engines) into one
+    fleet report: per-replica balance, fleet-wide SLO percentiles over
+    the UNION of samples, and the burn-rate summary against the given
+    objectives (profiler/slo — the whole-file span is treated as one
+    window, so the summary answers "did this run burn its budget",
+    not "when")."""
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:            # script-mode: tools/ is path[0]
+        sys.path.insert(0, repo)
+    from paddle_tpu.profiler.slo import BurnRateMonitor, Objective
+
+    per_replica = []
+    all_ttft, all_itl = [], []
+    tick_ts = []
+    total_tokens = 0
+    for path in paths:
+        doc = summarize(path)
+        blk = doc.get("serving_ticks") or {}
+        ttft = (doc.get("serving") or {}).get("ttft") or {}
+        row = {"path": path,
+               "ticks": blk.get("ticks", 0),
+               "tokens": blk.get("tokens", 0),
+               "dur_ms_p50": blk.get("dur_ms_p50"),
+               "mean_active": blk.get("mean_active"),
+               "ttft_n": ttft.get("n", 0)}
+        per_replica.append(row)
+        total_tokens += row["tokens"]
+        # re-read the raw SLO samples (summarize only keeps pcts)
+        with open(path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("kind") == "serving_slo":
+                    all_ttft.extend(rec.get("ttft_ms") or [])
+                    all_itl.extend(rec.get("itl_ms") or [])
+                elif rec.get("kind") == "serving_tick":
+                    tick_ts.append(rec.get("t", 0.0))
+
+    def _pcts(vals):
+        if not vals:
+            return None
+        ordered = sorted(vals)
+        return {"n": len(vals),
+                "p50_ms": round(_percentile(ordered, 50), 3),
+                "p95_ms": round(_percentile(ordered, 95), 3),
+                "p99_ms": round(_percentile(ordered, 99), 3)}
+
+    out = {"replicas": len(paths),
+           "per_replica": per_replica,
+           "tokens_total": total_tokens}
+    if per_replica and total_tokens:
+        toks = [r["tokens"] for r in per_replica]
+        out["balance"] = {"tokens": toks,
+                          "imbalance": round(
+                              (max(toks) - min(toks))
+                              / max(max(toks), 1), 3)}
+    fleet = {}
+    if all_ttft:
+        fleet["ttft"] = _pcts(all_ttft)
+    if all_itl:
+        fleet["inter_token"] = _pcts(all_itl)
+    if fleet:
+        out["fleet"] = fleet
+
+    # burn-rate summary: one window spanning the run
+    span = (max(tick_ts) - min(tick_ts) + 1.0) if tick_ts else 60.0
+    now = max(tick_ts) if tick_ts else None
+    mon = BurnRateMonitor(
+        [Objective("ttft_p99", "ttft", "latency",
+                   threshold_ms=ttft_slo_ms, budget=error_budget),
+         Objective("itl_p99", "itl", "latency",
+                   threshold_ms=itl_slo_ms, budget=error_budget)],
+        pairs=((span + 1.0, span / 2 + 0.5),))
+    t_mid = now if now is not None else None
+    if all_ttft:
+        mon.observe_latency("ttft", all_ttft, t=t_mid)
+    if all_itl:
+        mon.observe_latency("itl", all_itl, t=t_mid)
+    alerts = mon.check(now=now, flight=False)
+    out["burn_rate"] = {
+        "objectives": {"ttft_slo_ms": ttft_slo_ms,
+                       "itl_slo_ms": itl_slo_ms,
+                       "error_budget": error_budget},
+        "window_s": round(span, 1),
+        "burn_rates": mon.burn_rates(now),
+        "alerts": [a.to_dict() for a in alerts]}
+    return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("jsonl", help="telemetry JSONL file")
+    ap.add_argument("jsonl", nargs="*", help="telemetry JSONL file(s)")
     ap.add_argument("--pretty", action="store_true")
     ap.add_argument("--samples-per-step", type=float, default=None,
                     help="items per step for ips (overrides the run "
                          "header)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="merge the given per-replica serving JSONLs "
+                         "into one aggregate fleet report")
+    ap.add_argument("--ttft-slo-ms", type=float, default=1000.0,
+                    help="--fleet: TTFT latency objective")
+    ap.add_argument("--itl-slo-ms", type=float, default=200.0,
+                    help="--fleet: inter-token latency objective")
+    ap.add_argument("--error-budget", type=float, default=0.01,
+                    help="--fleet: allowed bad-sample fraction")
     args = ap.parse_args()
+    if not args.jsonl:
+        ap.error("need at least one JSONL path")
     try:
-        doc = summarize(args.jsonl, samples_per_step=args.samples_per_step)
+        if args.fleet:
+            doc = summarize_fleet(args.jsonl,
+                                  ttft_slo_ms=args.ttft_slo_ms,
+                                  itl_slo_ms=args.itl_slo_ms,
+                                  error_budget=args.error_budget)
+        else:
+            if len(args.jsonl) != 1:
+                ap.error("multiple JSONLs need --fleet")
+            doc = summarize(args.jsonl[0],
+                            samples_per_step=args.samples_per_step)
     except OSError as e:
         print(f"cannot read {args.jsonl}: {e}", file=sys.stderr)
         return 2
