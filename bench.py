@@ -70,14 +70,14 @@ PEAK_FLOPS = [
 
 def train_flops_per_token(n_params: int, num_layers: int,
                           hidden_size: int, seq: int) -> float:
-    """ONE home for the train-step MFU accounting: 6N matmul FLOPs per
-    token (fwd+bwd) plus the attention score/context matmul term. The
-    plan3d rung (tools/bench_plan3d.py), the sharded-step ablation rows
-    (tools/ablate_step.py) and the campaign's sweep plausibility gate
-    (tools/tpu_campaign.py) all price against THIS formula, so their
-    MFU/evidence rows stay comparable with the BENCH_window best_tpu
-    rows — adjust it here and every consumer moves together."""
-    return 6.0 * n_params + 12.0 * num_layers * hidden_size * seq
+    """The train-step MFU accounting — ONE home, which since the MFU
+    observatory PR is paddle_tpu.cost_model.train_flops_per_token (the
+    train ledger and the telemetry `train.mfu` gauge price against it
+    too). This re-export keeps the historical bench.py import surface
+    for the tools (ablate_step, tpu_campaign, bench_plan3d); the import
+    is deferred so the orchestrator process stays framework-light."""
+    from paddle_tpu.cost_model import train_flops_per_token as _f
+    return _f(n_params, num_layers, hidden_size, seq)
 
 
 def _peak_for(device_kind: str, platform: str) -> float:
@@ -438,10 +438,31 @@ def best_tpu(here: str = None) -> dict | None:
     return max(recs, key=lambda r: r.get("value", 0)) if recs else None
 
 
+def _probe_note(info: dict) -> None:
+    """Make the probe OUTCOME observable (the r05 lesson: a dead-tunnel
+    window and a regression look identical in a bare BENCH_* history):
+    a `bench.tpu_probe.alive|dead` monitor counter + `bench.tpu_probe_ms`
+    gauge (import-light — profiler.monitor pulls no jax) and a flight-
+    recorder note (no-op without PADDLE_TPU_FLIGHT_DIR). Failures here
+    must never kill the orchestrator."""
+    try:
+        from paddle_tpu.profiler import monitor
+        monitor.counter("bench.tpu_probe."
+                        + ("alive" if info["alive"] else "dead")).add()
+        monitor.gauge("bench.tpu_probe_ms").set(info["ms"])
+        from paddle_tpu.profiler import flight_recorder
+        flight_recorder.note(kind="bench.tpu_probe", **info)
+    except Exception as e:            # observability is best-effort
+        _log(f"probe note failed (non-fatal): {e!r}")
+
+
 def _probe_tpu(here: str, tries: int = 2, timeout_s: int = 360,
-               first_timeout_s: int = 120) -> bool:
+               first_timeout_s: int = 120) -> dict:
     """Cheap bounded check that the TPU tunnel is alive before committing
-    to the long TPU-rung timeouts.
+    to the long TPU-rung timeouts. Returns the probe RECORD —
+    {"alive", "ms", "attempts", "outcome"} — which main() stamps into
+    the emitted JSON line (`tpu_probe`) so every BENCH_* artifact says
+    whether its CPU fallback happened under a dead tunnel.
 
     Tunnel-down economics (BENCH_r05 tail burned 2x360 s here before the
     CPU fallback even started): a LIVE tunnel answers a probe in seconds,
@@ -451,9 +472,18 @@ def _probe_tpu(here: str, tries: int = 2, timeout_s: int = 360,
     fast non-zero exits (a transient init error with the tunnel up).
     `PADDLE_TPU_SKIP_TPU_PROBE=1` skips probing altogether — straight to
     the CPU rungs (CI / known-dead-tunnel runs)."""
+    t0 = time.perf_counter()
+
+    def record(alive: bool, attempts: int, outcome: str) -> dict:
+        info = {"alive": alive, "attempts": attempts,
+                "outcome": outcome,
+                "ms": round((time.perf_counter() - t0) * 1e3, 1)}
+        _probe_note(info)
+        return info
+
     if os.environ.get("PADDLE_TPU_SKIP_TPU_PROBE") == "1":
         _log("PADDLE_TPU_SKIP_TPU_PROBE=1: skipping TPU probe")
-        return False
+        return record(False, 0, "skipped")
     code = "import jax; print('PROBE', jax.devices()[0].platform)"
     for i in range(tries):
         t_s = first_timeout_s if i == 0 else timeout_s
@@ -465,22 +495,25 @@ def _probe_tpu(here: str, tries: int = 2, timeout_s: int = 360,
                  + ("; dead-tunnel signature, not retrying"
                     if i == 0 else ""))
             if i == 0:
-                return False
+                return record(False, 1, "timeout")
             continue
         out = res.stdout.decode()
         if res.returncode == 0 and "PROBE" in out:
             platform = out.split("PROBE", 1)[1].strip().split()[0]
             _log(f"TPU probe: platform={platform}")
-            return platform in ("tpu", "axon")
+            return record(platform in ("tpu", "axon"), i + 1,
+                          f"platform={platform}")
         _log(f"TPU probe {i + 1}/{tries} failed (rc={res.returncode})")
-    return False
+    return record(False, tries, "all attempts failed")
 
 
 def main() -> None:
     here = os.path.dirname(os.path.abspath(__file__))
     ladder = LADDER
-    if not _probe_tpu(here):
-        _log("no live TPU backend; skipping TPU rungs")
+    probe = _probe_tpu(here)
+    if not probe["alive"]:
+        _log("no live TPU backend; skipping TPU rungs "
+             f"(probe: {probe['outcome']}, {probe['ms']} ms)")
         ladder = [c for c in LADDER if not c[0].startswith("tpu")]
     for name, _, _, _, _, timeout_s in ladder:
         # TPU rungs get a 2nd, shorter attempt that also disables Pallas —
@@ -520,6 +553,10 @@ def main() -> None:
                 except json.JSONDecodeError:
                     _log(f"rung '{name}' emitted unparseable stdout")
                     continue
+                # the probe record rides every emitted line (and the
+                # BENCH_window artifact), so the history distinguishes
+                # dead-tunnel fallbacks from regressions
+                rec["tpu_probe"] = probe
                 if rec.get("backend") in ("tpu", "axon"):
                     record_window("bench", rec, here)
                 else:

@@ -19,6 +19,21 @@ with measured per-tick milliseconds (the in-tick telemetry stream,
 profiler/serving_telemetry) into the achieved-vs-roofline report — the
 measurement half of the MFU campaign that works on the CPU rung while
 the TPU tunnel is down.
+
+Train-step ledger (`train_step_ledger`): the training-side analog for
+ONE planned dp×fsdp×tp train step (parallel/planner.plan_train) —
+forward matmuls/attention, backward at 2x, remat recompute as its own
+phase, the AdamW/AMP update over the stacked params, LM head + loss,
+PLUS one collective phase per mesh axis (fsdp all-gather/reduce-
+scatter, dp grad all-reduce, tp per-layer activation all-reduces)
+priced against ChipSpec.ici_bw instead of HBM bandwidth (phases carry
+`channel: "ici"`; `roofline_attribution` picks the right denominator).
+The collective byte formulas mirror parallel/planner._estimate exactly
+(same _ring_factor model), so a plan's ledger cross-checks against the
+planner's breakdown — and `train_flops_per_token` lives HERE as the
+one home of the 6N MFU accounting (bench.py re-exports it; the
+profiler/telemetry `train.mfu` gauge and tools/train_attrib.py price
+against it).
 """
 from __future__ import annotations
 
@@ -218,30 +233,242 @@ def serving_tick_ledger(cfg, family: str = "gpt",
 
 
 def roofline_attribution(ledger: dict, peak_flops: float = None,
-                         hbm_bw: float = None, chip=None) -> dict:
-    """Price a serving_tick_ledger against a chip roofline: per phase,
-    the bound time is max(flops/peak, bytes/bw) and the binding side
-    names itself; the attribution column is each phase's share of the
-    summed bound time. `chip` defaults to parallel.planner.ChipSpec
-    (the same numbers plan_serving_tp prices with)."""
-    if peak_flops is None or hbm_bw is None:
+                         hbm_bw: float = None, ici_bw: float = None,
+                         chip=None) -> dict:
+    """Price a serving_tick_ledger or train_step_ledger against a chip
+    roofline: per phase, the bound time is max(flops/peak, bytes/bw)
+    and the binding side names itself; the attribution column is each
+    phase's share of the summed bound time. Phases carrying
+    `channel: "ici"` (the train ledger's collective phases) price their
+    bytes against the interconnect bandwidth instead of HBM. `chip`
+    defaults to parallel.planner.ChipSpec (the same numbers
+    plan_serving_tp / plan_train price with).
+
+    Train ledgers additionally report `predicted_step_ms` (the summed
+    per-chip bound time) and `peak_mfu` — the MFU ceiling of the plan:
+    useful model FLOPs per chip (ledger `model_flops` / n_devices) over
+    predicted time, as a fraction of `peak_flops`. That ceiling is what
+    the measured `train.mfu` gauge is chased against."""
+    if peak_flops is None or hbm_bw is None or ici_bw is None:
         from .parallel.planner import ChipSpec
         chip = chip or ChipSpec()
         peak_flops = peak_flops or chip.peak_flops
         hbm_bw = hbm_bw or chip.hbm_bw
+        ici_bw = ici_bw or chip.ici_bw
     per_phase = {}
     for name, p in ledger["phases"].items():
+        bw = ici_bw if p.get("channel") == "ici" else hbm_bw
         t_c = p["flops"] / peak_flops
-        t_b = p["bytes"] / hbm_bw
+        t_b = p["bytes"] / bw
         per_phase[name] = {
             "flops": p["flops"], "bytes": p["bytes"],
             "bound_s": max(t_c, t_b),
-            "bound": "compute" if t_c >= t_b else "bandwidth"}
+            "bound": "compute" if t_c >= t_b else (
+                "ici" if p.get("channel") == "ici" else "bandwidth")}
     total_s = sum(p["bound_s"] for p in per_phase.values())
     for p in per_phase.values():
         p["share"] = round(p["bound_s"] / total_s, 4) if total_s else 0.0
-    return {"per_phase": per_phase, "roofline_s": total_s,
-            "peak_flops": peak_flops, "hbm_bw": hbm_bw}
+    out = {"per_phase": per_phase, "roofline_s": total_s,
+           "peak_flops": peak_flops, "hbm_bw": hbm_bw, "ici_bw": ici_bw}
+    model_flops = ledger.get("model_flops")
+    if model_flops:
+        n_dev = (ledger.get("config") or {}).get("n_devices", 1)
+        out["predicted_step_ms"] = total_s * 1e3
+        out["peak_mfu"] = round(
+            model_flops / n_dev / total_s / peak_flops, 6) if total_s \
+            else None
+    return out
+
+
+# --------------------------------------------------------------------
+# train-step ledger (tools/train_attrib.py's pricing half)
+# --------------------------------------------------------------------
+def train_flops_per_token(n_params: int, num_layers: int,
+                          hidden_size: int, seq: int) -> float:
+    """ONE home for the train-step MFU accounting: 6N matmul FLOPs per
+    token (fwd+bwd) plus the attention score/context matmul term.
+    bench.py re-exports this; the plan3d rung (tools/bench_plan3d.py),
+    the sharded-step ablation rows (tools/ablate_step.py), the
+    campaign's sweep plausibility gate (tools/tpu_campaign.py) and the
+    telemetry `train.mfu` gauge all price against THIS formula, so
+    their MFU/evidence rows stay comparable with the BENCH_window
+    best_tpu rows — adjust it here and every consumer moves together."""
+    return 6.0 * n_params + 12.0 * num_layers * hidden_size * seq
+
+
+# fraction of the FORWARD flops recomputed in the backward, by remat
+# policy (mirrors parallel/planner._estimate's remat_extra table)
+_REMAT_RECOMPUTE = {"full": 1.0 / 3.0, "dots": 0.15, "dots_flash": 0.1,
+                    "offload_dots": 0.2, "all_but_mlp": 0.12,
+                    "none": 0.0}
+
+
+def _plan_degrees(plan) -> dict:
+    """Normalize a plan argument — parallel.planner.TrainPlan, Plan,
+    a {axis: degree} dict, or None (single device) — to the 3D degrees
+    the train ledger prices."""
+    if plan is None:
+        return {"dp": 1, "fsdp": 1, "tp": 1}
+    if hasattr(plan, "axes"):                      # TrainPlan
+        axes = dict(plan.axes)
+        return {"dp": int(axes.get("dp", 1)),
+                "fsdp": int(axes.get("fsdp", 1)),
+                "tp": int(axes.get("tp", axes.get("mp", 1)))}
+    if hasattr(plan, "dp"):                        # priced Plan row
+        return {"dp": int(plan.dp), "fsdp": int(plan.fsdp),
+                "tp": int(plan.mp)}
+    axes = dict(plan)
+    return {"dp": int(axes.get("dp", 1)),
+            "fsdp": int(axes.get("fsdp", 1)),
+            "tp": int(axes.get("tp", axes.get("mp", 1)))}
+
+
+def train_step_ledger(cfg, family: str = "gpt", plan=None,
+                      global_batch: int = 8, seq: int = 0,
+                      remat=None, amp: bool = False,
+                      dtype_bytes: int = 0) -> dict:
+    """Per-chip, per-phase FLOPs/bytes for ONE planned train step.
+
+    The serving ledger's design carried to training: closed-form over
+    the model dims (cost_analysis undercounts the layer scan), split
+    into the phases an operator can act on, and priced for the work
+    each CHIP dispatches under the plan's dp×fsdp×tp degrees — the
+    batch shards over dp×fsdp (`tok_local`), the head/ffn dims over tp,
+    the optimizer state over fsdp×tp, and fsdp's gathered weights still
+    STREAM full-size per tp shard (ZeRO shards storage, not compute).
+    Phases:
+
+    - fwd_matmul:    2·P_layer FLOPs/token over the stacked block
+      matmuls (_family_dims mats); bytes = one weight stream per step
+      in the compute dtype;
+    - fwd_attention: QK^T + PV (4·D·S per token per layer, heads
+      folded — the planner's non-causal form);
+    - bwd:           2x the forward (dgrad + wgrad), weight stream
+      re-read twice;
+    - remat:         the recompute fraction of the forward by policy
+      (_REMAT_RECOMPUTE) as its OWN phase — recompute adds FLOPs, not
+      bytes, which is the whole point of remat and a pinned test
+      property;
+    - optimizer:     the fused AdamW update over this chip's param
+      shard (f32 master math, ~12 FLOPs/elem; +2 under `amp` for the
+      master-cast + scale epilogue); bytes = read p/m/v/grad + write
+      p/m/v, all f32;
+    - head_loss:     LM head fwd+bwd (vocab-parallel over tp) + the
+      fused-CE logit stream (f32, two passes: lse + target gather);
+    - coll_tp / coll_dp / coll_fsdp: one phase PER MESH AXIS, bytes
+      from the planner's exact formulas (_ring_factor model: tp = 4
+      activation all-reduces per layer, dp = one grad all-reduce of
+      the f32 shard, fsdp = ~3 all-gather-sized moves), `channel:
+      "ici"` so roofline_attribution prices them against
+      ChipSpec.ici_bw. Degree-1 axes price to zero.
+
+    `remat` overrides the config's policy (True/False or a policy
+    name); `dtype_bytes` is the compute/activation width (default 2
+    under `amp`, else the cfg dtype's width, else 4). `model_flops`
+    carries the 6N useful-work numerator (train_flops_per_token ·
+    global tokens) for the MFU columns downstream."""
+    dims = _family_dims(cfg, family)
+    D, L, V, F = dims["D"], dims["L"], dims["V"], dims["F"]
+    S = int(seq or cfg.max_seq_len)
+    deg = _plan_degrees(plan)
+    dp, fsdp, tp = deg["dp"], deg["fsdp"], deg["tp"]
+    n_devices = dp * fsdp * tp
+    if remat is None:
+        policy = (getattr(cfg, "remat_policy", "full") or "full") \
+            if getattr(cfg, "remat", False) else "none"
+    elif isinstance(remat, str):
+        policy = remat
+    else:
+        policy = ((getattr(cfg, "remat_policy", "full") or "full")
+                  if remat else "none")
+    if policy not in _REMAT_RECOMPUTE:
+        raise ValueError(f"unknown remat policy {policy!r} "
+                         f"({sorted(_REMAT_RECOMPUTE)})")
+    if not dtype_bytes:
+        dtype_bytes = 2 if amp else jnp_dtype_bytes(
+            getattr(cfg, "dtype", None))
+
+    tokens = float(global_batch) * S
+    # integer clamp mirrors planner._estimate's b_local exactly — a
+    # non-divisible or oversharded batch must price the same tokens the
+    # planner (and the padded execution) pays, not a fractional row
+    tok_local = float(max(int(global_batch) // (dp * fsdp), 1) * S)
+    # total params: stacked blocks + embeddings (wte + wpe) — matches
+    # planner.ModelSpec.total_params so the collective cross-check is
+    # exact
+    n_params = (dims["layer_params"] * L
+                + (V + int(cfg.max_seq_len)) * D)
+    w_stream = dims["layer_params"] * L * dtype_bytes / tp
+
+    fwd_matmul = {
+        "flops": 2.0 * dims["layer_params"] * L * tok_local / tp,
+        "bytes": w_stream,
+    }
+    fwd_attention = {
+        "flops": 4.0 * D * S * L * tok_local / tp,
+        "bytes": 0.0,
+    }
+    fwd_flops = fwd_matmul["flops"] + fwd_attention["flops"]
+    bwd = {"flops": 2.0 * fwd_flops, "bytes": 2.0 * w_stream}
+    remat_phase = {"flops": _REMAT_RECOMPUTE[policy] * fwd_flops,
+                   "bytes": 0.0}
+    opt_elems = n_params / (tp * fsdp)
+    optimizer = {
+        "flops": (14.0 if amp else 12.0) * opt_elems,
+        "bytes": 28.0 * opt_elems,      # r p/m/v/grad + w p/m/v, f32
+    }
+    head_loss = {
+        "flops": 3.0 * 2.0 * D * V * tok_local / tp,
+        "bytes": (3.0 * D * V * dtype_bytes + 2.0 * tok_local * V * 4.0)
+                 / tp,
+    }
+    # ---- collective phases (planner._estimate formulas, per chip) ----
+    from .parallel.planner import _ring_factor
+    coll_tp = {
+        "flops": 0.0, "channel": "ici",
+        "bytes": (_ring_factor(tp) * 4.0 * L * tok_local * D
+                  * dtype_bytes if tp > 1 else 0.0),
+    }
+    coll_dp = {
+        "flops": 0.0, "channel": "ici",
+        "bytes": _ring_factor(dp) * (n_params / (tp * fsdp)) * 4.0,
+    }
+    coll_fsdp = {
+        "flops": 0.0, "channel": "ici",
+        "bytes": (3.0 * (fsdp - 1) / fsdp * (n_params / tp)
+                  * dtype_bytes if fsdp > 1 else 0.0),
+    }
+    phases = {"fwd_matmul": fwd_matmul, "fwd_attention": fwd_attention,
+              "bwd": bwd, "remat": remat_phase, "optimizer": optimizer,
+              "head_loss": head_loss, "coll_tp": coll_tp,
+              "coll_dp": coll_dp, "coll_fsdp": coll_fsdp}
+    total = {
+        "flops": sum(p["flops"] for p in phases.values()),
+        "bytes": sum(p["bytes"] for p in phases.values()
+                     if p.get("channel") != "ici"),
+        "coll_bytes": sum(p["bytes"] for p in phases.values()
+                          if p.get("channel") == "ici"),
+    }
+    return {
+        "phases": phases, "total": total,
+        "model_flops": train_flops_per_token(n_params, L, D, S) * tokens,
+        "tokens": tokens,
+        "config": {"family": family, "plan": dict(deg),
+                   "n_devices": n_devices, "global_batch": global_batch,
+                   "seq": S, "remat": policy, "amp": bool(amp),
+                   "dtype_bytes": dtype_bytes, "n_params": n_params}}
+
+
+def jnp_dtype_bytes(dtype, default: int = 4) -> int:
+    """Byte width of a jnp/np dtype-ish, without importing jax at module
+    load (cost_model must stay import-light for the tools)."""
+    if dtype is None:
+        return default
+    try:
+        import numpy as np
+        return int(np.dtype(dtype).itemsize)
+    except Exception:
+        return default
 
 
 def rank_parallel_plans(model, n_devices, global_batch, **kw):

@@ -177,8 +177,21 @@ class _ShardedTrainStep:
         import jax
         args = (params, opt_state, batch) + rest
         if self._jit is None:
+            # first call = build + GSPMD compile + run: time it and
+            # publish train.compile.* (docs/observability.md) so a
+            # run's telemetry stream records what warmup cost next to
+            # the trace_count zero-recompile observable (and the
+            # hlo_audit's train.compile.audit_ms)
+            import time
+            from ..profiler import monitor
+            t0 = time.perf_counter()
             self._build(args)
             args = self.shard_args(*args)
+            out = self._jit(*args)
+            monitor.gauge("train.compile.wall_ms").set(
+                round((time.perf_counter() - t0) * 1e3, 3))
+            monitor.counter("train.compile.executables").add()
+            return out
         else:
             # steady state: params/opt arrive as the previous call's
             # pinned outputs; the batch (and any scalar extras like the

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.mesh import get_mesh, constraint as mesh_constraint
+from ..utils.compat import pcast
 from .facade import FacadeModel
 
 
@@ -383,7 +384,7 @@ def _apply_stack(stacked, x, cfg: GPTConfig):
                     return (h2, aux + aux_l), None
                 # runs inside the pp-manual shard_map: the zero init must be
                 # marked device-varying to match the scan's carry vma type
-                aux0 = jax.lax.pcast(jnp.zeros((), jnp.float32), "pp",
+                aux0 = pcast(jnp.zeros((), jnp.float32), "pp",
                                      to="varying")
                 (h, aux), _ = jax.lax.scan(body_fn, (h, aux0), chunk_params)
                 return h, aux

@@ -123,10 +123,13 @@ def _is_sharded_on(value, axes) -> bool:
 
 def _shmap(fn, mesh, axes, in_specs, out_specs):
     # check_vma=True: partial-manual shard_map with check_vma=False is
-    # broken in jax 0.9 (see parallel/pipeline.py)
-    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                         out_specs=out_specs, axis_names=set(axes),
-                         check_vma=True)
+    # broken in jax 0.9 (see parallel/pipeline.py). Resolved through
+    # utils.compat so older jax (no jax.shard_map alias) translates to
+    # the experimental spelling instead of AttributeError-ing.
+    from ..utils.compat import shard_map
+    return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, axis_names=set(axes),
+                     check_vma=True)
 
 
 @functools.lru_cache(maxsize=256)

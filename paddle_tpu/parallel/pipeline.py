@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P, NamedSharding
 
 from .mesh import get_mesh
+from ..utils.compat import pcast
 
 
 def bubble_fraction(n_stages: int, n_microbatches: int,
@@ -104,7 +105,7 @@ def spmd_pipeline(stage_fn: Callable, n_stages: int, n_microbatches: int,
             # stage 0 ingests microbatch t (clamped); every other stage
             # keeps its circulating activation
             idx = jnp.clip(t, 0, n_microbatches - 1)
-            inject = jax.lax.pcast(
+            inject = pcast(
                 jax.lax.dynamic_index_in_dim(x_mb, idx, 0, keepdims=False),
                 axis_name, to="varying")
             inp = jnp.where(stage == 0, inject, state)
@@ -141,7 +142,7 @@ def spmd_pipeline(stage_fn: Callable, n_stages: int, n_microbatches: int,
         # pcast-to-varying: carries are device-varying over pp from tick one,
         # and scan/cond require carry vma types to be invariant
         def vary(z):
-            return jax.lax.pcast(z, axis_name, to="varying")
+            return pcast(z, axis_name, to="varying")
 
         state0 = vary(jnp.zeros(mb_shape, x_mb.dtype))
         outputs0 = vary(jnp.zeros((n_microbatches,) + mb_shape, x_mb.dtype))
@@ -186,15 +187,29 @@ def pipeline_forward(stage_fn, stacked_params, x_mb, n_stages,
     body = stage_fn
     if remat:
         body = jax.checkpoint(stage_fn)
+    # argument validation (the interleave rejection) still fires on
+    # every build — the capability gate below only guards the
+    # shard_map lowering itself
     piped = spmd_pipeline(body, n_stages, n_microbatches,
                           interleave=interleave, with_aux=with_aux)
+    from ..utils.compat import spmd_pipeline_supported
+    if not spmd_pipeline_supported():
+        # partial-auto shard_map (pp manual, dp/mp under GSPMD) FATALLY
+        # aborts legacy XLA's partitioner — refuse cleanly instead of
+        # taking the whole process down (utils/compat.py; the dryrun
+        # degrades to layer-weight pp sharding on these builds)
+        raise NotImplementedError(
+            "the SPMD pipeline needs partial-auto shard_map, which "
+            "this jax/XLA build cannot partition "
+            "(utils.compat.spmd_pipeline_supported)")
     param_specs = jax.tree_util.tree_map(lambda _: P("pp"), stacked_params)
     # check_vma=True is load-bearing: partial-manual shard_map with
     # check_vma=False is broken in jax 0.9 (its internal _unmatch builds a
     # spec over ALL mesh axes and rejects itself). The masked-psum output
     # broadcast makes the result genuinely replicated over pp, so the vma
     # check passes.
-    sm = jax.shard_map(
+    from ..utils.compat import shard_map
+    sm = shard_map(
         piped, mesh=mesh,
         in_specs=(param_specs, P()),
         out_specs=(P(), P()) if with_aux else P(),

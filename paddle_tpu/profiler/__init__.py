@@ -386,7 +386,7 @@ def __getattr__(name):
     # (serving_telemetry / tracing / slo are jax-free but ride the same
     # lazy seam so the profiler package stays import-light)
     if name in ("telemetry", "flight_recorder", "serving_telemetry",
-                "tracing", "slo"):
+                "tracing", "slo", "hlo_audit"):
         import importlib
         return importlib.import_module(f".{name}", __name__)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

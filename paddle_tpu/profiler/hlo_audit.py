@@ -1,0 +1,257 @@
+"""GSPMD collective audit: what the compiled train step REALLY moves.
+
+Reference analog: the auto_parallel cost-model validation pass
+(python/paddle/distributed/auto_parallel/static/cost/base_cost.py
+pricing comm ops op-by-op over the lowered program) + the profiler's
+distributed view. TPU-native collapse: GSPMD inserts the collectives
+during XLA SPMD partitioning, BELOW the StableHLO the jax tracer emits
+(`pir.get_stablehlo` shows sharding annotations, not collectives) — so
+the audit lowers the ACTUAL sharded step (`jax.jit(...).lower(...)
+.compile().as_text()`, the same seam `profiler.cost_analysis` reads
+its flop counts from) and parses the post-partitioning HLO for
+all-gather / all-reduce / reduce-scatter / collective-permute /
+all-to-all ops, sizing each from its result shape and mapping its
+replica groups back onto the plan's mesh axes.
+
+The diff against the plan's EXPECTED schedule is the product: a
+dp×fsdp×tp plan should pay tp activation all-reduces, fsdp gathers/
+scatters (or contraction all-reduces — GSPMD may choose either
+spelling of ZeRO-3), and dp(×fsdp) gradient reductions. Anything else
+— a collective-permute, an op on an axis combination no phase of the
+cost_model.train_step_ledger prices — is a RESHARDING collective the
+partitioner inserted involuntarily (XLA logs these as "Involuntary
+full rematerialization"), i.e. a silent MFU killer, and surfaces as a
+named audit finding instead of an unexplained slow step.
+
+Static-count caveat: collectives inside a `while` (the stacked-layer
+scan) appear ONCE in the HLO text but execute once per trip — counts
+and bytes here are per-appearance, the schedule-shape signal, not a
+wall-clock integral. Compile wall-ms and audit counts publish as
+`train.compile.*` monitor stats next to the facade's `trace_count`.
+"""
+from __future__ import annotations
+
+import itertools
+import re
+import time
+from typing import Dict, List, Optional, Tuple
+
+from . import monitor
+
+COLLECTIVE_OPS = ("all-gather", "all-reduce", "reduce-scatter",
+                  "collective-permute", "all-to-all")
+
+_DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2,
+                "f16": 2, "bf16": 2, "s32": 4, "u32": 4, "f32": 4,
+                "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16}
+
+# `%name = <result-type> <op>(`; async forms appear as `<op>-start`
+_OP_RE = re.compile(
+    r"=\s+((?:\([^)]*\)|\S+))\s+(" + "|".join(COLLECTIVE_OPS)
+    + r")(-start)?\(")
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+_GROUPS_RE = re.compile(
+    r"replica_groups=(\{\{[\d,{} ]*\}\}|\[[\d,]+\]<=\[[\d,]+\]"
+    r"(?:T\([\d,]+\))?)")
+
+
+def _type_bytes(type_str: str, async_start: bool = False) -> int:
+    """Total bytes of an HLO result type (tuples summed). Async
+    `<op>-start` ops return an (operands..., results...) tuple — count
+    only the results half, or the same schedule would audit 2x the
+    bytes of its sync spelling."""
+    sizes = []
+    for dt, dims in _SHAPE_RE.findall(type_str):
+        if dt not in _DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        sizes.append(n * _DTYPE_BYTES[dt])
+    if async_start and len(sizes) >= 2 and len(sizes) % 2 == 0:
+        sizes = sizes[len(sizes) // 2:]
+    return sum(sizes)
+
+
+def _parse_groups(spec: str) -> List[Tuple[int, ...]]:
+    """Replica groups from either HLO spelling: literal
+    ``{{0,2},{1,3}}`` or iota ``[G,S]<=[dims]T(perm)`` (devices =
+    arange(prod(dims)).reshape(dims).transpose(perm).reshape(G, S))."""
+    if spec.startswith("{"):
+        return [tuple(int(x) for x in grp.split(",") if x.strip())
+                for grp in re.findall(r"\{([\d, ]+)\}", spec[1:-1])
+                if grp.strip()]
+    m = re.match(r"\[([\d,]+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?", spec)
+    if not m:
+        return []
+    import numpy as np
+    out_dims = [int(x) for x in m.group(1).split(",")]
+    src_dims = [int(x) for x in m.group(2).split(",")]
+    ids = np.arange(int(np.prod(src_dims))).reshape(src_dims)
+    if m.group(3):
+        ids = ids.transpose([int(x) for x in m.group(3).split(",")])
+    ids = ids.reshape(out_dims)
+    return [tuple(int(x) for x in row) for row in ids]
+
+
+def _axis_groupings(mesh_axes: Dict[str, int]) -> Dict[frozenset, tuple]:
+    """Map {frozenset of device-id groups -> mesh-axis combination}:
+    for each axis subset, the groups that vary exactly those axes while
+    fixing the rest (linear ids row-major over the mesh shape — jax's
+    device order for a build_mesh mesh). Smallest subset wins when
+    degree-1 axes make combinations degenerate."""
+    import numpy as np
+    names = [n for n in mesh_axes]
+    sizes = [int(mesh_axes[n]) for n in names]
+    ids = np.arange(int(np.prod(sizes))).reshape(sizes)
+    out: Dict[frozenset, tuple] = {}
+    idxs = [i for i, s in enumerate(sizes) if s > 1]
+    for r in range(1, len(idxs) + 1):
+        for combo in itertools.combinations(idxs, r):
+            keep = [a for a in range(len(names)) if a not in combo]
+            g = np.transpose(ids, keep + list(combo)).reshape(
+                -1, int(np.prod([sizes[a] for a in combo])))
+            key = frozenset(frozenset(int(x) for x in row) for row in g)
+            out.setdefault(key, tuple(names[a] for a in combo))
+    return out
+
+
+def parse_hlo_collectives(hlo_text: str,
+                          mesh_axes: Optional[Dict[str, int]] = None
+                          ) -> List[dict]:
+    """Every collective op in a post-partitioning HLO module text:
+    ``{"op", "bytes", "count", "axes", "groups"}`` rows aggregated by
+    (op, axes, group structure). `axes` is the mesh-axis combination
+    the replica groups vary (None when they match no combination — a
+    resharding group structure)."""
+    groupings = _axis_groupings(mesh_axes) if mesh_axes else {}
+    rows: Dict[tuple, dict] = {}
+    for line in hlo_text.splitlines():
+        m = _OP_RE.search(line)
+        if not m:
+            continue
+        type_str, op = m.group(1), m.group(2)
+        gm = _GROUPS_RE.search(line)
+        groups = _parse_groups(gm.group(1)) if gm else []
+        key_groups = frozenset(frozenset(g) for g in groups)
+        axes = groupings.get(key_groups) if groups else None
+        group_size = len(groups[0]) if groups else 0
+        # size-1 groups are partitioner no-ops (degree-1 axis residue)
+        if groups and group_size <= 1:
+            continue
+        nbytes = _type_bytes(type_str, async_start=bool(m.group(3)))
+        key = (op, axes, group_size)
+        row = rows.setdefault(key, {
+            "op": op, "axes": list(axes) if axes else None,
+            "group_size": group_size, "count": 0, "bytes": 0})
+        row["count"] += 1
+        row["bytes"] += nbytes
+    return sorted(rows.values(),
+                  key=lambda r: (-r["bytes"], r["op"]))
+
+
+def expected_collectives(plan) -> Dict[tuple, set]:
+    """The op kinds a dp×fsdp×tp plan legitimately pays, per mesh-axis
+    combination (the schedule cost_model.train_step_ledger prices):
+
+    - tp: per-layer activation all-reduces (SP may spell them as a
+      reduce-scatter + all-gather pair — same moved volume);
+    - fsdp: ZeRO-3 parameter all-gathers + gradient reduce-scatters,
+      OR contraction-dim partial-sum all-reduces (GSPMD picks per dot);
+    - dp, and the combined dp×fsdp batch axes: gradient/loss
+      reductions (all-reduce; reduce-scatter under sharded grads), and
+      the batch all-gathers GSPMD inserts where a replicated value is
+      rebuilt from batch-sharded shards.
+    Everything NOT in this map — collective-permute above all — is a
+    resharding collective and audits as a finding."""
+    from ..cost_model import _plan_degrees
+    deg = _plan_degrees(plan)
+    exp: Dict[tuple, set] = {}
+    if deg["tp"] > 1:
+        exp[("tp",)] = {"all-reduce", "all-gather", "reduce-scatter"}
+    if deg["fsdp"] > 1:
+        exp[("fsdp",)] = {"all-gather", "reduce-scatter", "all-reduce"}
+    if deg["dp"] > 1:
+        exp[("dp",)] = {"all-reduce", "reduce-scatter", "all-gather"}
+    batch = tuple(a for a in ("dp", "fsdp") if deg[a] > 1)
+    if len(batch) > 1:
+        exp[batch] = {"all-reduce", "reduce-scatter", "all-gather"}
+    return exp
+
+
+def diff_vs_expected(collectives: List[dict], expected: Dict[tuple, set]
+                     ) -> List[dict]:
+    """Audit findings: every parsed collective whose (axes, op) the
+    expected schedule does not cover, named by failure mode."""
+    findings = []
+    for row in collectives:
+        axes = tuple(row["axes"]) if row["axes"] else None
+        if axes is None:
+            findings.append(dict(
+                row, kind="resharding_groups",
+                detail="replica groups match no mesh-axis combination "
+                       "— GSPMD resharding between layouts"))
+        elif row["op"] == "collective-permute":
+            findings.append(dict(
+                row, kind="resharding_permute",
+                detail=f"collective-permute over {axes} — a layout "
+                       "move, not a planned schedule collective"))
+        elif axes not in expected or row["op"] not in expected[axes]:
+            findings.append(dict(
+                row, kind="unplanned_collective",
+                detail=f"{row['op']} over {axes} is outside the plan's "
+                       "expected schedule"))
+    return findings
+
+
+def audit_train_step(cfg, plan, global_batch: int, seq: int = 0,
+                     family: str = "gpt", lr: float = 1e-3) -> dict:
+    """Lower + compile the ACTUAL planner-driven GSPMD train step for
+    (cfg, plan) over abstract avals (no params materialize) and audit
+    the collectives GSPMD inserted against the plan's expected
+    schedule. Returns {"plan", "counts", "collectives", "findings",
+    "expected", "compile_ms", "n_devices"} and publishes
+    `train.compile.audit_ms` / `train.compile.audits` monitor stats —
+    the wall cost of auditing is itself observable."""
+    import jax
+    import jax.numpy as jnp
+    from ..models import facade, gpt as gpt_mod, llama as llama_mod
+    fam = {"gpt": gpt_mod, "llama": llama_mod}[family]
+    seq = int(seq or cfg.max_seq_len)
+    init = {"gpt": "init_gpt_params",
+            "llama": "init_llama_params"}[family]
+    params = jax.eval_shape(
+        lambda k: getattr(fam, init)(cfg, k), jax.random.PRNGKey(0))
+    opt = jax.eval_shape(gpt_mod.init_opt_state, params)
+    toks = jax.ShapeDtypeStruct((int(global_batch), seq + 1), jnp.int32)
+    mesh = plan.build_mesh()
+    step = facade.make_train_step(fam.train_step, cfg=cfg, lr=lr,
+                                  mesh=mesh, plan=plan)
+    args = (params, opt, toks)
+    step._build(args)
+    t0 = time.perf_counter()
+    compiled = step._jit.lower(*args).compile()
+    compile_ms = (time.perf_counter() - t0) * 1e3
+    text = compiled.as_text()
+    mesh_axes = {str(a): int(s) for a, s in zip(mesh.axis_names,
+                                                mesh.devices.shape)}
+    collectives = parse_hlo_collectives(text, mesh_axes)
+    expected = expected_collectives(plan)
+    findings = diff_vs_expected(collectives, expected)
+    counts: Dict[str, int] = {}
+    for row in collectives:
+        counts[row["op"]] = counts.get(row["op"], 0) + row["count"]
+    monitor.gauge("train.compile.audit_ms").set(round(compile_ms, 3))
+    monitor.counter("train.compile.audits").add()
+    monitor.gauge("train.compile.audit_findings").set(len(findings))
+    return {
+        "plan": getattr(plan, "name", str(plan)),
+        "n_devices": int(mesh.devices.size),
+        "compile_ms": round(compile_ms, 1),
+        "counts": counts,
+        "collectives": collectives,
+        "expected": {"+".join(k): sorted(v)
+                     for k, v in expected.items()},
+        "findings": findings,
+    }

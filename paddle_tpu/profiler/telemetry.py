@@ -46,6 +46,10 @@ from typing import Dict, Optional, Sequence
 from . import monitor
 
 DEFAULT_FIELDS = ("loss", "grad_norm", "param_norm", "nonfinite", "lr")
+# the MFU-observatory field set: + tokens trained per step, so the
+# flush can turn flush-to-flush wall time into an achieved-MFU gauge
+# (train.mfu) against the cost-model ledger's FLOPs/token
+MFU_FIELDS = DEFAULT_FIELDS + ("tokens",)
 
 
 # ------------------------------------------------------------ in-jit helpers
@@ -203,12 +207,31 @@ class TelemetryPipeline:
 
     def __init__(self, path: str, every: int = 8,
                  fields: Sequence[str] = DEFAULT_FIELDS,
-                 meta: Optional[dict] = None):
+                 meta: Optional[dict] = None,
+                 flops_per_token: Optional[float] = None,
+                 peak_flops: Optional[float] = None):
         if every < 1:
             raise ValueError("every must be >= 1")
         self.path = path
         self.every = int(every)
         self.fields = tuple(fields)
+        # achieved-MFU wiring (docs/observability.md "Training
+        # observability"): with `flops_per_token` (the cost-model
+        # ledger's model_flops / tokens — cost_model.
+        # train_flops_per_token) and `peak_flops` (TOTAL across the
+        # plan's chips), each flush past the first (the compile window)
+        # computes mfu = flops_per_token · tokens/s ÷ peak_flops from
+        # the recorded `tokens` field and the flush-to-flush wall delta
+        # — no extra pulls, no per-step clocks — and publishes the
+        # `train.mfu` / `train.tokens_per_s` gauges into the same
+        # monitor snapshot the flush already writes.
+        if flops_per_token and "tokens" not in self.fields:
+            raise ValueError(
+                "flops_per_token= needs a 'tokens' field "
+                "(fields=telemetry.MFU_FIELDS)")
+        self._flops_per_token = flops_per_token
+        self._peak_flops = peak_flops
+        self._prev_flush_t: Optional[float] = None
         self._writer = TelemetryWriter(path)
         self._pulls = 0
         self._floor = 0        # lowest cursor value this process wrote
@@ -282,6 +305,38 @@ class TelemetryPipeline:
             records.append(rec)
         records.append({"kind": "flush", "t": now, "step": n - 1,
                         "n": len(records)})
+        # achieved MFU: from the SECOND flush on (the first window
+        # absorbs the jit compile — telemetry_report's exclusion rule),
+        # turn this window's recorded tokens + wall delta into the
+        # train.mfu / train.tokens_per_s gauges. Gauges are set BEFORE
+        # the snapshot below so the same flush's monitor record carries
+        # them into the JSONL.
+        if (self._flops_per_token and "tokens" in self.fields
+                and self._prev_flush_t is not None
+                and now > self._prev_flush_t):
+            tok_i = self.fields.index("tokens")
+            window_tokens = float(sum(
+                0.0 if math.isnan(float(buf[s % self.every][tok_i]))
+                else float(buf[s % self.every][tok_i])
+                for s in range(first, n)))
+            if window_tokens > 0:
+                peak = self._peak_flops
+                if not peak:
+                    # the recorded tokens are GLOBAL, so the default
+                    # denominator must be too: one ChipSpec peak per
+                    # visible device (a single-chip fallback would
+                    # overstate MFU by n_devices on a sharded run) —
+                    # pass peak_flops= explicitly when the mesh spans a
+                    # subset of the backend
+                    import jax
+                    from ..parallel.planner import ChipSpec
+                    peak = self._peak_flops = (ChipSpec().peak_flops
+                                               * jax.device_count())
+                tps = window_tokens / (now - self._prev_flush_t)
+                monitor.gauge("train.tokens_per_s").set(round(tps, 1))
+                monitor.gauge("train.mfu").set(
+                    round(self._flops_per_token * tps / peak, 6))
+        self._prev_flush_t = now
         records.append({"kind": "monitor", "t": now, "pid": os.getpid(),
                         "stats": monitor.snapshot()})
         self._writer.put(records)
@@ -365,6 +420,17 @@ def instrument_train_step(step_fn, pipeline: TelemetryPipeline, cfg=None,
         }
         if lr is not None and "lr" in pipeline.fields:
             scalars["lr"] = lr
+        if "tokens" in pipeline.fields:
+            # trained tokens this step, from the STATIC batch shape
+            # ([B, S+1] next-token batches train B·S tokens) — a trace
+            # constant, so the accumulator row costs nothing extra and
+            # the loss math is untouched (bit-identical trajectories,
+            # tests/test_train_observability.py)
+            toks = batch["tokens"] if isinstance(batch, dict) else batch
+            shape = getattr(toks, "shape", ())
+            scalars["tokens"] = (
+                float(shape[0] * (shape[1] - 1)) if len(shape) >= 2
+                else float("nan"))
         scalars = {k: v for k, v in scalars.items()
                    if k in pipeline.fields}
         tstate = pipeline.device_record(tstate, **scalars)

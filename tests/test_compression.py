@@ -15,6 +15,7 @@ from paddle_tpu.parallel.compression import (
     compressed_psum, dgc_compress, dgc_decompress, dgc_psum,
     local_sgd_sync)
 from paddle_tpu.parallel.mesh import build_mesh
+from paddle_tpu.utils.compat import shard_map
 
 
 def _mesh8():
@@ -30,7 +31,7 @@ class TestCompressedPsum:
         def body(xs):
             return compressed_psum(xs[0], "dp")
 
-        got = jax.shard_map(body, mesh=mesh, in_specs=P("dp"),
+        got = shard_map(body, mesh=mesh, in_specs=P("dp"),
                             out_specs=P())(x)
         want = x.sum(0)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
@@ -40,7 +41,7 @@ class TestCompressedPsum:
     def test_wire_dtype_is_configurable(self):
         mesh = _mesh8()
         x = jnp.ones((8, 4), jnp.float32)
-        got = jax.shard_map(
+        got = shard_map(
             lambda xs: compressed_psum(xs[0], "dp",
                                        wire_dtype=jnp.float16),
             mesh=mesh, in_specs=P("dp"), out_specs=P())(x)
@@ -91,7 +92,7 @@ class TestDGC:
             out, new_r = dgc_psum(gs[0], rs[0], "dp", k_frac=0.25)
             return out, new_r[None]
 
-        out, new_r = jax.shard_map(
+        out, new_r = shard_map(
             body, mesh=mesh, in_specs=(P("dp"), P("dp")),
             out_specs=(P(), P("dp")))(g, r0)
         # oracle: per-member top-4 of |g|, summed
@@ -116,7 +117,7 @@ class TestLocalSGD:
         def body(ps):
             return local_sgd_sync({"w": ps[0]}, "dp")["w"][None]
 
-        out = jax.shard_map(body, mesh=mesh, in_specs=P("dp"),
+        out = shard_map(body, mesh=mesh, in_specs=P("dp"),
                             out_specs=P("dp"))(p)
         np.testing.assert_allclose(np.asarray(out),
                                    np.full((8, 3), 3.5), atol=1e-6)
@@ -132,7 +133,7 @@ class TestLocalSGD:
                             jnp.float32)
         w0 = jnp.asarray(rng.randn(8, 4), jnp.float32)
 
-        @functools.partial(jax.shard_map, mesh=mesh,
+        @functools.partial(shard_map, mesh=mesh,
                            in_specs=(P("dp"), P("dp")),
                            out_specs=P("dp"))
         def run(w, tgt):
@@ -145,9 +146,11 @@ class TestLocalSGD:
             for _ in range(3):               # 3 rounds of (4 local + sync)
                 w, _ = jax.lax.scan(local, w, None, length=4)
                 # pmean replicates (vma-invariant); the next scan's carry
-                # must be device-varying again
-                w = jax.lax.pcast(local_sgd_sync({"w": w}, "dp")["w"],
-                                  "dp", to="varying")
+                # must be device-varying again. Old jax has no vma typing
+                # (and no pcast) — the replicated value carries directly.
+                w = local_sgd_sync({"w": w}, "dp")["w"]
+                if hasattr(jax.lax, "pcast"):
+                    w = jax.lax.pcast(w, "dp", to="varying")
             return w[None]
 
         w = run(w0, noisy)
@@ -173,7 +176,7 @@ class TestMultisliceGradSync:
                 {"w": gs[0]}, axis_name="slice", strategy=strategy)
             return synced["w"]
 
-        return g, jax.shard_map(body, mesh=mesh, in_specs=P("slice"),
+        return g, shard_map(body, mesh=mesh, in_specs=P("slice"),
                                 out_specs=P())(g)
 
     def test_default_is_exact_psum(self):
@@ -206,7 +209,7 @@ class TestMultisliceGradSync:
                 {"w": gs[0]}, axis_name="slice", strategy=S())
             return synced["w"], res["w"][None]
 
-        out, res = jax.shard_map(
+        out, res = shard_map(
             body, mesh=mesh, in_specs=P("slice"),
             out_specs=(P(), P("slice")))(g)
         # per-member top-3 summed; residual carries the rest

@@ -319,6 +319,42 @@ class TestTelemetryReport:
         assert tp["checkpoint"]["async_pending"] == 0.0
         assert tp["checkpoint"]["last_save_ms"] == 12.5
 
+    def test_mfu_and_train_attrib_blocks(self, tmp_path):
+        """The MFU observatory surfaces (ISSUE 12): train.mfu /
+        train.tokens_per_s / train.compile.* gauges render as the
+        'mfu' block (last value), and embedded train_attrib records
+        (tools/train_attrib.py's achieved-vs-roofline joins) replay as
+        the 'train_attrib' block."""
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "tools"))
+        from telemetry_report import summarize
+        path = str(tmp_path / "mfu.jsonl")
+        recs = [
+            {"kind": "run", "t": 0.0, "every": 2,
+             "fields": ["loss", "tokens"]},
+            {"kind": "monitor", "t": 1.0, "pid": 1, "stats": {
+                "train.mfu": 0.01, "train.tokens_per_s": 100.0}},
+            {"kind": "monitor", "t": 9.0, "pid": 1, "stats": {
+                "train.mfu": 0.21, "train.tokens_per_s": 2100.0,
+                "train.compile.wall_ms": 840.5,
+                "train.compile.executables": 1,
+                "train.compile.audit_findings": 1.0}},
+            {"kind": "train_attrib", "plan": "dp2_fsdp2_tp2",
+             "measured_ms_per_step_p50": 31.3, "peak_mfu": 0.015},
+        ]
+        with open(path, "w") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+        doc = summarize(path)
+        assert doc["mfu"]["mfu"] == 0.21             # gauge: last value
+        assert doc["mfu"]["tokens_per_s"] == 2100.0
+        assert doc["mfu"]["compile"]["wall_ms"] == 840.5
+        assert doc["mfu"]["compile"]["audit_findings"] == 1.0
+        ta = doc["train_attrib"]
+        assert ta[0]["plan"] == "dp2_fsdp2_tp2"
+        assert "kind" not in ta[0]
+
     def test_tolerates_torn_tail(self, tmp_path):
         import sys
         sys.path.insert(0, os.path.join(os.path.dirname(

@@ -384,6 +384,45 @@ def v_plan3d_nodonate():
                     dict(remat=True, remat_policy="dots"), donate=False)
 
 
+def v_train_attrib():
+    """Achieved-vs-roofline evidence rows for the planned train step
+    (ISSUE 12): run tools/train_attrib.py's measurement in-process for
+    the plan this backend's device count admits and emit one
+    kernel-registry-format row per plan — ms + step FLOPs + the ledger
+    phase attribution + the HLO audit finding count — so the MFU gap
+    hunt has per-phase attribution next to the plan3d timings."""
+    import train_attrib as ta
+    n = len(jax.devices())
+    plans = "dp2_fsdp2_tp2,dp1_fsdp8_tp1" if n >= 8 else "dp1_fsdp1_tp1"
+    args = type("A", (), {})()
+    args.batch, args.seq, args.steps, args.every = 8, 1024, 10, 3
+    args.hidden, args.layers, args.vocab = 1024, 24, 32768
+    if jax.devices()[0].platform == "cpu":
+        # ANY CPU run gets the test shape, not the flagship (a 24L
+        # flagship step on a host core measures swap — at any device
+        # count)
+        args.hidden, args.layers, args.vocab = 128, 2, 512
+        args.seq, args.steps = 32, 12
+    args.jsonl_prefix = "/tmp/ablate_train_attrib"
+    cfg = ta.build_cfg(args)
+    for name in plans.split(","):
+        row = ta.measure_plan(name, cfg, args, None, None, None)
+        top = max(row["phases"].items(), key=lambda kv: kv[1]["share"])
+        emit(f"train_attrib_{row['plan']}",
+             row["measured_ms_per_step_p50"] or -1.0, {
+                 "flops": row["model_flops_per_step"],
+                 "roofline_ms": row["roofline_ms_per_step"],
+                 "achieved_vs_roofline": row["achieved_vs_roofline"],
+                 "peak_mfu": row["peak_mfu"],
+                 "achieved_mfu": row["achieved_mfu"],
+                 "bound_phase": f"{top[0]}({top[1]['bound']})",
+                 "audit_findings": len(row["audit"]["findings"]),
+                 "knobs": {"plan": row["plan"], "batch": args.batch,
+                           "seq": args.seq,
+                           "n_devices": len(jax.devices())},
+             })
+
+
 def v_sgd():
     """AdamW swapped for plain SGD: isolates optimizer-update cost."""
     from paddle_tpu.models import gpt as G
@@ -428,6 +467,10 @@ VARIANTS = {
     "plan3d_full": v_plan3d_full,
     "plan3d_noremat": v_plan3d_noremat,
     "plan3d_nodonate": v_plan3d_nodonate,
+    # per-phase roofline attribution + collective audit over the
+    # planned step (ISSUE 12) — the evidence row every future MFU
+    # optimization PR ships with
+    "train_attrib": v_train_attrib,
 }
 
 

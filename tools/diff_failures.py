@@ -1,0 +1,134 @@
+"""Diff pytest failure sets: are any failures NEW vs the baseline?
+
+Automates the ROADMAP tier-1 ritual ("always diff FAILED lists against
+a clean-HEAD worktree" — this container carries ~46 pre-existing
+environment failures, so raw counts mean nothing; the SET is the
+signal). Parses `FAILED`/`ERROR` node ids out of pytest logs (the -q
+summary lines, trailing ` - reason` stripped) and compares:
+
+  python tools/diff_failures.py NEW.log                # vs the stored
+                                                       # baseline file
+  python tools/diff_failures.py NEW.log OLD.log        # log vs log
+  python tools/diff_failures.py --write-baseline \\
+      tests/baseline_failures_tier1.txt NEW.log        # (re)store
+
+Exit status: 0 when no NEW failures (fixed/removed ones are reported
+but never fail the gate), 1 when any test fails that the baseline did
+not, 2 on usage/IO errors. The stored baseline
+(tests/baseline_failures_tier1.txt) is one node id per line, '#'
+comments ignored — regenerate it whenever the environment set moves
+(and say so in ROADMAP's re-anchor note).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests", "baseline_failures_tier1.txt")
+
+_LINE_RE = re.compile(r"^(?:FAILED|ERROR)\s+(\S+)")
+
+
+def parse_log(path: str) -> set:
+    """FAILED/ERROR node ids from a pytest log (short summary lines)."""
+    out = set()
+    with open(path, errors="replace") as f:
+        for line in f:
+            m = _LINE_RE.match(line.strip())
+            if m:
+                out.add(m.group(1).rstrip(":"))
+    return out
+
+
+def parse_baseline(path: str) -> set:
+    """Node ids from a stored baseline file OR a pytest log. A file
+    containing any FAILED/ERROR summary lines is a log and parses
+    exactly like new_log; otherwise it's id-per-line, where only
+    tokens that look like pytest node ids ('::'-qualified, or a bare
+    collection-error file ending in .py) are accepted — stray prose in
+    a hand-edited baseline must not pollute the set (or mask a real
+    new failure by collision)."""
+    log_ids = parse_log(path)
+    if log_ids:
+        return log_ids
+    ids = set()
+    with open(path, errors="replace") as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            tok = line.split()[0]
+            if "::" in tok or tok.endswith(".py"):
+                ids.add(tok)
+    return ids
+
+
+def diff(new: set, old: set) -> dict:
+    return {"added": sorted(new - old), "removed": sorted(old - new),
+            "unchanged": len(new & old)}
+
+
+def write_baseline(path: str, ids: set, source: str) -> None:
+    import datetime
+    tmp = f"{path}.tmp{os.getpid()}"
+    now = datetime.date.today().isoformat()
+    with open(tmp, "w") as f:
+        f.write("# Tier-1 pre-existing failure baseline (ROADMAP "
+                "tier-1 verify command).\n"
+                "# One pytest node id per line; '#' comments "
+                "ignored.\n"
+                "# Regenerate: python tools/diff_failures.py "
+                "--write-baseline tests/baseline_failures_tier1.txt "
+                "<tier1.log>\n"
+                f"# Captured {now} from {source}.\n")
+        for nid in sorted(ids):
+            f.write(nid + "\n")
+    os.replace(tmp, path)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("new_log", help="pytest log of the tree under test")
+    ap.add_argument("old", nargs="?", default=DEFAULT_BASELINE,
+                    help="baseline: a stored id-per-line file or a "
+                         "second pytest log (default: "
+                         "tests/baseline_failures_tier1.txt)")
+    ap.add_argument("--write-baseline", metavar="PATH", default=None,
+                    help="store new_log's failure set as the baseline "
+                         "file at PATH and exit 0")
+    args = ap.parse_args(argv)
+    try:
+        new = parse_log(args.new_log)
+    except OSError as e:
+        print(f"cannot read {args.new_log}: {e}", file=sys.stderr)
+        return 2
+    if args.write_baseline:
+        write_baseline(args.write_baseline, new, args.new_log)
+        print(f"wrote {len(new)} ids to {args.write_baseline}")
+        return 0
+    try:
+        old = parse_baseline(args.old)
+    except OSError as e:
+        print(f"cannot read baseline {args.old}: {e}", file=sys.stderr)
+        return 2
+    d = diff(new, old)
+    print(f"failures: {len(new)} now / {len(old)} baseline "
+          f"({d['unchanged']} shared)")
+    for nid in d["removed"]:
+        print(f"  FIXED   {nid}")
+    for nid in d["added"]:
+        print(f"  NEW     {nid}")
+    if d["added"]:
+        print(f"{len(d['added'])} NEW failure(s) vs baseline",
+              file=sys.stderr)
+        return 1
+    print("no new failures")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -57,6 +57,7 @@ def summarize(path: str, samples_per_step: Optional[float] = None) -> dict:
     run = {}
     runs = []          # every header, in order (restarts append new ones)
     steps = []
+    train_attribs = [] # achieved-vs-roofline joins (tools/train_attrib)
     flushes = []
     flush_groups = []  # flushes bucketed per run header, in file order —
     #                    windows must not span a kill/restart boundary
@@ -93,6 +94,8 @@ def summarize(path: str, samples_per_step: Optional[float] = None) -> dict:
                 monitors.append(rec)
             elif kind == "event":
                 events.append(rec)
+            elif kind == "train_attrib":
+                train_attribs.append(rec)
             elif kind == "serving_slo":
                 slo_ttft.extend(rec.get("ttft_ms") or [])
                 slo_itl.extend(rec.get("itl_ms") or [])
@@ -196,6 +199,32 @@ def summarize(path: str, samples_per_step: Optional[float] = None) -> dict:
             if ck:
                 tplan["checkpoint"] = ck
             out["train_plan"] = tplan
+
+    # ---- achieved MFU + compile observability (the train.mfu /
+    # train.tokens_per_s gauges the telemetry flush publishes when
+    # wired with flops_per_token=, and the train.compile.* stats from
+    # models/facade + profiler/hlo_audit). Gauges report last value. ----
+    if monitors:
+        last_s = monitors[-1]["stats"]
+        mfu = {}
+        if "train.mfu" in last_s:
+            mfu["mfu"] = last_s["train.mfu"]
+        if "train.tokens_per_s" in last_s:
+            mfu["tokens_per_s"] = last_s["train.tokens_per_s"]
+        comp = {k[len("train.compile."):]: last_s[k]
+                for k in sorted(last_s)
+                if k.startswith("train.compile.")}
+        if comp:
+            mfu["compile"] = comp
+        if mfu:
+            out["mfu"] = mfu
+
+    # ---- achieved-vs-roofline joins embedded in the stream
+    # (tools/train_attrib.py appends one per measured plan) ----
+    if train_attribs:
+        out["train_attrib"] = [
+            {k: v for k, v in r.items() if k != "kind"}
+            for r in train_attribs]
 
     # ---- serving-engine stats (inference/serving.py monitor names:
     # slot occupancy/queue depth gauges, token/prefill/tick counters;

@@ -81,6 +81,19 @@ JOBS = [
     ("ablate_plan3d",
      [sys.executable, "tools/ablate_step.py", "plan3d", "plan3d_full",
       "plan3d_noremat", "plan3d_nodonate"], 3600, {}),
+    # the training MFU observatory rung (ISSUE 12): achieved-vs-
+    # roofline per-phase attribution + GSPMD collective audit for the
+    # planned train step on the real chip — like --plan3d its CPU leg
+    # runs tunnel-free (tools/train_attrib.py pins the 8-virtual-device
+    # platform unless --tpu), so this queue entry is the TPU leg
+    # single chip -> the plan degrades to dp1 (the attribution +
+    # achieved-MFU join itself is the evidence); flagship bench shape
+    # so the mfu rows compare with BENCH_window best_tpu
+    ("train_attrib",
+     [sys.executable, "tools/train_attrib.py", "--tpu",
+      "--plans", "dp1_fsdp1_tp1", "--hidden", "1024", "--layers", "24",
+      "--vocab", "32768", "--seq", "1024", "--batch", "8",
+      "--steps", "10", "--every", "3"], 2700, {}),
 ]
 
 
