@@ -33,6 +33,68 @@ ENV_STEP_MODE = "PADDLE_HEARTBEAT_STEP_MODE"
 # the one liveness module both the controller and the worker import.
 ELASTIC_EXIT_CODE = 101
 
+# Degraded-world handshake riding the exit-101 protocol
+# (docs/fault_tolerance.md "Elastic 3D training"): a worker that
+# detected device loss writes a world spec JSON to $ENV_WORLD_FILE
+# before exiting 101; the launcher reads it and re-exports the spec as
+# $ENV_WORLD (re-shaping the CPU virtual device count when the spec
+# carries one) so the restarted worker rebuilds its mesh on the
+# SURVIVING world instead of assuming the old one. Spec keys (all
+# optional): n_devices (int), cpu_devices (int — the launcher's
+# --devices cpu re-pin), axes ({axis: degree} — the degraded plan),
+# reason (str). Shared contract: both sides import THESE names.
+ENV_WORLD_FILE = "PADDLE_TPU_ELASTIC_WORLD_FILE"
+ENV_WORLD = "PADDLE_TPU_ELASTIC_WORLD"
+
+
+def write_world_spec(spec: dict, path: Optional[str] = None
+                     ) -> Optional[str]:
+    """Atomically (tmp + rename + fsync) write the degraded world spec
+    a worker wants its elastic restart to come back with. Returns the
+    path written, or None when the launcher did not export the
+    contract (then exit-101 restarts on the unchanged world, the
+    pre-degrade behavior)."""
+    import json
+    path = path if path is not None else os.environ.get(ENV_WORLD_FILE)
+    if not path:
+        return None
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(json.dumps(spec))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def read_world_spec(path: str) -> Optional[dict]:
+    """Parse a world-spec file (None when absent or unparseable — a
+    torn spec must degrade to the old-world restart, never crash the
+    controller)."""
+    import json
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
+def degraded_world() -> Optional[dict]:
+    """The degraded world spec the launcher granted THIS (restarted)
+    worker, or None on a fresh/full-world start. The elastic trainer
+    consults it before planning so the resumed run plans onto the
+    surviving device count (parallel/elastic.py)."""
+    import json
+    raw = os.environ.get(ENV_WORLD)
+    if not raw:
+        return None
+    try:
+        doc = json.loads(raw)
+    except ValueError:
+        return None
+    return doc if isinstance(doc, dict) else None
+
 
 def _touch(path: str) -> None:
     try:

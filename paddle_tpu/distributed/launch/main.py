@@ -41,7 +41,8 @@ import sys
 import time
 from typing import List, Optional
 
-from .heartbeat import ELASTIC_EXIT_CODE
+from .heartbeat import (ELASTIC_EXIT_CODE, ENV_WORLD, ENV_WORLD_FILE,
+                        read_world_spec)
 
 
 def _parse_args(argv=None):
@@ -128,6 +129,14 @@ def _worker_env(args, local_rank: int) -> dict:
         from ...device import cpu_pin_env
         env = cpu_pin_env(args.cpus_per_proc, base_env=env)
         env["PADDLE_LAUNCH_CPU_DEVICES"] = str(args.cpus_per_proc)
+    # degraded-world handshake (heartbeat.py): the worker writes its
+    # wanted world spec here before an elastic exit; the launcher reads
+    # it back in launch() and re-exports it to the restarted pod
+    env.setdefault(ENV_WORLD_FILE,
+                   os.path.join(_hb_dir(args), "elastic_world.json"))
+    granted = getattr(args, "_elastic_world", None)
+    if granted:
+        env[ENV_WORLD] = granted
     # crash flight recorder (profiler/flight_recorder.py): every worker
     # gets a dump directory so a dead pod leaves a black box the operator
     # (and tools/chaos_drill.py) can read — an explicit
@@ -308,6 +317,29 @@ def launch(argv: Optional[List[str]] = None) -> int:
             # flaps don't consume the crash-restart budget.
             elastic += 1
             mon_elastic.add()
+            # degraded-world handshake: a worker that lost devices
+            # leaves a world spec (heartbeat.write_world_spec) naming
+            # the SURVIVING world; the restarted pod must not assume
+            # the old one. The spec re-exports as $PADDLE_TPU_ELASTIC_
+            # WORLD to every later spawn, and a cpu_devices entry
+            # re-shapes the virtual CPU platform (the --devices cpu
+            # simulation of a physically smaller slice).
+            wpath = os.environ.get(ENV_WORLD_FILE) or os.path.join(
+                _hb_dir(args), "elastic_world.json")
+            spec = read_world_spec(wpath)
+            if spec is not None:
+                import json as _json
+                args._elastic_world = _json.dumps(spec)
+                try:            # consumed: one spec per elastic exit
+                    os.remove(wpath)
+                except OSError:
+                    pass
+                if args.devices == "cpu" and spec.get("cpu_devices"):
+                    args.cpus_per_proc = int(spec["cpu_devices"])
+                mon_degraded = monitor.counter("launch_degraded_world")
+                mon_degraded.add()
+                print(f"[launch] elastic restart carries a DEGRADED "
+                      f"world spec: {spec}", file=sys.stderr, flush=True)
             print(f"[launch] worker requested elastic restart "
                   f"({elastic}/{args.max_elastic_restart}, "
                   f"rc={ELASTIC_EXIT_CODE})", file=sys.stderr, flush=True)

@@ -206,6 +206,29 @@ class _ShardedTrainStep:
                       for r in rest))
         return self._jit(*args)
 
+    def rebuild(self, mesh=None, plan=None) -> "_ShardedTrainStep":
+        """Re-target this step at a new mesh/plan — the elastic replan
+        seam (parallel/elastic.py: device loss shrinks the world, the
+        planner degrades the plan, and the SAME step object re-pins).
+        Drops the compiled executable and both pin tables; the next
+        call re-derives in/out shardings from the new plan's specs and
+        compiles ONE fresh executable. Because the retarget swaps in a
+        brand-new `jax.jit` object (rather than feeding new shardings
+        to the old one), the old mesh's executable cannot linger as a
+        second cache entry — the cache key space never bifurcates, and
+        `trace_count` restarts at 0 so the zero-recompiles-after-
+        replan-warmup gate reads exactly like first warmup."""
+        if mesh is not None:
+            self.mesh = mesh
+        if plan is not None:
+            self.plan = plan
+        self._jit = None
+        self.in_pins = None
+        self.out_pins = None
+        from ..profiler import monitor
+        monitor.counter("facade_train_step_rebuilds").add()
+        return self
+
     @property
     def trace_count(self) -> int:
         """Compiled-executable count (0 before the first call) — the

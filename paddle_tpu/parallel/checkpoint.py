@@ -783,12 +783,15 @@ class CheckpointManager:
     checksum-verified fallback across snapshots)."""
 
     def __init__(self, root: str, max_to_keep: int = 3,
-                 prefix: str = "ckpt"):
+                 prefix: str = "ckpt", async_retry_backoff_s: float = 0.5):
         self.root = os.path.abspath(root)
         # 0 (or negative) = keep every snapshot, matching the hapi
         # ModelCheckpoint semantics in callbacks.py
         self.max_to_keep = int(max_to_keep)
         self.prefix = prefix
+        # one retry after this backoff before an async writer failure
+        # surfaces (transient-FS blips must not kill a run)
+        self.async_retry_backoff_s = float(async_retry_backoff_s)
         os.makedirs(self.root, exist_ok=True)
         # async-save state: AT MOST ONE write in flight (the invariant
         # the step-overlap design rests on — docs/parallel_training.md);
@@ -797,6 +800,13 @@ class CheckpointManager:
         self._async_lock = threading.Lock()
         self._async_thread: Optional[threading.Thread] = None
         self._async_err: Optional[BaseException] = None
+        # serializes keep-K pruning against fallback restore: _gc (which
+        # may run on the async writer thread) must never rmtree a
+        # snapshot dir that restore()'s checksum-verified fallback is
+        # mid-read on — the newest snapshot being corrupt is exactly
+        # when restore reads an OLDER dir that a concurrent save's gc
+        # would consider prunable (tests/test_checkpoint_edges.py)
+        self._retain_lock = threading.RLock()
 
     def _path(self, step: int) -> str:
         return os.path.join(self.root, f"{self.prefix}-{int(step)}")
@@ -824,12 +834,19 @@ class CheckpointManager:
 
         At most one save is in flight: a second save_async first waits
         out the previous writer (surfacing its failure as AsyncSaveError
-        here rather than losing it). A failed write additionally dumps
-        the flight recorder ('checkpoint_async_fail') with the step and
-        error. Observability: `checkpoint_async_save` counter at
-        submission, `checkpoint_async_pending` gauge 1 while the writer
-        runs, plus the usual checkpoint_save counter/span from the
-        writer itself."""
+        here rather than losing it). A writer failure RETRIES ONCE
+        after `async_retry_backoff_s` (staging is wiped and rewritten
+        from the host snapshot, so the retry is idempotent) — a
+        transient-FS blip must not kill a run; the retry itself is
+        flight-dumped ('checkpoint_async_retry') and counted
+        (`checkpoint_async_retry`). A SECOND failure surfaces as
+        AsyncSaveError at the next barrier, with its own flight dump
+        ('checkpoint_async_fail') carrying the step and both errors.
+        Observability: `checkpoint_async_save` counter at submission,
+        `checkpoint_async_pending` gauge 1 while the writer runs, plus
+        the usual checkpoint_save counter/span from the writer
+        itself."""
+        import time as _time
         from ..profiler import RecordEvent, flight_recorder, monitor
         self.wait()                       # one in flight + surface errors
         with RecordEvent("checkpoint.snapshot"):
@@ -840,13 +857,24 @@ class CheckpointManager:
 
         def work():
             try:
-                save_sharded(snap, path)
+                try:
+                    save_sharded(snap, path)
+                except BaseException as e:
+                    monitor.counter("checkpoint_async_retry").add()
+                    rec = flight_recorder.recorder()
+                    rec.configure(last_error=f"async checkpoint save of "
+                                             f"step {step} failed "
+                                             f"(retrying once): {e!r}")
+                    rec.dump("checkpoint_async_retry")
+                    _time.sleep(self.async_retry_backoff_s)
+                    save_sharded(snap, path)
                 self._gc()
             except BaseException as e:    # surfaced at the next barrier
                 self._async_err = e
                 rec = flight_recorder.recorder()
                 rec.configure(last_error=f"async checkpoint save of "
-                                         f"step {step} failed: {e!r}")
+                                         f"step {step} failed twice: "
+                                         f"{e!r}")
                 rec.dump("checkpoint_async_fail")
             finally:
                 monitor.gauge("checkpoint_async_pending").set(0)
@@ -909,21 +937,28 @@ class CheckpointManager:
             self.wait()
         except AsyncSaveError:
             monitor.counter("checkpoint_fallback_restore").add()
-        for cand in self._candidates():
-            try:
-                verify_checkpoint(cand)
-                # the verify pass just CRC-checked every shard; don't pay
-                # a second full read+CRC inside the load
-                state = load_sharded(cand, mesh=mesh, specs=specs,
-                                     template=template, verify=False)
-            except CheckpointCorruptError:
-                # the pointed/newest snapshot was torn or bit-rotted and
-                # the restore is falling back to an older one — the count
-                # a production run alerts on (docs/observability.md)
-                monitor.counter("checkpoint_fallback_restore").add()
-                continue
-            monitor.counter("checkpoint_restore").add()
-            return state, self._step_of(cand)
+        # the retain lock (held through verify+load of each candidate)
+        # keeps a concurrent save's keep-K gc from rmtree-ing the very
+        # dir a fallback restore is mid-read on; taken AFTER wait() so
+        # joining a writer that itself takes the lock in _gc cannot
+        # deadlock
+        with self._retain_lock:
+            for cand in self._candidates():
+                try:
+                    verify_checkpoint(cand)
+                    # the verify pass just CRC-checked every shard;
+                    # don't pay a second full read+CRC inside the load
+                    state = load_sharded(cand, mesh=mesh, specs=specs,
+                                         template=template, verify=False)
+                except CheckpointCorruptError:
+                    # the pointed/newest snapshot was torn or bit-rotted
+                    # and the restore is falling back to an older one —
+                    # the count a production run alerts on
+                    # (docs/observability.md)
+                    monitor.counter("checkpoint_fallback_restore").add()
+                    continue
+                monitor.counter("checkpoint_restore").add()
+                return state, self._step_of(cand)
         return None, None
 
     def _candidates(self) -> List[str]:
@@ -940,16 +975,21 @@ class CheckpointManager:
             return None
 
     def _gc(self) -> None:
-        if self.max_to_keep > 0:
-            snaps = _snapshot_steps(self.root, self.prefix)
-            for _step, full in snaps[:-self.max_to_keep]:
-                shutil.rmtree(full, ignore_errors=True)
-                audit_forget(full)
-        # crashed saves leave *.tmp-* / *.old-* orphans; sweep them
-        for name in os.listdir(self.root):
-            if ".tmp-" in name or ".old-" in name:
-                shutil.rmtree(os.path.join(self.root, name),
-                              ignore_errors=True)
+        # the retain lock serializes pruning with restore()'s
+        # candidate walk: a fallback restore mid-read on an old
+        # snapshot (because newer ones are corrupt) must never have it
+        # deleted underneath — gc simply waits the read out
+        with self._retain_lock:
+            if self.max_to_keep > 0:
+                snaps = _snapshot_steps(self.root, self.prefix)
+                for _step, full in snaps[:-self.max_to_keep]:
+                    shutil.rmtree(full, ignore_errors=True)
+                    audit_forget(full)
+            # crashed saves leave *.tmp-* / *.old-* orphans; sweep them
+            for name in os.listdir(self.root):
+                if ".tmp-" in name or ".old-" in name:
+                    shutil.rmtree(os.path.join(self.root, name),
+                                  ignore_errors=True)
 
 
 # --------------------------------------------------- train-state convenience

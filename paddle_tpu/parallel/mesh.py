@@ -57,6 +57,18 @@ def build_mesh(axes: Dict[str, int], devices=None) -> Mesh:
     return Mesh(arr, tuple(shape.keys()))
 
 
+def device_keys(devices) -> List[str]:
+    """Stable per-device identity strings for a Mesh or a device list
+    (`str(d)` is unique per PJRT device, e.g. 'TFRT_CPU_3'). ONE home
+    for the keying used by the elastic device leases
+    (parallel/elastic.py), the fault injector's lose-the-last-K
+    selection, and the survivors set-difference after a loss — a mesh
+    and a flat list over the same devices must key identically."""
+    if isinstance(devices, Mesh):
+        devices = devices.devices.flat
+    return [str(d) for d in devices]
+
+
 def set_global_mesh(mesh: Mesh):
     _mesh_stack().clear()
     _mesh_stack().append(mesh)

@@ -24,9 +24,20 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
 __all__ = ["ChipSpec", "ModelSpec", "Plan", "TrainPlan",
-           "enumerate_plans", "plan_parallel", "plan_train",
-           "spec_from_config", "spec_from_gpt_config",
-           "best_mesh_axes", "plan_serving_tp"]
+           "NoFeasiblePlanError", "enumerate_plans", "plan_parallel",
+           "plan_train", "degrade_plan", "spec_from_config",
+           "spec_from_gpt_config", "best_mesh_axes", "plan_serving_tp"]
+
+
+class NoFeasiblePlanError(ValueError):
+    """No (degraded) plan fits the offered device count. `constraint`
+    names the violated constraint (divisibility or HBM) so the elastic
+    controller can die with a diagnosis instead of hanging on a
+    collective that can never complete (parallel/elastic.py)."""
+
+    def __init__(self, msg: str, constraint: str = ""):
+        super().__init__(msg)
+        self.constraint = constraint or msg
 
 
 @dataclass(frozen=True)
@@ -454,6 +465,84 @@ def plan_train(cfg_or_spec, n_devices: int, global_batch: int,
     monitor.gauge("train.plan.n_devices").set(best.n_devices)
     return TrainPlan(axes=axes, mapping=mapping,
                      batch_axes=("dp", "fsdp"), plan=best, specs=specs)
+
+
+def _divisors_desc(n: int) -> List[int]:
+    return [d for d in range(n, 0, -1) if n % d == 0]
+
+
+def degrade_plan(cfg_or_spec, old: TrainPlan, n_surviving: int,
+                 global_batch: int, chip: Optional[ChipSpec] = None,
+                 tp_axis: str = "tp",
+                 param_specs: Optional[Dict] = None) -> TrainPlan:
+    """Degrade `old` onto at most `n_surviving` devices after device
+    loss (parallel/elastic.py). Preference order: **dp gives way first,
+    then fsdp, and tp is held** — re-slicing the TP split would change
+    the per-layer collective pattern and the head partitioning (the
+    most expensive reshard), while shrinking dp/fsdp only re-shards the
+    batch and the ZeRO-3 windows, which the checkpoint manifest
+    re-slices for free (docs/fault_tolerance.md). Candidates rank
+    largest-surviving-world-first so the degrade strands as few chips
+    as possible; when no tp-held candidate is legal (e.g. tp itself
+    exceeds the survivors) the full `plan_train` search runs on every
+    world size down from `n_surviving`.
+
+    Raises NoFeasiblePlanError naming the violated constraint when
+    nothing fits — divisibility via the `_diagnose_empty` walk, HBM
+    with the per-chip state bytes spelled out."""
+    spec = _coerce_spec(cfg_or_spec)
+    chip = chip or ChipSpec()
+    if n_surviving < 1:
+        raise NoFeasiblePlanError(
+            f"no surviving devices (n_surviving={n_surviving})",
+            constraint=f"n_surviving={n_surviving} < 1")
+    dp0 = old.axes.get("dp", 1)
+    fsdp0 = old.axes.get("fsdp", 1)
+    tp0 = old.axes.get(tp_axis, 1)
+    oom = []                      # legal-but-OOM candidates, for the error
+    # tp-held lattice: every (dp' | dp, fsdp' | fsdp) shrink keeps the
+    # batch divisibility old already satisfied; rank by total desc, then
+    # PREFER the larger fsdp' (i.e. shrink dp before fsdp). Candidates
+    # are priced with _estimate only; plan_train (which publishes the
+    # train.plan.* gauges) runs once, for the winner.
+    cands = sorted(((dp, fsdp) for dp in _divisors_desc(dp0)
+                    for fsdp in _divisors_desc(fsdp0)
+                    if dp * fsdp * tp0 <= n_surviving),
+                   key=lambda c: (-(c[0] * c[1] * tp0), -c[1], -c[0]))
+    for dp, fsdp in cands:
+        priced = _estimate(Plan(dp=dp, mp=tp0, fsdp=fsdp), spec,
+                           global_batch, chip)
+        if priced.fits:
+            return plan_train(cfg_or_spec, dp * fsdp * tp0, global_batch,
+                              chip=chip, dp=dp, fsdp=fsdp, tp=tp0,
+                              tp_axis=tp_axis, param_specs=param_specs)
+        oom.append(priced)
+    # tp cannot be held (or every held candidate is OOM): full search,
+    # largest world first
+    for n in range(n_surviving, 0, -1):
+        fitting = [p for p in enumerate_plans(spec, n, global_batch,
+                                              chip) if p.pp == 1]
+        oom.extend(p for p in fitting if not p.fits)
+        fitting = [p for p in fitting if p.fits]
+        if fitting:
+            best = fitting[0]
+            return plan_train(cfg_or_spec, n, global_batch, chip=chip,
+                              dp=best.dp, fsdp=best.fsdp, tp=best.mp,
+                              tp_axis=tp_axis, param_specs=param_specs)
+    if oom:
+        best = min(oom, key=lambda p: p.mem_bytes)
+        raise NoFeasiblePlanError(
+            f"no degraded plan fits {n_surviving} surviving devices: "
+            f"best candidate {best!r} needs {best.mem_bytes / 1e9:.2f} "
+            f"GB/chip > 0.9*hbm_bytes = {0.9 * chip.hbm_bytes / 1e9:.2f}"
+            f" GB even at max sharding",
+            constraint=f"hbm: {best.mem_bytes / 1e9:.2f} GB/chip > "
+                       f"{0.9 * chip.hbm_bytes / 1e9:.2f} GB")
+    reason = _diagnose_empty(spec, n_surviving, global_batch, None,
+                             max_pp=1)
+    raise NoFeasiblePlanError(
+        f"no legal degraded (dp, fsdp, tp) assignment for "
+        f"{n_surviving} surviving devices: {reason}", constraint=reason)
 
 
 def plan_serving_tp(cfg_or_spec, n_devices: int, num_slots: int = 8,

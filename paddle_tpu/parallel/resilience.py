@@ -54,6 +54,20 @@ class StepHungError(RuntimeError):
     on this hardware usually the TPU tunnel flapping)."""
 
 
+def plan_state_specs(plan):
+    """The restore-layout tree for a TrainPlan's trainer state: params
+    per the plan's remapped PARAM_SPECS, Adam m/v mirroring them leaf
+    for leaf (the facade pin rule). ONE home — ResilientTrainer's
+    ctor/rebuild, the elastic controller's reshard-restore and the
+    chaos drill all derive the layout here, so an optimizer-state
+    shape change cannot drift between them. None when the plan carries
+    no spec table."""
+    if plan is None or not getattr(plan, "specs", None):
+        return None
+    return {"params": plan.specs,
+            "opt_state": {"m": plan.specs, "v": plan.specs}}
+
+
 @dataclass
 class ResilienceConfig:
     """Knobs for ResilientTrainer (defaults are safe-but-lenient)."""
@@ -357,9 +371,7 @@ class ResilientTrainer:
         step_mesh = mesh if (plan is not None
                              and mesh not in (_UNSET, None)) else None
         if plan is not None and specs is None and plan.specs:
-            self._specs = {"params": plan.specs,
-                           "opt_state": {"m": plan.specs,
-                                         "v": plan.specs}}
+            self._specs = plan_state_specs(plan)
         self._guarded = make_resilient_step(step_fn, cfg=cfg,
                                             donate=donate,
                                             telemetry=telemetry,
@@ -421,6 +433,36 @@ class ResilientTrainer:
         saved = state.get("step")
         self.step = int(saved) if saved is not None else int(step or 0)
         return True
+
+    # ------------------------------------------------------------- replan
+    def rebuild_plan(self, mesh, plan, *, params=None, opt_state=None,
+                     step=None) -> None:
+        """Elastic replan seam (parallel/elastic.py): re-target the
+        guarded step at a degraded mesh/plan via the facade's
+        `_ShardedTrainStep.rebuild` (same step object, fresh pins, one
+        new executable — no cache-key bifurcation), swap the restore
+        layout to the new plan's specs, and optionally install the
+        reshard-restored state. The telemetry device accumulator lived
+        on the OLD mesh, so it resets and re-initializes lazily at the
+        next step, seeded from the (restored) step counter — exactly
+        the maybe_resume continuation semantics."""
+        if not hasattr(self._guarded, "rebuild"):
+            raise TypeError(
+                "rebuild_plan needs the planner-driven sharded step "
+                "(make_resilient_step with mesh= and plan=); the plain "
+                "jitted step has no mesh to re-target")
+        self._guarded.rebuild(mesh=mesh, plan=plan)
+        self._mesh = mesh
+        if plan is not None and plan.specs:
+            self._specs = plan_state_specs(plan)
+        self._tstate = None
+        if params is not None:
+            self.params = params
+        if opt_state is not None:
+            self.opt_state = opt_state
+        if step is not None:
+            self.step = int(step)
+        self._bad_streak = 0
 
     # --------------------------------------------------------------- save
     def save(self) -> Optional[str]:

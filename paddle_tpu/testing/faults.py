@@ -62,6 +62,29 @@ tools/chaos_serving.py):
                           admission rollback must release the shared
                           pages it retained.
 
+Elastic (mesh-level) fault kinds (parallel/elastic.py consults
+`on_elastic` through `elastic._FAULT_HOOK` at its phase boundaries —
+"step" before each step, "restore" at the start of each reshard-
+restore attempt; each fires at most once via the same marker scheme):
+
+- ``device_loss@S:K``    — wedge the LAST K device leases at/after
+                           step S (K defaults to 1): staleness
+                           detection fires at the next boundary and
+                           the elastic controller replans onto the
+                           survivors. AT MOST ONE device_loss fires
+                           per consult, so a second token queued at
+                           the same step fires at the NEXT phase
+                           boundary — which, after a loss at "step",
+                           is the replan's "restore" phase: exactly
+                           the killed-mid-restore drill.
+- ``collective_hang@S:MS`` — stall the watched step for MS
+                           milliseconds at/after step S (inside the
+                           elastic watchdog clock; size MS past the
+                           budget and the hang detector fires).
+- ``straggler@S:MS``     — same stall, named for the within-budget
+                           case: the run slows but MUST NOT replan
+                           (the detector-does-not-overfire drill).
+
 File corruptors (`truncate_shard` / `bitflip_shard` / `remove_shard`)
 damage committed checkpoints in place for restore-fallback tests; they
 call `checkpoint.audit_forget` so the test-suite write audit knows the
@@ -84,10 +107,13 @@ KILL_EXIT = 37
 
 _KINDS = ("kill", "crash_shard", "nan", "hb_stale", "elastic_exit",
           "nan_logits", "tick_stall", "prefill_raise", "decode_raise",
-          "cow_raise", "draft_nan")
+          "cow_raise", "draft_nan", "device_loss", "collective_hang",
+          "straggler")
 _SERVING_KINDS = frozenset(
     {"nan_logits", "tick_stall", "prefill_raise", "decode_raise",
      "cow_raise", "draft_nan"})
+_ELASTIC_KINDS = frozenset(
+    {"device_loss", "collective_hang", "straggler"})
 
 
 @dataclass
@@ -204,6 +230,32 @@ class FaultPlan:
                   f"{count} shard files)", file=sys.stderr, flush=True)
             os._exit(KILL_EXIT)
 
+    def on_elastic(self, phase: str, step: int) -> dict:
+        """elastic._FAULT_HOOK: called at the elastic controller's
+        phase boundaries with ("step"|"restore", current step);
+        returns the action dict the controller applies ({"lose": K}
+        wedges the last K device leases, {"stall_s": S} stalls the
+        next watched step). AT MOST ONE device_loss fires per consult
+        (see the module docstring: queued same-step losses cascade
+        into the mid-restore phase); stalls only fire at "step"."""
+        actions: dict = {}
+        for f in self.faults:
+            if f.done or f.kind not in _ELASTIC_KINDS or step < f.step:
+                continue
+            if f.kind == "device_loss" and "lose" not in actions:
+                self._mark_fired(f)
+                print(f"[faults] device_loss at {phase} (step {step}): "
+                      f"losing {max(f.arg, 1)} device(s)",
+                      file=sys.stderr, flush=True)
+                actions["lose"] = max(f.arg, 1)
+            elif f.kind in ("collective_hang", "straggler") \
+                    and phase == "step" and "stall_s" not in actions:
+                self._mark_fired(f)
+                print(f"[faults] {f.kind} at step {step}: stalling "
+                      f"{f.arg} ms", file=sys.stderr, flush=True)
+                actions["stall_s"] = f.arg / 1000.0
+        return actions
+
     def on_serving_tick(self, tick: int) -> dict:
         """serving._FAULT_HOOK: called with the engine tick about to
         run; returns the action dict the engine applies this tick
@@ -246,22 +298,24 @@ def install(spec: Optional[str] = None,
     once = once_dir if once_dir is not None \
         else os.environ.get(ENV_ONCE_DIR) or None
     plan = FaultPlan(spec, once_dir=once)
-    from ..parallel import checkpoint, resilience
+    from ..parallel import checkpoint, elastic, resilience
     from ..inference import serving
     resilience._STEP_HOOK = plan.on_step
     checkpoint._SHARD_WRITE_HOOK = plan.on_shard_write
     serving._FAULT_HOOK = plan.on_serving_tick
+    elastic._FAULT_HOOK = plan.on_elastic
     _PLAN = plan
     return plan
 
 
 def uninstall() -> None:
     global _PLAN
-    from ..parallel import checkpoint, resilience
+    from ..parallel import checkpoint, elastic, resilience
     from ..inference import serving
     resilience._STEP_HOOK = None
     checkpoint._SHARD_WRITE_HOOK = None
     serving._FAULT_HOOK = None
+    elastic._FAULT_HOOK = None
     _PLAN = None
 
 

@@ -103,7 +103,7 @@ SMOKE_FILES = {
     # fault-tolerance runtime (in-process; the chaos drills in
     # test_chaos_drill.py / test_chaos_serving.py stay full-suite-only)
     "test_fault_tolerance.py", "test_checkpoint_edges.py",
-    "test_checkpoint_async.py",
+    "test_checkpoint_async.py", "test_elastic.py",
 }
 
 

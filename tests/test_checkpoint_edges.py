@@ -5,6 +5,8 @@ Reference analog: auto_parallel Converter merge/slice edge cases
 (converter.py) — the windows recorded in the manifest must compose for
 ANY target mesh, including ones that do not divide the saved layout.
 """
+import os
+
 import numpy as np
 import pytest
 import jax.numpy as jnp
@@ -87,3 +89,72 @@ def test_load_names_missing_and_extra_leaves(tmp_path):
     msg = str(ei.value)
     assert "params/w_extra" in msg          # expected but absent
     assert "step" in msg                    # present but unexpected
+
+
+def test_gc_never_deletes_dir_fallback_restore_is_reading(tmp_path):
+    """keep-K pruning vs fallback-restore race (ISSUE 14 satellite):
+    while restore() — newest snapshot corrupt, fallback mid-read on an
+    OLDER one — holds the retain lock, a concurrent save()'s keep-K gc
+    must WAIT rather than rmtree the dir under the read. Without the
+    CheckpointManager._retain_lock this interleaving deleted ckpt-2
+    mid-read (missing-shard CheckpointCorruptError or garbage)."""
+    import threading
+    import time
+    from paddle_tpu.parallel import checkpoint as ck
+    from paddle_tpu.parallel.checkpoint import CheckpointManager
+    from paddle_tpu.testing import faults as fmod
+
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=2)
+    state = {"w": np.arange(8, dtype=np.float32)}
+    for s in (1, 2, 3):
+        mgr.save(dict(state, step=np.int64(s)), s)
+    # corrupt the newest so restore falls back to ckpt-2 — exactly the
+    # dir a later save's keep-K=2 gc considers prunable
+    fmod.truncate_shard(str(tmp_path / "ckpt-3"), index=0)
+
+    in_read = threading.Event()
+    release = threading.Event()
+    orig_verify = ck.verify_checkpoint
+
+    def slow_verify(path):
+        out = orig_verify(path)
+        if path.endswith("ckpt-2"):
+            in_read.set()
+            release.wait(10)         # hold the fallback read open
+        return out
+    ck.verify_checkpoint = slow_verify
+    box = {}
+
+    def do_restore():
+        try:
+            box["state"], box["step"] = mgr.restore(mesh=None)
+        except BaseException as e:
+            box["err"] = e
+    t = threading.Thread(target=do_restore)
+    try:
+        t.start()
+        assert in_read.wait(10)
+        # gc (inside save) must block on the retain lock, not delete
+        gc_done = threading.Event()
+
+        def do_save():
+            mgr.save(dict(state, step=np.int64(4)), 4)
+            gc_done.set()
+        t2 = threading.Thread(target=do_save)
+        t2.start()
+        time.sleep(0.3)
+        assert not gc_done.is_set()          # gc is WAITING
+        assert os.path.isdir(tmp_path / "ckpt-2")
+        release.set()
+        t.join(30)
+        t2.join(30)
+    finally:
+        ck.verify_checkpoint = orig_verify
+        release.set()
+    assert "err" not in box, box.get("err")
+    assert box["step"] == 2                  # fallback read ckpt-2 intact
+    np.testing.assert_array_equal(np.asarray(box["state"]["w"]),
+                                  state["w"])
+    assert gc_done.is_set()
+    # after the read released, pruning proceeded normally
+    assert mgr.steps() == [3, 4] or mgr.steps() == [2, 3, 4]

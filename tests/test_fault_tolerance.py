@@ -378,6 +378,60 @@ class TestResilientStep:
         got = pull_with_watchdog(jnp.asarray(3.0), timeout=5.0)
         assert float(got) == 3.0
 
+    def test_puller_tuple_passthrough_stall_mid_tuple(self):
+        """WatchdogPuller tuple passthrough (PR 11) under a stall MID
+        tuple conversion: the first element converts, the SECOND
+        stalls past the deadline — the budget must still expire
+        (StepHungError), the late result must never cross-deliver to
+        the next pull, and the puller must recover with a fresh
+        worker. The elastic hang detector leans on exactly this path
+        (parallel/elastic.py watches (loss, ok) pairs)."""
+        from paddle_tpu.parallel.resilience import WatchdogPuller
+        import threading
+        import time as _time
+        released = threading.Event()
+
+        class StallsOnConvert:
+            def __array__(self, dtype=None):
+                released.wait(30)        # wedged until the test frees it
+                return np.full((), 7.0)
+
+        puller = WatchdogPuller(label="test")
+        with pytest.raises(StepHungError, match="did not arrive"):
+            puller.pull((jnp.asarray(1.0), StallsOnConvert()),
+                        timeout=0.1, retries=1, backoff_base=0.05,
+                        backoff_max=0.05)
+        released.set()                   # the zombie completes late...
+        _time.sleep(0.2)
+        # ...and a fresh pull neither hangs nor receives the stale pair
+        a, b = puller.pull((jnp.asarray(2.0), jnp.asarray(3.0)),
+                           timeout=5.0)
+        assert (float(a), float(b)) == (2.0, 3.0)
+
+    def test_puller_tuple_deadline_expiry_callable(self):
+        """Deadline expiry with a CALLABLE producing the tuple (the
+        elastic step wraps the whole guarded step this way): the
+        budget covers the call, on_retry observes each backoff, and a
+        within-budget call passes tuples through element-wise."""
+        from paddle_tpu.parallel.resilience import WatchdogPuller
+        puller = WatchdogPuller(label="test2")
+        seen = []
+
+        def slow_pair():
+            import time as _t
+            _t.sleep(30)
+            return (np.zeros(()), np.zeros(()))
+
+        with pytest.raises(StepHungError):
+            puller.pull(slow_pair, timeout=0.05, retries=2,
+                        backoff_base=0.05, backoff_max=0.05,
+                        on_retry=seen.append)
+        assert seen == [0, 1]
+        got = puller.pull(lambda: (np.float32(1.5), np.int32(2)),
+                          timeout=5.0)
+        assert (float(got[0]), int(got[1])) == (1.5, 2)
+        assert got[0].dtype == np.float32 and got[1].dtype == np.int32
+
     def test_exit_on_hang_uses_elastic_code(self, tmp_path, monkeypatch):
         tr = _trainer(tmp_path, watchdog_timeout=0.1)
         tr.config.retries = 0

@@ -200,6 +200,23 @@ def summarize(path: str, samples_per_step: Optional[float] = None) -> dict:
                 tplan["checkpoint"] = ck
             out["train_plan"] = tplan
 
+    # ---- elastic replans (parallel/elastic.py train.elastic.* family:
+    # replans/device_loss/collective_hang counters report first-to-last
+    # deltas; world_size/replan_ms/reshard_bytes gauges their last
+    # value — "replan is priced and observable", ISSUE 14) ----
+    if monitors:
+        first_s, last_s = monitors[0]["stats"], monitors[-1]["stats"]
+        _ELASTIC_GAUGES = ("world_size", "replan_ms", "reshard_bytes")
+        ela = {}
+        for k in sorted(last_s):
+            if not k.startswith("train.elastic."):
+                continue
+            name = k[len("train.elastic."):]
+            ela[name] = (last_s[k] if name in _ELASTIC_GAUGES
+                         else last_s[k] - first_s.get(k, 0))
+        if ela:
+            out["elastic"] = ela
+
     # ---- achieved MFU + compile observability (the train.mfu /
     # train.tokens_per_s gauges the telemetry flush publishes when
     # wired with flops_per_token=, and the train.compile.* stats from
