@@ -305,22 +305,41 @@ _REMAT_RECOMPUTE = {"full": 1.0 / 3.0, "dots": 0.15, "dots_flash": 0.1,
 
 def _plan_degrees(plan) -> dict:
     """Normalize a plan argument — parallel.planner.TrainPlan, Plan,
-    a {axis: degree} dict, or None (single device) — to the 3D degrees
-    the train ledger prices."""
+    a {axis: degree} dict, or None (single device) — to the 3D/4D
+    degrees the train ledger prices (+ `mb`, the pp microbatch count,
+    defaulting to 2·pp when the plan carries none)."""
     if plan is None:
-        return {"dp": 1, "fsdp": 1, "tp": 1}
+        return {"dp": 1, "fsdp": 1, "tp": 1, "pp": 1, "mb": 1}
+    def _mb(pp: int, raw) -> int:
+        # a pp>1 plan must microbatch (plan_train never emits mb<2);
+        # mb<=1 therefore means "the plan carries no real count"
+        # (TrainPlan.microbatches and the Plan dataclass both default
+        # to 1) — fall back to the documented 2·pp
+        raw = int(raw or 0)
+        if pp <= 1:
+            return 1
+        return raw if raw > 1 else 2 * pp
+
     if hasattr(plan, "axes"):                      # TrainPlan
         axes = dict(plan.axes)
-        return {"dp": int(axes.get("dp", 1)),
-                "fsdp": int(axes.get("fsdp", 1)),
-                "tp": int(axes.get("tp", axes.get("mp", 1)))}
+        deg = {"dp": int(axes.get("dp", 1)),
+               "fsdp": int(axes.get("fsdp", 1)),
+               "tp": int(axes.get("tp", axes.get("mp", 1))),
+               "pp": int(axes.get("pp", 1))}
+        deg["mb"] = _mb(deg["pp"], getattr(plan, "microbatches", 0))
+        return deg
     if hasattr(plan, "dp"):                        # priced Plan row
+        pp = int(getattr(plan, "pp", 1))
         return {"dp": int(plan.dp), "fsdp": int(plan.fsdp),
-                "tp": int(plan.mp)}
+                "tp": int(plan.mp), "pp": pp,
+                "mb": _mb(pp, getattr(plan, "microbatches", 0))}
     axes = dict(plan)
+    pp = int(axes.get("pp", 1))
     return {"dp": int(axes.get("dp", 1)),
             "fsdp": int(axes.get("fsdp", 1)),
-            "tp": int(axes.get("tp", axes.get("mp", 1)))}
+            "tp": int(axes.get("tp", axes.get("mp", 1))),
+            "pp": pp,
+            "mb": _mb(pp, axes.get("microbatches", 0))}
 
 
 def train_step_ledger(cfg, family: str = "gpt", plan=None,
@@ -355,12 +374,20 @@ def train_step_ledger(cfg, family: str = "gpt", plan=None,
       p/m/v, all f32;
     - head_loss:     LM head fwd+bwd (vocab-parallel over tp) + the
       fused-CE logit stream (f32, two passes: lse + target gather);
-    - coll_tp / coll_dp / coll_fsdp: one phase PER MESH AXIS, bytes
-      from the planner's exact formulas (_ring_factor model: tp = 4
-      activation all-reduces per layer, dp = one grad all-reduce of
-      the f32 shard, fsdp = ~3 all-gather-sized moves), `channel:
+    - coll_tp / coll_dp / coll_fsdp / coll_pp: one phase PER MESH
+      AXIS, bytes from the planner's exact formulas (_ring_factor
+      model: tp = 4 activation all-reduces per layer, dp = one grad
+      all-reduce of the f32 shard, fsdp = ~3 all-gather-sized moves,
+      pp = boundary activations each way per microbatch), `channel:
       "ici"` so roofline_attribution prices them against
       ChipSpec.ici_bw. Degree-1 axes price to zero.
+    - pp_bubble (pp>1 only): the 1F1B schedule's (pp-1)/m idle slots
+      as idle-equivalent FLOPs of the pipelined phases — zero bytes,
+      the schedule burns time, not bandwidth. The per-chip stacked-
+      block phases divide by pp (each chip runs its L/pp stage chunk)
+      while head_loss stays undivided (the manual step computes the
+      vocab-parallel head on every pp rank — see
+      parallel/pipeline_train.py).
 
     `remat` overrides the config's policy (True/False or a policy
     name); `dtype_bytes` is the compute/activation width (default 2
@@ -372,7 +399,8 @@ def train_step_ledger(cfg, family: str = "gpt", plan=None,
     S = int(seq or cfg.max_seq_len)
     deg = _plan_degrees(plan)
     dp, fsdp, tp = deg["dp"], deg["fsdp"], deg["tp"]
-    n_devices = dp * fsdp * tp
+    pp, mb = deg["pp"], deg["mb"]
+    n_devices = dp * fsdp * tp * pp
     if remat is None:
         policy = (getattr(cfg, "remat_policy", "full") or "full") \
             if getattr(cfg, "remat", False) else "none"
@@ -398,21 +426,35 @@ def train_step_ledger(cfg, family: str = "gpt", plan=None,
     # exact
     n_params = (dims["layer_params"] * L
                 + (V + int(cfg.max_seq_len)) * D)
-    w_stream = dims["layer_params"] * L * dtype_bytes / tp
+    # per-chip stacked-block work: the layer stack shards over tp AND
+    # (pp>1) over the stage axis — each chip holds and streams L/pp
+    # layers' weights and computes L/pp layers' matmuls per microbatch
+    w_stream = dims["layer_params"] * L * dtype_bytes / (tp * pp)
 
     fwd_matmul = {
-        "flops": 2.0 * dims["layer_params"] * L * tok_local / tp,
+        "flops": 2.0 * dims["layer_params"] * L * tok_local / (tp * pp),
         "bytes": w_stream,
     }
     fwd_attention = {
-        "flops": 4.0 * D * S * L * tok_local / tp,
+        "flops": 4.0 * D * S * L * tok_local / (tp * pp),
         "bytes": 0.0,
     }
     fwd_flops = fwd_matmul["flops"] + fwd_attention["flops"]
     bwd = {"flops": 2.0 * fwd_flops, "bytes": 2.0 * w_stream}
     remat_phase = {"flops": _REMAT_RECOMPUTE[policy] * fwd_flops,
                    "bytes": 0.0}
-    opt_elems = n_params / (tp * fsdp)
+    # pipeline bubble as its OWN phase (pp>1 only): (pp-1)/m of the
+    # pipelined compute is idle-equivalent slots — the planner's
+    # compute_s multiplier, broken out so the attribution table shows
+    # the schedule's cost next to the work (flops, no bytes: the
+    # bubble burns time, not bandwidth)
+    bubble_phase = {
+        "flops": ((pp - 1) / max(mb, 1)
+                  * (fwd_flops + bwd["flops"] + remat_phase["flops"])
+                  if pp > 1 else 0.0),
+        "bytes": 0.0,
+    }
+    opt_elems = n_params / (tp * fsdp * pp)
     optimizer = {
         "flops": (14.0 if amp else 12.0) * opt_elems,
         "bytes": 28.0 * opt_elems,      # r p/m/v/grad + w p/m/v, f32
@@ -431,17 +473,27 @@ def train_step_ledger(cfg, family: str = "gpt", plan=None,
     }
     coll_dp = {
         "flops": 0.0, "channel": "ici",
-        "bytes": _ring_factor(dp) * (n_params / (tp * fsdp)) * 4.0,
+        "bytes": _ring_factor(dp) * (n_params / (tp * fsdp * pp)) * 4.0,
     }
     coll_fsdp = {
         "flops": 0.0, "channel": "ici",
-        "bytes": (3.0 * (fsdp - 1) / fsdp * (n_params / tp)
+        "bytes": (3.0 * (fsdp - 1) / fsdp * (n_params / (tp * pp))
                   * dtype_bytes if fsdp > 1 else 0.0),
     }
+    # pp: boundary activations each way per microbatch — the planner's
+    # pp_bytes formula exactly (2·m·(tok_local/m)·D·(pp-1)/pp; the
+    # microbatch count cancels out of the volume, not the bubble)
+    coll_pp = {
+        "flops": 0.0, "channel": "ici",
+        "bytes": (2.0 * tok_local * D * dtype_bytes * (pp - 1) / pp
+                  if pp > 1 else 0.0),
+    }
     phases = {"fwd_matmul": fwd_matmul, "fwd_attention": fwd_attention,
-              "bwd": bwd, "remat": remat_phase, "optimizer": optimizer,
+              "bwd": bwd, "remat": remat_phase,
+              "pp_bubble": bubble_phase, "optimizer": optimizer,
               "head_loss": head_loss, "coll_tp": coll_tp,
-              "coll_dp": coll_dp, "coll_fsdp": coll_fsdp}
+              "coll_dp": coll_dp, "coll_fsdp": coll_fsdp,
+              "coll_pp": coll_pp}
     total = {
         "flops": sum(p["flops"] for p in phases.values()),
         "bytes": sum(p["bytes"] for p in phases.values()

@@ -14,6 +14,77 @@ and the (small, immutable) config travel in the closure.
 from __future__ import annotations
 
 
+def _plan_pp(plan) -> int:
+    """The plan's pipeline degree (1 when absent/3D)."""
+    try:
+        return int(plan.axes.get("pp", 1))
+    except AttributeError:
+        return 1
+
+
+def resolve_plan_step(step_fn, cfg=None, mesh=None, plan=None,
+                      with_stats=False, **step_kw):
+    """ONE seam turning (step_fn, plan) into the callable the jit wraps.
+
+    pp=1 (or no plan): `functools.partial(step_fn, cfg=..., **kw)` —
+    exactly the historical behavior. pp>1: the family train step cannot
+    run as-is (its layer scan is on-chip; the stacked axis is now
+    stage-chunked over the 'pp' mesh axis), so the resolved fn is
+    parallel.pipeline_train.make_pp_step_fn's full-manual pipelined
+    step honoring the same (params, opt, batch) -> (loss, new_params,
+    new_opt) contract, with the optimizer kwargs (lr, betas, ...)
+    forwarded to the shared apply_adamw. Wrappers that already resolved
+    (the resilient guard, the telemetry instrumenter) mark their
+    closure `_plan_resolved` so make_train_step never double-resolves."""
+    import functools
+    if (_plan_pp(plan) > 1
+            and not getattr(step_fn, "_plan_resolved", False)):
+        if mesh is None:
+            raise ValueError("a pp>1 plan needs mesh= (build it with "
+                             "plan.build_mesh())")
+        from ..parallel.pipeline_train import make_pp_step_fn
+        fn = make_pp_step_fn(cfg, plan, mesh, with_stats=with_stats,
+                             **step_kw)
+        fn._plan_resolved = True
+        return fn
+    if cfg is not None:
+        step_kw = dict(step_kw, cfg=cfg)
+    return functools.partial(step_fn, **step_kw) if step_kw else step_fn
+
+
+def plan_step_cell(step_fn, cfg=None, mesh=None, plan=None, **step_kw):
+    """The mutable inner-resolution cell wrappers (the resilient guard,
+    the telemetry instrumenter) build over resolve_plan_step: returns
+    `(inner, outer, make_rebuild)` where `inner(params, opt, batch)`
+    dispatches to the CURRENT resolved step, `outer` is a one-slot dict
+    the wrapper must fill (`outer["fn"] = <its jit-facing closure>`),
+    and `make_rebuild()` is the `_plan_rebuild` hook for
+    `_ShardedTrainStep.rebuild`: it re-resolves the inner against a
+    degraded mesh/plan and returns a FRESH outer-forwarding wrapper —
+    fresh-identity is load-bearing, because jax's tracing cache keys on
+    function identity and re-jitting the same wrapper object would
+    silently reuse the old mesh's trace (its shard_map eqn bakes the
+    mesh in). ONE home so the subtlety cannot drift between wrappers."""
+    cell = {"fn": resolve_plan_step(step_fn, cfg=cfg, mesh=mesh,
+                                    plan=plan, **step_kw)}
+    outer = {}
+
+    def inner(*a, **k):
+        return cell["fn"](*a, **k)
+
+    def _plan_rebuild(new_mesh, new_plan):
+        cell["fn"] = resolve_plan_step(step_fn, cfg=cfg, mesh=new_mesh,
+                                       plan=new_plan, **step_kw)
+
+        def refreshed(*a, **k):
+            return outer["fn"](*a, **k)
+        refreshed._plan_resolved = True
+        refreshed._plan_rebuild = _plan_rebuild
+        return refreshed
+
+    return inner, outer, _plan_rebuild
+
+
 def make_train_step(step_fn, cfg=None, donate=True, extra_donate=(),
                     mesh=None, plan=None, **step_kw):
     """jit the stacked-params functional train step with the params and
@@ -50,15 +121,39 @@ def make_train_step(step_fn, cfg=None, donate=True, extra_donate=(),
     shapes; subsequent calls reuse the one compiled executable (the
     `trace_count` property observes this — the zero-recompiles-after-
     warmup test gate)."""
-    import functools
     import jax
     from ..profiler import RecordEvent, monitor
-    if cfg is not None:
-        step_kw["cfg"] = cfg
-    fn = functools.partial(step_fn, **step_kw) if step_kw else step_fn
     donate_argnums = ((0, 1) + tuple(extra_donate)) if donate else ()
     with RecordEvent("facade.make_train_step"):
         monitor.counter("facade_train_step_builds").add()
+        if (mesh is not None and _plan_pp(plan) > 1
+                and not getattr(step_fn, "_plan_resolved", False)):
+            # 4D plan on a raw family step: swap in the full-manual
+            # pipelined step (parallel/pipeline_train.py) with the
+            # schedule-stats tail; _PipelineTrainStep strips it and
+            # publishes train.bubble_fraction. Already-resolved
+            # wrappers (resilient guard, telemetry) take the plain
+            # _ShardedTrainStep branch below — their extra args/outputs
+            # pin replicated exactly like the 3D case. The re-resolve
+            # on mesh change rides the SAME _plan_rebuild hook the
+            # wrappers use (_ShardedTrainStep.rebuild — one mechanism):
+            # each resolution wraps in a fresh closure carrying the
+            # hook, so a pp->pp1->pp degrade chain keeps re-resolving.
+            def _resolve(new_mesh, new_plan):
+                inner = resolve_plan_step(step_fn, cfg=cfg,
+                                          mesh=new_mesh, plan=new_plan,
+                                          with_stats=True, **step_kw)
+
+                def stepfn(params, opt_state, batch, *rest):
+                    return inner(params, opt_state, batch, *rest)
+                stepfn._plan_resolved = True
+                stepfn._plan_rebuild = _resolve
+                return stepfn
+            return _PipelineTrainStep(
+                _resolve(mesh, plan), mesh, plan,
+                donate_argnums=donate_argnums)
+        fn = resolve_plan_step(step_fn, cfg=cfg, mesh=mesh, plan=plan,
+                               **step_kw)
         if mesh is None:
             return jax.jit(fn, donate_argnums=donate_argnums)
         return _ShardedTrainStep(fn, mesh, plan,
@@ -95,13 +190,11 @@ class _ShardedTrainStep:
 
     @staticmethod
     def _leaf_name(path):
-        import jax.tree_util as jtu
-        for entry in reversed(path):
-            if isinstance(entry, jtu.DictKey):
-                return str(entry.key)
-            if isinstance(entry, jtu.GetAttrKey):
-                return str(entry.name)
-        return ""
+        # ONE home: parallel.mesh.leaf_path_name — the manual pp step's
+        # shard_map specs resolve by the same rule, and pins/specs must
+        # agree leaf for leaf
+        from ..parallel.mesh import leaf_path_name
+        return leaf_path_name(path)
 
     def _state_pins(self, tree):
         """Name-keyed spec lookup, shape-aware (params AND opt trees)."""
@@ -225,6 +318,19 @@ class _ShardedTrainStep:
         self._jit = None
         self.in_pins = None
         self.out_pins = None
+        # wrapped steps that bake plan internals into their closure
+        # (the resilient guard / telemetry instrumenter over a pp>1
+        # pipelined inner — parallel/pipeline_train.py) expose a
+        # re-resolution hook; 3D closures are mesh-agnostic and carry
+        # none. The hook returns a FRESH callable: jax's jaxpr-tracing
+        # cache keys on function identity, so re-jitting the SAME
+        # wrapper object would silently reuse the old trace with the
+        # old mesh baked into its shard_map eqn.
+        hook = getattr(self._fn, "_plan_rebuild", None)
+        if hook is not None:
+            fresh = hook(self.mesh, self.plan)
+            if fresh is not None:
+                self._fn = fresh
         from ..profiler import monitor
         monitor.counter("facade_train_step_rebuilds").add()
         return self
@@ -239,6 +345,41 @@ class _ShardedTrainStep:
             return self._jit._cache_size()
         except AttributeError:       # jax moved the private counter
             return -1
+
+
+class _PipelineTrainStep(_ShardedTrainStep):
+    """make_train_step's pp>1 flavor: the compiled fn is the full-manual
+    pipelined step (parallel/pipeline_train.py) whose output carries a
+    trailing schedule-measured bubble-fraction scalar. The wrapper
+    strips it — callers see the facade triple — and publishes it as the
+    `train.bubble_fraction` gauge at warmup (the 1F1B schedule is
+    static per executable, so the warmup measurement IS the
+    measurement; re-pulling it every step would add a host sync for a
+    constant). A rebuild re-resolves the pipelined fn against the new
+    mesh/plan through the base class's `_plan_rebuild` hook — ONE
+    mechanism shared with the guard/instrumenter wrappers (the closure
+    bakes the stage grid in, unlike the 3D step whose layouts live
+    entirely in the pins); this subclass only resets the
+    measurement."""
+
+    def __init__(self, fn, mesh, plan, donate_argnums=()):
+        super().__init__(fn, mesh, plan, donate_argnums=donate_argnums)
+        self.bubble_fraction = None
+
+    def __call__(self, params, opt_state, batch, *rest):
+        out = super().__call__(params, opt_state, batch, *rest)
+        if len(out) > 3 and self.bubble_fraction is None:
+            import numpy as np
+            from ..profiler import monitor
+            self.bubble_fraction = float(np.asarray(out[3]))
+            monitor.gauge("train.bubble_fraction").set(
+                round(self.bubble_fraction, 6))
+        return tuple(out[:3])
+
+    def rebuild(self, mesh=None, plan=None):
+        super().rebuild(mesh=mesh, plan=plan)
+        self.bubble_fraction = None
+        return self
 
 
 class FacadeModel:

@@ -92,6 +92,23 @@ class use_mesh:
         return False
 
 
+def leaf_path_name(path) -> str:
+    """Last dict/attr key on a jax tree path — the ONE name-keyed
+    lookup rule shared by the facade's pinned step
+    (models/facade._ShardedTrainStep) and the manual pp step's
+    shard_map specs (parallel/pipeline_train.py): both resolve a leaf's
+    PartitionSpec from the plan's spec table by this name, so the rule
+    living in one place is what keeps pins and specs agreeing leaf for
+    leaf."""
+    import jax.tree_util as jtu
+    for entry in reversed(path):
+        if isinstance(entry, jtu.DictKey):
+            return str(entry.key)
+        if isinstance(entry, jtu.GetAttrKey):
+            return str(entry.name)
+    return ""
+
+
 def _clean_spec(spec: PartitionSpec, mesh: Mesh,
                 shape: Optional[Sequence[int]] = None) -> PartitionSpec:
     """Adapt `spec` to `mesh`: drop axes the mesh doesn't have (per

@@ -45,7 +45,7 @@ def naive_bubble_fraction(n_stages: int) -> float:
 
 def spmd_pipeline(stage_fn: Callable, n_stages: int, n_microbatches: int,
                   axis_name: str = "pp", interleave: int = 1,
-                  with_aux: bool = False):
+                  with_aux: bool = False, schedule_stats: bool = False):
     """Lift `stage_fn(chunk_params, x) -> y` into a pipelined
     `fn(stacked_params, microbatched_x) -> microbatched_y`.
 
@@ -77,6 +77,16 @@ def spmd_pipeline(stage_fn: Callable, n_stages: int, n_microbatches: int,
     perf/pipeline_ab.json). Virtual-stage interleaving genuinely helps
     only under the host-driven schedule, where it lives:
     parallel.host_pipeline.HostPipeline (measured -21% at v=2).
+
+    schedule_stats=True: the scan additionally counts USEFUL stage-tick
+    slots in-jit (stage s holds a real microbatch on ticks
+    [s, s+m) — the warmup/cooldown slots compute on garbage, which IS
+    the bubble) and returns (outputs, {"busy", "ticks", "stages"}) —
+    busy psum'd over the pp axis, so
+    1 - busy / (stages·ticks) is the MEASURED schedule bubble the
+    train.bubble_fraction gauge publishes
+    (parallel/pipeline_train.py). Mutually exclusive with with_aux
+    (the MoE path has no consumer for it yet).
     """
     if interleave != 1:
         raise ValueError(
@@ -84,6 +94,8 @@ def spmd_pipeline(stage_fn: Callable, n_stages: int, n_microbatches: int,
             "synchronous formulation makes virtual stages a strict "
             "throughput loss (see perf/pipeline_ab.json). Use "
             "parallel.host_pipeline.HostPipeline for interleaved 1F1B.")
+    if schedule_stats and with_aux:
+        raise ValueError("schedule_stats does not compose with with_aux")
     p = n_stages
 
     def pipelined(local_params, x_mb):
@@ -98,8 +110,11 @@ def spmd_pipeline(stage_fn: Callable, n_stages: int, n_microbatches: int,
         # ppermute) exists ONLY when requested — the dense pipeline
         # carries no dead collectives
         def tick(carry, t):
+            busy = None
             if with_aux:
                 state, aux_state, outputs, aux_out = carry
+            elif schedule_stats:
+                state, outputs, busy = carry
             else:
                 state, outputs = carry
             # stage 0 ingests microbatch t (clamped); every other stage
@@ -137,6 +152,14 @@ def spmd_pipeline(stage_fn: Callable, n_stages: int, n_microbatches: int,
             if with_aux:
                 aux_state = jax.lax.ppermute(aux_new, axis_name, perm)
                 return (state, aux_state, outputs, aux_out), None
+            if schedule_stats:
+                # a stage-tick slot is USEFUL iff this stage holds a
+                # real microbatch: stage s works on mb (t - s) — in
+                # range exactly for t in [s, s+m)
+                useful = jnp.logical_and(t >= stage,
+                                         t < stage + n_microbatches)
+                busy = busy + useful.astype(busy.dtype)
+                return (state, outputs, busy), None
             return (state, outputs), None
 
         # pcast-to-varying: carries are device-varying over pp from tick one,
@@ -152,6 +175,10 @@ def spmd_pipeline(stage_fn: Callable, n_stages: int, n_microbatches: int,
             (_, _, outputs, aux_out), _ = jax.lax.scan(
                 tick, (state0, aux0, outputs0, aux_out0),
                 jnp.arange(n_ticks))
+        elif schedule_stats:
+            busy0 = vary(jnp.zeros((), jnp.float32))
+            (_, outputs, busy), _ = jax.lax.scan(
+                tick, (state0, outputs0, busy0), jnp.arange(n_ticks))
         else:
             (_, outputs), _ = jax.lax.scan(
                 tick, (state0, outputs0), jnp.arange(n_ticks))
@@ -166,6 +193,10 @@ def spmd_pipeline(stage_fn: Callable, n_stages: int, n_microbatches: int,
                     axis_name)
         if with_aux:
             return outputs, aux_out
+        if schedule_stats:
+            stats = {"busy": jax.lax.psum(busy, axis_name),
+                     "ticks": float(n_ticks), "stages": float(p)}
+            return outputs, stats
         return outputs
 
     return pipelined

@@ -362,12 +362,15 @@ def plan_parallel(cfg_or_spec, n_devices: int, global_batch: int,
 # ------------------------------------------------------- executable plans
 @dataclass
 class TrainPlan:
-    """An EXECUTABLE 3D assignment: what models.facade.make_train_step
+    """An EXECUTABLE 3D/4D assignment: what models.facade.make_train_step
     (mesh=, plan=) consumes. `axes` materializes through
     parallel.mesh.build_mesh; `specs` is the family's module-level
     PARAM_SPECS table remapped onto those axes (parallel.mesh.remap_specs
-    — the TP split lands on `tp`, ZeRO-3 on `fsdp`, 'pp' drops because
-    the stacked layer axis scans on-chip in the 3D formulation);
+    — the TP split lands on `tp`, ZeRO-3 on `fsdp`; 'pp' drops to the
+    on-chip layer scan in the 3D formulation, but SURVIVES as the
+    stage-chunk axis when the plan carries pp>1: the stacked layer dim
+    shards over the 'pp' mesh axis and the step runs the 1F1B
+    microbatched pipeline of parallel/pipeline_train.py);
     `batch_axes` names the axes the global batch shards over (dp×fsdp).
     `plan` keeps the priced cost-model row the choice came from."""
     axes: Dict[str, int]
@@ -379,6 +382,14 @@ class TrainPlan:
     @property
     def name(self) -> str:
         return "_".join(f"{a}{n}" for a, n in self.axes.items())
+
+    @property
+    def pp(self) -> int:
+        return int(self.axes.get("pp", 1))
+
+    @property
+    def microbatches(self) -> int:
+        return int(getattr(self.plan, "microbatches", 1) or 1)
 
     def build_mesh(self, devices=None):
         from .mesh import build_mesh
@@ -407,29 +418,95 @@ def _resolve_param_specs(cfg) -> Optional[Dict]:
     return getattr(mod, "PARAM_SPECS", None)
 
 
+def _pick_microbatches(b_local: int, pp: int) -> Optional[int]:
+    """The microbatch count a pp>1 plan runs: the largest divisor of the
+    per-(dp×fsdp)-shard batch not exceeding 4·pp (deeper pipelines want
+    more microbatches to amortize the (pp-1)/m bubble; past ~4·pp the
+    returns flatten while the per-microbatch tensors shrink below
+    efficient tile sizes). None when the shard admits no split (a
+    1-row shard cannot microbatch)."""
+    for m in range(min(int(b_local), 4 * pp), 1, -1):
+        if b_local % m == 0:
+            return m
+    return None
+
+
+def _pp_manual_constraints(spec: ModelSpec, dp: int, fsdp: int, tp: int,
+                           pp: int, global_batch: int,
+                           microbatches: Optional[int] = None
+                           ) -> tuple:
+    """(problems, microbatches) for a pp>1 assignment. The pipelined
+    step is a FULL-manual shard_map (parallel/pipeline_train.py — this
+    container's legacy GSPMD fatally aborts partial-auto shard_map, so
+    every axis is hand-partitioned), which cannot shape-degrade per
+    leaf the way GSPMD does; the extra divisibilities are therefore
+    plan-level legality, each named."""
+    problems = []
+    if spec.num_layers % pp:
+        problems.append(f"pp={pp} does not divide num_layers="
+                        f"{spec.num_layers} (stage chunking needs equal "
+                        "layer chunks per stage)")
+    if tp > 1 and spec.vocab_size and spec.vocab_size % tp:
+        problems.append(f"tp={tp} does not divide vocab_size="
+                        f"{spec.vocab_size} (the pp step's manual "
+                        "vocab-parallel embedding/head)")
+    if fsdp > 1 and spec.hidden_size % fsdp:
+        problems.append(f"fsdp={fsdp} does not divide hidden_size="
+                        f"{spec.hidden_size} (the pp step's manual "
+                        "ZeRO-3 weight gather)")
+    b_local = global_batch // max(dp * fsdp, 1) \
+        if global_batch % max(dp * fsdp, 1) == 0 else 0
+    mb = microbatches or (_pick_microbatches(b_local, pp) if b_local
+                          else None)
+    if not mb or mb < 2 or (b_local and b_local % mb):
+        problems.append(
+            f"microbatches={microbatches or mb} does not split the "
+            f"per-shard batch global_batch/(dp*fsdp)="
+            f"{b_local or '<indivisible>'} into >=2 equal microbatches "
+            f"(pp={pp} needs a 1F1B schedule)")
+        mb = mb or 0
+    return problems, int(mb or 0)
+
+
 def plan_train(cfg_or_spec, n_devices: int, global_batch: int,
                chip: Optional[ChipSpec] = None, dp: Optional[int] = None,
                fsdp: Optional[int] = None, tp: Optional[int] = None,
+               pp: Optional[int] = None,
+               microbatches: Optional[int] = None,
                tp_axis: str = "tp", param_specs: Optional[Dict] = None,
                **kw) -> TrainPlan:
-    """The executable dp×fsdp×tp assignment for a model config: search
-    the cost model (pp excluded — the 3D train step scans the stacked
-    layer axis on-chip; pass explicit dp/fsdp/tp degrees to skip the
-    search), then emit the {axes -> PartitionSpec tree} contract:
-    mesh axes for build_mesh, the family PARAM_SPECS remapped onto them,
-    and the dp×fsdp batch spec. Illegal explicit degrees raise naming
-    the violated constraint, same as plan_parallel.
+    """The executable dp×fsdp×tp(×pp) assignment for a model config:
+    search the cost model, then emit the {axes -> PartitionSpec tree}
+    contract: mesh axes for build_mesh, the family PARAM_SPECS remapped
+    onto them, and the dp×fsdp batch spec. Pass explicit degrees
+    (dp/fsdp/tp, optionally pp + microbatches) to skip the search;
+    illegal explicit degrees raise naming the violated constraint, same
+    as plan_parallel.
+
+    Pipeline parallelism (docs/parallel_training.md): the search
+    prefers pp=1 (the 3D step scans the stacked layer axis on-chip —
+    no bubble, no boundary traffic) and emits pp>1 ONLY through the
+    HBM gate: when no dp×fsdp×tp assignment fits per-chip memory even
+    at fsdp=max, stage-chunking the layer stack over a 'pp' mesh axis
+    is the remaining lever (it divides per-stage weights AND
+    activations, and microbatching divides the logit working set).
+    A pp>1 plan carries the extra manual-step legality constraints
+    (_pp_manual_constraints) and a microbatch count with
+    (pp-1)/microbatches priced as its bubble.
 
     Also publishes the chosen degrees as the `train.plan.*` monitor
     gauge family (docs/observability.md) so a run's telemetry stream
     records WHICH plan it executed."""
     spec = _coerce_spec(cfg_or_spec)
-    if any(d is not None for d in (dp, fsdp, tp)):
-        dp, fsdp, tp = (int(d or 1) for d in (dp, fsdp, tp))
+    chip = chip or ChipSpec()
+    if any(d is not None for d in (dp, fsdp, tp, pp)):
+        dp, fsdp, tp, pp = (int(d or 1) for d in (dp, fsdp, tp, pp))
         problems = []
-        if dp * fsdp * tp != n_devices:
-            problems.append(f"dp*fsdp*tp = {dp}*{fsdp}*{tp} = "
-                            f"{dp * fsdp * tp} != n_devices={n_devices}")
+        if dp * fsdp * tp * pp != n_devices:
+            wanted = (f"dp*fsdp*tp = {dp}*{fsdp}*{tp}" if pp == 1 else
+                      f"dp*fsdp*tp*pp = {dp}*{fsdp}*{tp}*{pp}")
+            problems.append(f"{wanted} = {dp * fsdp * tp * pp} != "
+                            f"n_devices={n_devices}")
         if spec.num_heads % tp or spec.ffn_hidden % tp:
             problems.append(f"tp={tp} does not divide num_heads="
                             f"{spec.num_heads}/ffn_hidden="
@@ -437,22 +514,69 @@ def plan_train(cfg_or_spec, n_devices: int, global_batch: int,
         if global_batch % (dp * fsdp):
             problems.append(f"global_batch={global_batch} is not "
                             f"divisible by dp*fsdp={dp * fsdp}")
+        mb = 1
+        if pp > 1:
+            pp_problems, mb = _pp_manual_constraints(
+                spec, dp, fsdp, tp, pp, global_batch, microbatches)
+            problems.extend(pp_problems)
+        elif microbatches and microbatches > 1:
+            problems.append(f"microbatches={microbatches} needs pp>1 "
+                            "(the 3D step has no pipeline to fill)")
         if problems:
-            raise ValueError("illegal 3D plan: " + "; ".join(problems))
-        best = _estimate(Plan(dp=dp, mp=tp, fsdp=fsdp), spec,
-                         global_batch, chip or ChipSpec())
+            # NoFeasiblePlanError IS a ValueError (historical callers
+            # keep matching); `constraint` names the violation for the
+            # elastic controller's diagnosis path
+            raise NoFeasiblePlanError(
+                f"illegal {'4D' if pp > 1 else '3D'} plan: "
+                + "; ".join(problems),
+                constraint="; ".join(problems))
+        best = _estimate(Plan(dp=dp, mp=tp, pp=pp, fsdp=fsdp,
+                              microbatches=mb), spec, global_batch, chip)
     else:
-        plans = [p for p in enumerate_plans(spec, n_devices, global_batch,
-                                            chip, **kw) if p.pp == 1]
-        if not plans:
+        plans = enumerate_plans(spec, n_devices, global_batch, chip, **kw)
+        pp1 = [p for p in plans if p.pp == 1]
+        best = next((p for p in pp1 if p.fits), None)
+        if best is None:
+            # HBM gate: nothing fits flat, even at fsdp=max — the priced
+            # enumeration may emit pp>1 (stage chunks divide per-chip
+            # layer weights AND activations; microbatches divide the
+            # logit working set). Only manual-step-legal candidates
+            # qualify; the microbatch count is re-picked per candidate
+            # so the priced bubble is the one the step will run.
+            for cand in (p for p in plans if p.pp > 1 and p.fits):
+                probs, mb = _pp_manual_constraints(
+                    spec, cand.dp, cand.fsdp, cand.mp, cand.pp,
+                    global_batch)
+                if probs:
+                    continue
+                priced = _estimate(
+                    Plan(dp=cand.dp, mp=cand.mp, pp=cand.pp,
+                         fsdp=cand.fsdp, microbatches=mb),
+                    spec, global_batch, chip)
+                # re-CHECK fits with the REAL microbatch count: the
+                # enumeration priced this candidate at ~4·pp
+                # microbatches, and a smaller legal mb grows the logit
+                # working set — an OOM re-estimate must not win over a
+                # deeper candidate whose mb is viable
+                if priced.fits:
+                    best = priced
+                    break
+        if best is None and pp1:
+            best = pp1[0]            # least-bad OOM 3D plan, still priced
+        if best is None:
             raise ValueError(
-                f"no legal (dp, fsdp, tp) assignment for {n_devices} "
-                f"devices (pp excluded — 3D train plan): "
+                f"no legal (dp, fsdp, tp[, pp]) assignment for "
+                f"{n_devices} devices: "
                 + _diagnose_empty(spec, n_devices, global_batch,
-                                  kw.get("max_mp"), max_pp=1))
-        best = plans[0]
+                                  kw.get("max_mp")))
     axes = {"dp": best.dp, "fsdp": best.fsdp, tp_axis: best.mp}
     mapping = {"dp": "dp", "fsdp": "fsdp", "mp": tp_axis}
+    if best.pp > 1:
+        # the stacked layer axis SURVIVES as the stage-chunk axis: the
+        # remapped specs shard it over 'pp' and the mesh carries all
+        # four axes (degree-1 included — the manual step names them all)
+        axes["pp"] = best.pp
+        mapping["pp"] = "pp"
     if param_specs is None:
         param_specs = _resolve_param_specs(cfg_or_spec)
     specs = None
@@ -463,6 +587,17 @@ def plan_train(cfg_or_spec, n_devices: int, global_batch: int,
     for ax, n in axes.items():
         monitor.gauge(f"train.plan.{ax}").set(n)
     monitor.gauge("train.plan.n_devices").set(best.n_devices)
+    # the pp family publishes UNCONDITIONALLY: after an elastic degrade
+    # collapses pp>1 back onto the layer scan, stale train.plan.pp /
+    # microbatches / bubble_fraction gauges would keep advertising the
+    # old 4D plan in telemetry_report's train_plan block. pp=1 resets
+    # them (bubble 0.0 = no pipeline; the pp>1 step's measured value
+    # overwrites at its warmup).
+    monitor.gauge("train.plan.pp").set(best.pp)
+    monitor.gauge("train.plan.microbatches").set(
+        best.microbatches if best.pp > 1 else 1)
+    if best.pp <= 1:
+        monitor.gauge("train.bubble_fraction").set(0.0)
     return TrainPlan(axes=axes, mapping=mapping,
                      batch_axes=("dp", "fsdp"), plan=best, specs=specs)
 
@@ -477,19 +612,24 @@ def degrade_plan(cfg_or_spec, old: TrainPlan, n_surviving: int,
                  param_specs: Optional[Dict] = None) -> TrainPlan:
     """Degrade `old` onto at most `n_surviving` devices after device
     loss (parallel/elastic.py). Preference order: **dp gives way first,
-    then fsdp, and tp is held** — re-slicing the TP split would change
-    the per-layer collective pattern and the head partitioning (the
-    most expensive reshard), while shrinking dp/fsdp only re-shards the
+    then fsdp, and tp AND pp are held** — re-slicing the TP split would
+    change the per-layer collective pattern and the head partitioning,
+    and re-chunking the pipeline stages would re-slice every stacked
+    leaf's stage windows AND change the 1F1B schedule (both the most
+    expensive reshards), while shrinking dp/fsdp only re-shards the
     batch and the ZeRO-3 windows, which the checkpoint manifest
     re-slices for free (docs/fault_tolerance.md). Candidates rank
     largest-surviving-world-first so the degrade strands as few chips
-    as possible; when no tp-held candidate is legal (e.g. tp itself
-    exceeds the survivors) the full `plan_train` search runs on every
-    world size down from `n_surviving`.
+    as possible; when no held candidate is legal (e.g. tp·pp itself
+    exceeds the survivors) the full search runs on every world size
+    down from `n_surviving` — collapsing pipeline stages (pp shrinks
+    toward the layer scan) only when the survivors cannot form the old
+    stage grid.
 
     Raises NoFeasiblePlanError naming the violated constraint when
-    nothing fits — divisibility via the `_diagnose_empty` walk, HBM
-    with the per-chip state bytes spelled out."""
+    nothing fits — divisibility (including the pp stage-grid
+    constraint) via the `_diagnose_empty` walk, HBM with the per-chip
+    state bytes spelled out."""
     spec = _coerce_spec(cfg_or_spec)
     chip = chip or ChipSpec()
     if n_surviving < 1:
@@ -499,35 +639,63 @@ def degrade_plan(cfg_or_spec, old: TrainPlan, n_surviving: int,
     dp0 = old.axes.get("dp", 1)
     fsdp0 = old.axes.get("fsdp", 1)
     tp0 = old.axes.get(tp_axis, 1)
+    pp0 = old.axes.get("pp", 1)
     oom = []                      # legal-but-OOM candidates, for the error
-    # tp-held lattice: every (dp' | dp, fsdp' | fsdp) shrink keeps the
-    # batch divisibility old already satisfied; rank by total desc, then
-    # PREFER the larger fsdp' (i.e. shrink dp before fsdp). Candidates
-    # are priced with _estimate only; plan_train (which publishes the
-    # train.plan.* gauges) runs once, for the winner.
+    # tp·pp-held lattice: every (dp' | dp, fsdp' | fsdp) shrink keeps
+    # the batch divisibility old already satisfied; rank by total desc,
+    # then PREFER the larger fsdp' (i.e. shrink dp before fsdp).
+    # Candidates are priced with _estimate only; plan_train (which
+    # publishes the train.plan.* gauges) runs once, for the winner.
     cands = sorted(((dp, fsdp) for dp in _divisors_desc(dp0)
                     for fsdp in _divisors_desc(fsdp0)
-                    if dp * fsdp * tp0 <= n_surviving),
-                   key=lambda c: (-(c[0] * c[1] * tp0), -c[1], -c[0]))
+                    if dp * fsdp * tp0 * pp0 <= n_surviving),
+                   key=lambda c: (-(c[0] * c[1] * tp0 * pp0), -c[1],
+                                  -c[0]))
     for dp, fsdp in cands:
-        priced = _estimate(Plan(dp=dp, mp=tp0, fsdp=fsdp), spec,
-                           global_batch, chip)
+        mb = 1
+        if pp0 > 1:
+            probs, mb = _pp_manual_constraints(spec, dp, fsdp, tp0, pp0,
+                                               global_batch)
+            if probs:
+                continue          # this shrink can't microbatch — skip
+        priced = _estimate(Plan(dp=dp, mp=tp0, pp=pp0, fsdp=fsdp,
+                                microbatches=mb), spec, global_batch,
+                           chip)
         if priced.fits:
-            return plan_train(cfg_or_spec, dp * fsdp * tp0, global_batch,
-                              chip=chip, dp=dp, fsdp=fsdp, tp=tp0,
+            return plan_train(cfg_or_spec, dp * fsdp * tp0 * pp0,
+                              global_batch, chip=chip, dp=dp, fsdp=fsdp,
+                              tp=tp0, pp=pp0,
+                              microbatches=mb if pp0 > 1 else None,
                               tp_axis=tp_axis, param_specs=param_specs)
         oom.append(priced)
-    # tp cannot be held (or every held candidate is OOM): full search,
-    # largest world first
+    # tp/pp cannot be held (or every held candidate is OOM): full
+    # search, largest world first — pp=1 plans preferred (stage
+    # collapse back onto the layer scan), pp>1 only through the same
+    # HBM-gate legality plan_train's search applies
     for n in range(n_surviving, 0, -1):
-        fitting = [p for p in enumerate_plans(spec, n, global_batch,
-                                              chip) if p.pp == 1]
-        oom.extend(p for p in fitting if not p.fits)
-        fitting = [p for p in fitting if p.fits]
-        if fitting:
-            best = fitting[0]
+        plans = enumerate_plans(spec, n, global_batch, chip)
+        oom.extend(p for p in plans if p.pp == 1 and not p.fits)
+        best = next((p for p in plans if p.pp == 1 and p.fits), None)
+        mb = None
+        if best is None:
+            for p in (q for q in plans if q.pp > 1 and q.fits):
+                probs, cand_mb = _pp_manual_constraints(
+                    spec, p.dp, p.fsdp, p.mp, p.pp, global_batch)
+                if probs:
+                    continue
+                priced = _estimate(Plan(dp=p.dp, mp=p.mp, pp=p.pp,
+                                        fsdp=p.fsdp,
+                                        microbatches=cand_mb),
+                                   spec, global_batch, chip)
+                # same re-check as plan_train's HBM gate: fits must
+                # hold at the REAL microbatch count
+                if priced.fits:
+                    best, mb = priced, cand_mb
+                    break
+        if best is not None:
             return plan_train(cfg_or_spec, n, global_batch, chip=chip,
                               dp=best.dp, fsdp=best.fsdp, tp=best.mp,
+                              pp=best.pp, microbatches=mb,
                               tp_axis=tp_axis, param_specs=param_specs)
     if oom:
         best = min(oom, key=lambda p: p.mem_bytes)
@@ -538,10 +706,9 @@ def degrade_plan(cfg_or_spec, old: TrainPlan, n_surviving: int,
             f" GB even at max sharding",
             constraint=f"hbm: {best.mem_bytes / 1e9:.2f} GB/chip > "
                        f"{0.9 * chip.hbm_bytes / 1e9:.2f} GB")
-    reason = _diagnose_empty(spec, n_surviving, global_batch, None,
-                             max_pp=1)
+    reason = _diagnose_empty(spec, n_surviving, global_batch, None)
     raise NoFeasiblePlanError(
-        f"no legal degraded (dp, fsdp, tp) assignment for "
+        f"no legal degraded (dp, fsdp, tp, pp) assignment for "
         f"{n_surviving} surviving devices: {reason}", constraint=reason)
 
 

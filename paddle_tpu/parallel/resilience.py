@@ -116,10 +116,16 @@ def make_resilient_step(step_fn, cfg=None, donate: bool = True,
     nan-folded skip."""
     import jax
     import jax.numpy as jnp
-    from ..models.facade import make_train_step
-    if cfg is not None:
-        step_kw["cfg"] = cfg
-    inner = functools.partial(step_fn, **step_kw) if step_kw else step_fn
+    from ..models.facade import make_train_step, plan_step_cell
+    # pp>1 plans swap the family step for the full-manual pipelined one
+    # HERE (the guard wraps the resolved fn, so the select + ok flag
+    # ride the 4D step exactly like the 3D one); the cell's
+    # _plan_rebuild hook lets the elastic rebuild seam re-resolve the
+    # pipelined inner against a degraded mesh (a pp closure bakes the
+    # stage grid in; 3D closures are mesh-agnostic) — see
+    # models/facade.plan_step_cell for the fresh-identity subtlety
+    inner, _outer, _plan_rebuild = plan_step_cell(
+        step_fn, cfg=cfg, mesh=mesh, plan=plan, **step_kw)
 
     def tree_finite(tree):
         fin = jnp.asarray(True)
@@ -146,6 +152,9 @@ def make_resilient_step(step_fn, cfg=None, donate: bool = True,
             params, opt_state, batch, poison)
         return jnp.where(ok, loss, jnp.nan), kept_params, kept_opt, ok
 
+    guarded._plan_resolved = True
+    guarded._plan_rebuild = _plan_rebuild
+    _outer["fn"] = guarded
     if telemetry is None:
         # the facade owns the jit/donation policy (ONE home — see
         # models/facade.py); the guard only adds the select + ok flag
@@ -172,6 +181,9 @@ def make_resilient_step(step_fn, cfg=None, donate: bool = True,
         return (jnp.where(ok, loss, jnp.nan), kept_params, kept_opt, ok,
                 tstate)
 
+    guarded_telemetry._plan_resolved = True
+    guarded_telemetry._plan_rebuild = _plan_rebuild
+    _outer["fn"] = guarded_telemetry
     return make_train_step(guarded_telemetry, donate=donate,
                            extra_donate=(4,), mesh=mesh, plan=plan)
 

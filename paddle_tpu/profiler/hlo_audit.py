@@ -53,6 +53,7 @@ _SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
 _GROUPS_RE = re.compile(
     r"replica_groups=(\{\{[\d,{} ]*\}\}|\[[\d,]+\]<=\[[\d,]+\]"
     r"(?:T\([\d,]+\))?)")
+_PAIRS_RE = re.compile(r"source_target_pairs=\{((?:\{\d+,\d+\},?)*)\}")
 
 
 def _type_bytes(type_str: str, async_start: bool = False) -> int:
@@ -117,6 +118,31 @@ def _axis_groupings(mesh_axes: Dict[str, int]) -> Dict[frozenset, tuple]:
     return out
 
 
+def _pairs_axes(pairs: List[Tuple[int, int]],
+                mesh_axes: Dict[str, int]) -> Optional[tuple]:
+    """The mesh-axis combination a collective-permute's
+    source_target_pairs vary (every pair's endpoints agree on all OTHER
+    axis coordinates — e.g. the 1F1B stage-handoff ring varies exactly
+    'pp'); None when the pairs cross axes inconsistently or ids fall
+    outside the mesh."""
+    import numpy as np
+    if not pairs:
+        return None
+    names = list(mesh_axes)
+    sizes = [int(mesh_axes[n]) for n in names]
+    total = int(np.prod(sizes))
+    if any(s >= total or t >= total for s, t in pairs):
+        return None
+    varying = set()
+    for s, t in pairs:
+        cs = np.unravel_index(s, sizes)
+        ct = np.unravel_index(t, sizes)
+        varying.update(i for i in range(len(names)) if cs[i] != ct[i])
+    if not varying:
+        return None
+    return tuple(names[i] for i in sorted(varying))
+
+
 def parse_hlo_collectives(hlo_text: str,
                           mesh_axes: Optional[Dict[str, int]] = None
                           ) -> List[dict]:
@@ -124,7 +150,11 @@ def parse_hlo_collectives(hlo_text: str,
     ``{"op", "bytes", "count", "axes", "groups"}`` rows aggregated by
     (op, axes, group structure). `axes` is the mesh-axis combination
     the replica groups vary (None when they match no combination — a
-    resharding group structure)."""
+    resharding group structure). collective-permutes carry
+    source_target_pairs instead of replica_groups; their `axes` is the
+    combination the pairs vary (`_pairs_axes`) — how the pp plan's
+    expected stage-handoff ring is told apart from an involuntary
+    resharding move."""
     groupings = _axis_groupings(mesh_axes) if mesh_axes else {}
     rows: Dict[tuple, dict] = {}
     for line in hlo_text.splitlines():
@@ -137,6 +167,14 @@ def parse_hlo_collectives(hlo_text: str,
         key_groups = frozenset(frozenset(g) for g in groups)
         axes = groupings.get(key_groups) if groups else None
         group_size = len(groups[0]) if groups else 0
+        if axes is None and op == "collective-permute" and mesh_axes:
+            pm = _PAIRS_RE.search(line)
+            if pm:
+                pairs = [tuple(int(x) for x in p.split(","))
+                         for p in re.findall(r"\{(\d+,\d+)\}",
+                                             pm.group(0))]
+                axes = _pairs_axes(pairs, mesh_axes)
+                group_size = group_size or 2
         # size-1 groups are partitioner no-ops (degree-1 axis residue)
         if groups and group_size <= 1:
             continue
@@ -162,9 +200,15 @@ def expected_collectives(plan) -> Dict[tuple, set]:
     - dp, and the combined dp×fsdp batch axes: gradient/loss
       reductions (all-reduce; reduce-scatter under sharded grads), and
       the batch all-gathers GSPMD inserts where a replicated value is
-      rebuilt from batch-sharded shards.
-    Everything NOT in this map — collective-permute above all — is a
-    resharding collective and audits as a finding."""
+      rebuilt from batch-sharded shards;
+    - pp (pp>1 plans only — the full-manual pipelined step of
+      parallel/pipeline_train.py): the 1F1B stage-handoff
+      collective-permute RING over the pp axis plus the output/loss
+      broadcast all-reduce, and — because the manual step psums each
+      gradient leaf over exactly the axes its spec does not name —
+      all-reduces over EVERY combination of the live mesh axes.
+    Everything NOT in this map — an involuntary resharding
+    collective-permute above all — audits as a finding."""
     from ..cost_model import _plan_degrees
     deg = _plan_degrees(plan)
     exp: Dict[tuple, set] = {}
@@ -177,6 +221,18 @@ def expected_collectives(plan) -> Dict[tuple, set]:
     batch = tuple(a for a in ("dp", "fsdp") if deg[a] > 1)
     if len(batch) > 1:
         exp[batch] = {"all-reduce", "reduce-scatter", "all-gather"}
+    if deg.get("pp", 1) > 1:
+        live = [a for a in ("dp", "fsdp", "tp", "pp") if deg[a] > 1]
+        for r in range(1, len(live) + 1):
+            for combo in itertools.combinations(live, r):
+                exp.setdefault(combo, set()).add("all-reduce")
+        exp.setdefault(("pp",), set()).add("collective-permute")
+        if deg["tp"] > 1:
+            # the qkv column re-gather + CE max gather, and their
+            # reduce-scatter transposes
+            exp[("tp",)] |= {"all-gather", "reduce-scatter"}
+        if deg["fsdp"] > 1:
+            exp[("fsdp",)] |= {"all-gather", "reduce-scatter"}
     return exp
 
 
@@ -187,6 +243,8 @@ def diff_vs_expected(collectives: List[dict], expected: Dict[tuple, set]
     findings = []
     for row in collectives:
         axes = tuple(row["axes"]) if row["axes"] else None
+        if axes is not None and row["op"] in expected.get(axes, ()):
+            continue      # planned — incl. the pp stage-handoff ring
         if axes is None:
             findings.append(dict(
                 row, kind="resharding_groups",
@@ -197,7 +255,7 @@ def diff_vs_expected(collectives: List[dict], expected: Dict[tuple, set]
                 row, kind="resharding_permute",
                 detail=f"collective-permute over {axes} — a layout "
                        "move, not a planned schedule collective"))
-        elif axes not in expected or row["op"] not in expected[axes]:
+        else:
             findings.append(dict(
                 row, kind="unplanned_collective",
                 detail=f"{row['op']} over {axes} is outside the plan's "

@@ -400,13 +400,15 @@ def instrument_train_step(step_fn, pipeline: TelemetryPipeline, cfg=None,
     step as a replicated donated leaf (docs/parallel_training.md), and
     the recorded scalars — global norms, the moment-sum identity — are
     full-tree reductions, so their values match the unsharded step's."""
-    import functools
-    from ..models.facade import make_train_step
-    if cfg is not None:
-        step_kw["cfg"] = cfg
+    from ..models.facade import make_train_step, plan_step_cell
     if lr is not None:
         step_kw["lr"] = lr
-    inner = functools.partial(step_fn, **step_kw) if step_kw else step_fn
+    # pp>1 plans swap the family step for the full-manual pipelined one
+    # (models/facade.plan_step_cell — the same seam the resilient guard
+    # routes through, incl. the elastic rebuild hook's fresh-identity
+    # subtlety); pp=1 keeps the historical partial
+    inner, _outer, _plan_rebuild = plan_step_cell(
+        step_fn, cfg=cfg, mesh=mesh, plan=plan, **step_kw)
 
     def instrumented(params, opt_state, batch, tstate):
         loss, new_params, new_opt = inner(params, opt_state, batch)
@@ -436,5 +438,8 @@ def instrument_train_step(step_fn, pipeline: TelemetryPipeline, cfg=None,
         tstate = pipeline.device_record(tstate, **scalars)
         return loss, new_params, new_opt, tstate
 
+    instrumented._plan_resolved = True
+    instrumented._plan_rebuild = _plan_rebuild
+    _outer["fn"] = instrumented
     return make_train_step(instrumented, donate=donate, extra_donate=(3,),
                            mesh=mesh, plan=plan)

@@ -99,20 +99,21 @@ class TestFactorizationEdgeCases:
             plan_parallel(_spec(num_heads=16, num_layers=7), 16, 13,
                           max_mp=1)
 
-    def test_plan_train_search_excludes_pp_and_reports(self):
+    def test_plan_train_search_names_the_empty_space(self):
         from paddle_tpu.parallel.planner import plan_train
-        with pytest.raises(ValueError, match="pp excluded"):
-            # heads=7/layers=7 on 16 devices with batch 13: nothing legal
+        with pytest.raises(ValueError, match="no legal"):
+            # heads=7/layers=7 on 16 devices with batch 13: nothing
+            # legal at pp=1, and layers=7 divides no pp>1 degree of 16
+            # either — the HBM-gate fallback (ISSUE 15) finds nothing
             plan_train(_spec(num_heads=7, ffn_hidden=7 * 256,
                              num_layers=7), 16, 13)
 
-    def test_plan_train_diagnosis_restricted_to_pp1(self):
+    def test_plan_train_diagnosis_names_batch_constraint(self):
         from paddle_tpu.parallel.planner import plan_train
-        # layers=8 leaves pp=8/pp=16 escapes that plan_parallel WOULD
-        # accept (dp*fsdp=1 divides 13) — plan_train forbids them, and
-        # its diagnosis must price the pp=1 space it actually searched,
-        # naming the batch constraint instead of 'every assignment was
-        # pruned'
+        # layers=8 admits pp∈{2,4,8} escapes, but every surviving
+        # dp*fsdp split (16/pp) still fails 13's divisibility — the
+        # diagnosis must name the batch constraint instead of 'every
+        # assignment was pruned'
         with pytest.raises(ValueError, match=r"global_batch=13"):
             plan_train(_spec(num_heads=7, ffn_hidden=7 * 256,
                              num_layers=8), 16, 13)

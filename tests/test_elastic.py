@@ -214,6 +214,57 @@ def test_device_loss_resumes_bit_identical(tmp_path):
     assert monitor.gauge("train.elastic.reshard_bytes").value > 0
 
 
+def test_device_loss_on_pp_plan_holds_stage_grid(tmp_path):
+    """Elastic regression on a pp>1 plan (ISSUE 15): a device lost at
+    step 3 degrades dp2×tp2×pp2 -> dp1×tp2×pp2 — the stage grid (and
+    tp) HELD, dp gives way — reshard-restores the stage-chunked state
+    and resumes with the post-restore trajectory BIT-identical to a
+    clean restore of the same checkpoint on the same degraded plan."""
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=16, dtype=jnp.float32,
+                    remat=False, sequence_parallel=False)
+    faults.install("device_loss@3:1", once_dir=str(tmp_path / "once"))
+    try:
+        params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+        opt = init_opt_state(params)
+        mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=0)
+        plan0 = plan_train(cfg, 8, B, dp=2, fsdp=1, tp=2, pp=2,
+                           microbatches=4)
+        et = ElasticTrainer(train_step, params, opt, cfg=cfg,
+                            global_batch=B, manager=mgr, plan=plan0,
+                            config=ElasticConfig(heartbeat_timeout=60.0),
+                            resilience=ResilienceConfig(
+                                checkpoint_every=1),
+                            lr=1e-3)
+        losses = {}
+        run_elastic(et, _batch, 6,
+                    on_step=lambda s, l, ok: losses.__setitem__(s, l))
+    finally:
+        faults.uninstall()
+    assert et.replans == 1
+    assert et.plan.axes == {"dp": 1, "fsdp": 1, "tp": 2, "pp": 2}
+    assert et.plan.microbatches >= 2
+    assert sorted(losses) == list(range(6))
+    assert et.trace_count == 1               # one executable post-replan
+
+    # clean restore of the SAME checkpoint on the SAME degraded plan
+    plan_d = et.plan
+    mesh_d = plan_d.build_mesh(devices=list(jax.devices())[:4])
+    specs = {"params": plan_d.specs,
+             "opt_state": {"m": plan_d.specs, "v": plan_d.specs}}
+    from paddle_tpu.parallel.checkpoint import load_sharded
+    state = load_sharded(str(tmp_path / "ckpt" / "ckpt-3"),
+                         mesh=mesh_d, specs=specs)
+    step2 = make_train_step(train_step, cfg=cfg, lr=1e-3, mesh=mesh_d,
+                            plan=plan_d)
+    p2, o2 = state["params"], state["opt_state"]
+    for s in range(3, 6):
+        loss, p2, o2 = step2(p2, o2, _batch(s))
+        assert float(loss) == losses[s], s   # BIT-identical
+    # the restored stacked leaves landed stage-chunked
+    assert p2["qkv_w"].sharding.spec == plan_d.specs["qkv_w"]
+
+
 def test_collective_hang_replan_and_straggler_tolerance(tmp_path):
     """A stall past the watchdog budget reads as device loss and
     replans; a straggler within budget must not."""

@@ -1,0 +1,485 @@
+"""4D auto-parallel training: executable pipeline parallelism (ISSUE 15
+tentpole) — dp×fsdp×tp×pp with 1F1B microbatching.
+
+The contract pinned here, on the 8-virtual-device CPU mesh:
+- `plan_train` grows pp: explicit degrees (pp=, microbatches=) emit the
+  stage-chunked spec table (PARAM_SPECS' stacked layer axis SURVIVES on
+  'pp'), illegal degrees raise NoFeasiblePlanError naming the violated
+  constraint (pp|layers, microbatch split, tp|vocab, fsdp|hidden), and
+  the search emits pp>1 ONLY through the HBM gate (a shape that fits at
+  no dp×fsdp×tp assignment, even fsdp=max);
+- `make_train_step(mesh=, plan=)` on a pp>1 plan runs the FULL-manual
+  pipelined step (parallel/pipeline_train.py — this container's legacy
+  GSPMD fatally aborts partial-auto shard_map): loss trajectories match
+  the unsharded step within the repo's multi-device tolerance (rtol/
+  atol 2e-4, the test_plan3d convention) for dp2×tp2×pp2, fsdp2×tp2×pp2
+  and pp4 (microbatches >= 2·pp), for the gpt AND llama cores;
+- params AND Adam moments come back with the plan's shardings
+  (stage-chunked stacked leaves included), ZERO recompiles after warmup;
+- the measured 1F1B bubble publishes as `train.bubble_fraction` and
+  sits within 1.5x of the planner's (pp-1)/m model;
+- `hlo_audit.expected_collectives` knows the pp stage-handoff ring: the
+  dp2×tp2×pp2 audit shows collective-permutes over ('pp',) and they are
+  NOT findings;
+- `degrade_plan` holds pp like tp (dp first, then fsdp), collapsing
+  stages only when the survivors can't form the stage grid.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.models.facade import make_train_step
+from paddle_tpu.models.gpt import (GPTConfig, init_gpt_params,
+                                   init_opt_state, train_step)
+from paddle_tpu.parallel.planner import (ChipSpec, NoFeasiblePlanError,
+                                         degrade_plan, enumerate_plans,
+                                         plan_train, spec_from_config)
+
+B, S = 8, 32
+N_STEPS = 4
+
+
+def _cfg(**kw):
+    base = dict(vocab_size=512, hidden_size=128, num_layers=2,
+                num_heads=4, max_seq_len=64, dtype=jnp.float32,
+                remat=False, sequence_parallel=False)
+    base.update(kw)
+    return GPTConfig(**base)
+
+
+def _tokens(seed=0, vocab=512):
+    return np.random.RandomState(seed).randint(
+        0, vocab, (B, S + 1)).astype(np.int32)
+
+
+@pytest.fixture(scope="module")
+def ref_trajectory():
+    """Unsharded oracle for the default 2-layer config."""
+    cfg = _cfg()
+    params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+    opt = init_opt_state(params)
+    step = make_train_step(train_step, cfg=cfg, lr=1e-3)
+    toks = jnp.asarray(_tokens())
+    out = []
+    for _ in range(N_STEPS):
+        loss, params, opt = step(params, opt, toks)
+        out.append(float(loss))
+    return out
+
+
+@pytest.fixture(scope="module")
+def ref_trajectory_l4():
+    """Unsharded oracle for the 4-layer (pp4) config."""
+    cfg = _cfg(num_layers=4)
+    params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+    opt = init_opt_state(params)
+    step = make_train_step(train_step, cfg=cfg, lr=1e-3)
+    toks = jnp.asarray(_tokens())
+    out = []
+    for _ in range(N_STEPS):
+        loss, params, opt = step(params, opt, toks)
+        out.append(float(loss))
+    return out
+
+
+# --------------------------------------------------------------------------
+# plan_train: the pp axis in the {axes -> PartitionSpec tree} contract
+# --------------------------------------------------------------------------
+class TestPlanTrain4D:
+    def test_explicit_pp_degrees_emit_stage_chunked_specs(self):
+        plan = plan_train(_cfg(), 8, B, dp=2, fsdp=1, tp=2, pp=2,
+                          microbatches=4)
+        assert plan.axes == {"dp": 2, "fsdp": 1, "tp": 2, "pp": 2}
+        assert plan.name == "dp2_fsdp1_tp2_pp2"
+        assert plan.pp == 2 and plan.microbatches == 4
+        # the stacked layer axis SURVIVES as the stage-chunk axis
+        assert plan.specs["qkv_w"] == P("pp", "fsdp", "tp")
+        assert plan.specs["ln1_scale"] == P("pp", None)
+        assert plan.specs["wte"] == P("tp", "fsdp")
+        assert plan.batch_spec(2) == P(("dp", "fsdp"), None)
+        mesh = plan.build_mesh()
+        assert dict(mesh.shape) == {"dp": 2, "fsdp": 1, "tp": 2, "pp": 2}
+
+    def test_plan_gauges_include_pp_and_microbatches(self):
+        from paddle_tpu.profiler import monitor
+        plan_train(_cfg(), 8, B, dp=2, fsdp=1, tp=2, pp=2,
+                   microbatches=4)
+        assert monitor.gauge("train.plan.pp").value == 2
+        assert monitor.gauge("train.plan.microbatches").value == 4
+
+    def test_default_microbatches_picked_for_pp(self):
+        plan = plan_train(_cfg(num_layers=4), 8, B, dp=1, fsdp=1, tp=2,
+                          pp=4)
+        # b_local=8, clamp 4*pp=16 -> largest divisor 8
+        assert plan.microbatches == 8
+
+    def test_illegal_pp_degrees_name_the_constraint(self):
+        with pytest.raises(NoFeasiblePlanError,
+                           match="does not divide num_layers"):
+            plan_train(_cfg(num_layers=3), 8, B, dp=2, fsdp=1, tp=2,
+                       pp=2, microbatches=4)
+        with pytest.raises(ValueError, match="microbatches=3"):
+            plan_train(_cfg(), 8, B, dp=2, fsdp=1, tp=2, pp=2,
+                       microbatches=3)      # b_local=4, 3 doesn't split
+        with pytest.raises(ValueError, match="vocab_size"):
+            plan_train(_cfg(vocab_size=511), 8, B, dp=2, fsdp=1, tp=2,
+                       pp=2, microbatches=4)
+        with pytest.raises(ValueError, match="hidden_size"):
+            plan_train(_cfg(hidden_size=130, num_heads=2), 8, B, dp=1,
+                       fsdp=4, tp=1, pp=2, microbatches=2)
+        with pytest.raises(ValueError, match="needs pp>1"):
+            plan_train(_cfg(), 8, B, dp=4, fsdp=1, tp=2, microbatches=4)
+
+    def test_layers_indivisible_by_every_candidate_pp(self):
+        # L=3 divides no pp degree of an 8-device world (pp in
+        # {2,4,8}); the explicit raise names it, and the search never
+        # emits a pp plan for this shape even under HBM pressure
+        with pytest.raises(NoFeasiblePlanError) as ei:
+            plan_train(_cfg(num_layers=3), 8, B, dp=1, fsdp=1, tp=1,
+                       pp=8)
+        assert "num_layers=3" in ei.value.constraint
+        chip = ChipSpec(hbm_bytes=1e4)       # everything OOMs
+        plan = plan_train(_cfg(num_layers=3), 8, B, chip=chip)
+        assert plan.pp == 1                  # least-bad 3D, never 4D
+
+    def test_search_emits_pp_only_through_the_hbm_gate(self):
+        cfg = _cfg(vocab_size=4096, hidden_size=64, num_heads=2,
+                   max_seq_len=256)
+        spec = spec_from_config(cfg)
+        chip = ChipSpec(hbm_bytes=6.5e6)
+        # the premise: NO pp=1 assignment fits, even at fsdp=max
+        assert not [p for p in enumerate_plans(spec, 8, B, chip)
+                    if p.pp == 1 and p.fits]
+        plan = plan_train(cfg, 8, B, chip=chip)
+        assert plan.pp > 1
+        assert plan.plan.fits
+        assert plan.microbatches >= 2
+        # an ample chip never pays the bubble
+        assert plan_train(cfg, 8, B).pp == 1
+
+
+# --------------------------------------------------------------------------
+# the pipelined step: trajectory parity + pins + zero recompiles + bubble
+# --------------------------------------------------------------------------
+PLANS_4D = [
+    {"dp": 2, "fsdp": 1, "tp": 2, "pp": 2, "microbatches": 4},
+    {"dp": 1, "fsdp": 2, "tp": 2, "pp": 2, "microbatches": 4},
+]
+
+
+@pytest.mark.parametrize("axes", PLANS_4D,
+                         ids=lambda a: "_".join(
+                             f"{k}{v}" for k, v in a.items()
+                             if k != "microbatches"))
+def test_pp_trajectory_matches_unsharded(axes, ref_trajectory):
+    from paddle_tpu.profiler import monitor
+    cfg = _cfg()
+    plan = plan_train(cfg, 8, B, **axes)
+    mesh = plan.build_mesh()
+    params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+    opt = init_opt_state(params)
+    step = make_train_step(train_step, cfg=cfg, lr=1e-3, mesh=mesh,
+                           plan=plan)
+    toks = _tokens()
+    losses = []
+    for _ in range(N_STEPS):
+        loss, params, opt = step(params, opt, toks)
+        losses.append(float(loss))
+    np.testing.assert_allclose(losses, ref_trajectory, rtol=2e-4,
+                               atol=2e-4)
+
+    # shardings per plan: params AND both Adam moment trees, the
+    # stage-chunked stacked leaves included
+    for name in ("qkv_w", "mlp_up_w", "wte", "ln1_scale"):
+        want = plan.specs[name]
+        for tree in (params, opt["m"], opt["v"]):
+            got = tree[name].sharding.spec
+            assert got == want, (name, axes, got, want)
+    assert opt["step"].sharding.spec == P()
+
+    # zero recompiles after warmup
+    assert step.trace_count == 1
+    loss, params, opt = step(params, opt, _tokens(seed=1))
+    assert step.trace_count == 1
+
+    # measured 1F1B bubble: published, equal to the schedule's
+    # (pp-1)/(m+pp-1), within 1.5x of the planner's (pp-1)/m model
+    pp, m = plan.pp, plan.microbatches
+    measured = monitor.gauge("train.bubble_fraction").value
+    assert measured == pytest.approx(step.bubble_fraction)
+    assert measured == pytest.approx((pp - 1) / (m + pp - 1), rel=1e-3)
+    predicted = (pp - 1) / m
+    assert measured <= predicted * 1.5
+    assert predicted <= measured * 1.5
+
+
+def test_pp4_trajectory_and_bubble(ref_trajectory_l4):
+    """A 4-stage pipeline on 4 of the 8 devices, microbatches = 2·pp."""
+    from paddle_tpu.profiler import monitor
+    cfg = _cfg(num_layers=4)
+    plan = plan_train(cfg, 4, B, dp=1, fsdp=1, tp=1, pp=4,
+                      microbatches=8)
+    mesh = plan.build_mesh(devices=list(jax.devices())[:4])
+    params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+    opt = init_opt_state(params)
+    step = make_train_step(train_step, cfg=cfg, lr=1e-3, mesh=mesh,
+                           plan=plan)
+    toks = _tokens()
+    losses = []
+    for _ in range(N_STEPS):
+        loss, params, opt = step(params, opt, toks)
+        losses.append(float(loss))
+    np.testing.assert_allclose(losses, ref_trajectory_l4, rtol=2e-4,
+                               atol=2e-4)
+    assert step.trace_count == 1
+    # stage chunks: each rank holds 1 of the 4 stacked layers
+    assert params["qkv_w"].sharding.spec == P("pp", "fsdp", "tp")
+    assert params["qkv_w"].addressable_shards[0].data.shape[0] == 1
+    measured = monitor.gauge("train.bubble_fraction").value
+    assert measured == pytest.approx(3 / 11, rel=1e-3)   # (p-1)/(m+p-1)
+    assert measured <= (3 / 8) * 1.5 and (3 / 8) <= measured * 1.5
+
+
+def test_llama_pp_trajectory_matches_unsharded():
+    """The llama core (GQA kv=2 with tp=2 -> 1 kv-head per rank) through
+    the same pipelined step."""
+    from paddle_tpu.models.llama import (LlamaConfig, init_llama_params,
+                                         train_step as llama_step)
+    cfg = LlamaConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                      num_heads=4, num_kv_heads=2, max_seq_len=64,
+                      dtype=jnp.float32, remat=False)
+    toks = _tokens()
+    params = init_llama_params(cfg, jax.random.PRNGKey(0))
+    opt = init_opt_state(params)
+    step0 = make_train_step(llama_step, cfg=cfg, lr=1e-3)
+    ref = []
+    for _ in range(N_STEPS):
+        loss, params, opt = step0(params, opt, toks)
+        ref.append(float(loss))
+
+    plan = plan_train(cfg, 8, B, dp=2, fsdp=1, tp=2, pp=2,
+                      microbatches=4)
+    mesh = plan.build_mesh()
+    params = init_llama_params(cfg, jax.random.PRNGKey(0))
+    opt = init_opt_state(params)
+    step = make_train_step(llama_step, cfg=cfg, lr=1e-3, mesh=mesh,
+                           plan=plan)
+    got = []
+    for _ in range(N_STEPS):
+        loss, params, opt = step(params, opt, toks)
+        got.append(float(loss))
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+    assert step.trace_count == 1
+    assert params["q_w"].sharding.spec == P("pp", "fsdp", "tp")
+    assert opt["m"]["down_w"].sharding.spec == plan.specs["down_w"]
+
+
+def test_hbm_gated_shape_trains_at_pp(ref_trajectory):
+    """The acceptance shape: infeasible at pp=1/fsdp=max, planned AND
+    trained at pp>1 (a short trajectory — the full-parity matrix runs
+    above; this pins that the GATED plan executes)."""
+    cfg = _cfg(vocab_size=4096, hidden_size=64, num_heads=2,
+               max_seq_len=256)
+    chip = ChipSpec(hbm_bytes=6.5e6)
+    plan = plan_train(cfg, 8, B, chip=chip)
+    assert plan.pp > 1
+    mesh = plan.build_mesh()
+    params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+    opt = init_opt_state(params)
+    step = make_train_step(train_step, cfg=cfg, lr=1e-3, mesh=mesh,
+                           plan=plan)
+    toks = _tokens(vocab=4096)
+    l0, params, opt = step(params, opt, toks)
+    l1, params, opt = step(params, opt, toks)
+    assert np.isfinite(float(l0)) and float(l1) < float(l0)
+    assert step.trace_count == 1
+
+
+def test_resilient_guard_rides_the_pp_step():
+    from paddle_tpu.parallel.resilience import make_resilient_step
+    cfg = _cfg()
+    plan = plan_train(cfg, 8, B, dp=2, fsdp=1, tp=2, pp=2,
+                      microbatches=4)
+    mesh = plan.build_mesh()
+    params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+    opt = init_opt_state(params)
+    guarded = make_resilient_step(train_step, cfg=cfg, lr=1e-3,
+                                  mesh=mesh, plan=plan)
+    toks = _tokens()
+    loss, params, opt, ok = guarded(params, opt, toks, 1.0)
+    assert bool(ok) and np.isfinite(float(loss))
+    before = np.asarray(params["qkv_w"].addressable_shards[0].data).copy()
+    loss, params, opt, ok = guarded(params, opt, toks, float("nan"))
+    assert not bool(ok)
+    after = np.asarray(params["qkv_w"].addressable_shards[0].data)
+    np.testing.assert_array_equal(before, after)
+    assert params["qkv_w"].sharding.spec == plan.specs["qkv_w"]
+    assert guarded.trace_count == 1
+
+
+# --------------------------------------------------------------------------
+# hlo audit: the stage-handoff ring is planned, not a finding
+# --------------------------------------------------------------------------
+def test_audit_pp_handoffs_are_planned_not_findings():
+    from paddle_tpu.profiler import hlo_audit
+    cfg = _cfg()
+    plan = plan_train(cfg, 8, B, dp=2, fsdp=1, tp=2, pp=2,
+                      microbatches=4)
+    doc = hlo_audit.audit_train_step(cfg, plan, B, seq=S)
+    by_axes = {(tuple(r["axes"]) if r["axes"] else None, r["op"])
+               for r in doc["collectives"]}
+    # the 1F1B ring over the pp axis is present...
+    assert (("pp",), "collective-permute") in by_axes
+    # ...and EXPECTED — never a resharding_permute finding
+    assert "pp" in doc["expected"]
+    assert "collective-permute" in doc["expected"]["pp"]
+    assert not [f for f in doc["findings"]
+                if f["op"] == "collective-permute"
+                and f["axes"] == ["pp"]]
+    # the manual tp schedule is expected too
+    assert any(op == "all-reduce" and ax and "tp" in ax
+               for ax, op in by_axes)
+    for f in doc["findings"]:
+        assert f["kind"] in ("resharding_groups", "resharding_permute",
+                             "unplanned_collective")
+
+
+# --------------------------------------------------------------------------
+# elastic: degrade_plan holds the stage grid
+# --------------------------------------------------------------------------
+class TestDegradePlanPP:
+    def test_dp_gives_way_pp_and_tp_held(self):
+        old = plan_train(_cfg(), 8, B, dp=2, fsdp=1, tp=2, pp=2,
+                         microbatches=4)
+        got = degrade_plan(_cfg(), old, 7, B)
+        assert got.axes == {"dp": 1, "fsdp": 1, "tp": 2, "pp": 2}
+        assert got.microbatches >= 2
+
+    def test_stage_grid_collapses_only_when_it_must(self):
+        old = plan_train(_cfg(), 8, B, dp=2, fsdp=1, tp=2, pp=2,
+                         microbatches=4)
+        # 3 survivors cannot form the tp2·pp2 grid: stages collapse
+        # back onto the layer scan (a pp=1 plan on <=3 devices)
+        got = degrade_plan(_cfg(), old, 3, B)
+        assert got.pp == 1
+        assert got.plan.n_devices <= 3
+
+    def test_no_fit_names_constraint_for_pp_plans(self):
+        old = plan_train(_cfg(), 8, B, dp=2, fsdp=1, tp=2, pp=2,
+                         microbatches=4)
+        with pytest.raises(NoFeasiblePlanError) as ei:
+            degrade_plan(_cfg(), old, 7, B, chip=ChipSpec(hbm_bytes=1e4))
+        assert "hbm" in ei.value.constraint
+
+    def test_rebuild_retargets_the_pipelined_step(self):
+        """The facade rebuild seam on a pp plan: same object, the
+        pipelined fn re-resolves against the new stage grid."""
+        cfg = _cfg()
+        plan_a = plan_train(cfg, 8, B, dp=2, fsdp=1, tp=2, pp=2,
+                            microbatches=4)
+        mesh_a = plan_a.build_mesh()
+        step = make_train_step(train_step, cfg=cfg, lr=1e-3,
+                               mesh=mesh_a, plan=plan_a)
+        params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+        opt = init_opt_state(params)
+        toks = _tokens()
+        _, params, opt = step(params, opt, toks)
+        assert step.trace_count == 1
+        b_a = step.bubble_fraction
+        plan_b = degrade_plan(cfg, plan_a, 7, B)
+        mesh_b = plan_b.build_mesh(
+            devices=list(jax.devices())[:plan_b.plan.n_devices])
+        same = step.rebuild(mesh=mesh_b, plan=plan_b)
+        assert same is step and step.trace_count == 0
+        assert step.bubble_fraction is None     # re-measured next call
+        _, params, opt = step(params, opt, toks)
+        _, params, opt = step(params, opt, _tokens(seed=1))
+        assert step.trace_count == 1
+        # pp held, but dp=1 doubles b_local -> more microbatches, a
+        # SMALLER bubble than before the degrade
+        pp_b, m_b = plan_b.pp, plan_b.microbatches
+        assert step.bubble_fraction == pytest.approx(
+            (pp_b - 1) / (m_b + pp_b - 1), rel=1e-3)
+        assert step.bubble_fraction <= b_a
+        from paddle_tpu.parallel.mesh import sharding_for
+        want = sharding_for(plan_b.specs["qkv_w"], mesh_b,
+                            shape=params["qkv_w"].shape).spec
+        assert params["qkv_w"].sharding.spec == want
+
+
+# --------------------------------------------------------------------------
+# cost model: the pp phases price and cross-check
+# --------------------------------------------------------------------------
+class TestLedgerPP:
+    def test_coll_pp_and_bubble_phases(self):
+        from paddle_tpu.cost_model import train_step_ledger
+        cfg = _cfg()
+        plan = plan_train(cfg, 8, B, dp=2, fsdp=1, tp=2, pp=2,
+                          microbatches=4)
+        led = train_step_ledger(cfg, plan=plan, global_batch=B, seq=S)
+        p3 = plan_train(cfg, 8, B, dp=4, fsdp=1, tp=2)
+        led3 = train_step_ledger(cfg, plan=p3, global_batch=B, seq=S)
+        assert led["phases"]["coll_pp"]["bytes"] > 0
+        assert led["phases"]["coll_pp"]["channel"] == "ici"
+        assert led3["phases"]["coll_pp"]["bytes"] == 0
+        assert led3["phases"]["pp_bubble"]["flops"] == 0
+        # the bubble phase is the planner's (pp-1)/m share of the
+        # pipelined compute, and carries no bytes
+        pipelined = (led["phases"]["fwd_matmul"]["flops"]
+                     + led["phases"]["fwd_attention"]["flops"]
+                     + led["phases"]["bwd"]["flops"]
+                     + led["phases"]["remat"]["flops"])
+        assert led["phases"]["pp_bubble"]["flops"] == pytest.approx(
+            (2 - 1) / 4 * pipelined)
+        assert led["phases"]["pp_bubble"]["bytes"] == 0
+        # per-chip stacked-block work divides by the stage count (same
+        # dp×fsdp×tp degrees, pp=1 vs pp=2 -> 2x the per-chip flops)
+        led_flat = train_step_ledger(
+            cfg, plan={"dp": 2, "fsdp": 1, "tp": 2}, global_batch=B,
+            seq=S)
+        assert led_flat["phases"]["fwd_matmul"]["flops"] == \
+            pytest.approx(2 * led["phases"]["fwd_matmul"]["flops"])
+
+    def test_cross_checks_planner_pp_pricing(self):
+        from paddle_tpu.cost_model import train_step_ledger
+        from paddle_tpu.parallel.planner import (ModelSpec, Plan,
+                                                 _estimate)
+        cfg = _cfg(dtype=jnp.bfloat16)       # abytes=2 == dtype width
+        plan = plan_train(cfg, 8, B, dp=2, fsdp=1, tp=2, pp=2,
+                          microbatches=4)
+        chip = ChipSpec()
+        led = train_step_ledger(cfg, plan=plan, global_batch=B, seq=S)
+        spec = spec_from_config(cfg)
+        spec = ModelSpec(**{**spec.__dict__, "seq_len": S})
+        priced = _estimate(Plan(dp=2, mp=2, pp=2, fsdp=1,
+                                microbatches=4), spec, B, chip)
+        assert 0.5 * led["phases"]["coll_pp"]["bytes"] / chip.ici_bw \
+            == pytest.approx(priced.breakdown["pp_s"])
+
+
+# --------------------------------------------------------------------------
+# telemetry report: the train_plan block carries the pp rows
+# --------------------------------------------------------------------------
+def test_train_plan_block_carries_pp_and_bubble(tmp_path):
+    import json
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    from telemetry_report import summarize
+    path = tmp_path / "t.jsonl"
+    recs = [
+        {"kind": "monitor", "t": 1.0, "stats": {
+            "train.plan.dp": 2, "train.plan.tp": 2, "train.plan.pp": 2,
+            "train.plan.microbatches": 4, "train.plan.n_devices": 8,
+            "train.bubble_fraction": 0.2}},
+        {"kind": "step", "t": 1.5, "step": 0, "loss": 1.0},
+    ]
+    path.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    blk = summarize(str(path))["train_plan"]
+    assert blk["pp"] == 2
+    assert blk["microbatches"] == 4
+    assert blk["bubble_fraction"] == 0.2

@@ -48,6 +48,14 @@ LEGS = {
                             num_heads=4, max_seq_len=128, remat=False,
                             dtype="float32"), 8, 64, 3, 600,
              dict(dp=2, fsdp=2, tp=2)),
+    # the 4D rung (tpu_campaign --plan4d): the full-manual pipelined
+    # step on a pinned dp2×tp2×pp2 grid, microbatches = 2·pp — reports
+    # bubble_fraction next to ms/step (ISSUE 15)
+    "cpu8_pp": (False, 8, dict(vocab_size=512, hidden_size=128,
+                               num_layers=2, num_heads=4,
+                               max_seq_len=128, remat=False,
+                               dtype="float32"), 8, 64, 3, 600,
+                dict(dp=2, fsdp=1, tp=2, pp=2, microbatches=4)),
     "tpu": (True, 0, dict(vocab_size=32768, hidden_size=1024,
                           num_layers=24, num_heads=16, max_seq_len=1024,
                           remat=True, remat_policy="dots",
@@ -117,8 +125,9 @@ def run_leg(name: str) -> None:
     # claim the ROADMAP's >=45% target is stated in
     mfu = flops_per_token * tps / (_peak_for(devs[0].device_kind,
                                              platform) * n)
-    print(json.dumps({
-        "metric": "gpt_train_plan3d",
+    rec = {
+        "metric": ("gpt_train_plan4d" if plan.pp > 1
+                   else "gpt_train_plan3d"),
         "n_devices": n,
         "plan": plan.name,
         "backend": platform,
@@ -127,14 +136,20 @@ def run_leg(name: str) -> None:
         "mfu": round(mfu, 4),
         "traces_after_warmup": step.trace_count,
         "batch": batch, "seq": seq,
-    }), flush=True)
+    }
+    if plan.pp > 1:
+        rec["microbatches"] = plan.microbatches
+        rec["bubble_fraction"] = round(
+            float(getattr(step, "bubble_fraction", 0.0) or 0.0), 4)
+    print(json.dumps(rec), flush=True)
 
 
-def orchestrate(want_tpu: bool) -> int:
+def orchestrate(want_tpu: bool, want_pp: bool = False) -> int:
     """Run the legs in subprocesses; print ONE MULTICHIP-format JSON
     line per leg ({"n_devices", "rc", "ok", "skipped", "tail"} + the
     measured record when the leg produced one)."""
-    legs = ["cpu8"] + (["tpu"] if want_tpu else [])
+    legs = (["cpu8"] + (["cpu8_pp"] if want_pp else [])
+            + (["tpu"] if want_tpu else []))
     worst = 0
     for name in legs:
         _wt, n_dev, _kw, _b, _s, _i, timeout_s, _deg = LEGS[name]
@@ -181,13 +196,16 @@ def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--tpu", action="store_true",
                     help="also attempt the TPU leg (tunnel-gated)")
+    ap.add_argument("--pp", action="store_true",
+                    help="also run the cpu8_pp 4D (dp2×tp2×pp2) leg "
+                         "(tpu_campaign --plan4d)")
     ap.add_argument("--run", default=None, choices=sorted(LEGS),
                     help="run ONE leg in-process (orchestrator internal)")
     args = ap.parse_args()
     if args.run:
         run_leg(args.run)
         return 0
-    return orchestrate(args.tpu)
+    return orchestrate(args.tpu, args.pp)
 
 
 if __name__ == "__main__":

@@ -186,6 +186,11 @@ def summarize(path: str, samples_per_step: Optional[float] = None) -> dict:
         first_s, last_s = monitors[0]["stats"], monitors[-1]["stats"]
         tplan = {k[len("train.plan."):]: last_s[k]
                  for k in sorted(last_s) if k.startswith("train.plan.")}
+        if tplan and "train.bubble_fraction" in last_s:
+            # the pp step's measured 1F1B schedule bubble (gauge: last
+            # value) rides the plan block — the pair (pp, bubble) is
+            # the 4D plan's efficiency signature
+            tplan["bubble_fraction"] = last_s["train.bubble_fraction"]
         if tplan:
             ck = {}
             if "checkpoint_async_save" in last_s:

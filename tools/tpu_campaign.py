@@ -305,9 +305,15 @@ def main() -> None:
                          "CPU leg pins the 8-virtual-device platform "
                          "unconditionally; the TPU leg stays "
                          "probe-gated inside the tool)")
+    ap.add_argument("--plan4d", action="store_true",
+                    help="run the plan3d rung WITH the cpu8_pp 4D leg "
+                         "(dp2×tp2×pp2, 1F1B microbatching — ISSUE 15; "
+                         "same no-tunnel-gate semantics: the CPU legs "
+                         "pin the 8-virtual-device platform "
+                         "unconditionally)")
     args = ap.parse_args()
 
-    if args.plan3d:
+    if args.plan3d or args.plan4d:
         # no probe loop: the rung must produce its CPU-mesh evidence
         # even with the tunnel dead — TPU execution is gated inside
         # bench_plan3d.py
@@ -316,7 +322,9 @@ def main() -> None:
         window_dir = os.path.join(PERF, f"window_{window_ts}")
         job = next(j for j in JOBS if j[0] == "plan3d")
         name, argv, timeout_s, env_extra = job
-        log(f"--plan3d: running {name} (timeout {timeout_s}s)")
+        if args.plan4d:
+            name, argv = "plan4d", list(argv) + ["--pp"]
+        log(f"--{name}: running (timeout {timeout_s}s)")
         res = run_job(name, argv, timeout_s, env_extra, window_dir)
         log(f"plan3d: rc={res['rc']} {res['seconds']}s, "
             f"{len(res['json_lines'])} JSON records")
