@@ -309,7 +309,8 @@ def _plan_degrees(plan) -> dict:
     degrees the train ledger prices (+ `mb`, the pp microbatch count,
     defaulting to 2·pp when the plan carries none)."""
     if plan is None:
-        return {"dp": 1, "fsdp": 1, "tp": 1, "pp": 1, "mb": 1}
+        return {"dp": 1, "fsdp": 1, "tp": 1, "pp": 1, "mb": 1,
+                "overlap": False}
     def _mb(pp: int, raw) -> int:
         # a pp>1 plan must microbatch (plan_train never emits mb<2);
         # mb<=1 therefore means "the plan carries no real count"
@@ -327,19 +328,22 @@ def _plan_degrees(plan) -> dict:
                "tp": int(axes.get("tp", axes.get("mp", 1))),
                "pp": int(axes.get("pp", 1))}
         deg["mb"] = _mb(deg["pp"], getattr(plan, "microbatches", 0))
+        deg["overlap"] = bool(getattr(plan, "overlap", False))
         return deg
     if hasattr(plan, "dp"):                        # priced Plan row
         pp = int(getattr(plan, "pp", 1))
         return {"dp": int(plan.dp), "fsdp": int(plan.fsdp),
                 "tp": int(plan.mp), "pp": pp,
-                "mb": _mb(pp, getattr(plan, "microbatches", 0))}
+                "mb": _mb(pp, getattr(plan, "microbatches", 0)),
+                "overlap": bool(getattr(plan, "overlap", False))}
     axes = dict(plan)
     pp = int(axes.get("pp", 1))
     return {"dp": int(axes.get("dp", 1)),
             "fsdp": int(axes.get("fsdp", 1)),
             "tp": int(axes.get("tp", axes.get("mp", 1))),
             "pp": pp,
-            "mb": _mb(pp, axes.get("microbatches", 0))}
+            "mb": _mb(pp, axes.get("microbatches", 0)),
+            "overlap": bool(axes.get("overlap", False))}
 
 
 def train_step_ledger(cfg, family: str = "gpt", plan=None,
@@ -475,10 +479,17 @@ def train_step_ledger(cfg, family: str = "gpt", plan=None,
         "flops": 0.0, "channel": "ici",
         "bytes": _ring_factor(dp) * (n_params / (tp * fsdp * pp)) * 4.0,
     }
+    # overlap (plan.overlap): the double-buffered ZeRO-3 gather hides
+    # all but FSDP_OVERLAP_EXPOSED of the fsdp volume behind layer
+    # compute — the SAME constant planner._estimate discounts with, so
+    # tools/train_attrib's ledger shares and the planner's priced
+    # breakdown agree phase for phase
+    from .parallel.planner import FSDP_OVERLAP_EXPOSED
+    fsdp_exposed = FSDP_OVERLAP_EXPOSED if deg.get("overlap") else 1.0
     coll_fsdp = {
         "flops": 0.0, "channel": "ici",
         "bytes": (3.0 * (fsdp - 1) / fsdp * (n_params / (tp * pp))
-                  * dtype_bytes if fsdp > 1 else 0.0),
+                  * dtype_bytes * fsdp_exposed if fsdp > 1 else 0.0),
     }
     # pp: boundary activations each way per microbatch — the planner's
     # pp_bytes formula exactly (2·m·(tok_local/m)·D·(pp-1)/pp; the

@@ -86,7 +86,15 @@ REGISTRY_PATH = os.path.join(
 # falls through to the hardcoded default)
 KNOWN_IMPLS: Dict[str, tuple] = {
     "attention": ("pallas", "jax_flash", "splash", "xla"),
-    "ce": ("pallas", "jax"),
+    # 'pallas_fused' = the one-pass CE+grad kernel (pallas_ce.ce_fused_
+    # train: backward collapses into the forward launch) — training
+    # paths only; select via evidence-gated adoption, never by default
+    "ce": ("pallas", "jax", "pallas_fused"),
+    # fused AdamW/AMP master-update (kernels/pallas_update.py): 'jax' =
+    # the models.gpt.apply_adamw tree-level form (default + oracle),
+    # 'pallas' = the one-launch-per-leaf kernel;
+    # tools/bench_fused_step.py --adopt is the evidence-gated writer
+    "fused_update": ("jax", "pallas"),
     "varlen_attention": ("blockwise", "dense"),
     # decode-path attention over the KV cache (greedy decode + the
     # serving engine's slot pool): 'dense' = f32 scores/context (the

@@ -23,7 +23,7 @@ def _plan_pp(plan) -> int:
 
 
 def resolve_plan_step(step_fn, cfg=None, mesh=None, plan=None,
-                      with_stats=False, **step_kw):
+                      with_stats=False, overlap=None, **step_kw):
     """ONE seam turning (step_fn, plan) into the callable the jit wraps.
 
     pp=1 (or no plan): `functools.partial(step_fn, cfg=..., **kw)` —
@@ -35,7 +35,14 @@ def resolve_plan_step(step_fn, cfg=None, mesh=None, plan=None,
     new_opt) contract, with the optimizer kwargs (lr, betas, ...)
     forwarded to the shared apply_adamw. Wrappers that already resolved
     (the resilient guard, the telemetry instrumenter) mark their
-    closure `_plan_resolved` so make_train_step never double-resolves."""
+    closure `_plan_resolved` so make_train_step never double-resolves.
+
+    `overlap` (None = follow `plan.overlap`) selects the latency-hiding
+    collective schedule (docs/parallel_training.md §Collective overlap).
+    It reaches make_pp_step_fn on the pp>1 path (the per-layer ZeRO-3
+    gather prefetch) and is deliberately STRIPPED on the pp=1 path —
+    the family train steps don't take it; there the knob lives in the
+    _ShardedTrainStep's compiler options instead."""
     import functools
     if (_plan_pp(plan) > 1
             and not getattr(step_fn, "_plan_resolved", False)):
@@ -44,7 +51,7 @@ def resolve_plan_step(step_fn, cfg=None, mesh=None, plan=None,
                              "plan.build_mesh())")
         from ..parallel.pipeline_train import make_pp_step_fn
         fn = make_pp_step_fn(cfg, plan, mesh, with_stats=with_stats,
-                             **step_kw)
+                             overlap=overlap, **step_kw)
         fn._plan_resolved = True
         return fn
     if cfg is not None:
@@ -86,7 +93,7 @@ def plan_step_cell(step_fn, cfg=None, mesh=None, plan=None, **step_kw):
 
 
 def make_train_step(step_fn, cfg=None, donate=True, extra_donate=(),
-                    mesh=None, plan=None, **step_kw):
+                    mesh=None, plan=None, overlap=None, **step_kw):
     """jit the stacked-params functional train step with the params and
     optimizer-state buffers DONATED — step_fn(params, opt_state, batch,
     ...) -> (loss, new_params, new_opt_state) consumes both trees and
@@ -120,9 +127,19 @@ def make_train_step(step_fn, cfg=None, donate=True, extra_donate=(),
     a recompile) between calls. The pins derive from the FIRST call's
     shapes; subsequent calls reuse the one compiled executable (the
     `trace_count` property observes this — the zero-recompiles-after-
-    warmup test gate)."""
+    warmup test gate).
+
+    `overlap=None` follows the plan's own `overlap` field (TrainPlan /
+    Plan, default off); an explicit bool wins. On: the pp>1 pipelined
+    step double-buffers its per-layer ZeRO-3 weight gathers
+    (parallel/pipeline_train.py), and the GSPMD step asks XLA for
+    async-collective fusion / collective-matmul on TPU-class backends
+    (_ShardedTrainStep — a no-op on CPU, where the xla_tpu_* flags
+    don't exist). docs/parallel_training.md §Collective overlap."""
     import jax
     from ..profiler import RecordEvent, monitor
+    if overlap is None:
+        overlap = bool(getattr(plan, "overlap", False))
     donate_argnums = ((0, 1) + tuple(extra_donate)) if donate else ()
     with RecordEvent("facade.make_train_step"):
         monitor.counter("facade_train_step_builds").add()
@@ -142,7 +159,8 @@ def make_train_step(step_fn, cfg=None, donate=True, extra_donate=(),
             def _resolve(new_mesh, new_plan):
                 inner = resolve_plan_step(step_fn, cfg=cfg,
                                           mesh=new_mesh, plan=new_plan,
-                                          with_stats=True, **step_kw)
+                                          with_stats=True,
+                                          overlap=overlap, **step_kw)
 
                 def stepfn(params, opt_state, batch, *rest):
                     return inner(params, opt_state, batch, *rest)
@@ -151,13 +169,14 @@ def make_train_step(step_fn, cfg=None, donate=True, extra_donate=(),
                 return stepfn
             return _PipelineTrainStep(
                 _resolve(mesh, plan), mesh, plan,
-                donate_argnums=donate_argnums)
+                donate_argnums=donate_argnums, overlap=overlap)
         fn = resolve_plan_step(step_fn, cfg=cfg, mesh=mesh, plan=plan,
-                               **step_kw)
+                               overlap=overlap, **step_kw)
         if mesh is None:
             return jax.jit(fn, donate_argnums=donate_argnums)
         return _ShardedTrainStep(fn, mesh, plan,
-                                 donate_argnums=donate_argnums)
+                                 donate_argnums=donate_argnums,
+                                 overlap=overlap)
 
 
 class _ShardedTrainStep:
@@ -179,14 +198,70 @@ class _ShardedTrainStep:
     Outputs index 1/2 reuse the INPUT pins verbatim — donation aliasing
     by construction, executables that cannot drift."""
 
-    def __init__(self, fn, mesh, plan, donate_argnums=()):
+    # The latency-hiding compiler profile (docs/parallel_training.md
+    # §Collective overlap): ask XLA:TPU to (a) fuse collectives into
+    # async start/done pairs and slide compute between them, and (b)
+    # lower every sharded einsum as a windowed collective-matmul
+    # (threshold 0 MiB) so the ZeRO-3 all-gather / tp reduce-scatter
+    # overlap their consuming/producing matmuls. TPU-only: CPU/GPU XLA
+    # rejects unknown xla_tpu_* flags, so _build attaches these only
+    # when the mesh's devices are TPU-class.
+    _OVERLAP_COMPILER_OPTIONS = {
+        "xla_tpu_enable_async_collective_fusion": "true",
+        "xla_tpu_enable_async_collective_fusion_fuse_all_gather":
+            "true",
+        "xla_tpu_enable_async_collective_fusion_multiple_steps":
+            "true",
+        "xla_tpu_overlap_compute_collective_tc": "true",
+        "xla_jf_spmd_threshold_for_windowed_einsum_mib": "0",
+    }
+
+    def __init__(self, fn, mesh, plan, donate_argnums=(),
+                 overlap=False):
         self._fn = fn
         self.mesh = mesh
         self.plan = plan
+        self.overlap = bool(overlap)
         self._donate = tuple(donate_argnums)
         self._jit = None
         self.in_pins = None
         self.out_pins = None
+
+    def _compiler_options(self):
+        """The overlap XLA flags, or None when they don't apply (knob
+        off, or a non-TPU backend that would reject them). Numerics
+        note: windowed einsum re-orders partial-sum accumulation, so
+        overlap-on parity vs overlap-off is trajectory-level (<=2e-4,
+        the test_plan4d convention) on real TPU; on CPU the options
+        never attach and the two steps are bit-identical."""
+        if not self.overlap:
+            return None
+        try:
+            platforms = {d.platform for d in self.mesh.devices.flat}
+        except AttributeError:
+            return None
+        if platforms != {"tpu"}:
+            return None
+        return dict(self._OVERLAP_COMPILER_OPTIONS)
+
+    def _traced_fn(self):
+        """The jit target: the step fn traced with this plan's mesh
+        ambient, so the model-internal activation hints
+        (models/gpt._sp_constraint / _tp_constraint — mesh_constraint
+        reads parallel.mesh.get_mesh() at trace time) engage instead of
+        degrading to identity. Without the ambient mesh GSPMD guesses
+        every activation layout from the weight shardings alone — the
+        audited involuntary reshards around the scan carry
+        (profiler/hlo_audit findings). Identity-stable per (_fn, mesh):
+        rebuilt only by rebuild(), so jax's trace cache never sees two
+        names for one step."""
+        from ..parallel.mesh import use_mesh
+        fn, mesh = self._fn, self.mesh
+
+        def traced(*args):
+            with use_mesh(mesh):
+                return fn(*args)
+        return traced
 
     @staticmethod
     def _leaf_name(path):
@@ -246,7 +321,8 @@ class _ShardedTrainStep:
         in_pins = (self._state_pins(args[0]), self._state_pins(args[1]),
                    self._batch_pins(args[2]),
                    *(self._replicated_pins(a) for a in args[3:]))
-        out_struct = jax.eval_shape(self._fn, *args)
+        fn = self._traced_fn()
+        out_struct = jax.eval_shape(fn, *args)
         if not (isinstance(out_struct, (tuple, list))
                 and len(out_struct) >= 3):
             raise TypeError(
@@ -262,9 +338,13 @@ class _ShardedTrainStep:
             else:
                 out_pins.append(self._replicated_pins(sub))
         self.in_pins, self.out_pins = in_pins, tuple(out_pins)
-        self._jit = jax.jit(self._fn, in_shardings=in_pins,
+        jit_kw = {}
+        opts = self._compiler_options()
+        if opts is not None:
+            jit_kw["compiler_options"] = opts
+        self._jit = jax.jit(fn, in_shardings=in_pins,
                             out_shardings=self.out_pins,
-                            donate_argnums=self._donate)
+                            donate_argnums=self._donate, **jit_kw)
 
     def __call__(self, params, opt_state, batch, *rest):
         import jax
@@ -362,8 +442,10 @@ class _PipelineTrainStep(_ShardedTrainStep):
     entirely in the pins); this subclass only resets the
     measurement."""
 
-    def __init__(self, fn, mesh, plan, donate_argnums=()):
-        super().__init__(fn, mesh, plan, donate_argnums=donate_argnums)
+    def __init__(self, fn, mesh, plan, donate_argnums=(),
+                 overlap=False):
+        super().__init__(fn, mesh, plan, donate_argnums=donate_argnums,
+                         overlap=overlap)
         self.bubble_fraction = None
 
     def __call__(self, params, opt_state, batch, *rest):

@@ -211,29 +211,69 @@ def _ln(x, scale, bias, eps):
     return (out * scale + bias).astype(x.dtype)
 
 
+def _model_axis():
+    """The live model-parallel mesh-axis NAME for activation hints:
+    'mp' by family convention, but the 3D/4D planner meshes
+    (parallel/planner.py plan_train) name the remapped axis 'tp' — an
+    'mp' hint there would make mesh_constraint degrade to identity
+    (all-or-nothing), leaving GSPMD to guess the activation layouts
+    (the audited involuntary reshards around the scan carry). Resolved
+    per trace from the ambient mesh; None outside a mesh."""
+    mesh = get_mesh()
+    if mesh is None:
+        return None
+    for ax in ("mp", "tp"):
+        if ax in mesh.axis_names:
+            return ax
+    return None
+
+
 def _sp_constraint(x, cfg):
     """Sequence-parallel: shard (batch, seq) as (dp, mp) in norm regions."""
     if cfg.sequence_parallel:
-        return mesh_constraint(x, P(("dp", "fsdp"), "mp", None))
+        return mesh_constraint(x, P(("dp", "fsdp"), _model_axis(), None))
     return mesh_constraint(x, P(("dp", "fsdp"), None, None))
 
 
 def _tp_constraint(x, cfg):
     """Inside attention/FFN: batch on dp, heads/features on mp."""
-    return mesh_constraint(x, P(("dp", "fsdp"), None, "mp"))
+    return mesh_constraint(x, P(("dp", "fsdp"), None, _model_axis()))
 
 
 def _attention(x, w_qkv, b_qkv, w_out, b_out, cfg, mask_causal=True):
     B, S, D = x.shape
     H, hd = cfg.num_heads, cfg.head_dim
-    qkv = jnp.einsum("bsd,df->bsf", x, w_qkv.astype(x.dtype))
+    # Reshard hygiene (hlo_audit): the fused [D, q|k|v] weight's tp
+    # shard tiles (3D/tp columns) straddle the q/k/v block boundaries
+    # at D, so splitting a tp-sharded [B,S,3D] projection makes GSPMD
+    # re-tile each block with involuntary collective-permutes inside
+    # the layer scan (resharding_permute findings, once per layer per
+    # direction). Gather the weight first — an all-gather over fsdp/mp,
+    # the PLANNED ZeRO-3/Megatron spelling whose autodiff transpose is
+    # the gradient reduce-scatter (the same gather-then-slice schedule
+    # the full-manual pp step hand-writes in
+    # parallel/pipeline_train._gpt_stage_compute) — project in ONE fused
+    # einsum, pin the projection's feature dim replicated so the q/k/v
+    # split is shard-local, then re-pin each projection head-parallel
+    # (H is a multiple of the tp degree, so that slice is local too).
+    # Concretely: reshape the gathered weight to [D, 3, H, hd] (free on
+    # a replicated value) and project straight into head-structured
+    # form — the projection is then tp-sharded on the HEAD dim with
+    # block-aligned boundaries, and the q/k/v selection is an indexed
+    # slice of an UNSHARDED dim, shard-local in both directions of
+    # autodiff. Splitting a [B,S,3D] projection (or the weight) instead
+    # leaves 3D/tp shard tiles straddling the block boundaries, which
+    # the scan residual stash re-tiles with misaligned permutes.
+    ax = _model_axis()
+    w_qkv = mesh_constraint(w_qkv, P(None, None))
+    w4 = w_qkv.astype(x.dtype).reshape(D, 3, H, hd)
+    p = jnp.einsum("bsd,dkhf->bskhf", x, w4)
     if b_qkv is not None:
-        qkv = qkv + b_qkv.astype(x.dtype)
-    qkv = _tp_constraint(qkv, cfg)
-    q, k_, v = jnp.split(qkv, 3, axis=-1)
-    q = q.reshape(B, S, H, hd)
-    k_ = k_.reshape(B, S, H, hd)
-    v = v.reshape(B, S, H, hd)
+        b_qkv = mesh_constraint(b_qkv, P(None))
+        p = p + b_qkv.astype(x.dtype).reshape(3, H, hd)
+    p = mesh_constraint(p, P(("dp", "fsdp"), None, None, ax, None))
+    head_spec = P(("dp", "fsdp"), None, ax, None)
+    q, k_, v = (mesh_constraint(p[:, :, i], head_spec) for i in range(3))
     if cfg.context_parallel in ("ring", "ulysses"):
         from ..parallel.mesh import get_mesh
         from ..parallel.context_parallel import (ring_attention,
@@ -267,19 +307,38 @@ def _attention(x, w_qkv, b_qkv, w_out, b_out, cfg, mask_causal=True):
     # policy alone recomputes all attention forwards in the backward
     from jax.ad_checkpoint import checkpoint_name
     ctx = checkpoint_name(ctx, "flash_out")
-    ctx = ctx.reshape(B, S, D)
+    ctx = mesh_constraint(ctx, head_spec)
+    ctx = mesh_constraint(ctx.reshape(B, S, D),
+                          P(("dp", "fsdp"), None, ax))
+    # row-parallel output projection: the mp-sharded contraction leaves
+    # per-rank partial sums — GSPMD's all-reduce here is the planned
+    # Megatron activation reduction, and pinning the result replicated
+    # on the feature dim stops the scan carry from flipping layouts
     out = jnp.einsum("bsd,df->bsf", ctx, w_out.astype(x.dtype))
+    out = mesh_constraint(out, P(("dp", "fsdp"), None, None))
     if b_out is not None:
         out = out + b_out.astype(x.dtype)
     return out
 
 
 def _dense_ffn(x, up_w, up_b, down_w, down_b):
+    # column→row parallel Megatron pair; the explicit pins keep the
+    # hidden activation's batch dim on the SAME ("dp","fsdp") merged
+    # axis order as every other activation — without them the up
+    # projection's autodiff transpose regroups the batch contraction in
+    # (fsdp,dp) order and GSPMD bridges the two linearizations with a
+    # collective-permute inside the scan (hlo_audit resharding_permute)
+    ax = _model_axis()
+    x = mesh_constraint(x, P(("dp", "fsdp"), None, None))
+    up_w = mesh_constraint(up_w, P(None, None))
     h = jnp.einsum("bsd,df->bsf", x, up_w.astype(x.dtype))
     if up_b is not None:
         h = h + up_b.astype(x.dtype)
+    h = mesh_constraint(h, P(("dp", "fsdp"), None, ax))
     h = jax.nn.gelu(h)
+    down_w = mesh_constraint(down_w, P(None, None))
     out = jnp.einsum("bsf,fd->bsd", h, down_w.astype(x.dtype))
+    out = mesh_constraint(out, P(("dp", "fsdp"), None, None))
     if down_b is not None:
         out = out + down_b.astype(x.dtype)
     return out
@@ -452,7 +511,16 @@ def _apply_stack(stacked, x, cfg: GPTConfig):
 def _gpt_forward_impl(params, tokens, cfg: GPTConfig):
     """→ (logits [B,S,V], aux MoE loss)."""
     B, S = tokens.shape
-    x = jnp.take(params["wte"], tokens, axis=0).astype(cfg.dtype)
+    # Reshard hygiene (hlo_audit): a token gather from the
+    # vocab-sharded table makes GSPMD reshard the gathered rows between
+    # layouts (involuntary full rematerialization at this op). Gather
+    # the table first — an all-gather over mp/fsdp, planned ZeRO-3
+    # spelling, whose transpose reduce-scatters the embedding cotangent
+    # back onto the shards — then the row lookup is rank-local. The
+    # tied LM head below keeps consuming the SHARDED table: the
+    # vocab-parallel matmul never needs full rows.
+    wte = mesh_constraint(params["wte"], P(None, None))
+    x = jnp.take(wte, tokens, axis=0).astype(cfg.dtype)
     x = x + params["wpe"][:S][None].astype(cfg.dtype)
     x = _sp_constraint(x, cfg)
 
@@ -460,10 +528,16 @@ def _gpt_forward_impl(params, tokens, cfg: GPTConfig):
     stacked = {k: params[k] for k in block_keys if k in params}
 
     x, aux = _apply_stack(stacked, x, cfg)
+    # re-pin the scan output: the layer scan's COTANGENT carry seeds
+    # from this value's layout, and without the pin the unembed dgrad
+    # hands the transpose scan a relinearized (fsdp-major) batch
+    # assignment that GSPMD then bridges with a per-iteration
+    # collective-permute inside the backward while loop
+    x = _sp_constraint(x, cfg)
     x = _ln(x, params["ln_f_scale"], params["ln_f_bias"], cfg.layer_norm_eps)
     # tied LM head (vocab-parallel matmul — mp shards the vocab dim)
     logits = jnp.einsum("bsd,vd->bsv", x, params["wte"].astype(x.dtype))
-    logits = mesh_constraint(logits, P(("dp", "fsdp"), None, "mp"))
+    logits = mesh_constraint(logits, P(("dp", "fsdp"), None, _model_axis()))
     return logits, aux
 
 
@@ -509,7 +583,18 @@ def apply_adamw(grads, params, opt_state, lr, beta1=0.9, beta2=0.95,
                 eps=1e-8, weight_decay=0.1):
     """One fused AdamW update over the param tree (f32 master math,
     params cast back to their storage dtype). Shared by every flagship
-    family's train_step (gpt, llama) so the update rule cannot drift."""
+    family's train_step (gpt, llama) so the update rule cannot drift.
+
+    On TPU-class backends with an evidence-gated 'fused_update' registry
+    winner the whole update runs through the hand-tiled Pallas kernel
+    (kernels/pallas_update.py — one launch per leaf, rule-for-rule these
+    numerics); this jax form stays the default and the parity oracle."""
+    from ..kernels.pallas_update import fused_update_enabled
+    if fused_update_enabled():
+        from ..kernels.pallas_update import fused_apply_adamw
+        return fused_apply_adamw(grads, params, opt_state, lr,
+                                 beta1=beta1, beta2=beta2, eps=eps,
+                                 weight_decay=weight_decay)
     step = opt_state["step"] + 1.0
     bc1 = 1.0 - beta1 ** step
     bc2 = 1.0 - beta2 ** step

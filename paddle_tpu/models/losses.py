@@ -48,7 +48,15 @@ def fused_softmax_ce(logits, targets, valid_mask=None):
     lead = logits.shape[:-1]
     V = logits.shape[-1]
     if _pallas_ce_enabled() and pallas_ce.suitable(logits.shape):
-        per_pos = pallas_ce.ce_with_logits(
+        # the one-pass CE+grad flavor (backward folded into the forward
+        # launch) rides the SAME enablement gate but only engages when
+        # the registry's evidence-gated winner names it explicitly —
+        # a primal-only caller would pay for the discarded d_logits
+        from ..kernels import registry
+        ce_fn = (pallas_ce.ce_fused_train
+                 if registry.winner("ce", backend="tpu")
+                 == "pallas_fused" else pallas_ce.ce_with_logits)
+        per_pos = ce_fn(
             logits.reshape(-1, V),
             targets.reshape(-1).astype(jnp.int32)).reshape(lead)
     else:

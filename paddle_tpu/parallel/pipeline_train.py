@@ -124,18 +124,60 @@ def _vocab_parallel_ce(logits, targets, tp_axis: str):
     return jnp.mean(lse - tgt)
 
 
-def _run_pipeline(stacked, x, block_fn, pp: int, microbatches: int,
-                  remat: bool):
+def _run_pipeline(stacked, x, gather_fn, compute_fn, pp: int,
+                  microbatches: int, remat: bool, overlap: bool = False):
     """Microbatch the local activations and run the stage-chunk scan
     through spmd_pipeline's circulate schedule. `stacked` leaves carry
-    this rank's [L/pp, ...] stage chunk; returns (y, schedule stats)."""
-    body = jax.checkpoint(block_fn) if remat else block_fn
+    this rank's [L/pp, ...] stage chunk; returns (y, schedule stats).
 
-    def stage_fn(chunk, h):
-        def scan_body(h, lp):
-            return body(lp, h), None
-        h, _ = jax.lax.scan(scan_body, h, chunk)
-        return h
+    The per-layer block is split at the ZeRO-3 seam:
+    `gather_fn(lp) -> gw` issues the just-in-time weight all-gathers,
+    `compute_fn(gw, h)` is everything else. overlap=False composes the
+    two inside the scan body — the historical trace, gather and compute
+    strictly serial per layer. overlap=True double-buffers the gather
+    through the scan CARRY: layer 0's weights gather before the scan,
+    and iteration i issues layer i+1's all-gather BEFORE running layer
+    i's compute, so XLA's async scheduler can slide the gather under
+    the matmuls (latency-hiding collectives —
+    docs/parallel_training.md §Collective overlap). The autodiff
+    transpose replays the same offset in reverse: layer i+1's gradient
+    reduce-scatter (the gather's transpose) lands in iteration i's
+    backward, overlapping layer i's dgrad matmuls.
+
+    Costs, by construction: one extra (discarded) gather per stage scan
+    (the xs roll wraps layer 0 back in at the end), and — under
+    remat — the gathered weights ride the carry, so they are saved as
+    per-iteration residuals instead of re-gathered in the backward:
+    overlap trades the ZeRO-3 backward re-gather's memory saving for
+    schedule slack. That is why the knob is off by default."""
+    if not overlap:
+        def block_fn(lp, h):
+            return compute_fn(gather_fn(lp), h)
+        body = jax.checkpoint(block_fn) if remat else block_fn
+
+        def stage_fn(chunk, h):
+            def scan_body(h, lp):
+                return body(lp, h), None
+            h, _ = jax.lax.scan(scan_body, h, chunk)
+            return h
+    else:
+        comp = jax.checkpoint(compute_fn) if remat else compute_fn
+
+        def stage_fn(chunk, h):
+            first = jax.tree_util.tree_map(lambda a: a[0], chunk)
+            gw0 = gather_fn(first)
+            # xs rolled by -1: iteration i carries layer i's gathered
+            # weights in and sees layer i+1's SHARDED leaves as xs
+            nxt = jax.tree_util.tree_map(
+                lambda a: jnp.roll(a, -1, axis=0), chunk)
+
+            def scan_body(carry, lp_next):
+                h, gw = carry
+                gw_next = gather_fn(lp_next)   # prefetch: issue first,
+                h = comp(gw, h)                # compute hides it
+                return (h, gw_next), None
+            (h, _), _ = jax.lax.scan(scan_body, (h, gw0), nxt)
+            return h
 
     b_loc = x.shape[0]
     x_mb = x.reshape((microbatches, b_loc // microbatches) + x.shape[1:])
@@ -150,12 +192,30 @@ def _run_pipeline(stacked, x, block_fn, pp: int, microbatches: int,
 
 
 # ------------------------------------------------------- family: GPT
-def _gpt_stage_block(lp, x, cfg, tp: int, tp_axis: str):
+def _gpt_gather_weights(lp, tp_axis: str):
+    """The layer's just-in-time ZeRO-3/tp weight gathers — the overlap
+    seam (_run_pipeline): everything here may be issued one layer ahead
+    of the compute consuming it. Pass-through leaves (ln scales/biases,
+    the tp-partial output biases) copy through unchanged so compute
+    reads ONE dict."""
+    gw = dict(lp)
+    gw["qkv_w"] = _gather(_gather(lp["qkv_w"], "fsdp", 0),
+                          tp_axis, 1)                          # [D, 3D]
+    if lp.get("qkv_b") is not None:
+        gw["qkv_b"] = _gather(lp["qkv_b"], tp_axis, 0)         # [3D]
+    gw["attn_out_w"] = _gather(lp["attn_out_w"], "fsdp", 1)    # [D/tp,D]
+    gw["mlp_up_w"] = _gather(lp["mlp_up_w"], "fsdp", 0)        # [D,F/tp]
+    gw["mlp_down_w"] = _gather(lp["mlp_down_w"], "fsdp", 1)    # [F/tp,D]
+    return gw
+
+
+def _gpt_stage_compute(gw, x, cfg, tp: int, tp_axis: str):
     """One transformer block over this rank's tp shard (models/gpt._block
-    semantics, hand-partitioned). The fused qkv weight's [3·D] column
-    axis concatenates q|k|v, so its tp shard is NOT a head block —
-    gather the columns once and slice this rank's heads out of each of
-    q/k/v (exact: column selection commutes with the matmul)."""
+    semantics, hand-partitioned) given pre-gathered weights `gw`. The
+    fused qkv weight's [3·D] column axis concatenates q|k|v, so its tp
+    shard is NOT a head block — gather the columns once and slice this
+    rank's heads out of each of q/k/v (exact: column selection commutes
+    with the matmul)."""
     from ..models.gpt import _ln
     D = cfg.hidden_size
     H, hd = cfg.num_heads, cfg.head_dim
@@ -164,10 +224,9 @@ def _gpt_stage_block(lp, x, cfg, tp: int, tp_axis: str):
     B, S, _ = x.shape
 
     h = x
-    a_in = _ln(h, lp["ln1_scale"], lp["ln1_bias"], cfg.layer_norm_eps)
-    w_qkv = _gather(_gather(lp["qkv_w"], "fsdp", 0), tp_axis, 1)  # [D,3D]
-    b_qkv = (_gather(lp["qkv_b"], tp_axis, 0)
-             if lp.get("qkv_b") is not None else None)             # [3D]
+    a_in = _ln(h, gw["ln1_scale"], gw["ln1_bias"], cfg.layer_norm_eps)
+    w_qkv = gw["qkv_w"]                                        # [D, 3D]
+    b_qkv = gw.get("qkv_b")                                    # [3D]
 
     def head_cols(w, j):
         return jax.lax.dynamic_slice_in_dim(w, j * D + ti * d_loc, d_loc,
@@ -183,29 +242,29 @@ def _gpt_stage_block(lp, x, cfg, tp: int, tp_axis: str):
     q, k, v = qkv_loc
     from ..kernels.flash_attention import flash_attention_fn
     ctx = flash_attention_fn(q, k, v, causal=True).reshape(B, S, d_loc)
-    w_o = _gather(lp["attn_out_w"], "fsdp", 1)                 # [D/tp, D]
+    w_o = gw["attn_out_w"]                                     # [D/tp, D]
     a = jax.lax.psum(
         jnp.einsum("bsd,df->bsf", ctx, w_o.astype(ctx.dtype)), tp_axis)
-    if lp.get("attn_out_b") is not None:
-        a = a + lp["attn_out_b"].astype(a.dtype)
+    if gw.get("attn_out_b") is not None:
+        a = a + gw["attn_out_b"].astype(a.dtype)
     h = h + a
 
-    m_in = _ln(h, lp["ln2_scale"], lp["ln2_bias"], cfg.layer_norm_eps)
-    w_up = _gather(lp["mlp_up_w"], "fsdp", 0)                  # [D, F/tp]
+    m_in = _ln(h, gw["ln2_scale"], gw["ln2_bias"], cfg.layer_norm_eps)
+    w_up = gw["mlp_up_w"]                                      # [D, F/tp]
     mh = jnp.einsum("bsd,df->bsf", m_in, w_up.astype(m_in.dtype))
-    if lp.get("mlp_up_b") is not None:
-        mh = mh + lp["mlp_up_b"].astype(mh.dtype)
+    if gw.get("mlp_up_b") is not None:
+        mh = mh + gw["mlp_up_b"].astype(mh.dtype)
     mh = jax.nn.gelu(mh)
-    w_dn = _gather(lp["mlp_down_w"], "fsdp", 1)                # [F/tp, D]
+    w_dn = gw["mlp_down_w"]                                    # [F/tp, D]
     mo = jax.lax.psum(
         jnp.einsum("bsf,fd->bsd", mh, w_dn.astype(mh.dtype)), tp_axis)
-    if lp.get("mlp_down_b") is not None:
-        mo = mo + lp["mlp_down_b"].astype(mo.dtype)
+    if gw.get("mlp_down_b") is not None:
+        mo = mo + gw["mlp_down_b"].astype(mo.dtype)
     return h + mo
 
 
 def _gpt_pp_ce(params, toks, cfg, tp: int, tp_axis: str, pp: int,
-               microbatches: int):
+               microbatches: int, overlap: bool = False):
     from ..models import gpt as gpt_mod
     inp, tgt = toks[:, :-1], toks[:, 1:]
     S = inp.shape[1]
@@ -215,10 +274,12 @@ def _gpt_pp_ce(params, toks, cfg, tp: int, tp_axis: str, pp: int,
     x = x + wpe[:S][None].astype(cfg.dtype)
     stacked = {k: params[k] for k in gpt_mod._BLOCK_KEYS_DENSE
                if k in params}
-    block = functools.partial(_gpt_stage_block, cfg=cfg, tp=tp,
-                              tp_axis=tp_axis)
-    y, stats = _run_pipeline(stacked, x, block, pp, microbatches,
-                             remat=cfg.remat)
+    gather = functools.partial(_gpt_gather_weights, tp_axis=tp_axis)
+    compute = functools.partial(_gpt_stage_compute, cfg=cfg, tp=tp,
+                                tp_axis=tp_axis)
+    y, stats = _run_pipeline(stacked, x, gather, compute, pp,
+                             microbatches, remat=cfg.remat,
+                             overlap=overlap)
     y = gpt_mod._ln(y, params["ln_f_scale"], params["ln_f_bias"],
                     cfg.layer_norm_eps)
     logits = jnp.einsum("bsd,vd->bsv", y, wte.astype(y.dtype))
@@ -226,24 +287,32 @@ def _gpt_pp_ce(params, toks, cfg, tp: int, tp_axis: str, pp: int,
 
 
 # ----------------------------------------------------- family: Llama
-def _llama_stage_block(lp, x, cfg, tp: int, tp_axis: str, cos, sin):
-    """models/llama._block over this rank's tp shard. The separate
-    q/k/v leaves column-shard straight into contiguous head blocks
-    (no fused-qkv reshuffle); GQA holds KV/tp kv-heads per rank, and
-    the repeat factor H//KV aligns them with this rank's query
-    heads."""
+def _llama_gather_weights(lp):
+    """Llama's per-layer ZeRO-3 gathers — the overlap seam (see
+    _gpt_gather_weights). Norm scales copy through."""
+    gw = dict(lp)
+    for k in ("q_w", "k_w", "v_w", "gate_w", "up_w"):
+        gw[k] = _gather(lp[k], "fsdp", 0)
+    for k in ("o_w", "down_w"):
+        gw[k] = _gather(lp[k], "fsdp", 1)
+    return gw
+
+
+def _llama_stage_compute(gw, x, cfg, tp: int, tp_axis: str, cos, sin):
+    """models/llama._block over this rank's tp shard, given pre-gathered
+    weights `gw`. The separate q/k/v leaves column-shard straight into
+    contiguous head blocks (no fused-qkv reshuffle); GQA holds KV/tp
+    kv-heads per rank, and the repeat factor H//KV aligns them with
+    this rank's query heads."""
     from ..models.llama import _rmsnorm, _apply_rope
     H, KV, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     h_loc, kv_loc = H // tp, KV // tp
     B, S, D = x.shape
 
-    h = _rmsnorm(x, lp["attn_norm"], cfg.rms_eps)
-    q = (h @ _gather(lp["q_w"], "fsdp", 0).astype(h.dtype)
-         ).reshape(B, S, h_loc, hd)
-    k = (h @ _gather(lp["k_w"], "fsdp", 0).astype(h.dtype)
-         ).reshape(B, S, kv_loc, hd)
-    v = (h @ _gather(lp["v_w"], "fsdp", 0).astype(h.dtype)
-         ).reshape(B, S, kv_loc, hd)
+    h = _rmsnorm(x, gw["attn_norm"], cfg.rms_eps)
+    q = (h @ gw["q_w"].astype(h.dtype)).reshape(B, S, h_loc, hd)
+    k = (h @ gw["k_w"].astype(h.dtype)).reshape(B, S, kv_loc, hd)
+    v = (h @ gw["v_w"].astype(h.dtype)).reshape(B, S, kv_loc, hd)
     q = _apply_rope(q, cos, sin)
     k = _apply_rope(k, cos, sin)
     if KV != H:
@@ -251,21 +320,21 @@ def _llama_stage_block(lp, x, cfg, tp: int, tp_axis: str, cos, sin):
         v = jnp.repeat(v, H // KV, axis=2)
     from ..kernels.flash_attention import flash_attention_fn
     ctx = flash_attention_fn(q, k, v, causal=True)
-    w_o = _gather(lp["o_w"], "fsdp", 1)                # [(H·hd)/tp, D]
+    w_o = gw["o_w"]                                    # [(H·hd)/tp, D]
     x = x + jax.lax.psum(
         ctx.reshape(B, S, h_loc * hd) @ w_o.astype(x.dtype), tp_axis)
 
-    hh = _rmsnorm(x, lp["ffn_norm"], cfg.rms_eps)
+    hh = _rmsnorm(x, gw["ffn_norm"], cfg.rms_eps)
     gated = jax.nn.silu(
-        hh @ _gather(lp["gate_w"], "fsdp", 0).astype(hh.dtype)) * (
-        hh @ _gather(lp["up_w"], "fsdp", 0).astype(hh.dtype))
-    w_dn = _gather(lp["down_w"], "fsdp", 1)            # [F/tp, D]
+        hh @ gw["gate_w"].astype(hh.dtype)) * (
+        hh @ gw["up_w"].astype(hh.dtype))
+    w_dn = gw["down_w"]                                # [F/tp, D]
     x = x + jax.lax.psum(gated @ w_dn.astype(x.dtype), tp_axis)
     return x
 
 
 def _llama_pp_ce(params, toks, cfg, tp: int, tp_axis: str, pp: int,
-                 microbatches: int):
+                 microbatches: int, overlap: bool = False):
     from ..models import llama as llama_mod
     inp, tgt = toks[:, :-1], toks[:, 1:]
     S = inp.shape[1]
@@ -274,10 +343,11 @@ def _llama_pp_ce(params, toks, cfg, tp: int, tp_axis: str, pp: int,
     cos, sin = llama_mod._rope_tables(S, cfg.head_dim, cfg.rope_theta)
     stacked = {k: params[k] for k in llama_mod._BLOCK_KEYS
                if k in params}
-    block = functools.partial(_llama_stage_block, cfg=cfg, tp=tp,
-                              tp_axis=tp_axis, cos=cos, sin=sin)
-    y, stats = _run_pipeline(stacked, x, block, pp, microbatches,
-                             remat=cfg.remat)
+    compute = functools.partial(_llama_stage_compute, cfg=cfg, tp=tp,
+                                tp_axis=tp_axis, cos=cos, sin=sin)
+    y, stats = _run_pipeline(stacked, x, _llama_gather_weights, compute,
+                             pp, microbatches, remat=cfg.remat,
+                             overlap=overlap)
     y = llama_mod._rmsnorm(y, params["norm_f"], cfg.rms_eps)
     logits = jnp.einsum("bsd,vd->bsv", y, wte.astype(y.dtype))
     return _vocab_parallel_ce(logits, tgt, tp_axis), stats
@@ -296,15 +366,22 @@ def _family_of(cfg) -> str:
 
 # ------------------------------------------------------- the step builder
 def make_pp_step_fn(cfg, plan, mesh, lr: float = 3e-4,
-                    with_stats: bool = False, **adamw_kw):
+                    with_stats: bool = False, overlap=None, **adamw_kw):
     """Build the facade-contract pp>1 train step fn for (cfg, plan):
     `(params, opt_state, batch) -> (loss, new_params, new_opt)` — plus
     a trailing schedule-measured bubble-fraction scalar under
     `with_stats=True`. The fn traces ONE full-manual shard_map over the
     plan's mesh; models.facade.make_train_step wraps it in the pinned
     _ShardedTrainStep machinery (resolve_plan_step is the seam the
-    resilient guard and the telemetry instrumenter route through)."""
+    resilient guard and the telemetry instrumenter route through).
+
+    `overlap` (None = follow `plan.overlap`) selects _run_pipeline's
+    double-buffered ZeRO-3 gather prefetch
+    (docs/parallel_training.md §Collective overlap)."""
     family = _family_of(cfg)
+    if overlap is None:
+        overlap = bool(getattr(plan, "overlap", False))
+    overlap = bool(overlap)
     pp = int(plan.axes.get("pp", 1))
     if pp <= 1:
         raise ValueError("make_pp_step_fn needs a plan with a pp>1 axis"
@@ -379,7 +456,7 @@ def make_pp_step_fn(cfg, plan, mesh, lr: float = 3e-4,
 
         def loss_fn(p):
             ce, stats = ce_fn(p, toks, cfg, tp, tp_axis, pp,
-                              microbatches)
+                              microbatches, overlap)
             stage = jax.lax.axis_index("pp")
             # per-device PARTIAL loss: masked to the LAST stage (where
             # the pipeline's outputs are real — the mask also routes
@@ -418,4 +495,5 @@ def make_pp_step_fn(cfg, plan, mesh, lr: float = 3e-4,
 
     step.plan = plan
     step.microbatches = microbatches
+    step.overlap = overlap
     return step

@@ -131,6 +131,12 @@ class Plan:
     pp: int = 1
     fsdp: int = 1
     microbatches: int = 1
+    # latency-hiding collectives (docs/parallel_training.md §Collective
+    # overlap): the pp step double-buffers the ZeRO-3 layer gather and
+    # the GSPMD step gets the async-collective XLA flags; priced as a
+    # deeper fsdp discount in _estimate. Off by default — adoption is
+    # evidence-gated, never assumed.
+    overlap: bool = False
     step_s: float = float("inf")
     mem_bytes: float = 0.0
     fits: bool = True
@@ -167,6 +173,17 @@ def _ring_factor(n: int) -> float:
     """Per-chip all-reduce volume multiplier: ring moves 2(n-1)/n of the
     buffer through each chip."""
     return 2.0 * (n - 1) / n if n > 1 else 0.0
+
+
+# Fraction of the ZeRO-3 gather/scatter volume still EXPOSED on the
+# critical path when plan.overlap double-buffers the per-layer gather
+# (layer i+1's all-gather issues under layer i's compute; the transpose
+# reduce-scatter overlaps the backward the same way). One layer's
+# gather — the un-prefetchable first one — plus scheduling slack; kept
+# a single named constant so cost_model.train_step_ledger prices the
+# coll_fsdp phase with the SAME number (tools/train_attrib --compare
+# cross-checks the two).
+FSDP_OVERLAP_EXPOSED = 0.4
 
 
 def _estimate(plan: Plan, spec: ModelSpec, global_batch: int,
@@ -219,7 +236,15 @@ def _estimate(plan: Plan, spec: ModelSpec, global_batch: int,
     dp_ops = 2 if dp > 1 else 0
     fsdp_ops = 3 if fsdp > 1 else 0
     pp_ops = 2 * plan.microbatches if pp > 1 else 0
-    comm_s = ((tp_bytes * 1.0 + dp_bytes * 0.3 + fsdp_bytes * 0.6
+    # latency-hiding collectives (plan.overlap): the double-buffered
+    # ZeRO-3 gather issues layer i+1's all-gather while layer i
+    # computes, so only the un-hideable fraction of the fsdp volume
+    # stays on the critical path (FSDP_OVERLAP_EXPOSED of the default
+    # 0.6 exposure). TP all-reduces stay at 1.0 — collective-matmul
+    # hides them only on real TPU rungs, and pricing must not promise
+    # what the CPU rung can't measure.
+    fsdp_disc = (0.6 * FSDP_OVERLAP_EXPOSED if plan.overlap else 0.6)
+    comm_s = ((tp_bytes * 1.0 + dp_bytes * 0.3 + fsdp_bytes * fsdp_disc
                + pp_bytes * 0.5) / chip.ici_bw
               + (tp_ops + dp_ops + fsdp_ops + pp_ops)
               * chip.coll_latency)
@@ -239,7 +264,7 @@ def _estimate(plan: Plan, spec: ModelSpec, global_batch: int,
     plan.breakdown = {
         "compute_s": compute_s, "tp_s": tp_bytes / chip.ici_bw,
         "dp_s": dp_bytes * 0.3 / chip.ici_bw,
-        "fsdp_s": fsdp_bytes * 0.6 / chip.ici_bw,
+        "fsdp_s": fsdp_bytes * fsdp_disc / chip.ici_bw,
         "pp_s": pp_bytes * 0.5 / chip.ici_bw,
         "state_gb": state_bytes / 1e9, "act_gb": act_bytes / 1e9,
     }
@@ -378,6 +403,9 @@ class TrainPlan:
     batch_axes: tuple
     plan: Plan
     specs: Optional[Dict] = None
+    # latency-hiding collectives knob (mirrors Plan.overlap): the
+    # facade reads it as the default for make_train_step(overlap=None)
+    overlap: bool = False
 
     @property
     def name(self) -> str:
@@ -474,7 +502,7 @@ def plan_train(cfg_or_spec, n_devices: int, global_batch: int,
                pp: Optional[int] = None,
                microbatches: Optional[int] = None,
                tp_axis: str = "tp", param_specs: Optional[Dict] = None,
-               **kw) -> TrainPlan:
+               overlap: bool = False, **kw) -> TrainPlan:
     """The executable dp×fsdp×tp(×pp) assignment for a model config:
     search the cost model, then emit the {axes -> PartitionSpec tree}
     contract: mesh axes for build_mesh, the family PARAM_SPECS remapped
@@ -531,7 +559,8 @@ def plan_train(cfg_or_spec, n_devices: int, global_batch: int,
                 + "; ".join(problems),
                 constraint="; ".join(problems))
         best = _estimate(Plan(dp=dp, mp=tp, pp=pp, fsdp=fsdp,
-                              microbatches=mb), spec, global_batch, chip)
+                              microbatches=mb, overlap=overlap),
+                         spec, global_batch, chip)
     else:
         plans = enumerate_plans(spec, n_devices, global_batch, chip, **kw)
         pp1 = [p for p in plans if p.pp == 1]
@@ -569,6 +598,14 @@ def plan_train(cfg_or_spec, n_devices: int, global_batch: int,
                 f"{n_devices} devices: "
                 + _diagnose_empty(spec, n_devices, global_batch,
                                   kw.get("max_mp")))
+    if overlap and not best.overlap:
+        # the search priced candidates without overlap (the knob never
+        # changes WHICH plan wins — it scales one phase); re-price the
+        # winner so step_s/breakdown reflect the hidden fsdp volume
+        best = _estimate(
+            Plan(dp=best.dp, mp=best.mp, pp=best.pp, fsdp=best.fsdp,
+                 microbatches=best.microbatches, overlap=True),
+            spec, global_batch, chip)
     axes = {"dp": best.dp, "fsdp": best.fsdp, tp_axis: best.mp}
     mapping = {"dp": "dp", "fsdp": "fsdp", "mp": tp_axis}
     if best.pp > 1:
@@ -599,7 +636,8 @@ def plan_train(cfg_or_spec, n_devices: int, global_batch: int,
     if best.pp <= 1:
         monitor.gauge("train.bubble_fraction").set(0.0)
     return TrainPlan(axes=axes, mapping=mapping,
-                     batch_axes=("dp", "fsdp"), plan=best, specs=specs)
+                     batch_axes=("dp", "fsdp"), plan=best, specs=specs,
+                     overlap=bool(overlap))
 
 
 def _divisors_desc(n: int) -> List[int]:
@@ -666,7 +704,8 @@ def degrade_plan(cfg_or_spec, old: TrainPlan, n_surviving: int,
                               global_batch, chip=chip, dp=dp, fsdp=fsdp,
                               tp=tp0, pp=pp0,
                               microbatches=mb if pp0 > 1 else None,
-                              tp_axis=tp_axis, param_specs=param_specs)
+                              tp_axis=tp_axis, param_specs=param_specs,
+                              overlap=getattr(old, "overlap", False))
         oom.append(priced)
     # tp/pp cannot be held (or every held candidate is OOM): full
     # search, largest world first — pp=1 plans preferred (stage
@@ -696,7 +735,8 @@ def degrade_plan(cfg_or_spec, old: TrainPlan, n_surviving: int,
             return plan_train(cfg_or_spec, n, global_batch, chip=chip,
                               dp=best.dp, fsdp=best.fsdp, tp=best.mp,
                               pp=best.pp, microbatches=mb,
-                              tp_axis=tp_axis, param_specs=param_specs)
+                              tp_axis=tp_axis, param_specs=param_specs,
+                              overlap=getattr(old, "overlap", False))
     if oom:
         best = min(oom, key=lambda p: p.mem_bytes)
         raise NoFeasiblePlanError(

@@ -237,6 +237,167 @@ class TestPallasCrossEntropy:
         assert abs(jax_val - kernel_val) < 1e-5
 
 
+class TestPallasFusedCE:
+    """One-pass CE+grad kernel (pallas_ce.ce_fused_train / _ce_fused):
+    loss AND d_logits out of one launch, vs the jax oracle, interpret
+    mode."""
+
+    def _data(self, T=50, V=700, seed=11):
+        rng = np.random.RandomState(seed)
+        logits = jnp.asarray(rng.randn(T, V).astype(np.float32) * 3)
+        tgt = jnp.asarray(rng.randint(0, V, T), jnp.int32)
+        return logits, tgt
+
+    def test_loss_matches_two_pass_kernel(self):
+        from paddle_tpu.kernels.pallas_ce import (ce_fused_train,
+                                                  ce_with_logits)
+        logits, tgt = self._data()
+        fused = ce_fused_train(logits, tgt, True)
+        two_pass = ce_with_logits(logits, tgt, True)
+        np.testing.assert_allclose(np.asarray(fused),
+                                   np.asarray(two_pass),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_grad_parity_vs_jax_oracle(self):
+        """The folded backward (saved d_logits × cotangent) against
+        jax.grad of the dense logsumexp form."""
+        from paddle_tpu.kernels.pallas_ce import ce_fused_train
+        logits, tgt = self._data()
+
+        def f_k(x):
+            return jnp.mean(ce_fused_train(x, tgt, True))
+
+        def f_r(x):
+            l = jax.scipy.special.logsumexp(x.astype(jnp.float32), -1)
+            return jnp.mean(l - x[jnp.arange(50), tgt])
+
+        np.testing.assert_allclose(np.asarray(jax.grad(f_k)(logits)),
+                                   np.asarray(jax.grad(f_r)(logits)),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_bf16_unaligned_padding(self):
+        from paddle_tpu.kernels.pallas_ce import ce_fused_train
+        logits, tgt = self._data(T=37, V=900, seed=13)
+        lb = logits.astype(jnp.bfloat16)
+
+        def f_k(x):
+            return jnp.sum(ce_fused_train(x, tgt, True)
+                           * jnp.arange(37, dtype=jnp.float32))
+
+        def f_r(x):
+            lf = x.astype(jnp.float32)
+            per = jax.scipy.special.logsumexp(lf, -1) - \
+                lf[jnp.arange(37), tgt]
+            return jnp.sum(per * jnp.arange(37, dtype=jnp.float32))
+
+        np.testing.assert_allclose(float(f_k(lb)), float(f_r(lb)),
+                                   rtol=1e-3)
+        np.testing.assert_allclose(
+            np.asarray(jax.grad(f_k)(lb)).astype(np.float32),
+            np.asarray(jax.grad(f_r)(lb)).astype(np.float32),
+            rtol=0.1, atol=0.05)
+
+    def test_registry_selects_fused_impl(self, monkeypatch):
+        """losses.fused_softmax_ce routes onto ce_fused_train ONLY when
+        the registry's 'ce' winner names 'pallas_fused'."""
+        from paddle_tpu.models import losses
+        from paddle_tpu.kernels import pallas_ce, registry
+        logits, tgt = self._data(T=24, V=600, seed=17)
+        logits3 = logits.reshape(2, 12, 600)
+        tgt3 = tgt.reshape(2, 12)
+        jax_val = float(losses.fused_softmax_ce(logits3, tgt3))
+
+        monkeypatch.setattr(losses, "_pallas_ce_enabled", lambda: True)
+        monkeypatch.setattr(registry, "winner",
+                            lambda *a, **k: "pallas_fused")
+        seen = []
+        real = pallas_ce.ce_fused_train
+
+        def spy(x, t, interpret=False):
+            seen.append("fused")
+            return real(x, t, True)
+        monkeypatch.setattr(pallas_ce, "ce_fused_train", spy)
+        fused_val = float(losses.fused_softmax_ce(logits3, tgt3))
+        assert seen == ["fused"]
+        assert abs(jax_val - fused_val) < 1e-5
+
+
+class TestPallasFusedUpdate:
+    """Fused AdamW/AMP master-update kernel (kernels/pallas_update.py)
+    vs the models.gpt.apply_adamw oracle, interpret mode."""
+
+    def _tree(self, seed=0, dtype=jnp.float32):
+        rng = np.random.RandomState(seed)
+
+        def t(*shape):
+            return jnp.asarray(rng.randn(*shape).astype(np.float32))
+        params = {"w": t(33, 257).astype(dtype), "b": t(64),
+                  "s": t(3, 5, 7)}
+        grads = {"w": t(33, 257).astype(dtype), "b": t(64),
+                 "s": t(3, 5, 7)}
+        opt = {"m": jax.tree_util.tree_map(
+                   lambda p: t(*p.shape), params),
+               "v": jax.tree_util.tree_map(
+                   lambda p: jnp.abs(t(*p.shape)), params),
+               "step": jnp.asarray(4.0, jnp.float32)}
+        return params, grads, opt
+
+    def test_parity_vs_oracle(self):
+        from paddle_tpu.models.gpt import apply_adamw
+        from paddle_tpu.kernels.pallas_update import fused_apply_adamw
+        params, grads, opt = self._tree()
+        ref_p, ref_o = apply_adamw(grads, params, opt, 1e-3)
+        got_p, got_o = fused_apply_adamw(grads, params, opt, 1e-3,
+                                         interpret=True)
+        for k in params:
+            np.testing.assert_allclose(np.asarray(got_p[k]),
+                                       np.asarray(ref_p[k]),
+                                       rtol=1e-6, atol=1e-7)
+            np.testing.assert_allclose(np.asarray(got_o["m"][k]),
+                                       np.asarray(ref_o["m"][k]),
+                                       rtol=1e-6, atol=1e-7)
+            np.testing.assert_allclose(np.asarray(got_o["v"][k]),
+                                       np.asarray(ref_o["v"][k]),
+                                       rtol=1e-6, atol=1e-7)
+        assert float(got_o["step"]) == float(ref_o["step"])
+
+    def test_parity_bf16_master_math(self):
+        """bf16 params keep f32 moments and f32 master math — the AMP
+        master-update contract."""
+        from paddle_tpu.models.gpt import apply_adamw
+        from paddle_tpu.kernels.pallas_update import fused_apply_adamw
+        params, grads, opt = self._tree(seed=3, dtype=jnp.bfloat16)
+        ref_p, ref_o = apply_adamw(grads, params, opt, 3e-4,
+                                   weight_decay=0.05)
+        got_p, got_o = fused_apply_adamw(grads, params, opt, 3e-4,
+                                         weight_decay=0.05,
+                                         interpret=True)
+        assert got_p["w"].dtype == jnp.bfloat16
+        assert got_o["m"]["w"].dtype == jnp.float32
+        for k in params:
+            np.testing.assert_allclose(
+                np.asarray(got_p[k]).astype(np.float32),
+                np.asarray(ref_p[k]).astype(np.float32),
+                rtol=1e-2, atol=1e-3)
+            np.testing.assert_allclose(np.asarray(got_o["v"][k]),
+                                       np.asarray(ref_o["v"][k]),
+                                       rtol=1e-6, atol=1e-7)
+
+    def test_off_by_default_and_kill_switch(self, monkeypatch):
+        """No registry entry -> apply_adamw stays on the jax path; the
+        targeted and global kill switches both veto a registry win."""
+        from paddle_tpu.kernels import pallas_update, registry
+        assert not pallas_update.fused_update_enabled()
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        monkeypatch.setattr(registry, "winner", lambda *a, **k: "pallas")
+        assert pallas_update.fused_update_enabled()
+        monkeypatch.setenv("PADDLE_TPU_DISABLE_PALLAS_UPDATE", "1")
+        assert not pallas_update.fused_update_enabled()
+        monkeypatch.delenv("PADDLE_TPU_DISABLE_PALLAS_UPDATE")
+        monkeypatch.setenv("PADDLE_TPU_DISABLE_PALLAS", "1")
+        assert not pallas_update.fused_update_enabled()
+
+
 class TestKillSwitchGates:
     """The kill-switch family must stay layered: global > attention-only
     > backward-only, with the CE kernel on the global gate only."""

@@ -204,18 +204,16 @@ class TestHloAudit:
             # gradient/loss reductions touch dp (alone or with fsdp)
             assert any(op == "all-reduce" and ax and "dp" in ax
                        for ax, op in by_axes)
-        # every surprise is NAMED — and the known embedding-resharding
-        # collective-permutes are among them (BASELINE.md explains)
+        # every surprise is NAMED ...
         for f in doc["findings"]:
             assert f["kind"] in ("resharding_groups",
                                  "resharding_permute",
                                  "unplanned_collective")
-        assert any(f["op"] == "collective-permute"
-                   for f in doc["findings"])
-        # planned-schedule ops never audit as findings
-        finding_keys = {(tuple(f["axes"]) if f["axes"] else None,
-                         f["op"]) for f in doc["findings"]}
-        assert (("fsdp",), "all-gather") not in finding_keys
+        # ... and since PR 16 killed the embedding-resharding
+        # collective-permutes (batch-axis-aligned embedding specs),
+        # the canonical 3D plans audit CLEAN — tools/audit_gate.py
+        # pins this per plan against perf/audit_baseline.json
+        assert doc["findings"] == []
         # compile observability published
         assert monitor.counter("train.compile.audits").value >= 1
         assert monitor.gauge("train.compile.audit_ms").value > 0
@@ -342,6 +340,188 @@ class TestTrainAttribJoin:
                                               "tp": 1}
         assert t.parse_plan_name("dp4_tp2") == {"dp": 4, "fsdp": 1,
                                                 "tp": 2}
+        assert t.parse_plan_name("dp2_tp2_pp2_mb4") == {
+            "dp": 2, "fsdp": 1, "tp": 2, "pp": 2, "microbatches": 4}
+        assert t.parse_plan_name("fsdp8_overlap") == {
+            "dp": 1, "fsdp": 8, "tp": 1, "overlap": True}
+
+
+# --------------------------------------------------------------------------
+# train_attrib --compare: the before/after delta table on recorded
+# fixtures (the overlap campaign's evidence format)
+# --------------------------------------------------------------------------
+class TestTrainAttribCompare:
+    @staticmethod
+    def _row(plan, ms, mfu, shares, findings=0):
+        return {
+            "plan": plan, "steps": 8,
+            "measured_ms_per_step_p50": ms,
+            "roofline_ms_per_step": 1.0,
+            "achieved_mfu": mfu,
+            "phases": {p: {"share": s, "bound": "ici",
+                           "flops": 0, "bytes": 100}
+                       for p, s in shares.items()},
+            "audit": {"counts": {}, "compile_ms": 1.0,
+                      "findings": [
+                          {"kind": "resharding-all-gather", "op": "ag",
+                           "axes": ["fsdp"], "count": 1, "bytes": 8}
+                      ] * findings},
+        }
+
+    def _fixtures(self, tmp_path):
+        import json
+        before = [
+            self._row("dp2_fsdp2_tp2", 40.0, 0.20,
+                      {"fwd_matmul": 0.4, "coll_fsdp": 0.35,
+                       "coll_tp": 0.25}, findings=2),
+            self._row("fsdp8", 30.0, 0.22,
+                      {"fwd_matmul": 0.5, "coll_fsdp": 0.5}),
+        ]
+        after = [
+            self._row("dp2_fsdp2_tp2", 31.0, 0.31,
+                      {"fwd_matmul": 0.55, "coll_fsdp": 0.15,
+                       "coll_tp": 0.30}),
+            self._row("fsdp8", 24.0, 0.29,
+                      {"fwd_matmul": 0.7, "coll_fsdp": 0.3}),
+        ]
+        # before: a main() stdout doc; after: a telemetry stream with
+        # embedded rows — load_rows must read both shapes
+        bpath, apath = tmp_path / "before.jsonl", tmp_path / "after.jsonl"
+        bpath.write_text(json.dumps(
+            {"metric": "train_roofline_attribution",
+             "backend": "cpu", "plans": before}) + "\n")
+        with open(apath, "w") as f:
+            f.write(json.dumps({"kind": "telemetry",
+                                "step_ms": 24.0}) + "\n")
+            for r in after:
+                f.write(json.dumps({"kind": "train_attrib", **r}) + "\n")
+            f.write("not json\n")
+        return str(bpath), str(apath)
+
+    def test_load_rows_reads_both_formats(self, tmp_path):
+        t = __import__("train_attrib")
+        bpath, apath = self._fixtures(tmp_path)
+        assert [r["plan"] for r in t.load_rows(bpath)] == [
+            "dp2_fsdp2_tp2", "fsdp8"]
+        assert [r["plan"] for r in t.load_rows(apath)] == [
+            "dp2_fsdp2_tp2", "fsdp8"]
+
+    def test_compare_rows_deltas(self, tmp_path):
+        t = __import__("train_attrib")
+        bpath, apath = self._fixtures(tmp_path)
+        cmp_rows = t.compare_rows(t.load_rows(bpath),
+                                  t.load_rows(apath))
+        assert [r["plan"] for r in cmp_rows] == ["dp2_fsdp2_tp2",
+                                                 "fsdp8"]
+        r = cmp_rows[0]
+        assert r["measured_ms_delta"] == pytest.approx(-9.0)
+        assert r["achieved_mfu_delta"] == pytest.approx(0.11)
+        assert r["findings_before"] == 2 and r["findings_after"] == 0
+        # the ISSUE acceptance check: coll_fsdp share strictly down on
+        # both canonical plans with overlap on
+        for row in cmp_rows:
+            assert row["phase_share_delta"]["coll_fsdp"] < 0, row
+
+    def test_compare_skips_unmatched_plans(self, tmp_path):
+        t = __import__("train_attrib")
+        bpath, apath = self._fixtures(tmp_path)
+        after = t.load_rows(apath)
+        after.append(self._row("dp8", 9.0, 0.5, {"fwd_matmul": 1.0}))
+        cmp_rows = t.compare_rows(t.load_rows(bpath), after)
+        assert [r["plan"] for r in cmp_rows] == ["dp2_fsdp2_tp2",
+                                                 "fsdp8"]
+
+    def test_render_compare_table(self, tmp_path):
+        t = __import__("train_attrib")
+        bpath, apath = self._fixtures(tmp_path)
+        out = t.render_compare(t.compare_rows(t.load_rows(bpath),
+                                              t.load_rows(apath)))
+        assert "dp2_fsdp2_tp2" in out and "fsdp8" in out
+        assert "coll_fsdp-20%" in out       # the hidden collective leg
+        assert "+11.00%" in out             # the MFU delta
+
+    def test_cli_compare_prints_doc_and_table(self, tmp_path, capsys,
+                                              monkeypatch):
+        import json
+        t = __import__("train_attrib")
+        bpath, apath = self._fixtures(tmp_path)
+        monkeypatch.setattr(sys, "argv",
+                            ["train_attrib.py", "--compare", bpath,
+                             apath])
+        assert t.main() == 0
+        lines = capsys.readouterr().out.splitlines()
+        doc = json.loads(lines[0])
+        assert doc["metric"] == "train_attrib_compare"
+        assert [r["plan"] for r in doc["plans"]] == [
+            "dp2_fsdp2_tp2", "fsdp8"]
+        assert any("coll_fsdp" in ln for ln in lines[1:])
+
+
+# --------------------------------------------------------------------------
+# tools/audit_gate.py: the no-new-resharding regression gate
+# --------------------------------------------------------------------------
+class TestAuditGate:
+    def test_finding_counts_aggregates_by_kind(self):
+        g = __import__("audit_gate")
+        audit = {"findings": [
+            {"kind": "resharding_permute", "count": 2},
+            {"kind": "resharding_permute", "count": 1},
+            {"kind": "resharding_groups", "count": 4},
+        ]}
+        assert g.finding_counts(audit) == {"resharding_permute": 3,
+                                           "resharding_groups": 4}
+        assert g.finding_counts({"findings": []}) == {}
+
+    def test_diff_counts_flags_new_and_grown_only(self):
+        g = __import__("audit_gate")
+        base = {"resharding_permute": 2}
+        assert g.diff_counts(base, {"resharding_permute": 2}) == []
+        assert g.diff_counts(base, {"resharding_permute": 1}) == []
+        assert g.diff_counts(base, {"resharding_permute": 3}) == [
+            ("resharding_permute", 2, 3)]
+        assert g.diff_counts(base, {"unplanned_collective": 1}) == [
+            ("unplanned_collective", 0, 1)]
+
+    def test_gate_round_trip_on_stub_audits(self, tmp_path,
+                                            monkeypatch, capsys):
+        import json
+        g = __import__("audit_gate")
+        audits = {"fsdp8": {"findings": []},
+                  "dp2_fsdp2_tp2": {"findings": [
+                      {"kind": "resharding_permute", "count": 1}]}}
+        monkeypatch.setattr(g, "audit_plan", lambda n: audits[n])
+        path = str(tmp_path / "audit_baseline.json")
+        plans = ["fsdp8", "dp2_fsdp2_tp2"]
+        assert g.gate(plans, path, write=True) == 0
+        doc = json.load(open(path))
+        assert doc["plans"]["fsdp8"]["findings"] == 0
+        assert doc["plans"]["dp2_fsdp2_tp2"]["kinds"] == {
+            "resharding_permute": 1}
+        # unchanged state: green
+        assert g.gate(plans, path) == 0
+        # a NEW kind on a clean plan: red, and the regression is named
+        audits["fsdp8"] = {"findings": [
+            {"kind": "resharding_groups", "count": 2}]}
+        assert g.gate(plans, path) == 1
+        assert "REGRESSION fsdp8: resharding_groups 0 -> 2" in \
+            capsys.readouterr().out
+        # a FIXED plan: green with the --write-baseline nudge
+        audits["fsdp8"] = {"findings": []}
+        audits["dp2_fsdp2_tp2"] = {"findings": []}
+        assert g.gate(plans, path) == 0
+        assert "--write-baseline" in capsys.readouterr().out
+
+    def test_repo_baseline_is_all_zero(self):
+        """PR 16's contract: the canonical plans audit CLEAN, and the
+        checked-in baseline says so (a nonzero entry means someone
+        banked a regression instead of fixing it)."""
+        import json
+        g = __import__("audit_gate")
+        doc = json.load(open(g.BASELINE_PATH))
+        assert set(doc["plans"]) == set(g.CANONICAL_PLANS)
+        for name, entry in doc["plans"].items():
+            assert entry["findings"] == 0, name
+            assert entry["kinds"] == {}, name
 
 
 # --------------------------------------------------------------------------

@@ -320,7 +320,8 @@ def _step_flops(cfg, params, batch, seq):
                                  cfg.hidden_size, seq) * batch * seq
 
 
-def _plan3d_variant(row_name, cfg_kw, donate=True, batch=8, seq=1024):
+def _plan3d_variant(row_name, cfg_kw, donate=True, batch=8, seq=1024,
+                    overlap=False):
     """One sharded-step ablation row: plan the 3D dp×fsdp×tp assignment
     for THIS backend's device count (on one TPU chip the plan degrades
     to dp1 — the row then isolates the pin/donate overhead itself),
@@ -334,7 +335,7 @@ def _plan3d_variant(row_name, cfg_kw, donate=True, batch=8, seq=1024):
     from paddle_tpu.parallel.planner import plan_train
     n = len(jax.devices())
     cfg, params, opt, toks = build(cfg_kw, batch=batch, seq=seq)
-    plan = plan_train(cfg, n, batch)
+    plan = plan_train(cfg, n, batch, overlap=overlap)
     mesh = plan.build_mesh()
     step = make_train_step(train_step, cfg=cfg, lr=1e-4, donate=donate,
                            mesh=mesh, plan=plan)
@@ -353,7 +354,8 @@ def _plan3d_variant(row_name, cfg_kw, donate=True, batch=8, seq=1024):
         "knobs": {"plan": plan.name, "donate": donate,
                   "remat": cfg.remat,
                   "remat_policy": cfg.remat_policy if cfg.remat
-                  else "none", "n_devices": n},
+                  else "none", "n_devices": n,
+                  "overlap": bool(getattr(plan, "overlap", False))},
         "traces": step.trace_count,
     })
 
@@ -382,6 +384,40 @@ def v_plan3d_nodonate():
     os.environ["PADDLE_TPU_DISABLE_PALLAS_BWD"] = "1"
     _plan3d_variant("plan3d_dots_nodonate_b8",
                     dict(remat=True, remat_policy="dots"), donate=False)
+
+
+def v_plan3d_overlap():
+    """Overlap A/B (ISSUE 16): the plan3d_dots grid with the latency-
+    hiding collective schedule on (plan.overlap -> the XLA async-
+    collective/collective-matmul compiler options on TPU meshes; a
+    no-op attachment on CPU, where the row pins parity + trace count).
+    Run `plan3d plan3d_overlap` together — the delta IS the hidden
+    coll_fsdp time."""
+    os.environ["PADDLE_TPU_DISABLE_PALLAS_BWD"] = "1"
+    _plan3d_variant("plan3d_overlap_b8",
+                    dict(remat=True, remat_policy="dots"), overlap=True)
+
+
+def v_fused_step():
+    """Fused-kernel A/B (ISSUE 16): the plan3d_dots grid with BOTH
+    fused Pallas step kernels forced on — one-pass CE+grad
+    (kernels/pallas_ce.ce_fused_train) and the fused AdamW master
+    update (kernels/pallas_update.fused_apply_adamw) — by pointing the
+    registry resolution at them in-process (the shipped default stays
+    off; tools/bench_fused_step.py --adopt is the only writer). On a
+    non-TPU backend the kill-switch gates keep the oracles, so the row
+    is only meaningful on the chip."""
+    from paddle_tpu.kernels import registry as reg
+    forced = {"ce": "pallas_fused", "fused_update": "pallas"}
+    orig = reg.winner
+    reg.winner = (lambda kernel, backend=None, bucket="*", path=None:
+                  forced.get(kernel) or orig(kernel, backend=backend,
+                                             bucket=bucket, path=path))
+    try:
+        _plan3d_variant("plan3d_fusedkernels_b8",
+                        dict(remat=True, remat_policy="dots"))
+    finally:
+        reg.winner = orig
 
 
 def v_train_attrib():
@@ -467,6 +503,10 @@ VARIANTS = {
     "plan3d_full": v_plan3d_full,
     "plan3d_noremat": v_plan3d_noremat,
     "plan3d_nodonate": v_plan3d_nodonate,
+    # ISSUE 16 A/B rows: latency-hiding collectives and the fused step
+    # kernels over the same plan3d_dots grid
+    "plan3d_overlap": v_plan3d_overlap,
+    "fused_step": v_fused_step,
     # per-phase roofline attribution + collective audit over the
     # planned step (ISSUE 12) — the evidence row every future MFU
     # optimization PR ships with

@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""HLO-audit regression gate: NO NEW RESHARDING, EVER.
+
+PR 16 drove the canonical training plans to ZERO involuntary-resharding
+findings (profiler/hlo_audit.py); this gate keeps them there. It
+re-audits the canonical plans on the 8-virtual-device CPU mesh and
+diffs the per-plan finding-kind counts against the stored baseline
+(perf/audit_baseline.json):
+
+- a finding KIND the baseline does not list for that plan  -> FAIL
+- a listed kind whose count GREW                           -> FAIL
+- fewer findings than baseline                             -> pass
+  (with a note to --write-baseline and bank the win)
+
+ONE exit code. Wired into `tools/chaos_drill.py --gate` (the pre-commit
+robustness gate), so a refactor that re-introduces a GSPMD layout move
+is caught before it lands, the same way diff_failures.py pins the
+tier-1 failure set.
+
+Usage:
+  python tools/audit_gate.py                   # gate vs stored baseline
+  python tools/audit_gate.py --write-baseline  # re-pin after a win
+  python tools/audit_gate.py --plans fsdp8 --json
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+TOOLS = os.path.dirname(os.path.abspath(__file__))
+if TOOLS not in sys.path:
+    sys.path.insert(0, TOOLS)
+
+BASELINE_PATH = os.path.join(REPO, "perf", "audit_baseline.json")
+# the canonical plan set: the two 3D acceptance plans of the MFU
+# campaign plus the pipelined hybrid (BASELINE.md §MFU campaign)
+CANONICAL_PLANS = ("dp2_fsdp2_tp2", "fsdp8", "dp2_tp2_pp2_mb4")
+
+
+def finding_counts(audit: dict) -> dict:
+    """{kind: count} over an audit_train_step result (or any dict
+    carrying a findings list)."""
+    counts = {}
+    for f in audit.get("findings", []):
+        k = f.get("kind", "unknown")
+        counts[k] = counts.get(k, 0) + int(f.get("count", 1))
+    return counts
+
+
+def diff_counts(baseline: dict, observed: dict) -> list:
+    """Regressions of one plan's observed {kind: count} vs its baseline
+    {kind: count}: [(kind, base_count, seen_count), ...]. New kinds and
+    grown counts regress; shrunk counts do not."""
+    out = []
+    for kind, seen in sorted(observed.items()):
+        base = int(baseline.get(kind, 0))
+        if seen > base:
+            out.append((kind, base, seen))
+    return out
+
+
+def audit_plan(name: str):
+    """Audit ONE canonical plan on the small observability config —
+    the same cfg/batch/seq train_attrib measures, so the baseline and
+    the attrib evidence describe the same lowering."""
+    import train_attrib
+
+    from paddle_tpu.models.gpt import PARAM_SPECS
+    from paddle_tpu.parallel.planner import plan_train
+    from paddle_tpu.profiler import hlo_audit
+
+    class _Args:
+        vocab, hidden, layers, seq = 512, 128, 2, 32
+
+    cfg = train_attrib.build_cfg(_Args)
+    deg = train_attrib.parse_plan_name(name)
+    n_devices = deg["dp"] * deg["fsdp"] * deg["tp"] * deg.get("pp", 1)
+    plan = plan_train(cfg, n_devices, 8, param_specs=PARAM_SPECS, **deg)
+    return hlo_audit.audit_train_step(cfg, plan, 8, seq=_Args.seq)
+
+
+def gate(plans, baseline_path: str, write: bool = False,
+         as_json: bool = False) -> int:
+    stored = {}
+    if os.path.exists(baseline_path):
+        with open(baseline_path) as f:
+            stored = json.load(f)
+    base_plans = stored.get("plans", {})
+    observed, regressions, shrunk = {}, [], []
+    for name in plans:
+        counts = finding_counts(audit_plan(name))
+        observed[name] = counts
+        base = base_plans.get(name, {}).get("kinds", {})
+        for kind, b, s in diff_counts(base, counts):
+            regressions.append((name, kind, b, s))
+        if sum(counts.values()) < sum(int(v) for v in base.values()):
+            shrunk.append(name)
+    if write:
+        doc = {
+            "comment": "HLO-audit finding baseline per canonical plan "
+                       "(tools/audit_gate.py --write-baseline). The "
+                       "gate fails on any NEW kind or grown count.",
+            "plans": {n: {"findings": sum(c.values()), "kinds": c}
+                      for n, c in observed.items()},
+        }
+        with open(baseline_path, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"[audit-gate] baseline written: {baseline_path}",
+              flush=True)
+        return 0
+    if as_json:
+        print(json.dumps({"metric": "hlo_audit_gate",
+                          "observed": observed,
+                          "regressions": [
+                              {"plan": p, "kind": k, "baseline": b,
+                               "seen": s}
+                              for p, k, b, s in regressions]}),
+              flush=True)
+    for p, k, b, s in regressions:
+        print(f"[audit-gate] REGRESSION {p}: {k} {b} -> {s}",
+              flush=True)
+    if regressions:
+        print("[audit-gate] HLO AUDIT GATE RED "
+              f"({len(regressions)} regressed kind(s))", flush=True)
+        return 1
+    for p in shrunk:
+        print(f"[audit-gate] {p}: fewer findings than baseline — "
+              "bank it with --write-baseline", flush=True)
+    total = sum(sum(c.values()) for c in observed.values())
+    print(f"[audit-gate] GREEN: {len(observed)} plan(s), "
+          f"{total} finding(s), no new kinds", flush=True)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0])
+    ap.add_argument("--plans", default=",".join(CANONICAL_PLANS),
+                    help="comma-separated plan names to audit")
+    ap.add_argument("--baseline", default=BASELINE_PATH)
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="re-pin the stored baseline from this run")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.device import pin_cpu
+    if not pin_cpu(8):
+        print("[audit-gate] could not pin the 8-device CPU platform",
+              flush=True)
+        return 2
+    plans = [p for p in args.plans.split(",") if p]
+    return gate(plans, args.baseline, write=args.write_baseline,
+                as_json=args.json)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

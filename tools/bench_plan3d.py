@@ -56,10 +56,32 @@ LEGS = {
                                max_seq_len=128, remat=False,
                                dtype="float32"), 8, 64, 3, 600,
                 dict(dp=2, fsdp=1, tp=2, pp=2, microbatches=4)),
+    # overlap A/B legs (ISSUE 16): the SAME grids with the latency-
+    # hiding collective schedule on (plan_train(..., overlap=True) —
+    # double-buffered ZeRO-3 gather on pp plans, XLA async-collective/
+    # collective-matmul flags on the GSPMD path; TPU-only there, so the
+    # cpu8 A/B pins parity + trace count while the tpu A/B measures)
+    "cpu8_overlap": (False, 8,
+                     dict(vocab_size=512, hidden_size=128, num_layers=2,
+                          num_heads=4, max_seq_len=128, remat=False,
+                          dtype="float32"), 8, 64, 3, 600,
+                     dict(dp=2, fsdp=2, tp=2, overlap=True)),
+    "cpu8_pp_overlap": (False, 8,
+                        dict(vocab_size=512, hidden_size=128,
+                             num_layers=2, num_heads=4, max_seq_len=128,
+                             remat=False, dtype="float32"), 8, 64, 3,
+                        600, dict(dp=2, fsdp=1, tp=2, pp=2,
+                                  microbatches=4, overlap=True)),
     "tpu": (True, 0, dict(vocab_size=32768, hidden_size=1024,
                           num_layers=24, num_heads=16, max_seq_len=1024,
                           remat=True, remat_policy="dots",
                           dtype="bfloat16"), 8, 1024, 10, 2100, None),
+    "tpu_overlap": (True, 0, dict(vocab_size=32768, hidden_size=1024,
+                                  num_layers=24, num_heads=16,
+                                  max_seq_len=1024, remat=True,
+                                  remat_policy="dots",
+                                  dtype="bfloat16"), 8, 1024, 10, 2100,
+                    dict(overlap=True)),
 }
 
 
@@ -136,6 +158,7 @@ def run_leg(name: str) -> None:
         "mfu": round(mfu, 4),
         "traces_after_warmup": step.trace_count,
         "batch": batch, "seq": seq,
+        "overlap": bool(getattr(plan, "overlap", False)),
     }
     if plan.pp > 1:
         rec["microbatches"] = plan.microbatches
@@ -144,16 +167,26 @@ def run_leg(name: str) -> None:
     print(json.dumps(rec), flush=True)
 
 
-def orchestrate(want_tpu: bool, want_pp: bool = False) -> int:
+def orchestrate(want_tpu: bool, want_pp: bool = False,
+                want_overlap: bool = False) -> int:
     """Run the legs in subprocesses; print ONE MULTICHIP-format JSON
     line per leg ({"n_devices", "rc", "ok", "skipped", "tail"} + the
     measured record when the leg produced one)."""
-    legs = (["cpu8"] + (["cpu8_pp"] if want_pp else [])
-            + (["tpu"] if want_tpu else []))
+    legs = ["cpu8"]
+    if want_overlap:
+        legs.append("cpu8_overlap")
+    if want_pp:
+        legs.append("cpu8_pp")
+        if want_overlap:
+            legs.append("cpu8_pp_overlap")
+    if want_tpu:
+        legs.append("tpu")
+        if want_overlap:
+            legs.append("tpu_overlap")
     worst = 0
     for name in legs:
         _wt, n_dev, _kw, _b, _s, _i, timeout_s, _deg = LEGS[name]
-        if name == "tpu":
+        if name.startswith("tpu"):
             from bench import _probe_tpu
             if not _probe_tpu(HERE):
                 log("tunnel dead; TPU leg skipped")
@@ -199,13 +232,16 @@ def main() -> int:
     ap.add_argument("--pp", action="store_true",
                     help="also run the cpu8_pp 4D (dp2×tp2×pp2) leg "
                          "(tpu_campaign --plan4d)")
+    ap.add_argument("--overlap", action="store_true",
+                    help="also run the overlap A/B legs (same grids, "
+                         "latency-hiding collective schedule on)")
     ap.add_argument("--run", default=None, choices=sorted(LEGS),
                     help="run ONE leg in-process (orchestrator internal)")
     args = ap.parse_args()
     if args.run:
         run_leg(args.run)
         return 0
-    return orchestrate(args.tpu, args.pp)
+    return orchestrate(args.tpu, args.pp, args.overlap)
 
 
 if __name__ == "__main__":

@@ -640,7 +640,9 @@ def run_elastic_drill(steps: int = 10, keep_logs: bool = False) -> int:
 def gate_main(steps: int, elastic_steps: int, tier1_log: str,
               keep_logs: bool = False) -> int:
     """The pre-commit robustness gate (CLAUDE.md testing section): ONE
-    exit code = quick drill green AND elastic drill green AND
+    exit code = quick drill green AND elastic drill green AND the
+    HLO-audit regression gate green (tools/audit_gate.py vs
+    perf/audit_baseline.json — no new resharding) AND
     tools/diff_failures.py clean against the stored tier-1 baseline
     (skipped with a note when no tier-1 log exists yet)."""
     rc = run_drill(steps, full=False, keep_logs=keep_logs)
@@ -651,6 +653,13 @@ def gate_main(steps: int, elastic_steps: int, tier1_log: str,
     if rc != 0:
         print("[gate] elastic drill FAILED", flush=True)
         return rc
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "audit_gate.py")],
+        cwd=REPO)
+    if res.returncode != 0:
+        print("[gate] HLO audit gate FAILED (new resharding findings "
+              "vs perf/audit_baseline.json)", flush=True)
+        return res.returncode
     if tier1_log and os.path.exists(tier1_log):
         res = subprocess.run(
             [sys.executable,

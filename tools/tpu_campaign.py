@@ -94,6 +94,25 @@ JOBS = [
       "--plans", "dp1_fsdp1_tp1", "--hidden", "1024", "--layers", "24",
       "--vocab", "32768", "--seq", "1024", "--batch", "8",
       "--steps", "10", "--every", "3"], 2700, {}),
+    # ISSUE 16 rungs for the next tunnel window:
+    # (1) the latency-hiding-collectives A/B — bench_plan3d's overlap
+    # legs (plan.overlap -> XLA async-collective/collective-matmul
+    # options on the TPU mesh) next to the baseline legs, plus the
+    # ablate rows whose plan3d vs plan3d_overlap delta IS the hidden
+    # coll_fsdp time
+    ("plan3d_overlap",
+     [sys.executable, "tools/bench_plan3d.py", "--tpu", "--overlap"],
+     4200, {}),
+    ("ablate_overlap",
+     [sys.executable, "tools/ablate_step.py", "plan3d",
+      "plan3d_overlap", "fused_step"], 3600, {}),
+    # (2) the fused step kernels (one-pass CE+grad, fused AdamW) —
+    # micro A/B in kernel-registry evidence format; --adopt is the ONE
+    # evidence-gated writer and refuses on parity miss, <1.03x speedup,
+    # or an implausible timing (registry.gate_ms)
+    ("fused_step",
+     [sys.executable, "tools/bench_fused_step.py", "--tpu", "--adopt"],
+     2700, {}),
 ]
 
 

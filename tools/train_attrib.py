@@ -62,10 +62,19 @@ def _log(msg):
 
 
 def parse_plan_name(name: str) -> dict:
-    """'dp2_fsdp2_tp2' / 'dp4_tp2' / 'fsdp8' -> explicit degrees."""
+    """'dp2_fsdp2_tp2' / 'dp4_tp2' / 'fsdp8' -> explicit degrees.
+    'pp'/'mb' tokens select the pipelined step ('dp2_tp2_pp2_mb4');
+    an 'overlap' token turns on the latency-hiding collective schedule
+    (docs/parallel_training.md §Collective overlap)."""
     deg = {"dp": 1, "fsdp": 1, "tp": 1}
-    for axis, n in re.findall(r"(dp|fsdp|tp)(\d+)", name):
+    for axis, n in re.findall(r"(dp|fsdp|tp|pp|mb)(\d+)", name):
         deg[axis] = int(n)
+    if deg.pop("mb", None):
+        deg["microbatches"] = int(re.search(r"mb(\d+)", name).group(1))
+    if deg.get("pp", 1) == 1:
+        deg.pop("pp", None)
+    if "overlap" in name:
+        deg["overlap"] = True
     return deg
 
 
@@ -134,7 +143,10 @@ def measure_plan(name, cfg, args, peak_flops, hbm_bw, ici_bw):
     from telemetry_report import summarize
 
     deg = parse_plan_name(name)
-    n_devices = deg["dp"] * deg["fsdp"] * deg["tp"]
+    if getattr(args, "overlap", False):
+        deg["overlap"] = True
+    n_devices = (deg["dp"] * deg["fsdp"] * deg["tp"]
+                 * deg.get("pp", 1))
     plan = plan_train(cfg, n_devices, args.batch, **deg)
     mesh = plan.build_mesh()
     ledger = train_step_ledger(cfg, plan=plan, global_batch=args.batch,
@@ -215,6 +227,95 @@ def render_table(rows) -> str:
     return "\n".join(lines)
 
 
+def load_rows(path) -> list:
+    """All train_attrib rows a JSONL file carries — either the main()
+    stdout doc ({"metric": "train_roofline_attribution", "plans": [..]})
+    or a telemetry stream with embedded {"kind": "train_attrib"}
+    records (measure_plan appends one per run)."""
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                d = json.loads(line)
+            except ValueError:
+                continue
+            if d.get("metric") == "train_roofline_attribution":
+                rows.extend(d.get("plans") or [])
+            elif d.get("kind") == "train_attrib":
+                rows.append(d)
+    return rows
+
+
+def compare_rows(before: list, after: list) -> list:
+    """Join two row sets by plan name into delta rows: measured
+    ms/step, achieved MFU, and per-phase roofline share deltas
+    (after − before). The before/after evidence format for the overlap
+    and fused-kernel campaigns (BASELINE.md §MFU campaign)."""
+    out = []
+    bmap = {r.get("plan"): r for r in before}
+    for a in after:
+        b = bmap.get(a.get("plan"))
+        if b is None:
+            continue
+
+        def _d(key):
+            av, bv = a.get(key), b.get(key)
+            return (round(av - bv, 6)
+                    if av is not None and bv is not None else None)
+        phases = sorted(set(b.get("phases") or {})
+                        | set(a.get("phases") or {}))
+        share = {
+            p: round(((a.get("phases") or {}).get(p) or {})
+                     .get("share", 0.0)
+                     - ((b.get("phases") or {}).get(p) or {})
+                     .get("share", 0.0), 6)
+            for p in phases}
+        out.append({
+            "plan": a.get("plan"),
+            "measured_ms_before": b.get("measured_ms_per_step_p50"),
+            "measured_ms_after": a.get("measured_ms_per_step_p50"),
+            "measured_ms_delta": _d("measured_ms_per_step_p50"),
+            "achieved_mfu_before": (b.get("achieved_mfu")),
+            "achieved_mfu_after": (a.get("achieved_mfu")),
+            "achieved_mfu_delta": _d("achieved_mfu"),
+            "findings_before": len((b.get("audit") or {})
+                                   .get("findings", [])),
+            "findings_after": len((a.get("audit") or {})
+                                  .get("findings", [])),
+            "phase_share_delta": share,
+        })
+    return out
+
+
+def render_compare(cmp_rows) -> str:
+    """The human-readable before/after delta table."""
+    lines = []
+    hdr = (f"{'plan':<18} {'ms b':>9} {'ms a':>9} {'Δms':>8} "
+           f"{'MFU b':>7} {'MFU a':>7} {'ΔMFU':>7}  "
+           f"phase-share deltas (|Δ| >= 1%)")
+    lines.append(hdr)
+    lines.append("-" * len(hdr))
+
+    def fm(v, spec, dash="      --"):
+        return format(v, spec) if v is not None else dash
+    for r in cmp_rows:
+        shares = "  ".join(
+            f"{p}{d:+.0%}" for p, d in sorted(
+                r["phase_share_delta"].items(), key=lambda kv: kv[1])
+            if abs(d) >= 0.01)
+        lines.append(
+            f"{r['plan']:<18} {fm(r['measured_ms_before'], '>9.3f')} "
+            f"{fm(r['measured_ms_after'], '>9.3f')} "
+            f"{fm(r['measured_ms_delta'], '>+8.3f')} "
+            f"{fm(r['achieved_mfu_before'], '>7.2%')} "
+            f"{fm(r['achieved_mfu_after'], '>7.2%')} "
+            f"{fm(r['achieved_mfu_delta'], '>+7.2%')}  {shares}")
+    return "\n".join(lines)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--plans", default="dp2_fsdp2_tp2,fsdp8",
@@ -242,7 +343,26 @@ def main() -> int:
     ap.add_argument("--hbm-bw", type=float, default=None)
     ap.add_argument("--ici-bw", type=float, default=None)
     ap.add_argument("--pretty", action="store_true")
+    ap.add_argument("--overlap", action="store_true",
+                    help="plan every --plans entry with the "
+                         "latency-hiding collective overlap knob on")
+    ap.add_argument("--compare", nargs=2, metavar=("BEFORE", "AFTER"),
+                    default=None,
+                    help="diff two recorded train_attrib JSONLs "
+                         "(stdout docs or telemetry streams) instead "
+                         "of running; prints per-plan ms/MFU/"
+                         "phase-share deltas")
     args = ap.parse_args()
+
+    if args.compare:
+        cmp_rows = compare_rows(load_rows(args.compare[0]),
+                                load_rows(args.compare[1]))
+        print(json.dumps({"metric": "train_attrib_compare",
+                          "before": args.compare[0],
+                          "after": args.compare[1],
+                          "plans": cmp_rows}), flush=True)
+        print(render_compare(cmp_rows), flush=True)
+        return 0
 
     cfg = build_cfg(args)
     names = [n for n in args.plans.split(",") if n]
