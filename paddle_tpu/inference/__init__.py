@@ -26,7 +26,8 @@ __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
            "ServingEngine", "Request", "create_serving_engine",
            "family_for", "BackpressureError", "PoolExhaustedError",
            "ServingFaultError", "TERMINAL_REASONS",
-           "EngineRouter", "RouterRequest", "create_router"]
+           "EngineRouter", "RouterRequest", "create_router",
+           "AutoscaleConfig", "Autoscaler", "EnginePreemptGuard"]
 
 
 class PrecisionType:
@@ -255,7 +256,11 @@ from .serving import (ServingEngine, Request,          # noqa: E402,F401
                       create_serving_engine, family_for,
                       BackpressureError, PoolExhaustedError,
                       ServingFaultError, TERMINAL_REASONS)
-# the replicated-engine router (least-loaded admission, replica-death
-# requeue) — horizontal traffic scaling over N engine replicas
+# the replicated-engine router (least-loaded admission, live migration,
+# replica-death requeue) — horizontal traffic scaling over N replicas
 from .router import (EngineRouter, RouterRequest,      # noqa: E402,F401
                      create_router)
+# the serving control loop: SLO/occupancy-driven replica autoscaling
+# and tp-preemption tolerance over the router/engine seams above
+from .autoscale import (AutoscaleConfig, Autoscaler,   # noqa: E402,F401
+                        EnginePreemptGuard)

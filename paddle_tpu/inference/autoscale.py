@@ -1,0 +1,276 @@
+"""SLO-driven autoscaling + preemption tolerance for the serving fleet.
+
+Reference analog: the elastic fleet manager
+(/root/reference/python/paddle/distributed/fleet/elastic/manager.py:124
+— etcd leases per worker, watch-for-expiry, scale-out/scale-in
+protocol) applied to SERVING: where the reference restarts training
+worlds, this module closes the serving control loop ROADMAP item 5
+names ("serving-oriented runtime features... heavy traffic from
+millions of users"). Two controllers, composable:
+
+- **Autoscaler**: a host-side control loop over `EngineRouter`
+  (inference/router.py). Each `tick()` reads ONE occupancy signal —
+  (router-queued + in-flight demand) / (dispatchable replicas x
+  slots) — plus, when given, the PR-11 `BurnRateMonitor`'s short-
+  window burn rate, and drives `spawn_replica` / `drain_replica`
+  with the classic control-loop guards: hysteresis (separate
+  scale-out/scale-in thresholds with a dead band between), streak
+  requirements (`breach_ticks` consecutive breaches before scaling
+  out, `idle_ticks` consecutive idles before scaling in), a wall
+  cooldown between actions, and hard `min_replicas`/`max_replicas`
+  bounds. The clock is injectable, so tests drive whole
+  flood->scale-out->idle->scale-in trajectories deterministically.
+  Scale-in is GRACEFUL: the drained replica migrates its live
+  requests out (zero re-prefill) and the router releases it at the
+  first empty tick — no request is ever dropped by a scale decision.
+
+- **EnginePreemptGuard**: the PR-13 lease/watchdog detection
+  (parallel/elastic.py `DeviceLeases`) applied to ONE tp-sharded
+  ServingEngine's mesh. `poll()` pulses the leases, consults the
+  fault hook (`testing/faults.py` ``replica_preempt@T:R`` — R = the
+  number of devices to wedge here; the SAME kind names a replica
+  index when aimed at the router hook), and on staleness degrades tp
+  via `plan_serving_tp`'s shape-aware pricing, rebuilds the engine on
+  the surviving mesh (`ServingEngine.rebuild_on_mesh` — sharded-birth
+  discipline, live streams migrate through host snapshots in place),
+  and resets the leases to the survivors. One pull per tick, the
+  trace-count ceilings, and exactly-once terminal resolution all hold
+  through the transition (tests/test_autoscale.py asserts each).
+
+Observables: `serving.autoscale.{scale_out,scale_in}` counters +
+`serving.autoscale.replicas_target` gauge here (the router adds
+`migrations`/`migrate_fallbacks`/`migrated_pages_bytes`), a
+flight-recorder dump on every scale/preempt decision, and a
+telemetry_report "autoscale" block. docs/serving.md "Autoscaling &
+live migration" is the operator story.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..profiler import monitor
+
+__all__ = ["AutoscaleConfig", "Autoscaler", "EnginePreemptGuard"]
+
+# testing/faults.py installs a callable here: consulted once per
+# EnginePreemptGuard.poll as _FAULT_HOOK(tick) -> dict, e.g.
+# {"replica_preempt": n_devices} (wedge the LAST n device leases —
+# detection still runs the real staleness rule). None in production.
+_FAULT_HOOK = None
+
+
+@dataclasses.dataclass
+class AutoscaleConfig:
+    """Control-loop knobs. Occupancy is demand/capacity: (router
+    pending + per-replica in-flight) / (dispatchable replicas x
+    num_slots) — >= 1.0 means requests are queueing somewhere."""
+    min_replicas: int = 1
+    max_replicas: int = 4
+    scale_out_occupancy: float = 0.95    # breach at/above this...
+    scale_in_occupancy: float = 0.25     # ...idle at/below this
+    breach_ticks: int = 3                # consecutive breaches -> out
+    idle_ticks: int = 8                  # consecutive idles -> in
+    cooldown_s: float = 5.0              # min wall gap between actions
+    burn_threshold: float = 1.0          # SLO short-window burn -> breach
+
+    def __post_init__(self):
+        if not 1 <= self.min_replicas <= self.max_replicas:
+            raise ValueError(
+                f"need 1 <= min_replicas ({self.min_replicas}) <= "
+                f"max_replicas ({self.max_replicas})")
+        if self.scale_in_occupancy >= self.scale_out_occupancy:
+            raise ValueError(
+                "hysteresis requires scale_in_occupancy "
+                f"({self.scale_in_occupancy}) < scale_out_occupancy "
+                f"({self.scale_out_occupancy})")
+
+
+class Autoscaler:
+    """SLO/occupancy-driven replica-count controller over an
+    EngineRouter.
+
+    >>> scaler = Autoscaler(router, spawn=make_engine)
+    >>> while router.has_work():
+    ...     router.step()
+    ...     scaler.tick()
+
+    `spawn` is a zero-arg factory returning a warm ServingEngine
+    sharing the fleet's params/config (create_router's engine
+    construction is the template). The scaler never blocks a tick:
+    spawn cost (engine construction + first-dispatch compiles) is paid
+    once per scale-out, and the <5% guardrail-overhead budget
+    (tools/bench_serving.py --autoscale-overhead) prices the steady
+    state, where `tick()` is pure host arithmetic."""
+
+    def __init__(self, router, spawn: Callable[[], object],
+                 cfg: Optional[AutoscaleConfig] = None,
+                 slo=None, clock=None):
+        self.router = router
+        self.spawn = spawn
+        self.cfg = cfg or AutoscaleConfig()
+        self.slo = slo                  # profiler.slo.BurnRateMonitor
+        # default to the ROUTER's clock so one injected clock drives
+        # deadlines and autoscale cooldowns coherently
+        self._clock = (clock if clock is not None
+                       else getattr(router, "_clock", time.perf_counter))
+        self._breach = 0                # consecutive breach ticks
+        self._idle = 0                  # consecutive idle ticks
+        self._last_action = -float("inf")
+        self._m_out = monitor.counter("serving.autoscale.scale_out")
+        self._m_in = monitor.counter("serving.autoscale.scale_in")
+        self._m_target = monitor.gauge(
+            "serving.autoscale.replicas_target")
+        self._m_occ = monitor.gauge("serving.autoscale.occupancy")
+        from ..profiler import flight_recorder
+        self._flight = flight_recorder.recorder()
+        self._m_target.set(len(router.dispatchable()))
+
+    # ----------------------------------------------------------- signals
+    def occupancy(self) -> float:
+        """Demand over capacity across the dispatchable fleet; +inf
+        when demand exists but nothing admits (all draining/dead) —
+        the strongest possible scale-out signal."""
+        reps = self.router.dispatchable()
+        demand = (len(self.router._pending)
+                  + sum(r.load() for r in reps))
+        cap = sum(r.eng.num_slots for r in reps)
+        if cap == 0:
+            return float("inf") if demand else 0.0
+        return demand / cap
+
+    def burn(self) -> float:
+        """Max short-window burn rate across the SLO monitor's
+        objectives (0.0 without a monitor — occupancy alone then
+        drives the loop)."""
+        if self.slo is None:
+            return 0.0
+        short = min(s for _, s in self.slo.pairs)
+        now = self._clock()
+        return max((self.slo.burn_rate(o.name, short, now=now)
+                    for o in self.slo.objectives), default=0.0)
+
+    # -------------------------------------------------------- the tick
+    def tick(self) -> Optional[str]:
+        """One control decision. Returns "scale_out" / "scale_in" when
+        an action fired, else None. Call once per router step."""
+        cfg = self.cfg
+        occ = self.occupancy()
+        self._m_occ.set(0.0 if occ == float("inf") else occ)
+        breach = (occ >= cfg.scale_out_occupancy
+                  or self.burn() >= cfg.burn_threshold)
+        idle = (not breach) and occ <= cfg.scale_in_occupancy
+        # streaks: the dead band between the thresholds resets BOTH —
+        # a noisy signal oscillating inside the band never acts
+        self._breach = self._breach + 1 if breach else 0
+        self._idle = self._idle + 1 if idle else 0
+        now = self._clock()
+        if now - self._last_action < cfg.cooldown_s:
+            return None
+        n = len(self.router.dispatchable())
+        if self._breach >= cfg.breach_ticks and n < cfg.max_replicas:
+            idx = self.router.spawn_replica(self.spawn())
+            self._after_action(now, occ, n + 1)
+            self._m_out.add()
+            self._flight.note(autoscale_scale_out=idx,
+                              occupancy=round(min(occ, 1e9), 3),
+                              replicas=n + 1)
+            self._flight.dump("autoscale_scale_out")
+            return "scale_out"
+        if self._idle >= cfg.idle_ticks and n > cfg.min_replicas:
+            # drain the least-loaded dispatchable replica — its live
+            # requests migrate out, the router releases it when empty
+            victim = min(self.router.dispatchable(),
+                         key=lambda r: (r.load(), -r.idx))
+            self.router.drain_replica(victim.idx, migrate=True)
+            self._after_action(now, occ, n - 1)
+            self._m_in.add()
+            self._flight.note(autoscale_scale_in=victim.idx,
+                              occupancy=round(occ, 3), replicas=n - 1)
+            self._flight.dump("autoscale_scale_in")
+            return "scale_in"
+        return None
+
+    def _after_action(self, now: float, occ: float, target: int) -> None:
+        self._last_action = now
+        self._breach = 0
+        self._idle = 0
+        self._m_target.set(target)
+
+
+class EnginePreemptGuard:
+    """Lease/watchdog preemption detection for ONE tp-sharded
+    ServingEngine: `poll()` after each engine tick; a stale device
+    lease degrades tp through the planner and rebuilds the engine on
+    the surviving mesh with its live streams migrated in place.
+
+    >>> guard = EnginePreemptGuard(engine)
+    >>> while engine.has_work():
+    ...     engine.step()
+    ...     guard.poll()
+
+    In production the pulse is fed by per-host heartbeats; in drills
+    `testing/faults.py` ``replica_preempt@T:R`` wedges R leases
+    through this module's `_FAULT_HOOK` — backdated, so the REAL
+    staleness rule fires at the next poll (the elastic-training
+    detection discipline, parallel/elastic.py)."""
+
+    def __init__(self, engine, lease_timeout_s: float = 5.0,
+                 chip=None):
+        if engine.mesh is None:
+            raise ValueError("EnginePreemptGuard needs a tp-sharded "
+                             "engine (mesh=)")
+        from ..parallel.elastic import DeviceLeases
+        self.engine = engine
+        self.lease_timeout_s = float(lease_timeout_s)
+        self.chip = chip
+        self._devices = list(np.asarray(engine.mesh.devices).flat)
+        self.leases = DeviceLeases(self._devices)
+        self._ticks = 0
+        self._m_preempt = monitor.counter(
+            "serving.autoscale.preemptions")
+        from ..profiler import flight_recorder
+        self._flight = flight_recorder.recorder()
+
+    def poll(self) -> int:
+        """Pulse live leases, detect staleness, degrade+rebuild when
+        devices are gone. Returns the NEW tp degree after a rebuild,
+        else 0 (no action)."""
+        if _FAULT_HOOK is not None:
+            actions = _FAULT_HOOK(self._ticks) or {}
+            lose = actions.pop("replica_preempt", None)
+            if lose:
+                from ..parallel.mesh import device_keys
+                keys = device_keys(self._devices)
+                self.leases.wedge(keys[-int(lose):])
+        self._ticks += 1
+        self.leases.pulse()
+        stale = set(self.leases.stale(self.lease_timeout_s))
+        if not stale:
+            return 0
+        from ..parallel.mesh import build_mesh, device_keys
+        keys = device_keys(self._devices)
+        survivors = [d for d, k in zip(self._devices, keys)
+                     if k not in stale]
+        if not survivors:
+            raise RuntimeError("every device lease stale — no mesh "
+                               "left to rebuild the engine on")
+        from ..parallel.planner import plan_serving_tp
+        plan = plan_serving_tp(self.engine.cfg, len(survivors),
+                               num_slots=self.engine.num_slots,
+                               max_len=self.engine.max_len,
+                               chip=self.chip)
+        tp = plan["tp"]
+        mesh = build_mesh({"tp": tp}, devices=survivors[:tp])
+        migrated = self.engine.rebuild_on_mesh(mesh)
+        self._devices = survivors[:tp]
+        self.leases.reset(self._devices)
+        self._m_preempt.add()
+        self._flight.note(serving_preempt_lost=sorted(stale),
+                          new_tp=tp, migrated=migrated,
+                          tick=self._ticks)
+        self._flight.dump("serving_preempt")
+        return tp

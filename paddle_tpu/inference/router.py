@@ -27,17 +27,32 @@ to its own EXACTLY-ONCE resolution.
 Replica death (`kill_replica`, or any exception escaping a replica's
 step — the engines self-heal internally, so an escape means the
 replica is gone): every un-terminal request mapped to the dead replica
-REQUEUES at the head of the router queue and replays FROM SCRATCH on a
-survivor — the engine has no cross-replica KV migration, and greedy
-streams are deterministic, so a replayed request's final token stream
-is bit-identical to an undisturbed run. Migration semantics are
-therefore at-least-once token DELIVERY (tokens emitted before the
-death are re-emitted by the replay; `RouterRequest.tokens` is reset so
-the final list never duplicates) with exactly-once TERMINAL
-resolution — the same contract a resumable stream gives its client.
-Requests already terminal on the dead replica stay resolved (never
-re-run); a death with zero live replicas left resolves everything
-"evicted" (never limbo). Every death leaves a flight-recorder dump.
+moves to a survivor. The router tries LIVE MIGRATION first — host
+snapshot of the request's KV (pages or cache rows) + decode-state
+mirror via `ServingEngine.snapshot_request`, restored into a
+survivor's pool through the admission-reservation path
+(`restore_request`), so the stream continues with ZERO re-prefilled
+tokens and a continuation bit-identical to an undisturbed engine.
+Only when no snapshot exists (the replica died mid-step, the request
+was still mid-prefill, or no survivor has capacity) does it fall back
+to the original requeue-replay: the request REQUEUES at the head of
+the router queue and replays FROM SCRATCH (`RouterRequest.tokens` is
+reset so the final list never duplicates) — at-least-once token
+DELIVERY with exactly-once TERMINAL resolution either way. Requests
+already terminal on the dead replica stay resolved (never re-run); a
+death with zero live replicas left resolves everything "evicted"
+(never limbo). Every death leaves a flight-recorder dump.
+
+Fleet elasticity (`spawn_replica` / `drain_replica`) is the seam
+`inference/autoscale.py`'s control loop drives: spawn adds a warm
+engine to the rotation; drain flips a replica to DRAINING (admits
+nothing, keeps stepping, live requests migrate out where capacity
+allows) and the router releases it at the first tick it holds no
+work. Deadlines re-scope to the REMAINING budget at every (re)
+dispatch and migration — an exhausted budget resolves "timeout"
+immediately instead of burning a survivor's slot. `testing/faults.py`
+injects `replica_preempt@T:R` / `migrate_raise` through this module's
+`_FAULT_HOOK` (consulted once per router tick).
 
 Observability: serving.router.* monitor names — the replicas_live
 gauge, the requeues/rejected counters, per-replica queue-depth gauges
@@ -61,6 +76,13 @@ from .serving import (BackpressureError, PoolExhaustedError,
 from ..profiler import monitor
 
 __all__ = ["EngineRouter", "RouterRequest", "create_router"]
+
+# testing/faults.py installs a callable here: called once per router
+# tick as _FAULT_HOOK(tick) -> dict of actions, e.g.
+# {"replica_preempt": idx} (kill replica idx, migration-first) or
+# {"raise_migrate": True} (the NEXT migration attempt fails once and
+# takes the requeue-replay fallback). None in production.
+_FAULT_HOOK = None
 
 
 class RouterRequest:
@@ -121,6 +143,7 @@ class _Replica:
         self.idx = idx
         self.eng = eng
         self.alive = True
+        self.draining = False           # admits nothing, still stepped
         self.inner = {}                 # inner request id -> RouterRequest
         self.m_depth = monitor.gauge(f"serving.router.queue_depth.r{idx}")
         self.m_disp = monitor.counter(f"serving.router.dispatched.r{idx}")
@@ -153,7 +176,8 @@ class EngineRouter:
 
     def __init__(self, engines: Sequence[ServingEngine],
                  max_queue: int = 0, queue_policy: str = "reject",
-                 concurrent: bool = True, tracing: bool = False):
+                 concurrent: bool = True, tracing: bool = False,
+                 clock=None):
         if not engines:
             raise ValueError("EngineRouter needs >= 1 engine replica")
         if queue_policy not in ("reject", "shed_oldest"):
@@ -174,6 +198,11 @@ class EngineRouter:
         self._pending: collections.deque = collections.deque()
         self._next_id = 0
         self._ticks = 0
+        # injectable clock (seconds, perf_counter-like) — deadline
+        # re-scoping and dispatch-latency math read ONLY this, so
+        # tests drive wall-budget trajectories deterministically
+        self._clock = clock if clock is not None else time.perf_counter
+        self._migrate_raise = False           # injected migrate_raise
         from ..profiler import flight_recorder
         self._flight = flight_recorder.recorder()
         # request-scoped tracing (profiler/tracing): the router mints
@@ -195,11 +224,27 @@ class EngineRouter:
         self._m_sub = monitor.counter("serving.router.requests_submitted")
         self._m_done = monitor.counter("serving.router.requests_completed")
         self._m_deaths = monitor.counter("serving.router.replica_deaths")
+        # live-migration observables (serving.autoscale.* namespace —
+        # the autoscaler adds scale_out/scale_in/replicas_target there;
+        # telemetry_report groups the whole prefix into one block)
+        self._m_mig = monitor.counter("serving.autoscale.migrations")
+        self._m_mig_fb = monitor.counter(
+            "serving.autoscale.migrate_fallbacks")
+        self._m_mig_bytes = monitor.gauge(
+            "serving.autoscale.migrated_pages_bytes")
+        self._mig_bytes = 0                   # cumulative KV bytes moved
         self._m_live.set(len(self.replicas))
 
     # ------------------------------------------------------- observables
     def live(self) -> List[_Replica]:
+        """Replicas still being STEPPED (includes draining ones — they
+        keep serving their in-flight requests until released)."""
         return [r for r in self.replicas if r.alive]
+
+    def dispatchable(self) -> List[_Replica]:
+        """Replicas that admit NEW work: live and not draining — the
+        placement set for dispatch and migration targets."""
+        return [r for r in self.replicas if r.alive and not r.draining]
 
     def has_work(self) -> bool:
         return (bool(self._pending)
@@ -210,10 +255,13 @@ class EngineRouter:
         the admission balance (dispatch counts)."""
         return {"replicas": len(self.replicas),
                 "replicas_live": len(self.live()),
+                "replicas_dispatchable": len(self.dispatchable()),
                 "pending": len(self._pending),
                 "requeues": self._m_requeue.value,
+                "migrations": self._m_mig.value,
                 "per_replica": [
                     {"idx": r.idx, "alive": r.alive,
+                     "draining": r.draining,
                      "load": r.load() if r.alive else 0,
                      "dispatched": r.m_disp.value}
                     for r in self.replicas]}
@@ -242,7 +290,7 @@ class EngineRouter:
                             None if deadline_ticks is None
                             else int(deadline_ticks))
         self._next_id += 1
-        req.t_submit = time.perf_counter()
+        req.t_submit = self._clock()
         req._tick_submit = self._ticks
         req._router = self
         if self._tracer is not None:
@@ -281,22 +329,38 @@ class EngineRouter:
         self._m_sub.add()
         return req
 
+    def _remaining_budget(self, req: RouterRequest):
+        """Re-scope `req`'s deadlines to the budget LEFT as of now:
+        wall seconds since the router submit, router ticks since the
+        submit tick (router ticks double as engine ticks — every
+        router step ticks every live replica once). Returns
+        (deadline_s, deadline_ticks, expired)."""
+        dl_s = req.deadline_s
+        if dl_s is not None:
+            dl_s = dl_s - (self._clock() - req.t_submit)
+        dl_t = req.deadline_ticks
+        if dl_t is not None:
+            dl_t = dl_t - (self._ticks - req._tick_submit)
+        expired = ((dl_s is not None and dl_s <= 0.0)
+                   or (dl_t is not None and dl_t <= 0))
+        return dl_s, dl_t, expired
+
     def _try_dispatch(self, req: RouterRequest) -> bool:
-        """Place `req` on the least-loaded live replica that accepts
-        it. Deadlines re-scope to the REMAINING budget (wall seconds
-        since the router submit; router ticks double as engine ticks —
-        every router step ticks every live replica once)."""
+        """Place `req` on the least-loaded dispatchable replica that
+        accepts it. Deadlines re-scope to the REMAINING budget — a
+        request whose budget is already exhausted (it waited out its
+        deadline in the router queue, or died with its replica at the
+        deadline edge) resolves "timeout" HERE rather than being
+        dispatched with a floor-clamped budget that burns a survivor
+        slot for one doomed tick."""
+        dl_s, dl_t, expired = self._remaining_budget(req)
+        if expired:
+            self._finish(req, "timeout")
+            return True                   # resolved — nothing to place
         never_fits = 0
-        t_disp0 = time.perf_counter()
-        live = sorted(self.live(), key=_Replica.load)
+        t_disp0 = self._clock()
+        live = sorted(self.dispatchable(), key=_Replica.load)
         for rep in live:
-            dl_s = req.deadline_s
-            if dl_s is not None:
-                dl_s = max(dl_s - (time.perf_counter() - req.t_submit),
-                           1e-6)
-            dl_t = req.deadline_ticks
-            if dl_t is not None:
-                dl_t = max(dl_t - (self._ticks - req._tick_submit), 1)
             try:
                 inner = rep.eng.submit(
                     req.prompt, req.max_new_tokens,
@@ -310,8 +374,7 @@ class EngineRouter:
                 continue
             rep.inner[inner.id] = req
             rep.m_disp.add()
-            self._m_disp_ms.observe(
-                (time.perf_counter() - t_disp0) * 1e3)
+            self._m_disp_ms.observe((self._clock() - t_disp0) * 1e3)
             req.replica = rep.idx
             req._inner = inner
             if req.trace is not None:
@@ -332,6 +395,14 @@ class EngineRouter:
         escape means the replica is gone) dies here and its in-flight
         requests requeue."""
         events: List[tuple] = []
+        if _FAULT_HOOK is not None:
+            actions = _FAULT_HOOK(self._ticks) or {}
+            if actions.pop("raise_migrate", None):
+                self._migrate_raise = True    # next migration fails once
+            rp = actions.pop("replica_preempt", None)
+            if rp is not None:
+                self.kill_replica(int(rp) % len(self.replicas),
+                                  reason="preempt")
         self._dispatch_pending()
         live = self.live()
         results = {}
@@ -365,6 +436,13 @@ class EngineRouter:
                     outer.tokens.append(int(tok))
                     events.append((outer, int(tok)))
             self._sweep_terminals(rep)
+        for rep in self.replicas:
+            # graceful-drain release: a draining replica leaves the
+            # rotation at the FIRST tick it holds no work — every
+            # in-flight request it had has migrated out or resolved
+            if (rep.alive and rep.draining and not rep.inner
+                    and not rep.eng.has_work()):
+                self._release_replica(rep)
         self._ticks += 1
         if not self.live():
             self.abort_pending("evicted")
@@ -465,22 +543,150 @@ class EngineRouter:
         self._publish_gauges()
         return n
 
+    # ------------------------------------------------- fleet elasticity
+    def spawn_replica(self, engine: ServingEngine) -> int:
+        """Scale OUT: add a warm `engine` to the rotation and return
+        its replica index. The engine must share params/config with
+        the fleet (greedy bit-parity across replicas assumes it); the
+        autoscaler's `spawn` factory owns that construction. Joins
+        the dispatchable set immediately — the next `step()` places
+        queued work on it. Leaves a flight-recorder dump."""
+        rep = _Replica(len(self.replicas), engine)
+        self.replicas.append(rep)
+        if self._exec is not None:
+            # the lazy executor was sized for the OLD fleet — rebuild
+            # next tick so every live replica still gets its own worker
+            self._exec.shutdown(wait=False)
+            self._exec = None
+        self._flight.note(router_spawn=rep.idx, tick=self._ticks,
+                          replicas_live=len(self.live()))
+        self._flight.dump("router_scale_out")
+        self._publish_gauges()
+        return rep.idx
+
+    def drain_replica(self, idx: int, migrate: bool = True) -> int:
+        """Scale IN, gracefully: replica `idx` stops admitting new
+        work but KEEPS STEPPING its in-flight requests; the router
+        releases it at the first tick it holds no work. With
+        `migrate=True` every snapshot-able in-flight request moves to
+        a dispatchable survivor NOW (zero re-prefill, bit-identical
+        continuation) so release is typically immediate; requests that
+        cannot move (mid-prefill, no capacity) simply finish in place.
+        Returns the number migrated. Idempotent; flight-dumps."""
+        rep = self.replicas[idx]
+        if not rep.alive or rep.draining:
+            return 0
+        rep.draining = True
+        moved = 0
+        if migrate:
+            for outer in [o for o in rep.inner.values() if not o.done]:
+                if self._migrate(outer, rep):
+                    moved += 1
+        self._flight.note(router_drain=idx, migrated=moved,
+                          remaining=len(rep.inner), tick=self._ticks)
+        self._flight.dump("router_scale_in")
+        self._publish_gauges()
+        return moved
+
+    def _release_replica(self, rep: _Replica) -> None:
+        """Final step of a graceful drain: the replica holds no work —
+        take it out of rotation (NOT a death: nothing requeues, the
+        deaths counter stays put)."""
+        rep.alive = False
+        rep.draining = False
+        self._flight.note(router_release=rep.idx, tick=self._ticks)
+        self._flight.dump("router_release")
+
+    # ----------------------------------------------------- live migration
+    def _migrate(self, outer: RouterRequest, src: _Replica) -> bool:
+        """Move `outer` mid-decode from `src` to a dispatchable
+        survivor via host KV snapshot — the zero-re-prefill path.
+        Order is snapshot -> restore -> detach so any failure leaves
+        the source intact (the caller falls back to requeue-replay or
+        leaves the request draining in place). Deadlines re-scope to
+        the remaining budget; an exhausted budget resolves "timeout"
+        here. Returns True only when the request now lives on the
+        target replica."""
+        inner = outer._inner
+        if inner is None or outer.done:
+            return False
+        try:
+            if self._migrate_raise:
+                self._migrate_raise = False
+                raise RuntimeError("injected migrate_raise")
+            snap = src.eng.snapshot_request(inner)
+        except Exception:                      # noqa: BLE001 — fault or
+            snap = None                        # mid-step corpse: fallback
+        if snap is None:
+            self._m_mig_fb.add()
+            return False
+        dl_s, dl_t, expired = self._remaining_budget(outer)
+        if expired:
+            src.eng.detach_request(inner)
+            src.inner.pop(inner.id, None)
+            self._finish(outer, "timeout")
+            return True                        # resolved, nothing to move
+        targets = sorted((r for r in self.dispatchable()
+                          if r is not src), key=_Replica.load)
+        for dst in targets:
+            try:
+                new_inner = dst.eng.restore_request(
+                    snap, deadline_s=dl_s, deadline_ticks=dl_t,
+                    _trace=outer.trace)
+            except Exception:                  # noqa: BLE001
+                new_inner = None
+            if new_inner is None:
+                continue
+            src.eng.detach_request(inner)
+            src.inner.pop(inner.id, None)
+            dst.inner[new_inner.id] = outer
+            outer._inner = new_inner
+            outer.replica = dst.idx
+            self._m_mig.add()
+            self._mig_bytes += int(snap.get("kv_bytes", 0))
+            self._m_mig_bytes.set(self._mig_bytes)
+            if outer.trace is not None:
+                outer.trace.instant("migrate", src=src.idx, dst=dst.idx,
+                                    kv_bytes=int(snap.get("kv_bytes", 0)))
+            self._flight.note(router_migration=outer.id, src=src.idx,
+                              dst=dst.idx, tick=self._ticks,
+                              kv_bytes=int(snap.get("kv_bytes", 0)))
+            return True
+        self._m_mig_fb.add()                   # snapshot ok, no capacity
+        return False
+
     # ---------------------------------------------------- replica death
-    def kill_replica(self, idx: int, reason: str = "killed") -> int:
+    def kill_replica(self, idx: int, reason: str = "killed",
+                     migrate: bool = True) -> int:
         """Take replica `idx` out of rotation NOW. Un-terminal requests
-        it held requeue at the HEAD of the router queue (they waited
-        longest) and replay from scratch on a survivor — their token
-        lists reset so the final streams carry no duplicates; already-
-        terminal requests stay resolved (exactly-once). Returns the
-        number requeued. Idempotent; leaves a flight-recorder dump."""
+        it held migrate to a survivor via live KV snapshot when
+        possible (`migrate=True`, zero re-prefill, bit-identical
+        continuation); the rest requeue at the HEAD of the router
+        queue (they waited longest) and replay from scratch — their
+        token lists reset so the final streams carry no duplicates.
+        Already-terminal requests stay resolved (exactly-once).
+        Returns the number requeued for replay. Idempotent; leaves a
+        flight-recorder dump."""
         rep = self.replicas[idx]
         if not rep.alive:
             return 0
         rep.alive = False
+        rep.draining = False
         self._m_deaths.add()
         victims = [o for o in rep.inner.values() if not o.done]
-        rep.inner.clear()
+        replay = []
+        migrated = 0
         for outer in victims:
+            # migration-first: reads the dying engine's arrays, which
+            # survive `alive=False` (host process, not real hardware
+            # loss) — a replica killed because its STEP raised usually
+            # fails the snapshot instead and takes the replay path
+            if migrate and self._migrate(outer, rep):
+                migrated += 1
+            elif not outer.done:               # _migrate may resolve it
+                replay.append(outer)
+        rep.inner.clear()
+        for outer in replay:
             outer.tokens.clear()          # replay regenerates the stream
             outer._inner = None
             outer.replica = None
@@ -493,14 +699,15 @@ class EngineRouter:
                 # attempt index
                 outer.trace.sever("replica_death", replica=idx)
                 outer.trace.link_replay(replica_died=idx)
-        self._pending.extendleft(reversed(victims))
+        self._pending.extendleft(reversed(replay))
         self._flight.note(router_replica_death=idx, reason=reason,
-                          requeued=len(victims), tick=self._ticks)
+                          migrated=migrated, requeued=len(replay),
+                          tick=self._ticks)
         self._flight.dump("router_replica_death")
         if not self.live():
             self.abort_pending("evicted")
         self._publish_gauges()
-        return len(victims)
+        return len(replay)
 
     # ------------------------------------------------------ conveniences
     def drain(self, max_ticks: Optional[int] = None):
@@ -539,7 +746,7 @@ def create_router(params, cfg, replicas: int = 2, family: str = "gpt",
                   max_queue: int = 0, queue_policy: str = "reject",
                   concurrent: bool = True,
                   meshes: Optional[Sequence] = None,
-                  tracing: bool = False,
+                  tracing: bool = False, clock=None,
                   **engine_kw) -> EngineRouter:
     """Build an EngineRouter over `replicas` identical ServingEngines
     sharing ONE param tree (read-only at decode — on a single host the
@@ -567,4 +774,4 @@ def create_router(params, cfg, replicas: int = 2, family: str = "gpt",
                for i in range(replicas)]
     return EngineRouter(engines, max_queue=max_queue,
                         queue_policy=queue_policy, concurrent=concurrent,
-                        tracing=tracing)
+                        tracing=tracing, clock=clock)

@@ -280,7 +280,8 @@ TERMINAL_REASONS = frozenset(
 # called with the tick index about to run, returns an action dict
 # ({"poison_slot": i} | {"draft_poison_slot": i} | {"stall_s": s} |
 # {"raise_prefill": True} | {"raise_decode": True} |
-# {"raise_cow": True}). Production code never sets it.
+# {"raise_cow": True} | {"raise_migrate": True}). Production code
+# never sets it.
 _FAULT_HOOK: Optional[Callable[[int], dict]] = None
 
 
@@ -944,53 +945,16 @@ class ServingEngine:
             from ..profiler import tracing as _tracing
             self._tracer = _tracing.tracer()
 
-        _oor = (self.max_pages * self.page_size if self.paged else None)
-        if self.spec:
-            from .spec_decode import spec_tick
-            self._decode = jax.jit(
-                functools.partial(spec_tick,
-                                  fwd=self.family.forward_cached,
-                                  cfg=run_cfg, max_top_k=self.max_top_k,
-                                  guard=self.guardrails,
-                                  gamma=self.spec_gamma,
-                                  draft_layers=self.spec_draft_layers,
-                                  oor_pos=_oor,
-                                  cache_pin=self._cache_pin,
-                                  tele=self._tick_tele),
-                donate_argnums=(1, 2), static_argnames=("sampling",))
-        else:
-            self._decode = jax.jit(
-                functools.partial(_decode_tick,
-                                  fwd=self.family.forward_cached,
-                                  cfg=run_cfg, max_top_k=self.max_top_k,
-                                  guard=self.guardrails, oor_pos=_oor,
-                                  cache_pin=self._cache_pin,
-                                  tele=self._tick_tele),
-                donate_argnums=(1, 2), static_argnames=("sampling",))
+        self._run_cfg = run_cfg       # the unroll-resolved config the
+        #                               jitted bodies close over — kept
+        #                               so rebuild_on_mesh re-jits the
+        #                               SAME computation on a new mesh
         if self.paged:
-            self._prefill = jax.jit(
-                functools.partial(_prefill_chunk,
-                                  fwd=self.family.forward_cached,
-                                  cfg=run_cfg, max_top_k=self.max_top_k,
-                                  guard=self.guardrails,
-                                  cache_pin=self._cache_pin),
-                donate_argnums=(1,), static_argnames=("sampling",))
-            self._cow = jax.jit(
-                functools.partial(_cow_copy,
-                                  cache_pin=self._cache_pin),
-                donate_argnums=(0,))
             self._slot_reserve = np.zeros(self.num_slots, np.int64)
             self._prefilling: collections.deque = collections.deque()
             self._raise_cow = False          # injected cow_raise fault
-        else:
-            self._prefill = jax.jit(
-                functools.partial(_prefill_slot,
-                                  fwd=self.family.forward_cached,
-                                  init_cache=self.family.init_cache,
-                                  cfg=run_cfg, max_top_k=self.max_top_k,
-                                  guard=self.guardrails,
-                                  cache_pin=self._cache_pin),
-                donate_argnums=(1,), static_argnames=("sampling",))
+        self._raise_migrate = False          # injected migrate_raise fault
+        self._make_executables()
 
         from ..profiler import flight_recorder
         self._flight = flight_recorder.recorder()
@@ -1123,6 +1087,63 @@ class ServingEngine:
                                 self.mesh, shape=v.shape)
                 for k, v in shapes.items()}
         return jax.jit(mk, out_shardings=self._cache_pin)()
+
+    def _make_executables(self) -> None:
+        """Build (or REBUILD) the jitted bodies — decode tick, bucketed/
+        chunked prefill, COW page copy — from the engine's current mesh
+        state. Extracted from __init__ so `rebuild_on_mesh` (preemption
+        recovery) can re-jit on the surviving mesh: the partials close
+        over `self._cache_pin`, which a mesh change invalidates. Must
+        run AFTER `_new_cache` has pinned the cache layout (the pin
+        dict is closed over by identity). Fresh jits start with empty
+        trace caches — one warmup recompile per body, then the
+        trace-count ceilings hold exactly as at first construction."""
+        run_cfg = self._run_cfg
+        self._repin = None      # lazy identity re-pin (see _pin_cache_host)
+        _oor = (self.max_pages * self.page_size if self.paged else None)
+        if self.spec:
+            from .spec_decode import spec_tick
+            self._decode = jax.jit(
+                functools.partial(spec_tick,
+                                  fwd=self.family.forward_cached,
+                                  cfg=run_cfg, max_top_k=self.max_top_k,
+                                  guard=self.guardrails,
+                                  gamma=self.spec_gamma,
+                                  draft_layers=self.spec_draft_layers,
+                                  oor_pos=_oor,
+                                  cache_pin=self._cache_pin,
+                                  tele=self._tick_tele),
+                donate_argnums=(1, 2), static_argnames=("sampling",))
+        else:
+            self._decode = jax.jit(
+                functools.partial(_decode_tick,
+                                  fwd=self.family.forward_cached,
+                                  cfg=run_cfg, max_top_k=self.max_top_k,
+                                  guard=self.guardrails, oor_pos=_oor,
+                                  cache_pin=self._cache_pin,
+                                  tele=self._tick_tele),
+                donate_argnums=(1, 2), static_argnames=("sampling",))
+        if self.paged:
+            self._prefill = jax.jit(
+                functools.partial(_prefill_chunk,
+                                  fwd=self.family.forward_cached,
+                                  cfg=run_cfg, max_top_k=self.max_top_k,
+                                  guard=self.guardrails,
+                                  cache_pin=self._cache_pin),
+                donate_argnums=(1,), static_argnames=("sampling",))
+            self._cow = jax.jit(
+                functools.partial(_cow_copy,
+                                  cache_pin=self._cache_pin),
+                donate_argnums=(0,))
+        else:
+            self._prefill = jax.jit(
+                functools.partial(_prefill_slot,
+                                  fwd=self.family.forward_cached,
+                                  init_cache=self.family.init_cache,
+                                  cfg=run_cfg, max_top_k=self.max_top_k,
+                                  guard=self.guardrails,
+                                  cache_pin=self._cache_pin),
+                donate_argnums=(1,), static_argnames=("sampling",))
 
     def pool_stats(self) -> dict:
         """The kv-pool observable (paged layout only): page states,
@@ -1276,6 +1297,8 @@ class ServingEngine:
             actions = _FAULT_HOOK(self._ticks) or {}
         if self.paged and actions.pop("raise_cow", None):
             self._raise_cow = True
+        if actions.pop("raise_migrate", None):
+            self._raise_migrate = True       # next snapshot raises once
         now = time.perf_counter()
         self._expire_queued(now)
         if self.paged:
@@ -2162,6 +2185,296 @@ class ServingEngine:
             self._pool.reserved += 1
             row[j] = 0
             self._pt_dirty = True
+
+    # ---------------------------------------- live migration + rebuild
+    def _pin_cache_host(self, cache):
+        """Re-assert the pinned layouts after an EAGER cache update
+        (the migration restore writes run outside the jitted bodies).
+        A jitted identity with the SAME out_shardings `_new_cache`
+        allocates under — not a bare device_put — because jit
+        NORMALIZES PartitionSpec spellings (trailing Nones stripped):
+        a device_put'd leaf would carry an equivalent-but-differently-
+        spelled sharding, and the next decode tick would silently
+        compile a second executable for it. No-op off-mesh."""
+        if not self._cache_pin:
+            return cache
+        if self._repin is None:
+            # Strip trailing Nones from the pin specs: jit OUTPUTS carry
+            # the trimmed spelling, and equivalent-but-longer spellings
+            # are DIFFERENT pjit cache keys — without this the first
+            # post-restore tick compiles against a spelling no later
+            # tick ever reproduces (a permanent extra executable).
+            norm = {}
+            for k, s in self._cache_pin.items():
+                if s is None:
+                    norm[k] = None
+                    continue
+                parts = list(s.spec)
+                while parts and parts[-1] is None:
+                    parts.pop()
+                norm[k] = jax.sharding.NamedSharding(
+                    s.mesh, jax.sharding.PartitionSpec(*parts))
+            self._repin = jax.jit(lambda c: c, out_shardings=norm)
+        return self._repin(cache)
+
+    def snapshot_request(self, req: Request) -> Optional[dict]:
+        """Host-snapshot a mid-decode request's LIVE state for cross-
+        engine migration: the already-computed K/V of every written
+        position (dense: the slot row's prefix; paged: the mapped
+        pages, flattened to one contiguous [L, pos, KV, hd] block —
+        layout-neutral, so a dense engine can restore a paged
+        snapshot and vice versa) plus the decode-state mirror (pos /
+        cur_tok / gen_idx and the PRNG id, so sampled streams continue
+        bit-identically). Returns None when there is nothing to
+        migrate — the request is terminal, still queued, or mid-
+        chunked-prefill (no first token yet; a replay costs the same
+        prefill it would need anyway). Call BETWEEN ticks only (the
+        scheduler's context — the same contract as submit/cancel).
+        Raises ServingFaultError under the injected migrate_raise
+        fault so drills exercise the fallback-to-replay path."""
+        slot = req.slot
+        if (req.done or slot is None or req._pf_next is not None
+                or not self._active[slot]):
+            return None
+        if self._raise_migrate:
+            self._raise_migrate = False
+            raise ServingFaultError("injected migrate fault")
+        pos = int(self._positions[slot])
+        if self.paged:
+            ps = self.page_size
+            npg = -(-pos // ps)
+            pids = np.asarray(self._ptab[slot, :npg], np.int32)
+            # gather the mapped pages -> [L, npg, ps, KV, hd], flatten
+            # the (page, in-page) axes (already position-ordered), and
+            # truncate to the written prefix
+            k = np.asarray(self._cache["k"][:, pids])
+            v = np.asarray(self._cache["v"][:, pids])
+            k = k.reshape(k.shape[0], npg * ps, *k.shape[3:])[:, :pos]
+            v = v.reshape(v.shape[0], npg * ps, *v.shape[3:])[:, :pos]
+        else:
+            k = np.asarray(self._cache["k"][:, slot, :pos])
+            v = np.asarray(self._cache["v"][:, slot, :pos])
+        return {"prompt": np.asarray(req.prompt, np.int32),
+                "tokens": list(req.tokens),
+                "max_new_tokens": int(req.max_new_tokens),
+                "temperature": float(req.temperature),
+                "top_k": int(req.top_k),
+                "eos_id": req.eos_id,
+                "pos": pos,
+                "cur_tok": int(self._cur_tok[slot]),
+                "gen_idx": int(self._gen_idx[slot]),
+                "prng_id": int(self._req_ids[slot]),
+                "kv_k": k, "kv_v": v,
+                "kv_bytes": int(k.nbytes + v.nbytes)}
+
+    def restore_request(self, snap: dict,
+                        deadline_s: Optional[float] = None,
+                        deadline_ticks: Optional[int] = None,
+                        _trace=None) -> Optional[Request]:
+        """Admit a migrated snapshot into THIS engine, bypassing the
+        queue (the request is already mid-flight — queueing would
+        re-order it behind cold admissions): a free slot is claimed
+        directly, the paged restore reserves the request's REMAINING
+        worst-case page envelope through the same admission-
+        reservation accounting as submit (pages already holding the
+        snapshot allocate now; the rest reserve), and the K/V block
+        uploads with ZERO re-prefilled tokens. Deadlines are the
+        REMAINING budget (the caller re-scopes — see
+        EngineRouter._remaining_budget). Returns the new live Request
+        (its .tokens pre-seeded with the already-generated ids so
+        eos/length checks continue where the source left off), or None
+        when this engine cannot take it (no free slot / pages / shape
+        limits) — the caller falls back to requeue-replay."""
+        prompt = np.asarray(snap["prompt"], np.int32).reshape(-1)
+        t0 = prompt.shape[0]
+        max_new = int(snap["max_new_tokens"])
+        if t0 + max_new > self.max_len:
+            return None
+        if snap["top_k"] > self.max_top_k:
+            return None
+        slot = self._free_slot()
+        if slot is None:
+            return None
+        pos = int(snap["pos"])
+        if self.paged:
+            need = self._pages_needed(t0, max_new)
+            if need > self._pool.available():
+                return None
+        req = Request(self._next_id, prompt, max_new,
+                      float(snap["temperature"]), int(snap["top_k"]),
+                      snap["eos_id"],
+                      deadline_s=(None if deadline_s is None
+                                  else float(deadline_s)),
+                      deadline_ticks=(None if deadline_ticks is None
+                                      else int(deadline_ticks)))
+        self._next_id += 1
+        req.t_submit = time.perf_counter()
+        req._tick_submit = self._ticks
+        req._engine = self
+        req.tokens = list(snap["tokens"])
+        req.trace = _trace
+        self._restore_into(req, snap, slot)
+        self._m_sub.add()
+        return req
+
+    def _restore_into(self, req: Request, snap: dict, slot: int) -> None:
+        """Write a snapshot's K/V into `slot` and arm every host
+        mirror — the shared tail of cross-engine restore and the
+        in-place mesh rebuild. The writes are EAGER in-pool updates
+        (migration is rare; the jitted tick bodies and their trace
+        caches are untouched), re-pinned to the mesh layout so the
+        next donated tick aliases exactly. The PRNG id mirror carries
+        the SOURCE engine's id — `_slot_keys` folds the mirror, not
+        the Request, into the stream, so sampled continuations are
+        bit-identical to the undisturbed engine."""
+        pos = int(snap["pos"])
+        kv_k, kv_v = snap["kv_k"], snap["kv_v"]
+        if self.paged:
+            ps = self.page_size
+            npg = -(-pos // ps)
+            need = self._pages_needed(len(req.prompt),
+                                      req.max_new_tokens)
+            L = kv_k.shape[0]
+            pad = np.zeros((L, npg * ps) + kv_k.shape[2:], kv_k.dtype)
+            padv = np.zeros_like(pad)
+            pad[:, :pos] = kv_k
+            padv[:, :pos] = kv_v
+            for j in range(npg):
+                pid = self._pool.alloc()
+                self._ptab[slot, j] = pid
+                self._cache["k"] = self._cache["k"].at[:, pid].set(
+                    self._rep(pad[:, j * ps:(j + 1) * ps]))
+                self._cache["v"] = self._cache["v"].at[:, pid].set(
+                    self._rep(padv[:, j * ps:(j + 1) * ps]))
+            reserve = max(need - npg, 0)
+            self._slot_reserve[slot] = reserve
+            self._pool.reserved += reserve
+            self._pt_dirty = True
+        else:
+            self._cache["k"] = self._cache["k"].at[
+                :, slot, :pos].set(self._rep(kv_k))
+            self._cache["v"] = self._cache["v"].at[
+                :, slot, :pos].set(self._rep(kv_v))
+        self._cache = self._pin_cache_host(self._cache)
+        now = time.perf_counter()
+        req.slot = slot
+        req._t_last = now
+        self._slot_req[slot] = req
+        self._positions[slot] = pos
+        self._active[slot] = True
+        self._cur_tok[slot] = int(snap["cur_tok"])
+        self._temps[slot] = req.temperature
+        self._top_ks[slot] = req.top_k
+        self._req_ids[slot] = int(snap["prng_id"])
+        self._gen_idx[slot] = int(snap["gen_idx"])
+        self._dirty = True
+        if req.trace is not None:
+            req._sp_decode = req.trace.begin(
+                "decode", slot=slot, migrated=True,
+                attempt=req.trace.attempt)
+
+    def detach_request(self, req: Request) -> bool:
+        """Non-terminal release — the live-migration seam. Drops `req`
+        from THIS engine (slot, pages, reservation, queue) WITHOUT the
+        terminal transition: the request continues on another engine,
+        so its trace stays OPEN (only the open decode span closes) and
+        no terminal-reason counter fires. finish_reason is the
+        sentinel "migrated" — deliberately NOT in TERMINAL_REASONS,
+        because for this engine the request did not terminate, it
+        left. requests_completed still advances so submitted-completed
+        stays a true in-flight gauge. Returns False when the request
+        already resolved."""
+        if req.done:
+            return False
+        if req.slot is not None:
+            self._clear_slot(req.slot)
+        else:
+            try:
+                self._queue.remove(req)
+            except ValueError:
+                pass
+        req.slot = None
+        req.done = True
+        req.finish_reason = "migrated"
+        if req.trace is not None and req._sp_decode is not None:
+            req.trace.end(req._sp_decode)
+            req._sp_decode = None
+        self._m_done.add()
+        self._m_occ.set(int(self._active.sum()))
+        self._m_queue.set(len(self._queue))
+        return True
+
+    def rebuild_on_mesh(self, mesh) -> int:
+        """Preemption recovery: re-host THIS engine on a (typically
+        smaller) mesh without dropping its live streams. Every active
+        slot host-snapshots (`snapshot_request`), params re-host
+        through device_get -> `_shard_params` onto the new mesh (the
+        simulated-loss drill's seam — a production loss would re-read
+        weights from their source), the pool cache reallocates via
+        `_new_cache` under a FRESH `_cache_pin` (sharded-birth
+        discipline: no device ever stages the whole pool), the jitted
+        bodies re-make (`_make_executables` — one warmup recompile
+        each, then the trace ceilings hold), and the snapshots restore
+        IN PLACE onto the SAME Request objects — callers' handles keep
+        filling, zero re-prefilled tokens, streams bit-identical.
+        Requests that cannot snapshot (mid-chunked-prefill) resolve
+        "evicted"; queued requests stay queued and prefill on the new
+        mesh. Returns the number of live streams migrated."""
+        if self.tp_axis not in mesh.axis_names:
+            raise ValueError(
+                f"mesh {dict(mesh.shape)} has no {self.tp_axis!r} axis")
+        if self.family.serving_specs is None:
+            raise ValueError(
+                f"family {self.family.name!r} has no "
+                "SERVING_PARAM_SPECS — it cannot run tensor-parallel")
+        snaps = []
+        for req in list(self._slot_req):
+            if req is None:
+                continue
+            try:
+                snap = self.snapshot_request(req)
+            except Exception as e:             # noqa: BLE001
+                self._on_fault("migrate", e)
+                snap = None
+            if snap is None:
+                self._finish(req, "evicted")
+            else:
+                slot = req.slot
+                self._clear_slot(slot)         # old pool's accounting
+                req.slot = None
+                snaps.append((req, snap))
+        # host copies BEFORE the old mesh state is dropped
+        params_host = jax.device_get(self._params)
+        key_host = np.asarray(jax.device_get(self._base_key))
+        from jax.sharding import NamedSharding, PartitionSpec
+        self.mesh = mesh
+        self.tp = int(mesh.shape[self.tp_axis])
+        self._rep_sharding = NamedSharding(mesh, PartitionSpec())
+        self._cache_pin = None
+        self._params = self._shard_params(params_host)
+        if self.paged:
+            self._pool = _PagePool(self.num_pages, self.page_size)
+            self._ptab[:] = 0
+            self._slot_reserve[:] = 0
+            self._prefilling.clear()
+            self._pt_dirty = False
+        self._cache = self._new_cache()        # re-pins the layout
+        self._base_key = self._rep(key_host)
+        self._poison_ones = self._rep(np.ones(self.num_slots,
+                                              np.float32))
+        self._dstate = None
+        self._dirty = True
+        self._make_executables()
+        for req, snap in snaps:
+            slot = self._free_slot()
+            self._restore_into(req, snap, slot)
+        self._flight.note(serving_rebuild=dict(mesh.shape),
+                          tick=self._ticks, migrated=len(snaps))
+        self._flight.dump("serving_rebuild")
+        print(f"[serving] rebuilt on mesh {dict(mesh.shape)} at tick "
+              f"{self._ticks}: {len(snaps)} live stream(s) migrated",
+              file=sys.stderr, flush=True)
+        return len(snaps)
 
     def _maybe_finish(self, req: Request) -> None:
         slot = req.slot

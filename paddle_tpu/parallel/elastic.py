@@ -146,7 +146,10 @@ class DeviceLeases:
     stop refreshing AND backdate, so `stale(timeout)` detects them at
     the very next boundary instead of waiting the timeout out in real
     time (the injector simulates a dead chip, the detector still runs
-    the real staleness rule)."""
+    the real staleness rule). Also the SERVING preemption detector:
+    inference/autoscale.EnginePreemptGuard runs the same
+    pulse/wedge/stale cycle per engine tick over a tp mesh's
+    devices."""
 
     def __init__(self, devices):
         self._t: Dict[str, float] = {}

@@ -768,7 +768,10 @@ def plan_serving_tp(cfg_or_spec, n_devices: int, num_slots: int = 8,
     FORCES tp > 1 — the "models bigger than one chip" half of ROADMAP
     item 3. Returns mesh axes for parallel.mesh.build_mesh, e.g.
     {'tp': 4}; only degrees dividing both n_devices and num_heads are
-    considered (head-sharded attention)."""
+    considered (head-sharded attention). Consumers: the serving bench
+    (--tp adoption), and inference/autoscale.EnginePreemptGuard,
+    which re-runs this pricing on the SURVIVOR count after a device
+    lease goes stale to pick the degraded tp degree."""
     spec = _coerce_spec(cfg_or_spec)
     chip = chip or ChipSpec()
     S = max_len or spec.seq_len

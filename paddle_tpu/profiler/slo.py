@@ -88,7 +88,12 @@ class Alert:
 
 
 class BurnRateMonitor:
-    """Multi-window burn-rate evaluation over declared objectives."""
+    """Multi-window burn-rate evaluation over declared objectives.
+
+    Consumers: the alerting path (check() -> flight dump) and the
+    serving Autoscaler (inference/autoscale.py), which reads the
+    short-window `burn_rate` per objective as a scale-out breach
+    signal alongside fleet occupancy."""
 
     def __init__(self, objectives: Sequence[Objective],
                  pairs: Sequence[Tuple[float, float]] = DEFAULT_PAIRS,

@@ -61,6 +61,29 @@ tools/chaos_serving.py):
                           time a COW fires at/after tick T — the
                           admission rollback must release the shared
                           pages it retained.
+- ``migrate_raise@T``   — when aimed at the ENGINE hook: the next
+                          `snapshot_request` at/after tick T raises
+                          once (mid-migration failure — the router
+                          must take the requeue-replay fallback).
+
+Router fault kinds (inference/router.py consults `on_router_tick`
+through `router._FAULT_HOOK` once per ROUTER tick — a separate hook
+from the serving one, so a router drill never cross-consumes an
+engine fault; `inference/autoscale.py`'s EnginePreemptGuard consults
+the SAME method through `autoscale._FAULT_HOOK`, where the tick is
+the guard's poll index):
+
+- ``replica_preempt@T:R`` — at the ROUTER: kill replica R at tick T
+                          (migration-first, replay fallback). At the
+                          PREEMPT GUARD: wedge the last R device
+                          leases of the engine's mesh — staleness
+                          detection, tp degrade and rebuild run the
+                          real path. R defaults to 1... the same
+                          token drives whichever hook is armed.
+- ``migrate_raise@T``   — at the router/guard hook: the next router
+                          migration attempt at/after tick T fails
+                          once (fallback + migrate_fallbacks
+                          counter).
 
 Elastic (mesh-level) fault kinds (parallel/elastic.py consults
 `on_elastic` through `elastic._FAULT_HOOK` at its phase boundaries —
@@ -108,12 +131,13 @@ KILL_EXIT = 37
 _KINDS = ("kill", "crash_shard", "nan", "hb_stale", "elastic_exit",
           "nan_logits", "tick_stall", "prefill_raise", "decode_raise",
           "cow_raise", "draft_nan", "device_loss", "collective_hang",
-          "straggler")
+          "straggler", "replica_preempt", "migrate_raise")
 _SERVING_KINDS = frozenset(
     {"nan_logits", "tick_stall", "prefill_raise", "decode_raise",
-     "cow_raise", "draft_nan"})
+     "cow_raise", "draft_nan", "migrate_raise"})
 _ELASTIC_KINDS = frozenset(
     {"device_loss", "collective_hang", "straggler"})
+_ROUTER_KINDS = frozenset({"replica_preempt", "migrate_raise"})
 
 
 @dataclass
@@ -280,6 +304,36 @@ class FaultPlan:
                 actions["raise_decode"] = True
             elif f.kind == "cow_raise":
                 actions["raise_cow"] = True
+            elif f.kind == "migrate_raise":
+                actions["raise_migrate"] = True
+        return actions
+
+    def on_router_tick(self, tick: int) -> dict:
+        """router._FAULT_HOOK / autoscale._FAULT_HOOK: called with the
+        router tick (or preempt-guard poll index) about to run;
+        returns the action dict the consumer applies (keys:
+        replica_preempt — replica index at the router, device count at
+        the guard — and raise_migrate). Each fault fires at most once
+        (marker scheme), and at most one replica_preempt fires per
+        consult so stacked preemptions land on successive ticks."""
+        actions: dict = {}
+        for f in self.faults:
+            if f.done or f.kind not in _ROUTER_KINDS or tick < f.step:
+                continue
+            if f.kind == "replica_preempt":
+                if "replica_preempt" in actions:
+                    continue
+                self._mark_fired(f)
+                print(f"[faults] replica_preempt at tick {tick} "
+                      f"(arg={f.arg})", file=sys.stderr, flush=True)
+                # verbatim: replica INDEX at the router (0 is legal,
+                # spelled `:0`), device COUNT at the preempt guard
+                actions["replica_preempt"] = f.arg
+            elif f.kind == "migrate_raise":
+                self._mark_fired(f)
+                print(f"[faults] migrate_raise at tick {tick}",
+                      file=sys.stderr, flush=True)
+                actions["raise_migrate"] = True
         return actions
 
 
@@ -299,10 +353,12 @@ def install(spec: Optional[str] = None,
         else os.environ.get(ENV_ONCE_DIR) or None
     plan = FaultPlan(spec, once_dir=once)
     from ..parallel import checkpoint, elastic, resilience
-    from ..inference import serving
+    from ..inference import autoscale, router, serving
     resilience._STEP_HOOK = plan.on_step
     checkpoint._SHARD_WRITE_HOOK = plan.on_shard_write
     serving._FAULT_HOOK = plan.on_serving_tick
+    router._FAULT_HOOK = plan.on_router_tick
+    autoscale._FAULT_HOOK = plan.on_router_tick
     elastic._FAULT_HOOK = plan.on_elastic
     _PLAN = plan
     return plan
@@ -311,10 +367,12 @@ def install(spec: Optional[str] = None,
 def uninstall() -> None:
     global _PLAN
     from ..parallel import checkpoint, elastic, resilience
-    from ..inference import serving
+    from ..inference import autoscale, router, serving
     resilience._STEP_HOOK = None
     checkpoint._SHARD_WRITE_HOOK = None
     serving._FAULT_HOOK = None
+    router._FAULT_HOOK = None
+    autoscale._FAULT_HOOK = None
     elastic._FAULT_HOOK = None
     _PLAN = None
 

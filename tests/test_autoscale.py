@@ -1,0 +1,555 @@
+"""Serving control-loop tests: SLO-driven autoscaling, live KV
+migration, preemption-tolerant engines (inference/autoscale.py,
+inference/router.py migration seams, serving.py snapshot/restore/
+rebuild).
+
+The load-bearing guarantees under test:
+- the autoscaler tracks load: flood -> scale out (bounded by
+  max_replicas, cooldown, breach streak), idle -> graceful scale in
+  (bounded by min_replicas, idle streak); the dead band between the
+  hysteresis thresholds never acts;
+- live migration moves a mid-decode request between replicas with
+  ZERO re-prefilled tokens and a continuation bit-identical to an
+  undisturbed engine — dense, paged, speculative and tp layouts;
+- when no snapshot exists (mid-prefill, injected migrate_raise) the
+  router falls back to the PR-8 requeue-replay and the stream is
+  still bit-identical end to end;
+- requeued requests carry their REMAINING deadline budget, and an
+  exhausted budget resolves "timeout" instead of burning a survivor
+  slot;
+- a lost device on a tp-sharded engine degrades tp via the planner,
+  rebuilds on the surviving mesh and keeps one-pull-per-tick, the
+  trace-count ceilings, and exactly-once terminal resolution.
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.inference import (AutoscaleConfig, Autoscaler,
+                                  EnginePreemptGuard, ServingEngine,
+                                  create_router)
+from paddle_tpu.models.gpt import GPTConfig, init_gpt_params
+from paddle_tpu.parallel.mesh import build_mesh
+from paddle_tpu.testing import faults
+
+MAXLEN = 32
+
+
+def _gpt_cfg():
+    return GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                     num_heads=2, ffn_hidden=64, max_seq_len=64,
+                     sequence_parallel=False, remat=False,
+                     dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def gpt_setup():
+    cfg = _gpt_cfg()
+    return cfg, init_gpt_params(cfg, jax.random.PRNGKey(0))
+
+
+@pytest.fixture
+def clean_faults():
+    yield
+    faults.uninstall()
+
+
+@pytest.fixture(autouse=True)
+def _clean_flight_ring():
+    from paddle_tpu.profiler import flight_recorder
+    yield
+    rec = flight_recorder.recorder()
+    rec.clear()
+    rec.set_dir(None)
+
+
+def _prompts(lens, seed=0, vocab=64):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, vocab, L).astype(np.int32) for L in lens]
+
+
+def _router(params, cfg, replicas=2, clock=None, **kw):
+    kw.setdefault("num_slots", 3)
+    kw.setdefault("max_len", MAXLEN)
+    return create_router(params, cfg, replicas=replicas, family="gpt",
+                         concurrent=False, clock=clock, **kw)
+
+
+def _fake_clock():
+    state = [0.0]
+
+    def clock():
+        return state[0]
+    clock.advance = lambda dt: state.__setitem__(0, state[0] + dt)
+    return clock
+
+
+def _count_pulls(eng):
+    counts = [0]
+    orig = eng._pull
+
+    def counted(value, stall_s=0.0):
+        counts[0] += 1
+        return orig(value, stall_s)
+    eng._pull = counted
+    return counts
+
+
+# ==========================================================================
+# autoscaler control loop
+# ==========================================================================
+class TestAutoscaler:
+    def test_flood_scale_out_idle_scale_in(self, gpt_setup):
+        """The acceptance trajectory: a flood scales the fleet out,
+        the post-flood idle drains it back to min, and every request
+        resolves exactly once through both transitions."""
+        cfg, params = gpt_setup
+        clock = _fake_clock()
+
+        def mk():
+            return ServingEngine(params, cfg, family="gpt",
+                                 num_slots=2, max_len=MAXLEN)
+        r = _router(params, cfg, replicas=1, num_slots=2, clock=clock)
+        sc = Autoscaler(r, spawn=mk, cfg=AutoscaleConfig(
+            min_replicas=1, max_replicas=3, breach_ticks=2,
+            idle_ticks=3, cooldown_s=1.0))
+        out0, in0 = sc._m_out.value, sc._m_in.value
+        reqs = [r.submit(p, 20) for p in _prompts([6] * 8, seed=3)]
+        peak = 1
+        while r.has_work():
+            r.step()
+            clock.advance(1.0)
+            sc.tick()
+            peak = max(peak, len(r.dispatchable()))
+        assert peak > 1 and sc._m_out.value - out0 >= 1
+        for _ in range(12):                     # the idle tail
+            r.step()
+            clock.advance(1.0)
+            sc.tick()
+        assert len(r.dispatchable()) == 1       # back to min_replicas
+        assert sc._m_in.value - in0 >= 1
+        assert all(q.done and q.finish_reason in ("eos", "length")
+                   for q in reqs)
+        assert sc._m_target.value == 1
+
+    def test_cooldown_gates_actions(self, gpt_setup):
+        """Two breach streaks inside one cooldown window yield ONE
+        scale-out; the second fires only after the window passes."""
+        cfg, params = gpt_setup
+        clock = _fake_clock()
+
+        def mk():
+            return ServingEngine(params, cfg, family="gpt",
+                                 num_slots=1, max_len=MAXLEN)
+        r = _router(params, cfg, replicas=1, num_slots=1, clock=clock)
+        sc = Autoscaler(r, spawn=mk, cfg=AutoscaleConfig(
+            min_replicas=1, max_replicas=4, breach_ticks=1,
+            idle_ticks=100, cooldown_s=10.0))
+        for p in _prompts([6] * 6, seed=4):
+            r.submit(p, 24)
+        r.step()
+        assert sc.tick() == "scale_out"         # first breach acts
+        for _ in range(5):                      # still inside cooldown
+            r.step()
+            clock.advance(1.0)
+            assert sc.tick() is None
+        clock.advance(10.0)                     # window passes
+        r.step()
+        assert sc.tick() == "scale_out"
+        r.drain()
+
+    def test_hysteresis_dead_band_never_acts(self, gpt_setup):
+        """Occupancy held BETWEEN the thresholds (0.25 < occ < 0.95)
+        resets both streaks — the controller must not flap."""
+        cfg, params = gpt_setup
+        clock = _fake_clock()
+        r = _router(params, cfg, replicas=2, num_slots=2, clock=clock)
+        sc = Autoscaler(r, spawn=lambda: None, cfg=AutoscaleConfig(
+            min_replicas=1, max_replicas=4, breach_ticks=1,
+            idle_ticks=1, cooldown_s=0.0))
+        out0, in0 = sc._m_out.value, sc._m_in.value
+        # 2 long-running requests over 4 slots -> occupancy 0.5
+        reqs = [r.submit(p, 24) for p in _prompts([5, 7], seed=5)]
+        for _ in range(6):
+            r.step()
+            clock.advance(1.0)
+            assert sc.tick() is None
+            assert 0.25 < sc.occupancy() < 0.95
+        assert sc._m_out.value == out0 and sc._m_in.value == in0
+        r.drain()
+        assert all(q.done for q in reqs)
+
+    def test_bounds_respected(self, gpt_setup):
+        """max_replicas caps a permanent flood; min_replicas floors a
+        permanent idle."""
+        cfg, params = gpt_setup
+        clock = _fake_clock()
+
+        def mk():
+            return ServingEngine(params, cfg, family="gpt",
+                                 num_slots=1, max_len=MAXLEN)
+        r = _router(params, cfg, replicas=1, num_slots=1, clock=clock)
+        sc = Autoscaler(r, spawn=mk, cfg=AutoscaleConfig(
+            min_replicas=1, max_replicas=2, breach_ticks=1,
+            idle_ticks=1, cooldown_s=0.0))
+        for p in _prompts([6] * 8, seed=6):
+            r.submit(p, 24)
+        for _ in range(6):
+            r.step()
+            clock.advance(1.0)
+            sc.tick()
+            assert len(r.dispatchable()) <= 2
+        r.drain()
+        for _ in range(6):                      # idle floor
+            r.step()
+            clock.advance(1.0)
+            sc.tick()
+        assert len(r.dispatchable()) == 1
+
+
+# ==========================================================================
+# graceful drain
+# ==========================================================================
+class TestGracefulDrain:
+    def test_drain_invariants(self, gpt_setup):
+        """A draining replica admits nothing, keeps serving what it
+        holds (migrate=False forces in-place finish), releases at its
+        first empty tick, and is NOT counted a death."""
+        cfg, params = gpt_setup
+        r = _router(params, cfg, replicas=2)
+        deaths0 = r._m_deaths.value
+        reqs = [r.submit(p, 12) for p in _prompts([5, 7, 9, 6], seed=7)]
+        r.step()
+        held = len(r.replicas[1].inner)
+        assert held > 0                         # JSQ spread the load
+        assert r.drain_replica(1, migrate=False) == 0
+        assert r.replicas[1].draining
+        # admits nothing: a new submit lands elsewhere or queues
+        extra = r.submit(_prompts([4], seed=8)[0], 8)
+        while not extra.done or r.has_work():
+            r.step()
+            assert extra.replica != 1 or extra.done
+            if not r.replicas[1].alive:
+                break
+        while r.has_work():
+            r.step()
+        assert not r.replicas[1].alive          # released when empty
+        assert not r.replicas[1].draining
+        assert r._m_deaths.value == deaths0     # a release, not a death
+        for q in reqs + [extra]:
+            assert q.done and q.finish_reason in ("eos", "length")
+
+    def test_drain_migrates_out_and_releases_fast(self, gpt_setup):
+        """With migration on, the drained replica empties immediately
+        and its streams continue bit-identically elsewhere."""
+        cfg, params = gpt_setup
+        base = ServingEngine(params, cfg, family="gpt", num_slots=4,
+                             max_len=MAXLEN)
+        prompts = _prompts([5, 9, 7], seed=9)
+        want = base.generate(prompts, 14)
+        r = _router(params, cfg, replicas=2)
+        reqs = [r.submit(p, 14) for p in prompts]
+        for _ in range(4):
+            r.step()
+        on_r0 = sum(1 for q in reqs if q.replica == 0 and not q.done)
+        assert on_r0 > 0
+        moved = r.drain_replica(0, migrate=True)
+        assert moved == on_r0                   # everything moved out
+        assert not r.replicas[0].inner
+        r.step()                                # release tick
+        assert not r.replicas[0].alive
+        while r.has_work():
+            r.step()
+        for q, w in zip(reqs, want):
+            np.testing.assert_array_equal(np.asarray(q.tokens), w)
+            assert q.requeues == 0              # migrated, not replayed
+
+
+# ==========================================================================
+# live migration bit-parity
+# ==========================================================================
+class TestLiveMigration:
+    @pytest.mark.parametrize("layout", ["dense", "paged", "spec", "tp"])
+    def test_kill_replica_migrates_bit_identical(self, gpt_setup,
+                                                 layout):
+        """kill_replica moves live mid-decode streams to the survivor
+        with zero re-prefilled tokens and bit-identical continuation,
+        across every engine layout."""
+        cfg, params = gpt_setup
+        kw = {}
+        meshes = None
+        if layout == "paged":
+            kw.update(kv_layout="paged", page_size=8)
+        elif layout == "spec":
+            kw.update(spec_decode="spec", gamma=2,
+                      draft_layers=cfg.num_layers)
+        elif layout == "tp":
+            devs = list(np.asarray(build_mesh({"tp": 8}).devices).flat)
+            meshes = [build_mesh({"tp": 2}, devices=devs[:2]),
+                      build_mesh({"tp": 2}, devices=devs[2:4])]
+        base = ServingEngine(params, cfg, family="gpt", num_slots=4,
+                             max_len=MAXLEN)
+        prompts = _prompts([5, 9, 7, 6], seed=11)
+        want = base.generate(prompts, 14)
+        # num_slots=4: the survivor must have capacity for the whole
+        # victim fleet, or the overflow correctly falls back to replay
+        r = _router(params, cfg, replicas=2, num_slots=4,
+                    meshes=meshes, **kw)
+        mig0 = r._m_mig.value
+        reqs = [r.submit(p, 14) for p in prompts]
+        # spec emits up to gamma+1 tokens/tick — kill while streams
+        # are still mid-decode
+        for _ in range(2 if layout == "spec" else 5):
+            r.step()
+        assert any(not q.done for q in reqs)    # something to migrate
+        victim = max(r.replicas,
+                     key=lambda rep: sum(1 for o in rep.inner.values()
+                                         if not o.done)).idx
+        replayed = r.kill_replica(victim)
+        assert replayed == 0                    # all snapshot-able
+        assert r._m_mig.value - mig0 > 0
+        while r.has_work():
+            r.step()
+        for q, w in zip(reqs, want):
+            np.testing.assert_array_equal(np.asarray(q.tokens), w)
+            assert q.requeues == 0              # ZERO re-prefill
+            assert q.done and q.finish_reason in ("eos", "length")
+
+    def test_zero_reprefill_observable(self, gpt_setup):
+        """The survivor engine never compiles a prefill for a migrated
+        request: its prefill trace cache stays EMPTY when migration is
+        its only traffic."""
+        cfg, params = gpt_setup
+        r = _router(params, cfg, replicas=2)
+        req = r.submit(_prompts([9], seed=12)[0], 14)
+        for _ in range(4):
+            r.step()
+        src = req.replica
+        dst = 1 - src
+        assert r.replicas[dst].eng._prefill._cache_size() == 0
+        assert r.kill_replica(src) == 0
+        assert req.replica == dst
+        while r.has_work():
+            r.step()
+        assert req.done and req.finish_reason in ("eos", "length")
+        # migrated stream decoded on dst without ANY prefill compile
+        assert r.replicas[dst].eng._prefill._cache_size() == 0
+        assert len(req.tokens) == 14 or req.finish_reason == "eos"
+
+    def test_sampled_stream_migrates_bit_identical(self, gpt_setup):
+        """Sampled (temperature/top_k) streams survive migration: the
+        snapshot carries the PRNG stream id, so the continuation draws
+        the same samples. Baseline is an UNDISTURBED router with the
+        same submission order — sampled streams fold the engine-local
+        request id, so they are reproducible per (replica, submission
+        order) but not router-vs-single-engine comparable (the router
+        docstring states this)."""
+        cfg, params = gpt_setup
+        prompts = _prompts([5, 8], seed=13)
+        rb = _router(params, cfg, replicas=2, max_top_k=8)
+        base = [rb.submit(p, 14, temperature=0.8, top_k=5)
+                for p in prompts]
+        while rb.has_work():                    # undisturbed baseline
+            rb.step()
+        r = _router(params, cfg, replicas=2, max_top_k=8)
+        reqs = [r.submit(p, 14, temperature=0.8, top_k=5)
+                for p in prompts]
+        for _ in range(4):
+            r.step()
+        mig0 = r._m_mig.value
+        victim = max(r.replicas,
+                     key=lambda rep: sum(1 for o in rep.inner.values()
+                                         if not o.done)).idx
+        assert r.kill_replica(victim) == 0
+        while r.has_work():
+            r.step()
+        assert r._m_mig.value - mig0 > 0        # something moved live
+        for q, w in zip(reqs, base):
+            np.testing.assert_array_equal(np.asarray(q.tokens),
+                                          np.asarray(w.tokens))
+
+    def test_mid_prefill_falls_back_to_replay(self, gpt_setup):
+        """A request still mid-chunked-prefill has no snapshot — the
+        kill takes the requeue-replay fallback and the stream is STILL
+        bit-identical end to end (at-least-once delivery, exactly-once
+        terminal)."""
+        cfg, params = gpt_setup
+        base = ServingEngine(params, cfg, family="gpt", num_slots=4,
+                             max_len=MAXLEN, kv_layout="paged",
+                             page_size=8, prefill_chunk=4)
+        prompts = _prompts([13, 5], seed=14)
+        want = base.generate(prompts, 12)
+        r = _router(params, cfg, replicas=2, kv_layout="paged",
+                    page_size=8, prefill_chunk=4)
+        fb0 = r._m_mig_fb.value
+        reqs = [r.submit(p, 12) for p in prompts]
+        r.step()                                # len-13 prompt: chunked,
+        #                                         still mid-prefill
+        assert reqs[0]._inner._pf_next is not None   # really mid-prefill
+        r.kill_replica(reqs[0].replica)
+        assert r._m_mig_fb.value - fb0 >= 1     # the mid-prefill one
+        assert reqs[0].requeues == 1            # replay, not migration
+        while r.has_work():
+            r.step()
+        for q, w in zip(reqs, want):
+            np.testing.assert_array_equal(np.asarray(q.tokens), w)
+            assert q.done and q.finish_reason in ("eos", "length")
+
+    def test_migrate_raise_fault_falls_back(self, gpt_setup,
+                                            clean_faults):
+        """Injected mid-migration failure (migrate_raise through the
+        router fault hook): the kill falls back to replay for the
+        first attempt and the streams stay bit-identical."""
+        cfg, params = gpt_setup
+        base = ServingEngine(params, cfg, family="gpt", num_slots=4,
+                             max_len=MAXLEN)
+        prompts = _prompts([5, 7], seed=15)
+        want = base.generate(prompts, 12)
+        r = _router(params, cfg, replicas=2)
+        fb0 = r._m_mig_fb.value
+        reqs = [r.submit(p, 12) for p in prompts]
+        faults.install("migrate_raise@2,replica_preempt@3:0")
+        for _ in range(6):
+            r.step()
+        assert not r.replicas[0].alive          # preempted via hook
+        assert r._m_mig_fb.value - fb0 >= 1     # first migrate raised
+        while r.has_work():
+            r.step()
+        for q, w in zip(reqs, want):
+            np.testing.assert_array_equal(np.asarray(q.tokens), w)
+            assert q.done and q.finish_reason in ("eos", "length")
+
+
+# ==========================================================================
+# deadline re-scoping on the requeue path (satellite bugfix)
+# ==========================================================================
+class TestDeadlineRescope:
+    def test_requeue_carries_remaining_budget(self, gpt_setup):
+        """A request requeued after replica death redispatches with
+        its REMAINING wall budget, not the original deadline_s."""
+        cfg, params = gpt_setup
+        clock = _fake_clock()
+        r = _router(params, cfg, replicas=2, clock=clock)
+        req = r.submit(_prompts([5], seed=16)[0], 12, deadline_s=100.0)
+        r.step()
+        clock.advance(30.0)
+        r.kill_replica(req.replica, migrate=False)   # force replay
+        assert req.requeues == 1
+        r.step()                                     # redispatch
+        assert req._inner is not None
+        assert req._inner.deadline_s <= 70.0 + 1e-6
+        assert req._inner.deadline_s > 60.0
+        while r.has_work():
+            r.step()
+        assert req.done
+
+    def test_exhausted_budget_resolves_timeout(self, gpt_setup):
+        """A requeued request whose deadline already passed resolves
+        "timeout" at redispatch — it is NOT dispatched with a clamped
+        epsilon budget that burns a survivor prefill."""
+        cfg, params = gpt_setup
+        clock = _fake_clock()
+        r = _router(params, cfg, replicas=2, clock=clock)
+        req = r.submit(_prompts([5], seed=17)[0], 12, deadline_s=5.0)
+        r.step()
+        clock.advance(6.0)                           # budget gone
+        src = req.replica
+        r.kill_replica(src, migrate=False)
+        survivor = r.replicas[1 - src].eng
+        r.step()
+        assert req.done and req.finish_reason == "timeout"
+        assert req._inner is None                    # never redispatched
+        assert not survivor.has_work()
+        r.drain()
+
+    def test_tick_budget_rescopes_too(self, gpt_setup):
+        """deadline_ticks re-scopes by elapsed ROUTER ticks on the
+        same path."""
+        cfg, params = gpt_setup
+        r = _router(params, cfg, replicas=2)
+        req = r.submit(_prompts([5], seed=18)[0], 24, deadline_ticks=6)
+        for _ in range(3):
+            r.step()
+        r.kill_replica(req.replica, migrate=False)
+        r.step()
+        if req._inner is not None:
+            assert req._inner.deadline_ticks <= 3
+        while r.has_work():
+            r.step()
+        assert req.done and req.finish_reason == "timeout"
+
+
+# ==========================================================================
+# preemption tolerance (device loss on a tp-sharded engine)
+# ==========================================================================
+class TestPreemptGuard:
+    def test_device_loss_degrades_and_streams_survive(self, gpt_setup,
+                                                      clean_faults):
+        """The acceptance drill: lose 2 of 4 tp devices mid-decode;
+        the guard degrades tp via the planner, rebuilds on survivors,
+        live streams continue bit-identically, one pull per tick and
+        the decode trace ceiling hold post-rebuild."""
+        cfg, params = gpt_setup
+        base = ServingEngine(params, cfg, family="gpt", num_slots=3,
+                             max_len=MAXLEN)
+        prompts = _prompts([5, 9], seed=19)
+        want = base.generate(prompts, 16)
+        eng = ServingEngine(params, cfg, family="gpt", num_slots=3,
+                            max_len=MAXLEN, mesh=build_mesh({"tp": 4}))
+        reqs = [eng.submit(p, 16) for p in prompts]
+        guard = EnginePreemptGuard(eng, lease_timeout_s=5.0)
+        faults.install("replica_preempt@4:2")
+        rebuilt_tp = 0
+        pulls = None
+        post_ticks = 0
+        while eng.has_work():
+            eng.step()
+            if pulls is not None:
+                post_ticks += 1
+            tp = guard.poll()
+            if tp:
+                rebuilt_tp = tp
+                pulls = _count_pulls(eng)
+        assert rebuilt_tp in (1, 2)             # planner degraded tp
+        assert int(np.prod(list(eng.mesh.shape.values()))) == rebuilt_tp
+        assert pulls[0] == post_ticks           # ONE pull per tick
+        assert eng._decode._cache_size() <= 2   # trace ceiling holds
+        for q, w in zip(reqs, want):
+            np.testing.assert_array_equal(np.asarray(q.tokens), w)
+            assert q.done and q.finish_reason in ("eos", "length")
+
+    @pytest.mark.parametrize("layout", ["dense", "paged"])
+    def test_rebuild_on_mesh_direct(self, gpt_setup, layout):
+        """Engine-level rebuild: tp4 -> tp2 mid-decode migrates every
+        decodable stream in place (same Request objects), evicts only
+        mid-prefill ones, and keeps the trace ceiling."""
+        cfg, params = gpt_setup
+        kw = {}
+        if layout == "paged":
+            kw.update(kv_layout="paged", page_size=8, prefill_chunk=4)
+        base = ServingEngine(params, cfg, family="gpt", num_slots=3,
+                             max_len=MAXLEN, mesh=build_mesh({"tp": 2}),
+                             **kw)
+        prompts = _prompts([5, 9, 13], seed=20)
+        want = base.generate(prompts, 16)
+        mesh4 = build_mesh({"tp": 4})
+        eng = ServingEngine(params, cfg, family="gpt", num_slots=3,
+                            max_len=MAXLEN, mesh=mesh4, **kw)
+        reqs = [eng.submit(p, 16) for p in prompts]
+        for _ in range(6):
+            eng.step()
+        devs = list(np.asarray(mesh4.devices).flat)[:2]
+        n = eng.rebuild_on_mesh(build_mesh({"tp": 2}, devices=devs))
+        assert n >= 2
+        while eng.has_work():
+            eng.step()
+        assert eng._decode._cache_size() <= 2
+        survived = 0
+        for q, w in zip(reqs, want):
+            assert q.done
+            if q.finish_reason == "evicted":
+                continue                        # was mid-prefill
+            np.testing.assert_array_equal(np.asarray(q.tokens), w)
+            survived += 1
+        assert survived == n

@@ -23,9 +23,11 @@ _spec.loader.exec_module(drill)
 def test_quick_drill_all_green():
     """Every scenario of the quick serving chaos drill passes: under
     nan-logits, tick-stall, raise-mid-prefill, raise-mid-decode, queue
-    flood (both policies) and cancel/deadline, every submitted request
-    reaches exactly one terminal finish_reason and surviving streams
-    are bit-identical to the fault-free run."""
+    flood (both policies), cancel/deadline, and the PR-17 fleet
+    scenarios (autoscale flood→idle, live KV migration with zero
+    re-prefill, tp device loss under the preempt guard), every
+    submitted request reaches exactly one terminal finish_reason and
+    surviving streams are bit-identical to the fault-free run."""
     assert drill.run_drill(quick=True) == 0
 
 

@@ -462,6 +462,24 @@ class TestFaultPlan:
         assert kinds == [("kill", 3, 1), ("crash_shard", 5, 2),
                          ("nan", 7, 4)]
 
+    def test_parse_router_kinds(self):
+        """PR-17 fleet kinds: replica_preempt carries its :R verbatim
+        (replica index at the router, device count at the preempt
+        guard — :0 is a legal replica index), migrate_raise has no
+        arg."""
+        plan = faults.FaultPlan("replica_preempt@4:0, migrate_raise@2")
+        kinds = [(f.kind, f.step, f.arg) for f in plan.faults]
+        assert kinds == [("replica_preempt", 4, 0),
+                         ("migrate_raise", 2, 1)]
+        assert plan.on_router_tick(1) == {}     # nothing due yet
+        assert plan.on_router_tick(2) == {"raise_migrate": True}
+        assert plan.on_router_tick(4) == {"replica_preempt": 0}
+        assert plan.on_router_tick(4) == {}     # once-markers consumed
+        # aimed at the ENGINE hook instead, migrate_raise maps to the
+        # same raise_migrate action (shared once-marker either way)
+        plan2 = faults.FaultPlan("migrate_raise@2")
+        assert plan2.on_serving_tick(2) == {"raise_migrate": True}
+
     def test_bad_spec_rejected(self):
         with pytest.raises(ValueError, match="unknown fault kind"):
             faults.FaultPlan("explode@3")

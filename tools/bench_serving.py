@@ -28,6 +28,7 @@ Usage:
   python tools/bench_serving.py --quant        # weight-only int8 A/B
   python tools/bench_serving.py --tp 2         # tp-sharded decode parity
   python tools/bench_serving.py --router 2     # replicated-engine router
+  python tools/bench_serving.py --autoscale-overhead  # control-loop A/B
   PADDLE_TPU_TELEMETRY_JSONL=serve.jsonl python tools/bench_serving.py
 
 --tp N shards the decode tick over an N-way virtual-CPU build_mesh
@@ -791,6 +792,94 @@ def telemetry_main(args):
     return 0 if mismatch == 0 and parseable else 1
 
 
+def autoscale_main(args):
+    """--autoscale-overhead: the same router workload with the
+    Autoscaler's control loop OFF vs ON (inference/autoscale.py —
+    ticked once per router step, bounds pinned min==max so the loop
+    PRICES its steady state: occupancy + burn arithmetic every tick,
+    zero scale actions). Timed passes ALTERNATE between the two warm
+    fleets and each side reports its best (the PR-5 paired best-of-N
+    methodology). One JSON line — the BASELINE.md "Serving control
+    loop" row; the acceptance bar is < 5% overhead."""
+    from paddle_tpu.models.decode import next_pow2
+    from paddle_tpu.inference.router import create_router
+    from paddle_tpu.inference.autoscale import (AutoscaleConfig,
+                                                Autoscaler)
+
+    gen = args.gen
+    max_len = args.max_len or next_pow2(args.prompt_hi + gen)
+    params, cfg = _build_family(args, max_len)
+    prompts = build_workload(args.requests, args.prompt_lo,
+                             args.prompt_hi, args.vocab)
+    total = args.requests * gen
+    replicas = 2
+    _log(f"autoscale A/B: {args.requests} reqs, gen {gen}, "
+         f"{args.family} {args.layers}Lx{args.hidden}d, "
+         f"{replicas} replicas x {args.slots} slots")
+
+    def build(with_scaler):
+        # concurrent=False: both sides run the same single-threaded
+        # step loop, so the A/B isolates the scaler arithmetic
+        router = create_router(params, cfg, replicas=replicas,
+                               family=args.family, num_slots=args.slots,
+                               max_len=max_len, concurrent=False)
+        scaler = None
+        if with_scaler:
+            scaler = Autoscaler(
+                router, spawn=lambda: (_ for _ in ()).throw(
+                    AssertionError("steady-state bench must not spawn")),
+                cfg=AutoscaleConfig(min_replicas=replicas,
+                                    max_replicas=replicas))
+        return router, scaler
+
+    def run(router, scaler):
+        reqs = [router.submit(p, gen) for p in prompts]
+        while router.has_work():
+            router.step()
+            if scaler is not None:
+                scaler.tick()
+        return [np.asarray(r.tokens, np.int32) for r in reqs]
+
+    r_off, _none = build(False)
+    r_on, scaler = build(True)
+    warm_off = run(r_off, None)                  # compile everything
+    warm_on = run(r_on, scaler)
+    mismatch = sum(1 for a, b in zip(warm_off, warm_on)
+                   if not np.array_equal(a, b))
+    best_off = best_on = 1e18
+    repeats = 3
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        outs = run(r_off, None)
+        best_off = min(best_off, time.perf_counter() - t0)
+        mismatch += sum(1 for a, b in zip(warm_off, outs)
+                        if not np.array_equal(a, b))
+        t0 = time.perf_counter()
+        outs = run(r_on, scaler)
+        best_on = min(best_on, time.perf_counter() - t0)
+        mismatch += sum(1 for a, b in zip(warm_off, outs)
+                        if not np.array_equal(a, b))
+    tps_off, tps_on = total / best_off, total / best_on
+    overhead = (tps_off - tps_on) / tps_off * 100.0
+    st = r_on.stats()
+    print(json.dumps({
+        "metric": "serving_autoscale_overhead",
+        "value": round(overhead, 2),
+        "unit": "%",
+        "backend": jax.devices()[0].platform,
+        "tokens_per_sec_autoscale_off": round(tps_off, 1),
+        "tokens_per_sec_autoscale_on": round(tps_on, 1),
+        "requests": args.requests, "gen": gen, "slots": args.slots,
+        "replicas": replicas, "repeats": repeats,
+        "model": f"{args.layers}Lx{args.hidden}d",
+        "family": args.family,
+        "replicas_live": st["replicas_live"],
+        "scale_actions": 0,          # min==max pins the fleet by design
+        "stream_mismatches": mismatch,
+    }), flush=True)
+    return 0 if mismatch == 0 else 1
+
+
 def router_main(args):
     """--router R: aggregate tokens/s through the replicated-engine
     router (inference/router.py) vs ONE engine at the same per-replica
@@ -935,6 +1024,10 @@ def main():
     ap.add_argument("--telemetry-overhead", action="store_true",
                     help="A/B in-tick telemetry off vs on (paired "
                          "best-of-3, bit-parity checked)")
+    ap.add_argument("--autoscale-overhead", action="store_true",
+                    help="A/B the Autoscaler control loop off vs on "
+                         "over a 2-replica router (steady state, "
+                         "paired best-of-3, bit-parity checked)")
     args = ap.parse_args()
     if args.tp and args.tp != _TP:
         ap.error("--tp was read pre-init for the CPU pin; don't "
@@ -949,6 +1042,8 @@ def main():
         args.requests = 16
     if args.telemetry_overhead:
         return telemetry_main(args)
+    if args.autoscale_overhead:
+        return autoscale_main(args)
     if args.capacity:
         return capacity_main(args)
     if args.chunk_slo:

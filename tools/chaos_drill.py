@@ -34,11 +34,13 @@ Usage:
   python tools/chaos_drill.py --quick          # representative phases
   python tools/chaos_drill.py --full           # kill/crash at EVERY step
   python tools/chaos_drill.py --elastic        # device-loss scenarios
+  python tools/chaos_drill.py --serving        # serving chaos drill
+                                               # (chaos_serving --quick)
   python tools/chaos_drill.py --bench          # save/verify overhead JSON
   python tools/chaos_drill.py --gate [T1LOG]   # pre-commit robustness
-                                               # gate: quick+elastic
-                                               # drill green AND
-                                               # diff_failures clean
+                                               # gate: quick+elastic+
+                                               # serving drills green
+                                               # AND diff_failures clean
 (The launcher re-enters this file with --worker; not for direct use.)
 """
 from __future__ import annotations
@@ -637,10 +639,28 @@ def run_elastic_drill(steps: int = 10, keep_logs: bool = False) -> int:
 
 
 # =============================================================== gate mode
+def run_serving_drill(keep_logs: bool = False) -> int:
+    """The serving leg: tools/chaos_serving.py --quick in a fresh
+    subprocess (it pins its own CPU device count before jax init, so
+    it cannot share this process's backend)."""
+    cmd = [sys.executable, os.path.join(REPO, "tools",
+                                        "chaos_serving.py"), "--quick"]
+    if keep_logs:
+        cmd.append("--keep")
+    t0 = time.time()
+    res = subprocess.run(cmd, cwd=REPO, timeout=2400)
+    tag = "ok" if res.returncode == 0 else "FAIL"
+    print(f"[drill] serving_quick          {tag}  "
+          f"({time.time() - t0:.1f}s)", flush=True)
+    return res.returncode
+
+
 def gate_main(steps: int, elastic_steps: int, tier1_log: str,
               keep_logs: bool = False) -> int:
     """The pre-commit robustness gate (CLAUDE.md testing section): ONE
     exit code = quick drill green AND elastic drill green AND the
+    serving chaos drill green (chaos_serving.py --quick — autoscale/
+    live-migration/device-loss scenarios included) AND the
     HLO-audit regression gate green (tools/audit_gate.py vs
     perf/audit_baseline.json — no new resharding) AND
     tools/diff_failures.py clean against the stored tier-1 baseline
@@ -652,6 +672,10 @@ def gate_main(steps: int, elastic_steps: int, tier1_log: str,
     rc = run_elastic_drill(elastic_steps, keep_logs=keep_logs)
     if rc != 0:
         print("[gate] elastic drill FAILED", flush=True)
+        return rc
+    rc = run_serving_drill(keep_logs=keep_logs)
+    if rc != 0:
+        print("[gate] serving drill FAILED", flush=True)
         return rc
     res = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "audit_gate.py")],
@@ -750,8 +774,11 @@ def main() -> int:
                          "(ISSUE 14); composes with --quick")
     ap.add_argument("--gate", action="store_true",
                     help="pre-commit robustness gate: quick + elastic "
-                         "drills AND tools/diff_failures.py vs the "
-                         "stored tier-1 baseline, one exit code")
+                         "+ serving drills AND tools/diff_failures.py "
+                         "vs the stored tier-1 baseline, one exit code")
+    ap.add_argument("--serving", action="store_true",
+                    help="serving chaos drill only "
+                         "(chaos_serving.py --quick subprocess)")
     ap.add_argument("--tier1-log", default="/tmp/_t1.log",
                     help="tier-1 pytest log for the --gate "
                          "diff_failures leg")
@@ -768,6 +795,8 @@ def main() -> int:
     if args.gate:
         return gate_main(args.steps, args.elastic_steps,
                          args.tier1_log, keep_logs=args.keep_logs)
+    if args.serving:
+        return run_serving_drill(keep_logs=args.keep_logs)
     if args.elastic:
         rc = 0
         if args.quick or args.full:

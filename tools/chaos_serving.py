@@ -61,6 +61,25 @@ docs/serving.md "Sharded serving & routing"):
                    once resolution), the survivor holds its trace
                    ceilings, and the death leaves a flight dump
 
+Fleet scenarios (autoscaling + live migration + preemption tolerance,
+inference/autoscale.py; docs/serving.md "Autoscaling & live
+migration"):
+  autoscale_flood  a request flood on a 1-replica fleet under the
+                   Autoscaler -> replicas scale out toward max, then
+                   drain back to min when idle; every request resolves
+                   exactly once, streams bit-identical, scale
+                   decisions leave parseable flight dumps
+  live_migration   kill a paged-KV replica mid-decode with migration
+                   ON -> every live stream moves through a host KV
+                   snapshot (ZERO re-prefill: the survivor's prefill
+                   trace count does not move), zero replays, streams
+                   bit-identical to the fault-free run
+  serving_device_loss a tp=2 engine under EnginePreemptGuard loses a
+                   device (replica_preempt fault) -> tp degrades via
+                   the planner, the engine rebuilds on the survivor
+                   mesh with live streams migrated in place, streams
+                   stay bit-identical and the trace ceilings hold
+
 Paged-KV scenarios (the block-pool layout, docs/serving.md "Paged KV
 cache"):
   paged_pool_flood more demand than pages -> later requests WAIT for
@@ -104,8 +123,10 @@ if REPO not in sys.path:
 
 # CPU unconditionally: the axon tunnel flaps and ANY backend init then
 # hangs (CLAUDE.md trap); the drill's assertions are platform-free.
+# 4 virtual devices: serving_device_loss needs a tp-sharded mesh to
+# preempt; the single-engine scenarios just run on device 0.
 from paddle_tpu.device import pin_cpu            # noqa: E402
-pin_cpu(1)
+pin_cpu(4)
 
 import numpy as np                               # noqa: E402
 import jax                                       # noqa: E402
@@ -673,6 +694,146 @@ def run_drill(quick: bool = False, keep_root: bool = False) -> int:
             return f"deadline request finished {reqs[1].finish_reason!r}"
         return None
     scenario("cancel_deadline", cancel_deadline, want_flight=False)
+
+    # --- autoscaler: flood scales out, idle drains back to min -------
+    def autoscale_flood():
+        from paddle_tpu.inference.autoscale import (AutoscaleConfig,
+                                                    Autoscaler)
+        t = [0.0]
+        router = make_router(params, cfg, max_len, replicas=1,
+                             family="gpt", num_slots=2,
+                             concurrent=False, clock=lambda: t[0])
+        scaler = Autoscaler(
+            router, spawn=lambda: make_engine(params, cfg, max_len,
+                                              num_slots=2),
+            cfg=AutoscaleConfig(min_replicas=1, max_replicas=3,
+                                breach_ticks=2, idle_ticks=3,
+                                cooldown_s=1.0),
+            clock=lambda: t[0])
+        reqs = [router.submit(p, gen) for p in prompts]
+        peak = 1
+        for _ in range(200):
+            if not router.has_work():
+                break
+            router.step()
+            t[0] += 2.0
+            scaler.tick()
+            peak = max(peak, len(router.dispatchable()))
+        if router.has_work():
+            return "flood never drained"
+        if peak < 2:
+            return f"flood never scaled out (peak {peak})"
+        for _ in range(30):                  # idle: drain back to min
+            if len(router.dispatchable()) == 1:
+                break
+            router.step()
+            t[0] += 2.0
+            scaler.tick()
+        if len(router.dispatchable()) != 1:
+            return (f"idle fleet never scaled back to min "
+                    f"({len(router.dispatchable())} dispatchable)")
+        err = (check_terminal(reqs) or check_streams(reqs, baseline))
+        if err:
+            return err
+        if any(r.finish_reason not in ("length", "eos") for r in reqs):
+            return ("scaling was not transparent: "
+                    f"{[r.finish_reason for r in reqs]}")
+        for rep in router.replicas:
+            if rep.alive:
+                err = check_traces(rep.eng)
+                if err:
+                    return err
+        fdir = os.path.join(root, "autoscale_flood", "flight")
+        return (check_flight(fdir, want_reason="autoscale_scale_out")
+                or check_flight(fdir, want_reason="autoscale_scale_in"))
+    scenario("autoscale_flood", autoscale_flood, want_flight=False)
+
+    # --- live migration: replica death moves streams, ZERO re-prefill
+    def live_migration():
+        mig0 = monitor.counter("serving.autoscale.migrations").value
+        fb0 = monitor.counter(
+            "serving.autoscale.migrate_fallbacks").value
+        router = make_router(params, cfg, max_len, replicas=2,
+                             family="gpt", num_slots=6,
+                             concurrent=False, kv_layout="paged",
+                             page_size=8)
+        reqs = [router.submit(p, gen) for p in prompts]
+        for _ in range(3):
+            router.step()                 # streams mid-decode on BOTH
+        victim = max(router.replicas,
+                     key=lambda rep: sum(1 for o in rep.inner.values()
+                                         if not o.done)).idx
+        live = sum(1 for o in router.replicas[victim].inner.values()
+                   if not o.done)
+        if live == 0:
+            return "nothing live on the victim (drill too short)"
+        survivor = router.replicas[1 - victim].eng
+        pre_prefills = survivor.trace_counts()[1]
+        replayed = router.kill_replica(victim)
+        if replayed != 0:
+            return (f"{replayed} requests fell back to replay "
+                    "(every stream should migrate)")
+        moved = (monitor.counter("serving.autoscale.migrations").value
+                 - mig0)
+        if moved < live:
+            return f"only {moved}/{live} live streams migrated"
+        if monitor.counter(
+                "serving.autoscale.migrate_fallbacks").value != fb0:
+            return "migrate_fallbacks moved on the migration-only path"
+        router.drain()
+        # THE migration claim: zero re-prefilled tokens — the survivor
+        # ran no prefill for the adopted streams (its prefill trace
+        # count is unchanged), and no request records a requeue
+        if survivor.trace_counts()[1] != pre_prefills:
+            return (f"survivor re-prefilled: {pre_prefills} -> "
+                    f"{survivor.trace_counts()[1]} prefill traces")
+        if any(r.requeues for r in reqs):
+            return "a migrated stream recorded a requeue (replay path)"
+        err = (check_terminal(reqs) or check_streams(reqs, baseline)
+               or check_traces(survivor))
+        if err:
+            return err
+        if any(r.finish_reason not in ("length", "eos") for r in reqs):
+            return ("migration was not transparent: "
+                    f"{[r.finish_reason for r in reqs]}")
+        fdir = os.path.join(root, "live_migration", "flight")
+        return check_flight(fdir, want_reason="router_replica_death")
+    scenario("live_migration", live_migration, want_flight=False)
+
+    # --- device loss: tp degrade + in-place stream migration ---------
+    def device_loss():
+        from paddle_tpu.inference.autoscale import EnginePreemptGuard
+        from paddle_tpu.parallel.mesh import build_mesh
+        devs = jax.devices()
+        if len(devs) < 2:
+            return f"need >= 2 devices for a tp mesh, got {len(devs)}"
+        mesh = build_mesh({"tp": 2}, devices=devs[:2])
+        eng = make_engine(params, cfg, max_len, num_slots=4, mesh=mesh)
+        guard = EnginePreemptGuard(eng, lease_timeout_s=0.05)
+        reqs = [eng.submit(p, gen) for p in prompts]
+        new_tp = 0
+        for _ in range(200):
+            if not eng.has_work():
+                break
+            eng.step()
+            new_tp = max(new_tp, guard.poll())
+        if eng.has_work():
+            return "engine never drained after the preemption"
+        if new_tp != 1:
+            return f"guard never degraded tp (poll() -> {new_tp})"
+        if int(np.prod(eng.mesh.devices.shape)) != 1:
+            return f"engine not rebuilt on the survivor mesh: {eng.mesh}"
+        err = (check_terminal(reqs) or check_streams(reqs, baseline)
+               or check_traces(eng))
+        if err:
+            return err
+        if any(r.finish_reason not in ("length", "eos") for r in reqs):
+            return ("preemption was not transparent: "
+                    f"{[r.finish_reason for r in reqs]}")
+        fdir = os.path.join(root, "serving_device_loss", "flight")
+        return check_flight(fdir, want_reason="serving_preempt")
+    scenario("serving_device_loss", device_loss,
+             spec="replica_preempt@3:1", want_flight=False)
 
     rec.clear()          # don't leak scenario records into the caller's
     #                      process-global ring (in-process test usage)

@@ -260,7 +260,10 @@ def summarize(path: str, samples_per_step: Optional[float] = None) -> dict:
                        "serving.quant_weights_bytes",
                        "serving.fp_weights_bytes",
                        "serving.router.replicas_live",
-                       "serving.router.pending")
+                       "serving.router.pending",
+                       "serving.autoscale.replicas_target",
+                       "serving.autoscale.occupancy",
+                       "serving.autoscale.migrated_pages_bytes")
 
     def _is_gauge(k):
         # per-replica queue-depth gauges carry a dynamic suffix
@@ -321,6 +324,15 @@ def summarize(path: str, samples_per_step: Optional[float] = None) -> dict:
                                 if k.startswith("router.")]}
             if any(router.values()):
                 srv["router"] = router
+            # the serving control loop (inference/autoscale.py +
+            # router migration counters, serving.autoscale.*):
+            # scale_out/scale_in/migrations/preemptions deltas, the
+            # replicas_target/occupancy/migrated_pages_bytes gauges
+            auto = {k[len("autoscale."):]: srv.pop(k)
+                    for k in [k for k in srv
+                              if k.startswith("autoscale.")]}
+            if any(auto.values()):
+                srv["autoscale"] = auto
             out["serving"] = srv
 
     # ---- serving SLO percentiles (ServingEngine.export_slo_jsonl
