@@ -236,6 +236,23 @@ def run_measurement(rung: str) -> None:
             float(loss)        # forces the whole chained sequence
             dt = min(dt, (time.perf_counter() - t0) / warm_iters)
         n_params = sum(int(v.size) for v in params.values())
+        # compiled peak HBM for the JSON stamp (profiler/mem_audit):
+        # an AOT lower of the already-traced step — reads XLA's memory
+        # accounting, never dispatches. Best-effort: backends that
+        # don't report (or wrappers without .lower) stamp null.
+        peak_hbm = None
+        try:
+            from paddle_tpu.profiler.mem_audit import \
+                compiled_memory_stats
+            lower = getattr(step, "lower", None)
+            if callable(lower):
+                args = (params, opt_state, tokens)
+                if tele is not None:
+                    args += (tstate,)
+                peak_hbm = compiled_memory_stats(
+                    lower(*args).compile()).get("peak_bytes")
+        except Exception as e:   # the stamp must never kill the rung
+            _log(f"  peak-HBM stamp failed: {e}")
         if tele is not None:
             tele.close(tstate)
             try:
@@ -247,7 +264,7 @@ def run_measurement(rung: str) -> None:
             except Exception as e:   # report failure must not kill the rung
                 _log(f"telemetry report failed: {e}")
         del params, opt_state
-        return dt, n_params
+        return dt, n_params, peak_hbm
 
     # variant race: the rung's OWN config is the baseline; TPU remat
     # rungs additionally race the round-4 candidates (attention impls x
@@ -302,7 +319,7 @@ def run_measurement(rung: str) -> None:
         variants.append((dict(remat_policy="all_but_mlp"), 12, splash))
         variants.append((dict(), 16, pallas))
 
-    def emit(dt, cfg, n_params, vkw, vbatch):
+    def emit(dt, cfg, n_params, vkw, vbatch, peak_hbm=None):
         tps = vbatch * seq / dt
         flops_per_token = train_flops_per_token(
             n_params, cfg.num_layers, cfg.hidden_size, seq)
@@ -322,6 +339,10 @@ def run_measurement(rung: str) -> None:
             "variant": (vkw or "default"),
             "batch": vbatch,
             "ms_per_step": round(dt * 1e3, 2),
+            # XLA's compiled peak HBM for the winning executable
+            # (profiler/mem_audit) — the BENCH_* history tracks memory
+            # alongside ms/step, and tools/mem_gate.py pins regressions
+            "compiled_peak_hbm_bytes": peak_hbm,
         }), flush=True)
 
     best = None
@@ -336,7 +357,7 @@ def run_measurement(rung: str) -> None:
         prior_env = {k: os.environ.get(k) for k in venv}
         os.environ.update(venv)
         try:
-            dt, n_params = measure(cfg, iters, vbatch)
+            dt, n_params, peak_hbm = measure(cfg, iters, vbatch)
         except Exception as e:
             oom = "RESOURCE_EXHAUSTED" in str(e)
             _log(f"  variant failed: {type(e).__name__}: {e}")
@@ -357,11 +378,11 @@ def run_measurement(rung: str) -> None:
              f"({vbatch * seq / dt:.0f} tok/s)")
         # throughput decides (variants race at different batches)
         if best is None or vbatch * seq / dt > best[4] * seq / best[0]:
-            best = (dt, cfg, n_params, vkw, vbatch)
+            best = (dt, cfg, n_params, vkw, vbatch, peak_hbm)
             emit(*best)
     if best is None:
         raise RuntimeError("every bench variant failed")
-    dt, cfg, n_params, vkw, vbatch = best
+    dt, cfg, n_params, vkw, vbatch, peak_hbm = best
     _log(f"winner: {vkw or 'rung default'} at {dt * 1e3:.1f} ms/step, "
          f"B={vbatch}")
 

@@ -34,6 +34,15 @@ planner's breakdown — and `train_flops_per_token` lives HERE as the
 one home of the 6N MFU accounting (bench.py re-exports it; the
 profiler/telemetry `train.mfu` gauge and tools/train_attrib.py price
 against it).
+
+Memory ledgers (`train_memory_ledger` / `serving_memory_ledger`): the
+HBM half of the same attribution stack — per-chip bytes attributed to
+named components (train: the f32 master state, remat activation
+working set, logits chunk, overlap prefetch buffers; serving: weights
+incl. quantized pairs, the KV pool, decode scratch). These are the ONE
+home of the planner's memory gates (parallel/planner._estimate and
+plan_serving_tp consume them) and the analytical side
+profiler/mem_audit diffs against XLA's `compiled.memory_analysis()`.
 """
 from __future__ import annotations
 
@@ -520,6 +529,159 @@ def train_step_ledger(cfg, family: str = "gpt", plan=None,
                    "n_devices": n_devices, "global_batch": global_batch,
                    "seq": S, "remat": policy, "amp": bool(amp),
                    "dtype_bytes": dtype_bytes, "n_params": n_params}}
+
+
+# --------------------------------------------------------------------
+# memory ledgers (profiler/mem_audit.py's analytical half)
+# --------------------------------------------------------------------
+def train_memory_ledger(cfg, plan=None, global_batch: int = 8,
+                        seq: int = 0) -> dict:
+    """Per-chip HBM bytes for ONE planned train step, attributed to
+    named components.
+
+    THE one home of the planner's HBM model: parallel/planner._estimate
+    consumes `total` for its mem_bytes/fits gate (the cross-check test
+    pins the equality), and profiler/mem_audit diffs the same total
+    against XLA's compiled accounting (`compiled.memory_analysis()`) so
+    estimate drift becomes a named finding instead of a silent mis-gate.
+    Components:
+
+    - params / grads / adam_m / adam_v: the f32 master state, each
+      4 bytes/elem over this chip's tp×pp×fsdp param shard (the
+      planner's `state_bytes = shard_params*16`, split four ways);
+    - activations: the remat residual / activation working set —
+      _ACT_BUFFERS[policy] residual-sized buffers per local layer
+      (L/pp), sharded over tp under sequence parallelism;
+    - logits: the f32 logits working set, vocab-parallel over tp and
+      divided by the microbatch count (pp runs one microbatch's head
+      at a time);
+    - overlap_prefetch: plan.overlap's double-buffered ZeRO-3 gather
+      holds two gathered layers' worth of bf16 weights in flight
+      (zero when overlap is off or fsdp == 1 — the buffer only exists
+      when there is a gather to hide).
+
+    `cfg` is a model config or a planner.ModelSpec; `plan` anything
+    _plan_degrees takes. `seq` defaults to the spec's sequence length
+    (what _estimate prices)."""
+    from .parallel.planner import _ACT_BUFFERS, _coerce_spec
+    spec = _coerce_spec(cfg)
+    deg = _plan_degrees(plan)
+    dp, fsdp, tp, pp = deg["dp"], deg["fsdp"], deg["tp"], deg["pp"]
+    # the plan's OWN microbatch count when it carries one (enumerate_
+    # plans clamps mb to the local batch, possibly down to 1 — the
+    # ledger must price the same logits chunk _estimate always did,
+    # not _plan_degrees' 2·pp fallback for count-less dict plans)
+    raw_mb = int(getattr(plan, "microbatches", 0) or 0) \
+        if plan is not None else 0
+    mb = raw_mb if raw_mb >= 1 else deg["mb"]
+    L, D = spec.num_layers, spec.hidden_size
+    V = spec.vocab_size
+    S = int(seq or spec.seq_len)
+    b_local = max(int(global_batch) // (dp * fsdp), 1)
+    tok_local = b_local * S
+    abytes = spec.act_bytes_per_elem
+    shard_params = spec.total_params / (tp * pp * fsdp)
+    state_each = shard_params * 4.0              # f32, one of p/g/m/v
+    seq_shard = tp if (spec.sequence_parallel and tp > 1) else 1
+    act_bytes = (_ACT_BUFFERS.get(spec.remat_policy, 2.0)
+                 * (L / pp) * tok_local * D * abytes / seq_shard)
+    logit_bytes = tok_local * V * 4.0 / tp / max(mb, 1)
+    prefetch = (2.0 * (spec.block_params / L) * abytes
+                if deg.get("overlap") and fsdp > 1 else 0.0)
+    components = {
+        "params": state_each, "grads": state_each,
+        "adam_m": state_each, "adam_v": state_each,
+        "activations": act_bytes, "logits": logit_bytes,
+        "overlap_prefetch": prefetch,
+    }
+    # summed in the planner's historical order (state first) so the
+    # non-overlap total is bit-identical to the pre-ledger _estimate
+    total = state_each * 4.0 + act_bytes + logit_bytes + prefetch
+    return {"components": components, "total": total,
+            "config": {"plan": dict(deg, mb=mb),
+                       "n_devices": dp * fsdp * tp * pp,
+                       "global_batch": int(global_batch), "seq": S,
+                       "remat": spec.remat_policy,
+                       "act_bytes_per_elem": abytes,
+                       "n_params": spec.total_params}}
+
+
+def serving_memory_ledger(cfg, family: str = "gpt",
+                          layout: str = "dense", quant: str = "off",
+                          num_slots: int = 8, max_len: int = 0,
+                          page_size: int = 16, num_pages: int = 0,
+                          cache_bytes_per_elem: int = 2,
+                          dtype_bytes: int = 0, tp: int = 1) -> dict:
+    """Per-chip HBM bytes for a serving-engine configuration,
+    attributed to named components — the serving sibling of
+    train_memory_ledger and the formula home for
+    parallel/planner.plan_serving_tp's memory gate (its dense-fp
+    envelope is exactly `weights + kv_pool` here; the cross-check test
+    pins it). Components:
+
+    - weights: the fp parameter payload (every param for quant="off";
+      just the embeddings for "int8" — the block matmul leaves and the
+      tied LM head move to the quantized pair below, `wte` stays fp
+      for the gather — quantization/serving.py);
+    - weights_quant / weights_quant_scales: the int8 payloads
+      (L stacked layers + the transposed head copy) and their f32
+      per-output-channel scales — the "quantized pairs";
+    - kv_pool: dense — k+v for every slot at full max_len; paged —
+      the page pool ([L, num_pages, page_size] k+v, engine default
+      num_slots*max_pages + 1 pages) plus the i32 page table;
+    - decode_scratch: the per-tick working set — f32 logits for every
+      scored row plus the hidden/residual activations.
+
+    Sharding: weights and the KV pool shard over `tp` (head-sharded
+    attention, vocab/ffn-sharded matmuls) — `total` is per chip,
+    `unsharded` the tp=1 envelope. `dtype_bytes` is the serving
+    compute dtype width (default: the cfg dtype via jnp_dtype_bytes)."""
+    dims = _family_dims(cfg, family)
+    if layout not in ("dense", "paged"):
+        raise ValueError(f"layout {layout!r} (dense|paged)")
+    if quant not in ("off", "int8"):
+        raise ValueError(f"quant {quant!r} (off|int8)")
+    D, L, V, KV, hd = (dims["D"], dims["L"], dims["V"], dims["KV"],
+                       dims["hd"])
+    embed_seq = int(getattr(cfg, "max_seq_len", 0)
+                    or getattr(cfg, "seq_len", 0) or max_len)
+    max_len = int(max_len or embed_seq)
+    if not dtype_bytes:
+        dtype_bytes = jnp_dtype_bytes(getattr(cfg, "dtype", None))
+    n_params = dims["layer_params"] * L + (V + embed_seq) * D
+    embed_params = (V + embed_seq) * D
+    if quant == "int8":
+        weights = float(embed_params * dtype_bytes)
+        w_quant = float(dims["layer_params"] * L + D * V)
+        w_scales = 4.0 * (dims["layer_out_features"] * L + V)
+    else:
+        weights = float(n_params * dtype_bytes)
+        w_quant = w_scales = 0.0
+    max_pages = -(-max_len // page_size)
+    if layout == "paged":
+        n_pages = int(num_pages or num_slots * max_pages + 1)
+        kv_pool = (2.0 * L * n_pages * page_size * KV * hd
+                   * cache_bytes_per_elem
+                   + 4.0 * num_slots * max_pages)      # i32 page table
+    else:
+        n_pages = 0
+        kv_pool = (2.0 * L * num_slots * max_len * KV * hd
+                   * cache_bytes_per_elem)
+    scratch = num_slots * (V * 4.0 + 2.0 * D * dtype_bytes)
+    components = {"weights": weights, "weights_quant": w_quant,
+                  "weights_quant_scales": w_scales, "kv_pool": kv_pool,
+                  "decode_scratch": scratch}
+    unsharded = sum(components.values())
+    tp = max(int(tp), 1)
+    return {"components": {k: v / tp for k, v in components.items()},
+            "total": unsharded / tp, "unsharded": unsharded,
+            "config": {"family": family, "layout": layout,
+                       "quant": quant, "num_slots": int(num_slots),
+                       "max_len": max_len, "page_size": int(page_size),
+                       "num_pages": n_pages, "tp": tp,
+                       "cache_bytes_per_elem": cache_bytes_per_elem,
+                       "dtype_bytes": dtype_bytes,
+                       "n_params": n_params}}
 
 
 def jnp_dtype_bytes(dtype, default: int = 4) -> int:

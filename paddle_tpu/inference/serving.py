@@ -985,6 +985,17 @@ class ServingEngine:
         self._m_shared = monitor.gauge("serving.pages_shared")
         self._m_cow = monitor.counter("serving.cow_copies")
         self._m_chunks = monitor.counter("serving.prefill_chunks")
+        # kv-pool HBM in bytes, next to pages_in_use: dense = the full
+        # preallocated cache (constant, set once); paged = pages_in_use
+        # x per-page bytes, republished with the page gauges
+        self._m_kv_bytes = monitor.gauge("serving.kv_pool_bytes")
+        self._m_oom = monitor.counter("serving.oom_forensics")
+        _kb = self._cache["k"]
+        if self.paged:
+            self._page_bytes = 2 * _kb.nbytes // self.num_pages
+            self._publish_pool_gauges()
+        else:
+            self._m_kv_bytes.set(2 * _kb.nbytes)
         # speculative-decode surface (stay 0 with spec off): proposed =
         # gamma per greedy slot per tick, accepted = drafts the verify
         # kept; the rate gauge is THIS ENGINE's cumulative
@@ -1168,8 +1179,86 @@ class ServingEngine:
     def _publish_pool_gauges(self) -> None:
         if not self.paged:
             return
-        self._m_pages.set(int((self._pool.ref[1:] > 0).sum()))
+        pages = int((self._pool.ref[1:] > 0).sum())
+        self._m_pages.set(pages)
         self._m_shared.set(int((self._pool.ref[1:] > 1).sum()))
+        self._m_kv_bytes.set(pages * self._page_bytes)
+
+    # ------------------------------------------------- memory observability
+    def memory_ledger(self) -> dict:
+        """This engine's `cost_model.serving_memory_ledger` — per-chip
+        HBM attribution (weights / quantized pairs / kv pool / decode
+        scratch) from the LIVE configuration. The analytical half that
+        `profiler.mem_audit.audit_serving_memory` diffs against the
+        compiled decode tick, and the first page of an oom_forensics
+        dump."""
+        from ..cost_model import jnp_dtype_bytes, serving_memory_ledger
+        return serving_memory_ledger(
+            self.cfg, family=self.family.name,
+            layout="paged" if self.paged else "dense",
+            quant="int8" if self._quant_info else "off",
+            num_slots=self.num_slots, max_len=self.max_len,
+            page_size=self.page_size,
+            num_pages=self.num_pages if self.paged else 0,
+            cache_bytes_per_elem=int(self._cache["k"].dtype.itemsize),
+            dtype_bytes=jnp_dtype_bytes(getattr(self.cfg, "dtype", None)),
+            tp=self.tp)
+
+    def compiled_memory_stats(self, sampling: bool = False) -> dict:
+        """XLA's compiled memory accounting for THIS engine's decode
+        tick: re-lower `self._decode` over the avals of the live state
+        (shapes/dtypes only — no tick dispatched, no host pull, no
+        device transfer) and read `memory_analysis()` through the
+        profiler.mem_audit seam. The jit's trace cache makes the
+        compile a warm no-op when the tick already ran with the same
+        sampling mode."""
+        from ..profiler.mem_audit import compiled_memory_stats
+        aval = lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)  # noqa: E731
+        cache = jax.tree_util.tree_map(aval, self._cache)
+        if self.paged and "pt" not in cache:
+            cache["pt"] = jax.ShapeDtypeStruct(
+                self._ptab.shape, self._ptab.dtype)
+        # the tick's dstate tuple, aval'd from the HOST mirrors so a
+        # dirty (not-yet-replicated) state needs no device round-trip
+        dstate = tuple(jax.ShapeDtypeStruct(m.shape, m.dtype)
+                       for m in (self._cur_tok, self._positions,
+                                 self._active, self._temps,
+                                 self._top_ks, self._req_ids,
+                                 self._gen_idx))
+        args = [jax.tree_util.tree_map(aval, self._params), cache,
+                dstate, aval(self._base_key), aval(self._poison_ones)]
+        if self.spec:
+            args.append(aval(self._poison_ones))
+        compiled = self._decode.lower(
+            *args, sampling=bool(sampling)).compile()
+        return compiled_memory_stats(compiled)
+
+    def _dump_oom_forensics(self, where: str, exc) -> None:
+        """The OOM black box: when a dispatch seam sees
+        RESOURCE_EXHAUSTED, dump ledger + live-array census (summarized
+        by shape/dtype/sharding, byte-sorted) + pool/quant stats +
+        active config to the flight dir BEFORE the retry/reset
+        machinery runs, so the post-mortem names the tenant instead of
+        guessing. Forensics must never mask the original failure —
+        every step is best-effort."""
+        try:
+            from ..profiler.mem_audit import live_array_census
+            census = live_array_census()
+            self._m_oom.add()
+            self._flight.configure(oom_forensics={
+                "where": where, "tick": self._ticks,
+                "error": repr(exc)[:500],
+                "ledger": self.memory_ledger(),
+                "census": census["rows"],
+                "live_bytes": census["total_bytes"],
+                "pool": self.pool_stats(), "quant": self.quant_stats(),
+                "config": {"layout": "paged" if self.paged else "dense",
+                           "num_slots": self.num_slots,
+                           "max_len": self.max_len, "tp": self.tp}})
+            self._flight.note(oom_forensics=where, tick=self._ticks)
+            self._flight.dump("oom_forensics")
+        except Exception:                      # noqa: BLE001
+            pass
 
     # ------------------------------------------------------- observables
     def trace_counts(self):
@@ -1614,6 +1703,8 @@ class ServingEngine:
                 return
             except Exception as e:                 # noqa: BLE001
                 self._rollback_slot(slot, req, n_tok)
+                if "RESOURCE_EXHAUSTED" in str(e):
+                    self._dump_oom_forensics("prefill", e)
                 self._on_fault("prefill", e)
                 dead = self._cache_dead()
                 if dead or attempt >= self.retries:
@@ -1636,6 +1727,13 @@ class ServingEngine:
             try:
                 if actions.pop("raise_decode", None):
                     raise ServingFaultError("injected decode fault")
+                if actions.pop("raise_oom", None):
+                    # the injected message carries the real backend's
+                    # marker so the forensics trigger below is the SAME
+                    # path a true allocation failure takes
+                    raise ServingFaultError(
+                        "injected allocation failure: "
+                        "RESOURCE_EXHAUSTED: simulated out of memory")
                 if self.paged:
                     # every active slot's write page must exist and be
                     # private before the scatter (idempotent: a retry
@@ -1700,6 +1798,8 @@ class ServingEngine:
                 return
             except Exception as e:                 # noqa: BLE001
                 self._dirty = True        # resync _dstate from mirrors
+                if "RESOURCE_EXHAUSTED" in str(e):
+                    self._dump_oom_forensics("decode", e)
                 self._on_fault("decode", e)
                 dead = self._cache_dead()
                 if dead or attempt >= self.retries:

@@ -167,16 +167,20 @@ def make_train_step(step_fn, cfg=None, donate=True, extra_donate=(),
                 stepfn._plan_resolved = True
                 stepfn._plan_rebuild = _resolve
                 return stepfn
-            return _PipelineTrainStep(
+            step = _PipelineTrainStep(
                 _resolve(mesh, plan), mesh, plan,
                 donate_argnums=donate_argnums, overlap=overlap)
+            step._cfg = cfg        # oom_forensics' ledger input
+            return step
         fn = resolve_plan_step(step_fn, cfg=cfg, mesh=mesh, plan=plan,
                                overlap=overlap, **step_kw)
         if mesh is None:
             return jax.jit(fn, donate_argnums=donate_argnums)
-        return _ShardedTrainStep(fn, mesh, plan,
+        step = _ShardedTrainStep(fn, mesh, plan,
                                  donate_argnums=donate_argnums,
                                  overlap=overlap)
+        step._cfg = cfg            # oom_forensics' ledger input
+        return step
 
 
 class _ShardedTrainStep:
@@ -360,7 +364,7 @@ class _ShardedTrainStep:
             t0 = time.perf_counter()
             self._build(args)
             args = self.shard_args(*args)
-            out = self._jit(*args)
+            out = self._dispatch(args)
             monitor.gauge("train.compile.wall_ms").set(
                 round((time.perf_counter() - t0) * 1e3, 3))
             monitor.counter("train.compile.executables").add()
@@ -377,7 +381,51 @@ class _ShardedTrainStep:
                     jax.device_put(batch, self._batch_pins(batch)),
                     *(jax.device_put(r, self._replicated_pins(r))
                       for r in rest))
-        return self._jit(*args)
+        return self._dispatch(args)
+
+    def _dispatch(self, args):
+        """The one executable-dispatch seam: a RESOURCE_EXHAUSTED (real
+        backend OOM) dumps an oom_forensics flight black box — the
+        plan's train_memory_ledger plus a live-array census — before
+        re-raising, so the abort names its tenants instead of dying
+        with a bare allocator message (docs/observability.md §Memory
+        observability)."""
+        try:
+            return self._jit(*args)
+        except Exception as e:                     # noqa: BLE001
+            if "RESOURCE_EXHAUSTED" in str(e):
+                self._dump_oom_forensics(e, args)
+            raise
+
+    def _dump_oom_forensics(self, exc, args) -> None:
+        # best-effort: forensics must never mask the original failure
+        try:
+            from ..profiler import flight_recorder, monitor
+            from ..profiler.mem_audit import live_array_census
+            ledger = None
+            cfg = getattr(self, "_cfg", None)
+            try:
+                if cfg is not None and self.plan is not None:
+                    from ..cost_model import train_memory_ledger
+                    batch = args[2]
+                    ledger = train_memory_ledger(
+                        cfg, self.plan, global_batch=batch.shape[0],
+                        seq=max(int(batch.shape[1]) - 1, 1))
+            except Exception:                      # noqa: BLE001
+                pass
+            census = live_array_census()
+            monitor.counter("train.oom_forensics").add()
+            rec = flight_recorder.recorder()
+            rec.configure(oom_forensics={
+                "where": "train_step", "error": repr(exc)[:500],
+                "ledger": ledger,
+                "census": census["rows"],
+                "live_bytes": census["total_bytes"],
+                "plan": getattr(self.plan, "name", repr(self.plan))})
+            rec.note(oom_forensics="train_step")
+            rec.dump("oom_forensics")
+        except Exception:                          # noqa: BLE001
+            pass
 
     def rebuild(self, mesh=None, plan=None) -> "_ShardedTrainStep":
         """Re-target this step at a new mesh/plan — the elastic replan

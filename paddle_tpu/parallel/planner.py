@@ -250,14 +250,16 @@ def _estimate(plan: Plan, spec: ModelSpec, global_batch: int,
               * chip.coll_latency)
 
     # ---- memory ------------------------------------------------------
-    # master param + grad + adam m/v, all f32, sharded by mp*pp*fsdp
-    state_bytes = shard_params * 16
-    seq_shard = mp if (spec.sequence_parallel and mp > 1) else 1
-    act_bytes = (_ACT_BUFFERS.get(spec.remat_policy, 2.0)
-                 * (L / pp) * tok_local * D * abytes / seq_shard)
-    # logits working set (vocab-parallel over mp)
-    logit_bytes = tok_local * V * 4 / mp / max(plan.microbatches, 1)
-    mem = state_bytes + act_bytes + logit_bytes
+    # ONE home for the per-chip HBM model: cost_model.train_memory_
+    # ledger attributes the same bytes to named components (params /
+    # grads / adam m+v, remat activation working set, logits chunk,
+    # overlap prefetch) and profiler/mem_audit diffs that ledger
+    # against XLA's compiled accounting — _estimate consumes the
+    # ledger's total so the gate and the audit can never drift apart.
+    from ..cost_model import train_memory_ledger
+    led = train_memory_ledger(spec, plan, global_batch)
+    comp = led["components"]
+    mem = led["total"]
     plan.step_s = compute_s + comm_s
     plan.mem_bytes = mem
     plan.fits = mem <= 0.9 * chip.hbm_bytes
@@ -266,7 +268,9 @@ def _estimate(plan: Plan, spec: ModelSpec, global_batch: int,
         "dp_s": dp_bytes * 0.3 / chip.ici_bw,
         "fsdp_s": fsdp_bytes * fsdp_disc / chip.ici_bw,
         "pp_s": pp_bytes * 0.5 / chip.ici_bw,
-        "state_gb": state_bytes / 1e9, "act_gb": act_bytes / 1e9,
+        "state_gb": (comp["params"] + comp["grads"] + comp["adam_m"]
+                     + comp["adam_v"]) / 1e9,
+        "act_gb": comp["activations"] / 1e9,
     }
     return plan
 
@@ -776,10 +780,18 @@ def plan_serving_tp(cfg_or_spec, n_devices: int, num_slots: int = 8,
     chip = chip or ChipSpec()
     S = max_len or spec.seq_len
     # per-tick streamed bytes: weights in the serving compute dtype +
-    # the worst-case live KV pool (dense-equivalent envelope)
-    w_bytes = spec.total_params * spec.act_bytes_per_elem
-    kv_bytes = (2 * spec.num_layers * num_slots * S
-                * spec.hidden_size * cache_bytes_per_elem)
+    # the worst-case live KV pool (dense-equivalent envelope). The
+    # formulas live in cost_model.serving_memory_ledger (the ONE home
+    # profiler/mem_audit diffs against compiled accounting); the gate
+    # envelope is weights + kv_pool — decode scratch rides inside the
+    # 10% headroom the 0.9 factor already reserves.
+    from ..cost_model import serving_memory_ledger
+    led = serving_memory_ledger(
+        spec, layout="dense", quant="off", num_slots=num_slots,
+        max_len=S, cache_bytes_per_elem=cache_bytes_per_elem,
+        dtype_bytes=spec.act_bytes_per_elem)
+    w_bytes = led["components"]["weights"]
+    kv_bytes = led["components"]["kv_pool"]
     degrees = [d for d in range(1, n_devices + 1)
                if n_devices % d == 0 and spec.num_heads % d == 0]
     best, best_t, best_fits = None, float("inf"), False

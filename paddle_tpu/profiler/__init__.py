@@ -364,18 +364,10 @@ def cost_analysis(fn, *example_args, **jit_kwargs):
     out = {"flops": float(raw.get("flops", 0.0)),
            "bytes_accessed": float(raw.get("bytes accessed", 0.0)),
            "optimal_seconds": float(raw.get("optimal_seconds", 0.0))}
-    mem = getattr(compiled, "memory_analysis", None)
-    if callable(mem):
-        try:
-            m = mem()
-            out["temp_size_bytes"] = int(
-                getattr(m, "temp_size_in_bytes", 0))
-            out["argument_size_bytes"] = int(
-                getattr(m, "argument_size_in_bytes", 0))
-            out["output_size_bytes"] = int(
-                getattr(m, "output_size_in_bytes", 0))
-        except Exception:
-            pass
+    # mem_audit is THE home for compiled-memory reads; same historical
+    # output keys (temp/argument/output_size_bytes) plus its extras
+    from .mem_audit import compiled_memory_stats
+    out.update(compiled_memory_stats(compiled))
     out["raw"] = dict(raw)
     return out
 
@@ -386,7 +378,7 @@ def __getattr__(name):
     # (serving_telemetry / tracing / slo are jax-free but ride the same
     # lazy seam so the profiler package stays import-light)
     if name in ("telemetry", "flight_recorder", "serving_telemetry",
-                "tracing", "slo", "hlo_audit"):
+                "tracing", "slo", "hlo_audit", "mem_audit"):
         import importlib
         return importlib.import_module(f".{name}", __name__)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -164,6 +164,12 @@ class ServingTelemetry:
         if self._writer is not None:
             self._pending.append(rec)
             if len(self._pending) >= self.every:
+                # live memory gauges ride the batch drain (the serving
+                # flush cadence): host-side reads only, zero extra
+                # device pulls, so streams stay bit-identical to
+                # telemetry-off
+                from .mem_audit import publish_hbm_gauges
+                publish_hbm_gauges()
                 self._writer.put(self._pending)
                 self._pending = []
 

@@ -337,6 +337,11 @@ class TelemetryPipeline:
                 monitor.gauge("train.mfu").set(
                     round(self._flops_per_token * tps / peak, 6))
         self._prev_flush_t = now
+        # live memory gauges ride the same flush (host-side PJRT /
+        # proc reads, zero device pulls) so the monitor record below
+        # carries hbm.bytes_in_use / hbm.peak_bytes into the JSONL
+        from .mem_audit import publish_hbm_gauges
+        publish_hbm_gauges()
         records.append({"kind": "monitor", "t": now, "pid": os.getpid(),
                         "stats": monitor.snapshot()})
         self._writer.put(records)

@@ -65,6 +65,13 @@ tools/chaos_serving.py):
                           `snapshot_request` at/after tick T raises
                           once (mid-migration failure — the router
                           must take the requeue-replay fallback).
+- ``oom@T``             — raise a simulated allocation failure (the
+                          message carries the backend's
+                          RESOURCE_EXHAUSTED marker) at the decode
+                          seam on tick T: the engine must dump an
+                          oom_forensics flight black box (ledger +
+                          live-array census + pool stats) and then
+                          recover through the normal retry path.
 
 Router fault kinds (inference/router.py consults `on_router_tick`
 through `router._FAULT_HOOK` once per ROUTER tick — a separate hook
@@ -131,10 +138,10 @@ KILL_EXIT = 37
 _KINDS = ("kill", "crash_shard", "nan", "hb_stale", "elastic_exit",
           "nan_logits", "tick_stall", "prefill_raise", "decode_raise",
           "cow_raise", "draft_nan", "device_loss", "collective_hang",
-          "straggler", "replica_preempt", "migrate_raise")
+          "straggler", "replica_preempt", "migrate_raise", "oom")
 _SERVING_KINDS = frozenset(
     {"nan_logits", "tick_stall", "prefill_raise", "decode_raise",
-     "cow_raise", "draft_nan", "migrate_raise"})
+     "cow_raise", "draft_nan", "migrate_raise", "oom"})
 _ELASTIC_KINDS = frozenset(
     {"device_loss", "collective_hang", "straggler"})
 _ROUTER_KINDS = frozenset({"replica_preempt", "migrate_raise"})
@@ -306,6 +313,8 @@ class FaultPlan:
                 actions["raise_cow"] = True
             elif f.kind == "migrate_raise":
                 actions["raise_migrate"] = True
+            elif f.kind == "oom":
+                actions["raise_oom"] = True
         return actions
 
     def on_router_tick(self, tick: int) -> dict:

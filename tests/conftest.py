@@ -99,7 +99,7 @@ SMOKE_FILES = {
     # high-level API + aux subsystems
     "test_hapi.py", "test_profiler.py", "test_checkpoint.py",
     "test_tokenizer.py", "test_misc_modules.py", "test_telemetry.py",
-    "test_train_observability.py",
+    "test_train_observability.py", "test_mem_observability.py",
     # fault-tolerance runtime (in-process; the chaos drills in
     # test_chaos_drill.py / test_chaos_serving.py stay full-suite-only)
     "test_fault_tolerance.py", "test_checkpoint_edges.py",

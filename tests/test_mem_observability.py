@@ -1,0 +1,340 @@
+"""Memory observability (the HBM observatory, ISSUE 18).
+
+What this file pins (docs/observability.md "Memory observability"):
+- the memory LEDGER is the planner's HBM gate — train_memory_ledger's
+  total equals Plan.mem_bytes bit-exactly for every enumerated plan
+  (ONE home for the formula), and the serving ledger's kv_pool prices
+  the engine's real cache arrays byte-exactly;
+- the compiled-memory AUDIT (profiler/mem_audit) lowers the actual
+  GSPMD train step / serving decode tick and reads
+  compiled.memory_analysis(): peaks are positive, findings are NAMED
+  (hbm_underestimate / hbm_overestimate) and the tolerance is honored
+  in both directions;
+- the LIVE gauges (hbm.bytes_in_use / hbm.peak_bytes,
+  serving.kv_pool_bytes) publish at the existing flush cadences with
+  ZERO extra host pulls — serving streams stay bit-identical to
+  telemetry-off;
+- the REGRESSION gate (tools/mem_gate.py) fails on peak growth beyond
+  tolerance, passes unpinned/shrunk plans with notes, and regenerates
+  its baseline with --write-baseline.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.cost_model import train_memory_ledger
+from paddle_tpu.inference.serving import ServingEngine
+from paddle_tpu.models.gpt import GPTConfig, PARAM_SPECS, init_gpt_params
+from paddle_tpu.parallel.planner import enumerate_plans, plan_train
+from paddle_tpu.profiler import mem_audit, monitor
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+MAX_LEN = 64
+GEN = 6
+LENS = (5, 9, 13)
+
+
+def _train_cfg():
+    return GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                     num_heads=4, max_seq_len=64)
+
+
+def _serving_cfg():
+    return GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                     num_heads=2, ffn_hidden=64, max_seq_len=128,
+                     sequence_parallel=False, remat=False,
+                     dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def gpt_setup():
+    cfg = _serving_cfg()
+    return cfg, init_gpt_params(cfg, jax.random.PRNGKey(0))
+
+
+def _prompts(lens=LENS, seed=7):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, 60, L).astype(np.int32) for L in lens]
+
+
+def _engine(params, cfg, **kw):
+    kw.setdefault("num_slots", 3)
+    return ServingEngine(params, cfg, family="gpt", max_len=MAX_LEN,
+                         **kw)
+
+
+# --------------------------------------------------------------------------
+# layer 1: the ledger IS the planner's formula
+# --------------------------------------------------------------------------
+class TestLedgerPlannerEquality:
+    def test_train_ledger_equals_plan_mem_bytes(self):
+        """Every enumerated plan's mem_bytes is the ledger total,
+        bit-exact — _estimate consumes train_memory_ledger, so the
+        gate and the audit can never drift apart."""
+        cfg = _train_cfg()
+        plans = enumerate_plans(cfg, 8, 8)
+        assert plans
+        for plan in plans:
+            led = train_memory_ledger(cfg, plan, 8)
+            assert led["total"] == plan.mem_bytes, plan
+            # and the total is exactly its named components
+            assert led["total"] == pytest.approx(
+                sum(led["components"].values()), rel=1e-12)
+            assert all(v >= 0 for v in led["components"].values())
+
+    def test_overlap_prefetch_prices_only_when_hideable(self):
+        """The double-buffered ZeRO-3 gather buffer exists exactly when
+        overlap is on AND there is an fsdp gather to hide."""
+        cfg = _train_cfg()
+        on = train_memory_ledger(
+            cfg, {"fsdp": 4, "tp": 2, "overlap": True}, 8)
+        off = train_memory_ledger(cfg, {"fsdp": 4, "tp": 2}, 8)
+        no_gather = train_memory_ledger(
+            cfg, {"tp": 2, "overlap": True}, 8)
+        assert on["components"]["overlap_prefetch"] > 0
+        assert off["components"]["overlap_prefetch"] == 0
+        assert no_gather["components"]["overlap_prefetch"] == 0
+
+    def test_serving_ledger_prices_real_cache_and_gauge(self, gpt_setup):
+        """The dense kv_pool component equals the engine's actual k+v
+        cache bytes, which is exactly what serving.kv_pool_bytes
+        publishes."""
+        cfg, params = gpt_setup
+        eng = _engine(params, cfg)
+        led = eng.memory_ledger()
+        kv_actual = 2 * eng._cache["k"].nbytes
+        assert led["components"]["kv_pool"] == kv_actual
+        assert monitor.gauge("serving.kv_pool_bytes").value == kv_actual
+        assert led["total"] == pytest.approx(
+            sum(led["components"].values()), rel=1e-12)
+
+    def test_paged_pool_gauge_tracks_occupancy(self, gpt_setup):
+        """Paged engines publish kv_pool_bytes = pages_in_use x page
+        bytes next to the pages_in_use gauge — it moves with
+        admissions."""
+        cfg, params = gpt_setup
+        eng = _engine(params, cfg, kv_layout="paged", page_size=8)
+        assert monitor.gauge("serving.kv_pool_bytes").value == 0
+        eng.generate(_prompts(), GEN)
+        led = eng.memory_ledger()
+        assert led["config"]["layout"] == "paged"
+        assert led["components"]["kv_pool"] > 0
+
+
+# --------------------------------------------------------------------------
+# layer 2: the compiled-memory audit
+# --------------------------------------------------------------------------
+class TestCompiledAudit:
+    def test_train_audit_canonical_plan(self):
+        """dp2 x fsdp2 x tp2 on the 8-device CPU mesh: the compiled
+        peak is read from the ACTUAL lowered step, the ledger inside
+        the result is the planner's own number, and the tolerance is
+        honored in both directions."""
+        cfg = _train_cfg()
+        plan = plan_train(cfg, 8, 8, dp=2, fsdp=2, tp=2,
+                          param_specs=PARAM_SPECS)
+        res = mem_audit.audit_train_memory(cfg, plan, 8, seq=32)
+        assert res["plan"] == "dp2_fsdp2_tp2"
+        assert res["n_devices"] == 8
+        assert res["compiled"]["peak_bytes"] > 0
+        assert res["ledger"]["total"] > 0
+        # tolerance honored: infinite tolerance silences, zero names
+        assert mem_audit.diff_vs_ledger(
+            res["compiled"], res["ledger"], res["plan"],
+            tolerance=1e9) == []
+        f = mem_audit.diff_vs_ledger(
+            res["compiled"], res["ledger"], res["plan"], tolerance=0.0)
+        assert len(f) == 1
+        assert f[0]["kind"] in ("hbm_underestimate", "hbm_overestimate")
+        assert f[0]["plan"] == "dp2_fsdp2_tp2"
+        assert f[0]["largest_component"] in res["ledger"]["components"]
+        # the audit published its monitor stats
+        snap = monitor.snapshot()
+        assert snap["train.mem.compiled_peak_bytes"] \
+            == res["compiled"]["peak_bytes"]
+        assert snap["train.mem.audits"] >= 1
+
+    def test_overestimate_named_too(self):
+        """A ledger bigger than the compiled peak names
+        hbm_overestimate — the gate over-refusing plans is a finding,
+        not a silent margin."""
+        f = mem_audit.diff_vs_ledger(
+            {"peak_bytes": 100}, {"total": 1000.0,
+                                  "components": {"params": 900.0,
+                                                 "logits": 100.0}},
+            "toy", tolerance=0.5)
+        assert f[0]["kind"] == "hbm_overestimate"
+        assert f[0]["largest_component"] == "params"
+
+    def test_serving_audit_layouts(self, gpt_setup):
+        """dense_fp and paged_int8 both audit through the live
+        engine's own decode tick — no tick dispatched, named rows."""
+        cfg, params = gpt_setup
+        dense = mem_audit.audit_serving_memory(_engine(params, cfg))
+        paged = mem_audit.audit_serving_memory(
+            _engine(params, cfg, kv_layout="paged", page_size=8,
+                    quant="int8"))
+        assert dense["plan"] == "dense_fp"
+        assert paged["plan"] == "paged_int8"
+        for res in (dense, paged):
+            assert res["compiled"]["peak_bytes"] > 0
+            assert res["gap_fraction"] is not None
+            for f in res["findings"]:
+                assert f["kind"] in ("hbm_underestimate",
+                                     "hbm_overestimate")
+
+    def test_cost_analysis_keys_preserved(self, gpt_setup):
+        """The dedup of profiler.cost_analysis' historical inline
+        getattr: the old temp/argument/output keys still come back
+        through the mem_audit seam, plus peak_bytes."""
+        cfg, params = gpt_setup
+        eng = _engine(params, cfg)
+        stats = eng.compiled_memory_stats()
+        for key in ("temp_size_bytes", "argument_size_bytes",
+                    "output_size_bytes", "peak_bytes"):
+            assert key in stats, key
+
+
+# --------------------------------------------------------------------------
+# layer 3: live gauges, zero extra pulls
+# --------------------------------------------------------------------------
+class TestLiveGauges:
+    def test_hbm_gauges_present_peak_monotonic(self):
+        mem_audit.publish_hbm_gauges()
+        snap = monitor.snapshot()
+        assert snap["hbm.bytes_in_use"] > 0      # host-RSS fallback on CPU
+        assert snap["hbm.peak_bytes"] >= snap["hbm.bytes_in_use"]
+        peak1 = snap["hbm.peak_bytes"]
+        mem_audit.publish_hbm_gauges()
+        assert monitor.gauge("hbm.peak_bytes").value >= peak1
+
+    def test_streams_bit_identical_zero_extra_pulls(self, gpt_setup,
+                                                    tmp_path):
+        """Telemetry ON (jsonl stream draining, hbm gauges riding the
+        drain): streams equal telemetry-off bit for bit, and the host
+        pull count stays one per tick + one per prefill."""
+        cfg, params = gpt_setup
+        base = _engine(params, cfg, telemetry="off").generate(
+            _prompts(), GEN)
+        monitor.gauge("hbm.bytes_in_use").set(0)
+        path = str(tmp_path / "srv.jsonl")
+        eng = _engine(params, cfg, telemetry_jsonl=path,
+                      telemetry_every=4)
+        eng.generate(_prompts(), GEN)            # warm (compiles)
+        counts = [0]
+        orig = eng._pull
+
+        def counted(value, stall_s=0.0):
+            counts[0] += 1
+            return orig(value, stall_s)
+        eng._pull = counted
+        t0 = eng._ticks
+        outs = eng.generate(_prompts(), GEN)
+        assert counts[0] == (eng._ticks - t0) + len(LENS)
+        for a, b in zip(base, outs):
+            assert np.array_equal(a, b)
+        # the drain cadence DID publish the live gauges meanwhile
+        assert monitor.gauge("hbm.bytes_in_use").value > 0
+
+
+# --------------------------------------------------------------------------
+# layer 4: the regression gate
+# --------------------------------------------------------------------------
+class TestMemGate:
+    @pytest.fixture()
+    def gate_env(self, monkeypatch, tmp_path):
+        import mem_gate
+        rows = {"p1": 100_000}
+        monkeypatch.setattr(
+            mem_gate, "measure",
+            lambda n: {"peak_bytes": rows[n], "ledger_bytes": 80_000,
+                       "gap_fraction": 0.25, "findings": []})
+        return mem_gate, rows, str(tmp_path / "base.json")
+
+    def test_write_baseline_then_green(self, gate_env):
+        mem_gate, rows, bp = gate_env
+        assert mem_gate.gate(["p1"], bp, 0.05, write=True) == 0
+        with open(bp) as f:
+            doc = json.load(f)
+        assert doc["plans"]["p1"]["peak_bytes"] == 100_000
+        assert doc["plans"]["p1"]["ledger_bytes"] == 80_000
+        assert mem_gate.gate(["p1"], bp, 0.05) == 0      # unchanged
+        rows["p1"] = 104_000                             # within 5%
+        assert mem_gate.gate(["p1"], bp, 0.05) == 0
+
+    def test_growth_beyond_tolerance_fails(self, gate_env):
+        mem_gate, rows, bp = gate_env
+        assert mem_gate.gate(["p1"], bp, 0.05, write=True) == 0
+        rows["p1"] = 120_000                             # +20%
+        assert mem_gate.gate(["p1"], bp, 0.05) == 1
+
+    def test_shrink_and_unpinned_pass(self, gate_env):
+        mem_gate, rows, bp = gate_env
+        assert mem_gate.gate(["p1"], bp, 0.05, write=True) == 0
+        rows["p1"] = 60_000                              # banked win
+        assert mem_gate.gate(["p1"], bp, 0.05) == 0
+        rows["p2"] = 1                                   # not pinned yet
+        assert mem_gate.gate(["p1", "p2"], bp, 0.05) == 0
+
+    def test_stored_baseline_covers_canonical_plans(self):
+        """perf/mem_baseline.json pins every canonical train plan AND
+        both serving layouts (the chaos_drill --gate contract)."""
+        import mem_gate
+        with open(os.path.join(REPO, "perf", "mem_baseline.json")) as f:
+            doc = json.load(f)
+        assert set(doc["plans"]) == set(mem_gate.CANONICAL_PLANS)
+        assert all(r["peak_bytes"] > 0 for r in doc["plans"].values())
+
+
+# --------------------------------------------------------------------------
+# oom forensics (the chaos drill runs the injected end-to-end scenario;
+# here: the census is sane and the serving dump carries it)
+# --------------------------------------------------------------------------
+class TestOomForensics:
+    def test_live_array_census_shape(self, gpt_setup):
+        cfg, params = gpt_setup
+        census = mem_audit.live_array_census(limit=4)
+        assert census["total_bytes"] > 0
+        assert 0 < len(census["rows"]) <= 4
+        for key, row in census["rows"].items():
+            assert row["count"] >= 1 and row["bytes"] > 0
+            assert key.count("/") >= 2               # shape/dtype/spec
+
+    def test_serving_oom_dump_has_census_and_ledger(self, gpt_setup,
+                                                    tmp_path):
+        """An injected RESOURCE_EXHAUSTED on the decode tick leaves ONE
+        parseable oom_forensics flight dump naming the ledger and the
+        live-array census, and the engine recovers transparently."""
+        from paddle_tpu.profiler import flight_recorder
+        from paddle_tpu.testing import faults
+        cfg, params = gpt_setup
+        fdir = str(tmp_path / "flight")
+        os.makedirs(fdir, exist_ok=True)
+        c0 = int(monitor.counter("serving.oom_forensics").value)
+        rec = flight_recorder.recorder()
+        old_dir = rec.dir
+        rec.set_dir(fdir)
+        faults.install("oom@2", once_dir=str(tmp_path / "once"))
+        try:
+            eng = _engine(params, cfg)
+            outs = eng.generate(_prompts(), GEN)
+        finally:
+            faults.uninstall()
+            rec.set_dir(old_dir)
+        assert all(len(o) for o in outs)             # recovered
+        assert monitor.counter("serving.oom_forensics").value == c0 + 1
+        dumps = [f for f in os.listdir(fdir) if "oom_forensics" in f]
+        assert len(dumps) == 1                       # exactly once
+        doc = flight_recorder.load_dump(os.path.join(fdir, dumps[0]))
+        info = doc["config"]["oom_forensics"]
+        assert info["where"] == "decode"
+        assert info["census"] and info["live_bytes"] > 0
+        assert info["ledger"]["components"]["kv_pool"] > 0
+        assert "RESOURCE_EXHAUSTED" in info["error"]

@@ -355,6 +355,50 @@ class TestTelemetryReport:
         assert ta[0]["plan"] == "dp2_fsdp2_tp2"
         assert "kind" not in ta[0]
 
+    def test_memory_block(self, tmp_path):
+        """The memory observatory surfaces (ISSUE 18): hbm.* live
+        gauges (last value), serving.kv_pool_bytes (gauge, grouped
+        into serving.kv_pool AND surfaced in the memory block), the
+        oom_forensics flight-dump counters (first-to-last deltas), and
+        the {train,serving}.mem.* compiled-audit family render as the
+        'memory' block."""
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "tools"))
+        from telemetry_report import summarize
+        path = str(tmp_path / "mem.jsonl")
+        recs = [
+            {"kind": "run", "t": 0.0, "every": 2, "fields": ["loss"]},
+            {"kind": "monitor", "t": 1.0, "pid": 1, "stats": {
+                "hbm.bytes_in_use": 100, "hbm.peak_bytes": 120,
+                "serving.kv_pool_bytes": 50, "serving.pages_in_use": 2,
+                "serving.oom_forensics": 0, "train.oom_forensics": 0,
+                "train.mem.compiled_peak_bytes": 999,
+                "train.mem.audits": 1}},
+            {"kind": "monitor", "t": 9.0, "pid": 1, "stats": {
+                "hbm.bytes_in_use": 110, "hbm.peak_bytes": 130,
+                "serving.kv_pool_bytes": 60, "serving.pages_in_use": 3,
+                "serving.oom_forensics": 2, "train.oom_forensics": 0,
+                "train.mem.compiled_peak_bytes": 999,
+                "train.mem.audits": 3}},
+        ]
+        with open(path, "w") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+        doc = summarize(path)
+        mem = doc["memory"]
+        assert mem["hbm"] == {"bytes_in_use": 110, "peak_bytes": 130}
+        assert mem["kv_pool_bytes"] == 60            # gauge: last value
+        assert mem["oom_forensics"] == {"train": 0, "serving": 2}
+        assert mem["audit"]["train"]["compiled_peak_bytes"] == 999
+        assert mem["audit"]["train"]["audits"] == 2  # counter: delta
+        srv = doc["serving"]
+        # kv_pool_bytes rides the kv_pool group as a gauge, next to
+        # pages_in_use; the mem.* family reports only under 'memory'
+        assert srv["kv_pool"]["kv_pool_bytes"] == 60
+        assert srv["kv_pool"]["pages_in_use"] == 3
+        assert not any(k.startswith("mem.") for k in srv)
+
     def test_tolerates_torn_tail(self, tmp_path):
         import sys
         sys.path.insert(0, os.path.join(os.path.dirname(

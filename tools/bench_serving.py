@@ -1125,6 +1125,11 @@ def main():
                   traces_after[1] - traces_warm[1])
     srv = {k[len("serving."):]: v for k, v in monitor.snapshot().items()
            if k.startswith("serving.")}
+    try:   # compiled peak HBM of the decode tick rides the BENCH line
+        peak_hbm = eng.compiled_memory_stats().get("peak_bytes")
+    except Exception as e:            # backend may not report memory
+        _log(f"compiled memory stats unavailable: {e}")
+        peak_hbm = None
     print(json.dumps({
         "metric": "serving_tokens_per_sec",
         "value": round(eng_tps, 1),
@@ -1138,6 +1143,7 @@ def main():
         "model": f"{args.layers}Lx{args.hidden}d",
         "recompiles_after_warmup": list(recompiles),
         "stream_mismatches": mismatches,
+        "compiled_peak_hbm_bytes": peak_hbm,
         "monitor": srv,
     }), flush=True)
     return 0 if mismatches == 0 else 1

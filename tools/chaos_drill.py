@@ -662,7 +662,9 @@ def gate_main(steps: int, elastic_steps: int, tier1_log: str,
     serving chaos drill green (chaos_serving.py --quick — autoscale/
     live-migration/device-loss scenarios included) AND the
     HLO-audit regression gate green (tools/audit_gate.py vs
-    perf/audit_baseline.json — no new resharding) AND
+    perf/audit_baseline.json — no new resharding) AND the
+    compiled-memory gate green (tools/mem_gate.py vs
+    perf/mem_baseline.json — no peak-HBM growth) AND
     tools/diff_failures.py clean against the stored tier-1 baseline
     (skipped with a note when no tier-1 log exists yet)."""
     rc = run_drill(steps, full=False, keep_logs=keep_logs)
@@ -683,6 +685,13 @@ def gate_main(steps: int, elastic_steps: int, tier1_log: str,
     if res.returncode != 0:
         print("[gate] HLO audit gate FAILED (new resharding findings "
               "vs perf/audit_baseline.json)", flush=True)
+        return res.returncode
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "mem_gate.py")],
+        cwd=REPO)
+    if res.returncode != 0:
+        print("[gate] compiled-memory gate FAILED (peak HBM grew vs "
+              "perf/mem_baseline.json)", flush=True)
         return res.returncode
     if tier1_log and os.path.exists(tier1_log):
         res = subprocess.run(

@@ -432,6 +432,47 @@ def run_drill(quick: bool = False, keep_root: bool = False) -> int:
     scenario("decode_raise@2", raise_body("decode"),
              spec="decode_raise@2")
 
+    # --- oom: forensics black box + transparent recovery -------------
+    def oom_body():
+        o0 = monitor.counter("serving.oom_forensics").value
+        eng = make_engine(params, cfg, max_len)
+        reqs = [eng.submit(p, gen) for p in prompts]
+        eng.drain()
+        if monitor.counter("serving.oom_forensics").value <= o0:
+            return "oom fault never fired (no forensics dump)"
+        err = check_terminal(reqs) or check_traces(eng)
+        if err:
+            return err
+        # the injected RESOURCE_EXHAUSTED rides the decode retry path,
+        # so recovery is transparent (exactly-once fire + bit-exact
+        # streams) — the forensics dump is pure observation
+        if any(r.finish_reason != "length" for r in reqs):
+            return ("oom recovery was not transparent: "
+                    f"{[r.finish_reason for r in reqs]}")
+        err = check_streams(reqs, baseline)
+        if err:
+            return err
+        # the black box itself: parseable, with a non-empty live-array
+        # census AND a component-attributed ledger
+        fdir = os.path.join(root, "oom@2", "flight")
+        err = check_flight(fdir, want_reason="oom_forensics")
+        if err:
+            return err
+        from paddle_tpu.profiler.flight_recorder import load_dump
+        for name in sorted(os.listdir(fdir)):
+            doc = load_dump(os.path.join(fdir, name))
+            if doc.get("reason") != "oom_forensics":
+                continue
+            oom = (doc.get("config") or {}).get("oom_forensics") or {}
+            if not oom.get("census"):
+                return "oom_forensics dump has an empty census"
+            led = oom.get("ledger") or {}
+            if not led.get("components") or not led.get("total"):
+                return "oom_forensics dump has an empty ledger"
+            return None
+        return "no oom_forensics dump under the scenario flight dir"
+    scenario("oom@2", oom_body, spec="oom@2")
+
     # --- queue flood: backpressure under both policies ---------------
     def flood_reject():
         eng = make_engine(params, cfg, max_len, num_slots=2, max_queue=2)

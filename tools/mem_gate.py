@@ -1,0 +1,197 @@
+#!/usr/bin/env python
+"""Compiled-memory regression gate: peak HBM per canonical plan.
+
+The memory sibling of tools/audit_gate.py (which pins resharding
+finding counts): this gate re-lowers the canonical train plans AND the
+canonical serving layouts on the CPU mesh, reads XLA's compiled memory
+accounting through profiler/mem_audit.py, and diffs each plan's
+`peak_bytes` against the stored baseline (perf/mem_baseline.json):
+
+- compiled peak GREW beyond --tolerance vs the stored peak  -> FAIL
+- a plan the baseline does not list                          -> pass
+  (with a note to --write-baseline and start pinning it)
+- peak SHRANK beyond tolerance                               -> pass
+  (with a note to --write-baseline and bank the win)
+
+ONE exit code. Wired into `tools/chaos_drill.py --gate` (the
+pre-commit robustness gate) so an HBM regression — a dropped donation,
+a doubled buffer, a remat policy that silently rematerializes nothing
+— is caught at commit time, before it becomes a mystery OOM at scale.
+
+Usage:
+  python tools/mem_gate.py                   # gate vs stored baseline
+  python tools/mem_gate.py --write-baseline  # re-pin after a win
+  python tools/mem_gate.py --plans fsdp8 --json
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+TOOLS = os.path.dirname(os.path.abspath(__file__))
+if TOOLS not in sys.path:
+    sys.path.insert(0, TOOLS)
+
+BASELINE_PATH = os.path.join(REPO, "perf", "mem_baseline.json")
+# the same canonical train plans audit_gate pins, plus the two serving
+# layouts serving_attrib A/Bs (BASELINE.md §Memory observability)
+CANONICAL_TRAIN = ("dp2_fsdp2_tp2", "fsdp8", "dp2_tp2_pp2_mb4")
+CANONICAL_SERVING = ("dense_fp", "paged_int8")
+CANONICAL_PLANS = CANONICAL_TRAIN + CANONICAL_SERVING
+TOLERANCE = 0.05
+
+
+def measure_train_plan(name: str) -> dict:
+    """Compiled peak for ONE canonical train plan on the small
+    observability config — the same cfg/batch/seq audit_gate and
+    train_attrib lower, so every gate describes the same executable."""
+    import train_attrib
+
+    from paddle_tpu.models.gpt import PARAM_SPECS
+    from paddle_tpu.parallel.planner import plan_train
+    from paddle_tpu.profiler import mem_audit
+
+    class _Args:
+        vocab, hidden, layers, seq = 512, 128, 2, 32
+
+    cfg = train_attrib.build_cfg(_Args)
+    deg = train_attrib.parse_plan_name(name)
+    n_devices = deg["dp"] * deg["fsdp"] * deg["tp"] * deg.get("pp", 1)
+    plan = plan_train(cfg, n_devices, 8, param_specs=PARAM_SPECS, **deg)
+    res = mem_audit.audit_train_memory(cfg, plan, 8, seq=_Args.seq)
+    return {"peak_bytes": int(res["compiled"].get("peak_bytes", 0)),
+            "ledger_bytes": int(res["ledger"]["total"]),
+            "gap_fraction": res["gap_fraction"],
+            "findings": sorted(f["kind"] for f in res["findings"])}
+
+
+def measure_serving_layout(name: str) -> dict:
+    """Compiled decode-tick peak for ONE canonical serving layout on
+    the chaos-drill-sized model (dense_fp | paged_int8)."""
+    import jax
+
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.models.gpt import GPTConfig, init_gpt_params
+    from paddle_tpu.profiler import mem_audit
+
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=64, dtype="float32")
+    params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+    kw = ({} if name == "dense_fp"
+          else {"kv_layout": "paged", "page_size": 8, "quant": "int8"})
+    eng = ServingEngine(params, cfg, family="gpt", num_slots=3,
+                        max_len=64, **kw)
+    res = mem_audit.audit_serving_memory(eng)
+    return {"peak_bytes": int(res["compiled"].get("peak_bytes", 0)),
+            "ledger_bytes": int(res["ledger"]["total"]),
+            "gap_fraction": res["gap_fraction"],
+            "findings": sorted(f["kind"] for f in res["findings"])}
+
+
+def measure(name: str) -> dict:
+    if name in CANONICAL_SERVING:
+        return measure_serving_layout(name)
+    return measure_train_plan(name)
+
+
+def gate(plans, baseline_path: str, tolerance: float,
+         write: bool = False, as_json: bool = False) -> int:
+    stored = {}
+    if os.path.exists(baseline_path):
+        with open(baseline_path) as f:
+            stored = json.load(f)
+    base_plans = stored.get("plans", {})
+    observed, regressions, shrunk, unpinned = {}, [], [], []
+    for name in plans:
+        row = measure(name)
+        observed[name] = row
+        base = base_plans.get(name, {}).get("peak_bytes")
+        if base is None:
+            unpinned.append(name)
+            continue
+        base = int(base)
+        seen = row["peak_bytes"]
+        if base > 0 and seen > base * (1.0 + tolerance):
+            regressions.append((name, base, seen))
+        elif base > 0 and seen < base * (1.0 - tolerance):
+            shrunk.append((name, base, seen))
+    if write:
+        doc = {
+            "comment": "Compiled peak-HBM baseline per canonical plan "
+                       "(tools/mem_gate.py --write-baseline). The gate "
+                       "fails when a plan's compiled peak grows beyond "
+                       "the tolerance.",
+            "tolerance": tolerance,
+            "plans": {n: {"peak_bytes": r["peak_bytes"],
+                          "ledger_bytes": r["ledger_bytes"]}
+                      for n, r in observed.items()},
+        }
+        os.makedirs(os.path.dirname(baseline_path), exist_ok=True)
+        with open(baseline_path, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"[mem-gate] baseline written: {baseline_path}",
+              flush=True)
+        return 0
+    if as_json:
+        print(json.dumps({"metric": "mem_gate", "observed": observed,
+                          "regressions": [
+                              {"plan": p, "baseline": b, "seen": s}
+                              for p, b, s in regressions]}),
+              flush=True)
+    for p, b, s in regressions:
+        print(f"[mem-gate] REGRESSION {p}: compiled peak "
+              f"{b / 1e6:.2f} -> {s / 1e6:.2f} MB "
+              f"(+{(s - b) / b:.1%} > {tolerance:.0%})", flush=True)
+    if regressions:
+        print(f"[mem-gate] MEMORY GATE RED ({len(regressions)} "
+              "plan(s) grew)", flush=True)
+        return 1
+    for p in unpinned:
+        print(f"[mem-gate] {p}: not in baseline — pin it with "
+              "--write-baseline", flush=True)
+    for p, b, s in shrunk:
+        print(f"[mem-gate] {p}: compiled peak {b / 1e6:.2f} -> "
+              f"{s / 1e6:.2f} MB — bank it with --write-baseline",
+              flush=True)
+    print(f"[mem-gate] GREEN: {len(observed)} plan(s) within "
+          f"{tolerance:.0%} of baseline", flush=True)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--plans", default=",".join(CANONICAL_PLANS),
+                    help="comma-separated plan/layout names to measure")
+    ap.add_argument("--baseline", default=BASELINE_PATH)
+    ap.add_argument("--tolerance", type=float, default=None,
+                    help="allowed peak growth fraction (default: the "
+                         "baseline's stored tolerance, else "
+                         f"{TOLERANCE})")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="re-pin the stored baseline from this run")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.device import pin_cpu
+    if not pin_cpu(8):
+        print("[mem-gate] could not pin the 8-device CPU platform",
+              flush=True)
+        return 2
+    tolerance = args.tolerance
+    if tolerance is None:
+        tolerance = TOLERANCE
+        if os.path.exists(args.baseline):
+            with open(args.baseline) as f:
+                tolerance = float(json.load(f).get("tolerance",
+                                                   TOLERANCE))
+    plans = [p for p in args.plans.split(",") if p]
+    return gate(plans, args.baseline, tolerance,
+                write=args.write_baseline, as_json=args.json)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -241,6 +241,42 @@ def summarize(path: str, samples_per_step: Optional[float] = None) -> dict:
         if mfu:
             out["mfu"] = mfu
 
+    # ---- memory observability (profiler/mem_audit.py): the hbm.*
+    # live gauges ride every telemetry flush (PJRT memory_stats, or
+    # host RSS on CPU), serving.kv_pool_bytes sits next to the pool
+    # occupancy gauges, the oom_forensics counters count flight dumps,
+    # and the {train,serving}.mem.* family carries the last compiled-
+    # memory audit. Gauges report last value; counters deltas. ----
+    if monitors:
+        first_s, last_s = monitors[0]["stats"], monitors[-1]["stats"]
+        mem = {}
+        hbm = {k[len("hbm."):]: last_s[k]
+               for k in sorted(last_s) if k.startswith("hbm.")}
+        if hbm:
+            mem["hbm"] = hbm
+        if "serving.kv_pool_bytes" in last_s:
+            mem["kv_pool_bytes"] = last_s["serving.kv_pool_bytes"]
+        oom = {}
+        for k in ("train.oom_forensics", "serving.oom_forensics"):
+            if k in last_s:
+                oom[k.split(".")[0]] = last_s[k] - first_s.get(k, 0)
+        if oom:
+            mem["oom_forensics"] = oom
+        audit = {}
+        for fam in ("train", "serving"):
+            pre = fam + ".mem."
+            fam_stats = {k[len(pre):]: last_s[k]
+                         for k in sorted(last_s) if k.startswith(pre)}
+            if fam_stats:
+                if "audits" in fam_stats:     # the only counter here
+                    fam_stats["audits"] -= first_s.get(pre + "audits",
+                                                       0)
+                audit[fam] = fam_stats
+        if audit:
+            mem["audit"] = audit
+        if mem:
+            out["memory"] = mem
+
     # ---- achieved-vs-roofline joins embedded in the stream
     # (tools/train_attrib.py appends one per measured plan) ----
     if train_attribs:
@@ -263,7 +299,8 @@ def summarize(path: str, samples_per_step: Optional[float] = None) -> dict:
                        "serving.router.pending",
                        "serving.autoscale.replicas_target",
                        "serving.autoscale.occupancy",
-                       "serving.autoscale.migrated_pages_bytes")
+                       "serving.autoscale.migrated_pages_bytes",
+                       "serving.kv_pool_bytes")
 
     def _is_gauge(k):
         # per-replica queue-depth gauges carry a dynamic suffix
@@ -274,7 +311,7 @@ def summarize(path: str, samples_per_step: Optional[float] = None) -> dict:
     # occupancy/sharing gauges + COW and chunked-prefill counters,
     # grouped under serving.kv_pool when any of them moved
     _KV_POOL = ("pages_in_use", "pages_shared", "cow_copies",
-                "prefill_chunks")
+                "prefill_chunks", "kv_pool_bytes")
     # the speculative-decode surface (inference/spec_decode.py):
     # proposed/accepted counter deltas + the per-engine acceptance-rate
     # gauge, grouped under serving.spec when any of them moved
@@ -333,6 +370,10 @@ def summarize(path: str, samples_per_step: Optional[float] = None) -> dict:
                               if k.startswith("autoscale.")]}
             if any(auto.values()):
                 srv["autoscale"] = auto
+            # the compiled-memory audit family reports (correctly
+            # typed) under out["memory"]["audit"]["serving"] instead
+            for k in [k for k in srv if k.startswith("mem.")]:
+                srv.pop(k)
             out["serving"] = srv
 
     # ---- serving SLO percentiles (ServingEngine.export_slo_jsonl
