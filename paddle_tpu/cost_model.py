@@ -611,7 +611,8 @@ def serving_memory_ledger(cfg, family: str = "gpt",
                           num_slots: int = 8, max_len: int = 0,
                           page_size: int = 16, num_pages: int = 0,
                           cache_bytes_per_elem: int = 2,
-                          dtype_bytes: int = 0, tp: int = 1) -> dict:
+                          dtype_bytes: int = 0, tp: int = 1,
+                          host_kv_bytes: int = 0) -> dict:
     """Per-chip HBM bytes for a serving-engine configuration,
     attributed to named components — the serving sibling of
     train_memory_ledger and the formula home for
@@ -626,9 +627,15 @@ def serving_memory_ledger(cfg, family: str = "gpt",
     - weights_quant / weights_quant_scales: the int8 payloads
       (L stacked layers + the transposed head copy) and their f32
       per-output-channel scales — the "quantized pairs";
-    - kv_pool: dense — k+v for every slot at full max_len; paged —
-      the page pool ([L, num_pages, page_size] k+v, engine default
-      num_slots*max_pages + 1 pages) plus the i32 page table;
+    - kv_pool_device: dense — k+v for every slot at full max_len;
+      paged — the page pool ([L, num_pages, page_size] k+v, engine
+      default num_slots*max_pages + 1 pages) plus the i32 page table.
+      DEVICE HBM only: pages spilled to the host tier are priced in
+      kv_pool_host, never here (spilled pages are NOT device-resident);
+    - kv_pool_host: the host-tier KV bytes (inference/host_kv.py) —
+      host RAM, so it is EXCLUDED from `total`/`unsharded` (which are
+      device-HBM envelopes) and reported separately as `host_total`;
+      the host copy is whole (not tp-sharded);
     - decode_scratch: the per-tick working set — f32 logits for every
       scored row plus the hidden/residual activations.
 
@@ -669,19 +676,26 @@ def serving_memory_ledger(cfg, family: str = "gpt",
                    * cache_bytes_per_elem)
     scratch = num_slots * (V * 4.0 + 2.0 * D * dtype_bytes)
     components = {"weights": weights, "weights_quant": w_quant,
-                  "weights_quant_scales": w_scales, "kv_pool": kv_pool,
+                  "weights_quant_scales": w_scales,
+                  "kv_pool_device": kv_pool,
                   "decode_scratch": scratch}
     unsharded = sum(components.values())
     tp = max(int(tp), 1)
-    return {"components": {k: v / tp for k, v in components.items()},
+    sharded = {k: v / tp for k, v in components.items()}
+    # the host tier is host RAM: added AFTER the tp division (every
+    # host holds its whole copy) and excluded from the device totals
+    sharded["kv_pool_host"] = float(host_kv_bytes)
+    return {"components": sharded,
             "total": unsharded / tp, "unsharded": unsharded,
+            "host_total": float(host_kv_bytes),
             "config": {"family": family, "layout": layout,
                        "quant": quant, "num_slots": int(num_slots),
                        "max_len": max_len, "page_size": int(page_size),
                        "num_pages": n_pages, "tp": tp,
                        "cache_bytes_per_elem": cache_bytes_per_elem,
                        "dtype_bytes": dtype_bytes,
-                       "n_params": n_params}}
+                       "n_params": n_params,
+                       "host_kv_bytes": int(host_kv_bytes)}}
 
 
 def jnp_dtype_bytes(dtype, default: int = 4) -> int:

@@ -130,13 +130,22 @@ class Autoscaler:
         self._m_target.set(len(router.dispatchable()))
 
     # ----------------------------------------------------------- signals
-    def occupancy(self) -> float:
+    def occupancy(self, role: str = None) -> float:
         """Demand over capacity across the dispatchable fleet; +inf
         when demand exists but nothing admits (all draining/dead) —
-        the strongest possible scale-out signal."""
+        the strongest possible scale-out signal. With a `role`
+        ("prefill" | "decode") the signal narrows to that capability
+        pool: router-queued requests are demand on the PREFILL pool
+        (they are waiting to be prefilled), in-flight load counts
+        against whichever pool holds it."""
         reps = self.router.dispatchable()
-        demand = (len(self.router._pending)
-                  + sum(r.load() for r in reps))
+        if role == "prefill":
+            reps = [r for r in reps if r.can_prefill]
+        elif role == "decode":
+            reps = [r for r in reps if r.can_decode]
+        demand = sum(r.load() for r in reps)
+        if role != "decode":
+            demand += len(self.router._pending)
         cap = sum(r.eng.num_slots for r in reps)
         if cap == 0:
             return float("inf") if demand else 0.0
@@ -156,9 +165,24 @@ class Autoscaler:
     # -------------------------------------------------------- the tick
     def tick(self) -> Optional[str]:
         """One control decision. Returns "scale_out" / "scale_in" when
-        an action fired, else None. Call once per router step."""
+        an action fired, else None. Call once per router step.
+
+        Over a role-split fleet (any replica with role != "any") the
+        loop is PER-POOL: scale-out targets the breaching capability
+        pool (a prefill flood spawns a prefill replica and leaves the
+        decode pool alone — the disaggregation isolation property),
+        the spawned replica inherits that role (the `spawn` factory
+        may accept a `role=` kwarg; a factory without one still
+        works), and scale-in never drains the last replica of a
+        capability."""
         cfg = self.cfg
-        occ = self.occupancy()
+        role_aware = any(r.role != "any" for r in self.router.replicas)
+        if role_aware:
+            occ_by = {"prefill": self.occupancy("prefill"),
+                      "decode": self.occupancy("decode")}
+            occ = max(occ_by.values())
+        else:
+            occ = self.occupancy()
         self._m_occ.set(0.0 if occ == float("inf") else occ)
         breach = (occ >= cfg.scale_out_occupancy
                   or self.burn() >= cfg.burn_threshold)
@@ -172,19 +196,28 @@ class Autoscaler:
             return None
         n = len(self.router.dispatchable())
         if self._breach >= cfg.breach_ticks and n < cfg.max_replicas:
-            idx = self.router.spawn_replica(self.spawn())
+            role = ("any" if not role_aware
+                    else max(occ_by, key=occ_by.get))
+            idx = self.router.spawn_replica(self._spawn(role), role=role)
             self._after_action(now, occ, n + 1)
             self._m_out.add()
-            self._flight.note(autoscale_scale_out=idx,
+            self._flight.note(autoscale_scale_out=idx, role=role,
                               occupancy=round(min(occ, 1e9), 3),
                               replicas=n + 1)
             self._flight.dump("autoscale_scale_out")
             return "scale_out"
         if self._idle >= cfg.idle_ticks and n > cfg.min_replicas:
             # drain the least-loaded dispatchable replica — its live
-            # requests migrate out, the router releases it when empty
-            victim = min(self.router.dispatchable(),
-                         key=lambda r: (r.load(), -r.idx))
+            # requests migrate out, the router releases it when empty.
+            # Role-split: a replica whose drain would zero out a
+            # capability pool is not a candidate
+            cands = self.router.dispatchable()
+            if role_aware:
+                cands = [r for r in cands
+                         if not self._last_of_capability(r)]
+                if not cands:
+                    return None
+            victim = min(cands, key=lambda r: (r.load(), -r.idx))
             self.router.drain_replica(victim.idx, migrate=True)
             self._after_action(now, occ, n - 1)
             self._m_in.add()
@@ -193,6 +226,31 @@ class Autoscaler:
             self._flight.dump("autoscale_scale_in")
             return "scale_in"
         return None
+
+    def _spawn(self, role: str):
+        """Call the user's spawn factory, forwarding the target role
+        when the factory takes one (a role-oblivious factory — the
+        pre-disaggregation signature — still works: every engine is
+        role-capable, the role only steers the ROUTER's placement)."""
+        if role != "any":
+            import inspect
+            try:
+                params = inspect.signature(self.spawn).parameters
+                takes_role = ("role" in params
+                              or any(p.kind is p.VAR_KEYWORD
+                                     for p in params.values()))
+            except (TypeError, ValueError):   # builtins/C callables
+                takes_role = False
+            if takes_role:
+                return self.spawn(role=role)
+        return self.spawn()
+
+    def _last_of_capability(self, rep) -> bool:
+        """True when draining `rep` would leave the dispatchable set
+        without prefill or without decode capability."""
+        rest = [r for r in self.router.dispatchable() if r is not rep]
+        return (not any(r.can_prefill for r in rest)
+                or not any(r.can_decode for r in rest))
 
     def _after_action(self, now: float, occ: float, target: int) -> None:
         self._last_action = now

@@ -43,6 +43,20 @@ already terminal on the dead replica stay resolved (never re-run); a
 death with zero live replicas left resolves everything "evicted"
 (never limbo). Every death leaves a flight-recorder dump.
 
+Prefill/decode disaggregation (`roles=`): replicas can specialize —
+"prefill" replicas take ALL new admissions (chunked prefill and the
+first tokens), and the per-tick handoff sweep moves each stream to a
+"decode" replica the moment its prefill finishes, through the SAME
+live-migration seam deaths use (zero re-prefilled tokens:
+serving.prefills stays equal to requests submitted; bit-identical
+continuation). A prefill flood therefore queues against the prefill
+pool while decode replicas keep their tick cadence — decode ITL p99
+stays flat (tools/bench_serving.py --role-split is the A/B). Roles
+are placement PREFERENCES, not availability constraints: when the
+fleet degrades to one capability, prefill_targets/decode_targets fall
+back to the full dispatchable set (chaos_serving prefill_role_death
+pins that requests still resolve).
+
 Fleet elasticity (`spawn_replica` / `drain_replica`) is the seam
 `inference/autoscale.py`'s control loop drives: spawn adds a warm
 engine to the rotation; drain flips a replica to DRAINING (admits
@@ -138,15 +152,35 @@ class RouterRequest:
                 f"requeues={self.requeues}, done={self.done})")
 
 
+ROLES = ("any", "prefill", "decode")
+
+
 class _Replica:
-    def __init__(self, idx: int, eng: ServingEngine):
+    def __init__(self, idx: int, eng: ServingEngine, role: str = "any"):
+        if role not in ROLES:
+            raise ValueError(f"replica role {role!r} (any|prefill|decode)")
         self.idx = idx
         self.eng = eng
+        # disaggregation role: "prefill" replicas admit new requests
+        # (chunked prefill + first tokens) and hand mid-decode streams
+        # off to "decode" replicas; "any" does both. The role is a
+        # ROUTER placement preference — the engine underneath always
+        # runs whatever it holds, so a request on a prefill replica
+        # keeps decoding in place until a handoff slot frees (no stall)
+        self.role = role
         self.alive = True
         self.draining = False           # admits nothing, still stepped
         self.inner = {}                 # inner request id -> RouterRequest
         self.m_depth = monitor.gauge(f"serving.router.queue_depth.r{idx}")
         self.m_disp = monitor.counter(f"serving.router.dispatched.r{idx}")
+
+    @property
+    def can_prefill(self) -> bool:
+        return self.role != "decode"
+
+    @property
+    def can_decode(self) -> bool:
+        return self.role != "prefill"
 
     def load(self) -> int:
         """In-flight demand: occupied slots (active or mid-prefill) +
@@ -177,13 +211,32 @@ class EngineRouter:
     def __init__(self, engines: Sequence[ServingEngine],
                  max_queue: int = 0, queue_policy: str = "reject",
                  concurrent: bool = True, tracing: bool = False,
-                 clock=None):
+                 clock=None, roles: Optional[Sequence[str]] = None):
         if not engines:
             raise ValueError("EngineRouter needs >= 1 engine replica")
         if queue_policy not in ("reject", "shed_oldest"):
             raise ValueError(f"queue_policy {queue_policy!r} "
                              "(reject|shed_oldest)")
-        self.replicas = [_Replica(i, e) for i, e in enumerate(engines)]
+        # prefill/decode disaggregation (docs/serving.md §Disaggregation):
+        # roles aligns with `engines`; None = homogeneous "any" fleet
+        # (the pre-role behavior, bit-for-bit). A role-split fleet must
+        # start with both capabilities present — degradation below that
+        # is handled at dispatch time (availability beats specialization)
+        if roles is not None:
+            roles = list(roles)
+            if len(roles) != len(engines):
+                raise ValueError(f"roles ({len(roles)}) must match "
+                                 f"engines ({len(engines)})")
+            if not any(r != "decode" for r in roles):
+                raise ValueError("role split needs >= 1 prefill-capable "
+                                 "replica (any|prefill)")
+            if not any(r != "prefill" for r in roles):
+                raise ValueError("role split needs >= 1 decode-capable "
+                                 "replica (any|decode)")
+        else:
+            roles = ["any"] * len(engines)
+        self.replicas = [_Replica(i, e, role=r)
+                         for i, (e, r) in enumerate(zip(engines, roles))]
         self.max_queue = int(max_queue)       # bound on the ROUTER queue
         self.queue_policy = queue_policy
         # concurrent=True steps the replicas in parallel threads: each
@@ -233,6 +286,9 @@ class EngineRouter:
         self._m_mig_bytes = monitor.gauge(
             "serving.autoscale.migrated_pages_bytes")
         self._mig_bytes = 0                   # cumulative KV bytes moved
+        # prefill->decode stream handoffs (the disaggregation seam) —
+        # a subset of serving.autoscale.migrations
+        self._m_handoff = monitor.counter("serving.router.handoffs")
         self._m_live.set(len(self.replicas))
 
     # ------------------------------------------------------- observables
@@ -245,6 +301,22 @@ class EngineRouter:
         """Replicas that admit NEW work: live and not draining — the
         placement set for dispatch and migration targets."""
         return [r for r in self.replicas if r.alive and not r.draining]
+
+    def prefill_targets(self) -> List[_Replica]:
+        """Dispatchable replicas whose role admits NEW requests
+        (prefill-capable). Falls back to the FULL dispatchable set when
+        the role split has degraded to zero prefill-capable replicas —
+        role purity is a latency preference, never an availability
+        constraint (the prefill_role_death drill pins this)."""
+        caps = [r for r in self.dispatchable() if r.can_prefill]
+        return caps if caps else self.dispatchable()
+
+    def decode_targets(self) -> List[_Replica]:
+        """Dispatchable replicas whose role holds mid-decode streams —
+        migration/handoff placement. Same availability fallback as
+        prefill_targets."""
+        caps = [r for r in self.dispatchable() if r.can_decode]
+        return caps if caps else self.dispatchable()
 
     def has_work(self) -> bool:
         return (bool(self._pending)
@@ -259,9 +331,10 @@ class EngineRouter:
                 "pending": len(self._pending),
                 "requeues": self._m_requeue.value,
                 "migrations": self._m_mig.value,
+                "handoffs": self._m_handoff.value,
                 "per_replica": [
                     {"idx": r.idx, "alive": r.alive,
-                     "draining": r.draining,
+                     "draining": r.draining, "role": r.role,
                      "load": r.load() if r.alive else 0,
                      "dispatched": r.m_disp.value}
                     for r in self.replicas]}
@@ -359,7 +432,10 @@ class EngineRouter:
             return True                   # resolved — nothing to place
         never_fits = 0
         t_disp0 = self._clock()
-        live = sorted(self.dispatchable(), key=_Replica.load)
+        # NEW requests land on prefill-capable replicas only — a
+        # prefill flood then queues against the prefill pool while
+        # decode replicas keep their tick cadence (ITL p99 flat)
+        live = sorted(self.prefill_targets(), key=_Replica.load)
         for rep in live:
             try:
                 inner = rep.eng.submit(
@@ -436,6 +512,7 @@ class EngineRouter:
                     outer.tokens.append(int(tok))
                     events.append((outer, int(tok)))
             self._sweep_terminals(rep)
+        self._sweep_handoffs()
         for rep in self.replicas:
             # graceful-drain release: a draining replica leaves the
             # rotation at the FIRST tick it holds no work — every
@@ -479,6 +556,30 @@ class EngineRouter:
                     if outer._inner is not None and outer._inner.done]:
             outer = rep.inner.pop(iid)
             self._finish(outer, outer._inner.finish_reason)
+
+    def _sweep_handoffs(self) -> None:
+        """Disaggregation seam: every request on a "prefill"-role
+        replica that has FINISHED its chunked prefill (it holds a live
+        slot and `_pf_next is None`) moves to a decode replica through
+        the live-migration path — host KV snapshot, zero re-prefilled
+        tokens (`serving.prefills` stays == requests submitted),
+        bit-identical stream continuation. A request that cannot move
+        yet (decode pool full) keeps decoding IN PLACE on the prefill
+        replica and retries next tick — handoff is a latency
+        optimization, never a stall."""
+        for rep in self.live():
+            if rep.role != "prefill" or not rep.inner:
+                continue
+            targets = [r for r in self.dispatchable() if r.can_decode]
+            if not targets:
+                return
+            for outer in list(rep.inner.values()):
+                inner = outer._inner
+                if (outer.done or inner is None or inner.slot is None
+                        or inner._pf_next is not None):
+                    continue              # queued / mid-prefill / gone
+                if self._migrate(outer, rep, targets=targets):
+                    self._m_handoff.add()
 
     def _publish_gauges(self) -> None:
         self._m_live.set(len(self.live()))
@@ -544,21 +645,24 @@ class EngineRouter:
         return n
 
     # ------------------------------------------------- fleet elasticity
-    def spawn_replica(self, engine: ServingEngine) -> int:
-        """Scale OUT: add a warm `engine` to the rotation and return
-        its replica index. The engine must share params/config with
-        the fleet (greedy bit-parity across replicas assumes it); the
+    def spawn_replica(self, engine: ServingEngine,
+                      role: str = "any") -> int:
+        """Scale OUT: add a warm `engine` to the rotation (with a
+        disaggregation `role`, default "any") and return its replica
+        index. The engine must share params/config with the fleet
+        (greedy bit-parity across replicas assumes it); the
         autoscaler's `spawn` factory owns that construction. Joins
         the dispatchable set immediately — the next `step()` places
         queued work on it. Leaves a flight-recorder dump."""
-        rep = _Replica(len(self.replicas), engine)
+        rep = _Replica(len(self.replicas), engine, role=role)
         self.replicas.append(rep)
         if self._exec is not None:
             # the lazy executor was sized for the OLD fleet — rebuild
             # next tick so every live replica still gets its own worker
             self._exec.shutdown(wait=False)
             self._exec = None
-        self._flight.note(router_spawn=rep.idx, tick=self._ticks,
+        self._flight.note(router_spawn=rep.idx, role=role,
+                          tick=self._ticks,
                           replicas_live=len(self.live()))
         self._flight.dump("router_scale_out")
         self._publish_gauges()
@@ -598,7 +702,8 @@ class EngineRouter:
         self._flight.dump("router_release")
 
     # ----------------------------------------------------- live migration
-    def _migrate(self, outer: RouterRequest, src: _Replica) -> bool:
+    def _migrate(self, outer: RouterRequest, src: _Replica,
+                 targets: Optional[List[_Replica]] = None) -> bool:
         """Move `outer` mid-decode from `src` to a dispatchable
         survivor via host KV snapshot — the zero-re-prefill path.
         Order is snapshot -> restore -> detach so any failure leaves
@@ -626,8 +731,17 @@ class EngineRouter:
             src.inner.pop(inner.id, None)
             self._finish(outer, "timeout")
             return True                        # resolved, nothing to move
-        targets = sorted((r for r in self.dispatchable()
-                          if r is not src), key=_Replica.load)
+        if targets is None:
+            # a migrating request is mid-decode by construction
+            # (snapshot_request refuses mid-prefill), so decode-capable
+            # replicas come first; prefill-role replicas remain a
+            # last-resort landing zone under fleet degradation
+            targets = sorted((r for r in self.dispatchable()
+                              if r is not src),
+                             key=lambda r: (not r.can_decode, r.load()))
+        else:
+            targets = sorted((r for r in targets if r is not src),
+                             key=_Replica.load)
         for dst in targets:
             try:
                 new_inner = dst.eng.restore_request(
@@ -747,6 +861,7 @@ def create_router(params, cfg, replicas: int = 2, family: str = "gpt",
                   concurrent: bool = True,
                   meshes: Optional[Sequence] = None,
                   tracing: bool = False, clock=None,
+                  roles: Optional[Sequence[str]] = None,
                   **engine_kw) -> EngineRouter:
     """Build an EngineRouter over `replicas` identical ServingEngines
     sharing ONE param tree (read-only at decode — on a single host the
@@ -759,7 +874,9 @@ def create_router(params, cfg, replicas: int = 2, family: str = "gpt",
     `telemetry_jsonl=` engine kwarg fans out per replica
     (`<path>.r<i>`), so each replica streams its own serving_tick
     JSONL — the per-replica files tools/telemetry_report.py's fleet
-    mode merges."""
+    mode merges. `roles` (aligned with replica index, values
+    any|prefill|decode) turns on prefill/decode disaggregation —
+    docs/serving.md §Disaggregation."""
     if replicas < 1:
         raise ValueError(f"replicas must be >= 1; got {replicas}")
     if meshes is not None and len(meshes) != replicas:
@@ -774,4 +891,4 @@ def create_router(params, cfg, replicas: int = 2, family: str = "gpt",
                for i in range(replicas)]
     return EngineRouter(engines, max_queue=max_queue,
                         queue_policy=queue_policy, concurrent=concurrent,
-                        tracing=tracing, clock=clock)
+                        tracing=tracing, clock=clock, roles=roles)

@@ -377,6 +377,12 @@ class _PagePool:
         self.by_key: dict = {}               # prefix key -> page_id
         self.key_of: dict = {}               # page_id -> prefix key
         self.reserved = 0                    # admission reservations
+        # eviction tap (host-tier KV offload): called as on_evict(pid,
+        # key) just before a registered page's LRU eviction drops its
+        # prefix-map entry — the engine's spill hook copies the page to
+        # host there, so "evicted from device" means "demoted to the
+        # host tier", not "gone"
+        self.on_evict = None
 
     def available(self) -> int:
         """Pages a new admission may still reserve: free + evictable
@@ -392,6 +398,8 @@ class _PagePool:
             pid = self.free.pop()
         elif self.cached:
             pid, key = self.cached.popitem(last=False)     # LRU
+            if self.on_evict is not None:
+                self.on_evict(pid, key)
             del self.by_key[key]
             del self.key_of[pid]
         else:
@@ -732,7 +740,8 @@ class ServingEngine:
                  draft_layers: int = 0, mesh=None, tp_axis: str = "tp",
                  quant: str = "auto", telemetry: str = "auto",
                  telemetry_jsonl: Optional[str] = None,
-                 telemetry_every: int = 32, tracing: bool = False):
+                 telemetry_every: int = 32, tracing: bool = False,
+                 multi_tick: int = 0, host_kv_bytes: int = 0):
         self.family = (family_for(family) if isinstance(family, str)
                        else family)
         self.cfg = cfg
@@ -791,6 +800,18 @@ class ServingEngine:
                     f"family {self.family.name!r}: forward_cached does "
                     "not accept layers= — the truncated-depth self-draft "
                     "needs it (see models/gpt.py gpt_forward_cached)")
+        # --------------------------------------------- fused multi-tick
+        # knob 0/'auto' consults env > registry ('multi_tick') > off;
+        # PADDLE_TPU_MULTI_TICK's off values kill-switch even an
+        # explicit K (inference/multi_tick.resolve_multi_tick). K is
+        # BAKED into the decode executable (a lax.scan of length K), so
+        # the jit cache keys of engines with different K never collide.
+        from .multi_tick import resolve_multi_tick
+        self.mt_k = resolve_multi_tick(multi_tick)
+        # per-dispatch emission width: how many tokens one host pull
+        # may carry per slot (spec emits gamma+1 columns per tick)
+        self._tick_span = self.mt_k * ((self.spec_gamma + 1)
+                                       if self.spec else 1)
         # ------------------------------------------------- cache layout
         if kv_layout == "auto":
             from ..kernels.decode_attention import decode_attn_impl
@@ -897,6 +918,13 @@ class ServingEngine:
         self._top_ks = np.zeros(n, np.int32)
         self._req_ids = np.zeros(n, np.int32)
         self._gen_idx = np.zeros(n, np.int32)     # next sample index
+        # multi-tick early-exit inputs (EOS id, -1 = none; token
+        # budget): host mirrors here, device copies in _daux, rebuilt
+        # alongside the state tuple when _dirty (multi_tick.py scans
+        # retire slots ON DEVICE by these rules)
+        self._eos_ids = np.full(n, -1, np.int32)
+        self._max_new = np.zeros(n, np.int32)
+        self._daux = None
         self._dstate = None                       # device state tuple
         self._dirty = True
         self._slot_req: List[Optional[Request]] = [None] * n
@@ -932,8 +960,10 @@ class ServingEngine:
                       "layout": "paged" if self.paged else "dense",
                       "spec": bool(self.spec),
                       "quant": "int8" if self.quant else "off",
+                      "multi_tick": self.mt_k,
                       "tp": self.tp, "num_slots": self.num_slots,
-                      "max_len": self.max_len})
+                      "max_len": self.max_len},
+                on_flush=self._publish_tier_gauges)
         # ---------------------------------------- request-scoped traces
         # opt-in (tracing=True): submit() mints a RequestTrace
         # (profiler/tracing) and the scheduler emits parented spans
@@ -996,6 +1026,26 @@ class ServingEngine:
             self._publish_pool_gauges()
         else:
             self._m_kv_bytes.set(2 * _kb.nbytes)
+        # ------------------------------------------ host-tier KV offload
+        # paged + prefix_sharing only: the pool's LRU eviction demotes
+        # registered pages to host ndarrays instead of dropping them,
+        # and admission swaps them back (inference/host_kv.py). 0 = off;
+        # PADDLE_TPU_HOST_KV kill-switches an explicit cap.
+        from .host_kv import resolve_host_kv
+        self.host_kv_bytes = resolve_host_kv(host_kv_bytes)
+        self._host_tier = None
+        self._host_stage: dict = {}    # prefix key -> (dk, dv) prefetch
+        if self.paged and self.prefix_sharing and self.host_kv_bytes > 0:
+            from .host_kv import HostKVTier
+            self._host_tier = HostKVTier(self.host_kv_bytes)
+            self._pool.on_evict = self._spill_page
+        # disaggregation surface: gauges ride the telemetry flush
+        # cadence via on_flush (zero extra device pulls)
+        self._m_kv_host = monitor.gauge("serving.kv_host_bytes")
+        self._m_ticks_pull = monitor.gauge("serving.ticks_per_pull")
+        self._m_ticks_pull.set(self.mt_k)
+        self._m_spill = monitor.counter("serving.host_spills")
+        self._m_swapin = monitor.counter("serving.host_swapins")
         # speculative-decode surface (stay 0 with spec off): proposed =
         # gamma per greedy slot per tick, accepted = drafts the verify
         # kept; the rate gauge is THIS ENGINE's cumulative
@@ -1112,7 +1162,35 @@ class ServingEngine:
         run_cfg = self._run_cfg
         self._repin = None      # lazy identity re-pin (see _pin_cache_host)
         _oor = (self.max_pages * self.page_size if self.paged else None)
-        if self.spec:
+        if self.mt_k > 1 and self.spec:
+            from .multi_tick import multi_tick_spec_scan
+            self._decode = jax.jit(
+                functools.partial(multi_tick_spec_scan,
+                                  fwd=self.family.forward_cached,
+                                  cfg=run_cfg, max_top_k=self.max_top_k,
+                                  guard=self.guardrails,
+                                  gamma=self.spec_gamma,
+                                  draft_layers=self.spec_draft_layers,
+                                  k_ticks=self.mt_k,
+                                  max_len=self.max_len,
+                                  oor_pos=_oor,
+                                  cache_pin=self._cache_pin,
+                                  tele=self._tick_tele),
+                donate_argnums=(1, 2), static_argnames=("sampling",))
+        elif self.mt_k > 1:
+            from .multi_tick import multi_tick_scan
+            self._decode = jax.jit(
+                functools.partial(multi_tick_scan,
+                                  fwd=self.family.forward_cached,
+                                  cfg=run_cfg, max_top_k=self.max_top_k,
+                                  guard=self.guardrails,
+                                  k_ticks=self.mt_k,
+                                  max_len=self.max_len,
+                                  oor_pos=_oor,
+                                  cache_pin=self._cache_pin,
+                                  tele=self._tick_tele),
+                donate_argnums=(1, 2), static_argnames=("sampling",))
+        elif self.spec:
             from .spec_decode import spec_tick
             self._decode = jax.jit(
                 functools.partial(spec_tick,
@@ -1166,6 +1244,8 @@ class ServingEngine:
         st["layout"] = "paged"
         st["cow_copies"] = self._m_cow.value
         st["prefill_chunks"] = self._m_chunks.value
+        if self._host_tier is not None:
+            st["host_tier"] = self._host_tier.stats()
         return st
 
     def quant_stats(self) -> dict:
@@ -1183,6 +1263,55 @@ class ServingEngine:
         self._m_pages.set(pages)
         self._m_shared.set(int((self._pool.ref[1:] > 1).sum()))
         self._m_kv_bytes.set(pages * self._page_bytes)
+
+    def _publish_tier_gauges(self) -> None:
+        """Disaggregation gauges: host-side bookkeeping only (zero
+        extra device pulls), published on the telemetry FLUSH cadence
+        (ServingTelemetry on_flush=) and with the per-step pool gauges.
+        The spill/swap-in COUNTERS advance at event time instead
+        (_spill_page / _admit_paged) — process-global counters can't
+        take last-writer deltas with several engines alive."""
+        self._m_ticks_pull.set(self.mt_k)
+        if self._host_tier is not None:
+            self._m_kv_host.set(self._host_tier.bytes)
+
+    # ---------------------------------------------- host-tier KV offload
+    def _spill_page(self, pid: int, key) -> None:
+        """_PagePool.on_evict tap: demote the evicting registered page
+        to the host tier before its prefix-map entry drops. The page is
+        FROZEN (registered => COW-immutable), so the copy taken here is
+        bit-identical to what a device hit would read; the engine is
+        single-threaded, so the pool never evicts mid-write. Skips keys
+        the tier already holds (a page that round-tripped host ->
+        device -> eviction again)."""
+        if self._host_tier is None or key in self._host_tier:
+            return
+        k_np = np.asarray(self._cache["k"][:, pid])
+        v_np = np.asarray(self._cache["v"][:, pid])
+        if self._host_tier.put(key, k_np, v_np):
+            self._m_spill.add()
+
+    def _prefetch_host(self, req: "Request") -> None:
+        """Asynchronous swap-in ahead of admission: while the head-of-
+        line request WAITS for capacity, start `jax.device_put` uploads
+        of the host-tier pages its prefix walk will hit, so by the time
+        `_admit_paged` maps them the transfers have overlapped the
+        wait. Staged uploads park in `_host_stage` (key -> (dk, dv))
+        and are consumed (or dropped) by the next admission of that
+        key; idempotent per key."""
+        if self._host_tier is None or not self.prefix_sharing:
+            return
+        ps = self.page_size
+        toks = req.prompt
+        for j in range(len(toks) // ps):
+            key = _prefix_key(toks, (j + 1) * ps)
+            if key in self._host_stage or key in self._pool.by_key:
+                continue
+            pair = self._host_tier.get(key)
+            if pair is None:
+                break        # tier walk stops at the first miss too
+            self._host_stage[key] = (self._rep(pair[0]),
+                                     self._rep(pair[1]))
 
     # ------------------------------------------------- memory observability
     def memory_ledger(self) -> dict:
@@ -1202,7 +1331,9 @@ class ServingEngine:
             num_pages=self.num_pages if self.paged else 0,
             cache_bytes_per_elem=int(self._cache["k"].dtype.itemsize),
             dtype_bytes=jnp_dtype_bytes(getattr(self.cfg, "dtype", None)),
-            tp=self.tp)
+            tp=self.tp,
+            host_kv_bytes=(int(self._host_tier.bytes)
+                           if self._host_tier is not None else 0))
 
     def compiled_memory_stats(self, sampling: bool = False) -> dict:
         """XLA's compiled memory accounting for THIS engine's decode
@@ -1229,6 +1360,11 @@ class ServingEngine:
                 dstate, aval(self._base_key), aval(self._poison_ones)]
         if self.spec:
             args.append(aval(self._poison_ones))
+        if self.mt_k > 1:
+            args += [jax.ShapeDtypeStruct(self._eos_ids.shape,
+                                          self._eos_ids.dtype),
+                     jax.ShapeDtypeStruct(self._max_new.shape,
+                                          self._max_new.dtype)]
         compiled = self._decode.lower(
             *args, sampling=bool(sampling)).compile()
         return compiled_memory_stats(compiled)
@@ -1404,6 +1540,10 @@ class ServingEngine:
             if (self.paged
                     and self._plan_admission(head)[4]
                     > self._pool.available()):
+                # overlap the wait: start device_put uploads of the
+                # host-tier pages this head's prefix walk will hit, so
+                # admission maps already-transferred buffers
+                self._prefetch_host(head)
                 break       # head-of-line waits for pages (FCFS); live
                 #             slots free pages as they finish
             self._queue.popleft()
@@ -1419,6 +1559,7 @@ class ServingEngine:
         self._m_occ.set(int(self._active.sum()))
         self._m_queue.set(len(self._queue))
         self._publish_pool_gauges()
+        self._publish_tier_gauges()
         return events
 
     def drain(self, max_ticks: Optional[int] = None):
@@ -1500,6 +1641,8 @@ class ServingEngine:
         self._temps[slot] = 0.0
         self._top_ks[slot] = 0
         self._gen_idx[slot] = 0
+        self._eos_ids[slot] = -1
+        self._max_new[slot] = 0
         self._dirty = True
         if self.paged:
             row = self._ptab[slot]
@@ -1627,8 +1770,13 @@ class ServingEngine:
             if req is not None:
                 self._finish(req, "evicted")
         if self.paged:
-            # prefix-map contents died with the buffers: fresh pool
+            # prefix-map contents died with the buffers: fresh pool.
+            # The host tier SURVIVES (its pages are deterministic
+            # functions of prompt + params, still bit-valid) — only
+            # the eviction tap re-attaches
             self._pool = _PagePool(self.num_pages, self.page_size)
+            if self._host_tier is not None:
+                self._pool.on_evict = self._spill_page
             self._ptab[:] = 0
             self._slot_reserve[:] = 0
             self._prefilling.clear()
@@ -1751,6 +1899,11 @@ class ServingEngine:
                         self._rep(self._top_ks),
                         self._rep(self._req_ids),
                         self._rep(self._gen_idx))
+                    if self.mt_k > 1:
+                        # the scan's early-exit inputs ride the same
+                        # dirty-rebuild cadence as the state tuple
+                        self._daux = (self._rep(self._eos_ids),
+                                      self._rep(self._max_new))
                     self._dirty = False
                 sampling = bool(np.any(self._temps[self._active] > 0.0))
                 poison = self._poison_ones
@@ -1768,14 +1921,17 @@ class ServingEngine:
                             dp[int(draft_slot) % self.num_slots] = np.nan
                             dpoison = self._rep(dp)
                         draft_slot = None     # injected at most once
-                        out = self._decode(
-                            self._params, self._cache, self._dstate,
-                            self._base_key, poison, dpoison,
-                            sampling=sampling)
+                        args = (self._params, self._cache, self._dstate,
+                                self._base_key, poison, dpoison)
+                        if self.mt_k > 1:
+                            args += self._daux
+                        out = self._decode(*args, sampling=sampling)
                     else:
-                        out = self._decode(
-                            self._params, self._cache, self._dstate,
-                            self._base_key, poison, sampling=sampling)
+                        args = (self._params, self._cache, self._dstate,
+                                self._base_key, poison)
+                        if self.mt_k > 1:
+                            args += self._daux
+                        out = self._decode(*args, sampling=sampling)
                     # ONE host pull per tick ([N] non-spec; the
                     # [N, gamma+1] emission matrix under spec) — with
                     # in-tick telemetry the TICK_FIELDS row rides the
@@ -1825,6 +1981,9 @@ class ServingEngine:
         if self.spec:
             self._apply_spec_emissions(toks, events, tick_now)
             return
+        if self.mt_k > 1:
+            self._apply_multi_emissions(toks, events, tick_now)
+            return
         for i in np.nonzero(self._active)[0]:
             req = self._slot_req[i]
             tok = int(toks[i])
@@ -1844,12 +2003,17 @@ class ServingEngine:
             self._emit_token(i, req, tok, events, tick_now)
 
     def _emit_token(self, i: int, req: Request, tok: int,
-                    events: list, tick_now: float) -> None:
+                    events: list, tick_now: float,
+                    itl_ms: Optional[float] = None) -> None:
         """The per-token bookkeeping both decode paths share: advance
         the host mirrors (positions/_cur_tok/_gen_idx), record the
         token + SLO sample, and run the finish checks. The non-spec
         tick is the cut=1 case of the spec loop — one seam so a future
-        accounting change can't silently miss one copy."""
+        accounting change can't silently miss one copy. `itl_ms`
+        overrides the wall-clock inter-token sample: a multi-tick pull
+        delivers K tokens at once, and attributing the whole dispatch
+        gap to each would K-fold-inflate the ITL histogram — the
+        caller amortizes the gap across the tokens it carried."""
         self._positions[i] += 1
         self._cur_tok[i] = tok
         self._gen_idx[i] += 1
@@ -1859,7 +2023,8 @@ class ServingEngine:
         req.tokens.append(tok)
         events.append((req, tok))
         self._m_tok.add()
-        self._slo_itl.append((tick_now - req._t_last) * 1e3)
+        self._slo_itl.append((tick_now - req._t_last) * 1e3
+                             if itl_ms is None else itl_ms)
         req._t_last = tick_now
         self._maybe_finish(req)
 
@@ -1877,24 +2042,47 @@ class ServingEngine:
         Under the paged layout, pages past every surviving slot's new
         position are speculative only and roll back to the pool."""
         from .spec_decode import SPEC_PAD
+        width = self.spec_gamma + 1
         for i in np.nonzero(self._active)[0]:
             req = self._slot_req[i]
-            row = [int(t) for t in np.asarray(toks[i]).reshape(-1)]
-            if row[0] < -1:                      # defensive: never PAD
-                row[0] = -1
-            if row[0] < 0:
+            flat = [int(t) for t in np.asarray(toks[i]).reshape(-1)]
+            # the pull is `mt_k` blocks of gamma+1 columns (one block
+            # under the single-dispatch spec tick); an all-PAD block
+            # marks "retired in an earlier scan step" — stop there
+            blocks = []
+            for b in range(len(flat) // width):
+                row = flat[b * width:(b + 1) * width]
+                if b > 0 and row[0] == SPEC_PAD:
+                    break       # dead block: the scan retired this slot
+                if row[0] < -1:                  # defensive: never PAD
+                    row[0] = -1
+                blocks.append(row)
+            poisoned = False
+            emit: List[int] = []
+            for row in blocks:
+                if row[0] < 0:
+                    poisoned = True
+                    break
+                cut = (row.index(SPEC_PAD) if SPEC_PAD in row
+                       else len(row))
+                if self._temps[i] <= 0.0:
+                    # acceptance telemetry counts GREEDY slots only —
+                    # sampled slots never propose
+                    self._spec_prop_total += self.spec_gamma
+                    self._spec_acc_total += cut - 1
+                    self._m_spec_prop.add(self.spec_gamma)
+                    self._m_spec_acc.add(cut - 1)
+                emit.extend(row[:cut])
+            if not blocks or (poisoned and not emit):
                 self._on_fault("poisoned", RuntimeError(
                     f"non-finite logits in slot {i} (request {req.id})"))
                 self._finish(req, "poisoned")
                 continue
-            cut = row.index(SPEC_PAD) if SPEC_PAD in row else len(row)
-            if self._temps[i] <= 0.0:
-                # acceptance telemetry counts GREEDY slots only —
-                # sampled slots never propose
-                self._spec_prop_total += self.spec_gamma
-                self._spec_acc_total += cut - 1
-                self._m_spec_prop.add(self.spec_gamma)
-                self._m_spec_acc.add(cut - 1)
+            # a multi-block pull amortizes the dispatch gap across the
+            # tokens it carried (see _emit_token); the single-block
+            # path keeps the wall-clock sample bit-for-bit as before
+            share = ((tick_now - req._t_last) * 1e3 / max(len(emit), 1)
+                     if len(blocks) > 1 else None)
             # mirror the device advance TOKEN BY TOKEN, not as one
             # block: _maybe_finish's cache-full eviction check reads
             # the position mirror, and advancing the whole block up
@@ -1904,16 +2092,60 @@ class ServingEngine:
             # engine would emit. A surviving slot's mirror still lands
             # exactly at the device's pos + cut; a mid-block finish
             # dirties the device state as before.
-            for tok in row[:cut]:
-                self._emit_token(i, req, tok, events, tick_now)
+            for tok in emit:
+                self._emit_token(i, req, tok, events, tick_now,
+                                 itl_ms=share)
                 if req.done:
                     break
+            if poisoned and not req.done:
+                # a later scan step hit the quarantine after this slot
+                # already emitted real tokens this dispatch: deliver
+                # them, then resolve exactly like the single-tick path
+                self._on_fault("poisoned", RuntimeError(
+                    f"non-finite logits in slot {i} (request {req.id})"))
+                self._finish(req, "poisoned")
         if self._spec_prop_total:
             self._m_spec_rate.set(
                 self._spec_acc_total / self._spec_prop_total)
         if self.paged:
             for i in np.nonzero(self._active)[0]:
                 self._rollback_spec_pages(int(i))
+
+    def _apply_multi_emissions(self, toks, events: list,
+                               tick_now: float) -> None:
+        """Multi-tick (non-spec) post-pull bookkeeping: `toks` is the
+        [N, K] emission matrix from multi_tick_scan — column j = the
+        token scan step j emitted, MT_PAD after the slot's device-side
+        retirement, -1 the quarantine verdict. The host replays the
+        columns through `_emit_token` (same exactly-once terminal seam
+        as the single-tick loop), amortizing the dispatch gap across
+        the K tokens for the ITL histogram; host finish rules fire on
+        the same token the device retired on, so mirrors land exactly
+        where the device state did for surviving slots."""
+        from .multi_tick import MT_PAD
+        for i in np.nonzero(self._active)[0]:
+            req = self._slot_req[i]
+            row = [int(t) for t in np.asarray(toks[i]).reshape(-1)]
+            cut = row.index(MT_PAD) if MT_PAD in row else len(row)
+            row = row[:cut]
+            n_real = sum(1 for t in row if t >= 0)
+            share = (tick_now - req._t_last) * 1e3 / max(n_real, 1)
+            if not row or row[0] < 0:
+                self._on_fault("poisoned", RuntimeError(
+                    f"non-finite logits in slot {i} (request {req.id})"))
+                self._finish(req, "poisoned")
+                continue
+            for tok in row:
+                if tok < 0:
+                    self._on_fault("poisoned", RuntimeError(
+                        f"non-finite logits in slot {i} "
+                        f"(request {req.id})"))
+                    self._finish(req, "poisoned")
+                    break
+                self._emit_token(i, req, tok, events, tick_now,
+                                 itl_ms=share)
+                if req.done:
+                    break
 
     # ---------------------------------------------------------- plumbing
     def _free_slot(self) -> Optional[int]:
@@ -1983,6 +2215,9 @@ class ServingEngine:
         self._top_ks[slot] = req.top_k
         self._req_ids[slot] = req.id
         self._gen_idx[slot] = 1
+        self._eos_ids[slot] = (-1 if req.eos_id is None
+                               else int(req.eos_id))
+        self._max_new[slot] = int(req.max_new_tokens)
         self._dirty = True
         if req.trace is not None:
             req._sp_decode = req.trace.begin(
@@ -2011,23 +2246,36 @@ class ServingEngine:
         suffix always re-runs >= 1 prompt token (the first-token
         logits must be computed), so a fully page-aligned match COWs
         its last matched page (aligned_full) and recomputes the last
-        prompt token into the private copy."""
+        prompt token into the private copy.
+
+        The match is a CHAIN of ("dev", page_id) | ("host", key)
+        entries: the walk consults the device prefix map first, then
+        the host tier (inference/host_kv.py) — a host hit costs one
+        page allocation at admission (the swap-in) but zero recomputed
+        prompt tokens, so `need` credits only device entries."""
         t0 = len(req.prompt)
         ps = self.page_size
-        matched: List[int] = []
+        matched: List[tuple] = []        # ("dev", pid) | ("host", key)
+        n_dev = 0
         if self.prefix_sharing:
             for key in self._prefix_keys(req):
                 pid = self._pool.lookup(key)
-                if pid is None:
+                if pid is not None:
+                    matched.append(("dev", pid))
+                    n_dev += 1
+                elif (self._host_tier is not None
+                      and (key in self._host_stage
+                           or key in self._host_tier)):
+                    matched.append(("host", key))
+                else:
                     break
-                matched.append(pid)
         aligned_full = (bool(matched) and len(matched) == t0 // ps
                         and t0 % ps == 0)
         suffix_start = (t0 - 1) if aligned_full else len(matched) * ps
-        shared_keep = len(matched) - (1 if aligned_full else 0)
-        need = self._pages_needed(t0, req.max_new_tokens) - shared_keep
-        gross = need + sum(1 for pid in matched
-                           if self._pool.ref[pid] == 0)
+        need = (self._pages_needed(t0, req.max_new_tokens) - n_dev
+                + (1 if aligned_full else 0))
+        gross = need + sum(1 for kind, pid in matched
+                           if kind == "dev" and self._pool.ref[pid] == 0)
         if gross > self.num_pages - 1:
             # an aligned-full match costs one page over the bare
             # envelope (the COW of its last matched page); in a pool
@@ -2058,11 +2306,57 @@ class ServingEngine:
         (`step()`) already checked the reservation fits."""
         matched, aligned_full, suffix_start, need, _ = \
             self._plan_admission(req)
+        # capture host-tier page data BEFORE any allocation: alloc()'s
+        # device eviction cascades into the host tier's own LRU, which
+        # could drop a key this very admission still needs. Prefetched
+        # uploads (_prefetch_host) are consumed here; cold hits upload
+        # synchronously.
+        staged = {}
+        for kind, key in matched:
+            if kind != "host" or key in staged:
+                continue
+            pair = self._host_stage.pop(key, None)
+            if pair is None and self._host_tier is not None:
+                hp = self._host_tier.get(key)
+                if hp is not None:
+                    pair = (self._rep(hp[0]), self._rep(hp[1]))
+            if pair is not None:
+                staged[key] = pair
+                continue
+            # defensive: the tier dropped the key since planning —
+            # degrade to an unshared suffix from this page on
+            cutoff = matched.index((kind, key))
+            matched = matched[:cutoff]
+            n_dev = sum(1 for k, _ in matched if k == "dev")
+            aligned_full = False
+            suffix_start = len(matched) * self.page_size
+            need = self._pages_needed(
+                len(req.prompt), req.max_new_tokens) - n_dev
+            break
         self._pool.reserved += need
         self._slot_reserve[slot] = need
-        for j, pid in enumerate(matched):
-            self._pool.retain(pid)
-            self._ptab[slot, j] = pid
+        swapped = False
+        for j, (kind, val) in enumerate(matched):
+            if kind == "dev":
+                self._pool.retain(val)
+                self._ptab[slot, j] = val
+                continue
+            # host swap-in: promote the page back to the device pool,
+            # re-register it under its prefix key (future sharers hit
+            # device again), and map it shared for this slot
+            dk, dv = staged[val]
+            pid = self._alloc_slot_page(slot, j)
+            self._cache["k"] = self._cache["k"].at[:, pid].set(dk)
+            self._cache["v"] = self._cache["v"].at[:, pid].set(dv)
+            self._pool.register(pid, val)
+            if self._host_tier is not None:
+                self._host_tier.swapins += 1
+            self._m_swapin.add()
+            swapped = True
+        if swapped and self._cache_pin:
+            # the eager .at[].set writes ran outside the jitted bodies —
+            # re-assert the pinned layouts (same seam as _restore_into)
+            self._cache = self._pin_cache_host(self._cache)
         if matched:
             self._pt_dirty = True
         req.slot = slot
@@ -2238,7 +2532,7 @@ class ServingEngine:
         positions past it scatter to the scratch page through the
         unmapped table instead of drawing pages the admission never
         reserved)."""
-        span = (self.spec_gamma + 1) if self.spec else 1
+        span = self._tick_span     # K ticks x (gamma+1 under spec)
         for i in np.nonzero(self._active)[0]:
             pos = int(self._positions[i])
             last = pos + span - 1
@@ -2275,7 +2569,7 @@ class ServingEngine:
         # only grow): its last write position is pos_before + gamma
         # <= pos - 1 + gamma, so the scan is O(gamma/page_size), not
         # O(max_pages), per slot per tick
-        last = min((pos + self.spec_gamma - 1) // ps + 1, self.max_pages)
+        last = min((pos + self._tick_span - 2) // ps + 1, self.max_pages)
         for j in range(first, last):
             pid = int(row[j])
             if pid == 0:
@@ -2467,6 +2761,9 @@ class ServingEngine:
         self._top_ks[slot] = req.top_k
         self._req_ids[slot] = int(snap["prng_id"])
         self._gen_idx[slot] = int(snap["gen_idx"])
+        self._eos_ids[slot] = (-1 if req.eos_id is None
+                               else int(req.eos_id))
+        self._max_new[slot] = int(req.max_new_tokens)
         self._dirty = True
         if req.trace is not None:
             req._sp_decode = req.trace.begin(

@@ -147,37 +147,21 @@ def resolve_spec(knob: str) -> bool:
     raise ValueError(f"spec_decode {knob!r} (auto|off|spec)")
 
 
-def spec_tick(params, cache, state, base_key, poison, draft_poison, *,
-              fwd, cfg, max_top_k, sampling, guard, gamma, draft_layers,
-              oor_pos=None, cache_pin=None, tele=False):
-    """THE speculative mixed step (the spec-mode replacement for
-    serving._decode_tick, same state tuple / donation / static
-    `sampling` flag). Per active slot: gamma truncated-depth draft
-    steps propose tokens, one full-depth verify pass scores all
-    gamma+1 positions, and the greedy acceptance rule
-    (models/decode.greedy_accept) picks how many to emit. Returns the
-    [N, gamma+1] emission matrix (column 0 = the always-emitted token
-    or the -1 quarantine sentinel; SPEC_PAD beyond the accepted
-    prefix), the updated cache, and the advanced state.
-
-    `draft_poison` [N] is the draft-lane fault multiplier (all-ones in
-    production; testing.faults draft_nan sets one lane to nan INSIDE
-    the jit): a non-finite draft row forces acceptance 0 — the slot
-    degrades to non-spec decode, never quarantine, because verify row
-    0 is the target's own logits. `poison` is the TARGET lane, handled
-    exactly as in the non-spec tick.
-
-    Tensor-parallel serving (ServingEngine mesh=): the draft's
-    first-K-layers throwaway cache view inherits the pool's head
-    sharding (a leading-axis slice never moves the KV-head axis), the
-    verify pass writes through the same sharded seam, and `cache_pin`
-    pins the returned pool leaves to their input NamedShardings
-    exactly like the non-spec tick (serving._pin_cache) — donation
-    aliases, zero recompiles, still one [N, gamma+1] pull per mesh."""
-    from .serving import _pin_cache, _sample, _slot_keys
+def _spec_core(params, cache, toks, positions, active, temps, top_ks,
+               req_ids, gen_idx, base_key, poison, draft_poison, *,
+               fwd, cfg, max_top_k, sampling, guard, gamma, draft_layers,
+               oor_pos=None):
+    """One propose+verify round over explicit per-slot arrays — the
+    body `spec_tick` wraps for the single-dispatch path and
+    inference/multi_tick.py scans K times with an early-exit alive
+    mask threaded through `active`. Returns (emit [N, gamma+1], cache,
+    new_tok [N], adv [N], m [N]): the emission matrix, the rewritten
+    cache, the last accepted token, the per-slot position/gen advance
+    (m + 1 for active rows, 0 otherwise), and the raw acceptance
+    count."""
+    from .serving import _sample, _slot_keys
     from ..models.decode import greedy_accept
 
-    toks, positions, active, temps, top_ks, req_ids, gen_idx = state
     n = toks.shape[0]
 
     # ---- draft: gamma greedy steps through the first draft_layers
@@ -246,6 +230,47 @@ def spec_tick(params, cache, state, base_key, poison, draft_poison, *,
     adv = jnp.where(active, m + 1, 0).astype(jnp.int32)
     last = jnp.take_along_axis(emit, m[:, None], axis=1)[:, 0]
     new_tok = jnp.where(active, last, toks).astype(jnp.int32)
+    return emit, cache, new_tok, adv, m
+
+
+def spec_tick(params, cache, state, base_key, poison, draft_poison, *,
+              fwd, cfg, max_top_k, sampling, guard, gamma, draft_layers,
+              oor_pos=None, cache_pin=None, tele=False):
+    """THE speculative mixed step (the spec-mode replacement for
+    serving._decode_tick, same state tuple / donation / static
+    `sampling` flag). Per active slot: gamma truncated-depth draft
+    steps propose tokens, one full-depth verify pass scores all
+    gamma+1 positions, and the greedy acceptance rule
+    (models/decode.greedy_accept) picks how many to emit. Returns the
+    [N, gamma+1] emission matrix (column 0 = the always-emitted token
+    or the -1 quarantine sentinel; SPEC_PAD beyond the accepted
+    prefix), the updated cache, and the advanced state. The math
+    lives in `_spec_core` so the fused multi-tick scan
+    (inference/multi_tick.py) can run the same round K times per
+    dispatch with an early-exit mask.
+
+    `draft_poison` [N] is the draft-lane fault multiplier (all-ones in
+    production; testing.faults draft_nan sets one lane to nan INSIDE
+    the jit): a non-finite draft row forces acceptance 0 — the slot
+    degrades to non-spec decode, never quarantine, because verify row
+    0 is the target's own logits. `poison` is the TARGET lane, handled
+    exactly as in the non-spec tick.
+
+    Tensor-parallel serving (ServingEngine mesh=): the draft's
+    first-K-layers throwaway cache view inherits the pool's head
+    sharding (a leading-axis slice never moves the KV-head axis), the
+    verify pass writes through the same sharded seam, and `cache_pin`
+    pins the returned pool leaves to their input NamedShardings
+    exactly like the non-spec tick (serving._pin_cache) — donation
+    aliases, zero recompiles, still one [N, gamma+1] pull per mesh."""
+    from .serving import _pin_cache
+
+    toks, positions, active, temps, top_ks, req_ids, gen_idx = state
+    emit, cache, new_tok, adv, m = _spec_core(
+        params, cache, toks, positions, active, temps, top_ks, req_ids,
+        gen_idx, base_key, poison, draft_poison, fwd=fwd, cfg=cfg,
+        max_top_k=max_top_k, sampling=sampling, guard=guard, gamma=gamma,
+        draft_layers=draft_layers, oor_pos=oor_pos)
     new_state = (new_tok, positions + adv, active, temps, top_ks,
                  req_ids, gen_idx + adv)
     if not tele:

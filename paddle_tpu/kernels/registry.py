@@ -118,6 +118,15 @@ KNOWN_IMPLS: Dict[str, tuple] = {
     # writer (refuses unless weight bytes <= 0.55x fp AND tokens/s
     # >= 0.95x fp)
     "quant_matmul": ("off", "xla", "pallas"),
+    # fused multi-tick decode (inference/multi_tick.py): 'off' = one
+    # decode tick per dispatch, 'scan' = K ticks inside one jitted
+    # lax.scan with a device-side early-exit mask (one dispatch + one
+    # host pull per K tokens — the chained_ms amortization in the
+    # product path). Env PADDLE_TPU_MULTI_TICK overrides AND
+    # kill-switches (an int >= 2 sets K; unrecognized fails safe to
+    # off); tools/bench_serving.py --multi-tick --adopt is the
+    # evidence-gated writer
+    "multi_tick": ("off", "scan"),
 }
 
 _DOCS: Dict[str, Optional[dict]] = {}   # path -> parsed doc (memoized)

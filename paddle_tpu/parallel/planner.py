@@ -791,7 +791,7 @@ def plan_serving_tp(cfg_or_spec, n_devices: int, num_slots: int = 8,
         max_len=S, cache_bytes_per_elem=cache_bytes_per_elem,
         dtype_bytes=spec.act_bytes_per_elem)
     w_bytes = led["components"]["weights"]
-    kv_bytes = led["components"]["kv_pool"]
+    kv_bytes = led["components"]["kv_pool_device"]
     degrees = [d for d in range(1, n_devices + 1)
                if n_devices % d == 0 and spec.num_heads % d == 0]
     best, best_t, best_fits = None, float("inf"), False

@@ -119,11 +119,18 @@ class ServingTelemetry:
     drained by a background writer thread."""
 
     def __init__(self, path: Optional[str] = None, every: int = 32,
-                 ring: int = 4096, meta: Optional[dict] = None):
+                 ring: int = 4096, meta: Optional[dict] = None,
+                 on_flush=None):
         if every < 1:
             raise ValueError("every must be >= 1")
         self.path = path
         self.every = int(every)
+        # flush-cadence tap: called (no args, exceptions swallowed)
+        # every time a pending batch drains — the engine hangs its
+        # host-bookkeeping gauges here (host-tier KV bytes, ticks per
+        # pull) so they update on the SAME cadence as the HBM gauges
+        # with zero extra device pulls
+        self.on_flush = on_flush
         self._ring: collections.deque = collections.deque(
             maxlen=max(int(ring), 1))
         self._pending: list = []
@@ -170,6 +177,11 @@ class ServingTelemetry:
                 # telemetry-off
                 from .mem_audit import publish_hbm_gauges
                 publish_hbm_gauges()
+                if self.on_flush is not None:
+                    try:
+                        self.on_flush()
+                    except Exception:              # noqa: BLE001
+                        pass       # gauges must never break the stream
                 self._writer.put(self._pending)
                 self._pending = []
 

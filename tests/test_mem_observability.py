@@ -103,17 +103,21 @@ class TestLedgerPlannerEquality:
         assert no_gather["components"]["overlap_prefetch"] == 0
 
     def test_serving_ledger_prices_real_cache_and_gauge(self, gpt_setup):
-        """The dense kv_pool component equals the engine's actual k+v
-        cache bytes, which is exactly what serving.kv_pool_bytes
-        publishes."""
+        """The dense kv_pool_device component equals the engine's
+        actual k+v cache bytes, which is exactly what
+        serving.kv_pool_bytes publishes. `total` is the DEVICE HBM
+        envelope: the kv_pool_host row (host RAM) stays outside it."""
         cfg, params = gpt_setup
         eng = _engine(params, cfg)
         led = eng.memory_ledger()
         kv_actual = 2 * eng._cache["k"].nbytes
-        assert led["components"]["kv_pool"] == kv_actual
+        assert led["components"]["kv_pool_device"] == kv_actual
         assert monitor.gauge("serving.kv_pool_bytes").value == kv_actual
+        assert led["components"]["kv_pool_host"] == 0
+        assert led["host_total"] == 0
         assert led["total"] == pytest.approx(
-            sum(led["components"].values()), rel=1e-12)
+            sum(v for k, v in led["components"].items()
+                if k != "kv_pool_host"), rel=1e-12)
 
     def test_paged_pool_gauge_tracks_occupancy(self, gpt_setup):
         """Paged engines publish kv_pool_bytes = pages_in_use x page
@@ -125,7 +129,7 @@ class TestLedgerPlannerEquality:
         eng.generate(_prompts(), GEN)
         led = eng.memory_ledger()
         assert led["config"]["layout"] == "paged"
-        assert led["components"]["kv_pool"] > 0
+        assert led["components"]["kv_pool_device"] > 0
 
 
 # --------------------------------------------------------------------------
@@ -336,5 +340,5 @@ class TestOomForensics:
         info = doc["config"]["oom_forensics"]
         assert info["where"] == "decode"
         assert info["census"] and info["live_bytes"] > 0
-        assert info["ledger"]["components"]["kv_pool"] > 0
+        assert info["ledger"]["components"]["kv_pool_device"] > 0
         assert "RESOURCE_EXHAUSTED" in info["error"]

@@ -28,6 +28,8 @@ Usage:
   python tools/bench_serving.py --quant        # weight-only int8 A/B
   python tools/bench_serving.py --tp 2         # tp-sharded decode parity
   python tools/bench_serving.py --router 2     # replicated-engine router
+  python tools/bench_serving.py --multi-tick 4 # fused K-tick decode A/B
+  python tools/bench_serving.py --role-split   # prefill/decode disagg A/B
   python tools/bench_serving.py --autoscale-overhead  # control-loop A/B
   PADDLE_TPU_TELEMETRY_JSONL=serve.jsonl python tools/bench_serving.py
 
@@ -76,6 +78,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import sys
 import tempfile
@@ -974,6 +977,243 @@ def router_main(args):
     return 0 if mismatches == 0 else 1
 
 
+def multi_tick_main(args):
+    """--multi-tick K: fused multi-tick decode A/B (BASELINE.md
+    "Disaggregated serving") — single-tick engine vs multi_tick=K
+    engine on single-stream AND concurrent workloads, bit-parity
+    checked. The single-stream leg is the dispatch-amortization
+    observable: one jitted lax.scan runs K decode ticks per dispatch,
+    so the host pays one dispatch + one pull per K tokens
+    (serving.decode_ticks counts DISPATCHES — the tokens/dispatch
+    ratio printed here is the one-pull-per-K-tokens assertion). One
+    JSON line; --adopt writes the evidence-gated registry row
+    (kernels/registry.py "multi_tick": parity + >=1.5x single-stream
+    + zero recompiles)."""
+    from paddle_tpu.models.decode import next_pow2
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.profiler import monitor
+
+    gen = args.gen
+    K = args.multi_tick
+    max_len = args.max_len or next_pow2(args.prompt_hi + gen + K)
+    params, cfg = _build_family(args, max_len)
+    prompts = build_workload(args.requests, args.prompt_lo,
+                             args.prompt_hi, args.vocab)
+    total_tokens = args.requests * gen
+    _log(f"multi-tick workload: {args.requests} single streams x {gen} "
+         f"tok, {args.family} {args.layers}Lx{args.hidden}d, K={K}, "
+         f"max_len={max_len}")
+
+    def run(eng):
+        t0 = time.perf_counter()
+        outs = eng.generate(prompts, gen)
+        return time.perf_counter() - t0, outs
+
+    def ticks():
+        return monitor.counter("serving.decode_ticks").value
+
+    def timed(eng, reps=3):
+        # best-of-reps: the CPU rung's host-load swings (BASELINE.md
+        # "CPU bench rung noise") dwarf the short timed window, and the
+        # best rep is the least-perturbed one. Dispatch counts are
+        # deterministic — every rep's delta is identical.
+        best_s, outs, tick_delta = math.inf, None, 0
+        for _ in range(reps):
+            k0 = ticks()
+            s, outs = run(eng)
+            tick_delta = ticks() - k0
+            best_s = min(best_s, s)
+        return best_s, outs, tick_delta
+
+    base = ServingEngine(params, cfg, family=args.family, num_slots=1,
+                         max_len=max_len)
+    run(base)                                        # warm
+    base_s, base_outs, base_ticks = timed(base)
+
+    mt = ServingEngine(params, cfg, family=args.family, num_slots=1,
+                       max_len=max_len, multi_tick=K)
+    run(mt)                                          # warm
+    traces_warm = mt.trace_counts()
+    mt_s, mt_outs, mt_ticks = timed(mt)
+    traces_after = mt.trace_counts()
+
+    mismatches = sum(1 for a, b in zip(base_outs, mt_outs)
+                     if not np.array_equal(a, b))
+    base_tps = total_tokens / base_s
+    mt_tps = total_tokens / mt_s
+    # one dispatch (== one host pull) per K tokens: each stream of
+    # `gen` tokens needs ceil(gen/K) dispatches
+    expected_dispatches = args.requests * -(-gen // K)
+    tokens_per_dispatch = total_tokens / max(mt_ticks, 1)
+
+    # concurrent leg: same engines' shape at --slots concurrency — the
+    # ITL p99 check (per-token latency is the amortized share of each
+    # K-token pull, so p99 must not blow up under batching)
+    conc = ServingEngine(params, cfg, family=args.family,
+                         num_slots=args.slots, max_len=max_len,
+                         multi_tick=K)
+    conc.generate(prompts, gen)                      # warm
+    conc.slo_snapshot()["itl_ms"]                    # (ring persists)
+    conc._slo_itl.clear()
+    t0 = time.perf_counter()
+    conc_outs = conc.generate(prompts, gen)
+    conc_s = time.perf_counter() - t0
+    itl = sorted(conc.slo_snapshot()["itl_ms"])
+    itl_p99 = itl[int(0.99 * (len(itl) - 1))] if itl else None
+    mismatches += sum(1 for a, b in zip(base_outs, conc_outs)
+                      if not np.array_equal(a, b))
+
+    doc = {
+        "metric": "serving_multi_tick_tokens_per_sec",
+        "value": round(mt_tps, 1),
+        "unit": "single-stream tokens/s",
+        "backend": jax.devices()[0].platform,
+        "single_tick_tokens_per_sec": round(base_tps, 1),
+        "speedup_vs_single_tick": round(mt_tps / base_tps, 2),
+        "ticks_per_dispatch": K,
+        "tokens_per_dispatch_measured": round(tokens_per_dispatch, 2),
+        "dispatches": [base_ticks, mt_ticks],
+        "dispatches_expected": expected_dispatches,
+        "concurrent_tokens_per_sec": round(total_tokens / conc_s, 1),
+        "concurrent_itl_p99_ms": (None if itl_p99 is None
+                                  else round(itl_p99, 3)),
+        "requests": args.requests, "gen": gen, "slots": args.slots,
+        "model": f"{args.layers}Lx{args.hidden}d",
+        "family": args.family, "max_len": max_len,
+        "recompiles_after_warmup": [
+            traces_after[0] - traces_warm[0],
+            traces_after[1] - traces_warm[1]],
+        "stream_mismatches": mismatches,
+    }
+    if args.adopt:
+        from paddle_tpu.kernels import registry
+        ok = (mismatches == 0
+              and doc["speedup_vs_single_tick"] >= 1.5
+              and doc["recompiles_after_warmup"] == [0, 0]
+              and mt_ticks <= expected_dispatches)
+        if not ok:
+            doc["adopt"] = "refused: speedup/parity/recompile gate failed"
+        else:
+            pbytes = sum(np.asarray(v).nbytes for v in params.values())
+            per_dispatch_ms = mt_s * 1e3 / max(mt_ticks, 1)
+            problem = registry.adopt(
+                "multi_tick", "scan", per_dispatch_ms,
+                bytes_moved=pbytes * K,
+                source=(f"bench_serving --multi-tick {K}: "
+                        f"{doc['speedup_vs_single_tick']}x single-stream "
+                        f"vs single-tick ({tokens_per_dispatch:.1f} "
+                        f"tok/dispatch measured, K={K}; dispatch-bound "
+                        "rungs only — at step-sized device work the scan "
+                        "amortizes nothing)"))
+            doc["adopt"] = problem or "adopted"
+    print(json.dumps(doc), flush=True)
+    return 0 if mismatches == 0 else 1
+
+
+def role_split_main(args):
+    """--role-split: prefill/decode disaggregation A/B (the isolation
+    acceptance). Two 2-replica fleets serve the SAME trace: a few
+    long-lived decode streams (the victims) plus a flood of
+    long-prompt short-gen requests arriving mid-decode. The
+    homogeneous fleet interleaves flood prefills with the victims'
+    ticks on the same engines; the role-split fleet admits the flood
+    on the prefill replica only and hands streams to the decode
+    replica at first token — victim ITL p99 must stay flat while
+    serving.prefills stays == requests (zero re-prefilled tokens
+    across every handoff). ITL is measured over STEADY-STATE decode
+    (each victim's tokens 8+): the one-time admission/handoff
+    transient is priced by the handoff counter, not smeared into the
+    isolation percentile. One JSON line."""
+    from paddle_tpu.models.decode import next_pow2
+    from paddle_tpu.inference.router import create_router
+    from paddle_tpu.profiler import monitor
+
+    gen = args.gen
+    max_len = args.max_len or next_pow2(args.prompt_hi + gen)
+    params, cfg = _build_family(args, max_len)
+    rng = np.random.RandomState(7)
+    victims = [rng.randint(1, args.vocab - 1, size=args.prompt_lo)
+               .astype(np.int32) for _ in range(2)]
+    flood = [rng.randint(1, args.vocab - 1, size=args.prompt_hi)
+             .astype(np.int32) for _ in range(args.requests)]
+    _log(f"role-split workload: 2 victims x {gen} tok + "
+         f"{args.requests}-request prefill flood "
+         f"(prompts {args.prompt_hi} tok, gen 2)")
+
+    def run(roles):
+        router = create_router(params, cfg, replicas=2,
+                               family=args.family, num_slots=args.slots,
+                               max_len=max_len, roles=roles)
+        # warm every executable (prefill buckets + decode) on both
+        # replicas before the measured trace
+        router.generate(victims + flood[:2], 4)
+        pre0 = monitor.counter("serving.prefills").value
+        vreqs = [router.submit(p, gen) for p in victims]
+        gaps = {id(r): [] for r in vreqs}
+        last = {id(r): None for r in vreqs}
+        seen = {id(r): 0 for r in vreqs}
+        flooded = 0
+        t0 = time.perf_counter()
+        while router.has_work() or flooded < len(flood):
+            # flood arrives paced across the victims' WHOLE decode
+            # (one prefill every other tick), not as one front-loaded
+            # burst — the homogeneous fleet must keep interleaving
+            # prefills with victim ticks for the isolation A/B to
+            # measure anything
+            while (flooded < len(flood)
+                   and 2 * flooded <= router._ticks):
+                router.submit(flood[flooded], 2)
+                flooded += 1
+            now = time.perf_counter()
+            for r, tok in router.step():
+                if id(r) in gaps:
+                    seen[id(r)] += 1
+                    # steady state only: tokens 8+ (past the
+                    # admission/handoff transient)
+                    if last[id(r)] is not None and seen[id(r)] > 8:
+                        gaps[id(r)].append((now - last[id(r)]) * 1e3)
+                    last[id(r)] = now
+        wall = time.perf_counter() - t0
+        itl = sorted(g for gs in gaps.values() for g in gs)
+        p99 = itl[int(0.99 * (len(itl) - 1))] if itl else None
+        p50 = itl[len(itl) // 2] if itl else None
+        st = router.stats()
+        prefills = monitor.counter("serving.prefills").value - pre0
+        return {"itl_p99_ms": None if p99 is None else round(p99, 3),
+                "itl_p50_ms": None if p50 is None else round(p50, 3),
+                "wall_s": round(wall, 3),
+                "victim_tokens": [len(r.tokens) for r in vreqs],
+                "victims_done": all(r.done for r in vreqs),
+                "prefills": prefills,
+                "handoffs": st["handoffs"]}
+
+    hand0 = monitor.counter("serving.router.handoffs").value
+    baseline = run(None)
+    split = run(["prefill", "decode"])
+    split["handoffs"] -= hand0 + baseline["handoffs"]
+    # zero re-prefill: one completed prefill per submitted request
+    # (2 victims + the flood), handoffs notwithstanding
+    n_req = 2 + len(flood)
+    ok = (split["victims_done"] and baseline["victims_done"]
+          and split["prefills"] == n_req)
+    doc = {
+        "metric": "serving_role_split_itl_p99_ms",
+        "value": split["itl_p99_ms"],
+        "unit": "victim decode ITL p99 (ms) under prefill flood",
+        "backend": jax.devices()[0].platform,
+        "homogeneous": baseline, "role_split": split,
+        "p99_ratio_vs_homogeneous": (
+            None if not baseline["itl_p99_ms"] or not split["itl_p99_ms"]
+            else round(split["itl_p99_ms"] / baseline["itl_p99_ms"], 2)),
+        "flood_requests": len(flood), "gen": gen, "slots": args.slots,
+        "model": f"{args.layers}Lx{args.hidden}d",
+        "family": args.family, "max_len": max_len,
+        "zero_reprefill": split["prefills"] == n_req,
+    }
+    print(json.dumps(doc), flush=True)
+    return 0 if ok else 1
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--requests", type=int, default=None,
@@ -1019,6 +1259,14 @@ def main():
     ap.add_argument("--router", type=int, default=0,
                     help="aggregate tokens/s through N replicated "
                          "engines (inference/router.py) vs one engine")
+    ap.add_argument("--multi-tick", type=int, default=0,
+                    help="fused multi-tick decode A/B: single-tick vs "
+                         "multi_tick=K engine (one dispatch + one pull "
+                         "per K tokens; bit-parity checked)")
+    ap.add_argument("--role-split", action="store_true",
+                    help="prefill/decode disaggregation A/B: victim "
+                         "decode ITL p99 under a prefill flood, "
+                         "homogeneous vs role-split 2-replica fleet")
     ap.add_argument("--kv-layout", choices=("auto", "dense", "paged"),
                     default="auto", help="--tp: cache layout under test")
     ap.add_argument("--telemetry-overhead", action="store_true",
@@ -1040,6 +1288,10 @@ def main():
         return router_main(args)          # sizes its own default
     if args.requests is None:
         args.requests = 16
+    if args.multi_tick:
+        return multi_tick_main(args)
+    if args.role_split:
+        return role_split_main(args)
     if args.telemetry_overhead:
         return telemetry_main(args)
     if args.autoscale_overhead:

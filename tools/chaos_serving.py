@@ -80,6 +80,24 @@ migration"):
                    mesh with live streams migrated in place, streams
                    stay bit-identical and the trace ceilings hold
 
+Disaggregation scenarios (host-tier KV + prefill/decode role split,
+inference/host_kv.py + router roles; docs/serving.md
+"Disaggregation"):
+  host_spill_flood shared-prefix families oversubscribe a tiny paged
+                   pool on a host-tiered engine -> evicted registered
+                   pages SPILL to host ndarrays and SWAP back in on
+                   the next family hit (spills > 0, swapins > 0),
+                   streams bit-identical to a tier-less twin, and the
+                   memory ledger's kv_pool_host row tracks the tier's
+                   live bytes
+  prefill_role_death a roles=["prefill","decode"] fleet loses its
+                   only prefill replica AFTER handoffs started -> new
+                   submissions still admit (roles are placement
+                   preferences, availability beats specialization:
+                   the decode survivor picks up prefill duty), every
+                   stream resolves "length"/"eos" bit-identical, and
+                   the death leaves a router_replica_death flight dump
+
 Paged-KV scenarios (the block-pool layout, docs/serving.md "Paged KV
 cache"):
   paged_pool_flood more demand than pages -> later requests WAIT for
@@ -875,6 +893,89 @@ def run_drill(quick: bool = False, keep_root: bool = False) -> int:
         return check_flight(fdir, want_reason="serving_preempt")
     scenario("serving_device_loss", device_loss,
              spec="replica_preempt@3:1", want_flight=False)
+
+    # --- host_spill_flood: prefix reuse beyond the device pool -------
+    def host_spill_flood():
+        # shared-prefix families deliberately oversubscribe a tiny
+        # device pool: every evicted REGISTERED page must spill to the
+        # host tier and come back as a swap-in on the next family hit,
+        # with streams bit-identical to a tier-less engine
+        rng = np.random.RandomState(11)
+        fam_prompts = []
+        for _ in range(3):
+            head = rng.randint(1, cfg.vocab_size - 1, 16).astype(np.int32)
+            for _ in range(2):
+                fam_prompts.append(np.concatenate(
+                    [head, rng.randint(1, cfg.vocab_size - 1,
+                                       4).astype(np.int32)]))
+        kw = dict(num_slots=1, kv_layout="paged", page_size=8,
+                  num_pages=6, prefix_sharing=True)
+        plain = make_engine(params, cfg, max_len, **kw)
+        tiered = make_engine(params, cfg, max_len,
+                             host_kv_bytes=1 << 20, **kw)
+        local_base = None
+        for _ in range(2):                    # round 2 re-hits the tier
+            base_reqs = [plain.submit(p, gen) for p in fam_prompts]
+            plain.drain()
+            local_base = [np.asarray(r.tokens, np.int32)
+                          for r in base_reqs]
+            reqs = [tiered.submit(p, gen) for p in fam_prompts]
+            tiered.drain()
+            err = (check_terminal(reqs)
+                   or check_streams(reqs, local_base)
+                   or check_traces(tiered))
+            if err:
+                return err
+        st = tiered.pool_stats()["host_tier"]
+        if st["spills"] == 0:
+            return f"device pool never spilled to host: {st}"
+        if st["swapins"] == 0:
+            return f"host tier never served a swap-in: {st}"
+        led = tiered.memory_ledger()
+        if led["components"]["kv_pool_host"] != st["bytes"]:
+            return ("ledger kv_pool_host "
+                    f"{led['components']['kv_pool_host']} != tier "
+                    f"bytes {st['bytes']}")
+        return None
+    scenario("host_spill_flood", host_spill_flood, want_flight=False)
+
+    # --- prefill_role_death: disagg fleet loses its prefill replica --
+    def prefill_role_death():
+        h0 = monitor.counter("serving.router.handoffs").value
+        router = make_router(params, cfg, max_len, replicas=2,
+                             family="gpt", num_slots=4,
+                             concurrent=False,
+                             roles=["prefill", "decode"])
+        half = len(prompts) // 2
+        reqs = [router.submit(p, gen) for p in prompts[:half]]
+        for _ in range(60):            # prefill + first handoffs land
+            router.step()
+            if monitor.counter("serving.router.handoffs").value > h0:
+                break
+        if monitor.counter("serving.router.handoffs").value <= h0:
+            return "no prefill->decode handoff before the death"
+        router.kill_replica(0, reason="chaos")     # the prefill replica
+        # NEW work arriving after the death must still admit: role
+        # purity degrades to shared duty on the survivor, never to a
+        # stuck router queue
+        reqs += [router.submit(p, gen) for p in prompts[half:]]
+        router.drain(max_ticks=400)
+        err = check_terminal(reqs) or check_streams(reqs, baseline)
+        if err:
+            return err
+        if any(r.finish_reason not in ("length", "eos") for r in reqs):
+            return ("prefill-role death was not transparent: "
+                    f"{[r.finish_reason for r in reqs]}")
+        st = router.stats()
+        if st["replicas_live"] != 1:
+            return f"expected 1 live replica: {st}"
+        err = check_traces(router.replicas[1].eng)
+        if err:
+            return err
+        fdir = os.path.join(root, "prefill_role_death", "flight")
+        return check_flight(fdir, want_reason="router_replica_death")
+    scenario("prefill_role_death", prefill_role_death,
+             want_flight=False)
 
     rec.clear()          # don't leak scenario records into the caller's
     #                      process-global ring (in-process test usage)

@@ -85,8 +85,15 @@ def measure_serving_layout(name: str) -> dict:
     eng = ServingEngine(params, cfg, family="gpt", num_slots=3,
                         max_len=64, **kw)
     res = mem_audit.audit_serving_memory(eng)
+    comps = res["ledger"]["components"]
     return {"peak_bytes": int(res["compiled"].get("peak_bytes", 0)),
             "ledger_bytes": int(res["ledger"]["total"]),
+            # the split KV rows: device HBM (inside ledger_bytes) vs
+            # the host tier (host RAM, outside it) — pinned so a
+            # regression that silently re-prices spilled pages as
+            # device-resident fails the gate
+            "kv_device_bytes": int(comps["kv_pool_device"]),
+            "kv_host_bytes": int(comps["kv_pool_host"]),
             "gap_fraction": res["gap_fraction"],
             "findings": sorted(f["kind"] for f in res["findings"])}
 
@@ -125,8 +132,10 @@ def gate(plans, baseline_path: str, tolerance: float,
                        "fails when a plan's compiled peak grows beyond "
                        "the tolerance.",
             "tolerance": tolerance,
-            "plans": {n: {"peak_bytes": r["peak_bytes"],
-                          "ledger_bytes": r["ledger_bytes"]}
+            "plans": {n: {k: r[k] for k in
+                          ("peak_bytes", "ledger_bytes",
+                           "kv_device_bytes", "kv_host_bytes")
+                          if k in r}
                       for n, r in observed.items()},
         }
         os.makedirs(os.path.dirname(baseline_path), exist_ok=True)

@@ -256,6 +256,8 @@ def summarize(path: str, samples_per_step: Optional[float] = None) -> dict:
             mem["hbm"] = hbm
         if "serving.kv_pool_bytes" in last_s:
             mem["kv_pool_bytes"] = last_s["serving.kv_pool_bytes"]
+        if "serving.kv_host_bytes" in last_s:
+            mem["kv_host_bytes"] = last_s["serving.kv_host_bytes"]
         oom = {}
         for k in ("train.oom_forensics", "serving.oom_forensics"):
             if k in last_s:
@@ -300,7 +302,9 @@ def summarize(path: str, samples_per_step: Optional[float] = None) -> dict:
                        "serving.autoscale.replicas_target",
                        "serving.autoscale.occupancy",
                        "serving.autoscale.migrated_pages_bytes",
-                       "serving.kv_pool_bytes")
+                       "serving.kv_pool_bytes",
+                       "serving.kv_host_bytes",
+                       "serving.ticks_per_pull")
 
     def _is_gauge(k):
         # per-replica queue-depth gauges carry a dynamic suffix
@@ -321,6 +325,13 @@ def summarize(path: str, samples_per_step: Optional[float] = None) -> dict:
     # counter, grouped under serving.quant when any of them moved
     _QUANT = ("quant_weights_bytes", "fp_weights_bytes",
               "quant_matmuls")
+    # the disaggregation surface (inference/multi_tick.py +
+    # inference/host_kv.py): the multi-tick K gauge, the host-tier
+    # occupancy gauge, and the spill/swap-in counters, grouped under
+    # serving.disagg when any of them moved (router handoffs stay in
+    # the router block — they are a fleet stat, not an engine stat)
+    _DISAGG = ("ticks_per_pull", "kv_host_bytes", "host_spills",
+               "host_swapins")
     def _stat_val(k, last_s, first_s):
         # gauges and histograms (dict snapshots) report last value;
         # counters report the first-to-last delta
@@ -344,6 +355,17 @@ def summarize(path: str, samples_per_step: Optional[float] = None) -> dict:
             spec = {k: srv.pop(k) for k in _SPEC if k in srv}
             if any(spec.values()):
                 srv["spec"] = spec
+            disagg = {k: srv.pop(k) for k in _DISAGG if k in srv}
+            if any(disagg.values()):
+                # tokens per dispatch: the multi-tick economics in one
+                # number (== K on a saturated single stream, lower when
+                # early-exit masks trim the scan)
+                dtok = srv.get("tokens_emitted", 0)
+                dticks = srv.get("decode_ticks", 0)
+                if dtok and dticks:
+                    disagg["tokens_per_dispatch"] = round(
+                        dtok / dticks, 2)
+                srv["disagg"] = disagg
             quant = {k: srv.pop(k) for k in _QUANT if k in srv}
             if any(quant.values()):
                 if quant.get("quant_weights_bytes") and \

@@ -113,6 +113,15 @@ JOBS = [
     ("fused_step",
      [sys.executable, "tools/bench_fused_step.py", "--tpu", "--adopt"],
      2700, {}),
+    # ISSUE 19 rung: the multi-tick decode A/B on the REAL tunnel —
+    # the ~70-170 ms per-dispatch RTT is the overhead K amortizes, so
+    # the TPU speedup should dwarf the CPU-bench 2.08x. --adopt is the
+    # evidence-gated registry writer (parity + >=1.5x + zero recompiles
+    # required); single-stream leg + concurrent ITL leg in ONE JSON
+    ("multi_tick",
+     [sys.executable, "tools/bench_serving.py", "--tpu",
+      "--multi-tick", "8", "--requests", "8", "--gen", "64",
+      "--adopt"], 2700, {}),
 ]
 
 
