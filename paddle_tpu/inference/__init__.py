@@ -27,7 +27,10 @@ __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
            "family_for", "BackpressureError", "PoolExhaustedError",
            "ServingFaultError", "TERMINAL_REASONS",
            "EngineRouter", "RouterRequest", "create_router",
-           "AutoscaleConfig", "Autoscaler", "EnginePreemptGuard"]
+           "AutoscaleConfig", "Autoscaler", "EnginePreemptGuard",
+           "AdmissionController", "TenantQuota", "QuotaExceededError",
+           "BrownoutConfig", "BrownoutController", "BROWNOUT_LEVELS",
+           "RequestJournal"]
 
 
 class PrecisionType:
@@ -264,3 +267,11 @@ from .router import (EngineRouter, RouterRequest,      # noqa: E402,F401
 # and tp-preemption tolerance over the router/engine seams above
 from .autoscale import (AutoscaleConfig, Autoscaler,   # noqa: E402,F401
                         EnginePreemptGuard)
+# overload resilience: multi-tenant admission (quotas / weighted-fair /
+# preempt-to-host), the SLO-burn brownout ladder, and the crash-safe
+# request journal the router replays after a process death
+from .admission import (AdmissionController,           # noqa: E402,F401
+                        TenantQuota, QuotaExceededError)
+from .brownout import (BrownoutConfig,                 # noqa: E402,F401
+                       BrownoutController, BROWNOUT_LEVELS)
+from .journal import RequestJournal                    # noqa: E402,F401

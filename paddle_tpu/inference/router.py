@@ -68,6 +68,24 @@ immediately instead of burning a survivor's slot. `testing/faults.py`
 injects `replica_preempt@T:R` / `migrate_raise` through this module's
 `_FAULT_HOOK` (consulted once per router tick).
 
+Multi-tenant overload resilience (docs/serving.md §Tenancy, brownout &
+durability): `admission=` plugs an `inference/admission.py`
+AdmissionController in front of the queue — per-tenant token-bucket
+quotas (a typed QuotaExceededError with the exact retry-after),
+weighted-fair dispatch ordering (priority classes strictly first, then
+tenant virtual time), and PREEMPT-TO-HOST: when a high-priority submit
+finds no capacity, the lowest-priority mid-decode victim is SUSPENDED
+— its KV parks in a router-owned HostKVTier via the same
+snapshot/restore seam migration uses — and resumes later with zero
+re-prefilled tokens. `journal_dir=` adds the crash-safe request WAL
+(`inference/journal.py`): every accepted request is durable before
+submit() returns, every terminal lands in `_finish`, and a router
+rebuilt over the same directory REPLAYS the crashed process's
+un-terminal requests (at-least-once prefill, exactly-once terminal).
+The brownout ladder (`inference/brownout.py`) drives the degrade
+levers this module exposes: `set_spec_drafts` / `set_resume_hold` +
+`suspend_lowest_class` / `shed_oldest_pending`.
+
 Observability: serving.router.* monitor names — the replicas_live
 gauge, the requeues/rejected counters, per-replica queue-depth gauges
 (serving.router.queue_depth.r<i>) and dispatch counters
@@ -85,6 +103,8 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from .admission import AdmissionController, QuotaExceededError
+from .host_kv import HostKVTier
 from .serving import (BackpressureError, PoolExhaustedError,
                       ServingEngine, TERMINAL_REASONS)
 from ..profiler import monitor
@@ -93,9 +113,10 @@ __all__ = ["EngineRouter", "RouterRequest", "create_router"]
 
 # testing/faults.py installs a callable here: called once per router
 # tick as _FAULT_HOOK(tick) -> dict of actions, e.g.
-# {"replica_preempt": idx} (kill replica idx, migration-first) or
+# {"replica_preempt": idx} (kill replica idx, migration-first),
 # {"raise_migrate": True} (the NEXT migration attempt fails once and
-# takes the requeue-replay fallback). None in production.
+# takes the requeue-replay fallback) or {"quota_flood": n} (burst n
+# low-priority flood-tenant submissions). None in production.
 _FAULT_HOOK = None
 
 
@@ -110,10 +131,11 @@ class RouterRequest:
                  "top_k", "eos_id", "deadline_s", "deadline_ticks",
                  "tokens", "done", "finish_reason", "replica",
                  "requeues", "t_submit", "_tick_submit", "_inner",
-                 "_router", "trace")
+                 "_router", "trace", "tenant", "priority", "suspended")
 
     def __init__(self, req_id, prompt, max_new_tokens, temperature,
-                 top_k, eos_id, deadline_s, deadline_ticks):
+                 top_k, eos_id, deadline_s, deadline_ticks,
+                 tenant: str = "default", priority: int = 0):
         self.id = req_id
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
@@ -133,6 +155,11 @@ class RouterRequest:
         self._router = None
         self.trace = None               # RequestTrace (tracing=True) —
         #                                 ONE tree across dispatch/replay
+        # multi-tenant admission labels (inference/admission.py) +
+        # the preempt-to-host parked state (KV in the router's tier)
+        self.tenant = str(tenant)
+        self.priority = int(priority)
+        self.suspended = False
 
     @property
     def slot(self):
@@ -211,7 +238,9 @@ class EngineRouter:
     def __init__(self, engines: Sequence[ServingEngine],
                  max_queue: int = 0, queue_policy: str = "reject",
                  concurrent: bool = True, tracing: bool = False,
-                 clock=None, roles: Optional[Sequence[str]] = None):
+                 clock=None, roles: Optional[Sequence[str]] = None,
+                 admission=None, journal_dir: Optional[str] = None,
+                 suspend_tier_bytes: int = 1 << 28):
         if not engines:
             raise ValueError("EngineRouter needs >= 1 engine replica")
         if queue_policy not in ("reject", "shed_oldest"):
@@ -289,6 +318,59 @@ class EngineRouter:
         # prefill->decode stream handoffs (the disaggregation seam) —
         # a subset of serving.autoscale.migrations
         self._m_handoff = monitor.counter("serving.router.handoffs")
+        # ---------------------------------------- multi-tenant admission
+        # admission= is an AdmissionController or a {tenant: TenantQuota}
+        # dict (sugar — wrapped on the router's clock); None keeps the
+        # pre-tenancy dispatch bit-for-bit (pure FCFS, no quotas, no
+        # preemption)
+        if admission is None or isinstance(admission,
+                                           AdmissionController):
+            self._admission = admission
+        else:
+            self._admission = AdmissionController(dict(admission),
+                                                  clock=self._clock)
+        # preempt-to-host parking lot: a suspended request's KV lives in
+        # this LRU tier (host RAM, bounded) keyed ("suspend", outer.id);
+        # everything else about it sits in _suspended as a kv-less
+        # snapshot dict. A park the LRU evicts falls back to
+        # requeue-replay at resume time — at-least-once, never limbo.
+        self._suspend_tier = HostKVTier(int(suspend_tier_bytes))
+        self._suspended: dict = {}            # id -> (outer, meta snap)
+        self._resume_hold = False             # brownout level-2 latch
+        self._m_susp = monitor.gauge("serving.router.suspended")
+        # ------------------------------------------ crash-safe journal
+        # construction RECOVERS: un-terminal admits from a previous
+        # process replay through the router queue under their ORIGINAL
+        # ids (the id counter seeds past the WAL's horizon, so fresh
+        # and replayed ids never collide and the journal's terminal set
+        # stays duplicate-free)
+        self._journal = None
+        self._m_replay = monitor.counter("serving.journal.replays")
+        if journal_dir is not None:
+            from .journal import RequestJournal
+            self._journal = RequestJournal(journal_dir)
+            self._next_id = self._journal.next_id
+            for rec in self._journal.replayable():
+                req = RouterRequest(
+                    int(rec["id"]),
+                    np.asarray(rec["prompt"], np.int32).reshape(-1),
+                    int(rec["max_new_tokens"]),
+                    float(rec["temperature"]), int(rec["top_k"]),
+                    rec.get("eos_id"), None, None,
+                    tenant=rec.get("tenant", "default"),
+                    priority=int(rec.get("priority", 0)))
+                req.t_submit = self._clock()
+                req._router = self
+                if self._tracer is not None:
+                    req.trace = self._tracer.trace(
+                        f"request-r{req.id}", request_id=req.id,
+                        prompt_len=int(req.prompt.shape[0]),
+                        max_new_tokens=req.max_new_tokens,
+                        router=True, replayed=True)
+                self._pending.append(req)
+                self._m_replay.add()
+                self._m_sub.add()
+            self._m_pending.set(len(self._pending))
         self._m_live.set(len(self.replicas))
 
     # ------------------------------------------------------- observables
@@ -319,16 +401,17 @@ class EngineRouter:
         return caps if caps else self.dispatchable()
 
     def has_work(self) -> bool:
-        return (bool(self._pending)
+        return (bool(self._pending) or bool(self._suspended)
                 or any(r.eng.has_work() for r in self.live()))
 
     def stats(self) -> dict:
         """Host-side router observable: per-replica liveness/load and
         the admission balance (dispatch counts)."""
-        return {"replicas": len(self.replicas),
+        out = {"replicas": len(self.replicas),
                 "replicas_live": len(self.live()),
                 "replicas_dispatchable": len(self.dispatchable()),
                 "pending": len(self._pending),
+                "suspended": len(self._suspended),
                 "requeues": self._m_requeue.value,
                 "migrations": self._m_mig.value,
                 "handoffs": self._m_handoff.value,
@@ -338,20 +421,37 @@ class EngineRouter:
                      "load": r.load() if r.alive else 0,
                      "dispatched": r.m_disp.value}
                     for r in self.replicas]}
+        if self._admission is not None:
+            out["admission"] = self._admission.stats()
+        if self._journal is not None:
+            out["journal"] = {
+                "admits": len(self._journal.admits),
+                "ends": len(self._journal.ends),
+                "replayable": len(self._journal.replayable())}
+        return out
 
     # --------------------------------------------------------- admission
     def submit(self, prompt, max_new_tokens: int,
                temperature: float = 0.0, top_k: int = 0,
                eos_id: Optional[int] = None,
                deadline_s: Optional[float] = None,
-               deadline_ticks: Optional[int] = None) -> RouterRequest:
+               deadline_ticks: Optional[int] = None,
+               tenant: str = "default",
+               priority: int = 0) -> RouterRequest:
         """Queue one request with the least-loaded live replica (falling
         through replicas that refuse admission); raises
         BackpressureError when every replica refuses AND the router
         queue is at max_queue under "reject" (shed_oldest evicts the
         oldest router-queued request instead). PoolExhaustedError
         propagates only when NO live replica could EVER hold the
-        request."""
+        request. Under `admission=`, `tenant`'s token bucket is charged
+        the worst-case cost first (QuotaExceededError carries the exact
+        retry-after; nothing is deducted on reject), and a `priority`-
+        class request that finds no capacity SUSPENDS the lowest
+        strictly-lower-priority mid-decode victim to the host tier and
+        takes its slot. Under `journal_dir=`, acceptance is durable
+        (the admit record is fsynced before this returns) and every
+        rejection leaves an end-only journal record."""
         if not self.live():
             raise BackpressureError("no live replicas", queue_depth=0)
         req = RouterRequest(self._next_id,
@@ -361,7 +461,8 @@ class EngineRouter:
                             None if deadline_s is None
                             else float(deadline_s),
                             None if deadline_ticks is None
-                            else int(deadline_ticks))
+                            else int(deadline_ticks),
+                            tenant=tenant, priority=priority)
         self._next_id += 1
         req.t_submit = self._clock()
         req._tick_submit = self._ticks
@@ -373,34 +474,79 @@ class EngineRouter:
                 max_new_tokens=req.max_new_tokens, router=True)
         # requests_submitted counts ACCEPTED requests only (same as the
         # engine's: a reject raises before anything is admitted), so
-        # submitted - completed is a true in-flight gauge. A REJECTED
-        # submit still owns a freshly-minted trace — finish it
-        # ("rejected") before raising, or the open root span would
-        # leak in the tracer forever (Tracer._open is unbounded).
+        # submitted - completed is a true in-flight gauge. EVERY reject
+        # path below runs _reject first: the freshly-minted trace
+        # finishes ("rejected") before raising — or the open root span
+        # would leak in the tracer forever (Tracer._open is unbounded)
+        # — and the journal gets its end-only record (the satellite
+        # trace-leak contract: one terminal trace + one journal
+        # terminal per rejection, THEN the error propagates).
+        if self._admission is not None:
+            cost = int(req.prompt.shape[0]) + req.max_new_tokens
+            try:
+                self._admission.charge(req.tenant, cost)
+            except QuotaExceededError:
+                self._admission.counter("rejected", req.tenant).add()
+                self._m_rej.add()
+                self._reject(req)
+                raise
+        if self._admission is not None and not self._has_free_slot():
+            # preempt-to-host: no replica can SLOT this request right
+            # now (engines with unbounded queues never refuse — they
+            # would just queue it behind the very streams it outranks),
+            # so park the lowest strictly-lower-priority mid-decode
+            # victim (KV to the host tier, zero re-prefill on resume)
+            # and let the dispatch below take the freed slot
+            victim = self._admission.preempt_candidate(
+                self._inflight(), req.priority)
+            if victim is not None and self._suspend(victim):
+                self._admission._m_pre.add()
         try:
             placed = self._try_dispatch(req)
         except PoolExhaustedError:
-            if req.trace is not None:
-                req.trace.finish("rejected", tokens=0)
+            self._reject(req)
             raise
         if placed:
-            self._m_sub.add()
+            self._accept(req)
             return req
         if self.max_queue > 0 and len(self._pending) >= self.max_queue:
             if self.queue_policy == "shed_oldest":
                 self._finish(self._pending.popleft(), "evicted")
             else:
                 self._m_rej.add()
-                if req.trace is not None:
-                    req.trace.finish("rejected", tokens=0)
+                self._reject(req)
                 raise BackpressureError(
                     f"router queue full ({len(self._pending)} waiting, "
                     f"max_queue={self.max_queue})",
                     queue_depth=len(self._pending))
         self._pending.append(req)
         self._m_pending.set(len(self._pending))
-        self._m_sub.add()
+        self._accept(req)
         return req
+
+    def _accept(self, req: RouterRequest) -> None:
+        """The accepted-submission bookkeeping shared by the placed and
+        queued paths: the fsynced journal admit record (acceptance is
+        durable before submit() returns), the per-tenant admitted
+        counter, the submitted counter."""
+        if self._journal is not None:
+            self._journal.record_admit(
+                req.id, [int(t) for t in req.prompt],
+                req.max_new_tokens, req.temperature, req.top_k,
+                req.eos_id, req.tenant, req.priority)
+        if self._admission is not None:
+            self._admission.counter("admitted", req.tenant).add()
+        self._m_sub.add()
+
+    def _reject(self, req: RouterRequest) -> None:
+        """The rejected-submission bookkeeping run BEFORE the error
+        propagates: exactly one terminal trace span and one end-only
+        journal record (recovery ignores end-only ids — a rejection was
+        client-visible as an exception and must never replay)."""
+        if req.trace is not None:
+            req.trace.finish("rejected", tokens=0)
+        if self._journal is not None:
+            self._journal.record_terminal(req.id, "rejected", tokens=0)
 
     def _remaining_budget(self, req: RouterRequest):
         """Re-scope `req`'s deadlines to the budget LEFT as of now:
@@ -453,6 +599,12 @@ class EngineRouter:
             self._m_disp_ms.observe((self._clock() - t_disp0) * 1e3)
             req.replica = rep.idx
             req._inner = inner
+            if self._admission is not None:
+                # stride update: the tenant's virtual time advances by
+                # the work it just got placed, over its weight
+                self._admission.note_dispatch(
+                    req.tenant,
+                    int(req.prompt.shape[0]) + req.max_new_tokens)
             if req.trace is not None:
                 req.trace.instant("dispatch", replica=rep.idx,
                                   attempt=req.trace.attempt)
@@ -479,6 +631,13 @@ class EngineRouter:
             if rp is not None:
                 self.kill_replica(int(rp) % len(self.replicas),
                                   reason="preempt")
+            qf = actions.pop("quota_flood", None)
+            if qf is not None:
+                self._inject_flood(int(qf))
+        # suspended streams resume BEFORE cold admissions dispatch —
+        # they are mid-flight (their tokens are owed) and their slot
+        # claim predates everything in the queue
+        self._resume_suspended()
         self._dispatch_pending()
         live = self.live()
         results = {}
@@ -527,6 +686,13 @@ class EngineRouter:
         return events
 
     def _dispatch_pending(self) -> None:
+        if self._admission is not None and len(self._pending) > 1:
+            # weighted-fair head-of-line: reorder the queue by
+            # (priority DESC, tenant virtual-time ASC, id) — the FCFS
+            # loop below then runs unchanged, so admission=None keeps
+            # the pre-tenancy dispatch bit-for-bit
+            self._pending = collections.deque(
+                self._admission.order(self._pending))
         while self._pending:
             head = self._pending[0]
             if head.done:                     # cancelled while queued
@@ -587,6 +753,212 @@ class EngineRouter:
         for rep in self.replicas:
             rep.m_depth.set(rep.load() if rep.alive else 0)
 
+    # ------------------------------------ tenancy, suspension, brownout
+    def _has_free_slot(self) -> bool:
+        """Whether any prefill-capable replica could SLOT a new request
+        immediately — a free slot AND an empty engine queue (anything
+        already engine-queued claims the slot first)."""
+        for rep in self.prefill_targets():
+            eng = rep.eng
+            if (not eng._queue
+                    and any(r is None for r in eng._slot_req)):
+                return True
+        return False
+
+    def _inflight(self) -> List[RouterRequest]:
+        """Un-terminal requests currently HOLDING an engine slot on a
+        live replica — the preemption candidate set (queued and
+        suspended requests hold nothing worth preempting)."""
+        out = []
+        for rep in self.live():
+            out.extend(o for o in rep.inner.values()
+                       if not o.done and o._inner is not None)
+        return out
+
+    def _suspend(self, outer: RouterRequest) -> bool:
+        """Park `outer` mid-decode: host KV snapshot (the migration
+        seam) into the router's HostKVTier, kv-less metadata into
+        `_suspended`, slot and pages freed NOW. Returns False when no
+        snapshot exists (mid-prefill / already gone) — the caller picks
+        another victim or gives up. A KV block bigger than the whole
+        tier (put refuses) falls back to requeue-replay immediately:
+        capacity still frees, delivery degrades to at-least-once."""
+        inner = outer._inner
+        if inner is None or outer.done:
+            return False
+        rep = self.replicas[outer.replica]
+        try:
+            snap = rep.eng.snapshot_request(inner)
+        except Exception:                      # noqa: BLE001
+            snap = None
+        if snap is None:
+            return False
+        kv_k = snap.pop("kv_k")
+        kv_v = snap.pop("kv_v")
+        rep.eng.detach_request(inner)
+        rep.inner.pop(inner.id, None)
+        outer._inner = None
+        outer.replica = None
+        if self._suspend_tier.put(("suspend", outer.id), kv_k, kv_v):
+            outer.suspended = True
+            self._suspended[outer.id] = (outer, snap)
+            if self._admission is not None:
+                self._admission.counter("suspended", outer.tenant).add()
+            if outer.trace is not None:
+                outer.trace.instant(
+                    "suspend", kv_bytes=int(snap.get("kv_bytes", 0)))
+            self._flight.note(router_suspend=outer.id,
+                              priority=outer.priority,
+                              tenant=outer.tenant, tick=self._ticks)
+        else:
+            self._replay_requeue(outer, "suspend_spill")
+        self._m_susp.set(len(self._suspended))
+        return True
+
+    def _resume_suspended(self) -> int:
+        """Un-park suspended streams onto replicas with capacity (id
+        order — longest-parked first), restoring through the SAME seam
+        migration uses: zero re-prefilled tokens, bit-identical greedy
+        continuation. Held entirely while the brownout latch
+        (`set_resume_hold(True)`) is on. A park whose KV the tier
+        LRU-evicted replays from scratch instead; an expired budget
+        resolves "timeout". Stops at the first no-capacity miss (the
+        rest retry next tick). Returns the number resumed."""
+        if self._resume_hold or not self._suspended:
+            return 0
+        resumed = 0
+        for rid in sorted(self._suspended):
+            outer, meta = self._suspended[rid]
+            if outer.done:                     # finished while parked
+                self._suspended.pop(rid, None)
+                self._suspend_tier.pop(("suspend", rid))
+                continue
+            dl_s, dl_t, expired = self._remaining_budget(outer)
+            if expired:
+                self._finish(outer, "timeout")  # drops the park
+                continue
+            pair = self._suspend_tier.get(("suspend", rid))
+            if pair is None:
+                # the tier evicted this park to make room for a later
+                # one: replay from scratch (at-least-once, never limbo)
+                self._suspended.pop(rid, None)
+                outer.suspended = False
+                self._replay_requeue(outer, "suspend_evicted")
+                continue
+            snap = dict(meta)
+            snap["kv_k"], snap["kv_v"] = pair
+            placed = None
+            for dst in sorted(self.decode_targets(), key=_Replica.load):
+                try:
+                    placed = dst.eng.restore_request(
+                        snap, deadline_s=dl_s, deadline_ticks=dl_t,
+                        _trace=outer.trace)
+                except Exception:              # noqa: BLE001
+                    placed = None
+                if placed is not None:
+                    break
+            if placed is None:
+                break                          # no capacity this tick
+            self._suspended.pop(rid, None)
+            self._suspend_tier.pop(("suspend", rid))
+            outer.suspended = False
+            dst.inner[placed.id] = outer
+            outer._inner = placed
+            outer.replica = dst.idx
+            resumed += 1
+            if self._admission is not None:
+                self._admission._m_res.add()
+            if outer.trace is not None:
+                outer.trace.instant("resume", replica=dst.idx)
+            self._flight.note(router_resume=rid, replica=dst.idx,
+                              tick=self._ticks)
+        self._m_susp.set(len(self._suspended))
+        return resumed
+
+    def _replay_requeue(self, outer: RouterRequest, why: str) -> None:
+        """The shared at-least-once fallback: reset the stream (the
+        final token list never duplicates), sever the trace subtree,
+        requeue at the head of the router queue."""
+        outer.tokens.clear()
+        outer._inner = None
+        outer.replica = None
+        outer.suspended = False
+        outer.requeues += 1
+        self._m_requeue.add()
+        if outer.trace is not None:
+            outer.trace.sever(why)
+            outer.trace.link_replay(cause=why)
+        self._pending.appendleft(outer)
+        self._m_pending.set(len(self._pending))
+
+    def suspend_lowest_class(self) -> int:
+        """Brownout level-2 action: suspend EVERY mid-decode stream of
+        the lowest priority class present — but only when more than one
+        class is in flight (suspending the only class serves no one).
+        Returns the number suspended."""
+        infl = self._inflight()
+        prios = {int(o.priority) for o in infl}
+        if len(prios) < 2:
+            return 0
+        low = min(prios)
+        n = 0
+        for outer in [o for o in infl if int(o.priority) == low]:
+            if self._suspend(outer):
+                n += 1
+        return n
+
+    def shed_oldest_pending(self, n: int = 1) -> int:
+        """Brownout level-3 action: resolve the `n` oldest router-
+        queued requests "evicted" (terminal — the journal and trace
+        close, never limbo). Returns the number shed."""
+        shed = 0
+        while self._pending and shed < n:
+            self._finish(self._pending.popleft(), "evicted")
+            shed += 1
+        self._m_pending.set(len(self._pending))
+        return shed
+
+    def set_spec_drafts(self, enabled: bool) -> bool:
+        """Broadcast the speculative-drafts toggle to every live
+        replica (ServingEngine.set_spec_drafts — a no-op on engines
+        built without spec). Returns True when any replica now runs
+        drafts."""
+        on = False
+        for rep in self.live():
+            if rep.eng.set_spec_drafts(enabled):
+                on = True
+        return on
+
+    def set_resume_hold(self, on: bool) -> None:
+        """Latch (or release) suspended-stream resumption — the
+        brownout level-2 hold: while on, parked streams stay parked
+        even when slots free; releasing lets the per-tick resume pass
+        drain the parking lot level by level."""
+        self._resume_hold = bool(on)
+
+    def _inject_flood(self, n: int) -> None:
+        """testing/faults.py `quota_flood@T:N` action: burst `n` small
+        priority-(-1) submissions from the "flood" tenant, swallowing
+        the quota/backpressure rejects — the drill asserts OTHER
+        tenants' admission and latency hold."""
+        for _ in range(int(n)):
+            try:
+                self.submit([1, 2, 3], 4, tenant="flood", priority=-1)
+            except (QuotaExceededError, BackpressureError,
+                    PoolExhaustedError):
+                pass
+
+    def close(self) -> None:
+        """Release host-side resources (the journal's WAL handle, the
+        step executor). The engines and their device state are
+        untouched — close() is for process teardown, not teardown of
+        serving."""
+        if self._journal is not None:
+            self._journal.close()
+        if self._exec is not None:
+            self._exec.shutdown(wait=False)
+            self._exec = None
+
     # ------------------------------------------------------ terminality
     def _finish(self, req: RouterRequest, reason: str) -> None:
         if req.done:
@@ -594,6 +966,18 @@ class EngineRouter:
         req.done = True
         req.finish_reason = reason
         req._inner = None
+        if req.suspended:
+            # a parked request resolving terminally (timeout / abort /
+            # cancel) drops its host-tier KV — never a leak, never limbo
+            self._suspended.pop(req.id, None)
+            self._suspend_tier.pop(("suspend", req.id))
+            req.suspended = False
+        if self._journal is not None:
+            # the journal's terminal set mirrors THIS seam — exactly
+            # once per id per process, and recovery skips already-ended
+            # ids, so it stays duplicate-free across a crash
+            self._journal.record_terminal(req.id, reason,
+                                          tokens=len(req.tokens))
         if req.trace is not None:
             # exactly-once terminal span: an inner engine _finish that
             # already emitted it makes this a no-op (the once-only
@@ -612,7 +996,7 @@ class EngineRouter:
             rep.inner.pop(req._inner.id, None)
             if rep.alive:
                 req._inner.cancel()       # frees the engine slot
-        else:
+        elif not req.suspended:           # parked: _finish drops the KV
             try:
                 self._pending.remove(req)
             except ValueError:
@@ -632,6 +1016,14 @@ class EngineRouter:
         while self._pending:
             self._finish(self._pending.popleft(), reason)
             n += 1
+        for rid in list(self._suspended):
+            outer, _ = self._suspended[rid]
+            if not outer.done:
+                self._finish(outer, reason)   # drops the parked KV too
+                n += 1
+            else:                             # stale park: just drop it
+                self._suspended.pop(rid, None)
+                self._suspend_tier.pop(("suspend", rid))
         for rep in self.replicas:
             for outer in list(rep.inner.values()):
                 if outer.done:
@@ -862,6 +1254,7 @@ def create_router(params, cfg, replicas: int = 2, family: str = "gpt",
                   meshes: Optional[Sequence] = None,
                   tracing: bool = False, clock=None,
                   roles: Optional[Sequence[str]] = None,
+                  admission=None, journal_dir: Optional[str] = None,
                   **engine_kw) -> EngineRouter:
     """Build an EngineRouter over `replicas` identical ServingEngines
     sharing ONE param tree (read-only at decode — on a single host the
@@ -876,7 +1269,12 @@ def create_router(params, cfg, replicas: int = 2, family: str = "gpt",
     JSONL — the per-replica files tools/telemetry_report.py's fleet
     mode merges. `roles` (aligned with replica index, values
     any|prefill|decode) turns on prefill/decode disaggregation —
-    docs/serving.md §Disaggregation."""
+    docs/serving.md §Disaggregation. `admission` (an
+    AdmissionController or a {tenant: TenantQuota} dict) turns on
+    multi-tenant quotas / weighted-fair dispatch / preempt-to-host;
+    `journal_dir` turns on the crash-safe request WAL (construction
+    over an existing directory RECOVERS and replays) — docs/serving.md
+    §Tenancy, brownout & durability."""
     if replicas < 1:
         raise ValueError(f"replicas must be >= 1; got {replicas}")
     if meshes is not None and len(meshes) != replicas:
@@ -891,4 +1289,5 @@ def create_router(params, cfg, replicas: int = 2, family: str = "gpt",
                for i in range(replicas)]
     return EngineRouter(engines, max_queue=max_queue,
                         queue_policy=queue_policy, concurrent=concurrent,
-                        tracing=tracing, clock=clock, roles=roles)
+                        tracing=tracing, clock=clock, roles=roles,
+                        admission=admission, journal_dir=journal_dir)

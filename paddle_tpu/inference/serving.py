@@ -477,16 +477,23 @@ class Request:
                  "slot", "deadline_s", "deadline_ticks", "t_submit",
                  "_tick_submit", "_t_last", "_engine", "_pf_next",
                  "shared_tokens", "_pfx_keys", "trace", "_sp_queue",
-                 "_sp_decode")
+                 "_sp_decode", "tenant", "priority")
 
     def __init__(self, req_id, prompt, max_new_tokens, temperature,
-                 top_k, eos_id, deadline_s=None, deadline_ticks=None):
+                 top_k, eos_id, deadline_s=None, deadline_ticks=None,
+                 tenant="default", priority=0):
         self.id = req_id
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.temperature = temperature
         self.top_k = top_k
         self.eos_id = eos_id
+        # multi-tenant admission labels (inference/admission.py): the
+        # ENGINE carries them untouched — quotas/fairness/preemption
+        # are router policy; they ride snapshots so a suspended or
+        # migrated stream keeps its class
+        self.tenant = tenant
+        self.priority = int(priority)
         self.deadline_s = deadline_s       # wall seconds from submit
         self.deadline_ticks = deadline_ticks  # engine ticks from submit
         self.tokens: List[int] = []     # generated ids, in order
@@ -780,6 +787,9 @@ class ServingEngine:
         # (inference/spec_decode.resolve_spec)
         from .spec_decode import resolve_spec
         self.spec = resolve_spec(spec_decode)
+        # whether drafts CAN run: set_spec_drafts (brownout) may flip
+        # self.spec live, but only back up to this construction-time cap
+        self._spec_capable = self.spec
         n_layers = int(getattr(cfg, "num_layers", 0))
         self.spec_gamma = int(gamma)
         self.spec_draft_layers = int(draft_layers) or max(1, n_layers // 2)
@@ -1149,22 +1159,20 @@ class ServingEngine:
                 for k, v in shapes.items()}
         return jax.jit(mk, out_shardings=self._cache_pin)()
 
-    def _make_executables(self) -> None:
-        """Build (or REBUILD) the jitted bodies — decode tick, bucketed/
-        chunked prefill, COW page copy — from the engine's current mesh
-        state. Extracted from __init__ so `rebuild_on_mesh` (preemption
-        recovery) can re-jit on the surviving mesh: the partials close
-        over `self._cache_pin`, which a mesh change invalidates. Must
-        run AFTER `_new_cache` has pinned the cache layout (the pin
-        dict is closed over by identity). Fresh jits start with empty
-        trace caches — one warmup recompile per body, then the
-        trace-count ceilings hold exactly as at first construction."""
+    def _build_decode(self, spec: bool):
+        """The decode-tick jit for `spec` drafts on or off, cached per
+        flag in `_decode_variants` (reset by _make_executables on mesh
+        rebuild). Four bodies: multi-tick x spec crossed — all share
+        the donation/static signature, so `_decode_guarded` only varies
+        its ARG assembly (keyed off self.spec / self.mt_k)."""
+        cached = self._decode_variants.get(bool(spec))
+        if cached is not None:
+            return cached
         run_cfg = self._run_cfg
-        self._repin = None      # lazy identity re-pin (see _pin_cache_host)
         _oor = (self.max_pages * self.page_size if self.paged else None)
-        if self.mt_k > 1 and self.spec:
+        if self.mt_k > 1 and spec:
             from .multi_tick import multi_tick_spec_scan
-            self._decode = jax.jit(
+            fn = jax.jit(
                 functools.partial(multi_tick_spec_scan,
                                   fwd=self.family.forward_cached,
                                   cfg=run_cfg, max_top_k=self.max_top_k,
@@ -1179,7 +1187,7 @@ class ServingEngine:
                 donate_argnums=(1, 2), static_argnames=("sampling",))
         elif self.mt_k > 1:
             from .multi_tick import multi_tick_scan
-            self._decode = jax.jit(
+            fn = jax.jit(
                 functools.partial(multi_tick_scan,
                                   fwd=self.family.forward_cached,
                                   cfg=run_cfg, max_top_k=self.max_top_k,
@@ -1190,9 +1198,9 @@ class ServingEngine:
                                   cache_pin=self._cache_pin,
                                   tele=self._tick_tele),
                 donate_argnums=(1, 2), static_argnames=("sampling",))
-        elif self.spec:
+        elif spec:
             from .spec_decode import spec_tick
-            self._decode = jax.jit(
+            fn = jax.jit(
                 functools.partial(spec_tick,
                                   fwd=self.family.forward_cached,
                                   cfg=run_cfg, max_top_k=self.max_top_k,
@@ -1204,7 +1212,7 @@ class ServingEngine:
                                   tele=self._tick_tele),
                 donate_argnums=(1, 2), static_argnames=("sampling",))
         else:
-            self._decode = jax.jit(
+            fn = jax.jit(
                 functools.partial(_decode_tick,
                                   fwd=self.family.forward_cached,
                                   cfg=run_cfg, max_top_k=self.max_top_k,
@@ -1212,6 +1220,47 @@ class ServingEngine:
                                   cache_pin=self._cache_pin,
                                   tele=self._tick_tele),
                 donate_argnums=(1, 2), static_argnames=("sampling",))
+        self._decode_variants[bool(spec)] = fn
+        return fn
+
+    def set_spec_drafts(self, enabled: bool) -> bool:
+        """Toggle speculative-decode drafts live (the brownout ladder's
+        level-1 lever): flipping OFF swaps the decode jit to the plain
+        tick — drafts burn extra FLOPs for latency, and greedy streams
+        are bit-identical with or without them, so the switch frees
+        capacity with nothing user-visible. Only an engine BUILT with
+        spec on can re-enable (`enabled=True` is a no-op otherwise);
+        the first flip in each direction compiles the other variant
+        once (a warmup-class recompile — the zero-recompile invariant
+        counts steady-state ticks, and each variant's trace cache
+        persists across later flips). Returns the live spec flag."""
+        want = bool(enabled) and self._spec_capable
+        if want == self.spec:
+            return self.spec
+        self.spec = want
+        self._tick_span = self.mt_k * ((self.spec_gamma + 1) if want
+                                       else 1)
+        self._decode = self._build_decode(want)
+        return self.spec
+
+    def _make_executables(self) -> None:
+        """Build (or REBUILD) the jitted bodies — decode tick, bucketed/
+        chunked prefill, COW page copy — from the engine's current mesh
+        state. Extracted from __init__ so `rebuild_on_mesh` (preemption
+        recovery) can re-jit on the surviving mesh: the partials close
+        over `self._cache_pin`, which a mesh change invalidates. Must
+        run AFTER `_new_cache` has pinned the cache layout (the pin
+        dict is closed over by identity). Fresh jits start with empty
+        trace caches — one warmup recompile per body, then the
+        trace-count ceilings hold exactly as at first construction."""
+        run_cfg = self._run_cfg
+        self._repin = None      # lazy identity re-pin (see _pin_cache_host)
+        # the decode jit is keyed by the LIVE spec flag: brownout's
+        # set_spec_drafts swaps between the spec and non-spec variants
+        # without touching prefill/COW, and a mesh rebuild resets the
+        # cache (the partials close over a pin the new mesh invalidates)
+        self._decode_variants = {}
+        self._decode = self._build_decode(self.spec)
         if self.paged:
             self._prefill = jax.jit(
                 functools.partial(_prefill_chunk,
@@ -1432,6 +1481,7 @@ class ServingEngine:
                top_k: int = 0, eos_id: Optional[int] = None,
                deadline_s: Optional[float] = None,
                deadline_ticks: Optional[int] = None,
+               tenant: str = "default", priority: int = 0,
                _trace=None) -> Request:
         """Queue one request. prompt: 1-D int token ids. Returns the
         live Request; its .tokens fills in as the engine steps.
@@ -1485,7 +1535,8 @@ class ServingEngine:
                       deadline_s=(None if deadline_s is None
                                   else float(deadline_s)),
                       deadline_ticks=(None if deadline_ticks is None
-                                      else int(deadline_ticks)))
+                                      else int(deadline_ticks)),
+                      tenant=tenant, priority=priority)
         req.t_submit = time.perf_counter()
         req._tick_submit = self._ticks
         req._engine = self
@@ -2654,6 +2705,8 @@ class ServingEngine:
                 "temperature": float(req.temperature),
                 "top_k": int(req.top_k),
                 "eos_id": req.eos_id,
+                "tenant": req.tenant,
+                "priority": req.priority,
                 "pos": pos,
                 "cur_tok": int(self._cur_tok[slot]),
                 "gen_idx": int(self._gen_idx[slot]),
@@ -2700,7 +2753,9 @@ class ServingEngine:
                       deadline_s=(None if deadline_s is None
                                   else float(deadline_s)),
                       deadline_ticks=(None if deadline_ticks is None
-                                      else int(deadline_ticks)))
+                                      else int(deadline_ticks)),
+                      tenant=str(snap.get("tenant", "default")),
+                      priority=int(snap.get("priority", 0)))
         self._next_id += 1
         req.t_submit = time.perf_counter()
         req._tick_submit = self._ticks

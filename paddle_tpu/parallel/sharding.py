@@ -59,6 +59,17 @@ def host_memory_kind():
     return None
 
 
+def device_memory_kind():
+    """The backend's DEFAULT (compute) memory kind — 'device' on TPU
+    PJRT, 'unpinned_host' on the CPU backend, whose only memory space
+    IS host memory. Offload round-trips must target this rather than a
+    literal 'device', which the CPU backend rejects."""
+    try:
+        return jax.devices()[0].default_memory().kind
+    except Exception:
+        return "device"
+
+
 def shard_model_stage3(model, mesh=None):
     """Parameter sharding (ZeRO-3): each param's dim-0 over the fsdp axis."""
     mesh = mesh or get_mesh()
@@ -116,10 +127,11 @@ def shard_optimizer_state(optimizer, mesh=None, offload=False):
 
         def build_offloaded(params):
             inner = orig_build(params)
+            dev_kind = device_memory_kind()
 
             def to_dev(v):
                 return jax.device_put(
-                    v, v.sharding.with_memory_kind("device"))
+                    v, v.sharding.with_memory_kind(dev_kind))
 
             def to_host(v):
                 return jax.device_put(

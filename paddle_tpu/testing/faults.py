@@ -91,6 +91,33 @@ the guard's poll index):
                           migration attempt at/after tick T fails
                           once (fallback + migrate_fallbacks
                           counter).
+- ``quota_flood@T:N``   — at router tick T, burst N low-priority
+                          flood-tenant submissions through the
+                          router's own submit path
+                          (`EngineRouter._inject_flood` — quota and
+                          backpressure rejects swallowed): the
+                          multi-tenant isolation drill asserts OTHER
+                          tenants' admission and latency hold. N
+                          defaults to 1.
+- ``sigkill@T``         — at serving/router tick T: a REAL
+                          `SIGKILL` to our own pid (no flush, no
+                          atexit — harsher than ``kill``'s
+                          `os._exit`, indistinguishable from the OOM
+                          killer). The marker is fsynced first, so
+                          the drill's restart runs clean; the
+                          process-crash-replay drill
+                          (tools/chaos_serving.py) restarts over the
+                          same `journal_dir` and asserts every
+                          journal-accepted request still reaches
+                          exactly one terminal.
+
+Journal fault kind (inference/journal.py consults `on_journal_recover`
+through `journal._FAULT_HOOK` once per WAL recovery, BEFORE reading):
+
+- ``journal_torn@N``    — truncate N bytes off the request WAL's tail
+                          before recovery parses it (the torn-tail
+                          drill: the half-written record must drop,
+                          everything before it must replay).
 
 Elastic (mesh-level) fault kinds (parallel/elastic.py consults
 `on_elastic` through `elastic._FAULT_HOOK` at its phase boundaries —
@@ -138,13 +165,16 @@ KILL_EXIT = 37
 _KINDS = ("kill", "crash_shard", "nan", "hb_stale", "elastic_exit",
           "nan_logits", "tick_stall", "prefill_raise", "decode_raise",
           "cow_raise", "draft_nan", "device_loss", "collective_hang",
-          "straggler", "replica_preempt", "migrate_raise", "oom")
+          "straggler", "replica_preempt", "migrate_raise", "oom",
+          "quota_flood", "sigkill", "journal_torn")
 _SERVING_KINDS = frozenset(
     {"nan_logits", "tick_stall", "prefill_raise", "decode_raise",
-     "cow_raise", "draft_nan", "migrate_raise", "oom"})
+     "cow_raise", "draft_nan", "migrate_raise", "oom", "sigkill"})
 _ELASTIC_KINDS = frozenset(
     {"device_loss", "collective_hang", "straggler"})
-_ROUTER_KINDS = frozenset({"replica_preempt", "migrate_raise"})
+_ROUTER_KINDS = frozenset({"replica_preempt", "migrate_raise",
+                           "quota_flood", "sigkill"})
+_JOURNAL_KINDS = frozenset({"journal_torn"})
 
 
 @dataclass
@@ -315,6 +345,12 @@ class FaultPlan:
                 actions["raise_migrate"] = True
             elif f.kind == "oom":
                 actions["raise_oom"] = True
+            elif f.kind == "sigkill":
+                # marker already durable (above): a restart won't
+                # re-fire. Real SIGKILL — no flush, no atexit, the
+                # journal's fsynced WAL is all that survives.
+                import signal
+                os.kill(os.getpid(), signal.SIGKILL)
         return actions
 
     def on_router_tick(self, tick: int) -> dict:
@@ -343,6 +379,34 @@ class FaultPlan:
                 print(f"[faults] migrate_raise at tick {tick}",
                       file=sys.stderr, flush=True)
                 actions["raise_migrate"] = True
+            elif f.kind == "quota_flood":
+                self._mark_fired(f)
+                print(f"[faults] quota_flood at tick {tick} "
+                      f"(n={max(f.arg, 1)})", file=sys.stderr,
+                      flush=True)
+                actions["quota_flood"] = max(f.arg, 1)
+            elif f.kind == "sigkill":
+                self._mark_fired(f)
+                print(f"[faults] sigkill at router tick {tick}",
+                      file=sys.stderr, flush=True)
+                import signal
+                os.kill(os.getpid(), signal.SIGKILL)
+        return actions
+
+    def on_journal_recover(self) -> dict:
+        """journal._FAULT_HOOK: consulted ONCE per request-WAL
+        recovery, BEFORE the file is read; returns
+        {"journal_torn": nbytes} to truncate the WAL tail first (the
+        torn-tail drill — `journal_torn@N`'s coordinate is the BYTE
+        count, not a tick). Fires at most once (marker scheme)."""
+        actions: dict = {}
+        for f in self.faults:
+            if f.done or f.kind not in _JOURNAL_KINDS:
+                continue
+            self._mark_fired(f)
+            print(f"[faults] journal_torn: truncating {max(f.step, 0)} "
+                  f"bytes off the WAL tail", file=sys.stderr, flush=True)
+            actions["journal_torn"] = max(f.step, 0)
         return actions
 
 
@@ -362,12 +426,13 @@ def install(spec: Optional[str] = None,
         else os.environ.get(ENV_ONCE_DIR) or None
     plan = FaultPlan(spec, once_dir=once)
     from ..parallel import checkpoint, elastic, resilience
-    from ..inference import autoscale, router, serving
+    from ..inference import autoscale, journal, router, serving
     resilience._STEP_HOOK = plan.on_step
     checkpoint._SHARD_WRITE_HOOK = plan.on_shard_write
     serving._FAULT_HOOK = plan.on_serving_tick
     router._FAULT_HOOK = plan.on_router_tick
     autoscale._FAULT_HOOK = plan.on_router_tick
+    journal._FAULT_HOOK = plan.on_journal_recover
     elastic._FAULT_HOOK = plan.on_elastic
     _PLAN = plan
     return plan
@@ -376,12 +441,13 @@ def install(spec: Optional[str] = None,
 def uninstall() -> None:
     global _PLAN
     from ..parallel import checkpoint, elastic, resilience
-    from ..inference import autoscale, router, serving
+    from ..inference import autoscale, journal, router, serving
     resilience._STEP_HOOK = None
     checkpoint._SHARD_WRITE_HOOK = None
     serving._FAULT_HOOK = None
     router._FAULT_HOOK = None
     autoscale._FAULT_HOOK = None
+    journal._FAULT_HOOK = None
     elastic._FAULT_HOOK = None
     _PLAN = None
 

@@ -96,7 +96,7 @@ SMOKE_FILES = {
     "test_serving.py", "test_serving_robustness.py", "test_paged_kv.py",
     "test_spec_decode.py", "test_tp_serving.py", "test_quant_serving.py",
     "test_serving_observability.py", "test_autoscale.py",
-    "test_multi_tick.py",
+    "test_multi_tick.py", "test_admission.py",
     # high-level API + aux subsystems
     "test_hapi.py", "test_profiler.py", "test_checkpoint.py",
     "test_tokenizer.py", "test_misc_modules.py", "test_telemetry.py",

@@ -1,0 +1,477 @@
+"""Overload-resilience unit surface: multi-tenant admission
+(inference/admission.py), the brownout ladder (inference/brownout.py),
+and the crash-safe request journal (inference/journal.py) — plus their
+router integration seams (suspend-to-host / resume, journal replay).
+
+Reference analog: the elastic fleet manager's admission + staged
+response discipline (fleet/elastic/manager.py:124) applied to serving
+requests; the subprocess crash drills live in tools/chaos_serving.py
+(process_crash_replay) — here is the in-process (smoke-tier) surface.
+
+Load-bearing guarantees under test:
+- token-bucket arithmetic is exact on an injected clock and a rejected
+  charge deducts NOTHING (QuotaExceededError.retry_after_s is the true
+  refill wait);
+- order() is priority-strict then weighted-fair; preempt_candidate
+  never inverts or equalizes priority classes;
+- the WAL survives a torn tail (intact prefix kept), end-only ids
+  never replay, and a recovered router replays un-terminal admits with
+  their original ids;
+- a suspended victim resumes with ZERO re-prefilled tokens and a
+  bit-identical greedy stream;
+- the brownout ladder escalates/recovers one level at a time with
+  hysteresis + cooldown, driving the documented router levers.
+"""
+import os
+import types
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.inference.admission import (AdmissionController,
+                                            QuotaExceededError,
+                                            TenantQuota)
+from paddle_tpu.inference.brownout import (BROWNOUT_LEVELS,
+                                           BrownoutConfig,
+                                           BrownoutController)
+from paddle_tpu.inference.journal import WAL_NAME, RequestJournal
+from paddle_tpu.inference.router import create_router
+from paddle_tpu.models.gpt import (GPTConfig, init_gpt_params,
+                                   greedy_generate)
+from paddle_tpu.profiler import monitor
+
+MAXLEN = 32
+
+
+def _gpt_cfg():
+    return GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                     num_heads=2, ffn_hidden=64, max_seq_len=64,
+                     sequence_parallel=False, remat=False,
+                     dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def gpt_setup():
+    cfg = _gpt_cfg()
+    return cfg, init_gpt_params(cfg, jax.random.PRNGKey(0))
+
+
+def _prompts(lens, seed=0, vocab=64):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, vocab, L).astype(np.int32) for L in lens]
+
+
+def _want(params, cfg, prompt, n):
+    out = greedy_generate(params, jnp.asarray(prompt)[None], cfg, n,
+                          max_len=MAXLEN)
+    return np.asarray(out)[0, len(prompt):]
+
+
+def _router(params, cfg, **kw):
+    kw.setdefault("replicas", 1)
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_len", MAXLEN)
+    kw.setdefault("concurrent", False)
+    return create_router(params, cfg, family="gpt", **kw)
+
+
+def _req(rid, tenant="default", priority=0, done=False):
+    return types.SimpleNamespace(id=rid, tenant=tenant,
+                                 priority=priority, done=done)
+
+
+# --------------------------------------------------------------------------
+# quotas: validation + token-bucket arithmetic
+# --------------------------------------------------------------------------
+class TestTenantQuota:
+    def test_rate_limited_needs_burst(self):
+        with pytest.raises(ValueError, match="burst"):
+            TenantQuota(tokens_per_s=5.0, burst=0.0)
+
+    def test_weight_positive(self):
+        with pytest.raises(ValueError, match="weight"):
+            TenantQuota(weight=0.0)
+
+    def test_default_is_unmetered(self):
+        q = TenantQuota()
+        assert q.tokens_per_s == 0.0 and q.weight == 1.0
+
+
+class TestTokenBucket:
+    def _adm(self, t, **quotas):
+        return AdmissionController(quotas, clock=lambda: t[0])
+
+    def test_charge_refill_retry_arithmetic(self):
+        t = [0.0]
+        adm = self._adm(t, a=TenantQuota(tokens_per_s=5.0, burst=20.0))
+        adm.charge("a", 15)                     # level 20 -> 5
+        with pytest.raises(QuotaExceededError) as ei:
+            adm.charge("a", 10)
+        e = ei.value
+        assert e.tenant == "a"
+        assert e.tokens_requested == 10
+        assert e.tokens_available == pytest.approx(5.0)
+        # exact refill wait: (10 - 5) / 5/s = 1.0 s
+        assert e.retry_after_s == pytest.approx(1.0)
+        # the reject deducted nothing: the 5 banked tokens still spend
+        adm.charge("a", 5)
+        # ... and after exactly retry_after_s the rejected charge fits
+        t[0] += 2.0                             # refill 10 tokens
+        adm.charge("a", 10)
+
+    def test_burst_caps_banking(self):
+        t = [0.0]
+        adm = self._adm(t, a=TenantQuota(tokens_per_s=5.0, burst=20.0))
+        t[0] += 1e6                             # a very quiet tenant
+        with pytest.raises(QuotaExceededError):
+            adm.charge("a", 21)                 # bank capped at burst
+        adm.charge("a", 20)
+
+    def test_unknown_tenant_gets_default_unmetered(self):
+        t = [0.0]
+        adm = self._adm(t)
+        adm.charge("anyone", 10 ** 9)           # never raises
+
+    def test_stats_reports_live_level(self):
+        t = [0.0]
+        adm = self._adm(t, a=TenantQuota(tokens_per_s=5.0, burst=20.0))
+        adm.charge("a", 15)
+        t[0] += 1.0
+        assert adm.stats()["a"]["tokens_available"] == pytest.approx(
+            10.0)
+
+
+# --------------------------------------------------------------------------
+# fairness + preemption policy
+# --------------------------------------------------------------------------
+class TestFairOrder:
+    def test_priority_strictly_dominates(self):
+        adm = AdmissionController()
+        reqs = [_req(1, priority=0), _req(2, priority=5),
+                _req(3, priority=1)]
+        assert [r.id for r in adm.order(reqs)] == [2, 3, 1]
+
+    def test_vtime_orders_equal_priority(self):
+        t = [0.0]
+        adm = AdmissionController(
+            {"heavy": TenantQuota(), "light": TenantQuota()},
+            clock=lambda: t[0])
+        adm.note_dispatch("heavy", 1000)        # flooded already
+        reqs = [_req(1, tenant="heavy"), _req(2, tenant="light"),
+                _req(3, tenant="heavy")]
+        # the light tenant's backlog jumps the flood; FIFO within one
+        assert [r.id for r in adm.order(reqs)] == [2, 1, 3]
+
+    def test_weight_scales_virtual_time(self):
+        adm = AdmissionController(
+            {"w2": TenantQuota(weight=2.0), "w1": TenantQuota()})
+        adm.note_dispatch("w2", 100)            # vtime 50
+        adm.note_dispatch("w1", 100)            # vtime 100
+        reqs = [_req(1, tenant="w1"), _req(2, tenant="w2")]
+        assert [r.id for r in adm.order(reqs)] == [2, 1]
+
+
+class TestPreemptCandidate:
+    def test_picks_lowest_class_most_recent(self):
+        adm = AdmissionController()
+        inflight = [_req(1, priority=0), _req(2, priority=0),
+                    _req(3, priority=1)]
+        v = adm.preempt_candidate(inflight, priority=2)
+        assert v.id == 2                        # lowest class, least sunk
+
+    def test_never_equalizes_priority(self):
+        adm = AdmissionController()
+        inflight = [_req(1, priority=1), _req(2, priority=2)]
+        assert adm.preempt_candidate(inflight, priority=1) is None
+
+    def test_skips_done(self):
+        adm = AdmissionController()
+        assert adm.preempt_candidate([_req(1, done=True)], 5) is None
+
+
+# --------------------------------------------------------------------------
+# the request WAL
+# --------------------------------------------------------------------------
+class TestJournal:
+    def _admit(self, j, rid, prompt=(1, 2, 3), n=4):
+        j.record_admit(rid, list(prompt), n, 0.0, 0, None, "default", 0)
+
+    def test_round_trip_and_next_id(self, tmp_path):
+        j = RequestJournal(str(tmp_path))
+        self._admit(j, 1)
+        self._admit(j, 2)
+        j.record_terminal(1, "length", tokens=4)
+        j.close()
+        j2 = RequestJournal(str(tmp_path))
+        reps = j2.replayable()
+        assert [r["id"] for r in reps] == [2]
+        assert reps[0]["prompt"] == [1, 2, 3]
+        assert reps[0]["max_new_tokens"] == 4
+        assert j2.next_id == 3
+        j2.close()
+
+    def test_end_only_ids_never_replay(self, tmp_path):
+        j = RequestJournal(str(tmp_path))
+        j.record_terminal(7, "rejected", tokens=0)
+        j.close()
+        j2 = RequestJournal(str(tmp_path))
+        assert j2.replayable() == []
+        assert j2.next_id == 8                  # ids stay monotonic
+        j2.close()
+
+    def test_torn_tail_truncated_to_intact_prefix(self, tmp_path):
+        j = RequestJournal(str(tmp_path))
+        self._admit(j, 1)
+        self._admit(j, 2)
+        j.close()
+        path = os.path.join(str(tmp_path), WAL_NAME)
+        intact = os.path.getsize(path)
+        with open(path, "ab") as f:             # a torn (CRC-less) tail
+            f.write(b"deadbeef {\"op\": \"adm")
+        torn0 = monitor.counter("serving.journal.torn").value
+        j2 = RequestJournal(str(tmp_path))
+        assert [r["id"] for r in j2.replayable()] == [1, 2]
+        assert os.path.getsize(path) == intact  # tail truncated away
+        assert monitor.counter("serving.journal.torn").value > torn0
+        j2.close()
+
+    def test_corrupt_crc_stops_scan(self, tmp_path):
+        j = RequestJournal(str(tmp_path))
+        self._admit(j, 1)
+        self._admit(j, 2)
+        j.close()
+        path = os.path.join(str(tmp_path), WAL_NAME)
+        raw = open(path, "rb").read().splitlines(keepends=True)
+        with open(path, "wb") as f:             # flip a byte in rec 2
+            f.write(raw[0] + raw[1][:12] + b"X" + raw[1][13:])
+        j2 = RequestJournal(str(tmp_path))
+        assert [r["id"] for r in j2.replayable()] == [1]
+        j2.close()
+
+
+# --------------------------------------------------------------------------
+# router integration: replay + suspend/resume
+# --------------------------------------------------------------------------
+class TestRouterReplay:
+    def test_crash_replay_bit_identical(self, gpt_setup, tmp_path):
+        cfg, params = gpt_setup
+        prompts = _prompts([3, 5], seed=40)
+        r1 = _router(params, cfg, journal_dir=str(tmp_path))
+        a = r1.submit(prompts[0], 6)
+        b = r1.submit(prompts[1], 6)
+        # "crash": no drain, no terminals — only the fsynced WAL is
+        # left behind (the executor is concurrent=False; nothing to
+        # shut down)
+        del r1
+        r2 = _router(params, cfg, journal_dir=str(tmp_path))
+        st = r2.stats()
+        assert st["pending"] == 2
+        assert monitor.counter("serving.journal.replays").value >= 2
+        r2.drain()
+        j = r2.stats()["journal"]
+        assert j["replayable"] == 0
+        assert j["ends"] == j["admits"] == 2
+        # a fresh submit picks up AFTER the recovered ids
+        c = r2.submit(prompts[0], 2)
+        assert c.id > max(a.id, b.id)
+        r2.drain()
+        r2.close()
+
+    def test_replayed_streams_match_oracle(self, gpt_setup, tmp_path):
+        cfg, params = gpt_setup
+        prompts = _prompts([4, 6], seed=41)
+        r1 = _router(params, cfg, journal_dir=str(tmp_path))
+        for p in prompts:
+            r1.submit(p, 5)
+        del r1                                   # crash before any tick
+        r2 = _router(params, cfg, journal_dir=str(tmp_path))
+        streams = {}
+        while r2.has_work():
+            for req, tok in r2.step():
+                streams.setdefault(req.id, []).append(int(tok))
+        # the replayed requests kept their original ids 0/1 and their
+        # greedy streams are bit-identical to the oracle
+        assert sorted(streams) == [0, 1]
+        for rid, p in zip((0, 1), prompts):
+            np.testing.assert_array_equal(
+                np.asarray(streams[rid], np.int32),
+                _want(params, cfg, p, 5)[:len(streams[rid])])
+        assert r2.stats()["journal"]["replayable"] == 0
+        r2.close()
+
+
+class TestSuspendResume:
+    def test_zero_reprefill_and_bit_parity(self, gpt_setup, tmp_path):
+        cfg, params = gpt_setup
+        router = _router(params, cfg, admission={},
+                         journal_dir=str(tmp_path))
+        prompts = _prompts([3, 4, 5], seed=42)
+        low = [router.submit(p, 12, priority=0) for p in prompts[:2]]
+        for _ in range(3):
+            router.step()
+        pre0 = monitor.counter("serving.prefills").value
+        hi = router.submit(prompts[2], 12, priority=5)
+        assert router.stats()["suspended"] == 1
+        assert monitor.counter(
+            "serving.admission.preemptions").value >= 1
+        router.drain()
+        # ONE new prefill total: the high-priority request's. The
+        # resumed victim re-prefilled NOTHING (snapshot_request parked
+        # its KV pages in the host tier and restore put them back).
+        assert monitor.counter("serving.prefills").value == pre0 + 1
+        for r, p in zip(low + [hi], prompts):
+            assert r.done and r.finish_reason in ("length", "eos")
+            assert r.requeues == 0
+            np.testing.assert_array_equal(
+                np.asarray(r.tokens, np.int32),
+                _want(params, cfg, p, 12)[:len(r.tokens)])
+        assert router.stats()["suspended"] == 0
+        router.close()
+
+
+# --------------------------------------------------------------------------
+# brownout ladder
+# --------------------------------------------------------------------------
+class _Obj:
+    def __init__(self, name="ttft_p99"):
+        self.name = name
+
+
+class _FakeSLO:
+    """BurnRateMonitor stand-in: pairs + objectives + burn_rate()."""
+
+    def __init__(self):
+        self.pairs = [(3600.0, 60.0)]
+        self.objectives = [_Obj()]
+        self.burn = 0.0
+
+    def burn_rate(self, name, window, now=None):
+        return self.burn
+
+
+class _LeverRouter:
+    """Records the brownout levers; _ticks for the flight note."""
+
+    def __init__(self):
+        self.calls = []
+        self._ticks = 0
+        self._clock = lambda: 0.0
+
+    def set_spec_drafts(self, on):
+        self.calls.append(("spec", bool(on)))
+        return bool(on)
+
+    def set_resume_hold(self, on):
+        self.calls.append(("hold", bool(on)))
+
+    def suspend_lowest_class(self):
+        self.calls.append(("suspend", None))
+        return 1
+
+    def shed_oldest_pending(self, n=1):
+        self.calls.append(("shed", n))
+        return n
+
+
+class TestBrownout:
+    def _ctrl(self, slo, router=None, **cfg):
+        cfg.setdefault("breach_ticks", 2)
+        cfg.setdefault("recover_ticks", 2)
+        cfg.setdefault("cooldown_s", 0.0)
+        t = [0.0]
+        ctrl = BrownoutController(router or _LeverRouter(), slo=slo,
+                                  cfg=BrownoutConfig(**cfg),
+                                  clock=lambda: t[0])
+        return ctrl, t
+
+    def test_full_ladder_up_and_down(self):
+        slo = _FakeSLO()
+        ctrl, t = self._ctrl(slo)
+        r = ctrl.router
+        slo.burn = 2.0
+        moves = []
+        for _ in range(8):
+            t[0] += 1.0
+            m = ctrl.tick()
+            if m:
+                moves.append((m, ctrl.level))
+        assert moves == [("escalate", 1), ("escalate", 2),
+                         ("escalate", 3)]
+        assert ctrl.level == 3 == ctrl.cfg.max_level
+        assert monitor.gauge("serving.brownout_level").value == 3
+        # enter actions ran in ladder order; level 3 sheds every tick
+        assert ("spec", False) in r.calls
+        assert ("hold", True) in r.calls and ("suspend", None) in r.calls
+        assert [c for c in r.calls if c[0] == "shed"]
+        r.calls.clear()
+        slo.burn = 0.0
+        moves = []
+        for _ in range(8):
+            t[0] += 1.0
+            m = ctrl.tick()
+            if m:
+                moves.append((m, ctrl.level))
+        assert moves == [("recover", 2), ("recover", 1),
+                         ("recover", 0)]
+        assert ctrl.level == 0
+        assert monitor.gauge("serving.brownout_level").value == 0
+        # exit actions undo in reverse ladder order
+        assert ("hold", False) in r.calls and ("spec", True) in r.calls
+
+    def test_hysteresis_needs_consecutive_breaches(self):
+        slo = _FakeSLO()
+        ctrl, t = self._ctrl(slo, breach_ticks=3)
+        slo.burn = 2.0
+        for _ in range(2):
+            t[0] += 1.0
+            assert ctrl.tick() is None
+        slo.burn = 0.0                          # streak broken
+        t[0] += 1.0
+        assert ctrl.tick() is None
+        slo.burn = 2.0
+        for _ in range(2):
+            t[0] += 1.0
+            assert ctrl.tick() is None          # streak restarts at 0
+        t[0] += 1.0
+        assert ctrl.tick() == "escalate"
+
+    def test_cooldown_gates_transitions(self):
+        slo = _FakeSLO()
+        ctrl, t = self._ctrl(slo, cooldown_s=10.0)
+        slo.burn = 2.0
+        for _ in range(4):
+            t[0] += 1.0
+            ctrl.tick()
+        assert ctrl.level == 1                  # second step blocked
+        t[0] += 10.0
+        for _ in range(2):
+            ctrl.tick()
+        assert ctrl.level == 2
+
+    def test_without_slo_never_escalates(self):
+        ctrl, t = self._ctrl(None)
+        for _ in range(10):
+            t[0] += 1.0
+            assert ctrl.tick() is None
+        assert ctrl.level == 0
+
+    def test_max_level_validated(self):
+        with pytest.raises(ValueError, match="max_level"):
+            BrownoutConfig(max_level=9)
+
+
+# --------------------------------------------------------------------------
+# the spec-drafts lever on a spec-less engine
+# --------------------------------------------------------------------------
+class TestSpecDraftLever:
+    def test_specless_engine_noop(self, gpt_setup):
+        from paddle_tpu.inference.serving import ServingEngine
+        cfg, params = gpt_setup
+        eng = ServingEngine(params, cfg, family="gpt", num_slots=2,
+                            max_len=MAXLEN)
+        span0 = eng._tick_span
+        assert eng.set_spec_drafts(True) is False   # never spec-capable
+        assert eng.set_spec_drafts(False) is False
+        assert eng._tick_span == span0

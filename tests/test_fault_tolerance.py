@@ -480,6 +480,40 @@ class TestFaultPlan:
         plan2 = faults.FaultPlan("migrate_raise@2")
         assert plan2.on_serving_tick(2) == {"raise_migrate": True}
 
+    def test_parse_overload_kinds(self):
+        """PR-20 overload kinds: quota_flood carries its :N burst size,
+        sigkill is argless, and journal_torn's coordinate is a BYTE
+        count (the step slot, not an @T tick)."""
+        plan = faults.FaultPlan("quota_flood@3:5, sigkill@9, "
+                                "journal_torn@16")
+        kinds = [(f.kind, f.step, f.arg) for f in plan.faults]
+        assert kinds == [("quota_flood", 3, 5), ("sigkill", 9, 1),
+                         ("journal_torn", 16, 1)]
+
+    def test_quota_flood_router_action(self):
+        plan = faults.FaultPlan("quota_flood@3:5")
+        assert plan.on_router_tick(2) == {}
+        assert plan.on_router_tick(3) == {"quota_flood": 5}
+        assert plan.on_router_tick(3) == {}      # once-marker consumed
+
+    def test_journal_torn_recover_hook(self):
+        """on_journal_recover fires once and reports the byte count;
+        it must NOT leak into the tick hooks (journal_torn is a
+        recovery-time fault, not a tick fault)."""
+        plan = faults.FaultPlan("journal_torn@16")
+        assert plan.on_router_tick(16) == {}
+        assert plan.on_serving_tick(16) == {}
+        assert plan.on_journal_recover() == {"journal_torn": 16}
+        assert plan.on_journal_recover() == {}   # once per recovery
+
+    def test_sigkill_aims_at_both_tick_hooks(self):
+        """sigkill is in the serving AND router kind sets — parse only;
+        firing it would SIGKILL the test process. Verify membership so
+        a refactor can't silently strip one of the hooks."""
+        assert "sigkill" in faults._SERVING_KINDS
+        assert "sigkill" in faults._ROUTER_KINDS
+        assert "sigkill" not in faults._JOURNAL_KINDS
+
     def test_bad_spec_rejected(self):
         with pytest.raises(ValueError, match="unknown fault kind"):
             faults.FaultPlan("explode@3")
@@ -508,15 +542,18 @@ class TestFaultPlan:
 
     def test_install_uninstall(self, monkeypatch):
         monkeypatch.setenv(faults.ENV_SPEC, "nan@1:1")
+        from paddle_tpu.inference import journal
         plan = faults.install()
         try:
             assert plan is not None
             assert resilience._STEP_HOOK is not None
             assert ckpt._SHARD_WRITE_HOOK is not None
+            assert journal._FAULT_HOOK is not None
         finally:
             faults.uninstall()
         assert resilience._STEP_HOOK is None
         assert ckpt._SHARD_WRITE_HOOK is None
+        assert journal._FAULT_HOOK is None
 
     def test_install_noop_without_spec(self, monkeypatch):
         monkeypatch.delenv(faults.ENV_SPEC, raising=False)

@@ -511,3 +511,106 @@ class TestFacadePassthrough:
                     max_queue=8)
         assert gm._serving_engine is not eng
         assert gm._serving_engine.max_queue == 8
+
+
+# --------------------------------------------------------------------------
+# overload terminality (PR-20 satellite: the trace-leak regression)
+# --------------------------------------------------------------------------
+class TestOverloadTraceLeak:
+    """Every shed / suspended / quota-rejected request must leave
+    EXACTLY one terminal trace span and one journal terminal event —
+    BEFORE any error propagates to the caller. A leak here means an
+    open root span pinned in the tracer forever and a journal admit
+    that would spuriously replay after a crash."""
+
+    def _router(self, params, cfg, **kw):
+        from paddle_tpu.inference.router import create_router
+        kw.setdefault("replicas", 1)
+        kw.setdefault("num_slots", 2)
+        kw.setdefault("max_len", MAXLEN)
+        kw.setdefault("concurrent", False)
+        kw.setdefault("tracing", True)
+        return create_router(params, cfg, family="gpt", **kw)
+
+    def _assert_no_leaks(self, router):
+        """One terminal span per trace; journal admits all terminated."""
+        from paddle_tpu.profiler import tracing
+        tr = tracing.tracer()
+        for tid in tr.trace_ids():
+            assert len(tr.terminal_spans(tid)) == 1, tid
+        j = router.stats().get("journal")
+        if j is not None:
+            assert j["replayable"] == 0
+
+    def test_quota_reject_terminal_before_raise(self, gpt_setup,
+                                                tmp_path):
+        from paddle_tpu.inference.admission import (TenantQuota,
+                                                    QuotaExceededError)
+        from paddle_tpu.profiler import tracing
+        cfg, params = gpt_setup
+        tracing.clear()
+        router = self._router(
+            params, cfg, journal_dir=str(tmp_path),
+            admission={"t": TenantQuota(tokens_per_s=1.0, burst=4.0)})
+        with pytest.raises(QuotaExceededError) as ei:
+            router.submit(_prompts([3], seed=30)[0], 8, tenant="t")
+        assert ei.value.retry_after_s > 0
+        tr = tracing.tracer()
+        assert len(tr.trace_ids()) == 1
+        terms = tr.terminal_spans(tr.trace_ids()[0])
+        assert len(terms) == 1
+        assert terms[0].attrs["reason"] == "rejected"
+        j = router.stats()["journal"]
+        # end-only record: never admitted, never replayable
+        assert j["admits"] == 0 and j["ends"] == 1
+        assert j["replayable"] == 0
+        router.close()
+
+    def test_shed_terminal_once(self, gpt_setup, tmp_path):
+        from paddle_tpu.profiler import tracing
+        cfg, params = gpt_setup
+        tracing.clear()
+        # cap the ENGINE queue so dispatch refuses and requests pool in
+        # the router's own pending deque (create_router's engines take
+        # an unbounded queue that would swallow everything)
+        from paddle_tpu.inference.router import EngineRouter
+        eng = _engine(params, cfg, num_slots=2, max_queue=1)
+        router = EngineRouter([eng], tracing=True, admission={},
+                              journal_dir=str(tmp_path))
+        prompts = _prompts([3, 4, 5, 6], seed=31)
+        reqs = [router.submit(p, 6) for p in prompts]
+        assert router.stats()["pending"] >= 1
+        shed = router.shed_oldest_pending(1)
+        assert shed == 1
+        victim = [r for r in reqs if r.done][0]
+        assert victim.finish_reason == "evicted"
+        router.drain()
+        _assert_resolved(reqs)
+        self._assert_no_leaks(router)
+        j = router.stats()["journal"]
+        assert j["admits"] == len(reqs) and j["ends"] == len(reqs)
+        router.close()
+
+    def test_suspend_resume_terminal_once(self, gpt_setup, tmp_path):
+        from paddle_tpu.profiler import tracing
+        cfg, params = gpt_setup
+        tracing.clear()
+        router = self._router(params, cfg, journal_dir=str(tmp_path),
+                              admission={})
+        prompts = _prompts([3, 4, 5], seed=32)
+        low = [router.submit(p, 10, priority=0) for p in prompts[:2]]
+        for _ in range(3):
+            router.step()
+        hi = router.submit(prompts[2], 10, priority=5)
+        assert router.stats()["suspended"] == 1
+        router.drain()
+        _assert_resolved(low + [hi])
+        from paddle_tpu.profiler import tracing as _t
+        tr = _t.tracer()
+        victim = [r for r in low if r.requeues == 0 and any(
+            s.name == "suspend"
+            for s in tr.spans(r.trace.trace_id))][0]
+        names = [s.name for s in tr.spans(victim.trace.trace_id)]
+        assert "suspend" in names and "resume" in names
+        self._assert_no_leaks(router)
+        router.close()

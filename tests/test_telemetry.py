@@ -411,6 +411,55 @@ class TestTelemetryReport:
         assert doc["bad_lines"] == 1
         assert doc["steps_recorded"] == 4
 
+    def test_admission_block(self, tmp_path):
+        """The overload-resilience family (serving.admission.* with
+        dynamic per-tenant suffixes, serving.brownout_level /
+        brownout.*, serving.journal.*) groups into ONE serving
+        'admission' block: counters as first-to-last deltas, the level
+        gauge as last value — and none of the raw keys leak into the
+        flat serving block."""
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "tools"))
+        from telemetry_report import summarize
+        path = str(tmp_path / "adm.jsonl")
+        recs = [
+            {"kind": "run", "t": 0.0, "every": 1, "fields": []},
+            {"kind": "monitor", "t": 1.0, "pid": 1, "stats": {
+                "serving.requests_submitted": 0,
+                "serving.admission.admitted.acme": 0,
+                "serving.admission.rejected.flood": 0,
+                "serving.admission.preemptions": 0,
+                "serving.brownout_level": 0,
+                "serving.brownout.escalations": 0,
+                "serving.journal.appends": 0,
+                "serving.journal.replays": 0}},
+            {"kind": "monitor", "t": 9.0, "pid": 1, "stats": {
+                "serving.requests_submitted": 12,
+                "serving.admission.admitted.acme": 9,
+                "serving.admission.rejected.flood": 3,
+                "serving.admission.preemptions": 2,
+                "serving.brownout_level": 2,
+                "serving.brownout.escalations": 2,
+                "serving.journal.appends": 21,
+                "serving.journal.replays": 1}},
+        ]
+        with open(path, "w") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+        doc = summarize(path)
+        srv = doc["serving"]
+        adm = srv["admission"]
+        assert adm["admitted.acme"] == 9
+        assert adm["rejected.flood"] == 3
+        assert adm["preemptions"] == 2
+        assert adm["brownout_level"] == 2          # gauge: last value
+        assert adm["brownout.escalations"] == 2
+        assert adm["journal.appends"] == 21
+        assert adm["journal.replays"] == 1
+        assert not any(k.startswith(("admission.", "brownout",
+                                     "journal.")) for k in srv)
+
 
 # --------------------------------------------------------- flight recorder
 class TestFlightRecorder:

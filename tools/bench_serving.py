@@ -883,6 +883,94 @@ def autoscale_main(args):
     return 0 if mismatch == 0 else 1
 
 
+def admission_main(args):
+    """--admission-overhead: the same router workload with the
+    overload-resilience machinery OFF vs ON (inference/admission.py +
+    journal.py — an AdmissionController with an unmetered default
+    tenant, so every submit runs the charge/order/note_dispatch
+    arithmetic and every accept/terminal hits the fsynced request WAL,
+    but no request is ever rejected, preempted or reordered: the A/B
+    prices the steady state, not the policies). Timed passes ALTERNATE
+    between the two warm fleets and each side reports its best (the
+    PR-5 paired methodology). One JSON line — the BASELINE.md
+    "Overload resilience" row; the acceptance bar is < 5% overhead and
+    ZERO stream mismatches (admission must not perturb greedy
+    streams)."""
+    import tempfile
+    from paddle_tpu.models.decode import next_pow2
+    from paddle_tpu.inference.router import create_router
+    from paddle_tpu.profiler import monitor
+
+    gen = args.gen
+    max_len = args.max_len or next_pow2(args.prompt_hi + gen)
+    params, cfg = _build_family(args, max_len)
+    prompts = build_workload(args.requests, args.prompt_lo,
+                             args.prompt_hi, args.vocab)
+    total = args.requests * gen
+    replicas = 2
+    _log(f"admission A/B: {args.requests} reqs, gen {gen}, "
+         f"{args.family} {args.layers}Lx{args.hidden}d, "
+         f"{replicas} replicas x {args.slots} slots")
+    jdir = tempfile.mkdtemp(prefix="bench_admission_wal_")
+
+    def build(with_admission):
+        # concurrent=False: both sides run the same single-threaded
+        # step loop, so the A/B isolates admission + WAL arithmetic
+        kw = {}
+        if with_admission:
+            kw = {"admission": {}, "journal_dir": jdir}
+        return create_router(params, cfg, replicas=replicas,
+                             family=args.family, num_slots=args.slots,
+                             max_len=max_len, concurrent=False, **kw)
+
+    def run(router):
+        reqs = [router.submit(p, gen) for p in prompts]
+        router.drain()
+        return [np.asarray(r.tokens, np.int32) for r in reqs]
+
+    r_off = build(False)
+    r_on = build(True)
+    warm_off = run(r_off)                        # compile everything
+    warm_on = run(r_on)
+    mismatch = sum(1 for a, b in zip(warm_off, warm_on)
+                   if not np.array_equal(a, b))
+    best_off = best_on = 1e18
+    repeats = 3
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        outs = run(r_off)
+        best_off = min(best_off, time.perf_counter() - t0)
+        mismatch += sum(1 for a, b in zip(warm_off, outs)
+                        if not np.array_equal(a, b))
+        t0 = time.perf_counter()
+        outs = run(r_on)
+        best_on = min(best_on, time.perf_counter() - t0)
+        mismatch += sum(1 for a, b in zip(warm_off, outs)
+                        if not np.array_equal(a, b))
+    tps_off, tps_on = total / best_off, total / best_on
+    overhead = (tps_off - tps_on) / tps_off * 100.0
+    st = r_on.stats()
+    r_on.close()
+    print(json.dumps({
+        "metric": "serving_admission_overhead",
+        "value": round(overhead, 2),
+        "unit": "%",
+        "backend": jax.devices()[0].platform,
+        "tokens_per_sec_admission_off": round(tps_off, 1),
+        "tokens_per_sec_admission_on": round(tps_on, 1),
+        "requests": args.requests, "gen": gen, "slots": args.slots,
+        "replicas": replicas, "repeats": repeats,
+        "model": f"{args.layers}Lx{args.hidden}d",
+        "family": args.family,
+        "journal_appends": monitor.counter(
+            "serving.journal.appends").value,
+        "journal_replayable": st["journal"]["replayable"],
+        "rejections": 0,             # unmetered default by design
+        "stream_mismatches": mismatch,
+    }), flush=True)
+    return 0 if mismatch == 0 else 1
+
+
 def router_main(args):
     """--router R: aggregate tokens/s through the replicated-engine
     router (inference/router.py) vs ONE engine at the same per-replica
@@ -1276,6 +1364,10 @@ def main():
                     help="A/B the Autoscaler control loop off vs on "
                          "over a 2-replica router (steady state, "
                          "paired best-of-3, bit-parity checked)")
+    ap.add_argument("--admission-overhead", action="store_true",
+                    help="A/B multi-tenant admission + request WAL "
+                         "off vs on over a 2-replica router (steady "
+                         "state, paired best-of-3, bit-parity checked)")
     args = ap.parse_args()
     if args.tp and args.tp != _TP:
         ap.error("--tp was read pre-init for the CPU pin; don't "
@@ -1296,6 +1388,8 @@ def main():
         return telemetry_main(args)
     if args.autoscale_overhead:
         return autoscale_main(args)
+    if args.admission_overhead:
+        return admission_main(args)
     if args.capacity:
         return capacity_main(args)
     if args.chunk_slo:

@@ -110,6 +110,34 @@ cache"):
                    rolls back (shared refcounts released), retry
                    succeeds, the sharer's stream stays exact
 
+Overload-resilience scenarios (multi-tenant admission + brownout +
+the request journal, inference/admission.py / brownout.py /
+journal.py; docs/serving.md "Tenancy, brownout & durability"):
+  tenant_flood     a rate-limited tenant floods (quota_flood fault:
+                   the router self-injects low-priority flood
+                   submissions mid-drill) -> the flood is quota-
+                   rejected past its burst, every paying-tenant
+                   stream completes bit-identical, and every
+                   rejection resolves terminally (no limbo, no trace
+                   leak)
+  brownout_ladder  a sustained SLO burn on an injected clock drives
+                   the full 0 -> 3 escalation (spec drafts off,
+                   lowest class suspended to host KV, oldest pending
+                   shed) and the clear drives 3 -> 0 level-by-level;
+                   streams stay bit-identical (the ladder degrades
+                   capacity, never correctness) and every transition
+                   leaves a brownout_escalate / brownout_recover
+                   flight dump
+  process_crash_replay a subprocess builds a JOURNALED router, is
+                   SIGKILLed mid-decode (sigkill fault: a real
+                   os.kill, no flush, no atexit), and the parent
+                   recovers a fresh router over the same journal_dir
+                   -> every journal-accepted request reaches EXACTLY
+                   one terminal event across both processes
+                   (at-least-once prefill, exactly-once resolution),
+                   and every replayed greedy stream is bit-identical
+                   to the fault-free run
+
 Observability requirements (every scenario, the PR-3 "parseable black
 box" pattern extended to serving): a parseable serving-telemetry JSONL
 with >= 1 serving_tick record (profiler/serving_telemetry — engines in
@@ -977,6 +1005,167 @@ def run_drill(quick: bool = False, keep_root: bool = False) -> int:
     scenario("prefill_role_death", prefill_role_death,
              want_flight=False)
 
+    # --- tenant_flood: quota-rejected flood, paying streams exact ---
+    def tenant_flood():
+        from paddle_tpu.inference.admission import TenantQuota
+        rej0 = monitor.counter(
+            "serving.admission.rejected.flood").value
+        # the flood tenant's bucket covers ONE injected request
+        # (cost 3 prompt + 4 gen = 7 tokens); the default (paying)
+        # tenant stays unmetered
+        router = make_router(
+            params, cfg, max_len, replicas=1, family="gpt",
+            num_slots=4, concurrent=False,
+            admission={"flood": TenantQuota(tokens_per_s=0.5,
+                                            burst=7.0)})
+        reqs = [router.submit(p, gen) for p in prompts]
+        router.drain(max_ticks=400)
+        err = check_terminal(reqs) or check_streams(reqs, baseline)
+        if err:
+            return err
+        rej = monitor.counter(
+            "serving.admission.rejected.flood").value - rej0
+        if rej < 1:
+            return f"flood tenant was never quota-rejected (rej={rej})"
+        if any(r.finish_reason not in ("length", "eos") for r in reqs):
+            return ("the flood touched a paying stream: "
+                    f"{[r.finish_reason for r in reqs]}")
+        return check_traces(router.replicas[0].eng)
+    scenario("tenant_flood", tenant_flood, spec="quota_flood@2:6",
+             want_flight=False)
+
+    # --- brownout_ladder: full 0->3->0 on an injected clock ---------
+    def brownout_ladder():
+        from paddle_tpu.inference.brownout import (BrownoutConfig,
+                                                   BrownoutController)
+
+        class _Obj:
+            name = "ttft"
+
+        class _SLO:
+            pairs = [(3600.0, 60.0)]
+            objectives = [_Obj()]
+            burn = 0.0
+
+            def burn_rate(self, name, window, now=None):
+                return self.burn
+
+        t = [0.0]
+        router = make_router(params, cfg, max_len, replicas=1,
+                             family="gpt", num_slots=4,
+                             concurrent=False, admission={})
+        slo = _SLO()
+        ctrl = BrownoutController(
+            router, slo=slo,
+            cfg=BrownoutConfig(breach_ticks=2, recover_ticks=2,
+                               cooldown_s=0.0),
+            clock=lambda: t[0])
+        # two priority classes in flight so level 2 has a victim
+        reqs = [router.submit(p, gen, priority=i % 2)
+                for i, p in enumerate(prompts)]
+        up = []
+        slo.burn = 2.0
+        for _ in range(8):
+            router.step()
+            t[0] += 1.0
+            if ctrl.tick():
+                up.append(ctrl.level)
+        if up != [1, 2, 3]:
+            return f"escalation trajectory {up}, wanted [1, 2, 3]"
+        down = []
+        slo.burn = 0.0
+        for _ in range(8):
+            router.step()
+            t[0] += 1.0
+            if ctrl.tick():
+                down.append(ctrl.level)
+        if down != [2, 1, 0]:
+            return f"recovery trajectory {down}, wanted [2, 1, 0]"
+        router.drain(max_ticks=400)
+        # the ladder degrades CAPACITY, never correctness: every
+        # stream (including the suspended-and-resumed victims)
+        # completes bit-identical
+        err = check_terminal(reqs) or check_streams(reqs, baseline)
+        if err:
+            return err
+        if any(r.finish_reason not in ("length", "eos") for r in reqs):
+            return ("brownout was not transparent: "
+                    f"{[r.finish_reason for r in reqs]}")
+        fdir = os.path.join(root, "brownout_ladder", "flight")
+        return (check_flight(fdir, want_reason="brownout_escalate")
+                or check_flight(fdir, want_reason="brownout_recover"))
+    scenario("brownout_ladder", brownout_ladder, want_flight=False)
+
+    # --- process_crash_replay: SIGKILL + journaled recovery ---------
+    def process_crash_replay():
+        import signal
+        import subprocess
+        sdir = os.path.join(root, "process_crash_replay")
+        jdir = os.path.join(sdir, "journal")
+        os.makedirs(jdir, exist_ok=True)
+        env = dict(os.environ)
+        env.pop(faults.ENV_SPEC, None)
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--crash-child", jdir, "--crash-n", str(n_req),
+             "--crash-gen", str(gen)],
+            capture_output=True, text=True, timeout=600, env=env)
+        if proc.returncode != -signal.SIGKILL:
+            return (f"child exited {proc.returncode}, wanted SIGKILL "
+                    f"(-{signal.SIGKILL}); stderr tail: "
+                    f"{proc.stderr[-500:]}")
+        replays0 = monitor.counter("serving.journal.replays").value
+        router = make_router(params, cfg, max_len, replicas=1,
+                             family="gpt", num_slots=4,
+                             concurrent=False, journal_dir=jdir)
+        if monitor.counter(
+                "serving.journal.replays").value == replays0:
+            return "recovery replayed nothing (sigkill too late?)"
+        streams = {}
+        ticks = 0
+        while router.has_work() and ticks < 400:
+            for req, tok in router.step():
+                streams.setdefault(req.id, []).append(int(tok))
+            ticks += 1
+        j = router.stats()["journal"]
+        if j["replayable"] != 0:
+            return f"{j['replayable']} requests still un-terminal"
+        router.close()
+        # the WAL across BOTH processes: every admitted id reaches
+        # EXACTLY one terminal event, duplicate-free
+        from paddle_tpu.inference.journal import RequestJournal
+        wal = RequestJournal(jdir, fsync=False)
+        admits, ends = set(), {}
+        with open(wal.path, "rb") as f:
+            for line in f:
+                rec = wal._parse(line.rstrip(b"\n"))
+                if rec is None:
+                    return "torn record in a cleanly-recovered WAL"
+                if rec["ev"] == "admit":
+                    admits.add(rec["id"])
+                else:
+                    ends[rec["id"]] = ends.get(rec["id"], 0) + 1
+        wal.close()
+        if not admits:
+            return "child journaled no admits"
+        missing = [i for i in admits if ends.get(i, 0) != 1]
+        if missing:
+            return (f"admits without exactly one terminal: {missing} "
+                    f"(ends={ends})")
+        # replayed greedy streams are bit-identical to the fault-free
+        # baseline (the child used the drill's own workload)
+        for rid, toks in streams.items():
+            got = np.asarray(toks, np.int32)
+            want = baseline[rid]
+            if not np.array_equal(got, want[:len(got)]):
+                return (f"replayed stream {rid} diverged: "
+                        f"{got.tolist()} vs {want.tolist()}")
+        if not streams:
+            return "no streams replayed in the parent"
+        return None
+    scenario("process_crash_replay", process_crash_replay,
+             want_flight=False)
+
     rec.clear()          # don't leak scenario records into the caller's
     #                      process-global ring (in-process test usage)
     dt = time.time() - t_start
@@ -989,6 +1178,31 @@ def run_drill(quick: bool = False, keep_root: bool = False) -> int:
         return 1
     _log(f"ALL SCENARIOS PASSED (quick={quick}) in {dt:.1f}s")
     return 0
+
+
+# ------------------------------------------------------- crash child
+def crash_child_main(jdir: str, n_req: int, gen: int) -> int:
+    """--crash-child: the sacrificial process of process_crash_replay.
+    Builds a JOURNALED router over `jdir`, submits the drill's own
+    deterministic workload, and drains under a sigkill fault — the
+    process dies mid-decode with no flush and no atexit; the fsynced
+    request WAL is all that survives for the parent to recover."""
+    from paddle_tpu.inference.router import create_router
+    from paddle_tpu.testing import faults
+    params, cfg = build_model()
+    prompts = build_workload(n_req, 3, 20, cfg.vocab_size)
+    # gen+2 ticks in: the first wave is mid-decode (some streams may
+    # already be terminal — both replay classes get exercised)
+    faults.install(f"sigkill@{gen + 2}",
+                   once_dir=os.path.join(jdir, os.pardir, "once"))
+    router = create_router(params, cfg, replicas=1, family="gpt",
+                           num_slots=4, max_len=64, concurrent=False,
+                           journal_dir=jdir)
+    for p in prompts:
+        router.submit(p, gen)
+    router.drain(max_ticks=400)      # SIGKILL fires mid-drain
+    _log("crash child survived its own sigkill fault")
+    return 3                         # a working drill never gets here
 
 
 # ------------------------------------------------------------ bench mode
@@ -1072,7 +1286,17 @@ def main() -> int:
                     help="measure guardrail overhead, print one JSON")
     ap.add_argument("--keep", action="store_true",
                     help="keep scenario artifacts")
+    ap.add_argument("--crash-child", metavar="JOURNAL_DIR",
+                    help="internal: process_crash_replay's sacrificial "
+                         "child (journaled router + sigkill fault)")
+    ap.add_argument("--crash-n", type=int, default=6,
+                    help="internal: crash-child workload size")
+    ap.add_argument("--crash-gen", type=int, default=6,
+                    help="internal: crash-child tokens per request")
     args = ap.parse_args()
+    if args.crash_child:
+        return crash_child_main(args.crash_child, args.crash_n,
+                                args.crash_gen)
     if args.bench:
         return bench_main()
     return run_drill(quick=args.quick, keep_root=args.keep)

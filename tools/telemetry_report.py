@@ -299,6 +299,8 @@ def summarize(path: str, samples_per_step: Optional[float] = None) -> dict:
                        "serving.fp_weights_bytes",
                        "serving.router.replicas_live",
                        "serving.router.pending",
+                       "serving.router.suspended",
+                       "serving.brownout_level",
                        "serving.autoscale.replicas_target",
                        "serving.autoscale.occupancy",
                        "serving.autoscale.migrated_pages_bytes",
@@ -392,6 +394,23 @@ def summarize(path: str, samples_per_step: Optional[float] = None) -> dict:
                               if k.startswith("autoscale.")]}
             if any(auto.values()):
                 srv["autoscale"] = auto
+            # the overload-resilience surface (inference/admission.py
+            # + brownout.py + journal.py): per-tenant admitted/
+            # rejected/suspended counter deltas (dynamic .<tenant>
+            # suffixes kept inside the block), preemption/resume
+            # counters, the brownout level gauge + transition/shed
+            # counters, and the request-journal WAL counters — ONE
+            # "admission" block, the overload story in one place
+            adm = {k[len("admission."):]: srv.pop(k)
+                   for k in [k for k in srv
+                             if k.startswith("admission.")]}
+            for k in [k for k in srv if k.startswith("brownout.")
+                      or k.startswith("journal.")]:
+                adm[k] = srv.pop(k)
+            if "brownout_level" in srv:
+                adm["brownout_level"] = srv.pop("brownout_level")
+            if any(adm.values()):
+                srv["admission"] = adm
             # the compiled-memory audit family reports (correctly
             # typed) under out["memory"]["audit"]["serving"] instead
             for k in [k for k in srv if k.startswith("mem.")]:
